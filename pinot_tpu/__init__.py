@@ -1,0 +1,10 @@
+"""pinot_tpu: a TPU-native realtime distributed OLAP datastore.
+
+A from-scratch reimplementation of the capabilities of Apache Pinot (reference mounted at
+/root/reference) designed TPU-first: columnar segments live in HBM as fixed-width arrays,
+the per-segment scan path (decode -> predicate masks -> projection -> group-by -> reduce)
+is jax.jit/Pallas compiled, multi-segment combine uses shard_map + ICI collectives, and the
+control plane (catalog, routing, ingestion FSMs) is host-side Python. See SURVEY.md.
+"""
+
+__version__ = "0.1.0"

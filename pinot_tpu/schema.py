@@ -1,0 +1,224 @@
+"""Table schema model: typed field specs for dimensions, metrics and time columns.
+
+TPU-native redesign of the reference's schema model
+(`pinot-spi/src/main/java/org/apache/pinot/spi/data/Schema.java` and `FieldSpec.java`).
+The key departure: every field declares a *storage dtype* that is guaranteed to be a
+fixed-width machine type so the column can live in HBM as a dense array. STRING/BYTES/JSON
+columns are therefore always dictionary-encoded; their device representation is an int32
+dict-id array and all predicate work happens on dict ids (the reference does the same on its
+scan path — see SURVEY.md §7 "Hard parts").
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class DataType(Enum):
+    """Logical column types (reference: FieldSpec.DataType)."""
+
+    INT = "INT"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    BOOLEAN = "BOOLEAN"
+    TIMESTAMP = "TIMESTAMP"  # epoch millis, stored as int64
+    STRING = "STRING"
+    JSON = "JSON"
+    BYTES = "BYTES"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (DataType.INT, DataType.LONG, DataType.FLOAT, DataType.DOUBLE,
+                        DataType.BOOLEAN, DataType.TIMESTAMP)
+
+    @property
+    def numpy_dtype(self) -> np.dtype:
+        """Host/disk representation of *raw* (non-dict-encoded) values."""
+        return {
+            DataType.INT: np.dtype(np.int32),
+            DataType.LONG: np.dtype(np.int64),
+            DataType.FLOAT: np.dtype(np.float32),
+            DataType.DOUBLE: np.dtype(np.float64),
+            DataType.BOOLEAN: np.dtype(np.int32),
+            DataType.TIMESTAMP: np.dtype(np.int64),
+            DataType.STRING: np.dtype(object),
+            DataType.JSON: np.dtype(object),
+            DataType.BYTES: np.dtype(object),
+        }[self]
+
+    @property
+    def default_null(self) -> Any:
+        """Default placeholder for nulls (reference: FieldSpec default null values)."""
+        return {
+            DataType.INT: -(2 ** 31),
+            DataType.LONG: -(2 ** 63),
+            DataType.FLOAT: float("-inf"),
+            DataType.DOUBLE: float("-inf"),
+            DataType.BOOLEAN: 0,
+            DataType.TIMESTAMP: 0,
+            DataType.STRING: "null",
+            DataType.JSON: "null",
+            DataType.BYTES: b"",
+        }[self]
+
+    def coerce(self, value: Any) -> Any:
+        """Coerce an ingested python value to this type (DataTypeTransformer analog)."""
+        if value is None:
+            return self.default_null
+        if self in (DataType.INT, DataType.LONG):
+            return int(value)
+        if self in (DataType.FLOAT, DataType.DOUBLE):
+            return float(value)
+        if self is DataType.BOOLEAN:
+            if isinstance(value, str):
+                return 1 if value.lower() in ("true", "1", "t", "yes") else 0
+            return int(bool(value))
+        if self is DataType.TIMESTAMP:
+            return int(value)
+        if self is DataType.BYTES:
+            if isinstance(value, str):
+                return bytes.fromhex(value)
+            return bytes(value)
+        if self is DataType.JSON:
+            if not isinstance(value, str):
+                return json.dumps(value)
+            return value
+        return str(value)
+
+
+class FieldRole(Enum):
+    """Reference: FieldSpec.FieldType (DIMENSION / METRIC / DATE_TIME)."""
+
+    DIMENSION = "DIMENSION"
+    METRIC = "METRIC"
+    DATE_TIME = "DATE_TIME"
+
+
+@dataclass
+class FieldSpec:
+    name: str
+    data_type: DataType
+    role: FieldRole = FieldRole.DIMENSION
+    single_value: bool = True
+    # DATE_TIME metadata (reference: DateTimeFieldSpec format/granularity)
+    format: Optional[str] = None
+    granularity: Optional[str] = None
+    default_null_value: Optional[Any] = None
+
+    @property
+    def null_value(self) -> Any:
+        if self.default_null_value is not None:
+            return self.default_null_value
+        # Metrics default to 0 (reference: FieldSpec.DEFAULT_METRIC_NULL_VALUE_OF_*) so a
+        # null-filled metric can't poison SUM/MIN; dimensions use type sentinels.
+        if self.role is FieldRole.METRIC and self.data_type.is_numeric:
+            return 0 if self.data_type.numpy_dtype.kind == "i" else 0.0
+        return self.data_type.default_null
+
+    def to_json(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "dataType": self.data_type.value,
+            "role": self.role.value,
+            "singleValue": self.single_value,
+        }
+        if self.format:
+            d["format"] = self.format
+        if self.granularity:
+            d["granularity"] = self.granularity
+        if self.default_null_value is not None:
+            d["defaultNullValue"] = self.default_null_value
+        return d
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "FieldSpec":
+        return FieldSpec(
+            name=d["name"],
+            data_type=DataType(d["dataType"]),
+            role=FieldRole(d.get("role", "DIMENSION")),
+            single_value=d.get("singleValue", True),
+            format=d.get("format"),
+            granularity=d.get("granularity"),
+            default_null_value=d.get("defaultNullValue"),
+        )
+
+
+@dataclass
+class Schema:
+    """Reference: pinot-spi Schema (JSON-serialized, stored in the catalog)."""
+
+    name: str
+    fields: List[FieldSpec] = field(default_factory=list)
+    primary_key_columns: List[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self._by_name = {f.name: f for f in self.fields}
+        if len(self._by_name) != len(self.fields):
+            raise ValueError(f"duplicate column names in schema {self.name}")
+
+    # -- accessors ---------------------------------------------------------
+    def field_spec(self, name: str) -> FieldSpec:
+        try:
+            return self._by_name[name]
+        except KeyError:
+            raise KeyError(f"unknown column {name!r} in schema {self.name}") from None
+
+    def has_column(self, name: str) -> bool:
+        return name in self._by_name
+
+    @property
+    def column_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def dimension_columns(self) -> List[str]:
+        return [f.name for f in self.fields if f.role is FieldRole.DIMENSION]
+
+    @property
+    def metric_columns(self) -> List[str]:
+        return [f.name for f in self.fields if f.role is FieldRole.METRIC]
+
+    @property
+    def time_columns(self) -> List[str]:
+        return [f.name for f in self.fields if f.role is FieldRole.DATE_TIME]
+
+    # -- serde -------------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "schemaName": self.name,
+            "fields": [f.to_json() for f in self.fields],
+            "primaryKeyColumns": self.primary_key_columns,
+        }
+
+    def to_json_str(self) -> str:
+        return json.dumps(self.to_json(), indent=2)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Schema":
+        return Schema(
+            name=d["schemaName"],
+            fields=[FieldSpec.from_json(f) for f in d.get("fields", [])],
+            primary_key_columns=d.get("primaryKeyColumns", []),
+        )
+
+    @staticmethod
+    def from_json_str(s: str) -> "Schema":
+        return Schema.from_json(json.loads(s))
+
+
+def dimension(name: str, data_type: DataType = DataType.STRING, **kw) -> FieldSpec:
+    return FieldSpec(name, data_type, FieldRole.DIMENSION, **kw)
+
+
+def metric(name: str, data_type: DataType = DataType.DOUBLE, **kw) -> FieldSpec:
+    return FieldSpec(name, data_type, FieldRole.METRIC, **kw)
+
+
+def date_time(name: str, data_type: DataType = DataType.TIMESTAMP, **kw) -> FieldSpec:
+    return FieldSpec(name, data_type, FieldRole.DATE_TIME, **kw)

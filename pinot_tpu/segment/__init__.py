@@ -1,0 +1,14 @@
+"""Columnar segment storage engine: format, dictionaries, writers, readers, indexes.
+
+TPU-native redesign of the reference's `pinot-segment-spi` + `pinot-segment-local` layers
+(see SURVEY.md §2.2/§2.3).
+"""
+
+from .dictionary import Dictionary, build_dictionary
+from .reader import ColumnReader, ImmutableSegment, load_segment
+from .writer import SegmentBuilder, SegmentGeneratorConfig
+
+__all__ = [
+    "Dictionary", "build_dictionary", "ColumnReader", "ImmutableSegment", "load_segment",
+    "SegmentBuilder", "SegmentGeneratorConfig",
+]

@@ -1,0 +1,129 @@
+"""On-disk segment format constants and low-level helpers.
+
+TPU-native analog of the reference's segment file layout
+(`pinot-segment-spi/src/main/java/org/apache/pinot/segment/spi/V1Constants.java:25-57`).
+
+Layout (one directory per segment):
+
+    <segment>/
+        metadata.json        # segment + per-column metadata, index map (v3 `metadata.properties` + `index_map`)
+        creation.meta.json   # creation time, crc (v1 `creation.meta`)
+        cols/<col>.fwd.npy   # forward index: minimal-width dict ids, or raw values
+        cols/<col>.dict.npy  # numeric dictionary (sorted values)
+        cols/<col>.dict.blob / .dictoff.npy   # string dictionary: utf-8 blob + int64 offsets
+        cols/<col>.nulls.npy # packed null bitmap (np.packbits)
+        cols/<col>.inv.npz   # bitmap inverted index (per-dict-id packed bitmaps)
+        cols/<col>.rng.npz   # bit-sliced range index
+        cols/<col>.bloom.npy # bloom filter bit array
+        cols/<col>.mvoff.npy # multi-value row offsets (int32, num_docs+1)
+        startree/*           # star-tree pre-aggregated tensors
+
+Design departures from the reference, on purpose (TPU-first):
+
+* The forward index stores dict ids **byte-aligned at minimal width** (uint8/uint16/int32)
+  instead of arbitrary-bit packing (`FixedBitSVForwardIndexReaderV2`). Byte-aligned widths
+  load into HBM with zero decode work and XLA upcasts for free; arbitrary bit widths would
+  force a host-side unpack pass. Disk cost is at most 2x the entropy bound and the scan path
+  (the thing we optimize for) is strictly faster.
+* Everything is little-endian numpy; mmap-able via `np.load(..., mmap_mode='r')`, which is the
+  exact analog of the reference's `PinotDataBuffer` mmap path
+  (`pinot-segment-spi/.../memory/PinotDataBuffer.java:54`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any, Dict, List
+
+import numpy as np
+
+SEGMENT_METADATA_FILE = "metadata.json"
+CREATION_META_FILE = "creation.meta.json"
+COLS_DIR = "cols"
+STARTREE_DIR = "startree"
+
+FWD_SUFFIX = ".fwd.npy"
+DICT_NUMERIC_SUFFIX = ".dict.npy"
+DICT_BLOB_SUFFIX = ".dict.blob"
+DICT_OFFSETS_SUFFIX = ".dictoff.npy"
+NULLS_SUFFIX = ".nulls.npy"
+INVERTED_SUFFIX = ".inv.npz"
+RANGE_SUFFIX = ".rng.npz"
+BLOOM_SUFFIX = ".bloom.npy"
+MV_OFFSETS_SUFFIX = ".mvoff.npy"
+
+FORMAT_VERSION = 1
+
+# Device blocks are padded to a multiple of this many rows: 8 sublanes x 128 lanes, the
+# float32/int32 VREG tile. Keeps every (rows/TILE)-shaped kernel landing on full tiles.
+ROW_TILE = 1024
+
+
+def minimal_dtype_for_cardinality(cardinality: int) -> np.dtype:
+    """Smallest byte-aligned unsigned dtype that can hold dict ids [0, cardinality)."""
+    if cardinality <= 1 << 8:
+        return np.dtype(np.uint8)
+    if cardinality <= 1 << 16:
+        return np.dtype(np.uint16)
+    return np.dtype(np.int32)  # dictionaries beyond 2^31 ids are not supported
+
+
+def write_json(path: str, obj: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, default=_json_default)
+    os.replace(tmp, path)
+
+
+def _json_default(o: Any) -> Any:
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not json-serializable: {type(o)}")
+
+
+def read_json(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_string_dictionary(path_prefix: str, values: List[str]) -> None:
+    """Sorted string dictionary as utf-8 blob + int64 offsets (n+1)."""
+    encoded = [v.encode("utf-8") for v in values]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    with open(path_prefix + DICT_BLOB_SUFFIX, "wb") as f:
+        f.write(b"".join(encoded))
+    np.save(path_prefix + DICT_OFFSETS_SUFFIX, offsets)
+
+
+def read_string_dictionary(path_prefix: str) -> List[str]:
+    offsets = np.load(path_prefix + DICT_OFFSETS_SUFFIX)
+    with open(path_prefix + DICT_BLOB_SUFFIX, "rb") as f:
+        blob = f.read()
+    return [blob[offsets[i]:offsets[i + 1]].decode("utf-8") for i in range(len(offsets) - 1)]
+
+
+def pack_bitmap(mask: np.ndarray) -> np.ndarray:
+    """bool[n] -> packed uint8 bitmap (np.packbits, little-bit-order for simplicity)."""
+    return np.packbits(mask.astype(np.uint8), bitorder="little")
+
+
+def unpack_bitmap(packed: np.ndarray, n: int) -> np.ndarray:
+    return np.unpackbits(packed, count=n, bitorder="little").astype(bool)
+
+
+def segment_crc(segment_dir: str) -> int:
+    """CRC over all column files, mirroring the reference's creation.meta crc."""
+    crc = 0
+    cols_dir = os.path.join(segment_dir, COLS_DIR)
+    if os.path.isdir(cols_dir):
+        for name in sorted(os.listdir(cols_dir)):
+            with open(os.path.join(cols_dir, name), "rb") as f:
+                crc = zlib.crc32(f.read(), crc)
+    return crc

@@ -1,0 +1,5 @@
+"""Auxiliary per-column indexes: inverted, range (bit-sliced), bloom, null vectors.
+
+Analog of the reference's index readers/creators under
+`pinot-segment-local/src/main/java/org/apache/pinot/segment/local/segment/index/`.
+"""

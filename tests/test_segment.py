@@ -1,0 +1,131 @@
+"""Segment format round-trip tests (reference pattern: reader/creator unit tests that
+round-trip files in temp dirs, SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment import SegmentBuilder, SegmentGeneratorConfig, load_segment
+from pinot_tpu.segment.dictionary import build_dictionary
+
+
+def test_roundtrip_values(ssb_segment_dir, ssb_schema):
+    seg_dir, cols = ssb_segment_dir
+    seg = load_segment(seg_dir)
+    assert seg.num_docs == 4096
+    assert set(seg.column_names) == set(ssb_schema.column_names)
+    for name, raw in cols.items():
+        col = seg.column(name)
+        got = col.values()
+        if isinstance(raw, np.ndarray) and raw.dtype.kind == "f":
+            np.testing.assert_allclose(got.astype(np.float64), raw, rtol=1e-6)
+        elif isinstance(raw, np.ndarray):
+            np.testing.assert_array_equal(got.astype(raw.dtype), raw)
+        else:
+            assert list(got) == list(raw)
+
+
+def test_dictionary_resolution(ssb_segment_dir):
+    seg_dir, cols = ssb_segment_dir
+    seg = load_segment(seg_dir)
+    d = seg.column("lo_region").dictionary
+    assert d is not None
+    assert sorted(set(cols["lo_region"])) == list(d.values)
+    assert d.index_of("ASIA") >= 0
+    assert d.index_of("NOWHERE") == -1
+    lo, hi = d.id_range("AMERICA", "ASIA")
+    assert [d.get(i) for i in range(lo, hi)] == ["AMERICA", "ASIA"]
+    # LIKE over the dictionary
+    ids = d.ids_matching_like("A%")
+    assert {d.get(i) for i in ids} == {"AFRICA", "AMERICA", "ASIA"}
+
+
+def test_dict_id_width_minimal(ssb_segment_dir):
+    seg_dir, _ = ssb_segment_dir
+    seg = load_segment(seg_dir)
+    region = seg.column("lo_region")
+    assert region.fwd.dtype == np.uint8  # 5 regions fit in one byte
+    assert region.cardinality == 5
+
+
+def test_inverted_index(ssb_segment_dir, ssb_schema):
+    seg_dir, cols = ssb_segment_dir
+    seg = load_segment(seg_dir)
+    col = seg.column("lo_region")
+    inv = col.inverted_index
+    assert inv is not None
+    d = col.dictionary
+    asia_id = d.index_of("ASIA")
+    docs = inv.doc_ids_for(asia_id)
+    expect = np.nonzero(np.array(cols["lo_region"], dtype=object) == "ASIA")[0]
+    np.testing.assert_array_equal(np.sort(docs), expect)
+    assert inv.match_count_for_range(asia_id, asia_id + 1) == len(expect)
+
+
+def test_range_index(ssb_segment_dir, ssb_schema):
+    seg_dir, cols = ssb_segment_dir
+    from pinot_tpu.segment.format import unpack_bitmap
+    seg = load_segment(seg_dir)
+    col = seg.column("lo_discount")
+    rng_idx = col.range_index
+    assert rng_idx is not None
+    d = col.dictionary
+    lo, hi = d.id_range(1, 3)  # discount between 1 and 3 inclusive
+    mask = unpack_bitmap(rng_idx.mask_range(lo, hi), seg.num_docs)
+    expect = (cols["lo_discount"] >= 1) & (cols["lo_discount"] <= 3)
+    np.testing.assert_array_equal(mask, expect)
+
+
+def test_bloom_filter(ssb_segment_dir):
+    seg_dir, cols = ssb_segment_dir
+    seg = load_segment(seg_dir)
+    bf = seg.column("lo_brand").bloom_filter
+    assert bf is not None
+    for v in set(cols["lo_brand"]):
+        assert bf.might_contain(v)
+    misses = sum(bf.might_contain(f"NOPE#{i}") for i in range(200))
+    assert misses <= 10  # ~1% fpp
+
+
+def test_nulls_and_defaults(tmp_path):
+    schema = Schema("t", [dimension("s", DataType.STRING), metric("m", DataType.DOUBLE)])
+    cols = {"s": ["a", None, "b", None], "m": np.array([1.0, 2.0, 3.0, 4.0])}
+    seg_dir = SegmentBuilder(schema).build(cols, str(tmp_path), "t_0")
+    seg = load_segment(seg_dir)
+    s = seg.column("s")
+    np.testing.assert_array_equal(s.null_bitmap, [False, True, False, True])
+    assert list(s.values()) == ["a", "null", "b", "null"]
+    assert seg.column("m").null_bitmap is None
+
+
+def test_raw_encoding_for_high_cardinality_metric(tmp_path):
+    schema = Schema("t", [metric("m", DataType.DOUBLE)])
+    vals = np.arange(1000, dtype=np.float64) + 0.5
+    seg_dir = SegmentBuilder(schema).build({"m": vals}, str(tmp_path), "t_0")
+    col = load_segment(seg_dir).column("m")
+    assert not col.has_dictionary
+    assert col.fwd.dtype == np.float64
+    assert col.min_value == 0.5 and col.max_value == 999.5
+
+
+def test_sorted_detection(tmp_path):
+    schema = Schema("t", [dimension("k", DataType.INT)])
+    seg_dir = SegmentBuilder(schema).build({"k": np.arange(100, dtype=np.int32)},
+                                           str(tmp_path), "t_0")
+    assert load_segment(seg_dir).column("k").is_sorted
+
+
+def test_build_dictionary_types():
+    d, ids = build_dictionary(np.array([3, 1, 2, 1], dtype=np.int64), DataType.LONG)
+    assert list(d.values) == [1, 2, 3]
+    np.testing.assert_array_equal(ids, [2, 0, 1, 0])
+    d2, ids2 = build_dictionary(["b", "a", "b"], DataType.STRING)
+    assert list(d2.values) == ["a", "b"]
+    np.testing.assert_array_equal(ids2, [1, 0, 1])
+
+
+def test_mismatched_column_lengths_rejected(tmp_path):
+    schema = Schema("t", [metric("a", DataType.INT), metric("b", DataType.INT)])
+    with pytest.raises(ValueError, match="ragged"):
+        SegmentBuilder(schema).build({"a": np.arange(3), "b": np.arange(4)},
+                                     str(tmp_path), "t_0")
