@@ -1,0 +1,5 @@
+"""Query compilation and execution: context, planner, executor, combine/reduce."""
+
+from .context import QueryContext, QueryValidationError, compile_query
+
+__all__ = ["QueryContext", "QueryValidationError", "compile_query"]

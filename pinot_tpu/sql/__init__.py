@@ -1,0 +1,15 @@
+"""SQL front-end: lexer, recursive-descent parser, expression AST.
+
+Replaces the reference's Calcite-based parser (`pinot-common/.../sql/parsers/`) — Calcite
+(and sqlglot) are unavailable here, and the supported single-table grammar is small enough
+that a hand-rolled parser is simpler than a dependency.
+"""
+
+from .ast import (Expr, Function, Identifier, Literal, OrderByItem, QueryStatement, STAR,
+                  is_aggregation, contains_aggregation)
+from .lexer import SqlSyntaxError, tokenize
+from .parser import parse_query
+
+__all__ = ["Expr", "Function", "Identifier", "Literal", "OrderByItem", "QueryStatement",
+           "STAR", "is_aggregation", "contains_aggregation", "SqlSyntaxError", "tokenize",
+           "parse_query"]

@@ -1,0 +1,114 @@
+"""SQL expression AST.
+
+Mirrors the reference's thrift expression model
+(`pinot-common/src/thrift/query.thrift` -> `PinotQuery`/`Expression`): every node is a
+Literal, an Identifier, or a Function call — operators are normalized to canonical function
+names (`plus`, `eq`, `and`, ...), exactly like the reference's
+`RequestUtils.getFunctionExpression` canonicalization. This keeps the compiler uniform: one
+recursive walk lowers any expression to device ops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple, Union
+
+Expr = Union["Literal", "Identifier", "Function"]
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any  # python int/float/str/bool/None
+
+    def __repr__(self) -> str:
+        return f"lit({self.value!r})"
+
+
+@dataclass(frozen=True)
+class Identifier:
+    name: str
+
+    def __repr__(self) -> str:
+        return f"col({self.name})"
+
+
+@dataclass(frozen=True)
+class Function:
+    name: str  # canonical lowercase: plus, times, eq, and, sum, count, ...
+    args: Tuple[Expr, ...]
+    distinct: bool = False  # COUNT(DISTINCT x)
+
+    def __repr__(self) -> str:
+        d = "distinct " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(repr, self.args))})"
+
+
+STAR = Identifier("*")
+
+# canonical operator names (reference: FilterKind + arithmetic function names)
+COMPARISONS = {"eq", "neq", "gt", "gte", "lt", "lte"}
+LOGICAL = {"and", "or", "not"}
+MEMBERSHIP = {"in", "not_in", "between", "like", "not_like", "regexp_like",
+              "is_null", "is_not_null", "text_match", "json_match"}
+FILTER_FUNCTIONS = COMPARISONS | LOGICAL | MEMBERSHIP
+
+# aggregation functions (subset of the reference's AggregationFunctionType,
+# pinot-segment-spi/.../AggregationFunctionType.java:31-80)
+AGGREGATION_FUNCTIONS = {
+    "count", "sum", "min", "max", "avg", "minmaxrange",
+    "distinctcount", "distinctcounthll", "distinctcountbitmap",
+    "percentile", "percentileest", "percentiletdigest",
+    "sumprecision", "mode",
+}
+
+
+def is_aggregation(e: Expr) -> bool:
+    return isinstance(e, Function) and (
+        e.name in AGGREGATION_FUNCTIONS or e.name.startswith("percentile"))
+
+
+def contains_aggregation(e: Expr) -> bool:
+    if is_aggregation(e):
+        return True
+    if isinstance(e, Function):
+        return any(contains_aggregation(a) for a in e.args)
+    return False
+
+
+def walk(e: Expr):
+    """Yield every node in the expression tree, pre-order."""
+    yield e
+    if isinstance(e, Function):
+        for a in e.args:
+            yield from walk(a)
+
+
+def identifiers_in(e: Expr) -> List[str]:
+    out = []
+    for n in walk(e):
+        if isinstance(n, Identifier) and n.name != "*":
+            out.append(n.name)
+    return out
+
+
+@dataclass
+class OrderByItem:
+    expr: Expr
+    desc: bool = False
+    nulls_last: Optional[bool] = None
+
+
+@dataclass
+class QueryStatement:
+    """Parsed SELECT statement (reference: PinotQuery thrift struct)."""
+
+    select: List[Tuple[Expr, Optional[str]]] = field(default_factory=list)  # (expr, alias)
+    distinct: bool = False
+    table: str = ""
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[OrderByItem] = field(default_factory=list)
+    limit: int = 10  # reference default broker limit
+    offset: int = 0
+    options: dict = field(default_factory=dict)  # SQL `SET key=value;` / OPTION(...)

@@ -1,0 +1,72 @@
+"""SQL tokenizer.
+
+Hand-written (no sqlglot/Calcite in this environment — the reference uses Calcite's babel
+parser, `pinot-common/.../sql/parsers/CalciteSqlParser.java:72`). Produces a flat token
+stream for the recursive-descent parser in `parser.py`.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List
+
+
+class SqlSyntaxError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str   # KEYWORD, IDENT, NUMBER, STRING, OP, EOF
+    value: str  # normalized: keywords upper, operators literal
+    pos: int    # character offset, for error messages
+
+
+KEYWORDS = {
+    "SELECT", "DISTINCT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "OFFSET", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "IS", "NULL", "TRUE",
+    "FALSE", "ASC", "DESC", "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "SET",
+    "OPTION", "NULLS", "FIRST", "LAST",
+}
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$\.]*)
+  | (?P<op><=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|;)
+""", re.VERBOSE | re.DOTALL)
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlSyntaxError(f"unexpected character {sql[pos]!r} at position {pos}")
+        kind = m.lastgroup
+        text = m.group()
+        if kind == "ws" or kind == "comment":
+            pass
+        elif kind == "number":
+            tokens.append(Token("NUMBER", text, pos))
+        elif kind == "string":
+            tokens.append(Token("STRING", text[1:-1].replace("''", "'"), pos))
+        elif kind == "qident":
+            tokens.append(Token("IDENT", text[1:-1].replace('""', '"'), pos))
+        elif kind == "ident":
+            upper = text.upper()
+            if upper in KEYWORDS:
+                tokens.append(Token("KEYWORD", upper, pos))
+            else:
+                tokens.append(Token("IDENT", text, pos))
+        else:
+            tokens.append(Token("OP", text, pos))
+        pos = m.end()
+    tokens.append(Token("EOF", "", n))
+    return tokens

@@ -1,0 +1,318 @@
+"""Recursive-descent SQL parser: token stream -> QueryStatement AST.
+
+Covers the reference's single-table query surface (`CalciteSqlParser.compileToPinotQuery`,
+`pinot-common/.../sql/parsers/CalciteSqlParser.java:72`): SELECT [DISTINCT] exprs FROM t
+WHERE ... GROUP BY ... HAVING ... ORDER BY ... LIMIT n [OFFSET m], `SET k=v;` statement
+options and trailing `OPTION(k=v)` clauses, full expression grammar with
+IN/BETWEEN/LIKE/IS NULL/CASE/CAST. Multi-table FROM (joins) is handled by the multistage
+planner on top of this parser, mirroring the reference's v1/v2 engine split.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .ast import (Expr, Function, Identifier, Literal, OrderByItem, QueryStatement, STAR)
+from .lexer import SqlSyntaxError, Token, tokenize
+
+_COMPARISON_OPS = {"=": "eq", "!=": "neq", "<>": "neq", "<": "lt", "<=": "lte",
+                   ">": "gt", ">=": "gte"}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.tokens[self.i]
+
+    def advance(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def at_keyword(self, *kws: str) -> bool:
+        return self.cur.kind == "KEYWORD" and self.cur.value in kws
+
+    def accept_keyword(self, *kws: str) -> bool:
+        if self.at_keyword(*kws):
+            self.advance()
+            return True
+        return False
+
+    def expect_keyword(self, kw: str) -> None:
+        if not self.accept_keyword(kw):
+            raise SqlSyntaxError(f"expected {kw} at position {self.cur.pos}, got {self.cur.value!r}")
+
+    def at_op(self, *ops: str) -> bool:
+        return self.cur.kind == "OP" and self.cur.value in ops
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        if self.at_op(*ops):
+            return self.advance().value
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SqlSyntaxError(f"expected {op!r} at position {self.cur.pos}, got {self.cur.value!r}")
+
+    # -- statement ---------------------------------------------------------
+    def parse(self) -> QueryStatement:
+        q = QueryStatement()
+        # leading `SET key = value;` statements (reference: SqlNodeAndOptions options)
+        while self.at_keyword("SET"):
+            self.advance()
+            key = self.advance().value
+            self.expect_op("=")
+            q.options[key] = self._literal_token_value()
+            self.accept_op(";")
+
+        self.expect_keyword("SELECT")
+        q.distinct = self.accept_keyword("DISTINCT")
+        q.select = self._select_list()
+        self.expect_keyword("FROM")
+        q.table = self._table_name()
+        if self.accept_keyword("WHERE"):
+            q.where = self.expression()
+        if self.accept_keyword("GROUP"):
+            self.expect_keyword("BY")
+            q.group_by = self._expr_list()
+        if self.accept_keyword("HAVING"):
+            q.having = self.expression()
+        if self.accept_keyword("ORDER"):
+            self.expect_keyword("BY")
+            q.order_by = self._order_by_list()
+        if self.accept_keyword("LIMIT"):
+            first = int(self._number_token())
+            if self.accept_op(","):          # LIMIT offset, count (MySQL style)
+                q.offset, q.limit = first, int(self._number_token())
+            else:
+                q.limit = first
+                if self.accept_keyword("OFFSET"):
+                    q.offset = int(self._number_token())
+        if self.accept_keyword("OPTION"):    # trailing OPTION(k=v, ...) clauses
+            self.expect_op("(")
+            while not self.accept_op(")"):
+                key = self.advance().value
+                self.expect_op("=")
+                q.options[key] = self._literal_token_value()
+                self.accept_op(",")
+        self.accept_op(";")
+        if self.cur.kind != "EOF":
+            raise SqlSyntaxError(f"unexpected trailing input at position {self.cur.pos}: "
+                                 f"{self.cur.value!r}")
+        return q
+
+    def _literal_token_value(self):
+        t = self.advance()
+        if t.kind == "NUMBER":
+            return _number(t.value)
+        if t.kind == "KEYWORD" and t.value in ("TRUE", "FALSE"):
+            return t.value == "TRUE"
+        return t.value
+
+    def _number_token(self) -> float:
+        t = self.advance()
+        if t.kind != "NUMBER":
+            raise SqlSyntaxError(f"expected number at position {t.pos}, got {t.value!r}")
+        return _number(t.value)
+
+    def _table_name(self) -> str:
+        t = self.advance()
+        if t.kind != "IDENT":
+            raise SqlSyntaxError(f"expected table name at position {t.pos}, got {t.value!r}")
+        return t.value
+
+    def _select_list(self) -> List[Tuple[Expr, Optional[str]]]:
+        items: List[Tuple[Expr, Optional[str]]] = []
+        while True:
+            expr = self.expression()
+            alias = None
+            if self.accept_keyword("AS"):
+                alias = self.advance().value
+            elif self.cur.kind == "IDENT":  # bare alias: SELECT x total FROM ...
+                alias = self.advance().value
+            items.append((expr, alias))
+            if not self.accept_op(","):
+                return items
+
+    def _expr_list(self) -> List[Expr]:
+        items = [self.expression()]
+        while self.accept_op(","):
+            items.append(self.expression())
+        return items
+
+    def _order_by_list(self) -> List[OrderByItem]:
+        items = []
+        while True:
+            expr = self.expression()
+            desc = False
+            if self.accept_keyword("DESC"):
+                desc = True
+            else:
+                self.accept_keyword("ASC")
+            nulls_last = None
+            if self.accept_keyword("NULLS"):
+                nulls_last = self.accept_keyword("LAST")
+                if not nulls_last:
+                    self.expect_keyword("FIRST")
+            items.append(OrderByItem(expr, desc, nulls_last))
+            if not self.accept_op(","):
+                return items
+
+    # -- expressions (precedence climbing) ---------------------------------
+    def expression(self) -> Expr:
+        return self._or_expr()
+
+    def _or_expr(self) -> Expr:
+        left = self._and_expr()
+        while self.accept_keyword("OR"):
+            left = Function("or", (left, self._and_expr()))
+        return left
+
+    def _and_expr(self) -> Expr:
+        left = self._not_expr()
+        while self.accept_keyword("AND"):
+            left = Function("and", (left, self._not_expr()))
+        return left
+
+    def _not_expr(self) -> Expr:
+        if self.accept_keyword("NOT"):
+            return Function("not", (self._not_expr(),))
+        return self._predicate()
+
+    def _predicate(self) -> Expr:
+        left = self._additive()
+        op = self.accept_op(*_COMPARISON_OPS)
+        if op:
+            return Function(_COMPARISON_OPS[op], (left, self._additive()))
+        negated = self.accept_keyword("NOT")
+        if self.accept_keyword("IN"):
+            self.expect_op("(")
+            values = self._expr_list()
+            self.expect_op(")")
+            return Function("not_in" if negated else "in", (left, *values))
+        if self.accept_keyword("BETWEEN"):
+            lo = self._additive()
+            self.expect_keyword("AND")
+            hi = self._additive()
+            f = Function("between", (left, lo, hi))
+            return Function("not", (f,)) if negated else f
+        if self.accept_keyword("LIKE"):
+            return Function("not_like" if negated else "like", (left, self._additive()))
+        if negated:
+            raise SqlSyntaxError(f"expected IN/BETWEEN/LIKE after NOT at position {self.cur.pos}")
+        if self.accept_keyword("IS"):
+            negated = self.accept_keyword("NOT")
+            self.expect_keyword("NULL")
+            return Function("is_not_null" if negated else "is_null", (left,))
+        return left
+
+    def _additive(self) -> Expr:
+        left = self._multiplicative()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return left
+            name = "plus" if op == "+" else "minus"
+            left = Function(name, (left, self._multiplicative()))
+
+    def _multiplicative(self) -> Expr:
+        left = self._unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return left
+            name = {"*": "times", "/": "divide", "%": "mod"}[op]
+            left = Function(name, (left, self._unary()))
+
+    def _unary(self) -> Expr:
+        if self.accept_op("-"):
+            inner = self._unary()
+            if isinstance(inner, Literal) and isinstance(inner.value, (int, float)):
+                return Literal(-inner.value)
+            return Function("minus", (Literal(0), inner))
+        if self.accept_op("+"):
+            return self._unary()
+        return self._primary()
+
+    def _primary(self) -> Expr:
+        t = self.cur
+        if t.kind == "NUMBER":
+            self.advance()
+            return Literal(_number(t.value))
+        if t.kind == "STRING":
+            self.advance()
+            return Literal(t.value)
+        if t.kind == "KEYWORD":
+            if t.value in ("TRUE", "FALSE"):
+                self.advance()
+                return Literal(t.value == "TRUE")
+            if t.value == "NULL":
+                self.advance()
+                return Literal(None)
+            if t.value == "CASE":
+                return self._case()
+            if t.value == "CAST":
+                self.advance()
+                self.expect_op("(")
+                inner = self.expression()
+                self.expect_keyword("AS")
+                target = self.advance().value
+                self.expect_op(")")
+                return Function("cast", (inner, Literal(target.upper())))
+        if self.at_op("("):
+            self.advance()
+            e = self.expression()
+            self.expect_op(")")
+            return e
+        if self.at_op("*"):
+            self.advance()
+            return STAR
+        if t.kind == "IDENT":
+            self.advance()
+            if self.accept_op("("):
+                return self._function_call(t.value)
+            return Identifier(t.value)
+        raise SqlSyntaxError(f"unexpected token {t.value!r} at position {t.pos}")
+
+    def _function_call(self, name: str) -> Expr:
+        distinct = self.accept_keyword("DISTINCT")
+        args: Tuple[Expr, ...] = ()
+        if not self.accept_op(")"):
+            args = tuple(self._expr_list())
+            self.expect_op(")")
+        return Function(name.lower(), args, distinct=distinct)
+
+    def _case(self) -> Expr:
+        """CASE [operand] WHEN .. THEN .. [ELSE ..] END -> case(w1,t1,...,wn,tn,else)."""
+        self.expect_keyword("CASE")
+        operand = None
+        if not self.at_keyword("WHEN"):
+            operand = self.expression()
+        whens: List[Expr] = []
+        while self.accept_keyword("WHEN"):
+            cond = self.expression()
+            if operand is not None:
+                cond = Function("eq", (operand, cond))
+            self.expect_keyword("THEN")
+            whens.extend((cond, self.expression()))
+        default: Expr = Literal(None)
+        if self.accept_keyword("ELSE"):
+            default = self.expression()
+        self.expect_keyword("END")
+        return Function("case", (*whens, default))
+
+
+def _number(text: str):
+    if any(c in text for c in ".eE"):
+        return float(text)
+    return int(text)
+
+
+def parse_query(sql: str) -> QueryStatement:
+    """SQL text -> QueryStatement (reference: CalciteSqlParser.compileToPinotQuery)."""
+    return Parser(sql).parse()

@@ -1,0 +1,134 @@
+"""SQL parser + QueryContext compilation tests (reference pattern:
+CalciteSqlParser tests in pinot-common)."""
+
+import pytest
+
+from pinot_tpu.query import QueryValidationError, compile_query
+from pinot_tpu.sql import SqlSyntaxError, parse_query
+from pinot_tpu.sql.ast import Function, Identifier, Literal
+
+
+def test_basic_select():
+    q = parse_query("SELECT a, b FROM t")
+    assert q.table == "t"
+    assert q.select == [(Identifier("a"), None), (Identifier("b"), None)]
+    assert q.limit == 10  # default broker limit
+
+
+def test_aggregation_group_by():
+    q = parse_query(
+        "SELECT lo_region, SUM(lo_revenue) AS total FROM lineorder "
+        "WHERE lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25 "
+        "GROUP BY lo_region HAVING SUM(lo_revenue) > 100 "
+        "ORDER BY total DESC LIMIT 5")
+    assert q.select[1] == (Function("sum", (Identifier("lo_revenue"),)), "total")
+    assert q.where.name == "and"
+    assert q.group_by == [Identifier("lo_region")]
+    assert q.having == Function("gt", (Function("sum", (Identifier("lo_revenue"),)), Literal(100)))
+    assert q.order_by[0].desc
+    assert q.limit == 5
+
+
+def test_operator_precedence():
+    q = parse_query("SELECT a + b * c - d FROM t")
+    e = q.select[0][0]
+    # ((a + (b*c)) - d)
+    assert e == Function("minus", (
+        Function("plus", (Identifier("a"), Function("times", (Identifier("b"), Identifier("c"))))),
+        Identifier("d")))
+
+
+def test_where_precedence_and_or_not():
+    q = parse_query("SELECT a FROM t WHERE x = 1 OR y = 2 AND NOT z = 3")
+    e = q.where
+    assert e.name == "or"
+    assert e.args[1].name == "and"
+    assert e.args[1].args[1].name == "not"
+
+
+def test_in_between_like_null():
+    q = parse_query("SELECT a FROM t WHERE c IN ('x', 'y') AND d NOT IN (1, 2) "
+                    "AND e NOT BETWEEN 1 AND 2 AND f LIKE 'A%' AND g IS NOT NULL")
+    kinds = []
+    def collect(e):
+        if isinstance(e, Function):
+            if e.name == "and":
+                for a in e.args:
+                    collect(a)
+            else:
+                kinds.append(e.name)
+    collect(q.where)
+    assert kinds == ["in", "not_in", "not", "like", "is_not_null"]
+
+
+def test_count_star_distinct_cast_case():
+    q = parse_query("SELECT COUNT(*), COUNT(DISTINCT u), CAST(x AS LONG), "
+                    "CASE WHEN a > 1 THEN 'hi' ELSE 'lo' END FROM t")
+    count, cdist, cast, case = [e for e, _ in q.select]
+    assert count == Function("count", (Identifier("*"),))
+    assert cdist.distinct and cdist.name == "count"
+    assert cast == Function("cast", (Identifier("x"), Literal("LONG")))
+    assert case.name == "case" and len(case.args) == 3
+
+
+def test_options_and_limit_offset():
+    q = parse_query("SET useMultistageEngine = true; SELECT a FROM t LIMIT 7 OFFSET 3 "
+                    "OPTION(timeoutMs=100)")
+    assert q.options == {"useMultistageEngine": True, "timeoutMs": 100}
+    assert (q.limit, q.offset) == (7, 3)
+    q2 = parse_query("SELECT a FROM t LIMIT 3, 7")
+    assert (q2.offset, q2.limit) == (3, 7)
+
+
+def test_quoted_identifiers_and_strings():
+    q = parse_query('SELECT "weird col" FROM t WHERE s = \'it''s\'')
+    assert q.select[0][0] == Identifier("weird col")
+
+
+def test_negative_numbers_and_unary():
+    q = parse_query("SELECT -3, -x FROM t WHERE a > -1.5e2")
+    assert q.select[0][0] == Literal(-3)
+    assert q.select[1][0] == Function("minus", (Literal(0), Identifier("x")))
+    assert q.where.args[1] == Literal(-150.0)
+
+
+def test_syntax_errors():
+    for bad in ["SELECT FROM t", "SELECT a t", "SELECT a FROM t WHERE", "FOO BAR",
+                "SELECT a FROM t GROUP 1", "SELECT a FROM t trailing junk ("]:
+        with pytest.raises(SqlSyntaxError):
+            parse_query(bad)
+
+
+# -- QueryContext compilation ------------------------------------------------
+
+def test_context_ordinal_and_alias_resolution(ssb_schema):
+    ctx = compile_query(
+        "SELECT lo_region AS r, SUM(lo_revenue) AS total FROM lineorder "
+        "GROUP BY 1 ORDER BY total DESC", ssb_schema)
+    assert ctx.group_by == [Identifier("lo_region")]
+    assert ctx.order_by[0].expr == Function("sum", (Identifier("lo_revenue"),))
+    assert ctx.aggregations == [Function("sum", (Identifier("lo_revenue"),))]
+    assert ctx.output_names == ["r", "total"]
+
+
+def test_context_star_expansion(ssb_schema):
+    ctx = compile_query("SELECT * FROM lineorder", ssb_schema)
+    assert ctx.output_names == ssb_schema.column_names
+
+
+def test_context_validations(ssb_schema):
+    with pytest.raises(QueryValidationError, match="unknown column"):
+        compile_query("SELECT nope FROM lineorder", ssb_schema)
+    with pytest.raises(QueryValidationError, match="neither aggregated"):
+        compile_query("SELECT lo_region, SUM(lo_revenue) FROM lineorder", ssb_schema)
+    with pytest.raises(QueryValidationError, match="WHERE"):
+        compile_query("SELECT lo_region FROM lineorder WHERE SUM(lo_revenue) > 1", ssb_schema)
+    with pytest.raises(QueryValidationError, match="nested"):
+        compile_query("SELECT SUM(MAX(lo_revenue)) FROM lineorder", ssb_schema)
+
+
+def test_context_dedups_aggregations(ssb_schema):
+    ctx = compile_query(
+        "SELECT SUM(lo_revenue), SUM(lo_revenue) + COUNT(*) FROM lineorder", ssb_schema)
+    names = [a.name for a in ctx.aggregations]
+    assert names == ["sum", "count"]
