@@ -1,0 +1,7 @@
+"""Execution engine: device blocks, expression compiler, jit scan kernels.
+
+This is the TPU replacement for the reference's per-segment operator hot loop
+(`DocIdSetOperator` -> `ProjectionOperator` -> `TransformOperator` -> aggregation executors,
+SURVEY.md §3.1): one fused jit program per plan shape computes predicate masks, projected
+expressions and dense-key group-by partials in a single pass over HBM-resident columns.
+"""

@@ -1,0 +1,135 @@
+"""Device-resident segment blocks: padded HBM columns + valid mask.
+
+The TPU analog of the reference's `DataFetcher`/`DataBlockCache`
+(`pinot-core/.../common/DataFetcher.java:47`): columns are transferred to device once per
+segment, cached, and every query against the segment reuses them. Padding to power-of-two
+row counts (min `format.ROW_TILE`) bucketizes shapes so jit kernels are reused across
+segments instead of recompiling per row count.
+
+Padding contract:
+* dict-encoded columns pad with id = cardinality ("invalid id"); every LUT/decode array is
+  sized `pow2(cardinality + 1)` so the invalid id hits a well-defined slot (False / 0).
+* raw columns pad with 0; the block's `valid` mask excludes padding rows from every result.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..segment.format import ROW_TILE
+from ..segment.reader import ColumnReader, ImmutableSegment
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+def _narrow(arr: np.ndarray) -> np.ndarray:
+    """Explicitly narrow 64-bit arrays for device transfer (int64->int32, f64->f32)."""
+    if arr.dtype == np.int64:
+        return arr.astype(np.int32)
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)
+    return arr
+
+
+def padded_rows(num_docs: int) -> int:
+    return max(ROW_TILE, _pow2(num_docs))
+
+
+def lut_size(cardinality: int) -> int:
+    return _pow2(cardinality + 1)
+
+
+class SegmentBlock:
+    """Lazy per-column device cache for one immutable segment."""
+
+    def __init__(self, segment: ImmutableSegment):
+        self.segment = segment
+        self.num_docs = segment.num_docs
+        self.padded = padded_rows(self.num_docs)
+        self._ids: Dict[str, jnp.ndarray] = {}
+        self._raw: Dict[str, jnp.ndarray] = {}
+        self._dict_vals: Dict[str, jnp.ndarray] = {}
+        self._valid: Optional[jnp.ndarray] = None
+        self._null: Dict[str, jnp.ndarray] = {}
+
+    @property
+    def valid(self) -> jnp.ndarray:
+        if self._valid is None:
+            v = np.zeros(self.padded, dtype=bool)
+            v[:self.num_docs] = True
+            self._valid = jnp.asarray(v)
+        return self._valid
+
+    def ids(self, col: str) -> jnp.ndarray:
+        """Padded int32 dict-id array for a dict-encoded column."""
+        if col not in self._ids:
+            reader = self.segment.column(col)
+            assert reader.has_dictionary, f"{col} has no dictionary"
+            arr = np.asarray(reader.fwd).astype(np.int32)
+            padded = np.full(self.padded, reader.cardinality, dtype=np.int32)
+            padded[:self.num_docs] = arr
+            self._ids[col] = jnp.asarray(padded)
+        return self._ids[col]
+
+    def raw(self, col: str) -> jnp.ndarray:
+        """Padded raw-value array for a non-dict numeric column.
+
+        64-bit types narrow to 32-bit explicitly (device compute is int32/float32; the
+        planner falls back to host for columns whose min/max exceed int32 — see
+        `planner._expr_device_ok`).
+        """
+        if col not in self._raw:
+            reader = self.segment.column(col)
+            arr = np.asarray(reader.fwd)
+            arr = _narrow(arr)
+            padded = np.zeros(self.padded, dtype=arr.dtype)
+            padded[:self.num_docs] = arr
+            self._raw[col] = jnp.asarray(padded)
+        return self._raw[col]
+
+    def dict_values(self, col: str) -> jnp.ndarray:
+        """Decode table: dictionary values padded to `lut_size(card)` (invalid id -> 0).
+
+        Numeric dict decode on device is `dict_values(col)[ids(col)]` — one gather.
+        """
+        if col not in self._dict_vals:
+            reader = self.segment.column(col)
+            vals = _narrow(np.asarray(reader.dictionary.values))
+            out = np.zeros(lut_size(reader.cardinality), dtype=vals.dtype)
+            out[:len(vals)] = vals
+            self._dict_vals[col] = jnp.asarray(out)
+        return self._dict_vals[col]
+
+    def null_mask(self, col: str) -> jnp.ndarray:
+        """Padded bool array: True where the stored value is a filled-in null."""
+        if col not in self._null:
+            reader = self.segment.column(col)
+            nb = reader.null_bitmap
+            padded = np.zeros(self.padded, dtype=bool)
+            if nb is not None:
+                padded[:self.num_docs] = nb
+            self._null[col] = jnp.asarray(padded)
+        return self._null[col]
+
+    def values(self, col: str) -> jnp.ndarray:
+        """Decoded numeric values on device regardless of encoding."""
+        reader = self.segment.column(col)
+        if reader.has_dictionary:
+            return self.dict_values(col)[self.ids(col)]
+        return self.raw(col)
+
+
+_BLOCK_ATTR = "_device_block"
+
+
+def block_for(segment: ImmutableSegment) -> SegmentBlock:
+    blk = getattr(segment, _BLOCK_ATTR, None)
+    if blk is None:
+        blk = SegmentBlock(segment)
+        setattr(segment, _BLOCK_ATTR, blk)
+    return blk

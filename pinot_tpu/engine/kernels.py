@@ -1,0 +1,248 @@
+"""Fused jit scan kernels: filter mask + group key + aggregation partials in one pass.
+
+This replaces the reference's entire per-segment operator chain
+(`FilterPlanNode` -> `DocIdSetOperator` -> `ProjectionOperator` -> `TransformOperator` ->
+`AggregationGroupByOrderByOperator`, SURVEY.md §3.1) with ONE XLA program per plan shape:
+
+    mask   = filter_tree(LUT gathers | vector compares | null bitmaps) & valid
+    key    = sum(group_ids * strides)        (dense dict-id keys, reference:
+                                              DictionaryBasedGroupKeyGenerator.java:62)
+    partials = segment_sum/min/max over key  (masked rows -> overflow bucket)
+
+There is no 10k-doc batching loop (`DocIdSetPlanNode.MAX_DOC_PER_CALL`): the TPU analog of
+batching is the grid XLA tiles over the padded row axis. Kernels are cached by structural
+signature; literal operands arrive via runtime scalar arrays so changing `WHERE x > 5` to
+`x > 7` reuses the compiled program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query.aggregates import AggFunc
+from ..query.predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf
+from ..sql.ast import Identifier
+from .expr import eval_expr
+
+_INT_MIN_IDENT = np.iinfo(np.int32).max  # identity for masked-out min over int
+_INT_MAX_IDENT = np.iinfo(np.int32).min
+
+
+@dataclass
+class KernelSpec:
+    """Static description of one fused kernel (the jit cache key is `signature()`)."""
+
+    filter: FilterProgram
+    group_cols: Tuple[str, ...]            # dict-encoded group-by columns
+    num_keys_pad: int                      # pow2 >= product of real cardinalities
+    aggs: Tuple[Tuple[AggFunc, Tuple[str, ...]], ...]  # (func, device outputs)
+    distinct_lut_sizes: Dict[int, int] = field(default_factory=dict)  # agg idx -> lut size
+    padded_rows: int = 0
+
+    # per-leaf runtime input routing, computed in __post_init__
+    lut_index: Dict[int, int] = field(default_factory=dict)
+    cmp_offset: Dict[int, Tuple[str, int]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        luts = 0
+        ioff = foff = 0
+        for i, leaf in enumerate(self.filter.leaves):
+            if isinstance(leaf, LutLeaf):
+                self.lut_index[i] = luts
+                luts += 1
+            elif isinstance(leaf, CmpLeaf):
+                if leaf.is_int:
+                    self.cmp_offset[i] = ("iscal", ioff)
+                    ioff += len(leaf.operands)
+                else:
+                    self.cmp_offset[i] = ("fscal", foff)
+                    foff += len(leaf.operands)
+
+    def signature(self) -> Tuple:
+        return (
+            self.filter.signature(),
+            self.group_cols,
+            self.num_keys_pad,
+            tuple((a.name, repr(a.arg), outs) for a, outs in self.aggs),
+            tuple(sorted(self.distinct_lut_sizes.items())),
+            self.padded_rows,
+        )
+
+
+@dataclass
+class KernelInputs:
+    """Runtime (traced) inputs for one segment execution."""
+
+    ids: Dict[str, jnp.ndarray]
+    vals: Dict[str, jnp.ndarray]
+    luts: Tuple[jnp.ndarray, ...]
+    iscal: jnp.ndarray
+    fscal: jnp.ndarray
+    nulls: Dict[str, jnp.ndarray]
+    valid: jnp.ndarray
+    strides: jnp.ndarray  # i32[G] (empty for scalar aggregation)
+
+
+_KERNEL_CACHE: Dict[Tuple, Any] = {}
+
+
+def kernel_cache_size() -> int:
+    return len(_KERNEL_CACHE)
+
+
+def _make_mask_fn(spec: KernelSpec):
+    """Returns mask(ids, vals, luts, iscal, fscal, nulls, valid) -> bool[P] closure."""
+    leaves = spec.filter.leaves
+
+    def leaf_mask(i, ids, vals, luts, iscal, fscal, nulls):
+        leaf = leaves[i]
+        if isinstance(leaf, LutLeaf):
+            return luts[spec.lut_index[i]][ids[leaf.col]]
+        if isinstance(leaf, NullLeaf):
+            m = nulls[leaf.col]
+            return ~m if leaf.negated else m
+        assert isinstance(leaf, CmpLeaf)
+        v = eval_expr(leaf.expr, vals, jnp)
+        arr_name, off = spec.cmp_offset[i]
+        sc = iscal if arr_name == "iscal" else fscal
+        if leaf.op == "eq":
+            return v == sc[off]
+        if leaf.op == "gte":
+            return v >= sc[off]
+        if leaf.op == "lte":
+            return v <= sc[off]
+        if leaf.op == "gt":
+            return v > sc[off]
+        if leaf.op == "lt":
+            return v < sc[off]
+        if leaf.op == "between":
+            return (v >= sc[off]) & (v <= sc[off + 1])
+        if leaf.op == "in":
+            m = v == sc[off]
+            for j in range(1, len(leaf.operands)):
+                m = m | (v == sc[off + j])
+            return m
+        raise AssertionError(f"bad cmp op {leaf.op}")
+
+    def tree_mask(node, env, valid):
+        kind = node[0]
+        if kind == "const":
+            # _simplify folds consts away except a top-level all/none
+            return valid if node[1] else jnp.zeros_like(valid)
+        if kind == "leaf":
+            return leaf_mask(node[1], *env)
+        if kind == "not":
+            return ~tree_mask(node[1], env, valid)
+        masks = [tree_mask(c, env, valid) for c in node[1]]
+        out = masks[0]
+        for m in masks[1:]:
+            out = (out & m) if kind == "and" else (out | m)
+        return out
+
+    def mask_fn(ids, vals, luts, iscal, fscal, nulls, valid):
+        if spec.filter.is_match_all:
+            return valid
+        env = (ids, vals, luts, iscal, fscal, nulls)
+        return tree_mask(spec.filter.tree, env, valid) & valid
+
+    return mask_fn
+
+
+def _build_kernel(spec: KernelSpec):
+    group = bool(spec.group_cols)
+    num_seg = spec.num_keys_pad + 1  # +1 overflow bucket for masked-out rows
+    mask_fn = _make_mask_fn(spec)
+
+    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides):
+        mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid)
+        out: Dict[str, jnp.ndarray] = {}
+
+        if group:
+            key = jnp.zeros_like(ids[spec.group_cols[0]])
+            for gi, gc in enumerate(spec.group_cols):
+                key = key + ids[gc] * strides[gi]
+            key = jnp.where(mask, key, spec.num_keys_pad)
+            counts = jax.ops.segment_sum(jnp.ones_like(key), key, num_segments=num_seg)
+            out["count"] = counts
+            for ai, (agg, outs) in enumerate(spec.aggs):
+                v = _agg_arg(agg, vals)
+                for o in outs:
+                    if o == "count":
+                        continue  # shared counts
+                    if o == "sum":
+                        out[f"{ai}.sum"] = jax.ops.segment_sum(
+                            jnp.where(mask, v.astype(jnp.float32), 0.0), key,
+                            num_segments=num_seg)
+                    elif o == "min":
+                        out[f"{ai}.min"] = jax.ops.segment_min(v, key, num_segments=num_seg)
+                    elif o == "max":
+                        out[f"{ai}.max"] = jax.ops.segment_max(v, key, num_segments=num_seg)
+        else:
+            out["count"] = mask.sum(dtype=jnp.int32)
+            for ai, (agg, outs) in enumerate(spec.aggs):
+                if "distinct" in outs:
+                    # exact distinct over a dict column: per-dict-id presence vector.
+                    # Returned as a vector (not a count) because cross-segment merge
+                    # needs the id set — dictionaries differ per segment.
+                    out[f"{ai}.distinct"] = jax.ops.segment_sum(
+                        mask.astype(jnp.int32), ids[agg.arg.name],
+                        num_segments=spec.distinct_lut_sizes[ai])
+                    continue
+                if outs == ("count",):
+                    continue
+                v = _agg_arg(agg, vals)
+                for o in outs:
+                    if o == "count":
+                        continue
+                    if o == "sum":
+                        out[f"{ai}.sum"] = (v.astype(jnp.float32)
+                                            * mask.astype(jnp.float32)).sum()
+                    elif o == "min":
+                        ident = _INT_MIN_IDENT if v.dtype.kind == "i" else jnp.inf
+                        out[f"{ai}.min"] = jnp.where(mask, v, ident).min()
+                    elif o == "max":
+                        ident = _INT_MAX_IDENT if v.dtype.kind == "i" else -jnp.inf
+                        out[f"{ai}.max"] = jnp.where(mask, v, ident).max()
+        return out
+
+    return jax.jit(kernel)
+
+
+def get_kernel(spec: KernelSpec):
+    key = spec.signature()
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = _build_kernel(spec)
+        _KERNEL_CACHE[key] = fn
+    return fn
+
+
+def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
+    out = get_kernel(spec)(inputs.ids, inputs.vals, inputs.luts, inputs.iscal,
+                           inputs.fscal, inputs.nulls, inputs.valid, inputs.strides)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
+    """Filter-only kernel for selection queries: returns the boolean match mask."""
+    key = ("mask", spec.filter.signature(), spec.padded_rows)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        mask_fn = _make_mask_fn(spec)
+        fn = jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid:
+                     mask_fn(ids, vals, luts, iscal, fscal, nulls, valid))
+        _KERNEL_CACHE[key] = fn
+    out = fn(inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
+             inputs.nulls, inputs.valid)
+    return np.asarray(out)
+
+
+def _agg_arg(agg: AggFunc, vals) -> Optional[jnp.ndarray]:
+    if agg.arg is None or (isinstance(agg.arg, Identifier) and agg.arg.name == "*"):
+        return None
+    return eval_expr(agg.arg, vals, jnp)

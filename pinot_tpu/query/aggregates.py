@@ -1,0 +1,285 @@
+"""Aggregation functions: device partial computation spec + host merge/finalize.
+
+Analog of the reference's `AggregationFunction` interface
+(`pinot-core/.../query/aggregation/function/`, 58 classes): each function defines
+(1) which fused-kernel outputs it needs on device (`device_outputs`),
+(2) how per-segment partial states merge across segments/servers (`merge` — the reference's
+    `merge(intermediate, intermediate)`), and
+(3) how a final value is extracted (`finalize` — `extractFinalResult`).
+
+Functions whose exact semantics need raw values (percentile, mode, exact distinct-count on
+expressions) run on the host path; the planner asks `device_ok()`.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..sql.ast import Expr, Function, Identifier
+from .context import QueryValidationError
+
+
+@dataclass
+class AggContext:
+    """Static facts the planner knows when choosing the device/host path."""
+    group_by: bool
+    arg_is_dict_column: bool  # argument is a plain dictionary-encoded column
+    arg_is_numeric: bool
+
+
+class AggFunc:
+    name: str = ""
+    device_outputs: Tuple[str, ...] = ()  # subset of {count,sum,min,max,distinct}
+
+    def __init__(self, call: Function):
+        self.call = call
+        self.arg: Optional[Expr] = call.args[0] if call.args else None
+
+    # -- capability --------------------------------------------------------
+    def device_ok(self, ctx: AggContext) -> bool:
+        return True
+
+    # -- host path ---------------------------------------------------------
+    def host_state(self, values: np.ndarray) -> Any:
+        """Build a partial state from the filtered argument values of one segment."""
+        raise NotImplementedError
+
+    def state_from_device(self, outs: Dict[str, float]) -> Any:
+        """Build the same state from the fused kernel's per-key outputs."""
+        raise NotImplementedError
+
+    def merge(self, a: Any, b: Any) -> Any:
+        raise NotImplementedError
+
+    def finalize(self, state: Any) -> Any:
+        raise NotImplementedError
+
+    def empty_result(self) -> Any:
+        """Result over zero rows (no group-by), mirroring reference defaults."""
+        return None
+
+
+class CountAgg(AggFunc):
+    name = "count"
+    device_outputs = ("count",)
+
+    def host_state(self, values):
+        return int(len(values))
+
+    def state_from_device(self, outs):
+        return int(outs["count"])
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return int(state)
+
+    def empty_result(self):
+        return 0
+
+
+class SumAgg(AggFunc):
+    name = "sum"
+    device_outputs = ("sum",)
+
+    def host_state(self, values):
+        return float(np.sum(values)) if len(values) else None
+
+    def state_from_device(self, outs):
+        return float(outs["sum"]) if outs["count"] > 0 else None
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a + b
+
+    def finalize(self, state):
+        return None if state is None else float(state)
+
+
+class MinAgg(AggFunc):
+    name = "min"
+    device_outputs = ("min",)
+
+    def host_state(self, values):
+        return float(np.min(values)) if len(values) else None
+
+    def state_from_device(self, outs):
+        return float(outs["min"]) if outs["count"] > 0 else None
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return min(a, b)
+
+    def finalize(self, state):
+        return None if state is None else float(state)
+
+
+class MaxAgg(MinAgg):
+    name = "max"
+    device_outputs = ("max",)
+
+    def host_state(self, values):
+        return float(np.max(values)) if len(values) else None
+
+    def state_from_device(self, outs):
+        return float(outs["max"]) if outs["count"] > 0 else None
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return max(a, b)
+
+
+class AvgAgg(AggFunc):
+    name = "avg"
+    device_outputs = ("sum", "count")
+
+    def host_state(self, values):
+        return (float(np.sum(values)), len(values))
+
+    def state_from_device(self, outs):
+        return (float(outs["sum"]), int(outs["count"]))
+
+    def merge(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def finalize(self, state):
+        s, c = state
+        return None if c == 0 else s / c
+
+
+class MinMaxRangeAgg(AggFunc):
+    name = "minmaxrange"
+    device_outputs = ("min", "max")
+
+    def host_state(self, values):
+        if not len(values):
+            return None
+        return (float(np.min(values)), float(np.max(values)))
+
+    def state_from_device(self, outs):
+        if outs["count"] == 0:
+            return None
+        return (float(outs["min"]), float(outs["max"]))
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return (min(a[0], b[0]), max(a[1], b[1]))
+
+    def finalize(self, state):
+        return None if state is None else state[1] - state[0]
+
+
+class DistinctCountAgg(AggFunc):
+    """Exact distinct count. Device path: per-dict-id presence vector (no group-by);
+    states merge as value sets across segments since dictionaries differ per segment."""
+    name = "distinctcount"
+    device_outputs = ("distinct",)
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return ctx.arg_is_dict_column and not ctx.group_by
+
+    def host_state(self, values):
+        return set(np.unique(values).tolist())
+
+    def merge(self, a, b):
+        return a | b
+
+    def finalize(self, state):
+        return len(state)
+
+    def empty_result(self):
+        return 0
+
+
+class PercentileAgg(AggFunc):
+    """Exact percentile — keeps filtered values per state (host-path only).
+    `percentile(col, p)` or legacy `percentileNN(col)`."""
+    name = "percentile"
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        if call.name.startswith("percentile") and call.name[10:].isdigit():
+            self.pct = float(call.name[10:])
+        elif len(call.args) >= 2:
+            from ..sql.ast import Literal
+            assert isinstance(call.args[1], Literal)
+            self.pct = float(call.args[1].value)
+        else:
+            raise QueryValidationError(f"{call.name} needs a percentile argument")
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def host_state(self, values):
+        return np.asarray(values, dtype=np.float64)
+
+    def merge(self, a, b):
+        return np.concatenate([a, b])
+
+    def finalize(self, state):
+        return None if len(state) == 0 else float(np.percentile(state, self.pct))
+
+
+class ModeAgg(AggFunc):
+    name = "mode"
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def host_state(self, values):
+        return Counter(values.tolist())
+
+    def merge(self, a, b):
+        a.update(b)
+        return a
+
+    def finalize(self, state):
+        if not state:
+            return None
+        # ties broken by smallest value, matching reference MODE default
+        best = max(state.items(), key=lambda kv: (kv[1], -kv[0] if isinstance(kv[0], (int, float)) else 0))
+        return float(best[0]) if isinstance(best[0], (int, float)) else best[0]
+
+
+_REGISTRY = {
+    "count": CountAgg,
+    "sum": SumAgg,
+    "min": MinAgg,
+    "max": MaxAgg,
+    "avg": AvgAgg,
+    "minmaxrange": MinMaxRangeAgg,
+    "distinctcount": DistinctCountAgg,
+    "mode": ModeAgg,
+    "percentile": PercentileAgg,
+    "percentileest": PercentileAgg,
+}
+
+
+def make_agg(call: Function) -> AggFunc:
+    name = call.name
+    if call.name == "count" and call.distinct:
+        # COUNT(DISTINCT x) -> DISTINCTCOUNT(x), reference does the same rewrite
+        return DistinctCountAgg(Function("distinctcount", call.args))
+    if name.startswith("percentile") and name[10:].isdigit():
+        return PercentileAgg(call)
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise QueryValidationError(f"unsupported aggregation function {name!r}")
+    return cls(call)

@@ -120,16 +120,24 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
 
 
 def _resolve(e: Expr, select: List[Tuple[Expr, str]], alias_map: Dict[str, Expr]) -> Expr:
-    """Resolve ordinals (GROUP BY 1) and select aliases (ORDER BY total)."""
+    """Resolve ordinals (GROUP BY 1) and select aliases (ORDER BY total).
+
+    Ordinals only apply to a *whole* GROUP BY/ORDER BY item (top level); a literal inside
+    an expression (HAVING COUNT(*) > 2) stays a literal. Aliases resolve at any depth.
+    """
     if isinstance(e, Literal) and isinstance(e.value, int) and not isinstance(e.value, bool):
         idx = e.value - 1
         if 0 <= idx < len(select):
             return select[idx][0]
         raise QueryValidationError(f"ordinal {e.value} out of range")
+    return _resolve_aliases(e, alias_map)
+
+
+def _resolve_aliases(e: Expr, alias_map: Dict[str, Expr]) -> Expr:
     if isinstance(e, Identifier) and e.name in alias_map:
         return alias_map[e.name]
     if isinstance(e, Function):
-        return Function(e.name, tuple(_resolve(a, select, alias_map) for a in e.args),
+        return Function(e.name, tuple(_resolve_aliases(a, alias_map) for a in e.args),
                         e.distinct)
     return e
 

@@ -1,0 +1,359 @@
+"""Per-server query executor: plan + run each segment, combine, reduce.
+
+Analog of `ServerQueryExecutorV1Impl.processQuery`
+(`pinot-core/.../query/executor/ServerQueryExecutorV1Impl.java:130`): acquire segments,
+plan per segment (`planner.py`), execute (device kernel / host fallback / selection),
+combine partials (`reduce.merge_segment_results`) and — when used standalone, as in the
+single-process tests — run the broker reduce too (`reduce.reduce_to_result`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..segment.reader import ImmutableSegment
+from ..sql.ast import Expr, Function, Identifier, identifiers_in
+from .aggregates import AggFunc, make_agg
+from .context import QueryContext, compile_query
+from .planner import SegmentPlan, build_device_geometry, plan_segment
+from .predicate import CmpLeaf, LutLeaf, NullLeaf
+from .reduce import SegmentResult, merge_segment_results, reduce_to_result
+from .result import ResultTable
+
+
+class ServerQueryExecutor:
+    """Executes a QueryContext over a set of local segments."""
+
+    def __init__(self, use_device: bool = True):
+        self.use_device = use_device
+
+    # -- public API --------------------------------------------------------
+    def execute(self, segments: Sequence[ImmutableSegment],
+                query: Union[str, QueryContext], schema=None) -> ResultTable:
+        ctx = compile_query(query, schema or (segments[0].schema if segments else None)) \
+            if isinstance(query, str) else query
+        aggs = [make_agg(f) for f in ctx.aggregations]
+        group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                       else list(ctx.group_by))
+        results = [self.execute_segment(ctx, seg) for seg in segments]
+        merged = merge_segment_results(results, aggs)
+        if not results:
+            merged.kind = ("groups" if (group_exprs or ctx.distinct) else
+                           "scalar" if aggs else "selection")
+        return reduce_to_result(ctx, merged, aggs, group_exprs)
+
+    # -- per-segment execution --------------------------------------------
+    def execute_segment(self, ctx: QueryContext, segment: ImmutableSegment) -> SegmentResult:
+        plan = plan_segment(ctx, segment)
+        if not self.use_device and plan.kind == "device":
+            plan.kind = "host"
+            plan.fallback_reason = "device disabled"
+        if plan.kind == "empty":
+            return self._empty_result(plan)
+        if plan.kind == "metadata":
+            return self._metadata_result(plan)
+        if plan.kind == "selection":
+            return self._selection(plan)
+        if plan.kind == "device":
+            return self._device_aggregate(plan)
+        return self._host_aggregate(plan)
+
+    # ------------------------------------------------------------------
+    def _result_kind(self, plan: SegmentPlan) -> str:
+        return "groups" if plan.group_exprs else "scalar"
+
+    def _empty_result(self, plan: SegmentPlan) -> SegmentResult:
+        if plan.group_exprs:
+            return SegmentResult("groups")
+        empty = np.empty(0, dtype=np.float64)
+        return SegmentResult("scalar",
+                             scalar=[a.host_state(empty) for a in plan.aggs] or None)
+
+    def _metadata_result(self, plan: SegmentPlan) -> SegmentResult:
+        """Answer from metadata without scanning (NonScanBasedAggregationOperator)."""
+        seg = plan.segment
+        states: List[Any] = []
+        for agg in plan.aggs:
+            if agg.name == "count":
+                states.append(seg.num_docs)
+            else:
+                reader = seg.column(agg.arg.name)
+                mn, mx = float(reader.min_value), float(reader.max_value)
+                if agg.name == "min":
+                    states.append(mn)
+                elif agg.name == "max":
+                    states.append(mx)
+                else:  # minmaxrange
+                    states.append((mn, mx))
+        return SegmentResult("scalar", scalar=states, num_docs_scanned=0)
+
+    # -- device aggregation path ----------------------------------------
+    def _device_aggregate(self, plan: SegmentPlan) -> SegmentResult:
+        from ..engine import kernels
+        from ..engine.datablock import block_for, lut_size
+
+        seg = plan.segment
+        build_device_geometry(plan)
+        agg_specs: List[Tuple[AggFunc, Tuple[str, ...]]] = []
+        distinct_lut_sizes: Dict[int, int] = {}
+        for i, agg in enumerate(plan.aggs):
+            agg_specs.append((agg, agg.device_outputs))
+            if "distinct" in agg.device_outputs:
+                distinct_lut_sizes[i] = lut_size(seg.column(agg.arg.name).cardinality)
+
+        block = block_for(seg)
+        spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
+                                  tuple(agg_specs), distinct_lut_sizes, block.padded)
+        inputs = self._kernel_inputs(plan, spec, block)
+        outs = kernels.run_kernel(spec, inputs)
+
+        if plan.group_cols:
+            return self._decode_group_partials(plan, outs)
+        return self._decode_scalar_partials(plan, outs)
+
+    def _kernel_inputs(self, plan: SegmentPlan, spec, block):
+        import jax.numpy as jnp
+        from ..engine.kernels import KernelInputs
+
+        ids_cols = set(plan.group_cols)
+        vals_cols = set()
+        nulls_cols = set()
+        luts = []
+        iscal: List[int] = []
+        fscal: List[float] = []
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, LutLeaf):
+                ids_cols.add(leaf.col)
+                luts.append(jnp.asarray(leaf.lut))
+            elif isinstance(leaf, CmpLeaf):
+                vals_cols.update(identifiers_in(leaf.expr))
+                (iscal if leaf.is_int else fscal).extend(leaf.operands)
+            elif isinstance(leaf, NullLeaf):
+                nulls_cols.add(leaf.col)
+        for i, agg in enumerate(plan.aggs):
+            if "distinct" in agg.device_outputs:
+                ids_cols.add(agg.arg.name)
+            elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
+                                              and agg.arg.name == "*"):
+                vals_cols.update(identifiers_in(agg.arg))
+
+        return KernelInputs(
+            ids={c: block.ids(c) for c in ids_cols},
+            vals={c: block.values(c) for c in vals_cols},
+            luts=tuple(luts),
+            iscal=jnp.asarray(np.asarray(iscal, dtype=np.int32)),
+            fscal=jnp.asarray(np.asarray(fscal, dtype=np.float32)),
+            nulls={c: block.null_mask(c) for c in nulls_cols},
+            valid=block.valid,
+            strides=jnp.asarray(np.asarray(plan.strides, dtype=np.int32)),
+        )
+
+    def _decode_group_partials(self, plan: SegmentPlan, outs) -> SegmentResult:
+        seg = plan.segment
+        counts = outs["count"][:plan.num_keys_real]
+        occupied = np.nonzero(counts > 0)[0]
+        # decode dense keys -> per-column dict ids -> values (vectorized per column)
+        value_cols = []
+        for j, col in enumerate(plan.group_cols):
+            ids_j = (occupied // plan.strides[j]) % max(plan.cards[j], 1)
+            value_cols.append(seg.column(col).dictionary.take(ids_j.astype(np.int64)))
+        keys = list(zip(*[c.tolist() for c in value_cols])) if len(occupied) else []
+
+        result = SegmentResult("groups")
+        result.num_docs_scanned = int(counts.sum())
+        for row, k in enumerate(occupied):
+            states = []
+            for i, agg in enumerate(plan.aggs):
+                o = {"count": int(counts[k])}
+                for out_name in agg.device_outputs:
+                    if out_name != "count":
+                        o[out_name] = outs[f"{i}.{out_name}"][k]
+                states.append(agg.state_from_device(o))
+            result.groups[tuple(keys[row])] = states
+        return result
+
+    def _decode_scalar_partials(self, plan: SegmentPlan, outs) -> SegmentResult:
+        seg = plan.segment
+        count = int(outs["count"])
+        states: List[Any] = []
+        for i, agg in enumerate(plan.aggs):
+            if "distinct" in agg.device_outputs:
+                presence = outs[f"{i}.distinct"]
+                card = seg.column(agg.arg.name).cardinality
+                present_ids = np.nonzero(presence[:card] > 0)[0]
+                values = seg.column(agg.arg.name).dictionary.take(present_ids)
+                states.append(set(values.tolist()))
+                continue
+            o = {"count": count}
+            for out_name in agg.device_outputs:
+                if out_name != "count":
+                    o[out_name] = outs[f"{i}.{out_name}"]
+            states.append(agg.state_from_device(o))
+        return SegmentResult("scalar", scalar=states, num_docs_scanned=count)
+
+    # -- host fallback aggregation ---------------------------------------
+    def _host_aggregate(self, plan: SegmentPlan) -> SegmentResult:
+        import pandas as pd
+
+        seg = plan.segment
+        mask = host_filter_mask(plan, seg)
+        idx = np.nonzero(mask)[0]
+        env = _host_env(plan, seg)
+
+        def arg_values(agg: AggFunc) -> np.ndarray:
+            if agg.arg is None or (isinstance(agg.arg, Identifier) and agg.arg.name == "*"):
+                return np.zeros(len(idx))
+            from ..engine.expr import eval_expr
+            return np.asarray(eval_expr(agg.arg, env, np))[idx]
+
+        if not plan.group_exprs:
+            states = [a.host_state(arg_values(a)) for a in plan.aggs]
+            return SegmentResult("scalar", scalar=states, num_docs_scanned=len(idx))
+
+        from ..engine.expr import eval_expr
+        key_arrays = [np.asarray(eval_expr(g, env, np))[idx] for g in plan.group_exprs]
+        arg_arrays = [arg_values(a) for a in plan.aggs]
+
+        frame = pd.DataFrame({f"g{j}": k for j, k in enumerate(key_arrays)})
+        grouped = frame.groupby([f"g{j}" for j in range(len(key_arrays))], sort=False).indices
+
+        result = SegmentResult("groups", num_docs_scanned=len(idx))
+        for key, gidx in grouped.items():
+            key = key if isinstance(key, tuple) else (key,)
+            key = tuple(v.item() if isinstance(v, np.generic) else v for v in key)
+            result.groups[key] = [a.host_state(arg_arrays[i][gidx])
+                                  for i, a in enumerate(plan.aggs)]
+        return result
+
+    # -- selection --------------------------------------------------------
+    def _selection(self, plan: SegmentPlan) -> SegmentResult:
+        ctx, seg = plan.ctx, plan.segment
+        mask = self._selection_mask(plan)
+        idx = np.nonzero(mask)[0]
+        if not ctx.order_by:
+            idx = idx[:ctx.offset + ctx.limit]  # early terminate (SelectionOnlyOperator)
+
+        needed = set()
+        for e, _ in ctx.select_items:
+            needed.update(identifiers_in(e))
+        for o in ctx.order_by:
+            needed.update(identifiers_in(o.expr))
+        env = {c: seg.column(c).values()[idx] for c in needed}
+
+        from ..engine.expr import eval_expr
+        out_cols = [np.asarray(eval_expr(e, env, np)) if not _is_const(e)
+                    else np.full(len(idx), eval_expr(e, env, np), dtype=object)
+                    for e, _ in ctx.select_items]
+        rows = [tuple(c[i].item() if isinstance(c[i], np.generic) else c[i]
+                      for c in out_cols) for i in range(len(idx))]
+        sort_keys = []
+        if ctx.order_by:
+            sort_cols = [np.asarray(eval_expr(o.expr, env, np)) for o in ctx.order_by]
+            sort_keys = [tuple(c[i].item() if isinstance(c[i], np.generic) else c[i]
+                               for c in sort_cols) for i in range(len(idx))]
+        return SegmentResult("selection", rows=rows, sort_keys=sort_keys,
+                             num_docs_scanned=len(idx))
+
+    def _selection_mask(self, plan: SegmentPlan) -> np.ndarray:
+        seg = plan.segment
+        if plan.filter_prog.is_match_all:
+            return np.ones(seg.num_docs, dtype=bool)
+        use_device = self.use_device
+        if use_device:
+            from .planner import _expr_device_ok
+            for leaf in plan.filter_prog.leaves:
+                if isinstance(leaf, CmpLeaf) and _expr_device_ok(leaf.expr, seg):
+                    use_device = False
+                    break
+        if use_device:
+            from ..engine import kernels
+            from ..engine.datablock import block_for
+            block = block_for(seg)
+            spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded)
+            inputs = self._kernel_inputs(plan, spec, block)
+            return kernels.compute_mask(spec, inputs)[:seg.num_docs]
+        return host_filter_mask(plan, seg)
+
+
+def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
+    """Evaluate the compiled filter program with numpy on the host — same LUT semantics as
+    the device path, so host and device paths agree by construction."""
+    from ..engine.expr import eval_expr
+
+    prog = plan.filter_prog
+    n = seg.num_docs
+    if prog is None or prog.is_match_all:
+        return np.ones(n, dtype=bool)
+    env = _host_env(plan, seg)
+
+    def leaf_mask(i: int) -> np.ndarray:
+        leaf = prog.leaves[i]
+        if isinstance(leaf, LutLeaf):
+            ids = np.asarray(seg.column(leaf.col).fwd).astype(np.int64)
+            return leaf.lut[ids]
+        if isinstance(leaf, NullLeaf):
+            nb = seg.column(leaf.col).null_bitmap
+            m = nb if nb is not None else np.zeros(n, dtype=bool)
+            return ~m if leaf.negated else m
+        assert isinstance(leaf, CmpLeaf)
+        v = np.asarray(eval_expr(leaf.expr, env, np))
+        ops = leaf.operands
+        if leaf.op == "eq":
+            return v == ops[0]
+        if leaf.op == "gte":
+            return v >= ops[0]
+        if leaf.op == "lte":
+            return v <= ops[0]
+        if leaf.op == "gt":
+            return v > ops[0]
+        if leaf.op == "lt":
+            return v < ops[0]
+        if leaf.op == "between":
+            return (v >= ops[0]) & (v <= ops[1])
+        m = v == ops[0]
+        for o in ops[1:]:
+            m = m | (v == o)
+        return m
+
+    def walk_tree(node) -> np.ndarray:
+        kind = node[0]
+        if kind == "const":
+            return np.full(n, node[1], dtype=bool)
+        if kind == "leaf":
+            return leaf_mask(node[1])
+        if kind == "not":
+            return ~walk_tree(node[1])
+        masks = [walk_tree(c) for c in node[1]]
+        out = masks[0]
+        for m in masks[1:]:
+            out = (out & m) if kind == "and" else (out | m)
+        return out
+
+    return walk_tree(prog.tree)
+
+
+def _host_env(plan: SegmentPlan, seg: ImmutableSegment) -> Dict[str, np.ndarray]:
+    """Decoded column environment for host-side expression evaluation."""
+    needed = set()
+    for g in plan.group_exprs:
+        needed.update(identifiers_in(g))
+    for a in plan.aggs:
+        if a.arg is not None:
+            needed.update(identifiers_in(a.arg))
+    if plan.filter_prog:
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, CmpLeaf):
+                needed.update(identifiers_in(leaf.expr))
+    return {c: seg.column(c).values() for c in needed}
+
+
+def _is_const(e: Expr) -> bool:
+    return not identifiers_in(e)
+
+
+def execute_query(segments: Sequence[ImmutableSegment], sql: str,
+                  schema=None, use_device: bool = True) -> ResultTable:
+    """One-call convenience: SQL over loaded segments (the BaseQueriesTest harness shape)."""
+    return ServerQueryExecutor(use_device).execute(segments, sql, schema)

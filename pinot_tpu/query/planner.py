@@ -1,0 +1,213 @@
+"""Per-segment plan maker: choose execution strategy and build kernel specs/inputs.
+
+Analog of the reference's `InstancePlanMakerImplV2.makeSegmentPlanNode`
+(`pinot-core/.../plan/maker/InstancePlanMakerImplV2.java:153,243,288`) + segment pruners
+(`core/query/pruner/`): decide per segment whether the query runs as
+
+* `metadata` — answered from segment metadata alone, no scan (reference:
+  `NonScanBasedAggregationOperator`): COUNT(*)/MIN/MAX with no filter;
+* `empty`    — pruned: filter folds to constant-false (bloom / min-max / dictionary miss);
+* `device`   — the fused TPU kernel (aggregation/group-by hot path);
+* `host`     — numpy fallback for shapes the device path doesn't cover yet
+  (group-by on expressions/raw columns, percentile/mode, huge key spaces);
+* `selection`— mask on device, gather + order on host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..segment.reader import ImmutableSegment
+from ..sql.ast import Expr, Function, Identifier, Literal, identifiers_in
+from .aggregates import AggContext, AggFunc, make_agg
+from .context import QueryContext, QueryValidationError
+from .predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf, compile_filter
+
+MAX_DEVICE_GROUP_KEYS = 1 << 20  # dense-key cap (reference caps group-by at 100k groups)
+
+_DEVICE_FUNCS = {"plus", "minus", "times", "divide", "mod", "case", "cast", "abs", "ceil",
+                 "floor", "exp", "ln", "log10", "sqrt", "power", "round", "least",
+                 "greatest", "eq", "neq", "gt", "gte", "lt", "lte", "and", "or", "not",
+                 "in", "not_in", "between"}
+
+
+@dataclass
+class SegmentPlan:
+    kind: str  # metadata | empty | device | host | selection
+    segment: ImmutableSegment
+    ctx: QueryContext
+    aggs: List[AggFunc] = field(default_factory=list)
+    group_exprs: List[Expr] = field(default_factory=list)
+    filter_prog: Optional[FilterProgram] = None
+    # device group-by geometry
+    group_cols: Tuple[str, ...] = ()
+    cards: Tuple[int, ...] = ()
+    strides: Tuple[int, ...] = ()
+    num_keys_real: int = 0
+    num_keys_pad: int = 0
+    fallback_reason: str = ""
+
+
+def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    # DISTINCT rewrites to a group-by over the select expressions with no aggregations
+    # (reference: DistinctOperator is a specialized group-by).
+    if ctx.distinct:
+        group_exprs = [e for e, _ in ctx.select_items]
+    else:
+        group_exprs = list(ctx.group_by)
+
+    plan = SegmentPlan("host", segment, ctx, aggs, group_exprs)
+
+    # -- filter compilation + constant-fold pruning ------------------------
+    try:
+        prog = compile_filter(ctx.filter, segment)
+    except QueryValidationError:
+        raise
+    plan.filter_prog = _fold_luts(prog, segment)
+    if plan.filter_prog.tree == ("const", False):
+        plan.kind = "empty"
+        return plan
+
+    if not ctx.is_aggregation_query and not ctx.distinct:
+        plan.kind = "selection"
+        return plan
+
+    # -- metadata-only answers --------------------------------------------
+    if (not group_exprs and ctx.filter is None and aggs
+            and all(_metadata_answerable(a, segment) for a in aggs)):
+        plan.kind = "metadata"
+        return plan
+
+    # -- device path feasibility ------------------------------------------
+    reason = _device_feasible(plan, segment)
+    if reason:
+        plan.kind = "host"
+        plan.fallback_reason = reason
+        return plan
+    plan.kind = "device"
+    return plan
+
+
+def _fold_luts(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgram:
+    """Fold all-false/all-true LUT leaves to constants — this is segment pruning for free:
+    an EQ literal absent from the dictionary (or outside min/max) folds the whole tree to
+    constant-false (reference: ColumnValueSegmentPruner + dictionary-miss shortcut)."""
+    from .predicate import _simplify  # shared with filter compilation
+
+    def fold(node):
+        if node[0] == "leaf":
+            leaf = prog.leaves[node[1]]
+            if isinstance(leaf, LutLeaf):
+                card = segment.column(leaf.col).cardinality
+                if not leaf.lut.any():
+                    return ("const", False)
+                if leaf.lut[:card].all():
+                    return ("const", True)
+            if isinstance(leaf, NullLeaf):
+                has_nulls = segment.column(leaf.col).meta.get("hasNulls", False)
+                if not has_nulls:
+                    return ("const", leaf.negated)
+            return node
+        if node[0] in ("and", "or"):
+            return (node[0], tuple(fold(c) for c in node[1]))
+        if node[0] == "not":
+            return ("not", fold(node[1]))
+        return node
+
+    prog.tree = _simplify(fold(prog.tree))
+    return prog
+
+
+def _metadata_answerable(agg: AggFunc, segment: ImmutableSegment) -> bool:
+    if agg.name == "count" and (agg.arg is None or
+                                (isinstance(agg.arg, Identifier) and agg.arg.name == "*")):
+        return True
+    if agg.name in ("min", "max", "minmaxrange") and isinstance(agg.arg, Identifier):
+        reader = segment.column(agg.arg.name)
+        return reader.data_type.is_numeric and reader.min_value is not None
+    return False
+
+
+def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
+    """Empty string if the fused device kernel can run this plan; else the reason."""
+    # group-by columns must be plain dict-encoded columns with a bounded key space
+    cards: List[int] = []
+    cols: List[str] = []
+    for e in plan.group_exprs:
+        if not isinstance(e, Identifier):
+            return f"group-by expression {e!r} (host transform)"
+        reader = segment.column(e.name)
+        if not reader.has_dictionary:
+            return f"group-by on raw column {e.name}"
+        cols.append(e.name)
+        cards.append(reader.cardinality)
+    num_keys = 1
+    for c in cards:
+        num_keys *= max(c, 1)
+    if num_keys > MAX_DEVICE_GROUP_KEYS:
+        return f"group key space {num_keys} exceeds device cap"
+    plan.group_cols = tuple(cols)
+
+    group_by = bool(cols)
+    for agg in plan.aggs:
+        arg = agg.arg
+        arg_is_dict = isinstance(arg, Identifier) and arg.name != "*" and \
+            segment.column(arg.name).has_dictionary
+        arg_numeric = arg is None or not isinstance(arg, Identifier) or arg.name == "*" or \
+            segment.column(arg.name).data_type.is_numeric
+        if not agg.device_ok(AggContext(group_by, arg_is_dict, arg_numeric)):
+            return f"aggregation {agg.name} not device-supported here"
+        if "distinct" in agg.device_outputs and arg_is_dict:
+            continue  # distinct over a dict column works on ids; value dtype irrelevant
+        if arg is not None and not (isinstance(arg, Identifier) and arg.name == "*"):
+            err = _expr_device_ok(arg, segment)
+            if err:
+                return err
+
+    if plan.filter_prog:
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, CmpLeaf):
+                err = _expr_device_ok(leaf.expr, segment)
+                if err:
+                    return err
+    return ""
+
+
+def _expr_device_ok(e: Expr, segment: ImmutableSegment) -> str:
+    """Device-evaluable: numeric identifiers representable in 32 bits, known functions."""
+    for node_name in identifiers_in(e):
+        reader = segment.column(node_name)
+        if not reader.data_type.is_numeric:
+            return f"non-numeric column {node_name} in expression"
+        mn, mx = reader.min_value, reader.max_value
+        if (mn is not None and mx is not None and isinstance(mn, (int, np.integer))
+                and (mn < -(2 ** 31) or mx >= 2 ** 31)):
+            return f"column {node_name} exceeds int32 range (device is 32-bit)"
+    def check(node):
+        if isinstance(node, Function):
+            if node.name not in _DEVICE_FUNCS:
+                return f"function {node.name} not device-supported"
+            for a in node.args:
+                err = check(a)
+                if err:
+                    return err
+        return ""
+    return check(e)
+
+
+def build_device_geometry(plan: SegmentPlan) -> None:
+    """Fill dense-key geometry: strides over real cardinalities, pow2-padded key count."""
+    cards = [plan.segment.column(c).cardinality for c in plan.group_cols]
+    strides = []
+    s = 1
+    for c in cards:
+        strides.append(s)
+        s *= max(c, 1)
+    plan.cards = tuple(cards)
+    plan.strides = tuple(strides)
+    plan.num_keys_real = s
+    plan.num_keys_pad = 1 << max(0, (s - 1)).bit_length()

@@ -1,0 +1,281 @@
+"""Filter compilation: predicate AST + segment dictionaries -> device filter program.
+
+Analog of the reference's predicate evaluators
+(`pinot-core/.../operator/filter/predicate/`, 13 factories): every predicate over a
+dict-encoded column is resolved host-side against the *sorted dictionary* into a boolean
+lookup table (LUT) over dict ids, so on device it is one gather (`lut[ids]`) regardless of
+whether it was EQ/IN/RANGE/LIKE/REGEXP. Predicates over raw numeric columns (and arbitrary
+expressions — the reference's `ExpressionFilterOperator`) compile to vectorized comparisons
+with scalar operands passed as runtime inputs, keeping the jit kernel reusable across
+literal changes.
+
+Integer normalization: float literals against integer expressions are normalized host-side
+(`x > 2.5` -> `x >= 3`) so the device compares integers exactly instead of in float32.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..segment.reader import ColumnReader, ImmutableSegment
+from ..sql.ast import Expr, Function, Identifier, Literal
+from .context import QueryValidationError
+
+# filter tree: ("and"|"or", (children...)) | ("not", child) | ("leaf", index) | ("const", bool)
+FilterTree = Tuple
+
+
+@dataclass
+class LutLeaf:
+    """Dict-column predicate resolved to a boolean LUT over dict ids."""
+    col: str
+    lut: np.ndarray  # bool[lut_size(card)] — padding ids map to False
+
+    @property
+    def kind(self) -> str:
+        return "lut"
+
+    def signature(self) -> Tuple:
+        return ("lut", self.col, len(self.lut))
+
+
+@dataclass
+class CmpLeaf:
+    """Comparison of a device-evaluable numeric expression against scalar operands.
+
+    op in {eq, neq, gt, gte, lt, lte, between, in}; operands live in the runtime scalar
+    arrays (int slots for integer compares, float slots otherwise).
+    """
+    expr: Expr
+    op: str
+    operands: List[Any]
+    is_int: bool
+
+    @property
+    def kind(self) -> str:
+        return "cmp"
+
+    def signature(self) -> Tuple:
+        return ("cmp", repr(self.expr), self.op, len(self.operands), self.is_int)
+
+
+@dataclass
+class NullLeaf:
+    col: str
+    negated: bool  # True for IS NOT NULL
+
+    @property
+    def kind(self) -> str:
+        return "null"
+
+    def signature(self) -> Tuple:
+        return ("null", self.col, self.negated)
+
+
+Leaf = Union[LutLeaf, CmpLeaf, NullLeaf]
+
+
+@dataclass
+class FilterProgram:
+    tree: FilterTree = ("const", True)
+    leaves: List[Leaf] = field(default_factory=list)
+
+    def signature(self) -> Tuple:
+        return (_tree_sig(self.tree), tuple(l.signature() for l in self.leaves))
+
+    @property
+    def is_match_all(self) -> bool:
+        return self.tree == ("const", True)
+
+
+def _tree_sig(tree: FilterTree) -> Tuple:
+    kind = tree[0]
+    if kind in ("and", "or"):
+        return (kind, tuple(_tree_sig(c) for c in tree[1]))
+    if kind == "not":
+        return ("not", _tree_sig(tree[1]))
+    return tree  # ("leaf", i) / ("const", b)
+
+
+_RANGE_OPS = {"gt", "gte", "lt", "lte", "between"}
+_NEGATIONS = {"neq": "eq", "not_in": "in", "not_like": "like"}
+
+
+def compile_filter(expr: Optional[Expr], segment: ImmutableSegment) -> FilterProgram:
+    """Compile a WHERE tree for one segment (reference: FilterPlanNode.run, per-segment
+    because dictionaries — and therefore LUT contents — are per-segment)."""
+    prog = FilterProgram()
+    if expr is None:
+        return prog
+    prog.tree = _compile_node(expr, segment, prog.leaves)
+    prog.tree = _simplify(prog.tree)
+    return prog
+
+
+def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterTree:
+    if isinstance(e, Literal):
+        return ("const", bool(e.value))
+    if isinstance(e, Identifier):
+        raise QueryValidationError(f"bare column {e.name!r} is not a boolean predicate")
+    assert isinstance(e, Function)
+    name = e.name
+    if name == "and":
+        return ("and", tuple(_compile_node(a, seg, leaves) for a in e.args))
+    if name == "or":
+        return ("or", tuple(_compile_node(a, seg, leaves) for a in e.args))
+    if name == "not":
+        return ("not", _compile_node(e.args[0], seg, leaves))
+    if name in _NEGATIONS:
+        return ("not", _compile_node(Function(_NEGATIONS[name], e.args), seg, leaves))
+    if name in ("is_null", "is_not_null"):
+        col = e.args[0]
+        if not isinstance(col, Identifier):
+            raise QueryValidationError("IS NULL requires a plain column")
+        leaves.append(NullLeaf(col.name, negated=(name == "is_not_null")))
+        return ("leaf", len(leaves) - 1)
+    return _compile_predicate(e, seg, leaves)
+
+
+def _compile_predicate(e: Function, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterTree:
+    lhs = e.args[0]
+    rhs = list(e.args[1:])
+    # normalize `literal op column` to `column op' literal`
+    if isinstance(lhs, Literal) and len(rhs) == 1 and not isinstance(rhs[0], Literal):
+        flip = {"eq": "eq", "gt": "lt", "gte": "lte", "lt": "gt", "lte": "gte"}
+        if e.name in flip:
+            lhs, rhs = rhs[0], [lhs]
+            e = Function(flip[e.name], (lhs, *rhs))
+    if not all(isinstance(r, Literal) for r in rhs):
+        raise QueryValidationError(f"predicate operands must be literals: {e!r}")
+    values = [r.value for r in rhs]
+
+    # dictionary-encoded single-column predicate -> LUT leaf
+    if isinstance(lhs, Identifier):
+        reader = seg.column(lhs.name)
+        if reader.has_dictionary:
+            leaves.append(LutLeaf(lhs.name, _build_lut(e.name, values, reader)))
+            return ("leaf", len(leaves) - 1)
+
+    # raw column / expression predicate -> comparison leaf
+    op, operands, is_int, const = _normalize_cmp(e.name, values, lhs, seg)
+    if const is not None:
+        return ("const", const)
+    leaves.append(CmpLeaf(lhs, op, operands, is_int))
+    return ("leaf", len(leaves) - 1)
+
+
+def _build_lut(op: str, values: List[Any], reader: ColumnReader) -> np.ndarray:
+    from ..engine.datablock import lut_size  # local import to avoid jax at module import
+    d = reader.dictionary
+    lut = np.zeros(lut_size(reader.cardinality), dtype=bool)
+    if op == "eq":
+        i = d.index_of(values[0])
+        if i >= 0:
+            lut[i] = True
+    elif op == "in":
+        lut[d.ids_for_values(values)] = True
+    elif op == "between":
+        lo, hi = d.id_range(values[0], values[1])
+        lut[lo:hi] = True
+    elif op in ("gt", "gte"):
+        lo, hi = d.id_range(values[0], None, lower_inclusive=(op == "gte"))
+        lut[lo:hi] = True
+    elif op in ("lt", "lte"):
+        lo, hi = d.id_range(None, values[0], upper_inclusive=(op == "lte"))
+        lut[lo:hi] = True
+    elif op == "like":
+        lut[d.ids_matching_like(str(values[0]))] = True
+    elif op == "regexp_like":
+        lut[d.ids_matching_regex(str(values[0]))] = True
+    else:
+        raise QueryValidationError(f"unsupported predicate {op} on dictionary column")
+    return lut
+
+
+def _normalize_cmp(op: str, values: List[Any], lhs: Expr, seg: ImmutableSegment):
+    """Normalize operands for a raw/expression compare; returns (op, operands, is_int, const).
+
+    const is a bool when the predicate folds to a constant (e.g. `int_col = 2.5` -> False).
+    """
+    is_int = _expr_is_integer(lhs, seg)
+    if op == "like" or op == "regexp_like":
+        raise QueryValidationError("LIKE/REGEXP on raw (non-dictionary) columns is unsupported")
+    if not is_int:
+        return op, [float(v) for v in values], False, None
+
+    # integer expression: normalize float literals to exact integer comparisons
+    if op == "eq":
+        v = values[0]
+        if float(v) != int(v):
+            return op, [], True, False
+        return op, [int(v)], True, None
+    if op == "in":
+        ints = [int(v) for v in values if float(v) == int(v)]
+        if not ints:
+            return op, [], True, False
+        return op, ints, True, None
+    if op == "between":
+        lo, hi = math.ceil(values[0]), math.floor(values[1])
+        if lo > hi:
+            return op, [], True, False
+        return op, [lo, hi], True, None
+    if op == "gt":
+        return "gte", [math.floor(values[0]) + 1], True, None
+    if op == "gte":
+        return "gte", [math.ceil(values[0])], True, None
+    if op == "lt":
+        return "lte", [math.ceil(values[0]) - 1], True, None
+    if op == "lte":
+        return "lte", [math.floor(values[0])], True, None
+    raise QueryValidationError(f"unsupported comparison {op}")
+
+
+def _expr_is_integer(e: Expr, seg: ImmutableSegment) -> bool:
+    """Conservatively: integer iff all leaves are integer columns/literals and ops preserve
+    integrality (no divide)."""
+    if isinstance(e, Literal):
+        return isinstance(e.value, int) and not isinstance(e.value, bool)
+    if isinstance(e, Identifier):
+        reader = seg.column(e.name)
+        return np.dtype(reader.meta["fwdDtype"]).kind in "iu" and (
+            not reader.has_dictionary or reader.data_type.is_numeric)
+    if isinstance(e, Function):
+        if e.name in ("plus", "minus", "times", "mod"):
+            return all(_expr_is_integer(a, seg) for a in e.args)
+        return False
+    return False
+
+
+def _simplify(tree: FilterTree) -> FilterTree:
+    """Constant-fold and flatten (reference: filter optimizer, `core/query/optimizer/filter/`)."""
+    kind = tree[0]
+    if kind in ("and", "or"):
+        absorb, identity = (False, True) if kind == "and" else (True, False)
+        children = []
+        for c in tree[1]:
+            c = _simplify(c)
+            if c[0] == "const":
+                if c[1] == absorb:
+                    return ("const", absorb)
+                continue  # identity: drop
+            if c[0] == kind:  # flatten nested and(and(...)) — reference: FlattenAndOrFilterOptimizer
+                children.extend(c[1])
+            else:
+                children.append(c)
+        if not children:
+            return ("const", identity)
+        if len(children) == 1:
+            return children[0]
+        return (kind, tuple(children))
+    if kind == "not":
+        c = _simplify(tree[1])
+        if c[0] == "const":
+            return ("const", not c[1])
+        if c[0] == "not":
+            return c[1]
+        return ("not", c)
+    return tree

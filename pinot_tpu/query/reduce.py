@@ -1,0 +1,197 @@
+"""Combine + reduce: merge per-segment partials, finalize, project, HAVING/ORDER/LIMIT.
+
+Analog of the reference's combine operators + broker reduce
+(`pinot-core/.../operator/combine/GroupByOrderByCombineOperator.java` merging into
+`ConcurrentIndexedTable`, then `core/query/reduce/GroupByDataTableReducer.java`,
+`PostAggregationHandler.java`, `HavingFilterHandler.java`). Here both levels use the same
+value-keyed hash merge, because group keys are decoded to *values* before leaving a segment
+(per-segment dictionaries don't align across segments).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..sql.ast import Expr, Function, Identifier, Literal
+from ..engine.expr import eval_expr
+from .aggregates import AggFunc
+from .context import QueryContext
+from .result import ResultTable
+
+
+@dataclass
+class SegmentResult:
+    """Partial result of one segment (reference: IntermediateResultsBlock)."""
+
+    kind: str  # "groups" | "scalar" | "selection"
+    groups: Dict[Tuple, List[Any]] = field(default_factory=dict)  # key values -> agg states
+    scalar: Optional[List[Any]] = None                            # agg states (no group-by)
+    rows: List[Tuple] = field(default_factory=list)               # selection output rows
+    sort_keys: List[Tuple] = field(default_factory=list)          # selection sort keys
+    num_docs_scanned: int = 0
+
+
+def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> SegmentResult:
+    """Server-level combine (also reused broker-side across servers)."""
+    if not results:
+        return SegmentResult("scalar", scalar=None)
+    kind = results[0].kind
+    out = SegmentResult(kind)
+    out.num_docs_scanned = sum(r.num_docs_scanned for r in results)
+    if kind == "groups":
+        merged: Dict[Tuple, List[Any]] = {}
+        for r in results:
+            for key, states in r.groups.items():
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = list(states)
+                else:
+                    for i, agg in enumerate(aggs):
+                        cur[i] = agg.merge(cur[i], states[i])
+        out.groups = merged
+    elif kind == "scalar":
+        merged_states: Optional[List[Any]] = None
+        for r in results:
+            if r.scalar is None:
+                continue
+            if merged_states is None:
+                merged_states = list(r.scalar)
+            else:
+                for i, agg in enumerate(aggs):
+                    merged_states[i] = agg.merge(merged_states[i], r.scalar[i])
+        out.scalar = merged_states
+    else:
+        for r in results:
+            out.rows.extend(r.rows)
+            out.sort_keys.extend(r.sort_keys)
+    return out
+
+
+def reduce_to_result(ctx: QueryContext, merged: SegmentResult, aggs: List[AggFunc],
+                     group_exprs: List[Expr]) -> ResultTable:
+    """Broker-side reduce: finalize states, post-aggregate, HAVING, ORDER BY, LIMIT."""
+    if merged.kind == "selection":
+        return _reduce_selection(ctx, merged)
+
+    # -- build the result-expression environment ---------------------------
+    env: Dict[str, np.ndarray] = {}
+    if merged.kind == "groups":
+        keys = list(merged.groups.keys())
+        n = len(keys)
+        for j, g in enumerate(group_exprs):
+            env[repr(g)] = np.array([k[j] for k in keys], dtype=object)
+        for i, call in enumerate(ctx.aggregations):
+            vals = [aggs[i].finalize(merged.groups[k][i]) for k in keys]
+            env[repr(call)] = np.array(vals, dtype=object)
+    else:
+        n = 1
+        states = merged.scalar
+        for i, call in enumerate(ctx.aggregations):
+            v = (aggs[i].finalize(states[i]) if states is not None
+                 else aggs[i].empty_result())
+            env[repr(call)] = np.array([v], dtype=object)
+
+    # -- HAVING ------------------------------------------------------------
+    keep = np.ones(n, dtype=bool)
+    if ctx.having is not None:
+        keep &= np.asarray(_eval_result(ctx.having, env, n), dtype=bool)
+
+    # -- project select items ---------------------------------------------
+    out_cols: List[np.ndarray] = []
+    for expr, _name in ctx.select_items:
+        out_cols.append(np.asarray(_eval_result(expr, env, n), dtype=object))
+
+    # -- ORDER BY ----------------------------------------------------------
+    idx = np.nonzero(keep)[0].tolist()
+    if ctx.order_by:
+        sort_cols = [np.asarray(_eval_result(o.expr, env, n), dtype=object)
+                     for o in ctx.order_by]
+        idx.sort(key=lambda i: _sort_key(
+            [c[i] for c in sort_cols], ctx.order_by))
+    idx = idx[ctx.offset:ctx.offset + ctx.limit]
+
+    rows = [[col[i] for col in out_cols] for i in idx]
+    return ResultTable([name for _, name in ctx.select_items], _pyify(rows),
+                       {"numDocsScanned": merged.num_docs_scanned,
+                        "numGroupsTotal": n if merged.kind == "groups" else None})
+
+
+def _reduce_selection(ctx: QueryContext, merged: SegmentResult) -> ResultTable:
+    order = list(range(len(merged.rows)))
+    if ctx.order_by:
+        order.sort(key=lambda i: _sort_key(list(merged.sort_keys[i]), ctx.order_by))
+    order = order[ctx.offset:ctx.offset + ctx.limit]
+    rows = [list(merged.rows[i]) for i in order]
+    return ResultTable([name for _, name in ctx.select_items], _pyify(rows),
+                       {"numDocsScanned": merged.num_docs_scanned})
+
+
+class _Reverse:
+    """Inverts comparison order for DESC keys of arbitrary comparable type."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _sort_key(values: List[Any], order_by) -> Tuple:
+    key = []
+    for v, o in zip(values, order_by):
+        # Null ordering: reference treats null as largest unless NULLS FIRST/LAST given.
+        nulls_last = o.nulls_last if o.nulls_last is not None else not o.desc
+        is_null = v is None
+        null_rank = (1 if is_null else 0) if nulls_last else (0 if is_null else 1)
+        v = 0 if is_null else v
+        key.append((null_rank, _Reverse(v) if o.desc else v))
+    return tuple(key)
+
+
+def _eval_result(e: Expr, env: Dict[str, np.ndarray], n: int):
+    """Evaluate a result-shaping expression: aggregation/group subtrees come from `env`
+    (keyed by canonical repr), remaining arithmetic evaluates vectorized on host."""
+    sub, bindings = _substitute(e, env)
+    out = eval_expr(sub, bindings, np)
+    if np.isscalar(out) or not hasattr(out, "__len__"):
+        return np.full(n, out, dtype=object)
+    return out
+
+
+def _substitute(e: Expr, env: Dict[str, np.ndarray], bindings=None):
+    if bindings is None:
+        bindings = {}
+    r = repr(e)
+    if r in env:
+        name = f"\x00{len(bindings)}"
+        # reuse binding for identical subtrees
+        for k, v in bindings.items():
+            if v is env[r]:
+                name = k
+                break
+        bindings[name] = env[r]
+        return Identifier(name), bindings
+    if isinstance(e, Function):
+        new_args = []
+        for a in e.args:
+            na, bindings = _substitute(a, env, bindings)
+            new_args.append(na)
+        return Function(e.name, tuple(new_args), e.distinct), bindings
+    if isinstance(e, Identifier):
+        raise KeyError(f"unresolved column {e.name!r} in post-aggregation expression")
+    return e, bindings
+
+
+def _pyify(rows: List[List[Any]]) -> List[List[Any]]:
+    out = []
+    for row in rows:
+        out.append([v.item() if isinstance(v, np.generic) else v for v in row])
+    return out

@@ -1,0 +1,40 @@
+"""Query result model: ResultTable + execution stats.
+
+Analog of the reference's broker response
+(`pinot-common/.../response/broker/BrokerResponseNative.java` / `ResultTable`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass
+class ResultTable:
+    columns: List[str]
+    rows: List[List[Any]]
+    stats: Dict[str, Any] = field(default_factory=dict)  # numDocsScanned, segments, timings
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "resultTable": {
+                "dataSchema": {"columnNames": self.columns},
+                "rows": [[_jsonify(v) for v in row] for row in self.rows],
+            },
+            **self.stats,
+        }
+
+    def __repr__(self) -> str:
+        return f"ResultTable({self.columns}, {len(self.rows)} rows)"
+
+
+def _jsonify(v: Any) -> Any:
+    import numpy as np
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v

@@ -1,0 +1,267 @@
+"""Query correctness: differential testing against sqlite3 as oracle.
+
+Reference pattern: `BaseQueriesTest` (pinot-core/src/test/.../queries/BaseQueriesTest.java)
+builds real segments and runs the full single-server stack without networking, and the
+integration suite checks randomized queries against H2 (`QueryGenerator`). Here sqlite3
+(stdlib) is the oracle; the same SQL runs through both engines over identical rows.
+"""
+
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import execute_query
+from pinot_tpu.segment import SegmentBuilder, SegmentGeneratorConfig, load_segment
+
+from conftest import make_ssb_columns
+
+
+@pytest.fixture(scope="module")
+def qenv(tmp_path_factory, ssb_schema):
+    """Two segments of SSB data + a sqlite mirror of the union."""
+    rng = np.random.default_rng(7)
+    out = tmp_path_factory.mktemp("qseg")
+    cols_a = make_ssb_columns(rng, 3000)
+    cols_b = make_ssb_columns(rng, 2000)
+    builder = SegmentBuilder(ssb_schema, SegmentGeneratorConfig(
+        inverted_index_columns=["lo_region"]))
+    seg_a = load_segment(builder.build(cols_a, str(out), "lineorder_0"))
+    seg_b = load_segment(builder.build(cols_b, str(out), "lineorder_1"))
+
+    db = sqlite3.connect(":memory:")
+    db.execute("PRAGMA case_sensitive_like=ON")
+    names = list(cols_a.keys())
+    db.execute(f"CREATE TABLE lineorder ({', '.join(names)})")
+    for cols in (cols_a, cols_b):
+        rows = list(zip(*[np.asarray(cols[c]).tolist() if isinstance(cols[c], np.ndarray)
+                          else cols[c] for c in names]))
+        db.executemany(f"INSERT INTO lineorder VALUES ({','.join('?' * len(names))})", rows)
+    db.commit()
+    return [seg_a, seg_b], db
+
+
+def run_both(qenv, sql, sqlite_sql=None, ordered=False):
+    segments, db = qenv
+    ours = execute_query(segments, sql)
+    oracle = db.execute(sqlite_sql or sql).fetchall()
+    compare(ours.rows, oracle, ordered)
+    return ours
+
+
+def compare(got_rows, want_rows, ordered):
+    def norm(rows):
+        normed = [tuple(_norm_val(v) for v in r) for r in rows]
+        return normed if ordered else sorted(normed, key=repr)
+    got, want = norm(got_rows), norm(want_rows)
+    assert len(got) == len(want), f"row count {len(got)} != {len(want)}\n{got[:5]}\n{want[:5]}"
+    for g, w in zip(got, want):
+        assert len(g) == len(w)
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float) and isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=2e-3, abs=1e-6), f"{g} != {w}"
+            else:
+                assert gv == wv, f"{g} != {w}"
+
+
+def _norm_val(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, np.integer)):
+        return float(v)  # unify int/float across engines
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    return v
+
+
+# -- scalar aggregations -----------------------------------------------------
+
+def test_ssb_q1_1(qenv):
+    # SSB Q1.1: revenue = SUM(extendedprice * discount) with range filters
+    run_both(qenv,
+             "SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+             "WHERE lo_orderdate BETWEEN 19930101 AND 19931231 "
+             "AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25 LIMIT 100")
+
+
+def test_count_star_no_filter(qenv):
+    run_both(qenv, "SELECT COUNT(*) FROM lineorder")
+
+
+def test_min_max_avg(qenv):
+    run_both(qenv,
+             "SELECT MIN(lo_revenue), MAX(lo_revenue), AVG(lo_quantity), COUNT(*) "
+             "FROM lineorder WHERE lo_region = 'ASIA'")
+
+
+def test_minmaxrange(qenv):
+    run_both(qenv,
+             "SELECT MINMAXRANGE(lo_quantity) FROM lineorder WHERE lo_region = 'EUROPE'",
+             sqlite_sql="SELECT MAX(lo_quantity) - MIN(lo_quantity) FROM lineorder "
+                        "WHERE lo_region = 'EUROPE'")
+
+
+def test_metadata_only_answers(qenv):
+    run_both(qenv, "SELECT COUNT(*), MIN(lo_quantity), MAX(lo_revenue) FROM lineorder")
+
+
+def test_empty_filter_result(qenv):
+    run_both(qenv, "SELECT COUNT(*), SUM(lo_revenue) FROM lineorder "
+                   "WHERE lo_region = 'ATLANTIS'")
+
+
+def test_distinctcount(qenv):
+    run_both(qenv,
+             "SELECT DISTINCTCOUNT(lo_brand) FROM lineorder WHERE lo_quantity > 10",
+             sqlite_sql="SELECT COUNT(DISTINCT lo_brand) FROM lineorder "
+                        "WHERE lo_quantity > 10")
+
+
+def test_count_distinct(qenv):
+    run_both(qenv,
+             "SELECT COUNT(DISTINCT lo_region) FROM lineorder WHERE lo_discount <= 5")
+
+
+# -- group by ---------------------------------------------------------------
+
+def test_group_by_single(qenv):
+    run_both(qenv,
+             "SELECT lo_region, SUM(lo_revenue), COUNT(*) FROM lineorder "
+             "GROUP BY lo_region LIMIT 100")
+
+
+def test_group_by_multi_with_filter(qenv):
+    run_both(qenv,
+             "SELECT lo_region, lo_category, SUM(lo_revenue) FROM lineorder "
+             "WHERE lo_quantity BETWEEN 10 AND 40 AND lo_region IN ('ASIA', 'EUROPE') "
+             "GROUP BY lo_region, lo_category LIMIT 100")
+
+
+def test_group_by_order_by_limit(qenv):
+    run_both(qenv,
+             "SELECT lo_brand, SUM(lo_revenue) AS rev FROM lineorder "
+             "GROUP BY lo_brand ORDER BY rev DESC, lo_brand LIMIT 7", ordered=True)
+
+
+def test_group_by_having(qenv):
+    run_both(qenv,
+             "SELECT lo_category, COUNT(*) AS c FROM lineorder "
+             "GROUP BY lo_category HAVING COUNT(*) > 400 LIMIT 100")
+
+
+def test_group_by_expression_key(qenv):
+    # expression group key -> host fallback path
+    run_both(qenv,
+             "SELECT lo_discount * 2, COUNT(*) FROM lineorder "
+             "GROUP BY lo_discount * 2 LIMIT 100")
+
+
+def test_group_by_int_column(qenv):
+    run_both(qenv,
+             "SELECT lo_discount, AVG(lo_extendedprice) FROM lineorder "
+             "WHERE lo_category = 'MFGR#3' GROUP BY lo_discount LIMIT 100")
+
+
+def test_post_aggregation_arithmetic(qenv):
+    run_both(qenv,
+             "SELECT lo_region, SUM(lo_revenue) / COUNT(*) FROM lineorder "
+             "GROUP BY lo_region LIMIT 100",
+             sqlite_sql="SELECT lo_region, SUM(lo_revenue) * 1.0 / COUNT(*) "
+                        "FROM lineorder GROUP BY lo_region")
+
+
+def test_order_by_group_key_asc(qenv):
+    run_both(qenv,
+             "SELECT lo_region, MAX(lo_quantity) FROM lineorder "
+             "GROUP BY lo_region ORDER BY lo_region LIMIT 100", ordered=True)
+
+
+# -- filters ----------------------------------------------------------------
+
+def test_or_not_combinations(qenv):
+    run_both(qenv,
+             "SELECT COUNT(*) FROM lineorder WHERE "
+             "(lo_region = 'ASIA' OR lo_region = 'AFRICA') AND NOT lo_discount = 0")
+
+
+def test_in_not_in(qenv):
+    run_both(qenv,
+             "SELECT COUNT(*) FROM lineorder WHERE lo_region IN ('ASIA', 'EUROPE') "
+             "AND lo_category NOT IN ('MFGR#1')")
+
+
+def test_like(qenv):
+    run_both(qenv,
+             "SELECT COUNT(*) FROM lineorder WHERE lo_brand LIKE 'MFGR#2%'")
+
+
+def test_neq_and_range_on_string_dict(qenv):
+    run_both(qenv,
+             "SELECT COUNT(*) FROM lineorder WHERE lo_region != 'ASIA' "
+             "AND lo_region > 'AMERICA'")
+
+
+def test_expression_filter(qenv):
+    # arithmetic predicate -> cmp leaf on device
+    run_both(qenv,
+             "SELECT COUNT(*) FROM lineorder "
+             "WHERE lo_extendedprice * lo_quantity > 100000")
+
+
+def test_float_literal_on_int_column(qenv):
+    run_both(qenv, "SELECT COUNT(*) FROM lineorder WHERE lo_quantity > 24.5")
+    run_both(qenv, "SELECT COUNT(*) FROM lineorder WHERE lo_quantity = 24.5")
+
+
+# -- selection --------------------------------------------------------------
+
+def test_selection_order_by(qenv):
+    run_both(qenv,
+             "SELECT lo_orderkey, lo_region, lo_revenue FROM lineorder "
+             "WHERE lo_quantity = 50 ORDER BY lo_revenue DESC, lo_orderkey LIMIT 15",
+             ordered=True)
+
+
+def test_selection_expression(qenv):
+    run_both(qenv,
+             "SELECT lo_orderkey, lo_extendedprice * (1 - lo_discount) FROM lineorder "
+             "WHERE lo_brand = 'MFGR#11' ORDER BY lo_orderkey LIMIT 20", ordered=True)
+
+
+def test_selection_limit_no_order(qenv):
+    segments, db = qenv
+    res = execute_query(segments, "SELECT lo_orderkey FROM lineorder LIMIT 5")
+    assert len(res.rows) == 5
+
+
+def test_distinct(qenv):
+    run_both(qenv,
+             "SELECT DISTINCT lo_region FROM lineorder WHERE lo_discount > 7 LIMIT 100")
+
+
+def test_default_limit_is_10(qenv):
+    segments, _ = qenv
+    res = execute_query(segments, "SELECT lo_orderkey FROM lineorder")
+    assert len(res.rows) == 10
+
+
+# -- percentile (vs numpy, sqlite has no percentile) -------------------------
+
+def test_percentile_host_path(qenv):
+    segments, db = qenv
+    res = execute_query(segments,
+                        "SELECT PERCENTILE(lo_quantity, 50) FROM lineorder LIMIT 5")
+    vals = [r[0] for r in db.execute("SELECT lo_quantity FROM lineorder")]
+    assert res.rows[0][0] == pytest.approx(np.percentile(vals, 50), rel=1e-6)
+
+
+def test_offset_pagination(qenv):
+    segments, _ = qenv
+    full = execute_query(segments, "SELECT lo_brand, COUNT(*) FROM lineorder "
+                                   "GROUP BY lo_brand ORDER BY lo_brand LIMIT 40")
+    page = execute_query(segments, "SELECT lo_brand, COUNT(*) FROM lineorder "
+                                   "GROUP BY lo_brand ORDER BY lo_brand LIMIT 10 OFFSET 5")
+    assert page.rows == full.rows[5:15]
