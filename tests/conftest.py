@@ -8,7 +8,40 @@ XLA's host-platform virtual devices (the analog of the reference's single-JVM cl
 import os
 import sys
 
-# Must run before jax is imported anywhere.
+# Force the CPU backend with 8 virtual devices. On this box the environment pins
+# JAX_PLATFORMS=axon (a tunneled TPU) and a sitecustomize hook registers the axon PJRT
+# plugin at interpreter start — before any conftest code can run, and merely setting
+# JAX_PLATFORMS=cpu afterwards still initializes (and can hang on) the tunnel. So
+# `pytest_configure` below re-execs the interpreter once with a scrubbed environment;
+# jax backend init is lazy, so re-exec before any test imports run jax ops is safe.
+_REEXEC_MARKER = "PINOT_TPU_TEST_REEXEC"
+
+
+def _needs_cpu_reexec() -> bool:
+    return (os.environ.get(_REEXEC_MARKER) != "1"
+            and (os.environ.get("JAX_PLATFORMS", "cpu") != "cpu"
+                 or bool(os.environ.get("PALLAS_AXON_POOL_IPS"))))
+
+
+def pytest_configure(config):
+    if _needs_cpu_reexec():
+        env = dict(os.environ)
+        env.update({
+            _REEXEC_MARKER: "1",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",   # sitecustomize no-ops without this
+            "PYTHONPATH": os.pathsep.join(
+                p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon_site" not in p),
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8").strip(),
+        })
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "pytest", *config.invocation_params.args], env)
+
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
