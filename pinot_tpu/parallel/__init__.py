@@ -1,0 +1,13 @@
+"""Multi-chip execution: device mesh scatter/combine via shard_map + ICI collectives.
+
+The TPU-native replacement for the reference's scatter/gather data plane
+(broker fan-out `QueryRouter.submitQuery` + per-server combine operators + broker reduce,
+SURVEY.md §2.11): segments shard over a 1-D `Mesh` axis, each device scans its shard with
+the same fused kernel as single-chip, and partial aggregates combine with
+`psum`/`pmin`/`pmax` over ICI instead of DataTable shuffles over TCP.
+"""
+
+from .combine import MeshQueryExecutor, aligned_dictionaries
+from .mesh import default_mesh
+
+__all__ = ["MeshQueryExecutor", "aligned_dictionaries", "default_mesh"]

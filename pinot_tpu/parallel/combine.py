@@ -1,0 +1,303 @@
+"""Sharded multi-segment execution: scatter segments over a mesh, psum-combine partials.
+
+The TPU-native analog of the reference's entire distributed query data plane for
+aggregations (SURVEY.md §2.11): where the reference scatters segments to servers over
+Netty (`QueryRouter.submitQuery`), runs per-segment operator trees on thread pools
+(`BaseCombineOperator`), and merges DataTables on the broker
+(`GroupByDataTableReducer`), here the segment axis IS a mesh axis:
+
+    stacked columns [S, P] --shard_map--> per-device fused scan --psum/pmin/pmax--> result
+
+The fast path requires segments with *aligned dictionaries* (`dictHash` equal — built via
+`segment.writer.build_aligned_segments` or a shared ingestion dictionary): dense group
+keys and LUT ids then agree across devices, so partial aggregates combine with one ICI
+collective and no host-side value merge. Unaligned segment sets fall back to the
+per-segment executor + value-keyed host merge, which is always correct.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.datablock import lut_size, padded_rows
+from ..engine.kernels import KernelSpec, _make_mask_fn
+from ..query.aggregates import make_agg
+from ..query.context import QueryContext, compile_query
+from ..query.executor import ServerQueryExecutor
+from ..query.planner import build_device_geometry, plan_segment
+from ..query.predicate import CmpLeaf, LutLeaf, NullLeaf
+from ..query.reduce import merge_segment_results, reduce_to_result
+from ..query.result import ResultTable
+from ..segment.reader import ImmutableSegment
+from ..sql.ast import Identifier, identifiers_in
+from .mesh import SEGMENT_AXIS, default_mesh
+
+_SHARD_KERNEL_CACHE: Dict[Tuple, object] = {}
+
+
+def aligned_dictionaries(segments: Sequence[ImmutableSegment], cols: Sequence[str]) -> bool:
+    """True iff every column in `cols` has identical dictionaries across segments."""
+    for col in cols:
+        hashes = set()
+        for seg in segments:
+            reader = seg.column(col)
+            if not reader.has_dictionary:
+                return False
+            h = reader.meta.get("dictHash")
+            if h is None:
+                return False
+            hashes.add((h, reader.cardinality))
+        if len(hashes) > 1:
+            return False
+    return True
+
+
+class SegmentSetBlock:
+    """Stacked device columns for an aligned segment set: [S_pad, P] arrays."""
+
+    def __init__(self, segments: Sequence[ImmutableSegment], s_pad: int):
+        self.segments = list(segments)
+        self.s_pad = s_pad
+        self.rows = max(padded_rows(s.num_docs) for s in segments)
+        self._cache: Dict[Tuple[str, str], jnp.ndarray] = {}
+
+    def _stack(self, kind: str, col: str, fill, per_seg) -> jnp.ndarray:
+        key = (kind, col)
+        if key not in self._cache:
+            first = np.asarray(per_seg(self.segments[0]))
+            out = np.full((self.s_pad, self.rows), fill, dtype=first.dtype)
+            for i, seg in enumerate(self.segments):
+                arr = np.asarray(per_seg(seg))
+                out[i, :len(arr)] = arr
+            self._cache[key] = jnp.asarray(out)
+        return self._cache[key]
+
+    def ids(self, col: str) -> jnp.ndarray:
+        card = self.segments[0].column(col).cardinality
+        return self._stack("ids", col, np.int32(card),
+                           lambda s: np.asarray(s.column(col).fwd).astype(np.int32))
+
+    def raw(self, col: str) -> jnp.ndarray:
+        from ..engine.datablock import _narrow
+        return self._stack("raw", col, 0,
+                           lambda s: _narrow(np.asarray(s.column(col).fwd)))
+
+    def decode_table(self, col: str) -> jnp.ndarray:
+        from ..engine.datablock import _narrow
+        reader = self.segments[0].column(col)
+        vals = _narrow(np.asarray(reader.dictionary.values))
+        out = np.zeros(lut_size(reader.cardinality), dtype=vals.dtype)
+        out[:len(vals)] = vals
+        return jnp.asarray(out)
+
+    def null_mask(self, col: str) -> jnp.ndarray:
+        def per_seg(s):
+            nb = s.column(col).null_bitmap
+            return nb if nb is not None else np.zeros(s.num_docs, dtype=bool)
+        return self._stack("null", col, False, per_seg)
+
+    @property
+    def valid(self) -> jnp.ndarray:
+        def per_seg(s):
+            return np.ones(s.num_docs, dtype=bool)
+        return self._stack("valid", "", False, per_seg)
+
+
+class MeshQueryExecutor:
+    """Executes aggregation queries over segment sets sharded across a device mesh."""
+
+    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None):
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.n_devices = self.mesh.devices.size
+        self._fallback = ServerQueryExecutor()
+        self._set_blocks: Dict[Tuple[str, ...], SegmentSetBlock] = {}
+
+    # ------------------------------------------------------------------
+    def execute(self, segments: Sequence[ImmutableSegment],
+                query: Union[str, QueryContext], schema=None) -> ResultTable:
+        ctx = compile_query(query, schema or segments[0].schema) \
+            if isinstance(query, str) else query
+        plan = plan_segment(ctx, segments[0])
+        if plan.kind != "device" or not self._alignable(plan, segments):
+            return self._fallback.execute(segments, ctx)
+        return self._execute_sharded(ctx, plan, segments)
+
+    def _alignable(self, plan, segments) -> bool:
+        cols = set(plan.group_cols)
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, LutLeaf):
+                cols.add(leaf.col)
+            elif isinstance(leaf, CmpLeaf):
+                cols.update(c for c in identifiers_in(leaf.expr)
+                            if segments[0].column(c).has_dictionary)
+        for agg in plan.aggs:
+            if agg.arg is None or (isinstance(agg.arg, Identifier) and agg.arg.name == "*"):
+                continue
+            cols.update(c for c in identifiers_in(agg.arg)
+                        if segments[0].column(c).has_dictionary)
+        return aligned_dictionaries(segments, cols)
+
+    # ------------------------------------------------------------------
+    def _execute_sharded(self, ctx: QueryContext, plan, segments) -> ResultTable:
+        build_device_geometry(plan)
+        agg_specs = []
+        distinct_lut_sizes: Dict[int, int] = {}
+        for i, agg in enumerate(plan.aggs):
+            agg_specs.append((agg, agg.device_outputs))
+            if "distinct" in agg.device_outputs:
+                distinct_lut_sizes[i] = lut_size(segments[0].column(agg.arg.name).cardinality)
+
+        s_pad = -(-len(segments) // self.n_devices) * self.n_devices
+        key = tuple(s.path for s in segments)
+        block = self._set_blocks.get(key)
+        if block is None or block.s_pad != s_pad:
+            block = SegmentSetBlock(segments, s_pad)
+            self._set_blocks[key] = block
+
+        spec = KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
+                          tuple(agg_specs), distinct_lut_sizes, block.rows)
+
+        # -- gather runtime inputs ------------------------------------
+        ids_cols, decode_cols, raw_cols, nulls_cols = set(plan.group_cols), set(), set(), set()
+        luts, iscal, fscal = [], [], []
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, LutLeaf):
+                ids_cols.add(leaf.col)
+                luts.append(jnp.asarray(leaf.lut))
+            elif isinstance(leaf, CmpLeaf):
+                for c in identifiers_in(leaf.expr):
+                    (decode_cols if segments[0].column(c).has_dictionary else raw_cols).add(c)
+                (iscal if leaf.is_int else fscal).extend(leaf.operands)
+            elif isinstance(leaf, NullLeaf):
+                nulls_cols.add(leaf.col)
+        for i, agg in enumerate(plan.aggs):
+            if "distinct" in agg.device_outputs:
+                ids_cols.add(agg.arg.name)
+            elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
+                                              and agg.arg.name == "*"):
+                for c in identifiers_in(agg.arg):
+                    (decode_cols if segments[0].column(c).has_dictionary else raw_cols).add(c)
+        ids_cols |= decode_cols  # decode needs the ids too
+
+        inputs = dict(
+            ids={c: block.ids(c) for c in ids_cols},
+            raw={c: block.raw(c) for c in raw_cols},
+            decode={c: block.decode_table(c) for c in decode_cols},
+            luts=tuple(luts),
+            iscal=jnp.asarray(np.asarray(iscal, dtype=np.int32)),
+            fscal=jnp.asarray(np.asarray(fscal, dtype=np.float32)),
+            nulls={c: block.null_mask(c) for c in nulls_cols},
+            valid=block.valid,
+            strides=jnp.asarray(np.asarray(plan.strides, dtype=np.int32)),
+        )
+
+        fn = self._get_shard_kernel(spec, s_pad, block.rows)
+        outs = {k: np.asarray(v) for k, v in fn(inputs).items()}
+
+        # replicated outputs decode exactly like the single-segment path; dictionaries
+        # are shared, so segment[0]'s dictionaries decode the global dense keys.
+        if plan.group_cols:
+            seg_result = self._fallback._decode_group_partials(plan, outs)
+        else:
+            seg_result = self._fallback._decode_scalar_partials(plan, outs)
+        merged = merge_segment_results([seg_result], plan.aggs)
+        group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                       else list(ctx.group_by))
+        return reduce_to_result(ctx, merged, plan.aggs, group_exprs)
+
+    # ------------------------------------------------------------------
+    def _get_shard_kernel(self, spec: KernelSpec, s_pad: int, rows: int):
+        cache_key = (spec.signature(), self.n_devices, s_pad, rows, id(self.mesh))
+        fn = _SHARD_KERNEL_CACHE.get(cache_key)
+        if fn is None:
+            fn = self._build_shard_kernel(spec)
+            _SHARD_KERNEL_CACHE[cache_key] = fn
+        return fn
+
+    def _build_shard_kernel(self, spec: KernelSpec):
+        mask_fn = _make_mask_fn(spec)
+        group = bool(spec.group_cols)
+        num_seg = spec.num_keys_pad + 1
+        P = jax.sharding.PartitionSpec
+        ax = SEGMENT_AXIS
+        sharded, repl = P(ax), P()
+
+        in_specs = (dict(ids=sharded, raw=sharded, decode=repl, luts=repl, iscal=repl,
+                         fscal=repl, nulls=sharded, valid=sharded, strides=repl),)
+
+        def shard_body(inputs):
+            ids, raw, decode = inputs["ids"], inputs["raw"], inputs["decode"]
+            luts, iscal, fscal = inputs["luts"], inputs["iscal"], inputs["fscal"]
+            nulls, valid, strides = inputs["nulls"], inputs["valid"], inputs["strides"]
+            # local shapes: [s_local, P] — decode dict values in-kernel (one gather)
+            vals = {c: decode[c][ids[c]] for c in decode}
+            vals.update(raw)
+            mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid)
+            out = {}
+            if group:
+                key = jnp.zeros_like(ids[spec.group_cols[0]])
+                for gi, gc in enumerate(spec.group_cols):
+                    key = key + ids[gc] * strides[gi]
+                key = jnp.where(mask, key, spec.num_keys_pad).ravel()
+                flat_mask = mask.ravel()
+                counts = jax.ops.segment_sum(jnp.ones_like(key), key, num_segments=num_seg)
+                out["count"] = jax.lax.psum(counts, ax)
+                for ai, (agg, outs_names) in enumerate(spec.aggs):
+                    v = None if agg.arg is None or (
+                        isinstance(agg.arg, Identifier) and agg.arg.name == "*") \
+                        else _eval_flat(agg.arg, vals).ravel()
+                    for o in outs_names:
+                        if o == "count":
+                            continue
+                        if o == "sum":
+                            part = jax.ops.segment_sum(
+                                jnp.where(flat_mask, v.astype(jnp.float32), 0.0), key,
+                                num_segments=num_seg)
+                            out[f"{ai}.sum"] = jax.lax.psum(part, ax)
+                        elif o == "min":
+                            part = jax.ops.segment_min(v, key, num_segments=num_seg)
+                            out[f"{ai}.min"] = jax.lax.pmin(part, ax)
+                        elif o == "max":
+                            part = jax.ops.segment_max(v, key, num_segments=num_seg)
+                            out[f"{ai}.max"] = jax.lax.pmax(part, ax)
+            else:
+                flat_mask = mask.ravel()
+                out["count"] = jax.lax.psum(flat_mask.sum(dtype=jnp.int32), ax)
+                for ai, (agg, outs_names) in enumerate(spec.aggs):
+                    if "distinct" in outs_names:
+                        presence = jax.ops.segment_sum(
+                            flat_mask.astype(jnp.int32), ids[agg.arg.name].ravel(),
+                            num_segments=spec.distinct_lut_sizes[ai])
+                        out[f"{ai}.distinct"] = jax.lax.psum(presence, ax)
+                        continue
+                    if outs_names == ("count",):
+                        continue
+                    v = _eval_flat(agg.arg, vals)
+                    for o in outs_names:
+                        if o == "count":
+                            continue
+                        if o == "sum":
+                            s = (v.astype(jnp.float32) * mask.astype(jnp.float32)).sum()
+                            out[f"{ai}.sum"] = jax.lax.psum(s, ax)
+                        elif o == "min":
+                            ident = np.iinfo(np.int32).max if v.dtype.kind == "i" else jnp.inf
+                            out[f"{ai}.min"] = jax.lax.pmin(
+                                jnp.where(mask, v, ident).min(), ax)
+                        elif o == "max":
+                            ident = np.iinfo(np.int32).min if v.dtype.kind == "i" else -jnp.inf
+                            out[f"{ai}.max"] = jax.lax.pmax(
+                                jnp.where(mask, v, ident).max(), ax)
+            return out
+
+        return jax.jit(jax.shard_map(shard_body, mesh=self.mesh,
+                                     in_specs=in_specs, out_specs=repl))
+
+
+def _eval_flat(expr, vals):
+    from ..engine.expr import eval_expr
+    return eval_expr(expr, vals, jnp)

@@ -1,0 +1,19 @@
+"""Device mesh helpers."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+SEGMENT_AXIS = "seg"
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = SEGMENT_AXIS) -> jax.sharding.Mesh:
+    """1-D mesh over available devices; the single parallel axis is segment scatter
+    (the reference's only data-parallel dimension — SURVEY.md §2.11 row 'DP')."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, only {len(devices)} available")
+    return jax.make_mesh((n,), (axis,), devices=devices[:n])

@@ -46,13 +46,20 @@ class SegmentBuilder:
 
     def build(self, columns: Dict[str, Union[np.ndarray, Sequence[Any]]],
               out_dir: str, segment_name: str,
-              extra_metadata: Optional[Dict[str, Any]] = None) -> str:
+              extra_metadata: Optional[Dict[str, Any]] = None,
+              fixed_dictionaries: Optional[Dict[str, "Dictionary"]] = None) -> str:
         """Write segment `<out_dir>/<segment_name>/`; returns the segment path.
 
         `columns` maps column name -> raw values (numpy array or python sequence).
         Missing schema columns are filled with default nulls. `None` entries become the
         type's default null and are recorded in the null bitmap
         (reference: `NullValueVectorCreator`).
+
+        `fixed_dictionaries` pins columns to pre-built dictionaries so a *set* of
+        segments shares dict-id space — the TPU scatter fast path (mesh combine via
+        psum over dense keys) requires aligned dictionaries. This has no reference
+        equivalent (Pinot dictionaries are strictly per-segment); it's a deliberate
+        TPU-first design addition. Values absent from a fixed dictionary are an error.
         """
         num_docs = self._num_docs(columns)
         seg_dir = os.path.join(out_dir, segment_name)
@@ -64,7 +71,8 @@ class SegmentBuilder:
             raw = columns.get(spec.name)
             if raw is None:
                 raw = [spec.null_value] * num_docs
-            col_meta[spec.name] = self._write_column(cols_dir, spec, raw, num_docs)
+            fixed = (fixed_dictionaries or {}).get(spec.name)
+            col_meta[spec.name] = self._write_column(cols_dir, spec, raw, num_docs, fixed)
 
         meta = {
             "formatVersion": fmt.FORMAT_VERSION,
@@ -91,7 +99,8 @@ class SegmentBuilder:
         return sizes.pop() if sizes else 0
 
     def _write_column(self, cols_dir: str, spec: "FieldSpec",
-                      raw: Union[np.ndarray, Sequence[Any]], num_docs: int) -> Dict[str, Any]:
+                      raw: Union[np.ndarray, Sequence[Any]], num_docs: int,
+                      fixed_dict: Optional["Dictionary"] = None) -> Dict[str, Any]:
         name, data_type = spec.name, spec.data_type
         prefix = os.path.join(cols_dir, name)
 
@@ -111,7 +120,11 @@ class SegmentBuilder:
         # np.unique is simultaneously the stats collector, the cardinality counter for
         # the dict-vs-raw decision, and the dictionary creator — one sort pass total.
         dictionary = dict_ids = None
-        if name in self.config.no_dictionary_columns:
+        if fixed_dict is not None:
+            dictionary = fixed_dict
+            dict_ids = _encode_with_fixed_dict(raw, fixed_dict, name)
+            use_dict = True
+        elif name in self.config.no_dictionary_columns:
             if not data_type.is_numeric:
                 raise ValueError(f"column {name}: non-numeric columns must be dictionary-encoded "
                                  f"(device representation is dict ids; see format.py)")
@@ -147,6 +160,9 @@ class SegmentBuilder:
                 "sorted": bool(np.all(dict_ids[1:] >= dict_ids[:-1])) if num_docs else True,
                 "minValue": _jsonable(dictionary.min_value, data_type),
                 "maxValue": _jsonable(dictionary.max_value, data_type),
+                # content hash: segments with equal dictHash share dict-id space, which
+                # unlocks the mesh psum combine fast path (parallel/combine.py)
+                "dictHash": _dict_hash(dictionary),
             })
             # -- auxiliary indexes (pass 2) ----------------------------
             if name in self.config.inverted_index_columns:
@@ -178,6 +194,67 @@ class SegmentBuilder:
 
         meta["indexes"] = indexes
         return meta
+
+
+def _encode_with_fixed_dict(raw, dictionary, name: str) -> np.ndarray:
+    from .dictionary import Dictionary  # noqa: F401 (typing aid)
+    values = np.asarray(dictionary.values) if not isinstance(dictionary.values, np.ndarray) \
+        else dictionary.values
+    arr = np.asarray(raw, dtype=values.dtype if values.dtype.kind != "O" else object)
+    ids = np.searchsorted(values, arr)
+    ids = np.clip(ids, 0, len(values) - 1)
+    if not np.all(values[ids] == arr):
+        raise ValueError(f"column {name}: value absent from fixed dictionary")
+    return ids.astype(np.int64)
+
+
+def _dict_hash(dictionary) -> int:
+    import zlib
+    vals = dictionary.values
+    if isinstance(vals, np.ndarray):
+        return zlib.crc32(np.ascontiguousarray(vals).tobytes())
+    joined = "\x00".join(v.hex() if isinstance(v, bytes) else str(v) for v in vals)
+    return zlib.crc32(joined.encode("utf-8"))
+
+
+def build_aligned_segments(schema: Schema, columns: Dict[str, Union[np.ndarray, Sequence[Any]]],
+                           out_dir: str, base_name: str, num_segments: int,
+                           config: Optional[SegmentGeneratorConfig] = None) -> List[str]:
+    """Split one column batch into `num_segments` row-range segments that share
+    dictionaries (computed over the union). This is how the benchmark and the mesh
+    scatter tests produce device-alignable segment sets."""
+    import dataclasses
+
+    from .dictionary import build_dictionary
+
+    config = dataclasses.replace(config or SegmentGeneratorConfig())
+    config.no_dictionary_columns = list(config.no_dictionary_columns)  # private copy
+    builder = SegmentBuilder(schema, config)
+    num_docs = builder._num_docs(columns)
+    fixed: Dict[str, Any] = {}
+    for spec in builder.schema.fields:
+        raw = columns.get(spec.name)
+        if raw is None or spec.name in builder.config.no_dictionary_columns:
+            continue  # missing -> per-segment default fill; no-dict -> raw everywhere
+        if spec.data_type.is_numeric:
+            d, _ = build_dictionary(np.asarray(raw), spec.data_type)
+            if d.cardinality > builder.config.raw_cardinality_fraction * num_docs:
+                # force raw in *every* segment (per-segment heuristics could diverge)
+                builder.config.no_dictionary_columns.append(spec.name)
+                continue
+            fixed[spec.name] = d
+        else:
+            fixed[spec.name], _ = build_dictionary(raw, spec.data_type)
+
+    bounds = np.linspace(0, num_docs, num_segments + 1, dtype=np.int64)
+    paths = []
+    for i in range(num_segments):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        part = {c: (v[lo:hi] if isinstance(v, np.ndarray) else list(v[lo:hi]))
+                for c, v in columns.items()}
+        paths.append(builder.build(part, out_dir, f"{base_name}_{i}",
+                                   fixed_dictionaries=fixed))
+    return paths
 
 
 def _jsonable(v: Any, data_type: DataType) -> Any:
