@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Headline benchmark: SSB Q1.1-style filter+aggregate scan rate, rows/sec/chip.
+
+Runs the fused TPU scan (MeshQueryExecutor over however many devices are visible — one
+real chip under axon) on synthetic SSB lineorder data, and compares against a
+single-thread vectorized numpy evaluation of the same query — the stand-in for the
+reference's Java vectorized engine (the JVM engine itself cannot run in this image; see
+BASELINE.md). Prints ONE JSON line:
+
+    {"metric": ..., "value": rows_per_sec, "unit": "rows/s", "vs_baseline": ratio}
+
+Env knobs: PINOT_BENCH_ROWS (default 8M), PINOT_BENCH_SEGMENTS (8),
+PINOT_BENCH_ITERS (20), PINOT_BENCH_DIR (cache dir).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("PINOT_BENCH_ROWS", 8 * 1024 * 1024))
+SEGMENTS = int(os.environ.get("PINOT_BENCH_SEGMENTS", 8))
+ITERS = int(os.environ.get("PINOT_BENCH_ITERS", 20))
+CACHE = os.environ.get("PINOT_BENCH_DIR", "/tmp/pinot_tpu_bench")
+
+QUERY = ("SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+         "WHERE lo_orderdate BETWEEN 19930101 AND 19931231 "
+         "AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25 LIMIT 10")
+
+
+def ssb_schema():
+    from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+    return Schema("lineorder", [
+        dimension("lo_region", DataType.STRING),
+        date_time("lo_orderdate", DataType.INT),
+        metric("lo_quantity", DataType.INT),
+        metric("lo_extendedprice", DataType.DOUBLE),
+        metric("lo_discount", DataType.INT),
+        metric("lo_revenue", DataType.DOUBLE),
+    ])
+
+
+def make_columns(n: int):
+    rng = np.random.default_rng(20260729)
+    regions = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+    region_ids = rng.integers(0, 5, n)
+    return {
+        "lo_region": np.array(regions, dtype=object)[region_ids],
+        "lo_orderdate": (19920101 + rng.integers(0, 7, n) * 10000
+                         + rng.integers(1, 13, n) * 100
+                         + rng.integers(1, 29, n)).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+        "lo_extendedprice": np.round(rng.uniform(1.0, 10_000.0, n), 2).astype(np.float64),
+        "lo_discount": rng.integers(0, 11, n).astype(np.int32),
+        "lo_revenue": np.round(rng.uniform(1.0, 60_000.0, n), 2).astype(np.float64),
+    }
+
+
+def build_or_load_segments(schema, cols):
+    from pinot_tpu.segment import load_segment
+    from pinot_tpu.segment.writer import build_aligned_segments
+    tag = f"r{ROWS}_s{SEGMENTS}_v1"
+    seg_root = os.path.join(CACHE, tag)
+    marker = os.path.join(seg_root, "DONE")
+    if not os.path.exists(marker):
+        os.makedirs(seg_root, exist_ok=True)
+        build_aligned_segments(schema, cols, seg_root, "lineorder", SEGMENTS)
+        with open(marker, "w") as f:
+            f.write("ok")
+    names = sorted(d for d in os.listdir(seg_root) if d.startswith("lineorder_"))
+    return [load_segment(os.path.join(seg_root, d)) for d in names]
+
+
+def numpy_baseline(cols, iters=3) -> float:
+    """Single-thread vectorized scan of the same query (Java-engine stand-in)."""
+    od, disc, qty = cols["lo_orderdate"], cols["lo_discount"], cols["lo_quantity"]
+    price = cols["lo_extendedprice"]
+
+    def run():
+        mask = ((od >= 19930101) & (od <= 19931231)
+                & (disc >= 1) & (disc <= 3) & (qty < 25))
+        return float(np.sum(price[mask] * disc[mask]))
+
+    run()  # warm caches
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        result = run()
+    dt = (time.perf_counter() - t0) / iters
+    return len(od) / dt, result
+
+
+def main():
+    schema = ssb_schema()
+    cols = make_columns(ROWS)
+    segments = build_or_load_segments(schema, cols)
+
+    import jax
+    from pinot_tpu.parallel import MeshQueryExecutor, default_mesh
+    n_dev = len(jax.devices())
+    mesh_exec = MeshQueryExecutor(default_mesh(n_dev))
+
+    # warmup: device transfer + jit compile
+    for _ in range(2):
+        res = mesh_exec.execute(segments, QUERY)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        res = mesh_exec.execute(segments, QUERY)
+    per_query = (time.perf_counter() - t0) / ITERS
+    rows_per_sec = ROWS / per_query
+
+    np_rows_per_sec, np_result = numpy_baseline(cols)
+    ours = res.rows[0][0]
+    if abs(ours - np_result) > 2e-3 * max(1.0, abs(np_result)):
+        print(f"WARNING: result mismatch tpu={ours} numpy={np_result}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "ssb_q1.1_filter_agg_scan_rate",
+        "value": round(rows_per_sec / n_dev, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(rows_per_sec / n_dev / np_rows_per_sec, 3),
+        "detail": {
+            "rows": ROWS, "segments": SEGMENTS, "devices": n_dev,
+            "p50_query_latency_ms": round(per_query * 1000, 3),
+            "numpy_single_thread_rows_per_sec": round(np_rows_per_sec, 1),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
