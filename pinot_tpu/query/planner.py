@@ -67,7 +67,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         prog = compile_filter(ctx.filter, segment)
     except QueryValidationError:
         raise
-    plan.filter_prog = _fold_luts(prog, segment)
+    plan.filter_prog = _fold_leaves(prog, segment)
     if plan.filter_prog.tree == ("const", False):
         plan.kind = "empty"
         return plan
@@ -77,7 +77,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         return plan
 
     # -- metadata-only answers --------------------------------------------
-    if (not group_exprs and ctx.filter is None and aggs
+    if (not group_exprs and plan.filter_prog.is_match_all and aggs
             and all(_metadata_answerable(a, segment) for a in aggs)):
         plan.kind = "metadata"
         return plan
@@ -92,10 +92,12 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     return plan
 
 
-def _fold_luts(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgram:
-    """Fold all-false/all-true LUT leaves to constants — this is segment pruning for free:
-    an EQ literal absent from the dictionary (or outside min/max) folds the whole tree to
-    constant-false (reference: ColumnValueSegmentPruner + dictionary-miss shortcut)."""
+def _fold_leaves(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgram:
+    """Fold decidable leaves to constants — this is segment pruning for free: an EQ
+    literal absent from the dictionary, or a range disjoint from a raw column's
+    [min, max] metadata, folds the whole tree to constant-false (reference:
+    ColumnValueSegmentPruner + dictionary-miss shortcut; bloom filters serve the same
+    role for EQ in the cluster-level pruner, see cluster/routing)."""
     from .predicate import _simplify  # shared with filter compilation
 
     def fold(node):
@@ -111,6 +113,10 @@ def _fold_luts(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgram:
                 has_nulls = segment.column(leaf.col).meta.get("hasNulls", False)
                 if not has_nulls:
                     return ("const", leaf.negated)
+            if isinstance(leaf, CmpLeaf) and isinstance(leaf.expr, Identifier):
+                folded = _fold_cmp_minmax(leaf, segment)
+                if folded is not None:
+                    return ("const", folded)
             return node
         if node[0] in ("and", "or"):
             return (node[0], tuple(fold(c) for c in node[1]))
@@ -120,6 +126,45 @@ def _fold_luts(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgram:
 
     prog.tree = _simplify(fold(prog.tree))
     return prog
+
+
+def _fold_cmp_minmax(leaf: CmpLeaf, segment: ImmutableSegment):
+    """Decide a raw-column comparison from metadata min/max when possible.
+
+    Returns True (matches everything), False (matches nothing), or None (must scan).
+    """
+    reader = segment.column(leaf.expr.name)
+    mn, mx = reader.min_value, reader.max_value
+    if mn is None or mx is None or not leaf.operands:
+        return None
+    ops = leaf.operands
+    if leaf.op == "eq":
+        return False if (ops[0] < mn or ops[0] > mx) else None
+    if leaf.op == "in":
+        return False if all(v < mn or v > mx for v in ops) else None
+    if leaf.op in ("gte", "gt"):
+        if ops[0] <= mn and leaf.op == "gte":
+            return True
+        if ops[0] < mn:
+            return True
+        if ops[0] > mx or (ops[0] == mx and leaf.op == "gt"):
+            return False
+        return None
+    if leaf.op in ("lte", "lt"):
+        if ops[0] >= mx and leaf.op == "lte":
+            return True
+        if ops[0] > mx:
+            return True
+        if ops[0] < mn or (ops[0] == mn and leaf.op == "lt"):
+            return False
+        return None
+    if leaf.op == "between":
+        lo, hi = ops
+        if lo <= mn and hi >= mx:
+            return True
+        if hi < mn or lo > mx:
+            return False
+    return None
 
 
 def _metadata_answerable(agg: AggFunc, segment: ImmutableSegment) -> bool:

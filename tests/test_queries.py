@@ -265,3 +265,21 @@ def test_offset_pagination(qenv):
     page = execute_query(segments, "SELECT lo_brand, COUNT(*) FROM lineorder "
                                    "GROUP BY lo_brand ORDER BY lo_brand LIMIT 10 OFFSET 5")
     assert page.rows == full.rows[5:15]
+
+
+# -- pruning ----------------------------------------------------------------
+
+def test_minmax_pruning_raw_column(qenv):
+    """Range disjoint from metadata min/max folds to an empty plan — no scan."""
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    segments, db = qenv
+    ctx = compile_query("SELECT COUNT(*) FROM lineorder WHERE lo_extendedprice > 1e9",
+                        segments[0].schema)
+    assert plan_segment(ctx, segments[0]).kind == "empty"
+    run_both(qenv, "SELECT COUNT(*) FROM lineorder WHERE lo_extendedprice > 1e9")
+    # match-all range folds to const-true: becomes a metadata-only count
+    ctx2 = compile_query("SELECT COUNT(*) FROM lineorder WHERE lo_extendedprice >= 0",
+                         segments[0].schema)
+    assert plan_segment(ctx2, segments[0]).kind == "metadata"
+    run_both(qenv, "SELECT COUNT(*) FROM lineorder WHERE lo_extendedprice >= 0")
