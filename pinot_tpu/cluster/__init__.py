@@ -1,0 +1,17 @@
+"""Cluster control plane: catalog, controller, broker, server roles.
+
+TPU-native replacement for the reference's Helix/ZooKeeper control plane (SURVEY.md §1
+"Control plane backbone"): a single lightweight catalog holds what the reference keeps in
+ZK — table configs, schemas, segment metadata, IdealState (desired) and ExternalView
+(actual) — with watch callbacks in place of Helix state transitions. Roles are plain
+Python objects that run in-process (the single-process cluster test enclosure, reference:
+`ClusterTest.java:88`) or behind the stdlib-HTTP data plane in `transport.py`.
+"""
+
+from .catalog import Catalog, SegmentMeta
+from .controller import Controller
+from .broker import Broker
+from .server import ServerNode
+from .enclosure import QuickCluster
+
+__all__ = ["Catalog", "SegmentMeta", "Controller", "Broker", "ServerNode", "QuickCluster"]

@@ -1,0 +1,111 @@
+"""Broker role: SQL entry, routing, scatter/gather, reduce.
+
+Analog of the reference's broker request path (SURVEY.md §3.1 top half):
+`BaseBrokerRequestHandler.handleRequest` compile + routing split, `QueryRouter`
+scatter, `BrokerReduceService` reduce. The scatter here calls server objects directly
+(in-proc) or via the HTTP transport's server proxies; per-server calls run on a thread
+pool like the reference's async Netty channels, and failed servers are reported as
+partial results + marked unhealthy (reference: `ConnectionFailureDetector` ->
+`excludeServerFromRouting`, `SingleConnectionBrokerRequestHandler.java:169-175`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from ..query.aggregates import make_agg
+from ..query.context import QueryContext, QueryValidationError, compile_query
+from ..query.reduce import SegmentResult, merge_segment_results, reduce_to_result
+from ..query.result import ResultTable
+from ..table import TableType
+from .catalog import Catalog, InstanceInfo
+from .routing import RoutingManager
+
+# server handle: execute_partial(table, ctx, segment_names) -> SegmentResult
+ServerHandle = Callable[[str, QueryContext, Sequence[str]], SegmentResult]
+
+
+class Broker:
+    def __init__(self, instance_id: str, catalog: Catalog,
+                 max_scatter_threads: int = 8):
+        self.instance_id = instance_id
+        self.catalog = catalog
+        self.routing = RoutingManager(catalog)
+        self._servers: Dict[str, ServerHandle] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads,
+                                        thread_name_prefix=f"{instance_id}-scatter")
+        self._lock = threading.RLock()
+        catalog.register_instance(InstanceInfo(instance_id, "broker"))
+
+    def register_server_handle(self, server_id: str, handle: ServerHandle) -> None:
+        """Wire a server's execute entry (direct object in-proc, HTTP proxy remote)."""
+        with self._lock:
+            self._servers[server_id] = handle
+        self.routing.mark_server_healthy(server_id)
+
+    # ------------------------------------------------------------------
+    def handle_query(self, sql: str) -> ResultTable:
+        """Full broker path: compile -> resolve physical tables -> scatter -> reduce."""
+        t0 = time.perf_counter()
+        stmt_ctx = compile_query(sql)  # schema resolved below per physical table
+        raw_table = stmt_ctx.table
+
+        physical = self._physical_tables(raw_table)
+        if not physical:
+            raise QueryValidationError(f"unknown table {raw_table!r}")
+        schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
+        ctx = compile_query(sql, schema)
+
+        aggs = [make_agg(f) for f in ctx.aggregations]
+        group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                       else list(ctx.group_by))
+
+        partials: List[SegmentResult] = []
+        servers_queried = servers_failed = 0
+        for table in physical:
+            routing = self.routing.route_query(table, ctx)
+            futures = {}
+            for server_id, segments in routing.items():
+                handle = self._servers.get(server_id)
+                if handle is None:
+                    continue
+                futures[self._pool.submit(handle, table, ctx, segments)] = server_id
+            for fut in as_completed(futures):
+                server_id = futures[fut]
+                servers_queried += 1
+                try:
+                    partials.append(fut.result())
+                except Exception:
+                    # partial results are surfaced, not fatal (reference:
+                    # serversNotResponded -> exception in response metadata)
+                    servers_failed += 1
+                    self.routing.mark_server_unhealthy(server_id)
+
+        merged = merge_segment_results(partials, aggs)
+        if not partials:
+            merged.kind = ("groups" if group_exprs else
+                           "scalar" if aggs else "selection")
+        result = reduce_to_result(ctx, merged, aggs, group_exprs)
+        result.stats.update({
+            "timeUsedMs": round((time.perf_counter() - t0) * 1000, 3),
+            "numServersQueried": servers_queried,
+            "numServersResponded": servers_queried - servers_failed,
+            "partialResult": servers_failed > 0,
+        })
+        return result
+
+    def _physical_tables(self, raw_table: str) -> List[str]:
+        """Resolve a logical name to physical tables; hybrid tables hit both OFFLINE
+        and REALTIME halves (reference: time-boundary split — simplified: realtime
+        segments carry only post-boundary data by construction here)."""
+        out = []
+        for t in (f"{raw_table}_{TableType.OFFLINE.value}",
+                  f"{raw_table}_{TableType.REALTIME.value}"):
+            if t in self.catalog.table_configs:
+                out.append(t)
+        if raw_table in self.catalog.table_configs:
+            out.append(raw_table)
+        return out

@@ -1,0 +1,200 @@
+"""Controller role: table CRUD, segment upload, assignment, retention, rebalance.
+
+Analog of the reference's controller (SURVEY.md §2.7): `PinotHelixResourceManager`
+(cluster mutations), `ZKOperator.completeSegmentOperations`
+(`pinot-controller/.../api/upload/ZKOperator.java:50,64` — validate, copy to deep store,
+write metadata, update ideal state), `RetentionManager` (expiry deletion),
+`SegmentDeletionManager`, and `TableRebalancer`'s converge loop. Periodic tasks run on a
+`PeriodicTaskScheduler` analog (`pinot_tpu/utils/periodic.py`).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..schema import Schema
+from ..segment.format import read_json, SEGMENT_METADATA_FILE
+from ..segment.reader import load_segment
+from ..table import TableConfig, TableType
+from .assignment import balanced_assign, compute_counts, rebalance_table, replica_group_assign
+from .catalog import (Catalog, InstanceInfo, ONLINE, SegmentMeta, STATUS_UPLOADED)
+from .deepstore import DeepStoreFS, tar_segment
+from .routing import partition_for_value
+
+
+class Controller:
+    def __init__(self, instance_id: str, catalog: Catalog, deepstore: DeepStoreFS,
+                 work_dir: str):
+        self.instance_id = instance_id
+        self.catalog = catalog
+        self.deepstore = deepstore
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        catalog.register_instance(InstanceInfo(instance_id, "controller"))
+
+    # -- table CRUD (reference: PinotTableRestletResource + resource manager) ----
+    def add_schema(self, schema: Schema) -> None:
+        self.catalog.put_schema(schema)
+
+    def add_table(self, config: TableConfig) -> None:
+        if config.name not in self.catalog.schemas:
+            raise ValueError(f"schema {config.name!r} must be added before the table")
+        self.catalog.put_table_config(config)
+
+    def drop_table(self, table: str) -> None:
+        for seg in list(self.catalog.segments.get(table, {})):
+            self.delete_segment(table, seg)
+        self.catalog.drop_table(table)
+
+    # -- segment upload (reference: ZKOperator.completeSegmentOperations) --------
+    def upload_segment(self, table: str, segment_dir: str) -> SegmentMeta:
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None:
+            raise ValueError(f"unknown table {table!r}")
+        seg_meta_json = read_json(os.path.join(segment_dir, SEGMENT_METADATA_FILE))
+        seg_name = seg_meta_json["segmentName"]
+
+        # validate schema compatibility
+        schema = self.catalog.schemas.get(cfg.name)
+        seg_schema = Schema.from_json(seg_meta_json["schema"])
+        for f in schema.fields:
+            if not seg_schema.has_column(f.name):
+                raise ValueError(f"segment {seg_name} missing column {f.name}")
+
+        # copy to deep store
+        tar_path = os.path.join(self.work_dir, f"{seg_name}.tar.gz")
+        tar_segment(segment_dir, tar_path)
+        uri = f"{table}/{seg_name}.tar.gz"
+        self.deepstore.upload(tar_path, uri)
+        size = os.path.getsize(tar_path)
+        os.remove(tar_path)
+
+        meta = SegmentMeta(
+            name=seg_name, table=table, status=STATUS_UPLOADED,
+            num_docs=seg_meta_json["totalDocs"],
+            crc=read_json(os.path.join(segment_dir, "creation.meta.json"))["crc"],
+            size_bytes=size, download_path=uri,
+            push_time_ms=int(time.time() * 1000),
+            partition_id=self._partition_id(cfg, segment_dir, seg_meta_json),
+        )
+        self._fill_time_range(cfg, seg_meta_json, meta)
+        self.catalog.put_segment_meta(meta)
+        self._assign_segment(table, cfg, meta)
+        return meta
+
+    def _partition_id(self, cfg: TableConfig, segment_dir: str, seg_meta) -> Optional[int]:
+        if not cfg.partition:
+            return None
+        col = seg_meta["columns"].get(cfg.partition.column)
+        if col is None:
+            return None
+        # all rows of a properly partitioned segment map to one partition; derive it
+        # from the column min value (builder-side partition check comes with ingest)
+        v = col.get("minValue")
+        if v is None:
+            return None
+        return partition_for_value(v, cfg.partition.function, cfg.partition.num_partitions)
+
+    def _fill_time_range(self, cfg: TableConfig, seg_meta, meta: SegmentMeta) -> None:
+        if not cfg.time_column:
+            return
+        col = seg_meta["columns"].get(cfg.time_column)
+        if col and col.get("minValue") is not None:
+            meta.start_time_ms = int(col["minValue"])
+            meta.end_time_ms = int(col["maxValue"])
+
+    def _assign_segment(self, table: str, cfg: TableConfig, meta: SegmentMeta) -> None:
+        servers = self.catalog.live_servers(cfg.tenant)
+        ist = self.catalog.ideal_state.get(table, {})
+        counts = compute_counts(ist)
+        if cfg.partition and meta.partition_id is not None:
+            chosen = replica_group_assign(meta.name, servers, cfg.replication,
+                                          meta.partition_id, counts)
+        else:
+            chosen = balanced_assign(meta.name, servers, cfg.replication, counts)
+        self.catalog.update_ideal_state(table, {meta.name: {s: ONLINE for s in chosen}})
+
+    # -- deletion / retention ---------------------------------------------------
+    def delete_segment(self, table: str, segment: str) -> None:
+        """Reference: SegmentDeletionManager — remove from ideal state, metadata, and
+        deep store (deleted segments park under Deleted_Segments in the reference;
+        simplified to direct delete + catalog property note)."""
+        meta = self.catalog.segments.get(table, {}).get(segment)
+        self.catalog.update_ideal_state(table, {segment: None})
+        self.catalog.drop_segment_meta(table, segment)
+        if meta and meta.download_path:
+            self.deepstore.delete(meta.download_path)
+
+    def run_retention(self, now_ms: Optional[int] = None) -> List[str]:
+        """Reference: RetentionManager periodic task — delete segments past retention."""
+        now_ms = now_ms or int(time.time() * 1000)
+        deleted = []
+        for table, cfg in list(self.catalog.table_configs.items()):
+            if not cfg.retention_days or not cfg.time_column:
+                continue
+            cutoff = now_ms - cfg.retention_days * 24 * 3600 * 1000
+            for seg, meta in list(self.catalog.segments.get(table, {}).items()):
+                if meta.end_time_ms is not None and meta.end_time_ms < cutoff:
+                    self.delete_segment(table, seg)
+                    deleted.append(f"{table}/{seg}")
+        return deleted
+
+    # -- rebalance (reference: TableRebalancer.java:114,277) ---------------------
+    def rebalance(self, table: str, min_available_replicas: int = 1) -> Dict[str, Dict[str, str]]:
+        """Compute a balanced target and converge incrementally, never dropping a
+        segment below `min_available_replicas` currently-online copies."""
+        cfg = self.catalog.table_configs[table]
+        servers = self.catalog.live_servers(cfg.tenant)
+        current = {s: dict(a) for s, a in self.catalog.ideal_state.get(table, {}).items()}
+        target = rebalance_table(current, servers, cfg.replication)
+
+        max_rounds = len(target) * (cfg.replication + 1) + 4
+        for _ in range(max_rounds):
+            if current == target:
+                break
+            updates = {}
+            for seg, want in target.items():
+                have = current.get(seg, {})
+                if have == want:
+                    continue
+                ev = self.catalog.external_view.get(table, {}).get(seg, {})
+                online_now = [s for s, st in ev.items() if st == ONLINE]
+                step = dict(have)
+                added = False
+                for s in want:
+                    if s not in step:
+                        step[s] = ONLINE  # add first ...
+                        added = True
+                        break
+                if not added:
+                    removable = [s for s in step if s not in want]
+                    for s in removable:
+                        # ... drop only when enough target replicas are live
+                        live_targets = [t for t in online_now if t in want]
+                        if len(live_targets) >= min_available_replicas:
+                            step.pop(s)
+                            break
+                if step != have:
+                    updates[seg] = step
+                    current[seg] = step
+            if updates:
+                self.catalog.update_ideal_state(table, updates)
+            else:
+                break
+        return current
+
+    # -- status (reference: SegmentStatusChecker) --------------------------------
+    def table_status(self, table: str) -> Dict[str, object]:
+        ist = self.catalog.ideal_state.get(table, {})
+        ev = self.catalog.external_view.get(table, {})
+        converged = all(ev.get(seg, {}) == assignment for seg, assignment in ist.items())
+        return {
+            "segments": len(ist),
+            "converged": converged,
+            "replicas_online": {seg: sum(1 for st in ev.get(seg, {}).values()
+                                         if st == ONLINE) for seg in ist},
+        }

@@ -1,0 +1,95 @@
+"""Deep store: durable segment storage behind a filesystem SPI.
+
+Analog of the reference's PinotFS (`pinot-spi/.../filesystem/PinotFS.java`) + segment
+fetchers (`pinot-common/.../utils/fetcher/SegmentFetcherFactory.java`). Segments are
+tarred directories; any server can fetch any segment — this is the durability story
+(SURVEY.md §5 "Checkpoint / resume": segments are the durable artifact).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+from typing import Dict, List, Type
+
+
+class DeepStoreFS:
+    """Filesystem SPI: copy/open/delete by URI."""
+
+    scheme = ""
+
+    def upload(self, local_path: str, uri: str) -> None:
+        raise NotImplementedError
+
+    def download(self, uri: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, uri: str) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalDeepStore(DeepStoreFS):
+    """Reference: LocalPinotFS. URIs are `file://`-less plain paths under a root."""
+
+    scheme = "local"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, uri: str) -> str:
+        return os.path.join(self.root, uri.lstrip("/"))
+
+    def upload(self, local_path: str, uri: str) -> None:
+        dest = self._path(uri)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copyfile(local_path, dest)
+
+    def download(self, uri: str, local_path: str) -> None:
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        shutil.copyfile(self._path(uri), local_path)
+
+    def delete(self, uri: str) -> None:
+        p = self._path(uri)
+        if os.path.isfile(p):
+            os.remove(p)
+        elif os.path.isdir(p):
+            shutil.rmtree(p)
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(self._path(uri))
+
+    def listdir(self, uri: str) -> List[str]:
+        p = self._path(uri)
+        return sorted(os.listdir(p)) if os.path.isdir(p) else []
+
+
+_FS_REGISTRY: Dict[str, Type[DeepStoreFS]] = {"local": LocalDeepStore}
+
+
+def register_fs(scheme: str, cls: Type[DeepStoreFS]) -> None:
+    """Plugin hook (reference: PinotFSFactory.register)."""
+    _FS_REGISTRY[scheme] = cls
+
+
+def tar_segment(segment_dir: str, out_path: str) -> str:
+    """Pack a segment directory (reference: TarGzCompressionUtils)."""
+    with tarfile.open(out_path, "w:gz") as tar:
+        tar.add(segment_dir, arcname=os.path.basename(segment_dir))
+    return out_path
+
+
+def untar_segment(tar_path: str, dest_dir: str) -> str:
+    """Unpack; returns the segment directory path."""
+    with tarfile.open(tar_path, "r:gz") as tar:
+        names = tar.getnames()
+        root = names[0].split("/")[0]
+        tar.extractall(dest_dir, filter="data")
+    return os.path.join(dest_dir, root)

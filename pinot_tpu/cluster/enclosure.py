@@ -1,0 +1,84 @@
+"""In-process cluster enclosure for tests and quickstarts.
+
+Analog of the reference's single-JVM cluster harness (`ClusterTest extends
+ControllerTest`, `pinot-integration-test-base/.../ClusterTest.java:88`: embedded ZK +
+controller + brokers + servers in one process) and of the quickstart launcher
+(`pinot-tools/.../Quickstart.java`): one object wires a catalog, a controller, N
+servers and a broker, with helpers to create tables and ingest column batches.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..query.result import ResultTable
+from ..schema import Schema
+from ..segment.writer import SegmentBuilder, SegmentGeneratorConfig
+from ..table import IndexingConfig, TableConfig, TableType
+from .broker import Broker
+from .catalog import Catalog
+from .controller import Controller
+from .deepstore import LocalDeepStore
+from .server import ServerNode
+
+
+class QuickCluster:
+    def __init__(self, num_servers: int = 2, work_dir: Optional[str] = None):
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="pinot_tpu_cluster_")
+        self.catalog = Catalog()
+        self.deepstore = LocalDeepStore(os.path.join(self.work_dir, "deepstore"))
+        self.controller = Controller("controller_0", self.catalog, self.deepstore,
+                                     os.path.join(self.work_dir, "controller"))
+        self.servers: List[ServerNode] = [
+            ServerNode(f"server_{i}", self.catalog, self.deepstore,
+                       os.path.join(self.work_dir, f"server_{i}"))
+            for i in range(num_servers)
+        ]
+        self.broker = Broker("broker_0", self.catalog)
+        for s in self.servers:
+            self.broker.register_server_handle(s.instance_id, s.execute_partial)
+        self._seg_seq: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def create_table(self, schema: Schema, config: Optional[TableConfig] = None
+                     ) -> TableConfig:
+        config = config or TableConfig(schema.name)
+        self.controller.add_schema(schema)
+        self.controller.add_table(config)
+        return config
+
+    def ingest_columns(self, table_config: TableConfig, columns: Dict[str, object],
+                       segment_name: Optional[str] = None) -> str:
+        """Build one segment from columns and push it (batch ingestion shortcut)."""
+        table = table_config.table_name_with_type
+        schema = self.catalog.schemas[table_config.name]
+        seq = self._seg_seq.get(table, 0)
+        self._seg_seq[table] = seq + 1
+        name = segment_name or f"{table_config.name}_{seq}"
+        idx = table_config.indexing
+        builder = SegmentBuilder(schema, SegmentGeneratorConfig(
+            no_dictionary_columns=list(idx.no_dictionary_columns),
+            inverted_index_columns=list(idx.inverted_index_columns),
+            range_index_columns=list(idx.range_index_columns),
+            bloom_filter_columns=list(idx.bloom_filter_columns),
+        ))
+        build_dir = os.path.join(self.work_dir, "build")
+        seg_dir = builder.build(columns, build_dir, name)
+        self.controller.upload_segment(table, seg_dir)
+        return name
+
+    def query(self, sql: str) -> ResultTable:
+        return self.broker.handle_query(sql)
+
+    # -- chaos helpers (reference: ChaosMonkeyIntegrationTest) --------------
+    def kill_server(self, instance_id: str) -> None:
+        self.catalog.set_instance_alive(instance_id, False)
+        self.broker.routing.mark_server_unhealthy(instance_id)
+
+    def revive_server(self, instance_id: str) -> None:
+        self.catalog.set_instance_alive(instance_id, True)
+        self.broker.routing.mark_server_healthy(instance_id)

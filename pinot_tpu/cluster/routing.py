@@ -1,0 +1,166 @@
+"""Broker routing: external-view-driven routing tables, instance selection, pruning.
+
+Analog of the reference's `BrokerRoutingManager`
+(`pinot-broker/.../routing/BrokerRoutingManager.java:88,122`), instance selectors
+(`routing/instanceselector/`), and segment pruners (`routing/segmentpruner/`): watch the
+external view, keep segment -> online replica servers, select one replica per segment
+per query (round-robin for balance), and prune segments by partition/time metadata
+before scatter.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..query.context import QueryContext
+from ..sql.ast import Expr, Function, Identifier, Literal
+from .catalog import CONSUMING, ONLINE, Catalog, SegmentMeta
+
+
+def partition_for_value(value, function: str, num_partitions: int) -> int:
+    """Partition functions (reference: pinot-segment-spi partition functions)."""
+    if function == "modulo":
+        return int(value) % num_partitions
+    # murmur stand-in: crc32 over the string form — stable across processes
+    return zlib.crc32(str(value).encode("utf-8")) % num_partitions
+
+
+class RoutingTable:
+    """segment -> candidate servers, resolved per query to server -> [segments]."""
+
+    def __init__(self, table: str):
+        self.table = table
+        self.segment_servers: Dict[str, List[str]] = {}
+        self._rr = itertools.count()
+
+    def route(self, segments: Optional[Set[str]] = None,
+              exclude: Optional[Set[str]] = None) -> Dict[str, List[str]]:
+        """Pick one healthy replica per segment, round-robin for load balance
+        (reference: BalancedInstanceSelector)."""
+        out: Dict[str, List[str]] = {}
+        offset = next(self._rr)
+        for i, (seg, servers) in enumerate(sorted(self.segment_servers.items())):
+            if segments is not None and seg not in segments:
+                continue
+            candidates = [s for s in servers if not exclude or s not in exclude]
+            if not candidates:
+                continue
+            chosen = candidates[(offset + i) % len(candidates)]
+            out.setdefault(chosen, []).append(seg)
+        return out
+
+
+class RoutingManager:
+    """Watches the catalog and maintains routing tables per table."""
+
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self._tables: Dict[str, RoutingTable] = {}
+        self._unhealthy: Set[str] = set()
+        self._lock = threading.RLock()
+        catalog.subscribe(self._on_event)
+        for table in list(catalog.external_view):
+            self._rebuild(table)
+
+    def _on_event(self, event: str, table: str) -> None:
+        if event in ("external_view", "table", "instance"):
+            if event == "instance":
+                with self._lock:
+                    tables = list(self._tables)
+                for t in tables:
+                    self._rebuild(t)
+            else:
+                self._rebuild(table)
+
+    def _rebuild(self, table: str) -> None:
+        ev = self.catalog.external_view.get(table)
+        if ev is None:
+            with self._lock:
+                self._tables.pop(table, None)
+            return
+        rt = RoutingTable(table)
+        alive = set(self.catalog.live_servers())
+        for seg, states in ev.items():
+            servers = [srv for srv, st in states.items()
+                       if st in (ONLINE, CONSUMING) and srv in alive]
+            if servers:
+                rt.segment_servers[seg] = sorted(servers)
+        with self._lock:
+            self._tables[table] = rt
+
+    # -- health (reference: broker failure detector wiring) -----------------
+    def mark_server_unhealthy(self, server: str) -> None:
+        with self._lock:
+            self._unhealthy.add(server)
+
+    def mark_server_healthy(self, server: str) -> None:
+        with self._lock:
+            self._unhealthy.discard(server)
+
+    # -- query routing -----------------------------------------------------
+    def route_query(self, table: str, ctx: Optional[QueryContext] = None
+                    ) -> Dict[str, List[str]]:
+        with self._lock:
+            rt = self._tables.get(table)
+            unhealthy = set(self._unhealthy)
+        if rt is None:
+            return {}
+        keep = None
+        if ctx is not None:
+            keep = self._prune(table, set(rt.segment_servers), ctx)
+        return rt.route(keep, exclude=unhealthy)
+
+    def _prune(self, table: str, segments: Set[str], ctx: QueryContext) -> Set[str]:
+        """Partition + time pruning from SegmentMeta (reference:
+        MultiPartitionColumnsSegmentPruner + TimeSegmentPruner)."""
+        cfg = self.catalog.table_configs.get(table)
+        metas = self.catalog.segments.get(table, {})
+        if cfg is None or ctx.filter is None:
+            return segments
+        keep = set()
+        for seg in segments:
+            meta = metas.get(seg)
+            if meta is None:
+                keep.add(seg)
+                continue
+            if not _segment_may_match(ctx.filter, cfg, meta):
+                continue
+            keep.add(seg)
+        return keep
+
+
+def _segment_may_match(filt: Expr, cfg, meta: SegmentMeta) -> bool:
+    """Conservative filter check against segment partition/time metadata."""
+    if isinstance(filt, Function):
+        if filt.name == "and":
+            return all(_segment_may_match(a, cfg, meta) for a in filt.args)
+        if filt.name == "or":
+            return any(_segment_may_match(a, cfg, meta) for a in filt.args)
+        # partition pruning: eq on the partition column
+        if (filt.name == "eq" and cfg.partition and meta.partition_id is not None
+                and isinstance(filt.args[0], Identifier)
+                and filt.args[0].name == cfg.partition.column
+                and isinstance(filt.args[1], Literal)):
+            pid = partition_for_value(filt.args[1].value, cfg.partition.function,
+                                      cfg.partition.num_partitions)
+            return pid == meta.partition_id
+        # time pruning: range on the time column vs [start_time, end_time]
+        if (cfg.time_column and meta.start_time_ms is not None
+                and meta.end_time_ms is not None
+                and isinstance(filt.args[0], Identifier)
+                and filt.args[0].name == cfg.time_column
+                and all(isinstance(a, Literal) for a in filt.args[1:])):
+            vals = [a.value for a in filt.args[1:]]
+            lo, hi = meta.start_time_ms, meta.end_time_ms
+            if filt.name == "between":
+                return not (vals[1] < lo or vals[0] > hi)
+            if filt.name == "eq":
+                return lo <= vals[0] <= hi
+            if filt.name in ("gt", "gte"):
+                return vals[0] <= hi
+            if filt.name in ("lt", "lte"):
+                return vals[0] >= lo
+    return True

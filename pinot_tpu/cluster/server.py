@@ -1,0 +1,168 @@
+"""Server role: segment lifecycle management + query execution.
+
+Analog of the reference's server stack (SURVEY.md §2.6): `BaseServerStarter` boot,
+`HelixInstanceDataManager` (add/replace/drop segments on state transitions,
+`server/starter/helix/HelixInstanceDataManager.java:78,164`), per-table data managers
+with refcounted acquire/release (`BaseTableDataManager`), and the query executor half of
+`ServerQueryExecutorV1Impl`. State transitions arrive as catalog ideal-state watch events
+instead of Helix messages; the server reconciles desired vs loaded and reports the
+external view.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Union
+
+from ..query.aggregates import make_agg
+from ..query.context import QueryContext, compile_query
+from ..query.executor import ServerQueryExecutor
+from ..query.reduce import SegmentResult, merge_segment_results
+from ..segment.reader import ImmutableSegment, load_segment
+from .catalog import CONSUMING, DROPPED, OFFLINE, ONLINE, Catalog, InstanceInfo
+from .deepstore import DeepStoreFS, untar_segment
+
+
+class TableDataManager:
+    """Per-table loaded segments with refcounting (reference: BaseTableDataManager)."""
+
+    def __init__(self, table: str, data_dir: str):
+        self.table = table
+        self.data_dir = data_dir
+        self._segments: Dict[str, ImmutableSegment] = {}
+        self._refcounts: Dict[str, int] = {}
+        self._lock = threading.RLock()
+
+    def add_segment(self, name: str, segment: ImmutableSegment) -> None:
+        with self._lock:
+            self._segments[name] = segment
+            self._refcounts.setdefault(name, 0)
+
+    def remove_segment(self, name: str) -> None:
+        with self._lock:
+            self._segments.pop(name, None)
+            self._refcounts.pop(name, None)
+
+    def acquire(self, names: Optional[Sequence[str]] = None) -> List[ImmutableSegment]:
+        with self._lock:
+            targets = list(self._segments) if names is None else \
+                [n for n in names if n in self._segments]
+            for n in targets:
+                self._refcounts[n] = self._refcounts.get(n, 0) + 1
+            return [self._segments[n] for n in targets]
+
+    def release(self, segments: Sequence[ImmutableSegment]) -> None:
+        with self._lock:
+            for seg in segments:
+                if seg.name in self._refcounts:
+                    self._refcounts[seg.name] -= 1
+
+    @property
+    def segment_names(self) -> List[str]:
+        with self._lock:
+            return list(self._segments)
+
+
+class ServerNode:
+    """One server instance (reference: HelixServerStarter + ServerInstance)."""
+
+    def __init__(self, instance_id: str, catalog: Catalog, deepstore: DeepStoreFS,
+                 data_dir: str, tags: Optional[List[str]] = None):
+        self.instance_id = instance_id
+        self.catalog = catalog
+        self.deepstore = deepstore
+        self.data_dir = data_dir
+        self.executor = ServerQueryExecutor()
+        self.tables: Dict[str, TableDataManager] = {}
+        self._lock = threading.RLock()
+        self._realtime_managers: Dict[str, object] = {}  # wired by ingest.realtime
+        os.makedirs(data_dir, exist_ok=True)
+        catalog.register_instance(InstanceInfo(instance_id, "server", tags=tags
+                                               or ["DefaultTenant"]))
+        catalog.subscribe(self._on_catalog_event)
+        # catch up with pre-existing ideal state (reference: startup reconciliation)
+        for table in list(catalog.ideal_state):
+            self.reconcile(table)
+
+    # -- state transitions -------------------------------------------------
+    def _on_catalog_event(self, event: str, table: str) -> None:
+        if event == "ideal_state":
+            self.reconcile(table)
+
+    def reconcile(self, table: str) -> None:
+        """Converge loaded segments to the ideal state (reference: Helix transitions
+        OFFLINE->ONLINE / ONLINE->OFFLINE / ->DROPPED in
+        SegmentOnlineOfflineStateModelFactory)."""
+        ist = self.catalog.ideal_state.get(table, {})
+        mgr = self._table_manager(table)
+        desired = {seg: assignment[self.instance_id]
+                   for seg, assignment in ist.items() if self.instance_id in assignment}
+
+        for seg_name, state in desired.items():
+            if state == ONLINE and seg_name not in mgr.segment_names:
+                try:
+                    self._load_online_segment(table, seg_name, mgr)
+                    self.catalog.report_state(table, seg_name, self.instance_id, ONLINE)
+                except Exception:
+                    self.catalog.report_state(table, seg_name, self.instance_id, "ERROR")
+                    raise
+            elif state == CONSUMING and seg_name not in mgr.segment_names:
+                handler = self._realtime_managers.get(table)
+                if handler is not None:
+                    handler.start_consuming(seg_name)  # ingest.realtime wires this
+                self.catalog.report_state(table, seg_name, self.instance_id, CONSUMING)
+
+        for seg_name in list(mgr.segment_names):
+            if seg_name not in desired:
+                mgr.remove_segment(seg_name)
+                self.catalog.report_state(table, seg_name, self.instance_id, None)
+
+    def _load_online_segment(self, table: str, seg_name: str, mgr: TableDataManager) -> None:
+        meta = self.catalog.segments.get(table, {}).get(seg_name)
+        local_dir = os.path.join(self.data_dir, table, seg_name)
+        if not os.path.isdir(local_dir):
+            if meta is None or not meta.download_path:
+                raise FileNotFoundError(f"no deep-store path for {table}/{seg_name}")
+            tar_local = local_dir + ".tar.gz"
+            self.deepstore.download(meta.download_path, tar_local)
+            untar_segment(tar_local, os.path.dirname(local_dir))
+            os.remove(tar_local)
+        mgr.add_segment(seg_name, load_segment(local_dir))
+
+    def add_local_segment(self, table: str, segment: ImmutableSegment) -> None:
+        """Directly register an already-built local segment (used by realtime commit)."""
+        self._table_manager(table).add_segment(segment.name, segment)
+
+    def _table_manager(self, table: str) -> TableDataManager:
+        with self._lock:
+            if table not in self.tables:
+                self.tables[table] = TableDataManager(
+                    table, os.path.join(self.data_dir, table))
+            return self.tables[table]
+
+    # -- query execution ---------------------------------------------------
+    def execute_partial(self, table: str, ctx: Union[str, QueryContext],
+                        segment_names: Optional[Sequence[str]] = None) -> SegmentResult:
+        """Run the query over this server's copy of `segment_names`, return the merged
+        server-level partial (reference: ServerQueryExecutorV1Impl.processQuery returning
+        a DataTable)."""
+        schema = self.catalog.schema_for_table(table)
+        if isinstance(ctx, str):
+            ctx = compile_query(ctx, schema)
+        mgr = self._table_manager(table)
+        segments = mgr.acquire(segment_names)
+        try:
+            results = [self.executor.execute_segment(ctx, seg) for seg in segments]
+            # include in-progress realtime docs when a consuming manager exists
+            handler = self._realtime_managers.get(table)
+            if handler is not None:
+                extra = handler.consuming_results(ctx, segment_names)
+                results.extend(extra)
+        finally:
+            mgr.release(segments)
+        aggs = [make_agg(f) for f in ctx.aggregations]
+        return merge_segment_results(results, aggs)
+
+    def segments_served(self, table: str) -> List[str]:
+        return self._table_manager(table).segment_names

@@ -1,0 +1,168 @@
+"""Table configuration model.
+
+Analog of the reference's `TableConfig`
+(`pinot-spi/src/main/java/org/apache/pinot/spi/config/table/TableConfig.java:37`) plus the
+nested configs we support so far (IndexingConfig, SegmentsValidationAndRetentionConfig,
+StreamConfig subset, UpsertConfig/DedupConfig stubs wired in later milestones). JSON
+round-trips; stored in the catalog property store like the reference stores it in ZK.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+
+class TableType(Enum):
+    OFFLINE = "OFFLINE"
+    REALTIME = "REALTIME"
+
+
+@dataclass
+class IndexingConfig:
+    inverted_index_columns: List[str] = field(default_factory=list)
+    range_index_columns: List[str] = field(default_factory=list)
+    bloom_filter_columns: List[str] = field(default_factory=list)
+    no_dictionary_columns: List[str] = field(default_factory=list)
+    sorted_column: Optional[str] = None
+    star_tree_configs: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_json(self):
+        return {
+            "invertedIndexColumns": self.inverted_index_columns,
+            "rangeIndexColumns": self.range_index_columns,
+            "bloomFilterColumns": self.bloom_filter_columns,
+            "noDictionaryColumns": self.no_dictionary_columns,
+            "sortedColumn": self.sorted_column,
+            "starTreeIndexConfigs": self.star_tree_configs,
+        }
+
+    @staticmethod
+    def from_json(d):
+        return IndexingConfig(
+            inverted_index_columns=d.get("invertedIndexColumns", []),
+            range_index_columns=d.get("rangeIndexColumns", []),
+            bloom_filter_columns=d.get("bloomFilterColumns", []),
+            no_dictionary_columns=d.get("noDictionaryColumns", []),
+            sorted_column=d.get("sortedColumn"),
+            star_tree_configs=d.get("starTreeIndexConfigs", []),
+        )
+
+
+@dataclass
+class SegmentPartitionConfig:
+    """Reference: SegmentPartitionConfig — enables partition-aware routing pruning."""
+    column: str = ""
+    function: str = "murmur"  # murmur | modulo
+    num_partitions: int = 0
+
+    def to_json(self):
+        return {"column": self.column, "function": self.function,
+                "numPartitions": self.num_partitions}
+
+    @staticmethod
+    def from_json(d):
+        return SegmentPartitionConfig(d.get("column", ""), d.get("function", "murmur"),
+                                      d.get("numPartitions", 0))
+
+
+@dataclass
+class StreamConfig:
+    """Reference: stream configs map inside IndexingConfig (spi/stream/StreamConfig)."""
+    stream_type: str = "memory"           # plugin name (memory/file/kafka-protocol)
+    topic: str = ""
+    decoder: str = "json"
+    properties: Dict[str, Any] = field(default_factory=dict)
+    # segment completion thresholds (reference: realtime.segment.flush.*)
+    flush_threshold_rows: int = 100_000
+    flush_threshold_seconds: int = 6 * 3600
+
+    def to_json(self):
+        return {"streamType": self.stream_type, "topic": self.topic,
+                "decoder": self.decoder, "properties": self.properties,
+                "flushThresholdRows": self.flush_threshold_rows,
+                "flushThresholdSeconds": self.flush_threshold_seconds}
+
+    @staticmethod
+    def from_json(d):
+        return StreamConfig(d.get("streamType", "memory"), d.get("topic", ""),
+                            d.get("decoder", "json"), d.get("properties", {}),
+                            d.get("flushThresholdRows", 100_000),
+                            d.get("flushThresholdSeconds", 6 * 3600))
+
+
+@dataclass
+class UpsertConfig:
+    """Reference: spi/config/table/UpsertConfig (FULL or PARTIAL mode)."""
+    mode: str = "FULL"  # FULL | PARTIAL
+    comparison_column: Optional[str] = None
+    partial_strategies: Dict[str, str] = field(default_factory=dict)  # col -> strategy
+
+    def to_json(self):
+        return {"mode": self.mode, "comparisonColumn": self.comparison_column,
+                "partialUpsertStrategies": self.partial_strategies}
+
+    @staticmethod
+    def from_json(d):
+        return UpsertConfig(d.get("mode", "FULL"), d.get("comparisonColumn"),
+                            d.get("partialUpsertStrategies", {}))
+
+
+@dataclass
+class TableConfig:
+    name: str                       # raw table name (no type suffix)
+    table_type: TableType = TableType.OFFLINE
+    replication: int = 1
+    retention_days: Optional[float] = None
+    time_column: Optional[str] = None
+    indexing: IndexingConfig = field(default_factory=IndexingConfig)
+    partition: Optional[SegmentPartitionConfig] = None
+    stream: Optional[StreamConfig] = None
+    upsert: Optional[UpsertConfig] = None
+    dedup_enabled: bool = False
+    tenant: str = "DefaultTenant"
+
+    @property
+    def table_name_with_type(self) -> str:
+        return f"{self.name}_{self.table_type.value}"
+
+    def to_json(self) -> Dict[str, Any]:
+        d = {
+            "tableName": self.name,
+            "tableType": self.table_type.value,
+            "replication": self.replication,
+            "retentionDays": self.retention_days,
+            "timeColumn": self.time_column,
+            "indexing": self.indexing.to_json(),
+            "tenant": self.tenant,
+            "dedupEnabled": self.dedup_enabled,
+        }
+        if self.partition:
+            d["segmentPartitionConfig"] = self.partition.to_json()
+        if self.stream:
+            d["streamConfig"] = self.stream.to_json()
+        if self.upsert:
+            d["upsertConfig"] = self.upsert.to_json()
+        return d
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "TableConfig":
+        return TableConfig(
+            name=d["tableName"],
+            table_type=TableType(d.get("tableType", "OFFLINE")),
+            replication=d.get("replication", 1),
+            retention_days=d.get("retentionDays"),
+            time_column=d.get("timeColumn"),
+            indexing=IndexingConfig.from_json(d.get("indexing", {})),
+            partition=SegmentPartitionConfig.from_json(d["segmentPartitionConfig"])
+            if d.get("segmentPartitionConfig") else None,
+            stream=StreamConfig.from_json(d["streamConfig"]) if d.get("streamConfig") else None,
+            upsert=UpsertConfig.from_json(d["upsertConfig"]) if d.get("upsertConfig") else None,
+            dedup_enabled=d.get("dedupEnabled", False),
+            tenant=d.get("tenant", "DefaultTenant"),
+        )
+
+    def to_json_str(self) -> str:
+        return json.dumps(self.to_json(), indent=2)

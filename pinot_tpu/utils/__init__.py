@@ -1,0 +1,1 @@
+"""Shared utilities: periodic task scheduling, tracing, metrics."""

@@ -1,0 +1,71 @@
+"""Periodic task framework.
+
+Analog of the reference's `BasePeriodicTask` + `PeriodicTaskScheduler`
+(`pinot-core/.../periodictask/`): named tasks on fixed intervals, start/stop lifecycle,
+manual `run_once` for deterministic tests (the reference's tests do the same).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class PeriodicTask:
+    def __init__(self, name: str, interval_s: float, fn: Callable[[], None],
+                 initial_delay_s: float = 0.0):
+        self.name = name
+        self.interval_s = interval_s
+        self.fn = fn
+        self.initial_delay_s = initial_delay_s
+        self.run_count = 0
+        self.last_error: Optional[BaseException] = None
+
+    def run_once(self) -> None:
+        try:
+            self.fn()
+            self.run_count += 1
+        except BaseException as e:  # periodic tasks never kill the scheduler
+            self.last_error = e
+            self.run_count += 1
+
+
+class PeriodicTaskScheduler:
+    def __init__(self):
+        self._tasks: Dict[str, PeriodicTask] = {}
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def register(self, task: PeriodicTask) -> None:
+        self._tasks[task.name] = task
+
+    def task(self, name: str) -> PeriodicTask:
+        return self._tasks[name]
+
+    def run_all_once(self) -> None:
+        """Deterministic tick for tests."""
+        for t in self._tasks.values():
+            t.run_once()
+
+    def start(self) -> None:
+        self._stop.clear()
+        for t in self._tasks.values():
+            th = threading.Thread(target=self._loop, args=(t,), daemon=True,
+                                  name=f"periodic-{t.name}")
+            th.start()
+            self._threads.append(th)
+
+    def _loop(self, task: PeriodicTask) -> None:
+        if task.initial_delay_s and self._stop.wait(task.initial_delay_s):
+            return
+        while not self._stop.is_set():
+            task.run_once()
+            if self._stop.wait(task.interval_s):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=5)
+        self._threads.clear()

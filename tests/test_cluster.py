@@ -1,0 +1,180 @@
+"""Cluster control-plane tests: upload->assign->load->route->query, replication,
+failure handling, retention, rebalance.
+
+Reference pattern: OfflineClusterIntegrationTest + ControllerTest suites (SURVEY.md §4.4)
+run in one process via the enclosure.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.catalog import ONLINE
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.table import SegmentPartitionConfig, TableConfig
+
+from conftest import make_ssb_columns
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    return QuickCluster(num_servers=3, work_dir=str(tmp_path))
+
+
+@pytest.fixture()
+def lineorder_cluster(cluster, ssb_schema):
+    rng = np.random.default_rng(5)
+    cfg = TableConfig(ssb_schema.name, replication=2, time_column="lo_orderdate")
+    cluster.create_table(ssb_schema, cfg)
+    for i in range(4):
+        cluster.ingest_columns(cfg, make_ssb_columns(rng, 1000))
+    return cluster, cfg
+
+
+def test_upload_assign_load_query(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    # ideal state has 4 segments x 2 replicas over 3 servers
+    ist = cluster.catalog.ideal_state[table]
+    assert len(ist) == 4
+    assert all(len(a) == 2 for a in ist.values())
+    # external view converged
+    status = cluster.controller.table_status(table)
+    assert status["converged"], status
+    # queries work through the broker
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000
+    assert res.stats["numServersResponded"] == res.stats["numServersQueried"]
+
+
+def test_group_by_through_broker(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    res = cluster.query("SELECT lo_region, COUNT(*) FROM lineorder "
+                        "GROUP BY lo_region ORDER BY lo_region LIMIT 10")
+    assert sum(r[1] for r in res.rows) == 4000
+    assert [r[0] for r in res.rows] == sorted(r[0] for r in res.rows)
+
+
+def test_replica_failover(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    cluster.kill_server("server_0")
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    # replication=2: every segment still has a live replica
+    assert res.rows[0][0] == 4000
+    assert not res.stats["partialResult"]
+
+
+def test_failed_server_produces_partial_result(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+
+    def broken(table, ctx, segments):
+        raise ConnectionError("boom")
+
+    cluster.broker.register_server_handle("server_1", broken)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    if res.stats["partialResult"]:
+        # second attempt routes around the unhealthy server (failure detector)
+        res2 = cluster.query("SELECT COUNT(*) FROM lineorder")
+        assert res2.rows[0][0] == 4000
+        assert not res2.stats["partialResult"]
+
+
+def test_segment_deletion(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    seg = next(iter(cluster.catalog.segments[table]))
+    meta = cluster.catalog.segments[table][seg]
+    assert cluster.deepstore.exists(meta.download_path)
+    cluster.controller.delete_segment(table, seg)
+    assert seg not in cluster.catalog.ideal_state[table]
+    assert not cluster.deepstore.exists(meta.download_path)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 3000
+
+
+def test_retention(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    cfg.retention_days = 1.0
+    table = cfg.table_name_with_type
+    # pretend every segment's data ended 2 days ago (time units: the table's raw time
+    # values; retention compares in the same unit scaled to ms here)
+    now_ms = 10_000_000
+    for meta in cluster.catalog.segments[table].values():
+        meta.end_time_ms = now_ms - 2 * 24 * 3600 * 1000
+    deleted = cluster.controller.run_retention(now_ms=now_ms)
+    assert len(deleted) == 4
+    assert cluster.query("SELECT COUNT(*) FROM lineorder").rows[0][0] == 0
+
+
+def test_rebalance_after_server_addition(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    from pinot_tpu.cluster.server import ServerNode
+    import os
+    new_server = ServerNode("server_3", cluster.catalog, cluster.deepstore,
+                            os.path.join(cluster.work_dir, "server_3"))
+    cluster.broker.register_server_handle("server_3", new_server.execute_partial)
+    final = cluster.controller.rebalance(table)
+    # the new server picked up work and every segment kept its replica count
+    loads = {}
+    for seg, assignment in final.items():
+        assert len(assignment) == cfg.replication
+        for s in assignment:
+            loads[s] = loads.get(s, 0) + 1
+    assert "server_3" in loads
+    assert cluster.query("SELECT COUNT(*) FROM lineorder").rows[0][0] == 4000
+
+
+def test_partition_pruned_routing(cluster):
+    schema = Schema("events", [dimension("user", DataType.STRING),
+                               metric("value", DataType.DOUBLE)])
+    cfg = TableConfig("events", replication=1,
+                      partition=SegmentPartitionConfig("user", "murmur", 4))
+    cluster.create_table(schema, cfg)
+    from pinot_tpu.cluster.routing import partition_for_value
+    # build one segment per partition with matching users
+    users = [f"user{i}" for i in range(40)]
+    by_partition = {}
+    for u in users:
+        by_partition.setdefault(partition_for_value(u, "murmur", 4), []).append(u)
+    for pid, us in sorted(by_partition.items()):
+        cluster.ingest_columns(cfg, {"user": us * 5, "value": np.ones(len(us) * 5)})
+
+    target_user = users[0]
+    res = cluster.query(f"SELECT COUNT(*) FROM events WHERE user = '{target_user}'")
+    assert res.rows[0][0] == 5
+    # routing pruned to exactly the one partition's segment
+    from pinot_tpu.query.context import compile_query
+    ctx = compile_query(f"SELECT COUNT(*) FROM events WHERE user = '{target_user}'", schema)
+    routed = cluster.broker.routing.route_query(cfg.table_name_with_type, ctx)
+    assert sum(len(v) for v in routed.values()) == 1
+
+
+def test_time_pruned_routing(cluster, ssb_schema):
+    cfg = TableConfig(ssb_schema.name, replication=1, time_column="lo_orderdate")
+    cluster.create_table(ssb_schema, cfg)
+    rng = np.random.default_rng(3)
+    for year in (1992, 1995):
+        cols = make_ssb_columns(rng, 500)
+        cols["lo_orderdate"] = (np.full(500, year * 10000 + 601)).astype(np.int32)
+        cluster.ingest_columns(cfg, cols)
+    from pinot_tpu.query.context import compile_query
+    ctx = compile_query("SELECT COUNT(*) FROM lineorder "
+                        "WHERE lo_orderdate BETWEEN 19950101 AND 19951231", ssb_schema)
+    routed = cluster.broker.routing.route_query(cfg.table_name_with_type, ctx)
+    assert sum(len(v) for v in routed.values()) == 1
+    res = cluster.query("SELECT COUNT(*) FROM lineorder "
+                        "WHERE lo_orderdate BETWEEN 19950101 AND 19951231")
+    assert res.rows[0][0] == 500
+
+
+def test_catalog_snapshot_restore(lineorder_cluster):
+    cluster, cfg = lineorder_cluster
+    blob = cluster.catalog.snapshot()
+    from pinot_tpu.cluster.catalog import Catalog
+    fresh = Catalog()
+    fresh.restore(blob)
+    table = cfg.table_name_with_type
+    assert set(fresh.segments[table]) == set(cluster.catalog.segments[table])
+    assert fresh.ideal_state[table] == cluster.catalog.ideal_state[table]
+    assert fresh.table_configs[table].replication == 2
