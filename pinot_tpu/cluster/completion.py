@@ -1,0 +1,247 @@
+"""Realtime (LLC) segment management + segment completion protocol.
+
+Controller-side analog of the reference's two FSM owners (SURVEY.md §3.2):
+
+* `PinotLLCRealtimeSegmentManager` (`pinot-controller/.../realtime/
+  PinotLLCRealtimeSegmentManager.java`): creates CONSUMING segments per partition
+  group, and on commit writes the final metadata, flips ideal state CONSUMING->ONLINE,
+  and creates the successor CONSUMING segment from the end offset.
+* `SegmentCompletionManager` (`.../realtime/SegmentCompletionManager.java:59,63-71`):
+  per-segment FSM electing one committer among replicas; the wire protocol responses
+  (HOLD / CATCHUP / COMMIT / DISCARD / KEEP / COMMIT_SUCCESS / FAILED) follow
+  `pinot-common/.../protocols/SegmentCompletionProtocol.java:54`.
+
+Committer election: replicas report `segment_consumed(offset)` when they hit end
+criteria. The FSM HOLDs until every live replica has reported (or a re-report arrives,
+covering lost replicas), then elects the max-offset reporter as committer; laggards get
+CATCHUP to the committer's offset, peers at the same offset HOLD until COMMITTED, then
+KEEP (use the local build) or DISCARD (download from deep store).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..segment.format import read_json, CREATION_META_FILE, SEGMENT_METADATA_FILE
+from ..table import TableConfig
+from .assignment import balanced_assign, compute_counts
+from .catalog import (CONSUMING, ONLINE, Catalog, SegmentMeta, STATUS_DONE,
+                      STATUS_IN_PROGRESS)
+from .deepstore import DeepStoreFS, tar_segment
+
+# protocol responses (reference: SegmentCompletionProtocol.ControllerResponseStatus)
+HOLD = "HOLD"
+CATCHUP = "CATCHUP"
+COMMIT = "COMMIT"
+DISCARD = "DISCARD"
+KEEP = "KEEP"
+COMMIT_SUCCESS = "COMMIT_SUCCESS"
+COMMIT_CONTINUE = "COMMIT_CONTINUE"
+FAILED = "FAILED"
+
+
+def llc_segment_name(table: str, partition: int, seq: int) -> str:
+    """Reference LLC name format: {table}__{partitionGroup}__{sequence}__{creation}."""
+    return f"{table}__{partition}__{seq}__{int(time.time() * 1000)}"
+
+
+def parse_llc_name(name: str):
+    parts = name.split("__")
+    return {"table": parts[0], "partition": int(parts[1]), "sequence": int(parts[2])}
+
+
+@dataclass
+class CompletionFSM:
+    """Per-segment completion state (reference: SegmentCompletionFSM inner class)."""
+
+    segment: str
+    num_replicas: int
+    state: str = "HOLDING"     # HOLDING -> COMMITTER_NOTIFIED -> COMMITTING -> COMMITTED
+    offsets: Dict[str, int] = field(default_factory=dict)
+    reports: Dict[str, int] = field(default_factory=dict)   # server -> report count
+    committer: Optional[str] = None
+    final_offset: Optional[int] = None
+    committer_decided_at: float = 0.0
+    commit_timeout_s: float = 120.0
+
+    def on_consumed(self, server: str, offset: int) -> Dict[str, object]:
+        if self.state == "COMMITTED":
+            if offset == self.final_offset:
+                return {"status": KEEP, "offset": self.final_offset}
+            return {"status": DISCARD, "offset": self.final_offset}
+
+        self.offsets[server] = max(offset, self.offsets.get(server, -1))
+        self.reports[server] = self.reports.get(server, 0) + 1
+
+        if self.state == "HOLDING":
+            all_reported = len(self.offsets) >= self.num_replicas
+            re_reported = any(c > 1 for c in self.reports.values())
+            if not (all_reported or re_reported):
+                return {"status": HOLD, "offset": offset}
+            self._elect()
+
+        if self.state in ("COMMITTER_NOTIFIED", "COMMITTING"):
+            if self._committer_stale():
+                self._elect()  # re-elect on committer loss (reference: FSM timeout)
+            target = self.offsets[self.committer]
+            if server == self.committer and offset >= target:
+                return {"status": COMMIT, "offset": target}
+            if offset < target:
+                return {"status": CATCHUP, "offset": target}
+            return {"status": HOLD, "offset": offset}
+        return {"status": HOLD, "offset": offset}
+
+    def _elect(self) -> None:
+        self.committer = max(self.offsets, key=lambda s: (self.offsets[s], s))
+        self.state = "COMMITTER_NOTIFIED"
+        self.committer_decided_at = time.time()
+
+    def _committer_stale(self) -> bool:
+        return (self.state == "COMMITTER_NOTIFIED"
+                and time.time() - self.committer_decided_at > self.commit_timeout_s)
+
+    def on_commit_start(self, server: str) -> str:
+        if self.state not in ("COMMITTER_NOTIFIED", "COMMITTING") or server != self.committer:
+            return FAILED
+        self.state = "COMMITTING"
+        return COMMIT_CONTINUE
+
+    def on_commit_end(self, server: str, end_offset: int) -> str:
+        if self.state != "COMMITTING" or server != self.committer:
+            return FAILED
+        self.state = "COMMITTED"
+        self.final_offset = end_offset
+        return COMMIT_SUCCESS
+
+
+class LLCSegmentManager:
+    """Controller-side realtime lifecycle (one per controller)."""
+
+    def __init__(self, catalog: Catalog, deepstore: DeepStoreFS, work_dir: str):
+        self.catalog = catalog
+        self.deepstore = deepstore
+        self.work_dir = work_dir
+        self.fsms: Dict[str, CompletionFSM] = {}
+        os.makedirs(work_dir, exist_ok=True)
+
+    # -- table setup (reference: setUpNewTable) -----------------------------
+    def setup_realtime_table(self, cfg: TableConfig, num_partitions: int,
+                             start_offsets: Optional[List[int]] = None) -> List[str]:
+        table = cfg.table_name_with_type
+        names = []
+        for p in range(num_partitions):
+            off = start_offsets[p] if start_offsets else 0
+            names.append(self._create_consuming_segment(table, cfg, p, 0, off))
+        return names
+
+    def _create_consuming_segment(self, table: str, cfg: TableConfig, partition: int,
+                                  seq: int, start_offset: int) -> str:
+        name = llc_segment_name(cfg.name, partition, seq)
+        meta = SegmentMeta(name=name, table=table, status=STATUS_IN_PROGRESS,
+                           start_offset=str(start_offset), partition_group=partition,
+                           sequence_number=seq,
+                           creation_time_ms=int(time.time() * 1000))
+        self.catalog.put_segment_meta(meta)
+        servers = self.catalog.live_servers(cfg.tenant)
+        counts = compute_counts(self.catalog.ideal_state.get(table, {}))
+        chosen = balanced_assign(name, servers, cfg.replication, counts)
+        self.catalog.update_ideal_state(table, {name: {s: CONSUMING for s in chosen}})
+        self.fsms[name] = CompletionFSM(name, num_replicas=len(chosen))
+        return name
+
+    # -- completion protocol endpoints (reference: LLCSegmentCompletionHandlers) ----
+    def segment_consumed(self, segment: str, server: str, offset: int) -> Dict[str, object]:
+        fsm = self.fsms.get(segment)
+        if fsm is None:
+            meta = self._meta(segment)
+            if meta is not None and meta.status == STATUS_DONE:
+                final = int(meta.end_offset)
+                return {"status": KEEP if offset == final else DISCARD, "offset": final}
+            return {"status": FAILED, "offset": offset}
+        return fsm.on_consumed(server, offset)
+
+    def segment_commit_start(self, segment: str, server: str) -> str:
+        fsm = self.fsms.get(segment)
+        return fsm.on_commit_start(server) if fsm else FAILED
+
+    def segment_commit_end(self, segment: str, server: str, segment_dir: str,
+                           end_offset: int) -> str:
+        """Upload + metadata flip + successor creation (reference: commitSegment path in
+        PinotLLCRealtimeSegmentManager: commitSegmentFile + commitSegmentMetadata)."""
+        fsm = self.fsms.get(segment)
+        if fsm is None or fsm.state != "COMMITTING" or server != fsm.committer:
+            return FAILED
+        meta = self._meta(segment)
+        table = meta.table
+        cfg = self.catalog.table_configs[table]
+
+        # upload the built segment to the deep store
+        seg_meta_json = read_json(os.path.join(segment_dir, SEGMENT_METADATA_FILE))
+        tar_path = os.path.join(self.work_dir, f"{segment}.tar.gz")
+        tar_segment(segment_dir, tar_path)
+        uri = f"{table}/{segment}.tar.gz"
+        self.deepstore.upload(tar_path, uri)
+        size = os.path.getsize(tar_path)
+        os.remove(tar_path)
+
+        meta.status = STATUS_DONE
+        meta.end_offset = str(end_offset)
+        meta.num_docs = seg_meta_json["totalDocs"]
+        meta.crc = read_json(os.path.join(segment_dir, CREATION_META_FILE))["crc"]
+        meta.size_bytes = size
+        meta.download_path = uri
+        self._fill_time_range(cfg, seg_meta_json, meta)
+        self.catalog.put_segment_meta(meta)
+
+        resp = fsm.on_commit_end(server, end_offset)
+        if resp != COMMIT_SUCCESS:
+            return resp
+
+        # ideal state: this segment CONSUMING -> ONLINE on all its replicas
+        assignment = self.catalog.ideal_state.get(table, {}).get(segment, {})
+        self.catalog.update_ideal_state(
+            table, {segment: {s: ONLINE for s in assignment}})
+
+        # create the successor CONSUMING segment from the end offset
+        info = parse_llc_name(segment)
+        self._create_consuming_segment(table, cfg, info["partition"],
+                                       info["sequence"] + 1, end_offset)
+        return COMMIT_SUCCESS
+
+    # -- repair (reference: RealtimeSegmentValidationManager) ---------------
+    def repair_missing_consuming_segments(self) -> List[str]:
+        """Recreate CONSUMING segments for partitions whose latest segment is DONE but
+        has no successor (e.g. controller crashed between commit and create)."""
+        created = []
+        for table, cfg in list(self.catalog.table_configs.items()):
+            if cfg.stream is None:
+                continue
+            latest: Dict[int, SegmentMeta] = {}
+            for meta in self.catalog.segments.get(table, {}).values():
+                if meta.partition_group is None:
+                    continue
+                cur = latest.get(meta.partition_group)
+                if cur is None or meta.sequence_number > cur.sequence_number:
+                    latest[meta.partition_group] = meta
+            for p, meta in latest.items():
+                if meta.status == STATUS_DONE:
+                    created.append(self._create_consuming_segment(
+                        table, cfg, p, meta.sequence_number + 1, int(meta.end_offset)))
+        return created
+
+    def _meta(self, segment: str) -> Optional[SegmentMeta]:
+        for table_segs in self.catalog.segments.values():
+            if segment in table_segs:
+                return table_segs[segment]
+        return None
+
+    def _fill_time_range(self, cfg: TableConfig, seg_meta_json, meta: SegmentMeta) -> None:
+        if not cfg.time_column:
+            return
+        col = seg_meta_json["columns"].get(cfg.time_column)
+        if col and col.get("minValue") is not None:
+            meta.start_time_ms = int(col["minValue"])
+            meta.end_time_ms = int(col["maxValue"])

@@ -34,6 +34,9 @@ class Controller:
         self.deepstore = deepstore
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)
+        from .completion import LLCSegmentManager
+        self.llc = LLCSegmentManager(catalog, deepstore,
+                                     os.path.join(work_dir, "llc"))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     # -- table CRUD (reference: PinotTableRestletResource + resource manager) ----
@@ -44,6 +47,13 @@ class Controller:
         if config.name not in self.catalog.schemas:
             raise ValueError(f"schema {config.name!r} must be added before the table")
         self.catalog.put_table_config(config)
+
+    def add_realtime_table(self, config: TableConfig, num_partitions: int) -> List[str]:
+        """Create a realtime table and its initial CONSUMING segments (reference:
+        table creation path calling PinotLLCRealtimeSegmentManager.setUpNewTable)."""
+        assert config.table_type is TableType.REALTIME and config.stream is not None
+        self.add_table(config)
+        return self.llc.setup_realtime_table(config, num_partitions)
 
     def drop_table(self, table: str) -> None:
         for seg in list(self.catalog.segments.get(table, {})):

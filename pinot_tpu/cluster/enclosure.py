@@ -35,7 +35,8 @@ class QuickCluster:
                                      os.path.join(self.work_dir, "controller"))
         self.servers: List[ServerNode] = [
             ServerNode(f"server_{i}", self.catalog, self.deepstore,
-                       os.path.join(self.work_dir, f"server_{i}"))
+                       os.path.join(self.work_dir, f"server_{i}"),
+                       completion=self.controller.llc)
             for i in range(num_servers)
         ]
         self.broker = Broker("broker_0", self.catalog)
@@ -70,6 +71,25 @@ class QuickCluster:
         seg_dir = builder.build(columns, build_dir, name)
         self.controller.upload_segment(table, seg_dir)
         return name
+
+    def create_realtime_table(self, schema: Schema, config: TableConfig,
+                              num_partitions: int):
+        """Realtime table backed by an in-memory stream topic (embedded-Kafka analog)."""
+        from ..ingest.stream import MemoryStream
+        self.controller.add_schema(schema)
+        MemoryStream.create(config.stream.topic, num_partitions)
+        return self.controller.add_realtime_table(config, num_partitions)
+
+    def pump_realtime(self, table_name_with_type: str) -> int:
+        """Deterministically drive every server's consumers one batch + one protocol
+        round (tests; production uses RealtimeTableManager.start_loop)."""
+        moved = 0
+        for s in self.servers:
+            mgr = s.realtime_manager(table_name_with_type)
+            if mgr is not None:
+                moved += mgr.pump_all()
+                mgr.complete_all()
+        return moved
 
     def query(self, sql: str) -> ResultTable:
         return self.broker.handle_query(sql)

@@ -68,7 +68,7 @@ class ServerNode:
     """One server instance (reference: HelixServerStarter + ServerInstance)."""
 
     def __init__(self, instance_id: str, catalog: Catalog, deepstore: DeepStoreFS,
-                 data_dir: str, tags: Optional[List[str]] = None):
+                 data_dir: str, tags: Optional[List[str]] = None, completion=None):
         self.instance_id = instance_id
         self.catalog = catalog
         self.deepstore = deepstore
@@ -76,7 +76,8 @@ class ServerNode:
         self.executor = ServerQueryExecutor()
         self.tables: Dict[str, TableDataManager] = {}
         self._lock = threading.RLock()
-        self._realtime_managers: Dict[str, object] = {}  # wired by ingest.realtime
+        self._realtime_managers: Dict[str, object] = {}
+        self.completion = completion  # LLCSegmentManager handle (in-proc or HTTP proxy)
         os.makedirs(data_dir, exist_ok=True)
         catalog.register_instance(InstanceInfo(instance_id, "server", tags=tags
                                                or ["DefaultTenant"]))
@@ -102,21 +103,45 @@ class ServerNode:
         for seg_name, state in desired.items():
             if state == ONLINE and seg_name not in mgr.segment_names:
                 try:
-                    self._load_online_segment(table, seg_name, mgr)
+                    # CONSUMING -> ONLINE: adopt the local build when offsets allow,
+                    # else download the committed copy (reference:
+                    # onBecomeOnlineFromConsuming, CONSUMING->ONLINE transition :91)
+                    handler = self._realtime_managers.get(table)
+                    local_dir = handler.on_segment_online(seg_name) if handler else None
+                    if local_dir:
+                        mgr.add_segment(seg_name, load_segment(local_dir))
+                    else:
+                        self._load_online_segment(table, seg_name, mgr)
                     self.catalog.report_state(table, seg_name, self.instance_id, ONLINE)
                 except Exception:
                     self.catalog.report_state(table, seg_name, self.instance_id, "ERROR")
                     raise
             elif state == CONSUMING and seg_name not in mgr.segment_names:
-                handler = self._realtime_managers.get(table)
+                handler = self._ensure_realtime_manager(table)
                 if handler is not None:
-                    handler.start_consuming(seg_name)  # ingest.realtime wires this
-                self.catalog.report_state(table, seg_name, self.instance_id, CONSUMING)
+                    handler.start_consuming(seg_name)
+                    self.catalog.report_state(table, seg_name, self.instance_id,
+                                              CONSUMING)
 
         for seg_name in list(mgr.segment_names):
             if seg_name not in desired:
                 mgr.remove_segment(seg_name)
                 self.catalog.report_state(table, seg_name, self.instance_id, None)
+
+    def _ensure_realtime_manager(self, table: str):
+        with self._lock:
+            handler = self._realtime_managers.get(table)
+            if handler is None:
+                cfg = self.catalog.table_configs.get(table)
+                if cfg is None or cfg.stream is None or self.completion is None:
+                    return None
+                from ..ingest.realtime import RealtimeTableManager
+                handler = RealtimeTableManager(self, table, cfg, self.completion)
+                self._realtime_managers[table] = handler
+            return handler
+
+    def realtime_manager(self, table: str):
+        return self._realtime_managers.get(table)
 
     def _load_online_segment(self, table: str, seg_name: str, mgr: TableDataManager) -> None:
         meta = self.catalog.segments.get(table, {}).get(seg_name)

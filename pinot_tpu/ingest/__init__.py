@@ -1,0 +1,6 @@
+"""Ingestion: record readers, transform pipeline, batch jobs, realtime streams.
+
+Mirrors the reference's ingestion surface (SURVEY.md §2.1 stream SPI + record I/O SPI,
+§3.2 realtime consumption, §3.3 batch build-and-push) with a TPU-first twist: the batch
+path builds aligned-dictionary segment sets so the mesh combine fast path applies.
+"""

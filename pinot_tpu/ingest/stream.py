@@ -1,0 +1,182 @@
+"""Stream SPI + in-memory stream implementation.
+
+Analog of the reference's pluggable stream abstraction
+(`pinot-spi/src/main/java/org/apache/pinot/spi/stream/`: `PartitionGroupConsumer`,
+`StreamConsumerFactory`, `StreamPartitionMsgOffset`, `MessageBatch`,
+`StreamMessageDecoder`, `StreamMetadataProvider`). Offsets are opaque comparables
+serialized as strings, exactly like the reference, so a Kafka-protocol consumer plugs in
+without touching the consumption FSM. `MemoryStream` plays the role of the embedded
+Kafka the reference uses in tests (`KafkaDataServerStartable`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class StreamMessage:
+    value: Any
+    offset: int
+    key: Optional[str] = None
+    timestamp_ms: int = 0
+
+
+@dataclass
+class MessageBatch:
+    messages: List[StreamMessage]
+    next_offset: int                 # offset to resume from
+
+    def __len__(self):
+        return len(self.messages)
+
+
+class PartitionGroupConsumer:
+    """Fetch interface for one partition (reference: PartitionGroupConsumer)."""
+
+    def fetch(self, start_offset: int, max_messages: int, timeout_ms: int = 0) -> MessageBatch:
+        raise NotImplementedError
+
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StreamMetadataProvider:
+    def partition_count(self, topic: str) -> int:
+        raise NotImplementedError
+
+    def earliest_offset(self, topic: str, partition: int) -> int:
+        return 0
+
+
+class StreamConsumerFactory:
+    """Reference: StreamConsumerFactory — one per stream plugin type."""
+
+    def create_consumer(self, topic: str, partition: int) -> PartitionGroupConsumer:
+        raise NotImplementedError
+
+    def metadata_provider(self) -> StreamMetadataProvider:
+        raise NotImplementedError
+
+
+# -- in-memory stream --------------------------------------------------------
+
+class MemoryStream:
+    """In-process partitioned topic store shared by producers and consumers."""
+
+    _topics: Dict[str, "MemoryStream"] = {}
+    _lock = threading.RLock()
+
+    def __init__(self, topic: str, num_partitions: int):
+        self.topic = topic
+        self.num_partitions = num_partitions
+        self.partitions: List[List[StreamMessage]] = [[] for _ in range(num_partitions)]
+        self._plock = threading.RLock()
+
+    @classmethod
+    def create(cls, topic: str, num_partitions: int) -> "MemoryStream":
+        with cls._lock:
+            if topic not in cls._topics:
+                cls._topics[topic] = MemoryStream(topic, num_partitions)
+            return cls._topics[topic]
+
+    @classmethod
+    def get(cls, topic: str) -> "MemoryStream":
+        with cls._lock:
+            if topic not in cls._topics:
+                raise KeyError(f"unknown topic {topic!r}")
+            return cls._topics[topic]
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._lock:
+            cls._topics.clear()
+
+    def produce(self, value: Any, partition: Optional[int] = None,
+                key: Optional[str] = None) -> int:
+        with self._plock:
+            if partition is None:
+                partition = (hash(key) if key is not None else
+                             sum(len(p) for p in self.partitions)) % self.num_partitions
+            plist = self.partitions[partition]
+            msg = StreamMessage(value=value, offset=len(plist), key=key)
+            plist.append(msg)
+            return msg.offset
+
+
+class MemoryStreamConsumer(PartitionGroupConsumer):
+    def __init__(self, topic: str, partition: int):
+        self.stream = MemoryStream.get(topic)
+        self.partition = partition
+
+    def fetch(self, start_offset: int, max_messages: int, timeout_ms: int = 0) -> MessageBatch:
+        with self.stream._plock:
+            msgs = self.stream.partitions[self.partition][
+                start_offset:start_offset + max_messages]
+        return MessageBatch(list(msgs), start_offset + len(msgs))
+
+    def latest_offset(self) -> int:
+        with self.stream._plock:
+            return len(self.stream.partitions[self.partition])
+
+
+class MemoryStreamFactory(StreamConsumerFactory):
+    def __init__(self, topic: str):
+        self.topic = topic
+
+    def create_consumer(self, topic: str, partition: int) -> PartitionGroupConsumer:
+        return MemoryStreamConsumer(topic, partition)
+
+    def metadata_provider(self) -> StreamMetadataProvider:
+        factory = self
+
+        class _Meta(StreamMetadataProvider):
+            def partition_count(self, topic: str) -> int:
+                return MemoryStream.get(topic or factory.topic).num_partitions
+
+        return _Meta()
+
+
+# -- decoders (reference: StreamMessageDecoder SPI) --------------------------
+
+def json_decoder(value: Any) -> Dict[str, Any]:
+    if isinstance(value, (bytes, str)):
+        return json.loads(value)
+    return dict(value)
+
+
+def passthrough_decoder(value: Any) -> Dict[str, Any]:
+    return value
+
+
+_DECODERS: Dict[str, Callable[[Any], Dict[str, Any]]] = {
+    "json": json_decoder,
+    "dict": passthrough_decoder,
+}
+
+_FACTORIES: Dict[str, Callable[[str], StreamConsumerFactory]] = {
+    "memory": MemoryStreamFactory,
+}
+
+
+def register_decoder(name: str, fn: Callable[[Any], Dict[str, Any]]) -> None:
+    _DECODERS[name] = fn
+
+
+def register_stream_factory(name: str, factory: Callable[[str], StreamConsumerFactory]) -> None:
+    """Plugin hook (reference: stream type -> factory class name in stream configs)."""
+    _FACTORIES[name] = factory
+
+
+def get_decoder(name: str) -> Callable[[Any], Dict[str, Any]]:
+    return _DECODERS[name]
+
+
+def get_stream_factory(stream_type: str, topic: str) -> StreamConsumerFactory:
+    return _FACTORIES[stream_type](topic)

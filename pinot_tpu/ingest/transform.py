@@ -1,0 +1,100 @@
+"""Record transform pipeline: filter -> expression transforms -> type coercion.
+
+Analog of the reference's ordered transformer chain
+(`pinot-segment-local/.../recordtransformer/CompositeTransformer.java:33`:
+complex-type flatten -> FilterTransformer -> ExpressionTransformer ->
+DataTypeTransformer -> null handling -> sanitize). Transform expressions reuse the SQL
+expression compiler — the same `eval_expr` that powers queries — so ingestion-time
+functions and query-time functions are one registry (the reference shares its
+`FunctionRegistry` between both for the same reason).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..engine.expr import eval_expr
+from ..schema import Schema
+from ..sql.parser import Parser
+
+
+def _parse_expr(text: str):
+    p = Parser(text)
+    e = p.expression()
+    if p.cur.kind != "EOF":
+        raise ValueError(f"trailing input in expression {text!r}")
+    return e
+
+
+class TransformPipeline:
+    """Vectorized over row batches (columns dict of lists/arrays)."""
+
+    def __init__(self, schema: Schema,
+                 filter_expr: Optional[str] = None,
+                 column_transforms: Optional[Dict[str, str]] = None):
+        """`filter_expr`: rows matching are DROPPED (reference FilterTransformer
+        semantics: filterFunction selects records to skip).
+        `column_transforms`: dest column -> SQL expression over source fields."""
+        self.schema = schema
+        self.filter_expr = _parse_expr(filter_expr) if filter_expr else None
+        self.column_transforms = {dest: _parse_expr(src)
+                                  for dest, src in (column_transforms or {}).items()}
+
+    def apply(self, columns: Dict[str, Any]) -> Dict[str, List[Any]]:
+        n = len(next(iter(columns.values()))) if columns else 0
+        env = {k: _as_array(v) for k, v in columns.items()}
+
+        # 0. pre-coerce schema columns so filters/transforms see typed values even for
+        #    string inputs (CSV); non-schema fields stay raw for transforms to consume.
+        for spec in self.schema.fields:
+            if spec.name in env:
+                coerce = spec.data_type.coerce
+                env[spec.name] = _as_array(
+                    [None if v is None or _is_nan(v) else coerce(v)
+                     for v in env[spec.name].tolist()])
+
+        # 1. expression transforms (may reference raw input fields)
+        for dest, expr in self.column_transforms.items():
+            out = eval_expr(expr, env, np)
+            env[dest] = np.full(n, out, dtype=object) if np.isscalar(out) else _as_array(out)
+
+        # 2. filter (drop matching rows)
+        if self.filter_expr is not None:
+            drop = np.asarray(eval_expr(self.filter_expr, env, np), dtype=bool)
+            keep = ~drop
+            env = {k: v[keep] for k, v in env.items()}
+            n = int(keep.sum())
+
+        # 3. type coercion + null defaulting per schema (DataTypeTransformer analog);
+        #    None survives as None so the segment writer records null bitmaps.
+        out_cols: Dict[str, List[Any]] = {}
+        for spec in self.schema.fields:
+            if spec.name not in env:
+                out_cols[spec.name] = [None] * n
+                continue
+            vals = env[spec.name]
+            coerce = spec.data_type.coerce
+            out_cols[spec.name] = [None if v is None or _is_nan(v) else coerce(v)
+                                   for v in vals.tolist()]
+        return out_cols
+
+    def apply_row(self, row: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Single-row variant for the realtime consume loop."""
+        cols = self.apply({k: [v] for k, v in row.items()})
+        if not cols or len(next(iter(cols.values()))) == 0:
+            return None
+        return {k: v[0] for k, v in cols.items()}
+
+
+def _as_array(v) -> np.ndarray:
+    if isinstance(v, np.ndarray):
+        return v
+    arr = np.empty(len(v), dtype=object)
+    arr[:] = v
+    return arr
+
+
+def _is_nan(v: Any) -> bool:
+    return isinstance(v, float) and v != v

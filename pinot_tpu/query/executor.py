@@ -260,7 +260,7 @@ class ServerQueryExecutor:
         seg = plan.segment
         if plan.filter_prog.is_match_all:
             return np.ones(seg.num_docs, dtype=bool)
-        use_device = self.use_device
+        use_device = self.use_device and not getattr(seg, "is_mutable", False)
         if use_device:
             from .planner import _expr_device_ok
             for leaf in plan.filter_prog.leaves:

@@ -83,6 +83,11 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         return plan
 
     # -- device path feasibility ------------------------------------------
+    if getattr(segment, "is_mutable", False):
+        # consuming segments stay host-side; the TPU path starts at commit
+        plan.kind = "host"
+        plan.fallback_reason = "mutable (consuming) segment"
+        return plan
     reason = _device_feasible(plan, segment)
     if reason:
         plan.kind = "host"

@@ -1,0 +1,183 @@
+"""Mutable (consuming) segment: in-memory row-append columnar store.
+
+Analog of the reference's `MutableSegmentImpl`
+(`pinot-segment-local/.../indexsegment/mutable/MutableSegmentImpl.java:117,495`): one
+writer thread appends decoded rows; queries see a consistent snapshot via the volatile
+row counter (`:145` — here a plain int read under the GIL). Exposes the same column
+reader surface as `ImmutableSegment` so the host execution path runs unchanged; the
+planner routes mutable segments to the host path (`is_mutable`), since consuming
+segments are small and bounded by the flush threshold — the TPU path begins at segment
+commit, when data becomes immutable and device-resident.
+
+Dictionaries: string columns keep an append-order value<->id map while consuming
+(reference: mutable dictionaries are unsorted); query-time snapshots build a *sorted*
+`Dictionary` + remapped ids lazily, cached per snapshot row count.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..schema import DataType, FieldSpec, Schema
+from .dictionary import Dictionary
+
+
+class MutableColumnReader:
+    """ColumnReader-compatible view over an appending column."""
+
+    def __init__(self, spec: FieldSpec, store: "MutableSegment"):
+        self.spec = spec
+        self.store = store
+        self.name = spec.name
+        self.data_type = spec.data_type
+        self._snap_rows = -1
+        self._snap_dict: Optional[Dictionary] = None
+        self._snap_ids: Optional[np.ndarray] = None
+
+    # -- reader surface ----------------------------------------------------
+    @property
+    def has_dictionary(self) -> bool:
+        return not self.data_type.is_numeric
+
+    @property
+    def num_docs(self) -> int:
+        return self.store.num_docs
+
+    @property
+    def is_sorted(self) -> bool:
+        return False
+
+    @property
+    def cardinality(self) -> int:
+        self._snapshot()
+        return len(self._snap_dict) if self._snap_dict is not None else -1
+
+    @property
+    def meta(self) -> Dict[str, Any]:
+        return {"hasNulls": bool(self.store.null_rows.get(self.name)),
+                "dataType": self.data_type.value,
+                "fwdDtype": str(self.fwd.dtype)}
+
+    @property
+    def dictionary(self) -> Optional[Dictionary]:
+        self._snapshot()
+        return self._snap_dict
+
+    @property
+    def fwd(self) -> np.ndarray:
+        """Dict ids for string columns, raw values for numeric."""
+        n = self.store.num_docs
+        vals = self.store.columns[self.name][:n]
+        if self.has_dictionary:
+            self._snapshot()
+            return self._snap_ids
+        return np.asarray(vals, dtype=self.data_type.numpy_dtype)
+
+    def values(self) -> np.ndarray:
+        n = self.store.num_docs
+        vals = self.store.columns[self.name][:n]
+        if self.has_dictionary:
+            return np.array(vals, dtype=object)
+        return np.asarray(vals, dtype=self.data_type.numpy_dtype)
+
+    @property
+    def null_bitmap(self) -> Optional[np.ndarray]:
+        nulls = self.store.null_rows.get(self.name)
+        if not nulls:
+            return None
+        n = self.store.num_docs
+        out = np.zeros(n, dtype=bool)
+        out[[i for i in nulls if i < n]] = True
+        return out
+
+    @property
+    def min_value(self):
+        v = self.values()
+        return None if not len(v) else (v.min() if not self.has_dictionary else min(v))
+
+    @property
+    def max_value(self):
+        v = self.values()
+        return None if not len(v) else (v.max() if not self.has_dictionary else max(v))
+
+    # aux indexes don't exist while consuming (realtime inverted index comes later)
+    inverted_index = None
+    range_index = None
+    bloom_filter = None
+    index_types: List[str] = []
+
+    # ------------------------------------------------------------------
+    def _snapshot(self) -> None:
+        if not self.has_dictionary:
+            return
+        n = self.store.num_docs
+        if n == self._snap_rows:
+            return
+        vals = self.store.columns[self.name][:n]
+        arr = np.array(vals, dtype=object)
+        uniq, inverse = np.unique(arr, return_inverse=True)
+        self._snap_dict = Dictionary(list(uniq), self.data_type)
+        self._snap_ids = inverse.astype(np.int64)
+        self._snap_rows = n
+
+
+class MutableSegment:
+    """Row-append segment; single writer, many readers."""
+
+    is_mutable = True
+
+    def __init__(self, name: str, schema: Schema):
+        self.name = name
+        self.schema = schema
+        self.columns: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
+        self.null_rows: Dict[str, List[int]] = {}
+        self._num_docs = 0          # volatile row counter (MutableSegmentImpl.java:145)
+        self._readers: Dict[str, MutableColumnReader] = {}
+        self.start_time_ms = int(time.time() * 1000)
+
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.schema.column_names
+
+    def index(self, row: Dict[str, Any]) -> None:
+        """Append one decoded+transformed row (reference: MutableSegmentImpl.index)."""
+        n = self._num_docs
+        for spec in self.schema.fields:
+            v = row.get(spec.name)
+            if v is None:
+                self.null_rows.setdefault(spec.name, []).append(n)
+                v = spec.null_value
+            else:
+                v = spec.data_type.coerce(v)
+            self.columns[spec.name].append(v)
+        self._num_docs = n + 1  # publish the row (single atomic int store)
+
+    def column(self, name: str) -> MutableColumnReader:
+        if name not in self._readers:
+            if name not in self.columns:
+                raise KeyError(f"segment {self.name}: no column {name!r}")
+            self._readers[name] = MutableColumnReader(self.schema.field_spec(name), self)
+        return self._readers[name]
+
+    def snapshot_columns(self) -> Dict[str, list]:
+        """Consistent copy of all columns (for immutable conversion at commit)."""
+        n = self._num_docs
+        cols = {}
+        for name, vals in self.columns.items():
+            col = list(vals[:n])
+            for i in self.null_rows.get(name, []):
+                if i < n:
+                    col[i] = None
+            cols[name] = col
+        return cols
+
+    def __repr__(self) -> str:
+        return f"MutableSegment({self.name!r}, docs={self._num_docs})"

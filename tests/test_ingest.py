@@ -1,0 +1,224 @@
+"""Ingestion tests: readers, transforms, batch jobs, mutable segments, realtime
+consumption + segment completion protocol.
+
+Reference patterns: record-transformer unit tests, LLCRealtimeClusterIntegrationTest and
+SegmentCompletionIntegrationTest (FSM driving) — all in-process (SURVEY.md §4).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.catalog import CONSUMING, ONLINE, STATUS_DONE, STATUS_IN_PROGRESS
+from pinot_tpu.ingest.batch import BatchIngestionJobSpec, run_batch_ingestion
+from pinot_tpu.ingest.stream import MemoryStream
+from pinot_tpu.ingest.transform import TransformPipeline
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.mutable import MutableSegment
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+
+@pytest.fixture(autouse=True)
+def _reset_streams():
+    MemoryStream.reset_all()
+    yield
+    MemoryStream.reset_all()
+
+
+@pytest.fixture()
+def events_schema():
+    return Schema("events", [
+        dimension("user", DataType.STRING),
+        dimension("country", DataType.STRING),
+        metric("value", DataType.DOUBLE),
+        metric("clicks", DataType.INT),
+    ])
+
+
+# -- transforms --------------------------------------------------------------
+
+def test_transform_pipeline(events_schema):
+    p = TransformPipeline(events_schema,
+                          filter_expr="value < 0",
+                          column_transforms={"clicks": "clicks * 2"})
+    cols = p.apply({"user": ["a", "b", "c"], "country": ["US", "DE", "US"],
+                    "value": [1.0, -5.0, 2.0], "clicks": [1, 2, 3]})
+    assert cols["user"] == ["a", "c"]
+    assert cols["clicks"] == [2, 6]
+    assert cols["value"] == [1.0, 2.0]
+
+
+def test_transform_missing_column_defaults(events_schema):
+    p = TransformPipeline(events_schema)
+    cols = p.apply({"user": ["a"], "value": [1.5]})
+    assert cols["country"] == [None]
+    assert cols["clicks"] == [None]
+
+
+# -- readers + batch job -----------------------------------------------------
+
+def test_batch_ingestion_job(tmp_path, events_schema):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path / "cluster"))
+    cfg = TableConfig("events")
+    cluster.create_table(events_schema, cfg)
+
+    csv_path = tmp_path / "in.csv"
+    csv_path.write_text("user,country,value,clicks\n" +
+                        "".join(f"u{i % 7},C{i % 3},{i}.5,{i}\n" for i in range(100)))
+    jsonl_path = tmp_path / "in.jsonl"
+    jsonl_path.write_text("".join(
+        json.dumps({"user": f"u{i}", "country": "JP", "value": i, "clicks": 1}) + "\n"
+        for i in range(20)))
+
+    spec = BatchIngestionJobSpec(
+        input_paths=[str(csv_path), str(jsonl_path)],
+        table=cfg.table_name_with_type,
+        segment_rows=50,
+        filter_expr="clicks > 90",
+    )
+    pushed = run_batch_ingestion(spec, cluster.controller, work_dir=str(tmp_path))
+    assert len(pushed) == 3  # 111 rows kept / 50 per segment
+    res = cluster.query("SELECT COUNT(*), SUM(value) FROM events")
+    assert res.rows[0][0] == 111  # 100 - 9 filtered + 20
+
+
+# -- mutable segment ---------------------------------------------------------
+
+def test_mutable_segment_query(events_schema):
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    seg = MutableSegment("events__0__0__x", events_schema)
+    for i in range(50):
+        seg.index({"user": f"u{i % 5}", "country": "US" if i % 2 else "DE",
+                   "value": float(i), "clicks": i})
+    ex = ServerQueryExecutor()
+    res = ex.execute([seg], "SELECT COUNT(*), SUM(value) FROM events "
+                            "WHERE country = 'US'", events_schema)
+    assert res.rows[0][0] == 25
+    res2 = ex.execute([seg], "SELECT user, COUNT(*) FROM events GROUP BY user LIMIT 10",
+                      events_schema)
+    assert sum(r[1] for r in res2.rows) == 50
+
+
+# -- realtime end-to-end -----------------------------------------------------
+
+def realtime_cluster(tmp_path, events_schema, replication=2, flush_rows=40,
+                     num_partitions=2):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cfg = TableConfig("events", table_type=TableType.REALTIME, replication=replication,
+                      stream=StreamConfig(stream_type="memory", topic="events_topic",
+                                          decoder="json",
+                                          flush_threshold_rows=flush_rows))
+    cluster.create_realtime_table(events_schema, cfg, num_partitions)
+    return cluster, cfg
+
+
+def produce(topic, partition, rows):
+    stream = MemoryStream.get(topic)
+    for row in rows:
+        stream.produce(json.dumps(row), partition=partition)
+
+
+def test_realtime_consume_query_commit(tmp_path, events_schema):
+    cluster, cfg = realtime_cluster(tmp_path, events_schema)
+    table = cfg.table_name_with_type
+
+    # initial CONSUMING segments exist and are routable
+    ist = cluster.catalog.ideal_state[table]
+    assert len(ist) == 2 and all(set(a.values()) == {CONSUMING} for a in ist.values())
+
+    produce("events_topic", 0, [{"user": f"u{i}", "country": "US", "value": i,
+                                 "clicks": 1} for i in range(30)])
+    produce("events_topic", 1, [{"user": f"v{i}", "country": "DE", "value": i,
+                                 "clicks": 1} for i in range(10)])
+    cluster.pump_realtime(table)
+
+    # rows visible before any commit (consuming segments are queryable)
+    res = cluster.query("SELECT COUNT(*) FROM events")
+    assert res.rows[0][0] == 40
+
+    # cross the flush threshold on partition 0 -> completion protocol runs
+    produce("events_topic", 0, [{"user": "x", "country": "US", "value": 1,
+                                 "clicks": 2} for _ in range(15)])
+    cluster.pump_realtime(table)   # consume; first consumed report HOLDs
+    cluster.pump_realtime(table)   # re-report -> elect committer -> COMMIT round
+    cluster.pump_realtime(table)
+
+    metas = cluster.catalog.segments[table]
+    done = [m for m in metas.values() if m.status == STATUS_DONE]
+    assert len(done) == 1
+    committed = done[0]
+    assert committed.partition_group == 0
+    assert int(committed.end_offset) == 45
+    assert committed.num_docs == 45
+    # successor consuming segment created from the end offset
+    successors = [m for m in metas.values()
+                  if m.partition_group == 0 and m.sequence_number == 1]
+    assert len(successors) == 1
+    assert successors[0].status == STATUS_IN_PROGRESS
+    assert int(successors[0].start_offset) == 45
+
+    # committed segment serves ONLINE replicas; data still complete
+    res = cluster.query("SELECT COUNT(*) FROM events")
+    assert res.rows[0][0] == 55
+    ev = cluster.catalog.external_view[table]
+    assert set(ev[committed.name].values()) == {ONLINE}
+
+
+def test_realtime_data_survives_commit_plus_new_rows(tmp_path, events_schema):
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=20,
+                                    num_partitions=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "country": "US", "value": 1,
+                                 "clicks": 1} for i in range(25)])
+    for _ in range(4):
+        cluster.pump_realtime(table)
+    # post-commit rows land in the successor consuming segment
+    produce("events_topic", 0, [{"user": "z", "country": "JP", "value": 2,
+                                 "clicks": 1} for _ in range(5)])
+    cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*), SUM(value) FROM events")
+    assert res.rows[0][0] == 30
+    assert res.rows[0][1] == pytest.approx(25 + 10)
+
+
+def test_completion_fsm_edges():
+    from pinot_tpu.cluster.completion import CompletionFSM, HOLD, CATCHUP, COMMIT, KEEP, DISCARD
+    fsm = CompletionFSM("seg", num_replicas=2)
+    # first reporter holds until all replicas report
+    assert fsm.on_consumed("s1", 100)["status"] == HOLD
+    # second reporter at lower offset: election happens; s1 wins; s2 must catch up
+    r = fsm.on_consumed("s2", 90)
+    assert r["status"] == CATCHUP and r["offset"] == 100
+    # winner gets COMMIT
+    assert fsm.on_consumed("s1", 100)["status"] == COMMIT
+    assert fsm.on_commit_start("s2") == "FAILED"      # only the committer may commit
+    assert fsm.on_commit_start("s1") == "COMMIT_CONTINUE"
+    assert fsm.on_commit_end("s1", 100) == "COMMIT_SUCCESS"
+    # post-commit reports: caught-up replica keeps local build, laggard discards
+    assert fsm.on_consumed("s2", 100)["status"] == KEEP
+    assert fsm.on_consumed("s3", 90)["status"] == DISCARD
+
+
+def test_repair_missing_consuming_segment(tmp_path, events_schema):
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=10,
+                                    num_partitions=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": "a", "country": "US", "value": 1, "clicks": 1}
+                                for _ in range(12)])
+    for _ in range(4):
+        cluster.pump_realtime(table)
+    metas = cluster.catalog.segments[table]
+    # simulate controller crash after commit: delete the successor's metadata + IS
+    succ = next(m for m in metas.values() if m.sequence_number == 1)
+    cluster.controller.llc.fsms.pop(succ.name, None)
+    cluster.catalog.update_ideal_state(table, {succ.name: None})
+    cluster.catalog.drop_segment_meta(table, succ.name)
+
+    created = cluster.controller.llc.repair_missing_consuming_segments()
+    assert len(created) == 1
+    new_meta = cluster.catalog.segments[table][created[0]]
+    assert new_meta.sequence_number == 1
+    assert int(new_meta.start_offset) == 12
