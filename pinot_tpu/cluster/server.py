@@ -176,14 +176,17 @@ class ServerNode:
         if isinstance(ctx, str):
             ctx = compile_query(ctx, schema)
         mgr = self._table_manager(table)
+        handler = self._realtime_managers.get(table)
+        upsert = getattr(handler, "upsert", None) if handler else None
         segments = mgr.acquire(segment_names)
         try:
-            results = [self.executor.execute_segment(ctx, seg) for seg in segments]
+            results = []
+            for seg in segments:
+                valid = upsert.valid_mask(seg.name, seg.num_docs) if upsert else None
+                results.append(self.executor.execute_segment(ctx, seg, valid))
             # include in-progress realtime docs when a consuming manager exists
-            handler = self._realtime_managers.get(table)
             if handler is not None:
-                extra = handler.consuming_results(ctx, segment_names)
-                results.extend(extra)
+                results.extend(handler.consuming_results(ctx, segment_names))
         finally:
             mgr.release(segments)
         aggs = [make_agg(f) for f in ctx.aggregations]

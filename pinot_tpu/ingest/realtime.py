@@ -48,7 +48,8 @@ class RealtimePartitionConsumer:
 
     def __init__(self, segment_name: str, table_cfg: TableConfig, schema,
                  start_offset: int, server_id: str, completion, data_dir: str,
-                 pipeline: Optional[TransformPipeline] = None):
+                 pipeline: Optional[TransformPipeline] = None,
+                 upsert=None, dedup=None, partial_rows: Optional[dict] = None):
         self.segment_name = segment_name
         self.table_cfg = table_cfg
         self.schema = schema
@@ -58,6 +59,9 @@ class RealtimePartitionConsumer:
         self.state = INITIAL_CONSUMING
         self.mutable = MutableSegment(segment_name, schema)
         self.pipeline = pipeline or TransformPipeline(schema)
+        self.upsert = upsert                    # TableUpsertMetadataManager or None
+        self.dedup = dedup                      # PartitionDedupMetadataManager or None
+        self.partial_rows = partial_rows if partial_rows is not None else {}
         stream_cfg = table_cfg.stream
         from ..cluster.completion import parse_llc_name
         self.partition = parse_llc_name(segment_name)["partition"]
@@ -84,11 +88,45 @@ class RealtimePartitionConsumer:
         for msg in batch.messages:
             row = self.decoder(msg.value)
             row = self.pipeline.apply_row(row)
-            if row is not None:
-                self.mutable.index(row)
+            if row is not None and self._index_row(row, msg.offset):
                 indexed += 1
         self.offset = batch.next_offset
         return indexed
+
+    def _index_row(self, row: Dict, msg_offset: int) -> bool:
+        """Index with dedup/upsert hooks (reference: MutableSegmentImpl.index
+        upsert/dedup hooks at :498-541)."""
+        pk_cols = self.schema.primary_key_columns
+        pk = tuple(row.get(c) for c in pk_cols) if pk_cols else None
+
+        if self.dedup is not None and pk is not None:
+            if not self.dedup.check_and_add(pk):
+                return False  # exact duplicate dropped at ingest
+
+        if self.upsert is not None and pk is not None:
+            up_cfg = self.table_cfg.upsert
+            if up_cfg and up_cfg.mode == "PARTIAL":
+                prev = self.partial_rows.get(pk)
+                if prev is not None:
+                    from ..upsert import merge_partial
+                    merged = dict(prev)
+                    for col, val in row.items():
+                        if col in pk_cols:
+                            continue
+                        strategy = up_cfg.partial_strategies.get(col, "OVERWRITE")
+                        merged[col] = merge_partial(strategy, prev.get(col), val)
+                    row = merged
+                self.partial_rows[pk] = dict(row)
+            cmp_val = (row.get(up_cfg.comparison_column)
+                       if up_cfg and up_cfg.comparison_column else msg_offset)
+            doc_id = self.mutable.num_docs
+            self.mutable.index(row)
+            self.upsert.partition(self.partition).add_record(
+                self.segment_name, doc_id, pk, cmp_val)
+            return True
+
+        self.mutable.index(row)
+        return True
 
     def end_criteria_reached(self) -> bool:
         """Reference: row-count / time thresholds (realtime.segment.flush.*)."""
@@ -167,6 +205,11 @@ class RealtimeTableManager:
         filter_expr = (table_cfg.stream.properties or {}).get("filterExpr")
         schema = server.catalog.schema_for_table(table)
         self._pipeline = TransformPipeline(schema, filter_expr, transforms)
+        from ..upsert import PartitionDedupMetadataManager, TableUpsertMetadataManager
+        self.upsert = TableUpsertMetadataManager() if table_cfg.upsert else None
+        self._dedup: Dict[int, PartitionDedupMetadataManager] = {}
+        self.dedup_enabled = table_cfg.dedup_enabled
+        self.partial_rows: Dict[tuple, dict] = {}
 
     # wired from ServerNode.reconcile on CONSUMING transitions
     def start_consuming(self, segment_name: str) -> None:
@@ -176,10 +219,17 @@ class RealtimeTableManager:
             meta = self.server.catalog.segments.get(self.table, {}).get(segment_name)
             start_offset = int(meta.start_offset) if meta and meta.start_offset else 0
             schema = self.server.catalog.schema_for_table(self.table)
+            from ..cluster.completion import parse_llc_name
+            partition = parse_llc_name(segment_name)["partition"]
+            from ..upsert import PartitionDedupMetadataManager
+            dedup = None
+            if self.dedup_enabled:
+                dedup = self._dedup.setdefault(partition, PartitionDedupMetadataManager())
             self.consumers[segment_name] = RealtimePartitionConsumer(
                 segment_name, self.table_cfg, schema, start_offset,
                 self.server.instance_id, self.completion, self.server.data_dir,
-                self._pipeline)
+                self._pipeline, upsert=self.upsert, dedup=dedup,
+                partial_rows=self.partial_rows)
 
     def stop_consuming(self, segment_name: str) -> Optional[RealtimePartitionConsumer]:
         with self._lock:
@@ -215,7 +265,9 @@ class RealtimeTableManager:
         out = []
         for c in consumers:
             if c.mutable.num_docs > 0 and c.state not in (COMMITTED, DISCARDED):
-                out.append(self.server.executor.execute_segment(ctx, c.mutable))
+                valid = (self.upsert.valid_mask(c.segment_name, c.mutable.num_docs)
+                         if self.upsert else None)
+                out.append(self.server.executor.execute_segment(ctx, c.mutable, valid))
         return out
 
     # -- deterministic drive (tests) / background loop (production) ---------

@@ -45,8 +45,9 @@ class ServerQueryExecutor:
         return reduce_to_result(ctx, merged, aggs, group_exprs)
 
     # -- per-segment execution --------------------------------------------
-    def execute_segment(self, ctx: QueryContext, segment: ImmutableSegment) -> SegmentResult:
-        plan = plan_segment(ctx, segment)
+    def execute_segment(self, ctx: QueryContext, segment: ImmutableSegment,
+                        valid_docs: Optional[np.ndarray] = None) -> SegmentResult:
+        plan = plan_segment(ctx, segment, valid_docs)
         if not self.use_device and plan.kind == "device":
             plan.kind = "host"
             plan.fallback_reason = "device disabled"
@@ -139,6 +140,12 @@ class ServerQueryExecutor:
                                               and agg.arg.name == "*"):
                 vals_cols.update(identifiers_in(agg.arg))
 
+        valid = block.valid
+        if plan.valid_docs is not None:
+            padded = np.zeros(block.padded, dtype=bool)
+            padded[:len(plan.valid_docs)] = plan.valid_docs
+            valid = valid & jnp.asarray(padded)  # upsert valid-doc intersection
+
         return KernelInputs(
             ids={c: block.ids(c) for c in ids_cols},
             vals={c: block.values(c) for c in vals_cols},
@@ -146,7 +153,7 @@ class ServerQueryExecutor:
             iscal=jnp.asarray(np.asarray(iscal, dtype=np.int32)),
             fscal=jnp.asarray(np.asarray(fscal, dtype=np.float32)),
             nulls={c: block.null_mask(c) for c in nulls_cols},
-            valid=block.valid,
+            valid=valid,
             strides=jnp.asarray(np.asarray(plan.strides, dtype=np.int32)),
         )
 
@@ -195,10 +202,10 @@ class ServerQueryExecutor:
 
     # -- host fallback aggregation ---------------------------------------
     def _host_aggregate(self, plan: SegmentPlan) -> SegmentResult:
-        import pandas as pd
-
         seg = plan.segment
         mask = host_filter_mask(plan, seg)
+        if plan.valid_docs is not None:
+            mask = mask & plan.valid_docs[:len(mask)]
         idx = np.nonzero(mask)[0]
         env = _host_env(plan, seg)
 
@@ -216,21 +223,43 @@ class ServerQueryExecutor:
         key_arrays = [np.asarray(eval_expr(g, env, np))[idx] for g in plan.group_exprs]
         arg_arrays = [arg_values(a) for a in plan.aggs]
 
-        frame = pd.DataFrame({f"g{j}": k for j, k in enumerate(key_arrays)})
-        grouped = frame.groupby([f"g{j}" for j in range(len(key_arrays))], sort=False).indices
+        # vectorized grouping: factorize each key column, combine into one dense int
+        # key, then split row indices per group — the host-side mirror of the device's
+        # DictionaryBasedGroupKeyGenerator dense keys (no pandas: its arrow string
+        # backend is not thread-safe for object arrays).
+        value_dicts = []
+        combined = np.zeros(len(idx), dtype=np.int64)
+        stride = 1
+        for arr in key_arrays:
+            uniq, inv = np.unique(arr, return_inverse=True)
+            combined += inv.astype(np.int64) * stride
+            value_dicts.append(uniq)
+            stride *= max(len(uniq), 1)
+        uniq_keys, inverse = np.unique(combined, return_inverse=True)
+        order = np.argsort(inverse, kind="stable")
+        bounds = np.zeros(len(uniq_keys) + 1, dtype=np.int64)
+        np.cumsum(np.bincount(inverse, minlength=len(uniq_keys)), out=bounds[1:])
 
         result = SegmentResult("groups", num_docs_scanned=len(idx))
-        for key, gidx in grouped.items():
-            key = key if isinstance(key, tuple) else (key,)
-            key = tuple(v.item() if isinstance(v, np.generic) else v for v in key)
-            result.groups[key] = [a.host_state(arg_arrays[i][gidx])
-                                  for i, a in enumerate(plan.aggs)]
+        for g, dense in enumerate(uniq_keys):
+            gidx = order[bounds[g]:bounds[g + 1]]
+            key = []
+            rem = dense
+            for j, uniq in enumerate(value_dicts):
+                card = max(len(uniq), 1)
+                v = uniq[rem % card]
+                key.append(v.item() if isinstance(v, np.generic) else v)
+                rem //= card
+            result.groups[tuple(key)] = [a.host_state(arg_arrays[i][gidx])
+                                         for i, a in enumerate(plan.aggs)]
         return result
 
     # -- selection --------------------------------------------------------
     def _selection(self, plan: SegmentPlan) -> SegmentResult:
         ctx, seg = plan.ctx, plan.segment
         mask = self._selection_mask(plan)
+        if plan.valid_docs is not None:
+            mask = mask & plan.valid_docs[:len(mask)]
         idx = np.nonzero(mask)[0]
         if not ctx.order_by:
             idx = idx[:ctx.offset + ctx.limit]  # early terminate (SelectionOnlyOperator)

@@ -49,9 +49,12 @@ class SegmentPlan:
     num_keys_real: int = 0
     num_keys_pad: int = 0
     fallback_reason: str = ""
+    # upsert: only rows set in this mask are visible (None = all rows)
+    valid_docs: Optional[np.ndarray] = None
 
 
-def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
+                 valid_docs: Optional[np.ndarray] = None) -> SegmentPlan:
     aggs = [make_agg(f) for f in ctx.aggregations]
     # DISTINCT rewrites to a group-by over the select expressions with no aggregations
     # (reference: DistinctOperator is a specialized group-by).
@@ -61,6 +64,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         group_exprs = list(ctx.group_by)
 
     plan = SegmentPlan("host", segment, ctx, aggs, group_exprs)
+    plan.valid_docs = valid_docs
 
     # -- filter compilation + constant-fold pruning ------------------------
     try:
@@ -76,9 +80,9 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         plan.kind = "selection"
         return plan
 
-    # -- metadata-only answers --------------------------------------------
-    if (not group_exprs and plan.filter_prog.is_match_all and aggs
-            and all(_metadata_answerable(a, segment) for a in aggs)):
+    # -- metadata-only answers (unavailable under an upsert mask) ----------
+    if (not group_exprs and plan.filter_prog.is_match_all and valid_docs is None
+            and aggs and all(_metadata_answerable(a, segment) for a in aggs)):
         plan.kind = "metadata"
         return plan
 

@@ -1,0 +1,183 @@
+"""Upsert + dedup metadata managers.
+
+Analog of the reference's upsert engine
+(`pinot-segment-local/.../upsert/ConcurrentMapPartitionUpsertMetadataManager.java:60,109,145`):
+a per-partition primary-key map -> (segment, docId, comparisonValue); when a newer row
+with the same PK arrives, the older location's valid-doc bitmap bit is cleared and the
+new location set. Queries AND the per-segment valid-docs mask into the filter, so exactly
+one (the latest) row per key is visible. Dedup
+(`pinot-segment-local/.../dedup/PartitionDedupMetadataManager.java`) is the ingest-time
+drop variant of the same PK map.
+
+Partial upsert (reference: PartialUpsertHandler + merger/) supports per-column merge
+strategies applied at ingest: OVERWRITE, IGNORE, INCREMENT, APPEND, UNION, MAX, MIN.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class PartitionUpsertMetadataManager:
+    """PK -> location map + per-segment valid-doc bitmaps for one partition group."""
+
+    def __init__(self, comparison_enabled: bool = True):
+        self._lock = threading.RLock()
+        self._primary_keys: Dict[Tuple, Tuple[str, int, Any]] = {}
+        self._valid: Dict[str, np.ndarray] = {}
+        self._versions: Dict[str, int] = {}
+        self.comparison_enabled = comparison_enabled
+
+    def _bitmap(self, segment: str, min_size: int) -> np.ndarray:
+        cur = self._valid.get(segment)
+        if cur is None:
+            cur = np.zeros(max(min_size, 64), dtype=bool)
+            self._valid[segment] = cur
+        elif len(cur) < min_size:
+            grown = np.zeros(max(min_size, len(cur) * 2), dtype=bool)
+            grown[:len(cur)] = cur
+            self._valid[segment] = grown
+            cur = grown
+        return cur
+
+    def add_record(self, segment: str, doc_id: int, pk: Tuple,
+                   comparison_value: Any = None) -> bool:
+        """Register a row; returns True if it became the live row for its key
+        (reference: addRecord / addOrReplaceSegment record loop)."""
+        with self._lock:
+            bitmap = self._bitmap(segment, doc_id + 1)
+            existing = self._primary_keys.get(pk)
+            if existing is not None:
+                old_seg, old_doc, old_cmp = existing
+                if (self.comparison_enabled and comparison_value is not None
+                        and old_cmp is not None and comparison_value < old_cmp):
+                    return False  # out-of-order event: older than the live row
+                old_bitmap = self._valid.get(old_seg)
+                if old_bitmap is not None and old_doc < len(old_bitmap):
+                    old_bitmap[old_doc] = False
+                self._bump(old_seg)
+            bitmap[doc_id] = True
+            self._primary_keys[pk] = (segment, doc_id, comparison_value)
+            self._bump(segment)
+            return True
+
+    def rename_segment(self, old: str, new: str) -> None:
+        """Mutable -> committed immutable keeps doc ids; carry the bitmap over."""
+        with self._lock:
+            if old == new:
+                return
+            if old in self._valid:
+                self._valid[new] = self._valid.pop(old)
+                self._versions[new] = self._versions.pop(old, 0)
+            for pk, (seg, doc, cmp_val) in list(self._primary_keys.items()):
+                if seg == old:
+                    self._primary_keys[pk] = (new, doc, cmp_val)
+
+    def remove_segment(self, segment: str) -> None:
+        with self._lock:
+            self._valid.pop(segment, None)
+            self._versions.pop(segment, None)
+            for pk, (seg, _, _) in list(self._primary_keys.items()):
+                if seg == segment:
+                    del self._primary_keys[pk]
+
+    def valid_mask(self, segment: str, num_docs: int) -> Optional[np.ndarray]:
+        """bool[num_docs] of live rows, or None if the segment is untracked."""
+        with self._lock:
+            cur = self._valid.get(segment)
+            if cur is None:
+                return None
+            out = np.zeros(num_docs, dtype=bool)
+            n = min(num_docs, len(cur))
+            out[:n] = cur[:n]
+            return out
+
+    def version(self, segment: str) -> int:
+        with self._lock:
+            return self._versions.get(segment, 0)
+
+    def _bump(self, segment: str) -> None:
+        self._versions[segment] = self._versions.get(segment, 0) + 1
+
+    @property
+    def num_primary_keys(self) -> int:
+        with self._lock:
+            return len(self._primary_keys)
+
+
+class TableUpsertMetadataManager:
+    """Per-table: partition group -> partition manager (reference:
+    TableUpsertMetadataManager)."""
+
+    def __init__(self, comparison_enabled: bool = True):
+        self._partitions: Dict[int, PartitionUpsertMetadataManager] = {}
+        self._lock = threading.RLock()
+        self.comparison_enabled = comparison_enabled
+
+    def partition(self, partition_group: int) -> PartitionUpsertMetadataManager:
+        with self._lock:
+            if partition_group not in self._partitions:
+                self._partitions[partition_group] = PartitionUpsertMetadataManager(
+                    self.comparison_enabled)
+            return self._partitions[partition_group]
+
+    def valid_mask(self, segment: str, num_docs: int) -> Optional[np.ndarray]:
+        for pm in list(self._partitions.values()):
+            mask = pm.valid_mask(segment, num_docs)
+            if mask is not None:
+                return mask
+        return None
+
+
+class PartitionDedupMetadataManager:
+    """Exact ingest-time dedup: drop rows whose PK was already seen
+    (reference: PartitionDedupMetadataManager)."""
+
+    def __init__(self):
+        self._seen: set = set()
+        self._lock = threading.RLock()
+
+    def check_and_add(self, pk: Tuple) -> bool:
+        """True if the PK is new (row should be ingested)."""
+        with self._lock:
+            if pk in self._seen:
+                return False
+            self._seen.add(pk)
+            return True
+
+    def remove_segment_keys(self, pks) -> None:
+        with self._lock:
+            self._seen.difference_update(pks)
+
+
+# -- partial upsert mergers (reference: upsert/merger/) ----------------------
+
+def merge_partial(strategy: str, old: Any, new: Any) -> Any:
+    if new is None:
+        return old
+    if old is None:
+        return new
+    s = strategy.upper()
+    if s == "OVERWRITE":
+        return new
+    if s == "IGNORE":
+        return old
+    if s == "INCREMENT":
+        return old + new
+    if s == "MAX":
+        return max(old, new)
+    if s == "MIN":
+        return min(old, new)
+    if s == "APPEND":
+        return (old if isinstance(old, list) else [old]) + \
+            (new if isinstance(new, list) else [new])
+    if s == "UNION":
+        merged = (old if isinstance(old, list) else [old])
+        for v in (new if isinstance(new, list) else [new]):
+            if v not in merged:
+                merged.append(v)
+        return merged
+    raise ValueError(f"unknown partial upsert strategy {strategy!r}")

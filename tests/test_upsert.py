@@ -1,0 +1,154 @@
+"""Upsert + dedup tests (reference patterns: upsert metadata manager unit tests +
+UpsertTableIntegrationTest / PartialUpsertTableIntegrationTest)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.ingest.stream import MemoryStream
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType, UpsertConfig
+from pinot_tpu.upsert import (PartitionDedupMetadataManager,
+                              PartitionUpsertMetadataManager, merge_partial)
+
+
+@pytest.fixture(autouse=True)
+def _reset_streams():
+    MemoryStream.reset_all()
+    yield
+    MemoryStream.reset_all()
+
+
+def test_partition_upsert_manager_basics():
+    m = PartitionUpsertMetadataManager()
+    assert m.add_record("s1", 0, ("k1",), 10)
+    assert m.add_record("s1", 1, ("k2",), 10)
+    # replace k1 with a newer row in another segment
+    assert m.add_record("s2", 0, ("k1",), 20)
+    np.testing.assert_array_equal(m.valid_mask("s1", 2), [False, True])
+    np.testing.assert_array_equal(m.valid_mask("s2", 1), [True])
+    # out-of-order (older comparison value) is rejected
+    assert not m.add_record("s2", 1, ("k1",), 5)
+    np.testing.assert_array_equal(m.valid_mask("s2", 2), [True, False])
+    assert m.num_primary_keys == 2
+
+
+def test_dedup_manager():
+    d = PartitionDedupMetadataManager()
+    assert d.check_and_add(("a",))
+    assert not d.check_and_add(("a",))
+    assert d.check_and_add(("b",))
+
+
+def test_merge_partial_strategies():
+    assert merge_partial("OVERWRITE", 1, 2) == 2
+    assert merge_partial("IGNORE", 1, 2) == 1
+    assert merge_partial("INCREMENT", 1, 2) == 3
+    assert merge_partial("MAX", 1, 2) == 2
+    assert merge_partial("MIN", 1, 2) == 1
+    assert merge_partial("APPEND", ["a"], "b") == ["a", "b"]
+    assert merge_partial("UNION", ["a"], ["a", "b"]) == ["a", "b"]
+    assert merge_partial("OVERWRITE", None, 5) == 5
+    assert merge_partial("OVERWRITE", 5, None) == 5
+
+
+def _upsert_schema():
+    return Schema("orders", [
+        dimension("order_id", DataType.STRING),
+        dimension("status", DataType.STRING),
+        metric("amount", DataType.DOUBLE),
+    ], primary_key_columns=["order_id"])
+
+
+def _make_cluster(tmp_path, upsert_cfg=None, dedup=False):
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig("orders", table_type=TableType.REALTIME, replication=1,
+                      stream=StreamConfig(stream_type="memory", topic="orders_topic",
+                                          decoder="json", flush_threshold_rows=1000),
+                      upsert=upsert_cfg, dedup_enabled=dedup)
+    cluster.create_realtime_table(_upsert_schema(), cfg, 1)
+    return cluster, cfg
+
+
+def _produce(rows):
+    stream = MemoryStream.get("orders_topic")
+    for r in rows:
+        stream.produce(json.dumps(r), partition=0)
+
+
+def test_full_upsert_end_to_end(tmp_path):
+    cluster, cfg = _make_cluster(tmp_path, UpsertConfig(mode="FULL"))
+    table = cfg.table_name_with_type
+    _produce([
+        {"order_id": "o1", "status": "NEW", "amount": 10.0},
+        {"order_id": "o2", "status": "NEW", "amount": 20.0},
+        {"order_id": "o1", "status": "SHIPPED", "amount": 10.0},
+        {"order_id": "o1", "status": "DELIVERED", "amount": 10.0},
+    ])
+    cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*), SUM(amount) FROM orders")
+    assert res.rows[0][0] == 2  # one live row per key
+    assert res.rows[0][1] == pytest.approx(30.0)
+    res2 = cluster.query("SELECT status, COUNT(*) FROM orders GROUP BY status LIMIT 10")
+    assert dict((r[0], r[1]) for r in res2.rows) == {"DELIVERED": 1, "NEW": 1}
+
+
+def test_partial_upsert_increment(tmp_path):
+    cluster, cfg = _make_cluster(tmp_path, UpsertConfig(
+        mode="PARTIAL", partial_strategies={"amount": "INCREMENT",
+                                            "status": "OVERWRITE"}))
+    table = cfg.table_name_with_type
+    _produce([
+        {"order_id": "o1", "status": "NEW", "amount": 10.0},
+        {"order_id": "o1", "status": "PAID", "amount": 5.0},
+        {"order_id": "o1", "status": "PAID", "amount": 2.0},
+    ])
+    cluster.pump_realtime(table)
+    res = cluster.query("SELECT status, SUM(amount) FROM orders GROUP BY status LIMIT 5")
+    assert res.rows == [["PAID", 17.0]]
+
+
+def test_dedup_end_to_end(tmp_path):
+    cluster, cfg = _make_cluster(tmp_path, dedup=True)
+    table = cfg.table_name_with_type
+    _produce([
+        {"order_id": "o1", "status": "NEW", "amount": 10.0},
+        {"order_id": "o1", "status": "DUPLICATE", "amount": 99.0},
+        {"order_id": "o2", "status": "NEW", "amount": 20.0},
+    ])
+    cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*), SUM(amount) FROM orders")
+    assert res.rows[0][0] == 2  # duplicate dropped at ingest
+    assert res.rows[0][1] == pytest.approx(30.0)
+
+
+def test_upsert_survives_commit(tmp_path):
+    """Valid-doc masks follow the segment across the mutable->immutable commit."""
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig("orders", table_type=TableType.REALTIME, replication=1,
+                      stream=StreamConfig(stream_type="memory", topic="orders_topic",
+                                          decoder="json", flush_threshold_rows=4),
+                      upsert=UpsertConfig(mode="FULL"))
+    cluster.create_realtime_table(_upsert_schema(), cfg, 1)
+    table = cfg.table_name_with_type
+    _produce([
+        {"order_id": "o1", "status": "NEW", "amount": 1.0},
+        {"order_id": "o2", "status": "NEW", "amount": 2.0},
+        {"order_id": "o1", "status": "PAID", "amount": 1.0},
+        {"order_id": "o3", "status": "NEW", "amount": 3.0},
+    ])
+    for _ in range(4):
+        cluster.pump_realtime(table)
+    from pinot_tpu.cluster.catalog import STATUS_DONE
+    metas = cluster.catalog.segments[table]
+    assert any(m.status == STATUS_DONE for m in metas.values())
+    # post-commit: update o2 in the new consuming segment
+    _produce([{"order_id": "o2", "status": "CANCELLED", "amount": 2.0}])
+    cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*) FROM orders")
+    assert res.rows[0][0] == 3
+    res2 = cluster.query("SELECT status, COUNT(*) FROM orders GROUP BY status LIMIT 10")
+    assert dict((r[0], r[1]) for r in res2.rows) == \
+        {"PAID": 1, "NEW": 1, "CANCELLED": 1}
