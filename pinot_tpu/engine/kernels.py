@@ -43,6 +43,7 @@ class KernelSpec:
     aggs: Tuple[Tuple[AggFunc, Tuple[str, ...]], ...]  # (func, device outputs)
     distinct_lut_sizes: Dict[int, int] = field(default_factory=dict)  # agg idx -> lut size
     padded_rows: int = 0
+    hll_params: Dict[int, int] = field(default_factory=dict)  # agg idx -> precision p
 
     # per-leaf runtime input routing, computed in __post_init__
     lut_index: Dict[int, int] = field(default_factory=dict)
@@ -71,6 +72,7 @@ class KernelSpec:
             tuple((a.name, repr(a.arg), outs) for a, outs in self.aggs),
             tuple(sorted(self.distinct_lut_sizes.items())),
             self.padded_rows,
+            tuple(sorted(self.hll_params.items())),
         )
 
 
@@ -86,6 +88,7 @@ class KernelInputs:
     nulls: Dict[str, jnp.ndarray]
     valid: jnp.ndarray
     strides: jnp.ndarray  # i32[G] (empty for scalar aggregation)
+    agg_luts: Dict[str, jnp.ndarray] = field(default_factory=dict)  # "<i>.bucket"/"<i>.rank"
 
 
 _KERNEL_CACHE: Dict[Tuple, Any] = {}
@@ -158,7 +161,7 @@ def _build_kernel(spec: KernelSpec):
     num_seg = spec.num_keys_pad + 1  # +1 overflow bucket for masked-out rows
     mask_fn = _make_mask_fn(spec)
 
-    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides):
+    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts):
         mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid)
         out: Dict[str, jnp.ndarray] = {}
 
@@ -193,6 +196,16 @@ def _build_kernel(spec: KernelSpec):
                         mask.astype(jnp.int32), ids[agg.arg.name],
                         num_segments=spec.distinct_lut_sizes[ai])
                     continue
+                if "hll" in outs:
+                    # HLL register update: per-dict-id (bucket, rank) LUT gathers +
+                    # one segment_max — no hashing on device.
+                    m = 1 << spec.hll_params[ai]
+                    col_ids = ids[agg.arg.name]
+                    bucket = jnp.where(mask, agg_luts[f"{ai}.bucket"][col_ids], m)
+                    rank = jnp.where(mask, agg_luts[f"{ai}.rank"][col_ids], 0)
+                    regs = jax.ops.segment_max(rank, bucket, num_segments=m + 1)[:m]
+                    out[f"{ai}.hll"] = jnp.maximum(regs, 0)
+                    continue
                 if outs == ("count",):
                     continue
                 v = _agg_arg(agg, vals)
@@ -224,7 +237,8 @@ def get_kernel(spec: KernelSpec):
 
 def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
     out = get_kernel(spec)(inputs.ids, inputs.vals, inputs.luts, inputs.iscal,
-                           inputs.fscal, inputs.nulls, inputs.valid, inputs.strides)
+                           inputs.fscal, inputs.nulls, inputs.valid, inputs.strides,
+                           inputs.agg_luts)
     return {k: np.asarray(v) for k, v in out.items()}
 
 

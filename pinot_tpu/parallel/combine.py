@@ -174,10 +174,18 @@ class MeshQueryExecutor:
         build_device_geometry(plan)
         agg_specs = []
         distinct_lut_sizes: Dict[int, int] = {}
+        hll_params: Dict[int, int] = {}
+        agg_luts: Dict[str, jnp.ndarray] = {}
         for i, agg in enumerate(plan.aggs):
             agg_specs.append((agg, agg.device_outputs))
             if "distinct" in agg.device_outputs:
                 distinct_lut_sizes[i] = lut_size(segments[0].column(agg.arg.name).cardinality)
+            if "hll" in agg.device_outputs:
+                from ..query.executor import _hll_luts
+                hll_params[i] = agg.p
+                bucket, rank = _hll_luts(segments[0].column(agg.arg.name), agg.p)
+                agg_luts[f"{i}.bucket"] = self._const(bucket)
+                agg_luts[f"{i}.rank"] = self._const(rank)
 
         s_pad = -(-len(segments) // self.n_devices) * self.n_devices
         key = tuple(s.path for s in segments)
@@ -187,7 +195,7 @@ class MeshQueryExecutor:
             self._set_blocks[key] = block
 
         spec = KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
-                          tuple(agg_specs), distinct_lut_sizes, block.rows)
+                          tuple(agg_specs), distinct_lut_sizes, block.rows, hll_params)
 
         # -- gather runtime inputs ------------------------------------
         ids_cols, decode_cols, raw_cols, nulls_cols = set(plan.group_cols), set(), set(), set()
@@ -203,7 +211,7 @@ class MeshQueryExecutor:
             elif isinstance(leaf, NullLeaf):
                 nulls_cols.add(leaf.col)
         for i, agg in enumerate(plan.aggs):
-            if "distinct" in agg.device_outputs:
+            if "distinct" in agg.device_outputs or "hll" in agg.device_outputs:
                 ids_cols.add(agg.arg.name)
             elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
                                               and agg.arg.name == "*"):
@@ -221,6 +229,7 @@ class MeshQueryExecutor:
             nulls={c: block.null_mask(c) for c in nulls_cols},
             valid=block.valid,
             strides=self._const(np.asarray(plan.strides, dtype=np.int32)),
+            agg_luts=agg_luts,
         )
 
         fn = self._get_shard_kernel(spec, s_pad, block.rows)
@@ -255,12 +264,14 @@ class MeshQueryExecutor:
         sharded, repl = P(ax), P()
 
         in_specs = (dict(ids=sharded, raw=sharded, decode=repl, luts=repl, iscal=repl,
-                         fscal=repl, nulls=sharded, valid=sharded, strides=repl),)
+                         fscal=repl, nulls=sharded, valid=sharded, strides=repl,
+                         agg_luts=repl),)
 
         def shard_body(inputs):
             ids, raw, decode = inputs["ids"], inputs["raw"], inputs["decode"]
             luts, iscal, fscal = inputs["luts"], inputs["iscal"], inputs["fscal"]
             nulls, valid, strides = inputs["nulls"], inputs["valid"], inputs["strides"]
+            agg_luts = inputs["agg_luts"]
             # local shapes: [s_local, P] — decode dict values in-kernel (one gather)
             vals = {c: decode[c][ids[c]] for c in decode}
             vals.update(raw)
@@ -301,6 +312,15 @@ class MeshQueryExecutor:
                             flat_mask.astype(jnp.int32), ids[agg.arg.name].ravel(),
                             num_segments=spec.distinct_lut_sizes[ai])
                         out[f"{ai}.distinct"] = jax.lax.psum(presence, ax)
+                        continue
+                    if "hll" in outs_names:
+                        m = 1 << spec.hll_params[ai]
+                        col_ids = ids[agg.arg.name].ravel()
+                        bucket = jnp.where(flat_mask,
+                                           agg_luts[f"{ai}.bucket"][col_ids], m)
+                        rank = jnp.where(flat_mask, agg_luts[f"{ai}.rank"][col_ids], 0)
+                        regs = jax.ops.segment_max(rank, bucket, num_segments=m + 1)[:m]
+                        out[f"{ai}.hll"] = jax.lax.pmax(jnp.maximum(regs, 0), ax)
                         continue
                     if outs_names == ("count",):
                         continue

@@ -208,6 +208,86 @@ class DistinctCountAgg(AggFunc):
         return 0
 
 
+HLL_DEFAULT_P = 12  # 4096 registers, ~1.6% relative error
+
+
+def hll_hash(value) -> int:
+    """64-bit stable hash for HLL bucketing."""
+    import hashlib
+    if isinstance(value, bytes):
+        data = value
+    elif isinstance(value, (float, np.floating)) and float(value).is_integer():
+        data = str(int(value)).encode()
+    else:
+        data = str(value).encode()
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def hll_bucket_rank(value, p: int) -> Tuple[int, int]:
+    h = hll_hash(value)
+    bucket = h >> (64 - p)
+    w = (h << p) & ((1 << 64) - 1)
+    rank = (64 - p) + 1 if w == 0 else (64 - w.bit_length() + 1 - p) + 1
+    return bucket, rank
+
+
+def hll_estimate(registers: np.ndarray) -> float:
+    """Standard HyperLogLog estimator with small-range correction."""
+    m = len(registers)
+    alpha = 0.7213 / (1 + 1.079 / m) if m >= 128 else {16: 0.673, 32: 0.697,
+                                                       64: 0.709}.get(m, 0.7213)
+    est = alpha * m * m / np.sum(np.exp2(-registers.astype(np.float64)))
+    zeros = int(np.sum(registers == 0))
+    if est <= 2.5 * m and zeros > 0:
+        est = m * np.log(m / zeros)
+    return float(est)
+
+
+class DistinctCountHLLAgg(AggFunc):
+    """Approximate distinct count via HyperLogLog (reference:
+    DistinctCountHLLAggregationFunction, default log2m in
+    `CommonConstants.Helix.DEFAULT_HYPERLOGLOG_LOG2M`).
+
+    TPU path (dict-column arg, no group-by): per-dict-id (bucket, rank) LUTs are
+    precomputed host-side from the dictionary; on device the registers are one
+    `segment_max(rank_lut[ids], bucket_lut[ids])` — the sketch update is a gather+scatter
+    with no hashing on device. States merge by elementwise register max.
+    """
+
+    name = "distinctcounthll"
+    device_outputs = ("hll",)
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        self.p = HLL_DEFAULT_P
+        if len(call.args) >= 2:
+            from ..sql.ast import Literal
+            if isinstance(call.args[1], Literal):
+                self.p = int(call.args[1].value)
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return ctx.arg_is_dict_column and not ctx.group_by
+
+    def host_state(self, values) -> np.ndarray:
+        regs = np.zeros(1 << self.p, dtype=np.int8)
+        for v in np.unique(np.asarray(values, dtype=object)):
+            b, r = hll_bucket_rank(v, self.p)
+            regs[b] = max(regs[b], r)
+        return regs
+
+    def state_from_device(self, outs) -> np.ndarray:
+        return np.asarray(outs["hll"], dtype=np.int8)
+
+    def merge(self, a, b):
+        return np.maximum(a, b)
+
+    def finalize(self, state) -> int:
+        return int(round(hll_estimate(state)))
+
+    def empty_result(self):
+        return 0
+
+
 class PercentileAgg(AggFunc):
     """Exact percentile — keeps filtered values per state (host-path only).
     `percentile(col, p)` or legacy `percentileNN(col)`."""
@@ -266,9 +346,12 @@ _REGISTRY = {
     "avg": AvgAgg,
     "minmaxrange": MinMaxRangeAgg,
     "distinctcount": DistinctCountAgg,
+    "distinctcountbitmap": DistinctCountAgg,  # exact; same state here
+    "distinctcounthll": DistinctCountHLLAgg,
     "mode": ModeAgg,
     "percentile": PercentileAgg,
     "percentileest": PercentileAgg,
+    "percentiletdigest": PercentileAgg,  # exact values stand in for the tdigest sketch
 }
 
 

@@ -99,14 +99,18 @@ class ServerQueryExecutor:
         build_device_geometry(plan)
         agg_specs: List[Tuple[AggFunc, Tuple[str, ...]]] = []
         distinct_lut_sizes: Dict[int, int] = {}
+        hll_params: Dict[int, int] = {}
         for i, agg in enumerate(plan.aggs):
             agg_specs.append((agg, agg.device_outputs))
             if "distinct" in agg.device_outputs:
                 distinct_lut_sizes[i] = lut_size(seg.column(agg.arg.name).cardinality)
+            if "hll" in agg.device_outputs:
+                hll_params[i] = agg.p
 
         block = block_for(seg)
         spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
-                                  tuple(agg_specs), distinct_lut_sizes, block.padded)
+                                  tuple(agg_specs), distinct_lut_sizes, block.padded,
+                                  hll_params)
         inputs = self._kernel_inputs(plan, spec, block)
         outs = kernels.run_kernel(spec, inputs)
 
@@ -133,9 +137,15 @@ class ServerQueryExecutor:
                 (iscal if leaf.is_int else fscal).extend(leaf.operands)
             elif isinstance(leaf, NullLeaf):
                 nulls_cols.add(leaf.col)
+        agg_luts: Dict[str, "jnp.ndarray"] = {}
         for i, agg in enumerate(plan.aggs):
             if "distinct" in agg.device_outputs:
                 ids_cols.add(agg.arg.name)
+            elif "hll" in agg.device_outputs:
+                ids_cols.add(agg.arg.name)
+                bucket, rank = _hll_luts(plan.segment.column(agg.arg.name), agg.p)
+                agg_luts[f"{i}.bucket"] = jnp.asarray(bucket)
+                agg_luts[f"{i}.rank"] = jnp.asarray(rank)
             elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
                                               and agg.arg.name == "*"):
                 vals_cols.update(identifiers_in(agg.arg))
@@ -155,6 +165,7 @@ class ServerQueryExecutor:
             nulls={c: block.null_mask(c) for c in nulls_cols},
             valid=valid,
             strides=jnp.asarray(np.asarray(plan.strides, dtype=np.int32)),
+            agg_luts=agg_luts,
         )
 
     def _decode_group_partials(self, plan: SegmentPlan, outs) -> SegmentResult:
@@ -380,6 +391,26 @@ def _host_env(plan: SegmentPlan, seg: ImmutableSegment) -> Dict[str, np.ndarray]
 
 def _is_const(e: Expr) -> bool:
     return not identifiers_in(e)
+
+
+def _hll_luts(reader, p: int):
+    """Per-dict-id (bucket, rank) HLL update tables, cached on the column reader."""
+    from ..engine.datablock import lut_size
+    from .aggregates import hll_bucket_rank
+    cache = getattr(reader, "_hll_lut_cache", None)
+    if cache is None:
+        cache = {}
+        reader._hll_lut_cache = cache
+    if p not in cache:
+        size = lut_size(reader.cardinality)
+        bucket = np.zeros(size, dtype=np.int32)
+        rank = np.zeros(size, dtype=np.int32)
+        for i, v in enumerate(reader.dictionary.values):
+            b, r = hll_bucket_rank(v, p)
+            bucket[i] = b
+            rank[i] = r
+        cache[p] = (bucket, rank)
+    return cache[p]
 
 
 def execute_query(segments: Sequence[ImmutableSegment], sql: str,

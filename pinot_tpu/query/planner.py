@@ -215,8 +215,9 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
             segment.column(arg.name).data_type.is_numeric
         if not agg.device_ok(AggContext(group_by, arg_is_dict, arg_numeric)):
             return f"aggregation {agg.name} not device-supported here"
-        if "distinct" in agg.device_outputs and arg_is_dict:
-            continue  # distinct over a dict column works on ids; value dtype irrelevant
+        if arg_is_dict and ("distinct" in agg.device_outputs
+                            or "hll" in agg.device_outputs):
+            continue  # distinct/HLL over a dict column works on ids; dtype irrelevant
         if arg is not None and not (isinstance(arg, Identifier) and arg.name == "*"):
             err = _expr_device_ok(arg, segment)
             if err:

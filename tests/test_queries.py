@@ -283,3 +283,27 @@ def test_minmax_pruning_raw_column(qenv):
                          segments[0].schema)
     assert plan_segment(ctx2, segments[0]).kind == "metadata"
     run_both(qenv, "SELECT COUNT(*) FROM lineorder WHERE lo_extendedprice >= 0")
+
+
+def test_distinctcounthll_device(qenv):
+    """HLL estimate within ~3% of exact (device path: LUT gather + segment_max)."""
+    segments, db = qenv
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    ctx = compile_query("SELECT DISTINCTCOUNTHLL(lo_brand) FROM lineorder "
+                        "WHERE lo_quantity > 5", segments[0].schema)
+    assert plan_segment(ctx, segments[0]).kind == "device"  # dict column -> device HLL
+    res = execute_query(segments, "SELECT DISTINCTCOUNTHLL(lo_custkey) FROM lineorder "
+                                  "WHERE lo_quantity > 5")  # raw column -> host HLL
+    exact = db.execute("SELECT COUNT(DISTINCT lo_custkey) FROM lineorder "
+                       "WHERE lo_quantity > 5").fetchone()[0]
+    assert res.rows[0][0] == pytest.approx(exact, rel=0.03)
+
+
+def test_distinctcounthll_matches_host_path(qenv):
+    """Device and host HLL paths produce identical sketches."""
+    segments, _ = qenv
+    sql = "SELECT DISTINCTCOUNTHLL(lo_brand) FROM lineorder"
+    dev = execute_query(segments, sql, use_device=True)
+    host = execute_query(segments, sql, use_device=False)
+    assert dev.rows == host.rows
