@@ -226,8 +226,10 @@ def hll_hash(value) -> int:
 def hll_bucket_rank(value, p: int) -> Tuple[int, int]:
     h = hll_hash(value)
     bucket = h >> (64 - p)
+    # remaining bits shifted to the top of the word; low p bits are zero-filled, so
+    # leading zeros of the 64-bit word count within the (64-p)-bit window
     w = (h << p) & ((1 << 64) - 1)
-    rank = (64 - p) + 1 if w == 0 else (64 - w.bit_length() + 1 - p) + 1
+    rank = (64 - p) + 1 if w == 0 else min(64 - w.bit_length() + 1, (64 - p) + 1)
     return bucket, rank
 
 
