@@ -5,3 +5,8 @@ This is the TPU replacement for the reference's per-segment operator hot loop
 SURVEY.md §3.1): one fused jit program per plan shape computes predicate masks, projected
 expressions and dense-key group-by partials in a single pass over HBM-resident columns.
 """
+
+# Importing these modules populates the transform-function registry (the analog of
+# TransformFunctionFactory + FunctionRegistry static registration).
+from . import datetime_fns as _datetime_fns  # noqa: F401,E402
+from . import string_fns as _string_fns      # noqa: F401,E402

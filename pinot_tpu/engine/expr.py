@@ -210,3 +210,83 @@ def _greatest(xp, *vs):
     for v in vs[1:]:
         out = xp.maximum(out, v)
     return out
+
+
+@register_function("sign")
+def _sign(xp, v):
+    return xp.sign(v)
+
+
+@register_function("truncate")
+def _truncate(xp, v, digits=0):
+    f = 10.0 ** int(digits)
+    return xp.trunc(v * f) / f
+
+
+@register_function("log2")
+def _log2(xp, v):
+    return xp.log2(v)
+
+
+@register_function("log")
+def _log(xp, v):
+    return xp.log(v)
+
+
+for _trig in ("sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh"):
+    _sql_name = _trig.replace("arc", "a")  # SQL: ASIN/ACOS/ATAN
+
+    def _make(tn):
+        def f(xp, v):
+            return getattr(xp, tn)(v)
+        return f
+    register_function(_sql_name)(_make(_trig))
+
+
+@register_function("atan2")
+def _atan2(xp, y, x):
+    return xp.arctan2(y, x)
+
+
+@register_function("degrees")
+def _degrees(xp, v):
+    return xp.degrees(v)
+
+
+@register_function("radians")
+def _radians(xp, v):
+    return xp.radians(v)
+
+
+@register_function("coalesce")
+def _coalesce(xp, *vs):
+    """First non-null argument. Null = NaN for float arrays, None for scalars/objects
+    (nulls surface as NaN on the decoded-value host path; see NullValueVector handling)."""
+    out = vs[0]
+    for v in vs[1:]:
+        if out is None:
+            out = v
+            continue
+        if hasattr(out, "dtype") and np.issubdtype(getattr(out, "dtype"), np.floating):
+            out = xp.where(xp.isnan(out), v, out)
+        elif hasattr(out, "dtype") and out.dtype == object:
+            out = np.asarray([v_ if o is None else o
+                              for o, v_ in zip(out, np.broadcast_to(np.asarray(v, dtype=object),
+                                                                    out.shape))], dtype=object)
+    return out
+
+
+@register_function("nullif")
+def _nullif(xp, a, b):
+    if hasattr(a, "dtype") and np.issubdtype(getattr(a, "dtype"), np.floating):
+        return xp.where(a == b, xp.nan, a)
+    if hasattr(a, "dtype"):
+        if a.dtype == object or (xp is np and not np.issubdtype(a.dtype, np.number)):
+            arr = np.asarray(a, dtype=object).copy()
+            arr[np.asarray(a == b)] = None
+            return arr
+        # integer path: NaN is this module's null representation, so widen to float —
+        # a sentinel in-domain value would collide with legitimate data
+        af = a.astype(np.float64)
+        return xp.where(a == b, xp.nan, af)
+    return None if a == b else a

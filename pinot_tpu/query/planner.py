@@ -28,10 +28,14 @@ from .predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf, compile_filter
 
 MAX_DEVICE_GROUP_KEYS = 1 << 20  # dense-key cap (reference caps group-by at 100k groups)
 
+from ..engine.datetime_fns import DEVICE_DATETIME_FUNCS
+
 _DEVICE_FUNCS = {"plus", "minus", "times", "divide", "mod", "case", "cast", "abs", "ceil",
-                 "floor", "exp", "ln", "log10", "sqrt", "power", "round", "least",
-                 "greatest", "eq", "neq", "gt", "gte", "lt", "lte", "and", "or", "not",
-                 "in", "not_in", "between"}
+                 "floor", "exp", "ln", "log10", "log2", "log", "sqrt", "power", "round",
+                 "least", "greatest", "sign", "truncate", "eq", "neq", "gt", "gte", "lt",
+                 "lte", "and", "or", "not", "in", "not_in", "between", "sin", "cos", "tan",
+                 "asin", "acos", "atan", "sinh", "cosh", "tanh", "atan2", "degrees",
+                 "radians"} | set(DEVICE_DATETIME_FUNCS)
 
 
 @dataclass
