@@ -9,4 +9,5 @@ expressions and dense-key group-by partials in a single pass over HBM-resident c
 # Importing these modules populates the transform-function registry (the analog of
 # TransformFunctionFactory + FunctionRegistry static registration).
 from . import datetime_fns as _datetime_fns  # noqa: F401,E402
+from . import json_fns as _json_fns          # noqa: F401,E402
 from . import string_fns as _string_fns      # noqa: F401,E402

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..query.aggregates import AggFunc
-from ..query.predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf
+from ..query.predicate import CmpLeaf, DocSetLeaf, FilterProgram, LutLeaf, NullLeaf
 from ..sql.ast import Identifier
 from .expr import eval_expr
 
@@ -48,14 +48,18 @@ class KernelSpec:
     # per-leaf runtime input routing, computed in __post_init__
     lut_index: Dict[int, int] = field(default_factory=dict)
     cmp_offset: Dict[int, Tuple[str, int]] = field(default_factory=dict)
+    docset_index: Dict[int, int] = field(default_factory=dict)
 
     def __post_init__(self):
-        luts = 0
+        luts = docsets = 0
         ioff = foff = 0
         for i, leaf in enumerate(self.filter.leaves):
             if isinstance(leaf, LutLeaf):
                 self.lut_index[i] = luts
                 luts += 1
+            elif isinstance(leaf, DocSetLeaf):
+                self.docset_index[i] = docsets
+                docsets += 1
             elif isinstance(leaf, CmpLeaf):
                 if leaf.is_int:
                     self.cmp_offset[i] = ("iscal", ioff)
@@ -89,6 +93,7 @@ class KernelInputs:
     valid: jnp.ndarray
     strides: jnp.ndarray  # i32[G] (empty for scalar aggregation)
     agg_luts: Dict[str, jnp.ndarray] = field(default_factory=dict)  # "<i>.bucket"/"<i>.rank"
+    docsets: Tuple[jnp.ndarray, ...] = ()  # padded bool[P] per DocSetLeaf
 
 
 _KERNEL_CACHE: Dict[Tuple, Any] = {}
@@ -102,10 +107,12 @@ def _make_mask_fn(spec: KernelSpec):
     """Returns mask(ids, vals, luts, iscal, fscal, nulls, valid) -> bool[P] closure."""
     leaves = spec.filter.leaves
 
-    def leaf_mask(i, ids, vals, luts, iscal, fscal, nulls):
+    def leaf_mask(i, ids, vals, luts, iscal, fscal, nulls, docsets):
         leaf = leaves[i]
         if isinstance(leaf, LutLeaf):
             return luts[spec.lut_index[i]][ids[leaf.col]]
+        if isinstance(leaf, DocSetLeaf):
+            return docsets[spec.docset_index[i]]
         if isinstance(leaf, NullLeaf):
             m = nulls[leaf.col]
             return ~m if leaf.negated else m
@@ -147,10 +154,10 @@ def _make_mask_fn(spec: KernelSpec):
             out = (out & m) if kind == "and" else (out | m)
         return out
 
-    def mask_fn(ids, vals, luts, iscal, fscal, nulls, valid):
+    def mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets=()):
         if spec.filter.is_match_all:
             return valid
-        env = (ids, vals, luts, iscal, fscal, nulls)
+        env = (ids, vals, luts, iscal, fscal, nulls, docsets)
         return tree_mask(spec.filter.tree, env, valid) & valid
 
     return mask_fn
@@ -161,8 +168,8 @@ def _build_kernel(spec: KernelSpec):
     num_seg = spec.num_keys_pad + 1  # +1 overflow bucket for masked-out rows
     mask_fn = _make_mask_fn(spec)
 
-    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts):
-        mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid)
+    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts, docsets):
+        mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets)
         out: Dict[str, jnp.ndarray] = {}
 
         if group:
@@ -238,7 +245,7 @@ def get_kernel(spec: KernelSpec):
 def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
     out = get_kernel(spec)(inputs.ids, inputs.vals, inputs.luts, inputs.iscal,
                            inputs.fscal, inputs.nulls, inputs.valid, inputs.strides,
-                           inputs.agg_luts)
+                           inputs.agg_luts, inputs.docsets)
     return {k: np.asarray(v) for k, v in out.items()}
 
 
@@ -248,11 +255,11 @@ def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
     fn = _KERNEL_CACHE.get(key)
     if fn is None:
         mask_fn = _make_mask_fn(spec)
-        fn = jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid:
-                     mask_fn(ids, vals, luts, iscal, fscal, nulls, valid))
+        fn = jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid, docsets:
+                     mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets))
         _KERNEL_CACHE[key] = fn
     out = fn(inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
-             inputs.nulls, inputs.valid)
+             inputs.nulls, inputs.valid, inputs.docsets)
     return np.asarray(out)
 
 

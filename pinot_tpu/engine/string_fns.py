@@ -107,15 +107,7 @@ def _concat(xp, *vs):
 @register_function("concat_ws")
 def _concat_ws(xp, sep, *vs):
     _host_only(xp)
-    arrs = [np.asarray(v) for v in vs]
-    n = max((a.shape[0] for a in arrs if a.ndim), default=0)
-    s = str(sep)
-
-    def at(a, i):
-        return str(a.item() if a.ndim == 0 else a[i])
-    if n == 0:
-        return s.join(str(a.item()) for a in arrs)
-    return np.asarray([s.join(at(a, i) for a in arrs) for i in range(n)], dtype=object)
+    return _zip_join(str(sep), vs)
 
 
 @register_function("trim")

@@ -155,6 +155,9 @@ class MeshQueryExecutor:
         return self._execute_sharded(ctx, plan, segments)
 
     def _alignable(self, plan, segments) -> bool:
+        from ..query.predicate import DocSetLeaf
+        if any(isinstance(l, DocSetLeaf) for l in plan.filter_prog.leaves):
+            return False  # doc-set masks are per-segment; plan[0] can't be reused
         cols = set(plan.group_cols)
         for leaf in plan.filter_prog.leaves:
             if isinstance(leaf, LutLeaf):

@@ -18,7 +18,7 @@ from ..sql.ast import Expr, Function, Identifier, identifiers_in
 from .aggregates import AggFunc, make_agg
 from .context import QueryContext, compile_query
 from .planner import SegmentPlan, build_device_geometry, plan_segment
-from .predicate import CmpLeaf, LutLeaf, NullLeaf
+from .predicate import CmpLeaf, DocSetLeaf, LutLeaf, NullLeaf
 from .reduce import SegmentResult, merge_segment_results, reduce_to_result
 from .result import ResultTable
 
@@ -128,6 +128,7 @@ class ServerQueryExecutor:
         luts = []
         iscal: List[int] = []
         fscal: List[float] = []
+        docsets = []
         for leaf in plan.filter_prog.leaves:
             if isinstance(leaf, LutLeaf):
                 ids_cols.add(leaf.col)
@@ -137,6 +138,10 @@ class ServerQueryExecutor:
                 (iscal if leaf.is_int else fscal).extend(leaf.operands)
             elif isinstance(leaf, NullLeaf):
                 nulls_cols.add(leaf.col)
+            elif isinstance(leaf, DocSetLeaf):
+                padded = np.zeros(block.padded, dtype=bool)
+                padded[:len(leaf.mask)] = leaf.mask
+                docsets.append(jnp.asarray(padded))
         agg_luts: Dict[str, "jnp.ndarray"] = {}
         for i, agg in enumerate(plan.aggs):
             if "distinct" in agg.device_outputs:
@@ -166,6 +171,7 @@ class ServerQueryExecutor:
             valid=valid,
             strides=jnp.asarray(np.asarray(plan.strides, dtype=np.int32)),
             agg_luts=agg_luts,
+            docsets=tuple(docsets),
         )
 
     def _decode_group_partials(self, plan: SegmentPlan, outs) -> SegmentResult:
@@ -337,6 +343,8 @@ def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
             nb = seg.column(leaf.col).null_bitmap
             m = nb if nb is not None else np.zeros(n, dtype=bool)
             return ~m if leaf.negated else m
+        if isinstance(leaf, DocSetLeaf):
+            return leaf.mask[:n]
         assert isinstance(leaf, CmpLeaf)
         v = np.asarray(eval_expr(leaf.expr, env, np))
         ops = leaf.operands

@@ -76,7 +76,28 @@ class NullLeaf:
         return ("null", self.col, self.negated)
 
 
-Leaf = Union[LutLeaf, CmpLeaf, NullLeaf]
+@dataclass
+class DocSetLeaf:
+    """Predicate resolved host-side into a per-doc bitmap: JSON_MATCH / TEXT_MATCH.
+
+    The reference's JsonMatchFilterOperator / TextMatchFilterOperator likewise resolve
+    these against their index into a doc bitmap before the scan; on the device path the
+    bitmap ships as a runtime input (padded bool vector) consumed by one load.
+    """
+    col: str
+    desc: str
+    mask: np.ndarray  # bool[num_docs]
+
+    @property
+    def kind(self) -> str:
+        return "docset"
+
+    def signature(self) -> Tuple:
+        # mask contents are runtime inputs; only structure keys the kernel cache
+        return ("docset", self.col)
+
+
+Leaf = Union[LutLeaf, CmpLeaf, NullLeaf, DocSetLeaf]
 
 
 @dataclass
@@ -136,6 +157,31 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
         if not isinstance(col, Identifier):
             raise QueryValidationError("IS NULL requires a plain column")
         leaves.append(NullLeaf(col.name, negated=(name == "is_not_null")))
+        return ("leaf", len(leaves) - 1)
+    if name in ("json_match", "text_match"):
+        col, arg = e.args[0], e.args[1]
+        if not isinstance(col, Identifier) or not isinstance(arg, Literal):
+            raise QueryValidationError(f"{name.upper()}(column, 'filter') expected: {e!r}")
+        reader = seg.column(col.name)
+        query = str(arg.value)
+        try:
+            if name == "json_match":
+                idx = reader.json_index
+                if idx is not None:
+                    mask = idx.match(query)
+                else:
+                    from ..segment.indexes.jsonidx import json_match_scan
+                    mask = json_match_scan(reader.values(), query)
+            else:
+                idx = reader.text_index
+                if idx is not None:
+                    mask = idx.match(query)
+                else:
+                    from ..segment.indexes.text import text_match_scan
+                    mask = text_match_scan(reader.values(), query)
+        except ValueError as exc:
+            raise QueryValidationError(f"{name.upper()}: {exc}") from exc
+        leaves.append(DocSetLeaf(col.name, query, mask))
         return ("leaf", len(leaves) - 1)
     return _compile_predicate(e, seg, leaves)
 

@@ -52,6 +52,8 @@ NULLS_SUFFIX = ".nulls.npy"
 INVERTED_SUFFIX = ".inv.npz"
 RANGE_SUFFIX = ".rng.npz"
 BLOOM_SUFFIX = ".bloom.npy"
+JSON_SUFFIX = ".json.npz"
+TEXT_SUFFIX = ".text.npz"
 MV_OFFSETS_SUFFIX = ".mvoff.npy"
 
 FORMAT_VERSION = 1

@@ -93,6 +93,18 @@ class ColumnReader:
         return BloomFilterReader(path) if "bloom" in self.index_types else None
 
     @cached_property
+    def json_index(self) -> Optional["JsonIndexReader"]:
+        from .indexes.jsonidx import JsonIndexReader
+        path = self._prefix + fmt.JSON_SUFFIX
+        return JsonIndexReader(path, self.num_docs) if "json" in self.index_types else None
+
+    @cached_property
+    def text_index(self) -> Optional["TextIndexReader"]:
+        from .indexes.text import TextIndexReader
+        path = self._prefix + fmt.TEXT_SUFFIX
+        return TextIndexReader(path, self.num_docs) if "text" in self.index_types else None
+
+    @cached_property
     def null_bitmap(self) -> Optional[np.ndarray]:
         """bool[num_docs] of null positions, or None."""
         if not self.meta.get("hasNulls"):

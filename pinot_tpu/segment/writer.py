@@ -33,6 +33,8 @@ class SegmentGeneratorConfig:
     inverted_index_columns: List[str] = field(default_factory=list)
     range_index_columns: List[str] = field(default_factory=list)
     bloom_filter_columns: List[str] = field(default_factory=list)
+    json_index_columns: List[str] = field(default_factory=list)
+    text_index_columns: List[str] = field(default_factory=list)
     # raw-encode numeric columns whose cardinality exceeds this fraction of num_docs
     raw_cardinality_fraction: float = 0.7
 
@@ -187,6 +189,15 @@ class SegmentBuilder:
             values = dictionary.values if use_dict else raw
             create_bloom_filter(prefix + fmt.BLOOM_SUFFIX, values, data_type)
             indexes.append("bloom")
+
+        if name in self.config.json_index_columns:
+            from .indexes.jsonidx import create_json_index
+            create_json_index(prefix + fmt.JSON_SUFFIX, raw)
+            indexes.append("json")
+        if name in self.config.text_index_columns:
+            from .indexes.text import create_text_index
+            create_text_index(prefix + fmt.TEXT_SUFFIX, raw)
+            indexes.append("text")
 
         if null_mask is not None and null_mask.any():
             np.save(prefix + fmt.NULLS_SUFFIX, fmt.pack_bitmap(null_mask))
