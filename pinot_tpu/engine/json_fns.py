@@ -32,12 +32,22 @@ def parse_json_path(path: str) -> List[Any]:
             i = j
         elif c == "[":
             j = path.index("]", i)
-            tok = path[i + 1:j].strip("'\"")
-            out.append("*" if tok == "*" else int(tok))
+            raw = path[i + 1:j]
+            tok = raw.strip("'\"")
+            if tok == "*":
+                out.append("*")
+            elif raw != tok or not _is_int(tok):
+                out.append(tok)  # quoted (or non-numeric) bracket token -> dict key
+            else:
+                out.append(int(tok))
             i = j + 1
         else:
             raise ValueError(f"bad json path {path!r} at {i}")
     return [p for p in out if p != ""]
+
+
+def _is_int(s: str) -> bool:
+    return s.lstrip("-").isdigit()
 
 
 def extract_path(obj: Any, steps: List[Any]) -> Any:

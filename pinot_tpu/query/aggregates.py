@@ -297,14 +297,7 @@ class PercentileAgg(AggFunc):
 
     def __init__(self, call: Function):
         super().__init__(call)
-        if call.name.startswith("percentile") and call.name[10:].isdigit():
-            self.pct = float(call.name[10:])
-        elif len(call.args) >= 2:
-            from ..sql.ast import Literal
-            assert isinstance(call.args[1], Literal)
-            self.pct = float(call.args[1].value)
-        else:
-            raise QueryValidationError(f"{call.name} needs a percentile argument")
+        self.pct = _parse_percentile(call, "percentile")
 
     def device_ok(self, ctx: AggContext) -> bool:
         return False
@@ -317,6 +310,127 @@ class PercentileAgg(AggFunc):
 
     def finalize(self, state):
         return None if len(state) == 0 else float(np.percentile(state, self.pct))
+
+
+def _parse_percentile(call: Function, base: str) -> float:
+    """`<base>NN(col)` suffix form or `<base>(col, NN)` argument form."""
+    if call.name.startswith(base) and call.name[len(base):].isdigit():
+        return float(call.name[len(base):])
+    if len(call.args) >= 2:
+        from ..sql.ast import Literal
+        assert isinstance(call.args[1], Literal)
+        return float(call.args[1].value)
+    raise QueryValidationError(f"{call.name} needs a percentile argument")
+
+
+class DistinctCountThetaAgg(AggFunc):
+    """DISTINCTCOUNTTHETASKETCH — KMV theta sketch state (`sketches.ThetaSketch`).
+
+    Reference: DistinctCountThetaSketchAggregationFunction (DataSketches theta). On the
+    device path over a dict column the exact present-id set comes back from the kernel
+    (same output as DISTINCTCOUNT); the sketch is built from the surviving dictionary
+    values host-side — cardinality-sized work, not row-sized.
+    """
+    name = "distinctcountthetasketch"
+    device_outputs = ("distinct",)
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        from ..sql.ast import Literal
+        self.k = 4096
+        if len(call.args) >= 2 and isinstance(call.args[1], Literal):
+            # reference accepts 'nominalEntries=NNNN' parameter strings
+            s = str(call.args[1].value)
+            if "=" in s:
+                self.k = int(s.split("=", 1)[1])
+            elif s.isdigit():
+                self.k = int(s)
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return not ctx.group_by and ctx.arg_is_dict_column
+
+    @staticmethod
+    def _canonical(values) -> np.ndarray:
+        """One hash domain per logical type across segments AND device/host paths (the
+        device path yields python ints where the host path sees the column dtype).
+        Integers stay integral — float64 would collapse distinct int64s above 2^53."""
+        arr = np.asarray(list(values) if isinstance(values, set) else values)
+        if arr.dtype.kind in "iub":
+            return arr.astype(np.int64)
+        if arr.dtype.kind == "f":
+            return arr.astype(np.float64)
+        return arr
+
+    def _normalize(self, state):
+        from .sketches import ThetaSketch
+        if isinstance(state, set):  # device path returns the exact value set
+            return ThetaSketch.from_values(self._canonical(state), self.k)
+        return state
+
+    def host_state(self, values):
+        from .sketches import ThetaSketch
+        return ThetaSketch.from_values(self._canonical(values), self.k)
+
+    def merge(self, a, b):
+        return self._normalize(a).union(self._normalize(b))
+
+    def finalize(self, state):
+        return int(round(self._normalize(state).estimate()))
+
+    def empty_result(self):
+        return 0
+
+
+class DistinctCountRawThetaAgg(DistinctCountThetaAgg):
+    """DISTINCTCOUNTRAWTHETASKETCH — returns the serialized sketch (hex) instead of the
+    estimate, for client-side set operations (reference: ...RawThetaSketchAggregationFunction)."""
+    name = "distinctcountrawthetasketch"
+
+    def finalize(self, state):
+        return self._normalize(state).to_bytes().hex()
+
+    def empty_result(self):
+        from .sketches import ThetaSketch
+        return ThetaSketch(self.k).to_bytes().hex()
+
+
+class PercentileTDigestAgg(AggFunc):
+    """PERCENTILETDIGEST / PERCENTILETDIGESTNN — merging t-digest state.
+
+    Reference: PercentileTDigestAggregationFunction (com.tdunning TDigest). Bounded-size
+    mergeable state — unlike PercentileAgg's exact value buffer, this flows through
+    multi-host reduce without shipping raw rows.
+    """
+    name = "percentiletdigest"
+    COMPRESSION = 100.0
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        self.pct = _parse_percentile(call, self.name)
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def host_state(self, values):
+        from .sketches import TDigest
+        return TDigest.from_values(values, self.COMPRESSION)
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def finalize(self, state):
+        q = state.quantile(self.pct / 100.0)
+        return None if q is None else float(q)
+
+
+class PercentileEstAgg(PercentileTDigestAgg):
+    """PERCENTILEEST — approximate long-valued percentile (reference uses QuantileDigest;
+    here the same t-digest state with integer extraction)."""
+    name = "percentileest"
+
+    def finalize(self, state):
+        q = state.quantile(self.pct / 100.0)
+        return None if q is None else int(round(q))
 
 
 class ModeAgg(AggFunc):
@@ -352,8 +466,10 @@ _REGISTRY = {
     "distinctcounthll": DistinctCountHLLAgg,
     "mode": ModeAgg,
     "percentile": PercentileAgg,
-    "percentileest": PercentileAgg,
-    "percentiletdigest": PercentileAgg,  # exact values stand in for the tdigest sketch
+    "percentileest": PercentileEstAgg,
+    "percentiletdigest": PercentileTDigestAgg,
+    "distinctcountthetasketch": DistinctCountThetaAgg,
+    "distinctcountrawthetasketch": DistinctCountRawThetaAgg,
 }
 
 
@@ -362,8 +478,11 @@ def make_agg(call: Function) -> AggFunc:
     if call.name == "count" and call.distinct:
         # COUNT(DISTINCT x) -> DISTINCTCOUNT(x), reference does the same rewrite
         return DistinctCountAgg(Function("distinctcount", call.args))
-    if name.startswith("percentile") and name[10:].isdigit():
-        return PercentileAgg(call)
+    for prefix, cls in (("percentiletdigest", PercentileTDigestAgg),
+                        ("percentileest", PercentileEstAgg),
+                        ("percentile", PercentileAgg)):
+        if name.startswith(prefix) and name[len(prefix):].isdigit():
+            return cls(call)
     cls = _REGISTRY.get(name)
     if cls is None:
         raise QueryValidationError(f"unsupported aggregation function {name!r}")

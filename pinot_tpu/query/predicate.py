@@ -159,27 +159,29 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
         leaves.append(NullLeaf(col.name, negated=(name == "is_not_null")))
         return ("leaf", len(leaves) - 1)
     if name in ("json_match", "text_match"):
-        col, arg = e.args[0], e.args[1]
-        if not isinstance(col, Identifier) or not isinstance(arg, Literal):
+        if len(e.args) != 2 or not isinstance(e.args[0], Identifier) \
+                or not isinstance(e.args[1], Literal):
             raise QueryValidationError(f"{name.upper()}(column, 'filter') expected: {e!r}")
+        col, arg = e.args[0], e.args[1]
         reader = seg.column(col.name)
         query = str(arg.value)
         try:
             if name == "json_match":
-                idx = reader.json_index
+                # mutable (realtime) column readers carry no aux indexes -> scan fallback
+                idx = getattr(reader, "json_index", None)
                 if idx is not None:
                     mask = idx.match(query)
                 else:
                     from ..segment.indexes.jsonidx import json_match_scan
                     mask = json_match_scan(reader.values(), query)
             else:
-                idx = reader.text_index
+                idx = getattr(reader, "text_index", None)
                 if idx is not None:
                     mask = idx.match(query)
                 else:
                     from ..segment.indexes.text import text_match_scan
                     mask = text_match_scan(reader.values(), query)
-        except ValueError as exc:
+        except (ValueError, AssertionError, IndexError, KeyError) as exc:
             raise QueryValidationError(f"{name.upper()}: {exc}") from exc
         leaves.append(DocSetLeaf(col.name, query, mask))
         return ("leaf", len(leaves) - 1)

@@ -9,10 +9,11 @@ and resolved entirely against posting lists into ONE doc-id bitmap host-side —
 kernel then consumes it as a precomputed mask (DocSetLeaf), exactly how the reference's
 JsonMatchFilterOperator produces a bitmap before the scan.
 
-Key layout: keys are `"<path>\\x00<value>"` strings plus `"<path>\\x01"` presence keys,
-sorted, with CSR postings — range predicates over a path binary-search the contiguous
-key run for that path and union the matching slices. Keys persist as one utf-8 blob with
-an offsets array (length-delimited — key text may contain any codepoint).
+Key layout: keys are `"<path>\\x00<value>"` strings plus `"<path>\\x01"` presence keys
+(the presence key sorts just after the path's value-key run), sorted, with CSR postings —
+range predicates over a path binary-search the contiguous key run for that path and union
+the matching slices. Keys persist as one utf-8 blob with an offsets array
+(length-delimited — key text may contain any codepoint).
 """
 
 from __future__ import annotations
@@ -126,19 +127,35 @@ class JsonIndexReader:
 
     def mask_for_key(self, path: str, value: Any) -> np.ndarray:
         m = np.zeros(self.num_docs, dtype=bool)
-        if isinstance(value, bool):
-            value = "true" if value else "false"
-        forms = [str(value)]
         # numeric literals serialize as either 1 or 1.0 depending on the source doc; a
-        # mixed corpus needs BOTH forms unioned
-        if isinstance(value, (int, float)) and not isinstance(value, bool):
+        # mixed corpus needs both forms unioned (_forms yields both)
+        for f in self._forms(value):
+            i = self._find(path + SEP + f)
+            if i >= 0:
+                m[self._postings_at(i)] = True
+        return m
+
+    @staticmethod
+    def _forms(value: Any) -> List[str]:
+        if isinstance(value, bool):
+            return ["true" if value else "false"]
+        forms = [str(value)]
+        if isinstance(value, (int, float)):
             if isinstance(value, int):
                 forms.append(str(float(value)))
             elif value == int(value):
                 forms.append(str(int(value)))
-        for f in forms:
-            i = self._find(path + SEP + f)
-            if i >= 0:
+        return forms
+
+    def mask_for_not_values(self, path: str, values: List[Any]) -> np.ndarray:
+        """Docs where SOME flattened record at `path` has a value outside `values` —
+        the reference evaluates <> / NOT IN per flattened record, so a doc with array
+        values [1, 2] matches `<> 1` (record 2 satisfies it)."""
+        excluded = {f for v in values for f in self._forms(v)}
+        lo, hi = self._key_run(path)
+        m = np.zeros(self.num_docs, dtype=bool)
+        for i in range(lo, hi):
+            if self._keys[i].split(SEP, 1)[1] not in excluded:
                 m[self._postings_at(i)] = True
         return m
 
@@ -203,12 +220,14 @@ class JsonIndexReader:
         if name == "eq":
             return self.mask_for_key(p, values[0])
         if name == "neq":
-            return self.mask_for_presence(p) & ~self.mask_for_key(p, values[0])
-        if name in ("in", "not_in"):
+            return self.mask_for_not_values(p, values)
+        if name == "not_in":
+            return self.mask_for_not_values(p, values)
+        if name == "in":
             m = np.zeros(self.num_docs, dtype=bool)
             for v in values:
                 m |= self.mask_for_key(p, v)
-            return (self.mask_for_presence(p) & ~m) if name == "not_in" else m
+            return m
         if name in ("gt", "gte", "lt", "lte"):
             return self.mask_for_range(p, name, values[0])
         if name == "between":

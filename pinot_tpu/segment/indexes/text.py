@@ -190,6 +190,11 @@ class _QueryParser:
             if t and t[0] == "OR":
                 self.i += 1
                 left = left | self._and()
+            elif t and t[0] == "NOT":
+                # Lucene semantics: a bare NOT clause is a must_not on the enclosing
+                # boolean query — 'a NOT b' means a AND NOT b, not a OR (NOT b)
+                self.i += 1
+                left = left & ~self._unary()
             elif t and t[0] not in (")",) and t[0] != "AND":
                 # implicit OR between adjacent terms (Lucene default operator OR)
                 left = left | self._and()

@@ -57,6 +57,7 @@ FILTER_FUNCTIONS = COMPARISONS | LOGICAL | MEMBERSHIP
 AGGREGATION_FUNCTIONS = {
     "count", "sum", "min", "max", "avg", "minmaxrange",
     "distinctcount", "distinctcounthll", "distinctcountbitmap",
+    "distinctcountthetasketch", "distinctcountrawthetasketch",
     "percentile", "percentileest", "percentiletdigest",
     "sumprecision", "mode",
 }

@@ -217,6 +217,51 @@ def test_text_match_unterminated_quote_is_validation_error(jenv):
         execute_query([seg], "SELECT COUNT(*) FROM people WHERE TEXT_MATCH(doc, '\"oops')")
 
 
+def test_text_match_bare_not_is_must_not(jenv):
+    from pinot_tpu.segment.indexes.text import text_match_scan
+    docs = ["apple pie", "banana split", "cherry cake"]
+    # Lucene: 'apple NOT banana' == apple AND NOT banana
+    np.testing.assert_array_equal(text_match_scan(docs, "apple NOT banana"),
+                                  [True, False, False])
+
+
+def test_json_match_neq_flattened_record_semantics(jenv):
+    from pinot_tpu.segment.indexes.jsonidx import json_match_scan
+    docs = ['{"arr":[{"x":1},{"x":2}]}', '{"arr":[{"x":3}]}', '{"arr":[{"x":1}]}']
+    # per flattened record: doc 0 has a record with x=2 (satisfies <> 1)
+    np.testing.assert_array_equal(json_match_scan(docs, '"$.arr[*].x" <> 1'),
+                                  [True, True, False])
+
+
+def test_json_extract_quoted_bracket_key():
+    from pinot_tpu.engine.expr import eval_expr
+    from pinot_tpu.sql.parser import Parser
+    e = Parser("SELECT json_extract_scalar(js, '$.a[''b'']', 'STRING', 'd') FROM t") \
+        .parse().select[0][0]
+    got = eval_expr(e, {"js": np.asarray(['{"a": {"b": "v"}}'], dtype=object)})
+    assert list(got) == ["v"]
+
+
+def test_json_match_malformed_is_validation_error(jenv):
+    from pinot_tpu.query.context import QueryValidationError
+    seg, _, _, _, _ = jenv
+    with pytest.raises(QueryValidationError):
+        execute_query([seg], "SELECT COUNT(*) FROM people WHERE "
+                      "JSON_MATCH(js, '''a'' = ''b''')")
+
+
+def test_json_match_on_mutable_segment(jenv):
+    """Mutable readers have no json_index attr -> must fall back to the scan path."""
+    from pinot_tpu.schema import DataType, Schema, dimension
+    from pinot_tpu.segment.mutable import MutableSegment
+    schema = Schema("m", [dimension("js", DataType.JSON)])
+    seg = MutableSegment("m__0", schema)
+    for i in range(10):
+        seg.index({"js": f'{{"a": {i % 3}}}'})
+    res = execute_query([seg], "SELECT COUNT(*) FROM m WHERE JSON_MATCH(js, '\"$.a\" = 1')")
+    assert int(res.rows[0][0]) == sum(1 for i in range(10) if i % 3 == 1)
+
+
 def test_text_match_selection(jenv):
     seg, _, _, texts, _ = jenv
     res = execute_query([seg], "SELECT doc FROM people WHERE "

@@ -1,0 +1,166 @@
+"""Theta sketch and t-digest: unit accuracy + merge associativity + e2e query paths.
+
+Reference analogs: DistinctCountThetaSketchQueriesTest, PercentileTDigestQueriesTest
+(pinot-core/src/test/.../queries/)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import execute_query
+from pinot_tpu.query.sketches import TDigest, ThetaSketch
+
+from conftest import make_ssb_columns
+
+
+def test_theta_exact_below_k():
+    v = np.arange(1000)
+    sk = ThetaSketch.from_values(v, k=4096)
+    assert sk.estimate() == 1000
+
+
+def test_theta_approx_above_k():
+    rng = np.random.default_rng(0)
+    v = rng.integers(0, 10**12, 200_000)
+    true = len(np.unique(v))
+    sk = ThetaSketch.from_values(v, k=4096)
+    assert sk.estimate() == pytest.approx(true, rel=0.05)
+
+
+def test_theta_merge_matches_bulk():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 10**9, 50_000)
+    b = rng.integers(0, 10**9, 50_000)
+    merged = ThetaSketch.from_values(a, 2048).union(ThetaSketch.from_values(b, 2048))
+    true = len(np.unique(np.concatenate([a, b])))
+    assert merged.estimate() == pytest.approx(true, rel=0.08)
+
+
+def test_theta_set_operations():
+    a = ThetaSketch.from_values(np.arange(0, 1000), 4096)
+    b = ThetaSketch.from_values(np.arange(500, 1500), 4096)
+    assert a.intersect(b).estimate() == pytest.approx(500, rel=0.01)
+    assert a.a_not_b(b).estimate() == pytest.approx(500, rel=0.01)
+    assert a.union(b).estimate() == pytest.approx(1500, rel=0.01)
+
+
+def test_theta_serialization_roundtrip():
+    sk = ThetaSketch.from_values(np.arange(10_000), 1024)
+    back = ThetaSketch.from_bytes(sk.to_bytes())
+    assert back.estimate() == pytest.approx(sk.estimate())
+    assert back.theta == sk.theta
+
+
+def test_tdigest_quantiles():
+    rng = np.random.default_rng(2)
+    v = rng.normal(100, 15, 100_000)
+    td = TDigest.from_values(v)
+    for q in (0.01, 0.25, 0.5, 0.75, 0.99):
+        assert td.quantile(q) == pytest.approx(np.quantile(v, q), abs=1.0)
+
+
+def test_tdigest_merge():
+    rng = np.random.default_rng(3)
+    parts = [rng.uniform(0, 1000, 20_000) for _ in range(5)]
+    td = TDigest.from_values(parts[0])
+    for p in parts[1:]:
+        td = td.merge(TDigest.from_values(p))
+    allv = np.concatenate(parts)
+    assert td.quantile(0.5) == pytest.approx(np.quantile(allv, 0.5), rel=0.02)
+    assert td.quantile(0.95) == pytest.approx(np.quantile(allv, 0.95), rel=0.02)
+
+
+def test_tdigest_serialization_roundtrip():
+    td = TDigest.from_values(np.arange(1000, dtype=float))
+    back = TDigest.from_bytes(td.to_bytes())
+    assert back.quantile(0.5) == td.quantile(0.5)
+
+
+def test_tdigest_bounded_size():
+    td = TDigest.from_values(np.random.default_rng(4).uniform(0, 1, 500_000))
+    assert len(td.means) < 200  # compression=100 keeps ~O(compression) centroids
+
+
+# -- end-to-end through the engine --------------------------------------------
+
+@pytest.fixture(scope="module")
+def senv(tmp_path_factory):
+    from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, SegmentGeneratorConfig, load_segment
+    rng = np.random.default_rng(9)
+    out = tmp_path_factory.mktemp("sketchseg")
+    from conftest import make_ssb_columns
+    schema = Schema("lineorder", [
+        dimension("lo_orderkey", DataType.LONG),
+        dimension("lo_custkey", DataType.INT),
+        dimension("lo_region", DataType.STRING),
+        dimension("lo_category", DataType.STRING),
+        dimension("lo_brand", DataType.STRING),
+        date_time("lo_orderdate", DataType.INT),
+        metric("lo_quantity", DataType.INT),
+        metric("lo_extendedprice", DataType.DOUBLE),
+        metric("lo_discount", DataType.INT),
+        metric("lo_revenue", DataType.DOUBLE),
+    ])
+    builder = SegmentBuilder(schema, SegmentGeneratorConfig())
+    cols_a = make_ssb_columns(rng, 3000)
+    cols_b = make_ssb_columns(rng, 3000)
+    segs = [
+        __import__("pinot_tpu.segment", fromlist=["load_segment"]).load_segment(
+            builder.build(c, str(out), f"lineorder_{i}"))
+        for i, c in enumerate((cols_a, cols_b))]
+    allcols = {k: np.concatenate([np.asarray(cols_a[k]), np.asarray(cols_b[k])])
+               for k in cols_a}
+    return segs, allcols
+
+
+def test_theta_query_vs_exact(senv):
+    segs, cols = senv
+    res = execute_query(segs, "SELECT DISTINCTCOUNTTHETASKETCH(lo_custkey) FROM lineorder")
+    true = len(np.unique(cols["lo_custkey"]))
+    assert int(res.rows[0][0]) == pytest.approx(true, rel=0.05)
+
+
+def test_theta_query_string_column(senv):
+    segs, cols = senv
+    res = execute_query(segs, "SELECT DISTINCTCOUNTTHETASKETCH(lo_brand) FROM lineorder "
+                        "WHERE lo_quantity > 25")
+    mask = cols["lo_quantity"] > 25
+    true = len(set(np.asarray(cols["lo_brand"])[mask]))
+    assert int(res.rows[0][0]) == true  # below k -> exact
+
+
+def test_raw_theta_query_returns_sketch(senv):
+    from pinot_tpu.query.sketches import ThetaSketch
+    segs, cols = senv
+    res = execute_query(segs,
+                        "SELECT DISTINCTCOUNTRAWTHETASKETCH(lo_custkey) FROM lineorder")
+    sk = ThetaSketch.from_bytes(bytes.fromhex(res.rows[0][0]))
+    true = len(np.unique(cols["lo_custkey"]))
+    assert sk.estimate() == pytest.approx(true, rel=0.05)
+
+
+def test_percentile_tdigest_query(senv):
+    segs, cols = senv
+    res = execute_query(
+        segs, "SELECT PERCENTILETDIGEST(lo_extendedprice, 95), "
+              "PERCENTILETDIGEST50(lo_extendedprice) FROM lineorder")
+    v = cols["lo_extendedprice"]
+    assert res.rows[0][0] == pytest.approx(np.percentile(v, 95), rel=0.02)
+    assert res.rows[0][1] == pytest.approx(np.percentile(v, 50), rel=0.02)
+
+
+def test_percentile_est_query(senv):
+    segs, cols = senv
+    res = execute_query(segs, "SELECT PERCENTILEEST90(lo_quantity) FROM lineorder")
+    assert res.rows[0][0] == pytest.approx(np.percentile(cols["lo_quantity"], 90), abs=2)
+
+
+def test_tdigest_group_by(senv):
+    segs, cols = senv
+    res = execute_query(
+        segs, "SELECT lo_region, PERCENTILETDIGEST(lo_revenue, 50) FROM lineorder "
+              "GROUP BY lo_region ORDER BY lo_region")
+    regions = np.asarray(cols["lo_region"])
+    for region, got in res.rows:
+        want = np.percentile(cols["lo_revenue"][regions == region], 50)
+        assert got == pytest.approx(want, rel=0.05)
