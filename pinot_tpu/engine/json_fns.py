@@ -17,8 +17,18 @@ import numpy as np
 from .expr import register_function
 
 
+class _Wildcard:
+    """Sentinel for the [*] / .* path step (distinct from a key literally named '*')."""
+
+    def __repr__(self) -> str:
+        return "*"
+
+
+WILDCARD = _Wildcard()
+
+
 def parse_json_path(path: str) -> List[Any]:
-    """'$.a.b[3][*].c' -> ['a', 'b', 3, '*', 'c']."""
+    """'$.a.b[3][*].c' -> ['a', 'b', 3, WILDCARD, 'c']."""
     assert path.startswith("$"), f"json path must start with $: {path!r}"
     out: List[Any] = []
     i = 1
@@ -28,14 +38,15 @@ def parse_json_path(path: str) -> List[Any]:
             j = i + 1
             while j < len(path) and path[j] not in ".[":
                 j += 1
-            out.append(path[i + 1:j])
+            seg = path[i + 1:j]
+            out.append(WILDCARD if seg == "*" else seg)
             i = j
         elif c == "[":
             j = path.index("]", i)
             raw = path[i + 1:j]
             tok = raw.strip("'\"")
-            if tok == "*":
-                out.append("*")
+            if raw == "*":
+                out.append(WILDCARD)  # quoted ['*'] stays a literal dict key
             elif raw != tok or not _is_int(tok):
                 out.append(tok)  # quoted (or non-numeric) bracket token -> dict key
             else:
@@ -51,12 +62,12 @@ def _is_int(s: str) -> bool:
 
 
 def extract_path(obj: Any, steps: List[Any]) -> Any:
-    """Walk parsed JSON; '*' fans out into a list of matches."""
+    """Walk parsed JSON; WILDCARD fans out into a list of matches."""
     cur: List[Any] = [obj]
     for s in steps:
         nxt: List[Any] = []
         for o in cur:
-            if s == "*":
+            if s is WILDCARD:
                 if isinstance(o, list):
                     nxt.extend(o)
                 elif isinstance(o, dict):

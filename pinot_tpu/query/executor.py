@@ -47,6 +47,16 @@ class ServerQueryExecutor:
     # -- per-segment execution --------------------------------------------
     def execute_segment(self, ctx: QueryContext, segment: ImmutableSegment,
                         valid_docs: Optional[np.ndarray] = None) -> SegmentResult:
+        # star-tree rewrite (not under an upsert valid-doc mask: pre-aggregated
+        # records cannot honor per-doc visibility, same restriction as the reference)
+        if valid_docs is None and not getattr(segment, "is_mutable", False):
+            from .startree_exec import reassemble, try_star_tree
+            stp = try_star_tree(ctx, segment)
+            if stp is not None:
+                sub = self.execute_segment(stp.ctx2, stp.tree.view,
+                                           valid_docs=stp.record_mask)
+                reassemble(stp, sub)
+                return sub
         plan = plan_segment(ctx, segment, valid_docs)
         if not self.use_device and plan.kind == "device":
             plan.kind = "host"

@@ -6,9 +6,10 @@ TPU-native redesign of the reference's `pinot-segment-spi` + `pinot-segment-loca
 
 from .dictionary import Dictionary, build_dictionary
 from .reader import ColumnReader, ImmutableSegment, load_segment
+from .startree import StarTreeIndexConfig, build_star_tree
 from .writer import SegmentBuilder, SegmentGeneratorConfig
 
 __all__ = [
     "Dictionary", "build_dictionary", "ColumnReader", "ImmutableSegment", "load_segment",
-    "SegmentBuilder", "SegmentGeneratorConfig",
+    "SegmentBuilder", "SegmentGeneratorConfig", "StarTreeIndexConfig", "build_star_tree",
 ]

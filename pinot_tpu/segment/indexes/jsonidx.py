@@ -119,10 +119,13 @@ class JsonIndexReader:
         return -1
 
     def _key_run(self, path: str) -> Tuple[int, int]:
-        """[lo, hi) of value-keys for a path (contiguous in the sorted key array)."""
+        """[lo, hi) of value-keys for a path (contiguous in the sorted key array).
+
+        Upper bound is the successor of the separator character so the run covers
+        every value string, including code points above U+FFFF."""
         import bisect
         lo = bisect.bisect_left(self._keys, path + SEP)
-        hi = bisect.bisect_left(self._keys, path + SEP + "￿")
+        hi = bisect.bisect_left(self._keys, path + chr(ord(SEP) + 1))
         return lo, hi
 
     def mask_for_key(self, path: str, value: Any) -> np.ndarray:

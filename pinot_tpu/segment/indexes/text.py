@@ -184,22 +184,31 @@ class _QueryParser:
         return self._or()
 
     def _or(self) -> np.ndarray:
-        left = self._and()
+        # Lucene boolean-clause semantics: SHOULD clauses union (implicit or explicit
+        # OR), and every bare NOT clause is a must_not on the whole enclosing query —
+        # 'a NOT b c' means (a OR c) AND NOT b, regardless of clause order.
+        pos: Optional[np.ndarray] = None
+        neg: Optional[np.ndarray] = None
         while True:
             t = self._peek()
-            if t and t[0] == "OR":
+            if t is None or t[0] == ")":
+                break
+            if t[0] in ("OR", "AND"):
+                # AND binds inside _and(); a stray leading AND degrades to OR
                 self.i += 1
-                left = left | self._and()
-            elif t and t[0] == "NOT":
-                # Lucene semantics: a bare NOT clause is a must_not on the enclosing
-                # boolean query — 'a NOT b' means a AND NOT b, not a OR (NOT b)
+                continue
+            if t[0] == "NOT":
                 self.i += 1
-                left = left & ~self._unary()
-            elif t and t[0] not in (")",) and t[0] != "AND":
-                # implicit OR between adjacent terms (Lucene default operator OR)
-                left = left | self._and()
-            else:
-                return left
+                c = self._unary()
+                neg = c if neg is None else (neg | c)
+                continue
+            c = self._and()
+            pos = c if pos is None else (pos | c)
+        if pos is None:
+            # pure must_not ('NOT b'): everything except the excluded docs
+            pos = np.ones(self.index.num_docs, dtype=bool) if neg is not None \
+                else np.zeros(self.index.num_docs, dtype=bool)
+        return pos & ~neg if neg is not None else pos
 
     def _and(self) -> np.ndarray:
         left = self._unary()

@@ -137,6 +137,11 @@ class ImmutableSegment:
     def column_names(self) -> List[str]:
         return list(self.metadata["columns"].keys())
 
+    @cached_property
+    def star_trees(self) -> List["StarTree"]:
+        from .startree import load_star_trees
+        return load_star_trees(self)
+
     def __repr__(self) -> str:
         return f"ImmutableSegment({self.name!r}, docs={self.num_docs})"
 

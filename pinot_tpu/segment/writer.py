@@ -37,6 +37,8 @@ class SegmentGeneratorConfig:
     text_index_columns: List[str] = field(default_factory=list)
     # raw-encode numeric columns whose cardinality exceeds this fraction of num_docs
     raw_cardinality_fraction: float = 0.7
+    # star-tree pre-aggregation configs (segment/startree.py StarTreeIndexConfig)
+    star_tree_configs: List["StarTreeIndexConfig"] = field(default_factory=list)
 
 
 class SegmentBuilder:
@@ -91,6 +93,12 @@ class SegmentBuilder:
             "creationTimeMs": int(time.time() * 1000),
             "crc": fmt.segment_crc(seg_dir),
         })
+        if self.config.star_tree_configs:
+            from .reader import load_segment
+            from .startree import build_star_tree
+            built = load_segment(seg_dir)
+            for i, st_cfg in enumerate(self.config.star_tree_configs):
+                build_star_tree(built, st_cfg, i)
         return seg_dir
 
     # ------------------------------------------------------------------

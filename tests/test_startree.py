@@ -1,0 +1,161 @@
+"""Star-tree index: build correctness + query-rewrite equivalence.
+
+Reference pattern: `StarTreeV2BuilderTest` + star-tree query suites compare star-tree
+answers against the scan path over the same data. Here every fitting query must return
+bit-identical group keys and numerically-equal aggregates with and without the tree,
+and must scan fewer (pre-aggregated) records.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.context import compile_query
+from pinot_tpu.query.executor import ServerQueryExecutor, execute_query
+from pinot_tpu.query.startree_exec import try_star_tree
+from pinot_tpu.segment import (SegmentBuilder, SegmentGeneratorConfig,
+                               StarTreeIndexConfig, load_segment)
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+
+from conftest import make_ssb_columns
+
+
+@pytest.fixture(scope="module")
+def st_env(tmp_path_factory, ssb_schema):
+    """The same data built twice: with star-trees and without (the oracle)."""
+    rng = np.random.default_rng(11)
+    out = tmp_path_factory.mktemp("st")
+    cols = make_ssb_columns(rng, 5000)
+    st_cfg = StarTreeIndexConfig(
+        dimensions_split_order=["lo_region", "lo_category", "lo_discount"],
+        function_column_pairs=["SUM__lo_revenue", "AVG__lo_quantity",
+                               "MIN__lo_extendedprice", "MAX__lo_extendedprice",
+                               "MINMAXRANGE__lo_extendedprice"],
+        max_leaf_records=10,
+    )
+    with_tree = load_segment(SegmentBuilder(ssb_schema, SegmentGeneratorConfig(
+        star_tree_configs=[st_cfg])).build(cols, str(out), "st_seg"))
+    plain = load_segment(SegmentBuilder(ssb_schema).build(cols, str(out), "plain_seg"))
+    return with_tree, plain
+
+
+FITTING_QUERIES = [
+    "SELECT lo_region, SUM(lo_revenue) FROM lineorder GROUP BY lo_region",
+    "SELECT lo_region, lo_category, SUM(lo_revenue), COUNT(*) FROM lineorder "
+    "GROUP BY lo_region, lo_category",
+    "SELECT SUM(lo_revenue), COUNT(*) FROM lineorder WHERE lo_region = 'ASIA'",
+    "SELECT lo_category, AVG(lo_quantity) FROM lineorder "
+    "WHERE lo_region IN ('ASIA', 'EUROPE') GROUP BY lo_category",
+    "SELECT lo_region, MIN(lo_extendedprice), MAX(lo_extendedprice) FROM lineorder "
+    "WHERE lo_discount BETWEEN 2 AND 7 GROUP BY lo_region",
+    "SELECT MINMAXRANGE(lo_extendedprice) FROM lineorder WHERE lo_category = 'MFGR#2'",
+    "SELECT lo_discount, COUNT(*) FROM lineorder WHERE lo_region <> 'AFRICA' "
+    "GROUP BY lo_discount",
+    # OR across dimensions: no child pruning, but still answerable from the tree
+    "SELECT COUNT(*) FROM lineorder WHERE lo_region = 'ASIA' OR lo_category = 'MFGR#1'",
+]
+
+
+def _rows_match(a, b):
+    sa = sorted([tuple(r) for r in a], key=repr)
+    sb = sorted([tuple(r) for r in b], key=repr)
+    assert len(sa) == len(sb), f"{len(sa)} != {len(sb)}"
+    for ra, rb in zip(sa, sb):
+        assert len(ra) == len(rb)
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                assert va == pytest.approx(vb, rel=1e-4, abs=1e-4)
+            else:
+                assert va == vb
+
+
+@pytest.mark.parametrize("sql", FITTING_QUERIES)
+def test_startree_matches_scan(st_env, sql):
+    with_tree, plain = st_env
+    got = execute_query([with_tree], sql)
+    want = execute_query([plain], sql)
+    _rows_match(got.rows, want.rows)
+    # the tree must actually be used and must scan fewer records than raw docs
+    assert got.stats["numDocsScanned"] < want.stats["numDocsScanned"]
+
+
+def test_fit_detection(st_env):
+    with_tree, plain = st_env
+    sch = with_tree.schema
+    fit = compile_query(
+        "SELECT lo_region, SUM(lo_revenue) FROM lineorder GROUP BY lo_region", sch)
+    assert try_star_tree(fit, with_tree) is not None
+    assert try_star_tree(fit, plain) is None
+    # group-by on a non-tree dimension: no fit
+    nofit = compile_query(
+        "SELECT lo_brand, SUM(lo_revenue) FROM lineorder GROUP BY lo_brand", sch)
+    assert try_star_tree(nofit, with_tree) is None
+    # unsupported aggregation: no fit
+    nofit2 = compile_query(
+        "SELECT lo_region, DISTINCTCOUNT(lo_custkey) FROM lineorder GROUP BY lo_region",
+        sch)
+    assert try_star_tree(nofit2, with_tree) is None
+    # filter on a non-tree column: no fit
+    nofit3 = compile_query(
+        "SELECT SUM(lo_revenue) FROM lineorder WHERE lo_quantity > 10", sch)
+    assert try_star_tree(nofit3, with_tree) is None
+
+
+def test_non_fitting_queries_still_correct(st_env):
+    """Queries that miss the tree fall back to the scan path transparently."""
+    with_tree, plain = st_env
+    for sql in [
+        "SELECT lo_brand, SUM(lo_revenue) FROM lineorder GROUP BY lo_brand",
+        "SELECT SUM(lo_revenue) FROM lineorder WHERE lo_quantity > 25",
+        "SELECT DISTINCTCOUNT(lo_region) FROM lineorder",
+    ]:
+        got = execute_query([with_tree], sql)
+        want = execute_query([plain], sql)
+        _rows_match(got.rows, want.rows)
+
+
+def test_startree_mixed_segments(st_env):
+    """A query over one star-tree segment and one plain segment merges correctly."""
+    with_tree, plain = st_env
+    sql = ("SELECT lo_region, SUM(lo_revenue), COUNT(*), AVG(lo_quantity) "
+           "FROM lineorder GROUP BY lo_region")
+    got = execute_query([with_tree, plain], sql)
+    want = execute_query([plain, plain], sql)
+    _rows_match(got.rows, want.rows)
+
+
+def test_host_path_matches_device(st_env):
+    with_tree, _ = st_env
+    sql = ("SELECT lo_region, SUM(lo_revenue) FROM lineorder "
+           "WHERE lo_discount <= 5 GROUP BY lo_region")
+    dev = ServerQueryExecutor(use_device=True).execute([with_tree], sql)
+    host = ServerQueryExecutor(use_device=False).execute([with_tree], sql)
+    _rows_match(dev.rows, host.rows)
+
+
+def test_tiny_and_skip_star_configs(tmp_path):
+    """max_leaf_records=1 (fully split tree) and skipped star dimensions."""
+    schema = Schema("t", [dimension("d1", DataType.STRING),
+                          dimension("d2", DataType.INT),
+                          metric("m", DataType.DOUBLE)])
+    rng = np.random.default_rng(3)
+    n = 400
+    cols = {
+        "d1": [f"k{i}" for i in rng.integers(0, 7, n)],
+        "d2": rng.integers(0, 5, n).astype(np.int32),
+        "m": rng.uniform(0, 100, n),
+    }
+    cfg = SegmentGeneratorConfig(star_tree_configs=[StarTreeIndexConfig(
+        dimensions_split_order=["d1", "d2"],
+        function_column_pairs=["SUM__m"],
+        max_leaf_records=1,
+        skip_star_node_creation=["d2"],
+    )])
+    seg = load_segment(SegmentBuilder(schema, cfg).build(cols, str(tmp_path), "s1"))
+    plain = load_segment(SegmentBuilder(schema).build(cols, str(tmp_path), "s2"))
+    for sql in [
+        "SELECT d1, SUM(m) FROM t GROUP BY d1",
+        "SELECT d2, SUM(m), COUNT(*) FROM t GROUP BY d2",
+        "SELECT SUM(m) FROM t WHERE d1 = 'k3'",
+        "SELECT COUNT(*) FROM t WHERE d2 >= 2",
+    ]:
+        _rows_match(execute_query([seg], sql).rows, execute_query([plain], sql).rows)
