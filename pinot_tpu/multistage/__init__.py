@@ -1,0 +1,17 @@
+"""Multistage ("v2") query engine: joins and multi-table queries.
+
+TPU-native redesign of the reference's multistage engine
+(`pinot-query-planner` + `pinot-query-runtime`, SURVEY.md §2.9): the broker plans a
+stage DAG split at exchange boundaries, leaf stages scan tables through the regular
+single-stage device engine, and intermediate stages (hash joins, aggregates) run over
+hash-partitioned mailboxes. Here the mailbox service is in-process (the multi-host
+transport is the cluster layer's concern); the partitioned execution model — hash
+exchange, per-partition hash join, partial aggregation, final broker reduce — mirrors
+`GrpcMailboxService`/`HashJoinOperator`/`AggregateOperator` exactly.
+"""
+
+from .planner import MultistagePlan, plan_multistage
+from .runtime import execute_multistage, make_segment_scan
+
+__all__ = ["MultistagePlan", "plan_multistage", "execute_multistage",
+           "make_segment_scan"]

@@ -1,0 +1,472 @@
+"""Multistage runtime: hash exchange, per-partition hash joins, aggregate, reduce.
+
+Analog of the reference's `pinot-query-runtime` operator chain
+(`runtime/operator/HashJoinOperator.java`, `AggregateOperator.java`,
+`MailboxSendOperator`/`MailboxReceiveOperator` over `GrpcMailboxService`,
+`QueryDispatcher.submitAndReduce`, SURVEY.md §3.4). Data moves between stages as
+columnar blocks (`Dict[col -> np.ndarray]`) through an in-process mailbox service —
+within one host that is a dict of queues; across hosts the cluster layer would carry
+the same blocks over DCN. Leaf scans reuse the single-stage device engine (exactly as
+the reference's leaf stages reuse `ServerQueryExecutorV1Impl`).
+
+Join null semantics: outer-join null-extended numeric columns become float NaN and
+object columns None; aggregations skip them (SQL null-skipping), comparisons fail
+them, and the final reduce's sort treats them as SQL nulls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..engine.expr import eval_expr
+from ..query.aggregates import AggFunc, make_agg
+from ..query.context import QueryContext
+from ..query.reduce import SegmentResult, merge_segment_results, reduce_to_result
+from ..query.result import ResultTable
+from ..sql.ast import Expr, Function, Identifier, identifiers_in
+from .planner import JoinSpec, MultistagePlan, plan_multistage
+
+Block = Dict[str, np.ndarray]
+# scan_fn(table, columns, bare-name filter) -> Dict[bare col -> np.ndarray]
+ScanFn = Callable[[str, List[str], Optional[Expr]], Block]
+
+DEFAULT_PARTITIONS = 8
+
+
+class MailboxService:
+    """In-process mailbox fabric keyed (stage, partition): the degenerate single-host
+    instance of the reference's `GrpcMailboxService` (mailbox.proto bidi streams)."""
+
+    def __init__(self) -> None:
+        self._boxes: Dict[Tuple[str, int], List[Block]] = {}
+
+    def send(self, stage: str, partition: int, block: Block) -> None:
+        self._boxes.setdefault((stage, partition), []).append(block)
+
+    def receive(self, stage: str, partition: int) -> List[Block]:
+        return self._boxes.pop((stage, partition), [])
+
+
+# ---------------------------------------------------------------------------
+# block primitives
+# ---------------------------------------------------------------------------
+
+def _block_rows(block: Block) -> int:
+    for v in block.values():
+        return len(v)
+    return 0
+
+
+def _concat_blocks(blocks: List[Block]) -> Block:
+    if not blocks:
+        return {}
+    cols = blocks[0].keys()
+    out: Block = {}
+    for c in cols:
+        arrs = [b[c] for b in blocks]
+        if any(a.dtype == object for a in arrs):
+            arrs = [a.astype(object) for a in arrs]
+        out[c] = np.concatenate(arrs) if arrs else np.empty(0)
+    return out
+
+
+def _take(block: Block, idx: np.ndarray) -> Block:
+    return {c: v[idx] for c, v in block.items()}
+
+
+def _hash_codes(block: Block, keys: Sequence[str]) -> np.ndarray:
+    """Stable per-row hash over the key columns for partition routing."""
+    n = _block_rows(block)
+    h = np.zeros(n, dtype=np.uint64)
+    for k in keys:
+        arr = block[k]
+        if arr.dtype == object:
+            col = np.fromiter((hash(x) for x in arr), dtype=np.int64, count=n
+                              ).view(np.uint64)
+        else:
+            # every numeric dtype canonicalizes through float64 bits so equal keys
+            # hash equally across dtypes (int 3 joining double 3.0 must co-partition;
+            # an outer join upstream may have promoted one side to float)
+            f = np.nan_to_num(arr.astype(np.float64), nan=0.0)
+            f = np.where(f == 0.0, 0.0, f)  # collapse -0.0/+0.0 to one bit pattern
+            col = f.view(np.uint64)
+        h = h * np.uint64(1000003) ^ col
+    return h
+
+
+def _partition_block(block: Block, keys: Sequence[str], p: int) -> List[Block]:
+    if _block_rows(block) == 0:
+        return [block for _ in range(p)]
+    pid = (_hash_codes(block, keys) % np.uint64(p)).astype(np.int64)
+    return [_take(block, np.nonzero(pid == i)[0]) for i in range(p)]
+
+
+def _factorize_pair(left: np.ndarray, right: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense int codes consistent across both arrays; null (None/NaN) -> -1."""
+    nl = len(left)
+    both = np.concatenate([left.astype(object) if left.dtype == object else left,
+                           right.astype(object) if right.dtype == object else right])
+    if both.dtype == object:
+        codes = np.empty(len(both), dtype=np.int64)
+        seen: Dict[Any, int] = {}
+        for i, v in enumerate(both):
+            if v is None:
+                codes[i] = -1
+            else:
+                c = seen.get(v)
+                if c is None:
+                    c = len(seen)
+                    seen[v] = c
+                codes[i] = c
+    else:
+        if both.dtype.kind == "f":
+            nan = np.isnan(both)
+            filled = np.where(nan, 0.0, both)
+            _, codes = np.unique(filled, return_inverse=True)
+            codes = codes.astype(np.int64)
+            codes[nan] = -1
+        else:
+            _, codes = np.unique(both, return_inverse=True)
+            codes = codes.astype(np.int64)
+    return codes[:nl], codes[nl:]
+
+
+def _combine_codes_pair(lparts: List[np.ndarray], rparts: List[np.ndarray]
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-column keys -> one dense code per side, with strides shared across both
+    sides so equal keys combine equally; any null column nulls the row key."""
+    lout = lparts[0].copy()
+    rout = rparts[0].copy()
+    for lc, rc in zip(lparts[1:], rparts[1:]):
+        card = int(max(lc.max(initial=-1), rc.max(initial=-1))) + 2
+        lnull = (lout < 0) | (lc < 0)
+        rnull = (rout < 0) | (rc < 0)
+        lout = lout * card + lc
+        rout = rout * card + rc
+        lout[lnull] = -1
+        rout[rnull] = -1
+    return lout, rout
+
+
+# ---------------------------------------------------------------------------
+# hash join
+# ---------------------------------------------------------------------------
+
+def join_indices(lcodes: np.ndarray, rcodes: np.ndarray, how: str
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Row index pairs for an equi-join on dense key codes; -1 marks a
+    null-extended side. Null keys (-1 codes) never match (SQL semantics)."""
+    order = np.argsort(rcodes, kind="stable")
+    rs = rcodes[order]
+    valid_l = lcodes >= 0
+    lo = np.searchsorted(rs, lcodes, "left")
+    hi = np.searchsorted(rs, lcodes, "right")
+    cnt = np.where(valid_l, hi - lo, 0)
+    total = int(cnt.sum())
+    li = np.repeat(np.arange(len(lcodes)), cnt)
+    offs = np.arange(total) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+    ri = order[np.repeat(lo, cnt) + offs] if total else np.empty(0, dtype=np.int64)
+
+    if how in ("left", "full"):
+        unmatched_l = np.nonzero(cnt == 0)[0]
+        li = np.concatenate([li, unmatched_l])
+        ri = np.concatenate([ri, np.full(len(unmatched_l), -1, dtype=np.int64)])
+    if how in ("right", "full"):
+        matched_r = np.zeros(len(rcodes), dtype=bool)
+        if total:
+            matched_r[ri[ri >= 0]] = True
+        matched_r[rcodes < 0] = False
+        unmatched_r = np.nonzero(~matched_r)[0]
+        li = np.concatenate([li, np.full(len(unmatched_r), -1, dtype=np.int64)])
+        ri = np.concatenate([ri, unmatched_r])
+    return li.astype(np.int64), ri.astype(np.int64)
+
+
+def _take_nullable(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Gather with -1 producing SQL null (NaN for numerics, None for objects)."""
+    if not (idx < 0).any():
+        return arr[idx]
+    safe = np.clip(idx, 0, max(len(arr) - 1, 0))
+    if arr.dtype == object:
+        out = arr[safe] if len(arr) else np.full(len(idx), None, dtype=object)
+        out = out.astype(object)
+        out[idx < 0] = None
+        return out
+    out = (arr[safe] if len(arr) else np.zeros(len(idx))).astype(np.float64)
+    out[idx < 0] = np.nan
+    return out
+
+
+def hash_join(left: Block, right: Block, spec: JoinSpec) -> Block:
+    pairs = [_factorize_pair(left[lk], right[rk])
+             for lk, rk in zip(spec.left_keys, spec.right_keys)]
+    lcodes, rcodes = _combine_codes_pair([p[0] for p in pairs],
+                                         [p[1] for p in pairs])
+    li, ri = join_indices(lcodes, rcodes, spec.join_type)
+    out: Block = {}
+    for c, v in left.items():
+        out[c] = _take_nullable(v, li)
+    for c, v in right.items():
+        out[c] = _take_nullable(v, ri)
+    if spec.residual is not None and _block_rows(out):
+        mask = np.asarray(_null_safe_mask(spec.residual, out), dtype=bool)
+        out = _take(out, np.nonzero(mask)[0])
+    return out
+
+
+def _null_safe_mask(e: Expr, env: Block) -> np.ndarray:
+    """Evaluate a predicate; rows whose inputs are null fail it (SQL three-valued
+    logic collapsed to False, which matches WHERE/ON semantics)."""
+    n = _block_rows(env)
+    invalid = np.zeros(n, dtype=bool)
+    safe_env: Block = {}
+    for name in set(identifiers_in(e)):
+        arr = env[name]
+        if arr.dtype == object:
+            null = np.array([v is None for v in arr], dtype=bool)
+            if null.any():
+                fill = next((v for v in arr if v is not None), 0)
+                arr = arr.copy()
+                arr[null] = fill
+        else:
+            null = np.isnan(arr) if arr.dtype.kind == "f" else np.zeros(n, dtype=bool)
+            if null.any():
+                arr = np.nan_to_num(arr, nan=0.0)
+        invalid |= null
+        safe_env[name] = arr
+    mask = np.asarray(eval_expr(e, safe_env, np))
+    if mask.dtype != bool:
+        mask = mask.astype(bool)
+    return mask & ~invalid
+
+
+# ---------------------------------------------------------------------------
+# aggregation over a joined block (null-skipping)
+# ---------------------------------------------------------------------------
+
+def _factorize_single(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """codes + uniques for group keys; nulls group together under code==len(uniques).
+
+    SQL GROUP BY treats null as one group; the null group key surfaces as None."""
+    if arr.dtype == object:
+        seen: Dict[Any, int] = {}
+        codes = np.empty(len(arr), dtype=np.int64)
+        for i, v in enumerate(arr):
+            if v is None:
+                codes[i] = -1
+                continue
+            c = seen.get(v)
+            if c is None:
+                c = len(seen)
+                seen[v] = c
+            codes[i] = c
+        uniq = np.array(list(seen.keys()), dtype=object)
+    else:
+        if arr.dtype.kind == "f":
+            nan = np.isnan(arr)
+            uniq, codes = np.unique(np.where(nan, 0.0, arr), return_inverse=True)
+            codes = codes.astype(np.int64)
+            codes[nan] = -1
+        else:
+            uniq, codes = np.unique(arr, return_inverse=True)
+            codes = codes.astype(np.int64)
+    codes = np.where(codes < 0, len(uniq), codes)
+    return codes, uniq
+
+
+def aggregate_block(ctx: QueryContext, aggs: List[AggFunc], block: Block
+                    ) -> SegmentResult:
+    """Group + aggregate one partition's joined rows -> mergeable SegmentResult."""
+    n = _block_rows(block)
+    group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                   else list(ctx.group_by))
+
+    # precompute each aggregation's argument values + null-validity once
+    arg_vals: List[Optional[np.ndarray]] = []
+    arg_valid: List[Optional[np.ndarray]] = []
+    for a in aggs:
+        if a.arg is None or (isinstance(a.arg, Identifier) and a.arg.name == "*"):
+            arg_vals.append(None)
+            arg_valid.append(None)
+            continue
+        v = np.asarray(eval_expr(a.arg, block, np))
+        if v.dtype == object:
+            valid = np.array([x is not None for x in v], dtype=bool)
+        elif v.dtype.kind == "f":
+            valid = ~np.isnan(v)
+        else:
+            valid = np.ones(n, dtype=bool)
+        arg_vals.append(v)
+        arg_valid.append(valid)
+
+    def group_states(idx: np.ndarray) -> List[Any]:
+        states: List[Any] = []
+        for i, a in enumerate(aggs):
+            if arg_vals[i] is None:
+                # COUNT(*) counts rows; other arg-less shapes aggregate zeros
+                states.append(len(idx) if a.name == "count"
+                              else a.host_state(np.zeros(len(idx))))
+                continue
+            sel = idx[arg_valid[i][idx]]  # SQL null-skipping per argument
+            if a.name == "count":
+                states.append(len(sel))
+            else:
+                states.append(a.host_state(arg_vals[i][sel]))
+        return states
+
+    if not group_exprs:
+        return SegmentResult("scalar", scalar=group_states(np.arange(n)),
+                             num_docs_scanned=n)
+
+    codes_list = []
+    uniq_list = []
+    for g in group_exprs:
+        arr = np.asarray(eval_expr(g, block, np))
+        codes, uniq = _factorize_single(arr)
+        codes_list.append(codes)
+        uniq_list.append(uniq)
+    combined = np.zeros(n, dtype=np.int64)
+    stride = 1
+    for codes, uniq in zip(codes_list, uniq_list):
+        combined += codes * stride
+        stride *= len(uniq) + 1
+    uniq_keys, inverse = np.unique(combined, return_inverse=True)
+    order = np.argsort(inverse, kind="stable")
+    bounds = np.zeros(len(uniq_keys) + 1, dtype=np.int64)
+    np.cumsum(np.bincount(inverse, minlength=len(uniq_keys)), out=bounds[1:])
+
+    result = SegmentResult("groups", num_docs_scanned=n)
+    for g, dense in enumerate(uniq_keys):
+        gidx = order[bounds[g]:bounds[g + 1]]
+        key = []
+        rem = int(dense)
+        for uniq in uniq_list:
+            card = len(uniq) + 1
+            c = rem % card
+            v = None if c == len(uniq) else uniq[c]
+            key.append(v.item() if isinstance(v, np.generic) else v)
+            rem //= card
+        result.groups[tuple(key)] = group_states(gidx)
+    return result
+
+
+def selection_block(ctx: QueryContext, block: Block) -> SegmentResult:
+    n = _block_rows(block)
+    out_cols = [np.asarray(_eval_or_const(e, block, n)) for e, _ in ctx.select_items]
+    rows = [tuple(_py(c[i]) for c in out_cols) for i in range(n)]
+    sort_keys: List[Tuple] = []
+    if ctx.order_by:
+        sort_cols = [np.asarray(_eval_or_const(o.expr, block, n))
+                     for o in ctx.order_by]
+        sort_keys = [tuple(_py(c[i]) for c in sort_cols) for i in range(n)]
+    return SegmentResult("selection", rows=rows, sort_keys=sort_keys,
+                         num_docs_scanned=n)
+
+
+def _eval_or_const(e: Expr, env: Block, n: int):
+    out = eval_expr(e, env, np)
+    if np.isscalar(out) or not hasattr(out, "__len__"):
+        return np.full(n, out, dtype=object)
+    return out
+
+
+def _py(v):
+    if isinstance(v, np.generic):
+        v = v.item()
+    if isinstance(v, float) and np.isnan(v):
+        return None
+    return v
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
+                       num_partitions: int = DEFAULT_PARTITIONS) -> ResultTable:
+    """Run a join query: leaf scans -> hash exchange -> per-partition joins ->
+    aggregate/selection -> broker reduce."""
+    plan: MultistagePlan = (sql_or_plan if isinstance(sql_or_plan, MultistagePlan)
+                            else plan_multistage(sql_or_plan, schema_for))
+    ctx = plan.ctx
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                   else list(ctx.group_by))
+    mailboxes = MailboxService()
+
+    # -- leaf scan stages (single-stage engine per table) ------------------
+    blocks: Dict[str, Block] = {}
+    for alias, scan in plan.scans.items():
+        raw = scan_fn(scan.table, scan.columns, scan.filter)
+        blocks[alias] = {f"{alias}.{c}": np.asarray(v) for c, v in raw.items()}
+
+    # -- join pipeline: hash exchange + per-partition joins ----------------
+    current = blocks[plan.base_alias]
+    for si, spec in enumerate(plan.joins):
+        right = blocks[spec.right_alias]
+        stage = f"join{si}"
+        for p, blk in enumerate(_partition_block(current, spec.left_keys,
+                                                 num_partitions)):
+            mailboxes.send(f"{stage}.L", p, blk)
+        for p, blk in enumerate(_partition_block(right, spec.right_keys,
+                                                 num_partitions)):
+            mailboxes.send(f"{stage}.R", p, blk)
+        parts = []
+        for p in range(num_partitions):
+            lp = _concat_blocks(mailboxes.receive(f"{stage}.L", p))
+            rp = _concat_blocks(mailboxes.receive(f"{stage}.R", p))
+            parts.append(hash_join(lp, rp, spec))
+        current = _concat_blocks(parts)
+
+    if plan.post_filter is not None and _block_rows(current):
+        mask = _null_safe_mask(plan.post_filter, current)
+        current = _take(current, np.nonzero(mask)[0])
+
+    # -- final stage: aggregate or select, then regular broker reduce ------
+    if ctx.is_aggregation_query or ctx.distinct:
+        partial = aggregate_block(ctx, aggs, current)
+        merged = merge_segment_results([partial], aggs)
+    else:
+        merged = selection_block(ctx, current)
+    result = reduce_to_result(ctx, merged, aggs, group_exprs)
+    result.stats["multistage"] = True
+    return result
+
+
+def make_segment_scan(tables: Dict[str, List], use_device: bool = True) -> ScanFn:
+    """Leaf-scan provider over in-memory segment lists: filter via the regular
+    single-stage plan/kernel path, then materialize only the needed columns
+    (reference: leaf stages compile to `ServerQueryRequest` on the v1 engine)."""
+    from ..query.executor import ServerQueryExecutor
+    from ..query.planner import plan_segment
+
+    executor = ServerQueryExecutor(use_device)
+
+    def scan(table: str, columns: List[str], filt: Optional[Expr]) -> Block:
+        segs = tables.get(table)
+        if segs is None:
+            raise KeyError(f"unknown table {table!r}")
+        out: Dict[str, List[np.ndarray]] = {c: [] for c in columns}
+        for seg in segs:
+            ctx = QueryContext(
+                table=table,
+                select_items=[(Identifier(c), c) for c in columns],
+                filter=filt, group_by=[], aggregations=[], having=None,
+                order_by=[], limit=1 << 62, offset=0, distinct=False)
+            plan = plan_segment(ctx, seg)
+            if plan.kind == "empty":
+                continue
+            mask = executor._selection_mask(plan)
+            idx = np.nonzero(mask[:seg.num_docs])[0]
+            for c in columns:
+                out[c].append(np.asarray(seg.column(c).values())[idx])
+        return {c: (np.concatenate([a.astype(object) for a in arrs])
+                    if arrs and any(a.dtype == object for a in arrs)
+                    else np.concatenate(arrs) if arrs else np.empty(0))
+                for c, arrs in out.items()}
+
+    return scan

@@ -52,6 +52,10 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
     aliases/ordinals, extract aggregations, validate against the schema when given.
     """
     stmt = parse_query(sql_or_stmt) if isinstance(sql_or_stmt, str) else sql_or_stmt
+    if stmt.joins:
+        raise QueryValidationError(
+            "JOIN queries run on the multistage engine (multistage/)"
+        )
 
     # -- expand SELECT * ---------------------------------------------------
     select: List[Tuple[Expr, str]] = []

@@ -100,12 +100,25 @@ class OrderByItem:
 
 
 @dataclass
+class JoinClause:
+    """One JOIN item in the FROM clause (multistage engine only; reference:
+    Calcite SqlJoin consumed by the v2 planner, SURVEY.md §2.9)."""
+
+    table: str
+    alias: Optional[str]
+    join_type: str            # "inner" | "left" | "right" | "full"
+    condition: Optional[Expr]  # ON expression
+
+
+@dataclass
 class QueryStatement:
     """Parsed SELECT statement (reference: PinotQuery thrift struct)."""
 
     select: List[Tuple[Expr, Optional[str]]] = field(default_factory=list)  # (expr, alias)
     distinct: bool = False
     table: str = ""
+    table_alias: Optional[str] = None
+    joins: List[JoinClause] = field(default_factory=list)
     where: Optional[Expr] = None
     group_by: List[Expr] = field(default_factory=list)
     having: Optional[Expr] = None

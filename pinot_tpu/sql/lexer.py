@@ -28,6 +28,7 @@ KEYWORDS = {
     "OFFSET", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "IS", "NULL", "TRUE",
     "FALSE", "ASC", "DESC", "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "SET",
     "OPTION", "NULLS", "FIRST", "LAST",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "ON",
 }
 
 _TOKEN_RE = re.compile(r"""

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from .ast import (Expr, Function, Identifier, Literal, OrderByItem, QueryStatement, STAR)
+from .ast import (Expr, Function, Identifier, JoinClause, Literal, OrderByItem,
+                  QueryStatement, STAR)
 from .lexer import SqlSyntaxError, Token, tokenize
 
 _COMPARISON_OPS = {"=": "eq", "!=": "neq", "<>": "neq", "<": "lt", "<=": "lte",
@@ -75,6 +76,9 @@ class Parser:
         q.select = self._select_list()
         self.expect_keyword("FROM")
         q.table = self._table_name()
+        q.table_alias = self._table_alias()
+        while self.at_keyword("JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS"):
+            q.joins.append(self._join_clause())
         if self.accept_keyword("WHERE"):
             q.where = self.expression()
         if self.accept_keyword("GROUP"):
@@ -125,6 +129,36 @@ class Parser:
         if t.kind != "IDENT":
             raise SqlSyntaxError(f"expected table name at position {t.pos}, got {t.value!r}")
         return t.value
+
+    def _table_alias(self) -> Optional[str]:
+        """Optional `AS alias` / bare-ident alias after a FROM/JOIN table name."""
+        if self.accept_keyword("AS"):
+            return self._table_name()
+        if self.cur.kind == "IDENT":
+            return self.advance().value
+        return None
+
+    def _join_clause(self) -> JoinClause:
+        join_type = "inner"
+        if self.accept_keyword("INNER"):
+            pass
+        elif self.accept_keyword("LEFT"):
+            join_type = "left"
+        elif self.accept_keyword("RIGHT"):
+            join_type = "right"
+        elif self.accept_keyword("FULL"):
+            join_type = "full"
+        elif self.accept_keyword("CROSS"):
+            join_type = "cross"
+        self.accept_keyword("OUTER")
+        self.expect_keyword("JOIN")
+        table = self._table_name()
+        alias = self._table_alias()
+        condition = None
+        if join_type != "cross":
+            self.expect_keyword("ON")
+            condition = self.expression()
+        return JoinClause(table, alias, join_type, condition)
 
     def _select_list(self) -> List[Tuple[Expr, Optional[str]]]:
         items: List[Tuple[Expr, Optional[str]]] = []

@@ -1,0 +1,268 @@
+"""Multistage engine: join queries checked against sqlite3 as oracle.
+
+Reference pattern: `QueryRunnerTest`/`QueryDispatcherTest` run a multi-server mailbox
+topology in one process and `MultiStageEngineIntegrationTest` checks join SQL against
+H2. Here identical rows live in segments and a sqlite mirror; every query runs through
+both engines.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.multistage import execute_multistage, make_segment_scan, plan_multistage
+from pinot_tpu.query.context import QueryValidationError
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+
+
+@pytest.fixture(scope="module")
+def jenv(tmp_path_factory):
+    """orders (2 segments) + customers + regions, mirrored into sqlite."""
+    rng = np.random.default_rng(5)
+    out = tmp_path_factory.mktemp("join")
+
+    n_cust = 40
+    customers = {
+        "cust_id": np.arange(1, n_cust + 1, dtype=np.int64),
+        "cust_name": [f"cust{i}" for i in range(1, n_cust + 1)],
+        "region_id": rng.integers(0, 6, n_cust).astype(np.int32),  # 5 exists, 5 doesn't
+    }
+    regions = {
+        "region_id": np.arange(0, 5, dtype=np.int32),
+        "region_name": ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MEA"],
+    }
+    n_ord = 800
+    orders_all = {
+        # some orders reference missing customers (id up to 45) for outer-join tests
+        "cust_id": rng.integers(1, 46, n_ord).astype(np.int64),
+        "amount": np.round(rng.uniform(1, 1000, n_ord), 2),
+        "qty": rng.integers(1, 20, n_ord).astype(np.int32),
+    }
+
+    cust_schema = Schema("customers", [dimension("cust_id", DataType.LONG),
+                                       dimension("cust_name", DataType.STRING),
+                                       dimension("region_id", DataType.INT)])
+    reg_schema = Schema("regions", [dimension("region_id", DataType.INT),
+                                    dimension("region_name", DataType.STRING)])
+    ord_schema = Schema("orders", [dimension("cust_id", DataType.LONG),
+                                   metric("amount", DataType.DOUBLE),
+                                   metric("qty", DataType.INT)])
+
+    half = n_ord // 2
+    orders_a = {k: v[:half] for k, v in orders_all.items()}
+    orders_b = {k: v[half:] for k, v in orders_all.items()}
+
+    tables = {
+        "customers": [load_segment(SegmentBuilder(cust_schema).build(
+            customers, str(out), "cust_0"))],
+        "regions": [load_segment(SegmentBuilder(reg_schema).build(
+            regions, str(out), "reg_0"))],
+        "orders": [load_segment(SegmentBuilder(ord_schema).build(
+            orders_a, str(out), "ord_0")),
+                   load_segment(SegmentBuilder(ord_schema).build(
+            orders_b, str(out), "ord_1"))],
+    }
+    schemas = {"customers": cust_schema, "regions": reg_schema, "orders": ord_schema}
+
+    db = sqlite3.connect(":memory:")
+    db.execute("CREATE TABLE customers (cust_id, cust_name, region_id)")
+    db.executemany("INSERT INTO customers VALUES (?,?,?)",
+                   list(zip(customers["cust_id"].tolist(), customers["cust_name"],
+                            customers["region_id"].tolist())))
+    db.execute("CREATE TABLE regions (region_id, region_name)")
+    db.executemany("INSERT INTO regions VALUES (?,?)",
+                   list(zip(regions["region_id"].tolist(), regions["region_name"])))
+    db.execute("CREATE TABLE orders (cust_id, amount, qty)")
+    db.executemany("INSERT INTO orders VALUES (?,?,?)",
+                   list(zip(orders_all["cust_id"].tolist(),
+                            orders_all["amount"].tolist(),
+                            orders_all["qty"].tolist())))
+    db.commit()
+    return tables, schemas, db
+
+
+def run_both(jenv, sql, sqlite_sql=None, ordered=False):
+    tables, schemas, db = jenv
+    ours = execute_multistage(sql, make_segment_scan(tables), schemas.get)
+    oracle = db.execute(sqlite_sql or sql).fetchall()
+    got = [tuple(r) for r in ours.rows]
+    want = [tuple(r) for r in oracle]
+    if not ordered:
+        # sort on rounded values so float noise cannot reorder; compare approx below
+        keyfn = lambda r: repr(tuple(_norm(v) for v in r))
+        got, want = sorted(got, key=keyfn), sorted(want, key=keyfn)
+    assert len(got) == len(want), f"{len(got)} rows != {len(want)}\n{got[:5]}\n{want[:5]}"
+    for g, w in zip(got, want):
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float) or isinstance(wv, float):
+                assert gv == pytest.approx(wv, rel=1e-6, abs=1e-6), f"{g} != {w}"
+            else:
+                assert gv == wv, f"{g} != {w}"
+    return ours
+
+
+def _norm(v):
+    if isinstance(v, float):
+        return round(v, 2)
+    return v
+
+
+INNER_QUERIES = [
+    # plain inner join, selection
+    "SELECT o.cust_id, c.cust_name, o.amount FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id LIMIT 100000",
+    # join + group-by + aggregates
+    "SELECT c.cust_name, COUNT(*), SUM(o.amount) FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id GROUP BY c.cust_name LIMIT 100000",
+    # three-way join
+    "SELECT r.region_name, SUM(o.amount) FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id "
+    "JOIN regions r ON c.region_id = r.region_id GROUP BY r.region_name LIMIT 100000",
+    # WHERE pushdown both sides + post-join condition
+    "SELECT c.cust_name, SUM(o.amount) FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id "
+    "WHERE o.qty > 5 AND c.region_id <= 3 GROUP BY c.cust_name LIMIT 100000",
+    # unqualified columns resolved by uniqueness
+    "SELECT cust_name, SUM(amount) FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id GROUP BY cust_name LIMIT 100000",
+    # non-equi residual ON condition (inner only)
+    "SELECT COUNT(*) FROM orders o JOIN customers c "
+    "ON o.cust_id = c.cust_id AND o.qty > c.region_id",
+    # HAVING + ORDER BY + LIMIT on joined aggregate
+    "SELECT c.cust_name, SUM(o.amount) AS total FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id GROUP BY c.cust_name "
+    "HAVING SUM(o.amount) > 2000 ORDER BY total DESC LIMIT 5",
+    # expression select items over both tables
+    "SELECT o.cust_id + c.region_id, AVG(o.amount) FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id "
+    "GROUP BY o.cust_id + c.region_id LIMIT 100000",
+    # DISTINCT over joined columns
+    "SELECT DISTINCT c.region_id FROM orders o "
+    "JOIN customers c ON o.cust_id = c.cust_id LIMIT 100000",
+]
+
+
+@pytest.mark.parametrize("sql", INNER_QUERIES)
+def test_inner_joins(jenv, sql):
+    run_both(jenv, sql)
+
+
+def test_left_join(jenv):
+    # orders with missing customers survive with null cust_name
+    run_both(jenv,
+             "SELECT o.cust_id, c.cust_name FROM orders o "
+             "LEFT JOIN customers c ON o.cust_id = c.cust_id LIMIT 100000")
+    # aggregation over the null-extended side skips nulls like SQL
+    run_both(jenv,
+             "SELECT o.cust_id, COUNT(c.cust_name) FROM orders o "
+             "LEFT JOIN customers c ON o.cust_id = c.cust_id "
+             "GROUP BY o.cust_id LIMIT 100000")
+
+
+def test_left_join_where_not_pushed(jenv):
+    # WHERE on the null-extended side must apply after the join
+    run_both(jenv,
+             "SELECT o.cust_id, c.cust_name FROM orders o "
+             "LEFT JOIN customers c ON o.cust_id = c.cust_id "
+             "WHERE c.region_id <= 2 LIMIT 100000")
+
+
+def test_right_and_full_join(jenv):
+    # customers with no orders (sqlite supports RIGHT/FULL from 3.39; emulate)
+    ours = execute_multistage(
+        "SELECT c.cust_id, COUNT(o.amount) FROM orders o "
+        "RIGHT JOIN customers c ON o.cust_id = c.cust_id "
+        "GROUP BY c.cust_id LIMIT 100000",
+        make_segment_scan(jenv[0]), jenv[1].get)
+    oracle = jenv[2].execute(
+        "SELECT c.cust_id, COUNT(o.amount) FROM customers c "
+        "LEFT JOIN orders o ON o.cust_id = c.cust_id GROUP BY c.cust_id").fetchall()
+    assert sorted(map(tuple, ours.rows)) == sorted(map(tuple, oracle))
+
+    full = execute_multistage(
+        "SELECT o.cust_id, c.cust_id FROM orders o "
+        "FULL JOIN customers c ON o.cust_id = c.cust_id LIMIT 100000",
+        make_segment_scan(jenv[0]), jenv[1].get)
+    # full join row count = inner matches + unmatched left + unmatched right
+    inner = jenv[2].execute(
+        "SELECT COUNT(*) FROM orders o JOIN customers c "
+        "ON o.cust_id = c.cust_id").fetchone()[0]
+    left_un = jenv[2].execute(
+        "SELECT COUNT(*) FROM orders o WHERE cust_id NOT IN "
+        "(SELECT cust_id FROM customers)").fetchone()[0]
+    right_un = jenv[2].execute(
+        "SELECT COUNT(*) FROM customers c WHERE cust_id NOT IN "
+        "(SELECT cust_id FROM orders)").fetchone()[0]
+    assert len(full.rows) == inner + left_un + right_un
+
+
+def test_plan_shapes(jenv):
+    _, schemas, _ = jenv
+    plan = plan_multistage(
+        "SELECT c.cust_name, SUM(o.amount) FROM orders o "
+        "JOIN customers c ON o.cust_id = c.cust_id "
+        "WHERE o.qty > 5 AND c.region_id = 2 GROUP BY c.cust_name",
+        schemas.get)
+    assert plan.scans["o"].filter is not None      # o.qty > 5 pushed down
+    assert plan.scans["c"].filter is not None      # c.region_id = 2 pushed down
+    assert plan.post_filter is None
+    assert plan.joins[0].left_keys == ["o.cust_id"]
+    assert plan.joins[0].right_keys == ["c.cust_id"]
+    # pushdown is disabled for the null-extended side of an outer join
+    plan2 = plan_multistage(
+        "SELECT o.cust_id FROM orders o LEFT JOIN customers c "
+        "ON o.cust_id = c.cust_id WHERE c.region_id = 2 AND o.qty > 5",
+        schemas.get)
+    assert plan2.scans["c"].filter is None
+    assert plan2.post_filter is not None
+    assert plan2.scans["o"].filter is not None
+
+
+def test_errors(jenv):
+    _, schemas, _ = jenv
+    with pytest.raises(QueryValidationError, match="equality key"):
+        plan_multistage("SELECT 1 FROM orders o JOIN customers c ON o.qty > c.region_id",
+                        schemas.get)
+    with pytest.raises(QueryValidationError, match="ambiguous"):
+        plan_multistage("SELECT cust_id FROM orders o JOIN customers c "
+                        "ON o.cust_id = c.cust_id", schemas.get)
+    with pytest.raises(QueryValidationError, match="multistage"):
+        from pinot_tpu.query.context import compile_query
+        compile_query("SELECT 1 FROM a JOIN b ON a.x = b.x")
+
+
+def test_cluster_join_query(tmp_path):
+    """Join query through the full broker path (reference:
+    MultiStageEngineIntegrationTest via BrokerRequestHandlerDelegate)."""
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.table import TableConfig
+
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    dim_schema = Schema("dim", [dimension("k", DataType.INT),
+                                dimension("label", DataType.STRING)])
+    fact_schema = Schema("fact", [dimension("k", DataType.INT),
+                                  metric("v", DataType.DOUBLE)])
+    dim_cfg = cluster.create_table(dim_schema, TableConfig("dim"))
+    fact_cfg = cluster.create_table(fact_schema, TableConfig("fact"))
+    cluster.ingest_columns(dim_cfg, {"k": np.arange(5, dtype=np.int32),
+                                     "label": [f"L{i}" for i in range(5)]})
+    rng = np.random.default_rng(1)
+    ks = rng.integers(0, 5, 200).astype(np.int32)
+    vs = np.round(rng.uniform(0, 10, 200), 2)
+    cluster.ingest_columns(fact_cfg, {"k": ks[:100], "v": vs[:100]})
+    cluster.ingest_columns(fact_cfg, {"k": ks[100:], "v": vs[100:]})
+
+    res = cluster.query(
+        "SELECT d.label, SUM(f.v), COUNT(*) FROM fact f "
+        "JOIN dim d ON f.k = d.k GROUP BY d.label ORDER BY d.label LIMIT 100")
+    assert res.stats.get("multistage") is True
+    want = {}
+    for k, v in zip(ks.tolist(), vs.tolist()):
+        s, c = want.get(f"L{k}", (0.0, 0))
+        want[f"L{k}"] = (s + v, c + 1)
+    assert [r[0] for r in res.rows] == sorted(want)
+    for label, s, c in res.rows:
+        assert s == pytest.approx(want[label][0], rel=1e-6)
+        assert c == want[label][1]
