@@ -128,6 +128,25 @@ class ServerNode:
                 mgr.remove_segment(seg_name)
                 self.catalog.report_state(table, seg_name, self.instance_id, None)
 
+        self._refresh_dim_table(table, mgr)
+
+    def _refresh_dim_table(self, table: str, mgr: TableDataManager) -> None:
+        """(Re)load a dimension table's PK map after segment changes (reference:
+        DimensionTableDataManager rebuilds its map on every segment add/remove)."""
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None or not cfg.is_dim_table:
+            return
+        from ..query.lookup import register_dim_table_from_segments
+        schema = self.catalog.schema_for_table(table)
+        pk = schema.primary_key_columns if schema else []
+        if not pk:
+            return
+        segments = mgr.acquire()
+        try:
+            register_dim_table_from_segments(cfg.name, pk, segments)
+        finally:
+            mgr.release(segments)
+
     def _ensure_realtime_manager(self, table: str):
         with self._lock:
             handler = self._realtime_managers.get(table)

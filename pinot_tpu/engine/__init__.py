@@ -11,3 +11,4 @@ expressions and dense-key group-by partials in a single pass over HBM-resident c
 from . import datetime_fns as _datetime_fns  # noqa: F401,E402
 from . import json_fns as _json_fns          # noqa: F401,E402
 from . import string_fns as _string_fns      # noqa: F401,E402
+from ..query import lookup as _lookup_fns    # noqa: F401,E402

@@ -22,6 +22,20 @@ class QueryValidationError(ValueError):
 
 
 @dataclass
+class GapfillSpec:
+    """GAPFILL(timeExpr, start, end, bucket) + per-column FILL modes (reference:
+    `core/query/reduce/GapfillProcessor.java` family, broker post-processing)."""
+
+    index: int               # select-item position of the time bucket column
+    start: int
+    end: int                 # exclusive
+    bucket: int
+    fills: Dict[int, Tuple[str, object]] = field(default_factory=dict)
+    # select-item position -> (mode, default); modes: FILL_PREVIOUS_VALUE,
+    # FILL_DEFAULT_VALUE
+
+
+@dataclass
 class QueryContext:
     table: str
     select_items: List[Tuple[Expr, str]]            # (resolved expr, output column name)
@@ -34,6 +48,7 @@ class QueryContext:
     offset: int
     distinct: bool
     options: Dict[str, object] = field(default_factory=dict)
+    gapfill: Optional[GapfillSpec] = None
 
     @property
     def is_aggregation_query(self) -> bool:
@@ -57,15 +72,43 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
             "JOIN queries run on the multistage engine (multistage/)"
         )
 
-    # -- expand SELECT * ---------------------------------------------------
+    # -- expand SELECT *, strip GAPFILL/FILL wrappers ----------------------
     select: List[Tuple[Expr, str]] = []
+    gapfill: Optional[GapfillSpec] = None
+    fills: Dict[int, Tuple[str, object]] = {}
     for expr, alias in stmt.select:
         if isinstance(expr, Identifier) and expr.name == "*":
             if schema is None:
                 raise QueryValidationError("SELECT * requires a schema to expand")
             select.extend((Identifier(c), c) for c in schema.column_names)
-        else:
-            select.append((expr, alias or _default_name(expr)))
+            continue
+        if isinstance(expr, Function) and expr.name == "gapfill":
+            if gapfill is not None:
+                raise QueryValidationError("only one GAPFILL column is allowed")
+            if len(expr.args) != 4 or not all(
+                    isinstance(a, Literal) for a in expr.args[1:]):
+                raise QueryValidationError(
+                    "GAPFILL(timeExpr, start, end, bucket) with literal bounds")
+            gapfill = GapfillSpec(index=len(select), start=int(expr.args[1].value),
+                                  end=int(expr.args[2].value),
+                                  bucket=int(expr.args[3].value))
+            if gapfill.bucket <= 0:
+                raise QueryValidationError("GAPFILL bucket must be positive")
+            expr = expr.args[0]
+        elif isinstance(expr, Function) and expr.name == "fill":
+            if len(expr.args) < 2 or not isinstance(expr.args[1], Literal):
+                raise QueryValidationError("FILL(expr, 'MODE'[, default])")
+            mode = str(expr.args[1].value).upper()
+            if mode not in ("FILL_PREVIOUS_VALUE", "FILL_DEFAULT_VALUE"):
+                raise QueryValidationError(f"unknown FILL mode {mode!r}")
+            default = expr.args[2].value if len(expr.args) > 2 else None
+            fills[len(select)] = (mode, default)
+            expr = expr.args[0]
+        select.append((expr, alias or _default_name(expr)))
+    if gapfill is not None:
+        gapfill.fills = fills
+    elif fills:
+        raise QueryValidationError("FILL requires a GAPFILL column in the select list")
 
     alias_map = {name: expr for expr, name in select}
 
@@ -120,6 +163,7 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
         offset=stmt.offset,
         distinct=stmt.distinct,
         options=dict(stmt.options),
+        gapfill=gapfill,
     )
 
 

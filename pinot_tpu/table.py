@@ -123,6 +123,9 @@ class TableConfig:
     upsert: Optional[UpsertConfig] = None
     dedup_enabled: bool = False
     tenant: str = "DefaultTenant"
+    # dimension table: small, fully replicated to every server, loaded into a PK map
+    # for LOOKUP joins (reference: DimensionTableConfig / isDimTable)
+    is_dim_table: bool = False
 
     @property
     def table_name_with_type(self) -> str:
@@ -138,6 +141,7 @@ class TableConfig:
             "indexing": self.indexing.to_json(),
             "tenant": self.tenant,
             "dedupEnabled": self.dedup_enabled,
+            "isDimTable": self.is_dim_table,
         }
         if self.partition:
             d["segmentPartitionConfig"] = self.partition.to_json()
@@ -161,6 +165,7 @@ class TableConfig:
             stream=StreamConfig.from_json(d["streamConfig"]) if d.get("streamConfig") else None,
             upsert=UpsertConfig.from_json(d["upsertConfig"]) if d.get("upsertConfig") else None,
             dedup_enabled=d.get("dedupEnabled", False),
+            is_dim_table=d.get("isDimTable", False),
             tenant=d.get("tenant", "DefaultTenant"),
         )
 

@@ -1,0 +1,146 @@
+"""Gapfill post-processing + LOOKUP dimension-table joins.
+
+Reference: `GapfillProcessor` reduce-side time-bucket filling and
+`DimensionTableDataManager`/`LookupTransformFunction` scan-time lookup joins.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.context import QueryValidationError, compile_query
+from pinot_tpu.query.executor import execute_query
+from pinot_tpu.query.lookup import REGISTRY, DimensionTable
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+
+
+@pytest.fixture(scope="module")
+def tseg(tmp_path_factory):
+    schema = Schema("events", [dimension("ts", DataType.LONG),
+                               dimension("host", DataType.STRING),
+                               metric("v", DataType.DOUBLE)])
+    # buckets of 10; host a has data at 0,10,30; host b at 10,20
+    cols = {
+        "ts": np.array([0, 10, 30, 10, 20], dtype=np.int64),
+        "host": ["a", "a", "a", "b", "b"],
+        "v": np.array([1.0, 2.0, 3.0, 5.0, 6.0]),
+    }
+    out = tmp_path_factory.mktemp("gap")
+    return [load_segment(SegmentBuilder(schema).build(cols, str(out), "ev_0"))]
+
+
+def test_gapfill_previous_value(tseg):
+    r = execute_query(
+        tseg,
+        "SELECT GAPFILL(ts, 0, 40, 10), host, FILL(SUM(v), 'FILL_PREVIOUS_VALUE') "
+        "FROM events GROUP BY ts, host LIMIT 100")
+    rows = {(row[1], row[0]): row[2] for row in r.rows}
+    assert rows[("a", 0)] == 1.0
+    assert rows[("a", 10)] == 2.0
+    assert rows[("a", 20)] == 2.0   # filled with previous
+    assert rows[("a", 30)] == 3.0
+    assert rows[("b", 0)] is None   # nothing before the first real bucket
+    assert rows[("b", 10)] == 5.0
+    assert rows[("b", 20)] == 6.0
+    assert rows[("b", 30)] == 6.0   # filled
+    assert len(r.rows) == 8         # 2 series x 4 buckets
+
+
+def test_gapfill_default_value(tseg):
+    r = execute_query(
+        tseg,
+        "SELECT GAPFILL(ts, 0, 40, 10), host, FILL(SUM(v), 'FILL_DEFAULT_VALUE', 0) "
+        "FROM events GROUP BY ts, host LIMIT 100")
+    rows = {(row[1], row[0]): row[2] for row in r.rows}
+    assert rows[("a", 20)] == 0
+    assert rows[("b", 0)] == 0
+
+
+def test_gapfill_unfilled_is_null(tseg):
+    r = execute_query(
+        tseg,
+        "SELECT GAPFILL(ts, 0, 40, 10), host, SUM(v), COUNT(*) "
+        "FROM events GROUP BY ts, host LIMIT 100")
+    rows = {(row[1], row[0]): (row[2], row[3]) for row in r.rows}
+    assert rows[("a", 20)] == (None, None)
+
+
+def test_gapfill_validation(tseg):
+    with pytest.raises(QueryValidationError, match="GAPFILL"):
+        compile_query("SELECT GAPFILL(ts, 0, 40) FROM events GROUP BY ts")
+    with pytest.raises(QueryValidationError, match="FILL requires"):
+        compile_query("SELECT ts, FILL(SUM(v), 'FILL_DEFAULT_VALUE') "
+                      "FROM events GROUP BY ts")
+
+
+# ---------------------------------------------------------------------------
+# LOOKUP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lookup_env(tmp_path_factory):
+    REGISTRY.register(DimensionTable(
+        "dim_hosts", ["hostname"],
+        {"hostname": np.array(["a", "b", "c"], dtype=object),
+         "dc": np.array(["us-east", "eu-west", "us-east"], dtype=object),
+         "cores": np.array([8, 16, 32], dtype=np.int64)}))
+    schema = Schema("metrics", [dimension("host", DataType.STRING),
+                                metric("load", DataType.DOUBLE)])
+    cols = {"host": ["a", "b", "a", "x"],
+            "load": np.array([0.5, 0.6, 0.7, 0.9])}
+    out = tmp_path_factory.mktemp("lkp")
+    return [load_segment(SegmentBuilder(schema).build(cols, str(out), "m_0"))]
+
+
+def test_lookup_selection(lookup_env):
+    r = execute_query(
+        lookup_env,
+        "SELECT host, LOOKUP('dim_hosts', 'dc', 'hostname', host), load "
+        "FROM metrics LIMIT 10")
+    got = {tuple(row[:2]) for row in r.rows}
+    assert ("a", "us-east") in got
+    assert ("b", "eu-west") in got
+    assert ("x", None) in got  # lookup miss -> null
+
+
+def test_lookup_group_by(lookup_env):
+    r = execute_query(
+        lookup_env,
+        "SELECT LOOKUP('dim_hosts', 'dc', 'hostname', host), SUM(load) "
+        "FROM metrics GROUP BY LOOKUP('dim_hosts', 'dc', 'hostname', host) LIMIT 10")
+    rows = {row[0]: row[1] for row in r.rows}
+    assert rows["us-east"] == pytest.approx(1.2)
+    assert rows["eu-west"] == pytest.approx(0.6)
+
+
+def test_lookup_numeric_value(lookup_env):
+    r = execute_query(
+        lookup_env,
+        "SELECT SUM(LOOKUP('dim_hosts', 'cores', 'hostname', host)) "
+        "FROM metrics WHERE host <> 'x' LIMIT 10")
+    assert r.rows[0][0] == pytest.approx(8 + 16 + 8)
+
+
+def test_lookup_in_cluster(tmp_path):
+    """Dimension table loaded through the server path on table creation."""
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.table import TableConfig
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    dim_schema = Schema("countries",
+                        [dimension("code", DataType.STRING),
+                         dimension("continent", DataType.STRING)],
+                        primary_key_columns=["code"])
+    fact_schema = Schema("visits", [dimension("code", DataType.STRING),
+                                    metric("n", DataType.INT)])
+    dim_cfg = cluster.create_table(dim_schema, TableConfig("countries",
+                                                           is_dim_table=True))
+    fact_cfg = cluster.create_table(fact_schema, TableConfig("visits"))
+    cluster.ingest_columns(dim_cfg, {"code": ["de", "fr", "jp"],
+                                     "continent": ["EU", "EU", "AS"]})
+    cluster.ingest_columns(fact_cfg, {"code": ["de", "fr", "jp", "de"],
+                                      "n": np.array([1, 2, 3, 4], dtype=np.int32)})
+    r = cluster.query(
+        "SELECT LOOKUP('countries', 'continent', 'code', code), SUM(n) FROM visits "
+        "GROUP BY LOOKUP('countries', 'continent', 'code', code) ORDER BY 1 LIMIT 10")
+    assert [list(row) for row in r.rows] == [["AS", 3.0], ["EU", 7.0]]
