@@ -121,7 +121,11 @@ class Controller:
         servers = self.catalog.live_servers(cfg.tenant)
         ist = self.catalog.ideal_state.get(table, {})
         counts = compute_counts(ist)
-        if cfg.partition and meta.partition_id is not None:
+        if cfg.is_dim_table:
+            # dimension tables replicate to EVERY server in the tenant so LOOKUP
+            # always resolves locally (reference: DimTableSegmentAssignment)
+            chosen = list(servers)
+        elif cfg.partition and meta.partition_id is not None:
             chosen = replica_group_assign(meta.name, servers, cfg.replication,
                                           meta.partition_id, counts)
         else:

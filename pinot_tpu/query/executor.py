@@ -258,10 +258,10 @@ class ServerQueryExecutor:
         combined = np.zeros(len(idx), dtype=np.int64)
         stride = 1
         for arr in key_arrays:
-            uniq, inv = np.unique(arr, return_inverse=True)
-            combined += inv.astype(np.int64) * stride
-            value_dicts.append(uniq)
-            stride *= max(len(uniq), 1)
+            codes, values = _factorize_keys(arr)
+            combined += codes * stride
+            value_dicts.append(values)
+            stride *= max(len(values), 1)
         uniq_keys, inverse = np.unique(combined, return_inverse=True)
         order = np.argsort(inverse, kind="stable")
         bounds = np.zeros(len(uniq_keys) + 1, dtype=np.int64)
@@ -272,9 +272,9 @@ class ServerQueryExecutor:
             gidx = order[bounds[g]:bounds[g + 1]]
             key = []
             rem = dense
-            for j, uniq in enumerate(value_dicts):
-                card = max(len(uniq), 1)
-                v = uniq[rem % card]
+            for j, values in enumerate(value_dicts):
+                card = max(len(values), 1)
+                v = values[rem % card]
                 key.append(v.item() if isinstance(v, np.generic) else v)
                 rem //= card
             result.groups[tuple(key)] = [a.host_state(arg_arrays[i][gidx])
@@ -405,6 +405,37 @@ def _host_env(plan: SegmentPlan, seg: ImmutableSegment) -> Dict[str, np.ndarray]
             if isinstance(leaf, CmpLeaf):
                 needed.update(identifiers_in(leaf.expr))
     return {c: seg.column(c).values() for c in needed}
+
+
+def _factorize_keys(arr: np.ndarray):
+    """Null-aware dense codes for host group-by keys.
+
+    SQL groups all nulls (None in object arrays, NaN in float arrays — e.g. a
+    LOOKUP miss, `LookupTransformFunction.java:65` semantics) into ONE group whose
+    key surfaces as None; np.unique alone cannot sort None against str. Returns
+    (codes, values) where nulls get the trailing code len(values)-1 -> None."""
+    n = len(arr)
+    if arr.dtype == object:
+        isnull = np.fromiter((v is None for v in arr), dtype=bool, count=n)
+        if isnull.any():
+            fill = next((v for v in arr if v is not None), "")
+            tmp = arr.copy()
+            tmp[isnull] = fill
+        else:
+            tmp = arr
+        uniq, inv = np.unique(tmp, return_inverse=True)
+    elif arr.dtype.kind == "f":
+        isnull = np.isnan(arr)
+        uniq, inv = np.unique(np.where(isnull, 0.0, arr), return_inverse=True)
+    else:
+        isnull = np.zeros(n, dtype=bool)
+        uniq, inv = np.unique(arr, return_inverse=True)
+    codes = inv.astype(np.int64).reshape(n)
+    values = list(uniq)
+    if isnull.any():
+        codes[isnull] = len(values)
+        values.append(None)
+    return codes, values
 
 
 def _is_const(e: Expr) -> bool:

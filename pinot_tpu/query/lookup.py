@@ -27,7 +27,10 @@ class DimensionTable:
         self.name = name
         self.pk_columns = list(pk_columns)
         self.columns = {c: np.asarray(v) for c, v in columns.items()}
-        pk_arrays = [self.columns[c] for c in self.pk_columns]
+        # a table built from zero segments has no columns at all; treat it as an
+        # empty dim table (every lookup misses) instead of KeyError-ing on the pk
+        pk_arrays = ([self.columns[c] for c in self.pk_columns]
+                     if all(c in self.columns for c in self.pk_columns) else [])
         n = len(pk_arrays[0]) if pk_arrays else 0
         self._index: Dict[Tuple, int] = {}
         for i in range(n):
@@ -92,8 +95,9 @@ def _py(v: Any) -> Any:
 def _lookup(xp, table_name, value_col, *pk_pairs):
     """LOOKUP('dimTable', 'valueCol', 'pk1', expr1[, 'pk2', expr2...]).
 
-    Missing keys produce the python None (object output) or NaN (numeric output),
-    mirroring the reference's null-handling on lookup misses."""
+    Missing keys produce Python None (object-dtype output); when every key hits, the
+    value column's native dtype is preserved. Mirrors the reference's null-handling
+    on lookup misses."""
     if xp is not np:
         raise ValueError("LOOKUP is host-side only")
     name = str(table_name)
@@ -110,14 +114,17 @@ def _lookup(xp, table_name, value_col, *pk_pairs):
     tuples = list(zip(*[
         [_py(v) for v in (e if e.ndim else np.full(n, e.item()))] for e in exprs]))
     rows = table.lookup_rows(tuples)
+    if str(value_col) not in table.columns:  # zero-segment table: every key misses
+        return np.full(n, None, dtype=object)
     values = table.columns[str(value_col)]
     missing = rows < 0
     safe = np.clip(rows, 0, max(len(values) - 1, 0))
-    if values.dtype == object:
-        out = values[safe].astype(object) if len(values) else \
-            np.full(n, None, dtype=object)
-        out[missing] = None
-        return out
-    out = (values[safe] if len(values) else np.zeros(n)).astype(np.float64)
-    out[missing] = np.nan
+    if not missing.any() and len(values):
+        return values[safe]  # keep the column's native dtype when every key hits
+    # misses present: surface them as None in an object array so hits keep their
+    # native values (int stays int) and the same column is type-stable across
+    # segments with and without misses
+    out = (values[safe].astype(object) if len(values)
+           else np.full(n, None, dtype=object))
+    out[missing] = None
     return out

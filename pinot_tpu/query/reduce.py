@@ -115,6 +115,14 @@ def reduce_to_result(ctx: QueryContext, merged: SegmentResult, aggs: List[AggFun
     if ctx.gapfill is not None:
         rows = _apply_gapfill(ctx, group_exprs,
                               [[col[i] for col in out_cols] for i in idx])
+        if ctx.order_by:
+            # gap rows were generated series-first/bucket-ascending; re-apply the
+            # query's ORDER BY (over select-item columns) before OFFSET/LIMIT
+            sel_repr = {repr(e): j for j, (e, _) in enumerate(ctx.select_items)}
+            cols = [sel_repr.get(repr(o.expr)) for o in ctx.order_by]
+            if all(c is not None for c in cols):
+                rows.sort(key=lambda r: _sort_key([r[c] for c in cols],
+                                                  ctx.order_by))
         rows = rows[ctx.offset:ctx.offset + ctx.limit]
         return ResultTable([name for _, name in ctx.select_items], _pyify(rows),
                            {"numDocsScanned": merged.num_docs_scanned,
