@@ -54,6 +54,8 @@ class SegmentBlock:
         self._ids: Dict[str, jnp.ndarray] = {}
         self._raw: Dict[str, jnp.ndarray] = {}
         self._dict_vals: Dict[str, jnp.ndarray] = {}
+        self._decoded: Dict[str, jnp.ndarray] = {}
+        self._hll: Dict[tuple, tuple] = {}
         self._valid: Optional[jnp.ndarray] = None
         self._null: Dict[str, jnp.ndarray] = {}
 
@@ -117,11 +119,42 @@ class SegmentBlock:
         return self._null[col]
 
     def values(self, col: str) -> jnp.ndarray:
-        """Decoded numeric values on device regardless of encoding."""
+        """Decoded numeric values on device regardless of encoding.
+
+        Dict columns are decoded HOST-side once and the materialized array cached in
+        HBM — never `table[ids]` on device: the axon relay turns every device gather
+        into an extra host round trip per dispatch, so decode must not be in the
+        per-query kernel. This is the TPU analog of the reference's
+        `DataFetcher` value-buffer cache (`DataFetcher.java:47`).
+        """
         reader = self.segment.column(col)
-        if reader.has_dictionary:
-            return self.dict_values(col)[self.ids(col)]
-        return self.raw(col)
+        if not reader.has_dictionary:
+            return self.raw(col)
+        if col not in self._decoded:
+            vals = _narrow(np.asarray(reader.dictionary.values))
+            fwd = np.asarray(reader.fwd).astype(np.int64)
+            padded = np.zeros(self.padded, dtype=vals.dtype)
+            padded[:self.num_docs] = vals[fwd]
+            self._decoded[col] = jnp.asarray(padded)
+        return self._decoded[col]
+
+    def hll_arrays(self, col: str, p: int):
+        """Per-doc (bucket, rank) HLL update vectors, decoded host-side once.
+
+        Padding rows get bucket = 2**p (overflow slot dropped after segment_max) and
+        rank 0. Replaces the previous per-query `bucket_lut[ids]` device gathers."""
+        key = (col, p)
+        if key not in self._hll:
+            from ..query.executor import _hll_luts
+            reader = self.segment.column(col)
+            bucket_lut, rank_lut = _hll_luts(reader, p)
+            fwd = np.asarray(reader.fwd).astype(np.int64)
+            bucket = np.full(self.padded, 1 << p, dtype=np.int32)
+            rank = np.zeros(self.padded, dtype=np.int32)
+            bucket[:self.num_docs] = bucket_lut[fwd]
+            rank[:self.num_docs] = rank_lut[fwd]
+            self._hll[key] = (jnp.asarray(bucket), jnp.asarray(rank))
+        return self._hll[key]
 
 
 _BLOCK_ATTR = "_device_block"

@@ -4,10 +4,22 @@ This replaces the reference's entire per-segment operator chain
 (`FilterPlanNode` -> `DocIdSetOperator` -> `ProjectionOperator` -> `TransformOperator` ->
 `AggregationGroupByOrderByOperator`, SURVEY.md §3.1) with ONE XLA program per plan shape:
 
-    mask   = filter_tree(LUT gathers | vector compares | null bitmaps) & valid
+    mask   = filter_tree(id-interval compares | vector compares | null bitmaps) & valid
     key    = sum(group_ids * strides)        (dense dict-id keys, reference:
                                               DictionaryBasedGroupKeyGenerator.java:62)
-    partials = segment_sum/min/max over key  (masked rows -> overflow bucket)
+    partials = [mask; masked values] @ one_hot(key)   (ONE stacked matmul on the MXU)
+
+GATHER/SCATTER-FREE BY DESIGN: the TPU relay serializes every gather/scatter op into an
+extra host round trip per dispatch (~65ms each, measured), so the hot kernel uses only
+compares, selects, reductions and matmuls:
+
+* dict predicates -> id-interval compares (sorted dictionaries make EQ/RANGE/small-IN
+  contiguous id runs, resolved host-side at plan time);
+* dict decode -> host-materialized value columns cached in HBM (`datablock.values`);
+* group-by partials -> one-hot matmul `[rows, N] @ [N, keys]` when the key space is
+  small enough (the common OLAP case), per-key broadcast-reduce for min/max; scatter
+  (`segment_*`) only above the cap, where the matmul's N*K work would exceed the extra
+  round trip it avoids.
 
 There is no 10k-doc batching loop (`DocIdSetPlanNode.MAX_DOC_PER_CALL`): the TPU analog of
 batching is the grid XLA tiles over the padded row axis. Kernels are cached by structural
@@ -32,6 +44,12 @@ from .expr import eval_expr
 _INT_MIN_IDENT = np.iinfo(np.int32).max  # identity for masked-out min over int
 _INT_MAX_IDENT = np.iinfo(np.int32).min
 
+# Above these sizes the matmul / broadcast-reduce does more device work than the extra
+# relay round trip a scatter costs; below them it stays at the dispatch latency floor.
+MATMUL_KEY_CAP = 8192     # one-hot matmul group-by partials (count/sum), MXU-bound
+MINMAX_BCAST_CAP = 1024   # per-key broadcast-reduce min/max, VPU-bound
+DENSE_LUT_MATMUL_CAP = 8192  # scattered-LUT membership via one-hot matmul
+
 
 @dataclass
 class KernelSpec:
@@ -46,7 +64,8 @@ class KernelSpec:
     hll_params: Dict[int, int] = field(default_factory=dict)  # agg idx -> precision p
 
     # per-leaf runtime input routing, computed in __post_init__
-    lut_index: Dict[int, int] = field(default_factory=dict)
+    lut_index: Dict[int, int] = field(default_factory=dict)       # dense (scattered) LUTs
+    lut_interval: Dict[int, Tuple[int, int]] = field(default_factory=dict)  # (ioff, n)
     cmp_offset: Dict[int, Tuple[str, int]] = field(default_factory=dict)
     docset_index: Dict[int, int] = field(default_factory=dict)
 
@@ -55,8 +74,13 @@ class KernelSpec:
         ioff = foff = 0
         for i, leaf in enumerate(self.filter.leaves):
             if isinstance(leaf, LutLeaf):
-                self.lut_index[i] = luts
-                luts += 1
+                if leaf.intervals is not None:
+                    # interval bounds ride the int scalar stream: [lo0,hi0,lo1,hi1,...]
+                    self.lut_interval[i] = (ioff, len(leaf.intervals))
+                    ioff += 2 * len(leaf.intervals)
+                else:
+                    self.lut_index[i] = luts
+                    luts += 1
             elif isinstance(leaf, DocSetLeaf):
                 self.docset_index[i] = docsets
                 docsets += 1
@@ -110,7 +134,24 @@ def _make_mask_fn(spec: KernelSpec):
     def leaf_mask(i, ids, vals, luts, iscal, fscal, nulls, docsets):
         leaf = leaves[i]
         if isinstance(leaf, LutLeaf):
-            return luts[spec.lut_index[i]][ids[leaf.col]]
+            col_ids = ids[leaf.col]
+            if i in spec.lut_interval:
+                # id-interval membership: OR of range compares, zero gathers
+                off, n = spec.lut_interval[i]
+                if n == 0:
+                    return jnp.zeros(col_ids.shape, dtype=bool)
+                m = (col_ids >= iscal[off]) & (col_ids <= iscal[off + 1])
+                for j in range(1, n):
+                    m = m | ((col_ids >= iscal[off + 2 * j])
+                             & (col_ids <= iscal[off + 2 * j + 1]))
+                return m
+            lut = luts[spec.lut_index[i]]
+            if len(lut) <= DENSE_LUT_MATMUL_CAP:
+                # scattered-set membership as a one-hot matvec (gather-free; the
+                # one-hot fuses into the dot's tiles, it is never materialized)
+                oh = jax.nn.one_hot(col_ids.ravel(), len(lut), dtype=jnp.float32)
+                return (oh @ lut.astype(jnp.float32) > 0.5).reshape(col_ids.shape)
+            return lut[col_ids]  # huge scattered LUT: gather (slow relay path, rare)
         if isinstance(leaf, DocSetLeaf):
             return docsets[spec.docset_index[i]]
         if isinstance(leaf, NullLeaf):
@@ -163,7 +204,23 @@ def _make_mask_fn(spec: KernelSpec):
     return mask_fn
 
 
-def _build_kernel(spec: KernelSpec):
+def combine_collective(name: str, v, axis: str):
+    """The cross-device combine for one kernel output: partials agree on dense keys
+    (aligned dictionaries), so one ICI collective merges them."""
+    if name.endswith(".min"):
+        return jax.lax.pmin(v, axis)
+    if name.endswith(".max") or name.endswith(".hll"):
+        return jax.lax.pmax(v, axis)
+    return jax.lax.psum(v, axis)
+
+
+def make_kernel_body(spec: KernelSpec):
+    """The un-jitted fused scan body — shared between the single-device jit kernel and
+    the shard_map mesh kernel (which composes it with per-output ICI collectives)."""
+    return _make_body(spec)
+
+
+def _make_body(spec: KernelSpec):
     group = bool(spec.group_cols)
     num_seg = spec.num_keys_pad + 1  # +1 overflow bucket for masked-out rows
     mask_fn = _make_mask_fn(spec)
@@ -176,40 +233,80 @@ def _build_kernel(spec: KernelSpec):
             key = jnp.zeros_like(ids[spec.group_cols[0]])
             for gi, gc in enumerate(spec.group_cols):
                 key = key + ids[gc] * strides[gi]
-            key = jnp.where(mask, key, spec.num_keys_pad)
-            counts = jax.ops.segment_sum(jnp.ones_like(key), key, num_segments=num_seg)
-            out["count"] = counts
+            key = jnp.where(mask, key, spec.num_keys_pad).ravel()
+            fmask = mask.ravel().astype(jnp.float32)
+            # collect count + every sum row, then ONE stacked one-hot matmul:
+            # [1 + n_sums, N] @ one_hot(key)[N, num_seg] -> [1 + n_sums, num_seg]
+            sum_rows, sum_names = [fmask], ["count"]
+            minmax = []  # (out name, values, is_min)
             for ai, (agg, outs) in enumerate(spec.aggs):
                 v = _agg_arg(agg, vals)
                 for o in outs:
-                    if o == "count":
-                        continue  # shared counts
                     if o == "sum":
-                        out[f"{ai}.sum"] = jax.ops.segment_sum(
-                            jnp.where(mask, v.astype(jnp.float32), 0.0), key,
-                            num_segments=num_seg)
-                    elif o == "min":
-                        out[f"{ai}.min"] = jax.ops.segment_min(v, key, num_segments=num_seg)
-                    elif o == "max":
-                        out[f"{ai}.max"] = jax.ops.segment_max(v, key, num_segments=num_seg)
+                        sum_rows.append(v.ravel().astype(jnp.float32) * fmask)
+                        sum_names.append(f"{ai}.sum")
+                    elif o in ("min", "max"):
+                        minmax.append((f"{ai}.{o}", v.ravel(), o == "min"))
+            # f32 one-hot counts are exact only below 2^24 increments; the row count
+            # is static at trace time, so pick the exact int32 scatter when a single
+            # group could overflow the f32 integer range (keys.size is the bound).
+            count_exact_in_f32 = key.size < (1 << 24)
+            if num_seg <= MATMUL_KEY_CAP and count_exact_in_f32:
+                # one-hot is NOT materialized: XLA:TPU fuses its iota-compare into the
+                # matmul tiles (measured: N=8M, K=4096 runs in ~100ms on a 16GB chip —
+                # a dense [N, K] f32 operand would be 137GB). HIGHEST precision keeps
+                # the value operand in f32 on the MXU instead of bf16 truncation.
+                oh = jax.nn.one_hot(key, num_seg, dtype=jnp.float32)
+                partials = jax.lax.dot(jnp.stack(sum_rows), oh,
+                                       precision=jax.lax.Precision.HIGHEST)
+                for r, name in enumerate(sum_names):
+                    p = partials[r]
+                    out[name] = (jnp.round(p).astype(jnp.int32) if name == "count" else p)
+            else:
+                counts = jax.ops.segment_sum(mask.ravel().astype(jnp.int32), key,
+                                             num_segments=num_seg)
+                out["count"] = counts
+                for row, name in zip(sum_rows[1:], sum_names[1:]):
+                    out[name] = jax.ops.segment_sum(row, key, num_segments=num_seg)
+            for name, v, is_min in minmax:
+                if num_seg <= MINMAX_BCAST_CAP:
+                    ident = (_INT_MIN_IDENT if is_min else _INT_MAX_IDENT) \
+                        if v.dtype.kind == "i" else (jnp.inf if is_min else -jnp.inf)
+                    onehot = key[:, None] == jnp.arange(num_seg)[None, :]
+                    cells = jnp.where(onehot, v[:, None], ident)
+                    out[name] = cells.min(axis=0) if is_min else cells.max(axis=0)
+                else:
+                    op = jax.ops.segment_min if is_min else jax.ops.segment_max
+                    out[name] = op(v, key, num_segments=num_seg)
         else:
+            fmask = mask.ravel().astype(jnp.float32)
             out["count"] = mask.sum(dtype=jnp.int32)
             for ai, (agg, outs) in enumerate(spec.aggs):
                 if "distinct" in outs:
                     # exact distinct over a dict column: per-dict-id presence vector.
                     # Returned as a vector (not a count) because cross-segment merge
                     # needs the id set — dictionaries differ per segment.
-                    out[f"{ai}.distinct"] = jax.ops.segment_sum(
-                        mask.astype(jnp.int32), ids[agg.arg.name],
-                        num_segments=spec.distinct_lut_sizes[ai])
+                    size = spec.distinct_lut_sizes[ai]
+                    col_ids = ids[agg.arg.name].ravel()
+                    if size <= MATMUL_KEY_CAP:
+                        # f32 saturation above 2^24 rows per id cannot flip presence
+                        # (saturated counts stay >= 1); only presence>0 is consumed
+                        presence = jax.lax.dot(fmask[None, :],
+                                               jax.nn.one_hot(col_ids, size,
+                                                              dtype=jnp.float32),
+                                               precision=jax.lax.Precision.HIGHEST)[0]
+                        out[f"{ai}.distinct"] = jnp.round(presence).astype(jnp.int32)
+                    else:
+                        out[f"{ai}.distinct"] = jax.ops.segment_sum(
+                            mask.ravel().astype(jnp.int32), col_ids, num_segments=size)
                     continue
                 if "hll" in outs:
-                    # HLL register update: per-dict-id (bucket, rank) LUT gathers +
+                    # HLL register update from per-doc (bucket, rank) vectors
+                    # (host-materialized at block load — no LUT gathers here) +
                     # one segment_max — no hashing on device.
                     m = 1 << spec.hll_params[ai]
-                    col_ids = ids[agg.arg.name]
-                    bucket = jnp.where(mask, agg_luts[f"{ai}.bucket"][col_ids], m)
-                    rank = jnp.where(mask, agg_luts[f"{ai}.rank"][col_ids], 0)
+                    bucket = jnp.where(mask, agg_luts[f"{ai}.bucket"], m).ravel()
+                    rank = jnp.where(mask, agg_luts[f"{ai}.rank"], 0).ravel()
                     regs = jax.ops.segment_max(rank, bucket, num_segments=m + 1)[:m]
                     out[f"{ai}.hll"] = jnp.maximum(regs, 0)
                     continue
@@ -220,8 +317,7 @@ def _build_kernel(spec: KernelSpec):
                     if o == "count":
                         continue
                     if o == "sum":
-                        out[f"{ai}.sum"] = (v.astype(jnp.float32)
-                                            * mask.astype(jnp.float32)).sum()
+                        out[f"{ai}.sum"] = (v.ravel().astype(jnp.float32) * fmask).sum()
                     elif o == "min":
                         ident = _INT_MIN_IDENT if v.dtype.kind == "i" else jnp.inf
                         out[f"{ai}.min"] = jnp.where(mask, v, ident).min()
@@ -230,7 +326,11 @@ def _build_kernel(spec: KernelSpec):
                         out[f"{ai}.max"] = jnp.where(mask, v, ident).max()
         return out
 
-    return jax.jit(kernel)
+    return kernel
+
+
+def _build_kernel(spec: KernelSpec):
+    return jax.jit(_make_body(spec))
 
 
 def get_kernel(spec: KernelSpec):
@@ -242,11 +342,21 @@ def get_kernel(spec: KernelSpec):
     return fn
 
 
+def dispatch_kernel(spec: KernelSpec, inputs: KernelInputs):
+    """Asynchronously dispatch the fused kernel; returns unfetched device outputs.
+
+    Callers batch several dispatches and fetch them with ONE `jax.device_get` (the
+    relay charges a full host round trip per synchronization, so the fetch count —
+    not the FLOPs — is the latency floor)."""
+    return get_kernel(spec)(inputs.ids, inputs.vals, inputs.luts, inputs.iscal,
+                            inputs.fscal, inputs.nulls, inputs.valid, inputs.strides,
+                            inputs.agg_luts, inputs.docsets)
+
+
 def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
-    out = get_kernel(spec)(inputs.ids, inputs.vals, inputs.luts, inputs.iscal,
-                           inputs.fscal, inputs.nulls, inputs.valid, inputs.strides,
-                           inputs.agg_luts, inputs.docsets)
-    return {k: np.asarray(v) for k, v in out.items()}
+    # device_get, never np.asarray: asarray takes the synchronous per-leaf literal
+    # path on the relay (~7x slower than one batched device_get round trip)
+    return jax.device_get(dispatch_kernel(spec, inputs))
 
 
 def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
@@ -260,7 +370,7 @@ def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
         _KERNEL_CACHE[key] = fn
     out = fn(inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
              inputs.nulls, inputs.valid, inputs.docsets)
-    return np.asarray(out)
+    return jax.device_get(out)
 
 
 def _agg_arg(agg: AggFunc, vals) -> Optional[jnp.ndarray]:

@@ -142,7 +142,13 @@ class ServerQueryExecutor:
         for leaf in plan.filter_prog.leaves:
             if isinstance(leaf, LutLeaf):
                 ids_cols.add(leaf.col)
-                luts.append(jnp.asarray(leaf.lut))
+                if leaf.intervals is not None:
+                    # interval bounds ride the int scalar stream, in leaf order —
+                    # must mirror KernelSpec.__post_init__ routing exactly
+                    for lo, hi in leaf.intervals:
+                        iscal.extend((lo, hi))
+                else:
+                    luts.append(jnp.asarray(leaf.lut))
             elif isinstance(leaf, CmpLeaf):
                 vals_cols.update(identifiers_in(leaf.expr))
                 (iscal if leaf.is_int else fscal).extend(leaf.operands)
@@ -157,10 +163,10 @@ class ServerQueryExecutor:
             if "distinct" in agg.device_outputs:
                 ids_cols.add(agg.arg.name)
             elif "hll" in agg.device_outputs:
-                ids_cols.add(agg.arg.name)
-                bucket, rank = _hll_luts(plan.segment.column(agg.arg.name), agg.p)
-                agg_luts[f"{i}.bucket"] = jnp.asarray(bucket)
-                agg_luts[f"{i}.rank"] = jnp.asarray(rank)
+                # per-doc (bucket, rank) vectors, host-materialized once in the block
+                bucket, rank = block.hll_arrays(agg.arg.name, agg.p)
+                agg_luts[f"{i}.bucket"] = bucket
+                agg_luts[f"{i}.rank"] = rank
             elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
                                               and agg.arg.name == "*"):
                 vals_cols.update(identifiers_in(agg.arg))

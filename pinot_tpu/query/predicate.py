@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -29,18 +29,55 @@ from .context import QueryValidationError
 FilterTree = Tuple
 
 
+# A LUT whose true-set decomposes into at most this many contiguous id runs is
+# evaluated on device as interval compares over the id vector — zero gathers, zero
+# matmuls. Sorted dictionaries make this the common case: EQ is one run, RANGE is one
+# run, small IN-lists are <= k runs. (The axon TPU relay serializes every gather into
+# an extra host round trip, so gather-free filters are the difference between the
+# latency floor and multiples of it.)
+MAX_LUT_INTERVALS = 8
+
+
+def _lut_intervals(lut: np.ndarray) -> Optional[List[Tuple[int, int]]]:
+    """Decompose a boolean LUT into inclusive [lo, hi] runs of True, or None if the
+    decomposition exceeds MAX_LUT_INTERVALS (dense scattered sets: big IN / LIKE)."""
+    idx = np.flatnonzero(lut)
+    if len(idx) == 0:
+        return []
+    breaks = np.flatnonzero(np.diff(idx) > 1)
+    if len(breaks) + 1 > MAX_LUT_INTERVALS:
+        return None
+    starts = np.concatenate(([idx[0]], idx[breaks + 1]))
+    ends = np.concatenate((idx[breaks], [idx[-1]]))
+    return [(int(lo), int(hi)) for lo, hi in zip(starts, ends)]
+
+
 @dataclass
 class LutLeaf:
-    """Dict-column predicate resolved to a boolean LUT over dict ids."""
+    """Dict-column predicate resolved to a boolean LUT over dict ids.
+
+    `intervals` is the contiguous-run decomposition of the LUT (None when the true-set
+    is too scattered): the device kernel evaluates intervals as id-range compares with
+    runtime scalar operands, and falls back to a one-hot matmul (small dictionaries) or
+    a gather (large ones) only for scattered sets.
+    """
     col: str
     lut: np.ndarray  # bool[lut_size(card)] — padding ids map to False
+    intervals: Optional[List[Tuple[int, int]]] = field(default=None)
+
+    def __post_init__(self):
+        if self.intervals is None:
+            self.intervals = _lut_intervals(self.lut)
 
     @property
     def kind(self) -> str:
         return "lut"
 
     def signature(self) -> Tuple:
-        return ("lut", self.col, len(self.lut))
+        # interval count is structural (operand values are runtime inputs); scattered
+        # LUTs key on size only, their contents are runtime inputs too
+        mode = len(self.intervals) if self.intervals is not None else "dense"
+        return ("lut", self.col, len(self.lut), mode)
 
 
 @dataclass
