@@ -27,6 +27,10 @@ from .routing import RoutingManager
 # server handle: execute_partial(table, ctx, segment_names) -> SegmentResult
 ServerHandle = Callable[[str, QueryContext, Sequence[str]], SegmentResult]
 
+# "unbounded" LIMIT for synthesized leaf scans — one sentinel for both the in-proc
+# ctx and the SQL shipped to remote servers, so both transports behave identically
+UNBOUNDED_LIMIT = 1 << 40
+
 
 class Broker:
     def __init__(self, instance_id: str, catalog: Catalog,
@@ -117,14 +121,21 @@ class Broker:
             return self.catalog.schema_for_table(phys[0]) if phys else None
 
         def scan(raw_table: str, columns, filt):
+            from ..sql.ast import to_sql
             schema = schema_for(raw_table)
             rows: List[tuple] = []
+            # synthesized SQL lets remote (HTTP) server handles recompile the leaf
+            leaf_sql = f"SELECT {', '.join(columns)} FROM {raw_table}"
+            if filt is not None:
+                leaf_sql += f" WHERE {to_sql(filt)}"
+            leaf_sql += f" LIMIT {UNBOUNDED_LIMIT}"
             for table in self._physical_tables(raw_table):
                 ctx = QueryContext(
                     table=table,
                     select_items=[(Identifier(c), c) for c in columns],
                     filter=filt, group_by=[], aggregations=[], having=None,
-                    order_by=[], limit=1 << 62, offset=0, distinct=False)
+                    order_by=[], limit=UNBOUNDED_LIMIT, offset=0, distinct=False,
+                    sql=leaf_sql)
                 routing = self.routing.route_query(table, ctx)
                 futures = {}
                 for server_id, segments in routing.items():

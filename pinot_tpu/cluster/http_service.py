@@ -1,0 +1,147 @@
+"""Minimal HTTP service harness + client used by every cluster role.
+
+Transport analog of the reference's role endpoints: the broker/server/controller all
+embed an HTTP server (reference: Jersey/Grizzly admin apps, Netty query server
+`core/transport/QueryServer.java`, completion handlers
+`controller/api/resources/LLCSegmentCompletionHandlers.java`). One threaded HTTP
+server per role; routes are registered as callables. The data plane (query dispatch,
+result blocks) rides the binary wire format from `wire.py`; the control plane
+(catalog, completion, admin) is JSON.
+
+Design note (TPU-first): the per-host data plane stays on DCN/TCP like the
+reference's; on-slice combine is ICI collectives inside pjit (parallel/combine.py).
+This module is deliberately dependency-free (stdlib http.server) so a role process
+starts in milliseconds in tests.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# route handler: (path_parts, query_params, body) -> (status, content_type, body_bytes)
+RouteHandler = Callable[[list, Dict[str, str], bytes], Tuple[int, str, bytes]]
+
+
+def json_response(obj: Any, status: int = 200) -> Tuple[int, str, bytes]:
+    return status, "application/json", json.dumps(obj).encode()
+
+
+def binary_response(data: bytes, status: int = 200) -> Tuple[int, str, bytes]:
+    return status, "application/octet-stream", data
+
+
+def error_response(msg: str, status: int = 500) -> Tuple[int, str, bytes]:
+    return status, "application/json", json.dumps({"error": msg}).encode()
+
+
+class HttpService:
+    """A role's HTTP endpoint: register routes, serve on a daemon thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes: Dict[Tuple[str, str], RouteHandler] = {}
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # silence per-request stderr noise
+                pass
+
+            def _dispatch(self, method: str) -> None:
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                handler = service._routes.get((method, parts[0] if parts else ""))
+                if handler is None:
+                    status, ctype, data = error_response("not found", 404)
+                else:
+                    try:
+                        status, ctype, data = handler(parts[1:], params, body)
+                    except Exception as e:  # surfaced to caller, not fatal to server
+                        status, ctype, data = error_response(
+                            f"{type(e).__name__}: {e}", 500)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def route(self, method: str, head: str, handler: RouteHandler) -> None:
+        """Register a handler for `METHOD /head/...` (first path component match)."""
+        self._routes[(method, head)] = handler
+
+    def start(self) -> "HttpService":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"http-{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+def http_call(method: str, url: str, body: Optional[bytes] = None,
+              timeout: float = 30.0, retries: int = 0,
+              content_type: str = "application/json") -> bytes:
+    """One HTTP request with optional connection-failure retries (reference:
+    broker's retry/exponential-backoff in BaseExponentialBackoffRetryFailureDetector
+    — here a bounded linear retry; callers decide unhealthy-marking)."""
+    last: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        try:
+            req = urllib.request.Request(url, data=body, method=method,
+                                         headers={"Content-Type": content_type})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise HttpError(e.code, e.read().decode(errors="replace")) from None
+        except (urllib.error.URLError, socket.timeout, ConnectionError, OSError) as e:
+            last = e
+            if attempt < retries:
+                time.sleep(0.05 * (attempt + 1))
+    raise ConnectionError(f"{method} {url} failed: {last}") from last
+
+
+def get_json(url: str, timeout: float = 30.0, retries: int = 0) -> Any:
+    return json.loads(http_call("GET", url, timeout=timeout, retries=retries).decode())
+
+
+def post_json(url: str, obj: Any, timeout: float = 30.0, retries: int = 0) -> Any:
+    data = json.dumps(obj).encode()
+    return json.loads(http_call("POST", url, data, timeout=timeout,
+                                retries=retries).decode())

@@ -1,0 +1,240 @@
+"""Multi-process cluster: each role as an OS process, joined over HTTP.
+
+This is the real deployment shape (reference: one JVM per role started by
+`PinotAdministrator` Start*Command; here one Python process per role started by
+`python -m pinot_tpu.cluster.process` or the admin CLI). The controller owns the
+catalog + deep store; servers and brokers join with `RemoteCatalog` (watch-based
+mirror) and talk data-plane over the binary wire format.
+
+`ProcessCluster` is the test/quickstart harness that spawns the processes and waits
+for readiness (reference: ClusterTest boots embedded roles; here they are genuinely
+separate processes so a kill is a real process death).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.parse
+from typing import Dict, List, Optional, Sequence
+
+from .http_service import HttpError, get_json, http_call, post_json
+
+
+def _write_ready(run_dir: str, name: str, payload: Dict) -> None:
+    path = os.path.join(run_dir, f"{name}.ready")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def run_controller(work_dir: str, run_dir: str, port: int = 0) -> None:
+    from .catalog import Catalog
+    from .controller import Controller
+    from .deepstore import LocalDeepStore
+    from .services import ControllerService
+
+    catalog = Catalog()
+    deepstore = LocalDeepStore(os.path.join(work_dir, "deepstore"))
+    controller = Controller("controller_0", catalog, deepstore,
+                            os.path.join(work_dir, "controller"))
+    svc = ControllerService(controller, port=port)
+    _write_ready(run_dir, "controller_0", {"url": svc.url})
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+
+
+def run_server(controller_url: str, instance_id: str, work_dir: str,
+               run_dir: str, port: int = 0) -> None:
+    from .remote import ControllerDeepStore, RemoteCatalog, RemoteCompletion
+    from .server import ServerNode
+    from .services import ServerService
+
+    catalog = RemoteCatalog(controller_url)
+    deepstore = ControllerDeepStore(controller_url)
+    server = ServerNode(instance_id, catalog, deepstore,
+                        os.path.join(work_dir, instance_id),
+                        completion=RemoteCompletion(controller_url))
+    svc = ServerService(server, port=port)
+    _write_ready(run_dir, instance_id, {"url": svc.url})
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+
+
+def run_broker(controller_url: str, instance_id: str, run_dir: str,
+               port: int = 0) -> None:
+    from .broker import Broker
+    from .remote import RemoteCatalog
+    from .services import BrokerService
+
+    catalog = RemoteCatalog(controller_url)
+    broker = Broker(instance_id, catalog)
+    svc = BrokerService(broker, port=port)
+    _write_ready(run_dir, instance_id, {"url": svc.url})
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="pinot_tpu.cluster.process")
+    p.add_argument("--role", required=True,
+                   choices=["controller", "server", "broker"])
+    p.add_argument("--controller-url", default="")
+    p.add_argument("--instance-id", default="")
+    p.add_argument("--work-dir", default="")
+    p.add_argument("--run-dir", required=True)
+    p.add_argument("--port", type=int, default=0)
+    a = p.parse_args(argv)
+    if a.role == "controller":
+        run_controller(a.work_dir, a.run_dir, a.port)
+    elif a.role == "server":
+        run_server(a.controller_url, a.instance_id, a.work_dir, a.run_dir, a.port)
+    else:
+        run_broker(a.controller_url, a.instance_id, a.run_dir, a.port)
+
+
+class ControllerClient:
+    """HTTP admin client for a controller (reference: the java-client /
+    controller REST API consumers)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def add_schema(self, schema) -> None:
+        post_json(f"{self.url}/schemas", schema.to_json())
+
+    def add_table(self, config, num_partitions: int = 1) -> Dict:
+        return post_json(f"{self.url}/tables",
+                         {"config": config.to_json(),
+                          "numPartitions": num_partitions})
+
+    def drop_table(self, table: str) -> None:
+        http_call("DELETE", f"{self.url}/tables/{table}")
+
+    def upload_segment(self, table: str, segment_dir: str) -> Dict:
+        """Tar a built segment dir and push it (reference: segment tar push)."""
+        from .deepstore import tar_segment
+        name = os.path.basename(segment_dir.rstrip("/"))
+        with tempfile.TemporaryDirectory() as tmp:
+            tar_path = os.path.join(tmp, f"{name}.tar.gz")
+            tar_segment(segment_dir, tar_path)
+            with open(tar_path, "rb") as f:
+                payload = f.read()
+        q = urllib.parse.urlencode({"name": name})
+        return json.loads(http_call(
+            "POST", f"{self.url}/segments/{table}?{q}", payload,
+            content_type="application/octet-stream", timeout=120.0).decode())
+
+    def table_status(self, table: str) -> Dict:
+        return get_json(f"{self.url}/tableStatus/{table}")
+
+
+class BrokerClient:
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def query(self, sql: str, timeout: float = 120.0) -> Dict:
+        return post_json(f"{self.url}/query", {"sql": sql}, timeout=timeout)
+
+
+class ProcessCluster:
+    """Spawn controller + N servers + broker as OS processes and wait for ready.
+
+    Server processes are pinned to CPU JAX by default (`JAX_PLATFORMS=cpu`) so a
+    test cluster doesn't fight over the single TPU; production servers would own
+    their chip(s).
+    """
+
+    def __init__(self, num_servers: int = 2, work_dir: Optional[str] = None,
+                 server_env: Optional[Dict[str, str]] = None,
+                 startup_timeout_s: float = 60.0):
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="pinot_tpu_proc_")
+        self.run_dir = os.path.join(self.work_dir, "run")
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self._timeout = startup_timeout_s
+
+        env = dict(os.environ)
+        # scrub any TPU-tunnel plugin hooks: role subprocesses default to CPU jax
+        # (same scrub as tests/conftest.py); production servers own their chips.
+        env["JAX_PLATFORMS"] = env.get("PINOT_TPU_SUBPROCESS_PLATFORM", "cpu")
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p) or os.getcwd()
+        env.pop("XLA_FLAGS", None)
+        if server_env:
+            env.update(server_env)
+        self._env = env
+
+        self._spawn("controller_0", ["--role", "controller",
+                                     "--work-dir", self.work_dir])
+        self.controller_url = self._await_ready("controller_0")
+        for i in range(num_servers):
+            sid = f"server_{i}"
+            self._spawn(sid, ["--role", "server", "--instance-id", sid,
+                              "--controller-url", self.controller_url,
+                              "--work-dir", self.work_dir])
+        for i in range(num_servers):
+            self._await_ready(f"server_{i}")
+        self._spawn("broker_0", ["--role", "broker", "--instance-id", "broker_0",
+                                 "--controller-url", self.controller_url])
+        self.broker_url = self._await_ready("broker_0")
+        self.controller = ControllerClient(self.controller_url)
+        self.broker = BrokerClient(self.broker_url)
+
+    def _spawn(self, name: str, args: List[str]) -> None:
+        cmd = [sys.executable, "-m", "pinot_tpu.cluster.process",
+               "--run-dir", self.run_dir] + args
+        with open(os.path.join(self.run_dir, f"{name}.log"), "wb") as log:
+            # the child holds its own dup of the fd; close the parent's copy
+            self.procs[name] = subprocess.Popen(
+                cmd, env=self._env, stdout=log, stderr=subprocess.STDOUT)
+
+    def _await_ready(self, name: str) -> str:
+        path = os.path.join(self.run_dir, f"{name}.ready")
+        deadline = time.time() + self._timeout
+        while time.time() < deadline:
+            if os.path.exists(path):
+                with open(path) as f:
+                    return json.load(f)["url"]
+            proc = self.procs.get(name)
+            if proc is not None and proc.poll() is not None:
+                log = open(os.path.join(self.run_dir, f"{name}.log")).read()
+                raise RuntimeError(f"{name} died at startup:\n{log[-4000:]}")
+            time.sleep(0.05)
+        raise TimeoutError(f"{name} not ready after {self._timeout}s")
+
+    def query(self, sql: str) -> Dict:
+        return self.broker.query(sql)
+
+    def kill_server(self, instance_id: str) -> None:
+        """SIGKILL a server process — a real process death, not a flag flip."""
+        proc = self.procs.get(instance_id)
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+
+    def shutdown(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def __enter__(self) -> "ProcessCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,217 @@
+"""Remote proxies: HTTP clients that let server/broker processes join a cluster.
+
+The reference keeps all cluster state in ZooKeeper and every role watches it via Helix;
+here the controller process is the authoritative metadata owner (catalog.py) and
+remote roles mirror it through `RemoteCatalog` — a version-stamped snapshot poll with
+long-poll watches (the ZK-watch analog). Mutations initiated by remote roles
+(instance registration, external-view reports) are POSTed to the controller, then
+reflected locally on the next snapshot.
+
+Also here: `RemoteCompletion` (the server's HTTP client for the segment completion
+protocol — reference: `ServerSegmentCompletionProtocolHandler` POSTing to
+`LLCSegmentCompletionHandlers`), `RemoteServerHandle` (the broker's query dispatch to
+a server over HTTP — reference: `QueryRouter.submitQuery` over Netty), and
+`ControllerDeepStore` (segment fetch by URL through the controller — reference:
+`SegmentFetcherFactory` http scheme).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import urllib.parse
+from typing import Dict, Optional, Sequence
+
+from ..schema import Schema
+from ..table import TableConfig
+from .catalog import Catalog, InstanceInfo, SegmentMeta
+from .deepstore import DeepStoreFS, tar_segment, untar_segment
+from .http_service import HttpError, get_json, http_call, post_json
+from .wire import decode_segment_result, encode_query_request
+
+
+class RemoteCatalog(Catalog):
+    """Catalog mirror for a remote role process.
+
+    Reads are served from the local mirror (refreshed by a watch thread); the
+    mutations a remote role performs are forwarded to the controller. Watch events
+    fire exactly like the in-proc catalog's, driven by snapshot diffs.
+    """
+
+    def __init__(self, controller_url: str, poll_timeout_s: float = 10.0):
+        super().__init__()
+        self.controller_url = controller_url.rstrip("/")
+        self._version = -1
+        self._poll_timeout_s = poll_timeout_s
+        self._stop = threading.Event()
+        self._refresh()  # initial sync before any subscriber exists
+        self._thread = threading.Thread(target=self._watch_loop,
+                                        name="catalog-watch", daemon=True)
+        self._thread.start()
+
+    # -- remote-forwarded mutations ----------------------------------------
+    def register_instance(self, info: InstanceInfo) -> None:
+        post_json(f"{self.controller_url}/catalog/instances", info.to_json(),
+                  retries=2)
+        super().register_instance(info)
+
+    def report_state(self, table: str, segment: str, server: str, state) -> None:
+        post_json(f"{self.controller_url}/catalog/externalView",
+                  {"table": table, "segment": segment, "server": server,
+                   "state": state}, retries=2)
+        super().report_state(table, segment, server, state)
+
+    def set_instance_alive(self, instance_id: str, alive: bool) -> None:
+        post_json(f"{self.controller_url}/catalog/instances",
+                  {"instance_id": instance_id, "alive": alive}, retries=2)
+        super().set_instance_alive(instance_id, alive)
+
+    # -- watch loop ----------------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                resp = get_json(f"{self.controller_url}/catalog/watch"
+                                f"?since={self._version}"
+                                f"&timeoutSec={self._poll_timeout_s}",
+                                timeout=self._poll_timeout_s + 10)
+                if resp.get("version", -1) != self._version:
+                    self._refresh()
+            except (ConnectionError, HttpError):
+                if self._stop.wait(0.5):
+                    return
+
+    def _refresh(self) -> None:
+        snap = get_json(f"{self.controller_url}/catalog/snapshot", retries=2)
+        with self._lock:
+            old_ideal = self.ideal_state
+            old_ev = self.external_view
+            old_tables = set(self.table_configs)
+            old_instances = {k: (v.alive, v.port) for k, v in self.instances.items()}
+
+            self.schemas = {k: Schema.from_json(v)
+                            for k, v in snap["schemas"].items()}
+            self.table_configs = {k: TableConfig.from_json(v)
+                                  for k, v in snap["tableConfigs"].items()}
+            self.segments = {t: {s: SegmentMeta.from_json(m)
+                                 for s, m in segs.items()}
+                             for t, segs in snap["segments"].items()}
+            self.ideal_state = snap["idealState"]
+            self.external_view = snap["externalView"]
+            self.instances = {k: InstanceInfo.from_json(v)
+                              for k, v in snap["instances"].items()}
+            self.properties = snap.get("properties", {})
+            self._version = snap["version"]
+
+            ideal_changed = [t for t in set(old_ideal) | set(self.ideal_state)
+                             if old_ideal.get(t) != self.ideal_state.get(t)]
+            ev_changed = [t for t in set(old_ev) | set(self.external_view)
+                          if old_ev.get(t) != self.external_view.get(t)]
+            table_changed = list(old_tables ^ set(self.table_configs))
+            inst_changed = [
+                k for k, v in self.instances.items()
+                if old_instances.get(k) != (v.alive, v.port)
+            ] + [k for k in old_instances if k not in self.instances]
+
+        for t in table_changed:
+            self._notify("table", t)
+        for t in ideal_changed:
+            self._notify("ideal_state", t)
+        for t in ev_changed:
+            self._notify("external_view", t)
+        for i in inst_changed:
+            self._notify("instance", i)
+
+
+class RemoteCompletion:
+    """Server-side HTTP client for the segment completion protocol (reference:
+    `ServerSegmentCompletionProtocolHandler` — segmentConsumed / segmentCommitStart /
+    segmentCommit with file upload, against `LLCSegmentCompletionHandlers`)."""
+
+    def __init__(self, controller_url: str):
+        self.controller_url = controller_url.rstrip("/")
+
+    def segment_consumed(self, segment: str, server: str, offset: int):
+        return post_json(f"{self.controller_url}/segmentConsumed",
+                         {"segment": segment, "server": server, "offset": offset},
+                         retries=2)
+
+    def segment_commit_start(self, segment: str, server: str) -> str:
+        return post_json(f"{self.controller_url}/segmentCommitStart",
+                         {"segment": segment, "server": server}, retries=2)["status"]
+
+    def segment_commit_end(self, segment: str, server: str, segment_dir: str,
+                           end_offset: int) -> str:
+        """Tar the locally built segment and upload it with the commit-end call
+        (reference: commitSegment = segmentCommitEndWithMetadata + file upload)."""
+        with tempfile.TemporaryDirectory() as tmp:
+            tar_path = os.path.join(tmp, f"{segment}.tar.gz")
+            tar_segment(segment_dir, tar_path)
+            with open(tar_path, "rb") as f:
+                payload = f.read()
+        q = urllib.parse.urlencode({"segment": segment, "server": server,
+                                    "offset": end_offset})
+        resp = http_call("POST", f"{self.controller_url}/segmentCommitEnd?{q}",
+                         payload, content_type="application/octet-stream",
+                         timeout=120.0)
+        return json.loads(resp.decode())["status"]
+
+
+class RemoteServerHandle:
+    """Broker -> server query dispatch over HTTP; matches the in-proc
+    `ServerHandle` signature (reference: QueryRouter.submitQuery + DataTable
+    deserialize on response)."""
+
+    def __init__(self, server_url: str, timeout_s: float = 60.0):
+        self.server_url = server_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def __call__(self, table: str, ctx, segment_names: Sequence[str]):
+        sql = ctx if isinstance(ctx, str) else ctx.sql
+        if not sql:
+            raise ValueError("remote dispatch requires the query SQL text")
+        body = encode_query_request(table, sql, segment_names)
+        resp = http_call("POST", f"{self.server_url}/query", body,
+                         timeout=self.timeout_s,
+                         content_type="application/octet-stream")
+        return decode_segment_result(resp)
+
+
+class ControllerDeepStore(DeepStoreFS):
+    """Deep-store access proxied through the controller by URL (reference: the http
+    segment-fetcher scheme in `SegmentFetcherFactory`; servers without direct
+    deep-store credentials download through the controller)."""
+
+    scheme = "http"
+
+    def __init__(self, controller_url: str):
+        self.controller_url = controller_url.rstrip("/")
+
+    def upload(self, local_path: str, uri: str) -> None:
+        with open(local_path, "rb") as f:
+            http_call("POST", f"{self.controller_url}/deepstore/{uri}", f.read(),
+                      content_type="application/octet-stream", timeout=120.0)
+
+    def download(self, uri: str, local_path: str) -> None:
+        data = http_call("GET", f"{self.controller_url}/deepstore/{uri}",
+                         timeout=120.0, retries=2)
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(data)
+
+    def delete(self, uri: str) -> None:
+        http_call("DELETE", f"{self.controller_url}/deepstore/{uri}")
+
+    def exists(self, uri: str) -> bool:
+        try:
+            get_json(f"{self.controller_url}/deepstore-exists/{uri}")
+            return True
+        except HttpError:
+            return False
+
+    def listdir(self, uri: str) -> list:
+        return get_json(f"{self.controller_url}/deepstore-list/{uri}")

@@ -1,0 +1,298 @@
+"""HTTP services for each cluster role: controller, server, broker.
+
+These wrap the in-proc role objects (controller.py / server.py / broker.py) with the
+HTTP endpoints the reference exposes:
+
+* ControllerService — table/schema CRUD + segment upload/download
+  (`controller/api/resources/PinotSegmentUploadDownloadRestletResource.java`),
+  segment completion protocol (`LLCSegmentCompletionHandlers.java`), and the
+  catalog API standing in for ZooKeeper (snapshot + long-poll watch).
+* ServerService — the query endpoint (`core/transport/InstanceRequestHandler.java:96`
+  over Netty in the reference; HTTP/binary wire here).
+* BrokerService — SQL entry (`pinot-broker/api/resources/PinotClientRequest.java`
+  POST /query/sql).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Dict, Optional
+
+from ..schema import Schema
+from ..table import TableConfig
+from .broker import Broker
+from .catalog import Catalog, InstanceInfo
+from .controller import Controller
+from .http_service import (HttpService, binary_response, error_response,
+                           json_response)
+from .deepstore import untar_segment
+from .remote import RemoteServerHandle
+from .server import ServerNode
+from .wire import decode_query_request, encode_segment_result
+
+
+def _untar_body(body: bytes, name: str, dest: str) -> str:
+    """Write an uploaded segment tar to disk and unpack it; returns the segment dir."""
+    tar_path = os.path.join(dest, f"{name}.tar.gz")
+    with open(tar_path, "wb") as f:
+        f.write(body)
+    return untar_segment(tar_path, dest)
+
+
+class ControllerService:
+    """Controller role process: owns the authoritative catalog + deep store."""
+
+    def __init__(self, controller: Controller, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.controller = controller
+        self.catalog = controller.catalog
+        self.http = HttpService(host, port)
+        self._version = 0
+        self._version_cv = threading.Condition()
+        self.catalog.subscribe(self._bump_version)
+        s = self.http
+        s.route("GET", "health", lambda p, q, b: json_response({"status": "OK"}))
+        s.route("GET", "catalog", self._catalog_get)
+        s.route("POST", "catalog", self._catalog_post)
+        s.route("POST", "schemas", self._post_schema)
+        s.route("POST", "tables", self._post_table)
+        s.route("DELETE", "tables", self._delete_table)
+        s.route("POST", "segments", self._post_segment)
+        s.route("GET", "segments", self._get_segment)
+        s.route("DELETE", "segments", self._delete_segment)
+        s.route("POST", "segmentConsumed", self._segment_consumed)
+        s.route("POST", "segmentCommitStart", self._segment_commit_start)
+        s.route("POST", "segmentCommitEnd", self._segment_commit_end)
+        s.route("GET", "deepstore", self._deepstore_get)
+        s.route("POST", "deepstore", self._deepstore_post)
+        s.route("GET", "tableStatus", self._table_status)
+        self.http.start()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    # -- catalog API (the ZooKeeper stand-in) -------------------------------
+    def _bump_version(self, event: str, table: str) -> None:
+        with self._version_cv:
+            self._version += 1
+            self._version_cv.notify_all()
+
+    def _catalog_get(self, parts, params, body):
+        if parts and parts[0] == "snapshot":
+            with self.catalog._lock:
+                snap = {
+                    "version": self._version,
+                    "schemas": {k: v.to_json()
+                                for k, v in self.catalog.schemas.items()},
+                    "tableConfigs": {k: v.to_json()
+                                     for k, v in self.catalog.table_configs.items()},
+                    "segments": {t: {s: m.to_json() for s, m in segs.items()}
+                                 for t, segs in self.catalog.segments.items()},
+                    "idealState": self.catalog.ideal_state,
+                    "externalView": self.catalog.external_view,
+                    "instances": {k: v.to_json()
+                                  for k, v in self.catalog.instances.items()},
+                    "properties": self.catalog.properties,
+                }
+            return json_response(snap)
+        if parts and parts[0] == "watch":
+            since = int(params.get("since", -1))
+            timeout = float(params.get("timeoutSec", 10.0))
+            with self._version_cv:
+                self._version_cv.wait_for(lambda: self._version != since,
+                                          timeout=timeout)
+                return json_response({"version": self._version})
+        return error_response("not found", 404)
+
+    def _catalog_post(self, parts, params, body):
+        d = json.loads(body.decode())
+        if parts and parts[0] == "instances":
+            if "role" in d:
+                self.catalog.register_instance(InstanceInfo.from_json(d))
+            else:  # liveness update
+                self.catalog.set_instance_alive(d["instance_id"], d["alive"])
+            return json_response({"status": "OK"})
+        if parts and parts[0] == "externalView":
+            self.catalog.report_state(d["table"], d["segment"], d["server"],
+                                      d["state"])
+            return json_response({"status": "OK"})
+        return error_response("not found", 404)
+
+    # -- admin: schemas / tables / segments ---------------------------------
+    def _post_schema(self, parts, params, body):
+        self.controller.add_schema(Schema.from_json(json.loads(body.decode())))
+        return json_response({"status": "OK"})
+
+    def _post_table(self, parts, params, body):
+        d = json.loads(body.decode())
+        cfg = TableConfig.from_json(d["config"] if "config" in d else d)
+        if cfg.stream is not None:
+            segs = self.controller.add_realtime_table(
+                cfg, int(d.get("numPartitions", 1)))
+            return json_response({"status": "OK", "consumingSegments": segs})
+        self.controller.add_table(cfg)
+        return json_response({"status": "OK"})
+
+    def _delete_table(self, parts, params, body):
+        self.controller.drop_table(parts[0])
+        return json_response({"status": "OK"})
+
+    def _post_segment(self, parts, params, body):
+        """POST /segments/{tableNameWithType}?name=... with the tar as the body
+        (reference: segment push via PinotSegmentUploadDownloadRestletResource)."""
+        table = parts[0]
+        name = params["name"]
+        with tempfile.TemporaryDirectory() as tmp:
+            seg_dir = _untar_body(body, name, tmp)
+            meta = self.controller.upload_segment(table, seg_dir)
+        return json_response({"status": "OK", "segment": meta.name})
+
+    def _get_segment(self, parts, params, body):
+        """GET /segments/{table}/{name} — download the committed tar by URL."""
+        table, name = parts[0], parts[1]
+        meta = self.catalog.segments.get(table, {}).get(name)
+        if meta is None or not meta.download_path:
+            return error_response(f"no such segment {table}/{name}", 404)
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "seg.tar.gz")
+            self.controller.deepstore.download(meta.download_path, local)
+            with open(local, "rb") as f:
+                return binary_response(f.read())
+
+    def _delete_segment(self, parts, params, body):
+        self.controller.delete_segment(parts[0], parts[1])
+        return json_response({"status": "OK"})
+
+    def _table_status(self, parts, params, body):
+        return json_response(self.controller.table_status(parts[0]))
+
+    # -- segment completion protocol ----------------------------------------
+    def _segment_consumed(self, parts, params, body):
+        d = json.loads(body.decode())
+        return json_response(self.controller.llc.segment_consumed(
+            d["segment"], d["server"], int(d["offset"])))
+
+    def _segment_commit_start(self, parts, params, body):
+        d = json.loads(body.decode())
+        return json_response({"status": self.controller.llc.segment_commit_start(
+            d["segment"], d["server"])})
+
+    def _segment_commit_end(self, parts, params, body):
+        """Commit with segment upload: body is the built segment tar."""
+        segment = params["segment"]
+        server = params["server"]
+        offset = int(params["offset"])
+        with tempfile.TemporaryDirectory() as tmp:
+            seg_dir = _untar_body(body, segment, tmp)
+            status = self.controller.llc.segment_commit_end(
+                segment, server, seg_dir, offset)
+        return json_response({"status": status})
+
+    # -- deep-store proxy ----------------------------------------------------
+    def _deepstore_get(self, parts, params, body):
+        uri = "/".join(parts)
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "blob")
+            self.controller.deepstore.download(uri, local)
+            with open(local, "rb") as f:
+                return binary_response(f.read())
+
+    def _deepstore_post(self, parts, params, body):
+        uri = "/".join(parts)
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "blob")
+            with open(local, "wb") as f:
+                f.write(body)
+            self.controller.deepstore.upload(local, uri)
+        return json_response({"status": "OK"})
+
+
+class ServerService:
+    """Server role process: query endpoint over the binary wire format."""
+
+    def __init__(self, server: ServerNode, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self.http = HttpService(host, port)
+        self.http.route("POST", "query", self._query)
+        self.http.route("GET", "health", lambda p, q, b: json_response(
+            {"status": "OK", "instance": server.instance_id}))
+        self.http.route("GET", "segments", self._segments)
+        self.http.start()
+        # advertise the query endpoint so brokers can find us (reference: Helix
+        # instance config carries host/port)
+        info = server.catalog.instances.get(server.instance_id)
+        tags = info.tags if info else ["DefaultTenant"]
+        server.catalog.register_instance(InstanceInfo(
+            server.instance_id, "server", host=self.http.host,
+            port=self.http.port, tags=tags))
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    def _query(self, parts, params, body):
+        req = decode_query_request(body)
+        result = self.server.execute_partial(req["table"], req["sql"],
+                                             req["segments"])
+        return binary_response(encode_segment_result(result))
+
+    def _segments(self, parts, params, body):
+        return json_response({"segments": self.server.segments_served(parts[0])})
+
+
+class BrokerService:
+    """Broker role process: SQL entry over HTTP; discovers servers via catalog."""
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0):
+        self.broker = broker
+        self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
+        self.http = HttpService(host, port)
+        self.http.route("POST", "query", self._query)
+        self.http.route("GET", "health",
+                        lambda p, q, b: json_response({"status": "OK"}))
+        self._wire_server_handles()
+        broker.catalog.subscribe(self._on_event)
+        self.http.start()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    def _on_event(self, event: str, _key: str) -> None:
+        if event == "instance":
+            self._wire_server_handles()
+
+    def _wire_server_handles(self) -> None:
+        """Register an HTTP handle for every advertised live server instance.
+
+        Only new/changed endpoints are (re)registered — re-registering marks the
+        server healthy, which must not resurrect a server the failure detector
+        already excluded (reference: routing exclusion survives until the
+        detector's retry probe succeeds)."""
+        for info in list(self.broker.catalog.instances.values()):
+            if info.role != "server" or not info.port or not info.alive:
+                continue
+            url = f"http://{info.host}:{info.port}"
+            if self._registered.get(info.instance_id) == url:
+                continue
+            self._registered[info.instance_id] = url
+            self.broker.register_server_handle(info.instance_id,
+                                               RemoteServerHandle(url))
+
+    def _query(self, parts, params, body):
+        d = json.loads(body.decode())
+        result = self.broker.handle_query(d["sql"])
+        return json_response(result.to_json())

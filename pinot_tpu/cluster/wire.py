@@ -1,0 +1,239 @@
+"""Wire format: versioned binary serialization for query requests and partial results.
+
+Analog of the reference's versioned DataTable wire format
+(`pinot-core/.../common/datatable/DataTableImplV3.java`, `DataTableFactory.java:31-60`)
+plus the thrift `InstanceRequest` (`pinot-common/src/thrift/request.thrift`). The
+reference serializes row-major blocks with a typed DataSchema; here a `SegmentResult`
+(our IntermediateResultsBlock) carries heterogeneous aggregation *states* — numpy
+arrays (HLL registers), sketch objects, tuples — so the codec is a small
+self-describing tagged binary format with a registry for sketch types. No pickle:
+every byte on the wire is produced and parsed by this module.
+
+Layout: `MAGIC(4) | version(u8) | tagged-value tree`. Tags are single ASCII bytes;
+containers carry u32 counts; ndarrays carry dtype-string + shape + raw little-endian
+bytes (TPU-friendly: the receiving side can hand the buffer straight to jnp).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from io import BytesIO
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+from ..query.reduce import SegmentResult
+
+MAGIC = b"PTPU"
+VERSION = 1
+
+# -- object registry (sketch states etc.) -----------------------------------
+# name -> (type, to_bytes, from_bytes); mirrors the reference's custom serde for
+# sketch aggregation intermediates (ObjectSerDeUtils in pinot-core).
+_OBJ_REGISTRY: Dict[str, Tuple[type, Callable[[Any], bytes], Callable[[bytes], Any]]] = {}
+_OBJ_BY_TYPE: Dict[type, str] = {}
+
+
+def register_wire_type(name: str, cls: type, to_bytes: Callable[[Any], bytes],
+                       from_bytes: Callable[[bytes], Any]) -> None:
+    _OBJ_REGISTRY[name] = (cls, to_bytes, from_bytes)
+    _OBJ_BY_TYPE[cls] = name
+
+
+def _register_builtin_types() -> None:
+    from ..query.sketches import TDigest, ThetaSketch
+    register_wire_type("theta", ThetaSketch, lambda s: s.to_bytes(),
+                       ThetaSketch.from_bytes)
+    register_wire_type("tdigest", TDigest, lambda s: s.to_bytes(), TDigest.from_bytes)
+
+
+_register_builtin_types()
+
+
+# -- tagged value codec ------------------------------------------------------
+
+def _write_value(out: BytesIO, v: Any) -> None:
+    if v is None:
+        out.write(b"N")
+    elif v is True:
+        out.write(b"T")
+    elif v is False:
+        out.write(b"F")
+    elif isinstance(v, (int, np.integer)):
+        v = int(v)
+        if -(1 << 63) <= v < (1 << 63):
+            out.write(b"i")
+            out.write(struct.pack("<q", v))
+        else:  # arbitrary-precision fallback
+            raw = str(v).encode()
+            out.write(b"I")
+            out.write(struct.pack("<I", len(raw)))
+            out.write(raw)
+    elif isinstance(v, (float, np.floating)):
+        out.write(b"f")
+        out.write(struct.pack("<d", float(v)))
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        out.write(b"s")
+        out.write(struct.pack("<I", len(raw)))
+        out.write(raw)
+    elif isinstance(v, (bytes, bytearray)):
+        out.write(b"b")
+        out.write(struct.pack("<I", len(v)))
+        out.write(bytes(v))
+    elif isinstance(v, np.ndarray):
+        dt = v.dtype
+        if dt == object:  # object arrays decay to a list of tagged values
+            out.write(b"l")
+            out.write(struct.pack("<I", v.size))
+            for item in v.reshape(-1):
+                _write_value(out, item)
+            return
+        dts = dt.str.encode()  # e.g. b"<i4"
+        out.write(b"a")
+        out.write(struct.pack("<B", len(dts)))
+        out.write(dts)
+        out.write(struct.pack("<B", v.ndim))
+        for d in v.shape:
+            out.write(struct.pack("<I", d))
+        raw = np.ascontiguousarray(v).tobytes()
+        out.write(struct.pack("<I", len(raw)))
+        out.write(raw)
+    elif isinstance(v, tuple):
+        out.write(b"t")
+        out.write(struct.pack("<I", len(v)))
+        for item in v:
+            _write_value(out, item)
+    elif isinstance(v, list):
+        out.write(b"l")
+        out.write(struct.pack("<I", len(v)))
+        for item in v:
+            _write_value(out, item)
+    elif isinstance(v, (set, frozenset)):
+        out.write(b"S")
+        out.write(struct.pack("<I", len(v)))
+        for item in v:
+            _write_value(out, item)
+    elif isinstance(v, dict):
+        out.write(b"d")
+        out.write(struct.pack("<I", len(v)))
+        for k, item in v.items():
+            _write_value(out, k)
+            _write_value(out, item)
+    else:
+        name = _OBJ_BY_TYPE.get(type(v))
+        if name is None:
+            raise TypeError(f"no wire encoding for {type(v).__name__}")
+        raw = _OBJ_REGISTRY[name][1](v)
+        nm = name.encode()
+        out.write(b"O")
+        out.write(struct.pack("<B", len(nm)))
+        out.write(nm)
+        out.write(struct.pack("<I", len(raw)))
+        out.write(raw)
+
+
+def _read_value(buf: BytesIO) -> Any:
+    tag = buf.read(1)
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"i":
+        return struct.unpack("<q", buf.read(8))[0]
+    if tag == b"I":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return int(buf.read(n).decode())
+    if tag == b"f":
+        return struct.unpack("<d", buf.read(8))[0]
+    if tag == b"s":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return buf.read(n).decode("utf-8")
+    if tag == b"b":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return buf.read(n)
+    if tag == b"a":
+        (dn,) = struct.unpack("<B", buf.read(1))
+        dt = np.dtype(buf.read(dn).decode())
+        (ndim,) = struct.unpack("<B", buf.read(1))
+        shape = tuple(struct.unpack("<I", buf.read(4))[0] for _ in range(ndim))
+        (n,) = struct.unpack("<I", buf.read(4))
+        return np.frombuffer(buf.read(n), dtype=dt).reshape(shape).copy()
+    if tag == b"t":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return tuple(_read_value(buf) for _ in range(n))
+    if tag == b"l":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return [_read_value(buf) for _ in range(n)]
+    if tag == b"S":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return {_read_value(buf) for _ in range(n)}
+    if tag == b"d":
+        (n,) = struct.unpack("<I", buf.read(4))
+        return {_read_value(buf): _read_value(buf) for _ in range(n)}
+    if tag == b"O":
+        (nn,) = struct.unpack("<B", buf.read(1))
+        name = buf.read(nn).decode()
+        (n,) = struct.unpack("<I", buf.read(4))
+        entry = _OBJ_REGISTRY.get(name)
+        if entry is None:
+            raise ValueError(f"unknown wire object type {name!r}")
+        return entry[2](buf.read(n))
+    raise ValueError(f"bad wire tag {tag!r}")
+
+
+def encode_value(v: Any) -> bytes:
+    out = BytesIO()
+    out.write(MAGIC)
+    out.write(struct.pack("<B", VERSION))
+    _write_value(out, v)
+    return out.getvalue()
+
+
+def decode_value(data: bytes) -> Any:
+    buf = BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError("bad wire magic")
+    (ver,) = struct.unpack("<B", buf.read(1))
+    if ver != VERSION:
+        raise ValueError(f"unsupported wire version {ver}")
+    return _read_value(buf)
+
+
+# -- message framing ---------------------------------------------------------
+
+def encode_segment_result(r: SegmentResult) -> bytes:
+    """SegmentResult -> bytes (reference: DataTable serialize on the server)."""
+    return encode_value({
+        "kind": r.kind,
+        "numDocs": r.num_docs_scanned,
+        "groups": [(k, v) for k, v in r.groups.items()],
+        "scalar": r.scalar,
+        "rows": r.rows,
+        "sortKeys": r.sort_keys,
+    })
+
+
+def decode_segment_result(data: bytes) -> SegmentResult:
+    d = decode_value(data)
+    r = SegmentResult(d["kind"])
+    r.num_docs_scanned = d["numDocs"]
+    r.groups = {k: v for k, v in d["groups"]}
+    r.scalar = d["scalar"]
+    r.rows = [tuple(row) if not isinstance(row, tuple) else row for row in d["rows"]]
+    r.sort_keys = [tuple(k) if not isinstance(k, tuple) else k for k in d["sortKeys"]]
+    return r
+
+
+def encode_query_request(table: str, sql: str, segments) -> bytes:
+    """Broker -> server query dispatch (reference: thrift InstanceRequest with the
+    compiled query + searchSegments list, `InstanceRequestHandler.java:96`)."""
+    return json.dumps({"table": table, "sql": sql,
+                       "segments": list(segments)}).encode()
+
+
+def decode_query_request(data: bytes) -> Dict[str, Any]:
+    return json.loads(data.decode())

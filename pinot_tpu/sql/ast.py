@@ -126,3 +126,77 @@ class QueryStatement:
     limit: int = 10  # reference default broker limit
     offset: int = 0
     options: dict = field(default_factory=dict)  # SQL `SET key=value;` / OPTION(...)
+    raw: str = ""    # original SQL text (shipped to remote servers by the transport)
+
+
+# -- SQL unparser ------------------------------------------------------------
+# Inverse of the parser: expression tree -> SQL text. Used by the HTTP transport
+# to ship synthesized leaf scans (multistage engine) to remote servers, and by
+# EXPLAIN output. Canonical function names map back to infix operators.
+
+_INFIX = {"eq": "=", "neq": "<>", "gt": ">", "gte": ">=", "lt": "<", "lte": "<=",
+          "plus": "+", "minus": "-", "times": "*", "divide": "/", "mod": "%"}
+
+
+def _sql_literal(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    return repr(v)
+
+
+def _sql_ident(name: str) -> str:
+    """Quote identifiers that are not plain names or that collide with keywords."""
+    import re
+    from .lexer import KEYWORDS
+    if re.fullmatch(r"[A-Za-z_][A-Za-z0-9_$\.]*", name) and \
+            name.upper() not in KEYWORDS:
+        return name
+    return '"' + name.replace('"', '""') + '"'
+
+
+def to_sql(e: Expr) -> str:
+    """Expression -> SQL text that re-parses to the same tree."""
+    if isinstance(e, Literal):
+        return _sql_literal(e.value)
+    if isinstance(e, Identifier):
+        return _sql_ident(e.name)
+    op = e.name
+    if op in _INFIX and len(e.args) == 2:
+        return f"({to_sql(e.args[0])} {_INFIX[op]} {to_sql(e.args[1])})"
+    if op == "and":
+        return "(" + " AND ".join(to_sql(a) for a in e.args) + ")"
+    if op == "or":
+        return "(" + " OR ".join(to_sql(a) for a in e.args) + ")"
+    if op == "not":
+        return f"(NOT {to_sql(e.args[0])})"
+    if op in ("in", "not_in"):
+        kw = "IN" if op == "in" else "NOT IN"
+        vals = ", ".join(to_sql(a) for a in e.args[1:])
+        return f"({to_sql(e.args[0])} {kw} ({vals}))"
+    if op == "between":
+        return (f"({to_sql(e.args[0])} BETWEEN {to_sql(e.args[1])}"
+                f" AND {to_sql(e.args[2])})")
+    if op in ("like", "not_like"):
+        kw = "LIKE" if op == "like" else "NOT LIKE"
+        return f"({to_sql(e.args[0])} {kw} {to_sql(e.args[1])})"
+    if op == "is_null":
+        return f"({to_sql(e.args[0])} IS NULL)"
+    if op == "is_not_null":
+        return f"({to_sql(e.args[0])} IS NOT NULL)"
+    if op == "cast" and len(e.args) == 2 and isinstance(e.args[1], Literal):
+        return f"CAST({to_sql(e.args[0])} AS {e.args[1].value})"
+    if op == "case" and len(e.args) % 2 == 1:
+        parts = ["CASE"]
+        for i in range(0, len(e.args) - 1, 2):
+            parts.append(f"WHEN {to_sql(e.args[i])} THEN {to_sql(e.args[i + 1])}")
+        default = e.args[-1]
+        if not (isinstance(default, Literal) and default.value is None):
+            parts.append(f"ELSE {to_sql(default)}")
+        parts.append("END")
+        return " ".join(parts)
+    d = "DISTINCT " if e.distinct else ""
+    return f"{op}({d}{', '.join(to_sql(a) for a in e.args)})"

@@ -349,4 +349,6 @@ def _number(text: str):
 
 def parse_query(sql: str) -> QueryStatement:
     """SQL text -> QueryStatement (reference: CalciteSqlParser.compileToPinotQuery)."""
-    return Parser(sql).parse()
+    stmt = Parser(sql).parse()
+    stmt.raw = sql
+    return stmt

@@ -1,0 +1,249 @@
+"""Transport tests: wire codec, HTTP services, and a real multi-process cluster.
+
+Mirrors the reference's transport coverage: DataTable serde tests
+(`pinot-core/src/test/.../datatable/`), `QueryRoutingTest` (broker->server dispatch),
+and `OfflineClusterIntegrationTest` (multi-role cluster + queries + failures).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.catalog import Catalog
+from pinot_tpu.cluster.controller import Controller
+from pinot_tpu.cluster.deepstore import LocalDeepStore
+from pinot_tpu.cluster.broker import Broker
+from pinot_tpu.cluster.process import ControllerClient, ProcessCluster
+from pinot_tpu.cluster.remote import (ControllerDeepStore, RemoteCatalog,
+                                      RemoteServerHandle)
+from pinot_tpu.cluster.server import ServerNode
+from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                        ServerService)
+from pinot_tpu.cluster.wire import (decode_segment_result, decode_value,
+                                    encode_segment_result, encode_value)
+from pinot_tpu.query.reduce import SegmentResult
+from pinot_tpu.query.sketches import TDigest, ThetaSketch
+from pinot_tpu.schema import DataType, FieldSpec, Schema
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+from pinot_tpu.table import TableConfig
+
+
+# -- wire codec --------------------------------------------------------------
+
+def test_wire_value_roundtrip():
+    cases = [
+        None, True, False, 0, -1, 1 << 40, -(1 << 70), 3.5, float("inf"),
+        "héllo", b"\x00\xffbytes", (1, "a", None), [1, [2, [3]]],
+        {"k": (1, 2), "n": None}, {1, 2, 3}, (),
+    ]
+    for v in cases:
+        assert decode_value(encode_value(v)) == v, v
+
+
+def test_wire_ndarray_roundtrip():
+    for arr in [np.arange(12, dtype=np.int32).reshape(3, 4),
+                np.array([1.5, 2.5], dtype=np.float64),
+                np.array([True, False]),
+                np.zeros((0,), dtype=np.int64)]:
+        out = decode_value(encode_value(arr))
+        assert isinstance(out, np.ndarray)
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        assert np.array_equal(out, arr)
+
+
+def test_wire_sketch_objects():
+    theta = ThetaSketch.from_values(np.array(["a", "b", "c"], dtype=object))
+    td = TDigest.from_values(np.arange(100.0))
+    out = decode_value(encode_value([theta, td]))
+    assert round(out[0].estimate()) == 3
+    assert abs(out[1].quantile(0.5) - 49.5) < 5
+
+
+def test_segment_result_roundtrip():
+    r = SegmentResult("groups")
+    r.num_docs_scanned = 42
+    r.groups = {("a", 1): [3.0, (2.0, 5)], ("b", 2): [1.0, (1.0, 1)]}
+    out = decode_segment_result(encode_segment_result(r))
+    assert out.kind == "groups"
+    assert out.num_docs_scanned == 42
+    assert out.groups == r.groups
+
+    sel = SegmentResult("selection")
+    sel.rows = [(1, "x"), (2, "y")]
+    sel.sort_keys = [(1,), (2,)]
+    out = decode_segment_result(encode_segment_result(sel))
+    assert out.rows == sel.rows and out.sort_keys == sel.sort_keys
+
+
+# -- single-process HTTP cluster (every hop over localhost HTTP) -------------
+
+SCHEMA = Schema("trips", [
+    FieldSpec("city", DataType.STRING),
+    FieldSpec("fare", DataType.DOUBLE),
+    FieldSpec("n", DataType.INT),
+])
+
+
+def _build_segment(tmp, name, cities, fares, ns):
+    builder = SegmentBuilder(SCHEMA, SegmentGeneratorConfig())
+    return builder.build(
+        {"city": np.array(cities, dtype=object),
+         "fare": np.array(fares, dtype=np.float64),
+         "n": np.array(ns, dtype=np.int32)},
+        str(tmp), name)
+
+
+@pytest.fixture
+def http_cluster(tmp_path):
+    """Controller + 2 servers + broker in one process, every call over HTTP."""
+    catalog = Catalog()
+    deepstore = LocalDeepStore(str(tmp_path / "deepstore"))
+    controller = Controller("controller_0", catalog, deepstore,
+                            str(tmp_path / "ctrl"))
+    csvc = ControllerService(controller)
+    services = [csvc]
+    catalogs = []
+    servers = []
+    try:
+        for i in range(2):
+            rc = RemoteCatalog(csvc.url, poll_timeout_s=1.0)
+            catalogs.append(rc)
+            node = ServerNode(f"server_{i}", rc, ControllerDeepStore(csvc.url),
+                              str(tmp_path / f"server_{i}"))
+            ssvc = ServerService(node)
+            services.append(ssvc)
+            servers.append((node, rc, ssvc))
+        brc = RemoteCatalog(csvc.url, poll_timeout_s=1.0)
+        catalogs.append(brc)
+        broker = Broker("broker_0", brc)
+        bsvc = BrokerService(broker)
+        services.append(bsvc)
+        yield {"controller": controller, "csvc": csvc, "servers": servers,
+               "broker": broker, "bsvc": bsvc, "tmp": tmp_path}
+    finally:
+        for rc in catalogs:
+            rc.close()
+        for s in services:
+            s.stop()
+
+
+def _wait_until(fn, timeout=15.0):
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_http_cluster_query(http_cluster):
+    c = ControllerClient(http_cluster["csvc"].url)
+    c.add_schema(SCHEMA)
+    cfg = TableConfig("trips", replication=2)
+    c.add_table(cfg)
+    seg1 = _build_segment(http_cluster["tmp"] / "b1", "trips_0",
+                          ["nyc", "sf", "nyc"], [10.0, 20.0, 30.0], [1, 2, 3])
+    seg2 = _build_segment(http_cluster["tmp"] / "b2", "trips_1",
+                          ["sf", "la"], [5.0, 7.0], [4, 5])
+    c.upload_segment(cfg.table_name_with_type, seg1)
+    c.upload_segment(cfg.table_name_with_type, seg2)
+
+    # wait for both remote servers to converge on the ideal state
+    assert _wait_until(lambda: all(
+        len(node.segments_served(cfg.table_name_with_type)) == 2
+        for node, _, _ in http_cluster["servers"]))
+
+    from pinot_tpu.cluster.process import BrokerClient
+    bc = BrokerClient(http_cluster["bsvc"].url)
+    resp = bc.query("SELECT city, SUM(fare) AS total FROM trips "
+                    "GROUP BY city ORDER BY total DESC")
+    rows = resp["resultTable"]["rows"]
+    assert rows == [["nyc", 40.0], ["sf", 25.0], ["la", 7.0]]
+
+    resp = bc.query("SELECT COUNT(*) FROM trips WHERE fare > 6")
+    assert resp["resultTable"]["rows"][0][0] == 4
+
+
+def test_http_cluster_multistage_join(http_cluster):
+    """JOIN through the broker with leaf scans dispatched to HTTP servers."""
+    c = ControllerClient(http_cluster["csvc"].url)
+    c.add_schema(SCHEMA)
+    dim_schema = Schema("cities", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("state", DataType.STRING),
+    ])
+    c.add_schema(dim_schema)
+    cfg = TableConfig("trips", replication=2)
+    c.add_table(cfg)
+    dim_cfg = TableConfig("cities", replication=2)
+    c.add_table(dim_cfg)
+
+    seg = _build_segment(http_cluster["tmp"] / "b1", "trips_0",
+                         ["nyc", "sf", "nyc"], [10.0, 20.0, 30.0], [1, 2, 3])
+    c.upload_segment(cfg.table_name_with_type, seg)
+    dim_builder = SegmentBuilder(dim_schema, SegmentGeneratorConfig())
+    dim_seg = dim_builder.build(
+        {"city": np.array(["nyc", "sf"], dtype=object),
+         "state": np.array(["NY", "CA"], dtype=object)},
+        str(http_cluster["tmp"] / "bd"), "cities_0")
+    c.upload_segment(dim_cfg.table_name_with_type, dim_seg)
+
+    assert _wait_until(lambda: all(
+        len(node.segments_served(cfg.table_name_with_type)) == 1
+        and len(node.segments_served(dim_cfg.table_name_with_type)) == 1
+        for node, _, _ in http_cluster["servers"]))
+
+    from pinot_tpu.cluster.process import BrokerClient
+    bc = BrokerClient(http_cluster["bsvc"].url)
+    resp = bc.query(
+        "SELECT c.state, SUM(t.fare) AS total FROM trips t "
+        "JOIN cities c ON t.city = c.city GROUP BY c.state ORDER BY total DESC")
+    assert resp["resultTable"]["rows"] == [["NY", 40.0], ["CA", 20.0]]
+
+
+# -- real multi-process cluster ----------------------------------------------
+
+def test_process_cluster_query_and_server_death(tmp_path):
+    """Queries answered across >=2 OS processes; killing a server yields partial
+    results (reference: OfflineClusterIntegrationTest + ChaosMonkey)."""
+    with ProcessCluster(num_servers=2, work_dir=str(tmp_path)) as cluster:
+        cluster.controller.add_schema(SCHEMA)
+        cfg = TableConfig("trips")  # replication=1: a dead server loses data
+        cluster.controller.add_table(cfg)
+        table = cfg.table_name_with_type
+
+        seg_dirs = [
+            _build_segment(tmp_path / "b0", "trips_0",
+                           ["nyc", "sf"], [10.0, 20.0], [1, 2]),
+            _build_segment(tmp_path / "b1", "trips_1",
+                           ["nyc", "la"], [30.0, 7.0], [3, 4]),
+            _build_segment(tmp_path / "b2", "trips_2",
+                           ["sf", "sf"], [5.0, 6.0], [5, 6]),
+            _build_segment(tmp_path / "b3", "trips_3",
+                           ["la", "nyc"], [8.0, 9.0], [7, 8]),
+        ]
+        for d in seg_dirs:
+            cluster.controller.upload_segment(table, d)
+
+        def all_online():
+            status = cluster.controller.table_status(table)
+            return status.get("segments", 0) == 4 and status.get("converged")
+
+        assert _wait_until(all_online, timeout=30.0)
+
+        resp = cluster.query("SELECT COUNT(*), SUM(fare) FROM trips")
+        assert resp["resultTable"]["rows"][0] == [8, 95.0]
+        assert resp["numServersResponded"] == resp["numServersQueried"]
+
+        # kill one server process outright: partial results, not an error
+        cluster.kill_server("server_1")
+        resp = cluster.query("SELECT COUNT(*), SUM(fare) FROM trips")
+        assert resp["partialResult"] is True
+        count = resp["resultTable"]["rows"][0][0]
+        assert 0 < count < 8
+
+        # a retry routes around the dead server (unhealthy exclusion)
+        resp2 = cluster.query("SELECT COUNT(*) FROM trips")
+        assert resp2["resultTable"]["rows"][0][0] == count
