@@ -121,11 +121,13 @@ class Broker:
             return self.catalog.schema_for_table(phys[0]) if phys else None
 
         def scan(raw_table: str, columns, filt):
-            from ..sql.ast import to_sql
+            from ..sql.ast import _sql_ident, to_sql
             schema = schema_for(raw_table)
             rows: List[tuple] = []
-            # synthesized SQL lets remote (HTTP) server handles recompile the leaf
-            leaf_sql = f"SELECT {', '.join(columns)} FROM {raw_table}"
+            # synthesized SQL lets remote (HTTP) server handles recompile the leaf;
+            # identifiers are quoted as needed (keywords, special chars)
+            leaf_sql = (f"SELECT {', '.join(_sql_ident(c) for c in columns)} "
+                        f"FROM {_sql_ident(raw_table)}")
             if filt is not None:
                 leaf_sql += f" WHERE {to_sql(filt)}"
             leaf_sql += f" LIMIT {UNBOUNDED_LIMIT}"

@@ -260,8 +260,10 @@ class BrokerService:
         self.http.route("POST", "query", self._query)
         self.http.route("GET", "health",
                         lambda p, q, b: json_response({"status": "OK"}))
-        self._wire_server_handles()
+        # subscribe BEFORE the initial scan: a server registering in between then
+        # fires an event we handle (re-scan), instead of being silently missed
         broker.catalog.subscribe(self._on_event)
+        self._wire_server_handles()
         self.http.start()
 
     @property

@@ -8,11 +8,18 @@ Netty (`QueryRouter.submitQuery`), runs per-segment operator trees on thread poo
 
     stacked columns [S, P] --shard_map--> per-device fused scan --psum/pmin/pmax--> result
 
-The fast path requires segments with *aligned dictionaries* (`dictHash` equal — built via
-`segment.writer.build_aligned_segments` or a shared ingestion dictionary): dense group
-keys and LUT ids then agree across devices, so partial aggregates combine with one ICI
-collective and no host-side value merge. Unaligned segment sets fall back to the
-per-segment executor + value-keyed host merge, which is always correct.
+Dense group keys and LUT ids must agree across devices so partial aggregates combine
+with one ICI collective and no host-side value merge. Two ways a segment set qualifies:
+
+* *aligned dictionaries* (`dictHash` equal — built via `segment.writer.
+  build_aligned_segments` or a shared ingestion dictionary): ids already agree;
+* anything else — including consuming (mutable) segments — rides the **merged-
+  dictionary path** (`parallel/merged.py`): a global sorted dictionary per referenced
+  column, per-segment ids remapped host-side once at block-build time, after which the
+  set is aligned by construction.
+
+Only JSON_MATCH/TEXT_MATCH filters (per-segment doc-set bitmaps) still fall back to the
+per-segment executor + value-keyed host merge.
 """
 
 from __future__ import annotations
@@ -34,8 +41,21 @@ from ..query.predicate import CmpLeaf, LutLeaf, NullLeaf
 from ..query.reduce import merge_segment_results, reduce_to_result
 from ..query.result import ResultTable
 from ..segment.reader import ImmutableSegment
-from ..sql.ast import Identifier, identifiers_in
+from ..sql.ast import Expr, Function, Identifier, identifiers_in
+from .merged import MergedSegmentView, view_key
 from .mesh import SEGMENT_AXIS, default_mesh
+
+
+def _has_docset_filter(ctx: QueryContext) -> bool:
+    """JSON_MATCH/TEXT_MATCH resolve to per-segment doc bitmaps (DocSetLeaf), which the
+    stacked mesh kernel does not take as inputs — those queries keep the fallback."""
+    def walk(e) -> bool:
+        if isinstance(e, Function):
+            if e.name in ("json_match", "text_match"):
+                return True
+            return any(walk(a) for a in e.args)
+        return False
+    return ctx.filter is not None and walk(ctx.filter)
 
 _SHARD_KERNEL_CACHE: Dict[Tuple, object] = {}
 
@@ -66,10 +86,13 @@ class SegmentSetBlock:
     """
 
     def __init__(self, segments: Sequence[ImmutableSegment], s_pad: int,
-                 mesh: jax.sharding.Mesh):
+                 mesh: jax.sharding.Mesh, view=None):
         self.segments = list(segments)
         self.s_pad = s_pad
-        self.rows = max(padded_rows(s.num_docs) for s in segments)
+        self.view = view  # MergedSegmentView for unaligned sets, else None
+        self.seg_docs = view.seg_docs if view is not None \
+            else tuple(s.num_docs for s in segments)
+        self.rows = max(padded_rows(n) for n in self.seg_docs)
         P = jax.sharding.PartitionSpec
         self._sharded = jax.sharding.NamedSharding(mesh, P(SEGMENT_AXIS))
         self._replicated = jax.sharding.NamedSharding(mesh, P())
@@ -78,33 +101,43 @@ class SegmentSetBlock:
     def _stack(self, kind: str, col: str, fill, per_seg) -> jnp.ndarray:
         key = (kind, col)
         if key not in self._cache:
-            first = np.asarray(per_seg(self.segments[0]))
+            first = np.asarray(per_seg(0, self.segments[0]))
             out = np.full((self.s_pad, self.rows), fill, dtype=first.dtype)
             for i, seg in enumerate(self.segments):
-                arr = np.asarray(per_seg(seg))
+                # slice to the view's snapshot row count: mutable members may have
+                # grown since the view (and its remap tables) were built
+                arr = np.asarray(per_seg(i, seg))[:self.seg_docs[i]]
                 out[i, :len(arr)] = arr
             self._cache[key] = jax.device_put(out, self._sharded)
         return self._cache[key]
 
     def ids(self, col: str) -> jnp.ndarray:
-        card = self.segments[0].column(col).cardinality
-        return self._stack("ids", col, np.int32(card),
-                           lambda s: np.asarray(s.column(col).fwd).astype(np.int32))
+        """Dict ids in the space the plan was made in: segment-local ids for aligned
+        sets, remapped GLOBAL ids (merged.py) for unaligned ones."""
+        remaps = self.view.remap(col) if self.view is not None else None
+        if remaps is None:
+            card = self.segments[0].column(col).cardinality
+            return self._stack("ids", col, np.int32(card),
+                               lambda i, s: np.asarray(s.column(col).fwd).astype(np.int32))
+        mc = self.view.column(col)
+        return self._stack("ids", col, np.int32(mc.cardinality),
+                           lambda i, s: remaps[i][mc.local_ids(i)])
 
     def raw(self, col: str) -> jnp.ndarray:
         from ..engine.datablock import _narrow
         return self._stack("raw", col, 0,
-                           lambda s: _narrow(np.asarray(s.column(col).fwd)))
+                           lambda i, s: _narrow(np.asarray(s.column(col).fwd)))
 
     def decoded(self, col: str) -> jnp.ndarray:
         """Decoded numeric values regardless of encoding, host-materialized ONCE.
 
         Dict decode never happens on device: the relay serializes each device gather
         into an extra host round trip per dispatch, so queries read pre-decoded HBM
-        columns (the `DataFetcher.java:47` value-buffer analog)."""
+        columns (the `DataFetcher.java:47` value-buffer analog). Decode uses each
+        segment's OWN dictionary, so it is alignment-independent."""
         from ..engine.datablock import _narrow
 
-        def per_seg(s):
+        def per_seg(i, s):
             reader = s.column(col)
             arr = np.asarray(reader.fwd)
             if reader.has_dictionary:
@@ -115,32 +148,41 @@ class SegmentSetBlock:
         return self._stack("decoded", col, 0, per_seg)
 
     def hll(self, col: str, p: int):
-        """Per-doc (bucket, rank) HLL update vectors, host-materialized once."""
-        from ..query.executor import _hll_luts
+        """Per-doc (bucket, rank) HLL update vectors, host-materialized once.
 
-        def bucket_per_seg(s):
-            reader = s.column(col)
-            bucket_lut, _ = _hll_luts(reader, p)
-            return bucket_lut[np.asarray(reader.fwd).astype(np.int64)]
+        Buckets/ranks hash the *values*, so per-segment dictionaries need no
+        alignment here either."""
+        from ..query.executor import _hll_luts, _hll_tables
 
-        def rank_per_seg(s):
+        def luts_and_ids(s):
             reader = s.column(col)
-            _, rank_lut = _hll_luts(reader, p)
-            return rank_lut[np.asarray(reader.fwd).astype(np.int64)]
+            snap = getattr(reader, "dict_snapshot", None)
+            if snap is not None:  # mutable: LUTs from the SAME snapshot as the ids
+                _, d, ids = snap()
+                return _hll_tables(d, p), np.asarray(ids)
+            return _hll_luts(reader, p), np.asarray(reader.fwd).astype(np.int64)
+
+        def bucket_per_seg(i, s):
+            (bucket_lut, _), ids = luts_and_ids(s)
+            return bucket_lut[ids]
+
+        def rank_per_seg(i, s):
+            (_, rank_lut), ids = luts_and_ids(s)
+            return rank_lut[ids]
 
         # padding rows: bucket = 2**p overflow slot, rank 0
         return (self._stack(f"hllb{p}", col, np.int32(1 << p), bucket_per_seg),
                 self._stack(f"hllr{p}", col, np.int32(0), rank_per_seg))
 
     def null_mask(self, col: str) -> jnp.ndarray:
-        def per_seg(s):
+        def per_seg(i, s):
             nb = s.column(col).null_bitmap
             return nb if nb is not None else np.zeros(s.num_docs, dtype=bool)
         return self._stack("null", col, False, per_seg)
 
     @property
     def valid(self) -> jnp.ndarray:
-        def per_seg(s):
+        def per_seg(i, s):
             return np.ones(s.num_docs, dtype=bool)
         return self._stack("valid", "", False, per_seg)
 
@@ -152,7 +194,8 @@ class MeshQueryExecutor:
         self.mesh = mesh if mesh is not None else default_mesh()
         self.n_devices = self.mesh.devices.size
         self._fallback = ServerQueryExecutor()
-        self._set_blocks: Dict[Tuple[str, ...], SegmentSetBlock] = {}
+        self._set_blocks: Dict[Tuple, SegmentSetBlock] = {}
+        self._views: Dict[Tuple, MergedSegmentView] = {}
         self._replicated = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec())
         # content-addressed cache of replicated query constants (LUTs, scalars, strides):
@@ -174,10 +217,43 @@ class MeshQueryExecutor:
                 query: Union[str, QueryContext], schema=None) -> ResultTable:
         ctx = compile_query(query, schema or segments[0].schema) \
             if isinstance(query, str) else query
-        plan = plan_segment(ctx, segments[0])
-        if plan.kind != "device" or not self._alignable(plan, segments):
+        plan, view = self._plan_for_set(ctx, segments)
+        if plan is None or plan.kind != "device":
             return self._fallback.execute(segments, ctx)
-        return self._execute_sharded(ctx, plan, segments)
+        return self._execute_sharded(ctx, plan, segments, view)
+
+    def _plan_for_set(self, ctx: QueryContext, segments):
+        """Choose the planning surface for a segment set.
+
+        Returns (plan, view): view is None for the aligned fast path (ids agree by
+        dictHash), a MergedSegmentView when ids must be remapped to a global
+        dictionary, and plan is None when the set must take the per-segment fallback
+        (JSON/TEXT_MATCH doc-set filters, which are per-segment bitmaps)."""
+        if _has_docset_filter(ctx):
+            return None, None
+        any_mutable = any(getattr(s, "is_mutable", False) for s in segments)
+        if not any_mutable:
+            plan = plan_segment(ctx, segments[0])
+            if plan.kind != "device":
+                return plan, None
+            if self._alignable(plan, segments):
+                return plan, None
+        view = self._merged_view(segments)
+        return plan_segment(ctx, view), view
+
+    def _merged_view(self, segments) -> MergedSegmentView:
+        # keyed by STABLE segment identity; the volatile part (mutable row counts)
+        # is the value's subkey, so a grown consuming segment REPLACES its stale
+        # view instead of accumulating one per growth step
+        stable = tuple(getattr(s, "path", s.name) for s in segments)
+        vkey = view_key(segments)
+        entry = self._views.get(stable)
+        if entry is None or entry[0] != vkey:
+            if len(self._views) > 64:
+                self._views.clear()
+            entry = (vkey, MergedSegmentView(segments))
+            self._views[stable] = entry
+        return entry[1]
 
     def _alignable(self, plan, segments) -> bool:
         """Dictionary alignment is only needed where dict IDS are shared across
@@ -185,9 +261,6 @@ class MeshQueryExecutor:
         presence vectors. Decoded value columns (CmpLeaf expressions, SUM/MIN/MAX
         args) and HLL (bucket, rank) vectors are materialized per segment against its
         OWN dictionary, so mixed segment sets still ride the mesh kernel for them."""
-        from ..query.predicate import DocSetLeaf
-        if any(isinstance(l, DocSetLeaf) for l in plan.filter_prog.leaves):
-            return False  # doc-set masks are per-segment; plan[0] can't be reused
         cols = set(plan.group_cols)
         for leaf in plan.filter_prog.leaves:
             if isinstance(leaf, LutLeaf):
@@ -198,8 +271,8 @@ class MeshQueryExecutor:
         return aligned_dictionaries(segments, cols)
 
     # ------------------------------------------------------------------
-    def _execute_sharded(self, ctx: QueryContext, plan, segments) -> ResultTable:
-        outs_dev, decode = self._dispatch_sharded(ctx, plan, segments)
+    def _execute_sharded(self, ctx: QueryContext, plan, segments, view=None) -> ResultTable:
+        outs_dev, decode = self._dispatch_sharded(ctx, plan, segments, view)
         return decode(jax.device_get(outs_dev))  # one host sync for all partials
 
     def execute_many(self, segments: Sequence[ImmutableSegment],
@@ -216,11 +289,11 @@ class MeshQueryExecutor:
         for qi, query in enumerate(queries):
             ctx = compile_query(query, schema or segments[0].schema) \
                 if isinstance(query, str) else query
-            plan = plan_segment(ctx, segments[0])
-            if plan.kind != "device" or not self._alignable(plan, segments):
+            plan, view = self._plan_for_set(ctx, segments)
+            if plan is None or plan.kind != "device":
                 pending.append((qi, self._fallback.execute(segments, ctx)))
             else:
-                outs_dev, decode = self._dispatch_sharded(ctx, plan, segments)
+                outs_dev, decode = self._dispatch_sharded(ctx, plan, segments, view)
                 pending.append((qi, outs_dev, decode))
         fetched = jax.device_get([p[1] for p in pending if len(p) == 3])
         results: List[Optional[ResultTable]] = [None] * len(queries)
@@ -229,7 +302,7 @@ class MeshQueryExecutor:
             results[p[0]] = p[1] if len(p) == 2 else p[2](next(it))
         return results
 
-    def _dispatch_sharded(self, ctx: QueryContext, plan, segments):
+    def _dispatch_sharded(self, ctx: QueryContext, plan, segments, view=None):
         """Dispatch the fused mesh kernel asynchronously.
 
         Returns (device outputs, decode) where decode(host_outs) -> ResultTable; the
@@ -241,16 +314,24 @@ class MeshQueryExecutor:
         agg_luts: Dict[str, jnp.ndarray] = {}
 
         s_pad = -(-len(segments) // self.n_devices) * self.n_devices
-        key = tuple(s.path for s in segments)
-        block = self._set_blocks.get(key)
-        if block is None or block.s_pad != s_pad:
-            block = SegmentSetBlock(segments, s_pad, self.mesh)
-            self._set_blocks[key] = block
+        # stable key + volatile subkey: growth of a consuming segment frees the
+        # superseded block's device arrays instead of pinning up to 64 dead copies
+        stable = (tuple(getattr(s, "path", s.name) for s in segments), view is not None)
+        vkey = (view_key(segments), s_pad)
+        entry = self._set_blocks.get(stable)
+        if entry is None or entry[0] != vkey:
+            if len(self._set_blocks) > 64:
+                self._set_blocks.clear()
+            entry = (vkey, SegmentSetBlock(segments, s_pad, self.mesh, view))
+            self._set_blocks[stable] = entry
+        block = entry[1]
 
         for i, agg in enumerate(plan.aggs):
             agg_specs.append((agg, agg.device_outputs))
             if "distinct" in agg.device_outputs:
-                distinct_lut_sizes[i] = lut_size(segments[0].column(agg.arg.name).cardinality)
+                # plan.segment is the merged view on the unaligned path, so this is
+                # the GLOBAL cardinality there (ids arrive remapped)
+                distinct_lut_sizes[i] = lut_size(plan.segment.column(agg.arg.name).cardinality)
             if "hll" in agg.device_outputs:
                 hll_params[i] = agg.p
                 bucket, rank = block.hll(agg.arg.name, agg.p)
@@ -304,8 +385,8 @@ class MeshQueryExecutor:
 
         def decode(outs) -> ResultTable:
             # replicated outputs decode exactly like the single-segment path;
-            # group/distinct dictionaries are aligned, so segment[0]'s dictionaries
-            # decode the global dense keys.
+            # plan.segment's dictionaries (segment[0] when aligned, the merged global
+            # dictionaries otherwise) decode the dense keys.
             if plan.group_cols:
                 seg_result = self._fallback._decode_group_partials(plan, outs)
             else:

@@ -448,24 +448,36 @@ def _is_const(e: Expr) -> bool:
     return not identifiers_in(e)
 
 
-def _hll_luts(reader, p: int):
-    """Per-dict-id (bucket, rank) HLL update tables, cached on the column reader."""
+def _hll_tables(dictionary, p: int):
+    """(bucket, rank) HLL update tables over one dictionary's values."""
     from ..engine.datablock import lut_size
     from .aggregates import hll_bucket_rank
+    size = lut_size(len(dictionary))
+    bucket = np.zeros(size, dtype=np.int32)
+    rank = np.zeros(size, dtype=np.int32)
+    for i, v in enumerate(dictionary.values):
+        b, r = hll_bucket_rank(v, p)
+        bucket[i] = b
+        rank[i] = r
+    return bucket, rank
+
+
+def _hll_luts(reader, p: int):
+    """Per-dict-id (bucket, rank) HLL update tables, cached on the column reader."""
     cache = getattr(reader, "_hll_lut_cache", None)
     if cache is None:
         cache = {}
         reader._hll_lut_cache = cache
-    if p not in cache:
-        size = lut_size(reader.cardinality)
-        bucket = np.zeros(size, dtype=np.int32)
-        rank = np.zeros(size, dtype=np.int32)
-        for i, v in enumerate(reader.dictionary.values):
-            b, r = hll_bucket_rank(v, p)
-            bucket[i] = b
-            rank[i] = r
-        cache[p] = (bucket, rank)
-    return cache[p]
+    d = reader.dictionary  # one read: tables stay internally consistent
+    # cardinality in the key: a mutable reader's dictionary grows between snapshots,
+    # and a stale (smaller) LUT would be indexed out of bounds by new ids; stale
+    # cardinalities for the same p are dropped so growth doesn't accumulate LUTs
+    key = (p, len(d))
+    if key not in cache:
+        for k in [k for k in cache if k[0] == p]:
+            del cache[k]
+        cache[key] = _hll_tables(d, p)
+    return cache[key]
 
 
 def execute_query(segments: Sequence[ImmutableSegment], sql: str,

@@ -246,6 +246,10 @@ def _expr_device_ok(e: Expr, segment: ImmutableSegment) -> str:
         if (mn is not None and mx is not None and isinstance(mn, (int, np.integer))
                 and (mn < -(2 ** 31) or mx >= 2 ** 31)):
             return f"column {node_name} exceeds int32 range (device is 32-bit)"
+        if (mn is None or mx is None) and reader.data_type.numpy_dtype.itemsize > 4 \
+                and np.dtype(reader.data_type.numpy_dtype).kind in "iu":
+            # unknown bounds on a 64-bit integer column: cannot prove int32-safe
+            return f"column {node_name} is 64-bit with unknown bounds"
     def check(node):
         if isinstance(node, Function):
             if node.name not in _DEVICE_FUNCS:

@@ -34,9 +34,10 @@ class MutableColumnReader:
         self.store = store
         self.name = spec.name
         self.data_type = spec.data_type
-        self._snap_rows = -1
-        self._snap_dict: Optional[Dictionary] = None
-        self._snap_ids: Optional[np.ndarray] = None
+        # one tuple attribute (rows, dict, ids): a single attribute load is atomic
+        # under the GIL, so readers never pair a dictionary with ids from a newer
+        # snapshot (the ids are re-sorted ids over a DIFFERENT sorted value set)
+        self._snap: tuple = (-1, None, None)
 
     # -- reader surface ----------------------------------------------------
     @property
@@ -53,8 +54,8 @@ class MutableColumnReader:
 
     @property
     def cardinality(self) -> int:
-        self._snapshot()
-        return len(self._snap_dict) if self._snap_dict is not None else -1
+        d = self._snapshot()[1]
+        return len(d) if d is not None else -1
 
     @property
     def meta(self) -> Dict[str, Any]:
@@ -64,18 +65,21 @@ class MutableColumnReader:
 
     @property
     def dictionary(self) -> Optional[Dictionary]:
-        self._snapshot()
-        return self._snap_dict
+        return self._snapshot()[1]
 
     @property
     def fwd(self) -> np.ndarray:
         """Dict ids for string columns, raw values for numeric."""
+        if self.has_dictionary:
+            return self._snapshot()[2]
         n = self.store.num_docs
         vals = self.store.columns[self.name][:n]
-        if self.has_dictionary:
-            self._snapshot()
-            return self._snap_ids
         return np.asarray(vals, dtype=self.data_type.numpy_dtype)
+
+    def dict_snapshot(self):
+        """Atomic (rows, dictionary, ids) triple — ids are guaranteed to be in THIS
+        dictionary's id space (consumers building remap/LUT tables need the pair)."""
+        return self._snapshot()
 
     def values(self) -> np.ndarray:
         n = self.store.num_docs
@@ -111,18 +115,19 @@ class MutableColumnReader:
     index_types: List[str] = []
 
     # ------------------------------------------------------------------
-    def _snapshot(self) -> None:
+    def _snapshot(self) -> tuple:
         if not self.has_dictionary:
-            return
+            return (-1, None, None)
+        snap = self._snap
         n = self.store.num_docs
-        if n == self._snap_rows:
-            return
+        if n == snap[0]:
+            return snap
         vals = self.store.columns[self.name][:n]
         arr = np.array(vals, dtype=object)
         uniq, inverse = np.unique(arr, return_inverse=True)
-        self._snap_dict = Dictionary(list(uniq), self.data_type)
-        self._snap_ids = inverse.astype(np.int64)
-        self._snap_rows = n
+        snap = (n, Dictionary(list(uniq), self.data_type), inverse.astype(np.int64))
+        self._snap = snap  # single store publishes the consistent triple
+        return snap
 
 
 class MutableSegment:
