@@ -373,6 +373,44 @@ def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
     return jax.device_get(out)
 
 
+def compute_topk(spec: KernelSpec, inputs: KernelInputs, order_expr,
+                 desc: bool, k: int) -> Tuple[np.ndarray, int]:
+    """Device top-k for `SELECT ... ORDER BY <numeric expr> LIMIT k` (SURVEY hard-part 3).
+
+    Fuses the filter mask with a single `lax.top_k` over the (sign-adjusted) sort key,
+    so only k doc indices cross back to the host instead of every matching row — the
+    TPU analog of the reference's per-segment `TableResizer` trim before broker merge.
+    Returns (doc indices, match count, match flag per index); indices whose flag is
+    False are filtered-out rows that tied with a legitimate -inf/NaN sort key and must
+    be dropped by the caller. The caller re-sorts candidates exactly on the host, so
+    f32 here only decides the CANDIDATE SET (callers overfetch slack for boundary
+    ties); final ordering is exact.
+    """
+    k = min(k, spec.padded_rows)
+    key = ("topk", spec.filter.signature(), repr(order_expr), desc, k,
+           spec.padded_rows)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        mask_fn = _make_mask_fn(spec)
+
+        def body(ids, vals, luts, iscal, fscal, nulls, valid, docsets):
+            mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets).ravel()
+            v = eval_expr(order_expr, vals, jnp).ravel().astype(jnp.float32)
+            # NaN keys sink to the bottom (numpy sorts NaN last ascending; exact
+            # parity for NaN keys is out of contract either way)
+            usable = mask & ~jnp.isnan(v)
+            score = jnp.where(usable, v if desc else -v, -jnp.inf)
+            _, idx = jax.lax.top_k(score, k)
+            return idx, mask.sum(dtype=jnp.int32), usable[idx]
+
+        fn = jax.jit(body)
+        _KERNEL_CACHE[key] = fn
+    idx, count, ok = jax.device_get(fn(inputs.ids, inputs.vals, inputs.luts,
+                                       inputs.iscal, inputs.fscal, inputs.nulls,
+                                       inputs.valid, inputs.docsets))
+    return np.asarray(idx), int(count), np.asarray(ok)
+
+
 def _agg_arg(agg: AggFunc, vals) -> Optional[jnp.ndarray]:
     if agg.arg is None or (isinstance(agg.arg, Identifier) and agg.arg.name == "*"):
         return None

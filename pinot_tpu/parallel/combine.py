@@ -388,7 +388,9 @@ class MeshQueryExecutor:
             # plan.segment's dictionaries (segment[0] when aligned, the merged global
             # dictionaries otherwise) decode the dense keys.
             if plan.group_cols:
-                seg_result = self._fallback._decode_group_partials(plan, outs)
+                # post-psum outputs are global, so the order-by trim is exact here
+                seg_result = self._fallback._decode_group_partials(plan, outs,
+                                                                   trim_global=True)
             else:
                 seg_result = self._fallback._decode_scalar_partials(plan, outs)
             merged = merge_segment_results([seg_result], plan.aggs)

@@ -190,10 +190,16 @@ class ServerQueryExecutor:
             docsets=tuple(docsets),
         )
 
-    def _decode_group_partials(self, plan: SegmentPlan, outs) -> SegmentResult:
+    def _decode_group_partials(self, plan: SegmentPlan, outs,
+                               trim_global: bool = False) -> SegmentResult:
         seg = plan.segment
         counts = outs["count"][:plan.num_keys_real]
         occupied = np.nonzero(counts > 0)[0]
+        if trim_global:
+            # outs are GLOBAL (post-collective) partials, so an order-by trim here is
+            # exact — the TableResizer analog, but vectorized over the dense key space
+            # instead of a heap, bounding the decode loop to k groups
+            occupied = _trim_occupied(plan, outs, occupied)
         # decode dense keys -> per-column dict ids -> values (vectorized per column)
         value_cols = []
         for j, col in enumerate(plan.group_cols):
@@ -288,14 +294,21 @@ class ServerQueryExecutor:
         return result
 
     # -- selection --------------------------------------------------------
+    MAX_DEVICE_TOPK = 65536
+
     def _selection(self, plan: SegmentPlan) -> SegmentResult:
         ctx, seg = plan.ctx, plan.segment
-        mask = self._selection_mask(plan)
-        if plan.valid_docs is not None:
-            mask = mask & plan.valid_docs[:len(mask)]
-        idx = np.nonzero(mask)[0]
-        if not ctx.order_by:
-            idx = idx[:ctx.offset + ctx.limit]  # early terminate (SelectionOnlyOperator)
+        topk = self._topk_candidates(plan)
+        if topk is not None:
+            idx, scanned = topk
+        else:
+            mask = self._selection_mask(plan)
+            if plan.valid_docs is not None:
+                mask = mask & plan.valid_docs[:len(mask)]
+            idx = np.nonzero(mask)[0]
+            if not ctx.order_by:
+                idx = idx[:ctx.offset + ctx.limit]  # early terminate (SelectionOnlyOperator)
+            scanned = len(idx)
 
         needed = set()
         for e, _ in ctx.select_items:
@@ -316,7 +329,55 @@ class ServerQueryExecutor:
             sort_keys = [tuple(c[i].item() if isinstance(c[i], np.generic) else c[i]
                                for c in sort_cols) for i in range(len(idx))]
         return SegmentResult("selection", rows=rows, sort_keys=sort_keys,
-                             num_docs_scanned=len(idx))
+                             num_docs_scanned=scanned)
+
+    # slack candidates beyond k so f32 ties at the k-boundary cannot evict a true
+    # top-k row (final ordering is exact: candidates re-sort on host in f64)
+    TOPK_SLACK = 256
+
+    def _topk_candidates(self, plan: SegmentPlan) -> Optional[Tuple[np.ndarray, int]]:
+        """(candidate doc ids, match count) from a DEVICE order-by trim, or None.
+
+        Eligible: single plain-column numeric ORDER BY key, bounded LIMIT, immutable
+        segment. Integer keys require known bounds within 2^24 (f32-exact); float
+        keys ride with TOPK_SLACK overfetch, since only the candidate set — never the
+        final order — is decided in f32. Expression keys (e.g. a*b) can overflow f32
+        precision without column bounds revealing it, so they stay on the host."""
+        ctx, seg = plan.ctx, plan.segment
+        k = ctx.offset + ctx.limit
+        if (len(ctx.order_by) != 1 or not self.use_device or k <= 0
+                or k > self.MAX_DEVICE_TOPK or getattr(seg, "is_mutable", False)):
+            return None
+        order = ctx.order_by[0]
+        if not isinstance(order.expr, Identifier):
+            return None
+        from .planner import _expr_device_ok
+        if _expr_device_ok(order.expr, seg):
+            return None
+        reader = seg.column(order.expr.name)
+        if reader.data_type.numpy_dtype.kind in "iu":
+            mn, mx = reader.min_value, reader.max_value
+            if mn is None or mx is None or max(abs(float(mn)), abs(float(mx))) >= (1 << 24):
+                return None  # f32 would misorder wide integers
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, CmpLeaf) and _expr_device_ok(leaf.expr, seg):
+                return None  # mask itself needs the host path
+        from ..engine import kernels
+        from ..engine.datablock import block_for
+        block = block_for(seg)
+        spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded)
+        inputs = self._kernel_inputs(plan, spec, block)
+        for c in identifiers_in(order.expr):
+            if c not in inputs.vals:
+                inputs.vals[c] = block.values(c)
+        idx, count, ok = kernels.compute_topk(spec, inputs, order.expr, order.desc,
+                                              k + self.TOPK_SLACK)
+        keep = min(k + self.TOPK_SLACK, count)
+        idx, ok = idx[:keep], ok[:keep]
+        idx = idx[ok & (idx < seg.num_docs)]
+        if len(idx) < min(k, count):
+            return None  # -inf/NaN keys displaced matches; exact host path decides
+        return idx, count
 
     def _selection_mask(self, plan: SegmentPlan) -> np.ndarray:
         seg = plan.segment
@@ -411,6 +472,48 @@ def _host_env(plan: SegmentPlan, seg: ImmutableSegment) -> Dict[str, np.ndarray]
             if isinstance(leaf, CmpLeaf):
                 needed.update(identifiers_in(leaf.expr))
     return {c: seg.column(c).values() for c in needed}
+
+
+def group_trim_spec(ctx: QueryContext, plan: SegmentPlan):
+    """(agg index or None-for-count, desc, k) when a group-by ORDER BY can be trimmed
+    to its top-k groups from device outputs alone; None otherwise.
+
+    Safe only against GLOBAL (fully combined) partials: per-segment partial sums can
+    rank groups differently than their cross-segment totals. Requires: single ORDER BY
+    key that IS one of the query's aggregations, no HAVING (it could resurrect
+    trimmed groups), no DISTINCT rewrite."""
+    if ctx.having is not None or ctx.distinct or len(ctx.order_by) != 1:
+        return None
+    k = ctx.offset + ctx.limit
+    if k <= 0 or k > ServerQueryExecutor.MAX_DEVICE_TOPK:
+        return None
+    o = ctx.order_by[0]
+    for i, fn_expr in enumerate(ctx.aggregations):
+        if repr(o.expr) == repr(fn_expr):
+            outs = plan.aggs[i].device_outputs
+            if outs in (("count",), ("sum",), ("min",), ("max",), ("sum", "count")):
+                return (i, o.desc, k)
+    return None
+
+
+def _trim_occupied(plan: SegmentPlan, outs, occupied: np.ndarray) -> np.ndarray:
+    """Exact top-k subset of occupied dense keys by the ORDER BY aggregation."""
+    trim = group_trim_spec(plan.ctx, plan)
+    if trim is None or len(occupied) <= trim[2]:
+        return occupied
+    i, desc, k = trim
+    outs_names = plan.aggs[i].device_outputs
+    if outs_names == ("count",):
+        score = outs["count"][:plan.num_keys_real][occupied].astype(np.float64)
+    elif outs_names == ("sum", "count"):  # AVG
+        s = outs[f"{i}.sum"][:plan.num_keys_real][occupied].astype(np.float64)
+        c = outs["count"][:plan.num_keys_real][occupied].astype(np.float64)
+        score = s / np.maximum(c, 1)
+    else:
+        score = np.asarray(outs[f"{i}.{outs_names[0]}"][:plan.num_keys_real][occupied],
+                           dtype=np.float64)
+    top = np.argpartition(-score if desc else score, k - 1)[:k]
+    return occupied[top]
 
 
 def _factorize_keys(arr: np.ndarray):

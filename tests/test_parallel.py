@@ -180,3 +180,34 @@ def test_mutable_growth_invalidates_view(unaligned_segments, mesh_exec, ssb_sche
         mut.index({k: v[r] for k, v in cols.items()})
     after = mesh_exec.execute(segs, sql).rows[0][0]
     assert after == before + 32
+
+
+def test_groupby_orderby_trim(aligned_segments, mesh_exec):
+    """Mesh group-by with ORDER BY <agg> LIMIT k trims decode to k groups, exactly."""
+    sql = ("SELECT lo_brand, SUM(lo_revenue) FROM lineorder "
+           "GROUP BY lo_brand ORDER BY SUM(lo_revenue) DESC LIMIT 5")
+    sharded = mesh_exec.execute(aligned_segments, sql)
+    single = ServerQueryExecutor().execute(aligned_segments, sql)
+    assert len(sharded.rows) == 5
+    assert sorted(map(repr, _norm(sharded.rows))) == sorted(map(repr, _norm(single.rows)))
+    # ascending + AVG variants
+    for sql in [
+        "SELECT lo_brand, COUNT(*) FROM lineorder GROUP BY lo_brand "
+        "ORDER BY COUNT(*) LIMIT 7",
+        "SELECT lo_brand, AVG(lo_extendedprice) FROM lineorder GROUP BY lo_brand "
+        "ORDER BY AVG(lo_extendedprice) DESC LIMIT 3",
+        "SELECT lo_brand, MIN(lo_revenue) FROM lineorder GROUP BY lo_brand "
+        "ORDER BY MIN(lo_revenue) LIMIT 4 OFFSET 2",
+    ]:
+        sharded = mesh_exec.execute(aligned_segments, sql)
+        single = ServerQueryExecutor().execute(aligned_segments, sql)
+        assert sorted(map(repr, _norm(sharded.rows))) == sorted(map(repr, _norm(single.rows)))
+
+
+def test_groupby_having_not_trimmed(aligned_segments, mesh_exec):
+    """HAVING must see ALL groups (trim would drop groups HAVING could keep)."""
+    sql = ("SELECT lo_brand, COUNT(*) FROM lineorder GROUP BY lo_brand "
+           "HAVING COUNT(*) > 10 ORDER BY COUNT(*) DESC LIMIT 3")
+    sharded = mesh_exec.execute(aligned_segments, sql)
+    single = ServerQueryExecutor().execute(aligned_segments, sql)
+    assert sorted(map(repr, _norm(sharded.rows))) == sorted(map(repr, _norm(single.rows)))

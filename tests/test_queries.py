@@ -307,3 +307,86 @@ def test_distinctcounthll_matches_host_path(qenv):
     dev = execute_query(segments, sql, use_device=True)
     host = execute_query(segments, sql, use_device=False)
     assert dev.rows == host.rows
+
+
+# ---------------------------------------------------------------------------
+# Device ORDER BY top-k (lax.top_k trim before host materialization)
+# ---------------------------------------------------------------------------
+
+class TestDeviceTopK:
+    @pytest.fixture(scope="class")
+    def seg(self, ssb_segment_dir):
+        from pinot_tpu.segment import load_segment
+        return load_segment(ssb_segment_dir[0])
+
+    TOPK_QUERIES = [
+        "SELECT lo_orderkey, lo_revenue FROM lineorder WHERE lo_quantity < 25 "
+        "ORDER BY lo_revenue DESC LIMIT 10",
+        "SELECT lo_orderkey, lo_revenue FROM lineorder WHERE lo_quantity < 25 "
+        "ORDER BY lo_revenue LIMIT 7",
+        "SELECT lo_orderkey FROM lineorder ORDER BY lo_extendedprice DESC LIMIT 5 OFFSET 3",
+        "SELECT lo_orderkey FROM lineorder WHERE lo_discount = 10 "
+        "ORDER BY lo_extendedprice * lo_discount DESC LIMIT 6",
+        # filter matching fewer rows than LIMIT
+        "SELECT lo_orderkey FROM lineorder WHERE lo_quantity = 1 AND lo_discount = 0 "
+        "ORDER BY lo_revenue DESC LIMIT 5000",
+    ]
+
+    @pytest.mark.parametrize("sql", TOPK_QUERIES)
+    def test_matches_host_sort(self, seg, sql):
+        from pinot_tpu.query.executor import ServerQueryExecutor
+        dev = ServerQueryExecutor(use_device=True).execute([seg], sql)
+        host = ServerQueryExecutor(use_device=False).execute([seg], sql)
+        assert dev.rows == host.rows
+
+    def test_device_trim_is_used(self, seg):
+        from pinot_tpu.query.context import compile_query
+        from pinot_tpu.query.executor import ServerQueryExecutor
+        from pinot_tpu.query.planner import plan_segment
+        ctx = compile_query(
+            "SELECT lo_orderkey FROM lineorder ORDER BY lo_revenue DESC LIMIT 10",
+            seg.schema)
+        plan = plan_segment(ctx, seg)
+        topk = ServerQueryExecutor()._topk_candidates(plan)
+        assert topk is not None
+        idx, scanned = topk
+        assert scanned == seg.num_docs  # match-all filter
+        assert 10 <= len(idx) <= 10 + ServerQueryExecutor.TOPK_SLACK
+
+    def test_wide_int_key_falls_back(self, tmp_path):
+        """Integer sort keys beyond 2^24 would misorder in f32 -> exact host sort."""
+        import numpy as np
+        from pinot_tpu.schema import DataType, Schema, dimension, metric
+        from pinot_tpu.segment import SegmentBuilder, load_segment
+        from pinot_tpu.query.context import compile_query
+        from pinot_tpu.query.executor import ServerQueryExecutor
+        from pinot_tpu.query.planner import plan_segment
+        schema = Schema("wide", [dimension("id", DataType.LONG),
+                                 metric("v", DataType.DOUBLE)])
+        rng = np.random.default_rng(53)
+        # adjacent wide ints that collide in f32 (2^25 + small deltas)
+        ids = (1 << 25) + rng.permutation(64).astype(np.int64)
+        seg = load_segment(SegmentBuilder(schema).build(
+            {"id": ids, "v": rng.uniform(0, 1, 64)}, str(tmp_path), "wide_0"))
+        ctx = compile_query("SELECT id FROM wide ORDER BY id DESC LIMIT 10", schema)
+        plan = plan_segment(ctx, seg)
+        assert ServerQueryExecutor()._topk_candidates(plan) is None
+        dev = ServerQueryExecutor(use_device=True).execute([seg], ctx)
+        host = ServerQueryExecutor(use_device=False).execute([seg], ctx)
+        assert dev.rows == host.rows
+
+    def test_multisegment_trim_merges(self, ssb_segment_dir, tmp_path, ssb_schema):
+        from pinot_tpu.segment import SegmentBuilder, load_segment
+        from pinot_tpu.query.executor import ServerQueryExecutor
+        import numpy as np
+        from conftest import make_ssb_columns
+        rng = np.random.default_rng(47)
+        segs = [load_segment(ssb_segment_dir[0])]
+        cols = make_ssb_columns(rng, 2048)
+        segs.append(load_segment(
+            SegmentBuilder(ssb_schema).build(cols, str(tmp_path), "lineorder_1")))
+        sql = ("SELECT lo_orderkey, lo_revenue FROM lineorder "
+               "ORDER BY lo_revenue DESC LIMIT 12")
+        dev = ServerQueryExecutor(use_device=True).execute(segs, sql)
+        host = ServerQueryExecutor(use_device=False).execute(segs, sql)
+        assert dev.rows == host.rows
