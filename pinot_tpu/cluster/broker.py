@@ -24,8 +24,8 @@ from ..table import TableType
 from .catalog import Catalog, InstanceInfo
 from .routing import RoutingManager
 
-# server handle: execute_partial(table, ctx, segment_names) -> SegmentResult
-ServerHandle = Callable[[str, QueryContext, Sequence[str]], SegmentResult]
+# server handle: execute_partial(table, ctx, segment_names, time_filter) -> SegmentResult
+ServerHandle = Callable[..., SegmentResult]
 
 # "unbounded" LIMIT for synthesized leaf scans — one sentinel for both the in-proc
 # ctx and the SQL shipped to remote servers, so both transports behave identically
@@ -79,14 +79,16 @@ class Broker:
 
         partials: List[SegmentResult] = []
         servers_queried = servers_failed = 0
+        boundary = self._time_boundary(physical)
         for table in physical:
             routing = self.routing.route_query(table, ctx)
             futures = {}
+            tf = _boundary_filter(boundary, table)
             for server_id, segments in routing.items():
                 handle = self._servers.get(server_id)
                 if handle is None:
                     continue
-                futures[self._pool.submit(handle, table, ctx, segments)] = server_id
+                futures[self._pool.submit(handle, table, ctx, segments, tf)] = server_id
             for fut in as_completed(futures):
                 server_id = futures[fut]
                 servers_queried += 1
@@ -131,7 +133,9 @@ class Broker:
             if filt is not None:
                 leaf_sql += f" WHERE {to_sql(filt)}"
             leaf_sql += f" LIMIT {UNBOUNDED_LIMIT}"
-            for table in self._physical_tables(raw_table):
+            physical = self._physical_tables(raw_table)
+            boundary = self._time_boundary(physical)
+            for table in physical:
                 ctx = QueryContext(
                     table=table,
                     select_items=[(Identifier(c), c) for c in columns],
@@ -140,11 +144,12 @@ class Broker:
                     sql=leaf_sql)
                 routing = self.routing.route_query(table, ctx)
                 futures = {}
+                tf = _boundary_filter(boundary, table)
                 for server_id, segments in routing.items():
                     handle = self._servers.get(server_id)
                     if handle is None:
                         continue
-                    futures[self._pool.submit(handle, table, ctx, segments)] = server_id
+                    futures[self._pool.submit(handle, table, ctx, segments, tf)] = server_id
                 for fut in as_completed(futures):
                     server_id = futures[fut]
                     try:
@@ -165,8 +170,7 @@ class Broker:
 
     def _physical_tables(self, raw_table: str) -> List[str]:
         """Resolve a logical name to physical tables; hybrid tables hit both OFFLINE
-        and REALTIME halves (reference: time-boundary split — simplified: realtime
-        segments carry only post-boundary data by construction here)."""
+        and REALTIME halves, split at the time boundary (`_time_boundary`)."""
         out = []
         for t in (f"{raw_table}_{TableType.OFFLINE.value}",
                   f"{raw_table}_{TableType.REALTIME.value}"):
@@ -175,3 +179,40 @@ class Broker:
         if raw_table in self.catalog.table_configs:
             out.append(raw_table)
         return out
+
+    def _time_boundary(self, physical: List[str]):
+        """Hybrid split point (reference: TimeBoundaryManager): OFFLINE answers
+        `time <= boundary`, REALTIME answers `time > boundary`, where boundary is the
+        max offline end time — data copied realtime->offline is then never counted
+        twice while the realtime copies await retention."""
+        offline = [t for t in physical if t.endswith(f"_{TableType.OFFLINE.value}")]
+        if len(physical) < 2 or not offline:
+            return None
+        cfg = self.catalog.table_configs.get(offline[0])
+        if cfg is None or not cfg.time_column:
+            return None
+        # only segments that are actually SERVABLE move the boundary: metadata lands
+        # before any server loads the segment, and advancing on metadata alone would
+        # transiently hide that window's realtime rows (reference:
+        # TimeBoundaryManager updates on external-view changes for the same reason)
+        ev = self.catalog.external_view.get(offline[0], {})
+        from .catalog import ONLINE
+        ends = [m.end_time_ms
+                for name, m in self.catalog.segments.get(offline[0], {}).items()
+                if m.end_time_ms is not None
+                and any(st == ONLINE for st in ev.get(name, {}).values())]
+        if not ends:
+            return None
+        return (cfg.time_column, max(ends))
+
+
+def _boundary_filter(boundary, table: str) -> Optional[str]:
+    if boundary is None:
+        return None
+    col, b = boundary
+    from ..sql.ast import _sql_ident
+    if table.endswith(f"_{TableType.OFFLINE.value}"):
+        return f"{_sql_ident(col)} <= {b}"
+    if table.endswith(f"_{TableType.REALTIME.value}"):
+        return f"{_sql_ident(col)} > {b}"
+    return None

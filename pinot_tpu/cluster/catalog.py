@@ -56,6 +56,9 @@ class SegmentMeta:
     end_offset: Optional[str] = None
     partition_group: Optional[int] = None
     sequence_number: Optional[int] = None
+    # free-form marks (reference: SegmentZKMetadata custom map — e.g. which minion
+    # task produced the segment, so generators don't re-process outputs)
+    custom: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self):
         return {k: v for k, v in self.__dict__.items()}
@@ -172,6 +175,31 @@ class Catalog:
             else:
                 entry[server] = state
         self._notify("external_view", table)
+
+    # -- properties (reference: ZK property store misc nodes: lineage, tasks,
+    # watermarks) ----------------------------------------------------------
+    def put_property(self, key: str, value: Any) -> None:
+        with self._lock:
+            if value is None:
+                self.properties.pop(key, None)
+            else:
+                self.properties[key] = value
+        self._notify("property", key)
+
+    def get_property(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self.properties.get(key, default)
+
+    def mutate_property(self, key: str, fn: Callable[[Any], Any]) -> Any:
+        """Atomic read-modify-write (the ZK compare-and-set analog)."""
+        with self._lock:
+            value = fn(self.properties.get(key))
+            if value is None:
+                self.properties.pop(key, None)
+            else:
+                self.properties[key] = value
+        self._notify("property", key)
+        return value
 
     # -- instances ---------------------------------------------------------
     def register_instance(self, info: InstanceInfo) -> None:

@@ -37,7 +37,24 @@ class Controller:
         from .completion import LLCSegmentManager
         self.llc = LLCSegmentManager(catalog, deepstore,
                                      os.path.join(work_dir, "llc"))
+        from ..minion.tasks import PinotTaskManager
+        from ..utils.periodic import PeriodicTask, PeriodicTaskScheduler
+        self.task_manager = PinotTaskManager(catalog)
+        # periodic controller tasks (reference: ControllerPeriodicTask registrations:
+        # RetentionManager, PinotTaskManager's generation cron)
+        self.scheduler = PeriodicTaskScheduler()
+        self.scheduler.register(PeriodicTask("RetentionManager", 300.0,
+                                             self.run_retention))
+        self.scheduler.register(PeriodicTask("PinotTaskManager", 60.0,
+                                             self.task_manager.generate_all))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
+
+    def start_periodic_tasks(self) -> None:
+        """Start background schedulers (tests tick with scheduler.run_all_once())."""
+        self.scheduler.start()
+
+    def stop_periodic_tasks(self) -> None:
+        self.scheduler.stop()
 
     # -- table CRUD (reference: PinotTableRestletResource + resource manager) ----
     def add_schema(self, schema: Schema) -> None:
@@ -61,7 +78,8 @@ class Controller:
         self.catalog.drop_table(table)
 
     # -- segment upload (reference: ZKOperator.completeSegmentOperations) --------
-    def upload_segment(self, table: str, segment_dir: str) -> SegmentMeta:
+    def upload_segment(self, table: str, segment_dir: str,
+                       custom: Optional[Dict[str, str]] = None) -> SegmentMeta:
         cfg = self.catalog.table_configs.get(table)
         if cfg is None:
             raise ValueError(f"unknown table {table!r}")
@@ -90,6 +108,7 @@ class Controller:
             size_bytes=size, download_path=uri,
             push_time_ms=int(time.time() * 1000),
             partition_id=self._partition_id(cfg, segment_dir, seg_meta_json),
+            custom=dict(custom or {}),
         )
         self._fill_time_range(cfg, seg_meta_json, meta)
         self.catalog.put_segment_meta(meta)
@@ -131,6 +150,55 @@ class Controller:
         else:
             chosen = balanced_assign(meta.name, servers, cfg.replication, counts)
         self.catalog.update_ideal_state(table, {meta.name: {s: ONLINE for s in chosen}})
+
+    # -- segment replace w/ lineage (reference: SegmentLineage +
+    # startReplaceSegments/endReplaceSegments REST flow) --------------------------
+    def replace_segments(self, table: str, old_names: List[str],
+                         new_segment_dirs: List[str],
+                         custom: Optional[Dict[str, str]] = None) -> List[str]:
+        """Atomically (to queries) swap `old_names` for the new segments.
+
+        Routing consults the lineage entries (`cluster/routing.py`): while the entry
+        is IN_PROGRESS queries keep hitting the old segments and ignore the new ones;
+        after the flip to COMPLETED they see only the new ones. Old segments are then
+        physically deleted and the entry removed.
+        """
+        import uuid as _uuid
+        new_names = []
+        for d in new_segment_dirs:
+            new_names.append(read_json(os.path.join(d, SEGMENT_METADATA_FILE))["segmentName"])
+        entry_id = _uuid.uuid4().hex
+        key = f"lineage/{table}"
+
+        def add_entry(entries):
+            entries = list(entries or [])
+            entries.append({"id": entry_id, "from": list(old_names),
+                            "to": new_names, "state": "IN_PROGRESS"})
+            return entries
+        self.catalog.mutate_property(key, add_entry)
+
+        try:
+            for d in new_segment_dirs:
+                self.upload_segment(table, d, custom=custom)
+        except Exception:
+            # revert: drop the half-uploaded outputs, queries never saw them
+            for name in new_names:
+                if name in self.catalog.segments.get(table, {}):
+                    self.delete_segment(table, name)
+            self.catalog.mutate_property(
+                key, lambda es: [e for e in (es or []) if e["id"] != entry_id] or None)
+            raise
+
+        def complete(entries):
+            return [dict(e, state="COMPLETED") if e["id"] == entry_id else e
+                    for e in (entries or [])]
+        self.catalog.mutate_property(key, complete)
+
+        for name in old_names:
+            self.delete_segment(table, name)
+        self.catalog.mutate_property(
+            key, lambda es: [e for e in (es or []) if e["id"] != entry_id] or None)
+        return new_names
 
     # -- deletion / retention ---------------------------------------------------
     def delete_segment(self, table: str, segment: str) -> None:

@@ -42,6 +42,10 @@ class QuickCluster:
         self.broker = Broker("broker_0", self.catalog)
         for s in self.servers:
             self.broker.register_server_handle(s.instance_id, s.execute_partial)
+        from ..minion.tasks import MinionWorker
+        self.minion = MinionWorker("minion_0", self.catalog, self.deepstore,
+                                   self.controller,
+                                   os.path.join(self.work_dir, "minion_0"))
         self._seg_seq: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
@@ -93,6 +97,11 @@ class QuickCluster:
 
     def query(self, sql: str) -> ResultTable:
         return self.broker.handle_query(sql)
+
+    def run_minion_round(self):
+        """One deterministic minion cycle: generate tasks, drain the queue."""
+        self.controller.task_manager.generate_all()
+        return self.minion.drain()
 
     # -- chaos helpers (reference: ChaosMonkeyIntegrationTest) --------------
     def kill_server(self, instance_id: str) -> None:

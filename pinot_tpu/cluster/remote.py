@@ -68,6 +68,19 @@ class RemoteCatalog(Catalog):
                   {"instance_id": instance_id, "alive": alive}, retries=2)
         super().set_instance_alive(instance_id, alive)
 
+    def put_property(self, key: str, value) -> None:
+        post_json(f"{self.controller_url}/catalog/property",
+                  {"key": key, "value": value}, retries=2)
+        super().put_property(key, value)
+
+    def mutate_property(self, key: str, fn):
+        # A remote read-modify-write needs a controller-side CAS endpoint; silently
+        # mutating only the mirror would be clobbered by the next snapshot poll
+        # (e.g. two minions double-claiming a task). Fail loudly until that exists.
+        raise NotImplementedError(
+            "mutate_property is not supported on RemoteCatalog; run task claiming "
+            "(TaskQueue) against the controller's in-proc catalog")
+
     # -- watch loop ----------------------------------------------------------
     def close(self) -> None:
         self._stop.set()
@@ -170,11 +183,12 @@ class RemoteServerHandle:
         self.server_url = server_url.rstrip("/")
         self.timeout_s = timeout_s
 
-    def __call__(self, table: str, ctx, segment_names: Sequence[str]):
+    def __call__(self, table: str, ctx, segment_names: Sequence[str],
+                 time_filter: Optional[str] = None):
         sql = ctx if isinstance(ctx, str) else ctx.sql
         if not sql:
             raise ValueError("remote dispatch requires the query SQL text")
-        body = encode_query_request(table, sql, segment_names)
+        body = encode_query_request(table, sql, segment_names, time_filter)
         resp = http_call("POST", f"{self.server_url}/query", body,
                          timeout=self.timeout_s,
                          content_type="application/octet-stream")

@@ -108,10 +108,23 @@ class RoutingManager:
             unhealthy = set(self._unhealthy)
         if rt is None:
             return {}
-        keep = None
+        keep = set(rt.segment_servers)
+        hidden = self._lineage_hidden(table)
+        if hidden:
+            keep -= hidden
         if ctx is not None:
-            keep = self._prune(table, set(rt.segment_servers), ctx)
+            keep = self._prune(table, keep, ctx)
         return rt.route(keep, exclude=unhealthy)
+
+    def _lineage_hidden(self, table: str) -> Set[str]:
+        """Segments hidden by replace-segment lineage (reference: SegmentLineage,
+        `selectSegments` filtering): IN_PROGRESS hides the replacement outputs,
+        COMPLETED hides the replaced inputs — so a query never sees both sides."""
+        entries = self.catalog.get_property(f"lineage/{table}") or []
+        hidden: Set[str] = set()
+        for e in entries:
+            hidden.update(e["to"] if e["state"] == "IN_PROGRESS" else e["from"])
+        return hidden
 
     def _prune(self, table: str, segments: Set[str], ctx: QueryContext) -> Set[str]:
         """Partition + time pruning from SegmentMeta (reference:

@@ -187,13 +187,21 @@ class ServerNode:
 
     # -- query execution ---------------------------------------------------
     def execute_partial(self, table: str, ctx: Union[str, QueryContext],
-                        segment_names: Optional[Sequence[str]] = None) -> SegmentResult:
+                        segment_names: Optional[Sequence[str]] = None,
+                        time_filter: Optional[str] = None) -> SegmentResult:
         """Run the query over this server's copy of `segment_names`, return the merged
         server-level partial (reference: ServerQueryExecutorV1Impl.processQuery returning
-        a DataTable)."""
+        a DataTable).
+
+        `time_filter` is an optional SQL boolean expression ANDed into the WHERE
+        clause — the broker's hybrid-table time-boundary split (reference: the
+        brokerRequest's timeBoundary attachment in BaseSingleStageBrokerRequestHandler).
+        """
         schema = self.catalog.schema_for_table(table)
         if isinstance(ctx, str):
             ctx = compile_query(ctx, schema)
+        if time_filter:
+            ctx = _apply_time_filter(ctx, time_filter, schema)
         mgr = self._table_manager(table)
         handler = self._realtime_managers.get(table)
         upsert = getattr(handler, "upsert", None) if handler else None
@@ -213,3 +221,20 @@ class ServerNode:
 
     def segments_served(self, table: str) -> List[str]:
         return self._table_manager(table).segment_names
+
+    @staticmethod
+    def apply_time_filter(ctx: QueryContext, time_filter: str, schema) -> QueryContext:
+        return _apply_time_filter(ctx, time_filter, schema)
+
+
+def _apply_time_filter(ctx: QueryContext, time_filter: str, schema) -> QueryContext:
+    """AND a SQL boolean expression (the broker's hybrid time-boundary predicate)
+    into the context's WHERE tree, reusing the normal compile pipeline so the
+    predicate is normalized exactly like a user-written one."""
+    import dataclasses
+    from ..sql.ast import Function
+    from ..sql.parser import parse_query
+    dummy = parse_query(f"SELECT * FROM t WHERE {time_filter}")
+    tf = compile_query(dummy, schema).filter
+    new_filter = tf if ctx.filter is None else Function("and", (ctx.filter, tf))
+    return dataclasses.replace(ctx, filter=new_filter)

@@ -123,6 +123,9 @@ class ControllerService:
             self.catalog.report_state(d["table"], d["segment"], d["server"],
                                       d["state"])
             return json_response({"status": "OK"})
+        if parts and parts[0] == "property":
+            self.catalog.put_property(d["key"], d.get("value"))
+            return json_response({"status": "OK"})
         return error_response("not found", 404)
 
     # -- admin: schemas / tables / segments ---------------------------------
@@ -243,7 +246,8 @@ class ServerService:
     def _query(self, parts, params, body):
         req = decode_query_request(body)
         result = self.server.execute_partial(req["table"], req["sql"],
-                                             req["segments"])
+                                             req["segments"],
+                                             time_filter=req.get("timeFilter"))
         return binary_response(encode_segment_result(result))
 
     def _segments(self, parts, params, body):

@@ -228,11 +228,13 @@ def decode_segment_result(data: bytes) -> SegmentResult:
     return r
 
 
-def encode_query_request(table: str, sql: str, segments) -> bytes:
+def encode_query_request(table: str, sql: str, segments,
+                         time_filter: str = None) -> bytes:
     """Broker -> server query dispatch (reference: thrift InstanceRequest with the
-    compiled query + searchSegments list, `InstanceRequestHandler.java:96`)."""
-    return json.dumps({"table": table, "sql": sql,
-                       "segments": list(segments)}).encode()
+    compiled query + searchSegments list, `InstanceRequestHandler.java:96`;
+    `timeFilter` carries the hybrid time-boundary predicate)."""
+    return json.dumps({"table": table, "sql": sql, "segments": list(segments),
+                       "timeFilter": time_filter}).encode()
 
 
 def decode_query_request(data: bytes) -> Dict[str, Any]:

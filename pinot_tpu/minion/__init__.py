@@ -1,0 +1,15 @@
+"""Minion: background segment maintenance (reference: pinot-minion + the
+segment-processing framework in pinot-core).
+
+`framework.py` is the data path (map/partition -> reduce/rollup -> segment build);
+`tasks.py` is the control path (task generation on the controller, a task queue in the
+catalog, minion workers executing registered task types).
+"""
+
+from .framework import ProcessorConfig, process_segments
+from .tasks import (MergeRollupTaskGenerator, MinionWorker, PinotTaskManager,
+                    RealtimeToOfflineTaskGenerator, TaskQueue, TaskSpec)
+
+__all__ = ["ProcessorConfig", "process_segments", "TaskQueue", "TaskSpec",
+           "PinotTaskManager", "MinionWorker", "MergeRollupTaskGenerator",
+           "RealtimeToOfflineTaskGenerator"]

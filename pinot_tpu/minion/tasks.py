@@ -1,0 +1,502 @@
+"""Minion task framework: generation (controller) -> queue (catalog) -> execution.
+
+Analog of the reference's task pipeline (SURVEY.md §2.8): `PinotTaskManager` runs task
+generators per table config (`pinot-controller/.../helix/core/minion/PinotTaskManager.java`),
+Helix's task framework queues them, and minion workers execute registered
+`PinotTaskExecutor`s (`pinot-minion/.../taskfactory/TaskFactoryRegistry.java`). Here the
+queue is a catalog property (the ZK analog), claims are atomic under the catalog lock,
+and executors run in `MinionWorker.run_once()` — deterministic for tests, loopable for
+production.
+
+Built-in tasks:
+* MergeRollupTask      — merge a time bucket's small segments into bigger ones,
+  optionally rolling up metrics (`.../mergerollup/MergeRollupTaskExecutor.java`)
+* RealtimeToOfflineSegmentsTask — move committed realtime data into the OFFLINE half
+  of a hybrid table, window by window (`.../realtimetoofflinesegments/...Executor.java`)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..table import TableConfig, TableType
+from .framework import CONCAT, ProcessorConfig, process_segments
+
+TASKS_KEY = "minionTasks"
+
+GENERATED = "GENERATED"
+RUNNING = "RUNNING"
+COMPLETED = "COMPLETED"
+ERROR = "ERROR"
+
+MERGE_ROLLUP = "MergeRollupTask"
+REALTIME_TO_OFFLINE = "RealtimeToOfflineSegmentsTask"
+PURGE = "PurgeTask"
+
+
+@dataclass
+class TaskSpec:
+    """One unit of minion work (reference: PinotTaskConfig)."""
+    task_id: str
+    task_type: str
+    table: str
+    config: Dict[str, Any] = field(default_factory=dict)
+    state: str = GENERATED
+    worker: str = ""
+    error: str = ""
+    finished_ms: int = 0
+
+    def to_json(self):
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_json(d):
+        return TaskSpec(**d)
+
+
+class TaskQueue:
+    """Task queue in the catalog property store (the Helix task-queue analog)."""
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    def _all(self) -> Dict[str, Dict]:
+        return self.catalog.get_property(TASKS_KEY, {}) or {}
+
+    def submit(self, spec: TaskSpec) -> None:
+        def mutate(tasks):
+            tasks = dict(tasks or {})
+            tasks[spec.task_id] = spec.to_json()
+            return tasks
+        self.catalog.mutate_property(TASKS_KEY, mutate)
+
+    def claim(self, worker_id: str, task_types: List[str]) -> Optional[TaskSpec]:
+        """Atomically claim the oldest GENERATED task of a supported type."""
+        claimed: List[TaskSpec] = []
+
+        def mutate(tasks):
+            tasks = dict(tasks or {})
+            for tid in sorted(tasks):
+                t = tasks[tid]
+                if t["state"] == GENERATED and t["task_type"] in task_types:
+                    t = dict(t, state=RUNNING, worker=worker_id)
+                    tasks[tid] = t
+                    claimed.append(TaskSpec.from_json(t))
+                    break
+            return tasks
+        self.catalog.mutate_property(TASKS_KEY, mutate)
+        return claimed[0] if claimed else None
+
+    def finish(self, task_id: str, error: str = "") -> None:
+        def mutate(tasks):
+            tasks = dict(tasks or {})
+            if task_id in tasks:
+                tasks[task_id] = dict(tasks[task_id],
+                                      state=ERROR if error else COMPLETED,
+                                      error=error, finished_ms=int(time.time() * 1000))
+            return tasks
+        self.catalog.mutate_property(TASKS_KEY, mutate)
+
+    def tasks(self, table: Optional[str] = None,
+              task_type: Optional[str] = None) -> List[TaskSpec]:
+        out = [TaskSpec.from_json(t) for t in self._all().values()]
+        if table is not None:
+            out = [t for t in out if t.table == table]
+        if task_type is not None:
+            out = [t for t in out if t.task_type == task_type]
+        return sorted(out, key=lambda t: t.task_id)
+
+    def has_pending(self, table: str, task_type: str) -> bool:
+        return any(t.state in (GENERATED, RUNNING)
+                   for t in self.tasks(table, task_type))
+
+    def in_error_backoff(self, table: str, task_type: str,
+                         backoff_ms: int = 300_000,
+                         now_ms: Optional[int] = None) -> bool:
+        """True while the most recent task of this type failed recently — generators
+        wait out the backoff instead of re-queueing a failing task every tick."""
+        now_ms = now_ms or int(time.time() * 1000)
+        recent = [t for t in self.tasks(table, task_type) if t.finished_ms]
+        if not recent:
+            return False
+        last = max(recent, key=lambda t: t.finished_ms)
+        return last.state == ERROR and now_ms - last.finished_ms < backoff_ms
+
+    def gc(self, max_age_ms: int = 3600_000, keep: int = 100,
+           now_ms: Optional[int] = None) -> int:
+        """Drop old terminal tasks so the property (shipped in every catalog
+        snapshot) stays bounded; returns how many were removed."""
+        now_ms = now_ms or int(time.time() * 1000)
+        removed = []
+
+        def mutate(tasks):
+            tasks = dict(tasks or {})
+            terminal = sorted(
+                (tid for tid, t in tasks.items()
+                 if t["state"] in (COMPLETED, ERROR)),
+                key=lambda tid: tasks[tid].get("finished_ms", 0), reverse=True)
+            for tid in terminal[keep:]:
+                removed.append(tasks.pop(tid))
+            for tid in terminal[:keep]:
+                if now_ms - tasks[tid].get("finished_ms", 0) > max_age_ms:
+                    removed.append(tasks.pop(tid))
+            return tasks or None
+        self.catalog.mutate_property(TASKS_KEY, mutate)
+        return len(removed)
+
+
+# ---------------------------------------------------------------------------
+# Task generation (controller side)
+# ---------------------------------------------------------------------------
+
+class TaskGenerator:
+    """SPI (reference: PinotTaskGenerator). One instance per task type."""
+
+    task_type = ""
+
+    def generate(self, catalog, cfg: TableConfig, queue: TaskQueue) -> List[TaskSpec]:
+        raise NotImplementedError
+
+
+def _mergeable_segments(catalog, table: str, bucket_ms: int, now_ms: int,
+                        buffer_ms: int) -> Dict[int, List]:
+    """Completed segments grouped by CLOSED time bucket, excluding merge outputs."""
+    from ..cluster.catalog import STATUS_DONE, STATUS_UPLOADED
+    out: Dict[int, List] = {}
+    for name, meta in catalog.segments.get(table, {}).items():
+        if meta.status not in (STATUS_DONE, STATUS_UPLOADED):
+            continue  # consuming segments are not merge inputs
+        if meta.start_time_ms is None or meta.end_time_ms is None:
+            continue
+        if meta.custom.get("task") == MERGE_ROLLUP:
+            continue  # single merge level: don't re-merge outputs
+        lo_b, hi_b = meta.start_time_ms // bucket_ms, meta.end_time_ms // bucket_ms
+        if lo_b != hi_b:
+            continue  # spans buckets: already bucket-sized or bigger
+        if (lo_b + 1) * bucket_ms > now_ms - buffer_ms:
+            continue  # bucket not closed yet
+        out.setdefault(int(lo_b), []).append(meta)
+    return out
+
+
+class MergeRollupTaskGenerator(TaskGenerator):
+    """Reference: MergeRollupTaskGenerator — one task per closed time bucket holding
+    more than one un-merged segment."""
+
+    task_type = MERGE_ROLLUP
+
+    def generate(self, catalog, cfg: TableConfig, queue: TaskQueue) -> List[TaskSpec]:
+        tcfg = cfg.task_configs.get(self.task_type)
+        table = cfg.table_name_with_type
+        if tcfg is None or not cfg.time_column:
+            return []
+        if queue.has_pending(table, self.task_type) \
+                or queue.in_error_backoff(table, self.task_type):
+            return []  # one in-flight task per table (reference: same guard)
+        bucket_ms = int(tcfg.get("bucketMs", 24 * 3600 * 1000))
+        buffer_ms = int(tcfg.get("bufferMs", 0))
+        now_ms = int(time.time() * 1000)
+        specs = []
+        for bucket, metas in sorted(_mergeable_segments(
+                catalog, table, bucket_ms, now_ms, buffer_ms).items()):
+            if len(metas) < 2:
+                continue
+            specs.append(TaskSpec(
+                task_id=f"{self.task_type}_{table}_{bucket}_{uuid.uuid4().hex[:8]}",
+                task_type=self.task_type, table=table,
+                config={
+                    "segments": sorted(m.name for m in metas),
+                    "bucketMs": bucket_ms,
+                    "mergeType": tcfg.get("mergeType", CONCAT),
+                    "roundTimeTo": tcfg.get("roundTimeTo"),
+                    "aggregations": tcfg.get("aggregations", {}),
+                    "maxRowsPerSegment": int(tcfg.get("maxRowsPerSegment", 5_000_000)),
+                    "bucket": bucket,
+                }))
+        for s in specs:
+            queue.submit(s)
+        return specs
+
+
+class RealtimeToOfflineTaskGenerator(TaskGenerator):
+    """Reference: RealtimeToOfflineSegmentsTaskGenerator — advance a per-table
+    watermark window; only windows fully covered by COMMITTED segments qualify."""
+
+    task_type = REALTIME_TO_OFFLINE
+
+    def generate(self, catalog, cfg: TableConfig, queue: TaskQueue) -> List[TaskSpec]:
+        from ..cluster.catalog import STATUS_DONE, STATUS_UPLOADED
+        tcfg = cfg.task_configs.get(self.task_type)
+        table = cfg.table_name_with_type
+        if (tcfg is None or cfg.table_type is not TableType.REALTIME
+                or not cfg.time_column):
+            return []
+        if queue.has_pending(table, self.task_type) \
+                or queue.in_error_backoff(table, self.task_type):
+            return []
+        bucket_ms = int(tcfg.get("bucketMs", 24 * 3600 * 1000))
+        metas = list(catalog.segments.get(table, {}).values())
+        done = [m for m in metas if m.status in (STATUS_DONE, STATUS_UPLOADED)
+                and m.start_time_ms is not None]
+        if not done:
+            return []
+        wm_key = f"rtToOffline/{table}/watermark"
+        watermark = catalog.get_property(wm_key)
+        if watermark is None:
+            watermark = (min(m.start_time_ms for m in done) // bucket_ms) * bucket_ms
+        window_start, window_end = int(watermark), int(watermark) + bucket_ms
+        # window completeness: per partition, COMMITTED segments must already cover
+        # data past the window end — per-partition stream order then guarantees the
+        # still-consuming segment holds only newer rows (reference: the generator's
+        # check against each partition's latest completed segment end time)
+        partitions = {m.partition_group for m in metas}
+        for pg in partitions:
+            ends = [m.end_time_ms for m in done
+                    if m.partition_group == pg and m.end_time_ms is not None]
+            if not ends or max(ends) < window_end:
+                return []
+        inputs = [m.name for m in done
+                  if m.start_time_ms < window_end
+                  and (m.end_time_ms or m.start_time_ms) >= window_start]
+        if not inputs:
+            # nothing in this window: advance the watermark and retry next round
+            catalog.put_property(wm_key, window_end)
+            return []
+        spec = TaskSpec(
+            task_id=f"{self.task_type}_{table}_{window_start}_{uuid.uuid4().hex[:8]}",
+            task_type=self.task_type, table=table,
+            config={
+                "segments": sorted(inputs),
+                "windowStartMs": window_start,
+                "windowEndMs": window_end,
+                "mergeType": tcfg.get("mergeType", CONCAT),
+                "roundTimeTo": tcfg.get("roundTimeTo"),
+                "aggregations": tcfg.get("aggregations", {}),
+                "maxRowsPerSegment": int(tcfg.get("maxRowsPerSegment", 5_000_000)),
+            })
+        queue.submit(spec)
+        return [spec]
+
+
+class PinotTaskManager:
+    """Controller-side periodic generation over all tables (reference: PinotTaskManager)."""
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+        self.queue = TaskQueue(catalog)
+        self.generators: Dict[str, TaskGenerator] = {}
+        for gen in (MergeRollupTaskGenerator(), RealtimeToOfflineTaskGenerator()):
+            self.generators[gen.task_type] = gen
+
+    def register_generator(self, gen: TaskGenerator) -> None:
+        self.generators[gen.task_type] = gen
+
+    def generate_all(self) -> List[TaskSpec]:
+        self.queue.gc()
+        specs: List[TaskSpec] = []
+        for cfg in list(self.catalog.table_configs.values()):
+            for task_type in cfg.task_configs:
+                gen = self.generators.get(task_type)
+                if gen is not None:
+                    specs.extend(gen.generate(self.catalog, cfg, self.queue))
+        return specs
+
+
+# ---------------------------------------------------------------------------
+# Execution (minion worker side)
+# ---------------------------------------------------------------------------
+
+class TaskExecutor:
+    """SPI (reference: PinotTaskExecutor)."""
+
+    task_type = ""
+
+    def execute(self, spec: TaskSpec, worker: "MinionWorker") -> None:
+        raise NotImplementedError
+
+
+class BaseMergeExecutor(TaskExecutor):
+    """Shared download -> process -> publish pipeline for merge-shaped tasks."""
+
+    def _load_inputs(self, spec: TaskSpec, worker: "MinionWorker"):
+        from ..segment.reader import load_segment
+        segs = []
+        for name in spec.config["segments"]:
+            segs.append(load_segment(worker.fetch_segment(spec.table, name)))
+        return segs
+
+    @staticmethod
+    def _generator_config(cfg: TableConfig):
+        from ..segment.writer import SegmentGeneratorConfig
+        idx = cfg.indexing
+        return SegmentGeneratorConfig(
+            no_dictionary_columns=list(idx.no_dictionary_columns),
+            inverted_index_columns=list(idx.inverted_index_columns),
+            range_index_columns=list(idx.range_index_columns),
+            bloom_filter_columns=list(idx.bloom_filter_columns),
+        )
+
+    def _processor_config(self, spec: TaskSpec, cfg: TableConfig,
+                          prefix: str) -> ProcessorConfig:
+        return ProcessorConfig(
+            merge_type=spec.config.get("mergeType", CONCAT),
+            time_column=cfg.time_column,
+            bucket_ms=spec.config.get("bucketMs"),
+            round_time_to=spec.config.get("roundTimeTo"),
+            window_start=spec.config.get("windowStartMs"),
+            window_end=spec.config.get("windowEndMs"),
+            max_rows_per_segment=spec.config.get("maxRowsPerSegment", 5_000_000),
+            aggregations=spec.config.get("aggregations", {}),
+            segment_prefix=prefix,
+            generator_config=self._generator_config(cfg))
+
+
+class MergeRollupTaskExecutor(BaseMergeExecutor):
+    task_type = MERGE_ROLLUP
+
+    def execute(self, spec: TaskSpec, worker: "MinionWorker") -> None:
+        cfg = worker.catalog.table_configs[spec.table]
+        schema = worker.catalog.schemas[cfg.name]
+        segs = self._load_inputs(spec, worker)
+        prefix = f"merged_{cfg.name}_{spec.config['bucket']}_{uuid.uuid4().hex[:6]}"
+        out_dir = os.path.join(worker.work_dir, spec.task_id, "out")
+        built = process_segments(segs, schema, self._processor_config(spec, cfg, prefix),
+                                 out_dir)
+        # atomic swap via segment lineage: queries never see inputs+outputs together;
+        # the custom mark keeps outputs out of the next generation round
+        worker.controller.replace_segments(spec.table, spec.config["segments"], built,
+                                           custom={"task": MERGE_ROLLUP})
+
+
+class RealtimeToOfflineTaskExecutor(BaseMergeExecutor):
+    task_type = REALTIME_TO_OFFLINE
+
+    def execute(self, spec: TaskSpec, worker: "MinionWorker") -> None:
+        rt_cfg = worker.catalog.table_configs[spec.table]
+        offline_table = f"{rt_cfg.name}_{TableType.OFFLINE.value}"
+        if offline_table not in worker.catalog.table_configs:
+            raise ValueError(f"hybrid table {rt_cfg.name!r} has no OFFLINE half")
+        schema = worker.catalog.schemas[rt_cfg.name]
+        segs = self._load_inputs(spec, worker)
+        start = spec.config["windowStartMs"]
+        # DETERMINISTIC per-window prefix: a retry after partial failure first sweeps
+        # leftovers of the previous attempt, so the window's rows appear exactly once
+        prefix = f"{rt_cfg.name}_rto_{start}"
+        leftovers = [n for n in worker.catalog.segments.get(offline_table, {})
+                     if n.startswith(prefix + "_")]
+        for n in leftovers:
+            worker.controller.delete_segment(offline_table, n)
+        out_dir = os.path.join(worker.work_dir, spec.task_id, "out")
+        built = process_segments(segs, schema, self._processor_config(spec, rt_cfg, prefix),
+                                 out_dir)
+        for seg_dir in built:
+            worker.controller.upload_segment(offline_table, seg_dir,
+                                             custom={"task": REALTIME_TO_OFFLINE,
+                                                     "windowStartMs": str(start)})
+        # advance the watermark only after every upload landed; a crash before this
+        # re-runs the window, and the sweep above keeps that idempotent
+        worker.catalog.put_property(f"rtToOffline/{spec.table}/watermark",
+                                    spec.config["windowEndMs"])
+        # realtime copies stay until retention expires them; the broker's hybrid time
+        # boundary keeps them from double-counting (cluster/broker.py)
+
+
+class PurgeTaskExecutor(BaseMergeExecutor):
+    """Rewrite segments dropping rows that match a purge predicate (reference:
+    PurgeTaskExecutor + RecordPurger)."""
+
+    task_type = PURGE
+
+    def execute(self, spec: TaskSpec, worker: "MinionWorker") -> None:
+        import numpy as np
+        from .framework import concat_columns, read_columns
+        from ..segment.writer import SegmentBuilder
+        cfg = worker.catalog.table_configs[spec.table]
+        schema = worker.catalog.schemas[cfg.name]
+        segs = self._load_inputs(spec, worker)
+        column = spec.config["column"]
+        values = set(spec.config["values"])
+        out_dir = os.path.join(worker.work_dir, spec.task_id, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        builder = SegmentBuilder(schema, self._generator_config(cfg))
+        built = []
+        for seg, name in zip(segs, spec.config["segments"]):
+            cols = read_columns(seg, schema)
+            keep = np.array([v not in values for v in cols[column].tolist()], dtype=bool)
+            if keep.all():
+                continue
+            kept = {k: v[keep] for k, v in cols.items()}
+            built.append((name, builder.build(kept, out_dir,
+                                              f"{name}_purged_{uuid.uuid4().hex[:6]}")))
+        if built:
+            worker.controller.replace_segments(spec.table, [n for n, _ in built],
+                                               [d for _, d in built])
+
+
+class MinionWorker:
+    """Minion role: claims queued tasks and runs the registered executor.
+
+    `controller` is the controller API surface it needs (upload_segment,
+    replace_segments) — the in-proc Controller object or an HTTP proxy with the
+    same methods.
+    """
+
+    def __init__(self, instance_id: str, catalog, deepstore, controller, work_dir: str):
+        from ..cluster.catalog import InstanceInfo
+        self.instance_id = instance_id
+        self.catalog = catalog
+        self.deepstore = deepstore
+        self.controller = controller
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self.queue = TaskQueue(catalog)
+        self.executors: Dict[str, TaskExecutor] = {}
+        for ex in (MergeRollupTaskExecutor(), RealtimeToOfflineTaskExecutor(),
+                   PurgeTaskExecutor()):
+            self.executors[ex.task_type] = ex
+        self.completed = 0
+        self.failed = 0
+        catalog.register_instance(InstanceInfo(instance_id, "minion"))
+
+    def register_executor(self, ex: TaskExecutor) -> None:
+        self.executors[ex.task_type] = ex
+
+    def fetch_segment(self, table: str, segment: str) -> str:
+        """Download + unpack one segment from the deep store; returns its dir."""
+        from ..cluster.deepstore import untar_segment
+        meta = self.catalog.segments[table][segment]
+        tar_path = os.path.join(self.work_dir, "fetch", f"{segment}.tar.gz")
+        self.deepstore.download(meta.download_path, tar_path)
+        seg_dir = untar_segment(tar_path, os.path.join(self.work_dir, "fetch", segment))
+        os.remove(tar_path)
+        return seg_dir
+
+    def run_once(self) -> Optional[TaskSpec]:
+        """Claim and execute one task; returns it (state reflects the outcome)."""
+        spec = self.queue.claim(self.instance_id, list(self.executors))
+        if spec is None:
+            return None
+        try:
+            self.executors[spec.task_type].execute(spec, self)
+            self.queue.finish(spec.task_id)
+            spec.state = COMPLETED
+            self.completed += 1
+        except Exception as e:  # task failure must not kill the worker loop
+            self.queue.finish(spec.task_id, error=f"{type(e).__name__}: {e}")
+            spec.state = ERROR
+            spec.error = str(e)
+            self.failed += 1
+        return spec
+
+    def drain(self, max_tasks: int = 64) -> List[TaskSpec]:
+        out = []
+        for _ in range(max_tasks):
+            spec = self.run_once()
+            if spec is None:
+                break
+            out.append(spec)
+        return out

@@ -482,7 +482,8 @@ def group_trim_spec(ctx: QueryContext, plan: SegmentPlan):
     rank groups differently than their cross-segment totals. Requires: single ORDER BY
     key that IS one of the query's aggregations, no HAVING (it could resurrect
     trimmed groups), no DISTINCT rewrite."""
-    if ctx.having is not None or ctx.distinct or len(ctx.order_by) != 1:
+    if (ctx.having is not None or ctx.distinct or len(ctx.order_by) != 1
+            or ctx.gapfill is not None):  # gapfill fabricates rows for trimmed groups
         return None
     k = ctx.offset + ctx.limit
     if k <= 0 or k > ServerQueryExecutor.MAX_DEVICE_TOPK:

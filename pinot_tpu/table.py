@@ -126,6 +126,9 @@ class TableConfig:
     # dimension table: small, fully replicated to every server, loaded into a PK map
     # for LOOKUP joins (reference: DimensionTableConfig / isDimTable)
     is_dim_table: bool = False
+    # minion task configs by task type (reference: TableTaskConfig, e.g.
+    # {"MergeRollupTask": {"bucketMs": 86400000}, "RealtimeToOfflineSegmentsTask": {}})
+    task_configs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     @property
     def table_name_with_type(self) -> str:
@@ -142,6 +145,7 @@ class TableConfig:
             "tenant": self.tenant,
             "dedupEnabled": self.dedup_enabled,
             "isDimTable": self.is_dim_table,
+            "taskConfigs": self.task_configs,
         }
         if self.partition:
             d["segmentPartitionConfig"] = self.partition.to_json()
@@ -167,6 +171,7 @@ class TableConfig:
             dedup_enabled=d.get("dedupEnabled", False),
             is_dim_table=d.get("isDimTable", False),
             tenant=d.get("tenant", "DefaultTenant"),
+            task_configs=d.get("taskConfigs", {}),
         )
 
     def to_json_str(self) -> str:

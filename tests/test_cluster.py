@@ -67,7 +67,7 @@ def test_replica_failover(lineorder_cluster):
 def test_failed_server_produces_partial_result(lineorder_cluster):
     cluster, cfg = lineorder_cluster
 
-    def broken(table, ctx, segments):
+    def broken(table, ctx, segments, time_filter=None):
         raise ConnectionError("boom")
 
     cluster.broker.register_server_handle("server_1", broken)

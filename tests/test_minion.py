@@ -1,0 +1,266 @@
+"""Minion tests: segment-processing framework, MergeRollup, RealtimeToOffline,
+scheduled retention, lineage-protected replace.
+
+Reference scenarios: MergeRollupTaskExecutor/Generator tests, RealtimeToOffline
+integration tests, RetentionManager tests (SURVEY.md §2.8).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.enclosure import QuickCluster
+from pinot_tpu.minion import ProcessorConfig, process_segments
+from pinot_tpu.minion.framework import CONCAT, DEDUP, ROLLUP
+from pinot_tpu.minion.tasks import COMPLETED, MERGE_ROLLUP, REALTIME_TO_OFFLINE
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+DAY = 24 * 3600 * 1000
+
+
+def event_schema(name="events"):
+    return Schema(name, [
+        dimension("site", DataType.STRING),
+        date_time("ts", DataType.LONG),
+        metric("clicks", DataType.LONG),
+        metric("cost", DataType.DOUBLE),
+    ])
+
+
+def make_cols(rng, n, day_ms, sites=("a", "b", "c")):
+    return {
+        "site": [sites[i] for i in rng.integers(0, len(sites), n)],
+        "ts": day_ms + rng.integers(0, DAY, n, dtype=np.int64),
+        "clicks": rng.integers(1, 10, n, dtype=np.int64),
+        "cost": np.round(rng.uniform(0.1, 5.0, n), 4),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Framework
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def _segments(self, tmp_path, n_segs=3, rows=100, day=0):
+        rng = np.random.default_rng(5)
+        schema = event_schema()
+        builder = SegmentBuilder(schema)
+        segs = []
+        for i in range(n_segs):
+            cols = make_cols(rng, rows, day * DAY)
+            segs.append(load_segment(builder.build(cols, str(tmp_path), f"in_{i}")))
+        return schema, segs
+
+    def test_concat_preserves_rows(self, tmp_path):
+        schema, segs = self._segments(tmp_path / "in")
+        built = process_segments(segs, schema, ProcessorConfig(
+            merge_type=CONCAT, segment_prefix="m"), str(tmp_path / "out"))
+        assert len(built) == 1
+        merged = load_segment(built[0])
+        assert merged.num_docs == sum(s.num_docs for s in segs)
+        want = sum(int(v) for s in segs for v in s.column("clicks").values())
+        assert sum(int(v) for v in merged.column("clicks").values()) == want
+
+    def test_rollup_aggregates_metrics(self, tmp_path):
+        schema, segs = self._segments(tmp_path / "in")
+        built = process_segments(segs, schema, ProcessorConfig(
+            merge_type=ROLLUP, time_column="ts", round_time_to=DAY,
+            aggregations={"cost": "sum"}, segment_prefix="m"), str(tmp_path / "out"))
+        merged = load_segment(built[0])
+        # after rounding ts to the day, keys collapse to (site, day): <= 3 sites
+        assert merged.num_docs <= 3
+        total = sum(float(v) for s in segs for v in s.column("cost").values())
+        assert sum(float(v) for v in merged.column("cost").values()) == pytest.approx(
+            total, rel=1e-9)
+        want_clicks = sum(int(v) for s in segs for v in s.column("clicks").values())
+        assert sum(int(v) for v in merged.column("clicks").values()) == want_clicks
+
+    def test_rollup_min_max(self, tmp_path):
+        schema, segs = self._segments(tmp_path / "in")
+        built = process_segments(segs, schema, ProcessorConfig(
+            merge_type=ROLLUP, time_column="ts", round_time_to=DAY,
+            aggregations={"cost": "max", "clicks": "min"}, segment_prefix="m"),
+            str(tmp_path / "out"))
+        merged = load_segment(built[0])
+        want_max = max(float(v) for s in segs for v in s.column("cost").values())
+        assert max(float(v) for v in merged.column("cost").values()) == pytest.approx(want_max)
+
+    def test_dedup_drops_identical_rows(self, tmp_path):
+        schema = event_schema()
+        cols = {"site": ["x", "x", "y"], "ts": np.array([1, 1, 2], dtype=np.int64),
+                "clicks": np.array([5, 5, 6], dtype=np.int64),
+                "cost": np.array([1.0, 1.0, 2.0])}
+        seg = load_segment(SegmentBuilder(schema).build(cols, str(tmp_path / "in"), "d0"))
+        built = process_segments([seg, seg], schema, ProcessorConfig(
+            merge_type=DEDUP, segment_prefix="m"), str(tmp_path / "out"))
+        assert load_segment(built[0]).num_docs == 2
+
+    def test_time_window_and_buckets(self, tmp_path):
+        schema = event_schema()
+        rng = np.random.default_rng(9)
+        cols = make_cols(rng, 200, 0)
+        cols["ts"] = rng.integers(0, 3 * DAY, 200, dtype=np.int64)  # spans 3 days
+        seg = load_segment(SegmentBuilder(schema).build(cols, str(tmp_path / "in"), "w0"))
+        built = process_segments([seg], schema, ProcessorConfig(
+            merge_type=CONCAT, time_column="ts", bucket_ms=DAY,
+            window_start=0, window_end=2 * DAY, segment_prefix="m"),
+            str(tmp_path / "out"))
+        assert len(built) == 2  # one per day bucket inside the window
+        total = sum(load_segment(b).num_docs for b in built)
+        assert total == int((cols["ts"] < 2 * DAY).sum())
+
+    def test_split_by_max_rows(self, tmp_path):
+        schema, segs = self._segments(tmp_path / "in", n_segs=2, rows=150)
+        built = process_segments(segs, schema, ProcessorConfig(
+            merge_type=CONCAT, max_rows_per_segment=100, segment_prefix="m"),
+            str(tmp_path / "out"))
+        assert len(built) == 3
+        assert sum(load_segment(b).num_docs for b in built) == 300
+
+
+# ---------------------------------------------------------------------------
+# MergeRollupTask end-to-end
+# ---------------------------------------------------------------------------
+
+def test_merge_rollup_task(tmp_path):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    schema = event_schema()
+    yesterday = (int(time.time() * 1000) // DAY - 1) * DAY
+    cfg = TableConfig(schema.name, time_column="ts",
+                      task_configs={MERGE_ROLLUP: {
+                          "bucketMs": DAY, "mergeType": "ROLLUP",
+                          "roundTimeTo": DAY, "aggregations": {"cost": "sum"}}})
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(17)
+    for i in range(3):
+        cluster.ingest_columns(cfg, make_cols(rng, 120, yesterday))
+    before = cluster.query("SELECT SUM(clicks), SUM(cost), COUNT(*) FROM events LIMIT 5")
+    assert len(cluster.catalog.segments[cfg.table_name_with_type]) == 3
+
+    done = cluster.run_minion_round()
+    assert [t.state for t in done] == [COMPLETED], [t.error for t in done]
+
+    segs = cluster.catalog.segments[cfg.table_name_with_type]
+    assert len(segs) == 1 and next(iter(segs)).startswith("merged_")
+    after = cluster.query("SELECT SUM(clicks), SUM(cost), COUNT(*) FROM events LIMIT 5")
+    assert after.rows[0][0] == before.rows[0][0]
+    assert after.rows[0][1] == pytest.approx(before.rows[0][1], rel=1e-5)
+    assert after.rows[0][2] <= before.rows[0][2]  # rollup shrank the row count
+    # idempotent: merged outputs are not re-merged
+    assert cluster.run_minion_round() == []
+
+
+def test_merge_rollup_concat_preserves_queries(tmp_path):
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = event_schema()
+    yesterday = (int(time.time() * 1000) // DAY - 1) * DAY
+    cfg = TableConfig(schema.name, time_column="ts",
+                      task_configs={MERGE_ROLLUP: {"bucketMs": DAY}})
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(19)
+    for i in range(2):
+        cluster.ingest_columns(cfg, make_cols(rng, 80, yesterday))
+    before = cluster.query(
+        "SELECT site, COUNT(*), SUM(cost) FROM events GROUP BY site ORDER BY site LIMIT 10")
+    done = cluster.run_minion_round()
+    assert [t.state for t in done] == [COMPLETED], [t.error for t in done]
+    after = cluster.query(
+        "SELECT site, COUNT(*), SUM(cost) FROM events GROUP BY site ORDER BY site LIMIT 10")
+    assert [(r[0], r[1]) for r in after.rows] == [(r[0], r[1]) for r in before.rows]
+    for a, b in zip(after.rows, before.rows):
+        assert a[2] == pytest.approx(b[2], rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# RealtimeToOfflineSegmentsTask end-to-end (hybrid table)
+# ---------------------------------------------------------------------------
+
+def _ingest_realtime_window(cluster, cfg, schema, rng, day_ms, rows=60):
+    import json
+    from pinot_tpu.ingest.stream import MemoryStream
+    topic = MemoryStream.get(cfg.stream.topic)
+    cols = make_cols(rng, rows, day_ms)
+    for i in range(rows):
+        row = {k: (v[i].item() if isinstance(v[i], np.generic) else v[i])
+               for k, v in cols.items()}
+        topic.produce(json.dumps(row), partition=0)
+    cluster.pump_realtime(cfg.table_name_with_type)
+
+
+def test_realtime_to_offline_task(tmp_path):
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = event_schema()
+    day0 = (int(time.time() * 1000) // DAY - 3) * DAY
+    rt_cfg = TableConfig(schema.name, table_type=TableType.REALTIME,
+                         time_column="ts",
+                         stream=StreamConfig(topic="events_topic",
+                                             flush_threshold_rows=50),
+                         task_configs={REALTIME_TO_OFFLINE: {"bucketMs": DAY}})
+    off_cfg = TableConfig(schema.name, table_type=TableType.OFFLINE, time_column="ts")
+    cluster.controller.add_schema(schema)
+    cluster.controller.add_table(off_cfg)
+    cluster.create_realtime_table(schema, rt_cfg, num_partitions=1)
+
+    rng = np.random.default_rng(23)
+    # two committed windows + rows still consuming in a later window
+    _ingest_realtime_window(cluster, rt_cfg, schema, rng, day0, rows=60)
+    _ingest_realtime_window(cluster, rt_cfg, schema, rng, day0 + DAY, rows=60)
+    _ingest_realtime_window(cluster, rt_cfg, schema, rng, day0 + 2 * DAY, rows=20)
+
+    before = cluster.query("SELECT COUNT(*), SUM(clicks) FROM events LIMIT 5")
+
+    done = cluster.run_minion_round()
+    assert done and all(t.state == COMPLETED for t in done), [t.error for t in done]
+    off_table = off_cfg.table_name_with_type
+    assert cluster.catalog.segments[off_table], "offline segments must exist"
+
+    # hybrid query must not double count (time boundary split)
+    after = cluster.query("SELECT COUNT(*), SUM(clicks) FROM events LIMIT 5")
+    assert after.rows[0] == before.rows[0]
+
+    wm = cluster.catalog.get_property(
+        f"rtToOffline/{rt_cfg.table_name_with_type}/watermark")
+    assert wm is not None and wm >= day0 + DAY
+
+
+# ---------------------------------------------------------------------------
+# Scheduled retention + lineage
+# ---------------------------------------------------------------------------
+
+def test_retention_scheduled(tmp_path):
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = event_schema()
+    cfg = TableConfig(schema.name, time_column="ts", retention_days=2)
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(29)
+    now = int(time.time() * 1000)
+    cluster.ingest_columns(cfg, make_cols(rng, 50, now - 10 * DAY))  # expired
+    cluster.ingest_columns(cfg, make_cols(rng, 50, now - DAY // 2))  # fresh
+    # the registered periodic task runs retention (deterministic tick)
+    cluster.controller.scheduler.task("RetentionManager").run_once()
+    segs = cluster.catalog.segments[cfg.table_name_with_type]
+    assert len(segs) == 1
+    assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 50
+
+
+def test_replace_segments_lineage_hides_both_sides(tmp_path):
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = event_schema()
+    cfg = TableConfig(schema.name, time_column="ts")
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(31)
+    cluster.ingest_columns(cfg, make_cols(rng, 40, 0))
+    table = cfg.table_name_with_type
+    # IN_PROGRESS lineage hides the replacement outputs from routing
+    cluster.catalog.put_property(f"lineage/{table}", [
+        {"id": "x", "from": [], "to": ["events_0"], "state": "IN_PROGRESS"}])
+    assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 0
+    # COMPLETED lineage hides the replaced inputs
+    cluster.catalog.put_property(f"lineage/{table}", [
+        {"id": "x", "from": ["events_0"], "to": [], "state": "COMPLETED"}])
+    assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 0
+    cluster.catalog.put_property(f"lineage/{table}", None)
+    assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 40
