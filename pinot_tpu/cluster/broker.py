@@ -81,9 +81,10 @@ class Broker:
         servers_queried = servers_failed = 0
         boundary = self._time_boundary(physical)
         for table in physical:
-            routing = self.routing.route_query(table, ctx)
-            futures = {}
             tf = _boundary_filter(boundary, table)
+            routing = self.routing.route_query(
+                table, ctx, extra_filter=_boundary_expr(boundary, table))
+            futures = {}
             for server_id, segments in routing.items():
                 handle = self._servers.get(server_id)
                 if handle is None:
@@ -142,9 +143,10 @@ class Broker:
                     filter=filt, group_by=[], aggregations=[], having=None,
                     order_by=[], limit=UNBOUNDED_LIMIT, offset=0, distinct=False,
                     sql=leaf_sql)
-                routing = self.routing.route_query(table, ctx)
-                futures = {}
                 tf = _boundary_filter(boundary, table)
+                routing = self.routing.route_query(
+                    table, ctx, extra_filter=_boundary_expr(boundary, table))
+                futures = {}
                 for server_id, segments in routing.items():
                     handle = self._servers.get(server_id)
                     if handle is None:
@@ -215,4 +217,17 @@ def _boundary_filter(boundary, table: str) -> Optional[str]:
         return f"{_sql_ident(col)} <= {b}"
     if table.endswith(f"_{TableType.REALTIME.value}"):
         return f"{_sql_ident(col)} > {b}"
+    return None
+
+
+def _boundary_expr(boundary, table: str):
+    """The boundary as a predicate AST, for routing's metadata pruner."""
+    if boundary is None:
+        return None
+    col, b = boundary
+    from ..sql.ast import Function, Identifier, Literal
+    if table.endswith(f"_{TableType.OFFLINE.value}"):
+        return Function("lte", (Identifier(col), Literal(b)))
+    if table.endswith(f"_{TableType.REALTIME.value}"):
+        return Function("gt", (Identifier(col), Literal(b)))
     return None

@@ -101,8 +101,12 @@ class RoutingManager:
             self._unhealthy.discard(server)
 
     # -- query routing -----------------------------------------------------
-    def route_query(self, table: str, ctx: Optional[QueryContext] = None
-                    ) -> Dict[str, List[str]]:
+    def route_query(self, table: str, ctx: Optional[QueryContext] = None,
+                    extra_filter: Optional[Expr] = None) -> Dict[str, List[str]]:
+        """`extra_filter` is an additional predicate the servers will apply (the
+        broker's hybrid time-boundary split) — fed into the metadata pruner here so
+        retained realtime segments entirely below the boundary are never dispatched
+        (reference: TimeSegmentPruner sees the boundary-augmented filter)."""
         with self._lock:
             rt = self._tables.get(table)
             unhealthy = set(self._unhealthy)
@@ -114,6 +118,13 @@ class RoutingManager:
             keep -= hidden
         if ctx is not None:
             keep = self._prune(table, keep, ctx)
+        if extra_filter is not None:
+            cfg = self.catalog.table_configs.get(table)
+            metas = self.catalog.segments.get(table, {})
+            if cfg is not None:
+                keep = {seg for seg in keep
+                        if seg not in metas
+                        or _segment_may_match(extra_filter, cfg, metas[seg])}
         return rt.route(keep, exclude=unhealthy)
 
     def _lineage_hidden(self, table: str) -> Set[str]:

@@ -65,7 +65,8 @@ class RealtimePartitionConsumer:
         stream_cfg = table_cfg.stream
         from ..cluster.completion import parse_llc_name
         self.partition = parse_llc_name(segment_name)["partition"]
-        factory = get_stream_factory(stream_cfg.stream_type, stream_cfg.topic)
+        factory = get_stream_factory(stream_cfg.stream_type, stream_cfg.topic,
+                                     stream_cfg.properties)
         self.consumer = factory.create_consumer(stream_cfg.topic, self.partition)
         self.decoder = get_decoder(stream_cfg.decoder)
         self.offset = start_offset

@@ -127,7 +127,7 @@ class MemoryStreamConsumer(PartitionGroupConsumer):
 
 
 class MemoryStreamFactory(StreamConsumerFactory):
-    def __init__(self, topic: str):
+    def __init__(self, topic: str, properties: Optional[Dict[str, Any]] = None):
         self.topic = topic
 
     def create_consumer(self, topic: str, partition: int) -> PartitionGroupConsumer:
@@ -178,5 +178,12 @@ def get_decoder(name: str) -> Callable[[Any], Dict[str, Any]]:
     return _DECODERS[name]
 
 
-def get_stream_factory(stream_type: str, topic: str) -> StreamConsumerFactory:
-    return _FACTORIES[stream_type](topic)
+def get_stream_factory(stream_type: str, topic: str,
+                       properties: Optional[Dict[str, Any]] = None
+                       ) -> StreamConsumerFactory:
+    """Instantiate a stream plugin factory; `properties` carries plugin-specific
+    connection config (reference: the stream.* keys of StreamConfig, e.g. Kafka
+    bootstrap servers). `kafkalite` (socket log broker) registers lazily."""
+    if stream_type not in _FACTORIES and stream_type == "kafkalite":
+        from . import kafkalite  # noqa: F401  (registers itself on import)
+    return _FACTORIES[stream_type](topic, properties)

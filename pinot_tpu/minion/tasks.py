@@ -49,6 +49,7 @@ class TaskSpec:
     worker: str = ""
     error: str = ""
     finished_ms: int = 0
+    claimed_ms: int = 0  # lease start; stale RUNNING tasks get requeued (gc)
 
     def to_json(self):
         return dict(self.__dict__)
@@ -83,7 +84,8 @@ class TaskQueue:
             for tid in sorted(tasks):
                 t = tasks[tid]
                 if t["state"] == GENERATED and t["task_type"] in task_types:
-                    t = dict(t, state=RUNNING, worker=worker_id)
+                    t = dict(t, state=RUNNING, worker=worker_id,
+                             claimed_ms=int(time.time() * 1000))
                     tasks[tid] = t
                     claimed.append(TaskSpec.from_json(t))
                     break
@@ -127,14 +129,20 @@ class TaskQueue:
         return last.state == ERROR and now_ms - last.finished_ms < backoff_ms
 
     def gc(self, max_age_ms: int = 3600_000, keep: int = 100,
-           now_ms: Optional[int] = None) -> int:
+           lease_ms: int = 600_000, now_ms: Optional[int] = None) -> int:
         """Drop old terminal tasks so the property (shipped in every catalog
-        snapshot) stays bounded; returns how many were removed."""
+        snapshot) stays bounded, and requeue RUNNING tasks whose lease expired —
+        a worker that died mid-task must not block generation forever. Returns how
+        many entries were removed."""
         now_ms = now_ms or int(time.time() * 1000)
         removed = []
 
         def mutate(tasks):
             tasks = dict(tasks or {})
+            for tid, t in tasks.items():
+                if (t["state"] == RUNNING
+                        and now_ms - t.get("claimed_ms", 0) > lease_ms):
+                    tasks[tid] = dict(t, state=GENERATED, worker="", claimed_ms=0)
             terminal = sorted(
                 (tid for tid, t in tasks.items()
                  if t["state"] in (COMPLETED, ERROR)),
@@ -423,18 +431,20 @@ class PurgeTaskExecutor(BaseMergeExecutor):
         out_dir = os.path.join(worker.work_dir, spec.task_id, "out")
         os.makedirs(out_dir, exist_ok=True)
         builder = SegmentBuilder(schema, self._generator_config(cfg))
-        built = []
+        old_names, new_dirs = [], []
         for seg, name in zip(segs, spec.config["segments"]):
             cols = read_columns(seg, schema)
             keep = np.array([v not in values for v in cols[column].tolist()], dtype=bool)
             if keep.all():
                 continue
+            old_names.append(name)
+            if not keep.any():
+                continue  # fully purged: drop the input with no replacement
             kept = {k: v[keep] for k, v in cols.items()}
-            built.append((name, builder.build(kept, out_dir,
-                                              f"{name}_purged_{uuid.uuid4().hex[:6]}")))
-        if built:
-            worker.controller.replace_segments(spec.table, [n for n, _ in built],
-                                               [d for _, d in built])
+            new_dirs.append(builder.build(kept, out_dir,
+                                          f"{name}_purged_{uuid.uuid4().hex[:6]}"))
+        if old_names:
+            worker.controller.replace_segments(spec.table, old_names, new_dirs)
 
 
 class MinionWorker:
