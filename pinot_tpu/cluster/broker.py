@@ -20,6 +20,7 @@ from ..query.aggregates import make_agg
 from ..query.context import QueryContext, QueryValidationError, compile_query
 from ..query.reduce import SegmentResult, merge_segment_results, reduce_to_result
 from ..query.result import ResultTable
+from ..sql.ast import to_sql
 from ..table import TableType
 from .catalog import Catalog, InstanceInfo
 from .routing import RoutingManager
@@ -42,6 +43,8 @@ class Broker:
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads,
                                         thread_name_prefix=f"{instance_id}-scatter")
         self._lock = threading.RLock()
+        from ..query.scheduler import QueryQuotaManager
+        self.quota = QueryQuotaManager(catalog)
         catalog.register_instance(InstanceInfo(instance_id, "broker"))
 
     def register_server_handle(self, server_id: str, handle: ServerHandle) -> None:
@@ -70,6 +73,11 @@ class Broker:
         physical = self._physical_tables(raw_table)
         if not physical:
             raise QueryValidationError(f"unknown table {raw_table!r}")
+        for table in physical:  # per-table QPS quota (reference: QueryQuotaManager)
+            if not self.quota.try_acquire(table):
+                from ..query.scheduler import QueryRejectedError
+                raise QueryRejectedError(
+                    f"table {raw_table!r} exceeded its query quota")
         schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
         ctx = compile_query(stmt, schema)
 
@@ -81,9 +89,9 @@ class Broker:
         servers_queried = servers_failed = 0
         boundary = self._time_boundary(physical)
         for table in physical:
-            tf = _boundary_filter(boundary, table)
-            routing = self.routing.route_query(
-                table, ctx, extra_filter=_boundary_expr(boundary, table))
+            tf_expr = _boundary_expr(boundary, table)
+            tf = to_sql(tf_expr) if tf_expr is not None else None
+            routing = self.routing.route_query(table, ctx, extra_filter=tf_expr)
             futures = {}
             for server_id, segments in routing.items():
                 handle = self._servers.get(server_id)
@@ -95,11 +103,15 @@ class Broker:
                 servers_queried += 1
                 try:
                     partials.append(fut.result())
-                except Exception:
+                except Exception as e:
                     # partial results are surfaced, not fatal (reference:
-                    # serversNotResponded -> exception in response metadata)
+                    # serversNotResponded -> exception in response metadata).
+                    # Backpressure (admission rejection / timeout) is the server
+                    # WORKING as designed — only transport/crash failures take it
+                    # out of routing.
                     servers_failed += 1
-                    self.routing.mark_server_unhealthy(server_id)
+                    if not _is_backpressure(e):
+                        self.routing.mark_server_unhealthy(server_id)
 
         merged = merge_segment_results(partials, aggs)
         if not partials:
@@ -125,6 +137,11 @@ class Broker:
 
         def scan(raw_table: str, columns, filt):
             from ..sql.ast import _sql_ident, to_sql
+            for table in self._physical_tables(raw_table):
+                if not self.quota.try_acquire(table):
+                    from ..query.scheduler import QueryRejectedError
+                    raise QueryRejectedError(
+                        f"table {raw_table!r} exceeded its query quota")
             schema = schema_for(raw_table)
             rows: List[tuple] = []
             # synthesized SQL lets remote (HTTP) server handles recompile the leaf;
@@ -143,9 +160,9 @@ class Broker:
                     filter=filt, group_by=[], aggregations=[], having=None,
                     order_by=[], limit=UNBOUNDED_LIMIT, offset=0, distinct=False,
                     sql=leaf_sql)
-                tf = _boundary_filter(boundary, table)
-                routing = self.routing.route_query(
-                    table, ctx, extra_filter=_boundary_expr(boundary, table))
+                tf_expr = _boundary_expr(boundary, table)
+                tf = to_sql(tf_expr) if tf_expr is not None else None
+                routing = self.routing.route_query(table, ctx, extra_filter=tf_expr)
                 futures = {}
                 for server_id, segments in routing.items():
                     handle = self._servers.get(server_id)
@@ -208,20 +225,9 @@ class Broker:
         return (cfg.time_column, max(ends))
 
 
-def _boundary_filter(boundary, table: str) -> Optional[str]:
-    if boundary is None:
-        return None
-    col, b = boundary
-    from ..sql.ast import _sql_ident
-    if table.endswith(f"_{TableType.OFFLINE.value}"):
-        return f"{_sql_ident(col)} <= {b}"
-    if table.endswith(f"_{TableType.REALTIME.value}"):
-        return f"{_sql_ident(col)} > {b}"
-    return None
-
-
 def _boundary_expr(boundary, table: str):
-    """The boundary as a predicate AST, for routing's metadata pruner."""
+    """The boundary as a predicate AST — the single source of truth: routing prunes
+    with the AST, servers get `to_sql(expr)` of the same node."""
     if boundary is None:
         return None
     col, b = boundary
@@ -231,3 +237,11 @@ def _boundary_expr(boundary, table: str):
     if table.endswith(f"_{TableType.REALTIME.value}"):
         return Function("gt", (Identifier(col), Literal(b)))
     return None
+
+
+def _is_backpressure(e: BaseException) -> bool:
+    from ..query.scheduler import QueryRejectedError, QueryTimeoutError
+    if isinstance(e, (QueryRejectedError, QueryTimeoutError)):
+        return True
+    from .http_service import HttpError
+    return isinstance(e, HttpError) and getattr(e, "status", None) in (408, 429)

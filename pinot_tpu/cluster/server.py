@@ -68,12 +68,16 @@ class ServerNode:
     """One server instance (reference: HelixServerStarter + ServerInstance)."""
 
     def __init__(self, instance_id: str, catalog: Catalog, deepstore: DeepStoreFS,
-                 data_dir: str, tags: Optional[List[str]] = None, completion=None):
+                 data_dir: str, tags: Optional[List[str]] = None, completion=None,
+                 scheduler=None):
         self.instance_id = instance_id
         self.catalog = catalog
         self.deepstore = deepstore
         self.data_dir = data_dir
         self.executor = ServerQueryExecutor()
+        # optional admission control (reference: QueryScheduler wrapping the
+        # executor; None = direct execution, the single-tenant test default)
+        self.scheduler = scheduler
         self.tables: Dict[str, TableDataManager] = {}
         self._lock = threading.RLock()
         self._realtime_managers: Dict[str, object] = {}
@@ -202,6 +206,18 @@ class ServerNode:
             ctx = compile_query(ctx, schema)
         if time_filter:
             ctx = _apply_time_filter(ctx, time_filter, schema)
+        if self.scheduler is not None:
+            timeout_s = None
+            t_ms = ctx.options.get("timeoutMs") if ctx.options else None
+            if t_ms is not None:
+                timeout_s = float(t_ms) / 1000.0
+            return self.scheduler.submit(
+                table, lambda: self._execute_partial(table, ctx, segment_names),
+                timeout_s=timeout_s)
+        return self._execute_partial(table, ctx, segment_names)
+
+    def _execute_partial(self, table: str, ctx: QueryContext,
+                         segment_names: Optional[Sequence[str]]) -> SegmentResult:
         mgr = self._table_manager(table)
         handler = self._realtime_managers.get(table)
         upsert = getattr(handler, "upsert", None) if handler else None

@@ -244,10 +244,16 @@ class ServerService:
         self.http.stop()
 
     def _query(self, parts, params, body):
+        from ..query.scheduler import QueryRejectedError, QueryTimeoutError
         req = decode_query_request(body)
-        result = self.server.execute_partial(req["table"], req["sql"],
-                                             req["segments"],
-                                             time_filter=req.get("timeFilter"))
+        try:
+            result = self.server.execute_partial(req["table"], req["sql"],
+                                                 req["segments"],
+                                                 time_filter=req.get("timeFilter"))
+        except QueryRejectedError as e:   # backpressure, not a server fault
+            return error_response(str(e), 429)
+        except QueryTimeoutError as e:
+            return error_response(str(e), 408)
         return binary_response(encode_segment_result(result))
 
     def _segments(self, parts, params, body):

@@ -93,15 +93,26 @@ class TaskQueue:
         self.catalog.mutate_property(TASKS_KEY, mutate)
         return claimed[0] if claimed else None
 
-    def finish(self, task_id: str, error: str = "") -> None:
+    def finish(self, task_id: str, error: str = "",
+               worker_id: Optional[str] = None) -> bool:
+        """Mark a task terminal. With `worker_id`, the write is FENCED: it applies
+        only while this worker still holds the claim — a lease-expired task that was
+        requeued/re-claimed ignores the stale worker's completion."""
+        applied = []
+
         def mutate(tasks):
             tasks = dict(tasks or {})
-            if task_id in tasks:
-                tasks[task_id] = dict(tasks[task_id],
-                                      state=ERROR if error else COMPLETED,
-                                      error=error, finished_ms=int(time.time() * 1000))
+            t = tasks.get(task_id)
+            if t is not None and (worker_id is None
+                                  or (t["state"] == RUNNING
+                                      and t["worker"] == worker_id)):
+                tasks[task_id] = dict(t, state=ERROR if error else COMPLETED,
+                                      error=error,
+                                      finished_ms=int(time.time() * 1000))
+                applied.append(True)
             return tasks
         self.catalog.mutate_property(TASKS_KEY, mutate)
+        return bool(applied)
 
     def tasks(self, table: Optional[str] = None,
               task_type: Optional[str] = None) -> List[TaskSpec]:
@@ -140,8 +151,14 @@ class TaskQueue:
         def mutate(tasks):
             tasks = dict(tasks or {})
             for tid, t in tasks.items():
-                if (t["state"] == RUNNING
-                        and now_ms - t.get("claimed_ms", 0) > lease_ms):
+                if t["state"] != RUNNING:
+                    continue
+                claimed = t.get("claimed_ms", 0)
+                if not claimed:
+                    # legacy entry without a lease stamp: start its lease now
+                    # rather than treating it as infinitely stale
+                    tasks[tid] = dict(t, claimed_ms=now_ms)
+                elif now_ms - claimed > lease_ms:
                     tasks[tid] = dict(t, state=GENERATED, worker="", claimed_ms=0)
             terminal = sorted(
                 (tid for tid, t in tasks.items()
@@ -327,11 +344,20 @@ class TaskExecutor:
         raise NotImplementedError
 
 
+class StaleTaskError(Exception):
+    """The task's inputs no longer exist — another worker (after a lease expiry)
+    already completed it. Treated as success with no side effects."""
+
+
 class BaseMergeExecutor(TaskExecutor):
     """Shared download -> process -> publish pipeline for merge-shaped tasks."""
 
     def _load_inputs(self, spec: TaskSpec, worker: "MinionWorker"):
         from ..segment.reader import load_segment
+        live = worker.catalog.segments.get(spec.table, {})
+        missing = [n for n in spec.config["segments"] if n not in live]
+        if missing:
+            raise StaleTaskError(f"inputs gone (completed elsewhere?): {missing}")
         segs = []
         for name in spec.config["segments"]:
             segs.append(load_segment(worker.fetch_segment(spec.table, name)))
@@ -492,11 +518,17 @@ class MinionWorker:
             return None
         try:
             self.executors[spec.task_type].execute(spec, self)
-            self.queue.finish(spec.task_id)
+            self.queue.finish(spec.task_id, worker_id=self.instance_id)
             spec.state = COMPLETED
             self.completed += 1
+        except StaleTaskError:
+            # another worker finished it after our (or a predecessor's) lease
+            # lapsed; nothing to do and nothing failed
+            self.queue.finish(spec.task_id, worker_id=self.instance_id)
+            spec.state = COMPLETED
         except Exception as e:  # task failure must not kill the worker loop
-            self.queue.finish(spec.task_id, error=f"{type(e).__name__}: {e}")
+            self.queue.finish(spec.task_id, error=f"{type(e).__name__}: {e}",
+                              worker_id=self.instance_id)
             spec.state = ERROR
             spec.error = str(e)
             self.failed += 1

@@ -1,0 +1,219 @@
+"""Query scheduling and admission control.
+
+Analog of the reference's `QueryScheduler` hierarchy
+(`pinot-core/src/main/java/org/apache/pinot/core/query/scheduler/QueryScheduler.java:56`,
+`FCFSQueryScheduler`, `BoundedFCFSScheduler`, `TokenPriorityScheduler` with its
+`ResourceManager` semaphores) and the broker's `QueryQuotaManager` (per-table QPS
+quotas). TPU framing: a server fronts ONE chip, so admission control is what keeps a
+single runaway query from occupying the device while everything else queues — the
+scheduler bounds concurrency (device dispatch is serialized by XLA anyway; host-side
+decode/plan work does parallelize), bounds the wait queue, enforces wall-clock
+timeouts, and accounts per-table usage so one table cannot starve the rest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor, TimeoutError as FutureTimeout
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+class QueryRejectedError(Exception):
+    """Admission denied (queue full / quota exceeded / scheduler stopped).
+
+    Reference: QueryScheduler returning an error DataTable with
+    SERVER_SCHEDULER_DOWN/SERVER_OUT_OF_CAPACITY."""
+
+
+class QueryTimeoutError(Exception):
+    """Query exceeded its wall-clock budget (reference: per-query timeoutMs)."""
+
+
+@dataclass
+class SchedulerStats:
+    submitted: int = 0
+    completed: int = 0
+    rejected: int = 0
+    timed_out: int = 0
+    failed: int = 0
+    # live gauges
+    running: int = 0
+    queued: int = 0
+    per_table_running: Dict[str, int] = field(default_factory=dict)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in self.__dict__.items()}
+
+
+class QueryScheduler:
+    """Bounded-FCFS scheduler with per-table accounting.
+
+    Queries run on a fixed worker pool (`max_concurrent`); at most `max_pending`
+    more may wait; beyond that, submission is rejected immediately — backpressure
+    instead of unbounded queue growth, exactly the BoundedFCFS behavior. A
+    `per_table_share` < 1 caps how many workers a single table may hold
+    concurrently (the ResourceManager's per-query-group semaphore analog).
+    """
+
+    def __init__(self, max_concurrent: int = 4, max_pending: int = 32,
+                 default_timeout_s: float = 60.0, per_table_share: float = 1.0):
+        self.max_concurrent = max_concurrent
+        self.max_pending = max_pending
+        self.default_timeout_s = default_timeout_s
+        # share < 1 caps one table's in-flight (running+queued) queries; 1.0 means
+        # no per-table cap — admission is then bounded by max_pending alone
+        self.table_cap = (None if per_table_share >= 1.0
+                          else max(1, int(max_concurrent * per_table_share)))
+        self._pool = ThreadPoolExecutor(max_workers=max_concurrent,
+                                        thread_name_prefix="query-sched")
+        self._lock = threading.Condition()
+        self.stats = SchedulerStats()
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+    def submit(self, table: str, fn: Callable[[], Any],
+               timeout_s: Optional[float] = None) -> Any:
+        """Run fn under admission control; blocks the caller until done.
+
+        Raises QueryRejectedError when the server is out of capacity and
+        QueryTimeoutError when fn exceeds its budget (the worker is abandoned to
+        finish in the background — same as the reference reaping the response
+        future; the slot frees when it completes)."""
+        timeout_s = timeout_s if timeout_s is not None else self.default_timeout_s
+        with self._lock:
+            if self._stopped:
+                self.stats.rejected += 1
+                raise QueryRejectedError("scheduler is shut down")
+            if self.stats.queued >= self.max_pending:
+                self.stats.rejected += 1
+                raise QueryRejectedError(
+                    f"server out of capacity: {self.stats.queued} queries pending")
+            if self.table_cap is not None \
+                    and self.stats.per_table_running.get(table, 0) >= self.table_cap:
+                self.stats.rejected += 1
+                raise QueryRejectedError(
+                    f"table {table!r} is at its concurrency share ({self.table_cap})")
+            self.stats.submitted += 1
+            self.stats.queued += 1
+            self.stats.per_table_running[table] = \
+                self.stats.per_table_running.get(table, 0) + 1
+
+        def release_table_slot():
+            n = self.stats.per_table_running.get(table, 1) - 1
+            if n <= 0:
+                self.stats.per_table_running.pop(table, None)
+            else:
+                self.stats.per_table_running[table] = n
+
+        def run():
+            with self._lock:
+                self.stats.queued -= 1
+                self.stats.running += 1
+            try:
+                return fn()
+            finally:
+                # the table slot frees when the work ACTUALLY finishes — a timed-out
+                # caller abandons the worker, but the table stays at its cap until
+                # the abandoned query completes (else the cap could be exceeded)
+                with self._lock:
+                    self.stats.running -= 1
+                    release_table_slot()
+
+        try:
+            fut: Future = self._pool.submit(run)
+        except RuntimeError:
+            with self._lock:
+                self.stats.rejected += 1
+                self.stats.queued -= 1
+                release_table_slot()
+            raise QueryRejectedError("scheduler is shut down") from None
+        try:
+            result = fut.result(timeout=timeout_s)
+            with self._lock:
+                self.stats.completed += 1
+            return result
+        except FutureTimeout:
+            with self._lock:
+                self.stats.timed_out += 1
+            raise QueryTimeoutError(f"query exceeded {timeout_s}s") from None
+        except Exception:
+            with self._lock:
+                self.stats.failed += 1
+            raise
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+        self._pool.shutdown(wait=False)
+
+
+class TokenBucket:
+    """Classic token bucket (reference: HitCounter-based QPS tracking in
+    QueryQuotaManager; a bucket gives the same steady rate + burst semantics)."""
+
+    def __init__(self, rate_per_s: float, burst: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate_per_s)
+        self.capacity = float(burst if burst is not None else max(1.0, rate_per_s))
+        self._tokens = self.capacity
+        self._last = clock()
+        self._clock = clock
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.capacity, self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class QueryQuotaManager:
+    """Broker-side per-table QPS quota (reference:
+    `pinot-broker/.../queryquota/HelixExternalViewBasedQueryQuotaManager.java`).
+
+    Quotas come from `TableConfig.quota.max_qps`; a table without a quota is
+    unlimited. The per-broker rate is the table quota divided by the live broker
+    count, like the reference splits quota across brokers."""
+
+    def __init__(self, catalog, broker_count_fn: Optional[Callable[[], int]] = None):
+        self.catalog = catalog
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self._broker_count_fn = broker_count_fn or (lambda: max(1, sum(
+            1 for i in catalog.instances.values()
+            if i.role == "broker" and i.alive)))
+        catalog.subscribe(self._on_event)
+
+    def _on_event(self, event: str, key: str) -> None:
+        if event == "table":
+            with self._lock:
+                self._buckets.pop(key, None)  # config changed: rebuild lazily
+        elif event == "instance":
+            # broker membership changed: the per-broker share of every quota
+            # changes, so drop all buckets and rebuild at the new split
+            with self._lock:
+                self._buckets.clear()
+
+    def _bucket(self, table: str) -> Optional[TokenBucket]:
+        with self._lock:
+            if table in self._buckets:
+                return self._buckets[table]
+        cfg = self.catalog.table_configs.get(table)
+        max_qps = getattr(getattr(cfg, "quota", None), "max_qps", None) if cfg else None
+        bucket = None
+        if max_qps:
+            bucket = TokenBucket(float(max_qps) / self._broker_count_fn())
+        with self._lock:
+            self._buckets[table] = bucket
+        return bucket
+
+    def try_acquire(self, table: str) -> bool:
+        bucket = self._bucket(table)
+        return bucket.try_acquire() if bucket is not None else True

@@ -111,6 +111,20 @@ class UpsertConfig:
 
 
 @dataclass
+class QuotaConfig:
+    """Reference: spi/config/table/QuotaConfig (maxQueriesPerSecond + storage)."""
+    max_qps: Optional[float] = None
+    storage_bytes: Optional[int] = None
+
+    def to_json(self):
+        return {"maxQueriesPerSecond": self.max_qps, "storageBytes": self.storage_bytes}
+
+    @staticmethod
+    def from_json(d):
+        return QuotaConfig(d.get("maxQueriesPerSecond"), d.get("storageBytes"))
+
+
+@dataclass
 class TableConfig:
     name: str                       # raw table name (no type suffix)
     table_type: TableType = TableType.OFFLINE
@@ -129,6 +143,8 @@ class TableConfig:
     # minion task configs by task type (reference: TableTaskConfig, e.g.
     # {"MergeRollupTask": {"bucketMs": 86400000}, "RealtimeToOfflineSegmentsTask": {}})
     task_configs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    # per-table query quota (reference: QuotaConfig)
+    quota: Optional[QuotaConfig] = None
 
     @property
     def table_name_with_type(self) -> str:
@@ -153,6 +169,8 @@ class TableConfig:
             d["streamConfig"] = self.stream.to_json()
         if self.upsert:
             d["upsertConfig"] = self.upsert.to_json()
+        if self.quota:
+            d["quota"] = self.quota.to_json()
         return d
 
     @staticmethod
@@ -172,6 +190,7 @@ class TableConfig:
             is_dim_table=d.get("isDimTable", False),
             tenant=d.get("tenant", "DefaultTenant"),
             task_configs=d.get("taskConfigs", {}),
+            quota=QuotaConfig.from_json(d["quota"]) if d.get("quota") else None,
         )
 
     def to_json_str(self) -> str:

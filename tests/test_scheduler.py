@@ -1,0 +1,156 @@
+"""Query scheduler / admission control / quota tests.
+
+Reference scenarios: QuerySchedulerTest (FCFS + bounded capacity),
+QueryQuotaManager tests (per-table QPS).
+"""
+
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.query.scheduler import (QueryQuotaManager, QueryRejectedError,
+                                       QueryScheduler, QueryTimeoutError, TokenBucket)
+
+
+class TestQueryScheduler:
+    def test_runs_and_accounts(self):
+        s = QueryScheduler(max_concurrent=2)
+        assert s.submit("t", lambda: 41 + 1) == 42
+        snap = s.stats.snapshot()
+        assert snap["submitted"] == snap["completed"] == 1
+        assert snap["rejected"] == 0 and snap["running"] == 0
+        s.stop()
+
+    def test_bounded_queue_rejects(self):
+        s = QueryScheduler(max_concurrent=1, max_pending=1)
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow():
+            started.set()
+            release.wait(5)
+            return "slow"
+
+        results = []
+        t1 = threading.Thread(target=lambda: results.append(s.submit("t", slow)))
+        t1.start()
+        started.wait(2)
+        # occupy the single pending slot
+        t2 = threading.Thread(target=lambda: results.append(
+            s.submit("t", lambda: "queued")))
+        t2.start()
+        for _ in range(100):
+            if s.stats.queued >= 1:
+                break
+            time.sleep(0.01)
+        with pytest.raises(QueryRejectedError):
+            s.submit("t", lambda: "overflow")
+        release.set()
+        t1.join(5)
+        t2.join(5)
+        assert sorted(results) == ["queued", "slow"]
+        assert s.stats.rejected == 1
+        s.stop()
+
+    def test_timeout(self):
+        s = QueryScheduler(max_concurrent=1, default_timeout_s=0.05)
+        with pytest.raises(QueryTimeoutError):
+            s.submit("t", lambda: time.sleep(1))
+        assert s.stats.timed_out == 1
+        s.stop()
+
+    def test_per_table_share(self):
+        s = QueryScheduler(max_concurrent=4, per_table_share=0.25)  # cap 1 per table
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow():
+            started.set()
+            release.wait(5)
+
+        th = threading.Thread(target=lambda: s.submit("hot", slow))
+        th.start()
+        started.wait(2)
+        with pytest.raises(QueryRejectedError):
+            s.submit("hot", lambda: None)  # table at its share
+        assert s.submit("cold", lambda: "ok") == "ok"  # other tables unaffected
+        release.set()
+        th.join(5)
+        s.stop()
+
+    def test_stopped_scheduler_rejects(self):
+        s = QueryScheduler()
+        s.stop()
+        with pytest.raises(QueryRejectedError):
+            s.submit("t", lambda: 1)
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        now = [0.0]
+        b = TokenBucket(rate_per_s=2, burst=2, clock=lambda: now[0])
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()       # burst exhausted
+        now[0] += 0.5                     # refills 1 token
+        assert b.try_acquire()
+        assert not b.try_acquire()
+
+
+def test_broker_quota_rejects(tmp_path):
+    import numpy as np
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import QuotaConfig, TableConfig
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = Schema("q", [dimension("d", DataType.STRING), metric("m", DataType.LONG)])
+    cfg = TableConfig("q", quota=QuotaConfig(max_qps=2))  # burst 2 per broker
+    cluster.create_table(schema, cfg)
+    cluster.ingest_columns(cfg, {"d": ["a", "b"], "m": np.array([1, 2])})
+    assert cluster.query("SELECT COUNT(*) FROM q LIMIT 1").rows[0][0] == 2
+    assert cluster.query("SELECT COUNT(*) FROM q LIMIT 1").rows[0][0] == 2
+    with pytest.raises(QueryRejectedError):
+        cluster.query("SELECT COUNT(*) FROM q LIMIT 1")  # third within the burst
+
+
+def test_server_scheduler_wired(tmp_path):
+    import numpy as np
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.table import TableConfig
+    import os
+
+    catalog = Catalog()
+    deepstore = LocalDeepStore(os.path.join(str(tmp_path), "ds"))
+    controller = Controller("c0", catalog, deepstore, os.path.join(str(tmp_path), "c"))
+    sched = QueryScheduler(max_concurrent=2)
+    server = ServerNode("s0", catalog, deepstore, os.path.join(str(tmp_path), "s"),
+                        scheduler=sched)
+    schema = Schema("t", [dimension("d", DataType.STRING), metric("m", DataType.LONG)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("t"))
+    seg_dir = SegmentBuilder(schema).build(
+        {"d": ["x", "y", "x"], "m": np.array([1, 2, 3])}, str(tmp_path / "b"), "t_0")
+    controller.upload_segment("t_OFFLINE", seg_dir)
+    res = server.execute_partial("t_OFFLINE", "SELECT COUNT(*) FROM t LIMIT 1", None)
+    assert res.scalar[0] == 3
+    assert sched.stats.completed == 1
+    # OPTION(timeoutMs=...) flows into the scheduler budget
+    with pytest.raises(QueryTimeoutError):
+        slow_sched = QueryScheduler(max_concurrent=1)
+        server.scheduler = slow_sched
+        import pinot_tpu.cluster.server as srv_mod
+        orig = server._execute_partial
+        server._execute_partial = lambda *a, **k: (time.sleep(1), orig(*a, **k))[1]
+        try:
+            server.execute_partial("t_OFFLINE",
+                                   "SELECT COUNT(*) FROM t LIMIT 1 OPTION(timeoutMs=50)",
+                                   None)
+        finally:
+            server._execute_partial = orig
+    sched.stop()
