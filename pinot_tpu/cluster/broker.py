@@ -73,11 +73,11 @@ class Broker:
         physical = self._physical_tables(raw_table)
         if not physical:
             raise QueryValidationError(f"unknown table {raw_table!r}")
-        for table in physical:  # per-table QPS quota (reference: QueryQuotaManager)
-            if not self.quota.try_acquire(table):
-                from ..query.scheduler import QueryRejectedError
-                raise QueryRejectedError(
-                    f"table {raw_table!r} exceeded its query quota")
+        # per-table QPS quota, all-or-refund across hybrid halves (reference:
+        # QueryQuotaManager)
+        if not self.quota.try_acquire_all(physical):
+            from ..query.scheduler import QueryRejectedError
+            raise QueryRejectedError(f"table {raw_table!r} exceeded its query quota")
         schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
         ctx = compile_query(stmt, schema)
 
@@ -137,11 +137,10 @@ class Broker:
 
         def scan(raw_table: str, columns, filt):
             from ..sql.ast import _sql_ident, to_sql
-            for table in self._physical_tables(raw_table):
-                if not self.quota.try_acquire(table):
-                    from ..query.scheduler import QueryRejectedError
-                    raise QueryRejectedError(
-                        f"table {raw_table!r} exceeded its query quota")
+            if not self.quota.try_acquire_all(self._physical_tables(raw_table)):
+                from ..query.scheduler import QueryRejectedError
+                raise QueryRejectedError(
+                    f"table {raw_table!r} exceeded its query quota")
             schema = schema_for(raw_table)
             rows: List[tuple] = []
             # synthesized SQL lets remote (HTTP) server handles recompile the leaf;

@@ -200,6 +200,24 @@ class Controller:
             key, lambda es: [e for e in (es or []) if e["id"] != entry_id] or None)
         return new_names
 
+    def reload_table(self, table: str) -> None:
+        """Ask every server holding the table to re-run the segment preprocessor
+        against the current config (reference: the controller's
+        /segments/{table}/reload endpoint sending Helix RELOAD messages).
+
+        A uuid nonce (not a timestamp) guarantees back-to-back reloads each
+        produce a distinct property value, so remote snapshot-diff watchers never
+        coalesce two reloads into one."""
+        import uuid as _uuid
+        self.catalog.put_property(f"reload/{table}", _uuid.uuid4().hex)
+
+    def update_table(self, config: TableConfig, reload: bool = True) -> None:
+        """Replace a table's config; by default trigger a reload so index changes
+        take effect on servers."""
+        self.catalog.put_table_config(config)
+        if reload:
+            self.reload_table(config.table_name_with_type)
+
     # -- deletion / retention ---------------------------------------------------
     def delete_segment(self, table: str, segment: str) -> None:
         """Reference: SegmentDeletionManager — remove from ideal state, metadata, and

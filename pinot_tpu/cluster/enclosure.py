@@ -70,6 +70,8 @@ class QuickCluster:
             inverted_index_columns=list(idx.inverted_index_columns),
             range_index_columns=list(idx.range_index_columns),
             bloom_filter_columns=list(idx.bloom_filter_columns),
+            json_index_columns=list(idx.json_index_columns),
+            text_index_columns=list(idx.text_index_columns),
         ))
         build_dir = os.path.join(self.work_dir, "build")
         seg_dir = builder.build(columns, build_dir, name)

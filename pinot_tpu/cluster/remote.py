@@ -103,8 +103,12 @@ class RemoteCatalog(Catalog):
         with self._lock:
             old_ideal = self.ideal_state
             old_ev = self.external_view
-            old_tables = set(self.table_configs)
+            # content-sensitive: a config VALUE change (quota, indexing) must fire
+            # a 'table' event too, not just key add/remove
+            old_tables = {k: json.dumps(v.to_json(), sort_keys=True)
+                          for k, v in self.table_configs.items()}
             old_instances = {k: (v.alive, v.port) for k, v in self.instances.items()}
+            old_properties = dict(self.properties)
 
             self.schemas = {k: Schema.from_json(v)
                             for k, v in snap["schemas"].items()}
@@ -124,11 +128,16 @@ class RemoteCatalog(Catalog):
                              if old_ideal.get(t) != self.ideal_state.get(t)]
             ev_changed = [t for t in set(old_ev) | set(self.external_view)
                           if old_ev.get(t) != self.external_view.get(t)]
-            table_changed = list(old_tables ^ set(self.table_configs))
+            new_tables = {k: json.dumps(v.to_json(), sort_keys=True)
+                          for k, v in self.table_configs.items()}
+            table_changed = [k for k in set(old_tables) | set(new_tables)
+                             if old_tables.get(k) != new_tables.get(k)]
             inst_changed = [
                 k for k, v in self.instances.items()
                 if old_instances.get(k) != (v.alive, v.port)
             ] + [k for k in old_instances if k not in self.instances]
+            prop_changed = [k for k in set(old_properties) | set(self.properties)
+                            if old_properties.get(k) != self.properties.get(k)]
 
         for t in table_changed:
             self._notify("table", t)
@@ -138,6 +147,8 @@ class RemoteCatalog(Catalog):
             self._notify("external_view", t)
         for i in inst_changed:
             self._notify("instance", i)
+        for k in prop_changed:
+            self._notify("property", k)
 
 
 class RemoteCompletion:

@@ -94,6 +94,72 @@ class ServerNode:
     def _on_catalog_event(self, event: str, table: str) -> None:
         if event == "ideal_state":
             self.reconcile(table)
+        elif event == "property" and table.startswith("reload/"):
+            # controller-triggered segment reload (reference: the Helix RELOAD
+            # message driving SegmentPreProcessor on each server). Never let a
+            # reload failure propagate: it would kill the catalog watch thread.
+            try:
+                self.reload_table(table.split("/", 1)[1])
+            except Exception:
+                pass  # per-segment errors are already isolated + reported below
+
+    def reload_table(self, table: str) -> List[str]:
+        """Reconcile every loaded immutable segment's aux indexes with the CURRENT
+        table config (reference: HelixInstanceDataManager.reloadSegment ->
+        SegmentPreProcessor), swapping in fresh readers so new indexes are used.
+
+        Index REMOVALS are deferred until after the fresh reader is swapped in and
+        the old reader's refcount drains, so in-flight queries holding the old
+        reader never lazily open a deleted file (the reference likewise destroys
+        old index buffers only after segment release)."""
+        from ..segment.preprocess import preprocess_segment
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None:
+            return []
+        mgr = self._table_manager(table)
+        changes: List[str] = []
+        segments = mgr.acquire()
+        try:
+            for seg in segments:
+                if getattr(seg, "is_mutable", False) or not getattr(seg, "path", None):
+                    continue
+                deferred: List[str] = []
+                try:
+                    ch = preprocess_segment(seg.path, cfg.indexing,
+                                            defer_removals=deferred)
+                except Exception as e:  # one bad segment must not stop the rest
+                    changes.append(f"{seg.name}: ERROR {type(e).__name__}: {e}")
+                    continue
+                if ch:
+                    mgr.add_segment(seg.name, load_segment(seg.path))
+                    changes.extend(f"{seg.name}/{c}" for c in ch)
+                if deferred:
+                    self._remove_after_release(mgr, seg, deferred)
+        finally:
+            mgr.release(segments)
+        return changes
+
+    def _remove_after_release(self, mgr: TableDataManager, old_seg,
+                              paths: List[str]) -> None:
+        """Delete superseded index files once the old reader is no longer acquired
+        (bounded wait; open mmaps survive unlink on POSIX, so this is belt and
+        braces against first-touch-after-delete)."""
+        def reap():
+            import time as _t
+            deadline = _t.time() + 5.0
+            while _t.time() < deadline:
+                with mgr._lock:
+                    # our caller still holds one ref during reload_table
+                    if mgr._refcounts.get(old_seg.name, 0) <= 1:
+                        break
+                _t.sleep(0.05)
+            for p in paths:
+                try:
+                    if os.path.exists(p):
+                        os.remove(p)
+                except OSError:
+                    pass
+        threading.Thread(target=reap, daemon=True, name="reload-reap").start()
 
     def reconcile(self, table: str) -> None:
         """Converge loaded segments to the ideal state (reference: Helix transitions

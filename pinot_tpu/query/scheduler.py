@@ -136,8 +136,13 @@ class QueryScheduler:
                 self.stats.completed += 1
             return result
         except FutureTimeout:
+            cancelled = fut.cancel()  # a still-queued query never needs to run
             with self._lock:
                 self.stats.timed_out += 1
+                if cancelled:
+                    # run() will never execute: undo its accounting here
+                    self.stats.queued -= 1
+                    release_table_slot()
             raise QueryTimeoutError(f"query exceeded {timeout_s}s") from None
         except Exception:
             with self._lock:
@@ -173,6 +178,11 @@ class TokenBucket:
                 return True
             return False
 
+    def refund(self, n: float = 1.0) -> None:
+        """Return tokens taken for an admission that was then aborted."""
+        with self._lock:
+            self._tokens = min(self.capacity, self._tokens + n)
+
 
 class QueryQuotaManager:
     """Broker-side per-table QPS quota (reference:
@@ -189,6 +199,7 @@ class QueryQuotaManager:
         self._broker_count_fn = broker_count_fn or (lambda: max(1, sum(
             1 for i in catalog.instances.values()
             if i.role == "broker" and i.alive)))
+        self._last_broker_count = self._broker_count_fn()
         catalog.subscribe(self._on_event)
 
     def _on_event(self, event: str, key: str) -> None:
@@ -196,10 +207,13 @@ class QueryQuotaManager:
             with self._lock:
                 self._buckets.pop(key, None)  # config changed: rebuild lazily
         elif event == "instance":
-            # broker membership changed: the per-broker share of every quota
-            # changes, so drop all buckets and rebuild at the new split
+            # rebuild only when BROKER membership actually changed — server churn
+            # must not refill every table's burst allowance
+            count = self._broker_count_fn()
             with self._lock:
-                self._buckets.clear()
+                if count != self._last_broker_count:
+                    self._last_broker_count = count
+                    self._buckets.clear()
 
     def _bucket(self, table: str) -> Optional[TokenBucket]:
         with self._lock:
@@ -217,3 +231,21 @@ class QueryQuotaManager:
     def try_acquire(self, table: str) -> bool:
         bucket = self._bucket(table)
         return bucket.try_acquire() if bucket is not None else True
+
+    def refund(self, table: str) -> None:
+        bucket = self._bucket(table)
+        if bucket is not None:
+            bucket.refund()
+
+    def try_acquire_all(self, tables) -> bool:
+        """All-or-nothing admission over several physical tables (hybrid split):
+        a rejection refunds tokens already taken so no table's quota leaks."""
+        taken = []
+        for t in tables:
+            if self.try_acquire(t):
+                taken.append(t)
+            else:
+                for u in taken:
+                    self.refund(u)
+                return False
+        return True

@@ -1,0 +1,110 @@
+"""Segment preprocessing: reconcile an existing segment's indexes with table config.
+
+Analog of the reference's `SegmentPreProcessor` + `IndexHandler` factories
+(`pinot-segment-local/src/main/java/org/apache/pinot/segment/local/segment/index/loader/
+SegmentPreProcessor.java`, `IndexHandlerFactory.java`): when a table's indexing config
+changes, servers rebuild the segment's auxiliary indexes IN PLACE from the data already
+on disk — no re-ingestion. Forward index and dictionaries are immutable here (encoding
+changes require a rebuild, same as most of the reference's paths); inverted / range /
+bloom / json / text indexes are added or removed to match config.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from . import format as fmt
+from .reader import ImmutableSegment, load_segment
+
+
+def desired_indexes(col_meta: Dict[str, Any], name: str, indexing) -> List[str]:
+    """Index types the config wants for this column, limited to what the stored
+    encoding supports (inverted/range need dict ids; json/text need strings)."""
+    out = []
+    if col_meta["hasDictionary"]:
+        if name in indexing.inverted_index_columns:
+            out.append("inverted")
+        if name in indexing.range_index_columns:
+            out.append("range")
+    if name in indexing.bloom_filter_columns:
+        out.append("bloom")
+    if name in getattr(indexing, "json_index_columns", []):
+        out.append("json")
+    if name in getattr(indexing, "text_index_columns", []):
+        out.append("text")
+    return out
+
+
+_SUFFIX = {"inverted": fmt.INVERTED_SUFFIX, "range": fmt.RANGE_SUFFIX,
+           "bloom": fmt.BLOOM_SUFFIX, "json": fmt.JSON_SUFFIX,
+           "text": fmt.TEXT_SUFFIX}
+
+
+def preprocess_segment(seg_dir: str, indexing,
+                       defer_removals: List[str] = None) -> List[str]:
+    """Bring one on-disk segment's aux indexes in line with `indexing`
+    (an IndexingConfig or SegmentGeneratorConfig — duck-typed column lists).
+
+    Returns human-readable change descriptions ([] when already converged).
+    Metadata (`indexes` per column) is rewritten at the end. When
+    `defer_removals` is a list, superseded index files are NOT deleted here —
+    their paths are appended for the caller to delete once no old reader can
+    touch them (live-reload safety, see ServerNode.reload_table).
+    """
+    meta_path = os.path.join(seg_dir, fmt.SEGMENT_METADATA_FILE)
+    meta = fmt.read_json(meta_path)
+    changes: List[str] = []
+    seg = None  # lazy-loaded only if something must be built
+
+    for name, col_meta in meta["columns"].items():
+        have = set(col_meta.get("indexes", []))
+        want = set(desired_indexes(col_meta, name, indexing))
+        prefix = os.path.join(seg_dir, fmt.COLS_DIR, name)
+
+        for idx in sorted(have - want):
+            path = prefix + _SUFFIX[idx]
+            if defer_removals is not None:
+                defer_removals.append(path)
+            elif os.path.exists(path):
+                os.remove(path)
+            changes.append(f"{name}: removed {idx} index")
+        for idx in sorted(want - have):
+            if seg is None:
+                seg = load_segment(seg_dir)
+            _build_index(idx, seg, name, col_meta, prefix)
+            changes.append(f"{name}: added {idx} index")
+        if have != want:
+            col_meta["indexes"] = sorted(want)
+
+    if changes:
+        fmt.write_json(meta_path, meta)
+    return changes
+
+
+def _build_index(idx: str, seg: ImmutableSegment, name: str,
+                 col_meta: Dict[str, Any], prefix: str) -> None:
+    reader = seg.column(name)
+    if idx == "inverted":
+        from .indexes.inverted import create_inverted_index
+        dict_ids = np.asarray(reader.fwd).astype(np.int64)
+        create_inverted_index(prefix + fmt.INVERTED_SUFFIX, dict_ids, reader.cardinality)
+    elif idx == "range":
+        from .indexes.range import create_range_index
+        dict_ids = np.asarray(reader.fwd).astype(np.int64)
+        create_range_index(prefix + fmt.RANGE_SUFFIX, dict_ids, reader.cardinality)
+    elif idx == "bloom":
+        from .indexes.bloom import create_bloom_filter
+        values = reader.dictionary.values if reader.has_dictionary \
+            else np.asarray(reader.fwd)
+        create_bloom_filter(prefix + fmt.BLOOM_SUFFIX, values, reader.data_type)
+    elif idx == "json":
+        from .indexes.jsonidx import create_json_index
+        create_json_index(prefix + fmt.JSON_SUFFIX, list(reader.values()))
+    elif idx == "text":
+        from .indexes.text import create_text_index
+        create_text_index(prefix + fmt.TEXT_SUFFIX, list(reader.values()))
+    else:
+        raise ValueError(f"unknown index type {idx!r}")

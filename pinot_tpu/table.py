@@ -26,6 +26,8 @@ class IndexingConfig:
     range_index_columns: List[str] = field(default_factory=list)
     bloom_filter_columns: List[str] = field(default_factory=list)
     no_dictionary_columns: List[str] = field(default_factory=list)
+    json_index_columns: List[str] = field(default_factory=list)
+    text_index_columns: List[str] = field(default_factory=list)
     sorted_column: Optional[str] = None
     star_tree_configs: List[Dict[str, Any]] = field(default_factory=list)
 
@@ -35,6 +37,8 @@ class IndexingConfig:
             "rangeIndexColumns": self.range_index_columns,
             "bloomFilterColumns": self.bloom_filter_columns,
             "noDictionaryColumns": self.no_dictionary_columns,
+            "jsonIndexColumns": self.json_index_columns,
+            "textIndexColumns": self.text_index_columns,
             "sortedColumn": self.sorted_column,
             "starTreeIndexConfigs": self.star_tree_configs,
         }
@@ -46,6 +50,8 @@ class IndexingConfig:
             range_index_columns=d.get("rangeIndexColumns", []),
             bloom_filter_columns=d.get("bloomFilterColumns", []),
             no_dictionary_columns=d.get("noDictionaryColumns", []),
+            json_index_columns=d.get("jsonIndexColumns", []),
+            text_index_columns=d.get("textIndexColumns", []),
             sorted_column=d.get("sortedColumn"),
             star_tree_configs=d.get("starTreeIndexConfigs", []),
         )

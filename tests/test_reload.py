@@ -1,0 +1,98 @@
+"""Segment reload / index management tests (SegmentPreProcessor analog).
+
+Reference scenarios: SegmentPreProcessorTest (add/remove index on an existing
+segment), reload-via-controller integration tests.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.enclosure import QuickCluster
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.segment.preprocess import preprocess_segment
+from pinot_tpu.table import IndexingConfig, TableConfig
+
+
+@pytest.fixture()
+def plain_segment(tmp_path):
+    schema = Schema("ev", [dimension("country", DataType.STRING),
+                           dimension("body", DataType.STRING),
+                           metric("v", DataType.LONG)])
+    rng = np.random.default_rng(41)
+    n = 500
+    cols = {
+        "country": [["US", "DE", "JP"][i] for i in rng.integers(0, 3, n)],
+        "body": [f"quick brown fox {i % 7}" for i in range(n)],
+        "v": rng.integers(0, 100, n, dtype=np.int64),
+    }
+    seg_dir = SegmentBuilder(schema).build(cols, str(tmp_path), "ev_0")
+    return schema, seg_dir, cols
+
+
+class TestPreprocess:
+    def test_add_indexes_in_place(self, plain_segment):
+        schema, seg_dir, cols = plain_segment
+        before = load_segment(seg_dir)
+        assert before.column("country").inverted_index is None
+        changes = preprocess_segment(seg_dir, IndexingConfig(
+            inverted_index_columns=["country"],
+            range_index_columns=["v"],
+            bloom_filter_columns=["country"],
+            text_index_columns=["body"]))
+        assert any("added inverted" in c for c in changes)
+        seg = load_segment(seg_dir)
+        assert seg.column("country").inverted_index is not None
+        assert seg.column("country").bloom_filter is not None
+        assert seg.column("body").text_index is not None
+        # range index only if v is dict-encoded; raw columns skip it safely
+        if seg.column("v").has_dictionary:
+            assert seg.column("v").range_index is not None
+        # the new inverted index agrees with a scan
+        inv = seg.column("country").inverted_index
+        dict_id = seg.column("country").dictionary.index_of("US")
+        want = sum(1 for c in cols["country"] if c == "US")
+        assert len(inv.doc_ids_for(dict_id)) == want
+
+    def test_idempotent(self, plain_segment):
+        _, seg_dir, _ = plain_segment
+        cfg = IndexingConfig(inverted_index_columns=["country"])
+        assert preprocess_segment(seg_dir, cfg)
+        assert preprocess_segment(seg_dir, cfg) == []
+
+    def test_remove_indexes(self, plain_segment):
+        _, seg_dir, _ = plain_segment
+        preprocess_segment(seg_dir, IndexingConfig(inverted_index_columns=["country"]))
+        changes = preprocess_segment(seg_dir, IndexingConfig())
+        assert any("removed inverted" in c for c in changes)
+        assert load_segment(seg_dir).column("country").inverted_index is None
+
+
+def test_cluster_reload_applies_new_indexes(tmp_path):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    schema = Schema("ev", [dimension("country", DataType.STRING),
+                           metric("v", DataType.LONG)])
+    cfg = TableConfig("ev")
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(43)
+    n = 300
+    cluster.ingest_columns(cfg, {
+        "country": [["US", "DE"][i] for i in rng.integers(0, 2, n)],
+        "v": rng.integers(0, 50, n, dtype=np.int64)})
+    before = cluster.query(
+        "SELECT country, COUNT(*) FROM ev GROUP BY country ORDER BY country LIMIT 10")
+
+    # change the indexing config and trigger a cluster-wide reload
+    cfg.indexing = IndexingConfig(inverted_index_columns=["country"],
+                                  bloom_filter_columns=["country"])
+    cluster.controller.update_table(cfg)
+
+    loaded = [s for srv in cluster.servers
+              for s in srv.tables["ev_OFFLINE"].acquire()]
+    assert loaded, "servers must hold the segment"
+    for seg in loaded:
+        assert seg.column("country").inverted_index is not None
+        assert seg.column("country").bloom_filter is not None
+    after = cluster.query(
+        "SELECT country, COUNT(*) FROM ev GROUP BY country ORDER BY country LIMIT 10")
+    assert after.rows == before.rows
