@@ -59,16 +59,39 @@ class Broker:
 
         Join queries delegate to the multistage engine with a cluster-wide leaf-scan
         provider (reference: `BrokerRequestHandlerDelegate` picking
-        `MultiStageBrokerRequestHandler`)."""
+        `MultiStageBrokerRequestHandler`). Emits broker metrics (reference:
+        BrokerMeter QUERIES/...EXCEPTIONS) and, under OPTION(trace=true), a span
+        trace in `stats["traceInfo"]` (reference: Tracing.java request tracing)."""
+        from ..utils import trace as tracing
+        from ..utils.metrics import get_registry
+        reg = get_registry()
         t0 = time.perf_counter()
-        from ..sql.parser import parse_query
-        stmt = parse_query(sql)
-        if stmt.joins:
-            result = self._handle_multistage(stmt)
-            result.stats["timeUsedMs"] = round((time.perf_counter() - t0) * 1000, 3)
-            return result
-        stmt_ctx = compile_query(stmt)  # schema resolved below per physical table
+        try:
+            from ..sql.parser import parse_query
+            stmt = parse_query(sql)
+            trace_on = _truthy(stmt.options.get("trace"))
+            with tracing.request_trace(trace_on) as tr:
+                if stmt.joins:
+                    result = self._handle_multistage(stmt)
+                else:
+                    result = self._handle_single(stmt, t0)
+                if tr is not None:
+                    result.stats["traceInfo"] = tr.to_rows()
+        except Exception:
+            reg.counter("pinot_broker_query_exceptions").inc()
+            raise
+        elapsed_ms = (time.perf_counter() - t0) * 1000
+        result.stats["timeUsedMs"] = round(elapsed_ms, 3)
+        reg.counter("pinot_broker_queries").inc()
+        reg.timer("pinot_broker_query_latency_ms").update(elapsed_ms)
+        return result
+
+    def _handle_single(self, stmt, t0: float) -> ResultTable:
+        from ..utils.trace import current_trace, span
+        with span("compile"):
+            stmt_ctx = compile_query(stmt)  # schema resolved below per physical table
         raw_table = stmt_ctx.table
+        t_compile = time.perf_counter()
 
         physical = self._physical_tables(raw_table)
         if not physical:
@@ -77,6 +100,8 @@ class Broker:
         # QueryQuotaManager)
         if not self.quota.try_acquire_all(physical):
             from ..query.scheduler import QueryRejectedError
+            from ..utils.metrics import get_registry
+            get_registry().counter("pinot_broker_queries_throttled").inc()
             raise QueryRejectedError(f"table {raw_table!r} exceeded its query quota")
         schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
         ctx = compile_query(stmt, schema)
@@ -88,6 +113,18 @@ class Broker:
         partials: List[SegmentResult] = []
         servers_queried = servers_failed = 0
         boundary = self._time_boundary(physical)
+        tr = current_trace()
+
+        def _traced(handle, server_id):
+            # scatter-pool threads share the request's trace (activate is per-thread)
+            if tr is None:
+                return handle
+
+            def call(*args):
+                with tr.activate(), span(f"server:{server_id}"):
+                    return handle(*args)
+            return call
+
         for table in physical:
             tf_expr = _boundary_expr(boundary, table)
             tf = to_sql(tf_expr) if tf_expr is not None else None
@@ -97,7 +134,8 @@ class Broker:
                 handle = self._servers.get(server_id)
                 if handle is None:
                     continue
-                futures[self._pool.submit(handle, table, ctx, segments, tf)] = server_id
+                futures[self._pool.submit(_traced(handle, server_id), table, ctx,
+                                          segments, tf)] = server_id
             for fut in as_completed(futures):
                 server_id = futures[fut]
                 servers_queried += 1
@@ -113,16 +151,25 @@ class Broker:
                     if not _is_backpressure(e):
                         self.routing.mark_server_unhealthy(server_id)
 
-        merged = merge_segment_results(partials, aggs)
-        if not partials:
-            merged.kind = ("groups" if group_exprs else
-                           "scalar" if aggs else "selection")
-        result = reduce_to_result(ctx, merged, aggs, group_exprs)
+        t_scatter = time.perf_counter()
+        with span("reduce"):
+            merged = merge_segment_results(partials, aggs)
+            if not partials:
+                merged.kind = ("groups" if group_exprs else
+                               "scalar" if aggs else "selection")
+            result = reduce_to_result(ctx, merged, aggs, group_exprs)
+        t_reduce = time.perf_counter()
         result.stats.update({
-            "timeUsedMs": round((time.perf_counter() - t0) * 1000, 3),
             "numServersQueried": servers_queried,
             "numServersResponded": servers_queried - servers_failed,
             "partialResult": servers_failed > 0,
+            # per-phase wall times (reference: BrokerQueryPhase REQUEST_COMPILATION /
+            # QUERY_ROUTING+SCATTER / REDUCE)
+            "phaseTimesMs": {
+                "compile": round((t_compile - t0) * 1000, 3),
+                "scatter": round((t_scatter - t_compile) * 1000, 3),
+                "reduce": round((t_reduce - t_scatter) * 1000, 3),
+            },
         })
         return result
 
@@ -236,6 +283,10 @@ def _boundary_expr(boundary, table: str):
     if table.endswith(f"_{TableType.REALTIME.value}"):
         return Function("gt", (Identifier(col), Literal(b)))
     return None
+
+
+def _truthy(v) -> bool:
+    return str(v).lower() in ("true", "1") if v is not None else False
 
 
 def _is_backpressure(e: BaseException) -> bool:

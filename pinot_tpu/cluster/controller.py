@@ -113,6 +113,9 @@ class Controller:
         self._fill_time_range(cfg, seg_meta_json, meta)
         self.catalog.put_segment_meta(meta)
         self._assign_segment(table, cfg, meta)
+        from ..utils.metrics import get_registry
+        get_registry().counter("pinot_controller_segments_uploaded",
+                               {"table": table}).inc()
         return meta
 
     def _partition_id(self, cfg: TableConfig, segment_dir: str, seg_meta) -> Optional[int]:

@@ -196,14 +196,24 @@ class RemoteServerHandle:
 
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
+        from ..utils.trace import current_trace
         sql = ctx if isinstance(ctx, str) else ctx.sql
         if not sql:
             raise ValueError("remote dispatch requires the query SQL text")
-        body = encode_query_request(table, sql, segment_names, time_filter)
+        tr = current_trace()
+        dispatch_ms = tr.elapsed_ms() if tr is not None else 0.0
+        body = encode_query_request(table, sql, segment_names, time_filter,
+                                    trace=tr is not None)
         resp = http_call("POST", f"{self.server_url}/query", body,
                          timeout=self.timeout_s,
                          content_type="application/octet-stream")
-        return decode_segment_result(resp)
+        result = decode_segment_result(resp)
+        spans = getattr(result, "trace_spans", None)
+        if tr is not None and spans:
+            # already prefixed server-side with its instance id; rebase the server's
+            # local clock onto this trace's axis at the dispatch point
+            tr.splice(spans, offset_ms=dispatch_ms)
+        return result
 
 
 class ControllerDeepStore(DeepStoreFS):

@@ -277,13 +277,26 @@ class ServerNode:
             t_ms = ctx.options.get("timeoutMs") if ctx.options else None
             if t_ms is not None:
                 timeout_s = float(t_ms) / 1000.0
-            return self.scheduler.submit(
-                table, lambda: self._execute_partial(table, ctx, segment_names),
-                timeout_s=timeout_s)
+            # the scheduler's worker thread must see the caller's request trace
+            from ..utils.trace import current_trace
+            tr = current_trace()
+
+            def run():
+                if tr is None:
+                    return self._execute_partial(table, ctx, segment_names)
+                with tr.activate():
+                    return self._execute_partial(table, ctx, segment_names)
+            return self.scheduler.submit(table, run, timeout_s=timeout_s)
         return self._execute_partial(table, ctx, segment_names)
 
     def _execute_partial(self, table: str, ctx: QueryContext,
                          segment_names: Optional[Sequence[str]]) -> SegmentResult:
+        import time as _t
+
+        from ..utils.metrics import get_registry
+        from ..utils.trace import span
+        reg = get_registry()
+        t0 = _t.perf_counter()
         mgr = self._table_manager(table)
         handler = self._realtime_managers.get(table)
         upsert = getattr(handler, "upsert", None) if handler else None
@@ -291,15 +304,25 @@ class ServerNode:
         try:
             results = []
             for seg in segments:
-                valid = upsert.valid_mask(seg.name, seg.num_docs) if upsert else None
-                results.append(self.executor.execute_segment(ctx, seg, valid))
+                with span(f"segment:{seg.name}"):
+                    valid = upsert.valid_mask(seg.name, seg.num_docs) if upsert else None
+                    results.append(self.executor.execute_segment(ctx, seg, valid))
             # include in-progress realtime docs when a consuming manager exists
             if handler is not None:
-                results.extend(handler.consuming_results(ctx, segment_names))
+                with span("consuming"):
+                    results.extend(handler.consuming_results(ctx, segment_names))
         finally:
             mgr.release(segments)
         aggs = [make_agg(f) for f in ctx.aggregations]
-        return merge_segment_results(results, aggs)
+        with span("merge"):
+            merged = merge_segment_results(results, aggs)
+        # ServerMeter QUERIES / NUM_DOCS_SCANNED / NUM_SEGMENTS_QUERIED analogs
+        reg.counter("pinot_server_queries", {"table": table}).inc()
+        reg.counter("pinot_server_docs_scanned").inc(merged.num_docs_scanned)
+        reg.counter("pinot_server_segments_queried").inc(len(segments))
+        reg.timer("pinot_server_query_latency_ms").update(
+            (_t.perf_counter() - t0) * 1000)
+        return merged
 
     def segments_served(self, table: str) -> List[str]:
         return self._table_manager(table).segment_names

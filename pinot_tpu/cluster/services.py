@@ -34,6 +34,13 @@ from .server import ServerNode
 from .wire import decode_query_request, encode_segment_result
 
 
+def _metrics_route(parts, params, body):
+    """GET /metrics — Prometheus text exposition of the process registry
+    (reference: the JMX->Prometheus exporter over the yammer metrics registry)."""
+    from ..utils.metrics import get_registry
+    return 200, "text/plain; version=0.0.4", get_registry().render_prometheus().encode()
+
+
 def _untar_body(body: bytes, name: str, dest: str) -> str:
     """Write an uploaded segment tar to disk and unpack it; returns the segment dir."""
     tar_path = os.path.join(dest, f"{name}.tar.gz")
@@ -69,6 +76,7 @@ class ControllerService:
         s.route("GET", "deepstore", self._deepstore_get)
         s.route("POST", "deepstore", self._deepstore_post)
         s.route("GET", "tableStatus", self._table_status)
+        s.route("GET", "metrics", _metrics_route)
         self.http.start()
 
     @property
@@ -227,6 +235,7 @@ class ServerService:
         self.http.route("GET", "health", lambda p, q, b: json_response(
             {"status": "OK", "instance": server.instance_id}))
         self.http.route("GET", "segments", self._segments)
+        self.http.route("GET", "metrics", _metrics_route)
         self.http.start()
         # advertise the query endpoint so brokers can find us (reference: Helix
         # instance config carries host/port)
@@ -245,16 +254,24 @@ class ServerService:
 
     def _query(self, parts, params, body):
         from ..query.scheduler import QueryRejectedError, QueryTimeoutError
+        from ..utils.trace import request_trace
         req = decode_query_request(body)
         try:
-            result = self.server.execute_partial(req["table"], req["sql"],
-                                                 req["segments"],
-                                                 time_filter=req.get("timeFilter"))
+            with request_trace(bool(req.get("trace"))) as tr:
+                result = self.server.execute_partial(
+                    req["table"], req["sql"], req["segments"],
+                    time_filter=req.get("timeFilter"))
         except QueryRejectedError as e:   # backpressure, not a server fault
             return error_response(str(e), 429)
         except QueryTimeoutError as e:
             return error_response(str(e), 408)
-        return binary_response(encode_segment_result(result))
+        spans = None
+        if tr is not None:
+            # prefix with this server's id so the broker's spliced view reads like
+            # its own scatter spans (server:<id>/segment:...)
+            spans = [dict(s, name=f"server:{self.server.instance_id}/{s['name']}")
+                     for s in tr.to_rows()]
+        return binary_response(encode_segment_result(result, trace_spans=spans))
 
     def _segments(self, parts, params, body):
         return json_response({"segments": self.server.segments_served(parts[0])})
@@ -270,6 +287,7 @@ class BrokerService:
         self.http.route("POST", "query", self._query)
         self.http.route("GET", "health",
                         lambda p, q, b: json_response({"status": "OK"}))
+        self.http.route("GET", "metrics", _metrics_route)
         # subscribe BEFORE the initial scan: a server registering in between then
         # fires an event we handle (re-scan), instead of being silently missed
         broker.catalog.subscribe(self._on_event)

@@ -205,8 +205,11 @@ def decode_value(data: bytes) -> Any:
 
 # -- message framing ---------------------------------------------------------
 
-def encode_segment_result(r: SegmentResult) -> bytes:
-    """SegmentResult -> bytes (reference: DataTable serialize on the server)."""
+def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
+    """SegmentResult -> bytes (reference: DataTable serialize on the server).
+
+    `trace_spans` optionally carries the server's request-trace span rows back to
+    the broker (reference: DataTable metadata TRACE_INFO key)."""
     return encode_value({
         "kind": r.kind,
         "numDocs": r.num_docs_scanned,
@@ -214,6 +217,7 @@ def encode_segment_result(r: SegmentResult) -> bytes:
         "scalar": r.scalar,
         "rows": r.rows,
         "sortKeys": r.sort_keys,
+        "trace": trace_spans,
     })
 
 
@@ -225,16 +229,19 @@ def decode_segment_result(data: bytes) -> SegmentResult:
     r.scalar = d["scalar"]
     r.rows = [tuple(row) if not isinstance(row, tuple) else row for row in d["rows"]]
     r.sort_keys = [tuple(k) if not isinstance(k, tuple) else k for k in d["sortKeys"]]
+    if d.get("trace"):
+        r.trace_spans = d["trace"]  # spliced into the broker's trace by the caller
     return r
 
 
 def encode_query_request(table: str, sql: str, segments,
-                         time_filter: str = None) -> bytes:
+                         time_filter: str = None, trace: bool = False) -> bytes:
     """Broker -> server query dispatch (reference: thrift InstanceRequest with the
     compiled query + searchSegments list, `InstanceRequestHandler.java:96`;
-    `timeFilter` carries the hybrid time-boundary predicate)."""
+    `timeFilter` carries the hybrid time-boundary predicate, `trace` the request's
+    trace-enabled flag — CommonConstants.Request.TRACE)."""
     return json.dumps({"table": table, "sql": sql, "segments": list(segments),
-                       "timeFilter": time_filter}).encode()
+                       "timeFilter": time_filter, "trace": trace}).encode()
 
 
 def decode_query_request(data: bytes) -> Dict[str, Any]:

@@ -92,6 +92,10 @@ class RealtimePartitionConsumer:
             if row is not None and self._index_row(row, msg.offset):
                 indexed += 1
         self.offset = batch.next_offset
+        if indexed:  # ServerMeter REALTIME_ROWS_CONSUMED analog
+            from ..utils.metrics import get_registry
+            get_registry().counter("pinot_server_realtime_rows_consumed",
+                                   {"table": self.table_cfg.name}).inc(indexed)
         return indexed
 
     def _index_row(self, row: Dict, msg_offset: int) -> bool:
@@ -175,6 +179,10 @@ class RealtimePartitionConsumer:
         resp = self.completion.segment_commit_end(self.segment_name, self.server_id,
                                                   seg_dir, self.offset)
         self.state = COMMITTED if resp == "COMMIT_SUCCESS" else ERROR
+        if self.state == COMMITTED:
+            from ..utils.metrics import get_registry
+            get_registry().counter("pinot_server_realtime_segments_committed",
+                                   {"table": self.table_cfg.name}).inc()
 
     def build_immutable(self) -> str:
         """Convert mutable -> immutable on disk (reference: RealtimeSegmentConverter)."""

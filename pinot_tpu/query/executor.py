@@ -32,17 +32,29 @@ class ServerQueryExecutor:
     # -- public API --------------------------------------------------------
     def execute(self, segments: Sequence[ImmutableSegment],
                 query: Union[str, QueryContext], schema=None) -> ResultTable:
+        import time as _t
+        t0 = _t.perf_counter()
         ctx = compile_query(query, schema or (segments[0].schema if segments else None)) \
             if isinstance(query, str) else query
         aggs = [make_agg(f) for f in ctx.aggregations]
         group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
                        else list(ctx.group_by))
+        t_compile = _t.perf_counter()
         results = [self.execute_segment(ctx, seg) for seg in segments]
+        t_scan = _t.perf_counter()
         merged = merge_segment_results(results, aggs)
         if not results:
             merged.kind = ("groups" if (group_exprs or ctx.distinct) else
                            "scalar" if aggs else "selection")
-        return reduce_to_result(ctx, merged, aggs, group_exprs)
+        result = reduce_to_result(ctx, merged, aggs, group_exprs)
+        # per-phase wall times (reference: ServerQueryPhase SCHEDULER_WAIT /
+        # QUERY_PLANNING / QUERY_PROCESSING), surfaced in the response stats
+        result.stats["phaseTimesMs"] = {
+            "compile": round((t_compile - t0) * 1000, 3),
+            "scan": round((t_scan - t_compile) * 1000, 3),
+            "reduce": round((_t.perf_counter() - t_scan) * 1000, 3),
+        }
+        return result
 
     # -- per-segment execution --------------------------------------------
     def execute_segment(self, ctx: QueryContext, segment: ImmutableSegment,
@@ -57,19 +69,22 @@ class ServerQueryExecutor:
                                            valid_docs=stp.record_mask)
                 reassemble(stp, sub)
                 return sub
-        plan = plan_segment(ctx, segment, valid_docs)
+        from ..utils.trace import span
+        with span("plan"):
+            plan = plan_segment(ctx, segment, valid_docs)
         if not self.use_device and plan.kind == "device":
             plan.kind = "host"
             plan.fallback_reason = "device disabled"
-        if plan.kind == "empty":
-            return self._empty_result(plan)
-        if plan.kind == "metadata":
-            return self._metadata_result(plan)
-        if plan.kind == "selection":
-            return self._selection(plan)
-        if plan.kind == "device":
-            return self._device_aggregate(plan)
-        return self._host_aggregate(plan)
+        with span(f"exec:{plan.kind}"):
+            if plan.kind == "empty":
+                return self._empty_result(plan)
+            if plan.kind == "metadata":
+                return self._metadata_result(plan)
+            if plan.kind == "selection":
+                return self._selection(plan)
+            if plan.kind == "device":
+                return self._device_aggregate(plan)
+            return self._host_aggregate(plan)
 
     # ------------------------------------------------------------------
     def _result_kind(self, plan: SegmentPlan) -> str:

@@ -82,18 +82,22 @@ class QueryScheduler:
         QueryTimeoutError when fn exceeds its budget (the worker is abandoned to
         finish in the background — same as the reference reaping the response
         future; the slot frees when it completes)."""
+        from ..utils.metrics import get_registry
         timeout_s = timeout_s if timeout_s is not None else self.default_timeout_s
         with self._lock:
             if self._stopped:
                 self.stats.rejected += 1
+                get_registry().counter("pinot_server_queries_rejected").inc()
                 raise QueryRejectedError("scheduler is shut down")
             if self.stats.queued >= self.max_pending:
                 self.stats.rejected += 1
+                get_registry().counter("pinot_server_queries_rejected").inc()
                 raise QueryRejectedError(
                     f"server out of capacity: {self.stats.queued} queries pending")
             if self.table_cap is not None \
                     and self.stats.per_table_running.get(table, 0) >= self.table_cap:
                 self.stats.rejected += 1
+                get_registry().counter("pinot_server_queries_rejected").inc()
                 raise QueryRejectedError(
                     f"table {table!r} is at its concurrency share ({self.table_cap})")
             self.stats.submitted += 1
@@ -129,6 +133,7 @@ class QueryScheduler:
                 self.stats.rejected += 1
                 self.stats.queued -= 1
                 release_table_slot()
+            get_registry().counter("pinot_server_queries_rejected").inc()
             raise QueryRejectedError("scheduler is shut down") from None
         try:
             result = fut.result(timeout=timeout_s)
@@ -137,6 +142,7 @@ class QueryScheduler:
             return result
         except FutureTimeout:
             cancelled = fut.cancel()  # a still-queued query never needs to run
+            get_registry().counter("pinot_server_queries_timed_out").inc()
             with self._lock:
                 self.stats.timed_out += 1
                 if cancelled:

@@ -1,0 +1,183 @@
+"""Process-wide metrics registry: counters, gauges, timers, Prometheus export.
+
+Analog of the reference's metrics stack (`pinot-common/src/main/java/org/apache/pinot/
+common/metrics/`: AbstractMetrics + ServerMeter/BrokerMeter/ControllerMeter catalogs,
+exported via the yammer/dropwizard registry). One flat registry per process; metric
+identity is (name, sorted label pairs), mirroring the reference's per-table metric
+names (`pinot.server.query.exceptions.{table}` etc. become labels here).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+LabelPairs = Tuple[Tuple[str, str], ...]
+
+
+def _key(name: str, labels: Optional[Dict[str, str]]) -> Tuple[str, LabelPairs]:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Timer:
+    """Duration accumulator: count / total / min / max (ms)."""
+
+    __slots__ = ("count", "total_ms", "min_ms", "max_ms", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, duration_ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_ms += duration_ms
+            self.min_ms = min(self.min_ms, duration_ms)
+            self.max_ms = max(self.max_ms, duration_ms)
+
+    def time(self):
+        """Context manager measuring a block."""
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                timer.update((time.perf_counter() - self._t0) * 1000)
+                return False
+
+        return _Ctx()
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._counters: Dict[Tuple[str, LabelPairs], Counter] = {}
+        self._gauges: Dict[Tuple[str, LabelPairs], Gauge] = {}
+        self._timers: Dict[Tuple[str, LabelPairs], Timer] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+        k = _key(name, labels)
+        with self._lock:
+            if k not in self._counters:
+                self._counters[k] = Counter()
+            return self._counters[k]
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+        k = _key(name, labels)
+        with self._lock:
+            if k not in self._gauges:
+                self._gauges[k] = Gauge()
+            return self._gauges[k]
+
+    def timer(self, name: str, labels: Optional[Dict[str, str]] = None) -> Timer:
+        k = _key(name, labels)
+        with self._lock:
+            if k not in self._timers:
+                self._timers[k] = Timer()
+            return self._timers[k]
+
+    # -- read side ----------------------------------------------------------
+    def counter_value(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.counter(name, labels).value
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat {rendered-name: value} map (counters + gauges + timer aggregates)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for (name, labels), c in self._counters.items():
+                out[_render_name(name, labels)] = c.value
+            for (name, labels), g in self._gauges.items():
+                out[_render_name(name, labels)] = g.value
+            for (name, labels), t in self._timers.items():
+                base = _render_name(name, labels)
+                out[f"{base}_count"] = t.count
+                out[f"{base}_total_ms"] = t.total_ms
+        return out
+
+    def render_prometheus(self) -> str:
+        """Text exposition format (the /metrics endpoint body): exactly one
+        `# TYPE` line per metric family, series grouped under it."""
+        lines: List[str] = []
+        with self._lock:
+            for kind, series in (("counter", self._counters),
+                                 ("gauge", self._gauges)):
+                last_family = None
+                for (name, labels), m in sorted(series.items()):
+                    if name != last_family:
+                        lines.append(f"# TYPE {name} {kind}")
+                        last_family = name
+                    lines.append(f"{_prom_name(name, labels)} {m.value}")
+            last_family = None
+            for (name, labels), t in sorted(self._timers.items()):
+                if name != last_family:
+                    lines.append(f"# TYPE {name} summary")
+                    last_family = name
+                lines.append(f"{_prom_name(name + '_count', labels)} {t.count}")
+                lines.append(f"{_prom_name(name + '_sum', labels)} {t.total_ms}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+def _render_name(name: str, labels: LabelPairs) -> str:
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _prom_name(name: str, labels: LabelPairs) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+# the process-wide default registry (reference: PinotMetricUtils singleton registry)
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY

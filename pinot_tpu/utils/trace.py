@@ -1,0 +1,111 @@
+"""Per-request tracing: named spans with timings, across scatter threads.
+
+Analog of the reference's trace SPI (`pinot-spi/src/main/java/org/apache/pinot/spi/
+trace/Tracing.java:32` + `DefaultRequestContext`): a request-scoped recorder that
+operators register phase timings into, surfaced in the broker response when the query
+sets OPTION(trace=true) (reference: `CommonConstants.Request.TRACE`).
+
+Design departure: the reference builds a tree of per-operator trace nodes per server
+and merges them in the broker reduce. Here a single flat span list with depth markers
+is shared by every thread working the request (the broker's scatter pool threads
+`activate` the same Trace), which keeps the recorder lock-free on the read side and
+needs no cross-process merge for the in-proc transport. Remote (HTTP) servers attach
+their span lists to the serialized partial and the broker splices them in.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_local = threading.local()
+
+
+class Trace:
+    """Request-scoped span recorder. Thread-safe appends; one instance per query."""
+
+    def __init__(self, request_id: str = ""):
+        self.request_id = request_id
+        self.spans: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def record(self, name: str, start_ms: float, duration_ms: float,
+               depth: int = 0) -> None:
+        with self._lock:
+            self.spans.append({
+                "name": name,
+                "startMs": round(start_ms, 3),
+                "durationMs": round(duration_ms, 3),
+                "depth": depth,
+            })
+
+    def splice(self, spans: List[Dict[str, Any]], prefix: str = "",
+               offset_ms: float = 0.0) -> None:
+        """Merge a remote server's span list. Its startMs values are relative to the
+        SERVER's request start; `offset_ms` (when the dispatch left this trace's
+        timeline) rebases them so the merged view sorts on one axis."""
+        with self._lock:
+            for s in spans:
+                s = dict(s)
+                if prefix:
+                    s["name"] = f"{prefix}/{s['name']}"
+                s["startMs"] = round(s.get("startMs", 0.0) + offset_ms, 3)
+                self.spans.append(s)
+
+    def elapsed_ms(self) -> float:
+        """Milliseconds since this trace's origin (for rebasing remote spans)."""
+        return (time.perf_counter() - self._t0) * 1000
+
+    def to_rows(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return sorted(self.spans, key=lambda s: s["startMs"])
+
+    @contextmanager
+    def activate(self):
+        """Make this trace current for the calling thread (scatter-pool workers)."""
+        prev = getattr(_local, "trace", None)
+        prev_depth = getattr(_local, "depth", 0)
+        _local.trace = self
+        _local.depth = 0
+        try:
+            yield self
+        finally:
+            _local.trace = prev
+            _local.depth = prev_depth
+
+
+def current_trace() -> Optional[Trace]:
+    return getattr(_local, "trace", None)
+
+
+@contextmanager
+def request_trace(enabled: bool, request_id: str = ""):
+    """Start a trace for this request on the current thread; None when disabled —
+    `span()` then degrades to a no-op so instrumented code never branches."""
+    if not enabled:
+        yield None
+        return
+    tr = Trace(request_id)
+    with tr.activate():
+        yield tr
+
+
+@contextmanager
+def span(name: str):
+    """Record a named span on the current thread's active trace (no-op if none)."""
+    tr = getattr(_local, "trace", None)
+    if tr is None:
+        yield
+        return
+    depth = getattr(_local, "depth", 0)
+    _local.depth = depth + 1
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _local.depth = depth
+        tr.record(name, (t0 - tr._t0) * 1000,
+                  (time.perf_counter() - t0) * 1000, depth)

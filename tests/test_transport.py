@@ -165,6 +165,18 @@ def test_http_cluster_query(http_cluster):
     resp = bc.query("SELECT COUNT(*) FROM trips WHERE fare > 6")
     assert resp["resultTable"]["rows"][0][0] == 4
 
+    # OPTION(trace=true): remote servers ship their span rows back on the wire and
+    # the broker splices them under a server:<id>/ prefix (DataTable TRACE_INFO)
+    resp = bc.query("SELECT COUNT(*) FROM trips OPTION(trace=true)")
+    names = [s["name"] for s in resp["traceInfo"]]
+    assert any(n.startswith("server:server_") and "/segment:" in n for n in names)
+
+    # /metrics on every role serves the Prometheus exposition of the registry
+    from pinot_tpu.cluster.http_service import http_call
+    for svc in (http_cluster["csvc"], http_cluster["bsvc"]):
+        text = http_call("GET", f"{svc.url}/metrics").decode()
+        assert "pinot_broker_queries" in text  # one process => shared registry
+
 
 def test_http_cluster_multistage_join(http_cluster):
     """JOIN through the broker with leaf scans dispatched to HTTP servers."""
