@@ -66,6 +66,11 @@ class CompletionFSM:
     final_offset: Optional[int] = None
     committer_decided_at: float = 0.0
     commit_timeout_s: float = 120.0
+    # set only when _fsm_for rebuilt this FSM after a controller restart: gates
+    # the commit-start adoption path so a fresh segment's FSM still requires a
+    # real election before any commit
+    rebuilt: bool = False
+    replica_set: frozenset = frozenset()   # adoption is limited to these servers
 
     def on_consumed(self, server: str, offset: int) -> Dict[str, object]:
         if self.state == "COMMITTED":
@@ -85,7 +90,14 @@ class CompletionFSM:
 
         if self.state in ("COMMITTER_NOTIFIED", "COMMITTING"):
             if self._committer_stale():
-                self._elect()  # re-elect on committer loss (reference: FSM timeout)
+                # re-elect on committer loss (reference: FSM commit time limit).
+                # Strike the silent committer's report first — its stale max
+                # offset must not win the re-election and wedge the FSM on a
+                # dead server; if it is merely slow it re-reports and rejoins.
+                if server != self.committer:
+                    self.offsets.pop(self.committer, None)
+                    self.reports.pop(self.committer, None)
+                self._elect()
             target = self.offsets[self.committer]
             if server == self.committer and offset >= target:
                 return {"status": COMMIT, "offset": target}
@@ -100,12 +112,34 @@ class CompletionFSM:
         self.committer_decided_at = time.time()
 
     def _committer_stale(self) -> bool:
-        return (self.state == "COMMITTER_NOTIFIED"
+        # COMMITTING times out too: a committer that crashed after commitStart
+        # (even mid deep-store upload — the upload is atomic-by-rename) must not
+        # wedge the segment forever (reference: MAX_COMMIT_TIME in the FSM)
+        return (self.state in ("COMMITTER_NOTIFIED", "COMMITTING")
                 and time.time() - self.committer_decided_at > self.commit_timeout_s)
 
+    def can_adopt(self, server: str) -> bool:
+        """Controller-failover adoption eligibility: this FSM was rebuilt from
+        catalog metadata after a restart (so the election that already happened is
+        lost) and a replica-set member is claiming the in-flight commit. Exactly
+        one server got COMMIT from the previous incarnation (reference:
+        lookupOrCreateFsm + committer takeover on failover)."""
+        return (self.rebuilt and self.state == "HOLDING" and self.committer is None
+                and server in self.replica_set)
+
+    def adopt_committer(self, server: str) -> None:
+        self.committer = server
+        self.offsets.setdefault(server, -1)
+        self.committer_decided_at = time.time()
+        self.state = "COMMITTING"
+
     def on_commit_start(self, server: str) -> str:
+        if self.can_adopt(server):
+            self.adopt_committer(server)
+            return COMMIT_CONTINUE
         if self.state not in ("COMMITTER_NOTIFIED", "COMMITTING") or server != self.committer:
             return FAILED
+        self.committer_decided_at = time.time()  # commit clock starts now
         self.state = "COMMITTING"
         return COMMIT_CONTINUE
 
@@ -153,10 +187,31 @@ class LLCSegmentManager:
         return name
 
     # -- completion protocol endpoints (reference: LLCSegmentCompletionHandlers) ----
-    def segment_consumed(self, segment: str, server: str, offset: int) -> Dict[str, object]:
+    def _fsm_for(self, segment: str,
+                 meta: Optional[SegmentMeta]) -> Optional[CompletionFSM]:
+        """Get — or, after a controller restart, rebuild — the segment's FSM.
+
+        FSMs are in-memory; a restarted controller has lost them while segment
+        metadata (the durable record, passed in by the caller) says IN_PROGRESS.
+        Rebuild an empty HOLDING FSM from the ideal-state replica set so the
+        protocol continues instead of FAILING every replica (reference:
+        SegmentCompletionManager.lookupOrCreateFsm creating the FSM on first
+        message)."""
         fsm = self.fsms.get(segment)
+        if fsm is not None:
+            return fsm
+        if meta is None or meta.status != STATUS_IN_PROGRESS:
+            return None
+        assignment = self.catalog.ideal_state.get(meta.table, {}).get(segment, {})
+        fsm = CompletionFSM(segment, num_replicas=max(len(assignment), 1),
+                            rebuilt=True, replica_set=frozenset(assignment))
+        self.fsms[segment] = fsm
+        return fsm
+
+    def segment_consumed(self, segment: str, server: str, offset: int) -> Dict[str, object]:
+        meta = self._meta(segment)
+        fsm = self._fsm_for(segment, meta)
         if fsm is None:
-            meta = self._meta(segment)
             if meta is not None and meta.status == STATUS_DONE:
                 final = int(meta.end_offset)
                 return {"status": KEEP if offset == final else DISCARD, "offset": final}
@@ -164,17 +219,22 @@ class LLCSegmentManager:
         return fsm.on_consumed(server, offset)
 
     def segment_commit_start(self, segment: str, server: str) -> str:
-        fsm = self.fsms.get(segment)
+        fsm = self._fsm_for(segment, self._meta(segment))
         return fsm.on_commit_start(server) if fsm else FAILED
 
     def segment_commit_end(self, segment: str, server: str, segment_dir: str,
                            end_offset: int) -> str:
         """Upload + metadata flip + successor creation (reference: commitSegment path in
         PinotLLCRealtimeSegmentManager: commitSegmentFile + commitSegmentMetadata)."""
-        fsm = self.fsms.get(segment)
+        meta = self._meta(segment)
+        fsm = self._fsm_for(segment, meta)
+        if fsm is not None and fsm.can_adopt(server):
+            # controller restarted between this committer's commitStart and its
+            # commitEnd (segment build can take seconds): adopt it here too, else
+            # the sole replica FAILs into terminal ERROR and the partition wedges
+            fsm.adopt_committer(server)
         if fsm is None or fsm.state != "COMMITTING" or server != fsm.committer:
             return FAILED
-        meta = self._meta(segment)
         table = meta.table
         cfg = self.catalog.table_configs[table]
 

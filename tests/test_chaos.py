@@ -1,0 +1,258 @@
+"""Completion-protocol chaos tests: committer crashes, controller restarts,
+replica divergence.
+
+Reference pattern: `SegmentCompletionIntegrationTest` (scripted FSM races) and
+ChaosMonkey scenarios — committer dies before/after commitStart, controller loses
+its in-memory FSMs mid-protocol, a laggard replica discards and downloads the
+committed copy. Every scenario ends with a differential query check: no data loss.
+"""
+
+import json
+import time
+
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.catalog import ONLINE, STATUS_DONE
+from pinot_tpu.cluster.completion import (CATCHUP, COMMIT, COMMIT_CONTINUE,
+                                          COMMIT_SUCCESS, CompletionFSM, DISCARD,
+                                          FAILED, HOLD, KEEP)
+from pinot_tpu.ingest.stream import MemoryStream
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+
+@pytest.fixture(autouse=True)
+def _reset_streams():
+    MemoryStream.reset_all()
+    yield
+    MemoryStream.reset_all()
+
+
+@pytest.fixture()
+def events_schema():
+    return Schema("events", [
+        dimension("user", DataType.STRING),
+        metric("value", DataType.DOUBLE),
+    ])
+
+
+def realtime_cluster(tmp_path, schema, replication=2, flush_rows=20,
+                     num_partitions=1):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cfg = TableConfig("events", table_type=TableType.REALTIME,
+                      replication=replication,
+                      stream=StreamConfig(stream_type="memory", topic="events_topic",
+                                          decoder="json",
+                                          flush_threshold_rows=flush_rows))
+    cluster.create_realtime_table(schema, cfg, num_partitions)
+    return cluster, cfg
+
+
+def produce(topic, partition, rows):
+    stream = MemoryStream.get(topic)
+    for row in rows:
+        stream.produce(json.dumps(row), partition=partition)
+
+
+# -- FSM-level crash scripts --------------------------------------------------
+
+def test_committer_crash_before_commit_start():
+    """The elected committer dies without ever calling commitStart; after the
+    commit timeout a surviving replica is re-elected and commits."""
+    fsm = CompletionFSM("seg", num_replicas=2, commit_timeout_s=0.05)
+    assert fsm.on_consumed("s1", 50)["status"] == HOLD
+    # s2 has the higher offset: elected, told to COMMIT... and then crashes
+    assert fsm.on_consumed("s2", 100)["status"] == COMMIT
+    time.sleep(0.1)
+    # s1 re-reports after the timeout: the silent committer's stale offset is
+    # struck so the re-election can land on a live server
+    r = fsm.on_consumed("s1", 50)
+    assert r["status"] == COMMIT and fsm.committer == "s1"
+    assert fsm.on_commit_start("s1") == COMMIT_CONTINUE
+    assert fsm.on_commit_end("s1", 50) == COMMIT_SUCCESS
+    # the resurrected old committer cannot double-commit; it discards (its 100 >
+    # the committed 50 means its local build diverges from the committed copy)
+    assert fsm.on_commit_start("s2") == FAILED
+    assert fsm.on_consumed("s2", 100)["status"] == DISCARD
+
+
+def test_committer_crash_mid_commit():
+    """Committer crashes AFTER commitStart (deep-store upload may be in flight);
+    the COMMITTING state itself times out and another replica takes over."""
+    fsm = CompletionFSM("seg", num_replicas=2, commit_timeout_s=0.05)
+    fsm.on_consumed("s1", 100)
+    r = fsm.on_consumed("s2", 100)   # tie: s2 wins (offset, name) order
+    assert fsm.committer == "s2"
+    assert fsm.on_commit_start("s2") == COMMIT_CONTINUE   # ...and s2 dies here
+    time.sleep(0.1)
+    r = fsm.on_consumed("s1", 100)
+    assert r["status"] == COMMIT and fsm.committer == "s1"
+    assert fsm.on_commit_start("s1") == COMMIT_CONTINUE
+    # the zombie's late commitEnd must not be accepted
+    assert fsm.on_commit_end("s2", 100) == FAILED
+    assert fsm.on_commit_end("s1", 100) == COMMIT_SUCCESS
+    # caught-up peer keeps its local build
+    assert fsm.on_consumed("s2", 100)["status"] == KEEP
+
+
+def test_commit_start_adoption_after_controller_restart():
+    """Controller restarts between sending COMMIT and receiving commitStart: the
+    rebuilt (HOLDING, committer-less) FSM adopts the in-flight committer —
+    but ONLY a rebuilt FSM, and only for replica-set members."""
+    fsm = CompletionFSM("seg", num_replicas=2, rebuilt=True,
+                        replica_set=frozenset({"s1", "s2"}))
+    # a server outside the replica set can never hijack the commit
+    assert fsm.on_commit_start("rogue") == FAILED
+    assert fsm.on_commit_start("s1") == COMMIT_CONTINUE
+    assert fsm.committer == "s1"
+    # a second replica racing commitStart after the failover loses
+    assert fsm.on_commit_start("s2") == FAILED
+    assert fsm.on_commit_end("s1", 80) == COMMIT_SUCCESS
+
+
+def test_no_adoption_on_fresh_fsm():
+    """A brand-new segment's FSM (not rebuilt from a restart) still requires a
+    real election: commitStart without a prior COMMIT is rejected."""
+    fsm = CompletionFSM("seg", num_replicas=2)
+    assert fsm.on_commit_start("s1") == FAILED
+    assert fsm.committer is None and fsm.state == "HOLDING"
+
+
+def test_laggard_catchup_script():
+    """CATCHUP drives a behind replica to the committer's offset before commit."""
+    fsm = CompletionFSM("seg", num_replicas=2)
+    fsm.on_consumed("s1", 90)
+    r = fsm.on_consumed("s2", 100)
+    assert fsm.committer == "s2"
+    r = fsm.on_consumed("s1", 90)
+    assert r["status"] == CATCHUP and r["offset"] == 100
+    r = fsm.on_consumed("s1", 100)   # caught up: parks until the commit lands
+    assert r["status"] == HOLD
+
+
+# -- cluster-level chaos ------------------------------------------------------
+
+def test_controller_restart_between_commit_start_and_end(tmp_path, events_schema):
+    """Controller loses its FSMs while the committer is building the segment
+    (between commitStart and commitEnd): the rebuilt FSM adopts the in-flight
+    committer at commitEnd instead of FAILing it into terminal ERROR."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=20,
+                                    replication=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "value": 1.0}
+                                for i in range(25)])
+    mgr0 = cluster.servers[0].realtime_manager(table)
+    mgr1 = cluster.servers[1].realtime_manager(table)
+    mgr = mgr0 if mgr0.consumers else mgr1   # replication=1: one server consumes
+    mgr.pump_all()
+    consumer = next(iter(mgr.consumers.values()))
+    orig_build = consumer.build_immutable
+
+    def build_during_restart():
+        cluster.controller.llc.fsms.clear()   # the restart happens mid-build
+        return orig_build()
+    consumer.build_immutable = build_during_restart
+
+    mgr.complete_all()   # single replica: elected immediately -> COMMIT -> build
+    done = [m for m in cluster.catalog.segments[table].values()
+            if m.status == STATUS_DONE]
+    assert len(done) == 1, "commitEnd after FSM loss must adopt, not FAIL"
+    assert int(done[0].end_offset) == 25
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 25
+
+def test_controller_restart_mid_consumption(tmp_path, events_schema):
+    """Losing every in-memory FSM mid-protocol (controller restart) must not
+    strand the segment: FSMs rebuild from catalog metadata and the commit
+    completes with no data loss."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=20)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "value": float(i)}
+                                for i in range(25)])
+    cluster.pump_realtime(table)          # consume; end criteria reached
+    before = cluster.query("SELECT COUNT(*) FROM events").rows[0][0]
+    assert before == 25
+
+    # "restart": the durable catalog survives, the in-memory FSMs do not
+    cluster.controller.llc.fsms.clear()
+
+    for _ in range(4):
+        cluster.pump_realtime(table)
+    metas = cluster.catalog.segments[table]
+    done = [m for m in metas.values() if m.status == STATUS_DONE]
+    assert len(done) == 1, "commit must complete after FSM loss"
+    assert int(done[0].end_offset) == 25
+    # differential: every row still answers
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 25
+
+
+def test_replica_divergence_download_from_deepstore(tmp_path, events_schema):
+    """One replica never consumes; after the other commits, the laggard serves
+    the committed copy from the deep store — both replicas answer identically."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=20,
+                                    replication=2)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "value": 1.0}
+                                for i in range(25)])
+
+    # only server_0 consumes; server_1 is wedged (paused process)
+    mgr0 = cluster.servers[0].realtime_manager(table)
+    mgr0.pump_all()
+    mgr0.complete_all()      # first consumed report -> HOLD (1/2 replicas)
+    mgr0.complete_all()      # re-report -> elected -> COMMIT -> committed
+    metas = cluster.catalog.segments[table]
+    done = [m for m in metas.values() if m.status == STATUS_DONE]
+    assert len(done) == 1
+    committed = done[0]
+
+    # ideal-state flip drove BOTH replicas ONLINE; the laggard (which had
+    # nothing) must have downloaded the committed copy from the deep store
+    ev = cluster.catalog.external_view[table]
+    assert set(ev[committed.name].values()) == {ONLINE}
+    assert committed.name in cluster.servers[1].segments_served(table)
+
+    # differential: each replica alone answers the full committed data
+    cluster.kill_server("server_0")
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 25
+    cluster.revive_server("server_0")
+    cluster.kill_server("server_1")
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 25
+
+
+def test_committer_crash_cluster_level(tmp_path, events_schema):
+    """The elected committer server is killed before it can commit; the second
+    replica takes over after the commit timeout and no rows are lost."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=20,
+                                    replication=2)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "value": 1.0}
+                                for i in range(25)])
+    mgr0 = cluster.servers[0].realtime_manager(table)
+    mgr1 = cluster.servers[1].realtime_manager(table)
+    mgr0.pump_all()
+    mgr1.pump_all()
+
+    # shrink the FSM's commit timeout so the test doesn't wait 120s
+    seg_name = next(iter(cluster.controller.llc.fsms))
+    fsm = cluster.controller.llc.fsms[seg_name]
+    fsm.commit_timeout_s = 0.05
+
+    # server_1 will win the (offset, name) tie-break; script its crash at the
+    # exact moment it would commit — it receives COMMIT and then dies
+    consumer1 = next(iter(mgr1.consumers.values()))
+    consumer1._commit = lambda: None
+    mgr0.complete_all()          # first report -> HOLD
+    mgr1.complete_all()          # elected -> COMMIT -> "crash"
+    assert fsm.committer == "server_1"
+    assert not any(m.status == STATUS_DONE
+                   for m in cluster.catalog.segments[table].values())
+    time.sleep(0.1)
+
+    # the survivor re-reports after the timeout, takes over, commits
+    mgr0.complete_all()
+    done = [m for m in cluster.catalog.segments[table].values()
+            if m.status == STATUS_DONE]
+    assert len(done) == 1
+    assert int(done[0].end_offset) == 25
+    assert fsm.committer == "server_0"
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 25
