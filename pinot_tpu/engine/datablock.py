@@ -68,14 +68,31 @@ class SegmentBlock:
         return self._valid
 
     def ids(self, col: str) -> jnp.ndarray:
-        """Padded int32 dict-id array for a dict-encoded column."""
+        """Padded int32 dict-id array for a dict-encoded column.
+
+        Multi-value columns come back as a [padded_rows, max_num_values] matrix:
+        each row's ids left-justified, the rest (and all padding rows) filled with
+        the out-of-dictionary id = cardinality, which every LUT maps to False/0.
+        Kernels reduce MV leaf masks with any(axis=-1) ("row matches if ANY value
+        matches", reference: MVScanDocIdIterator semantics)."""
         if col not in self._ids:
             reader = self.segment.column(col)
             assert reader.has_dictionary, f"{col} has no dictionary"
-            arr = np.asarray(reader.fwd).astype(np.int32)
-            padded = np.full(self.padded, reader.cardinality, dtype=np.int32)
-            padded[:self.num_docs] = arr
-            self._ids[col] = jnp.asarray(padded)
+            if getattr(reader, "is_multi_value", False):
+                w = max(reader.max_num_values, 1)
+                flat = np.asarray(reader.fwd).astype(np.int32)
+                off = np.asarray(reader.mv_offsets)
+                counts = np.diff(off)
+                mat = np.full((self.padded, w), reader.cardinality, dtype=np.int32)
+                rows = np.repeat(np.arange(self.num_docs), counts)
+                within = np.arange(len(flat)) - np.repeat(off[:-1], counts)
+                mat[rows, within] = flat
+                self._ids[col] = jnp.asarray(mat)
+            else:
+                arr = np.asarray(reader.fwd).astype(np.int32)
+                padded = np.full(self.padded, reader.cardinality, dtype=np.int32)
+                padded[:self.num_docs] = arr
+                self._ids[col] = jnp.asarray(padded)
         return self._ids[col]
 
     def raw(self, col: str) -> jnp.ndarray:

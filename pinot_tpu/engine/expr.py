@@ -290,3 +290,43 @@ def _nullif(xp, a, b):
         af = a.astype(np.float64)
         return xp.where(a == b, xp.nan, af)
     return None if a == b else a
+
+
+# -- multi-value transforms (reference: ArrayLengthTransformFunction,
+# ValueInTransformFunction — host path only; MV cells are object arrays of
+# per-row numpy arrays and the planner keeps MV expressions off the device) ----
+
+@register_function("arraylength")
+def _arraylength(xp, v):
+    arr = np.asarray(v)
+    if arr.dtype == object:
+        return np.fromiter((len(np.atleast_1d(x)) for x in arr), dtype=np.int64,
+                           count=len(arr))
+    return np.ones(len(arr), dtype=np.int64)  # SV column: one value per row
+
+
+@register_function("cardinality")
+def _cardinality(xp, v):
+    return _arraylength(xp, v)
+
+
+@register_function("valuein")
+def _valuein(xp, v, *allowed):
+    """MV -> MV: per-row intersection with the literal set, preserving row order."""
+    sel = set(allowed)
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        out[i] = np.asarray([x for x in np.atleast_1d(np.asarray(row)).tolist()
+                             if x in sel])
+    return out
+
+
+@register_function("arrayelementat")
+def _arrayelementat(xp, v, idx):
+    """1-based element access; out-of-range -> None (reference: arrayElementAt)."""
+    i = int(idx) - 1
+    out = np.empty(len(v), dtype=object)
+    for r, row in enumerate(v):
+        row = np.atleast_1d(np.asarray(row))
+        out[r] = row[i].item() if 0 <= i < len(row) else None
+    return out

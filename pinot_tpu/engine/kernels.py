@@ -62,6 +62,10 @@ class KernelSpec:
     distinct_lut_sizes: Dict[int, int] = field(default_factory=dict)  # agg idx -> lut size
     padded_rows: int = 0
     hll_params: Dict[int, int] = field(default_factory=dict)  # agg idx -> precision p
+    # LUT-leaf columns that are multi-value: their ids arrive as [rows, W] matrices
+    # and leaf masks reduce any(-1). Static (not shape-inferred): the mesh path's
+    # stacked [segments, rows] arrays are also 2-D but are NOT multi-value.
+    mv_cols: Tuple[str, ...] = ()
 
     # per-leaf runtime input routing, computed in __post_init__
     lut_index: Dict[int, int] = field(default_factory=dict)       # dense (scattered) LUTs
@@ -101,6 +105,7 @@ class KernelSpec:
             tuple(sorted(self.distinct_lut_sizes.items())),
             self.padded_rows,
             tuple(sorted(self.hll_params.items())),
+            self.mv_cols,
         )
 
 
@@ -135,23 +140,32 @@ def _make_mask_fn(spec: KernelSpec):
         leaf = leaves[i]
         if isinstance(leaf, LutLeaf):
             col_ids = ids[leaf.col]
+            # multi-value column: [P, W] id matrix; a row matches if ANY of its
+            # values does (reference: MVScanDocIdIterator), so per-value masks
+            # reduce with any(-1). The fill id (= cardinality) maps to False in
+            # every LUT and lies above every interval hi.
+            mv = leaf.col in spec.mv_cols
+
+            def _reduce(m):
+                return m.any(axis=-1) if mv else m
             if i in spec.lut_interval:
                 # id-interval membership: OR of range compares, zero gathers
                 off, n = spec.lut_interval[i]
                 if n == 0:
-                    return jnp.zeros(col_ids.shape, dtype=bool)
+                    return _reduce(jnp.zeros(col_ids.shape, dtype=bool))
                 m = (col_ids >= iscal[off]) & (col_ids <= iscal[off + 1])
                 for j in range(1, n):
                     m = m | ((col_ids >= iscal[off + 2 * j])
                              & (col_ids <= iscal[off + 2 * j + 1]))
-                return m
+                return _reduce(m)
             lut = luts[spec.lut_index[i]]
             if len(lut) <= DENSE_LUT_MATMUL_CAP:
                 # scattered-set membership as a one-hot matvec (gather-free; the
                 # one-hot fuses into the dot's tiles, it is never materialized)
                 oh = jax.nn.one_hot(col_ids.ravel(), len(lut), dtype=jnp.float32)
-                return (oh @ lut.astype(jnp.float32) > 0.5).reshape(col_ids.shape)
-            return lut[col_ids]  # huge scattered LUT: gather (slow relay path, rare)
+                return _reduce((oh @ lut.astype(jnp.float32) > 0.5)
+                               .reshape(col_ids.shape))
+            return _reduce(lut[col_ids])  # huge scattered LUT: gather (rare)
         if isinstance(leaf, DocSetLeaf):
             return docsets[spec.docset_index[i]]
         if isinstance(leaf, NullLeaf):

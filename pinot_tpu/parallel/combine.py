@@ -60,6 +60,27 @@ def _has_docset_filter(ctx: QueryContext) -> bool:
 _SHARD_KERNEL_CACHE: Dict[Tuple, object] = {}
 
 
+def _refs_multi_value(ctx: QueryContext, seg) -> bool:
+    """True when any column the query touches is multi-value."""
+    from ..sql.ast import identifiers_in
+    names = set()
+    if ctx.filter is not None:
+        names.update(identifiers_in(ctx.filter))
+    for e in ctx.group_by:
+        names.update(identifiers_in(e))
+    for f in ctx.aggregations:
+        names.update(identifiers_in(f))
+    for e, _ in ctx.select_items:
+        names.update(identifiers_in(e))
+    for name in names:
+        try:
+            if getattr(seg.column(name), "is_multi_value", False):
+                return True
+        except KeyError:
+            continue  # '*' / alias — not a physical column
+    return False
+
+
 def aligned_dictionaries(segments: Sequence[ImmutableSegment], cols: Sequence[str]) -> bool:
     """True iff every column in `cols` has identical dictionaries across segments."""
     for col in cols:
@@ -230,6 +251,11 @@ class MeshQueryExecutor:
         dictionary, and plan is None when the set must take the per-segment fallback
         (JSON/TEXT_MATCH doc-set filters, which are per-segment bitmaps)."""
         if _has_docset_filter(ctx):
+            return None, None
+        if _refs_multi_value(ctx, segments[0]):
+            # MV forward indexes are ragged (flat ids + offsets): the [S, rows]
+            # stacked mesh block can't carry them; per-segment execution still
+            # rides the single-device kernel's padded [rows, W] MV path
             return None, None
         any_mutable = any(getattr(s, "is_mutable", False) for s in segments)
         if not any_mutable:

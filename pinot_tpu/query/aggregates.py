@@ -454,8 +454,87 @@ class ModeAgg(AggFunc):
         return float(best[0]) if isinstance(best[0], (int, float)) else best[0]
 
 
+# -- multi-value aggregations (reference: CountMVAggregationFunction etc.) ----
+# `values` on the host path is an object array of per-row numpy arrays (the MV
+# cells); every *MV function flattens rows to their values first. Host-only:
+# the device kernel has no ragged-row reduction (the planner's AggContext marks
+# MV args non-dict / non-numeric, and device_ok returns False anyway).
+
+def _mv_flat(values) -> np.ndarray:
+    rows = [np.asarray(v) for v in values]
+    if not rows:
+        return np.empty(0, dtype=np.float64)
+    return np.concatenate(rows)
+
+
+class CountMVAgg(CountAgg):
+    name = "countmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return int(sum(len(v) for v in values))
+
+
+class SumMVAgg(SumAgg):
+    name = "summv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class MinMVAgg(MinAgg):
+    name = "minmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class MaxMVAgg(MaxAgg):
+    name = "maxmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class AvgMVAgg(AvgAgg):
+    name = "avgmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class DistinctCountMVAgg(DistinctCountAgg):
+    name = "distinctcountmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
 _REGISTRY = {
     "count": CountAgg,
+    "countmv": CountMVAgg,
+    "summv": SumMVAgg,
+    "minmv": MinMVAgg,
+    "maxmv": MaxMVAgg,
+    "avgmv": AvgMVAgg,
+    "distinctcountmv": DistinctCountMVAgg,
     "sum": SumAgg,
     "min": MinAgg,
     "max": MaxAgg,

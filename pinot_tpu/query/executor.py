@@ -135,7 +135,7 @@ class ServerQueryExecutor:
         block = block_for(seg)
         spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
                                   tuple(agg_specs), distinct_lut_sizes, block.padded,
-                                  hll_params)
+                                  hll_params, mv_cols=_mv_lut_cols(plan, seg))
         inputs = self._kernel_inputs(plan, spec, block)
         outs = kernels.run_kernel(spec, inputs)
 
@@ -277,12 +277,37 @@ class ServerQueryExecutor:
         key_arrays = [np.asarray(eval_expr(g, env, np))[idx] for g in plan.group_exprs]
         arg_arrays = [arg_values(a) for a in plan.aggs]
 
+        # multi-value group-by: explode each row into one group row per value
+        # (reference: MV group key generators emit one key per value combination).
+        # Detected on the EVALUATED key arrays so MV->MV transforms (VALUEIN)
+        # explode the same way bare MV identifiers do.
+        def _is_mv_keys(arr: np.ndarray) -> bool:
+            return (arr.dtype == object and len(arr)
+                    and isinstance(arr[0], np.ndarray))
+        mv_pos = [j for j, arr in enumerate(key_arrays) if _is_mv_keys(arr)]
+        if mv_pos:
+            from .context import QueryValidationError
+            if len(mv_pos) > 1:
+                raise QueryValidationError(
+                    "GROUP BY supports at most one multi-value expression")
+            j = mv_pos[0]
+            rows = key_arrays[j]
+            counts = np.fromiter((len(r) for r in rows), dtype=np.int64,
+                                 count=len(rows))
+            rep = np.repeat(np.arange(len(rows)), counts)
+            flat = (np.concatenate(list(rows)) if len(rows)
+                    else np.empty(0, dtype=object))
+            key_arrays = [flat if k == j else arr[rep]
+                          for k, arr in enumerate(key_arrays)]
+            arg_arrays = [a[rep] for a in arg_arrays]
+
         # vectorized grouping: factorize each key column, combine into one dense int
         # key, then split row indices per group — the host-side mirror of the device's
         # DictionaryBasedGroupKeyGenerator dense keys (no pandas: its arrow string
         # backend is not thread-safe for object arrays).
         value_dicts = []
-        combined = np.zeros(len(idx), dtype=np.int64)
+        n_rows = len(key_arrays[0]) if key_arrays else len(idx)  # post-explode size
+        combined = np.zeros(n_rows, dtype=np.int64)
         stride = 1
         for arr in key_arrays:
             codes, values = _factorize_keys(arr)
@@ -336,8 +361,14 @@ class ServerQueryExecutor:
         out_cols = [np.asarray(eval_expr(e, env, np)) if not _is_const(e)
                     else np.full(len(idx), eval_expr(e, env, np), dtype=object)
                     for e, _ in ctx.select_items]
-        rows = [tuple(c[i].item() if isinstance(c[i], np.generic) else c[i]
-                      for c in out_cols) for i in range(len(idx))]
+
+        def _cell(v):
+            if isinstance(v, np.generic):
+                return v.item()
+            if isinstance(v, np.ndarray):  # multi-value cell -> python list
+                return v.tolist()
+            return v
+        rows = [tuple(_cell(c[i]) for c in out_cols) for i in range(len(idx))]
         sort_keys = []
         if ctx.order_by:
             sort_cols = [np.asarray(eval_expr(o.expr, env, np)) for o in ctx.order_by]
@@ -380,7 +411,8 @@ class ServerQueryExecutor:
         from ..engine import kernels
         from ..engine.datablock import block_for
         block = block_for(seg)
-        spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded)
+        spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded,
+                                  mv_cols=_mv_lut_cols(plan, seg))
         inputs = self._kernel_inputs(plan, spec, block)
         for c in identifiers_in(order.expr):
             if c not in inputs.vals:
@@ -409,10 +441,21 @@ class ServerQueryExecutor:
             from ..engine import kernels
             from ..engine.datablock import block_for
             block = block_for(seg)
-            spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded)
+            spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded,
+                                      mv_cols=_mv_lut_cols(plan, seg))
             inputs = self._kernel_inputs(plan, spec, block)
             return kernels.compute_mask(spec, inputs)[:seg.num_docs]
         return host_filter_mask(plan, seg)
+
+
+def _mv_lut_cols(plan: SegmentPlan, seg: ImmutableSegment) -> Tuple[str, ...]:
+    """LUT-leaf columns that are multi-value in this segment (KernelSpec.mv_cols)."""
+    cols = set()
+    for leaf in plan.filter_prog.leaves:
+        if isinstance(leaf, LutLeaf) and \
+                getattr(seg.column(leaf.col), "is_multi_value", False):
+            cols.add(leaf.col)
+    return tuple(sorted(cols))
 
 
 def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
@@ -429,7 +472,27 @@ def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
     def leaf_mask(i: int) -> np.ndarray:
         leaf = prog.leaves[i]
         if isinstance(leaf, LutLeaf):
-            ids = np.asarray(seg.column(leaf.col).fwd).astype(np.int64)
+            reader = seg.column(leaf.col)
+            if getattr(reader, "is_multi_value", False):
+                # ANY-value-matches per row (MVScanDocIdIterator semantics); every
+                # row has >= 1 value (writer stores [null] for empty), so reduceat
+                # over the CSR offsets is well-defined. Mutable readers: take flat
+                # ids + offsets from ONE dict_snapshot — separate property reads
+                # could pair arrays from different growth snapshots.
+                snap = getattr(reader, "dict_snapshot", None)
+                if snap is not None:
+                    _, _, flat, off = snap()
+                else:
+                    flat = np.asarray(reader.fwd).astype(np.int64)
+                    off = np.asarray(reader.mv_offsets)
+                if not len(flat):
+                    return np.zeros(n, dtype=bool)
+                hits = leaf.lut[np.asarray(flat).astype(np.int64)].astype(np.int32)
+                m = np.add.reduceat(hits, np.asarray(off)[:-1]) > 0
+                if len(m) < n:  # snapshot older than the captured row count
+                    m = np.pad(m, (0, n - len(m)), constant_values=False)
+                return m[:n]
+            ids = np.asarray(reader.fwd).astype(np.int64)
             return leaf.lut[ids]
         if isinstance(leaf, NullLeaf):
             nb = seg.column(leaf.col).null_bitmap

@@ -69,6 +69,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
 
     plan = SegmentPlan("host", segment, ctx, aggs, group_exprs)
     plan.valid_docs = valid_docs
+    _validate_mv_usage(ctx, aggs, segment)
 
     # -- filter compilation + constant-fold pruning ------------------------
     try:
@@ -103,6 +104,36 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
         return plan
     plan.kind = "device"
     return plan
+
+
+def _validate_mv_usage(ctx: QueryContext, aggs: List[AggFunc],
+                       segment: ImmutableSegment) -> None:
+    """Reject shapes whose semantics need the *MV function family, with a clear
+    error instead of a deep numpy crash (reference: AggregationFunctionFactory
+    rejects SV functions over MV arguments)."""
+    def is_mv(name: str) -> bool:
+        try:
+            return getattr(segment.column(name), "is_multi_value", False)
+        except KeyError:
+            return False
+
+    for agg in aggs:
+        if (isinstance(agg.arg, Identifier) and agg.arg.name != "*"
+                and is_mv(agg.arg.name)
+                and not agg.name.endswith("mv") and agg.name != "count"):
+            raise QueryValidationError(
+                f"{agg.name.upper()} over multi-value column {agg.arg.name!r}: "
+                f"use {agg.name.upper()}MV")
+    # selection ORDER BY on an MV cell compares ragged arrays — undefined. (In a
+    # group-by, ORDER BY the MV *group key* is fine: keys are scalars after the
+    # explode; ARRAYLENGTH/CARDINALITY order keys are scalars too.)
+    if not ctx.is_aggregation_query and not ctx.distinct:
+        for o in ctx.order_by:
+            if any(is_mv(c) for c in identifiers_in(o.expr)) \
+                    and not (isinstance(o.expr, Function)
+                             and o.expr.name in ("arraylength", "cardinality")):
+                raise QueryValidationError(
+                    f"ORDER BY over multi-value column in {o.expr!r} is undefined")
 
 
 def _fold_leaves(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgram:
@@ -186,7 +217,8 @@ def _metadata_answerable(agg: AggFunc, segment: ImmutableSegment) -> bool:
         return True
     if agg.name in ("min", "max", "minmaxrange") and isinstance(agg.arg, Identifier):
         reader = segment.column(agg.arg.name)
-        return reader.data_type.is_numeric and reader.min_value is not None
+        return (reader.data_type.is_numeric and reader.min_value is not None
+                and not getattr(reader, "is_multi_value", False))
     return False
 
 
@@ -201,6 +233,10 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
         reader = segment.column(e.name)
         if not reader.has_dictionary:
             return f"group-by on raw column {e.name}"
+        if getattr(reader, "is_multi_value", False):
+            # MV group-by explodes one row into one group per value — dense-key
+            # matmul can't express that; host path explodes via mv offsets
+            return f"group-by on multi-value column {e.name}"
         cols.append(e.name)
         cards.append(reader.cardinality)
     num_keys = 1
@@ -214,9 +250,11 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
     for agg in plan.aggs:
         arg = agg.arg
         arg_is_dict = isinstance(arg, Identifier) and arg.name != "*" and \
-            segment.column(arg.name).has_dictionary
+            segment.column(arg.name).has_dictionary and \
+            not getattr(segment.column(arg.name), "is_multi_value", False)
         arg_numeric = arg is None or not isinstance(arg, Identifier) or arg.name == "*" or \
-            segment.column(arg.name).data_type.is_numeric
+            (segment.column(arg.name).data_type.is_numeric
+             and not getattr(segment.column(arg.name), "is_multi_value", False))
         if not agg.device_ok(AggContext(group_by, arg_is_dict, arg_numeric)):
             return f"aggregation {agg.name} not device-supported here"
         if arg_is_dict and ("distinct" in agg.device_outputs
@@ -240,6 +278,8 @@ def _expr_device_ok(e: Expr, segment: ImmutableSegment) -> str:
     """Device-evaluable: numeric identifiers representable in 32 bits, known functions."""
     for node_name in identifiers_in(e):
         reader = segment.column(node_name)
+        if getattr(reader, "is_multi_value", False):
+            return f"multi-value column {node_name} in expression (host path)"
         if not reader.data_type.is_numeric:
             return f"non-numeric column {node_name} in expression"
         mn, mx = reader.min_value, reader.max_value

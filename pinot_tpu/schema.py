@@ -212,6 +212,20 @@ class Schema:
         return Schema.from_json(json.loads(s))
 
 
+def normalize_mv_cell(spec: FieldSpec, v: Any):
+    """(values list, is_null) for one multi-value cell — the single normalization
+    used by BOTH the batch writer and the mutable (realtime) segment so the two
+    ingestion paths store identical values. None/empty -> one default null value
+    (reference: MV default null is a one-element array); scalars wrap; every
+    element goes through the type's coerce."""
+    if v is None or (hasattr(v, "__len__") and len(v) == 0
+                     and not isinstance(v, (str, bytes))):
+        return [spec.null_value], True
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return [spec.data_type.coerce(x) for x in v], False
+    return [spec.data_type.coerce(v)], False
+
+
 def dimension(name: str, data_type: DataType = DataType.STRING, **kw) -> FieldSpec:
     return FieldSpec(name, data_type, FieldRole.DIMENSION, **kw)
 

@@ -20,12 +20,22 @@ from typing import Sequence
 import numpy as np
 
 
-def create_inverted_index(path: str, dict_ids: np.ndarray, cardinality: int) -> None:
-    order = np.argsort(dict_ids, kind="stable")  # doc ids grouped by dict id, ascending
+def create_inverted_index(path: str, dict_ids: np.ndarray, cardinality: int,
+                          doc_ids: np.ndarray = None) -> None:
+    """`doc_ids` maps each dict_ids entry to its document (multi-value columns pass
+    rows repeated per value); omitted, entry position IS the doc id (single-value)."""
+    if doc_ids is not None:
+        # dedupe (dict id, doc) pairs: a row repeating a value must post its doc
+        # once, like the reference's bitmap (set) semantics
+        pairs = np.unique(np.stack([np.asarray(dict_ids, dtype=np.int64),
+                                    np.asarray(doc_ids, dtype=np.int64)]), axis=1)
+        dict_ids, doc_ids = pairs[0], pairs[1]
+    order = np.argsort(dict_ids, kind="stable")  # entries grouped by dict id, ascending
     counts = np.bincount(dict_ids, minlength=cardinality)
     offsets = np.zeros(cardinality + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
-    np.savez(path, doc_ids=order.astype(np.int32), offsets=offsets)
+    postings = order if doc_ids is None else np.asarray(doc_ids)[order]
+    np.savez(path, doc_ids=postings.astype(np.int32), offsets=offsets)
 
 
 class InvertedIndexReader:

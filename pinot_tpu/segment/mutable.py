@@ -42,7 +42,28 @@ class MutableColumnReader:
     # -- reader surface ----------------------------------------------------
     @property
     def has_dictionary(self) -> bool:
-        return not self.data_type.is_numeric
+        # MV columns are always dict-encoded (flat ids + offsets), like on disk
+        return not self.data_type.is_numeric or self.is_multi_value
+
+    @property
+    def is_multi_value(self) -> bool:
+        return not self.spec.single_value
+
+    @property
+    def max_num_values(self) -> int:
+        if not self.is_multi_value:
+            return 1
+        n = self.store.num_docs
+        return max((len(v) for v in self.store.columns[self.name][:n]), default=0)
+
+    @property
+    def mv_offsets(self) -> Optional[np.ndarray]:
+        if not self.is_multi_value:
+            return None
+        return self._snapshot()[3]
+
+    def mv_counts(self) -> np.ndarray:
+        return np.diff(np.asarray(self.mv_offsets))
 
     @property
     def num_docs(self) -> int:
@@ -84,6 +105,12 @@ class MutableColumnReader:
     def values(self) -> np.ndarray:
         n = self.store.num_docs
         vals = self.store.columns[self.name][:n]
+        if self.is_multi_value:
+            out = np.empty(n, dtype=object)
+            dt = self.data_type.numpy_dtype
+            for i, row in enumerate(vals):
+                out[i] = np.asarray(row, dtype=dt if dt.kind != "O" else object)
+            return out
         if self.has_dictionary:
             return np.array(vals, dtype=object)
         return np.asarray(vals, dtype=self.data_type.numpy_dtype)
@@ -100,11 +127,17 @@ class MutableColumnReader:
 
     @property
     def min_value(self):
+        if self.is_multi_value:
+            d = self.dictionary
+            return d.min_value if d is not None and len(d) else None
         v = self.values()
         return None if not len(v) else (v.min() if not self.has_dictionary else min(v))
 
     @property
     def max_value(self):
+        if self.is_multi_value:
+            d = self.dictionary
+            return d.max_value if d is not None and len(d) else None
         v = self.values()
         return None if not len(v) else (v.max() if not self.has_dictionary else max(v))
 
@@ -123,9 +156,25 @@ class MutableColumnReader:
         if n == snap[0]:
             return snap
         vals = self.store.columns[self.name][:n]
-        arr = np.array(vals, dtype=object)
-        uniq, inverse = np.unique(arr, return_inverse=True)
-        snap = (n, Dictionary(list(uniq), self.data_type), inverse.astype(np.int64))
+        if self.is_multi_value:
+            # (rows, dictionary, flat ids, offsets) — CSR like the on-disk layout
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum([len(r) for r in vals], out=offsets[1:])
+            flat = [x for r in vals for x in r]
+            if self.data_type.is_numeric:
+                arr = np.asarray(flat, dtype=self.data_type.numpy_dtype)
+                uniq, inverse = np.unique(arr, return_inverse=True)
+                d = Dictionary(uniq, self.data_type)
+            else:
+                uniq, inverse = np.unique(np.array(flat, dtype=object),
+                                          return_inverse=True)
+                d = Dictionary(list(uniq), self.data_type)
+            snap = (n, d, inverse.astype(np.int64), offsets)
+        else:
+            arr = np.array(vals, dtype=object)
+            uniq, inverse = np.unique(arr, return_inverse=True)
+            snap = (n, Dictionary(list(uniq), self.data_type),
+                    inverse.astype(np.int64))
         self._snap = snap  # single store publishes the consistent triple
         return snap
 
@@ -157,7 +206,12 @@ class MutableSegment:
         n = self._num_docs
         for spec in self.schema.fields:
             v = row.get(spec.name)
-            if v is None:
+            if not spec.single_value:
+                from ..schema import normalize_mv_cell
+                v, is_null = normalize_mv_cell(spec, v)
+                if is_null:
+                    self.null_rows.setdefault(spec.name, []).append(n)
+            elif v is None:
                 self.null_rows.setdefault(spec.name, []).append(n)
                 v = spec.null_value
             else:

@@ -24,16 +24,20 @@ def desired_indexes(col_meta: Dict[str, Any], name: str, indexing) -> List[str]:
     """Index types the config wants for this column, limited to what the stored
     encoding supports (inverted/range need dict ids; json/text need strings)."""
     out = []
+    mv = col_meta.get("multiValue", False)
     if col_meta["hasDictionary"]:
         if name in indexing.inverted_index_columns:
             out.append("inverted")
-        if name in indexing.range_index_columns:
+        # MV supports inverted (per-value postings) but not range/json/text:
+        # those index builders consume one value per doc (the writer skips them
+        # for MV too, so want/have stay converged)
+        if name in indexing.range_index_columns and not mv:
             out.append("range")
     if name in indexing.bloom_filter_columns:
         out.append("bloom")
-    if name in getattr(indexing, "json_index_columns", []):
+    if name in getattr(indexing, "json_index_columns", []) and not mv:
         out.append("json")
-    if name in getattr(indexing, "text_index_columns", []):
+    if name in getattr(indexing, "text_index_columns", []) and not mv:
         out.append("text")
     return out
 
@@ -90,7 +94,12 @@ def _build_index(idx: str, seg: ImmutableSegment, name: str,
     if idx == "inverted":
         from .indexes.inverted import create_inverted_index
         dict_ids = np.asarray(reader.fwd).astype(np.int64)
-        create_inverted_index(prefix + fmt.INVERTED_SUFFIX, dict_ids, reader.cardinality)
+        doc_ids = None
+        if getattr(reader, "is_multi_value", False):
+            doc_ids = np.repeat(np.arange(reader.num_docs, dtype=np.int64),
+                                reader.mv_counts())
+        create_inverted_index(prefix + fmt.INVERTED_SUFFIX, dict_ids,
+                              reader.cardinality, doc_ids=doc_ids)
     elif idx == "range":
         from .indexes.range import create_range_index
         dict_ids = np.asarray(reader.fwd).astype(np.int64)

@@ -37,12 +37,27 @@ class ColumnReader:
         self.cardinality: int = meta["cardinality"]
         self.is_sorted: bool = meta.get("sorted", False)
         self.num_docs: int = meta["totalDocs"]
+        self.is_multi_value: bool = meta.get("multiValue", False)
+        self.max_num_values: int = meta.get("maxNumValues", 1)
 
     # -- forward index -----------------------------------------------------
     @cached_property
     def fwd(self) -> np.ndarray:
-        """Dict ids (minimal-width uint) if dict-encoded, else raw values."""
+        """Dict ids (minimal-width uint) if dict-encoded, else raw values.
+
+        Multi-value columns: the FLAT concatenated per-row value ids; row
+        boundaries come from `mv_offsets` (CSR layout, see writer._write_mv_column)."""
         return np.load(self._prefix + fmt.FWD_SUFFIX, mmap_mode="r")
+
+    @cached_property
+    def mv_offsets(self) -> Optional[np.ndarray]:
+        """int64[num_docs+1] row offsets into the flat MV forward index."""
+        if not self.is_multi_value:
+            return None
+        return np.load(self._prefix + fmt.MV_OFFSETS_SUFFIX, mmap_mode="r")
+
+    def mv_counts(self) -> np.ndarray:
+        return np.diff(np.asarray(self.mv_offsets))
 
     @cached_property
     def dictionary(self) -> Optional[Dictionary]:
@@ -56,7 +71,17 @@ class ColumnReader:
         return Dictionary(values, self.data_type)
 
     def values(self) -> np.ndarray:
-        """Fully decoded column values (host-side; used by tests/selection/reduce)."""
+        """Fully decoded column values (host-side; used by tests/selection/reduce).
+
+        Multi-value columns return an object array whose elements are per-row
+        numpy arrays (the decoded value lists)."""
+        if self.is_multi_value:
+            flat = self.dictionary.take(np.asarray(self.fwd).astype(np.int64))
+            off = np.asarray(self.mv_offsets)
+            out = np.empty(self.num_docs, dtype=object)
+            for i in range(self.num_docs):
+                out[i] = flat[off[i]:off[i + 1]]
+            return out
         if not self.has_dictionary:
             return np.asarray(self.fwd)
         return self.dictionary.take(np.asarray(self.fwd).astype(np.int64))

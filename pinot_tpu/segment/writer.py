@@ -113,6 +113,8 @@ class SegmentBuilder:
                       fixed_dict: Optional["Dictionary"] = None) -> Dict[str, Any]:
         name, data_type = spec.name, spec.data_type
         prefix = os.path.join(cols_dir, name)
+        if not spec.single_value:
+            return self._write_mv_column(prefix, spec, raw, num_docs)
 
         # -- null extraction (pass 1a) ---------------------------------
         null_mask = None
@@ -211,6 +213,67 @@ class SegmentBuilder:
             np.save(prefix + fmt.NULLS_SUFFIX, fmt.pack_bitmap(null_mask))
             meta["hasNulls"] = True
 
+        meta["indexes"] = indexes
+        return meta
+
+
+    def _write_mv_column(self, prefix: str, spec: "FieldSpec", raw,
+                         num_docs: int) -> Dict[str, Any]:
+        """Multi-value column: flat dict-id forward index + row offsets.
+
+        Layout (`format.py`): `<col>.fwd.npy` holds the CONCATENATED per-row value
+        ids, `<col>.mvoff.npy` the int64 row offsets (num_docs+1) — CSR over rows
+        (reference: MultiValueFixedByteRawIndexCreator / the MV fwd creators).
+        MV columns are ALWAYS dictionary-encoded: the device representation is a
+        row-padded id matrix (`datablock.SegmentBlock.ids`) whose fill id must be a
+        bounded out-of-dictionary sentinel. A None/empty row stores the single
+        default null value (reference: MV default null = one-element array)."""
+        from ..schema import normalize_mv_cell
+        name, data_type = spec.name, spec.data_type
+        null_mask = np.zeros(num_docs, dtype=bool)
+        rows: List[List[Any]] = []
+        for i, v in enumerate(raw):
+            vals, is_null = normalize_mv_cell(spec, v)
+            null_mask[i] = is_null
+            rows.append(vals)
+        counts = np.fromiter((len(r) for r in rows), dtype=np.int64, count=num_docs)
+        offsets = np.zeros(num_docs + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        flat: List[Any] = [x for r in rows for x in r]
+
+        dictionary, dict_ids = build_dictionary(flat, data_type)
+        card = dictionary.cardinality
+        fwd = dict_ids.astype(fmt.minimal_dtype_for_cardinality(card))
+        np.save(prefix + fmt.FWD_SUFFIX, fwd)
+        np.save(prefix + fmt.MV_OFFSETS_SUFFIX, offsets)
+        if data_type.is_numeric:
+            np.save(prefix + fmt.DICT_NUMERIC_SUFFIX, np.asarray(dictionary.values))
+        else:
+            fmt.write_string_dictionary(prefix, list(dictionary.values))
+
+        meta: Dict[str, Any] = {
+            "dataType": data_type.value, "totalDocs": num_docs,
+            "multiValue": True, "hasDictionary": True,
+            "cardinality": card, "fwdDtype": str(fwd.dtype),
+            "maxNumValues": int(counts.max()) if num_docs else 0,
+            "totalNumValues": int(offsets[-1]),
+            "sorted": False,
+            "minValue": _jsonable(dictionary.min_value, data_type),
+            "maxValue": _jsonable(dictionary.max_value, data_type),
+            "dictHash": _dict_hash(dictionary),
+        }
+        indexes: List[str] = []
+        if name in self.config.inverted_index_columns:
+            doc_ids = np.repeat(np.arange(num_docs, dtype=np.int64), counts)
+            create_inverted_index(prefix + fmt.INVERTED_SUFFIX, dict_ids, card,
+                                  doc_ids=doc_ids)
+            indexes.append("inverted")
+        if name in self.config.bloom_filter_columns:
+            create_bloom_filter(prefix + fmt.BLOOM_SUFFIX, dictionary.values, data_type)
+            indexes.append("bloom")
+        if null_mask.any():
+            np.save(prefix + fmt.NULLS_SUFFIX, fmt.pack_bitmap(null_mask))
+            meta["hasNulls"] = True
         meta["indexes"] = indexes
         return meta
 

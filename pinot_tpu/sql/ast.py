@@ -60,6 +60,8 @@ AGGREGATION_FUNCTIONS = {
     "distinctcountthetasketch", "distinctcountrawthetasketch",
     "percentile", "percentileest", "percentiletdigest",
     "sumprecision", "mode",
+    # multi-value variants (reference: CountMVAggregationFunction family)
+    "countmv", "summv", "minmv", "maxmv", "avgmv", "distinctcountmv",
 }
 
 

@@ -1,0 +1,223 @@
+"""Multi-value column tests: writer/reader CSR layout, device+host predicate
+parity, MV aggregations, MV group-by explode, transforms, mutable MV, inverted.
+
+Reference patterns: MVScanDocIdIterator semantics ("row matches if ANY value
+matches"), CountMV/SumMV/... aggregation functions, MV group key explosion.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import ServerQueryExecutor, execute_query
+from pinot_tpu.schema import DataType, FieldSpec, FieldRole, Schema, dimension, metric
+from pinot_tpu.segment.mutable import MutableSegment
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+SCHEMA = Schema("docs", [
+    dimension("doc", DataType.STRING),
+    FieldSpec("tags", DataType.STRING, FieldRole.DIMENSION, single_value=False),
+    FieldSpec("scores", DataType.INT, FieldRole.DIMENSION, single_value=False),
+    metric("weight", DataType.DOUBLE),
+])
+
+ROWS = {
+    "doc": ["a", "b", "c", "d"],
+    "tags": [["x", "y"], ["y"], ["z", "x", "w"], None],
+    "scores": [[1, 2], [2, 3], [5], [7, 8]],
+    "weight": np.array([1.0, 2.0, 3.0, 4.0]),
+}
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mv")
+    builder = SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        inverted_index_columns=["tags"]))
+    return load_segment(builder.build(dict(ROWS), str(tmp), "docs_0"))
+
+
+# -- storage roundtrip --------------------------------------------------------
+
+def test_mv_roundtrip(seg):
+    r = seg.column("tags")
+    assert r.is_multi_value
+    vals = r.values()
+    assert list(vals[0]) == ["x", "y"]
+    assert list(vals[2]) == ["z", "x", "w"]
+    assert list(vals[3]) == ["null"]   # None row -> [default null]
+    assert r.null_bitmap is not None and r.null_bitmap[3]
+    assert r.max_num_values == 3
+    scores = seg.column("scores").values()
+    assert list(scores[1]) == [2, 3]
+
+
+def test_mv_inverted_index_postings(seg):
+    inv = seg.column("tags").inverted_index
+    d = seg.column("tags").dictionary
+    docs_with_x = inv.doc_ids_for(d.index_of("x"))
+    assert sorted(docs_with_x.tolist()) == [0, 2]
+
+
+# -- predicate semantics: any value matches ----------------------------------
+
+@pytest.mark.parametrize("use_device", [True, False])
+def test_mv_filters(seg, use_device):
+    ex = ServerQueryExecutor(use_device=use_device)
+    res = ex.execute([seg], "SELECT COUNT(*) FROM docs WHERE tags = 'x'")
+    assert res.rows[0][0] == 2           # rows a and c contain 'x'
+    res = ex.execute([seg], "SELECT COUNT(*) FROM docs WHERE tags IN ('y', 'w')")
+    assert res.rows[0][0] == 3           # a, b (y) and c (w)
+    res = ex.execute([seg], "SELECT COUNT(*) FROM docs WHERE scores BETWEEN 3 AND 6")
+    assert res.rows[0][0] == 2           # b (3) and c (5)
+    res = ex.execute([seg], "SELECT COUNT(*) FROM docs WHERE NOT tags = 'y'")
+    assert res.rows[0][0] == 2           # c and d have no 'y' at all
+    res = ex.execute([seg], "SELECT SUM(weight) FROM docs WHERE tags = 'x'")
+    assert res.rows[0][0] == pytest.approx(4.0)
+
+
+def test_mv_device_host_parity(seg):
+    for sql in ["SELECT COUNT(*) FROM docs WHERE tags = 'x'",
+                "SELECT COUNT(*) FROM docs WHERE scores >= 2 AND tags IN ('y','z')",
+                "SELECT SUM(weight), COUNT(*) FROM docs WHERE scores < 3"]:
+        dev = ServerQueryExecutor(use_device=True).execute([seg], sql)
+        host = ServerQueryExecutor(use_device=False).execute([seg], sql)
+        assert dev.rows == host.rows, sql
+
+
+# -- MV aggregations ----------------------------------------------------------
+
+def test_mv_aggregations(seg):
+    res = execute_query(
+        [seg], "SELECT COUNTMV(scores), SUMMV(scores), MINMV(scores), "
+               "MAXMV(scores), AVGMV(scores), DISTINCTCOUNTMV(tags) FROM docs")
+    row = res.rows[0]
+    assert row[0] == 7                       # 2+2+1+2 values
+    assert row[1] == pytest.approx(28.0)     # 1+2+2+3+5+7+8
+    assert row[2] == 1 and row[3] == 8
+    assert row[4] == pytest.approx(28.0 / 7)
+    assert row[5] == 5                       # x y z w null
+
+
+def test_mv_agg_with_filter(seg):
+    res = execute_query(
+        [seg], "SELECT COUNTMV(tags) FROM docs WHERE weight < 2.5")
+    assert res.rows[0][0] == 3               # a: [x,y], b: [y]
+
+
+# -- MV group-by explode ------------------------------------------------------
+
+def test_mv_group_by_explodes(seg):
+    res = execute_query(
+        [seg], "SELECT tags, COUNT(*), SUM(weight) FROM docs "
+               "GROUP BY tags ORDER BY tags LIMIT 20")
+    got = {r[0]: (r[1], r[2]) for r in res.rows}
+    assert got["x"] == (2, pytest.approx(4.0))    # docs a, c
+    assert got["y"] == (2, pytest.approx(3.0))    # docs a, b
+    assert got["z"] == (1, pytest.approx(3.0))
+    assert got["w"] == (1, pytest.approx(3.0))
+    assert got["null"] == (1, pytest.approx(4.0))  # doc d's default-null row
+
+
+def test_mv_group_by_with_sv_key(seg):
+    res = execute_query(
+        [seg], "SELECT doc, tags, COUNT(*) FROM docs "
+               "WHERE doc IN ('a', 'b') GROUP BY doc, tags LIMIT 20")
+    keys = {(r[0], r[1]) for r in res.rows}
+    assert keys == {("a", "x"), ("a", "y"), ("b", "y")}
+
+
+def test_mv_distinct(seg):
+    res = execute_query([seg], "SELECT DISTINCT tags FROM docs LIMIT 20")
+    assert {r[0] for r in res.rows} == {"x", "y", "z", "w", "null"}
+
+
+# -- transforms ---------------------------------------------------------------
+
+def test_arraylength_and_selection(seg):
+    res = execute_query(
+        [seg], "SELECT doc, ARRAYLENGTH(tags) FROM docs ORDER BY doc LIMIT 10")
+    assert [r[1] for r in res.rows] == [2, 1, 3, 1]
+    # MV cells in selection results surface as python lists
+    res = execute_query([seg], "SELECT doc, tags FROM docs ORDER BY doc LIMIT 10")
+    assert res.rows[0][1] == ["x", "y"]
+
+
+def test_arraylength_filter(seg):
+    res = execute_query(
+        [seg], "SELECT COUNT(*) FROM docs WHERE ARRAYLENGTH(tags) >= 2")
+    assert res.rows[0][0] == 2
+
+
+def test_arrayelementat(seg):
+    res = execute_query(
+        [seg], "SELECT doc, ARRAYELEMENTAT(scores, 2) FROM docs ORDER BY doc LIMIT 10")
+    assert [r[1] for r in res.rows] == [2, 3, None, 8]
+
+
+def test_valuein_group_by_explodes(seg):
+    res = execute_query(
+        [seg], "SELECT VALUEIN(tags, 'x', 'y'), COUNTMV(tags) FROM docs "
+               "GROUP BY VALUEIN(tags, 'x', 'y') LIMIT 20")
+    got = {r[0]: r[1] for r in res.rows}
+    # rows with neither x nor y contribute no group (empty VALUEIN row)
+    assert got == {"x": 5, "y": 3}   # x: docs a(2)+c(3) values; y: a(2)+b(1)
+
+
+def test_sv_agg_over_mv_rejected(seg):
+    from pinot_tpu.query.context import QueryValidationError
+    with pytest.raises(QueryValidationError, match="SUMMV"):
+        execute_query([seg], "SELECT SUM(scores) FROM docs")
+    with pytest.raises(QueryValidationError, match="multi-value"):
+        execute_query([seg], "SELECT doc FROM docs ORDER BY tags LIMIT 5")
+
+
+def test_mv_inverted_dedupes_repeated_values(tmp_path):
+    builder = SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        inverted_index_columns=["tags"]))
+    seg = load_segment(builder.build(
+        {"doc": ["a"], "tags": [["x", "x", "y"]], "scores": [[1]],
+         "weight": np.array([1.0])}, str(tmp_path), "dup_0"))
+    inv = seg.column("tags").inverted_index
+    d = seg.column("tags").dictionary
+    # a row repeating a value posts its doc ONCE (reference bitmap semantics)
+    assert inv.doc_ids_for(d.index_of("x")).tolist() == [0]
+
+
+# -- mutable MV ---------------------------------------------------------------
+
+def test_mutable_mv_index_and_query():
+    seg = MutableSegment("docs__0__0__1", SCHEMA)
+    seg.index({"doc": "a", "tags": ["x", "y"], "scores": [1], "weight": 1.0})
+    seg.index({"doc": "b", "tags": ["y"], "scores": [2, 3], "weight": 2.0})
+    seg.index({"doc": "c", "tags": None, "scores": [], "weight": 3.0})
+    r = seg.column("tags")
+    assert r.is_multi_value and r.has_dictionary
+    assert list(r.values()[0]) == ["x", "y"]
+    assert list(r.values()[2]) == ["null"]
+    # empty MV row stores the type's default null (reference MV null handling)
+    assert list(seg.column("scores").values()[2]) == [DataType.INT.default_null]
+
+    ex = ServerQueryExecutor(use_device=False)
+    res = ex.execute([seg], "SELECT COUNT(*) FROM docs WHERE tags = 'y'", SCHEMA)
+    assert res.rows[0][0] == 2
+    res = ex.execute([seg], "SELECT SUMMV(scores) FROM docs WHERE weight < 2.5",
+                     SCHEMA)
+    assert res.rows[0][0] == pytest.approx(6.0)
+    res = ex.execute([seg], "SELECT tags, COUNT(*) FROM docs GROUP BY tags LIMIT 10",
+                     SCHEMA)
+    got = {r[0]: r[1] for r in res.rows}
+    assert got == {"x": 1, "y": 2, "null": 1}
+
+
+def test_mutable_mv_commit_roundtrip(tmp_path):
+    """Mutable MV rows survive conversion to an immutable segment."""
+    mseg = MutableSegment("docs__0__0__2", SCHEMA)
+    mseg.index({"doc": "a", "tags": ["p", "q"], "scores": [1, 2], "weight": 1.0})
+    mseg.index({"doc": "b", "tags": ["q"], "scores": [3], "weight": 2.0})
+    cols = mseg.snapshot_columns()
+    builder = SegmentBuilder(SCHEMA, SegmentGeneratorConfig())
+    seg = load_segment(builder.build(cols, str(tmp_path), "docs_imm"))
+    assert list(seg.column("tags").values()[0]) == ["p", "q"]
+    res = execute_query([seg], "SELECT COUNT(*) FROM docs WHERE tags = 'q'")
+    assert res.rows[0][0] == 2
