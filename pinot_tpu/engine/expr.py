@@ -330,3 +330,11 @@ def _arrayelementat(xp, v, idx):
         row = np.atleast_1d(np.asarray(row))
         out[r] = row[i].item() if 0 <= i < len(row) else None
     return out
+
+
+@register_function("__pack")
+def _pack(xp, *cols):
+    """Internal: stack k argument columns into an [n, k] matrix so multi-argument
+    aggregations (COVAR/CORR/FIRSTWITHTIME) flow through the single-argument
+    executor surface. Host-only by construction (not in planner._DEVICE_FUNCS)."""
+    return np.stack([np.asarray(c, dtype=np.float64) for c in cols], axis=1)

@@ -44,6 +44,9 @@ from .expr import eval_expr
 _INT_MIN_IDENT = np.iinfo(np.int32).max  # identity for masked-out min over int
 _INT_MAX_IDENT = np.iinfo(np.int32).min
 
+# kernel outputs that are masked sums of integer powers of the argument
+_POWER_SUMS = {"sum": 1, "sum2": 2, "sum3": 3, "sum4": 4}
+
 # Above these sizes the matmul / broadcast-reduce does more device work than the extra
 # relay round trip a scatter costs; below them it stays at the dispatch latency floor.
 MATMUL_KEY_CAP = 8192     # one-hot matmul group-by partials (count/sum), MXU-bound
@@ -256,9 +259,12 @@ def _make_body(spec: KernelSpec):
             for ai, (agg, outs) in enumerate(spec.aggs):
                 v = _agg_arg(agg, vals)
                 for o in outs:
-                    if o == "sum":
-                        sum_rows.append(v.ravel().astype(jnp.float32) * fmask)
-                        sum_names.append(f"{ai}.sum")
+                    if o in _POWER_SUMS:
+                        # sums of powers ride the same stacked matmul (variance /
+                        # skewness / kurtosis moments, VarianceAggregationFunction)
+                        row = v.ravel().astype(jnp.float32) ** _POWER_SUMS[o]
+                        sum_rows.append(row * fmask)
+                        sum_names.append(f"{ai}.{o}")
                     elif o in ("min", "max"):
                         minmax.append((f"{ai}.{o}", v.ravel(), o == "min"))
             # f32 one-hot counts are exact only below 2^24 increments; the row count
@@ -330,8 +336,9 @@ def _make_body(spec: KernelSpec):
                 for o in outs:
                     if o == "count":
                         continue
-                    if o == "sum":
-                        out[f"{ai}.sum"] = (v.ravel().astype(jnp.float32) * fmask).sum()
+                    if o in _POWER_SUMS:
+                        row = v.ravel().astype(jnp.float32) ** _POWER_SUMS[o]
+                        out[f"{ai}.{o}"] = (row * fmask).sum()
                     elif o == "min":
                         ident = _INT_MIN_IDENT if v.dtype.kind == "i" else jnp.inf
                         out[f"{ai}.min"] = jnp.where(mask, v, ident).min()

@@ -298,6 +298,8 @@ def aggregate_block(ctx: QueryContext, aggs: List[AggFunc], block: Block
             valid = np.array([x is not None for x in v], dtype=bool)
         elif v.dtype.kind == "f":
             valid = ~np.isnan(v)
+            if valid.ndim == 2:  # __pack matrix (multi-arg agg): row-valid
+                valid = valid.all(axis=1)
         else:
             valid = np.ones(n, dtype=bool)
         arg_vals.append(v)

@@ -62,6 +62,11 @@ class AggFunc:
         """Result over zero rows (no group-by), mirroring reference defaults."""
         return None
 
+    def validate_args(self, segment) -> None:
+        """Plan-time argument validation against one segment's column types;
+        raise QueryValidationError for shapes whose host path would crash deep
+        in numpy (reference: AggregationFunctionFactory type checks)."""
+
 
 class CountAgg(AggFunc):
     name = "count"
@@ -454,6 +459,481 @@ class ModeAgg(AggFunc):
         return float(best[0]) if isinstance(best[0], (int, float)) else best[0]
 
 
+# -- moment-based aggregations (reference: VarianceAggregationFunction,
+# SkewnessAggregationFunction / FourthMomentAggregationFunction) -------------
+# States are tuples of raw power sums (n, Σx, Σx², ...): exactly mergeable
+# across segments/servers and computable on device as stacked masked-sum rows
+# (kernels._POWER_SUMS) — the TPU analog of the reference's PinotFourthMoment
+# combine. Central moments are derived only at finalize.
+
+class MomentAgg(AggFunc):
+    """Base for power-sum states (n, Σx, Σx², ...): element-wise mergeable, and
+    decodable generically from the kernel's per-power outputs."""
+
+    def state_from_device(self, outs):
+        return (int(outs["count"]),) + tuple(
+            float(outs.get(o, 0.0)) for o in self.device_outputs if o != "count")
+
+    def merge(self, a, b):
+        return tuple(x + y for x, y in zip(a, b))
+
+    def validate_args(self, segment) -> None:
+        _require_numeric_arg(self, segment)
+
+
+class VarianceAgg(MomentAgg):
+    """VAR_POP / VAR_SAMP / STDDEV_POP / STDDEV_SAMP.
+
+    State is the CENTERED (n, Σx, m2=Σ(x-mean)²) tuple with the pairwise
+    Chan/Welford merge (reference: VarianceTuple.apply) — raw Σx² would cancel
+    catastrophically for large-magnitude columns (epoch seconds) even in f64.
+    The device path still ships raw f32 power sums, but only for columns the
+    planner proved small enough (`_power_sum_f32_safe`)."""
+    name = "varpop"
+    device_outputs = ("sum", "sum2", "count")
+    sample = False
+    sqrt = False
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        if len(v) == 0:
+            return (0, 0.0, 0.0)
+        mean = v.mean()
+        return (len(v), float(v.sum()), float(((v - mean) ** 2).sum()))
+
+    def state_from_device(self, outs):
+        n = int(outs["count"])
+        s1 = float(outs.get("sum", 0.0))
+        s2 = float(outs.get("sum2", 0.0))
+        m2 = max(0.0, s2 - s1 * s1 / n) if n else 0.0
+        return (n, s1, m2)
+
+    def merge(self, a, b):
+        na, sa, m2a = a
+        nb, sb, m2b = b
+        if na == 0:
+            return b
+        if nb == 0:
+            return a
+        n = na + nb
+        delta = sb / nb - sa / na
+        return (n, sa + sb, m2a + m2b + delta * delta * na * nb / n)
+
+    def finalize(self, state):
+        n, _s1, m2 = state
+        d = n - 1 if self.sample else n
+        if n == 0 or d <= 0:
+            return None
+        var = max(0.0, m2 / d)
+        return float(np.sqrt(var)) if self.sqrt else var
+
+
+class VarSampAgg(VarianceAgg):
+    name = "varsamp"
+    sample = True
+
+
+class StdDevPopAgg(VarianceAgg):
+    name = "stddevpop"
+    sqrt = True
+
+
+class StdDevSampAgg(VarianceAgg):
+    name = "stddevsamp"
+    sample = True
+    sqrt = True
+
+
+class SkewnessAgg(MomentAgg):
+    """SKEWNESS — population skewness from the first three raw moments."""
+    name = "skewness"
+    device_outputs = ("sum", "sum2", "sum3", "count")
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        return (len(v), float(v.sum()), float((v ** 2).sum()), float((v ** 3).sum()))
+
+    def finalize(self, state):
+        n, s1, s2, s3 = state
+        if n == 0:
+            return None
+        mean = s1 / n
+        m2 = s2 / n - mean * mean
+        if m2 <= 0:
+            return 0.0
+        m3 = s3 / n - 3 * mean * s2 / n + 2 * mean ** 3
+        return float(m3 / m2 ** 1.5)
+
+
+class KurtosisAgg(MomentAgg):
+    """KURTOSIS — excess kurtosis from the first four raw moments."""
+    name = "kurtosis"
+    device_outputs = ("sum", "sum2", "sum3", "sum4", "count")
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        return (len(v), float(v.sum()), float((v ** 2).sum()),
+                float((v ** 3).sum()), float((v ** 4).sum()))
+
+    def finalize(self, state):
+        n, s1, s2, s3, s4 = state
+        if n == 0:
+            return None
+        mean = s1 / n
+        m2 = s2 / n - mean * mean
+        if m2 <= 0:
+            return 0.0
+        m4 = (s4 / n - 4 * mean * s3 / n + 6 * mean ** 2 * s2 / n - 3 * mean ** 4)
+        return float(m4 / (m2 * m2) - 3.0)
+
+
+# -- two-argument aggregations ------------------------------------------------
+# The executor evaluates ONE argument expression per aggregation, so multi-arg
+# functions pack their columns into an [n, k] matrix via the internal __pack
+# transform (engine/expr.py); host_state then unpacks columns. Host-path only
+# (__pack is not a device function), like the reference's covariance family.
+
+def _pack_args(args) -> Function:
+    return Function("__pack", tuple(args))
+
+
+def _require_numeric_arg(agg: AggFunc, segment, arg: Optional[Expr] = None) -> None:
+    """Every column referenced by the argument must be numeric."""
+    from ..sql.ast import identifiers_in
+    arg = arg if arg is not None else agg.arg
+    if arg is None:
+        return
+    for name in identifiers_in(arg):
+        if name == "*":
+            continue
+        try:
+            reader = segment.column(name)
+        except KeyError:
+            continue
+        if not reader.data_type.is_numeric:
+            raise QueryValidationError(
+                f"{agg.call.name.upper()} requires numeric arguments; "
+                f"column {name!r} is {reader.data_type.value}")
+
+
+class CovarPopAgg(MomentAgg):
+    """COVAR_POP / COVAR_SAMP (reference: CovarianceAggregationFunction)."""
+    name = "covarpop"
+    device_outputs = ()
+    sample = False
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        if len(call.args) < 2:
+            raise QueryValidationError(f"{self.name} needs two arguments")
+        self._arg_cols = call.args[:2]
+        self.arg = _pack_args(self._arg_cols)
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def validate_args(self, segment) -> None:
+        for a in self._arg_cols:
+            _require_numeric_arg(self, segment, a)
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        if v.size == 0:
+            return (0, 0.0, 0.0, 0.0)
+        x, y = v[:, 0], v[:, 1]
+        # centered co-moment, merged pairwise like VarianceAgg (stable at any
+        # magnitude; raw Σxy cancels catastrophically for epoch-sized columns)
+        cxy = float(((x - x.mean()) * (y - y.mean())).sum())
+        return (len(x), float(x.sum()), float(y.sum()), cxy)
+
+    def merge(self, a, b):
+        na, sxa, sya, ca = a
+        nb, sxb, syb, cb = b
+        if na == 0:
+            return b
+        if nb == 0:
+            return a
+        n = na + nb
+        dx = sxb / nb - sxa / na
+        dy = syb / nb - sya / na
+        return (n, sxa + sxb, sya + syb, ca + cb + dx * dy * na * nb / n)
+
+    def finalize(self, state):
+        n, _sx, _sy, cxy = state
+        d = n - 1 if self.sample else n
+        if n == 0 or d <= 0:
+            return None
+        return float(cxy / d)
+
+
+class CovarSampAgg(CovarPopAgg):
+    name = "covarsamp"
+    sample = True
+
+
+class CorrAgg(CovarPopAgg):
+    """CORR — Pearson correlation; centered co-moments like CovarPopAgg."""
+    name = "corr"
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        if v.size == 0:
+            return (0, 0.0, 0.0, 0.0, 0.0, 0.0)
+        x, y = v[:, 0], v[:, 1]
+        dx, dy = x - x.mean(), y - y.mean()
+        return (len(x), float(x.sum()), float(y.sum()), float((dx * dx).sum()),
+                float((dy * dy).sum()), float((dx * dy).sum()))
+
+    def merge(self, a, b):
+        na, sxa, sya, cxxa, cyya, cxya = a
+        nb, sxb, syb, cxxb, cyyb, cxyb = b
+        if na == 0:
+            return b
+        if nb == 0:
+            return a
+        n = na + nb
+        dx = sxb / nb - sxa / na
+        dy = syb / nb - sya / na
+        w = na * nb / n
+        return (n, sxa + sxb, sya + syb,
+                cxxa + cxxb + dx * dx * w,
+                cyya + cyyb + dy * dy * w,
+                cxya + cxyb + dx * dy * w)
+
+    def finalize(self, state):
+        n, _sx, _sy, cxx, cyy, cxy = state
+        if n == 0 or cxx <= 0 or cyy <= 0:
+            return None
+        return float(cxy / np.sqrt(cxx * cyy))
+
+
+class LastWithTimeAgg(AggFunc):
+    """LASTWITHTIME(col, timeCol, 'dataType') — value at the max time
+    (reference: LastWithTimeAggregationFunction). State: (time, value)."""
+    name = "lastwithtime"
+    pick_last = True
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        if len(call.args) < 2:
+            raise QueryValidationError(f"{self.name} needs (value, time) arguments")
+        self._arg_cols = call.args[:2]
+        self.arg = _pack_args(self._arg_cols)
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def validate_args(self, segment) -> None:
+        # string value columns need a typed state the __pack matrix can't carry;
+        # fail at plan time instead of deep in np.asarray(dtype=float64)
+        for a in self._arg_cols:
+            _require_numeric_arg(self, segment, a)
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        if v.size == 0:
+            return None
+        x, t = v[:, 0], v[:, 1]
+        i = int(np.argmax(t) if self.pick_last else np.argmin(t))
+        return (float(t[i]), float(x[i]))
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if self.pick_last:
+            return a if a[0] >= b[0] else b
+        return a if a[0] <= b[0] else b
+
+    def finalize(self, state):
+        return None if state is None else state[1]
+
+
+class FirstWithTimeAgg(LastWithTimeAgg):
+    name = "firstwithtime"
+    pick_last = False
+
+
+class HistogramAgg(AggFunc):
+    """HISTOGRAM(col, lower, upper, numBins) — equal-width bin counts
+    (reference: HistogramAggregationFunction). State: int64[numBins]; values
+    outside [lower, upper) are clamped into the edge bins like the reference."""
+    name = "histogram"
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        from ..sql.ast import Literal
+        if len(call.args) != 4 or not all(isinstance(a, Literal)
+                                          for a in call.args[1:]):
+            raise QueryValidationError(
+                "HISTOGRAM needs (column, lower, upper, numBins) literals")
+        self.lower = float(call.args[1].value)
+        self.upper = float(call.args[2].value)
+        self.bins = int(call.args[3].value)
+        if self.bins <= 0 or self.upper <= self.lower:
+            raise QueryValidationError("HISTOGRAM needs upper > lower, bins > 0")
+        self.arg = call.args[0]
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def host_state(self, values):
+        v = np.asarray(values, dtype=np.float64)
+        idx = np.floor((v - self.lower) / (self.upper - self.lower) * self.bins)
+        idx = np.clip(idx, 0, self.bins - 1).astype(np.int64)
+        return np.bincount(idx, minlength=self.bins).astype(np.int64)
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return [int(c) for c in state]
+
+    def empty_result(self):
+        return [0] * self.bins
+
+
+class DistinctSumAgg(DistinctCountAgg):
+    """DISTINCTSUM — sum over the distinct value set (reference:
+    DistinctSumAggregationFunction); device path reuses the presence vector."""
+    name = "distinctsum"
+
+    def validate_args(self, segment) -> None:
+        _require_numeric_arg(self, segment)
+
+    def finalize(self, state):
+        return float(sum(state)) if state else None
+
+    def empty_result(self):
+        return None
+
+
+class DistinctAvgAgg(DistinctCountAgg):
+    name = "distinctavg"
+
+    def finalize(self, state):
+        return float(sum(state) / len(state)) if state else None
+
+    def empty_result(self):
+        return None
+
+
+class DistinctSumMVAgg(DistinctSumAgg):
+    name = "distinctsummv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return DistinctCountAgg.host_state(self, _mv_flat(values))
+
+
+class DistinctAvgMVAgg(DistinctAvgAgg):
+    name = "distinctavgmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return DistinctCountAgg.host_state(self, _mv_flat(values))
+
+
+class BoolAndAgg(AggFunc):
+    """BOOL_AND — true iff every (boolean 0/1) value is true; rides the device
+    min output (reference: BooleanAndAggregationFunction, which likewise
+    requires a BOOLEAN argument — enforced in validate_args so the device
+    min>=1 decode and the host truthiness path can never disagree)."""
+    name = "booland"
+    device_outputs = ("min",)
+
+    def validate_args(self, segment) -> None:
+        from ..sql.ast import Identifier as _Id
+        if isinstance(self.arg, _Id) and self.arg.name != "*":
+            try:
+                dt = segment.column(self.arg.name).data_type
+            except KeyError:
+                return
+            from ..schema import DataType as _DT
+            if dt is not _DT.BOOLEAN:
+                raise QueryValidationError(
+                    f"{self.call.name.upper()} requires a BOOLEAN column; "
+                    f"{self.arg.name!r} is {dt.value}")
+
+    def host_state(self, values):
+        return bool(np.all(np.asarray(values) != 0)) if len(values) else None
+
+    def state_from_device(self, outs):
+        return bool(outs["min"] >= 1) if outs["count"] > 0 else None
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a and b
+
+    def finalize(self, state):
+        return state
+
+
+class BoolOrAgg(BoolAndAgg):
+    name = "boolor"
+    device_outputs = ("max",)
+
+    def host_state(self, values):
+        return bool(np.any(np.asarray(values) != 0)) if len(values) else None
+
+    def state_from_device(self, outs):
+        return bool(outs["max"] >= 1) if outs["count"] > 0 else None
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a or b
+
+
+class SumPrecisionAgg(AggFunc):
+    """SUMPRECISION — exact decimal sum, returned as a string (reference:
+    SumPrecisionAggregationFunction over BigDecimal)."""
+    name = "sumprecision"
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
+
+    def validate_args(self, segment) -> None:
+        _require_numeric_arg(self, segment)
+
+    def host_state(self, values):
+        from decimal import Decimal
+        if not len(values):
+            return None  # empty -> null, like SUM (and like empty_result)
+        total = Decimal(0)
+        for v in np.asarray(values).tolist():
+            total += Decimal(str(v))
+        return total
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a + b
+
+    def finalize(self, state):
+        return str(state.normalize()) if state is not None else None
+
+
+class PercentileRawTDigestAgg(PercentileTDigestAgg):
+    """PERCENTILERAWTDIGEST — serialized t-digest (hex) for client-side merging."""
+    name = "percentilerawtdigest"
+
+    def finalize(self, state):
+        return state.to_bytes().hex()
+
+
 # -- multi-value aggregations (reference: CountMVAggregationFunction etc.) ----
 # `values` on the host path is an object array of per-row numpy arrays (the MV
 # cells); every *MV function flattens rows to their values first. Host-only:
@@ -547,8 +1027,29 @@ _REGISTRY = {
     "percentile": PercentileAgg,
     "percentileest": PercentileEstAgg,
     "percentiletdigest": PercentileTDigestAgg,
+    "percentilerawtdigest": PercentileRawTDigestAgg,
     "distinctcountthetasketch": DistinctCountThetaAgg,
     "distinctcountrawthetasketch": DistinctCountRawThetaAgg,
+    # moments (both reference camelCase-derived and SQL-standard spellings)
+    "varpop": VarianceAgg, "var_pop": VarianceAgg,
+    "varsamp": VarSampAgg, "var_samp": VarSampAgg,
+    "stddevpop": StdDevPopAgg, "stddev_pop": StdDevPopAgg,
+    "stddevsamp": StdDevSampAgg, "stddev_samp": StdDevSampAgg,
+    "skewness": SkewnessAgg,
+    "kurtosis": KurtosisAgg,
+    "covarpop": CovarPopAgg, "covar_pop": CovarPopAgg,
+    "covarsamp": CovarSampAgg, "covar_samp": CovarSampAgg,
+    "corr": CorrAgg,
+    "firstwithtime": FirstWithTimeAgg,
+    "lastwithtime": LastWithTimeAgg,
+    "histogram": HistogramAgg,
+    "distinctsum": DistinctSumAgg,
+    "distinctavg": DistinctAvgAgg,
+    "distinctsummv": DistinctSumMVAgg,
+    "distinctavgmv": DistinctAvgMVAgg,
+    "booland": BoolAndAgg, "bool_and": BoolAndAgg,
+    "boolor": BoolOrAgg, "bool_or": BoolOrAgg,
+    "sumprecision": SumPrecisionAgg,
 }
 
 
@@ -557,7 +1058,8 @@ def make_agg(call: Function) -> AggFunc:
     if call.name == "count" and call.distinct:
         # COUNT(DISTINCT x) -> DISTINCTCOUNT(x), reference does the same rewrite
         return DistinctCountAgg(Function("distinctcount", call.args))
-    for prefix, cls in (("percentiletdigest", PercentileTDigestAgg),
+    for prefix, cls in (("percentilerawtdigest", PercentileRawTDigestAgg),
+                        ("percentiletdigest", PercentileTDigestAgg),
                         ("percentileest", PercentileEstAgg),
                         ("percentile", PercentileAgg)):
         if name.startswith(prefix) and name[len(prefix):].isdigit():

@@ -70,6 +70,8 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
     plan = SegmentPlan("host", segment, ctx, aggs, group_exprs)
     plan.valid_docs = valid_docs
     _validate_mv_usage(ctx, aggs, segment)
+    for agg in aggs:
+        agg.validate_args(segment)
 
     # -- filter compilation + constant-fold pruning ------------------------
     try:
@@ -257,6 +259,9 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
              and not getattr(segment.column(arg.name), "is_multi_value", False))
         if not agg.device_ok(AggContext(group_by, arg_is_dict, arg_numeric)):
             return f"aggregation {agg.name} not device-supported here"
+        err = _power_sum_f32_safe(agg, segment)
+        if err:
+            return err
         if arg_is_dict and ("distinct" in agg.device_outputs
                             or "hll" in agg.device_outputs):
             continue  # distinct/HLL over a dict column works on ids; dtype irrelevant
@@ -271,6 +276,31 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
                 err = _expr_device_ok(leaf.expr, segment)
                 if err:
                     return err
+    return ""
+
+
+# Device power sums accumulate in f32 (~7 significant digits). Allow the device
+# path only when max|x|^p stays within the f32 integer-exact-ish range, so the
+# centered-moment subtraction at finalize is not pure cancellation noise; large
+# columns (epoch timestamps, ids) take the f64 host path instead.
+POWER_SUM_F32_LIMIT = float(1 << 20)
+
+
+def _power_sum_f32_safe(agg, segment: ImmutableSegment) -> str:
+    powers = [p for o, p in (("sum2", 2), ("sum3", 3), ("sum4", 4))
+              if o in agg.device_outputs]
+    if not powers:
+        return ""
+    if not isinstance(agg.arg, Identifier):
+        return f"{agg.name} over an expression: unknown bounds for f32 power sums"
+    reader = segment.column(agg.arg.name)
+    mn, mx = reader.min_value, reader.max_value
+    if mn is None or mx is None:
+        return f"{agg.name}: no column bounds to prove f32 power sums safe"
+    max_abs = max(abs(float(mn)), abs(float(mx)))
+    if max_abs ** max(powers) > POWER_SUM_F32_LIMIT:
+        return (f"{agg.name}: |{agg.arg.name}|^{max(powers)} exceeds f32 "
+                f"precision budget (host f64 path)")
     return ""
 
 

@@ -70,6 +70,16 @@ def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> 
     return out
 
 
+def _object_array(vals: List[Any]) -> np.ndarray:
+    """1-D object array of exactly len(vals) cells. np.array(vals, dtype=object)
+    would splat equal-length LIST values (e.g. HISTOGRAM results) into a 2-D
+    array instead of keeping one list per cell."""
+    out = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        out[i] = v
+    return out
+
+
 def reduce_to_result(ctx: QueryContext, merged: SegmentResult, aggs: List[AggFunc],
                      group_exprs: List[Expr]) -> ResultTable:
     """Broker-side reduce: finalize states, post-aggregate, HAVING, ORDER BY, LIMIT."""
@@ -85,14 +95,14 @@ def reduce_to_result(ctx: QueryContext, merged: SegmentResult, aggs: List[AggFun
             env[repr(g)] = np.array([k[j] for k in keys], dtype=object)
         for i, call in enumerate(ctx.aggregations):
             vals = [aggs[i].finalize(merged.groups[k][i]) for k in keys]
-            env[repr(call)] = np.array(vals, dtype=object)
+            env[repr(call)] = _object_array(vals)
     else:
         n = 1
         states = merged.scalar
         for i, call in enumerate(ctx.aggregations):
             v = (aggs[i].finalize(states[i]) if states is not None
                  else aggs[i].empty_result())
-            env[repr(call)] = np.array([v], dtype=object)
+            env[repr(call)] = _object_array([v])
 
     # -- HAVING ------------------------------------------------------------
     keep = np.ones(n, dtype=bool)

@@ -58,10 +58,17 @@ AGGREGATION_FUNCTIONS = {
     "count", "sum", "min", "max", "avg", "minmaxrange",
     "distinctcount", "distinctcounthll", "distinctcountbitmap",
     "distinctcountthetasketch", "distinctcountrawthetasketch",
-    "percentile", "percentileest", "percentiletdigest",
+    "percentile", "percentileest", "percentiletdigest", "percentilerawtdigest",
     "sumprecision", "mode",
     # multi-value variants (reference: CountMVAggregationFunction family)
     "countmv", "summv", "minmv", "maxmv", "avgmv", "distinctcountmv",
+    "distinctsummv", "distinctavgmv",
+    # moments / stats (reference: VarianceAggregationFunction + fourth moment)
+    "varpop", "var_pop", "varsamp", "var_samp",
+    "stddevpop", "stddev_pop", "stddevsamp", "stddev_samp",
+    "skewness", "kurtosis", "covarpop", "covar_pop", "covarsamp", "covar_samp",
+    "corr", "firstwithtime", "lastwithtime", "histogram",
+    "distinctsum", "distinctavg", "booland", "bool_and", "boolor", "bool_or",
 }
 
 
