@@ -40,6 +40,7 @@ class Broker:
         self.catalog = catalog
         self.routing = RoutingManager(catalog)
         self._servers: Dict[str, ServerHandle] = {}
+        self._explain: Dict[str, Callable] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads,
                                         thread_name_prefix=f"{instance_id}-scatter")
         self._lock = threading.RLock()
@@ -47,10 +48,14 @@ class Broker:
         self.quota = QueryQuotaManager(catalog)
         catalog.register_instance(InstanceInfo(instance_id, "broker"))
 
-    def register_server_handle(self, server_id: str, handle: ServerHandle) -> None:
-        """Wire a server's execute entry (direct object in-proc, HTTP proxy remote)."""
+    def register_server_handle(self, server_id: str, handle: ServerHandle,
+                               explain_handle=None) -> None:
+        """Wire a server's execute entry (direct object in-proc, HTTP proxy remote).
+        `explain_handle(table, ctx, segments) -> rows` serves EXPLAIN PLAN."""
         with self._lock:
             self._servers[server_id] = handle
+            if explain_handle is not None:
+                self._explain[server_id] = explain_handle
         self.routing.mark_server_healthy(server_id)
 
     # ------------------------------------------------------------------
@@ -72,7 +77,8 @@ class Broker:
             trace_on = _truthy(stmt.options.get("trace"))
             with tracing.request_trace(trace_on) as tr:
                 if stmt.joins:
-                    result = self._handle_multistage(stmt)
+                    result = (self._explain_multistage(stmt) if stmt.explain
+                              else self._handle_multistage(stmt))
                 else:
                     result = self._handle_single(stmt, t0)
                 if tr is not None:
@@ -105,6 +111,9 @@ class Broker:
             raise QueryRejectedError(f"table {raw_table!r} exceeded its query quota")
         schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
         ctx = compile_query(stmt, schema)
+
+        if ctx.explain:
+            return self._handle_explain(ctx, physical)
 
         aggs = [make_agg(f) for f in ctx.aggregations]
         group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
@@ -172,6 +181,73 @@ class Broker:
             },
         })
         return result
+
+    def _handle_explain(self, ctx, physical: List[str]) -> ResultTable:
+        """EXPLAIN PLAN: ask ONE server per physical table for its operator plan
+        (reference: v2 explain gathers server plans; identical replicas make one
+        representative server per table sufficient). Hybrid tables show BOTH
+        halves, each under the same time-boundary predicate the real query
+        applies, spliced under a single broker prefix."""
+        import dataclasses
+
+        from ..sql.ast import Function
+        boundary = self._time_boundary(physical)
+        merged: Optional[List[list]] = None
+        for table in physical:
+            tf_expr = _boundary_expr(boundary, table)
+            ctx_t = ctx if tf_expr is None else dataclasses.replace(
+                ctx, filter=tf_expr if ctx.filter is None
+                else Function("and", (ctx.filter, tf_expr)))
+            routing = self.routing.route_query(table, ctx_t, extra_filter=None)
+            rows = None
+            for server_id, segments in routing.items():
+                handle = self._explain.get(server_id)
+                if handle is None or not segments:
+                    continue
+                rows = [list(r) for r in handle(table, ctx_t, segments)]
+                break
+            if not rows or len(rows) < 2:
+                continue
+            if merged is None:
+                merged = rows
+            else:
+                # splice this table's SEGMENT_PLAN subtrees (everything past the
+                # 2-row BROKER_REDUCE/COMBINE prefix) under the merged COMBINE
+                shift = len(merged) - 2
+                for op, op_id, parent in rows[2:]:
+                    merged.append([op, op_id + shift,
+                                   1 if parent == 1 else parent + shift])
+        if merged is None:
+            # no segments anywhere: answer with the broker-level operators only
+            from ..query.explain import explain_result
+            return explain_result(ctx, [])
+        return ResultTable(["Operator", "Operator_Id", "Parent_Id"], merged,
+                           {"explain": True})
+
+    def _explain_multistage(self, stmt) -> ResultTable:
+        """EXPLAIN for a JOIN query: describe the stage plan WITHOUT executing
+        (reference: v2 EXPLAIN prints the logical stage tree)."""
+        from ..multistage.planner import plan_multistage
+        from ..sql.ast import to_sql
+        plan = plan_multistage(stmt, lambda t: (
+            self.catalog.schema_for_table(self._physical_tables(t)[0])
+            if self._physical_tables(t) else None))
+        rows: List[list] = [["MULTISTAGE_REDUCE", 0, -1]]
+        parent = 0
+        for j in reversed(plan.joins):
+            keys = ", ".join(f"{l}={r}" for l, r in
+                             zip(j.left_keys, j.right_keys))
+            rows.append([f"HASH_JOIN(type:{j.join_type}; keys:[{keys}])",
+                         len(rows), parent])
+            parent = len(rows) - 1
+        for alias in [plan.base_alias] + [j.right_alias for j in plan.joins]:
+            scan = plan.scans[alias]
+            label = f"TABLE_SCAN(table:{scan.table}; alias:{alias}"
+            if scan.filter is not None:
+                label += f"; pushdownFilter:{to_sql(scan.filter)}"
+            rows.append([label + ")", len(rows), parent])
+        return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows,
+                           {"explain": True})
 
     def _handle_multistage(self, stmt) -> ResultTable:
         """Join query: multistage engine over a scatter-based leaf-scan provider."""

@@ -41,7 +41,8 @@ class QuickCluster:
         ]
         self.broker = Broker("broker_0", self.catalog)
         for s in self.servers:
-            self.broker.register_server_handle(s.instance_id, s.execute_partial)
+            self.broker.register_server_handle(s.instance_id, s.execute_partial,
+                                               explain_handle=s.explain_partial)
         from ..minion.tasks import MinionWorker
         self.minion = MinionWorker("minion_0", self.catalog, self.deepstore,
                                    self.controller,

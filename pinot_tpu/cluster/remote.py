@@ -215,6 +215,15 @@ class RemoteServerHandle:
             tr.splice(spans, offset_ms=dispatch_ms)
         return result
 
+    def explain(self, table: str, ctx, segment_names: Sequence[str]):
+        """EXPLAIN rows from the remote server (POST /explain, JSON)."""
+        sql = ctx if isinstance(ctx, str) else ctx.sql
+        body = encode_query_request(table, sql, segment_names)
+        resp = http_call("POST", f"{self.server_url}/explain", body,
+                         timeout=self.timeout_s,
+                         content_type="application/octet-stream")
+        return json.loads(resp.decode())["rows"]
+
 
 class ControllerDeepStore(DeepStoreFS):
     """Deep-store access proxied through the controller by URL (reference: the http

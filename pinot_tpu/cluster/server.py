@@ -324,6 +324,21 @@ class ServerNode:
             (_t.perf_counter() - t0) * 1000)
         return merged
 
+    def explain_partial(self, table: str, ctx: Union[str, QueryContext],
+                        segment_names: Optional[Sequence[str]] = None) -> List[List]:
+        """EXPLAIN rows over this server's copy of the segments (reference: v2
+        explain asks servers for their operator plans)."""
+        from ..query.explain import explain_result
+        schema = self.catalog.schema_for_table(table)
+        if isinstance(ctx, str):
+            ctx = compile_query(ctx, schema)
+        mgr = self._table_manager(table)
+        segments = mgr.acquire(segment_names)
+        try:
+            return explain_result(ctx, segments, table=table).rows
+        finally:
+            mgr.release(segments)
+
     def segments_served(self, table: str) -> List[str]:
         return self._table_manager(table).segment_names
 

@@ -232,6 +232,7 @@ class ServerService:
         self.server = server
         self.http = HttpService(host, port)
         self.http.route("POST", "query", self._query)
+        self.http.route("POST", "explain", self._explain)
         self.http.route("GET", "health", lambda p, q, b: json_response(
             {"status": "OK", "instance": server.instance_id}))
         self.http.route("GET", "segments", self._segments)
@@ -272,6 +273,12 @@ class ServerService:
             spans = [dict(s, name=f"server:{self.server.instance_id}/{s['name']}")
                      for s in tr.to_rows()]
         return binary_response(encode_segment_result(result, trace_spans=spans))
+
+    def _explain(self, parts, params, body):
+        req = decode_query_request(body)
+        rows = self.server.explain_partial(req["table"], req["sql"],
+                                           req["segments"])
+        return json_response({"rows": rows})
 
     def _segments(self, parts, params, body):
         return json_response({"segments": self.server.segments_served(parts[0])})
@@ -319,8 +326,9 @@ class BrokerService:
             if self._registered.get(info.instance_id) == url:
                 continue
             self._registered[info.instance_id] = url
-            self.broker.register_server_handle(info.instance_id,
-                                               RemoteServerHandle(url))
+            handle = RemoteServerHandle(url)
+            self.broker.register_server_handle(info.instance_id, handle,
+                                               explain_handle=handle.explain)
 
     def _query(self, parts, params, body):
         d = json.loads(body.decode())

@@ -50,6 +50,7 @@ class QueryContext:
     options: Dict[str, object] = field(default_factory=dict)
     gapfill: Optional[GapfillSpec] = None
     sql: str = ""   # original SQL text; the HTTP transport re-compiles server-side
+    explain: bool = False
 
     @property
     def is_aggregation_query(self) -> bool:
@@ -164,6 +165,7 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
         offset=stmt.offset,
         distinct=stmt.distinct,
         options=dict(stmt.options),
+        explain=stmt.explain,
         gapfill=gapfill,
         sql=stmt.raw or (sql_or_stmt if isinstance(sql_or_stmt, str) else ""),
     )

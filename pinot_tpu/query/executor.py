@@ -36,6 +36,9 @@ class ServerQueryExecutor:
         t0 = _t.perf_counter()
         ctx = compile_query(query, schema or (segments[0].schema if segments else None)) \
             if isinstance(query, str) else query
+        if ctx.explain:
+            from .explain import explain_result
+            return explain_result(ctx, segments)
         aggs = [make_agg(f) for f in ctx.aggregations]
         group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
                        else list(ctx.group_by))

@@ -1,0 +1,181 @@
+"""EXPLAIN PLAN FOR <query>: operator-tree description of the execution plan.
+
+Analog of the reference's explain support (`ExplainPlanQueriesTest`,
+`core/query/reduce/ExplainPlanDataTableReducer`): the response is a ResultTable
+with columns [Operator, Operator_Id, Parent_Id], one row per operator node,
+ids in pre-order so the tree reconstructs from parent links.
+
+The plan surface here is the per-segment `SegmentPlan` (planner.py): segments
+sharing a plan shape collapse into one subtree with a `segments=N` count —
+the analog of the reference grouping identical server plans in v2 explain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..sql.ast import to_sql
+from .context import QueryContext
+from .planner import SegmentPlan, plan_segment
+from .predicate import CmpLeaf, DocSetLeaf, LutLeaf, NullLeaf
+from .result import ResultTable
+
+
+class _Node:
+    def __init__(self, label: str, children: Optional[List["_Node"]] = None):
+        self.label = label
+        self.children = children or []
+
+    def signature(self) -> Tuple:
+        return (self.label, tuple(c.signature() for c in self.children))
+
+
+def _filter_node(plan: SegmentPlan) -> Optional[_Node]:
+    prog = plan.filter_prog
+    if prog is None or prog.is_match_all:
+        return _Node("FILTER_MATCH_ALL")
+
+    def leaf_node(i: int) -> _Node:
+        leaf = prog.leaves[i]
+        if isinstance(leaf, LutLeaf):
+            kind = ("ID_INTERVALS" if leaf.intervals is not None else "LUT")
+            return _Node(f"FILTER_DICT_{kind}(column={leaf.col})")
+        if isinstance(leaf, NullLeaf):
+            op = "IS_NOT_NULL" if leaf.negated else "IS_NULL"
+            return _Node(f"FILTER_{op}(column={leaf.col})")
+        if isinstance(leaf, DocSetLeaf):
+            return _Node(f"FILTER_DOCSET(column={leaf.col}; {leaf.desc})")
+        assert isinstance(leaf, CmpLeaf)
+        return _Node(f"FILTER_EXPR({to_sql(leaf.expr)} {leaf.op} {list(leaf.operands)})")
+
+    def walk(node) -> _Node:
+        kind = node[0]
+        if kind == "const":
+            return _Node(f"FILTER_CONST({'ALL' if node[1] else 'NONE'})")
+        if kind == "leaf":
+            return leaf_node(node[1])
+        if kind == "not":
+            return _Node("FILTER_NOT", [walk(node[1])])
+        return _Node(f"FILTER_{kind.upper()}", [walk(c) for c in node[1]])
+
+    return walk(prog.tree)
+
+
+def _segment_plan_node(ctx: QueryContext, plan: SegmentPlan) -> _Node:
+    if plan.kind == "empty":
+        return _Node("PRUNED(filter folds to constant false)")
+    if plan.kind == "metadata":
+        aggs = ", ".join(a.call.name for a in plan.aggs)
+        return _Node(f"METADATA_ONLY_AGGREGATE(aggregations:{aggs})")
+
+    children: List[_Node] = []
+    f = _filter_node(plan)
+    if f is not None:
+        children.append(f)
+
+    if plan.kind == "selection":
+        cols = ", ".join(name for _, name in ctx.select_items)
+        label = ("SELECT_ORDERBY" if ctx.order_by else "SELECT") + f"(columns:{cols})"
+        return _Node(label, children)
+
+    if plan.group_exprs:
+        keys = ", ".join(to_sql(g) for g in plan.group_exprs)
+        aggs = ", ".join(a.call.name for a in plan.aggs) or "-"
+        if plan.kind == "device":
+            label = (f"DEVICE_FUSED_GROUP_BY(keys:{keys}; aggregations:{aggs}; "
+                     f"denseKeys:{plan.num_keys_real or '?'})")
+        else:
+            label = f"HOST_GROUP_BY(keys:{keys}; aggregations:{aggs})"
+    else:
+        aggs = ", ".join(a.call.name for a in plan.aggs)
+        label = (f"DEVICE_FUSED_AGGREGATE(aggregations:{aggs})"
+                 if plan.kind == "device" else
+                 f"HOST_AGGREGATE(aggregations:{aggs})")
+    if plan.kind == "host" and plan.fallback_reason:
+        label = label[:-1] + f"; fallback:{plan.fallback_reason})"
+    return _Node(label, children)
+
+
+def explain_plan_nodes(ctx: QueryContext, segments: Sequence[Any],
+                       table: Optional[str] = None) -> List[_Node]:
+    """One node per DISTINCT per-segment plan shape, each tagged segments=N."""
+    shapes: Dict[Tuple, Tuple[_Node, int]] = {}
+    order: List[Tuple] = []
+    for seg in segments:
+        node = None
+        if not getattr(seg, "is_mutable", False):
+            # mirror the executor: the star-tree rewrite happens before planning
+            from .startree_exec import try_star_tree
+            stp = try_star_tree(ctx, seg)
+            if stp is not None:
+                sub = plan_segment(ctx2 := stp.ctx2, stp.tree.view)
+                if sub.kind == "device":
+                    from .planner import build_device_geometry
+                    build_device_geometry(sub)
+                node = _Node(f"STAR_TREE_REWRITE(records:{stp.tree.view.num_docs})",
+                             [_segment_plan_node(ctx2, sub)])
+        if node is None:
+            plan = plan_segment(ctx, seg)
+            if plan.kind == "device":
+                from .planner import build_device_geometry
+                build_device_geometry(plan)
+            node = _segment_plan_node(ctx, plan)
+        sig = node.signature()
+        if sig in shapes:
+            shapes[sig] = (shapes[sig][0], shapes[sig][1] + 1)
+        else:
+            shapes[sig] = (node, 1)
+            order.append(sig)
+    out = []
+    tbl = f"table:{table}; " if table else ""
+    for sig in order:
+        node, count = shapes[sig]
+        out.append(_Node(f"SEGMENT_PLAN({tbl}segments:{count})", [node]))
+    return out
+
+
+def explain_result(ctx: QueryContext, segments: Sequence[Any],
+                   broker_prefix: Optional[List[str]] = None,
+                   table: Optional[str] = None) -> ResultTable:
+    """Full EXPLAIN response. `broker_prefix` lets the broker prepend its own
+    operators (reduce, combine) above the per-segment subtrees."""
+    root_labels = broker_prefix if broker_prefix is not None else \
+        _default_prefix(ctx)
+    # nest the prefix chain, then hang segment-plan subtrees off the last one
+    root = _Node(root_labels[0])
+    cur = root
+    for label in root_labels[1:]:
+        nxt = _Node(label)
+        cur.children.append(nxt)
+        cur = nxt
+    cur.children.extend(explain_plan_nodes(ctx, segments, table))
+
+    rows: List[List[Any]] = []
+
+    def emit(node: _Node, parent_id: int) -> None:
+        my_id = len(rows)
+        rows.append([node.label, my_id, parent_id])
+        for c in node.children:
+            emit(c, my_id)
+
+    emit(root, -1)
+    return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows,
+                       {"explain": True})
+
+
+def _default_prefix(ctx: QueryContext) -> List[str]:
+    parts = []
+    if ctx.order_by:
+        keys = ", ".join(to_sql(o.expr) + (" DESC" if o.desc else "")
+                         for o in ctx.order_by)
+        parts.append(f"sort:[{keys}]")
+    parts.append(f"limit:{ctx.limit}")
+    if ctx.having is not None:
+        parts.append(f"having:{to_sql(ctx.having)}")
+    prefix = [f"BROKER_REDUCE({', '.join(parts)})"]
+    if ctx.is_aggregation_query or ctx.distinct:
+        prefix.append("COMBINE_GROUP_BY" if (ctx.group_by or ctx.distinct)
+                      else "COMBINE_AGGREGATE")
+    else:
+        prefix.append("COMBINE_SELECT")
+    return prefix

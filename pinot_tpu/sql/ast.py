@@ -136,6 +136,7 @@ class QueryStatement:
     offset: int = 0
     options: dict = field(default_factory=dict)  # SQL `SET key=value;` / OPTION(...)
     raw: str = ""    # original SQL text (shipped to remote servers by the transport)
+    explain: bool = False  # EXPLAIN PLAN FOR prefix (reference: SqlKind.EXPLAIN)
 
 
 # -- SQL unparser ------------------------------------------------------------

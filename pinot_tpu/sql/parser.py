@@ -60,6 +60,13 @@ class Parser:
         if not self.accept_op(op):
             raise SqlSyntaxError(f"expected {op!r} at position {self.cur.pos}, got {self.cur.value!r}")
 
+    def _accept_ident_word(self, word: str) -> bool:
+        """Accept a contextual keyword: an IDENT token whose text matches."""
+        if self.cur.kind == "IDENT" and self.cur.value.upper() == word:
+            self.advance()
+            return True
+        return False
+
     # -- statement ---------------------------------------------------------
     def parse(self) -> QueryStatement:
         q = QueryStatement()
@@ -71,6 +78,14 @@ class Parser:
             q.options[key] = self._literal_token_value()
             self.accept_op(";")
 
+        # EXPLAIN/PLAN/FOR are CONTEXTUAL: only the statement-leading "EXPLAIN
+        # PLAN FOR" sequence is special, so columns/tables named plan/for/explain
+        # keep working (reference: Calcite treats EXPLAIN as a statement prefix)
+        if self._accept_ident_word("EXPLAIN"):
+            if not (self._accept_ident_word("PLAN")
+                    and self._accept_ident_word("FOR")):
+                raise SqlSyntaxError("expected PLAN FOR after EXPLAIN")
+            q.explain = True
         self.expect_keyword("SELECT")
         q.distinct = self.accept_keyword("DISTINCT")
         q.select = self._select_list()

@@ -1,0 +1,151 @@
+"""EXPLAIN PLAN tests: operator-tree output, plan-selection visibility
+(device vs host vs star-tree vs metadata vs pruned), cluster + HTTP paths.
+
+Reference pattern: ExplainPlanQueriesTest asserting [Operator, Operator_Id,
+Parent_Id] rows for representative query shapes.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import execute_query
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+SCHEMA = Schema("ev", [
+    dimension("site", DataType.STRING),
+    metric("v", DataType.DOUBLE),
+])
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("explain")
+    return load_segment(SegmentBuilder(SCHEMA, SegmentGeneratorConfig()).build(
+        {"site": ["a", "b", "a", "c"], "v": np.array([1.0, 2.0, 3.0, 4.0])},
+        str(tmp), "ev_0"))
+
+
+def labels(res):
+    return [r[0] for r in res.rows]
+
+
+def tree_ok(res):
+    """ids are pre-order, root parent is -1, every parent precedes its child."""
+    ids = [r[1] for r in res.rows]
+    assert ids == list(range(len(ids)))
+    assert res.rows[0][2] == -1
+    for _, op_id, parent in res.rows[1:]:
+        assert 0 <= parent < op_id
+
+
+def test_explain_device_group_by(seg):
+    res = execute_query(
+        [seg], "EXPLAIN PLAN FOR SELECT site, SUM(v) FROM ev "
+               "WHERE site IN ('a', 'b') GROUP BY site")
+    assert res.columns == ["Operator", "Operator_Id", "Parent_Id"]
+    tree_ok(res)
+    ls = labels(res)
+    assert ls[0].startswith("BROKER_REDUCE")
+    assert "COMBINE_GROUP_BY" in ls[1]
+    assert any(l.startswith("SEGMENT_PLAN(segments:1)") for l in ls)
+    assert any("DEVICE_FUSED_GROUP_BY" in l and "keys:site" in l for l in ls)
+    assert any(l.startswith("FILTER_DICT") and "site" in l for l in ls)
+
+
+def test_explain_host_fallback_visible(seg):
+    res = execute_query(
+        [seg], "EXPLAIN PLAN FOR SELECT UPPER(site), COUNT(*) FROM ev "
+               "GROUP BY UPPER(site)")
+    assert any("HOST_GROUP_BY" in l for l in labels(res))
+
+
+def test_explain_metadata_and_pruned(seg):
+    res = execute_query([seg], "EXPLAIN PLAN FOR SELECT COUNT(*) FROM ev")
+    assert any("METADATA_ONLY_AGGREGATE" in l for l in labels(res))
+    res = execute_query(
+        [seg], "EXPLAIN PLAN FOR SELECT COUNT(*) FROM ev WHERE site = 'zzz'")
+    assert any("PRUNED" in l for l in labels(res))
+
+
+def test_explain_selection_order(seg):
+    res = execute_query(
+        [seg], "EXPLAIN PLAN FOR SELECT site, v FROM ev WHERE v > 1 "
+               "ORDER BY v DESC LIMIT 2")
+    ls = labels(res)
+    assert "sort:[v DESC]" in ls[0] and "limit:2" in ls[0]
+    assert any("SELECT_ORDERBY" in l for l in ls)
+    assert any(l.startswith("FILTER_EXPR") for l in ls)
+
+
+def test_explain_star_tree(tmp_path):
+    from pinot_tpu.segment.startree import StarTreeIndexConfig
+    cfg = SegmentGeneratorConfig(star_tree_configs=[StarTreeIndexConfig(
+        dimensions_split_order=["site"], function_column_pairs=["SUM__v"])])
+    seg = load_segment(SegmentBuilder(SCHEMA, cfg).build(
+        {"site": ["a", "b"] * 50, "v": np.arange(100.0)}, str(tmp_path), "st_0"))
+    res = execute_query(
+        [seg], "EXPLAIN PLAN FOR SELECT site, SUM(v) FROM ev GROUP BY site")
+    assert any(l.startswith("STAR_TREE_REWRITE") for l in labels(res))
+
+
+def test_explain_identical_segments_collapse(tmp_path):
+    segs = []
+    for i in range(3):
+        segs.append(load_segment(SegmentBuilder(SCHEMA).build(
+            {"site": ["a", "b"], "v": np.array([1.0, 2.0])},
+            str(tmp_path), f"m_{i}")))
+    res = execute_query(
+        segs, "EXPLAIN PLAN FOR SELECT site, COUNT(*) FROM ev GROUP BY site")
+    assert any("SEGMENT_PLAN(segments:3)" in l for l in labels(res))
+
+
+def test_explain_words_stay_valid_identifiers(tmp_path):
+    """EXPLAIN/PLAN/FOR are contextual: columns with those names keep working."""
+    schema = Schema("kw", [dimension("plan"), metric("v", DataType.DOUBLE)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"plan": ["x", "y"], "v": np.array([1.0, 2.0])}, str(tmp_path), "kw_0"))
+    res = execute_query([seg], "SELECT plan, v FROM kw ORDER BY plan LIMIT 5")
+    assert res.rows == [["x", 1.0], ["y", 2.0]]
+    res = execute_query(
+        [seg], "EXPLAIN PLAN FOR SELECT plan FROM kw WHERE plan = 'x'")
+    assert any("FILTER_DICT" in l for l in labels(res))
+
+
+def test_explain_join_does_not_execute(tmp_path):
+    """EXPLAIN of a JOIN must return the stage plan, not run the join."""
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.table import TableConfig
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    t1 = Schema("orders", [dimension("cust"), metric("amt", DataType.DOUBLE)])
+    t2 = Schema("custs", [dimension("cust"), dimension("region")])
+    cfg1 = cluster.create_table(t1, TableConfig("orders"))
+    cfg2 = cluster.create_table(t2, TableConfig("custs"))
+    cluster.ingest_columns(cfg1, {"cust": ["c1", "c2"], "amt": np.array([5.0, 7.0])})
+    cluster.ingest_columns(cfg2, {"cust": ["c1", "c2"], "region": ["e", "w"]})
+    res = cluster.query(
+        "EXPLAIN PLAN FOR SELECT c.region, SUM(o.amt) FROM orders o "
+        "JOIN custs c ON o.cust = c.cust GROUP BY c.region")
+    ls = labels(res)
+    assert ls[0] == "MULTISTAGE_REDUCE"
+    assert any(l.startswith("HASH_JOIN(type:inner") for l in ls)
+    assert sum(l.startswith("TABLE_SCAN") for l in ls) == 2
+    tree_ok(res)
+
+
+def test_explain_through_cluster(tmp_path, ssb_schema):
+    from conftest import make_ssb_columns
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.table import TableConfig
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cfg = TableConfig(ssb_schema.name, replication=1)
+    cluster.create_table(ssb_schema, cfg)
+    cluster.ingest_columns(cfg, make_ssb_columns(np.random.default_rng(1), 500))
+    res = cluster.query("EXPLAIN PLAN FOR SELECT lo_region, SUM(lo_revenue) "
+                        "FROM lineorder GROUP BY lo_region")
+    tree_ok(res)
+    ls = labels(res)
+    assert ls[0].startswith("BROKER_REDUCE")
+    assert any("DEVICE_FUSED_GROUP_BY" in l for l in ls)
+    assert any("table:lineorder_OFFLINE" in l for l in ls)
