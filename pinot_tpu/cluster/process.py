@@ -35,46 +35,69 @@ def _write_ready(run_dir: str, name: str, payload: Dict) -> None:
     os.replace(tmp, path)
 
 
-def run_controller(work_dir: str, run_dir: str, port: int = 0) -> None:
+def _load_config(config_path: str, cli_port: int, port_key: str):
+    """Shared config stack for role starters. The CLI port (when explicitly
+    given) is the topmost override layer, matching the documented precedence
+    explicit args > env > files > defaults."""
+    from .. import plugins
+    from ..config import Configuration
+    overrides = {port_key: cli_port} if cli_port else {}
+    cfg = Configuration.load(config_path or None, overrides=overrides)
+    plugins.load_from_config(cfg)
+    return cfg
+
+
+def run_controller(work_dir: str, run_dir: str, port: int = 0,
+                   config_path: str = "") -> None:
     from .catalog import Catalog
     from .controller import Controller
     from .deepstore import LocalDeepStore
     from .services import ControllerService
 
+    cfg = _load_config(config_path, port, "controller.port")
     catalog = Catalog()
     deepstore = LocalDeepStore(os.path.join(work_dir, "deepstore"))
     controller = Controller("controller_0", catalog, deepstore,
                             os.path.join(work_dir, "controller"))
-    svc = ControllerService(controller, port=port)
+    svc = ControllerService(controller, port=cfg.get_int("controller.port", 0))
     _write_ready(run_dir, "controller_0", {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
 
 
 def run_server(controller_url: str, instance_id: str, work_dir: str,
-               run_dir: str, port: int = 0) -> None:
+               run_dir: str, port: int = 0, config_path: str = "") -> None:
+    from ..query.scheduler import scheduler_from_config
     from .remote import ControllerDeepStore, RemoteCatalog, RemoteCompletion
     from .server import ServerNode
     from .services import ServerService
 
+    # defaults < config file < PINOT_TPU_* env < CLI args (reference:
+    # PinotConfiguration stack consumed by HelixServerStarter)
+    cfg = _load_config(config_path, port, "server.port")
     catalog = RemoteCatalog(controller_url)
     deepstore = ControllerDeepStore(controller_url)
     server = ServerNode(instance_id, catalog, deepstore,
                         os.path.join(work_dir, instance_id),
-                        completion=RemoteCompletion(controller_url))
-    svc = ServerService(server, port=port)
+                        tags=cfg.get_list("server.tenant.tags") or None,
+                        completion=RemoteCompletion(controller_url),
+                        scheduler=scheduler_from_config(cfg))
+    svc = ServerService(server, port=cfg.get_int("server.port", 0))
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    server.shutdown()
 
 
 def run_broker(controller_url: str, instance_id: str, run_dir: str,
-               port: int = 0) -> None:
+               port: int = 0, config_path: str = "") -> None:
     from .broker import Broker
     from .remote import RemoteCatalog
     from .services import BrokerService
 
+    cfg = _load_config(config_path, port, "broker.port")
     catalog = RemoteCatalog(controller_url)
-    broker = Broker(instance_id, catalog)
-    svc = BrokerService(broker, port=port)
+    broker = Broker(instance_id, catalog,
+                    max_scatter_threads=cfg.get_int("broker.scatter.threads", 8))
+    svc = BrokerService(broker, port=cfg.get_int("broker.port", 0))
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
 
@@ -88,13 +111,16 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     p.add_argument("--work-dir", default="")
     p.add_argument("--run-dir", required=True)
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--config", default="", help="properties/json config file")
     a = p.parse_args(argv)
     if a.role == "controller":
-        run_controller(a.work_dir, a.run_dir, a.port)
+        run_controller(a.work_dir, a.run_dir, a.port, config_path=a.config)
     elif a.role == "server":
-        run_server(a.controller_url, a.instance_id, a.work_dir, a.run_dir, a.port)
+        run_server(a.controller_url, a.instance_id, a.work_dir, a.run_dir, a.port,
+                   config_path=a.config)
     else:
-        run_broker(a.controller_url, a.instance_id, a.run_dir, a.port)
+        run_broker(a.controller_url, a.instance_id, a.run_dir, a.port,
+                   config_path=a.config)
 
 
 class ControllerClient:

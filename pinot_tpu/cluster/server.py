@@ -82,6 +82,9 @@ class ServerNode:
         self._lock = threading.RLock()
         self._realtime_managers: Dict[str, object] = {}
         self.completion = completion  # LLCSegmentManager handle (in-proc or HTTP proxy)
+        # lifecycle: STARTING -> UP -> SHUTTING_DOWN (reference: ServiceStatus +
+        # BaseServerStarter's startupServiceStatusCheck gate)
+        self.status = "STARTING"
         os.makedirs(data_dir, exist_ok=True)
         catalog.register_instance(InstanceInfo(instance_id, "server", tags=tags
                                                or ["DefaultTenant"]))
@@ -89,6 +92,47 @@ class ServerNode:
         # catch up with pre-existing ideal state (reference: startup reconciliation)
         for table in list(catalog.ideal_state):
             self.reconcile(table)
+        self.status = "UP"
+
+    # -- lifecycle ----------------------------------------------------------
+    def startup_status(self) -> Dict[str, object]:
+        """Readiness: every segment the ideal state assigns to this server is
+        actually served/consuming (reference: BaseServerStarter.java:542-549 —
+        no queries before all assigned segments are loaded)."""
+        assigned = loaded = 0
+        # snapshot under the catalog lock: the in-proc Catalog mutates ideal
+        # state dicts in place, and a health probe racing update_ideal_state
+        # would die with "dictionary changed size during iteration"
+        with self.catalog._lock:
+            ideal = {t: {s: dict(a) for s, a in ist.items()}
+                     for t, ist in self.catalog.ideal_state.items()}
+        for table, ist in ideal.items():
+            mgr = self.tables.get(table)
+            served = set(mgr.segment_names) if mgr else set()
+            rt = self._realtime_managers.get(table)
+            consuming = set(rt.consumers) if rt is not None else set()
+            for seg, assignment in ist.items():
+                state = assignment.get(self.instance_id)
+                if state in (ONLINE, CONSUMING):
+                    assigned += 1
+                    if seg in served or seg in consuming:
+                        loaded += 1
+        ready = self.status == "UP" and loaded == assigned
+        return {"status": self.status, "assignedSegments": assigned,
+                "loadedSegments": loaded, "ready": ready}
+
+    def shutdown(self) -> None:
+        """Graceful stop: deregister from routing, stop consumers/scheduler
+        (reference: BaseServerStarter.stop -> shutdownGracefully)."""
+        self.status = "SHUTTING_DOWN"
+        try:
+            self.catalog.set_instance_alive(self.instance_id, False)
+        except Exception:
+            pass  # controller may already be gone during teardown
+        for handler in list(self._realtime_managers.values()):
+            handler.stop()
+        if self.scheduler is not None:
+            self.scheduler.stop()
 
     # -- state transitions -------------------------------------------------
     def _on_catalog_event(self, event: str, table: str) -> None:

@@ -233,8 +233,7 @@ class ServerService:
         self.http = HttpService(host, port)
         self.http.route("POST", "query", self._query)
         self.http.route("POST", "explain", self._explain)
-        self.http.route("GET", "health", lambda p, q, b: json_response(
-            {"status": "OK", "instance": server.instance_id}))
+        self.http.route("GET", "health", self._health)
         self.http.route("GET", "segments", self._segments)
         self.http.route("GET", "metrics", _metrics_route)
         self.http.start()
@@ -273,6 +272,13 @@ class ServerService:
             spans = [dict(s, name=f"server:{self.server.instance_id}/{s['name']}")
                      for s in tr.to_rows()]
         return binary_response(encode_segment_result(result, trace_spans=spans))
+
+    def _health(self, parts, params, body):
+        """Readiness probe: 503 until every assigned segment is loaded
+        (reference: /health/readiness gated on ServiceStatus)."""
+        st = self.server.startup_status()
+        st["instance"] = self.server.instance_id
+        return json_response(st, status=200 if st["ready"] else 503)
 
     def _explain(self, parts, params, body):
         req = decode_query_request(body)

@@ -184,6 +184,8 @@ def get_stream_factory(stream_type: str, topic: str,
     """Instantiate a stream plugin factory; `properties` carries plugin-specific
     connection config (reference: the stream.* keys of StreamConfig, e.g. Kafka
     bootstrap servers). `kafkalite` (socket log broker) registers lazily."""
-    if stream_type not in _FACTORIES and stream_type == "kafkalite":
-        from . import kafkalite  # noqa: F401  (registers itself on import)
+    if stream_type not in _FACTORIES:
+        # lazily-registered builtins live in ONE list (plugins._BUILTIN_MODULES)
+        from .. import plugins
+        plugins._ensure_builtins()
     return _FACTORIES[stream_type](topic, properties)

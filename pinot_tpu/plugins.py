@@ -1,0 +1,104 @@
+"""Plugin manager: one discovery surface over every SPI registry.
+
+Analog of the reference's PluginManager (`pinot-spi/src/main/java/org/apache/
+pinot/spi/plugin/PluginManager.java`): plugins self-register at import time into
+their SPI's registry (stream factories, record decoders, deep-store FS schemes,
+record readers); this module aggregates those registries behind one `get/
+available` surface and adds config-driven loading — `plugins.modules=a.b,c.d`
+imports each module, which registers its factories as a side effect (the
+import-as-installation analog of the reference's plugin classloader dirs).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict, List
+
+# kind -> accessor functions over the owning SPI registry
+STREAM = "stream"
+DECODER = "decoder"
+FS = "fs"
+READER = "reader"
+
+
+def _stream_registry() -> Dict[str, Any]:
+    from .ingest import stream
+    return stream._FACTORIES
+
+
+def _decoder_registry() -> Dict[str, Any]:
+    from .ingest import stream
+    return stream._DECODERS
+
+
+def _fs_registry() -> Dict[str, Any]:
+    from .cluster import deepstore
+    return deepstore._FS_REGISTRY
+
+
+def _reader_registry() -> Dict[str, Any]:
+    from .ingest import readers
+    return readers._READERS
+
+
+_REGISTRIES: Dict[str, Callable[[], Dict[str, Any]]] = {
+    STREAM: _stream_registry,
+    DECODER: _decoder_registry,
+    FS: _fs_registry,
+    READER: _reader_registry,
+}
+
+# modules whose import registers built-in plugins lazily (reference: the
+# always-on plugins shipped inside pinot-plugins/)
+_BUILTIN_MODULES = ["pinot_tpu.ingest.kafkalite"]
+_loaded_builtins = False
+
+
+def _ensure_builtins() -> None:
+    global _loaded_builtins
+    if not _loaded_builtins:
+        for mod in _BUILTIN_MODULES:
+            importlib.import_module(mod)
+        _loaded_builtins = True
+
+
+def available(kind: str) -> List[str]:
+    """Registered plugin names for one SPI kind."""
+    _ensure_builtins()
+    reg = _REGISTRIES.get(kind)
+    if reg is None:
+        raise KeyError(f"unknown plugin kind {kind!r}; kinds: {sorted(_REGISTRIES)}")
+    return sorted(reg())
+
+
+def get(kind: str, name: str) -> Any:
+    """The registered factory/class for (kind, name)."""
+    _ensure_builtins()
+    reg = _REGISTRIES.get(kind)
+    if reg is None:
+        raise KeyError(f"unknown plugin kind {kind!r}; kinds: {sorted(_REGISTRIES)}")
+    entry = reg().get(name)
+    if entry is None:
+        raise KeyError(f"no {kind} plugin named {name!r}; "
+                       f"available: {sorted(reg())}")
+    return entry
+
+
+def load_modules(modules: List[str]) -> List[str]:
+    """Import external plugin modules; each registers itself into its SPI
+    registry at import time. Returns the imported module names."""
+    out = []
+    for mod in modules:
+        importlib.import_module(mod)
+        out.append(mod)
+    return out
+
+
+def load_from_config(cfg) -> List[str]:
+    """`plugins.modules` (comma list) from a Configuration."""
+    return load_modules(cfg.get_list("plugins.modules"))
+
+
+def inventory() -> Dict[str, List[str]]:
+    """{kind: [names]} across every SPI — the admin/debug surface."""
+    return {kind: available(kind) for kind in _REGISTRIES}

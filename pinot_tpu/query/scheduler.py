@@ -161,6 +161,20 @@ class QueryScheduler:
         self._pool.shutdown(wait=False)
 
 
+def scheduler_from_config(cfg) -> Optional["QueryScheduler"]:
+    """Build a QueryScheduler from a Configuration's `server.scheduler.*` keys
+    (reference: pinot.query.scheduler.* configs consumed by QuerySchedulerFactory);
+    returns None when admission control is disabled (the default)."""
+    if not cfg.get_bool("server.scheduler.enabled", False):
+        return None
+    return QueryScheduler(
+        max_concurrent=cfg.get_int("server.scheduler.max.concurrent", 4),
+        max_pending=cfg.get_int("server.scheduler.max.pending", 32),
+        default_timeout_s=cfg.get_float("server.scheduler.timeout.seconds", 60.0),
+        per_table_share=cfg.get_float("server.scheduler.table.share", 1.0),
+    )
+
+
 class TokenBucket:
     """Classic token bucket (reference: HitCounter-based QPS tracking in
     QueryQuotaManager; a bucket gives the same steady rate + burst semantics)."""
