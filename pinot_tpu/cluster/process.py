@@ -158,6 +158,21 @@ class ControllerClient:
     def table_status(self, table: str) -> Dict:
         return get_json(f"{self.url}/tableStatus/{table}")
 
+    def list_tables(self) -> Dict:
+        return get_json(f"{self.url}/tables")
+
+    def table_config(self, table: str) -> Dict:
+        return get_json(f"{self.url}/tables/{table}")
+
+    def segments_meta(self, table: str) -> Dict:
+        return get_json(f"{self.url}/segmentsMeta/{table}")
+
+    def reload_table(self, table: str) -> Dict:
+        return post_json(f"{self.url}/reload/{table}", {})
+
+    def rebalance(self, table: str) -> Dict:
+        return post_json(f"{self.url}/rebalance/{table}", {})
+
 
 class BrokerClient:
     def __init__(self, url: str):

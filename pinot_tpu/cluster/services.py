@@ -76,6 +76,11 @@ class ControllerService:
         s.route("GET", "deepstore", self._deepstore_get)
         s.route("POST", "deepstore", self._deepstore_post)
         s.route("GET", "tableStatus", self._table_status)
+        s.route("GET", "tables", self._get_tables)
+        s.route("GET", "schemas", self._get_schema)
+        s.route("GET", "segmentsMeta", self._segments_meta)
+        s.route("POST", "reload", self._reload_table)
+        s.route("POST", "rebalance", self._rebalance)
         s.route("GET", "metrics", _metrics_route)
         self.http.start()
 
@@ -183,6 +188,50 @@ class ControllerService:
 
     def _table_status(self, parts, params, body):
         return json_response(self.controller.table_status(parts[0]))
+
+    # -- admin/read APIs (reference: PinotTableRestletResource et al.) -------
+    # reads snapshot under catalog._lock: handlers run on concurrent HTTP
+    # threads while writers mutate the same dicts in place (same discipline as
+    # _catalog_get above)
+    def _get_tables(self, parts, params, body):
+        with self.catalog._lock:
+            if parts:  # GET /tables/{nameWithType} -> the table config
+                cfg = self.catalog.table_configs.get(parts[0])
+                resp = None if cfg is None else {"config": cfg.to_json()}
+            else:
+                resp = {"tables": sorted(self.catalog.table_configs)}
+        if resp is None:
+            return error_response(f"unknown table {parts[0]}", 404)
+        return json_response(resp)
+
+    def _get_schema(self, parts, params, body):
+        with self.catalog._lock:
+            schema = self.catalog.schemas.get(parts[0]) if parts else None
+            resp = schema.to_json() if schema is not None else None
+        if resp is None:
+            return error_response(f"unknown schema {parts[0] if parts else ''}", 404)
+        return json_response(resp)
+
+    def _segments_meta(self, parts, params, body):
+        """GET /segmentsMeta/{tableNameWithType} — per-segment metadata list."""
+        table = parts[0]
+        with self.catalog._lock:
+            segs = self.catalog.segments.get(table)
+            resp = None if segs is None else \
+                {"segments": {s: m.to_json() for s, m in segs.items()}}
+        if resp is None:
+            return error_response(f"unknown table {table}", 404)
+        return json_response(resp)
+
+    def _reload_table(self, parts, params, body):
+        if parts[0] not in self.catalog.table_configs:
+            return error_response(f"unknown table {parts[0]}", 404)
+        self.controller.reload_table(parts[0])
+        return json_response({"status": "OK", "table": parts[0]})
+
+    def _rebalance(self, parts, params, body):
+        moves = self.controller.rebalance(parts[0])
+        return json_response({"status": "OK", "idealState": moves})
 
     # -- segment completion protocol ----------------------------------------
     def _segment_consumed(self, parts, params, body):

@@ -1,0 +1,222 @@
+"""pinot-tpu admin CLI: operate a cluster without writing Python.
+
+Analog of the reference's `pinot-admin.sh` command surface
+(`pinot-tools/src/main/java/org/apache/pinot/tools/admin/PinotAdministrator.java`):
+role starters, schema/table management, segment push, queries, and segment
+tools, all against the controller/broker HTTP APIs.
+
+    python -m pinot_tpu.tools.admin start-controller --work-dir /data --run-dir /run
+    python -m pinot_tpu.tools.admin add-schema    --controller URL --file schema.json
+    python -m pinot_tpu.tools.admin add-table     --controller URL --file table.json
+    python -m pinot_tpu.tools.admin list-tables   --controller URL
+    python -m pinot_tpu.tools.admin upload-segment --controller URL --table t_OFFLINE --dir seg/
+    python -m pinot_tpu.tools.admin build-segment --schema schema.json --input rows.json \\
+                                                  --out dir --name seg_0
+    python -m pinot_tpu.tools.admin query         --broker URL --sql "SELECT ..."
+    python -m pinot_tpu.tools.admin table-status  --controller URL --table t_OFFLINE
+    python -m pinot_tpu.tools.admin reload-table  --controller URL --table t_OFFLINE
+    python -m pinot_tpu.tools.admin dump-segment  --dir seg/
+    python -m pinot_tpu.tools.admin verify-segment --dir seg/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Optional, Sequence
+
+
+def _print(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def _controller(args):
+    from ..cluster.process import ControllerClient
+    return ControllerClient(args.controller)
+
+
+def cmd_start_role(args) -> int:
+    from ..cluster import process
+    if args.cmd == "start-controller":
+        process.run_controller(args.work_dir, args.run_dir, args.port, args.config)
+    elif args.cmd == "start-server":
+        process.run_server(args.controller, args.instance_id or "server_0",
+                           args.work_dir, args.run_dir, args.port, args.config)
+    else:
+        process.run_broker(args.controller, args.instance_id or "broker_0",
+                           args.run_dir, args.port, args.config)
+    return 0
+
+
+def cmd_add_schema(args) -> int:
+    from ..schema import Schema
+    with open(args.file) as f:
+        schema = Schema.from_json(json.load(f))
+    _controller(args).add_schema(schema)
+    _print({"status": "OK", "schema": schema.name})
+    return 0
+
+
+def cmd_add_table(args) -> int:
+    from ..table import TableConfig
+    with open(args.file) as f:
+        cfg = TableConfig.from_json(json.load(f))
+    resp = _controller(args).add_table(cfg, num_partitions=args.num_partitions)
+    _print(resp)
+    return 0
+
+
+def cmd_list_tables(args) -> int:
+    _print(_controller(args).list_tables())
+    return 0
+
+
+def cmd_table_status(args) -> int:
+    _print(_controller(args).table_status(args.table))
+    return 0
+
+
+def cmd_upload_segment(args) -> int:
+    _print(_controller(args).upload_segment(args.table, args.dir))
+    return 0
+
+
+def cmd_build_segment(args) -> int:
+    """Build a segment from a JSON-lines (or CSV) file + schema json
+    (reference: CreateSegmentCommand)."""
+    from ..ingest.readers import reader_for
+    from ..schema import Schema
+    from ..segment.writer import SegmentBuilder, SegmentGeneratorConfig
+    with open(args.schema) as f:
+        schema = Schema.from_json(json.load(f))
+    rows = list(reader_for(args.input, args.format or None).rows())
+    cols = {c: [r.get(c) for r in rows] for c in schema.column_names}
+    path = SegmentBuilder(schema, SegmentGeneratorConfig()).build(
+        cols, args.out, args.name)
+    _print({"status": "OK", "segmentDir": path, "rows": len(rows)})
+    return 0
+
+
+def cmd_reload_table(args) -> int:
+    _print(_controller(args).reload_table(args.table))
+    return 0
+
+
+def cmd_query(args) -> int:
+    from ..cluster.process import BrokerClient
+    resp = BrokerClient(args.broker).query(args.sql)
+    if args.json:
+        _print(resp)
+        return 0
+    table = resp.get("resultTable", {})
+    names = table.get("dataSchema", {}).get("columnNames", [])
+    rows = table.get("rows", [])
+    if names:
+        print("\t".join(map(str, names)))
+    for row in rows:
+        print("\t".join(map(str, row)))
+    stats = {k: v for k, v in resp.items() if k != "resultTable"}
+    print(f"-- {len(rows)} rows, {json.dumps(stats, default=str)}", file=sys.stderr)
+    return 0
+
+
+def cmd_dump_segment(args) -> int:
+    from .segment import dump_segment
+    _print(dump_segment(args.dir, max_rows=args.rows))
+    return 0
+
+
+def cmd_verify_segment(args) -> int:
+    from .segment import verify_segment
+    report = verify_segment(args.dir)
+    _print(report)
+    return 0 if report["ok"] else 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pinot-tpu-admin", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def role(name):
+        sp = sub.add_parser(name)
+        # only the controller bootstraps without a controller URL
+        sp.add_argument("--controller", required=(name != "start-controller"),
+                        default="")
+        sp.add_argument("--instance-id", default="")
+        sp.add_argument("--work-dir", default="")
+        sp.add_argument("--run-dir", required=True)
+        sp.add_argument("--port", type=int, default=0)
+        sp.add_argument("--config", default="")
+        sp.set_defaults(fn=cmd_start_role)
+    role("start-controller")
+    role("start-server")
+    role("start-broker")
+
+    sp = sub.add_parser("add-schema")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--file", required=True)
+    sp.set_defaults(fn=cmd_add_schema)
+
+    sp = sub.add_parser("add-table")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--file", required=True)
+    sp.add_argument("--num-partitions", type=int, default=1)
+    sp.set_defaults(fn=cmd_add_table)
+
+    sp = sub.add_parser("list-tables")
+    sp.add_argument("--controller", required=True)
+    sp.set_defaults(fn=cmd_list_tables)
+
+    sp = sub.add_parser("table-status")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.set_defaults(fn=cmd_table_status)
+
+    sp = sub.add_parser("upload-segment")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--dir", required=True)
+    sp.set_defaults(fn=cmd_upload_segment)
+
+    sp = sub.add_parser("build-segment")
+    sp.add_argument("--schema", required=True)
+    sp.add_argument("--input", required=True)
+    sp.add_argument("--format", default="")
+    sp.add_argument("--out", required=True)
+    sp.add_argument("--name", required=True)
+    sp.set_defaults(fn=cmd_build_segment)
+
+    sp = sub.add_parser("reload-table")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.set_defaults(fn=cmd_reload_table)
+
+    sp = sub.add_parser("query")
+    sp.add_argument("--broker", required=True)
+    sp.add_argument("--sql", required=True)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("dump-segment")
+    sp.add_argument("--dir", required=True)
+    sp.add_argument("--rows", type=int, default=10)
+    sp.set_defaults(fn=cmd_dump_segment)
+
+    sp = sub.add_parser("verify-segment")
+    sp.add_argument("--dir", required=True)
+    sp.set_defaults(fn=cmd_verify_segment)
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    # die quietly when the downstream pipe closes (e.g. `... | head`)
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    sys.exit(main())
