@@ -66,14 +66,7 @@ class QuickCluster:
         self._seg_seq[table] = seq + 1
         name = segment_name or f"{table_config.name}_{seq}"
         idx = table_config.indexing
-        builder = SegmentBuilder(schema, SegmentGeneratorConfig(
-            no_dictionary_columns=list(idx.no_dictionary_columns),
-            inverted_index_columns=list(idx.inverted_index_columns),
-            range_index_columns=list(idx.range_index_columns),
-            bloom_filter_columns=list(idx.bloom_filter_columns),
-            json_index_columns=list(idx.json_index_columns),
-            text_index_columns=list(idx.text_index_columns),
-        ))
+        builder = SegmentBuilder(schema, SegmentGeneratorConfig.from_indexing(idx))
         build_dir = os.path.join(self.work_dir, "build")
         seg_dir = builder.build(columns, build_dir, name)
         self.controller.upload_segment(table, seg_dir)

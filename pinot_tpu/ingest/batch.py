@@ -54,12 +54,7 @@ def run_batch_ingestion(spec: BatchIngestionJobSpec, controller, *,
     os.makedirs(build_dir, exist_ok=True)
 
     idx = table_cfg.indexing
-    gen_cfg = SegmentGeneratorConfig(
-        no_dictionary_columns=list(idx.no_dictionary_columns),
-        inverted_index_columns=list(idx.inverted_index_columns),
-        range_index_columns=list(idx.range_index_columns),
-        bloom_filter_columns=list(idx.bloom_filter_columns),
-    )
+    gen_cfg = SegmentGeneratorConfig.from_indexing(idx)
 
     def read_one(path: str) -> List[Dict[str, Any]]:
         reader = reader_for(path, spec.input_format)

@@ -186,13 +186,9 @@ class RealtimePartitionConsumer:
 
     def build_immutable(self) -> str:
         """Convert mutable -> immutable on disk (reference: RealtimeSegmentConverter)."""
-        idx = self.table_cfg.indexing
-        builder = SegmentBuilder(self.schema, SegmentGeneratorConfig(
-            no_dictionary_columns=list(idx.no_dictionary_columns),
-            inverted_index_columns=list(idx.inverted_index_columns),
-            range_index_columns=list(idx.range_index_columns),
-            bloom_filter_columns=list(idx.bloom_filter_columns),
-        ))
+        builder = SegmentBuilder(
+            self.schema,
+            SegmentGeneratorConfig.from_indexing(self.table_cfg.indexing))
         return builder.build(self.mutable.snapshot_columns(),
                              os.path.join(self.data_dir, "realtime_build"),
                              self.segment_name)

@@ -367,12 +367,7 @@ class BaseMergeExecutor(TaskExecutor):
     def _generator_config(cfg: TableConfig):
         from ..segment.writer import SegmentGeneratorConfig
         idx = cfg.indexing
-        return SegmentGeneratorConfig(
-            no_dictionary_columns=list(idx.no_dictionary_columns),
-            inverted_index_columns=list(idx.inverted_index_columns),
-            range_index_columns=list(idx.range_index_columns),
-            bloom_filter_columns=list(idx.bloom_filter_columns),
-        )
+        return SegmentGeneratorConfig.from_indexing(idx)
 
     def _processor_config(self, spec: TaskSpec, cfg: TableConfig,
                           prefix: str) -> ProcessorConfig:

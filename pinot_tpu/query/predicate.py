@@ -222,10 +222,43 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
             raise QueryValidationError(f"{name.upper()}: {exc}") from exc
         leaves.append(DocSetLeaf(col.name, query, mask))
         return ("leaf", len(leaves) - 1)
+    geo = _try_geo_predicate(e, seg, leaves)
+    if geo is not None:
+        return geo
+    if name in ("stwithin", "stcontains", "stequals"):
+        # boolean geo function used directly as a predicate -> compare to true
+        return _compile_predicate(Function("eq", (e, Literal(1))), seg, leaves)
     return _compile_predicate(e, seg, leaves)
 
 
+def _try_geo_predicate(e: Function, seg: ImmutableSegment,
+                       leaves: List[Leaf]):
+    """`ST_DISTANCE(ST_POINT(lngCol, latCol), <const point>) < r`:
+    geo-cell-index candidate mask (when the segment has one for the column
+    pair) ANDed with the exact haversine compare — the H3 coarse-cover +
+    exact-refine pattern (reference: H3IndexFilterOperator). Without an index
+    the predicate still compiles: the rewrite below turns it into elementwise
+    device math."""
+    from ..engine.geo_fns import distance_predicate_parts
+    parts = distance_predicate_parts(e)
+    if parts is None:
+        return None
+    lng_col, lat_col, cx, cy, radius = parts
+    exact = _compile_predicate(e, seg, leaves)  # rewrites to haversine inside
+    geo_idx = None
+    getter = getattr(seg, "geo_index", None)
+    if getter is not None:
+        geo_idx = getter(lng_col, lat_col)
+    if geo_idx is None:
+        return exact
+    mask = geo_idx.candidate_mask(cx, cy, radius, seg.num_docs)
+    leaves.append(DocSetLeaf(f"{lng_col},{lat_col}",
+                             f"geo cells r={radius:g}m", mask))
+    return ("and", (("leaf", len(leaves) - 1), exact))
+
+
 def _compile_predicate(e: Function, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterTree:
+    from ..engine.geo_fns import rewrite_geo
     lhs = e.args[0]
     rhs = list(e.args[1:])
     # normalize `literal op column` to `column op' literal`
@@ -234,6 +267,9 @@ def _compile_predicate(e: Function, seg: ImmutableSegment, leaves: List[Leaf]) -
         if e.name in flip:
             lhs, rhs = rhs[0], [lhs]
             e = Function(flip[e.name], (lhs, *rhs))
+    # AFTER the flip, so `r > stdistance(...)` rewrites too:
+    # distance-over-columns -> elementwise device haversine
+    lhs = rewrite_geo(lhs)
     if not all(isinstance(r, Literal) for r in rhs):
         raise QueryValidationError(f"predicate operands must be literals: {e!r}")
     values = [r.value for r in rhs]

@@ -167,6 +167,24 @@ class ImmutableSegment:
         from .startree import load_star_trees
         return load_star_trees(self)
 
+    def geo_index(self, lng_col: str, lat_col: str):
+        """GeoIndexReader for a (lng, lat) column pair, or None (H3 analog)."""
+        key = ("geo", lng_col, lat_col)
+        if not hasattr(self, "_geo_cache"):
+            self._geo_cache = {}
+        if key not in self._geo_cache:
+            reader = None
+            for g in self.metadata.get("geoIndexes", []):
+                if g["lngColumn"] == lng_col and g["latColumn"] == lat_col:
+                    from .indexes.geo import GeoIndexReader, geo_index_path
+                    path = geo_index_path(
+                        os.path.join(self.path, fmt.COLS_DIR, ""),
+                        lng_col, lat_col)
+                    reader = GeoIndexReader(path)
+                    break
+            self._geo_cache[key] = reader
+        return self._geo_cache[key]
+
     def __repr__(self) -> str:
         return f"ImmutableSegment({self.name!r}, docs={self.num_docs})"
 

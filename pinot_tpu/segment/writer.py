@@ -39,6 +39,25 @@ class SegmentGeneratorConfig:
     raw_cardinality_fraction: float = 0.7
     # star-tree pre-aggregation configs (segment/startree.py StarTreeIndexConfig)
     star_tree_configs: List["StarTreeIndexConfig"] = field(default_factory=list)
+    # geo cell indexes over (lngColumn, latColumn) pairs — "lng,lat" strings
+    # (reference: H3 index config on a geometry column; see indexes/geo.py)
+    geo_index_pairs: List[str] = field(default_factory=list)
+    geo_resolution_deg: float = 0.1
+
+    @staticmethod
+    def from_indexing(idx) -> "SegmentGeneratorConfig":
+        """The ONE IndexingConfig -> SegmentGeneratorConfig mapping, shared by
+        every segment-producing path (batch, realtime flush, minion merge,
+        quickstart) so a new index type cannot silently drop from one of them."""
+        return SegmentGeneratorConfig(
+            no_dictionary_columns=list(idx.no_dictionary_columns),
+            inverted_index_columns=list(idx.inverted_index_columns),
+            range_index_columns=list(idx.range_index_columns),
+            bloom_filter_columns=list(idx.bloom_filter_columns),
+            json_index_columns=list(getattr(idx, "json_index_columns", [])),
+            text_index_columns=list(getattr(idx, "text_index_columns", [])),
+            geo_index_pairs=list(getattr(idx, "geo_index_pairs", [])),
+        )
 
 
 class SegmentBuilder:
@@ -78,6 +97,29 @@ class SegmentBuilder:
             fixed = (fixed_dictionaries or {}).get(spec.name)
             col_meta[spec.name] = self._write_column(cols_dir, spec, raw, num_docs, fixed)
 
+        geo_meta = []
+        for pair in self.config.geo_index_pairs:
+            lng_col, lat_col = [c.strip() for c in pair.split(",")]
+            from .indexes.geo import create_geo_index, geo_index_path
+
+            def coord(col: str) -> np.ndarray:
+                # index the SAME values the column stores: nulls become the
+                # spec's null fill, exactly like _write_column — an index over
+                # raw (None->NaN) input would bucket null rows differently
+                # from the stored coordinates and break the superset invariant
+                spec = self.schema.field_spec(col)
+                raw = columns.get(col)
+                vals = ([spec.null_value] * num_docs if raw is None else
+                        [spec.null_value if v is None else v for v in raw])
+                return np.asarray(vals, dtype=np.float64)
+
+            create_geo_index(geo_index_path(os.path.join(cols_dir, ""),
+                                            lng_col, lat_col),
+                             coord(lng_col), coord(lat_col),
+                             self.config.geo_resolution_deg)
+            geo_meta.append({"lngColumn": lng_col, "latColumn": lat_col,
+                             "resolution": self.config.geo_resolution_deg})
+
         meta = {
             "formatVersion": fmt.FORMAT_VERSION,
             "segmentName": segment_name,
@@ -86,6 +128,8 @@ class SegmentBuilder:
             "schema": self.schema.to_json(),
             "columns": col_meta,
         }
+        if geo_meta:
+            meta["geoIndexes"] = geo_meta
         if extra_metadata:
             meta.update(extra_metadata)
         fmt.write_json(os.path.join(seg_dir, fmt.SEGMENT_METADATA_FILE), meta)

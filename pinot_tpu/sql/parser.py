@@ -334,7 +334,12 @@ class Parser:
         if not self.accept_op(")"):
             args = tuple(self._expr_list())
             self.expect_op(")")
-        return Function(name.lower(), args, distinct=distinct)
+        name = name.lower()
+        if name.startswith("st_"):
+            # geospatial canonicalization: ST_Point / ST_DISTANCE / ST_AsText
+            # -> stpoint / stdistance / stastext (the registry spelling)
+            name = "st" + name[3:]
+        return Function(name, args, distinct=distinct)
 
     def _case(self) -> Expr:
         """CASE [operand] WHEN .. THEN .. [ELSE ..] END -> case(w1,t1,...,wn,tn,else)."""

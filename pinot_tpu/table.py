@@ -30,6 +30,7 @@ class IndexingConfig:
     text_index_columns: List[str] = field(default_factory=list)
     sorted_column: Optional[str] = None
     star_tree_configs: List[Dict[str, Any]] = field(default_factory=list)
+    geo_index_pairs: List[str] = field(default_factory=list)  # "lngCol,latCol"
 
     def to_json(self):
         return {
@@ -41,6 +42,7 @@ class IndexingConfig:
             "textIndexColumns": self.text_index_columns,
             "sortedColumn": self.sorted_column,
             "starTreeIndexConfigs": self.star_tree_configs,
+            "geoIndexPairs": self.geo_index_pairs,
         }
 
     @staticmethod
@@ -54,6 +56,7 @@ class IndexingConfig:
             text_index_columns=d.get("textIndexColumns", []),
             sorted_column=d.get("sortedColumn"),
             star_tree_configs=d.get("starTreeIndexConfigs", []),
+            geo_index_pairs=d.get("geoIndexPairs", []),
         )
 
 

@@ -1,0 +1,219 @@
+"""Geospatial tests: ST_* functions, device haversine rewrite, geo cell index.
+
+Reference patterns: StDistanceFunctionTest / StContainsFunctionTest +
+H3IndexFilterOperator (coarse cell cover + exact refine).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.geo_fns import (GeoPolygon, haversine_m, parse_wkt,
+                                      rewrite_geo)
+from pinot_tpu.query.executor import ServerQueryExecutor, execute_query
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+# well-known distances: SFO (-122.375, 37.619), LAX (-118.408, 33.9425)
+SFO = (-122.375, 37.619)
+LAX = (-118.408, 33.9425)
+SFO_LAX_M = 543_000  # ~543 km
+
+
+N = 2000
+RNG = np.random.default_rng(9)
+LNG = RNG.uniform(-123.0, -118.0, N)
+LAT = RNG.uniform(33.0, 38.5, N)
+
+SCHEMA = Schema("places", [
+    dimension("name", DataType.STRING),
+    metric("lng", DataType.DOUBLE),
+    metric("lat", DataType.DOUBLE),
+])
+COLS = {"name": [f"p{i}" for i in range(N)], "lng": LNG, "lat": LAT}
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("geo")
+    return load_segment(SegmentBuilder(SCHEMA, SegmentGeneratorConfig())
+                        .build(dict(COLS), str(tmp), "places_0"))
+
+
+@pytest.fixture(scope="module")
+def seg_indexed(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("geoidx")
+    cfg = SegmentGeneratorConfig(geo_index_pairs=["lng,lat"])
+    return load_segment(SegmentBuilder(SCHEMA, cfg)
+                        .build(dict(COLS), str(tmp), "places_idx"))
+
+
+# -- function library ---------------------------------------------------------
+
+def test_wkt_roundtrip():
+    p = parse_wkt("POINT (-122.375 37.619)")
+    assert p == complex(-122.375, 37.619)
+    poly = parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))")
+    assert isinstance(poly, GeoPolygon)
+    assert poly.contains(2, 2) and not poly.contains(5, 1)
+
+
+def test_haversine_known_distance():
+    d = haversine_m(*SFO, *LAX)
+    assert d == pytest.approx(SFO_LAX_M, rel=0.01)
+
+
+def test_st_functions_in_selection(seg):
+    res = execute_query(
+        [seg], "SELECT name, ST_DISTANCE(ST_POINT(lng, lat), "
+               "ST_GEOGFROMTEXT('POINT (-122.375 37.619)')) FROM places "
+               "ORDER BY name LIMIT 3")
+    exp = haversine_m(LNG, LAT, *SFO)
+    by_name = {f"p{i}": exp[i] for i in range(N)}
+    for name, d in res.rows:
+        assert d == pytest.approx(by_name[name], rel=1e-6)
+    res = execute_query(
+        [seg], "SELECT ST_ASTEXT(ST_POINT(lng, lat)), ST_X(ST_POINT(lng, lat)) "
+               "FROM places LIMIT 1")
+    assert res.rows[0][0].startswith("POINT (")
+    assert res.rows[0][1] == pytest.approx(LNG[0])
+
+
+def test_rewrite_produces_device_plan(seg):
+    """The distance predicate must compile onto the fused device kernel."""
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    sql = ("SELECT COUNT(*) FROM places WHERE "
+           "ST_DISTANCE(ST_POINT(lng, lat), ST_POINT(-122.375, 37.619)) < 100000")
+    ctx = compile_query(sql, SCHEMA)
+    plan = plan_segment(ctx, seg)
+    assert plan.kind == "device", plan.fallback_reason
+
+
+@pytest.mark.parametrize("radius", [50_000, 200_000, 500_000])
+def test_distance_filter_device_host_parity(seg, radius):
+    sql = (f"SELECT COUNT(*) FROM places WHERE ST_DISTANCE(ST_POINT(lng, lat), "
+           f"ST_GEOGFROMTEXT('POINT (-122.375 37.619)')) < {radius}")
+    dev = ServerQueryExecutor(use_device=True).execute([seg], sql).rows[0][0]
+    host = ServerQueryExecutor(use_device=False).execute([seg], sql).rows[0][0]
+    exact = int((haversine_m(LNG, LAT, *SFO) < radius).sum())
+    assert host == exact
+    # f32 trig on device may flip docs within ~1e-4 relative of the boundary
+    assert abs(dev - exact) <= max(2, int(0.002 * exact))
+
+
+def test_polygon_contains_filter(seg):
+    sql = ("SELECT COUNT(*) FROM places WHERE ST_CONTAINS("
+           "ST_GEOGFROMTEXT('POLYGON ((-123 36, -120 36, -120 38, -123 38, -123 36))'), "
+           "ST_POINT(lng, lat))")
+    got = execute_query([seg], sql).rows[0][0]
+    exact = int(((LNG >= -123) & (LNG <= -120) & (LAT >= 36) & (LAT <= 38)).sum())
+    assert got == exact
+    # ST_WITHIN is the flipped-argument equivalent
+    sql2 = ("SELECT COUNT(*) FROM places WHERE ST_WITHIN(ST_POINT(lng, lat), "
+            "ST_GEOGFROMTEXT('POLYGON ((-123 36, -120 36, -120 38, -123 38, -123 36))'))")
+    assert execute_query([seg], sql2).rows[0][0] == exact
+
+
+# -- geo cell index -----------------------------------------------------------
+
+def test_geo_index_candidates_superset(seg_indexed):
+    idx = seg_indexed.geo_index("lng", "lat")
+    assert idx is not None
+    for radius in (20_000, 100_000):
+        mask = idx.candidate_mask(*SFO, radius, N)
+        exact = haversine_m(LNG, LAT, *SFO) < radius
+        assert (mask | ~exact).all(), "candidates must be a superset"
+        assert mask.sum() < N, "cover must actually prune"
+
+
+def test_geo_index_query_matches_unindexed(seg, seg_indexed):
+    sql = ("SELECT COUNT(*) FROM places WHERE ST_DISTANCE(ST_POINT(lng, lat), "
+           "ST_POINT(-122.375, 37.619)) < 150000")
+    host_plain = ServerQueryExecutor(use_device=False).execute([seg], sql).rows
+    host_idx = ServerQueryExecutor(use_device=False).execute([seg_indexed], sql).rows
+    assert host_plain == host_idx
+    dev_idx = ServerQueryExecutor(use_device=True).execute([seg_indexed], sql).rows
+    assert abs(dev_idx[0][0] - host_idx[0][0]) <= 2
+
+
+def test_geo_index_in_explain(seg_indexed):
+    res = execute_query(
+        [seg_indexed], "EXPLAIN PLAN FOR SELECT COUNT(*) FROM places WHERE "
+        "ST_DISTANCE(ST_POINT(lng, lat), ST_POINT(-122.375, 37.619)) < 50000")
+    ls = [r[0] for r in res.rows]
+    assert any("FILTER_DOCSET" in l and "geo cells" in l for l in ls)
+    assert any("FILTER_EXPR" in l for l in ls)
+
+
+def test_geo_index_antimeridian_and_poles(tmp_path):
+    """Cells wrap at lng ±180 and clamp at lat ±90 — the superset invariant
+    must hold at the globe's seams."""
+    lng = np.array([-179.95, 179.95, 10.0, 0.0])
+    lat = np.array([0.0, 0.0, 90.0, -90.0])
+    cols = {"name": ["a", "b", "c", "d"], "lng": lng, "lat": lat}
+    cfg = SegmentGeneratorConfig(geo_index_pairs=["lng,lat"])
+    seg = load_segment(SegmentBuilder(SCHEMA, cfg).build(
+        cols, str(tmp_path), "seam_0"))
+    idx = seg.geo_index("lng", "lat")
+    # circle centered just east of the date line must reach the western doc
+    mask = idx.candidate_mask(179.95, 0.0, 30_000, 4)
+    exact = haversine_m(lng, lat, 179.95, 0.0) < 30_000
+    assert (mask | ~exact).all()
+    assert mask[0] and mask[1]
+    # pole doc reachable from a near-pole center
+    mask = idx.candidate_mask(10.0, 89.99, 50_000, 4)
+    exact = haversine_m(lng, lat, 10.0, 89.99) < 50_000
+    assert (mask | ~exact).all() and mask[2]
+
+
+def test_flipped_distance_predicate_uses_index_and_device(seg, seg_indexed):
+    """`r > ST_DISTANCE(...)` is the same predicate: device plan + geo docset."""
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    sql = ("SELECT COUNT(*) FROM places WHERE 100000 > "
+           "ST_DISTANCE(ST_POINT(lng, lat), ST_POINT(-122.375, 37.619))")
+    ctx = compile_query(sql, SCHEMA)
+    assert plan_segment(ctx, seg).kind == "device"
+    res = execute_query([seg_indexed], "EXPLAIN PLAN FOR " + sql)
+    assert any("geo cells" in r[0] for r in res.rows)
+    straight = execute_query(
+        [seg_indexed], sql.replace("100000 > ST_DISTANCE", "ST_DISTANCE")
+        .replace("37.619))", "37.619)) < 100000")).rows
+    assert execute_query([seg_indexed], sql).rows == straight
+
+
+def test_geo_index_null_coordinates(tmp_path):
+    """Null coordinates index under the stored null-fill values, keeping the
+    index consistent with the column (no dropped or phantom rows)."""
+    cols = {"name": ["a", "b"], "lng": [-122.0, None], "lat": [37.0, None]}
+    cfg = SegmentGeneratorConfig(geo_index_pairs=["lng,lat"])
+    seg = load_segment(SegmentBuilder(SCHEMA, cfg).build(
+        cols, str(tmp_path), "nulls_0"))
+    sql = ("SELECT COUNT(*) FROM places WHERE ST_DISTANCE(ST_POINT(lng, lat), "
+           "ST_POINT(-122.0, 37.0)) < 1000")
+    assert ServerQueryExecutor(use_device=False).execute([seg], sql).rows[0][0] == 1
+
+
+def test_geo_index_built_by_every_ingestion_path(tmp_path):
+    """Batch ingestion and realtime flush honor geo_index_pairs like quickstart."""
+    from pinot_tpu.segment.writer import SegmentGeneratorConfig as SGC
+    from pinot_tpu.table import IndexingConfig
+    idx = IndexingConfig(geo_index_pairs=["lng,lat"])
+    gen = SGC.from_indexing(idx)
+    assert gen.geo_index_pairs == ["lng,lat"]
+
+
+def test_geo_cluster_path(tmp_path):
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.table import IndexingConfig, TableConfig
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig("places",
+                      indexing=IndexingConfig(geo_index_pairs=["lng,lat"]))
+    cluster.create_table(SCHEMA, cfg)
+    cluster.ingest_columns(cfg, dict(COLS))
+    res = cluster.query(
+        "SELECT COUNT(*) FROM places WHERE ST_DISTANCE(ST_POINT(lng, lat), "
+        "ST_POINT(-122.375, 37.619)) < 100000")
+    exact = int((haversine_m(LNG, LAT, *SFO) < 100_000).sum())
+    assert abs(res.rows[0][0] - exact) <= 2
