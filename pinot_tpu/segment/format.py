@@ -45,6 +45,7 @@ COLS_DIR = "cols"
 STARTREE_DIR = "startree"
 
 FWD_SUFFIX = ".fwd.npy"
+FWD_COMPRESSED_SUFFIX = ".fwdc.bin"  # chunk-compressed raw forward index
 DICT_NUMERIC_SUFFIX = ".dict.npy"
 DICT_BLOB_SUFFIX = ".dict.blob"
 DICT_OFFSETS_SUFFIX = ".dictoff.npy"

@@ -46,7 +46,13 @@ class ColumnReader:
         """Dict ids (minimal-width uint) if dict-encoded, else raw values.
 
         Multi-value columns: the FLAT concatenated per-row value ids; row
-        boundaries come from `mv_offsets` (CSR layout, see writer._write_mv_column)."""
+        boundaries come from `mv_offsets` (CSR layout, see writer._write_mv_column).
+        Chunk-compressed raw columns decode through ChunkedArrayReader."""
+        if self.meta.get("compression"):
+            # the chunked reader IS the array surface: slices decode only the
+            # covering chunks, np.asarray() materializes (and caches) the rest
+            from .compression import ChunkedArrayReader
+            return ChunkedArrayReader(self._prefix + fmt.FWD_COMPRESSED_SUFFIX)
         return np.load(self._prefix + fmt.FWD_SUFFIX, mmap_mode="r")
 
     @cached_property

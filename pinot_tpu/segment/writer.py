@@ -43,6 +43,9 @@ class SegmentGeneratorConfig:
     # (reference: H3 index config on a geometry column; see indexes/geo.py)
     geo_index_pairs: List[str] = field(default_factory=list)
     geo_resolution_deg: float = 0.1
+    # chunk compression codec for raw (no-dictionary) forward indexes:
+    # "" = uncompressed npy; "zlib"/"lzma"/"passthrough" (compression.py)
+    raw_compression: str = ""
 
     @staticmethod
     def from_indexing(idx) -> "SegmentGeneratorConfig":
@@ -57,6 +60,7 @@ class SegmentGeneratorConfig:
             json_index_columns=list(getattr(idx, "json_index_columns", [])),
             text_index_columns=list(getattr(idx, "text_index_columns", [])),
             geo_index_pairs=list(getattr(idx, "geo_index_pairs", [])),
+            raw_compression=getattr(idx, "raw_compression", ""),
         )
 
 
@@ -229,7 +233,15 @@ class SegmentBuilder:
                 indexes.append("range")
         else:
             arr = np.asarray(raw, dtype=data_type.numpy_dtype)
-            np.save(prefix + fmt.FWD_SUFFIX, arr)
+            codec = self.config.raw_compression
+            if codec:
+                # chunk-compressed raw forward index (reference:
+                # ChunkCompressionType + the V4 chunk writers)
+                from .compression import write_chunked
+                write_chunked(prefix + fmt.FWD_COMPRESSED_SUFFIX, arr, codec)
+                meta["compression"] = codec
+            else:
+                np.save(prefix + fmt.FWD_SUFFIX, arr)
             meta.update({
                 "hasDictionary": False,
                 "cardinality": -1,

@@ -31,6 +31,7 @@ class IndexingConfig:
     sorted_column: Optional[str] = None
     star_tree_configs: List[Dict[str, Any]] = field(default_factory=list)
     geo_index_pairs: List[str] = field(default_factory=list)  # "lngCol,latCol"
+    raw_compression: str = ""  # chunk codec for raw fwd indexes (zlib/lzma)
 
     def to_json(self):
         return {
@@ -43,6 +44,7 @@ class IndexingConfig:
             "sortedColumn": self.sorted_column,
             "starTreeIndexConfigs": self.star_tree_configs,
             "geoIndexPairs": self.geo_index_pairs,
+            "rawCompression": self.raw_compression,
         }
 
     @staticmethod
@@ -57,6 +59,7 @@ class IndexingConfig:
             sorted_column=d.get("sortedColumn"),
             star_tree_configs=d.get("starTreeIndexConfigs", []),
             geo_index_pairs=d.get("geoIndexPairs", []),
+            raw_compression=d.get("rawCompression", ""),
         )
 
 

@@ -1,0 +1,125 @@
+"""Chunk compression tests: codec roundtrips, chunked range reads, writer/
+reader integration, query parity over compressed columns.
+
+Reference pattern: ChunkCompressorFactory tests + V4 forward index reader
+tests over each ChunkCompressionType.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.compression import (CODECS, ChunkedArrayReader,
+                                           write_chunked)
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+
+@pytest.mark.parametrize("codec", sorted(CODECS))
+def test_roundtrip_all_codecs(tmp_path, codec):
+    arr = np.arange(200_000, dtype=np.int64) % 1000
+    path = str(tmp_path / f"c_{codec}.bin")
+    write_chunked(path, arr, codec=codec, chunk_rows=4096)
+    r = ChunkedArrayReader(path)
+    assert len(r) == len(arr) and r.codec == codec
+    assert np.array_equal(r.array(), arr)
+    if codec != "passthrough":
+        assert os.path.getsize(path) < arr.nbytes // 4  # repetitive data shrinks
+
+
+def test_range_reads_cross_chunks(tmp_path):
+    arr = np.random.default_rng(1).random(10_000)
+    path = str(tmp_path / "r.bin")
+    write_chunked(path, arr, codec="zlib", chunk_rows=1000)
+    r = ChunkedArrayReader(path)
+    for lo, hi in [(0, 10), (995, 1005), (2999, 5001), (9990, 10_000),
+                   (0, 10_000), (5000, 5000)]:
+        assert np.array_equal(r.read_rows(lo, hi), arr[lo:hi]), (lo, hi)
+    # out-of-range clamps
+    assert np.array_equal(r.read_rows(-5, 3), arr[0:3])
+    assert len(r.read_rows(9_999, 20_000)) == 1
+
+
+def test_empty_and_single_chunk(tmp_path):
+    for arr in [np.empty(0, dtype=np.float32), np.array([7], dtype=np.int32)]:
+        path = str(tmp_path / f"e{len(arr)}.bin")
+        write_chunked(path, arr, codec="lzma")
+        r = ChunkedArrayReader(path)
+        assert np.array_equal(r.array(), arr)
+
+
+SCHEMA = Schema("m", [
+    dimension("k", DataType.STRING),
+    metric("v", DataType.DOUBLE),
+    metric("big", DataType.LONG),
+])
+
+
+@pytest.fixture(scope="module", params=["zlib", "lzma"])
+def seg_pair(tmp_path_factory, request):
+    """(compressed, uncompressed) segments with identical data; raw columns
+    forced via no_dictionary + high-cardinality values."""
+    tmp = tmp_path_factory.mktemp(f"comp_{request.param}")
+    rng = np.random.default_rng(3)
+    cols = {"k": [f"k{i % 50}" for i in range(20_000)],
+            "v": np.round(rng.random(20_000) * 100, 2),
+            "big": rng.integers(0, 1 << 30, 20_000, dtype=np.int64)}
+    plain = SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        no_dictionary_columns=["v", "big"])).build(dict(cols), str(tmp), "plain")
+    comp = SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        no_dictionary_columns=["v", "big"],
+        raw_compression=request.param)).build(dict(cols), str(tmp), "comp")
+    return load_segment(comp), load_segment(plain)
+
+
+def test_compressed_column_reads_identically(seg_pair):
+    comp, plain = seg_pair
+    for col in ("v", "big"):
+        assert comp.column(col).meta.get("compression")
+        assert np.array_equal(np.asarray(comp.column(col).fwd),
+                              np.asarray(plain.column(col).fwd))
+    # on-disk raw forward indexes are actually smaller
+    def raw_size(seg, suffixes):
+        cols_dir = os.path.join(seg.path, "cols")
+        return sum(os.path.getsize(os.path.join(cols_dir, f))
+                   for f in os.listdir(cols_dir)
+                   if any(f.endswith(s) for s in suffixes) and
+                   (f.startswith("v.") or f.startswith("big.")))
+    assert raw_size(comp, [".fwdc.bin"]) < raw_size(plain, [".fwd.npy"])
+
+
+@pytest.mark.parametrize("sql", [
+    "SELECT SUM(v), COUNT(*) FROM m WHERE big > 536870912",
+    "SELECT k, AVG(v) FROM m GROUP BY k ORDER BY k LIMIT 5",
+    "SELECT k, v FROM m WHERE v < 1 ORDER BY v LIMIT 5",
+])
+def test_query_parity_compressed_vs_plain(seg_pair, sql):
+    comp, plain = seg_pair
+    for use_device in (True, False):
+        ex = ServerQueryExecutor(use_device=use_device)
+        assert ex.execute([comp], sql).rows == ex.execute([plain], sql).rows
+
+
+def test_fwd_slicing_is_bounded(seg_pair):
+    """reader.fwd[:n] on a compressed column decodes only the covering chunks
+    (the dump tool's bounded-read contract)."""
+    comp, plain = seg_pair
+    comp = load_segment(comp.path)  # fresh readers: no cached full decode
+    r = comp.column("v").fwd
+    assert r._full is None
+    head = r[:7]
+    assert np.array_equal(head, np.asarray(plain.column("v").fwd)[:7])
+    assert r._full is None, "a head slice must not trigger a full decode"
+    # full materialization still works and caches
+    assert len(np.asarray(r)) == 20_000
+    assert r._full is not None
+
+
+def test_verify_segment_handles_compressed(seg_pair):
+    from pinot_tpu.tools.segment import verify_segment
+    comp, _ = seg_pair
+    report = verify_segment(comp.path)
+    assert report["ok"], report
