@@ -156,7 +156,9 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
     return QueryContext(
         table=stmt.table,
         select_items=select,
-        filter=stmt.where,
+        # AST-level filter rewrites (merge EQ->IN, range tightening, dedupe) —
+        # reference: core/query/optimizer/filter/ chain in BrokerRequestOptimizer
+        filter=_optimize_filter(stmt.where, schema),
         group_by=group_by,
         aggregations=aggregations,
         having=having,
@@ -214,3 +216,8 @@ def _default_name(e: Expr) -> str:
         d = "distinct " if e.distinct else ""
         return f"{e.name}({d}{inner})"
     return repr(e)
+
+
+def _optimize_filter(e, schema=None):
+    from .optimizer import optimize_filter
+    return optimize_filter(e, schema)

@@ -1,0 +1,193 @@
+"""AST-level filter optimizer: rewrites applied once at compile time.
+
+Analog of the reference's filter optimizer chain
+(`pinot-core/src/main/java/org/apache/pinot/core/query/optimizer/filter/`):
+
+* MergeEqInFilterOptimizer  — OR of EQ/IN on one column -> one IN (here: one
+  LUT leaf / id-interval set on the device, instead of N separate leaf masks)
+* MergeRangeFilterOptimizer — AND of ranges on one column -> one BETWEEN
+* IdenticalPredicateFilterOptimizer — duplicate subtrees collapse
+* FlattenAndOrFilterOptimizer — nested AND/OR flattening (predicate._simplify
+  also flattens during compile; flattening here lets the merges above see
+  siblings)
+
+Runs BEFORE per-segment predicate compilation, so every segment benefits and
+the rewritten tree is what EXPLAIN shows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..sql.ast import Expr, Function, Identifier, Literal
+
+_RANGE_OPS = {"gt", "gte", "lt", "lte", "between"}
+
+
+def optimize_filter(e: Optional[Expr], schema=None) -> Optional[Expr]:
+    if e is None or not isinstance(e, Function):
+        return e
+    return _dedupe(_merge(_flatten(e), schema))
+
+
+def _flatten(e: Expr) -> Expr:
+    if not isinstance(e, Function):
+        return e
+    args = tuple(_flatten(a) for a in e.args)
+    if e.name in ("and", "or"):
+        flat: List[Expr] = []
+        for a in args:
+            if isinstance(a, Function) and a.name == e.name:
+                flat.extend(a.args)
+            else:
+                flat.append(a)
+        return Function(e.name, tuple(flat))
+    return Function(e.name, args, e.distinct)
+
+
+def _eq_in_column(e: Expr) -> Optional[Tuple[str, List]]:
+    """(column, values) when e is EQ/IN over a plain column and literals."""
+    if isinstance(e, Function) and e.name in ("eq", "in") \
+            and isinstance(e.args[0], Identifier) \
+            and all(isinstance(a, Literal) for a in e.args[1:]):
+        return e.args[0].name, [a.value for a in e.args[1:]]
+    return None
+
+
+def _range_bounds(e: Expr):
+    """(column, lo, lo_inc, hi, hi_inc) for a range predicate over a column."""
+    if not (isinstance(e, Function) and e.name in _RANGE_OPS
+            and isinstance(e.args[0], Identifier)
+            and all(isinstance(a, Literal) for a in e.args[1:])):
+        return None
+    col = e.args[0].name
+    if e.name == "between":
+        return col, e.args[1].value, True, e.args[2].value, True
+    v = e.args[1].value
+    return {
+        "gt": (col, v, False, None, True),
+        "gte": (col, v, True, None, True),
+        "lt": (col, None, True, v, False),
+        "lte": (col, None, True, v, True),
+    }[e.name]
+
+
+def _merge(e: Expr, schema=None) -> Expr:
+    if not isinstance(e, Function):
+        return e
+    args = [_merge(a, schema) for a in e.args]
+
+    if e.name == "or":
+        # MergeEqInFilter: OR of EQ/IN per column -> one IN
+        by_col: Dict[str, List] = {}
+        rest: List[Expr] = []
+        for a in args:
+            hit = _eq_in_column(a)
+            if hit is not None:
+                by_col.setdefault(hit[0], []).extend(hit[1])
+            else:
+                rest.append(a)
+        for col, values in by_col.items():
+            uniq = list(dict.fromkeys(values))  # order-stable dedupe
+            if len(uniq) == 1:
+                rest.append(Function("eq", (Identifier(col), Literal(uniq[0]))))
+            else:
+                rest.append(Function("in", (Identifier(col),
+                                            *[Literal(v) for v in uniq])))
+        return rest[0] if len(rest) == 1 else Function("or", tuple(rest))
+
+    if e.name == "and":
+        # MergeRangeFilter: AND of ranges per column -> tightest single range.
+        # ONLY for provably single-value columns: an MV column's conjuncts use
+        # ANY-value semantics ("some value >= 5 AND some value <= 10" can be
+        # satisfied by DIFFERENT values), which a merged BETWEEN would break —
+        # the reference's MergeRangeFilterOptimizer has the same SV guard.
+        per_col: Dict[str, List] = {}
+        originals: Dict[str, List[Expr]] = {}
+        rest: List[Expr] = []
+        for a in args:
+            rb = _range_bounds(a)
+            if rb is None or not _mergeable_sv_column(rb[0], schema):
+                rest.append(a)
+            else:
+                per_col.setdefault(rb[0], []).append(rb[1:])
+                originals.setdefault(rb[0], []).append(a)
+        for col, items in per_col.items():
+            merged = _merge_range_items(col, items)
+            if merged is None:  # mixed literal type families: don't touch
+                rest.extend(originals[col])
+            else:
+                rest.append(merged)
+        return rest[0] if len(rest) == 1 else Function("and", tuple(rest))
+
+    return Function(e.name, tuple(args), e.distinct)
+
+
+def _value_family(v) -> Optional[str]:
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, (int, float)):
+        return "num"
+    if isinstance(v, str):
+        return "str"
+    return None
+
+
+def _merge_range_items(col: str, items: List[Tuple]) -> Optional[Expr]:
+    """Fold (lo, lo_inc, hi, hi_inc) conjuncts to the tightest range; None when
+    literal families mix (e.g. `v > 5 AND v > '3'`) — cross-type comparison
+    would raise, and the per-type normalization downstream already copes."""
+    fams = {_value_family(b) for lo, _, hi, _ in items
+            for b in (lo, hi) if b is not None}
+    if len(fams) != 1 or None in fams:
+        return None
+    key = (lambda v: float(v)) if fams == {"num"} else (lambda v: v)
+    lo = hi = None
+    lo_inc = hi_inc = True
+    for b_lo, b_lo_inc, b_hi, b_hi_inc in items:
+        if b_lo is not None:
+            if lo is None or key(b_lo) > key(lo):
+                lo, lo_inc = b_lo, b_lo_inc
+            elif key(b_lo) == key(lo):
+                lo_inc = lo_inc and b_lo_inc
+        if b_hi is not None:
+            if hi is None or key(b_hi) < key(hi):
+                hi, hi_inc = b_hi, b_hi_inc
+            elif key(b_hi) == key(hi):
+                hi_inc = hi_inc and b_hi_inc
+    return _range_expr(col, lo, lo_inc, hi, hi_inc)
+
+
+def _mergeable_sv_column(col: str, schema) -> bool:
+    """Range merge requires knowing the column is single-value."""
+    if schema is None or not schema.has_column(col):
+        return False
+    return schema.field_spec(col).single_value
+
+
+def _range_expr(col: str, lo, lo_inc: bool, hi, hi_inc: bool) -> Expr:
+    ident = Identifier(col)
+    if lo is not None and hi is not None and lo_inc and hi_inc:
+        return Function("between", (ident, Literal(lo), Literal(hi)))
+    parts: List[Expr] = []
+    if lo is not None:
+        parts.append(Function("gte" if lo_inc else "gt", (ident, Literal(lo))))
+    if hi is not None:
+        parts.append(Function("lte" if hi_inc else "lt", (ident, Literal(hi))))
+    if not parts:  # unbounded on both sides cannot happen (caller guards)
+        return Function("eq", (Literal(1), Literal(1)))
+    return parts[0] if len(parts) == 1 else Function("and", tuple(parts))
+
+
+def _dedupe(e: Expr) -> Expr:
+    """IdenticalPredicateFilter: equal siblings under AND/OR collapse to one."""
+    if not isinstance(e, Function):
+        return e
+    args = [_dedupe(a) for a in e.args]
+    if e.name in ("and", "or"):
+        seen = {}
+        for a in args:
+            seen.setdefault(repr(a), a)
+        uniq = list(seen.values())
+        return uniq[0] if len(uniq) == 1 else Function(e.name, tuple(uniq))
+    return Function(e.name, tuple(args), e.distinct)

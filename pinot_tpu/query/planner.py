@@ -161,6 +161,8 @@ def _fold_leaves(prog: FilterProgram, segment: ImmutableSegment) -> FilterProgra
                     return ("const", leaf.negated)
             if isinstance(leaf, CmpLeaf) and isinstance(leaf.expr, Identifier):
                 folded = _fold_cmp_minmax(leaf, segment)
+                if folded is None:
+                    folded = _fold_cmp_bloom(leaf, segment)
                 if folded is not None:
                     return ("const", folded)
             return node
@@ -210,6 +212,20 @@ def _fold_cmp_minmax(leaf: CmpLeaf, segment: ImmutableSegment):
             return True
         if hi < mn or lo > mx:
             return False
+    return None
+
+
+def _fold_cmp_bloom(leaf: CmpLeaf, segment: ImmutableSegment):
+    """EQ/IN on a raw column with a bloom filter: definitely-absent values fold
+    the leaf to constant false (reference: BloomFilterSegmentPruner runs this
+    server-side per segment, not just at routing)."""
+    if leaf.op not in ("eq", "in") or not leaf.operands:
+        return None
+    bloom = segment.column(leaf.expr.name).bloom_filter
+    if bloom is None:
+        return None
+    if all(not bloom.might_contain(v) for v in leaf.operands):
+        return False
     return None
 
 

@@ -1,0 +1,150 @@
+"""Filter optimizer tests: EQ/IN merge, range tightening, dedupe, bloom fold.
+
+Reference pattern: core/query/optimizer/filter/ optimizer unit tests
+(MergeEqInFilterOptimizerTest, MergeRangeFilterOptimizerTest,
+IdenticalPredicateFilterOptimizerTest) + BloomFilterSegmentPruner.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.context import compile_query
+from pinot_tpu.query.executor import ServerQueryExecutor, execute_query
+from pinot_tpu.query.optimizer import optimize_filter
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+from pinot_tpu.sql.ast import to_sql
+from pinot_tpu.sql.parser import parse_query
+
+
+OPT_SCHEMA = Schema("t", [dimension("c"), dimension("d", DataType.INT),
+                          metric("v", DataType.DOUBLE)])
+
+
+def opt(sql_where: str) -> str:
+    stmt = parse_query(f"SELECT * FROM t WHERE {sql_where}")
+    return to_sql(optimize_filter(stmt.where, OPT_SCHEMA))
+
+
+# -- AST rewrites -------------------------------------------------------------
+
+def test_merge_eq_or_to_in():
+    out = opt("c = 'a' OR c = 'b' OR c = 'c'")
+    assert "IN" in out and out.count("c") >= 1
+    assert to_sql(parse_query(
+        "SELECT * FROM t WHERE c IN ('a', 'b', 'c')").where) == out
+
+
+def test_merge_eq_and_in_dedupes():
+    out = opt("c IN ('a', 'b') OR c = 'b' OR c = 'd'")
+    assert out == "(c IN ('a', 'b', 'd'))"
+
+
+def test_merge_preserves_other_disjuncts():
+    out = opt("c = 'a' OR d > 5 OR c = 'b'")
+    assert "d > 5" in out and "IN" in out
+
+
+def test_merge_ranges_tightest():
+    # tightest combined range is the inclusive [5, 10]
+    assert opt("v > 3 AND v >= 5 AND v < 20 AND v <= 10") == "(v BETWEEN 5 AND 10)"
+    assert opt("v >= 5 AND v <= 10 AND v >= 2") == "(v BETWEEN 5 AND 10)"
+
+
+def test_range_merge_exclusive_bounds():
+    assert opt("v > 5 AND v >= 5") == "(v > 5)"
+    assert opt("v < 9 AND v <= 9") == "(v < 9)"
+
+
+def test_dedupe_identical():
+    assert opt("c = 'a' AND c = 'a'") == "(c = 'a')"
+    out = opt("(v > 1 AND c = 'x') OR (v > 1 AND c = 'x')")
+    assert out == "((v > 1) AND (c = 'x'))" or out == "((c = 'x') AND (v > 1))"
+
+
+def test_nested_flatten_enables_merge():
+    out = opt("(c = 'a' OR (c = 'b' OR c = 'd'))")
+    assert out == "(c IN ('a', 'b', 'd'))"
+
+
+def test_mixed_type_range_not_merged():
+    """`v > 5 AND v > '3'` must not merge (string vs number literals) — and
+    must still compile/execute through the normal per-type normalization."""
+    out = opt("v > 5 AND v > '3'")
+    assert "AND" in out
+
+
+def test_mv_range_not_merged(tmp_path):
+    """ANY-value MV semantics: `tag >= 5 AND tag <= 10` is satisfiable by
+    DIFFERENT values of one row; a merged BETWEEN would silently drop rows."""
+    from pinot_tpu.schema import FieldSpec, FieldRole
+    mv_schema = Schema("mvq", [
+        FieldSpec("tag", DataType.INT, FieldRole.DIMENSION, single_value=False)])
+    seg = load_segment(SegmentBuilder(mv_schema).build(
+        {"tag": [[1, 20], [6, 7], [2, 3]]}, str(tmp_path), "mv_0"))
+    res = execute_query([seg],
+                        "SELECT COUNT(*) FROM mvq WHERE tag >= 5 AND tag <= 10")
+    assert res.rows[0][0] == 2   # rows [1,20] (20>=5, 1<=10) and [6,7]
+
+
+# -- behavior preserved end-to-end --------------------------------------------
+
+SCHEMA = Schema("o", [dimension("c"), metric("v", DataType.DOUBLE)])
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("opt")
+    rng = np.random.default_rng(4)
+    return load_segment(SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        bloom_filter_columns=["c"])).build(
+        {"c": [f"c{i % 17}" for i in range(3000)],
+         "v": rng.uniform(0, 100, 3000)}, str(tmp), "o_0"))
+
+
+@pytest.mark.parametrize("where", [
+    "c = 'c1' OR c = 'c2' OR c = 'c3'",
+    "v > 10 AND v >= 20 AND v < 90",
+    "(c = 'c1' OR c = 'c1') AND v BETWEEN 5 AND 95 AND v >= 10",
+    "c IN ('c1', 'c5') OR c = 'c5' OR v < 2",
+])
+def test_optimized_results_match_brute_force(seg, where):
+    sql = f"SELECT COUNT(*), SUM(v) FROM o WHERE {where}"
+    got = execute_query([seg], sql).rows
+    # brute force via host numpy on the RAW (unoptimized) predicate
+    c = np.array([f"c{i % 17}" for i in range(3000)], dtype=object)
+    v = seg.column("v").values()
+    env = {"c": c, "v": v}
+    from pinot_tpu.engine.expr import eval_expr
+    mask = np.asarray(eval_expr(parse_query(
+        f"SELECT * FROM t WHERE {where}").where, env, np), dtype=bool)
+    assert got[0][0] == int(mask.sum())
+    assert got[0][1] == pytest.approx(float(v[mask].sum()), rel=1e-6)
+
+
+def test_eq_or_merge_gives_single_lut_leaf(seg):
+    ctx = compile_query("SELECT COUNT(*) FROM o WHERE c = 'c1' OR c = 'c2'",
+                        SCHEMA)
+    from pinot_tpu.query.planner import plan_segment
+    plan = plan_segment(ctx, seg)
+    assert len(plan.filter_prog.leaves) == 1   # one LUT, not two ORed masks
+
+
+def test_bloom_prunes_at_plan_time(seg):
+    from pinot_tpu.query.planner import plan_segment
+    # dict-encoded columns already fold on dictionary miss; the bloom path
+    # matters for RAW (no-dictionary) columns, exercised below
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        schema = Schema("b", [metric("x", DataType.LONG)])
+        seg2 = load_segment(SegmentBuilder(schema, SegmentGeneratorConfig(
+            no_dictionary_columns=["x"], bloom_filter_columns=["x"])).build(
+            {"x": np.arange(0, 5000, 7, dtype=np.int64)}, tmp, "b_0"))
+        ctx = compile_query("SELECT COUNT(*) FROM b WHERE x = 3", schema)
+        plan = plan_segment(ctx, seg2)   # 3 not in range steps of 7... but
+        # 3 < max and > min so min-max cannot fold; bloom proves absence
+        assert plan.kind == "empty", (plan.kind, plan.fallback_reason)
+        res = ServerQueryExecutor().execute([seg2],
+                                            "SELECT COUNT(*) FROM b WHERE x = 3")
+        assert res.rows[0][0] == 0
