@@ -15,7 +15,7 @@ so a serving loop drains its queue with one fetch per batch — the steady-state
 an OLAP server. Single-query p50 latency (one dispatch + one fetch round trip) and the
 group-by / HLL configs from BASELINE.json are reported in `detail`.
 
-Env knobs: PINOT_BENCH_ROWS (default 8M), PINOT_BENCH_SEGMENTS (8),
+Env knobs: PINOT_BENCH_ROWS (default 16M), PINOT_BENCH_SEGMENTS (8),
 PINOT_BENCH_ITERS (20), PINOT_BENCH_DIR (cache dir).
 """
 
@@ -28,7 +28,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
 
-ROWS = int(os.environ.get("PINOT_BENCH_ROWS", 8 * 1024 * 1024))
+# 16M rows = 2M/segment x 8: the largest padded block that keeps the group-by
+# one-hot matmul inside the f32-exact 2^24-increment budget on ONE device
+# (multi-chip divides rows per device, so real meshes scale past this)
+ROWS = int(os.environ.get("PINOT_BENCH_ROWS", 16 * 1024 * 1024))
 SEGMENTS = int(os.environ.get("PINOT_BENCH_SEGMENTS", 8))
 ITERS = int(os.environ.get("PINOT_BENCH_ITERS", 20))
 CACHE = os.environ.get("PINOT_BENCH_DIR", "/tmp/pinot_tpu_bench")

@@ -267,10 +267,12 @@ def _make_body(spec: KernelSpec):
                         sum_names.append(f"{ai}.{o}")
                     elif o in ("min", "max"):
                         minmax.append((f"{ai}.{o}", v.ravel(), o == "min"))
-            # f32 one-hot counts are exact only below 2^24 increments; the row count
-            # is static at trace time, so pick the exact int32 scatter when a single
-            # group could overflow the f32 integer range (keys.size is the bound).
-            count_exact_in_f32 = key.size < (1 << 24)
+            # f32 one-hot counts are exact only up to 2^24 increments (2^24 itself
+            # IS representable); the row count is static at trace time, so pick the
+            # exact int32 scatter when a single group could overflow the f32
+            # integer range (keys.size is the bound). The <= matters: a 16M-row
+            # padded block sits exactly at 2^24 and must keep the matmul path.
+            count_exact_in_f32 = key.size <= (1 << 24)
             if num_seg <= MATMUL_KEY_CAP and count_exact_in_f32:
                 # one-hot is NOT materialized: XLA:TPU fuses its iota-compare into the
                 # matmul tiles (measured: N=8M, K=4096 runs in ~100ms on a 16GB chip —

@@ -136,16 +136,25 @@ def test_cli_against_http_cluster(tmp_path, capsys):
              "fare": np.array([1.0, 2.0])}, str(tmp_path / "b"), "trips_0")
         assert admin_main(["upload-segment", "--controller", csvc.url,
                            "--table", "trips_OFFLINE", "--dir", seg]) == 0
+        # retry until the broker's catalog mirror + routing converge (segment
+        # load and broker snapshot polls race the first query)
         import time
-        deadline = time.time() + 15
-        while time.time() < deadline and \
-                len(node.segments_served("trips_OFFLINE")) < 1:
-            time.sleep(0.05)
-        capsys.readouterr()
-        assert admin_main(["query", "--broker", bsvc.url, "--json",
-                           "--sql", "SELECT SUM(fare) FROM trips"]) == 0
-        resp = json.loads(capsys.readouterr().out)
-        assert resp["resultTable"]["rows"][0][0] == 3.0
+        deadline = time.time() + 20
+        rows = None
+        while time.time() < deadline:
+            capsys.readouterr()
+            try:
+                rc = admin_main(["query", "--broker", bsvc.url, "--json",
+                                 "--sql", "SELECT SUM(fare) FROM trips"])
+            except Exception:  # broker mirror not converged: 500 -> retry
+                time.sleep(0.2)
+                continue
+            if rc == 0:
+                rows = json.loads(capsys.readouterr().out)["resultTable"]["rows"]
+                if rows and rows[0][0] == 3.0:
+                    break
+            time.sleep(0.2)
+        assert rows and rows[0][0] == 3.0, f"no converged result: {rows}"
 
         assert admin_main(["table-status", "--controller", csvc.url,
                            "--table", "trips_OFFLINE"]) == 0

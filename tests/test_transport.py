@@ -157,10 +157,19 @@ def test_http_cluster_query(http_cluster):
 
     from pinot_tpu.cluster.process import BrokerClient
     bc = BrokerClient(http_cluster["bsvc"].url)
-    resp = bc.query("SELECT city, SUM(fare) AS total FROM trips "
-                    "GROUP BY city ORDER BY total DESC")
-    rows = resp["resultTable"]["rows"]
-    assert rows == [["nyc", 40.0], ["sf", 25.0], ["la", 7.0]]
+    # retry: the broker's catalog mirror polls — the first query can race the
+    # external-view convergence even after both servers report loaded
+    expected = [["nyc", 40.0], ["sf", 25.0], ["la", 7.0]]
+
+    def rows():
+        try:
+            return bc.query("SELECT city, SUM(fare) AS total FROM trips "
+                            "GROUP BY city ORDER BY total DESC"
+                            )["resultTable"]["rows"]
+        except Exception:   # mirror not converged yet: broker 500s -> retry
+            return None
+    assert _wait_until(lambda: rows() == expected)
+    assert rows() == expected
 
     resp = bc.query("SELECT COUNT(*) FROM trips WHERE fare > 6")
     assert resp["resultTable"]["rows"][0][0] == 4
