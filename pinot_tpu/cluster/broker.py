@@ -59,7 +59,7 @@ class Broker:
         self.routing.mark_server_healthy(server_id)
 
     # ------------------------------------------------------------------
-    def handle_query(self, sql: str) -> ResultTable:
+    def handle_query(self, sql: str, stmt=None) -> ResultTable:
         """Full broker path: compile -> resolve physical tables -> scatter -> reduce.
 
         Join queries delegate to the multistage engine with a cluster-wide leaf-scan
@@ -72,8 +72,9 @@ class Broker:
         reg = get_registry()
         t0 = time.perf_counter()
         try:
-            from ..sql.parser import parse_query
-            stmt = parse_query(sql)
+            if stmt is None:
+                from ..sql.parser import parse_query
+                stmt = parse_query(sql)
             trace_on = _truthy(stmt.options.get("trace"))
             with tracing.request_trace(trace_on) as tr:
                 if stmt.joins:

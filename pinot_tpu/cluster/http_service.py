@@ -42,10 +42,20 @@ def error_response(msg: str, status: int = 500) -> Tuple[int, str, bytes]:
 
 
 class HttpService:
-    """A role's HTTP endpoint: register routes, serve on a daemon thread."""
+    """A role's HTTP endpoint: register routes, serve on a daemon thread.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    `access_control` (pinot_tpu.auth.AccessControl) gates every request:
+    bearer-token authentication (401 on failure), then the route's declared
+    action against the principal's permissions (403); handlers do table-level
+    checks via auth.require_table_access. None skips authentication entirely;
+    auth.AllowAllAccessControl keeps the auth machinery on but grants every
+    request an anonymous admin principal."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 access_control=None):
         self._routes: Dict[Tuple[str, str], RouteHandler] = {}
+        self._actions: Dict[Tuple[str, str], str] = {}
+        self.access_control = access_control
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,15 +70,22 @@ class HttpService:
                 params = dict(urllib.parse.parse_qsl(parsed.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
-                handler = service._routes.get((method, parts[0] if parts else ""))
+                head = parts[0] if parts else ""
+                handler = service._routes.get((method, head))
                 if handler is None:
                     status, ctype, data = error_response("not found", 404)
                 else:
                     try:
+                        service._authenticate(method, head, self.headers)
                         status, ctype, data = handler(parts[1:], params, body)
                     except Exception as e:  # surfaced to caller, not fatal to server
+                        from ..auth import AuthError
+                        code = e.status if isinstance(e, AuthError) else 500
                         status, ctype, data = error_response(
-                            f"{type(e).__name__}: {e}", 500)
+                            f"{type(e).__name__}: {e}", code)
+                    finally:
+                        from ..auth import set_current_principal
+                        set_current_principal(None)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
@@ -94,9 +111,34 @@ class HttpService:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def route(self, method: str, head: str, handler: RouteHandler) -> None:
-        """Register a handler for `METHOD /head/...` (first path component match)."""
+    def route(self, method: str, head: str, handler: RouteHandler,
+              action: str = "READ") -> None:
+        """Register a handler for `METHOD /head/...` (first path component match).
+        `action` is the permission access control demands (READ/WRITE/ADMIN)."""
         self._routes[(method, head)] = handler
+        self._actions[(method, head)] = action
+
+    def _authenticate(self, method: str, head: str, headers) -> None:
+        """Bearer-token auth + route-action authorization; publishes the
+        principal for handler-level table checks."""
+        from ..auth import AuthError, set_current_principal
+        if self.access_control is None:
+            set_current_principal(None)
+            return
+        if method == "GET" and head == "health":
+            # liveness/readiness probes are credential-less by convention
+            # (reference: Pinot exempts health endpoints from auth)
+            set_current_principal(None)
+            return
+        raw = headers.get("Authorization", "")
+        token = raw[7:] if raw.startswith("Bearer ") else None
+        principal = self.access_control.authenticate(token)
+        if principal is None:
+            raise AuthError(401, "missing or invalid bearer token")
+        action = self._actions.get((method, head), "READ")
+        if not principal.allows(action):
+            raise AuthError(403, f"{principal.name} lacks {action}")
+        set_current_principal(principal)
 
     def start(self) -> "HttpService":
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -115,17 +157,32 @@ class HttpError(Exception):
         self.status = status
 
 
+# this process's outgoing identity (reference: per-service auth token configs
+# like pinot.broker.segment.fetcher.auth.token) — applied to every http_call
+_DEFAULT_TOKEN: Optional[str] = None
+
+
+def set_default_token(token: Optional[str]) -> None:
+    global _DEFAULT_TOKEN
+    _DEFAULT_TOKEN = token
+
+
 def http_call(method: str, url: str, body: Optional[bytes] = None,
               timeout: float = 30.0, retries: int = 0,
-              content_type: str = "application/json") -> bytes:
+              content_type: str = "application/json",
+              token: Optional[str] = None) -> bytes:
     """One HTTP request with optional connection-failure retries (reference:
     broker's retry/exponential-backoff in BaseExponentialBackoffRetryFailureDetector
     — here a bounded linear retry; callers decide unhealthy-marking)."""
     last: Optional[Exception] = None
+    headers = {"Content-Type": content_type}
+    bearer = token if token is not None else _DEFAULT_TOKEN
+    if bearer:
+        headers["Authorization"] = f"Bearer {bearer}"
     for attempt in range(retries + 1):
         try:
             req = urllib.request.Request(url, data=body, method=method,
-                                         headers={"Content-Type": content_type})
+                                         headers=headers)
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:

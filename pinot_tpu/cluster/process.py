@@ -47,6 +47,15 @@ def _load_config(config_path: str, cli_port: int, port_key: str):
     return cfg
 
 
+def _setup_auth(cfg):
+    """Access control for this role's endpoints + this process's outgoing
+    identity (reference: BasicAuthAccessControlFactory + per-service tokens)."""
+    from ..auth import StaticTokenAccessControl
+    from .http_service import set_default_token
+    set_default_token(cfg.get_str("auth.service.token"))
+    return StaticTokenAccessControl.from_config(cfg)
+
+
 def run_controller(work_dir: str, run_dir: str, port: int = 0,
                    config_path: str = "") -> None:
     from .catalog import Catalog
@@ -55,11 +64,13 @@ def run_controller(work_dir: str, run_dir: str, port: int = 0,
     from .services import ControllerService
 
     cfg = _load_config(config_path, port, "controller.port")
+    access_control = _setup_auth(cfg)
     catalog = Catalog()
     deepstore = LocalDeepStore(os.path.join(work_dir, "deepstore"))
     controller = Controller("controller_0", catalog, deepstore,
                             os.path.join(work_dir, "controller"))
-    svc = ControllerService(controller, port=cfg.get_int("controller.port", 0))
+    svc = ControllerService(controller, port=cfg.get_int("controller.port", 0),
+                            access_control=access_control)
     _write_ready(run_dir, "controller_0", {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
 
@@ -74,6 +85,7 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
     # defaults < config file < PINOT_TPU_* env < CLI args (reference:
     # PinotConfiguration stack consumed by HelixServerStarter)
     cfg = _load_config(config_path, port, "server.port")
+    access_control = _setup_auth(cfg)
     catalog = RemoteCatalog(controller_url)
     deepstore = ControllerDeepStore(controller_url)
     server = ServerNode(instance_id, catalog, deepstore,
@@ -81,7 +93,8 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
                         tags=cfg.get_list("server.tenant.tags") or None,
                         completion=RemoteCompletion(controller_url),
                         scheduler=scheduler_from_config(cfg))
-    svc = ServerService(server, port=cfg.get_int("server.port", 0))
+    svc = ServerService(server, port=cfg.get_int("server.port", 0),
+                        access_control=access_control)
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
     server.shutdown()
@@ -94,10 +107,12 @@ def run_broker(controller_url: str, instance_id: str, run_dir: str,
     from .services import BrokerService
 
     cfg = _load_config(config_path, port, "broker.port")
+    access_control = _setup_auth(cfg)
     catalog = RemoteCatalog(controller_url)
     broker = Broker(instance_id, catalog,
                     max_scatter_threads=cfg.get_int("broker.scatter.threads", 8))
-    svc = BrokerService(broker, port=cfg.get_int("broker.port", 0))
+    svc = BrokerService(broker, port=cfg.get_int("broker.port", 0),
+                        access_control=access_control)
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
 

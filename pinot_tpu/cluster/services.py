@@ -53,34 +53,36 @@ class ControllerService:
     """Controller role process: owns the authoritative catalog + deep store."""
 
     def __init__(self, controller: Controller, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, access_control=None):
         self.controller = controller
         self.catalog = controller.catalog
-        self.http = HttpService(host, port)
+        self.http = HttpService(host, port, access_control=access_control)
         self._version = 0
         self._version_cv = threading.Condition()
         self.catalog.subscribe(self._bump_version)
         s = self.http
         s.route("GET", "health", lambda p, q, b: json_response({"status": "OK"}))
         s.route("GET", "catalog", self._catalog_get)
-        s.route("POST", "catalog", self._catalog_post)
-        s.route("POST", "schemas", self._post_schema)
-        s.route("POST", "tables", self._post_table)
-        s.route("DELETE", "tables", self._delete_table)
-        s.route("POST", "segments", self._post_segment)
+        s.route("POST", "catalog", self._catalog_post, action="WRITE")
+        s.route("POST", "schemas", self._post_schema, action="WRITE")
+        s.route("POST", "tables", self._post_table, action="WRITE")
+        s.route("DELETE", "tables", self._delete_table, action="ADMIN")
+        s.route("POST", "segments", self._post_segment, action="WRITE")
         s.route("GET", "segments", self._get_segment)
-        s.route("DELETE", "segments", self._delete_segment)
-        s.route("POST", "segmentConsumed", self._segment_consumed)
-        s.route("POST", "segmentCommitStart", self._segment_commit_start)
-        s.route("POST", "segmentCommitEnd", self._segment_commit_end)
+        s.route("DELETE", "segments", self._delete_segment, action="ADMIN")
+        s.route("POST", "segmentConsumed", self._segment_consumed, action="WRITE")
+        s.route("POST", "segmentCommitStart", self._segment_commit_start,
+                action="WRITE")
+        s.route("POST", "segmentCommitEnd", self._segment_commit_end,
+                action="WRITE")
         s.route("GET", "deepstore", self._deepstore_get)
-        s.route("POST", "deepstore", self._deepstore_post)
+        s.route("POST", "deepstore", self._deepstore_post, action="WRITE")
         s.route("GET", "tableStatus", self._table_status)
         s.route("GET", "tables", self._get_tables)
         s.route("GET", "schemas", self._get_schema)
         s.route("GET", "segmentsMeta", self._segments_meta)
-        s.route("POST", "reload", self._reload_table)
-        s.route("POST", "rebalance", self._rebalance)
+        s.route("POST", "reload", self._reload_table, action="WRITE")
+        s.route("POST", "rebalance", self._rebalance, action="ADMIN")
         s.route("GET", "metrics", _metrics_route)
         self.http.start()
 
@@ -164,6 +166,8 @@ class ControllerService:
         """POST /segments/{tableNameWithType}?name=... with the tar as the body
         (reference: segment push via PinotSegmentUploadDownloadRestletResource)."""
         table = parts[0]
+        from ..auth import require_table_access
+        require_table_access(table, "WRITE")
         name = params["name"]
         with tempfile.TemporaryDirectory() as tmp:
             seg_dir = _untar_body(body, name, tmp)
@@ -173,6 +177,8 @@ class ControllerService:
     def _get_segment(self, parts, params, body):
         """GET /segments/{table}/{name} — download the committed tar by URL."""
         table, name = parts[0], parts[1]
+        from ..auth import require_table_access
+        require_table_access(table, "READ")  # raw data = same ACL as queries
         meta = self.catalog.segments.get(table, {}).get(name)
         if meta is None or not meta.download_path:
             return error_response(f"no such segment {table}/{name}", 404)
@@ -258,6 +264,11 @@ class ControllerService:
     # -- deep-store proxy ----------------------------------------------------
     def _deepstore_get(self, parts, params, body):
         uri = "/".join(parts)
+        # deep-store URIs lead with the table name ("{table}/{segment}.tar.gz"):
+        # a table-scoped reader must not exfiltrate raw segments of denied tables
+        from ..auth import require_table_access
+        if parts:
+            require_table_access(parts[0], "READ")
         with tempfile.TemporaryDirectory() as tmp:
             local = os.path.join(tmp, "blob")
             self.controller.deepstore.download(uri, local)
@@ -277,9 +288,10 @@ class ControllerService:
 class ServerService:
     """Server role process: query endpoint over the binary wire format."""
 
-    def __init__(self, server: ServerNode, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, server: ServerNode, host: str = "127.0.0.1", port: int = 0,
+                 access_control=None):
         self.server = server
-        self.http = HttpService(host, port)
+        self.http = HttpService(host, port, access_control=access_control)
         self.http.route("POST", "query", self._query)
         self.http.route("POST", "explain", self._explain)
         self.http.route("GET", "health", self._health)
@@ -302,9 +314,11 @@ class ServerService:
         self.http.stop()
 
     def _query(self, parts, params, body):
+        from ..auth import require_table_access
         from ..query.scheduler import QueryRejectedError, QueryTimeoutError
         from ..utils.trace import request_trace
         req = decode_query_request(body)
+        require_table_access(req["table"], "READ")
         try:
             with request_trace(bool(req.get("trace"))) as tr:
                 result = self.server.execute_partial(
@@ -330,7 +344,9 @@ class ServerService:
         return json_response(st, status=200 if st["ready"] else 503)
 
     def _explain(self, parts, params, body):
+        from ..auth import require_table_access
         req = decode_query_request(body)
+        require_table_access(req["table"], "READ")  # plans leak schema/indexes
         rows = self.server.explain_partial(req["table"], req["sql"],
                                            req["segments"])
         return json_response({"rows": rows})
@@ -342,10 +358,11 @@ class ServerService:
 class BrokerService:
     """Broker role process: SQL entry over HTTP; discovers servers via catalog."""
 
-    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                 access_control=None):
         self.broker = broker
         self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
-        self.http = HttpService(host, port)
+        self.http = HttpService(host, port, access_control=access_control)
         self.http.route("POST", "query", self._query)
         self.http.route("GET", "health",
                         lambda p, q, b: json_response({"status": "OK"}))
@@ -387,5 +404,22 @@ class BrokerService:
 
     def _query(self, parts, params, body):
         d = json.loads(body.decode())
-        result = self.broker.handle_query(d["sql"])
+        sql = d["sql"]
+        # table-level ACL before any work (reference: broker AccessControl
+        # .hasAccess(requesterIdentity, tables) right after compile). The parsed
+        # statement is handed to the broker so the SQL is parsed ONCE; a parse
+        # failure defers to handle_query, which raises AND counts the broker
+        # query-exception meter.
+        from ..auth import current_principal, require_table_access
+        stmt = None
+        if current_principal() is not None:
+            from ..sql.parser import parse_query
+            try:
+                stmt = parse_query(sql)
+            except Exception:
+                stmt = None
+            if stmt is not None:
+                for table in [stmt.table] + [j.table for j in stmt.joins]:
+                    require_table_access(table, "READ")
+        result = self.broker.handle_query(sql, stmt=stmt)
         return json_response(result.to_json())

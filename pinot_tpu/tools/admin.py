@@ -211,6 +211,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    import os
+    token = os.environ.get("PINOT_TPU_AUTH_TOKEN")
+    if token:  # bearer identity for every remote call this invocation makes
+        from ..cluster.http_service import set_default_token
+        set_default_token(token)
     args = build_parser().parse_args(argv)
     return args.fn(args)
 
