@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 import shutil
 import tarfile
+import threading
 from typing import Dict, List, Type
 
 
@@ -34,6 +35,23 @@ class DeepStoreFS:
     def listdir(self, uri: str) -> List[str]:
         raise NotImplementedError
 
+    # -- small-blob convenience (leases, checkpoints, manifests) -----------
+    def put_bytes(self, data: bytes, uri: str) -> None:
+        import tempfile
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "blob")
+            with open(local, "wb") as f:
+                f.write(data)
+            self.upload(local, uri)
+
+    def get_bytes(self, uri: str) -> bytes:
+        import tempfile
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "blob")
+            self.download(uri, local)
+            with open(local, "rb") as f:
+                return f.read()
+
 
 class LocalDeepStore(DeepStoreFS):
     """Reference: LocalPinotFS. URIs are `file://`-less plain paths under a root."""
@@ -50,7 +68,11 @@ class LocalDeepStore(DeepStoreFS):
     def upload(self, local_path: str, uri: str) -> None:
         dest = self._path(uri)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        shutil.copyfile(local_path, dest)
+        # copy-to-temp + rename: readers never observe a torn write (the
+        # leadership lease/checkpoint blobs depend on this)
+        tmp = f"{dest}.tmp.{os.getpid()}.{threading.get_ident()}"
+        shutil.copyfile(local_path, tmp)
+        os.replace(tmp, dest)
 
     def download(self, uri: str, local_path: str) -> None:
         os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)

@@ -1,0 +1,190 @@
+"""Lead-controller election + standby failover over the shared deep store.
+
+Analog of the reference's lead-controller machinery (`pinot-controller/.../
+LeadControllerManager.java` + the Helix leader resource): exactly one
+controller acts on the cluster at a time; standbys take over when the leader
+stops renewing its claim.
+
+Redesign for this architecture: the reference leans on ZK ephemeral nodes; the
+shared durable medium here is the deep store, so leadership is a LEASE blob
+(`_leadership/lease.json`: holder, epoch, deadline) that the leader renews and
+standbys poll. Writes are atomic (temp+rename in LocalDeepStore) and
+verify-after-write (no CAS on generic deep stores): a contender writes its
+claim, waits a settle window, and reads back — if its claim survived, it leads
+under a NEW epoch. Epochs bump on every acquisition of an expired/free lease —
+including a restarted process reusing its instance id — so a stale incarnation
+always sees a higher epoch and steps down (fencing).
+
+The catalog (the ZK stand-in) rides the same medium: the leader checkpoints
+`Catalog.snapshot()` to `_leadership/catalog.json` on every change — each
+upload re-verifies the lease first, so a deposed leader cannot clobber its
+successor's checkpoint — and a standby RESTORES that snapshot at takeover,
+exactly like the reference's ZK state surviving controller churn.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+LEASE_URI = "_leadership/lease.json"
+CATALOG_URI = "_leadership/catalog.json"
+
+
+class LeaderElection:
+    """One contender's view of the leadership lease."""
+
+    def __init__(self, deepstore, instance_id: str, lease_ttl_s: float = 10.0,
+                 settle_s: float = 0.05):
+        self.deepstore = deepstore
+        self.instance_id = instance_id
+        self.lease_ttl_s = lease_ttl_s
+        self.settle_s = settle_s
+        self.epoch = 0
+        self.is_leader = False
+
+    # -- lease blob I/O ----------------------------------------------------
+    def _read_lease(self) -> Optional[dict]:
+        try:
+            return json.loads(self.deepstore.get_bytes(LEASE_URI).decode())
+        except Exception:
+            return None
+
+    def _write_lease(self, lease: dict) -> None:
+        self.deepstore.put_bytes(json.dumps(lease).encode(), LEASE_URI)
+
+    def _holds(self, cur: Optional[dict]) -> bool:
+        """Does the CURRENT incarnation of this object hold `cur`?"""
+        return bool(self.is_leader and cur is not None
+                    and cur["holder"] == self.instance_id
+                    and cur["epoch"] == self.epoch)
+
+    # -- acquire/renew -----------------------------------------------------
+    def try_acquire(self) -> bool:
+        """Claim leadership if the lease is free/expired; verify-after-write."""
+        now = time.time()
+        cur = self._read_lease()
+        if cur is not None and cur["deadline"] > now and not self._holds(cur):
+            # someone (possibly an older incarnation of OUR id) holds a live
+            # lease; a restarted process must wait for expiry like anyone else
+            self.is_leader = False
+            return False
+        if self._holds(cur):
+            return self.renew()
+        # free/expired: every fresh acquisition bumps the epoch — even for the
+        # same instance id — so stale incarnations are fenced out
+        epoch = (cur["epoch"] if cur else 0) + 1
+        claim = {"holder": self.instance_id, "epoch": epoch,
+                 "deadline": now + self.lease_ttl_s}
+        self._write_lease(claim)
+        if self.settle_s:
+            time.sleep(self.settle_s)   # let a racing contender's write land
+        final = self._read_lease()
+        won = bool(final and final["holder"] == self.instance_id
+                   and final["epoch"] == epoch)
+        self.epoch = epoch if won else self.epoch
+        self.is_leader = won
+        return won
+
+    def renew(self) -> bool:
+        """Extend the lease; returns False (and steps down) when deposed."""
+        cur = self._read_lease()
+        if not self._holds(cur):
+            self.is_leader = False
+            return False
+        self._write_lease({"holder": self.instance_id, "epoch": self.epoch,
+                           "deadline": time.time() + self.lease_ttl_s})
+        return True
+
+    def release(self) -> None:
+        """Voluntary step-down: expire the lease — but only if THIS incarnation
+        still holds it (a stale ex-leader must not clobber its successor)."""
+        cur = self._read_lease()
+        if self._holds(cur):
+            self._write_lease({"holder": self.instance_id, "epoch": self.epoch,
+                               "deadline": 0.0})
+        self.is_leader = False
+
+
+class ControllerFailover:
+    """Wires a Controller to the election: leader checkpoints the catalog,
+    standby polls and restores + takes over on lease expiry.
+
+    Reference flow: LeadControllerManager callbacks start/stop the controller's
+    periodic tasks and realtime manager on leadership changes."""
+
+    CHECKPOINT_READ_RETRIES = 3
+
+    def __init__(self, controller, election: LeaderElection,
+                 on_gain: Optional[Callable[[], None]] = None,
+                 on_loss: Optional[Callable[[], None]] = None):
+        self.controller = controller
+        self.election = election
+        self.on_gain = on_gain
+        self.on_loss = on_loss
+        self._subscribed = False
+
+    # -- leader side -------------------------------------------------------
+    def lead(self) -> bool:
+        """Become leader (if the lease allows) and start checkpointing."""
+        if not self.election.try_acquire():
+            return False
+        self._on_become_leader()
+        return True
+
+    def _on_become_leader(self) -> None:
+        self._checkpoint()
+        if not self._subscribed:  # a re-elected standby must not double-write
+            self.controller.catalog.subscribe(self._on_catalog_event)
+            self._subscribed = True
+        if self.on_gain:
+            self.on_gain()
+
+    def _on_catalog_event(self, event: str, key: str) -> None:
+        if self.election.is_leader:
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        # epoch fence: re-verify the lease IMMEDIATELY before uploading so a
+        # deposed leader's late catalog events cannot overwrite the successor's
+        # checkpoint (the lease is fenced; the checkpoint must be too)
+        if not self.election._holds(self.election._read_lease()):
+            self.election.is_leader = False
+            return
+        self.election.deepstore.put_bytes(
+            self.controller.catalog.snapshot().encode(), CATALOG_URI)
+
+    def heartbeat(self) -> bool:
+        """Renew the lease; on deposition, stop acting (tests drive this
+        deterministically; production wraps it in utils.periodic)."""
+        ok = self.election.renew()
+        if not ok and self.on_loss:
+            self.on_loss()
+        return ok
+
+    # -- standby side ------------------------------------------------------
+    def try_takeover(self) -> bool:
+        """Standby poll: if the lease is free/expired, restore the last
+        catalog checkpoint and assume leadership. A checkpoint that EXISTS but
+        cannot be read aborts the takeover (stepping up with an empty catalog
+        would overwrite the good checkpoint and lose all metadata)."""
+        if self.election.is_leader:
+            return True
+        if not self.election.try_acquire():
+            return False
+        if self.election.deepstore.exists(CATALOG_URI):
+            blob = None
+            for _ in range(self.CHECKPOINT_READ_RETRIES):
+                try:
+                    blob = self.election.deepstore.get_bytes(CATALOG_URI)
+                    self.controller.catalog.restore(blob.decode())
+                    break
+                except Exception:
+                    blob = None
+                    time.sleep(0.05)
+            if blob is None:
+                self.election.release()   # do NOT clobber what we can't read
+                return False
+        self._on_become_leader()
+        return True

@@ -1,0 +1,157 @@
+"""Lead-controller election + failover tests.
+
+Reference pattern: LeadControllerManager tests — one leader at a time, standby
+takeover on lease expiry, deposed leader steps down, metadata survives.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.catalog import Catalog
+from pinot_tpu.cluster.controller import Controller
+from pinot_tpu.cluster.deepstore import LocalDeepStore
+from pinot_tpu.cluster.leadership import (ControllerFailover, LeaderElection)
+from pinot_tpu.schema import Schema, dimension, metric
+from pinot_tpu.table import TableConfig
+
+
+@pytest.fixture()
+def ds(tmp_path):
+    return LocalDeepStore(str(tmp_path / "deepstore"))
+
+
+def test_single_winner(ds):
+    a = LeaderElection(ds, "ctrl_a", lease_ttl_s=5.0, settle_s=0.0)
+    b = LeaderElection(ds, "ctrl_b", lease_ttl_s=5.0, settle_s=0.0)
+    assert a.try_acquire()
+    assert not b.try_acquire()      # live lease blocks the standby
+    assert a.renew()
+    assert a.is_leader and not b.is_leader
+
+
+def test_takeover_after_expiry(ds):
+    a = LeaderElection(ds, "ctrl_a", lease_ttl_s=0.05, settle_s=0.0)
+    b = LeaderElection(ds, "ctrl_b", lease_ttl_s=5.0, settle_s=0.0)
+    assert a.try_acquire()
+    import time
+    time.sleep(0.1)                 # leader "crashes": never renews
+    assert b.try_acquire()
+    assert b.epoch == a.epoch + 1   # epoch fences the old incarnation
+    # the deposed leader notices at its next renewal and steps down
+    assert not a.renew()
+    assert not a.is_leader
+
+
+def test_voluntary_release(ds):
+    a = LeaderElection(ds, "ctrl_a", lease_ttl_s=60.0, settle_s=0.0)
+    b = LeaderElection(ds, "ctrl_b", lease_ttl_s=5.0, settle_s=0.0)
+    assert a.try_acquire()
+    a.release()
+    assert b.try_acquire()          # no TTL wait after a clean step-down
+
+
+def test_failover_restores_catalog(tmp_path, ds):
+    """Standby controller takes over with the leader's metadata intact."""
+    schema = Schema("trips", [dimension("city"), metric("fare")])
+
+    leader = Controller("ctrl_a", Catalog(), ds, str(tmp_path / "a"))
+    fo_a = ControllerFailover(
+        leader, LeaderElection(ds, "ctrl_a", lease_ttl_s=0.05, settle_s=0.0))
+    assert fo_a.lead()
+
+    # leader does real work: schema + table land in the checkpoint
+    leader.add_schema(schema)
+    leader.add_table(TableConfig("trips", replication=2))
+    assert fo_a.heartbeat()
+
+    # leader dies (stops renewing); standby polls, wins, restores
+    import time
+    time.sleep(0.1)
+    standby = Controller("ctrl_b", Catalog(), ds, str(tmp_path / "b"))
+    fo_b = ControllerFailover(
+        standby, LeaderElection(ds, "ctrl_b", lease_ttl_s=5.0, settle_s=0.0))
+    assert fo_b.try_takeover()
+    assert "trips_OFFLINE" in standby.catalog.table_configs
+    assert standby.catalog.table_configs["trips_OFFLINE"].replication == 2
+    assert standby.catalog.schemas["trips"].has_column("fare")
+
+    # the old leader's next heartbeat detects deposition
+    assert not fo_a.heartbeat()
+    assert not fo_a.election.is_leader
+
+    # the new leader keeps checkpointing: further writes survive ANOTHER failover
+    standby.add_schema(Schema("orders", [dimension("id")]))
+    standby.add_table(TableConfig("orders"))
+    time.sleep(0.01)
+    third = Controller("ctrl_c", Catalog(), ds, str(tmp_path / "c"))
+    fo_b.election.release()
+    fo_c = ControllerFailover(
+        third, LeaderElection(ds, "ctrl_c", lease_ttl_s=5.0, settle_s=0.0))
+    assert fo_c.try_takeover()
+    assert "orders_OFFLINE" in third.catalog.table_configs
+
+
+def test_stale_release_does_not_clobber_successor(ds):
+    """An ex-leader's release() after being deposed must not expire the NEW
+    leader's lease (split-brain prevention)."""
+    import time
+    a = LeaderElection(ds, "ctrl_a", lease_ttl_s=0.05, settle_s=0.0)
+    b = LeaderElection(ds, "ctrl_b", lease_ttl_s=60.0, settle_s=0.0)
+    assert a.try_acquire()
+    time.sleep(0.1)
+    assert b.try_acquire()
+    a.release()                      # stale: A still thinks it leads
+    assert b.renew(), "successor's lease must survive a stale release"
+
+
+def test_restarted_same_id_bumps_epoch(ds):
+    """A replacement process reusing the instance id gets a NEW epoch, so the
+    hung original incarnation is fenced out at its next renew."""
+    import time
+    original = LeaderElection(ds, "ctrl_a", lease_ttl_s=0.05, settle_s=0.0)
+    assert original.try_acquire()
+    time.sleep(0.1)                  # original hangs past expiry
+    replacement = LeaderElection(ds, "ctrl_a", lease_ttl_s=60.0, settle_s=0.0)
+    assert replacement.try_acquire()
+    assert replacement.epoch == original.epoch + 1
+    assert not original.renew(), "hung incarnation must be fenced"
+
+
+def test_deposed_leader_cannot_overwrite_checkpoint(tmp_path, ds):
+    """Late catalog events on a deposed leader must not clobber the successor's
+    checkpoint (the checkpoint is epoch-fenced like the lease)."""
+    import time
+    a = Controller("ctrl_a", Catalog(), ds, str(tmp_path / "a"))
+    fo_a = ControllerFailover(
+        a, LeaderElection(ds, "ctrl_a", lease_ttl_s=0.05, settle_s=0.0))
+    assert fo_a.lead()
+    a.add_schema(Schema("t1", [dimension("x")]))
+    time.sleep(0.1)                  # A's lease expires
+
+    b = Controller("ctrl_b", Catalog(), ds, str(tmp_path / "b"))
+    fo_b = ControllerFailover(
+        b, LeaderElection(ds, "ctrl_b", lease_ttl_s=60.0, settle_s=0.0))
+    assert fo_b.try_takeover()
+    b.add_schema(Schema("t2", [dimension("y")]))   # successor's new state
+
+    # deposed A fires a late catalog event; the fenced checkpoint must refuse
+    a.add_schema(Schema("stale", [dimension("z")]))
+    c = Controller("ctrl_c", Catalog(), ds, str(tmp_path / "c"))
+    fo_b.election.release()
+    fo_c = ControllerFailover(
+        c, LeaderElection(ds, "ctrl_c", lease_ttl_s=60.0, settle_s=0.0))
+    assert fo_c.try_takeover()
+    assert "t2" in c.catalog.schemas, "successor's writes must survive"
+    assert "stale" not in c.catalog.schemas, "deposed leader's write leaked"
+
+
+def test_standby_does_not_takeover_live_leader(tmp_path, ds):
+    leader = Controller("ctrl_a", Catalog(), ds, str(tmp_path / "a"))
+    fo_a = ControllerFailover(
+        leader, LeaderElection(ds, "ctrl_a", lease_ttl_s=60.0, settle_s=0.0))
+    assert fo_a.lead()
+    standby = Controller("ctrl_b", Catalog(), ds, str(tmp_path / "b"))
+    fo_b = ControllerFailover(
+        standby, LeaderElection(ds, "ctrl_b", lease_ttl_s=5.0, settle_s=0.0))
+    assert not fo_b.try_takeover()
+    assert fo_a.heartbeat()
