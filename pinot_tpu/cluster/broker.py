@@ -14,7 +14,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
-from typing import Callable, Dict, List, Optional, Sequence, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 from ..query.aggregates import make_agg
 from ..query.context import QueryContext, QueryValidationError, compile_query
@@ -33,6 +33,99 @@ ServerHandle = Callable[..., SegmentResult]
 UNBOUNDED_LIMIT = 1 << 40
 
 
+class FailureDetector:
+    """Exponential-backoff re-probing of unhealthy servers (reference:
+    `BaseExponentialBackoffRetryFailureDetector`): a server excluded from
+    routing after a transport failure is probed on a growing interval and
+    returned to rotation when its probe succeeds — without this, one blip
+    removes a server until an operator intervenes."""
+
+    def __init__(self, routing, initial_interval_s: float = 0.5,
+                 backoff_factor: float = 2.0, max_interval_s: float = 30.0):
+        self.routing = routing
+        self.initial_interval_s = initial_interval_s
+        self.backoff_factor = backoff_factor
+        self.max_interval_s = max_interval_s
+        self._probes: Dict[str, Callable[[], bool]] = {}
+        # server -> (next probe time, current interval)
+        self._pending: Dict[str, Tuple[float, float]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register_probe(self, server_id: str, probe: Callable[[], bool]) -> None:
+        with self._lock:
+            self._probes[server_id] = probe
+
+    def notify_unhealthy(self, server_id: str) -> None:
+        with self._lock:
+            if server_id in self._probes and server_id not in self._pending:
+                self._pending[server_id] = (
+                    time.time() + self.initial_interval_s,
+                    self.initial_interval_s)
+
+    def notify_healthy(self, server_id: str) -> None:
+        with self._lock:
+            self._pending.pop(server_id, None)
+
+    def remove(self, server_id: str) -> None:
+        """Forget a decommissioned server entirely: its probe closure must not
+        be retained (a reused port answering 2xx would re-admit a dead id)."""
+        with self._lock:
+            self._probes.pop(server_id, None)
+            self._pending.pop(server_id, None)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Probe every due server once (tests drive this deterministically;
+        `start()` runs it on a daemon thread). Probes run CONCURRENTLY: one
+        unreachable host's timeout must not serialize behind it the recovery
+        of every other server."""
+        now = time.time() if now is None else now
+        with self._lock:
+            due = [(s, iv) for s, (t, iv) in self._pending.items() if t <= now]
+        if not due:
+            return
+
+        def run_probe(server_id: str) -> bool:
+            probe = self._probes.get(server_id)
+            try:
+                return bool(probe()) if probe else False
+            except Exception:
+                return False
+
+        if len(due) == 1:
+            results = {due[0][0]: run_probe(due[0][0])}
+        else:
+            with ThreadPoolExecutor(max_workers=min(8, len(due)),
+                                    thread_name_prefix="fd-probe") as pool:
+                futs = {s: pool.submit(run_probe, s) for s, _ in due}
+                results = {s: f.result() for s, f in futs.items()}
+        for server_id, interval in due:
+            ok = results[server_id]
+            with self._lock:
+                if server_id not in self._pending:
+                    continue  # raced with notify_healthy/remove
+                if ok:
+                    self._pending.pop(server_id, None)
+                else:
+                    nxt = min(interval * self.backoff_factor,
+                              self.max_interval_s)
+                    self._pending[server_id] = (now + nxt, nxt)
+            if ok:
+                self.routing.mark_server_healthy(server_id)
+
+    def start(self, tick_s: float = 0.25) -> None:
+        def loop():
+            while not self._stop.wait(tick_s):
+                self.tick()
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="failure-detector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 class Broker:
     def __init__(self, instance_id: str, catalog: Catalog,
                  max_scatter_threads: int = 8):
@@ -46,16 +139,22 @@ class Broker:
         self._lock = threading.RLock()
         from ..query.scheduler import QueryQuotaManager
         self.quota = QueryQuotaManager(catalog)
+        self.failure_detector = FailureDetector(self.routing)
         catalog.register_instance(InstanceInfo(instance_id, "broker"))
 
     def register_server_handle(self, server_id: str, handle: ServerHandle,
-                               explain_handle=None) -> None:
+                               explain_handle=None, probe=None) -> None:
         """Wire a server's execute entry (direct object in-proc, HTTP proxy remote).
-        `explain_handle(table, ctx, segments) -> rows` serves EXPLAIN PLAN."""
+        `explain_handle(table, ctx, segments) -> rows` serves EXPLAIN PLAN;
+        `probe() -> bool` lets the failure detector re-admit the server after a
+        transport failure (no probe = manual recovery only)."""
         with self._lock:
             self._servers[server_id] = handle
             if explain_handle is not None:
                 self._explain[server_id] = explain_handle
+        if probe is not None:
+            self.failure_detector.register_probe(server_id, probe)
+        self.failure_detector.notify_healthy(server_id)
         self.routing.mark_server_healthy(server_id)
 
     # ------------------------------------------------------------------
@@ -160,6 +259,7 @@ class Broker:
                     servers_failed += 1
                     if not _is_backpressure(e):
                         self.routing.mark_server_unhealthy(server_id)
+                        self.failure_detector.notify_unhealthy(server_id)
 
         t_scatter = time.perf_counter()
         with span("reduce"):
@@ -298,6 +398,7 @@ class Broker:
                         rows.extend(fut.result().rows)
                     except Exception:
                         self.routing.mark_server_unhealthy(server_id)
+                        self.failure_detector.notify_unhealthy(server_id)
                         raise
             import numpy as np
             out = {}

@@ -371,6 +371,7 @@ class BrokerService:
         # fires an event we handle (re-scan), instead of being silently missed
         broker.catalog.subscribe(self._on_event)
         self._wire_server_handles()
+        self.broker.failure_detector.start()  # background re-probe loop
         self.http.start()
 
     @property
@@ -378,6 +379,7 @@ class BrokerService:
         return self.http.url
 
     def stop(self) -> None:
+        self.broker.failure_detector.stop()  # kill the background probe loop
         self.http.stop()
 
     def _on_event(self, event: str, _key: str) -> None:
@@ -390,17 +392,35 @@ class BrokerService:
         Only new/changed endpoints are (re)registered — re-registering marks the
         server healthy, which must not resurrect a server the failure detector
         already excluded (reference: routing exclusion survives until the
-        detector's retry probe succeeds)."""
+        detector's retry probe succeeds). Decommissioned/dead instances are
+        FORGOTTEN by the detector so their probes stop and a reused port can
+        never re-admit a dead server id."""
         for info in list(self.broker.catalog.instances.values()):
-            if info.role != "server" or not info.port or not info.alive:
+            if info.role != "server" or not info.port:
+                continue
+            if not info.alive:
+                if self._registered.pop(info.instance_id, None):
+                    self.broker.failure_detector.remove(info.instance_id)
                 continue
             url = f"http://{info.host}:{info.port}"
             if self._registered.get(info.instance_id) == url:
                 continue
             self._registered[info.instance_id] = url
             handle = RemoteServerHandle(url)
+
+            def probe(u=url):
+                # /health is auth-exempt; ready=false still proves liveness
+                from .http_service import HttpError, http_call
+                try:
+                    http_call("GET", f"{u}/health", timeout=2.0)
+                    return True
+                except HttpError as e:
+                    return e.status == 503  # alive but not ready: re-admit
+                except Exception:
+                    return False
             self.broker.register_server_handle(info.instance_id, handle,
-                                               explain_handle=handle.explain)
+                                               explain_handle=handle.explain,
+                                               probe=probe)
 
     def _query(self, parts, params, body):
         d = json.loads(body.decode())

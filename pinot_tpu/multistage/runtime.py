@@ -4,10 +4,15 @@ Analog of the reference's `pinot-query-runtime` operator chain
 (`runtime/operator/HashJoinOperator.java`, `AggregateOperator.java`,
 `MailboxSendOperator`/`MailboxReceiveOperator` over `GrpcMailboxService`,
 `QueryDispatcher.submitAndReduce`, SURVEY.md §3.4). Data moves between stages as
-columnar blocks (`Dict[col -> np.ndarray]`) through an in-process mailbox service —
-within one host that is a dict of queues; across hosts the cluster layer would carry
-the same blocks over DCN. Leaf scans reuse the single-stage device engine (exactly as
-the reference's leaf stages reuse `ServerQueryExecutorV1Impl`).
+columnar blocks (`Dict[col -> np.ndarray]`) through an in-process mailbox service
+(a dict of queues). Distribution TODAY: LEAF SCANS cross process boundaries — the
+broker's scan provider scatters them to servers over the HTTP transport and the
+blocks come back on the binary wire format — while the join/aggregate stages above
+the leaves run inside the broker process. Stage-level worker distribution (the
+reference's GrpcMailboxService between query-runtime workers) is not implemented;
+`wire.encode_value` already serializes the block format those mailboxes would carry.
+Leaf scans reuse the single-stage device engine (exactly as the reference's leaf
+stages reuse `ServerQueryExecutorV1Impl`).
 
 Join null semantics: outer-join null-extended numeric columns become float NaN and
 object columns None; aggregations skip them (SQL null-skipping), comparisons fail

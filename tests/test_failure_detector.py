@@ -1,0 +1,89 @@
+"""Failure detector tests: backoff re-probing returns blipped servers to
+routing (reference: BaseExponentialBackoffRetryFailureDetector).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.broker import FailureDetector
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import TableConfig
+
+
+class FakeRouting:
+    def __init__(self):
+        self.healthy = []
+
+    def mark_server_healthy(self, s):
+        self.healthy.append(s)
+
+
+def test_backoff_schedule_and_recovery():
+    routing = FakeRouting()
+    fd = FailureDetector(routing, initial_interval_s=1.0, backoff_factor=2.0,
+                         max_interval_s=8.0)
+    state = {"up": False}
+    fd.register_probe("s1", lambda: state["up"])
+    fd.notify_unhealthy("s1")
+
+    t0 = 1000.0
+    fd._pending["s1"] = (t0 + 1.0, 1.0)   # pin the schedule for determinism
+    fd.tick(t0 + 0.5)                      # not due yet
+    assert routing.healthy == []
+    fd.tick(t0 + 1.0)                      # due, probe fails -> backoff 2s
+    assert fd._pending["s1"][1] == 2.0
+    fd.tick(t0 + 3.0)                      # fails -> 4s
+    fd.tick(t0 + 7.0)                      # fails -> 8s
+    fd.tick(t0 + 15.0)                     # fails -> capped at 8s
+    assert fd._pending["s1"][1] == 8.0
+    state["up"] = True
+    fd.tick(t0 + 23.0)                     # probe succeeds
+    assert routing.healthy == ["s1"]
+    assert "s1" not in fd._pending
+
+
+def test_no_probe_means_manual_recovery_only():
+    routing = FakeRouting()
+    fd = FailureDetector(routing)
+    fd.notify_unhealthy("mystery")         # no registered probe: not tracked
+    fd.tick(1e12)
+    assert routing.healthy == [] and not fd._pending
+
+
+def test_broker_recovers_blipped_server(tmp_path):
+    """End-to-end: a failing server drops out of routing after a bad query and
+    returns automatically once its probe passes."""
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    schema = Schema("t", [dimension("s"), metric("v", DataType.DOUBLE)])
+    cfg = cluster.create_table(schema, TableConfig("t", replication=2))
+    cluster.ingest_columns(cfg, {"s": ["a", "b"], "v": np.array([1.0, 2.0])})
+
+    broken = {"on": True}
+    real = cluster.servers[0].execute_partial
+
+    def flaky(*args, **kw):
+        if broken["on"]:
+            raise ConnectionError("transport blip")
+        return real(*args, **kw)
+    cluster.broker.register_server_handle(
+        "server_0", flaky, probe=lambda: not broken["on"])
+
+    cluster.query("SELECT s, COUNT(*) FROM t GROUP BY s LIMIT 5")
+    assert "server_0" in cluster.broker.routing._unhealthy
+    assert "server_0" in cluster.broker.failure_detector._pending
+    # with server_0 excluded, the healthy replica answers everything
+    res = cluster.query("SELECT s, COUNT(*) FROM t GROUP BY s LIMIT 5")
+    assert sum(r[1] for r in res.rows) == 2
+
+    # probe keeps failing -> still excluded
+    cluster.broker.failure_detector.tick(now=1e12)
+    assert "server_0" in cluster.broker.routing._unhealthy
+
+    # server recovers -> next probe re-admits it
+    broken["on"] = False
+    cluster.broker.failure_detector.tick(now=2e12)
+    assert "server_0" not in cluster.broker.routing._unhealthy
+    res = cluster.query("SELECT s, COUNT(*) FROM t GROUP BY s LIMIT 5")
+    assert sum(r[1] for r in res.rows) == 2
+    assert res.stats["numServersResponded"] == res.stats["numServersQueried"]
