@@ -134,6 +134,7 @@ class Broker:
         self.routing = RoutingManager(catalog)
         self._servers: Dict[str, ServerHandle] = {}
         self._explain: Dict[str, Callable] = {}
+        self._stage: Dict[str, Callable] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads,
                                         thread_name_prefix=f"{instance_id}-scatter")
         self._lock = threading.RLock()
@@ -143,19 +144,34 @@ class Broker:
         catalog.register_instance(InstanceInfo(instance_id, "broker"))
 
     def register_server_handle(self, server_id: str, handle: ServerHandle,
-                               explain_handle=None, probe=None) -> None:
+                               explain_handle=None, probe=None,
+                               stage_handle=None) -> None:
         """Wire a server's execute entry (direct object in-proc, HTTP proxy remote).
         `explain_handle(table, ctx, segments) -> rows` serves EXPLAIN PLAN;
         `probe() -> bool` lets the failure detector re-admit the server after a
-        transport failure (no probe = manual recovery only)."""
+        transport failure (no probe = manual recovery only);
+        `stage_handle(spec, left, right) -> block` runs one multistage join
+        partition on the server (the worker-mailbox analog)."""
         with self._lock:
             self._servers[server_id] = handle
             if explain_handle is not None:
                 self._explain[server_id] = explain_handle
+            if stage_handle is not None:
+                self._stage[server_id] = stage_handle
         if probe is not None:
             self.failure_detector.register_probe(server_id, probe)
         self.failure_detector.notify_healthy(server_id)
         self.routing.mark_server_healthy(server_id)
+
+    def unregister_server(self, server_id: str) -> None:
+        """Forget a decommissioned server: every handle map + detector entry
+        (a retained stage/query handle would keep dispatching to a dead URL)."""
+        with self._lock:
+            self._servers.pop(server_id, None)
+            self._explain.pop(server_id, None)
+            self._stage.pop(server_id, None)
+        self.failure_detector.remove(server_id)
+        self.routing.mark_server_unhealthy(server_id)
 
     # ------------------------------------------------------------------
     def handle_query(self, sql: str, stmt=None) -> ResultTable:
@@ -359,6 +375,37 @@ class Broker:
             phys = self._physical_tables(raw_table)
             return self.catalog.schema_for_table(phys[0]) if phys else None
 
+        def stage_runner():
+            """Round-robin dispatch of join partitions to HEALTHY server
+            workers (the reference's intermediate-stage workers); local
+            fallback when no worker is wired or a dispatch fails mid-query."""
+            import itertools
+
+            from ..multistage.runtime import hash_join
+            from ..utils.metrics import get_registry
+            unhealthy = self.routing.unhealthy_servers()
+            with self._lock:
+                workers = [(sid, h) for sid, h in self._stage.items()
+                           if sid not in unhealthy]
+            if not workers:
+                return None
+            rr = itertools.count()
+
+            def run(spec, lp, rp):
+                sid, h = workers[next(rr) % len(workers)]
+                try:
+                    return h(spec, lp, rp)
+                except Exception:
+                    # degrade to broker-local execution, but VISIBLY: the
+                    # failed worker leaves routing until its probe passes, and
+                    # the meter shows the distributed path regressing
+                    get_registry().counter(
+                        "pinot_broker_stage_dispatch_failures").inc()
+                    self.routing.mark_server_unhealthy(sid)
+                    self.failure_detector.notify_unhealthy(sid)
+                    return hash_join(lp, rp, spec)
+            return run
+
         def scan(raw_table: str, columns, filt):
             from ..sql.ast import _sql_ident, to_sql
             if not self.quota.try_acquire_all(self._physical_tables(raw_table)):
@@ -409,7 +456,8 @@ class Broker:
                           else np.asarray(vals, dtype=object))
             return out
 
-        return execute_multistage(stmt, scan, schema_for)
+        return execute_multistage(stmt, scan, schema_for,
+                                  stage_runner=stage_runner())
 
     def _physical_tables(self, raw_table: str) -> List[str]:
         """Resolve a logical name to physical tables; hybrid tables hit both OFFLINE

@@ -224,6 +224,18 @@ class RemoteServerHandle:
                          content_type="application/octet-stream")
         return json.loads(resp.decode())["rows"]
 
+    def join_stage(self, spec, left, right):
+        """Run one multistage join partition on the remote server (POST /stage
+        with wire-encoded blocks — the worker-mailbox dispatch)."""
+        from ..multistage.runtime import spec_to_json
+        from .wire import decode_block, decode_value, encode_value
+        body = encode_value({"spec": spec_to_json(spec),
+                             "left": dict(left), "right": dict(right)})
+        resp = http_call("POST", f"{self.server_url}/stage", body,
+                         timeout=self.timeout_s,
+                         content_type="application/octet-stream")
+        return decode_block(decode_value(resp))
+
 
 class ControllerDeepStore(DeepStoreFS):
     """Deep-store access proxied through the controller by URL (reference: the http

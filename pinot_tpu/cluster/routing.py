@@ -96,6 +96,10 @@ class RoutingManager:
         with self._lock:
             self._unhealthy.add(server)
 
+    def unhealthy_servers(self) -> Set[str]:
+        with self._lock:
+            return set(self._unhealthy)
+
     def mark_server_healthy(self, server: str) -> None:
         with self._lock:
             self._unhealthy.discard(server)

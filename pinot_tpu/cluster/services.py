@@ -294,6 +294,7 @@ class ServerService:
         self.http = HttpService(host, port, access_control=access_control)
         self.http.route("POST", "query", self._query)
         self.http.route("POST", "explain", self._explain)
+        self.http.route("POST", "stage", self._stage)
         self.http.route("GET", "health", self._health)
         self.http.route("GET", "segments", self._segments)
         self.http.route("GET", "metrics", _metrics_route)
@@ -351,6 +352,20 @@ class ServerService:
                                            req["segments"])
         return json_response({"rows": rows})
 
+    def _stage(self, parts, params, body):
+        """POST /stage — run one multistage join partition on this server
+        (reference: an intermediate-stage worker consuming its mailbox).
+        Body/response are wire-encoded blocks, the same columnar format the
+        query path returns."""
+        from ..multistage.runtime import hash_join, spec_from_json
+        from ..utils.metrics import get_registry
+        from .wire import decode_block, decode_value, encode_value
+        d = decode_value(body)
+        out = hash_join(decode_block(d["left"]), decode_block(d["right"]),
+                        spec_from_json(d["spec"]))
+        get_registry().counter("pinot_server_join_stages").inc()
+        return binary_response(encode_value(out))
+
     def _segments(self, parts, params, body):
         return json_response({"segments": self.server.segments_served(parts[0])})
 
@@ -400,7 +415,7 @@ class BrokerService:
                 continue
             if not info.alive:
                 if self._registered.pop(info.instance_id, None):
-                    self.broker.failure_detector.remove(info.instance_id)
+                    self.broker.unregister_server(info.instance_id)
                 continue
             url = f"http://{info.host}:{info.port}"
             if self._registered.get(info.instance_id) == url:
@@ -420,7 +435,8 @@ class BrokerService:
                     return False
             self.broker.register_server_handle(info.instance_id, handle,
                                                explain_handle=handle.explain,
-                                               probe=probe)
+                                               probe=probe,
+                                               stage_handle=handle.join_stage)
 
     def _query(self, parts, params, body):
         d = json.loads(body.decode())

@@ -234,6 +234,14 @@ def decode_segment_result(data: bytes) -> SegmentResult:
     return r
 
 
+def decode_block(d: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Columnar block off the wire: numeric ndarrays roundtrip natively (tag
+    'a'); OBJECT columns (strings) decay to lists and come back here as object
+    arrays — never as numpy unicode, which would break null (None) cells."""
+    return {k: (v if isinstance(v, np.ndarray)
+                else np.asarray(v, dtype=object)) for k, v in d.items()}
+
+
 def encode_query_request(table: str, sql: str, segments,
                          time_filter: str = None, trace: bool = False) -> bytes:
     """Broker -> server query dispatch (reference: thrift InstanceRequest with the

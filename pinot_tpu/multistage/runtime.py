@@ -393,10 +393,42 @@ def _py(v):
 # execution
 # ---------------------------------------------------------------------------
 
+# a stage runner executes ONE partition's hash join; the default is the local
+# hash_join, the broker substitutes a round-robin dispatch to server workers
+# (reference: intermediate-stage workers receiving partitioned blocks through
+# GrpcMailboxService)
+StageRunner = Callable[[JoinSpec, Block, Block], Block]
+
+
+def spec_to_json(spec: JoinSpec) -> Dict[str, Any]:
+    """JoinSpec -> wire-safe dict (residual exprs ride as SQL text)."""
+    from ..sql.ast import to_sql
+    return {
+        "rightAlias": spec.right_alias,
+        "joinType": spec.join_type,
+        "leftKeys": list(spec.left_keys),
+        "rightKeys": list(spec.right_keys),
+        "residual": to_sql(spec.residual) if spec.residual is not None else None,
+    }
+
+
+def spec_from_json(d: Dict[str, Any]) -> JoinSpec:
+    from ..sql.parser import parse_query
+    residual = None
+    if d.get("residual"):
+        residual = parse_query(f"SELECT * FROM t WHERE {d['residual']}").where
+    return JoinSpec(right_alias=d["rightAlias"], join_type=d["joinType"],
+                    left_keys=list(d["leftKeys"]), right_keys=list(d["rightKeys"]),
+                    residual=residual)
+
+
 def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
-                       num_partitions: int = DEFAULT_PARTITIONS) -> ResultTable:
+                       num_partitions: int = DEFAULT_PARTITIONS,
+                       stage_runner: Optional[StageRunner] = None) -> ResultTable:
     """Run a join query: leaf scans -> hash exchange -> per-partition joins ->
-    aggregate/selection -> broker reduce."""
+    aggregate/selection -> broker reduce. Partitions run through `stage_runner`
+    CONCURRENTLY (default: local hash_join; the broker passes a dispatcher that
+    ships partitions to server workers over the wire)."""
     plan: MultistagePlan = (sql_or_plan if isinstance(sql_or_plan, MultistagePlan)
                             else plan_multistage(sql_or_plan, schema_for))
     ctx = plan.ctx
@@ -404,6 +436,8 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
     group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
                    else list(ctx.group_by))
     mailboxes = MailboxService()
+    runner: StageRunner = stage_runner if stage_runner is not None else \
+        (lambda spec, lp, rp: hash_join(lp, rp, spec))
 
     # -- leaf scan stages (single-stage engine per table) ------------------
     blocks: Dict[str, Block] = {}
@@ -412,6 +446,7 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
         blocks[alias] = {f"{alias}.{c}": np.asarray(v) for c, v in raw.items()}
 
     # -- join pipeline: hash exchange + per-partition joins ----------------
+    from concurrent.futures import ThreadPoolExecutor
     current = blocks[plan.base_alias]
     for si, spec in enumerate(plan.joins):
         right = blocks[spec.right_alias]
@@ -422,11 +457,21 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
         for p, blk in enumerate(_partition_block(right, spec.right_keys,
                                                  num_partitions)):
             mailboxes.send(f"{stage}.R", p, blk)
-        parts = []
-        for p in range(num_partitions):
+
+        def one_partition(p: int) -> Block:
             lp = _concat_blocks(mailboxes.receive(f"{stage}.L", p))
             rp = _concat_blocks(mailboxes.receive(f"{stage}.R", p))
-            parts.append(hash_join(lp, rp, spec))
+            # trivial partitions join locally — an empty (or inner-join
+            # one-sided-empty) partition is O(columns) here but a full wire
+            # round trip through a remote stage runner
+            if (_block_rows(lp) == 0 and _block_rows(rp) == 0) or \
+                    (spec.join_type == "inner"
+                     and (_block_rows(lp) == 0 or _block_rows(rp) == 0)):
+                return hash_join(lp, rp, spec)
+            return runner(spec, lp, rp)
+        with ThreadPoolExecutor(max_workers=min(8, num_partitions),
+                                thread_name_prefix=f"stage-{stage}") as pool:
+            parts = list(pool.map(one_partition, range(num_partitions)))
         current = _concat_blocks(parts)
 
     if plan.post_filter is not None and _block_rows(current):

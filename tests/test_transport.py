@@ -217,11 +217,17 @@ def test_http_cluster_multistage_join(http_cluster):
         for node, _, _ in http_cluster["servers"]))
 
     from pinot_tpu.cluster.process import BrokerClient
+    from pinot_tpu.utils.metrics import get_registry
+    stages_before = get_registry().counter_value("pinot_server_join_stages")
     bc = BrokerClient(http_cluster["bsvc"].url)
     resp = bc.query(
         "SELECT c.state, SUM(t.fare) AS total FROM trips t "
         "JOIN cities c ON t.city = c.city GROUP BY c.state ORDER BY total DESC")
     assert resp["resultTable"]["rows"] == [["NY", 40.0], ["CA", 20.0]]
+    # the join partitions actually executed ON SERVERS over the wire (the
+    # worker-mailbox dispatch), not broker-locally
+    assert get_registry().counter_value("pinot_server_join_stages") \
+        >= stages_before + 1
 
 
 # -- real multi-process cluster ----------------------------------------------
