@@ -390,19 +390,27 @@ class Broker:
             if not workers:
                 return None
             rr = itertools.count()
+            lock = threading.Lock()
 
             def run(spec, lp, rp):
-                sid, h = workers[next(rr) % len(workers)]
+                with lock:
+                    pool = list(workers)
+                if not pool:
+                    return hash_join(lp, rp, spec)
+                sid, h = pool[next(rr) % len(pool)]
                 try:
                     return h(spec, lp, rp)
                 except Exception:
                     # degrade to broker-local execution, but VISIBLY: the
-                    # failed worker leaves routing until its probe passes, and
-                    # the meter shows the distributed path regressing
+                    # failed worker leaves routing until its probe passes, the
+                    # meter shows the regression, and THIS query stops sending
+                    # further partitions into the dead worker's timeout
                     get_registry().counter(
                         "pinot_broker_stage_dispatch_failures").inc()
                     self.routing.mark_server_unhealthy(sid)
                     self.failure_detector.notify_unhealthy(sid)
+                    with lock:
+                        workers[:] = [(s, hh) for s, hh in workers if s != sid]
                     return hash_join(lp, rp, spec)
             return run
 

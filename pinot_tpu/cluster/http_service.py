@@ -194,11 +194,14 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
     raise ConnectionError(f"{method} {url} failed: {last}") from last
 
 
-def get_json(url: str, timeout: float = 30.0, retries: int = 0) -> Any:
-    return json.loads(http_call("GET", url, timeout=timeout, retries=retries).decode())
+def get_json(url: str, timeout: float = 30.0, retries: int = 0,
+             token: Optional[str] = None) -> Any:
+    return json.loads(http_call("GET", url, timeout=timeout, retries=retries,
+                                token=token).decode())
 
 
-def post_json(url: str, obj: Any, timeout: float = 30.0, retries: int = 0) -> Any:
+def post_json(url: str, obj: Any, timeout: float = 30.0, retries: int = 0,
+              token: Optional[str] = None) -> Any:
     data = json.dumps(obj).encode()
     return json.loads(http_call("POST", url, data, timeout=timeout,
-                                retries=retries).decode())
+                                retries=retries, token=token).decode())

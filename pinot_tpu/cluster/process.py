@@ -140,21 +140,23 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
 
 class ControllerClient:
     """HTTP admin client for a controller (reference: the java-client /
-    controller REST API consumers)."""
+    controller REST API consumers). `token` is per-client: each request carries
+    it explicitly, never via process-global state."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, token: Optional[str] = None):
         self.url = url.rstrip("/")
+        self.token = token
 
     def add_schema(self, schema) -> None:
-        post_json(f"{self.url}/schemas", schema.to_json())
+        post_json(f"{self.url}/schemas", schema.to_json(), token=self.token)
 
     def add_table(self, config, num_partitions: int = 1) -> Dict:
         return post_json(f"{self.url}/tables",
                          {"config": config.to_json(),
-                          "numPartitions": num_partitions})
+                          "numPartitions": num_partitions}, token=self.token)
 
     def drop_table(self, table: str) -> None:
-        http_call("DELETE", f"{self.url}/tables/{table}")
+        http_call("DELETE", f"{self.url}/tables/{table}", token=self.token)
 
     def upload_segment(self, table: str, segment_dir: str) -> Dict:
         """Tar a built segment dir and push it (reference: segment tar push)."""
@@ -168,33 +170,36 @@ class ControllerClient:
         q = urllib.parse.urlencode({"name": name})
         return json.loads(http_call(
             "POST", f"{self.url}/segments/{table}?{q}", payload,
-            content_type="application/octet-stream", timeout=120.0).decode())
+            content_type="application/octet-stream", timeout=120.0,
+            token=self.token).decode())
 
     def table_status(self, table: str) -> Dict:
-        return get_json(f"{self.url}/tableStatus/{table}")
+        return get_json(f"{self.url}/tableStatus/{table}", token=self.token)
 
     def list_tables(self) -> Dict:
-        return get_json(f"{self.url}/tables")
+        return get_json(f"{self.url}/tables", token=self.token)
 
     def table_config(self, table: str) -> Dict:
-        return get_json(f"{self.url}/tables/{table}")
+        return get_json(f"{self.url}/tables/{table}", token=self.token)
 
     def segments_meta(self, table: str) -> Dict:
-        return get_json(f"{self.url}/segmentsMeta/{table}")
+        return get_json(f"{self.url}/segmentsMeta/{table}", token=self.token)
 
     def reload_table(self, table: str) -> Dict:
-        return post_json(f"{self.url}/reload/{table}", {})
+        return post_json(f"{self.url}/reload/{table}", {}, token=self.token)
 
     def rebalance(self, table: str) -> Dict:
-        return post_json(f"{self.url}/rebalance/{table}", {})
+        return post_json(f"{self.url}/rebalance/{table}", {}, token=self.token)
 
 
 class BrokerClient:
-    def __init__(self, url: str):
+    def __init__(self, url: str, token: Optional[str] = None):
         self.url = url.rstrip("/")
+        self.token = token
 
     def query(self, sql: str, timeout: float = 120.0) -> Dict:
-        return post_json(f"{self.url}/query", {"sql": sql}, timeout=timeout)
+        return post_json(f"{self.url}/query", {"sql": sql}, timeout=timeout,
+                         token=self.token)
 
 
 class ProcessCluster:

@@ -84,6 +84,8 @@ class ControllerService:
         s.route("POST", "reload", self._reload_table, action="WRITE")
         s.route("POST", "rebalance", self._rebalance, action="ADMIN")
         s.route("GET", "metrics", _metrics_route)
+        s.route("GET", "", self._ui)       # minimal admin UI at /
+        s.route("GET", "ui", self._ui)
         self.http.start()
 
     @property
@@ -92,6 +94,38 @@ class ControllerService:
 
     def stop(self) -> None:
         self.http.stop()
+
+    def _ui(self, parts, params, body):
+        """GET / — a minimal server-rendered status page (stand-in for the
+        reference's controller admin webapp): tables, segments, instances."""
+        from html import escape
+        with self.catalog._lock:
+            tables = {
+                t: {"segments": len(self.catalog.segments.get(t, {})),
+                    "replication": cfg.replication,
+                    "type": "REALTIME" if cfg.stream else "OFFLINE"}
+                for t, cfg in self.catalog.table_configs.items()}
+            instances = [(i.instance_id, i.role, "UP" if i.alive else "DOWN")
+                         for i in self.catalog.instances.values()]
+        # escape EVERY catalog-derived value: table/instance names are
+        # client-supplied and would otherwise be stored XSS in the operator UI
+        rows = "".join(
+            f"<tr><td>{escape(t)}</td><td>{d['type']}</td><td>{d['segments']}</td>"
+            f"<td>{d['replication']}</td></tr>" for t, d in sorted(tables.items()))
+        inst = "".join(
+            f"<tr><td>{escape(i)}</td><td>{escape(r)}</td><td>{s}</td></tr>"
+            for i, r, s in sorted(instances))
+        html = (
+            "<!doctype html><title>pinot-tpu controller</title>"
+            "<style>body{font-family:sans-serif;margin:2em}table{border-collapse:"
+            "collapse}td,th{border:1px solid #ccc;padding:4px 10px}</style>"
+            "<h1>pinot-tpu controller</h1>"
+            "<h2>Tables</h2><table><tr><th>table</th><th>type</th>"
+            f"<th>segments</th><th>replication</th></tr>{rows}</table>"
+            "<h2>Instances</h2><table><tr><th>instance</th><th>role</th>"
+            f"<th>status</th></tr>{inst}</table>"
+            "<p><a href=/metrics>metrics</a> · <a href=/tables>tables api</a></p>")
+        return 200, "text/html", html.encode()
 
     # -- catalog API (the ZooKeeper stand-in) -------------------------------
     def _bump_version(self, event: str, table: str) -> None:

@@ -5,13 +5,11 @@ Analog of the reference's `pinot-query-runtime` operator chain
 `MailboxSendOperator`/`MailboxReceiveOperator` over `GrpcMailboxService`,
 `QueryDispatcher.submitAndReduce`, SURVEY.md §3.4). Data moves between stages as
 columnar blocks (`Dict[col -> np.ndarray]`) through an in-process mailbox service
-(a dict of queues). Distribution TODAY: LEAF SCANS cross process boundaries — the
-broker's scan provider scatters them to servers over the HTTP transport and the
-blocks come back on the binary wire format — while the join/aggregate stages above
-the leaves run inside the broker process. Stage-level worker distribution (the
-reference's GrpcMailboxService between query-runtime workers) is not implemented;
-`wire.encode_value` already serializes the block format those mailboxes would carry.
-Leaf scans reuse the single-stage device engine (exactly as the reference's leaf
+(a dict of queues). Distribution: LEAF SCANS scatter to servers over the HTTP
+transport, and JOIN-STAGE PARTITIONS dispatch to server workers through the
+pluggable `stage_runner` (the broker ships wire-encoded blocks to POST /stage —
+the worker-mailbox analog); the final aggregate/reduce runs broker-side. Leaf
+scans reuse the single-stage device engine (exactly as the reference's leaf
 stages reuse `ServerQueryExecutorV1Impl`).
 
 Join null semantics: outer-join null-extended numeric columns become float NaN and
@@ -393,6 +391,21 @@ def _py(v):
 # execution
 # ---------------------------------------------------------------------------
 
+# one long-lived partition pool per process: a pool-per-stage-per-query would
+# churn thread create/destroy on the broker's hot path. one_partition never
+# re-submits into this pool, so nested-wait deadlock is impossible.
+_STAGE_POOL = None
+
+
+def _stage_pool():
+    global _STAGE_POOL
+    if _STAGE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _STAGE_POOL = ThreadPoolExecutor(max_workers=8,
+                                         thread_name_prefix="stage-part")
+    return _STAGE_POOL
+
+
 # a stage runner executes ONE partition's hash join; the default is the local
 # hash_join, the broker substitutes a round-robin dispatch to server workers
 # (reference: intermediate-stage workers receiving partitioned blocks through
@@ -446,7 +459,6 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
         blocks[alias] = {f"{alias}.{c}": np.asarray(v) for c, v in raw.items()}
 
     # -- join pipeline: hash exchange + per-partition joins ----------------
-    from concurrent.futures import ThreadPoolExecutor
     current = blocks[plan.base_alias]
     for si, spec in enumerate(plan.joins):
         right = blocks[spec.right_alias]
@@ -469,9 +481,7 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
                      and (_block_rows(lp) == 0 or _block_rows(rp) == 0)):
                 return hash_join(lp, rp, spec)
             return runner(spec, lp, rp)
-        with ThreadPoolExecutor(max_workers=min(8, num_partitions),
-                                thread_name_prefix=f"stage-{stage}") as pool:
-            parts = list(pool.map(one_partition, range(num_partitions)))
+        parts = list(_stage_pool().map(one_partition, range(num_partitions)))
         current = _concat_blocks(parts)
 
     if plan.post_filter is not None and _block_rows(current):

@@ -160,6 +160,33 @@ def test_segment_download_respects_table_acl(secured_cluster):
     assert ei.value.status == 403
 
 
+def test_two_client_connections_with_different_tokens(secured_cluster):
+    """Per-connection credentials: one process, two identities, no clobbering
+    (the client must not route tokens through process-global state)."""
+    import time
+    from pinot_tpu.client import connect
+    from pinot_tpu.cluster.http_service import HttpError
+    _setup_table(secured_cluster)
+    admin = connect(secured_cluster["bsvc"].url, token="admin")
+    reader = connect(secured_cluster["bsvc"].url, token="reader")
+    deadline = time.time() + 20   # broker catalog mirror converges via polls
+    while time.time() < deadline:
+        try:
+            if admin.execute("SELECT COUNT(*) FROM trips").scalar() == 2:
+                break
+        except HttpError:
+            pass
+        time.sleep(0.2)
+    assert admin.execute("SELECT COUNT(*) FROM trips").scalar() == 2
+    assert reader.execute("SELECT COUNT(*) FROM trips").scalar() == 2
+    # reader stays scoped even after the admin connection was created LAST-ish
+    with pytest.raises(HttpError) as ei:
+        reader.execute("SELECT COUNT(*) FROM secrets")
+    assert ei.value.status == 403
+    # and the admin connection still carries ITS token afterwards
+    assert admin.execute("SELECT COUNT(*) FROM trips").scalar() == 2
+
+
 def test_missing_token_is_401(secured_cluster):
     from pinot_tpu.cluster.http_service import HttpError, http_call
     with pytest.raises(HttpError) as ei:

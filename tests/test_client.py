@@ -1,0 +1,76 @@
+"""Python client + controller UI tests (reference: pinot-java-client / pinotdb
+connect-and-execute surface, controller admin webapp)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.client import connect
+from pinot_tpu.schema import Schema, dimension, metric
+from pinot_tpu.table import TableConfig
+
+
+@pytest.fixture()
+def http_stack(tmp_path):
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+    catalog = Catalog()
+    ctrl = Controller("c0", catalog, LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "c"))
+    csvc = ControllerService(ctrl)
+    cats = [RemoteCatalog(csvc.url, poll_timeout_s=1.0)]
+    node = ServerNode("server_0", cats[0], ControllerDeepStore(csvc.url),
+                      str(tmp_path / "s0"))
+    ssvc = ServerService(node)
+    cats.append(RemoteCatalog(csvc.url, poll_timeout_s=1.0))
+    bsvc = BrokerService(Broker("b0", cats[1]))
+    try:
+        yield csvc, bsvc, node, tmp_path
+    finally:
+        for c in cats:
+            c.close()
+        for s in (csvc, ssvc, bsvc):
+            s.stop()
+
+
+def test_connect_and_execute(http_stack):
+    csvc, bsvc, node, tmp = http_stack
+    conn = connect(bsvc.url, controller=csvc.url)
+    schema = Schema("trips", [dimension("city"), metric("fare")])
+    conn.admin.add_schema(schema)
+    conn.admin.add_table(TableConfig("trips"))
+    from pinot_tpu.segment.writer import SegmentBuilder
+    seg = SegmentBuilder(schema).build(
+        {"city": ["nyc", "sf", "nyc"], "fare": np.array([1.0, 2.0, 3.0])},
+        str(tmp / "b"), "trips_0")
+    conn.admin.upload_segment("trips_OFFLINE", seg)
+    import time
+    deadline = time.time() + 20
+    while time.time() < deadline:   # broker catalog mirror converges via polls
+        try:
+            if conn.execute("SELECT COUNT(*) FROM trips").scalar() == 3:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+
+    rs = conn.execute("SELECT city, SUM(fare) FROM trips GROUP BY city "
+                      "ORDER BY city LIMIT 5")
+    assert rs.columns == ["city", "sum(fare)"]
+    assert list(rs) == [["nyc", 4.0], ["sf", 2.0]]
+    assert len(rs) == 2 and rs.first() == ["nyc", 4.0]
+    assert conn.execute("SELECT COUNT(*) FROM trips").scalar() == 3
+    assert "timeUsedMs" in rs.stats
+
+
+def test_controller_ui(http_stack):
+    csvc, bsvc, node, tmp = http_stack
+    from pinot_tpu.cluster.http_service import http_call
+    html = http_call("GET", f"{csvc.url}/").decode()
+    assert "pinot-tpu controller" in html
+    assert "server_0" in html and "b0" in html
