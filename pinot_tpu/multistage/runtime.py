@@ -395,15 +395,17 @@ def _py(v):
 # churn thread create/destroy on the broker's hot path. one_partition never
 # re-submits into this pool, so nested-wait deadlock is impossible.
 _STAGE_POOL = None
+_STAGE_POOL_LOCK = __import__("threading").Lock()
 
 
 def _stage_pool():
     global _STAGE_POOL
-    if _STAGE_POOL is None:
-        from concurrent.futures import ThreadPoolExecutor
-        _STAGE_POOL = ThreadPoolExecutor(max_workers=8,
-                                         thread_name_prefix="stage-part")
-    return _STAGE_POOL
+    with _STAGE_POOL_LOCK:  # unsynchronized check-then-set would orphan a pool
+        if _STAGE_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _STAGE_POOL = ThreadPoolExecutor(max_workers=8,
+                                             thread_name_prefix="stage-part")
+        return _STAGE_POOL
 
 
 # a stage runner executes ONE partition's hash join; the default is the local

@@ -1,0 +1,101 @@
+"""Table config tuner: recommend indexing/encoding from observed data shape.
+
+Analog of the reference's config recommendation engine
+(`pinot-controller/.../recommender/`): given a built segment (the data's
+statistical profile) and optionally the query shapes, propose an
+IndexingConfig — which columns want inverted/range/bloom indexes, which
+metrics should skip the dictionary, where a star-tree pays off.
+
+Heuristics mirror the reference's rules engine, adapted to THIS engine's cost
+model: dictionary LUT filters are nearly free on the device (id-interval
+compares), so inverted indexes matter mainly for very selective host-path
+lookups; no-dictionary raw encoding matters for high-cardinality numerics
+(dict adds an indirection the device path must host-materialize anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..segment.reader import ImmutableSegment, load_segment
+from ..table import IndexingConfig
+
+
+def analyze_segment(seg_or_dir) -> Dict[str, Dict[str, Any]]:
+    """Per-column profile: cardinality ratio, type, encoding, MV-ness."""
+    seg: ImmutableSegment = (seg_or_dir if isinstance(seg_or_dir, ImmutableSegment)
+                             else load_segment(seg_or_dir))
+    out: Dict[str, Dict[str, Any]] = {}
+    n = max(seg.num_docs, 1)
+    for name in seg.column_names:
+        r = seg.column(name)
+        card = r.cardinality if r.has_dictionary else None
+        out[name] = {
+            "dataType": r.data_type.value,
+            "numeric": r.data_type.is_numeric,
+            "hasDictionary": r.has_dictionary,
+            "cardinality": card,
+            "cardinalityRatio": (card / n) if card is not None else 1.0,
+            "multiValue": getattr(r, "is_multi_value", False),
+            "sorted": r.is_sorted,
+            "indexes": list(r.index_types),
+        }
+    return out
+
+
+def recommend(seg_or_dir, filter_columns: Optional[List[str]] = None,
+              group_by_columns: Optional[List[str]] = None,
+              agg_columns: Optional[List[str]] = None) -> Dict[str, Any]:
+    """IndexingConfig proposal + per-recommendation rationale.
+
+    `filter_columns`/`group_by_columns`/`agg_columns` describe the workload
+    (the reference feeds query patterns into its rules engine); omitted, every
+    dimension is assumed filterable.
+    """
+    profile = analyze_segment(seg_or_dir)
+    filt = set(filter_columns if filter_columns is not None else
+               [c for c, p in profile.items() if not p["numeric"]])
+    group = set(group_by_columns or [])
+    aggs = set(agg_columns or [])
+
+    cfg = IndexingConfig()
+    why: List[str] = []
+    for col, p in profile.items():
+        ratio = p["cardinalityRatio"]
+        if p["numeric"] and not p["multiValue"] and ratio > 0.7 \
+                and col not in group:
+            cfg.no_dictionary_columns.append(col)
+            why.append(f"{col}: cardinality ratio {ratio:.2f} > 0.7 — raw "
+                       f"encoding (dictionary adds indirection without reuse)")
+            if col in filt:
+                cfg.range_index_columns.append(col)
+                why.append(f"{col}: raw + filtered — range index for "
+                           f"selective range predicates")
+                cfg.bloom_filter_columns.append(col)
+                why.append(f"{col}: raw + filtered — bloom filter folds "
+                           f"absent-value EQ to constant false at plan time")
+            continue
+        if col in filt and p["hasDictionary"]:
+            if p["cardinality"] is not None and p["cardinality"] <= 10_000 \
+                    and ratio < 0.1:
+                cfg.inverted_index_columns.append(col)
+                why.append(f"{col}: low-cardinality filtered dimension — "
+                           f"inverted index for very selective host lookups "
+                           f"(device LUT filters stay free either way)")
+    # star-tree: a few low-cardinality group dimensions + numeric aggregations
+    st_dims = [c for c in group
+               if profile.get(c, {}).get("cardinality") is not None
+               and profile[c]["cardinality"] <= 1000
+               and not profile[c]["multiValue"]]
+    if st_dims and aggs:
+        pairs = [f"SUM__{a}" for a in sorted(aggs)
+                 if profile.get(a, {}).get("numeric")]
+        if pairs:
+            cfg.star_tree_configs.append({
+                "dimensionsSplitOrder": sorted(st_dims),
+                "functionColumnPairs": pairs,
+                "maxLeafRecords": 10_000,
+            })
+            why.append(f"star-tree over {sorted(st_dims)}: repeated group-bys "
+                       f"with bounded key space pre-aggregate well")
+    return {"indexing": cfg.to_json(), "rationale": why, "profile": profile}

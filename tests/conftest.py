@@ -111,3 +111,19 @@ def ssb_segment_dir(tmp_path_factory, rng, ssb_schema):
     ))
     out = tmp_path_factory.mktemp("segments")
     return builder.build(cols, str(out), "lineorder_0"), cols
+
+
+def wait_until(fn, timeout: float = 20.0, interval: float = 0.2,
+               swallow: tuple = (Exception,)) -> bool:
+    """Poll until fn() is truthy (catalog-mirror convergence etc.); exceptions
+    in `swallow` count as not-yet (transient 500s during convergence)."""
+    import time as _t
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        try:
+            if fn():
+                return True
+        except swallow:
+            pass
+        _t.sleep(interval)
+    return False

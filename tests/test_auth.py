@@ -169,14 +169,9 @@ def test_two_client_connections_with_different_tokens(secured_cluster):
     _setup_table(secured_cluster)
     admin = connect(secured_cluster["bsvc"].url, token="admin")
     reader = connect(secured_cluster["bsvc"].url, token="reader")
-    deadline = time.time() + 20   # broker catalog mirror converges via polls
-    while time.time() < deadline:
-        try:
-            if admin.execute("SELECT COUNT(*) FROM trips").scalar() == 2:
-                break
-        except HttpError:
-            pass
-        time.sleep(0.2)
+    from conftest import wait_until
+    assert wait_until(   # broker catalog mirror converges via polls
+        lambda: admin.execute("SELECT COUNT(*) FROM trips").scalar() == 2)
     assert admin.execute("SELECT COUNT(*) FROM trips").scalar() == 2
     assert reader.execute("SELECT COUNT(*) FROM trips").scalar() == 2
     # reader stays scoped even after the admin connection was created LAST-ish
@@ -222,7 +217,11 @@ def test_table_scoped_query_acl(secured_cluster):
                          json.dumps({"sql": sql}).encode(), token=token)
         return json.loads(resp.decode())
 
-    # service/admin identity works end-to-end (segment upload above used it)
+    # service/admin identity works end-to-end (segment upload above used it);
+    # retry through the broker catalog-mirror convergence window
+    from conftest import wait_until
+    assert wait_until(lambda: query("SELECT SUM(fare) FROM trips",
+                                    "admin")["resultTable"]["rows"][0][0] == 3.0)
     out = query("SELECT SUM(fare) FROM trips", "admin")
     assert out["resultTable"]["rows"][0][0] == 3.0
     # reader is scoped to `trips`: allowed there...

@@ -49,15 +49,9 @@ def test_connect_and_execute(http_stack):
         {"city": ["nyc", "sf", "nyc"], "fare": np.array([1.0, 2.0, 3.0])},
         str(tmp / "b"), "trips_0")
     conn.admin.upload_segment("trips_OFFLINE", seg)
-    import time
-    deadline = time.time() + 20
-    while time.time() < deadline:   # broker catalog mirror converges via polls
-        try:
-            if conn.execute("SELECT COUNT(*) FROM trips").scalar() == 3:
-                break
-        except Exception:
-            pass
-        time.sleep(0.2)
+    from conftest import wait_until
+    assert wait_until(   # broker catalog mirror converges via polls
+        lambda: conn.execute("SELECT COUNT(*) FROM trips").scalar() == 3)
 
     rs = conn.execute("SELECT city, SUM(fare) FROM trips GROUP BY city "
                       "ORDER BY city LIMIT 5")

@@ -1,0 +1,102 @@
+"""Tuner/recommender + dataframe connector tests.
+
+Reference patterns: controller recommender rules engine, spark connector's
+dataframe -> segment write path.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.schema import DataType, FieldRole, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+from pinot_tpu.tools.tuner import analyze_segment, recommend
+
+
+@pytest.fixture(scope="module")
+def seg_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tuner")
+    rng = np.random.default_rng(6)
+    n = 5000
+    return SegmentBuilder(Schema("ev", [
+        dimension("country"),                       # low cardinality
+        dimension("user_id"),                       # high cardinality string
+        metric("price", DataType.DOUBLE),           # high cardinality numeric
+        metric("qty", DataType.INT),                # low cardinality numeric
+    ])).build({
+        "country": [f"c{i % 20}" for i in range(n)],
+        "user_id": [f"u{i}" for i in range(n)],
+        "price": np.round(rng.uniform(0, 1e6, n), 4),
+        "qty": (np.arange(n) % 9).astype(np.int32),
+    }, str(tmp), "ev_0")
+
+
+def test_analyze_profile(seg_dir):
+    p = analyze_segment(seg_dir)
+    assert p["country"]["cardinality"] == 20
+    assert p["country"]["cardinalityRatio"] < 0.01
+    assert p["price"]["hasDictionary"] is False   # writer's raw heuristic
+    assert p["user_id"]["cardinalityRatio"] == 1.0
+
+
+def test_recommendations(seg_dir):
+    rec = recommend(seg_dir, filter_columns=["country", "price"],
+                    group_by_columns=["country"], agg_columns=["price", "qty"])
+    idx = rec["indexing"]
+    assert "country" in idx["invertedIndexColumns"]     # low-card filtered dim
+    assert "price" in idx["rangeIndexColumns"]          # raw filtered numeric
+    assert "price" in idx["bloomFilterColumns"]
+    assert "user_id" not in idx["invertedIndexColumns"]  # unfiltered high-card
+    st = idx["starTreeIndexConfigs"]
+    assert st and st[0]["dimensionsSplitOrder"] == ["country"]
+    assert any("SUM__price" in p for p in st[0]["functionColumnPairs"])
+    assert rec["rationale"]                              # every choice explained
+    # the recommendation round-trips into a working build config
+    from pinot_tpu.table import IndexingConfig
+    cfg = IndexingConfig.from_json(idx)
+    assert SegmentGeneratorConfig.from_indexing(cfg).inverted_index_columns \
+        == ["country"]
+
+
+# -- dataframe connector ------------------------------------------------------
+
+def test_dataframe_roundtrip(tmp_path):
+    import pandas as pd
+    from pinot_tpu.ingest.dataframe import (schema_from_dataframe,
+                                            segments_from_dataframe)
+    from pinot_tpu.query.executor import execute_query
+    df = pd.DataFrame({
+        "city": ["nyc", "sf", "nyc", None],
+        "fare": [10.0, 20.0, 30.0, 5.0],
+        "n": np.array([1, 2, 3, 4], dtype=np.int64),
+    })
+    schema = schema_from_dataframe(df, "trips", metrics=["fare", "n"])
+    assert schema.field_spec("fare").role is FieldRole.METRIC
+    assert schema.field_spec("city").data_type is DataType.STRING
+    dirs = segments_from_dataframe(df, schema, str(tmp_path), "trips")
+    assert len(dirs) == 1
+    seg = load_segment(dirs[0])
+    assert seg.num_docs == 4
+    res = execute_query([seg], "SELECT SUM(fare) FROM trips WHERE city = 'nyc'")
+    assert res.rows[0][0] == pytest.approx(40.0)
+    # the None city row landed as a recorded null
+    res = execute_query([seg], "SELECT COUNT(*) FROM trips WHERE city IS NULL")
+    assert res.rows[0][0] == 1
+
+
+def test_dataframe_partitions_and_push(tmp_path):
+    import pandas as pd
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.ingest.dataframe import push_dataframe, schema_from_dataframe
+    from pinot_tpu.table import TableConfig
+    parts = [pd.DataFrame({"k": [f"p{i}"] * 100, "v": np.arange(100.0)})
+             for i in range(3)]
+    schema = schema_from_dataframe(parts[0], "pt", metrics=["v"])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cluster.create_table(schema, TableConfig("pt"))
+    dirs = push_dataframe(iter(parts), schema, cluster.controller, "pt_OFFLINE",
+                          str(tmp_path / "b"))
+    assert len(dirs) == 3               # one segment per partition frame
+    res = cluster.query("SELECT k, COUNT(*) FROM pt GROUP BY k LIMIT 10")
+    assert sorted((r[0], r[1]) for r in res.rows) == \
+        [("p0", 100), ("p1", 100), ("p2", 100)]
