@@ -28,7 +28,14 @@ def schema_from_dataframe(df, name: str,
     fields: List[FieldSpec] = []
     for col in df.columns:
         kind = df[col].dtype.kind
-        if kind in "iu":
+        if kind == "u":
+            # unsigned widths promote one step (uint32's top half would wrap a
+            # signed INT); uint64 has no signed container -> DOUBLE, lossy past
+            # 2^53 but never silently negative
+            size = df[col].dtype.itemsize
+            dt = (DataType.DOUBLE if size >= 8 else
+                  DataType.LONG if size >= 4 else DataType.INT)
+        elif kind == "i":
             dt = DataType.LONG if df[col].dtype.itemsize > 4 else DataType.INT
         elif kind == "f":
             dt = DataType.DOUBLE
@@ -48,17 +55,15 @@ def _columns_from_frame(df, schema: Schema) -> Dict[str, Any]:
         if spec.name not in df.columns:
             continue
         s = df[spec.name]
-        if spec.data_type.is_numeric:
-            # pandas nullable values -> None so the writer's null path records them
-            if s.isna().any():
-                cols[spec.name] = [None if v else x for v, x in
-                                   zip(s.isna(), s.tolist())]
-            else:
-                cols[spec.name] = np.asarray(s.to_numpy())
+        # s.isna() covers None, NaN AND pd.NA (arrow-backed nullable dtypes from
+        # spark_df.toPandas()) — hand-rolled checks miss pd.NA, whose truthiness
+        # raises inside the writer
+        na = s.isna()
+        if spec.data_type.is_numeric and not na.any():
+            cols[spec.name] = np.asarray(s.to_numpy())
         else:
-            cols[spec.name] = [None if v is None or (isinstance(v, float)
-                                                     and np.isnan(v)) else v
-                               for v in s.tolist()]
+            cols[spec.name] = [None if isna else v
+                               for isna, v in zip(na.tolist(), s.tolist())]
     return cols
 
 

@@ -61,7 +61,18 @@ class SegmentGeneratorConfig:
             text_index_columns=list(getattr(idx, "text_index_columns", [])),
             geo_index_pairs=list(getattr(idx, "geo_index_pairs", [])),
             raw_compression=getattr(idx, "raw_compression", ""),
+            star_tree_configs=[_star_tree_cfg(d)
+                               for d in getattr(idx, "star_tree_configs", [])],
         )
+
+
+def _star_tree_cfg(d):
+    """IndexingConfig carries star-tree configs as JSON dicts; the builder
+    wants StarTreeIndexConfig objects (tuner recommendations round-trip)."""
+    if isinstance(d, dict):
+        from .startree import StarTreeIndexConfig
+        return StarTreeIndexConfig.from_json(d)
+    return d
 
 
 class SegmentBuilder:

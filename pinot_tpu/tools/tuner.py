@@ -66,15 +66,19 @@ def recommend(seg_or_dir, filter_columns: Optional[List[str]] = None,
                 and col not in group:
             cfg.no_dictionary_columns.append(col)
             why.append(f"{col}: cardinality ratio {ratio:.2f} > 0.7 — raw "
-                       f"encoding (dictionary adds indirection without reuse)")
+                       f"encoding (dictionary adds indirection without reuse); "
+                       f"range predicates ride device compares + min/max "
+                       f"metadata pruning (range indexes need dict ids)")
             if col in filt:
-                cfg.range_index_columns.append(col)
-                why.append(f"{col}: raw + filtered — range index for "
-                           f"selective range predicates")
                 cfg.bloom_filter_columns.append(col)
                 why.append(f"{col}: raw + filtered — bloom filter folds "
                            f"absent-value EQ to constant false at plan time")
             continue
+        if col in filt and p["hasDictionary"] and p["numeric"] \
+                and not p["multiValue"] and 0.1 <= ratio <= 0.7:
+            cfg.range_index_columns.append(col)
+            why.append(f"{col}: dict-encoded filtered numeric — range index "
+                       f"for selective host-path range predicates")
         if col in filt and p["hasDictionary"]:
             if p["cardinality"] is not None and p["cardinality"] <= 10_000 \
                     and ratio < 0.1:

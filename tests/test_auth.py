@@ -163,7 +163,6 @@ def test_segment_download_respects_table_acl(secured_cluster):
 def test_two_client_connections_with_different_tokens(secured_cluster):
     """Per-connection credentials: one process, two identities, no clobbering
     (the client must not route tokens through process-global state)."""
-    import time
     from pinot_tpu.client import connect
     from pinot_tpu.cluster.http_service import HttpError
     _setup_table(secured_cluster)

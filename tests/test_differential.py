@@ -1,0 +1,197 @@
+"""Randomized differential testing: this engine vs sqlite3 as the oracle.
+
+Reference pattern: the reference cross-checks its two engines against each
+other and against H2 in integration tests (`BaseQueriesTest`,
+OfflineClusterIntegrationTest's H2 comparisons). Here the oracle is stdlib
+sqlite3: generate random queries in the shared SQL dialect, run them on BOTH
+engines over identical data, and compare row sets (float tolerances per path —
+see TOL). Runs device + host paths, so it differentially checks THREE
+implementations per query.
+
+Seeded, so failures reproduce; the generator prints the SQL on mismatch.
+"""
+
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+N = 3000
+RNG = np.random.default_rng(20260730)
+
+COLS = {
+    "dim_a": [f"a{i}" for i in RNG.integers(0, 12, N)],
+    "dim_b": [f"b{i}" for i in RNG.integers(0, 5, N)],
+    "num_i": RNG.integers(-50, 50, N).astype(np.int32),
+    "num_j": RNG.integers(0, 1000, N).astype(np.int32),
+    "val_x": np.round(RNG.uniform(-100, 100, N), 3),
+    "val_y": np.round(RNG.exponential(10, N), 3),
+}
+
+SCHEMA = Schema("diff", [
+    dimension("dim_a"), dimension("dim_b"),
+    metric("num_i", DataType.INT), metric("num_j", DataType.INT),
+    metric("val_x", DataType.DOUBLE), metric("val_y", DataType.DOUBLE),
+])
+
+
+@pytest.fixture(scope="module")
+def engines(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("diff")
+    seg = load_segment(SegmentBuilder(SCHEMA, SegmentGeneratorConfig())
+                       .build({k: (v.copy() if isinstance(v, np.ndarray) else
+                                   list(v)) for k, v in COLS.items()},
+                              str(tmp), "diff_0"))
+    db = sqlite3.connect(":memory:")
+    db.execute("CREATE TABLE diff (dim_a TEXT, dim_b TEXT, num_i INTEGER, "
+               "num_j INTEGER, val_x REAL, val_y REAL)")
+    rows = list(zip(COLS["dim_a"], COLS["dim_b"],
+                    COLS["num_i"].tolist(), COLS["num_j"].tolist(),
+                    COLS["val_x"].tolist(), COLS["val_y"].tolist()))
+    db.executemany("INSERT INTO diff VALUES (?,?,?,?,?,?)", rows)
+    return seg, db
+
+
+# -- random query generator (shared pinot_tpu/sqlite dialect) -----------------
+
+DIMS = ["dim_a", "dim_b"]
+NUMS = ["num_i", "num_j", "val_x", "val_y"]
+AGGS = ["COUNT(*)", "SUM({c})", "MIN({c})", "MAX({c})", "AVG({c})"]
+
+
+def _rand_pred(rng) -> str:
+    kind = rng.integers(0, 6)
+    if kind == 0:
+        c = DIMS[rng.integers(0, len(DIMS))]
+        v = f"a{rng.integers(0, 14)}" if c == "dim_a" else f"b{rng.integers(0, 7)}"
+        return f"{c} = '{v}'"
+    if kind == 1:
+        c = DIMS[rng.integers(0, len(DIMS))]
+        vals = ", ".join(f"'{p}{i}'" for p, i in
+                         [("a" if c == "dim_a" else "b", rng.integers(0, 14))
+                          for _ in range(int(rng.integers(1, 4)))])
+        return f"{c} IN ({vals})"
+    c = NUMS[rng.integers(0, len(NUMS))]
+    v = round(float(rng.uniform(-60, 60)), 2)
+    if kind == 2:
+        return f"{c} > {v}"
+    if kind == 3:
+        return f"{c} <= {v}"
+    if kind == 4:
+        lo = round(float(rng.uniform(-60, 0)), 2)
+        hi = round(float(rng.uniform(0, 60)), 2)
+        return f"{c} BETWEEN {lo} AND {hi}"
+    return f"NOT {c} < {v}"
+
+
+def _rand_where(rng) -> str:
+    n = int(rng.integers(0, 4))
+    if n == 0:
+        return ""
+    preds = [_rand_pred(rng) for _ in range(n)]
+    glue = [" AND " if rng.random() < 0.6 else " OR " for _ in range(n - 1)]
+    out = preds[0]
+    for g, p in zip(glue, preds[1:]):
+        out += g + p
+    return " WHERE " + out
+
+
+def gen_query(rng) -> str:
+    where = _rand_where(rng)
+    if rng.random() < 0.5:
+        # scalar aggregation
+        aggs = [AGGS[rng.integers(0, len(AGGS))].format(
+            c=NUMS[rng.integers(0, len(NUMS))]) for _ in range(int(rng.integers(1, 4)))]
+        return f"SELECT {', '.join(dict.fromkeys(aggs))} FROM diff{where}"
+    # group-by
+    keys = list(dict.fromkeys(
+        DIMS[rng.integers(0, len(DIMS))] for _ in range(int(rng.integers(1, 3)))))
+    aggs = list(dict.fromkeys(
+        AGGS[rng.integers(0, len(AGGS))].format(c=NUMS[rng.integers(0, len(NUMS))])
+        for _ in range(int(rng.integers(1, 3)))))
+    return (f"SELECT {', '.join(keys + aggs)} FROM diff{where} "
+            f"GROUP BY {', '.join(keys)} LIMIT 100000")
+
+
+# -- comparison ---------------------------------------------------------------
+
+def _norm_cell(v):
+    if v is None:
+        return None
+    if isinstance(v, (float, np.floating)):
+        f = float(v)
+        if math.isnan(f):
+            return None
+        return f
+    if isinstance(v, (int, np.integer)):
+        return float(v)
+    return v
+
+
+def _rows_match(a, b, rel: float, abs_: float) -> bool:
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) and isinstance(y, float):
+                if not math.isclose(x, y, rel_tol=rel, abs_tol=abs_):
+                    return False
+            elif x != y:
+                return False
+    return True
+
+
+# device partials accumulate in f32: SUM over n values of magnitude M carries
+# ~n*M*eps32 absolute error (0.04 for this dataset), and a near-cancelling sum
+# has unbounded RELATIVE error — so the device comparison needs the abs term.
+# The host path is f64 end-to-end and must match the oracle almost exactly.
+TOL = {True: (1e-5, 0.05), False: (1e-9, 1e-6)}
+
+
+def _sorted_rows(rows):
+    return sorted([[_norm_cell(v) for v in r] for r in rows],
+                  key=lambda r: [(x is None, str(type(x)), x) for x in r])
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_vs_sqlite(engines, seed):
+    seg, db = engines
+    rng = np.random.default_rng(1000 + seed)
+    for qi in range(25):
+        sql = gen_query(rng)
+        oracle = _sorted_rows(db.execute(sql.replace(" LIMIT 100000", "")
+                                         ).fetchall())
+        for use_device in (True, False):
+            got = ServerQueryExecutor(use_device=use_device).execute(
+                [seg], sql).rows
+            got = _sorted_rows(got)
+            rel, abs_ = TOL[use_device]
+            assert _rows_match(got, oracle, rel, abs_), (
+                f"MISMATCH seed={seed} q={qi} device={use_device}\n{sql}\n"
+                f"ours({len(got)}): {got[:5]}\noracle({len(oracle)}): {oracle[:5]}")
+
+
+def test_differential_multi_segment(engines, tmp_path):
+    """The same oracle check across a SPLIT segment set (merge paths)."""
+    _, db = engines
+    from pinot_tpu.segment.writer import build_aligned_segments
+    dirs = build_aligned_segments(
+        SCHEMA, {k: (v.copy() if isinstance(v, np.ndarray) else list(v))
+                 for k, v in COLS.items()}, str(tmp_path), "diffm", 4)
+    segs = [load_segment(d) for d in dirs]
+    rng = np.random.default_rng(77)
+    for _ in range(10):
+        sql = gen_query(rng)
+        oracle = _sorted_rows(db.execute(sql.replace(" LIMIT 100000", "")
+                                         ).fetchall())
+        got = _sorted_rows(ServerQueryExecutor().execute(segs, sql).rows)
+        assert _rows_match(got, oracle, *TOL[True]), \
+            f"multi-segment mismatch:\n{sql}"

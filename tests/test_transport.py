@@ -129,13 +129,8 @@ def http_cluster(tmp_path):
 
 
 def _wait_until(fn, timeout=15.0):
-    import time
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if fn():
-            return True
-        time.sleep(0.05)
-    return False
+    from conftest import wait_until
+    return wait_until(fn, timeout=timeout, interval=0.05, swallow=())
 
 
 def test_http_cluster_query(http_cluster):

@@ -44,7 +44,10 @@ def test_recommendations(seg_dir):
                     group_by_columns=["country"], agg_columns=["price", "qty"])
     idx = rec["indexing"]
     assert "country" in idx["invertedIndexColumns"]     # low-card filtered dim
-    assert "price" in idx["rangeIndexColumns"]          # raw filtered numeric
+    # raw columns cannot carry a range index (dict ids only): min/max pruning +
+    # device compares serve ranges; bloom covers EQ
+    assert "price" not in idx["rangeIndexColumns"]
+    assert "price" in idx["noDictionaryColumns"]
     assert "price" in idx["bloomFilterColumns"]
     assert "user_id" not in idx["invertedIndexColumns"]  # unfiltered high-card
     st = idx["starTreeIndexConfigs"]
