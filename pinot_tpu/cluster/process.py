@@ -92,7 +92,8 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
                         os.path.join(work_dir, instance_id),
                         tags=cfg.get_list("server.tenant.tags") or None,
                         completion=RemoteCompletion(controller_url),
-                        scheduler=scheduler_from_config(cfg))
+                        scheduler=scheduler_from_config(cfg),
+                        auto_consume=True)  # real processes pump themselves
     svc = ServerService(server, port=cfg.get_int("server.port", 0),
                         access_control=access_control)
     _write_ready(run_dir, instance_id, {"url": svc.url})

@@ -69,7 +69,7 @@ class ServerNode:
 
     def __init__(self, instance_id: str, catalog: Catalog, deepstore: DeepStoreFS,
                  data_dir: str, tags: Optional[List[str]] = None, completion=None,
-                 scheduler=None):
+                 scheduler=None, auto_consume: bool = False):
         self.instance_id = instance_id
         self.catalog = catalog
         self.deepstore = deepstore
@@ -78,6 +78,10 @@ class ServerNode:
         # optional admission control (reference: QueryScheduler wrapping the
         # executor; None = direct execution, the single-tenant test default)
         self.scheduler = scheduler
+        # True in real server processes: realtime managers run their background
+        # consume loop (reference: PartitionConsumer threads); False in tests,
+        # which drive pump/complete deterministically
+        self.auto_consume = auto_consume
         self.tables: Dict[str, TableDataManager] = {}
         self._lock = threading.RLock()
         self._realtime_managers: Dict[str, object] = {}
@@ -271,6 +275,8 @@ class ServerNode:
                 from ..ingest.realtime import RealtimeTableManager
                 handler = RealtimeTableManager(self, table, cfg, self.completion)
                 self._realtime_managers[table] = handler
+                if self.auto_consume:
+                    handler.start_loop()
             return handler
 
     def realtime_manager(self, table: str):

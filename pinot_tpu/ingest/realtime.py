@@ -72,26 +72,35 @@ class RealtimePartitionConsumer:
         self.offset = start_offset
         self.start_consume_time = time.time()
         self.catchup_target: Optional[int] = None
+        # halt fence: on_segment_online sets `halted` and takes `pump_lock`
+        # before the offset check + adoption build, so a background loop
+        # thread's in-flight pump can never index rows past the committed end
+        # offset into a segment about to be adopted (duplication with the
+        # successor would follow)
+        self.halted = False
+        self.pump_lock = threading.Lock()
 
     # -- consume loop ------------------------------------------------------
     def pump(self, max_messages: int = 10_000) -> int:
         """Fetch + decode + transform + index one batch; returns rows indexed
         (reference: consumeLoop one iteration)."""
-        if self.state not in (INITIAL_CONSUMING, CATCHING_UP, HOLDING):
-            return 0
-        limit = max_messages
-        if self.catchup_target is not None:
-            limit = min(limit, self.catchup_target - self.offset)
-            if limit <= 0:
+        with self.pump_lock:
+            if self.halted or \
+                    self.state not in (INITIAL_CONSUMING, CATCHING_UP, HOLDING):
                 return 0
-        batch = self.consumer.fetch(self.offset, limit)
-        indexed = 0
-        for msg in batch.messages:
-            row = self.decoder(msg.value)
-            row = self.pipeline.apply_row(row)
-            if row is not None and self._index_row(row, msg.offset):
-                indexed += 1
-        self.offset = batch.next_offset
+            limit = max_messages
+            if self.catchup_target is not None:
+                limit = min(limit, self.catchup_target - self.offset)
+                if limit <= 0:
+                    return 0
+            batch = self.consumer.fetch(self.offset, limit)
+            indexed = 0
+            for msg in batch.messages:
+                row = self.decoder(msg.value)
+                row = self.pipeline.apply_row(row)
+                if row is not None and self._index_row(row, msg.offset):
+                    indexed += 1
+            self.offset = batch.next_offset
         if indexed:  # ServerMeter REALTIME_ROWS_CONSUMED analog
             from ..utils.metrics import get_registry
             get_registry().counter("pinot_server_realtime_rows_consumed",
@@ -249,15 +258,24 @@ class RealtimeTableManager:
         consumer = self.stop_consuming(segment_name)
         if consumer is None:
             return None
-        if consumer.state == COMMITTED:
-            seg_dir = os.path.join(consumer.data_dir, "realtime_build", segment_name)
-            if os.path.isdir(seg_dir):
-                return seg_dir
-        if consumer.state in (INITIAL_CONSUMING, HOLDING, CATCHING_UP, RETAINED):
-            meta = self.server.catalog.segments.get(self.table, {}).get(segment_name)
-            if meta is not None and meta.end_offset is not None \
-                    and consumer.offset == int(meta.end_offset):
-                return consumer.build_immutable()
+        # fence out the background consume loop BEFORE inspecting offsets: an
+        # in-flight pump could otherwise index rows past the committed end
+        # offset between the check and the build (duplicating them with the
+        # successor segment)
+        consumer.halted = True
+        with consumer.pump_lock:
+            if consumer.state == COMMITTED:
+                seg_dir = os.path.join(consumer.data_dir, "realtime_build",
+                                       segment_name)
+                if os.path.isdir(seg_dir):
+                    return seg_dir
+            if consumer.state in (INITIAL_CONSUMING, HOLDING, CATCHING_UP,
+                                  RETAINED):
+                meta = self.server.catalog.segments.get(self.table,
+                                                        {}).get(segment_name)
+                if meta is not None and meta.end_offset is not None \
+                        and consumer.offset == int(meta.end_offset):
+                    return consumer.build_immutable()
         return None  # caller downloads from deep store
 
     # -- query integration -------------------------------------------------
@@ -289,8 +307,16 @@ class RealtimeTableManager:
     def start_loop(self, interval_s: float = 0.1) -> None:
         def loop():
             while not self._stop.is_set():
-                self.pump_all()
-                self.complete_all()
+                try:
+                    self.pump_all()
+                    self.complete_all()
+                except Exception:
+                    # a transient broker/controller error (socket hiccup,
+                    # completion 5xx past its retries) must not kill the
+                    # consume thread forever — meter it and keep going
+                    from ..utils.metrics import get_registry
+                    get_registry().counter("pinot_server_consume_errors",
+                                           {"table": self.table}).inc()
                 self._stop.wait(interval_s)
         t = threading.Thread(target=loop, daemon=True,
                              name=f"consume-{self.server.instance_id}-{self.table}")
