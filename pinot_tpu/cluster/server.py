@@ -142,6 +142,11 @@ class ServerNode:
     def _on_catalog_event(self, event: str, table: str) -> None:
         if event == "ideal_state":
             self.reconcile(table)
+        elif event == "table" and self.catalog.table_configs.get(table) is None:
+            # table DROPPED: the final config removal arrives as a 'table'
+            # event (ideal-state events already emptied the segments); one
+            # last reconcile tears down the realtime manager + its loop
+            self.reconcile(table)
         elif event == "property" and table.startswith("reload/"):
             # controller-triggered segment reload (reference: the Helix RELOAD
             # message driving SegmentPreProcessor on each server). Never let a
@@ -245,6 +250,16 @@ class ServerNode:
             if seg_name not in desired:
                 mgr.remove_segment(seg_name)
                 self.catalog.report_state(table, seg_name, self.instance_id, None)
+
+        if self.catalog.table_configs.get(table) is None:
+            # table dropped: the realtime manager (and its auto_consume loop)
+            # must die with it — a stale handler would keep fetching from the
+            # old stream and shadow a recreated table's new config
+            handler = None
+            with self._lock:
+                handler = self._realtime_managers.pop(table, None)
+            if handler is not None:
+                handler.stop()
 
         self._refresh_dim_table(table, mgr)
 

@@ -83,18 +83,25 @@ class RealtimePartitionConsumer:
     # -- consume loop ------------------------------------------------------
     def pump(self, max_messages: int = 10_000) -> int:
         """Fetch + decode + transform + index one batch; returns rows indexed
-        (reference: consumeLoop one iteration)."""
-        with self.pump_lock:
-            if self.halted or \
-                    self.state not in (INITIAL_CONSUMING, CATCHING_UP, HOLDING):
+        (reference: consumeLoop one iteration).
+
+        The network fetch runs OUTSIDE pump_lock (a stalled broker socket must
+        not block catalog state transitions waiting on the lock); indexing and
+        the offset publish re-check `halted` under the lock, so an adoption
+        fence still discards any in-flight batch."""
+        if self.halted or \
+                self.state not in (INITIAL_CONSUMING, CATCHING_UP, HOLDING):
+            return 0
+        limit = max_messages
+        if self.catchup_target is not None:
+            limit = min(limit, self.catchup_target - self.offset)
+            if limit <= 0:
                 return 0
-            limit = max_messages
-            if self.catchup_target is not None:
-                limit = min(limit, self.catchup_target - self.offset)
-                if limit <= 0:
-                    return 0
-            batch = self.consumer.fetch(self.offset, limit)
-            indexed = 0
+        batch = self.consumer.fetch(self.offset, limit)
+        indexed = 0
+        with self.pump_lock:
+            if self.halted:
+                return 0  # adopted mid-fetch: drop the batch, offset unmoved
             for msg in batch.messages:
                 row = self.decoder(msg.value)
                 row = self.pipeline.apply_row(row)
@@ -180,14 +187,23 @@ class RealtimePartitionConsumer:
         """Reference: buildSegmentForCommit (:699) + commitSegment (:705):
         commitStart -> build immutable -> upload -> commitEnd."""
         self.state = COMMITTING
-        if self.completion.segment_commit_start(self.segment_name, self.server_id) \
-                != "COMMIT_CONTINUE":
-            self.state = ERROR
-            return
-        seg_dir = self.build_immutable()
-        resp = self.completion.segment_commit_end(self.segment_name, self.server_id,
-                                                  seg_dir, self.offset)
-        self.state = COMMITTED if resp == "COMMIT_SUCCESS" else ERROR
+        # in-proc clusters run catalog notifications (and thus the reconcile
+        # that calls on_segment_online) ON THIS THREAD from inside commit_end;
+        # the marker lets adoption recognize its own in-flight commit instead
+        # of waiting for a state flip that cannot happen until we return
+        self._commit_thread = threading.get_ident()
+        try:
+            if self.completion.segment_commit_start(self.segment_name,
+                                                    self.server_id) \
+                    != "COMMIT_CONTINUE":
+                self.state = ERROR
+                return
+            seg_dir = self.build_immutable()
+            resp = self.completion.segment_commit_end(
+                self.segment_name, self.server_id, seg_dir, self.offset)
+            self.state = COMMITTED if resp == "COMMIT_SUCCESS" else ERROR
+        finally:
+            self._commit_thread = None
         if self.state == COMMITTED:
             from ..utils.metrics import get_registry
             get_registry().counter("pinot_server_realtime_segments_committed",
@@ -258,13 +274,27 @@ class RealtimeTableManager:
         consumer = self.stop_consuming(segment_name)
         if consumer is None:
             return None
+        # the committer usually arrives here while its commitEnd call is still
+        # in flight (the controller publishes ONLINE before responding). Two
+        # shapes: in-proc, THIS thread is the committer mid-call (the state
+        # cannot flip until we return — recognize our own commit and adopt the
+        # already-built dir); over HTTP, a different thread is committing —
+        # wait briefly for the COMMITTING->COMMITTED flip instead of
+        # re-downloading what this very server just uploaded.
+        own_commit = (getattr(consumer, "_commit_thread", None)
+                      == threading.get_ident())
+        if not own_commit:
+            deadline = time.time() + 10.0
+            while consumer.state == COMMITTING and time.time() < deadline:
+                time.sleep(0.02)
         # fence out the background consume loop BEFORE inspecting offsets: an
         # in-flight pump could otherwise index rows past the committed end
         # offset between the check and the build (duplicating them with the
         # successor segment)
         consumer.halted = True
         with consumer.pump_lock:
-            if consumer.state == COMMITTED:
+            if consumer.state == COMMITTED or \
+                    (own_commit and consumer.state == COMMITTING):
                 seg_dir = os.path.join(consumer.data_dir, "realtime_build",
                                        segment_name)
                 if os.path.isdir(seg_dir):
@@ -306,17 +336,29 @@ class RealtimeTableManager:
 
     def start_loop(self, interval_s: float = 0.1) -> None:
         def loop():
+            import sys
+            errors = 0
             while not self._stop.is_set():
                 try:
                     self.pump_all()
                     self.complete_all()
-                except Exception:
+                    errors = 0
+                except Exception as e:
                     # a transient broker/controller error (socket hiccup,
-                    # completion 5xx past its retries) must not kill the
-                    # consume thread forever — meter it and keep going
+                    # completion 5xx past its retries) or a poison message must
+                    # not kill the consume thread forever — log the FIRST
+                    # failure of a streak, meter every one, and back off
+                    # exponentially so a wedged partition is a visible slow
+                    # retry, not a silent 10 req/s hot loop
+                    errors += 1
                     from ..utils.metrics import get_registry
                     get_registry().counter("pinot_server_consume_errors",
                                            {"table": self.table}).inc()
+                    if errors == 1:
+                        print(f"[pinot-tpu] consume error on {self.table}: "
+                              f"{type(e).__name__}: {e} (backing off)",
+                              file=sys.stderr)
+                    self._stop.wait(min(interval_s * (2 ** min(errors, 6)), 5.0))
                 self._stop.wait(interval_s)
         t = threading.Thread(target=loop, daemon=True,
                              name=f"consume-{self.server.instance_id}-{self.table}")

@@ -96,6 +96,11 @@ class ServerQueryExecutor:
     def _empty_result(self, plan: SegmentPlan) -> SegmentResult:
         if plan.group_exprs:
             return SegmentResult("groups")
+        if not plan.ctx.is_aggregation_query and not plan.ctx.distinct:
+            # a pruned SELECTION segment contributes zero rows — NOT a scalar
+            # block, which would route the broker reduce down the aggregation
+            # path and crash resolving bare columns
+            return SegmentResult("selection")
         empty = np.empty(0, dtype=np.float64)
         return SegmentResult("scalar",
                              scalar=[a.host_state(empty) for a in plan.aggs] or None)

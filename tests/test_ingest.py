@@ -184,6 +184,26 @@ def test_realtime_data_survives_commit_plus_new_rows(tmp_path, events_schema):
     assert res.rows[0][1] == pytest.approx(25 + 10)
 
 
+def test_drop_realtime_table_stops_consumers(tmp_path, events_schema):
+    """Dropping a realtime table must stop + forget its realtime manager — a
+    stale handler would keep consuming and shadow a recreated table's config."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, num_partitions=1,
+                                    replication=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": "a", "country": "US", "value": 1,
+                                 "clicks": 1} for _ in range(5)])
+    cluster.pump_realtime(table)
+    mgrs = [s.realtime_manager(table) for s in cluster.servers
+            if s.realtime_manager(table) is not None]
+    assert mgrs, "a consuming manager must exist before the drop"
+
+    cluster.controller.drop_table(table)
+    for s in cluster.servers:
+        assert s.realtime_manager(table) is None, "manager must be forgotten"
+    for m in mgrs:
+        assert m._stop.is_set(), "consume loop must be stopped"
+
+
 def test_completion_fsm_edges():
     from pinot_tpu.cluster.completion import CompletionFSM, HOLD, CATCHUP, COMMIT, KEEP, DISCARD
     fsm = CompletionFSM("seg", num_replicas=2)
