@@ -251,13 +251,27 @@ class ServerNode:
                 mgr.remove_segment(seg_name)
                 self.catalog.report_state(table, seg_name, self.instance_id, None)
 
+        # CONSUMING segments removed from the ideal state (segment deletion,
+        # shrink) must stop consuming too — they live in the realtime manager,
+        # not the TableDataManager the loop above sweeps
+        rt = self._realtime_managers.get(table)
+        if rt is not None:
+            for seg_name in list(rt.consumers):
+                if seg_name not in desired:
+                    consumer = rt.stop_consuming(seg_name)
+                    if consumer is not None:
+                        consumer.close()
+                    self.catalog.report_state(table, seg_name,
+                                              self.instance_id, None)
+
         if self.catalog.table_configs.get(table) is None:
             # table dropped: the realtime manager (and its auto_consume loop)
             # must die with it — a stale handler would keep fetching from the
-            # old stream and shadow a recreated table's new config
-            handler = None
+            # old stream and shadow a recreated table's new config — and the
+            # empty TableDataManager entry goes too
             with self._lock:
                 handler = self._realtime_managers.pop(table, None)
+                self.tables.pop(table, None)
             if handler is not None:
                 handler.stop()
 

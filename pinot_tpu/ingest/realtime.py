@@ -79,6 +79,7 @@ class RealtimePartitionConsumer:
         # successor would follow)
         self.halted = False
         self.pump_lock = threading.Lock()
+        self._commit_done = threading.Event()  # set when _commit returns
 
     # -- consume loop ------------------------------------------------------
     def pump(self, max_messages: int = 10_000) -> int:
@@ -97,11 +98,15 @@ class RealtimePartitionConsumer:
             limit = min(limit, self.catchup_target - self.offset)
             if limit <= 0:
                 return 0
-        batch = self.consumer.fetch(self.offset, limit)
+        fetch_from = self.offset
+        batch = self.consumer.fetch(fetch_from, limit)
         indexed = 0
         with self.pump_lock:
-            if self.halted:
-                return 0  # adopted mid-fetch: drop the batch, offset unmoved
+            if self.halted or self.offset != fetch_from:
+                # adopted mid-fetch, or a CONCURRENT pump indexed this range
+                # already (two drivers double-indexing the same batch would
+                # duplicate rows): drop the batch, offset untouched
+                return 0
             for msg in batch.messages:
                 row = self.decoder(msg.value)
                 row = self.pipeline.apply_row(row)
@@ -148,6 +153,16 @@ class RealtimePartitionConsumer:
 
         self.mutable.index(row)
         return True
+
+    def close(self) -> None:
+        """Halt pumping and release the stream connection (idempotent)."""
+        self.halted = True
+        close_fn = getattr(self.consumer, "close", None)
+        if close_fn is not None:
+            try:
+                close_fn()
+            except Exception:
+                pass  # already torn down / broker gone
 
     def end_criteria_reached(self) -> bool:
         """Reference: row-count / time thresholds (realtime.segment.flush.*)."""
@@ -204,6 +219,7 @@ class RealtimePartitionConsumer:
             self.state = COMMITTED if resp == "COMMIT_SUCCESS" else ERROR
         finally:
             self._commit_thread = None
+            self._commit_done.set()
         if self.state == COMMITTED:
             from ..utils.metrics import get_registry
             get_registry().counter("pinot_server_realtime_segments_committed",
@@ -283,30 +299,35 @@ class RealtimeTableManager:
         # re-downloading what this very server just uploaded.
         own_commit = (getattr(consumer, "_commit_thread", None)
                       == threading.get_ident())
-        if not own_commit:
-            deadline = time.time() + 10.0
-            while consumer.state == COMMITTING and time.time() < deadline:
-                time.sleep(0.02)
+        if not own_commit and consumer.state == COMMITTING:
+            # bounded event wait (NOT a long poll): this runs on the server's
+            # single catalog-watch thread, and every second spent here stalls
+            # ALL state transitions — time out quickly and fall back to the
+            # deep-store download, which is merely wasteful, never wrong
+            consumer._commit_done.wait(2.0)
         # fence out the background consume loop BEFORE inspecting offsets: an
         # in-flight pump could otherwise index rows past the committed end
         # offset between the check and the build (duplicating them with the
         # successor segment)
         consumer.halted = True
-        with consumer.pump_lock:
-            if consumer.state == COMMITTED or \
-                    (own_commit and consumer.state == COMMITTING):
-                seg_dir = os.path.join(consumer.data_dir, "realtime_build",
-                                       segment_name)
-                if os.path.isdir(seg_dir):
-                    return seg_dir
-            if consumer.state in (INITIAL_CONSUMING, HOLDING, CATCHING_UP,
-                                  RETAINED):
-                meta = self.server.catalog.segments.get(self.table,
-                                                        {}).get(segment_name)
-                if meta is not None and meta.end_offset is not None \
-                        and consumer.offset == int(meta.end_offset):
-                    return consumer.build_immutable()
-        return None  # caller downloads from deep store
+        try:
+            with consumer.pump_lock:
+                if consumer.state == COMMITTED or \
+                        (own_commit and consumer.state == COMMITTING):
+                    seg_dir = os.path.join(consumer.data_dir, "realtime_build",
+                                           segment_name)
+                    if os.path.isdir(seg_dir):
+                        return seg_dir
+                if consumer.state in (INITIAL_CONSUMING, HOLDING, CATCHING_UP,
+                                      RETAINED):
+                    meta = self.server.catalog.segments.get(
+                        self.table, {}).get(segment_name)
+                    if meta is not None and meta.end_offset is not None \
+                            and consumer.offset == int(meta.end_offset):
+                        return consumer.build_immutable()
+            return None  # caller downloads from deep store
+        finally:
+            consumer.close()  # the stream connection is done either way
 
     # -- query integration -------------------------------------------------
     def consuming_results(self, ctx: QueryContext,
@@ -369,3 +390,8 @@ class RealtimeTableManager:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        with self._lock:
+            consumers = list(self.consumers.values())
+            self.consumers.clear()
+        for c in consumers:   # release stream sockets (kafkalite TCP etc.)
+            c.close()

@@ -161,6 +161,42 @@ def _sorted_rows(rows):
                   key=lambda r: [(x is None, str(type(x)), x) for x in r])
 
 
+EXPRS = [  # expressions valid in BOTH dialects, deterministic results
+    "UPPER(dim_a)", "LOWER(dim_b)", "LENGTH(dim_a)",
+    "num_i + num_j", "val_x * 2", "ABS(num_i)",
+]
+
+
+def gen_expr_query(rng) -> str:
+    """Transform expressions in SELECT and numeric-expression filters."""
+    e = EXPRS[rng.integers(0, len(EXPRS))]
+    where = _rand_where(rng)
+    extra = ""
+    if rng.random() < 0.5:
+        extra = (" AND " if where else " WHERE ") + \
+            f"num_i + num_j > {int(rng.integers(0, 800))}"
+    cols = ["dim_a", "num_i", e]
+    return (f"SELECT {', '.join(cols)} FROM diff{where}{extra} "
+            f"ORDER BY {', '.join(cols)} LIMIT {int(rng.integers(1, 40))}")
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_differential_expressions_vs_sqlite(engines, seed):
+    seg, db = engines
+    rng = np.random.default_rng(9000 + seed)
+    for qi in range(15):
+        sql = gen_expr_query(rng)
+        oracle = [[_norm_cell(v) for v in r] for r in db.execute(sql).fetchall()]
+        for use_device in (True, False):
+            got = [[_norm_cell(v) for v in r]
+                   for r in ServerQueryExecutor(use_device=use_device)
+                   .execute([seg], sql).rows]
+            rel, abs_ = TOL[use_device]
+            assert _rows_match(got, oracle, rel, abs_), (
+                f"EXPR MISMATCH seed={seed} q={qi} device={use_device}\n{sql}\n"
+                f"ours({len(got)}): {got[:4]}\noracle({len(oracle)}): {oracle[:4]}")
+
+
 def gen_ordered_query(rng) -> str:
     """Shapes with a TOTAL order (ties broken by every selected column), so the
     ordered row list compares 1:1 against sqlite."""
