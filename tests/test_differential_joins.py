@@ -1,0 +1,121 @@
+"""Randomized JOIN differential testing: multistage engine vs sqlite3 oracle.
+
+Extends the single-table harness (test_differential.py) to the join engine:
+random INNER/LEFT joins over two tables with WHERE pushdown, aggregations, and
+group-bys, executed through `execute_multistage` (the same runtime the broker
+dispatches) and compared row-for-row against sqlite.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.multistage import execute_multistage
+from pinot_tpu.multistage.runtime import make_segment_scan
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder
+
+RNG = np.random.default_rng(42)
+N_ORDERS = 2000
+N_CUST = 80   # some customers absent from orders; some orders dangling
+
+ORDERS = {
+    "cust_id": [f"c{i}" for i in RNG.integers(0, 100, N_ORDERS)],  # c80..c99 dangle
+    "qty": RNG.integers(1, 20, N_ORDERS).astype(np.int32),
+    "amount": np.round(RNG.uniform(1, 500, N_ORDERS), 2),
+}
+CUSTS = {
+    "cust_id": [f"c{i}" for i in range(N_CUST)],
+    "region": [["east", "west", "north"][i % 3] for i in range(N_CUST)],
+    "tier": RNG.integers(1, 4, N_CUST).astype(np.int32),
+}
+
+ORDERS_SCHEMA = Schema("orders", [
+    dimension("cust_id"), metric("qty", DataType.INT),
+    metric("amount", DataType.DOUBLE)])
+CUSTS_SCHEMA = Schema("custs", [
+    dimension("cust_id"), dimension("region"), metric("tier", DataType.INT)])
+
+
+@pytest.fixture(scope="module")
+def engines(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("joins")
+    o_seg = load_segment(SegmentBuilder(ORDERS_SCHEMA).build(
+        {k: (v.copy() if isinstance(v, np.ndarray) else list(v))
+         for k, v in ORDERS.items()}, str(tmp), "o_0"))
+    c_seg = load_segment(SegmentBuilder(CUSTS_SCHEMA).build(
+        {k: (v.copy() if isinstance(v, np.ndarray) else list(v))
+         for k, v in CUSTS.items()}, str(tmp), "c_0"))
+    scan = make_segment_scan({"orders": [o_seg], "custs": [c_seg]})
+    schema_for = {"orders": ORDERS_SCHEMA, "custs": CUSTS_SCHEMA}.get
+
+    db = sqlite3.connect(":memory:")
+    db.execute("CREATE TABLE orders (cust_id TEXT, qty INTEGER, amount REAL)")
+    db.execute("CREATE TABLE custs (cust_id TEXT, region TEXT, tier INTEGER)")
+    db.executemany("INSERT INTO orders VALUES (?,?,?)",
+                   list(zip(ORDERS["cust_id"], ORDERS["qty"].tolist(),
+                            ORDERS["amount"].tolist())))
+    db.executemany("INSERT INTO custs VALUES (?,?,?)",
+                   list(zip(CUSTS["cust_id"], CUSTS["region"],
+                            CUSTS["tier"].tolist())))
+    return scan, schema_for, db
+
+
+def gen_join_query(rng) -> str:
+    join_type = ["JOIN", "LEFT JOIN"][rng.integers(0, 2)]
+    conds = []
+    if rng.random() < 0.5:
+        conds.append(f"o.qty > {int(rng.integers(1, 15))}")
+    if rng.random() < 0.5:
+        conds.append(f"c.tier = {int(rng.integers(1, 4))}")
+    if rng.random() < 0.3:
+        conds.append(f"o.amount < {round(float(rng.uniform(50, 450)), 2)}")
+    where = (" WHERE " + " AND ".join(conds)) if conds else ""
+    shape = rng.integers(0, 3)
+    if shape == 0:
+        return (f"SELECT c.region, COUNT(*), SUM(o.amount) FROM orders o "
+                f"{join_type} custs c ON o.cust_id = c.cust_id{where} "
+                f"GROUP BY c.region LIMIT 100000")
+    if shape == 1:
+        return (f"SELECT c.region, c.tier, SUM(o.qty) FROM orders o "
+                f"{join_type} custs c ON o.cust_id = c.cust_id{where} "
+                f"GROUP BY c.region, c.tier LIMIT 100000")
+    return (f"SELECT COUNT(*), SUM(o.amount), MIN(o.qty), MAX(o.qty) "
+            f"FROM orders o {join_type} custs c ON o.cust_id = c.cust_id{where}")
+
+
+# share the single-table harness's comparison helpers (no rounding: rounding
+# before isclose() injects error the tolerance then has to absorb)
+from test_differential import _rows_match, _sorted_rows
+
+
+def _rows(rows):
+    return _sorted_rows(rows)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_join_differential_vs_sqlite(engines, seed):
+    scan, schema_for, db = engines
+    rng = np.random.default_rng(3000 + seed)
+    for qi in range(15):
+        sql = gen_join_query(rng)
+        oracle = _rows(db.execute(sql.replace(" LIMIT 100000", "")).fetchall())
+        got = _rows(execute_multistage(sql, scan, schema_for).rows)
+        # _rows_match checks row count AND per-row column count (a dropped
+        # trailing column must fail, not silently zip-truncate)
+        assert _rows_match(got, oracle, 1e-6, 1e-4), (
+            f"JOIN MISMATCH seed={seed} q={qi}\n{sql}\n"
+            f"ours({len(got)}): {got[:4]}\noracle({len(oracle)}): {oracle[:4]}")
+
+
+def test_join_differential_non_equi_residual(engines):
+    """Inner joins with non-equi residual conditions on the ON clause."""
+    scan, schema_for, db = engines
+    sql = ("SELECT c.region, COUNT(*) FROM orders o JOIN custs c "
+           "ON o.cust_id = c.cust_id AND o.qty > c.tier "
+           "GROUP BY c.region LIMIT 1000")
+    oracle = _rows(db.execute(sql.replace(" LIMIT 1000", "")).fetchall())
+    got = _rows(execute_multistage(sql, scan, schema_for).rows)
+    assert got == oracle
