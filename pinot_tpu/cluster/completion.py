@@ -21,6 +21,7 @@ KEEP (use the local build) or DISCARD (download from deep store).
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -159,6 +160,13 @@ class LLCSegmentManager:
         self.deepstore = deepstore
         self.work_dir = work_dir
         self.fsms: Dict[str, CompletionFSM] = {}
+        # one lock across the commit protocol and the validation/repair paths:
+        # the protocol is served by HTTP handler threads while the periodic
+        # RealtimeSegmentValidationManager runs on the scheduler thread — an
+        # unsynchronized repair inside commit_end's DONE->successor window
+        # would create a DUPLICATE successor consuming the same records
+        # (reference: leadership + per-partition locks guard the same window)
+        self._lock = threading.RLock()
         os.makedirs(work_dir, exist_ok=True)
 
     # -- table setup (reference: setUpNewTable) -----------------------------
@@ -209,23 +217,35 @@ class LLCSegmentManager:
         return fsm
 
     def segment_consumed(self, segment: str, server: str, offset: int) -> Dict[str, object]:
-        meta = self._meta(segment)
-        fsm = self._fsm_for(segment, meta)
-        if fsm is None:
-            if meta is not None and meta.status == STATUS_DONE:
-                final = int(meta.end_offset)
-                return {"status": KEEP if offset == final else DISCARD, "offset": final}
-            return {"status": FAILED, "offset": offset}
-        return fsm.on_consumed(server, offset)
+        with self._lock:
+            meta = self._meta(segment)
+            fsm = self._fsm_for(segment, meta)
+            if fsm is None:
+                if meta is not None and meta.status == STATUS_DONE:
+                    final = int(meta.end_offset)
+                    return {"status": KEEP if offset == final else DISCARD,
+                            "offset": final}
+                return {"status": FAILED, "offset": offset}
+            return fsm.on_consumed(server, offset)
 
     def segment_commit_start(self, segment: str, server: str) -> str:
-        fsm = self._fsm_for(segment, self._meta(segment))
-        return fsm.on_commit_start(server) if fsm else FAILED
+        with self._lock:
+            fsm = self._fsm_for(segment, self._meta(segment))
+            return fsm.on_commit_start(server) if fsm else FAILED
 
     def segment_commit_end(self, segment: str, server: str, segment_dir: str,
                            end_offset: int) -> str:
         """Upload + metadata flip + successor creation (reference: commitSegment path in
-        PinotLLCRealtimeSegmentManager: commitSegmentFile + commitSegmentMetadata)."""
+        PinotLLCRealtimeSegmentManager: commitSegmentFile + commitSegmentMetadata).
+        Held under the manager lock end-to-end: the validation thread must never
+        observe the DONE-without-successor window (it would create a duplicate
+        successor consuming the same records)."""
+        with self._lock:
+            return self._segment_commit_end(segment, server, segment_dir,
+                                            end_offset)
+
+    def _segment_commit_end(self, segment: str, server: str, segment_dir: str,
+                            end_offset: int) -> str:
         meta = self._meta(segment)
         fsm = self._fsm_for(segment, meta)
         if fsm is not None and fsm.can_adopt(server):
@@ -275,6 +295,10 @@ class LLCSegmentManager:
     def repair_missing_consuming_segments(self) -> List[str]:
         """Recreate CONSUMING segments for partitions whose latest segment is DONE but
         has no successor (e.g. controller crashed between commit and create)."""
+        with self._lock:
+            return self._repair_missing_consuming_segments()
+
+    def _repair_missing_consuming_segments(self) -> List[str]:
         created = []
         for table, cfg in list(self.catalog.table_configs.items()):
             if cfg.stream is None:
@@ -291,6 +315,51 @@ class LLCSegmentManager:
                     created.append(self._create_consuming_segment(
                         table, cfg, p, meta.sequence_number + 1, int(meta.end_offset)))
         return created
+
+    def reassign_dead_consuming_segments(self) -> List[str]:
+        """Move CONSUMING segments whose every assigned replica is dead onto
+        live servers (reference: RealtimeSegmentValidationManager repairing
+        consuming segments after server loss). The FSM resets so the new
+        replicas run a fresh committer election; they re-consume from the
+        segment's durable start offset — at-least-once, no data loss."""
+        with self._lock:
+            return self._reassign_dead_consuming_segments()
+
+    def _reassign_dead_consuming_segments(self) -> List[str]:
+        moved = []
+        for table, cfg in list(self.catalog.table_configs.items()):
+            if cfg.stream is None:
+                continue
+            ist = self.catalog.ideal_state.get(table, {})
+            live = self.catalog.live_servers(cfg.tenant)
+            if not live:
+                continue
+            counts = compute_counts(ist)
+            for seg, assignment in list(ist.items()):
+                meta = self.catalog.segments.get(table, {}).get(seg)
+                if meta is None or meta.status != STATUS_IN_PROGRESS:
+                    continue
+                if any(self.catalog.instances.get(s) is not None
+                       and self.catalog.instances[s].alive for s in assignment):
+                    continue
+                chosen = balanced_assign(seg, live, cfg.replication, counts)
+                for c in chosen:   # keep counts live: N moved segments SPREAD
+                    counts[c] = counts.get(c, 0) + 1
+                self.catalog.update_ideal_state(
+                    table, {seg: {s: CONSUMING for s in chosen}})
+                # fresh election among the new replicas
+                self.fsms[seg] = CompletionFSM(seg, num_replicas=len(chosen))
+                moved.append(seg)
+        return moved
+
+    def validate(self) -> Dict[str, List[str]]:
+        """One RealtimeSegmentValidationManager round: recreate missing
+        successors + move dead-replica consuming segments."""
+        with self._lock:
+            return {
+                "created": self._repair_missing_consuming_segments(),
+                "reassigned": self._reassign_dead_consuming_segments(),
+            }
 
     def _meta(self, segment: str) -> Optional[SegmentMeta]:
         for table_segs in self.catalog.segments.values():

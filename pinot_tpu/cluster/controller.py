@@ -47,6 +47,8 @@ class Controller:
                                              self.run_retention))
         self.scheduler.register(PeriodicTask("PinotTaskManager", 60.0,
                                              self.task_manager.generate_all))
+        self.scheduler.register(PeriodicTask("RealtimeSegmentValidationManager",
+                                             60.0, self.llc.validate))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:

@@ -219,6 +219,39 @@ def test_replica_divergence_download_from_deepstore(tmp_path, events_schema):
     assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 25
 
 
+def test_dead_replica_consuming_segment_reassigned(tmp_path, events_schema):
+    """Every replica of a CONSUMING segment dies; the validation manager moves
+    it to a live server which re-consumes from the durable start offset."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, flush_rows=100,
+                                    replication=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "value": 1.0}
+                                for i in range(10)])
+    cluster.pump_realtime(table)
+
+    # find the server consuming partition 0 and kill it
+    seg_name = next(iter(cluster.controller.llc.fsms))
+    holder = next(iter(cluster.catalog.ideal_state[table][seg_name]))
+    cluster.kill_server(holder)
+
+    # one validation round: segment reassigned to a live server as CONSUMING
+    out = cluster.controller.llc.validate()
+    assert seg_name in out["reassigned"], out
+    new_assignment = cluster.catalog.ideal_state[table][seg_name]
+    assert holder not in new_assignment
+    assert all(st == "CONSUMING" for st in new_assignment.values())
+
+    # the new replica re-consumes from the start offset: no data loss
+    cluster.pump_realtime(table)
+    survivor = next(iter(new_assignment))
+    node = next(s for s in cluster.servers if s.instance_id == survivor)
+    rt = node.realtime_manager(table)
+    assert rt is not None and seg_name in rt.consumers
+    assert rt.consumers[seg_name].mutable.num_docs == 10
+    # a validation round with everyone alive is a no-op
+    assert cluster.controller.llc.validate()["reassigned"] == []
+
+
 def test_committer_crash_cluster_level(tmp_path, events_schema):
     """The elected committer server is killed before it can commit; the second
     replica takes over after the commit timeout and no rows are lost."""
