@@ -237,29 +237,33 @@ class LLCSegmentManager:
                            end_offset: int) -> str:
         """Upload + metadata flip + successor creation (reference: commitSegment path in
         PinotLLCRealtimeSegmentManager: commitSegmentFile + commitSegmentMetadata).
-        Held under the manager lock end-to-end: the validation thread must never
-        observe the DONE-without-successor window (it would create a duplicate
-        successor consuming the same records)."""
+
+        Locking: the metadata flip + FSM transition + successor creation hold
+        the manager lock (the validation thread must never observe the
+        DONE-without-successor window — it would create a duplicate successor
+        consuming the same records), but the DEEP-STORE UPLOAD runs OUTSIDE it:
+        one segment's slow tar+upload must not block every other segment's
+        HOLD/CATCHUP responses into the commit timeout. During the upload the
+        segment is still IN_PROGRESS with a live committer, so neither repair
+        path can act on it; eligibility is re-checked after the upload in case
+        a timeout re-elected the committer away mid-upload."""
         with self._lock:
-            return self._segment_commit_end(segment, server, segment_dir,
-                                            end_offset)
+            meta = self._meta(segment)
+            fsm = self._fsm_for(segment, meta)
+            if fsm is not None and fsm.can_adopt(server):
+                # controller restarted between this committer's commitStart and
+                # its commitEnd (segment build can take seconds): adopt it here
+                # too, else the sole replica FAILs into terminal ERROR and the
+                # partition wedges
+                fsm.adopt_committer(server)
+            if fsm is None or fsm.state != "COMMITTING" or server != fsm.committer:
+                return FAILED
+            table = meta.table
+            cfg = self.catalog.table_configs[table]
 
-    def _segment_commit_end(self, segment: str, server: str, segment_dir: str,
-                            end_offset: int) -> str:
-        meta = self._meta(segment)
-        fsm = self._fsm_for(segment, meta)
-        if fsm is not None and fsm.can_adopt(server):
-            # controller restarted between this committer's commitStart and its
-            # commitEnd (segment build can take seconds): adopt it here too, else
-            # the sole replica FAILs into terminal ERROR and the partition wedges
-            fsm.adopt_committer(server)
-        if fsm is None or fsm.state != "COMMITTING" or server != fsm.committer:
-            return FAILED
-        table = meta.table
-        cfg = self.catalog.table_configs[table]
-
-        # upload the built segment to the deep store
+        # upload the built segment to the deep store (lock NOT held)
         seg_meta_json = read_json(os.path.join(segment_dir, SEGMENT_METADATA_FILE))
+        crc = read_json(os.path.join(segment_dir, CREATION_META_FILE))["crc"]
         tar_path = os.path.join(self.work_dir, f"{segment}.tar.gz")
         tar_segment(segment_dir, tar_path)
         uri = f"{table}/{segment}.tar.gz"
@@ -267,10 +271,20 @@ class LLCSegmentManager:
         size = os.path.getsize(tar_path)
         os.remove(tar_path)
 
+        with self._lock:
+            return self._finish_commit(segment, server, fsm, meta, cfg,
+                                       seg_meta_json, crc, uri, size,
+                                       end_offset)
+
+    def _finish_commit(self, segment, server, fsm, meta, cfg, seg_meta_json,
+                       crc, uri, size, end_offset) -> str:
+        if fsm.state != "COMMITTING" or server != fsm.committer:
+            return FAILED   # re-elected away during a slow upload
+        table = meta.table
         meta.status = STATUS_DONE
         meta.end_offset = str(end_offset)
         meta.num_docs = seg_meta_json["totalDocs"]
-        meta.crc = read_json(os.path.join(segment_dir, CREATION_META_FILE))["crc"]
+        meta.crc = crc
         meta.size_bytes = size
         meta.download_path = uri
         self._fill_time_range(cfg, seg_meta_json, meta)
@@ -302,6 +316,12 @@ class LLCSegmentManager:
         created = []
         for table, cfg in list(self.catalog.table_configs.items()):
             if cfg.stream is None:
+                continue
+            if not self.catalog.live_servers(cfg.tenant):
+                # creating a successor persists metadata BEFORE assignment;
+                # with zero live servers the assignment raises and the orphan
+                # IN_PROGRESS meta would wedge the partition forever — wait
+                # for servers to come back (next validation round)
                 continue
             latest: Dict[int, SegmentMeta] = {}
             for meta in self.catalog.segments.get(table, {}).values():

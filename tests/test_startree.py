@@ -159,3 +159,42 @@ def test_tiny_and_skip_star_configs(tmp_path):
         "SELECT COUNT(*) FROM t WHERE d2 >= 2",
     ]:
         _rows_match(execute_query([seg], sql).rows, execute_query([plain], sql).rows)
+
+
+def test_startree_randomized_differential(st_env):
+    """Randomized queries over the tree's dimension/metric domain: the star
+    tree rewrite must agree with the plain scan on EVERY shape (filters on any
+    split dims, any key subset, all covered aggregations)."""
+    import numpy as np
+    with_tree, plain = st_env
+    rng = np.random.default_rng(314)
+    dims = ["lo_region", "lo_category", "lo_discount"]
+    aggs = ["SUM(lo_revenue)", "AVG(lo_quantity)", "MIN(lo_extendedprice)",
+            "MAX(lo_extendedprice)", "COUNT(*)"]
+    regions = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+    cats = [f"MFGR#{i}" for i in range(1, 6)]
+    used_tree = 0
+    for qi in range(40):
+        keys = [d for d in dims if rng.random() < 0.5]
+        chosen = list(dict.fromkeys(
+            aggs[rng.integers(0, len(aggs))] for _ in range(int(rng.integers(1, 4)))))
+        preds = []
+        if rng.random() < 0.6:
+            vals = ", ".join(f"'{regions[i]}'" for i in
+                             sorted(set(rng.integers(0, 5, int(rng.integers(1, 3)))))) 
+            preds.append(f"lo_region IN ({vals})")
+        if rng.random() < 0.4:
+            preds.append(f"lo_category = '{cats[rng.integers(0, 5)]}'")
+        if rng.random() < 0.4:
+            preds.append(f"lo_discount BETWEEN {int(rng.integers(0, 5))} "
+                         f"AND {int(rng.integers(5, 11))}")
+        where = (" WHERE " + " AND ".join(preds)) if preds else ""
+        select = ", ".join(keys + chosen)
+        group = f" GROUP BY {', '.join(keys)}" if keys else ""
+        sql = f"SELECT {select} FROM lineorder{where}{group} LIMIT 100000"
+        got = execute_query([with_tree], sql)
+        want = execute_query([plain], sql)
+        _rows_match(got.rows, want.rows)
+        if got.stats["numDocsScanned"] < want.stats["numDocsScanned"]:
+            used_tree += 1
+    assert used_tree >= 30, f"tree used only {used_tree}/40 times"
