@@ -171,6 +171,7 @@ class ServerNode:
             return []
         mgr = self._table_manager(table)
         changes: List[str] = []
+        schema = self.catalog.schema_for_table(table)
         segments = mgr.acquire()
         try:
             for seg in segments:
@@ -178,8 +179,9 @@ class ServerNode:
                     continue
                 deferred: List[str] = []
                 try:
-                    ch = preprocess_segment(seg.path, cfg.indexing,
-                                            defer_removals=deferred)
+                    ch = preprocess_segment(
+                        seg.path, cfg.indexing, defer_removals=deferred,
+                        schema=schema)
                 except Exception as e:  # one bad segment must not stop the rest
                     changes.append(f"{seg.name}: ERROR {type(e).__name__}: {e}")
                     continue

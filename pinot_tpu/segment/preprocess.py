@@ -48,9 +48,15 @@ _SUFFIX = {"inverted": fmt.INVERTED_SUFFIX, "range": fmt.RANGE_SUFFIX,
 
 
 def preprocess_segment(seg_dir: str, indexing,
-                       defer_removals: List[str] = None) -> List[str]:
+                       defer_removals: List[str] = None,
+                       schema=None) -> List[str]:
     """Bring one on-disk segment's aux indexes in line with `indexing`
     (an IndexingConfig or SegmentGeneratorConfig — duck-typed column lists).
+
+    `schema` (the CURRENT table schema) additionally backfills columns the
+    segment predates with their default values — the reference's
+    DefaultColumnHandler in SegmentPreProcessor: schema evolution must not
+    break queries over old segments.
 
     Returns human-readable change descriptions ([] when already converged).
     Metadata (`indexes` per column) is rewritten at the end. When
@@ -63,28 +69,68 @@ def preprocess_segment(seg_dir: str, indexing,
     changes: List[str] = []
     seg = None  # lazy-loaded only if something must be built
 
-    for name, col_meta in meta["columns"].items():
-        have = set(col_meta.get("indexes", []))
-        want = set(desired_indexes(col_meta, name, indexing))
-        prefix = os.path.join(seg_dir, fmt.COLS_DIR, name)
+    try:
+        if schema is not None:
+            changes.extend(_add_default_columns(seg_dir, meta, schema))
 
-        for idx in sorted(have - want):
-            path = prefix + _SUFFIX[idx]
-            if defer_removals is not None:
-                defer_removals.append(path)
-            elif os.path.exists(path):
-                os.remove(path)
-            changes.append(f"{name}: removed {idx} index")
-        for idx in sorted(want - have):
-            if seg is None:
-                seg = load_segment(seg_dir)
-            _build_index(idx, seg, name, col_meta, prefix)
-            changes.append(f"{name}: added {idx} index")
-        if have != want:
-            col_meta["indexes"] = sorted(want)
+        for name, col_meta in meta["columns"].items():
+            have = set(col_meta.get("indexes", []))
+            want = set(desired_indexes(col_meta, name, indexing))
+            prefix = os.path.join(seg_dir, fmt.COLS_DIR, name)
 
+            for idx in sorted(have - want):
+                path = prefix + _SUFFIX[idx]
+                if defer_removals is not None:
+                    defer_removals.append(path)
+                elif os.path.exists(path):
+                    os.remove(path)
+                changes.append(f"{name}: removed {idx} index")
+            for idx in sorted(want - have):
+                if seg is None:
+                    seg = load_segment(seg_dir)
+                _build_index(idx, seg, name, col_meta, prefix)
+                changes.append(f"{name}: added {idx} index")
+            if have != want:
+                col_meta["indexes"] = sorted(want)
+    finally:
+        # persist on failure TOO: `meta` only records columns/indexes whose
+        # files landed (each step updates it after its writes), so writing it
+        # plus a fresh CRC keeps the segment self-consistent even when a later
+        # step raised — otherwise orphan files fail CRC verification forever
+        if changes:
+            fmt.write_json(meta_path, meta)
+            cm_path = os.path.join(seg_dir, fmt.CREATION_META_FILE)
+            cm = fmt.read_json(cm_path)
+            # deferred-removal files are ABOUT to be deleted by the reaper:
+            # hash the directory as it will look after their deletion
+            cm["crc"] = fmt.segment_crc(seg_dir,
+                                        exclude=defer_removals or ())
+            fmt.write_json(cm_path, cm)
+    return changes
+
+
+def _add_default_columns(seg_dir: str, meta: Dict[str, Any],
+                         schema) -> List[str]:
+    """Write default-filled physical columns for schema fields the segment
+    lacks (reference: DefaultColumnHandler, defaultColumnAction=ADD). The
+    stored schema is upgraded too, so readers see one consistent view."""
+    from .writer import SegmentBuilder, SegmentGeneratorConfig
+    changes: List[str] = []
+    num_docs = meta["totalDocs"]
+    cols_dir = os.path.join(seg_dir, fmt.COLS_DIR)
+    builder = None
+    for spec in schema.fields:
+        if spec.name in meta["columns"]:
+            continue
+        if builder is None:
+            builder = SegmentBuilder(schema, SegmentGeneratorConfig())
+            os.makedirs(cols_dir, exist_ok=True)
+        meta["columns"][spec.name] = builder.write_default_column(
+            cols_dir, spec, num_docs)
+        changes.append(f"{spec.name}: added default column "
+                       f"({spec.data_type.value})")
     if changes:
-        fmt.write_json(meta_path, meta)
+        meta["schema"] = schema.to_json()
     return changes
 
 

@@ -284,6 +284,14 @@ class SegmentBuilder:
         return meta
 
 
+    def write_default_column(self, cols_dir: str, spec: "FieldSpec",
+                             num_docs: int) -> Dict[str, Any]:
+        """Write one default-filled column (schema-evolution backfill — the
+        DefaultColumnHandler surface consumed by segment/preprocess.py)."""
+        raw = ([spec.null_value] * num_docs if spec.single_value
+               else [[spec.null_value]] * num_docs)
+        return self._write_column(cols_dir, spec, raw, num_docs)
+
     def _write_mv_column(self, prefix: str, spec: "FieldSpec", raw,
                          num_docs: int) -> Dict[str, Any]:
         """Multi-value column: flat dict-id forward index + row offsets.

@@ -96,3 +96,67 @@ def test_cluster_reload_applies_new_indexes(tmp_path):
     after = cluster.query(
         "SELECT country, COUNT(*) FROM ev GROUP BY country ORDER BY country LIMIT 10")
     assert after.rows == before.rows
+
+
+def test_schema_evolution_backfills_default_columns(tmp_path, ssb_schema):
+    """Adding a schema column + reload backfills old segments with defaults
+    (reference: SegmentPreProcessor DefaultColumnHandler) so queries over the
+    new column work cluster-wide."""
+    import numpy as np
+    from conftest import make_ssb_columns
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, metric
+    from pinot_tpu.table import TableConfig
+
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cfg = TableConfig(ssb_schema.name, replication=1)
+    cluster.create_table(ssb_schema, cfg)
+    cluster.ingest_columns(cfg, make_ssb_columns(np.random.default_rng(3), 300))
+
+    # evolve: add a metric column, push the schema, reload
+    v2 = Schema(ssb_schema.name,
+                list(ssb_schema.fields) + [metric("lo_tax", DataType.DOUBLE)],
+                ssb_schema.primary_key_columns)
+    cluster.controller.add_schema(v2)
+    cluster.controller.reload_table(cfg.table_name_with_type)
+
+    res = cluster.query("SELECT SUM(lo_tax), COUNT(*) FROM lineorder "
+                        "WHERE lo_quantity >= 1")
+    assert res.rows[0][1] == 300
+    assert res.rows[0][0] == 0.0      # metric default null is 0
+    res = cluster.query("SELECT lo_region, AVG(lo_tax) FROM lineorder "
+                        "GROUP BY lo_region LIMIT 10")
+    assert all(r[1] == 0.0 for r in res.rows)
+    # new ingests naturally carry the column; old + new mix cleanly
+    cols = make_ssb_columns(np.random.default_rng(4), 100)
+    cols["lo_tax"] = np.full(100, 2.5)
+    cluster.ingest_columns(cfg, cols)
+    res = cluster.query("SELECT SUM(lo_tax) FROM lineorder WHERE lo_quantity >= 1")
+    assert res.rows[0][0] == 250.0
+
+
+def test_crc_stays_valid_after_deferred_index_removal(tmp_path):
+    """CRC is recorded for the directory as it looks AFTER the reaper deletes
+    deferred index files — verify-segment must pass post-reload."""
+    import os
+    import numpy as np
+    from pinot_tpu.schema import Schema, dimension, metric
+    from pinot_tpu.segment.preprocess import preprocess_segment
+    from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+    from pinot_tpu.table import IndexingConfig
+    from pinot_tpu.tools.segment import verify_segment
+
+    schema = Schema("t", [dimension("c"), metric("v")])
+    seg_dir = SegmentBuilder(schema, SegmentGeneratorConfig(
+        inverted_index_columns=["c"])).build(
+        {"c": ["a", "b"], "v": np.array([1.0, 2.0])}, str(tmp_path), "t_0")
+    deferred = []
+    changes = preprocess_segment(seg_dir, IndexingConfig(),  # drop the index
+                                 defer_removals=deferred)
+    assert any("removed inverted" in c for c in changes)
+    assert deferred
+    for p in deferred:        # the reaper's deletion
+        if os.path.exists(p):
+            os.remove(p)
+    report = verify_segment(seg_dir)
+    assert report["ok"], report
