@@ -190,6 +190,7 @@ class Broker:
             if stmt is None:
                 from ..sql.parser import parse_query
                 stmt = parse_query(sql)
+            stmt = self._rewrite_subqueries(stmt)
             trace_on = _truthy(stmt.options.get("trace"))
             with tracing.request_trace(trace_on) as tr:
                 if stmt.joins:
@@ -207,6 +208,41 @@ class Broker:
         reg.counter("pinot_broker_queries").inc()
         reg.timer("pinot_broker_query_latency_ms").update(elapsed_ms)
         return result
+
+    def _rewrite_subqueries(self, stmt):
+        """`IN_SUBQUERY(expr, 'inner sql')` -> run the inner query through this
+        broker, splice its serialized id-set in as `IN_ID_SET(expr, '...')`
+        (reference: BaseBrokerRequestHandler.java:782 subquery recursion; the
+        inner query is expected to produce one IDSET(...) value). Nested
+        subqueries resolve naturally — each handle_query call rewrites its own
+        statement first."""
+        import dataclasses
+
+        from ..sql.ast import Function, Literal
+
+        def rw(e):
+            if not isinstance(e, Function):
+                return e
+            if e.name in ("in_subquery", "in_partitioned_subquery"):
+                if len(e.args) != 2 or not isinstance(e.args[1], Literal):
+                    raise QueryValidationError(
+                        f"IN_SUBQUERY(expr, 'sql') expected: {e!r}")
+                sub = self.handle_query(str(e.args[1].value))
+                if len(sub.rows) != 1 or len(sub.rows[0]) != 1 \
+                        or not isinstance(sub.rows[0][0], str):
+                    raise QueryValidationError(
+                        "IN_SUBQUERY inner query must return exactly one serialized "
+                        "id-set (use IDSET(col))")
+                return Function("in_id_set", (rw(e.args[0]), Literal(sub.rows[0][0])))
+            return Function(e.name, tuple(rw(a) for a in e.args))
+
+        from ..sql.ast import walk
+        if stmt.where is None or not any(
+                isinstance(n, Function) and n.name in ("in_subquery",
+                                                       "in_partitioned_subquery")
+                for n in walk(stmt.where)):
+            return stmt
+        return dataclasses.replace(stmt, where=rw(stmt.where))
 
     def _handle_single(self, stmt, t0: float) -> ResultTable:
         from ..utils.trace import current_trace, span

@@ -184,12 +184,16 @@ class ServerNode:
                         schema=schema)
                 except Exception as e:  # one bad segment must not stop the rest
                     changes.append(f"{seg.name}: ERROR {type(e).__name__}: {e}")
-                    continue
+                    ch = None
+                # reap deferred removals even when a later step failed:
+                # preprocess_segment already recorded a CRC that excludes them,
+                # so leaving the files on disk would fail CRC verification
+                # until some unrelated reload rewrote it
+                if deferred:
+                    self._remove_after_release(mgr, seg, deferred)
                 if ch:
                     mgr.add_segment(seg.name, load_segment(seg.path))
                     changes.extend(f"{seg.name}/{c}" for c in ch)
-                if deferred:
-                    self._remove_after_release(mgr, seg, deferred)
         finally:
             mgr.release(segments)
         return changes

@@ -46,6 +46,8 @@ def _register_builtin_types() -> None:
     register_wire_type("theta", ThetaSketch, lambda s: s.to_bytes(),
                        ThetaSketch.from_bytes)
     register_wire_type("tdigest", TDigest, lambda s: s.to_bytes(), TDigest.from_bytes)
+    from ..query.idset import IdSet
+    register_wire_type("idset", IdSet, lambda s: s.to_bytes(), IdSet.from_bytes)
 
 
 _register_builtin_types()

@@ -1007,7 +1007,42 @@ class DistinctCountMVAgg(DistinctCountAgg):
         return super().host_state(_mv_flat(values))
 
 
+class IdSetAgg(AggFunc):
+    """IDSET(col): build a serialized value-set usable as an `IN_ID_SET` filter
+    literal in a later query (reference: IdSetAggregationFunction; the broker's
+    IN_SUBQUERY rewrite consumes this). State is an `IdSet`; finalize emits the
+    base64 string."""
+
+    name = "idset"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        from .idset import IdSet
+        return IdSet.from_values(values)
+
+    def merge(self, a, b):
+        return a.union(b)
+
+    def finalize(self, state):
+        return state.serialize()
+
+    def empty_result(self):
+        from .idset import IdSet
+        return IdSet.empty().serialize()
+
+
+class IdSetMVAgg(IdSetAgg):
+    name = "idsetmv"
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
 _REGISTRY = {
+    "idset": IdSetAgg,
+    "idsetmv": IdSetMVAgg,
     "count": CountAgg,
     "countmv": CountMVAgg,
     "summv": SumMVAgg,

@@ -222,6 +222,32 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
             raise QueryValidationError(f"{name.upper()}: {exc}") from exc
         leaves.append(DocSetLeaf(col.name, query, mask))
         return ("leaf", len(leaves) - 1)
+    if name in ("in_id_set", "inidset"):
+        # membership against a serialized IdSet literal (reference:
+        # InIdSetTransformFunction). Dict column -> LUT once over the sorted
+        # dictionary; raw column -> host doc mask (same shape as TEXT_MATCH).
+        from .idset import IdSet, IdSetError
+        if len(e.args) != 2 or not isinstance(e.args[0], Identifier) \
+                or not isinstance(e.args[1], Literal):
+            raise QueryValidationError(
+                f"IN_ID_SET(column, 'serialized-idset') expected: {e!r}")
+        col, lit = e.args[0], e.args[1]
+        try:
+            ids = IdSet.deserialize(str(lit.value))
+        except IdSetError as exc:
+            raise QueryValidationError(str(exc)) from exc
+        reader = seg.column(col.name)
+        if reader.has_dictionary:
+            from ..engine.datablock import lut_size
+            lut = np.zeros(lut_size(reader.cardinality), dtype=bool)
+            card = reader.cardinality
+            if card:
+                lut[:card] = ids.contains(reader.dictionary._np_values)
+            leaves.append(LutLeaf(col.name, lut))
+        else:
+            mask = ids.contains(reader.values())
+            leaves.append(DocSetLeaf(col.name, f"idset[{len(ids)}]", mask))
+        return ("leaf", len(leaves) - 1)
     geo = _try_geo_predicate(e, seg, leaves)
     if geo is not None:
         return geo

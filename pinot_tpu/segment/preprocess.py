@@ -71,7 +71,14 @@ def preprocess_segment(seg_dir: str, indexing,
 
     try:
         if schema is not None:
-            changes.extend(_add_default_columns(seg_dir, meta, schema))
+            added = _add_default_columns(seg_dir, meta, schema)
+            if added:
+                # persist NOW: the index loop below may load_segment(seg_dir),
+                # which reads metadata from disk — it must see the new columns
+                # (their files are already written) or index builds on a
+                # backfilled column crash with an unknown-column error
+                fmt.write_json(meta_path, meta)
+            changes.extend(added)
 
         for name, col_meta in meta["columns"].items():
             have = set(col_meta.get("indexes", []))

@@ -69,6 +69,9 @@ AGGREGATION_FUNCTIONS = {
     "skewness", "kurtosis", "covarpop", "covar_pop", "covarsamp", "covar_samp",
     "corr", "firstwithtime", "lastwithtime", "histogram",
     "distinctsum", "distinctavg", "booland", "bool_and", "boolor", "bool_or",
+    # id-set building for cross-query IN_ID_SET filters (reference:
+    # IdSetAggregationFunction)
+    "idset", "idsetmv",
 }
 
 

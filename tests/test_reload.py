@@ -160,3 +160,99 @@ def test_crc_stays_valid_after_deferred_index_removal(tmp_path):
             os.remove(p)
     report = verify_segment(seg_dir)
     assert report["ok"], report
+
+
+def test_backfilled_column_with_index_first_reload(tmp_path, ssb_schema):
+    """Regression: schema adds a column that the indexing config ALSO wants
+    indexed — the index build on the first reload must see the backfilled
+    column (metadata is persisted before load_segment re-reads it)."""
+    import numpy as np
+    from conftest import make_ssb_columns
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension
+    from pinot_tpu.table import IndexingConfig, TableConfig
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig(ssb_schema.name, replication=1)
+    cluster.create_table(ssb_schema, cfg)
+    cluster.ingest_columns(cfg, make_ssb_columns(np.random.default_rng(6), 200))
+
+    v2 = Schema(ssb_schema.name,
+                list(ssb_schema.fields) + [dimension("lo_channel", DataType.STRING)],
+                ssb_schema.primary_key_columns)
+    cluster.controller.add_schema(v2)
+    cfg.indexing = IndexingConfig(inverted_index_columns=["lo_channel"])
+    cluster.controller.update_table(cfg)
+    changes = cluster.controller.reload_table(cfg.table_name_with_type)
+    flat = "\n".join(str(c) for c in (changes or []))
+    assert "ERROR" not in flat, flat
+
+    res = cluster.query("SELECT COUNT(*) FROM lineorder WHERE lo_channel = 'null'")
+    assert res.rows[0][0] == 200  # string default fill is 'null'
+
+
+def test_deferred_removal_reaped_even_when_reload_errors(tmp_path):
+    """Regression: when a reload both defers an index removal and fails a later
+    step, the deferred files must still be reaped — the recorded CRC already
+    excludes them."""
+    import os
+    import time
+    import numpy as np
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import IndexingConfig, TableConfig
+    from pinot_tpu.tools.segment import verify_segment
+
+    schema = Schema("t2", [dimension("c"), metric("v")])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig("t2", replication=1,
+                      indexing=IndexingConfig(inverted_index_columns=["c"]))
+    cluster.create_table(schema, cfg)
+    cluster.ingest_columns(cfg, {"c": ["a", "b", "a"],
+                                 "v": np.array([1.0, 2.0, 3.0])})
+
+    # evolve the schema with a new indexed column AND drop the old index; break
+    # the new-index build by adding a bogus schema field type the builder can
+    # handle but pointing the index at a column that will not exist on disk
+    # drop the inverted index (deferred removal) and request a new index in the
+    # same pass, with the index BUILD forced to fail after the removal was
+    # already deferred
+    import pinot_tpu.segment.preprocess as pp
+
+    cfg.indexing = IndexingConfig(json_index_columns=["c"])
+    cluster.controller.update_table(cfg, reload=False)
+    orig_build = pp._build_index
+
+    def failing_build(idx, seg, name, col_meta, prefix):
+        raise RuntimeError("forced index-build failure")
+
+    pp._build_index = failing_build
+    try:
+        changes = cluster.servers[0].reload_table(cfg.table_name_with_type)
+    finally:
+        pp._build_index = orig_build
+    flat = "\n".join(str(c) for c in (changes or []))
+    assert "ERROR" in flat, flat
+
+    # the deferred old-index file must eventually be gone and CRC must verify
+    server = cluster.servers[0]
+    seg_dirs = []
+    mgr = server._table_manager(cfg.table_name_with_type)
+    segs = mgr.acquire()
+    try:
+        seg_dirs = [s.path for s in segs if getattr(s, "path", None)]
+    finally:
+        mgr.release(segs)
+    deadline = time.time() + 6
+    while time.time() < deadline:
+        leftovers = [p for d in seg_dirs
+                     for p in [os.path.join(d, "cols", "c.inv.npz")]
+                     if os.path.exists(p)]
+        if not leftovers:
+            break
+        time.sleep(0.1)
+    for d in seg_dirs:
+        report = verify_segment(d)
+        assert report["ok"], report
