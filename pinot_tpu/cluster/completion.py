@@ -299,11 +299,43 @@ class LLCSegmentManager:
         self.catalog.update_ideal_state(
             table, {segment: {s: ONLINE for s in assignment}})
 
-        # create the successor CONSUMING segment from the end offset
-        info = parse_llc_name(segment)
-        self._create_consuming_segment(table, cfg, info["partition"],
-                                       info["sequence"] + 1, end_offset)
+        # create the successor CONSUMING segment from the end offset — unless
+        # consumption is paused, in which case resume (or the validation
+        # manager after resume) recreates successors from committed offsets
+        if not self.is_paused(table):
+            info = parse_llc_name(segment)
+            self._create_consuming_segment(table, cfg, info["partition"],
+                                           info["sequence"] + 1, end_offset)
         return COMMIT_SUCCESS
+
+    # -- pause/resume (reference: PinotRealtimeTableResource pauseConsumption /
+    # resumeConsumption + PauseStatus in ideal state) -----------------------
+    def is_paused(self, table: str) -> bool:
+        return self.catalog.get_property(f"pause/{table}") is not None
+
+    def pause_consumption(self, table: str) -> Dict[str, object]:
+        """Stop consumption: servers see the pause property and (a) stop
+        fetching, (b) force-commit consuming segments that already hold rows.
+        Committed segments get NO successor until resume."""
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None or cfg.stream is None:
+            raise ValueError(f"{table!r} is not a realtime table")
+        consuming = [m.name for m in self.catalog.segments.get(table, {}).values()
+                     if m.status == STATUS_IN_PROGRESS]
+        self.catalog.put_property(f"pause/{table}", "paused")
+        return {"paused": True, "consumingSegments": consuming}
+
+    def resume_consumption(self, table: str) -> Dict[str, object]:
+        """Clear the pause flag and recreate CONSUMING successors for partitions
+        whose latest segment committed while paused (consumption restarts from
+        the last committed offsets — the reference's resume semantics)."""
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None or cfg.stream is None:
+            raise ValueError(f"{table!r} is not a realtime table")
+        self.catalog.put_property(f"pause/{table}", None)
+        with self._lock:
+            created = self._repair_missing_consuming_segments()
+        return {"paused": False, "created": created}
 
     # -- repair (reference: RealtimeSegmentValidationManager) ---------------
     def repair_missing_consuming_segments(self) -> List[str]:
@@ -315,7 +347,7 @@ class LLCSegmentManager:
     def _repair_missing_consuming_segments(self) -> List[str]:
         created = []
         for table, cfg in list(self.catalog.table_configs.items()):
-            if cfg.stream is None:
+            if cfg.stream is None or self.is_paused(table):
                 continue
             if not self.catalog.live_servers(cfg.tenant):
                 # creating a successor persists metadata BEFORE assignment;

@@ -49,6 +49,8 @@ class Controller:
                                              self.task_manager.generate_all))
         self.scheduler.register(PeriodicTask("RealtimeSegmentValidationManager",
                                              60.0, self.llc.validate))
+        self.scheduler.register(PeriodicTask("SegmentRelocator", 3600.0,
+                                             self.run_segment_relocation))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -248,16 +250,106 @@ class Controller:
                     deleted.append(f"{table}/{seg}")
         return deleted
 
+    def pause_consumption(self, table: str) -> Dict[str, object]:
+        """Reference: PinotRealtimeTableResource.pauseConsumption."""
+        return self.llc.pause_consumption(table)
+
+    def resume_consumption(self, table: str) -> Dict[str, object]:
+        return self.llc.resume_consumption(table)
+
+    def _tier_pool(self, cfg: TableConfig, meta: SegmentMeta,
+                   now_ms: int):
+        """(tier_name, pool_tag) a segment belongs on: the matching TierConfig
+        with the LARGEST age threshold wins (oldest tier first); age is
+        measured from the segment's data end-time, falling back to push time
+        for time-column-less tables. Consuming segments (no push time yet)
+        and un-aged segments stay on the tenant pool."""
+        basis = meta.end_time_ms if meta.end_time_ms is not None \
+            else meta.push_time_ms
+        if cfg.tiers and basis:
+            age_days = (now_ms - basis) / 86_400_000.0
+            for t in sorted(cfg.tiers, key=lambda t: -t.segment_age_days):
+                if age_days >= t.segment_age_days:
+                    return t.name, t.server_tag
+        return None, cfg.tenant
+
+    def run_segment_relocation(self, now_ms: Optional[int] = None) -> List[str]:
+        """Reference: SegmentRelocator periodic task — move segments whose age
+        crossed a tier threshold onto that tier's tagged server pool.
+
+        Moves converge through the same add-first/drop-when-live loop as
+        rebalance (never below one online replica), so queries keep working
+        mid-move: the tier server downloads from the deep store and reports
+        ONLINE before the old replica is dropped. Partitioned tables keep
+        their replica-group placement inside the new pool."""
+        now_ms = now_ms or int(time.time() * 1000)
+        moved: List[str] = []
+        for table, cfg in list(self.catalog.table_configs.items()):
+            if not cfg.tiers:
+                continue
+            target: Dict[str, Dict[str, str]] = {}
+            for seg, meta in list(self.catalog.segments.get(table, {}).items()):
+                tier_name, pool_tag = self._tier_pool(cfg, meta, now_ms)
+                pool = self.catalog.live_servers(pool_tag)
+                if not pool:  # never strand a segment on an empty tier pool
+                    continue
+                current = set(self.catalog.ideal_state.get(table, {})
+                              .get(seg, {}))
+                if current and current <= set(pool):
+                    continue  # already fully inside the desired pool
+                counts = compute_counts({
+                    s: a for s, a in self.catalog.ideal_state.get(table, {}).items()
+                    if set(a) <= set(pool)})
+                if cfg.partition and meta.partition_id is not None:
+                    chosen = replica_group_assign(seg, pool, cfg.replication,
+                                                  meta.partition_id, counts)
+                else:
+                    chosen = balanced_assign(seg, pool, cfg.replication, counts)
+                target[seg] = {s: ONLINE for s in chosen}
+                moved.append(f"{table}/{seg}->{tier_name or cfg.tenant}")
+            if target:
+                self._converge_ideal_state(table, target, cfg.replication)
+        return moved
+
     # -- rebalance (reference: TableRebalancer.java:114,277) ---------------------
     def rebalance(self, table: str, min_available_replicas: int = 1) -> Dict[str, Dict[str, str]]:
         """Compute a balanced target and converge incrementally, never dropping a
         segment below `min_available_replicas` currently-online copies."""
         cfg = self.catalog.table_configs[table]
-        servers = self.catalog.live_servers(cfg.tenant)
         current = {s: dict(a) for s, a in self.catalog.ideal_state.get(table, {}).items()}
-        target = rebalance_table(current, servers, cfg.replication)
 
-        max_rounds = len(target) * (cfg.replication + 1) + 4
+        # tier-aware: rebalance each storage pool separately, so tiered
+        # segments stay on their tier servers instead of being pulled back
+        # onto the tenant pool (and ping-ponging with the SegmentRelocator)
+        now_ms = int(time.time() * 1000)
+        metas = self.catalog.segments.get(table, {})
+        by_pool: Dict[str, Dict[str, Dict[str, str]]] = {}
+        for seg, assignment in current.items():
+            meta = metas.get(seg)
+            pool_tag = cfg.tenant if meta is None \
+                else self._tier_pool(cfg, meta, now_ms)[1]
+            by_pool.setdefault(pool_tag, {})[seg] = assignment
+        target: Dict[str, Dict[str, str]] = {}
+        for pool_tag, segs in by_pool.items():
+            pool = self.catalog.live_servers(pool_tag)
+            if not pool:  # empty pool: leave those segments untouched
+                target.update(segs)
+                continue
+            target.update(rebalance_table(segs, pool, cfg.replication))
+        return self._converge_ideal_state(table, target, cfg.replication,
+                                          min_available_replicas)
+
+    def _converge_ideal_state(self, table: str, target: Dict[str, Dict[str, str]],
+                              replication: int, min_available_replicas: int = 1
+                              ) -> Dict[str, Dict[str, str]]:
+        """Incrementally walk ideal state toward `target`, adding a replica
+        before dropping one and never dropping below `min_available_replicas`
+        currently-online target copies (reference: TableRebalancer.java:277-298).
+        Segments absent from `target` are left untouched."""
+        current = {s: dict(a) for s, a in
+                   self.catalog.ideal_state.get(table, {}).items()
+                   if s in target}
+        max_rounds = len(target) * (replication + 1) + 4
         for _ in range(max_rounds):
             if current == target:
                 break

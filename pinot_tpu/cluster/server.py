@@ -147,6 +147,13 @@ class ServerNode:
             # event (ideal-state events already emptied the segments); one
             # last reconcile tears down the realtime manager + its loop
             self.reconcile(table)
+        elif event == "property" and table.startswith("pause/"):
+            # controller pause/resume consumption (reference: the pause state
+            # servers observe in ideal state)
+            t = table.split("/", 1)[1]
+            rt = self._realtime_managers.get(t)
+            if rt is not None:
+                rt.set_paused(self.catalog.get_property(table) is not None)
         elif event == "property" and table.startswith("reload/"):
             # controller-triggered segment reload (reference: the Helix RELOAD
             # message driving SegmentPreProcessor on each server). Never let a

@@ -82,6 +82,8 @@ class ControllerService:
         s.route("GET", "schemas", self._get_schema)
         s.route("GET", "segmentsMeta", self._segments_meta)
         s.route("POST", "reload", self._reload_table, action="WRITE")
+        s.route("POST", "pauseConsumption", self._pause_consumption, action="ADMIN")
+        s.route("POST", "resumeConsumption", self._resume_consumption, action="ADMIN")
         s.route("POST", "rebalance", self._rebalance, action="ADMIN")
         s.route("GET", "metrics", _metrics_route)
         s.route("GET", "", self._ui)       # minimal admin UI at /
@@ -268,6 +270,20 @@ class ControllerService:
             return error_response(f"unknown table {parts[0]}", 404)
         self.controller.reload_table(parts[0])
         return json_response({"status": "OK", "table": parts[0]})
+
+    def _pause_consumption(self, parts, params, body):
+        """POST /pauseConsumption/{tableNameWithType} (reference:
+        PinotRealtimeTableResource.pauseConsumption)."""
+        try:
+            return json_response(self.controller.llc.pause_consumption(parts[0]))
+        except ValueError as e:
+            return error_response(str(e), 400)
+
+    def _resume_consumption(self, parts, params, body):
+        try:
+            return json_response(self.controller.llc.resume_consumption(parts[0]))
+        except ValueError as e:
+            return error_response(str(e), 400)
 
     def _rebalance(self, parts, params, body):
         moves = self.controller.rebalance(parts[0])

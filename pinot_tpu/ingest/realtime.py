@@ -78,6 +78,9 @@ class RealtimePartitionConsumer:
         # offset into a segment about to be adopted (duplication with the
         # successor would follow)
         self.halted = False
+        # controller-requested pause (reference: pauseConsumption): stop
+        # fetching, and force-commit if rows are already held
+        self.pause_requested = False
         self.pump_lock = threading.Lock()
         self._commit_done = threading.Event()  # set when _commit returns
 
@@ -90,7 +93,7 @@ class RealtimePartitionConsumer:
         not block catalog state transitions waiting on the lock); indexing and
         the offset publish re-check `halted` under the lock, so an adoption
         fence still discards any in-flight batch."""
-        if self.halted or \
+        if self.halted or self.pause_requested or \
                 self.state not in (INITIAL_CONSUMING, CATCHING_UP, HOLDING):
             return 0
         limit = max_messages
@@ -177,7 +180,9 @@ class RealtimePartitionConsumer:
         """Run one protocol round-trip; returns the resulting consumer state."""
         if self.state in (COMMITTED, DISCARDED, RETAINED, ERROR):
             return self.state
-        if not self.end_criteria_reached() and self.catchup_target is None:
+        force = self.pause_requested and self.mutable.num_docs > 0
+        if not force and not self.end_criteria_reached() \
+                and self.catchup_target is None:
             return self.state
 
         resp = self.completion.segment_consumed(self.segment_name, self.server_id,
@@ -256,6 +261,8 @@ class RealtimeTableManager:
         self._dedup: Dict[int, PartitionDedupMetadataManager] = {}
         self.dedup_enabled = table_cfg.dedup_enabled
         self.partial_rows: Dict[tuple, dict] = {}
+        # inherit an already-paused table's state for consumers started later
+        self._paused = bool(server.catalog.get_property(f"pause/{table}"))
 
     # wired from ServerNode.reconcile on CONSUMING transitions
     def start_consuming(self, segment_name: str) -> None:
@@ -271,11 +278,13 @@ class RealtimeTableManager:
             dedup = None
             if self.dedup_enabled:
                 dedup = self._dedup.setdefault(partition, PartitionDedupMetadataManager())
-            self.consumers[segment_name] = RealtimePartitionConsumer(
+            consumer = RealtimePartitionConsumer(
                 segment_name, self.table_cfg, schema, start_offset,
                 self.server.instance_id, self.completion, self.server.data_dir,
                 self._pipeline, upsert=self.upsert, dedup=dedup,
                 partial_rows=self.partial_rows)
+            consumer.pause_requested = self._paused
+            self.consumers[segment_name] = consumer
 
     def stop_consuming(self, segment_name: str) -> Optional[RealtimePartitionConsumer]:
         with self._lock:
@@ -354,6 +363,16 @@ class RealtimeTableManager:
         with self._lock:
             consumers = list(self.consumers.items())
         return {name: c.maybe_complete() for name, c in consumers}
+
+    def set_paused(self, paused: bool) -> None:
+        """Controller pause/resume fan-in (reference: pause propagated to
+        servers via ideal state; here via the catalog pause property). Paused
+        consumers stop fetching; those already holding rows force-commit on
+        the next completion tick."""
+        with self._lock:
+            self._paused = paused
+            for c in self.consumers.values():
+                c.pause_requested = paused
 
     def start_loop(self, interval_s: float = 0.1) -> None:
         def loop():

@@ -19,9 +19,10 @@ id-set filter rides the fused kernel with zero extra dispatches.
 from __future__ import annotations
 
 import base64
-import functools
 import struct
+import threading
 import zlib
+from collections import OrderedDict
 from typing import Any, List, Union
 
 import numpy as np
@@ -182,11 +183,31 @@ class IdSet:
     def deserialize(cls, s: str) -> "IdSet":
         # memoized: filter compilation runs per segment, and the same (often large)
         # literal is decoded by every segment of every query using it
-        return _deserialize_cached(s)
+        with _CACHE_LOCK:
+            hit = _CACHE.get(s)
+            if hit is not None:
+                _CACHE.move_to_end(s)
+                return hit
+        out = _deserialize_uncached(s)
+        with _CACHE_LOCK:
+            _CACHE[s] = out
+            _CACHE.move_to_end(s)
+            # size-weighted eviction: bound resident decoded values, not entry
+            # count — 64 near-cap sets would otherwise pin GBs forever
+            total = sum(len(v) for v in _CACHE.values())
+            while total > _CACHE_MAX_TOTAL_VALUES and len(_CACHE) > 1:
+                _, evicted = _CACHE.popitem(last=False)
+                total -= len(evicted)
+        return out
 
 
-@functools.lru_cache(maxsize=64)
-def _deserialize_cached(s: str) -> IdSet:
+# literal-string -> decoded IdSet, LRU by total decoded values
+_CACHE: "OrderedDict[str, IdSet]" = OrderedDict()
+_CACHE_LOCK = threading.Lock()
+_CACHE_MAX_TOTAL_VALUES = 8_000_000
+
+
+def _deserialize_uncached(s: str) -> IdSet:
     try:
         return IdSet.from_bytes(zlib.decompress(base64.b64decode(s.encode("ascii"))))
     except (ValueError, zlib.error, struct.error) as exc:

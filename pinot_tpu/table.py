@@ -123,6 +123,27 @@ class UpsertConfig:
 
 
 @dataclass
+class TierConfig:
+    """One storage tier: segments older than `segment_age_days` relocate to the
+    server pool tagged `server_tag` (reference: spi/config/table/TierConfig with
+    segmentSelectorType=time, storageType=pinot_server; applied by the
+    SegmentRelocator periodic task)."""
+    name: str
+    segment_age_days: float
+    server_tag: str
+
+    def to_json(self):
+        return {"name": self.name, "segmentAge": f"{self.segment_age_days}d",
+                "serverTag": self.server_tag}
+
+    @staticmethod
+    def from_json(d):
+        age = d.get("segmentAge", "0d")
+        days = float(age[:-1]) if isinstance(age, str) and age.endswith("d") else float(age)
+        return TierConfig(d.get("name", ""), days, d.get("serverTag", ""))
+
+
+@dataclass
 class QuotaConfig:
     """Reference: spi/config/table/QuotaConfig (maxQueriesPerSecond + storage)."""
     max_qps: Optional[float] = None
@@ -157,6 +178,9 @@ class TableConfig:
     task_configs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     # per-table query quota (reference: QuotaConfig)
     quota: Optional[QuotaConfig] = None
+    # storage tiers, checked oldest-threshold-first by the SegmentRelocator
+    # (reference: tierConfigs in TableConfig)
+    tiers: List[TierConfig] = field(default_factory=list)
 
     @property
     def table_name_with_type(self) -> str:
@@ -183,6 +207,8 @@ class TableConfig:
             d["upsertConfig"] = self.upsert.to_json()
         if self.quota:
             d["quota"] = self.quota.to_json()
+        if self.tiers:
+            d["tierConfigs"] = [t.to_json() for t in self.tiers]
         return d
 
     @staticmethod
@@ -203,6 +229,7 @@ class TableConfig:
             tenant=d.get("tenant", "DefaultTenant"),
             task_configs=d.get("taskConfigs", {}),
             quota=QuotaConfig.from_json(d["quota"]) if d.get("quota") else None,
+            tiers=[TierConfig.from_json(t) for t in d.get("tierConfigs", [])],
         )
 
     def to_json_str(self) -> str:

@@ -242,3 +242,67 @@ def test_repair_missing_consuming_segment(tmp_path, events_schema):
     new_meta = cluster.catalog.segments[table][created[0]]
     assert new_meta.sequence_number == 1
     assert int(new_meta.start_offset) == 12
+
+
+def test_pause_resume_consumption(tmp_path, events_schema):
+    """Reference: PinotRealtimeTableResource pauseConsumption/resumeConsumption —
+    pause force-commits consuming rows and stops successors; resume restarts
+    consumption from the committed offsets."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, replication=1,
+                                    flush_rows=1000, num_partitions=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "country": "US", "value": 1,
+                                 "clicks": 1} for i in range(12)])
+    cluster.pump_realtime(table)
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 12
+
+    # pause: held rows force-commit (well under the 1000-row flush threshold),
+    # no successor is created
+    resp = cluster.controller.pause_consumption(table)
+    assert resp["paused"] and resp["consumingSegments"]
+    for _ in range(3):
+        cluster.pump_realtime(table)
+    metas = cluster.catalog.segments[table]
+    done = [m for m in metas.values() if m.status == STATUS_DONE]
+    assert len(done) == 1 and done[0].num_docs == 12
+    assert all(m.status == STATUS_DONE for m in metas.values())  # no successor
+
+    # rows produced while paused are NOT consumed
+    produce("events_topic", 0, [{"user": "p", "country": "DE", "value": 2,
+                                 "clicks": 1} for _ in range(5)])
+    for _ in range(3):
+        cluster.pump_realtime(table)
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 12
+
+    # resume: successor created from offset 12, catches up on the backlog
+    resp = cluster.controller.resume_consumption(table)
+    assert resp["created"]
+    successors = [m for m in cluster.catalog.segments[table].values()
+                  if m.status == STATUS_IN_PROGRESS]
+    assert len(successors) == 1 and int(successors[0].start_offset) == 12
+    for _ in range(3):
+        cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*), SUM(value) FROM events")
+    assert res.rows[0][0] == 17
+    assert res.rows[0][1] == pytest.approx(12 + 10)
+
+
+def test_pause_with_empty_consuming_segment(tmp_path, events_schema):
+    """Pausing a partition with zero consumed rows: nothing to commit, the
+    consuming segment idles, resume simply restarts fetching."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, replication=1,
+                                    flush_rows=1000, num_partitions=1)
+    table = cfg.table_name_with_type
+    cluster.controller.pause_consumption(table)
+    produce("events_topic", 0, [{"user": "a", "country": "US", "value": 1,
+                                 "clicks": 1} for _ in range(4)])
+    for _ in range(2):
+        cluster.pump_realtime(table)
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 0
+    metas = cluster.catalog.segments[table]
+    assert all(m.status == STATUS_IN_PROGRESS for m in metas.values())
+
+    cluster.controller.resume_consumption(table)
+    for _ in range(2):
+        cluster.pump_realtime(table)
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 4

@@ -1,0 +1,121 @@
+"""Storage tiers: TierConfig + SegmentRelocator moving aged segments to tagged pools.
+
+Reference: spi/config/table/TierConfig (time-based selector, pinot_server storage)
+applied by the SegmentRelocator periodic task
+(controller/helix/core/relocation/SegmentRelocator.java).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.server import ServerNode
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.table import TableConfig, TierConfig
+
+
+@pytest.fixture()
+def tiered_cluster(tmp_path):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cold = ServerNode("server_cold", cluster.catalog, cluster.deepstore,
+                      os.path.join(str(tmp_path), "server_cold"),
+                      tags=["cold"], completion=cluster.controller.llc)
+    cluster.broker.register_server_handle(
+        cold.instance_id, cold.execute_partial,
+        explain_handle=cold.explain_partial)
+    cluster.servers.append(cold)
+    return cluster
+
+
+def _schema():
+    return Schema("events", [dimension("k", DataType.STRING),
+                             metric("v", DataType.DOUBLE),
+                             date_time("ts", DataType.LONG)])
+
+
+def _cols(n, ts_ms):
+    return {"k": [f"k{i % 5}" for i in range(n)],
+            "v": np.arange(n, dtype=np.float64),
+            "ts": np.full(n, ts_ms, dtype=np.int64)}
+
+
+def test_tier_config_roundtrip():
+    cfg = TableConfig("events", tiers=[TierConfig("cold", 7.0, "cold")])
+    back = TableConfig.from_json(cfg.to_json())
+    assert back.tiers == [TierConfig("cold", 7.0, "cold")]
+
+
+def test_aged_segment_relocates_to_cold_pool(tiered_cluster):
+    cluster = tiered_cluster
+    now_ms = int(time.time() * 1000)
+    cfg = TableConfig("events", replication=1, time_column="ts",
+                      tiers=[TierConfig("cold", 7.0, "cold")])
+    cluster.create_table(_schema(), cfg)
+    table = cfg.table_name_with_type
+    cluster.ingest_columns(cfg, _cols(100, now_ms))                   # fresh
+    cluster.ingest_columns(cfg, _cols(80, now_ms - 30 * 86_400_000))  # 30d old
+
+    ist = cluster.catalog.ideal_state[table]
+    assert all(set(a) <= {"server_0", "server_1"} for a in ist.values())
+
+    moved = cluster.controller.run_segment_relocation()
+    assert len(moved) == 1 and moved[0].endswith("->cold"), moved
+
+    ist = cluster.catalog.ideal_state[table]
+    by_age = {}
+    for seg, meta in cluster.catalog.segments[table].items():
+        by_age[seg] = meta.end_time_ms
+    old_seg = min(by_age, key=by_age.get)
+    fresh_seg = max(by_age, key=by_age.get)
+    assert set(ist[old_seg]) == {"server_cold"}
+    assert set(ist[fresh_seg]) <= {"server_0", "server_1"}
+
+    # idempotent once converged
+    assert cluster.controller.run_segment_relocation() == []
+
+    # data remains fully queryable after the move
+    res = cluster.query("SELECT COUNT(*) FROM events")
+    assert res.rows[0][0] == 180
+    res = cluster.query(f"SELECT COUNT(*) FROM events WHERE ts < {now_ms - 86_400_000}")
+    assert res.rows[0][0] == 80
+
+
+def test_empty_tier_pool_never_strands_segments(tmp_path):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    now_ms = int(time.time() * 1000)
+    cfg = TableConfig("events", replication=1, time_column="ts",
+                      tiers=[TierConfig("cold", 7.0, "cold")])  # no cold servers
+    cluster.create_table(_schema(), cfg)
+    cluster.ingest_columns(cfg, _cols(50, now_ms - 30 * 86_400_000))
+    assert cluster.controller.run_segment_relocation() == []
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 50
+
+
+def test_multiple_tiers_oldest_threshold_wins(tiered_cluster):
+    cluster = tiered_cluster
+    frozen = ServerNode("server_frozen", cluster.catalog, cluster.deepstore,
+                        os.path.join(cluster.work_dir, "server_frozen"),
+                        tags=["frozen"], completion=cluster.controller.llc)
+    cluster.broker.register_server_handle(
+        frozen.instance_id, frozen.execute_partial,
+        explain_handle=frozen.explain_partial)
+    now_ms = int(time.time() * 1000)
+    cfg = TableConfig("events", replication=1, time_column="ts",
+                      tiers=[TierConfig("cold", 7.0, "cold"),
+                             TierConfig("frozen", 90.0, "frozen")])
+    cluster.create_table(_schema(), cfg)
+    table = cfg.table_name_with_type
+    cluster.ingest_columns(cfg, _cols(10, now_ms - 30 * 86_400_000))    # cold
+    cluster.ingest_columns(cfg, _cols(10, now_ms - 200 * 86_400_000))   # frozen
+
+    moved = sorted(cluster.controller.run_segment_relocation())
+    assert len(moved) == 2
+    assert any(m.endswith("->cold") for m in moved)
+    assert any(m.endswith("->frozen") for m in moved)
+    ist = cluster.catalog.ideal_state[table]
+    pools = sorted(tuple(sorted(a)) for a in ist.values())
+    assert pools == [("server_cold",), ("server_frozen",)]
+    assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 20
