@@ -334,7 +334,7 @@ class LLCSegmentManager:
             raise ValueError(f"{table!r} is not a realtime table")
         self.catalog.put_property(f"pause/{table}", None)
         with self._lock:
-            created = self._repair_missing_consuming_segments()
+            created = self._repair_missing_consuming_segments(only_table=table)
         return {"paused": False, "created": created}
 
     # -- repair (reference: RealtimeSegmentValidationManager) ---------------
@@ -344,9 +344,12 @@ class LLCSegmentManager:
         with self._lock:
             return self._repair_missing_consuming_segments()
 
-    def _repair_missing_consuming_segments(self) -> List[str]:
+    def _repair_missing_consuming_segments(self, only_table: Optional[str] = None
+                                           ) -> List[str]:
         created = []
         for table, cfg in list(self.catalog.table_configs.items()):
+            if only_table is not None and table != only_table:
+                continue
             if cfg.stream is None or self.is_paused(table):
                 continue
             if not self.catalog.live_servers(cfg.tenant):

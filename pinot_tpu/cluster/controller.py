@@ -21,7 +21,8 @@ from ..segment.format import read_json, SEGMENT_METADATA_FILE
 from ..segment.reader import load_segment
 from ..table import TableConfig, TableType
 from .assignment import balanced_assign, compute_counts, rebalance_table, replica_group_assign
-from .catalog import (Catalog, InstanceInfo, ONLINE, SegmentMeta, STATUS_UPLOADED)
+from .catalog import (Catalog, InstanceInfo, ONLINE, SegmentMeta,
+                      STATUS_IN_PROGRESS, STATUS_UPLOADED)
 from .deepstore import DeepStoreFS, tar_segment
 from .routing import partition_for_value
 
@@ -288,23 +289,35 @@ class Controller:
             if not cfg.tiers:
                 continue
             target: Dict[str, Dict[str, str]] = {}
+            ist = self.catalog.ideal_state.get(table, {})
+            # per-pool load counts, computed once and incremented as segments
+            # are placed — otherwise every segment in one pass picks the same
+            # least-loaded server and dogpiles it
+            pool_counts: Dict[str, Dict[str, int]] = {}
             for seg, meta in list(self.catalog.segments.get(table, {}).items()):
+                if meta.status == STATUS_IN_PROGRESS:
+                    continue  # consuming segments are not relocatable — they
+                    # have no deep-store copy; the completed successor will be
+                    # placed by tier on a later pass (reference: SegmentRelocator
+                    # only moves completed segments)
                 tier_name, pool_tag = self._tier_pool(cfg, meta, now_ms)
                 pool = self.catalog.live_servers(pool_tag)
                 if not pool:  # never strand a segment on an empty tier pool
                     continue
-                current = set(self.catalog.ideal_state.get(table, {})
-                              .get(seg, {}))
+                current = set(ist.get(seg, {}))
                 if current and current <= set(pool):
                     continue  # already fully inside the desired pool
-                counts = compute_counts({
-                    s: a for s, a in self.catalog.ideal_state.get(table, {}).items()
-                    if set(a) <= set(pool)})
+                counts = pool_counts.get(pool_tag)
+                if counts is None:
+                    counts = pool_counts[pool_tag] = compute_counts({
+                        s: a for s, a in ist.items() if set(a) <= set(pool)})
                 if cfg.partition and meta.partition_id is not None:
                     chosen = replica_group_assign(seg, pool, cfg.replication,
                                                   meta.partition_id, counts)
                 else:
                     chosen = balanced_assign(seg, pool, cfg.replication, counts)
+                for s in chosen:
+                    counts[s] = counts.get(s, 0) + 1
                 target[seg] = {s: ONLINE for s in chosen}
                 moved.append(f"{table}/{seg}->{tier_name or cfg.tenant}")
             if target:

@@ -177,6 +177,9 @@ class ControllerClient:
     def table_status(self, table: str) -> Dict:
         return get_json(f"{self.url}/tableStatus/{table}", token=self.token)
 
+    def get_schema(self, name: str) -> Dict:
+        return get_json(f"{self.url}/schemas/{name}", token=self.token)
+
     def list_tables(self) -> Dict:
         return get_json(f"{self.url}/tables", token=self.token)
 

@@ -97,6 +97,15 @@ class RemoteCatalog(Catalog):
             except (ConnectionError, HttpError):
                 if self._stop.wait(0.5):
                     return
+            except Exception as e:
+                # a subscriber callback blowing up (transient consumer-create
+                # failure, reconcile error) must NOT kill the watch thread —
+                # that would permanently blind this node to catalog changes
+                import sys
+                print(f"[pinot-tpu] catalog watch error: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                if self._stop.wait(0.5):
+                    return
 
     def _refresh(self) -> None:
         snap = get_json(f"{self.controller_url}/catalog/snapshot", retries=2)

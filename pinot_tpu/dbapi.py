@@ -1,0 +1,307 @@
+"""PEP 249 (DB-API 2.0) driver over the HTTP broker — the JDBC-client analog.
+
+The reference ships a `java.sql` driver (`pinot-clients/pinot-jdbc-client`:
+`PinotDriver` / `PinotConnection` / `PinotPreparedStatement`) layered on its
+java-client; this module is the same layering on `pinot_tpu.client`, so any
+DB-API tooling (pandas `read_sql`, SQLAlchemy raw connections, plain scripts)
+can talk to a cluster:
+
+    import pinot_tpu.dbapi as dbapi
+    conn = dbapi.connect(broker="http://localhost:8099")
+    cur = conn.cursor()
+    cur.execute("SELECT city, COUNT(*) FROM trips WHERE fare > ? GROUP BY city", [10])
+    print(cur.description, cur.fetchall())
+
+`paramstyle` is "qmark": `?` placeholders are substituted with escaped SQL
+literals, mirroring `PinotPreparedStatement`'s client-side substitution (the
+wire protocol has no server-side prepared statements). `?` inside string
+literals is left alone.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .client import Connection as _ClientConnection
+
+apilevel = "2.0"
+threadsafety = 2          # threads may share the module and connections
+paramstyle = "qmark"
+
+
+# -- exceptions (PEP 249 hierarchy) -----------------------------------------
+
+class Warning(Exception):            # noqa: A001 — name mandated by PEP 249
+    pass
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class DataError(DatabaseError):
+    pass
+
+
+class OperationalError(DatabaseError):
+    pass
+
+
+class IntegrityError(DatabaseError):
+    pass
+
+
+class InternalError(DatabaseError):
+    pass
+
+
+class ProgrammingError(DatabaseError):
+    pass
+
+
+class NotSupportedError(DatabaseError):
+    pass
+
+
+# -- module-level constructors/type objects (PEP 249) ------------------------
+
+Date = datetime.date
+Time = datetime.time
+Timestamp = datetime.datetime
+
+
+def DateFromTicks(ticks):
+    return Date.fromtimestamp(ticks)
+
+
+def TimeFromTicks(ticks):
+    return Timestamp.fromtimestamp(ticks).time()
+
+
+def TimestampFromTicks(ticks):
+    return Timestamp.fromtimestamp(ticks)
+
+
+Binary = bytes
+
+
+class _TypeObject:
+    def __init__(self, *py_types):
+        self.py_types = py_types
+
+    def __eq__(self, other):
+        return other in self.py_types
+
+
+STRING = _TypeObject(str)
+BINARY = _TypeObject(bytes)
+NUMBER = _TypeObject(int, float)
+DATETIME = _TypeObject(datetime.datetime, datetime.date)
+ROWID = _TypeObject(int)
+
+
+# -- literal escaping --------------------------------------------------------
+
+def escape(value: Any) -> str:
+    if value is None:
+        return "NULL"
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (int, float)):
+        return repr(value)
+    if isinstance(value, (datetime.date, datetime.datetime, datetime.time)):
+        return f"'{value.isoformat()}'"
+    if isinstance(value, str):
+        return "'" + value.replace("'", "''") + "'"
+    if isinstance(value, (list, tuple)):
+        return ", ".join(escape(v) for v in value)
+    raise ProgrammingError(f"cannot bind parameter of type {type(value).__name__}")
+
+
+def _substitute(operation: str, parameters: Sequence[Any]) -> str:
+    """Replace `?` placeholders outside string literals with escaped values."""
+    out: List[str] = []
+    it = iter(parameters)
+    in_str = False
+    i = 0
+    n = len(operation)
+    used = 0
+    while i < n:
+        ch = operation[i]
+        if in_str:
+            out.append(ch)
+            if ch == "'":
+                # '' is an escaped quote inside the literal
+                if i + 1 < n and operation[i + 1] == "'":
+                    out.append("'")
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+        elif ch == "?":
+            try:
+                out.append(escape(next(it)))
+            except StopIteration:
+                raise ProgrammingError(
+                    f"SQL has more placeholders than the {len(parameters)} "
+                    "parameters given") from None
+            used += 1
+        else:
+            out.append(ch)
+        i += 1
+    if used != len(parameters):
+        raise ProgrammingError(
+            f"SQL has {used} placeholders but {len(parameters)} parameters given")
+    return "".join(out)
+
+
+# -- cursor / connection -----------------------------------------------------
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self._rows: List[List[Any]] = []
+        self._pos = 0
+        self.description: Optional[List[Tuple]] = None
+        self.rowcount = -1
+        self.stats = {}
+        self._closed = False
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, operation: str, parameters: Optional[Sequence[Any]] = None
+                ) -> "Cursor":
+        if self._closed:
+            raise InterfaceError("cursor is closed")
+        if self._conn._client is None:
+            raise InterfaceError("connection is closed")
+        sql = _substitute(operation, list(parameters)) if parameters else operation
+        try:
+            rs = self._conn._client.execute(sql)
+        except Error:
+            raise
+        except Exception as exc:  # transport / server-side failures
+            raise OperationalError(str(exc)) from exc
+        self._rows = rs.rows
+        self._pos = 0
+        self.rowcount = len(rs.rows)
+        self.stats = rs.stats
+        self.description = [
+            (name, self._infer_type(idx), None, None, None, None, None)
+            for idx, name in enumerate(rs.columns)
+        ]
+        return self
+
+    def executemany(self, operation: str,
+                    seq_of_parameters: Sequence[Sequence[Any]]) -> "Cursor":
+        for parameters in seq_of_parameters:
+            self.execute(operation, parameters)
+        return self
+
+    def _infer_type(self, idx: int):
+        for row in self._rows:
+            v = row[idx]
+            if v is not None:
+                return type(v)
+        return None
+
+    # -- fetch -------------------------------------------------------------
+    def fetchone(self) -> Optional[List[Any]]:
+        self._check_results()
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[List[Any]]:
+        self._check_results()
+        size = size or self.arraysize
+        out = self._rows[self._pos:self._pos + size]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[List[Any]]:
+        self._check_results()
+        out = self._rows[self._pos:]
+        self._pos = len(self._rows)
+        return out
+
+    def _check_results(self) -> None:
+        if self._closed:
+            raise InterfaceError("cursor is closed")
+        if self.description is None:
+            raise ProgrammingError("no query has been executed")
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    # -- misc --------------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+        self._rows = []
+
+    def setinputsizes(self, sizes) -> None:
+        pass
+
+    def setoutputsize(self, size, column=None) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Connection:
+    def __init__(self, broker: str, controller: Optional[str] = None,
+                 token: Optional[str] = None):
+        self._client: Optional[_ClientConnection] = _ClientConnection(
+            broker, controller, token=token)
+
+    def cursor(self) -> Cursor:
+        if self._client is None:
+            raise InterfaceError("connection is closed")
+        return Cursor(self)
+
+    def execute(self, operation: str,
+                parameters: Optional[Sequence[Any]] = None) -> Cursor:
+        """Convenience shortcut (sqlite3-style): cursor + execute in one call."""
+        return self.cursor().execute(operation, parameters)
+
+    def close(self) -> None:
+        self._client = None
+
+    def commit(self) -> None:
+        pass  # reads only — nothing to commit, but PEP 249 requires the method
+
+    def rollback(self) -> None:
+        raise NotSupportedError("transactions are not supported")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def connect(broker: str, controller: Optional[str] = None,
+            token: Optional[str] = None) -> Connection:
+    return Connection(broker, controller, token=token)

@@ -65,9 +65,17 @@ class RealtimePartitionConsumer:
         stream_cfg = table_cfg.stream
         from ..cluster.completion import parse_llc_name
         self.partition = parse_llc_name(segment_name)["partition"]
-        factory = get_stream_factory(stream_cfg.stream_type, stream_cfg.topic,
-                                     stream_cfg.properties)
-        self.consumer = factory.create_consumer(stream_cfg.topic, self.partition)
+        self._factory = get_stream_factory(stream_cfg.stream_type, stream_cfg.topic,
+                                           stream_cfg.properties)
+        # consumer creation is retried lazily from pump(): the topic may not
+        # exist yet (producer races table creation) and a transient failure
+        # here must not wedge the CONSUMING transition (reference: consumer
+        # creation retries in LLRealtimeSegmentDataManager)
+        try:
+            self.consumer = self._factory.create_consumer(stream_cfg.topic,
+                                                          self.partition)
+        except Exception:
+            self.consumer = None
         self.decoder = get_decoder(stream_cfg.decoder)
         self.offset = start_offset
         self.start_consume_time = time.time()
@@ -96,6 +104,12 @@ class RealtimePartitionConsumer:
         if self.halted or self.pause_requested or \
                 self.state not in (INITIAL_CONSUMING, CATCHING_UP, HOLDING):
             return 0
+        if self.consumer is None:
+            try:
+                self.consumer = self._factory.create_consumer(
+                    self.table_cfg.stream.topic, self.partition)
+            except Exception:
+                return 0  # stream still unavailable; retry next tick
         limit = max_messages
         if self.catchup_target is not None:
             limit = min(limit, self.catchup_target - self.offset)

@@ -201,9 +201,27 @@ class Schema:
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "Schema":
+        fields = [FieldSpec.from_json(f) for f in d.get("fields", [])]
+        if not fields:
+            # accept the reference's schema JSON layout too
+            # (dimensionFieldSpecs / metricFieldSpecs / dateTimeFieldSpecs),
+            # so schemas written for Apache Pinot load unchanged
+            for key, role in (("dimensionFieldSpecs", FieldRole.DIMENSION),
+                              ("metricFieldSpecs", FieldRole.METRIC),
+                              ("dateTimeFieldSpecs", FieldRole.DATE_TIME)):
+                for f in d.get(key, []):
+                    fields.append(FieldSpec(
+                        name=f["name"],
+                        data_type=DataType(f["dataType"]),
+                        role=role,
+                        single_value=f.get("singleValueField", True),
+                        format=f.get("format"),
+                        granularity=f.get("granularity"),
+                        default_null_value=f.get("defaultNullValue"),
+                    ))
         return Schema(
             name=d["schemaName"],
-            fields=[FieldSpec.from_json(f) for f in d.get("fields", [])],
+            fields=fields,
             primary_key_columns=d.get("primaryKeyColumns", []),
         )
 

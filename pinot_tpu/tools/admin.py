@@ -207,7 +207,65 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("verify-segment")
     sp.add_argument("--dir", required=True)
     sp.set_defaults(fn=cmd_verify_segment)
+
+    sp = sub.add_parser("generate-data")
+    sp.add_argument("--schema-file", required=True)
+    sp.add_argument("--rows", type=int, default=1000)
+    sp.add_argument("--out", required=True, help=".csv or .jsonl output path")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--cardinality", action="append", default=[],
+                    help="col=N, repeatable")
+    sp.set_defaults(fn=cmd_generate_data)
+
+    sp = sub.add_parser("anonymize-data")
+    sp.add_argument("--input", required=True, help=".csv or .jsonl input")
+    sp.add_argument("--out", required=True)
+    sp.add_argument("--columns", required=True, help="comma-separated")
+    sp.set_defaults(fn=cmd_anonymize_data)
+
+    sp = sub.add_parser("compat-check")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--broker", required=True)
+    sp.add_argument("--ops", required=True, help="YAML op-sequence file")
+    sp.set_defaults(fn=cmd_compat_check)
     return p
+
+
+def cmd_generate_data(args) -> int:
+    """Reference: GenerateDataCommand."""
+    import json as _json
+    from ..schema import Schema
+    from .datagen import generate_columns, write_csv, write_jsonl
+    with open(args.schema_file) as f:
+        schema = Schema.from_json(_json.load(f))
+    cards = {}
+    for spec in args.cardinality:
+        col, _, n = spec.partition("=")
+        cards[col] = int(n)
+    cols = generate_columns(schema, args.rows, seed=args.seed,
+                            cardinalities=cards)
+    (write_csv if args.out.endswith(".csv") else write_jsonl)(args.out, cols)
+    print(f"wrote {args.rows} rows to {args.out}")
+    return 0
+
+
+def cmd_anonymize_data(args) -> int:
+    """Reference: AnonymizeDataCommand."""
+    from .datagen import anonymize_file
+    anonymize_file(args.input, args.out, args.columns.split(","))
+    print(f"anonymized {args.columns} -> {args.out}")
+    return 0
+
+
+def cmd_compat_check(args) -> int:
+    """Reference: pinot-compatibility-verifier CompatibilityOpsRunner CLI."""
+    from .compat import CompatibilityOpsRunner
+    runner = CompatibilityOpsRunner(args.controller, args.broker)
+    ok = runner.run(args.ops)
+    for line in runner.log:
+        print(line)
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

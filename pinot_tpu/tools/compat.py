@@ -1,0 +1,243 @@
+"""Compatibility verifier: YAML-driven operation sequences against a live cluster.
+
+Analog of the reference's `pinot-compatibility-verifier`
+(`compat/CompatibilityOpsRunner.java` + `TableOp`/`SegmentOp`/`QueryOp`/`StreamOp`):
+an operator writes a YAML file of cluster operations and expected outcomes, and the
+runner executes them in order over HTTP — the same file can be replayed against two
+software versions (upgrade/downgrade testing) or used as a smoke test after deploy.
+
+YAML shape:
+
+    description: round-trip smoke
+    operations:
+      - type: tableOp
+        op: CREATE                  # or DELETE
+        schemaFile: schema.json     # Schema.to_json format
+        tableConfigFile: table.json # TableConfig.to_json format
+      - type: segmentOp
+        op: UPLOAD                  # or DELETE
+        tableName: trips_OFFLINE
+        segmentName: trips_1
+        inputDataFile: rows.csv     # csv with header
+      - type: queryOp
+        queryFile: queries.sql              # one SQL per non-empty line
+        expectedResultsFile: results.jsonl  # one JSON {"rows": [...]} per line
+      - type: streamOp
+        op: PRODUCE
+        streamTopic: events_topic
+        partition: 0
+        inputDataFile: rows.jsonl   # one JSON object per line
+        tableName: events_REALTIME
+        recordCount: 25             # wait until COUNT(*) >= this through the broker
+
+Paths are resolved relative to the YAML file. Each op returns True/False; the run
+stops at the first failure (reference behavior) and reports which op failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..client import connect
+from ..schema import Schema
+from ..table import TableConfig
+
+
+class OpFailure(Exception):
+    pass
+
+
+class CompatibilityOpsRunner:
+    def __init__(self, controller_url: str, broker_url: str,
+                 token: Optional[str] = None, work_dir: Optional[str] = None,
+                 query_timeout_s: float = 60.0):
+        self.conn = connect(broker_url, controller=controller_url, token=token)
+        self.work_dir = work_dir or "/tmp/pinot_tpu_compat"
+        self.query_timeout_s = query_timeout_s
+        self.log: List[str] = []
+
+    # -- entry -------------------------------------------------------------
+    def run(self, yaml_path: str) -> bool:
+        import yaml
+        with open(yaml_path) as f:
+            doc = yaml.safe_load(f)
+        base = os.path.dirname(os.path.abspath(yaml_path))
+        ops = doc.get("operations", [])
+        for i, op in enumerate(ops):
+            kind = op.get("type", "")
+            handler = {
+                "tableOp": self._table_op,
+                "segmentOp": self._segment_op,
+                "queryOp": self._query_op,
+                "streamOp": self._stream_op,
+            }.get(kind)
+            if handler is None:
+                self.log.append(f"op {i}: unknown type {kind!r}")
+                return False
+            try:
+                handler(op, base)
+                self.log.append(f"op {i} ({kind}): OK")
+            except Exception as e:
+                self.log.append(f"op {i} ({kind}): FAILED — {e}")
+                return False
+        return True
+
+    # -- ops ----------------------------------------------------------------
+    def _table_op(self, op: Dict[str, Any], base: str) -> None:
+        action = op.get("op", "CREATE").upper()
+        if action == "CREATE":
+            schema = Schema.from_json(_load_json(base, op["schemaFile"]))
+            cfg = TableConfig.from_json(_load_json(base, op["tableConfigFile"]))
+            self.conn.admin.add_schema(schema)
+            self.conn.admin.add_table(cfg, num_partitions=op.get("numPartitions", 1))
+        elif action == "DELETE":
+            cfg = TableConfig.from_json(_load_json(base, op["tableConfigFile"]))
+            self.conn.admin.drop_table(cfg.table_name_with_type)
+        else:
+            raise OpFailure(f"unknown tableOp {action!r}")
+
+    def _segment_op(self, op: Dict[str, Any], base: str) -> None:
+        from ..ingest.readers import CsvRecordReader
+        from ..segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+        action = op.get("op", "UPLOAD").upper()
+        table = op["tableName"]
+        if action == "DELETE":
+            from ..cluster.process import http_call
+            http_call("DELETE",
+                      f"{self.conn.admin.url}/segments/{table}/{op['segmentName']}",
+                      token=self.conn.admin.token)
+            return
+        if action != "UPLOAD":
+            raise OpFailure(f"unknown segmentOp {action!r}")
+        raw_name = table.rsplit("_", 1)[0]
+        schema = Schema.from_json(self.conn.admin.get_schema(raw_name))
+        from ..ingest.readers import rows_to_columns
+        from ..ingest.transform import TransformPipeline
+        reader = CsvRecordReader(os.path.join(base, op["inputDataFile"]))
+        cols = TransformPipeline(schema).apply(
+            rows_to_columns(list(reader.rows()), schema))
+        out = os.path.join(self.work_dir, "segments")
+        os.makedirs(out, exist_ok=True)
+        seg_dir = SegmentBuilder(schema, SegmentGeneratorConfig()).build(
+            cols, out, op["segmentName"])
+        self.conn.admin.upload_segment(table, seg_dir)
+
+    def _query_op(self, op: Dict[str, Any], base: str) -> None:
+        queries = [q.strip() for q in
+                   _read(base, op["queryFile"]).splitlines() if q.strip()]
+        expected = [json.loads(line) for line in
+                    _read(base, op["expectedResultsFile"]).splitlines()
+                    if line.strip()]
+        if len(queries) != len(expected):
+            raise OpFailure(f"{len(queries)} queries vs {len(expected)} expected rows")
+        for sql, want in zip(queries, expected):
+            got = self._query_with_retry(sql, want.get("rows"))
+            if _norm_rows(got) != _norm_rows(want.get("rows", [])):
+                raise OpFailure(f"{sql!r}: got {got}, want {want.get('rows')}")
+
+    def _query_with_retry(self, sql: str, want) -> List[List[Any]]:
+        """Segment loads / catalog mirrors converge asynchronously after an
+        upload — retry until match or timeout, mirroring the reference's
+        post-op wait loops."""
+        deadline = time.time() + self.query_timeout_s
+        got: List[List[Any]] = []
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                got = self.conn.execute(sql).rows
+                last_err = None
+            except Exception as e:
+                # table metadata mirrors converge asynchronously — an "unknown
+                # table" right after CREATE is a not-yet, not a failure
+                last_err = e
+                time.sleep(0.2)
+                continue
+            if want is None or _norm_rows(got) == _norm_rows(want):
+                return got
+            time.sleep(0.2)
+        if last_err is not None:
+            raise OpFailure(str(last_err))
+        return got
+
+    def _stream_op(self, op: Dict[str, Any], base: str) -> None:
+        action = op.get("op", "PRODUCE").upper()
+        if action != "PRODUCE":
+            raise OpFailure(f"unknown streamOp {action!r}")
+        topic = op["streamTopic"]
+        partition = int(op.get("partition", 0))
+        rows = [line for line in _read(base, op["inputDataFile"]).splitlines()
+                if line.strip()]
+        # route by the table's stream plugin: kafkalite produces over TCP (works
+        # against separately-running cluster processes); the in-memory stream is
+        # process-local and only meaningful when the cluster shares this process
+        # (in-proc test enclosures)
+        stream_cfg = self._table_stream_config(op["tableName"])
+        stype = stream_cfg.get("streamType", "memory")
+        try:
+            if stype == "kafkalite":
+                from ..ingest.kafkalite import LogBrokerClient
+                client = LogBrokerClient(stream_cfg["properties"]["bootstrap"])
+                try:
+                    try:
+                        client.create_topic(topic, partition + 1)
+                    except RuntimeError:
+                        pass  # already exists
+                    for line in rows:
+                        client.produce(topic, line, partition=partition)
+                finally:
+                    client.close()
+            else:
+                from ..ingest.stream import MemoryStream
+                stream = MemoryStream.create(topic, partition + 1)  # get-or-create
+                for line in rows:
+                    stream.produce(line, partition=partition)
+        except (IndexError, KeyError, OSError) as e:
+            raise OpFailure(f"produce to {stype}:{topic}[{partition}] failed: {e}"
+                            ) from e
+        want_count = op.get("recordCount")
+        if want_count is not None:
+            raw = op["tableName"].rsplit("_", 1)[0]
+            deadline = time.time() + self.query_timeout_s
+            n = -1
+            while time.time() < deadline:
+                try:
+                    n = self.conn.execute(f"SELECT COUNT(*) FROM {raw}").rows[0][0]
+                except Exception:
+                    n = -1  # table not routable yet on this broker mirror
+                if n >= int(want_count):
+                    return
+                time.sleep(0.2)
+            raise OpFailure(f"consumed {n} rows, wanted >= {want_count}")
+
+
+    def _table_stream_config(self, table: str) -> Dict[str, Any]:
+        from ..cluster.process import get_json
+        try:
+            cfg = get_json(f"{self.conn.admin.url}/tables/{table}",
+                           token=self.conn.admin.token)
+            return cfg.get("streamConfig", {}) or {}
+        except Exception:
+            return {}
+
+
+def _read(base: str, rel: str) -> str:
+    with open(os.path.join(base, rel)) as f:
+        return f.read()
+
+
+def _load_json(base: str, rel: str) -> Dict[str, Any]:
+    return json.loads(_read(base, rel))
+
+
+def _norm_rows(rows) -> List[tuple]:
+    def norm_v(v):
+        if isinstance(v, bool):
+            return float(v)
+        if isinstance(v, (int, float)):
+            return round(float(v), 6)
+        return v
+    return sorted((tuple(norm_v(v) for v in r) for r in rows or []), key=repr)

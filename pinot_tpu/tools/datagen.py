@@ -1,0 +1,189 @@
+"""Synthetic data generation + data anonymization tools.
+
+Analogs of the reference CLI commands:
+- `GenerateDataCommand` (pinot-tools/.../command/GenerateDataCommand.java):
+  schema-driven synthetic rows to CSV/JSONL, with per-column cardinality control
+  — for quickstarts, benchmarks, and capacity planning.
+- `AnonymizeDataCommand` (pinot-tools/.../command/AnonymizeDataCommand.java +
+  tools/anonymizer/): rewrite sensitive column values with generated tokens while
+  preserving the properties queries depend on — equality (one consistent mapping
+  per column), sort order (tokens sort like the originals, so range predicates
+  and ORDER BY behave identically), and null-ness. Numeric columns are
+  rank-mapped for the same reason.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import math
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..schema import DataType, FieldRole, Schema
+
+_WORDS = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+          "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+          "oscar", "papa", "quebec", "romeo", "sierra", "tango"]
+
+
+# -- generation --------------------------------------------------------------
+
+def generate_columns(schema: Schema, num_rows: int, seed: int = 0,
+                     cardinalities: Optional[Dict[str, int]] = None
+                     ) -> Dict[str, list]:
+    """Column dict of `num_rows` synthetic values per schema field.
+
+    Dimensions draw from a per-column vocabulary of `cardinality` distinct
+    values (default 20); metrics are uniform numerics; DATE_TIME columns are
+    an increasing epoch-ms walk so time pruning/retention behave naturally."""
+    rng = np.random.default_rng(seed)
+    cards = cardinalities or {}
+    out: Dict[str, list] = {}
+    for f in schema.fields:
+        card = max(1, int(cards.get(f.name, 20)))
+        if f.role == FieldRole.DATE_TIME:
+            start = 1_600_000_000_000
+            steps = rng.integers(0, 60_000, num_rows)
+            vals = (start + np.cumsum(steps)).astype(np.int64)
+            if f.data_type in (DataType.INT,):
+                vals = (vals // 86_400_000).astype(np.int32)  # day buckets
+            out[f.name] = vals.tolist()
+        elif f.data_type == DataType.STRING:
+            vocab = [f"{_WORDS[i % len(_WORDS)]}_{i}" for i in range(card)]
+            out[f.name] = [vocab[i] for i in rng.integers(0, card, num_rows)]
+        elif f.data_type in (DataType.INT, DataType.LONG):
+            if f.role == FieldRole.DIMENSION:
+                out[f.name] = rng.integers(0, card, num_rows).tolist()
+            else:
+                out[f.name] = rng.integers(0, 10_000, num_rows).tolist()
+        elif f.data_type in (DataType.FLOAT, DataType.DOUBLE):
+            out[f.name] = np.round(rng.uniform(0, 1000, num_rows), 3).tolist()
+        elif f.data_type == DataType.BOOLEAN:
+            out[f.name] = (rng.integers(0, 2, num_rows) == 1).tolist()
+        elif f.data_type == DataType.JSON:
+            out[f.name] = [json.dumps({"k": _WORDS[i % len(_WORDS)],
+                                       "n": int(i)})
+                           for i in rng.integers(0, card, num_rows)]
+        else:
+            out[f.name] = [None] * num_rows
+    return out
+
+
+def columns_to_rows(cols: Dict[str, list]) -> List[Dict[str, Any]]:
+    names = list(cols)
+    n = len(cols[names[0]]) if names else 0
+    return [{c: cols[c][i] for c in names} for i in range(n)]
+
+
+def write_csv(path: str, cols: Dict[str, list]) -> None:
+    names = list(cols)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(names)
+        for row in zip(*[cols[c] for c in names]):
+            w.writerow(["" if v is None else v for v in row])
+
+
+def write_jsonl(path: str, cols: Dict[str, list]) -> None:
+    with open(path, "w") as f:
+        for row in columns_to_rows(cols):
+            f.write(json.dumps(row) + "\n")
+
+
+# -- anonymization -----------------------------------------------------------
+
+def _maybe_numeric(values: List[str]) -> list:
+    """int column if every non-empty cell parses as int, else float column if
+    every cell parses as float, else strings. Empty cells become None."""
+    vals = [None if v == "" else v for v in values]
+    present = [v for v in vals if v is not None]
+    for cast in (int, float):
+        try:
+            converted = [cast(v) for v in present]
+        except (TypeError, ValueError):
+            continue
+        it = iter(converted)
+        return [None if v is None else next(it) for v in vals]
+    return vals
+
+
+class ColumnAnonymizer:
+    """One consistent, order-preserving mapping for a column's values.
+
+    Strings map to fixed-width tokens assigned in sorted order
+    (`<col>_000000`...), so `a < b` iff `anon(a) < anon(b)`; numerics map to
+    their rank. Equality, joins across files anonymized with the same
+    instance, GROUP BY cardinality, and range/ORDER BY semantics all
+    survive; the values themselves do not."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mapping: Dict[Any, Any] = {}
+
+    def fit(self, values: Iterable[Any]) -> "ColumnAnonymizer":
+        distinct = {v for v in values if v is not None}
+        numeric = all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                      and not (isinstance(v, float) and math.isnan(v))
+                      for v in distinct)
+        width = max(6, len(str(len(distinct))))
+        for rank, v in enumerate(sorted(distinct, key=lambda x: (str(type(x)), x))
+                                 if not numeric else sorted(distinct)):
+            self._mapping[v] = rank if numeric else f"{self.name}_{rank:0{width}d}"
+        return self
+
+    def apply(self, values: Sequence[Any]) -> List[Any]:
+        return [None if v is None else self._mapping.get(v, v) for v in values]
+
+
+def anonymize_columns(cols: Dict[str, list], columns: Sequence[str],
+                      anonymizers: Optional[Dict[str, ColumnAnonymizer]] = None
+                      ) -> Dict[str, list]:
+    """Anonymize the named columns; pass the same `anonymizers` dict across
+    multiple files to keep mappings (and joins) consistent. Order preservation
+    is guaranteed within one fitted file set; values first seen in later files
+    keep equality/join semantics but may sort after earlier tokens."""
+    anonymizers = anonymizers if anonymizers is not None else {}
+    out = dict(cols)
+    for c in columns:
+        if c not in cols:
+            continue
+        anon = anonymizers.get(c)
+        if anon is None:
+            anon = anonymizers[c] = ColumnAnonymizer(c).fit(cols[c])
+        else:
+            # extend the mapping with values unseen in earlier files
+            missing = [v for v in cols[c]
+                       if v is not None and v not in anon._mapping]
+            if missing:
+                refit = ColumnAnonymizer(c)
+                refit.fit(list(anon._mapping) + missing)
+                # keep already-issued tokens stable; only add new ones
+                for v, tok in refit._mapping.items():
+                    anon._mapping.setdefault(v, tok)
+        out[c] = anon.apply(cols[c])
+    return out
+
+
+def anonymize_file(in_path: str, out_path: str, columns: Sequence[str],
+                   anonymizers: Optional[Dict[str, ColumnAnonymizer]] = None
+                   ) -> None:
+    """CSV/JSONL in -> same format out with the named columns anonymized."""
+    if in_path.endswith(".csv"):
+        with open(in_path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        names = list(rows[0]) if rows else []
+        # CSV reads everything as strings — restore numerics first, or numeric
+        # columns would be token-mapped lexicographically ('10' < '9'),
+        # breaking order preservation and re-ingestability
+        cols = {c: _maybe_numeric([r[c] for r in rows]) for c in names}
+        out = anonymize_columns(cols, columns, anonymizers)
+        write_csv(out_path, out)
+    else:
+        with open(in_path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        names = list(rows[0]) if rows else []
+        cols = {c: [r.get(c) for r in rows] for c in names}
+        out = anonymize_columns(cols, columns, anonymizers)
+        write_jsonl(out_path, out)

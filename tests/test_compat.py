@@ -1,0 +1,166 @@
+"""Compatibility verifier: YAML-driven ops against a live HTTP cluster.
+
+Reference: pinot-compatibility-verifier (CompatibilityOpsRunner + TableOp /
+SegmentOp / QueryOp / StreamOp YAML ops).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from pinot_tpu.ingest.stream import MemoryStream
+from pinot_tpu.tools.compat import CompatibilityOpsRunner
+
+
+@pytest.fixture(autouse=True)
+def _reset_streams():
+    MemoryStream.reset_all()
+    yield
+    MemoryStream.reset_all()
+
+
+@pytest.fixture()
+def http_cluster(tmp_path):
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+    catalog = Catalog()
+    ctrl = Controller("c0", catalog, LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "c"))
+    csvc = ControllerService(ctrl)
+    cats = [RemoteCatalog(csvc.url, poll_timeout_s=1.0)]
+    node = ServerNode("server_0", cats[0], ControllerDeepStore(csvc.url),
+                      str(tmp_path / "s0"), auto_consume=True,
+                      completion=ctrl.llc)
+    ssvc = ServerService(node)
+    cats.append(RemoteCatalog(csvc.url, poll_timeout_s=1.0))
+    bsvc = BrokerService(Broker("b0", cats[1]))
+    try:
+        yield csvc, bsvc
+    finally:
+        for c in cats:
+            c.close()
+        for s in (csvc, ssvc, bsvc):
+            s.stop()
+
+
+def _write(p, text):
+    p.write_text(textwrap.dedent(text))
+    return p.name
+
+
+def test_offline_roundtrip_ops(tmp_path, http_cluster):
+    csvc, bsvc = http_cluster
+    d = tmp_path / "ops"
+    d.mkdir()
+    (d / "schema.json").write_text(json.dumps({
+        "schemaName": "trips",
+        "dimensionFieldSpecs": [{"name": "city", "dataType": "STRING"}],
+        "metricFieldSpecs": [{"name": "fare", "dataType": "DOUBLE"}],
+    }))
+    (d / "table.json").write_text(json.dumps({"tableName": "trips"}))
+    (d / "rows.csv").write_text("city,fare\nnyc,1.5\nsf,2.0\nnyc,3.0\n")
+    _write(d / "queries.sql", """\
+        SELECT COUNT(*) FROM trips
+        SELECT city, SUM(fare) FROM trips GROUP BY city ORDER BY city LIMIT 5
+    """)
+    (d / "results.jsonl").write_text(
+        json.dumps({"rows": [[3]]}) + "\n" +
+        json.dumps({"rows": [["nyc", 4.5], ["sf", 2.0]]}) + "\n")
+    _write(d / "ops.yaml", """\
+        description: offline round-trip
+        operations:
+          - type: tableOp
+            op: CREATE
+            schemaFile: schema.json
+            tableConfigFile: table.json
+          - type: segmentOp
+            op: UPLOAD
+            tableName: trips_OFFLINE
+            segmentName: trips_c0
+            inputDataFile: rows.csv
+          - type: queryOp
+            queryFile: queries.sql
+            expectedResultsFile: results.jsonl
+    """)
+    runner = CompatibilityOpsRunner(csvc.url, bsvc.url,
+                                    work_dir=str(tmp_path / "work"))
+    ok = runner.run(str(d / "ops.yaml"))
+    assert ok, runner.log
+
+
+def test_query_mismatch_fails(tmp_path, http_cluster):
+    csvc, bsvc = http_cluster
+    d = tmp_path / "ops2"
+    d.mkdir()
+    (d / "schema.json").write_text(json.dumps({
+        "schemaName": "miss",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "STRING"}],
+        "metricFieldSpecs": [{"name": "v", "dataType": "DOUBLE"}],
+    }))
+    (d / "table.json").write_text(json.dumps({"tableName": "miss"}))
+    (d / "rows.csv").write_text("k,v\na,1.0\n")
+    (d / "queries.sql").write_text("SELECT COUNT(*) FROM miss\n")
+    (d / "results.jsonl").write_text(json.dumps({"rows": [[999]]}) + "\n")
+    _write(d / "ops.yaml", """\
+        operations:
+          - type: tableOp
+            op: CREATE
+            schemaFile: schema.json
+            tableConfigFile: table.json
+          - type: segmentOp
+            op: UPLOAD
+            tableName: miss_OFFLINE
+            segmentName: miss_0
+            inputDataFile: rows.csv
+          - type: queryOp
+            queryFile: queries.sql
+            expectedResultsFile: results.jsonl
+    """)
+    runner = CompatibilityOpsRunner(csvc.url, bsvc.url,
+                                    work_dir=str(tmp_path / "work"),
+                                    query_timeout_s=3.0)
+    assert not runner.run(str(d / "ops.yaml"))
+    assert any("FAILED" in line for line in runner.log)
+
+
+def test_stream_op_realtime(tmp_path, http_cluster):
+    csvc, bsvc = http_cluster
+    d = tmp_path / "ops3"
+    d.mkdir()
+    (d / "schema.json").write_text(json.dumps({
+        "schemaName": "events",
+        "dimensionFieldSpecs": [{"name": "u", "dataType": "STRING"}],
+        "metricFieldSpecs": [{"name": "m", "dataType": "DOUBLE"}],
+    }))
+    (d / "table.json").write_text(json.dumps({
+        "tableName": "events", "tableType": "REALTIME",
+        "streamConfig": {"streamType": "memory", "topic": "compat_topic",
+                         "decoder": "json", "flushThresholdRows": 1000},
+    }))
+    (d / "rows.jsonl").write_text(
+        "".join(json.dumps({"u": f"u{i}", "m": 1.0}) + "\n" for i in range(8)))
+    _write(d / "ops.yaml", """\
+        operations:
+          - type: tableOp
+            op: CREATE
+            schemaFile: schema.json
+            tableConfigFile: table.json
+          - type: streamOp
+            op: PRODUCE
+            streamTopic: compat_topic
+            partition: 0
+            inputDataFile: rows.jsonl
+            tableName: events_REALTIME
+            recordCount: 8
+    """)
+    runner = CompatibilityOpsRunner(csvc.url, bsvc.url,
+                                    work_dir=str(tmp_path / "work"))
+    ok = runner.run(str(d / "ops.yaml"))
+    assert ok, runner.log

@@ -1,0 +1,123 @@
+"""Data generator + anonymizer tools (reference: GenerateDataCommand /
+AnonymizeDataCommand in pinot-tools)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.tools.datagen import (ColumnAnonymizer, anonymize_columns,
+                                     anonymize_file, generate_columns,
+                                     write_csv, write_jsonl)
+
+
+@pytest.fixture()
+def schema():
+    return Schema("gen", [dimension("city", DataType.STRING),
+                          dimension("code", DataType.INT),
+                          metric("fare", DataType.DOUBLE),
+                          date_time("ts", DataType.LONG)])
+
+
+def test_generate_columns_shapes_and_cardinality(schema):
+    cols = generate_columns(schema, 500, seed=3, cardinalities={"city": 7})
+    assert set(cols) == {"city", "code", "fare", "ts"}
+    assert all(len(v) == 500 for v in cols.values())
+    assert len(set(cols["city"])) == 7
+    assert all(isinstance(v, float) for v in cols["fare"])
+    ts = cols["ts"]
+    assert all(b >= a for a, b in zip(ts, ts[1:]))  # time column increases
+
+
+def test_generate_deterministic(schema):
+    a = generate_columns(schema, 50, seed=9)
+    b = generate_columns(schema, 50, seed=9)
+    assert a == b
+    c = generate_columns(schema, 50, seed=10)
+    assert a != c
+
+
+def test_generated_data_builds_segment_and_queries(tmp_path, schema):
+    from pinot_tpu.ingest.transform import TransformPipeline
+    from pinot_tpu.query.executor import execute_query
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    cols = generate_columns(schema, 300, seed=1, cardinalities={"city": 5})
+    cols = TransformPipeline(schema).apply(cols)
+    seg = load_segment(SegmentBuilder(schema).build(cols, str(tmp_path), "gen_0"))
+    res = execute_query([seg], "SELECT city, COUNT(*) FROM gen GROUP BY city "
+                               "ORDER BY city LIMIT 10")
+    assert sum(r[1] for r in res.rows) == 300
+    assert len(res.rows) == 5
+
+
+def test_anonymizer_preserves_equality_and_order():
+    vals = ["delta", "alpha", "delta", None, "bravo"]
+    anon = ColumnAnonymizer("c").fit(vals)
+    out = anon.apply(vals)
+    assert out[0] == out[2]             # equality kept
+    assert out[3] is None               # nulls kept
+    assert (out[1] < out[4] < out[0]) == ("alpha" < "bravo" < "delta")  # order kept
+    assert not set(out) - {None} & set(vals)  # no original leaks
+
+
+def test_anonymizer_numeric_rank():
+    vals = [30, 10, 20, 10]
+    out = ColumnAnonymizer("n").fit(vals).apply(vals)
+    assert out == [2, 0, 1, 0]
+
+
+def test_anonymize_consistent_across_files():
+    shared = {}
+    a = anonymize_columns({"k": ["x", "y"], "v": [1, 2]}, ["k"], shared)
+    b = anonymize_columns({"k": ["y", "z"], "v": [3, 4]}, ["k"], shared)
+    assert a["k"][1] == b["k"][0]       # same token for "y" in both files
+    assert a["v"] == [1, 2]             # untouched column passes through
+
+
+def test_anonymize_file_roundtrip_csv_and_jsonl(tmp_path):
+    csv_in = tmp_path / "in.csv"
+    csv_in.write_text("user,city,n\nalice,nyc,1\nbob,sf,2\nalice,nyc,3\n")
+    csv_out = tmp_path / "out.csv"
+    anonymize_file(str(csv_in), str(csv_out), ["user"])
+    lines = csv_out.read_text().strip().splitlines()
+    assert lines[0] == "user,city,n"
+    u1, u2, u3 = (ln.split(",")[0] for ln in lines[1:])
+    assert u1 == u3 != u2 and "alice" not in {u1, u2}
+    assert lines[1].split(",")[1] == "nyc"  # untouched column survives
+
+    j_in = tmp_path / "in.jsonl"
+    j_in.write_text(json.dumps({"user": "alice", "n": 1}) + "\n"
+                    + json.dumps({"user": "bob", "n": 2}) + "\n")
+    j_out = tmp_path / "out.jsonl"
+    anonymize_file(str(j_in), str(j_out), ["user"])
+    rows = [json.loads(x) for x in j_out.read_text().splitlines()]
+    assert rows[0]["user"] != "alice" and rows[0]["n"] == 1
+
+
+def test_cli_generate_and_anonymize(tmp_path, schema):
+    from pinot_tpu.tools.admin import main
+    sf = tmp_path / "schema.json"
+    sf.write_text(json.dumps(schema.to_json()))
+    out = tmp_path / "data.csv"
+    rc = main(["generate-data", "--schema-file", str(sf), "--rows", "40",
+               "--out", str(out), "--cardinality", "city=3"])
+    assert rc == 0
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 41
+    anon_out = tmp_path / "anon.csv"
+    rc = main(["anonymize-data", "--input", str(out), "--out", str(anon_out),
+               "--columns", "city"])
+    assert rc == 0
+    assert len(anon_out.read_text().strip().splitlines()) == 41
+
+
+def test_anonymize_csv_numeric_rank_preserved(tmp_path):
+    p = tmp_path / "n.csv"
+    p.write_text("fare,k\n9,a\n10,b\n9,c\n")
+    out = tmp_path / "n_out.csv"
+    anonymize_file(str(p), str(out), ["fare"])
+    lines = out.read_text().strip().splitlines()
+    fares = [ln.split(",")[0] for ln in lines[1:]]
+    # numeric rank mapping: 9 -> 0, 10 -> 1 (not lexicographic string tokens)
+    assert fares == ["0", "1", "0"]

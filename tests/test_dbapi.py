@@ -1,0 +1,133 @@
+"""DB-API 2.0 driver tests (reference: pinot-jdbc-client PinotDriver /
+PinotPreparedStatement over the java-client)."""
+
+import numpy as np
+import pytest
+
+import pinot_tpu.dbapi as dbapi
+from pinot_tpu.dbapi import ProgrammingError, _substitute, escape
+from pinot_tpu.schema import Schema, dimension, metric
+from pinot_tpu.table import TableConfig
+
+
+# -- parameter substitution (pure) -------------------------------------------
+
+def test_escape_literals():
+    assert escape(None) == "NULL"
+    assert escape(True) == "true"
+    assert escape(5) == "5"
+    assert escape(2.5) == "2.5"
+    assert escape("o'hare") == "'o''hare'"
+    assert escape([1, 2, 3]) == "1, 2, 3"
+
+
+def test_substitute_skips_string_literals():
+    sql = _substitute("SELECT * FROM t WHERE a = '?' AND b = ?", [7])
+    assert sql == "SELECT * FROM t WHERE a = '?' AND b = 7"
+    sql = _substitute("SELECT * FROM t WHERE a = 'it''s ?' AND b = ?", ["x"])
+    assert sql == "SELECT * FROM t WHERE a = 'it''s ?' AND b = 'x'"
+
+
+def test_substitute_count_mismatch():
+    with pytest.raises(ProgrammingError):
+        _substitute("SELECT ? FROM t", [])
+    with pytest.raises(ProgrammingError):
+        _substitute("SELECT 1 FROM t", [1])
+
+
+def test_module_globals():
+    assert dbapi.apilevel == "2.0"
+    assert dbapi.paramstyle == "qmark"
+    assert issubclass(dbapi.ProgrammingError, dbapi.DatabaseError)
+    assert issubclass(dbapi.DatabaseError, dbapi.Error)
+
+
+# -- end-to-end over HTTP ----------------------------------------------------
+
+@pytest.fixture()
+def stack(tmp_path):
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+    from pinot_tpu.segment.writer import SegmentBuilder
+    from conftest import wait_until
+
+    catalog = Catalog()
+    ctrl = Controller("c0", catalog, LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "c"))
+    csvc = ControllerService(ctrl)
+    cats = [RemoteCatalog(csvc.url, poll_timeout_s=1.0)]
+    node = ServerNode("server_0", cats[0], ControllerDeepStore(csvc.url),
+                      str(tmp_path / "s0"))
+    ssvc = ServerService(node)
+    cats.append(RemoteCatalog(csvc.url, poll_timeout_s=1.0))
+    bsvc = BrokerService(Broker("b0", cats[1]))
+
+    schema = Schema("trips", [dimension("city"), metric("fare")])
+    ctrl.add_schema(schema)
+    ctrl.add_table(TableConfig("trips"))
+    seg = SegmentBuilder(schema).build(
+        {"city": ["nyc", "sf", "nyc", "la"],
+         "fare": np.array([1.0, 2.0, 3.0, 4.0])}, str(tmp_path / "b"), "trips_0")
+    ctrl.upload_segment("trips_OFFLINE", seg)
+    conn = dbapi.connect(bsvc.url)
+    try:
+        wait_until(lambda: conn.cursor().execute(
+            "SELECT COUNT(*) FROM trips").fetchone()[0] == 4)
+        yield conn
+    finally:
+        conn.close()
+        for c in cats:
+            c.close()
+        for s in (csvc, ssvc, bsvc):
+            s.stop()
+
+
+def test_cursor_fetch_and_description(stack):
+    cur = stack.cursor()
+    cur.execute("SELECT city, SUM(fare) FROM trips GROUP BY city "
+                "ORDER BY city LIMIT 10")
+    assert [d[0] for d in cur.description] == ["city", "sum(fare)"]
+    assert cur.description[0][1] == dbapi.STRING
+    assert cur.description[1][1] == dbapi.NUMBER
+    assert cur.rowcount == 3
+    assert cur.fetchone() == ["la", 4.0]
+    assert cur.fetchmany(1) == [["nyc", 4.0]]
+    assert cur.fetchall() == [["sf", 2.0]]
+    assert cur.fetchone() is None
+
+
+def test_parameterized_query(stack):
+    cur = stack.cursor()
+    cur.execute("SELECT COUNT(*) FROM trips WHERE city = ? AND fare >= ?",
+                ["nyc", 1.5])
+    assert cur.fetchone() == [1]
+
+
+def test_iteration_and_context_manager(stack):
+    with stack.cursor() as cur:
+        rows = list(cur.execute("SELECT city FROM trips ORDER BY city LIMIT 10"))
+        assert rows == [["la"], ["nyc"], ["nyc"], ["sf"]]
+    with pytest.raises(dbapi.InterfaceError):
+        cur.fetchone()
+
+
+def test_fetch_before_execute_raises(stack):
+    with pytest.raises(ProgrammingError):
+        stack.cursor().fetchall()
+
+
+def test_bad_sql_raises_operational(stack):
+    with pytest.raises(dbapi.OperationalError):
+        stack.cursor().execute("SELECT bogus_col FROM trips")
+
+
+def test_rollback_not_supported(stack):
+    stack.commit()  # no-op
+    with pytest.raises(dbapi.NotSupportedError):
+        stack.rollback()

@@ -119,3 +119,41 @@ def test_multiple_tiers_oldest_threshold_wins(tiered_cluster):
     pools = sorted(tuple(sorted(a)) for a in ist.values())
     assert pools == [("server_cold",), ("server_frozen",)]
     assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 20
+
+
+def test_relocation_spreads_over_pool_and_skips_consuming(tmp_path):
+    """A batch of aged segments must spread across the tier pool (not dogpile
+    the first server), and consuming (IN_PROGRESS) segments never relocate."""
+    from pinot_tpu.cluster.catalog import STATUS_IN_PROGRESS
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    for i in (1, 2):
+        cold = ServerNode(f"server_cold{i}", cluster.catalog, cluster.deepstore,
+                          os.path.join(str(tmp_path), f"server_cold{i}"),
+                          tags=["cold"], completion=cluster.controller.llc)
+        cluster.broker.register_server_handle(
+            cold.instance_id, cold.execute_partial,
+            explain_handle=cold.explain_partial)
+    now_ms = int(time.time() * 1000)
+    cfg = TableConfig("events", replication=1, time_column="ts",
+                      tiers=[TierConfig("cold", 7.0, "cold")])
+    cluster.create_table(_schema(), cfg)
+    table = cfg.table_name_with_type
+    for _ in range(6):
+        cluster.ingest_columns(cfg, _cols(20, now_ms - 30 * 86_400_000))
+    # one fake consuming segment must be left alone
+    from pinot_tpu.cluster.catalog import SegmentMeta
+    cluster.catalog.put_segment_meta(SegmentMeta(
+        name="events__0__0__x", table=table, status=STATUS_IN_PROGRESS,
+        partition_group=0, sequence_number=0, start_offset="0"))
+    cluster.catalog.update_ideal_state(
+        table, {"events__0__0__x": {"server_0": "CONSUMING"}})
+
+    moved = cluster.controller.run_segment_relocation()
+    assert len(moved) == 6
+    ist = cluster.catalog.ideal_state[table]
+    placements = [next(iter(a)) for seg, a in ist.items()
+                  if seg != "events__0__0__x"]
+    assert set(placements) == {"server_cold1", "server_cold2"}
+    counts = {s: placements.count(s) for s in set(placements)}
+    assert all(c == 3 for c in counts.values()), counts
+    assert ist["events__0__0__x"] == {"server_0": "CONSUMING"}
