@@ -57,7 +57,9 @@ class RealtimePartitionConsumer:
         self.completion = completion            # LLCSegmentManager (or HTTP proxy)
         self.data_dir = data_dir
         self.state = INITIAL_CONSUMING
-        self.mutable = MutableSegment(segment_name, schema)
+        self.mutable = MutableSegment(
+            segment_name, schema,
+            text_index_columns=table_cfg.indexing.text_index_columns)
         self.pipeline = pipeline or TransformPipeline(schema)
         self.upsert = upsert                    # TableUpsertMetadataManager or None
         self.dedup = dedup                      # PartitionDedupMetadataManager or None

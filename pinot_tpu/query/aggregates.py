@@ -407,11 +407,13 @@ class PercentileTDigestAgg(AggFunc):
     multi-host reduce without shipping raw rows.
     """
     name = "percentiletdigest"
+    pct_base = "percentiletdigest"  # suffix parsing base — MV subclasses keep
+    # the parent's base because their call name was already 'mv'-stripped
     COMPRESSION = 100.0
 
     def __init__(self, call: Function):
         super().__init__(call)
-        self.pct = _parse_percentile(call, self.name)
+        self.pct = _parse_percentile(call, self.pct_base)
 
     def device_ok(self, ctx: AggContext) -> bool:
         return False
@@ -432,6 +434,7 @@ class PercentileEstAgg(PercentileTDigestAgg):
     """PERCENTILEEST — approximate long-valued percentile (reference uses QuantileDigest;
     here the same t-digest state with integer extraction)."""
     name = "percentileest"
+    pct_base = "percentileest"
 
     def finalize(self, state):
         q = state.quantile(self.pct / 100.0)
@@ -929,6 +932,7 @@ class SumPrecisionAgg(AggFunc):
 class PercentileRawTDigestAgg(PercentileTDigestAgg):
     """PERCENTILERAWTDIGEST — serialized t-digest (hex) for client-side merging."""
     name = "percentilerawtdigest"
+    pct_base = "percentilerawtdigest"
 
     def finalize(self, state):
         return state.to_bytes().hex()
@@ -1007,6 +1011,130 @@ class DistinctCountMVAgg(DistinctCountAgg):
         return super().host_state(_mv_flat(values))
 
 
+def _strip_mv(call: Function) -> Function:
+    return Function(call.name[:-2], call.args, call.distinct)
+
+
+class PercentileMVAgg(PercentileAgg):
+    """PERCENTILEMV / PERCENTILE<NN>MV — exact percentile over flattened
+    multi-value cells (reference: PercentileMVAggregationFunction)."""
+    name = "percentilemv"
+
+    def __init__(self, call: Function):
+        super().__init__(_strip_mv(call))
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class PercentileEstMVAgg(PercentileEstAgg):
+    name = "percentileestmv"
+
+    def __init__(self, call: Function):
+        super().__init__(_strip_mv(call))
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class PercentileTDigestMVAgg(PercentileTDigestAgg):
+    name = "percentiletdigestmv"
+
+    def __init__(self, call: Function):
+        super().__init__(_strip_mv(call))
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class DistinctCountHLLMVAgg(DistinctCountHLLAgg):
+    """Reference: DistinctCountHLLMVAggregationFunction."""
+    name = "distinctcounthllmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class SegmentPartitionedDistinctCountAgg(AggFunc):
+    """Exact distinct count under the promise that the column is partitioned by
+    segment (each value appears in only one segment): per-segment exact unique
+    count, merged by SUM — O(1) merge state instead of shipping value sets
+    (reference: SegmentPartitionedDistinctCountAggregationFunction; returns
+    overcounts if the promise is violated, same as the reference)."""
+    name = "segmentpartitioneddistinctcount"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        arr = np.asarray(values)
+        if arr.dtype == object:
+            return len({v for v in arr if v is not None})
+        return len(np.unique(arr))
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, state):
+        return int(state)
+
+    def empty_result(self):
+        return 0
+
+
+class DistinctCountSmartHLLAgg(AggFunc):
+    """Exact distinct set until `threshold` distinct values, then degrade to
+    HLL (reference: DistinctCountSmartHLLAggregationFunction). Second literal
+    argument overrides the threshold."""
+    name = "distinctcountsmarthll"
+    DEFAULT_THRESHOLD = 100_000
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        self.threshold = self.DEFAULT_THRESHOLD
+        if len(call.args) >= 2:
+            from ..sql.ast import Literal
+            if isinstance(call.args[1], Literal):
+                self.threshold = int(call.args[1].value)
+        self._hll = DistinctCountHLLAgg(Function("distinctcounthll",
+                                                 call.args[:1]))
+
+    def device_ok(self, ctx):
+        return False
+
+    def _to_hll(self, values_set):
+        return self._hll.host_state(np.asarray(list(values_set), dtype=object))
+
+    def host_state(self, values):
+        s = {v for v in np.asarray(values, dtype=object).reshape(-1)
+             if v is not None}
+        if len(s) > self.threshold:
+            return ("hll", self._to_hll(s))
+        return ("set", s)
+
+    def merge(self, a, b):
+        ka, va = a
+        kb, vb = b
+        if ka == "set" and kb == "set":
+            u = va | vb
+            if len(u) > self.threshold:
+                return ("hll", self._to_hll(u))
+            return ("set", u)
+        ha = va if ka == "hll" else self._to_hll(va)
+        hb = vb if kb == "hll" else self._to_hll(vb)
+        return ("hll", np.maximum(ha, hb))
+
+    def finalize(self, state):
+        kind, v = state
+        return len(v) if kind == "set" else self._hll.finalize(v)
+
+    def empty_result(self):
+        return 0
+
+
 class IdSetAgg(AggFunc):
     """IDSET(col): build a serialized value-set usable as an `IN_ID_SET` filter
     literal in a later query (reference: IdSetAggregationFunction; the broker's
@@ -1043,6 +1171,11 @@ class IdSetMVAgg(IdSetAgg):
 _REGISTRY = {
     "idset": IdSetAgg,
     "idsetmv": IdSetMVAgg,
+    # (percentile*mv names dispatch through make_agg's MV-percentile branch,
+    # which also handles the digit-suffix forms — not via this registry)
+    "distinctcounthllmv": DistinctCountHLLMVAgg,
+    "segmentpartitioneddistinctcount": SegmentPartitionedDistinctCountAgg,
+    "distinctcountsmarthll": DistinctCountSmartHLLAgg,
     "count": CountAgg,
     "countmv": CountMVAgg,
     "summv": SumMVAgg,
@@ -1093,6 +1226,14 @@ def make_agg(call: Function) -> AggFunc:
     if call.name == "count" and call.distinct:
         # COUNT(DISTINCT x) -> DISTINCTCOUNT(x), reference does the same rewrite
         return DistinctCountAgg(Function("distinctcount", call.args))
+    if name.endswith("mv") and name.startswith("percentile"):
+        stem = name[:-2]
+        for prefix, cls in (("percentiletdigest", PercentileTDigestMVAgg),
+                            ("percentileest", PercentileEstMVAgg),
+                            ("percentile", PercentileMVAgg)):
+            if stem == prefix or (stem.startswith(prefix)
+                                  and stem[len(prefix):].isdigit()):
+                return cls(call)
     for prefix, cls in (("percentilerawtdigest", PercentileRawTDigestAgg),
                         ("percentiletdigest", PercentileTDigestAgg),
                         ("percentileest", PercentileEstAgg),

@@ -337,7 +337,17 @@ def _build_lut(op: str, values: List[Any], reader: ColumnReader) -> np.ndarray:
     elif op == "like":
         lut[d.ids_matching_like(str(values[0]))] = True
     elif op == "regexp_like":
-        lut[d.ids_matching_regex(str(values[0]))] = True
+        # trigram FST-analog index prefilters the dictionary scan when present
+        # (reference: FSTBasedRegexpPredicateEvaluatorFactory); falls back to
+        # the full per-distinct-value regex otherwise
+        ids = None
+        fst = getattr(reader, "fst_index", None)
+        if fst is not None:
+            from ..segment.indexes.fst import ids_matching_regex_indexed
+            ids = ids_matching_regex_indexed(fst, d.values, str(values[0]))
+        if ids is None:
+            ids = d.ids_matching_regex(str(values[0]))
+        lut[ids] = True
     else:
         raise QueryValidationError(f"unsupported predicate {op} on dictionary column")
     return lut

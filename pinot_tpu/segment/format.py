@@ -55,6 +55,7 @@ RANGE_SUFFIX = ".rng.npz"
 BLOOM_SUFFIX = ".bloom.npy"
 JSON_SUFFIX = ".json.npz"
 TEXT_SUFFIX = ".text.npz"
+FST_SUFFIX = ".fst.npz"  # trigram regex prefilter over the dictionary
 MV_OFFSETS_SUFFIX = ".mvoff.npy"
 
 FORMAT_VERSION = 1

@@ -60,28 +60,19 @@ def create_text_index(path: str, raw_values: Iterable[Any]) -> None:
              token_blob=np.frombuffer(blob, dtype=np.uint8))
 
 
-class TextIndexReader:
-    def __init__(self, path: str, num_docs: int):
-        data = np.load(path)
-        self._doc_ids = data["doc_ids"]
-        self._positions = data["positions"]
-        self._offsets = data["offsets"]
-        blob = data["token_blob"].tobytes().decode("utf-8")
-        self._tokens: List[str] = blob.split("\x00") if blob else []
-        self.num_docs = num_docs
+class _TextMaskOps:
+    """Shared TEXT_MATCH mask algebra over two primitives: `_term_pairs(token)`
+    -> (doc_ids, positions) and `_iter_token_docs()` -> iterable of
+    (token, doc_id_array). ONE copy of term/prefix/regex/phrase semantics for
+    the immutable reader and the realtime view — they cannot drift."""
 
-    # -- primitives ---------------------------------------------------------
-    def _token_index(self, token: str) -> int:
-        import bisect
-        i = bisect.bisect_left(self._tokens, token)
-        return i if i < len(self._tokens) and self._tokens[i] == token else -1
+    num_docs: int
 
-    def _term_pairs(self, token: str) -> Tuple[np.ndarray, np.ndarray]:
-        i = self._token_index(token)
-        if i < 0:
-            return np.empty(0, dtype=np.int32), np.empty(0, dtype=np.int32)
-        lo, hi = self._offsets[i], self._offsets[i + 1]
-        return self._doc_ids[lo:hi], self._positions[lo:hi]
+    def _term_pairs(self, token: str):
+        raise NotImplementedError
+
+    def _iter_token_docs(self):
+        raise NotImplementedError
 
     def mask_for_term(self, token: str) -> np.ndarray:
         m = np.zeros(self.num_docs, dtype=bool)
@@ -90,21 +81,19 @@ class TextIndexReader:
         return m
 
     def mask_for_prefix(self, prefix: str) -> np.ndarray:
-        import bisect
         prefix = prefix.lower()
-        lo = bisect.bisect_left(self._tokens, prefix)
-        hi = bisect.bisect_left(self._tokens, prefix + "￿")
         m = np.zeros(self.num_docs, dtype=bool)
-        if lo < hi:
-            m[self._doc_ids[self._offsets[lo]:self._offsets[hi]]] = True
+        for tok, docs in self._iter_token_docs():
+            if tok.startswith(prefix):
+                m[docs[docs < self.num_docs]] = True
         return m
 
     def mask_for_regex(self, pattern: str) -> np.ndarray:
         rx = re.compile(pattern)
         m = np.zeros(self.num_docs, dtype=bool)
-        for i, t in enumerate(self._tokens):
-            if rx.fullmatch(t):
-                m[self._doc_ids[self._offsets[i]:self._offsets[i + 1]]] = True
+        for tok, docs in self._iter_token_docs():
+            if rx.fullmatch(tok):
+                m[docs[docs < self.num_docs]] = True
         return m
 
     def mask_for_phrase(self, tokens: List[str]) -> np.ndarray:
@@ -131,6 +120,46 @@ class TextIndexReader:
         """Lucene-ish boolean query: terms, "phrases", prefix*, /regex/, AND/OR/NOT, parens.
         Bare whitespace between terms means OR (Lucene default operator)."""
         return _QueryParser(query, self).parse()
+
+
+class TextIndexReader(_TextMaskOps):
+    def __init__(self, path: str, num_docs: int):
+        data = np.load(path)
+        self._doc_ids = data["doc_ids"]
+        self._positions = data["positions"]
+        self._offsets = data["offsets"]
+        blob = data["token_blob"].tobytes().decode("utf-8")
+        self._tokens: List[str] = blob.split("\x00") if blob else []
+        self.num_docs = num_docs
+
+    # -- primitives ---------------------------------------------------------
+    def _token_index(self, token: str) -> int:
+        import bisect
+        i = bisect.bisect_left(self._tokens, token)
+        return i if i < len(self._tokens) and self._tokens[i] == token else -1
+
+    def _term_pairs(self, token: str) -> Tuple[np.ndarray, np.ndarray]:
+        i = self._token_index(token)
+        if i < 0:
+            return np.empty(0, dtype=np.int32), np.empty(0, dtype=np.int32)
+        lo, hi = self._offsets[i], self._offsets[i + 1]
+        return self._doc_ids[lo:hi], self._positions[lo:hi]
+
+    def _iter_token_docs(self):
+        for i, t in enumerate(self._tokens):
+            yield t, self._doc_ids[self._offsets[i]:self._offsets[i + 1]]
+
+    def mask_for_prefix(self, prefix: str) -> np.ndarray:
+        # sorted token array: prefix range is contiguous — faster than the
+        # generic scan in _TextMaskOps
+        import bisect
+        prefix = prefix.lower()
+        lo = bisect.bisect_left(self._tokens, prefix)
+        hi = bisect.bisect_left(self._tokens, prefix + "\uffff")
+        m = np.zeros(self.num_docs, dtype=bool)
+        if lo < hi:
+            m[self._doc_ids[self._offsets[lo]:self._offsets[hi]]] = True
+        return m
 
 
 class _QueryParser:
@@ -245,6 +274,63 @@ class _QueryParser:
         if kind == "regex":
             return self.index.mask_for_regex(val)
         return self.index.mask_for_term(val)
+
+
+class MutableTextIndex:
+    """Incrementally-maintained text index for a CONSUMING column.
+
+    Analog of the reference's realtime Lucene index
+    (`realtime/impl/invertedindex/RealtimeLuceneTextIndexReader.java` + its
+    `RealtimeLuceneIndexReaderRefreshThread`): TEXT_MATCH over a consuming
+    segment must not re-tokenize the whole column per query. Single writer
+    appends postings per event; queries snapshot by doc count (`view()`), so a
+    concurrent append is simply not visible yet. No refresh lag: the reference
+    needs a reopen thread because Lucene readers are point-in-time, dict
+    postings are queryable immediately.
+
+    (The reference also keeps a realtime INVERTED index; in this engine the
+    host filter path evaluates dictionary predicates as vectorized LUT lookups
+    over the id snapshot, so per-dict-id doc bitmaps would be dead weight —
+    there is deliberately no mutable inverted index.)"""
+
+    def __init__(self):
+        self._postings: Dict[str, List[Tuple[int, int]]] = {}
+        self._num_docs = 0
+
+    def add_doc(self, text: Any) -> None:
+        d = self._num_docs
+        if text is not None:
+            for pos, tok in enumerate(tokenize_text(text)):
+                self._postings.setdefault(tok, []).append((d, pos))
+        # publish the doc AFTER its postings: a concurrent view() snapshot
+        # either sees the full doc or none of it
+        self._num_docs = d + 1
+
+    def view(self) -> "_MutableTextView":
+        return _MutableTextView(self._postings, self._num_docs)
+
+
+class _MutableTextView(_TextMaskOps):
+    """Point-in-time reader over MutableTextIndex postings — all mask algebra
+    inherited from _TextMaskOps; only the postings primitives differ."""
+
+    def __init__(self, postings: Dict[str, List[Tuple[int, int]]], num_docs: int):
+        self._postings = postings
+        self.num_docs = num_docs
+
+    def _term_pairs(self, token: str) -> Tuple[np.ndarray, np.ndarray]:
+        # the pairs list is append-only; entries past the snapshot are filtered
+        pairs = [pr for pr in self._postings.get(token, ())
+                 if pr[0] < self.num_docs]
+        docs = np.asarray([d for d, _ in pairs], dtype=np.int32)
+        poss = np.asarray([p for _, p in pairs], dtype=np.int32)
+        return docs, poss
+
+    def _iter_token_docs(self):
+        # list() the live dict: the single writer may insert a first-seen token
+        # concurrently, and dict-resize during iteration raises RuntimeError
+        for tok, pairs in list(self._postings.items()):
+            yield tok, np.asarray([d for d, _ in pairs], dtype=np.int32)
 
 
 class _InMemoryTextIndex(TextIndexReader):

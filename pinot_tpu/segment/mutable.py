@@ -116,6 +116,13 @@ class MutableColumnReader:
         return np.asarray(vals, dtype=self.data_type.numpy_dtype)
 
     @property
+    def text_index(self):
+        """Point-in-time view of the realtime text index, or None when the
+        column isn't text-indexed (TEXT_MATCH then scan-falls-back)."""
+        idx = self.store.text_indexes.get(self.name)
+        return idx.view() if idx is not None else None
+
+    @property
     def null_bitmap(self) -> Optional[np.ndarray]:
         nulls = self.store.null_rows.get(self.name)
         if not nulls:
@@ -184,7 +191,8 @@ class MutableSegment:
 
     is_mutable = True
 
-    def __init__(self, name: str, schema: Schema):
+    def __init__(self, name: str, schema: Schema,
+                 text_index_columns: Sequence[str] = ()):
         self.name = name
         self.schema = schema
         self.columns: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
@@ -192,6 +200,12 @@ class MutableSegment:
         self._num_docs = 0          # volatile row counter (MutableSegmentImpl.java:145)
         self._readers: Dict[str, MutableColumnReader] = {}
         self.start_time_ms = int(time.time() * 1000)
+        # incrementally-maintained realtime text indexes (reference: realtime
+        # Lucene index; see indexes/text.py MutableTextIndex)
+        from .indexes.text import MutableTextIndex
+        self.text_indexes: Dict[str, MutableTextIndex] = {
+            c: MutableTextIndex() for c in text_index_columns
+            if schema.has_column(c)}
 
     @property
     def num_docs(self) -> int:
@@ -217,6 +231,9 @@ class MutableSegment:
             else:
                 v = spec.data_type.coerce(v)
             self.columns[spec.name].append(v)
+            idx = self.text_indexes.get(spec.name)
+            if idx is not None:
+                idx.add_doc(v)
         self._num_docs = n + 1  # publish the row (single atomic int store)
 
     def column(self, name: str) -> MutableColumnReader:

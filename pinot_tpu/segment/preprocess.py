@@ -33,6 +33,9 @@ def desired_indexes(col_meta: Dict[str, Any], name: str, indexing) -> List[str]:
         # for MV too, so want/have stay converged)
         if name in indexing.range_index_columns and not mv:
             out.append("range")
+        if name in getattr(indexing, "fst_index_columns", []) \
+                and col_meta.get("dataType") != "BYTES":
+            out.append("fst")
     if name in indexing.bloom_filter_columns:
         out.append("bloom")
     if name in getattr(indexing, "json_index_columns", []) and not mv:
@@ -44,7 +47,7 @@ def desired_indexes(col_meta: Dict[str, Any], name: str, indexing) -> List[str]:
 
 _SUFFIX = {"inverted": fmt.INVERTED_SUFFIX, "range": fmt.RANGE_SUFFIX,
            "bloom": fmt.BLOOM_SUFFIX, "json": fmt.JSON_SUFFIX,
-           "text": fmt.TEXT_SUFFIX}
+           "text": fmt.TEXT_SUFFIX, "fst": fmt.FST_SUFFIX}
 
 
 def preprocess_segment(seg_dir: str, indexing,
@@ -168,5 +171,8 @@ def _build_index(idx: str, seg: ImmutableSegment, name: str,
     elif idx == "text":
         from .indexes.text import create_text_index
         create_text_index(prefix + fmt.TEXT_SUFFIX, list(reader.values()))
+    elif idx == "fst":
+        from .indexes.fst import create_fst_index
+        create_fst_index(prefix + fmt.FST_SUFFIX, list(reader.dictionary.values))
     else:
         raise ValueError(f"unknown index type {idx!r}")

@@ -136,6 +136,12 @@ class ColumnReader:
         return TextIndexReader(path, self.num_docs) if "text" in self.index_types else None
 
     @cached_property
+    def fst_index(self):
+        from .indexes.fst import FstIndexReader
+        path = self._prefix + fmt.FST_SUFFIX
+        return FstIndexReader(path) if "fst" in self.index_types else None
+
+    @cached_property
     def null_bitmap(self) -> Optional[np.ndarray]:
         """bool[num_docs] of null positions, or None."""
         if not self.meta.get("hasNulls"):

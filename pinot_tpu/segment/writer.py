@@ -35,6 +35,7 @@ class SegmentGeneratorConfig:
     bloom_filter_columns: List[str] = field(default_factory=list)
     json_index_columns: List[str] = field(default_factory=list)
     text_index_columns: List[str] = field(default_factory=list)
+    fst_index_columns: List[str] = field(default_factory=list)
     # raw-encode numeric columns whose cardinality exceeds this fraction of num_docs
     raw_cardinality_fraction: float = 0.7
     # star-tree pre-aggregation configs (segment/startree.py StarTreeIndexConfig)
@@ -59,6 +60,7 @@ class SegmentGeneratorConfig:
             bloom_filter_columns=list(idx.bloom_filter_columns),
             json_index_columns=list(getattr(idx, "json_index_columns", [])),
             text_index_columns=list(getattr(idx, "text_index_columns", [])),
+            fst_index_columns=list(getattr(idx, "fst_index_columns", [])),
             geo_index_pairs=list(getattr(idx, "geo_index_pairs", [])),
             raw_compression=getattr(idx, "raw_compression", ""),
             star_tree_configs=[_star_tree_cfg(d)
@@ -242,6 +244,14 @@ class SegmentBuilder:
             if name in self.config.range_index_columns:
                 create_range_index(prefix + fmt.RANGE_SUFFIX, dict_ids, card)
                 indexes.append("range")
+            if name in self.config.fst_index_columns \
+                    and data_type is not DataType.BYTES:
+                # BYTES is excluded: the unindexed REGEXP_LIKE path matches
+                # nothing on bytes (isinstance str check), and the index must
+                # be a pure accelerator — never change results
+                from .indexes.fst import create_fst_index
+                create_fst_index(prefix + fmt.FST_SUFFIX, list(dictionary.values))
+                indexes.append("fst")
         else:
             arr = np.asarray(raw, dtype=data_type.numpy_dtype)
             codec = self.config.raw_compression

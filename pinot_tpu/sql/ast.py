@@ -72,6 +72,8 @@ AGGREGATION_FUNCTIONS = {
     # id-set building for cross-query IN_ID_SET filters (reference:
     # IdSetAggregationFunction)
     "idset", "idsetmv",
+    "distinctcounthllmv", "segmentpartitioneddistinctcount",
+    "distinctcountsmarthll",
 }
 
 

@@ -28,6 +28,9 @@ class IndexingConfig:
     no_dictionary_columns: List[str] = field(default_factory=list)
     json_index_columns: List[str] = field(default_factory=list)
     text_index_columns: List[str] = field(default_factory=list)
+    # trigram regex prefilter over the dictionary (reference: FST index,
+    # fieldConfigList FST indexType)
+    fst_index_columns: List[str] = field(default_factory=list)
     sorted_column: Optional[str] = None
     star_tree_configs: List[Dict[str, Any]] = field(default_factory=list)
     geo_index_pairs: List[str] = field(default_factory=list)  # "lngCol,latCol"
@@ -41,6 +44,7 @@ class IndexingConfig:
             "noDictionaryColumns": self.no_dictionary_columns,
             "jsonIndexColumns": self.json_index_columns,
             "textIndexColumns": self.text_index_columns,
+            "fstIndexColumns": self.fst_index_columns,
             "sortedColumn": self.sorted_column,
             "starTreeIndexConfigs": self.star_tree_configs,
             "geoIndexPairs": self.geo_index_pairs,
@@ -56,6 +60,7 @@ class IndexingConfig:
             no_dictionary_columns=d.get("noDictionaryColumns", []),
             json_index_columns=d.get("jsonIndexColumns", []),
             text_index_columns=d.get("textIndexColumns", []),
+            fst_index_columns=d.get("fstIndexColumns", []),
             sorted_column=d.get("sortedColumn"),
             star_tree_configs=d.get("starTreeIndexConfigs", []),
             geo_index_pairs=d.get("geoIndexPairs", []),

@@ -1,0 +1,185 @@
+"""Trigram FST-analog regex index (reference: native FST index under
+segment/local/utils/nativefst/ + FSTBasedRegexpPredicateEvaluatorFactory).
+
+Core invariant: the index must be a pure accelerator — REGEXP_LIKE results with
+and without the index are identical for ANY pattern (false positives filtered by
+the exact regex; candidate extraction conservative enough to never lose a match).
+"""
+
+import re
+import string
+
+import numpy as np
+import pytest
+
+from pinot_tpu.query.executor import execute_query
+from pinot_tpu.segment import SegmentBuilder, SegmentGeneratorConfig, load_segment
+from pinot_tpu.segment.indexes.fst import (FstIndexReader, create_fst_index,
+                                           ids_matching_regex_indexed,
+                                           required_literals)
+
+
+# -- literal extraction ------------------------------------------------------
+
+@pytest.mark.parametrize("pattern,expected", [
+    ("error", ["error"]),
+    ("^error$", ["error"]),
+    ("foo.*bar", ["foo", "bar"]),
+    ("ab", []),                       # too short
+    ("foo|bar", []),                  # alternation voids requirements
+    ("fooo*", ["foo"]),               # trailing o optional, 'foo' still required
+    ("colou?r", ["colo"]),            # optional u cuts the run after 'colo'
+    ("err[0-9]+code", ["err", "code"]),
+    ("(warn)+fatal", ["fatal"]),
+    ("a{2,3}bcd", ["bcd"]),
+    ("abc\\d+", ["abc"]),
+    ("(?i)error", []),                # inline flags -> not indexable
+])
+def test_required_literals(pattern, expected):
+    assert required_literals(pattern) == expected, pattern
+
+
+def _check_extraction_safe(pattern, values):
+    """Every literal claimed 'required' must appear in every matching value."""
+    rx = re.compile(pattern)
+    for lit in required_literals(pattern):
+        for v in values:
+            if rx.search(v):
+                assert lit in v, (pattern, lit, v)
+
+
+def test_extraction_never_loses_matches_random():
+    rng = np.random.default_rng(7)
+    alphabet = "abcde"
+    values = ["".join(rng.choice(list(alphabet), size=rng.integers(3, 12)))
+              for _ in range(300)]
+    pieces = ["abc", "de", "a.c", "b+", "c*", "d?e", "[ab]", "(cd)", "ab|cd",
+              "^ab", "de$", "a{2}", "b{0,2}"]
+    for _ in range(200):
+        k = rng.integers(1, 4)
+        pattern = "".join(rng.choice(pieces) for _ in range(k))
+        try:
+            re.compile(pattern)
+        except re.error:
+            continue
+        _check_extraction_safe(pattern, values)
+
+
+# -- index correctness vs full scan ------------------------------------------
+
+def test_indexed_regex_equals_full_scan(tmp_path):
+    rng = np.random.default_rng(3)
+    words = ["server", "service", "serial", "verse", "obverse", "nurse",
+             "错误代码", "err_500", "err_404", "warning", "fatal_error",
+             "x" * 50, "", "abcabcabc"]
+    vals = sorted({w + str(i % 7) for i, w in enumerate(words * 10)})
+    path = str(tmp_path / "t.fst.npz")
+    create_fst_index(path, vals)
+    idx = FstIndexReader(path)
+    for pattern in ["err", "err_[0-9]+", "serv(er|ice)", "^obv", "verse[0-9]$",
+                    "abcabc", "错误", "nomatchxyz", "fatal_error[0-3]"]:
+        got = ids_matching_regex_indexed(idx, vals, pattern)
+        rx = re.compile(pattern)
+        want = [i for i, v in enumerate(vals) if rx.search(v)]
+        if got is None:
+            continue  # unindexable pattern: full scan path, nothing to compare
+        assert got.tolist() == want, pattern
+
+
+def test_index_skips_unindexable_patterns(tmp_path):
+    path = str(tmp_path / "u.fst.npz")
+    create_fst_index(path, ["aa", "bb"])
+    idx = FstIndexReader(path)
+    assert idx.candidate_ids("a|b") is None
+    assert idx.candidate_ids("x?y?") is None
+    assert ids_matching_regex_indexed(idx, ["aa", "bb"], "a|b") is None
+
+
+# -- end-to-end query path ---------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fst_segment(tmp_path_factory):
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    rng = np.random.default_rng(5)
+    schema = Schema("logs", [dimension("msg", DataType.STRING),
+                             metric("n", DataType.INT)])
+    stems = ["connection reset", "timeout waiting", "auth failed",
+             "disk full", "retry scheduled", "ok"]
+    msgs = [f"{stems[i % len(stems)]} host{i % 17}" for i in range(2000)]
+    cols = {"msg": msgs, "n": rng.integers(0, 100, 2000, dtype=np.int32)}
+    out = tmp_path_factory.mktemp("fstseg")
+    with_idx = SegmentBuilder(schema, SegmentGeneratorConfig(
+        fst_index_columns=["msg"])).build(cols, str(out), "logs_fst")
+    without_idx = SegmentBuilder(schema, SegmentGeneratorConfig()).build(
+        cols, str(out), "logs_plain")
+    return load_segment(with_idx), load_segment(without_idx)
+
+
+def test_query_results_identical_with_and_without_index(fst_segment):
+    seg_i, seg_p = fst_segment
+    assert seg_i.column("msg").fst_index is not None
+    assert seg_p.column("msg").fst_index is None
+    for pattern in ["timeout", "host1[0-9]", "auth.*host3", "resets?",
+                    "full|empty", "^ok", "no_such_message"]:
+        sql = (f"SELECT COUNT(*), SUM(n) FROM logs "
+               f"WHERE REGEXP_LIKE(msg, '{pattern}')")
+        a = execute_query([seg_i], sql).rows
+        b = execute_query([seg_p], sql).rows
+        assert a == b, (pattern, a, b)
+    # sanity: some patterns actually match
+    n = execute_query([seg_i], "SELECT COUNT(*) FROM logs "
+                               "WHERE REGEXP_LIKE(msg, 'timeout')").rows[0][0]
+    assert n > 0
+
+
+def test_reload_adds_fst_index(tmp_path):
+    from pinot_tpu.segment.preprocess import preprocess_segment
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import IndexingConfig
+    schema = Schema("logs", [dimension("msg", DataType.STRING),
+                             metric("n", DataType.INT)])
+    seg_dir = SegmentBuilder(schema).build(
+        {"msg": ["alpha one", "beta two", "alpha three"],
+         "n": np.array([1, 2, 3], dtype=np.int32)}, str(tmp_path), "logs_0")
+    changes = preprocess_segment(seg_dir, IndexingConfig(fst_index_columns=["msg"]))
+    assert any("added fst" in c for c in changes)
+    seg = load_segment(seg_dir)
+    assert seg.column("msg").fst_index is not None
+    n = execute_query([seg], "SELECT COUNT(*) FROM logs "
+                             "WHERE REGEXP_LIKE(msg, 'alpha')").rows[0][0]
+    assert n == 2
+
+
+def test_fst_handles_nul_in_values(tmp_path):
+    vals = ["a\x00bcq", "yellow", "zebra", "zenith", "zzzzzz"]
+    path = str(tmp_path / "nul.fst.npz")
+    create_fst_index(path, vals)
+    idx = FstIndexReader(path)
+    got = ids_matching_regex_indexed(idx, vals, "zebra")
+    assert got is not None and got.tolist() == [2]
+    got = ids_matching_regex_indexed(idx, vals, "zzzz")
+    assert got is not None and got.tolist() == [4]
+    got = ids_matching_regex_indexed(idx, vals, "a\x00bc")
+    assert got is not None and got.tolist() == [0]
+
+
+def test_fst_skipped_for_bytes_columns(tmp_path):
+    from pinot_tpu.schema import DataType, Schema, dimension, metric, FieldSpec, FieldRole
+    schema = Schema("b", [FieldSpec("raw", DataType.BYTES, FieldRole.DIMENSION),
+                          metric("n", DataType.INT)])
+    seg = load_segment(SegmentBuilder(schema, SegmentGeneratorConfig(
+        fst_index_columns=["raw"])).build(
+        {"raw": [b"\x01\x02", b"\x03"], "n": np.array([1, 2], dtype=np.int32)},
+        str(tmp_path), "b_0"))
+    assert seg.column("raw").fst_index is None
+
+
+def test_percentile_digit_suffix_mv_forms():
+    from pinot_tpu.query.aggregates import make_agg
+    from pinot_tpu.sql.ast import Function, Identifier
+    for name, pct in [("percentile95mv", 95.0), ("percentileest90mv", 90.0),
+                      ("percentiletdigest50mv", 50.0), ("percentilemv", None)]:
+        args = (Identifier("scores"),) if pct is not None \
+            else (Identifier("scores"), __import__("pinot_tpu.sql.ast", fromlist=["Literal"]).Literal(75))
+        agg = make_agg(Function(name, args))
+        assert agg.pct == (pct if pct is not None else 75.0), name

@@ -268,3 +268,93 @@ def test_text_match_selection(jenv):
                         "TEXT_MATCH(doc, '\"query engine\"') LIMIT 500")
     assert len(res.rows) == sum(1 for t in texts if "query engine" in t)
     assert all("query engine" in r[0] for r in res.rows)
+
+
+# -- realtime (mutable) text index -------------------------------------------
+
+class TestMutableTextIndex:
+    """Reference: RealtimeLuceneTextIndexReader — TEXT_MATCH over a consuming
+    segment rides an incrementally-maintained index, not a per-query rescan."""
+
+    def _mutable(self):
+        from pinot_tpu.schema import DataType, Schema, dimension, metric
+        from pinot_tpu.segment.mutable import MutableSegment
+        schema = Schema("logs", [dimension("msg", DataType.STRING),
+                                 metric("n", DataType.INT)])
+        seg = MutableSegment("logs__0__0__x", schema,
+                             text_index_columns=["msg"])
+        for i, msg in enumerate(["connection reset by peer",
+                                 "auth failed for user bob",
+                                 "connection timeout",
+                                 "all good"]):
+            seg.index({"msg": msg, "n": i})
+        return seg
+
+    def test_index_maintained_and_queryable(self):
+        seg = self._mutable()
+        idx = seg.column("msg").text_index
+        assert idx is not None
+        assert idx.match("connection").tolist() == [True, False, True, False]
+        assert idx.match('"connection reset"').tolist() == [True, False, False, False]
+        assert idx.match("auth AND bob").tolist() == [False, True, False, False]
+        assert idx.match("time*").tolist() == [False, False, True, False]
+
+    def test_text_match_query_on_mutable_segment(self):
+        from pinot_tpu.query.executor import execute_query
+        seg = self._mutable()
+        res = execute_query([seg], "SELECT COUNT(*) FROM logs "
+                                   "WHERE TEXT_MATCH(msg, 'connection')")
+        assert res.rows[0][0] == 2
+        res = execute_query([seg], "SELECT SUM(n) FROM logs "
+                                   "WHERE TEXT_MATCH(msg, 'NOT connection')")
+        assert res.rows[0][0] == 1 + 3
+
+    def test_snapshot_isolation(self):
+        seg = self._mutable()
+        view = seg.column("msg").text_index
+        seg.index({"msg": "connection again", "n": 99})
+        # the earlier view must not see the new doc; a fresh view must
+        assert len(view.match("connection")) == 4
+        assert seg.column("msg").text_index.match("connection").tolist() == [
+            True, False, True, False, True]
+
+    def test_unindexed_column_falls_back(self):
+        from pinot_tpu.query.executor import execute_query
+        from pinot_tpu.schema import DataType, Schema, dimension, metric
+        from pinot_tpu.segment.mutable import MutableSegment
+        schema = Schema("logs2", [dimension("msg", DataType.STRING),
+                                  metric("n", DataType.INT)])
+        seg = MutableSegment("x", schema)  # no text index configured
+        seg.index({"msg": "hello world", "n": 1})
+        seg.index({"msg": "bye", "n": 2})
+        assert seg.column("msg").text_index is None
+        res = execute_query([seg], "SELECT COUNT(*) FROM logs2 "
+                                   "WHERE TEXT_MATCH(msg, 'hello')")
+        assert res.rows[0][0] == 1
+
+    def test_consuming_segment_through_cluster(self, tmp_path):
+        import json as _json
+        from pinot_tpu.cluster import QuickCluster
+        from pinot_tpu.ingest.stream import MemoryStream
+        from pinot_tpu.schema import DataType, Schema, dimension, metric
+        from pinot_tpu.table import IndexingConfig, StreamConfig, TableConfig, TableType
+        MemoryStream.reset_all()
+        try:
+            cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+            schema = Schema("rt_logs", [dimension("msg", DataType.STRING),
+                                        metric("n", DataType.INT)])
+            cfg = TableConfig(
+                "rt_logs", table_type=TableType.REALTIME, replication=1,
+                indexing=IndexingConfig(text_index_columns=["msg"]),
+                stream=StreamConfig(stream_type="memory", topic="rtl_topic",
+                                    decoder="json", flush_threshold_rows=1000))
+            cluster.create_realtime_table(schema, cfg, 1)
+            stream = MemoryStream.get("rtl_topic")
+            for i, m in enumerate(["connection reset", "auth ok", "connection slow"]):
+                stream.produce(_json.dumps({"msg": m, "n": i}), partition=0)
+            cluster.pump_realtime(cfg.table_name_with_type)
+            res = cluster.query("SELECT COUNT(*) FROM rt_logs "
+                                "WHERE TEXT_MATCH(msg, 'connection')")
+            assert res.rows[0][0] == 2
+        finally:
+            MemoryStream.reset_all()

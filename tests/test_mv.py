@@ -221,3 +221,34 @@ def test_mutable_mv_commit_roundtrip(tmp_path):
     assert list(seg.column("tags").values()[0]) == ["p", "q"]
     res = execute_query([seg], "SELECT COUNT(*) FROM docs WHERE tags = 'q'")
     assert res.rows[0][0] == 2
+
+
+# -- MV percentile / HLL variants (reference: PercentileMV / DistinctCountHLLMV) --
+
+def test_percentile_mv(seg):
+    # scores flattened: [1,2,2,3,5,7,8] -> median 3
+    res = execute_query([seg], "SELECT PERCENTILEMV(scores, 50) FROM docs")
+    flat = np.array([1, 2, 2, 3, 5, 7, 8], dtype=float)
+    assert res.rows[0][0] == pytest.approx(float(np.percentile(flat, 50)))
+    res2 = execute_query([seg], "SELECT PERCENTILE50MV(scores) FROM docs")
+    assert res2.rows[0][0] == res.rows[0][0]
+
+
+def test_percentile_est_and_tdigest_mv(seg):
+    flat = np.array([1, 2, 2, 3, 5, 7, 8], dtype=float)
+    for fn in ("PERCENTILEESTMV", "PERCENTILETDIGESTMV"):
+        res = execute_query([seg], f"SELECT {fn}(scores, 90) FROM docs")
+        assert res.rows[0][0] == pytest.approx(float(np.percentile(flat, 90)),
+                                               rel=0.15)
+
+
+def test_distinctcount_hll_mv(seg):
+    res = execute_query([seg], "SELECT DISTINCTCOUNTHLLMV(tags) FROM docs")
+    # distinct flattened tags: x y z w + the default 'null' fill = 5
+    assert abs(res.rows[0][0] - 5) <= 1
+
+
+def test_percentile_mv_group_by(seg):
+    res = execute_query([seg], "SELECT doc, PERCENTILEMV(scores, 100) FROM docs "
+                               "GROUP BY doc ORDER BY doc LIMIT 10")
+    assert [r[1] for r in res.rows] == [2.0, 3.0, 5.0, 8.0]
