@@ -24,6 +24,11 @@ from .assignment import balanced_assign, compute_counts, rebalance_table, replic
 from .catalog import (Catalog, InstanceInfo, ONLINE, SegmentMeta,
                       STATUS_IN_PROGRESS, STATUS_UPLOADED)
 from .deepstore import DeepStoreFS, tar_segment
+
+# deleted segments park in the deep store this long before the retention
+# reaper removes them (reference: SegmentDeletionManager's Deleted_Segments
+# retention, controller.deleted.segments.retentionInDays default 7)
+DELETED_SEGMENTS_RETENTION_DAYS = 7.0
 from .routing import partition_for_value
 
 
@@ -192,7 +197,7 @@ class Controller:
             # revert: drop the half-uploaded outputs, queries never saw them
             for name in new_names:
                 if name in self.catalog.segments.get(table, {}):
-                    self.delete_segment(table, name)
+                    self.delete_segment(table, name, permanent=True)
             self.catalog.mutate_property(
                 key, lambda es: [e for e in (es or []) if e["id"] != entry_id] or None)
             raise
@@ -227,15 +232,33 @@ class Controller:
             self.reload_table(config.table_name_with_type)
 
     # -- deletion / retention ---------------------------------------------------
-    def delete_segment(self, table: str, segment: str) -> None:
-        """Reference: SegmentDeletionManager — remove from ideal state, metadata, and
-        deep store (deleted segments park under Deleted_Segments in the reference;
-        simplified to direct delete + catalog property note)."""
+    def delete_segment(self, table: str, segment: str, *,
+                       permanent: bool = False,
+                       now_ms: Optional[int] = None) -> None:
+        """Reference: SegmentDeletionManager — remove from ideal state and
+        metadata, and PARK the deep-store copy under Deleted_Segments/ instead
+        of deleting it: an accidental drop is recoverable until the retention
+        reaper (run_retention) removes parked copies past
+        DELETED_SEGMENTS_RETENTION_DAYS.
+
+        `permanent=True` bypasses parking — for internal cleanup of segments
+        queries never saw (replace-rollback, minion retry sweeps), where a
+        parked copy would just be 7 days of deep-store garbage. `now_ms` is
+        the deletion timestamp for the parking note; callers driving a
+        simulated clock pass theirs so parking and reaping share one clock."""
         meta = self.catalog.segments.get(table, {}).get(segment)
         self.catalog.update_ideal_state(table, {segment: None})
         self.catalog.drop_segment_meta(table, segment)
-        if meta and meta.download_path:
-            self.deepstore.delete(meta.download_path)
+        if meta and meta.download_path and self.deepstore.exists(meta.download_path):
+            if permanent:
+                self.deepstore.delete(meta.download_path)
+                return
+            parked = f"Deleted_Segments/{table}/{segment}.tar.gz"
+            self.deepstore.move(meta.download_path, parked)
+            self.catalog.put_property(
+                f"deleted/{table}/{segment}",
+                {"uri": parked,
+                 "deletedAtMs": now_ms or int(time.time() * 1000)})
 
     def run_retention(self, now_ms: Optional[int] = None) -> List[str]:
         """Reference: RetentionManager periodic task — delete segments past retention."""
@@ -247,8 +270,17 @@ class Controller:
             cutoff = now_ms - cfg.retention_days * 24 * 3600 * 1000
             for seg, meta in list(self.catalog.segments.get(table, {}).items()):
                 if meta.end_time_ms is not None and meta.end_time_ms < cutoff:
-                    self.delete_segment(table, seg)
+                    self.delete_segment(table, seg, now_ms=now_ms)
                     deleted.append(f"{table}/{seg}")
+        # reap parked deep-store copies past the deleted-segment retention
+        park_cutoff = now_ms - DELETED_SEGMENTS_RETENTION_DAYS * 86_400_000
+        for key, note in list(self.catalog.properties.items()):
+            if not key.startswith("deleted/") or not isinstance(note, dict):
+                continue
+            if note.get("deletedAtMs", 0) < park_cutoff:
+                self.deepstore.delete(note["uri"])
+                self.catalog.put_property(key, None)
+                deleted.append(f"reaped:{note['uri']}")
         return deleted
 
     def pause_consumption(self, table: str) -> Dict[str, object]:

@@ -44,6 +44,12 @@ class DeepStoreFS:
                 f.write(data)
             self.upload(local, uri)
 
+    def move(self, src_uri: str, dst_uri: str) -> None:
+        """Default move = copy + delete; concrete stores may override with a
+        native rename (LocalDeepStore does)."""
+        self.put_bytes(self.get_bytes(src_uri), dst_uri)
+        self.delete(src_uri)
+
     def get_bytes(self, uri: str) -> bytes:
         import tempfile
         with tempfile.TemporaryDirectory() as tmp:
@@ -91,6 +97,11 @@ class LocalDeepStore(DeepStoreFS):
     def listdir(self, uri: str) -> List[str]:
         p = self._path(uri)
         return sorted(os.listdir(p)) if os.path.isdir(p) else []
+
+    def move(self, src_uri: str, dst_uri: str) -> None:
+        src, dst = self._path(src_uri), self._path(dst_uri)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
 
 
 _FS_REGISTRY: Dict[str, Type[DeepStoreFS]] = {"local": LocalDeepStore}

@@ -338,3 +338,85 @@ def _pack(xp, *cols):
     aggregations (COVAR/CORR/FIRSTWITHTIME) flow through the single-argument
     executor surface. Host-only by construction (not in planner._DEVICE_FUNCS)."""
     return np.stack([np.asarray(c, dtype=np.float64) for c in cols], axis=1)
+
+
+@register_function("cot")
+def _cot(xp, v):
+    return 1.0 / xp.tan(v)
+
+
+# -- MV reductions (reference: ArraySum/ArrayMin/ArrayMax/ArrayAverage/
+# ArrayDistinct/ArraySort transform functions) --------------------------------
+
+def _mv_reduce(v, fn, empty):
+    arr = np.asarray(v, dtype=object)
+    return np.asarray([fn(np.atleast_1d(np.asarray(row)).astype(np.float64))
+                       if row is not None and len(np.atleast_1d(row)) else empty
+                       for row in arr], dtype=np.float64)
+
+
+@register_function("arraysum")
+def _arraysum(xp, v):
+    return _mv_reduce(v, np.sum, 0.0)
+
+
+@register_function("arraymin")
+def _arraymin(xp, v):
+    return _mv_reduce(v, np.min, float("nan"))
+
+
+@register_function("arraymax")
+def _arraymax(xp, v):
+    return _mv_reduce(v, np.max, float("nan"))
+
+
+@register_function("arrayaverage")
+def _arrayaverage(xp, v):
+    return _mv_reduce(v, np.mean, float("nan"))
+
+
+@register_function("arraydistinct")
+def _arraydistinct(xp, v):
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        vals = np.atleast_1d(np.asarray(row))
+        seen, keep = set(), []
+        for x in vals.tolist():
+            if x not in seen:
+                seen.add(x)
+                keep.append(x)
+        out[i] = np.asarray(keep)
+    return out
+
+
+@register_function("arraysortasc")
+def _arraysortasc(xp, v):
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        out[i] = np.sort(np.atleast_1d(np.asarray(row)))
+    return out
+
+
+@register_function("arraysortdesc")
+def _arraysortdesc(xp, v):
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        out[i] = np.sort(np.atleast_1d(np.asarray(row)))[::-1]
+    return out
+
+
+@register_function("arrayindexof")
+def _arrayindexof(xp, v, target):
+    """0-based index of `target` in each row's values; -1 when absent
+    (reference: arrayIndexOf)."""
+    out = np.empty(len(v), dtype=np.int64)
+    for i, row in enumerate(v):
+        vals = np.atleast_1d(np.asarray(row)).tolist()
+        out[i] = vals.index(target) if target in vals else -1
+    return out
+
+
+@register_function("arraycontains")
+def _arraycontains(xp, v, target):
+    return np.asarray([target in np.atleast_1d(np.asarray(row)).tolist()
+                       for row in v], dtype=bool)

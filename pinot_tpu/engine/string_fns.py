@@ -277,3 +277,34 @@ def _regexp_replace(xp, v, pattern, sub):
     rx = re.compile(str(pattern))
     r = str(sub)
     return _vec(lambda s: rx.sub(r, str(s)))(v)
+
+
+# -- codecs (reference: ScalarFunctions toBase64/fromBase64, encodeUrl/
+# decodeUrl, toUtf8/fromUtf8, hex digests already above) ----------------------
+
+def _str_map(v, fn):
+    return _vec(lambda x: None if x is None else fn(str(x)))(v)
+
+
+@register_function("tobase64")
+def _tobase64(xp, v):
+    import base64
+    return _str_map(v, lambda s: base64.b64encode(s.encode("utf-8")).decode("ascii"))
+
+
+@register_function("frombase64")
+def _frombase64(xp, v):
+    import base64
+    return _str_map(v, lambda s: base64.b64decode(s.encode("ascii")).decode("utf-8"))
+
+
+@register_function("encodeurl")
+def _encodeurl(xp, v):
+    import urllib.parse
+    return _str_map(v, lambda s: urllib.parse.quote(s, safe=""))
+
+
+@register_function("decodeurl")
+def _decodeurl(xp, v):
+    import urllib.parse
+    return _str_map(v, urllib.parse.unquote)

@@ -418,7 +418,7 @@ class RealtimeToOfflineTaskExecutor(BaseMergeExecutor):
         leftovers = [n for n in worker.catalog.segments.get(offline_table, {})
                      if n.startswith(prefix + "_")]
         for n in leftovers:
-            worker.controller.delete_segment(offline_table, n)
+            worker.controller.delete_segment(offline_table, n, permanent=True)
         out_dir = os.path.join(worker.work_dir, spec.task_id, "out")
         built = process_segments(segs, schema, self._processor_config(spec, rt_cfg, prefix),
                                  out_dir)

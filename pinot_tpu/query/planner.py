@@ -34,7 +34,7 @@ _DEVICE_FUNCS = {"plus", "minus", "times", "divide", "mod", "case", "cast", "abs
                  "floor", "exp", "ln", "log10", "log2", "log", "sqrt", "power", "round",
                  "least", "greatest", "sign", "truncate", "eq", "neq", "gt", "gte", "lt",
                  "lte", "and", "or", "not", "in", "not_in", "between", "sin", "cos", "tan",
-                 "asin", "acos", "atan", "sinh", "cosh", "tanh", "atan2", "degrees",
+                 "asin", "acos", "atan", "sinh", "cosh", "tanh", "cot", "atan2", "degrees",
                  "radians"} | set(DEVICE_DATETIME_FUNCS)
 
 

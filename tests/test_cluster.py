@@ -178,3 +178,31 @@ def test_catalog_snapshot_restore(lineorder_cluster):
     assert set(fresh.segments[table]) == set(cluster.catalog.segments[table])
     assert fresh.ideal_state[table] == cluster.catalog.ideal_state[table]
     assert fresh.table_configs[table].replication == 2
+
+
+def test_deleted_segment_parks_then_reaped(lineorder_cluster):
+    """Reference: SegmentDeletionManager — deleted segments park under
+    Deleted_Segments/ in the deep store and are reaped after retention."""
+    import time as _t
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    seg = next(iter(cluster.catalog.ideal_state[table]))
+    uri = cluster.catalog.segments[table][seg].download_path
+    assert cluster.deepstore.exists(uri)
+
+    cluster.controller.delete_segment(table, seg)
+    parked = f"Deleted_Segments/{table}/{seg}.tar.gz"
+    assert not cluster.deepstore.exists(uri)
+    assert cluster.deepstore.exists(parked)
+    note = cluster.catalog.get_property(f"deleted/{table}/{seg}")
+    assert note and note["uri"] == parked
+
+    # within retention: reaper leaves it
+    cluster.controller.run_retention()
+    assert cluster.deepstore.exists(parked)
+    # past retention: reaped
+    future = int(_t.time() * 1000) + 8 * 86_400_000
+    out = cluster.controller.run_retention(now_ms=future)
+    assert any(x == f"reaped:{parked}" for x in out), out
+    assert not cluster.deepstore.exists(parked)
+    assert cluster.catalog.get_property(f"deleted/{table}/{seg}") is None

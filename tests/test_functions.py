@@ -232,3 +232,54 @@ def test_select_todatetime(time_env):
                         "SELECT TODATETIME(ts, 'yyyy-MM-dd') FROM events LIMIT 5")
     for row in res.rows:
         assert len(row[0]) == 10 and row[0][4] == "-"
+
+
+# -- new breadth: MV reductions, codecs, cot ----------------------------------
+
+def _mv_env():
+    return {"a": np.array([np.array([1.0, 2.0, 3.0]), np.array([5.0]),
+                           np.array([])], dtype=object),
+            "s": np.array(["café com leite", "a&b=c", None], dtype=object)}
+
+
+def test_array_reductions():
+    env = _mv_env()
+    assert ev("arraysum(a)", env).tolist() == [6.0, 5.0, 0.0]
+    assert ev("arraymax(a)", env)[:2].tolist() == [3.0, 5.0]
+    assert ev("arraymin(a)", env)[:2].tolist() == [1.0, 5.0]
+    assert ev("arrayaverage(a)", env)[0] == pytest.approx(2.0)
+    assert np.isnan(ev("arrayaverage(a)", env)[2])
+
+
+def test_array_distinct_sort_index():
+    env = {"a": np.array([np.array([3, 1, 3, 2]), np.array([7])], dtype=object)}
+    d = ev("arraydistinct(a)", env)
+    assert d[0].tolist() == [3, 1, 2]
+    assert ev("arraysortasc(a)", env)[0].tolist() == [1, 2, 3, 3]
+    assert ev("arraysortdesc(a)", env)[0].tolist() == [3, 3, 2, 1]
+    assert ev("arrayindexof(a, 2)", env).tolist() == [3, -1]
+    assert ev("arraycontains(a, 7)", env).tolist() == [False, True]
+
+
+def test_base64_and_url_codecs():
+    import base64
+    import urllib.parse
+    env = _mv_env()
+    enc = ev("tobase64(s)", env)
+    assert enc[0] == base64.b64encode("café com leite".encode()).decode()
+    assert enc[2] is None
+    back = ev("frombase64(tobase64(s))", env)
+    assert back[0] == "café com leite"
+    u = ev("encodeurl(s)", env)
+    assert u[1] == urllib.parse.quote("a&b=c", safe="")
+    assert ev("decodeurl(encodeurl(s))", env)[1] == "a&b=c"
+
+
+def test_cot():
+    assert ev("cot(x)", {"x": np.array([1.0])})[0] == pytest.approx(1 / np.tan(1.0))
+
+
+def test_codecs_on_scalar_literals():
+    assert ev("tobase64('hello')", {}) == "aGVsbG8="
+    assert ev("frombase64('aGVsbG8=')", {}) == "hello"
+    assert ev("encodeurl('a b')", {}) == "a%20b"
