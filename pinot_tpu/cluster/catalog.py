@@ -213,6 +213,14 @@ class Catalog:
                 self.instances[instance_id].alive = alive
         self._notify("instance", instance_id)
 
+    def update_instance_tags(self, instance_id: str, tags: List[str]) -> None:
+        with self._lock:
+            info = self.instances.get(instance_id)
+            if info is None:
+                raise ValueError(f"unknown instance {instance_id!r}")
+            info.tags = list(tags)
+        self._notify("instance", instance_id)
+
     def live_servers(self, tenant: Optional[str] = None) -> List[str]:
         with self._lock:
             return [i.instance_id for i in self.instances.values()

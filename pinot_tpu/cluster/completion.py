@@ -188,8 +188,27 @@ class LLCSegmentManager:
                            creation_time_ms=int(time.time() * 1000))
         self.catalog.put_segment_meta(meta)
         servers = self.catalog.live_servers(cfg.tenant)
-        counts = compute_counts(self.catalog.ideal_state.get(table, {}))
-        chosen = balanced_assign(name, servers, cfg.replication, counts)
+        # partition-consistent placement (reference: RealtimeSegmentAssignment —
+        # all segments of a partition share one replica set): reuse the
+        # predecessor's servers while they are live, so replica-group routing
+        # can serve a whole partition from one server (required for upsert
+        # valid-doc consistency). Fall back to balanced placement when there is
+        # no live predecessor set (first segment, server loss).
+        chosen: List[str] = []
+        if seq > 0:
+            prev_name = next(
+                (m.name for m in self.catalog.segments.get(table, {}).values()
+                 if m.partition_group == partition
+                 and m.sequence_number == seq - 1), None)
+            prev = self.catalog.ideal_state.get(table, {}).get(prev_name) \
+                if prev_name else None
+            if prev:
+                inherited = [s for s in sorted(prev) if s in servers]
+                if len(inherited) == cfg.replication:
+                    chosen = inherited
+        if not chosen:
+            counts = compute_counts(self.catalog.ideal_state.get(table, {}))
+            chosen = balanced_assign(name, servers, cfg.replication, counts)
         self.catalog.update_ideal_state(table, {name: {s: CONSUMING for s in chosen}})
         self.fsms[name] = CompletionFSM(name, num_replicas=len(chosen))
         return name

@@ -24,12 +24,12 @@ from .assignment import balanced_assign, compute_counts, rebalance_table, replic
 from .catalog import (Catalog, InstanceInfo, ONLINE, SegmentMeta,
                       STATUS_IN_PROGRESS, STATUS_UPLOADED)
 from .deepstore import DeepStoreFS, tar_segment
+from .routing import partition_for_value
 
 # deleted segments park in the deep store this long before the retention
 # reaper removes them (reference: SegmentDeletionManager's Deleted_Segments
 # retention, controller.deleted.segments.retentionInDays default 7)
 DELETED_SEGMENTS_RETENTION_DAYS = 7.0
-from .routing import partition_for_value
 
 
 class Controller:
@@ -73,7 +73,18 @@ class Controller:
     def add_table(self, config: TableConfig) -> None:
         if config.name not in self.catalog.schemas:
             raise ValueError(f"schema {config.name!r} must be added before the table")
+        self._validate_table_config(config)
         self.catalog.put_table_config(config)
+
+    @staticmethod
+    def _validate_table_config(config: TableConfig) -> None:
+        if config.routing_selector and config.routing_selector.lower().replace(
+                "_", "") not in ("balanced", "replicagroup", "strictreplicagroup"):
+            # a typo here would silently fall back to balanced and disable the
+            # upsert consistency guard — reject at config-write time instead
+            raise ValueError(
+                f"unknown routingSelector {config.routing_selector!r} "
+                "(balanced | replicaGroup | strictReplicaGroup)")
 
     def add_realtime_table(self, config: TableConfig, num_partitions: int) -> List[str]:
         """Create a realtime table and its initial CONSUMING segments (reference:
@@ -227,6 +238,7 @@ class Controller:
     def update_table(self, config: TableConfig, reload: bool = True) -> None:
         """Replace a table's config; by default trigger a reload so index changes
         take effect on servers."""
+        self._validate_table_config(config)
         self.catalog.put_table_config(config)
         if reload:
             self.reload_table(config.table_name_with_type)
@@ -282,6 +294,25 @@ class Controller:
                 self.catalog.put_property(key, None)
                 deleted.append(f"reaped:{note['uri']}")
         return deleted
+
+    # -- tenants (reference: PinotTenantRestletResource + tag-based instance
+    # assignment: a tenant IS a tag on server instances) --------------------
+    def update_instance_tags(self, instance_id: str, tags: List[str]) -> None:
+        """Re-tag an instance (reference: updateInstanceTags). Tables assigned
+        to a tenant tag pick up the change on the next assignment/rebalance/
+        relocation — existing ideal state is not rewritten here."""
+        self.catalog.update_instance_tags(instance_id, tags)
+
+    def list_tenants(self) -> Dict[str, List[str]]:
+        """tenant tag -> live server instances carrying it."""
+        out: Dict[str, List[str]] = {}
+        with self.catalog._lock:
+            for info in self.catalog.instances.values():
+                if info.role != "server" or not info.alive:
+                    continue
+                for tag in info.tags:
+                    out.setdefault(tag, []).append(info.instance_id)
+        return {t: sorted(v) for t, v in sorted(out.items())}
 
     def pause_consumption(self, table: str) -> Dict[str, object]:
         """Reference: PinotRealtimeTableResource.pauseConsumption."""

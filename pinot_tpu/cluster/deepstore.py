@@ -45,9 +45,14 @@ class DeepStoreFS:
             self.upload(local, uri)
 
     def move(self, src_uri: str, dst_uri: str) -> None:
-        """Default move = copy + delete; concrete stores may override with a
-        native rename (LocalDeepStore does)."""
-        self.put_bytes(self.get_bytes(src_uri), dst_uri)
+        """Default move = download-to-temp + upload + delete (streams through
+        disk, never buffers the object in memory — segment tars can be GBs);
+        concrete stores may override with a native rename (LocalDeepStore does)."""
+        import tempfile
+        with tempfile.TemporaryDirectory() as tmp:
+            local = os.path.join(tmp, "moving")
+            self.download(src_uri, local)
+            self.upload(local, dst_uri)
         self.delete(src_uri)
 
     def get_bytes(self, uri: str) -> bytes:

@@ -37,18 +37,51 @@ class RoutingTable:
         self._rr = itertools.count()
 
     def route(self, segments: Optional[Set[str]] = None,
-              exclude: Optional[Set[str]] = None) -> Dict[str, List[str]]:
-        """Pick one healthy replica per segment, round-robin for load balance
-        (reference: BalancedInstanceSelector)."""
+              exclude: Optional[Set[str]] = None,
+              selector: str = "balanced") -> Dict[str, List[str]]:
+        """Resolve one healthy replica per segment.
+
+        Selectors (reference: instanceselector/ package):
+        - "balanced": per-segment round-robin (BalancedInstanceSelector) —
+          best load spread, segments of one query fan across replicas.
+        - "replicaGroup"/"strictReplicaGroup": ONE replica ordinal per query
+          (ReplicaGroupInstanceSelector / StrictReplicaGroupInstanceSelector):
+          every segment is served by the same replica position, so with
+          replica-group-aligned assignment a query touches one group — and,
+          critically for upsert tables, all segments of a partition are read
+          from the SAME server, whose valid-doc bitmaps are mutually
+          consistent (mixing replicas can double-count a primary key mid
+          upsert propagation)."""
+        sel = selector.lower().replace("_", "")
+        if sel not in ("balanced", "replicagroup", "strictreplicagroup"):
+            raise ValueError(f"unknown routing selector {selector!r}")
         out: Dict[str, List[str]] = {}
         offset = next(self._rr)
+        group_mode = sel in ("replicagroup", "strictreplicagroup")
+        if group_mode:
+            # one per-query PREFERENCE ORDER over all servers: every segment
+            # picks its highest-preference candidate, so segments with equal
+            # candidate sets always co-locate (partition-consistent realtime
+            # assignment makes upsert partitions share candidate sets), and
+            # overlapping sets co-locate whenever their preferred server is
+            # shared — a per-segment modulo over differing candidate-list
+            # lengths would scatter replicas instead
+            all_servers = sorted({s for servers in self.segment_servers.values()
+                                  for s in servers})
+            if all_servers:
+                rot = offset % len(all_servers)
+                preference = {s: i for i, s in enumerate(
+                    all_servers[rot:] + all_servers[:rot])}
         for i, (seg, servers) in enumerate(sorted(self.segment_servers.items())):
             if segments is not None and seg not in segments:
                 continue
             candidates = [s for s in servers if not exclude or s not in exclude]
             if not candidates:
                 continue
-            chosen = candidates[(offset + i) % len(candidates)]
+            if group_mode:
+                chosen = min(candidates, key=preference.__getitem__)
+            else:
+                chosen = candidates[(offset + i) % len(candidates)]
             out.setdefault(chosen, []).append(seg)
         return out
 
@@ -116,20 +149,25 @@ class RoutingManager:
             unhealthy = set(self._unhealthy)
         if rt is None:
             return {}
+        cfg = self.catalog.table_configs.get(table)
         keep = set(rt.segment_servers)
         hidden = self._lineage_hidden(table)
         if hidden:
             keep -= hidden
         if ctx is not None:
             keep = self._prune(table, keep, ctx)
-        if extra_filter is not None:
-            cfg = self.catalog.table_configs.get(table)
+        if extra_filter is not None and cfg is not None:
             metas = self.catalog.segments.get(table, {})
-            if cfg is not None:
-                keep = {seg for seg in keep
-                        if seg not in metas
-                        or _segment_may_match(extra_filter, cfg, metas[seg])}
-        return rt.route(keep, exclude=unhealthy)
+            keep = {seg for seg in keep
+                    if seg not in metas
+                    or _segment_may_match(extra_filter, cfg, metas[seg])}
+        selector = "balanced"
+        if cfg is not None:
+            selector = cfg.routing_selector or (
+                # upsert correctness requires consistent-replica reads
+                # (reference: upsert tables mandate strictReplicaGroup routing)
+                "strictReplicaGroup" if cfg.upsert else "balanced")
+        return rt.route(keep, exclude=unhealthy, selector=selector)
 
     def _lineage_hidden(self, table: str) -> Set[str]:
         """Segments hidden by replace-segment lineage (reference: SegmentLineage,

@@ -82,6 +82,8 @@ class ControllerService:
         s.route("GET", "schemas", self._get_schema)
         s.route("GET", "segmentsMeta", self._segments_meta)
         s.route("POST", "reload", self._reload_table, action="WRITE")
+        s.route("GET", "tenants", self._list_tenants)
+        s.route("POST", "instanceTags", self._update_instance_tags, action="ADMIN")
         s.route("POST", "pauseConsumption", self._pause_consumption, action="ADMIN")
         s.route("POST", "resumeConsumption", self._resume_consumption, action="ADMIN")
         s.route("POST", "rebalance", self._rebalance, action="ADMIN")
@@ -270,6 +272,21 @@ class ControllerService:
             return error_response(f"unknown table {parts[0]}", 404)
         self.controller.reload_table(parts[0])
         return json_response({"status": "OK", "table": parts[0]})
+
+    def _list_tenants(self, parts, params, body):
+        """GET /tenants (reference: PinotTenantRestletResource.getAllTenants)."""
+        return json_response({"tenants": self.controller.list_tenants()})
+
+    def _update_instance_tags(self, parts, params, body):
+        """POST /instanceTags/{instanceId} with {"tags": [...]} (reference:
+        PinotInstanceRestletResource.updateInstanceTags)."""
+        d = json.loads(body.decode())
+        try:
+            self.controller.update_instance_tags(parts[0], list(d["tags"]))
+        except ValueError as e:
+            return error_response(str(e), 404)
+        return json_response({"status": "OK", "instance": parts[0],
+                              "tags": d["tags"]})
 
     def _pause_consumption(self, parts, params, body):
         """POST /pauseConsumption/{tableNameWithType} (reference:

@@ -183,6 +183,10 @@ class TableConfig:
     task_configs: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     # per-table query quota (reference: QuotaConfig)
     quota: Optional[QuotaConfig] = None
+    # instance selector: "" = auto (strictReplicaGroup for upsert tables,
+    # balanced otherwise); explicit "balanced" | "replicaGroup" |
+    # "strictReplicaGroup" (reference: RoutingConfig.instanceSelectorType)
+    routing_selector: str = ""
     # storage tiers, checked oldest-threshold-first by the SegmentRelocator
     # (reference: tierConfigs in TableConfig)
     tiers: List[TierConfig] = field(default_factory=list)
@@ -204,6 +208,8 @@ class TableConfig:
             "isDimTable": self.is_dim_table,
             "taskConfigs": self.task_configs,
         }
+        if self.routing_selector:
+            d["routingSelector"] = self.routing_selector
         if self.partition:
             d["segmentPartitionConfig"] = self.partition.to_json()
         if self.stream:
@@ -235,6 +241,7 @@ class TableConfig:
             task_configs=d.get("taskConfigs", {}),
             quota=QuotaConfig.from_json(d["quota"]) if d.get("quota") else None,
             tiers=[TierConfig.from_json(t) for t in d.get("tierConfigs", [])],
+            routing_selector=d.get("routingSelector", ""),
         )
 
     def to_json_str(self) -> str:

@@ -206,3 +206,75 @@ def test_deleted_segment_parks_then_reaped(lineorder_cluster):
     assert any(x == f"reaped:{parked}" for x in out), out
     assert not cluster.deepstore.exists(parked)
     assert cluster.catalog.get_property(f"deleted/{table}/{seg}") is None
+
+
+def test_replica_group_selector_routes_one_replica_ordinal(tmp_path, ssb_schema):
+    """replicaGroup/strictReplicaGroup: every segment of one query is served
+    from the same replica ordinal (reference: ReplicaGroupInstanceSelector);
+    upsert tables get strict routing automatically for valid-doc consistency."""
+    from pinot_tpu.table import UpsertConfig
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    rng = np.random.default_rng(8)
+    cfg = TableConfig(ssb_schema.name, replication=2,
+                      routing_selector="replicaGroup")
+    cluster.create_table(ssb_schema, cfg)
+    for _ in range(4):
+        cluster.ingest_columns(cfg, make_ssb_columns(rng, 200))
+
+    rm = cluster.broker.routing
+    for _ in range(6):
+        plan = rm.route_query(cfg.table_name_with_type)
+        # all four segments land on exactly one server per query
+        assert len(plan) == 1, plan
+        assert sum(len(v) for v in plan.values()) == 4
+
+    # balanced (default) spreads segments across both servers
+    cfg2 = TableConfig("spread", replication=2)
+    schema2 = Schema("spread", list(ssb_schema.fields))
+    cluster.create_table(schema2, cfg2)
+    for _ in range(4):
+        cluster.ingest_columns(cfg2, make_ssb_columns(rng, 100))
+    seen = set()
+    for _ in range(6):
+        seen |= set(rm.route_query(cfg2.table_name_with_type))
+    assert len(seen) == 2
+
+    # upsert tables default to strict-replica-group behavior
+    cfg3 = TableConfig("ups", replication=2, upsert=UpsertConfig())
+    schema3 = Schema("ups", list(ssb_schema.fields), ["lo_orderkey"])
+    assert cfg3.routing_selector == ""
+    cluster.create_table(schema3, cfg3)
+    cluster.ingest_columns(cfg3, make_ssb_columns(rng, 50))
+    cluster.ingest_columns(cfg3, make_ssb_columns(rng, 50))
+    for _ in range(4):
+        plan = rm.route_query(cfg3.table_name_with_type)
+        assert len(plan) == 1, plan
+
+
+def test_group_selector_equal_candidate_sets_always_colocate():
+    """The strict guarantee: segments with IDENTICAL candidate sets pick the
+    same server on every rotation (per-segment modulo over different list
+    lengths would scatter them — the upsert double-count hole)."""
+    from pinot_tpu.cluster.routing import RoutingTable
+    rt = RoutingTable("t")
+    rt.segment_servers = {"a": ["s0", "s1"], "b": ["s0", "s1"], "c": ["s0", "s1"],
+                          "d": ["s1", "s2"]}
+    seen = set()
+    for _ in range(7):
+        plan = rt.route(selector="strictReplicaGroup")
+        by_seg = {seg: srv for srv, segs in plan.items() for seg in segs}
+        assert by_seg["a"] == by_seg["b"] == by_seg["c"]
+        seen.add(by_seg["a"])
+    assert len(seen) > 1  # rotation still spreads load across queries
+
+    import pytest as _p
+    with _p.raises(ValueError):
+        rt.route(selector="bogus")
+
+
+def test_unknown_routing_selector_rejected_at_create(tmp_path, ssb_schema):
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig(ssb_schema.name, routing_selector="strict")  # typo
+    import pytest as _p
+    with _p.raises(ValueError, match="routingSelector"):
+        cluster.create_table(ssb_schema, cfg)

@@ -306,3 +306,24 @@ def test_pause_with_empty_consuming_segment(tmp_path, events_schema):
     for _ in range(2):
         cluster.pump_realtime(table)
     assert cluster.query("SELECT COUNT(*) FROM events").rows[0][0] == 4
+
+
+def test_successor_consuming_segment_inherits_replica_set(tmp_path, events_schema):
+    """Partition-consistent realtime assignment (reference:
+    RealtimeSegmentAssignment): the successor CONSUMING segment is placed on
+    the same servers as its committed predecessor, so replica-group routing
+    can serve the whole partition from one server."""
+    cluster, cfg = realtime_cluster(tmp_path, events_schema, replication=1,
+                                    flush_rows=10, num_partitions=1)
+    table = cfg.table_name_with_type
+    produce("events_topic", 0, [{"user": f"u{i}", "country": "US", "value": 1,
+                                 "clicks": 1} for i in range(12)])
+    for _ in range(4):
+        cluster.pump_realtime(table)
+    ist = cluster.catalog.ideal_state[table]
+    by_seq = {}
+    for seg, assignment in ist.items():
+        meta = cluster.catalog.segments[table][seg]
+        by_seq[meta.sequence_number] = set(assignment)
+    assert len(by_seq) >= 2  # committed seq 0 + consuming seq 1
+    assert by_seq[0] == by_seq[1]

@@ -157,3 +157,31 @@ def test_relocation_spreads_over_pool_and_skips_consuming(tmp_path):
     counts = {s: placements.count(s) for s in set(placements)}
     assert all(c == 3 for c in counts.values()), counts
     assert ist["events__0__0__x"] == {"server_0": "CONSUMING"}
+
+
+def test_tenant_listing_and_retag(tmp_path):
+    """Tenant = tag on server instances (reference: PinotTenantRestletResource,
+    updateInstanceTags): re-tagging moves a server between pools; assignment
+    follows on the next relocation pass."""
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    tenants = cluster.controller.list_tenants()
+    assert tenants == {"DefaultTenant": ["server_0", "server_1"]}
+
+    cluster.controller.update_instance_tags("server_1", ["cold"])
+    tenants = cluster.controller.list_tenants()
+    assert tenants == {"DefaultTenant": ["server_0"], "cold": ["server_1"]}
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        cluster.controller.update_instance_tags("nope", ["x"])
+
+    # a tiered table now relocates aged segments onto the re-tagged server
+    now_ms = int(time.time() * 1000)
+    cfg = TableConfig("events", replication=1, time_column="ts",
+                      tiers=[TierConfig("cold", 7.0, "cold")])
+    cluster.create_table(_schema(), cfg)
+    cluster.ingest_columns(cfg, _cols(30, now_ms - 30 * 86_400_000))
+    moved = cluster.controller.run_segment_relocation()
+    assert len(moved) == 1
+    ist = cluster.catalog.ideal_state[cfg.table_name_with_type]
+    assert all(set(a) == {"server_1"} for a in ist.values())
