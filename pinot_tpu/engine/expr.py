@@ -332,6 +332,18 @@ def _arrayelementat(xp, v, idx):
     return out
 
 
+@register_function("__packobj")
+def _packobj(xp, *cols):
+    """Internal: stack k argument columns into an [n, k] OBJECT matrix —
+    like __pack but type-preserving, for aggregations whose key column may be
+    strings (filtered theta sketches). Host-only."""
+    arrs = [np.asarray(c, dtype=object) for c in cols]
+    n = max((len(a) for a in arrs if a.ndim), default=0)
+    arrs = [np.full(n, a.item(), dtype=object) if a.ndim == 0 else a
+            for a in arrs]
+    return np.stack(arrs, axis=1)
+
+
 @register_function("__pack")
 def _pack(xp, *cols):
     """Internal: stack k argument columns into an [n, k] matrix so multi-argument

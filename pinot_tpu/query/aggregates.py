@@ -350,9 +350,38 @@ class DistinctCountThetaAgg(AggFunc):
                 self.k = int(s.split("=", 1)[1])
             elif s.isdigit():
                 self.k = int(s)
+        # filtered set-op form (reference signature:
+        # DISTINCTCOUNTTHETASKETCH(col, 'params', 'pred1', ..., 'SET_OP($1,$2)')):
+        # one sketch per predicate over the rows surviving the MAIN filter,
+        # combined by the post-op expression at finalize
+        self.filter_exprs: List[Expr] = []
+        self.post_op: Optional[str] = None
+        if len(call.args) == 3:
+            raise QueryValidationError(
+                f"{self.name}: the filtered form needs at least one predicate "
+                "AND a set-op expression — (col, 'params', 'pred1', ..., "
+                "'SET_OP($1, ...)'); a lone third argument would be silently "
+                "ignored")
+        if len(call.args) >= 4:
+            from ..sql.parser import Parser
+            preds = call.args[2:-1]
+            post = call.args[-1]
+            if not all(isinstance(p, Literal) for p in (*preds, post)):
+                raise QueryValidationError(
+                    f"{self.name}: predicate/set-op arguments must be string literals")
+            for p in preds:
+                stmt = Parser(f"SELECT 1 FROM t WHERE {p.value}").parse()
+                self.filter_exprs.append(stmt.where)
+            self.post_op = str(post.value)
+            # evaluate the key column AND each predicate as one packed argument
+            # (the executor's agg surface is single-expression; same trick as
+            # COVAR's __pack, object-typed so string keys survive)
+            self.arg = Function("__packobj",
+                                (call.args[0], *self.filter_exprs))
 
     def device_ok(self, ctx: AggContext) -> bool:
-        return not ctx.group_by and ctx.arg_is_dict_column
+        return not ctx.group_by and ctx.arg_is_dict_column \
+            and not self.filter_exprs
 
     @staticmethod
     def _canonical(values) -> np.ndarray:
@@ -360,6 +389,15 @@ class DistinctCountThetaAgg(AggFunc):
         device path yields python ints where the host path sees the column dtype).
         Integers stay integral — float64 would collapse distinct int64s above 2^53."""
         arr = np.asarray(list(values) if isinstance(values, set) else values)
+        if arr.dtype == object and arr.size:
+            # the filtered path's __packobj matrix is object-typed; restore the
+            # numeric hash domain or identical ids would hash differently from
+            # the unfiltered/device path (raw-sketch clients intersect across
+            # queries)
+            if all(isinstance(v, (int, np.integer)) for v in arr.reshape(-1)):
+                arr = arr.astype(np.int64)
+            elif all(isinstance(v, (float, np.floating)) for v in arr.reshape(-1)):
+                arr = arr.astype(np.float64)
         if arr.dtype.kind in "iub":
             return arr.astype(np.int64)
         if arr.dtype.kind == "f":
@@ -374,16 +412,102 @@ class DistinctCountThetaAgg(AggFunc):
 
     def host_state(self, values):
         from .sketches import ThetaSketch
+        if self.filter_exprs:
+            arr = np.asarray(values)  # [n, 1+m] object matrix from __packobj
+            keys = arr[:, 0] if arr.ndim == 2 else np.empty(0, dtype=object)
+            out = []
+            for j in range(len(self.filter_exprs)):
+                mask = arr[:, 1 + j].astype(bool) if arr.ndim == 2 \
+                    else np.empty(0, dtype=bool)
+                out.append(ThetaSketch.from_values(
+                    self._canonical(keys[mask]), self.k))
+            return tuple(out)
         return ThetaSketch.from_values(self._canonical(values), self.k)
 
     def merge(self, a, b):
+        if self.filter_exprs:
+            return tuple(x.union(y) for x, y in zip(a, b))
         return self._normalize(a).union(self._normalize(b))
 
+    def _combined(self, state):
+        from .sketches import ThetaSketch
+        if not self.filter_exprs:
+            return self._normalize(state)
+        if state is None:
+            return ThetaSketch(self.k)
+        return _eval_theta_setop(self.post_op, list(state))
+
     def finalize(self, state):
-        return int(round(self._normalize(state).estimate()))
+        return int(round(self._combined(state).estimate()))
 
     def empty_result(self):
         return 0
+
+
+def _eval_theta_setop(expr: str, sketches: List) -> "object":
+    """Parse + evaluate the reference's theta post-aggregation expression:
+    `$N` (1-based sketch refs), SET_UNION(...), SET_INTERSECT(...),
+    SET_DIFF(a, b) (reference: DistinctCountThetaSketchAggregationFunction's
+    postAggregationExpression)."""
+    import re as _re
+    src = expr or "$1"
+    toks = []
+    i = 0
+    while i < len(src):  # position-tracking lexer: unknown chars ERROR, never vanish
+        if src[i].isspace():
+            i += 1
+            continue
+        m = _re.match(r"\$\d+|[A-Za-z_]+|[(),]", src[i:])
+        if m is None:
+            raise QueryValidationError(
+                f"theta set-op: unexpected character {src[i]!r} in {expr!r}")
+        toks.append(m.group(0))
+        i += len(m.group(0))
+    pos = [0]
+
+    def peek():
+        return toks[pos[0]] if pos[0] < len(toks) else None
+
+    def take():
+        t = peek()
+        pos[0] += 1
+        return t
+
+    def parse_node():
+        t = take()
+        if t is None:
+            raise QueryValidationError(f"theta set-op: unexpected end in {expr!r}")
+        if t.startswith("$"):
+            i = int(t[1:]) - 1
+            if not 0 <= i < len(sketches):
+                raise QueryValidationError(
+                    f"theta set-op references ${i + 1} but only "
+                    f"{len(sketches)} filter sketches exist")
+            return sketches[i]
+        op = t.upper()
+        if op not in ("SET_UNION", "SET_INTERSECT", "SET_DIFF"):
+            raise QueryValidationError(f"unknown theta set-op {t!r}")
+        if take() != "(":
+            raise QueryValidationError(f"theta set-op: expected ( after {t}")
+        args = [parse_node()]
+        while peek() == ",":
+            take()
+            args.append(parse_node())
+        if take() != ")":
+            raise QueryValidationError(f"theta set-op: expected ) in {expr!r}")
+        if op == "SET_DIFF":
+            if len(args) != 2:
+                raise QueryValidationError("SET_DIFF takes exactly two arguments")
+            return args[0].a_not_b(args[1])
+        out = args[0]
+        for a in args[1:]:
+            out = out.union(a) if op == "SET_UNION" else out.intersect(a)
+        return out
+
+    node = parse_node()
+    if peek() is not None:
+        raise QueryValidationError(f"theta set-op: trailing tokens in {expr!r}")
+    return node
 
 
 class DistinctCountRawThetaAgg(DistinctCountThetaAgg):
@@ -392,7 +516,7 @@ class DistinctCountRawThetaAgg(DistinctCountThetaAgg):
     name = "distinctcountrawthetasketch"
 
     def finalize(self, state):
-        return self._normalize(state).to_bytes().hex()
+        return self._combined(state).to_bytes().hex()
 
     def empty_result(self):
         from .sketches import ThetaSketch

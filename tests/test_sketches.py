@@ -164,3 +164,120 @@ def test_tdigest_group_by(senv):
     for region, got in res.rows:
         want = np.percentile(cols["lo_revenue"][regions == region], 50)
         assert got == pytest.approx(want, rel=0.05)
+
+
+# -- filtered theta set operations (reference:
+# DistinctCountThetaSketchAggregationFunction postAggregationExpression) ------
+
+def test_theta_filtered_set_ops(tmp_path):
+    from pinot_tpu.query.executor import execute_query
+    from pinot_tpu.schema import DataType, Schema, dimension
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    rng = np.random.default_rng(4)
+    n = 3000
+    users = [f"u{i % 800}" for i in range(n)]
+    device = [("mobile" if i % 3 else "desktop") for i in range(n)]
+    country = [("US" if i % 2 else "DE") for i in range(n)]
+    schema = Schema("events", [dimension("user"), dimension("device"),
+                               dimension("country", DataType.STRING)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"user": users, "device": device, "country": country},
+        str(tmp_path), "ev_0"))
+
+    def exact(pred):
+        return len({u for u, d, c in zip(users, device, country) if pred(d, c)})
+
+    # users seen on mobile AND on desktop (intersection across predicates)
+    q = ("SELECT DISTINCTCOUNTTHETASKETCH(user, 'nominalEntries=8192', "
+         "'device = ''mobile''', 'device = ''desktop''', "
+         "'SET_INTERSECT($1, $2)') FROM events")
+    got = execute_query([seg], q).rows[0][0]
+    mob = {u for u, d in zip(users, device) if d == "mobile"}
+    desk = {u for u, d in zip(users, device) if d == "desktop"}
+    want = len(mob & desk)
+    assert got == pytest.approx(want, rel=0.06), (got, want)
+
+    # union and diff
+    q2 = ("SELECT DISTINCTCOUNTTHETASKETCH(user, 'nominalEntries=8192', "
+          "'country = ''US''', 'country = ''DE''', "
+          "'SET_UNION($1, $2)') FROM events")
+    got2 = execute_query([seg], q2).rows[0][0]
+    assert got2 == pytest.approx(800, rel=0.06)
+    q3 = ("SELECT DISTINCTCOUNTTHETASKETCH(user, 'nominalEntries=8192', "
+          "'device = ''mobile''', 'device = ''desktop''', "
+          "'SET_DIFF($1, $2)') FROM events")
+    got3 = execute_query([seg], q3).rows[0][0]
+    assert got3 == pytest.approx(len(mob - desk), rel=0.25) or \
+        abs(got3 - len(mob - desk)) <= 30
+
+    # main WHERE composes with the per-predicate filters
+    q4 = ("SELECT DISTINCTCOUNTTHETASKETCH(user, 'nominalEntries=8192', "
+          "'device = ''mobile''', 'device = ''desktop''', "
+          "'SET_INTERSECT($1, $2)') FROM events WHERE country = 'US'")
+    got4 = execute_query([seg], q4).rows[0][0]
+    mob_us = {u for u, d, c in zip(users, device, country)
+              if d == "mobile" and c == "US"}
+    desk_us = {u for u, d, c in zip(users, device, country)
+               if d == "desktop" and c == "US"}
+    assert got4 == pytest.approx(len(mob_us & desk_us), rel=0.1)
+
+
+def test_theta_setop_errors(tmp_path):
+    from pinot_tpu.query.context import QueryValidationError
+    from pinot_tpu.query.executor import execute_query
+    from pinot_tpu.schema import Schema, dimension
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    schema = Schema("e2", [dimension("u"), dimension("d")])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"u": ["a"], "d": ["x"]}, str(tmp_path), "e2_0"))
+    with pytest.raises(QueryValidationError):
+        execute_query([seg], "SELECT DISTINCTCOUNTTHETASKETCH(u, 'x=1', "
+                             "'d = ''x''', 'SET_BOGUS($1)') FROM e2")
+    with pytest.raises(QueryValidationError):
+        execute_query([seg], "SELECT DISTINCTCOUNTTHETASKETCH(u, 'x=1', "
+                             "'d = ''x''', 'SET_UNION($1, $9)') FROM e2")
+
+
+def test_theta_three_arg_form_rejected(tmp_path):
+    from pinot_tpu.query.context import QueryValidationError
+    from pinot_tpu.query.executor import execute_query
+    from pinot_tpu.schema import Schema, dimension
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    schema = Schema("e3", [dimension("u"), dimension("d")])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"u": ["a"], "d": ["x"]}, str(tmp_path), "e3_0"))
+    with pytest.raises(QueryValidationError):
+        execute_query([seg], "SELECT DISTINCTCOUNTTHETASKETCH(u, 'x=1', "
+                             "'d = ''x''') FROM e3")
+
+
+def test_theta_setop_rejects_unknown_chars():
+    from pinot_tpu.query.aggregates import _eval_theta_setop
+    from pinot_tpu.query.context import QueryValidationError
+    from pinot_tpu.query.sketches import ThetaSketch
+    s = [ThetaSketch(), ThetaSketch()]
+    with pytest.raises(QueryValidationError):
+        _eval_theta_setop("SET_DIFF($1,$2)*2", s)
+
+
+def test_theta_filtered_numeric_hash_domain_matches_unfiltered(tmp_path):
+    """Raw sketches from filtered and unfiltered queries over the same int
+    column must share a hash domain (clients intersect them)."""
+    import numpy as np
+    from pinot_tpu.query.executor import execute_query
+    from pinot_tpu.query.sketches import ThetaSketch
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    schema = Schema("n1", [metric("k", DataType.LONG), dimension("d")])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"k": np.arange(100, dtype=np.int64), "d": ["x"] * 100},
+        str(tmp_path), "n1_0"))
+    raw_all = execute_query(
+        [seg], "SELECT DISTINCTCOUNTRAWTHETASKETCH(k) FROM n1").rows[0][0]
+    raw_filt = execute_query(
+        [seg], "SELECT DISTINCTCOUNTRAWTHETASKETCH(k, 'nominalEntries=4096', "
+               "'d = ''x''', 'SET_UNION($1)') FROM n1").rows[0][0]
+    a = ThetaSketch.from_bytes(bytes.fromhex(raw_all))
+    b = ThetaSketch.from_bytes(bytes.fromhex(raw_filt))
+    inter = a.intersect(b).estimate()
+    assert inter == pytest.approx(100, rel=0.05), inter
