@@ -213,6 +213,18 @@ class Catalog:
                 self.instances[instance_id].alive = alive
         self._notify("instance", instance_id)
 
+    def remove_instance(self, instance_id: str, only_if=None) -> bool:
+        """Remove an instance; `only_if(info)` (evaluated under the lock)
+        guards check-then-remove races — e.g. a dead-minion sweep must not
+        delete an instance that was just marked alive again."""
+        with self._lock:
+            info = self.instances.get(instance_id)
+            if info is None or (only_if is not None and not only_if(info)):
+                return False
+            del self.instances[instance_id]
+        self._notify("instance", instance_id)
+        return True
+
     def update_instance_tags(self, instance_id: str, tags: List[str]) -> None:
         with self._lock:
             info = self.instances.get(instance_id)

@@ -49,6 +49,7 @@ class Controller:
         # periodic controller tasks (reference: ControllerPeriodicTask registrations:
         # RetentionManager, PinotTaskManager's generation cron)
         self.scheduler = PeriodicTaskScheduler()
+        self._status_tables: set = set()  # tables with exported health gauges
         self.scheduler.register(PeriodicTask("RetentionManager", 300.0,
                                              self.run_retention))
         self.scheduler.register(PeriodicTask("PinotTaskManager", 60.0,
@@ -57,6 +58,12 @@ class Controller:
                                              60.0, self.llc.validate))
         self.scheduler.register(PeriodicTask("SegmentRelocator", 3600.0,
                                              self.run_segment_relocation))
+        self.scheduler.register(PeriodicTask("SegmentStatusChecker", 300.0,
+                                             self.run_segment_status_check))
+        self.scheduler.register(PeriodicTask("MinionInstancesCleanupTask",
+                                             3600.0, self.cleanup_dead_minions))
+        self.scheduler.register(PeriodicTask("TaskMetricsEmitter", 300.0,
+                                             self.emit_task_metrics))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -294,6 +301,56 @@ class Controller:
                 self.catalog.put_property(key, None)
                 deleted.append(f"reaped:{note['uri']}")
         return deleted
+
+    # -- periodic health/cleanup tasks --------------------------------------
+    def run_segment_status_check(self) -> Dict[str, Dict[str, int]]:
+        """Reference: SegmentStatusChecker — per-table segment/replica health
+        gauges the metrics endpoint exposes for alerting. Gauges of dropped
+        tables are removed, not left exporting stale values."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        out: Dict[str, Dict[str, int]] = {}
+        for table in list(self.catalog.ideal_state):
+            st = self.table_status(table)
+            online = sum(1 for n in st["replicas_online"].values() if n > 0)
+            labels = {"table": table}
+            reg.gauge("pinot_controller_segments_total", labels).set(st["segments"])
+            reg.gauge("pinot_controller_segments_online", labels).set(online)
+            reg.gauge("pinot_controller_table_converged", labels).set(
+                1 if st["converged"] else 0)
+            out[table] = {"segments": st["segments"], "online": online}
+        for table in self._status_tables - set(out):
+            for g in ("pinot_controller_segments_total",
+                      "pinot_controller_segments_online",
+                      "pinot_controller_table_converged"):
+                reg.remove_gauge(g, {"table": table})
+        self._status_tables = set(out)
+        return out
+
+    def cleanup_dead_minions(self) -> List[str]:
+        """Reference: MinionInstancesCleanupTask — drop dead minion instances
+        from the catalog so they stop counting toward capacity. Liveness is
+        re-checked under the catalog lock: a minion that came back between the
+        scan and the removal must survive."""
+        dead = [iid for iid, info in list(self.catalog.instances.items())
+                if info.role == "minion" and not info.alive]
+        return [iid for iid in dead if self.catalog.remove_instance(
+            iid, only_if=lambda i: i.role == "minion" and not i.alive)]
+
+    def emit_task_metrics(self) -> Dict[str, int]:
+        """Reference: TaskMetricsEmitter — minion task queue depth by state.
+        Every known state is written each tick (including zeros), so a drained
+        queue doesn't leave a stale nonzero gauge alerting forever."""
+        from ..minion.tasks import COMPLETED, ERROR, GENERATED, RUNNING
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        counts: Dict[str, int] = {}
+        for t in self.task_manager.queue.tasks():
+            counts[t.state] = counts.get(t.state, 0) + 1
+        for state in (GENERATED, RUNNING, COMPLETED, ERROR):
+            reg.gauge("pinot_controller_minion_tasks", {"state": state}).set(
+                counts.get(state, 0))
+        return counts
 
     # -- tenants (reference: PinotTenantRestletResource + tag-based instance
     # assignment: a tenant IS a tag on server instances) --------------------

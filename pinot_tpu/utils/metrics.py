@@ -111,6 +111,14 @@ class MetricsRegistry:
                 self._timers[k] = Timer()
             return self._timers[k]
 
+    def remove_gauge(self, name: str, labels: Optional[Dict[str, str]] = None
+                     ) -> None:
+        """Drop a gauge series (e.g. per-table health gauges after the table
+        is dropped — exporting metrics for nonexistent tables misleads
+        dashboards)."""
+        with self._lock:
+            self._gauges.pop(_key(name, labels), None)
+
     # -- read side ----------------------------------------------------------
     def counter_value(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
         return self.counter(name, labels).value

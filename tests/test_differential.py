@@ -43,12 +43,21 @@ SCHEMA = Schema("diff", [
 
 @pytest.fixture(scope="module")
 def engines(tmp_path_factory):
+    import re as _re
     tmp = tmp_path_factory.mktemp("diff")
-    seg = load_segment(SegmentBuilder(SCHEMA, SegmentGeneratorConfig())
+    # fst on the dims: the trigram regex prefilter runs differentially too
+    seg = load_segment(SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        fst_index_columns=["dim_a", "dim_b"]))
                        .build({k: (v.copy() if isinstance(v, np.ndarray) else
                                    list(v)) for k, v in COLS.items()},
                               str(tmp), "diff_0"))
     db = sqlite3.connect(":memory:")
+    db.execute("PRAGMA case_sensitive_like=ON")
+    # same spelling works in both dialects: our engine's REGEXP_LIKE(col, 'p')
+    # is a plain 2-arg function call sqlite can provide
+    db.create_function(
+        "regexp_like", 2,
+        lambda v, p: int(v is not None and _re.search(p, str(v)) is not None))
     db.execute("CREATE TABLE diff (dim_a TEXT, dim_b TEXT, num_i INTEGER, "
                "num_j INTEGER, val_x REAL, val_y REAL)")
     rows = list(zip(COLS["dim_a"], COLS["dim_b"],
@@ -65,8 +74,25 @@ NUMS = ["num_i", "num_j", "val_x", "val_y"]
 AGGS = ["COUNT(*)", "SUM({c})", "MIN({c})", "MAX({c})", "AVG({c})"]
 
 
+# regex fragments over the a0..a13 / b0..b6 value space: literals long enough
+# for the trigram index, plus shapes it must decline (alternation, anchors,
+# classes) — differential over indexed AND fallback paths
+_REGEXES = ["a1", "^a1$", "a1[0-3]", "a(1|2)", "^b[0-2]", "a1.*", "b[46]",
+            "nope", "^a\\d+$", "a1|b2",
+            # >=3-char required literals that MATCH real values (a10..a13):
+            # the trigram index's non-empty candidate/intersect path runs
+            "a10", "^a11$", "a12.*", "a13"]
+_LIKES = ["a1%", "%1", "a_", "b%", "%a%", "a1"]
+
+
 def _rand_pred(rng) -> str:
-    kind = rng.integers(0, 6)
+    kind = rng.integers(0, 8)
+    if kind == 6:
+        c = DIMS[rng.integers(0, len(DIMS))]
+        return f"REGEXP_LIKE({c}, '{_REGEXES[rng.integers(0, len(_REGEXES))]}')"
+    if kind == 7:
+        c = DIMS[rng.integers(0, len(DIMS))]
+        return f"{c} LIKE '{_LIKES[rng.integers(0, len(_LIKES))]}'"
     if kind == 0:
         c = DIMS[rng.integers(0, len(DIMS))]
         v = f"a{rng.integers(0, 14)}" if c == "dim_a" else f"b{rng.integers(0, 7)}"

@@ -166,3 +166,41 @@ def test_trace_through_broker(lineorder_cluster):
     # untraced query carries no traceInfo
     res2 = cluster.query("SELECT COUNT(*) FROM lineorder")
     assert "traceInfo" not in res2.stats
+
+
+def test_segment_status_checker_and_task_metrics(tmp_path):
+    """Reference: SegmentStatusChecker / TaskMetricsEmitter /
+    MinionInstancesCleanupTask periodic controller tasks."""
+    import numpy as np
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.cluster.catalog import InstanceInfo
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+    from pinot_tpu.utils.metrics import get_registry
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = Schema("m1", [dimension("k"), metric("v", DataType.DOUBLE)])
+    cfg = TableConfig("m1")
+    cluster.create_table(schema, cfg)
+    cluster.ingest_columns(cfg, {"k": ["a", "b"], "v": np.array([1.0, 2.0])})
+
+    st = cluster.controller.run_segment_status_check()
+    assert st["m1_OFFLINE"]["segments"] == 1
+    assert st["m1_OFFLINE"]["online"] == 1
+    reg = get_registry()
+    assert reg.gauge("pinot_controller_segments_total",
+                     {"table": "m1_OFFLINE"}).value == 1
+    assert reg.gauge("pinot_controller_table_converged",
+                     {"table": "m1_OFFLINE"}).value == 1
+
+    # dead minion cleanup
+    cluster.catalog.register_instance(InstanceInfo("minion_9", "minion"))
+    cluster.catalog.set_instance_alive("minion_9", False)
+    assert cluster.controller.cleanup_dead_minions() == ["minion_9"]
+    assert "minion_9" not in cluster.catalog.instances
+    assert cluster.controller.cleanup_dead_minions() == []
+
+    # task metrics over the queue (generate_all may enqueue nothing here;
+    # emit must not fail on an empty queue either way)
+    counts = cluster.controller.emit_task_metrics()
+    assert isinstance(counts, dict)
