@@ -55,7 +55,6 @@ class SegmentBlock:
         self._raw: Dict[str, jnp.ndarray] = {}
         self._dict_vals: Dict[str, jnp.ndarray] = {}
         self._decoded: Dict[str, jnp.ndarray] = {}
-        self._hll: Dict[tuple, tuple] = {}
         self._valid: Optional[jnp.ndarray] = None
         self._null: Dict[str, jnp.ndarray] = {}
 
@@ -154,24 +153,6 @@ class SegmentBlock:
             padded[:self.num_docs] = vals[fwd]
             self._decoded[col] = jnp.asarray(padded)
         return self._decoded[col]
-
-    def hll_arrays(self, col: str, p: int):
-        """Per-doc (bucket, rank) HLL update vectors, decoded host-side once.
-
-        Padding rows get bucket = 2**p (overflow slot dropped after segment_max) and
-        rank 0. Replaces the previous per-query `bucket_lut[ids]` device gathers."""
-        key = (col, p)
-        if key not in self._hll:
-            from ..query.executor import _hll_luts
-            reader = self.segment.column(col)
-            bucket_lut, rank_lut = _hll_luts(reader, p)
-            fwd = np.asarray(reader.fwd).astype(np.int64)
-            bucket = np.full(self.padded, 1 << p, dtype=np.int32)
-            rank = np.zeros(self.padded, dtype=np.int32)
-            bucket[:self.num_docs] = bucket_lut[fwd]
-            rank[:self.num_docs] = rank_lut[fwd]
-            self._hll[key] = (jnp.asarray(bucket), jnp.asarray(rank))
-        return self._hll[key]
 
 
 _BLOCK_ATTR = "_device_block"

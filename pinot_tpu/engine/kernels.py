@@ -64,7 +64,6 @@ class KernelSpec:
     aggs: Tuple[Tuple[AggFunc, Tuple[str, ...]], ...]  # (func, device outputs)
     distinct_lut_sizes: Dict[int, int] = field(default_factory=dict)  # agg idx -> lut size
     padded_rows: int = 0
-    hll_params: Dict[int, int] = field(default_factory=dict)  # agg idx -> precision p
     # LUT-leaf columns that are multi-value: their ids arrive as [rows, W] matrices
     # and leaf masks reduce any(-1). Static (not shape-inferred): the mesh path's
     # stacked [segments, rows] arrays are also 2-D but are NOT multi-value.
@@ -107,7 +106,6 @@ class KernelSpec:
             tuple((a.name, repr(a.arg), outs) for a, outs in self.aggs),
             tuple(sorted(self.distinct_lut_sizes.items())),
             self.padded_rows,
-            tuple(sorted(self.hll_params.items())),
             self.mv_cols,
         )
 
@@ -226,7 +224,7 @@ def combine_collective(name: str, v, axis: str):
     (aligned dictionaries), so one ICI collective merges them."""
     if name.endswith(".min"):
         return jax.lax.pmin(v, axis)
-    if name.endswith(".max") or name.endswith(".hll"):
+    if name.endswith(".max"):
         return jax.lax.pmax(v, axis)
     return jax.lax.psum(v, axis)
 
@@ -321,16 +319,6 @@ def _make_body(spec: KernelSpec):
                     else:
                         out[f"{ai}.distinct"] = jax.ops.segment_sum(
                             mask.ravel().astype(jnp.int32), col_ids, num_segments=size)
-                    continue
-                if "hll" in outs:
-                    # HLL register update from per-doc (bucket, rank) vectors
-                    # (host-materialized at block load — no LUT gathers here) +
-                    # one segment_max — no hashing on device.
-                    m = 1 << spec.hll_params[ai]
-                    bucket = jnp.where(mask, agg_luts[f"{ai}.bucket"], m).ravel()
-                    rank = jnp.where(mask, agg_luts[f"{ai}.rank"], 0).ravel()
-                    regs = jax.ops.segment_max(rank, bucket, num_segments=m + 1)[:m]
-                    out[f"{ai}.hll"] = jnp.maximum(regs, 0)
                     continue
                 if outs == ("count",):
                     continue

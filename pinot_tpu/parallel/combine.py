@@ -168,33 +168,6 @@ class SegmentSetBlock:
 
         return self._stack("decoded", col, 0, per_seg)
 
-    def hll(self, col: str, p: int):
-        """Per-doc (bucket, rank) HLL update vectors, host-materialized once.
-
-        Buckets/ranks hash the *values*, so per-segment dictionaries need no
-        alignment here either."""
-        from ..query.executor import _hll_luts, _hll_tables
-
-        def luts_and_ids(s):
-            reader = s.column(col)
-            snap = getattr(reader, "dict_snapshot", None)
-            if snap is not None:  # mutable: LUTs from the SAME snapshot as the ids
-                _, d, ids = snap()
-                return _hll_tables(d, p), np.asarray(ids)
-            return _hll_luts(reader, p), np.asarray(reader.fwd).astype(np.int64)
-
-        def bucket_per_seg(i, s):
-            (bucket_lut, _), ids = luts_and_ids(s)
-            return bucket_lut[ids]
-
-        def rank_per_seg(i, s):
-            (_, rank_lut), ids = luts_and_ids(s)
-            return rank_lut[ids]
-
-        # padding rows: bucket = 2**p overflow slot, rank 0
-        return (self._stack(f"hllb{p}", col, np.int32(1 << p), bucket_per_seg),
-                self._stack(f"hllr{p}", col, np.int32(0), rank_per_seg))
-
     def null_mask(self, col: str) -> jnp.ndarray:
         def per_seg(i, s):
             nb = s.column(col).null_bitmap
@@ -283,9 +256,12 @@ class MeshQueryExecutor:
 
     def _alignable(self, plan, segments) -> bool:
         """Dictionary alignment is only needed where dict IDS are shared across
-        devices: dense group keys, id-interval/LUT filters, and exact-distinct
-        presence vectors. Decoded value columns (CmpLeaf expressions, SUM/MIN/MAX
-        args) and HLL (bucket, rank) vectors are materialized per segment against its
+        devices: dense group keys, id-interval/LUT filters, and the
+        distinct-family presence vectors (DISTINCTCOUNT/HLL/theta — HLL moved
+        onto the presence path for the ~15x matmul-vs-scatter kernel win, at
+        the cost of now needing alignment; unaligned sets take the merged-view
+        global-dictionary remap instead). Decoded value columns (CmpLeaf
+        expressions, SUM/MIN/MAX args) are materialized per segment against its
         OWN dictionary, so mixed segment sets still ride the mesh kernel for them."""
         cols = set(plan.group_cols)
         for leaf in plan.filter_prog.leaves:
@@ -336,7 +312,6 @@ class MeshQueryExecutor:
         build_device_geometry(plan)
         agg_specs = []
         distinct_lut_sizes: Dict[int, int] = {}
-        hll_params: Dict[int, int] = {}
         agg_luts: Dict[str, jnp.ndarray] = {}
 
         s_pad = -(-len(segments) // self.n_devices) * self.n_devices
@@ -358,14 +333,9 @@ class MeshQueryExecutor:
                 # plan.segment is the merged view on the unaligned path, so this is
                 # the GLOBAL cardinality there (ids arrive remapped)
                 distinct_lut_sizes[i] = lut_size(plan.segment.column(agg.arg.name).cardinality)
-            if "hll" in agg.device_outputs:
-                hll_params[i] = agg.p
-                bucket, rank = block.hll(agg.arg.name, agg.p)
-                agg_luts[f"{i}.bucket"] = bucket
-                agg_luts[f"{i}.rank"] = rank
 
         spec = KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
-                          tuple(agg_specs), distinct_lut_sizes, block.rows, hll_params)
+                          tuple(agg_specs), distinct_lut_sizes, block.rows)
 
         # -- gather runtime inputs ------------------------------------
         # ids only where dict ids are semantically needed (group keys, interval/LUT
@@ -388,8 +358,6 @@ class MeshQueryExecutor:
         for i, agg in enumerate(plan.aggs):
             if "distinct" in agg.device_outputs:
                 ids_cols.add(agg.arg.name)
-            elif "hll" in agg.device_outputs:
-                pass  # per-doc (bucket, rank) vectors already in agg_luts
             elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
                                               and agg.arg.name == "*"):
                 vals_cols.update(identifiers_in(agg.arg))

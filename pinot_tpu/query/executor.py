@@ -132,18 +132,15 @@ class ServerQueryExecutor:
         build_device_geometry(plan)
         agg_specs: List[Tuple[AggFunc, Tuple[str, ...]]] = []
         distinct_lut_sizes: Dict[int, int] = {}
-        hll_params: Dict[int, int] = {}
         for i, agg in enumerate(plan.aggs):
             agg_specs.append((agg, agg.device_outputs))
             if "distinct" in agg.device_outputs:
                 distinct_lut_sizes[i] = lut_size(seg.column(agg.arg.name).cardinality)
-            if "hll" in agg.device_outputs:
-                hll_params[i] = agg.p
 
         block = block_for(seg)
         spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
                                   tuple(agg_specs), distinct_lut_sizes, block.padded,
-                                  hll_params, mv_cols=_mv_lut_cols(plan, seg))
+                                  mv_cols=_mv_lut_cols(plan, seg))
         inputs = self._kernel_inputs(plan, spec, block)
         outs = kernels.run_kernel(spec, inputs)
 
@@ -185,11 +182,6 @@ class ServerQueryExecutor:
         for i, agg in enumerate(plan.aggs):
             if "distinct" in agg.device_outputs:
                 ids_cols.add(agg.arg.name)
-            elif "hll" in agg.device_outputs:
-                # per-doc (bucket, rank) vectors, host-materialized once in the block
-                bucket, rank = block.hll_arrays(agg.arg.name, agg.p)
-                agg_luts[f"{i}.bucket"] = bucket
-                agg_luts[f"{i}.rank"] = rank
             elif agg.arg is not None and not (isinstance(agg.arg, Identifier)
                                               and agg.arg.name == "*"):
                 vals_cols.update(identifiers_in(agg.arg))
@@ -253,7 +245,7 @@ class ServerQueryExecutor:
                 card = seg.column(agg.arg.name).cardinality
                 present_ids = np.nonzero(presence[:card] > 0)[0]
                 values = seg.column(agg.arg.name).dictionary.take(present_ids)
-                states.append(set(values.tolist()))
+                states.append(agg.state_from_value_set(set(values.tolist())))
                 continue
             o = {"count": count}
             for out_name in agg.device_outputs:
@@ -636,38 +628,6 @@ def _factorize_keys(arr: np.ndarray):
 
 def _is_const(e: Expr) -> bool:
     return not identifiers_in(e)
-
-
-def _hll_tables(dictionary, p: int):
-    """(bucket, rank) HLL update tables over one dictionary's values."""
-    from ..engine.datablock import lut_size
-    from .aggregates import hll_bucket_rank
-    size = lut_size(len(dictionary))
-    bucket = np.zeros(size, dtype=np.int32)
-    rank = np.zeros(size, dtype=np.int32)
-    for i, v in enumerate(dictionary.values):
-        b, r = hll_bucket_rank(v, p)
-        bucket[i] = b
-        rank[i] = r
-    return bucket, rank
-
-
-def _hll_luts(reader, p: int):
-    """Per-dict-id (bucket, rank) HLL update tables, cached on the column reader."""
-    cache = getattr(reader, "_hll_lut_cache", None)
-    if cache is None:
-        cache = {}
-        reader._hll_lut_cache = cache
-    d = reader.dictionary  # one read: tables stay internally consistent
-    # cardinality in the key: a mutable reader's dictionary grows between snapshots,
-    # and a stale (smaller) LUT would be indexed out of bounds by new ids; stale
-    # cardinalities for the same p are dropped so growth doesn't accumulate LUTs
-    key = (p, len(d))
-    if key not in cache:
-        for k in [k for k in cache if k[0] == p]:
-            del cache[k]
-        cache[key] = _hll_tables(d, p)
-    return cache[key]
 
 
 def execute_query(segments: Sequence[ImmutableSegment], sql: str,

@@ -278,9 +278,8 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
         err = _power_sum_f32_safe(agg, segment)
         if err:
             return err
-        if arg_is_dict and ("distinct" in agg.device_outputs
-                            or "hll" in agg.device_outputs):
-            continue  # distinct/HLL over a dict column works on ids; dtype irrelevant
+        if arg_is_dict and "distinct" in agg.device_outputs:
+            continue  # distinct-family over a dict column works on ids; dtype irrelevant
         if arg is not None and not (isinstance(arg, Identifier) and arg.name == "*"):
             err = _expr_device_ok(arg, segment)
             if err:

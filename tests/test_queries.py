@@ -286,7 +286,8 @@ def test_minmax_pruning_raw_column(qenv):
 
 
 def test_distinctcounthll_device(qenv):
-    """HLL estimate within ~3% of exact (device path: LUT gather + segment_max)."""
+    """HLL estimate within ~3% of exact (device path: one-hot-matmul presence
+    vector, registers built host-side from surviving dictionary values)."""
     segments, db = qenv
     from pinot_tpu.query.context import compile_query
     from pinot_tpu.query.planner import plan_segment

@@ -281,3 +281,26 @@ def test_theta_filtered_numeric_hash_domain_matches_unfiltered(tmp_path):
     b = ThetaSketch.from_bytes(bytes.fromhex(raw_filt))
     inter = a.intersect(b).estimate()
     assert inter == pytest.approx(100, rel=0.05), inter
+
+
+def test_hll_device_state_is_registers_not_value_set(tmp_path):
+    """A single-segment server ships the HLL partial over the wire without any
+    merge; the state must already be the bounded register array, not the exact
+    value set the device decode produces."""
+    import numpy as np
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    schema = Schema("w1", [dimension("k"), metric("v", DataType.INT)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"k": [f"k{i % 50}" for i in range(500)],
+         "v": np.arange(500, dtype=np.int32)}, str(tmp_path), "w1_0"))
+    ctx = compile_query("SELECT DISTINCTCOUNTHLL(k), "
+                        "DISTINCTCOUNTTHETASKETCH(k) FROM w1", schema)
+    res = ServerQueryExecutor(use_device=True).execute_segment(ctx, seg)
+    hll_state, theta_state = res.scalar[0], res.scalar[1]
+    assert isinstance(hll_state, np.ndarray) and hll_state.dtype == np.int8, \
+        type(hll_state)
+    from pinot_tpu.query.sketches import ThetaSketch
+    assert isinstance(theta_state, ThetaSketch), type(theta_state)
