@@ -109,12 +109,73 @@ class LocalDeepStore(DeepStoreFS):
         os.replace(src, dst)
 
 
-_FS_REGISTRY: Dict[str, Type[DeepStoreFS]] = {"local": LocalDeepStore}
+class MemDeepStore(DeepStoreFS):
+    """In-memory object store keyed by URI — the remote-FS stand-in (reference:
+    the S3/GCS/ADLS PinotFS plugins are all "bytes by URI" with no rename;
+    this implementation deliberately has the same shape: move() uses the
+    base-class copy+delete, there is no local path). Proves the FS SPI is
+    actually pluggable: everything the controller/server do against the deep
+    store must work through put/get-by-URI alone."""
+
+    scheme = "mem"
+
+    def __init__(self, root: str = ""):
+        self._blobs: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def upload(self, local_path: str, uri: str) -> None:
+        with open(local_path, "rb") as f:
+            data = f.read()
+        with self._lock:
+            self._blobs[uri] = data
+
+    def download(self, uri: str, local_path: str) -> None:
+        with self._lock:
+            if uri not in self._blobs:
+                raise FileNotFoundError(f"mem://{uri}")
+            data = self._blobs[uri]
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(data)
+
+    def delete(self, uri: str) -> None:
+        with self._lock:
+            prefix = uri.rstrip("/") + "/"
+            for k in [k for k in self._blobs if k == uri or k.startswith(prefix)]:
+                del self._blobs[k]
+
+    def exists(self, uri: str) -> bool:
+        with self._lock:
+            prefix = uri.rstrip("/") + "/"
+            return uri in self._blobs or any(k.startswith(prefix)
+                                             for k in self._blobs)
+
+    def listdir(self, uri: str) -> List[str]:
+        prefix = uri.rstrip("/") + "/" if uri else ""
+        with self._lock:
+            names = {k[len(prefix):].split("/", 1)[0]
+                     for k in self._blobs if k.startswith(prefix)}
+        return sorted(names)
+
+
+_FS_REGISTRY: Dict[str, Type[DeepStoreFS]] = {"local": LocalDeepStore,
+                                              "mem": MemDeepStore}
 
 
 def register_fs(scheme: str, cls: Type[DeepStoreFS]) -> None:
     """Plugin hook (reference: PinotFSFactory.register)."""
     _FS_REGISTRY[scheme] = cls
+
+
+def create_fs(spec: str) -> DeepStoreFS:
+    """Factory from a "scheme://root" spec (reference: PinotFSFactory.create):
+    "local:///data/deepstore", "mem://", or a plugin-registered scheme."""
+    scheme, _, root = spec.partition("://")
+    cls = _FS_REGISTRY.get(scheme)
+    if cls is None:
+        raise ValueError(f"unknown deep-store scheme {scheme!r} "
+                         f"(registered: {sorted(_FS_REGISTRY)})")
+    return cls(root)
 
 
 def tar_segment(segment_dir: str, out_path: str) -> str:

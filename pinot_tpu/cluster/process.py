@@ -60,13 +60,17 @@ def run_controller(work_dir: str, run_dir: str, port: int = 0,
                    config_path: str = "") -> None:
     from .catalog import Catalog
     from .controller import Controller
-    from .deepstore import LocalDeepStore
+    from .deepstore import create_fs
     from .services import ControllerService
 
     cfg = _load_config(config_path, port, "controller.port")
     access_control = _setup_auth(cfg)
     catalog = Catalog()
-    deepstore = LocalDeepStore(os.path.join(work_dir, "deepstore"))
+    # deep store is configurable by scheme (reference:
+    # controller.data.dir + pinot.controller.storage.factory.class.*)
+    deepstore = create_fs(cfg.get_str(
+        "controller.deepstore",
+        f"local://{os.path.join(work_dir, 'deepstore')}"))
     controller = Controller("controller_0", catalog, deepstore,
                             os.path.join(work_dir, "controller"))
     svc = ControllerService(controller, port=cfg.get_int("controller.port", 0),
