@@ -1156,6 +1156,98 @@ class DistinctCountMVAgg(DistinctCountAgg):
         return super().host_state(_mv_flat(values))
 
 
+class DistinctCountRawHLLAgg(DistinctCountHLLAgg):
+    """DISTINCTCOUNTRAWHLL — serialized registers (hex) for client-side merging
+    (reference: DistinctCountRawHLLAggregationFunction). Register-max merge of
+    two hex payloads of equal p reproduces the server-side union."""
+    name = "distinctcountrawhll"
+
+    def finalize(self, state):
+        return self._normalize(state).astype(np.int8).tobytes().hex()
+
+    def empty_result(self):
+        return np.zeros(1 << self.p, dtype=np.int8).tobytes().hex()
+
+
+class DistinctCountRawHLLMVAgg(DistinctCountRawHLLAgg):
+    name = "distinctcountrawhllmv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class PercentileRawEstAgg(PercentileEstAgg):
+    """PERCENTILERAWEST — serialized digest (hex); the reference serializes a
+    QuantileDigest, here the same t-digest state as PERCENTILERAWTDIGEST."""
+    name = "percentilerawest"
+    pct_base = "percentilerawest"
+
+    def finalize(self, state):
+        return state.to_bytes().hex()
+
+
+class PercentileSmartTDigestAgg(PercentileTDigestAgg):
+    """PERCENTILESMARTTDIGEST — exact value buffer until `threshold` values,
+    then degrade to a t-digest (reference: PercentileSmartTDigestAggregationFunction,
+    threshold via a 'threshold=N' third argument)."""
+    name = "percentilesmarttdigest"
+    pct_base = "percentilesmarttdigest"
+    DEFAULT_THRESHOLD = 100_000
+
+    def __init__(self, call: Function):
+        super().__init__(call)
+        self.threshold = self.DEFAULT_THRESHOLD
+        from ..sql.ast import Literal
+        # args[1:]: in the digit-suffix form (PERCENTILESMARTTDIGEST90(x, ...))
+        # the threshold literal is args[1]; a pct literal never contains
+        # "threshold=" so the guard excludes it either way
+        for a in call.args[1:]:
+            if isinstance(a, Literal) and "threshold=" in str(a.value):
+                self.threshold = int(str(a.value).split("=", 1)[1])
+
+    def _digest(self, values: np.ndarray):
+        from .sketches import TDigest
+        return TDigest.from_values(values, self.COMPRESSION)
+
+    def host_state(self, values):
+        arr = np.asarray(values, dtype=np.float64)
+        if len(arr) > self.threshold:
+            return ("digest", self._digest(arr))
+        return ("exact", arr)
+
+    def merge(self, a, b):
+        ka, va = a
+        kb, vb = b
+        if ka == "exact" and kb == "exact":
+            u = np.concatenate([va, vb])
+            if len(u) > self.threshold:
+                return ("digest", self._digest(u))
+            return ("exact", u)
+        da = va if ka == "digest" else self._digest(va)
+        db = vb if kb == "digest" else self._digest(vb)
+        return ("digest", da.merge(db))
+
+    def finalize(self, state):
+        kind, v = state
+        if kind == "exact":
+            return None if len(v) == 0 else float(np.percentile(v, self.pct))
+        q = v.quantile(self.pct / 100.0)
+        return None if q is None else float(q)
+
+
+class MinMaxRangeMVAgg(MinMaxRangeAgg):
+    name = "minmaxrangemv"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
 def _strip_mv(call: Function) -> Function:
     return Function(call.name[:-2], call.args, call.distinct)
 
@@ -1184,6 +1276,26 @@ class PercentileEstMVAgg(PercentileEstAgg):
 
 class PercentileTDigestMVAgg(PercentileTDigestAgg):
     name = "percentiletdigestmv"
+
+    def __init__(self, call: Function):
+        super().__init__(_strip_mv(call))
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class PercentileRawEstMVAgg(PercentileRawEstAgg):
+    name = "percentilerawestmv"
+
+    def __init__(self, call: Function):
+        super().__init__(_strip_mv(call))
+
+    def host_state(self, values):
+        return super().host_state(_mv_flat(values))
+
+
+class PercentileRawTDigestMVAgg(PercentileRawTDigestAgg):
+    name = "percentilerawtdigestmv"
 
     def __init__(self, call: Function):
         super().__init__(_strip_mv(call))
@@ -1319,6 +1431,13 @@ _REGISTRY = {
     # (percentile*mv names dispatch through make_agg's MV-percentile branch,
     # which also handles the digit-suffix forms — not via this registry)
     "distinctcounthllmv": DistinctCountHLLMVAgg,
+    "percentilesmarttdigest": PercentileSmartTDigestAgg,
+    "percentilerawest": PercentileRawEstAgg,
+    "distinctcountrawhll": DistinctCountRawHLLAgg,
+    "distinctcountrawhllmv": DistinctCountRawHLLMVAgg,
+    "fasthll": DistinctCountHLLAgg,  # legacy alias (reference: FASTHLL)
+    "distinctcountbitmapmv": DistinctCountMVAgg,  # exact, same state
+    "minmaxrangemv": MinMaxRangeMVAgg,
     "segmentpartitioneddistinctcount": SegmentPartitionedDistinctCountAgg,
     "distinctcountsmarthll": DistinctCountSmartHLLAgg,
     "count": CountAgg,
@@ -1373,13 +1492,17 @@ def make_agg(call: Function) -> AggFunc:
         return DistinctCountAgg(Function("distinctcount", call.args))
     if name.endswith("mv") and name.startswith("percentile"):
         stem = name[:-2]
-        for prefix, cls in (("percentiletdigest", PercentileTDigestMVAgg),
+        for prefix, cls in (("percentilerawtdigest", PercentileRawTDigestMVAgg),
+                            ("percentilerawest", PercentileRawEstMVAgg),
+                            ("percentiletdigest", PercentileTDigestMVAgg),
                             ("percentileest", PercentileEstMVAgg),
                             ("percentile", PercentileMVAgg)):
             if stem == prefix or (stem.startswith(prefix)
                                   and stem[len(prefix):].isdigit()):
                 return cls(call)
-    for prefix, cls in (("percentilerawtdigest", PercentileRawTDigestAgg),
+    for prefix, cls in (("percentilesmarttdigest", PercentileSmartTDigestAgg),
+                        ("percentilerawtdigest", PercentileRawTDigestAgg),
+                        ("percentilerawest", PercentileRawEstAgg),
                         ("percentiletdigest", PercentileTDigestAgg),
                         ("percentileest", PercentileEstAgg),
                         ("percentile", PercentileAgg)):

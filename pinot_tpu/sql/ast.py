@@ -73,7 +73,8 @@ AGGREGATION_FUNCTIONS = {
     # IdSetAggregationFunction)
     "idset", "idsetmv",
     "distinctcounthllmv", "segmentpartitioneddistinctcount",
-    "distinctcountsmarthll",
+    "distinctcountsmarthll", "distinctcountrawhll", "distinctcountrawhllmv",
+    "fasthll", "distinctcountbitmapmv", "minmaxrangemv",
 }
 
 

@@ -252,3 +252,51 @@ def test_distinctcount_smart_hll(seg):
     approx = execute_query(
         [seg], "SELECT DISTINCTCOUNTSMARTHLL(small, 2) FROM stats").rows[0][0]
     assert approx == pytest.approx(exact, rel=0.2)
+
+
+def test_raw_hll_and_aliases(seg):
+    import numpy as np
+    from pinot_tpu.query.aggregates import HLL_DEFAULT_P, hll_estimate
+    raw = execute_query([seg], "SELECT DISTINCTCOUNTRAWHLL(small) FROM stats"
+                        ).rows[0][0]
+    regs = np.frombuffer(bytes.fromhex(raw), dtype=np.int8)
+    assert len(regs) == 1 << HLL_DEFAULT_P
+    est = hll_estimate(regs)
+    exact = execute_query([seg], "SELECT DISTINCTCOUNT(small) FROM stats").rows[0][0]
+    assert est == pytest.approx(exact, rel=0.2)
+    # FASTHLL legacy alias behaves like DISTINCTCOUNTHLL
+    a = execute_query([seg], "SELECT FASTHLL(small) FROM stats").rows[0][0]
+    b = execute_query([seg], "SELECT DISTINCTCOUNTHLL(small) FROM stats").rows[0][0]
+    assert a == b
+
+
+def test_percentile_smart_tdigest(seg):
+    exact = execute_query([seg], "SELECT PERCENTILE(x, 90) FROM stats").rows[0][0]
+    smart = execute_query([seg],
+                          "SELECT PERCENTILESMARTTDIGEST(x, 90) FROM stats").rows[0][0]
+    assert smart == pytest.approx(exact, rel=1e-9)  # under threshold: exact
+    degraded = execute_query(
+        [seg], "SELECT PERCENTILESMARTTDIGEST(x, 90, 'threshold=10') FROM stats"
+    ).rows[0][0]
+    assert degraded == pytest.approx(exact, rel=0.1)
+
+
+def test_percentile_rawest(seg):
+    from pinot_tpu.query.sketches import TDigest
+    raw = execute_query([seg], "SELECT PERCENTILERAWEST90(t) FROM stats").rows[0][0]
+    d = TDigest.from_bytes(bytes.fromhex(raw))
+    exact = execute_query([seg], "SELECT PERCENTILEEST(t, 90) FROM stats").rows[0][0]
+    assert d.quantile(0.9) == pytest.approx(exact, rel=0.05)
+
+
+def test_percentile_smart_tdigest_suffix_form_threshold(seg):
+    exact = execute_query([seg], "SELECT PERCENTILE(x, 90) FROM stats").rows[0][0]
+    got = execute_query(
+        [seg], "SELECT PERCENTILESMARTTDIGEST90(x, 'threshold=10') FROM stats"
+    ).rows[0][0]
+    assert got == pytest.approx(exact, rel=0.1)
+    from pinot_tpu.query.aggregates import make_agg
+    from pinot_tpu.sql.ast import Function, Identifier, Literal
+    agg = make_agg(Function("percentilesmarttdigest90",
+                            (Identifier("x"), Literal("threshold=10"))))
+    assert agg.threshold == 10 and agg.pct == 90.0

@@ -252,3 +252,11 @@ def test_percentile_mv_group_by(seg):
     res = execute_query([seg], "SELECT doc, PERCENTILEMV(scores, 100) FROM docs "
                                "GROUP BY doc ORDER BY doc LIMIT 10")
     assert [r[1] for r in res.rows] == [2.0, 3.0, 5.0, 8.0]
+
+
+def test_minmaxrange_and_bitmap_mv(seg):
+    res = execute_query([seg], "SELECT MINMAXRANGEMV(scores), "
+                               "DISTINCTCOUNTBITMAPMV(scores) FROM docs")
+    # flattened scores: [1,2,2,3,5,7,8] -> range 7, distinct 6
+    assert res.rows[0][0] == 7.0
+    assert res.rows[0][1] == 6
