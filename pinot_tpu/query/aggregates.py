@@ -1392,6 +1392,35 @@ class DistinctCountSmartHLLAgg(AggFunc):
         return 0
 
 
+class StUnionAgg(AggFunc):
+    """STUNION — union of point geometries into one MULTIPOINT WKT (reference:
+    StUnionAggregationFunction unions theta-sketch-free geometries; our geo
+    model is lng/lat points — see engine/geo_fns.py — so the union is the
+    distinct point set, serialized as WKT)."""
+    name = "stunion"
+
+    def device_ok(self, ctx):
+        return False
+
+    def host_state(self, values):
+        from ..engine.geo_fns import _as_complex
+        pts = _as_complex(values)
+        return {(float(p.real), float(p.imag))
+                for p in np.atleast_1d(np.asarray(pts, dtype=complex))}
+
+    def merge(self, a, b):
+        return a | b
+
+    def finalize(self, state):
+        if not state:
+            return "MULTIPOINT EMPTY"
+        body = ", ".join(f"{x:g} {y:g}" for x, y in sorted(state))
+        return f"MULTIPOINT ({body})"
+
+    def empty_result(self):
+        return "MULTIPOINT EMPTY"
+
+
 class IdSetAgg(AggFunc):
     """IDSET(col): build a serialized value-set usable as an `IN_ID_SET` filter
     literal in a later query (reference: IdSetAggregationFunction; the broker's
@@ -1431,6 +1460,7 @@ _REGISTRY = {
     # (percentile*mv names dispatch through make_agg's MV-percentile branch,
     # which also handles the digit-suffix forms — not via this registry)
     "distinctcounthllmv": DistinctCountHLLMVAgg,
+    "stunion": StUnionAgg,
     "percentilesmarttdigest": PercentileSmartTDigestAgg,
     "percentilerawest": PercentileRawEstAgg,
     "distinctcountrawhll": DistinctCountRawHLLAgg,

@@ -74,7 +74,7 @@ AGGREGATION_FUNCTIONS = {
     "idset", "idsetmv",
     "distinctcounthllmv", "segmentpartitioneddistinctcount",
     "distinctcountsmarthll", "distinctcountrawhll", "distinctcountrawhllmv",
-    "fasthll", "distinctcountbitmapmv", "minmaxrangemv",
+    "fasthll", "distinctcountbitmapmv", "minmaxrangemv", "stunion",
 }
 
 

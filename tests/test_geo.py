@@ -217,3 +217,28 @@ def test_geo_cluster_path(tmp_path):
         "ST_POINT(-122.375, 37.619)) < 100000")
     exact = int((haversine_m(LNG, LAT, *SFO) < 100_000).sum())
     assert abs(res.rows[0][0] - exact) <= 2
+
+
+def test_stunion_aggregation(tmp_path):
+    """STUNION: distinct-point union serialized as MULTIPOINT WKT
+    (reference: StUnionAggregationFunction)."""
+    import numpy as np
+    from pinot_tpu.query.executor import execute_query
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    schema = Schema("pts", [dimension("city"),
+                            metric("lng", DataType.DOUBLE),
+                            metric("lat", DataType.DOUBLE)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"city": ["a", "b", "a"],
+         "lng": np.array([1.0, 2.0, 1.0]),
+         "lat": np.array([3.0, 4.0, 3.0])}, str(tmp_path), "pts_0"))
+    res = execute_query([seg],
+                        "SELECT STUNION(ST_POINT(lng, lat)) FROM pts")
+    assert res.rows[0][0] == "MULTIPOINT (1 3, 2 4)"
+    res = execute_query([seg], "SELECT STUNION(ST_POINT(lng, lat)) FROM pts "
+                               "WHERE city = 'nope'")
+    assert res.rows[0][0] == "MULTIPOINT EMPTY"
+    res = execute_query([seg], "SELECT city, STUNION(ST_POINT(lng, lat)) FROM pts "
+                               "GROUP BY city ORDER BY city LIMIT 5")
+    assert res.rows == [["a", "MULTIPOINT (1 3)"], ["b", "MULTIPOINT (2 4)"]]
