@@ -383,3 +383,124 @@ DEVICE_DATETIME_FUNCS = frozenset({
     "hour", "minute", "second", "millisecond",
 } | {f"toepoch{u.lower()}{suf}" for u in ("SECONDS", "MINUTES", "HOURS", "DAYS")
      for suf in ("", "bucket")})
+
+
+# -- timestamp arithmetic (reference: DateTimeFunctions timestampAdd/
+# timestampDiff aka dateAdd/dateDiff, totimestamp/fromtimestamp) --------------
+
+_FIXED_UNIT_MS = {"MILLISECOND": 1, "SECOND": 1000, "MINUTE": 60_000,
+                  "HOUR": 3_600_000, "DAY": 86_400_000, "WEEK": 7 * 86_400_000}
+
+
+def _ts_shift_calendar(ms: int, unit: str, amount: int) -> int:
+    import calendar
+    import datetime as _dt
+    d = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc) \
+        + _dt.timedelta(milliseconds=int(ms))
+    if unit == "YEAR":
+        y = d.year + amount
+        d = d.replace(year=y, day=min(d.day, calendar.monthrange(y, d.month)[1]))
+    else:  # MONTH / QUARTER — day-of-month clamps to the target month's length
+        months = amount * (3 if unit == "QUARTER" else 1)
+        total = d.year * 12 + (d.month - 1) + months
+        y, m = divmod(total, 12)
+        d = d.replace(year=y, month=m + 1,
+                      day=min(d.day, calendar.monthrange(y, m + 1)[1]))
+    return int(d.timestamp() * 1000)
+
+
+@register_function("timestampadd")
+def _timestampadd(xp, unit, amount, ts):
+    """timestampAdd('MONTH', n, tsMs): calendar-aware for YEAR/QUARTER/MONTH,
+    fixed-width otherwise (reference: DateTimeFunctions.timestampAdd)."""
+    u = str(unit).upper()
+    n = int(amount)
+    arr = np.asarray(ts)
+    if u in _FIXED_UNIT_MS:
+        return (arr.astype(np.int64) + n * _FIXED_UNIT_MS[u])
+    if u not in ("YEAR", "QUARTER", "MONTH"):
+        raise ValueError(f"timestampAdd: unknown unit {unit!r}")
+    if arr.ndim == 0:
+        return _ts_shift_calendar(int(arr), u, n)
+    return np.asarray([_ts_shift_calendar(int(x), u, n) for x in arr.ravel()],
+                      dtype=np.int64).reshape(arr.shape)
+
+
+@register_function("dateadd")
+def _dateadd(xp, unit, amount, ts):
+    return _timestampadd(xp, unit, amount, ts)
+
+
+@register_function("timestampdiff")
+def _timestampdiff(xp, unit, a, b):
+    """timestampDiff(unit, tsA, tsB) = whole units from A to B
+    (reference: DateTimeFunctions.timestampDiff)."""
+    u = str(unit).upper()
+    aa = np.asarray(a).astype(np.int64)
+    bb = np.asarray(b).astype(np.int64)
+    if u in _FIXED_UNIT_MS:
+        return (bb - aa) // _FIXED_UNIT_MS[u]
+
+    def months_between(x, y):
+        import datetime as _dt
+        dx = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc) \
+            + _dt.timedelta(milliseconds=int(x))
+        dy = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc) \
+            + _dt.timedelta(milliseconds=int(y))
+        m = (dy.year - dx.year) * 12 + (dy.month - dx.month)
+        # partial month doesn't count
+        if m > 0 and (dy.day, dy.time()) < (dx.day, dx.time()):
+            m -= 1
+        elif m < 0 and (dy.day, dy.time()) > (dx.day, dx.time()):
+            m += 1
+        return m
+
+    if u not in ("YEAR", "QUARTER", "MONTH"):
+        raise ValueError(f"timestampDiff: unknown unit {unit!r}")
+    div = {"YEAR": 12, "QUARTER": 3, "MONTH": 1}[u]
+    flat_a, flat_b = np.broadcast_arrays(aa, bb)
+    if flat_a.ndim == 0:
+        return months_between(int(flat_a), int(flat_b)) // div
+    out = np.asarray([months_between(int(x), int(y)) // div
+                      for x, y in zip(flat_a.ravel(), flat_b.ravel())],
+                     dtype=np.int64)
+    return out.reshape(flat_a.shape)
+
+
+@register_function("datediff")
+def _datediff(xp, unit, a, b):
+    return _timestampdiff(xp, unit, a, b)
+
+
+@register_function("totimestamp")
+def _totimestamp(xp, v):
+    # ms since epoch passthrough (the reference converts long -> java Timestamp)
+    return np.asarray(v).astype(np.int64)
+
+
+@register_function("fromtimestamp")
+def _fromtimestamp(xp, v):
+    return np.asarray(v).astype(np.int64)
+
+
+def _tz_offset_seconds(tz, millis) -> int:
+    """UTC offset of `tz` at `millis` (reference 1-arg form evaluates at epoch
+    0 — deterministic, unlike wall-clock now() which flips with DST)."""
+    import datetime as _dt
+    import zoneinfo
+    at = _dt.datetime.fromtimestamp(int(millis) / 1000, _dt.timezone.utc)
+    return int(at.astimezone(zoneinfo.ZoneInfo(str(tz))).utcoffset()
+               .total_seconds())
+
+
+@register_function("timezonehour")
+def _timezonehour(xp, tz, millis=0):
+    total = _tz_offset_seconds(tz, millis)
+    return int(total / 3600)  # truncate toward zero: -3:30 -> hour -3
+
+
+@register_function("timezoneminute")
+def _timezoneminute(xp, tz, millis=0):
+    total = _tz_offset_seconds(tz, millis)
+    hours = int(total / 3600)
+    return int((total - hours * 3600) / 60)  # -3:30 -> minute -30

@@ -432,3 +432,66 @@ def _arrayindexof(xp, v, target):
 def _arraycontains(xp, v, target):
     return np.asarray([target in np.atleast_1d(np.asarray(row)).tolist()
                        for row in v], dtype=bool)
+
+
+@register_function("arrayreverse")
+def _arrayreverse(xp, v):
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        out[i] = np.atleast_1d(np.asarray(row))[::-1]
+    return out
+
+
+@register_function("arrayslice")
+def _arrayslice(xp, v, start, end):
+    s, e = int(start), int(end)
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        out[i] = np.atleast_1d(np.asarray(row))[s:e]
+    return out
+
+
+@register_function("arrayremove")
+def _arrayremove(xp, v, target):
+    # first occurrence only (reference: ArrayUtils.removeElement semantics)
+    out = np.empty(len(v), dtype=object)
+    for i, row in enumerate(v):
+        vals = np.atleast_1d(np.asarray(row)).tolist()
+        if target in vals:
+            vals.remove(target)
+        out[i] = np.asarray(vals)
+    return out
+
+
+@register_function("arrayunion")
+def _arrayunion(xp, a, b):
+    out = np.empty(len(a), dtype=object)
+    for i in range(len(a)):
+        seen, keep = set(), []
+        for src in (a[i], b[i]):
+            for x in np.atleast_1d(np.asarray(src)).tolist():
+                if x not in seen:
+                    seen.add(x)
+                    keep.append(x)
+        out[i] = np.asarray(keep)
+    return out
+
+
+@register_function("arrayconcat")
+def _arrayconcat(xp, a, b):
+    out = np.empty(len(a), dtype=object)
+    for i in range(len(a)):
+        out[i] = np.concatenate([np.atleast_1d(np.asarray(a[i])),
+                                 np.atleast_1d(np.asarray(b[i]))])
+    return out
+
+
+# the reference registers type-suffixed spellings (arraySortInt/arraySortString
+# etc.) — same implementations here, values are already typed
+for _base in ("arrayconcat", "arraycontains", "arraydistinct", "arrayindexof",
+              "arrayremove", "arrayreverse", "arrayslice", "arrayunion"):
+    for _suffix in ("int", "long", "float", "double", "string"):
+        if _base in _FUNCTIONS:
+            _FUNCTIONS[f"{_base}{_suffix}"] = _FUNCTIONS[_base]
+for _suffix in ("int", "string"):
+    _FUNCTIONS[f"arraysort{_suffix}"] = _FUNCTIONS["arraysortasc"]

@@ -14,7 +14,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
-from .expr import register_function
+from .expr import _FUNCTIONS, register_function
 
 
 class _Wildcard:
@@ -168,3 +168,24 @@ def _json_format(xp, col):
     if arr.ndim == 0:
         return one(arr.item())
     return np.asarray([one(x) for x in arr.ravel()], dtype=object).reshape(arr.shape)
+
+
+# reference jsonPath* scalar spellings (JsonFunctions.java) map onto the
+# json_extract_scalar machinery: same path syntax, type pinned per name
+def _register_jsonpath_aliases():
+    def make(out_type, sentinel):
+        def fn(xp, v, path, *default):
+            # numeric sentinels keep the result arrays numeric on missing
+            # paths (reference: jsonPathLong -> Long.MIN_VALUE,
+            # jsonPathDouble -> NaN) — a None would poison comparisons
+            args = [v, path, out_type,
+                    default[0] if default else sentinel]
+            return _FUNCTIONS["json_extract_scalar"](xp, *args)
+        return fn
+    _FUNCTIONS["jsonpathstring"] = make("STRING", None)
+    _FUNCTIONS["jsonpathlong"] = make("LONG", -(1 << 63))
+    _FUNCTIONS["jsonpathdouble"] = make("DOUBLE", float("nan"))
+    _FUNCTIONS["jsonpath"] = make("STRING", None)
+
+
+_register_jsonpath_aliases()

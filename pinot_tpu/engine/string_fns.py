@@ -308,3 +308,108 @@ def _encodeurl(xp, v):
 def _decodeurl(xp, v):
     import urllib.parse
     return _str_map(v, urllib.parse.unquote)
+
+
+# -- remaining reference StringFunctions (StringFunctions.java) ---------------
+
+@register_function("repeat")
+def _repeat(xp, v, a, b=None):
+    # reference forms: repeat(input, times) and repeat(input, sep, times)
+    if b is None:
+        sep, times = "", int(a)
+    else:
+        sep, times = str(a), int(b)
+    return _str_map(v, lambda x: sep.join([x] * times))
+
+
+@register_function("remove")
+def _remove(xp, v, sub):
+    return _str_map(v, lambda x: x.replace(str(sub), ""))
+
+
+@register_function("leftsubstr")
+def _leftsubstr(xp, v, n):
+    return _str_map(v, lambda x: x[:int(n)])
+
+
+@register_function("rightsubstr")
+def _rightsubstr(xp, v, n):
+    return _str_map(v, lambda x: x[-int(n):] if int(n) else "")
+
+
+@register_function("strcmp")
+def _strcmp(xp, v, other):
+    o = str(other)
+
+    def cmp(x):
+        if x is None:
+            return 0
+        x = str(x)
+        return -1 if x < o else (1 if x > o else 0)
+    return _vec(cmp, dtype=np.int64)(v)
+
+
+@register_function("strrpos")
+def _strrpos(xp, v, sub, *start):
+    sub_s = str(sub)
+
+    def rpos(x):
+        if x is None:
+            return -1
+        x = str(x)
+        # Java lastIndexOf(str, fromIndex): the match may START at fromIndex,
+        # so the rfind end bound is fromIndex + len(needle)
+        end = len(x) if not start else min(len(x), int(start[0]) + len(sub_s))
+        return x.rfind(sub_s, 0, end)
+    return _vec(rpos, dtype=np.int64)(v)
+
+
+@register_function("hammingdistance")
+def _hammingdistance(xp, v, other):
+    o = str(other)
+
+    def ham(x):
+        if x is None or len(str(x)) != len(o):
+            return -1  # reference returns -1 on length mismatch
+        return sum(1 for a, b in zip(str(x), o) if a != b)
+    return _vec(ham, dtype=np.int64)(v)
+
+
+@register_function("normalize")
+def _normalize(xp, v, form="NFC"):
+    import unicodedata
+    f = str(form).upper()
+    return _str_map(v, lambda x: unicodedata.normalize(f, x))
+
+
+@register_function("toascii")
+def _toascii(xp, v):
+    return _str_map(v, lambda x: x.encode("ascii", "ignore").decode("ascii"))
+
+
+@register_function("toutf8")
+def _toutf8(xp, v):
+    return _vec(lambda x: None if x is None else str(x).encode("utf-8"))(v)
+
+
+@register_function("fromutf8")
+def _fromutf8(xp, v):
+    return _vec(lambda x: None if x is None
+                else (bytes(x).decode("utf-8") if not isinstance(x, str) else x))(v)
+
+
+@register_function("bytestohex")
+def _bytestohex(xp, v):
+    return _vec(lambda x: None if x is None else bytes(x).hex())(v)
+
+
+@register_function("hextobytes")
+def _hextobytes(xp, v):
+    return _vec(lambda x: None if x is None else bytes.fromhex(str(x)))(v)
+
+
+# reference spells the codecs both ways
+_FUNCTIONS_ALIASES = {"base64encode": "tobase64", "base64decode": "frombase64"}
+from .expr import _FUNCTIONS as _FN_REG  # noqa: E402
+for _alias, _target in _FUNCTIONS_ALIASES.items():
+    _FN_REG[_alias] = _FN_REG[_target]

@@ -1414,7 +1414,9 @@ class StUnionAgg(AggFunc):
     def finalize(self, state):
         if not state:
             return "MULTIPOINT EMPTY"
-        body = ", ".join(f"{x:g} {y:g}" for x, y in sorted(state))
+        # 12 significant digits: ~1e-7 deg (cm-scale) lng/lat stays distinct,
+        # %g's 6-digit default would collapse nearby real-world points
+        body = ", ".join(f"{x:.12g} {y:.12g}" for x, y in sorted(state))
         return f"MULTIPOINT ({body})"
 
     def empty_result(self):

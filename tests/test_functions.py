@@ -283,3 +283,68 @@ def test_codecs_on_scalar_literals():
     assert ev("tobase64('hello')", {}) == "aGVsbG8="
     assert ev("frombase64('aGVsbG8=')", {}) == "hello"
     assert ev("encodeurl('a b')", {}) == "a%20b"
+
+
+def test_string_breadth_batch():
+    env = {"s": np.array(["hello", "world"], dtype=object)}
+    assert ev("repeat(s, 2)", env).tolist() == ["hellohello", "worldworld"]
+    assert ev("remove(s, 'l')", env).tolist() == ["heo", "word"]
+    assert ev("leftsubstr(s, 3)", env).tolist() == ["hel", "wor"]
+    assert ev("rightsubstr(s, 3)", env).tolist() == ["llo", "rld"]
+    assert ev("strcmp(s, 'hello')", env).tolist() == [0, 1]
+    assert ev("strrpos(s, 'l')", env).tolist() == [3, 3]
+    assert ev("hammingdistance(s, 'hella')", env).tolist() == [1, 4]
+    assert ev("toascii(s)", env).tolist() == ["hello", "world"]
+    assert ev("base64encode(s)", env)[0] == "aGVsbG8="
+    assert ev("bytestohex(toutf8(s))", env)[0] == "68656c6c6f"
+    assert ev("fromutf8(hextobytes('68656c6c6f'))", {}) == "hello"
+
+
+def test_timestamp_add_diff():
+    ts = 1_700_000_000_000  # 2023-11-14
+    env = {"t": np.array([ts], dtype=np.int64)}
+    plus_day = ev("timestampadd('DAY', 3, t)", env)
+    assert int(plus_day[0]) == ts + 3 * 86_400_000
+    plus_month = ev("timestampadd('MONTH', 2, t)", env)
+    import datetime as dt
+    d0 = dt.datetime.fromtimestamp(ts / 1000, dt.timezone.utc)
+    d1 = dt.datetime.fromtimestamp(int(plus_month[0]) / 1000, dt.timezone.utc)
+    assert (d1.year, d1.month, d1.day) == (2024, 1, d0.day)
+    assert ev("timestampdiff('HOUR', t, timestampadd('HOUR', 7, t))", env)[0] == 7
+    assert ev("datediff('MONTH', t, dateadd('MONTH', 5, t))", env)[0] == 5
+    # month-end clamping: Jan 31 + 1 month -> Feb 29 (2024 leap)
+    jan31 = int(dt.datetime(2024, 1, 31, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    feb = ev("timestampadd('MONTH', 1, t2)", {"t2": np.array([jan31], dtype=np.int64)})
+    d2 = dt.datetime.fromtimestamp(int(feb[0]) / 1000, dt.timezone.utc)
+    assert (d2.month, d2.day) == (2, 29)
+
+
+def test_array_breadth_batch():
+    env = {"a": np.array([np.array([3, 1, 3]), np.array([7, 8])], dtype=object),
+           "b": np.array([np.array([1, 9]), np.array([8])], dtype=object)}
+    assert ev("arrayreverse(a)", env)[0].tolist() == [3, 1, 3][::-1]
+    assert ev("arrayslice(a, 0, 2)", env)[0].tolist() == [3, 1]
+    assert ev("arrayremove(a, 3)", env)[0].tolist() == [1, 3]  # first occurrence only
+    assert ev("arrayunion(a, b)", env)[0].tolist() == [3, 1, 9]
+    assert ev("arrayconcat(a, b)", env)[1].tolist() == [7, 8, 8]
+    assert ev("arraysortint(a)", env)[0].tolist() == [1, 3, 3]
+
+
+def test_jsonpath_aliases():
+    env = {"j": np.array(['{"a": {"b": 7, "s": "x"}}'], dtype=object)}
+    assert ev("jsonpathlong(j, '$.a.b')", env).tolist() == [7]
+    assert ev("jsonpathstring(j, '$.a.s')", env).tolist() == ["x"]
+    assert ev("jsonpathdouble(j, '$.a.b')", env).tolist() == [7.0]
+
+
+def test_function_review_fixes():
+    import math
+    env = {"s": np.array(["abcabc"], dtype=object)}
+    assert ev("repeat(s, '-', 3)", env)[0] == "abcabc-abcabc-abcabc"
+    assert ev("strrpos(s, 'bc', 4)", env)[0] == 4  # match may START at fromIndex
+    assert ev("timezonehour('America/New_York')", {}) == -5    # at epoch 0, no DST
+    assert ev("timezonehour('America/St_Johns')", {}) == -3    # truncate toward zero
+    assert ev("timezoneminute('America/St_Johns')", {}) == -30
+    j = {"j": np.array(['{"a": 1}'], dtype=object)}
+    assert ev("jsonpathlong(j, '$.missing')", j).tolist() == [-(1 << 63)]
+    assert math.isnan(ev("jsonpathdouble(j, '$.missing')", j)[0])
