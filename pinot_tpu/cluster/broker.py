@@ -254,6 +254,12 @@ class Broker:
         physical = self._physical_tables(raw_table)
         if not physical:
             raise QueryValidationError(f"unknown table {raw_table!r}")
+        disabled = [t for t in physical
+                    if self.catalog.get_property(f"tableState/{t}") == "disabled"]
+        if disabled:
+            # reference: ChangeTableState disable — table stays loaded but
+            # stops serving queries until re-enabled
+            raise QueryValidationError(f"table {raw_table!r} is disabled")
         # per-table QPS quota, all-or-refund across hybrid halves (reference:
         # QueryQuotaManager)
         if not self.quota.try_acquire_all(physical):

@@ -103,6 +103,10 @@ class Controller:
     def drop_table(self, table: str) -> None:
         for seg in list(self.catalog.segments.get(table, {})):
             self.delete_segment(table, seg)
+        # clear per-table operational flags: a table recreated under the same
+        # name must not inherit a disabled/paused state from its predecessor
+        self.catalog.put_property(f"tableState/{table}", None)
+        self.catalog.put_property(f"pause/{table}", None)
         self.catalog.drop_table(table)
 
     # -- segment upload (reference: ZKOperator.completeSegmentOperations) --------
@@ -351,6 +355,15 @@ class Controller:
             reg.gauge("pinot_controller_minion_tasks", {"state": state}).set(
                 counts.get(state, 0))
         return counts
+
+    def set_table_state(self, table: str, enabled: bool) -> None:
+        """Reference: ChangeTableState / table enable-disable REST op — a
+        disabled table keeps its segments loaded but brokers refuse queries
+        until it is re-enabled."""
+        if table not in self.catalog.table_configs:
+            raise ValueError(f"unknown table {table!r}")
+        self.catalog.put_property(f"tableState/{table}",
+                                  None if enabled else "disabled")
 
     # -- tenants (reference: PinotTenantRestletResource + tag-based instance
     # assignment: a tenant IS a tag on server instances) --------------------

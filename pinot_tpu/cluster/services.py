@@ -83,6 +83,7 @@ class ControllerService:
         s.route("GET", "segmentsMeta", self._segments_meta)
         s.route("POST", "reload", self._reload_table, action="WRITE")
         s.route("GET", "tenants", self._list_tenants)
+        s.route("POST", "tableState", self._table_state, action="ADMIN")
         s.route("POST", "instanceTags", self._update_instance_tags, action="ADMIN")
         s.route("POST", "pauseConsumption", self._pause_consumption, action="ADMIN")
         s.route("POST", "resumeConsumption", self._resume_consumption, action="ADMIN")
@@ -272,6 +273,18 @@ class ControllerService:
             return error_response(f"unknown table {parts[0]}", 404)
         self.controller.reload_table(parts[0])
         return json_response({"status": "OK", "table": parts[0]})
+
+    def _table_state(self, parts, params, body):
+        """POST /tableState/{table}?state=enable|disable (reference:
+        ChangeTableState)."""
+        state = str(params.get("state", "")).lower()
+        if state not in ("enable", "disable"):
+            return error_response("state must be enable|disable", 400)
+        try:
+            self.controller.set_table_state(parts[0], state == "enable")
+        except ValueError as e:
+            return error_response(str(e), 404)
+        return json_response({"status": "OK", "table": parts[0], "state": state})
 
     def _list_tenants(self, parts, params, body):
         """GET /tenants (reference: PinotTenantRestletResource.getAllTenants)."""

@@ -406,7 +406,9 @@ def _ts_shift_calendar(ms: int, unit: str, amount: int) -> int:
         y, m = divmod(total, 12)
         d = d.replace(year=y, month=m + 1,
                       day=min(d.day, calendar.monthrange(y, m + 1)[1]))
-    return int(d.timestamp() * 1000)
+    # integer epoch math: float timestamp() truncation would drop 1 ms on ~1%
+    # of inputs, silently breaking equality filters on the shifted value
+    return calendar.timegm(d.timetuple()) * 1000 + d.microsecond // 1000
 
 
 @register_function("timestampadd")

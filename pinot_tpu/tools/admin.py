@@ -208,6 +208,65 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dir", required=True)
     sp.set_defaults(fn=cmd_verify_segment)
 
+    sp = sub.add_parser("quickstart")
+    sp.add_argument("--type", dest="qtype", default="batch",
+                    choices=["batch", "realtime", "hybrid"])
+    sp.add_argument("--rows", type=int, default=10_000)
+    sp.add_argument("--work-dir", default=None)
+    sp.add_argument("--exit-after-queries", action="store_true")
+    sp.set_defaults(fn=cmd_quickstart)
+
+    sp = sub.add_parser("infer-schema")
+    sp.add_argument("--input", required=True, help=".csv or .jsonl sample")
+    sp.add_argument("--table-name", default=None)
+    sp.add_argument("--time-column", default=None)
+    sp.set_defaults(fn=cmd_infer_schema)
+
+    sp = sub.add_parser("ingest-job")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--spec", required=True, help="job spec JSON/YAML file")
+    sp.set_defaults(fn=cmd_ingest_job)
+
+    sp = sub.add_parser("cluster-info")
+    sp.add_argument("--controller", required=True)
+    sp.set_defaults(fn=cmd_cluster_info)
+
+    sp = sub.add_parser("list-tenants")
+    sp.add_argument("--controller", required=True)
+    sp.set_defaults(fn=cmd_list_tenants)
+
+    sp = sub.add_parser("tag-instance")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--instance", required=True)
+    sp.add_argument("--tags", required=True, help="comma-separated")
+    sp.set_defaults(fn=cmd_tag_instance)
+
+    sp = sub.add_parser("pause-consumption")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.set_defaults(fn=cmd_pause_consumption)
+
+    sp = sub.add_parser("resume-consumption")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.set_defaults(fn=cmd_resume_consumption)
+
+    sp = sub.add_parser("rebalance-table")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.set_defaults(fn=cmd_rebalance_table)
+
+    sp = sub.add_parser("change-table-state")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True)
+    sp.add_argument("--state", required=True, choices=["enable", "disable"])
+    sp.set_defaults(fn=cmd_change_table_state)
+
+    sp = sub.add_parser("drop-table")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--table", required=True, help="table name with type")
+    sp.set_defaults(fn=cmd_drop_table)
+
     sp = sub.add_parser("generate-data")
     sp.add_argument("--schema-file", required=True)
     sp.add_argument("--rows", type=int, default=1000)
@@ -229,6 +288,154 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ops", required=True, help="YAML op-sequence file")
     sp.set_defaults(fn=cmd_compat_check)
     return p
+
+
+def cmd_quickstart(args) -> int:
+    """Reference: Quickstart / RealtimeQuickStart / HybridQuickstart."""
+    from .quickstart import run_quickstart
+    return run_quickstart(args.qtype, rows=args.rows, work_dir=args.work_dir,
+                          exit_after_queries=args.exit_after_queries)
+
+
+def cmd_infer_schema(args) -> int:
+    """Reference: JsonToPinotSchema / AvroSchemaToPinotSchema."""
+    from .datagen import infer_schema
+    schema = infer_schema(args.input, table_name=args.table_name,
+                          time_column=args.time_column)
+    _print(schema.to_json())
+    return 0
+
+
+def cmd_ingest_job(args) -> int:
+    """Reference: LaunchDataIngestionJobCommand over a job-spec file."""
+    import json as _json
+    from ..cluster.process import ControllerClient
+    from ..ingest.batch import BatchIngestionJobSpec, run_batch_ingestion
+
+    with open(args.spec) as f:
+        text = f.read()
+    try:
+        d = _json.loads(text)
+    except ValueError:
+        import yaml
+        d = yaml.safe_load(text)
+    spec = BatchIngestionJobSpec(
+        input_paths=d.get("inputPaths", d.get("input_paths", [])),
+        input_format=d.get("inputFormat"),
+        table=d["table"],
+        segment_name_prefix=d.get("segmentNamePrefix", ""),
+        segment_rows=int(d.get("segmentRows", 1_000_000)),
+        filter_expr=d.get("filterExpr"),
+        column_transforms=d.get("columnTransforms", {}),
+    )
+    import tempfile
+    with tempfile.TemporaryDirectory() as work:
+        pushed = run_batch_ingestion(spec, _RemoteJobController(
+            ControllerClient(args.controller), spec.table), work_dir=work)
+    print(f"pushed {len(pushed)} segments: {pushed}")
+    return 0
+
+
+class _RemoteJobController:
+    """Minimal controller facade the batch runner needs, over HTTP — fetches
+    only the job's table config + schema (not the whole cluster's)."""
+
+    def __init__(self, client, table: str):
+        self._client = client
+        from ..schema import Schema
+        from ..table import TableConfig
+
+        class _Cat:
+            pass
+        cfg = TableConfig.from_json(client.table_config(table)["config"])
+        self.catalog = _Cat()
+        self.catalog.table_configs = {table: cfg}
+        self.catalog.schemas = {
+            cfg.name: Schema.from_json(client.get_schema(cfg.name))}
+
+    def upload_segment(self, table, seg_dir, custom=None):
+        import os
+        import types
+        resp = self._client.upload_segment(table, seg_dir)
+        # normalize the HTTP response to the in-proc SegmentMeta surface the
+        # batch runner consumes
+        return types.SimpleNamespace(
+            name=resp.get("segment") or os.path.basename(seg_dir.rstrip("/")))
+
+
+def cmd_cluster_info(args) -> int:
+    """Reference: ShowClusterInfo / VerifyClusterState."""
+    from ..cluster.http_service import get_json
+    from ..cluster.process import ControllerClient
+    c = ControllerClient(args.controller)
+    tables = c.list_tables().get("tables", {})
+    tenants = get_json(f"{c.url}/tenants", token=c.token).get("tenants", {})
+    print(f"tenants: {tenants}")
+    ok = True
+    for name in tables:
+        st = c.table_status(name)
+        ok &= bool(st.get("converged"))
+        print(f"{name}: segments={st.get('segments')} "
+              f"converged={st.get('converged')}")
+    print("cluster state: " + ("GOOD" if ok else "NOT CONVERGED"))
+    return 0 if ok else 1
+
+
+def cmd_list_tenants(args) -> int:
+    from ..cluster.http_service import get_json
+    from ..cluster.process import ControllerClient
+    c = ControllerClient(args.controller)
+    _print(get_json(f"{c.url}/tenants", token=c.token))
+    return 0
+
+
+def cmd_tag_instance(args) -> int:
+    from ..cluster.http_service import post_json
+    from ..cluster.process import ControllerClient
+    c = ControllerClient(args.controller)
+    _print(post_json(f"{c.url}/instanceTags/{args.instance}",
+                     {"tags": args.tags.split(",")}, token=c.token))
+    return 0
+
+
+def cmd_pause_consumption(args) -> int:
+    from ..cluster.http_service import post_json
+    from ..cluster.process import ControllerClient
+    c = ControllerClient(args.controller)
+    _print(post_json(f"{c.url}/pauseConsumption/{args.table}", {}, token=c.token))
+    return 0
+
+
+def cmd_resume_consumption(args) -> int:
+    from ..cluster.http_service import post_json
+    from ..cluster.process import ControllerClient
+    c = ControllerClient(args.controller)
+    _print(post_json(f"{c.url}/resumeConsumption/{args.table}", {}, token=c.token))
+    return 0
+
+
+def cmd_rebalance_table(args) -> int:
+    from ..cluster.process import ControllerClient
+    _print(ControllerClient(args.controller).rebalance(args.table))
+    return 0
+
+
+def cmd_change_table_state(args) -> int:
+    from ..cluster.http_service import http_call
+    from ..cluster.process import ControllerClient
+    import json as _json
+    c = ControllerClient(args.controller)
+    out = http_call("POST", f"{c.url}/tableState/{args.table}?state={args.state}",
+                    b"{}", token=c.token)
+    _print(_json.loads(out.decode()))
+    return 0
+
+
+def cmd_drop_table(args) -> int:
+    from ..cluster.process import ControllerClient
+    ControllerClient(args.controller).drop_table(args.table)
+    print(f"dropped {args.table}")
+    return 0
 
 
 def cmd_generate_data(args) -> int:

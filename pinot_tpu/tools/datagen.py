@@ -17,6 +17,7 @@ from __future__ import annotations
 import csv
 import json
 import math
+import os
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -187,3 +188,71 @@ def anonymize_file(in_path: str, out_path: str, columns: Sequence[str],
         cols = {c: [r.get(c) for r in rows] for c in names}
         out = anonymize_columns(cols, columns, anonymizers)
         write_jsonl(out_path, out)
+
+
+# -- schema inference (reference: JsonToPinotSchema / AvroSchemaToPinotSchema
+# CLI commands — derive a Schema from sample data) ----------------------------
+
+def infer_schema(path: str, table_name: Optional[str] = None,
+                 time_column: Optional[str] = None) -> "object":
+    """Infer a Schema from a CSV/JSONL sample: int/float columns become
+    metrics, strings become dimensions, lists become multi-value dimensions,
+    and a column named like a timestamp (or passed as `time_column`) becomes
+    the DATE_TIME field."""
+    from ..schema import DataType, FieldRole, FieldSpec, Schema
+    if path.endswith(".csv"):
+        with open(path, newline="") as f:
+            rows = list(csv.DictReader(f))
+        cols = {c: _maybe_numeric([r[c] for r in rows]) for c in (rows[0] if rows else [])}
+    else:
+        with open(path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        names: List[str] = []
+        for r in rows:  # union over ALL rows: later-appearing fields count too
+            for c in r:
+                if c not in names:
+                    names.append(c)
+        cols = {c: [r.get(c) for r in rows] for c in names}
+
+    def looks_time(name: str) -> bool:
+        n = name.lower()
+        return n in ("ts", "time", "timestamp", "date", "datetime") \
+            or n.endswith(("_ts", "_time", "_at", "_date", "timemillis"))
+
+    if time_column is not None and time_column not in cols:
+        raise ValueError(f"time column {time_column!r} not found in {path}")
+    fields = []
+    for name, vals in cols.items():
+        present = [v for v in vals if v is not None]
+        if name == time_column and not (
+                present and all(isinstance(v, int) and not isinstance(v, bool)
+                                for v in present)):
+            raise ValueError(
+                f"time column {time_column!r} must be integer epoch values; "
+                f"got {type(present[0]).__name__ if present else 'no values'} — "
+                "convert before inference (DATE_TIME columns are epoch-typed)")
+        if any(isinstance(v, list) for v in present):
+            inner = [x for v in present if isinstance(v, list) for x in v]
+            dt = DataType.INT if all(isinstance(x, int) for x in inner) \
+                else DataType.DOUBLE if all(isinstance(x, (int, float))
+                                            for x in inner) else DataType.STRING
+            fields.append(FieldSpec(name, dt, FieldRole.DIMENSION,
+                                    single_value=False))
+            continue
+        if all(isinstance(v, bool) for v in present) and present:
+            fields.append(FieldSpec(name, DataType.BOOLEAN, FieldRole.METRIC))
+        elif all(isinstance(v, int) and not isinstance(v, bool)
+                 for v in present) and present:
+            big = max(abs(v) for v in present) > (1 << 31) - 1
+            dt = DataType.LONG if big else DataType.INT
+            if name == time_column or (time_column is None and looks_time(name)):
+                fields.append(FieldSpec(name, DataType.LONG, FieldRole.DATE_TIME))
+            else:
+                fields.append(FieldSpec(name, dt, FieldRole.METRIC))
+        elif all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                 for v in present) and present:
+            fields.append(FieldSpec(name, DataType.DOUBLE, FieldRole.METRIC))
+        else:
+            fields.append(FieldSpec(name, DataType.STRING, FieldRole.DIMENSION))
+    return Schema(table_name or os.path.splitext(os.path.basename(path))[0],
+                  fields)

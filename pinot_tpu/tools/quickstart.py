@@ -1,0 +1,130 @@
+"""One-command demo clusters (reference: pinot-tools Quickstart family —
+Quickstart.java, RealtimeQuickStart, HybridQuickstart): boot real controller/
+server/broker processes, load sample data, run showcase queries, and leave the
+cluster serving so the user can explore with the CLI/clients/web UI.
+
+    python -m pinot_tpu.tools.admin quickstart --type batch
+    python -m pinot_tpu.tools.admin quickstart --type realtime
+    python -m pinot_tpu.tools.admin quickstart --type hybrid
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..schema import DataType, Schema, date_time, dimension, metric
+
+_SAMPLE_QUERIES = [
+    "SELECT COUNT(*) FROM trips",
+    "SELECT city, COUNT(*), SUM(fare) FROM trips GROUP BY city ORDER BY city LIMIT 10",
+    "SELECT city, AVG(fare) FROM trips WHERE fare > 20 GROUP BY city "
+    "ORDER BY AVG(fare) DESC LIMIT 3",
+    "SELECT PERCENTILE(fare, 95), DISTINCTCOUNTHLL(city) FROM trips",
+]
+
+
+def _schema() -> Schema:
+    return Schema("trips", [dimension("city", DataType.STRING),
+                            metric("fare", DataType.DOUBLE),
+                            date_time("ts", DataType.LONG)])
+
+
+def _rows(n: int, seed: int = 7) -> List[dict]:
+    from .datagen import columns_to_rows, generate_columns
+    cols = generate_columns(_schema(), n, seed=seed, cardinalities={"city": 8})
+    return columns_to_rows(cols)
+
+
+def _build_and_upload(cluster, rows, work_dir: str, name: str,
+                      table: str = "trips_OFFLINE") -> None:
+    from ..ingest.readers import rows_to_columns
+    from ..ingest.transform import TransformPipeline
+    from ..segment.writer import SegmentBuilder
+    cols = TransformPipeline(_schema()).apply(rows_to_columns(rows, _schema()))
+    seg_dir = SegmentBuilder(_schema()).build(cols, os.path.join(work_dir, "build"),
+                                              name)
+    cluster.controller.upload_segment(table, seg_dir)
+
+
+def _show_queries(cluster, queries=_SAMPLE_QUERIES, wait_rows: Optional[int] = None
+                  ) -> None:
+    if wait_rows is not None:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                got = cluster.query("SELECT COUNT(*) FROM trips")
+                n = got["resultTable"]["rows"][0][0]
+                if n >= wait_rows:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+    for sql in queries:
+        resp = cluster.query(sql)
+        table = resp.get("resultTable", {})
+        print(f"\n> {sql}")
+        print("  " + "\t".join(map(str, table.get("dataSchema", {})
+                                   .get("columnNames", []))))
+        for row in table.get("rows", []):
+            print("  " + "\t".join(map(str, row)))
+
+
+def run_quickstart(qtype: str = "batch", rows: int = 10_000,
+                   work_dir: Optional[str] = None,
+                   exit_after_queries: bool = False) -> int:
+    from ..cluster.process import ProcessCluster
+    from ..table import StreamConfig, TableConfig, TableType
+
+    work_dir = work_dir or tempfile.mkdtemp(prefix="pinot_tpu_quickstart_")
+    print(f"*** pinot_tpu {qtype} quickstart (work dir {work_dir}) ***")
+    log_broker = None
+    cluster = ProcessCluster(num_servers=1, work_dir=work_dir)
+    try:
+        cluster.controller.add_schema(_schema())
+        total = 0
+        if qtype in ("batch", "hybrid"):
+            cluster.controller.add_table(TableConfig("trips"))
+            data = _rows(rows)
+            _build_and_upload(cluster, data, work_dir, "trips_batch_0")
+            total += len(data)
+        if qtype in ("realtime", "hybrid"):
+            from ..ingest.kafkalite import LogBrokerClient, LogBrokerServer
+            log_broker = LogBrokerServer()
+            client = LogBrokerClient(log_broker.bootstrap)
+            client.create_topic("trips_topic", 1)
+            cfg = TableConfig(
+                "trips", table_type=TableType.REALTIME, time_column="ts",
+                stream=StreamConfig(
+                    stream_type="kafkalite", topic="trips_topic", decoder="json",
+                    properties={"bootstrap": log_broker.bootstrap},
+                    flush_threshold_rows=max(rows, 1000) * 2))
+            cluster.controller.add_table(cfg, num_partitions=1)
+            n_rt = rows // 2 if qtype == "hybrid" else rows
+            for row in _rows(n_rt, seed=11):
+                client.produce("trips_topic", json.dumps(row), partition=0)
+            client.close()
+            total += n_rt
+
+        _show_queries(cluster, wait_rows=total)
+        print(f"\ncontroller: {cluster.controller_url}")
+        print(f"broker:     {cluster.broker_url}")
+        print(f'try: python -m pinot_tpu.tools.admin query --broker '
+              f'{cluster.broker_url} --sql "SELECT COUNT(*) FROM trips"')
+        if exit_after_queries:
+            return 0
+        print("\nserving — Ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return 0
+    finally:
+        cluster.shutdown()
+        if log_broker is not None:
+            log_broker.stop()

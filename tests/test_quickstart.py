@@ -1,0 +1,165 @@
+"""Quickstart + new CLI commands (reference: Quickstart family, ShowClusterInfo,
+ChangeTableState, JsonToPinotSchema, LaunchDataIngestionJob)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.schema import DataType, FieldRole
+from pinot_tpu.tools.datagen import infer_schema
+
+
+def test_infer_schema_jsonl(tmp_path):
+    p = tmp_path / "rows.jsonl"
+    p.write_text(json.dumps({"city": "nyc", "fare": 1.5, "n": 3,
+                             "tags": ["a", "b"], "ts": 1_700_000_000_000}) + "\n" +
+                 json.dumps({"city": "sf", "fare": 2.0, "n": 4,
+                             "tags": ["c"], "ts": 1_700_000_100_000}) + "\n")
+    s = infer_schema(str(p), table_name="trips")
+    by_name = {f.name: f for f in s.fields}
+    assert by_name["city"].data_type == DataType.STRING
+    assert by_name["fare"].data_type == DataType.DOUBLE
+    assert by_name["n"].data_type == DataType.INT
+    assert by_name["tags"].single_value is False
+    assert by_name["ts"].role == FieldRole.DATE_TIME
+    assert s.name == "trips"
+
+
+def test_infer_schema_csv(tmp_path):
+    p = tmp_path / "rows.csv"
+    p.write_text("k,v,big\na,1.5,9999999999\nb,2,123\n")
+    s = infer_schema(str(p))
+    by_name = {f.name: f for f in s.fields}
+    assert by_name["k"].data_type == DataType.STRING
+    assert by_name["v"].data_type == DataType.DOUBLE
+    assert by_name["big"].data_type == DataType.LONG
+
+
+def test_table_state_disable_enable(tmp_path):
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.query.context import QueryValidationError
+    from pinot_tpu.schema import Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = Schema("t", [dimension("k"), metric("v", DataType.DOUBLE)])
+    cfg = TableConfig("t")
+    cluster.create_table(schema, cfg)
+    cluster.ingest_columns(cfg, {"k": ["a"], "v": np.array([1.0])})
+    assert cluster.query("SELECT COUNT(*) FROM t").rows[0][0] == 1
+
+    cluster.controller.set_table_state("t_OFFLINE", enabled=False)
+    with pytest.raises(QueryValidationError, match="disabled"):
+        cluster.query("SELECT COUNT(*) FROM t")
+    cluster.controller.set_table_state("t_OFFLINE", enabled=True)
+    assert cluster.query("SELECT COUNT(*) FROM t").rows[0][0] == 1
+    with pytest.raises(ValueError):
+        cluster.controller.set_table_state("nope_OFFLINE", enabled=False)
+
+
+def test_quickstart_batch_end_to_end(tmp_path, capsys):
+    from pinot_tpu.tools.quickstart import run_quickstart
+    rc = run_quickstart("batch", rows=500, work_dir=str(tmp_path),
+                        exit_after_queries=True)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SELECT COUNT(*) FROM trips" in out
+    assert "500" in out
+    assert "broker:" in out
+
+
+def test_quickstart_hybrid_end_to_end(tmp_path, capsys):
+    from pinot_tpu.tools.quickstart import run_quickstart
+    rc = run_quickstart("hybrid", rows=400, work_dir=str(tmp_path),
+                        exit_after_queries=True)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "600" in out  # 400 offline + 200 realtime rows
+
+
+def test_ingest_job_cli(tmp_path):
+    """LaunchDataIngestionJob over HTTP with a YAML spec."""
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+    from pinot_tpu.schema import Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+    from pinot_tpu.tools.admin import main
+    from conftest import wait_until
+
+    catalog = Catalog()
+    ctrl = Controller("c0", catalog, LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "c"))
+    csvc = ControllerService(ctrl)
+    cats = [RemoteCatalog(csvc.url, poll_timeout_s=1.0)]
+    node = ServerNode("server_0", cats[0], ControllerDeepStore(csvc.url),
+                      str(tmp_path / "s0"))
+    ssvc = ServerService(node)
+    cats.append(RemoteCatalog(csvc.url, poll_timeout_s=1.0))
+    bsvc = BrokerService(Broker("b0", cats[1]))
+    try:
+        schema = Schema("jobs", [dimension("k"), metric("v", DataType.DOUBLE)])
+        ctrl.add_schema(schema)
+        ctrl.add_table(TableConfig("jobs"))
+        data = tmp_path / "in.csv"
+        data.write_text("k,v\na,1.0\nb,2.0\na,3.0\n")
+        spec = tmp_path / "job.yaml"
+        spec.write_text(f"table: jobs_OFFLINE\ninputPaths:\n  - {data}\n")
+        rc = main(["ingest-job", "--controller", csvc.url, "--spec", str(spec)])
+        assert rc == 0
+        from pinot_tpu.cluster.process import BrokerClient
+        bc = BrokerClient(bsvc.url)
+        assert wait_until(lambda: bc.query("SELECT COUNT(*) FROM jobs")
+                          ["resultTable"]["rows"][0][0] == 3)
+        # cluster-info sees the table converged
+        rc = main(["cluster-info", "--controller", csvc.url])
+        assert rc == 0
+    finally:
+        for c in cats:
+            c.close()
+        for s in (csvc, ssvc, bsvc):
+            s.stop()
+
+
+def test_review_regressions(tmp_path):
+    """Covers: later-row JSONL fields, int-only time-column guard, ms-exact
+    calendar shifts, drop_table clearing operational flags."""
+    import numpy as np
+    from pinot_tpu.engine.expr import eval_expr
+    from pinot_tpu.sql.parser import Parser
+
+    # JSONL field appearing only in row 2 still infers
+    p = tmp_path / "r.jsonl"
+    p.write_text(json.dumps({"city": "nyc"}) + "\n" +
+                 json.dumps({"city": "sf", "fare": 2.0}) + "\n")
+    s = infer_schema(str(p))
+    assert {f.name for f in s.fields} == {"city", "fare"}
+
+    # non-integer explicit time column is rejected loudly
+    p2 = tmp_path / "r2.jsonl"
+    p2.write_text(json.dumps({"created_at": "2026-07-30", "v": 1}) + "\n")
+    with pytest.raises(ValueError, match="time column"):
+        infer_schema(str(p2), time_column="created_at")
+
+    # ms-exact calendar shift (float timestamp() truncation dropped 1 ms)
+    e = Parser("SELECT timestampadd('MONTH', 1, t) FROM x").parse().select[0][0]
+    out = eval_expr(e, {"t": np.array([539656225879], dtype=np.int64)})
+    assert int(out[0]) % 1000 == 879
+
+    # drop_table clears disabled state
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path / "cl"))
+    schema = Schema("t2", [dimension("k"), metric("v", DataType.DOUBLE)])
+    cluster.create_table(schema, TableConfig("t2"))
+    cluster.controller.set_table_state("t2_OFFLINE", enabled=False)
+    cluster.controller.drop_table("t2_OFFLINE")
+    cluster.create_table(schema, TableConfig("t2"))
+    cluster.ingest_columns(TableConfig("t2"), {"k": ["a"], "v": np.array([1.0])})
+    assert cluster.query("SELECT COUNT(*) FROM t2").rows[0][0] == 1
