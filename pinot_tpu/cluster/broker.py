@@ -14,7 +14,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
-from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from ..query.aggregates import make_agg
 from ..query.context import QueryContext, QueryValidationError, compile_query
@@ -303,11 +303,17 @@ class Broker:
                     continue
                 futures[self._pool.submit(_traced(handle, server_id), table, ctx,
                                           segments, tf)] = server_id
+            missing: Dict[str, Set[str]] = {}  # segment -> servers that missed it
             for fut in as_completed(futures):
                 server_id = futures[fut]
                 servers_queried += 1
                 try:
-                    partials.append(fut.result())
+                    partial = fut.result()
+                    partials.append(partial)
+                    if partial.served is not None:
+                        for seg in set(routing.get(server_id, ())) \
+                                - set(partial.served):
+                            missing.setdefault(seg, set()).add(server_id)
                 except Exception as e:
                     # partial results are surfaced, not fatal (reference:
                     # serversNotResponded -> exception in response metadata).
@@ -318,6 +324,16 @@ class Broker:
                     if not _is_backpressure(e):
                         self.routing.mark_server_unhealthy(server_id)
                         self.failure_detector.notify_unhealthy(server_id)
+            if missing:
+                # a replica mid segment-transition (commit adoption, move) can
+                # briefly serve without a segment it was routed — ONE retry
+                # round on the other replicas keeps results complete instead
+                # of silently short (counts must never regress mid-commit)
+                retry_partials, retry_failed = self._retry_missing(
+                    table, ctx, missing, tf, _traced)
+                partials.extend(retry_partials)
+                servers_queried += len(retry_partials) + retry_failed
+                servers_failed += retry_failed
 
         t_scatter = time.perf_counter()
         with span("reduce"):
@@ -340,6 +356,37 @@ class Broker:
             },
         })
         return result
+
+    def _retry_missing(self, table: str, ctx, missing: Dict[str, Set[str]],
+                       tf: Optional[str], traced) -> Tuple[List[SegmentResult], int]:
+        """One retry round for segments a routed replica didn't serve: dispatch
+        each to a different healthy replica, in parallel on the scatter pool
+        with per-server trace spans like the first round. Returns
+        (partials, failed retry-server count) — a crashed retry target counts
+        as a failed server (partial result) and leaves routing via the
+        failure detector, exactly like a first-round failure."""
+        by_server: Dict[str, List[str]] = {}
+        for seg, missed_on in missing.items():
+            for cand in self.routing.segment_candidates(table, seg):
+                if cand not in missed_on and cand in self._servers \
+                        and cand not in self.routing.unhealthy_servers():
+                    by_server.setdefault(cand, []).append(seg)
+                    break
+        futures = {self._pool.submit(traced(self._servers[s], s), table, ctx,
+                                     segs, tf): s
+                   for s, segs in by_server.items()}
+        out: List[SegmentResult] = []
+        failed = 0
+        for fut in as_completed(futures):
+            server_id = futures[fut]
+            try:
+                out.append(fut.result())
+            except Exception as e:
+                failed += 1
+                if not _is_backpressure(e):
+                    self.routing.mark_server_unhealthy(server_id)
+                    self.failure_detector.notify_unhealthy(server_id)
+        return out, failed
 
     def _handle_explain(self, ctx, physical: List[str]) -> ResultTable:
         """EXPLAIN PLAN: ask ONE server per physical table for its operator plan

@@ -137,6 +137,12 @@ class RoutingManager:
         with self._lock:
             self._unhealthy.discard(server)
 
+    def segment_candidates(self, table: str, segment: str) -> List[str]:
+        """Healthy-state candidate servers for one segment (broker retry)."""
+        with self._lock:
+            rt = self._tables.get(table)
+            return list(rt.segment_servers.get(segment, ())) if rt else []
+
     # -- query routing -----------------------------------------------------
     def route_query(self, table: str, ctx: Optional[QueryContext] = None,
                     extra_filter: Optional[Expr] = None) -> Dict[str, List[str]]:

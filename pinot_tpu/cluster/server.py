@@ -85,6 +85,7 @@ class ServerNode:
         self.tables: Dict[str, TableDataManager] = {}
         self._lock = threading.RLock()
         self._realtime_managers: Dict[str, object] = {}
+        self._load_locks: Dict[tuple, threading.Lock] = {}
         self.completion = completion  # LLCSegmentManager handle (in-proc or HTTP proxy)
         # lifecycle: STARTING -> UP -> SHUTTING_DOWN (reference: ServiceStatus +
         # BaseServerStarter's startupServiceStatusCheck gate)
@@ -262,6 +263,8 @@ class ServerNode:
         for seg_name in list(mgr.segment_names):
             if seg_name not in desired:
                 mgr.remove_segment(seg_name)
+                with self._lock:  # prune the load lock with the segment
+                    self._load_locks.pop((table, seg_name), None)
                 self.catalog.report_state(table, seg_name, self.instance_id, None)
 
         # CONSUMING segments removed from the ideal state (segment deletion,
@@ -285,6 +288,8 @@ class ServerNode:
             with self._lock:
                 handler = self._realtime_managers.pop(table, None)
                 self.tables.pop(table, None)
+                for key in [k for k in self._load_locks if k[0] == table]:
+                    del self._load_locks[key]
             if handler is not None:
                 handler.stop()
 
@@ -325,16 +330,33 @@ class ServerNode:
         return self._realtime_managers.get(table)
 
     def _load_online_segment(self, table: str, seg_name: str, mgr: TableDataManager) -> None:
-        meta = self.catalog.segments.get(table, {}).get(seg_name)
-        local_dir = os.path.join(self.data_dir, table, seg_name)
-        if not os.path.isdir(local_dir):
-            if meta is None or not meta.download_path:
-                raise FileNotFoundError(f"no deep-store path for {table}/{seg_name}")
-            tar_local = local_dir + ".tar.gz"
-            self.deepstore.download(meta.download_path, tar_local)
-            untar_segment(tar_local, os.path.dirname(local_dir))
-            os.remove(tar_local)
-        mgr.add_segment(seg_name, load_segment(local_dir))
+        # per-segment load lock (reference: SegmentLocks): concurrent
+        # reconciles — an ideal-state notify racing a rebalance notify — must
+        # not double-download/untar into the same directory (one thread's
+        # cleanup deletes the tar under the other, and a racing untar could be
+        # read half-written)
+        with self._segment_load_lock(table, seg_name):
+            meta = self.catalog.segments.get(table, {}).get(seg_name)
+            local_dir = os.path.join(self.data_dir, table, seg_name)
+            if not os.path.isdir(local_dir):
+                if meta is None or not meta.download_path:
+                    raise FileNotFoundError(f"no deep-store path for {table}/{seg_name}")
+                tar_local = f"{local_dir}.{threading.get_ident()}.tar.gz"
+                self.deepstore.download(meta.download_path, tar_local)
+                try:
+                    untar_segment(tar_local, os.path.dirname(local_dir))
+                finally:
+                    if os.path.exists(tar_local):
+                        os.remove(tar_local)
+            mgr.add_segment(seg_name, load_segment(local_dir))
+
+    def _segment_load_lock(self, table: str, seg_name: str) -> threading.Lock:
+        key = (table, seg_name)
+        with self._lock:
+            lock = self._load_locks.get(key)
+            if lock is None:
+                lock = self._load_locks[key] = threading.Lock()
+            return lock
 
     def add_local_segment(self, table: str, segment: ImmutableSegment) -> None:
         """Directly register an already-built local segment (used by realtime commit)."""
@@ -400,14 +422,19 @@ class ServerNode:
                     valid = upsert.valid_mask(seg.name, seg.num_docs) if upsert else None
                     results.append(self.executor.execute_segment(ctx, seg, valid))
             # include in-progress realtime docs when a consuming manager exists
+            served = [seg.name for seg in segments]
             if handler is not None:
                 with span("consuming"):
-                    results.extend(handler.consuming_results(ctx, segment_names))
+                    rt_results, rt_served = handler.consuming_results(
+                        ctx, segment_names)
+                results.extend(rt_results)
+                served.extend(rt_served)
         finally:
             mgr.release(segments)
         aggs = [make_agg(f) for f in ctx.aggregations]
         with span("merge"):
             merged = merge_segment_results(results, aggs)
+        merged.served = served
         # ServerMeter QUERIES / NUM_DOCS_SCANNED / NUM_SEGMENTS_QUERIED analogs
         reg.counter("pinot_server_queries", {"table": table}).inc()
         reg.counter("pinot_server_docs_scanned").inc(merged.num_docs_scanned)

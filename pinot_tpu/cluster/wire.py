@@ -219,6 +219,7 @@ def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
         "scalar": r.scalar,
         "rows": r.rows,
         "sortKeys": r.sort_keys,
+        "served": r.served,
         "trace": trace_spans,
     })
 
@@ -231,6 +232,7 @@ def decode_segment_result(data: bytes) -> SegmentResult:
     r.scalar = d["scalar"]
     r.rows = [tuple(row) if not isinstance(row, tuple) else row for row in d["rows"]]
     r.sort_keys = [tuple(k) if not isinstance(k, tuple) else k for k in d["sortKeys"]]
+    r.served = d.get("served")
     if d.get("trace"):
         r.trace_spans = d["trace"]  # spliced into the broker's trace by the caller
     return r

@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..query.context import QueryContext
 from ..query.reduce import SegmentResult
@@ -357,17 +357,26 @@ class RealtimeTableManager:
     # -- query integration -------------------------------------------------
     def consuming_results(self, ctx: QueryContext,
                           segment_names: Optional[Sequence[str]] = None
-                          ) -> List[SegmentResult]:
+                          ) -> Tuple[List[SegmentResult], List[str]]:
+        """(results, served names) — BOTH from one locked snapshot: serve/not
+        is decided once per segment, so the served list always matches what
+        the results actually include. Deciding them separately would let a
+        commit land in between, and the broker would retry a segment whose
+        rows were already counted (double count), or vice versa. A consumer
+        that commits mid-execution still serves consistently: its mutable
+        buffer outlives the commit until adoption."""
         with self._lock:
-            consumers = [c for name, c in self.consumers.items()
-                         if segment_names is None or name in segment_names]
+            snapshot = [(name, c) for name, c in self.consumers.items()
+                        if (segment_names is None or name in segment_names)
+                        and c.state not in (COMMITTED, DISCARDED)]
+        served = [name for name, _ in snapshot]
         out = []
-        for c in consumers:
-            if c.mutable.num_docs > 0 and c.state not in (COMMITTED, DISCARDED):
+        for _, c in snapshot:
+            if c.mutable.num_docs > 0:
                 valid = (self.upsert.valid_mask(c.segment_name, c.mutable.num_docs)
                          if self.upsert else None)
                 out.append(self.server.executor.execute_segment(ctx, c.mutable, valid))
-        return out
+        return out, served
 
     # -- deterministic drive (tests) / background loop (production) ---------
     def pump_all(self, max_messages: int = 10_000) -> int:

@@ -32,6 +32,10 @@ class SegmentResult:
     rows: List[Tuple] = field(default_factory=list)               # selection output rows
     sort_keys: List[Tuple] = field(default_factory=list)          # selection sort keys
     num_docs_scanned: int = 0
+    # segments this SERVER-LEVEL partial actually covered (None for per-segment
+    # results): lets the broker detect a replica that silently skipped a
+    # segment mid-transition and retry it on another replica
+    served: Optional[List[str]] = None
 
 
 def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> SegmentResult:
