@@ -357,6 +357,94 @@ class Broker:
         })
         return result
 
+    def stream_query(self, sql: str):
+        """Streaming results: yields ("schema", columns) once, then
+        ("rows", batch) per server partial as they arrive (reference: the
+        gRPC streaming transport for selection-only queries, server.proto:42 /
+        StreamingSelectionOnlyCombineOperator). Streamable = plain selection
+        with no aggregation/group/order/offset/join; anything else falls back
+        to one buffered batch of the normal path — same results, no streaming
+        win."""
+        from ..sql.parser import parse_query
+        from ..utils.metrics import get_registry
+        stmt = parse_query(sql)
+        stmt = self._rewrite_subqueries(stmt)
+        probe = compile_query(stmt)
+        streamable = (not stmt.joins and not probe.is_aggregation_query
+                      and not probe.distinct and not probe.order_by
+                      and not probe.offset)
+        if not streamable:
+            result = self.handle_query(sql, stmt=stmt)  # already parsed/rewritten
+            yield ("schema", result.columns)
+            if result.rows:
+                yield ("rows", result.rows)
+            return
+
+        physical = self._physical_tables(probe.table)
+        if not physical:
+            raise QueryValidationError(f"unknown table {probe.table!r}")
+        # same admin controls as the buffered path: disable + quota must not
+        # be bypassable through the streaming endpoint
+        if any(self.catalog.get_property(f"tableState/{t}") == "disabled"
+               for t in physical):
+            raise QueryValidationError(f"table {probe.table!r} is disabled")
+        if not self.quota.try_acquire_all(physical):
+            from ..query.scheduler import QueryRejectedError
+            get_registry().counter("pinot_broker_queries_throttled").inc()
+            raise QueryRejectedError(
+                f"table {probe.table!r} exceeded its query quota")
+        get_registry().counter("pinot_broker_queries").inc()
+        schema = self.catalog.schemas.get(
+            self.catalog.table_configs[physical[0]].name)
+        ctx = compile_query(stmt, schema)
+        empty = reduce_to_result(ctx, SegmentResult("selection"), [], [])
+        yield ("schema", empty.columns)
+        remaining = ctx.limit if ctx.limit is not None else UNBOUNDED_LIMIT
+        boundary = self._time_boundary(physical)
+        for table in physical:
+            if remaining <= 0:
+                return
+            tf_expr = _boundary_expr(boundary, table)
+            tf = to_sql(tf_expr) if tf_expr is not None else None
+            for server_id, segments in self.routing.route_query(
+                    table, ctx, extra_filter=tf_expr).items():
+                if remaining <= 0:
+                    return
+                handle = self._servers.get(server_id)
+                partial = None
+                missed: Set[str] = set(segments)
+                if handle is not None:
+                    try:
+                        partial = handle(table, ctx, segments, tf)
+                        missed = (set(segments) - set(partial.served)
+                                  if partial.served is not None else set())
+                    except Exception as e:
+                        if not _is_backpressure(e):
+                            self.routing.mark_server_unhealthy(server_id)
+                            self.failure_detector.notify_unhealthy(server_id)
+                if missed:
+                    # same completeness contract as the buffered path: retry
+                    # unserved segments on another replica; an export that
+                    # cannot be completed ERRORS instead of silently ending
+                    retries, failed = self._retry_missing(
+                        table, ctx, {s: {server_id} for s in missed}, tf,
+                        lambda h, s: h)
+                    if failed or sum(
+                            len(r.served or []) for r in retries) < len(missed):
+                        raise RuntimeError(
+                            f"streaming export incomplete: segments {sorted(missed)} "
+                            "unavailable on all replicas")
+                    for r in retries:
+                        rows = reduce_to_result(ctx, r, [], []).rows[:remaining]
+                        if rows:
+                            remaining -= len(rows)
+                            yield ("rows", rows)
+                if partial is not None:
+                    rows = reduce_to_result(ctx, partial, [], []).rows[:remaining]
+                    if rows:
+                        remaining -= len(rows)
+                        yield ("rows", rows)
+
     def _retry_missing(self, table: str, ctx, missing: Dict[str, Set[str]],
                        tf: Optional[str], traced) -> Tuple[List[SegmentResult], int]:
         """One retry round for segments a routed replica didn't serve: dispatch
@@ -553,7 +641,19 @@ class Broker:
                           else np.asarray(vals, dtype=object))
             return out
 
+        # shuffle width is per-query tunable (reference: the v2 engine's
+        # stage parallelism query options)
+        from ..multistage.runtime import DEFAULT_PARTITIONS
+        num_partitions = DEFAULT_PARTITIONS
+        for key, v in (stmt.options or {}).items():
+            if key.lower() in ("numpartitions", "stageparallelism"):
+                try:
+                    num_partitions = max(1, int(v))
+                except (TypeError, ValueError):
+                    raise QueryValidationError(
+                        f"OPTION({key}=...) must be an integer, got {v!r}") from None
         return execute_multistage(stmt, scan, schema_for,
+                                  num_partitions=num_partitions,
                                   stage_runner=stage_runner())
 
     def _physical_tables(self, raw_table: str) -> List[str]:

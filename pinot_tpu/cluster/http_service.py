@@ -86,6 +86,38 @@ class HttpService:
                     finally:
                         from ..auth import set_current_principal
                         set_current_principal(None)
+                if not isinstance(data, (bytes, bytearray)) and hasattr(data, "__iter__"):
+                    # streaming handler: iterator of byte chunks -> HTTP/1.1
+                    # chunked transfer (the gRPC-streaming analog for large
+                    # exports; see BrokerService queryStream)
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    def write_chunk(payload: bytes) -> None:
+                        self.wfile.write(f"{len(payload):x}\r\n".encode())
+                        self.wfile.write(payload)
+                        self.wfile.write(b"\r\n")
+                    try:
+                        for chunk in data:
+                            if chunk:
+                                write_chunk(chunk)
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass  # client went away mid-stream
+                    except Exception as e:
+                        # the 200/chunked headers are already on the wire — a
+                        # mid-stream failure must still terminate the stream
+                        # cleanly, with the error as the final event (clients
+                        # check for it) instead of an abrupt IncompleteRead
+                        try:
+                            write_chunk(json.dumps(
+                                {"error": f"{type(e).__name__}: {e}"}
+                            ).encode() + b"\n")
+                            self.wfile.write(b"0\r\n\r\n")
+                        except (BrokenPipeError, ConnectionResetError):
+                            pass
+                    return
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))

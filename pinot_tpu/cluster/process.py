@@ -209,6 +209,32 @@ class BrokerClient:
         return post_json(f"{self.url}/query", {"sql": sql}, timeout=timeout,
                          token=self.token)
 
+    def query_stream(self, sql: str, timeout: float = 600.0):
+        """Incremental results: yields the columns list first, then row
+        batches as the broker streams them (chunked HTTP; reference: the gRPC
+        streaming query endpoint). Use for large exports — rows are consumed
+        without buffering the full result anywhere."""
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.url}/queryStream",
+            data=json.dumps({"sql": sql}).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.token}"}
+                        if self.token else {})})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                d = json.loads(line)
+                if "error" in d:
+                    # a mid-stream failure arrives as the final event (headers
+                    # were already 200/chunked by then)
+                    raise RuntimeError(f"stream failed: {d['error']}")
+                if "columns" in d:
+                    yield ("schema", d["columns"])
+                else:
+                    yield ("rows", d["rows"])
+
 
 class ProcessCluster:
     """Spawn controller + N servers + broker as OS processes and wait for ready.

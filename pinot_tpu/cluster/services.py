@@ -459,6 +459,7 @@ class BrokerService:
         self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
         self.http = HttpService(host, port, access_control=access_control)
         self.http.route("POST", "query", self._query)
+        self.http.route("POST", "queryStream", self._query_stream)
         self.http.route("GET", "health",
                         lambda p, q, b: json_response({"status": "OK"}))
         self.http.route("GET", "metrics", _metrics_route)
@@ -539,3 +540,31 @@ class BrokerService:
                     require_table_access(table, "READ")
         result = self.broker.handle_query(sql, stmt=stmt)
         return json_response(result.to_json())
+
+    def _query_stream(self, parts, params, body):
+        """POST /queryStream — JSON-lines over chunked HTTP: one
+        {"columns": [...]} line, then {"rows": [...]} lines per batch
+        (reference: the gRPC streaming endpoint server.proto:42)."""
+        d = json.loads(body.decode())
+        sql = d["sql"]
+        from ..auth import current_principal, require_table_access
+        if current_principal() is not None:
+            from ..sql.parser import parse_query
+            try:
+                stmt = parse_query(sql)
+            except Exception:
+                stmt = None
+            if stmt is not None:
+                for table in [stmt.table] + [j.table for j in stmt.joins]:
+                    require_table_access(table, "READ")
+
+        def gen():
+            from ..query.result import _jsonify
+            for kind, payload in self.broker.stream_query(sql):
+                if kind == "schema":
+                    yield (json.dumps({"columns": payload}) + "\n").encode()
+                else:
+                    yield (json.dumps(
+                        {"rows": [[_jsonify(v) for v in r] for r in payload]})
+                        + "\n").encode()
+        return 200, "application/x-ndjson", gen()

@@ -266,3 +266,29 @@ def test_cluster_join_query(tmp_path):
     for label, s, c in res.rows:
         assert s == pytest.approx(want[label][0], rel=1e-6)
         assert c == want[label][1]
+
+
+def test_num_partitions_query_option(tmp_path):
+    """OPTION(numPartitions=N) tunes the join shuffle width per query."""
+    import numpy as np
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    sa = Schema("pa", [dimension("k"), metric("x", DataType.DOUBLE)])
+    sb = Schema("pb", [dimension("k"), dimension("g")])
+    cluster.create_table(sa, TableConfig("pa"))
+    cluster.create_table(sb, TableConfig("pb"))
+    cluster.ingest_columns(TableConfig("pa"),
+                           {"k": [f"k{i % 7}" for i in range(50)],
+                            "x": np.arange(50, dtype=np.float64)})
+    cluster.ingest_columns(TableConfig("pb"),
+                           {"k": [f"k{i}" for i in range(7)],
+                            "g": [f"g{i % 2}" for i in range(7)]})
+    base = cluster.query("SELECT pb.g, SUM(pa.x) FROM pa JOIN pb ON pa.k = pb.k "
+                         "GROUP BY pb.g ORDER BY pb.g LIMIT 10").rows
+    for n in (1, 3, 16):
+        got = cluster.query(
+            "SELECT pb.g, SUM(pa.x) FROM pa JOIN pb ON pa.k = pb.k "
+            f"GROUP BY pb.g ORDER BY pb.g LIMIT 10 OPTION(numPartitions={n})").rows
+        assert got == base, (n, got, base)

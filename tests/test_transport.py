@@ -269,3 +269,97 @@ def test_process_cluster_query_and_server_death(tmp_path):
         # a retry routes around the dead server (unhealthy exclusion)
         resp2 = cluster.query("SELECT COUNT(*) FROM trips")
         assert resp2["resultTable"]["rows"][0][0] == count
+
+
+def test_query_stream_selection(tmp_path):
+    """Chunked streaming export (reference: gRPC streaming selection-only
+    path): rows arrive in per-server batches; non-streamable shapes fall back
+    to one buffered batch with identical results."""
+    import numpy as np
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.process import BrokerClient
+    from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+    from pinot_tpu.segment.writer import SegmentBuilder
+    from conftest import wait_until
+
+    catalog = Catalog()
+    ctrl = Controller("c0", catalog, LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "c"))
+    csvc = ControllerService(ctrl)
+    cats = [RemoteCatalog(csvc.url, poll_timeout_s=1.0)]
+    node = ServerNode("server_0", cats[0], ControllerDeepStore(csvc.url),
+                      str(tmp_path / "s0"))
+    ssvc = ServerService(node)
+    cats.append(RemoteCatalog(csvc.url, poll_timeout_s=1.0))
+    bsvc = BrokerService(Broker("b0", cats[1]))
+    try:
+        schema = Schema("exp", [dimension("k"), metric("v", DataType.DOUBLE)])
+        ctrl.add_schema(schema)
+        ctrl.add_table(TableConfig("exp"))
+        n = 500
+        for i in range(2):
+            seg = SegmentBuilder(schema).build(
+                {"k": [f"k{j % 9}" for j in range(n)],
+                 "v": np.arange(n, dtype=np.float64) + i},
+                str(tmp_path / "b"), f"exp_{i}")
+            ctrl.upload_segment("exp_OFFLINE", seg)
+        bc = BrokerClient(bsvc.url)
+        wait_until(lambda: bc.query("SELECT COUNT(*) FROM exp")
+                   ["resultTable"]["rows"][0][0] == 2 * n)
+
+        got_rows, cols = [], None
+        for kind, payload in bc.query_stream(
+                "SELECT k, v FROM exp WHERE v >= 1 LIMIT 100000"):
+            if kind == "schema":
+                cols = payload
+            else:
+                got_rows.extend(payload)
+        assert cols == ["k", "v"]
+        buffered = bc.query("SELECT COUNT(*) FROM exp WHERE v >= 1")
+        assert len(got_rows) == buffered["resultTable"]["rows"][0][0]
+
+        # LIMIT respected mid-stream
+        limited = []
+        for kind, payload in bc.query_stream("SELECT k FROM exp LIMIT 37"):
+            if kind == "rows":
+                limited.extend(payload)
+        assert len(limited) == 37
+
+        # non-streamable shape (aggregation): buffered fallback, same results
+        agg_rows = []
+        for kind, payload in bc.query_stream(
+                "SELECT k, COUNT(*) FROM exp GROUP BY k ORDER BY k LIMIT 20"):
+            if kind == "rows":
+                agg_rows.extend(payload)
+        want = bc.query("SELECT k, COUNT(*) FROM exp GROUP BY k "
+                        "ORDER BY k LIMIT 20")["resultTable"]["rows"]
+        assert agg_rows == want
+    finally:
+        for c in cats:
+            c.close()
+        for s in (csvc, ssvc, bsvc):
+            s.stop()
+
+
+def test_query_stream_errors_cleanly_on_bad_table(tmp_path):
+    """A failure after the 200/chunked headers surfaces as a final error event,
+    not an abrupt connection close."""
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.process import BrokerClient
+    from pinot_tpu.cluster.services import BrokerService
+    bsvc = BrokerService(Broker("b0", Catalog()))
+    try:
+        bc = BrokerClient(bsvc.url)
+        with pytest.raises(RuntimeError, match="stream failed"):
+            list(bc.query_stream("SELECT k FROM nosuchtable LIMIT 5"))
+    finally:
+        bsvc.stop()
