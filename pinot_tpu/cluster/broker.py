@@ -357,7 +357,7 @@ class Broker:
         })
         return result
 
-    def stream_query(self, sql: str):
+    def stream_query(self, sql: str, stmt=None):
         """Streaming results: yields ("schema", columns) once, then
         ("rows", batch) per server partial as they arrive (reference: the
         gRPC streaming transport for selection-only queries, server.proto:42 /
@@ -367,12 +367,13 @@ class Broker:
         win."""
         from ..sql.parser import parse_query
         from ..utils.metrics import get_registry
-        stmt = parse_query(sql)
+        if stmt is None:
+            stmt = parse_query(sql)
         stmt = self._rewrite_subqueries(stmt)
         probe = compile_query(stmt)
         streamable = (not stmt.joins and not probe.is_aggregation_query
                       and not probe.distinct and not probe.order_by
-                      and not probe.offset)
+                      and not probe.offset and not probe.explain)
         if not streamable:
             result = self.handle_query(sql, stmt=stmt)  # already parsed/rewritten
             yield ("schema", result.columns)
@@ -429,11 +430,18 @@ class Broker:
                     retries, failed = self._retry_missing(
                         table, ctx, {s: {server_id} for s in missed}, tf,
                         lambda h, s: h)
-                    if failed or sum(
-                            len(r.served or []) for r in retries) < len(missed):
+                    explicit = [r for r in retries if r.served is not None]
+                    covered = set().union(*[set(r.served) for r in explicit]) \
+                        if explicit else set()
+                    # a served-less partial (older peer) can't prove coverage;
+                    # only declare the export incomplete on EVIDENCE — a
+                    # failed retry target, or explicit served lists that still
+                    # leave segments uncovered
+                    unknown = len(retries) > len(explicit)
+                    if failed or (not unknown and missed - covered):
                         raise RuntimeError(
-                            f"streaming export incomplete: segments {sorted(missed)} "
-                            "unavailable on all replicas")
+                            f"streaming export incomplete: segments "
+                            f"{sorted(missed - covered)} unavailable on all replicas")
                     for r in retries:
                         rows = reduce_to_result(ctx, r, [], []).rows[:remaining]
                         if rows:

@@ -122,10 +122,73 @@ def run_broker(controller_url: str, instance_id: str, run_dir: str,
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
 
 
+def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
+                        config_path: str = "", block: bool = True):
+    """All roles in ONE process from one bootstrap config (reference:
+    PinotServiceManager / StartServiceManagerCommand — the quickstarts' and
+    small deployments' topology). Controller, one server, and a broker share
+    the process; the server/broker still talk to the controller over its HTTP
+    catalog so the wiring matches a distributed deployment."""
+    from .broker import Broker
+    from .catalog import Catalog
+    from .controller import Controller
+    from .deepstore import create_fs
+    from .remote import ControllerDeepStore, RemoteCatalog, RemoteCompletion
+    from .server import ServerNode
+    from .services import BrokerService, ControllerService, ServerService
+
+    os.makedirs(run_dir, exist_ok=True)
+    cfg = _load_config(config_path, port, "controller.port")
+    access_control = _setup_auth(cfg)
+    catalog = Catalog()
+    deepstore = create_fs(cfg.get_str(
+        "controller.deepstore",
+        f"local://{os.path.join(work_dir, 'deepstore')}"))
+    controller = Controller("controller_0", catalog, deepstore,
+                            os.path.join(work_dir, "controller"))
+    csvc = ControllerService(controller, port=cfg.get_int("controller.port", 0),
+                             access_control=access_control)
+    controller.start_periodic_tasks()
+
+    from ..query.scheduler import scheduler_from_config
+    server_catalog = RemoteCatalog(csvc.url)
+    server = ServerNode("server_0", server_catalog,
+                        ControllerDeepStore(csvc.url),
+                        os.path.join(work_dir, "server_0"),
+                        tags=cfg.get_list("server.tenant.tags") or None,
+                        completion=RemoteCompletion(csvc.url),
+                        scheduler=scheduler_from_config(cfg),
+                        auto_consume=True)
+    ssvc = ServerService(server, port=cfg.get_int("server.port", 0),
+                         access_control=access_control)
+
+    broker_catalog = RemoteCatalog(csvc.url)
+    broker = Broker("broker_0", broker_catalog,
+                    max_scatter_threads=cfg.get_int("broker.scatter.threads", 8))
+    bsvc = BrokerService(broker, port=cfg.get_int("broker.port", 0),
+                         access_control=access_control)
+    _write_ready(run_dir, "controller_0", {"url": csvc.url})
+    _write_ready(run_dir, "server_0", {"url": ssvc.url})
+    _write_ready(run_dir, "broker_0", {"url": bsvc.url})
+    handles = {"controller": csvc, "server": ssvc, "broker": bsvc,
+               "catalogs": (server_catalog, broker_catalog),
+               "controller_obj": controller}
+    if block:
+        signal.sigwait({signal.SIGTERM, signal.SIGINT})
+        # graceful teardown, same order as the per-role processes: server
+        # first (consuming handlers flush/stop), then periodic tasks/watchers
+        server.shutdown()
+        controller.stop_periodic_tasks()
+        for c in (server_catalog, broker_catalog):
+            c.close()
+        return None
+    return handles
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="pinot_tpu.cluster.process")
     p.add_argument("--role", required=True,
-                   choices=["controller", "server", "broker"])
+                   choices=["controller", "server", "broker", "service-manager"])
     p.add_argument("--controller-url", default="")
     p.add_argument("--instance-id", default="")
     p.add_argument("--work-dir", default="")
@@ -138,6 +201,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     elif a.role == "server":
         run_server(a.controller_url, a.instance_id, a.work_dir, a.run_dir, a.port,
                    config_path=a.config)
+    elif a.role == "service-manager":
+        run_service_manager(a.work_dir, a.run_dir, a.port, config_path=a.config)
     else:
         run_broker(a.controller_url, a.instance_id, a.run_dir, a.port,
                    config_path=a.config)

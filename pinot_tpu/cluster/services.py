@@ -548,6 +548,7 @@ class BrokerService:
         d = json.loads(body.decode())
         sql = d["sql"]
         from ..auth import current_principal, require_table_access
+        stmt = None
         if current_principal() is not None:
             from ..sql.parser import parse_query
             try:
@@ -558,9 +559,9 @@ class BrokerService:
                 for table in [stmt.table] + [j.table for j in stmt.joins]:
                     require_table_access(table, "READ")
 
-        def gen():
+        def gen(stmt=stmt):
             from ..query.result import _jsonify
-            for kind, payload in self.broker.stream_query(sql):
+            for kind, payload in self.broker.stream_query(sql, stmt=stmt):
                 if kind == "schema":
                     yield (json.dumps({"columns": payload}) + "\n").encode()
                 else:

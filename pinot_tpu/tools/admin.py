@@ -154,6 +154,13 @@ def build_parser() -> argparse.ArgumentParser:
     role("start-server")
     role("start-broker")
 
+    sp = sub.add_parser("start-service-manager")
+    sp.add_argument("--work-dir", required=True)
+    sp.add_argument("--run-dir", required=True)
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--config", default="")
+    sp.set_defaults(fn=cmd_start_service_manager)
+
     sp = sub.add_parser("add-schema")
     sp.add_argument("--controller", required=True)
     sp.add_argument("--file", required=True)
@@ -288,6 +295,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ops", required=True, help="YAML op-sequence file")
     sp.set_defaults(fn=cmd_compat_check)
     return p
+
+
+def cmd_start_service_manager(args) -> int:
+    """Reference: StartServiceManagerCommand — all roles in one process."""
+    from ..cluster.process import run_service_manager
+    run_service_manager(args.work_dir, args.run_dir, args.port, args.config)
+    return 0
 
 
 def cmd_quickstart(args) -> int:

@@ -163,3 +163,36 @@ def test_review_regressions(tmp_path):
     cluster.create_table(schema, TableConfig("t2"))
     cluster.ingest_columns(TableConfig("t2"), {"k": ["a"], "v": np.array([1.0])})
     assert cluster.query("SELECT COUNT(*) FROM t2").rows[0][0] == 1
+
+
+def test_service_manager_all_roles_one_process(tmp_path):
+    """Reference: PinotServiceManager — controller + server + broker in one
+    process from one bootstrap; full ingest->query lifecycle works."""
+    import numpy as np
+    from pinot_tpu.cluster.process import BrokerClient, ControllerClient, \
+        run_service_manager
+    from pinot_tpu.schema import Schema, dimension, metric
+    from pinot_tpu.segment.writer import SegmentBuilder
+    from pinot_tpu.table import TableConfig
+    from conftest import wait_until
+
+    handles = run_service_manager(str(tmp_path / "work"), str(tmp_path / "run"),
+                                  block=False)
+    try:
+        ctrl = ControllerClient(handles["controller"].url)
+        schema = Schema("svc", [dimension("k"), metric("v", DataType.DOUBLE)])
+        ctrl.add_schema(schema)
+        ctrl.add_table(TableConfig("svc"))
+        seg = SegmentBuilder(schema).build(
+            {"k": ["a", "b"], "v": np.array([1.0, 2.0])},
+            str(tmp_path / "b"), "svc_0")
+        ctrl.upload_segment("svc_OFFLINE", seg)
+        bc = BrokerClient(handles["broker"].url)
+        assert wait_until(lambda: bc.query("SELECT SUM(v) FROM svc")
+                          ["resultTable"]["rows"][0][0] == 3.0)
+    finally:
+        handles["controller_obj"].stop_periodic_tasks()
+        for c in handles["catalogs"]:
+            c.close()
+        for role in ("controller", "server", "broker"):
+            handles[role].stop()
