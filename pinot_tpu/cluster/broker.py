@@ -329,10 +329,10 @@ class Broker:
                 # briefly serve without a segment it was routed — ONE retry
                 # round on the other replicas keeps results complete instead
                 # of silently short (counts must never regress mid-commit)
-                retry_partials, retry_failed = self._retry_missing(
+                retry_results, retry_failed = self._retry_missing(
                     table, ctx, missing, tf, _traced)
-                partials.extend(retry_partials)
-                servers_queried += len(retry_partials) + retry_failed
+                partials.extend(r for r, _ in retry_results)
+                servers_queried += len(retry_results) + retry_failed
                 servers_failed += retry_failed
 
         t_scatter = time.perf_counter()
@@ -430,19 +430,19 @@ class Broker:
                     retries, failed = self._retry_missing(
                         table, ctx, {s: {server_id} for s in missed}, tf,
                         lambda h, s: h)
-                    explicit = [r for r in retries if r.served is not None]
-                    covered = set().union(*[set(r.served) for r in explicit]) \
-                        if explicit else set()
-                    # a served-less partial (older peer) can't prove coverage;
-                    # only declare the export incomplete on EVIDENCE — a
-                    # failed retry target, or explicit served lists that still
-                    # leave segments uncovered
-                    unknown = len(retries) > len(explicit)
-                    if failed or (not unknown and missed - covered):
+                    # per-target coverage: an explicit served list is positive
+                    # evidence; a served-less partial (older peer) is assumed
+                    # to have covered exactly the segments dispatched to IT —
+                    # never forgiveness for segments sent elsewhere
+                    uncovered = set(missed)
+                    for r, segs in retries:
+                        uncovered -= (set(segs) if r.served is None
+                                      else set(r.served))
+                    if failed or uncovered:
                         raise RuntimeError(
                             f"streaming export incomplete: segments "
-                            f"{sorted(missed - covered)} unavailable on all replicas")
-                    for r in retries:
+                            f"{sorted(uncovered)} unavailable on all replicas")
+                    for r, _ in retries:
                         rows = reduce_to_result(ctx, r, [], []).rows[:remaining]
                         if rows:
                             remaining -= len(rows)
@@ -454,13 +454,14 @@ class Broker:
                         yield ("rows", rows)
 
     def _retry_missing(self, table: str, ctx, missing: Dict[str, Set[str]],
-                       tf: Optional[str], traced) -> Tuple[List[SegmentResult], int]:
+                       tf: Optional[str], traced
+                       ) -> Tuple[List[Tuple[SegmentResult, List[str]]], int]:
         """One retry round for segments a routed replica didn't serve: dispatch
         each to a different healthy replica, in parallel on the scatter pool
         with per-server trace spans like the first round. Returns
-        (partials, failed retry-server count) — a crashed retry target counts
-        as a failed server (partial result) and leaves routing via the
-        failure detector, exactly like a first-round failure."""
+        ([(partial, segments dispatched to that target)], failed count) — a
+        crashed retry target counts as a failed server (partial result) and
+        leaves routing via the failure detector, like a first-round failure."""
         by_server: Dict[str, List[str]] = {}
         for seg, missed_on in missing.items():
             for cand in self.routing.segment_candidates(table, seg):
@@ -469,14 +470,14 @@ class Broker:
                     by_server.setdefault(cand, []).append(seg)
                     break
         futures = {self._pool.submit(traced(self._servers[s], s), table, ctx,
-                                     segs, tf): s
+                                     segs, tf): (s, segs)
                    for s, segs in by_server.items()}
-        out: List[SegmentResult] = []
+        out: List[Tuple[SegmentResult, List[str]]] = []
         failed = 0
         for fut in as_completed(futures):
-            server_id = futures[fut]
+            server_id, segs = futures[fut]
             try:
-                out.append(fut.result())
+                out.append((fut.result(), segs))
             except Exception as e:
                 failed += 1
                 if not _is_backpressure(e):

@@ -75,8 +75,10 @@ def run_controller(work_dir: str, run_dir: str, port: int = 0,
                             os.path.join(work_dir, "controller"))
     svc = ControllerService(controller, port=cfg.get_int("controller.port", 0),
                             access_control=access_control)
+    controller.start_periodic_tasks()  # retention/repair/relocation/status
     _write_ready(run_dir, "controller_0", {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    controller.stop_periodic_tasks()
 
 
 def run_server(controller_url: str, instance_id: str, work_dir: str,
@@ -172,7 +174,7 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
     _write_ready(run_dir, "broker_0", {"url": bsvc.url})
     handles = {"controller": csvc, "server": ssvc, "broker": bsvc,
                "catalogs": (server_catalog, broker_catalog),
-               "controller_obj": controller}
+               "controller_obj": controller, "server_obj": server}
     if block:
         signal.sigwait({signal.SIGTERM, signal.SIGINT})
         # graceful teardown, same order as the per-role processes: server

@@ -83,6 +83,9 @@ class ControllerService:
         s.route("GET", "segmentsMeta", self._segments_meta)
         s.route("POST", "reload", self._reload_table, action="WRITE")
         s.route("GET", "tenants", self._list_tenants)
+        s.route("GET", "clusterConfigs", self._get_cluster_configs)
+        s.route("POST", "clusterConfigs", self._set_cluster_config,
+                action="ADMIN")
         s.route("POST", "tableState", self._table_state, action="ADMIN")
         s.route("POST", "instanceTags", self._update_instance_tags, action="ADMIN")
         s.route("POST", "pauseConsumption", self._pause_consumption, action="ADMIN")
@@ -273,6 +276,23 @@ class ControllerService:
             return error_response(f"unknown table {parts[0]}", 404)
         self.controller.reload_table(parts[0])
         return json_response({"status": "OK", "table": parts[0]})
+
+    def _get_cluster_configs(self, parts, params, body):
+        """GET /clusterConfigs (reference: /cluster/configs +
+        OperateClusterConfigCommand) — cluster-level dynamic settings, stored
+        in the catalog property store under clusterConfig/."""
+        with self.catalog._lock:
+            out = {k.split("/", 1)[1]: v for k, v in self.catalog.properties.items()
+                   if k.startswith("clusterConfig/")}
+        return json_response({"clusterConfigs": out})
+
+    def _set_cluster_config(self, parts, params, body):
+        """POST /clusterConfigs with {"key": ..., "value": ...} (value null
+        deletes)."""
+        d = json.loads(body.decode())
+        self.catalog.put_property(f"clusterConfig/{d['key']}", d.get("value"))
+        return json_response({"status": "OK", "key": d["key"],
+                              "value": d.get("value")})
 
     def _table_state(self, parts, params, body):
         """POST /tableState/{table}?state=enable|disable (reference:

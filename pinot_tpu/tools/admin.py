@@ -269,6 +269,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--state", required=True, choices=["enable", "disable"])
     sp.set_defaults(fn=cmd_change_table_state)
 
+    sp = sub.add_parser("cluster-config")
+    sp.add_argument("--controller", required=True)
+    sp.add_argument("--set", default=None, help="key=value (omit to list)")
+    sp.add_argument("--delete", default=None, help="key to delete")
+    sp.set_defaults(fn=cmd_cluster_config)
+
     sp = sub.add_parser("drop-table")
     sp.add_argument("--controller", required=True)
     sp.add_argument("--table", required=True, help="table name with type")
@@ -442,6 +448,23 @@ def cmd_change_table_state(args) -> int:
     out = http_call("POST", f"{c.url}/tableState/{args.table}?state={args.state}",
                     b"{}", token=c.token)
     _print(_json.loads(out.decode()))
+    return 0
+
+
+def cmd_cluster_config(args) -> int:
+    """Reference: OperateClusterConfigCommand (GET/POST/DELETE cluster configs)."""
+    from ..cluster.http_service import get_json, post_json
+    from ..cluster.process import ControllerClient
+    c = ControllerClient(args.controller)
+    if args.set:
+        key, _, value = args.set.partition("=")
+        _print(post_json(f"{c.url}/clusterConfigs",
+                         {"key": key, "value": value}, token=c.token))
+    elif args.delete:
+        _print(post_json(f"{c.url}/clusterConfigs",
+                         {"key": args.delete, "value": None}, token=c.token))
+    else:
+        _print(get_json(f"{c.url}/clusterConfigs", token=c.token))
     return 0
 
 

@@ -191,8 +191,31 @@ def test_service_manager_all_roles_one_process(tmp_path):
         assert wait_until(lambda: bc.query("SELECT SUM(v) FROM svc")
                           ["resultTable"]["rows"][0][0] == 3.0)
     finally:
+        handles["server_obj"].shutdown()
         handles["controller_obj"].stop_periodic_tasks()
         for c in handles["catalogs"]:
             c.close()
         for role in ("controller", "server", "broker"):
             handles[role].stop()
+
+
+def test_cluster_config_roundtrip(tmp_path):
+    """Reference: OperateClusterConfig / /cluster/configs REST."""
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.http_service import get_json, post_json
+    from pinot_tpu.cluster.services import ControllerService
+    ctrl = Controller("c0", Catalog(), LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "c"))
+    csvc = ControllerService(ctrl)
+    try:
+        post_json(f"{csvc.url}/clusterConfigs",
+                  {"key": "default.retention.days", "value": "30"})
+        got = get_json(f"{csvc.url}/clusterConfigs")["clusterConfigs"]
+        assert got == {"default.retention.days": "30"}
+        post_json(f"{csvc.url}/clusterConfigs",
+                  {"key": "default.retention.days", "value": None})
+        assert get_json(f"{csvc.url}/clusterConfigs")["clusterConfigs"] == {}
+    finally:
+        csvc.stop()
