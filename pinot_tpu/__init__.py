@@ -7,4 +7,25 @@ is jax.jit/Pallas compiled, multi-segment combine uses shard_map + ICI collectiv
 control plane (catalog, routing, ingestion FSMs) is host-side Python. See SURVEY.md.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+
+def __getattr__(name):
+    # lazy top-level conveniences: `pinot_tpu.connect` / `QuickCluster` /
+    # `execute_query` without importing jax at package-import time
+    if name == "connect":
+        from .client import connect
+        return connect
+    if name == "QuickCluster":
+        from .cluster import QuickCluster
+        return QuickCluster
+    if name == "execute_query":
+        from .query.executor import execute_query
+        return execute_query
+    if name == "Schema":
+        from .schema import Schema
+        return Schema
+    if name == "TableConfig":
+        from .table import TableConfig
+        return TableConfig
+    raise AttributeError(f"module 'pinot_tpu' has no attribute {name!r}")
