@@ -381,6 +381,24 @@ class ProcessCluster:
             proc.kill()
             proc.wait()
 
+    def restart_server(self, instance_id: str) -> str:
+        """Start a fresh server process under the same instance id (reference:
+        server restart recovery — it re-registers, reloads its assigned
+        segments from the deep store, and resumes consuming from the
+        checkpointed offsets). Returns the new process's URL."""
+        proc = self.procs.get(instance_id)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        ready = os.path.join(self.run_dir, f"{instance_id}.ready")
+        if os.path.exists(ready):
+            os.remove(ready)  # _await_ready must see the NEW process's file
+        self._spawn(instance_id, ["--role", "server",
+                                  "--instance-id", instance_id,
+                                  "--controller-url", self.controller_url,
+                                  "--work-dir", self.work_dir])
+        return self._await_ready(instance_id)
+
     def shutdown(self) -> None:
         for proc in self.procs.values():
             if proc.poll() is None:

@@ -363,3 +363,44 @@ def test_query_stream_errors_cleanly_on_bad_table(tmp_path):
             list(bc.query_stream("SELECT k FROM nosuchtable LIMIT 5"))
     finally:
         bsvc.stop()
+
+
+def test_server_restart_recovers_segments(tmp_path):
+    """Kill -9 a server, restart it under the same id: it re-registers,
+    reloads its assigned segments from the deep store, and full (non-partial)
+    results come back (reference: server restart recovery via deep-store
+    download + Helix re-registration; SURVEY §5 checkpoint/resume)."""
+    import numpy as np
+    from pinot_tpu.cluster.process import ProcessCluster
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment.writer import SegmentBuilder
+    from pinot_tpu.table import TableConfig
+    from conftest import wait_until
+
+    schema = Schema("rec", [dimension("k"), metric("v", DataType.DOUBLE)])
+    with ProcessCluster(num_servers=1, work_dir=str(tmp_path)) as cluster:
+        cluster.controller.add_schema(schema)
+        cluster.controller.add_table(TableConfig("rec"))
+        for i in range(2):
+            seg = SegmentBuilder(schema).build(
+                {"k": [f"k{j % 4}" for j in range(300)],
+                 "v": np.arange(300, dtype=np.float64)},
+                str(tmp_path / "b"), f"rec_{i}")
+            cluster.controller.upload_segment("rec_OFFLINE", seg)
+        assert wait_until(lambda: cluster.query("SELECT COUNT(*) FROM rec")
+                          ["resultTable"]["rows"][0][0] == 600)
+
+        cluster.kill_server("server_0")
+
+        def partial_now():
+            r = cluster.query("SELECT COUNT(*) FROM rec")
+            return r.get("partialResult") is True
+        assert wait_until(partial_now, timeout=30)
+
+        cluster.restart_server("server_0")
+
+        def full_again():
+            r = cluster.query("SELECT COUNT(*) FROM rec")
+            return (r["resultTable"]["rows"][0][0] == 600
+                    and not r.get("partialResult"))
+        assert wait_until(full_again, timeout=60)
