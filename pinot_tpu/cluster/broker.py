@@ -279,6 +279,8 @@ class Broker:
 
         partials: List[SegmentResult] = []
         servers_queried = servers_failed = 0
+        uncovered_segments: List[str] = []
+        query_errors: List[Exception] = []
         boundary = self._time_boundary(physical)
         tr = current_trace()
 
@@ -295,15 +297,23 @@ class Broker:
         for table in physical:
             tf_expr = _boundary_expr(boundary, table)
             tf = to_sql(tf_expr) if tf_expr is not None else None
-            routing = self.routing.route_query(table, ctx, extra_filter=tf_expr)
+            unroutable: List[str] = []
+            routing = self.routing.route_query(table, ctx, extra_filter=tf_expr,
+                                               uncovered=unroutable)
+            uncovered_segments.extend(f"{table}:{s}" for s in sorted(unroutable))
             futures = {}
+            missing: Dict[str, Set[str]] = {}  # segment -> servers that missed it
             for server_id, segments in routing.items():
                 handle = self._servers.get(server_id)
                 if handle is None:
+                    # routed to a server whose handle was unregistered between
+                    # route_query and dispatch — its segments enter the retry
+                    # round like any other miss, never silently dropped
+                    for seg in segments:
+                        missing.setdefault(seg, set()).add(server_id)
                     continue
                 futures[self._pool.submit(_traced(handle, server_id), table, ctx,
                                           segments, tf)] = server_id
-            missing: Dict[str, Set[str]] = {}  # segment -> servers that missed it
             for fut in as_completed(futures):
                 server_id = futures[fut]
                 servers_queried += 1
@@ -315,15 +325,27 @@ class Broker:
                                 - set(partial.served):
                             missing.setdefault(seg, set()).add(server_id)
                 except Exception as e:
-                    # partial results are surfaced, not fatal (reference:
-                    # serversNotResponded -> exception in response metadata).
-                    # Backpressure (admission rejection / timeout) is the server
-                    # WORKING as designed — only transport/crash failures take it
-                    # out of routing.
+                    # transport failures are surfaced as partial results, not
+                    # fatal (reference: serversNotResponded -> exception in
+                    # response metadata), and take the server out of routing.
+                    # Backpressure (admission rejection / timeout) is the
+                    # server WORKING as designed. A query error (the server
+                    # evaluated the query and rejected it) is deterministic
+                    # across replicas — raise it to the caller instead of
+                    # silently degrading to partial results.
                     servers_failed += 1
-                    if not _is_backpressure(e):
+                    if _is_transport_failure(e):
                         self.routing.mark_server_unhealthy(server_id)
                         self.failure_detector.notify_unhealthy(server_id)
+                        # the crashed server's segments enter the retry round
+                        # like a served-list miss — replicas can still complete
+                        # the result (the streaming path already does this)
+                        for seg in routing.get(server_id, ()):
+                            missing.setdefault(seg, set()).add(server_id)
+                    elif not _is_backpressure(e):
+                        query_errors.append(e)
+            if query_errors:
+                raise query_errors[0]
             if missing:
                 # a replica mid segment-transition (commit adoption, move) can
                 # briefly serve without a segment it was routed — ONE retry
@@ -334,6 +356,13 @@ class Broker:
                 partials.extend(r for r, _ in retry_results)
                 servers_queried += len(retry_results) + retry_failed
                 servers_failed += retry_failed
+                # coverage audit: a segment can stay unserved even after the
+                # retry round (no eligible candidate, retry target crashed, or
+                # the retry partial's own served list omits it) — surface it
+                # as a partial result instead of silently returning short
+                uncovered_segments.extend(
+                    f"{table}:{s}" for s in
+                    sorted(_uncovered_after_retry(missing, retry_results)))
 
         t_scatter = time.perf_counter()
         with span("reduce"):
@@ -343,10 +372,15 @@ class Broker:
                                "scalar" if aggs else "selection")
             result = reduce_to_result(ctx, merged, aggs, group_exprs)
         t_reduce = time.perf_counter()
+        if uncovered_segments:
+            from ..utils.metrics import get_registry as _reg
+            _reg().counter("pinot_broker_segments_unavailable").inc(
+                len(uncovered_segments))
+            result.stats["segmentsUnavailable"] = uncovered_segments
         result.stats.update({
             "numServersQueried": servers_queried,
             "numServersResponded": servers_queried - servers_failed,
-            "partialResult": servers_failed > 0,
+            "partialResult": servers_failed > 0 or bool(uncovered_segments),
             # per-phase wall times (reference: BrokerQueryPhase REQUEST_COMPILATION /
             # QUERY_ROUTING+SCATTER / REDUCE)
             "phaseTimesMs": {
@@ -407,8 +441,14 @@ class Broker:
                 return
             tf_expr = _boundary_expr(boundary, table)
             tf = to_sql(tf_expr) if tf_expr is not None else None
-            for server_id, segments in self.routing.route_query(
-                    table, ctx, extra_filter=tf_expr).items():
+            unroutable: List[str] = []
+            routing = self.routing.route_query(table, ctx, extra_filter=tf_expr,
+                                               uncovered=unroutable)
+            if unroutable:
+                raise RuntimeError(
+                    f"streaming export incomplete: segments "
+                    f"{sorted(unroutable)} have no healthy replica")
+            for server_id, segments in routing.items():
                 if remaining <= 0:
                     return
                 handle = self._servers.get(server_id)
@@ -420,9 +460,11 @@ class Broker:
                         missed = (set(segments) - set(partial.served)
                                   if partial.served is not None else set())
                     except Exception as e:
-                        if not _is_backpressure(e):
+                        if _is_transport_failure(e):
                             self.routing.mark_server_unhealthy(server_id)
                             self.failure_detector.notify_unhealthy(server_id)
+                        elif not _is_backpressure(e):
+                            raise  # deterministic query error — not retryable
                 if missed:
                     # same completeness contract as the buffered path: retry
                     # unserved segments on another replica; an export that
@@ -430,14 +472,8 @@ class Broker:
                     retries, failed = self._retry_missing(
                         table, ctx, {s: {server_id} for s in missed}, tf,
                         lambda h, s: h)
-                    # per-target coverage: an explicit served list is positive
-                    # evidence; a served-less partial (older peer) is assumed
-                    # to have covered exactly the segments dispatched to IT —
-                    # never forgiveness for segments sent elsewhere
-                    uncovered = set(missed)
-                    for r, segs in retries:
-                        uncovered -= (set(segs) if r.served is None
-                                      else set(r.served))
+                    uncovered = _uncovered_after_retry(
+                        {s: set() for s in missed}, retries)
                     if failed or uncovered:
                         raise RuntimeError(
                             f"streaming export incomplete: segments "
@@ -461,7 +497,17 @@ class Broker:
         with per-server trace spans like the first round. Returns
         ([(partial, segments dispatched to that target)], failed count) — a
         crashed retry target counts as a failed server (partial result) and
-        leaves routing via the failure detector, like a first-round failure."""
+        leaves routing via the failure detector, like a first-round failure.
+
+        strictReplicaGroup tables (including upsert, where that routing is
+        auto-mandated) never retry per segment: serving one segment from a
+        different replica than the rest of its partition reads valid-doc
+        bitmaps that are not mutually consistent and can double-count or drop
+        primary keys mid upsert propagation — the segments are returned
+        uncovered and the caller surfaces them (partial result / export
+        error) instead."""
+        if self.routing.selector_for(table) == "strictreplicagroup":
+            return [], 0
         by_server: Dict[str, List[str]] = {}
         for seg, missed_on in missing.items():
             for cand in self.routing.segment_candidates(table, seg):
@@ -480,7 +526,7 @@ class Broker:
                 out.append((fut.result(), segs))
             except Exception as e:
                 failed += 1
-                if not _is_backpressure(e):
+                if _is_transport_failure(e):
                     self.routing.mark_server_unhealthy(server_id)
                     self.failure_detector.notify_unhealthy(server_id)
         return out, failed
@@ -586,17 +632,20 @@ class Broker:
                 sid, h = pool[next(rr) % len(pool)]
                 try:
                     return h(spec, lp, rp)
-                except Exception:
-                    # degrade to broker-local execution, but VISIBLY: the
-                    # failed worker leaves routing until its probe passes, the
-                    # meter shows the regression, and THIS query stops sending
-                    # further partitions into the dead worker's timeout
+                except Exception as e:
+                    # degrade to broker-local execution, but VISIBLY: a
+                    # transport-failed worker leaves routing until its probe
+                    # passes, the meter shows the regression, and THIS query
+                    # stops sending further partitions into the dead worker's
+                    # timeout. A query error re-raises from the local run.
                     get_registry().counter(
                         "pinot_broker_stage_dispatch_failures").inc()
-                    self.routing.mark_server_unhealthy(sid)
-                    self.failure_detector.notify_unhealthy(sid)
-                    with lock:
-                        workers[:] = [(s, hh) for s, hh in workers if s != sid]
+                    if _is_transport_failure(e):
+                        self.routing.mark_server_unhealthy(sid)
+                        self.failure_detector.notify_unhealthy(sid)
+                        with lock:
+                            workers[:] = [(s, hh) for s, hh in workers
+                                          if s != sid]
                     return hash_join(lp, rp, spec)
             return run
 
@@ -637,9 +686,10 @@ class Broker:
                     server_id = futures[fut]
                     try:
                         rows.extend(fut.result().rows)
-                    except Exception:
-                        self.routing.mark_server_unhealthy(server_id)
-                        self.failure_detector.notify_unhealthy(server_id)
+                    except Exception as e:
+                        if _is_transport_failure(e):
+                            self.routing.mark_server_unhealthy(server_id)
+                            self.failure_detector.notify_unhealthy(server_id)
                         raise
             import numpy as np
             out = {}
@@ -717,6 +767,17 @@ def _boundary_expr(boundary, table: str):
     return None
 
 
+def _uncovered_after_retry(missing, retry_results) -> Set[str]:
+    """Segments still unserved after the retry round. An explicit served list
+    is positive evidence; a served-less partial (older peer) is assumed to
+    have covered exactly the segments dispatched to IT — never forgiveness
+    for segments sent elsewhere."""
+    uncovered = set(missing)
+    for r, segs in retry_results:
+        uncovered -= (set(segs) if r.served is None else set(r.served))
+    return uncovered
+
+
 def _truthy(v) -> bool:
     return str(v).lower() in ("true", "1") if v is not None else False
 
@@ -727,3 +788,13 @@ def _is_backpressure(e: BaseException) -> bool:
         return True
     from .http_service import HttpError
     return isinstance(e, HttpError) and getattr(e, "status", None) in (408, 429)
+
+
+def _is_transport_failure(e: BaseException) -> bool:
+    """Server unreachable or crashed (take it out of routing) vs a QUERY error
+    the server computed and reported (the server is healthy — propagate the
+    error to the caller). An HttpError is a response FROM a live server, so a
+    handler exception (500) is a query error, never grounds for removal:
+    removing healthy servers on a bad query would let one malformed request
+    silently empty the routing table and turn every later query into 0 rows."""
+    return isinstance(e, (ConnectionError, TimeoutError, OSError))

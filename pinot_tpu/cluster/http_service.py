@@ -86,6 +86,11 @@ class HttpService:
                     finally:
                         from ..auth import set_current_principal
                         set_current_principal(None)
+                if isinstance(data, str):
+                    # a str body is a non-streaming response that forgot to
+                    # encode — chunk-iterating it per character would garble
+                    # the stream and TypeError in write_chunk
+                    data = data.encode("utf-8")
                 if not isinstance(data, (bytes, bytearray)) and hasattr(data, "__iter__"):
                     # streaming handler: iterator of byte chunks -> HTTP/1.1
                     # chunked transfer (the gRPC-streaming analog for large

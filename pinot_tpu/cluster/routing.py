@@ -38,7 +38,8 @@ class RoutingTable:
 
     def route(self, segments: Optional[Set[str]] = None,
               exclude: Optional[Set[str]] = None,
-              selector: str = "balanced") -> Dict[str, List[str]]:
+              selector: str = "balanced",
+              uncovered: Optional[List[str]] = None) -> Dict[str, List[str]]:
         """Resolve one healthy replica per segment.
 
         Selectors (reference: instanceselector/ package):
@@ -77,6 +78,11 @@ class RoutingTable:
                 continue
             candidates = [s for s in servers if not exclude or s not in exclude]
             if not candidates:
+                # every replica is excluded (unhealthy): the segment cannot be
+                # dispatched — REPORT it so the broker surfaces a partial
+                # result instead of a silently-short answer
+                if uncovered is not None:
+                    uncovered.append(seg)
                 continue
             if group_mode:
                 chosen = min(candidates, key=preference.__getitem__)
@@ -145,11 +151,15 @@ class RoutingManager:
 
     # -- query routing -----------------------------------------------------
     def route_query(self, table: str, ctx: Optional[QueryContext] = None,
-                    extra_filter: Optional[Expr] = None) -> Dict[str, List[str]]:
+                    extra_filter: Optional[Expr] = None,
+                    uncovered: Optional[List[str]] = None
+                    ) -> Dict[str, List[str]]:
         """`extra_filter` is an additional predicate the servers will apply (the
         broker's hybrid time-boundary split) — fed into the metadata pruner here so
         retained realtime segments entirely below the boundary are never dispatched
-        (reference: TimeSegmentPruner sees the boundary-augmented filter)."""
+        (reference: TimeSegmentPruner sees the boundary-augmented filter).
+        `uncovered`, when given, collects segments that survive pruning but have
+        no healthy replica to serve them."""
         with self._lock:
             rt = self._tables.get(table)
             unhealthy = set(self._unhealthy)
@@ -167,13 +177,21 @@ class RoutingManager:
             keep = {seg for seg in keep
                     if seg not in metas
                     or _segment_may_match(extra_filter, cfg, metas[seg])}
-        selector = "balanced"
-        if cfg is not None:
-            selector = cfg.routing_selector or (
-                # upsert correctness requires consistent-replica reads
-                # (reference: upsert tables mandate strictReplicaGroup routing)
-                "strictReplicaGroup" if cfg.upsert else "balanced")
-        return rt.route(keep, exclude=unhealthy, selector=selector)
+        return rt.route(keep, exclude=unhealthy,
+                        selector=self.selector_for(table), uncovered=uncovered)
+
+    def selector_for(self, table: str) -> str:
+        """The table's effective instance selector, NORMALIZED (lowercase, no
+        underscores — the same canonical form RoutingTable.route validates
+        against) — single source of truth for the first scatter round AND the
+        retry round (upsert correctness requires consistent-replica reads;
+        reference: upsert tables mandate strictReplicaGroup routing)."""
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None:
+            return "balanced"
+        sel = cfg.routing_selector or (
+            "strictReplicaGroup" if cfg.upsert else "balanced")
+        return sel.lower().replace("_", "")
 
     def _lineage_hidden(self, table: str) -> Set[str]:
         """Segments hidden by replace-segment lineage (reference: SegmentLineage,

@@ -128,27 +128,37 @@ def escape(value: Any) -> str:
 
 
 def _substitute(operation: str, parameters: Sequence[Any]) -> str:
-    """Replace `?` placeholders outside string literals with escaped values."""
+    """Replace `?` placeholders with escaped values. A `?` inside a
+    single-quoted string literal, a double-quoted identifier, or a `--` line
+    comment is literal text, not a parameter slot."""
     out: List[str] = []
     it = iter(parameters)
-    in_str = False
     i = 0
     n = len(operation)
     used = 0
     while i < n:
         ch = operation[i]
-        if in_str:
+        if ch in ("'", '"'):
+            # quoted region, copied verbatim; a doubled quote char escapes it
+            q = ch
             out.append(ch)
-            if ch == "'":
-                # '' is an escaped quote inside the literal
-                if i + 1 < n and operation[i + 1] == "'":
-                    out.append("'")
+            i += 1
+            while i < n:
+                out.append(operation[i])
+                if operation[i] == q:
+                    if i + 1 < n and operation[i + 1] == q:
+                        out.append(q)
+                        i += 2
+                        continue
                     i += 1
-                else:
-                    in_str = False
-        elif ch == "'":
-            in_str = True
-            out.append(ch)
+                    break
+                i += 1
+        elif ch == "-" and i + 1 < n and operation[i + 1] == "-":
+            # -- line comment: verbatim to end of line
+            j = operation.find("\n", i)
+            j = n if j < 0 else j + 1
+            out.append(operation[i:j])
+            i = j
         elif ch == "?":
             try:
                 out.append(escape(next(it)))
@@ -157,9 +167,10 @@ def _substitute(operation: str, parameters: Sequence[Any]) -> str:
                     f"SQL has more placeholders than the {len(parameters)} "
                     "parameters given") from None
             used += 1
+            i += 1
         else:
             out.append(ch)
-        i += 1
+            i += 1
     if used != len(parameters):
         raise ProgrammingError(
             f"SQL has {used} placeholders but {len(parameters)} parameters given")

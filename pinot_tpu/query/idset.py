@@ -47,6 +47,30 @@ class IdSet:
         self.kind = kind
         self.values = values  # sorted unique; dtype int64/float64/object(str)
         self._str_set = None  # lazy python set for string membership
+        self._i8_view = None  # lazy int64 view of an f8 set (int-probe path)
+        self._u8_view = None  # lazy uint64 view (probes >= 2**63)
+
+    def _int_view(self) -> np.ndarray:
+        """Sorted int64 view of an f8 set: the integral, exactly-representable
+        members (cached — contains() runs once per segment)."""
+        if self._i8_view is None:
+            sv = self.values
+            ok = (np.isfinite(sv) & (np.floor(sv) == sv)
+                  & (sv >= -9.223372036854776e18) & (sv < 9.223372036854776e18))
+            vi = sv[ok].astype(np.int64)
+            self._i8_view = np.unique(vi[vi.astype(np.float64) == sv[ok]])
+        return self._i8_view
+
+    def _uint_view(self) -> np.ndarray:
+        """Sorted uint64 view of an f8 set for the [2**63, 2**64) range —
+        unsigned probes up there would WRAP in an int64 cast."""
+        if self._u8_view is None:
+            sv = self.values
+            ok = (np.isfinite(sv) & (np.floor(sv) == sv)
+                  & (sv >= 9.223372036854776e18) & (sv < 1.8446744073709552e19))
+            vu = sv[ok].astype(np.uint64)
+            self._u8_view = np.unique(vu[vu.astype(np.float64) == sv[ok]])
+        return self._u8_view
 
     def __len__(self) -> int:
         return len(self.values)
@@ -126,10 +150,41 @@ class IdSet:
         if probe.dtype == object or probe.dtype.kind in ("U", "S"):
             return np.zeros(flat.shape, dtype=bool)  # numeric set vs string column
         vals = self.values
+        # cross-kind numeric probes compare in the INT64 domain when both
+        # sides are integral-valued: casting int64<->float64 loses precision
+        # above 2^53 (the same hazard the theta path's _canonical guards
+        # against) and would produce false membership matches/misses
         if self.kind == "i8" and flat.dtype.kind == "f":
-            vals = vals.astype(np.float64)
-        elif self.kind == "f8" and flat.dtype.kind in ("i", "u", "b"):
-            flat = flat.astype(np.float64)
+            out = np.zeros(flat.shape, dtype=bool)
+            f = flat.astype(np.float64)
+            ok = (np.isfinite(f) & (np.floor(f) == f)
+                  & (f >= -9.223372036854776e18) & (f < 9.223372036854776e18))
+            probe_i = f[ok].astype(np.int64)
+            # above 2^53 one float spans many ints — require the exact
+            # round-trip so only truly representable members match
+            exact = probe_i.astype(np.float64) == f[ok]
+            idx_c = np.minimum(np.searchsorted(vals, probe_i), len(vals) - 1)
+            out[np.flatnonzero(ok)] = exact & (vals[idx_c] == probe_i)
+            return out
+        if self.kind == "f8" and flat.dtype.kind in ("i", "u", "b"):
+            out = np.zeros(flat.shape, dtype=bool)
+            lo = np.ones(flat.shape, dtype=bool)
+            if flat.dtype.kind == "u" and flat.dtype.itemsize == 8:
+                # uint64 probes >= 2**63 would WRAP in the int64 cast —
+                # compare that range in the uint64 domain instead
+                hi = flat >= np.uint64(1) << np.uint64(63)
+                lo = ~hi
+                vu = self._uint_view()
+                if vu.size and hi.any():
+                    fh = flat[hi]
+                    idx_c = np.minimum(np.searchsorted(vu, fh), len(vu) - 1)
+                    out[np.flatnonzero(hi)] = vu[idx_c] == fh
+            vi = self._int_view()
+            if vi.size and lo.any():
+                fl = flat[lo].astype(np.int64)
+                idx_c = np.minimum(np.searchsorted(vi, fl), len(vi) - 1)
+                out[np.flatnonzero(lo)] = vi[idx_c] == fl
+            return out
         # sorted-set membership via searchsorted: O(n log card), no hash build
         idx = np.searchsorted(vals, flat)
         idx_c = np.minimum(idx, len(vals) - 1)

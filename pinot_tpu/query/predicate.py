@@ -161,6 +161,18 @@ def _tree_sig(tree: FilterTree) -> Tuple:
 
 _RANGE_OPS = {"gt", "gte", "lt", "lte", "between"}
 _NEGATIONS = {"neq": "eq", "not_in": "in", "not_like": "like"}
+# boolean transform functions usable bare or as `f(...) = 1/0` comparisons
+_BOOL_PREDICATES = {"in_id_set", "inidset", "json_match", "text_match"}
+
+
+def _as_bool(v) -> Optional[bool]:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)) and v in (0, 1):
+        return bool(v)
+    if isinstance(v, str) and v.lower() in ("true", "false"):
+        return v.lower() == "true"
+    return None
 
 
 def compile_filter(expr: Optional[Expr], segment: ImmutableSegment) -> FilterProgram:
@@ -189,6 +201,17 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
         return ("not", _compile_node(e.args[0], seg, leaves))
     if name in _NEGATIONS:
         return ("not", _compile_node(Function(_NEGATIONS[name], e.args), seg, leaves))
+    if name == "eq" and len(e.args) == 2:
+        # `IN_ID_SET(col,'…') = 1` / `TEXT_MATCH(col,'…') = 0` — the
+        # reference's documented comparison form for boolean transform
+        # functions (InIdSetTransformFunction and friends return 1/0):
+        # normalize to the bare predicate / its negation. (`!= n` arrives
+        # here too: _NEGATIONS rewrites neq to not(eq(...)) above.)
+        for fn, lit in (e.args, e.args[::-1]):
+            if isinstance(fn, Function) and fn.name in _BOOL_PREDICATES \
+                    and isinstance(lit, Literal) and _as_bool(lit.value) is not None:
+                node = _compile_node(fn, seg, leaves)
+                return node if _as_bool(lit.value) else ("not", node)
     if name in ("is_null", "is_not_null"):
         col = e.args[0]
         if not isinstance(col, Identifier):

@@ -278,3 +278,115 @@ def test_unknown_routing_selector_rejected_at_create(tmp_path, ssb_schema):
     import pytest as _p
     with _p.raises(ValueError, match="routingSelector"):
         cluster.create_table(ssb_schema, cfg)
+
+
+def test_uncovered_segments_surface_as_partial_result(lineorder_cluster):
+    """A segment no replica can serve after the retry round must be SURFACED
+    (partialResult + segmentsUnavailable), never silently short results."""
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    victim = sorted(cluster.catalog.segments[table])[0]
+    victim_rows = 1000
+
+    def drop_victim(orig):
+        def handle(t, ctx, segments, tf=None):
+            return orig(t, ctx, [s for s in segments if s != victim], tf)
+        return handle
+
+    for sid in list(cluster.broker._servers):
+        cluster.broker.register_server_handle(
+            sid, drop_victim(cluster.broker._servers[sid]))
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000 - victim_rows
+    assert res.stats["partialResult"] is True
+    assert res.stats["segmentsUnavailable"] == [f"{table}:{victim}"]
+
+
+def test_retry_covers_single_flaky_replica(lineorder_cluster):
+    """One replica briefly missing a segment mid-transition: the retry round
+    fetches it from the other replica and the result stays complete."""
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    victim = sorted(cluster.catalog.segments[table])[0]
+    flaky = "server_0"
+
+    orig = cluster.broker._servers[flaky]
+
+    def handle(t, ctx, segments, tf=None):
+        return orig(t, ctx, [s for s in segments if s != victim], tf)
+
+    cluster.broker.register_server_handle(flaky, handle)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000
+    assert not res.stats["partialResult"]
+    assert "segmentsUnavailable" not in res.stats
+
+
+def test_strict_replica_group_never_retries_per_segment(lineorder_cluster):
+    """strictReplicaGroup (upsert) tables must not serve one segment from a
+    different replica than its partition peers — the retry round refuses and
+    the segment is surfaced as uncovered instead."""
+    cluster, cfg = lineorder_cluster
+    table = cfg.table_name_with_type
+    cluster.catalog.table_configs[table].routing_selector = "strictReplicaGroup"
+    out, failed = cluster.broker._retry_missing(
+        table, None, {"seg_x": {"server_0"}}, None, lambda h, s: h)
+    assert out == [] and failed == 0
+
+
+def test_query_error_raises_and_keeps_servers_routable(lineorder_cluster):
+    """A deterministic query error (server evaluated and rejected the query)
+    must RAISE to the caller and must NOT poison routing: before this guard a
+    single malformed query marked every replica unhealthy and all later
+    queries silently returned 0 rows."""
+    cluster, cfg = lineorder_cluster
+    with pytest.raises(Exception):
+        # bad serialized id-set -> per-server QueryValidationError
+        cluster.query("SELECT COUNT(*) FROM lineorder "
+                      "WHERE IN_ID_SET(lo_custkey, 'not-a-valid-idset')")
+    assert cluster.broker.routing.unhealthy_servers() == set()
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000 and not res.stats["partialResult"]
+
+
+def test_bool_predicate_comparison_form(lineorder_cluster):
+    """Reference syntax `IN_ID_SET(col, '...') = 1` / `= 0` (boolean transform
+    compared to a literal) must compile like the bare predicate / negation."""
+    cluster, cfg = lineorder_cluster
+    ser = cluster.query(
+        "SELECT IDSET(lo_region) FROM lineorder WHERE lo_region = 'ASIA'"
+    ).rows[0][0]
+    base = cluster.query("SELECT COUNT(*) FROM lineorder "
+                         f"WHERE IN_ID_SET(lo_region, '{ser}')").rows[0][0]
+    eq1 = cluster.query("SELECT COUNT(*) FROM lineorder "
+                        f"WHERE IN_ID_SET(lo_region, '{ser}') = 1").rows[0][0]
+    eq0 = cluster.query("SELECT COUNT(*) FROM lineorder "
+                        f"WHERE IN_ID_SET(lo_region, '{ser}') = 0").rows[0][0]
+    assert eq1 == base and eq0 == 4000 - base and 0 < base < 4000
+
+
+def test_all_replicas_down_surfaces_unavailable(lineorder_cluster):
+    """Every replica unhealthy: the query must flag the undispatchable
+    segments (partialResult + segmentsUnavailable), not answer 0 cleanly."""
+    cluster, cfg = lineorder_cluster
+    for sid in list(cluster.broker._servers):
+        cluster.broker.routing.mark_server_unhealthy(sid)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 0
+    assert res.stats["partialResult"] is True
+    assert len(res.stats["segmentsUnavailable"]) == 4
+
+
+def test_crashed_server_segments_retried_in_buffered_path(lineorder_cluster):
+    """A transport-failed server's segments enter the retry round: with a
+    healthy replica available the FIRST query already returns complete
+    results (servers_failed still marks it partial for visibility)."""
+    cluster, cfg = lineorder_cluster
+
+    def broken(table, ctx, segments, time_filter=None):
+        raise ConnectionError("boom")
+
+    cluster.broker.register_server_handle("server_1", broken)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000
+    assert "segmentsUnavailable" not in res.stats

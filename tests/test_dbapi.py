@@ -131,3 +131,18 @@ def test_rollback_not_supported(stack):
     stack.commit()  # no-op
     with pytest.raises(dbapi.NotSupportedError):
         stack.rollback()
+
+
+def test_substitute_skips_comments_and_quoted_identifiers():
+    # a ? inside a -- line comment is not a placeholder
+    sql = _substitute("SELECT ? FROM t -- what? a comment\nWHERE b = ?", [1, 2])
+    assert sql == "SELECT 1 FROM t -- what? a comment\nWHERE b = 2"
+    # comment at end of string (no trailing newline)
+    sql = _substitute("SELECT ? FROM t -- tail?", [3])
+    assert sql == "SELECT 3 FROM t -- tail?"
+    # a ? inside a double-quoted identifier is not a placeholder
+    sql = _substitute('SELECT "col?name" FROM t WHERE a = ?', [4])
+    assert sql == 'SELECT "col?name" FROM t WHERE a = 4'
+    # doubled "" escape inside an identifier
+    sql = _substitute('SELECT "we""ird?" FROM t WHERE a = ?', [5])
+    assert sql == 'SELECT "we""ird?" FROM t WHERE a = 5'

@@ -178,3 +178,26 @@ def test_idset_string_with_embedded_nul():
     assert back == s
     assert back.contains(np.array(["a\x00b", "a", ""], dtype=object)).tolist() \
         == [True, False, True]
+
+
+def test_contains_int64_precision_above_2_53():
+    import numpy as np
+    from pinot_tpu.query.idset import IdSet
+
+    # i8 set vs float probe: 2**53 + 1 is NOT float-representable; a float64
+    # promotion would collapse it onto 2.0**53 and falsely match
+    s = IdSet.from_values(np.array([2**53 + 1], dtype=np.int64))
+    assert s.contains(np.array([2.0**53])).tolist() == [False]
+    assert s.contains(np.array([float(2**54)])).tolist() == [False]
+    assert s.contains(np.array([1.5])).tolist() == [False]
+    # exactly-representable large ints still match through the float probe
+    s2 = IdSet.from_values(np.array([2**54], dtype=np.int64))
+    assert s2.contains(np.array([float(2**54)])).tolist() == [True]
+
+    # f8 set vs int probe: the converse collapse
+    f = IdSet.from_values(np.array([2.0**53]))
+    assert f.contains(np.array([2**53 + 1], dtype=np.int64)).tolist() == [False]
+    assert f.contains(np.array([2**53], dtype=np.int64)).tolist() == [True]
+    # fractional set values never match int probes
+    f2 = IdSet.from_values(np.array([2.5]))
+    assert f2.contains(np.array([2], dtype=np.int64)).tolist() == [False]

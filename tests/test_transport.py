@@ -404,3 +404,17 @@ def test_server_restart_recovers_segments(tmp_path):
             return (r["resultTable"]["rows"][0][0] == 600
                     and not r.get("partialResult"))
         assert wait_until(full_again, timeout=60)
+
+
+def test_http_service_str_body_is_encoded_not_chunked():
+    """A handler returning an unencoded str must be sent as one body, not
+    chunk-iterated per character (which garbled the response)."""
+    from pinot_tpu.cluster.http_service import HttpService, http_call
+    svc = HttpService()
+    svc.route("GET", "hello", lambda parts, params, body:
+              (200, "text/plain", "hello world"))
+    svc.start()
+    try:
+        assert http_call("GET", f"{svc.url}/hello") == b"hello world"
+    finally:
+        svc.stop()
