@@ -46,6 +46,13 @@ GROUP_QUERY = ("SELECT lo_region, SUM(lo_revenue), COUNT(*) FROM lineorder "
 
 HLL_QUERY = "SELECT DISTINCTCOUNTHLL(lo_orderdate) FROM lineorder WHERE lo_quantity < 25"
 
+# BASELINE.json config 3: the filter hits only star-tree split dimensions, so every
+# segment answers from its pre-aggregated record table (dict-id LUT lookup fused
+# into the predicate mask over ~100s of records instead of a 2M-row scan)
+STAR_QUERY = ("SELECT lo_region, SUM(lo_revenue) FROM lineorder "
+              "WHERE lo_discount BETWEEN 1 AND 3 "
+              "GROUP BY lo_region ORDER BY lo_region LIMIT 10")
+
 
 def ssb_schema():
     from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
@@ -75,15 +82,24 @@ def make_columns(n: int):
     }
 
 
-def build_or_load_segments(schema, cols):
-    from pinot_tpu.segment import load_segment
+def build_or_load_segments(schema, cols, star_tree=False, rows=None, tag=None):
+    from pinot_tpu.segment import (SegmentGeneratorConfig, StarTreeIndexConfig,
+                                   load_segment)
     from pinot_tpu.segment.writer import build_aligned_segments
-    tag = f"r{ROWS}_s{SEGMENTS}_v1"
+    rows = rows if rows is not None else ROWS
+    tag = tag or f"r{rows}_s{SEGMENTS}_v1{'_st' if star_tree else ''}"
     seg_root = os.path.join(CACHE, tag)
     marker = os.path.join(seg_root, "DONE")
     if not os.path.exists(marker):
         os.makedirs(seg_root, exist_ok=True)
-        build_aligned_segments(schema, cols, seg_root, "lineorder", SEGMENTS)
+        config = None
+        if star_tree:
+            config = SegmentGeneratorConfig(star_tree_configs=[
+                StarTreeIndexConfig(
+                    dimensions_split_order=["lo_region", "lo_discount"],
+                    function_column_pairs=["SUM__lo_revenue"])])
+        build_aligned_segments(schema, cols, seg_root, "lineorder", SEGMENTS,
+                               config=config)
         with open(marker, "w") as f:
             f.write("ok")
     names = sorted(d for d in os.listdir(seg_root) if d.startswith("lineorder_"))
@@ -108,32 +124,50 @@ def numpy_baseline(cols, iters=3) -> float:
     return len(od) / dt, result
 
 
+def relay_floor_ms(iters=7) -> float:
+    """Median dispatch+fetch of a TRIVIAL kernel: the transport's per-query
+    latency floor. Published next to p50 so engine overhead (p50 - floor) is
+    readable regardless of how the relay's round-trip cost drifts."""
+    import jax
+    f = jax.jit(lambda x: x + 1)
+    x = jax.device_put(np.float32(1.0))
+    jax.device_get(f(x))
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.device_get(f(x))
+        lat.append(time.perf_counter() - t0)
+    return float(np.median(lat)) * 1000
+
+
 def main():
     schema = ssb_schema()
     cols = make_columns(ROWS)
     segments = build_or_load_segments(schema, cols)
+    star_segments = build_or_load_segments(schema, cols, star_tree=True)
 
     import jax
     from pinot_tpu.parallel import MeshQueryExecutor, default_mesh
     n_dev = len(jax.devices())
     mesh_exec = MeshQueryExecutor(default_mesh(n_dev))
 
-    # warmup: device transfer + jit compile (all three query shapes)
+    # warmup: device transfer + jit compile (all device query shapes)
     for q in (QUERY, GROUP_QUERY, HLL_QUERY):
         mesh_exec.execute(segments, q)
         mesh_exec.execute(segments, q)
+    mesh_exec.execute(star_segments, STAR_QUERY)
 
-    def p50_latency(q, iters=9):
+    def p50_latency(q, iters=9, segs=segments):
         lat = []
         for _ in range(iters):
             t0 = time.perf_counter()
-            r = mesh_exec.execute(segments, q)
+            r = mesh_exec.execute(segs, q)
             lat.append(time.perf_counter() - t0)
         return float(np.median(lat)) * 1000, r
 
-    def pipelined_rate(q, iters=ITERS):
+    def pipelined_rate(q, iters=ITERS, segs=segments):
         t0 = time.perf_counter()
-        results = mesh_exec.execute_many(segments, [q] * iters)
+        results = mesh_exec.execute_many(segs, [q] * iters)
         dt = time.perf_counter() - t0
         return ROWS * iters / dt, results[-1]
 
@@ -142,6 +176,18 @@ def main():
     grp_p50, _ = p50_latency(GROUP_QUERY)
     grp_rate, grp_res = pipelined_rate(GROUP_QUERY)
     hll_rate, hll_res = pipelined_rate(HLL_QUERY)
+    star_p50, star_res = p50_latency(STAR_QUERY, segs=star_segments)
+    star_rate, _ = pipelined_rate(STAR_QUERY, segs=star_segments)
+
+    # single-query latency at serving-sized row counts (1M rows after pruning)
+    small_rows = 1024 * 1024
+    small_segs = build_or_load_segments(schema, make_columns(small_rows),
+                                        rows=small_rows,
+                                        tag=f"r{small_rows}_s{SEGMENTS}_v1")
+    mesh_exec.execute(small_segs, QUERY)
+    mesh_exec.execute(small_segs, QUERY)
+    p50_1m, _ = p50_latency(QUERY, segs=small_segs)
+    floor_ms = relay_floor_ms()
 
     np_rows_per_sec, np_result = numpy_baseline(cols)
     ours = res.rows[0][0]
@@ -161,6 +207,13 @@ def main():
     if abs(hll_res.rows[0][0] - exact) > 0.05 * exact:
         print(f"WARNING: HLL estimate {hll_res.rows[0][0]} vs exact {exact}",
               file=sys.stderr)
+    # star-tree differential: same group-by truth, filter lo_discount in [1,3]
+    smask = (cols["lo_discount"] >= 1) & (cols["lo_discount"] <= 3)
+    for region, got_sum in star_res.rows:
+        want = float(np.sum(cols["lo_revenue"][smask & (cols["lo_region"] == region)]))
+        if abs(got_sum - want) > 2e-3 * max(1.0, abs(want)):
+            print(f"WARNING: star-tree mismatch {region}: {got_sum} vs {want}",
+                  file=sys.stderr)
 
     print(json.dumps({
         "metric": "ssb_q1.1_filter_agg_scan_rate",
@@ -171,9 +224,14 @@ def main():
             "rows": ROWS, "segments": SEGMENTS, "devices": n_dev,
             "pipeline_depth": ITERS,
             "p50_query_latency_ms": round(q11_p50, 3),
+            "p50_query_latency_1m_rows_ms": round(p50_1m, 3),
+            "relay_roundtrip_floor_ms": round(floor_ms, 3),
             "groupby_rows_per_sec": round(grp_rate / n_dev, 1),
             "groupby_p50_latency_ms": round(grp_p50, 3),
             "hll_rows_per_sec": round(hll_rate / n_dev, 1),
+            "hll_vs_numpy": round(hll_rate / n_dev / np_rows_per_sec, 3),
+            "startree_rows_per_sec": round(star_rate / n_dev, 1),
+            "startree_p50_latency_ms": round(star_p50, 3),
             "numpy_single_thread_rows_per_sec": round(np_rows_per_sec, 1),
             "backend": jax.default_backend(),
         },

@@ -225,20 +225,40 @@ class MeshQueryExecutor:
         (JSON/TEXT_MATCH doc-set filters, which are per-segment bitmaps)."""
         if _has_docset_filter(ctx):
             return None, None
+        if self._all_star_tree(ctx, segments):
+            # every segment answers from a pre-aggregated star-tree record
+            # table (typically 100-1000x fewer records than the base scan):
+            # the per-segment executor's tree path beats a full device scan
+            # outright, so the mesh planner yields to it (reference:
+            # StarTreeUtils.isFitForStarTree gating in the leaf plan)
+            return None, None
         if _refs_multi_value(ctx, segments[0]):
             # MV forward indexes are ragged (flat ids + offsets): the [S, rows]
             # stacked mesh block can't carry them; per-segment execution still
             # rides the single-device kernel's padded [rows, W] MV path
             return None, None
+        total_docs = sum(s.num_docs for s in segments)
         any_mutable = any(getattr(s, "is_mutable", False) for s in segments)
         if not any_mutable:
-            plan = plan_segment(ctx, segments[0])
+            plan = plan_segment(ctx, segments[0], scan_docs=total_docs)
             if plan.kind != "device":
                 return plan, None
             if self._alignable(plan, segments):
                 return plan, None
         view = self._merged_view(segments)
-        return plan_segment(ctx, view), view
+        return plan_segment(ctx, view, scan_docs=total_docs), view
+
+    def _all_star_tree(self, ctx: QueryContext, segments) -> bool:
+        """True when EVERY segment can answer this query from a star-tree (a
+        mixed set keeps the mesh scan: one full-scan segment would serialize
+        the whole query behind the host fallback). The no-trees common case
+        exits before any fit work."""
+        if not all(getattr(s, "star_trees", None) for s in segments):
+            return False
+        if any(getattr(s, "is_mutable", False) for s in segments):
+            return False
+        from ..query.startree_exec import try_star_tree
+        return all(try_star_tree(ctx, s) is not None for s in segments)
 
     def _merged_view(self, segments) -> MergedSegmentView:
         # keyed by STABLE segment identity; the volatile part (mutable row counts)

@@ -60,6 +60,14 @@ class AggFunc:
         bounded-size purpose."""
         return values
 
+    def state_from_present_ids(self, dictionary, present_ids: np.ndarray) -> Any:
+        """State straight from the device presence vector's surviving DICT IDS.
+        Default decodes the values and defers to `state_from_value_set`;
+        aggregations whose state depends only on per-value derived data (HLL's
+        bucket/rank) override to skip the per-query value materialization."""
+        values = dictionary.take(present_ids)
+        return self.state_from_value_set(set(values.tolist()))
+
     def merge(self, a: Any, b: Any) -> Any:
         raise NotImplementedError
 
@@ -293,6 +301,32 @@ class DistinctCountHLLAgg(AggFunc):
         for v in np.unique(np.asarray(values, dtype=object)):
             b, r = hll_bucket_rank(v, self.p)
             regs[b] = max(regs[b], r)
+        return regs
+
+    def state_from_present_ids(self, dictionary, present_ids: np.ndarray):
+        """Registers straight from a presence vector, via a (bucket, rank)
+        table cached ON the dictionary object (lifetime-correct: a dictionary
+        lives exactly as long as its segment). Hashing every dictionary value
+        is paid once per dictionary instead of once per query — the per-query
+        cost drops to one vectorized maximum.at over the surviving ids."""
+        cache = getattr(dictionary, "_hll_br", None)
+        if cache is None:
+            cache = {}
+            try:
+                dictionary._hll_br = cache
+            except AttributeError:
+                return super().state_from_present_ids(dictionary, present_ids)
+        br = cache.get(self.p)
+        if br is None:
+            vals = np.asarray(dictionary.take(np.arange(len(dictionary))),
+                              dtype=object)
+            buckets = np.empty(len(vals), dtype=np.int32)
+            ranks = np.empty(len(vals), dtype=np.int8)
+            for i, v in enumerate(vals):
+                buckets[i], ranks[i] = hll_bucket_rank(v, self.p)
+            br = cache[self.p] = (buckets, ranks)
+        regs = np.zeros(1 << self.p, dtype=np.int8)
+        np.maximum.at(regs, br[0][present_ids], br[1][present_ids])
         return regs
 
     def _normalize(self, state) -> np.ndarray:

@@ -242,10 +242,10 @@ class ServerQueryExecutor:
         for i, agg in enumerate(plan.aggs):
             if "distinct" in agg.device_outputs:
                 presence = outs[f"{i}.distinct"]
-                card = seg.column(agg.arg.name).cardinality
-                present_ids = np.nonzero(presence[:card] > 0)[0]
-                values = seg.column(agg.arg.name).dictionary.take(present_ids)
-                states.append(agg.state_from_value_set(set(values.tolist())))
+                reader = seg.column(agg.arg.name)
+                present_ids = np.nonzero(presence[:reader.cardinality] > 0)[0]
+                states.append(agg.state_from_present_ids(reader.dictionary,
+                                                         present_ids))
                 continue
             o = {"count": count}
             for out_name in agg.device_outputs:

@@ -28,6 +28,23 @@ from .predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf, compile_filter
 
 MAX_DEVICE_GROUP_KEYS = 1 << 20  # dense-key cap (reference caps group-by at 100k groups)
 
+# Below this row count a single numpy pass beats any device dispatch on the
+# relay-attached backend (star-tree record tables, small dimension tables).
+SMALL_SCAN_DOCS = 1 << 16
+
+
+def _relay_backend() -> bool:
+    """True on a real accelerator backend (device dispatches pay host round
+    trips); False under CPU jax, where tests keep full kernel coverage."""
+    global _RELAY_BACKEND
+    if _RELAY_BACKEND is None:
+        import jax
+        _RELAY_BACKEND = jax.default_backend() != "cpu"
+    return _RELAY_BACKEND
+
+
+_RELAY_BACKEND: Optional[bool] = None
+
 from ..engine.datetime_fns import DEVICE_DATETIME_FUNCS
 
 _DEVICE_FUNCS = {"plus", "minus", "times", "divide", "mod", "case", "cast", "abs", "ceil",
@@ -58,7 +75,11 @@ class SegmentPlan:
 
 
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
-                 valid_docs: Optional[np.ndarray] = None) -> SegmentPlan:
+                 valid_docs: Optional[np.ndarray] = None,
+                 scan_docs: Optional[int] = None) -> SegmentPlan:
+    """`scan_docs` overrides the row count the small-scan heuristic sees: the
+    mesh path plans a whole SET from one probe segment and amortizes ONE
+    dispatch across all of it, so it passes the set's total."""
     aggs = [make_agg(f) for f in ctx.aggregations]
     # DISTINCT rewrites to a group-by over the select expressions with no aggregations
     # (reference: DistinctOperator is a specialized group-by).
@@ -98,6 +119,16 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
         # consuming segments stay host-side; the TPU path starts at commit
         plan.kind = "host"
         plan.fallback_reason = "mutable (consuming) segment"
+        return plan
+    if (scan_docs if scan_docs is not None
+            else segment.num_docs) <= SMALL_SCAN_DOCS and _relay_backend():
+        # tiny scans (star-tree record tables, mini dimension tables): one
+        # numpy pass costs microseconds while a device dispatch on the relay
+        # backend pays a ~100ms host round trip per sync — the kernel can
+        # never win below this size. CPU-jax (tests) keeps the device path
+        # so kernel coverage is unaffected.
+        plan.kind = "host"
+        plan.fallback_reason = "small scan (host beats device dispatch)"
         return plan
     reason = _device_feasible(plan, segment)
     if reason:
