@@ -281,6 +281,7 @@ class Broker:
         servers_queried = servers_failed = 0
         uncovered_segments: List[str] = []
         query_errors: List[Exception] = []
+        error_segments: Set[str] = set()
         boundary = self._time_boundary(physical)
         tr = current_trace()
 
@@ -325,27 +326,25 @@ class Broker:
                                 - set(partial.served):
                             missing.setdefault(seg, set()).add(server_id)
                 except Exception as e:
-                    # transport failures are surfaced as partial results, not
-                    # fatal (reference: serversNotResponded -> exception in
-                    # response metadata), and take the server out of routing.
-                    # Backpressure (admission rejection / timeout) is the
-                    # server WORKING as designed. A query error (the server
-                    # evaluated the query and rejected it) is deterministic
-                    # across replicas — raise it to the caller instead of
-                    # silently degrading to partial results.
+                    # EVERY failure mode sends the server's segments into the
+                    # retry round on a DIFFERENT replica (never re-targeting
+                    # the one that failed): transport failures additionally
+                    # remove the server from routing; backpressure (admission
+                    # rejection / timeout) is the server WORKING as designed;
+                    # a query error is remembered — if the retry covers the
+                    # segments it was replica-local (corrupt file, one bad
+                    # handler) and the query completes as a partial result,
+                    # but if the retry leaves them uncovered the error was
+                    # deterministic (bad query) and is raised to the caller.
                     servers_failed += 1
                     if _is_transport_failure(e):
                         self.routing.mark_server_unhealthy(server_id)
                         self.failure_detector.notify_unhealthy(server_id)
-                        # the crashed server's segments enter the retry round
-                        # like a served-list miss — replicas can still complete
-                        # the result (the streaming path already does this)
-                        for seg in routing.get(server_id, ()):
-                            missing.setdefault(seg, set()).add(server_id)
                     elif not _is_backpressure(e):
                         query_errors.append(e)
-            if query_errors:
-                raise query_errors[0]
+                        error_segments.update(routing.get(server_id, ()))
+                    for seg in routing.get(server_id, ()):
+                        missing.setdefault(seg, set()).add(server_id)
             if missing:
                 # a replica mid segment-transition (commit adoption, move) can
                 # briefly serve without a segment it was routed — ONE retry
@@ -360,9 +359,14 @@ class Broker:
                 # retry round (no eligible candidate, retry target crashed, or
                 # the retry partial's own served list omits it) — surface it
                 # as a partial result instead of silently returning short
+                uncovered = _uncovered_after_retry(missing, retry_results)
+                if query_errors and error_segments & uncovered:
+                    # a query-error server's segments failed on EVERY replica
+                    # tried: the error is deterministic, not replica-local —
+                    # propagate it instead of a misleading partial result
+                    raise query_errors[0]
                 uncovered_segments.extend(
-                    f"{table}:{s}" for s in
-                    sorted(_uncovered_after_retry(missing, retry_results)))
+                    f"{table}:{s}" for s in sorted(uncovered))
 
         t_scatter = time.perf_counter()
         with span("reduce"):

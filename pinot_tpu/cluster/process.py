@@ -107,6 +107,35 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
     server.shutdown()
 
 
+def run_minion(controller_url: str, instance_id: str, work_dir: str,
+               run_dir: str, port: int = 0, config_path: str = "") -> None:
+    """Minion role process (reference: MinionStarter): joins via RemoteCatalog,
+    claims tasks through the controller's atomic REST queue, fetches inputs
+    through the deep-store proxy, pushes outputs through the standard segment
+    upload/replace endpoints."""
+    from ..minion.tasks import MinionWorker
+    from .remote import (ControllerDeepStore, RemoteCatalog, RemoteController,
+                         RemoteTaskQueue)
+    from .services import MinionService
+
+    cfg = _load_config(config_path, port, "minion.port")
+    access_control = _setup_auth(cfg)
+    catalog = RemoteCatalog(controller_url)
+    worker = MinionWorker(instance_id, catalog,
+                          ControllerDeepStore(controller_url),
+                          RemoteController(controller_url,
+                                           cfg.get_str("auth.service.token")),
+                          os.path.join(work_dir, instance_id),
+                          queue=RemoteTaskQueue(controller_url))
+    svc = MinionService(worker, port=cfg.get_int("minion.port", 0),
+                        poll_s=cfg.get_float("minion.poll.seconds", 1.0),
+                        access_control=access_control)
+    _write_ready(run_dir, instance_id, {"url": svc.url})
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    svc.stop()
+    catalog.close()
+
+
 def run_broker(controller_url: str, instance_id: str, run_dir: str,
                port: int = 0, config_path: str = "") -> None:
     from .broker import Broker
@@ -169,19 +198,37 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
                     max_scatter_threads=cfg.get_int("broker.scatter.threads", 8))
     bsvc = BrokerService(broker, port=cfg.get_int("broker.port", 0),
                          access_control=access_control)
+
+    from ..minion.tasks import MinionWorker
+    from .remote import RemoteController, RemoteTaskQueue
+    from .services import MinionService
+    minion_catalog = RemoteCatalog(csvc.url)
+    minion = MinionWorker("minion_0", minion_catalog,
+                          ControllerDeepStore(csvc.url),
+                          RemoteController(csvc.url,
+                                           cfg.get_str("auth.service.token")),
+                          os.path.join(work_dir, "minion_0"),
+                          queue=RemoteTaskQueue(csvc.url))
+    msvc = MinionService(minion, port=cfg.get_int("minion.port", 0),
+                         poll_s=cfg.get_float("minion.poll.seconds", 1.0),
+                         access_control=access_control)
     _write_ready(run_dir, "controller_0", {"url": csvc.url})
     _write_ready(run_dir, "server_0", {"url": ssvc.url})
     _write_ready(run_dir, "broker_0", {"url": bsvc.url})
+    _write_ready(run_dir, "minion_0", {"url": msvc.url})
     handles = {"controller": csvc, "server": ssvc, "broker": bsvc,
-               "catalogs": (server_catalog, broker_catalog),
-               "controller_obj": controller, "server_obj": server}
+               "minion": msvc,
+               "catalogs": (server_catalog, broker_catalog, minion_catalog),
+               "controller_obj": controller, "server_obj": server,
+               "minion_obj": minion}
     if block:
         signal.sigwait({signal.SIGTERM, signal.SIGINT})
         # graceful teardown, same order as the per-role processes: server
         # first (consuming handlers flush/stop), then periodic tasks/watchers
+        msvc.stop()
         server.shutdown()
         controller.stop_periodic_tasks()
-        for c in (server_catalog, broker_catalog):
+        for c in (server_catalog, broker_catalog, minion_catalog):
             c.close()
         return None
     return handles
@@ -190,7 +237,8 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
 def main(argv: Optional[Sequence[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="pinot_tpu.cluster.process")
     p.add_argument("--role", required=True,
-                   choices=["controller", "server", "broker", "service-manager"])
+                   choices=["controller", "server", "broker", "minion",
+                            "service-manager"])
     p.add_argument("--controller-url", default="")
     p.add_argument("--instance-id", default="")
     p.add_argument("--work-dir", default="")
@@ -203,6 +251,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     elif a.role == "server":
         run_server(a.controller_url, a.instance_id, a.work_dir, a.run_dir, a.port,
                    config_path=a.config)
+    elif a.role == "minion":
+        run_minion(a.controller_url, a.instance_id, a.work_dir, a.run_dir,
+                   a.port, config_path=a.config)
     elif a.role == "service-manager":
         run_service_manager(a.work_dir, a.run_dir, a.port, config_path=a.config)
     else:
@@ -313,7 +364,7 @@ class ProcessCluster:
 
     def __init__(self, num_servers: int = 2, work_dir: Optional[str] = None,
                  server_env: Optional[Dict[str, str]] = None,
-                 startup_timeout_s: float = 60.0):
+                 startup_timeout_s: float = 60.0, num_minions: int = 0):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="pinot_tpu_proc_")
         self.run_dir = os.path.join(self.work_dir, "run")
         os.makedirs(self.run_dir, exist_ok=True)
@@ -345,7 +396,14 @@ class ProcessCluster:
             self._await_ready(f"server_{i}")
         self._spawn("broker_0", ["--role", "broker", "--instance-id", "broker_0",
                                  "--controller-url", self.controller_url])
+        for i in range(num_minions):
+            mid = f"minion_{i}"
+            self._spawn(mid, ["--role", "minion", "--instance-id", mid,
+                              "--controller-url", self.controller_url,
+                              "--work-dir", self.work_dir])
         self.broker_url = self._await_ready("broker_0")
+        for i in range(num_minions):
+            self._await_ready(f"minion_{i}")
         self.controller = ControllerClient(self.controller_url)
         self.broker = BrokerClient(self.broker_url)
 
@@ -394,6 +452,23 @@ class ProcessCluster:
         if os.path.exists(ready):
             os.remove(ready)  # _await_ready must see the NEW process's file
         self._spawn(instance_id, ["--role", "server",
+                                  "--instance-id", instance_id,
+                                  "--controller-url", self.controller_url,
+                                  "--work-dir", self.work_dir])
+        return self._await_ready(instance_id)
+
+    def restart_minion(self, instance_id: str) -> str:
+        """Fresh minion process under the same id (after a kill): it resumes
+        claiming from the controller queue; lease gc requeues whatever the
+        dead incarnation held."""
+        proc = self.procs.get(instance_id)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        ready = os.path.join(self.run_dir, f"{instance_id}.ready")
+        if os.path.exists(ready):
+            os.remove(ready)
+        self._spawn(instance_id, ["--role", "minion",
                                   "--instance-id", instance_id,
                                   "--controller-url", self.controller_url,
                                   "--work-dir", self.work_dir])

@@ -160,6 +160,77 @@ class RemoteCatalog(Catalog):
             self._notify("property", k)
 
 
+class RemoteTaskQueue:
+    """Minion-side task claim/finish against the controller's atomic queue
+    (reference: Helix task framework claims; `POST /tasks/claim` runs under the
+    controller catalog's lock, so N minions never double-claim)."""
+
+    def __init__(self, controller_url: str):
+        self.controller_url = controller_url.rstrip("/")
+
+    def claim(self, worker_id: str, task_types):
+        from ..minion.tasks import TaskSpec
+        resp = post_json(f"{self.controller_url}/tasks/claim",
+                         {"worker": worker_id, "taskTypes": list(task_types)})
+        return TaskSpec.from_json(resp["task"]) if resp.get("task") else None
+
+    def finish(self, task_id: str, error: str = "",
+               worker_id: Optional[str] = None) -> bool:
+        resp = post_json(f"{self.controller_url}/tasks/finish",
+                         {"taskId": task_id, "error": error,
+                          "worker": worker_id}, retries=2)
+        return bool(resp.get("applied"))
+
+
+class RemoteController:
+    """The controller API surface a remote MinionWorker needs — upload,
+    atomic replace (staged through the deep-store proxy), delete — over REST
+    (reference: minion executors talking to the controller's segment upload /
+    startReplaceSegments / endReplaceSegments resources)."""
+
+    def __init__(self, controller_url: str, token: Optional[str] = None):
+        self.controller_url = controller_url.rstrip("/")
+        self.token = token
+
+    def _tar_bytes(self, segment_dir: str) -> tuple:
+        name = os.path.basename(segment_dir.rstrip("/"))
+        with tempfile.TemporaryDirectory() as tmp:
+            tar_path = os.path.join(tmp, f"{name}.tar.gz")
+            tar_segment(segment_dir, tar_path)
+            with open(tar_path, "rb") as f:
+                return name, f.read()
+
+    def upload_segment(self, table: str, segment_dir: str,
+                       custom: Optional[Dict[str, str]] = None) -> None:
+        name, payload = self._tar_bytes(segment_dir)
+        q = urllib.parse.urlencode(
+            {"name": name, **({"custom": json.dumps(custom)} if custom else {})})
+        http_call("POST", f"{self.controller_url}/segments/{table}?{q}", payload,
+                  content_type="application/octet-stream", timeout=120.0,
+                  token=self.token)
+
+    def replace_segments(self, table: str, old_names, new_segment_dirs,
+                         custom: Optional[Dict[str, str]] = None) -> None:
+        import uuid as _uuid
+        staged = []
+        for d in new_segment_dirs:
+            name, payload = self._tar_bytes(d)
+            uri = f"staging/{_uuid.uuid4().hex[:12]}/{name}.tar.gz"
+            http_call("POST", f"{self.controller_url}/deepstore/{uri}", payload,
+                      content_type="application/octet-stream", timeout=120.0,
+                      token=self.token)
+            staged.append(uri)
+        post_json(f"{self.controller_url}/replaceSegments/{table}",
+                  {"from": list(old_names), "stagedTars": staged,
+                   "custom": custom}, timeout=120.0, token=self.token)
+
+    def delete_segment(self, table: str, segment: str, *,
+                       permanent: bool = False) -> None:
+        q = "?permanent=true" if permanent else ""
+        http_call("DELETE", f"{self.controller_url}/segments/{table}/{segment}{q}",
+                  token=self.token)
+
+
 class RemoteCompletion:
     """Server-side HTTP client for the segment completion protocol (reference:
     `ServerSegmentCompletionProtocolHandler` — segmentConsumed / segmentCommitStart /

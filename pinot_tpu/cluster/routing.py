@@ -34,6 +34,11 @@ class RoutingTable:
     def __init__(self, table: str):
         self.table = table
         self.segment_servers: Dict[str, List[str]] = {}
+        # segments in the external view whose every replica server is DEAD
+        # (left live_servers): undispatchable, but they must still surface in
+        # the coverage audit — dropping them entirely would silently shorten
+        # results with partialResult=False
+        self.dead_segments: Set[str] = set()
         self._rr = itertools.count()
 
     def route(self, segments: Optional[Set[str]] = None,
@@ -127,6 +132,9 @@ class RoutingManager:
                        if st in (ONLINE, CONSUMING) and srv in alive]
             if servers:
                 rt.segment_servers[seg] = sorted(servers)
+            elif any(st in (ONLINE, CONSUMING) for st in states.values()):
+                # the segment WAS being served and every such replica died
+                rt.dead_segments.add(seg)
         with self._lock:
             self._tables[table] = rt
 
@@ -166,7 +174,7 @@ class RoutingManager:
         if rt is None:
             return {}
         cfg = self.catalog.table_configs.get(table)
-        keep = set(rt.segment_servers)
+        keep = set(rt.segment_servers) | rt.dead_segments
         hidden = self._lineage_hidden(table)
         if hidden:
             keep -= hidden
@@ -177,7 +185,11 @@ class RoutingManager:
             keep = {seg for seg in keep
                     if seg not in metas
                     or _segment_may_match(extra_filter, cfg, metas[seg])}
-        return rt.route(keep, exclude=unhealthy,
+        if uncovered is not None:
+            # dead-replica segments that survive pruning are part of the
+            # query's answer set but have no server at all
+            uncovered.extend(sorted(keep & rt.dead_segments))
+        return rt.route(keep - rt.dead_segments, exclude=unhealthy,
                         selector=self.selector_for(table), uncovered=uncovered)
 
     def selector_for(self, table: str) -> str:

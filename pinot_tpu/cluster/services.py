@@ -91,6 +91,12 @@ class ControllerService:
         s.route("POST", "pauseConsumption", self._pause_consumption, action="ADMIN")
         s.route("POST", "resumeConsumption", self._resume_consumption, action="ADMIN")
         s.route("POST", "rebalance", self._rebalance, action="ADMIN")
+        # minion task protocol (reference: Helix task framework; claims are
+        # atomic against the authoritative catalog, so N remote minions can
+        # never double-claim)
+        s.route("POST", "tasks", self._tasks_post, action="WRITE")
+        s.route("GET", "tasks", self._tasks_get)
+        s.route("POST", "replaceSegments", self._replace_segments, action="WRITE")
         s.route("GET", "metrics", _metrics_route)
         s.route("GET", "", self._ui)       # minimal admin UI at /
         s.route("GET", "ui", self._ui)
@@ -205,15 +211,17 @@ class ControllerService:
         return json_response({"status": "OK"})
 
     def _post_segment(self, parts, params, body):
-        """POST /segments/{tableNameWithType}?name=... with the tar as the body
-        (reference: segment push via PinotSegmentUploadDownloadRestletResource)."""
+        """POST /segments/{tableNameWithType}?name=...[&custom=json] with the
+        tar as the body (reference: segment push via
+        PinotSegmentUploadDownloadRestletResource)."""
         table = parts[0]
         from ..auth import require_table_access
         require_table_access(table, "WRITE")
         name = params["name"]
+        custom = json.loads(params["custom"]) if params.get("custom") else None
         with tempfile.TemporaryDirectory() as tmp:
             seg_dir = _untar_body(body, name, tmp)
-            meta = self.controller.upload_segment(table, seg_dir)
+            meta = self.controller.upload_segment(table, seg_dir, custom=custom)
         return json_response({"status": "OK", "segment": meta.name})
 
     def _get_segment(self, parts, params, body):
@@ -231,8 +239,73 @@ class ControllerService:
                 return binary_response(f.read())
 
     def _delete_segment(self, parts, params, body):
-        self.controller.delete_segment(parts[0], parts[1])
+        permanent = str(params.get("permanent", "")).lower() in ("true", "1")
+        self.controller.delete_segment(parts[0], parts[1], permanent=permanent)
         return json_response({"status": "OK"})
+
+    # -- minion task protocol -----------------------------------------------
+    def _tasks_post(self, parts, params, body):
+        """POST /tasks/claim {"worker", "taskTypes"} -> spec | null
+        POST /tasks/finish {"taskId", "worker", "error"} -> {"applied": bool}
+        POST /tasks/generate -> run every generator once (tests/admin)."""
+        from ..minion.tasks import TaskQueue
+        queue = TaskQueue(self.catalog)
+        op = parts[0] if parts else ""
+        d = json.loads(body.decode()) if body else {}
+        if op == "claim":
+            spec = queue.claim(d["worker"], list(d["taskTypes"]))
+            return json_response({"task": spec.to_json() if spec else None})
+        if op == "finish":
+            applied = queue.finish(d["taskId"], error=d.get("error", ""),
+                                   worker_id=d.get("worker"))
+            return json_response({"applied": applied})
+        if op == "generate":
+            specs = self.controller.task_manager.generate_all()
+            return json_response({"generated": [s.task_id for s in specs]})
+        if op == "gc":
+            # admin/ops: requeue stale RUNNING tasks (dead worker) + drop old
+            # terminal entries; leaseMs override lets operators force-release
+            n = queue.gc(lease_ms=int(d.get("leaseMs", 600_000)))
+            return json_response({"removed": n})
+        return error_response("claim|finish|generate|gc", 404)
+
+    def _tasks_get(self, parts, params, body):
+        """GET /tasks[?table=...&type=...] — task states (admin surface)."""
+        from ..minion.tasks import TaskQueue
+        out = TaskQueue(self.catalog).tasks(params.get("table") or None,
+                                            params.get("type") or None)
+        return json_response({"tasks": [t.to_json() for t in out]})
+
+    def _replace_segments(self, parts, params, body):
+        """POST /replaceSegments/{table} {"from": [names], "stagedTars":
+        [deep-store staging uris], "custom": {...}}: the minion stages the new
+        segment tars through the deep-store proxy first, then this endpoint
+        runs the controller's ATOMIC lineage swap (reference:
+        startReplaceSegments/endReplaceSegments)."""
+        table = parts[0]
+        from ..auth import require_table_access
+        require_table_access(table, "WRITE")
+        d = json.loads(body.decode())
+        new_dirs = []
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                for i, uri in enumerate(d.get("stagedTars", [])):
+                    local = os.path.join(tmp, f"staged_{i}.tar.gz")
+                    self.controller.deepstore.download(uri, local)
+                    new_dirs.append(untar_segment(local,
+                                                  os.path.join(tmp, f"d{i}")))
+                new_names = self.controller.replace_segments(
+                    table, list(d["from"]), new_dirs, custom=d.get("custom"))
+        finally:
+            # staged tars are consumed (or the swap failed) either way —
+            # leaving them would accumulate unbounded deep-store garbage
+            # across failed merge attempts
+            for uri in d.get("stagedTars", []):
+                try:
+                    self.controller.deepstore.delete(uri)
+                except Exception:
+                    pass
+        return json_response({"status": "OK", "segments": new_names})
 
     def _table_status(self, parts, params, body):
         return json_response(self.controller.table_status(parts[0]))
@@ -468,6 +541,67 @@ class ServerService:
 
     def _segments(self, parts, params, body):
         return json_response({"segments": self.server.segments_served(parts[0])})
+
+
+class MinionService:
+    """Minion role process: claims tasks from the controller and executes them
+    (reference: `pinot-minion/.../MinionStarter.java` — a worker that registers
+    with Helix, polls the task framework, and runs registered executors).
+
+    The claim loop runs on a daemon thread: claim one task, execute, repeat;
+    sleep `poll_s` when the queue is empty. Task failures never kill the loop
+    (MinionWorker.run_once already fences + records them)."""
+
+    def __init__(self, worker, host: str = "127.0.0.1", port: int = 0,
+                 poll_s: float = 1.0, access_control=None):
+        self.worker = worker
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self.http = HttpService(host, port, access_control=access_control)
+        self.http.route("GET", "health", self._health)
+        self.http.route("GET", "tasks", self._tasks)
+        self.http.route("GET", "metrics", _metrics_route)
+        self.http.start()
+        worker.catalog.register_instance(InstanceInfo(
+            worker.instance_id, "minion", host=self.http.host,
+            port=self.http.port))
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"{worker.instance_id}-loop")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def _loop(self) -> None:
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        while not self._stop.is_set():
+            try:
+                spec = self.worker.run_once()
+            except Exception:
+                # claim-transport hiccup (controller restarting): back off
+                reg.counter("pinot_minion_claim_errors").inc()
+                spec = None
+            if spec is None:
+                self._stop.wait(self.poll_s)
+            else:
+                reg.counter("pinot_minion_tasks_executed").inc()
+
+    def _health(self, parts, params, body):
+        return json_response({"status": "OK",
+                              "instance": self.worker.instance_id,
+                              "completed": self.worker.completed,
+                              "failed": self.worker.failed})
+
+    def _tasks(self, parts, params, body):
+        return json_response({"completed": self.worker.completed,
+                              "failed": self.worker.failed})
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.http.stop()
 
 
 class BrokerService:

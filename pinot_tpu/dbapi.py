@@ -130,7 +130,7 @@ def escape(value: Any) -> str:
 def _substitute(operation: str, parameters: Sequence[Any]) -> str:
     """Replace `?` placeholders with escaped values. A `?` inside a
     single-quoted string literal, a double-quoted identifier, or a `--` line
-    comment is literal text, not a parameter slot."""
+    / `/* */` block comment is literal text, not a parameter slot."""
     out: List[str] = []
     it = iter(parameters)
     i = 0
@@ -157,6 +157,12 @@ def _substitute(operation: str, parameters: Sequence[Any]) -> str:
             # -- line comment: verbatim to end of line
             j = operation.find("\n", i)
             j = n if j < 0 else j + 1
+            out.append(operation[i:j])
+            i = j
+        elif ch == "/" and i + 1 < n and operation[i + 1] == "*":
+            # /* block comment */: verbatim through the terminator
+            j = operation.find("*/", i + 2)
+            j = n if j < 0 else j + 2
             out.append(operation[i:j])
             i = j
         elif ch == "?":

@@ -476,7 +476,8 @@ class MinionWorker:
     same methods.
     """
 
-    def __init__(self, instance_id: str, catalog, deepstore, controller, work_dir: str):
+    def __init__(self, instance_id: str, catalog, deepstore, controller,
+                 work_dir: str, queue=None):
         from ..cluster.catalog import InstanceInfo
         self.instance_id = instance_id
         self.catalog = catalog
@@ -484,7 +485,10 @@ class MinionWorker:
         self.controller = controller
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)
-        self.queue = TaskQueue(catalog)
+        # remote minions claim through the controller's REST queue
+        # (RemoteTaskQueue) — a RemoteCatalog mirror cannot run the atomic
+        # read-modify-write a claim needs
+        self.queue = queue if queue is not None else TaskQueue(catalog)
         self.executors: Dict[str, TaskExecutor] = {}
         for ex in (MergeRollupTaskExecutor(), RealtimeToOfflineTaskExecutor(),
                    PurgeTaskExecutor()):

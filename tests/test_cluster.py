@@ -390,3 +390,51 @@ def test_crashed_server_segments_retried_in_buffered_path(lineorder_cluster):
     res = cluster.query("SELECT COUNT(*) FROM lineorder")
     assert res.rows[0][0] == 4000
     assert "segmentsUnavailable" not in res.stats
+
+
+def test_backpressured_server_segments_retried(lineorder_cluster):
+    """HTTP 429 / admission rejection on one replica: the segments retry on a
+    DIFFERENT healthy replica and the result stays complete (the overloaded
+    server keeps its routing slot — backpressure is the server working)."""
+    cluster, cfg = lineorder_cluster
+    from pinot_tpu.query.scheduler import QueryRejectedError
+
+    def throttled(table, ctx, segments, time_filter=None):
+        raise QueryRejectedError("admission queue full")
+
+    cluster.broker.register_server_handle("server_2", throttled)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000
+    assert "segmentsUnavailable" not in res.stats
+    assert "server_2" not in cluster.broker.routing.unhealthy_servers()
+
+
+def test_all_replicas_dead_segments_surface(lineorder_cluster):
+    """Every server holding a segment leaves live_servers (process death, not
+    just unhealthy-marking): the segment must still appear in the coverage
+    audit — previously it vanished from the routing table entirely and the
+    query returned short with partialResult=False."""
+    cluster, cfg = lineorder_cluster
+    for sid in ("server_0", "server_1", "server_2"):
+        cluster.kill_server(sid)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 0
+    assert res.stats["partialResult"] is True
+    assert len(res.stats["segmentsUnavailable"]) == 4
+
+
+def test_replica_local_error_fails_over(lineorder_cluster):
+    """One replica raises a replica-LOCAL error (corrupt file): the segment
+    retries on the healthy replica and the query completes; the error is only
+    raised when EVERY replica fails (deterministic bad query)."""
+    cluster, cfg = lineorder_cluster
+
+    orig = cluster.broker._servers["server_0"]
+
+    def corrupt(table, ctx, segments, time_filter=None):
+        raise ValueError("segment file corrupt on this replica")
+
+    cluster.broker.register_server_handle("server_0", corrupt)
+    res = cluster.query("SELECT COUNT(*) FROM lineorder")
+    assert res.rows[0][0] == 4000  # replication=2 covered everything
+    assert "server_0" not in cluster.broker.routing.unhealthy_servers()

@@ -146,3 +146,11 @@ def test_substitute_skips_comments_and_quoted_identifiers():
     # doubled "" escape inside an identifier
     sql = _substitute('SELECT "we""ird?" FROM t WHERE a = ?', [5])
     assert sql == 'SELECT "we""ird?" FROM t WHERE a = 5'
+
+
+def test_substitute_skips_block_comments():
+    sql = _substitute("SELECT /* what? */ a FROM t WHERE b = ?", [1])
+    assert sql == "SELECT /* what? */ a FROM t WHERE b = 1"
+    # unterminated block comment swallows the rest
+    sql = _substitute("SELECT a FROM t /* trailing?", [])
+    assert sql == "SELECT a FROM t /* trailing?"

@@ -1,0 +1,208 @@
+"""Minion as a REAL process role: claim over controller REST, inputs via the
+deep-store proxy, outputs via segment upload / atomic replace — zero in-proc
+shortcuts (reference: MinionStarter + Helix task framework, here the process
+spawned by `python -m pinot_tpu.cluster.process --role minion`).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.http_service import get_json, post_json
+from pinot_tpu.cluster.process import ProcessCluster
+from pinot_tpu.minion.tasks import MERGE_ROLLUP, REALTIME_TO_OFFLINE
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.segment.writer import SegmentBuilder
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+from conftest import wait_until
+
+DAY = 24 * 3600 * 1000
+
+
+def event_schema():
+    return Schema("events", [
+        dimension("site", DataType.STRING),
+        metric("clicks", DataType.LONG),
+        metric("cost", DataType.DOUBLE),
+        date_time("ts", DataType.LONG),
+    ])
+
+
+def make_cols(rng, n, day_ms):
+    return {
+        "site": rng.choice(["a.com", "b.com", "c.com"], n).tolist(),
+        "clicks": rng.integers(1, 10, n),
+        "cost": np.round(rng.uniform(0.1, 5.0, n), 3),
+        "ts": day_ms + rng.integers(0, DAY, n),
+    }
+
+
+def _tasks(cluster, **q):
+    qs = "&".join(f"{k}={v}" for k, v in q.items())
+    return get_json(f"{cluster.controller_url}/tasks" + (f"?{qs}" if qs else ""))[
+        "tasks"]
+
+
+def test_merge_rollup_executes_on_minion_process(tmp_path):
+    """Full distributed flow: controller generates, the MINION PROCESS claims
+    through REST, downloads inputs through the deep-store proxy, merges, and
+    swaps via the atomic replaceSegments endpoint — queries never see a
+    half-state and totals are unchanged."""
+    schema = event_schema()
+    yesterday = (int(time.time() * 1000) // DAY - 1) * DAY
+    rng = np.random.default_rng(31)
+    with ProcessCluster(num_servers=1, num_minions=1,
+                        work_dir=str(tmp_path)) as cluster:
+        cluster.controller.add_schema(schema)
+        cfg = TableConfig(schema.name, time_column="ts",
+                          task_configs={MERGE_ROLLUP: {"bucketMs": DAY}})
+        cluster.controller.add_table(cfg)
+        builder = SegmentBuilder(schema)
+        for i in range(3):
+            seg = builder.build(make_cols(rng, 100, yesterday),
+                                str(tmp_path / "build"), f"events_{i}")
+            cluster.controller.upload_segment(cfg.table_name_with_type, seg)
+
+        def count():
+            rows = cluster.query(
+                "SELECT COUNT(*), SUM(clicks) FROM events")["resultTable"]["rows"]
+            return tuple(rows[0]) if rows else (0, 0)
+        assert wait_until(lambda: count()[0] == 300, timeout=30)
+        before = count()
+
+        post_json(f"{cluster.controller_url}/tasks/generate", {})
+        assert wait_until(lambda: any(
+            t["state"] == "COMPLETED" and t["task_type"] == MERGE_ROLLUP
+            for t in _tasks(cluster)), timeout=60), _tasks(cluster)
+
+        # the merged segment replaced the three inputs atomically
+        def seg_names():
+            return list(cluster.controller.segments_meta(
+                cfg.table_name_with_type)["segments"])
+        assert wait_until(
+            lambda: len(seg_names()) == 1 and seg_names()[0].startswith("merged_"),
+            timeout=30), seg_names()
+        assert wait_until(lambda: count() == before, timeout=30), \
+            (count(), before)
+        done = [t for t in _tasks(cluster) if t["state"] == "COMPLETED"]
+        assert done[0]["worker"] == "minion_0"  # the PROCESS did the work
+
+
+def test_realtime_to_offline_over_processes(tmp_path):
+    """Hybrid flow with every role a real process: realtime consumption over a
+    TCP log broker, commit over HTTP, the minion process moving a closed
+    window into the OFFLINE half, the broker's time boundary keeping counts
+    exact throughout."""
+    from pinot_tpu.ingest.kafkalite import LogBrokerClient, LogBrokerServer
+    schema = event_schema()
+    day0 = (int(time.time() * 1000) // DAY - 3) * DAY
+    srv = LogBrokerServer()
+    try:
+        client = LogBrokerClient(srv.bootstrap)
+        client.create_topic("events_topic", 1)
+        with ProcessCluster(num_servers=1, num_minions=1,
+                            work_dir=str(tmp_path)) as cluster:
+            cluster.controller.add_schema(schema)
+            off_cfg = TableConfig(schema.name, table_type=TableType.OFFLINE,
+                                  time_column="ts")
+            cluster.controller.add_table(off_cfg)
+            rt_cfg = TableConfig(
+                schema.name, table_type=TableType.REALTIME, time_column="ts",
+                stream=StreamConfig(stream_type="kafkalite",
+                                    topic="events_topic",
+                                    properties={"bootstrap": srv.bootstrap},
+                                    flush_threshold_rows=40),
+                task_configs={REALTIME_TO_OFFLINE: {"bucketMs": DAY}})
+            cluster.controller.add_table(rt_cfg, num_partitions=1)
+
+            rng = np.random.default_rng(37)
+            total = 0
+            for day in (day0, day0 + DAY, day0 + 2 * DAY):
+                cols = make_cols(rng, 40, day)
+                for i in range(40):
+                    client.produce("events_topic", json.dumps(
+                        {k: (v[i].item() if isinstance(v[i], np.generic)
+                             else v[i]) for k, v in cols.items()}))
+                total += 40
+
+            def count():
+                rows = cluster.query(
+                    "SELECT COUNT(*) FROM events")["resultTable"]["rows"]
+                return rows[0][0] if rows else 0
+            assert wait_until(lambda: count() == total, timeout=40), count()
+            before = count()
+
+            post_json(f"{cluster.controller_url}/tasks/generate", {})
+            assert wait_until(lambda: any(
+                t["state"] == "COMPLETED"
+                and t["task_type"] == REALTIME_TO_OFFLINE
+                for t in _tasks(cluster)), timeout=60), _tasks(cluster)
+
+            def offline_segments():
+                try:
+                    return cluster.controller.segments_meta(
+                        off_cfg.table_name_with_type)["segments"]
+                except Exception:
+                    return {}
+            assert wait_until(lambda: len(offline_segments()) >= 1, timeout=30)
+            # hybrid count never double-counts across the time boundary
+            assert wait_until(lambda: count() == before, timeout=30), \
+                (count(), before)
+    finally:
+        srv.stop()
+
+
+def test_dead_minion_lease_requeues_to_live_worker(tmp_path):
+    """A worker that claimed a task and died: the lease gc requeues it, the
+    live minion process completes it, and the dead worker's late finish is
+    FENCED (ignored) — no loss, no double-apply."""
+    schema = event_schema()
+    yesterday = (int(time.time() * 1000) // DAY - 1) * DAY
+    rng = np.random.default_rng(41)
+    with ProcessCluster(num_servers=1, num_minions=1,
+                        work_dir=str(tmp_path)) as cluster:
+        cluster.controller.add_schema(schema)
+        cfg = TableConfig(schema.name, time_column="ts",
+                          task_configs={MERGE_ROLLUP: {"bucketMs": DAY}})
+        cluster.controller.add_table(cfg)
+        builder = SegmentBuilder(schema)
+        for i in range(2):
+            seg = builder.build(make_cols(rng, 60, yesterday),
+                                str(tmp_path / "build"), f"events_{i}")
+            cluster.controller.upload_segment(cfg.table_name_with_type, seg)
+
+        def count():
+            rows = cluster.query(
+                "SELECT COUNT(*), SUM(cost) FROM events")["resultTable"]["rows"]
+            return tuple(rows[0]) if rows else (0, 0.0)
+        assert wait_until(lambda: count()[0] == 120, timeout=30)
+        before = count()
+
+        # a "dead" worker claims the generated task and never finishes
+        post_json(f"{cluster.controller_url}/tasks/generate", {})
+        claimed = post_json(f"{cluster.controller_url}/tasks/claim",
+                            {"worker": "minion_dead",
+                             "taskTypes": [MERGE_ROLLUP]})["task"]
+        assert claimed is not None and claimed["worker"] == "minion_dead"
+
+        # lease expires -> gc requeues -> the LIVE minion process completes it
+        post_json(f"{cluster.controller_url}/tasks/gc", {"leaseMs": 0})
+        assert wait_until(lambda: any(
+            t["state"] == "COMPLETED" and t["worker"] == "minion_0"
+            for t in _tasks(cluster)), timeout=60), _tasks(cluster)
+
+        # the dead worker's late completion must not apply (fencing)
+        resp = post_json(f"{cluster.controller_url}/tasks/finish",
+                         {"taskId": claimed["task_id"],
+                          "worker": "minion_dead", "error": ""})
+        assert resp["applied"] is False
+
+        # differential: data identical after the merge
+        assert wait_until(lambda: count()[0] == before[0], timeout=30)
+        assert count()[1] == pytest.approx(before[1], rel=1e-6)
+        segs = cluster.controller.segments_meta(
+            cfg.table_name_with_type)["segments"]
+        assert len(segs) == 1 and next(iter(segs)).startswith("merged_")

@@ -191,6 +191,7 @@ def test_service_manager_all_roles_one_process(tmp_path):
         assert wait_until(lambda: bc.query("SELECT SUM(v) FROM svc")
                           ["resultTable"]["rows"][0][0] == 3.0)
     finally:
+        handles["minion"].stop()  # claim loop first: it polls the controller
         handles["server_obj"].shutdown()
         handles["controller_obj"].stop_periodic_tasks()
         for c in handles["catalogs"]:
