@@ -458,6 +458,7 @@ class Broker:
                 handle = self._servers.get(server_id)
                 partial = None
                 missed: Set[str] = set(segments)
+                query_error: Optional[Exception] = None
                 if handle is not None:
                     try:
                         partial = handle(table, ctx, segments, tf)
@@ -468,7 +469,10 @@ class Broker:
                             self.routing.mark_server_unhealthy(server_id)
                             self.failure_detector.notify_unhealthy(server_id)
                         elif not _is_backpressure(e):
-                            raise  # deterministic query error — not retryable
+                            # same failover policy as the buffered path: the
+                            # segments retry on another replica; only an error
+                            # that survives the retry (deterministic) raises
+                            query_error = e
                 if missed:
                     # same completeness contract as the buffered path: retry
                     # unserved segments on another replica; an export that
@@ -479,6 +483,8 @@ class Broker:
                     uncovered = _uncovered_after_retry(
                         {s: set() for s in missed}, retries)
                     if failed or uncovered:
+                        if query_error is not None:
+                            raise query_error
                         raise RuntimeError(
                             f"streaming export incomplete: segments "
                             f"{sorted(uncovered)} unavailable on all replicas")

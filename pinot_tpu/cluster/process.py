@@ -439,11 +439,7 @@ class ProcessCluster:
             proc.kill()
             proc.wait()
 
-    def restart_server(self, instance_id: str) -> str:
-        """Start a fresh server process under the same instance id (reference:
-        server restart recovery — it re-registers, reloads its assigned
-        segments from the deep store, and resumes consuming from the
-        checkpointed offsets). Returns the new process's URL."""
+    def _restart(self, instance_id: str, role: str) -> str:
         proc = self.procs.get(instance_id)
         if proc is not None and proc.poll() is None:
             proc.kill()
@@ -451,28 +447,24 @@ class ProcessCluster:
         ready = os.path.join(self.run_dir, f"{instance_id}.ready")
         if os.path.exists(ready):
             os.remove(ready)  # _await_ready must see the NEW process's file
-        self._spawn(instance_id, ["--role", "server",
+        self._spawn(instance_id, ["--role", role,
                                   "--instance-id", instance_id,
                                   "--controller-url", self.controller_url,
                                   "--work-dir", self.work_dir])
         return self._await_ready(instance_id)
 
+    def restart_server(self, instance_id: str) -> str:
+        """Start a fresh server process under the same instance id (reference:
+        server restart recovery — it re-registers, reloads its assigned
+        segments from the deep store, and resumes consuming from the
+        checkpointed offsets). Returns the new process's URL."""
+        return self._restart(instance_id, "server")
+
     def restart_minion(self, instance_id: str) -> str:
         """Fresh minion process under the same id (after a kill): it resumes
         claiming from the controller queue; lease gc requeues whatever the
         dead incarnation held."""
-        proc = self.procs.get(instance_id)
-        if proc is not None and proc.poll() is None:
-            proc.kill()
-            proc.wait()
-        ready = os.path.join(self.run_dir, f"{instance_id}.ready")
-        if os.path.exists(ready):
-            os.remove(ready)
-        self._spawn(instance_id, ["--role", "minion",
-                                  "--instance-id", instance_id,
-                                  "--controller-url", self.controller_url,
-                                  "--work-dir", self.work_dir])
-        return self._await_ready(instance_id)
+        return self._restart(instance_id, "minion")
 
     def shutdown(self) -> None:
         for proc in self.procs.values():

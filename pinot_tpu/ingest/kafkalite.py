@@ -1,18 +1,19 @@
-"""KafkaLite: a Kafka-protocol-shaped TCP log broker + the stream plugin for it.
+"""KafkaLite: a Kafka-wire-protocol TCP log broker + the stream plugin for it.
 
 The reference ships a Kafka consumer plugin (`pinot-plugins/pinot-stream-ingestion/
 pinot-kafka-2.0/.../KafkaPartitionLevelConsumer.java`) against an external Kafka
 cluster; this module provides both halves so the stream SPI is proven against a REAL
-socket boundary with Kafka's model intact:
+socket boundary speaking Kafka's ACTUAL binary encoding (`ingest/kafka_wire.py`):
 
 * `LogBrokerServer` — partitioned, offset-addressed, append-only topic logs served
-  over TCP. The wire protocol mirrors Kafka's shape: length-prefixed frames, an apiKey
-  + correlationId header, and PRODUCE / FETCH / LIST_OFFSETS / METADATA /
-  CREATE_TOPICS request types (JSON bodies instead of Kafka's binary encoding — the
-  *protocol semantics*, long-polling FETCH included, are what the consumer exercises).
-  Optional file-backed logs (JSONL per partition) survive broker restarts.
+  over TCP with Kafka framing: length-prefixed frames, the int16 api_key/api_version
+  + int32 correlation_id header, ApiVersions / Metadata / ListOffsets / Fetch (with
+  `max_wait_ms` long-polling) / Produce / CreateTopics bodies, and record batches in
+  the v2 (magic=2, CRC-32C, zigzag-varint) format — so a stock Kafka client can
+  produce into it and our consumer fetches real Kafka frames. Optional file-backed
+  logs (JSONL per partition) survive broker restarts.
 * `KafkaLiteConsumer` / `KafkaLiteFactory` — the plugin side: implements
-  `PartitionGroupConsumer`/`StreamConsumerFactory` purely in terms of the socket
+  `PartitionGroupConsumer`/`StreamConsumerFactory` purely in terms of the binary
   client, registering as stream type "kafkalite". The realtime consumption FSM
   (`ingest/realtime.py`) runs against it UNCHANGED — the SPI claim the reference
   makes for its Kafka plugin, demonstrated end-to-end in tests/test_kafkalite.py.
@@ -25,33 +26,13 @@ import os
 import socket
 import struct
 import threading
+import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import kafka_wire as kw
 from .stream import (MessageBatch, PartitionGroupConsumer, StreamConsumerFactory,
                      StreamMessage, StreamMetadataProvider, register_stream_factory)
-
-# api keys (named after their Kafka counterparts)
-PRODUCE = "Produce"
-FETCH = "Fetch"
-LIST_OFFSETS = "ListOffsets"
-METADATA = "Metadata"
-CREATE_TOPICS = "CreateTopics"
-
-
-def _send_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
-    payload = json.dumps(obj).encode()
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
-    header = _recv_exact(sock, 4)
-    if header is None:
-        return None
-    (n,) = struct.unpack(">I", header)
-    payload = _recv_exact(sock, n)
-    if payload is None:
-        return None
-    return json.loads(payload.decode())
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -62,6 +43,26 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
             return None
         buf += chunk
     return buf
+
+
+def _recv_payload(sock: socket.socket) -> Optional[bytes]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack(">i", header)
+    return _recv_exact(sock, n)
+
+
+def _to_bytes(v: Any) -> bytes:
+    if v is None:
+        return b""
+    if isinstance(v, bytes):
+        return v
+    return str(v).encode("utf-8", "surrogateescape")
+
+
+def _to_str(b: Optional[bytes]) -> Optional[str]:
+    return None if b is None else b.decode("utf-8", "surrogateescape")
 
 
 class _PartitionLog:
@@ -153,71 +154,104 @@ class LogBrokerServer:
         with conn:
             while not self._stop.is_set():
                 try:
-                    req = _recv_frame(conn)
+                    payload = _recv_payload(conn)
                 except OSError:
                     return
-                if req is None:
+                if payload is None:
                     return
-                resp = {"correlationId": req.get("correlationId")}
                 try:
-                    resp.update(self._handle(req))
-                except Exception as e:
-                    resp["error"] = f"{type(e).__name__}: {e}"
+                    api, version, cid, _client, r = kw.decode_request_header(payload)
+                    body = self._handle(api, version, r)
+                except Exception:
+                    return  # malformed frame: drop the connection (Kafka does)
                 try:
-                    _send_frame(conn, resp)
+                    conn.sendall(kw.encode_response(cid, body))
                 except OSError:
                     return
 
-    def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
-        api = req["apiKey"]
-        if api == CREATE_TOPICS:
-            self.create_topic(req["topic"], int(req["numPartitions"]))
-            return {}
-        if api == METADATA:
+    def _handle(self, api: int, version: int, r: kw.Reader) -> bytes:
+        lo_hi = kw.SUPPORTED.get(api)
+        if lo_hi is None or not lo_hi[0] <= version <= lo_hi[1]:
+            if api == kw.API_API_VERSIONS:
+                # spec: answer v0 with UNSUPPORTED_VERSION so the client can
+                # downgrade its handshake
+                return kw.i16(kw.ERR_UNSUPPORTED_VERSION) + kw.array([])
+            raise ValueError(f"unsupported api {api} v{version}")
+        if api == kw.API_API_VERSIONS:
+            return kw.encode_api_versions_response()
+        if api == kw.API_CREATE_TOPICS:
+            results = []
+            for name, n in kw.decode_create_topics_request(r):
+                self.create_topic(name, n)
+                results.append((name, kw.ERR_NONE))
+            return kw.encode_create_topics_response(results)
+        if api == kw.API_METADATA:
+            wanted = kw.decode_metadata_request(r)
             with self._lock:
-                if req.get("topic"):
-                    logs = self._topics.get(req["topic"])
-                    if logs is None:
-                        raise KeyError(f"unknown topic {req['topic']!r}")
-                    return {"numPartitions": len(logs)}
-                return {"topics": {t: len(ls) for t, ls in self._topics.items()}}
-        if api == PRODUCE:
+                topics = {t: len(ls) for t, ls in self._topics.items()
+                          if wanted is None or not wanted or t in wanted}
+            return kw.encode_metadata_response(version, self.host, self.port,
+                                               topics)
+        if api == kw.API_PRODUCE:
+            results = []
+            for topic, partition, record_set in kw.decode_produce_request(r):
+                with self._lock:
+                    logs = self._topics.get(topic)
+                    if logs is None or not 0 <= partition < len(logs):
+                        results.append((topic, partition,
+                                        kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1))
+                        continue
+                    base = None
+                    for _off, ts, key, value in kw.decode_record_batches(record_set):
+                        o = logs[partition].append(_to_str(value), _to_str(key),
+                                                   int(ts))
+                        base = o if base is None else base
+                    self._data_arrived.notify_all()
+                results.append((topic, partition, kw.ERR_NONE,
+                                -1 if base is None else base))
+            return kw.encode_produce_response(results)
+        if api == kw.API_LIST_OFFSETS:
+            results = []
             with self._lock:
-                logs = self._topics[req["topic"]]
-                partition = req.get("partition")
-                if partition is None:
-                    key = req.get("key")
-                    if key is not None:
-                        # stable across processes/restarts (Python's hash() is
-                        # salted per process and would break key->partition
-                        # affinity over the file-backed logs)
-                        import zlib
-                        partition = zlib.crc32(str(key).encode()) % len(logs)
-                    else:
-                        partition = sum(len(l.records) for l in logs) % len(logs)
-                offset = logs[partition].append(req["value"], req.get("key"),
-                                                int(req.get("timestampMs", 0)))
-                self._data_arrived.notify_all()
-            return {"partition": partition, "offset": offset}
-        if api == LIST_OFFSETS:
-            with self._lock:
-                log = self._topics[req["topic"]][req["partition"]]
-                return {"earliest": 0, "latest": len(log.records)}
-        if api == FETCH:
-            start = int(req["offset"])
-            max_messages = int(req.get("maxMessages", 500))
-            timeout_ms = int(req.get("timeoutMs", 0))
-            deadline = timeout_ms / 1000.0
-            with self._lock:
-                log = self._topics[req["topic"]][req["partition"]]
-                if start >= len(log.records) and timeout_ms > 0:
-                    # long-poll like Kafka's fetch.max.wait.ms
-                    self._data_arrived.wait(deadline)
-                records = log.records[start:start + max_messages]
-            return {"messages": [{"v": v, "k": k, "t": t, "o": start + i}
-                                 for i, (v, k, t) in enumerate(records)],
-                    "nextOffset": start + len(records)}
-        raise ValueError(f"unknown apiKey {api!r}")
+                for topic, partition, ts in kw.decode_list_offsets_request(r):
+                    logs = self._topics.get(topic)
+                    if logs is None or not 0 <= partition < len(logs):
+                        results.append((topic, partition,
+                                        kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
+                        continue
+                    off = 0 if ts == kw.EARLIEST_TS else len(logs[partition].records)
+                    results.append((topic, partition, kw.ERR_NONE, -1, off))
+            return kw.encode_list_offsets_response(results)
+        if api == kw.API_FETCH:
+            max_wait, _max_bytes, parts = kw.decode_fetch_request(r)
+            results = []
+            for topic, partition, offset, part_max_bytes in parts:
+                with self._lock:
+                    logs = self._topics.get(topic)
+                    if logs is None or not 0 <= partition < len(logs):
+                        results.append((topic, partition,
+                                        kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
+                        continue
+                    log = logs[partition]
+                    if offset >= len(log.records) and max_wait > 0:
+                        # long-poll like Kafka's fetch.max.wait.ms
+                        self._data_arrived.wait(max_wait / 1000.0)
+                    records = []
+                    size = 0
+                    # bounded slice: never copy the whole log tail under the
+                    # broker lock — O(batch), not O(partition)
+                    for v, k, t in log.records[offset:offset + 500]:
+                        vb = _to_bytes(v)
+                        records.append((None if k is None else _to_bytes(k), vb,
+                                        int(t)))
+                        size += len(vb) + 32
+                        if size >= max(part_max_bytes, 1) or len(records) >= 500:
+                            break
+                    hw = len(log.records)
+                record_set = kw.encode_record_batch(offset, records)
+                results.append((topic, partition, kw.ERR_NONE, hw, record_set))
+            return kw.encode_fetch_response(results)
+        raise ValueError(f"unhandled api {api}")
 
     def stop(self) -> None:
         self._stop.set()
@@ -232,36 +266,115 @@ class LogBrokerServer:
 
 
 class LogBrokerClient:
-    """One TCP connection to the broker; thread-safe request/response."""
+    """One TCP connection speaking the Kafka binary protocol; thread-safe
+    request/response. Negotiates with ApiVersions on connect, exactly like a
+    stock client's bootstrap handshake."""
 
-    def __init__(self, bootstrap: str, timeout_s: float = 30.0):
+    def __init__(self, bootstrap: str, timeout_s: float = 30.0,
+                 client_id: str = "pinot-tpu"):
         host, port = bootstrap.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=timeout_s)
         self._lock = threading.Lock()
         self._correlation = 0
+        self.client_id = client_id
+        self._rr: Dict[str, int] = {}          # producer round-robin per topic
+        self._partitions: Dict[str, int] = {}  # cached partition counts
+        self.api_versions = kw.decode_api_versions_response(
+            self._request(kw.API_API_VERSIONS, 0, b""))
 
-    def request(self, api: str, **fields) -> Dict[str, Any]:
+    def _request(self, api: int, version: int, body: bytes) -> kw.Reader:
         with self._lock:
             self._correlation += 1
             cid = self._correlation
-            _send_frame(self._sock, {"apiKey": api, "correlationId": cid, **fields})
-            resp = _recv_frame(self._sock)
-        if resp is None:
+            self._sock.sendall(kw.encode_request(api, version, cid,
+                                                 self.client_id, body))
+            payload = _recv_payload(self._sock)
+        if payload is None:
             raise ConnectionError("broker closed the connection")
-        if resp.get("correlationId") != cid:
+        r = kw.Reader(payload)
+        if r.i32() != cid:
             raise ConnectionError("correlation id mismatch")
-        if resp.get("error"):
-            raise RuntimeError(resp["error"])
-        return resp
+        return r
 
+    # -- admin / metadata ---------------------------------------------------
     def create_topic(self, topic: str, num_partitions: int) -> None:
-        self.request(CREATE_TOPICS, topic=topic, numPartitions=num_partitions)
+        r = self._request(kw.API_CREATE_TOPICS, 0,
+                          kw.encode_create_topics_request(topic, num_partitions))
+        for name, err in kw.decode_create_topics_response(r):
+            if err:
+                raise RuntimeError(f"CreateTopics {name}: error {err}")
+        self._partitions.pop(topic, None)
 
+    def metadata(self, topic: Optional[str] = None) -> Dict[str, Any]:
+        body = kw.encode_metadata_request(None if topic is None else [topic])
+        return kw.decode_metadata_response(
+            1, self._request(kw.API_METADATA, 1, body))
+
+    def partition_count(self, topic: str) -> int:
+        n = self._partitions.get(topic)
+        if n is None:
+            meta = self.metadata(topic)
+            for t in meta["topics"]:
+                if t["topic"] == topic:
+                    if t["error"]:
+                        raise RuntimeError(f"metadata {topic}: error {t['error']}")
+                    n = len(t["partitions"])
+            if n is None:
+                raise RuntimeError(f"unknown topic {topic!r}")
+            self._partitions[topic] = n
+        return n
+
+    def partition_for(self, topic: str, key: str) -> int:
+        """The partition a keyed produce will land on (client-side hashing,
+        stable across processes — Python's salted hash() would not be)."""
+        return zlib.crc32(str(key).encode()) % self.partition_count(topic)
+
+    # -- data plane ----------------------------------------------------------
     def produce(self, topic: str, value: Any, partition: Optional[int] = None,
                 key: Optional[str] = None, timestamp_ms: int = 0) -> int:
-        resp = self.request(PRODUCE, topic=topic, value=value, partition=partition,
-                            key=key, timestampMs=timestamp_ms)
-        return resp["offset"]
+        if partition is None:
+            # client-side partitioning, like a stock producer: key hash when
+            # keyed (stable across processes), round-robin otherwise
+            n = self.partition_count(topic)
+            if key is not None:
+                partition = zlib.crc32(str(key).encode()) % n
+            else:
+                partition = self._rr.get(topic, 0) % n
+                self._rr[topic] = partition + 1
+        ts = timestamp_ms or int(time.time() * 1000)
+        record_set = kw.encode_record_batch(
+            0, [(None if key is None else _to_bytes(key), _to_bytes(value), ts)])
+        r = self._request(kw.API_PRODUCE, 3,
+                          kw.encode_produce_request(topic, partition, record_set))
+        for d in kw.decode_produce_response(r):
+            if d["error"]:
+                raise RuntimeError(f"Produce {topic}/{partition}: "
+                                   f"error {d['error']}")
+            return d["offset"]
+        raise RuntimeError("empty produce response")
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_wait_ms: int = 0, max_bytes: int = 1 << 20) -> List[Dict]:
+        r = self._request(kw.API_FETCH, 4,
+                          kw.encode_fetch_request(topic, partition, offset,
+                                                  max_wait_ms, max_bytes))
+        for d in kw.decode_fetch_response(r):
+            if d["error"]:
+                raise RuntimeError(f"Fetch {topic}/{partition}: error {d['error']}")
+            return d["records"]
+        return []
+
+    def list_offsets(self, topic: str, partition: int,
+                     timestamp: int = kw.LATEST_TS) -> int:
+        r = self._request(kw.API_LIST_OFFSETS, 1,
+                          kw.encode_list_offsets_request(topic, partition,
+                                                         timestamp))
+        for d in kw.decode_list_offsets_response(r):
+            if d["error"]:
+                raise RuntimeError(f"ListOffsets {topic}/{partition}: "
+                                   f"error {d['error']}")
+            return d["offset"]
+        raise RuntimeError("empty ListOffsets response")
 
     def close(self) -> None:
         try:
@@ -273,7 +386,7 @@ class LogBrokerClient:
 # -- the stream SPI plugin ----------------------------------------------------
 
 class KafkaLiteConsumer(PartitionGroupConsumer):
-    """PartitionGroupConsumer over the socket client (the
+    """PartitionGroupConsumer over the binary client (the
     KafkaPartitionLevelConsumer analog)."""
 
     def __init__(self, bootstrap: str, topic: str, partition: int):
@@ -282,16 +395,17 @@ class KafkaLiteConsumer(PartitionGroupConsumer):
         self.partition = partition
 
     def fetch(self, start_offset: int, max_messages: int, timeout_ms: int = 0) -> MessageBatch:
-        resp = self.client.request(FETCH, topic=self.topic, partition=self.partition,
-                                   offset=start_offset, maxMessages=max_messages,
-                                   timeoutMs=timeout_ms)
-        msgs = [StreamMessage(value=m["v"], offset=m["o"], key=m.get("k"),
-                              timestamp_ms=m.get("t", 0)) for m in resp["messages"]]
-        return MessageBatch(msgs, resp["nextOffset"])
+        records = self.client.fetch(self.topic, self.partition, start_offset,
+                                    max_wait_ms=timeout_ms)
+        records = records[:max_messages]
+        msgs = [StreamMessage(value=_to_str(value), offset=off,
+                              key=_to_str(key), timestamp_ms=ts)
+                for off, ts, key, value in records]
+        next_offset = msgs[-1].offset + 1 if msgs else start_offset
+        return MessageBatch(msgs, next_offset)
 
     def latest_offset(self) -> int:
-        return self.client.request(LIST_OFFSETS, topic=self.topic,
-                                   partition=self.partition)["latest"]
+        return self.client.list_offsets(self.topic, self.partition)
 
     def close(self) -> None:
         self.client.close()
@@ -317,8 +431,7 @@ class KafkaLiteFactory(StreamConsumerFactory):
             def partition_count(self, topic: str) -> int:
                 client = LogBrokerClient(factory.bootstrap)
                 try:
-                    return client.request(METADATA,
-                                          topic=topic or factory.topic)["numPartitions"]
+                    return client.partition_count(topic or factory.topic)
                 finally:
                     client.close()
 

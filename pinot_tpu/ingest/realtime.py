@@ -59,7 +59,8 @@ class RealtimePartitionConsumer:
         self.state = INITIAL_CONSUMING
         self.mutable = MutableSegment(
             segment_name, schema,
-            text_index_columns=table_cfg.indexing.text_index_columns)
+            text_index_columns=table_cfg.indexing.text_index_columns,
+            inverted_index_columns=table_cfg.indexing.inverted_index_columns)
         self.pipeline = pipeline or TransformPipeline(schema)
         self.upsert = upsert                    # TableUpsertMetadataManager or None
         self.dedup = dedup                      # PartitionDedupMetadataManager or None

@@ -473,6 +473,21 @@ def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
         leaf = prog.leaves[i]
         if isinstance(leaf, LutLeaf):
             reader = seg.column(leaf.col)
+            inv = getattr(reader, "inverted_index", None)
+            if inv is not None:
+                # index-aware path (reference: BitmapBasedFilterOperator;
+                # realtime segments serve it from the incrementally-maintained
+                # RealtimeInvertedIndex view): selective predicates
+                # materialize the doc set from postings — O(matches) instead
+                # of the O(docs) forward gather; dense predicates keep the
+                # gather, which is cheaper than concatenating huge postings
+                card = min(inv.cardinality, len(leaf.lut))
+                match_ids = np.nonzero(leaf.lut[:card])[0]
+                if inv.match_count_for_ids(match_ids) * 8 <= n:
+                    mask = np.zeros(n, dtype=bool)
+                    docs = inv.doc_ids_for_ids(match_ids)
+                    mask[docs[docs < n]] = True
+                    return mask
             if getattr(reader, "is_multi_value", False):
                 # ANY-value-matches per row (MVScanDocIdIterator semantics); every
                 # row has >= 1 value (writer stores [null] for empty), so reduceat

@@ -69,3 +69,61 @@ class InvertedIndexReader:
         if lo >= hi:
             return 0
         return int(self._offsets[hi] - self._offsets[lo])
+
+    def match_count_for_ids(self, dict_ids: Sequence[int]) -> int:
+        ids = np.asarray(dict_ids, dtype=np.int64)
+        return int(np.sum(self._offsets[ids + 1] - self._offsets[ids]))
+
+
+class MutableInvertedIndex:
+    """Incrementally-maintained realtime inverted index (reference:
+    `pinot-segment-local/.../realtime/impl/invertedindex/RealtimeInvertedIndex.java`).
+
+    Postings are keyed by VALUE, not dict id: the consuming segment's
+    append-order dictionary is re-sorted at every query snapshot, so value keys
+    stay stable while ids do not. One writer appends; `view()` binds a
+    point-in-time (sorted dictionary, row count) pair, mapping sorted dict ids
+    back to value-keyed postings and trimming them to the snapshot row count —
+    append-order postings are ascending, so the trim is one bisect."""
+
+    def __init__(self):
+        self._postings: dict = {}
+
+    def add_doc(self, value, doc_id: int) -> None:
+        vals = value if isinstance(value, (list, tuple)) else (value,)
+        for v in vals:
+            self._postings.setdefault(v, []).append(doc_id)
+
+    def view(self, dictionary, n_docs: int) -> "MutableInvertedView":
+        return MutableInvertedView(self._postings, dictionary, n_docs)
+
+
+class MutableInvertedView:
+    """Point-in-time reader with the same surface the immutable CSR reader
+    exposes to the filter path (doc_ids_for / doc_ids_for_ids /
+    match_count_for_ids)."""
+
+    def __init__(self, postings: dict, dictionary, n_docs: int):
+        self._postings = postings
+        self._dictionary = dictionary
+        self._n = n_docs
+
+    @property
+    def cardinality(self) -> int:
+        return len(self._dictionary)
+
+    def _list_for(self, dict_id: int) -> list:
+        lst = self._postings.get(self._dictionary.get(dict_id), ())
+        import bisect
+        return lst[:bisect.bisect_left(lst, self._n)]
+
+    def doc_ids_for(self, dict_id: int) -> np.ndarray:
+        return np.asarray(self._list_for(dict_id), dtype=np.int32)
+
+    def doc_ids_for_ids(self, dict_ids: Sequence[int]) -> np.ndarray:
+        parts = [self._list_for(i) for i in dict_ids]
+        flat = [d for p in parts for d in p]
+        return np.sort(np.asarray(flat, dtype=np.int32))
+
+    def match_count_for_ids(self, dict_ids: Sequence[int]) -> int:
+        return sum(len(self._list_for(i)) for i in dict_ids)

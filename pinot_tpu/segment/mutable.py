@@ -148,8 +148,18 @@ class MutableColumnReader:
         v = self.values()
         return None if not len(v) else (v.max() if not self.has_dictionary else max(v))
 
-    # aux indexes don't exist while consuming (realtime inverted index comes later)
-    inverted_index = None
+    @property
+    def inverted_index(self):
+        """Point-in-time view of the realtime inverted index (reference:
+        RealtimeInvertedIndex), id-space-consistent with THIS reader's sorted
+        dictionary snapshot; None when the column isn't inverted-indexed."""
+        idx = self.store.inverted_indexes.get(self.name)
+        if idx is None or not self.has_dictionary:
+            return None
+        n, d = self._snapshot()[:2]
+        return idx.view(d, n) if d is not None else None
+
+    # other aux indexes don't exist while consuming (range/bloom start at commit)
     range_index = None
     bloom_filter = None
     index_types: List[str] = []
@@ -192,7 +202,8 @@ class MutableSegment:
     is_mutable = True
 
     def __init__(self, name: str, schema: Schema,
-                 text_index_columns: Sequence[str] = ()):
+                 text_index_columns: Sequence[str] = (),
+                 inverted_index_columns: Sequence[str] = ()):
         self.name = name
         self.schema = schema
         self.columns: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
@@ -205,6 +216,13 @@ class MutableSegment:
         from .indexes.text import MutableTextIndex
         self.text_indexes: Dict[str, MutableTextIndex] = {
             c: MutableTextIndex() for c in text_index_columns
+            if schema.has_column(c)}
+        # realtime inverted indexes (reference: RealtimeInvertedIndex) — only
+        # meaningful on dict-encoded readers (strings / MV); numeric raw
+        # columns have no dict-id space while consuming
+        from .indexes.inverted import MutableInvertedIndex
+        self.inverted_indexes: Dict[str, MutableInvertedIndex] = {
+            c: MutableInvertedIndex() for c in inverted_index_columns
             if schema.has_column(c)}
 
     @property
@@ -234,6 +252,9 @@ class MutableSegment:
             idx = self.text_indexes.get(spec.name)
             if idx is not None:
                 idx.add_doc(v)
+            inv = self.inverted_indexes.get(spec.name)
+            if inv is not None:
+                inv.add_doc(v, n)
         self._num_docs = n + 1  # publish the row (single atomic int store)
 
     def column(self, name: str) -> MutableColumnReader:

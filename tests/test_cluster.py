@@ -438,3 +438,20 @@ def test_replica_local_error_fails_over(lineorder_cluster):
     res = cluster.query("SELECT COUNT(*) FROM lineorder")
     assert res.rows[0][0] == 4000  # replication=2 covered everything
     assert "server_0" not in cluster.broker.routing.unhealthy_servers()
+
+
+def test_stream_query_replica_local_error_fails_over(lineorder_cluster):
+    """Streaming export: a replica-local error retries on the healthy replica
+    (same policy as the buffered path) instead of aborting the export."""
+    cluster, cfg = lineorder_cluster
+
+    def corrupt(table, ctx, segments, time_filter=None):
+        raise ValueError("segment file corrupt on this replica")
+
+    cluster.broker.register_server_handle("server_1", corrupt)
+    rows = []
+    for kind, payload in cluster.broker.stream_query(
+            "SELECT lo_custkey FROM lineorder LIMIT 100000"):
+        if kind == "rows":
+            rows.extend(payload)
+    assert len(rows) == 4000

@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from pinot_tpu.cluster.enclosure import QuickCluster
-from pinot_tpu.ingest.kafkalite import (FETCH, KafkaLiteConsumer, LogBrokerClient,
+from pinot_tpu.ingest.kafkalite import (KafkaLiteConsumer, LogBrokerClient,
                                         LogBrokerServer)
 from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
 from pinot_tpu.table import StreamConfig, TableConfig, TableType
@@ -50,10 +50,12 @@ def test_key_partitioning_and_metadata(broker):
     factory = get_stream_factory("kafkalite", "keyed",
                                  {"bootstrap": broker.bootstrap})
     assert factory.metadata_provider().partition_count("keyed") == 4
-    # same key -> same partition
-    p1 = client.request("Produce", topic="keyed", value="a", key="k1")["partition"]
-    p2 = client.request("Produce", topic="keyed", value="b", key="k1")["partition"]
-    assert p1 == p2
+    # same key -> same partition (client-side hashing, like a stock producer)
+    p1 = client.partition_for("keyed", "k1")
+    assert client.partition_for("keyed", "k1") == p1
+    client.produce("keyed", "a", key="k1")
+    client.produce("keyed", "b", key="k1")
+    assert client.list_offsets("keyed", p1) == 2
     client.close()
 
 
