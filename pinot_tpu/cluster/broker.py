@@ -150,8 +150,10 @@ class Broker:
         `explain_handle(table, ctx, segments) -> rows` serves EXPLAIN PLAN;
         `probe() -> bool` lets the failure detector re-admit the server after a
         transport failure (no probe = manual recovery only);
-        `stage_handle(spec, left, right) -> block` runs one multistage join
-        partition on the server (the worker-mailbox analog)."""
+        `stage_handle(spec, left, right, agg=None) -> block | SegmentResult`
+        runs one multistage stage partition on the server — the hash join,
+        plus the partial GROUP BY when `agg` (an AggStageSpec) is given (the
+        worker-mailbox + partial-AggregateOperator analog)."""
         with self._lock:
             self._servers[server_id] = handle
             if explain_handle is not None:
@@ -618,12 +620,13 @@ class Broker:
             return self.catalog.schema_for_table(phys[0]) if phys else None
 
         def stage_runner():
-            """Round-robin dispatch of join partitions to HEALTHY server
-            workers (the reference's intermediate-stage workers); local
-            fallback when no worker is wired or a dispatch fails mid-query."""
+            """Round-robin dispatch of join(+partial-agg) partitions to
+            HEALTHY server workers (the reference's intermediate-stage
+            workers); local fallback when no worker is wired or a dispatch
+            fails mid-query."""
             import itertools
 
-            from ..multistage.runtime import hash_join
+            from ..multistage.runtime import run_join_stage
             from ..utils.metrics import get_registry
             unhealthy = self.routing.unhealthy_servers()
             with self._lock:
@@ -634,14 +637,14 @@ class Broker:
             rr = itertools.count()
             lock = threading.Lock()
 
-            def run(spec, lp, rp):
+            def run(spec, lp, rp, agg=None):
                 with lock:
                     pool = list(workers)
                 if not pool:
-                    return hash_join(lp, rp, spec)
+                    return run_join_stage(spec, lp, rp, agg)
                 sid, h = pool[next(rr) % len(pool)]
                 try:
-                    return h(spec, lp, rp)
+                    return h(spec, lp, rp, agg)
                 except Exception as e:
                     # degrade to broker-local execution, but VISIBLY: a
                     # transport-failed worker leaves routing until its probe
@@ -656,7 +659,7 @@ class Broker:
                         with lock:
                             workers[:] = [(s, hh) for s, hh in workers
                                           if s != sid]
-                    return hash_join(lp, rp, spec)
+                    return run_join_stage(spec, lp, rp, agg)
             return run
 
         def scan(raw_table: str, columns, filt):

@@ -304,17 +304,53 @@ class RemoteServerHandle:
                          content_type="application/octet-stream")
         return json.loads(resp.decode())["rows"]
 
-    def join_stage(self, spec, left, right):
-        """Run one multistage join partition on the remote server (POST /stage
-        with wire-encoded blocks — the worker-mailbox dispatch)."""
-        from ..multistage.runtime import spec_to_json
-        from .wire import decode_block, decode_value, encode_value
+    def join_stage(self, spec, left, right, agg=None):
+        """Run one multistage stage partition on the remote server (POST
+        /stage with wire-encoded blocks — the worker-mailbox dispatch). The
+        response is a chunked stream of length-prefixed frames: joined-row
+        block frames are consumed incrementally (bounded buffering), a
+        partial-aggregation frame decodes to a mergeable SegmentResult."""
+        import struct
+        import urllib.request
+
+        from ..multistage.runtime import agg_spec_to_json, spec_to_json
+        from .wire import (decode_block, decode_segment_result, decode_value,
+                           encode_value)
         body = encode_value({"spec": spec_to_json(spec),
+                             "agg": agg_spec_to_json(agg),
                              "left": dict(left), "right": dict(right)})
-        resp = http_call("POST", f"{self.server_url}/stage", body,
-                         timeout=self.timeout_s,
-                         content_type="application/octet-stream")
-        return decode_block(decode_value(resp))
+        from .http_service import _DEFAULT_TOKEN, HttpError
+        headers = {"Content-Type": "application/octet-stream"}
+        if _DEFAULT_TOKEN:
+            headers["Authorization"] = f"Bearer {_DEFAULT_TOKEN}"
+        req = urllib.request.Request(f"{self.server_url}/stage", data=body,
+                                     headers=headers)
+        blocks = []
+        try:
+            resp_cm = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            # an HTTP status is a response FROM A LIVE SERVER — re-raise as
+            # HttpError so the broker's transport/backpressure classification
+            # holds (urllib's HTTPError subclasses OSError, which would
+            # misread a query error as a crashed worker)
+            raise HttpError(e.code, e.read().decode(errors="replace")) from None
+        with resp_cm as resp:
+            while True:
+                header = resp.read(4)
+                if len(header) < 4:
+                    raise ConnectionError("stage stream truncated")
+                (n,) = struct.unpack(">I", header)
+                payload = resp.read(n)
+                if len(payload) < n:
+                    raise ConnectionError("stage stream truncated")
+                d = decode_value(payload)
+                if d["kind"] == "end":
+                    break
+                if d["kind"] == "partial":
+                    return decode_segment_result(d["result"])
+                blocks.append(decode_block(d["block"]))
+        from ..multistage.runtime import _concat_blocks
+        return _concat_blocks(blocks)
 
 
 class ControllerDeepStore(DeepStoreFS):

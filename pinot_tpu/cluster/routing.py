@@ -39,6 +39,12 @@ class RoutingTable:
         # the coverage audit — dropping them entirely would silently shorten
         # results with partialResult=False
         self.dead_segments: Set[str] = set()
+        # CONSUMING segments: replicas consume the same partition at
+        # INDEPENDENT offsets, so round-robin across them makes COUNT(*)
+        # wobble between queries (reads jump to a less-caught-up replica).
+        # These route to a STABLE choice — monotonic freshness per segment —
+        # until that replica leaves rotation.
+        self.consuming_segments: Set[str] = set()
         self._rr = itertools.count()
 
     def route(self, segments: Optional[Set[str]] = None,
@@ -91,6 +97,8 @@ class RoutingTable:
                 continue
             if group_mode:
                 chosen = min(candidates, key=preference.__getitem__)
+            elif seg in self.consuming_segments:
+                chosen = candidates[0]  # stable: monotonic consuming reads
             else:
                 chosen = candidates[(offset + i) % len(candidates)]
             out.setdefault(chosen, []).append(seg)
@@ -132,6 +140,8 @@ class RoutingManager:
                        if st in (ONLINE, CONSUMING) and srv in alive]
             if servers:
                 rt.segment_servers[seg] = sorted(servers)
+                if any(st == CONSUMING for st in states.values()):
+                    rt.consuming_segments.add(seg)
             elif any(st in (ONLINE, CONSUMING) for st in states.values()):
                 # the segment WAS being served and every such replica died
                 rt.dead_segments.add(seg)

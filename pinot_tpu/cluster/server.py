@@ -245,10 +245,18 @@ class ServerNode:
                     # onBecomeOnlineFromConsuming, CONSUMING->ONLINE transition :91)
                     handler = self._realtime_managers.get(table)
                     local_dir = handler.on_segment_online(seg_name) if handler else None
-                    if local_dir:
-                        mgr.add_segment(seg_name, load_segment(local_dir))
-                    else:
-                        self._load_online_segment(table, seg_name, mgr)
+                    try:
+                        if local_dir:
+                            mgr.add_segment(seg_name, load_segment(local_dir))
+                        else:
+                            self._load_online_segment(table, seg_name, mgr)
+                    finally:
+                        # handoff second half: retire the retained post-commit
+                        # consumer whether the load succeeded (immutable now
+                        # serves) or failed (ERROR state must not keep a
+                        # closed consumer and its buffer alive forever)
+                        if handler is not None:
+                            handler.retire_consumer(seg_name)
                     self.catalog.report_state(table, seg_name, self.instance_id, ONLINE)
                 except Exception:
                     self.catalog.report_state(table, seg_name, self.instance_id, "ERROR")
@@ -426,7 +434,7 @@ class ServerNode:
             if handler is not None:
                 with span("consuming"):
                     rt_results, rt_served = handler.consuming_results(
-                        ctx, segment_names)
+                        ctx, segment_names, exclude=set(served))
                 results.extend(rt_results)
                 served.extend(rt_served)
         finally:

@@ -525,19 +525,52 @@ class ServerService:
                                            req["segments"])
         return json_response({"rows": rows})
 
+    # rows per streamed stage-output frame: bounded buffering on both sides
+    STAGE_FRAME_ROWS = 65536
+
     def _stage(self, parts, params, body):
-        """POST /stage — run one multistage join partition on this server
-        (reference: an intermediate-stage worker consuming its mailbox).
-        Body/response are wire-encoded blocks, the same columnar format the
-        query path returns."""
-        from ..multistage.runtime import hash_join, spec_from_json
+        """POST /stage — run one multistage stage partition on this server:
+        hash join, plus the partial GROUP BY when the broker marks this the
+        final aggregation stage (reference: an intermediate-stage worker
+        consuming its mailbox + AggregateOperator partial mode).
+
+        The response STREAMS over chunked HTTP as length-prefixed wire
+        frames: joined rows leave in bounded-row block frames as they are
+        sliced (the mailbox-stream analog — neither side buffers the whole
+        joined output), and a partial-aggregation result is one frame."""
+        import struct
+
+        from ..multistage.runtime import (agg_spec_from_json, run_join_stage,
+                                          spec_from_json)
         from ..utils.metrics import get_registry
-        from .wire import decode_block, decode_value, encode_value
+        from .wire import (decode_block, decode_value, encode_segment_result,
+                           encode_value)
         d = decode_value(body)
-        out = hash_join(decode_block(d["left"]), decode_block(d["right"]),
-                        spec_from_json(d["spec"]))
+        out = run_join_stage(spec_from_json(d["spec"]),
+                             decode_block(d["left"]), decode_block(d["right"]),
+                             agg_spec_from_json(d.get("agg")))
         get_registry().counter("pinot_server_join_stages").inc()
-        return binary_response(encode_value(out))
+
+        def frame(obj) -> bytes:
+            payload = encode_value(obj)
+            return struct.pack(">I", len(payload)) + payload
+
+        def gen():
+            if isinstance(out, dict):  # joined block -> bounded row frames
+                n = 0
+                for v in out.values():
+                    n = len(v)
+                    break
+                step = self.STAGE_FRAME_ROWS
+                for lo in range(0, max(n, 1), step):
+                    yield frame({"kind": "rows",
+                                 "block": {c: v[lo:lo + step]
+                                           for c, v in out.items()}})
+            else:  # partial aggregation result
+                yield frame({"kind": "partial",
+                             "result": encode_segment_result(out)})
+            yield frame({"kind": "end"})
+        return 200, "application/octet-stream", gen()
 
     def _segments(self, parts, params, body):
         return json_response({"segments": self.server.segments_served(parts[0])})

@@ -219,7 +219,16 @@ class LogBrokerServer:
                         results.append((topic, partition,
                                         kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
                         continue
-                    off = 0 if ts == kw.EARLIEST_TS else len(logs[partition].records)
+                    recs = logs[partition].records
+                    if ts == kw.EARLIEST_TS:
+                        off = 0
+                    elif ts == kw.LATEST_TS:
+                        off = len(recs)
+                    else:
+                        # v1 semantics: first offset whose timestamp >= ts
+                        # (offsetsForTimes); -1 when no such record exists
+                        off = next((i for i, (_v, _k, t) in enumerate(recs)
+                                    if t >= ts), -1)
                     results.append((topic, partition, kw.ERR_NONE, -1, off))
             return kw.encode_list_offsets_response(results)
         if api == kw.API_FETCH:
@@ -331,7 +340,8 @@ class LogBrokerClient:
 
     # -- data plane ----------------------------------------------------------
     def produce(self, topic: str, value: Any, partition: Optional[int] = None,
-                key: Optional[str] = None, timestamp_ms: int = 0) -> int:
+                key: Optional[str] = None,
+                timestamp_ms: Optional[int] = None) -> int:
         if partition is None:
             # client-side partitioning, like a stock producer: key hash when
             # keyed (stable across processes), round-robin otherwise
@@ -341,7 +351,9 @@ class LogBrokerClient:
             else:
                 partition = self._rr.get(topic, 0) % n
                 self._rr[topic] = partition + 1
-        ts = timestamp_ms or int(time.time() * 1000)
+        # None -> producer stamps wall clock (CreateTime, like a stock client);
+        # an EXPLICIT value — including 0 — is preserved verbatim
+        ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
         record_set = kw.encode_record_batch(
             0, [(None if key is None else _to_bytes(key), _to_bytes(value), ts)])
         r = self._request(kw.API_PRODUCE, 3,

@@ -307,13 +307,29 @@ class RealtimeTableManager:
         with self._lock:
             return self.consumers.pop(segment_name, None)
 
+    def retire_consumer(self, segment_name: str) -> None:
+        """Second half of the CONSUMING->ONLINE handoff: drop the retained
+        post-commit consumer once the immutable copy is registered and
+        serving. Until this call its mutable buffer keeps answering queries,
+        so the segment is never unserved mid-handoff."""
+        with self._lock:
+            self.consumers.pop(segment_name, None)
+
     # -- segment transition handling --------------------------------------
     def on_segment_online(self, segment_name: str) -> Optional[str]:
         """CONSUMING -> ONLINE for this replica (reference:
         SegmentOnlineOfflineStateModelFactory.onBecomeOnlineFromConsuming:91): adopt the
         local build when committed here or offsets match (KEEP), else signal the caller
-        to download the committed copy."""
-        consumer = self.stop_consuming(segment_name)
+        to download the committed copy.
+
+        The consumer STAYS registered (serving its mutable buffer to queries)
+        until the caller registers the immutable copy and calls
+        `retire_consumer` — popping it here would leave the segment unserved
+        for the whole load/download window, and every query in that window
+        would fail over to a replica whose consumer may be far behind
+        (COUNT(*) visibly regressing mid-commit)."""
+        with self._lock:
+            consumer = self.consumers.get(segment_name)
         if consumer is None:
             return None
         # the committer usually arrives here while its commitEnd call is still
@@ -357,19 +373,24 @@ class RealtimeTableManager:
 
     # -- query integration -------------------------------------------------
     def consuming_results(self, ctx: QueryContext,
-                          segment_names: Optional[Sequence[str]] = None
+                          segment_names: Optional[Sequence[str]] = None,
+                          exclude: Sequence[str] = ()
                           ) -> Tuple[List[SegmentResult], List[str]]:
         """(results, served names) — BOTH from one locked snapshot: serve/not
         is decided once per segment, so the served list always matches what
         the results actually include. Deciding them separately would let a
         commit land in between, and the broker would retry a segment whose
-        rows were already counted (double count), or vice versa. A consumer
-        that commits mid-execution still serves consistently: its mutable
-        buffer outlives the commit until adoption."""
+        rows were already counted (double count), or vice versa.
+
+        COMMITTED consumers keep serving their mutable buffer until
+        `retire_consumer` swaps in the immutable copy — `exclude` (segments
+        the caller already answered immutably in THIS query) prevents the
+        one double-count window that creates. DISCARDED stays unserved: its
+        rows lost the commit race and may disagree with the winning copy."""
         with self._lock:
             snapshot = [(name, c) for name, c in self.consumers.items()
                         if (segment_names is None or name in segment_names)
-                        and c.state not in (COMMITTED, DISCARDED)]
+                        and c.state != DISCARDED and name not in exclude]
         served = [name for name, _ in snapshot]
         out = []
         for _, c in snapshot:

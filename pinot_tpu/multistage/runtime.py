@@ -408,11 +408,70 @@ def _stage_pool():
         return _STAGE_POOL
 
 
-# a stage runner executes ONE partition's hash join; the default is the local
-# hash_join, the broker substitutes a round-robin dispatch to server workers
-# (reference: intermediate-stage workers receiving partitioned blocks through
-# GrpcMailboxService)
-StageRunner = Callable[[JoinSpec, Block, Block], Block]
+# a stage runner executes ONE partition's join (+ optional partial GROUP BY);
+# the default is the local run_join_stage, the broker substitutes a round-robin
+# dispatch to server workers (reference: intermediate-stage workers receiving
+# partitioned blocks through GrpcMailboxService, AggregateOperator partial mode)
+StageRunner = Callable[[JoinSpec, Block, Block, Optional["AggStageSpec"]], Any]
+
+
+@dataclass
+class AggStageSpec:
+    """The worker-side partial-aggregation stage description (reference:
+    AggregateOperator in partial/intermediate mode + the serialized stage
+    plan). Duck-types the QueryContext fields `aggregate_block` reads, so the
+    same function serves broker-local and worker execution."""
+
+    distinct: bool
+    group_by: List[Expr]
+    select_items: List[Tuple[Expr, Optional[str]]]
+    aggregations: List[Function]
+
+
+def agg_spec_from_ctx(ctx: QueryContext) -> AggStageSpec:
+    return AggStageSpec(distinct=ctx.distinct, group_by=list(ctx.group_by),
+                        select_items=list(ctx.select_items),
+                        aggregations=list(ctx.aggregations))
+
+
+def agg_spec_to_json(spec: Optional[AggStageSpec]) -> Optional[Dict[str, Any]]:
+    """Exprs travel as SQL text — qualified identifiers (a.x) round-trip
+    through to_sql/parse, so SQL is the wire IR for stage plans."""
+    if spec is None:
+        return None
+    from ..sql.ast import to_sql
+    return {"distinct": spec.distinct,
+            "groupBy": [to_sql(e) for e in spec.group_by],
+            "selectItems": [to_sql(e) for e, _ in spec.select_items],
+            "aggs": [to_sql(f) for f in spec.aggregations]}
+
+
+def agg_spec_from_json(d: Optional[Dict[str, Any]]) -> Optional[AggStageSpec]:
+    if d is None:
+        return None
+    from ..sql.parser import parse_query
+
+    def expr(txt: str) -> Expr:
+        return parse_query(f"SELECT {txt} FROM __t").select[0][0]
+    return AggStageSpec(
+        distinct=bool(d["distinct"]),
+        group_by=[expr(t) for t in d["groupBy"]],
+        select_items=[(expr(t), None) for t in d["selectItems"]],
+        aggregations=[expr(t) for t in d["aggs"]])
+
+
+def run_join_stage(spec: JoinSpec, left: Block, right: Block,
+                   agg: Optional[AggStageSpec] = None):
+    """One partition's full stage work: hash join, then (when this is the
+    final stage of an aggregation query) the PARTIAL GROUP BY — so the heavy
+    aggregation runs where the joined rows already are, and only mergeable
+    group partials cross back to the broker (reference: the v2 engine's
+    worker-side AggregateOperator before the final exchange)."""
+    out = hash_join(left, right, spec)
+    if agg is None:
+        return out
+    aggs = [make_agg(f) for f in agg.aggregations]
+    return aggregate_block(agg, aggs, out)
 
 
 def spec_to_json(spec: JoinSpec) -> Dict[str, Any]:
@@ -452,7 +511,7 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
                    else list(ctx.group_by))
     mailboxes = MailboxService()
     runner: StageRunner = stage_runner if stage_runner is not None else \
-        (lambda spec, lp, rp: hash_join(lp, rp, spec))
+        run_join_stage
 
     # -- leaf scan stages (single-stage engine per table) ------------------
     blocks: Dict[str, Block] = {}
@@ -462,9 +521,18 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
 
     # -- join pipeline: hash exchange + per-partition joins ----------------
     current = blocks[plan.base_alias]
+    worker_partials: Optional[List[SegmentResult]] = None
     for si, spec in enumerate(plan.joins):
         right = blocks[spec.right_alias]
         stage = f"join{si}"
+        # the LAST join stage of an aggregation query carries the partial
+        # GROUP BY with it: each worker aggregates its partition where the
+        # joined rows already live, and only mergeable partials come back —
+        # the broker stops being the aggregation bottleneck (post_filter
+        # needs the raw joined rows, so it keeps the block path)
+        agg_stage = (agg_spec_from_ctx(ctx)
+                     if si == len(plan.joins) - 1 and plan.post_filter is None
+                     and (ctx.is_aggregation_query or ctx.distinct) else None)
         for p, blk in enumerate(_partition_block(current, spec.left_keys,
                                                  num_partitions)):
             mailboxes.send(f"{stage}.L", p, blk)
@@ -472,7 +540,7 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
                                                  num_partitions)):
             mailboxes.send(f"{stage}.R", p, blk)
 
-        def one_partition(p: int) -> Block:
+        def one_partition(p: int):
             lp = _concat_blocks(mailboxes.receive(f"{stage}.L", p))
             rp = _concat_blocks(mailboxes.receive(f"{stage}.R", p))
             # trivial partitions join locally — an empty (or inner-join
@@ -481,10 +549,20 @@ def execute_multistage(sql_or_plan, scan_fn: ScanFn, schema_for=None,
             if (_block_rows(lp) == 0 and _block_rows(rp) == 0) or \
                     (spec.join_type == "inner"
                      and (_block_rows(lp) == 0 or _block_rows(rp) == 0)):
-                return hash_join(lp, rp, spec)
-            return runner(spec, lp, rp)
+                return run_join_stage(spec, lp, rp, agg_stage)
+            return runner(spec, lp, rp, agg_stage)
         parts = list(_stage_pool().map(one_partition, range(num_partitions)))
+        if agg_stage is not None:
+            worker_partials = list(parts)
+            break
         current = _concat_blocks(parts)
+
+    if worker_partials is not None:
+        merged = merge_segment_results(worker_partials, aggs)
+        result = reduce_to_result(ctx, merged, aggs, group_exprs)
+        result.stats["multistage"] = True
+        result.stats["workerAggregation"] = True
+        return result
 
     if plan.post_filter is not None and _block_rows(current):
         mask = _null_safe_mask(plan.post_filter, current)

@@ -137,3 +137,27 @@ def test_unsupported_version_gets_downgrade_answer():
         s.close()
     finally:
         srv.stop()
+
+
+def test_list_offsets_by_timestamp():
+    """ListOffsets v1 with a real timestamp returns the FIRST offset whose
+    record timestamp >= T (offsetsForTimes semantics), not log end."""
+    from pinot_tpu.ingest.kafkalite import LogBrokerClient, LogBrokerServer
+    srv = LogBrokerServer()
+    try:
+        c = LogBrokerClient(srv.bootstrap)
+        c.create_topic("t", 1)
+        for i, ts in enumerate((100, 200, 300)):
+            c.produce("t", f"m{i}", partition=0, timestamp_ms=ts)
+        assert c.list_offsets("t", 0, timestamp=-2) == 0   # earliest
+        assert c.list_offsets("t", 0, timestamp=-1) == 3   # latest
+        assert c.list_offsets("t", 0, timestamp=150) == 1
+        assert c.list_offsets("t", 0, timestamp=300) == 2
+        assert c.list_offsets("t", 0, timestamp=301) == -1  # past the end
+        # explicit timestamp 0 is preserved verbatim (no wall-clock re-stamp)
+        c.produce("t", "zero", partition=0, timestamp_ms=0)
+        recs = c.fetch("t", 0, 3)
+        assert recs[0][1] == 0
+        c.close()
+    finally:
+        srv.stop()

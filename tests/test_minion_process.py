@@ -206,3 +206,93 @@ def test_dead_minion_lease_requeues_to_live_worker(tmp_path):
         segs = cluster.controller.segments_meta(
             cfg.table_name_with_type)["segments"]
         assert len(segs) == 1 and next(iter(segs)).startswith("merged_")
+
+
+def test_multistage_join_groupby_on_worker_processes(tmp_path):
+    """VERDICT item 5 'done' shape: a join + GROUP BY over two tables executes
+    with scan, join, AND partial-aggregation stages on server PROCESSES
+    (streamed stage frames over chunked HTTP), differential-checked against
+    sqlite3."""
+    import os
+    import sqlite3
+
+    rng = np.random.default_rng(43)
+    orders_schema = Schema("orders", [
+        dimension("region", DataType.STRING),
+        metric("custkey", DataType.LONG),
+        metric("amount", DataType.DOUBLE),
+    ])
+    cust_schema = Schema("customer", [
+        dimension("segment", DataType.STRING),
+        metric("key", DataType.LONG),
+    ])
+    n_orders, n_cust = 600, 40
+    orders = {
+        "region": rng.choice(["NA", "EU", "APAC"], n_orders).tolist(),
+        "custkey": rng.integers(0, n_cust, n_orders),
+        "amount": np.round(rng.uniform(1.0, 100.0, n_orders), 2),
+    }
+    cust = {
+        "segment": rng.choice(["AUTO", "RETAIL"], n_cust).tolist(),
+        "key": np.arange(n_cust),
+    }
+
+    with ProcessCluster(num_servers=2, work_dir=str(tmp_path)) as cluster:
+        cluster.controller.add_schema(orders_schema)
+        cluster.controller.add_schema(cust_schema)
+        cluster.controller.add_table(TableConfig("orders"))
+        cluster.controller.add_table(TableConfig("customer"))
+        b = SegmentBuilder(orders_schema)
+        for i in range(2):
+            half = {k: v[i * 300:(i + 1) * 300] for k, v in orders.items()}
+            cluster.controller.upload_segment(
+                "orders_OFFLINE",
+                b.build(half, str(tmp_path / "bo"), f"orders_{i}"))
+        cluster.controller.upload_segment(
+            "customer_OFFLINE",
+            SegmentBuilder(cust_schema).build(cust, str(tmp_path / "bc"),
+                                              "customer_0"))
+        assert wait_until(lambda: cluster.query(
+            "SELECT COUNT(*) FROM orders")["resultTable"]["rows"][0][0] == 600,
+            timeout=30)
+
+        sql = ("SELECT c.segment, o.region, COUNT(*), SUM(o.amount) "
+               "FROM orders o JOIN customer c ON o.custkey = c.key "
+               "GROUP BY c.segment, o.region "
+               "ORDER BY c.segment, o.region LIMIT 100")
+        resp = cluster.query(sql)
+        assert resp["workerAggregation"] is True
+        got = [tuple(r) for r in resp["resultTable"]["rows"]]
+
+        # differential oracle
+        db = sqlite3.connect(":memory:")
+        db.execute("CREATE TABLE orders (region TEXT, custkey INT, amount REAL)")
+        db.execute("CREATE TABLE customer (segment TEXT, key INT)")
+        db.executemany("INSERT INTO orders VALUES (?,?,?)",
+                       list(zip(orders["region"],
+                                orders["custkey"].tolist(),
+                                orders["amount"].tolist())))
+        db.executemany("INSERT INTO customer VALUES (?,?)",
+                       list(zip(cust["segment"], cust["key"].tolist())))
+        want = db.execute(
+            "SELECT c.segment, o.region, COUNT(*), SUM(o.amount) "
+            "FROM orders o JOIN customer c ON o.custkey = c.key "
+            "GROUP BY c.segment, o.region "
+            "ORDER BY c.segment, o.region").fetchall()
+        assert [(g[0], g[1], g[2]) for g in got] == \
+            [(w[0], w[1], w[2]) for w in want]
+        for g, w in zip(got, want):
+            assert g[3] == pytest.approx(w[3], rel=1e-9)
+
+        # the join+agg stages genuinely ran on the server processes: their
+        # join-stage meters moved (streamed /stage dispatches)
+        total_stages = 0
+        for sid in ("server_0", "server_1"):
+            with open(os.path.join(cluster.run_dir, f"{sid}.ready")) as f:
+                url = json.load(f)["url"]
+            metrics = __import__("urllib.request", fromlist=["request"]).urlopen(
+                f"{url}/metrics", timeout=10).read().decode()
+            for line in metrics.splitlines():
+                if line.startswith("pinot_server_join_stages"):
+                    total_stages += float(line.split()[-1])
+        assert total_stages > 0
