@@ -18,8 +18,10 @@ with one ICI collective and no host-side value merge. Two ways a segment set qua
   column, per-segment ids remapped host-side once at block-build time, after which the
   set is aligned by construction.
 
-Only JSON_MATCH/TEXT_MATCH filters (per-segment doc-set bitmaps) still fall back to the
-per-segment executor + value-keyed host merge.
+JSON_MATCH/TEXT_MATCH/geo doc-set bitmaps stack [S, rows] into the kernel's
+`docsets` input (cached per predicate on the block), and multi-value LUT filter
+columns stack as [S, rows, W] padded id matrices — both on the ALIGNED immutable
+path; unaligned or mutable sets with those shapes keep the per-segment fallback.
 """
 
 from __future__ import annotations
@@ -58,6 +60,12 @@ def _has_docset_filter(ctx: QueryContext) -> bool:
     return ctx.filter is not None and walk(ctx.filter)
 
 _SHARD_KERNEL_CACHE: Dict[Tuple, object] = {}
+
+
+class DocsetPlanDivergence(Exception):
+    """Segments in one set compile to different doc-set leaf structures (e.g.
+    a geo index present on some segments only): the stacked mesh dispatch
+    cannot serve them — callers fall back to per-segment execution."""
 
 
 def _refs_multi_value(ctx: QueryContext, seg) -> bool:
@@ -123,7 +131,10 @@ class SegmentSetBlock:
         key = (kind, col)
         if key not in self._cache:
             first = np.asarray(per_seg(0, self.segments[0]))
-            out = np.full((self.s_pad, self.rows), fill, dtype=first.dtype)
+            # 1-D per-segment arrays stack to [S, rows]; 2-D (padded MV id
+            # matrices [rows, W]) stack to [S, rows, W]
+            shape = (self.s_pad, self.rows) + first.shape[1:]
+            out = np.full(shape, fill, dtype=first.dtype)
             for i, seg in enumerate(self.segments):
                 # slice to the view's snapshot row count: mutable members may have
                 # grown since the view (and its remap tables) were built
@@ -134,10 +145,30 @@ class SegmentSetBlock:
 
     def ids(self, col: str) -> jnp.ndarray:
         """Dict ids in the space the plan was made in: segment-local ids for aligned
-        sets, remapped GLOBAL ids (merged.py) for unaligned ones."""
+        sets, remapped GLOBAL ids (merged.py) for unaligned ones. Multi-value
+        columns stack as [S, rows, W] left-justified id matrices (W = the
+        set-wide max values per row), out-of-dictionary fill = cardinality —
+        exactly the single-device MV layout with a segment axis in front."""
         remaps = self.view.remap(col) if self.view is not None else None
         if remaps is None:
-            card = self.segments[0].column(col).cardinality
+            r0 = self.segments[0].column(col)
+            card = r0.cardinality
+            if getattr(r0, "is_multi_value", False):
+                w = max(max(s.column(col).max_num_values, 1)
+                        for s in self.segments)
+
+                def per_seg_mv(i, s):
+                    reader = s.column(col)
+                    flat = np.asarray(reader.fwd).astype(np.int32)
+                    off = np.asarray(reader.mv_offsets)
+                    counts = np.diff(off)
+                    n = len(counts)
+                    mat = np.full((n, w), card, dtype=np.int32)
+                    rows = np.repeat(np.arange(n), counts)
+                    within = np.arange(len(flat)) - np.repeat(off[:-1], counts)
+                    mat[rows, within] = flat
+                    return mat
+                return self._stack("ids", col, np.int32(card), per_seg_mv)
             return self._stack("ids", col, np.int32(card),
                                lambda i, s: np.asarray(s.column(col).fwd).astype(np.int32))
         mc = self.view.column(col)
@@ -214,17 +245,18 @@ class MeshQueryExecutor:
         plan, view = self._plan_for_set(ctx, segments)
         if plan is None or plan.kind != "device":
             return self._fallback.execute(segments, ctx)
-        return self._execute_sharded(ctx, plan, segments, view)
+        try:
+            return self._execute_sharded(ctx, plan, segments, view)
+        except DocsetPlanDivergence:
+            return self._fallback.execute(segments, ctx)
 
     def _plan_for_set(self, ctx: QueryContext, segments):
         """Choose the planning surface for a segment set.
 
         Returns (plan, view): view is None for the aligned fast path (ids agree by
         dictHash), a MergedSegmentView when ids must be remapped to a global
-        dictionary, and plan is None when the set must take the per-segment fallback
-        (JSON/TEXT_MATCH doc-set filters, which are per-segment bitmaps)."""
-        if _has_docset_filter(ctx):
-            return None, None
+        dictionary, and plan is None when the set must take the per-segment
+        fallback."""
         if self._all_star_tree(ctx, segments):
             # every segment answers from a pre-aggregated star-tree record
             # table (typically 100-1000x fewer records than the base scan):
@@ -232,11 +264,12 @@ class MeshQueryExecutor:
             # outright, so the mesh planner yields to it (reference:
             # StarTreeUtils.isFitForStarTree gating in the leaf plan)
             return None, None
-        if _refs_multi_value(ctx, segments[0]):
-            # MV forward indexes are ragged (flat ids + offsets): the [S, rows]
-            # stacked mesh block can't carry them; per-segment execution still
-            # rides the single-device kernel's padded [rows, W] MV path
-            return None, None
+        # doc-set filters (JSON/TEXT_MATCH bitmaps, stacked per segment) and
+        # MV LUT filters ([S, rows, W] padded id matrices) ride the mesh
+        # kernel on the ALIGNED immutable path only: the merged view has no
+        # aux indexes to match against and no MV remap, so those sets keep
+        # the per-segment fallback
+        special = _has_docset_filter(ctx) or _refs_multi_value(ctx, segments[0])
         total_docs = sum(s.num_docs for s in segments)
         any_mutable = any(getattr(s, "is_mutable", False) for s in segments)
         if not any_mutable:
@@ -245,6 +278,8 @@ class MeshQueryExecutor:
                 return plan, None
             if self._alignable(plan, segments):
                 return plan, None
+        if special:
+            return None, None
         view = self._merged_view(segments)
         return plan_segment(ctx, view, scan_docs=total_docs), view
 
@@ -259,6 +294,58 @@ class MeshQueryExecutor:
             return False
         from ..query.startree_exec import try_star_tree
         return all(try_star_tree(ctx, s) is not None for s in segments)
+
+    def _stacked_docsets(self, ctx: QueryContext, plan, segments,
+                         block: SegmentSetBlock) -> Tuple:
+        """Per-segment JSON/TEXT_MATCH (or id-set) doc bitmaps, stacked
+        [S_pad, rows] in leaf order and sharded on the segment axis — the
+        `docsets` kernel input. The masks come from each segment's OWN aux
+        index (a filter compile per segment IS the index lookup); the leaf
+        structure is deterministic for a fixed expression, so leaf order
+        agrees with the probe plan's.
+
+        Stacked masks are CACHED on the block keyed by each leaf's
+        `cache_token` (kind + every predicate parameter — geo leaves include
+        the center point): immutable segments give one index lookup + one
+        device transfer per distinct predicate, so repeated TEXT_MATCH
+        queries dispatch at the same cost as any other filter. Tokenless
+        leaves (id sets) are never cached; cached entries reuse PER KEY, so
+        one uncacheable leaf doesn't defeat the others' cache."""
+        from ..query.predicate import DocSetLeaf, compile_filter
+        probe_leaves = [l for l in plan.filter_prog.leaves
+                        if isinstance(l, DocSetLeaf)]
+        cache = block._cache
+        keys = [("docset", f"{l.col}\x00{l.cache_token}")
+                if l.cache_token else None for l in probe_leaves]
+        out: List = [cache.get(k) if k is not None else None for k in keys]
+        if any(v is None for v in out):
+            per_seg: List[List[np.ndarray]] = []
+            for s in segments:
+                prog = compile_filter(ctx.filter, s)
+                masks = [l.mask for l in prog.leaves
+                         if isinstance(l, DocSetLeaf)]
+                if len(masks) != len(probe_leaves):
+                    raise DocsetPlanDivergence(
+                        "doc-set leaf structure diverged across segments")
+                per_seg.append(masks)
+            n_docset_entries = sum(1 for k in cache if k[0] == "docset")
+            if n_docset_entries > 32:
+                # bound device memory: each entry is an [S_pad, rows] device
+                # array; a stream of distinct search terms must not grow HBM
+                # without limit
+                for k in [k for k in cache if k[0] == "docset"]:
+                    del cache[k]
+            for j, key in enumerate(keys):
+                if out[j] is not None:
+                    continue
+                stacked = np.zeros((block.s_pad, block.rows), dtype=bool)
+                for i in range(len(segments)):
+                    m = np.asarray(per_seg[i][j])
+                    stacked[i, :len(m)] = m[:block.rows]
+                out[j] = jax.device_put(stacked, block._sharded)
+                if key is not None:
+                    cache[key] = out[j]
+        return tuple(out)
 
     def _merged_view(self, segments) -> MergedSegmentView:
         # keyed by STABLE segment identity; the volatile part (mutable row counts)
@@ -315,8 +402,12 @@ class MeshQueryExecutor:
             if plan is None or plan.kind != "device":
                 pending.append((qi, self._fallback.execute(segments, ctx)))
             else:
-                outs_dev, decode = self._dispatch_sharded(ctx, plan, segments, view)
-                pending.append((qi, outs_dev, decode))
+                try:
+                    outs_dev, decode = self._dispatch_sharded(ctx, plan,
+                                                              segments, view)
+                    pending.append((qi, outs_dev, decode))
+                except DocsetPlanDivergence:
+                    pending.append((qi, self._fallback.execute(segments, ctx)))
         fetched = jax.device_get([p[1] for p in pending if len(p) == 3])
         results: List[Optional[ResultTable]] = [None] * len(queries)
         it = iter(fetched)
@@ -354,14 +445,17 @@ class MeshQueryExecutor:
                 # the GLOBAL cardinality there (ids arrive remapped)
                 distinct_lut_sizes[i] = lut_size(plan.segment.column(agg.arg.name).cardinality)
 
+        from ..query.executor import _mv_lut_cols
         spec = KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
-                          tuple(agg_specs), distinct_lut_sizes, block.rows)
+                          tuple(agg_specs), distinct_lut_sizes, block.rows,
+                          mv_cols=_mv_lut_cols(plan, plan.segment))
 
         # -- gather runtime inputs ------------------------------------
         # ids only where dict ids are semantically needed (group keys, interval/LUT
         # filters, distinct); everything value-like reads pre-decoded HBM columns.
         ids_cols, vals_cols, nulls_cols = set(plan.group_cols), set(), set()
         luts, iscal, fscal = [], [], []
+        has_docsets = False
         for leaf in plan.filter_prog.leaves:
             if isinstance(leaf, LutLeaf):
                 ids_cols.add(leaf.col)
@@ -375,6 +469,11 @@ class MeshQueryExecutor:
                 (iscal if leaf.is_int else fscal).extend(leaf.operands)
             elif isinstance(leaf, NullLeaf):
                 nulls_cols.add(leaf.col)
+            else:
+                has_docsets = True
+        docsets: Tuple = ()
+        if has_docsets:
+            docsets = self._stacked_docsets(ctx, plan, segments, block)
         for i, agg in enumerate(plan.aggs):
             if "distinct" in agg.device_outputs:
                 ids_cols.add(agg.arg.name)
@@ -392,6 +491,7 @@ class MeshQueryExecutor:
             valid=block.valid,
             strides=self._const(np.asarray(plan.strides, dtype=np.int32)),
             agg_luts=agg_luts,
+            docsets=docsets,
         )
 
         fn = self._get_shard_kernel(spec, s_pad, block.rows)
@@ -437,12 +537,12 @@ class MeshQueryExecutor:
 
         in_specs = (dict(ids=sharded, vals=sharded, luts=repl, iscal=repl,
                          fscal=repl, nulls=sharded, valid=sharded, strides=repl,
-                         agg_luts=sharded),)
+                         agg_luts=sharded, docsets=sharded),)
 
         def shard_body(inputs):
             out = body(inputs["ids"], inputs["vals"], inputs["luts"], inputs["iscal"],
                        inputs["fscal"], inputs["nulls"], inputs["valid"],
-                       inputs["strides"], inputs["agg_luts"], ())
+                       inputs["strides"], inputs["agg_luts"], inputs["docsets"])
             return {k: combine_collective(k, v, ax) for k, v in out.items()}
 
         return jax.jit(jax.shard_map(shard_body, mesh=self.mesh,

@@ -124,6 +124,11 @@ class DocSetLeaf:
     col: str
     desc: str
     mask: np.ndarray  # bool[num_docs]
+    # fully identifies the mask's CONTENTS for a given immutable segment
+    # (kind + every predicate parameter); "" = not content-addressable
+    # (id-set leaves), never cache. Excluded from signature(): masks are
+    # runtime inputs and must not fragment the kernel cache.
+    cache_token: str = ""
 
     @property
     def kind(self) -> str:
@@ -243,7 +248,8 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
                     mask = text_match_scan(reader.values(), query)
         except (ValueError, AssertionError, IndexError, KeyError) as exc:
             raise QueryValidationError(f"{name.upper()}: {exc}") from exc
-        leaves.append(DocSetLeaf(col.name, query, mask))
+        leaves.append(DocSetLeaf(col.name, query, mask,
+                                 cache_token=f"{name}:{query}"))
         return ("leaf", len(leaves) - 1)
     if name in ("in_id_set", "inidset"):
         # membership against a serialized IdSet literal (reference:
@@ -302,7 +308,8 @@ def _try_geo_predicate(e: Function, seg: ImmutableSegment,
         return exact
     mask = geo_idx.candidate_mask(cx, cy, radius, seg.num_docs)
     leaves.append(DocSetLeaf(f"{lng_col},{lat_col}",
-                             f"geo cells r={radius:g}m", mask))
+                             f"geo cells r={radius:g}m", mask,
+                             cache_token=f"geo:{cx!r}:{cy!r}:{radius!r}"))
     return ("and", (("leaf", len(leaves) - 1), exact))
 
 

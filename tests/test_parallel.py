@@ -211,3 +211,105 @@ def test_groupby_having_not_trimmed(aligned_segments, mesh_exec):
     sharded = mesh_exec.execute(aligned_segments, sql)
     single = ServerQueryExecutor().execute(aligned_segments, sql)
     assert sorted(map(repr, _norm(sharded.rows))) == sorted(map(repr, _norm(single.rows)))
+
+
+# -- doc-set filters + MV on the mesh kernel ---------------------------------
+
+@pytest.fixture(scope="module")
+def text_mv_segments(tmp_path_factory):
+    """Aligned segments with a text-indexed column and an MV column."""
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment.writer import SegmentGeneratorConfig
+    schema = Schema("docs", [
+        dimension("body", DataType.STRING),
+        dimension("tags", DataType.STRING, single_value=False),
+        dimension("kind", DataType.STRING),
+        metric("v", DataType.DOUBLE),
+    ])
+    rng = np.random.default_rng(29)
+    n = 4000
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    cols = {
+        "body": [" ".join(rng.choice(words, 3)) for _ in range(n)],
+        "tags": [list(rng.choice(["red", "green", "blue", "gold"],
+                                 rng.integers(1, 4), replace=False))
+                 for _ in range(n)],
+        "kind": rng.choice(["a", "b", "c"], n).tolist(),
+        "v": np.round(rng.uniform(0, 10, n), 3),
+    }
+    out = tmp_path_factory.mktemp("textmv")
+    paths = build_aligned_segments(
+        schema, cols, str(out), "docs", 8,
+        config=SegmentGeneratorConfig(text_index_columns=["body"]))
+    return [load_segment(p) for p in paths], cols
+
+
+def test_text_match_agg_rides_mesh_kernel(text_mv_segments, mesh_exec):
+    """TEXT_MATCH + aggregation: the doc-set bitmaps stack [S, rows] into the
+    mesh kernel's docsets input instead of forcing per-segment fallback."""
+    segs, cols = text_mv_segments
+    ctx_plan, view = mesh_exec._plan_for_set(
+        __import__("pinot_tpu.query.context", fromlist=["compile_query"])
+        .compile_query("SELECT COUNT(*), SUM(v) FROM docs "
+                       "WHERE TEXT_MATCH(body, 'alpha') AND kind = 'a'",
+                       segs[0].schema), segs)
+    assert ctx_plan is not None and ctx_plan.kind == "device"
+    res = mesh_exec.execute(
+        segs, "SELECT COUNT(*), SUM(v) FROM docs "
+              "WHERE TEXT_MATCH(body, 'alpha') AND kind = 'a'")
+    import numpy as _np
+    want_mask = _np.array([("alpha" in b) and k == "a"
+                           for b, k in zip(cols["body"], cols["kind"])])
+    assert res.rows[0][0] == int(want_mask.sum())
+    assert res.rows[0][1] == pytest.approx(
+        float(_np.sum(_np.asarray(cols["v"])[want_mask])), rel=1e-5)
+
+
+def test_mv_filter_group_by_rides_mesh_kernel(text_mv_segments, mesh_exec):
+    """MV LUT filter ([S, rows, W] stacked ids) + SV group-by on the mesh
+    kernel: any-value-matches semantics, grouped totals exact."""
+    segs, cols = text_mv_segments
+    from pinot_tpu.query.context import compile_query
+    ctx = compile_query(
+        "SELECT kind, COUNT(*) FROM docs WHERE tags = 'gold' "
+        "GROUP BY kind ORDER BY kind LIMIT 10", segs[0].schema)
+    plan, view = mesh_exec._plan_for_set(ctx, segs)
+    assert plan is not None and plan.kind == "device", \
+        getattr(plan, "fallback_reason", None)
+    res = mesh_exec.execute(
+        segs, "SELECT kind, COUNT(*) FROM docs WHERE tags = 'gold' "
+              "GROUP BY kind ORDER BY kind LIMIT 10")
+    want = {}
+    for k, tags in zip(cols["kind"], cols["tags"]):
+        if "gold" in tags:
+            want[k] = want.get(k, 0) + 1
+    assert {r[0]: r[1] for r in res.rows} == want
+
+
+def test_mv_in_filter_matches_host(text_mv_segments, mesh_exec):
+    segs, cols = text_mv_segments
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    sql = ("SELECT COUNT(*), SUM(v) FROM docs "
+           "WHERE tags IN ('red', 'blue') LIMIT 5")
+    a = mesh_exec.execute(segs, sql)
+    b = ServerQueryExecutor(use_device=False).execute(segs, sql)
+    assert a.rows[0][0] == b.rows[0][0]
+    assert a.rows[0][1] == pytest.approx(b.rows[0][1], rel=1e-5)
+
+
+def test_docset_cache_distinguishes_predicates(text_mv_segments, mesh_exec):
+    """Two TEXT_MATCH queries with different terms must never share a cached
+    mask (the cache keys on the full predicate token)."""
+    segs, cols = text_mv_segments
+    a = mesh_exec.execute(segs, "SELECT COUNT(*) FROM docs "
+                                "WHERE TEXT_MATCH(body, 'alpha')")
+    b = mesh_exec.execute(segs, "SELECT COUNT(*) FROM docs "
+                                "WHERE TEXT_MATCH(body, 'beta')")
+    import numpy as _np
+    want_a = sum("alpha" in x for x in cols["body"])
+    want_b = sum("beta" in x for x in cols["body"])
+    assert (a.rows[0][0], b.rows[0][0]) == (want_a, want_b)
+    # repeat query hits the cache and stays correct
+    a2 = mesh_exec.execute(segs, "SELECT COUNT(*) FROM docs "
+                                 "WHERE TEXT_MATCH(body, 'alpha')")
+    assert a2.rows[0][0] == want_a
