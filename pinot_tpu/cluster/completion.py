@@ -286,7 +286,16 @@ class LLCSegmentManager:
         tar_path = os.path.join(self.work_dir, f"{segment}.tar.gz")
         tar_segment(segment_dir, tar_path)
         uri = f"{table}/{segment}.tar.gz"
-        self.deepstore.upload(tar_path, uri)
+        try:
+            self.deepstore.upload(tar_path, uri)
+        except Exception:
+            # deep store unavailable: the commit still succeeds under the PEER
+            # download scheme — replicas fetch the committed copy from a
+            # serving peer, and the validation round re-uploads to the deep
+            # store once it recovers (reference:
+            # PeerSchemeSplitSegmentCommitter + peerSegmentDownloadScheme,
+            # RealtimeSegmentValidationManager.uploadToDeepStoreIfMissing)
+            uri = f"peer://{table}/{segment}"
         size = os.path.getsize(tar_path)
         os.remove(tar_path)
 
@@ -428,12 +437,49 @@ class LLCSegmentManager:
 
     def validate(self) -> Dict[str, List[str]]:
         """One RealtimeSegmentValidationManager round: recreate missing
-        successors + move dead-replica consuming segments."""
+        successors + move dead-replica consuming segments + heal peer-scheme
+        segments into the deep store."""
         with self._lock:
-            return {
+            out = {
                 "created": self._repair_missing_consuming_segments(),
                 "reassigned": self._reassign_dead_consuming_segments(),
             }
+        out["healed"] = self._heal_peer_segments()
+        return out
+
+    def _heal_peer_segments(self) -> List[str]:
+        """Re-upload peer-scheme committed segments once the deep store is
+        reachable again (reference: RealtimeSegmentValidationManager
+        .uploadToDeepStoreIfMissing): fetch the tar from a serving peer, put
+        it in the deep store, and flip download_path to the durable URI."""
+        from .peers import fetch_from_peer
+        healed = []
+        for table, segs in list(self.catalog.segments.items()):
+            for name, meta in list(segs.items()):
+                if not (meta.download_path or "").startswith("peer://"):
+                    continue
+                uri = f"{table}/{name}.tar.gz"
+                tmp = os.path.join(self.work_dir, f"heal_{name}.tar.gz")
+                try:
+                    fetch_from_peer(self.catalog, table, name, tmp)
+                    self.deepstore.upload(tmp, uri)
+                except Exception:
+                    continue  # still unreachable; next round retries
+                finally:
+                    if os.path.exists(tmp):
+                        os.remove(tmp)
+                with self._lock:
+                    # re-check under the lock: the fetch+upload window is
+                    # seconds long — a concurrent table drop (or a racing
+                    # heal) must not resurrect the segment's metadata
+                    cur = self.catalog.segments.get(table, {}).get(name)
+                    if cur is None or not (cur.download_path or ""
+                                           ).startswith("peer://"):
+                        continue
+                    cur.download_path = uri
+                    self.catalog.put_segment_meta(cur)
+                healed.append(name)
+        return healed
 
     def _meta(self, segment: str) -> Optional[SegmentMeta]:
         for table_segs in self.catalog.segments.values():

@@ -1,0 +1,70 @@
+"""Peer segment fetch: download a committed segment tar from a replica server.
+
+Analog of the reference's `PeerServerSegmentFinder`
+(`pinot-core/src/main/java/org/apache/pinot/core/util/PeerServerSegmentFinder.java`):
+the external view IS the location map — every server reporting the segment
+ONLINE can serve its local copy over `GET /segmentData/{table}/{segment}`.
+Used when the deep store is slow/unavailable (download falls back
+deep-store -> peer) and for `peer://` scheme segments whose commit-time upload
+failed (`completion.py` PeerSchemeSplitSegmentCommitter analog).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .catalog import ONLINE
+
+
+def peer_urls(catalog, table: str, segment: str,
+              exclude_instance: Optional[str] = None) -> List[str]:
+    """Base URLs of live servers whose external-view state for the segment is
+    ONLINE (they hold a loaded local copy), excluding the asking instance."""
+    ev = catalog.external_view.get(table, {}).get(segment, {})
+    urls = []
+    for server_id, state in sorted(ev.items()):
+        if state != ONLINE or server_id == exclude_instance:
+            continue
+        info = catalog.instances.get(server_id)
+        if info is None or not info.alive or not info.port:
+            continue
+        urls.append(f"http://{info.host}:{info.port}")
+    return urls
+
+
+def download_segment_tar(deepstore, catalog, table: str, segment: str,
+                         dest_tar: str, download_path: str,
+                         exclude_instance: Optional[str] = None) -> None:
+    """One download policy for every fetcher (server load, minion input,
+    controller raw-download proxy): deep store first, falling back to a
+    serving peer on a peer:// scheme OR any deep-store failure."""
+    try:
+        if download_path.startswith("peer://"):
+            raise ConnectionError("peer-scheme segment")
+        deepstore.download(download_path, dest_tar)
+    except Exception:
+        fetch_from_peer(catalog, table, segment, dest_tar,
+                        exclude_instance=exclude_instance)
+
+
+def fetch_from_peer(catalog, table: str, segment: str, dest_tar: str,
+                    exclude_instance: Optional[str] = None,
+                    timeout_s: float = 60.0) -> str:
+    """Download the segment tar from the first answering peer; returns the
+    peer URL used. Raises ConnectionError when no peer can serve it."""
+    from .http_service import http_call
+    last: Optional[Exception] = None
+    for url in peer_urls(catalog, table, segment, exclude_instance):
+        try:
+            data = http_call("GET", f"{url}/segmentData/{table}/{segment}",
+                             timeout=timeout_s)
+        except Exception as e:
+            last = e
+            continue
+        os.makedirs(os.path.dirname(dest_tar) or ".", exist_ok=True)
+        with open(dest_tar, "wb") as f:
+            f.write(data)
+        return url
+    raise ConnectionError(
+        f"no peer can serve {table}/{segment}: {last!r}")

@@ -58,6 +58,10 @@ class TableDataManager:
                 if seg.name in self._refcounts:
                     self._refcounts[seg.name] -= 1
 
+    def get(self, name: str) -> Optional[ImmutableSegment]:
+        with self._lock:
+            return self._segments.get(name)
+
     @property
     def segment_names(self) -> List[str]:
         with self._lock:
@@ -350,13 +354,26 @@ class ServerNode:
                 if meta is None or not meta.download_path:
                     raise FileNotFoundError(f"no deep-store path for {table}/{seg_name}")
                 tar_local = f"{local_dir}.{threading.get_ident()}.tar.gz"
-                self.deepstore.download(meta.download_path, tar_local)
+                from .peers import download_segment_tar
+                download_segment_tar(self.deepstore, self.catalog, table,
+                                     seg_name, tar_local, meta.download_path,
+                                     exclude_instance=self.instance_id)
                 try:
                     untar_segment(tar_local, os.path.dirname(local_dir))
                 finally:
                     if os.path.exists(tar_local):
                         os.remove(tar_local)
             mgr.add_segment(seg_name, load_segment(local_dir))
+
+    def local_segment_dir(self, table: str, seg_name: str) -> Optional[str]:
+        """On-disk directory of a LOADED segment (peer download serves from
+        it); None when this server doesn't serve the segment."""
+        mgr = self.tables.get(table)
+        if mgr is None:
+            return None
+        seg = mgr.get(seg_name)
+        path = getattr(seg, "path", None)
+        return path if path and os.path.isdir(path) else None
 
     def _segment_load_lock(self, table: str, seg_name: str) -> threading.Lock:
         key = (table, seg_name)

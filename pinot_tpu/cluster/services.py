@@ -91,6 +91,7 @@ class ControllerService:
         s.route("POST", "pauseConsumption", self._pause_consumption, action="ADMIN")
         s.route("POST", "resumeConsumption", self._resume_consumption, action="ADMIN")
         s.route("POST", "rebalance", self._rebalance, action="ADMIN")
+        s.route("POST", "validate", self._validate, action="ADMIN")
         # minion task protocol (reference: Helix task framework; claims are
         # atomic against the authoritative catalog, so N remote minions can
         # never double-claim)
@@ -234,7 +235,9 @@ class ControllerService:
             return error_response(f"no such segment {table}/{name}", 404)
         with tempfile.TemporaryDirectory() as tmp:
             local = os.path.join(tmp, "seg.tar.gz")
-            self.controller.deepstore.download(meta.download_path, local)
+            from .peers import download_segment_tar
+            download_segment_tar(self.controller.deepstore, self.catalog,
+                                 table, name, local, meta.download_path)
             with open(local, "rb") as f:
                 return binary_response(f.read())
 
@@ -412,6 +415,12 @@ class ControllerService:
         moves = self.controller.rebalance(parts[0])
         return json_response({"status": "OK", "idealState": moves})
 
+    def _validate(self, parts, params, body):
+        """POST /validate — run one RealtimeSegmentValidationManager round now
+        (successor repair, dead-replica reassignment, peer-segment healing);
+        the same work the 60s periodic task does, on demand for operators."""
+        return json_response(self.controller.llc.validate())
+
     # -- segment completion protocol ----------------------------------------
     def _segment_consumed(self, parts, params, body):
         d = json.loads(body.decode())
@@ -470,6 +479,7 @@ class ServerService:
         self.http.route("POST", "stage", self._stage)
         self.http.route("GET", "health", self._health)
         self.http.route("GET", "segments", self._segments)
+        self.http.route("GET", "segmentData", self._segment_data)
         self.http.route("GET", "metrics", _metrics_route)
         self.http.start()
         # advertise the query endpoint so brokers can find us (reference: Helix
@@ -574,6 +584,25 @@ class ServerService:
 
     def _segments(self, parts, params, body):
         return json_response({"segments": self.server.segments_served(parts[0])})
+
+    def _segment_data(self, parts, params, body):
+        """GET /segmentData/{table}/{segment} — tar of this server's LOADED
+        copy (reference: peer download scheme; every ONLINE replica can serve
+        the committed bytes when the deep store can't)."""
+        import tempfile as _tf
+
+        from ..auth import require_table_access
+        from .deepstore import tar_segment
+        table, name = parts[0], parts[1]
+        require_table_access(table, "READ")  # raw data = same ACL as queries
+        seg_dir = self.server.local_segment_dir(table, name)
+        if seg_dir is None:
+            return error_response(f"{table}/{name} not served here", 404)
+        with _tf.TemporaryDirectory() as tmp:
+            tar_path = os.path.join(tmp, "seg.tar.gz")
+            tar_segment(seg_dir, tar_path)
+            with open(tar_path, "rb") as f:
+                return binary_response(f.read())
 
 
 class MinionService:

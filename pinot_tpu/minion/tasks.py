@@ -501,11 +501,15 @@ class MinionWorker:
         self.executors[ex.task_type] = ex
 
     def fetch_segment(self, table: str, segment: str) -> str:
-        """Download + unpack one segment from the deep store; returns its dir."""
+        """Download + unpack one segment (deep store, falling back to a
+        serving PEER replica for peer-scheme or outage cases); returns its
+        dir."""
         from ..cluster.deepstore import untar_segment
+        from ..cluster.peers import download_segment_tar
         meta = self.catalog.segments[table][segment]
         tar_path = os.path.join(self.work_dir, "fetch", f"{segment}.tar.gz")
-        self.deepstore.download(meta.download_path, tar_path)
+        download_segment_tar(self.deepstore, self.catalog, table, segment,
+                             tar_path, meta.download_path)
         seg_dir = untar_segment(tar_path, os.path.join(self.work_dir, "fetch", segment))
         os.remove(tar_path)
         return seg_dir

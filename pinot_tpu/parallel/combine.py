@@ -49,8 +49,9 @@ from .mesh import SEGMENT_AXIS, default_mesh
 
 
 def _has_docset_filter(ctx: QueryContext) -> bool:
-    """JSON_MATCH/TEXT_MATCH resolve to per-segment doc bitmaps (DocSetLeaf), which the
-    stacked mesh kernel does not take as inputs — those queries keep the fallback."""
+    """JSON_MATCH/TEXT_MATCH resolve to per-segment doc bitmaps (DocSetLeaf):
+    on the ALIGNED immutable path they stack into the mesh kernel's `docsets`
+    input (_stacked_docsets); unaligned/mutable sets keep the fallback."""
     def walk(e) -> bool:
         if isinstance(e, Function):
             if e.name in ("json_match", "text_match"):

@@ -274,8 +274,12 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
                 lut[:card] = ids.contains(reader.dictionary._np_values)
             leaves.append(LutLeaf(col.name, lut))
         else:
+            import hashlib
             mask = ids.contains(reader.values())
-            leaves.append(DocSetLeaf(col.name, f"idset[{len(ids)}]", mask))
+            # content-addressed token: the serialized literal IS the set
+            digest = hashlib.sha1(str(lit.value).encode()).hexdigest()
+            leaves.append(DocSetLeaf(col.name, f"idset[{len(ids)}]", mask,
+                                     cache_token=f"idset:{digest}"))
         return ("leaf", len(leaves) - 1)
     geo = _try_geo_predicate(e, seg, leaves)
     if geo is not None:

@@ -1,0 +1,120 @@
+"""Peer segment download: commit survives a deep-store outage (peer scheme),
+replicas and movers fetch from serving peers, and the validation round heals
+the deep store once it recovers.
+
+Reference: `PeerServerSegmentFinder.java` + PeerSchemeSplitSegmentCommitter +
+RealtimeSegmentValidationManager.uploadToDeepStoreIfMissing.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from pinot_tpu.cluster.http_service import get_json, post_json
+from pinot_tpu.cluster.process import ProcessCluster
+from pinot_tpu.ingest.kafkalite import LogBrokerClient, LogBrokerServer
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+from conftest import wait_until
+
+
+def _break_deepstore(work_dir: str) -> None:
+    """Make every deep-store write/read fail: replace the root dir with a
+    regular file (works even as root, unlike permission bits)."""
+    root = os.path.join(work_dir, "deepstore")
+    os.rename(root, root + ".parked")
+    with open(root, "w") as f:
+        f.write("outage")
+
+
+def _restore_deepstore(work_dir: str) -> None:
+    root = os.path.join(work_dir, "deepstore")
+    os.remove(root)
+    os.rename(root + ".parked", root)
+
+
+def test_commit_and_convergence_survive_deepstore_outage(tmp_path):
+    schema = Schema("pv", [
+        dimension("u", DataType.STRING),
+        metric("v", DataType.LONG),
+        date_time("ts", DataType.LONG),
+    ])
+    srv = LogBrokerServer()
+    try:
+        client = LogBrokerClient(srv.bootstrap)
+        client.create_topic("pv_t", 1)
+        with ProcessCluster(num_servers=2, work_dir=str(tmp_path)) as cluster:
+            cluster.controller.add_schema(schema)
+            cfg = TableConfig(
+                "pv", table_type=TableType.REALTIME, time_column="ts",
+                replication=2,
+                stream=StreamConfig(stream_type="kafkalite", topic="pv_t",
+                                    properties={"bootstrap": srv.bootstrap},
+                                    flush_threshold_rows=30))
+            cluster.controller.add_table(cfg, num_partitions=1)
+            table = cfg.table_name_with_type
+
+            def count():
+                rows = cluster.query(
+                    "SELECT COUNT(*) FROM pv")["resultTable"]["rows"]
+                return rows[0][0] if rows else 0
+
+            # a first healthy flush proves the normal path, then the OUTAGE
+            for i in range(10):
+                client.produce("pv_t", json.dumps(
+                    {"u": f"u{i % 3}", "v": i, "ts": 1700000000000 + i}))
+            assert wait_until(lambda: count() == 10, timeout=30)
+
+            _break_deepstore(str(tmp_path))
+            try:
+                for i in range(10, 40):
+                    client.produce("pv_t", json.dumps(
+                        {"u": f"u{i % 3}", "v": i, "ts": 1700000000000 + i}))
+
+                # the segment COMMITS despite the dead deep store — under the
+                # peer download scheme
+                def done_segments():
+                    metas = cluster.controller.segments_meta(table)["segments"]
+                    return {n: m for n, m in metas.items()
+                            if m.get("status") == "DONE"}
+                assert wait_until(lambda: len(done_segments()) >= 1,
+                                  timeout=40), "commit must survive the outage"
+                peer_segs = [n for n, m in done_segments().items()
+                             if str(m.get("download_path", "")
+                                    ).startswith("peer://")]
+                assert peer_segs, done_segments()
+                assert wait_until(lambda: count() == 40, timeout=30)
+
+                # EV converges: BOTH replicas serve the committed segment
+                def converged():
+                    return cluster.controller.table_status(table)["converged"]
+                assert wait_until(converged, timeout=30)
+
+                # a server that must DOWNLOAD the segment (post-restart, local
+                # data wiped) fetches it from a peer, deep store still dead
+                import shutil
+                victim = peer_segs[0]
+                shutil.rmtree(os.path.join(str(tmp_path), "server_1", table),
+                              ignore_errors=True)
+                cluster.restart_server("server_1")
+                assert wait_until(converged, timeout=40), \
+                    "restarted replica must converge via peer download"
+                assert wait_until(lambda: count() == 40, timeout=30)
+            finally:
+                _restore_deepstore(str(tmp_path))
+
+            # deep store is back: one validation round re-uploads the
+            # peer-scheme segment and flips its path to the durable URI
+            healed = post_json(f"{cluster.controller_url}/validate", {})
+            assert set(peer_segs) <= set(healed.get("healed", [])), healed
+            metas = cluster.controller.segments_meta(table)["segments"]
+            for n in peer_segs:
+                path = metas[n]["download_path"]
+                assert not path.startswith("peer://")
+                assert os.path.exists(
+                    os.path.join(str(tmp_path), "deepstore", path))
+    finally:
+        srv.stop()
