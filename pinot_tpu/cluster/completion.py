@@ -458,8 +458,13 @@ class LLCSegmentManager:
             for name, meta in list(segs.items()):
                 if not (meta.download_path or "").startswith("peer://"):
                     continue
+                import uuid as _uuid
                 uri = f"{table}/{name}.tar.gz"
-                tmp = os.path.join(self.work_dir, f"heal_{name}.tar.gz")
+                # unique temp per round: POST /validate can run concurrently
+                # with the periodic round — a shared name would let one
+                # round's truncating open race the other's upload read
+                tmp = os.path.join(self.work_dir,
+                                   f"heal_{name}_{_uuid.uuid4().hex[:8]}.tar.gz")
                 try:
                     fetch_from_peer(self.catalog, table, name, tmp)
                     self.deepstore.upload(tmp, uri)

@@ -270,9 +270,13 @@ class RemoteServerHandle:
     `ServerHandle` signature (reference: QueryRouter.submitQuery + DataTable
     deserialize on response)."""
 
-    def __init__(self, server_url: str, timeout_s: float = 60.0):
+    def __init__(self, server_url: str, timeout_s: float = 60.0,
+                 token: Optional[str] = None):
         self.server_url = server_url.rstrip("/")
         self.timeout_s = timeout_s
+        # explicit per-handle token (external connector processes have no
+        # process-global default token); None falls back to the default
+        self.token = token
 
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
@@ -286,7 +290,8 @@ class RemoteServerHandle:
                                     trace=tr is not None)
         resp = http_call("POST", f"{self.server_url}/query", body,
                          timeout=self.timeout_s,
-                         content_type="application/octet-stream")
+                         content_type="application/octet-stream",
+                         token=self.token)
         result = decode_segment_result(resp)
         spans = getattr(result, "trace_spans", None)
         if tr is not None and spans:

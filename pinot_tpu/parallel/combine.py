@@ -309,9 +309,10 @@ class MeshQueryExecutor:
         `cache_token` (kind + every predicate parameter — geo leaves include
         the center point): immutable segments give one index lookup + one
         device transfer per distinct predicate, so repeated TEXT_MATCH
-        queries dispatch at the same cost as any other filter. Tokenless
-        leaves (id sets) are never cached; cached entries reuse PER KEY, so
-        one uncacheable leaf doesn't defeat the others' cache."""
+        queries dispatch at the same cost as any other filter (id-set leaves
+        are content-addressed by a digest of the serialized set). A leaf
+        without a token is never cached; cached entries reuse PER KEY, so one
+        uncacheable leaf doesn't defeat the others' cache."""
         from ..query.predicate import DocSetLeaf, compile_filter
         probe_leaves = [l for l in plan.filter_prog.leaves
                         if isinstance(l, DocSetLeaf)]
