@@ -306,7 +306,8 @@ class RemoteServerHandle:
         body = encode_query_request(table, sql, segment_names)
         resp = http_call("POST", f"{self.server_url}/explain", body,
                          timeout=self.timeout_s,
-                         content_type="application/octet-stream")
+                         content_type="application/octet-stream",
+                         token=self.token)
         return json.loads(resp.decode())["rows"]
 
     def join_stage(self, spec, left, right, agg=None):
@@ -326,8 +327,9 @@ class RemoteServerHandle:
                              "left": dict(left), "right": dict(right)})
         from .http_service import _DEFAULT_TOKEN, HttpError
         headers = {"Content-Type": "application/octet-stream"}
-        if _DEFAULT_TOKEN:
-            headers["Authorization"] = f"Bearer {_DEFAULT_TOKEN}"
+        bearer = self.token if self.token is not None else _DEFAULT_TOKEN
+        if bearer:
+            headers["Authorization"] = f"Bearer {bearer}"
         req = urllib.request.Request(f"{self.server_url}/stage", data=body,
                                      headers=headers)
         blocks = []

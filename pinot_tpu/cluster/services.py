@@ -99,7 +99,8 @@ class ControllerService:
         s.route("GET", "tasks", self._tasks_get)
         s.route("POST", "replaceSegments", self._replace_segments, action="WRITE")
         s.route("GET", "metrics", _metrics_route)
-        s.route("GET", "", self._ui)       # minimal admin UI at /
+        s.route("POST", "sql", self._sql_proxy)  # query console backend
+        s.route("GET", "", self._ui)       # admin UI at /
         s.route("GET", "ui", self._ui)
         self.http.start()
 
@@ -110,9 +111,31 @@ class ControllerService:
     def stop(self) -> None:
         self.http.stop()
 
+    _UI_STYLE = (
+        "<style>body{font-family:sans-serif;margin:2em}table{border-collapse:"
+        "collapse}td,th{border:1px solid #ccc;padding:4px 10px;text-align:left}"
+        ".err{color:#b00}.warn{background:#fff3cd}nav a{margin-right:1em}"
+        "textarea{width:100%;font-family:monospace}</style>"
+        "<nav><a href=/ui>overview</a><a href=/ui/tasks>tasks</a>"
+        "<a href=/ui/query>query console</a><a href=/metrics>metrics</a></nav>")
+
     def _ui(self, parts, params, body):
-        """GET / — a minimal server-rendered status page (stand-in for the
-        reference's controller admin webapp): tables, segments, instances."""
+        """GET /ui[/...] — server-rendered admin console (stand-in for the
+        reference's React controller app): cluster overview, per-table
+        segment drill-down with replica placement (skew is visible at a
+        glance), task states (stuck/failed tasks diagnosable from the
+        browser), and a query console proxying to a live broker (reference:
+        PinotQueryResource)."""
+        page = parts[0] if parts else ""
+        if page == "table" and len(parts) > 1:
+            return self._ui_table(parts[1])
+        if page == "tasks":
+            return self._ui_tasks()
+        if page == "query":
+            return self._ui_query()
+        return self._ui_overview()
+
+    def _ui_overview(self):
         from html import escape
         with self.catalog._lock:
             tables = {
@@ -122,25 +145,145 @@ class ControllerService:
                 for t, cfg in self.catalog.table_configs.items()}
             instances = [(i.instance_id, i.role, "UP" if i.alive else "DOWN")
                          for i in self.catalog.instances.values()]
+            # per-server segment counts across all tables: load skew at a glance
+            load: Dict[str, int] = {}
+            for t, ev in self.catalog.external_view.items():
+                for seg, states in ev.items():
+                    for srv, st in states.items():
+                        if st in ("ONLINE", "CONSUMING"):
+                            load[srv] = load.get(srv, 0) + 1
         # escape EVERY catalog-derived value: table/instance names are
         # client-supplied and would otherwise be stored XSS in the operator UI
         rows = "".join(
-            f"<tr><td>{escape(t)}</td><td>{d['type']}</td><td>{d['segments']}</td>"
+            f"<tr><td><a href='/ui/table/{escape(t)}'>{escape(t)}</a></td>"
+            f"<td>{d['type']}</td><td>{d['segments']}</td>"
             f"<td>{d['replication']}</td></tr>" for t, d in sorted(tables.items()))
         inst = "".join(
-            f"<tr><td>{escape(i)}</td><td>{escape(r)}</td><td>{s}</td></tr>"
-            for i, r, s in sorted(instances))
+            f"<tr><td>{escape(i)}</td><td>{escape(r)}</td><td>{s}</td>"
+            f"<td>{load.get(i, 0)}</td></tr>" for i, r, s in sorted(instances))
         html = (
             "<!doctype html><title>pinot-tpu controller</title>"
-            "<style>body{font-family:sans-serif;margin:2em}table{border-collapse:"
-            "collapse}td,th{border:1px solid #ccc;padding:4px 10px}</style>"
-            "<h1>pinot-tpu controller</h1>"
+            f"{self._UI_STYLE}<h1>pinot-tpu controller</h1>"
             "<h2>Tables</h2><table><tr><th>table</th><th>type</th>"
             f"<th>segments</th><th>replication</th></tr>{rows}</table>"
             "<h2>Instances</h2><table><tr><th>instance</th><th>role</th>"
-            f"<th>status</th></tr>{inst}</table>"
-            "<p><a href=/metrics>metrics</a> · <a href=/tables>tables api</a></p>")
+            f"<th>status</th><th>segments served</th></tr>{inst}</table>")
         return 200, "text/html", html.encode()
+
+    def _ui_table(self, table):
+        """Per-segment drill-down: status, docs, size, time range, replica
+        placement and per-server counts — a skewed table shows up as uneven
+        'segments per server' and lopsided placements."""
+        from html import escape
+        with self.catalog._lock:
+            segs = dict(self.catalog.segments.get(table, {}))
+            ev = {s: dict(m) for s, m in
+                  self.catalog.external_view.get(table, {}).items()}
+        if not segs and not ev:
+            return error_response(f"unknown table {table}", 404)
+        per_server: Dict[str, int] = {}
+        rows = []
+        for name in sorted(set(segs) | set(ev)):
+            m = segs.get(name)
+            states = ev.get(name, {})
+            for srv, st in states.items():
+                if st in ("ONLINE", "CONSUMING"):
+                    per_server[srv] = per_server.get(srv, 0) + 1
+            placement = ", ".join(f"{escape(s)}:{escape(str(st))}"
+                                  for s, st in sorted(states.items()))
+            rows.append(
+                f"<tr><td>{escape(name)}</td>"
+                f"<td>{escape(str(m.status)) if m else '?'}</td>"
+                f"<td>{m.num_docs if m else '?'}</td>"
+                f"<td>{m.size_bytes if m else '?'}</td>"
+                f"<td>{m.start_time_ms if m else ''}..{m.end_time_ms if m else ''}</td>"
+                f"<td>{escape(str(m.download_path)) if m else ''}</td>"
+                f"<td>{placement}</td></tr>")
+        srv_rows = "".join(f"<tr><td>{escape(s)}</td><td>{n}</td></tr>"
+                           for s, n in sorted(per_server.items()))
+        html = (
+            f"<!doctype html><title>{escape(table)}</title>{self._UI_STYLE}"
+            f"<h1>table {escape(table)}</h1>"
+            "<h2>Segments per server</h2>"
+            f"<table><tr><th>server</th><th>segments</th></tr>{srv_rows}</table>"
+            "<h2>Segments</h2><table><tr><th>segment</th><th>status</th>"
+            "<th>docs</th><th>bytes</th><th>time range</th><th>download</th>"
+            f"<th>placement</th></tr>{''.join(rows)}</table>")
+        return 200, "text/html", html.encode()
+
+    def _ui_tasks(self):
+        """Task/job states: a STUCK task is RUNNING with an old lease, a
+        failed one shows its error inline (reference: task states in the
+        controller console)."""
+        import time as _t
+        from html import escape
+        from ..minion.tasks import TaskQueue
+        now_ms = int(_t.time() * 1000)
+        rows = []
+        for t in TaskQueue(self.catalog).tasks():
+            age_s = (now_ms - t.claimed_ms) / 1000 if t.claimed_ms else None
+            stuck = t.state == "RUNNING" and age_s is not None and age_s > 600
+            cls = " class=warn" if stuck else ""
+            rows.append(
+                f"<tr{cls}><td>{escape(t.task_id)}</td>"
+                f"<td>{escape(t.task_type)}</td><td>{escape(t.table)}</td>"
+                f"<td>{escape(t.state)}{' (stale lease)' if stuck else ''}</td>"
+                f"<td>{escape(t.worker)}</td>"
+                f"<td>{f'{age_s:.0f}s' if age_s is not None else ''}</td>"
+                f"<td class=err>{escape(t.error)}</td></tr>")
+        html = (
+            f"<!doctype html><title>tasks</title>{self._UI_STYLE}"
+            "<h1>Minion tasks</h1><table><tr><th>task</th><th>type</th>"
+            "<th>table</th><th>state</th><th>worker</th><th>claimed age</th>"
+            f"<th>error</th></tr>{''.join(rows)}</table>"
+            "<p>POST /tasks/gc requeues stale RUNNING tasks; POST "
+            "/tasks/generate runs the generators now.</p>")
+        return 200, "text/html", html.encode()
+
+    def _ui_query(self):
+        """Query console: textarea -> POST /sql (the controller-side broker
+        proxy, reference: PinotQueryResource.handlePostSql)."""
+        html = (
+            f"<!doctype html><title>query console</title>{self._UI_STYLE}"
+            "<h1>Query console</h1>"
+            "<textarea id=q rows=4>SELECT * FROM mytable LIMIT 10</textarea>"
+            "<p><button onclick='run()'>Run</button></p><div id=out></div>"
+            "<script>async function run(){"
+            "const r=await fetch('/sql',{method:'POST',headers:{'Content-Type'"
+            ":'application/json'},body:JSON.stringify({sql:document."
+            "getElementById('q').value})});const d=await r.json();"
+            "const o=document.getElementById('out');if(d.error){o.innerHTML="
+            "'<p class=err></p>';o.firstChild.textContent=d.error;return;}"
+            "const t=d.resultTable||{};const cols=(t.dataSchema||{})."
+            "columnNames||[];let h='<table><tr>'+cols.map(c=>'<th></th>')."
+            "join('')+'</tr>'+(t.rows||[]).map(r=>'<tr>'+r.map(c=>'<td></td>')"
+            ".join('')+'</tr>').join('')+'</table>';o.innerHTML=h;"
+            "const cells=o.querySelectorAll('th');cols.forEach((c,i)=>cells[i]"
+            ".textContent=c);let k=0;const tds=o.querySelectorAll('td');"
+            "(t.rows||[]).forEach(r=>r.forEach(v=>tds[k++].textContent="
+            "String(v)));}</script>")
+        return 200, "text/html", html.encode()
+
+    def _sql_proxy(self, parts, params, body):
+        """POST /sql {\"sql\": ...} — forward to a live broker (reference:
+        the controller's PinotQueryResource proxy, the query console's
+        backend). Tries each live broker instance until one answers."""
+        from .http_service import post_json
+        d = json.loads(body.decode())
+        with self.catalog._lock:
+            brokers = [(i.instance_id, i.host, i.port)
+                       for i in self.catalog.instances.values()
+                       if i.role == "broker" and i.alive and i.port]
+        last = "no live broker registered"
+        for _bid, host, port in sorted(brokers):
+            try:
+                resp = post_json(f"http://{host}:{port}/query",
+                                 {"sql": d["sql"]}, timeout=60.0)
+                return json_response(resp)
+            except Exception as e:
+                last = f"{type(e).__name__}: {e}"
+        return json_response({"error": f"broker unavailable: {last}"},
+                             status=503)
 
     # -- catalog API (the ZooKeeper stand-in) -------------------------------
     def _bump_version(self, event: str, table: str) -> None:
@@ -685,6 +828,11 @@ class BrokerService:
         self._wire_server_handles()
         self.broker.failure_detector.start()  # background re-probe loop
         self.http.start()
+        # advertise the SQL endpoint (the controller's query-console proxy
+        # and external clients discover brokers through the catalog)
+        broker.catalog.register_instance(InstanceInfo(
+            broker.instance_id, "broker", host=self.http.host,
+            port=self.http.port))
 
     @property
     def url(self) -> str:

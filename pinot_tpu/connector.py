@@ -27,8 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from .cluster.http_service import get_json
 from .schema import DataType, Schema
 
-# "unbounded" LIMIT for split scans (same sentinel the broker leaf scans use)
-_UNBOUNDED = 1 << 40
+from .cluster.broker import UNBOUNDED_LIMIT as _UNBOUNDED  # shared sentinel
 
 
 @dataclass
@@ -105,8 +104,19 @@ class PinotReader:
             if filter:
                 sql += f" WHERE {filter}"
             sql += f" LIMIT {_UNBOUNDED}"
+            # lineage visibility (reference: SegmentLineage filtering): during
+            # a replace, IN_PROGRESS hides the new outputs and COMPLETED the
+            # replaced inputs — reading both sides would double count
+            hidden = set()
+            for e in (snap.get("properties", {}).get(f"lineage/{phys}")
+                      or []):
+                hidden.update(e["to"] if e["state"] == "IN_PROGRESS"
+                              else e["from"])
             by_server: Dict[str, List[str]] = {}
+            unplaced: List[str] = []
             for seg, states in snap["externalView"].get(phys, {}).items():
+                if seg in hidden:
+                    continue
                 candidates = [
                     server_id for server_id, state in sorted(states.items())
                     if state in ("ONLINE", "CONSUMING")
@@ -122,6 +132,15 @@ class PinotReader:
                     chosen = candidates[
                         zlib.crc32(seg.encode()) % len(candidates)]
                     by_server.setdefault(chosen, []).append(seg)
+                else:
+                    unplaced.append(seg)
+            if unplaced:
+                # every visible segment must land in a split — an export
+                # ERRORS rather than silently shortening (the broker's
+                # streaming path enforces the same contract)
+                raise RuntimeError(
+                    f"segments with no live replica in {phys}: "
+                    f"{sorted(unplaced)}")
             for server_id, segs in sorted(by_server.items()):
                 info = instances[server_id]
                 url = f"http://{info['host']}:{info['port']}"
@@ -172,7 +191,12 @@ class PinotReader:
         fields = []
         for j, col in enumerate(split.columns):
             vals = [r[j] for r in result.rows]
-            typ = _arrow_type(schema.field_spec(col).data_type)
+            spec = schema.field_spec(col)
+            typ = _arrow_type(spec.data_type)
+            if not spec.single_value:
+                # MV cells arrive as sequences -> Arrow list arrays
+                typ = pa.list_(typ)
+                vals = [list(v) if v is not None else None for v in vals]
             arrays.append(pa.array(vals, type=typ))
             fields.append(pa.field(col, typ))
         return pa.Table.from_arrays(arrays, schema=pa.schema(fields))

@@ -149,3 +149,36 @@ def test_hybrid_read_respects_time_boundary(tmp_path):
                 sorted(901 + i * 10 for i in range(25))
     finally:
         srv.stop()
+
+
+def test_admin_ui_and_query_console(cluster_with_trips):
+    """Admin surface: overview with drill-down links, per-table segment page
+    (placement + per-server counts = skew diagnosis), task page, and the
+    query console's POST /sql broker proxy."""
+    import urllib.request
+    cluster, cols = cluster_with_trips
+    url = cluster.controller_url
+
+    def get(path):
+        return urllib.request.urlopen(f"{url}{path}", timeout=10).read().decode()
+
+    overview = get("/ui")
+    assert "/ui/table/trips_OFFLINE" in overview
+    assert "segments served" in overview
+
+    table_page = get("/ui/table/trips_OFFLINE")
+    assert "Segments per server" in table_page
+    for i in range(4):
+        assert f"trips_{i}" in table_page
+    assert "server_0" in table_page and "server_1" in table_page
+
+    tasks_page = get("/ui/tasks")
+    assert "Minion tasks" in tasks_page
+
+    console = get("/ui/query")
+    assert "Query console" in console and "/sql" in console
+
+    from pinot_tpu.cluster.http_service import post_json
+    resp = post_json(f"{url}/sql",
+                     {"sql": "SELECT COUNT(*) FROM trips"}, timeout=30)
+    assert resp["resultTable"]["rows"][0][0] == 1200
