@@ -28,9 +28,7 @@ from .routing import RoutingManager
 # server handle: execute_partial(table, ctx, segment_names, time_filter) -> SegmentResult
 ServerHandle = Callable[..., SegmentResult]
 
-# "unbounded" LIMIT for synthesized leaf scans — one sentinel for both the in-proc
-# ctx and the SQL shipped to remote servers, so both transports behave identically
-UNBOUNDED_LIMIT = 1 << 40
+from ..constants import UNBOUNDED_LIMIT
 
 
 class FailureDetector:

@@ -76,6 +76,11 @@ class InstanceInfo:
     port: int = 0
     tags: List[str] = field(default_factory=lambda: ["DefaultTenant"])
     alive: bool = True
+    scheme: str = "http"           # https when the role serves TLS
+
+    @property
+    def url(self) -> str:
+        return f"{self.scheme}://{self.host}:{self.port}"
 
     def to_json(self):
         return dict(self.__dict__)

@@ -52,10 +52,11 @@ class HttpService:
     request an anonymous admin principal."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 access_control=None):
+                 access_control=None, ssl_context=None):
         self._routes: Dict[Tuple[str, str], RouteHandler] = {}
         self._actions: Dict[Tuple[str, str], str] = {}
         self.access_control = access_control
+        self.scheme = "https" if ssl_context is not None else "http"
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -142,11 +143,22 @@ class HttpService:
         self._server.daemon_threads = True
         self.host = host
         self.port = self._server.server_address[1]
+        if ssl_context is not None:
+            # TLS on every role endpoint (reference: pinot.*.tls.* configs,
+            # TlsIntegrationTest). do_handshake_on_connect=False is
+            # LOAD-BEARING: with it, accept() returns immediately and the
+            # handshake happens lazily on first read INSIDE the per-connection
+            # handler thread — a client that connects and sends nothing would
+            # otherwise wedge the single accept loop and hang every request
+            # to this role
+            self._server.socket = ssl_context.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False)
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        return f"{self.scheme}://{self.host}:{self.port}"
 
     def route(self, method: str, head: str, handler: RouteHandler,
               action: str = "READ") -> None:
@@ -198,10 +210,35 @@ class HttpError(Exception):
 # like pinot.broker.segment.fetcher.auth.token) — applied to every http_call
 _DEFAULT_TOKEN: Optional[str] = None
 
+# this process's client-side TLS trust (reference: tls truststore configs);
+# None = plain http / system trust
+_CLIENT_SSL_CONTEXT = None
+
 
 def set_default_token(token: Optional[str]) -> None:
     global _DEFAULT_TOKEN
     _DEFAULT_TOKEN = token
+
+
+def set_default_tls(cafile: Optional[str] = None,
+                    insecure: bool = False) -> None:
+    """Configure this process's outgoing TLS trust: a CA bundle for the
+    cluster's (self-signed) certs, or `insecure=True` to skip verification
+    (test rigs only)."""
+    import ssl
+    global _CLIENT_SSL_CONTEXT
+    if cafile is None and not insecure:
+        _CLIENT_SSL_CONTEXT = None
+        return
+    ctx = ssl.create_default_context(cafile=cafile)
+    if insecure:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    _CLIENT_SSL_CONTEXT = ctx
+
+
+def client_ssl_context():
+    return _CLIENT_SSL_CONTEXT
 
 
 def http_call(method: str, url: str, body: Optional[bytes] = None,
@@ -220,7 +257,8 @@ def http_call(method: str, url: str, body: Optional[bytes] = None,
         try:
             req = urllib.request.Request(url, data=body, method=method,
                                          headers=headers)
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            with urllib.request.urlopen(req, timeout=timeout,
+                                        context=_CLIENT_SSL_CONTEXT) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             raise HttpError(e.code, e.read().decode(errors="replace")) from None

@@ -29,7 +29,7 @@ def peer_urls(catalog, table: str, segment: str,
         info = catalog.instances.get(server_id)
         if info is None or not info.alive or not info.port:
             continue
-        urls.append(f"http://{info.host}:{info.port}")
+        urls.append(info.url)
     return urls
 
 

@@ -56,6 +56,24 @@ def _setup_auth(cfg):
     return StaticTokenAccessControl.from_config(cfg)
 
 
+def _setup_tls(cfg):
+    """Server-side SSL context + this process's outgoing trust, from tls.*
+    config (reference: pinot.*.tls.* keystore/truststore keys,
+    TlsIntegrationTest): `tls.enabled`, `tls.cert`/`tls.key` (PEM), `tls.ca`
+    (the cluster's CA bundle — self-signed in tests)."""
+    if (cfg.get_str("tls.enabled") or "").lower() not in ("true", "1"):
+        return None
+    import ssl
+
+    from .http_service import set_default_tls
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.get_str("tls.cert"), cfg.get_str("tls.key"))
+    set_default_tls(
+        cafile=cfg.get_str("tls.ca"),
+        insecure=(cfg.get_str("tls.insecure") or "").lower() == "true")
+    return ctx
+
+
 def run_controller(work_dir: str, run_dir: str, port: int = 0,
                    config_path: str = "") -> None:
     from .catalog import Catalog
@@ -65,16 +83,20 @@ def run_controller(work_dir: str, run_dir: str, port: int = 0,
 
     cfg = _load_config(config_path, port, "controller.port")
     access_control = _setup_auth(cfg)
+    ssl_ctx = _setup_tls(cfg)
     catalog = Catalog()
     # deep store is configurable by scheme (reference:
-    # controller.data.dir + pinot.controller.storage.factory.class.*)
-    deepstore = create_fs(cfg.get_str(
+    # controller.data.dir + pinot.controller.storage.factory.class.*),
+    # optionally wrapped by the segment crypter (encryption at rest)
+    from ..crypt import wrap_deepstore_from_config
+    deepstore = wrap_deepstore_from_config(create_fs(cfg.get_str(
         "controller.deepstore",
-        f"local://{os.path.join(work_dir, 'deepstore')}"))
+        f"local://{os.path.join(work_dir, 'deepstore')}")), cfg)
     controller = Controller("controller_0", catalog, deepstore,
                             os.path.join(work_dir, "controller"))
     svc = ControllerService(controller, port=cfg.get_int("controller.port", 0),
-                            access_control=access_control)
+                            access_control=access_control,
+                            ssl_context=ssl_ctx)
     controller.start_periodic_tasks()  # retention/repair/relocation/status
     _write_ready(run_dir, "controller_0", {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
@@ -92,6 +114,7 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
     # PinotConfiguration stack consumed by HelixServerStarter)
     cfg = _load_config(config_path, port, "server.port")
     access_control = _setup_auth(cfg)
+    ssl_ctx = _setup_tls(cfg)
     catalog = RemoteCatalog(controller_url)
     deepstore = ControllerDeepStore(controller_url)
     server = ServerNode(instance_id, catalog, deepstore,
@@ -101,7 +124,7 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
                         scheduler=scheduler_from_config(cfg),
                         auto_consume=True)  # real processes pump themselves
     svc = ServerService(server, port=cfg.get_int("server.port", 0),
-                        access_control=access_control)
+                        access_control=access_control, ssl_context=ssl_ctx)
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
     server.shutdown()
@@ -120,6 +143,7 @@ def run_minion(controller_url: str, instance_id: str, work_dir: str,
 
     cfg = _load_config(config_path, port, "minion.port")
     access_control = _setup_auth(cfg)
+    ssl_ctx = _setup_tls(cfg)
     catalog = RemoteCatalog(controller_url)
     worker = MinionWorker(instance_id, catalog,
                           ControllerDeepStore(controller_url),
@@ -129,7 +153,7 @@ def run_minion(controller_url: str, instance_id: str, work_dir: str,
                           queue=RemoteTaskQueue(controller_url))
     svc = MinionService(worker, port=cfg.get_int("minion.port", 0),
                         poll_s=cfg.get_float("minion.poll.seconds", 1.0),
-                        access_control=access_control)
+                        access_control=access_control, ssl_context=ssl_ctx)
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
     svc.stop()
@@ -144,11 +168,12 @@ def run_broker(controller_url: str, instance_id: str, run_dir: str,
 
     cfg = _load_config(config_path, port, "broker.port")
     access_control = _setup_auth(cfg)
+    ssl_ctx = _setup_tls(cfg)
     catalog = RemoteCatalog(controller_url)
     broker = Broker(instance_id, catalog,
                     max_scatter_threads=cfg.get_int("broker.scatter.threads", 8))
     svc = BrokerService(broker, port=cfg.get_int("broker.port", 0),
-                        access_control=access_control)
+                        access_control=access_control, ssl_context=ssl_ctx)
     _write_ready(run_dir, instance_id, {"url": svc.url})
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
 
@@ -171,14 +196,17 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
     os.makedirs(run_dir, exist_ok=True)
     cfg = _load_config(config_path, port, "controller.port")
     access_control = _setup_auth(cfg)
+    ssl_ctx = _setup_tls(cfg)
+    from ..crypt import wrap_deepstore_from_config
     catalog = Catalog()
-    deepstore = create_fs(cfg.get_str(
+    deepstore = wrap_deepstore_from_config(create_fs(cfg.get_str(
         "controller.deepstore",
-        f"local://{os.path.join(work_dir, 'deepstore')}"))
+        f"local://{os.path.join(work_dir, 'deepstore')}")), cfg)
     controller = Controller("controller_0", catalog, deepstore,
                             os.path.join(work_dir, "controller"))
     csvc = ControllerService(controller, port=cfg.get_int("controller.port", 0),
-                             access_control=access_control)
+                             access_control=access_control,
+                             ssl_context=ssl_ctx)
     controller.start_periodic_tasks()
 
     from ..query.scheduler import scheduler_from_config
@@ -191,13 +219,13 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
                         scheduler=scheduler_from_config(cfg),
                         auto_consume=True)
     ssvc = ServerService(server, port=cfg.get_int("server.port", 0),
-                         access_control=access_control)
+                         access_control=access_control, ssl_context=ssl_ctx)
 
     broker_catalog = RemoteCatalog(csvc.url)
     broker = Broker("broker_0", broker_catalog,
                     max_scatter_threads=cfg.get_int("broker.scatter.threads", 8))
     bsvc = BrokerService(broker, port=cfg.get_int("broker.port", 0),
-                         access_control=access_control)
+                         access_control=access_control, ssl_context=ssl_ctx)
 
     from ..minion.tasks import MinionWorker
     from .remote import RemoteController, RemoteTaskQueue
@@ -211,7 +239,7 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
                           queue=RemoteTaskQueue(csvc.url))
     msvc = MinionService(minion, port=cfg.get_int("minion.port", 0),
                          poll_s=cfg.get_float("minion.poll.seconds", 1.0),
-                         access_control=access_control)
+                         access_control=access_control, ssl_context=ssl_ctx)
     _write_ready(run_dir, "controller_0", {"url": csvc.url})
     _write_ready(run_dir, "server_0", {"url": ssvc.url})
     _write_ready(run_dir, "broker_0", {"url": bsvc.url})
@@ -333,13 +361,16 @@ class BrokerClient:
         streaming query endpoint). Use for large exports — rows are consumed
         without buffering the full result anywhere."""
         import urllib.request
+
+        from .http_service import client_ssl_context
         req = urllib.request.Request(
             f"{self.url}/queryStream",
             data=json.dumps({"sql": sql}).encode(),
             headers={"Content-Type": "application/json",
                      **({"Authorization": f"Bearer {self.token}"}
                         if self.token else {})})
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=client_ssl_context()) as resp:
             for line in resp:
                 if not line.strip():
                     continue
@@ -364,12 +395,27 @@ class ProcessCluster:
 
     def __init__(self, num_servers: int = 2, work_dir: Optional[str] = None,
                  server_env: Optional[Dict[str, str]] = None,
-                 startup_timeout_s: float = 60.0, num_minions: int = 0):
+                 startup_timeout_s: float = 60.0, num_minions: int = 0,
+                 config_path: str = ""):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="pinot_tpu_proc_")
         self.run_dir = os.path.join(self.work_dir, "run")
         os.makedirs(self.run_dir, exist_ok=True)
         self.procs: Dict[str, subprocess.Popen] = {}
         self._timeout = startup_timeout_s
+        self._config_path = config_path
+        if config_path:
+            # the config is the single source of truth: apply its tls.* trust
+            # to THIS process's clients too, so cluster.query() works against
+            # the TLS cluster we are about to start without a separate
+            # set_default_tls call
+            from ..config import Configuration
+            from .http_service import set_default_tls
+            cfg = Configuration.load(config_path)
+            if (cfg.get_str("tls.enabled") or "").lower() in ("true", "1"):
+                set_default_tls(
+                    cafile=cfg.get_str("tls.ca"),
+                    insecure=(cfg.get_str("tls.insecure") or ""
+                              ).lower() == "true")
 
         env = dict(os.environ)
         # scrub any TPU-tunnel plugin hooks: role subprocesses default to CPU jax
@@ -410,6 +456,8 @@ class ProcessCluster:
     def _spawn(self, name: str, args: List[str]) -> None:
         cmd = [sys.executable, "-m", "pinot_tpu.cluster.process",
                "--run-dir", self.run_dir] + args
+        if self._config_path:
+            cmd += ["--config", self._config_path]
         with open(os.path.join(self.run_dir, f"{name}.log"), "wb") as log:
             # the child holds its own dup of the fd; close the parent's copy
             self.procs[name] = subprocess.Popen(

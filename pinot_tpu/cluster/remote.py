@@ -333,8 +333,10 @@ class RemoteServerHandle:
         req = urllib.request.Request(f"{self.server_url}/stage", data=body,
                                      headers=headers)
         blocks = []
+        from .http_service import client_ssl_context
         try:
-            resp_cm = urllib.request.urlopen(req, timeout=self.timeout_s)
+            resp_cm = urllib.request.urlopen(req, timeout=self.timeout_s,
+                                             context=client_ssl_context())
         except urllib.error.HTTPError as e:
             # an HTTP status is a response FROM A LIVE SERVER — re-raise as
             # HttpError so the broker's transport/backpressure classification

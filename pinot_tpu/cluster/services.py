@@ -53,10 +53,11 @@ class ControllerService:
     """Controller role process: owns the authoritative catalog + deep store."""
 
     def __init__(self, controller: Controller, host: str = "127.0.0.1",
-                 port: int = 0, access_control=None):
+                 port: int = 0, access_control=None, ssl_context=None):
         self.controller = controller
         self.catalog = controller.catalog
-        self.http = HttpService(host, port, access_control=access_control)
+        self.http = HttpService(host, port, access_control=access_control,
+                                ssl_context=ssl_context)
         self._version = 0
         self._version_cv = threading.Condition()
         self.catalog.subscribe(self._bump_version)
@@ -271,14 +272,14 @@ class ControllerService:
         from .http_service import post_json
         d = json.loads(body.decode())
         with self.catalog._lock:
-            brokers = [(i.instance_id, i.host, i.port)
+            brokers = [(i.instance_id, i.url)
                        for i in self.catalog.instances.values()
                        if i.role == "broker" and i.alive and i.port]
         last = "no live broker registered"
-        for _bid, host, port in sorted(brokers):
+        for _bid, url in sorted(brokers):
             try:
-                resp = post_json(f"http://{host}:{port}/query",
-                                 {"sql": d["sql"]}, timeout=60.0)
+                resp = post_json(f"{url}/query", {"sql": d["sql"]},
+                                 timeout=60.0)
                 return json_response(resp)
             except Exception as e:
                 last = f"{type(e).__name__}: {e}"
@@ -614,9 +615,10 @@ class ServerService:
     """Server role process: query endpoint over the binary wire format."""
 
     def __init__(self, server: ServerNode, host: str = "127.0.0.1", port: int = 0,
-                 access_control=None):
+                 access_control=None, ssl_context=None):
         self.server = server
-        self.http = HttpService(host, port, access_control=access_control)
+        self.http = HttpService(host, port, access_control=access_control,
+                                ssl_context=ssl_context)
         self.http.route("POST", "query", self._query)
         self.http.route("POST", "explain", self._explain)
         self.http.route("POST", "stage", self._stage)
@@ -631,7 +633,7 @@ class ServerService:
         tags = info.tags if info else ["DefaultTenant"]
         server.catalog.register_instance(InstanceInfo(
             server.instance_id, "server", host=self.http.host,
-            port=self.http.port, tags=tags))
+            port=self.http.port, tags=tags, scheme=self.http.scheme))
 
     @property
     def url(self) -> str:
@@ -758,18 +760,19 @@ class MinionService:
     (MinionWorker.run_once already fences + records them)."""
 
     def __init__(self, worker, host: str = "127.0.0.1", port: int = 0,
-                 poll_s: float = 1.0, access_control=None):
+                 poll_s: float = 1.0, access_control=None, ssl_context=None):
         self.worker = worker
         self.poll_s = poll_s
         self._stop = threading.Event()
-        self.http = HttpService(host, port, access_control=access_control)
+        self.http = HttpService(host, port, access_control=access_control,
+                                ssl_context=ssl_context)
         self.http.route("GET", "health", self._health)
         self.http.route("GET", "tasks", self._tasks)
         self.http.route("GET", "metrics", _metrics_route)
         self.http.start()
         worker.catalog.register_instance(InstanceInfo(
             worker.instance_id, "minion", host=self.http.host,
-            port=self.http.port))
+            port=self.http.port, scheme=self.http.scheme))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"{worker.instance_id}-loop")
         self._thread.start()
@@ -813,10 +816,11 @@ class BrokerService:
     """Broker role process: SQL entry over HTTP; discovers servers via catalog."""
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
-                 access_control=None):
+                 access_control=None, ssl_context=None):
         self.broker = broker
         self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
-        self.http = HttpService(host, port, access_control=access_control)
+        self.http = HttpService(host, port, access_control=access_control,
+                                ssl_context=ssl_context)
         self.http.route("POST", "query", self._query)
         self.http.route("POST", "queryStream", self._query_stream)
         self.http.route("GET", "health",
@@ -832,7 +836,7 @@ class BrokerService:
         # and external clients discover brokers through the catalog)
         broker.catalog.register_instance(InstanceInfo(
             broker.instance_id, "broker", host=self.http.host,
-            port=self.http.port))
+            port=self.http.port, scheme=self.http.scheme))
 
     @property
     def url(self) -> str:
@@ -862,7 +866,7 @@ class BrokerService:
                 if self._registered.pop(info.instance_id, None):
                     self.broker.unregister_server(info.instance_id)
                 continue
-            url = f"http://{info.host}:{info.port}"
+            url = info.url
             if self._registered.get(info.instance_id) == url:
                 continue
             self._registered[info.instance_id] = url

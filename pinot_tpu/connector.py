@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from .cluster.http_service import get_json
 from .schema import DataType, Schema
 
-from .cluster.broker import UNBOUNDED_LIMIT as _UNBOUNDED  # shared sentinel
+from .constants import UNBOUNDED_LIMIT as _UNBOUNDED  # shared sentinel
 
 
 @dataclass
@@ -143,7 +143,8 @@ class PinotReader:
                     f"{sorted(unplaced)}")
             for server_id, segs in sorted(by_server.items()):
                 info = instances[server_id]
-                url = f"http://{info['host']}:{info['port']}"
+                url = (f"{info.get('scheme', 'http')}://"
+                       f"{info['host']}:{info['port']}")
                 step = segments_per_split or len(segs)
                 for lo in range(0, len(segs), max(step, 1)):
                     splits.append(ReadSplit(url, phys, segs[lo:lo + step],

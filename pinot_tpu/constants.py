@@ -1,0 +1,7 @@
+"""Cross-layer constants shared by heavy (broker) and light (external
+connector) modules alike — deliberately dependency-free."""
+
+# "unbounded" LIMIT sentinel for synthesized leaf/export scans: one value for
+# the in-proc context, the SQL shipped to remote servers, and connector split
+# scans, so every transport behaves identically.
+UNBOUNDED_LIMIT = 1 << 40
