@@ -56,21 +56,27 @@ def _setup_auth(cfg):
     return StaticTokenAccessControl.from_config(cfg)
 
 
+def _apply_client_tls(cfg) -> bool:
+    """Apply the config's tls.* trust to THIS process's outgoing clients.
+    Returns whether TLS is enabled (one parser for every consumer)."""
+    from .http_service import set_default_tls
+    if not cfg.get_bool("tls.enabled"):
+        return False
+    set_default_tls(cafile=cfg.get_str("tls.ca"),
+                    insecure=cfg.get_bool("tls.insecure"))
+    return True
+
+
 def _setup_tls(cfg):
     """Server-side SSL context + this process's outgoing trust, from tls.*
     config (reference: pinot.*.tls.* keystore/truststore keys,
     TlsIntegrationTest): `tls.enabled`, `tls.cert`/`tls.key` (PEM), `tls.ca`
     (the cluster's CA bundle — self-signed in tests)."""
-    if (cfg.get_str("tls.enabled") or "").lower() not in ("true", "1"):
+    if not _apply_client_tls(cfg):
         return None
     import ssl
-
-    from .http_service import set_default_tls
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(cfg.get_str("tls.cert"), cfg.get_str("tls.key"))
-    set_default_tls(
-        cafile=cfg.get_str("tls.ca"),
-        insecure=(cfg.get_str("tls.insecure") or "").lower() == "true")
     return ctx
 
 
@@ -409,13 +415,7 @@ class ProcessCluster:
             # the TLS cluster we are about to start without a separate
             # set_default_tls call
             from ..config import Configuration
-            from .http_service import set_default_tls
-            cfg = Configuration.load(config_path)
-            if (cfg.get_str("tls.enabled") or "").lower() in ("true", "1"):
-                set_default_tls(
-                    cafile=cfg.get_str("tls.ca"),
-                    insecure=(cfg.get_str("tls.insecure") or ""
-                              ).lower() == "true")
+            _apply_client_tls(Configuration.load(config_path))
 
         env = dict(os.environ)
         # scrub any TPU-tunnel plugin hooks: role subprocesses default to CPU jax

@@ -99,6 +99,7 @@ class ControllerService:
         s.route("POST", "tasks", self._tasks_post, action="WRITE")
         s.route("GET", "tasks", self._tasks_get)
         s.route("POST", "replaceSegments", self._replace_segments, action="WRITE")
+        s.route("POST", "ingestJobs", self._ingest_jobs, action="WRITE")
         s.route("GET", "metrics", _metrics_route)
         s.route("POST", "sql", self._sql_proxy)  # query console backend
         s.route("GET", "", self._ui)       # admin UI at /
@@ -415,6 +416,48 @@ class ControllerService:
             n = queue.gc(lease_ms=int(d.get("leaseMs", 600_000)))
             return json_response({"removed": n})
         return error_response("claim|finish|generate|gc", 404)
+
+    def _ingest_jobs(self, parts, params, body):
+        """POST /ingestJobs {"table", "inputPaths": [...], ...} — split a
+        batch ingestion job into one SegmentGenerationAndPushTask per input
+        file and queue them for the minion fleet (the distributed analog of
+        the reference's hadoop/spark batch runners: N workers ingest N files
+        in parallel; reference: IngestionJobLauncher + per-file
+        SegmentGenerationJobRunner units)."""
+        import uuid as _uuid
+
+        from ..auth import require_table_access
+        from ..minion.tasks import (SEGMENT_GENERATION_AND_PUSH, TaskQueue,
+                                    TaskSpec)
+        d = json.loads(body.decode())
+        table = d["table"]
+        require_table_access(table, "WRITE")
+        if table not in self.catalog.table_configs:
+            return error_response(f"unknown table {table}", 404)
+        paths = list(d.get("inputPaths") or [])
+        if not paths:
+            return error_response("inputPaths required", 400)
+        logical = table.rsplit("_", 1)[0] if table.endswith(
+            ("_OFFLINE", "_REALTIME")) else table
+        prefix = (d.get("segmentNamePrefix")
+                  or f"{logical}_batch_{_uuid.uuid4().hex[:6]}")
+        queue = TaskQueue(self.catalog)
+        ids = []
+        for i, path in enumerate(paths):
+            spec = TaskSpec(
+                task_id=(f"{SEGMENT_GENERATION_AND_PUSH}_{table}_{i}_"
+                         f"{_uuid.uuid4().hex[:8]}"),
+                task_type=SEGMENT_GENERATION_AND_PUSH, table=table,
+                config={"inputPath": path,
+                        "inputFormat": d.get("inputFormat"),
+                        "segmentNamePrefix": prefix,
+                        "segmentRows": int(d.get("segmentRows", 1_000_000)),
+                        "filterExpr": d.get("filterExpr"),
+                        "columnTransforms": d.get("columnTransforms") or {},
+                        "sequence": i})
+            queue.submit(spec)
+            ids.append(spec.task_id)
+        return json_response({"tasks": ids, "segmentNamePrefix": prefix})
 
     def _tasks_get(self, parts, params, body):
         """GET /tasks[?table=...&type=...] — task states (admin surface)."""

@@ -41,6 +41,37 @@ class BatchIngestionJobSpec:
     map_workers: int = 1
 
 
+def ingest_file_to_segments(schema: Schema, table_cfg: TableConfig, path: str,
+                            *, input_format: Optional[str] = None,
+                            filter_expr: Optional[str] = None,
+                            column_transforms: Optional[Dict[str, str]] = None,
+                            segment_rows: int = 1_000_000,
+                            prefix: str, build_dir: str) -> List[str]:
+    """THE per-file ingestion unit (read -> transform -> chunk -> build),
+    shared by the standalone runner below and the distributed
+    SegmentGenerationAndPushTask minion executor — one implementation, so
+    standalone and fleet ingestion of the same spec build identical
+    segments. Returns the built segment dirs (caller pushes them)."""
+    pipeline = TransformPipeline(schema, filter_expr, column_transforms or {})
+    reader = reader_for(path, input_format)
+    try:
+        rows = list(reader.rows())
+    finally:
+        reader.close()
+    columns = pipeline.apply(rows_to_columns(rows, schema))
+    n = len(next(iter(columns.values()))) if columns else 0
+    if n == 0:
+        return []
+    builder = SegmentBuilder(
+        schema, SegmentGeneratorConfig.from_indexing(table_cfg.indexing))
+    seg_dirs = []
+    for i in range(max(1, -(-n // segment_rows))):
+        lo, hi = i * segment_rows, min(n, (i + 1) * segment_rows)
+        part = {c: v[lo:hi] for c, v in columns.items()}
+        seg_dirs.append(builder.build(part, build_dir, f"{prefix}_{i}"))
+    return seg_dirs
+
+
 def run_batch_ingestion(spec: BatchIngestionJobSpec, controller, *,
                         work_dir: str) -> List[str]:
     """Execute the job against a Controller (in-proc or HTTP proxy). Returns segment

@@ -36,6 +36,7 @@ ERROR = "ERROR"
 MERGE_ROLLUP = "MergeRollupTask"
 REALTIME_TO_OFFLINE = "RealtimeToOfflineSegmentsTask"
 PURGE = "PurgeTask"
+SEGMENT_GENERATION_AND_PUSH = "SegmentGenerationAndPushTask"
 
 
 @dataclass
@@ -468,6 +469,38 @@ class PurgeTaskExecutor(BaseMergeExecutor):
             worker.controller.replace_segments(spec.table, old_names, new_dirs)
 
 
+class SegmentGenerationAndPushExecutor(TaskExecutor):
+    """One input FILE -> transformed segment(s) -> controller push (reference:
+    `SegmentGenerationAndPushTaskExecutor` + the hadoop/spark batch runners'
+    per-file unit). The controller's /ingestJobs endpoint splits a batch job
+    into one task per input file, so N minion processes ingest N files in
+    parallel — the distributed runner the standalone in-process one scales
+    out to. Input paths must be readable by the minion (shared filesystem or
+    mounted staging)."""
+
+    task_type = SEGMENT_GENERATION_AND_PUSH
+
+    def execute(self, spec: TaskSpec, worker: "MinionWorker") -> None:
+        from ..ingest.batch import ingest_file_to_segments
+        cfg = worker.catalog.table_configs[spec.table]
+        schema = worker.catalog.schemas[cfg.name]
+        c = spec.config
+        prefix = (c.get("segmentNamePrefix") or cfg.name)
+        seg_dirs = ingest_file_to_segments(
+            schema, cfg, c["inputPath"],
+            input_format=c.get("inputFormat"),
+            filter_expr=c.get("filterExpr"),
+            column_transforms=c.get("columnTransforms"),
+            segment_rows=int(c.get("segmentRows", 1_000_000)),
+            prefix=f"{prefix}_{c.get('sequence', 0)}",
+            build_dir=os.path.join(worker.work_dir, spec.task_id, "out"))
+        for seg_dir in seg_dirs:
+            worker.controller.upload_segment(
+                spec.table, seg_dir,
+                custom={"task": SEGMENT_GENERATION_AND_PUSH,
+                        "inputPath": c["inputPath"]})
+
+
 class MinionWorker:
     """Minion role: claims queued tasks and runs the registered executor.
 
@@ -491,7 +524,7 @@ class MinionWorker:
         self.queue = queue if queue is not None else TaskQueue(catalog)
         self.executors: Dict[str, TaskExecutor] = {}
         for ex in (MergeRollupTaskExecutor(), RealtimeToOfflineTaskExecutor(),
-                   PurgeTaskExecutor()):
+                   PurgeTaskExecutor(), SegmentGenerationAndPushExecutor()):
             self.executors[ex.task_type] = ex
         self.completed = 0
         self.failed = 0

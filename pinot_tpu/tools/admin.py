@@ -232,6 +232,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("ingest-job")
     sp.add_argument("--controller", required=True)
     sp.add_argument("--spec", required=True, help="job spec JSON/YAML file")
+    sp.add_argument("--distributed", action="store_true",
+                    help="queue one task per input file for the minion fleet "
+                         "(POST /ingestJobs) instead of running standalone")
     sp.set_defaults(fn=cmd_ingest_job)
 
     sp = sub.add_parser("cluster-info")
@@ -339,6 +342,21 @@ def cmd_ingest_job(args) -> int:
     except ValueError:
         import yaml
         d = yaml.safe_load(text)
+    if getattr(args, "distributed", False):
+        # scale-out path: the controller splits the job per input file and
+        # the minion fleet executes in parallel (hadoop/spark-runner analog)
+        from ..cluster.http_service import post_json
+        resp = post_json(f"{args.controller.rstrip('/')}/ingestJobs", {
+            "table": d["table"],
+            "inputPaths": d.get("inputPaths", d.get("input_paths", [])),
+            "inputFormat": d.get("inputFormat"),
+            "segmentNamePrefix": d.get("segmentNamePrefix", ""),
+            "segmentRows": int(d.get("segmentRows", 1_000_000)),
+            "filterExpr": d.get("filterExpr"),
+            "columnTransforms": d.get("columnTransforms", {}),
+        })
+        print(f"queued {len(resp['tasks'])} tasks: {resp['tasks']}")
+        return 0
     spec = BatchIngestionJobSpec(
         input_paths=d.get("inputPaths", d.get("input_paths", [])),
         input_format=d.get("inputFormat"),

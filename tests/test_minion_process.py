@@ -296,3 +296,59 @@ def test_multistage_join_groupby_on_worker_processes(tmp_path):
                 if line.startswith("pinot_server_join_stages"):
                     total_stages += float(line.split()[-1])
         assert total_stages > 0
+
+
+def test_distributed_batch_ingestion_over_minions(tmp_path):
+    """POST /ingestJobs splits a batch job into per-file tasks; minion
+    PROCESSES ingest the files in parallel and push segments — the
+    hadoop/spark-runner analog over the minion fleet."""
+    import csv
+
+    schema = Schema("pages", [
+        dimension("site", DataType.STRING),
+        metric("clicks", DataType.LONG),
+        date_time("ts", DataType.LONG),
+    ])
+    rng = np.random.default_rng(53)
+    files, total_clicks, total_rows = [], 0, 0
+    for i in range(3):
+        path = tmp_path / f"in_{i}.csv"
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["site", "clicks", "ts"])
+            for j in range(200):
+                clicks = int(rng.integers(1, 50))
+                w.writerow([f"s{j % 7}.com", clicks, 1_700_000_000_000 + j])
+                total_clicks += clicks
+                total_rows += 1
+        files.append(str(path))
+
+    with ProcessCluster(num_servers=1, num_minions=2,
+                        work_dir=str(tmp_path / "cluster")) as cluster:
+        cluster.controller.add_schema(schema)
+        cluster.controller.add_table(TableConfig("pages"))
+        resp = post_json(f"{cluster.controller_url}/ingestJobs",
+                         {"table": "pages_OFFLINE", "inputPaths": files})
+        assert len(resp["tasks"]) == 3
+
+        def states():
+            return {t["task_id"]: t for t in _tasks(cluster)
+                    if t["task_type"] == "SegmentGenerationAndPushTask"}
+        assert wait_until(lambda: all(
+            t["state"] == "COMPLETED" for t in states().values())
+            and len(states()) == 3, timeout=60), states()
+
+        def count():
+            rows = cluster.query("SELECT COUNT(*), SUM(clicks) FROM pages")[
+                "resultTable"]["rows"]
+            return tuple(rows[0]) if rows else (0, 0)
+        assert wait_until(lambda: count() == (total_rows, total_clicks),
+                          timeout=30), count()
+        # tasks ran on the minion fleet (real processes)
+        workers = {t["worker"] for t in states().values()}
+        assert workers <= {"minion_0", "minion_1"} and workers
+        # segments carry the provenance custom marks
+        metas = cluster.controller.segments_meta("pages_OFFLINE")["segments"]
+        assert len(metas) == 3
+        assert all(m["custom"]["task"] == "SegmentGenerationAndPushTask"
+                   for m in metas.values())
