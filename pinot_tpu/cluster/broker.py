@@ -135,6 +135,15 @@ class Broker:
         self._stage: Dict[str, Callable] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_scatter_threads,
                                         thread_name_prefix=f"{instance_id}-scatter")
+        self._urls: Dict[str, str] = {}   # server_id -> HTTP endpoint (P2P shuffle)
+        # per-stage dispatch timeout for the mailbox shuffle
+        self.stage_timeout_s = 120.0
+        # data-plane memory cap for the legacy broker-funnel multistage path:
+        # a query that would materialize more than this many (estimated) bytes
+        # of inter-stage data IN BROKER MEMORY fails with a clear error instead
+        # of OOMing the broker (None = uncapped; the mailbox shuffle path never
+        # buffers inter-stage data here, so it is not subject to the cap)
+        self.max_data_plane_bytes: Optional[int] = None
         self._lock = threading.RLock()
         from ..query.scheduler import QueryQuotaManager
         self.quota = QueryQuotaManager(catalog)
@@ -143,7 +152,8 @@ class Broker:
 
     def register_server_handle(self, server_id: str, handle: ServerHandle,
                                explain_handle=None, probe=None,
-                               stage_handle=None) -> None:
+                               stage_handle=None, url: Optional[str] = None
+                               ) -> None:
         """Wire a server's execute entry (direct object in-proc, HTTP proxy remote).
         `explain_handle(table, ctx, segments) -> rows` serves EXPLAIN PLAN;
         `probe() -> bool` lets the failure detector re-admit the server after a
@@ -151,13 +161,20 @@ class Broker:
         `stage_handle(spec, left, right, agg=None) -> block | SegmentResult`
         runs one multistage stage partition on the server — the hash join,
         plus the partial GROUP BY when `agg` (an AggStageSpec) is given (the
-        worker-mailbox + partial-AggregateOperator analog)."""
+        worker-mailbox + partial-AggregateOperator analog);
+        `url` is the server's HTTP endpoint — when every routed server has
+        one, multistage queries run the peer-to-peer mailbox shuffle instead
+        of funneling inter-stage data through this broker."""
         with self._lock:
             self._servers[server_id] = handle
             if explain_handle is not None:
                 self._explain[server_id] = explain_handle
             if stage_handle is not None:
                 self._stage[server_id] = stage_handle
+            if url is not None:
+                self._urls[server_id] = url.rstrip("/")
+            else:
+                self._urls.pop(server_id, None)
         if probe is not None:
             self.failure_detector.register_probe(server_id, probe)
         self.failure_detector.notify_healthy(server_id)
@@ -170,6 +187,7 @@ class Broker:
             self._servers.pop(server_id, None)
             self._explain.pop(server_id, None)
             self._stage.pop(server_id, None)
+            self._urls.pop(server_id, None)
         self.failure_detector.remove(server_id)
         self.routing.mark_server_unhealthy(server_id)
 
@@ -272,6 +290,21 @@ class Broker:
 
         if ctx.explain:
             return self._handle_explain(ctx, physical)
+
+        if self._should_distribute_groupby(ctx, physical):
+            from ..multistage.shuffle import P2PUnavailable, coordinate_groupby
+            try:
+                result = coordinate_groupby(self, ctx, physical,
+                                            self._num_partitions(stmt))
+                result.stats["timeUsedMs"] = round(
+                    (time.perf_counter() - t0) * 1000, 3)
+                return result
+            except P2PUnavailable:
+                # in-proc handles or transiently-unhealthy workers: fall
+                # through to the broker-merge scatter (correct, just not
+                # distributed) — visibly, so operators can see the regression
+                from ..utils.metrics import get_registry
+                get_registry().counter("pinot_broker_p2p_fallbacks").inc()
 
         aggs = [make_agg(f) for f in ctx.aggregations]
         group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
@@ -608,10 +641,157 @@ class Broker:
         return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows,
                            {"explain": True})
 
+    # -- peer-to-peer mailbox shuffle support -------------------------------
+
+    def _num_partitions(self, stmt) -> int:
+        from ..multistage.runtime import DEFAULT_PARTITIONS
+        num_partitions = DEFAULT_PARTITIONS
+        for key, v in (stmt.options or {}).items():
+            if key.lower() in ("numpartitions", "stageparallelism"):
+                try:
+                    num_partitions = max(1, int(v))
+                except (TypeError, ValueError):
+                    raise QueryValidationError(
+                        f"OPTION({key}=...) must be an integer, got {v!r}"
+                    ) from None
+        return num_partitions
+
+    def _stage_workers(self, p: int) -> List[Tuple[str, str]]:
+        """Exactly p (server_id, url) worker slots, round-robin over healthy
+        HTTP-reachable servers (reference: the v2 dispatcher assigning stage
+        workers from the live server list)."""
+        from ..multistage.shuffle import P2PUnavailable
+        unhealthy = self.routing.unhealthy_servers()
+        with self._lock:
+            cands = sorted((sid, u) for sid, u in self._urls.items()
+                           if sid in self._servers and sid not in unhealthy)
+        if not cands:
+            raise P2PUnavailable("no HTTP-reachable stage workers")
+        return [cands[i % len(cands)] for i in range(p)]
+
+    def _route_leaf_table(self, table: str, ctx, boundary, routes: list
+                          ) -> None:
+        """Shared per-physical-table leaf routing: coverage check, HTTP-
+        endpoint check, LeafRoute build. Appends to `routes`."""
+        from ..multistage.shuffle import LeafRoute, P2PUnavailable
+        tf_expr = _boundary_expr(boundary, table)
+        tf = to_sql(tf_expr) if tf_expr is not None else None
+        unroutable: List[str] = []
+        routing = self.routing.route_query(table, ctx, extra_filter=tf_expr,
+                                           uncovered=unroutable)
+        if unroutable:
+            raise RuntimeError(
+                f"distributed scan incomplete: segments "
+                f"{sorted(unroutable)} have no healthy replica")
+        for server_id, segments in routing.items():
+            url = self._urls.get(server_id)
+            if url is None:
+                raise P2PUnavailable(
+                    f"server {server_id} has no HTTP endpoint")
+            if segments:
+                routes.append(LeafRoute(server_id, url, table,
+                                        list(segments), tf))
+
+    def _leaf_routes(self, raw_table: str, columns, filt):
+        """Leaf dispatch units for a multistage join scan. Raises
+        P2PUnavailable (caller falls back to the funnel path) when a routed
+        server has no HTTP endpoint. Quota is NOT acquired here — the
+        coordinator acquires it once after EVERY alias routes, so a fallback
+        never double-charges a table's QPS budget."""
+        from ..sql.ast import Identifier
+        physical = self._physical_tables(raw_table)
+        if not physical:
+            raise QueryValidationError(f"unknown table {raw_table!r}")
+        boundary = self._time_boundary(physical)
+        routes: list = []
+        for table in physical:
+            ctx = QueryContext(
+                table=table,
+                select_items=[(Identifier(c), c) for c in columns],
+                filter=filt, group_by=[], aggregations=[], having=None,
+                order_by=[], limit=UNBOUNDED_LIMIT, offset=0, distinct=False)
+            self._route_leaf_table(table, ctx, boundary, routes)
+        return routes
+
+    def _acquire_scan_quota(self, raw_tables) -> None:
+        """One QPS-quota acquisition per logical table (same accounting as the
+        funnel path's per-scan acquisition)."""
+        from ..query.scheduler import QueryRejectedError
+        for raw in raw_tables:
+            if not self.quota.try_acquire_all(self._physical_tables(raw)):
+                raise QueryRejectedError(
+                    f"table {raw!r} exceeded its query quota")
+
+    def _leaf_routes_groupby(self, ctx, physical: List[str]):
+        """Leaf dispatch units for a distributed single-table GROUP BY."""
+        boundary = self._time_boundary(physical)
+        routes: list = []
+        for table in physical:
+            self._route_leaf_table(table, ctx, boundary, routes)
+        return routes
+
+    def _post_leaf_task(self, url: str, path: str, task) -> Dict:
+        from .http_service import http_call
+        from .wire import decode_value, encode_value
+        resp = http_call("POST", f"{url}/{path}", encode_value(task),
+                         timeout=self.stage_timeout_s,
+                         content_type="application/octet-stream")
+        return decode_value(resp)
+
+    def _should_distribute_groupby(self, ctx, physical: List[str]) -> bool:
+        """Route a single-table aggregation through the partitioned mailbox
+        exchange (reference: PinotAggregateExchangeNodeInsertRule deciding to
+        insert an agg exchange). Triggers: an explicit
+        OPTION(useMultistageEngine/distributedGroupBy=true), or the cluster
+        config `broker.distributedGroupByDocThreshold` when the routed doc
+        count (a cheap proxy for key cardinality) exceeds it."""
+        if ctx.explain or ctx.gapfill is not None:
+            return False
+        group_exprs = ctx.group_by or (
+            [e for e, _ in ctx.select_items] if ctx.distinct else [])
+        if not group_exprs:
+            return False
+        opt = {str(k).lower(): v for k, v in (ctx.options or {}).items()}
+        if "distributedgroupby" in opt:
+            return _truthy(opt["distributedgroupby"])
+        if _truthy(opt.get("usemultistageengine")):
+            return True
+        thr = self.catalog.get_property(
+            "clusterConfig/broker.distributedGroupByDocThreshold")
+        if thr:
+            docs = sum(m.num_docs for t in physical
+                       for m in self.catalog.segments.get(t, {}).values())
+            return docs > int(thr)
+        return False
+
+    def _data_plane_cap(self) -> Optional[int]:
+        cap = self.max_data_plane_bytes
+        if cap is None:
+            prop = self.catalog.get_property(
+                "clusterConfig/broker.maxDataPlaneBytes")
+            cap = int(prop) if prop else None
+        return cap
+
     def _handle_multistage(self, stmt) -> ResultTable:
-        """Join query: multistage engine over a scatter-based leaf-scan provider."""
+        """Join query: peer-to-peer mailbox shuffle when every routed server
+        is HTTP-reachable (inter-stage data streams server->server and the
+        broker receives only final-stage partials); otherwise the in-proc
+        multistage engine over a scatter-based leaf-scan provider (the legacy
+        broker-funnel path, subject to the data-plane memory cap)."""
         from ..multistage import execute_multistage
         from ..sql.ast import Identifier
+
+        opt = {str(k).lower(): v for k, v in (stmt.options or {}).items()}
+        use_mailbox = ("usemailboxshuffle" not in opt
+                       or _truthy(opt["usemailboxshuffle"]))
+        if use_mailbox:
+            from ..multistage.shuffle import P2PUnavailable, coordinate_join
+            try:
+                return coordinate_join(self, stmt, self._num_partitions(stmt))
+            except P2PUnavailable:
+                # in-proc handles (tests) or mixed cluster: funnel path
+                from ..utils.metrics import get_registry
+                get_registry().counter("pinot_broker_p2p_fallbacks").inc()
 
         def schema_for(raw_table: str):
             phys = self._physical_tables(raw_table)
@@ -660,6 +840,23 @@ class Broker:
                     return run_join_stage(spec, lp, rp, agg)
             return run
 
+        # data-plane accounting for THIS query: the funnel path materializes
+        # every leaf row in broker memory, so meter it and enforce the cap
+        # (the mailbox path above never reaches this closure)
+        moved = {"bytes": 0}
+        cap = self._data_plane_cap()
+
+        def account(nbytes: int) -> None:
+            from ..utils.metrics import get_registry
+            moved["bytes"] += nbytes
+            get_registry().counter("pinot_broker_data_plane_bytes").inc(nbytes)
+            if cap is not None and moved["bytes"] > cap:
+                raise RuntimeError(
+                    f"broker data-plane memory cap exceeded "
+                    f"({moved['bytes']} > {cap} bytes buffered at the broker); "
+                    f"run servers with HTTP endpoints so the mailbox shuffle "
+                    f"streams inter-stage data server-to-server")
+
         def scan(raw_table: str, columns, filt):
             from ..sql.ast import _sql_ident, to_sql
             if not self.quota.try_acquire_all(self._physical_tables(raw_table)):
@@ -696,7 +893,9 @@ class Broker:
                 for fut in as_completed(futures):
                     server_id = futures[fut]
                     try:
-                        rows.extend(fut.result().rows)
+                        partial = fut.result()
+                        account(len(partial.rows) * max(1, len(columns)) * 16)
+                        rows.extend(partial.rows)
                     except Exception as e:
                         if _is_transport_failure(e):
                             self.routing.mark_server_unhealthy(server_id)
@@ -713,17 +912,8 @@ class Broker:
 
         # shuffle width is per-query tunable (reference: the v2 engine's
         # stage parallelism query options)
-        from ..multistage.runtime import DEFAULT_PARTITIONS
-        num_partitions = DEFAULT_PARTITIONS
-        for key, v in (stmt.options or {}).items():
-            if key.lower() in ("numpartitions", "stageparallelism"):
-                try:
-                    num_partitions = max(1, int(v))
-                except (TypeError, ValueError):
-                    raise QueryValidationError(
-                        f"OPTION({key}=...) must be an integer, got {v!r}") from None
         return execute_multistage(stmt, scan, schema_for,
-                                  num_partitions=num_partitions,
+                                  num_partitions=self._num_partitions(stmt),
                                   stage_runner=stage_runner())
 
     def _physical_tables(self, raw_table: str) -> List[str]:

@@ -29,6 +29,65 @@ from typing import Any, Callable, Dict, Optional, Tuple
 RouteHandler = Callable[[list, Dict[str, str], bytes], Tuple[int, str, bytes]]
 
 
+class _ChunkedReader:
+    """Incremental reader over a chunked transfer-encoded request body
+    (stdlib's BaseHTTPRequestHandler does not decode chunked requests; peers
+    stream mailbox frames as chunked POSTs)."""
+
+    def __init__(self, rfile):
+        self._rfile = rfile
+        self._remaining = 0   # unread bytes of the current chunk
+        self._done = False
+
+    def read(self, n: int) -> bytes:
+        if self._done:
+            return b""
+        if self._remaining == 0:
+            line = self._rfile.readline(128).strip()
+            try:
+                size = int(line.split(b";")[0], 16)
+            except ValueError:
+                raise ConnectionError(f"bad chunk size line {line!r}") from None
+            if size == 0:
+                # consume trailer section up to the blank line
+                while self._rfile.readline(1024).strip():
+                    pass
+                self._done = True
+                return b""
+            self._remaining = size
+        data = self._rfile.read(min(n, self._remaining))
+        self._remaining -= len(data)
+        if self._remaining == 0:
+            self._rfile.read(2)  # chunk-terminating CRLF
+        return data
+
+    def drain(self) -> None:
+        """Consume the rest of the body INCLUDING the terminating 0-chunk.
+        Responding while unread bytes sit in the receive buffer makes the
+        close send a TCP RST that races the 200 on the sender's side."""
+        while self.read(65536):
+            pass
+
+
+class _LengthReader:
+    """Incremental reader over a Content-Length request body."""
+
+    def __init__(self, rfile, length: int):
+        self._rfile = rfile
+        self._remaining = length
+
+    def read(self, n: int) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        data = self._rfile.read(min(n, self._remaining))
+        self._remaining -= len(data)
+        return data
+
+    def drain(self) -> None:
+        while self.read(65536):
+            pass
+
+
 def json_response(obj: Any, status: int = 200) -> Tuple[int, str, bytes]:
     return status, "application/json", json.dumps(obj).encode()
 
@@ -55,6 +114,7 @@ class HttpService:
                  access_control=None, ssl_context=None):
         self._routes: Dict[Tuple[str, str], RouteHandler] = {}
         self._actions: Dict[Tuple[str, str], str] = {}
+        self._stream_body: set = set()  # routes taking an incremental body reader
         self.access_control = access_control
         self.scheme = "https" if ssl_context is not None else "http"
         service = self
@@ -69,9 +129,26 @@ class HttpService:
                 parsed = urllib.parse.urlparse(self.path)
                 parts = [p for p in parsed.path.split("/") if p]
                 params = dict(urllib.parse.parse_qsl(parsed.query))
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 head = parts[0] if parts else ""
+                if (method, head) in service._stream_body:
+                    # streaming-body route: hand the handler an incremental
+                    # reader instead of buffering the body (mailbox frames
+                    # arrive as a chunked POST under backpressure — reading it
+                    # all here would be exactly the unbounded buffering the
+                    # mailbox design exists to prevent). The connection closes
+                    # after the response: the body may be only partially
+                    # consumed on error/cancel paths.
+                    self.close_connection = True
+                    if self.headers.get("Transfer-Encoding", ""
+                                        ).lower() == "chunked":
+                        body = _ChunkedReader(self.rfile)
+                    else:
+                        body = _LengthReader(
+                            self.rfile,
+                            int(self.headers.get("Content-Length") or 0))
+                else:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
                 handler = service._routes.get((method, head))
                 if handler is None:
                     status, ctype, data = error_response("not found", 404)
@@ -161,11 +238,15 @@ class HttpService:
         return f"{self.scheme}://{self.host}:{self.port}"
 
     def route(self, method: str, head: str, handler: RouteHandler,
-              action: str = "READ") -> None:
+              action: str = "READ", stream_body: bool = False) -> None:
         """Register a handler for `METHOD /head/...` (first path component match).
-        `action` is the permission access control demands (READ/WRITE/ADMIN)."""
+        `action` is the permission access control demands (READ/WRITE/ADMIN).
+        `stream_body=True` hands the handler an incremental `.read(n)` reader
+        instead of the buffered body (for peer mailbox streams)."""
         self._routes[(method, head)] = handler
         self._actions[(method, head)] = action
+        if stream_body:
+            self._stream_body.add((method, head))
 
     def _authenticate(self, method: str, head: str, headers) -> None:
         """Bearer-token auth + route-action authorization; publishes the

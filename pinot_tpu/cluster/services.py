@@ -665,6 +665,14 @@ class ServerService:
         self.http.route("POST", "query", self._query)
         self.http.route("POST", "explain", self._explain)
         self.http.route("POST", "stage", self._stage)
+        # peer-to-peer mailbox shuffle (reference: GrpcMailboxService +
+        # MailboxSend/ReceiveOperator; see multistage/shuffle.py)
+        self.http.route("POST", "mailbox", self._mailbox, stream_body=True)
+        self.http.route("DELETE", "mailbox", self._mailbox_cancel)
+        self.http.route("POST", "leafStage", self._leaf_stage)
+        self.http.route("POST", "leafAgg", self._leaf_agg)
+        self.http.route("POST", "joinStage", self._join_stage)
+        self.http.route("POST", "aggStage", self._agg_stage)
         self.http.route("GET", "health", self._health)
         self.http.route("GET", "segments", self._segments)
         self.http.route("GET", "segmentData", self._segment_data)
@@ -768,6 +776,108 @@ class ServerService:
                 yield frame({"kind": "partial",
                              "result": encode_segment_result(out)})
             yield frame({"kind": "end"})
+        return 200, "application/octet-stream", gen()
+
+    # -- peer-to-peer mailbox shuffle endpoints ------------------------------
+
+    def _mailbox(self, parts, params, body):
+        """POST /mailbox/{queryId}/{mailboxId} — a PEER streams partition
+        frames into this server's mailbox as a chunked request body. Frames
+        are enqueued into a BOUNDED per-mailbox queue; when the consuming
+        worker falls behind, the enqueue blocks, this thread stops reading the
+        socket, and TCP flow control backpressures the sender (reference: the
+        gRPC mailbox stream's flow-control window, mailbox.proto:43)."""
+        from ..multistage.shuffle import (REGISTRY, MailboxCancelled,
+                                          read_frame)
+        from .wire import decode_block, decode_segment_result
+        qid, mid = parts[0], parts[1]
+        from ..utils.metrics import get_registry
+        try:
+            box = REGISTRY.open(qid, mid)
+            while True:
+                d = read_frame(body)
+                if d["kind"] == "eos":
+                    box.put(("eos", d["sender"]))
+                    break
+                if d["kind"] == "block":
+                    box.put(("block", decode_block(d["block"])))
+                else:
+                    box.put(("partial", decode_segment_result(d["result"])))
+                get_registry().counter("pinot_server_mailbox_frames").inc()
+        except MailboxCancelled:
+            return error_response("query cancelled", 409)
+        # drain the chunked-body terminator BEFORE responding: closing the
+        # socket with unread bytes in the receive buffer sends a TCP RST that
+        # races the 200 on the sender's side (flaky "connection reset")
+        body.drain()
+        return json_response({"ok": True})
+
+    def _mailbox_cancel(self, parts, params, body):
+        """DELETE /mailbox/{queryId} — cancel every mailbox of a query: wakes
+        blocked senders and consumers so a failed query unwinds instead of
+        hanging on backpressure."""
+        from ..multistage.shuffle import REGISTRY
+        REGISTRY.cancel_query(parts[0])
+        return json_response({"ok": True})
+
+    def _leaf_stage(self, parts, params, body):
+        """POST /leafStage — scan local segments, hash-partition on the join
+        keys, stream partition frames DIRECTLY to the stage workers' mailboxes
+        (the MailboxSendOperator on top of the v1 leaf executor). The broker
+        never sees these rows."""
+        from ..auth import require_table_access
+        from ..multistage.shuffle import run_leaf_join_task
+        from .wire import decode_value, encode_value
+        task = decode_value(body)
+        require_table_access(task["table"], "READ")
+        return binary_response(encode_value(run_leaf_join_task(
+            self.server, task)))
+
+    def _leaf_agg(self, parts, params, body):
+        """POST /leafAgg — distributed single-table GROUP BY leaf: partial
+        aggregation locally, group partials hash-partitioned by key and
+        streamed to the merge workers."""
+        from ..auth import require_table_access
+        from ..multistage.shuffle import run_leaf_agg_task
+        from .wire import decode_value, encode_value
+        task = decode_value(body)
+        require_table_access(task["table"], "READ")
+        return binary_response(encode_value(run_leaf_agg_task(
+            self.server, task)))
+
+    def _join_stage(self, parts, params, body):
+        """POST /joinStage — one join-stage partition: consume both side
+        mailboxes, join, and either forward to the next stage's mailboxes or
+        stream final partial frames back. Errors surface as a terminal error
+        frame so the broker reports the cause instead of a truncated stream."""
+        from ..multistage.shuffle import frame_bytes, run_join_stage_task
+        from ..utils.metrics import get_registry
+        from .wire import decode_value
+        task = decode_value(body)
+        get_registry().counter("pinot_server_join_stages").inc()
+
+        def gen():
+            try:
+                yield from run_join_stage_task(task)
+            except Exception as e:
+                yield frame_bytes({"kind": "error",
+                                   "message": f"{type(e).__name__}: {e}"})
+        return 200, "application/octet-stream", gen()
+
+    def _agg_stage(self, parts, params, body):
+        """POST /aggStage — one merge partition of a distributed GROUP BY:
+        merge this disjoint key range, apply HAVING + top-k trim, stream the
+        merged partial back."""
+        from ..multistage.shuffle import frame_bytes, run_agg_stage_task
+        from .wire import decode_value
+        task = decode_value(body)
+
+        def gen():
+            try:
+                yield from run_agg_stage_task(task)
+            except Exception as e:
+                yield frame_bytes({"kind": "error",
+                                   "message": f"{type(e).__name__}: {e}"})
         return 200, "application/octet-stream", gen()
 
     def _segments(self, parts, params, body):
@@ -928,7 +1038,8 @@ class BrokerService:
             self.broker.register_server_handle(info.instance_id, handle,
                                                explain_handle=handle.explain,
                                                probe=probe,
-                                               stage_handle=handle.join_stage)
+                                               stage_handle=handle.join_stage,
+                                               url=url)
 
     def _query(self, parts, params, body):
         d = json.loads(body.decode())

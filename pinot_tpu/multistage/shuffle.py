@@ -1,0 +1,887 @@
+"""Server↔server streaming mailbox shuffle: the distributed multistage data plane.
+
+Analog of the reference's mailbox exchange (`pinot-query-runtime/.../runtime/
+operator/MailboxSendOperator.java`, `MailboxReceiveOperator.java` over
+`pinot-query-planner/.../mailbox/GrpcMailboxService.java`,
+`pinot-common/src/main/proto/mailbox.proto:43`): leaf stages hash-partition
+their scan output ON THE SERVERS and stream partition frames DIRECTLY to the
+assigned intermediate-stage worker's mailbox — the broker plans, assigns
+workers, and receives only final-stage partials. Data never funnels through
+broker memory, so a join (or a high-cardinality GROUP BY) whose intermediate
+data exceeds broker RAM still executes.
+
+Transport: chunked HTTP both ways (`POST /mailbox/{query}/{mailbox}` with a
+chunked request body of length-prefixed wire frames). Buffering is bounded on
+the receiving side by a fixed-size frame queue per mailbox; when a worker
+falls behind, the receiving handler thread blocks on the full queue, TCP flow
+control pushes back to the sender's socket, and the sender's partitioner
+stalls — end-to-end backpressure with ~WINDOW_FRAMES×FRAME_ROWS rows in
+flight per mailbox (the reference bounds the same way via gRPC flow control
+on the mailbox stream).
+
+Failure: any leaf or worker error cancels the query's mailboxes everywhere
+(DELETE /mailbox/{query}), which wakes blocked senders/consumers; the broker
+surfaces one clean error instead of hanging (reference: the v2 engine fails
+the query when a stage worker dies).
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import time
+import urllib.request
+import uuid
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..constants import UNBOUNDED_LIMIT
+from ..query.aggregates import make_agg
+from ..query.reduce import (SegmentResult, _eval_result, _object_array,
+                            _sort_key, merge_segment_results)
+from ..sql.ast import Expr, Function, OrderByItem, to_sql
+from .planner import JoinSpec
+from .runtime import (Block, _block_rows, _concat_blocks, _null_safe_mask,
+                      _take, aggregate_block, hash_join, selection_block,
+                      spec_from_json, spec_to_json)
+
+# rows per streamed block frame and frames buffered per mailbox: together they
+# bound each mailbox's in-flight memory (≈ WINDOW_FRAMES * FRAME_ROWS rows)
+FRAME_ROWS = 32768
+WINDOW_FRAMES = 8
+# group-key partials per streamed partial frame (leaf agg exchange)
+FRAME_GROUPS = 8192
+# how long a consumer waits for the next frame before declaring the sender dead
+MAILBOX_TIMEOUT_S = 120.0
+# cancelled-query tombstone + idle-mailbox TTL
+MAILBOX_TTL_S = 600.0
+
+
+class MailboxCancelled(Exception):
+    """The query owning this mailbox was cancelled (worker died / broker gave up)."""
+
+
+class P2PUnavailable(Exception):
+    """The peer-to-peer shuffle cannot run (a routed server has no HTTP
+    endpoint); callers fall back to the broker-funnel path."""
+
+
+# ---------------------------------------------------------------------------
+# stable cross-process hashing (partition routing)
+# ---------------------------------------------------------------------------
+# Python's builtin hash() is randomized per process (PYTHONHASHSEED), so two
+# leaf servers would route the same key to DIFFERENT partitions. Everything on
+# the wire uses this deterministic hash instead.
+
+_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
+_MULT = np.uint64(1000003)
+
+
+def _stable_obj_hash(v: Any) -> int:
+    if v is None:
+        return int(_NULL_HASH)
+    if isinstance(v, str):
+        return zlib.crc32(v.encode("utf-8"))
+    if isinstance(v, (bytes, bytearray)):
+        return zlib.crc32(bytes(v))
+    if isinstance(v, (bool, np.bool_)):
+        return int(v)
+    if isinstance(v, (int, np.integer, float, np.floating)):
+        f = float(v)
+        if f != f:  # NaN
+            return int(_NULL_HASH)
+        if f == 0.0:
+            f = 0.0  # collapse -0.0
+        return int(np.float64(f).view(np.uint64))
+    # MV cells (lists) and anything exotic: hash the repr deterministically
+    return zlib.crc32(repr(v).encode("utf-8"))
+
+
+def stable_hash_codes(block: Block, keys: Iterable[str]) -> np.ndarray:
+    """Per-row uint64 hash over key columns, identical in every process."""
+    n = _block_rows(block)
+    h = np.zeros(n, dtype=np.uint64)
+    for k in keys:
+        arr = block[k]
+        if arr.dtype == object:
+            col = np.fromiter((_stable_obj_hash(x) for x in arr),
+                              dtype=np.uint64, count=n)
+        else:
+            f = np.nan_to_num(arr.astype(np.float64), nan=0.0)
+            f = np.where(f == 0.0, 0.0, f)
+            col = f.view(np.uint64)
+        h = h * _MULT ^ col
+    return h
+
+
+def stable_hash_key(key: Tuple) -> int:
+    h = np.uint64(0)
+    for v in key:
+        h = h * _MULT ^ np.uint64(_stable_obj_hash(v) & 0xFFFFFFFFFFFFFFFF)
+    return int(h)
+
+
+def partition_block_stable(block: Block, keys: List[str], p: int) -> List[Block]:
+    if _block_rows(block) == 0:
+        return [block for _ in range(p)]
+    pid = (stable_hash_codes(block, keys) % np.uint64(p)).astype(np.int64)
+    return [_take(block, np.nonzero(pid == i)[0]) for i in range(p)]
+
+
+def partition_groups_stable(result: SegmentResult, p: int) -> List[SegmentResult]:
+    """Split a group-by partial's key space into p disjoint partials."""
+    outs = [SegmentResult("groups") for _ in range(p)]
+    for key, states in result.groups.items():
+        outs[stable_hash_key(key) % p].groups[key] = states
+    # attribute the scan count once (partition 0) so merged counts stay exact
+    if outs:
+        outs[0].num_docs_scanned = result.num_docs_scanned
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# frame codec (length-prefixed wire values)
+# ---------------------------------------------------------------------------
+
+def frame_bytes(obj: Any) -> bytes:
+    from ..cluster.wire import encode_value
+    payload = encode_value(obj)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def read_exact(reader, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = reader.read(remaining)
+        if not chunk:
+            raise ConnectionError("mailbox stream truncated")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(reader) -> Any:
+    from ..cluster.wire import decode_value
+    (n,) = struct.unpack(">I", read_exact(reader, 4))
+    return decode_value(read_exact(reader, n))
+
+
+def block_frames(block: Block, step: int = FRAME_ROWS) -> Iterator[dict]:
+    n = _block_rows(block)
+    if n == 0:
+        # an empty partition still ships ONE empty frame: the receiving join
+        # needs the column names/dtypes even when no rows hashed its way
+        yield {"kind": "block", "block": dict(block)}
+        return
+    for lo in range(0, n, step):
+        yield {"kind": "block",
+               "block": {c: v[lo:lo + step] for c, v in block.items()}}
+
+
+def partial_frames(result: SegmentResult, step: int = FRAME_GROUPS
+                   ) -> Iterator[dict]:
+    """A group/scalar/selection partial as one or more mergeable partial frames."""
+    from ..cluster.wire import encode_segment_result
+    if result.kind == "groups" and len(result.groups) > step:
+        keys = list(result.groups.keys())
+        for lo in range(0, len(keys), step):
+            chunk = SegmentResult("groups")
+            if lo == 0:
+                chunk.num_docs_scanned = result.num_docs_scanned
+            for k in keys[lo:lo + step]:
+                chunk.groups[k] = result.groups[k]
+            yield {"kind": "partial", "result": encode_segment_result(chunk)}
+    elif result.kind == "selection" and len(result.rows) > FRAME_ROWS:
+        for lo in range(0, len(result.rows), FRAME_ROWS):
+            chunk = SegmentResult("selection")
+            if lo == 0:
+                chunk.num_docs_scanned = result.num_docs_scanned
+            chunk.rows = result.rows[lo:lo + FRAME_ROWS]
+            if result.sort_keys:
+                chunk.sort_keys = result.sort_keys[lo:lo + FRAME_ROWS]
+            yield {"kind": "partial", "result": encode_segment_result(chunk)}
+    else:
+        yield {"kind": "partial", "result": encode_segment_result(result)}
+
+
+# ---------------------------------------------------------------------------
+# mailbox registry (one per process; receivers push, workers pop)
+# ---------------------------------------------------------------------------
+
+class _Mailbox:
+    def __init__(self, window: int = WINDOW_FRAMES):
+        self.q: "queue.Queue" = queue.Queue(maxsize=window)
+        self.cancelled = threading.Event()
+        self.created = time.time()
+        self.last_active = self.created
+
+    def put(self, item, timeout_s: float = MAILBOX_TIMEOUT_S) -> None:
+        deadline = time.time() + timeout_s
+        while True:
+            if self.cancelled.is_set():
+                raise MailboxCancelled("mailbox cancelled")
+            try:
+                self.q.put(item, timeout=0.2)
+                self.last_active = time.time()
+                return
+            except queue.Full:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "mailbox backpressure timeout: consumer stalled")
+
+    def get(self, timeout_s: float = MAILBOX_TIMEOUT_S):
+        deadline = time.time() + timeout_s
+        while True:
+            if self.cancelled.is_set():
+                raise MailboxCancelled("mailbox cancelled")
+            try:
+                item = self.q.get(timeout=0.2)
+                self.last_active = time.time()
+                return item
+            except queue.Empty:
+                if time.time() > deadline:
+                    raise TimeoutError("mailbox receive timeout: sender stalled")
+
+
+class MailboxRegistry:
+    """Per-process mailbox fabric keyed (query, mailbox-id); auto-creates on
+    first touch, tombstones cancelled queries, TTL-sweeps leaked boxes."""
+
+    def __init__(self):
+        self._boxes: Dict[Tuple[str, str], _Mailbox] = {}
+        self._cancelled: Dict[str, float] = {}  # query -> cancel time
+        self._lock = threading.Lock()
+
+    def open(self, qid: str, mid: str) -> _Mailbox:
+        with self._lock:
+            self._gc_locked()
+            if qid in self._cancelled:
+                raise MailboxCancelled(f"query {qid} cancelled")
+            box = self._boxes.get((qid, mid))
+            if box is None:
+                box = self._boxes[(qid, mid)] = _Mailbox()
+            return box
+
+    def cancel_query(self, qid: str) -> None:
+        with self._lock:
+            self._cancelled[qid] = time.time()
+            for (q, _m), box in self._boxes.items():
+                if q == qid:
+                    box.cancelled.set()
+
+    def close_query(self, qid: str) -> None:
+        """Normal end-of-query cleanup: drop the boxes, no tombstone."""
+        with self._lock:
+            for key in [k for k in self._boxes if k[0] == qid]:
+                self._boxes.pop(key)
+
+    def discard(self, qid: str, mid: str) -> None:
+        with self._lock:
+            self._boxes.pop((qid, mid), None)
+
+    def _gc_locked(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for q, t in list(self._cancelled.items()):
+            if now - t > MAILBOX_TTL_S:
+                del self._cancelled[q]
+        for key, box in list(self._boxes.items()):
+            # IDLE time, not age: a healthy long-running query with frames
+            # still flowing must never be reaped mid-flight
+            if now - box.last_active > MAILBOX_TTL_S:
+                box.cancelled.set()
+                del self._boxes[key]
+
+
+REGISTRY = MailboxRegistry()
+
+
+def consume_mailbox(qid: str, mid: str, expected_senders: int,
+                    timeout_s: float = MAILBOX_TIMEOUT_S
+                    ) -> Tuple[List[Block], List[SegmentResult]]:
+    """Pop frames until every expected sender's EOS arrives."""
+    box = REGISTRY.open(qid, mid)
+    eos: set = set()
+    blocks: List[Block] = []
+    partials: List[SegmentResult] = []
+    try:
+        while len(eos) < expected_senders:
+            kind, payload = box.get(timeout_s)
+            if kind == "eos":
+                eos.add(payload)
+            elif kind == "block":
+                blocks.append(payload)
+            else:
+                partials.append(payload)
+    except BaseException:
+        # the consumer is giving up: cancel the box IN PLACE (senders holding
+        # a reference to it wake immediately) and leave it registered so a
+        # later DELETE /mailbox cancellation still reaches it — discarding it
+        # here would strand blocked senders on a box no cancel can flag
+        box.cancelled.set()
+        raise
+    REGISTRY.discard(qid, mid)
+    return blocks, partials
+
+
+# ---------------------------------------------------------------------------
+# sender (chunked POST of frames to a peer's mailbox endpoint)
+# ---------------------------------------------------------------------------
+
+def send_to_mailbox(url: str, qid: str, mid: str, frames: Iterable[dict],
+                    sender_id: str, timeout_s: float = MAILBOX_TIMEOUT_S,
+                    token: Optional[str] = None) -> None:
+    from ..cluster.http_service import (_DEFAULT_TOKEN, HttpError,
+                                        client_ssl_context)
+
+    def gen():
+        for fr in frames:
+            yield frame_bytes(fr)
+        yield frame_bytes({"kind": "eos", "sender": sender_id})
+
+    headers = {"Content-Type": "application/octet-stream"}
+    bearer = token if token is not None else _DEFAULT_TOKEN
+    if bearer:
+        headers["Authorization"] = f"Bearer {bearer}"
+    req = urllib.request.Request(f"{url}/mailbox/{qid}/{mid}", data=gen(),
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s,
+                                    context=client_ssl_context()) as resp:
+            resp.read()
+    except urllib.error.HTTPError as e:
+        raise HttpError(e.code, e.read().decode(errors="replace")) from None
+
+
+def _send_partitions(targets: List[str], qid: str, stage: str, side: str,
+                     parts_frames: List[Iterable[dict]], sender_id: str,
+                     timeout_s: float = MAILBOX_TIMEOUT_S) -> None:
+    """Stream every partition's frames to its worker, a few in parallel.
+    EVERY partition sends (empty ones send just EOS) — the worker counts EOS
+    from every expected sender before joining."""
+    from concurrent.futures import ThreadPoolExecutor
+    p = len(targets)
+
+    def one(i: int) -> None:
+        send_to_mailbox(targets[i], qid, f"{stage}.{side}.{i}", parts_frames[i],
+                        sender_id, timeout_s)
+
+    if p == 1:
+        one(0)
+        return
+    with ThreadPoolExecutor(max_workers=min(4, p),
+                            thread_name_prefix="mailbox-send") as pool:
+        futs = [pool.submit(one, i) for i in range(p)]
+        errs = [f.exception() for f in futs]
+    for e in errs:
+        if e is not None:
+            raise e
+
+
+# ---------------------------------------------------------------------------
+# stage context (the final-stage plan shipped to workers, SQL as the wire IR)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StageCtx:
+    """Duck-types the QueryContext fields the stage operators read
+    (aggregate_block / selection_block / trim_group_result)."""
+
+    select_items: List[Tuple[Expr, Optional[str]]]
+    group_by: List[Expr]
+    aggregations: List[Function]
+    distinct: bool = False
+    having: Optional[Expr] = None
+    order_by: List[OrderByItem] = field(default_factory=list)
+    limit: int = UNBOUNDED_LIMIT
+    offset: int = 0
+
+    @property
+    def is_aggregation_query(self) -> bool:
+        return bool(self.aggregations) or bool(self.group_by)
+
+
+def stage_ctx_to_json(ctx) -> Dict[str, Any]:
+    return {
+        "selectItems": [to_sql(e) for e, _ in ctx.select_items],
+        "groupBy": [to_sql(e) for e in ctx.group_by],
+        "aggs": [to_sql(f) for f in ctx.aggregations],
+        "distinct": bool(ctx.distinct),
+        "having": to_sql(ctx.having) if ctx.having is not None else None,
+        "orderBy": [{"e": to_sql(o.expr), "d": o.desc, "nl": o.nulls_last}
+                    for o in ctx.order_by],
+        "limit": int(ctx.limit if ctx.limit is not None else UNBOUNDED_LIMIT),
+        "offset": int(ctx.offset or 0),
+    }
+
+
+def _parse_expr(txt: str) -> Expr:
+    from ..sql.parser import parse_query
+    return parse_query(f"SELECT {txt} FROM __t").select[0][0]
+
+
+def stage_ctx_from_json(d: Dict[str, Any]) -> StageCtx:
+    return StageCtx(
+        select_items=[(_parse_expr(t), None) for t in d["selectItems"]],
+        group_by=[_parse_expr(t) for t in d["groupBy"]],
+        aggregations=[_parse_expr(t) for t in d["aggs"]],
+        distinct=bool(d["distinct"]),
+        having=_parse_expr(d["having"]) if d.get("having") else None,
+        order_by=[OrderByItem(_parse_expr(o["e"]), o["d"], o.get("nl"))
+                  for o in d.get("orderBy", [])],
+        limit=int(d.get("limit", UNBOUNDED_LIMIT)),
+        offset=int(d.get("offset", 0)),
+    )
+
+
+def trim_group_result(ctx, merged: SegmentResult, aggs) -> SegmentResult:
+    """Worker-side distributed trim: apply HAVING (group-local, so safe on a
+    disjoint key range) and keep only the top-(limit+offset) groups by the
+    final ordering — the global top-k is a subset of the union of per-worker
+    top-k because key ranges are disjoint (reference: the v2 engine's
+    intermediate GroupByOperator trim / server-side minGroupTrimSize)."""
+    if merged.kind != "groups":
+        return merged
+    limit = ctx.limit if ctx.limit is not None else UNBOUNDED_LIMIT
+    k = min(limit + (ctx.offset or 0), UNBOUNDED_LIMIT)
+    needs_having = ctx.having is not None
+    needs_trim = k < UNBOUNDED_LIMIT and len(merged.groups) > k
+    if not needs_having and not needs_trim:
+        return merged
+    group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                   else list(ctx.group_by))
+    keys = list(merged.groups.keys())
+    n = len(keys)
+    env: Dict[str, np.ndarray] = {}
+    for j, g in enumerate(group_exprs):
+        env[repr(g)] = np.array([key[j] for key in keys], dtype=object)
+    for i, call in enumerate(ctx.aggregations):
+        env[repr(call)] = _object_array(
+            [aggs[i].finalize(merged.groups[key][i]) for key in keys])
+    idx = np.arange(n)
+    if needs_having:
+        keep = np.asarray(_eval_result(ctx.having, env, n), dtype=bool)
+        idx = idx[keep]
+    if k < UNBOUNDED_LIMIT and len(idx) > k:
+        if ctx.order_by:
+            cols = [np.asarray(_eval_result(o.expr, env, n), dtype=object)
+                    for o in ctx.order_by]
+            idx = sorted(idx, key=lambda i: _sort_key(
+                [c[i] for c in cols], ctx.order_by))[:k]
+        else:
+            idx = idx[:k]
+    out = SegmentResult("groups", num_docs_scanned=merged.num_docs_scanned)
+    for i in idx:
+        out.groups[keys[i]] = merged.groups[keys[i]]
+    return out
+
+
+def _trim_selection(ctx, result: SegmentResult) -> SegmentResult:
+    """Per-worker selection trim to limit+offset rows (sorted when ordered)."""
+    limit = ctx.limit if ctx.limit is not None else UNBOUNDED_LIMIT
+    k = limit + (ctx.offset or 0)
+    if k >= UNBOUNDED_LIMIT or len(result.rows) <= k:
+        return result
+    if result.sort_keys:
+        order = sorted(range(len(result.rows)),
+                       key=lambda i: _sort_key(list(result.sort_keys[i]),
+                                               ctx.order_by))[:k]
+        out = SegmentResult("selection", num_docs_scanned=result.num_docs_scanned)
+        out.rows = [result.rows[i] for i in order]
+        out.sort_keys = [result.sort_keys[i] for i in order]
+        return out
+    out = SegmentResult("selection", num_docs_scanned=result.num_docs_scanned)
+    out.rows = result.rows[:k]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# server-side task runners (invoked by ServerService routes)
+# ---------------------------------------------------------------------------
+
+def _check_leaf_coverage(task: Dict[str, Any], res: SegmentResult) -> None:
+    """A replica mid-segment-transition can silently skip a routed segment;
+    the single-stage scatter retries it on another replica, but a P2P leaf
+    has already streamed its partition frames — the only sound recovery is to
+    FAIL the query loudly (the client retries a fresh one) rather than return
+    a silently-short distributed result."""
+    if res.served is None:
+        return
+    missing = set(task["segments"]) - set(res.served)
+    if missing:
+        raise RuntimeError(
+            f"leaf scan did not cover routed segments {sorted(missing)} "
+            f"(segment transition in flight) — retry the query")
+
+
+def run_leaf_join_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
+    """Scan this server's segments, hash-partition on the join keys, stream
+    partition frames to the assigned stage workers (reference: a leaf stage's
+    MailboxSendOperator on top of the v1 leaf executor)."""
+    qid = task["queryId"]
+    alias = task["alias"]
+    columns = list(task["columns"])
+    res = server.execute_partial(task["table"], task["sql"], task["segments"],
+                                 time_filter=task.get("timeFilter"))
+    _check_leaf_coverage(task, res)
+    schema = server.catalog.schema_for_table(task["table"])
+    n = len(res.rows)
+    block: Block = {}
+    for j, c in enumerate(columns):
+        vals = [r[j] for r in res.rows]
+        dt = schema.field_spec(c).data_type
+        block[f"{alias}.{c}"] = (
+            np.asarray(vals, dtype=dt.numpy_dtype) if dt.is_numeric
+            else np.asarray(vals, dtype=object))
+    parts = partition_block_stable(block, list(task["keys"]),
+                                   int(task["numPartitions"]))
+    _send_partitions(list(task["targets"]), qid, task["stage"], task["side"],
+                     [block_frames(p) for p in parts], task["senderId"])
+    return {"rows": n}
+
+
+def run_leaf_agg_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
+    """Single-table distributed GROUP BY leaf: run the normal per-server
+    partial aggregation, hash-partition the GROUPS by key, stream partial
+    frames to the merge workers (reference: the agg exchange inserted by
+    PinotAggregateExchangeNodeInsertRule — servers emit partitioned partials)."""
+    qid = task["queryId"]
+    res = server.execute_partial(task["table"], task["sql"], task["segments"],
+                                 time_filter=task.get("timeFilter"))
+    _check_leaf_coverage(task, res)
+    if res.kind != "groups":
+        raise ValueError(f"leaf agg task expects a group-by, got {res.kind}")
+    parts = partition_groups_stable(res, int(task["numPartitions"]))
+    _send_partitions(list(task["targets"]), qid, task["stage"], "A",
+                     [partial_frames(p) for p in parts], task["senderId"])
+    return {"groups": len(res.groups)}
+
+
+def run_join_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
+    """One join-stage partition on a worker server: consume both side
+    mailboxes, hash-join, then either (a) forward re-partitioned output to the
+    next stage's mailboxes, or (b) run the final stage (post-filter +
+    aggregation/selection trim) and stream partial frames back in the HTTP
+    response. Yields response frames."""
+    qid = task["queryId"]
+    stage = task["stage"]
+    p = int(task["partition"])
+    spec = spec_from_json(task["spec"])
+    lblocks, _ = consume_mailbox(qid, f"{stage}.L.{p}",
+                                 int(task["numLeftSenders"]))
+    rblocks, _ = consume_mailbox(qid, f"{stage}.R.{p}",
+                                 int(task["numRightSenders"]))
+    out = hash_join(_concat_blocks(lblocks), _concat_blocks(rblocks), spec)
+
+    down = task["downstream"]
+    if down["kind"] == "mailbox":
+        parts = partition_block_stable(out, list(down["keys"]),
+                                       len(down["targets"]))
+        _send_partitions(list(down["targets"]), qid, down["stage"],
+                         down.get("side", "L"),
+                         [block_frames(b) for b in parts], down["senderId"])
+        yield frame_bytes({"kind": "ack", "rows": _block_rows(out)})
+        yield frame_bytes({"kind": "end"})
+        return
+
+    # final stage: post-filter (row-local, safe pre-aggregation), then
+    # aggregate or select + per-partition trim
+    ctx = stage_ctx_from_json(task["finalCtx"])
+    if task.get("postFilter") and _block_rows(out):
+        mask = _null_safe_mask(_parse_expr(task["postFilter"]), out)
+        out = _take(out, np.nonzero(np.asarray(mask, dtype=bool))[0])
+    if ctx.is_aggregation_query or ctx.distinct:
+        aggs = [make_agg(f) for f in ctx.aggregations]
+        partial = aggregate_block(ctx, aggs, out)
+        # keys are co-partitioned by the join keys, NOT the group keys, so
+        # group key ranges are NOT disjoint across partitions -> HAVING/top-k
+        # trim here would be unsound; ship full partials (they are mergeable)
+    else:
+        partial = _trim_selection(ctx, selection_block(ctx, out))
+    for fr in partial_frames(partial):
+        yield frame_bytes(fr)
+    yield frame_bytes({"kind": "end"})
+
+
+def run_agg_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
+    """One merge partition of a distributed single-table GROUP BY: consume the
+    partitioned partials, merge this key range, apply HAVING + top-k trim
+    (keys ARE disjoint across partitions here), stream the merged partial
+    back. Yields response frames."""
+    qid = task["queryId"]
+    stage = task["stage"]
+    p = int(task["partition"])
+    ctx = stage_ctx_from_json(task["finalCtx"])
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    _, partials = consume_mailbox(qid, f"{stage}.A.{p}",
+                                  int(task["numSenders"]))
+    merged = merge_segment_results(partials, aggs) if partials else \
+        SegmentResult("groups")
+    merged = trim_group_result(ctx, merged, aggs)
+    for fr in partial_frames(merged):
+        yield frame_bytes(fr)
+    yield frame_bytes({"kind": "end"})
+
+
+# ---------------------------------------------------------------------------
+# broker-side coordinator
+# ---------------------------------------------------------------------------
+
+def _post_stage_task(url: str, path: str, task: Dict[str, Any],
+                     timeout_s: float) -> List[SegmentResult]:
+    """Dispatch a worker task and consume its streamed response frames."""
+    from ..cluster.http_service import (_DEFAULT_TOKEN, HttpError,
+                                        client_ssl_context)
+    from ..cluster.wire import decode_segment_result, encode_value
+    body = encode_value(task)
+    headers = {"Content-Type": "application/octet-stream"}
+    if _DEFAULT_TOKEN:
+        headers["Authorization"] = f"Bearer {_DEFAULT_TOKEN}"
+    req = urllib.request.Request(f"{url}/{path}", data=body, headers=headers)
+    partials: List[SegmentResult] = []
+    try:
+        resp_cm = urllib.request.urlopen(req, timeout=timeout_s,
+                                         context=client_ssl_context())
+    except urllib.error.HTTPError as e:
+        raise HttpError(e.code, e.read().decode(errors="replace")) from None
+    with resp_cm as resp:
+        while True:
+            d = read_frame(resp)
+            if d["kind"] == "end":
+                break
+            if d["kind"] == "error":
+                # worker-computed failure (mailbox timeout, cancelled peer,
+                # bad plan): a QUERY error from a live server, not transport
+                raise RuntimeError(f"stage worker failed: {d['message']}")
+            if d["kind"] == "partial":
+                partials.append(decode_segment_result(d["result"]))
+            # "ack" frames carry no data
+    return partials
+
+
+def cancel_query_mailboxes(urls: Iterable[str], qid: str) -> None:
+    from ..cluster.http_service import http_call
+    for url in set(urls):
+        try:
+            http_call("DELETE", f"{url}/mailbox/{qid}", timeout=5.0)
+        except Exception:
+            pass  # best-effort: TTL GC is the backstop
+
+
+@dataclass
+class LeafRoute:
+    """One leaf dispatch unit: (server, table, segments, time-filter)."""
+    server_id: str
+    url: str
+    table: str
+    segments: List[str]
+    time_filter: Optional[str]
+
+
+def coordinate_join(broker, stmt, num_partitions: int):
+    """P2P multistage execution of a join query. The broker plans, routes leaf
+    scans, assigns P workers per stage, dispatches everything, and receives
+    ONLY final-stage partials (reference: QueryDispatcher.submitAndReduce —
+    the broker-side reduce sees just the last exchange)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..multistage.planner import plan_multistage
+    from ..query.reduce import reduce_to_result
+    from ..sql.ast import _sql_ident
+
+    plan = plan_multistage(stmt, lambda t: (
+        broker.catalog.schema_for_table(broker._physical_tables(t)[0])
+        if broker._physical_tables(t) else None))
+    ctx = plan.ctx
+    qid = f"q{uuid.uuid4().hex[:16]}"
+    P = num_partitions
+
+    # workers first (cheap check): an in-proc cluster with no HTTP endpoints
+    # falls back here before any quota is consumed
+    workers = broker._stage_workers(P)
+
+    # -- leaf routing (every routed server must have an HTTP endpoint) ------
+    leaf_routes: Dict[str, List[LeafRoute]] = {}
+    for alias, scan in plan.scans.items():
+        leaf_routes[alias] = broker._leaf_routes(scan.table, scan.columns,
+                                                 scan.filter)
+    # quota only after EVERY alias routed: a P2PUnavailable fallback to the
+    # funnel path must not have charged any table's QPS budget yet
+    broker._acquire_scan_quota([s.table for s in plan.scans.values()])
+
+    # -- build the task graph ----------------------------------------------
+    leaf_tasks: List[Tuple[str, Dict[str, Any]]] = []  # (url, task)
+
+    def leaf_sql(scan) -> str:
+        sql = (f"SELECT {', '.join(_sql_ident(c) for c in scan.columns)} "
+               f"FROM {_sql_ident(scan.table)}")
+        if scan.filter is not None:
+            sql += f" WHERE {to_sql(scan.filter)}"
+        return sql + f" LIMIT {UNBOUNDED_LIMIT}"
+
+    def add_leaf_tasks(alias: str, stage: str, side: str, keys: List[str]
+                       ) -> int:
+        scan = plan.scans[alias]
+        routes = leaf_routes[alias]
+        sql = leaf_sql(scan)
+        for i, r in enumerate(routes):
+            leaf_tasks.append((r.url, {
+                "queryId": qid, "table": r.table, "sql": sql,
+                "segments": r.segments, "timeFilter": r.time_filter,
+                "alias": alias, "columns": scan.columns, "keys": keys,
+                "numPartitions": P, "stage": stage, "side": side,
+                "targets": [w[1] for w in workers],
+                "senderId": f"leaf.{alias}.{i}"}))
+        return len(routes)
+
+    worker_tasks: List[Tuple[str, str, Dict[str, Any]]] = []  # (url, path, task)
+    n_left = add_leaf_tasks(plan.base_alias, "join0", "L",
+                            plan.joins[0].left_keys)
+    for si, spec in enumerate(plan.joins):
+        stage = f"join{si}"
+        n_right = add_leaf_tasks(spec.right_alias, stage, "R", spec.right_keys)
+        last = si == len(plan.joins) - 1
+        for p in range(P):
+            task: Dict[str, Any] = {
+                "queryId": qid, "stage": stage, "partition": p,
+                "spec": spec_to_json(spec),
+                "numLeftSenders": n_left, "numRightSenders": n_right,
+            }
+            if last:
+                task["downstream"] = {"kind": "response"}
+                task["finalCtx"] = stage_ctx_to_json(ctx)
+                task["postFilter"] = (to_sql(plan.post_filter)
+                                      if plan.post_filter is not None else None)
+            else:
+                nxt = plan.joins[si + 1]
+                task["downstream"] = {
+                    "kind": "mailbox", "keys": nxt.left_keys,
+                    "stage": f"join{si + 1}", "side": "L",
+                    "targets": [w[1] for w in workers],
+                    "senderId": f"{stage}.w{p}"}
+            worker_tasks.append((workers[p][1], "joinStage", task))
+        n_left = P  # next stage's left side is fed by this stage's P workers
+
+    all_urls = ({r.url for routes in leaf_routes.values() for r in routes}
+                | {w[1] for w in workers})
+
+    # dedicated per-query pool: worker dispatches BLOCK until their mailboxes
+    # drain, so sharing the broker's bounded scatter pool could deadlock
+    # (workers queued behind the leaf dispatches that feed them)
+    n_tasks = len(worker_tasks) + len(leaf_tasks)
+    partials: List[SegmentResult] = []
+    pool = ThreadPoolExecutor(max_workers=n_tasks,
+                              thread_name_prefix="p2p-stage")
+    try:
+        from concurrent.futures import as_completed
+        # one futures map consumed in COMPLETION order: the first failure —
+        # leaf or worker, whichever lands first — triggers the cancel below
+        # immediately instead of waiting behind unrelated futures
+        futs = {}
+        for url, path, task in worker_tasks:
+            futs[pool.submit(_post_stage_task, url, path, task,
+                             broker.stage_timeout_s)] = "worker"
+        for url, task in leaf_tasks:
+            futs[pool.submit(broker._post_leaf_task, url, "leafStage",
+                             task)] = "leaf"
+        for f in as_completed(futs):
+            r = f.result()
+            if futs[f] == "worker":
+                partials.extend(r)
+    except Exception:
+        # wake every blocked sender/consumer across the cluster BEFORE the
+        # pool shutdown below waits on their futures — otherwise a dead
+        # worker's surviving peers block the unwind for the full mailbox
+        # timeout. One clean error surfaces (a successful query needs no
+        # cleanup: workers discard their mailboxes as they drain them).
+        cancel_query_mailboxes(all_urls, qid)
+        raise
+    finally:
+        pool.shutdown(wait=True)
+
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                   else list(ctx.group_by))
+    merged = merge_segment_results(partials, aggs)
+    if not partials:
+        merged.kind = ("groups" if group_exprs else
+                       "scalar" if aggs else "selection")
+    result = reduce_to_result(ctx, merged, aggs, group_exprs)
+    result.stats["multistage"] = True
+    result.stats["mailboxShuffle"] = True
+    result.stats["numStageWorkers"] = len({u for u, _, _ in worker_tasks})
+    return result
+
+
+def coordinate_groupby(broker, ctx, physical: List[str], num_partitions: int):
+    """P2P distributed single-table GROUP BY: leaf servers emit hash-
+    partitioned group partials straight to P merge workers; the broker
+    receives P disjoint merged key ranges and concatenates (reference:
+    PinotAggregateExchangeNodeInsertRule's partitioned agg exchange)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..query.reduce import reduce_to_result
+
+    qid = f"q{uuid.uuid4().hex[:16]}"
+    P = num_partitions
+    workers = broker._stage_workers(P)
+
+    routes: List[LeafRoute] = broker._leaf_routes_groupby(ctx, physical)
+    if not routes:
+        raise P2PUnavailable("no routable leaf servers")
+
+    leaf_tasks = []
+    for i, r in enumerate(routes):
+        leaf_tasks.append((r.url, {
+            "queryId": qid, "table": r.table, "sql": ctx.sql,
+            "segments": r.segments, "timeFilter": r.time_filter,
+            "numPartitions": P, "stage": "agg0",
+            "targets": [w[1] for w in workers],
+            "senderId": f"leaf.{i}"}))
+    worker_tasks = []
+    for p in range(P):
+        worker_tasks.append((workers[p][1], {
+            "queryId": qid, "stage": "agg0", "partition": p,
+            "numSenders": len(routes),
+            "finalCtx": stage_ctx_to_json(ctx)}))
+    all_urls = {r.url for r in routes} | {w[1] for w in workers}
+
+    partials: List[SegmentResult] = []
+    pool = ThreadPoolExecutor(max_workers=len(leaf_tasks) + len(worker_tasks),
+                              thread_name_prefix="p2p-agg")
+    try:
+        from concurrent.futures import as_completed
+        futs = {}
+        for url, task in worker_tasks:
+            futs[pool.submit(_post_stage_task, url, "aggStage", task,
+                             broker.stage_timeout_s)] = "worker"
+        for url, task in leaf_tasks:
+            futs[pool.submit(broker._post_leaf_task, url, "leafAgg",
+                             task)] = "leaf"
+        for f in as_completed(futs):
+            r = f.result()
+            if futs[f] == "worker":
+                partials.extend(r)
+    except Exception:
+        # cancel BEFORE the pool shutdown waits on blocked peers (see
+        # coordinate_join)
+        cancel_query_mailboxes(all_urls, qid)
+        raise
+    finally:
+        pool.shutdown(wait=True)
+
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
+                   else list(ctx.group_by))
+    # key ranges are disjoint: merge is a cheap union, never a re-aggregation
+    merged = merge_segment_results(partials, aggs)
+    if not partials:
+        merged.kind = "groups"
+    result = reduce_to_result(ctx, merged, aggs, group_exprs)
+    result.stats["distributedGroupBy"] = True
+    result.stats["numStageWorkers"] = len({u for u, _ in worker_tasks})
+    return result

@@ -261,7 +261,11 @@ def test_multistage_join_groupby_on_worker_processes(tmp_path):
                "GROUP BY c.segment, o.region "
                "ORDER BY c.segment, o.region LIMIT 100")
         resp = cluster.query(sql)
-        assert resp["workerAggregation"] is True
+        # r3 asserted the funnel path's worker aggregation; r4's mailbox
+        # shuffle supersedes it (aggregation runs on stage workers AND the
+        # data never transits the broker) — accept either stat
+        assert resp.get("workerAggregation") or resp.get("mailboxShuffle"), \
+            sorted(resp.keys())
         got = [tuple(r) for r in resp["resultTable"]["rows"]]
 
         # differential oracle

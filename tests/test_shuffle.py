@@ -1,0 +1,548 @@
+"""Server↔server mailbox shuffle tests: the P2P multistage data plane.
+
+Coverage mirrors the reference's mailbox/exchange tests
+(`pinot-query-runtime/src/test/.../MailboxSendOperatorTest.java`,
+`GrpcMailboxServiceTest.java`, `QueryRunnerTest`): partition routing is
+deterministic across processes, bounded buffering backpressures, cancellation
+unwinds cleanly, join results through the P2P path match the sqlite oracle,
+single-table GROUP BY distributes across workers, and the broker's data-plane
+memory stays flat (enforced by a cap the funnel path trips and the shuffle
+path never touches).
+"""
+
+import sqlite3
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.broker import Broker
+from pinot_tpu.cluster.catalog import Catalog
+from pinot_tpu.cluster.controller import Controller
+from pinot_tpu.cluster.deepstore import LocalDeepStore
+from pinot_tpu.cluster.process import BrokerClient, ControllerClient
+from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+from pinot_tpu.cluster.server import ServerNode
+from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                        ServerService)
+from pinot_tpu.multistage.shuffle import (MailboxCancelled, REGISTRY,
+                                          SegmentResult, StageCtx, _Mailbox,
+                                          partition_block_stable,
+                                          partition_groups_stable,
+                                          stable_hash_codes, stable_hash_key,
+                                          trim_group_result)
+from pinot_tpu.query.aggregates import make_agg
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.writer import SegmentBuilder
+from pinot_tpu.sql.ast import Function, Identifier
+from pinot_tpu.table import TableConfig
+
+from conftest import wait_until
+from test_differential import _rows_match, _sorted_rows
+
+# ---------------------------------------------------------------------------
+# unit: stable partition routing
+# ---------------------------------------------------------------------------
+
+def test_stable_hash_routes_equal_keys_identically():
+    """Two independently-built blocks (as two leaf servers would build them)
+    must route equal keys to the same partition — Python's randomized hash()
+    would not."""
+    a = {"k": np.array(["x", "y", "z", "x"], dtype=object)}
+    b = {"k": np.array(["z", "x", "q"], dtype=object)}
+    pa = (stable_hash_codes(a, ["k"]) % np.uint64(8)).tolist()
+    pb = (stable_hash_codes(b, ["k"]) % np.uint64(8)).tolist()
+    assert pa[0] == pa[3] == pb[1]      # "x" always lands together
+    assert pa[2] == pb[0]               # "z" too
+
+
+def test_stable_hash_numeric_dtype_canonicalization():
+    """int 3 and double 3.0 must co-partition (outer joins can promote one
+    side to float)."""
+    ints = {"k": np.array([3, 7, 0], dtype=np.int64)}
+    flts = {"k": np.array([3.0, 7.0, -0.0], dtype=np.float64)}
+    pi = (stable_hash_codes(ints, ["k"]) % np.uint64(16)).tolist()
+    pf = (stable_hash_codes(flts, ["k"]) % np.uint64(16)).tolist()
+    assert pi == pf
+
+
+def test_partition_block_stable_partitions_cover_exactly():
+    rng = np.random.default_rng(7)
+    block = {"k": np.array([f"u{i}" for i in rng.integers(0, 50, 300)],
+                           dtype=object),
+             "v": rng.uniform(0, 1, 300)}
+    parts = partition_block_stable(block, ["k"], 8)
+    assert sum(len(p["v"]) for p in parts) == 300
+    # the same key never appears in two partitions
+    seen = {}
+    for pi, p in enumerate(parts):
+        for k in p["k"]:
+            assert seen.setdefault(k, pi) == pi
+
+
+def test_partition_groups_stable_disjoint_union():
+    res = SegmentResult("groups")
+    res.groups = {(f"k{i}", i % 3): [float(i)] for i in range(100)}
+    res.num_docs_scanned = 1234
+    parts = partition_groups_stable(res, 4)
+    assert sum(len(p.groups) for p in parts) == 100
+    assert sum(p.num_docs_scanned for p in parts) == 1234
+    merged = {}
+    for p in parts:
+        for k, v in p.groups.items():
+            assert k not in merged
+            merged[k] = v
+    assert merged == res.groups
+    # same key -> same partition on a rebuild (cross-process determinism)
+    again = partition_groups_stable(res, 4)
+    for p1, p2 in zip(parts, again):
+        assert set(p1.groups) == set(p2.groups)
+    assert stable_hash_key(("a", 1)) == stable_hash_key(("a", 1))
+
+
+# ---------------------------------------------------------------------------
+# unit: worker-side trim (HAVING + top-k on a disjoint key range)
+# ---------------------------------------------------------------------------
+
+def _sum_agg():
+    return Function("sum", (Identifier("v"),))
+
+
+def test_trim_group_result_having_and_topk():
+    call = _sum_agg()
+    ctx = StageCtx(select_items=[(Identifier("g"), "g"), (call, "s")],
+                   group_by=[Identifier("g")], aggregations=[call],
+                   having=Function("gt", (call, __import__(
+                       "pinot_tpu.sql.ast", fromlist=["Literal"]).Literal(10.0))),
+                   order_by=[__import__(
+                       "pinot_tpu.sql.ast", fromlist=["OrderByItem"]
+                   ).OrderByItem(call, desc=True)],
+                   limit=3, offset=0)
+    aggs = [make_agg(call)]
+    merged = SegmentResult("groups")
+    # states for SUM are plain floats
+    merged.groups = {(f"g{i}",): [float(i)] for i in range(30)}
+    out = trim_group_result(ctx, merged, aggs)
+    # HAVING sum > 10 keeps g11..g29; top-3 by sum desc = g29,g28,g27
+    assert set(out.groups) == {("g29",), ("g28",), ("g27",)}
+    # states are preserved un-finalized (still mergeable)
+    assert out.groups[("g29",)] == [29.0]
+
+
+def test_trim_group_result_no_trim_needed_is_identity():
+    call = _sum_agg()
+    ctx = StageCtx(select_items=[(Identifier("g"), "g"), (call, "s")],
+                   group_by=[Identifier("g")], aggregations=[call])
+    merged = SegmentResult("groups")
+    merged.groups = {("a",): [1.0], ("b",): [2.0]}
+    assert trim_group_result(ctx, merged, [make_agg(call)]) is merged
+
+
+# ---------------------------------------------------------------------------
+# unit: mailbox semantics (bounded buffering, cancellation)
+# ---------------------------------------------------------------------------
+
+def test_mailbox_backpressure_blocks_then_drains():
+    box = _Mailbox(window=2)
+    box.put(("block", 1))
+    box.put(("block", 2))
+    t0 = time.time()
+    done = []
+
+    def producer():
+        box.put(("block", 3), timeout_s=10)   # blocks until a consumer pops
+        done.append(time.time() - t0)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.3)
+    assert not done              # still blocked on the full window
+    assert box.get() == ("block", 1)
+    t.join(timeout=5)
+    assert done and done[0] >= 0.25
+
+
+def test_mailbox_cancel_wakes_blocked_producer_and_consumer():
+    box = _Mailbox(window=1)
+    box.put(("block", 1))
+    errs = []
+
+    def producer():
+        try:
+            box.put(("block", 2), timeout_s=30)
+        except MailboxCancelled:
+            errs.append("producer")
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.1)
+    box.cancelled.set()
+    t.join(timeout=5)
+    assert errs == ["producer"]
+    with pytest.raises(MailboxCancelled):
+        box.get(timeout_s=30)
+
+
+def test_registry_cancel_tombstones_new_opens():
+    REGISTRY.cancel_query("qdead")
+    with pytest.raises(MailboxCancelled):
+        REGISTRY.open("qdead", "join0.L.0")
+    REGISTRY._cancelled.pop("qdead", None)  # don't leak into other tests
+
+
+# ---------------------------------------------------------------------------
+# integration: full P2P shuffle over an HTTP cluster
+# ---------------------------------------------------------------------------
+
+RNG = np.random.default_rng(42)
+N_ORDERS = 2000
+
+ORDERS = {
+    "cust_id": [f"c{i}" for i in RNG.integers(0, 100, N_ORDERS)],
+    "qty": RNG.integers(1, 20, N_ORDERS).astype(np.int32),
+    "amount": np.round(RNG.uniform(1, 500, N_ORDERS), 2),
+}
+CUSTS = {
+    "cust_id": [f"c{i}" for i in range(80)],
+    "region": [["east", "west", "north"][i % 3] for i in range(80)],
+    "tier": RNG.integers(1, 4, 80).astype(np.int32),
+}
+REGIONS = {
+    "region": ["east", "west", "north"],
+    "zone": ["Z1", "Z2", "Z1"],
+}
+
+ORDERS_SCHEMA = Schema("orders", [
+    dimension("cust_id"), metric("qty", DataType.INT),
+    metric("amount", DataType.DOUBLE)])
+CUSTS_SCHEMA = Schema("custs", [
+    dimension("cust_id"), dimension("region"), metric("tier", DataType.INT)])
+REGIONS_SCHEMA = Schema("regions", [dimension("region"), dimension("zone")])
+
+
+def _slice(cols, lo, hi):
+    return {k: (v[lo:hi] if isinstance(v, np.ndarray) else list(v[lo:hi]))
+            for k, v in cols.items()}
+
+
+@pytest.fixture(scope="module")
+def shuffle_cluster(tmp_path_factory):
+    """Controller + 2 HTTP servers + broker; orders split into 4 segments so
+    both servers hold data and every join crosses the wire."""
+    tmp = tmp_path_factory.mktemp("shuffle")
+    catalog = Catalog()
+    deepstore = LocalDeepStore(str(tmp / "deepstore"))
+    controller = Controller("controller_0", catalog, deepstore, str(tmp / "c"))
+    csvc = ControllerService(controller)
+    services = [csvc]
+    catalogs = []
+    nodes = []
+    try:
+        for i in range(2):
+            rc = RemoteCatalog(csvc.url, poll_timeout_s=1.0)
+            catalogs.append(rc)
+            node = ServerNode(f"server_{i}", rc, ControllerDeepStore(csvc.url),
+                              str(tmp / f"s{i}"))
+            services.append(ServerService(node))
+            nodes.append(node)
+        brc = RemoteCatalog(csvc.url, poll_timeout_s=1.0)
+        catalogs.append(brc)
+        broker = Broker("broker_0", brc)
+        bsvc = BrokerService(broker)
+        services.append(bsvc)
+
+        cc = ControllerClient(csvc.url)
+        for schema, cols, n_segs in [(ORDERS_SCHEMA, ORDERS, 4),
+                                     (CUSTS_SCHEMA, CUSTS, 2),
+                                     (REGIONS_SCHEMA, REGIONS, 1)]:
+            cc.add_schema(schema)
+            cfg = TableConfig(schema.name, replication=1)
+            cc.add_table(cfg)
+            n = len(next(iter(cols.values())))
+            step = (n + n_segs - 1) // n_segs
+            builder = SegmentBuilder(schema)
+            for si, lo in enumerate(range(0, n, step)):
+                seg = builder.build(_slice(cols, lo, lo + step),
+                                    str(tmp / f"b_{schema.name}_{si}"),
+                                    f"{schema.name}_{si}")
+                cc.upload_segment(cfg.table_name_with_type, seg)
+
+        def ready():
+            served = [set() for _ in nodes]
+            for ni, node in enumerate(nodes):
+                for t in ("orders_OFFLINE", "custs_OFFLINE", "regions_OFFLINE"):
+                    served[ni] |= {f"{t}:{s}" for s in node.segments_served(t)}
+            return sum(len(s) for s in served) == 7
+        assert wait_until(ready, timeout=30, interval=0.1)
+
+        db = sqlite3.connect(":memory:", check_same_thread=False)
+        db.execute("CREATE TABLE orders (cust_id TEXT, qty INTEGER, amount REAL)")
+        db.execute("CREATE TABLE custs (cust_id TEXT, region TEXT, tier INTEGER)")
+        db.execute("CREATE TABLE regions (region TEXT, zone TEXT)")
+        db.executemany("INSERT INTO orders VALUES (?,?,?)",
+                       list(zip(ORDERS["cust_id"], ORDERS["qty"].tolist(),
+                                ORDERS["amount"].tolist())))
+        db.executemany("INSERT INTO custs VALUES (?,?,?)",
+                       list(zip(CUSTS["cust_id"], CUSTS["region"],
+                                CUSTS["tier"].tolist())))
+        db.executemany("INSERT INTO regions VALUES (?,?)",
+                       list(zip(REGIONS["region"], REGIONS["zone"])))
+        yield {"broker": broker, "bc": BrokerClient(bsvc.url), "db": db,
+               "nodes": nodes}
+    finally:
+        for rc in catalogs:
+            rc.close()
+        for s in services:
+            s.stop()
+
+
+def _oracle(db, sql):
+    import re
+    return _sorted_rows(db.execute(re.sub(r" LIMIT \d+", "", sql)).fetchall())
+
+
+def _query_rows(bc, sql):
+    resp = bc.query(sql)
+    if "error" in resp:
+        raise RuntimeError(resp["error"])
+    return resp, _sorted_rows([tuple(r) for r in resp["resultTable"]["rows"]])
+
+
+def test_p2p_join_differential_vs_sqlite(shuffle_cluster):
+    """Join results through the full server->server shuffle match sqlite, and
+    the broker never buffers leaf rows (mailboxShuffle stat set, data-plane
+    cap untouched)."""
+    from test_differential_joins import gen_join_query
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    shuffle_cluster["broker"].max_data_plane_bytes = 1  # funnel would trip this
+    try:
+        rng = np.random.default_rng(77)
+        for qi in range(12):
+            sql = gen_join_query(rng)
+            resp, got = _query_rows(bc, sql)
+            assert resp.get("mailboxShuffle"), resp.keys()
+            oracle = _oracle(db, sql)
+            assert _rows_match(got, oracle, 1e-6, 1e-4), \
+                f"q={qi}\n{sql}\nours({len(got)}): {got[:4]}\n" \
+                f"oracle({len(oracle)}): {oracle[:4]}"
+    finally:
+        shuffle_cluster["broker"].max_data_plane_bytes = None
+    # every mailbox drained: nothing leaked in the shared registry
+    assert not REGISTRY._boxes
+
+
+def test_p2p_three_way_join_worker_to_worker(shuffle_cluster):
+    """A 3-table join pipelines stage-0 worker output STRAIGHT to stage-1
+    workers' mailboxes (no broker hop between stages)."""
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    sql = ("SELECT r.zone, COUNT(*), SUM(o.amount) FROM orders o "
+           "JOIN custs c ON o.cust_id = c.cust_id "
+           "JOIN regions r ON c.region = r.region "
+           "GROUP BY r.zone LIMIT 1000")
+    shuffle_cluster["broker"].max_data_plane_bytes = 1
+    try:
+        resp, got = _query_rows(bc, sql)
+    finally:
+        shuffle_cluster["broker"].max_data_plane_bytes = None
+    assert resp.get("mailboxShuffle")
+    assert _rows_match(got, _oracle(db, sql), 1e-6, 1e-4)
+
+
+def test_p2p_selection_join_order_limit(shuffle_cluster):
+    """Selection (non-agg) join with ORDER BY + LIMIT: workers trim their
+    partitions, the broker merges the trimmed partials."""
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    sql = ("SELECT o.cust_id, o.amount, c.region FROM orders o "
+           "JOIN custs c ON o.cust_id = c.cust_id "
+           "ORDER BY o.amount DESC LIMIT 10")
+    resp, _ = _query_rows(bc, sql)
+    got = [tuple(r) for r in resp["resultTable"]["rows"]]
+    oracle = shuffle_cluster["db"].execute(sql).fetchall()
+    assert [round(r[1], 2) for r in got] == [round(r[1], 2) for r in oracle]
+
+
+def test_funnel_fallback_option_and_data_plane_cap(shuffle_cluster):
+    """OPTION(useMailboxShuffle=false) forces the legacy broker-funnel path;
+    with a data-plane cap set, the funnel fails with a clear error while the
+    mailbox path (default) still succeeds — the flat-broker-memory proof."""
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    broker = shuffle_cluster["broker"]
+    sql = ("SELECT c.region, COUNT(*) FROM orders o "
+           "JOIN custs c ON o.cust_id = c.cust_id GROUP BY c.region "
+           "LIMIT 100 OPTION(useMailboxShuffle=false)")
+    resp, got = _query_rows(bc, sql)             # uncapped funnel still works
+    assert "mailboxShuffle" not in resp
+    assert _rows_match(got, _oracle(db, sql.split(" OPTION")[0]), 1e-6, 1e-4)
+
+    from pinot_tpu.cluster.http_service import HttpError
+    broker.max_data_plane_bytes = 4096           # far below the leaf output
+    try:
+        with pytest.raises((RuntimeError, HttpError),
+                           match="data-plane memory cap"):
+            _query_rows(bc, sql)
+        # same query through the shuffle: broker data plane stays flat
+        resp, got = _query_rows(bc, sql.split(" OPTION")[0])
+        assert resp.get("mailboxShuffle")
+        assert _rows_match(got, _oracle(db, sql.split(" OPTION")[0]),
+                           1e-6, 1e-4)
+    finally:
+        broker.max_data_plane_bytes = None
+
+
+def test_distributed_groupby_partitions_key_space(shuffle_cluster):
+    """Single-table high-cardinality GROUP BY through the partitioned agg
+    exchange: exact results, HAVING + ORDER + LIMIT handled by worker-side
+    trim on disjoint key ranges."""
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    sql = ("SELECT cust_id, COUNT(*), SUM(amount) FROM orders "
+           "GROUP BY cust_id LIMIT 100000 OPTION(useMultistageEngine=true)")
+    resp, got = _query_rows(bc, sql)
+    assert resp.get("distributedGroupBy")
+    assert _rows_match(got, _oracle(db, sql.split(" OPTION")[0]), 1e-6, 1e-4)
+
+    # ordered top-k with HAVING: the trim must not change results
+    sql2 = ("SELECT cust_id, SUM(amount) AS total FROM orders GROUP BY cust_id "
+            "HAVING total > 100 ORDER BY total DESC LIMIT 7 "
+            "OPTION(useMultistageEngine=true)")
+    resp2, _ = _query_rows(bc, sql2)
+    got2 = [tuple(r) for r in resp2["resultTable"]["rows"]]
+    oracle2 = db.execute(
+        "SELECT cust_id, SUM(amount) AS total FROM orders GROUP BY cust_id "
+        "HAVING total > 100 ORDER BY total DESC LIMIT 7").fetchall()
+    assert resp2.get("distributedGroupBy")
+    assert [r[0] for r in got2] == [r[0] for r in oracle2]
+    assert np.allclose([r[1] for r in got2], [r[1] for r in oracle2])
+
+    # identical answers with the distribution off
+    _, plain = _query_rows(bc, sql.split(" OPTION")[0])
+    assert _rows_match(got, plain, 1e-9, 1e-9)
+    assert not REGISTRY._boxes
+
+
+def test_distributed_groupby_doc_threshold_auto_routes(shuffle_cluster):
+    """The cluster-config doc threshold routes big tables automatically."""
+    bc = shuffle_cluster["bc"]
+    broker = shuffle_cluster["broker"]
+    broker.catalog.put_property(
+        "clusterConfig/broker.distributedGroupByDocThreshold", "100")
+    try:
+        resp, _ = _query_rows(
+            bc, "SELECT cust_id, COUNT(*) FROM orders GROUP BY cust_id "
+                "LIMIT 100000")
+        assert resp.get("distributedGroupBy")
+    finally:
+        broker.catalog.put_property(
+            "clusterConfig/broker.distributedGroupByDocThreshold", None)
+
+
+def test_worker_death_mid_shuffle_fails_cleanly(shuffle_cluster):
+    """A worker that dies mid-query must produce ONE clean error promptly
+    (cancellation wakes all blocked peers) — never a hang. Simulated by
+    cancelling the query's mailboxes everywhere mid-flight, which is exactly
+    the unwind path a dead worker triggers."""
+    bc = shuffle_cluster["bc"]
+    sql = ("SELECT c.region, COUNT(*) FROM orders o "
+           "JOIN custs c ON o.cust_id = c.cust_id GROUP BY c.region LIMIT 10")
+    results = []
+
+    def run():
+        try:
+            results.append(("ok", _query_rows(bc, sql)[1]))
+        except Exception as e:
+            results.append(("err", str(e)))
+
+    # cancel continuously while the query runs: whichever stage it is in,
+    # the cancellation lands mid-flight
+    stop = threading.Event()
+
+    def killer():
+        while not stop.is_set():
+            for key in list(REGISTRY._boxes):
+                REGISTRY.cancel_query(key[0])
+            time.sleep(0.002)
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=60)
+    stop.set()
+    kt.join(timeout=5)
+    assert not t.is_alive(), "query hung after worker death"
+    assert results
+    kind, payload = results[0]
+    if kind == "err":   # cancelled mid-shuffle: one clean error
+        assert "cancel" in payload.lower() or "stage worker failed" in payload.lower() \
+            or "truncated" in payload.lower(), payload
+    REGISTRY._cancelled.clear()
+    # the cluster still answers queries afterwards
+    resp, _ = _query_rows(bc, sql)
+    assert resp["resultTable"]["rows"]
+
+
+def test_sigkill_worker_process_mid_shuffle(tmp_path):
+    """Real OS-process chaos: SIGKILL a server that is simultaneously a leaf
+    and a stage worker while a join is shuffling. The query must terminate
+    promptly — clean error or (if the kill landed after its frames) a correct
+    result — never a hang (reference: the v2 engine failing queries on
+    stage-worker death)."""
+    from pinot_tpu.cluster.process import ProcessCluster
+    rng = np.random.default_rng(5)
+    n = 60_000
+    fact_cols = {
+        "k": [f"u{i}" for i in rng.integers(0, 5000, n)],
+        "v": rng.uniform(0, 1, n),
+    }
+    fact_schema = Schema("fact", [dimension("k"), metric("v", DataType.DOUBLE)])
+    dim_schema = Schema("dims", [dimension("k"), dimension("grp")])
+    dim_cols = {"k": [f"u{i}" for i in range(5000)],
+                "grp": [f"g{i % 7}" for i in range(5000)]}
+    with ProcessCluster(num_servers=2, work_dir=str(tmp_path)) as cluster:
+        cluster.controller.add_schema(fact_schema)
+        cluster.controller.add_schema(dim_schema)
+        fcfg = TableConfig("fact", replication=1)
+        dcfg = TableConfig("dims", replication=2)
+        cluster.controller.add_table(fcfg)
+        cluster.controller.add_table(dcfg)
+        fb = SegmentBuilder(fact_schema)
+        for si in range(4):
+            seg = fb.build(_slice(fact_cols, si * n // 4, (si + 1) * n // 4),
+                           str(tmp_path / f"fb{si}"), f"fact_{si}")
+            cluster.controller.upload_segment(fcfg.table_name_with_type, seg)
+        dseg = SegmentBuilder(dim_schema).build(
+            dim_cols, str(tmp_path / "db"), "dims_0")
+        cluster.controller.upload_segment(dcfg.table_name_with_type, dseg)
+
+        def converged():
+            st = cluster.controller.table_status(fcfg.table_name_with_type)
+            return st.get("segments", 0) == 4 and st.get("converged")
+        assert wait_until(converged, timeout=30)
+
+        sql = ("SELECT d.grp, COUNT(*), SUM(f.v) FROM fact f "
+               "JOIN dims d ON f.k = d.k GROUP BY d.grp LIMIT 100")
+        results = []
+
+        def run():
+            try:
+                results.append(("ok", cluster.query(sql)))
+            except Exception as e:
+                results.append(("err", f"{type(e).__name__}: {e}"))
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.4)               # let the shuffle get going
+        cluster.procs["server_1"].kill()   # SIGKILL, no cleanup
+        t.join(timeout=90)
+        assert not t.is_alive(), "query hung after SIGKILL of a stage worker"
+        kind, payload = results[0]
+        if kind == "ok":
+            if "error" in payload:
+                assert any(s in str(payload["error"]) for s in
+                           ("stage worker failed", "Connection", "cancel",
+                            "truncated", "ConnectionError", "failed")), payload
+            else:
+                # kill landed after the worker's frames: result must be right
+                assert payload["resultTable"]["rows"]
+        else:
+            assert payload  # clean python-side error, not a hang
