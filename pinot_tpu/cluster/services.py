@@ -805,6 +805,14 @@ class ServerService:
                     box.put(("partial", decode_segment_result(d["result"])))
                 get_registry().counter("pinot_server_mailbox_frames").inc()
         except MailboxCancelled:
+            # the 409 must also drain (see below) or the RST race turns a
+            # clean "query cancelled" into a misleading connection reset on
+            # the sender; the remainder is bounded by the sender's in-memory
+            # partition
+            try:
+                body.drain()
+            except Exception:
+                pass
             return error_response("query cancelled", 409)
         # drain the chunked-body terminator BEFORE responding: closing the
         # socket with unread bytes in the receive buffer sends a TCP RST that

@@ -4,22 +4,25 @@ Analog of the reference's batch ingestion framework
 (`pinot-spi/.../ingestion/batch/IngestionJobLauncher.java:43,103` +
 `pinot-plugins/pinot-batch-ingestion/pinot-batch-ingestion-standalone/...
 SegmentGenerationJobRunner.java:61`): a job spec names inputs, the table, and
-partitioning; the runner streams records, applies the transform pipeline, cuts segments
-at `segment_rows`, builds them (aligned dictionaries per job so the mesh fast path
-applies across the job's output), and pushes via the controller. The hadoop/spark
-runners of the reference parallelize the same per-file unit; here `map_workers` uses a
-thread pool per input file.
+partitioning; the runner STREAMS records (O(segment)+O(dictionary) peak memory,
+never O(job)), applies the transform pipeline, cuts segments at `segment_rows`,
+builds them (aligned dictionaries per job so the mesh fast path applies across
+the job's output), and pushes each via the controller as it is cut. The
+hadoop/spark runners of the reference parallelize a per-file unit; the
+distributed analog here is `POST /ingestJobs` fanning per-file
+SegmentGenerationAndPushTasks over the minion fleet (services.py).
 """
 
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from ..schema import Schema
-from ..segment.writer import SegmentBuilder, SegmentGeneratorConfig, build_aligned_segments
+from ..segment.writer import SegmentBuilder, SegmentGeneratorConfig
 from ..table import TableConfig
 from .readers import reader_for, rows_to_columns
 from .transform import TransformPipeline
@@ -38,7 +41,7 @@ class BatchIngestionJobSpec:
     filter_expr: Optional[str] = None
     column_transforms: Dict[str, str] = field(default_factory=dict)
     aligned_dictionaries: bool = True                  # TPU mesh fast path across output
-    map_workers: int = 1
+    map_workers: int = 1   # distributed fan-out width hint (POST /ingestJobs path)
 
 
 def ingest_file_to_segments(schema: Schema, table_cfg: TableConfig, path: str,
@@ -72,11 +75,92 @@ def ingest_file_to_segments(schema: Schema, table_cfg: TableConfig, path: str,
     return seg_dirs
 
 
+# rows per streamed read chunk (bounds the stats pass's working set)
+CHUNK_ROWS = 65536
+
+
+def _iter_transformed_chunks(spec: BatchIngestionJobSpec, schema: Schema,
+                             pipeline: TransformPipeline,
+                             chunk_rows: int):
+    """Stream `input_paths` in order as transformed column-dict chunks of at
+    most `chunk_rows` rows — the O(chunk) unit both passes of the streaming
+    runner consume (reference: the record-at-a-time loop of
+    `SegmentIndexCreationDriverImpl.build():204`, chunk-vectorized here)."""
+    import itertools
+    for path in spec.input_paths:
+        reader = reader_for(path, spec.input_format)
+        try:
+            it = iter(reader.rows())
+            while True:
+                rows = list(itertools.islice(it, chunk_rows))
+                if not rows:
+                    break
+                cols = pipeline.apply(rows_to_columns(rows, schema))
+                if cols and len(next(iter(cols.values()))):
+                    yield cols
+        finally:
+            reader.close()
+
+
+def _collect_fixed_dictionaries(spec: BatchIngestionJobSpec, schema: Schema,
+                                pipeline: TransformPipeline,
+                                gen_cfg: SegmentGeneratorConfig,
+                                chunk_rows: int):
+    """Stats pass (reference: `SegmentPreIndexStatsCollectorImpl` feeding
+    `SegmentDictionaryCreator`): one streaming read collecting per-column
+    distinct values — memory is O(cardinality + chunk), never O(rows) — so
+    the write pass can pin every segment to shared dictionaries (the TPU
+    mesh fast path needs aligned dict-id spaces across the job's output)."""
+    from ..segment.dictionary import build_dictionary
+    uniques: Dict[str, Any] = {}
+    specs = {f.name: f for f in schema.fields}
+    no_dict = set(gen_cfg.no_dictionary_columns)
+    total = 0
+    for cols in _iter_transformed_chunks(spec, schema, pipeline, chunk_rows):
+        total += len(next(iter(cols.values())))
+        for name, fs in specs.items():
+            if name in no_dict or name not in cols:
+                continue
+            vals = cols[name]
+            if fs.data_type.is_numeric:
+                arr = np.asarray(
+                    [fs.null_value if v is None else v for v in vals],
+                    dtype=fs.data_type.numpy_dtype)
+                prev = uniques.get(name)
+                u = np.unique(arr)
+                uniques[name] = u if prev is None else np.union1d(prev, u)
+            else:
+                uniques.setdefault(name, set()).update(
+                    fs.null_value if v is None else v for v in vals)
+    fixed: Dict[str, Any] = {}
+    extra_no_dict: List[str] = []
+    for name, u in uniques.items():
+        fs = specs[name]
+        if fs.data_type.is_numeric:
+            if len(u) > gen_cfg.raw_cardinality_fraction * max(total, 1):
+                # force raw in EVERY segment, like build_aligned_segments —
+                # per-segment heuristics could diverge across the output set
+                extra_no_dict.append(name)
+                continue
+            fixed[name], _ = build_dictionary(u, fs.data_type)
+        else:
+            fixed[name], _ = build_dictionary(sorted(u), fs.data_type)
+    return fixed, extra_no_dict, total
+
+
 def run_batch_ingestion(spec: BatchIngestionJobSpec, controller, *,
                         work_dir: str) -> List[str]:
-    """Execute the job against a Controller (in-proc or HTTP proxy). Returns segment
-    names pushed (reference: IngestionJobLauncher.runIngestionJob ->
-    SegmentGenerationJobRunner + SegmentTarPushJobRunner)."""
+    """Execute the job against a Controller (in-proc or HTTP proxy). Returns
+    segment names pushed (reference: IngestionJobLauncher.runIngestionJob ->
+    SegmentGenerationJobRunner + SegmentTarPushJobRunner).
+
+    STREAMING: segments are cut incrementally while reading — peak memory is
+    O(segment_rows + dictionary), not O(total rows), so a job 10x larger than
+    one segment never needs 10x the RAM (reference: the two-pass
+    stats-then-write driver `SegmentIndexCreationDriverImpl.java:99,204`).
+    With `aligned_dictionaries` a first stats pass streams the inputs to
+    collect shared dictionaries; the write pass then streams again, buffering
+    only one segment's rows at a time and pushing each segment as it is cut."""
     table_cfg: TableConfig = controller.catalog.table_configs[spec.table]
     schema: Schema = controller.catalog.schemas[table_cfg.name]
     pipeline = TransformPipeline(schema, spec.filter_expr, spec.column_transforms)
@@ -84,42 +168,57 @@ def run_batch_ingestion(spec: BatchIngestionJobSpec, controller, *,
     build_dir = os.path.join(work_dir, "batch_build")
     os.makedirs(build_dir, exist_ok=True)
 
-    idx = table_cfg.indexing
-    gen_cfg = SegmentGeneratorConfig.from_indexing(idx)
+    import dataclasses
+    gen_cfg = dataclasses.replace(
+        SegmentGeneratorConfig.from_indexing(table_cfg.indexing))
+    gen_cfg.no_dictionary_columns = list(gen_cfg.no_dictionary_columns)
+    chunk_rows = min(spec.segment_rows, CHUNK_ROWS)
 
-    def read_one(path: str) -> List[Dict[str, Any]]:
-        reader = reader_for(path, spec.input_format)
-        try:
-            return list(reader.rows())
-        finally:
-            reader.close()
+    fixed = None
+    if spec.aligned_dictionaries:
+        fixed, extra_no_dict, total = _collect_fixed_dictionaries(
+            spec, schema, pipeline, gen_cfg, chunk_rows)
+        if total == 0:
+            return []
+        gen_cfg.no_dictionary_columns.extend(extra_no_dict)
 
-    if spec.map_workers > 1 and len(spec.input_paths) > 1:
-        with ThreadPoolExecutor(max_workers=spec.map_workers) as pool:
-            per_file = list(pool.map(read_one, spec.input_paths))
-    else:
-        per_file = [read_one(p) for p in spec.input_paths]
-
-    rows: List[Dict[str, Any]] = [r for rs in per_file for r in rs]
-    columns = pipeline.apply(rows_to_columns(rows, schema))
-    n = len(next(iter(columns.values()))) if columns else 0
-
+    builder = SegmentBuilder(schema, gen_cfg)
     pushed: List[str] = []
-    if n == 0:
-        return pushed
-    num_segments = max(1, -(-n // spec.segment_rows))
-    if spec.aligned_dictionaries and num_segments > 1:
-        seg_dirs = build_aligned_segments(schema, columns, build_dir,
-                                          prefix, num_segments, gen_cfg)
-    else:
-        builder = SegmentBuilder(schema, gen_cfg)
-        seg_dirs = []
-        for i in range(num_segments):
-            lo, hi = i * spec.segment_rows, min(n, (i + 1) * spec.segment_rows)
-            part = {c: v[lo:hi] for c, v in columns.items()}
-            seg_dirs.append(builder.build(part, build_dir, f"{prefix}_{i}"))
+    buf: Dict[str, List[Any]] = {}
+    buffered = 0
+    seq = 0
 
-    for seg_dir in seg_dirs:
+    def flush() -> None:
+        nonlocal buffered, seq, buf
+        if buffered == 0:
+            return
+        seg_dir = builder.build(buf, build_dir, f"{prefix}_{seq}",
+                                fixed_dictionaries=fixed)
         meta = controller.upload_segment(spec.table, seg_dir)
         pushed.append(meta.name)
+        # free the built segment's rows AND its on-disk build dir promptly:
+        # the runner's footprint must stay O(one segment)
+        import shutil
+        shutil.rmtree(seg_dir, ignore_errors=True)
+        buf = {c: [] for c in buf}
+        buffered = 0
+        seq += 1
+
+    for cols in _iter_transformed_chunks(spec, schema, pipeline, chunk_rows):
+        if not buf:
+            buf = {c: [] for c in cols}
+        n = len(next(iter(cols.values())))
+        off = 0
+        while off < n:
+            take = min(spec.segment_rows - buffered, n - off)
+            for c, acc in buf.items():
+                v = cols.get(c)
+                seg = (v[off:off + take] if v is not None
+                       else [None] * take)
+                acc.extend(seg.tolist() if isinstance(seg, np.ndarray) else seg)
+            buffered += take
+            off += take
+            if buffered >= spec.segment_rows:
+                flush()
+    flush()
     return pushed

@@ -80,31 +80,74 @@ def _take(block: Block, idx: np.ndarray) -> Block:
     return {c: v[idx] for c, v in block.items()}
 
 
-def _hash_codes(block: Block, keys: Sequence[str]) -> np.ndarray:
-    """Stable per-row hash over the key columns for partition routing."""
+# ONE stable hash implementation serves both the in-proc exchange and the
+# cross-process mailbox shuffle (shuffle.py): Python's builtin hash() is
+# randomized per process (PYTHONHASHSEED), so two leaf servers would route
+# the same key to DIFFERENT partitions — everything hashes deterministically.
+
+_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
+_HASH_MULT = np.uint64(1000003)
+
+
+def _stable_obj_hash(v) -> int:
+    import zlib
+    if v is None:
+        return int(_NULL_HASH)
+    if isinstance(v, str):
+        return zlib.crc32(v.encode("utf-8"))
+    if isinstance(v, (bytes, bytearray)):
+        return zlib.crc32(bytes(v))
+    if isinstance(v, (bool, np.bool_)):
+        return int(v)
+    if isinstance(v, (int, np.integer, float, np.floating)):
+        f = float(v)
+        if f != f:  # NaN
+            return int(_NULL_HASH)
+        if f == 0.0:
+            f = 0.0  # collapse -0.0
+        return int(np.float64(f).view(np.uint64))
+    # MV cells (lists) and anything exotic: hash the repr deterministically
+    return zlib.crc32(repr(v).encode("utf-8"))
+
+
+def stable_hash_codes(block: Block, keys: Sequence[str]) -> np.ndarray:
+    """Per-row uint64 hash over key columns, identical in every process.
+
+    Numeric dtypes canonicalize through float64 bits so equal keys hash
+    equally across dtypes (int 3 joining double 3.0 must co-partition; an
+    outer join upstream may have promoted one side to float)."""
     n = _block_rows(block)
     h = np.zeros(n, dtype=np.uint64)
     for k in keys:
         arr = block[k]
         if arr.dtype == object:
-            col = np.fromiter((hash(x) for x in arr), dtype=np.int64, count=n
-                              ).view(np.uint64)
+            col = np.fromiter((_stable_obj_hash(x) for x in arr),
+                              dtype=np.uint64, count=n)
         else:
-            # every numeric dtype canonicalizes through float64 bits so equal keys
-            # hash equally across dtypes (int 3 joining double 3.0 must co-partition;
-            # an outer join upstream may have promoted one side to float)
             f = np.nan_to_num(arr.astype(np.float64), nan=0.0)
-            f = np.where(f == 0.0, 0.0, f)  # collapse -0.0/+0.0 to one bit pattern
+            f = np.where(f == 0.0, 0.0, f)  # collapse -0.0/+0.0
             col = f.view(np.uint64)
-        h = h * np.uint64(1000003) ^ col
+        h = h * _HASH_MULT ^ col
     return h
+
+
+def stable_hash_key(key) -> int:
+    """Deterministic hash of a group-key tuple (same mixing as the columns)."""
+    h = np.uint64(0)
+    for v in key:
+        h = h * _HASH_MULT ^ np.uint64(_stable_obj_hash(v) & 0xFFFFFFFFFFFFFFFF)
+    return int(h)
 
 
 def _partition_block(block: Block, keys: Sequence[str], p: int) -> List[Block]:
     if _block_rows(block) == 0:
         return [block for _ in range(p)]
-    pid = (_hash_codes(block, keys) % np.uint64(p)).astype(np.int64)
+    pid = (stable_hash_codes(block, keys) % np.uint64(p)).astype(np.int64)
     return [_take(block, np.nonzero(pid == i)[0]) for i in range(p)]
+
+
+# cross-process alias used by the mailbox shuffle
+partition_block_stable = _partition_block
 
 
 def _factorize_pair(left: np.ndarray, right: np.ndarray

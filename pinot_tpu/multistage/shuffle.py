@@ -33,7 +33,6 @@ import threading
 import time
 import urllib.request
 import uuid
-import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
@@ -46,8 +45,9 @@ from ..query.reduce import (SegmentResult, _eval_result, _object_array,
 from ..sql.ast import Expr, Function, OrderByItem, to_sql
 from .planner import JoinSpec
 from .runtime import (Block, _block_rows, _concat_blocks, _null_safe_mask,
-                      _take, aggregate_block, hash_join, selection_block,
-                      spec_from_json, spec_to_json)
+                      _take, aggregate_block, hash_join, partition_block_stable,
+                      selection_block, spec_from_json, spec_to_json,
+                      stable_hash_codes, stable_hash_key)
 
 # rows per streamed block frame and frames buffered per mailbox: together they
 # bound each mailbox's in-flight memory (≈ WINDOW_FRAMES * FRAME_ROWS rows)
@@ -71,66 +71,9 @@ class P2PUnavailable(Exception):
 
 
 # ---------------------------------------------------------------------------
-# stable cross-process hashing (partition routing)
+# partition routing (the ONE stable hash lives in runtime.py — in-proc
+# exchange and cross-process mailbox shuffle must route identically)
 # ---------------------------------------------------------------------------
-# Python's builtin hash() is randomized per process (PYTHONHASHSEED), so two
-# leaf servers would route the same key to DIFFERENT partitions. Everything on
-# the wire uses this deterministic hash instead.
-
-_NULL_HASH = np.uint64(0x9E3779B97F4A7C15)
-_MULT = np.uint64(1000003)
-
-
-def _stable_obj_hash(v: Any) -> int:
-    if v is None:
-        return int(_NULL_HASH)
-    if isinstance(v, str):
-        return zlib.crc32(v.encode("utf-8"))
-    if isinstance(v, (bytes, bytearray)):
-        return zlib.crc32(bytes(v))
-    if isinstance(v, (bool, np.bool_)):
-        return int(v)
-    if isinstance(v, (int, np.integer, float, np.floating)):
-        f = float(v)
-        if f != f:  # NaN
-            return int(_NULL_HASH)
-        if f == 0.0:
-            f = 0.0  # collapse -0.0
-        return int(np.float64(f).view(np.uint64))
-    # MV cells (lists) and anything exotic: hash the repr deterministically
-    return zlib.crc32(repr(v).encode("utf-8"))
-
-
-def stable_hash_codes(block: Block, keys: Iterable[str]) -> np.ndarray:
-    """Per-row uint64 hash over key columns, identical in every process."""
-    n = _block_rows(block)
-    h = np.zeros(n, dtype=np.uint64)
-    for k in keys:
-        arr = block[k]
-        if arr.dtype == object:
-            col = np.fromiter((_stable_obj_hash(x) for x in arr),
-                              dtype=np.uint64, count=n)
-        else:
-            f = np.nan_to_num(arr.astype(np.float64), nan=0.0)
-            f = np.where(f == 0.0, 0.0, f)
-            col = f.view(np.uint64)
-        h = h * _MULT ^ col
-    return h
-
-
-def stable_hash_key(key: Tuple) -> int:
-    h = np.uint64(0)
-    for v in key:
-        h = h * _MULT ^ np.uint64(_stable_obj_hash(v) & 0xFFFFFFFFFFFFFFFF)
-    return int(h)
-
-
-def partition_block_stable(block: Block, keys: List[str], p: int) -> List[Block]:
-    if _block_rows(block) == 0:
-        return [block for _ in range(p)]
-    pid = (stable_hash_codes(block, keys) % np.uint64(p)).astype(np.int64)
-    return [_take(block, np.nonzero(pid == i)[0]) for i in range(p)]
-
 
 def partition_groups_stable(result: SegmentResult, p: int) -> List[SegmentResult]:
     """Split a group-by partial's key space into p disjoint partials."""
@@ -709,6 +652,11 @@ def coordinate_join(broker, stmt, num_partitions: int):
     for alias, scan in plan.scans.items():
         leaf_routes[alias] = broker._leaf_routes(scan.table, scan.columns,
                                                  scan.filter)
+        if not leaf_routes[alias]:
+            # an empty/fully-pruned side has no senders, so workers would see
+            # schema-less empty mailboxes; the funnel path handles the empty
+            # relation correctly — fall back
+            raise P2PUnavailable(f"no leaf routes for {scan.table!r}")
     # quota only after EVERY alias routed: a P2PUnavailable fallback to the
     # funnel path must not have charged any table's QPS budget yet
     broker._acquire_scan_quota([s.table for s in plan.scans.values()])

@@ -327,3 +327,51 @@ def test_successor_consuming_segment_inherits_replica_set(tmp_path, events_schem
         by_seq[meta.sequence_number] = set(assignment)
     assert len(by_seq) >= 2  # committed seq 0 + consuming seq 1
     assert by_seq[0] == by_seq[1]
+
+
+def test_batch_ingestion_streams_with_bounded_memory(tmp_path, events_schema):
+    """VERDICT r4 item 7: a job 10x one segment's size must peak at O(segment)
+    runner memory, not O(job) — the streaming two-pass driver cuts and pushes
+    segments incrementally (reference: SegmentIndexCreationDriverImpl's
+    stats-then-write record streaming)."""
+    import tracemalloc
+
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.ingest.readers import reader_for
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path / "cluster"))
+    cfg = TableConfig("events")
+    cluster.create_table(events_schema, cfg)
+
+    n, seg_rows = 40_000, 4_000   # 10 segments per job
+    csv_path = tmp_path / "big.csv"
+    csv_path.write_text("user,country,value,clicks\n" + "".join(
+        f"user_{i % 997},C{i % 13},{i}.25,{i % 51}\n" for i in range(n)))
+
+    # baseline: what materializing ALL rows (the pre-r4 runner) costs
+    tracemalloc.start()
+    reader = reader_for(str(csv_path), None)
+    all_rows = list(reader.rows())
+    reader.close()
+    _, full_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert len(all_rows) == n
+    del all_rows
+
+    spec = BatchIngestionJobSpec(
+        input_paths=[str(csv_path)],
+        table=cfg.table_name_with_type,
+        segment_rows=seg_rows,
+    )
+    tracemalloc.start()
+    pushed = run_batch_ingestion(spec, cluster.controller,
+                                 work_dir=str(tmp_path))
+    _, stream_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    assert len(pushed) == 10
+    res = cluster.query("SELECT COUNT(*), MAX(clicks) FROM events")
+    assert res.rows[0] == [n, 50]
+    # O(segment), not O(job): the streaming run must peak well below the cost
+    # of materializing the whole input (10 segments' worth) at once
+    assert stream_peak < 0.55 * full_peak, (stream_peak, full_peak)
