@@ -73,11 +73,17 @@ class DictRecordReader(RecordReader):
         return iter(self.records)
 
 
+def _avro_reader(path: str) -> RecordReader:
+    from .avro import AvroRecordReader   # lazy: avro codec loads on demand
+    return AvroRecordReader(path)
+
+
 _READERS: Dict[str, Callable[[str], RecordReader]] = {
     "csv": CsvRecordReader,
     "json": JsonLineRecordReader,
     "jsonl": JsonLineRecordReader,
     "parquet": ParquetRecordReader,
+    "avro": _avro_reader,
 }
 
 

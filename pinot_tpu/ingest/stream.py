@@ -155,9 +155,17 @@ def passthrough_decoder(value: Any) -> Dict[str, Any]:
     return value
 
 
+def avro_decoder(value: Any) -> Dict[str, Any]:
+    """Confluent-framed avro message -> row dict (schema id resolved against
+    the process-local registry; see ingest/avro.py)."""
+    from .avro import confluent_avro_decoder   # lazy
+    return confluent_avro_decoder(value)
+
+
 _DECODERS: Dict[str, Callable[[Any], Dict[str, Any]]] = {
     "json": json_decoder,
     "dict": passthrough_decoder,
+    "avro": avro_decoder,
 }
 
 _FACTORIES: Dict[str, Callable[[str], StreamConsumerFactory]] = {
