@@ -12,7 +12,7 @@ import os
 import shutil
 import tarfile
 import threading
-from typing import Dict, List, Type
+from typing import Callable, Dict, List
 
 
 class DeepStoreFS:
@@ -158,11 +158,20 @@ class MemDeepStore(DeepStoreFS):
         return sorted(names)
 
 
-_FS_REGISTRY: Dict[str, Type[DeepStoreFS]] = {"local": LocalDeepStore,
-                                              "mem": MemDeepStore}
+def _s3_fs(root: str) -> DeepStoreFS:
+    from .s3store import S3DeepStoreFS   # lazy: wire client loads on demand
+    return S3DeepStoreFS(root)
 
 
-def register_fs(scheme: str, cls: Type[DeepStoreFS]) -> None:
+# scheme -> factory callable (a class works too; reference: PinotFSFactory)
+_FS_REGISTRY: Dict[str, Callable[[str], DeepStoreFS]] = {
+    "local": LocalDeepStore,
+    "mem": MemDeepStore,
+    "s3": _s3_fs,
+}
+
+
+def register_fs(scheme: str, cls: Callable[[str], DeepStoreFS]) -> None:
     """Plugin hook (reference: PinotFSFactory.register)."""
     _FS_REGISTRY[scheme] = cls
 

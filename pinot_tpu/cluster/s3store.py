@@ -1,0 +1,451 @@
+"""S3-wire deep store: a PinotFS-analog speaking the S3 REST protocol.
+
+Analog of the reference's cloud deep-store plugin
+(`pinot-plugins/pinot-file-system/pinot-s3/src/main/java/org/apache/pinot/
+plugin/filesystem/S3PinotFS.java`): segments and control blobs live in an
+object store addressed by bucket/key over HTTP — PUT/GET/HEAD/DELETE objects
+plus ListObjectsV2, with AWS Signature V4 request signing (optional; enabled
+when credentials are configured, verified by the stub). The in-repo
+`S3StubServer` proves the wire seam the same way `kafka_wire.py`'s vector
+tests prove the stream seam: the client talks the real protocol, so pointing
+it at actual S3/minio is a config change, not a code change.
+
+Spec: `s3://bucket/prefix?endpoint=http://host:port[&accessKey=..&secretKey=..
+&region=..]` (the endpoint is required — this build has zero egress, so there
+is no default AWS endpoint to fall back to).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .deepstore import DeepStoreFS
+
+_EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# AWS Signature Version 4 (public spec; the subset S3 object ops need)
+# ---------------------------------------------------------------------------
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_signature(secret_key: str, region: str, amz_date: str,
+                    string_to_sign: str) -> str:
+    date = amz_date[:8]
+    k = _hmac(("AWS4" + secret_key).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, "s3")
+    k = _hmac(k, "aws4_request")
+    return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+
+def sigv4_canonical(method: str, path: str, query: str, host: str,
+                    amz_date: str, payload_sha: str) -> Tuple[str, str]:
+    """(canonical request, signed headers). Signed header set is fixed:
+    host;x-amz-content-sha256;x-amz-date — both sides agree by construction.
+
+    `path` is the ON-WIRE (already percent-encoded) request path and is used
+    VERBATIM: real S3 canonicalizes the once-encoded URI, so re-quoting here
+    would turn '%20' into '%2520' and 403 against S3/minio for any key
+    containing a space or special character."""
+    cq = "&".join(sorted(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in urllib.parse.parse_qsl(query, keep_blank_values=True)))
+    signed = "host;x-amz-content-sha256;x-amz-date"
+    canonical = "\n".join([
+        method,
+        path,
+        cq,
+        f"host:{host}\nx-amz-content-sha256:{payload_sha}\n"
+        f"x-amz-date:{amz_date}\n",
+        signed,
+        payload_sha,
+    ])
+    return canonical, signed
+
+
+def sigv4_string_to_sign(canonical: str, amz_date: str, region: str) -> str:
+    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    return "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                      hashlib.sha256(canonical.encode()).hexdigest()])
+
+
+def sign_request(method: str, url: str, payload: bytes, access_key: str,
+                 secret_key: str, region: str,
+                 amz_date: Optional[str] = None) -> Dict[str, str]:
+    """Headers for a sigv4-signed S3 request (spec: Authorization header
+    form). `amz_date` is injectable for golden tests."""
+    parsed = urllib.parse.urlparse(url)
+    if amz_date is None:
+        amz_date = datetime.datetime.now(datetime.timezone.utc
+                                         ).strftime("%Y%m%dT%H%M%SZ")
+    payload_sha = hashlib.sha256(payload or b"").hexdigest()
+    canonical, signed = sigv4_canonical(method, parsed.path, parsed.query,
+                                        parsed.netloc, amz_date, payload_sha)
+    sts = sigv4_string_to_sign(canonical, amz_date, region)
+    sig = sigv4_signature(secret_key, region, amz_date, sts)
+    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_sha,
+        "Authorization": (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+                          f"SignedHeaders={signed}, Signature={sig}"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# client: the deep-store FS
+# ---------------------------------------------------------------------------
+
+class S3Error(OSError):
+    def __init__(self, status: int, code: str, message: str = ""):
+        super().__init__(f"S3 {status} {code}: {message}")
+        self.status = status
+        self.code = code
+
+
+class S3DeepStoreFS(DeepStoreFS):
+    """Bytes-by-URI against an S3 endpoint (same shape as MemDeepStore: no
+    rename — move() is the base class's copy+delete, exactly like
+    S3PinotFS.move doing copyObject+delete)."""
+
+    scheme = "s3"
+
+    def __init__(self, root: str):
+        base, _, query = root.partition("?")
+        params = dict(urllib.parse.parse_qsl(query))
+        self.endpoint = params.get("endpoint", "").rstrip("/")
+        if not self.endpoint:
+            raise ValueError(
+                "s3 deep store requires ?endpoint=http://host:port "
+                "(no default AWS endpoint in this environment)")
+        self.bucket, _, prefix = base.strip("/").partition("/")
+        if not self.bucket:
+            raise ValueError("s3 spec needs a bucket: s3://bucket[/prefix]?...")
+        self.prefix = prefix.strip("/")
+        self.access_key = params.get("accessKey", "")
+        self.secret_key = params.get("secretKey", "")
+        self.region = params.get("region", "us-east-1")
+        self.timeout_s = float(params.get("timeoutSec", 30.0))
+        # ListObjectsV2 page size (real S3 caps at 1000; lowered in tests to
+        # exercise the pagination loop)
+        self.page_size = int(params.get("pageSize", 1000))
+
+    # -- wire ---------------------------------------------------------------
+    def _key(self, uri: str) -> str:
+        key = uri.strip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _url(self, key: str, query: str = "") -> str:
+        path = f"/{self.bucket}/{urllib.parse.quote(key)}" if key \
+            else f"/{self.bucket}"
+        return f"{self.endpoint}{path}" + (f"?{query}" if query else "")
+
+    def _call(self, method: str, url: str, body: Optional[bytes] = None
+              ) -> Tuple[int, bytes]:
+        headers = {}
+        if self.access_key:
+            headers = sign_request(method, url, body or b"", self.access_key,
+                                   self.secret_key, self.region)
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            code = "Unknown"
+            if b"<Code>" in payload:
+                code = payload.split(b"<Code>")[1].split(b"</Code>")[0].decode()
+            raise S3Error(e.code, code, payload[:200].decode(errors="replace")
+                          ) from None
+
+    # -- DeepStoreFS --------------------------------------------------------
+    def upload(self, local_path: str, uri: str) -> None:
+        with open(local_path, "rb") as f:
+            self.put_bytes(f.read(), uri)
+
+    def put_bytes(self, data: bytes, uri: str) -> None:
+        self._call("PUT", self._url(self._key(uri)), data)
+
+    def download(self, uri: str, local_path: str) -> None:
+        data = self.get_bytes(uri)
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(data)
+
+    def get_bytes(self, uri: str) -> bytes:
+        try:
+            _, data = self._call("GET", self._url(self._key(uri)))
+            return data
+        except S3Error as e:
+            if e.status == 404:
+                raise FileNotFoundError(f"s3://{self.bucket}/{self._key(uri)}"
+                                        ) from None
+            raise
+
+    def delete(self, uri: str) -> None:
+        key = self._key(uri)
+        # S3 has no recursive delete: enumerate the prefix like S3PinotFS.
+        # Per-key failures are COLLECTED and re-raised — a swallowed 503 here
+        # would report success while orphaning blobs the metadata believes
+        # are gone.
+        failures: List[str] = []
+        for k in self._list_keys(key + "/"):
+            try:
+                self._call("DELETE", self._url(k))
+            except S3Error as e:
+                if e.status != 404:
+                    failures.append(f"{k}: {e}")
+        try:
+            self._call("DELETE", self._url(key))
+        except S3Error as e:
+            if e.status != 404:
+                raise
+        if failures:
+            raise S3Error(500, "IncompleteDelete",
+                          f"{len(failures)} objects not deleted "
+                          f"({failures[0]} ...)")
+
+    def exists(self, uri: str) -> bool:
+        key = self._key(uri)
+        try:
+            self._call("HEAD", self._url(key))
+            return True
+        except S3Error as e:
+            if e.status != 404:
+                raise
+        return bool(self._list_keys(key + "/", limit=1))
+
+    def _list_page(self, prefix: str, delimiter: str, token: str
+                   ) -> Tuple[List[str], List[str], str]:
+        """One ListObjectsV2 page -> (keys, common prefixes, next token)."""
+        params = {"list-type": "2", "prefix": prefix,
+                  "max-keys": str(self.page_size)}
+        if delimiter:
+            params["delimiter"] = delimiter
+        if token:
+            params["continuation-token"] = token
+        _, payload = self._call("GET", self._url("",
+                                                 urllib.parse.urlencode(params)))
+        keys = [seg.split(b"</Key>")[0].decode()
+                for seg in payload.split(b"<Key>")[1:]]
+        prefixes = [seg.split(b"</Prefix>")[0].decode()
+                    for seg in payload.split(b"<CommonPrefixes><Prefix>")[1:]]
+        nxt = ""
+        if b"<IsTruncated>true</IsTruncated>" in payload:
+            nxt = payload.split(b"<NextContinuationToken>")[1].split(
+                b"</NextContinuationToken>")[0].decode()
+        return keys, prefixes, nxt
+
+    def _list_keys(self, prefix: str, delimiter: str = "",
+                   limit: int = 1 << 31) -> List[str]:
+        """Full listing across pagination (real S3 caps a page at 1000 —
+        IsTruncated/continuation-token MUST be followed or recursive delete
+        and listdir silently see a partial view)."""
+        keys: List[str] = []
+        token = ""
+        while True:
+            page, _, token = self._list_page(prefix, delimiter, token)
+            keys.extend(page)
+            if not token or len(keys) >= limit:
+                return keys[:limit] if limit < (1 << 31) else keys
+
+    def listdir(self, uri: str) -> List[str]:
+        key = self._key(uri)
+        prefix = key.rstrip("/") + "/" if key else (
+            f"{self.prefix}/" if self.prefix else "")
+        names: set = set()
+        token = ""
+        while True:
+            page, prefixes, token = self._list_page(prefix, "/", token)
+            names |= {k[len(prefix):] for k in page}
+            names |= {p[len(prefix):].rstrip("/") for p in prefixes}
+            if not token:
+                break
+        return sorted(n for n in names if n)
+
+
+# ---------------------------------------------------------------------------
+# in-repo stub server (the wire-seam proof; reference analog: S3 itself)
+# ---------------------------------------------------------------------------
+
+class S3StubServer:
+    """Minimal S3 REST endpoint: object PUT/GET/HEAD/DELETE + ListObjectsV2,
+    sigv4 verification when credentials are set, and an `outage` switch for
+    chaos tests (every request 503s, like an unreachable region)."""
+
+    def __init__(self, bucket: str = "pinot", access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.objects: Dict[str, bytes] = {}
+        self.outage = False
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _xml_error(self, status: int, code: str) -> None:
+                body = (f'<?xml version="1.0"?><Error><Code>{code}</Code>'
+                        f"</Error>").encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/xml")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _ok(self, body: bytes = b"",
+                    ctype: str = "application/octet-stream",
+                    head_only: bool = False) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("ETag", '"%s"' % hashlib.md5(body).hexdigest())
+                self.end_headers()
+                if not head_only and self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _authorized(self, payload: bytes) -> bool:
+                if not stub.access_key:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                amz_date = self.headers.get("x-amz-date", "")
+                sha = self.headers.get("x-amz-content-sha256", "")
+                if not auth.startswith("AWS4-HMAC-SHA256") or not amz_date:
+                    return False
+                if hashlib.sha256(payload).hexdigest() != sha:
+                    return False
+                parsed = urllib.parse.urlparse(self.path)
+                canonical, _ = sigv4_canonical(
+                    self.command, parsed.path, parsed.query,
+                    self.headers.get("Host", ""), amz_date, sha)
+                sts = sigv4_string_to_sign(canonical, amz_date, stub.region)
+                want = sigv4_signature(stub.secret_key, stub.region, amz_date,
+                                       sts)
+                got = auth.rsplit("Signature=", 1)[-1].strip()
+                cred = auth.split("Credential=", 1)[-1].split("/", 1)[0]
+                return cred == stub.access_key and hmac.compare_digest(want,
+                                                                       got)
+
+            def _dispatch(self) -> None:
+                if stub.outage:
+                    return self._xml_error(503, "SlowDown")
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                if parts[0] != stub.bucket:
+                    return self._xml_error(404, "NoSuchBucket")
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = self.rfile.read(length) if length else b""
+                if not self._authorized(payload):
+                    return self._xml_error(403, "SignatureDoesNotMatch")
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+
+                if self.command == "PUT":
+                    with stub._lock:
+                        stub.objects[key] = payload
+                    return self._ok()
+                if self.command in ("GET", "HEAD") and not key \
+                        and params.get("list-type") == "2":
+                    return self._ok(stub._list_xml(params),
+                                    ctype="application/xml")
+                if self.command in ("GET", "HEAD"):
+                    with stub._lock:
+                        data = stub.objects.get(key)
+                    if data is None:
+                        return self._xml_error(404, "NoSuchKey")
+                    return self._ok(data, head_only=self.command == "HEAD")
+                if self.command == "DELETE":
+                    with stub._lock:
+                        stub.objects.pop(key, None)
+                    body = b""
+                    self.send_response(204)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return None
+                return self._xml_error(405, "MethodNotAllowed")
+
+            do_GET = do_PUT = do_DELETE = do_HEAD = \
+                lambda self: self._dispatch()
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.url = f"http://{host}:{self._server.server_address[1]}"
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="s3-stub")
+        self._thread.start()
+
+    def _list_xml(self, params: Dict[str, str]) -> bytes:
+        """ListObjectsV2 with real-S3 pagination semantics: max-keys caps the
+        page (hard cap 1000 like S3), IsTruncated + NextContinuationToken
+        mark more pages, continuation-token resumes strictly after the marked
+        item — clients that ignore truncation see a partial view, exactly the
+        bug the pagination loop in S3DeepStoreFS exists to prevent."""
+        prefix = params.get("prefix", "")
+        delimiter = params.get("delimiter", "")
+        max_keys = min(int(params.get("max-keys", "1000")), 1000)
+        token = params.get("continuation-token", "")
+        with self._lock:
+            keys = sorted(k for k in self.objects if k.startswith(prefix))
+            sizes = {k: len(self.objects[k]) for k in keys}
+        # one sorted item stream of content keys + collapsed common prefixes
+        items: List[Tuple[str, bool]] = []    # (marker, is_common_prefix)
+        seen = set()
+        for k in keys:
+            if delimiter:
+                rest = k[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    if cp not in seen:
+                        seen.add(cp)
+                        items.append((cp, True))
+                    continue
+            items.append((k, False))
+        after = [it for it in items if it[0] > token]
+        page, more = after[:max_keys], after[max_keys:]
+        xml = ['<?xml version="1.0"?><ListBucketResult>',
+               f"<IsTruncated>{'true' if more else 'false'}</IsTruncated>"]
+        if more:
+            xml.append(f"<NextContinuationToken>{page[-1][0]}"
+                       f"</NextContinuationToken>")
+        for marker, is_cp in page:
+            if is_cp:
+                xml.append(f"<CommonPrefixes><Prefix>{marker}</Prefix>"
+                           f"</CommonPrefixes>")
+            else:
+                xml.append(f"<Contents><Key>{marker}</Key>"
+                           f"<Size>{sizes.get(marker, 0)}</Size></Contents>")
+        xml.append("</ListBucketResult>")
+        return "".join(xml).encode()
+
+    def spec(self, prefix: str = "") -> str:
+        """The s3:// deep-store spec pointing at this stub."""
+        auth = (f"&accessKey={self.access_key}&secretKey={self.secret_key}"
+                f"&region={self.region}" if self.access_key else "")
+        p = f"/{prefix}" if prefix else ""
+        return f"s3://{self.bucket}{p}?endpoint={self.url}{auth}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -501,16 +501,21 @@ class AvroRecordReader:
 
 class LocalSchemaRegistry:
     """In-process schema registry (the schema-registry-server analog for
-    kafkalite streams): id -> parsed schema."""
+    kafkalite streams): id -> parsed schema. Thread-safe: concurrent
+    producers must never be issued the same id."""
 
     def __init__(self):
+        import threading
         self._by_id: Dict[int, Any] = {}
         self._next = 1
+        self._lock = threading.Lock()
 
     def register(self, schema) -> int:
-        sid = self._next
-        self._next += 1
-        self._by_id[sid] = parse_schema(schema)
+        parsed = parse_schema(schema)
+        with self._lock:
+            sid = self._next
+            self._next += 1
+            self._by_id[sid] = parsed
         return sid
 
     def get(self, schema_id: int):

@@ -29,7 +29,9 @@ def test_create_fs_factory(tmp_path):
     mem = create_fs("mem://")
     assert isinstance(mem, MemDeepStore)
     with pytest.raises(ValueError):
-        create_fs("s3://bucket")  # not registered in this build
+        create_fs("gs://bucket")  # not registered in this build
+    with pytest.raises(ValueError):
+        create_fs("s3://bucket")  # s3 IS registered but needs ?endpoint=
 
 
 def test_register_custom_fs():
