@@ -54,10 +54,36 @@ STAR_QUERY = ("SELECT lo_region, SUM(lo_revenue) FROM lineorder "
               "GROUP BY lo_region ORDER BY lo_region LIMIT 10")
 
 
+# TPC-H Q1-shape: group-by with per-group COUNT DISTINCT (HLL) on device —
+# BASELINE config 5 as written (the grouped presence-matrix kernel path)
+HLL_GROUP_QUERY = ("SELECT lo_region, COUNT(*), SUM(lo_revenue), "
+                   "DISTINCTCOUNTHLL(lo_orderdate) FROM lineorder "
+                   "WHERE lo_quantity < 25 GROUP BY lo_region "
+                   "ORDER BY lo_region LIMIT 10")
+
+# >MATMUL_KEY_CAP keys: exercises the segment_sum scatter group-by path
+# (engine/kernels.py:52,322 — the design doc's economics flip point)
+HIGH_CARD_QUERY = ("SELECT lo_suppkey, SUM(lo_revenue), COUNT(*) "
+                   "FROM lineorder GROUP BY lo_suppkey LIMIT 100000")
+
+THETA_QUERY = ("SELECT DISTINCTCOUNTTHETASKETCH(lo_orderdate) FROM lineorder "
+               "WHERE lo_quantity < 25")
+
+# BASELINE config 3 as designed: a LARGE record table (high-cardinality split
+# dims) runs the STACKED DEVICE star path — record tables stack like base
+# segments, split-dim LUT fused into the kernel mask
+STAR_HC_QUERY = ("SELECT lo_orderdate, SUM(lo_revenue) FROM lineorder "
+                 "WHERE lo_discount BETWEEN 1 AND 3 GROUP BY lo_orderdate "
+                 "LIMIT 100000")
+
+HIGH_CARD_SUPPKEYS = 20_000
+
+
 def ssb_schema():
     from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
     return Schema("lineorder", [
         dimension("lo_region", DataType.STRING),
+        dimension("lo_suppkey", DataType.INT),
         date_time("lo_orderdate", DataType.INT),
         metric("lo_quantity", DataType.INT),
         metric("lo_extendedprice", DataType.DOUBLE),
@@ -72,6 +98,7 @@ def make_columns(n: int):
     region_ids = rng.integers(0, 5, n)
     return {
         "lo_region": np.array(regions, dtype=object)[region_ids],
+        "lo_suppkey": rng.integers(0, HIGH_CARD_SUPPKEYS, n).astype(np.int32),
         "lo_orderdate": (19920101 + rng.integers(0, 7, n) * 10000
                          + rng.integers(1, 13, n) * 100
                          + rng.integers(1, 29, n)).astype(np.int32),
@@ -82,12 +109,14 @@ def make_columns(n: int):
     }
 
 
-def build_or_load_segments(schema, cols, star_tree=False, rows=None, tag=None):
+def build_or_load_segments(schema, cols, star_tree=False, rows=None, tag=None,
+                           star_hc=False):
     from pinot_tpu.segment import (SegmentGeneratorConfig, StarTreeIndexConfig,
                                    load_segment)
     from pinot_tpu.segment.writer import build_aligned_segments
     rows = rows if rows is not None else ROWS
-    tag = tag or f"r{rows}_s{SEGMENTS}_v1{'_st' if star_tree else ''}"
+    tag = tag or (f"r{rows}_s{SEGMENTS}_v2"
+                  f"{'_st' if star_tree else ''}{'_sthc' if star_hc else ''}")
     seg_root = os.path.join(CACHE, tag)
     marker = os.path.join(seg_root, "DONE")
     if not os.path.exists(marker):
@@ -97,6 +126,13 @@ def build_or_load_segments(schema, cols, star_tree=False, rows=None, tag=None):
             config = SegmentGeneratorConfig(star_tree_configs=[
                 StarTreeIndexConfig(
                     dimensions_split_order=["lo_region", "lo_discount"],
+                    function_column_pairs=["SUM__lo_revenue"])])
+        elif star_hc:
+            # high-cardinality split dims -> 1e5+ combined records: the
+            # stacked DEVICE star path (small trees keep the host path)
+            config = SegmentGeneratorConfig(star_tree_configs=[
+                StarTreeIndexConfig(
+                    dimensions_split_order=["lo_orderdate", "lo_discount"],
                     function_column_pairs=["SUM__lo_revenue"])])
         build_aligned_segments(schema, cols, seg_root, "lineorder", SEGMENTS,
                                config=config)
@@ -122,6 +158,128 @@ def numpy_baseline(cols, iters=3) -> float:
         result = run()
     dt = (time.perf_counter() - t0) / iters
     return len(od) / dt, result
+
+
+def ingest_bench(rows: int = 50_000):
+    """Realtime consumption speed: kafkalite BINARY frames through
+    fetch->decode->MutableSegment.index — the full per-event realtime path —
+    vs a vectorized numpy column-append of the same rows (reference:
+    pinot-perf BenchmarkRealtimeConsumptionSpeed.java)."""
+    import json as _json
+
+    from pinot_tpu.ingest.kafkalite import (KafkaLiteConsumer, LogBrokerClient,
+                                            LogBrokerServer)
+    from pinot_tpu.schema import (DataType, Schema, date_time, dimension,
+                                  metric)
+    from pinot_tpu.segment.mutable import MutableSegment
+
+    schema = Schema("events", [
+        dimension("site", DataType.STRING), metric("clicks", DataType.LONG),
+        metric("cost", DataType.DOUBLE), date_time("ts", DataType.LONG)])
+    rng = np.random.default_rng(7)
+    raws = [{"site": f"s{int(i) % 50}.com", "clicks": int(c), "cost": float(x),
+             "ts": 1700000000000 + j}
+            for j, (i, c, x) in enumerate(zip(
+                rng.integers(0, 50, rows), rng.integers(1, 9, rows),
+                np.round(rng.uniform(0.1, 9.9, rows), 3)))]
+    srv = LogBrokerServer()
+    try:
+        client = LogBrokerClient(srv.bootstrap)
+        client.create_topic("bench_ingest", 1)
+        for r in raws:
+            client.produce("bench_ingest", _json.dumps(r))
+        consumer = KafkaLiteConsumer(srv.bootstrap, "bench_ingest", 0)
+        seg = MutableSegment("events__0__0__b", schema)
+        t0 = time.perf_counter()
+        off = 0
+        total_clicks = 0
+        while off < rows:
+            batch = consumer.fetch(off, 8192)
+            for msg in batch.messages:
+                row = _json.loads(msg.value)
+                seg.index(row)
+                total_clicks += row["clicks"]
+            off = batch.next_offset
+        dt = time.perf_counter() - t0
+        consumer.close()
+        if seg.num_docs != rows or total_clicks != sum(
+                r["clicks"] for r in raws):
+            print(f"WARNING: ingest count mismatch {seg.num_docs} != {rows}",
+                  file=sys.stderr)
+    finally:
+        srv.stop()
+    # numpy append baseline: same rows into plain column arrays, no indexes
+    t0 = time.perf_counter()
+    cols = {k: [] for k in ("site", "clicks", "cost", "ts")}
+    for r in raws:
+        for k in cols:
+            cols[k].append(r[k])
+    _ = {k: np.asarray(v) for k, v in cols.items()}
+    np_dt = time.perf_counter() - t0
+    return rows / dt, rows / np_dt
+
+
+def e2e_bench(n_clients: int = 8, queries_per_client: int = 25):
+    """End-to-end QPS/p50 through a REAL ProcessCluster broker over HTTP —
+    wire encode/decode, scheduler, scatter/gather included (reference:
+    README.md:56 'tens of thousands of queries per second'). Server processes
+    run the CPU engine (the TPU library rate is the headline metric; this
+    measures the serving stack around it)."""
+    import tempfile
+    import threading
+
+    from pinot_tpu.cluster.process import ProcessCluster
+    from pinot_tpu.segment.writer import SegmentBuilder
+    from pinot_tpu.table import TableConfig
+
+    schema = ssb_schema()
+    n = 100_000
+    cols = make_columns(n)
+    work = tempfile.mkdtemp(prefix="pinot_bench_e2e_")
+    sqls = [QUERY, GROUP_QUERY,
+            "SELECT COUNT(*) FROM lineorder WHERE lo_quantity < 10 LIMIT 5"]
+    with ProcessCluster(num_servers=2, work_dir=work) as cluster:
+        cluster.controller.add_schema(schema)
+        cfg = TableConfig("lineorder")
+        cluster.controller.add_table(cfg)
+        b = SegmentBuilder(schema)
+        for i in range(4):
+            part = {k: v[i * n // 4:(i + 1) * n // 4] for k, v in cols.items()}
+            cluster.controller.upload_segment(
+                cfg.table_name_with_type,
+                b.build(part, os.path.join(work, "b"), f"lineorder_{i}"))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            r = cluster.query("SELECT COUNT(*) FROM lineorder")[
+                "resultTable"]["rows"]
+            if r and r[0][0] == n:
+                break
+            time.sleep(0.2)
+        for q in sqls:     # warm every shape through every server
+            cluster.query(q)
+        lat: list = []
+        lock = threading.Lock()
+
+        def client(ci: int) -> None:
+            mine = []
+            for qi in range(queries_per_client):
+                q = sqls[(ci + qi) % len(sqls)]
+                t0 = time.perf_counter()
+                cluster.query(q)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    return (n_clients * queries_per_client) / dt, \
+        float(np.median(lat)) * 1000
 
 
 def relay_floor_ms(iters=7) -> float:
@@ -179,6 +337,33 @@ def main():
     star_p50, star_res = p50_latency(STAR_QUERY, segs=star_segments)
     star_rate, _ = pipelined_rate(STAR_QUERY, segs=star_segments)
 
+    # r4 configs: grouped HLL, >cap scatter group-by, device theta
+    for q in (HLL_GROUP_QUERY, HIGH_CARD_QUERY, THETA_QUERY):
+        mesh_exec.execute(segments, q)
+        mesh_exec.execute(segments, q)
+    hllg_rate, hllg_res = pipelined_rate(HLL_GROUP_QUERY)
+    hc_rate, hc_res = pipelined_rate(HIGH_CARD_QUERY, iters=max(4, ITERS // 4))
+    theta_rate, theta_res = pipelined_rate(THETA_QUERY)
+
+    # r4: stacked-device star path over a LARGE record table
+    star_hc_segments = build_or_load_segments(schema, cols, star_hc=True)
+    from pinot_tpu.parallel.combine import StarSetPlan
+    from pinot_tpu.query.context import compile_query as _cq
+    star_hc_on_device = isinstance(
+        mesh_exec._plan_star_device(_cq(STAR_HC_QUERY, schema),
+                                    star_hc_segments), StarSetPlan)
+    mesh_exec.execute(star_hc_segments, STAR_HC_QUERY)
+    mesh_exec.execute(star_hc_segments, STAR_HC_QUERY)
+    star_hc_rate, star_hc_res = pipelined_rate(STAR_HC_QUERY,
+                                               segs=star_hc_segments)
+    # host star path on the same trees, for the device-vs-host comparison
+    from pinot_tpu.query.executor import ServerQueryExecutor as _SQE
+    host_exec = _SQE(use_device=False)
+    host_exec.execute(star_hc_segments, STAR_HC_QUERY)
+    t0 = time.perf_counter()
+    host_exec.execute(star_hc_segments, STAR_HC_QUERY)
+    star_hc_host_rate = ROWS / (time.perf_counter() - t0)
+
     # single-query latency at serving-sized row counts (1M rows after pruning)
     small_rows = 1024 * 1024
     small_segs = build_or_load_segments(schema, make_columns(small_rows),
@@ -207,6 +392,53 @@ def main():
     if abs(hll_res.rows[0][0] - exact) > 0.05 * exact:
         print(f"WARNING: HLL estimate {hll_res.rows[0][0]} vs exact {exact}",
               file=sys.stderr)
+    if abs(theta_res.rows[0][0] - exact) > 0.05 * exact:
+        print(f"WARNING: theta estimate {theta_res.rows[0][0]} vs {exact}",
+              file=sys.stderr)
+    # grouped-HLL differential: per-region exact distinct within theta/HLL error
+    qmask = cols["lo_quantity"] < 25
+    for region, got_cnt, got_sum, got_hll in hllg_res.rows:
+        m = qmask & (cols["lo_region"] == region)
+        want_d = len(np.unique(cols["lo_orderdate"][m]))
+        if int(m.sum()) != got_cnt or abs(got_hll - want_d) > 0.05 * want_d:
+            print(f"WARNING: hll-groupby mismatch {region}: "
+                  f"cnt {got_cnt}/{int(m.sum())} hll {got_hll}/{want_d}",
+                  file=sys.stderr)
+    # high-card group-by differential: group count + sampled sums + count total
+    hc_groups = {r[0]: (r[1], r[2]) for r in hc_res.rows}
+    if len(hc_groups) != len(np.unique(cols["lo_suppkey"])):
+        print(f"WARNING: high-card group count {len(hc_groups)}", file=sys.stderr)
+    if sum(c for _, c in hc_groups.values()) != ROWS:
+        print("WARNING: high-card counts do not sum to ROWS", file=sys.stderr)
+    for sk in (0, 777, HIGH_CARD_SUPPKEYS - 1):
+        m = cols["lo_suppkey"] == sk
+        want = float(np.sum(cols["lo_revenue"][m]))
+        got = hc_groups.get(sk, (0.0, 0))
+        if got[1] != int(m.sum()) or abs(got[0] - want) > 2e-3 * max(1.0, abs(want)):
+            print(f"WARNING: high-card mismatch suppkey={sk}: {got} vs "
+                  f"({want},{int(m.sum())})", file=sys.stderr)
+    # stacked-device star differential: sampled dates vs raw columns
+    dmask = (cols["lo_discount"] >= 1) & (cols["lo_discount"] <= 3)
+    star_hc_groups = {r[0]: r[1] for r in star_hc_res.rows}
+    dates = np.unique(cols["lo_orderdate"])
+    for d in (dates[0], dates[len(dates) // 2], dates[-1]):
+        want = float(np.sum(cols["lo_revenue"][dmask
+                                               & (cols["lo_orderdate"] == d)]))
+        got = star_hc_groups.get(int(d), 0.0)
+        if abs(got - want) > 2e-3 * max(1.0, abs(want)):
+            print(f"WARNING: star-hc mismatch {d}: {got} vs {want}",
+                  file=sys.stderr)
+
+    # realtime ingest + end-to-end serving stack
+    ingest_rate, ingest_np_rate = ingest_bench()
+    e2e_qps, e2e_p50 = e2e_bench()
+    # theta numpy baseline: filter + bulk sketch build, both timed — the
+    # device query it is compared against pays for the filter too
+    from pinot_tpu.query.sketches import ThetaSketch
+    t0 = time.perf_counter()
+    ThetaSketch.from_values(
+        cols["lo_orderdate"][cols["lo_quantity"] < 25])
+    theta_np_rate = ROWS / (time.perf_counter() - t0)
     # star-tree differential: same group-by truth, filter lo_discount in [1,3]
     smask = (cols["lo_discount"] >= 1) & (cols["lo_discount"] <= 3)
     for region, got_sum in star_res.rows:
@@ -230,8 +462,21 @@ def main():
             "groupby_p50_latency_ms": round(grp_p50, 3),
             "hll_rows_per_sec": round(hll_rate / n_dev, 1),
             "hll_vs_numpy": round(hll_rate / n_dev / np_rows_per_sec, 3),
+            "hll_groupby_rows_per_sec": round(hllg_rate / n_dev, 1),
+            "high_card_groupby_rows_per_sec": round(hc_rate / n_dev, 1),
+            "high_card_groups": len(hc_groups),
+            "theta_rows_per_sec": round(theta_rate / n_dev, 1),
+            "theta_vs_numpy": round(theta_rate / n_dev / theta_np_rate, 3),
             "startree_rows_per_sec": round(star_rate / n_dev, 1),
             "startree_p50_latency_ms": round(star_p50, 3),
+            "startree_device_rows_per_sec": round(star_hc_rate / n_dev, 1),
+            "startree_device_on_device": star_hc_on_device,
+            "startree_device_vs_host": round(star_hc_rate / n_dev
+                                             / max(star_hc_host_rate, 1.0), 3),
+            "ingest_rows_per_sec": round(ingest_rate, 1),
+            "ingest_vs_numpy_append": round(ingest_rate / ingest_np_rate, 3),
+            "e2e_qps": round(e2e_qps, 1),
+            "e2e_p50_ms": round(e2e_p50, 3),
             "numpy_single_thread_rows_per_sec": round(np_rows_per_sec, 1),
             "backend": jax.default_backend(),
         },

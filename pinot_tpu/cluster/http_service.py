@@ -164,6 +164,16 @@ class HttpService:
                     finally:
                         from ..auth import set_current_principal
                         set_current_principal(None)
+                if (method, head) in service._stream_body:
+                    # safety net for EVERY response path (success, handler
+                    # error, auth failure): consume the rest of the request
+                    # body before responding — closing with unread bytes in
+                    # the receive buffer RSTs the sender (drain is idempotent;
+                    # the remainder is bounded by the sender's partition)
+                    try:
+                        body.drain()
+                    except Exception:
+                        pass
                 if isinstance(data, str):
                     # a str body is a non-streaming response that forgot to
                     # encode — chunk-iterating it per character would garble
@@ -216,7 +226,14 @@ class HttpService:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # stdlib default backlog is 5: a mailbox shuffle's burst of
+            # parallel partition streams (leaf senders x partitions x sides)
+            # overflows it under load and the kernel RSTs new connections —
+            # surfacing as spurious "connection reset by peer" query failures
+            request_queue_size = 128
+
+        self._server = _Server((host, port), Handler)
         self._server.daemon_threads = True
         self.host = host
         self.port = self._server.server_address[1]

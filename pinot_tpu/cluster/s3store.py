@@ -84,14 +84,17 @@ def sigv4_string_to_sign(canonical: str, amz_date: str, region: str) -> str:
 
 def sign_request(method: str, url: str, payload: bytes, access_key: str,
                  secret_key: str, region: str,
-                 amz_date: Optional[str] = None) -> Dict[str, str]:
+                 amz_date: Optional[str] = None,
+                 payload_sha: Optional[str] = None) -> Dict[str, str]:
     """Headers for a sigv4-signed S3 request (spec: Authorization header
-    form). `amz_date` is injectable for golden tests."""
+    form). `amz_date` is injectable for golden tests; `payload_sha` lets
+    streaming uploads pre-hash the body without buffering it."""
     parsed = urllib.parse.urlparse(url)
     if amz_date is None:
         amz_date = datetime.datetime.now(datetime.timezone.utc
                                          ).strftime("%Y%m%dT%H%M%SZ")
-    payload_sha = hashlib.sha256(payload or b"").hexdigest()
+    if payload_sha is None:
+        payload_sha = hashlib.sha256(payload or b"").hexdigest()
     canonical, signed = sigv4_canonical(method, parsed.path, parsed.query,
                                         parsed.netloc, amz_date, payload_sha)
     sts = sigv4_string_to_sign(canonical, amz_date, region)
@@ -174,8 +177,36 @@ class S3DeepStoreFS(DeepStoreFS):
 
     # -- DeepStoreFS --------------------------------------------------------
     def upload(self, local_path: str, uri: str) -> None:
+        """STREAMING put: the payload hash is computed in one pass and the
+        body is sent from the open file — a multi-GB segment tar never
+        buffers in memory (LocalDeepStore streams the same way)."""
+        size = os.path.getsize(local_path)
+        sha = hashlib.sha256()
         with open(local_path, "rb") as f:
-            self.put_bytes(f.read(), uri)
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                sha.update(chunk)
+        url = self._url(self._key(uri))
+        headers = {"Content-Length": str(size)}
+        if self.access_key:
+            headers.update(sign_request("PUT", url, b"", self.access_key,
+                                        self.secret_key, self.region,
+                                        payload_sha=sha.hexdigest()))
+        with open(local_path, "rb") as f:
+            req = urllib.request.Request(url, data=f, method="PUT",
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as resp:
+                    resp.read()
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                code = "Unknown"
+                if b"<Code>" in payload:
+                    code = payload.split(b"<Code>")[1].split(
+                        b"</Code>")[0].decode()
+                raise S3Error(e.code, code,
+                              payload[:200].decode(errors="replace")
+                              ) from None
 
     def put_bytes(self, data: bytes, uri: str) -> None:
         self._call("PUT", self._url(self._key(uri)), data)
@@ -240,14 +271,17 @@ class S3DeepStoreFS(DeepStoreFS):
             params["continuation-token"] = token
         _, payload = self._call("GET", self._url("",
                                                  urllib.parse.urlencode(params)))
-        keys = [seg.split(b"</Key>")[0].decode()
+        from xml.sax.saxutils import unescape
+        # real S3 XML-escapes key text (&amp; etc.) — unescape or recursive
+        # delete would target non-existent keys and silently orphan objects
+        keys = [unescape(seg.split(b"</Key>")[0].decode())
                 for seg in payload.split(b"<Key>")[1:]]
-        prefixes = [seg.split(b"</Prefix>")[0].decode()
+        prefixes = [unescape(seg.split(b"</Prefix>")[0].decode())
                     for seg in payload.split(b"<CommonPrefixes><Prefix>")[1:]]
         nxt = ""
         if b"<IsTruncated>true</IsTruncated>" in payload:
-            nxt = payload.split(b"<NextContinuationToken>")[1].split(
-                b"</NextContinuationToken>")[0].decode()
+            nxt = unescape(payload.split(b"<NextContinuationToken>")[1].split(
+                b"</NextContinuationToken>")[0].decode())
         return keys, prefixes, nxt
 
     def _list_keys(self, prefix: str, delimiter: str = "",
@@ -422,19 +456,20 @@ class S3StubServer:
                         items.append((cp, True))
                     continue
             items.append((k, False))
+        from xml.sax.saxutils import escape
         after = [it for it in items if it[0] > token]
         page, more = after[:max_keys], after[max_keys:]
         xml = ['<?xml version="1.0"?><ListBucketResult>',
                f"<IsTruncated>{'true' if more else 'false'}</IsTruncated>"]
         if more:
-            xml.append(f"<NextContinuationToken>{page[-1][0]}"
+            xml.append(f"<NextContinuationToken>{escape(page[-1][0])}"
                        f"</NextContinuationToken>")
         for marker, is_cp in page:
             if is_cp:
-                xml.append(f"<CommonPrefixes><Prefix>{marker}</Prefix>"
+                xml.append(f"<CommonPrefixes><Prefix>{escape(marker)}</Prefix>"
                            f"</CommonPrefixes>")
             else:
-                xml.append(f"<Contents><Key>{marker}</Key>"
+                xml.append(f"<Contents><Key>{escape(marker)}</Key>"
                            f"<Size>{sizes.get(marker, 0)}</Size></Contents>")
         xml.append("</ListBucketResult>")
         return "".join(xml).encode()

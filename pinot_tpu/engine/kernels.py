@@ -52,6 +52,9 @@ _POWER_SUMS = {"sum": 1, "sum2": 2, "sum3": 3, "sum4": 4}
 MATMUL_KEY_CAP = 8192     # one-hot matmul group-by partials (count/sum), MXU-bound
 MINMAX_BCAST_CAP = 1024   # per-key broadcast-reduce min/max, VPU-bound
 DENSE_LUT_MATMUL_CAP = 8192  # scattered-LUT membership via one-hot matmul
+# grouped distinct: presence counts over the (group key x dict id) product space
+# ride the one-hot matmul up to this combined width; above it, segment_sum
+GROUPED_DISTINCT_MATMUL_CAP = 1 << 16
 
 
 @dataclass
@@ -291,6 +294,27 @@ def _make_body(spec: KernelSpec):
             sum_rows, sum_names = [fmask], ["count"]
             minmax = []  # (out name, values, is_min)
             for ai, (agg, outs) in enumerate(spec.aggs):
+                if "distinct" in outs:
+                    # PER-GROUP presence counts [keys, dict ids] (the grouped
+                    # DISTINCTCOUNT/HLL/theta path, BASELINE config 5): one
+                    # combined dense key over the (group, id) product space —
+                    # masked rows ride the overflow band exactly like `key`.
+                    size = spec.distinct_lut_sizes[ai]
+                    col_ids = ids[agg.arg.name].ravel()
+                    comb = key * size + col_ids
+                    total = num_seg * size
+                    if total <= GROUPED_DISTINCT_MATMUL_CAP \
+                            and key.size <= (1 << 24):
+                        oh2 = jax.nn.one_hot(comb, total, dtype=jnp.float32)
+                        c = jax.lax.dot(fmask[None, :], oh2,
+                                        precision=jax.lax.Precision.HIGHEST)[0]
+                        out[f"{ai}.distinct"] = jnp.round(c).astype(
+                            jnp.int32).reshape(num_seg, size)
+                    else:
+                        out[f"{ai}.distinct"] = jax.ops.segment_sum(
+                            mask.ravel().astype(jnp.int32), comb,
+                            num_segments=total).reshape(num_seg, size)
+                    continue
                 v = _agg_arg(agg, vals)
                 for o in outs:
                     if o in _POWER_SUMS:

@@ -26,7 +26,9 @@ path; unaligned or mutable sets with those shapes keep the per-segment fallback.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
+from typing import Any
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -88,6 +90,22 @@ def _refs_multi_value(ctx: QueryContext, seg) -> bool:
         except KeyError:
             continue  # '*' / alias — not a physical column
     return False
+
+
+# below this many combined star-tree records, the per-segment host loop beats
+# any device dispatch (relay round trip >> microseconds of numpy); above it the
+# stacked device star path wins (high-cardinality split dimensions)
+STAR_DEVICE_MIN_RECORDS = 1 << 16
+
+
+@dataclass
+class StarSetPlan:
+    """Stacked device star-tree execution: one slot plan over every segment's
+    record-table view + the per-segment traversal masks."""
+    plans: list       # per-segment StarTreePlan (masks + reassembly)
+    views: list       # per-segment StarTreeView (the stacked mini-segments)
+    plan2: Any        # device SegmentPlan of the slot query over views[0]
+    kind = "star"
 
 
 def aligned_dictionaries(segments: Sequence[ImmutableSegment], cols: Sequence[str]) -> bool:
@@ -244,6 +262,9 @@ class MeshQueryExecutor:
         ctx = compile_query(query, schema or segments[0].schema) \
             if isinstance(query, str) else query
         plan, view = self._plan_for_set(ctx, segments)
+        if isinstance(plan, StarSetPlan):
+            outs_dev, decode = self._dispatch_star(ctx, plan)
+            return decode(jax.device_get(outs_dev))
         if plan is None or plan.kind != "device":
             return self._fallback.execute(segments, ctx)
         try:
@@ -258,12 +279,21 @@ class MeshQueryExecutor:
         dictHash), a MergedSegmentView when ids must be remapped to a global
         dictionary, and plan is None when the set must take the per-segment
         fallback."""
-        if self._all_star_tree(ctx, segments):
+        star_plans = self._star_fit_plans(ctx, segments)
+        if star_plans is not None:
             # every segment answers from a pre-aggregated star-tree record
-            # table (typically 100-1000x fewer records than the base scan):
-            # the per-segment executor's tree path beats a full device scan
-            # outright, so the mesh planner yields to it (reference:
-            # StarTreeUtils.isFitForStarTree gating in the leaf plan)
+            # table. SMALL tables (~100s of records): the per-segment host
+            # executor beats any device dispatch outright, so the mesh
+            # planner yields to it (reference: StarTreeUtils.isFitForStarTree
+            # gating in the leaf plan). LARGE record tables (high-cardinality
+            # split dimensions, 1e5+ records): stack the record tables like
+            # base segments and run the fused kernel over them — the
+            # split-dim predicates compile into the kernel mask as LUT/
+            # interval leaves and the tree-traversal record masks ride the
+            # kernel's valid input (BASELINE config 3 as designed).
+            star = self._plan_star_device(ctx, segments, star_plans)
+            if star is not None:
+                return star, "star"
             return None, None
         # doc-set filters (JSON/TEXT_MATCH bitmaps, stacked per segment) and
         # MV LUT filters ([S, rows, W] padded id matrices) ride the mesh
@@ -284,17 +314,60 @@ class MeshQueryExecutor:
         view = self._merged_view(segments)
         return plan_segment(ctx, view, scan_docs=total_docs), view
 
-    def _all_star_tree(self, ctx: QueryContext, segments) -> bool:
-        """True when EVERY segment can answer this query from a star-tree (a
-        mixed set keeps the mesh scan: one full-scan segment would serialize
-        the whole query behind the host fallback). The no-trees common case
-        exits before any fit work."""
+    def _star_fit_plans(self, ctx: QueryContext, segments):
+        """Per-segment StarTreePlans when EVERY segment answers this query
+        from a star-tree, else None (a mixed set keeps the mesh scan: one
+        full-scan segment would serialize the whole query behind the host
+        fallback). Computed ONCE — both the device decision and the stacked
+        dispatch reuse these plans (the traversal mask is the expensive part
+        for large trees)."""
         if not all(getattr(s, "star_trees", None) for s in segments):
-            return False
+            return None
         if any(getattr(s, "is_mutable", False) for s in segments):
-            return False
+            return None
         from ..query.startree_exec import try_star_tree
-        return all(try_star_tree(ctx, s) is not None for s in segments)
+        plans = []
+        for s in segments:
+            p = try_star_tree(ctx, s)
+            if p is None:
+                return None
+            plans.append(p)
+        return plans
+
+    def _plan_star_device(self, ctx: QueryContext, segments, plans=None):
+        """StarSetPlan when the stacked device star path applies: every tree
+        fits, the combined record tables are big enough to beat the host
+        loop, the slot plan is device-feasible, and the views' dictionaries
+        (the parents') align across segments."""
+        if plans is None:
+            plans = self._star_fit_plans(ctx, segments)
+        if plans is None:
+            return None
+        total = sum(p.tree.num_records for p in plans)
+        if total < STAR_DEVICE_MIN_RECORDS:
+            return None
+        views = [p.tree.view for p in plans]
+        plan2 = plan_segment(plans[0].ctx2, views[0], scan_docs=total)
+        if plan2.kind != "device" or not self._alignable(plan2, views):
+            return None
+        return StarSetPlan(plans, views, plan2)
+
+    def _dispatch_star(self, ctx: QueryContext, sp: "StarSetPlan"):
+        """Dispatch the stacked star-tree kernel: per-segment tree-traversal
+        record masks stack into the kernel's valid input (the split-dim LUT
+        predicates are already fused into the mask by the slot plan)."""
+        s_pad = -(-len(sp.views) // self.n_devices) * self.n_devices
+        rows = max(padded_rows(v.num_docs) for v in sp.views)
+        valid = np.zeros((s_pad, rows), dtype=bool)
+        for i, p in enumerate(sp.plans):
+            m = np.asarray(p.record_mask, dtype=bool)
+            valid[i, :len(m)] = m
+        P = jax.sharding.PartitionSpec
+        valid_dev = jax.device_put(
+            valid, jax.sharding.NamedSharding(self.mesh, P(SEGMENT_AXIS)))
+        return self._dispatch_sharded(sp.plans[0].ctx2, sp.plan2, sp.views,
+                                      valid_override=valid_dev,
+                                      star=(ctx, sp))
 
     def _stacked_docsets(self, ctx: QueryContext, plan, segments,
                          block: SegmentSetBlock) -> Tuple:
@@ -401,7 +474,10 @@ class MeshQueryExecutor:
             ctx = compile_query(query, schema or segments[0].schema) \
                 if isinstance(query, str) else query
             plan, view = self._plan_for_set(ctx, segments)
-            if plan is None or plan.kind != "device":
+            if isinstance(plan, StarSetPlan):
+                outs_dev, decode = self._dispatch_star(ctx, plan)
+                pending.append((qi, outs_dev, decode))
+            elif plan is None or plan.kind != "device":
                 pending.append((qi, self._fallback.execute(segments, ctx)))
             else:
                 try:
@@ -417,11 +493,15 @@ class MeshQueryExecutor:
             results[p[0]] = p[1] if len(p) == 2 else p[2](next(it))
         return results
 
-    def _dispatch_sharded(self, ctx: QueryContext, plan, segments, view=None):
+    def _dispatch_sharded(self, ctx: QueryContext, plan, segments, view=None,
+                          valid_override=None, star=None):
         """Dispatch the fused mesh kernel asynchronously.
 
         Returns (device outputs, decode) where decode(host_outs) -> ResultTable; the
-        caller chooses when to pay the fetch round trip (one query vs a batch)."""
+        caller chooses when to pay the fetch round trip (one query vs a batch).
+        `valid_override` replaces the block's all-true validity (stacked
+        star-tree record masks); `star` = (original ctx, StarSetPlan) makes
+        decode reassemble slot states into the original aggregations."""
         build_device_geometry(plan)
         agg_specs = []
         distinct_lut_sizes: Dict[int, int] = {}
@@ -490,7 +570,7 @@ class MeshQueryExecutor:
             iscal=self._const(np.asarray(iscal, dtype=np.int32)),
             fscal=self._const(np.asarray(fscal, dtype=np.float32)),
             nulls={c: block.null_mask(c) for c in nulls_cols},
-            valid=block.valid,
+            valid=block.valid if valid_override is None else valid_override,
             strides=self._const(np.asarray(plan.strides, dtype=np.int32)),
             agg_luts=agg_luts,
             docsets=docsets,
@@ -503,6 +583,26 @@ class MeshQueryExecutor:
             # replicated outputs decode exactly like the single-segment path;
             # plan.segment's dictionaries (segment[0] when aligned, the merged global
             # dictionaries otherwise) decode the dense keys.
+            if star is not None:
+                # stacked star-tree path: decode SLOT states (no trim — the
+                # order-by refers to the ORIGINAL aggregations), reassemble
+                # them into original-agg states, reduce with the original ctx
+                from ..query.aggregates import make_agg
+                from ..query.startree_exec import reassemble
+                orig_ctx, sp = star
+                if plan.group_cols:
+                    seg_result = self._fallback._decode_group_partials(
+                        plan, outs, trim_global=False)
+                else:
+                    seg_result = self._fallback._decode_scalar_partials(plan,
+                                                                        outs)
+                reassemble(sp.plans[0], seg_result)
+                orig_aggs = [make_agg(f) for f in orig_ctx.aggregations]
+                merged = merge_segment_results([seg_result], orig_aggs)
+                group_exprs = ([e for e, _ in orig_ctx.select_items]
+                               if orig_ctx.distinct else list(orig_ctx.group_by))
+                return reduce_to_result(orig_ctx, merged, orig_aggs,
+                                        group_exprs)
             if plan.group_cols:
                 # post-psum outputs are global, so the order-by trim is exact here
                 seg_result = self._fallback._decode_group_partials(plan, outs,

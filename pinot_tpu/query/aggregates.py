@@ -214,7 +214,9 @@ class DistinctCountAgg(AggFunc):
     device_outputs = ("distinct",)
 
     def device_ok(self, ctx: AggContext) -> bool:
-        return ctx.arg_is_dict_column and not ctx.group_by
+        # grouped path: the kernel emits a per-group presence matrix (the
+        # planner bounds its size via MAX_GROUPED_DISTINCT_CELLS)
+        return ctx.arg_is_dict_column
 
     def host_state(self, values):
         return set(np.unique(values).tolist())
@@ -294,7 +296,8 @@ class DistinctCountHLLAgg(AggFunc):
                 self.p = int(call.args[1].value)
 
     def device_ok(self, ctx: AggContext) -> bool:
-        return ctx.arg_is_dict_column and not ctx.group_by
+        # grouped HLL rides the per-group presence matrix (BASELINE config 5)
+        return ctx.arg_is_dict_column
 
     def host_state(self, values) -> np.ndarray:
         regs = np.zeros(1 << self.p, dtype=np.int8)
@@ -432,8 +435,7 @@ class DistinctCountThetaAgg(AggFunc):
                                 (call.args[0], *self.filter_exprs))
 
     def device_ok(self, ctx: AggContext) -> bool:
-        return not ctx.group_by and ctx.arg_is_dict_column \
-            and not self.filter_exprs
+        return ctx.arg_is_dict_column and not self.filter_exprs
 
     @staticmethod
     def _canonical(values) -> np.ndarray:
@@ -464,6 +466,26 @@ class DistinctCountThetaAgg(AggFunc):
 
     def state_from_value_set(self, values: set):
         return self._normalize(values)
+
+    def state_from_present_ids(self, dictionary, present_ids: np.ndarray):
+        """KMV sketch straight from the device presence vector, via a 64-bit
+        hash table cached ON the dictionary (HLL's bucket/rank trick, same
+        lifetime argument): hashing every dictionary value is paid once per
+        dictionary, and the per-query cost is one vectorized k-min over the
+        surviving ids — no per-query python-loop hashing of string values."""
+        from .sketches import ThetaSketch, hash64
+        cache = getattr(dictionary, "_theta_h64", None)
+        if cache is None:
+            vals = np.asarray(dictionary.take(np.arange(len(dictionary))),
+                              dtype=object)
+            cache = hash64(self._canonical(vals))
+            try:
+                dictionary._theta_h64 = cache
+            except AttributeError:
+                return super().state_from_present_ids(dictionary, present_ids)
+        sk = ThetaSketch(self.k)
+        sk._absorb(np.unique(cache[present_ids]))
+        return sk
 
     def host_state(self, values):
         from .sketches import ThetaSketch

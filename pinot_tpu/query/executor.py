@@ -224,9 +224,20 @@ class ServerQueryExecutor:
 
         result = SegmentResult("groups")
         result.num_docs_scanned = int(counts.sum())
+        # per-agg distinct decode inputs (grouped presence matrices)
+        distinct_readers = {
+            i: seg.column(agg.arg.name)
+            for i, agg in enumerate(plan.aggs)
+            if "distinct" in agg.device_outputs}
         for row, k in enumerate(occupied):
             states = []
             for i, agg in enumerate(plan.aggs):
+                if i in distinct_readers:
+                    reader = distinct_readers[i]
+                    presence = outs[f"{i}.distinct"][k][:reader.cardinality]
+                    states.append(agg.state_from_present_ids(
+                        reader.dictionary, np.nonzero(presence > 0)[0]))
+                    continue
                 o = {"count": int(counts[k])}
                 for out_name in agg.device_outputs:
                     if out_name != "count":

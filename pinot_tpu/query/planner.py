@@ -27,6 +27,8 @@ from .context import QueryContext, QueryValidationError
 from .predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf, compile_filter
 
 MAX_DEVICE_GROUP_KEYS = 1 << 20  # dense-key cap (reference caps group-by at 100k groups)
+# grouped distinct presence matrix cap: (padded keys) x (dict-id lut) int32 cells
+MAX_GROUPED_DISTINCT_CELLS = 1 << 22  # 16MB of presence counts per aggregation
 
 # Below this row count a single numpy pass beats any device dispatch on the
 # relay-attached backend (star-tree record tables, small dimension tables).
@@ -310,6 +312,15 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
         if err:
             return err
         if arg_is_dict and "distinct" in agg.device_outputs:
+            if group_by:
+                # grouped distinct materializes a [keys, ids] presence matrix
+                # on device; bound its memory (padded keys <= 2x real product)
+                from ..engine.datablock import lut_size
+                cells = 2 * num_keys * lut_size(
+                    segment.column(arg.name).cardinality)
+                if cells > MAX_GROUPED_DISTINCT_CELLS:
+                    return (f"grouped {agg.name} presence matrix "
+                            f"({cells} cells) exceeds device cap")
             continue  # distinct-family over a dict column works on ids; dtype irrelevant
         if arg is not None and not (isinstance(arg, Identifier) and arg.name == "*"):
             err = _expr_device_ok(arg, segment)

@@ -313,8 +313,13 @@ class StarTreeView:
         specs: List[FieldSpec] = []
         for d in tree.dims:
             preader = parent.column(d)
-            self._columns[d] = _ViewColumn(d, preader.data_type, tree.dim_ids[d],
-                                           preader.dictionary, preader.cardinality)
+            col = _ViewColumn(d, preader.data_type, tree.dim_ids[d],
+                              preader.dictionary, preader.cardinality)
+            # propagate the parent's dictionary hash: aligned parents make
+            # aligned views, which the stacked device star path requires
+            if preader.meta.get("dictHash") is not None:
+                col.meta["dictHash"] = preader.meta["dictHash"]
+            self._columns[d] = col
             specs.append(FieldSpec(d, preader.data_type))
         for mname, arr in tree.metric_arrays.items():
             dt = DataType.LONG if arr.dtype.kind == "i" else DataType.DOUBLE

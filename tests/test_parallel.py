@@ -313,3 +313,17 @@ def test_docset_cache_distinguishes_predicates(text_mv_segments, mesh_exec):
     a2 = mesh_exec.execute(segs, "SELECT COUNT(*) FROM docs "
                                  "WHERE TEXT_MATCH(body, 'alpha')")
     assert a2.rows[0][0] == want_a
+
+
+def test_mesh_grouped_distinct_family(aligned_segments, mesh_exec):
+    """r4: GROUP BY + DISTINCTCOUNT/HLL/THETA through the mesh (per-group
+    presence matrices psum across devices) agrees with the single-device
+    engine exactly."""
+    sql = ("SELECT lo_region, DISTINCTCOUNT(lo_brand), "
+           "DISTINCTCOUNTHLL(lo_orderdate), "
+           "DISTINCTCOUNTTHETASKETCH(lo_custkey), COUNT(*) FROM lineorder "
+           "WHERE lo_quantity < 40 GROUP BY lo_region ORDER BY lo_region "
+           "LIMIT 100")
+    sharded = mesh_exec.execute(aligned_segments, sql)
+    single = ServerQueryExecutor().execute(aligned_segments, sql)
+    assert sharded.rows == single.rows

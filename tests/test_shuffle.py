@@ -315,6 +315,7 @@ def test_p2p_join_differential_vs_sqlite(shuffle_cluster):
     cap untouched)."""
     from test_differential_joins import gen_join_query
     bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    boxes_before = len(REGISTRY._boxes)
     shuffle_cluster["broker"].max_data_plane_bytes = 1  # funnel would trip this
     try:
         rng = np.random.default_rng(77)
@@ -328,8 +329,10 @@ def test_p2p_join_differential_vs_sqlite(shuffle_cluster):
                 f"oracle({len(oracle)}): {oracle[:4]}"
     finally:
         shuffle_cluster["broker"].max_data_plane_bytes = None
-    # every mailbox drained: nothing leaked in the shared registry
-    assert not REGISTRY._boxes
+    # every mailbox of THESE queries drained (a failed query elsewhere in the
+    # process may legitimately leave cancelled boxes for the TTL sweep, so
+    # assert no growth rather than global emptiness)
+    assert len(REGISTRY._boxes) <= boxes_before
 
 
 def test_p2p_three_way_join_worker_to_worker(shuffle_cluster):
@@ -395,6 +398,7 @@ def test_distributed_groupby_partitions_key_space(shuffle_cluster):
     exchange: exact results, HAVING + ORDER + LIMIT handled by worker-side
     trim on disjoint key ranges."""
     bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    boxes_before = len(REGISTRY._boxes)
     sql = ("SELECT cust_id, COUNT(*), SUM(amount) FROM orders "
            "GROUP BY cust_id LIMIT 100000 OPTION(useMultistageEngine=true)")
     resp, got = _query_rows(bc, sql)
@@ -417,7 +421,7 @@ def test_distributed_groupby_partitions_key_space(shuffle_cluster):
     # identical answers with the distribution off
     _, plain = _query_rows(bc, sql.split(" OPTION")[0])
     assert _rows_match(got, plain, 1e-9, 1e-9)
-    assert not REGISTRY._boxes
+    assert len(REGISTRY._boxes) <= boxes_before  # this test's boxes drained
 
 
 def test_distributed_groupby_doc_threshold_auto_routes(shuffle_cluster):

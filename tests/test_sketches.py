@@ -304,3 +304,76 @@ def test_hll_device_state_is_registers_not_value_set(tmp_path):
         type(hll_state)
     from pinot_tpu.query.sketches import ThetaSketch
     assert isinstance(theta_state, ThetaSketch), type(theta_state)
+
+
+def test_theta_device_cached_hashes_match_host_exactly(tmp_path):
+    """r4: the device presence path builds the sketch from a per-dictionary
+    cached hash table (vectorized k-min) — its hashes must be IDENTICAL to
+    the host from_values path, or cross-segment/cross-path merges would
+    double-count (same invariant HLL's bucket/rank cache keeps)."""
+    import numpy as np
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.sketches import ThetaSketch, hash64
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+
+    rng = np.random.default_rng(3)
+    n = 20_000
+    ks = [f"user_{i}" for i in rng.integers(0, 9000, n)]       # > k=4096
+    iv = rng.integers(0, 7000, n).astype(np.int64)
+    schema = Schema("w2", [dimension("k"), dimension("ki", DataType.LONG),
+                           metric("v", DataType.INT)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"k": ks, "ki": iv, "v": np.arange(n, dtype=np.int32)},
+        str(tmp_path), "w2_0"))
+    ctx = compile_query("SELECT DISTINCTCOUNTTHETASKETCH(k), "
+                        "DISTINCTCOUNTTHETASKETCH(ki) FROM w2", schema)
+    dev = ServerQueryExecutor(use_device=True).execute_segment(ctx, seg)
+    host = ServerQueryExecutor(use_device=False).execute_segment(ctx, seg)
+    for got, want, col in [(dev.scalar[0], host.scalar[0], "k"),
+                           (dev.scalar[1], host.scalar[1], "ki")]:
+        assert isinstance(got, ThetaSketch), type(got)
+        assert got.theta == pytest.approx(want.theta)
+        assert np.array_equal(got.hashes, want.hashes), col
+    # the dictionary-level cache is populated (the device fast path ran)
+    assert getattr(seg.column("k").dictionary, "_theta_h64", None) is not None
+    # estimates agree with the truth within theta error
+    est = int(round(dev.scalar[0].estimate()))
+    assert est == pytest.approx(len(set(ks)), rel=0.05)
+
+
+def test_grouped_distinct_family_device_matches_host(tmp_path):
+    """r4 (BASELINE config 5): GROUP BY + DISTINCTCOUNT/HLL/THETA runs ON
+    DEVICE via the per-group presence matrix and matches the host path
+    exactly (HLL registers and theta hashes are value-deterministic)."""
+    import numpy as np
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.planner import plan_segment
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+
+    rng = np.random.default_rng(5)
+    n = 30_000
+    schema = Schema("g1", [dimension("g"), dimension("u"),
+                           metric("v", DataType.INT)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"g": [f"grp{i % 6}" for i in range(n)],
+         "u": [f"user_{x}" for x in rng.integers(0, 800, n)],
+         "v": np.arange(n, dtype=np.int32)}, str(tmp_path), "g1_0"))
+    sql = ("SELECT g, DISTINCTCOUNT(u), DISTINCTCOUNTHLL(u), "
+           "DISTINCTCOUNTTHETASKETCH(u), COUNT(*) FROM g1 "
+           "WHERE v < 25000 GROUP BY g ORDER BY g LIMIT 10")
+    ctx = compile_query(sql, schema)
+    # the plan must actually take the device path (not a silent host fallback)
+    plan = plan_segment(ctx, seg)
+    assert plan.kind == "device", plan.reason if hasattr(plan, "reason") else plan.kind
+    dev_rows = execute_query([seg], sql).rows
+    host = ServerQueryExecutor(use_device=False)
+    from pinot_tpu.query.reduce import merge_segment_results, reduce_to_result
+    from pinot_tpu.query.aggregates import make_agg
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    merged = merge_segment_results([host.execute_segment(ctx, seg)], aggs)
+    host_rows = reduce_to_result(ctx, merged, aggs, list(ctx.group_by)).rows
+    assert dev_rows == host_rows

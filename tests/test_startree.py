@@ -198,3 +198,69 @@ def test_startree_randomized_differential(st_env):
         if got.stats["numDocsScanned"] < want.stats["numDocsScanned"]:
             used_tree += 1
     assert used_tree >= 30, f"tree used only {used_tree}/40 times"
+
+
+def test_stacked_device_star_path_high_cardinality(tmp_path):
+    """r4 (BASELINE config 3 as designed): segments whose star-trees have
+    LARGE record tables run the stacked device path — record tables stack
+    like base segments, split-dim predicates fuse into the kernel mask, and
+    per-segment traversal masks ride the valid input. Results must equal the
+    per-segment host star path exactly."""
+    import numpy as np
+    from pinot_tpu.parallel import MeshQueryExecutor, default_mesh
+    from pinot_tpu.parallel.combine import StarSetPlan
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import (SegmentGeneratorConfig, StarTreeIndexConfig,
+                                   load_segment)
+    from pinot_tpu.segment.writer import build_aligned_segments
+
+    rng = np.random.default_rng(17)
+    n = 120_000
+    schema = Schema("hc", [
+        dimension("d1", DataType.INT), dimension("d2", DataType.INT),
+        metric("m", DataType.DOUBLE)])
+    cols = {"d1": rng.integers(0, 300, n).astype(np.int32),
+            "d2": rng.integers(0, 300, n).astype(np.int32),
+            "m": np.round(rng.uniform(0, 100, n), 3)}
+    cfg = SegmentGeneratorConfig(star_tree_configs=[StarTreeIndexConfig(
+        dimensions_split_order=["d1", "d2"],
+        function_column_pairs=["SUM__m", "COUNT__*"])])
+    paths = build_aligned_segments(schema, cols, str(tmp_path), "hc", 2,
+                                   config=cfg)
+    segs = [load_segment(p) for p in paths]
+    total_records = sum(t.num_records for s in segs for t in s.star_trees)
+    assert total_records >= 65536, total_records   # large-table premise
+
+    mesh_exec = MeshQueryExecutor(default_mesh(8))
+    sql = ("SELECT d1, SUM(m), COUNT(*) FROM hc WHERE d2 < 120 "
+           "GROUP BY d1 ORDER BY d1 LIMIT 1000")
+    ctx = compile_query(sql, schema)
+    sp = mesh_exec._plan_star_device(ctx, segs)
+    assert isinstance(sp, StarSetPlan), "stacked star path must plan"
+
+    sharded = mesh_exec.execute(segs, sql)
+    host = ServerQueryExecutor().execute(segs, sql)       # host star path
+    assert [r[0] for r in sharded.rows] == [r[0] for r in host.rows]
+    for a, b in zip(sharded.rows, host.rows):
+        assert a[2] == b[2]                               # counts exact
+        assert a[1] == pytest.approx(b[1], rel=1e-6)
+    # truth from the raw columns
+    want = {}
+    m_ok = cols["d2"] < 120
+    for d1 in np.unique(cols["d1"]):
+        mm = m_ok & (cols["d1"] == d1)
+        want[int(d1)] = (float(cols["m"][mm].sum()), int(mm.sum()))
+    for d1, s, c in sharded.rows:
+        assert c == want[int(d1)][1]
+        assert s == pytest.approx(want[int(d1)][0], rel=1e-5)
+
+    # a scalar star query takes the same stacked path
+    sql2 = "SELECT SUM(m), COUNT(*) FROM hc WHERE d1 < 50"
+    assert isinstance(mesh_exec._plan_star_device(
+        compile_query(sql2, schema), segs), StarSetPlan)
+    r2 = mesh_exec.execute(segs, sql2)
+    mm = cols["d1"] < 50
+    assert r2.rows[0][1] == int(mm.sum())
+    assert r2.rows[0][0] == pytest.approx(float(cols["m"][mm].sum()), rel=1e-5)
