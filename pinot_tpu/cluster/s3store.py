@@ -119,6 +119,17 @@ class S3Error(OSError):
         self.code = code
 
 
+def _raise_s3_error(e: "urllib.error.HTTPError") -> None:
+    """ONE translation of an S3 HTTP error body to S3Error (every operation
+    must raise the same shape for the same failure)."""
+    payload = e.read()
+    code = "Unknown"
+    if b"<Code>" in payload:
+        code = payload.split(b"<Code>")[1].split(b"</Code>")[0].decode()
+    raise S3Error(e.code, code,
+                  payload[:200].decode(errors="replace")) from None
+
+
 class S3DeepStoreFS(DeepStoreFS):
     """Bytes-by-URI against an S3 endpoint (same shape as MemDeepStore: no
     rename — move() is the base class's copy+delete, exactly like
@@ -168,12 +179,7 @@ class S3DeepStoreFS(DeepStoreFS):
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
-            payload = e.read()
-            code = "Unknown"
-            if b"<Code>" in payload:
-                code = payload.split(b"<Code>")[1].split(b"</Code>")[0].decode()
-            raise S3Error(e.code, code, payload[:200].decode(errors="replace")
-                          ) from None
+            _raise_s3_error(e)
 
     # -- DeepStoreFS --------------------------------------------------------
     def upload(self, local_path: str, uri: str) -> None:
@@ -199,14 +205,7 @@ class S3DeepStoreFS(DeepStoreFS):
                                             timeout=self.timeout_s) as resp:
                     resp.read()
             except urllib.error.HTTPError as e:
-                payload = e.read()
-                code = "Unknown"
-                if b"<Code>" in payload:
-                    code = payload.split(b"<Code>")[1].split(
-                        b"</Code>")[0].decode()
-                raise S3Error(e.code, code,
-                              payload[:200].decode(errors="replace")
-                              ) from None
+                _raise_s3_error(e)
 
     def put_bytes(self, data: bytes, uri: str) -> None:
         self._call("PUT", self._url(self._key(uri)), data)

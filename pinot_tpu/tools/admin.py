@@ -134,6 +134,29 @@ def cmd_verify_segment(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def cmd_recommend_config(args) -> int:
+    """Reference: the controller recommender endpoint (schema + query
+    patterns + throughput -> config advice)."""
+    from .tuner import (recommend, recommend_from_workload,
+                        recommend_realtime_provisioning)
+    if args.queries:
+        with open(args.queries) as f:
+            queries = [ln.strip() for ln in f if ln.strip()]
+        rec = recommend_from_workload(args.segment_dir, queries,
+                                      num_servers=args.num_servers)
+    else:
+        rec = recommend(args.segment_dir)
+    rec.pop("profile", None)   # advice, not the raw dump
+    if args.events_per_sec:
+        rec["realtimeProvisioning"] = recommend_realtime_provisioning(
+            args.events_per_sec, args.avg_row_bytes,
+            retention_hours=args.retention_hours,
+            host_memory_gb=args.host_memory_gb,
+            num_hosts=args.num_servers)
+    _print(rec)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="pinot-tpu-admin", description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -214,6 +237,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("verify-segment")
     sp.add_argument("--dir", required=True)
     sp.set_defaults(fn=cmd_verify_segment)
+
+    sp = sub.add_parser("recommend-config")
+    sp.add_argument("--segment-dir", required=True,
+                    help="a representative built segment")
+    sp.add_argument("--queries", default=None,
+                    help="file with one representative SQL query per line")
+    sp.add_argument("--num-servers", type=int, default=2)
+    sp.add_argument("--events-per-sec", type=float, default=0.0,
+                    help="also emit realtime provisioning advice")
+    sp.add_argument("--avg-row-bytes", type=int, default=256)
+    sp.add_argument("--retention-hours", type=int, default=72)
+    sp.add_argument("--host-memory-gb", type=float, default=16.0)
+    sp.set_defaults(fn=cmd_recommend_config)
 
     sp = sub.add_parser("quickstart")
     sp.add_argument("--type", dest="qtype", default="batch",

@@ -103,3 +103,105 @@ def test_dataframe_partitions_and_push(tmp_path):
     res = cluster.query("SELECT k, COUNT(*) FROM pt GROUP BY k LIMIT 10")
     assert sorted((r[0], r[1]) for r in res.rows) == \
         [("p0", 100), ("p1", 100), ("p2", 100)]
+
+
+# -- r4: workload-driven advisors (reference: recommender rules engine) ------
+
+def _workload_segment(tmp_path_factory):
+    import numpy as np
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment.writer import SegmentBuilder
+    tmp = tmp_path_factory.mktemp("tuner_wl")
+    rng = np.random.default_rng(23)
+    n = 20_000
+    schema = Schema("orders", [
+        dimension("customer_id", DataType.STRING),   # high-card, EQ-filtered
+        dimension("region", DataType.STRING),        # low-card group-by
+        dimension("payload", DataType.JSON),
+        metric("price", DataType.DOUBLE),
+        metric("seq", DataType.LONG),                # unique per row
+    ])
+    cols = {
+        "customer_id": [f"c{int(x)}" for x in rng.integers(0, 5000, n)],
+        "region": rng.choice(["NA", "EU", "APAC"], n).tolist(),
+        "payload": ['{"k": %d}' % int(i % 7) for i in range(n)],
+        "price": np.round(rng.uniform(1, 500, n), 2),
+        "seq": np.arange(n),
+    }
+    return SegmentBuilder(schema).build(cols, str(tmp), "orders_0")
+
+
+WORKLOAD = [
+    "SELECT COUNT(*) FROM orders WHERE customer_id = 'c42'",
+    "SELECT SUM(price) FROM orders WHERE customer_id IN ('c1', 'c2')",
+    "SELECT region, SUM(price) FROM orders GROUP BY region",
+    "SELECT COUNT(*) FROM orders WHERE customer_id = 'c7' AND price > 100",
+    "SELECT COUNT(*) FROM orders WHERE JSON_MATCH(payload, '\"$.k\" = 3')",
+]
+
+
+def test_analyze_workload_counts(tmp_path_factory):
+    from pinot_tpu.tools.tuner import analyze_workload
+    usage = analyze_workload(WORKLOAD)
+    assert usage["customer_id"]["eq"] == 3
+    assert usage["price"]["range"] == 1 and usage["price"]["agg"] == 2
+    assert usage["region"]["group"] == 1
+    assert usage["payload"]["json"] == 1
+
+
+def test_partition_advisor_picks_eq_filtered_high_card(tmp_path_factory):
+    from pinot_tpu.tools.tuner import recommend_partitioning
+    seg = _workload_segment(tmp_path_factory)
+    adv = recommend_partitioning(seg, WORKLOAD, num_servers=4)
+    assert adv["partitionColumn"] == "customer_id"
+    assert adv["numPartitions"] == 16        # pow2 >= 4 servers x 4
+    assert any("prune" in r for r in adv["rationale"])
+    # a workload with no EQ filters gets NO partition column
+    adv2 = recommend_partitioning(
+        seg, ["SELECT region, SUM(price) FROM orders GROUP BY region"],
+        num_servers=4)
+    assert adv2["partitionColumn"] is None
+
+
+def test_realtime_provisioning_advisor():
+    from pinot_tpu.tools.tuner import recommend_realtime_provisioning
+    small = recommend_realtime_provisioning(
+        events_per_sec=5_000, avg_row_bytes=100, retention_hours=24,
+        host_memory_gb=32, num_hosts=2)
+    assert small["numPartitions"] >= 1 and small["fitsInMemory"]
+    assert small["flushThresholdRows"] >= 10_000
+    big = recommend_realtime_provisioning(
+        events_per_sec=500_000, avg_row_bytes=500, retention_hours=168,
+        host_memory_gb=16, num_hosts=2)
+    assert big["numPartitions"] > small["numPartitions"]
+    assert not big["fitsInMemory"] and big["recommendedNumHosts"] > 2
+    assert big["retainedDiskMbPerHost"] > big["estimatedPerHostMb"]
+
+
+def test_recommend_from_workload_full_report(tmp_path_factory):
+    from pinot_tpu.tools.tuner import recommend_from_workload
+    seg = _workload_segment(tmp_path_factory)
+    rec = recommend_from_workload(seg, WORKLOAD, num_servers=4)
+    idx = rec["indexing"]
+    assert "payload" in idx["jsonIndexColumns"]          # JSON_MATCH rule
+    assert idx["sortedColumn"] == "customer_id"          # most-EQ rule
+    assert "seq" in idx["noDictionaryColumns"]           # unique-per-row metric
+    assert rec["partitioning"]["partitionColumn"] == "customer_id"
+    assert rec["rationale"]
+
+
+def test_partition_advisor_scores_per_query_not_per_predicate(
+        tmp_path_factory):
+    """Review round: the score is the fraction of QUERIES that prune on the
+    column — one query with many unrelated EQ predicates must not dilute a
+    column that appears in every query."""
+    from pinot_tpu.tools.tuner import recommend_partitioning
+    seg = _workload_segment(tmp_path_factory)
+    noisy = [
+        "SELECT COUNT(*) FROM orders WHERE customer_id = 'c1' AND "
+        "region = 'NA' AND seq = 1 AND seq = 2 AND seq = 3 AND seq = 4",
+        "SELECT COUNT(*) FROM orders WHERE customer_id = 'c2'",
+        "SELECT COUNT(*) FROM orders WHERE customer_id = 'c3'",
+    ]
+    adv = recommend_partitioning(seg, noisy, num_servers=4)
+    assert adv["partitionColumn"] == "customer_id", adv
