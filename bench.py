@@ -186,22 +186,25 @@ def ingest_bench(rows: int = 50_000):
     try:
         client = LogBrokerClient(srv.bootstrap)
         client.create_topic("bench_ingest", 1)
-        for r in raws:
-            client.produce("bench_ingest", _json.dumps(r))
+        payloads = [_json.dumps(r) for r in raws]
+        for lo in range(0, rows, 500):   # realistic producer batching
+            client.produce_many("bench_ingest", payloads[lo:lo + 500])
+        from pinot_tpu.ingest.transform import TransformPipeline
         consumer = KafkaLiteConsumer(srv.bootstrap, "bench_ingest", 0)
         seg = MutableSegment("events__0__0__b", schema)
+        pipeline = TransformPipeline(schema)   # same path as the consume FSM
         t0 = time.perf_counter()
         off = 0
-        total_clicks = 0
+        from pinot_tpu.ingest.transform import rows_to_all_columns
         while off < rows:
             batch = consumer.fetch(off, 8192)
-            for msg in batch.messages:
-                row = _json.loads(msg.value)
-                seg.index(row)
-                total_clicks += row["clicks"]
+            decoded = [_json.loads(m.value) for m in batch.messages]
+            seg.index_batch(pipeline.apply(rows_to_all_columns(decoded)),
+                            coerced=True)
             off = batch.next_offset
         dt = time.perf_counter() - t0
         consumer.close()
+        total_clicks = sum(seg.columns["clicks"][:seg.num_docs])
         if seg.num_docs != rows or total_clicks != sum(
                 r["clicks"] for r in raws):
             print(f"WARNING: ingest count mismatch {seg.num_docs} != {rows}",

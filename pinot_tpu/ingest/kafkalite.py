@@ -66,25 +66,135 @@ def _to_str(b: Optional[bytes]) -> Optional[str]:
 
 
 class _PartitionLog:
-    """Append-only offset-addressed log, optionally file-backed (JSONL)."""
+    """Append-only offset-addressed log of RAW v2 record batches.
+
+    The stored artifact IS the CRC'd wire batch (base offset patched in —
+    outside the CRC's coverage, exactly how a real broker assigns offsets):
+    fetch serves the stored bytes verbatim with ZERO re-encoding, restart
+    replays the identical bytes, and the on-disk file is a sequence of those
+    frames (reference: the kafka log segment format). Legacy JSONL partition
+    files from older builds are converted once at load."""
 
     def __init__(self, path: Optional[str]):
-        self.records: List[Tuple[Any, Optional[str], int]] = []  # (value, key, ts)
+        self.batches: List[bytes] = []       # raw frames: base(8) len(4) body
+        self.base_offsets: List[int] = []    # absolute base offset per batch
+        self.counts: List[int] = []          # records per batch
+        self.next_offset = 0
         self.path = path
-        if path and os.path.exists(path):
-            with open(path) as f:
-                for line in f:
-                    d = json.loads(line)
-                    self.records.append((d["v"], d.get("k"), d.get("t", 0)))
-        self._file = open(path, "a") if path else None
+        self._file = None
+        if path:
+            legacy = os.path.splitext(path)[0] + ".jsonl"
+            if os.path.exists(legacy) and not os.path.exists(path):
+                self._convert_legacy(legacy)
+            if os.path.exists(path):
+                self._recover(path)
+            self._file = open(path, "ab")
 
-    def append(self, value: Any, key: Optional[str], ts: int) -> int:
-        offset = len(self.records)
-        self.records.append((value, key, ts))
+    def _convert_legacy(self, legacy: str) -> None:
+        # temp + atomic replace: a crash mid-conversion must leave either no
+        # .log (retry converts) or a complete one — a torn .log next to the
+        # intact .jsonl would be truncated by recovery and the legacy records
+        # silently lost forever
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(legacy) as f, open(tmp, "wb") as out:
+            off = 0
+            for line in f:
+                d = json.loads(line)
+                frame = kw.encode_record_batch(
+                    off, [(None if d.get("k") is None else _to_bytes(d["k"]),
+                           _to_bytes(d["v"]), int(d.get("t", 0)))])
+                out.write(frame)
+                off += 1
+        os.replace(tmp, self.path)
+        os.rename(legacy, legacy + ".converted")
+
+    def _recover(self, path: str) -> None:
+        """Load frames; a torn tail (crash mid-append) truncates to the last
+        complete frame, like log recovery in the reference broker."""
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 12 <= len(data):
+            (blen,) = struct.unpack(">i", data[pos + 8:pos + 12])
+            end = pos + 12 + blen
+            if blen <= 0 or end > len(data):
+                break  # torn tail
+            self._index_frame(data[pos:end])
+            pos = end
+        if pos < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(pos)
+
+    def _index_frame(self, frame: bytes) -> None:
+        (base,) = struct.unpack(">q", frame[:8])
+        # count = lastOffsetDelta + 1 (frame: 12B header + leaderEpoch(4)
+        # magic(1) crc(4) attrs(2) -> lastOffsetDelta at [23:27])
+        (last_delta,) = struct.unpack(">i", frame[23:27])
+        self.batches.append(frame)
+        self.base_offsets.append(base)
+        self.counts.append(last_delta + 1)
+        self.next_offset = base + last_delta + 1
+
+    def append_record_set(self, record_set: bytes) -> int:
+        """Validate, offset-patch, and append every batch in a produce
+        record set; returns the FIRST assigned offset.
+
+        TWO-PHASE: every batch validates (framing + CRC) before ANY appends —
+        a bad batch k must not leave batches 1..k-1 durably appended while
+        the producer sees an error (its retry would duplicate them), and a
+        truncated tail is an error, never a silent partial accept."""
+        data = bytes(record_set)
+        frames: List[bytes] = []
+        pos = 0
+        while pos < len(data):
+            if pos + 12 > len(data):
+                raise ValueError("truncated record-set frame header")
+            (blen,) = struct.unpack(">i", data[pos + 8:pos + 12])
+            end = pos + 12 + blen
+            if blen <= 0 or end > len(data):
+                raise ValueError("truncated record batch in produce set")
+            frame = data[pos:end]
+            # broker-side CRC validation (crc at [17:21], covering [21:])
+            (crc,) = struct.unpack(">I", frame[17:21])
+            if kw.crc32c(frame[21:]) != crc:
+                raise ValueError("produce record batch CRC mismatch")
+            frames.append(frame)
+            pos = end
+        if not frames:
+            raise ValueError("empty produce record set")
+        first = self.next_offset
+        for frame in frames:
+            # assign offsets by PATCHING base offset — outside CRC coverage
+            frame = struct.pack(">q", self.next_offset) + frame[8:]
+            self._index_frame(frame)
+            if self._file:
+                self._file.write(frame)
         if self._file:
-            self._file.write(json.dumps({"v": value, "k": key, "t": ts}) + "\n")
             self._file.flush()
-        return offset
+        return first
+
+    def read_from(self, offset: int, max_bytes: int) -> bytes:
+        """Stored frames covering `offset`, concatenated verbatim (the client
+        skips records below its requested offset, like a stock consumer)."""
+        import bisect
+        i = bisect.bisect_right(self.base_offsets, offset) - 1
+        if i >= 0 and self.base_offsets[i] + self.counts[i] <= offset:
+            i += 1
+        i = max(i, 0)
+        out = []
+        size = 0
+        while i < len(self.batches) and size < max(max_bytes, 1):
+            out.append(self.batches[i])
+            size += len(self.batches[i])
+            i += 1
+        return b"".join(out)
+
+    def iter_records(self):
+        """(offset, ts, key, value) across all batches — the lazy per-record
+        view (timestamp lookups only; the hot paths never materialize it)."""
+        for frame in self.batches:
+            for rec in kw.decode_record_batches(frame):
+                yield rec
 
     def close(self):
         if self._file:
@@ -109,6 +219,8 @@ class LogBrokerServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         if log_dir:
             self._load_existing_topics()
         self._acceptor = threading.Thread(target=self._accept_loop,
@@ -124,9 +236,10 @@ class LogBrokerServer:
             tdir = os.path.join(self.log_dir, topic)
             if not os.path.isdir(tdir):
                 continue
-            parts = sorted(int(p.split(".")[0]) for p in os.listdir(tdir))
+            parts = sorted({int(p.split(".")[0]) for p in os.listdir(tdir)
+                            if p.split(".")[0].isdigit()})
             self._topics[topic] = [
-                _PartitionLog(os.path.join(tdir, f"{p}.jsonl")) for p in parts]
+                _PartitionLog(os.path.join(tdir, f"{p}.log")) for p in parts]
 
     def create_topic(self, topic: str, num_partitions: int) -> None:
         with self._lock:
@@ -136,7 +249,8 @@ class LogBrokerServer:
             if self.log_dir:
                 tdir = os.path.join(self.log_dir, topic)
                 os.makedirs(tdir, exist_ok=True)
-                paths = [os.path.join(tdir, f"{p}.jsonl") for p in range(num_partitions)]
+                paths = [os.path.join(tdir, f"{p}.log")
+                         for p in range(num_partitions)]
             self._topics[topic] = [_PartitionLog(p) for p in paths]
 
     # -- request handling ----------------------------------------------------
@@ -148,9 +262,19 @@ class LogBrokerServer:
                 return
             th = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
             th.start()
-            self._threads.append(th)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        # LIVE connections only: entries drop on handler exit, or a
+        # long-lived broker would grow one dead socket per short-lived client
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            self._serve_conn_loop(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _serve_conn_loop(self, conn: socket.socket) -> None:
         with conn:
             while not self._stop.is_set():
                 try:
@@ -201,14 +325,17 @@ class LogBrokerServer:
                         results.append((topic, partition,
                                         kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1))
                         continue
-                    base = None
-                    for _off, ts, key, value in kw.decode_record_batches(record_set):
-                        o = logs[partition].append(_to_str(value), _to_str(key),
-                                                   int(ts))
-                        base = o if base is None else base
+                    try:
+                        # offsets assigned by patching each batch's base (the
+                        # CRC does not cover it — spec); the stored artifact
+                        # is the producer's CRC'd bytes, verbatim
+                        base = logs[partition].append_record_set(record_set)
+                    except ValueError:
+                        results.append((topic, partition,
+                                        kw.ERR_CORRUPT_MESSAGE, -1))
+                        continue
                     self._data_arrived.notify_all()
-                results.append((topic, partition, kw.ERR_NONE,
-                                -1 if base is None else base))
+                results.append((topic, partition, kw.ERR_NONE, base))
             return kw.encode_produce_response(results)
         if api == kw.API_LIST_OFFSETS:
             results = []
@@ -219,15 +346,16 @@ class LogBrokerServer:
                         results.append((topic, partition,
                                         kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, -1))
                         continue
-                    recs = logs[partition].records
+                    log = logs[partition]
                     if ts == kw.EARLIEST_TS:
                         off = 0
                     elif ts == kw.LATEST_TS:
-                        off = len(recs)
+                        off = log.next_offset
                     else:
                         # v1 semantics: first offset whose timestamp >= ts
-                        # (offsetsForTimes); -1 when no such record exists
-                        off = next((i for i, (_v, _k, t) in enumerate(recs)
+                        # (offsetsForTimes); -1 when no such record exists —
+                        # lazy per-record decode, rare admin-path op
+                        off = next((o for o, t, _k, _v in log.iter_records()
                                     if t >= ts), -1)
                     results.append((topic, partition, kw.ERR_NONE, -1, off))
             return kw.encode_list_offsets_response(results)
@@ -242,32 +370,44 @@ class LogBrokerServer:
                                         kw.ERR_UNKNOWN_TOPIC_OR_PARTITION, -1, b""))
                         continue
                     log = logs[partition]
-                    if offset >= len(log.records) and max_wait > 0:
+                    if offset >= log.next_offset and max_wait > 0:
                         # long-poll like Kafka's fetch.max.wait.ms
                         self._data_arrived.wait(max_wait / 1000.0)
-                    records = []
-                    size = 0
-                    # bounded slice: never copy the whole log tail under the
-                    # broker lock — O(batch), not O(partition)
-                    for v, k, t in log.records[offset:offset + 500]:
-                        vb = _to_bytes(v)
-                        records.append((None if k is None else _to_bytes(k), vb,
-                                        int(t)))
-                        size += len(vb) + 32
-                        if size >= max(part_max_bytes, 1) or len(records) >= 500:
-                            break
-                    hw = len(log.records)
-                record_set = kw.encode_record_batch(offset, records)
+                    # serve the STORED frames verbatim — zero re-encode, zero
+                    # CRC recompute (the log bytes ARE the wire bytes, like a
+                    # real broker's zero-copy sendfile path)
+                    record_set = log.read_from(offset, part_max_bytes)
+                    hw = log.next_offset
                 results.append((topic, partition, kw.ERR_NONE, hw, record_set))
             return kw.encode_fetch_response(results)
         raise ValueError(f"unhandled api {api}")
 
     def stop(self) -> None:
         self._stop.set()
+        # WAKE the acceptor: a thread blocked in accept() pins the listening
+        # socket's file description past close(), so the port would stay
+        # bound (EADDRINUSE on a same-port restart) until a connection
+        # happened to arrive
+        try:
+            socket.create_connection((self.host, self.port),
+                                     timeout=1.0).close()
+        except OSError:
+            pass
+        self._acceptor.join(timeout=2.0)
         try:
             self._sock.close()
         except OSError:
             pass
+        # close accepted connections too: a handler blocked in recv keeps its
+        # socket (and therefore the PORT) alive, so a same-port restart would
+        # EADDRINUSE forever
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
         with self._lock:
             for logs in self._topics.values():
                 for log in logs:
@@ -282,7 +422,9 @@ class LogBrokerClient:
     def __init__(self, bootstrap: str, timeout_s: float = 30.0,
                  client_id: str = "pinot-tpu"):
         host, port = bootstrap.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=timeout_s)
+        self._addr = (host, int(port))
+        self._timeout_s = timeout_s
+        self._sock = socket.create_connection(self._addr, timeout=timeout_s)
         self._lock = threading.Lock()
         self._correlation = 0
         self.client_id = client_id
@@ -292,14 +434,32 @@ class LogBrokerClient:
             self._request(kw.API_API_VERSIONS, 0, b""))
 
     def _request(self, api: int, version: int, body: bytes) -> kw.Reader:
+        """One request/response, with ONE transparent reconnect on a dead
+        socket (a stock Kafka client reconnects the same way — without this,
+        a broker RESTART permanently stalls every consuming partition whose
+        client socket died). Idempotency: fetch/metadata/list-offsets are
+        read-only; a produce retried after a mid-flight failure could
+        duplicate, exactly like Kafka without idempotent-producer mode."""
         with self._lock:
-            self._correlation += 1
-            cid = self._correlation
-            self._sock.sendall(kw.encode_request(api, version, cid,
-                                                 self.client_id, body))
-            payload = _recv_payload(self._sock)
-        if payload is None:
-            raise ConnectionError("broker closed the connection")
+            for attempt in (0, 1):
+                self._correlation += 1
+                cid = self._correlation
+                try:
+                    self._sock.sendall(kw.encode_request(
+                        api, version, cid, self.client_id, body))
+                    payload = _recv_payload(self._sock)
+                    if payload is None:
+                        raise ConnectionError("broker closed the connection")
+                    break
+                except OSError:
+                    if attempt:
+                        raise
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = socket.create_connection(
+                        self._addr, timeout=self._timeout_s)
         r = kw.Reader(payload)
         if r.i32() != cid:
             raise ConnectionError("correlation id mismatch")
@@ -365,15 +525,36 @@ class LogBrokerClient:
             return d["offset"]
         raise RuntimeError("empty produce response")
 
+    def produce_many(self, topic: str, values, partition: int = 0,
+                     timestamp_ms: Optional[int] = None) -> int:
+        """Batch produce: ONE record batch, ONE round trip (a stock producer's
+        linger/batching); returns the LAST assigned offset."""
+        values = list(values)   # a generator must count AND encode the same
+        ts = timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
+        record_set = kw.encode_record_batch(
+            0, [(None, _to_bytes(v), ts) for v in values])
+        r = self._request(kw.API_PRODUCE, 3,
+                          kw.encode_produce_request(topic, partition,
+                                                    record_set))
+        for d in kw.decode_produce_response(r):
+            if d["error"]:
+                raise RuntimeError(f"Produce {topic}/{partition}: "
+                                   f"error {d['error']}")
+            return d["offset"] + len(values) - 1
+        raise RuntimeError("empty produce response")
+
     def fetch(self, topic: str, partition: int, offset: int,
-              max_wait_ms: int = 0, max_bytes: int = 1 << 20) -> List[Dict]:
+              max_wait_ms: int = 0, max_bytes: int = 8 << 20) -> List[Dict]:
         r = self._request(kw.API_FETCH, 4,
                           kw.encode_fetch_request(topic, partition, offset,
                                                   max_wait_ms, max_bytes))
         for d in kw.decode_fetch_response(r):
             if d["error"]:
                 raise RuntimeError(f"Fetch {topic}/{partition}: error {d['error']}")
-            return d["records"]
+            # a stored batch may start BEFORE the requested offset (the broker
+            # serves whole frames) — skip below-offset records like a stock
+            # consumer
+            return [rec for rec in d["records"] if rec[0] >= offset]
         return []
 
     def list_offsets(self, topic: str, partition: int,
@@ -405,10 +586,19 @@ class KafkaLiteConsumer(PartitionGroupConsumer):
         self.client = LogBrokerClient(bootstrap)
         self.topic = topic
         self.partition = partition
+        # running average record size: the Kafka fetch protocol bounds BYTES,
+        # not records, so the max_messages contract translates through this
+        # estimate (over-fetching then slicing would decode and discard)
+        self._avg_record_bytes = 256.0
 
     def fetch(self, start_offset: int, max_messages: int, timeout_ms: int = 0) -> MessageBatch:
+        budget = int(max_messages * self._avg_record_bytes)
+        budget = min(max(budget, 64 << 10), 8 << 20)
         records = self.client.fetch(self.topic, self.partition, start_offset,
-                                    max_wait_ms=timeout_ms)
+                                    max_wait_ms=timeout_ms, max_bytes=budget)
+        if records:
+            got = sum(len(v) + 32 for _off, _ts, _k, v in records) / len(records)
+            self._avg_record_bytes = 0.8 * self._avg_record_bytes + 0.2 * got
         records = records[:max_messages]
         msgs = [StreamMessage(value=_to_str(value), offset=off,
                               key=_to_str(key), timestamp_ms=ts)

@@ -48,12 +48,12 @@ class TransformPipeline:
 
         # 0. pre-coerce schema columns so filters/transforms see typed values even for
         #    string inputs (CSV); non-schema fields stay raw for transforms to consume.
+        coerced0 = set()
         for spec in self.schema.fields:
             if spec.name in env:
-                coerce = spec.data_type.coerce
                 env[spec.name] = _as_array(
-                    [None if v is None or _is_nan(v) else coerce(v)
-                     for v in env[spec.name].tolist()])
+                    _coerce_list(spec, env[spec.name].tolist()))
+                coerced0.add(spec.name)
 
         # 1. expression transforms (may reference raw input fields)
         for dest, expr in self.column_transforms.items():
@@ -69,15 +69,18 @@ class TransformPipeline:
 
         # 3. type coercion + null defaulting per schema (DataTypeTransformer analog);
         #    None survives as None so the segment writer records null bitmaps.
+        #    Columns step 0 already coerced and no transform overwrote pass
+        #    through — coercing every value TWICE dominated the consume rate.
         out_cols: Dict[str, List[Any]] = {}
         for spec in self.schema.fields:
             if spec.name not in env:
                 out_cols[spec.name] = [None] * n
                 continue
-            vals = env[spec.name]
-            coerce = spec.data_type.coerce
-            out_cols[spec.name] = [None if v is None or _is_nan(v) else coerce(v)
-                                   for v in vals.tolist()]
+            vals = env[spec.name].tolist()
+            if spec.name in coerced0 and spec.name not in self.column_transforms:
+                out_cols[spec.name] = vals
+            else:
+                out_cols[spec.name] = _coerce_list(spec, vals)
         return out_cols
 
     def apply_row(self, row: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -86,6 +89,46 @@ class TransformPipeline:
         if not cols or len(next(iter(cols.values()))) == 0:
             return None
         return {k: v[0] for k, v in cols.items()}
+
+
+def _coerce_list(spec, vals: list) -> list:
+    """Typed values with None preserved. Numeric fast path: one numpy cast
+    when every value is already clean (no None/NaN/strings) — the per-value
+    python coerce loop is the realtime consume path's hot spot."""
+    from ..schema import DataType
+    dt = spec.data_type
+    coerce = dt.coerce
+    # BOOLEAN coerces by TRUTHINESS (2 -> 1, 0.5 -> 1, 'yes' -> 1); a plain
+    # numeric cast would store raw/truncated values — excluded from the fast
+    # path so both paths stay value-identical
+    if dt.is_numeric and dt is not DataType.BOOLEAN and vals:
+        # cast through int64/float64 regardless of the column's storage
+        # width: the slow path's coerce() yields full-precision python
+        # values, and the two paths must produce IDENTICAL values or the
+        # same input would round differently batch-to-batch (narrowing to
+        # the storage dtype happens once, at segment write)
+        wide = np.float64 if np.dtype(dt.numpy_dtype).kind == "f" else np.int64
+        try:
+            arr = np.asarray(vals, dtype=wide)
+        except (TypeError, ValueError, OverflowError):
+            arr = None
+        if arr is not None and not (arr.dtype.kind == "f"
+                                    and np.isnan(arr).any()):
+            # numpy may have mapped None -> nan silently for float dtypes;
+            # the nan check above routes to the slow path (None must
+            # survive as None for the null bitmap)
+            return arr.tolist()
+    return [None if v is None or _is_nan(v) else coerce(v) for v in vals]
+
+
+def rows_to_all_columns(rows: List[Dict[str, Any]]) -> Dict[str, List[Any]]:
+    """Row dicts -> column lists over the UNION of keys (non-schema fields
+    survive for transforms to consume) — the batch-decode shape the realtime
+    consume path and the ingest bench share."""
+    keys: set = set()
+    for r in rows:
+        keys.update(r)
+    return {k: [r.get(k) for r in rows] for k in keys}
 
 
 def _as_array(v) -> np.ndarray:

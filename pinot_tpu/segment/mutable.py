@@ -257,6 +257,58 @@ class MutableSegment:
                 inv.add_doc(v, n)
         self._num_docs = n + 1  # publish the row (single atomic int store)
 
+    def index_batch(self, cols: Dict[str, List[Any]],
+                    coerced: bool = False) -> int:
+        """Append a COLUMN batch in one pass per column — the hot realtime
+        consume path (reference batches the same loop per MessageBatch).
+        `coerced=True` skips per-value type coercion when the transform
+        pipeline already coerced (its step 0 does); rows publish atomically
+        once at the end, like index()'s single-row publish. Returns rows
+        appended."""
+        m = len(next(iter(cols.values()))) if cols else 0
+        if m == 0:
+            return 0
+        n0 = self._num_docs
+        for spec in self.schema.fields:
+            name = spec.name
+            vals = cols.get(name)
+            if vals is None:
+                vals = [None] * m
+            out: List[Any] = []
+            if not spec.single_value:
+                from ..schema import normalize_mv_cell
+                nr = None
+                for i, v in enumerate(vals):
+                    v2, is_null = normalize_mv_cell(spec, v)
+                    if is_null:
+                        if nr is None:
+                            nr = self.null_rows.setdefault(name, [])
+                        nr.append(n0 + i)
+                    out.append(v2)
+            else:
+                nv = spec.null_value
+                coerce = spec.data_type.coerce
+                nr = None
+                for i, v in enumerate(vals):
+                    if v is None:
+                        if nr is None:
+                            nr = self.null_rows.setdefault(name, [])
+                        nr.append(n0 + i)
+                        out.append(nv)
+                    else:
+                        out.append(v if coerced else coerce(v))
+            self.columns[name].extend(out)
+            tidx = self.text_indexes.get(name)
+            if tidx is not None:
+                for v in out:
+                    tidx.add_doc(v)
+            inv = self.inverted_indexes.get(name)
+            if inv is not None:
+                for i, v in enumerate(out):
+                    inv.add_doc(v, n0 + i)
+        self._num_docs = n0 + m  # publish the whole batch (one atomic store)
+        return m
+
     def column(self, name: str) -> MutableColumnReader:
         if name not in self._readers:
             if name not in self.columns:
