@@ -78,12 +78,19 @@ def _avro_reader(path: str) -> RecordReader:
     return AvroRecordReader(path)
 
 
+def _proto_reader(path: str) -> RecordReader:
+    from .proto import ProtoRecordReader   # lazy; sidecar <path>.desc schema
+    return ProtoRecordReader(path)
+
+
 _READERS: Dict[str, Callable[[str], RecordReader]] = {
     "csv": CsvRecordReader,
     "json": JsonLineRecordReader,
     "jsonl": JsonLineRecordReader,
     "parquet": ParquetRecordReader,
     "avro": _avro_reader,
+    "pb": _proto_reader,
+    "protobuf": _proto_reader,
 }
 
 

@@ -1,0 +1,257 @@
+"""Protobuf tests: wire golden vectors, descriptor parsing via REAL protoc
+output, round-trips, and end-to-end batch + realtime ingestion.
+
+Mirrors the reference's protobuf plugin coverage
+(`pinot-plugins/pinot-input-format/pinot-protobuf/src/test/...`). protoc
+ships in the image, so descriptor sets are genuine `--descriptor_set_out`
+blobs, not hand-built fixtures.
+"""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingest.proto import (DescriptorPool, ProtoError,
+                                    ProtoRecordReader, compile_proto,
+                                    decode_message, encode_message,
+                                    iter_fields, make_proto_decoder,
+                                    read_uvarint, write_delimited,
+                                    write_uvarint)
+
+PROTO_SRC = """
+syntax = "proto3";
+package bench;
+
+message Inner {
+  string label = 1;
+  double weight = 2;
+}
+
+message Event {
+  string user = 1;
+  int64 clicks = 2;
+  double cost = 3;
+  sint64 delta = 4;
+  bool active = 5;
+  fixed32 shard = 6;
+  repeated int32 codes = 7;
+  repeated string tags = 8;
+  Inner inner = 9;
+  bytes blob = 10;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    desc = compile_proto(PROTO_SRC, str(tmp_path_factory.mktemp("proto")))
+    return DescriptorPool(desc), desc
+
+
+# -- wire golden vectors (protobuf encoding spec examples) -------------------
+
+def test_golden_varints():
+    assert write_uvarint(0) == b"\x00"
+    assert write_uvarint(1) == b"\x01"
+    assert write_uvarint(300) == b"\xac\x02"     # the spec's classic example
+    assert read_uvarint(b"\xac\x02", 0) == (300, 2)
+
+
+def test_golden_field_tags():
+    # spec: message Test1 { int32 a = 1; } with a=150 -> 08 96 01
+    fields = list(iter_fields(b"\x08\x96\x01"))
+    assert fields == [(1, 0, 150)]
+    # field 2, string "testing" -> 12 07 74 65 73 74 69 6e 67
+    fields = list(iter_fields(b"\x12\x07testing"))
+    assert fields == [(2, 2, b"testing")]
+
+
+ROW = {
+    "user": "alice",
+    "clicks": -42,
+    "cost": 3.75,
+    "delta": -7,
+    "active": True,
+    "shard": 9,
+    "codes": [1, -2, 300],
+    "tags": ["a", "b"],
+    "inner": {"label": "x", "weight": 0.5},
+    "blob": b"\x00\xff",
+}
+
+
+def test_roundtrip_against_own_codec(pool):
+    p, _ = pool
+    schema = p.message("bench.Event")
+    data = encode_message(p, schema, ROW)
+    out = decode_message(p, schema, data)
+    assert out == ROW
+
+
+def test_decode_against_protoc_encoded_bytes(tmp_path, pool):
+    """protoc --encode produces the bytes; our decoder must read them (true
+    wire compatibility, not self-consistency)."""
+    import subprocess
+    p, _desc = pool
+    (tmp_path / "schema.proto").write_text(PROTO_SRC)
+    text = ('user: "bob" clicks: 5 cost: 1.5 delta: -3 active: true '
+            'shard: 2 codes: 1 codes: 2 tags: "t1" '
+            'inner { label: "in" weight: 2.25 } blob: "hi"')
+    enc = subprocess.run(
+        ["protoc", f"--proto_path={tmp_path}", "--encode=bench.Event",
+         str(tmp_path / "schema.proto")],
+        input=text.encode(), capture_output=True, check=True)
+    out = decode_message(p, p.message("bench.Event"), enc.stdout)
+    assert out["user"] == "bob" and out["clicks"] == 5
+    assert out["delta"] == -3 and out["active"] is True
+    assert out["codes"] == [1, 2] and out["tags"] == ["t1"]
+    assert out["inner"] == {"label": "in", "weight": 2.25}
+    assert out["blob"] == b"hi"
+    # and protoc can read OUR bytes back (encode direction)
+    ours = encode_message(p, p.message("bench.Event"), out)
+    dec = subprocess.run(
+        ["protoc", f"--proto_path={tmp_path}", "--decode=bench.Event",
+         str(tmp_path / "schema.proto")],
+        input=ours, capture_output=True, check=True)
+    assert b'user: "bob"' in dec.stdout and b"clicks: 5" in dec.stdout
+
+
+def test_unknown_fields_skipped(pool):
+    p, _ = pool
+    schema = p.message("bench.Inner")
+    # field 99 (unknown): varint — must be skipped, not error (weight absent
+    # on the wire -> proto3 default 0.0)
+    data = encode_message(p, schema, {"label": "x"}) + b"\x98\x06\x2a"
+    assert decode_message(p, schema, data) == {"label": "x", "weight": 0.0}
+
+
+def test_record_reader_with_sidecar(tmp_path, pool):
+    p, desc = pool
+    schema = p.message("bench.Event")
+    rows = [dict(ROW, user=f"u{i}", clicks=i) for i in range(50)]
+    path = str(tmp_path / "events.pb")
+    write_delimited(path, p, schema, rows)
+    (tmp_path / "events.pb.desc").write_bytes(desc)
+    (tmp_path / "events.pb.msg").write_text("bench.Event")
+    from pinot_tpu.ingest.readers import reader_for
+    rdr = reader_for(path)
+    got = list(rdr.rows())
+    rdr.close()
+    assert len(got) == 50
+    assert got[7]["user"] == "u7" and got[7]["clicks"] == 7
+    assert got[0]["inner"]["weight"] == 0.5
+
+
+def test_truncated_delimited_file_errors(tmp_path, pool):
+    p, desc = pool
+    schema = p.message("bench.Event")
+    path = str(tmp_path / "bad.pb")
+    write_delimited(path, p, schema, [ROW])
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-3])
+    with pytest.raises(ProtoError, match="truncated"):
+        list(ProtoRecordReader(path, descriptor_set=desc,
+                               message="bench.Event").rows())
+
+
+def test_batch_ingestion_of_protobuf_differential(tmp_path, pool):
+    """Same rows through .pb and .jsonl produce identical query results."""
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.ingest.batch import BatchIngestionJobSpec, run_batch_ingestion
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+
+    p, desc = pool
+    schema_pb = p.message("bench.Event")
+    rng = np.random.default_rng(3)
+    rows = [{"user": f"u{int(x) % 40}", "clicks": int(c),
+             "cost": round(float(v), 3)}
+            for x, c, v in zip(rng.integers(0, 40, 400),
+                               rng.integers(0, 9, 400),
+                               rng.uniform(0, 5, 400))]
+    pb_path = str(tmp_path / "ev.pb")
+    write_delimited(pb_path, p, schema_pb, rows)
+    (tmp_path / "ev.pb.desc").write_bytes(desc)
+    (tmp_path / "ev.pb.msg").write_text("bench.Event")
+    jsonl = tmp_path / "ev.jsonl"
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    schema = Schema("ev", [dimension("user"),
+                           metric("clicks", DataType.LONG),
+                           metric("cost", DataType.DOUBLE)])
+    results = {}
+    for fmt, path in [("pb", pb_path), ("jsonl", str(jsonl))]:
+        cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path / fmt))
+        cfg = TableConfig("ev")
+        cluster.create_table(schema, cfg)
+        run_batch_ingestion(
+            BatchIngestionJobSpec(input_paths=[path],
+                                  table=cfg.table_name_with_type,
+                                  segment_rows=150),
+            cluster.controller, work_dir=str(tmp_path / f"w_{fmt}"))
+        results[fmt] = cluster.query(
+            "SELECT user, COUNT(*), SUM(clicks), SUM(cost) FROM ev "
+            "GROUP BY user ORDER BY user LIMIT 100").rows
+    assert results["pb"] == results["jsonl"]
+
+
+def test_realtime_table_consumes_protobuf(tmp_path, pool):
+    """Realtime table decoding raw protobuf stream payloads via a registered
+    decoder closure (reference: ProtoBufMessageDecoder)."""
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.ingest.stream import MemoryStream, register_decoder
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+    p, desc = pool
+    schema_pb = p.message("bench.Event")
+    MemoryStream.reset_all()
+    register_decoder("proto_events", make_proto_decoder(desc, "bench.Event"))
+    try:
+        cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+        schema = Schema("ev", [dimension("user"),
+                               metric("clicks", DataType.LONG),
+                               metric("cost", DataType.DOUBLE)])
+        cfg = TableConfig("ev", table_type=TableType.REALTIME, replication=1,
+                          stream=StreamConfig(stream_type="memory",
+                                              topic="pb_topic",
+                                              decoder="proto_events",
+                                              flush_threshold_rows=1000))
+        cluster.create_realtime_table(schema, cfg, 1)
+        stream = MemoryStream.get("pb_topic")
+        total = 0
+        for i in range(200):
+            total += i
+            stream.produce(encode_message(p, schema_pb,
+                                          {"user": f"u{i % 5}", "clicks": i,
+                                           "cost": 0.5}), partition=0)
+        cluster.pump_realtime(cfg.table_name_with_type)
+        res = cluster.query("SELECT COUNT(*), SUM(clicks) FROM ev")
+        assert res.rows[0] == [200, total]
+    finally:
+        MemoryStream.reset_all()
+
+
+def test_proto3_implicit_defaults_filled(tmp_path, pool):
+    """Review round: fields at their default value are omitted on the wire by
+    proto3 producers; the decoder must fill 0/''/false/[], never drop keys."""
+    import subprocess
+    p, _ = pool
+    (tmp_path / "schema.proto").write_text(PROTO_SRC)
+    enc = subprocess.run(
+        ["protoc", f"--proto_path={tmp_path}", "--encode=bench.Event",
+         str(tmp_path / "schema.proto")],
+        input=b'user: "u"', capture_output=True, check=True)
+    out = decode_message(p, p.message("bench.Event"), enc.stdout)
+    assert out["clicks"] == 0 and out["cost"] == 0.0
+    assert out["active"] is False and out["blob"] == b""
+    assert out["codes"] == [] and out["tags"] == []
+    assert "inner" not in out            # absent submessage stays null
+
+
+def test_packed_fixed_truncation_raises_proto_error(pool):
+    from pinot_tpu.ingest.proto import _unpack_packed, T_FIXED64
+    with pytest.raises(ProtoError, match="packed"):
+        _unpack_packed(T_FIXED64, b"\x00" * 12)
