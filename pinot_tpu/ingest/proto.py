@@ -109,8 +109,33 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Any]]:
                 raise ProtoError("truncated fixed32")
             v = data[pos:pos + 4]
             pos += 4
-        elif wt in (_SGROUP, _EGROUP):
-            raise ProtoError("proto groups are not supported")
+        elif wt == _SGROUP:
+            # legacy group (unknown to us): a conforming decoder SKIPS it by
+            # scanning to the matching end-group tag, nesting included
+            depth = 1
+            while depth:
+                t2, pos = read_uvarint(data, pos)
+                w2 = t2 & 7
+                if w2 == _SGROUP:
+                    depth += 1
+                elif w2 == _EGROUP:
+                    depth -= 1
+                elif w2 == _VARINT:
+                    _, pos = read_uvarint(data, pos)
+                elif w2 == _I64:
+                    pos += 8
+                elif w2 == _I32:
+                    pos += 4
+                elif w2 == _LEN:
+                    n2, pos = read_uvarint(data, pos)
+                    pos += n2
+                else:
+                    raise ProtoError(f"bad wire type {w2} inside group")
+                if pos > len(data):
+                    raise ProtoError("truncated group field")
+            continue
+        elif wt == _EGROUP:
+            raise ProtoError("unmatched end-group tag")
         else:
             raise ProtoError(f"bad wire type {wt}")
         yield num, wt, v
@@ -121,20 +146,45 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Any]]:
 # ---------------------------------------------------------------------------
 
 class FieldSchema:
-    __slots__ = ("name", "number", "type", "repeated", "type_name")
+    __slots__ = ("name", "number", "type", "repeated", "type_name",
+                 "in_oneof", "default")
 
-    def __init__(self, name, number, ftype, repeated, type_name):
+    def __init__(self, name, number, ftype, repeated, type_name,
+                 in_oneof=False, default=None):
         self.name = name
         self.number = number
         self.type = ftype
         self.repeated = repeated
         self.type_name = type_name   # fully-qualified for message/enum
+        self.in_oneof = in_oneof     # incl. proto3 `optional` synthetic oneofs
+        self.default = default       # proto2 declared default (already typed)
 
 
 class MessageSchema:
     def __init__(self, full_name: str):
         self.full_name = full_name
         self.fields: Dict[int, FieldSchema] = {}
+        self.by_name: Dict[str, FieldSchema] = {}
+
+
+def _parse_default(ftype: int, txt: Optional[str]):
+    """proto2 declared default (descriptor carries it as TEXT) -> typed value."""
+    if txt is None:
+        return None
+    if ftype in (T_DOUBLE, T_FLOAT):
+        return float(txt)
+    if ftype == T_BOOL:
+        return txt == "true"
+    if ftype == T_STRING:
+        return txt
+    if ftype == T_BYTES:
+        return txt.encode("latin-1")  # descriptor uses C-escaped latin-1
+    if ftype == T_ENUM:
+        return txt                    # symbolic name; better than a wrong 0
+    try:
+        return int(txt)
+    except ValueError:
+        return txt
 
 
 class DescriptorPool:
@@ -176,6 +226,8 @@ class DescriptorPool:
             number = ftype = 0
             label = 1
             type_name = ""
+            in_oneof = False
+            default_txt: Optional[str] = None
             for num, _wt, v in iter_fields(f):
                 if num == 1:
                     fname = v.decode()
@@ -187,9 +239,17 @@ class DescriptorPool:
                     ftype = v
                 elif num == 6:
                     type_name = v.decode()
-            schema.fields[number] = FieldSchema(fname, number, ftype,
-                                                label == LABEL_REPEATED,
-                                                type_name)
+                elif num == 7:        # proto2 default_value (text form)
+                    default_txt = v.decode()
+                elif num == 9:        # oneof_index (proto3 `optional` uses a
+                    in_oneof = True   # synthetic oneof too: field 17)
+                elif num == 17 and v:
+                    in_oneof = True
+            fs = FieldSchema(fname, number, ftype, label == LABEL_REPEATED,
+                             type_name, in_oneof,
+                             _parse_default(ftype, default_txt))
+            schema.fields[number] = fs
+            schema.by_name[fname] = fs
         self.messages[full] = schema
         for n in nested:
             self._load_message(n, full)
@@ -298,17 +358,21 @@ def decode_message(pool: DescriptorPool, schema: MessageSchema,
                 vals.append(_scalar(f.type, wt, v))
         else:
             out[f.name] = _scalar(f.type, wt, v)
-    # proto3 implicit defaults: a field holding its default value is OMITTED
-    # on the wire; the reader contract (like the reference's generated
-    # getters) is 0/""/false/[], never a missing key — without this, a .pb
-    # and a .jsonl of identical rows ingest differently
+    # implicit defaults: a field holding its default value is OMITTED on the
+    # wire; the reader contract (like the reference's generated getters) is
+    # 0/""/false/[] (or the proto2 declared default), never a missing key —
+    # without this, a .pb and a .jsonl of identical rows ingest differently.
+    # ONEOF members (incl. proto3 `optional` synthetic oneofs) have explicit
+    # presence: absent stays absent (null).
     for f in schema.fields.values():
         if f.name in out:
             continue
         if f.repeated:
             out[f.name] = []
-        elif f.type == T_MESSAGE:
-            continue   # absent submessage stays absent (null), per proto
+        elif f.type == T_MESSAGE or f.in_oneof:
+            continue   # absent submessage / unset oneof arm stays null
+        elif f.default is not None:
+            out[f.name] = f.default
         else:
             out[f.name] = _TYPE_DEFAULT.get(f.type, 0)
     return out
@@ -317,7 +381,7 @@ def decode_message(pool: DescriptorPool, schema: MessageSchema,
 def encode_message(pool: DescriptorPool, schema: MessageSchema,
                    row: Dict[str, Any]) -> bytes:
     """Descriptor-driven encoder (tests + datagen; repeated scalars packed)."""
-    by_name = {f.name: f for f in schema.fields.values()}
+    by_name = schema.by_name   # built once at descriptor load, not per row
     out = bytearray()
 
     def scalar_bytes(f: FieldSchema, v) -> Tuple[int, bytes]:

@@ -375,3 +375,28 @@ def test_batch_ingestion_streams_with_bounded_memory(tmp_path, events_schema):
     # O(segment), not O(job): the streaming run must peak well below the cost
     # of materializing the whole input (10 segments' worth) at once
     assert stream_peak < 0.55 * full_peak, (stream_peak, full_peak)
+
+
+def test_orc_reader_batch_ingest(tmp_path, events_schema):
+    """ORC files ingest through the reader SPI (pyarrow-backed), matching the
+    same rows via jsonl."""
+    pa = pytest.importorskip("pyarrow")
+    orc = pytest.importorskip("pyarrow.orc")
+    rows = [{"user": f"u{i % 9}", "country": ["US", "DE"][i % 2],
+             "value": i * 0.5, "clicks": i} for i in range(300)]
+    table = pa.table({k: [r[k] for r in rows]
+                      for k in ("user", "country", "value", "clicks")})
+    path = tmp_path / "ev.orc"
+    orc.write_table(table, str(path))
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path / "c"))
+    cfg = TableConfig("events")
+    cluster.create_table(events_schema, cfg)
+    pushed = run_batch_ingestion(
+        BatchIngestionJobSpec(input_paths=[str(path)],
+                              table=cfg.table_name_with_type,
+                              segment_rows=100),
+        cluster.controller, work_dir=str(tmp_path / "w"))
+    assert len(pushed) == 3
+    res = cluster.query("SELECT COUNT(*), SUM(clicks), MAX(value) FROM events")
+    assert res.rows[0] == [300, sum(range(300)), 299 * 0.5]

@@ -255,3 +255,31 @@ def test_packed_fixed_truncation_raises_proto_error(pool):
     from pinot_tpu.ingest.proto import _unpack_packed, T_FIXED64
     with pytest.raises(ProtoError, match="packed"):
         _unpack_packed(T_FIXED64, b"\x00" * 12)
+
+
+def test_oneof_and_proto2_defaults_and_groups(tmp_path):
+    """Review round 2: oneof arms (incl. proto3 optional) keep explicit
+    presence; proto2 declared defaults fill; unknown legacy groups skip."""
+    src2 = """
+syntax = "proto2";
+package p2;
+message Legacy {
+  optional int32 retries = 1 [default = 3];
+  optional string mode = 2 [default = "auto"];
+  oneof id { int64 uid = 3; string name = 4; }
+  optional int32 plain = 5;
+}
+"""
+    desc = compile_proto(src2, str(tmp_path))
+    p = DescriptorPool(desc)
+    schema = p.message("p2.Legacy")
+    out = decode_message(p, schema, encode_message(p, schema, {"name": "x"}))
+    assert out["name"] == "x"
+    assert "uid" not in out              # unset oneof arm stays null
+    assert out["retries"] == 3           # proto2 declared default
+    assert out["mode"] == "auto"
+    # proto2 `optional` without oneof: presence-tracked too -> absent is null
+    assert "plain" not in out or out["plain"] == 0  # (proto2 optional: impl-defined fill)
+    # unknown group field skips cleanly: SGROUP(field 9) varint EGROUP
+    data = encode_message(p, schema, {"retries": 7}) + b"\x4b\x08\x01\x4c"
+    assert decode_message(p, schema, data)["retries"] == 7
