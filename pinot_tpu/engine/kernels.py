@@ -9,17 +9,19 @@ This replaces the reference's entire per-segment operator chain
                                               DictionaryBasedGroupKeyGenerator.java:62)
     partials = [mask; masked values] @ one_hot(key)   (ONE stacked matmul on the MXU)
 
-GATHER/SCATTER-FREE BY DESIGN: the TPU relay serializes every gather/scatter op into an
-extra host round trip per dispatch (~65ms each, measured), so the hot kernel uses only
-compares, selects, reductions and matmuls:
+GATHER-FREE ON THE HOT MASK PATH: the kernel favors compares, selects, reductions and
+matmuls —
 
 * dict predicates -> id-interval compares (sorted dictionaries make EQ/RANGE/small-IN
   contiguous id runs, resolved host-side at plan time);
 * dict decode -> host-materialized value columns cached in HBM (`datablock.values`);
-* group-by partials -> one-hot matmul `[rows, N] @ [N, keys]` when the key space is
-  small enough (the common OLAP case), per-key broadcast-reduce for min/max; scatter
-  (`segment_*`) only above the cap, where the matmul's N*K work would exceed the extra
-  round trip it avoids.
+* group-by partials -> one-hot matmul `[rows, N] @ [N, keys]` up to MATMUL_KEY_CAP
+  (the common OLAP case; XLA fuses the iota-compare into the dot's tiles), per-key
+  broadcast-reduce for min/max, `segment_*` scatter above the caps and for WIDE
+  product spaces (grouped distinct presence: the combined keys*ids width makes the
+  fused matmul ~100x the scatter's in-program cost; scattered programs pipeline a
+  little worse on the relay — roughly one round trip per dispatch — so the caps
+  trade that against matmul FLOPs).
 
 There is no 10k-doc batching loop (`DocIdSetPlanNode.MAX_DOC_PER_CALL`): the TPU analog of
 batching is the grid XLA tiles over the padded row axis. Kernels are cached by structural
@@ -52,9 +54,6 @@ _POWER_SUMS = {"sum": 1, "sum2": 2, "sum3": 3, "sum4": 4}
 MATMUL_KEY_CAP = 8192     # one-hot matmul group-by partials (count/sum), MXU-bound
 MINMAX_BCAST_CAP = 1024   # per-key broadcast-reduce min/max, VPU-bound
 DENSE_LUT_MATMUL_CAP = 8192  # scattered-LUT membership via one-hot matmul
-# grouped distinct: presence counts over the (group key x dict id) product space
-# ride the one-hot matmul up to this combined width; above it, segment_sum
-GROUPED_DISTINCT_MATMUL_CAP = 1 << 16
 
 
 @dataclass
@@ -299,21 +298,20 @@ def _make_body(spec: KernelSpec):
                     # DISTINCTCOUNT/HLL/theta path, BASELINE config 5): one
                     # combined dense key over the (group, id) product space —
                     # masked rows ride the overflow band exactly like `key`.
+                    # segment_sum, NOT a one-hot matmul: the combined width
+                    # (keys*ids, tens of thousands) makes the fused
+                    # iota-compare matmul ~100x slower than the scatter here
+                    # (measured ~10ms vs ~0.1ms per 2M-row segment, 5.4x on
+                    # the pipelined bench). On the relay backend a scattered
+                    # program still pipelines worse than pure-matmul ones
+                    # (~1 round trip per dispatch), but the matmul's compute
+                    # cost at this width dwarfs that.
                     size = spec.distinct_lut_sizes[ai]
                     col_ids = ids[agg.arg.name].ravel()
                     comb = key * size + col_ids
-                    total = num_seg * size
-                    if total <= GROUPED_DISTINCT_MATMUL_CAP \
-                            and key.size <= (1 << 24):
-                        oh2 = jax.nn.one_hot(comb, total, dtype=jnp.float32)
-                        c = jax.lax.dot(fmask[None, :], oh2,
-                                        precision=jax.lax.Precision.HIGHEST)[0]
-                        out[f"{ai}.distinct"] = jnp.round(c).astype(
-                            jnp.int32).reshape(num_seg, size)
-                    else:
-                        out[f"{ai}.distinct"] = jax.ops.segment_sum(
-                            mask.ravel().astype(jnp.int32), comb,
-                            num_segments=total).reshape(num_seg, size)
+                    out[f"{ai}.distinct"] = jax.ops.segment_sum(
+                        mask.ravel().astype(jnp.int32), comb,
+                        num_segments=num_seg * size).reshape(num_seg, size)
                     continue
                 v = _agg_arg(agg, vals)
                 for o in outs:

@@ -85,6 +85,37 @@ def _i32_signed(u: int) -> int:
     return u - (1 << 32) if u >= (1 << 31) else u
 
 
+def _skip_field(data: bytes, pos: int, wt: int) -> int:
+    """Advance past one field's payload (the ONE wire-type walk the group
+    skipper reuses — a second inlined copy would drift)."""
+    if wt == _VARINT:
+        _, pos = read_uvarint(data, pos)
+    elif wt == _I64:
+        pos += 8
+    elif wt == _I32:
+        pos += 4
+    elif wt == _LEN:
+        n, pos = read_uvarint(data, pos)
+        pos += n
+    elif wt == _SGROUP:
+        pos = _skip_group(data, pos)
+    else:
+        raise ProtoError(f"bad wire type {wt}")
+    if pos > len(data):
+        raise ProtoError("truncated field")
+    return pos
+
+
+def _skip_group(data: bytes, pos: int) -> int:
+    """Scan past a group body to the matching end-group tag."""
+    while True:
+        tag, pos = read_uvarint(data, pos)
+        wt = tag & 7
+        if wt == _EGROUP:
+            return pos
+        pos = _skip_field(data, pos, wt)
+
+
 def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Any]]:
     """Walk one message's (field number, wire type, raw value) tags."""
     pos = 0
@@ -110,29 +141,11 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Any]]:
             v = data[pos:pos + 4]
             pos += 4
         elif wt == _SGROUP:
-            # legacy group (unknown to us): a conforming decoder SKIPS it by
-            # scanning to the matching end-group tag, nesting included
-            depth = 1
-            while depth:
-                t2, pos = read_uvarint(data, pos)
-                w2 = t2 & 7
-                if w2 == _SGROUP:
-                    depth += 1
-                elif w2 == _EGROUP:
-                    depth -= 1
-                elif w2 == _VARINT:
-                    _, pos = read_uvarint(data, pos)
-                elif w2 == _I64:
-                    pos += 8
-                elif w2 == _I32:
-                    pos += 4
-                elif w2 == _LEN:
-                    n2, pos = read_uvarint(data, pos)
-                    pos += n2
-                else:
-                    raise ProtoError(f"bad wire type {w2} inside group")
-                if pos > len(data):
-                    raise ProtoError("truncated group field")
+            # legacy group field: a conforming decoder SKIPS it by scanning
+            # to the matching end-group tag, nesting included (groups are
+            # deprecated since proto2's earliest days; declared group fields
+            # decode as absent — see decode_message's default fill)
+            pos = _skip_group(data, pos)
             continue
         elif wt == _EGROUP:
             raise ProtoError("unmatched end-group tag")
@@ -167,8 +180,45 @@ class MessageSchema:
         self.by_name: Dict[str, FieldSchema] = {}
 
 
+def _c_unescape(txt: str) -> bytes:
+    """Descriptor default_value for bytes is C-escaped text — unescape it."""
+    out = bytearray()
+    i = 0
+    while i < len(txt):
+        c = txt[i]
+        if c != "\\":
+            out += c.encode("latin-1")
+            i += 1
+            continue
+        i += 1
+        e = txt[i]
+        simple = {"n": 10, "r": 13, "t": 9, "a": 7, "b": 8, "f": 12, "v": 11,
+                  "\\": 92, "'": 39, '"': 34, "?": 63}
+        if e in simple:
+            out.append(simple[e])
+            i += 1
+        elif e == "x":
+            j = i + 1
+            while j < len(txt) and j <= i + 2 and txt[j] in "0123456789abcdefABCDEF":
+                j += 1
+            out.append(int(txt[i + 1:j], 16))
+            i = j
+        elif e.isdigit():
+            j = i
+            while j < len(txt) and j < i + 3 and txt[j] in "01234567":
+                j += 1
+            out.append(int(txt[i:j], 8))
+            i = j
+        else:
+            out += e.encode("latin-1")
+            i += 1
+    return bytes(out)
+
+
 def _parse_default(ftype: int, txt: Optional[str]):
-    """proto2 declared default (descriptor carries it as TEXT) -> typed value."""
+    """proto2 declared default (descriptor carries it as TEXT) -> typed value.
+    Enum defaults arrive as SYMBOLIC names; the pool resolves them to numbers
+    after all enum descriptors are loaded (decode yields enum NUMBERS)."""
     if txt is None:
         return None
     if ftype in (T_DOUBLE, T_FLOAT):
@@ -178,9 +228,9 @@ def _parse_default(ftype: int, txt: Optional[str]):
     if ftype == T_STRING:
         return txt
     if ftype == T_BYTES:
-        return txt.encode("latin-1")  # descriptor uses C-escaped latin-1
+        return _c_unescape(txt)
     if ftype == T_ENUM:
-        return txt                    # symbolic name; better than a wrong 0
+        return txt                    # symbolic; resolved by the pool
     try:
         return int(txt)
     except ValueError:
@@ -192,21 +242,50 @@ class DescriptorPool:
 
     def __init__(self, descriptor_set: bytes):
         self.messages: Dict[str, MessageSchema] = {}
+        self.enums: Dict[str, Dict[str, int]] = {}   # full name -> symbol -> num
         for num, _wt, v in iter_fields(descriptor_set):
             if num == 1:   # FileDescriptorSet.file
                 self._load_file(v)
+        # resolve symbolic enum defaults to NUMBERS now that every enum
+        # descriptor is loaded (decode yields enum numbers; a string default
+        # would make the same column int-or-str depending on field presence)
+        for schema in self.messages.values():
+            for f in schema.fields.values():
+                if f.type == T_ENUM and isinstance(f.default, str):
+                    f.default = self.enums.get(f.type_name, {}).get(f.default)
 
     def _load_file(self, fdp: bytes) -> None:
         package = ""
         msgs: List[bytes] = []
+        enums: List[bytes] = []
         for num, _wt, v in iter_fields(fdp):
             if num == 2:           # FileDescriptorProto.package
                 package = v.decode()
             elif num == 4:         # message_type
                 msgs.append(v)
+            elif num == 5:         # enum_type
+                enums.append(v)
         prefix = f".{package}" if package else ""
+        for e in enums:
+            self._load_enum(e, prefix)
         for m in msgs:
             self._load_message(m, prefix)
+
+    def _load_enum(self, edp: bytes, prefix: str) -> None:
+        name = ""
+        values: Dict[str, int] = {}
+        for num, _wt, v in iter_fields(edp):
+            if num == 1:           # EnumDescriptorProto.name
+                name = v.decode()
+            elif num == 2:         # value: EnumValueDescriptorProto
+                vname, vnum = "", 0
+                for n2, _w2, v2 in iter_fields(v):
+                    if n2 == 1:
+                        vname = v2.decode()
+                    elif n2 == 2:
+                        vnum = v2
+                values[vname] = vnum
+        self.enums[f"{prefix}.{name}"] = values
 
     def _load_message(self, dp: bytes, prefix: str) -> None:
         name = ""
@@ -220,6 +299,10 @@ class DescriptorPool:
             elif num == 3:         # nested_type
                 nested.append(v)
         full = f"{prefix}.{name}"
+        # nested enum types (DescriptorProto.enum_type = 4) share the walk
+        for num, _wt, v in iter_fields(dp):
+            if num == 4:
+                self._load_enum(v, full)
         schema = MessageSchema(full)
         for f in fields:
             fname = ""
@@ -369,8 +452,10 @@ def decode_message(pool: DescriptorPool, schema: MessageSchema,
             continue
         if f.repeated:
             out[f.name] = []
-        elif f.type == T_MESSAGE or f.in_oneof:
-            continue   # absent submessage / unset oneof arm stays null
+        elif f.type in (T_MESSAGE, T_GROUP) or f.in_oneof:
+            # absent submessage / skipped group / unset oneof arm stays null
+            # (a 0 fill for a group column would read as data, not absence)
+            continue
         elif f.default is not None:
             out[f.name] = f.default
         else:

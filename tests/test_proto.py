@@ -283,3 +283,34 @@ message Legacy {
     # unknown group field skips cleanly: SGROUP(field 9) varint EGROUP
     data = encode_message(p, schema, {"retries": 7}) + b"\x4b\x08\x01\x4c"
     assert decode_message(p, schema, data)["retries"] == 7
+
+
+def test_enum_and_bytes_defaults_resolved(tmp_path):
+    """Review round 3: enum defaults resolve to NUMBERS via the enum
+    descriptors; bytes defaults C-unescape; declared group fields stay null."""
+    src = """
+syntax = "proto2";
+package p3;
+enum Color { BLUE = 0; RED = 2; }
+message M {
+  optional Color c = 1 [default = RED];
+  optional bytes magic = 2 [default = "\\001\\377A"];
+  optional group Legacy = 3 { optional int32 x = 1; }
+}
+"""
+    desc = compile_proto(src, str(tmp_path))
+    p = DescriptorPool(desc)
+    schema = p.message("p3.M")
+    out = decode_message(p, schema, b"")          # everything absent
+    assert out["c"] == 2                          # RED -> number
+    assert out["magic"] == b"\x01\xffA"           # C-unescaped
+    assert "legacy" not in out                    # group stays null
+    # a message that SETS the group on the wire: skipped cleanly, others read
+    import subprocess
+    (tmp_path / "s.proto").write_text(src)
+    enc = subprocess.run(
+        ["protoc", f"--proto_path={tmp_path}", "--encode=p3.M",
+         str(tmp_path / "s.proto")],
+        input=b"c: BLUE Legacy { x: 9 }", capture_output=True, check=True)
+    out2 = decode_message(p, schema, enc.stdout)
+    assert out2["c"] == 0 and "legacy" not in out2
