@@ -86,8 +86,8 @@ def _i32_signed(u: int) -> int:
 
 
 def _skip_field(data: bytes, pos: int, wt: int) -> int:
-    """Advance past one field's payload (the ONE wire-type walk the group
-    skipper reuses — a second inlined copy would drift)."""
+    """Advance past one NON-GROUP field's payload (the one wire-type walk the
+    group skipper reuses — a second inlined copy would drift)."""
     if wt == _VARINT:
         _, pos = read_uvarint(data, pos)
     elif wt == _I64:
@@ -97,8 +97,6 @@ def _skip_field(data: bytes, pos: int, wt: int) -> int:
     elif wt == _LEN:
         n, pos = read_uvarint(data, pos)
         pos += n
-    elif wt == _SGROUP:
-        pos = _skip_group(data, pos)
     else:
         raise ProtoError(f"bad wire type {wt}")
     if pos > len(data):
@@ -107,13 +105,20 @@ def _skip_field(data: bytes, pos: int, wt: int) -> int:
 
 
 def _skip_group(data: bytes, pos: int) -> int:
-    """Scan past a group body to the matching end-group tag."""
-    while True:
+    """Scan past a group body to the matching end-group tag. ITERATIVE depth
+    counter, not recursion: nesting depth is attacker-controlled (600 nested
+    group tags fit in ~1.2KB of input) and must never exhaust the stack."""
+    depth = 1
+    while depth:
         tag, pos = read_uvarint(data, pos)
         wt = tag & 7
-        if wt == _EGROUP:
-            return pos
-        pos = _skip_field(data, pos, wt)
+        if wt == _SGROUP:
+            depth += 1
+        elif wt == _EGROUP:
+            depth -= 1
+        else:
+            pos = _skip_field(data, pos, wt)
+    return pos
 
 
 def iter_fields(data: bytes) -> Iterator[Tuple[int, int, Any]]:
@@ -291,6 +296,7 @@ class DescriptorPool:
         name = ""
         fields: List[bytes] = []
         nested: List[bytes] = []
+        nested_enums: List[bytes] = []
         for num, _wt, v in iter_fields(dp):
             if num == 1:           # DescriptorProto.name
                 name = v.decode()
@@ -298,11 +304,11 @@ class DescriptorPool:
                 fields.append(v)
             elif num == 3:         # nested_type
                 nested.append(v)
+            elif num == 4:         # enum_type (nested)
+                nested_enums.append(v)
         full = f"{prefix}.{name}"
-        # nested enum types (DescriptorProto.enum_type = 4) share the walk
-        for num, _wt, v in iter_fields(dp):
-            if num == 4:
-                self._load_enum(v, full)
+        for e in nested_enums:
+            self._load_enum(e, full)
         schema = MessageSchema(full)
         for f in fields:
             fname = ""

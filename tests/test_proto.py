@@ -314,3 +314,12 @@ message M {
         input=b"c: BLUE Legacy { x: 9 }", capture_output=True, check=True)
     out2 = decode_message(p, schema, enc.stdout)
     assert out2["c"] == 0 and "legacy" not in out2
+
+
+def test_deeply_nested_group_skip_is_iterative():
+    """Review round 4: 600 nested group tags (~1.2KB of hostile input) must
+    raise ProtoError on truncation, never RecursionError."""
+    data = b"\x0b" * 600 + b"\x0c" * 600       # field 1 SGROUP x600, EGROUP x600
+    assert list(iter_fields(data)) == []        # fully skipped, no error
+    with pytest.raises(ProtoError):             # truncated: missing EGROUPs
+        list(iter_fields(b"\x0b" * 600))

@@ -215,6 +215,19 @@ def test_http_cluster_multistage_join(http_cluster):
     from pinot_tpu.utils.metrics import get_registry
     stages_before = get_registry().counter_value("pinot_server_join_stages")
     bc = BrokerClient(http_cluster["bsvc"].url)
+
+    # the broker's catalog mirror polls — retry until it converges (same race
+    # note as test_http_cluster_query; pooled keep-alive clients are fast
+    # enough to catch the mirror mid-sync)
+    def join_rows():
+        try:
+            return bc.query(
+                "SELECT c.state, SUM(t.fare) AS total FROM trips t "
+                "JOIN cities c ON t.city = c.city GROUP BY c.state "
+                "ORDER BY total DESC")["resultTable"]["rows"]
+        except Exception:
+            return None
+    assert _wait_until(lambda: join_rows() == [["NY", 40.0], ["CA", 20.0]])
     resp = bc.query(
         "SELECT c.state, SUM(t.fare) AS total FROM trips t "
         "JOIN cities c ON t.city = c.city GROUP BY c.state ORDER BY total DESC")
@@ -255,6 +268,14 @@ def test_process_cluster_query_and_server_death(tmp_path):
 
         assert _wait_until(all_online, timeout=30.0)
 
+        # broker mirror may lag controller convergence — wait for full counts
+        def full_count():
+            try:
+                return cluster.query("SELECT COUNT(*), SUM(fare) FROM trips"
+                                     )["resultTable"]["rows"][0] == [8, 95.0]
+            except Exception:
+                return False
+        assert _wait_until(full_count, timeout=30.0)
         resp = cluster.query("SELECT COUNT(*), SUM(fare) FROM trips")
         assert resp["resultTable"]["rows"][0] == [8, 95.0]
         assert resp["numServersResponded"] == resp["numServersQueried"]
