@@ -400,6 +400,18 @@ class _ConnPool:
                 return
         conn.close()
 
+    def flush(self, scheme: str, host: str, port: int) -> None:
+        """Drop every idle connection to one endpoint (a staleness failure
+        means the peer restarted: its other parked connections are stale
+        too, and the retry must get a genuinely FRESH socket)."""
+        with self._lock:
+            stack = self._idle.pop(self._key(scheme, host, port), [])
+        for conn in stack:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def clear(self) -> None:
         with self._lock:
             stacks = list(self._idle.values())
@@ -422,7 +434,6 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
     host = parsed.hostname or "127.0.0.1"
     port = parsed.port or (443 if scheme == "https" else 80)
     path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
-    import http.client as _hc
     for attempt in (0, 1):
         conn, reused = _POOL.get(scheme, host, port, timeout)
         try:
@@ -436,9 +447,12 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
             # processed (RemoteDisconnected subclasses ConnectionResetError).
             # A TIMEOUT is NOT staleness — the server may be slow but
             # working, and replaying a non-idempotent POST would execute it
-            # twice (double segment upload / commit).
+            # twice (double segment upload / commit). A restarted peer
+            # leaves EVERY parked connection stale: flush them so the retry
+            # gets a genuinely fresh socket, not stale conn #2.
             if reused and attempt == 0 and isinstance(
                     e, (ConnectionResetError, BrokenPipeError)):
+                _POOL.flush(scheme, host, port)
                 continue
             raise
         if resp.status >= 300:

@@ -101,6 +101,11 @@ def _proto_reader(path: str) -> RecordReader:
     return ProtoRecordReader(path)
 
 
+def _thrift_reader(path: str) -> RecordReader:
+    from .thriftfmt import ThriftRecordReader   # lazy; <path>.thrift sidecar
+    return ThriftRecordReader(path)
+
+
 _READERS: Dict[str, Callable[[str], RecordReader]] = {
     "csv": CsvRecordReader,
     "json": JsonLineRecordReader,
@@ -110,6 +115,7 @@ _READERS: Dict[str, Callable[[str], RecordReader]] = {
     "avro": _avro_reader,
     "pb": _proto_reader,
     "protobuf": _proto_reader,
+    "thrift": _thrift_reader,
 }
 
 
