@@ -550,3 +550,34 @@ def test_sigkill_worker_process_mid_shuffle(tmp_path):
                 assert payload["resultTable"]["rows"]
         else:
             assert payload  # clean python-side error, not a hang
+
+
+def test_distributed_groupby_randomized_differential(shuffle_cluster):
+    """Randomized single-table aggregations through the partitioned mailbox
+    exchange vs sqlite3 — the same differential discipline the join paths
+    get (seeded, multiple shapes: group-by, HAVING, ORDER+LIMIT, filters)."""
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    rng = np.random.default_rng(4242)
+    aggs = ["COUNT(*)", "SUM(amount)", "MIN(qty)", "MAX(qty)", "SUM(qty)"]
+    for qi in range(15):
+        pick = list(rng.choice(aggs, rng.integers(1, 4), replace=False))
+        where = ""
+        if rng.random() < 0.5:
+            where = f" WHERE qty > {int(rng.integers(1, 15))}"
+        if rng.random() < 0.3:
+            c = f"amount < {round(float(rng.uniform(50, 450)), 2)}"
+            where = where + (" AND " if where else " WHERE ") + c
+        tail = ""
+        if rng.random() < 0.4:
+            tail = f" HAVING COUNT(*) > {int(rng.integers(1, 10))}"
+            if "COUNT(*)" not in pick:
+                pick.append("COUNT(*)")
+        sql = (f"SELECT cust_id, {', '.join(pick)} FROM orders{where} "
+               f"GROUP BY cust_id{tail} LIMIT 100000 "
+               f"OPTION(useMultistageEngine=true)")
+        resp, got = _query_rows(bc, sql)
+        assert resp.get("distributedGroupBy"), sql
+        oracle = _oracle(db, sql.split(" OPTION")[0])
+        assert _rows_match(got, oracle, 1e-6, 1e-4), \
+            f"q={qi}\n{sql}\nours({len(got)}): {got[:4]}\n" \
+            f"oracle({len(oracle)}): {oracle[:4]}"
