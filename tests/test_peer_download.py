@@ -66,7 +66,7 @@ def test_commit_and_convergence_survive_deepstore_outage(tmp_path):
             for i in range(10):
                 client.produce("pv_t", json.dumps(
                     {"u": f"u{i % 3}", "v": i, "ts": 1700000000000 + i}))
-            assert wait_until(lambda: count() == 10, timeout=30)
+            assert wait_until(lambda: count() == 10, timeout=60)
 
             _break_deepstore(str(tmp_path))
             try:
@@ -81,17 +81,17 @@ def test_commit_and_convergence_survive_deepstore_outage(tmp_path):
                     return {n: m for n, m in metas.items()
                             if m.get("status") == "DONE"}
                 assert wait_until(lambda: len(done_segments()) >= 1,
-                                  timeout=40), "commit must survive the outage"
+                                  timeout=90), "commit must survive the outage"
                 peer_segs = [n for n, m in done_segments().items()
                              if str(m.get("download_path", "")
                                     ).startswith("peer://")]
                 assert peer_segs, done_segments()
-                assert wait_until(lambda: count() == 40, timeout=30)
+                assert wait_until(lambda: count() == 40, timeout=60)
 
                 # EV converges: BOTH replicas serve the committed segment
                 def converged():
                     return cluster.controller.table_status(table)["converged"]
-                assert wait_until(converged, timeout=30)
+                assert wait_until(converged, timeout=60)
 
                 # a server that must DOWNLOAD the segment (post-restart, local
                 # data wiped) fetches it from a peer, deep store still dead
@@ -100,9 +100,9 @@ def test_commit_and_convergence_survive_deepstore_outage(tmp_path):
                 shutil.rmtree(os.path.join(str(tmp_path), "server_1", table),
                               ignore_errors=True)
                 cluster.restart_server("server_1")
-                assert wait_until(converged, timeout=40), \
+                assert wait_until(converged, timeout=90), \
                     "restarted replica must converge via peer download"
-                assert wait_until(lambda: count() == 40, timeout=30)
+                assert wait_until(lambda: count() == 40, timeout=60)
             finally:
                 _restore_deepstore(str(tmp_path))
 
