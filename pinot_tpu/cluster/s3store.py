@@ -42,11 +42,11 @@ def _hmac(key: bytes, msg: str) -> bytes:
 
 
 def sigv4_signature(secret_key: str, region: str, amz_date: str,
-                    string_to_sign: str) -> str:
+                    string_to_sign: str, service: str = "s3") -> str:
     date = amz_date[:8]
     k = _hmac(("AWS4" + secret_key).encode(), date)
     k = _hmac(k, region)
-    k = _hmac(k, "s3")
+    k = _hmac(k, service)
     k = _hmac(k, "aws4_request")
     return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
 
@@ -76,8 +76,9 @@ def sigv4_canonical(method: str, path: str, query: str, host: str,
     return canonical, signed
 
 
-def sigv4_string_to_sign(canonical: str, amz_date: str, region: str) -> str:
-    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+def sigv4_string_to_sign(canonical: str, amz_date: str, region: str,
+                         service: str = "s3") -> str:
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
     return "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
                       hashlib.sha256(canonical.encode()).hexdigest()])
 
@@ -85,7 +86,8 @@ def sigv4_string_to_sign(canonical: str, amz_date: str, region: str) -> str:
 def sign_request(method: str, url: str, payload: bytes, access_key: str,
                  secret_key: str, region: str,
                  amz_date: Optional[str] = None,
-                 payload_sha: Optional[str] = None) -> Dict[str, str]:
+                 payload_sha: Optional[str] = None,
+                 service: str = "s3") -> Dict[str, str]:
     """Headers for a sigv4-signed S3 request (spec: Authorization header
     form). `amz_date` is injectable for golden tests; `payload_sha` lets
     streaming uploads pre-hash the body without buffering it."""
@@ -97,15 +99,37 @@ def sign_request(method: str, url: str, payload: bytes, access_key: str,
         payload_sha = hashlib.sha256(payload or b"").hexdigest()
     canonical, signed = sigv4_canonical(method, parsed.path, parsed.query,
                                         parsed.netloc, amz_date, payload_sha)
-    sts = sigv4_string_to_sign(canonical, amz_date, region)
-    sig = sigv4_signature(secret_key, region, amz_date, sts)
-    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    sts = sigv4_string_to_sign(canonical, amz_date, region, service)
+    sig = sigv4_signature(secret_key, region, amz_date, sts, service)
+    scope = f"{amz_date[:8]}/{region}/{service}/aws4_request"
     return {
         "x-amz-date": amz_date,
         "x-amz-content-sha256": payload_sha,
         "Authorization": (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
                           f"SignedHeaders={signed}, Signature={sig}"),
     }
+
+
+def sigv4_verify(headers, method: str, path: str, query: str, body: bytes,
+                 access_key: str, secret_key: str, region: str,
+                 service: str = "s3") -> bool:
+    """Stub-side verification (shared by S3StubServer and KinesisStub):
+    payload-hash binding, Credential access-key match, signature match."""
+    import hmac as _hmac2
+    auth = headers.get("Authorization", "")
+    amz_date = headers.get("x-amz-date", "")
+    sha = headers.get("x-amz-content-sha256", "")
+    if not auth.startswith("AWS4-HMAC-SHA256") or not amz_date:
+        return False
+    if hashlib.sha256(body).hexdigest() != sha:
+        return False
+    canonical, _ = sigv4_canonical(method, path, query,
+                                   headers.get("Host", ""), amz_date, sha)
+    sts = sigv4_string_to_sign(canonical, amz_date, region, service)
+    want = sigv4_signature(secret_key, region, amz_date, sts, service)
+    got = auth.rsplit("Signature=", 1)[-1].strip()
+    cred = auth.split("Credential=", 1)[-1].split("/", 1)[0]
+    return cred == access_key and _hmac2.compare_digest(want, got)
 
 
 # ---------------------------------------------------------------------------
@@ -362,24 +386,10 @@ class S3StubServer:
             def _authorized(self, payload: bytes) -> bool:
                 if not stub.access_key:
                     return True
-                auth = self.headers.get("Authorization", "")
-                amz_date = self.headers.get("x-amz-date", "")
-                sha = self.headers.get("x-amz-content-sha256", "")
-                if not auth.startswith("AWS4-HMAC-SHA256") or not amz_date:
-                    return False
-                if hashlib.sha256(payload).hexdigest() != sha:
-                    return False
                 parsed = urllib.parse.urlparse(self.path)
-                canonical, _ = sigv4_canonical(
-                    self.command, parsed.path, parsed.query,
-                    self.headers.get("Host", ""), amz_date, sha)
-                sts = sigv4_string_to_sign(canonical, amz_date, stub.region)
-                want = sigv4_signature(stub.secret_key, stub.region, amz_date,
-                                       sts)
-                got = auth.rsplit("Signature=", 1)[-1].strip()
-                cred = auth.split("Credential=", 1)[-1].split("/", 1)[0]
-                return cred == stub.access_key and hmac.compare_digest(want,
-                                                                       got)
+                return sigv4_verify(self.headers, self.command, parsed.path,
+                                    parsed.query, payload, stub.access_key,
+                                    stub.secret_key, stub.region)
 
             def _dispatch(self) -> None:
                 if stub.outage:

@@ -50,7 +50,7 @@ _REGISTRIES: Dict[str, Callable[[], Dict[str, Any]]] = {
 
 # modules whose import registers built-in plugins lazily (reference: the
 # always-on plugins shipped inside pinot-plugins/)
-_BUILTIN_MODULES = ["pinot_tpu.ingest.kafkalite"]
+_BUILTIN_MODULES = ["pinot_tpu.ingest.kafkalite", "pinot_tpu.ingest.kinesislite"]
 _loaded_builtins = False
 
 
