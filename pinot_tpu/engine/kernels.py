@@ -366,9 +366,21 @@ def _make_body(spec: KernelSpec):
                     # needs the id set — dictionaries differ per segment.
                     size = spec.distinct_lut_sizes[ai]
                     col_ids = ids[agg.arg.name].ravel()
-                    if size <= MATMUL_KEY_CAP:
+                    wants_counts = getattr(agg, "wants_id_counts", False)
+                    # count consumers (t-digest: per-id multiplicities as
+                    # centroid weights) need the EXACT histogram; f32 matmul
+                    # cells stop incrementing past 2^24, so blocks that could
+                    # overflow a cell take the int32 scatter (same guard as
+                    # the grouped sum path). Presence consumers (>0) are
+                    # immune to the saturation and keep the matmul.
+                    counts_exact = mask.size <= (1 << 24)
+                    if size <= MATMUL_KEY_CAP and (not wants_counts
+                                                   or counts_exact):
                         counts = _presence_2d(fmask, col_ids, size)
-                        out[f"{ai}.distinct"] = (counts > 0).astype(jnp.int32)
+                        if wants_counts:
+                            out[f"{ai}.distinct"] = counts.astype(jnp.int32)
+                        else:
+                            out[f"{ai}.distinct"] = (counts > 0).astype(jnp.int32)
                     else:
                         out[f"{ai}.distinct"] = jax.ops.segment_sum(
                             mask.ravel().astype(jnp.int32), col_ids, num_segments=size)

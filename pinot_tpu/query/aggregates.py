@@ -611,17 +611,40 @@ class PercentileTDigestAgg(AggFunc):
     pct_base = "percentiletdigest"  # suffix parsing base — MV subclasses keep
     # the parent's base because their call name was already 'mv'-stripped
     COMPRESSION = 100.0
+    # device path: ride the per-dict-id COUNT vector (not mere presence) —
+    # a dictionary's sorted values + masked multiplicities build the digest
+    # at O(cardinality) host cost after the row-sized work ran on device
+    device_outputs = ("distinct",)
+    wants_id_counts = True
 
     def __init__(self, call: Function):
         super().__init__(call)
         self.pct = _parse_percentile(call, self.pct_base)
 
     def device_ok(self, ctx: AggContext) -> bool:
-        return False
+        return ctx.arg_is_dict_column and ctx.arg_is_numeric
 
     def host_state(self, values):
         from .sketches import TDigest
         return TDigest.from_values(values, self.COMPRESSION)
+
+    def state_from_id_counts(self, dictionary, counts: np.ndarray):
+        """Counts per dict id -> weighted digest over the SORTED dictionary
+        values. The float64 value array caches ON the dictionary (lifetime =
+        the segment's, same as HLL's bucket/rank table): a grouped decode
+        calls this once per group, and re-materializing the dictionary per
+        group would cost O(groups x cardinality)."""
+        from .sketches import TDigest
+        vals = getattr(dictionary, "_td_vals", None)
+        if vals is None or len(vals) < len(counts):
+            vals = np.asarray(dictionary.take(np.arange(len(counts))),
+                              dtype=np.float64)
+            try:
+                dictionary._td_vals = vals
+            except AttributeError:
+                pass
+        return TDigest.from_weighted(vals[:len(counts)], counts,
+                                     self.COMPRESSION)
 
     def merge(self, a, b):
         return a.merge(b)
@@ -1252,6 +1275,14 @@ class PercentileSmartTDigestAgg(PercentileTDigestAgg):
     name = "percentilesmarttdigest"
     pct_base = "percentilesmarttdigest"
     DEFAULT_THRESHOLD = 100_000
+    # NOT the inherited device counts path: smart's state is ("exact"|
+    # "digest", v) tuples and its exact-below-threshold contract needs raw
+    # values, which the per-id count vector cannot restore
+    device_outputs = ()
+    wants_id_counts = False
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
 
     def __init__(self, call: Function):
         super().__init__(call)

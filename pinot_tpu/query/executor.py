@@ -235,8 +235,12 @@ class ServerQueryExecutor:
                 if i in distinct_readers:
                     reader = distinct_readers[i]
                     presence = outs[f"{i}.distinct"][k][:reader.cardinality]
-                    states.append(agg.state_from_present_ids(
-                        reader.dictionary, np.nonzero(presence > 0)[0]))
+                    if getattr(agg, "wants_id_counts", False):
+                        states.append(agg.state_from_id_counts(
+                            reader.dictionary, np.asarray(presence)))
+                    else:
+                        states.append(agg.state_from_present_ids(
+                            reader.dictionary, np.nonzero(presence > 0)[0]))
                     continue
                 o = {"count": int(counts[k])}
                 for out_name in agg.device_outputs:
@@ -254,6 +258,11 @@ class ServerQueryExecutor:
             if "distinct" in agg.device_outputs:
                 presence = outs[f"{i}.distinct"]
                 reader = seg.column(agg.arg.name)
+                if getattr(agg, "wants_id_counts", False):
+                    states.append(agg.state_from_id_counts(
+                        reader.dictionary,
+                        np.asarray(presence[:reader.cardinality])))
+                    continue
                 present_ids = np.nonzero(presence[:reader.cardinality] > 0)[0]
                 states.append(agg.state_from_present_ids(reader.dictionary,
                                                          present_ids))

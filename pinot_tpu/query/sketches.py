@@ -154,6 +154,24 @@ class TDigest:
             td._compress()
         return td
 
+    @classmethod
+    def from_weighted(cls, values: np.ndarray, weights: np.ndarray,
+                      compression: float = 100.0) -> "TDigest":
+        """Digest from (value, multiplicity) pairs — the device path's shape:
+        a dictionary's sorted values with per-id masked row counts, so the
+        build cost is O(cardinality), not O(rows)."""
+        td = cls(compression)
+        v = np.asarray(values, dtype=np.float64)
+        w = np.asarray(weights, dtype=np.float64)
+        keep = (w > 0) & ~np.isnan(v)
+        v, w = v[keep], w[keep]
+        if len(v):
+            order = np.argsort(v, kind="stable")
+            td.means = v[order]
+            td.weights = w[order]
+            td._compress()
+        return td
+
     def merge(self, other: "TDigest") -> "TDigest":
         out = TDigest(max(self.compression, other.compression))
         out.means = np.concatenate([self.means, other.means])

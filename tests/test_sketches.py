@@ -377,3 +377,78 @@ def test_grouped_distinct_family_device_matches_host(tmp_path):
     merged = merge_segment_results([host.execute_segment(ctx, seg)], aggs)
     host_rows = reduce_to_result(ctx, merged, aggs, list(ctx.group_by)).rows
     assert dev_rows == host_rows
+
+
+def test_tdigest_device_counts_path(tmp_path):
+    """r4: PERCENTILETDIGEST over a dict column rides the per-id COUNT vector
+    (weighted digest over the sorted dictionary at cardinality cost) — device
+    plan verified, quantiles match numpy and the host path within digest
+    error, scalar + grouped + mesh."""
+    from pinot_tpu.parallel import MeshQueryExecutor, default_mesh
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.planner import plan_segment
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import load_segment
+    from pinot_tpu.segment.writer import build_aligned_segments
+
+    rng = np.random.default_rng(8)
+    n = 40_000
+    # bounded-cardinality numeric: stays dictionary-encoded
+    vals = np.round(rng.normal(500, 120, n)).astype(np.int32)
+    cols = {"g": [f"g{i % 4}" for i in range(n)],
+            "price": vals, "pad": np.arange(n, dtype=np.int32)}
+    schema = Schema("td", [dimension("g"),
+                           metric("price", DataType.INT),
+                           metric("pad", DataType.INT)])
+    paths = build_aligned_segments(schema, cols, str(tmp_path), "td", 8)
+    segs = [load_segment(p) for p in paths]
+
+    sql = ("SELECT PERCENTILETDIGEST(price, 95), PERCENTILETDIGEST50(price) "
+           "FROM td WHERE pad < 30000")
+    ctx = compile_query(sql, segs[0].schema)
+    plan = plan_segment(ctx, segs[0])
+    assert plan.kind == "device", plan.fallback_reason
+
+    res = execute_query(segs, sql)
+    m = cols["pad"] < 30000
+    assert res.rows[0][0] == pytest.approx(np.percentile(vals[m], 95), rel=0.02)
+    assert res.rows[0][1] == pytest.approx(np.percentile(vals[m], 50), rel=0.02)
+
+    # host path agrees (same merge chain, different state construction)
+    host = ServerQueryExecutor(use_device=False).execute(segs, sql)
+    assert res.rows[0][0] == pytest.approx(host.rows[0][0], rel=0.02)
+
+    # grouped on the mesh: per-group count matrices psum across devices
+    gsql = ("SELECT g, PERCENTILETDIGEST(price, 50) FROM td "
+            "GROUP BY g ORDER BY g LIMIT 10")
+    mesh = MeshQueryExecutor(default_mesh(8)).execute(segs, gsql)
+    garr = np.array(cols["g"], dtype=object)
+    for g, got in mesh.rows:
+        want = np.percentile(vals[garr == g], 50)
+        assert got == pytest.approx(want, rel=0.03), (g, got, want)
+
+
+def test_smart_tdigest_stays_on_host(tmp_path):
+    """Review round: PERCENTILESMARTTDIGEST keeps its tuple state + exact-
+    below-threshold contract — it must NOT inherit the device counts path."""
+    import numpy as np
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+
+    rng = np.random.default_rng(2)
+    n = 20_000
+    vals = rng.integers(0, 500, n).astype(np.int32)
+    schema = Schema("sm", [dimension("g"), metric("p", DataType.INT)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"g": ["a"] * n, "p": vals}, str(tmp_path), "sm_0"))
+    sql = "SELECT PERCENTILESMARTTDIGEST(p, 50) FROM sm"
+    ctx = compile_query(sql, schema)
+    plan = plan_segment(ctx, seg)
+    assert plan.kind != "device" or all(
+        a.name != "percentilesmarttdigest" or not a.device_outputs
+        for a in plan.aggs)
+    res = execute_query([seg], sql)
+    assert res.rows[0][0] == pytest.approx(np.percentile(vals, 50), abs=1.0)
