@@ -1,0 +1,143 @@
+"""Pallas TPU scan kernel: the fused filter+aggregate hot loop, hand-tiled.
+
+The default engine path (`engine/kernels.py`) expresses the per-segment scan
+as one jit program and lets XLA fuse it; this module is the SAME masked
+multi-sum scan written as an explicit Pallas kernel — VMEM-resident row
+blocks walked by a 1-D grid, per-block partials in lane-aligned (8, 128)
+tiles, cross-block reduce outside.
+
+MEASURED (v5e via the axon relay, 8M rows x 5 i32/f32 columns, 5-predicate
+mask, 2 sums, 32k-row blocks, CSE-proof data-dependent chaining — a naive
+repeat-and-divide harness lets XLA dedupe identical pure calls and
+misreports ~10x): **Pallas ~7.4 ms vs XLA fusion ~13.0 ms per chained
+dispatch (~1.75x)**. Under the engine's REAL serving shape — independent
+pipelined dispatches through `MeshQueryExecutor.execute_many` — the XLA
+path measures 2.26B rows/s effective on 16M rows, above either chained
+number, so the comparison is pipelining-sensitive: XLA remains the default
+this round, and this kernel is the measured foundation for integrating
+hand-scheduled scans where the chained-dispatch advantage carries over.
+Run `python -m pinot_tpu.engine.pallas_scan` to reproduce on the current
+chip.
+
+Correctness is pinned by tests in interpret mode (runs on CPU) and on the
+TPU when one is attached.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_ROWS = 1 << 15   # VMEM row-block (32k rows x ~5 cols x 4B ≈ 640KB)
+
+
+def masked_sums_pallas(mask_cols: Sequence[jnp.ndarray],
+                       thresholds,
+                       sum_rows: Sequence[jnp.ndarray],
+                       block_rows: int = BLOCK_ROWS,
+                       interpret: bool = False) -> jnp.ndarray:
+    """sum_j(sum_rows[j] * mask) for mask = AND of range predicates.
+
+    `mask_cols` = [od, disc, qty]-style i32 columns; `thresholds` = for each
+    column a (lo, hi) inclusive band (use INT32_MIN/MAX for one-sided);
+    `sum_rows` = float32 rows to sum under the mask. All columns must share
+    one length that is a multiple of `block_rows` (the caller pads — the
+    engine's datablocks already are). Returns float32[len(sum_rows) + 1]:
+    the sums followed by the mask count."""
+    from jax.experimental import pallas as pl
+
+    n = int(mask_cols[0].shape[0])
+    if n % block_rows:
+        raise ValueError(f"rows {n} not a multiple of block {block_rows}")
+    grid = n // block_rows
+    n_mask = len(mask_cols)
+    n_sums = len(sum_rows)
+    bands = np.asarray(thresholds, dtype=np.int32).reshape(n_mask, 2)
+
+    def kernel(*refs):
+        ins = refs[:-1]
+        o_ref = refs[-1]
+        m = None
+        for c in range(n_mask):
+            col = ins[c][...]
+            leaf = (col >= bands[c, 0]) & (col <= bands[c, 1])
+            m = leaf if m is None else (m & leaf)
+        fm = m.astype(jnp.float32)
+        partials: List[jnp.ndarray] = []
+        for j in range(n_sums):
+            partials.append((ins[n_mask + j][...] * fm).sum())
+        partials.append(fm.sum())
+        # lane-aligned (8, 128) partial tile; scalar scatter is not lowerable
+        # on TPU, so the tile is built with iota masks
+        row = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+        tile = jnp.zeros((8, 128), dtype=jnp.float32)
+        for j, s in enumerate(partials):
+            tile = tile + jnp.where((row == 0) & (col == j), s, 0.0)
+        o_ref[...] = tile
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((block_rows,), lambda i: (i,))
+                  for _ in range(n_mask + n_sums)],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((grid * 8, 128), jnp.float32),
+        interpret=interpret,
+    )(*mask_cols, *sum_rows)
+    return out.reshape(grid, 8, 128).sum(axis=0)[0, :n_sums + 1]
+
+
+def masked_sums_xla(mask_cols, thresholds, sum_rows) -> jnp.ndarray:
+    """The XLA-fused reference implementation of the same contract."""
+    bands = np.asarray(thresholds, dtype=np.int32).reshape(len(mask_cols), 2)
+    m = None
+    for c, col in enumerate(mask_cols):
+        leaf = (col >= int(bands[c, 0])) & (col <= int(bands[c, 1]))
+        m = leaf if m is None else (m & leaf)
+    fm = m.astype(jnp.float32)
+    return jnp.stack([(r * fm).sum() for r in sum_rows] + [fm.sum()])
+
+
+def _bench() -> None:   # pragma: no cover - manual harness
+    import time
+    n = 1 << 23
+    rng = np.random.default_rng(0)
+    od = jnp.asarray(rng.integers(19920101, 19990101, n), dtype=jnp.int32)
+    disc = jnp.asarray(rng.integers(0, 11, n), dtype=jnp.int32)
+    qty = jnp.asarray(rng.integers(1, 51, n), dtype=jnp.int32)
+    price = jnp.asarray(rng.uniform(1, 10000, n), dtype=jnp.float32)
+    rev = jnp.asarray(rng.uniform(1, 60000, n), dtype=jnp.float32)
+    cols = (od, disc, qty)
+    bands = [(19930101, 19931231), (1, 3), (-(1 << 31), 24)]
+    rows = (price, rev)
+    fx = lambda *a: masked_sums_xla(a[:3], bands, a[3:])   # noqa: E731
+    fp = lambda *a: masked_sums_pallas(a[:3], bands, a[3:])  # noqa: E731
+    a = jax.device_get(jax.jit(fx)(*cols, *rows))
+    b = jax.device_get(jax.jit(fp)(*cols, *rows))
+    print("match:", np.allclose(a, b, rtol=1e-3))
+    for name, f in (("xla", fx), ("pallas", fp)):
+        # each iteration is DATA-DEPENDENT on the previous result: a chain of
+        # identical pure calls would be CSE'd by XLA into one computation and
+        # a divide-by-iters would misreport per-scan cost ~10x
+        def chain(od, disc, qty, price, rev, f=f):
+            acc = jnp.float32(0)
+            for _ in range(10):
+                out = f(od + (acc * 0).astype(jnp.int32), disc, qty,
+                        price, rev)
+                acc = acc + out.sum()
+            return acc
+        g = jax.jit(chain)
+        jax.device_get(g(*cols, *rows))
+        t0 = time.perf_counter()
+        jax.device_get(g(*cols, *rows))
+        dt = (time.perf_counter() - t0) / 10
+        print(f"{name}: {dt*1000:.2f} ms/scan ({n/dt/1e9:.1f}B rows/s, "
+              f"incl. amortized round trip)")
+
+
+if __name__ == "__main__":   # pragma: no cover
+    _bench()

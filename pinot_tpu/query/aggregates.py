@@ -1154,9 +1154,18 @@ class SumPrecisionAgg(AggFunc):
 
 
 class PercentileRawTDigestAgg(PercentileTDigestAgg):
-    """PERCENTILERAWTDIGEST — serialized t-digest (hex) for client-side merging."""
+    """PERCENTILERAWTDIGEST — serialized t-digest (hex) for client-side merging.
+
+    Host path ONLY: the device counts path builds one centroid per distinct
+    value, so the serialized bytes would differ between execution paths for
+    identical data — clients that store/diff raw digests need stability."""
     name = "percentilerawtdigest"
     pct_base = "percentilerawtdigest"
+    device_outputs = ()
+    wants_id_counts = False
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
 
     def finalize(self, state):
         return state.to_bytes().hex()
@@ -1260,9 +1269,16 @@ class DistinctCountRawHLLMVAgg(DistinctCountRawHLLAgg):
 
 class PercentileRawEstAgg(PercentileEstAgg):
     """PERCENTILERAWEST — serialized digest (hex); the reference serializes a
-    QuantileDigest, here the same t-digest state as PERCENTILERAWTDIGEST."""
+    QuantileDigest, here the same t-digest state as PERCENTILERAWTDIGEST.
+    Host path only, like the other RAW variant: serialized bytes must not
+    depend on the execution path."""
     name = "percentilerawest"
     pct_base = "percentilerawest"
+    device_outputs = ()
+    wants_id_counts = False
+
+    def device_ok(self, ctx: AggContext) -> bool:
+        return False
 
     def finalize(self, state):
         return state.to_bytes().hex()

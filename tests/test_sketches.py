@@ -452,3 +452,39 @@ def test_smart_tdigest_stays_on_host(tmp_path):
         for a in plan.aggs)
     res = execute_query([seg], sql)
     assert res.rows[0][0] == pytest.approx(np.percentile(vals, 50), abs=1.0)
+
+
+def test_est_on_device_and_raw_variants_on_host(tmp_path):
+    """Review round: PERCENTILEEST inherits the device counts path (audited:
+    int finalize of the same digest quantile); the RAW serialized variants
+    stay host-only so their hex payloads are execution-path-independent."""
+    import numpy as np
+    from pinot_tpu.query.aggregates import make_agg
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.planner import plan_segment
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+
+    rng = np.random.default_rng(6)
+    n = 30_000
+    vals = rng.integers(0, 800, n).astype(np.int32)
+    schema = Schema("pe", [dimension("g"), metric("p", DataType.INT)])
+    seg = load_segment(SegmentBuilder(schema).build(
+        {"g": ["a"] * n, "p": vals}, str(tmp_path), "pe_0"))
+
+    ctx = compile_query("SELECT PERCENTILEEST90(p) FROM pe", schema)
+    assert plan_segment(ctx, seg).kind == "device"
+    res = execute_query([seg], "SELECT PERCENTILEEST90(p) FROM pe")
+    assert res.rows[0][0] == pytest.approx(np.percentile(vals, 90), abs=3)
+
+    for fn in ("PERCENTILERAWTDIGEST(p, 50)", "PERCENTILERAWEST50(p)"):
+        sql = f"SELECT {fn} FROM pe"
+        ctx2 = compile_query(sql, schema)
+        plan2 = plan_segment(ctx2, seg)
+        raw_aggs = [a for a in plan2.aggs if a.name.startswith("percentileraw")]
+        assert all(not a.device_outputs for a in raw_aggs), fn
+        # identical hex regardless of use_device flag
+        a = execute_query([seg], sql).rows[0][0]
+        b = ServerQueryExecutor(use_device=False).execute([seg], sql).rows[0][0]
+        assert a == b, fn
