@@ -158,9 +158,86 @@ class MemDeepStore(DeepStoreFS):
         return sorted(names)
 
 
+class RemoteObjectFS(DeepStoreFS):
+    """Shared shape of bytes-by-key object stores (S3/GCS): spec parsing,
+    key prefixing, recursive delete with failure COLLECTION (a swallowed
+    per-key failure would report success while orphaning blobs), and
+    object-then-prefix existence. Concrete stores implement the wire:
+    `_head_ok(key)`, `_delete_object(key)` (missing keys raise an OSError
+    with .status == 404), `_list_keys(prefix, limit)`, put/get_bytes."""
+
+    def _parse_spec(self, root: str, what: str) -> dict:
+        import urllib.parse
+        base, _, query = root.partition("?")
+        params = dict(urllib.parse.parse_qsl(query))
+        self.endpoint = params.get("endpoint", "").rstrip("/")
+        if not self.endpoint:
+            raise ValueError(
+                f"{what} deep store requires ?endpoint=http://host:port "
+                f"(no default cloud endpoint in this environment)")
+        self.bucket, _, prefix = base.strip("/").partition("/")
+        if not self.bucket:
+            raise ValueError(f"{what} spec needs a bucket: "
+                             f"{what}://bucket[/prefix]?...")
+        self.prefix = prefix.strip("/")
+        self.timeout_s = float(params.get("timeoutSec", 30.0))
+        self.page_size = int(params.get("pageSize", 1000))
+        return params
+
+    def _key(self, uri: str) -> str:
+        key = uri.strip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    # wire primitives concrete stores provide -------------------------------
+    def _head_ok(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def _delete_object(self, key: str) -> None:
+        raise NotImplementedError
+
+    def _list_keys(self, prefix: str, limit: int = 1 << 31) -> List[str]:
+        raise NotImplementedError
+
+    # shared semantics ------------------------------------------------------
+    def download(self, uri: str, local_path: str) -> None:
+        data = self.get_bytes(uri)
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(data)
+
+    def delete(self, uri: str) -> None:
+        key = self._key(uri)
+        failures: List[str] = []
+        for k in self._list_keys(key + "/"):
+            try:
+                self._delete_object(k)
+            except OSError as e:
+                if getattr(e, "status", None) != 404:
+                    failures.append(f"{k}: {e}")
+        try:
+            self._delete_object(key)
+        except OSError as e:
+            if getattr(e, "status", None) != 404:
+                raise
+        if failures:
+            raise OSError(f"{len(failures)} objects not deleted "
+                          f"({failures[0]} ...)")
+
+    def exists(self, uri: str) -> bool:
+        key = self._key(uri)
+        if self._head_ok(key):
+            return True
+        return bool(self._list_keys(key + "/", limit=1))
+
+
 def _s3_fs(root: str) -> DeepStoreFS:
     from .s3store import S3DeepStoreFS   # lazy: wire client loads on demand
     return S3DeepStoreFS(root)
+
+
+def _gcs_fs(root: str) -> DeepStoreFS:
+    from .gcsstore import GcsDeepStoreFS   # lazy
+    return GcsDeepStoreFS(root)
 
 
 # scheme -> factory callable (a class works too; reference: PinotFSFactory)
@@ -168,6 +245,7 @@ _FS_REGISTRY: Dict[str, Callable[[str], DeepStoreFS]] = {
     "local": LocalDeepStore,
     "mem": MemDeepStore,
     "s3": _s3_fs,
+    "gs": _gcs_fs,
 }
 
 

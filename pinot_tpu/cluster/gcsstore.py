@@ -1,0 +1,292 @@
+"""GCS-wire deep store: the JSON/object API as a PinotFS-analog scheme.
+
+Analog of the reference's GCS plugin
+(`pinot-plugins/pinot-file-system/pinot-gcs/src/main/java/org/apache/pinot/
+plugin/filesystem/GcsPinotFS.java`): objects addressed bucket/name over the
+Cloud Storage JSON API — media upload (`POST /upload/storage/v1/b/{b}/o?
+uploadType=media&name=...`), media download (`GET /storage/v1/b/{b}/o/{o}?
+alt=media`), delete, and list with `prefix`/`delimiter`/`pageToken`
+pagination — with Bearer-token auth. The in-repo `GcsStub` proves the wire
+seam like `S3StubServer` does for S3: pointing the client at a real
+endpoint (or fake-gcs-server) is a config change.
+
+Spec: `gs://bucket/prefix?endpoint=http://host:port[&token=...]`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .deepstore import RemoteObjectFS
+
+
+class GcsError(OSError):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"GCS {status}: {message}")
+        self.status = status
+
+
+class GcsDeepStoreFS(RemoteObjectFS):
+    """Bytes-by-URI against a GCS JSON-API endpoint (no rename, like
+    GcsPinotFS: move = copy + delete via the base class). Spec parsing /
+    recursive delete / existence semantics are the RemoteObjectFS contract;
+    this class is the JSON-API wire (Bearer auth, pageToken pagination)."""
+
+    scheme = "gs"
+
+    def __init__(self, root: str):
+        params = self._parse_spec(root, "gs")
+        self.token = params.get("token", "")
+
+    # -- wire ---------------------------------------------------------------
+    def _headers(self) -> Dict[str, str]:
+        return {"Authorization": f"Bearer {self.token}"} if self.token else {}
+
+    def _call(self, method: str, url: str, body: Optional[bytes] = None,
+              headers: Optional[Dict[str, str]] = None) -> bytes:
+        from .http_service import HttpError, _pooled_request
+        h = self._headers()
+        if headers:
+            h.update(headers)
+        try:
+            return _pooled_request(method, url, body, h, self.timeout_s)
+        except HttpError as e:
+            raise GcsError(e.status, str(e)) from None
+
+    # -- DeepStoreFS --------------------------------------------------------
+    def put_bytes(self, data: bytes, uri: str) -> None:
+        q = urllib.parse.urlencode({"uploadType": "media",
+                                    "name": self._key(uri)})
+        self._call("POST",
+                   f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o?{q}",
+                   data, {"Content-Type": "application/octet-stream"})
+
+    def upload(self, local_path: str, uri: str) -> None:
+        """STREAMING: the tar is sent from the open file with an explicit
+        Content-Length — a multi-GB segment never buffers in memory (the
+        deep-store contract S3DeepStoreFS documents and upholds)."""
+        import urllib.request
+        q = urllib.parse.urlencode({"uploadType": "media",
+                                    "name": self._key(uri)})
+        url = f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o?{q}"
+        headers = dict(self._headers())
+        headers["Content-Type"] = "application/octet-stream"
+        headers["Content-Length"] = str(os.path.getsize(local_path))
+        with open(local_path, "rb") as f:
+            req = urllib.request.Request(url, data=f, method="POST",
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as resp:
+                    resp.read()
+            except urllib.error.HTTPError as e:
+                raise GcsError(e.code,
+                               e.read()[:200].decode(errors="replace")
+                               ) from None
+
+    def get_bytes(self, uri: str) -> bytes:
+        obj = urllib.parse.quote(self._key(uri), safe="")
+        try:
+            return self._call(
+                "GET",
+                f"{self.endpoint}/storage/v1/b/{self.bucket}/o/{obj}?alt=media")
+        except GcsError as e:
+            if e.status == 404:
+                raise FileNotFoundError(f"gs://{self.bucket}/{self._key(uri)}"
+                                        ) from None
+            raise
+
+    def _delete_object(self, key: str) -> None:
+        obj = urllib.parse.quote(key, safe="")
+        self._call("DELETE",
+                   f"{self.endpoint}/storage/v1/b/{self.bucket}/o/{obj}")
+
+    def _head_ok(self, key: str) -> bool:
+        obj = urllib.parse.quote(key, safe="")
+        try:
+            self._call("GET",
+                       f"{self.endpoint}/storage/v1/b/{self.bucket}/o/{obj}")
+            return True
+        except GcsError as e:
+            if e.status != 404:
+                raise
+            return False
+
+    def _list_keys(self, prefix: str, limit: int = 1 << 31) -> List[str]:
+        return self._list(prefix, "", limit)
+
+    def _list(self, prefix: str, delimiter: str,
+              limit: int = 1 << 31) -> List[str]:
+        """Full item listing following pageToken pagination."""
+        names: List[str] = []
+        token = ""
+        while True:
+            params = {"prefix": prefix, "maxResults": str(self.page_size)}
+            if delimiter:
+                params["delimiter"] = delimiter
+            if token:
+                params["pageToken"] = token
+            payload = self._call(
+                "GET", f"{self.endpoint}/storage/v1/b/{self.bucket}/o?"
+                       f"{urllib.parse.urlencode(params)}")
+            d = json.loads(payload.decode())
+            names.extend(item["name"] for item in d.get("items", []))
+            names.extend(d.get("prefixes", []))
+            token = d.get("nextPageToken", "")
+            if not token or len(names) >= limit:
+                return names[:limit] if limit < (1 << 31) else names
+
+    def listdir(self, uri: str) -> List[str]:
+        key = self._key(uri)
+        prefix = key.rstrip("/") + "/" if key else (
+            f"{self.prefix}/" if self.prefix else "")
+        out = set()
+        for name in self._list(prefix, "/"):
+            out.add(name[len(prefix):].rstrip("/"))
+        return sorted(n for n in out if n)
+
+
+class GcsStub:
+    """Minimal Cloud Storage JSON-API endpoint: media upload/download,
+    delete, paginated list with prefixes; Bearer-token auth; an `outage`
+    switch for chaos tests."""
+
+    def __init__(self, bucket: str = "pinot", token: str = "",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.bucket = bucket
+        self.token = token
+        self.objects: Dict[str, bytes] = {}
+        self.outage = False
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status: int, body: bytes,
+                       ctype: str = "application/json") -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _err(self, status: int, msg: str) -> None:
+                self._reply(status, json.dumps(
+                    {"error": {"code": status, "message": msg}}).encode())
+
+            def _auth_ok(self) -> bool:
+                if not stub.token:
+                    return True
+                return self.headers.get("Authorization", "") \
+                    == f"Bearer {stub.token}"
+
+            def _dispatch(self, method: str) -> None:
+                if stub.outage:
+                    return self._err(503, "backendError")
+                if not self._auth_ok():
+                    return self._err(401, "unauthorized")
+                parsed = urllib.parse.urlparse(self.path)
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                parts = [p for p in parsed.path.split("/") if p]
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                # /upload/storage/v1/b/{bucket}/o  (media upload)
+                if method == "POST" and parts[:1] == ["upload"]:
+                    if parts[4] != stub.bucket:
+                        return self._err(404, "bucket")
+                    name = params.get("name", "")
+                    with stub._lock:
+                        stub.objects[name] = body
+                    return self._reply(200, json.dumps(
+                        {"name": name, "size": str(len(body))}).encode())
+                # /storage/v1/b/{bucket}/o[/...object...]
+                if parts[:2] != ["storage", "v1"] or parts[3] != stub.bucket:
+                    return self._err(404, "bucket")
+                obj = urllib.parse.unquote(parts[5]) if len(parts) > 5 else ""
+                if method == "GET" and not obj:
+                    return self._reply(200, stub._list_json(params))
+                if method == "GET":
+                    with stub._lock:
+                        data = stub.objects.get(obj)
+                    if data is None:
+                        return self._err(404, "notFound")
+                    if params.get("alt") == "media":
+                        return self._reply(200, data,
+                                           "application/octet-stream")
+                    return self._reply(200, json.dumps(
+                        {"name": obj, "size": str(len(data))}).encode())
+                if method == "DELETE":
+                    with stub._lock:
+                        existed = stub.objects.pop(obj, None)
+                    if existed is None:
+                        return self._err(404, "notFound")
+                    return self._reply(204, b"")
+                return self._err(405, "method")
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 64
+
+        self._server = _Server((host, port), Handler)
+        self._server.daemon_threads = True
+        self.url = f"http://{host}:{self._server.server_address[1]}"
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="gcs-stub")
+        self._thread.start()
+
+    def _list_json(self, params: Dict[str, str]) -> bytes:
+        prefix = params.get("prefix", "")
+        delimiter = params.get("delimiter", "")
+        max_results = min(int(params.get("maxResults", "1000")), 1000)
+        token = params.get("pageToken", "")
+        with self._lock:
+            keys = sorted(k for k in self.objects if k.startswith(prefix))
+            sizes = {k: len(self.objects[k]) for k in keys}
+        items: List[Tuple[str, bool]] = []
+        seen = set()
+        for k in keys:
+            if delimiter:
+                rest = k[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    if cp not in seen:
+                        seen.add(cp)
+                        items.append((cp, True))
+                    continue
+            items.append((k, False))
+        after = [it for it in items if it[0] > token]
+        page, more = after[:max_results], after[max_results:]
+        out: Dict[str, object] = {
+            "items": [{"name": k, "size": str(sizes.get(k, 0))}
+                      for k, cp in page if not cp],
+            "prefixes": [k for k, cp in page if cp],
+        }
+        if more:
+            out["nextPageToken"] = page[-1][0]
+        return json.dumps(out).encode()
+
+    def spec(self, prefix: str = "") -> str:
+        auth = f"&token={self.token}" if self.token else ""
+        p = f"/{prefix}" if prefix else ""
+        return f"gs://{self.bucket}{p}?endpoint={self.url}{auth}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

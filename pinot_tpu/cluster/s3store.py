@@ -154,38 +154,25 @@ def _raise_s3_error(e: "urllib.error.HTTPError") -> None:
                   payload[:200].decode(errors="replace")) from None
 
 
-class S3DeepStoreFS(DeepStoreFS):
+from .deepstore import RemoteObjectFS
+
+
+class S3DeepStoreFS(RemoteObjectFS):
     """Bytes-by-URI against an S3 endpoint (same shape as MemDeepStore: no
     rename — move() is the base class's copy+delete, exactly like
-    S3PinotFS.move doing copyObject+delete)."""
+    S3PinotFS.move doing copyObject+delete). Spec parsing / recursive delete
+    / existence semantics are the RemoteObjectFS contract; this class is the
+    S3 wire (sigv4, ListObjectsV2 pagination, XML)."""
 
     scheme = "s3"
 
     def __init__(self, root: str):
-        base, _, query = root.partition("?")
-        params = dict(urllib.parse.parse_qsl(query))
-        self.endpoint = params.get("endpoint", "").rstrip("/")
-        if not self.endpoint:
-            raise ValueError(
-                "s3 deep store requires ?endpoint=http://host:port "
-                "(no default AWS endpoint in this environment)")
-        self.bucket, _, prefix = base.strip("/").partition("/")
-        if not self.bucket:
-            raise ValueError("s3 spec needs a bucket: s3://bucket[/prefix]?...")
-        self.prefix = prefix.strip("/")
+        params = self._parse_spec(root, "s3")
         self.access_key = params.get("accessKey", "")
         self.secret_key = params.get("secretKey", "")
         self.region = params.get("region", "us-east-1")
-        self.timeout_s = float(params.get("timeoutSec", 30.0))
-        # ListObjectsV2 page size (real S3 caps at 1000; lowered in tests to
-        # exercise the pagination loop)
-        self.page_size = int(params.get("pageSize", 1000))
 
     # -- wire ---------------------------------------------------------------
-    def _key(self, uri: str) -> str:
-        key = uri.strip("/")
-        return f"{self.prefix}/{key}" if self.prefix else key
-
     def _url(self, key: str, query: str = "") -> str:
         path = f"/{self.bucket}/{urllib.parse.quote(key)}" if key \
             else f"/{self.bucket}"
@@ -234,12 +221,6 @@ class S3DeepStoreFS(DeepStoreFS):
     def put_bytes(self, data: bytes, uri: str) -> None:
         self._call("PUT", self._url(self._key(uri)), data)
 
-    def download(self, uri: str, local_path: str) -> None:
-        data = self.get_bytes(uri)
-        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-        with open(local_path, "wb") as f:
-            f.write(data)
-
     def get_bytes(self, uri: str) -> bytes:
         try:
             _, data = self._call("GET", self._url(self._key(uri)))
@@ -250,38 +231,17 @@ class S3DeepStoreFS(DeepStoreFS):
                                         ) from None
             raise
 
-    def delete(self, uri: str) -> None:
-        key = self._key(uri)
-        # S3 has no recursive delete: enumerate the prefix like S3PinotFS.
-        # Per-key failures are COLLECTED and re-raised — a swallowed 503 here
-        # would report success while orphaning blobs the metadata believes
-        # are gone.
-        failures: List[str] = []
-        for k in self._list_keys(key + "/"):
-            try:
-                self._call("DELETE", self._url(k))
-            except S3Error as e:
-                if e.status != 404:
-                    failures.append(f"{k}: {e}")
-        try:
-            self._call("DELETE", self._url(key))
-        except S3Error as e:
-            if e.status != 404:
-                raise
-        if failures:
-            raise S3Error(500, "IncompleteDelete",
-                          f"{len(failures)} objects not deleted "
-                          f"({failures[0]} ...)")
+    def _delete_object(self, key: str) -> None:
+        self._call("DELETE", self._url(key))
 
-    def exists(self, uri: str) -> bool:
-        key = self._key(uri)
+    def _head_ok(self, key: str) -> bool:
         try:
             self._call("HEAD", self._url(key))
             return True
         except S3Error as e:
             if e.status != 404:
                 raise
-        return bool(self._list_keys(key + "/", limit=1))
+            return False
 
     def _list_page(self, prefix: str, delimiter: str, token: str
                    ) -> Tuple[List[str], List[str], str]:

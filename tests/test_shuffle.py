@@ -581,3 +581,99 @@ def test_distributed_groupby_randomized_differential(shuffle_cluster):
         assert _rows_match(got, oracle, 1e-6, 1e-4), \
             f"q={qi}\n{sql}\nours({len(got)}): {got[:4]}\n" \
             f"oracle({len(oracle)}): {oracle[:4]}"
+
+
+def test_p2p_three_way_randomized_differential(shuffle_cluster):
+    """Randomized 3-table joins (worker-to-worker forwarding) vs sqlite3 —
+    the multi-stage pipeline gets the same fuzz discipline as single joins."""
+    bc, db = shuffle_cluster["bc"], shuffle_cluster["db"]
+    rng = np.random.default_rng(909)
+    for qi in range(8):
+        jt1 = ["JOIN", "LEFT JOIN"][rng.integers(0, 2)]
+        where = ""
+        if rng.random() < 0.5:
+            where = f" WHERE o.qty > {int(rng.integers(1, 15))}"
+        agg = ["COUNT(*)", "SUM(o.amount)",
+               "COUNT(*), SUM(o.amount), MIN(o.qty)"][rng.integers(0, 3)]
+        sql = (f"SELECT r.zone, {agg} FROM orders o "
+               f"{jt1} custs c ON o.cust_id = c.cust_id "
+               f"JOIN regions r ON c.region = r.region{where} "
+               f"GROUP BY r.zone LIMIT 1000")
+        resp, got = _query_rows(bc, sql)
+        assert resp.get("mailboxShuffle"), sql
+        oracle = _oracle(db, sql)
+        assert _rows_match(got, oracle, 1e-6, 1e-4), \
+            f"q={qi}\n{sql}\nours: {got[:4]}\noracle: {oracle[:4]}"
+
+
+def test_p2p_hybrid_table_time_boundary(tmp_path):
+    """A HYBRID table (offline + realtime halves) queried through the P2P
+    paths: the time-boundary split rides the leaf tasks' time filters, so
+    rows copied realtime->offline are never double-counted."""
+    import json as _json
+
+    from pinot_tpu.cluster.process import ProcessCluster
+    from pinot_tpu.ingest.kafkalite import LogBrokerClient, LogBrokerServer
+    from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+    from pinot_tpu.table import StreamConfig, TableType
+
+    DAY = 86400000
+    t0 = 1700000000000
+    schema = Schema("hy", [dimension("u", DataType.STRING),
+                           metric("v", DataType.LONG),
+                           date_time("ts", DataType.LONG)])
+    srv = LogBrokerServer()
+    try:
+        client = LogBrokerClient(srv.bootstrap)
+        client.create_topic("hy_t", 1)
+        with ProcessCluster(num_servers=2, work_dir=str(tmp_path)) as cluster:
+            cluster.controller.add_schema(schema)
+            # OFFLINE half: days 0-1 (END of day1 becomes the boundary)
+            off = TableConfig("hy", table_type=TableType.OFFLINE,
+                              time_column="ts")
+            cluster.controller.add_table(off)
+            from pinot_tpu.segment.writer import SegmentBuilder
+            b = SegmentBuilder(schema)
+            cluster.controller.upload_segment(
+                off.table_name_with_type,
+                b.build({"u": [f"u{i % 3}" for i in range(60)],
+                         "v": list(range(60)),
+                         "ts": [t0 + (i % 2) * DAY for i in range(60)]},
+                        str(tmp_path / "b"), "hy_0"))
+            # REALTIME half: re-ingests day 1 (30 overlapping rows the
+            # boundary must hide) + fresh day 2 rows
+            rt = TableConfig("hy", table_type=TableType.REALTIME,
+                             time_column="ts",
+                             replication=1,
+                             stream=StreamConfig(
+                                 stream_type="kafkalite", topic="hy_t",
+                                 properties={"bootstrap": srv.bootstrap},
+                                 flush_threshold_rows=10_000))
+            cluster.controller.add_table(rt, num_partitions=1)
+            for i in range(30):
+                client.produce("hy_t", _json.dumps(
+                    {"u": f"u{i % 3}", "v": 1000 + i, "ts": t0 + DAY}))
+            for i in range(40):
+                client.produce("hy_t", _json.dumps(
+                    {"u": f"u{i % 3}", "v": 2000 + i, "ts": t0 + 2 * DAY}))
+
+            def counts():
+                r = cluster.query("SELECT COUNT(*) FROM hy"
+                                  )["resultTable"]["rows"]
+                return r[0][0] if r else 0
+            # boundary: offline answers <= day1, realtime answers > day1 —
+            # total = 60 offline + 40 fresh realtime (30 overlaps hidden)
+            assert wait_until(lambda: counts() == 100, timeout=60), counts()
+
+            # distributed GROUP BY over the hybrid: same split, exact totals
+            resp = cluster.query(
+                "SELECT u, COUNT(*), SUM(v) FROM hy GROUP BY u ORDER BY u "
+                "LIMIT 10 OPTION(useMultistageEngine=true)")
+            rows = resp["resultTable"]["rows"]
+            assert resp.get("distributedGroupBy"), resp.keys()
+            assert sum(r[1] for r in rows) == 100
+            want_sum = (sum(range(60))
+                        + sum(2000 + i for i in range(40)))
+            assert sum(r[2] for r in rows) == want_sum
+    finally:
+        srv.stop()
