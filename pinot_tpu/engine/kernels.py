@@ -16,12 +16,12 @@ matmuls —
   contiguous id runs, resolved host-side at plan time);
 * dict decode -> host-materialized value columns cached in HBM (`datablock.values`);
 * group-by partials -> one-hot matmul `[rows, N] @ [N, keys]` up to MATMUL_KEY_CAP
-  (the common OLAP case; XLA fuses the iota-compare into the dot's tiles), per-key
-  broadcast-reduce for min/max, `segment_*` scatter above the caps and for WIDE
-  product spaces (grouped distinct presence: the combined keys*ids width makes the
-  fused matmul ~100x the scatter's in-program cost; scattered programs pipeline a
-  little worse on the relay — roughly one round trip per dispatch — so the caps
-  trade that against matmul FLOPs).
+  (the common OLAP case; XLA fuses the iota-compare into the dot's tiles), the
+  CHUNKED 64x64-tile matmul `_grouped_chunk64` from there to CHUNK_KEY_CAP
+  (high-cardinality group-by AND the grouped-distinct presence product space,
+  bf16 3-part-split operands at full MXU tile utilization), per-key
+  broadcast-reduce for min/max, `segment_*` scatter above CHUNK_KEY_CAP where
+  the chunked path's N*K MACs cross over the K-independent scatter.
 
 There is no 10k-doc batching loop (`DocIdSetPlanNode.MAX_DOC_PER_CALL`): the TPU analog of
 batching is the grid XLA tiles over the padded row axis. Kernels are cached by structural
@@ -57,9 +57,19 @@ _POWER_SUMS = {"sum": 1, "sum2": 2, "sum3": 3, "sum4": 4}
 
 # Above these sizes the matmul / broadcast-reduce does more device work than the extra
 # relay round trip a scatter costs; below them it stays at the dispatch latency floor.
-MATMUL_KEY_CAP = 8192     # one-hot matmul group-by partials (count/sum), MXU-bound
+# SKINNY one-hot matmul ([1+sums, N] @ [N, keys], f32 HIGHEST): each 128-wide
+# output column tile re-walks the full contraction, so cost grows linearly in
+# keys — measured v5e 16M rows count+sum: 21ms @256 keys, 51ms @1024, 162ms
+# @4096. The chunked 64x64 formulation overtakes it between 256 and 1024.
+MATMUL_KEY_CAP = 512      # skinny one-hot matmul group-by partials
 MINMAX_BCAST_CAP = 1024   # per-key broadcast-reduce min/max, VPU-bound
 DENSE_LUT_MATMUL_CAP = 8192  # scattered-LUT membership via one-hot matmul
+PRESENCE_MATMUL_CAP = 8192   # _presence_2d chunked presence counts
+# Mid/high-cardinality group-by rides the CHUNKED 64x64 one-hot matmul
+# (_grouped_chunk64): measured v5e 16M rows count+sum 24ms @1024..2048 keys,
+# 30ms @4096, 39ms @20k, 69ms @32k vs segment_sum scatter ~248ms
+# (K-independent) — the crossover back to the scatter sits near 128k keys.
+CHUNK_KEY_CAP = 131072
 
 
 @dataclass
@@ -68,7 +78,7 @@ class KernelSpec:
 
     filter: FilterProgram
     group_cols: Tuple[str, ...]            # dict-encoded group-by columns
-    num_keys_pad: int                      # pow2 >= product of real cardinalities
+    num_keys_pad: int   # >= product of real cardinalities (pow2 to 4096, then 4096-multiples)
     aggs: Tuple[Tuple[AggFunc, Tuple[str, ...]], ...]  # (func, device outputs)
     distinct_lut_sizes: Dict[int, int] = field(default_factory=dict)  # agg idx -> lut size
     padded_rows: int = 0
@@ -228,20 +238,25 @@ def _make_mask_fn(spec: KernelSpec):
 
 
 def _presence_2d(fmask: jnp.ndarray, col_ids: jnp.ndarray, size: int) -> jnp.ndarray:
-    """Per-dict-id masked row counts as a REAL MXU matmul (28B rows/s measured,
-    ~110x the one-hot matvec it replaces).
+    """Per-dict-id masked row counts as a REAL MXU matmul (~1.0B rows/s
+    measured CSE-proof at size=4096, ~15x the one-hot matvec it replaces;
+    an earlier 28B figure came from a repeat-and-divide harness XLA could
+    dedupe and overstated it ~15x — r5 re-measured with data-dependent
+    chaining: 16.5ms per 16M rows).
 
     A [1, N] @ one_hot[N, K] histogram has zero operand reuse — XLA streams
     N*K compare-accumulate work through the VPU (~66ms for N=16M, K=4096).
     Decomposing the id into digits, id = 64*hi + lo, turns the same histogram
     into `one_hot(hi)^T @ (fmask * one_hot(lo))`: a [64, N] @ [N, 64] matmul
-    whose output cell (hi, lo) is exactly count(id == 64*hi+lo, mask) — and a
-    64x64-output contraction is the MXU's home shape (~0.6ms measured; both
-    one-hots fuse into the dot's tiles, nothing is materialized). bf16
-    operands are EXACT here: every input is 0/1 or a 0/1-masked 0/1. Sizes
-    above 4096 split into 4096-wide chunks, one dot per chunk, rows routed to
-    their chunk by zeroing fmask elsewhere. Returns f32 counts[size]
-    (exact to 2^24 per cell per device)."""
+    whose output cell (hi, lo) is exactly count(id == 64*hi+lo, mask) — a
+    64x64-output contraction is a full MXU tile (both one-hots fuse into the
+    dot's operand tiles, nothing is materialized), and the remaining cost is
+    the contraction stream itself: the N-length contraction walks at ~8
+    elements/cycle/MXU whatever the output size, ~2ms per output tile per
+    16M rows on v5e. bf16 operands are EXACT here: every input is 0/1 or a
+    0/1-masked 0/1. Sizes above 4096 split into 4096-wide chunks, one dot
+    per chunk, rows routed to their chunk by zeroing fmask elsewhere.
+    Returns f32 counts[size] (exact to 2^24 per cell per device)."""
     bf = jnp.bfloat16
     if size >= 4096:
         hi_w = lo_w = 64
@@ -261,6 +276,67 @@ def _presence_2d(fmask: jnp.ndarray, col_ids: jnp.ndarray, size: int) -> jnp.nda
             preferred_element_type=jnp.float32).reshape(-1))
     counts = jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
     return counts[:size]
+
+
+def _grouped_chunk64(key: jnp.ndarray, nseg: int, exact_rows, split_rows):
+    """Per-key sums over a LARGE dense key space as chunked 64x64-tile one-hot
+    matmuls — the high-cardinality GROUP BY kernel (8192 < keys <= 128k).
+
+    Each 4096-key chunk c decomposes the in-chunk key into two 64-wide digits
+    and computes sums[hi, lo] = one_hot(hi)^T @ (row * one_hot(lo)) — a
+    [64, N] @ [N, 64] contraction whose 64x64 output is an MXU tile (the
+    `_presence_2d` design, extended from presence counts to value sums).
+    Rows NOT exactly representable in bf16 are split into THREE bf16 parts
+    v = v1 + v2 + v3 (each part the bf16 rounding of the remaining residual):
+    3 x ~8 mantissa bits recovers full f32 per-element precision (2^-24),
+    so this path's sums match the skinny f32-HIGHEST matmul's — a two-part
+    split (2^-17 per element) was measurably worse on large-magnitude
+    integer columns. Three bf16 dots with f32 accumulation per sum row.
+
+    MEASURED (v5e via the axon relay, N=16M, K=20k, count+split-sum,
+    CSE-proof chained dispatch): 38.8ms (0.43B rows/s) vs segment_sum scatter
+    248.9ms — 6.4x. The hard limit of ANY one-hot formulation here is the
+    MXU contraction stream, not FLOPs: a [64, N] @ [N, 64] dot walks the
+    N-length contraction at ~8 elements/cycle/MXU regardless of its tiny
+    output (~2.1ms per output tile per 16M rows on this chip), and K=20k with
+    3 operand parts needs ~15 such tiles -> ~32ms floor, which the
+    measurement sits right on. Sort-based grouping does not beat it:
+    jax.lax.sort of 16M keys+payload alone measures 67ms.
+
+    `key` must already route masked-out rows to an overflow bucket (callers
+    pass the kernel's dense key with overflow = nseg-1). f32 accumulator
+    cells are exact to 2^24 increments; callers guard rows <= 2^24 exactly
+    like the skinny-matmul path. Returns f32[nseg] per row, exact_rows first.
+    """
+    bf = jnp.bfloat16
+    n_chunks = max(1, -(-nseg // 4096))
+    low = key & 4095
+    oh_hi = jax.nn.one_hot(low // 64, 64, dtype=bf)
+    oh_lo = jax.nn.one_hot(low % 64, 64, dtype=bf)
+    dot = lambda a, b: jax.lax.dot_general(          # noqa: E731
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    splits = []
+    for r in split_rows:
+        r1 = r.astype(bf)
+        rem = r - r1.astype(jnp.float32)
+        r2 = rem.astype(bf)
+        r3 = (rem - r2.astype(jnp.float32)).astype(bf)
+        splits.append((r1, r2, r3))
+    pieces: list = [[] for _ in range(len(exact_rows) + len(split_rows))]
+    for c in range(n_chunks):
+        in_c = (key >> 12) == c
+        for i, r in enumerate(exact_rows):
+            rc = jnp.where(in_c, r.astype(bf), 0)
+            pieces[i].append(dot(oh_hi, rc[:, None] * oh_lo).reshape(-1))
+        for j, parts in enumerate(splits):
+            s = None
+            for rp in parts:
+                d = dot(oh_hi, (jnp.where(in_c, rp, 0))[:, None] * oh_lo)
+                s = d if s is None else s + d
+            pieces[len(exact_rows) + j].append(s.reshape(-1))
+    if n_chunks == 1:
+        return [p[0][:nseg] for p in pieces]
+    return [jnp.concatenate(p)[:nseg] for p in pieces]
 
 
 def combine_collective(name: str, v, axis: str):
@@ -304,20 +380,28 @@ def _make_body(spec: KernelSpec):
                     # DISTINCTCOUNT/HLL/theta path, BASELINE config 5): one
                     # combined dense key over the (group, id) product space —
                     # masked rows ride the overflow band exactly like `key`.
-                    # segment_sum, NOT a one-hot matmul: the combined width
-                    # (keys*ids, tens of thousands) makes the fused
-                    # iota-compare matmul ~100x slower than the scatter here
-                    # (measured ~10ms vs ~0.1ms per 2M-row segment, 5.4x on
-                    # the pipelined bench). On the relay backend a scattered
-                    # program still pipelines worse than pure-matmul ones
-                    # (~1 round trip per dispatch), but the matmul's compute
-                    # cost at this width dwarfs that.
+                    # The SKINNY one-hot matmul is ~100x slower than a
+                    # scatter at this width (keys*ids, tens of thousands),
+                    # but the CHUNKED 64x64-tile formulation
+                    # (_grouped_chunk64) runs the same product space at full
+                    # MXU tile utilization — count-only, so one bf16 part
+                    # per chunk (exact: 0/1 operands, f32 accumulation,
+                    # 2^24-increment guard shared with the sum path).
+                    # segment_sum remains for widths past CHUNK_KEY_CAP and
+                    # blocks that could overflow an f32 cell.
                     size = spec.distinct_lut_sizes[ai]
                     col_ids = ids[agg.arg.name].ravel()
                     comb = key * size + col_ids
-                    out[f"{ai}.distinct"] = jax.ops.segment_sum(
-                        mask.ravel().astype(jnp.int32), comb,
-                        num_segments=num_seg * size).reshape(num_seg, size)
+                    width = num_seg * size
+                    if width <= CHUNK_KEY_CAP and key.size <= (1 << 24):
+                        fm = mask.ravel().astype(jnp.float32)
+                        pres = _grouped_chunk64(comb, width, [fm], [])[0]
+                        out[f"{ai}.distinct"] = jnp.round(pres).astype(
+                            jnp.int32).reshape(num_seg, size)
+                    else:
+                        out[f"{ai}.distinct"] = jax.ops.segment_sum(
+                            mask.ravel().astype(jnp.int32), comb,
+                            num_segments=width).reshape(num_seg, size)
                     continue
                 v = _agg_arg(agg, vals)
                 for o in outs:
@@ -346,6 +430,14 @@ def _make_body(spec: KernelSpec):
                 for r, name in enumerate(sum_names):
                     p = partials[r]
                     out[name] = (jnp.round(p).astype(jnp.int32) if name == "count" else p)
+            elif num_seg <= CHUNK_KEY_CAP and count_exact_in_f32:
+                # HIGH-CARDINALITY group-by: chunked 64x64-tile matmuls (the
+                # redesigned >cap path — 6.4x the segment_sum scatter at 20k
+                # keys; see _grouped_chunk64's measurement + limit analysis)
+                res = _grouped_chunk64(key, num_seg, [fmask], sum_rows[1:])
+                out["count"] = jnp.round(res[0]).astype(jnp.int32)
+                for arr, name in zip(res[1:], sum_names[1:]):
+                    out[name] = arr
             else:
                 counts = jax.ops.segment_sum(mask.ravel().astype(jnp.int32), key,
                                              num_segments=num_seg)
@@ -380,7 +472,7 @@ def _make_body(spec: KernelSpec):
                     # the grouped sum path). Presence consumers (>0) are
                     # immune to the saturation and keep the matmul.
                     counts_exact = mask.size <= (1 << 24)
-                    if size <= MATMUL_KEY_CAP and (not wants_counts
+                    if size <= PRESENCE_MATMUL_CAP and (not wants_counts
                                                    or counts_exact):
                         counts = _presence_2d(fmask, col_ids, size)
                         if wants_counts:

@@ -352,7 +352,8 @@ class MeshQueryExecutor:
             return None
         return StarSetPlan(plans, views, plan2)
 
-    def _dispatch_star(self, ctx: QueryContext, sp: "StarSetPlan"):
+    def _dispatch_star(self, ctx: QueryContext, sp: "StarSetPlan",
+                       partial=False):
         """Dispatch the stacked star-tree kernel: per-segment tree-traversal
         record masks stack into the kernel's valid input (the split-dim LUT
         predicates are already fused into the mask by the slot plan)."""
@@ -367,7 +368,7 @@ class MeshQueryExecutor:
             valid, jax.sharding.NamedSharding(self.mesh, P(SEGMENT_AXIS)))
         return self._dispatch_sharded(sp.plans[0].ctx2, sp.plan2, sp.views,
                                       valid_override=valid_dev,
-                                      star=(ctx, sp))
+                                      star=(ctx, sp), partial=partial)
 
     def _stacked_docsets(self, ctx: QueryContext, plan, segments,
                          block: SegmentSetBlock) -> Tuple:
@@ -493,11 +494,33 @@ class MeshQueryExecutor:
             results[p[0]] = p[1] if len(p) == 2 else p[2](next(it))
         return results
 
+    def dispatch_partial(self, ctx: QueryContext, segments):
+        """Plan + asynchronously dispatch a SERVER-LEVEL partial for the set.
+
+        Returns (device outputs, decode) where decode(host_outs) ->
+        SegmentResult — the pre-broker-reduce partial a server ships to the
+        broker (reference: ServerQueryExecutorV1Impl returning a DataTable,
+        not a reduced result) — or None when the set cannot ride the device
+        path (selection/host plans, doc-set divergence). Group partials are
+        NOT order-by trimmed: the broker merges partials from every server
+        before trimming, exactly like the CPU per-segment path."""
+        plan, view = self._plan_for_set(ctx, segments)
+        if isinstance(plan, StarSetPlan):
+            return self._dispatch_star(ctx, plan, partial=True)
+        if plan is None or plan.kind != "device":
+            return None
+        try:
+            return self._dispatch_sharded(ctx, plan, segments, view,
+                                          partial=True)
+        except DocsetPlanDivergence:
+            return None
+
     def _dispatch_sharded(self, ctx: QueryContext, plan, segments, view=None,
-                          valid_override=None, star=None):
+                          valid_override=None, star=None, partial=False):
         """Dispatch the fused mesh kernel asynchronously.
 
-        Returns (device outputs, decode) where decode(host_outs) -> ResultTable; the
+        Returns (device outputs, decode) where decode(host_outs) -> ResultTable
+        (or a SegmentResult partial when `partial=True`); the
         caller chooses when to pay the fetch round trip (one query vs a batch).
         `valid_override` replaces the block's all-true validity (stacked
         star-tree record masks); `star` = (original ctx, StarSetPlan) makes
@@ -579,7 +602,7 @@ class MeshQueryExecutor:
         fn = self._get_shard_kernel(spec, s_pad, block.rows)
         outs_dev = fn(inputs)
 
-        def decode(outs) -> ResultTable:
+        def decode(outs):
             # replicated outputs decode exactly like the single-segment path;
             # plan.segment's dictionaries (segment[0] when aligned, the merged global
             # dictionaries otherwise) decode the dense keys.
@@ -597,6 +620,8 @@ class MeshQueryExecutor:
                     seg_result = self._fallback._decode_scalar_partials(plan,
                                                                         outs)
                 reassemble(sp.plans[0], seg_result)
+                if partial:
+                    return seg_result
                 orig_aggs = [make_agg(f) for f in orig_ctx.aggregations]
                 merged = merge_segment_results([seg_result], orig_aggs)
                 group_exprs = ([e for e, _ in orig_ctx.select_items]
@@ -604,11 +629,26 @@ class MeshQueryExecutor:
                 return reduce_to_result(orig_ctx, merged, orig_aggs,
                                         group_exprs)
             if plan.group_cols:
-                # post-psum outputs are global, so the order-by trim is exact here
-                seg_result = self._fallback._decode_group_partials(plan, outs,
-                                                                   trim_global=True)
+                if not partial:
+                    # vectorized dense decode for the common agg shapes:
+                    # post-psum outputs are GLOBAL, so groups finalize
+                    # straight to rows with no state dicts (the decode half
+                    # of the high-cardinality group-by redesign — the Python
+                    # per-group loop costs more than the fused kernel past
+                    # ~10k groups; query/dense_reduce.py)
+                    from ..query.dense_reduce import try_dense_decode
+                    dense = try_dense_decode(ctx, plan, outs)
+                    if dense is not None:
+                        return dense
+                # an order-by trim is exact for a FULL result; a server
+                # partial stays untrimmed — the broker merges every server's
+                # groups before trimming
+                seg_result = self._fallback._decode_group_partials(
+                    plan, outs, trim_global=not partial)
             else:
                 seg_result = self._fallback._decode_scalar_partials(plan, outs)
+            if partial:
+                return seg_result
             merged = merge_segment_results([seg_result], plan.aggs)
             group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
                            else list(ctx.group_by))

@@ -74,6 +74,24 @@ class AggFunc:
     def finalize(self, state: Any) -> Any:
         raise NotImplementedError
 
+    # -- vectorized decode (the dense group fast path) ---------------------
+    #: dense_values emits NaN where the scalar finalize would return None
+    #: (e.g. VAR_SAMP of a single row); the dense reducer converts
+    dense_nan_is_null = False
+
+    def dense_values(self, get, counts: np.ndarray) -> Optional[np.ndarray]:
+        """Finalized values over ALL occupied groups at once, or None when
+        this aggregation has no dense path (sketches/value-set states).
+
+        `get(name)` returns this agg's kernel output column sliced to the
+        occupied dense keys; `counts` is the per-group matched row count
+        (> 0 for every occupied key, so the None-state cases of the scalar
+        `finalize` cannot occur except where `dense_nan_is_null` says so).
+        High-cardinality GROUP BY decodes through this instead of a
+        per-group Python state loop — the loop costs more than the fused
+        kernel once groups reach the tens of thousands."""
+        return None
+
     def empty_result(self) -> Any:
         """Result over zero rows (no group-by), mirroring reference defaults."""
         return None
@@ -100,6 +118,9 @@ class CountAgg(AggFunc):
     def finalize(self, state):
         return int(state)
 
+    def dense_values(self, get, counts):
+        return counts.astype(np.int64)
+
     def empty_result(self):
         return 0
 
@@ -124,6 +145,9 @@ class SumAgg(AggFunc):
     def finalize(self, state):
         return None if state is None else float(state)
 
+    def dense_values(self, get, counts):
+        return get("sum").astype(np.float64)
+
 
 class MinAgg(AggFunc):
     name = "min"
@@ -144,6 +168,9 @@ class MinAgg(AggFunc):
 
     def finalize(self, state):
         return None if state is None else float(state)
+
+    def dense_values(self, get, counts):
+        return get(self.device_outputs[0]).astype(np.float64)
 
 
 class MaxAgg(MinAgg):
@@ -181,6 +208,9 @@ class AvgAgg(AggFunc):
         s, c = state
         return None if c == 0 else s / c
 
+    def dense_values(self, get, counts):
+        return get("sum").astype(np.float64) / counts
+
 
 class MinMaxRangeAgg(AggFunc):
     name = "minmaxrange"
@@ -205,6 +235,10 @@ class MinMaxRangeAgg(AggFunc):
 
     def finalize(self, state):
         return None if state is None else state[1] - state[0]
+
+    def dense_values(self, get, counts):
+        return (get("max").astype(np.float64)
+                - get("min").astype(np.float64))
 
 
 class DistinctCountAgg(AggFunc):
@@ -754,6 +788,17 @@ class VarianceAgg(MomentAgg):
         var = max(0.0, m2 / d)
         return float(np.sqrt(var)) if self.sqrt else var
 
+    dense_nan_is_null = True  # VAR_SAMP/STDDEV_SAMP of a 1-row group is null
+
+    def dense_values(self, get, counts):
+        n = counts.astype(np.float64)
+        s1 = get("sum").astype(np.float64)
+        s2 = get("sum2").astype(np.float64)
+        m2 = np.maximum(0.0, s2 - s1 * s1 / n)
+        d = n - 1 if self.sample else n
+        var = np.where(d > 0, m2 / np.maximum(d, 1), np.nan)
+        return np.sqrt(var) if self.sqrt else var
+
 
 class VarSampAgg(VarianceAgg):
     name = "varsamp"
@@ -791,6 +836,17 @@ class SkewnessAgg(MomentAgg):
         m3 = s3 / n - 3 * mean * s2 / n + 2 * mean ** 3
         return float(m3 / m2 ** 1.5)
 
+    def dense_values(self, get, counts):
+        n = counts.astype(np.float64)
+        s1, s2, s3 = (get(o).astype(np.float64)
+                      for o in ("sum", "sum2", "sum3"))
+        mean = s1 / n
+        m2 = s2 / n - mean * mean
+        m3 = s3 / n - 3 * mean * s2 / n + 2 * mean ** 3
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = np.where(m2 > 0, m3 / np.maximum(m2, 1e-300) ** 1.5, 0.0)
+        return out
+
 
 class KurtosisAgg(MomentAgg):
     """KURTOSIS — excess kurtosis from the first four raw moments."""
@@ -812,6 +868,18 @@ class KurtosisAgg(MomentAgg):
             return 0.0
         m4 = (s4 / n - 4 * mean * s3 / n + 6 * mean ** 2 * s2 / n - 3 * mean ** 4)
         return float(m4 / (m2 * m2) - 3.0)
+
+    def dense_values(self, get, counts):
+        n = counts.astype(np.float64)
+        s1, s2, s3, s4 = (get(o).astype(np.float64)
+                          for o in ("sum", "sum2", "sum3", "sum4"))
+        mean = s1 / n
+        m2 = s2 / n - mean * mean
+        m4 = (s4 / n - 4 * mean * s3 / n
+              + 6 * mean ** 2 * s2 / n - 3 * mean ** 4)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = np.where(m2 > 0, m4 / np.maximum(m2 * m2, 1e-300) - 3.0, 0.0)
+        return out
 
 
 # -- two-argument aggregations ------------------------------------------------
@@ -1440,6 +1508,10 @@ class SegmentPartitionedDistinctCountAgg(AggFunc):
 
     def finalize(self, state):
         return int(state)
+
+    # NOTE: no dense_values here — `counts` is the matched-ROW count, not a
+    # distinct count; inheriting CountAgg's shape would silently miscount if
+    # this agg ever grows a device plan (today device_ok is False).
 
     def empty_result(self):
         return 0

@@ -390,7 +390,13 @@ def _expr_device_ok(e: Expr, segment: ImmutableSegment) -> str:
 
 
 def build_device_geometry(plan: SegmentPlan) -> None:
-    """Fill dense-key geometry: strides over real cardinalities, pow2-padded key count."""
+    """Fill dense-key geometry: strides over real cardinalities, padded key count.
+
+    Padding quantizes the kernel-cache key (tables with nearby cardinalities
+    share a compiled program): pow2 up to 4096, then MULTIPLES of 4096 — the
+    chunked group-by kernel's work is linear in padded keys with 4096-key
+    chunk granularity, so pow2 past 4096 would waste up to 2x device work
+    (e.g. 20k real keys -> 32768 pow2 = 9 chunks vs 24576 = 6)."""
     cards = [plan.segment.column(c).cardinality for c in plan.group_cols]
     strides = []
     s = 1
@@ -400,4 +406,7 @@ def build_device_geometry(plan: SegmentPlan) -> None:
     plan.cards = tuple(cards)
     plan.strides = tuple(strides)
     plan.num_keys_real = s
-    plan.num_keys_pad = 1 << max(0, (s - 1)).bit_length()
+    if s <= 4096:
+        plan.num_keys_pad = 1 << max(0, (s - 1)).bit_length()
+    else:
+        plan.num_keys_pad = -(-s // 4096) * 4096
