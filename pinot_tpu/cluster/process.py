@@ -123,12 +123,14 @@ def run_server(controller_url: str, instance_id: str, work_dir: str,
     ssl_ctx = _setup_tls(cfg)
     catalog = RemoteCatalog(controller_url)
     deepstore = ControllerDeepStore(controller_url)
+    from .device_server import pipeline_from_config
     server = ServerNode(instance_id, catalog, deepstore,
                         os.path.join(work_dir, instance_id),
                         tags=cfg.get_list("server.tenant.tags") or None,
                         completion=RemoteCompletion(controller_url),
                         scheduler=scheduler_from_config(cfg),
-                        auto_consume=True)  # real processes pump themselves
+                        auto_consume=True,  # real processes pump themselves
+                        device_pipeline=pipeline_from_config(cfg))
     svc = ServerService(server, port=cfg.get_int("server.port", 0),
                         access_control=access_control, ssl_context=ssl_ctx)
     _write_ready(run_dir, instance_id, {"url": svc.url})
@@ -216,6 +218,7 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
     controller.start_periodic_tasks()
 
     from ..query.scheduler import scheduler_from_config
+    from .device_server import pipeline_from_config
     server_catalog = RemoteCatalog(csvc.url)
     server = ServerNode("server_0", server_catalog,
                         ControllerDeepStore(csvc.url),
@@ -223,7 +226,8 @@ def run_service_manager(work_dir: str, run_dir: str, port: int = 0,
                         tags=cfg.get_list("server.tenant.tags") or None,
                         completion=RemoteCompletion(csvc.url),
                         scheduler=scheduler_from_config(cfg),
-                        auto_consume=True)
+                        auto_consume=True,
+                        device_pipeline=pipeline_from_config(cfg))
     ssvc = ServerService(server, port=cfg.get_int("server.port", 0),
                          access_control=access_control, ssl_context=ssl_ctx)
 

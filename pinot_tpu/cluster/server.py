@@ -73,7 +73,8 @@ class ServerNode:
 
     def __init__(self, instance_id: str, catalog: Catalog, deepstore: DeepStoreFS,
                  data_dir: str, tags: Optional[List[str]] = None, completion=None,
-                 scheduler=None, auto_consume: bool = False):
+                 scheduler=None, auto_consume: bool = False,
+                 device_pipeline=None):
         self.instance_id = instance_id
         self.catalog = catalog
         self.deepstore = deepstore
@@ -82,6 +83,11 @@ class ServerNode:
         # optional admission control (reference: QueryScheduler wrapping the
         # executor; None = direct execution, the single-tenant test default)
         self.scheduler = scheduler
+        # device-backed serving: when set, broker-routed partials execute on
+        # the TPU through the mesh executor with batched fetches
+        # (cluster/device_server.py; reference: ServerInstance owning the
+        # engine, ServerInstance.java:55,120-186)
+        self.device_pipeline = device_pipeline
         # True in real server processes: realtime managers run their background
         # consume loop (reference: PartitionConsumer threads); False in tests,
         # which drive pump/complete deterministically
@@ -142,6 +148,8 @@ class ServerNode:
             handler.stop()
         if self.scheduler is not None:
             self.scheduler.stop()
+        if self.device_pipeline is not None:
+            self.device_pipeline.stop()
 
     # -- state transitions -------------------------------------------------
     def _on_catalog_event(self, event: str, table: str) -> None:
@@ -442,10 +450,40 @@ class ServerNode:
         segments = mgr.acquire(segment_names)
         try:
             results = []
-            for seg in segments:
-                with span(f"segment:{seg.name}"):
-                    valid = upsert.valid_mask(seg.name, seg.num_docs) if upsert else None
-                    results.append(self.executor.execute_segment(ctx, seg, valid))
+            device_partial = None
+            if (self.device_pipeline is not None and segments
+                    and upsert is None
+                    and (ctx.aggregations or ctx.distinct)):
+                # pre-screened on THIS thread: selections and other
+                # non-aggregation shapes have no device plan, so they go
+                # straight to the host loop instead of waiting out the
+                # pipeline's batch-accumulation window for a FALLBACK verdict
+                # (DISTINCT rewrites to a group-by, which does plan on device)
+                # device path: ONE server-level partial for the whole set,
+                # executed on the mesh with batched fetches; falls back per
+                # segment below when the plan can't ride the device (upsert
+                # valid masks always take the host path — per-doc visibility
+                # is host state)
+                from .device_server import DEVICE_FALLBACK
+                with span("device"):
+                    try:
+                        out = self.device_pipeline.execute_partial(ctx,
+                                                                   segments)
+                    except Exception:
+                        out = DEVICE_FALLBACK  # device fault -> host answers
+                if out is not DEVICE_FALLBACK:
+                    device_partial = out
+                    reg.counter("pinot_server_device_queries",
+                                {"table": table}).inc()
+            if device_partial is not None:
+                results.append(device_partial)
+            else:
+                for seg in segments:
+                    with span(f"segment:{seg.name}"):
+                        valid = upsert.valid_mask(seg.name, seg.num_docs) \
+                            if upsert else None
+                        results.append(self.executor.execute_segment(ctx, seg,
+                                                                     valid))
             # include in-progress realtime docs when a consuming manager exists
             served = [seg.name for seg in segments]
             if handler is not None:

@@ -721,6 +721,11 @@ class ServerService:
         (reference: /health/readiness gated on ServiceStatus)."""
         st = self.server.startup_status()
         st["instance"] = self.server.instance_id
+        if self.server.device_pipeline is not None:
+            # device-serving observability: batch sizes prove the pipeline
+            # amortized fetches; tests/bench read this to verify the served
+            # path actually executed on the device
+            st["device"] = self.server.device_pipeline.stats()
         return json_response(st, status=200 if st["ready"] else 503)
 
     def _explain(self, parts, params, body):

