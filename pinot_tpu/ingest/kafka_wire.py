@@ -223,6 +223,55 @@ def encode_record_batch(base_offset: int,
     return i64(base_offset) + i32(len(inner)) + inner
 
 
+def splice_record_batches(data: bytes, min_offset: int, sep: bytes = b",",
+                          max_records: int = 1 << 62):
+    """All batches in a record set -> (values spliced with `sep`, count,
+    last_offset) with CRC verification, or None when the native splicer is
+    unavailable (callers use `decode_record_batches`). Zero per-record
+    Python objects: each batch's value section splices in C and the caller
+    runs ONE batch parse over the joined payload. `max_records` caps the
+    TOTAL spliced count — consume catch-up targets depend on the limit
+    being honored, not approximated."""
+    from ..native import splice_values as _native_splice
+    parts: List[bytes] = []
+    total = 0
+    last_offset = -1
+    r = Reader(data)
+    while r.pos + 12 <= len(r.data):
+        base_offset = r.i64()
+        batch_len = r.i32()
+        if r.pos + batch_len > len(r.data):
+            break  # partial trailing batch (Kafka allows truncated tails)
+        body = Reader(r._take(batch_len))
+        body.i32()                      # partitionLeaderEpoch
+        magic = body.i8()
+        if magic != 2:
+            raise ValueError(f"unsupported record batch magic {magic}")
+        crc = body.u32()
+        rest = body.data[body.pos:]
+        if crc32c(rest) != crc:
+            raise ValueError("record batch CRC mismatch")
+        body.i16()                      # attributes
+        body.i32()                      # lastOffsetDelta
+        body.i64()                      # firstTimestamp
+        body.i64()                      # maxTimestamp
+        body.i64(); body.i16(); body.i32()  # producer id/epoch/base seq
+        count = body.i32()
+        if total >= max_records:
+            break
+        spliced = _native_splice(body.data[body.pos:], base_offset,
+                                 min(count, max_records - total),
+                                 min_offset, sep)
+        if spliced is None:
+            return None
+        chunk, n, last = spliced
+        if n:
+            parts.append(chunk)
+            total += n
+            last_offset = max(last_offset, last)
+    return sep.join(parts), total, last_offset
+
+
 def decode_record_batches(data: bytes) -> List[Tuple[int, int, Optional[bytes], bytes]]:
     """All batches in a record set -> [(offset, timestamp_ms, key, value)]."""
     out: List[Tuple[int, int, Optional[bytes], bytes]] = []
@@ -425,7 +474,11 @@ def encode_fetch_response(
                            for t, ps in sorted(by_topic.items())])
 
 
-def decode_fetch_response(r: Reader) -> List[Dict[str, Any]]:
+def decode_fetch_response(r: Reader, raw_records: bool = False
+                          ) -> List[Dict[str, Any]]:
+    """`raw_records=True` keeps each partition's record-set BYTES under
+    "recordSet" instead of decoding per-record tuples (the splice fast
+    path's input)."""
     r.i32()  # throttle
     out = []
 
@@ -437,7 +490,11 @@ def decode_fetch_response(r: Reader) -> List[Dict[str, Any]]:
                  "highWatermark": r.i64()}
             r.i64()             # last_stable_offset
             r.array(lambda: (r.i64(), r.i64()))  # aborted txns
-            d["records"] = decode_record_batches(r.bytes32() or b"")
+            data = r.bytes32() or b""
+            if raw_records:
+                d["recordSet"] = data
+            else:
+                d["records"] = decode_record_batches(data)
             out.append(d)
         r.array(part)
     r.array(topic)

@@ -557,6 +557,27 @@ class LogBrokerClient:
             return [rec for rec in d["records"] if rec[0] >= offset]
         return []
 
+    def fetch_spliced(self, topic: str, partition: int, offset: int,
+                      max_wait_ms: int = 0, max_bytes: int = 8 << 20,
+                      sep: bytes = b",", max_records: int = 1 << 62):
+        """(values spliced with sep, count, next_offset) via the native
+        splicer, or None when the native library is unavailable — the
+        JSON-batch consume fast path (one C parse per fetch, zero
+        per-record Python objects)."""
+        r = self._request(kw.API_FETCH, 4,
+                          kw.encode_fetch_request(topic, partition, offset,
+                                                  max_wait_ms, max_bytes))
+        for d in kw.decode_fetch_response(r, raw_records=True):
+            if d["error"]:
+                raise RuntimeError(f"Fetch {topic}/{partition}: error {d['error']}")
+            spliced = kw.splice_record_batches(d["recordSet"], offset, sep,
+                                               max_records=max_records)
+            if spliced is None:
+                return None
+            data, n, last = spliced
+            return data, n, (last + 1 if n else offset)
+        return b"", 0, offset
+
     def list_offsets(self, topic: str, partition: int,
                      timestamp: int = kw.LATEST_TS) -> int:
         r = self._request(kw.API_LIST_OFFSETS, 1,
@@ -592,6 +613,47 @@ class KafkaLiteConsumer(PartitionGroupConsumer):
         self._avg_record_bytes = 256.0
 
     def fetch(self, start_offset: int, max_messages: int, timeout_ms: int = 0) -> MessageBatch:
+        records = self._fetch_records(start_offset, max_messages, timeout_ms)
+        msgs = [StreamMessage(value=_to_str(value), offset=off,
+                              key=_to_str(key), timestamp_ms=ts)
+                for off, ts, key, value in records]
+        next_offset = msgs[-1].offset + 1 if msgs else start_offset
+        return MessageBatch(msgs, next_offset)
+
+    def fetch_raw(self, start_offset: int, max_messages: int,
+                  timeout_ms: int = 0):
+        """(raw value bytes list, next_offset): the columnar consume fast
+        path — no StreamMessage objects, no utf-8 str materialization, keys
+        skipped. Pairs with a registered batch decoder
+        (`stream.get_batch_decoder`); at realtime rates the per-message
+        object churn costs more than the wire decode itself (measured ~2x
+        on the 200k-row ingest bench)."""
+        records = self._fetch_records(start_offset, max_messages, timeout_ms)
+        if not records:
+            return [], start_offset
+        return [value for _off, _ts, _k, value in records], records[-1][0] + 1
+
+    def fetch_spliced(self, start_offset: int, max_messages: int,
+                      timeout_ms: int = 0, sep: bytes = b","):
+        """(spliced values, count, next_offset) or None without the native
+        splicer. The record-count contract is approximated through the
+        byte budget like `fetch` (Kafka bounds bytes, not records)."""
+        budget = int(max_messages * self._avg_record_bytes)
+        budget = min(max(budget, 64 << 10), 8 << 20)
+        out = self.client.fetch_spliced(self.topic, self.partition,
+                                        start_offset, max_wait_ms=timeout_ms,
+                                        max_bytes=budget, sep=sep,
+                                        max_records=max_messages)
+        if out is None:
+            return None
+        data, n, next_offset = out
+        if n:
+            self._avg_record_bytes = 0.8 * self._avg_record_bytes \
+                + 0.2 * (len(data) / n + 32)
+        return data, n, next_offset
+
+    def _fetch_records(self, start_offset: int, max_messages: int,
+                       timeout_ms: int):
         budget = int(max_messages * self._avg_record_bytes)
         budget = min(max(budget, 64 << 10), 8 << 20)
         records = self.client.fetch(self.topic, self.partition, start_offset,
@@ -599,12 +661,7 @@ class KafkaLiteConsumer(PartitionGroupConsumer):
         if records:
             got = sum(len(v) + 32 for _off, _ts, _k, v in records) / len(records)
             self._avg_record_bytes = 0.8 * self._avg_record_bytes + 0.2 * got
-        records = records[:max_messages]
-        msgs = [StreamMessage(value=_to_str(value), offset=off,
-                              key=_to_str(key), timestamp_ms=ts)
-                for off, ts, key, value in records]
-        next_offset = msgs[-1].offset + 1 if msgs else start_offset
-        return MessageBatch(msgs, next_offset)
+        return records[:max_messages]
 
     def latest_offset(self) -> int:
         return self.client.list_offsets(self.topic, self.partition)

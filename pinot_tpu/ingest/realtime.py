@@ -80,6 +80,11 @@ class RealtimePartitionConsumer:
         except Exception:
             self.consumer = None
         self.decoder = get_decoder(stream_cfg.decoder)
+        # columnar fast path: raw-bytes fetch + one-shot batch decode
+        # (stream.get_batch_decoder), used when the consumer supports
+        # fetch_raw and no per-row machinery (dedup/upsert) is configured
+        from .stream import get_batch_decoder
+        self.batch_decoder = get_batch_decoder(stream_cfg.decoder)
         self.offset = start_offset
         self.start_consume_time = time.time()
         self.catchup_target: Optional[int] = None
@@ -94,6 +99,12 @@ class RealtimePartitionConsumer:
         self.pause_requested = False
         self.pump_lock = threading.Lock()
         self._commit_done = threading.Event()  # set when _commit returns
+        # observability: which decode strategy the last pump took
+        # ("columnar" | "spliced" | "raw" | "batch" | "row" | None)
+        self.last_decode_path: Optional[str] = None
+        # set on the first fetch_spliced that reports no native splicer:
+        # retrying it every pump would double-fetch every batch forever
+        self._no_native_splice = False
 
     # -- consume loop ------------------------------------------------------
     def pump(self, max_messages: int = 10_000) -> int:
@@ -119,7 +130,76 @@ class RealtimePartitionConsumer:
             if limit <= 0:
                 return 0
         fetch_from = self.offset
-        batch = self.consumer.fetch(fetch_from, limit)
+        batch_ok = self.dedup is None and self.upsert is None
+        # Decode strategy, fastest available first (all fetches run OUTSIDE
+        # pump_lock):
+        #   1. SPLICED: transport joins raw values in C, ONE parse call
+        #      (kafkalite fetch_spliced + a decoder with the spliced proto)
+        #   2. COLUMNAR: raw value bytes list + one batch-decoder call
+        #   3. BATCH: StreamMessage batch, per-message decode, one index_batch
+        #   4. PER-ROW: dedup/upsert need per-row offsets/keys
+        rows = None          # decoded row dicts (paths 1-2)
+        cols = None          # index-ready columns (path 0, native columnar)
+        batch = None         # MessageBatch (paths 3-4)
+        next_offset = fetch_from
+        rows_path = None
+        if batch_ok and self.batch_decoder is not None:
+            spliced = getattr(self.batch_decoder, "spliced", None)
+            fetch_spliced = None if self._no_native_splice else \
+                getattr(self.consumer, "fetch_spliced", None)
+            if spliced is not None and fetch_spliced is not None:
+                prefix, sep, suffix, parse = spliced
+                out = fetch_spliced(fetch_from, limit, sep=sep)
+                if out is None:
+                    self._no_native_splice = True
+                else:
+                    data, n, next_offset = out
+                    if n == 0:
+                        rows = []
+                    elif (self.table_cfg.stream.decoder == "json"
+                          and self.pipeline.filter_expr is None
+                          and not self.pipeline.column_transforms):
+                        # path 0: ONE C walk decodes straight to coerced
+                        # column lists (transform.columns_from_spliced_json)
+                        from .transform import columns_from_spliced_json
+                        try:
+                            cols = columns_from_spliced_json(
+                                data, n, self.schema)
+                        except Exception:
+                            cols = None
+                    if n and cols is None and rows is None:
+                        try:
+                            rows = parse(prefix + data + suffix)
+                            rows_path = "spliced"
+                        except Exception:
+                            rows = None  # malformed member: isolate below
+                        if rows is not None and len(rows) != n:
+                            # a value smuggled top-level separators: the
+                            # count is the transport's, the rows are the
+                            # payload's — never index a drifted batch
+                            # (offsets/flush thresholds would skew); the
+                            # per-message path below isolates the culprit
+                            rows = None
+            if rows is None and cols is None:
+                fetch_raw = getattr(self.consumer, "fetch_raw", None)
+                if fetch_raw is not None:
+                    raw_values, next_offset = fetch_raw(fetch_from, limit)
+                    if raw_values:
+                        rows_path = "raw"
+                        try:
+                            rows = self.batch_decoder(raw_values)
+                            if len(rows) != len(raw_values):
+                                raise ValueError("batch decode row drift")
+                        except Exception:
+                            # one bad payload fails the whole-batch decode:
+                            # per-message decode isolates it (json.loads
+                            # accepts the raw bytes)
+                            rows = [self.decoder(v) for v in raw_values]
+                    else:
+                        rows = []
+        if rows is None and cols is None:
+            batch = self.consumer.fetch(fetch_from, limit)
+            next_offset = batch.next_offset
         indexed = 0
         with self.pump_lock:
             if self.halted or self.offset != fetch_from:
@@ -127,24 +207,36 @@ class RealtimePartitionConsumer:
                 # already (two drivers double-indexing the same batch would
                 # duplicate rows): drop the batch, offset untouched
                 return 0
-            if self.dedup is None and self.upsert is None and batch.messages:
-                # fast path: decode the whole batch, run the transform
+            if cols is not None:
+                self.last_decode_path = "columnar"
+                indexed = self.mutable.index_batch(cols, coerced=True)
+            elif rows is not None:
+                if rows:
+                    self.last_decode_path = rows_path
+                    from .transform import rows_to_all_columns
+                    indexed = self.mutable.index_batch(
+                        self.pipeline.apply(rows_to_all_columns(rows)),
+                        coerced=True)
+            elif batch_ok and batch.messages:
+                self.last_decode_path = "batch"
+                # batch path: decode the whole batch, run the transform
                 # pipeline ONCE over it (vectorized filter + coercion), and
                 # append column-wise — per-row dict/array churn dominates the
                 # consume rate otherwise (reference: MessageBatch-granular
                 # indexing in LLRealtimeSegmentDataManager.processStreamEvents)
                 from .transform import rows_to_all_columns
-                rows = [self.decoder(m.value) for m in batch.messages]
+                decoded = [self.decoder(m.value) for m in batch.messages]
                 indexed = self.mutable.index_batch(
-                    self.pipeline.apply(rows_to_all_columns(rows)),
+                    self.pipeline.apply(rows_to_all_columns(decoded)),
                     coerced=True)
             else:
+                self.last_decode_path = "row"
                 for msg in batch.messages:
                     row = self.decoder(msg.value)
                     row = self.pipeline.apply_row(row)
                     if row is not None and self._index_row(row, msg.offset):
                         indexed += 1
-            self.offset = batch.next_offset
+            self.offset = next_offset
         if indexed:  # ServerMeter REALTIME_ROWS_CONSUMED analog
             from ..utils.metrics import get_registry
             get_registry().counter("pinot_server_realtime_rows_consumed",

@@ -131,6 +131,117 @@ def rows_to_all_columns(rows: List[Dict[str, Any]]) -> Dict[str, List[Any]]:
     return {k: [r.get(k) for r in rows] for k in keys}
 
 
+_NUMERIC_TYPES = ("INT", "LONG", "FLOAT", "DOUBLE")
+
+
+def columns_from_spliced_json(data: bytes, n: int, schema) -> \
+        Optional[Dict[str, List[Any]]]:
+    """NATIVE columnar decode of n spliced flat-JSON records straight to
+    index-ready, schema-coerced column lists — the decode->transform->
+    dict-assign fast path (VERDICT r4 #4): one C walk replaces per-row
+    json.loads + rows_to_all_columns + per-value coercion.
+
+    Returns None when the shape can't take the fast path (no native lib,
+    multi-value/typed-beyond-{INT,LONG,FLOAT,DOUBLE,STRING} schema fields,
+    malformed outer structure) — callers run the generic pipeline. Output
+    semantics match `TransformPipeline.apply` for a pipeline with no
+    filter/transforms: schema columns only, values coerced per DataType,
+    None for null/missing (index_batch records null bitmaps from them).
+    Rows the C decoder flags (nested values under schema keys,
+    out-of-int64 numbers) are re-parsed individually with json.loads."""
+    from ..native import json_columns
+    fields = list(schema.fields)
+    if any(not f.single_value or f.data_type.value not in
+           _NUMERIC_TYPES + ("STRING",) for f in fields):
+        return None
+    names = [f.name for f in fields]
+    out = json_columns(data, n, names)
+    if out is None:
+        return None
+    nums, lints, types, str_off, str_len, rec_ranges, bad = out
+    cols: Dict[str, List[Any]] = {}
+    for c, f in enumerate(fields):
+        t = types[c]
+        dt = f.data_type.value
+        if dt in ("INT", "LONG"):
+            if (t == 8).all():
+                cols[f.name] = lints[c].tolist()
+                continue
+            f_mask = t == 1
+            if ((t == 8) | f_mask).all():
+                fvals = nums[c][f_mask]
+                # vectorized float->int only when every double is safely in
+                # int64 range: numpy's cast of 1e300 silently yields
+                # INT64_MIN where the generic path's int() is exact — those
+                # rows take the per-cell loop below instead
+                if np.isfinite(fvals).all() and                         (np.abs(fvals) < float(1 << 62)).all():
+                    ints = lints[c].copy()
+                    ints[f_mask] = fvals.astype(np.int64)
+                    cols[f.name] = ints.tolist()
+                    continue
+        elif dt in ("FLOAT", "DOUBLE"):
+            i_mask = t == 8
+            if (i_mask | (t == 1)).all():
+                v = nums[c].copy()
+                v[i_mask] = lints[c][i_mask].astype(np.float64)
+                cols[f.name] = v.tolist()
+                continue
+        elif dt == "STRING" and ((t == 2).all()):
+            so, sl = str_off[c], str_len[c]
+            # intern repeated values (OLAP dimension columns are low-card:
+            # one decode per DISTINCT value, dict hits for the rest)
+            cache: Dict[bytes, str] = {}
+            out_s: List[Any] = []
+            for o, l in zip(so.tolist(), sl.tolist()):
+                b = data[o:o + l]
+                s = cache.get(b)
+                if s is None:
+                    if len(cache) > 65536:
+                        cache.clear()
+                    s = cache[b] = b.decode("utf-8")
+                out_s.append(s)
+            cols[f.name] = out_s
+            continue
+        # mixed/missing/escaped cells: per-cell assembly with exact
+        # null/coercion semantics (still no re-parse of the record)
+        import json as _json
+        coerce = f.data_type.coerce
+        vals: List[Any] = []
+        so, sl = str_off[c], str_len[c]
+        for r in range(n):
+            tv = t[r]
+            if tv == 0 or tv == 5:
+                vals.append(None)
+            elif tv == 8:
+                vals.append(coerce(int(lints[c, r])))
+            elif tv == 1:
+                vals.append(coerce(float(nums[c, r])))
+            elif tv == 2:
+                vals.append(coerce(
+                    data[so[r]:so[r] + sl[r]].decode("utf-8")))
+            elif tv == 6:
+                raw = data[so[r] - 1:so[r] + sl[r] + 1]
+                vals.append(coerce(_json.loads(raw)))
+            elif tv == 3:
+                vals.append(coerce(True))
+            else:
+                vals.append(coerce(False))
+        cols[f.name] = vals
+    if bad.any():
+        import json as _json
+        for r in np.nonzero(bad)[0].tolist():
+            off, ln = rec_ranges[r]
+            row = _json.loads(data[off:off + ln])
+            for f in fields:
+                if f.name in row:
+                    v = row[f.name]
+                    cols[f.name][r] = None if v is None \
+                        else f.data_type.coerce(v)
+                else:
+                    cols[f.name][r] = None
+    return cols
+
+
 def _as_array(v) -> np.ndarray:
     if isinstance(v, np.ndarray):
         return v

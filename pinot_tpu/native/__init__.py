@@ -54,6 +54,19 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong,
         ctypes.c_longlong, ctypes.c_long, LL, LL, LL, LL, LL, LL)
     lib.pinot_decode_records.restype = ctypes.c_long
+    lib.pinot_splice_values.argtypes = (
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_longlong, ctypes.c_long,
+        ctypes.c_longlong, ctypes.c_ubyte, ctypes.c_char_p, ctypes.c_size_t,
+        LL, LL)
+    lib.pinot_splice_values.restype = ctypes.c_long
+    lib.pinot_json_columns.argtypes = (
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_long,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_double), LL,
+        ctypes.POINTER(ctypes.c_ubyte), LL, LL, LL, LL,
+        ctypes.POINTER(ctypes.c_ubyte))
+    lib.pinot_json_columns.restype = ctypes.c_long
     return lib
 
 
@@ -80,6 +93,81 @@ def crc32c(data: bytes, crc: int = 0) -> Optional[int]:
     if lib is None:
         return None
     return lib.pinot_crc32c(data, len(data), crc)
+
+
+def splice_values(records_section: bytes, base_offset: int, count: int,
+                  min_offset: int, sep: bytes = b","):
+    """Native value splice: "v0<sep>v1<sep>..." over records >= min_offset.
+    Returns (bytes, n, last_offset) or None (no native lib / malformed) —
+    zero per-record Python work; the caller runs ONE batch parse over the
+    spliced payload (the realtime consume hot path)."""
+    lib = get_lib()
+    if lib is None or count <= 0:
+        return None
+    if count > len(records_section) // 7 + 1:
+        return None  # hostile count: bound allocations (see decode_records)
+    cap = len(records_section) + count + 1
+    out = ctypes.create_string_buffer(cap)
+    out_len = ctypes.c_longlong(0)
+    last = ctypes.c_longlong(-1)
+    n = lib.pinot_splice_values(records_section, len(records_section),
+                                base_offset, count, min_offset, sep[0],
+                                ctypes.cast(out, ctypes.c_char_p), cap,
+                                ctypes.byref(out_len), ctypes.byref(last))
+    if n < 0:
+        return None
+    return out.raw[:out_len.value], n, last.value
+
+
+def json_columns(data: bytes, n_records: int, col_names):
+    """Schema-directed flat-JSON columnar decode of n_records spliced
+    objects. Returns (nums f64[C,N], lints i64[C,N], types u8[C,N],
+    str_off i64[C,N], str_len i64[C,N], rec_ranges i64[N,2], bad bool[N])
+    as NUMPY views, or None (no native lib / outer structure malformed —
+    callers run the whole-batch Python parse instead).
+
+    Cell types: 0 missing, 1 double, 2 string, 3 true, 4 false, 5 null,
+    6 escaped string (re-decode the raw range), 8 int64. `bad` rows carry
+    a nested value under a schema key or an out-of-int64 number — the
+    caller re-parses just those record ranges."""
+    import numpy as np
+    lib = get_lib()
+    if lib is None or n_records <= 0:
+        return None
+    C = len(col_names)
+    name_bytes = [n.encode("utf-8") for n in col_names]
+    blob = b"".join(name_bytes)
+    offs = (ctypes.c_long * C)()
+    lens = (ctypes.c_long * C)()
+    o = 0
+    for i, nb in enumerate(name_bytes):
+        offs[i] = o
+        lens[i] = len(nb)
+        o += len(nb)
+    cells = C * n_records
+    nums = np.empty(cells, dtype=np.float64)
+    lints = np.empty(cells, dtype=np.int64)
+    types = np.zeros(cells, dtype=np.uint8)
+    str_off = np.empty(cells, dtype=np.int64)
+    str_len = np.empty(cells, dtype=np.int64)
+    rec_off = np.empty(n_records, dtype=np.int64)
+    rec_len = np.empty(n_records, dtype=np.int64)
+    bad = np.zeros(n_records, dtype=np.uint8)
+    LLP = ctypes.POINTER(ctypes.c_longlong)
+    n = lib.pinot_json_columns(
+        data, len(data), n_records, blob, offs, lens, C,
+        nums.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        lints.ctypes.data_as(LLP),
+        types.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        str_off.ctypes.data_as(LLP), str_len.ctypes.data_as(LLP),
+        rec_off.ctypes.data_as(LLP), rec_len.ctypes.data_as(LLP),
+        bad.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)))
+    if n != n_records:
+        return None
+    shape = (C, n_records)
+    return (nums.reshape(shape), lints.reshape(shape), types.reshape(shape),
+            str_off.reshape(shape), str_len.reshape(shape),
+            np.stack([rec_off, rec_len], axis=1), bad.astype(bool))
 
 
 def decode_records(records_section: bytes, base_offset: int, first_ts: int,

@@ -288,15 +288,21 @@ class MutableSegment:
             else:
                 nv = spec.null_value
                 coerce = spec.data_type.coerce
-                nr = None
-                for i, v in enumerate(vals):
-                    if v is None:
-                        if nr is None:
-                            nr = self.null_rows.setdefault(name, [])
-                        nr.append(n0 + i)
-                        out.append(nv)
-                    else:
-                        out.append(v if coerced else coerce(v))
+                if coerced and isinstance(vals, list) and None not in vals:
+                    # no nulls + already coerced (the columnar consume fast
+                    # path): adopt the list wholesale — the per-value append
+                    # loop below costs more than the whole C-side decode
+                    out = vals
+                else:
+                    nr = None
+                    for i, v in enumerate(vals):
+                        if v is None:
+                            if nr is None:
+                                nr = self.null_rows.setdefault(name, [])
+                            nr.append(n0 + i)
+                            out.append(nv)
+                        else:
+                            out.append(v if coerced else coerce(v))
             self.columns[name].extend(out)
             tidx = self.text_indexes.get(name)
             if tidx is not None:
