@@ -50,7 +50,8 @@ _REGISTRIES: Dict[str, Callable[[], Dict[str, Any]]] = {
 
 # modules whose import registers built-in plugins lazily (reference: the
 # always-on plugins shipped inside pinot-plugins/)
-_BUILTIN_MODULES = ["pinot_tpu.ingest.kafkalite", "pinot_tpu.ingest.kinesislite"]
+_BUILTIN_MODULES = ["pinot_tpu.ingest.kafkalite", "pinot_tpu.ingest.kinesislite",
+                    "pinot_tpu.ingest.pulsarlite"]
 _loaded_builtins = False
 
 

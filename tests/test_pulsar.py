@@ -1,0 +1,140 @@
+"""PulsarLite: Pulsar binary-wire broker + stream plugin (VERDICT r4 #6).
+
+Covers the wire framing (magic + CRC-32C payload frames, BaseCommand
+protobuf), producer/consumer round trips over real TCP, the reader-style
+SEEK/FLOW consumption model, and a REALTIME TABLE consuming through the
+plugin across OS processes (ProcessCluster servers connect to the broker
+over TCP — the cross-process claim the reference makes for its pulsar
+plugin). Ref: PulsarPartitionLevelConsumer.java.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingest.pulsarlite import (PulsarLiteBroker, PulsarLiteConsumer,
+                                         PulsarLiteProducer, encode_frame,
+                                         read_frame, _base_command, CONNECT)
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+
+@pytest.fixture()
+def broker():
+    b = PulsarLiteBroker()
+    yield b
+    b.stop()
+
+
+def test_produce_consume_roundtrip(broker):
+    prod = PulsarLiteProducer(broker.service_url, "t0")
+    offs = [prod.send(json.dumps({"i": i}).encode(), ts=1000 + i)
+            for i in range(40)]
+    assert offs == list(range(40))
+    prod.close()
+    cons = PulsarLiteConsumer(broker.service_url, "t0", 0)
+    batch = cons.fetch(0, 25)
+    assert [m.offset for m in batch.messages] == list(range(25))
+    assert json.loads(batch.messages[7].value) == {"i": 7}
+    assert batch.messages[7].timestamp_ms == 1007
+    assert batch.next_offset == 25
+    batch2 = cons.fetch(25, 100)
+    assert [m.offset for m in batch2.messages] == list(range(25, 40))
+    assert cons.latest_offset() == 40
+    cons.close()
+
+
+def test_seek_semantics(broker):
+    prod = PulsarLiteProducer(broker.service_url, "t1")
+    for i in range(30):
+        prod.send(f"v{i}".encode())
+    cons = PulsarLiteConsumer(broker.service_url, "t1", 0)
+    cons.fetch(0, 10)
+    # non-contiguous restart: the consumer must SEEK, not deliver stale rows
+    batch = cons.fetch(20, 10)
+    assert [m.offset for m in batch.messages] == list(range(20, 30))
+    # rewind (replay) also works — reader semantics
+    batch = cons.fetch(5, 3)
+    assert [m.value for m in batch.messages] == ["v5", "v6", "v7"]
+    cons.close()
+    prod.close()
+
+
+def test_empty_fetch_returns_quickly(broker):
+    PulsarLiteProducer(broker.service_url, "t2").close()
+    cons = PulsarLiteConsumer(broker.service_url, "t2", 0)
+    t0 = time.perf_counter()
+    batch = cons.fetch(0, 10, timeout_ms=100)
+    assert batch.messages == [] and batch.next_offset == 0
+    assert time.perf_counter() - t0 < 2.0
+    cons.close()
+
+
+def test_crc_rejects_corruption(broker):
+    import socket
+    import struct
+    host, port = broker.host, broker.port
+    s = socket.create_connection((host, port))
+    s.sendall(encode_frame(_base_command(CONNECT, {1: "x", 4: 21})))
+    read_frame(s)
+    # hand-build a SEND frame with a flipped payload byte: CRC must fail
+    from pinot_tpu.ingest.pulsarlite import MAGIC, PRODUCER, SEND, _msg
+    s.sendall(encode_frame(_base_command(PRODUCER, {
+        1: "persistent://public/default/t3-partition-0", 2: 1, 3: 1})))
+    read_frame(s)
+    cmd = _base_command(SEND, {1: 1, 2: 1})
+    meta = _msg({1: "p", 2: 1, 3: 0})
+    from pinot_tpu.ingest.kafka_wire import crc32c
+    meta_part = struct.pack(">I", len(meta)) + meta + b"payload"
+    crc = crc32c(meta_part)
+    corrupted = meta_part[:-1] + b"X"
+    frame = struct.pack(">II", 4 + len(cmd) + 2 + 4 + len(corrupted),
+                        len(cmd)) + cmd + MAGIC + struct.pack(">I", crc) \
+        + corrupted
+    s.sendall(frame)
+    # broker drops the connection on CRC mismatch
+    import contextlib
+    with contextlib.suppress(OSError):
+        assert read_frame(s) is None
+    s.close()
+
+
+def test_realtime_table_consumes_via_pulsar_across_processes(tmp_path):
+    """A REALTIME table in a real OS-process cluster consumes through the
+    pulsar plugin: server processes dial the broker over TCP."""
+    from pinot_tpu.cluster.process import ProcessCluster
+
+    schema = Schema("pev", [dimension("site", DataType.STRING),
+                            metric("clicks", DataType.LONG)])
+    broker = PulsarLiteBroker()
+    try:
+        prod = PulsarLiteProducer(broker.service_url, "pulsar_ev")
+        for i in range(300):
+            prod.send(json.dumps({"site": f"s{i % 3}",
+                                  "clicks": 1}).encode())
+        prod.close()
+        with ProcessCluster(num_servers=2, work_dir=str(tmp_path)) as cluster:
+            cluster.controller.add_schema(schema)
+            cfg = TableConfig(
+                "pev", table_type=TableType.REALTIME,
+                stream=StreamConfig(
+                    stream_type="pulsar", topic="pulsar_ev",
+                    properties={"serviceUrl": broker.service_url},
+                    flush_threshold_rows=10_000))
+            cluster.controller.add_table(cfg, num_partitions=1)
+            deadline = time.time() + 60
+            total = 0
+            while time.time() < deadline:
+                r = cluster.query("SELECT COUNT(*), SUM(clicks) FROM pev")[
+                    "resultTable"]["rows"]
+                total = r[0][0] if r else 0
+                if total == 300:
+                    assert r[0][1] == 300
+                    break
+                time.sleep(0.3)
+            assert total == 300, f"consumed {total}/300 via pulsar wire"
+    finally:
+        broker.stop()
