@@ -240,12 +240,18 @@ def _gcs_fs(root: str) -> DeepStoreFS:
     return GcsDeepStoreFS(root)
 
 
+def _hdfs_fs(root: str) -> DeepStoreFS:
+    from .hdfsstore import HdfsDeepStoreFS   # lazy
+    return HdfsDeepStoreFS(root)
+
+
 # scheme -> factory callable (a class works too; reference: PinotFSFactory)
 _FS_REGISTRY: Dict[str, Callable[[str], DeepStoreFS]] = {
     "local": LocalDeepStore,
     "mem": MemDeepStore,
     "s3": _s3_fs,
     "gs": _gcs_fs,
+    "hdfs": _hdfs_fs,
 }
 
 

@@ -1,0 +1,150 @@
+"""WebHDFS deep store: REST client (incl. the 307 redirect dance) + stub,
+native rename, cluster chaos (VERDICT r4 #6).
+
+Mirrors the reference's HDFS plugin coverage
+(`pinot-plugins/pinot-file-system/pinot-hdfs/...HadoopPinotFS.java`) with
+the same proof pattern as test_s3store.py / test_gcsstore.py."""
+
+import json
+
+import pytest
+
+from pinot_tpu.cluster.deepstore import create_fs
+from pinot_tpu.cluster.hdfsstore import HdfsDeepStoreFS, HdfsStub
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+from conftest import wait_until
+
+
+@pytest.fixture
+def stub():
+    s = HdfsStub()
+    yield s
+    s.stop()
+
+
+def test_hdfs_fs_contract(stub, tmp_path):
+    fs = create_fs(stub.spec())
+    assert isinstance(fs, HdfsDeepStoreFS)
+    fs.put_bytes(b"hello", "t/seg0.tar.gz")
+    assert fs.get_bytes("t/seg0.tar.gz") == b"hello"
+    assert fs.exists("t/seg0.tar.gz") and fs.exists("t")
+    assert not fs.exists("t/nope")
+    src = tmp_path / "blob"
+    src.write_bytes(b"\x00\x01" * 500)
+    fs.upload(str(src), "t/seg1.tar.gz")
+    dst = tmp_path / "out" / "blob"
+    fs.download("t/seg1.tar.gz", str(dst))
+    assert dst.read_bytes() == src.read_bytes()
+    fs.put_bytes(b"x", "t/sub/inner.bin")
+    assert fs.listdir("t") == ["seg0.tar.gz", "seg1.tar.gz", "sub"]
+    fs.move("t/seg0.tar.gz", "moved/seg0.tar.gz")
+    assert not fs.exists("t/seg0.tar.gz")
+    assert fs.get_bytes("moved/seg0.tar.gz") == b"hello"
+    fs.delete("t")
+    assert not fs.exists("t/seg1.tar.gz") and not fs.exists("t/sub/inner.bin")
+    with pytest.raises(FileNotFoundError):
+        fs.get_bytes("t/seg1.tar.gz")
+
+
+def test_hdfs_redirect_dance_is_real(stub):
+    """CREATE and OPEN must traverse the namenode->datanode 307 redirect;
+    the stub only stores/serves data on the step2 leg."""
+    fs = create_fs(stub.spec())
+    fs.put_bytes(b"abc", "r/x.bin")
+    # the stored path exists (write went through the redirect target)
+    assert any(k.endswith("/r/x.bin") for k in stub.files)
+    assert fs.get_bytes("r/x.bin") == b"abc"
+    # direct un-redirected PUT against the namenode leg stores nothing
+    import http.client
+    import urllib.parse
+    conn = http.client.HTTPConnection(stub.host, stub.port, timeout=5)
+    conn.request("PUT", "/webhdfs/v1/deepstore/raw.bin?op=CREATE",
+                 body=b"zz", headers={"Content-Length": "2"})
+    resp = conn.getresponse()
+    resp.read()
+    assert resp.status == 307 and resp.getheader("Location")
+    conn.close()
+    assert not any(k.endswith("/raw.bin") for k in stub.files)
+
+
+def test_hdfs_native_rename_is_metadata_move(stub):
+    fs = create_fs(stub.spec())
+    fs.put_bytes(b"payload", "a/b/seg.tar.gz")
+    before = dict(stub.files)
+    fs.move("a/b/seg.tar.gz", "c/d/seg.tar.gz")
+    assert fs.get_bytes("c/d/seg.tar.gz") == b"payload"
+    assert not fs.exists("a/b/seg.tar.gz")
+    # same bytes object moved, never re-uploaded (metadata rename)
+    new_key = [k for k in stub.files if k.endswith("/c/d/seg.tar.gz")][0]
+    old_key = [k for k in before if k.endswith("/a/b/seg.tar.gz")][0]
+    assert stub.files[new_key] is before[old_key]
+
+
+def test_process_cluster_on_hdfs_with_outage_heals(tmp_path):
+    """ProcessCluster storing realtime segments through hdfs://; an HDFS
+    outage mid-stream commits via peer download and heals after recovery
+    (the same chaos flow the s3/gcs schemes pass — one deep-store SPI)."""
+    from pinot_tpu.cluster.process import ProcessCluster
+    from pinot_tpu.ingest.kafkalite import LogBrokerClient, LogBrokerServer
+
+    stub = HdfsStub()
+    srv = LogBrokerServer()
+    try:
+        client = LogBrokerClient(srv.bootstrap)
+        client.create_topic("ht", 1)
+        cfg_path = tmp_path / "cluster.conf"
+        cfg_path.write_text(f"controller.deepstore={stub.spec('deepstore')}\n")
+        schema = Schema("ht", [
+            dimension("u", DataType.STRING), metric("v", DataType.LONG),
+            date_time("ts", DataType.LONG)])
+        with ProcessCluster(num_servers=2, work_dir=str(tmp_path),
+                            config_path=str(cfg_path)) as cluster:
+            cluster.controller.add_schema(schema)
+            cfg = TableConfig(
+                "ht", table_type=TableType.REALTIME, time_column="ts",
+                replication=2,
+                stream=StreamConfig(stream_type="kafkalite", topic="ht",
+                                    properties={"bootstrap": srv.bootstrap},
+                                    flush_threshold_rows=25))
+            cluster.controller.add_table(cfg, num_partitions=1)
+            table = cfg.table_name_with_type
+
+            def count():
+                rows = cluster.query(
+                    "SELECT COUNT(*) FROM ht")["resultTable"]["rows"]
+                return rows[0][0] if rows else 0
+
+            for i in range(30):
+                client.produce("ht", json.dumps(
+                    {"u": f"u{i % 3}", "v": i, "ts": 1700000000000 + i}))
+            assert wait_until(lambda: count() == 30, timeout=60)
+
+            def done_segments():
+                metas = cluster.controller.segments_meta(table)["segments"]
+                return {n: m for n, m in metas.items()
+                        if m.get("status") == "DONE"}
+            assert wait_until(lambda: len(done_segments()) >= 1, timeout=60)
+            assert any(k.endswith(".tar.gz") for k in stub.files)
+
+            stub.outage = True
+            try:
+                for i in range(30, 60):
+                    client.produce("ht", json.dumps(
+                        {"u": f"u{i % 3}", "v": i, "ts": 1700000000000 + i}))
+                assert wait_until(
+                    lambda: any(str(m.get("download_path", "")).startswith(
+                        "peer://") for m in done_segments().values()),
+                    timeout=90), "commit must survive the HDFS outage"
+                assert wait_until(lambda: count() == 60, timeout=60)
+            finally:
+                stub.outage = False
+            # healing: the repair task re-uploads the peer segment to hdfs
+            assert wait_until(
+                lambda: all(not str(m.get("download_path", "")).startswith(
+                    "peer://") for m in done_segments().values()),
+                timeout=120), "deep-store healing did not run"
+    finally:
+        srv.stop()
+        stub.stop()
