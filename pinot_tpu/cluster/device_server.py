@@ -161,12 +161,21 @@ class DeviceQueryPipeline:
                 continue
             self.batches += 1
             self.dispatched += len(pending)
+            handed_off = False
             while not self._stop.is_set():
                 try:
                     self._fetchq.put(pending, timeout=0.2)
+                    handed_off = True
                     break
                 except queue.Full:
                     continue  # fetcher backlogged: backpressure dispatch
+            if not handed_off:
+                # stopping with the fetch queue full: these futures would
+                # otherwise dangle past stop()'s drain for the full submit
+                # timeout — resolve them to the host path now
+                for item, _, _ in pending:
+                    if not item.future.done():
+                        item.future.set_result(DEVICE_FALLBACK)
 
     def _fetch_loop(self) -> None:
         import jax

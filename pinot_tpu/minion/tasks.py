@@ -37,6 +37,7 @@ MERGE_ROLLUP = "MergeRollupTask"
 REALTIME_TO_OFFLINE = "RealtimeToOfflineSegmentsTask"
 PURGE = "PurgeTask"
 SEGMENT_GENERATION_AND_PUSH = "SegmentGenerationAndPushTask"
+CONVERT_TO_RAW_INDEX = "ConvertToRawIndexTask"
 
 
 @dataclass
@@ -308,6 +309,42 @@ class RealtimeToOfflineTaskGenerator(TaskGenerator):
         return [spec]
 
 
+class ConvertToRawIndexTaskGenerator(TaskGenerator):
+    """Reference: ConvertToRawIndexTaskGenerator — one task per batch of
+    segments whose target columns are still dictionary-encoded. The custom
+    mark on rewritten segments keeps them out of later rounds."""
+
+    task_type = CONVERT_TO_RAW_INDEX
+
+    def generate(self, catalog, cfg: TableConfig, queue: TaskQueue) -> List[TaskSpec]:
+        tcfg = cfg.task_configs.get(self.task_type)
+        table = cfg.table_name_with_type
+        if tcfg is None:
+            return []
+        if queue.has_pending(table, self.task_type) \
+                or queue.in_error_backoff(table, self.task_type):
+            return []
+        max_tasks = int(tcfg.get("tableMaxNumTasks", 1))
+        per_task = int(tcfg.get("maxNumSegmentsPerTask", 10))
+        done = set(catalog.get_property(f"convertRawDone/{table}", []) or [])
+        todo = sorted(
+            m.name for m in catalog.segments.get(table, {}).values()
+            if m.status != "IN_PROGRESS"   # committed realtime OR uploaded
+            and m.custom.get("task") != CONVERT_TO_RAW_INDEX
+            and m.name not in done)
+        specs = []
+        for lo in range(0, min(len(todo), max_tasks * per_task), per_task):
+            specs.append(TaskSpec(
+                task_id=f"{self.task_type}_{table}_{uuid.uuid4().hex[:8]}",
+                task_type=self.task_type, table=table,
+                config={"segments": todo[lo:lo + per_task],
+                        "columnsToConvert":
+                            tcfg.get("columnsToConvert", [])}))
+        for s in specs:
+            queue.submit(s)
+        return specs
+
+
 class PinotTaskManager:
     """Controller-side periodic generation over all tables (reference: PinotTaskManager)."""
 
@@ -315,7 +352,8 @@ class PinotTaskManager:
         self.catalog = catalog
         self.queue = TaskQueue(catalog)
         self.generators: Dict[str, TaskGenerator] = {}
-        for gen in (MergeRollupTaskGenerator(), RealtimeToOfflineTaskGenerator()):
+        for gen in (MergeRollupTaskGenerator(), RealtimeToOfflineTaskGenerator(),
+                    ConvertToRawIndexTaskGenerator()):
             self.generators[gen.task_type] = gen
 
     def register_generator(self, gen: TaskGenerator) -> None:
@@ -469,6 +507,58 @@ class PurgeTaskExecutor(BaseMergeExecutor):
             worker.controller.replace_segments(spec.table, old_names, new_dirs)
 
 
+class ConvertToRawIndexTaskExecutor(BaseMergeExecutor):
+    """Rewrite segments with the given columns as RAW (no-dictionary)
+    forward indexes (reference: converttorawindex/
+    ConvertToRawIndexTaskExecutor.java — there a refresh push, here the
+    same lineage-protected replace the other rewrite tasks use). An empty
+    `columnsToConvert` converts every single-value column, matching the
+    reference's default."""
+
+    task_type = CONVERT_TO_RAW_INDEX
+
+    def execute(self, spec: TaskSpec, worker: "MinionWorker") -> None:
+        from .framework import read_columns
+        from ..segment.writer import SegmentBuilder
+        cfg = worker.catalog.table_configs[spec.table]
+        schema = worker.catalog.schemas[cfg.name]
+        segs = self._load_inputs(spec, worker)
+        columns = list(spec.config.get("columnsToConvert") or [])
+        if not columns:
+            columns = [f.name for f in schema.fields if f.single_value]
+        gen = self._generator_config(cfg)
+        gen.no_dictionary_columns = sorted(
+            set(gen.no_dictionary_columns) | set(columns))
+        out_dir = os.path.join(worker.work_dir, spec.task_id, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        builder = SegmentBuilder(schema, gen)
+        schema_names = {f.name for f in schema.fields}
+        old_names, new_dirs = [], []
+        already_raw: List[str] = []
+        for seg, name in zip(segs, spec.config["segments"]):
+            if all(not seg.column(c).has_dictionary
+                   for c in columns if c in schema_names):
+                already_raw.append(name)
+                continue
+            cols = read_columns(seg, schema)
+            old_names.append(name)
+            new_dirs.append(builder.build(
+                cols, out_dir, f"{name}_raw_{uuid.uuid4().hex[:6]}"))
+        if old_names:
+            worker.controller.replace_segments(
+                spec.table, old_names, new_dirs,
+                custom={"task": CONVERT_TO_RAW_INDEX})
+        if already_raw:
+            # record no-op inputs in the done-set property: the generator
+            # filters on it, so an already-raw segment (e.g. uploaded raw,
+            # or the table's indexing config already lists the columns)
+            # would otherwise be re-generated — and re-downloaded — every
+            # controller task tick forever
+            worker.catalog.mutate_property(
+                f"convertRawDone/{spec.table}",
+                lambda cur: sorted(set(cur or []) | set(already_raw)))
+
+
 class SegmentGenerationAndPushExecutor(TaskExecutor):
     """One input FILE -> transformed segment(s) -> controller push (reference:
     `SegmentGenerationAndPushTaskExecutor` + the hadoop/spark batch runners'
@@ -524,7 +614,8 @@ class MinionWorker:
         self.queue = queue if queue is not None else TaskQueue(catalog)
         self.executors: Dict[str, TaskExecutor] = {}
         for ex in (MergeRollupTaskExecutor(), RealtimeToOfflineTaskExecutor(),
-                   PurgeTaskExecutor(), SegmentGenerationAndPushExecutor()):
+                   PurgeTaskExecutor(), SegmentGenerationAndPushExecutor(),
+                   ConvertToRawIndexTaskExecutor()):
             self.executors[ex.task_type] = ex
         self.completed = 0
         self.failed = 0

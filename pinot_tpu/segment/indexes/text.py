@@ -24,6 +24,32 @@ import numpy as np
 _TOKEN_RX = re.compile(r"[A-Za-z0-9_]+")
 
 
+def _edit_distance_at_most(a: str, b: str, k: int) -> bool:
+    """Levenshtein(a, b) <= k, banded DP (cells beyond the +-k diagonal can
+    never come back under k) with row-minimum early exit."""
+    if a == b:
+        return True
+    if k == 0:
+        return False
+    la, lb = len(a), len(b)
+    if abs(la - lb) > k:
+        return False
+    if lb == 0:
+        return la <= k   # empty band below would crash min()
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        lo = max(1, i - k)
+        hi = min(lb, i + k)
+        cur = [i] + [k + 1] * lb
+        for j in range(lo, hi + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        if min(cur[lo:hi + 1]) > k:
+            return False
+        prev = cur
+    return prev[lb] <= k
+
+
 def tokenize_text(text: str) -> List[str]:
     return [t.lower() for t in _TOKEN_RX.findall(str(text))]
 
@@ -93,6 +119,24 @@ class _TextMaskOps:
         m = np.zeros(self.num_docs, dtype=bool)
         for tok, docs in self._iter_token_docs():
             if rx.fullmatch(tok):
+                m[docs[docs < self.num_docs]] = True
+        return m
+
+    def mask_for_fuzzy(self, term: str, max_edits: int = 2) -> np.ndarray:
+        """Docs containing a token within `max_edits` Levenshtein edits of
+        `term` — Lucene fuzzy query semantics (`roam~1` matches foam/roams).
+        The reference runs a Lucene FuzzyQuery (Levenshtein automaton);
+        here a banded edit-distance scan over the token dictionary — the
+        dictionaries are memory-resident and the band prunes each
+        comparison to O(len * max_edits)."""
+        term = term.lower()
+        k = max(0, int(max_edits))
+        m = np.zeros(self.num_docs, dtype=bool)
+        tl = len(term)
+        for tok, docs in self._iter_token_docs():
+            if abs(len(tok) - tl) > k:
+                continue
+            if _edit_distance_at_most(term, tok, k):
                 m[docs[docs < self.num_docs]] = True
         return m
 
@@ -196,10 +240,15 @@ class _QueryParser:
                 word = m.group(0)
                 i += len(word)
                 up = word.upper()
+                fz = re.fullmatch(r"(.+?)~(\d*)", word)
                 if up in ("AND", "OR", "NOT"):
                     out.append((up, up))
                 elif word.endswith("*"):
                     out.append(("prefix", word[:-1]))
+                elif fz:
+                    # Lucene fuzzy: term~ (2 edits) or term~N
+                    out.append(("fuzzy", (fz.group(1),
+                                          int(fz.group(2) or 2))))
                 else:
                     out.append(("term", word))
         return out
@@ -273,6 +322,8 @@ class _QueryParser:
             return self.index.mask_for_prefix(val)
         if kind == "regex":
             return self.index.mask_for_regex(val)
+        if kind == "fuzzy":
+            return self.index.mask_for_fuzzy(val[0], val[1])
         return self.index.mask_for_term(val)
 
 

@@ -358,3 +358,37 @@ class TestMutableTextIndex:
             assert res.rows[0][0] == 2
         finally:
             MemoryStream.reset_all()
+
+
+def test_text_match_fuzzy(jenv):
+    """Lucene fuzzy terms: term~ (2 edits) / term~1 — VERDICT r4 #8.
+    Differential against a python Levenshtein oracle over the raw texts,
+    on both the indexed and the index-less (scan) paths."""
+    seg, seg_noidx, _, texts, _ = jenv
+
+    def lev(a, b):
+        if len(a) < len(b):
+            a, b = b, a
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[-1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    for q, k in (("fox~1", 1), ("lazyy~", 2), ("quik~1", 1)):
+        term = q.split("~")[0]
+        want = sum(1 for t in texts
+                   if any(lev(term, w) <= k for w in t.split()))
+        sql = f"SELECT COUNT(*) FROM people WHERE TEXT_MATCH(doc, '{q}')"
+        assert q_count(seg, sql) == want, (q, want)
+        assert q_count(seg_noidx, sql) == want, (q, want)
+    # fuzzy composes with the boolean algebra
+    sql = ("SELECT COUNT(*) FROM people WHERE "
+           "TEXT_MATCH(doc, 'fox~1 AND NOT lazy')")
+    want = sum(1 for t in texts
+               if any(lev("fox", w) <= 1 for w in t.split())
+               and "lazy" not in t.split())
+    assert q_count(seg, sql) == want

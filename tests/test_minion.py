@@ -264,3 +264,34 @@ def test_replace_segments_lineage_hides_both_sides(tmp_path):
     assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 0
     cluster.catalog.put_property(f"lineage/{table}", None)
     assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 40
+
+
+def test_convert_to_raw_index_noop_does_not_churn(tmp_path):
+    """A segment whose target columns are ALREADY raw gets one no-op task,
+    lands in the done-set, and is never generated again (an unmarked no-op
+    would re-download the inputs every controller tick forever)."""
+    from pinot_tpu.minion.tasks import CONVERT_TO_RAW_INDEX
+    from pinot_tpu.segment.writer import SegmentGeneratorConfig
+    from pinot_tpu.table import IndexingConfig
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = event_schema()
+    cfg = TableConfig(
+        schema.name,
+        indexing=IndexingConfig(no_dictionary_columns=["cost"]),
+        task_configs={CONVERT_TO_RAW_INDEX: {"columnsToConvert": ["cost"]}})
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(3)
+    cluster.ingest_columns(cfg, make_cols(rng, 100, 0))
+    table = cfg.table_name_with_type
+    (name,) = cluster.catalog.segments[table]
+
+    done = cluster.run_minion_round()
+    assert [t.state for t in done] == [COMPLETED], [t.error for t in done]
+    # no replacement happened (it was already raw) and the done-set holds it
+    assert set(cluster.catalog.segments[table]) == {name}
+    assert name in (cluster.catalog.get_property(
+        f"convertRawDone/{table}") or [])
+    # the generator is now quiescent
+    assert cluster.run_minion_round() == []
+    assert cluster.run_minion_round() == []
