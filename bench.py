@@ -456,6 +456,15 @@ def e2e_device_bench(rows: int, n_clients: int = 32,
             for q in sqls:   # warm every kernel shape
                 cluster.query(q)
                 cluster.query(q)
+            # single-client p50: one query in flight -> no batch-wait, the
+            # relay round trip + kernel + HTTP hops (the latency floor of
+            # the served device path, vs QPS under concurrency below)
+            solo = []
+            for qi in range(9):
+                t0 = time.perf_counter()
+                cluster.query(sqls[qi % len(sqls)])
+                solo.append(time.perf_counter() - t0)
+            solo_p50 = float(np.median(solo)) * 1000
             lat: list = []
             lock = threading.Lock()
 
@@ -478,6 +487,7 @@ def e2e_device_bench(rows: int, n_clients: int = 32,
                 t.join()
             dt = time.perf_counter() - t0
             stats = pipeline.stats()
+            stats["soloP50Ms"] = round(solo_p50, 3)
         finally:
             svc.stop()
             server.shutdown()
@@ -820,6 +830,7 @@ def main():
             "e2e_p50_ms": round(e2e_p50, 3),
             "e2e_qps_device": round(e2e_dev_qps, 1),
             "e2e_p50_device_ms": round(e2e_dev_p50, 3),
+            "e2e_p50_device_1client_ms": dev_stats.get("soloP50Ms"),
             "e2e_device_mean_batch": dev_stats.get("meanBatch", 0.0),
             "e2e_qps_device_4m": round(e2e_dev_qps_4m, 1),
             "e2e_p50_device_4m_ms": round(e2e_dev_p50_4m, 3),

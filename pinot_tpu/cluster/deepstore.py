@@ -245,6 +245,11 @@ def _hdfs_fs(root: str) -> DeepStoreFS:
     return HdfsDeepStoreFS(root)
 
 
+def _adls_fs(root: str) -> DeepStoreFS:
+    from .adlsstore import AdlsDeepStoreFS   # lazy
+    return AdlsDeepStoreFS(root)
+
+
 # scheme -> factory callable (a class works too; reference: PinotFSFactory)
 _FS_REGISTRY: Dict[str, Callable[[str], DeepStoreFS]] = {
     "local": LocalDeepStore,
@@ -252,6 +257,7 @@ _FS_REGISTRY: Dict[str, Callable[[str], DeepStoreFS]] = {
     "s3": _s3_fs,
     "gs": _gcs_fs,
     "hdfs": _hdfs_fs,
+    "adls": _adls_fs,
 }
 
 
