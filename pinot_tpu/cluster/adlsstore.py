@@ -114,12 +114,6 @@ class AdlsDeepStoreFS(RemoteObjectFS):
                     f"adls://{self.filesystem}/{self._key(uri)}") from None
             raise
 
-    def download(self, uri: str, local_path: str) -> None:
-        data = self.get_bytes(uri)
-        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-        with open(local_path, "wb") as f:
-            f.write(data)
-
     def delete(self, uri: str) -> None:
         try:
             self._call("DELETE", self._url(self._key(uri),
@@ -214,11 +208,7 @@ class AdlsDeepStoreFS(RemoteObjectFS):
             conn.close()
 
 
-def _adls_fs(root: str) -> DeepStoreFS:
-    return AdlsDeepStoreFS(root)
-
-
-register_fs("adls", _adls_fs)
+register_fs("adls", AdlsDeepStoreFS)
 
 
 # ---------------------------------------------------------------------------

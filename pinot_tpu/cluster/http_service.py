@@ -428,7 +428,11 @@ _POOL = _ConnPool()
 
 
 def _pooled_request(method: str, url: str, body: Optional[bytes],
-                    headers: Dict[str, str], timeout: float) -> bytes:
+                    headers: Dict[str, str], timeout: float,
+                    return_headers: bool = False):
+    """One pooled exchange; returns the body, or (body, lowercase response
+    headers) with `return_headers=True` — for protocols whose pagination
+    token rides a header (ADLS x-ms-continuation)."""
     parsed = urllib.parse.urlparse(url)
     scheme = parsed.scheme or "http"
     host = parsed.hostname or "127.0.0.1"
@@ -465,6 +469,8 @@ def _pooled_request(method: str, url: str, body: Optional[bytes],
             conn.close()
         else:
             _POOL.put(scheme, host, port, conn)
+        if return_headers:
+            return data, {k.lower(): v for k, v in resp.getheaders()}
         return data
     raise ConnectionError(f"{method} {url}: unreachable")   # pragma: no cover
 

@@ -210,3 +210,75 @@ def test_process_cluster_device_mode(tmp_path, ssb_schema):
         # from metadata and counts as a fallback)
         assert st["device"]["dispatched"] >= 1, st
         assert st["device"]["batches"] >= 1, st
+
+
+def test_served_high_card_groupby_differential(tmp_path, ssb_schema):
+    """High-cardinality GROUP BY through the SERVED device path (the
+    chunked kernel feeding an UNTRIMMED server partial that the broker
+    reduces) must match numpy exactly."""
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    rng = np.random.default_rng(21)
+    cfg = TableConfig(ssb_schema.name)
+    cluster.create_table(ssb_schema, cfg)
+    all_cols = {k: [] for k in make_ssb_columns(rng, 1)}
+    for i in range(2):
+        cols = make_ssb_columns(rng, 30_000)
+        for k, v in cols.items():
+            all_cols[k].extend(list(v))
+        cluster.ingest_columns(cfg, cols)
+    d0 = pipeline.dispatched
+    res = cluster.query("SELECT lo_custkey, SUM(lo_revenue), COUNT(*) "
+                        "FROM lineorder GROUP BY lo_custkey "
+                        "ORDER BY SUM(lo_revenue) DESC LIMIT 50")
+    assert pipeline.dispatched == d0 + 1, "did not run on the device path"
+    keys = np.asarray(all_cols["lo_custkey"])
+    revs = np.asarray(all_cols["lo_revenue"], dtype=np.float64)
+    sums = {}
+    cnts = {}
+    for k, v in zip(keys.tolist(), revs.tolist()):
+        sums[k] = sums.get(k, 0.0) + v
+        cnts[k] = cnts.get(k, 0) + 1
+    want = sorted(sums.items(), key=lambda kv: -kv[1])[:50]
+    assert len(res.rows) == 50
+    for (gk, gs, gc), (wk, ws) in zip(res.rows, want):
+        assert gk == wk and gc == cnts[wk]
+        assert abs(gs - ws) <= 2e-3 * max(1.0, abs(ws)), (gk, gs, ws)
+    pipeline.stop()
+
+
+def test_upsert_table_bypasses_device(tmp_path):
+    """Upsert tables need per-doc validity masks (host state): on a
+    device-enabled server they must take the host path and stay correct."""
+    import json as _json
+
+    from pinot_tpu.ingest.stream import MemoryStream
+    from pinot_tpu.table import UpsertConfig
+
+    schema = Schema("ups", [dimension("pk", DataType.STRING),
+                            metric("v", DataType.LONG),
+                            metric("ts", DataType.LONG)])
+    schema.primary_key_columns = ["pk"]
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    cfg = TableConfig("ups", table_type=TableType.REALTIME,
+                      upsert=UpsertConfig(mode="FULL"),
+                      stream=StreamConfig(stream_type="memory",
+                                          topic="ups_dev",
+                                          flush_threshold_rows=1000))
+    cluster.create_realtime_table(schema, cfg, num_partitions=1)
+    stream = MemoryStream.get("ups_dev")
+    for i in range(60):
+        stream.produce(_json.dumps(
+            {"pk": f"k{i % 20}", "v": i, "ts": i}), partition=0)
+    for _ in range(8):
+        cluster.pump_realtime(cfg.table_name_with_type)
+    d0 = pipeline.dispatched
+    res = cluster.query("SELECT COUNT(*), SUM(v) FROM ups WHERE ts >= 0")
+    # 20 live rows (latest per pk: i in 40..59)
+    assert res.rows[0][0] == 20
+    assert res.rows[0][1] == sum(range(40, 60))
+    assert pipeline.dispatched == d0, "upsert query must not ride the device"
+    pipeline.stop()
