@@ -269,16 +269,22 @@ def ingest_bench(rows: int = 400_000):
     same rows (reference: pinot-perf BenchmarkRealtimeConsumptionSpeed.java)."""
     srv, raws = _ingest_topic(rows)
     try:
-        t0 = time.perf_counter()
-        n, clicks = _consume_partition(srv.bootstrap, 0, rows)
-        dt = time.perf_counter() - t0
-        if n != rows or clicks != sum(r["clicks"] for r in raws):
-            print(f"WARNING: ingest mismatch {n}/{rows} clicks {clicks}",
-                  file=sys.stderr)
+        # best-of-2 (noise on the shared 1-core host is strictly additive;
+        # the numpy denominator below gets the same best-of treatment)
+        dts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            n, clicks = _consume_partition(srv.bootstrap, 0, rows)
+            dts.append(time.perf_counter() - t0)
+            if n != rows or clicks != sum(r["clicks"] for r in raws):
+                print(f"WARNING: ingest mismatch {n}/{rows} clicks {clicks}",
+                      file=sys.stderr)
+        dt = min(dts)
     finally:
         srv.stop()
     # numpy append baseline: same rows into plain column arrays, no indexes
-    # (median of 3 — the pure-Python loop's rate swings ~50% run to run)
+    # (best of 3 — the pure-Python loop's rate swings ~50% run to run; both
+    # sides of the ratio get the best-of treatment)
     np_dts = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -288,7 +294,7 @@ def ingest_bench(rows: int = 400_000):
                 cols[k].append(r[k])
         _ = {k: np.asarray(v) for k, v in cols.items()}
         np_dts.append(time.perf_counter() - t0)
-    np_dt = float(np.median(np_dts))
+    np_dt = float(np.min(np_dts))
     return rows / dt, rows / np_dt
 
 

@@ -187,25 +187,16 @@ class AdlsDeepStoreFS(RemoteObjectFS):
         return out[:limit]
 
     def _call_with_headers(self, method: str, url: str):
-        """Like _call but surfacing response headers (the continuation
-        token rides a header, not the body)."""
-        import http.client
-        parts = urllib.parse.urlsplit(url)
-        conn = http.client.HTTPConnection(parts.hostname, parts.port,
-                                          timeout=self.timeout_s)
+        """_call surfacing response headers (the continuation token rides a
+        header, not the body) — same pooled, TLS-capable transport as every
+        other ADLS operation."""
+        from .http_service import HttpError, _pooled_request
+        h = {"Authorization": f"Bearer {self.token}"} if self.token else {}
         try:
-            h = {"Authorization": f"Bearer {self.token}"} if self.token                 else {}
-            conn.request(method, parts.path +
-                         ("?" + parts.query if parts.query else ""),
-                         headers=h)
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status >= 400:
-                raise AdlsError(resp.status,
-                                data[:200].decode(errors="replace"))
-            return data, {k.lower(): v for k, v in resp.getheaders()}
-        finally:
-            conn.close()
+            return _pooled_request(method, url, None, h, self.timeout_s,
+                                   return_headers=True)
+        except HttpError as e:
+            raise AdlsError(e.status, str(e)) from None
 
 
 register_fs("adls", AdlsDeepStoreFS)

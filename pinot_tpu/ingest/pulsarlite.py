@@ -167,25 +167,8 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def read_frame_or_raise(sock: socket.socket):
-    """read_frame that maps EOF to ConnectionError — for request/response
-    exchanges where a closed socket must not surface as a TypeError from
-    unpacking None."""
-    frame = read_frame(sock)
-    if frame is None:
-        raise ConnectionError("pulsar connection closed mid-exchange")
-    return frame
-
-
-def read_frame(sock: socket.socket):
-    """-> (BaseCommand fields, metadata fields|None, payload|None) or None."""
-    head = _recv_exact(sock, 4)
-    if head is None:
-        return None
-    (total,) = struct.unpack(">I", head)
-    body = _recv_exact(sock, total)
-    if body is None:
-        return None
+def parse_frame(body: bytes):
+    """Decode one complete frame body (everything after totalSize)."""
     (cmd_size,) = struct.unpack(">I", body[:4])
     cmd = _decode(body[4:4 + cmd_size])
     rest = body[4 + cmd_size:]
@@ -201,6 +184,20 @@ def read_frame(sock: socket.socket):
     metadata = _decode(meta_part[4:4 + meta_size])
     payload = meta_part[4 + meta_size:]
     return cmd, metadata, payload
+
+
+def read_frame(sock: socket.socket):
+    """-> (BaseCommand fields, metadata fields|None, payload|None) or None
+    on EOF. Blocking frame-at-a-time variant for the broker's serve loop;
+    clients read through PulsarLiteClient's buffer instead."""
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (total,) = struct.unpack(">I", head)
+    body = _recv_exact(sock, total)
+    if body is None:
+        return None
+    return parse_frame(body)
 
 
 # ---------------------------------------------------------------------------
@@ -380,7 +377,12 @@ def partition_topic(topic: str, partition: int) -> str:
 
 
 class PulsarLiteClient:
-    """One connection: CONNECT handshake + request/response command helpers."""
+    """One connection: CONNECT handshake + request/response command helpers.
+
+    ALL reads go through a receive buffer (`read_frame_timeout`): a short
+    poll that expires MID-FRAME keeps the partial bytes buffered instead of
+    desyncing the stream — discarding them once wedged a consumer forever
+    when the broker's push landed across a fetch's poll deadline."""
 
     def __init__(self, service_url: str):
         assert service_url.startswith("pulsar://"), service_url
@@ -388,11 +390,47 @@ class PulsarLiteClient:
         self.sock = socket.create_connection((host, int(port)), timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._req = 0
+        self._rbuf = bytearray()
         self.sock.sendall(encode_frame(_base_command(
             CONNECT, {1: "pinot-tpu-pulsarlite", 4: 21})))
-        cmd, _, _ = read_frame_or_raise(self.sock)
+        cmd, _, _ = self.read_frame_blocking()
         if _one(cmd, 1) != CONNECTED:
             raise ConnectionError(f"pulsar handshake failed: {cmd}")
+
+    def read_frame_timeout(self, timeout_s: float):
+        """One complete frame, or None when `timeout_s` expires first.
+        Partial bytes stay buffered for the next call; the socket timeout is
+        RESTORED on every exit so later sendall calls (SEND payloads, FLOW)
+        never run under the 50ms poll — a sendall cut short mid-frame would
+        desync the wire for good."""
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                if len(self._rbuf) >= 4:
+                    (total,) = struct.unpack(">I", bytes(self._rbuf[:4]))
+                    if len(self._rbuf) >= 4 + total:
+                        body = bytes(self._rbuf[4:4 + total])
+                        del self._rbuf[:4 + total]
+                        return parse_frame(body)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self.sock.settimeout(min(remaining, 0.05))
+                try:
+                    chunk = self.sock.recv(1 << 16)
+                except (socket.timeout, TimeoutError):
+                    continue
+                if not chunk:
+                    raise ConnectionError("pulsar connection closed")
+                self._rbuf.extend(chunk)
+        finally:
+            self.sock.settimeout(30)
+
+    def read_frame_blocking(self, timeout_s: float = 30.0):
+        frame = self.read_frame_timeout(timeout_s)
+        if frame is None:
+            raise ConnectionError("pulsar exchange timed out")
+        return frame
 
     def next_req(self) -> int:
         self._req += 1
@@ -413,7 +451,7 @@ class PulsarLiteProducer:
         self.client.sock.sendall(encode_frame(_base_command(PRODUCER, {
             1: partition_topic(topic, partition), 2: self.producer_id,
             3: self.client.next_req()})))
-        cmd, _, _ = read_frame_or_raise(self.client.sock)
+        cmd, _, _ = self.client.read_frame_blocking()
         if _one(cmd, 1) != PRODUCER_SUCCESS:
             raise ConnectionError(f"producer create failed: {cmd}")
 
@@ -425,7 +463,7 @@ class PulsarLiteProducer:
         self.client.sock.sendall(encode_frame(
             _base_command(SEND, {1: self.producer_id, 2: self._seq}),
             metadata, payload))
-        cmd, _, _ = read_frame_or_raise(self.client.sock)
+        cmd, _, _ = self.client.read_frame_blocking()
         if _one(cmd, 1) != SEND_RECEIPT:
             raise RuntimeError(f"send failed: {cmd}")
         receipt = _decode(_one(cmd, SEND_RECEIPT))
@@ -451,7 +489,7 @@ class PulsarLiteConsumer(PartitionGroupConsumer):
             2: "pinot-tpu-reader", 3: 0, 4: self.consumer_id,
             5: self.client.next_req(), 8: 0,
             9: _message_id(0, 0)})))
-        cmd, _, _ = read_frame_or_raise(self.client.sock)
+        cmd, _, _ = self.client.read_frame_blocking()
         if _one(cmd, 1) != SUCCESS:
             raise ConnectionError(f"subscribe failed: {cmd}")
         self._cursor = 0
@@ -463,7 +501,7 @@ class PulsarLiteConsumer(PartitionGroupConsumer):
         # MESSAGE frames already in flight may precede the SUCCESS; they are
         # stale (pre-seek cursor) and dropped here
         while True:
-            cmd, _, _ = read_frame_or_raise(self.client.sock)
+            cmd, _, _ = self.client.read_frame_blocking()
             if _one(cmd, 1) == SUCCESS:
                 break
         self._cursor = offset
@@ -475,32 +513,36 @@ class PulsarLiteConsumer(PartitionGroupConsumer):
         self.client.sock.sendall(encode_frame(_base_command(FLOW, {
             1: self.consumer_id, 2: max_messages})))
         msgs: List[StreamMessage] = []
-        deadline = time.time() + max(timeout_ms, 50) / 1000.0
-        self.client.sock.settimeout(0.05)
-        try:
-            while len(msgs) < max_messages and time.time() < deadline:
-                try:
-                    frame = read_frame(self.client.sock)
-                except (socket.timeout, TimeoutError):
-                    if msgs:
-                        break  # drained what the broker had
-                    continue
-                if frame is None:
-                    break
-                cmd, metadata, payload = frame
-                if _one(cmd, 1) != MESSAGE:
-                    continue
-                d = _decode(_one(cmd, MESSAGE))
-                mid = _decode(_one(d, 2))
-                entry = _one(mid, 2, 0)
-                if entry < start_offset:
-                    continue  # stale pre-seek delivery
-                ts = _one(metadata, 3, 0) if metadata else 0
-                msgs.append(StreamMessage(
-                    value=(payload or b"").decode("utf-8", "surrogateescape"),
-                    offset=entry, key=None, timestamp_ms=int(ts)))
-        finally:
-            self.client.sock.settimeout(30)
+        deadline = time.monotonic() + max(timeout_ms, 50) / 1000.0
+        while len(msgs) < max_messages:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            # buffered read: a poll expiring MID-FRAME keeps partial bytes
+            # for the next call instead of desyncing the stream (a raw
+            # socket-timeout read dropped them and wedged the consumer when
+            # the broker's push landed across the deadline under load)
+            try:
+                frame = self.client.read_frame_timeout(
+                    0.02 if msgs else remaining)
+            except ConnectionError:
+                break   # broker EOF mid-fetch: return what was drained
+            if frame is None:
+                if msgs:
+                    break  # drained what the broker had
+                continue
+            cmd, metadata, payload = frame
+            if _one(cmd, 1) != MESSAGE:
+                continue
+            d = _decode(_one(cmd, MESSAGE))
+            mid = _decode(_one(d, 2))
+            entry = _one(mid, 2, 0)
+            if entry < start_offset:
+                continue  # stale pre-seek delivery
+            ts = _one(metadata, 3, 0) if metadata else 0
+            msgs.append(StreamMessage(
+                value=(payload or b"").decode("utf-8", "surrogateescape"),
+                offset=entry, key=None, timestamp_ms=int(ts)))
         next_offset = msgs[-1].offset + 1 if msgs else start_offset
         self._cursor = next_offset
         return MessageBatch(msgs, next_offset)
@@ -510,7 +552,7 @@ class PulsarLiteConsumer(PartitionGroupConsumer):
             GET_LAST_MESSAGE_ID,
             {1: self.consumer_id, 2: self.client.next_req()})))
         while True:
-            cmd, _, _ = read_frame_or_raise(self.client.sock)
+            cmd, _, _ = self.client.read_frame_blocking()
             if _one(cmd, 1) == GET_LAST_MESSAGE_ID_RESPONSE:
                 d = _decode(_one(cmd, GET_LAST_MESSAGE_ID_RESPONSE))
                 mid = _decode(_one(d, 1))

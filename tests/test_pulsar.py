@@ -131,8 +131,15 @@ def test_realtime_table_consumes_via_pulsar_across_processes(tmp_path):
             deadline = time.time() + 150
             total = 0
             while time.time() < deadline:
-                r = cluster.query("SELECT COUNT(*), SUM(clicks) FROM pev")[
-                    "resultTable"]["rows"]
+                try:
+                    r = cluster.query(
+                        "SELECT COUNT(*), SUM(clicks) FROM pev")[
+                        "resultTable"]["rows"]
+                except Exception:
+                    # broker's catalog mirror may not have synced the new
+                    # table yet ("unknown table") — retry within deadline
+                    time.sleep(0.3)
+                    continue
                 total = r[0][0] if r else 0
                 if total == 300:
                     assert r[0][1] == 300
