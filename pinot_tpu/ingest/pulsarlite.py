@@ -415,7 +415,11 @@ class PulsarLiteClient:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
-                self.sock.settimeout(min(remaining, 0.05))
+                # block for the full remaining budget: the client is
+                # single-threaded with nothing to service between frames,
+                # so a short poll here would only add wakeup churn (fetch's
+                # drain passes its own short timeout_s when it wants one)
+                self.sock.settimeout(remaining)
                 try:
                     chunk = self.sock.recv(1 << 16)
                 except (socket.timeout, TimeoutError):
