@@ -162,8 +162,14 @@ def test_dead_minion_lease_requeues_to_live_worker(tmp_path):
     schema = event_schema()
     yesterday = (int(time.time() * 1000) // DAY - 1) * DAY
     rng = np.random.default_rng(41)
+    # slow the live minion's claim polling: the test's "dead" worker must
+    # win the claim race right after generate (with the default 1s poll the
+    # live minion occasionally steals the task under suite load)
+    conf = tmp_path / "minion.conf"
+    conf.write_text("minion.poll.seconds=3\n")
     with ProcessCluster(num_servers=1, num_minions=1,
-                        work_dir=str(tmp_path)) as cluster:
+                        work_dir=str(tmp_path),
+                        config_path=str(conf)) as cluster:
         cluster.controller.add_schema(schema)
         cfg = TableConfig(schema.name, time_column="ts",
                           task_configs={MERGE_ROLLUP: {"bucketMs": DAY}})
