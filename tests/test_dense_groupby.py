@@ -150,3 +150,61 @@ def _norm(rows):
                 vals.append(v)
         out.append(tuple(vals))
     return out
+
+
+def _assert_rows_match(dev_rows, host_rows, ctxmsg):
+    assert len(dev_rows) == len(host_rows), ctxmsg
+    for dr, hr in zip(dev_rows, host_rows):
+        assert len(dr) == len(hr), (ctxmsg, dr, hr)
+        for dv, hv in zip(dr, hr):
+            if isinstance(dv, float) and isinstance(hv, float):
+                assert abs(dv - hv) <= 2e-3 * max(1.0, abs(hv)),                     (ctxmsg, dr, hr)
+            else:
+                assert dv == hv, (ctxmsg, dr, hr)
+
+
+# one card per kernel regime: skinny matmul (<=512), chunked 64x64 (two
+# points), and — via the g*k combined key space — past the chunk cap
+@pytest.mark.parametrize("card", [300, 700, 5000, 40_000])
+def test_groupby_fuzz_across_cap_regimes(tmp_path_factory, mesh_exec, card):
+    """Seeded fuzz of GROUP BY across the three kernel regimes, with
+    filters, agg mixes, and order/limit shapes — differential against the
+    host engine."""
+    seed = card % 97
+    rng = np.random.default_rng(1000 + seed)
+    rows = 30_000
+    schema = Schema(f"fz{seed}", [
+        dimension("k", DataType.INT),
+        dimension("g", DataType.STRING),
+        metric("v", DataType.DOUBLE),
+        metric("q", DataType.INT),
+    ])
+    cols = {
+        "k": rng.integers(0, card, rows).astype(np.int32),
+        "g": [f"g{i}" for i in rng.integers(0, 6, rows)],
+        "v": np.round(rng.uniform(-500, 500, rows), 3),
+        "q": rng.integers(0, 1000, rows).astype(np.int32),
+    }
+    out = tmp_path_factory.mktemp(f"fz{seed}")
+    paths = build_aligned_segments(schema, cols, str(out), f"fz{seed}", 4)
+    segs = [load_segment(p) for p in paths]
+    host = ServerQueryExecutor(use_device=False)
+    shapes = [
+        f"SELECT k, COUNT(*), SUM(v) FROM fz{seed} GROUP BY k "
+        f"ORDER BY k LIMIT 100000",
+        f"SELECT k, AVG(v), MIN(q), MAX(q) FROM fz{seed} WHERE q < 500 "
+        f"GROUP BY k ORDER BY k LIMIT 100000",
+        # multi-column group: the combined key space k*6 can cross caps
+        f"SELECT g, k, SUM(v) FROM fz{seed} WHERE q >= 250 GROUP BY g, k "
+        f"ORDER BY g, k LIMIT 100000",
+        # the k tiebreak pins rank order when adjacent sums differ by
+        # less than cross-engine float error
+        f"SELECT k, SUM(v) FROM fz{seed} GROUP BY k "
+        f"ORDER BY SUM(v) DESC, k LIMIT 13",
+        f"SELECT g, VARPOP(v), COUNT(*) FROM fz{seed} GROUP BY g "
+        f"ORDER BY g LIMIT 10",
+    ]
+    for sql in shapes:
+        dev = mesh_exec.execute(segs, sql)
+        want = host.execute(segs, sql)
+        _assert_rows_match(dev.rows, want.rows, sql)

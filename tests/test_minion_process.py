@@ -166,7 +166,7 @@ def test_dead_minion_lease_requeues_to_live_worker(tmp_path):
     # win the claim race right after generate (with the default 1s poll the
     # live minion occasionally steals the task under suite load)
     conf = tmp_path / "minion.conf"
-    conf.write_text("minion.poll.seconds=3\n")
+    conf.write_text("minion.poll.seconds=30\n")
     with ProcessCluster(num_servers=1, num_minions=1,
                         work_dir=str(tmp_path),
                         config_path=str(conf)) as cluster:
