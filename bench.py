@@ -28,6 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
 
+from pinot_tpu.engine import calibrate as _caps_mod  # noqa: E402
+
 # 16M rows = 2M/segment x 8: the largest padded block that keeps the group-by
 # one-hot matmul inside the f32-exact 2^24-increment budget on ONE device
 # (multi-chip divides rows per device, so real meshes scale past this)
@@ -70,12 +72,20 @@ HIGH_CARD_QUERY = ("SELECT lo_suppkey, SUM(lo_revenue), COUNT(*) "
 THETA_QUERY = ("SELECT DISTINCTCOUNTTHETASKETCH(lo_orderdate) FROM lineorder "
                "WHERE lo_quantity < 25")
 
-# 500k keys: past CHUNK_KEY_CAP, the K-independent segment_sum scatter path +
-# dense decode (the honest very-high-cardinality line VERDICT r4 asked for)
+# 500k keys: past chunk_cap, the calibrated high-card regime (default: the
+# radix/rank-partitioned sort kernel replacing the old segment_sum scatter —
+# the honest very-high-cardinality line VERDICT r4 asked for)
 VERY_HIGH_CARD_QUERY = ("SELECT lo_custkey, SUM(lo_revenue), COUNT(*) "
                         "FROM lineorder GROUP BY lo_custkey LIMIT 600000")
 
 VERY_HIGH_CARD_KEYS = 500_000
+
+# regime-ladder sweep: per-regime rows/s at each cardinality, every high-card
+# regime forced in turn via set_caps (output schema: detail.very_high_card_sweep
+# = {card: {partitioned|sorted|scatter_rows_per_sec, auto_rows_per_sec,
+# auto_regime, groups}})
+VHC_SWEEP_CARDS = (128 * 1024, 500_000, 2_000_000)
+VHC_SWEEP_ITERS = int(os.environ.get("PINOT_BENCH_VHC_ITERS", 3))
 
 # BASELINE config 3 as designed: a LARGE record table (high-cardinality split
 # dims) runs the STACKED DEVICE star path — record tables stack like base
@@ -150,6 +160,81 @@ def build_or_load_segments(schema, cols, star_tree=False, rows=None, tag=None,
             f.write("ok")
     names = sorted(d for d in os.listdir(seg_root) if d.startswith("lineorder_"))
     return [load_segment(os.path.join(seg_root, d)) for d in names]
+
+
+def _vhc_sweep_segments(card: int, rows: int):
+    """Dedicated two-column [k, v] sets per sweep cardinality (cached)."""
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.segment import load_segment
+    from pinot_tpu.segment.writer import (SegmentGeneratorConfig,
+                                          build_aligned_segments)
+    schema = Schema("vhsweep", [dimension("k", DataType.INT),
+                                metric("v", DataType.DOUBLE)])
+    seg_root = os.path.join(CACHE, f"vhc{card}_r{rows}_s{SEGMENTS}_v2")
+    marker = os.path.join(seg_root, "DONE")
+    if not os.path.exists(marker):
+        os.makedirs(seg_root, exist_ok=True)
+        rng = np.random.default_rng(card)
+        # one full pass of every key, the rest random repeats: the sweep's
+        # group count IS its nominal cardinality, not a random-draw fraction
+        base = min(card, rows)
+        k = np.concatenate([np.arange(base, dtype=np.int64),
+                            rng.integers(0, base, rows - base)])
+        rng.shuffle(k)
+        cols = {"k": k.astype(np.int32),
+                "v": np.round(rng.uniform(1.0, 60_000.0, rows), 2)}
+        # dict-encode k even at cardinality ~ rows: raw columns would demote
+        # the whole sweep to the host path
+        cfg = SegmentGeneratorConfig(raw_cardinality_fraction=4.0)
+        build_aligned_segments(schema, cols, seg_root, "vhsweep", SEGMENTS,
+                               config=cfg)
+        with open(marker, "w") as f:
+            f.write("ok")
+    names = sorted(d for d in os.listdir(seg_root) if d.startswith("vhsweep_"))
+    return schema, [load_segment(os.path.join(seg_root, d)) for d in names]
+
+
+def very_high_card_sweep(mesh_exec, n_dev: int) -> dict:
+    """Per-regime rows/s at 128k/500k/2M groups: every high-card regime forced
+    in turn (set_caps recompiles), plus the rate the CALIBRATED default caps
+    actually dispatch ("auto"). The regime ladder's measured crossover story."""
+    from pinot_tpu.engine.calibrate import KernelCaps, get_caps, set_caps
+    rows = min(ROWS, 4 * 1024 * 1024)
+    prev = get_caps()
+    sweep = {}
+    try:
+        for card in VHC_SWEEP_CARDS:
+            schema, segs = _vhc_sweep_segments(card, rows)
+            sql = (f"SELECT k, SUM(v), COUNT(*) FROM vhsweep GROUP BY k "
+                   f"LIMIT {3 * card}")
+            entry = {}
+            for regime in ("partitioned", "sorted", "scatter"):
+                # chunk_cap floored so EVERY sweep size dispatches through the
+                # regime under test rather than the chunked matmul
+                set_caps(KernelCaps(matmul_cap=prev.matmul_cap, chunk_cap=4096,
+                                    minmax_bcast_cap=prev.minmax_bcast_cap,
+                                    high_card_regime=regime,
+                                    partition_block=prev.partition_block))
+                mesh_exec.execute(segs, sql)  # compile + transfer warmup
+                t0 = time.perf_counter()
+                mesh_exec.execute_many(segs, [sql] * VHC_SWEEP_ITERS)
+                dt = time.perf_counter() - t0
+                entry[f"{regime}_rows_per_sec"] = round(
+                    rows * VHC_SWEEP_ITERS / dt / n_dev, 1)
+            set_caps(prev)
+            mesh_exec.execute(segs, sql)
+            t0 = time.perf_counter()
+            results = mesh_exec.execute_many(segs, [sql] * VHC_SWEEP_ITERS)
+            dt = time.perf_counter() - t0
+            entry["auto_rows_per_sec"] = round(
+                rows * VHC_SWEEP_ITERS / dt / n_dev, 1)
+            entry["auto_regime"] = ("chunk" if card <= prev.chunk_cap
+                                    else prev.high_card_regime)
+            entry["groups"] = len(results[-1].rows)
+            sweep[str(card)] = entry
+    finally:
+        set_caps(prev)
+    return sweep
 
 
 def numpy_baseline(cols, iters=3) -> float:
@@ -663,6 +748,8 @@ def main():
     theta_rate, theta_res = pipelined_rate(THETA_QUERY)
     mesh_exec.execute(segments, VERY_HIGH_CARD_QUERY)
     vhc_rate, vhc_res = pipelined_rate(VERY_HIGH_CARD_QUERY, iters=3)
+    # regime-ladder sweep: 128k/500k/2M groups, every high-card regime forced
+    vhc_sweep = very_high_card_sweep(mesh_exec, n_dev)
 
     # r4: stacked-device star path over a LARGE record table
     star_hc_segments = build_or_load_segments(schema, cols, star_hc=True)
@@ -827,6 +914,8 @@ def main():
             "high_card_groups": len(hc_groups),
             "very_high_card_groupby_rows_per_sec": round(vhc_rate / n_dev, 1),
             "very_high_card_groups": len(vhc_groups),
+            "very_high_card_regime": _caps_mod.get_caps().high_card_regime,
+            "very_high_card_sweep": vhc_sweep,
             "theta_rows_per_sec": round(theta_rate / n_dev, 1),
             "theta_vs_numpy": round(theta_rate / n_dev / theta_np_rate, 3),
             "startree_rows_per_sec": round(star_rate / n_dev, 1),

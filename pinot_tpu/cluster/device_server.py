@@ -30,7 +30,8 @@ from __future__ import annotations
 
 import queue
 import threading
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Optional, Sequence
 
 
@@ -41,6 +42,21 @@ class _Sentinel:
 
 #: resolved value when the query must take the host path instead
 DEVICE_FALLBACK = _Sentinel()
+
+
+def _resolve(future: Future, value, exc: Optional[BaseException] = None) -> None:
+    """set_result/set_exception tolerant of a caller that already timed out
+    and CANCELLED the future (racing a cancel with resolution is inherent to
+    the timeout path — losing the race must not kill the pipeline thread)."""
+    if future.done():
+        return
+    try:
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(value)
+    except (InvalidStateError, CancelledError):
+        pass
 
 
 class _Item:
@@ -74,6 +90,7 @@ class DeviceQueryPipeline:
         self.batches = 0
         self.dispatched = 0
         self.fallbacks = 0
+        self.timeouts = 0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="device-pipeline")
         self._thread.start()
@@ -86,7 +103,15 @@ class DeviceQueryPipeline:
         """Submit and wait; returns a SegmentResult partial or DEVICE_FALLBACK."""
         item = _Item(ctx, list(segments))
         self._q.put(item)
-        return item.future.result(timeout=self.submit_timeout_s)
+        try:
+            return item.future.result(timeout=self.submit_timeout_s)
+        except FutureTimeoutError:
+            # cancel so the dispatcher/fetcher SKIP the stale item instead of
+            # planning + dispatching + decoding a result nobody will read
+            # (under overload that duplicated work compounds the overload)
+            item.future.cancel()
+            self.timeouts += 1
+            return DEVICE_FALLBACK
 
     def stop(self) -> None:
         self._stop.set()
@@ -104,8 +129,7 @@ class DeviceQueryPipeline:
                 items = entry if isinstance(entry, list) else [entry]
                 for it in items:
                     item = it[0] if isinstance(it, tuple) else it
-                    if not item.future.done():
-                        item.future.set_result(DEVICE_FALLBACK)
+                    _resolve(item.future, DEVICE_FALLBACK)
 
     # -- dispatcher thread ------------------------------------------------
     def _drain(self) -> Optional[list]:
@@ -144,6 +168,10 @@ class DeviceQueryPipeline:
                 continue
             pending = []  # (item, outs_dev, decode)
             for item in batch:
+                if item.future.done():
+                    # caller already timed out and cancelled: don't burn a
+                    # device dispatch on a result nobody will read
+                    continue
                 try:
                     dp = self.mesh_exec.dispatch_partial(item.ctx,
                                                          item.segments)
@@ -154,7 +182,7 @@ class DeviceQueryPipeline:
                     dp = None
                 if dp is None:
                     self.fallbacks += 1
-                    item.future.set_result(DEVICE_FALLBACK)
+                    _resolve(item.future, DEVICE_FALLBACK)
                 else:
                     pending.append((item, dp[0], dp[1]))
             if not pending:
@@ -174,8 +202,7 @@ class DeviceQueryPipeline:
                 # otherwise dangle past stop()'s drain for the full submit
                 # timeout — resolve them to the host path now
                 for item, _, _ in pending:
-                    if not item.future.done():
-                        item.future.set_result(DEVICE_FALLBACK)
+                    _resolve(item.future, DEVICE_FALLBACK)
 
     def _fetch_loop(self) -> None:
         import jax
@@ -191,19 +218,21 @@ class DeviceQueryPipeline:
                     fetched = jax.device_get([p[1] for p in pending])
                 except Exception as e:
                     for item, _, _ in pending:
-                        item.future.set_exception(e)
+                        _resolve(item.future, None, exc=e)
                     continue
                 for (item, _, decode), outs in zip(pending, fetched):
+                    if item.future.done():
+                        continue  # caller timed out mid-fetch: skip the decode
                     try:
-                        item.future.set_result(decode(outs))
+                        _resolve(item.future, decode(outs))
                     except Exception as e:
-                        item.future.set_exception(e)
+                        _resolve(item.future, None, exc=e)
             finally:
                 self._fetch_busy.clear()
 
     def stats(self) -> dict:
         return {"batches": self.batches, "dispatched": self.dispatched,
-                "fallbacks": self.fallbacks,
+                "fallbacks": self.fallbacks, "timeouts": self.timeouts,
                 "meanBatch": round(self.dispatched / self.batches, 2)
                 if self.batches else 0.0}
 

@@ -221,6 +221,17 @@ def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
         "sortKeys": r.sort_keys,
         "served": r.served,
         "trace": trace_spans,
+        # array-form high-card partial: flat ndarrays instead of per-group
+        # state lists (reduce.DensePartial); `aggs` is build-side only
+        "dense": None if r.dense is None else {
+            "token": r.dense.token,
+            "cards": r.dense.cards,
+            "strides": r.dense.strides,
+            "numKeysReal": r.dense.num_keys_real,
+            "counts": r.dense.counts,
+            "outs": r.dense.outs,
+            "groupValues": [np.asarray(v) for v in r.dense.group_values],
+        },
     })
 
 
@@ -233,6 +244,21 @@ def decode_segment_result(data: bytes) -> SegmentResult:
     r.rows = [tuple(row) if not isinstance(row, tuple) else row for row in d["rows"]]
     r.sort_keys = [tuple(k) if not isinstance(k, tuple) else k for k in d["sortKeys"]]
     r.served = d.get("served")
+    dd = d.get("dense")
+    if dd is not None:
+        from ..query.reduce import DensePartial
+        r.dense = DensePartial(
+            token=dd["token"],
+            cards=tuple(dd["cards"]),
+            strides=tuple(dd["strides"]),
+            num_keys_real=dd["numKeysReal"],
+            counts=np.asarray(dd["counts"]),
+            outs={k: np.asarray(v) for k, v in dd["outs"].items()},
+            # string dictionaries decay to lists on the wire; rebuild them as
+            # OBJECT arrays (same rationale as decode_block)
+            group_values=[v if isinstance(v, np.ndarray)
+                          else np.asarray(v, dtype=object)
+                          for v in dd["groupValues"]])
     if d.get("trace"):
         r.trace_spans = d["trace"]  # spliced into the broker's trace by the caller
     return r

@@ -47,6 +47,7 @@ import numpy as np
 from ..query.aggregates import AggFunc
 from ..query.predicate import CmpLeaf, DocSetLeaf, FilterProgram, LutLeaf, NullLeaf
 from ..sql.ast import Identifier
+from .calibrate import get_caps
 from .expr import eval_expr
 
 _INT_MIN_IDENT = np.iinfo(np.int32).max  # identity for masked-out min over int
@@ -61,14 +62,21 @@ _POWER_SUMS = {"sum": 1, "sum2": 2, "sum3": 3, "sum4": 4}
 # output column tile re-walks the full contraction, so cost grows linearly in
 # keys — measured v5e 16M rows count+sum: 21ms @256 keys, 51ms @1024, 162ms
 # @4096. The chunked 64x64 formulation overtakes it between 256 and 1024.
+#
+# These constants are the DEFAULT values of the calibrated caps in
+# `engine/calibrate.py` (measured on v5e through the axon relay); the dispatch
+# ladder in `_make_body` reads `get_caps()`, not these names, so a persisted
+# calibration or PINOT_TPU_* env override retargets the ladder per platform.
 MATMUL_KEY_CAP = 512      # skinny one-hot matmul group-by partials
 MINMAX_BCAST_CAP = 1024   # per-key broadcast-reduce min/max, VPU-bound
 DENSE_LUT_MATMUL_CAP = 8192  # scattered-LUT membership via one-hot matmul
 PRESENCE_MATMUL_CAP = 8192   # _presence_2d chunked presence counts
 # Mid/high-cardinality group-by rides the CHUNKED 64x64 one-hot matmul
 # (_grouped_chunk64): measured v5e 16M rows count+sum 24ms @1024..2048 keys,
-# 30ms @4096, 39ms @20k, 69ms @32k vs segment_sum scatter ~248ms
-# (K-independent) — the crossover back to the scatter sits near 128k keys.
+# 30ms @4096, 39ms @20k, 69ms @32k. Past this cap the SORT-BASED regimes take
+# over (`_grouped_partitioned` / `_grouped_sorted`): the chunked path's cost is
+# linear in keys (~2.1ms per 4096-key chunk per bf16 part per 16M rows) while
+# a jax.lax.sort of 16M keys+payload is ~67ms flat — crossover near 128k keys.
 CHUNK_KEY_CAP = 131072
 
 
@@ -125,6 +133,8 @@ class KernelSpec:
             tuple(sorted(self.distinct_lut_sizes.items())),
             self.padded_rows,
             self.mv_cols,
+            # regime caps change the traced program for the same plan shape
+            get_caps().token(),
         )
 
 
@@ -339,6 +349,150 @@ def _grouped_chunk64(key: jnp.ndarray, nseg: int, exact_rows, split_rows):
     return [jnp.concatenate(p)[:nseg] for p in pieces]
 
 
+def _seg_sum_op(a, b):
+    """Associative combine for segmented inclusive sums: (head flag, value).
+    A set flag on the right element resets the running sum at segment heads."""
+    fa, va = a
+    fb, vb = b
+    return fa | fb, jnp.where(fb, vb, va + vb)
+
+
+def _sort_by_key(key: jnp.ndarray, nseg: int, value_rows, block: int):
+    """Co-sort value rows by group key, padded to a multiple of `block`.
+
+    Pad rows carry the overflow key (nseg-1 — the same bucket masked-out rows
+    already route to) and zero values, so sorted-run boundaries for REAL keys
+    are unaffected. Returns (sorted keys, sorted value rows, pad rows)."""
+    n = key.size
+    pad = (-n) % block
+    if pad:
+        key = jnp.concatenate([key, jnp.full((pad,), nseg - 1, key.dtype)])
+        value_rows = [jnp.concatenate([r, jnp.zeros((pad,), r.dtype)])
+                      for r in value_rows]
+    ops = jax.lax.sort([key] + list(value_rows), num_keys=1)
+    return ops[0], list(ops[1:]), pad
+
+
+def _counts_from_sorted(key_s: jnp.ndarray, nseg: int, pad: int):
+    """EXACT int32 per-key counts + run starts from a sorted key column.
+
+    `left[k]` is the first sorted position with key >= k (binary search, no
+    scatter), so counts[k] = left[k+1] - left[k] — integer arithmetic with no
+    f32 accumulator, hence no 2^24-increment guard on these regimes. The
+    `pad` rows _sort_by_key appended all carry key nseg-1 and are deducted."""
+    left = jnp.searchsorted(key_s, jnp.arange(nseg + 1, dtype=key_s.dtype))
+    counts = (left[1:] - left[:-1]).astype(jnp.int32)
+    if pad:
+        counts = counts - jnp.where(
+            jnp.arange(nseg) == nseg - 1, jnp.int32(pad), jnp.int32(0))
+    return left, counts
+
+
+def _grouped_sorted(key: jnp.ndarray, nseg: int, value_rows, block: int = 4096):
+    """Sort + segmented-scan group-by: the pathological-cardinality fallback.
+
+    One `jax.lax.sort` of (key, values), head flags at run boundaries, one
+    segmented inclusive `associative_scan` per value row, and a gather of each
+    run's last position (left[k+1]-1). Cost is the sort plus O(N log N) scan
+    work with NO per-key term, so it is the regime of last resort when the
+    residual cardinality makes even the rank-partitioned matmul's per-key
+    decode expensive. Returns [int32 counts[nseg], f32 sums[nseg]...].
+    """
+    key_s, vals_s, pad = _sort_by_key(key, nseg, value_rows, block)
+    n = key_s.size
+    left, counts = _counts_from_sorted(key_s, nseg, pad)
+    outs = [counts]
+    if not vals_s:
+        return outs
+    head = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    v = jnp.stack(vals_s)  # [R, n]
+    flags = jnp.broadcast_to(head[None, :], v.shape)
+    _, scan = jax.lax.associative_scan(_seg_sum_op, (flags, v), axis=1)
+    end = jnp.clip(left[1:] - 1, 0, n - 1)  # last row of each key's run
+    occ = counts > 0
+    for r in range(v.shape[0]):
+        outs.append(jnp.where(occ, scan[r][end], 0.0))
+    return outs
+
+
+def _grouped_partitioned(key: jnp.ndarray, nseg: int, value_rows,
+                         block: int = 4096):
+    """Two-level radix-partitioned sort group-by — the high-cardinality regime
+    replacing the flat `segment_sum` scatter.
+
+    The sort IS the radix split: after `jax.lax.sort`, each `block`-row slab is
+    one partition whose keys RANK-compress to a dense local id
+    j = rank - rank_start (ranks rise by at most 1 per row, so j < block no
+    matter how many of the 2^21 global keys land in the slab). That local id
+    is exactly the chunked one-hot shape, so each slab reuses the 64x64-tile
+    MXU formulation of `_grouped_chunk64` as ONE batched
+    [B, block, 64]^T @ [B, block, 64] dot per bf16 part — total MACs
+    N * block, i.e. a single chunk64-tile-equivalent per part REGARDLESS of
+    key count, where the chunked path pays per 4096 keys and the scatter pays
+    its K-independent ~248ms. Groups spanning slab boundaries always occupy
+    local id 0 of the continuation slabs, so a short segmented scan over the
+    [B] slab-head sums stitches them. The dense decode is scatter-free too:
+    `searchsorted` run boundaries give exact int32 counts and each key's first
+    sorted position, from which (slab, local id, continuation chain) are pure
+    gathers. Value sums use the 3-part bf16 split (full f32 precision) with
+    f32 accumulation. Returns [int32 counts[nseg], f32 sums[nseg]...].
+    """
+    key_s, vals_s, pad = _sort_by_key(key, nseg, value_rows, block)
+    n = key_s.size
+    nb = n // block
+    left, counts = _counts_from_sorted(key_s, nseg, pad)
+    outs = [counts]
+    if not vals_s:
+        return outs
+    head = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    rank = jnp.cumsum(head.astype(jnp.int32)) - 1           # nondecreasing
+    rank_start = rank.reshape(nb, block)[:, 0]              # [nb]
+    j = rank.reshape(nb, block) - rank_start[:, None]       # local id < block
+    bf = jnp.bfloat16
+    oh_hi = jax.nn.one_hot(j // 64, block // 64, dtype=bf)  # [nb, block, B/64]
+    oh_lo = jax.nn.one_hot(j % 64, 64, dtype=bf)            # [nb, block, 64]
+    dot = lambda a, b: jax.lax.dot_general(                 # noqa: E731
+        a, b, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    local = []
+    for v in vals_s:
+        v2 = v.reshape(nb, block)
+        p1 = v2.astype(bf)
+        rem = v2 - p1.astype(jnp.float32)
+        p2 = rem.astype(bf)
+        p3 = (rem - p2.astype(jnp.float32)).astype(bf)
+        s = None
+        for part in (p1, p2, p3):
+            d = dot(oh_hi, part[:, :, None] * oh_lo)        # [nb, B/64, 64]
+            s = d if s is None else s + d
+        local.append(s.reshape(nb, block))                  # sums per (slab, j)
+    # stitch slab-spanning groups: a group continuing into slab b sits at
+    # local id 0 there, so a segmented scan over local[:, 0] (heads where
+    # rank_start changes) accumulates each continuation chain
+    heads_b = jnp.concatenate([jnp.ones((1,), bool),
+                               rank_start[1:] != rank_start[:-1]])
+    slab0 = jnp.stack([l[:, 0] for l in local])             # [R, nb]
+    flags = jnp.broadcast_to(heads_b[None, :], slab0.shape)
+    _, chain = jax.lax.associative_scan(_seg_sum_op, (flags, slab0), axis=1)
+    # dense decode: each key's first sorted row -> (slab g0, local id j0); the
+    # last slab of its chain is the last rank_start <= its rank
+    p = jnp.minimum(left[:-1], n - 1)
+    r = rank[p]
+    g0 = p // block
+    j0 = r - rank_start[g0]
+    g1 = jnp.searchsorted(rank_start, r, side="right") - 1
+    occ = counts > 0
+    for li, ci in zip(local, chain):
+        start = li[g0, j0]
+        tail = ci[g1]
+        # j0 == 0: the chain includes slab g0 itself; otherwise the chain
+        # (if any: g1 > g0) covers only the continuation slabs after g0
+        total = jnp.where(j0 == 0, tail,
+                          start + jnp.where(g1 > g0, tail, 0.0))
+        outs.append(jnp.where(occ, total, 0.0))
+    return outs
+
+
 def combine_collective(name: str, v, axis: str):
     """The cross-device combine for one kernel output: partials agree on dense keys
     (aligned dictionaries), so one ICI collective merges them."""
@@ -359,6 +513,7 @@ def _make_body(spec: KernelSpec):
     group = bool(spec.group_cols)
     num_seg = spec.num_keys_pad + 1  # +1 overflow bucket for masked-out rows
     mask_fn = _make_mask_fn(spec)
+    caps = get_caps()  # regime crossovers (calibrated; part of signature())
 
     def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts, docsets):
         mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets)
@@ -393,15 +548,22 @@ def _make_body(spec: KernelSpec):
                     col_ids = ids[agg.arg.name].ravel()
                     comb = key * size + col_ids
                     width = num_seg * size
-                    if width <= CHUNK_KEY_CAP and key.size <= (1 << 24):
+                    if width <= caps.chunk_cap and key.size <= (1 << 24):
                         fm = mask.ravel().astype(jnp.float32)
                         pres = _grouped_chunk64(comb, width, [fm], [])[0]
                         out[f"{ai}.distinct"] = jnp.round(pres).astype(
                             jnp.int32).reshape(num_seg, size)
-                    else:
+                    elif caps.high_card_regime == "scatter":
                         out[f"{ai}.distinct"] = jax.ops.segment_sum(
                             mask.ravel().astype(jnp.int32), comb,
                             num_segments=width).reshape(num_seg, size)
+                    else:
+                        # presence counts over the combined (group, id) space
+                        # past the chunk cap: sorted-run boundary counts are
+                        # exact int32 with no matmul and no scatter
+                        pres = _grouped_sorted(comb, width, [],
+                                               caps.partition_block)[0]
+                        out[f"{ai}.distinct"] = pres.reshape(num_seg, size)
                     continue
                 v = _agg_arg(agg, vals)
                 for o in outs:
@@ -419,7 +581,7 @@ def _make_body(spec: KernelSpec):
             # integer range (keys.size is the bound). The <= matters: a 16M-row
             # padded block sits exactly at 2^24 and must keep the matmul path.
             count_exact_in_f32 = key.size <= (1 << 24)
-            if num_seg <= MATMUL_KEY_CAP and count_exact_in_f32:
+            if num_seg <= caps.matmul_cap and count_exact_in_f32:
                 # one-hot is NOT materialized: XLA:TPU fuses its iota-compare into the
                 # matmul tiles (measured: N=8M, K=4096 runs in ~100ms on a 16GB chip —
                 # a dense [N, K] f32 operand would be 137GB). HIGHEST precision keeps
@@ -430,7 +592,7 @@ def _make_body(spec: KernelSpec):
                 for r, name in enumerate(sum_names):
                     p = partials[r]
                     out[name] = (jnp.round(p).astype(jnp.int32) if name == "count" else p)
-            elif num_seg <= CHUNK_KEY_CAP and count_exact_in_f32:
+            elif num_seg <= caps.chunk_cap and count_exact_in_f32:
                 # HIGH-CARDINALITY group-by: chunked 64x64-tile matmuls (the
                 # redesigned >cap path — 6.4x the segment_sum scatter at 20k
                 # keys; see _grouped_chunk64's measurement + limit analysis)
@@ -438,14 +600,26 @@ def _make_body(spec: KernelSpec):
                 out["count"] = jnp.round(res[0]).astype(jnp.int32)
                 for arr, name in zip(res[1:], sum_names[1:]):
                     out[name] = arr
-            else:
+            elif caps.high_card_regime == "scatter":
+                # explicit escape hatch (calibration baseline / pathological
+                # platforms): the K-independent flat scatter
                 counts = jax.ops.segment_sum(mask.ravel().astype(jnp.int32), key,
                                              num_segments=num_seg)
                 out["count"] = counts
                 for row, name in zip(sum_rows[1:], sum_names[1:]):
                     out[name] = jax.ops.segment_sum(row, key, num_segments=num_seg)
+            else:
+                # VERY-HIGH-CARDINALITY group-by (> chunk_cap, or row counts
+                # past the f32 2^24 guard at any cardinality): sort-based
+                # regimes with exact int32 counts and no scatter
+                grouped = (_grouped_sorted if caps.high_card_regime == "sorted"
+                           else _grouped_partitioned)
+                res = grouped(key, num_seg, sum_rows[1:], caps.partition_block)
+                out["count"] = res[0]
+                for arr, name in zip(res[1:], sum_names[1:]):
+                    out[name] = arr
             for name, v, is_min in minmax:
-                if num_seg <= MINMAX_BCAST_CAP:
+                if num_seg <= caps.minmax_bcast_cap:
                     ident = (_INT_MIN_IDENT if is_min else _INT_MAX_IDENT) \
                         if v.dtype.kind == "i" else (jnp.inf if is_min else -jnp.inf)
                     onehot = key[:, None] == jnp.arange(num_seg)[None, :]

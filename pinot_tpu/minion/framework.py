@@ -45,10 +45,24 @@ class ProcessorConfig:
 
 
 def read_columns(segment, schema: Schema) -> Dict[str, np.ndarray]:
-    """Decode one segment into a column dict (object arrays for strings)."""
+    """Decode one segment into a column dict (object arrays for strings).
+
+    Null positions come back as None cells: `.values()` materializes the
+    default-value fill, so without consulting the null bitmap every rewrite
+    task (merge/rollup, raw-index convert, ...) would silently drop nullness
+    and IS NULL queries against the rewritten segment would go empty."""
     out = {}
     for f in schema.fields:
-        out[f.name] = np.asarray(segment.column(f.name).values())
+        reader = segment.column(f.name)
+        vals = np.asarray(reader.values())
+        bitmap = reader.null_bitmap
+        if bitmap is not None and not reader.is_multi_value and bitmap.any():
+            if vals.dtype != object:
+                vals = vals.astype(object)
+            else:
+                vals = vals.copy()
+            vals[np.asarray(bitmap, dtype=bool)] = None
+        out[f.name] = vals
     return out
 
 
@@ -93,6 +107,11 @@ def _rollup(cols: Dict[str, np.ndarray], schema: Schema,
     for c in metric_cols:
         agg = aggregations.get(c, "sum")
         v = cols[c]
+        if v.dtype == object:
+            # nulls restored by read_columns: a null metric contributes the
+            # aggregation identity instead of poisoning the whole group
+            ident = {"sum": 0, "min": np.inf, "max": -np.inf}.get(agg, 0)
+            v = np.asarray([ident if x is None else x for x in v])
         if agg == "sum":
             acc = np.zeros(len(uniq), dtype=np.float64 if v.dtype.kind == "f" else np.int64)
             np.add.at(acc, inverse, v)

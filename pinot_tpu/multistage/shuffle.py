@@ -77,6 +77,9 @@ class P2PUnavailable(Exception):
 
 def partition_groups_stable(result: SegmentResult, p: int) -> List[SegmentResult]:
     """Split a group-by partial's key space into p disjoint partials."""
+    # a hash partition reorders keys arbitrarily, so the array-form partial
+    # (aligned dense key space) can't survive it — densify to the dict form
+    result.materialize_dense()
     outs = [SegmentResult("groups") for _ in range(p)]
     for key, states in result.groups.items():
         outs[stable_hash_key(key) % p].groups[key] = states
@@ -391,6 +394,11 @@ def trim_group_result(ctx, merged: SegmentResult, aggs) -> SegmentResult:
         return merged
     limit = ctx.limit if ctx.limit is not None else UNBOUNDED_LIMIT
     k = min(limit + (ctx.offset or 0), UNBOUNDED_LIMIT)
+    if merged.dense is not None:
+        occupied = int((merged.dense.counts > 0).sum())
+        if ctx.having is None and (k >= UNBOUNDED_LIMIT or occupied <= k):
+            return merged  # nothing to trim; keep the array form
+        merged.materialize_dense(aggs)
     needs_having = ctx.having is not None
     needs_trim = k < UNBOUNDED_LIMIT and len(merged.groups) > k
     if not needs_having and not needs_trim:

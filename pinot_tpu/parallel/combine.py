@@ -640,6 +640,14 @@ class MeshQueryExecutor:
                     dense = try_dense_decode(ctx, plan, outs)
                     if dense is not None:
                         return dense
+                if partial:
+                    # high-cardinality server partial: keep the kernel's dense
+                    # arrays as-is (reduce.DensePartial) instead of densifying
+                    # 100k+ Python state dicts that the broker would re-hash
+                    dense_partial = self._fallback._decode_dense_partial(
+                        plan, outs)
+                    if dense_partial is not None:
+                        return dense_partial
                 # an order-by trim is exact for a FULL result; a server
                 # partial stays untrimmed — the broker merges every server's
                 # groups before trimming
@@ -687,7 +695,11 @@ class MeshQueryExecutor:
                        inputs["strides"], inputs["agg_luts"], inputs["docsets"])
             return {k: combine_collective(k, v, ax) for k, v in out.items()}
 
-        return jax.jit(jax.shard_map(shard_body, mesh=self.mesh,
-                                     in_specs=in_specs, out_specs=repl))
+        if hasattr(jax, "shard_map"):
+            shard_map = jax.shard_map
+        else:  # jax < 0.5: shard_map not yet promoted out of experimental
+            from jax.experimental.shard_map import shard_map
+        return jax.jit(shard_map(shard_body, mesh=self.mesh,
+                                 in_specs=in_specs, out_specs=repl))
 
 

@@ -63,13 +63,13 @@ def try_dense_decode(ctx: QueryContext, plan, outs) -> Optional[ResultTable]:
             sel.append(("agg", agg_reprs[r]))
         else:
             return None
-    order: list = []  # (("group", j) | ("agg", i), desc)
+    order: list = []  # (("group", j) | ("agg", i), OrderByItem)
     for o in ctx.order_by or []:
         r = repr(o.expr)
         if r in group_reprs:
-            order.append((("group", group_reprs[r]), o.desc))
+            order.append((("group", group_reprs[r]), o))
         elif r in agg_reprs:
-            order.append((("agg", agg_reprs[r]), o.desc))
+            order.append((("agg", agg_reprs[r]), o))
         else:
             return None
 
@@ -101,11 +101,21 @@ def try_dense_decode(ctx: QueryContext, plan, outs) -> Optional[ResultTable]:
     # -- ORDER BY over all occupied groups, then offset/limit ---------------
     if order:
         keys = []
-        for (kind, idx), desc in reversed(order):  # lexsort: last key primary
+        for (kind, idx), o in reversed(order):  # lexsort: last key primary
             arr = ids_for(idx) if kind == "group" else agg_for(idx)
             arr = np.asarray(arr, dtype=np.float64 if arr.dtype.kind == "f"
                              else np.int64)
-            keys.append(-arr if desc else arr)
+            # NaN-as-null ranking, mirrored off reduce._sort_key: null sorts
+            # as LARGEST unless NULLS FIRST/LAST overrides. Group dict ids are
+            # never null on the device path; agg NaN means dense-null.
+            is_null = (arr != arr) if arr.dtype.kind == "f" else None
+            if is_null is not None and is_null.any():
+                arr = np.where(is_null, 0.0, arr)
+            keys.append(-arr if o.desc else arr)
+            if is_null is not None and is_null.any():
+                nulls_last = (o.nulls_last if o.nulls_last is not None
+                              else not o.desc)
+                keys.append(is_null if nulls_last else ~is_null)
         take = np.lexsort(keys)
     else:
         take = np.arange(len(occupied))

@@ -19,8 +19,12 @@ from .aggregates import AggFunc, make_agg
 from .context import QueryContext, compile_query
 from .planner import SegmentPlan, build_device_geometry, plan_segment
 from .predicate import CmpLeaf, DocSetLeaf, LutLeaf, NullLeaf
-from .reduce import SegmentResult, merge_segment_results, reduce_to_result
+from .reduce import DensePartial, SegmentResult, merge_segment_results, reduce_to_result
 from .result import ResultTable
+
+#: below this dense-key-space size the classic dict partial is cheap enough
+#: that the array form only adds wire weight (it ships full dictionaries)
+DENSE_PARTIAL_MIN_GROUPS = 4096
 
 
 class ServerQueryExecutor:
@@ -249,6 +253,44 @@ class ServerQueryExecutor:
                 states.append(agg.state_from_device(o))
             result.groups[tuple(keys[row])] = states
         return result
+
+    def _decode_dense_partial(self, plan: SegmentPlan, outs) -> Optional[SegmentResult]:
+        """Array-form partial decode (see `reduce.DensePartial`): skip the
+        per-group Python state loop entirely at high cardinality. Returns None
+        when the plan can't prove cross-server key alignment (missing dict
+        hashes) or the dense form wouldn't pay for itself."""
+        from .dense_reduce import _dense_capable
+        if plan.num_keys_real < DENSE_PARTIAL_MIN_GROUPS:
+            return None
+        if not all(_dense_capable(a) for a in plan.aggs):
+            return None
+        if any("distinct" in a.device_outputs for a in plan.aggs):
+            return None
+        seg = plan.segment
+        dict_hashes = []
+        for col in plan.group_cols:
+            h = seg.column(col).meta.get("dictHash")
+            if h is None:
+                return None  # can't prove dictionaries align across servers
+            dict_hashes.append(h)
+        counts = np.asarray(outs["count"][:plan.num_keys_real]).astype(np.int64)
+        dp_outs = {}
+        for i, agg in enumerate(plan.aggs):
+            for out_name in agg.device_outputs:
+                if out_name != "count":
+                    dp_outs[f"{i}.{out_name}"] = np.asarray(
+                        outs[f"{i}.{out_name}"][:plan.num_keys_real])
+        group_values = [
+            seg.column(col).dictionary.take(
+                np.arange(plan.cards[j], dtype=np.int64))
+            for j, col in enumerate(plan.group_cols)]
+        token = (tuple(plan.group_cols), tuple(plan.cards),
+                 tuple(dict_hashes), plan.num_keys_real)
+        dp = DensePartial(token, tuple(plan.cards), tuple(plan.strides),
+                          plan.num_keys_real, counts, dp_outs, group_values,
+                          aggs=plan.aggs)
+        return SegmentResult("groups", dense=dp,
+                             num_docs_scanned=int(counts.sum()))
 
     def _decode_scalar_partials(self, plan: SegmentPlan, outs) -> SegmentResult:
         seg = plan.segment

@@ -26,7 +26,10 @@ from .aggregates import AggContext, AggFunc, make_agg
 from .context import QueryContext, QueryValidationError
 from .predicate import CmpLeaf, FilterProgram, LutLeaf, NullLeaf, compile_filter
 
-MAX_DEVICE_GROUP_KEYS = 1 << 20  # dense-key cap (reference caps group-by at 100k groups)
+# dense-key cap (reference caps group-by at 100k groups). Raised to 2M now
+# that the sort-based kernel regimes (engine/kernels.py) keep per-key cost
+# sublinear past the chunked-matmul cap instead of falling off a scatter cliff.
+MAX_DEVICE_GROUP_KEYS = 1 << 21
 # grouped distinct presence matrix cap: (padded keys) x (dict-id lut) int32 cells
 MAX_GROUPED_DISTINCT_CELLS = 1 << 22  # 16MB of presence counts per aggregation
 
@@ -71,6 +74,10 @@ class SegmentPlan:
     strides: Tuple[int, ...] = ()
     num_keys_real: int = 0
     num_keys_pad: int = 0
+    # upper bound on OCCUPIED groups (dictionary key-space product capped by
+    # scanned docs): drives merge/decode strategy — array-form dense partials
+    # vs per-group state dicts — without waiting for exact device counts
+    card_hint: int = 0
     fallback_reason: str = ""
     # upsert: only rows set in this mask are visible (None = all rows)
     valid_docs: Optional[np.ndarray] = None
@@ -138,6 +145,12 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
         plan.fallback_reason = reason
         return plan
     plan.kind = "device"
+    # a group needs at least one row, so occupied groups <= min(key space, docs
+    # actually scanned — the SET total on the mesh path, not the probe segment)
+    if plan.card_hint:
+        plan.card_hint = min(plan.card_hint,
+                             scan_docs if scan_docs is not None
+                             else segment.num_docs)
     return plan
 
 
@@ -296,6 +309,7 @@ def _device_feasible(plan: SegmentPlan, segment: ImmutableSegment) -> str:
     if num_keys > MAX_DEVICE_GROUP_KEYS:
         return f"group key space {num_keys} exceeds device cap"
     plan.group_cols = tuple(cols)
+    plan.card_hint = num_keys if cols else 0  # clamped by scan docs in plan_segment
 
     group_by = bool(cols)
     for agg in plan.aggs:

@@ -23,6 +23,42 @@ from .result import ResultTable
 
 
 @dataclass
+class DensePartial:
+    """A group-by partial in ARRAY form over an aligned dense key space.
+
+    At high cardinality the dict-of-states partial is the bottleneck: building
+    (and merging, and wire-encoding) 500k Python state lists costs seconds
+    while the kernel runs in tens of milliseconds. When every aggregation is
+    dense-finalizable and the servers share aligned dictionaries (`token`
+    matches), partials stay as the kernel's dense output arrays end to end:
+    merge is elementwise (+/min/max), the wire carries flat ndarrays, and the
+    broker finalizes vectorized (reference contrast: GroupByDataTableReducer's
+    IndexedTable hash merge).
+    """
+
+    token: Tuple                  # (group cols, cards, dict hashes, num_keys)
+    cards: Tuple[int, ...]
+    strides: Tuple[int, ...]
+    num_keys_real: int
+    counts: np.ndarray            # int64[num_keys_real] (exact, mergeable by +)
+    outs: Dict[str, np.ndarray]   # "<agg idx>.<out>" arrays, trimmed to real keys
+    group_values: List[Any]       # per group col: the full dictionary value table
+    # build-side only (never on the wire): lets server-local consumers
+    # materialize classic state dicts without replanning
+    aggs: Optional[List[AggFunc]] = None
+
+    def merge_from(self, other: "DensePartial") -> None:
+        self.counts = self.counts + other.counts
+        for k, v in other.outs.items():
+            if k.endswith(".min"):
+                self.outs[k] = np.minimum(self.outs[k], v)
+            elif k.endswith(".max"):
+                self.outs[k] = np.maximum(self.outs[k], v)
+            else:
+                self.outs[k] = self.outs[k] + v
+
+
+@dataclass
 class SegmentResult:
     """Partial result of one segment (reference: IntermediateResultsBlock)."""
 
@@ -36,6 +72,36 @@ class SegmentResult:
     # results): lets the broker detect a replica that silently skipped a
     # segment mid-transition and retry it on another replica
     served: Optional[List[str]] = None
+    # high-cardinality array-form partial; when set, `groups` is EMPTY until
+    # `materialize_dense` converts (consumers that need the dict form call it)
+    dense: Optional[DensePartial] = None
+
+    def materialize_dense(self, aggs: Optional[List[AggFunc]] = None) -> None:
+        """Convert the array-form partial into the classic state dict (for
+        dict-merge with non-dense partials, hash-partition shuffles, ...)."""
+        dp = self.dense
+        if dp is None:
+            return
+        use_aggs = aggs if aggs is not None else dp.aggs
+        if use_aggs is None:
+            raise ValueError("dense partial needs aggs to materialize")
+        occupied = np.nonzero(dp.counts > 0)[0]
+        value_cols = [
+            np.asarray(dp.group_values[j])[
+                (occupied // dp.strides[j]) % max(dp.cards[j], 1)]
+            for j in range(len(dp.strides))]
+        keys = (list(zip(*[c.tolist() for c in value_cols]))
+                if len(occupied) else [])
+        for row, k in enumerate(occupied):
+            states = []
+            for i, agg in enumerate(use_aggs):
+                o = {"count": int(dp.counts[k])}
+                for out_name in agg.device_outputs:
+                    if out_name != "count":
+                        o[out_name] = dp.outs[f"{i}.{out_name}"][k]
+                states.append(agg.state_from_device(o))
+            self.groups[keys[row]] = states
+        self.dense = None
 
 
 def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> SegmentResult:
@@ -46,6 +112,25 @@ def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> 
     out = SegmentResult(kind)
     out.num_docs_scanned = sum(r.num_docs_scanned for r in results)
     if kind == "groups":
+        denses = [r.dense for r in results]
+        if all(d is not None for d in denses) and \
+                len({d.token for d in denses}) == 1:
+            # partition-wise partial merge: servers with aligned dictionaries
+            # agree on dense keys, so high-card partials combine elementwise
+            # WITHOUT densifying 100k+ Python state dicts per server
+            base = denses[0]
+            acc = DensePartial(base.token, base.cards, base.strides,
+                               base.num_keys_real,
+                               base.counts.astype(np.int64, copy=True),
+                               {k: v.copy() for k, v in base.outs.items()},
+                               base.group_values, aggs=base.aggs)
+            for d in denses[1:]:
+                acc.merge_from(d)
+            out.dense = acc
+            return out
+        for r in results:
+            # mixed dense/dict (or unaligned dictionaries): densify once here
+            r.materialize_dense(aggs)
         merged: Dict[Tuple, List[Any]] = {}
         for r in results:
             for key, states in r.groups.items():
@@ -92,7 +177,34 @@ def reduce_to_result(ctx: QueryContext, merged: SegmentResult, aggs: List[AggFun
 
     # -- build the result-expression environment ---------------------------
     env: Dict[str, np.ndarray] = {}
-    if merged.kind == "groups":
+    if merged.kind == "groups" and merged.dense is not None:
+        # array-form partial: finalize VECTORIZED over occupied dense keys
+        # (dense_values per agg + dictionary takes per group column) instead
+        # of the per-group Python state loop below
+        dp = merged.dense
+        occupied = np.nonzero(dp.counts > 0)[0]
+        n = len(occupied)
+        counts_occ = dp.counts[occupied]
+        for j, g in enumerate(group_exprs):
+            ids_j = (occupied // dp.strides[j]) % max(dp.cards[j], 1)
+            env[repr(g)] = _object_array(
+                np.asarray(dp.group_values[j])[ids_j].tolist())
+        for i, call in enumerate(ctx.aggregations):
+            agg = aggs[i]
+
+            def get(name, i=i):
+                if name == "count":
+                    return counts_occ
+                return dp.outs[f"{i}.{name}"][occupied]
+
+            vals = np.asarray(agg.dense_values(get, counts_occ))
+            cells = _object_array(vals.tolist())
+            if agg.dense_nan_is_null and vals.dtype.kind == "f":
+                # scalar finalize returns None where the dense form emits NaN
+                for bad in np.nonzero(vals != vals)[0]:
+                    cells[bad] = None
+            env[repr(call)] = cells
+    elif merged.kind == "groups":
         keys = list(merged.groups.keys())
         n = len(keys)
         for j, g in enumerate(group_exprs):

@@ -24,6 +24,9 @@ def _needs_cpu_reexec() -> bool:
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 `-m 'not slow'` run")
     if _needs_cpu_reexec():
         env = dict(os.environ)
         env.update({

@@ -16,7 +16,8 @@ from pinot_tpu.parallel import MeshQueryExecutor, default_mesh
 from pinot_tpu.query.executor import ServerQueryExecutor
 from pinot_tpu.schema import DataType, Schema, dimension, metric
 from pinot_tpu.segment import load_segment
-from pinot_tpu.segment.writer import build_aligned_segments
+from pinot_tpu.segment.writer import (SegmentGeneratorConfig,
+                                      build_aligned_segments)
 
 N_KEYS = 2500  # > MATMUL_KEY_CAP -> the chunked kernel branch
 ROWS = 60_000
@@ -208,3 +209,254 @@ def test_groupby_fuzz_across_cap_regimes(tmp_path_factory, mesh_exec, card):
         dev = mesh_exec.execute(segs, sql)
         want = host.execute(segs, sql)
         _assert_rows_match(dev.rows, want.rows, sql)
+
+
+# ---------------------------------------------------------------------------
+# very-high-cardinality regimes: radix-partitioned + sort kernels (PR: the
+# segment_sum scatter fallback replacement) — differential vs the host engine
+# ---------------------------------------------------------------------------
+
+from pinot_tpu.engine.calibrate import KernelCaps, get_caps, set_caps  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def vhc_segments(tmp_path_factory):
+    """6000-key set: padded key space 8192 crosses a FORCED chunk_cap of 4096,
+    so the sort-based regimes exercise cheaply in tier-1."""
+    rng = np.random.default_rng(7)
+    rows = 40_000
+    schema = Schema("vhc", [
+        dimension("k", DataType.INT),
+        metric("v", DataType.DOUBLE),
+        metric("q", DataType.INT),
+    ])
+    cols = {
+        "k": rng.integers(0, 6000, rows).astype(np.int32),
+        "v": np.round(rng.uniform(-500, 500, rows), 3),
+        # group sums cross int32 (the overflow differential)
+        "q": rng.integers(0, 1 << 30, rows).astype(np.int32),
+    }
+    out = tmp_path_factory.mktemp("vhc")
+    paths = build_aligned_segments(schema, cols, str(out), "vhc", 4)
+    return [load_segment(p) for p in paths]
+
+
+def _assert_rows_close(dev_rows, host_rows, ctxmsg, rtol=1e-3):
+    """Row-for-row match; numerics compare with relative tolerance (device
+    sums accumulate in f32 via bf16 splits — int sums come back as floats)."""
+    assert len(dev_rows) == len(host_rows), ctxmsg
+    for dr, hr in zip(dev_rows, host_rows):
+        assert len(dr) == len(hr), (ctxmsg, dr, hr)
+        for dv, hv in zip(dr, hr):
+            if isinstance(dv, bool) or isinstance(hv, bool) \
+                    or not isinstance(dv, (int, float)) \
+                    or not isinstance(hv, (int, float)):
+                assert dv == hv, (ctxmsg, dr, hr)
+            else:
+                assert abs(dv - hv) <= rtol * max(1.0, abs(hv)), \
+                    (ctxmsg, dr, hr)
+
+
+VHC_QUERIES = [
+    "SELECT k, COUNT(*), SUM(v) FROM vhc GROUP BY k ORDER BY k LIMIT 3000000",
+    "SELECT k, SUM(q) FROM vhc GROUP BY k ORDER BY k LIMIT 3000000",
+    "SELECT k, AVG(v), MIN(q), MAX(q) FROM vhc WHERE q < 900000000 GROUP BY k "
+    "ORDER BY k LIMIT 3000000",
+    "SELECT k, SUM(v) FROM vhc GROUP BY k ORDER BY SUM(v) DESC, k LIMIT 17",
+]
+
+
+@pytest.mark.parametrize("regime", ["partitioned", "sorted"])
+def test_forced_high_card_regime_matches_host(vhc_segments, mesh_exec, regime):
+    """Force chunk_cap below the padded key space so BOTH new sort-based
+    kernels run through the full mesh stack, differentially vs the host."""
+    host = ServerQueryExecutor(use_device=False)
+    prev = get_caps()
+    set_caps(KernelCaps(chunk_cap=4096, high_card_regime=regime))
+    try:
+        for sql in VHC_QUERIES:
+            dev = mesh_exec.execute(vhc_segments, sql)
+            want = host.execute(vhc_segments, sql)
+            _assert_rows_close(dev.rows, want.rows, (regime, sql))
+    finally:
+        set_caps(prev)
+
+
+def test_scatter_escape_hatch_matches_host(vhc_segments, mesh_exec):
+    """high_card_regime='scatter' keeps the legacy segment_sum path alive."""
+    host = ServerQueryExecutor(use_device=False)
+    prev = get_caps()
+    set_caps(KernelCaps(chunk_cap=4096, high_card_regime="scatter"))
+    try:
+        sql = VHC_QUERIES[0]
+        dev = mesh_exec.execute(vhc_segments, sql)
+        want = host.execute(vhc_segments, sql)
+        _assert_rows_close(dev.rows, want.rows, ("scatter", sql))
+    finally:
+        set_caps(prev)
+
+
+def _guaranteed_card_keys(rng, card, rows):
+    """Exactly min(card, rows) distinct keys: one pass of every key, the rest
+    random repeats. Pure random draws top out far below the nominal card
+    (20k draws from 140k keys hit ~19k uniques) and would silently test the
+    WRONG dispatch regime."""
+    base = min(card, rows)
+    k = np.concatenate([np.arange(base, dtype=np.int64),
+                        rng.integers(0, base, rows - base)])
+    rng.shuffle(k)
+    return k.astype(np.int32)
+
+
+def _very_high_card_case(tmp_path_factory, card, rows, with_nulls):
+    rng = np.random.default_rng(card % 9973)
+    schema = Schema("vh", [
+        dimension("k", DataType.INT),
+        metric("v", DataType.DOUBLE),
+        metric("q", DataType.INT),
+    ])
+    v = np.round(rng.uniform(-500, 500, rows), 3)
+    cols = {
+        "k": _guaranteed_card_keys(rng, card, rows),
+        "v": v,
+        "q": rng.integers(0, 1 << 30, rows).astype(np.int32),
+    }
+    if with_nulls:
+        vo = v.astype(object)
+        vo[rng.random(rows) < 0.02] = None  # null cells -> NaN-aware aggs
+        cols["v"] = vo
+    out = tmp_path_factory.mktemp(f"vh{card}")
+    # keep k dictionary-encoded even at cardinality ~= rows: the device
+    # group-by only rides dict columns, and raw-encoding would demote every
+    # query here to the host path (vacuously green differential). Metrics
+    # stay raw — the fixed-dict encoder can't represent None cells.
+    cfg = SegmentGeneratorConfig(raw_cardinality_fraction=4.0,
+                                 no_dictionary_columns=["v", "q"])
+    paths = build_aligned_segments(schema, cols, str(out), f"vh{card}", 4,
+                                   config=cfg)
+    segs = [load_segment(p) for p in paths]
+    assert segs[0].column("k").dictionary is not None
+    return segs
+
+
+def _run_very_high_card(tmp_path_factory, mesh_exec, card, rows,
+                        with_nulls=False):
+    segs = _very_high_card_case(tmp_path_factory, card, rows, with_nulls)
+    host = ServerQueryExecutor(use_device=False)
+    shapes = [
+        f"SELECT k, COUNT(*), SUM(v) FROM vh GROUP BY k "
+        f"ORDER BY k LIMIT 3000000",
+        f"SELECT k, SUM(q) FROM vh GROUP BY k ORDER BY k LIMIT 3000000",
+        f"SELECT k, SUM(v) FROM vh GROUP BY k ORDER BY SUM(v) DESC, k "
+        f"LIMIT 23",
+    ]
+    for sql in shapes:
+        dev = mesh_exec.execute(segs, sql)
+        want = host.execute(segs, sql)
+        _assert_rows_close(dev.rows, want.rows, (card, sql))
+
+
+def test_partitioned_regime_128k_groups(tmp_path_factory, mesh_exec):
+    """Tier-1 anchor of the sweep: 140k REAL groups is past the default
+    chunk_cap (131072), so the radix-partitioned kernel is the regime
+    actually dispatched."""
+    assert get_caps().high_card_regime == "partitioned"
+    _run_very_high_card(tmp_path_factory, mesh_exec, 140_000, 160_000)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("card,rows", [(500_000, 650_000),
+                                       (2_000_000, 2_050_000)])
+def test_very_high_card_fuzz_sweep(tmp_path_factory, mesh_exec, card, rows):
+    _run_very_high_card(tmp_path_factory, mesh_exec, card, rows)
+
+
+@pytest.mark.slow
+def test_very_high_card_with_nulls(tmp_path_factory, mesh_exec):
+    _run_very_high_card(tmp_path_factory, mesh_exec, 140_000, 160_000,
+                        with_nulls=True)
+
+
+def test_dense_partial_roundtrip(vhc_segments, mesh_exec):
+    """Server partial at >=4096 groups ships the ARRAY form (DensePartial):
+    wire roundtrip + elementwise merge + vectorized broker reduce must equal
+    the classic end-to-end result."""
+    import jax
+
+    from pinot_tpu.cluster.wire import (decode_segment_result,
+                                        encode_segment_result)
+    from pinot_tpu.query.aggregates import make_agg
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.reduce import (merge_segment_results,
+                                        reduce_to_result)
+
+    sql = ("SELECT k, COUNT(*), SUM(v) FROM vhc GROUP BY k "
+           "ORDER BY k LIMIT 3000000")
+    schema = vhc_segments[0].schema
+    ctx = compile_query(sql, schema)
+    halves = [vhc_segments[:2], vhc_segments[2:]]
+    partials = []
+    for half in halves:
+        dp = mesh_exec.dispatch_partial(ctx, half)
+        assert dp is not None, "device partial path refused the plan"
+        outs_dev, decode = dp
+        part = decode(jax.device_get(outs_dev))
+        assert part.dense is not None, "expected the array-form partial"
+        assert len(part.groups) == 0
+        partials.append(part)
+    # one partial crosses the wire (server -> broker), one stays local
+    partials[0] = decode_segment_result(encode_segment_result(partials[0]))
+    assert partials[0].dense is not None
+    assert partials[0].dense.token == partials[1].dense.token
+    aggs = [make_agg(f) for f in ctx.aggregations]
+    merged = merge_segment_results(partials, aggs)
+    assert merged.dense is not None, "aligned dense partials must merge dense"
+    got = reduce_to_result(ctx, merged, aggs, list(ctx.group_by))
+    want = ServerQueryExecutor(use_device=False).execute(vhc_segments, sql)
+    _assert_rows_close(got.rows, want.rows, sql)
+
+
+@pytest.mark.slow
+def test_no_flat_scatter_at_high_card(tmp_path_factory):
+    """Regression guard: the >=128k-group count+sum kernel must never lower
+    through a flat scatter again (the 26.9M rows/s cliff this PR removes)."""
+    import jax
+
+    from pinot_tpu.engine import kernels
+    from pinot_tpu.engine.datablock import block_for
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import build_device_geometry, plan_segment
+
+    rng = np.random.default_rng(3)
+    rows = 150_000
+    schema = Schema("sc", [
+        dimension("k", DataType.INT),
+        metric("v", DataType.DOUBLE),
+    ])
+    cols = {
+        "k": _guaranteed_card_keys(rng, 140_000, rows),
+        "v": rng.uniform(0, 10, rows),
+    }
+    out = tmp_path_factory.mktemp("sc")
+    cfg = SegmentGeneratorConfig(raw_cardinality_fraction=4.0)
+    paths = build_aligned_segments(schema, cols, str(out), "sc", 1, config=cfg)
+    seg = load_segment(paths[0])
+    ctx = compile_query("SELECT k, COUNT(*), SUM(v) FROM sc GROUP BY k "
+                        "LIMIT 3000000", schema)
+    plan = plan_segment(ctx, seg)
+    assert plan.kind == "device"
+    build_device_geometry(plan)
+    assert plan.num_keys_pad > get_caps().chunk_cap
+    block = block_for(seg)
+    spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols,
+                              plan.num_keys_pad,
+                              tuple((a, a.device_outputs) for a in plan.aggs),
+                              {}, block.padded)
+    inputs = ServerQueryExecutor()._kernel_inputs(plan, spec, block)
+    body = kernels.make_kernel_body(spec)
+    jaxpr = jax.make_jaxpr(body)(
+        inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
+        inputs.nulls, inputs.valid, inputs.strides, inputs.agg_luts,
+        inputs.docsets)
+    assert "scatter" not in str(jaxpr), \
+        ">=128k-group count+sum kernel dispatched through flat scatter"
