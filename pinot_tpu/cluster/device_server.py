@@ -13,26 +13,38 @@ the data-readiness analog of the reference's mmap-resident buffers.
 
 THE PIPELINE IS THE SCHEDULER. One dispatcher thread owns the device; HTTP
 handler threads submit (ctx, segments) items and block on futures. Each drain
-of the queue dispatches EVERY pending query's kernel asynchronously, then
-fetches all of them with ONE `jax.device_get` — so under concurrency the
-relay's ~65ms host round trip amortizes across the whole batch (the
-productized form of `bench.py`'s pipeline_depth; reference:
-`QueryScheduler.java:56` bounding per-server concurrency, here batching is
-what concurrency buys instead of thread-pool fan-out, because the device
-serializes dispatches anyway).
+of the queue PREPARES every pending query (plan + build inputs, no launch),
+then groups the prepared work before touching the device:
 
-Queries whose plan cannot ride the device (selection, host-only functions,
-doc-set divergence, upsert masks) resolve to the DEVICE_FALLBACK sentinel and
-the caller runs the per-segment host path.
+  * items with equal `dedupe_key` are byte-identical dispatches — they share
+    ONE kernel launch and ONE fetched result;
+  * items with equal `stack_key` (same `KernelSpec.signature()` executable
+    over the same segment block, differing only in runtime scalars) stack
+    into ONE batched kernel launch instead of N sequential dispatches;
+  * everything dispatched in a drain is fetched with ONE host sync, so under
+    concurrency the relay's ~110ms round trip amortizes across the batch
+    (the productized form of `bench.py`'s pipeline_depth; reference:
+    `QueryScheduler.java:56` bounds per-server concurrency — here batching
+    is what concurrency buys, because the device serializes dispatches
+    anyway).
+
+Queries whose plan cannot ride the device (host-only functions, doc-set
+divergence, upsert masks, selections without a device-eligible ORDER BY)
+resolve to the DEVICE_FALLBACK sentinel and the caller runs the per-segment
+host path.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
+from collections import deque
 from concurrent.futures import CancelledError, Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeoutError
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.metrics import get_registry
 
 
 class _Sentinel:
@@ -42,6 +54,10 @@ class _Sentinel:
 
 #: resolved value when the query must take the host path instead
 DEVICE_FALLBACK = _Sentinel()
+
+#: pipeline stages timed per drain (ms); exported under
+#: pinot_server_device_pipeline_<stage>_ms via /metrics
+_STAGES = ("queue_wait", "dispatch", "fetch", "decode")
 
 
 def _resolve(future: Future, value, exc: Optional[BaseException] = None) -> None:
@@ -60,43 +76,69 @@ def _resolve(future: Future, value, exc: Optional[BaseException] = None) -> None
 
 
 class _Item:
-    __slots__ = ("ctx", "segments", "future")
+    __slots__ = ("ctx", "segments", "future", "t_enqueue")
 
     def __init__(self, ctx, segments):
         self.ctx = ctx
         self.segments = segments
         self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
 
 
 class DeviceQueryPipeline:
     """Single-owner device dispatch loop with whole-queue batched fetches."""
 
     def __init__(self, mesh_exec=None, max_batch: int = 64,
-                 submit_timeout_s: float = 120.0, max_inflight: int = 4):
+                 submit_timeout_s: float = 120.0, max_inflight: int = 4,
+                 stack: bool = True, start: bool = True):
         if mesh_exec is None:
             from ..parallel.combine import MeshQueryExecutor
             mesh_exec = MeshQueryExecutor()
         self.mesh_exec = mesh_exec
         self.max_batch = max_batch
         self.submit_timeout_s = submit_timeout_s
+        self.stack = stack
         self._q: "queue.Queue[_Item]" = queue.Queue()
         # dispatched-but-unfetched batches: bounded so a slow fetch applies
         # backpressure to dispatch instead of piling device work up
         self._fetchq: "queue.Queue[list]" = queue.Queue(maxsize=max_inflight)
         self._fetch_busy = threading.Event()
         self._stop = threading.Event()
-        # observability: batch sizes prove pipelining happened (the e2e bench
-        # and tests read these through the server /metrics endpoint)
+        # observability: batch sizes prove pipelining happened, launch counts
+        # prove dedupe/stacking happened (the e2e bench and tests read these
+        # through the server /metrics endpoint)
         self.batches = 0
         self.dispatched = 0
         self.fallbacks = 0
         self.timeouts = 0
+        self.launches = 0
+        self.dedupe_hits = 0
+        self.stacked_launches = 0
+        # per-stage wall times: bounded deques back stats() percentiles;
+        # the process registry histograms back /metrics
+        self._stage_ms: Dict[str, deque] = {s: deque(maxlen=512)
+                                            for s in _STAGES}
+        self._hists = {s: get_registry().histogram(
+            f"pinot_server_device_pipeline_{s}_ms") for s in _STAGES}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="device-pipeline")
-        self._thread.start()
         self._fetcher = threading.Thread(target=self._fetch_loop, daemon=True,
                                          name="device-fetcher")
-        self._fetcher.start()
+        if start:
+            self.start()
+
+    def start(self) -> None:
+        """Start the dispatcher/fetcher threads (idempotent). Tests construct
+        with start=False, pre-load the queue, then start — making "N
+        concurrent submissions coalesce into one drain" deterministic."""
+        if not self._thread.is_alive():
+            self._thread.start()
+        if not self._fetcher.is_alive():
+            self._fetcher.start()
+
+    def _observe(self, stage: str, ms: float) -> None:
+        self._stage_ms[stage].append(ms)
+        self._hists[stage].observe(ms)
 
     # -- caller side ------------------------------------------------------
     def execute_partial(self, ctx, segments: Sequence):
@@ -115,21 +157,28 @@ class DeviceQueryPipeline:
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5.0)
-        self._fetcher.join(timeout=5.0)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if self._fetcher.is_alive():
+            self._fetcher.join(timeout=5.0)
         # resolve anything stranded in either queue: blocked handler threads
         # must fall back to the host path immediately, not wait out their
         # 120s future timeout holding segment references
-        for q in (self._q, self._fetchq):
-            while True:
-                try:
-                    entry = q.get_nowait()
-                except queue.Empty:
-                    break
-                items = entry if isinstance(entry, list) else [entry]
-                for it in items:
-                    item = it[0] if isinstance(it, tuple) else it
-                    _resolve(item.future, DEVICE_FALLBACK)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            _resolve(item.future, DEVICE_FALLBACK)
+        while True:
+            try:
+                entry = self._fetchq.get_nowait()
+            except queue.Empty:
+                break
+            for _, _, groups in entry:
+                for group in groups:
+                    for item, _ in group:
+                        _resolve(item.future, DEVICE_FALLBACK)
 
     # -- dispatcher thread ------------------------------------------------
     def _drain(self) -> Optional[list]:
@@ -156,43 +205,32 @@ class DeviceQueryPipeline:
         return batch
 
     def _loop(self) -> None:
-        """Dispatcher: drain -> plan + async-dispatch -> hand to the fetcher.
+        """Dispatcher: drain -> prepare + group -> launch -> hand to fetcher.
 
-        Two-stage pipelining: while the fetcher blocks in `device_get` for
+        Two-stage pipelining: while the fetcher blocks in the host sync for
         batch N (one relay round trip), batch N+1's kernels are ALREADY
         dispatched and executing on the device — the round trip overlaps
         compute instead of serializing behind it."""
+        prepared_api = hasattr(self.mesh_exec, "prepare_partial")
         while not self._stop.is_set():
             batch = self._drain()
             if batch is None:
                 continue
-            pending = []  # (item, outs_dev, decode)
-            for item in batch:
-                if item.future.done():
-                    # caller already timed out and cancelled: don't burn a
-                    # device dispatch on a result nobody will read
-                    continue
-                try:
-                    dp = self.mesh_exec.dispatch_partial(item.ctx,
-                                                         item.segments)
-                except Exception:
-                    # planning failed on the device path (e.g. a shape the
-                    # mesh planner missets) — the host path is the answer,
-                    # not a query error
-                    dp = None
-                if dp is None:
-                    self.fallbacks += 1
-                    _resolve(item.future, DEVICE_FALLBACK)
-                else:
-                    pending.append((item, dp[0], dp[1]))
-            if not pending:
+            t0 = time.perf_counter()
+            if prepared_api:
+                entry, n_live = self._dispatch_grouped(batch, t0)
+            else:
+                entry, n_live = self._dispatch_legacy(batch, t0)
+            if not entry:
                 continue
+            self._observe("dispatch", (time.perf_counter() - t0) * 1000)
             self.batches += 1
-            self.dispatched += len(pending)
+            self.dispatched += n_live
+            self.launches += len(entry)
             handed_off = False
             while not self._stop.is_set():
                 try:
-                    self._fetchq.put(pending, timeout=0.2)
+                    self._fetchq.put(entry, timeout=0.2)
                     handed_off = True
                     break
                 except queue.Full:
@@ -201,40 +239,170 @@ class DeviceQueryPipeline:
                 # stopping with the fetch queue full: these futures would
                 # otherwise dangle past stop()'s drain for the full submit
                 # timeout — resolve them to the host path now
-                for item, _, _ in pending:
-                    _resolve(item.future, DEVICE_FALLBACK)
+                for _, _, groups in entry:
+                    for group in groups:
+                        for item, _ in group:
+                            _resolve(item.future, DEVICE_FALLBACK)
 
+    def _dispatch_grouped(self, batch, t0):
+        """Prepare every live item, collapse identical dispatches, launch the
+        dedupe representatives (stacking where executables align). Returns
+        (fetch entry, live item count); the entry is a list of launches
+        `(outs_dev, finish, groups)` where `groups[i]` holds the
+        (item, decode) pairs answered by the launch's i-th result."""
+        reps = []          # dedupe-group representative PreparedDispatch
+        rep_groups: List[list] = []   # aligned [(item, decode), ...] lists
+        dedupe_index: Dict[tuple, int] = {}
+        for item in batch:
+            if item.future.done():
+                # caller already timed out and cancelled: don't burn a
+                # device dispatch on a result nobody will read
+                continue
+            self._observe("queue_wait", (t0 - item.t_enqueue) * 1000)
+            try:
+                p = self.mesh_exec.prepare_partial(item.ctx, item.segments)
+            except Exception:
+                # planning failed on the device path (e.g. a shape the mesh
+                # planner missets) — the host path is the answer, not a
+                # query error
+                p = None
+            if p is None:
+                self.fallbacks += 1
+                _resolve(item.future, DEVICE_FALLBACK)
+                continue
+            if not self.stack:
+                p.stackable = False
+            if p.dedupe_key is not None and p.dedupe_key in dedupe_index:
+                rep_groups[dedupe_index[p.dedupe_key]].append(
+                    (item, p.decode))
+                self.dedupe_hits += 1
+                continue
+            if p.dedupe_key is not None:
+                dedupe_index[p.dedupe_key] = len(reps)
+            reps.append(p)
+            rep_groups.append([(item, p.decode)])
+        if not reps:
+            return [], 0
+        try:
+            launches = self.mesh_exec.dispatch_prepared(reps)
+        except Exception:
+            # a grouped launch failing (e.g. a stacked-shape trace the
+            # executor mishandles) downgrades to host execution for the
+            # whole drain — availability over the fast path
+            for group in rep_groups:
+                for item, _ in group:
+                    self.fallbacks += 1
+                    _resolve(item.future, DEVICE_FALLBACK)
+            return [], 0
+        self.stacked_launches += sum(1 for _, _, idxs in launches
+                                     if len(idxs) > 1)
+        entry = [(outs_dev, finish, [rep_groups[i] for i in idxs])
+                 for outs_dev, finish, idxs in launches]
+        return entry, sum(len(g) for g in rep_groups)
+
+    def _dispatch_legacy(self, batch, t0):
+        """One launch per item for executors without the prepared API (fakes,
+        older mesh executors): preserves batched fetching, skips
+        dedupe/stacking."""
+        entry = []
+        for item in batch:
+            if item.future.done():
+                continue
+            self._observe("queue_wait", (t0 - item.t_enqueue) * 1000)
+            try:
+                dp = self.mesh_exec.dispatch_partial(item.ctx, item.segments)
+            except Exception:
+                dp = None
+            if dp is None:
+                self.fallbacks += 1
+                _resolve(item.future, DEVICE_FALLBACK)
+                continue
+            entry.append((dp[0], (lambda host: [host]),
+                          [[(item, dp[1])]]))
+        return entry, len(entry)
+
+    # -- fetcher thread ---------------------------------------------------
     def _fetch_loop(self) -> None:
         import jax
+        fetch = getattr(self.mesh_exec, "fetch", None) or jax.device_get
         while not self._stop.is_set():
             try:
-                pending = self._fetchq.get(timeout=0.05)
+                entry = self._fetchq.get(timeout=0.05)
             except queue.Empty:
                 continue
             self._fetch_busy.set()
             try:
+                # launches whose every caller timed out are dead weight:
+                # dropping them BEFORE the host sync keeps a storm of
+                # cancellations from paying relay round trips for nothing
+                live = [L for L in entry
+                        if any(not item.future.done()
+                               for group in L[2] for item, _ in group)]
+                if not live:
+                    continue
+                t0 = time.perf_counter()
                 try:
                     # ONE host sync for the whole dispatched batch
-                    fetched = jax.device_get([p[1] for p in pending])
+                    fetched = fetch([L[0] for L in live])
                 except Exception as e:
-                    for item, _, _ in pending:
-                        _resolve(item.future, None, exc=e)
+                    for _, _, groups in live:
+                        for group in groups:
+                            for item, _ in group:
+                                _resolve(item.future, None, exc=e)
                     continue
-                for (item, _, decode), outs in zip(pending, fetched):
-                    if item.future.done():
-                        continue  # caller timed out mid-fetch: skip the decode
-                    try:
-                        _resolve(item.future, decode(outs))
-                    except Exception as e:
-                        _resolve(item.future, None, exc=e)
+                self._observe("fetch", (time.perf_counter() - t0) * 1000)
+                t1 = time.perf_counter()
+                for (_, finish, groups), host in zip(live, fetched):
+                    self._decode_launch(finish, groups, host)
+                self._observe("decode", (time.perf_counter() - t1) * 1000)
             finally:
                 self._fetch_busy.clear()
 
+    def _decode_launch(self, finish, groups, host) -> None:
+        try:
+            outs_list = finish(host)
+        except Exception as e:
+            for group in groups:
+                for item, _ in group:
+                    _resolve(item.future, None, exc=e)
+            return
+        for outs, group in zip(outs_list, groups):
+            for item, decode in group:
+                if item.future.done():
+                    continue  # caller timed out mid-fetch: skip the decode
+                try:
+                    r = decode(outs)
+                except Exception as e:
+                    _resolve(item.future, None, exc=e)
+                    continue
+                if r is DEVICE_FALLBACK:
+                    # the device result is unusable (e.g. NaN order keys,
+                    # candidate overflow) — host path decides
+                    self.fallbacks += 1
+                _resolve(item.future, r)
+
     def stats(self) -> dict:
-        return {"batches": self.batches, "dispatched": self.dispatched,
-                "fallbacks": self.fallbacks, "timeouts": self.timeouts,
-                "meanBatch": round(self.dispatched / self.batches, 2)
-                if self.batches else 0.0}
+        out = {"batches": self.batches, "dispatched": self.dispatched,
+               "fallbacks": self.fallbacks, "timeouts": self.timeouts,
+               "launches": self.launches, "dedupeHits": self.dedupe_hits,
+               "stackedLaunches": self.stacked_launches,
+               "meanBatch": round(self.dispatched / self.batches, 2)
+               if self.batches else 0.0}
+        out["stageMs"] = {s: _summarize(self._stage_ms[s]) for s in _STAGES}
+        return out
+
+
+def _summarize(samples: deque) -> dict:
+    vals = sorted(samples)
+    if not vals:
+        return {"count": 0, "meanMs": 0.0, "p50Ms": 0.0, "p95Ms": 0.0,
+                "maxMs": 0.0}
+    n = len(vals)
+    return {"count": n,
+            "meanMs": round(sum(vals) / n, 3),
+            "p50Ms": round(vals[min(n - 1, int(0.5 * n))], 3),
+            "p95Ms": round(vals[min(n - 1, int(0.95 * n))], 3),
+            "maxMs": round(vals[-1], 3)}
 
 
 def pipeline_from_config(cfg) -> Optional[DeviceQueryPipeline]:
@@ -245,4 +413,6 @@ def pipeline_from_config(cfg) -> Optional[DeviceQueryPipeline]:
         return None
     return DeviceQueryPipeline(
         max_batch=cfg.get_int("server.device.max.batch", 64),
-        submit_timeout_s=cfg.get_float("server.device.timeout.seconds", 120.0))
+        submit_timeout_s=cfg.get_float("server.device.timeout.seconds", 120.0),
+        max_inflight=cfg.get_int("server.device.max.inflight", 4),
+        stack=cfg.get_bool("server.device.stacking.enabled", True))

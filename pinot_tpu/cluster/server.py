@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 from ..query.aggregates import make_agg
 from ..query.context import QueryContext, compile_query
+from ..parallel.combine import device_topk_screen
 from ..query.executor import ServerQueryExecutor
 from ..query.reduce import SegmentResult, merge_segment_results
 from ..segment.reader import ImmutableSegment, load_segment
@@ -453,12 +454,15 @@ class ServerNode:
             device_partial = None
             if (self.device_pipeline is not None and segments
                     and upsert is None
-                    and (ctx.aggregations or ctx.distinct)):
-                # pre-screened on THIS thread: selections and other
-                # non-aggregation shapes have no device plan, so they go
-                # straight to the host loop instead of waiting out the
-                # pipeline's batch-accumulation window for a FALLBACK verdict
-                # (DISTINCT rewrites to a group-by, which does plan on device)
+                    and (ctx.aggregations or ctx.distinct
+                         or device_topk_screen(ctx))):
+                # pre-screened on THIS thread: only shapes that CAN plan on
+                # device enter the pipeline — everything else goes straight
+                # to the host loop instead of waiting out the pipeline's
+                # batch-accumulation window for a FALLBACK verdict. DISTINCT
+                # rewrites to a group-by, which plans on device; ORDER-BY-
+                # limit selections ride the fused top-k kernel when the
+                # screen admits them (single-column order, bounded k)
                 # device path: ONE server-level partial for the whole set,
                 # executed on the mesh with batched fetches; falls back per
                 # segment below when the plan can't ride the device (upsert

@@ -721,6 +721,47 @@ def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
     return jax.device_get(out)
 
 
+def topk_kernel(spec: KernelSpec, order_expr, desc: bool, k: int,
+                total_rows: Optional[int] = None):
+    """Cached jit of the fused filter + `lax.top_k` candidate kernel.
+
+    Returns (fn, k) where k is the clamped candidate count and
+    fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets) ->
+    {"idx": i32[k] flat row indices, "count": i32 match count,
+     "ok": bool[k] usable flag per candidate, "nanMatches": i32 matching rows
+     whose sort key is NaN (serving falls back to the host when > 0 — NaN
+     ordering parity with the Python sort is out of the device contract)}.
+
+    Both the synchronous single-segment path (`compute_topk`) and the served
+    mesh path dispatch THIS kernel; the mesh path passes the stacked
+    [segments, rows] arrays and `total_rows = segments * rows` and fetches the
+    outputs asynchronously in the pipeline's batched device_get."""
+    k = min(k, total_rows if total_rows is not None else spec.padded_rows)
+    key = ("topk", spec.filter.signature(), repr(order_expr), desc, k,
+           spec.padded_rows, total_rows)
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        mask_fn = _make_mask_fn(spec)
+
+        def body(ids, vals, luts, iscal, fscal, nulls, valid, docsets):
+            mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets).ravel()
+            v = eval_expr(order_expr, vals, jnp).ravel().astype(jnp.float32)
+            # NaN keys sink to the bottom (numpy sorts NaN last ascending; exact
+            # parity for NaN keys is out of contract either way)
+            nan = jnp.isnan(v)
+            usable = mask & ~nan
+            score = jnp.where(usable, v if desc else -v, -jnp.inf)
+            _, idx = jax.lax.top_k(score, k)
+            return {"idx": idx.astype(jnp.int32),
+                    "count": mask.sum(dtype=jnp.int32),
+                    "ok": usable[idx],
+                    "nanMatches": (mask & nan).sum(dtype=jnp.int32)}
+
+        fn = jax.jit(body)
+        _KERNEL_CACHE[key] = fn
+    return fn, k
+
+
 def compute_topk(spec: KernelSpec, inputs: KernelInputs, order_expr,
                  desc: bool, k: int) -> Tuple[np.ndarray, int]:
     """Device top-k for `SELECT ... ORDER BY <numeric expr> LIMIT k` (SURVEY hard-part 3).
@@ -734,29 +775,12 @@ def compute_topk(spec: KernelSpec, inputs: KernelInputs, order_expr,
     f32 here only decides the CANDIDATE SET (callers overfetch slack for boundary
     ties); final ordering is exact.
     """
-    k = min(k, spec.padded_rows)
-    key = ("topk", spec.filter.signature(), repr(order_expr), desc, k,
-           spec.padded_rows)
-    fn = _KERNEL_CACHE.get(key)
-    if fn is None:
-        mask_fn = _make_mask_fn(spec)
-
-        def body(ids, vals, luts, iscal, fscal, nulls, valid, docsets):
-            mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets).ravel()
-            v = eval_expr(order_expr, vals, jnp).ravel().astype(jnp.float32)
-            # NaN keys sink to the bottom (numpy sorts NaN last ascending; exact
-            # parity for NaN keys is out of contract either way)
-            usable = mask & ~jnp.isnan(v)
-            score = jnp.where(usable, v if desc else -v, -jnp.inf)
-            _, idx = jax.lax.top_k(score, k)
-            return idx, mask.sum(dtype=jnp.int32), usable[idx]
-
-        fn = jax.jit(body)
-        _KERNEL_CACHE[key] = fn
-    idx, count, ok = jax.device_get(fn(inputs.ids, inputs.vals, inputs.luts,
-                                       inputs.iscal, inputs.fscal, inputs.nulls,
-                                       inputs.valid, inputs.docsets))
-    return np.asarray(idx), int(count), np.asarray(ok)
+    fn, _ = topk_kernel(spec, order_expr, desc, k)
+    outs = jax.device_get(fn(inputs.ids, inputs.vals, inputs.luts,
+                             inputs.iscal, inputs.fscal, inputs.nulls,
+                             inputs.valid, inputs.docsets))
+    return (np.asarray(outs["idx"]), int(outs["count"]),
+            np.asarray(outs["ok"]))
 
 
 def _agg_arg(agg: AggFunc, vals) -> Optional[jnp.ndarray]:

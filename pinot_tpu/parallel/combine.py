@@ -65,6 +65,46 @@ def _has_docset_filter(ctx: QueryContext) -> bool:
 _SHARD_KERNEL_CACHE: Dict[Tuple, object] = {}
 
 
+def device_topk_screen(ctx: QueryContext) -> bool:
+    """Cheap handler-thread pre-screen: could this SELECTION ride the device
+    top-k path? (single plain-column ORDER BY, bounded LIMIT). The full
+    eligibility check (numeric dtype, int bounds, dictionary alignment,
+    device-safe filter) runs in `prepare_partial`; a miss there resolves to
+    the host fallback. Without this screen every orderless selection would
+    wait out the pipeline's batch window just to learn it must fall back."""
+    k = ctx.offset + ctx.limit
+    return (len(ctx.order_by) == 1
+            and isinstance(ctx.order_by[0].expr, Identifier)
+            and 0 < k <= ServerQueryExecutor.MAX_DEVICE_TOPK)
+
+
+@dataclass
+class PreparedDispatch:
+    """A planned-but-not-launched device dispatch (pipeline tentpole unit).
+
+    The pipeline groups prepared items before launching: items with equal
+    `dedupe_key` are byte-identical dispatches (same executable, same runtime
+    operands) and share ONE kernel launch + ONE fetched result; items with
+    equal `stack_key` (same `KernelSpec.signature()` executable over the same
+    block, differing only in runtime scalars) stack into ONE batched kernel
+    launch (`lax.scan` over the stacked scalar streams) instead of N
+    sequential dispatches."""
+
+    kind: str                    # "agg" | "topk"
+    spec: Any                    # KernelSpec ("agg") or static key tuple ("topk")
+    inputs: dict
+    s_pad: int
+    rows: int
+    stack_key: Tuple             # same traced executable + same device operands
+    dedupe_key: Optional[Tuple]  # fully identical dispatch (None = never dedupe)
+    stackable: bool
+    decode: Any                  # decode(host outs dict) -> partial | DEVICE_FALLBACK
+    iscal_np: Optional[np.ndarray] = None  # host scalar streams (stacking)
+    fscal_np: Optional[np.ndarray] = None
+    trim_keys: Tuple[int, int] = (0, 0)  # (num_keys_pad, num_keys_real) device trim
+    launch: Any = None           # "topk": () -> outs_dev (pre-bound kernel)
+
+
 class DocsetPlanDivergence(Exception):
     """Segments in one set compile to different doc-set leaf structures (e.g.
     a geo index present on some segments only): the stacked mesh dispatch
@@ -247,7 +287,10 @@ class MeshQueryExecutor:
         self._const_cache: Dict[bytes, jnp.ndarray] = {}
 
     def _const(self, arr: np.ndarray) -> jnp.ndarray:
-        key = arr.dtype.str.encode() + arr.tobytes()
+        # shape is part of identity: equal bytes at different shapes (e.g.
+        # an empty [0] scalar stream vs its stacked [B, 0] form) are
+        # different device constants
+        key = arr.dtype.str.encode() + repr(arr.shape).encode() + arr.tobytes()
         dev = self._const_cache.get(key)
         if dev is None:
             if len(self._const_cache) > 4096:
@@ -357,18 +400,9 @@ class MeshQueryExecutor:
         """Dispatch the stacked star-tree kernel: per-segment tree-traversal
         record masks stack into the kernel's valid input (the split-dim LUT
         predicates are already fused into the mask by the slot plan)."""
-        s_pad = -(-len(sp.views) // self.n_devices) * self.n_devices
-        rows = max(padded_rows(v.num_docs) for v in sp.views)
-        valid = np.zeros((s_pad, rows), dtype=bool)
-        for i, p in enumerate(sp.plans):
-            m = np.asarray(p.record_mask, dtype=bool)
-            valid[i, :len(m)] = m
-        P = jax.sharding.PartitionSpec
-        valid_dev = jax.device_put(
-            valid, jax.sharding.NamedSharding(self.mesh, P(SEGMENT_AXIS)))
-        return self._dispatch_sharded(sp.plans[0].ctx2, sp.plan2, sp.views,
-                                      valid_override=valid_dev,
-                                      star=(ctx, sp), partial=partial)
+        p = self._prepare_star(ctx, sp, partial=partial)
+        fn = self._get_shard_kernel(p.spec, p.s_pad, p.rows)
+        return fn(p.inputs), p.decode
 
     def _stacked_docsets(self, ctx: QueryContext, plan, segments,
                          block: SegmentSetBlock) -> Tuple:
@@ -515,6 +549,169 @@ class MeshQueryExecutor:
         except DocsetPlanDivergence:
             return None
 
+    # -- prepared dispatch (the serving pipeline's unit of work) -------
+    def prepare_partial(self, ctx: QueryContext, segments):
+        """Plan + build (but do NOT launch) a server-level partial dispatch.
+
+        Returns a PreparedDispatch or None (host fallback). The pipeline
+        groups prepared items by dedupe/stack key and launches them through
+        `dispatch_prepared`, so N same-shape queries pay one traced
+        executable and — where only runtime scalars differ — one batched
+        kernel launch."""
+        if not ctx.aggregations and not ctx.distinct:
+            # selection: only the immutable top-k path rides the device (no
+            # merged-view remap — a fallback verdict must stay cheap)
+            if not segments or any(getattr(s, "is_mutable", False)
+                                   for s in segments):
+                return None
+            plan = plan_segment(ctx, segments[0],
+                                scan_docs=sum(s.num_docs for s in segments))
+            if plan.kind != "selection":
+                return None  # empty/pruned: the host path answers trivially
+            return self._prepare_topk(ctx, plan, segments)
+        plan, view = self._plan_for_set(ctx, segments)
+        if isinstance(plan, StarSetPlan):
+            return self._prepare_star(ctx, plan)
+        if plan is None or plan.kind != "device":
+            return None
+        try:
+            return self._prepare_sharded(ctx, plan, segments, view,
+                                         partial=True)
+        except DocsetPlanDivergence:
+            return None
+
+    def fetch(self, trees):
+        """One host sync for a batch of dispatched output trees (the
+        pipeline's fetch hook; fakes in tests override this)."""
+        return jax.device_get(trees)
+
+    def dispatch_prepared(self, reps: Sequence[PreparedDispatch]):
+        """Launch a deduped batch of prepared dispatches.
+
+        `reps` are dedupe-group representatives. Returns a list of launches
+        `(outs_dev, finish, indices)`: `indices` are positions into `reps`
+        covered by that launch and `finish(host_fetched)` -> list of decoded
+        host outs dicts aligned with `indices`. Stackable reps sharing a
+        `stack_key` collapse into ONE batched kernel launch."""
+        groups: Dict[Tuple, List[int]] = {}
+        order: List[Tuple] = []
+        for i, p in enumerate(reps):
+            key = p.stack_key if (p.kind == "agg" and p.stackable) \
+                else ("solo", i)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(i)
+        launches = []
+        for key in order:
+            idxs = groups[key]
+            ps = [reps[i] for i in idxs]
+            if len(ps) == 1:
+                p = ps[0]
+                if p.kind == "topk":
+                    outs = p.launch()
+                else:
+                    fn = self._get_shard_kernel(p.spec, p.s_pad, p.rows)
+                    outs = fn(p.inputs)
+                packed, unpack = self._pack(outs, p.trim_keys, batched=0)
+                launches.append((packed,
+                                 (lambda host, u=unpack: [u(host)]), idxs))
+            else:
+                outs, b_real = self._launch_stacked(ps)
+                packed, unpack = self._pack(outs, ps[0].trim_keys,
+                                            batched=b_real)
+                launches.append((packed,
+                                 (lambda host, u=unpack, n=b_real:
+                                  [u(host, b) for b in range(n)]), idxs))
+        return launches
+
+    def _launch_stacked(self, ps: List[PreparedDispatch]):
+        """ONE batched kernel launch for same-executable prepared dispatches
+        differing only in runtime scalars: scan the fused body over stacked
+        [B, n] scalar streams (columns/LUTs/valid broadcast). B pads to the
+        next power of two (repeating the last scalars) so the jit cache holds
+        log2 variants, not one per concurrency level."""
+        b = len(ps)
+        b_pad = 1 << (b - 1).bit_length()
+        iscal = np.stack([p.iscal_np for p in ps]
+                         + [ps[-1].iscal_np] * (b_pad - b))
+        fscal = np.stack([p.fscal_np for p in ps]
+                         + [ps[-1].fscal_np] * (b_pad - b))
+        inputs = dict(ps[0].inputs)
+        inputs["iscal"] = self._const(iscal)
+        inputs["fscal"] = self._const(fscal)
+        fn = self._get_shard_kernel(ps[0].spec, ps[0].s_pad, ps[0].rows,
+                                    batch=b_pad)
+        return fn(inputs), b
+
+    def _pack(self, outs_dev: Dict[str, jnp.ndarray], trim_keys: Tuple[int, int],
+              batched: int):
+        """Device-resident combine of a launch's outputs before the fetch.
+
+        Concatenates every output leaf (raveled, grouped by dtype, key axis
+        trimmed from num_keys_pad to num_keys_real) into one flat array per
+        dtype ON DEVICE, so the batched `device_get` ships a couple of
+        combined arrays per launch instead of per-output (and, stacked,
+        per-item) leaves. Returns (packed device dict, unpack) where
+        unpack(host_packed[, b]) rebuilds the named outs dict."""
+        meta = tuple(sorted((k, tuple(v.shape), v.dtype.str)
+                            for k, v in outs_dev.items()))
+        pad, real = trim_keys
+        key = ("pack", meta, trim_keys, bool(batched))
+        fn = _SHARD_KERNEL_CACHE.get(key)
+
+        def _core(shape):
+            core = shape[1:] if batched else shape
+            if pad and real < pad and core and core[0] == pad:
+                core = (real,) + tuple(core[1:])
+            return core
+
+        if fn is None:
+            def pack_impl(outs):
+                by_dt: Dict[str, list] = {}
+                for name, shape, dts in meta:
+                    v = outs[name]
+                    core = shape[1:] if batched else shape
+                    if pad and real < pad and core and core[0] == pad:
+                        v = v[:, :real] if batched else v[:real]
+                    flat = v.reshape((v.shape[0], -1)) if batched \
+                        else v.reshape(-1)
+                    by_dt.setdefault(dts, []).append(flat)
+                return {dt: (jnp.concatenate(parts, axis=-1)
+                             if len(parts) > 1 else parts[0])
+                        for dt, parts in by_dt.items()}
+            fn = jax.jit(pack_impl)
+            _SHARD_KERNEL_CACHE[key] = fn
+
+        def unpack(host: Dict[str, np.ndarray], b: Optional[int] = None):
+            out = {}
+            offs: Dict[str, int] = {}
+            for name, shape, dts in meta:
+                core = _core(shape)
+                n = int(np.prod(core)) if core else 1
+                flat = host[dts]
+                row = flat[b] if batched else flat
+                o = offs.get(dts, 0)
+                out[name] = np.asarray(row[o:o + n]).reshape(core)
+                offs[dts] = o + n
+            return out
+
+        return fn(outs_dev), unpack
+
+    def _block_for(self, segments, view, s_pad: int) -> SegmentSetBlock:
+        # stable key + volatile subkey: growth of a consuming segment frees the
+        # superseded block's device arrays instead of pinning up to 64 dead copies
+        stable = (tuple(getattr(s, "path", s.name) for s in segments),
+                  view is not None)
+        vkey = (view_key(segments), s_pad)
+        entry = self._set_blocks.get(stable)
+        if entry is None or entry[0] != vkey:
+            if len(self._set_blocks) > 64:
+                self._set_blocks.clear()
+            entry = (vkey, SegmentSetBlock(segments, s_pad, self.mesh, view))
+            self._set_blocks[stable] = entry
+        return entry[1]
+
     def _dispatch_sharded(self, ctx: QueryContext, plan, segments, view=None,
                           valid_override=None, star=None, partial=False):
         """Dispatch the fused mesh kernel asynchronously.
@@ -525,23 +722,38 @@ class MeshQueryExecutor:
         `valid_override` replaces the block's all-true validity (stacked
         star-tree record masks); `star` = (original ctx, StarSetPlan) makes
         decode reassemble slot states into the original aggregations."""
+        p = self._prepare_sharded(ctx, plan, segments, view, valid_override,
+                                  star, partial)
+        fn = self._get_shard_kernel(p.spec, p.s_pad, p.rows)
+        return fn(p.inputs), p.decode
+
+    def _prepare_star(self, ctx: QueryContext, sp: "StarSetPlan",
+                      partial=True):
+        s_pad = -(-len(sp.views) // self.n_devices) * self.n_devices
+        rows = max(padded_rows(v.num_docs) for v in sp.views)
+        valid = np.zeros((s_pad, rows), dtype=bool)
+        for i, p in enumerate(sp.plans):
+            m = np.asarray(p.record_mask, dtype=bool)
+            valid[i, :len(m)] = m
+        P = jax.sharding.PartitionSpec
+        valid_dev = jax.device_put(
+            valid, jax.sharding.NamedSharding(self.mesh, P(SEGMENT_AXIS)))
+        return self._prepare_sharded(sp.plans[0].ctx2, sp.plan2, sp.views,
+                                     valid_override=valid_dev,
+                                     star=(ctx, sp), partial=partial)
+
+    def _prepare_sharded(self, ctx: QueryContext, plan, segments, view=None,
+                         valid_override=None, star=None,
+                         partial=False) -> PreparedDispatch:
+        """Plan-shape + runtime-input construction WITHOUT the kernel launch
+        (the separable front half of `_dispatch_sharded`)."""
         build_device_geometry(plan)
         agg_specs = []
         distinct_lut_sizes: Dict[int, int] = {}
         agg_luts: Dict[str, jnp.ndarray] = {}
 
         s_pad = -(-len(segments) // self.n_devices) * self.n_devices
-        # stable key + volatile subkey: growth of a consuming segment frees the
-        # superseded block's device arrays instead of pinning up to 64 dead copies
-        stable = (tuple(getattr(s, "path", s.name) for s in segments), view is not None)
-        vkey = (view_key(segments), s_pad)
-        entry = self._set_blocks.get(stable)
-        if entry is None or entry[0] != vkey:
-            if len(self._set_blocks) > 64:
-                self._set_blocks.clear()
-            entry = (vkey, SegmentSetBlock(segments, s_pad, self.mesh, view))
-            self._set_blocks[stable] = entry
-        block = entry[1]
+        block = self._block_for(segments, view, s_pad)
 
         for i, agg in enumerate(plan.aggs):
             agg_specs.append((agg, agg.device_outputs))
@@ -586,21 +798,20 @@ class MeshQueryExecutor:
                                               and agg.arg.name == "*"):
                 vals_cols.update(identifiers_in(agg.arg))
 
+        iscal_np = np.asarray(iscal, dtype=np.int32)
+        fscal_np = np.asarray(fscal, dtype=np.float32)
         inputs = dict(
             ids={c: block.ids(c) for c in ids_cols},
             vals={c: block.decoded(c) for c in vals_cols},
             luts=tuple(luts),
-            iscal=self._const(np.asarray(iscal, dtype=np.int32)),
-            fscal=self._const(np.asarray(fscal, dtype=np.float32)),
+            iscal=self._const(iscal_np),
+            fscal=self._const(fscal_np),
             nulls={c: block.null_mask(c) for c in nulls_cols},
             valid=block.valid if valid_override is None else valid_override,
             strides=self._const(np.asarray(plan.strides, dtype=np.int32)),
             agg_luts=agg_luts,
             docsets=docsets,
         )
-
-        fn = self._get_shard_kernel(spec, s_pad, block.rows)
-        outs_dev = fn(inputs)
 
         def decode(outs):
             # replicated outputs decode exactly like the single-segment path;
@@ -662,23 +873,197 @@ class MeshQueryExecutor:
                            else list(ctx.group_by))
             return reduce_to_result(ctx, merged, plan.aggs, group_exprs)
 
-        return outs_dev, decode
+        sig = spec.signature()
+        shape_key = ("agg", sig, id(block), s_pad, block.rows, id(self.mesh))
+        # device operands are content-addressed (`_const`) or block-cached, so
+        # object identity == content identity: two queries stack iff the same
+        # executable reads the same device arrays (scalars ride the stack)
+        operands = (tuple(id(a) for a in inputs["luts"]),
+                    id(inputs["valid"]), id(inputs["strides"]),
+                    tuple(id(d) for d in docsets))
+        stackable = (star is None and valid_override is None and not docsets)
+        stack_key = shape_key + operands
+        dedupe_key = None if valid_override is not None else \
+            stack_key + (iscal_np.tobytes(), fscal_np.tobytes())
+        # device-side key-axis trim: a grouped server partial only ever decodes
+        # the first num_keys_real entries, so padding rows never cross the relay
+        trim = (plan.num_keys_pad, plan.num_keys_real) \
+            if (partial and plan.group_cols and star is None) else (0, 0)
+        return PreparedDispatch(
+            kind="agg", spec=spec, inputs=inputs, s_pad=s_pad,
+            rows=block.rows, stack_key=stack_key, dedupe_key=dedupe_key,
+            stackable=stackable, decode=decode, iscal_np=iscal_np,
+            fscal_np=fscal_np, trim_keys=trim)
 
     # ------------------------------------------------------------------
-    def _get_shard_kernel(self, spec: KernelSpec, s_pad: int, rows: int):
-        cache_key = (spec.signature(), self.n_devices, s_pad, rows, id(self.mesh))
+    def _prepare_topk(self, ctx: QueryContext, plan, segments):
+        """Prepared device top-k for a served ORDER-BY-limit selection.
+
+        Mirrors `ServerQueryExecutor._topk_candidates` eligibility over the
+        STACKED segment set, dispatching the same fused `compute_topk` kernel
+        (`kernels.topk_kernel`) over the block's [S_pad, rows] arrays so the
+        candidate trim happens on device and only k+slack doc ids ship in the
+        pipeline's batched fetch. Returns None -> host fallback."""
+        from ..query.planner import _expr_device_ok
+        from ..query.predicate import DocSetLeaf
+        if not device_topk_screen(ctx):
+            return None
+        order = ctx.order_by[0]
+        k = ctx.offset + ctx.limit
+        seg0 = segments[0]
+        from ..query.executor import topk_order_key_device_ok
+        if any(not topk_order_key_device_ok(s, order.expr)
+               for s in segments):
+            return None
+        col = order.expr.name
+        if _refs_multi_value(ctx, seg0):
+            return None  # MV select/filter cells keep the per-segment path
+        lut_cols = []
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, CmpLeaf) and _expr_device_ok(leaf.expr, seg0):
+                return None  # mask itself needs the host path
+            if isinstance(leaf, DocSetLeaf):
+                return None  # per-segment aux-index bitmaps: host path
+            if isinstance(leaf, LutLeaf):
+                lut_cols.append(leaf.col)
+        if lut_cols and not aligned_dictionaries(segments, lut_cols):
+            return None  # plan's id intervals only valid set-wide when aligned
+
+        from ..engine.kernels import topk_kernel
+        s_pad = -(-len(segments) // self.n_devices) * self.n_devices
+        block = self._block_for(segments, None, s_pad)
+        spec = KernelSpec(plan.filter_prog, (), 1, (), {}, block.rows)
+
+        ids_cols, vals_cols, nulls_cols = set(), {col}, set()
+        luts, iscal, fscal = [], [], []
+        for leaf in plan.filter_prog.leaves:
+            if isinstance(leaf, LutLeaf):
+                ids_cols.add(leaf.col)
+                if leaf.intervals is not None:
+                    for lo, hi in leaf.intervals:
+                        iscal.extend((lo, hi))
+                else:
+                    luts.append(self._const(leaf.lut))
+            elif isinstance(leaf, CmpLeaf):
+                vals_cols.update(identifiers_in(leaf.expr))
+                (iscal if leaf.is_int else fscal).extend(leaf.operands)
+            elif isinstance(leaf, NullLeaf):
+                nulls_cols.add(leaf.col)
+        iscal_np = np.asarray(iscal, dtype=np.int32)
+        fscal_np = np.asarray(fscal, dtype=np.float32)
+        inputs = dict(
+            ids={c: block.ids(c) for c in ids_cols},
+            vals={c: block.decoded(c) for c in vals_cols},
+            luts=tuple(luts),
+            iscal=self._const(iscal_np),
+            fscal=self._const(fscal_np),
+            nulls={c: block.null_mask(c) for c in nulls_cols},
+            valid=block.valid,
+        )
+        slack = ServerQueryExecutor.TOPK_SLACK
+        fn, kk = topk_kernel(spec, order.expr, order.desc, k + slack,
+                             total_rows=s_pad * block.rows)
+
+        def launch(inp=inputs):
+            return fn(inp["ids"], inp["vals"], inp["luts"], inp["iscal"],
+                      inp["fscal"], inp["nulls"], inp["valid"], ())
+
+        decode = self._make_topk_decode(ctx, plan, segments, block, k, kk)
+        static = ("topk", plan.filter_prog.signature(), repr(order.expr),
+                  order.desc, kk, id(block), s_pad, block.rows)
+        return PreparedDispatch(
+            kind="topk", spec=static, inputs=inputs, s_pad=s_pad,
+            rows=block.rows, stack_key=static,
+            dedupe_key=static + (tuple(id(a) for a in luts),
+                                 iscal_np.tobytes(), fscal_np.tobytes()),
+            stackable=False, decode=decode, launch=launch)
+
+    def _make_topk_decode(self, ctx: QueryContext, plan, segments, block,
+                          k: int, kk: int):
+        """decode(host outs) for the served top-k: gather the few candidate
+        rows from the segments on host and ship a 'selection' partial whose
+        exact sort keys the broker re-sorts (f32 only decided the CANDIDATE
+        set, same contract as the single-segment `_topk_candidates`)."""
+        from ..cluster.device_server import DEVICE_FALLBACK
+        from ..engine.expr import eval_expr as _eval
+        from ..query.executor import _is_const
+        from ..query.reduce import SegmentResult
+
+        def decode(outs):
+            count = int(outs["count"])
+            if int(outs["nanMatches"]) > 0:
+                # NaN sort keys displace candidates unpredictably vs the
+                # Python sort: parity demands the host path decide
+                return DEVICE_FALLBACK
+            idx = np.asarray(outs["idx"])
+            ok = np.asarray(outs["ok"])
+            keep = min(kk, count)
+            idx, ok = idx[:keep], ok[:keep]
+            idx = idx[ok]
+            seg_i = idx // block.rows
+            row_i = idx % block.rows
+            if len(idx) < min(k, count):
+                return DEVICE_FALLBACK  # -inf ties displaced matches
+            # gather candidates per segment (order is irrelevant: the broker
+            # sorts the merged partial by the exact sort keys below)
+            perm = np.lexsort((row_i, seg_i))
+            seg_i, row_i = seg_i[perm], row_i[perm]
+            needed = set()
+            for e, _ in ctx.select_items:
+                needed.update(identifiers_in(e))
+            for o in ctx.order_by:
+                needed.update(identifiers_in(o.expr))
+            env = {}
+            for c in needed:
+                parts = []
+                for s in np.unique(seg_i):
+                    rows_in = row_i[seg_i == s]
+                    parts.append(np.asarray(
+                        segments[s].column(c).values()[rows_in]))
+                env[c] = np.concatenate(parts) if parts else np.empty(0)
+            n = len(row_i)
+            out_cols = [np.asarray(_eval(e, env, np)) if not _is_const(e)
+                        else np.full(n, _eval(e, env, np), dtype=object)
+                        for e, _ in ctx.select_items]
+
+            def _cell(v):
+                if isinstance(v, np.generic):
+                    return v.item()
+                if isinstance(v, np.ndarray):
+                    return v.tolist()
+                return v
+            rows = [tuple(_cell(c[i]) for c in out_cols) for i in range(n)]
+            sort_cols = [np.asarray(_eval(o.expr, env, np))
+                         for o in ctx.order_by]
+            sort_keys = [tuple(c[i].item() if isinstance(c[i], np.generic)
+                               else c[i] for c in sort_cols)
+                         for i in range(n)]
+            return SegmentResult("selection", rows=rows, sort_keys=sort_keys,
+                                 num_docs_scanned=count)
+
+        return decode
+
+    # ------------------------------------------------------------------
+    def _get_shard_kernel(self, spec: KernelSpec, s_pad: int, rows: int,
+                          batch: int = 0):
+        cache_key = (spec.signature(), self.n_devices, s_pad, rows,
+                     id(self.mesh), batch)
         fn = _SHARD_KERNEL_CACHE.get(cache_key)
         if fn is None:
-            fn = self._build_shard_kernel(spec)
+            fn = self._build_shard_kernel(spec, batch)
             _SHARD_KERNEL_CACHE[cache_key] = fn
         return fn
 
-    def _build_shard_kernel(self, spec: KernelSpec):
+    def _build_shard_kernel(self, spec: KernelSpec, batch: int = 0):
         """jit(shard_map(fused scan body + per-output ICI collective)).
 
         The body is the SAME gather/scatter-free kernel as the single-device path
         (`kernels.make_kernel_body`); partials agree on dense keys across devices, so
-        each output merges with exactly one collective (psum / pmin / pmax)."""
+        each output merges with exactly one collective (psum / pmin / pmax).
+
+        `batch > 0` builds the STACKED variant: iscal/fscal arrive [B, n] and
+        the body scans over them — B same-shape queries in one launch, reading
+        the HBM columns once per scan step but paying ONE dispatch."""
         from ..engine.kernels import combine_collective, make_kernel_body
         body = make_kernel_body(spec)
         P = jax.sharding.PartitionSpec
@@ -689,11 +1074,27 @@ class MeshQueryExecutor:
                          fscal=repl, nulls=sharded, valid=sharded, strides=repl,
                          agg_luts=sharded, docsets=sharded),)
 
-        def shard_body(inputs):
-            out = body(inputs["ids"], inputs["vals"], inputs["luts"], inputs["iscal"],
-                       inputs["fscal"], inputs["nulls"], inputs["valid"],
-                       inputs["strides"], inputs["agg_luts"], inputs["docsets"])
-            return {k: combine_collective(k, v, ax) for k, v in out.items()}
+        if batch:
+            def shard_body(inputs):
+                def step(carry, scal):
+                    i_s, f_s = scal
+                    out = body(inputs["ids"], inputs["vals"], inputs["luts"],
+                               i_s, f_s, inputs["nulls"], inputs["valid"],
+                               inputs["strides"], inputs["agg_luts"],
+                               inputs["docsets"])
+                    return carry, out
+                _, outs = jax.lax.scan(step, 0,
+                                       (inputs["iscal"], inputs["fscal"]))
+                return {k: combine_collective(k, v, ax)
+                        for k, v in outs.items()}
+        else:
+            def shard_body(inputs):
+                out = body(inputs["ids"], inputs["vals"], inputs["luts"],
+                           inputs["iscal"], inputs["fscal"], inputs["nulls"],
+                           inputs["valid"], inputs["strides"],
+                           inputs["agg_luts"], inputs["docsets"])
+                return {k: combine_collective(k, v, ax)
+                        for k, v in out.items()}
 
         if hasattr(jax, "shard_map"):
             shard_map = jax.shard_map

@@ -457,16 +457,9 @@ class ServerQueryExecutor:
                 or k > self.MAX_DEVICE_TOPK or getattr(seg, "is_mutable", False)):
             return None
         order = ctx.order_by[0]
-        if not isinstance(order.expr, Identifier):
+        if not topk_order_key_device_ok(seg, order.expr):
             return None
         from .planner import _expr_device_ok
-        if _expr_device_ok(order.expr, seg):
-            return None
-        reader = seg.column(order.expr.name)
-        if reader.data_type.numpy_dtype.kind in "iu":
-            mn, mx = reader.min_value, reader.max_value
-            if mn is None or mx is None or max(abs(float(mn)), abs(float(mx))) >= (1 << 24):
-                return None  # f32 would misorder wide integers
         for leaf in plan.filter_prog.leaves:
             if isinstance(leaf, CmpLeaf) and _expr_device_ok(leaf.expr, seg):
                 return None  # mask itself needs the host path
@@ -627,6 +620,32 @@ def _host_env(plan: SegmentPlan, seg: ImmutableSegment) -> Dict[str, np.ndarray]
             if isinstance(leaf, CmpLeaf):
                 needed.update(identifiers_in(leaf.expr))
     return {c: seg.column(c).values() for c in needed}
+
+
+def topk_order_key_device_ok(seg, order_expr) -> bool:
+    """True when `order_expr` is a device-sortable ORDER BY key on `seg`.
+
+    Requires a plain single-value column (expression keys like a*b can
+    overflow f32 precision without column bounds revealing it) that the
+    device can evaluate; integer keys additionally need known min/max within
+    2^24 so the f32 candidate pass cannot misorder them. Shared by the
+    per-segment `_topk_candidates` and the served mesh top-k
+    (`parallel.combine._prepare_topk`), so serving and library paths agree
+    on eligibility."""
+    if not isinstance(order_expr, Identifier):
+        return False
+    from .planner import _expr_device_ok
+    if _expr_device_ok(order_expr, seg):
+        return False
+    reader = seg.column(order_expr.name)
+    if getattr(reader, "is_multi_value", False):
+        return False
+    if reader.data_type.numpy_dtype.kind in "iu":
+        mn, mx = reader.min_value, reader.max_value
+        if mn is None or mx is None or \
+                max(abs(float(mn)), abs(float(mx))) >= (1 << 24):
+            return False  # f32 would misorder wide integers
+    return True
 
 
 def group_trim_spec(ctx: QueryContext, plan: SegmentPlan):

@@ -83,11 +83,69 @@ class Timer:
         return self.total_ms / self.count if self.count else 0.0
 
 
+class Histogram:
+    """Fixed-bucket duration histogram (ms) with Prometheus histogram exposition.
+
+    Buckets are cumulative upper bounds; percentiles are read back from the
+    bucket counts (upper-bound estimate), which is exactly the resolution a
+    scrape-side `histogram_quantile` would have."""
+
+    DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                       1000.0, 2500.0, 5000.0, 10000.0)
+
+    __slots__ = ("buckets", "bucket_counts", "count", "total", "max", "_lock")
+
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
+        self.buckets = tuple(buckets) if buckets else self.DEFAULT_BUCKETS
+        self.bucket_counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for i, ub in enumerate(self.buckets):
+            if v <= ub:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self.bucket_counts[i] += 1
+            self.count += 1
+            self.total += v
+            self.max = max(self.max, v)
+
+    def percentile(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile (0 < q <= 1) from buckets."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            cum = 0
+            for i, n in enumerate(self.bucket_counts):
+                cum += n
+                if cum >= target:
+                    return self.buckets[i] if i < len(self.buckets) else self.max
+            return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {"count": self.count, "meanMs": round(self.mean, 3),
+                "p50Ms": round(self.percentile(0.5), 3),
+                "p95Ms": round(self.percentile(0.95), 3),
+                "maxMs": round(self.max, 3)}
+
+
 class MetricsRegistry:
     def __init__(self):
         self._counters: Dict[Tuple[str, LabelPairs], Counter] = {}
         self._gauges: Dict[Tuple[str, LabelPairs], Gauge] = {}
         self._timers: Dict[Tuple[str, LabelPairs], Timer] = {}
+        self._histograms: Dict[Tuple[str, LabelPairs], Histogram] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
@@ -110,6 +168,14 @@ class MetricsRegistry:
             if k not in self._timers:
                 self._timers[k] = Timer()
             return self._timers[k]
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        k = _key(name, labels)
+        with self._lock:
+            if k not in self._histograms:
+                self._histograms[k] = Histogram(buckets)
+            return self._histograms[k]
 
     def remove_gauge(self, name: str, labels: Optional[Dict[str, str]] = None
                      ) -> None:
@@ -135,6 +201,11 @@ class MetricsRegistry:
                 base = _render_name(name, labels)
                 out[f"{base}_count"] = t.count
                 out[f"{base}_total_ms"] = t.total_ms
+            for (name, labels), h in self._histograms.items():
+                base = _render_name(name, labels)
+                out[f"{base}_count"] = h.count
+                out[f"{base}_sum"] = h.total
+                out[f"{base}_p50"] = h.percentile(0.5)
         return out
 
     def render_prometheus(self) -> str:
@@ -157,6 +228,22 @@ class MetricsRegistry:
                     last_family = name
                 lines.append(f"{_prom_name(name + '_count', labels)} {t.count}")
                 lines.append(f"{_prom_name(name + '_sum', labels)} {t.total_ms}")
+            last_family = None
+            for (name, labels), h in sorted(self._histograms.items()):
+                if name != last_family:
+                    lines.append(f"# TYPE {name} histogram")
+                    last_family = name
+                cum = 0
+                for i, ub in enumerate(h.buckets):
+                    cum += h.bucket_counts[i]
+                    lines.append(_prom_name(name + "_bucket",
+                                            labels + (("le", "%g" % ub),))
+                                 + f" {cum}")
+                lines.append(_prom_name(name + "_bucket",
+                                        labels + (("le", "+Inf"),))
+                             + f" {h.count}")
+                lines.append(f"{_prom_name(name + '_sum', labels)} {h.total}")
+                lines.append(f"{_prom_name(name + '_count', labels)} {h.count}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -164,6 +251,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+            self._histograms.clear()
 
 
 def _render_name(name: str, labels: LabelPairs) -> str:

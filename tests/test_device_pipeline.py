@@ -1,0 +1,282 @@
+"""Deterministic DeviceQueryPipeline batching tests (no real device work).
+
+A fake mesh executor with controllable latency and full call recording
+proves the pipeline's scheduling contract WITHOUT racing on real kernel
+times: (a) concurrent submissions coalesce into ONE host fetch, (b) a
+timed-out caller's future is never dispatched or fetched, (c) shape-keyed
+reuse launches ONE executable for N same-shape queries (stacked) and
+collapses byte-identical queries to one dispatch (dedupe). A final smoke
+test runs the REAL executor end-to-end on the CPU mesh and asserts
+meanBatch > 1, so served-path batching can never silently regress to
+one-query-per-round-trip.
+
+Reference: QueryScheduler.java:56 bounds per-server concurrency; here the
+pipeline converts that concurrency into batched device round trips.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.device_server import (DEVICE_FALLBACK,
+                                             DeviceQueryPipeline, _Item)
+from pinot_tpu.table import TableConfig
+
+from conftest import make_ssb_columns
+
+
+class FakePrepared:
+    """Duck-typed PreparedDispatch: only the fields the pipeline reads."""
+
+    def __init__(self, shape, literal, decoded):
+        self.kind = "agg"
+        self.stackable = True
+        self.stack_key = ("shape", shape)
+        self.dedupe_key = ("shape", shape, literal)
+        self.decode = lambda outs, d=decoded: (d, outs)
+
+
+class FakeMeshExec:
+    """Prepared-API fake: ctx is a dict {shape, literal, fallback?}."""
+
+    def __init__(self, fetch_latency: float = 0.0):
+        self.fetch_latency = fetch_latency
+        self.prepared = []        # ctxs that reached prepare_partial
+        self.launched_keys = []   # one stack_key per kernel launch
+        self.fetch_calls = []     # number of trees per fetch() call
+        self.fetch_started = threading.Event()
+
+    def prepare_partial(self, ctx, segments):
+        self.prepared.append(ctx)
+        if ctx.get("fallback"):
+            return None
+        return FakePrepared(ctx["shape"], ctx["literal"],
+                            ("res", ctx["shape"], ctx["literal"]))
+
+    def dispatch_prepared(self, reps):
+        groups = {}
+        order = []
+        for i, p in enumerate(reps):
+            key = p.stack_key if p.stackable else ("solo", i)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(i)
+        launches = []
+        for key in order:
+            idxs = groups[key]
+            self.launched_keys.append(key)
+            outs_dev = {"launch": len(self.launched_keys), "n": len(idxs)}
+            launches.append((outs_dev,
+                             lambda host, n=len(idxs): [host] * n, idxs))
+        return launches
+
+    def fetch(self, trees):
+        self.fetch_started.set()
+        if self.fetch_latency:
+            time.sleep(self.fetch_latency)
+        self.fetch_calls.append(len(trees))
+        return trees
+
+
+def _submit_concurrently(pipeline, ctxs):
+    """Queue every ctx from its own thread against a NOT-started pipeline,
+    wait until all are queued, then start — one deterministic drain."""
+    results = [None] * len(ctxs)
+
+    def run(i):
+        results[i] = pipeline.execute_partial(ctxs[i], [])
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(ctxs))]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 5
+    while pipeline._q.qsize() < len(ctxs) and time.time() < deadline:
+        time.sleep(0.005)
+    assert pipeline._q.qsize() == len(ctxs)
+    pipeline.start()
+    for t in threads:
+        t.join(timeout=10)
+    return results
+
+
+def test_concurrent_submissions_coalesce_into_one_fetch():
+    fake = FakeMeshExec()
+    pipeline = DeviceQueryPipeline(mesh_exec=fake, start=False)
+    try:
+        ctxs = [{"shape": "A", "literal": i} for i in range(6)]
+        results = _submit_concurrently(pipeline, ctxs)
+        assert results == [(("res", "A", i), {"launch": 1, "n": 6})
+                           for i in range(6)]
+        # six queries, one drain, ONE host fetch for the whole batch
+        assert len(fake.fetch_calls) == 1
+        assert pipeline.batches == 1
+        assert pipeline.stats()["meanBatch"] == 6.0
+    finally:
+        pipeline.stop()
+
+
+def test_timed_out_future_not_dispatched_or_fetched():
+    fake = FakeMeshExec()
+    pipeline = DeviceQueryPipeline(mesh_exec=fake, start=False)
+    try:
+        stale = _Item({"shape": "A", "literal": 0}, [])
+        stale.future.cancel()  # caller timed out while still queued
+        live = _Item({"shape": "A", "literal": 1}, [])
+        pipeline._q.put(stale)
+        pipeline._q.put(live)
+        pipeline.start()
+        assert live.future.result(timeout=10)[0] == ("res", "A", 1)
+        # the cancelled item never reached the executor at all
+        assert fake.prepared == [{"shape": "A", "literal": 1}]
+        assert pipeline.dispatched == 1
+    finally:
+        pipeline.stop()
+
+
+def test_timeout_mid_fetch_skips_decode():
+    fake = FakeMeshExec(fetch_latency=0.5)
+    pipeline = DeviceQueryPipeline(mesh_exec=fake, start=False)
+    try:
+        decoded = []
+        a = _Item({"shape": "A", "literal": 0}, [])
+        b = _Item({"shape": "B", "literal": 1}, [])
+        pipeline._q.put(a)
+        pipeline._q.put(b)
+        pipeline.start()
+        assert fake.fetch_started.wait(timeout=5)
+        a.future.cancel()  # times out while the batched fetch is in flight
+        got_b = b.future.result(timeout=10)
+        assert got_b[0] == ("res", "B", 1)
+        assert a.future.cancelled()
+    finally:
+        pipeline.stop()
+
+
+def test_all_timed_out_launches_never_fetched():
+    fake = FakeMeshExec()
+    pipeline = DeviceQueryPipeline(mesh_exec=fake, start=False)
+    try:
+        a = _Item({"shape": "A", "literal": 0}, [])
+        b = _Item({"shape": "A", "literal": 1}, [])
+        # dispatch on the calling thread (threads not running yet), then
+        # cancel BOTH callers before the fetcher ever sees the entry
+        entry, n = pipeline._dispatch_grouped([a, b], time.perf_counter())
+        assert n == 2 and entry
+        a.future.cancel()
+        b.future.cancel()
+        pipeline._fetchq.put(entry)
+        pipeline.start()
+        time.sleep(0.3)
+        # the dead batch was dropped WITHOUT paying a host round trip
+        assert fake.fetch_calls == []
+    finally:
+        pipeline.stop()
+
+
+def test_shape_keyed_reuse_one_executable_for_n_queries():
+    fake = FakeMeshExec()
+    pipeline = DeviceQueryPipeline(mesh_exec=fake, start=False)
+    try:
+        # five same-shape (different literal), one different shape, one
+        # byte-identical duplicate of the first
+        ctxs = ([{"shape": "A", "literal": i} for i in range(5)]
+                + [{"shape": "B", "literal": 99}]
+                + [{"shape": "A", "literal": 0}])
+        results = _submit_concurrently(pipeline, ctxs)
+        assert all(r is not DEVICE_FALLBACK for r in results)
+        # 7 queries -> 6 dedupe groups -> 2 launches (A stacked, B solo)
+        assert len(fake.launched_keys) == 2
+        assert set(fake.launched_keys) == {("shape", "A"), ("shape", "B")}
+        s = pipeline.stats()
+        assert s["dispatched"] == 7
+        assert s["launches"] == 2
+        assert s["dedupeHits"] == 1
+        assert s["stackedLaunches"] == 1
+        # the duplicate decoded from the SAME launch result as the original
+        assert results[6] == results[0]
+    finally:
+        pipeline.stop()
+
+
+def test_fallback_and_stage_timings():
+    fake = FakeMeshExec()
+    pipeline = DeviceQueryPipeline(mesh_exec=fake, start=False)
+    try:
+        results = _submit_concurrently(
+            pipeline, [{"shape": "A", "literal": 1},
+                       {"shape": "A", "literal": 2, "fallback": True}])
+        assert results[0][0] == ("res", "A", 1)
+        assert results[1] is DEVICE_FALLBACK
+        s = pipeline.stats()
+        assert s["fallbacks"] == 1
+        for stage in ("queue_wait", "dispatch", "fetch", "decode"):
+            assert s["stageMs"][stage]["count"] >= 1, stage
+    finally:
+        pipeline.stop()
+
+
+def test_legacy_executor_without_prepared_api():
+    class LegacyExec:
+        def __init__(self):
+            self.calls = 0
+
+        def dispatch_partial(self, ctx, segments):
+            self.calls += 1
+            if ctx.get("fallback"):
+                return None
+            return {"x": ctx["literal"]}, (lambda outs: ("legacy",
+                                                         outs["x"]))
+
+    legacy = LegacyExec()
+    pipeline = DeviceQueryPipeline(mesh_exec=legacy, start=False)
+    try:
+        results = _submit_concurrently(
+            pipeline, [{"literal": 7}, {"literal": 8, "fallback": True}])
+        assert results[0] == ("legacy", 7)
+        assert results[1] is DEVICE_FALLBACK
+        assert legacy.calls == 2
+    finally:
+        pipeline.stop()
+
+
+def test_smoke_real_executor_mean_batch_gt_one(tmp_path, ssb_schema):
+    """CI smoke (tier-1, CPU mesh): a real QuickCluster + real
+    MeshQueryExecutor under a small concurrent workload MUST batch —
+    meanBatch > 1 or the served path has regressed to one query per
+    round trip."""
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline(start=False)
+    cluster.servers[0].device_pipeline = pipeline
+    rng = np.random.default_rng(11)
+    cfg = TableConfig(ssb_schema.name)
+    cluster.create_table(ssb_schema, cfg)
+    cluster.ingest_columns(cfg, make_ssb_columns(rng, 1500))
+    try:
+        sqls = [("SELECT COUNT(*), SUM(lo_revenue) FROM lineorder "
+                 f"WHERE lo_quantity >= {q}") for q in (5, 15, 25, 35)]
+        results = [None] * len(sqls)
+
+        def run(i):
+            results[i] = cluster.query(sqls[i])
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(sqls))]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 10
+        while pipeline._q.qsize() < len(sqls) and time.time() < deadline:
+            time.sleep(0.01)
+        pipeline.start()
+        for t in threads:
+            t.join(timeout=60)
+        s = pipeline.stats()
+        assert s["dispatched"] == len(sqls)
+        assert s["meanBatch"] > 1, s
+        assert all(r is not None and r.rows for r in results)
+    finally:
+        pipeline.stop()

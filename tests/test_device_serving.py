@@ -282,3 +282,207 @@ def test_upsert_table_bypasses_device(tmp_path):
     assert res.rows[0][1] == sum(range(40, 60))
     assert pipeline.dispatched == d0, "upsert query must not ride the device"
     pipeline.stop()
+
+
+# -- served ORDER-BY-limit via the fused device top-k -----------------------
+
+TOPK_QUERIES = [
+    "SELECT lo_orderkey, lo_revenue FROM lineorder "
+    "WHERE lo_quantity >= 10 ORDER BY lo_revenue DESC LIMIT 7",
+    "SELECT lo_orderkey, lo_extendedprice FROM lineorder "
+    "ORDER BY lo_extendedprice LIMIT 12",
+    # NOTE: ordering by lo_orderdate would fall back by design — yyyymmdd
+    # ints exceed 2^24, past f32's exact-integer range for the score pass
+    "SELECT lo_orderkey, lo_orderdate, lo_revenue FROM lineorder "
+    "WHERE lo_discount BETWEEN 1 AND 3 ORDER BY lo_orderkey LIMIT 9",
+]
+
+
+def _host_answer(cluster, sql):
+    host = cluster.servers[0]
+    saved, host.device_pipeline = host.device_pipeline, None
+    try:
+        return cluster.query(sql)
+    finally:
+        host.device_pipeline = saved
+
+
+@pytest.mark.parametrize("sql", TOPK_QUERIES)
+def test_served_orderby_limit_executes_topk_on_device(device_cluster, sql):
+    """ORDER-BY-limit selections ride the fused filter+top_k kernel through
+    the REAL ServerNode path: dispatched (not fallback) and row-for-row
+    equal to the host reducer (unique random doubles -> no tie ambiguity)."""
+    cluster, pipeline = device_cluster
+    d0, f0 = pipeline.dispatched, pipeline.fallbacks
+    res = cluster.query(sql)
+    assert pipeline.dispatched == d0 + 1, \
+        "ORDER-BY-limit selection did not execute through the device pipeline"
+    assert pipeline.fallbacks == f0, "device top-k fell back to host"
+    want = _host_answer(cluster, sql)
+    assert res.rows == want.rows
+
+
+def test_served_orderby_tie_keys_match_host(tmp_path):
+    """Heavy ties: device and host may break ties differently (both are
+    valid per SQL), but the ordered KEY multiset and row count must agree,
+    and every device row must exist in the table."""
+    schema = Schema("tt", [dimension("id", DataType.LONG),
+                           metric("grade", DataType.INT),
+                           metric("score", DataType.DOUBLE)])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    cfg = TableConfig("tt")
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(5)
+    n = 3000
+    rows = {"id": np.arange(n, dtype=np.int64),
+            "grade": rng.integers(0, 4, n).astype(np.int32),  # 4 values: ties
+            "score": np.round(rng.uniform(0, 100, n), 2)}
+    cluster.ingest_columns(cfg, rows)
+    try:
+        sql = "SELECT id, grade FROM tt ORDER BY grade DESC LIMIT 40"
+        d0 = pipeline.dispatched
+        res = cluster.query(sql)
+        assert pipeline.dispatched == d0 + 1
+        want = _host_answer(cluster, sql)
+        assert len(res.rows) == len(want.rows) == 40
+        assert [r[1] for r in res.rows] == [r[1] for r in want.rows]
+        by_id = dict(zip(rows["id"].tolist(), rows["grade"].tolist()))
+        for rid, rgrade in res.rows:
+            assert by_id[rid] == rgrade
+    finally:
+        pipeline.stop()
+
+
+def test_served_orderby_nan_falls_back_to_host(tmp_path):
+    """NaN order keys poison lax.top_k comparisons: the kernel reports
+    nanMatches and the pipeline resolves DEVICE_FALLBACK — the host reducer
+    (NaN-as-null ordering) answers, and device/host agree by construction."""
+    schema = Schema("nt", [dimension("id", DataType.LONG),
+                           metric("score", DataType.DOUBLE)])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    cfg = TableConfig("nt")
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(6)
+    n = 2000
+    score = np.round(rng.uniform(0, 100, n), 2)
+    score[rng.choice(n, 25, replace=False)] = np.nan
+    cluster.ingest_columns(cfg, {"id": np.arange(n, dtype=np.int64),
+                                 "score": score})
+    try:
+        sql = "SELECT id, score FROM nt ORDER BY score DESC LIMIT 10"
+        f0 = pipeline.fallbacks
+        res = cluster.query(sql)
+        assert pipeline.fallbacks == f0 + 1, \
+            "NaN order keys must force the host fallback"
+        want = _host_answer(cluster, sql)
+        assert res.rows == want.rows
+    finally:
+        pipeline.stop()
+
+
+def test_served_orderby_nulls_parity(tmp_path):
+    """Null cells reach BOTH reducers as the column's null fill (the stored
+    sentinel), so device top-k and host sort place them identically —
+    including under NULLS LAST, which only reorders genuine None keys that
+    the selection path never produces."""
+    schema = Schema("nl", [dimension("id", DataType.LONG),
+                           metric("score", DataType.DOUBLE)])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline()
+    cluster.servers[0].device_pipeline = pipeline
+    cfg = TableConfig("nl")
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(7)
+    n = 1500
+    vals = list(np.round(rng.uniform(1, 100, n), 2))
+    for i in rng.choice(n, 30, replace=False):
+        vals[int(i)] = None  # stored as the DOUBLE metric null fill (0.0)
+    cluster.ingest_columns(cfg, {"id": np.arange(n, dtype=np.int64),
+                                 "score": vals})
+    try:
+        for sql in (
+                "SELECT id, score FROM nl ORDER BY score LIMIT 35",
+                "SELECT id, score FROM nl ORDER BY score ASC NULLS LAST "
+                "LIMIT 35",
+                "SELECT id, score FROM nl ORDER BY score DESC NULLS LAST "
+                "LIMIT 8"):
+            d0, f0 = pipeline.dispatched, pipeline.fallbacks
+            res = cluster.query(sql)
+            assert pipeline.dispatched == d0 + 1, sql
+            assert pipeline.fallbacks == f0, sql
+            want = _host_answer(cluster, sql)
+            assert [r[1] for r in res.rows] == [r[1] for r in want.rows], sql
+    finally:
+        pipeline.stop()
+
+
+def test_served_stacked_same_shape_queries_one_launch(tmp_path, ssb_schema):
+    """N concurrent same-plan-shape aggregations (different literals) share
+    ONE traced executable and ONE stacked kernel launch, with differential
+    correctness per query."""
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    pipeline = DeviceQueryPipeline(start=False)
+    cluster.servers[0].device_pipeline = pipeline
+    rng = np.random.default_rng(13)
+    cfg = TableConfig(ssb_schema.name)
+    cluster.create_table(ssb_schema, cfg)
+    for _ in range(2):
+        cluster.ingest_columns(cfg, make_ssb_columns(rng, 2500))
+    try:
+        thresholds = [5, 12, 24, 36, 12]  # duplicate 12 -> dedupe hit
+        sqls = [("SELECT COUNT(*), SUM(lo_revenue) FROM lineorder "
+                 f"WHERE lo_quantity >= {q}") for q in thresholds]
+        results = [None] * len(sqls)
+
+        def run(i):
+            results[i] = cluster.query(sqls[i])
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(len(sqls))]
+        for t in ts:
+            t.start()
+        import time
+        deadline = time.time() + 10
+        while pipeline._q.qsize() < len(sqls) and time.time() < deadline:
+            time.sleep(0.01)
+        pipeline.start()
+        for t in ts:
+            t.join(timeout=120)
+        s = pipeline.stats()
+        assert s["dispatched"] == len(sqls)
+        assert s["launches"] == 1, s
+        assert s["stackedLaunches"] == 1, s
+        assert s["dedupeHits"] == 1, s
+        host = cluster.servers[0]
+        saved, host.device_pipeline = host.device_pipeline, None
+        try:
+            for i, sql in enumerate(sqls):
+                want = cluster.query(sql)
+                for dr, hr in zip(results[i].rows, want.rows):
+                    for dv, hv in zip(dr, hr):
+                        if isinstance(dv, float):
+                            assert abs(dv - hv) <= 2e-3 * max(1.0, abs(hv))
+                        else:
+                            assert dv == hv
+        finally:
+            host.device_pipeline = saved
+    finally:
+        pipeline.stop()
+
+
+def test_pipeline_stage_histograms_exported(device_cluster):
+    """The stage timings ride the process metrics registry as Prometheus
+    histograms — the /metrics body a scraper sees."""
+    from pinot_tpu.utils.metrics import get_registry
+    cluster, pipeline = device_cluster
+    cluster.query("SELECT COUNT(*) FROM lineorder WHERE lo_quantity >= 2")
+    text = get_registry().render_prometheus()
+    for stage in ("queue_wait", "dispatch", "fetch"):
+        name = f"pinot_server_device_pipeline_{stage}_ms"
+        assert f"# TYPE {name} histogram" in text, name
+        assert f'{name}_bucket{{le="+Inf"}}' in text, name
+    st = pipeline.stats()
+    assert st["stageMs"]["fetch"]["count"] >= 1
