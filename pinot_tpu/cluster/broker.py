@@ -11,11 +11,15 @@ partial results + marked unhealthy (reference: `ConnectionFailureDetector` ->
 
 from __future__ import annotations
 
+import json
+import logging
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
+from ..query import stats as qstats
 from ..query.aggregates import make_agg
 from ..query.context import QueryContext, QueryValidationError, compile_query
 from ..query.reduce import SegmentResult, merge_segment_results, reduce_to_result
@@ -144,6 +148,15 @@ class Broker:
         # of OOMing the broker (None = uncapped; the mailbox shuffle path never
         # buffers inter-stage data here, so it is not subject to the cap)
         self.max_data_plane_bytes: Optional[int] = None
+        # slow-query observability: queries over `broker.slow.query.ms`
+        # (clusterConfig) emit one structured log line and land in this ring,
+        # surfaced with the query rollups on the HTTP /debug endpoint
+        self._recent_slow: "deque" = deque(maxlen=32)
+        self._query_rollup: Dict[str, float] = {
+            "numQueries": 0, "numExceptions": 0, "numSlowQueries": 0,
+            "totalTimeMs": 0.0, "maxTimeMs": 0.0,
+        }
+        self._obs_lock = threading.Lock()
         self._lock = threading.RLock()
         from ..query.scheduler import QueryQuotaManager
         self.quota = QueryQuotaManager(catalog)
@@ -220,12 +233,80 @@ class Broker:
                     result.stats["traceInfo"] = tr.to_rows()
         except Exception:
             reg.counter("pinot_broker_query_exceptions").inc()
+            with self._obs_lock:
+                self._query_rollup["numExceptions"] += 1
             raise
         elapsed_ms = (time.perf_counter() - t0) * 1000
         result.stats["timeUsedMs"] = round(elapsed_ms, 3)
         reg.counter("pinot_broker_queries").inc()
         reg.timer("pinot_broker_query_latency_ms").update(elapsed_ms)
+        self._account_query(sql, result, elapsed_ms)
         return result
+
+    # log channel for queries over the `broker.slow.query.ms` threshold: one
+    # machine-parseable JSON object per slow query (reference: the slow-query
+    # "Processed requestId=..." WARN in BaseSingleStageBrokerRequestHandler)
+    SLOW_QUERY_LOGGER = "pinot_tpu.broker.slow_query"
+
+    def _slow_threshold_ms(self) -> Optional[float]:
+        prop = self.catalog.get_property("clusterConfig/broker.slow.query.ms")
+        try:
+            return float(prop) if prop not in (None, "") else None
+        except (TypeError, ValueError):
+            return None
+
+    def _account_query(self, sql: str, result: ResultTable,
+                       elapsed_ms: float) -> None:
+        """Per-query bookkeeping after a successful response: rollups for
+        /debug, plus the slow-query log when over threshold (exactly one
+        structured line per slow query)."""
+        with self._obs_lock:
+            self._query_rollup["numQueries"] += 1
+            self._query_rollup["totalTimeMs"] += elapsed_ms
+            self._query_rollup["maxTimeMs"] = max(
+                self._query_rollup["maxTimeMs"], elapsed_ms)
+        thr = self._slow_threshold_ms()
+        if thr is None or elapsed_ms <= thr:
+            return
+        entry = {
+            "sql": sql,
+            "timeUsedMs": round(elapsed_ms, 3),
+            "thresholdMs": thr,
+            "brokerId": self.instance_id,
+            "stats": {k: v for k, v in result.stats.items()
+                      if isinstance(v, (int, float, bool, str))},
+        }
+        trace_rows = result.stats.get("traceInfo")
+        if trace_rows:
+            entry["traceSpans"] = trace_rows
+        with self._obs_lock:
+            self._query_rollup["numSlowQueries"] += 1
+            self._recent_slow.append(entry)
+        from ..utils.metrics import get_registry
+        get_registry().counter("pinot_broker_slow_queries").inc()
+        logging.getLogger(self.SLOW_QUERY_LOGGER).warning(
+            json.dumps(entry, default=str))
+
+    def debug_stats(self) -> Dict:
+        """Rollups for the HTTP /debug endpoint: lifetime query counters,
+        broker-scoped registry metrics, and the recent slow-query ring."""
+        from ..utils.metrics import get_registry
+        snap = get_registry().snapshot()
+        with self._obs_lock:
+            rollup = dict(self._query_rollup)
+            recent = list(self._recent_slow)
+        n = rollup["numQueries"]
+        rollup["avgTimeMs"] = round(rollup["totalTimeMs"] / n, 3) if n else 0.0
+        rollup["totalTimeMs"] = round(rollup["totalTimeMs"], 3)
+        rollup["maxTimeMs"] = round(rollup["maxTimeMs"], 3)
+        return {
+            "instanceId": self.instance_id,
+            "queryStats": rollup,
+            "slowQueryThresholdMs": self._slow_threshold_ms(),
+            "recentSlowQueries": recent,
+            "brokerMetrics": {k: v for k, v in sorted(snap.items())
+                              if k.startswith("pinot_broker_")},
+        }
 
     def _rewrite_subqueries(self, stmt):
         """`IN_SUBQUERY(expr, 'inner sql')` -> run the inner query through this
@@ -288,6 +369,8 @@ class Broker:
         schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
         ctx = compile_query(stmt, schema)
 
+        if ctx.analyze:
+            return self._handle_analyze(stmt, ctx, physical, t0)
         if ctx.explain:
             return self._handle_explain(ctx, physical)
 
@@ -311,6 +394,12 @@ class Broker:
                        else list(ctx.group_by))
 
         partials: List[SegmentResult] = []
+        # per-query telemetry record: server partials fold their wire stats in
+        # as they arrive; an EXPLAIN ANALYZE wrapper may have installed one on
+        # this thread already — keep accumulating into it in that case
+        exec_stats = qstats.current_stats()
+        if exec_stats is None:
+            exec_stats = qstats.ExecutionStats()
         servers_queried = servers_failed = 0
         uncovered_segments: List[str] = []
         query_errors: List[Exception] = []
@@ -354,6 +443,7 @@ class Broker:
                 try:
                     partial = fut.result()
                     partials.append(partial)
+                    exec_stats.merge(partial.stats)
                     if partial.served is not None:
                         for seg in set(routing.get(server_id, ())) \
                                 - set(partial.served):
@@ -386,6 +476,8 @@ class Broker:
                 retry_results, retry_failed = self._retry_missing(
                     table, ctx, missing, tf, _traced)
                 partials.extend(r for r, _ in retry_results)
+                for r, _ in retry_results:
+                    exec_stats.merge(r.stats)
                 servers_queried += len(retry_results) + retry_failed
                 servers_failed += retry_failed
                 # coverage audit: a segment can stay unserved even after the
@@ -414,6 +506,11 @@ class Broker:
             _reg().counter("pinot_broker_segments_unavailable").inc(
                 len(uncovered_segments))
             result.stats["segmentsUnavailable"] = uncovered_segments
+        exec_stats.add_operator("COMBINE", rows=merged.num_docs_scanned,
+                                ms=(t_scatter - t_compile) * 1000)
+        exec_stats.add_operator("BROKER_REDUCE", rows=len(result.rows),
+                                ms=(t_reduce - t_scatter) * 1000)
+        result.stats.update(exec_stats.to_public_dict())
         result.stats.update({
             "numServersQueried": servers_queried,
             "numServersResponded": servers_queried - servers_failed,
@@ -615,6 +712,28 @@ class Broker:
             return explain_result(ctx, [])
         return ResultTable(["Operator", "Operator_Id", "Parent_Id"], merged,
                            {"explain": True})
+
+    def _handle_analyze(self, stmt, ctx, physical: List[str],
+                        t0: float) -> ResultTable:
+        """EXPLAIN ANALYZE: run the real query through the normal scatter path
+        with a telemetry record installed on this thread, then annotate the
+        distributed EXPLAIN plan with the per-operator rows/ms it collected.
+        The query genuinely executes (and counts against quota) — the response
+        is the annotated plan, with the full stats record riding alongside."""
+        import dataclasses
+
+        from ..query.explain import ANALYZE_COLUMNS, annotate_plan_rows
+        run_stmt = dataclasses.replace(stmt, explain=False, analyze=False)
+        with qstats.collect_stats() as st:
+            inner = self._handle_single(run_stmt, t0)
+        total_ms = (time.perf_counter() - t0) * 1000
+        plan = self._handle_explain(ctx, physical)
+        rows = annotate_plan_rows(plan.rows, st, len(inner.rows), total_ms)
+        res = ResultTable(list(ANALYZE_COLUMNS), rows, dict(inner.stats))
+        res.stats.update(st.to_public_dict())
+        res.stats["explain"] = True
+        res.stats["analyze"] = True
+        return res
 
     def _explain_multistage(self, stmt) -> ResultTable:
         """EXPLAIN for a JOIN query: describe the stage plan WITHOUT executing

@@ -76,13 +76,17 @@ def _resolve(future: Future, value, exc: Optional[BaseException] = None) -> None
 
 
 class _Item:
-    __slots__ = ("ctx", "segments", "future", "t_enqueue")
+    __slots__ = ("ctx", "segments", "future", "t_enqueue", "stats")
 
     def __init__(self, ctx, segments):
         self.ctx = ctx
         self.segments = segments
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        # per-item launch attribution (queue wait, dedupe/stack flags): the
+        # pipeline threads serve MANY queries per drain, so per-query stats
+        # can't ride thread-locals — they attach to the decoded partial
+        self.stats: dict = {}
 
 
 class DeviceQueryPipeline:
@@ -258,7 +262,9 @@ class DeviceQueryPipeline:
                 # caller already timed out and cancelled: don't burn a
                 # device dispatch on a result nobody will read
                 continue
-            self._observe("queue_wait", (t0 - item.t_enqueue) * 1000)
+            wait_ms = (t0 - item.t_enqueue) * 1000
+            self._observe("queue_wait", wait_ms)
+            item.stats["queueWaitMs"] = round(wait_ms, 3)
             try:
                 p = self.mesh_exec.prepare_partial(item.ctx, item.segments)
             except Exception:
@@ -276,6 +282,7 @@ class DeviceQueryPipeline:
                 rep_groups[dedupe_index[p.dedupe_key]].append(
                     (item, p.decode))
                 self.dedupe_hits += 1
+                item.stats["dedupedLaunches"] = 1
                 continue
             if p.dedupe_key is not None:
                 dedupe_index[p.dedupe_key] = len(reps)
@@ -296,6 +303,13 @@ class DeviceQueryPipeline:
             return [], 0
         self.stacked_launches += sum(1 for _, _, idxs in launches
                                      if len(idxs) > 1)
+        for _, _, idxs in launches:
+            stacked = len(idxs) > 1
+            for i in idxs:
+                for item, _ in rep_groups[i]:
+                    item.stats["deviceLaunches"] = 1
+                    if stacked:
+                        item.stats["stackedLaunches"] = 1
         entry = [(outs_dev, finish, [rep_groups[i] for i in idxs])
                  for outs_dev, finish, idxs in launches]
         return entry, sum(len(g) for g in rep_groups)
@@ -308,7 +322,9 @@ class DeviceQueryPipeline:
         for item in batch:
             if item.future.done():
                 continue
-            self._observe("queue_wait", (t0 - item.t_enqueue) * 1000)
+            wait_ms = (t0 - item.t_enqueue) * 1000
+            self._observe("queue_wait", wait_ms)
+            item.stats["queueWaitMs"] = round(wait_ms, 3)
             try:
                 dp = self.mesh_exec.dispatch_partial(item.ctx, item.segments)
             except Exception:
@@ -317,6 +333,7 @@ class DeviceQueryPipeline:
                 self.fallbacks += 1
                 _resolve(item.future, DEVICE_FALLBACK)
                 continue
+            item.stats["deviceLaunches"] = 1
             entry.append((dp[0], (lambda host: [host]),
                           [[(item, dp[1])]]))
         return entry, len(entry)
@@ -350,15 +367,18 @@ class DeviceQueryPipeline:
                             for item, _ in group:
                                 _resolve(item.future, None, exc=e)
                     continue
-                self._observe("fetch", (time.perf_counter() - t0) * 1000)
+                fetch_ms = (time.perf_counter() - t0) * 1000
+                self._observe("fetch", fetch_ms)
                 t1 = time.perf_counter()
                 for (_, finish, groups), host in zip(live, fetched):
-                    self._decode_launch(finish, groups, host)
+                    self._decode_launch(finish, groups, host,
+                                        fetch_ms=fetch_ms)
                 self._observe("decode", (time.perf_counter() - t1) * 1000)
             finally:
                 self._fetch_busy.clear()
 
-    def _decode_launch(self, finish, groups, host) -> None:
+    def _decode_launch(self, finish, groups, host,
+                       fetch_ms: float = 0.0) -> None:
         try:
             outs_list = finish(host)
         except Exception as e:
@@ -379,6 +399,17 @@ class DeviceQueryPipeline:
                     # the device result is unusable (e.g. NaN order keys,
                     # candidate overflow) — host path decides
                     self.fallbacks += 1
+                elif hasattr(r, "stats"):
+                    # attach this item's launch attribution to its partial
+                    # BEFORE resolving: the query thread folds it into the
+                    # per-query ExecutionStats (the fetcher thread has no
+                    # query-scoped thread-locals to publish into). fetch_ms
+                    # is the batched host sync this result waited on (wall,
+                    # shared by every item in the batch)
+                    s = dict(item.stats)
+                    s["deviceFetchMs"] = round(fetch_ms, 3)
+                    s.update(r.stats or {})
+                    r.stats = s
                 _resolve(item.future, r)
 
     def stats(self) -> dict:

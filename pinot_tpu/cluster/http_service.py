@@ -100,6 +100,17 @@ def error_response(msg: str, status: int = 500) -> Tuple[int, str, bytes]:
     return status, "application/json", json.dumps({"error": msg}).encode()
 
 
+def stats_route(fn: Callable[[], Any]) -> Callable:
+    """Wrap a zero-argument stats provider (e.g. `Broker.debug_stats`) into a
+    GET route handler rendering its dict as JSON — the shared shape of the
+    /debug-style observability endpoints. `default=str` keeps the endpoint
+    alive when a rollup carries a non-JSON value (never worth a 500)."""
+    def handler(parts, params, body):
+        return (200, "application/json",
+                json.dumps(fn(), default=str).encode())
+    return handler
+
+
 class HttpService:
     """A role's HTTP endpoint: register routes, serve on a daemon thread.
 

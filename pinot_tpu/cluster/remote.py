@@ -280,7 +280,7 @@ class RemoteServerHandle:
 
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
-        from ..utils.trace import current_trace
+        from ..utils.trace import current_depth, current_trace
         sql = ctx if isinstance(ctx, str) else ctx.sql
         if not sql:
             raise ValueError("remote dispatch requires the query SQL text")
@@ -296,8 +296,10 @@ class RemoteServerHandle:
         spans = getattr(result, "trace_spans", None)
         if tr is not None and spans:
             # already prefixed server-side with its instance id; rebase the server's
-            # local clock onto this trace's axis at the dispatch point
-            tr.splice(spans, offset_ms=dispatch_ms)
+            # local clock onto this trace's axis at the dispatch point, and nest
+            # its spans one level under the dispatching server:<id> span
+            tr.splice(spans, offset_ms=dispatch_ms,
+                      depth_offset=current_depth())
         return result
 
     def explain(self, table: str, ctx, segment_names: Sequence[str]):

@@ -15,6 +15,7 @@ import os
 import threading
 from typing import Dict, List, Optional, Sequence, Union
 
+from ..query import stats as qstats
 from ..query.aggregates import make_agg
 from ..query.context import QueryContext, compile_query
 from ..parallel.combine import device_topk_screen
@@ -439,6 +440,17 @@ class ServerNode:
 
     def _execute_partial(self, table: str, ctx: QueryContext,
                          segment_names: Optional[Sequence[str]]) -> SegmentResult:
+        # per-query telemetry record for this server-level partial: executor /
+        # kernel hooks on THIS thread publish into it; pipeline-attributed
+        # launch stats arrive attached to the device partial and fold in after
+        with qstats.collect_stats() as st:
+            merged = self._run_partial(table, ctx, segment_names)
+        st.merge(merged.stats)
+        merged.stats = st.to_wire()
+        return merged
+
+    def _run_partial(self, table: str, ctx: QueryContext,
+                     segment_names: Optional[Sequence[str]]) -> SegmentResult:
         import time as _t
 
         from ..utils.metrics import get_registry
@@ -481,6 +493,14 @@ class ServerNode:
                                 {"table": table}).inc()
             if device_partial is not None:
                 results.append(device_partial)
+                # the pipeline's threads can't attribute per-query segment
+                # counts (they serve many queries per launch) — account the
+                # set here, on the query's own thread
+                qstats.record(qstats.NUM_SEGMENTS_QUERIED, len(segments))
+                if (device_partial.num_docs_scanned > 0
+                        or device_partial.groups or device_partial.rows
+                        or device_partial.dense is not None):
+                    qstats.record(qstats.NUM_SEGMENTS_MATCHED, len(segments))
             else:
                 for seg in segments:
                     with span(f"segment:{seg.name}"):

@@ -27,7 +27,7 @@ from .broker import Broker
 from .catalog import Catalog, InstanceInfo
 from .controller import Controller
 from .http_service import (HttpService, binary_response, error_response,
-                           json_response)
+                           json_response, stats_route)
 from .deepstore import untar_segment
 from .remote import RemoteServerHandle
 from .server import ServerNode
@@ -992,6 +992,9 @@ class BrokerService:
         self.http.route("GET", "health",
                         lambda p, q, b: json_response({"status": "OK"}))
         self.http.route("GET", "metrics", _metrics_route)
+        # GET /debug — query rollups + recent slow queries (JSON); the
+        # operator-facing companion to the Prometheus /metrics exposition
+        self.http.route("GET", "debug", stats_route(broker.debug_stats))
         # subscribe BEFORE the initial scan: a server registering in between then
         # fires an event we handle (re-scan), instead of being silently missed
         broker.catalog.subscribe(self._on_event)

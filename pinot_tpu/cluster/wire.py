@@ -221,6 +221,9 @@ def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
         "sortKeys": r.sort_keys,
         "served": r.served,
         "trace": trace_spans,
+        # per-query ExecutionStats counters (telemetry layer); absent/None on
+        # old peers — decode is tolerant both ways
+        "stats": getattr(r, "stats", None),
         # array-form high-card partial: flat ndarrays instead of per-group
         # state lists (reduce.DensePartial); `aggs` is build-side only
         "dense": None if r.dense is None else {
@@ -261,6 +264,8 @@ def decode_segment_result(data: bytes) -> SegmentResult:
                           for v in dd["groupValues"]])
     if d.get("trace"):
         r.trace_spans = d["trace"]  # spliced into the broker's trace by the caller
+    if d.get("stats"):
+        r.stats = d["stats"]  # merged into the broker's ExecutionStats
     return r
 
 

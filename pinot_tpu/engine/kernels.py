@@ -37,6 +37,7 @@ the measured foundation for future hand-scheduled integration.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -44,9 +45,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..query import stats as qstats
 from ..query.aggregates import AggFunc
 from ..query.predicate import CmpLeaf, DocSetLeaf, FilterProgram, LutLeaf, NullLeaf
 from ..sql.ast import Identifier
+from ..utils.metrics import get_registry
 from .calibrate import get_caps
 from .expr import eval_expr
 
@@ -159,6 +162,76 @@ _KERNEL_CACHE: Dict[Tuple, Any] = {}
 
 def kernel_cache_size() -> int:
     return len(_KERNEL_CACHE)
+
+
+def _block_tree(out):
+    """Fence: wait until every leaf of a device output tree is ready."""
+    fence = getattr(jax, "block_until_ready", None)
+    if fence is not None:
+        return fence(out)
+    for leaf in jax.tree_util.tree_leaves(out):  # jax < 0.4 compat
+        getattr(leaf, "block_until_ready", lambda: None)()
+    return out
+
+
+def _fence_first_call(fn):
+    """jax.jit is LAZY — trace + compile happen at the first invocation. Fence
+    that call with block_until_ready so its wall time (trace + compile + first
+    run) lands in the compile histogram / per-query `compileMs` instead of
+    silently inflating whichever query hits the cold cache; every invocation
+    counts one device launch."""
+    state = {"cold": True}
+
+    def call(*args, **kwargs):
+        qstats.record(qstats.DEVICE_LAUNCHES)
+        get_registry().counter("pinot_kernel_launches").inc()
+        if state["cold"]:
+            state["cold"] = False
+            t0 = time.perf_counter()
+            out = _block_tree(fn(*args, **kwargs))
+            ms = (time.perf_counter() - t0) * 1000
+            get_registry().histogram("pinot_kernel_compile_ms").observe(ms)
+            qstats.record(qstats.COMPILE_MS, ms)
+            return out
+        return fn(*args, **kwargs)
+
+    return call
+
+
+def _cached_kernel(key: Tuple, build) -> Any:
+    """Single gate for the compiled-kernel cache: counts hits/misses into the
+    process registry AND the active per-query ExecutionStats, and wraps fresh
+    entries with the first-call compile fence."""
+    fn = _KERNEL_CACHE.get(key)
+    if fn is None:
+        qstats.record(qstats.COMPILE_CACHE_MISSES)
+        get_registry().counter("pinot_kernel_cache_misses").inc()
+        fn = _fence_first_call(build())
+        _KERNEL_CACHE[key] = fn
+    else:
+        qstats.record(qstats.COMPILE_CACHE_HITS)
+        get_registry().counter("pinot_kernel_cache_hits").inc()
+    return fn
+
+
+def fetch_outputs(outs_dev):
+    """`jax.device_get` with execution accounting: dispatch is async, so the
+    wall spent blocking HERE is the kernel's device-exec + transfer time —
+    observed into the exec histogram and the per-query `deviceExecMs` /
+    `bytesFetched`."""
+    t0 = time.perf_counter()
+    out = jax.device_get(outs_dev)
+    ms = (time.perf_counter() - t0) * 1000
+    get_registry().histogram("pinot_kernel_exec_ms").observe(ms)
+    qstats.record(qstats.DEVICE_EXEC_MS, ms)
+    qstats.record(qstats.BYTES_FETCHED, tree_bytes(out))
+    return out
+
+
+def tree_bytes(tree) -> int:
+    """Total host bytes of a fetched output tree."""
+    return sum(int(np.asarray(leaf).nbytes)
+               for leaf in jax.tree_util.tree_leaves(tree))
 
 
 def _make_mask_fn(spec: KernelSpec):
@@ -682,12 +755,7 @@ def _build_kernel(spec: KernelSpec):
 
 
 def get_kernel(spec: KernelSpec):
-    key = spec.signature()
-    fn = _KERNEL_CACHE.get(key)
-    if fn is None:
-        fn = _build_kernel(spec)
-        _KERNEL_CACHE[key] = fn
-    return fn
+    return _cached_kernel(spec.signature(), lambda: _build_kernel(spec))
 
 
 def dispatch_kernel(spec: KernelSpec, inputs: KernelInputs):
@@ -704,21 +772,24 @@ def dispatch_kernel(spec: KernelSpec, inputs: KernelInputs):
 def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
     # device_get, never np.asarray: asarray takes the synchronous per-leaf literal
     # path on the relay (~7x slower than one batched device_get round trip)
-    return jax.device_get(dispatch_kernel(spec, inputs))
+    return fetch_outputs(dispatch_kernel(spec, inputs))
 
 
 def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
     """Filter-only kernel for selection queries: returns the boolean match mask."""
     key = ("mask", spec.filter.signature(), spec.padded_rows)
-    fn = _KERNEL_CACHE.get(key)
-    if fn is None:
+
+    def build():
         mask_fn = _make_mask_fn(spec)
-        fn = jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid, docsets:
-                     mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets))
-        _KERNEL_CACHE[key] = fn
+        return jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid,
+                       docsets:
+                       mask_fn(ids, vals, luts, iscal, fscal, nulls, valid,
+                               docsets))
+
+    fn = _cached_kernel(key, build)
     out = fn(inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
              inputs.nulls, inputs.valid, inputs.docsets)
-    return jax.device_get(out)
+    return fetch_outputs(out)
 
 
 def topk_kernel(spec: KernelSpec, order_expr, desc: bool, k: int,
@@ -739,8 +810,8 @@ def topk_kernel(spec: KernelSpec, order_expr, desc: bool, k: int,
     k = min(k, total_rows if total_rows is not None else spec.padded_rows)
     key = ("topk", spec.filter.signature(), repr(order_expr), desc, k,
            spec.padded_rows, total_rows)
-    fn = _KERNEL_CACHE.get(key)
-    if fn is None:
+
+    def build():
         mask_fn = _make_mask_fn(spec)
 
         def body(ids, vals, luts, iscal, fscal, nulls, valid, docsets):
@@ -757,9 +828,9 @@ def topk_kernel(spec: KernelSpec, order_expr, desc: bool, k: int,
                     "ok": usable[idx],
                     "nanMatches": (mask & nan).sum(dtype=jnp.int32)}
 
-        fn = jax.jit(body)
-        _KERNEL_CACHE[key] = fn
-    return fn, k
+        return jax.jit(body)
+
+    return _cached_kernel(key, build), k
 
 
 def compute_topk(spec: KernelSpec, inputs: KernelInputs, order_expr,
@@ -776,9 +847,9 @@ def compute_topk(spec: KernelSpec, inputs: KernelInputs, order_expr,
     ties); final ordering is exact.
     """
     fn, _ = topk_kernel(spec, order_expr, desc, k)
-    outs = jax.device_get(fn(inputs.ids, inputs.vals, inputs.luts,
-                             inputs.iscal, inputs.fscal, inputs.nulls,
-                             inputs.valid, inputs.docsets))
+    outs = fetch_outputs(fn(inputs.ids, inputs.vals, inputs.luts,
+                            inputs.iscal, inputs.fscal, inputs.nulls,
+                            inputs.valid, inputs.docsets))
     return (np.asarray(outs["idx"]), int(outs["count"]),
             np.asarray(outs["ok"]))
 

@@ -26,6 +26,7 @@ path; unaligned or mutable sets with those shapes keep the per-segment fallback.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -36,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..engine.datablock import lut_size, padded_rows
-from ..engine.kernels import KernelSpec
+from ..engine.kernels import KernelSpec, _fence_first_call, tree_bytes
+from ..query import stats as qstats
 from ..query.aggregates import make_agg
 from ..query.context import QueryContext, compile_query
 from ..query.executor import ServerQueryExecutor
@@ -46,6 +48,7 @@ from ..query.reduce import merge_segment_results, reduce_to_result
 from ..query.result import ResultTable
 from ..segment.reader import ImmutableSegment
 from ..sql.ast import Expr, Function, Identifier, identifiers_in
+from ..utils.metrics import get_registry
 from .merged import MergedSegmentView, view_key
 from .mesh import SEGMENT_AXIS, default_mesh
 
@@ -492,7 +495,7 @@ class MeshQueryExecutor:
     # ------------------------------------------------------------------
     def _execute_sharded(self, ctx: QueryContext, plan, segments, view=None) -> ResultTable:
         outs_dev, decode = self._dispatch_sharded(ctx, plan, segments, view)
-        return decode(jax.device_get(outs_dev))  # one host sync for all partials
+        return decode(self.fetch(outs_dev))  # one host sync for all partials
 
     def execute_many(self, segments: Sequence[ImmutableSegment],
                      queries: Sequence[Union[str, QueryContext]],
@@ -521,7 +524,7 @@ class MeshQueryExecutor:
                     pending.append((qi, outs_dev, decode))
                 except DocsetPlanDivergence:
                     pending.append((qi, self._fallback.execute(segments, ctx)))
-        fetched = jax.device_get([p[1] for p in pending if len(p) == 3])
+        fetched = self.fetch([p[1] for p in pending if len(p) == 3])
         results: List[Optional[ResultTable]] = [None] * len(queries)
         it = iter(fetched)
         for p in pending:
@@ -582,8 +585,15 @@ class MeshQueryExecutor:
 
     def fetch(self, trees):
         """One host sync for a batch of dispatched output trees (the
-        pipeline's fetch hook; fakes in tests override this)."""
-        return jax.device_get(trees)
+        pipeline's fetch hook; fakes in tests override this). The wall spent
+        blocking here is the batch's device-exec + transfer time."""
+        t0 = time.perf_counter()
+        out = jax.device_get(trees)
+        ms = (time.perf_counter() - t0) * 1000
+        get_registry().histogram("pinot_mesh_fetch_ms").observe(ms)
+        qstats.record(qstats.DEVICE_FETCH_MS, ms)
+        qstats.record(qstats.BYTES_FETCHED, tree_bytes(out))
+        return out
 
     def dispatch_prepared(self, reps: Sequence[PreparedDispatch]):
         """Launch a deduped batch of prepared dispatches.
@@ -1050,8 +1060,16 @@ class MeshQueryExecutor:
                      id(self.mesh), batch)
         fn = _SHARD_KERNEL_CACHE.get(cache_key)
         if fn is None:
-            fn = self._build_shard_kernel(spec, batch)
+            qstats.record(qstats.COMPILE_CACHE_MISSES)
+            get_registry().counter("pinot_kernel_cache_misses").inc()
+            # same first-call compile fence as the single-device cache: the
+            # cold call's wall (trace + compile + first run) lands in the
+            # compile histogram, not in whichever query drew the short straw
+            fn = _fence_first_call(self._build_shard_kernel(spec, batch))
             _SHARD_KERNEL_CACHE[cache_key] = fn
+        else:
+            qstats.record(qstats.COMPILE_CACHE_HITS)
+            get_registry().counter("pinot_kernel_cache_hits").inc()
         return fn
 
     def _build_shard_kernel(self, spec: KernelSpec, batch: int = 0):

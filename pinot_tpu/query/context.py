@@ -51,6 +51,7 @@ class QueryContext:
     gapfill: Optional[GapfillSpec] = None
     sql: str = ""   # original SQL text; the HTTP transport re-compiles server-side
     explain: bool = False
+    analyze: bool = False  # EXPLAIN ANALYZE: execute, then annotate the plan
 
     @property
     def is_aggregation_query(self) -> bool:
@@ -168,6 +169,7 @@ def compile_query(sql_or_stmt, schema: Optional[Schema] = None) -> QueryContext:
         distinct=stmt.distinct,
         options=dict(stmt.options),
         explain=stmt.explain,
+        analyze=stmt.analyze,
         gapfill=gapfill,
         sql=stmt.raw or (sql_or_stmt if isinstance(sql_or_stmt, str) else ""),
     )

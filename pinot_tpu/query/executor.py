@@ -9,18 +9,27 @@ single-process tests — run the broker reduce too (`reduce.reduce_to_result`).
 
 from __future__ import annotations
 
+import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from ..segment.reader import ImmutableSegment
 from ..sql.ast import Expr, Function, Identifier, identifiers_in
+from . import stats as qstats
 from .aggregates import AggFunc, make_agg
 from .context import QueryContext, compile_query
 from .planner import SegmentPlan, build_device_geometry, plan_segment
 from .predicate import CmpLeaf, DocSetLeaf, LutLeaf, NullLeaf
 from .reduce import DensePartial, SegmentResult, merge_segment_results, reduce_to_result
 from .result import ResultTable
+
+#: per-segment plan kind -> the explain-plan label family it annotates in
+#: EXPLAIN ANALYZE (prefix-matched against plan-node labels)
+_PLAN_OP_LABELS = {"empty": "PRUNED", "metadata": "METADATA_ONLY_AGGREGATE",
+                   "selection": "SELECT", "device": "DEVICE_FUSED",
+                   "host": "HOST"}
 
 #: below this dense-key-space size the classic dict partial is cheap enough
 #: that the array form only adds wire weight (it ships full dictionaries)
@@ -40,9 +49,17 @@ class ServerQueryExecutor:
         t0 = _t.perf_counter()
         ctx = compile_query(query, schema or (segments[0].schema if segments else None)) \
             if isinstance(query, str) else query
+        if ctx.analyze:
+            return self._execute_analyze(segments, ctx)
         if ctx.explain:
             from .explain import explain_result
             return explain_result(ctx, segments)
+        if qstats.current_stats() is None:
+            # single-process entry (no server wrapper installed a record):
+            # collect here so the engine API surfaces the same stats block
+            # as the broker path does
+            with qstats.collect_stats():
+                return self.execute(segments, ctx)
         aggs = [make_agg(f) for f in ctx.aggregations]
         group_exprs = ([e for e, _ in ctx.select_items] if ctx.distinct
                        else list(ctx.group_by))
@@ -61,7 +78,29 @@ class ServerQueryExecutor:
             "scan": round((t_scan - t_compile) * 1000, 3),
             "reduce": round((_t.perf_counter() - t_scan) * 1000, 3),
         }
+        # per-operator rollups for EXPLAIN ANALYZE (no-op without a record)
+        qstats.record_operator("COMBINE", rows=merged.num_docs_scanned,
+                               ms=(t_scan - t_compile) * 1000)
+        qstats.record_operator("BROKER_REDUCE", rows=len(result.rows),
+                               ms=(_t.perf_counter() - t_scan) * 1000)
+        st = qstats.current_stats()
+        if st is not None:
+            result.stats.update(st.to_public_dict())
         return result
+
+    def _execute_analyze(self, segments: Sequence[ImmutableSegment],
+                         ctx: QueryContext) -> ResultTable:
+        """EXPLAIN ANALYZE (single-process path): run the real query with a
+        fresh stats record, then render the plan tree annotated with each
+        node's rows/ms (reference: postgres-style EXPLAIN ANALYZE; the
+        reference engine has no direct analog)."""
+        from .explain import analyze_result
+        run_ctx = dataclasses.replace(ctx, explain=False, analyze=False)
+        t0 = time.perf_counter()
+        with qstats.collect_stats() as st:
+            inner = self.execute(segments, run_ctx)
+        total_ms = (time.perf_counter() - t0) * 1000
+        return analyze_result(ctx, segments, st, inner, total_ms)
 
     # -- per-segment execution --------------------------------------------
     def execute_segment(self, ctx: QueryContext, segment: ImmutableSegment,
@@ -82,16 +121,32 @@ class ServerQueryExecutor:
         if not self.use_device and plan.kind == "device":
             plan.kind = "host"
             plan.fallback_reason = "device disabled"
+        t0 = time.perf_counter()
         with span(f"exec:{plan.kind}"):
             if plan.kind == "empty":
-                return self._empty_result(plan)
-            if plan.kind == "metadata":
-                return self._metadata_result(plan)
-            if plan.kind == "selection":
-                return self._selection(plan)
-            if plan.kind == "device":
-                return self._device_aggregate(plan)
-            return self._host_aggregate(plan)
+                r = self._empty_result(plan)
+            elif plan.kind == "metadata":
+                r = self._metadata_result(plan)
+            elif plan.kind == "selection":
+                r = self._selection(plan)
+            elif plan.kind == "device":
+                r = self._device_aggregate(plan)
+            else:
+                r = self._host_aggregate(plan)
+        st = qstats.current_stats()
+        if st is not None:
+            ms = (time.perf_counter() - t0) * 1000
+            if plan.kind == "empty":
+                st.add(qstats.NUM_SEGMENTS_PRUNED)
+            else:
+                st.add(qstats.NUM_SEGMENTS_QUERIED)
+                if (r.num_docs_scanned > 0 or r.groups or r.rows
+                        or r.dense is not None or plan.kind == "metadata"):
+                    st.add(qstats.NUM_SEGMENTS_MATCHED)
+            st.add_operator("SEGMENT_PLAN", rows=r.num_docs_scanned, ms=ms)
+            st.add_operator(_PLAN_OP_LABELS[plan.kind],
+                            rows=r.num_docs_scanned, ms=ms)
+        return r
 
     # ------------------------------------------------------------------
     def _result_kind(self, plan: SegmentPlan) -> str:

@@ -163,6 +163,54 @@ def explain_result(ctx: QueryContext, segments: Sequence[Any],
                        {"explain": True})
 
 
+ANALYZE_COLUMNS = ["Operator", "Operator_Id", "Parent_Id", "Rows", "Ms"]
+
+
+def annotate_plan_rows(plan_rows: Sequence[Sequence[Any]], stats,
+                       result_rows: int, total_ms: float) -> List[List[Any]]:
+    """Extend 3-column EXPLAIN rows with [Rows, Ms] from the executed query's
+    ExecutionStats per-operator rollups. Labels prefix-match longest-first:
+    "DEVICE_FUSED" annotates DEVICE_FUSED_GROUP_BY(...), "SELECT" annotates
+    SELECT_ORDERBY(...), "SEGMENT_PLAN" its wrapper, etc. The root row always
+    carries the result row count and total wall time."""
+    ops = stats.operators()
+    keys = sorted(ops, key=len, reverse=True)
+
+    def annotate(label: str) -> Tuple[Any, Any]:
+        for k in keys:
+            if label.startswith(k):
+                op = ops[k]
+                return int(op.get("rows", 0)), round(float(op.get("ms", 0)), 3)
+        return None, None
+
+    rows = []
+    for label, my_id, parent_id in plan_rows:
+        r, ms = annotate(label)
+        if my_id == 0:
+            r = result_rows if r is None else r
+            ms = round(total_ms, 3)
+        rows.append([label, my_id, parent_id, r, ms])
+    return rows
+
+
+def analyze_result(ctx: QueryContext, segments: Sequence[Any], stats,
+                   inner: ResultTable, total_ms: float,
+                   broker_prefix: Optional[List[str]] = None,
+                   table: Optional[str] = None) -> ResultTable:
+    """EXPLAIN ANALYZE response: the same operator tree as EXPLAIN, with two
+    extra columns [Rows, Ms] filled from the executed query's ExecutionStats
+    per-operator rollups. `inner` is the already-executed query's ResultTable;
+    its stats ride along so the response carries the full telemetry record."""
+    base = explain_result(ctx, segments, broker_prefix=broker_prefix,
+                          table=table)
+    rows = annotate_plan_rows(base.rows, stats, len(inner.rows), total_ms)
+    res = ResultTable(list(ANALYZE_COLUMNS), rows, dict(inner.stats))
+    res.stats.update(stats.to_public_dict())
+    res.stats["explain"] = True
+    res.stats["analyze"] = True
+    return res
+
+
 def _default_prefix(ctx: QueryContext) -> List[str]:
     parts = []
     if ctx.order_by:

@@ -75,6 +75,10 @@ class SegmentResult:
     # high-cardinality array-form partial; when set, `groups` is EMPTY until
     # `materialize_dense` converts (consumers that need the dict form call it)
     dense: Optional[DensePartial] = None
+    # per-query ExecutionStats counters accumulated producing this partial
+    # (flat summable dict — see query/stats.py); rides the wire and merges
+    # into the broker's record
+    stats: Optional[Dict[str, float]] = None
 
     def materialize_dense(self, aggs: Optional[List[AggFunc]] = None) -> None:
         """Convert the array-form partial into the classic state dict (for
@@ -111,6 +115,11 @@ def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> 
     kind = results[0].kind
     out = SegmentResult(kind)
     out.num_docs_scanned = sum(r.num_docs_scanned for r in results)
+    merged_stats: Dict[str, float] = {}
+    for r in results:
+        for k, v in (r.stats or {}).items():
+            merged_stats[k] = merged_stats.get(k, 0) + v
+    out.stats = merged_stats or None  # set BEFORE the dense early return
     if kind == "groups":
         denses = [r.dense for r in results]
         if all(d is not None for d in denses) and \

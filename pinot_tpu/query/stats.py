@@ -1,0 +1,184 @@
+"""Typed per-query execution statistics (the tentpole of the telemetry layer).
+
+Reference pattern: the reference's BrokerResponseNative metadata block
+(numDocsScanned, numSegmentsQueried/Processed/Matched, numServersResponded,
+timeUsedMs) plus ServerQueryPhase/BrokerQueryPhase timers — but carried as ONE
+typed record created per request and threaded through
+scatter -> server -> executor/pipeline -> partial -> wire -> combine -> reduce,
+then merged back into `QueryResult.stats` under well-known keys.
+
+Accounting sites publish through a thread-local "current stats" slot (same
+pattern as `utils.trace`): the server activates a fresh record on its
+execution thread, kernel/launch/fetch hooks `record()` into whatever record is
+active (a no-op when none is — e.g. pipeline dispatcher threads serving many
+queries at once, which attribute per-item launch stats explicitly instead),
+and the record rides `SegmentResult.stats` back across the wire as a flat
+summable dict. Per-operator rows/ms breakdowns (EXPLAIN ANALYZE) flatten into
+the same dict under `op:<label>:rows` / `op:<label>:ms` keys so one merge rule
+covers everything; the public export strips them.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+# -- well-known stats keys ---------------------------------------------------
+# Every key the executor/broker can emit into `QueryResult.stats`, with the
+# operator-facing meaning. README's "Observability" glossary and the tier-1
+# drift-guard test are checked against THIS table: add a key here (and to
+# README) before emitting it.
+NUM_SEGMENTS_QUERIED = "numSegmentsQueried"
+NUM_SEGMENTS_PRUNED = "numSegmentsPruned"
+NUM_SEGMENTS_MATCHED = "numSegmentsMatched"
+NUM_DOCS_SCANNED = "numDocsScanned"
+DEVICE_LAUNCHES = "deviceLaunches"
+COMPILE_CACHE_HITS = "compileCacheHits"
+COMPILE_CACHE_MISSES = "compileCacheMisses"
+COMPILE_MS = "compileMs"
+DEVICE_EXEC_MS = "deviceExecMs"
+DEVICE_FETCH_MS = "deviceFetchMs"
+BYTES_FETCHED = "bytesFetched"
+QUEUE_WAIT_MS = "queueWaitMs"
+DEDUPED_LAUNCHES = "dedupedLaunches"
+STACKED_LAUNCHES = "stackedLaunches"
+
+# merged-counter keys always present in a query response (0 when the path
+# never ran); `*Ms` keys round to 3 decimals on export
+COUNTER_KEYS = (
+    NUM_SEGMENTS_QUERIED, NUM_SEGMENTS_PRUNED, NUM_SEGMENTS_MATCHED,
+    DEVICE_LAUNCHES, COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
+    COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
+    QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
+)
+
+# broker-level keys that live beside the merged counters in QueryResult.stats
+# (listed so the glossary drift guard covers the full emitted surface)
+BROKER_KEYS = (
+    "timeUsedMs", NUM_DOCS_SCANNED, "numGroupsTotal", "numServersQueried",
+    "numServersResponded", "partialResult", "phaseTimesMs", "traceInfo",
+    "gapfilled", "explain", "analyze",
+)
+
+_OP_PREFIX = "op:"
+
+
+def op_key(label: str, field: str) -> str:
+    return f"{_OP_PREFIX}{label}:{field}"
+
+
+class ExecutionStats:
+    """One query's execution accounting: a flat dict of summable counters
+    (plus flattened per-operator entries consumed by EXPLAIN ANALYZE)."""
+
+    __slots__ = ("counters", "_lock")
+
+    def __init__(self, counters: Optional[Dict[str, float]] = None):
+        self.counters: Dict[str, float] = dict(counters or {})
+        self._lock = threading.Lock()
+
+    def add(self, key: str, n: float = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def add_operator(self, label: str, rows: float = 0, ms: float = 0.0) -> None:
+        with self._lock:
+            rk, mk = op_key(label, "rows"), op_key(label, "ms")
+            self.counters[rk] = self.counters.get(rk, 0) + rows
+            self.counters[mk] = self.counters.get(mk, 0) + ms
+
+    def merge(self, other) -> None:
+        """Fold another record (ExecutionStats or its flat dict form) into
+        this one: every numeric key sums."""
+        if other is None:
+            return
+        src = other.counters if isinstance(other, ExecutionStats) else other
+        if isinstance(other, ExecutionStats):
+            with other._lock:
+                src = dict(src)
+        with self._lock:
+            for k, v in src.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self.counters[k] = self.counters.get(k, 0) + v
+
+    def operators(self) -> Dict[str, Dict[str, float]]:
+        """Reassemble the per-operator breakdown: label -> {rows, ms}."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for k, v in self.counters.items():
+                if not k.startswith(_OP_PREFIX):
+                    continue
+                label, _, fld = k[len(_OP_PREFIX):].rpartition(":")
+                out.setdefault(label, {"rows": 0, "ms": 0.0})[fld] = v
+        return out
+
+    def to_wire(self) -> Dict[str, float]:
+        """Flat dict for `SegmentResult.stats` (keeps op:* entries)."""
+        with self._lock:
+            return dict(self.counters)
+
+    def to_public_dict(self) -> Dict[str, object]:
+        """Export for `QueryResult.stats`: every well-known counter (0 when
+        untouched), ints for counts, rounded floats for `*Ms`; internal op:*
+        breakdowns stay off the response (EXPLAIN ANALYZE consumes them)."""
+        with self._lock:
+            out: Dict[str, object] = {}
+            for k in COUNTER_KEYS:
+                v = float(self.counters.get(k, 0))
+                out[k] = round(v, 3) if k.endswith("Ms") else int(v)
+            for k, v in self.counters.items():
+                if k not in out and not k.startswith(_OP_PREFIX):
+                    out[k] = (round(float(v), 3) if k.endswith("Ms")
+                              else int(v))
+            return out
+
+
+# -- thread-local current record (mirrors utils.trace's _local pattern) ------
+
+_local = threading.local()
+
+
+def current_stats() -> Optional[ExecutionStats]:
+    return getattr(_local, "stats", None)
+
+
+def record(key: str, n: float = 1) -> None:
+    """Accounting hook for hot paths: add to the active record if any.
+    Deliberately tolerant — kernel/fetch sites run on threads that may serve
+    many queries (pipeline dispatcher) or none (warmup/calibration), where
+    per-query attribution happens elsewhere or not at all."""
+    st = getattr(_local, "stats", None)
+    if st is not None:
+        st.add(key, n)
+
+
+def record_operator(label: str, rows: float = 0, ms: float = 0.0) -> None:
+    st = getattr(_local, "stats", None)
+    if st is not None:
+        st.add_operator(label, rows=rows, ms=ms)
+
+
+@contextmanager
+def collect_stats(st: Optional[ExecutionStats] = None
+                  ) -> Iterator[ExecutionStats]:
+    """Install a (fresh) record as this thread's active stats for the scope."""
+    st = st if st is not None else ExecutionStats()
+    prev = getattr(_local, "stats", None)
+    _local.stats = st
+    try:
+        yield st
+    finally:
+        _local.stats = prev
+
+
+@contextmanager
+def activate(st: ExecutionStats) -> Iterator[ExecutionStats]:
+    """Re-install an existing record on a worker thread (scheduler slots,
+    scatter pool) — the stats analog of `Trace.activate`."""
+    prev = getattr(_local, "stats", None)
+    _local.stats = st
+    try:
+        yield st
+    finally:
+        _local.stats = prev

@@ -143,6 +143,7 @@ class QueryStatement:
     options: dict = field(default_factory=dict)  # SQL `SET key=value;` / OPTION(...)
     raw: str = ""    # original SQL text (shipped to remote servers by the transport)
     explain: bool = False  # EXPLAIN PLAN FOR prefix (reference: SqlKind.EXPLAIN)
+    analyze: bool = False  # EXPLAIN ANALYZE prefix: run the query, annotate the plan
 
 
 # -- SQL unparser ------------------------------------------------------------

@@ -78,14 +78,19 @@ class Parser:
             q.options[key] = self._literal_token_value()
             self.accept_op(";")
 
-        # EXPLAIN/PLAN/FOR are CONTEXTUAL: only the statement-leading "EXPLAIN
-        # PLAN FOR" sequence is special, so columns/tables named plan/for/explain
-        # keep working (reference: Calcite treats EXPLAIN as a statement prefix)
+        # EXPLAIN/PLAN/FOR/ANALYZE are CONTEXTUAL: only the statement-leading
+        # "EXPLAIN PLAN FOR" / "EXPLAIN ANALYZE" sequences are special, so
+        # columns/tables named plan/for/explain/analyze keep working
+        # (reference: Calcite treats EXPLAIN as a statement prefix)
         if self._accept_ident_word("EXPLAIN"):
-            if not (self._accept_ident_word("PLAN")
+            if self._accept_ident_word("ANALYZE"):
+                q.explain = True
+                q.analyze = True
+            elif (self._accept_ident_word("PLAN")
                     and self._accept_ident_word("FOR")):
-                raise SqlSyntaxError("expected PLAN FOR after EXPLAIN")
-            q.explain = True
+                q.explain = True
+            else:
+                raise SqlSyntaxError("expected PLAN FOR or ANALYZE after EXPLAIN")
         self.expect_keyword("SELECT")
         q.distinct = self.accept_keyword("DISTINCT")
         q.select = self._select_list()

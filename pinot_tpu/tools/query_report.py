@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Pretty-print one query's ExecutionStats as a phase waterfall.
+
+Feed it the JSON a query response carries (the broker's `stats` block, a full
+HTTP response body, or a slow-query log line — all three shapes are accepted):
+
+    python tools/query_report.py response.json
+    curl -s broker:8099/query -d '{"sql": "..."}' | python tools/query_report.py
+
+Output: a wall-clock waterfall of the broker phases (compile / scatter /
+reduce), the device-time breakdown inside the scatter window (compile, exec,
+fetch, queue wait), and the scan/cache counters — everything an operator needs
+to see WHERE a slow query spent its time without attaching a profiler.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+BAR_WIDTH = 40
+
+
+def _extract_stats(doc: Any) -> Dict[str, Any]:
+    """Accept a bare stats dict, a response body with a 'stats' block, or a
+    slow-query log entry ('stats' + 'sql')."""
+    if not isinstance(doc, dict):
+        raise ValueError("expected a JSON object")
+    if isinstance(doc.get("stats"), dict):
+        inner = dict(doc["stats"])
+        for k in ("sql", "timeUsedMs", "thresholdMs"):
+            if k in doc and k not in inner:
+                inner[k] = doc[k]
+        return inner
+    return doc
+
+
+def _bar(ms: float, total: float) -> str:
+    if total <= 0:
+        return ""
+    n = int(round(BAR_WIDTH * ms / total))
+    return "#" * max(n, 1 if ms > 0 else 0)
+
+
+def _fmt_ms(v: Any) -> str:
+    try:
+        return f"{float(v):10.3f} ms"
+    except (TypeError, ValueError):
+        return f"{v!s:>10}"
+
+
+def render_report(stats: Dict[str, Any]) -> str:
+    """The report body as a string (the CLI prints it; tests assert on it)."""
+    out: List[str] = []
+    sql = stats.get("sql")
+    if sql:
+        out.append(f"query: {sql}")
+    total = float(stats.get("timeUsedMs") or 0.0)
+    phases = stats.get("phaseTimesMs") or {}
+    out.append(f"total wall time: {total:.3f} ms")
+    out.append("")
+    out.append("phase waterfall (broker wall clock)")
+    scale = total or sum(float(v) for v in phases.values()) or 1.0
+    for name in ("compile", "scatter", "reduce"):
+        if name not in phases:
+            continue
+        ms = float(phases[name])
+        out.append(f"  {name:<10} {_fmt_ms(ms)}  |{_bar(ms, scale):<{BAR_WIDTH}}|")
+    accounted = sum(float(v) for v in phases.values())
+    if total and phases:
+        out.append(f"  {'other':<10} {_fmt_ms(max(total - accounted, 0.0))}")
+    out.append("")
+    out.append("device time (inside scatter, summed over servers)")
+    for key, label in (("compileMs", "jit compile"),
+                       ("deviceExecMs", "device exec"),
+                       ("deviceFetchMs", "device fetch"),
+                       ("queueWaitMs", "queue wait")):
+        if key in stats:
+            out.append(f"  {label:<12} {_fmt_ms(stats.get(key, 0))}")
+    out.append("")
+    out.append("counters")
+    for key in ("numSegmentsQueried", "numSegmentsPruned", "numSegmentsMatched",
+                "numDocsScanned", "numGroupsTotal", "deviceLaunches",
+                "dedupedLaunches", "stackedLaunches", "compileCacheHits",
+                "compileCacheMisses", "bytesFetched", "numServersQueried",
+                "numServersResponded"):
+        if key in stats:
+            out.append(f"  {key:<20} {stats[key]}")
+    if stats.get("partialResult"):
+        out.append("  ** PARTIAL RESULT — some servers/segments missing **")
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) > 1 and argv[1] not in ("-", "-h", "--help"):
+        with open(argv[1]) as f:
+            doc = json.load(f)
+    elif len(argv) > 1 and argv[1] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    else:
+        doc = json.load(sys.stdin)
+    print(render_report(_extract_stats(doc)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -104,13 +104,16 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
-        i = 0
-        for i, ub in enumerate(self.buckets):
-            if v <= ub:
-                break
-        else:
-            i = len(self.buckets)
+        # the whole observe runs under the lock: scanning outside it let a
+        # concurrent snapshot/render see count incremented before the bucket
+        # row, breaking the cumulative-bucket invariant readers rely on
         with self._lock:
+            i = 0
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    break
+            else:
+                i = len(self.buckets)
             self.bucket_counts[i] += 1
             self.count += 1
             self.total += v

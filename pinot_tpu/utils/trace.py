@@ -43,16 +43,20 @@ class Trace:
             })
 
     def splice(self, spans: List[Dict[str, Any]], prefix: str = "",
-               offset_ms: float = 0.0) -> None:
+               offset_ms: float = 0.0, depth_offset: int = 0) -> None:
         """Merge a remote server's span list. Its startMs values are relative to the
         SERVER's request start; `offset_ms` (when the dispatch left this trace's
-        timeline) rebases them so the merged view sorts on one axis."""
+        timeline) rebases them so the merged view sorts on one axis. `depth_offset`
+        rebases the remote depths the same way — the server recorded depth 0 at its
+        own request root, but spliced spans nest under the dispatching span (pass
+        `current_depth()` from inside it) so the merged tree renders correctly."""
         with self._lock:
             for s in spans:
                 s = dict(s)
                 if prefix:
                     s["name"] = f"{prefix}/{s['name']}"
                 s["startMs"] = round(s.get("startMs", 0.0) + offset_ms, 3)
+                s["depth"] = int(s.get("depth", 0)) + depth_offset
                 self.spans.append(s)
 
     def elapsed_ms(self) -> float:
@@ -79,6 +83,12 @@ class Trace:
 
 def current_trace() -> Optional[Trace]:
     return getattr(_local, "trace", None)
+
+
+def current_depth() -> int:
+    """The calling thread's span nesting depth — what a span opened NOW would
+    record. Used to nest spliced remote spans under their dispatch span."""
+    return getattr(_local, "depth", 0)
 
 
 @contextmanager
