@@ -306,6 +306,7 @@ class Broker:
             "recentSlowQueries": recent,
             "brokerMetrics": {k: v for k, v in sorted(snap.items())
                               if k.startswith("pinot_broker_")},
+            "gaugeHistories": get_registry().gauge_histories("pinot_broker"),
         }
 
     def _rewrite_subqueries(self, stmt):

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -64,6 +64,15 @@ class Controller:
                                              3600.0, self.cleanup_dead_minions))
         self.scheduler.register(PeriodicTask("TaskMetricsEmitter", 300.0,
                                              self.emit_task_metrics))
+        # ingestion health plane (reference: the controller's
+        # tableIngestionStatus aggregation over server consumingSegmentsInfo)
+        self._ingestion_tables: set = set()   # tables with ingestion gauges
+        self._ingestion_status: Dict[str, Dict[str, object]] = {}
+        # in-proc clusters register ServerNode.ingestion_snapshot directly;
+        # OS-process clusters are discovered via advertised instance ports
+        self.ingestion_pollers: Dict[str, Callable[[], Dict[str, dict]]] = {}
+        self.scheduler.register(PeriodicTask("IngestionStatusChecker", 60.0,
+                                             self.run_ingestion_status_check))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -330,6 +339,171 @@ class Controller:
                 reg.remove_gauge(g, {"table": table})
         self._status_tables = set(out)
         return out
+
+    # -- ingestion health (reference: /tables/{t}/ingestionStatus + the
+    # RealtimeConsumerMonitor's per-partition lag aggregation) ---------------
+    DEFAULT_OFFSET_LAG_THRESHOLD = 10_000.0
+
+    def _cluster_config_float(self, key: str, default: Optional[float]
+                              ) -> Optional[float]:
+        v = self.catalog.get_property(f"clusterConfig/{key}")
+        if v is None:
+            return default
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return default
+
+    def _iter_ingestion_pollers(self):
+        """(server_id, poll fn) for every reachable server: explicitly
+        registered in-proc pollers first, then instances advertising an HTTP
+        port (OS-process servers) — their /debug/consuming route."""
+        seen = set()
+        for sid, poll in list(self.ingestion_pollers.items()):
+            seen.add(sid)
+            yield sid, poll
+        for info in list(self.catalog.instances.values()):
+            if info.role != "server" or not info.port or not info.alive \
+                    or info.instance_id in seen:
+                continue
+
+            def poll(url=info.url):
+                from .http_service import get_json
+                return get_json(f"{url}/debug/consuming", timeout=5.0,
+                                retries=1).get("tables", {})
+            yield info.instance_id, poll
+
+    def ingestion_status(self, table: str) -> Dict[str, object]:
+        """Per-table ingestion verdict: HEALTHY / DEGRADED / UNHEALTHY with
+        reasons, aggregated live from every server's consuming rollup.
+        Thresholds come from cluster config
+        (`controller.ingestion.offset.lag.threshold`, default 10k messages;
+        `controller.ingestion.freshness.lag.ms.threshold`, unset = freshness
+        not judged — event-time clocks are the table's business)."""
+        cfg = self.catalog.table_configs.get(table)
+        if cfg is None:
+            raise ValueError(f"unknown table {table!r}")
+        if cfg.stream is None or not cfg.stream.topic:
+            return {"table": table, "ingestionState": "HEALTHY", "reasons": [],
+                    "paused": False, "numConsumingSegments": 0,
+                    "maxOffsetLag": 0, "maxFreshnessLagMs": 0,
+                    "totalRowsPerSecond": 0.0, "servers": {},
+                    "unreachableServers": [],
+                    "message": "offline table: batch ingestion only"}
+        paused = bool(self.catalog.get_property(f"pause/{table}"))
+        consuming = [m.name for m in self.catalog.segments.get(table, {}).values()
+                     if m.status == STATUS_IN_PROGRESS]
+        statuses: Dict[str, Dict[str, object]] = {}
+        unreachable: List[str] = []
+        for sid, poll in self._iter_ingestion_pollers():
+            try:
+                snap = poll()
+            except Exception:
+                unreachable.append(sid)
+                continue
+            st = snap.get(table)
+            if st:
+                statuses[sid] = st
+        attached = {seg for st in statuses.values()
+                    for seg in st.get("segments", {})}
+        error_segs = sorted({seg for st in statuses.values()
+                             for seg in st.get("errorSegments", [])})
+        max_offset_lag = max((st.get("maxOffsetLag") or 0
+                              for st in statuses.values()), default=0)
+        max_fresh_lag = max((st.get("maxFreshnessLagMs") or 0
+                             for st in statuses.values()), default=0)
+        rows_per_s = round(sum(st.get("totalRowsPerSecond") or 0.0
+                               for st in statuses.values()), 3)
+        missing = sorted(set(consuming) - attached)
+
+        reasons: List[str] = []
+        verdict = "HEALTHY"
+
+        def degrade(to: str, reason: str) -> None:
+            nonlocal verdict
+            reasons.append(reason)
+            order = ("HEALTHY", "DEGRADED", "UNHEALTHY")
+            if order.index(to) > order.index(verdict):
+                verdict = to
+
+        if error_segs:
+            degrade("UNHEALTHY", f"consumers in ERROR state: {error_segs}")
+        if missing and not paused:
+            degrade("UNHEALTHY",
+                    f"consuming segments with no attached consumer: {missing}")
+        if consuming and not statuses:
+            if unreachable:
+                degrade("UNHEALTHY",
+                        f"no server reported ingestion status "
+                        f"(unreachable: {sorted(unreachable)})")
+        elif unreachable:
+            degrade("DEGRADED",
+                    f"ingestion status poll failed for: {sorted(unreachable)}")
+        if paused:
+            degrade("DEGRADED", "consumption is paused")
+        lag_thr = self._cluster_config_float(
+            "controller.ingestion.offset.lag.threshold",
+            self.DEFAULT_OFFSET_LAG_THRESHOLD)
+        if lag_thr is not None and max_offset_lag > lag_thr:
+            degrade("DEGRADED", f"offset lag {max_offset_lag} exceeds "
+                                f"threshold {lag_thr:g}")
+        fresh_thr = self._cluster_config_float(
+            "controller.ingestion.freshness.lag.ms.threshold", None)
+        if fresh_thr is not None and max_fresh_lag > fresh_thr:
+            degrade("DEGRADED", f"freshness lag {max_fresh_lag}ms exceeds "
+                                f"threshold {fresh_thr:g}ms")
+        return {"table": table, "ingestionState": verdict, "reasons": reasons,
+                "paused": paused, "numConsumingSegments": len(consuming),
+                "maxOffsetLag": max_offset_lag,
+                "maxFreshnessLagMs": max_fresh_lag,
+                "totalRowsPerSecond": rows_per_s,
+                "servers": statuses, "unreachableServers": sorted(unreachable)}
+
+    _INGESTION_GAUGES = ("pinot_controller_ingestion_healthy",
+                         "pinot_controller_ingestion_offset_lag",
+                         "pinot_controller_ingestion_freshness_lag_ms")
+
+    def run_ingestion_status_check(self) -> Dict[str, str]:
+        """Periodic rollup: per-realtime-table verdict gauges, stale series
+        removed on table drop (same hygiene as run_segment_status_check)."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        out: Dict[str, Dict[str, object]] = {}
+        for table, cfg in list(self.catalog.table_configs.items()):
+            if cfg.stream is None or not cfg.stream.topic:
+                continue
+            st = self.ingestion_status(table)
+            labels = {"table": table}
+            reg.gauge(self._INGESTION_GAUGES[0], labels).set(
+                1 if st["ingestionState"] == "HEALTHY" else 0)
+            reg.gauge(self._INGESTION_GAUGES[1], labels).set(st["maxOffsetLag"])
+            reg.gauge(self._INGESTION_GAUGES[2], labels).set(
+                st["maxFreshnessLagMs"])
+            out[table] = st
+        for table in self._ingestion_tables - set(out):
+            for g in self._INGESTION_GAUGES:
+                reg.remove_gauge(g, {"table": table})
+        self._ingestion_tables = set(out)
+        self._ingestion_status = out
+        return {t: str(s["ingestionState"]) for t, s in out.items()}
+
+    def debug_stats(self) -> Dict[str, object]:
+        """Controller /debug rollup: periodic task health (a silently-failing
+        task is a climbing errorCount + stale lastRunMs), the last ingestion
+        verdicts, and the controller-scoped metric snapshot + gauge rings."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        return {
+            "instance": self.instance_id,
+            "periodicTasks": self.scheduler.stats(),
+            "ingestionStatus": {t: {k: v for k, v in s.items()
+                                    if k != "servers"}
+                                for t, s in self._ingestion_status.items()},
+            "controllerMetrics": {k: v for k, v in reg.snapshot().items()
+                                  if k.startswith(("pinot_controller",
+                                                   "pinot_periodic"))},
+            "gaugeHistories": reg.gauge_histories("pinot_controller"),
+        }
 
     def cleanup_dead_minions(self) -> List[str]:
         """Reference: MinionInstancesCleanupTask — drop dead minion instances

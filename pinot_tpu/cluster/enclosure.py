@@ -43,6 +43,9 @@ class QuickCluster:
         for s in self.servers:
             self.broker.register_server_handle(s.instance_id, s.execute_partial,
                                                explain_handle=s.explain_partial)
+            # in-proc analog of the controller polling /debug/consuming: the
+            # ingestion status checker reads each server's consuming rollup
+            self.controller.ingestion_pollers[s.instance_id] = s.ingestion_snapshot
         from ..minion.tasks import MinionWorker
         self.minion = MinionWorker("minion_0", self.catalog, self.deepstore,
                                    self.controller,

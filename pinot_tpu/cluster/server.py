@@ -351,6 +351,14 @@ class ServerNode:
     def realtime_manager(self, table: str):
         return self._realtime_managers.get(table)
 
+    def ingestion_snapshot(self) -> Dict[str, Dict[str, object]]:
+        """{table: ingestion rollup} across every realtime manager on this
+        server — the payload behind /debug/consuming, and what the
+        controller's ingestion status check polls (in-proc clusters register
+        this method directly as the poller)."""
+        return {table: handler.ingestion_status()
+                for table, handler in list(self._realtime_managers.items())}
+
     def _load_online_segment(self, table: str, seg_name: str, mgr: TableDataManager) -> None:
         # per-segment load lock (reference: SegmentLocks): concurrent
         # reconciles — an ideal-state notify racing a rebalance notify — must
@@ -516,6 +524,18 @@ class ServerNode:
                         ctx, segment_names, exclude=set(served))
                 results.extend(rt_results)
                 served.extend(rt_served)
+                if rt_served:
+                    # consuming-segment visibility (reference: the broker
+                    # response's numConsumingSegmentsQueried +
+                    # minConsumingFreshnessTimeMs pair): freshness is the min
+                    # across the consuming segments THIS partial touched —
+                    # the broker min-merges across servers
+                    qstats.record(qstats.NUM_CONSUMING_SEGMENTS_QUERIED,
+                                  len(rt_served))
+                    fresh = handler.min_freshness_ms(rt_served)
+                    if fresh is not None:
+                        qstats.record_min(
+                            qstats.MIN_CONSUMING_FRESHNESS_TIME_MS, fresh)
         finally:
             mgr.release(segments)
         aggs = [make_agg(f) for f in ctx.aggregations]

@@ -101,6 +101,7 @@ class ControllerService:
         s.route("POST", "replaceSegments", self._replace_segments, action="WRITE")
         s.route("POST", "ingestJobs", self._ingest_jobs, action="WRITE")
         s.route("GET", "metrics", _metrics_route)
+        s.route("GET", "debug", stats_route(controller.debug_stats))
         s.route("POST", "sql", self._sql_proxy)  # query console backend
         s.route("GET", "", self._ui)       # admin UI at /
         s.route("GET", "ui", self._ui)
@@ -505,6 +506,14 @@ class ControllerService:
     # threads while writers mutate the same dicts in place (same discipline as
     # _catalog_get above)
     def _get_tables(self, parts, params, body):
+        # GET /tables/{t}/ingestionStatus (reference:
+        # /tables/{tableName}/ingestionStatus) polls servers over HTTP, so it
+        # must not run under the catalog lock
+        if len(parts) == 2 and parts[1] == "ingestionStatus":
+            try:
+                return json_response(self.controller.ingestion_status(parts[0]))
+            except ValueError as e:
+                return error_response(str(e), 404)
         with self.catalog._lock:
             if parts:  # GET /tables/{nameWithType} -> the table config
                 cfg = self.catalog.table_configs.get(parts[0])
@@ -674,6 +683,7 @@ class ServerService:
         self.http.route("POST", "joinStage", self._join_stage)
         self.http.route("POST", "aggStage", self._agg_stage)
         self.http.route("GET", "health", self._health)
+        self.http.route("GET", "debug", self._debug)
         self.http.route("GET", "segments", self._segments)
         self.http.route("GET", "segmentData", self._segment_data)
         self.http.route("GET", "metrics", _metrics_route)
@@ -717,8 +727,11 @@ class ServerService:
         return binary_response(encode_segment_result(result, trace_spans=spans))
 
     def _health(self, parts, params, body):
-        """Readiness probe: 503 until every assigned segment is loaded
-        (reference: /health/readiness gated on ServiceStatus)."""
+        """GET /health — pure liveness, always 200 while the process serves
+        HTTP; GET /health/readiness — 503 until every ideal-state-assigned
+        segment is served or consuming (reference: /health vs
+        /health/readiness gated on ServiceStatus). Both are credential-less
+        so orchestrators can probe without a token."""
         st = self.server.startup_status()
         st["instance"] = self.server.instance_id
         if self.server.device_pipeline is not None:
@@ -726,7 +739,25 @@ class ServerService:
             # amortized fetches; tests/bench read this to verify the served
             # path actually executed on the device
             st["device"] = self.server.device_pipeline.stats()
-        return json_response(st, status=200 if st["ready"] else 503)
+        if parts and parts[0] == "readiness":
+            return json_response(st, status=200 if st["ready"] else 503)
+        return json_response(st, status=200)
+
+    def _debug(self, parts, params, body):
+        """GET /debug — server metric rollup + gauge rings; GET
+        /debug/consuming — consumingSegmentsInfo analog: per-consuming-segment
+        offsets, lag, and consumer state for every realtime table."""
+        from ..utils.metrics import get_registry
+        if parts and parts[0] == "consuming":
+            return json_response({"instance": self.server.instance_id,
+                                  "tables": self.server.ingestion_snapshot()})
+        reg = get_registry()
+        return json_response({
+            "instance": self.server.instance_id,
+            "serverMetrics": {k: v for k, v in reg.snapshot().items()
+                              if k.startswith("pinot_server")},
+            "gaugeHistories": reg.gauge_histories("pinot_server"),
+        })
 
     def _explain(self, parts, params, body):
         from ..auth import require_table_access

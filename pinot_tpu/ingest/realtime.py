@@ -43,6 +43,65 @@ RETAINED = "RETAINED"
 ERROR = "ERROR"
 
 
+class ConsumerLagTracker:
+    """Per-partition ingestion lag/freshness accounting (reference:
+    `IngestionDelayTracker` + the `ServerGauge` realtime offset-lag family:
+    REALTIME_INGESTION_DELAY_MS, LLC_PARTITION_CONSUMING, ...).
+
+    One tracker per consuming partition; `pump()` feeds it per batch. Offset
+    lag (latest stream offset vs last consumed) is computed on demand from
+    the stream SPI so a PAUSED consumer's lag keeps growing while the
+    producer runs — exactly the signal the controller's ingestion status
+    check alerts on. Event times are epoch millis (the table's time column
+    convention everywhere else: SegmentMeta start/end_time_ms)."""
+
+    #: EWMA smoothing for the rows/s consumption rate (one batch = one sample)
+    EWMA_ALPHA = 0.3
+
+    def __init__(self, table: str, partition: int):
+        self.table = table
+        self.partition = partition
+        self.rows_indexed = 0
+        self.rows_filtered = 0        # fetched but dropped (filter/dedup)
+        self.errors = 0
+        self.last_consumed_ms: Optional[int] = None   # wall ms of last fetch>0
+        self.last_event_time_ms: Optional[float] = None  # max indexed event-time
+        self.rows_per_s = 0.0
+        self._last_batch_t: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def on_batch(self, fetched: int, indexed: int,
+                 max_event_time_ms: Optional[float]) -> None:
+        now = time.time()
+        with self._lock:
+            self.rows_indexed += indexed
+            self.rows_filtered += max(fetched - indexed, 0)
+            if fetched:
+                self.last_consumed_ms = int(now * 1000)
+            if max_event_time_ms is not None:
+                self.last_event_time_ms = max(self.last_event_time_ms or 0.0,
+                                              float(max_event_time_ms))
+            if self._last_batch_t is not None:
+                dt = max(now - self._last_batch_t, 1e-6)
+                self.rows_per_s = (self.EWMA_ALPHA * (indexed / dt)
+                                   + (1 - self.EWMA_ALPHA) * self.rows_per_s)
+            self._last_batch_t = now
+
+    def on_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+
+#: gauge families lag_status() exports per (table, partition); listed once so
+#: the manager's teardown can remove exactly this set (stale-series hygiene)
+_LAG_GAUGES = (
+    "pinot_server_realtime_offset_lag",
+    "pinot_server_realtime_freshness_lag_ms",
+    "pinot_server_realtime_rows_per_s",
+    "pinot_server_realtime_last_consumed_ts_ms",
+)
+
+
 class RealtimePartitionConsumer:
     """One consuming segment on one server (reference: LLRealtimeSegmentDataManager)."""
 
@@ -87,6 +146,7 @@ class RealtimePartitionConsumer:
         self.batch_decoder = get_batch_decoder(stream_cfg.decoder)
         self.offset = start_offset
         self.start_consume_time = time.time()
+        self.lag = ConsumerLagTracker(table_cfg.name, self.partition)
         self.catchup_target: Optional[int] = None
         # halt fence: on_segment_online sets `halted` and takes `pump_lock`
         # before the offset check + adoption build, so a background loop
@@ -201,6 +261,8 @@ class RealtimePartitionConsumer:
             batch = self.consumer.fetch(fetch_from, limit)
             next_offset = batch.next_offset
         indexed = 0
+        fetched = 0
+        max_event: Optional[float] = None
         with self.pump_lock:
             if self.halted or self.offset != fetch_from:
                 # adopted mid-fetch, or a CONCURRENT pump indexed this range
@@ -209,10 +271,14 @@ class RealtimePartitionConsumer:
                 return 0
             if cols is not None:
                 self.last_decode_path = "columnar"
+                fetched = len(next(iter(cols.values()))) if cols else 0
+                max_event = self._max_event_time(cols=cols)
                 indexed = self.mutable.index_batch(cols, coerced=True)
             elif rows is not None:
                 if rows:
                     self.last_decode_path = rows_path
+                    fetched = len(rows)
+                    max_event = self._max_event_time(rows=rows)
                     from .transform import rows_to_all_columns
                     indexed = self.mutable.index_batch(
                         self.pipeline.apply(rows_to_all_columns(rows)),
@@ -226,21 +292,31 @@ class RealtimePartitionConsumer:
                 # indexing in LLRealtimeSegmentDataManager.processStreamEvents)
                 from .transform import rows_to_all_columns
                 decoded = [self.decoder(m.value) for m in batch.messages]
+                fetched = len(decoded)
+                max_event = self._max_event_time(rows=decoded)
                 indexed = self.mutable.index_batch(
                     self.pipeline.apply(rows_to_all_columns(decoded)),
                     coerced=True)
             else:
                 self.last_decode_path = "row"
-                for msg in batch.messages:
-                    row = self.decoder(msg.value)
+                fetched = len(batch.messages)
+                decoded = [self.decoder(m.value) for m in batch.messages]
+                max_event = self._max_event_time(rows=decoded)
+                for row, msg in zip(decoded, batch.messages):
                     row = self.pipeline.apply_row(row)
                     if row is not None and self._index_row(row, msg.offset):
                         indexed += 1
             self.offset = next_offset
-        if indexed:  # ServerMeter REALTIME_ROWS_CONSUMED analog
+        self.lag.on_batch(fetched, indexed, max_event)
+        if indexed or fetched:
             from ..utils.metrics import get_registry
-            get_registry().counter("pinot_server_realtime_rows_consumed",
-                                   {"table": self.table_cfg.name}).inc(indexed)
+            reg = get_registry()
+            if indexed:  # ServerMeter REALTIME_ROWS_CONSUMED analog
+                reg.counter("pinot_server_realtime_rows_consumed",
+                            {"table": self.table_cfg.name}).inc(indexed)
+            if fetched > indexed:  # filter/dedup drops (ROWS_FILTERED analog)
+                reg.counter("pinot_server_realtime_rows_filtered",
+                            {"table": self.table_cfg.name}).inc(fetched - indexed)
         return indexed
 
     def _index_row(self, row: Dict, msg_offset: int) -> bool:
@@ -277,6 +353,74 @@ class RealtimePartitionConsumer:
 
         self.mutable.index(row)
         return True
+
+    def _max_event_time(self, rows=None, cols=None) -> Optional[float]:
+        """Max event-time (epoch ms) in one decoded batch, from the table's
+        time column; None when the table has no time column or the batch
+        carries no usable values (freshness then falls back to consume
+        wall-clock)."""
+        tc = self.table_cfg.time_column
+        if not tc:
+            return None
+        try:
+            if cols is not None:
+                vals = cols.get(tc)
+                if vals is None or not len(vals):
+                    return None
+                best = max(v for v in vals if v is not None)
+                return float(best)
+            best = None
+            for r in rows or ():
+                v = r.get(tc)
+                if v is not None and (best is None or v > best):
+                    best = v
+            return float(best) if best is not None else None
+        except (TypeError, ValueError):
+            return None  # non-numeric / all-null time values: no freshness signal
+
+    # -- lag / freshness observability -------------------------------------
+    def freshness_time_ms(self) -> int:
+        """Timestamp of the freshest data this consumer serves (reference:
+        consuming segment's latest ingestion time behind
+        minConsumingFreshnessTimeMs): max indexed event-time, else last
+        consume wall time, else when consumption started."""
+        lt = self.lag.last_event_time_ms or self.lag.last_consumed_ms
+        return int(lt if lt is not None else self.start_consume_time * 1000)
+
+    def lag_status(self, export: bool = True) -> Dict[str, object]:
+        """One consuming segment's lag snapshot (consumingSegmentsInfo row);
+        also exports the pinot_server_realtime_* gauges unless told not to."""
+        latest = None
+        if self.consumer is not None:
+            try:
+                latest = int(self.consumer.latest_offset())
+            except Exception:
+                latest = None   # stream probe failed; lag unknown this round
+        offset_lag = max(latest - self.offset, 0) if latest is not None else None
+        fresh = self.freshness_time_ms()
+        freshness_lag = max(int(time.time() * 1000) - fresh, 0)
+        st = {"segment": self.segment_name, "partition": self.partition,
+              "state": self.state, "paused": self.pause_requested,
+              "currentOffset": self.offset, "latestStreamOffset": latest,
+              "offsetLag": offset_lag, "freshnessTimeMs": fresh,
+              "freshnessLagMs": freshness_lag,
+              "rowsPerSecond": round(self.lag.rows_per_s, 3),
+              "rowsIndexed": self.lag.rows_indexed,
+              "rowsFiltered": self.lag.rows_filtered,
+              "consumeErrors": self.lag.errors,
+              "lastConsumedMs": self.lag.last_consumed_ms,
+              "numDocs": self.mutable.num_docs}
+        if export:
+            from ..utils.metrics import get_registry
+            reg = get_registry()
+            labels = {"table": self.table_cfg.name,
+                      "partition": str(self.partition)}
+            if offset_lag is not None:
+                reg.gauge(_LAG_GAUGES[0], labels).set(offset_lag)
+            reg.gauge(_LAG_GAUGES[1], labels).set(freshness_lag)
+            reg.gauge(_LAG_GAUGES[2], labels).set(self.lag.rows_per_s)
+            reg.gauge(_LAG_GAUGES[3], labels).set(self.lag.last_consumed_ms or 0)
+        return st
 
     def close(self) -> None:
         """Halt pumping and release the stream connection (idempotent)."""
@@ -409,7 +553,12 @@ class RealtimeTableManager:
 
     def stop_consuming(self, segment_name: str) -> Optional[RealtimePartitionConsumer]:
         with self._lock:
-            return self.consumers.pop(segment_name, None)
+            consumer = self.consumers.pop(segment_name, None)
+        if consumer is not None:
+            # the partition's lag series dies with its consumer (a successor
+            # segment re-exports it on the next status snapshot)
+            self._remove_lag_gauges([consumer])
+        return consumer
 
     def retire_consumer(self, segment_name: str) -> None:
         """Second half of the CONSUMING->ONLINE handoff: drop the retained
@@ -504,11 +653,66 @@ class RealtimeTableManager:
                 out.append(self.server.executor.execute_segment(ctx, c.mutable, valid))
         return out, served
 
+    # -- ingestion health rollup (reference: consumingSegmentsInfo + the
+    # tableIngestionStatus the controller aggregates) -----------------------
+    def ingestion_status(self) -> Dict[str, object]:
+        """Per-table rollup of every consuming segment's lag snapshot, plus
+        the worst-case numbers the controller's verdict keys off."""
+        with self._lock:
+            consumers = list(self.consumers.items())
+        segs = {name: c.lag_status() for name, c in consumers}
+        offset_lags = [s["offsetLag"] for s in segs.values()
+                       if s["offsetLag"] is not None]
+        return {
+            "table": self.table,
+            "paused": self._paused,
+            "numConsumingSegments": len(segs),
+            "maxOffsetLag": max(offset_lags) if offset_lags else 0,
+            "maxFreshnessLagMs": max((s["freshnessLagMs"] for s in segs.values()),
+                                     default=0),
+            "totalRowsPerSecond": round(sum(s["rowsPerSecond"]
+                                            for s in segs.values()), 3),
+            "errorSegments": sorted(n for n, s in segs.items()
+                                    if s["state"] == ERROR),
+            "segments": segs,
+        }
+
+    def min_freshness_ms(self, segment_names: Sequence[str]) -> Optional[int]:
+        """Min freshness timestamp across the named consuming segments (the
+        per-server contribution to minConsumingFreshnessTimeMs)."""
+        with self._lock:
+            consumers = [c for n, c in self.consumers.items()
+                         if n in segment_names]
+        if not consumers:
+            return None
+        return min(c.freshness_time_ms() for c in consumers)
+
+    def _remove_lag_gauges(self, consumers: Sequence[RealtimePartitionConsumer]
+                           ) -> None:
+        """Drop this table's per-partition lag series (table drop/manager
+        teardown) — same stale-gauge hygiene as the controller's status check."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        for c in consumers:
+            labels = {"table": self.table_cfg.name,
+                      "partition": str(c.partition)}
+            for g in _LAG_GAUGES:
+                reg.remove_gauge(g, labels)
+
     # -- deterministic drive (tests) / background loop (production) ---------
     def pump_all(self, max_messages: int = 10_000) -> int:
         with self._lock:
             consumers = list(self.consumers.values())
-        return sum(c.pump(max_messages) for c in consumers)
+        total = 0
+        for c in consumers:
+            try:
+                total += c.pump(max_messages)
+            except Exception:
+                # per-partition attribution before the loop-level backoff
+                # (start_loop meters + retries; tests see tracker.errors)
+                c.lag.on_error()
+                raise
+        return total
 
     def complete_all(self) -> Dict[str, str]:
         with self._lock:
@@ -565,3 +769,4 @@ class RealtimeTableManager:
             self.consumers.clear()
         for c in consumers:   # release stream sockets (kafkalite TCP etc.)
             c.close()
+        self._remove_lag_gauges(consumers)

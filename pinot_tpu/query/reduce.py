@@ -115,10 +115,15 @@ def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> 
     kind = results[0].kind
     out = SegmentResult(kind)
     out.num_docs_scanned = sum(r.num_docs_scanned for r in results)
+    from .stats import MIN_KEYS
     merged_stats: Dict[str, float] = {}
     for r in results:
         for k, v in (r.stats or {}).items():
-            merged_stats[k] = merged_stats.get(k, 0) + v
+            if k in MIN_KEYS:   # freshness timestamps: stalest side wins
+                cur = merged_stats.get(k)
+                merged_stats[k] = v if cur is None else min(cur, v)
+            else:
+                merged_stats[k] = merged_stats.get(k, 0) + v
     out.stats = merged_stats or None  # set BEFORE the dense early return
     if kind == "groups":
         denses = [r.dense for r in results]

@@ -43,6 +43,8 @@ BYTES_FETCHED = "bytesFetched"
 QUEUE_WAIT_MS = "queueWaitMs"
 DEDUPED_LAUNCHES = "dedupedLaunches"
 STACKED_LAUNCHES = "stackedLaunches"
+NUM_CONSUMING_SEGMENTS_QUERIED = "numConsumingSegmentsQueried"
+MIN_CONSUMING_FRESHNESS_TIME_MS = "minConsumingFreshnessTimeMs"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -51,7 +53,15 @@ COUNTER_KEYS = (
     DEVICE_LAUNCHES, COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
     COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
+    NUM_CONSUMING_SEGMENTS_QUERIED,
 )
+
+# keys that merge by MINIMUM instead of sum (reference: the broker reduces
+# minConsumingFreshnessTimeMs across servers with Math.min — the answer is
+# only as fresh as the STALEST consuming segment it touched). Absent on
+# responses that touched no consuming segment; never zero-filled, because a
+# zero-fill would poison every min-merge round.
+MIN_KEYS = (MIN_CONSUMING_FRESHNESS_TIME_MS,)
 
 # broker-level keys that live beside the merged counters in QueryResult.stats
 # (listed so the glossary drift guard covers the full emitted surface)
@@ -82,6 +92,12 @@ class ExecutionStats:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
 
+    def set_min(self, key: str, v: float) -> None:
+        """Keep the minimum seen for a min-merged key (no-op when `v` loses)."""
+        with self._lock:
+            cur = self.counters.get(key)
+            self.counters[key] = v if cur is None else min(cur, v)
+
     def add_operator(self, label: str, rows: float = 0, ms: float = 0.0) -> None:
         with self._lock:
             rk, mk = op_key(label, "rows"), op_key(label, "ms")
@@ -90,7 +106,8 @@ class ExecutionStats:
 
     def merge(self, other) -> None:
         """Fold another record (ExecutionStats or its flat dict form) into
-        this one: every numeric key sums."""
+        this one: every numeric key sums, except MIN_KEYS which keep the
+        minimum of the sides that carry the key."""
         if other is None:
             return
         src = other.counters if isinstance(other, ExecutionStats) else other
@@ -100,7 +117,11 @@ class ExecutionStats:
         with self._lock:
             for k, v in src.items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    self.counters[k] = self.counters.get(k, 0) + v
+                    if k in MIN_KEYS:
+                        cur = self.counters.get(k)
+                        self.counters[k] = v if cur is None else min(cur, v)
+                    else:
+                        self.counters[k] = self.counters.get(k, 0) + v
 
     def operators(self) -> Dict[str, Dict[str, float]]:
         """Reassemble the per-operator breakdown: label -> {rows, ms}."""
@@ -129,7 +150,9 @@ class ExecutionStats:
                 out[k] = round(v, 3) if k.endswith("Ms") else int(v)
             for k, v in self.counters.items():
                 if k not in out and not k.startswith(_OP_PREFIX):
-                    out[k] = (round(float(v), 3) if k.endswith("Ms")
+                    # MIN_KEYS are epoch-ms timestamps, not durations: whole ms
+                    out[k] = (round(float(v), 3)
+                              if k.endswith("Ms") and k not in MIN_KEYS
                               else int(v))
             return out
 
@@ -151,6 +174,14 @@ def record(key: str, n: float = 1) -> None:
     st = getattr(_local, "stats", None)
     if st is not None:
         st.add(key, n)
+
+
+def record_min(key: str, v: float) -> None:
+    """Min-merge accounting hook (freshness timestamps): keep the smallest
+    value seen by the active record, if any."""
+    st = getattr(_local, "stats", None)
+    if st is not None:
+        st.set_min(key, v)
 
 
 def record_operator(label: str, rows: float = 0, ms: float = 0.0) -> None:

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Live one-screen cluster health table (a `top` for the cluster).
+
+Polls the controller and broker debug/status endpoints and renders one row per
+table: QPS, consuming-segment count, max offset lag, max freshness lag, rows/s,
+and the controller's ingestion verdict — the operator's first stop when a
+dashboard shows a table going stale:
+
+    python -m pinot_tpu.tools.cluster_top --controller http://host:9000 \\
+        --broker http://host:8099 [--interval 5] [--once] [--token TOKEN]
+
+`snapshot()` and `render()` are pure (fetcher injected) so tests drive them
+without sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+Fetcher = Callable[[str], Any]
+
+
+def _default_fetcher(token: Optional[str]) -> Fetcher:
+    from ..cluster.http_service import get_json
+
+    def fetch(url: str) -> Any:
+        return get_json(url, timeout=5.0, token=token)
+    return fetch
+
+
+def snapshot(controller_url: str, broker_url: Optional[str],
+             fetch: Fetcher) -> Dict[str, Any]:
+    """One poll of the cluster: per-table ingestion verdicts from the
+    controller plus the broker's lifetime query rollup. Endpoint failures
+    degrade to partial data (an unreachable broker must not blank the lag
+    columns)."""
+    out: Dict[str, Any] = {"tables": {}, "broker": None, "errors": []}
+    try:
+        tables = fetch(f"{controller_url}/tables").get("tables", [])
+    except Exception as e:
+        out["errors"].append(f"controller /tables: {e}")
+        tables = []
+    for t in tables:
+        try:
+            out["tables"][t] = fetch(
+                f"{controller_url}/tables/{t}/ingestionStatus")
+        except Exception as e:
+            out["tables"][t] = {"table": t, "ingestionState": "UNKNOWN",
+                                "reasons": [f"poll failed: {e}"]}
+    if broker_url:
+        try:
+            out["broker"] = fetch(f"{broker_url}/debug").get("queryStats")
+        except Exception as e:
+            out["errors"].append(f"broker /debug: {e}")
+    try:
+        out["periodicTasks"] = fetch(f"{controller_url}/debug").get(
+            "periodicTasks", {})
+    except Exception as e:
+        out["errors"].append(f"controller /debug: {e}")
+        out["periodicTasks"] = {}
+    return out
+
+
+def _fmt_lag_ms(v: Any) -> str:
+    try:
+        ms = float(v)
+    except (TypeError, ValueError):
+        return "-"
+    if ms >= 3_600_000:
+        return f"{ms / 3_600_000:.1f}h"
+    if ms >= 60_000:
+        return f"{ms / 60_000:.1f}m"
+    if ms >= 1_000:
+        return f"{ms / 1_000:.1f}s"
+    return f"{ms:.0f}ms"
+
+
+def render(snap: Dict[str, Any]) -> str:
+    """The one-screen table for a snapshot()."""
+    lines: List[str] = []
+    broker = snap.get("broker") or {}
+    head = time.strftime("%H:%M:%S")
+    if broker:
+        head += (f"  queries={broker.get('numQueries', 0)}"
+                 f" avg={broker.get('avgTimeMs', 0)}ms"
+                 f" slow={broker.get('numSlowQueries', 0)}")
+    lines.append(head)
+    cols = f"{'TABLE':<28} {'HEALTH':<10} {'CONS':>4} {'OFFLAG':>8} " \
+           f"{'FRESHLAG':>9} {'ROWS/S':>8}  REASONS"
+    lines.append(cols)
+    lines.append("-" * len(cols))
+    for t in sorted(snap.get("tables", {})):
+        st = snap["tables"][t]
+        reasons = "; ".join(st.get("reasons") or [])
+        if st.get("paused") and "paused" not in reasons:
+            reasons = ("paused; " + reasons).rstrip("; ")
+        lines.append(
+            f"{t:<28} {st.get('ingestionState', '?'):<10} "
+            f"{st.get('numConsumingSegments', 0):>4} "
+            f"{st.get('maxOffsetLag', 0):>8} "
+            f"{_fmt_lag_ms(st.get('maxFreshnessLagMs')):>9} "
+            f"{st.get('totalRowsPerSecond', 0):>8}  {reasons}")
+    if not snap.get("tables"):
+        lines.append("(no tables)")
+    failing = {n: s for n, s in (snap.get("periodicTasks") or {}).items()
+               if s.get("lastError")}
+    for name, s in sorted(failing.items()):
+        lines.append(f"! task {name}: {s['lastError']} "
+                     f"(errors={s.get('errorCount')})")
+    for err in snap.get("errors", []):
+        lines.append(f"! {err}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--controller", required=True)
+    ap.add_argument("--broker", default=None)
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen clearing)")
+    ap.add_argument("--token", default=None, help="bearer token")
+    args = ap.parse_args(argv)
+    fetch = _default_fetcher(args.token)
+    while True:
+        text = render(snapshot(args.controller, args.broker, fetch))
+        if args.once:
+            print(text)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 LabelPairs = Tuple[Tuple[str, str], ...]
@@ -33,15 +34,27 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("value", "_lock")
+    #: bounded time-series ring: every set() appends (epoch_ms, value), so
+    #: /debug can show a gauge's recent trajectory (lag growing vs flat)
+    #: without an external scraper. 240 points ≈ 20 min at a 5s poll.
+    HISTORY_LEN = 240
+
+    __slots__ = ("value", "_history", "_lock")
 
     def __init__(self):
         self.value = 0.0
+        self._history: deque = deque(maxlen=self.HISTORY_LEN)
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
         with self._lock:
             self.value = float(v)
+            self._history.append((int(time.time() * 1000), self.value))
+
+    def history(self) -> List[Tuple[int, float]]:
+        """Recent (epoch_ms, value) samples, oldest first (bounded ring)."""
+        with self._lock:
+            return list(self._history)
 
 
 class Timer:
@@ -210,6 +223,19 @@ class MetricsRegistry:
                 out[f"{base}_sum"] = h.total
                 out[f"{base}_p50"] = h.percentile(0.5)
         return out
+
+    def gauge_histories(self, prefix: Optional[str] = None
+                        ) -> Dict[str, List[Tuple[int, float]]]:
+        """Per-gauge bounded time series for /debug: {rendered-name:
+        [(epoch_ms, value), ...]}, optionally filtered by name prefix so a
+        role's debug endpoint only ships its own series."""
+        with self._lock:
+            gauges = [(name, labels, g) for (name, labels), g
+                      in self._gauges.items()
+                      if prefix is None or name.startswith(prefix)]
+        # g.history() takes the per-gauge lock; never nest it under _lock
+        return {_render_name(name, labels): g.history()
+                for name, labels, g in gauges}
 
     def render_prometheus(self) -> str:
         """Text exposition format (the /metrics endpoint body): exactly one

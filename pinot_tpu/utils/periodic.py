@@ -20,15 +20,37 @@ class PeriodicTask:
         self.fn = fn
         self.initial_delay_s = initial_delay_s
         self.run_count = 0
+        self.error_count = 0
         self.last_error: Optional[BaseException] = None
+        self.last_run_ms: Optional[int] = None
 
     def run_once(self) -> None:
+        # exported per run (reference: ControllerMetrics' periodic task meters)
+        # so a task that silently fails every tick shows up as a climbing
+        # pinot_periodic_task_errors series and a stale last-run gauge when it
+        # stops being scheduled at all
+        from .metrics import get_registry
+        labels = {"task": self.name}
         try:
             self.fn()
+            self.last_error = None  # a clean run clears a stale error
             self.run_count += 1
         except BaseException as e:  # periodic tasks never kill the scheduler
             self.last_error = e
             self.run_count += 1
+            self.error_count += 1
+            get_registry().counter("pinot_periodic_task_errors", labels).inc()
+        self.last_run_ms = int(time.time() * 1000)
+        get_registry().gauge("pinot_periodic_task_last_run_ts_ms",
+                             labels).set(self.last_run_ms)
+
+    def stats(self) -> Dict[str, object]:
+        """One task's health for the controller /debug rollup."""
+        return {"runCount": self.run_count, "errorCount": self.error_count,
+                "lastRunMs": self.last_run_ms, "intervalS": self.interval_s,
+                "lastError": (f"{type(self.last_error).__name__}: "
+                              f"{self.last_error}"
+                              if self.last_error is not None else None)}
 
 
 class PeriodicTaskScheduler:
@@ -47,6 +69,10 @@ class PeriodicTaskScheduler:
         """Deterministic tick for tests."""
         for t in self._tasks.values():
             t.run_once()
+
+    def stats(self) -> Dict[str, Dict[str, object]]:
+        """{task name: run/error/last-run rollup} for debug endpoints."""
+        return {name: t.stats() for name, t in self._tasks.items()}
 
     def start(self) -> None:
         self._stop.clear()

@@ -131,11 +131,14 @@ def test_server_lifecycle_and_readiness(tmp_path):
     try:
         health = get_json(f"{svc.url}/health")
         assert health["ready"] is True and health["status"] == "UP"
-        # a not-yet-started server answers 503 to readiness probes
+        assert get_json(f"{svc.url}/health/readiness")["ready"] is True
+        # a not-yet-started server answers 503 to READINESS probes, while the
+        # bare liveness probe stays 200 (the process is up, just not ready)
         node.status = "STARTING"
         with pytest.raises(HttpError) as ei:
-            http_call("GET", f"{svc.url}/health")
+            http_call("GET", f"{svc.url}/health/readiness")
         assert ei.value.status == 503
+        assert get_json(f"{svc.url}/health")["status"] == "STARTING"
         node.status = "UP"
     finally:
         svc.stop()

@@ -128,6 +128,40 @@ def test_dense_decode_string_group_order(hc_segments, mesh_exec):
     assert tags == sorted(tags, reverse=True)
 
 
+def test_dense_orderby_null_ranking_matches_host(tmp_path_factory, mesh_exec):
+    """Differential lock on ORDER BY null ranking: groups whose aggregation is
+    null (every input cell null) must land in the same positions on the dense
+    decode as on the classic host reduce, for every desc/nulls combination —
+    the dense lexsort ranks NaN-as-null exactly like reduce._sort_key."""
+    rng = np.random.default_rng(7)
+    rows, card = 4000, 60
+    schema = Schema("nul", [dimension("k", DataType.INT),
+                            metric("v", DataType.DOUBLE)])
+    k = rng.integers(0, card, rows).astype(np.int64)
+    v = np.round(rng.uniform(-100, 100, rows), 3).astype(object)
+    v[k < 6] = None            # six all-null groups -> null SUM(v)
+    out = tmp_path_factory.mktemp("nulorder")
+    cfg = SegmentGeneratorConfig(raw_cardinality_fraction=4.0,
+                                 no_dictionary_columns=["v"])
+    paths = build_aligned_segments(schema, {"k": k, "v": v}, str(out),
+                                   "nul", 4, config=cfg)
+    segs = [load_segment(p) for p in paths]
+    host = ServerQueryExecutor(use_device=False)
+    for suffix in ("", " DESC", " NULLS FIRST", " NULLS LAST",
+                   " DESC NULLS FIRST", " DESC NULLS LAST"):
+        sql = (f"SELECT k, SUM(v) FROM nul GROUP BY k "
+               f"ORDER BY SUM(v){suffix}, k LIMIT 100")
+        dev = mesh_exec.execute(segs, sql)
+        want = host.execute(segs, sql)
+        assert dev.stats.get("denseReduce") is True, sql
+        assert [r[0] for r in dev.rows] == [r[0] for r in want.rows], sql
+        for dr, wr in zip(dev.rows, want.rows):
+            if wr[1] is None:
+                assert dr[1] is None, sql
+            else:
+                assert abs(dr[1] - wr[1]) <= 2e-3 * max(1.0, abs(wr[1])), sql
+
+
 def test_grouped_distinct_chunked(hc_segments, mesh_exec, hc_cols):
     """Grouped DISTINCTCOUNT: the presence matrix rides _grouped_chunk64 when
     the (groups x ids) product space fits the chunk cap."""

@@ -1,0 +1,478 @@
+"""Ingestion & cluster health plane: consumer lag tracking, readiness probes,
+controller ingestion verdicts, consuming-freshness query stats, periodic task
+health, gauge history rings, and the cluster_top tool.
+
+Reference scenarios: consumingSegmentsInfo + /tables/{t}/ingestionStatus
+(PinotRealtimeTableResource), /health vs /health/readiness (ServiceStatus),
+and the broker's Math.min reduce of minConsumingFreshnessTimeMs.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.enclosure import QuickCluster
+from pinot_tpu.ingest.stream import MemoryStream
+from pinot_tpu.query import stats as qstats
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.table import StreamConfig, TableConfig, TableType
+from pinot_tpu.utils.metrics import get_registry
+
+from conftest import wait_until
+
+
+@pytest.fixture(autouse=True)
+def _reset_streams():
+    MemoryStream.reset_all()
+    yield
+    MemoryStream.reset_all()
+
+
+def rt_schema():
+    return Schema("events", [
+        dimension("user", DataType.STRING),
+        metric("value", DataType.DOUBLE),
+        date_time("ts", DataType.LONG),
+    ])
+
+
+def rt_config(flush_rows=200):
+    return TableConfig(
+        "events", table_type=TableType.REALTIME, replication=1,
+        time_column="ts",
+        stream=StreamConfig(stream_type="memory", topic="events_topic",
+                            decoder="json", flush_threshold_rows=flush_rows))
+
+
+def produce(partition, n, ts_base=None):
+    ts_base = ts_base if ts_base is not None else int(time.time() * 1000)
+    stream = MemoryStream.get("events_topic")
+    for i in range(n):
+        stream.produce(json.dumps({"user": f"u{i}", "value": float(i),
+                                   "ts": ts_base + i}), partition=partition)
+
+
+def rt_cluster(tmp_path, num_servers=2, flush_rows=200):
+    cluster = QuickCluster(num_servers=num_servers, work_dir=str(tmp_path))
+    cfg = rt_config(flush_rows)
+    cluster.create_realtime_table(rt_schema(), cfg, num_partitions=2)
+    return cluster, cfg
+
+
+# ---------------------------------------------------------------------------
+# Units: lag tracker, stats min-merge, gauge history, periodic task metrics
+# ---------------------------------------------------------------------------
+
+def test_consumer_lag_tracker_units():
+    from pinot_tpu.ingest.realtime import ConsumerLagTracker
+    tr = ConsumerLagTracker("events_REALTIME", 0)
+    assert tr.rows_indexed == 0 and tr.last_consumed_ms is None
+    tr.on_batch(10, 8, 1_700_000_000_000)
+    assert tr.rows_indexed == 8
+    assert tr.rows_filtered == 2
+    assert tr.last_event_time_ms == 1_700_000_000_000
+    assert tr.last_consumed_ms is not None
+    # event-time high-water only moves forward
+    tr.on_batch(5, 5, 1_600_000_000_000)
+    assert tr.last_event_time_ms == 1_700_000_000_000
+    assert tr.rows_indexed == 13
+    # empty fetch: no last_consumed bump
+    before = tr.last_consumed_ms
+    tr.on_batch(0, 0, None)
+    assert tr.last_consumed_ms == before
+    tr.on_error()
+    assert tr.errors == 1
+
+
+def test_execution_stats_min_merge_units():
+    a = qstats.ExecutionStats()
+    a.set_min(qstats.MIN_CONSUMING_FRESHNESS_TIME_MS, 2000)
+    a.set_min(qstats.MIN_CONSUMING_FRESHNESS_TIME_MS, 3000)   # loses
+    assert a.counters[qstats.MIN_CONSUMING_FRESHNESS_TIME_MS] == 2000
+    b = qstats.ExecutionStats()
+    b.set_min(qstats.MIN_CONSUMING_FRESHNESS_TIME_MS, 1500)
+    b.add(qstats.NUM_CONSUMING_SEGMENTS_QUERIED, 2)
+    a.add(qstats.NUM_CONSUMING_SEGMENTS_QUERIED, 1)
+    a.merge(b)
+    # min-merged, not summed; counters still sum
+    assert a.counters[qstats.MIN_CONSUMING_FRESHNESS_TIME_MS] == 1500
+    assert a.counters[qstats.NUM_CONSUMING_SEGMENTS_QUERIED] == 3
+    # a side missing the key must NOT zero it out
+    c = qstats.ExecutionStats()
+    c.merge(a)
+    c.merge(qstats.ExecutionStats())
+    assert c.counters[qstats.MIN_CONSUMING_FRESHNESS_TIME_MS] == 1500
+    pub = c.to_public_dict()
+    assert pub[qstats.MIN_CONSUMING_FRESHNESS_TIME_MS] == 1500
+    assert isinstance(pub[qstats.MIN_CONSUMING_FRESHNESS_TIME_MS], int)
+    # never zero-filled: a record that touched no consuming segment omits it
+    empty_pub = qstats.ExecutionStats().to_public_dict()
+    assert qstats.MIN_CONSUMING_FRESHNESS_TIME_MS not in empty_pub
+    assert empty_pub[qstats.NUM_CONSUMING_SEGMENTS_QUERIED] == 0
+
+
+def test_merge_segment_results_min_rule():
+    from pinot_tpu.query.reduce import SegmentResult, merge_segment_results
+    r1 = SegmentResult("selection", stats={
+        "numDocsScanned": 10,
+        qstats.MIN_CONSUMING_FRESHNESS_TIME_MS: 5000})
+    r2 = SegmentResult("selection", stats={
+        "numDocsScanned": 7,
+        qstats.MIN_CONSUMING_FRESHNESS_TIME_MS: 4000})
+    r3 = SegmentResult("selection", stats={"numDocsScanned": 3})
+    merged = merge_segment_results([r1, r2, r3], aggs=[])
+    assert merged.stats["numDocsScanned"] == 20
+    assert merged.stats[qstats.MIN_CONSUMING_FRESHNESS_TIME_MS] == 4000
+
+
+def test_gauge_history_ring_bounded():
+    from pinot_tpu.utils.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    g = reg.gauge("pinot_server_realtime_offset_lag", {"table": "t"})
+    for i in range(g.HISTORY_LEN + 60):
+        g.set(i)
+    hist = g.history()
+    assert len(hist) == g.HISTORY_LEN          # bounded ring
+    assert hist[-1][1] == g.HISTORY_LEN + 59   # newest kept
+    assert hist[0][1] == 60                    # oldest evicted
+    assert all(ts > 0 for ts, _v in hist)
+    reg.gauge("pinot_broker_queries_g").set(1)
+    series = reg.gauge_histories("pinot_server")
+    assert list(series) == ["pinot_server_realtime_offset_lag{table=t}"]
+    assert len(series["pinot_server_realtime_offset_lag{table=t}"]) == \
+        g.HISTORY_LEN
+
+
+def test_periodic_task_error_metrics():
+    from pinot_tpu.utils.periodic import PeriodicTask, PeriodicTaskScheduler
+    reg = get_registry()
+    boom = PeriodicTask("BoomTask", 60.0,
+                        lambda: (_ for _ in ()).throw(RuntimeError("nope")))
+    base = reg.counter_value("pinot_periodic_task_errors", {"task": "BoomTask"})
+    boom.run_once()
+    boom.run_once()
+    assert boom.run_count == 2 and boom.error_count == 2
+    assert reg.counter_value("pinot_periodic_task_errors",
+                             {"task": "BoomTask"}) == base + 2
+    st = boom.stats()
+    assert st["errorCount"] == 2 and st["lastError"] == "RuntimeError: nope"
+    assert st["lastRunMs"] is not None
+    # a clean run clears the stale error
+    boom.fn = lambda: None
+    boom.run_once()
+    assert boom.stats()["lastError"] is None
+    sched = PeriodicTaskScheduler()
+    sched.register(boom)
+    assert sched.stats()["BoomTask"]["runCount"] == 3
+
+
+# ---------------------------------------------------------------------------
+# In-proc cluster: lag growth, verdicts, pause/resume, stale gauges
+# ---------------------------------------------------------------------------
+
+def test_offset_lag_grows_and_degrades(tmp_path):
+    cluster, cfg = rt_cluster(tmp_path)
+    table = cfg.table_name_with_type
+    produce(0, 20)
+    produce(1, 20)
+    cluster.pump_realtime(table)
+    st = cluster.controller.ingestion_status(table)
+    assert st["ingestionState"] == "HEALTHY" and st["maxOffsetLag"] == 0
+    assert st["numConsumingSegments"] == 2
+
+    # consumers stall (nothing pumps): upstream offsets run ahead
+    produce(0, 30)
+    st = cluster.controller.ingestion_status(table)
+    assert st["maxOffsetLag"] == 30
+    assert st["ingestionState"] == "HEALTHY"     # under the default threshold
+    cluster.catalog.put_property(
+        "clusterConfig/controller.ingestion.offset.lag.threshold", "10")
+    st = cluster.controller.ingestion_status(table)
+    assert st["ingestionState"] == "DEGRADED"
+    assert any("offset lag" in r for r in st["reasons"])
+    # catching up clears the verdict
+    cluster.pump_realtime(table)
+    st = cluster.controller.ingestion_status(table)
+    assert st["ingestionState"] == "HEALTHY" and st["reasons"] == []
+    # per-partition server gauges exist with the lag detail
+    seg_stats = next(iter(st["servers"].values()))["segments"]
+    any_seg = next(iter(seg_stats.values()))
+    assert any_seg["currentOffset"] is not None
+    assert any_seg["latestStreamOffset"] is not None
+    assert any_seg["offsetLag"] == 0
+
+
+def test_pause_degrades_resume_heals(tmp_path):
+    cluster, cfg = rt_cluster(tmp_path)
+    table = cfg.table_name_with_type
+    produce(0, 10)
+    cluster.pump_realtime(table)
+    assert cluster.controller.ingestion_status(table)["ingestionState"] == \
+        "HEALTHY"
+    cluster.controller.llc.pause_consumption(table)
+    st = cluster.controller.ingestion_status(table)
+    assert st["ingestionState"] == "DEGRADED"
+    assert st["paused"] is True
+    assert any("paused" in r for r in st["reasons"])
+    cluster.controller.llc.resume_consumption(table)
+    cluster.pump_realtime(table)
+    st = cluster.controller.ingestion_status(table)
+    assert st["ingestionState"] == "HEALTHY" and st["paused"] is False
+
+
+def test_ingestion_gauges_and_stale_removal(tmp_path):
+    cluster, cfg = rt_cluster(tmp_path)
+    table = cfg.table_name_with_type
+    produce(0, 5)
+    cluster.pump_realtime(table)
+    assert cluster.controller.run_ingestion_status_check() == \
+        {table: "HEALTHY"}
+    snap = get_registry().snapshot()
+    key = f"pinot_controller_ingestion_healthy{{table={table}}}"
+    assert snap[key] == 1
+    assert f"pinot_controller_ingestion_offset_lag{{table={table}}}" in snap
+    # cached rollup feeds the controller /debug view (no per-server detail)
+    dbg = cluster.controller.debug_stats()
+    assert dbg["ingestionStatus"][table]["ingestionState"] == "HEALTHY"
+    assert "servers" not in dbg["ingestionStatus"][table]
+    assert "IngestionStatusChecker" in dbg["periodicTasks"]
+
+    cluster.controller.drop_table(table)
+    assert cluster.controller.run_ingestion_status_check() == {}
+    snap = get_registry().snapshot()
+    assert key not in snap
+    assert f"pinot_controller_ingestion_offset_lag{{table={table}}}" not in snap
+    assert f"pinot_controller_ingestion_freshness_lag_ms{{table={table}}}" \
+        not in snap
+
+
+def test_server_lag_gauges_removed_on_stop(tmp_path):
+    cluster, cfg = rt_cluster(tmp_path)
+    table = cfg.table_name_with_type
+    produce(0, 5)
+    cluster.pump_realtime(table)
+    cluster.servers[0].ingestion_snapshot()      # exports per-partition gauges
+    assert any(k.startswith("pinot_server_realtime_offset_lag")
+               for k in get_registry().snapshot())
+    cluster.controller.drop_table(table)
+    assert wait_until(
+        lambda: not any(k.startswith("pinot_server_realtime_offset_lag")
+                        for k in get_registry().snapshot()),
+        timeout=10.0, interval=0.05, swallow=())
+
+
+def test_consuming_query_stats_min_merge_in_proc(tmp_path):
+    """Two partitions with different event-time high-waters: the response's
+    minConsumingFreshnessTimeMs is the MIN across consuming segments (stalest
+    wins), while numConsumingSegmentsQueried sums."""
+    cluster, cfg = rt_cluster(tmp_path)
+    table = cfg.table_name_with_type
+    now = int(time.time() * 1000)
+    produce(0, 10, ts_base=now - 10)             # fresh partition
+    produce(1, 10, ts_base=now - 60_000)         # stale partition
+    cluster.pump_realtime(table)
+    res = cluster.query("SELECT COUNT(*) FROM events LIMIT 5")
+    assert res.rows[0][0] == 20
+    assert res.stats["numConsumingSegmentsQueried"] == 2
+    assert res.stats["minConsumingFreshnessTimeMs"] == now - 60_000 + 9
+    # an offline-only query carries no freshness key at all
+    assert "minConsumingFreshnessTimeMs" not in \
+        qstats.ExecutionStats().to_public_dict()
+
+
+def test_ingestion_status_unknown_and_offline_tables(tmp_path):
+    cluster, cfg = rt_cluster(tmp_path)
+    with pytest.raises(ValueError):
+        cluster.controller.ingestion_status("nope_REALTIME")
+    schema = Schema("off", [dimension("site", DataType.STRING),
+                            metric("v", DataType.DOUBLE)])
+    cluster.create_table(schema, TableConfig("off"))
+    st = cluster.controller.ingestion_status("off_OFFLINE")
+    assert st["ingestionState"] == "HEALTHY"
+    assert "offline" in st["message"]
+    # offline tables never get ingestion gauges
+    cluster.controller.run_ingestion_status_check()
+    assert "pinot_controller_ingestion_healthy{table=off_OFFLINE}" not in \
+        get_registry().snapshot()
+
+
+# ---------------------------------------------------------------------------
+# HTTP plane: health split, /debug/consuming, ingestionStatus, E2E demo
+# ---------------------------------------------------------------------------
+
+def test_health_and_ingestion_over_http(tmp_path):
+    """The acceptance-criteria demo over real HTTP: (a) /health liveness vs
+    /health/readiness gating, (b) ingestionStatus DEGRADED with a lag reason
+    while paused / HEALTHY after resume, (c) a query over consuming segments
+    returning numConsumingSegmentsQueried + min-merged
+    minConsumingFreshnessTimeMs on the HTTP transport."""
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import CONSUMING, Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.http_service import HttpError, get_json, http_call
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+
+    catalog = Catalog()
+    controller = Controller("controller_0", catalog,
+                            LocalDeepStore(str(tmp_path / "ds")),
+                            str(tmp_path / "ctrl"))
+    csvc = ControllerService(controller)
+    services = [csvc]
+    try:
+        nodes = [ServerNode(f"server_{i}", catalog,
+                            LocalDeepStore(str(tmp_path / "ds")),
+                            str(tmp_path / f"server_{i}"),
+                            completion=controller.llc) for i in range(2)]
+        for n in nodes:
+            services.append(ServerService(n))
+        broker = Broker("broker_0", catalog)
+        bsvc = BrokerService(broker)
+        services.append(bsvc)
+        surl = services[1].url
+
+        # (a) liveness vs readiness: a ghost ideal-state assignment makes
+        # server_0 not data-ready — /health stays 200, readiness goes 503
+        assert get_json(f"{surl}/health")["instance"] == "server_0"
+        assert get_json(f"{surl}/health/readiness")["ready"] is True
+        with catalog._lock:
+            catalog.ideal_state.setdefault("ghost_REALTIME", {})[
+                "ghost__0__0__x"] = {"server_0": CONSUMING}
+        assert get_json(f"{surl}/health")["status"] == "UP"   # still alive
+        with pytest.raises(HttpError) as ei:
+            http_call("GET", f"{surl}/health/readiness", timeout=5.0)
+        assert ei.value.status == 503
+        with catalog._lock:
+            del catalog.ideal_state["ghost_REALTIME"]
+        assert get_json(f"{surl}/health/readiness")["ready"] is True
+
+        # realtime table over the shared catalog; consumers attach in-proc
+        controller.add_schema(rt_schema())
+        cfg = rt_config()
+        MemoryStream.create("events_topic", 2)
+        controller.add_realtime_table(cfg, num_partitions=2)
+        table = cfg.table_name_with_type
+        now = int(time.time() * 1000)
+        produce(0, 10, ts_base=now - 10)
+        produce(1, 10, ts_base=now - 60_000)
+        for n in nodes:
+            mgr = n.realtime_manager(table)
+            if mgr is not None:
+                mgr.pump_all()
+
+        # (c) consuming stats over the HTTP transport (broker scatters to the
+        # servers' /query routes registered from advertised instance ports)
+        def http_count():
+            try:
+                r = json.loads(http_call(
+                    "POST", f"{bsvc.url}/query",
+                    json.dumps({"sql": "SELECT COUNT(*) FROM events LIMIT 5"}
+                               ).encode()).decode())
+                rows = r["resultTable"]["rows"]
+                return r if rows and rows[0][0] == 20 else None
+            except Exception:
+                return None
+        assert wait_until(lambda: http_count() is not None,
+                          timeout=20.0, interval=0.2, swallow=())
+        resp = http_count()
+        # the merged stats record is spread at the response top level
+        assert resp["numConsumingSegmentsQueried"] == 2
+        assert resp["minConsumingFreshnessTimeMs"] == now - 60_000 + 9
+
+        # server /debug/consuming: per-segment offsets + lag over HTTP
+        snap = get_json(f"{surl}/debug/consuming")
+        assert snap["instance"] == "server_0"
+        segs = snap["tables"][table]["segments"]
+        assert all(s["currentOffset"] is not None for s in segs.values())
+
+        # (b) ingestionStatus over HTTP: HEALTHY -> paused DEGRADED with a
+        # reason -> HEALTHY after resume (controller polls the servers' own
+        # /debug/consuming routes)
+        st = get_json(f"{csvc.url}/tables/{table}/ingestionStatus")
+        assert st["ingestionState"] == "HEALTHY"
+        assert st["numConsumingSegments"] == 2
+        controller.llc.pause_consumption(table)
+        # stall some backlog behind the paused table for the lag detail
+        produce(0, 25)
+        catalog.put_property(
+            "clusterConfig/controller.ingestion.offset.lag.threshold", "10")
+        st = get_json(f"{csvc.url}/tables/{table}/ingestionStatus")
+        assert st["ingestionState"] == "DEGRADED"
+        assert any("paused" in r for r in st["reasons"])
+        controller.llc.resume_consumption(table)
+        for n in nodes:
+            mgr = n.realtime_manager(table)
+            if mgr is not None:
+                mgr.pump_all()
+        st = get_json(f"{csvc.url}/tables/{table}/ingestionStatus")
+        assert st["ingestionState"] == "HEALTHY", st["reasons"]
+
+        # controller + server /debug rollups over HTTP
+        cdbg = get_json(f"{csvc.url}/debug")
+        assert "IngestionStatusChecker" in cdbg["periodicTasks"]
+        sdbg = get_json(f"{surl}/debug")
+        assert "gaugeHistories" in sdbg
+        # 404 for an unknown table's ingestionStatus
+        with pytest.raises(HttpError) as ei:
+            http_call("GET", f"{csvc.url}/tables/nope_REALTIME/ingestionStatus",
+                      timeout=5.0)
+        assert ei.value.status == 404
+    finally:
+        for s in services:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# cluster_top tool (pure snapshot/render with an injected fetcher)
+# ---------------------------------------------------------------------------
+
+def test_cluster_top_snapshot_and_render():
+    from pinot_tpu.tools.cluster_top import render, snapshot
+    pages = {
+        "http://c/tables": {"tables": ["ev_REALTIME", "off_OFFLINE"]},
+        "http://c/tables/ev_REALTIME/ingestionStatus": {
+            "table": "ev_REALTIME", "ingestionState": "DEGRADED",
+            "reasons": ["consumption is paused"], "paused": True,
+            "numConsumingSegments": 2, "maxOffsetLag": 12345,
+            "maxFreshnessLagMs": 90_000, "totalRowsPerSecond": 42.5},
+        "http://c/tables/off_OFFLINE/ingestionStatus": {
+            "table": "off_OFFLINE", "ingestionState": "HEALTHY",
+            "reasons": [], "numConsumingSegments": 0, "maxOffsetLag": 0,
+            "maxFreshnessLagMs": 0, "totalRowsPerSecond": 0.0},
+        "http://c/debug": {"periodicTasks": {
+            "SegmentStatusChecker": {"errorCount": 0, "lastError": None},
+            "RetentionManager": {"errorCount": 3,
+                                 "lastError": "RuntimeError: boom"}}},
+        "http://b/debug": {"queryStats": {"numQueries": 7, "avgTimeMs": 3.2,
+                                          "numSlowQueries": 1}},
+    }
+    snap = snapshot("http://c", "http://b", pages.__getitem__)
+    assert set(snap["tables"]) == {"ev_REALTIME", "off_OFFLINE"}
+    assert snap["broker"]["numQueries"] == 7
+    out = render(snap)
+    assert "ev_REALTIME" in out and "DEGRADED" in out and "HEALTHY" in out
+    assert "12345" in out               # offset lag column
+    assert "1.5m" in out                # 90s freshness lag, humanized
+    assert "queries=7" in out
+    assert "RetentionManager" in out and "boom" in out
+    assert "SegmentStatusChecker" not in out.split("RetentionManager")[1]
+
+    # endpoint failures degrade to partial data, not a crash
+    def flaky(url):
+        if url.endswith("/debug"):
+            raise OSError("connection refused")
+        return pages[url]
+    snap2 = snapshot("http://c", "http://b", flaky)
+    assert len(snap2["errors"]) == 2    # broker + controller debug both down
+    assert "DEGRADED" in render(snap2)
+
+
+def test_cluster_top_render_empty():
+    from pinot_tpu.tools.cluster_top import render
+    out = render({"tables": {}, "broker": None, "errors": ["controller: x"],
+                  "periodicTasks": {}})
+    assert "(no tables)" in out and "controller: x" in out

@@ -266,6 +266,45 @@ def test_replace_segments_lineage_hides_both_sides(tmp_path):
     assert cluster.query("SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 40
 
 
+def test_convert_to_raw_rewrite_preserves_nulls(tmp_path):
+    """Segment-rewrite null preservation: a minion rewrite reads columns back
+    through read_columns, which must restore None at null-bitmap positions —
+    a rewrite that materializes default-value fills would silently turn
+    `cost IS NULL` rows into zeros in the replacement segment. The conversion
+    targets `clicks`; the nulls live in `cost`, which merely rides along
+    through the rebuild (a null-carrying column is raw-encoded from birth,
+    so it can never be the conversion target itself)."""
+    from pinot_tpu.minion.tasks import CONVERT_TO_RAW_INDEX
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = event_schema()
+    cfg = TableConfig(
+        schema.name,
+        task_configs={CONVERT_TO_RAW_INDEX: {"columnsToConvert": ["clicks"]}})
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(11)
+    cols = make_cols(rng, 100, 0)
+    cost = np.asarray(cols["cost"]).astype(object)
+    cost[::7] = None                      # 15 null cells at known positions
+    cols["cost"] = cost
+    cluster.ingest_columns(cfg, cols)
+    table = cfg.table_name_with_type
+    (old_name,) = cluster.catalog.segments[table]
+    n_null = cluster.query(
+        "SELECT COUNT(*) FROM events WHERE cost IS NULL LIMIT 5").rows[0][0]
+    assert n_null == 15
+
+    done = cluster.run_minion_round()
+    assert [t.state for t in done] == [COMPLETED], [t.error for t in done]
+    (new_name,) = cluster.catalog.segments[table]
+    assert new_name != old_name           # the segment really was rewritten
+    assert cluster.query(
+        "SELECT COUNT(*) FROM events WHERE cost IS NULL LIMIT 5"
+    ).rows[0][0] == 15
+    assert cluster.query(
+        "SELECT COUNT(*) FROM events LIMIT 5").rows[0][0] == 100
+
+
 def test_convert_to_raw_index_noop_does_not_churn(tmp_path):
     """A segment whose target columns are ALREADY raw gets one no-op task,
     lands in the done-set, and is never generated again (an unmarked no-op
