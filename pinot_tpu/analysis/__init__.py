@@ -1,0 +1,37 @@
+"""graftcheck: repo-native static analysis for the pinot_tpu codebase.
+
+Every regression class this repo has shipped was statically detectable — the
+PR 2 `_const` jit-cache shape collision, the PR 3 unfenced-compile timing bug,
+the PR 4 advisor findings (unlocked `Histogram.observe`, stale queued futures,
+null-bitmap-dropping rewrites). graftcheck encodes those lessons as four
+codebase-specific rule packs over stdlib `ast` (no new dependencies):
+
+* **jit-hygiene** — host/device boundary discipline: implicit host syncs on
+  traced values, device fetches outside the sanctioned fetch sites, literal
+  arrays rebuilt inside jit'd functions, unhashable jit cache-key components.
+* **lock-discipline** — for lock-owning classes: attributes written both
+  under and outside their lock, manual acquire()/release(), daemon threads
+  with no join/stop path.
+* **blocking-in-loop** — unbounded `Future.result()` / queue `.get()` waits
+  and sleeps inside dispatcher/fetcher loops and HTTP handlers.
+* **drift-guards** — declarative docs-vs-code guards: metric registry vs the
+  README glossary, ExecutionStats constants vs the merge/export key lists,
+  clusterConfig keys referenced in code vs documented defaults.
+
+Run it:  ``python -m pinot_tpu.analysis [--format text|json] [--update-baseline]``
+
+Findings are suppressed inline with
+``# graftcheck: ignore[rule-id] -- reason`` (the reason is mandatory) or
+accepted wholesale in ``analysis/baseline.json`` so only NEW findings fail;
+the tier-1 suite runs the whole thing via ``tests/test_analysis.py``.
+"""
+
+from .core import (AnalysisContext, Finding, Module, Rule, all_rules,
+                   collect_modules, load_baseline, run_rules, run_project,
+                   unbaselined)
+
+__all__ = [
+    "AnalysisContext", "Finding", "Module", "Rule", "all_rules",
+    "collect_modules", "load_baseline", "run_rules", "run_project",
+    "unbaselined",
+]

@@ -1,0 +1,77 @@
+"""graftcheck CLI: `python -m pinot_tpu.analysis [paths...]`.
+
+Exit codes: 0 = clean (or every finding baselined/suppressed), 1 = new
+findings, 2 = bad usage. `--update-baseline` rewrites baseline.json to accept
+the current findings (review the diff — a growing baseline is a smell).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import Counter
+from typing import List
+
+from .core import (BASELINE_PATH, Finding, all_rules, load_baseline,
+                   run_project, save_baseline, unbaselined)
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.analysis",
+        description="graftcheck: repo-native static analysis for pinot_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyse (default: the pinot_tpu "
+                         "package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file (default: the committed "
+                         "analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept current findings into the baseline and "
+                         "exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:28s} {rule.description}")
+        return 0
+
+    t0 = time.perf_counter()
+    findings, suppressed, _ctx = run_project(args.paths or None)
+    if args.update_baseline:
+        save_baseline(findings, args.baseline)
+        print(f"baseline updated: {len(findings)} finding(s) accepted "
+              f"-> {args.baseline}")
+        return 0
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new = unbaselined(findings, baseline)
+    elapsed = time.perf_counter() - t0
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.__dict__ for f in new],
+            "baselined": len(findings) - len(new),
+            "suppressed": len(suppressed),
+            "elapsedS": round(elapsed, 3),
+        }, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    by_rule = Counter(f.rule for f in new)
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    print(f"graftcheck: {len(new)} new finding(s)"
+          + (f" [{summary}]" if summary else "")
+          + f", {len(findings) - len(new)} baselined, "
+          f"{len(suppressed)} suppressed ({elapsed:.2f}s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""blocking-in-loop rules: unbounded waits in loops and handlers.
+
+The PR 1/PR 2 timeout work taught this shape: a dispatcher/fetcher loop or an
+HTTP handler that blocks without a bound turns overload into a hang — the
+device pipeline must DEGRADE (fall back to the host path, shed the request)
+rather than wedge a thread forever. Three rules:
+
+* `blocking-result-no-timeout` — `fut.result()` with no timeout, anywhere:
+  the producer side being overloaded/crashed parks the caller forever;
+* `blocking-queue-get` — queue `.get()` with neither timeout nor _nowait on
+  queue-named receivers: a stop() can never wake the consumer;
+* `blocking-sleep-in-loop` — `time.sleep`/un-timed `http_call` inside
+  `*_loop`/handler functions: the loop cannot observe its stop event while
+  sleeping, and a handler thread holding a connection must not nap.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+#: function names that mark dispatcher/fetcher loops and HTTP handlers
+_LOOP_FN_RE = re.compile(r"(_loop$|^_handle|^handle_|^do_[A-Z]|^serve)")
+
+#: receiver terminal names treated as queues for the .get() rule
+_QUEUE_NAME_RE = re.compile(r"(queue|(^|_)q$|q$)", re.IGNORECASE)
+
+#: blocking call roots that must carry a timeout inside loops/handlers
+_NETWORK_CALLS = {"http_call", "urlopen", "urllib.request.urlopen"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _in_loop_function(node: ast.AST) -> str:
+    """Name of the nearest enclosing loop/handler-shaped function ('' when
+    none)."""
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _LOOP_FN_RE.search(cur.name):
+                return cur.name
+            return ""
+        cur = getattr(cur, "graft_parent", None)
+    return ""
+
+
+class ResultNoTimeoutRule(Rule):
+    id = "blocking-result-no-timeout"
+    description = ("Future.result() without a timeout hangs the caller when "
+                   "the producer is overloaded")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func).split(".")[-1] == "as_completed" and \
+                    not _has_timeout(node):
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    "`as_completed(...)` without a timeout — one hung "
+                    "server parks the whole gather forever; bound the "
+                    "iteration and degrade on expiry"))
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "result" and \
+                    not node.args and not _has_timeout(node) and \
+                    not self._is_completed_future(node):
+                recv = dotted_name(node.func.value) or "<expr>"
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"`{recv}.result()` without a timeout — an overloaded "
+                    "or dead producer parks this thread forever; pass "
+                    "timeout= and degrade on expiry"))
+        return out
+
+    @staticmethod
+    def _is_completed_future(node: ast.Call) -> bool:
+        """True when the receiver is the loop variable of an enclosing
+        `for X in as_completed(...)` — those futures are already done, so
+        .result() cannot block (the as_completed call carries the bound)."""
+        recv = node.func.value
+        if not isinstance(recv, ast.Name):
+            return False
+        def _from_as_completed(target: ast.AST, it: ast.AST) -> bool:
+            return (isinstance(target, ast.Name) and
+                    target.id == recv.id and
+                    isinstance(it, ast.Call) and
+                    dotted_name(it.func).split(".")[-1] == "as_completed")
+
+        cur = getattr(node, "graft_parent", None)
+        while cur is not None:
+            if isinstance(cur, ast.For) and \
+                    _from_as_completed(cur.target, cur.iter):
+                return True
+            if isinstance(cur, (ast.ListComp, ast.SetComp,
+                                ast.GeneratorExp, ast.DictComp)) and \
+                    any(_from_as_completed(g.target, g.iter)
+                        for g in cur.generators):
+                return True
+            cur = getattr(cur, "graft_parent", None)
+        return False
+
+
+class QueueGetNoTimeoutRule(Rule):
+    id = "blocking-queue-get"
+    description = ("queue .get() without timeout/_nowait cannot observe a "
+                   "stop event")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == "get" and
+                    not node.args):
+                continue
+            if _has_timeout(node) or any(kw.arg == "block"
+                                         for kw in node.keywords):
+                continue
+            recv = dotted_name(node.func.value)
+            terminal = recv.rsplit(".", 1)[-1]
+            if recv and _QUEUE_NAME_RE.search(terminal):
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"`{recv}.get()` blocks with no timeout — the consumer "
+                    "loop can never observe its stop event; use "
+                    "get(timeout=...) and loop on the stop flag"))
+        return out
+
+
+class SleepInLoopRule(Rule):
+    id = "blocking-sleep-in-loop"
+    description = ("time.sleep / un-timed network call inside a "
+                   "dispatcher/fetcher loop or HTTP handler")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            fn = _in_loop_function(node)
+            if not fn:
+                continue
+            if name == "time.sleep":
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"time.sleep inside `{fn}` — sleep blinds the loop to "
+                    "its stop event; wait on the event with a timeout "
+                    "instead (Event.wait(t))"))
+            elif name in _NETWORK_CALLS and not _has_timeout(node):
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"`{name}` without a timeout inside `{fn}` — a stalled "
+                    "peer wedges the loop; bound the call"))
+        return out
+
+
+def rules() -> List[Rule]:
+    return [ResultNoTimeoutRule(), QueueGetNoTimeoutRule(), SleepInLoopRule()]

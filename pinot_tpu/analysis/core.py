@@ -1,0 +1,332 @@
+"""graftcheck rule engine: Rule/Finding, package walker, suppressions, baseline.
+
+Design mirrors the metric registry's "one flat process-wide surface" idiom:
+one walk parses every module once, every rule sees every module (plus a
+project-level hook for cross-file drift guards), and the output is a flat
+finding list keyed by stable fingerprints.
+
+Fingerprints are `(rule, relative path, message)` — deliberately line-free so
+unrelated edits above a known finding don't churn the committed baseline.
+The baseline stores a COUNT per fingerprint: only findings *beyond* the
+baselined count are "new" and fail the run.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: inline suppression: `# graftcheck: ignore[rule-id, ...] -- reason`.
+#: The reason is MANDATORY — a suppression without one is itself a finding
+#: (bad-suppression), because "why is this OK" is the whole point of the
+#: mechanism.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore\[([A-Za-z0-9_*,\- ]+)\]"
+    r"(?:\s*--\s*(?P<reason>\S.*))?")
+
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # repo-relative posix path
+    line: int
+    message: str
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Module:
+    """One parsed source file: tree + raw lines + inline suppressions."""
+
+    def __init__(self, abspath: str, rel: str, source: str):
+        self.path = abspath
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        #: line -> set of rule ids suppressed on that line ('*' = all)
+        self.suppressions: Dict[int, set] = {}
+        #: suppression comments missing their `-- reason` (line numbers)
+        self.bad_suppressions: List[int] = []
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            line = tok.start[0]
+            if not m.group("reason"):
+                self.bad_suppressions.append(line)
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            standalone = self.lines[line - 1].lstrip().startswith("#")
+            # a trailing comment suppresses its own line; a standalone
+            # comment suppresses the next CODE line (skipping the rest of a
+            # wrapped comment block)
+            target = line
+            if standalone:
+                target = line + 1
+                while target <= len(self.lines) and (
+                        not self.lines[target - 1].strip() or
+                        self.lines[target - 1].lstrip().startswith("#")):
+                    target += 1
+            self.suppressions.setdefault(target, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+
+@dataclass
+class AnalysisContext:
+    """Shared run state rules may consult (repo docs for drift guards)."""
+
+    repo_root: str
+    modules: List[Module] = field(default_factory=list)
+    _readme: Optional[str] = None
+
+    def module(self, rel_suffix: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.rel.endswith(rel_suffix):
+                return m
+        return None
+
+    def readme(self) -> str:
+        if self._readme is None:
+            path = os.path.join(self.repo_root, "README.md")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._readme = f.read()
+            except OSError:
+                self._readme = ""
+        return self._readme
+
+
+class Rule:
+    """Base rule: subclass and override one (or both) hooks.
+
+    `check_module` runs once per parsed file; `check_project` runs once per
+    analysis run with the full context (for cross-file drift guards)."""
+
+    id: str = "abstract"
+    description: str = ""
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        return ()
+
+
+# -- AST helpers shared by the rule packs ------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """Render `a.b.c` attribute/name chains ('' when not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Set `.graft_parent` on every node (rules walk up for enclosing scope)."""
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child.graft_parent = parent  # type: ignore[attr-defined]
+
+
+def enclosing(node: ast.AST, kinds) -> Optional[ast.AST]:
+    """Nearest ancestor of one of `kinds` (requires attach_parents)."""
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = getattr(cur, "graft_parent", None)
+    return None
+
+
+def is_constant_expr(node: ast.AST) -> bool:
+    """True for literal-only expressions (numbers, strings, and lists/tuples
+    thereof) — the `jnp.array([1, 2, 3])`-inside-jit shape of constant."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(is_constant_expr(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return is_constant_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return is_constant_expr(node.left) and is_constant_expr(node.right)
+    return False
+
+
+# -- walker ------------------------------------------------------------------
+
+def _iter_py_files(path: str) -> Iterable[str]:
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def repo_root_for_package() -> str:
+    """The directory holding the `pinot_tpu` package (== repo root)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def collect_modules(paths: Sequence[str], repo_root: Optional[str] = None
+                    ) -> List[Module]:
+    repo_root = repo_root or repo_root_for_package()
+    modules: List[Module] = []
+    for path in paths:
+        for fp in _iter_py_files(os.path.abspath(path)):
+            try:
+                rel = os.path.relpath(fp, repo_root)
+            except ValueError:  # different drive (windows) — keep absolute
+                rel = fp
+            rel = rel.replace(os.sep, "/")
+            if rel.startswith(".."):
+                rel = os.path.basename(fp)
+            with open(fp, encoding="utf-8") as f:
+                source = f.read()
+            modules.append(Module(fp, rel, source))
+    return modules
+
+
+def run_rules(rules: Sequence[Rule], modules: Sequence[Module],
+              ctx: AnalysisContext) -> Tuple[List[Finding], List[Finding]]:
+    """Run every rule; returns (active findings, suppressed findings).
+
+    Parse failures and reason-less suppressions surface as findings too —
+    a file the checker cannot read is not a clean file."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for m in modules:
+        if m.parse_error:
+            active.append(Finding(PARSE_ERROR, m.rel, 1, m.parse_error))
+        for line in m.bad_suppressions:
+            active.append(Finding(
+                BAD_SUPPRESSION, m.rel, line,
+                "graftcheck suppression without a `-- reason` "
+                "(the rationale is mandatory)"))
+        if m.tree is None:
+            continue
+        attach_parents(m.tree)
+        for rule in rules:
+            for f in rule.check_module(m, ctx):
+                (suppressed if m.suppressed(f.rule, f.line) else
+                 active).append(f)
+    by_rel = {m.rel: m for m in modules}
+    for rule in rules:
+        for f in rule.check_project(ctx):
+            m = by_rel.get(f.path)
+            if m is not None and m.suppressed(f.rule, f.line):
+                suppressed.append(f)
+            else:
+                active.append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return active, suppressed
+
+
+def all_rules() -> List[Rule]:
+    from . import blocking_in_loop, drift_guards, jit_hygiene, lock_discipline
+    rules: List[Rule] = []
+    for pack in (jit_hygiene, lock_discipline, blocking_in_loop, drift_guards):
+        rules.extend(pack.rules())
+    return rules
+
+
+def run_project(paths: Optional[Sequence[str]] = None,
+                rules: Optional[Sequence[Rule]] = None,
+                repo_root: Optional[str] = None
+                ) -> Tuple[List[Finding], List[Finding], AnalysisContext]:
+    """Analyse `paths` (default: the pinot_tpu package) with every rule."""
+    repo_root = repo_root or repo_root_for_package()
+    if not paths:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    modules = collect_modules(paths, repo_root)
+    ctx = AnalysisContext(repo_root=repo_root, modules=modules)
+    active, suppressed = run_rules(rules if rules is not None else all_rules(),
+                                   modules, ctx)
+    return active, suppressed, ctx
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, int]:
+    path = path or BASELINE_PATH
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {str(k): int(v) for k, v in data.get("fingerprints", {}).items()}
+
+
+def save_baseline(findings: Sequence[Finding],
+                  path: Optional[str] = None) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    payload = {"version": 1,
+               "note": "accepted pre-existing graftcheck findings; only "
+                       "findings beyond these counts fail the run "
+                       "(python -m pinot_tpu.analysis --update-baseline)",
+               "fingerprints": dict(sorted(counts.items()))}
+    with open(path or BASELINE_PATH, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def unbaselined(findings: Sequence[Finding],
+                baseline: Dict[str, int]) -> List[Finding]:
+    """Findings beyond their baselined count (order-stable)."""
+    remaining = dict(baseline)
+    out: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            out.append(f)
+    return out

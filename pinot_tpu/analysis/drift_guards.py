@@ -1,0 +1,225 @@
+"""drift-guard rules: declarative docs-vs-code guards.
+
+PRs 3 and 4 each grew a one-off "is the README still true" test (stats keys
+vs the Observability glossary, registered metrics vs the metric table). This
+pack generalizes them into static rules — the static form covers every
+`registry.counter("pinot_...")` call site in the package, not just the ones a
+test run happens to execute:
+
+* `drift-metric-glossary` — every `pinot_*` metric name passed to a registry
+  factory must appear in README.md's Observability metric glossary;
+* `drift-stats-keys` — every ExecutionStats key constant must be listed in a
+  merge/export table (COUNTER_KEYS/MIN_KEYS/BROKER_KEYS) and documented, and
+  raw string literals must not bypass the constants;
+* `drift-cluster-config` — every `clusterConfig/...` key read in code must be
+  documented in the README.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+_REGISTRY_FACTORIES = ("counter", "gauge", "timer", "histogram")
+_STATS_MODULE = "pinot_tpu/query/stats.py"
+_KEY_TABLES = ("COUNTER_KEYS", "MIN_KEYS", "BROKER_KEYS")
+
+
+def _observability_section(readme: str) -> str:
+    if "## Observability" not in readme:
+        return ""
+    tail = readme.split("## Observability", 1)[1]
+    # section ends at the next same-level heading
+    m = re.search(r"\n## ", tail)
+    return tail[:m.start()] if m else tail
+
+
+def _documented_metric_names(readme: str) -> Set[str]:
+    return set(re.findall(r"`(pinot_[a-z0-9_]+)`",
+                          _observability_section(readme)))
+
+
+def _documented_stats_keys(readme: str) -> Set[str]:
+    return set(re.findall(r"`([A-Za-z][A-Za-z.]*)`",
+                          _observability_section(readme)))
+
+
+class MetricGlossaryRule(Rule):
+    id = "drift-metric-glossary"
+    description = ("every pinot_* metric registered in code must be in the "
+                   "README Observability metric glossary")
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        documented = _documented_metric_names(ctx.readme())
+        if not documented:   # scanning outside the repo (scratch fixtures)
+            return ()
+        out: List[Finding] = []
+        for module, line, name, is_prefix in self._registered_names(ctx):
+            ok = (any(d.startswith(name) for d in documented) if is_prefix
+                  else name in documented)
+            if not ok:
+                what = f"prefix `{name}...`" if is_prefix else f"`{name}`"
+                out.append(Finding(
+                    self.id, module.rel, line,
+                    f"metric {what} is registered here but missing from "
+                    "README.md's Observability metric glossary — document "
+                    "it before shipping it"))
+        return out
+
+    @staticmethod
+    def _registered_names(ctx: AnalysisContext
+                          ) -> Iterable[Tuple[Module, int, str, bool]]:
+        """(module, line, name-or-prefix, is_prefix) for each registry
+        factory call with a pinot_* name (f-strings contribute their literal
+        prefix)."""
+        for module in ctx.modules:
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _REGISTRY_FACTORIES and node.args):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value.startswith("pinot_"):
+                    yield module, node.lineno, arg.value, False
+                elif isinstance(arg, ast.JoinedStr) and arg.values and \
+                        isinstance(arg.values[0], ast.Constant) and \
+                        str(arg.values[0].value).startswith("pinot_"):
+                    yield module, node.lineno, str(arg.values[0].value), True
+
+
+class StatsKeysRule(Rule):
+    id = "drift-stats-keys"
+    description = ("ExecutionStats key constants must be in a merge/export "
+                   "table and in the README glossary; no raw-string keys")
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        stats_mod = ctx.module(_STATS_MODULE)
+        if stats_mod is None or stats_mod.tree is None:
+            return ()
+        consts, tables, lines = self._stats_tables(stats_mod.tree)
+        known = set().union(*tables.values()) if tables else set()
+        out: List[Finding] = []
+        for name, value in consts.items():
+            if value not in known:
+                out.append(Finding(
+                    self.id, stats_mod.rel, lines.get(name, 1),
+                    f"stats key constant {name} = {value!r} is in no "
+                    f"merge/export table ({'/'.join(_KEY_TABLES)}) — it "
+                    "would silently drop during merge"))
+        documented = _documented_stats_keys(ctx.readme())
+        if documented:
+            for table in _KEY_TABLES:
+                for value in tables.get(table, ()):
+                    if value not in documented:
+                        out.append(Finding(
+                            self.id, stats_mod.rel, lines.get(table, 1),
+                            f"stats key {value!r} ({table}) is missing from "
+                            "README.md's Observability glossary"))
+        out.extend(self._raw_string_records(ctx, known))
+        return out
+
+    @staticmethod
+    def _stats_tables(tree: ast.AST):
+        """Module-level string constants, the key tables resolved to value
+        sets, and the source line of each assignment."""
+        consts: Dict[str, str] = {}
+        tables: Dict[str, Set[str]] = {}
+        lines: Dict[str, int] = {}
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if not isinstance(node, ast.Assign) or \
+                    len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            lines[name] = node.lineno
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                if name.isupper() and not name.startswith("_"):
+                    consts[name] = node.value.value
+            elif isinstance(node.value, ast.Tuple) and name in _KEY_TABLES:
+                vals: Set[str] = set()
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        vals.add(str(elt.value))
+                    elif isinstance(elt, ast.Name) and elt.id in consts:
+                        vals.add(consts[elt.id])
+                tables[name] = vals
+        return consts, tables, lines
+
+    @staticmethod
+    def _raw_string_records(ctx: AnalysisContext, known: Set[str]
+                            ) -> Iterable[Finding]:
+        """`qstats.record("rawKey")` bypassing the constants table."""
+        for module in ctx.modules:
+            if module.tree is None or module.rel == _STATS_MODULE:
+                continue
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call) and node.args and
+                        dotted_name(node.func).split(".")[-1] in
+                        ("record", "record_min")):
+                    continue
+                fname = dotted_name(node.func)
+                if not (fname.startswith("qstats.") or
+                        fname.startswith("stats.")):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and arg.value not in known:
+                    yield Finding(
+                        StatsKeysRule.id, module.rel, node.lineno,
+                        f"stats key {arg.value!r} recorded as a raw string "
+                        "— add a constant to query/stats.py and its "
+                        "merge/export table first")
+
+
+class ClusterConfigRule(Rule):
+    id = "drift-cluster-config"
+    description = ("clusterConfig keys read in code must be documented in "
+                   "the README")
+
+    #: calls whose first string arg is a clusterConfig key (controller helper)
+    _HELPER_RE = re.compile(r"_cluster_config")
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        readme = ctx.readme()
+        if not readme:
+            return ()
+        out: List[Finding] = []
+        for module, line, key in self._config_keys(ctx):
+            if key and key not in readme:
+                out.append(Finding(
+                    self.id, module.rel, line,
+                    f"clusterConfig key `{key}` is read here but documented "
+                    "nowhere in README.md — add it to the config docs"))
+        return out
+
+    def _config_keys(self, ctx: AnalysisContext
+                     ) -> Iterable[Tuple[Module, int, str]]:
+        for module in ctx.modules:
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        node.value.startswith("clusterConfig/"):
+                    key = node.value.split("/", 1)[1]
+                    if "." in key:
+                        yield module, node.lineno, key
+                elif isinstance(node, ast.Call) and node.args and \
+                        self._HELPER_RE.search(
+                            dotted_name(node.func).split(".")[-1]):
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str) and "." in arg.value:
+                        yield module, node.lineno, arg.value
+
+
+def rules() -> List[Rule]:
+    return [MetricGlossaryRule(), StatsKeysRule(), ClusterConfigRule()]

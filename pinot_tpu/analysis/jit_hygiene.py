@@ -1,0 +1,258 @@
+"""jit-hygiene rules: host/device boundary discipline for the jit/Pallas path.
+
+The served path stays fast only while dispatch remains asynchronous — one
+hidden host sync (a `float()` on a traced value, a stray `device_get`)
+serializes the pipelined dispatch loop behind a device round trip. These
+rules encode the repo's boundary contract:
+
+* hosts syncs (`float/int/bool/np.asarray` on jnp-produced values) are
+  findings wherever they appear;
+* `jax.device_get` / `block_until_ready` live ONLY in the sanctioned fetch
+  sites (the mesh combine layer, the kernel fetch/fence hooks, the pipeline
+  fetch loop) — everywhere else they are hidden syncs;
+* literal `jnp.array(...)` construction inside a jit'd function re-embeds the
+  constant every trace;
+* jit cache keys must be hashable and shape-complete (the PR 2 `_const`
+  collision keyed on dtype without shape).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .core import (AnalysisContext, Finding, Module, Rule, dotted_name,
+                   enclosing, is_constant_expr)
+
+#: modules allowed to block on the device: the batched combine/fetch layer,
+#: the kernel compile fence + timed fetch hook, and the pipeline fetcher
+SANCTIONED_FETCH_FILES = (
+    "pinot_tpu/parallel/combine.py",
+    "pinot_tpu/engine/kernels.py",
+    "pinot_tpu/cluster/device_server.py",
+)
+
+#: call roots that produce device/traced values
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+#: host materializers that force a sync when fed a device value
+_HOST_CASTS = {"float", "int", "bool"}
+_HOST_ARRAY_FNS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name.startswith(_DEVICE_PREFIXES)
+
+
+def _scopes(tree: ast.AST):
+    """The module plus every function, each visited as its own scope."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class HostSyncRule(Rule):
+    id = "jit-host-sync"
+    description = ("float()/int()/bool()/np.asarray() on a jnp-produced value "
+                   "forces a blocking host sync")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for scope in _scopes(module.tree):
+            tainted = self._device_names(scope)
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = dotted_name(node.func)
+                arg = node.args[0]
+                if not (fname in _HOST_CASTS or fname in _HOST_ARRAY_FNS):
+                    continue
+                if _is_device_call(arg) or (
+                        isinstance(arg, ast.Name) and arg.id in tainted):
+                    out.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        f"{fname}() on a jnp-produced value "
+                        f"({self._describe(arg)}) blocks on the device — "
+                        "fetch via the batched device_get path instead"))
+        return out
+
+    @staticmethod
+    def _describe(arg: ast.AST) -> str:
+        if isinstance(arg, ast.Name):
+            return arg.id
+        return dotted_name(getattr(arg, "func", arg)) or "expression"
+
+    @staticmethod
+    def _device_names(scope: ast.AST) -> Set[str]:
+        """Names assigned from jnp/lax calls within this scope, in order."""
+        tainted: Set[str] = set()
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and _is_device_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    _is_device_call(node.value) and \
+                    isinstance(node.target, ast.Name):
+                tainted.add(node.target.id)
+        return tainted
+
+
+class FetchSiteRule(Rule):
+    id = "jit-fetch-site"
+    description = ("jax.device_get/block_until_ready outside the sanctioned "
+                   "fetch sites is a hidden host sync")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if module.rel in SANCTIONED_FETCH_FILES:
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            is_sync = (name in ("jax.device_get", "jax.block_until_ready") or
+                       (isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "block_until_ready"))
+            if is_sync:
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"device sync `{name or node.func.attr}` outside the "
+                    "sanctioned fetch sites "
+                    f"({', '.join(SANCTIONED_FETCH_FILES)})"))
+        return out
+
+
+class LiteralRebuildRule(Rule):
+    id = "jit-literal-rebuild"
+    description = ("jnp.array(<literal>) inside a jit'd function re-embeds "
+                   "the constant on every trace — hoist it out")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        jitted = self._jitted_functions(module.tree)
+        out: List[Finding] = []
+        for fn in jitted:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = dotted_name(node.func)
+                if name in ("jnp.array", "jnp.asarray",
+                            "jax.numpy.array", "jax.numpy.asarray") and \
+                        is_constant_expr(node.args[0]):
+                    out.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        f"{name}(<literal>) inside jit'd `{fn.name}` is "
+                        "rebuilt every trace — hoist the constant to module "
+                        "scope or pass it as an argument"))
+        return out
+
+    @staticmethod
+    def _jitted_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+        """Functions decorated with *jit (incl. partial(jax.jit, ...)) or
+        passed by name to a jax.jit(...) call in the same module."""
+        jit_args: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func) in ("jax.jit", "jit") and \
+                    node.args and isinstance(node.args[0], ast.Name):
+                jit_args.add(node.args[0].id)
+        out: List[ast.FunctionDef] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name in jit_args or any(
+                    LiteralRebuildRule._is_jit_decorator(d)
+                    for d in node.decorator_list):
+                out.append(node)
+        return out
+
+    @staticmethod
+    def _is_jit_decorator(dec: ast.AST) -> bool:
+        name = dotted_name(dec)
+        if name.endswith("jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            fname = dotted_name(dec.func)
+            if fname.endswith("jit"):
+                return True
+            if fname in ("partial", "functools.partial") and dec.args and \
+                    dotted_name(dec.args[0]).endswith("jit"):
+                return True
+        return False
+
+
+class CacheKeyRule(Rule):
+    id = "jit-cache-key"
+    description = ("jit cache keys must be hashable and shape-complete "
+                   "(the PR 2 `_const` collision keyed dtype without shape)")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            key = self._cache_key_expr(node)
+            if key is None:
+                continue
+            problem = self._key_problem(key)
+            if problem:
+                out.append(Finding(self.id, module.rel, node.lineno, problem))
+        return out
+
+    @staticmethod
+    def _cache_key_expr(node: ast.AST) -> Optional[ast.AST]:
+        """The key expression of a kernel-cache access, if `node` is one:
+        `_cached_kernel(key, ...)` calls, or subscript stores/reads on dicts
+        whose name contains CACHE."""
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.endswith("_cached_kernel") and node.args:
+                return node.args[0]
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("get", "setdefault") and \
+                    "CACHE" in dotted_name(node.func.value).upper() and \
+                    node.args:
+                return node.args[0]
+        if isinstance(node, ast.Subscript) and \
+                "CACHE" in dotted_name(node.value).upper():
+            return node.slice
+        return None
+
+    @staticmethod
+    def _key_problem(key: ast.AST) -> Optional[str]:
+        dtype_roots: Set[str] = set()
+        shape_roots: Set[str] = set()
+        for sub in ast.walk(key):
+            if isinstance(sub, (ast.List, ast.Set, ast.Dict)):
+                return ("jit cache key contains an unhashable "
+                        f"{type(sub).__name__.lower()} literal — use a tuple")
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                if name in _HOST_ARRAY_FNS or \
+                        name.startswith(_DEVICE_PREFIXES):
+                    return (f"jit cache key contains `{name}(...)` — arrays "
+                            "are unhashable and key by identity, not shape")
+            if isinstance(sub, ast.Attribute):
+                root = dotted_name(sub.value)
+                if sub.attr == "dtype" and root:
+                    dtype_roots.add(root)
+                elif sub.attr == "shape" and root:
+                    shape_roots.add(root)
+        missing = dtype_roots - shape_roots
+        if missing:
+            root = sorted(missing)[0]
+            return (f"jit cache key includes `{root}.dtype` but not "
+                    f"`{root}.shape` — same-dtype/different-shape inputs "
+                    "collide (the PR 2 `_const` bug)")
+        return None
+
+
+def rules() -> List[Rule]:
+    return [HostSyncRule(), FetchSiteRule(), LiteralRebuildRule(),
+            CacheKeyRule()]

@@ -1,0 +1,301 @@
+"""lock-discipline rules: shared-state hygiene for lock-owning classes.
+
+The PR 4 advisor round found `Histogram.observe` publishing half its update
+outside the lock; this pack generalizes that audit. For any class that owns a
+`threading.Lock/RLock/Condition`:
+
+* an attribute written under `with self._lock` in one method and without it
+  in another is a torn-write hazard (`lock-unguarded-write`);
+* direct `.acquire()`/`.release()` instead of `with` leaks the lock on any
+  exception between them (`lock-manual-acquire`);
+* a `threading.Thread(...)` started with no join/stop path anywhere in its
+  owner means shutdown cannot fence in-flight work (`thread-no-join`).
+
+Scope-wise the heuristics are method-local: a helper that is only ever
+CALLED under the lock is a legitimate pattern the AST cannot prove — that is
+what `# graftcheck: ignore[lock-unguarded-write] -- held by caller` is for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+_LOCK_FACTORIES = ("threading.Lock", "threading.RLock", "threading.Condition")
+
+#: container method calls treated as writes to the receiver attribute
+_MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "update",
+             "clear", "extend", "remove", "discard", "setdefault"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> 'X' (one level only)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attribute names holding a threading lock (assigned anywhere in the
+    class body, including class-level `_lock = threading.RLock()`)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        if dotted_name(node.value.func) not in _LOCK_FACTORIES:
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr:
+                out.add(attr)
+            elif isinstance(t, ast.Name):
+                out.add(t.id)  # class-level lock (ingest.stream idiom)
+    return out
+
+
+def _held_locks(node: ast.AST, method: ast.FunctionDef,
+                lock_attrs: Set[str]) -> Set[str]:
+    """Owned locks held at `node` (enclosing `with self.<lock>` blocks)."""
+    held: Set[str] = set()
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None and cur is not method:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                attr = _self_attr(item.context_expr)
+                if attr is None and isinstance(item.context_expr, ast.Name):
+                    attr = item.context_expr.id
+                if attr in lock_attrs:
+                    held.add(attr)
+        cur = getattr(cur, "graft_parent", None)
+    return held
+
+
+def _write_targets(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(attr, site) pairs this statement writes, for self.X targets:
+    plain/aug/subscript assignment plus mutating container calls."""
+    out: List[Tuple[str, ast.AST]] = []
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        targets = [node.target]
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        attr = _self_attr(node.func.value)
+        if attr:
+            out.append((attr, node))
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            sub = [e for e in t.elts]
+        else:
+            sub = [t]
+        for e in sub:
+            attr = _self_attr(e)
+            if attr is None and isinstance(e, ast.Subscript):
+                attr = _self_attr(e.value)
+            if attr:
+                out.append((attr, node))
+    return out
+
+
+class UnguardedWriteRule(Rule):
+    id = "lock-unguarded-write"
+    description = ("attribute written both under `with self._lock` and "
+                   "without it — a torn-write/stale-read hazard")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(cls, module))
+        return out
+
+    def _check_class(self, cls: ast.ClassDef, module: Module
+                     ) -> Iterable[Finding]:
+        locks = _lock_attrs(cls)
+        if not locks:
+            return ()
+        guarded: Set[str] = set()       # attrs ever written under an owned lock
+        unguarded: List[Tuple[str, str, ast.AST]] = []  # (attr, method, site)
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(method):
+                for attr, site in _write_targets(node):
+                    if attr in locks:
+                        continue
+                    if _held_locks(node, method, locks):
+                        guarded.add(attr)
+                    elif method.name != "__init__":
+                        unguarded.append((attr, method.name, site))
+        out: List[Finding] = []
+        for attr, mname, site in unguarded:
+            if attr in guarded:
+                out.append(Finding(
+                    self.id, module.rel, site.lineno,
+                    f"{cls.name}.{attr} is written under its lock elsewhere "
+                    f"but without it in {mname}() — take the lock or document "
+                    "why this write is safe"))
+        return out
+
+
+class ManualAcquireRule(Rule):
+    id = "lock-manual-acquire"
+    description = ("lock.acquire()/release() outside `with` leaks the lock "
+                   "on any exception in between")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        lock_attrs: Set[str] = set()
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                lock_attrs |= _lock_attrs(cls)
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("acquire", "release"):
+                continue
+            recv = dotted_name(node.func.value)
+            terminal = recv.rsplit(".", 1)[-1]
+            if terminal in lock_attrs or "lock" in terminal.lower():
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"`{recv}.{node.func.attr}()` called directly — use "
+                    "`with` so the lock is released on every exit path"))
+        return out
+
+
+class ThreadJoinRule(Rule):
+    id = "thread-no-join"
+    description = ("threading.Thread started with no join/stop path — "
+                   "shutdown cannot fence its in-flight work")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and
+                    dotted_name(node.func) in ("threading.Thread", "Thread")):
+                continue
+            finding = self._check_thread(node, module)
+            if finding:
+                out.append(finding)
+        return out
+
+    def _check_thread(self, node: ast.Call, module: Module
+                      ) -> Optional[Finding]:
+        parent = getattr(node, "graft_parent", None)
+        # `threading.Thread(...).start()` — nothing retains the handle
+        if isinstance(parent, ast.Attribute) and parent.attr == "start":
+            return Finding(
+                self.id, module.rel, node.lineno,
+                "fire-and-forget `threading.Thread(...).start()` — keep the "
+                "handle and join/stop it on shutdown")
+        names = self._bound_names(node)
+        if names is None:
+            return None  # not an assignment we understand; stay quiet
+        scope = self._joined_scope(node)
+        for name in names:
+            if self._name_joined(scope, name):
+                return None
+        return Finding(
+            self.id, module.rel, node.lineno,
+            f"thread bound to `{sorted(names)[0]}` is never joined in its "
+            "owning scope — add a join/stop path (or suppress with the "
+            "lifecycle rationale)")
+
+    @staticmethod
+    def _bound_names(node: ast.Call) -> Optional[Set[str]]:
+        """Names the thread handle is bound to via the enclosing assignment:
+        `self.X` -> {'X'}, local `t` -> {'t'} plus any `self.Y = t` aliases
+        in the same function."""
+        assign = getattr(node, "graft_parent", None)
+        if not isinstance(assign, ast.Assign):
+            return None
+        names: Set[str] = set()
+        locals_: Set[str] = set()
+        for t in assign.targets:
+            attr = _self_attr(t)
+            if attr:
+                names.add(attr)
+            elif isinstance(t, ast.Name):
+                names.add(t.id)
+                locals_.add(t.id)
+        if not names:
+            return None
+        if locals_:
+            fn = ThreadJoinRule._enclosing_function(node)
+            if fn is not None:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id in locals_:
+                        for t in sub.targets:
+                            attr = _self_attr(t)
+                            if attr:
+                                names.add(attr)
+        return names
+
+    @staticmethod
+    def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+        cur = getattr(node, "graft_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "graft_parent", None)
+        return None
+
+    @staticmethod
+    def _joined_scope(node: ast.AST) -> ast.AST:
+        """Where to look for the join: the enclosing class if any (another
+        method may own shutdown), else the enclosing function/module."""
+        cur = getattr(node, "graft_parent", None)
+        best: Optional[ast.AST] = None
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    best is None:
+                best = cur
+            if isinstance(cur, (ast.ClassDef, ast.Module)):
+                return cur
+            cur = getattr(cur, "graft_parent", None)
+        return best if best is not None else node
+
+    @staticmethod
+    def _name_joined(scope: ast.AST, name: str) -> bool:
+        # aliases of the handle: `t = self.X` and the stop()-without-start()
+        # guard idiom `t = getattr(self, "X", None)`
+        aliases = {name}
+        for sub in ast.walk(scope):
+            if not isinstance(sub, ast.Assign):
+                continue
+            v = sub.value
+            is_alias = _self_attr(v) == name or (
+                isinstance(v, ast.Call) and
+                dotted_name(v.func) == "getattr" and
+                len(v.args) >= 2 and
+                isinstance(v.args[1], ast.Constant) and
+                v.args[1].value == name)
+            if is_alias:
+                aliases |= {t.id for t in sub.targets
+                            if isinstance(t, ast.Name)}
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Attribute) and sub.attr == "join":
+                recv = sub.value
+                if _self_attr(recv) in aliases or \
+                        (isinstance(recv, ast.Name) and recv.id in aliases):
+                    return True
+        return False
+
+
+def rules() -> List[Rule]:
+    return [UnguardedWriteRule(), ManualAcquireRule(), ThreadJoinRule()]

@@ -387,8 +387,9 @@ class AdlsStub:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address[:2]
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name="adls-stub").start()
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="adls-stub")
+        self._thread.start()
 
     @property
     def url(self) -> str:
@@ -402,3 +403,4 @@ class AdlsStub:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=5.0)

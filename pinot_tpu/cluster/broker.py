@@ -17,6 +17,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor, as_completed
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from ..query import stats as qstats
@@ -43,11 +44,13 @@ class FailureDetector:
     removes a server until an operator intervenes."""
 
     def __init__(self, routing, initial_interval_s: float = 0.5,
-                 backoff_factor: float = 2.0, max_interval_s: float = 30.0):
+                 backoff_factor: float = 2.0, max_interval_s: float = 30.0,
+                 probe_timeout_s: float = 10.0):
         self.routing = routing
         self.initial_interval_s = initial_interval_s
         self.backoff_factor = backoff_factor
         self.max_interval_s = max_interval_s
+        self.probe_timeout_s = probe_timeout_s
         self._probes: Dict[str, Callable[[], bool]] = {}
         # server -> (next probe time, current interval)
         self._pending: Dict[str, Tuple[float, float]] = {}
@@ -95,13 +98,22 @@ class FailureDetector:
             except Exception:
                 return False
 
-        if len(due) == 1:
-            results = {due[0][0]: run_probe(due[0][0])}
-        else:
-            with ThreadPoolExecutor(max_workers=min(8, len(due)),
-                                    thread_name_prefix="fd-probe") as pool:
-                futs = {s: pool.submit(run_probe, s) for s, _ in due}
-                results = {s: f.result() for s, f in futs.items()}
+        pool = ThreadPoolExecutor(max_workers=min(8, len(due)),
+                                  thread_name_prefix="fd-probe")
+        try:
+            futs = {s: pool.submit(run_probe, s) for s, _ in due}
+            results = {}
+            for s, f in futs.items():
+                try:
+                    # a probe closure stuck past its own transport timeout
+                    # counts as a failed probe — the tick must not wedge
+                    results[s] = f.result(timeout=self.probe_timeout_s)
+                except FutureTimeoutError:
+                    results[s] = False
+        finally:
+            # wait=False: a wedged probe thread must not block the tick
+            # (it is abandoned; the NEXT tick probes through a fresh pool)
+            pool.shutdown(wait=False)
         for server_id, interval in due:
             ok = results[server_id]
             with self._lock:
@@ -126,6 +138,9 @@ class FailureDetector:
 
     def stop(self) -> None:
         self._stop.set()
+        thread = getattr(self, "_thread", None)
+        if thread is not None:
+            thread.join(timeout=5.0)  # loop wakes within tick_s of the event
 
 
 class Broker:
@@ -438,35 +453,52 @@ class Broker:
                     continue
                 futures[self._pool.submit(_traced(handle, server_id), table, ctx,
                                           segments, tf)] = server_id
-            for fut in as_completed(futures):
-                server_id = futures[fut]
-                servers_queried += 1
-                try:
-                    partial = fut.result()
-                    partials.append(partial)
-                    exec_stats.merge(partial.stats)
-                    if partial.served is not None:
-                        for seg in set(routing.get(server_id, ())) \
-                                - set(partial.served):
+            pending = set(futures)
+            try:
+                for fut in as_completed(futures,
+                                        timeout=self.stage_timeout_s):
+                    pending.discard(fut)
+                    server_id = futures[fut]
+                    servers_queried += 1
+                    try:
+                        partial = fut.result()
+                        partials.append(partial)
+                        exec_stats.merge(partial.stats)
+                        if partial.served is not None:
+                            for seg in set(routing.get(server_id, ())) \
+                                    - set(partial.served):
+                                missing.setdefault(seg, set()).add(server_id)
+                    except Exception as e:
+                        # EVERY failure mode sends the server's segments into
+                        # the retry round on a DIFFERENT replica (never
+                        # re-targeting the one that failed): transport failures
+                        # additionally remove the server from routing;
+                        # backpressure (admission rejection / timeout) is the
+                        # server WORKING as designed; a query error is
+                        # remembered — if the retry covers the segments it was
+                        # replica-local (corrupt file, one bad handler) and the
+                        # query completes as a partial result, but if the retry
+                        # leaves them uncovered the error was deterministic
+                        # (bad query) and is raised to the caller.
+                        servers_failed += 1
+                        if _is_transport_failure(e):
+                            self.routing.mark_server_unhealthy(server_id)
+                            self.failure_detector.notify_unhealthy(server_id)
+                        elif not _is_backpressure(e):
+                            query_errors.append(e)
+                            error_segments.update(routing.get(server_id, ()))
+                        for seg in routing.get(server_id, ()):
                             missing.setdefault(seg, set()).add(server_id)
-                except Exception as e:
-                    # EVERY failure mode sends the server's segments into the
-                    # retry round on a DIFFERENT replica (never re-targeting
-                    # the one that failed): transport failures additionally
-                    # remove the server from routing; backpressure (admission
-                    # rejection / timeout) is the server WORKING as designed;
-                    # a query error is remembered — if the retry covers the
-                    # segments it was replica-local (corrupt file, one bad
-                    # handler) and the query completes as a partial result,
-                    # but if the retry leaves them uncovered the error was
-                    # deterministic (bad query) and is raised to the caller.
+            except FutureTimeoutError:
+                # stage deadline expired with servers still outstanding: each
+                # straggler is treated like a transport failure — marked
+                # unhealthy, its segments sent into the retry round on another
+                # replica (never silently dropped)
+                for fut in pending:
+                    server_id = futures[fut]
                     servers_failed += 1
-                    if _is_transport_failure(e):
-                        self.routing.mark_server_unhealthy(server_id)
-                        self.failure_detector.notify_unhealthy(server_id)
-                    elif not _is_backpressure(e):
-                        query_errors.append(e)
-                        error_segments.update(routing.get(server_id, ()))
+                    self.routing.mark_server_unhealthy(server_id)
+                    self.failure_detector.notify_unhealthy(server_id)
                     for seg in routing.get(server_id, ()):
                         missing.setdefault(seg, set()).add(server_id)
             if missing:
@@ -661,15 +693,26 @@ class Broker:
                    for s, segs in by_server.items()}
         out: List[Tuple[SegmentResult, List[str]]] = []
         failed = 0
-        for fut in as_completed(futures):
-            server_id, segs = futures[fut]
-            try:
-                out.append((fut.result(), segs))
-            except Exception as e:
+        pending = set(futures)
+        try:
+            for fut in as_completed(futures, timeout=self.stage_timeout_s):
+                pending.discard(fut)
+                server_id, segs = futures[fut]
+                try:
+                    out.append((fut.result(), segs))
+                except Exception as e:
+                    failed += 1
+                    if _is_transport_failure(e):
+                        self.routing.mark_server_unhealthy(server_id)
+                        self.failure_detector.notify_unhealthy(server_id)
+        except FutureTimeoutError:
+            # retry deadline: stragglers' segments stay uncovered (the caller
+            # surfaces a partial result) and the slow replicas leave routing
+            for fut in pending:
+                server_id, _segs = futures[fut]
                 failed += 1
-                if _is_transport_failure(e):
-                    self.routing.mark_server_unhealthy(server_id)
-                    self.failure_detector.notify_unhealthy(server_id)
+                self.routing.mark_server_unhealthy(server_id)
+                self.failure_detector.notify_unhealthy(server_id)
         return out, failed
 
     def _handle_explain(self, ctx, physical: List[str]) -> ResultTable:
@@ -1010,17 +1053,29 @@ class Broker:
                     if handle is None:
                         continue
                     futures[self._pool.submit(handle, table, ctx, segments, tf)] = server_id
-                for fut in as_completed(futures):
-                    server_id = futures[fut]
-                    try:
-                        partial = fut.result()
-                        account(len(partial.rows) * max(1, len(columns)) * 16)
-                        rows.extend(partial.rows)
-                    except Exception as e:
-                        if _is_transport_failure(e):
+                try:
+                    for fut in as_completed(futures,
+                                            timeout=self.stage_timeout_s):
+                        server_id = futures[fut]
+                        try:
+                            partial = fut.result()
+                            account(len(partial.rows) * max(1, len(columns))
+                                    * 16)
+                            rows.extend(partial.rows)
+                        except Exception as e:
+                            if _is_transport_failure(e):
+                                self.routing.mark_server_unhealthy(server_id)
+                                self.failure_detector.notify_unhealthy(
+                                    server_id)
+                            raise
+                except FutureTimeoutError:
+                    # a leaf scan cannot be partial — mark the stragglers and
+                    # surface the timeout to the multistage caller
+                    for f, server_id in futures.items():
+                        if not f.done():
                             self.routing.mark_server_unhealthy(server_id)
                             self.failure_detector.notify_unhealthy(server_id)
-                        raise
+                    raise
             import numpy as np
             out = {}
             for j, c in enumerate(columns):

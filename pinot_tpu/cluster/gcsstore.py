@@ -290,3 +290,4 @@ class GcsStub:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=5.0)

@@ -403,8 +403,9 @@ class HdfsStub:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address[:2]
-        threading.Thread(target=self._server.serve_forever, daemon=True,
-                         name="hdfs-stub").start()
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="hdfs-stub")
+        self._thread.start()
 
     def _mkparents(self, path: str) -> None:
         parts = path.strip("/").split("/")[:-1]
@@ -429,3 +430,4 @@ class HdfsStub:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=5.0)

@@ -311,6 +311,11 @@ class HttpService:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # shutdown() returns once serve_forever exits, so the join is quick;
+        # guard for stop() without start() (config-error teardown paths)
+        thread = getattr(self, "_thread", None)
+        if thread is not None:
+            thread.join(timeout=5.0)
         # drop idle pooled client connections: endpoints commonly die with
         # their co-located service (tests spin up hundreds) and parked
         # sockets to dead peers would sit in CLOSE_WAIT for the process life

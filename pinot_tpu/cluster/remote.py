@@ -84,6 +84,10 @@ class RemoteCatalog(Catalog):
     # -- watch loop ----------------------------------------------------------
     def close(self) -> None:
         self._stop.set()
+        # best-effort reap: an idle watcher exits immediately; one blocked in
+        # the long poll is a daemon and dies at its poll boundary — teardown
+        # must not wait out an in-flight controller hold
+        self._thread.join(timeout=1.0)
 
     def _watch_loop(self) -> None:
         while not self._stop.is_set():

@@ -240,6 +240,8 @@ class ServerNode:
                         os.remove(p)
                 except OSError:
                     pass
+        # graftcheck: ignore[thread-no-join] -- one-shot reaper bounded by its
+        # own 5s deadline; joining would stall reload_table on file cleanup
         threading.Thread(target=reap, daemon=True, name="reload-reap").start()
 
     def reconcile(self, table: str) -> None:

@@ -232,14 +232,18 @@ class LogBrokerServer:
         return f"{self.host}:{self.port}"
 
     def _load_existing_topics(self) -> None:
-        for topic in sorted(os.listdir(self.log_dir)):
-            tdir = os.path.join(self.log_dir, topic)
-            if not os.path.isdir(tdir):
-                continue
-            parts = sorted({int(p.split(".")[0]) for p in os.listdir(tdir)
-                            if p.split(".")[0].isdigit()})
-            self._topics[topic] = [
-                _PartitionLog(os.path.join(tdir, f"{p}.log")) for p in parts]
+        # runs from __init__ before the acceptor starts, but under the (re-
+        # entrant) lock anyway so the topic map only ever mutates guarded
+        with self._lock:
+            for topic in sorted(os.listdir(self.log_dir)):
+                tdir = os.path.join(self.log_dir, topic)
+                if not os.path.isdir(tdir):
+                    continue
+                parts = sorted({int(p.split(".")[0]) for p in os.listdir(tdir)
+                                if p.split(".")[0].isdigit()})
+                self._topics[topic] = [
+                    _PartitionLog(os.path.join(tdir, f"{p}.log"))
+                    for p in parts]
 
     def create_topic(self, topic: str, num_partitions: int) -> None:
         with self._lock:
@@ -260,7 +264,10 @@ class LogBrokerServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            th = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            # graftcheck: ignore[thread-no-join] -- per-connection daemon;
+            # stop() closes every live socket via _conns, unblocking the recv
+            th = threading.Thread(target=self._serve_conn, args=(conn,),
+                                  daemon=True)
             th.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:

@@ -216,6 +216,7 @@ class KinesisStub:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------

@@ -230,10 +230,10 @@ class PulsarLiteBroker:
         self.host, self.port = self._srv.getsockname()[:2]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="pulsarlite-accept")
-        t.start()
-        self._threads.append(t)
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          daemon=True,
+                                          name="pulsarlite-accept")
+        self._acceptor.start()
 
     @property
     def service_url(self) -> str:
@@ -251,6 +251,9 @@ class PulsarLiteBroker:
             self._srv.close()
         except OSError:
             pass
+        # closing the listener raises OSError in accept(), so the join is
+        # quick; per-connection threads die with their sockets (daemon)
+        self._acceptor.join(timeout=2.0)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -258,6 +261,8 @@ class PulsarLiteBroker:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            # graftcheck: ignore[thread-no-join] -- per-connection daemon
+            # thread, bounded by the client socket's lifetime
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True, name="pulsarlite-conn")
             t.start()

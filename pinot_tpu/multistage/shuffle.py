@@ -744,7 +744,9 @@ def coordinate_join(broker, stmt, num_partitions: int):
         for url, task in leaf_tasks:
             futs[pool.submit(broker._post_leaf_task, url, "leafStage",
                              task)] = "leaf"
-        for f in as_completed(futs):
+        # bounded gather: one wedged worker raises TimeoutError into the
+        # cancel-everything handler below instead of hanging the query
+        for f in as_completed(futs, timeout=broker.stage_timeout_s):
             r = f.result()
             if futs[f] == "worker":
                 partials.extend(r)
@@ -818,7 +820,8 @@ def coordinate_groupby(broker, ctx, physical: List[str], num_partitions: int):
         for url, task in leaf_tasks:
             futs[pool.submit(broker._post_leaf_task, url, "leafAgg",
                              task)] = "leaf"
-        for f in as_completed(futs):
+        # bounded gather (see coordinate_join)
+        for f in as_completed(futs, timeout=broker.stage_timeout_s):
             r = f.result()
             if futs[f] == "worker":
                 partials.extend(r)

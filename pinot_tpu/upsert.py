@@ -32,16 +32,19 @@ class PartitionUpsertMetadataManager:
         self.comparison_enabled = comparison_enabled
 
     def _bitmap(self, segment: str, min_size: int) -> np.ndarray:
-        cur = self._valid.get(segment)
-        if cur is None:
-            cur = np.zeros(max(min_size, 64), dtype=bool)
-            self._valid[segment] = cur
-        elif len(cur) < min_size:
-            grown = np.zeros(max(min_size, len(cur) * 2), dtype=bool)
-            grown[:len(cur)] = cur
-            self._valid[segment] = grown
-            cur = grown
-        return cur
+        # reentrant: callers already hold the RLock; taking it here too keeps
+        # the helper safe for any future caller that doesn't
+        with self._lock:
+            cur = self._valid.get(segment)
+            if cur is None:
+                cur = np.zeros(max(min_size, 64), dtype=bool)
+                self._valid[segment] = cur
+            elif len(cur) < min_size:
+                grown = np.zeros(max(min_size, len(cur) * 2), dtype=bool)
+                grown[:len(cur)] = cur
+                self._valid[segment] = grown
+                cur = grown
+            return cur
 
     def add_record(self, segment: str, doc_id: int, pk: Tuple,
                    comparison_value: Any = None) -> bool:
@@ -100,7 +103,8 @@ class PartitionUpsertMetadataManager:
             return self._versions.get(segment, 0)
 
     def _bump(self, segment: str) -> None:
-        self._versions[segment] = self._versions.get(segment, 0) + 1
+        with self._lock:  # reentrant under add_record's lock
+            self._versions[segment] = self._versions.get(segment, 0) + 1
 
     @property
     def num_primary_keys(self) -> int:

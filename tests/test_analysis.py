@@ -1,0 +1,447 @@
+"""graftcheck tests: fixture snippets per rule pack, the tier-1 package gate,
+CLI exit codes, and threaded regressions for the lock-discipline fixes.
+
+Fixture tests follow one shape per rule pack: a seeded true positive, a clean
+negative, and an honored `# graftcheck: ignore[...] -- reason` suppression —
+proving each rule both fires and can be silenced with a rationale.
+"""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
+                                run_project, run_rules, unbaselined)
+from pinot_tpu.analysis import (blocking_in_loop, drift_guards, jit_hygiene,
+                                lock_discipline)
+from pinot_tpu.analysis.__main__ import main as analysis_main
+from pinot_tpu.analysis.core import BAD_SUPPRESSION
+
+
+def _check(source, rules, rel="pinot_tpu/scratch/fixture.py", readme=""):
+    """Run `rules` over one in-memory module; (active, suppressed)."""
+    m = Module("/fixture.py", rel, textwrap.dedent(source))
+    assert m.parse_error is None, m.parse_error
+    ctx = AnalysisContext(repo_root="/nonexistent", modules=[m])
+    ctx._readme = readme
+    return run_rules(rules, [m], ctx)
+
+
+def _ids(findings):
+    return [f.rule for f in findings]
+
+
+# -- jit-hygiene --------------------------------------------------------------
+
+def test_jit_host_sync_true_positive():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        def f(a):
+            x = jnp.sum(a)
+            return float(x)
+    """, jit_hygiene.rules())
+    assert "jit-host-sync" in _ids(active)
+
+
+def test_jit_hygiene_clean_negative():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        def f(a, n):
+            x = jnp.sum(a)
+            return x, float(n)
+    """, jit_hygiene.rules())
+    assert active == []
+
+
+def test_jit_host_sync_suppression_honored():
+    active, suppressed = _check("""
+        import jax.numpy as jnp
+        def f(a):
+            x = jnp.sum(a)
+            return float(x)  # graftcheck: ignore[jit-host-sync] -- fixture
+    """, jit_hygiene.rules())
+    assert "jit-host-sync" not in _ids(active)
+    assert "jit-host-sync" in _ids(suppressed)
+
+
+def test_jit_fetch_site_outside_sanctioned_files():
+    src = """
+        import jax
+        def f(x):
+            return jax.device_get(x)
+    """
+    active, _ = _check(src, jit_hygiene.rules())
+    assert "jit-fetch-site" in _ids(active)
+    # the same call in a sanctioned fetch site is the batched fetch path
+    active, _ = _check(src, jit_hygiene.rules(),
+                       rel="pinot_tpu/parallel/combine.py")
+    assert active == []
+
+
+def test_jit_literal_rebuild_and_cache_key():
+    active, _ = _check("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return x + jnp.array([1.0, 2.0])
+        def kernel_for(arr):
+            return _cached_kernel((arr.dtype,), arr)
+    """, jit_hygiene.rules())
+    assert "jit-literal-rebuild" in _ids(active)
+    assert "jit-cache-key" in _ids(active)  # dtype keyed without shape
+
+
+# -- lock-discipline ----------------------------------------------------------
+
+def test_lock_unguarded_write_true_positive():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def safe(self):
+                with self._lock:
+                    self.n += 1
+            def racy(self):
+                self.n += 1
+    """, lock_discipline.rules())
+    assert _ids(active) == ["lock-unguarded-write"]
+
+
+def test_lock_discipline_clean_negative():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def a(self):
+                with self._lock:
+                    self.n += 1
+            def b(self):
+                with self._lock:
+                    self.n = 0
+    """, lock_discipline.rules())
+    assert active == []
+
+
+def test_lock_unguarded_write_suppression_honored():
+    active, suppressed = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def safe(self):
+                with self._lock:
+                    self.n += 1
+            def racy(self):
+                self.n += 1  # graftcheck: ignore[lock-unguarded-write] -- held by caller
+    """, lock_discipline.rules())
+    assert active == []
+    assert "lock-unguarded-write" in _ids(suppressed)
+
+
+def test_thread_no_join_variants():
+    # fire-and-forget fires; a joined handle does not; the getattr-guarded
+    # stop() idiom (stores/http_service) is recognized as a join path
+    active, _ = _check("""
+        import threading
+        def go():
+            threading.Thread(target=print, daemon=True).start()
+    """, lock_discipline.rules())
+    assert "thread-no-join" in _ids(active)
+    active, _ = _check("""
+        import threading
+        class C:
+            def start(self):
+                self._thread = threading.Thread(target=print)
+                self._thread.start()
+            def stop(self):
+                t = getattr(self, "_thread", None)
+                if t is not None:
+                    t.join(timeout=5.0)
+    """, lock_discipline.rules())
+    assert active == []
+
+
+# -- blocking-in-loop ---------------------------------------------------------
+
+def test_blocking_result_no_timeout_true_positive():
+    active, _ = _check("""
+        def gather(futs):
+            return [f.result() for f in futs]
+    """, blocking_in_loop.rules())
+    assert "blocking-result-no-timeout" in _ids(active)
+
+
+def test_blocking_clean_negative():
+    # .result() on an as_completed-yielded future is already done (the
+    # timeout on as_completed carries the bound) — not a finding
+    active, _ = _check("""
+        from concurrent.futures import as_completed
+        def gather(futs, q):
+            out = []
+            for f in as_completed(futs, timeout=30.0):
+                out.append(f.result())
+            out.append(q.result(timeout=5.0))
+            return out
+    """, blocking_in_loop.rules())
+    assert active == []
+
+
+def test_blocking_as_completed_without_timeout():
+    active, _ = _check("""
+        from concurrent.futures import as_completed
+        def gather(futs):
+            return [f.result() for f in as_completed(futs)]
+    """, blocking_in_loop.rules())
+    assert _ids(active) == ["blocking-result-no-timeout"]
+    assert "as_completed" in active[0].message
+
+
+def test_blocking_queue_and_sleep_rules():
+    active, _ = _check("""
+        import time
+        def _fetch_loop(self):
+            while True:
+                item = self._queue.get()
+                time.sleep(0.1)
+    """, blocking_in_loop.rules())
+    assert sorted(_ids(active)) == ["blocking-queue-get",
+                                    "blocking-sleep-in-loop"]
+
+
+def test_blocking_suppression_honored():
+    active, suppressed = _check("""
+        def gather(futs):
+            # graftcheck: ignore[blocking-result-no-timeout] -- fixture
+            return [f.result() for f in futs]
+    """, blocking_in_loop.rules())
+    assert active == []
+    assert "blocking-result-no-timeout" in _ids(suppressed)
+
+
+# -- drift-guards -------------------------------------------------------------
+
+_OBS_README = """
+## Observability
+
+| metric | meaning |
+|---|---|
+| `pinot_documented_total` | documented |
+
+## Layout
+"""
+
+
+def test_drift_metric_glossary_true_positive():
+    active, _ = _check("""
+        def report(reg):
+            reg.counter("pinot_documented_total").inc()
+            reg.counter("pinot_mystery_total").inc()
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert _ids(active) == ["drift-metric-glossary"]
+    assert "pinot_mystery_total" in active[0].message
+
+
+def test_drift_metric_glossary_clean_negative():
+    active, _ = _check("""
+        def report(reg):
+            reg.counter("pinot_documented_total").inc()
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert active == []
+
+
+def test_drift_metric_glossary_suppression_honored():
+    active, suppressed = _check("""
+        def report(reg):
+            reg.counter("pinot_mystery_total").inc()  # graftcheck: ignore[drift-metric-glossary] -- fixture
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert active == []
+    assert "drift-metric-glossary" in _ids(suppressed)
+
+
+def test_drift_cluster_config_rule():
+    src = """
+        def knob(catalog):
+            return catalog.get_property("clusterConfig/broker.mystery.knob")
+    """
+    active, _ = _check(src, drift_guards.rules(), readme=_OBS_README)
+    assert _ids(active) == ["drift-cluster-config"]
+    documented = _OBS_README + "\n`broker.mystery.knob` does a thing\n"
+    active, _ = _check(src, drift_guards.rules(), readme=documented)
+    assert active == []
+
+
+# -- suppression mechanics ----------------------------------------------------
+
+def test_suppression_without_reason_is_a_finding():
+    active, _ = _check("""
+        def gather(futs):
+            return [f.result() for f in futs]  # graftcheck: ignore[blocking-result-no-timeout]
+    """, blocking_in_loop.rules())
+    assert BAD_SUPPRESSION in _ids(active)
+    # the reason-less suppression does NOT silence the rule either
+    assert "blocking-result-no-timeout" in _ids(active)
+
+
+def test_standalone_suppression_covers_wrapped_comment():
+    active, suppressed = _check("""
+        def gather(futs):
+            # graftcheck: ignore[blocking-result-no-timeout] -- a two-line
+            # rationale wrapping onto a second comment line
+            return [f.result() for f in futs]
+    """, blocking_in_loop.rules())
+    assert active == []
+    assert _ids(suppressed) == ["blocking-result-no-timeout"]
+
+
+# -- tier-1 gate + CLI exit codes ---------------------------------------------
+
+def test_package_clean_against_committed_baseline():
+    """THE tier-1 gate: zero non-baselined findings over the live package."""
+    findings, _suppressed, _ctx = run_project()
+    new = unbaselined(findings, load_baseline())
+    assert not new, "new graftcheck findings:\n" + \
+        "\n".join(f.render() for f in new)
+
+
+def test_cli_exits_nonzero_on_seeded_violation(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def safe(self):
+                with self._lock:
+                    self.n += 1
+            def racy(self):
+                self.n += 1
+    """))
+    assert analysis_main([str(bad), "--no-baseline"]) == 1
+    assert "lock-unguarded-write" in capsys.readouterr().out
+    # and the same file with the violation fixed exits 0
+    ok = tmp_path / "clean.py"
+    ok.write_text("x = 1\n")
+    assert analysis_main([str(ok), "--no-baseline"]) == 0
+
+
+def test_cli_json_format(tmp_path, capsys):
+    import json
+    bad = tmp_path / "seeded.py"
+    bad.write_text("def g(futs):\n    return [f.result() for f in futs]\n")
+    assert analysis_main([str(bad), "--no-baseline", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["new"][0]["rule"] == "blocking-result-no-timeout"
+
+
+# -- threaded regressions for the lock-discipline sweep fixes -----------------
+
+def test_upsert_concurrent_add_record_stays_consistent():
+    """Regression for the upsert _bitmap/_bump lock fix: hammer add_record
+    from many threads; exactly one live row per primary key must survive and
+    the winner must carry the globally largest comparison value."""
+    from pinot_tpu.upsert import PartitionUpsertMetadataManager
+    mgr = PartitionUpsertMetadataManager(comparison_enabled=True)
+    NKEYS, NTHREADS, NITER = 32, 8, 25
+    ndocs = NITER * NKEYS
+    barrier = threading.Barrier(NTHREADS)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(NITER):
+            for k in range(NKEYS):
+                mgr.add_record(f"seg{tid}", i * NKEYS + k, (k,),
+                               comparison_value=tid * NITER + i)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(NTHREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads)
+
+    assert mgr.num_primary_keys == NKEYS
+    live = 0
+    for tid in range(NTHREADS):
+        mask = mgr.valid_mask(f"seg{tid}", ndocs)
+        if mask is not None:
+            live += int(mask.sum())
+    assert live == NKEYS
+    # the comparison contract: no add_record with a smaller value may have
+    # displaced the largest one
+    best = (NTHREADS - 1) * NITER + (NITER - 1)
+    with mgr._lock:
+        for k in range(NKEYS):
+            assert mgr._primary_keys[(k,)][2] == best
+
+
+def test_stub_store_stop_joins_serving_thread():
+    """Regression for the stop()-joins sweep: the stub deep stores must fence
+    their serving thread on stop, not orphan it."""
+    from pinot_tpu.cluster.s3store import S3StubServer
+    srv = S3StubServer()
+    assert srv._thread.is_alive()
+    srv.stop()
+    assert not srv._thread.is_alive()
+
+
+def test_kafkalite_concurrent_topic_creation():
+    """Regression for the kafkalite topic-map locking: concurrent
+    create_topic calls must collapse to one partition list."""
+    from pinot_tpu.ingest.kafkalite import LogBrokerServer
+    srv = LogBrokerServer()
+    try:
+        barrier = threading.Barrier(6)
+
+        def mk():
+            barrier.wait()
+            for _ in range(20):
+                srv.create_topic("events", 4)
+
+        threads = [threading.Thread(target=mk) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(srv._topics["events"]) == 4
+    finally:
+        srv.stop()
+
+
+def test_failure_detector_tick_survives_wedged_probe():
+    """Regression for the probe timeout: a probe stuck past probe_timeout_s
+    counts as failed and the tick returns instead of wedging."""
+    from pinot_tpu.cluster.broker import FailureDetector
+
+    class _Routing:
+        def __init__(self):
+            self.healthy = []
+
+        def mark_server_healthy(self, sid):
+            self.healthy.append(sid)
+
+    routing = _Routing()
+    fd = FailureDetector(routing, initial_interval_s=0.0,
+                         probe_timeout_s=0.2)
+    release = threading.Event()
+    fd.register_probe("stuck", lambda: release.wait(30.0))
+    fd.register_probe("fine", lambda: True)
+    fd.notify_unhealthy("stuck")
+    fd.notify_unhealthy("fine")
+    t0 = time.monotonic()
+    fd.tick(now=time.time() + 1.0)
+    elapsed = time.monotonic() - t0
+    release.set()  # unblock the abandoned probe thread
+    assert elapsed < 5.0, "tick wedged behind a stuck probe"
+    assert routing.healthy == ["fine"]
+    with fd._lock:
+        assert "stuck" in fd._pending  # still unhealthy, backoff rescheduled

@@ -448,34 +448,14 @@ def test_emitted_stats_keys_documented(tel_cluster):
         "pinot_tpu/query/stats.py's key tables")
 
 
-def _readme_documented_metric_names():
-    """Backticked `pinot_*` metric names in the Observability section (the
-    stats-key regex above skips underscores on purpose, so this is separate)."""
-    import os
-    readme = os.path.join(os.path.dirname(__file__), "..", "README.md")
-    with open(readme) as f:
-        text = f.read()
-    obs = text.split("## Observability", 1)[1].split("## Layout", 1)[0]
-    return set(re.findall(r"`(pinot_[a-z0-9_]+)`", obs))
-
-
-def test_every_registered_metric_documented_in_readme(tel_cluster):
-    """Drift guard: every pinot_* metric name the process registry has seen
-    during this test run must be in the README metric glossary. New metrics
-    land documented or this fails."""
-    from pinot_tpu.utils.metrics import get_registry
-    tel_cluster.query("SELECT site, SUM(v) FROM ev GROUP BY site")
-    reg = get_registry()
-    with reg._lock:
-        registered = {name for series in (reg._counters, reg._gauges,
-                                          reg._timers, reg._histograms)
-                      for (name, _labels) in series
-                      if name.startswith("pinot_")}
-    documented = _readme_documented_metric_names()
-    undocumented = registered - documented
-    assert not undocumented, (
-        f"metrics {sorted(undocumented)} are registered but not documented "
-        "in README.md's Observability metric glossary")
+def test_every_registered_metric_documented_in_readme():
+    """Drift guard, now delegated to graftcheck's drift-metric-glossary rule:
+    the static form covers EVERY registry call site in the package — not just
+    the ones a query in this test run happens to execute."""
+    from pinot_tpu.analysis import run_project
+    from pinot_tpu.analysis.drift_guards import MetricGlossaryRule
+    findings, _suppressed, _ctx = run_project(rules=[MetricGlossaryRule()])
+    assert not findings, "\n".join(f.render() for f in findings)
 
 
 def test_query_report_renders_waterfall(tel_cluster, capsys):
