@@ -16,7 +16,8 @@ codebase-specific rule packs over stdlib `ast` (no new dependencies):
   and sleeps inside dispatcher/fetcher loops and HTTP handlers.
 * **drift-guards** — declarative docs-vs-code guards: metric registry vs the
   README glossary, ExecutionStats constants vs the merge/export key lists,
-  clusterConfig keys referenced in code vs documented defaults.
+  clusterConfig keys referenced in code vs documented defaults, and bounded
+  metric-label cardinality at registry call sites.
 
 Run it:  ``python -m pinot_tpu.analysis [--format text|json] [--update-baseline]``
 

@@ -12,7 +12,9 @@ test run happens to execute:
   merge/export table (COUNTER_KEYS/MIN_KEYS/BROKER_KEYS) and documented, and
   raw string literals must not bypass the constants;
 * `drift-cluster-config` — every `clusterConfig/...` key read in code must be
-  documented in the README.
+  documented in the README;
+* `metric-label-cardinality` — label values at registry factory calls must be
+  bounded (dynamic values only under lifecycle-bounded keys like `table`).
 """
 
 from __future__ import annotations
@@ -21,7 +23,8 @@ import ast
 import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+from .core import (AnalysisContext, Finding, Module, Rule, dotted_name,
+                   is_constant_expr)
 
 _REGISTRY_FACTORIES = ("counter", "gauge", "timer", "histogram")
 _STATS_MODULE = "pinot_tpu/query/stats.py"
@@ -221,5 +224,54 @@ class ClusterConfigRule(Rule):
                         yield module, node.lineno, arg.value
 
 
+class LabelCardinalityRule(Rule):
+    id = "metric-label-cardinality"
+    description = ("metric label values must be bounded: dynamic values are "
+                   "only allowed under known lifecycle-bounded label keys")
+
+    #: label keys whose value sets are bounded by cluster lifecycle (tables,
+    #: instances, partitions, task/state enums) — safe to fill dynamically.
+    #: Anything else with a non-constant value risks unbounded series growth
+    #: (per-query/per-segment/per-user labels blow up the registry and every
+    #: scrape downstream).
+    _BOUNDED_LABEL_KEYS = frozenset(
+        ("table", "task", "partition", "instance", "server", "state"))
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if module.tree is None:
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in _REGISTRY_FACTORIES):
+                continue
+            labels = None
+            if len(node.args) >= 2:
+                labels = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "labels":
+                    labels = kw.value
+            # only a dict literal is judgeable; a Name variable may hold
+            # anything — other rules / review cover that
+            if not isinstance(labels, ast.Dict):
+                continue
+            for key, value in zip(labels.keys, labels.values):
+                if is_constant_expr(value):
+                    continue
+                key_name = key.value if isinstance(key, ast.Constant) else None
+                if key_name in self._BOUNDED_LABEL_KEYS:
+                    continue
+                shown = key_name if key_name is not None else "<dynamic>"
+                yield Finding(
+                    self.id, module.rel, value.lineno,
+                    f"metric label {shown!r} takes a non-constant value — "
+                    "unbounded label values create unbounded metric series; "
+                    "use a lifecycle-bounded key "
+                    f"({'/'.join(sorted(self._BOUNDED_LABEL_KEYS))}) or a "
+                    "constant value")
+
+
 def rules() -> List[Rule]:
-    return [MetricGlossaryRule(), StatsKeysRule(), ClusterConfigRule()]
+    return [MetricGlossaryRule(), StatsKeysRule(), ClusterConfigRule(),
+            LabelCardinalityRule()]

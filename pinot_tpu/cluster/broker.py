@@ -172,6 +172,18 @@ class Broker:
             "totalTimeMs": 0.0, "maxTimeMs": 0.0,
         }
         self._obs_lock = threading.Lock()
+        # always-on sampled tracing: EVERY query records a Trace (span appends
+        # are cheap); the head sampler (`broker.trace.sample.rate`) only
+        # decides ring RETENTION, and slow/errored queries tail-retain
+        # regardless so every slow-query log line resolves at /debug/traces
+        from ..utils.trace import TraceRing, TraceSampler
+        self.trace_sampler = TraceSampler()
+        self.trace_ring = TraceRing(capacity=256)
+        # per-table cumulative resource rollup behind the pinot_table_* gauge
+        # family and the /debug tableStats panel (under _obs_lock); dropped
+        # tables are swept lazily against the live catalog
+        self._table_rollup: Dict[str, Dict[str, float]] = {}
+        self._table_sweep_countdown = 0
         self._lock = threading.RLock()
         from ..query.scheduler import QueryQuotaManager
         self.quota = QueryQuotaManager(catalog)
@@ -232,30 +244,46 @@ class Broker:
         from ..utils.metrics import get_registry
         reg = get_registry()
         t0 = time.perf_counter()
+        tr = None
+        table = None
         try:
             if stmt is None:
                 from ..sql.parser import parse_query
                 stmt = parse_query(sql)
             stmt = self._rewrite_subqueries(stmt)
+            table = stmt.table
             trace_on = _truthy(stmt.options.get("trace"))
-            with tracing.request_trace(trace_on) as tr:
+            # always-on: the trace records regardless, the sampler only gates
+            # ring retention; OPTION(trace=true) force-samples AND returns the
+            # spans inline (traceInfo), exactly as before
+            with tracing.request_trace(True) as tr:
+                tr.sampled = trace_on or self.trace_sampler.sample(
+                    self._trace_sample_rate())
                 if stmt.joins:
                     result = (self._explain_multistage(stmt) if stmt.explain
                               else self._handle_multistage(stmt))
                 else:
                     result = self._handle_single(stmt, t0)
-                if tr is not None:
+                if trace_on:
                     result.stats["traceInfo"] = tr.to_rows()
+                result.stats["traceId"] = tr.trace_id
         except Exception:
             reg.counter("pinot_broker_query_exceptions").inc()
+            elapsed_ms = (time.perf_counter() - t0) * 1000
             with self._obs_lock:
                 self._query_rollup["numExceptions"] += 1
+            if table:
+                self._table_account(table, elapsed_ms, error=True)
+            if tr is not None and tr.sampled:
+                # errored traces tail-retain so failures are inspectable
+                self.trace_ring.admit(tr, sql=sql, error=True,
+                                      timeUsedMs=round(elapsed_ms, 3))
             raise
         elapsed_ms = (time.perf_counter() - t0) * 1000
         result.stats["timeUsedMs"] = round(elapsed_ms, 3)
         reg.counter("pinot_broker_queries").inc()
         reg.timer("pinot_broker_query_latency_ms").update(elapsed_ms)
-        self._account_query(sql, result, elapsed_ms)
+        self._account_query(sql, result, elapsed_ms, tr=tr, table=table)
         return result
 
     # log channel for queries over the `broker.slow.query.ms` threshold: one
@@ -270,18 +298,48 @@ class Broker:
         except (TypeError, ValueError):
             return None
 
+    def _trace_sample_rate(self) -> float:
+        """`broker.trace.sample.rate` (clusterConfig): fraction of queries
+        whose traces are retained in the /debug/traces ring. 0 (the default)
+        disables head sampling; slow/errored queries still tail-retain."""
+        prop = self.catalog.get_property(
+            "clusterConfig/broker.trace.sample.rate")
+        try:
+            return float(prop) if prop not in (None, "") else 0.0
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _slo_latency_target_ms(self) -> Optional[float]:
+        """`slo.latency.p99.ms` (clusterConfig): the per-query latency target
+        behind the SLO layer — queries over it count into the per-table
+        `numOverSlo` rollup that the controller's burn-rate check consumes."""
+        prop = self.catalog.get_property("clusterConfig/slo.latency.p99.ms")
+        try:
+            return float(prop) if prop not in (None, "") else None
+        except (TypeError, ValueError):
+            return None
+
     def _account_query(self, sql: str, result: ResultTable,
-                       elapsed_ms: float) -> None:
+                       elapsed_ms: float, tr=None, table=None) -> None:
         """Per-query bookkeeping after a successful response: rollups for
-        /debug, plus the slow-query log when over threshold (exactly one
-        structured line per slow query)."""
+        /debug, per-table resource attribution, trace-ring retention, plus
+        the slow-query log when over threshold (exactly one structured line
+        per slow query)."""
         with self._obs_lock:
             self._query_rollup["numQueries"] += 1
             self._query_rollup["totalTimeMs"] += elapsed_ms
             self._query_rollup["maxTimeMs"] = max(
                 self._query_rollup["maxTimeMs"], elapsed_ms)
         thr = self._slow_threshold_ms()
-        if thr is None or elapsed_ms <= thr:
+        slow = thr is not None and elapsed_ms > thr
+        if table:
+            self._table_account(table, elapsed_ms, result=result, slow=slow)
+        if tr is not None and (tr.sampled or slow):
+            # head-sampled OR tail-retained (slow): land in the bounded ring
+            # behind GET /debug/traces
+            self.trace_ring.admit(tr, sql=sql, slow=slow,
+                                  timeUsedMs=round(elapsed_ms, 3))
+        if not slow:
             return
         entry = {
             "sql": sql,
@@ -302,23 +360,122 @@ class Broker:
         logging.getLogger(self.SLOW_QUERY_LOGGER).warning(
             json.dumps(entry, default=str))
 
+    # cumulative per-table counters -> labeled gauge family. Gauges (set from
+    # the rollup), not counters, so a dropped table's whole series removes
+    # cleanly; the latency histogram is the one true distribution.
+    _TABLE_GAUGES = {
+        "numQueries": "pinot_table_queries",
+        "numErrors": "pinot_table_errors",
+        "numSlowQueries": "pinot_table_slow_queries",
+        "numOverSlo": "pinot_table_over_slo",
+        "totalTimeMs": "pinot_table_time_ms",
+        "deviceExecMs": "pinot_table_device_exec_ms",
+        "bytesFetched": "pinot_table_bytes_fetched",
+        "rowsScanned": "pinot_table_rows_scanned",
+        "queueWaitMs": "pinot_table_queue_wait_ms",
+    }
+
+    def _table_account(self, table: str, elapsed_ms: float, result=None,
+                       slow: bool = False, error: bool = False) -> None:
+        """Attribute one query's resources to its logical table: broker time,
+        device exec, bytes fetched, rows scanned, queue wait, slow/error/SLO
+        counts — the tenant-attribution panel cluster_top and the controller
+        SLO check read."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        stats = result.stats if result is not None else {}
+
+        def _num(key):
+            v = stats.get(key)
+            return float(v) if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else 0.0
+
+        slo_target = self._slo_latency_target_ms()
+        with self._obs_lock:
+            roll = self._table_rollup.setdefault(table, {
+                k: 0.0 for k in self._TABLE_GAUGES})
+            roll["numQueries"] += 1
+            roll["totalTimeMs"] += elapsed_ms
+            roll["numErrors"] += 1 if error else 0
+            roll["numSlowQueries"] += 1 if slow else 0
+            if slo_target is not None and elapsed_ms > slo_target:
+                roll["numOverSlo"] += 1
+            roll["deviceExecMs"] += _num("deviceExecMs")
+            roll["bytesFetched"] += _num("bytesFetched")
+            roll["rowsScanned"] += _num("numDocsScanned")
+            roll["queueWaitMs"] += _num("queueWaitMs")
+            snapshot = dict(roll)
+        labels = {"table": table}
+        for key, gname in self._TABLE_GAUGES.items():
+            reg.gauge(gname, labels).set(round(snapshot[key], 3))
+        reg.histogram("pinot_table_latency_ms", labels).observe(elapsed_ms)
+        self._maybe_sweep_dropped_tables()
+
+    def _maybe_sweep_dropped_tables(self, force: bool = False) -> None:
+        """Lazily reconcile the per-table rollup against the live catalog:
+        series for dropped tables are removed from both the rollup and the
+        registry (every 64 queries, plus on each /debug read)."""
+        with self._obs_lock:
+            self._table_sweep_countdown -= 1
+            if not force and self._table_sweep_countdown > 0:
+                return
+            self._table_sweep_countdown = 64
+            tracked = set(self._table_rollup)
+        live = set()
+        for name in list(self.catalog.table_configs):
+            live.add(name)
+            # rollups key on the LOGICAL table name; configs on name_TYPE
+            for suffix in ("_OFFLINE", "_REALTIME"):
+                if name.endswith(suffix):
+                    live.add(name[: -len(suffix)])
+        dead = tracked - live
+        if not dead:
+            return
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        with self._obs_lock:
+            for table in dead:
+                self._table_rollup.pop(table, None)
+        for table in dead:
+            labels = {"table": table}
+            for gname in self._TABLE_GAUGES.values():
+                reg.remove(gname, labels)
+            reg.remove("pinot_table_latency_ms", labels)
+
     def debug_stats(self) -> Dict:
         """Rollups for the HTTP /debug endpoint: lifetime query counters,
-        broker-scoped registry metrics, and the recent slow-query ring."""
+        per-table resource attribution, broker-scoped registry metrics, and
+        the recent slow-query ring."""
         from ..utils.metrics import get_registry
-        snap = get_registry().snapshot()
+        self._maybe_sweep_dropped_tables(force=True)
+        reg = get_registry()
+        snap = reg.snapshot()
         with self._obs_lock:
             rollup = dict(self._query_rollup)
             recent = list(self._recent_slow)
+            tables = {t: dict(r) for t, r in self._table_rollup.items()}
         n = rollup["numQueries"]
         rollup["avgTimeMs"] = round(rollup["totalTimeMs"] / n, 3) if n else 0.0
         rollup["totalTimeMs"] = round(rollup["totalTimeMs"], 3)
         rollup["maxTimeMs"] = round(rollup["maxTimeMs"], 3)
+        for t, r in tables.items():
+            nq = r["numQueries"]
+            r["avgTimeMs"] = round(r["totalTimeMs"] / nq, 3) if nq else 0.0
+            r["p99LatencyMs"] = round(
+                reg.histogram("pinot_table_latency_ms",
+                              {"table": t}).percentile(0.99), 3)
+            for k in list(r):
+                if isinstance(r[k], float):
+                    r[k] = round(r[k], 3)
         return {
             "instanceId": self.instance_id,
             "queryStats": rollup,
+            "tableStats": tables,
             "slowQueryThresholdMs": self._slow_threshold_ms(),
             "recentSlowQueries": recent,
+            "traceRing": {"retained": len(self.trace_ring),
+                          "capacity": self.trace_ring.capacity,
+                          "sampleRate": self._trace_sample_rate()},
             "brokerMetrics": {k: v for k, v in sorted(snap.items())
                               if k.startswith("pinot_broker_")},
             "gaugeHistories": get_registry().gauge_histories("pinot_broker"),

@@ -73,6 +73,17 @@ class Controller:
         self.ingestion_pollers: Dict[str, Callable[[], Dict[str, dict]]] = {}
         self.scheduler.register(PeriodicTask("IngestionStatusChecker", 60.0,
                                              self.run_ingestion_status_check))
+        # SLO burn-rate plane: windowed verdicts over the brokers' per-table
+        # rollups (slo.latency.p99.ms / slo.error.rate cluster config), the
+        # serving-side companion of ingestionStatus
+        self._slo_tables: set = set()         # tables with exported SLO gauges
+        self._slo_status: Dict[str, Dict[str, object]] = {}
+        self._slo_samples: Dict[str, object] = {}   # table -> deque of samples
+        # in-proc clusters register Broker.debug_stats directly; OS-process
+        # brokers are discovered via advertised instance ports (GET /debug)
+        self.slo_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
+        self.scheduler.register(PeriodicTask("SLOStatusChecker", 60.0,
+                                             self.run_slo_check))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -487,10 +498,184 @@ class Controller:
         self._ingestion_status = out
         return {t: str(s["ingestionState"]) for t, s in out.items()}
 
+    # -- SLO layer (reference frame: the SRE-workbook multi-window,
+    # multi-burn-rate alerting policy applied to per-table query rollups) ----
+
+    _SLO_GAUGES = ("pinot_controller_slo_healthy",
+                   "pinot_controller_slo_latency_burn_rate",
+                   "pinot_controller_slo_error_burn_rate")
+    #: fast-window burn at/above which the verdict is UNHEALTHY: the classic
+    #: 1h/14.4x page threshold — burning a 30-day budget in ~2 days
+    SLO_PAGE_BURN_RATE = 14.4
+    #: a p99 latency target allows 1% of queries over the bound; the latency
+    #: burn rate is measured against this violation budget
+    SLO_LATENCY_BUDGET = 0.01
+
+    def _iter_slo_pollers(self):
+        """(broker_id, poll fn) for every reachable broker: explicitly
+        registered in-proc pollers first, then instances advertising an HTTP
+        port (OS-process brokers) — their /debug route."""
+        seen = set()
+        for bid, poll in list(self.slo_pollers.items()):
+            seen.add(bid)
+            yield bid, poll
+        for info in list(self.catalog.instances.values()):
+            if info.role != "broker" or not info.port or not info.alive \
+                    or info.instance_id in seen:
+                continue
+
+            def poll(url=info.url):
+                from .http_service import get_json
+                return get_json(f"{url}/debug", timeout=5.0, retries=1)
+            yield info.instance_id, poll
+
+    def run_slo_check(self, now: Optional[float] = None) -> Dict[str, str]:
+        """Periodic SLO evaluation: sample every broker's cumulative per-table
+        counters, compute error/latency burn rates over a fast and a slow
+        window, and publish a verdict per table (HEALTHY / DEGRADED /
+        UNHEALTHY) plus `pinot_controller_slo_*` gauges with stale-series
+        removal. `now` is injectable so tests drive synthetic timelines."""
+        from collections import deque
+
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        now = time.time() if now is None else float(now)
+        lat_target = self._cluster_config_float("slo.latency.p99.ms", None)
+        err_target = self._cluster_config_float("slo.error.rate", None)
+        if err_target is not None and err_target <= 0:
+            err_target = None
+        if lat_target is None and err_target is None:
+            # no SLO configured: tear the whole plane down
+            for table in self._slo_tables:
+                for g in self._SLO_GAUGES:
+                    reg.remove_gauge(g, {"table": table})
+            self._slo_tables = set()
+            self._slo_status = {}
+            self._slo_samples.clear()
+            return {}
+        fast_s = self._cluster_config_float("slo.window.fast.s", 300.0)
+        slow_s = self._cluster_config_float("slo.window.slow.s", 3600.0)
+
+        # aggregate cumulative counters across brokers (counters only ever
+        # grow, so summing per poll keeps windowed deltas meaningful)
+        totals: Dict[str, Dict[str, float]] = {}
+        unreachable: List[str] = []
+        for bid, poll in self._iter_slo_pollers():
+            try:
+                snap = poll()
+            except Exception:
+                unreachable.append(bid)
+                continue
+            for table, roll in (snap.get("tableStats") or {}).items():
+                agg = totals.setdefault(table, {"numQueries": 0.0,
+                                                "numErrors": 0.0,
+                                                "numOverSlo": 0.0})
+                for k in agg:
+                    v = roll.get(k)
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        agg[k] += float(v)
+
+        out: Dict[str, Dict[str, object]] = {}
+        for table, agg in totals.items():
+            samples = self._slo_samples.setdefault(table, deque(maxlen=256))
+            samples.append((now, dict(agg)))
+
+            def window_delta(window_s):
+                # delta vs the OLDEST sample inside the window (zero when the
+                # window holds only the sample just taken — no judgement
+                # before a second observation lands)
+                cutoff = now - window_s
+                for ts, base in samples:
+                    if ts >= cutoff:
+                        return {k: agg[k] - base[k] for k in agg}
+                return {k: 0.0 for k in agg}
+
+            def burns(delta):
+                nq = delta["numQueries"]
+                if nq <= 0:
+                    return 0.0, 0.0   # zero traffic burns no budget
+                eb = ((delta["numErrors"] / nq) / err_target
+                      if err_target is not None else 0.0)
+                lb = ((delta["numOverSlo"] / nq) / self.SLO_LATENCY_BUDGET
+                      if lat_target is not None else 0.0)
+                return round(eb, 3), round(lb, 3)
+
+            eb_fast, lb_fast = burns(window_delta(fast_s))
+            eb_slow, lb_slow = burns(window_delta(slow_s))
+
+            verdict = "HEALTHY"
+            reasons: List[str] = []
+
+            def degrade(to: str, reason: str) -> None:
+                nonlocal verdict
+                reasons.append(reason)
+                order = ("HEALTHY", "DEGRADED", "UNHEALTHY")
+                if order.index(to) > order.index(verdict):
+                    verdict = to
+
+            for dim, bf, bs in (("error", eb_fast, eb_slow),
+                                ("latency", lb_fast, lb_slow)):
+                if bf >= self.SLO_PAGE_BURN_RATE:
+                    degrade("UNHEALTHY", f"{dim} budget burning at {bf:g}x "
+                                         f"over the fast window")
+                elif bf > 1.0 and bs > 1.0:
+                    degrade("DEGRADED", f"{dim} burn rate {bf:g}x fast / "
+                                        f"{bs:g}x slow — budget exhausting")
+            if unreachable:
+                degrade("DEGRADED",
+                        f"slo poll failed for brokers: {sorted(unreachable)}")
+
+            labels = {"table": table}
+            reg.gauge(self._SLO_GAUGES[0], labels).set(
+                1 if verdict == "HEALTHY" else 0)
+            reg.gauge(self._SLO_GAUGES[1], labels).set(max(lb_fast, lb_slow))
+            reg.gauge(self._SLO_GAUGES[2], labels).set(max(eb_fast, eb_slow))
+            out[table] = {
+                "table": table, "sloState": verdict, "reasons": reasons,
+                "latencyTargetMs": lat_target, "errorRateTarget": err_target,
+                "burnRates": {"errorFast": eb_fast, "errorSlow": eb_slow,
+                              "latencyFast": lb_fast, "latencySlow": lb_slow},
+                "windowsS": {"fast": fast_s, "slow": slow_s},
+                "totals": {k: round(v, 3) for k, v in agg.items()},
+                "unreachableBrokers": sorted(unreachable),
+            }
+        for table in self._slo_tables - set(out):
+            for g in self._SLO_GAUGES:
+                reg.remove_gauge(g, {"table": table})
+            self._slo_samples.pop(table, None)
+        self._slo_tables = set(out)
+        self._slo_status = out
+        return {t: str(s["sloState"]) for t, s in out.items()}
+
+    def slo_status(self, table: str) -> Dict[str, object]:
+        """Per-table SLO verdict (the /tables/{t}/sloStatus body). Tables the
+        check has not judged yet answer with an empty verdict; unknown tables
+        raise (-> 404)."""
+        st = self._slo_status.get(table)
+        if st is None and table.endswith(("_OFFLINE", "_REALTIME")):
+            # broker rollups key on the LOGICAL table name; accept nameWithType
+            st = self._slo_status.get(table.rsplit("_", 1)[0])
+        if st is not None:
+            return st
+        known = any(name == table or name.rsplit("_", 1)[0] == table
+                    for name in self.catalog.table_configs)
+        if not known:
+            raise ValueError(f"unknown table {table!r}")
+        lat = self._cluster_config_float("slo.latency.p99.ms", None)
+        err = self._cluster_config_float("slo.error.rate", None)
+        configured = lat is not None or (err is not None and err > 0)
+        return {"table": table,
+                "sloState": "HEALTHY" if configured else "UNCONFIGURED",
+                "reasons": [], "latencyTargetMs": lat, "errorRateTarget": err,
+                "burnRates": {}, "totals": {},
+                "message": ("no query traffic observed yet" if configured else
+                            "no SLO targets in cluster config")}
+
     def debug_stats(self) -> Dict[str, object]:
         """Controller /debug rollup: periodic task health (a silently-failing
         task is a climbing errorCount + stale lastRunMs), the last ingestion
-        verdicts, and the controller-scoped metric snapshot + gauge rings."""
+        and SLO verdicts, and the controller-scoped metric snapshot + gauge
+        rings."""
         from ..utils.metrics import get_registry
         reg = get_registry()
         return {
@@ -499,6 +684,7 @@ class Controller:
             "ingestionStatus": {t: {k: v for k, v in s.items()
                                     if k != "servers"}
                                 for t, s in self._ingestion_status.items()},
+            "sloStatus": dict(self._slo_status),
             "controllerMetrics": {k: v for k, v in reg.snapshot().items()
                                   if k.startswith(("pinot_controller",
                                                    "pinot_periodic"))},

@@ -147,10 +147,26 @@ class DeviceQueryPipeline:
     # -- caller side ------------------------------------------------------
     def execute_partial(self, ctx, segments: Sequence):
         """Submit and wait; returns a SegmentResult partial or DEVICE_FALLBACK."""
+        from ..utils.trace import current_depth, current_trace
         item = _Item(ctx, list(segments))
+        tr = current_trace()
+        submit_ms = tr.now_ms() if tr is not None else 0.0
         self._q.put(item)
         try:
-            return item.future.result(timeout=self.submit_timeout_s)
+            result = item.future.result(timeout=self.submit_timeout_s)
+            if tr is not None and result is not DEVICE_FALLBACK:
+                # the pipeline threads can't see this query's trace; rebuild
+                # the device-side phases from the item's launch attribution —
+                # queue wait starts at submit, the batched fetch ends now
+                depth = current_depth()
+                s = getattr(result, "stats", None) or {}
+                wait_ms = float(s.get("queueWaitMs") or 0.0)
+                tr.record("pipeline:queue_wait", submit_ms, wait_ms,
+                          depth=depth)
+                fetch_ms = float(s.get("deviceFetchMs") or 0.0)
+                tr.record("pipeline:fetch", tr.now_ms() - fetch_ms, fetch_ms,
+                          depth=depth)
+            return result
         except FutureTimeoutError:
             # cancel so the dispatcher/fetcher SKIP the stale item instead of
             # planning + dispatching + decoding a result nobody will read

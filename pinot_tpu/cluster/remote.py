@@ -284,19 +284,28 @@ class RemoteServerHandle:
 
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
-        from ..utils.trace import current_depth, current_trace
+        from ..utils.trace import current_depth, current_trace, span
         sql = ctx if isinstance(ctx, str) else ctx.sql
         if not sql:
             raise ValueError("remote dispatch requires the query SQL text")
         tr = current_trace()
         dispatch_ms = tr.elapsed_ms() if tr is not None else 0.0
-        body = encode_query_request(table, sql, segment_names, time_filter,
-                                    trace=tr is not None)
-        resp = http_call("POST", f"{self.server_url}/query", body,
-                         timeout=self.timeout_s,
-                         content_type="application/octet-stream",
-                         token=self.token)
-        result = decode_segment_result(resp)
+        # wire-level spans decompose the broker<->server hop: serialize the
+        # request, the on-the-wire round trip (send), deserialize the result —
+        # the server's own queue_wait/deserialize/exec spans splice in below
+        with span("serialize"):
+            body = encode_query_request(
+                table, sql, segment_names, time_filter,
+                trace=tr is not None,
+                trace_id=tr.trace_id if tr is not None else "",
+                sampled=bool(tr.sampled) if tr is not None else False)
+        with span("send"):
+            resp = http_call("POST", f"{self.server_url}/query", body,
+                             timeout=self.timeout_s,
+                             content_type="application/octet-stream",
+                             token=self.token)
+        with span("deserialize"):
+            result = decode_segment_result(resp)
         spans = getattr(result, "trace_spans", None)
         if tr is not None and spans:
             # already prefixed server-side with its instance id; rebase the server's

@@ -436,14 +436,22 @@ class ServerNode:
             t_ms = ctx.options.get("timeoutMs") if ctx.options else None
             if t_ms is not None:
                 timeout_s = float(t_ms) / 1000.0
-            # the scheduler's worker thread must see the caller's request trace
-            from ..utils.trace import current_trace
+            # the scheduler's worker thread must see the caller's request trace,
+            # seeded at the caller's nesting depth so in-proc spans tree up
+            # exactly like HTTP-spliced ones; the submit->run gap is admission
+            # queueing — recorded as queue_wait so the hop decomposition never
+            # goes queued-blind
+            from ..utils.trace import current_depth, current_trace
             tr = current_trace()
+            depth = current_depth()
+            submit_ms = tr.now_ms() if tr is not None else 0.0
 
             def run():
                 if tr is None:
                     return self._execute_partial(table, ctx, segment_names)
-                with tr.activate():
+                tr.record("queue_wait", submit_ms, tr.now_ms() - submit_ms,
+                          depth=depth)
+                with tr.activate(depth=depth):
                     return self._execute_partial(table, ctx, segment_names)
             return self.scheduler.submit(table, run, timeout_s=timeout_s)
         return self._execute_partial(table, ctx, segment_names)

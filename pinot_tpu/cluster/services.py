@@ -514,6 +514,13 @@ class ControllerService:
                 return json_response(self.controller.ingestion_status(parts[0]))
             except ValueError as e:
                 return error_response(str(e), 404)
+        # GET /tables/{t}/sloStatus — the burn-rate verdict computed by the
+        # controller's periodic SLO check (companion of ingestionStatus)
+        if len(parts) == 2 and parts[1] == "sloStatus":
+            try:
+                return json_response(self.controller.slo_status(parts[0]))
+            except ValueError as e:
+                return error_response(str(e), 404)
         with self.catalog._lock:
             if parts:  # GET /tables/{nameWithType} -> the table config
                 cfg = self.catalog.table_configs.get(parts[0])
@@ -704,13 +711,24 @@ class ServerService:
         self.http.stop()
 
     def _query(self, parts, params, body):
+        import time as _time
         from ..auth import require_table_access
         from ..query.scheduler import QueryRejectedError, QueryTimeoutError
         from ..utils.trace import request_trace
+        t_decode = _time.perf_counter()
         req = decode_query_request(body)
+        decode_ms = (_time.perf_counter() - t_decode) * 1000
         require_table_access(req["table"], "READ")
         try:
-            with request_trace(bool(req.get("trace"))) as tr:
+            # traceId propagates the dispatching broker's trace context so this
+            # server's spans splice into the SAME distributed trace
+            with request_trace(bool(req.get("trace")),
+                               trace_id=req.get("traceId") or None) as tr:
+                if tr is not None:
+                    # the wire decode ran just before this trace's origin;
+                    # record it pre-origin (negative start) so the hop reads
+                    # serialize -> send -> deserialize -> execute once rebased
+                    tr.record("deserialize", -decode_ms, decode_ms)
                 result = self.server.execute_partial(
                     req["table"], req["sql"], req["segments"],
                     time_filter=req.get("timeFilter"))
@@ -1024,8 +1042,9 @@ class BrokerService:
                         lambda p, q, b: json_response({"status": "OK"}))
         self.http.route("GET", "metrics", _metrics_route)
         # GET /debug — query rollups + recent slow queries (JSON); the
-        # operator-facing companion to the Prometheus /metrics exposition
-        self.http.route("GET", "debug", stats_route(broker.debug_stats))
+        # operator-facing companion to the Prometheus /metrics exposition.
+        # GET /debug/traces — the sampled-trace ring (see _debug).
+        self.http.route("GET", "debug", self._debug)
         # subscribe BEFORE the initial scan: a server registering in between then
         # fires an event we handle (re-scan), instead of being silently missed
         broker.catalog.subscribe(self._on_event)
@@ -1045,6 +1064,34 @@ class BrokerService:
     def stop(self) -> None:
         self.broker.failure_detector.stop()  # kill the background probe loop
         self.http.stop()
+
+    def _debug(self, parts, params, body):
+        """GET /debug — broker query rollups. GET /debug/traces — the retained
+        (sampled + slow) trace ring: `?id=<traceId>` resolves one trace (404
+        when evicted/unknown), `?limit=N` bounds the listing, `?format=chrome`
+        renders a Chrome trace-event document loadable in Perfetto."""
+        if parts and parts[0] == "traces":
+            from ..utils.trace import to_chrome_trace
+            ring = self.broker.trace_ring
+            trace_id = params.get("id")
+            if trace_id:
+                entry = ring.get(trace_id)
+                if entry is None:
+                    return error_response(f"unknown trace {trace_id}", 404)
+                if params.get("format") == "chrome":
+                    return json_response(to_chrome_trace(entry))
+                return json_response(entry)
+            try:
+                limit = int(params["limit"]) if "limit" in params else None
+            except (TypeError, ValueError):
+                limit = None
+            traces = ring.entries(limit)
+            if params.get("format") == "chrome":
+                return json_response(to_chrome_trace(traces))
+            return json_response({"traces": traces, "retained": len(ring),
+                                  "capacity": ring.capacity})
+        return (200, "application/json",
+                json.dumps(self.broker.debug_stats(), default=str).encode())
 
     def _on_event(self, event: str, _key: str) -> None:
         if event == "instance":

@@ -278,13 +278,17 @@ def decode_block(d: Dict[str, Any]) -> Dict[str, np.ndarray]:
 
 
 def encode_query_request(table: str, sql: str, segments,
-                         time_filter: str = None, trace: bool = False) -> bytes:
+                         time_filter: str = None, trace: bool = False,
+                         trace_id: str = "", sampled: bool = False) -> bytes:
     """Broker -> server query dispatch (reference: thrift InstanceRequest with the
     compiled query + searchSegments list, `InstanceRequestHandler.java:96`;
     `timeFilter` carries the hybrid time-boundary predicate, `trace` the request's
-    trace-enabled flag — CommonConstants.Request.TRACE)."""
+    trace-enabled flag — CommonConstants.Request.TRACE). `trace_id`/`sampled`
+    propagate the dispatching broker's trace context so the server's spans splice
+    into the SAME distributed trace (the trace-context header analog)."""
     return json.dumps({"table": table, "sql": sql, "segments": list(segments),
-                       "timeFilter": time_filter, "trace": trace}).encode()
+                       "timeFilter": time_filter, "trace": trace,
+                       "traceId": trace_id, "sampled": sampled}).encode()
 
 
 def decode_query_request(data: bytes) -> Dict[str, Any]:

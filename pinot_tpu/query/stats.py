@@ -68,7 +68,7 @@ MIN_KEYS = (MIN_CONSUMING_FRESHNESS_TIME_MS,)
 BROKER_KEYS = (
     "timeUsedMs", NUM_DOCS_SCANNED, "numGroupsTotal", "numServersQueried",
     "numServersResponded", "partialResult", "phaseTimesMs", "traceInfo",
-    "gapfilled", "explain", "analyze",
+    "traceId", "gapfilled", "explain", "analyze",
 )
 
 _OP_PREFIX = "op:"

@@ -3,8 +3,10 @@
 
 Polls the controller and broker debug/status endpoints and renders one row per
 table: QPS, consuming-segment count, max offset lag, max freshness lag, rows/s,
-and the controller's ingestion verdict — the operator's first stop when a
-dashboard shows a table going stale:
+the controller's ingestion verdict, and its SLO burn-rate verdict — plus a
+top-consumers panel attributing device time / bytes / queue wait per table
+from the broker rollups. The operator's first stop when a dashboard shows a
+table going stale or an SLO burning:
 
     python -m pinot_tpu.tools.cluster_top --controller http://host:9000 \\
         --broker http://host:8099 [--interval 5] [--once] [--token TOKEN]
@@ -37,7 +39,8 @@ def snapshot(controller_url: str, broker_url: Optional[str],
     controller plus the broker's lifetime query rollup. Endpoint failures
     degrade to partial data (an unreachable broker must not blank the lag
     columns)."""
-    out: Dict[str, Any] = {"tables": {}, "broker": None, "errors": []}
+    out: Dict[str, Any] = {"tables": {}, "slo": {}, "tableStats": {},
+                           "broker": None, "errors": []}
     try:
         tables = fetch(f"{controller_url}/tables").get("tables", [])
     except Exception as e:
@@ -50,9 +53,16 @@ def snapshot(controller_url: str, broker_url: Optional[str],
         except Exception as e:
             out["tables"][t] = {"table": t, "ingestionState": "UNKNOWN",
                                 "reasons": [f"poll failed: {e}"]}
+        try:
+            out["slo"][t] = fetch(f"{controller_url}/tables/{t}/sloStatus")
+        except Exception:
+            pass   # older controller / unknown table: SLO column shows "-"
     if broker_url:
         try:
-            out["broker"] = fetch(f"{broker_url}/debug").get("queryStats")
+            debug = fetch(f"{broker_url}/debug")
+            out["broker"] = debug.get("queryStats")
+            # per-table resource attribution (the top-consumers panel)
+            out["tableStats"] = debug.get("tableStats") or {}
         except Exception as e:
             out["errors"].append(f"broker /debug: {e}")
     try:
@@ -88,23 +98,47 @@ def render(snap: Dict[str, Any]) -> str:
                  f" avg={broker.get('avgTimeMs', 0)}ms"
                  f" slow={broker.get('numSlowQueries', 0)}")
     lines.append(head)
-    cols = f"{'TABLE':<28} {'HEALTH':<10} {'CONS':>4} {'OFFLAG':>8} " \
-           f"{'FRESHLAG':>9} {'ROWS/S':>8}  REASONS"
+    cols = f"{'TABLE':<28} {'HEALTH':<10} {'SLO':<12} {'CONS':>4} " \
+           f"{'OFFLAG':>8} {'FRESHLAG':>9} {'ROWS/S':>8}  REASONS"
     lines.append(cols)
     lines.append("-" * len(cols))
     for t in sorted(snap.get("tables", {})):
         st = snap["tables"][t]
-        reasons = "; ".join(st.get("reasons") or [])
+        slo = (snap.get("slo") or {}).get(t) or {}
+        reasons = "; ".join((st.get("reasons") or []) +
+                            (slo.get("reasons") or []))
         if st.get("paused") and "paused" not in reasons:
             reasons = ("paused; " + reasons).rstrip("; ")
         lines.append(
             f"{t:<28} {st.get('ingestionState', '?'):<10} "
+            f"{slo.get('sloState', '-'):<12} "
             f"{st.get('numConsumingSegments', 0):>4} "
             f"{st.get('maxOffsetLag', 0):>8} "
             f"{_fmt_lag_ms(st.get('maxFreshnessLagMs')):>9} "
             f"{st.get('totalRowsPerSecond', 0):>8}  {reasons}")
     if not snap.get("tables"):
         lines.append("(no tables)")
+    consumers = snap.get("tableStats") or {}
+    if consumers:
+        lines.append("")
+        lines.append("top consumers (broker attribution, lifetime)")
+        ccols = f"{'TABLE':<28} {'QUERIES':>8} {'DEVMS':>10} {'QWAITMS':>9} " \
+                f"{'BYTES':>12} {'ROWS':>12} {'P99MS':>8} {'SLOW':>5} {'ERR':>4}"
+        lines.append(ccols)
+        lines.append("-" * len(ccols))
+        ranked = sorted(consumers.items(),
+                        key=lambda kv: kv[1].get("deviceExecMs") or 0.0,
+                        reverse=True)
+        for t, r in ranked[:10]:
+            lines.append(
+                f"{t:<28} {int(r.get('numQueries', 0)):>8} "
+                f"{r.get('deviceExecMs', 0):>10} "
+                f"{r.get('queueWaitMs', 0):>9} "
+                f"{int(r.get('bytesFetched', 0)):>12} "
+                f"{int(r.get('rowsScanned', 0)):>12} "
+                f"{r.get('p99LatencyMs', 0):>8} "
+                f"{int(r.get('numSlowQueries', 0)):>5} "
+                f"{int(r.get('numErrors', 0)):>4}")
     failing = {n: s for n, s in (snap.get("periodicTasks") or {}).items()
                if s.get("lastError")}
     for name, s in sorted(failing.items()):

@@ -7,10 +7,16 @@ HTTP response body, or a slow-query log line — all three shapes are accepted):
     python tools/query_report.py response.json
     curl -s broker:8099/query -d '{"sql": "..."}' | python tools/query_report.py
 
+Exported traces work too, so saved `GET /debug/traces` captures analyze
+offline without a live cluster: a `{"traces": [...]}` listing, a single ring
+entry (`{"traceId", "spans", ...}`), or the Chrome trace-event form
+(`{"traceEvents": [...]}`) all render a per-span waterfall.
+
 Output: a wall-clock waterfall of the broker phases (compile / scatter /
-reduce), the device-time breakdown inside the scatter window (compile, exec,
-fetch, queue wait), and the scan/cache counters — everything an operator needs
-to see WHERE a slow query spent its time without attaching a profiler.
+reduce) or of the trace's spans, the device-time breakdown inside the scatter
+window (compile, exec, fetch, queue wait), and the scan/cache counters —
+everything an operator needs to see WHERE a slow query spent its time without
+attaching a profiler.
 """
 
 from __future__ import annotations
@@ -92,6 +98,75 @@ def render_report(stats: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def _trace_entries(doc: Any) -> List[Dict[str, Any]]:
+    """Detect an exported-trace document: a /debug/traces listing, a single
+    ring entry, or a Chrome trace-event export. Returns normalized entries
+    ({traceId, sql?, timeUsedMs?, spans: [{name, startMs, durationMs, depth,
+    error?}]}), or [] when `doc` is not a trace document."""
+    if not isinstance(doc, dict):
+        return []
+    if isinstance(doc.get("traces"), list):
+        return [e for e in doc["traces"] if isinstance(e, dict)]
+    if isinstance(doc.get("spans"), list) and "traceId" in doc:
+        return [doc]
+    if isinstance(doc.get("traceEvents"), list):
+        # fold the Chrome form back: one entry per pid, µs back to ms
+        by_pid: Dict[Any, Dict[str, Any]] = {}
+        for ev in doc["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            pid = ev.get("pid")
+            entry = by_pid.setdefault(pid, {"traceId": f"pid{pid}",
+                                            "spans": []})
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                entry["sql"] = (ev.get("args") or {}).get("name", "")
+            elif ev.get("ph") == "X":
+                entry["spans"].append({
+                    "name": ev.get("name", ""),
+                    "startMs": float(ev.get("ts", 0.0)) / 1000.0,
+                    "durationMs": float(ev.get("dur", 0.0)) / 1000.0,
+                    "depth": (ev.get("args") or {}).get("depth", 0),
+                    "error": bool((ev.get("args") or {}).get("error")),
+                })
+        return list(by_pid.values())
+    return []
+
+
+def render_trace(entry: Dict[str, Any]) -> str:
+    """Span waterfall for one retained trace: rows sorted by start, indented
+    by nesting depth, bars on a shared wall-clock axis."""
+    out: List[str] = []
+    head = f"trace: {entry.get('traceId', '?')}"
+    if entry.get("sql"):
+        head += f"  {entry['sql']}"
+    out.append(head)
+    meta = [f"{k}={entry[k]}" for k in ("timeUsedMs", "sampled", "slow",
+                                        "error") if k in entry]
+    if meta:
+        out.append("  " + "  ".join(meta))
+    spans = sorted(entry.get("spans") or [],
+                   key=lambda s: float(s.get("startMs", 0.0)))
+    if not spans:
+        out.append("  (no spans)")
+        return "\n".join(out)
+    end = max(float(s.get("startMs", 0.0)) + float(s.get("durationMs", 0.0))
+              for s in spans)
+    origin = min(float(s.get("startMs", 0.0)) for s in spans)
+    scale = (end - origin) or 1.0
+    out.append("")
+    for s in spans:
+        depth = int(s.get("depth", 0))
+        name = "  " * depth + str(s.get("name", "?"))
+        start = float(s.get("startMs", 0.0))
+        dur = float(s.get("durationMs", 0.0))
+        lead = int(round(BAR_WIDTH * (start - origin) / scale))
+        bar = " " * lead + (_bar(dur, scale) or ("|" if dur >= 0 else ""))
+        flag = "  !ERROR" if s.get("error") else ""
+        out.append(f"  {name:<34} {_fmt_ms(dur)}  "
+                   f"|{bar:<{BAR_WIDTH}}|{flag}")
+    return "\n".join(out)
+
+
 def main(argv: List[str]) -> int:
     if len(argv) > 1 and argv[1] not in ("-", "-h", "--help"):
         with open(argv[1]) as f:
@@ -101,6 +176,10 @@ def main(argv: List[str]) -> int:
         return 0
     else:
         doc = json.load(sys.stdin)
+    traces = _trace_entries(doc)
+    if traces:
+        print("\n\n".join(render_trace(e) for e in traces))
+        return 0
     print(render_report(_extract_stats(doc)))
     return 0
 

@@ -193,13 +193,22 @@ class MetricsRegistry:
                 self._histograms[k] = Histogram(buckets)
             return self._histograms[k]
 
+    def remove(self, name: str, labels: Optional[Dict[str, str]] = None) -> None:
+        """Drop a metric series of ANY kind (counter/gauge/timer/histogram).
+        Used when the labeled entity disappears — e.g. per-table series after
+        the table is dropped; exporting metrics for nonexistent tables
+        misleads dashboards."""
+        k = _key(name, labels)
+        with self._lock:
+            self._counters.pop(k, None)
+            self._gauges.pop(k, None)
+            self._timers.pop(k, None)
+            self._histograms.pop(k, None)
+
     def remove_gauge(self, name: str, labels: Optional[Dict[str, str]] = None
                      ) -> None:
-        """Drop a gauge series (e.g. per-table health gauges after the table
-        is dropped — exporting metrics for nonexistent tables misleads
-        dashboards)."""
-        with self._lock:
-            self._gauges.pop(_key(name, labels), None)
+        """Back-compat alias of `remove` (originally gauge-only)."""
+        self.remove(name, labels)
 
     # -- read side ----------------------------------------------------------
     def counter_value(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:

@@ -11,36 +11,74 @@ is shared by every thread working the request (the broker's scatter pool threads
 `activate` the same Trace), which keeps the recorder lock-free on the read side and
 needs no cross-process merge for the in-proc transport. Remote (HTTP) servers attach
 their span lists to the serialized partial and the broker splices them in.
+
+Always-on sampling layer (the broker owns one of each):
+
+* every query gets a Trace (span recording is a dict append — cheap enough to
+  leave on unconditionally), identified by a `trace_id` that rides the wire to
+  servers and back in the response stats;
+* `TraceSampler` — head-based probabilistic admission (`broker.trace.sample.rate`)
+  deciding which traces are RETAINED; seedable for deterministic tests;
+* `TraceRing` — the bounded retention ring behind `GET /debug/traces`. Queries
+  crossing `broker.slow.query.ms` are force-admitted at the tail regardless of
+  the head decision, so every slow-query log line resolves to a full trace;
+* `to_chrome_trace` — renders ring entries as a Chrome trace-event JSON document
+  (loadable in Perfetto / chrome://tracing) with one track per server hop.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
+import uuid
+from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Union
 
 _local = threading.local()
+
+
+def new_trace_id() -> str:
+    """16-hex-char unique id (the W3C trace-context span-id width)."""
+    return uuid.uuid4().hex[:16]
 
 
 class Trace:
     """Request-scoped span recorder. Thread-safe appends; one instance per query."""
 
-    def __init__(self, request_id: str = ""):
+    def __init__(self, request_id: str = "", trace_id: Optional[str] = None):
         self.request_id = request_id
+        self.trace_id = trace_id or new_trace_id()
+        #: head-sampling decision (set by the broker); tail retention may admit
+        #: the trace into the ring even when False
+        self.sampled = False
         self.spans: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
+    def now_ms(self) -> float:
+        """Milliseconds since this trace's origin — THE public clock. Span
+        starts, remote rebasing, and pipeline attribution read this instead of
+        reaching into `_t0`."""
+        return (time.perf_counter() - self._t0) * 1000
+
+    def elapsed_ms(self) -> float:
+        """Alias of `now_ms` (kept for the dispatch-rebasing call sites)."""
+        return self.now_ms()
+
     def record(self, name: str, start_ms: float, duration_ms: float,
-               depth: int = 0) -> None:
+               depth: int = 0, error: bool = False) -> None:
+        span = {
+            "name": name,
+            "startMs": round(start_ms, 3),
+            "durationMs": round(duration_ms, 3),
+            "depth": depth,
+        }
+        if error:
+            span["error"] = True
         with self._lock:
-            self.spans.append({
-                "name": name,
-                "startMs": round(start_ms, 3),
-                "durationMs": round(duration_ms, 3),
-                "depth": depth,
-            })
+            self.spans.append(span)
 
     def splice(self, spans: List[Dict[str, Any]], prefix: str = "",
                offset_ms: float = 0.0, depth_offset: int = 0) -> None:
@@ -59,21 +97,20 @@ class Trace:
                 s["depth"] = int(s.get("depth", 0)) + depth_offset
                 self.spans.append(s)
 
-    def elapsed_ms(self) -> float:
-        """Milliseconds since this trace's origin (for rebasing remote spans)."""
-        return (time.perf_counter() - self._t0) * 1000
-
     def to_rows(self) -> List[Dict[str, Any]]:
         with self._lock:
             return sorted(self.spans, key=lambda s: s["startMs"])
 
     @contextmanager
-    def activate(self):
-        """Make this trace current for the calling thread (scatter-pool workers)."""
+    def activate(self, depth: int = 0):
+        """Make this trace current for the calling thread (scatter-pool workers).
+        `depth` seeds the thread's nesting level — a server scheduler thread
+        passes the dispatch-site depth so its spans nest under the dispatching
+        span exactly like HTTP-spliced spans do."""
         prev = getattr(_local, "trace", None)
         prev_depth = getattr(_local, "depth", 0)
         _local.trace = self
-        _local.depth = 0
+        _local.depth = depth
         try:
             yield self
         finally:
@@ -92,30 +129,151 @@ def current_depth() -> int:
 
 
 @contextmanager
-def request_trace(enabled: bool, request_id: str = ""):
+def request_trace(enabled: bool, request_id: str = "",
+                  trace_id: Optional[str] = None):
     """Start a trace for this request on the current thread; None when disabled —
-    `span()` then degrades to a no-op so instrumented code never branches."""
+    `span()` then degrades to a no-op so instrumented code never branches.
+    `trace_id` carries a propagated wire context (server side of a dispatch)."""
     if not enabled:
         yield None
         return
-    tr = Trace(request_id)
+    tr = Trace(request_id, trace_id=trace_id)
     with tr.activate():
         yield tr
 
 
 @contextmanager
 def span(name: str):
-    """Record a named span on the current thread's active trace (no-op if none)."""
+    """Record a named span on the current thread's active trace (no-op if none).
+    A body that exits via exception marks the span `error: true` so failed
+    phases are visible in exported timelines."""
     tr = getattr(_local, "trace", None)
     if tr is None:
         yield
         return
     depth = getattr(_local, "depth", 0)
     _local.depth = depth + 1
+    start_ms = tr.now_ms()
     t0 = time.perf_counter()
+    error = False
     try:
         yield
+    except BaseException:
+        error = True
+        raise
     finally:
         _local.depth = depth
-        tr.record(name, (t0 - tr._t0) * 1000,
-                  (time.perf_counter() - t0) * 1000, depth)
+        tr.record(name, start_ms, (time.perf_counter() - t0) * 1000, depth,
+                  error=error)
+
+
+# -- sampling + retention -----------------------------------------------------
+
+class TraceSampler:
+    """Head-based probabilistic sampler. The rate is passed per call (the
+    broker re-reads `broker.trace.sample.rate` from clusterConfig each query);
+    inject a seeded `random.Random` for deterministic tests."""
+
+    def __init__(self, rng: Optional[random.Random] = None):
+        self._rng = rng if rng is not None else random.Random()
+        self._lock = threading.Lock()
+
+    def sample(self, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        with self._lock:
+            return self._rng.random() < rate
+
+
+class TraceRing:
+    """Bounded ring of retained traces, keyed by trace id. Head-sampled traces
+    and tail-retained (slow / errored) traces both land here; eviction is
+    strictly oldest-first so the ring can never grow past `capacity`."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries: "deque" = deque()        # oldest -> newest
+        self._by_id: Dict[str, Dict[str, Any]] = {}
+
+    def admit(self, trace: Trace, **meta: Any) -> Dict[str, Any]:
+        """Retain one finished trace; `meta` carries query-level context
+        (sql, timeUsedMs, slow/error flags)."""
+        entry: Dict[str, Any] = {
+            "traceId": trace.trace_id,
+            "requestId": trace.request_id,
+            "sampled": bool(trace.sampled),
+            "spans": trace.to_rows(),
+        }
+        entry.update(meta)
+        with self._lock:
+            self._entries.append(entry)
+            self._by_id[entry["traceId"]] = entry
+            while len(self._entries) > self.capacity:
+                dead = self._entries.popleft()
+                if self._by_id.get(dead["traceId"]) is dead:
+                    del self._by_id[dead["traceId"]]
+        return entry
+
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._by_id.get(trace_id)
+
+    def entries(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Newest-first retained entries (bounded by `limit` when given)."""
+        with self._lock:
+            rows = list(self._entries)
+        rows.reverse()
+        return rows[:limit] if limit is not None else rows
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# -- Chrome trace-event export ------------------------------------------------
+
+def to_chrome_trace(entries: Union[Dict[str, Any], Iterable[Dict[str, Any]]]
+                    ) -> Dict[str, Any]:
+    """Render ring entries as a Chrome trace-event JSON document (the
+    `{"traceEvents": [...]}` format Perfetto and chrome://tracing load).
+
+    Each retained query becomes one pid; span tracks split by hop — the
+    broker's own spans on one tid, each `server:<id>/...` spliced hop on its
+    own — so the broker↔server decomposition reads as parallel timelines.
+    All events are complete events (`ph: "X"`, microsecond ts/dur) plus
+    metadata events naming the process/threads."""
+    if isinstance(entries, dict):
+        entries = [entries]
+    events: List[Dict[str, Any]] = []
+    for pid, entry in enumerate(entries, start=1):
+        label = entry.get("sql") or entry.get("requestId") or ""
+        events.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                       "args": {"name": f"query {entry.get('traceId', '')} "
+                                        f"{label}".strip()}})
+        tids: Dict[str, int] = {}
+        for s in entry.get("spans", ()):
+            name = str(s.get("name", ""))
+            track = (name.split("/", 1)[0]
+                     if name.startswith("server:") and "/" in name
+                     else "broker")
+            if track not in tids:
+                tids[track] = len(tids) + 1
+                events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                               "tid": tids[track], "args": {"name": track}})
+            args: Dict[str, Any] = {"depth": int(s.get("depth", 0))}
+            if s.get("error"):
+                args["error"] = True
+            events.append({
+                "name": name,
+                "cat": "query",
+                "ph": "X",
+                "ts": round(max(float(s.get("startMs", 0.0)), 0.0) * 1000.0, 3),
+                "dur": round(max(float(s.get("durationMs", 0.0)), 0.0) * 1000.0, 3),
+                "pid": pid,
+                "tid": tids[track],
+                "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

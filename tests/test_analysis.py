@@ -278,6 +278,50 @@ def test_drift_cluster_config_rule():
     assert active == []
 
 
+def test_label_cardinality_true_positive():
+    # a per-user label value is unbounded: every distinct user mints a series
+    active, _ = _check("""
+        def report(reg, user_id):
+            reg.counter("pinot_documented_total", {"user": user_id}).inc()
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert _ids(active) == ["metric-label-cardinality"]
+    assert "'user'" in active[0].message
+
+
+def test_label_cardinality_kwarg_and_dynamic_key_flagged():
+    active, _ = _check("""
+        def report(reg, sql, key):
+            reg.histogram("pinot_documented_total",
+                          labels={"query": sql, key: sql}).observe(1.0)
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert _ids(active) == ["metric-label-cardinality"] * 2
+    assert any("'query'" in f.message for f in active)
+    assert any("<dynamic>" in f.message for f in active)
+
+
+def test_label_cardinality_clean_negative():
+    # bounded keys (table/task/...) may take dynamic values; unknown keys are
+    # fine with CONSTANT values; a labels VARIABLE is out of scope (only a
+    # dict literal is judgeable)
+    active, _ = _check("""
+        def report(reg, table, labels):
+            reg.counter("pinot_documented_total", {"table": table}).inc()
+            reg.gauge("pinot_documented_total", {"source": "broker"}).set(1)
+            reg.timer("pinot_documented_total", labels).update(2.0)
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert active == []
+
+
+def test_label_cardinality_suppression_honored():
+    active, suppressed = _check("""
+        def report(reg, shard):
+            reg.counter("pinot_documented_total",
+                        {"shard": shard}).inc()  # graftcheck: ignore[metric-label-cardinality] -- fixture
+    """, drift_guards.rules(), readme=_OBS_README)
+    assert active == []
+    assert "metric-label-cardinality" in _ids(suppressed)
+
+
 # -- suppression mechanics ----------------------------------------------------
 
 def test_suppression_without_reason_is_a_finding():
@@ -340,6 +384,43 @@ def test_cli_json_format(tmp_path, capsys):
     assert analysis_main([str(bad), "--no-baseline", "--format", "json"]) == 1
     payload = json.loads(capsys.readouterr().out)
     assert payload["new"][0]["rule"] == "blocking-result-no-timeout"
+
+
+def test_update_baseline_round_trip(tmp_path, capsys):
+    """--update-baseline accepts today's findings, the next run is clean, and
+    a NEW violation still fails against the updated baseline."""
+    fixture_dir = tmp_path / "corpus"
+    fixture_dir.mkdir()
+    (fixture_dir / "racy.py").write_text(textwrap.dedent("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def safe(self):
+                with self._lock:
+                    self.n += 1
+            def racy(self):
+                self.n += 1
+    """))
+    bl = str(tmp_path / "baseline.json")
+    corpus = str(fixture_dir)
+    # seeded violation fails against an empty baseline...
+    assert analysis_main([corpus, "--baseline", bl]) == 1
+    # ...--update-baseline accepts it and reports what it wrote...
+    assert analysis_main([corpus, "--update-baseline", "--baseline", bl]) == 0
+    assert "baseline updated" in capsys.readouterr().out
+    # ...after which the same corpus is clean, but --no-baseline still sees it
+    assert analysis_main([corpus, "--baseline", bl]) == 0
+    assert analysis_main([corpus, "--no-baseline"]) == 1
+    capsys.readouterr()
+    # a NEW violation (unbounded metric label) is not masked by the baseline
+    (fixture_dir / "labels.py").write_text(textwrap.dedent("""
+        def report(reg, user_id):
+            reg.counter("pinot_x_total", {"user": user_id}).inc()
+    """))
+    assert analysis_main([corpus, "--baseline", bl]) == 1
+    assert "metric-label-cardinality" in capsys.readouterr().out
 
 
 # -- threaded regressions for the lock-discipline sweep fixes -----------------

@@ -1,0 +1,299 @@
+"""Per-table resource accounting + SLO burn-rate verdicts.
+
+The broker attributes every query's resources to its logical table
+(`pinot_table_*` labeled gauges + the /debug tableStats panel); the
+controller's SLOStatusChecker turns those rollups into multi-window
+burn-rate verdicts (`sloStatus`, `pinot_controller_slo_*` gauges) — the
+SRE-workbook multi-burn-rate policy over cluster data.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import TableConfig
+from pinot_tpu.utils.metrics import get_registry
+
+
+@pytest.fixture
+def acct_cluster(tmp_path):
+    schema = Schema("acct", [dimension("site", DataType.STRING),
+                             metric("v", DataType.LONG)])
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cfg = TableConfig("acct", replication=1)
+    cluster.create_table(schema, cfg)
+    cluster.ingest_columns(cfg, {
+        "site": np.array(["a", "b"] * 50),
+        "v": np.arange(100, dtype=np.int64),
+    })
+    return cluster
+
+
+# -- per-table accounting -----------------------------------------------------
+
+def test_table_rollup_gauges_and_debug_panel(acct_cluster):
+    for _ in range(3):
+        acct_cluster.query("SELECT site, SUM(v) FROM acct GROUP BY site")
+    snap = get_registry().snapshot()
+    assert snap["pinot_table_queries{table=acct}"] == 3.0
+    assert snap["pinot_table_time_ms{table=acct}"] > 0
+    assert snap["pinot_table_rows_scanned{table=acct}"] == 300.0
+    assert snap["pinot_table_errors{table=acct}"] == 0.0
+    dbg = acct_cluster.broker.debug_stats()
+    panel = dbg["tableStats"]["acct"]
+    assert panel["numQueries"] == 3
+    assert panel["rowsScanned"] == 300
+    assert panel["avgTimeMs"] > 0
+    assert panel["p99LatencyMs"] > 0
+    # device/bytes/queue-wait attribution columns always present (0 on the
+    # pure-CPU path) so cluster_top renders a stable panel
+    for key in ("deviceExecMs", "bytesFetched", "queueWaitMs",
+                "numSlowQueries", "numOverSlo"):
+        assert key in panel
+
+
+def test_table_errors_attributed(acct_cluster):
+    with pytest.raises(Exception):
+        acct_cluster.query("SELECT nope_col, SUM(v) FROM acct GROUP BY nope_col")
+    snap = get_registry().snapshot()
+    assert snap["pinot_table_errors{table=acct}"] >= 1.0
+
+
+def test_slow_and_over_slo_counted(acct_cluster):
+    cat = acct_cluster.broker.catalog
+    cat.put_property("clusterConfig/broker.slow.query.ms", "0")
+    cat.put_property("clusterConfig/slo.latency.p99.ms", "0")
+    try:
+        acct_cluster.query("SELECT COUNT(*) FROM acct")
+    finally:
+        cat.put_property("clusterConfig/broker.slow.query.ms", None)
+        cat.put_property("clusterConfig/slo.latency.p99.ms", None)
+    panel = acct_cluster.broker.debug_stats()["tableStats"]["acct"]
+    assert panel["numSlowQueries"] >= 1
+    assert panel["numOverSlo"] >= 1
+
+
+def test_dropped_table_series_removed(acct_cluster):
+    acct_cluster.query("SELECT COUNT(*) FROM acct")
+    assert "pinot_table_queries{table=acct}" in get_registry().snapshot()
+    acct_cluster.controller.drop_table("acct_OFFLINE")
+    # /debug forces the sweep: rollup + every labeled series must go
+    dbg = acct_cluster.broker.debug_stats()
+    assert "acct" not in dbg["tableStats"]
+    snap = get_registry().snapshot()
+    assert not any(k.startswith("pinot_table_") and "table=acct}" in k
+                   for k in snap), sorted(
+        k for k in snap if "table=acct}" in k)
+
+
+# -- SLO burn-rate verdicts ---------------------------------------------------
+
+@pytest.fixture
+def slo_controller(tmp_path):
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    catalog = Catalog()
+    controller = Controller("controller_slo", catalog,
+                            LocalDeepStore(str(tmp_path / "ds")),
+                            str(tmp_path / "ctrl"))
+    schema = Schema("sloq", [dimension("k", DataType.STRING)])
+    controller.add_schema(schema)
+    controller.add_table(TableConfig("sloq", replication=1))
+    catalog.put_property("clusterConfig/slo.latency.p99.ms", "100")
+    catalog.put_property("clusterConfig/slo.error.rate", "0.01")
+    return controller
+
+
+def _poller(counters):
+    return lambda: {"tableStats": {"sloq": dict(counters)}}
+
+
+def test_slo_burn_rate_escalation(slo_controller):
+    """Synthetic counter timeline drives HEALTHY -> DEGRADED (fast & slow
+    burn > 1) -> UNHEALTHY (fast burn >= the 14.4x page threshold)."""
+    c = slo_controller
+    counters = {"numQueries": 1000, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+
+    # first observation: no prior sample in any window -> zero burn
+    assert c.run_slo_check(now=1000.0) == {"sloq": "HEALTHY"}
+    st = c.slo_status("sloq")
+    assert st["burnRates"] == {"errorFast": 0.0, "errorSlow": 0.0,
+                               "latencyFast": 0.0, "latencySlow": 0.0}
+    assert st["latencyTargetMs"] == 100.0 and st["errorRateTarget"] == 0.01
+
+    # clean traffic: burns stay zero
+    counters.update(numQueries=2000)
+    assert c.run_slo_check(now=1060.0) == {"sloq": "HEALTHY"}
+
+    # 2% errors over the window = 2x the 1% budget in BOTH windows -> DEGRADED
+    counters.update(numQueries=3000, numErrors=40)
+    assert c.run_slo_check(now=1120.0) == {"sloq": "DEGRADED"}
+    st = c.slo_status("sloq")
+    assert st["burnRates"]["errorFast"] == 2.0
+    assert st["burnRates"]["errorSlow"] == 2.0
+    assert any("error burn rate" in r for r in st["reasons"])
+
+    # error spike: 18% errors over the fast window >= 14.4x -> UNHEALTHY
+    counters.update(numQueries=4000, numErrors=540)
+    assert c.run_slo_check(now=1180.0) == {"sloq": "UNHEALTHY"}
+    st = c.slo_status("sloq")
+    assert st["burnRates"]["errorFast"] >= c.SLO_PAGE_BURN_RATE
+    assert any("budget burning" in r for r in st["reasons"])
+
+    snap = get_registry().snapshot()
+    assert snap["pinot_controller_slo_healthy{table=sloq}"] == 0.0
+    assert snap["pinot_controller_slo_error_burn_rate{table=sloq}"] >= 14.4
+
+
+def test_slo_latency_burn_via_over_slo_counter(slo_controller):
+    c = slo_controller
+    counters = {"numQueries": 1000, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+    c.run_slo_check(now=2000.0)
+    # 5% of window queries broke the p99 target = 5x the 1% violation budget
+    counters.update(numQueries=2000, numOverSlo=50)
+    assert c.run_slo_check(now=2060.0) == {"sloq": "DEGRADED"}
+    st = c.slo_status("sloq")
+    assert st["burnRates"]["latencyFast"] == 5.0
+    snap = get_registry().snapshot()
+    assert snap["pinot_controller_slo_latency_burn_rate{table=sloq}"] == 5.0
+
+
+def test_slo_unreachable_broker_degrades(slo_controller):
+    c = slo_controller
+
+    def boom():
+        raise ConnectionError("broker down")
+
+    counters = {"numQueries": 100, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+    c.slo_pollers["b2"] = boom
+    assert c.run_slo_check(now=3000.0) == {"sloq": "DEGRADED"}
+    st = c.slo_status("sloq")
+    assert st["unreachableBrokers"] == ["b2"]
+
+
+def test_slo_stale_table_series_removed(slo_controller):
+    c = slo_controller
+    counters = {"numQueries": 100, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+    c.run_slo_check(now=4000.0)
+    assert "pinot_controller_slo_healthy{table=sloq}" in \
+        get_registry().snapshot()
+    # the table stops reporting (dropped): verdict + gauges must clear
+    c.slo_pollers["b1"] = lambda: {"tableStats": {}}
+    assert c.run_slo_check(now=4060.0) == {}
+    snap = get_registry().snapshot()
+    assert not any("table=sloq}" in k and "slo" in k for k in snap)
+    with_type = c.slo_status("sloq")
+    assert with_type["sloState"] == "HEALTHY"       # known but unjudged
+    assert "no query traffic" in with_type["message"]
+
+
+def test_slo_unconfigured_tears_down(slo_controller):
+    c = slo_controller
+    counters = {"numQueries": 100, "numErrors": 50, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+    c.run_slo_check(now=5000.0)
+    # remove both targets: the whole plane tears down on the next tick
+    c.catalog.put_property("clusterConfig/slo.latency.p99.ms", None)
+    c.catalog.put_property("clusterConfig/slo.error.rate", None)
+    assert c.run_slo_check(now=5060.0) == {}
+    assert not any("pinot_controller_slo" in k and "table=sloq}" in k
+                   for k in get_registry().snapshot())
+    st = c.slo_status("sloq")
+    assert st["sloState"] == "UNCONFIGURED"
+    assert "no SLO targets" in st["message"]
+
+
+def test_slo_status_accepts_name_with_type_and_404s_unknown(slo_controller):
+    c = slo_controller
+    counters = {"numQueries": 100, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+    c.run_slo_check(now=6000.0)
+    # rollups key the LOGICAL name; the REST path uses nameWithType
+    assert c.slo_status("sloq_OFFLINE")["table"] == "sloq"
+    with pytest.raises(ValueError):
+        c.slo_status("never_heard_of_it")
+
+
+def test_slo_status_http_route(slo_controller):
+    from pinot_tpu.cluster.http_service import HttpError, get_json
+    from pinot_tpu.cluster.services import ControllerService
+    c = slo_controller
+    counters = {"numQueries": 200, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = _poller(counters)
+    c.run_slo_check(now=7000.0)
+    svc = ControllerService(c)
+    try:
+        body = get_json(f"{svc.url}/tables/sloq_OFFLINE/sloStatus")
+        assert body["sloState"] == "HEALTHY"
+        assert body["table"] == "sloq"
+        with pytest.raises(HttpError):
+            get_json(f"{svc.url}/tables/ghost/sloStatus")
+        # the controller /debug rollup carries the verdict map too
+        dbg = get_json(f"{svc.url}/debug")
+        assert dbg["sloStatus"]["sloq"]["sloState"] == "HEALTHY"
+        assert "SLOStatusChecker" in dbg["periodicTasks"]
+    finally:
+        svc.stop()
+
+
+# -- cluster_top: SLO column + top-consumers panel ----------------------------
+
+def test_cluster_top_renders_slo_and_consumers():
+    from pinot_tpu.tools.cluster_top import render, snapshot
+
+    pages = {
+        "http://c:9000/tables": {"tables": ["trips_REALTIME"]},
+        "http://c:9000/tables/trips_REALTIME/ingestionStatus": {
+            "table": "trips_REALTIME", "ingestionState": "HEALTHY",
+            "numConsumingSegments": 2, "maxOffsetLag": 0,
+            "maxFreshnessLagMs": 1200.0, "totalRowsPerSecond": 42.0,
+            "reasons": []},
+        "http://c:9000/tables/trips_REALTIME/sloStatus": {
+            "table": "trips", "sloState": "DEGRADED",
+            "reasons": ["error burn rate 2x fast / 2x slow — "
+                        "budget exhausting"]},
+        "http://c:9000/debug": {"periodicTasks": {}},
+        "http://b:8099/debug": {
+            "queryStats": {"numQueries": 7, "avgTimeMs": 3.0,
+                           "numSlowQueries": 1},
+            "tableStats": {
+                "trips": {"numQueries": 7, "deviceExecMs": 12.5,
+                          "queueWaitMs": 1.25, "bytesFetched": 4096,
+                          "rowsScanned": 700, "p99LatencyMs": 9.5,
+                          "numSlowQueries": 1, "numErrors": 0}}},
+    }
+    snap = snapshot("http://c:9000", "http://b:8099", lambda url: pages[url])
+    assert snap["slo"]["trips_REALTIME"]["sloState"] == "DEGRADED"
+    text = render(snap)
+    row = next(line for line in text.splitlines()
+               if line.startswith("trips_REALTIME"))
+    assert "DEGRADED" in row
+    assert "error burn rate" in row
+    assert "top consumers" in text
+    consumer_row = next(line for line in text.splitlines()
+                        if line.startswith("trips "))
+    assert "4096" in consumer_row and "700" in consumer_row
+
+
+def test_cluster_top_tolerates_missing_slo_endpoint():
+    from pinot_tpu.tools.cluster_top import render, snapshot
+
+    def fetch(url):
+        if url.endswith("/tables"):
+            return {"tables": ["t1_OFFLINE"]}
+        if url.endswith("/ingestionStatus"):
+            return {"table": "t1_OFFLINE", "ingestionState": "HEALTHY",
+                    "reasons": []}
+        raise ConnectionError("older controller")
+
+    snap = snapshot("http://c:9000", None, fetch)
+    text = render(snap)
+    row = next(line for line in text.splitlines()
+               if line.startswith("t1_OFFLINE"))
+    assert " - " in row        # SLO column degrades to "-"

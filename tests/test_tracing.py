@@ -1,0 +1,412 @@
+"""Always-on sampled tracing: the sampler/ring primitives, the Chrome
+trace-event export, and the distributed span tree over BOTH transports.
+
+Acceptance shape (ISSUE 6): every slow-query log line carries a trace id that
+resolves at GET /debug/traces, whose spans decompose the broker<->server HTTP
+hop (serialize / send / queue_wait / deserialize / device exec); the Chrome
+export of a sampled multi-server query loads as a valid timeline; the in-proc
+transport produces the SAME server-execution span tree as HTTP.
+"""
+
+import json
+import random
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.query.scheduler import QueryScheduler
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.table import TableConfig
+from pinot_tpu.utils.trace import (Trace, TraceRing, TraceSampler,
+                                   request_trace, span, to_chrome_trace)
+
+# broker-side wire spans + scheduler admission: transport mechanics, not
+# server execution — excluded from the dual-transport differential
+WIRE_SPANS = frozenset(("serialize", "send", "deserialize", "queue_wait"))
+
+
+# -- satellite: sampler determinism ------------------------------------------
+
+def test_sampler_seeded_rng_is_deterministic():
+    a = TraceSampler(rng=random.Random(42))
+    b = TraceSampler(rng=random.Random(42))
+    decisions_a = [a.sample(0.3) for _ in range(200)]
+    decisions_b = [b.sample(0.3) for _ in range(200)]
+    assert decisions_a == decisions_b
+    assert any(decisions_a) and not all(decisions_a)
+
+
+def test_sampler_rate_edges_never_consult_rng():
+    class Boom:
+        def random(self):
+            raise AssertionError("rng consulted for a 0/1 rate")
+
+    s = TraceSampler(rng=Boom())
+    assert s.sample(0.0) is False
+    assert s.sample(-1.0) is False
+    assert s.sample(1.0) is True
+    assert s.sample(2.0) is True
+
+
+# -- satellite: ring bounds under concurrency --------------------------------
+
+def test_trace_ring_bounded_under_concurrent_admits():
+    ring = TraceRing(capacity=8)
+    per_thread = 100
+    admitted = [[] for _ in range(4)]
+
+    def admit(i):
+        for j in range(per_thread):
+            tr = Trace(f"req-{i}-{j}")
+            tr.sampled = True
+            ring.admit(tr, sql=f"SELECT {i * per_thread + j}")
+            admitted[i].append(tr.trace_id)
+
+    threads = [threading.Thread(target=admit, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ring) == 8
+    entries = ring.entries()
+    assert len(entries) == 8
+    # every retained entry resolves by id; evicted ids return None
+    for e in entries:
+        assert ring.get(e["traceId"]) is e
+    retained = {e["traceId"] for e in entries}
+    for ids in admitted:
+        for tid in ids:
+            if tid not in retained:
+                assert ring.get(tid) is None
+    # the globally newest admit survived (eviction is strictly oldest-first),
+    # and it was some thread's final admit
+    assert any(entries[0]["traceId"] == ids[-1] for ids in admitted)
+
+
+def test_trace_ring_entries_newest_first_with_limit():
+    ring = TraceRing(capacity=4)
+    ids = []
+    for i in range(6):
+        tr = Trace(f"r{i}")
+        ring.admit(tr, seq=i)
+        ids.append(tr.trace_id)
+    assert [e["seq"] for e in ring.entries()] == [5, 4, 3, 2]
+    assert [e["seq"] for e in ring.entries(limit=2)] == [5, 4]
+    assert ring.get(ids[0]) is None     # evicted
+    assert ring.get(ids[-1])["seq"] == 5
+
+
+# -- satellite: error spans ---------------------------------------------------
+
+def test_span_marks_error_and_reraises():
+    with request_trace(True) as tr:
+        with pytest.raises(ValueError):
+            with span("explode"):
+                raise ValueError("boom")
+        with span("fine"):
+            pass
+    rows = {s["name"]: s for s in tr.to_rows()}
+    assert rows["explode"]["error"] is True
+    assert "error" not in rows["fine"]
+
+
+# -- tentpole: Chrome trace-event export --------------------------------------
+
+def _assert_valid_chrome_doc(doc):
+    """Schema-check a Chrome trace-event document (the subset Perfetto and
+    chrome://tracing require of the JSON object format)."""
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    json.loads(json.dumps(doc))        # round-trips as pure JSON
+    for ev in events:
+        assert ev["ph"] in ("M", "X")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "M":
+            assert ev["name"] in ("process_name", "thread_name")
+            assert isinstance(ev["args"]["name"], str)
+        else:
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+            assert isinstance(ev["args"]["depth"], int)
+
+
+def test_chrome_export_splits_tracks_per_server_hop():
+    tr = Trace("q1")
+    tr.sampled = True
+    tr.record("compile", 0.0, 1.0)
+    tr.record("server:server_0", 1.0, 5.0, depth=1)
+    tr.record("server:server_0/segment:ev_0", 2.0, 3.0, depth=2)
+    tr.record("server:server_1/segment:ev_1", 2.0, 3.0, depth=2,
+              error=True)
+    # a clock-skewed negative start must clamp, not corrupt the timeline
+    tr.record("server:server_0/deserialize", -0.4, 0.4, depth=2)
+    ring = TraceRing()
+    ring.admit(tr, sql="SELECT 1")
+    doc = to_chrome_trace(ring.entries())
+    _assert_valid_chrome_doc(doc)
+    events = doc["traceEvents"]
+    names = {ev["args"]["name"] for ev in events if ev["ph"] == "M"}
+    assert {"broker", "server:server_0", "server:server_1"} <= names
+    proc = next(ev for ev in events
+                if ev["ph"] == "M" and ev["name"] == "process_name")
+    assert tr.trace_id in proc["args"]["name"]
+    assert "SELECT 1" in proc["args"]["name"]
+    # per-hop tracks: broker spans and each server's spans get distinct tids
+    tid_of = {ev["args"]["name"]: ev["tid"] for ev in events
+              if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    x_events = {ev["name"]: ev for ev in events if ev["ph"] == "X"}
+    assert x_events["compile"]["tid"] == tid_of["broker"]
+    assert x_events["server:server_0"]["tid"] == tid_of["broker"]
+    assert x_events["server:server_0/segment:ev_0"]["tid"] == \
+        tid_of["server:server_0"]
+    assert x_events["server:server_1/segment:ev_1"]["tid"] == \
+        tid_of["server:server_1"]
+    assert x_events["server:server_1/segment:ev_1"]["args"]["error"] is True
+    assert x_events["server:server_0/deserialize"]["ts"] == 0.0
+
+
+# -- tentpole: dual-transport span-tree differential + HTTP acceptance -------
+
+@pytest.fixture
+def inproc_traced(tmp_path):
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    # same admission control as the HTTP fixture so queue_wait appears on
+    # both transports
+    for s in cluster.servers:
+        s.scheduler = QueryScheduler(max_concurrent=2)
+    schema = Schema("ev", [dimension("site", DataType.STRING),
+                           metric("v", DataType.LONG)])
+    cfg = TableConfig("ev", replication=1)
+    cluster.create_table(schema, cfg)
+    for i in range(2):
+        cluster.ingest_columns(cfg, {
+            "site": np.array(["a", "b"] * 10),
+            "v": np.arange(20, dtype=np.int64) + i,
+        })
+    return cluster
+
+
+@pytest.fixture
+def http_traced(tmp_path):
+    """A real HTTP cluster (controller + 2 scheduled servers + broker), torn
+    down after the test. Yields (broker_service_url, broker, controller
+    catalog, query client)."""
+    from conftest import wait_until
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.catalog import Catalog
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    from pinot_tpu.cluster.process import BrokerClient, ControllerClient
+    from pinot_tpu.cluster.remote import ControllerDeepStore, RemoteCatalog
+    from pinot_tpu.cluster.server import ServerNode
+    from pinot_tpu.cluster.services import (BrokerService, ControllerService,
+                                            ServerService)
+    from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+    schema = Schema("ev", [dimension("site", DataType.STRING),
+                           metric("v", DataType.LONG)])
+    catalog = Catalog()
+    controller = Controller("controller_0", catalog,
+                            LocalDeepStore(str(tmp_path / "ds")),
+                            str(tmp_path / "ctrl"))
+    csvc = ControllerService(controller)
+    services, catalogs, nodes = [csvc], [], []
+    try:
+        for i in range(2):
+            rc = RemoteCatalog(csvc.url, poll_timeout_s=1.0)
+            catalogs.append(rc)
+            node = ServerNode(f"server_{i}", rc, ControllerDeepStore(csvc.url),
+                              str(tmp_path / f"server_{i}"),
+                              scheduler=QueryScheduler(max_concurrent=2))
+            nodes.append(node)
+            services.append(ServerService(node))
+        brc = RemoteCatalog(csvc.url, poll_timeout_s=1.0)
+        catalogs.append(brc)
+        broker = Broker("broker_http", brc)
+        bsvc = BrokerService(broker)
+        services.append(bsvc)
+
+        cc = ControllerClient(csvc.url)
+        cc.add_schema(schema)
+        cfg = TableConfig("ev", replication=1)
+        cc.add_table(cfg)
+        b = SegmentBuilder(schema, SegmentGeneratorConfig())
+        for i in range(2):
+            seg = b.build({"site": np.array(["a", "b"] * 10, dtype=object),
+                           "v": np.arange(20, dtype=np.int64) + i},
+                          str(tmp_path / "b"), f"ev_{i}")
+            cc.upload_segment(cfg.table_name_with_type, seg)
+        assert wait_until(
+            lambda: sum(len(n.segments_served(cfg.table_name_with_type))
+                        for n in nodes) == 2,
+            timeout=15.0, interval=0.05, swallow=())
+        bc = BrokerClient(bsvc.url)
+
+        def query(sql):
+            return bc.query(sql)
+
+        assert wait_until(
+            lambda: _try(lambda: query("SELECT COUNT(*) FROM ev")) is not None,
+            timeout=15.0, interval=0.1, swallow=())
+        yield bsvc.url, broker, catalog, query
+    finally:
+        for c in catalogs:
+            c.close()
+        for s in services:
+            s.stop()
+
+
+def _try(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def _server_exec_shape(spans):
+    """Normalize one transport's server-execution spans to a comparable
+    shape: {(basename, depth relative to its dispatch span)}. HTTP spans are
+    spliced in as `server:<id>/<name>`; in-proc spans run under the dispatch
+    span directly."""
+    dispatch_depth = {s["name"]: s["depth"] for s in spans
+                      if re.fullmatch(r"server:server_\d+", s["name"])}
+    shape = set()
+    for s in spans:
+        name, depth = s["name"], s["depth"]
+        m = re.match(r"(server:server_\d+)/(.+)", name)
+        if m:                                   # HTTP: spliced + prefixed
+            base, rel = m.group(2), depth - dispatch_depth[m.group(1)]
+        elif name in dispatch_depth or name in ("compile", "reduce"):
+            continue                            # broker-side spans
+        else:                                   # in-proc: shared trace
+            base, rel = name, depth - min(dispatch_depth.values())
+        base = re.sub(r"^segment:ev_\d+$", "segment:*", base)
+        if base in WIRE_SPANS or base.startswith("pipeline:"):
+            continue
+        shape.add((base, rel))
+    return shape
+
+
+def test_dual_transport_span_tree_differential(inproc_traced, http_traced):
+    sql = "SELECT site, SUM(v) FROM ev GROUP BY site OPTION(trace=true)"
+    inproc_spans = inproc_traced.query(sql).stats["traceInfo"]
+    _url, _broker, _catalog, query = http_traced
+    http_spans = query(sql)["traceInfo"]
+    # both transports dispatched to real servers under a dispatch span
+    for spans in (inproc_spans, http_spans):
+        assert any(re.fullmatch(r"server:server_\d+", s["name"])
+                   for s in spans), [s["name"] for s in spans]
+    # HTTP decomposes the hop with wire spans the in-proc transport never pays
+    http_names = {s["name"] for s in http_spans}
+    assert {"serialize", "send", "deserialize"} <= http_names
+    assert any(n.endswith("/queue_wait") for n in http_names)
+    # ... but the server-execution tree (what ran, nested where) is IDENTICAL
+    assert _server_exec_shape(inproc_spans) == _server_exec_shape(http_spans)
+
+
+def test_http_slow_query_resolves_at_debug_traces(http_traced):
+    """The acceptance path: slow log line -> traceId -> GET /debug/traces?id=
+    -> spans decomposing the broker<->server hop; plus the Chrome export."""
+    import logging
+
+    from conftest import wait_until
+    from pinot_tpu.cluster.broker import Broker
+    from pinot_tpu.cluster.http_service import HttpError, get_json
+
+    url, broker, catalog, query = http_traced
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    h = Capture()
+    logger = logging.getLogger(Broker.SLOW_QUERY_LOGGER)
+    logger.addHandler(h)
+    catalog.put_property("clusterConfig/broker.slow.query.ms", "0")
+    try:
+        # the broker reads its RemoteCatalog MIRROR; wait for the watch loop
+        assert wait_until(
+            lambda: broker.catalog.get_property(
+                "clusterConfig/broker.slow.query.ms") == "0",
+            timeout=10.0, interval=0.05, swallow=())
+        query("SELECT COUNT(*) FROM ev")
+    finally:
+        catalog.put_property("clusterConfig/broker.slow.query.ms", None)
+        logger.removeHandler(h)
+    entry = json.loads(records[-1].getMessage())
+    trace_id = entry["stats"]["traceId"]
+    assert re.fullmatch(r"[0-9a-f]{16}", trace_id)
+
+    got = get_json(f"{url}/debug/traces?id={trace_id}")
+    assert got["traceId"] == trace_id
+    assert got["slow"] is True
+    names = {s["name"] for s in got["spans"]}
+    # the 110ms-floor decomposition: wire + admission + server execution
+    assert {"serialize", "send", "deserialize"} <= names
+    assert any(n.endswith("/deserialize") for n in names)
+    assert any(n.endswith("/queue_wait") for n in names)
+    assert any(re.match(r"server:server_\d+/(segment:|device)", n)
+               for n in names), sorted(names)
+
+    # the listing carries it too, and unknown ids 404
+    listing = get_json(f"{url}/debug/traces")
+    assert any(e["traceId"] == trace_id for e in listing["traces"])
+    assert listing["capacity"] >= listing["retained"] >= 1
+    with pytest.raises(HttpError):
+        get_json(f"{url}/debug/traces?id=deadbeefdeadbeef")
+
+    # Chrome export of the retained trace is a loadable timeline
+    doc = get_json(f"{url}/debug/traces?id={trace_id}&format=chrome")
+    _assert_valid_chrome_doc(doc)
+
+
+def test_http_sampled_multi_server_chrome_export(http_traced):
+    """sample.rate=1 through clusterConfig: a multi-server query lands in the
+    ring WITHOUT OPTION(trace=true), and its Chrome export carries one track
+    per server hop."""
+    from conftest import wait_until
+    from pinot_tpu.cluster.http_service import get_json
+
+    url, broker, catalog, query = http_traced
+    catalog.put_property("clusterConfig/broker.trace.sample.rate", "1")
+    try:
+        assert wait_until(
+            lambda: broker.catalog.get_property(
+                "clusterConfig/broker.trace.sample.rate") == "1",
+            timeout=10.0, interval=0.05, swallow=())
+        resp = query("SELECT site, SUM(v) FROM ev GROUP BY site")
+    finally:
+        catalog.put_property("clusterConfig/broker.trace.sample.rate", None)
+    assert "traceInfo" not in resp          # sampling retains, never inlines
+    trace_id = resp["traceId"]
+    entry = get_json(f"{url}/debug/traces?id={trace_id}")
+    assert entry["sampled"] is True
+    doc = get_json(f"{url}/debug/traces?id={trace_id}&format=chrome")
+    _assert_valid_chrome_doc(doc)
+    tracks = {ev["args"]["name"] for ev in doc["traceEvents"]
+              if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    # both servers held a segment, so both hops get their own track
+    assert {"broker", "server:server_0", "server:server_1"} <= tracks
+
+
+def test_query_report_renders_exported_traces(http_traced, capsys):
+    """Satellite: saved /debug/traces output analyzes offline."""
+    from pinot_tpu.cluster.http_service import get_json
+    from pinot_tpu.tools.query_report import _trace_entries, render_trace
+
+    url, _broker, _catalog, query = http_traced
+    query("SELECT COUNT(*) FROM ev OPTION(trace=true)")
+    listing = get_json(f"{url}/debug/traces")
+    entries = _trace_entries(listing)
+    assert entries
+    body = render_trace(entries[0])
+    assert body.startswith("trace: ")
+    assert "serialize" in body
+    # the chrome form folds back into the same waterfall
+    chrome = _trace_entries(get_json(f"{url}/debug/traces?format=chrome"))
+    assert chrome and any("serialize" in s["name"]
+                          for e in chrome for s in e["spans"])
