@@ -627,6 +627,55 @@ def e2e_device_bench(rows: int, n_clients: int = 32,
         float(np.median(lat)) * 1000, stats, loaded
 
 
+def wire_codec_bench(n: int = 4_000_000, iters: int = 5) -> dict:
+    """Wire-codec throughput (satellite of the zero-copy mux transport):
+    encode/decode GB/s over (a) a flat typed-array payload and (b) a
+    DensePartial-shaped SegmentResult — the shapes the data plane actually
+    ships. The gathered-parts encode and the `np.frombuffer` decode must
+    show up as *bandwidth* in the perf trajectory, not just as an absence
+    of copies in a unit test."""
+    from pinot_tpu.cluster.wire import (decode_segment_result, decode_value,
+                                        encode_segment_result_parts,
+                                        encode_value)
+    from pinot_tpu.query.reduce import DensePartial, SegmentResult
+
+    def _timed(fn):
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    arr = {"v": np.arange(n, dtype=np.float64),
+           "c": np.arange(n, dtype=np.int64)}
+    nbytes = sum(a.nbytes for a in arr.values())
+    enc = encode_value(arr)
+    t_enc = _timed(lambda: encode_value(arr))
+    t_dec = _timed(lambda: decode_value(enc))
+
+    keys = max(n // 8, 1)
+    dp = DensePartial(
+        token=("k", (keys,), ("h",), keys), cards=(keys,), strides=(1,),
+        num_keys_real=keys, counts=np.ones(keys, dtype=np.int64),
+        outs={"0.sum": np.arange(keys, dtype=np.float64)},
+        group_values=[np.arange(keys, dtype=np.int64)])
+    sr = SegmentResult(kind="groups", dense=dp, num_docs_scanned=n)
+    dp_bytes = dp.counts.nbytes + dp.outs["0.sum"].nbytes \
+        + dp.group_values[0].nbytes
+    sr_enc = b"".join(bytes(p) for p in encode_segment_result_parts(sr))
+    t_sr_enc = _timed(lambda: encode_segment_result_parts(sr))
+    t_sr_dec = _timed(lambda: decode_segment_result(sr_enc))
+    return {
+        "wire_encode_gbps": round(nbytes / max(t_enc, 1e-9) * 1e-9, 2),
+        "wire_decode_gbps": round(nbytes / max(t_dec, 1e-9) * 1e-9, 2),
+        "wire_dense_partial_encode_gbps": round(
+            dp_bytes / max(t_sr_enc, 1e-9) * 1e-9, 2),
+        "wire_dense_partial_decode_gbps": round(
+            dp_bytes / max(t_sr_dec, 1e-9) * 1e-9, 2),
+    }
+
+
 def relay_floor_ms(iters=7) -> float:
     """Median dispatch+fetch of a TRIVIAL kernel: the transport's per-query
     latency floor. Published next to p50 so engine overhead (p50 - floor) is
@@ -821,6 +870,7 @@ def main():
     mesh_exec.execute(small_segs, QUERY)
     p50_1m, _ = p50_latency(QUERY, segs=small_segs)
     floor_ms = relay_floor_ms()
+    wire_gbps = wire_codec_bench()
 
     np_rows_per_sec, np_result = numpy_baseline(cols)
     ours = res.rows[0][0]
@@ -956,6 +1006,7 @@ def main():
             "p50_query_latency_ms": round(q11_p50, 3),
             "p50_query_latency_1m_rows_ms": round(p50_1m, 3),
             "relay_roundtrip_floor_ms": round(floor_ms, 3),
+            **wire_gbps,
             "platform_calibration": cal,
             "scan_device_time_ms": round(scan_dev_ms, 3),
             "scan_effective_gbps": round(scan_gbps, 1),

@@ -3,7 +3,7 @@
 Every regression class this repo has shipped was statically detectable — the
 PR 2 `_const` jit-cache shape collision, the PR 3 unfenced-compile timing bug,
 the PR 4 advisor findings (unlocked `Histogram.observe`, stale queued futures,
-null-bitmap-dropping rewrites). graftcheck encodes those lessons as four
+null-bitmap-dropping rewrites). graftcheck encodes those lessons as five
 codebase-specific rule packs over stdlib `ast` (no new dependencies):
 
 * **jit-hygiene** — host/device boundary discipline: implicit host syncs on
@@ -18,6 +18,10 @@ codebase-specific rule packs over stdlib `ast` (no new dependencies):
   README glossary, ExecutionStats constants vs the merge/export key lists,
   clusterConfig keys referenced in code vs documented defaults, and bounded
   metric-label cardinality at registry call sites.
+* **transport-bypass** — `urllib.request` / `http.client` imported outside
+  `cluster/http_service.py`: raw clients skip the keep-alive pool and the
+  failure taxonomy the broker's routing health depends on (the PR 7
+  `join_stage` lesson).
 
 Run it:  ``python -m pinot_tpu.analysis [--format text|json] [--update-baseline]``
 

@@ -1,0 +1,79 @@
+"""transport-bypass rule: raw HTTP clients outside the pooled transport.
+
+The PR 7 mux work taught this shape: `RemoteServerHandle.join_stage` dispatched
+multistage shuffles through a raw `urllib.request.urlopen` — bypassing the
+keep-alive pool, TCP_NODELAY, the staleness retry, and the HttpError-vs-
+ConnectionError failure taxonomy that the broker's routing health depends on.
+Every such bypass re-pays the connection-setup round trip the transport work
+eliminated, and mis-classifies HTTP errors as dead servers (urllib's
+HTTPError subclasses OSError).
+
+One rule:
+
+* `transport-bypass` — importing `urllib.request` or `http.client` anywhere
+  but `cluster/http_service.py` (the one sanctioned owner of raw
+  connections). `urllib.parse` is fine — it is string manipulation, not
+  transport. External-service adapters (S3, WebHDFS, GCS, Kinesis) that talk
+  to endpoints outside the cluster carry rationale'd suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Module, Rule
+
+#: the one module allowed to mint raw connections (it owns the pool)
+_SANCTIONED = ("cluster/http_service.py",)
+
+#: module roots whose import marks a transport bypass
+_RAW_CLIENTS = ("urllib.request", "http.client")
+
+
+def _flagged_module(name: str) -> str:
+    """The raw-client module `name` resolves to, or '' when it is benign."""
+    for raw in _RAW_CLIENTS:
+        if name == raw or name.startswith(raw + "."):
+            return raw
+    return ""
+
+
+class TransportBypassRule(Rule):
+    id = "transport-bypass"
+    description = ("urllib.request / http.client outside cluster/"
+                   "http_service.py bypasses the pooled transport")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if module.rel.endswith(_SANCTIONED):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            raw = ""
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    raw = _flagged_module(alias.name)
+                    if raw:
+                        break
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                raw = _flagged_module(node.module)
+                if not raw and node.module in ("urllib", "http"):
+                    for alias in node.names:
+                        raw = _flagged_module(
+                            f"{node.module}.{alias.name}")
+                        if raw:
+                            break
+            if raw:
+                out.append(Finding(
+                    self.id, module.rel, node.lineno,
+                    f"`{raw}` imported outside cluster/http_service.py — "
+                    "raw clients skip the keep-alive pool, TCP_NODELAY, "
+                    "staleness retry, and the HttpError/ConnectionError "
+                    "failure taxonomy; use http_call / http_stream / "
+                    "open_client_connection instead"))
+        return out
+
+
+def rules() -> List[Rule]:
+    return [TransportBypassRule()]

@@ -16,7 +16,7 @@ import logging
 import threading
 import time
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor, as_completed
+from concurrent.futures import Future, ThreadPoolExecutor, as_completed
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
@@ -608,8 +608,9 @@ class Broker:
                     for seg in segments:
                         missing.setdefault(seg, set()).add(server_id)
                     continue
-                futures[self._pool.submit(_traced(handle, server_id), table, ctx,
-                                          segments, tf)] = server_id
+                futures[self._dispatch_partial(handle, server_id, _traced,
+                                               table, ctx, segments,
+                                               tf)] = server_id
             pending = set(futures)
             try:
                 for fut in as_completed(futures,
@@ -819,6 +820,33 @@ class Broker:
                         remaining -= len(rows)
                         yield ("rows", rows)
 
+    def _dispatch_partial(self, handle, server_id: str, traced, table, ctx,
+                          segments, tf) -> Future:
+        """Dispatch one server partial, async-first: a mux-capable handle's
+        `submit_async` returns a Future WITHOUT occupying a scatter-pool
+        thread for the round trip, so the in-flight fan-out is bounded by
+        the servers' flow-control windows instead of `self._pool`'s worker
+        count — concurrent queries to one server share an exchange and feed
+        the device pipeline bigger batches. Legacy handles (or a disabled /
+        peer-unsupported mux, signalled by submit_async returning None) fall
+        back to one pool thread per call; a synchronous dispatch failure
+        becomes a failed Future so the gather loop's failure taxonomy
+        (`_is_transport_failure` / `_is_backpressure`) sees it like any
+        other."""
+        submit = getattr(handle, "submit_async", None)
+        if submit is not None:
+            try:
+                fut = submit(table, ctx, segments, tf,
+                             span_name=f"server:{server_id}")
+            except Exception as e:
+                fut = Future()
+                fut.set_exception(e)
+                return fut
+            if fut is not None:
+                return fut
+        call = traced(handle, server_id) if traced is not None else handle
+        return self._pool.submit(call, table, ctx, segments, tf)
+
     def _retry_missing(self, table: str, ctx, missing: Dict[str, Set[str]],
                        tf: Optional[str], traced
                        ) -> Tuple[List[Tuple[SegmentResult, List[str]]], int]:
@@ -845,8 +873,8 @@ class Broker:
                         and cand not in self.routing.unhealthy_servers():
                     by_server.setdefault(cand, []).append(seg)
                     break
-        futures = {self._pool.submit(traced(self._servers[s], s), table, ctx,
-                                     segs, tf): (s, segs)
+        futures = {self._dispatch_partial(self._servers[s], s, traced, table,
+                                          ctx, segs, tf): (s, segs)
                    for s, segs in by_server.items()}
         out: List[Tuple[SegmentResult, List[str]]] = []
         failed = 0
@@ -1209,7 +1237,9 @@ class Broker:
                     handle = self._servers.get(server_id)
                     if handle is None:
                         continue
-                    futures[self._pool.submit(handle, table, ctx, segments, tf)] = server_id
+                    futures[self._dispatch_partial(
+                        handle, server_id, None, table, ctx, segments,
+                        tf)] = server_id
                 try:
                     for fut in as_completed(futures,
                                             timeout=self.stage_timeout_s):

@@ -70,6 +70,9 @@ class GcsDeepStoreFS(RemoteObjectFS):
         """STREAMING: the tar is sent from the open file with an explicit
         Content-Length — a multi-GB segment never buffers in memory (the
         deep-store contract S3DeepStoreFS documents and upholds)."""
+        # graftcheck: ignore[transport-bypass] -- external GCS endpoint, not
+        # the cluster data plane; streams a multi-GB tar from an open file,
+        # which the pooled client's bytes-body API cannot
         import urllib.request
         q = urllib.parse.urlencode({"uploadType": "media",
                                     "name": self._key(uri)})

@@ -25,6 +25,9 @@ config change.
 
 from __future__ import annotations
 
+# graftcheck: ignore[transport-bypass] -- external WebHDFS namenode/datanode
+# endpoints, not the cluster data plane; the 307-redirect dance needs raw
+# connection control
 import http.client
 import json
 import os

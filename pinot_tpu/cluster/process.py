@@ -370,6 +370,10 @@ class BrokerClient:
         batches as the broker streams them (chunked HTTP; reference: the gRPC
         streaming query endpoint). Use for large exports — rows are consumed
         without buffering the full result anywhere."""
+        # graftcheck: ignore[transport-bypass] -- line-oriented response
+        # streaming (iterates the raw response); the pooled client exposes
+        # block reads only, and an export-sized stream amortizes its own
+        # connection
         import urllib.request
 
         from .http_service import client_ssl_context

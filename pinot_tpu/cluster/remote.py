@@ -21,6 +21,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 import urllib.parse
 from typing import Dict, Optional, Sequence
 
@@ -272,19 +273,100 @@ class RemoteCompletion:
 class RemoteServerHandle:
     """Broker -> server query dispatch over HTTP; matches the in-proc
     `ServerHandle` signature (reference: QueryRouter.submitQuery + DataTable
-    deserialize on response)."""
+    deserialize on response).
+
+    Two transports: `submit_async` multiplexes tagged queries over the mux
+    stream (`cluster/mux.py`) and returns a Future WITHOUT holding a thread
+    for the round trip — the broker's scatter prefers it; `__call__` blocks
+    (riding the mux future when available, else the legacy one-exchange-per-
+    query POST /query). `use_mux=False` pins the legacy transport (the
+    differential tests dispatch both ways and compare)."""
 
     def __init__(self, server_url: str, timeout_s: float = 60.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, use_mux: bool = True,
+                 mux_streams: int = 1):
         self.server_url = server_url.rstrip("/")
         self.timeout_s = timeout_s
         # explicit per-handle token (external connector processes have no
         # process-global default token); None falls back to the default
         self.token = token
+        self.use_mux = use_mux
+        self._mux_streams = max(1, int(mux_streams))
+        self._mux = None               # lazily opened MuxClient
+        self._mux_unsupported = False  # old peer without /mux: legacy forever
+        self._mux_lock = threading.Lock()
+
+    def _mux_client(self):
+        from .mux import MuxClient
+        with self._mux_lock:
+            if self._mux is None:
+                from .http_service import _DEFAULT_TOKEN
+                token = self.token if self.token is not None \
+                    else _DEFAULT_TOKEN
+                self._mux = MuxClient(self.server_url, token=token,
+                                      streams=self._mux_streams,
+                                      timeout_s=self.timeout_s)
+            return self._mux
+
+    def close(self) -> None:
+        with self._mux_lock:
+            mux, self._mux = self._mux, None
+        if mux is not None:
+            mux.close()
+
+    def submit_async(self, table: str, ctx, segment_names: Sequence[str],
+                     time_filter: Optional[str] = None,
+                     span_name: Optional[str] = None):
+        """Mux dispatch: a Future resolving to the decoded SegmentResult
+        (tracing spliced, frame-queue stats folded in — same observable
+        surface as `__call__`). Returns None when mux is disabled or the
+        peer predates /mux; the caller falls back to the legacy transport."""
+        if not self.use_mux or self._mux_unsupported:
+            return None
+        from ..utils.metrics import get_registry
+        from ..utils.trace import current_depth, current_trace
+        sql = ctx if isinstance(ctx, str) else ctx.sql
+        if not sql:
+            raise ValueError("remote dispatch requires the query SQL text")
+        tr = current_trace()
+        depth = current_depth() if tr is not None else 0
+        dispatch_ms = tr.elapsed_ms() if tr is not None else 0.0
+        t0 = time.perf_counter()
+        body = encode_query_request(
+            table, sql, segment_names, time_filter,
+            trace=tr is not None,
+            trace_id=tr.trace_id if tr is not None else "",
+            sampled=bool(tr.sampled) if tr is not None else False)
+        if tr is not None:
+            tr.record("serialize", dispatch_ms,
+                      (time.perf_counter() - t0) * 1000, depth + 1)
+        try:
+            return self._mux_client().submit(
+                body, trace=tr, depth=depth, dispatch_ms=dispatch_ms,
+                span_name=span_name)
+        except HttpError as e:
+            if e.status in (404, 405, 501):
+                # peer without a /mux route: remember and use legacy for good
+                self._mux_unsupported = True
+                get_registry().counter("pinot_broker_mux_fallbacks").inc()
+                return None
+            raise
 
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
+        from concurrent.futures import TimeoutError as _FutureTimeout
+
         from ..utils.trace import current_depth, current_trace, span
+        fut = self.submit_async(table, ctx, segment_names, time_filter)
+        if fut is not None:
+            try:
+                return fut.result(timeout=self.timeout_s)
+            except _FutureTimeout:
+                # the stream's stale-reap fails the wedged connection on the
+                # next submit; classify this as a transport failure now
+                raise ConnectionError(
+                    f"mux response from {self.server_url} timed out "
+                    f"after {self.timeout_s}s") from None
         sql = ctx if isinstance(ctx, str) else ctx.sql
         if not sql:
             raise ValueError("remote dispatch requires the query SQL text")
@@ -330,35 +412,23 @@ class RemoteServerHandle:
         /stage with wire-encoded blocks — the worker-mailbox dispatch). The
         response is a chunked stream of length-prefixed frames: joined-row
         block frames are consumed incrementally (bounded buffering), a
-        partial-aggregation frame decodes to a mergeable SegmentResult."""
+        partial-aggregation frame decodes to a mergeable SegmentResult.
+        Rides the keep-alive pool via `http_stream` (TCP_NODELAY + staleness
+        retry + HttpError-vs-ConnectionError taxonomy, like every other
+        exchange — this used to be the one raw-urllib bypass)."""
         import struct
-        import urllib.request
 
         from ..multistage.runtime import agg_spec_to_json, spec_to_json
+        from .http_service import http_stream
         from .wire import (decode_block, decode_segment_result, decode_value,
                            encode_value)
         body = encode_value({"spec": spec_to_json(spec),
                              "agg": agg_spec_to_json(agg),
                              "left": dict(left), "right": dict(right)})
-        from .http_service import _DEFAULT_TOKEN, HttpError
-        headers = {"Content-Type": "application/octet-stream"}
-        bearer = self.token if self.token is not None else _DEFAULT_TOKEN
-        if bearer:
-            headers["Authorization"] = f"Bearer {bearer}"
-        req = urllib.request.Request(f"{self.server_url}/stage", data=body,
-                                     headers=headers)
         blocks = []
-        from .http_service import client_ssl_context
-        try:
-            resp_cm = urllib.request.urlopen(req, timeout=self.timeout_s,
-                                             context=client_ssl_context())
-        except urllib.error.HTTPError as e:
-            # an HTTP status is a response FROM A LIVE SERVER — re-raise as
-            # HttpError so the broker's transport/backpressure classification
-            # holds (urllib's HTTPError subclasses OSError, which would
-            # misread a query error as a crashed worker)
-            raise HttpError(e.code, e.read().decode(errors="replace")) from None
-        with resp_cm as resp:
+        with http_stream("POST", f"{self.server_url}/stage", body,
+                         timeout=self.timeout_s,
+                         token=self.token) as resp:
             while True:
                 header = resp.read(4)
                 if len(header) < 4:
@@ -369,6 +439,7 @@ class RemoteServerHandle:
                     raise ConnectionError("stage stream truncated")
                 d = decode_value(payload)
                 if d["kind"] == "end":
+                    resp.read()  # consume the terminal chunk: pool the conn
                     break
                 if d["kind"] == "partial":
                     return decode_segment_result(d["result"])

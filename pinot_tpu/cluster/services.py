@@ -19,6 +19,7 @@ import json
 import os
 import tempfile
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from ..schema import Schema
@@ -678,7 +679,18 @@ class ServerService:
         self.server = server
         self.http = HttpService(host, port, access_control=access_control,
                                 ssl_context=ssl_context)
+        # mux executor: queries demuxed off mux streams run here, NOT on the
+        # HTTP worker that owns the stream (it is busy reading frames); sized
+        # by `server.mux.workers` — the scheduler underneath still enforces
+        # its own admission control, this only bounds decode/dispatch threads
+        workers = int(server.catalog.get_property(
+            "clusterConfig/server.mux.workers", 16))
+        self._mux_pool = ThreadPoolExecutor(max_workers=max(1, workers),
+                                            thread_name_prefix="mux-exec")
+        self._mux_open = 0           # open mux streams (gauge has no inc/dec)
+        self._mux_lock = threading.Lock()
         self.http.route("POST", "query", self._query)
+        self.http.route("POST", "mux", self._mux, duplex=True)
         self.http.route("POST", "explain", self._explain)
         self.http.route("POST", "stage", self._stage)
         # peer-to-peer mailbox shuffle (reference: GrpcMailboxService +
@@ -709,6 +721,88 @@ class ServerService:
 
     def stop(self) -> None:
         self.http.stop()
+        self._mux_pool.shutdown(wait=False)
+
+    def _mux(self, parts, params, body):
+        """POST /mux — one duplex multiplexed query stream (cluster/mux.py):
+        tagged request frames demux into the mux executor under a per-stream
+        flow-control window (`server.mux.max.inflight`); response frames
+        stream back out of order as queries finish. The 200 + chunked headers
+        go out before any frame is read — the client reads and writes
+        concurrently on the one exchange."""
+        from ..auth import current_principal
+        from ..utils.metrics import get_registry
+        from .mux import serve_mux_stream
+        reg = get_registry()
+        frames = reg.counter("pinot_server_mux_frames")
+        streams_gauge = reg.gauge("pinot_server_mux_streams")
+        with self._mux_lock:
+            self._mux_open += 1
+            streams_gauge.set(self._mux_open)
+        max_inflight = int(self.server.catalog.get_property(
+            "clusterConfig/server.mux.max.inflight", 64))
+        inner = serve_mux_stream(body, self._mux_execute,
+                                 executor=self._mux_pool,
+                                 max_inflight=max(1, max_inflight),
+                                 principal=current_principal(),
+                                 on_frame=frames.inc)
+
+        def gen():
+            try:
+                yield from inner
+            finally:
+                with self._mux_lock:
+                    self._mux_open -= 1
+                    streams_gauge.set(self._mux_open)
+        return 200, "application/octet-stream", gen()
+
+    def _mux_execute(self, payload, flow_wait_ms):
+        """One mux request frame -> (status, response parts). Mirrors
+        `_query` exactly — same ACL check, trace-splice surface, and
+        backpressure statuses (429/408 ride the frame like HTTP statuses so
+        the broker's failure taxonomy is transport-agnostic) — plus the
+        flow-control wait recorded as a span and a stats key, keeping the
+        milliseconds a frame spent gated by the window attributable. The
+        response is gathered `encode_segment_result_parts` buffers: array
+        payloads go to the socket without an intermediate join."""
+        import time as _time
+        from ..auth import require_table_access
+        from ..query.scheduler import QueryRejectedError, QueryTimeoutError
+        from ..query.stats import MUX_FLOW_CONTROL_MS
+        from ..utils.trace import request_trace
+        from .wire import encode_segment_result_parts
+        t_decode = _time.perf_counter()
+        req = decode_query_request(payload)
+        decode_ms = (_time.perf_counter() - t_decode) * 1000
+        require_table_access(req["table"], "READ")
+        try:
+            with request_trace(bool(req.get("trace")),
+                               trace_id=req.get("traceId") or None) as tr:
+                if tr is not None:
+                    # pre-origin, like _query's deserialize: the window wait
+                    # and the wire decode both preceded this trace's origin
+                    if flow_wait_ms:
+                        tr.record("mux:flow_control",
+                                  -(decode_ms + flow_wait_ms), flow_wait_ms)
+                    tr.record("deserialize", -decode_ms, decode_ms)
+                result = self.server.execute_partial(
+                    req["table"], req["sql"], req["segments"],
+                    time_filter=req.get("timeFilter"))
+        except QueryRejectedError as e:  # backpressure, not a server fault
+            return 429, [json.dumps({"error": str(e)}).encode()]
+        except QueryTimeoutError as e:
+            return 408, [json.dumps({"error": str(e)}).encode()]
+        if flow_wait_ms:
+            stats = result.stats if isinstance(result.stats, dict) else {}
+            stats[MUX_FLOW_CONTROL_MS] = round(
+                stats.get(MUX_FLOW_CONTROL_MS, 0.0) + flow_wait_ms, 3)
+            result.stats = stats
+        spans = None
+        if tr is not None:
+            spans = [dict(s,
+                          name=f"server:{self.server.instance_id}/{s['name']}")
+                     for s in tr.to_rows()]
+        return 200, encode_segment_result_parts(result, trace_spans=spans)
 
     def _query(self, parts, params, body):
         import time as _time
@@ -1031,9 +1125,14 @@ class BrokerService:
     """Broker role process: SQL entry over HTTP; discovers servers via catalog."""
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 0,
-                 access_control=None, ssl_context=None):
+                 access_control=None, ssl_context=None,
+                 mux: Optional[bool] = None):
         self.broker = broker
         self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
+        self._handles: Dict[str, RemoteServerHandle] = {}  # for close()
+        # `mux` pins the server-dispatch transport (tests dispatch both ways
+        # and diff); None defers to the `broker.mux.enabled` knob per handle
+        self._mux_override = mux
         self.http = HttpService(host, port, access_control=access_control,
                                 ssl_context=ssl_context)
         self.http.route("POST", "query", self._query)
@@ -1063,7 +1162,24 @@ class BrokerService:
 
     def stop(self) -> None:
         self.broker.failure_detector.stop()  # kill the background probe loop
+        for handle in self._handles.values():
+            handle.close()   # retire mux streams (goodbye frame, join threads)
+        self._handles.clear()
         self.http.stop()
+
+    def _mux_enabled(self) -> bool:
+        if self._mux_override is not None:
+            return self._mux_override
+        v = self.broker.catalog.get_property(
+            "clusterConfig/broker.mux.enabled", True)
+        return str(v).lower() not in ("false", "0", "no")
+
+    def _mux_streams(self) -> int:
+        try:
+            return max(1, int(self.broker.catalog.get_property(
+                "clusterConfig/broker.mux.streams", 1)))
+        except (TypeError, ValueError):
+            return 1
 
     def _debug(self, parts, params, body):
         """GET /debug — broker query rollups. GET /debug/traces — the retained
@@ -1112,12 +1228,20 @@ class BrokerService:
             if not info.alive:
                 if self._registered.pop(info.instance_id, None):
                     self.broker.unregister_server(info.instance_id)
+                    old = self._handles.pop(info.instance_id, None)
+                    if old is not None:
+                        old.close()
                 continue
             url = info.url
             if self._registered.get(info.instance_id) == url:
                 continue
             self._registered[info.instance_id] = url
-            handle = RemoteServerHandle(url)
+            handle = RemoteServerHandle(url, use_mux=self._mux_enabled(),
+                                        mux_streams=self._mux_streams())
+            old = self._handles.pop(info.instance_id, None)
+            if old is not None:
+                old.close()  # endpoint changed: retire the old mux streams
+            self._handles[info.instance_id] = handle
 
             def probe(u=url):
                 # /health is auth-exempt; ready=false still proves liveness

@@ -12,14 +12,28 @@ every byte on the wire is produced and parsed by this module.
 Layout: `MAGIC(4) | version(u8) | tagged-value tree`. Tags are single ASCII bytes;
 containers carry u32 counts; ndarrays carry dtype-string + shape + raw little-endian
 bytes (TPU-friendly: the receiving side can hand the buffer straight to jnp).
+
+Zero-copy discipline (the transport-floor PR): the byte layout is unchanged,
+but neither side copies array payloads any more.
+
+* decode — a `_Cursor` walks one memoryview over the frame; ndarray payloads
+  come back as `np.frombuffer` views ALIASING the frame buffer (read-only when
+  the frame is immutable `bytes`). Every merge path in `query.reduce` is
+  copy-on-write, so shared/read-only partials are safe downstream; callers
+  that need a private mutable array copy explicitly.
+* encode — `_PartsWriter` gathers scalar fields into one accumulator and
+  appends large array payloads as standalone memoryviews of the source arrays
+  (no `tobytes()`). `encode_*_parts` hands the buffer list straight to a
+  vectored writer (the mux transport); `encode_*` joins once for callers that
+  need contiguous bytes. The source arrays must not be mutated until the
+  parts are written — encode sites serialize immediately before the send.
 """
 
 from __future__ import annotations
 
 import json
 import struct
-from io import BytesIO
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, List, Tuple, Union
 
 import numpy as np
 
@@ -27,6 +41,18 @@ from ..query.reduce import SegmentResult
 
 MAGIC = b"PTPU"
 VERSION = 1
+
+#: array payloads at or above this size ride as standalone zero-copy buffer
+#: parts; smaller ones are cheaper to copy into the accumulator than to
+#: fragment the socket writes over
+GATHER_MIN_BYTES = 1024
+
+Buffer = Union[bytes, bytearray, memoryview]
+
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
 
 # -- object registry (sketch states etc.) -----------------------------------
 # name -> (type, to_bytes, from_bytes); mirrors the reference's custom serde for
@@ -53,9 +79,40 @@ def _register_builtin_types() -> None:
 _register_builtin_types()
 
 
+# -- encoder sink ------------------------------------------------------------
+
+class _PartsWriter:
+    """Gathered-write encoder sink: scalar fields accumulate into a bytearray,
+    large array payloads are appended as zero-copy memoryviews of the source
+    arrays. `parts()` returns the frame as an ordered buffer list."""
+
+    __slots__ = ("_parts", "_buf")
+
+    def __init__(self):
+        self._parts: List[Buffer] = []
+        self._buf = bytearray()
+
+    def write(self, b: Buffer) -> None:
+        self._buf += b
+
+    def write_buffer(self, mv: memoryview) -> None:
+        """Append a large payload as its own part (no copy); flushes the
+        scalar accumulator first to preserve byte order."""
+        if self._buf:
+            self._parts.append(self._buf)
+            self._buf = bytearray()
+        self._parts.append(mv)
+
+    def parts(self) -> List[Buffer]:
+        if self._buf:
+            self._parts.append(self._buf)
+            self._buf = bytearray()
+        return self._parts
+
+
 # -- tagged value codec ------------------------------------------------------
 
-def _write_value(out: BytesIO, v: Any) -> None:
+def _write_value(out: _PartsWriter, v: Any) -> None:
     if v is None:
         out.write(b"N")
     elif v is True:
@@ -66,60 +123,63 @@ def _write_value(out: BytesIO, v: Any) -> None:
         v = int(v)
         if -(1 << 63) <= v < (1 << 63):
             out.write(b"i")
-            out.write(struct.pack("<q", v))
+            out.write(_I64.pack(v))
         else:  # arbitrary-precision fallback
             raw = str(v).encode()
             out.write(b"I")
-            out.write(struct.pack("<I", len(raw)))
+            out.write(_U32.pack(len(raw)))
             out.write(raw)
     elif isinstance(v, (float, np.floating)):
         out.write(b"f")
-        out.write(struct.pack("<d", float(v)))
+        out.write(_F64.pack(float(v)))
     elif isinstance(v, str):
         raw = v.encode("utf-8")
         out.write(b"s")
-        out.write(struct.pack("<I", len(raw)))
+        out.write(_U32.pack(len(raw)))
         out.write(raw)
     elif isinstance(v, (bytes, bytearray)):
         out.write(b"b")
-        out.write(struct.pack("<I", len(v)))
+        out.write(_U32.pack(len(v)))
         out.write(bytes(v))
     elif isinstance(v, np.ndarray):
         dt = v.dtype
         if dt == object:  # object arrays decay to a list of tagged values
             out.write(b"l")
-            out.write(struct.pack("<I", v.size))
+            out.write(_U32.pack(v.size))
             for item in v.reshape(-1):
                 _write_value(out, item)
             return
         dts = dt.str.encode()  # e.g. b"<i4"
         out.write(b"a")
-        out.write(struct.pack("<B", len(dts)))
+        out.write(_U8.pack(len(dts)))
         out.write(dts)
-        out.write(struct.pack("<B", v.ndim))
+        out.write(_U8.pack(v.ndim))
         for d in v.shape:
-            out.write(struct.pack("<I", d))
-        raw = np.ascontiguousarray(v).tobytes()
-        out.write(struct.pack("<I", len(raw)))
-        out.write(raw)
+            out.write(_U32.pack(d))
+        a = np.ascontiguousarray(v)
+        out.write(_U32.pack(a.nbytes))
+        if a.nbytes >= GATHER_MIN_BYTES and a.ndim:
+            out.write_buffer(a.data.cast("B"))  # alias, not tobytes()
+        else:
+            out.write(a.tobytes())
     elif isinstance(v, tuple):
         out.write(b"t")
-        out.write(struct.pack("<I", len(v)))
+        out.write(_U32.pack(len(v)))
         for item in v:
             _write_value(out, item)
     elif isinstance(v, list):
         out.write(b"l")
-        out.write(struct.pack("<I", len(v)))
+        out.write(_U32.pack(len(v)))
         for item in v:
             _write_value(out, item)
     elif isinstance(v, (set, frozenset)):
         out.write(b"S")
-        out.write(struct.pack("<I", len(v)))
+        out.write(_U32.pack(len(v)))
         for item in v:
             _write_value(out, item)
     elif isinstance(v, dict):
         out.write(b"d")
-        out.write(struct.pack("<I", len(v)))
+        out.write(_U32.pack(len(v)))
         for k, item in v.items():
             _write_value(out, k)
             _write_value(out, item)
@@ -130,14 +190,55 @@ def _write_value(out: BytesIO, v: Any) -> None:
         raw = _OBJ_REGISTRY[name][1](v)
         nm = name.encode()
         out.write(b"O")
-        out.write(struct.pack("<B", len(nm)))
+        out.write(_U8.pack(len(nm)))
         out.write(nm)
-        out.write(struct.pack("<I", len(raw)))
+        out.write(_U32.pack(len(raw)))
         out.write(raw)
 
 
-def _read_value(buf: BytesIO) -> Any:
-    tag = buf.read(1)
+class _Cursor:
+    """Zero-copy decode cursor: `take` returns SLICES of the frame buffer."""
+
+    __slots__ = ("mv", "off")
+
+    def __init__(self, data: Buffer):
+        mv = data if isinstance(data, memoryview) else memoryview(data)
+        if mv.ndim != 1 or mv.format != "B":
+            mv = mv.cast("B")
+        self.mv = mv
+        self.off = 0
+
+    def take(self, n: int) -> memoryview:
+        off = self.off
+        end = off + n
+        if end > len(self.mv):
+            raise ValueError("truncated wire frame")
+        self.off = end
+        return self.mv[off:end]
+
+    def u8(self) -> int:
+        (v,) = _U8.unpack_from(self.mv, self.off)
+        self.off += 1
+        return v
+
+    def u32(self) -> int:
+        (v,) = _U32.unpack_from(self.mv, self.off)
+        self.off += 4
+        return v
+
+    def i64(self) -> int:
+        (v,) = _I64.unpack_from(self.mv, self.off)
+        self.off += 8
+        return v
+
+    def f64(self) -> float:
+        (v,) = _F64.unpack_from(self.mv, self.off)
+        self.off += 8
+        return v
+
+
+def _read_value(cur: _Cursor) -> Any:
+    tag = cur.take(1)
     if tag == b"N":
         return None
     if tag == b"T":
@@ -145,74 +246,72 @@ def _read_value(buf: BytesIO) -> Any:
     if tag == b"F":
         return False
     if tag == b"i":
-        return struct.unpack("<q", buf.read(8))[0]
+        return cur.i64()
     if tag == b"I":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return int(buf.read(n).decode())
+        return int(str(cur.take(cur.u32()), "ascii"))
     if tag == b"f":
-        return struct.unpack("<d", buf.read(8))[0]
+        return cur.f64()
     if tag == b"s":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return buf.read(n).decode("utf-8")
+        return str(cur.take(cur.u32()), "utf-8")
     if tag == b"b":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return buf.read(n)
+        # private bytes on purpose: sketch from_bytes implementations may
+        # retain the buffer past the frame's lifetime
+        return bytes(cur.take(cur.u32()))
     if tag == b"a":
-        (dn,) = struct.unpack("<B", buf.read(1))
-        dt = np.dtype(buf.read(dn).decode())
-        (ndim,) = struct.unpack("<B", buf.read(1))
-        shape = tuple(struct.unpack("<I", buf.read(4))[0] for _ in range(ndim))
-        (n,) = struct.unpack("<I", buf.read(4))
-        return np.frombuffer(buf.read(n), dtype=dt).reshape(shape).copy()
+        dt = np.dtype(str(cur.take(cur.u8()), "ascii"))
+        shape = tuple(cur.u32() for _ in range(cur.u8()))
+        # the array ALIASES the frame buffer — read-only when the frame is
+        # immutable bytes; reduce's merge paths are copy-on-write
+        return np.frombuffer(cur.take(cur.u32()), dtype=dt).reshape(shape)
     if tag == b"t":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return tuple(_read_value(buf) for _ in range(n))
+        return tuple(_read_value(cur) for _ in range(cur.u32()))
     if tag == b"l":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return [_read_value(buf) for _ in range(n)]
+        return [_read_value(cur) for _ in range(cur.u32())]
     if tag == b"S":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return {_read_value(buf) for _ in range(n)}
+        return {_read_value(cur) for _ in range(cur.u32())}
     if tag == b"d":
-        (n,) = struct.unpack("<I", buf.read(4))
-        return {_read_value(buf): _read_value(buf) for _ in range(n)}
+        return {_read_value(cur): _read_value(cur) for _ in range(cur.u32())}
     if tag == b"O":
-        (nn,) = struct.unpack("<B", buf.read(1))
-        name = buf.read(nn).decode()
-        (n,) = struct.unpack("<I", buf.read(4))
+        name = str(cur.take(cur.u8()), "ascii")
         entry = _OBJ_REGISTRY.get(name)
         if entry is None:
             raise ValueError(f"unknown wire object type {name!r}")
-        return entry[2](buf.read(n))
-    raise ValueError(f"bad wire tag {tag!r}")
+        return entry[2](bytes(cur.take(cur.u32())))
+    raise ValueError(f"bad wire tag {bytes(tag)!r}")
+
+
+def encode_value_parts(v: Any) -> List[Buffer]:
+    """Encode as an ordered buffer list (vectored-write form): scalar runs are
+    private bytearrays, large array payloads are zero-copy views of the source
+    arrays. Concatenation of the parts == `encode_value(v)`."""
+    out = _PartsWriter()
+    out.write(MAGIC)
+    out.write(_U8.pack(VERSION))
+    _write_value(out, v)
+    return out.parts()
 
 
 def encode_value(v: Any) -> bytes:
-    out = BytesIO()
-    out.write(MAGIC)
-    out.write(struct.pack("<B", VERSION))
-    _write_value(out, v)
-    return out.getvalue()
+    return b"".join(encode_value_parts(v))
 
 
-def decode_value(data: bytes) -> Any:
-    buf = BytesIO(data)
-    if buf.read(4) != MAGIC:
+def decode_value(data: Buffer) -> Any:
+    """Decode one frame (bytes, bytearray, or memoryview). ndarray payloads
+    are zero-copy views over `data` — keep the frame alive as long as the
+    arrays; they are read-only when `data` is immutable."""
+    cur = _Cursor(data)
+    if cur.take(4) != MAGIC:
         raise ValueError("bad wire magic")
-    (ver,) = struct.unpack("<B", buf.read(1))
+    ver = cur.u8()
     if ver != VERSION:
         raise ValueError(f"unsupported wire version {ver}")
-    return _read_value(buf)
+    return _read_value(cur)
 
 
 # -- message framing ---------------------------------------------------------
 
-def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
-    """SegmentResult -> bytes (reference: DataTable serialize on the server).
-
-    `trace_spans` optionally carries the server's request-trace span rows back to
-    the broker (reference: DataTable metadata TRACE_INFO key)."""
-    return encode_value({
+def _segment_result_doc(r: SegmentResult, trace_spans=None) -> Dict[str, Any]:
+    return {
         "kind": r.kind,
         "numDocs": r.num_docs_scanned,
         "groups": [(k, v) for k, v in r.groups.items()],
@@ -235,10 +334,26 @@ def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
             "outs": r.dense.outs,
             "groupValues": [np.asarray(v) for v in r.dense.group_values],
         },
-    })
+    }
 
 
-def decode_segment_result(data: bytes) -> SegmentResult:
+def encode_segment_result(r: SegmentResult, trace_spans=None) -> bytes:
+    """SegmentResult -> bytes (reference: DataTable serialize on the server).
+
+    `trace_spans` optionally carries the server's request-trace span rows back to
+    the broker (reference: DataTable metadata TRACE_INFO key)."""
+    return encode_value(_segment_result_doc(r, trace_spans))
+
+
+def encode_segment_result_parts(r: SegmentResult, trace_spans=None
+                                ) -> List[Buffer]:
+    """Vectored-write form of `encode_segment_result` (the mux transport
+    hands the parts straight to the chunked response writer — the dense
+    arrays never transit an intermediate bytes copy)."""
+    return encode_value_parts(_segment_result_doc(r, trace_spans))
+
+
+def decode_segment_result(data: Buffer) -> SegmentResult:
     d = decode_value(data)
     r = SegmentResult(d["kind"])
     r.num_docs_scanned = d["numDocs"]
@@ -291,5 +406,8 @@ def encode_query_request(table: str, sql: str, segments,
                        "traceId": trace_id, "sampled": sampled}).encode()
 
 
-def decode_query_request(data: bytes) -> Dict[str, Any]:
-    return json.loads(data.decode())
+def decode_query_request(data: Buffer) -> Dict[str, Any]:
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    return json.loads(data if isinstance(data, (bytes, bytearray))
+                      else data.decode())

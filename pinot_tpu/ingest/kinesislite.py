@@ -25,6 +25,8 @@ import base64
 import json
 import threading
 import time
+# graftcheck: ignore[transport-bypass] -- external Kinesis endpoint, not the
+# cluster data plane; signed one-shot API calls, no pooling to gain
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 

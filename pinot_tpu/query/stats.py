@@ -45,6 +45,8 @@ DEDUPED_LAUNCHES = "dedupedLaunches"
 STACKED_LAUNCHES = "stackedLaunches"
 NUM_CONSUMING_SEGMENTS_QUERIED = "numConsumingSegmentsQueried"
 MIN_CONSUMING_FRESHNESS_TIME_MS = "minConsumingFreshnessTimeMs"
+MUX_FRAME_QUEUE_MS = "muxFrameQueueMs"
+MUX_FLOW_CONTROL_MS = "muxFlowControlMs"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -53,7 +55,7 @@ COUNTER_KEYS = (
     DEVICE_LAUNCHES, COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
     COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
-    NUM_CONSUMING_SEGMENTS_QUERIED,
+    NUM_CONSUMING_SEGMENTS_QUERIED, MUX_FRAME_QUEUE_MS, MUX_FLOW_CONTROL_MS,
 )
 
 # keys that merge by MINIMUM instead of sum (reference: the broker reduces

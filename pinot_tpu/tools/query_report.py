@@ -81,7 +81,9 @@ def render_report(stats: Dict[str, Any]) -> str:
     for key, label in (("compileMs", "jit compile"),
                        ("deviceExecMs", "device exec"),
                        ("deviceFetchMs", "device fetch"),
-                       ("queueWaitMs", "queue wait")):
+                       ("queueWaitMs", "queue wait"),
+                       ("muxFrameQueueMs", "mux frame queue"),
+                       ("muxFlowControlMs", "mux flow ctl")):
         if key in stats:
             out.append(f"  {label:<12} {_fmt_ms(stats.get(key, 0))}")
     out.append("")

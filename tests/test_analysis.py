@@ -15,7 +15,7 @@ import pytest
 from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
 from pinot_tpu.analysis import (blocking_in_loop, drift_guards, jit_hygiene,
-                                lock_discipline)
+                                lock_discipline, transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -320,6 +320,60 @@ def test_label_cardinality_suppression_honored():
     """, drift_guards.rules(), readme=_OBS_README)
     assert active == []
     assert "metric-label-cardinality" in _ids(suppressed)
+
+
+# -- transport-bypass ---------------------------------------------------------
+
+def test_transport_bypass_true_positive():
+    active, _ = _check("""
+        import urllib.request
+
+        def fetch(url):
+            from http.client import HTTPConnection
+            return urllib.request.urlopen(url).read()
+    """, transport_bypass.rules())
+    assert _ids(active) == ["transport-bypass"] * 2
+
+
+def test_transport_bypass_sanctioned_in_http_service():
+    active, _ = _check("""
+        import http.client
+        import urllib.request
+    """, transport_bypass.rules(),
+        rel="pinot_tpu/cluster/http_service.py")
+    assert active == []
+
+
+def test_transport_bypass_urllib_parse_is_clean():
+    # urllib.parse/error are string handling, not transport; the pooled
+    # helpers themselves are obviously fine
+    active, _ = _check("""
+        import urllib.parse
+        from urllib.parse import urlencode
+        from pinot_tpu.cluster.http_service import http_call, http_stream
+
+        def q(d):
+            return urllib.parse.urlencode(d)
+    """, transport_bypass.rules())
+    assert active == []
+
+
+def test_transport_bypass_from_import_forms_flagged():
+    active, _ = _check("""
+        from urllib import request
+        from http import client
+        from urllib.request import urlopen
+    """, transport_bypass.rules())
+    assert _ids(active) == ["transport-bypass"] * 3
+
+
+def test_transport_bypass_suppression_honored():
+    active, suppressed = _check("""
+        # graftcheck: ignore[transport-bypass] -- external S3 endpoint
+        import urllib.request
+    """, transport_bypass.rules())
+    assert active == []
+    assert _ids(suppressed) == ["transport-bypass"]
 
 
 # -- suppression mechanics ----------------------------------------------------

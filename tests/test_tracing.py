@@ -24,8 +24,10 @@ from pinot_tpu.utils.trace import (Trace, TraceRing, TraceSampler,
                                    request_trace, span, to_chrome_trace)
 
 # broker-side wire spans + scheduler admission: transport mechanics, not
-# server execution — excluded from the dual-transport differential
-WIRE_SPANS = frozenset(("serialize", "send", "deserialize", "queue_wait"))
+# server execution — excluded from the dual-transport differential (the mux
+# transport adds frame-queue / flow-control decomposition to the same hop)
+WIRE_SPANS = frozenset(("serialize", "send", "deserialize", "queue_wait",
+                        "mux:frame_queue", "mux:flow_control"))
 
 
 # -- satellite: sampler determinism ------------------------------------------
