@@ -1,0 +1,190 @@
+"""collective-hygiene rules: ICI collectives must run under a bound mesh axis.
+
+`jax.lax.psum(x, "seg")` and friends are only legal while tracing inside a
+`shard_map`/`pmap` that binds that axis name — anywhere else they raise
+`NameError: unbound axis name` at trace time, typically long after the code
+path was written (the mesh is lazy, so the first multi-device query is the
+first trace). This pack encodes the repo's collective contract:
+
+* a collective call is fine when the enclosing function takes the axis name
+  as a parameter (the `combine_collective(name, v, axis)` shape — the caller
+  owns the binding);
+* a collective call is fine when the enclosing function (or lambda) is wired
+  into a `shard_map(...)`/`pmap(...)` call in the same module — the wrapper
+  binds the axis;
+* everything else is a latent trace-time failure and a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+#: the jax.lax collectives that require a bound mesh axis
+COLLECTIVE_NAMES = {"psum", "pmin", "pmax", "pmean", "psum_scatter",
+                    "all_gather", "ppermute", "all_to_all", "axis_index"}
+
+#: call prefixes that unambiguously mean jax.lax (bare names could be
+#: user-defined helpers, so they only count with a `from jax.lax import` --
+#: see _bare_imports)
+_LAX_PREFIXES = ("jax.lax.", "lax.")
+
+#: wrappers that bind a mesh axis for the function they wrap
+_BINDING_WRAPPERS = ("shard_map", "pmap")
+
+
+def _collective_name(node: ast.Call) -> Optional[str]:
+    """The collective's short name when `node` calls one, else None."""
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    for prefix in _LAX_PREFIXES:
+        if name.startswith(prefix) and name[len(prefix):] in COLLECTIVE_NAMES:
+            return name
+    return None
+
+
+def _bare_imports(tree: ast.AST) -> Set[str]:
+    """Collective names imported bare via `from jax.lax import psum, ...`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            for alias in node.names:
+                if alias.name in COLLECTIVE_NAMES:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _is_binding_wrapper_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return bool(name) and (name.split(".")[-1] in _BINDING_WRAPPERS)
+
+
+def _wrapped_function_names(tree: ast.AST) -> Set[str]:
+    """Names passed (positionally or by keyword) to shard_map/pmap calls —
+    those functions execute with the wrapper's axis bound."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if not _is_binding_wrapper_call(node):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                wrapped.add(arg.id)
+    return wrapped
+
+
+def _enclosing_functions(node: ast.AST):
+    """Every enclosing FunctionDef/AsyncFunctionDef/Lambda, innermost first
+    (requires core.attach_parents, which run_rules applies)."""
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            yield cur
+        cur = getattr(cur, "graft_parent", None)
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _axis_arg(node: ast.Call) -> Optional[ast.AST]:
+    """The axis-name argument of a collective call: second positional (after
+    the operand) or the `axis_name=` keyword; `axis_index` takes it first."""
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    name = dotted_name(node.func)
+    first = name.split(".")[-1] == "axis_index"
+    idx = 0 if first else 1
+    if len(node.args) > idx:
+        return node.args[idx]
+    return None
+
+
+def _describe_axis(axis: Optional[ast.AST]) -> str:
+    if axis is None:
+        return "<missing axis>"
+    if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+        return f"'{axis.value}'"
+    if isinstance(axis, ast.Name):
+        return axis.id
+    return dotted_name(axis) or "expression"
+
+
+class CollectiveAxisScopeRule(Rule):
+    id = "collective-axis-scope"
+    description = ("jax.lax collectives (psum/psum_scatter/ppermute/...) "
+                   "whose axis name is not bound by an enclosing "
+                   "shard_map/pmap fail at trace time")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        bare = _bare_imports(module.tree)
+        wrapped = _wrapped_function_names(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _collective_name(node)
+            if cname is None and isinstance(node.func, ast.Name) and \
+                    node.func.id in bare:
+                cname = node.func.id
+            if cname is None:
+                continue
+            if self._axis_is_bound(node, wrapped):
+                continue
+            axis = _describe_axis(_axis_arg(node))
+            where = self._enclosing_name(node)
+            out.append(Finding(
+                self.id, module.rel, node.lineno,
+                f"`{cname}` with axis {axis} in {where} is not under any "
+                "shard_map/pmap binding — the axis name is unbound at trace "
+                "time; wrap the function in shard_map or take the axis as a "
+                "parameter from a caller that does"))
+        return out
+
+    @staticmethod
+    def _axis_is_bound(node: ast.Call, wrapped: Set[str]) -> bool:
+        axis = _axis_arg(node)
+        for fn in _enclosing_functions(node):
+            # exemption 1: the axis name is a parameter — the caller owns
+            # the binding (combine_collective(name, v, axis) shape)
+            if isinstance(axis, ast.Name) and axis.id in _param_names(fn):
+                return True
+            # exemption 2a: a named enclosing function is wired into a
+            # shard_map/pmap call somewhere in this module
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    fn.name in wrapped:
+                return True
+            # exemption 2b: a lambda passed directly as a shard_map/pmap
+            # argument (`shard_map(lambda x: psum(x, AX), mesh=...)`)
+            if isinstance(fn, ast.Lambda):
+                parent = getattr(fn, "graft_parent", None)
+                if isinstance(parent, ast.keyword):
+                    parent = getattr(parent, "graft_parent", None)
+                if _is_binding_wrapper_call(parent):
+                    return True
+        return False
+
+    @staticmethod
+    def _enclosing_name(node: ast.AST) -> str:
+        for fn in _enclosing_functions(node):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return f"`{fn.name}`"
+            return "a lambda"
+        return "module scope"
+
+
+def rules() -> List[Rule]:
+    return [CollectiveAxisScopeRule()]

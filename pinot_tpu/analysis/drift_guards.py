@@ -9,7 +9,8 @@ test run happens to execute:
 * `drift-metric-glossary` — every `pinot_*` metric name passed to a registry
   factory must appear in README.md's Observability metric glossary;
 * `drift-stats-keys` — every ExecutionStats key constant must be listed in a
-  merge/export table (COUNTER_KEYS/MIN_KEYS/BROKER_KEYS) and documented, and
+  merge/export table (COUNTER_KEYS/MIN_KEYS/MAX_KEYS/BROKER_KEYS) and
+  documented, and
   raw string literals must not bypass the constants;
 * `drift-cluster-config` — every `clusterConfig/...` key read in code must be
   documented in the README;
@@ -28,7 +29,7 @@ from .core import (AnalysisContext, Finding, Module, Rule, dotted_name,
 
 _REGISTRY_FACTORIES = ("counter", "gauge", "timer", "histogram")
 _STATS_MODULE = "pinot_tpu/query/stats.py"
-_KEY_TABLES = ("COUNTER_KEYS", "MIN_KEYS", "BROKER_KEYS")
+_KEY_TABLES = ("COUNTER_KEYS", "MIN_KEYS", "MAX_KEYS", "BROKER_KEYS")
 
 
 def _observability_section(readme: str) -> str:

@@ -458,7 +458,16 @@ def pipeline_from_config(cfg) -> Optional[DeviceQueryPipeline]:
     that want the host engine)."""
     if not cfg.get_bool("server.device.enabled", False):
         return None
+    mesh_exec = None
+    n_mesh = cfg.get_int("server.mesh.devices", 0)
+    if n_mesh > 0:
+        # explicit mesh width (0 = every visible device): a server can pin its
+        # pipeline to a sub-mesh, e.g. to split chips between serving replicas
+        from ..parallel.combine import MeshQueryExecutor
+        from ..parallel.mesh import default_mesh
+        mesh_exec = MeshQueryExecutor(default_mesh(n_mesh))
     return DeviceQueryPipeline(
+        mesh_exec=mesh_exec,
         max_batch=cfg.get_int("server.device.max.batch", 64),
         submit_timeout_s=cfg.get_float("server.device.timeout.seconds", 120.0),
         max_inflight=cfg.get_int("server.device.max.inflight", 4),

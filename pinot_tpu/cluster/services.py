@@ -714,12 +714,18 @@ class ServerService:
         server.catalog.register_instance(InstanceInfo(
             server.instance_id, "server", host=self.http.host,
             port=self.http.port, tags=tags, scheme=self.http.scheme))
+        # device-routed shuffle: mark this process as the owner of our mailbox
+        # endpoint so exchange legs targeting it skip the HTTP hop
+        from ..multistage.shuffle import register_local_endpoint
+        register_local_endpoint(self.http.url)
 
     @property
     def url(self) -> str:
         return self.http.url
 
     def stop(self) -> None:
+        from ..multistage.shuffle import unregister_local_endpoint
+        unregister_local_endpoint(self.http.url)
         self.http.stop()
         self._mux_pool.shutdown(wait=False)
 

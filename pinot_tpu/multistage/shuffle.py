@@ -80,6 +80,12 @@ class P2PUnavailable(Exception):
 
 def partition_groups_stable(result: SegmentResult, p: int) -> List[SegmentResult]:
     """Split a group-by partial's key space into p disjoint partials."""
+    if p == 1 and result.dense is not None:
+        # degenerate partition: the whole key space routes to one worker, so
+        # the array-form partial (reduce.DensePartial) survives the exchange
+        # intact — the device-routed shuffle's zero-host-value-merge case
+        # (wire.py ships dense partials, so this holds for remote workers too)
+        return [result]
     # a hash partition reorders keys arbitrarily, so the array-form partial
     # (aligned dense key space) can't survive it — densify to the dict form
     result.materialize_dense()
@@ -249,6 +255,55 @@ class MailboxRegistry:
 REGISTRY = MailboxRegistry()
 
 
+# ---------------------------------------------------------------------------
+# device-routed exchange: when sender and receiver live in the SAME process
+# (one server process owning the mesh, or an embedded broker+server cluster),
+# the mailbox endpoint is this process's own HTTP service — streaming frames
+# through localhost TCP + the wire codec is pure relay overhead. Servers
+# register their mailbox URLs here; `_send_partitions` short-circuits matching
+# targets straight into the local MailboxRegistry, handing the receiver the
+# sender's partition OBJECT (a DensePartial keeps its device-derived arrays —
+# zero re-encode, zero host value merges).
+# ---------------------------------------------------------------------------
+
+_LOCAL_ENDPOINTS: Dict[str, int] = {}  # url -> refcount
+_LOCAL_LOCK = threading.Lock()
+
+
+def register_local_endpoint(url: str) -> None:
+    u = url.rstrip("/")
+    with _LOCAL_LOCK:
+        _LOCAL_ENDPOINTS[u] = _LOCAL_ENDPOINTS.get(u, 0) + 1
+
+
+def unregister_local_endpoint(url: str) -> None:
+    u = url.rstrip("/")
+    with _LOCAL_LOCK:
+        n = _LOCAL_ENDPOINTS.get(u, 0) - 1
+        if n > 0:
+            _LOCAL_ENDPOINTS[u] = n
+        else:
+            _LOCAL_ENDPOINTS.pop(u, None)
+
+
+def is_local_endpoint(url: str) -> bool:
+    with _LOCAL_LOCK:
+        return url.rstrip("/") in _LOCAL_ENDPOINTS
+
+
+def _deliver_local(qid: str, mid: str, part: Any, kind: str,
+                   sender_id: str) -> None:
+    """In-process mailbox delivery: the frames a remote sender would stream
+    become two queue puts. The receiver's `consume_mailbox` contract is
+    unchanged (payload + per-sender EOS), so mixed clusters — some senders
+    local, some remote — drain the same box."""
+    from ..utils.metrics import get_registry
+    box = REGISTRY.open(qid, mid)
+    box.put((kind, part))
+    box.put(("eos", sender_id))
+    get_registry().counter("pinot_server_mailbox_local_sends").inc()
+
+
 def consume_mailbox(qid: str, mid: str, expected_senders: int,
                     timeout_s: float = MAILBOX_TIMEOUT_S
                     ) -> Tuple[List[Block], List[SegmentResult]]:
@@ -307,17 +362,28 @@ def send_to_mailbox(url: str, qid: str, mid: str, frames: Iterable[dict],
 
 
 def _send_partitions(targets: List[str], qid: str, stage: str, side: str,
-                     parts_frames: List[Iterable[dict]], sender_id: str,
+                     parts: List[Any], sender_id: str,
+                     framer: Callable[[Any], Iterable[dict]], kind: str,
+                     local_ok: bool = True,
                      timeout_s: float = MAILBOX_TIMEOUT_S) -> None:
-    """Stream every partition's frames to its worker, a few in parallel.
-    EVERY partition sends (empty ones send just EOS) — the worker counts EOS
-    from every expected sender before joining."""
+    """Deliver every partition to its worker, a few in parallel. EVERY
+    partition sends (empty ones send just EOS) — the worker counts EOS from
+    every expected sender before joining. Targets registered as THIS
+    process's own mailbox endpoints skip the frame codec and HTTP hop
+    entirely (device-routed shuffle, `local_ok` gates it per task); remote
+    targets stream `framer(part)` frames as before. Locality is checked per
+    target, so a mixed local/remote worker set short-circuits exactly the
+    local legs — routing is fixed by the task's target list either way."""
     from concurrent.futures import ThreadPoolExecutor
     p = len(targets)
 
     def one(i: int) -> None:
-        send_to_mailbox(targets[i], qid, f"{stage}.{side}.{i}", parts_frames[i],
-                        sender_id, timeout_s)
+        if local_ok and is_local_endpoint(targets[i]):
+            _deliver_local(qid, f"{stage}.{side}.{i}", parts[i], kind,
+                           sender_id)
+        else:
+            send_to_mailbox(targets[i], qid, f"{stage}.{side}.{i}",
+                            framer(parts[i]), sender_id, timeout_s)
 
     if p == 1:
         one(0)
@@ -494,7 +560,8 @@ def run_leaf_join_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
     parts = partition_block_stable(block, list(task["keys"]),
                                    int(task["numPartitions"]))
     _send_partitions(list(task["targets"]), qid, task["stage"], task["side"],
-                     [block_frames(p) for p in parts], task["senderId"])
+                     parts, task["senderId"], block_frames, "block",
+                     local_ok=bool(task.get("deviceRoute", True)))
     return {"rows": n}
 
 
@@ -511,8 +578,10 @@ def run_leaf_agg_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(f"leaf agg task expects a group-by, got {res.kind}")
     parts = partition_groups_stable(res, int(task["numPartitions"]))
     _send_partitions(list(task["targets"]), qid, task["stage"], "A",
-                     [partial_frames(p) for p in parts], task["senderId"])
-    return {"groups": len(res.groups)}
+                     parts, task["senderId"], partial_frames, "partial",
+                     local_ok=bool(task.get("deviceRoute", True)))
+    return {"groups": len(res.groups) if res.dense is None else
+            int((res.dense.counts > 0).sum())}
 
 
 def run_join_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
@@ -536,8 +605,9 @@ def run_join_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
         parts = partition_block_stable(out, list(down["keys"]),
                                        len(down["targets"]))
         _send_partitions(list(down["targets"]), qid, down["stage"],
-                         down.get("side", "L"),
-                         [block_frames(b) for b in parts], down["senderId"])
+                         down.get("side", "L"), parts, down["senderId"],
+                         block_frames, "block",
+                         local_ok=bool(down.get("deviceRoute", True)))
         yield frame_bytes({"kind": "ack", "rows": _block_rows(out)})
         yield frame_bytes({"kind": "end"})
         return
@@ -636,6 +706,22 @@ class LeafRoute:
     time_filter: Optional[str]
 
 
+def _device_routing_enabled(broker) -> bool:
+    """clusterConfig `broker.shuffle.device.routing` (default ON): let
+    exchange legs whose target mailbox lives in this process bypass the
+    frame codec + HTTP hop."""
+    prop = broker.catalog.get_property(
+        "clusterConfig/broker.shuffle.device.routing")
+    if prop is None:
+        return True
+    return str(prop).strip().lower() not in ("false", "0", "no", "off")
+
+
+def _explicit_partitions(options) -> bool:
+    opt = {str(k).lower() for k in (options or {})}
+    return bool(opt & {"numpartitions", "stageparallelism"})
+
+
 def coordinate_join(broker, stmt, num_partitions: int):
     """P2P multistage execution of a join query. The broker plans, routes leaf
     scans, assigns P workers per stage, dispatches everything, and receives
@@ -657,6 +743,7 @@ def coordinate_join(broker, stmt, num_partitions: int):
     # workers first (cheap check): an in-proc cluster with no HTTP endpoints
     # falls back here before any quota is consumed
     workers = broker._stage_workers(P)
+    device_route = _device_routing_enabled(broker)
 
     # -- leaf routing (every routed server must have an HTTP endpoint) ------
     leaf_routes: Dict[str, List[LeafRoute]] = {}
@@ -694,6 +781,7 @@ def coordinate_join(broker, stmt, num_partitions: int):
                 "alias": alias, "columns": scan.columns, "keys": keys,
                 "numPartitions": P, "stage": stage, "side": side,
                 "targets": [w[1] for w in workers],
+                "deviceRoute": device_route,
                 "senderId": f"leaf.{alias}.{i}"}))
         return len(routes)
 
@@ -721,6 +809,7 @@ def coordinate_join(broker, stmt, num_partitions: int):
                     "kind": "mailbox", "keys": nxt.left_keys,
                     "stage": f"join{si + 1}", "side": "L",
                     "targets": [w[1] for w in workers],
+                    "deviceRoute": device_route,
                     "senderId": f"{stage}.w{p}"}
             worker_tasks.append((workers[p][1], "joinStage", task))
         n_left = P  # next stage's left side is fed by this stage's P workers
@@ -795,6 +884,22 @@ def coordinate_groupby(broker, ctx, physical: List[str], num_partitions: int):
     if not routes:
         raise P2PUnavailable("no routable leaf servers")
 
+    device_route = _device_routing_enabled(broker)
+    device_routed = False
+    if device_route and P > 1 and not _explicit_partitions(ctx.options):
+        urls = {w[1] for w in workers} | {r.url for r in routes}
+        if all(is_local_endpoint(u) for u in urls):
+            # the whole exchange is in-process (one server owning the mesh, or
+            # an embedded cluster): collapse to ONE merge partition so array-
+            # form partials (reduce.DensePartial) survive the exchange end to
+            # end — leaves hand the worker their device-derived dense arrays
+            # by reference and the merge stays elementwise, zero host-side
+            # value merges. An explicit OPTION(numPartitions/
+            # stageParallelism=...) pins P and skips the collapse.
+            P = 1
+            workers = workers[:1]
+            device_routed = True
+
     leaf_tasks = []
     for i, r in enumerate(routes):
         leaf_tasks.append((r.url, {
@@ -802,6 +907,7 @@ def coordinate_groupby(broker, ctx, physical: List[str], num_partitions: int):
             "segments": r.segments, "timeFilter": r.time_filter,
             "numPartitions": P, "stage": "agg0",
             "targets": [w[1] for w in workers],
+            "deviceRoute": device_route,
             "senderId": f"leaf.{i}"}))
     worker_tasks = []
     for p in range(P):
@@ -845,5 +951,7 @@ def coordinate_groupby(broker, ctx, physical: List[str], num_partitions: int):
         merged.kind = "groups"
     result = reduce_to_result(ctx, merged, aggs, group_exprs)
     result.stats["distributedGroupBy"] = True
+    if device_routed:
+        result.stats["deviceRoutedShuffle"] = True
     result.stats["numStageWorkers"] = len({u for u, _ in worker_tasks})
     return result

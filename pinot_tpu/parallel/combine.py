@@ -50,7 +50,8 @@ from ..segment.reader import ImmutableSegment
 from ..sql.ast import Expr, Function, Identifier, identifiers_in
 from ..utils.metrics import get_registry
 from .merged import MergedSegmentView, view_key
-from .mesh import SEGMENT_AXIS, default_mesh
+from .mesh import (SEGMENT_AXIS, default_mesh, pad_slots, placement_slots,
+                   skew_pct)
 
 
 def _has_docset_filter(ctx: QueryContext) -> bool:
@@ -66,6 +67,19 @@ def _has_docset_filter(ctx: QueryContext) -> bool:
     return ctx.filter is not None and walk(ctx.filter)
 
 _SHARD_KERNEL_CACHE: Dict[Tuple, object] = {}
+
+# Dense grouped outputs at or above this key count combine with a reduce-
+# scatter (`psum_scatter`, each device keeping 1/n of the key space) instead
+# of a full psum: the all-gather half of the psum is pure waste when the host
+# fetch reassembles the shards anyway, so the collective moves half the bytes.
+# Below it the savings don't cover the sharded-layout bookkeeping.
+SCATTER_MIN_KEYS = 4096
+
+# one-time measured cost of a mesh psum per (mesh, element-count bucket):
+# the `collectiveMs` ESTIMATE attached to mesh results (the collective is
+# fused into the kernel by XLA, so it cannot be timed in situ without
+# perturbing the launch)
+_COLLECTIVE_BENCH: Dict[Tuple, float] = {}
 
 
 def device_topk_screen(ctx: QueryContext) -> bool:
@@ -184,6 +198,29 @@ class SegmentSetBlock:
         self.seg_docs = view.seg_docs if view is not None \
             else tuple(s.num_docs for s in segments)
         self.rows = max(padded_rows(n) for n in self.seg_docs)
+        self.n_devices = mesh.devices.size
+        # chip-aware placement (mesh.placement_slots): slots[i] is segment i's
+        # row in the stacked block. Aligned immutable sets reorder freely; a
+        # merged view keeps identity order — its remap tables and mutable
+        # snapshots are rebuilt per growth step, so the conservative identity
+        # placement keeps block reuse simple there.
+        if self.n_devices > 1 and view is None:
+            self.slots, self.device_loads = placement_slots(
+                self.seg_docs, s_pad, self.n_devices)
+        else:
+            self.slots = list(range(len(segments)))
+            k = max(s_pad // max(self.n_devices, 1), 1)
+            loads = [0] * self.n_devices
+            for i, d in enumerate(self.seg_docs):
+                loads[i // k] += int(d)
+            self.device_loads = loads
+        self.slot_to_seg = np.full(s_pad, -1, dtype=np.int64)
+        for i, sl in enumerate(self.slots):
+            self.slot_to_seg[sl] = i
+        self.skew_pct = skew_pct(self.device_loads)
+        cells = s_pad * self.rows
+        self.pad_waste_pct = \
+            (1.0 - sum(self.seg_docs) / cells) * 100.0 if cells else 0.0
         P = jax.sharding.PartitionSpec
         self._sharded = jax.sharding.NamedSharding(mesh, P(SEGMENT_AXIS))
         self._replicated = jax.sharding.NamedSharding(mesh, P())
@@ -201,7 +238,7 @@ class SegmentSetBlock:
                 # slice to the view's snapshot row count: mutable members may have
                 # grown since the view (and its remap tables) were built
                 arr = np.asarray(per_seg(i, seg))[:self.seg_docs[i]]
-                out[i, :len(arr)] = arr
+                out[self.slots[i], :len(arr)] = arr
             self._cache[key] = jax.device_put(out, self._sharded)
         return self._cache[key]
 
@@ -235,7 +272,9 @@ class SegmentSetBlock:
                                lambda i, s: np.asarray(s.column(col).fwd).astype(np.int32))
         mc = self.view.column(col)
         return self._stack("ids", col, np.int32(mc.cardinality),
-                           lambda i, s: remaps[i][mc.local_ids(i)])
+                           lambda i, s: mc.local_ids(i).astype(np.int32)
+                           if remaps[i] is None
+                           else remaps[i][mc.local_ids(i)])
 
     def raw(self, col: str) -> jnp.ndarray:
         from ..engine.datablock import _narrow
@@ -454,7 +493,7 @@ class MeshQueryExecutor:
                 stacked = np.zeros((block.s_pad, block.rows), dtype=bool)
                 for i in range(len(segments)):
                     m = np.asarray(per_seg[i][j])
-                    stacked[i, :len(m)] = m[:block.rows]
+                    stacked[block.slots[i], :len(m)] = m[:block.rows]
                 out[j] = jax.device_put(stacked, block._sharded)
                 if key is not None:
                     cache[key] = out[j]
@@ -670,9 +709,14 @@ class MeshQueryExecutor:
         key = ("pack", meta, trim_keys, bool(batched))
         fn = _SHARD_KERNEL_CACHE.get(key)
 
+        # grouped outputs carry the key axis at either `pad` (reduce-scattered
+        # dense outputs, overflow bucket dropped on device) or `pad + 1` (the
+        # psum/pmin/pmax path keeps the masked-row overflow bucket at index
+        # pad); both trim to `real` — every partial decoder reads only
+        # [:num_keys_real]
         def _core(shape):
             core = shape[1:] if batched else shape
-            if pad and real < pad and core and core[0] == pad:
+            if pad and real < pad and core and core[0] in (pad, pad + 1):
                 core = (real,) + tuple(core[1:])
             return core
 
@@ -682,7 +726,7 @@ class MeshQueryExecutor:
                 for name, shape, dts in meta:
                     v = outs[name]
                     core = shape[1:] if batched else shape
-                    if pad and real < pad and core and core[0] == pad:
+                    if pad and real < pad and core and core[0] in (pad, pad + 1):
                         v = v[:, :real] if batched else v[:real]
                     flat = v.reshape((v.shape[0], -1)) if batched \
                         else v.reshape(-1)
@@ -720,7 +764,66 @@ class MeshQueryExecutor:
                 self._set_blocks.clear()
             entry = (vkey, SegmentSetBlock(segments, s_pad, self.mesh, view))
             self._set_blocks[stable] = entry
+            # padding-waste accounting: fraction of the stacked [s_pad, rows]
+            # block that is fill (ragged tails + pow2 slot quantization), the
+            # scan overhead uneven segment sets pay for mesh rectangularity
+            get_registry().histogram("pinot_mesh_pad_waste_pct").observe(
+                entry[1].pad_waste_pct)
         return entry[1]
+
+    def _collective_ms(self, nelems: int) -> float:
+        """Measured-once estimate of one mesh psum over `nelems` f32 elements
+        (pow2-bucketed), the `collectiveMs` attached to mesh results. XLA
+        fuses the collective into the fused-scan kernel, so the real launch
+        cannot time it in isolation; a standalone shard_map psum of the same
+        payload is the honest proxy."""
+        if self.n_devices <= 1 or nelems <= 0:
+            return 0.0
+        bucket = 1 << (max(int(nelems), 1) - 1).bit_length()
+        key = (id(self.mesh), self.n_devices, bucket)
+        est = _COLLECTIVE_BENCH.get(key)
+        if est is None:
+            P = jax.sharding.PartitionSpec
+            if hasattr(jax, "shard_map"):
+                shard_map = jax.shard_map
+            else:
+                from jax.experimental.shard_map import shard_map
+            fn = jax.jit(shard_map(
+                lambda x: jax.lax.psum(x, SEGMENT_AXIS), mesh=self.mesh,
+                in_specs=(P(),), out_specs=P()))
+            arr = jax.device_put(np.zeros(bucket, np.float32),
+                                 self._replicated)
+            jax.block_until_ready(fn(arr))  # compile + warm outside the timer
+            t0 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                out = fn(arr)
+            jax.block_until_ready(out)
+            est = (time.perf_counter() - t0) / reps * 1000.0
+            _COLLECTIVE_BENCH[key] = est
+        return est
+
+    def _finish_mesh_stats(self, res, outs, block: SegmentSetBlock):
+        """Attach per-launch mesh accounting to a decoded result: worst
+        per-device doc-load skew (`deviceSkewPct`, max-merged upstream) and
+        the estimated cross-chip merge time (`collectiveMs`). Partials carry
+        them in `SegmentResult.stats` (riding the wire to the broker merge);
+        full results record into the request thread's active stats."""
+        if self.n_devices <= 1:
+            return res
+        from ..query.reduce import SegmentResult
+        est = self._collective_ms(
+            sum(int(np.asarray(v).size) for v in outs.values()))
+        if isinstance(res, SegmentResult):
+            st = dict(res.stats or {})
+            st[qstats.COLLECTIVE_MS] = st.get(qstats.COLLECTIVE_MS, 0.0) + est
+            st[qstats.DEVICE_SKEW_PCT] = max(
+                st.get(qstats.DEVICE_SKEW_PCT, 0.0), block.skew_pct)
+            res.stats = st
+        else:
+            qstats.record(qstats.COLLECTIVE_MS, est)
+            qstats.record_max(qstats.DEVICE_SKEW_PCT, block.skew_pct)
+        return res
 
     def _dispatch_sharded(self, ctx: QueryContext, plan, segments, view=None,
                           valid_override=None, star=None, partial=False):
@@ -739,15 +842,15 @@ class MeshQueryExecutor:
 
     def _prepare_star(self, ctx: QueryContext, sp: "StarSetPlan",
                       partial=True):
-        s_pad = -(-len(sp.views) // self.n_devices) * self.n_devices
-        rows = max(padded_rows(v.num_docs) for v in sp.views)
-        valid = np.zeros((s_pad, rows), dtype=bool)
+        s_pad = pad_slots(len(sp.views), self.n_devices)
+        # build (or fetch) the block FIRST so the stacked record masks land in
+        # the same placement slots as the record-table columns
+        block = self._block_for(sp.views, None, s_pad)
+        valid = np.zeros((s_pad, block.rows), dtype=bool)
         for i, p in enumerate(sp.plans):
             m = np.asarray(p.record_mask, dtype=bool)
-            valid[i, :len(m)] = m
-        P = jax.sharding.PartitionSpec
-        valid_dev = jax.device_put(
-            valid, jax.sharding.NamedSharding(self.mesh, P(SEGMENT_AXIS)))
+            valid[block.slots[i], :len(m)] = m[:block.rows]
+        valid_dev = jax.device_put(valid, block._sharded)
         return self._prepare_sharded(sp.plans[0].ctx2, sp.plan2, sp.views,
                                      valid_override=valid_dev,
                                      star=(ctx, sp), partial=partial)
@@ -762,7 +865,7 @@ class MeshQueryExecutor:
         distinct_lut_sizes: Dict[int, int] = {}
         agg_luts: Dict[str, jnp.ndarray] = {}
 
-        s_pad = -(-len(segments) // self.n_devices) * self.n_devices
+        s_pad = pad_slots(len(segments), self.n_devices)
         block = self._block_for(segments, view, s_pad)
 
         for i, agg in enumerate(plan.aggs):
@@ -824,6 +927,9 @@ class MeshQueryExecutor:
         )
 
         def decode(outs):
+            return self._finish_mesh_stats(_decode_impl(outs), outs, block)
+
+        def _decode_impl(outs):
             # replicated outputs decode exactly like the single-segment path;
             # plan.segment's dictionaries (segment[0] when aligned, the merged global
             # dictionaries otherwise) decode the dense keys.
@@ -940,7 +1046,7 @@ class MeshQueryExecutor:
             return None  # plan's id intervals only valid set-wide when aligned
 
         from ..engine.kernels import topk_kernel
-        s_pad = -(-len(segments) // self.n_devices) * self.n_devices
+        s_pad = pad_slots(len(segments), self.n_devices)
         block = self._block_for(segments, None, s_pad)
         spec = KernelSpec(plan.filter_prog, (), 1, (), {}, block.rows)
 
@@ -1010,7 +1116,9 @@ class MeshQueryExecutor:
             keep = min(kk, count)
             idx, ok = idx[:keep], ok[:keep]
             idx = idx[ok]
-            seg_i = idx // block.rows
+            # block rows are placement SLOTS (chip-aware, not identity order):
+            # map back to segment indices before the per-segment gather
+            seg_i = block.slot_to_seg[idx // block.rows]
             row_i = idx % block.rows
             if len(idx) < min(k, count):
                 return DEVICE_FALLBACK  # -inf ties displaced matches
@@ -1077,7 +1185,19 @@ class MeshQueryExecutor:
 
         The body is the SAME gather/scatter-free kernel as the single-device path
         (`kernels.make_kernel_body`); partials agree on dense keys across devices, so
-        each output merges with exactly one collective (psum / pmin / pmax).
+        each output merges with exactly one collective. Low-cardinality (and
+        min/max) outputs psum/pmin/pmax to a replicated result as before;
+        HIGH-cardinality dense sum outputs (DensePartial group-bys, distinct
+        presence matrices) instead reduce-scatter (`psum_scatter`): each device
+        keeps 1/n of the key space, the overflow bucket is dropped on device,
+        and the fetch reassembles the shards host-side — a pure memcpy, zero
+        host-side value merges, at half the collective bandwidth of a psum.
+
+        Output names/shapes are only known from the body, so the shard_map is
+        constructed LAZILY at the first invocation: `jax.eval_shape` over the
+        per-shard input shapes learns the outputs, which decides each one's
+        collective and out_spec. The first call runs inside the compile fence,
+        so the extra trace lands in `compileMs` like any cold compile.
 
         `batch > 0` builds the STACKED variant: iscal/fscal arrive [B, n] and
         the body scans over them — B same-shape queries in one launch, reading
@@ -1086,14 +1206,20 @@ class MeshQueryExecutor:
         body = make_kernel_body(spec)
         P = jax.sharding.PartitionSpec
         ax = SEGMENT_AXIS
+        n = self.n_devices
         sharded, repl = P(ax), P()
 
         in_specs = (dict(ids=sharded, vals=sharded, luts=repl, iscal=repl,
                          fscal=repl, nulls=sharded, valid=sharded, strides=repl,
                          agg_luts=sharded, docsets=sharded),)
+        _REPL_KEYS = ("luts", "iscal", "fscal", "strides")
+
+        num_seg = spec.num_keys_pad + 1
+        pad = spec.num_keys_pad
+        key_dim = 1 if batch else 0  # scan stacks a leading batch axis
 
         if batch:
-            def shard_body(inputs):
+            def call_body(inputs):
                 def step(carry, scal):
                     i_s, f_s = scal
                     out = body(inputs["ids"], inputs["vals"], inputs["luts"],
@@ -1103,22 +1229,65 @@ class MeshQueryExecutor:
                     return carry, out
                 _, outs = jax.lax.scan(step, 0,
                                        (inputs["iscal"], inputs["fscal"]))
-                return {k: combine_collective(k, v, ax)
-                        for k, v in outs.items()}
+                return outs
         else:
-            def shard_body(inputs):
-                out = body(inputs["ids"], inputs["vals"], inputs["luts"],
-                           inputs["iscal"], inputs["fscal"], inputs["nulls"],
-                           inputs["valid"], inputs["strides"],
-                           inputs["agg_luts"], inputs["docsets"])
-                return {k: combine_collective(k, v, ax)
-                        for k, v in out.items()}
+            def call_body(inputs):
+                return body(inputs["ids"], inputs["vals"], inputs["luts"],
+                            inputs["iscal"], inputs["fscal"], inputs["nulls"],
+                            inputs["valid"], inputs["strides"],
+                            inputs["agg_luts"], inputs["docsets"])
+
+        def scatterable(name, shape) -> bool:
+            return (n > 1 and pad >= SCATTER_MIN_KEYS and pad % n == 0
+                    and len(shape) > key_dim and shape[key_dim] == num_seg
+                    and not name.endswith((".min", ".max")))
 
         if hasattr(jax, "shard_map"):
             shard_map = jax.shard_map
         else:  # jax < 0.5: shard_map not yet promoted out of experimental
             from jax.experimental.shard_map import shard_map
-        return jax.jit(shard_map(shard_body, mesh=self.mesh,
-                                 in_specs=in_specs, out_specs=repl))
+
+        built: Dict[str, Any] = {}
+
+        def fn(inputs):
+            compiled = built.get("fn")
+            if compiled is None:
+                # learn output names/shapes from the per-shard input shapes
+                shard_in = {
+                    key: jax.tree_util.tree_map(
+                        lambda x, sh=(key not in _REPL_KEYS):
+                        jax.ShapeDtypeStruct(
+                            ((x.shape[0] // n,) + tuple(x.shape[1:]))
+                            if sh and x.ndim else tuple(x.shape), x.dtype),
+                        val)
+                    for key, val in inputs.items()}
+                out_shapes = jax.eval_shape(call_body, shard_in)
+                scat = {name for name, s in out_shapes.items()
+                        if scatterable(name, s.shape)}
+
+                def shard_body(sin):
+                    outs = call_body(sin)
+                    res = {}
+                    for name, v in outs.items():
+                        if name in scat:
+                            core = v[:, :pad] if batch else v[:pad]
+                            res[name] = jax.lax.psum_scatter(
+                                core, ax, scatter_dimension=key_dim,
+                                tiled=True)
+                        else:
+                            res[name] = combine_collective(name, v, ax)
+                    return res
+
+                out_specs = {
+                    name: ((P(None, ax) if batch else P(ax))
+                           if name in scat else repl)
+                    for name in out_shapes}
+                built["fn"] = jax.jit(shard_map(
+                    shard_body, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs))
+                compiled = built["fn"]
+            return compiled(inputs)
+
+        return fn
 
 

@@ -38,15 +38,23 @@ def _merge_sorted_values(dicts: List[Dictionary], data_type: DataType):
 
     remap[i][local_id] -> global_id; all inputs are sorted, so the union is one
     np.unique over the concatenation and each remap one vectorized searchsorted.
+
+    A member whose dictionary already equals the union gets remap `None`
+    (sorted + unique + same length as the union means its local ids ARE the
+    global ids), so the stacker skips the O(rows) remap gather for it —
+    mostly-aligned sets (immutable members sharing an ingestion dictionary
+    plus one consuming snapshot) pay the remap only where ids actually move.
     """
     if data_type.is_numeric:
         merged = np.unique(np.concatenate([np.asarray(d.values) for d in dicts]))
-        remaps = [np.searchsorted(merged, np.asarray(d.values)).astype(np.int32)
+        remaps = [None if len(d.values) == len(merged) else
+                  np.searchsorted(merged, np.asarray(d.values)).astype(np.int32)
                   for d in dicts]
         return Dictionary(merged, data_type), remaps
     arrays = [np.array(list(d.values), dtype=object) for d in dicts]
     merged = np.unique(np.concatenate(arrays)) if arrays else np.array([], dtype=object)
-    remaps = [np.searchsorted(merged, a).astype(np.int32) for a in arrays]
+    remaps = [None if len(a) == len(merged) else
+              np.searchsorted(merged, a).astype(np.int32) for a in arrays]
     return Dictionary(list(merged), data_type), remaps
 
 
@@ -67,7 +75,9 @@ class MergedColumnReader:
         self.num_docs = sum(r.num_docs for r in readers)
         self.is_sorted = False
         self._dictionary: Optional[Dictionary] = None
-        self.remaps: Optional[List[np.ndarray]] = None
+        # per-member local->global tables; an entry is None when that member's
+        # ids are already global (dictionary == the merged union)
+        self.remaps: Optional[List[Optional[np.ndarray]]] = None
         # Local ids for mutable members are snapshotted TOGETHER with the dictionary
         # the remap table was built from: a mutable reader re-snapshots (new sorted
         # dict, new ids) whenever rows arrive, so reading `fwd` later could pair new
@@ -188,8 +198,9 @@ class MergedSegmentView:
     def column_names(self) -> List[str]:
         return self.segments[0].column_names
 
-    def remap(self, col: str) -> Optional[List[np.ndarray]]:
-        """Per-segment local-id -> global-id translation tables (None for raw cols)."""
+    def remap(self, col: str) -> Optional[List[Optional[np.ndarray]]]:
+        """Per-segment local-id -> global-id translation tables (None for raw
+        cols; a None ENTRY means that member's ids are already global)."""
         return self.column(col).remaps
 
     star_trees: List = []

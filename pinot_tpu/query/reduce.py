@@ -115,13 +115,16 @@ def merge_segment_results(results: List[SegmentResult], aggs: List[AggFunc]) -> 
     kind = results[0].kind
     out = SegmentResult(kind)
     out.num_docs_scanned = sum(r.num_docs_scanned for r in results)
-    from .stats import MIN_KEYS
+    from .stats import MAX_KEYS, MIN_KEYS
     merged_stats: Dict[str, float] = {}
     for r in results:
         for k, v in (r.stats or {}).items():
             if k in MIN_KEYS:   # freshness timestamps: stalest side wins
                 cur = merged_stats.get(k)
                 merged_stats[k] = v if cur is None else min(cur, v)
+            elif k in MAX_KEYS:  # per-launch skew: worst side wins
+                cur = merged_stats.get(k)
+                merged_stats[k] = v if cur is None else max(cur, v)
             else:
                 merged_stats[k] = merged_stats.get(k, 0) + v
     out.stats = merged_stats or None  # set BEFORE the dense early return

@@ -47,6 +47,8 @@ NUM_CONSUMING_SEGMENTS_QUERIED = "numConsumingSegmentsQueried"
 MIN_CONSUMING_FRESHNESS_TIME_MS = "minConsumingFreshnessTimeMs"
 MUX_FRAME_QUEUE_MS = "muxFrameQueueMs"
 MUX_FLOW_CONTROL_MS = "muxFlowControlMs"
+COLLECTIVE_MS = "collectiveMs"
+DEVICE_SKEW_PCT = "deviceSkewPct"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -56,6 +58,7 @@ COUNTER_KEYS = (
     COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
     NUM_CONSUMING_SEGMENTS_QUERIED, MUX_FRAME_QUEUE_MS, MUX_FLOW_CONTROL_MS,
+    COLLECTIVE_MS,
 )
 
 # keys that merge by MINIMUM instead of sum (reference: the broker reduces
@@ -64,6 +67,12 @@ COUNTER_KEYS = (
 # responses that touched no consuming segment; never zero-filled, because a
 # zero-fill would poison every min-merge round.
 MIN_KEYS = (MIN_CONSUMING_FRESHNESS_TIME_MS,)
+
+# keys that merge by MAXIMUM: deviceSkewPct reports the WORST per-device
+# exec-time imbalance any mesh launch saw (summing percentages across
+# launches/servers is meaningless; the slowest chip bounds the query).
+# Absent on responses that never took a multi-device mesh path.
+MAX_KEYS = (DEVICE_SKEW_PCT,)
 
 # broker-level keys that live beside the merged counters in QueryResult.stats
 # (listed so the glossary drift guard covers the full emitted surface)
@@ -100,6 +109,12 @@ class ExecutionStats:
             cur = self.counters.get(key)
             self.counters[key] = v if cur is None else min(cur, v)
 
+    def set_max(self, key: str, v: float) -> None:
+        """Keep the maximum seen for a max-merged key (no-op when `v` loses)."""
+        with self._lock:
+            cur = self.counters.get(key)
+            self.counters[key] = v if cur is None else max(cur, v)
+
     def add_operator(self, label: str, rows: float = 0, ms: float = 0.0) -> None:
         with self._lock:
             rk, mk = op_key(label, "rows"), op_key(label, "ms")
@@ -108,8 +123,8 @@ class ExecutionStats:
 
     def merge(self, other) -> None:
         """Fold another record (ExecutionStats or its flat dict form) into
-        this one: every numeric key sums, except MIN_KEYS which keep the
-        minimum of the sides that carry the key."""
+        this one: every numeric key sums, except MIN_KEYS (MAX_KEYS) which
+        keep the minimum (maximum) of the sides that carry the key."""
         if other is None:
             return
         src = other.counters if isinstance(other, ExecutionStats) else other
@@ -122,6 +137,9 @@ class ExecutionStats:
                     if k in MIN_KEYS:
                         cur = self.counters.get(k)
                         self.counters[k] = v if cur is None else min(cur, v)
+                    elif k in MAX_KEYS:
+                        cur = self.counters.get(k)
+                        self.counters[k] = v if cur is None else max(cur, v)
                     else:
                         self.counters[k] = self.counters.get(k, 0) + v
 
@@ -154,7 +172,8 @@ class ExecutionStats:
                 if k not in out and not k.startswith(_OP_PREFIX):
                     # MIN_KEYS are epoch-ms timestamps, not durations: whole ms
                     out[k] = (round(float(v), 3)
-                              if k.endswith("Ms") and k not in MIN_KEYS
+                              if (k.endswith("Ms") and k not in MIN_KEYS)
+                              or k.endswith("Pct")
                               else int(v))
             return out
 
@@ -184,6 +203,14 @@ def record_min(key: str, v: float) -> None:
     st = getattr(_local, "stats", None)
     if st is not None:
         st.set_min(key, v)
+
+
+def record_max(key: str, v: float) -> None:
+    """Max-merge accounting hook (per-launch device skew): keep the largest
+    value seen by the active record, if any."""
+    st = getattr(_local, "stats", None)
+    if st is not None:
+        st.set_max(key, v)
 
 
 def record_operator(label: str, rows: float = 0, ms: float = 0.0) -> None:

@@ -83,9 +83,16 @@ def render_report(stats: Dict[str, Any]) -> str:
                        ("deviceFetchMs", "device fetch"),
                        ("queueWaitMs", "queue wait"),
                        ("muxFrameQueueMs", "mux frame queue"),
-                       ("muxFlowControlMs", "mux flow ctl")):
+                       ("muxFlowControlMs", "mux flow ctl"),
+                       ("collectiveMs", "ici collective")):
         if key in stats:
-            out.append(f"  {label:<12} {_fmt_ms(stats.get(key, 0))}")
+            out.append(f"  {label:<15} {_fmt_ms(stats.get(key, 0))}")
+    if "deviceSkewPct" in stats:
+        try:
+            skew = f"{float(stats['deviceSkewPct']):10.1f} %"
+        except (TypeError, ValueError):
+            skew = f"{stats['deviceSkewPct']!s:>10}"
+        out.append(f"  {'device skew':<15} {skew}  (worst mesh launch)")
     out.append("")
     out.append("counters")
     for key in ("numSegmentsQueried", "numSegmentsPruned", "numSegmentsMatched",
