@@ -1,0 +1,140 @@
+"""row-loop-in-ingest: per-row Python loops on the realtime ingest hot path.
+
+The device ingest plane (PR 9) exists because per-row Python — `.append` in a
+row loop, dict iteration per record — caps consume throughput around 1M
+rows/s while the vectorized lane does >10M. This rule keeps the hot modules
+honest: any per-row-shaped loop must either live in a function the module
+explicitly declares as a slow path (`__graft_slow_paths__ = ("fn", ...)` at
+module level) or carry an inline suppression explaining why it is not on the
+hot path. New per-row loops that sneak into the consume→index pipeline fail
+graftcheck instead of silently regressing ingest throughput.
+
+Two shapes are flagged, in the hot modules only:
+
+* a `for` loop that is the nearest enclosing loop of an `.append(...)` call —
+  the classic row-at-a-time accumulator. Loops over schema/field/column
+  collections are exempt (per-COLUMN iteration is O(schema), not O(rows));
+* a `for` over `.items()` / `.keys()` / `.values()` nested inside another
+  loop — per-record dict walking (`for row in rows: for k, v in
+  row.items()`), the shape `index_arrays` replaces.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+#: realtime consume→index pipeline modules (repo-relative suffixes). Other
+#: modules may loop however they like; these are the ones on the pump path.
+HOT_MODULES = (
+    "pinot_tpu/ingest/realtime.py",
+    "pinot_tpu/ingest/transform.py",
+    "pinot_tpu/ingest/vectorized.py",
+    "pinot_tpu/ingest/stream.py",
+    "pinot_tpu/segment/mutable.py",
+    "pinot_tpu/segment/mutable_device.py",
+)
+
+#: iterator sources that mean per-COLUMN (or per-chunk/partition) iteration —
+#: bounded by schema width or batch count, not row count
+_COLUMN_ITER_RE = re.compile(
+    r"(field|spec|schema|column|\bcols\b|chunk|consumer|partition|"
+    r"segment|snapshot|\bnames\b)")
+
+
+def slow_path_names(module: Module) -> Set[str]:
+    """Function names the module declares as intentional slow paths via a
+    module-level `__graft_slow_paths__ = ("fn", ...)` assignment."""
+    names: Set[str] = set()
+    if module.tree is None:
+        return names
+    for node in module.tree.body:
+        if not (isinstance(node, ast.Assign) and
+                len(node.targets) == 1 and
+                isinstance(node.targets[0], ast.Name) and
+                node.targets[0].id == "__graft_slow_paths__"):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.add(elt.value)
+    return names
+
+
+def _enclosing_function(node: ast.AST) -> Optional[str]:
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = getattr(cur, "graft_parent", None)
+    return None
+
+
+def _nearest_loop(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None   # don't attribute across a nested function boundary
+        cur = getattr(cur, "graft_parent", None)
+    return None
+
+
+def _iter_text(module: Module, loop: ast.For) -> str:
+    seg = ast.get_source_segment(module.source, loop.iter)
+    return seg if seg is not None else dotted_name(loop.iter)
+
+
+class RowLoopInIngestRule(Rule):
+    id = "row-loop-in-ingest"
+    description = ("per-row Python loop (`.append` accumulator or nested "
+                   "dict iteration) on the realtime ingest hot path outside "
+                   "a declared __graft_slow_paths__ function")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if not any(module.rel.endswith(suffix) for suffix in HOT_MODULES):
+            return ()
+        slow = slow_path_names(module)
+        out: List[Finding] = []
+        seen_lines: Set[int] = set()
+
+        def _flag(loop: ast.AST, message: str) -> None:
+            fn = _enclosing_function(loop)
+            if fn is not None and fn in slow:
+                return
+            if loop.lineno in seen_lines:
+                return
+            seen_lines.add(loop.lineno)
+            where = f"`{fn}`" if fn else "module scope"
+            out.append(Finding(self.id, module.rel, loop.lineno,
+                               f"{message} in {where} — vectorize it "
+                               "(columnar batch ops) or declare the function "
+                               "in __graft_slow_paths__"))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "append":
+                loop = _nearest_loop(node)
+                if isinstance(loop, ast.For) and \
+                        not _COLUMN_ITER_RE.search(_iter_text(module, loop)):
+                    _flag(loop, "row-at-a-time `.append` loop")
+            elif isinstance(node, ast.For) and \
+                    isinstance(node.iter, ast.Call) and \
+                    isinstance(node.iter.func, ast.Attribute) and \
+                    node.iter.func.attr in ("items", "keys", "values") and \
+                    not node.iter.args and \
+                    _nearest_loop(node) is not None and \
+                    not _COLUMN_ITER_RE.search(_iter_text(module, node)):
+                _flag(node, f"per-record dict `.{node.iter.func.attr}()` "
+                            "iteration nested in a loop")
+        return out
+
+
+def rules() -> List[Rule]:
+    return [RowLoopInIngestRule()]

@@ -242,24 +242,25 @@ def splice_record_batches(data: bytes, min_offset: int, sep: bytes = b",",
         batch_len = r.i32()
         if r.pos + batch_len > len(r.data):
             break  # partial trailing batch (Kafka allows truncated tails)
-        body = Reader(r._take(batch_len))
+        # read the header in place (no per-batch body copy: batches are
+        # multi-MB on the consume hot path); `rest` below is the single
+        # slice shared by the CRC check and the native splice
+        start, end = r.pos, r.pos + batch_len
+        r.pos = end
+        body = Reader(data)
+        body.pos = start
         body.i32()                      # partitionLeaderEpoch
         magic = body.i8()
         if magic != 2:
             raise ValueError(f"unsupported record batch magic {magic}")
         crc = body.u32()
-        rest = body.data[body.pos:]
+        rest = data[body.pos:end]
         if crc32c(rest) != crc:
             raise ValueError("record batch CRC mismatch")
-        body.i16()                      # attributes
-        body.i32()                      # lastOffsetDelta
-        body.i64()                      # firstTimestamp
-        body.i64()                      # maxTimestamp
-        body.i64(); body.i16(); body.i32()  # producer id/epoch/base seq
-        count = body.i32()
+        count = struct.unpack(">i", rest[36:40])[0]
         if total >= max_records:
             break
-        spliced = _native_splice(body.data[body.pos:], base_offset,
+        spliced = _native_splice(rest[40:], base_offset,
                                  min(count, max_records - total),
                                  min_offset, sep)
         if spliced is None:

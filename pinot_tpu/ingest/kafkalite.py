@@ -36,13 +36,18 @@ from .stream import (MessageBatch, PartitionGroupConsumer, StreamConsumerFactory
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into one preallocated buffer: `buf += chunk` reallocates and
+    # copies the prefix per recv call, which at multi-MB fetch payloads costs
+    # more than the kernel copy itself (O(n^2) over the chunk count)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             return None
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
 
 
 def _recv_payload(sock: socket.socket) -> Optional[bytes]:

@@ -116,10 +116,38 @@ class RealtimePartitionConsumer:
         self.completion = completion            # LLCSegmentManager (or HTTP proxy)
         self.data_dir = data_dir
         self.state = INITIAL_CONSUMING
-        self.mutable = MutableSegment(
-            segment_name, schema,
-            text_index_columns=table_cfg.indexing.text_index_columns,
-            inverted_index_columns=table_cfg.indexing.inverted_index_columns)
+        props = table_cfg.stream.properties or {}
+        # consuming-segment class selection: the chunked columnar store
+        # (segment/mutable_device.py) is the default; per-row machinery
+        # (upsert/dedup/realtime text+inverted indexes) needs the row-append
+        # MutableSegment. `realtime.ingest.vectorized=false` opts out.
+        vectorized_ok = (
+            str(props.get("realtime.ingest.vectorized", "true")).lower()
+            != "false"
+            and upsert is None and dedup is None
+            and not any(schema.has_column(c)
+                        for c in table_cfg.indexing.text_index_columns)
+            and not any(schema.has_column(c)
+                        for c in table_cfg.indexing.inverted_index_columns))
+        if vectorized_ok:
+            from ..segment.mutable_device import DeviceMutableSegment
+            self.mutable = DeviceMutableSegment(
+                segment_name, schema,
+                device_staging=str(props.get(
+                    "realtime.ingest.device.staging", "false")).lower()
+                == "true")
+        else:
+            self.mutable = MutableSegment(
+                segment_name, schema,
+                text_index_columns=table_cfg.indexing.text_index_columns,
+                inverted_index_columns=table_cfg.indexing.inverted_index_columns)
+        # per-pump fetch budget (messages); one columnar block message is
+        # ~thousands of rows, so the default covers both framings
+        try:
+            self._batch_size = int(props.get("realtime.ingest.batch.size",
+                                             10_000))
+        except (TypeError, ValueError):
+            self._batch_size = 10_000
         self.pipeline = pipeline or TransformPipeline(schema)
         self.upsert = upsert                    # TableUpsertMetadataManager or None
         self.dedup = dedup                      # PartitionDedupMetadataManager or None
@@ -142,8 +170,17 @@ class RealtimePartitionConsumer:
         # columnar fast path: raw-bytes fetch + one-shot batch decode
         # (stream.get_batch_decoder), used when the consumer supports
         # fetch_raw and no per-row machinery (dedup/upsert) is configured
-        from .stream import get_batch_decoder
+        from .stream import get_batch_decoder, get_block_decoder
         self.batch_decoder = get_batch_decoder(stream_cfg.decoder)
+        # columnar BLOCK streams (one message = one typed-column block of
+        # rows, ingest/vectorized.py): whole-batch array indexing, no row
+        # form ever exists — per-row machinery cannot run on this framing
+        self.block_decoder = get_block_decoder(stream_cfg.decoder)
+        if self.block_decoder is not None and (upsert is not None
+                                               or dedup is not None):
+            raise ValueError(
+                f"table {table_cfg.name}: upsert/dedup need per-row decode "
+                "and offsets; they cannot consume a columnar block stream")
         self.offset = start_offset
         self.start_consume_time = time.time()
         self.lag = ConsumerLagTracker(table_cfg.name, self.partition)
@@ -167,7 +204,7 @@ class RealtimePartitionConsumer:
         self._no_native_splice = False
 
     # -- consume loop ------------------------------------------------------
-    def pump(self, max_messages: int = 10_000) -> int:
+    def pump(self, max_messages: Optional[int] = None) -> int:
         """Fetch + decode + transform + index one batch; returns rows indexed
         (reference: consumeLoop one iteration).
 
@@ -184,7 +221,7 @@ class RealtimePartitionConsumer:
                     self.table_cfg.stream.topic, self.partition)
             except Exception:
                 return 0  # stream still unavailable; retry next tick
-        limit = max_messages
+        limit = max_messages if max_messages is not None else self._batch_size
         if self.catchup_target is not None:
             limit = min(limit, self.catchup_target - self.offset)
             if limit <= 0:
@@ -200,10 +237,17 @@ class RealtimePartitionConsumer:
         #   4. PER-ROW: dedup/upsert need per-row offsets/keys
         rows = None          # decoded row dicts (paths 1-2)
         cols = None          # index-ready columns (path 0, native columnar)
+        cbatch = None        # ColumnarBatch (path 0 array-native upgrade)
+        cbatches = None      # columnar BLOCK stream batches (path -1)
         batch = None         # MessageBatch (paths 3-4)
         next_offset = fetch_from
         rows_path = None
-        if batch_ok and self.batch_decoder is not None:
+        if self.block_decoder is not None:
+            # path -1: columnar block stream — every message is already a
+            # typed-column block; decode is frombuffer views, indexing is
+            # O(columns) chunk appends (ingest/vectorized.py)
+            cbatches, next_offset = self._fetch_blocks(fetch_from, limit)
+        elif batch_ok and self.batch_decoder is not None:
             spliced = getattr(self.batch_decoder, "spliced", None)
             fetch_spliced = None if self._no_native_splice else \
                 getattr(self.consumer, "fetch_spliced", None)
@@ -219,15 +263,25 @@ class RealtimePartitionConsumer:
                     elif (self.table_cfg.stream.decoder == "json"
                           and self.pipeline.filter_expr is None
                           and not self.pipeline.column_transforms):
-                        # path 0: ONE C walk decodes straight to coerced
-                        # column lists (transform.columns_from_spliced_json)
-                        from .transform import columns_from_spliced_json
-                        try:
-                            cols = columns_from_spliced_json(
-                                data, n, self.schema)
-                        except Exception:
-                            cols = None
-                    if n and cols is None and rows is None:
+                        # path 0: ONE C walk decodes straight to typed
+                        # column ARRAYS when the segment can index them
+                        # (vectorized.columnar_batch_from_json), else to
+                        # coerced column lists
+                        if hasattr(self.mutable, "index_arrays"):
+                            from .vectorized import columnar_batch_from_json
+                            try:
+                                cbatch = columnar_batch_from_json(
+                                    data, n, self.schema)
+                            except Exception:
+                                cbatch = None
+                        if cbatch is None:
+                            from .transform import columns_from_spliced_json
+                            try:
+                                cols = columns_from_spliced_json(
+                                    data, n, self.schema)
+                            except Exception:
+                                cols = None
+                    if n and cbatch is None and cols is None and rows is None:
                         try:
                             rows = parse(prefix + data + suffix)
                             rows_path = "spliced"
@@ -240,7 +294,7 @@ class RealtimePartitionConsumer:
                             # (offsets/flush thresholds would skew); the
                             # per-message path below isolates the culprit
                             rows = None
-            if rows is None and cols is None:
+            if rows is None and cols is None and cbatch is None:
                 fetch_raw = getattr(self.consumer, "fetch_raw", None)
                 if fetch_raw is not None:
                     raw_values, next_offset = fetch_raw(fetch_from, limit)
@@ -257,7 +311,8 @@ class RealtimePartitionConsumer:
                             rows = [self.decoder(v) for v in raw_values]
                     else:
                         rows = []
-        if rows is None and cols is None:
+        if rows is None and cols is None and cbatch is None \
+                and cbatches is None:
             batch = self.consumer.fetch(fetch_from, limit)
             next_offset = batch.next_offset
         indexed = 0
@@ -269,7 +324,34 @@ class RealtimePartitionConsumer:
                 # already (two drivers double-indexing the same batch would
                 # duplicate rows): drop the batch, offset untouched
                 return 0
-            if cols is not None:
+            if cbatches is not None:
+                self.last_decode_path = "blocks"
+                tc = self.table_cfg.time_column
+                # arrays index directly unless the table configured row-level
+                # transforms/filters (then blocks round-trip through lists)
+                direct = (hasattr(self.mutable, "index_arrays")
+                          and self.pipeline.filter_expr is None
+                          and not self.pipeline.column_transforms)
+                for cb in cbatches:
+                    fetched += cb.n
+                    if tc:
+                        ev = cb.max_of(tc)
+                        if ev is not None and (max_event is None
+                                               or ev > max_event):
+                            max_event = ev
+                    if direct:
+                        indexed += self.mutable.index_arrays(cb)
+                    else:
+                        indexed += self.mutable.index_batch(
+                            self.pipeline.apply(cb.to_lists(self.schema)),
+                            coerced=True)
+            elif cbatch is not None:
+                self.last_decode_path = "columnar-array"
+                fetched = cbatch.n
+                tc = self.table_cfg.time_column
+                max_event = cbatch.max_of(tc) if tc else None
+                indexed = self.mutable.index_arrays(cbatch)
+            elif cols is not None:
                 self.last_decode_path = "columnar"
                 fetched = len(next(iter(cols.values()))) if cols else 0
                 max_event = self._max_event_time(cols=cols)
@@ -318,6 +400,37 @@ class RealtimePartitionConsumer:
                 reg.counter("pinot_server_realtime_rows_filtered",
                             {"table": self.table_cfg.name}).inc(fetched - indexed)
         return indexed
+
+    def _fetch_blocks(self, fetch_from: int, limit: int):
+        """Fetch + decode one columnar-block batch (runs OUTSIDE pump_lock).
+        Returns (List[ColumnarBatch], next_offset). Prefers the transport's
+        native splice (one buffer, frombuffer column views), falls back to
+        raw value lists, then to the generic MessageBatch fetch."""
+        bd = self.block_decoder
+        fetch_spliced = None if self._no_native_splice else \
+            getattr(self.consumer, "fetch_spliced", None)
+        if fetch_spliced is not None:
+            out = fetch_spliced(fetch_from, limit, sep=bd.sep)
+            if out is None:
+                self._no_native_splice = True
+            else:
+                data, n_msgs, next_offset = out
+                batches = bd.decode_spliced(data, n_msgs) if n_msgs else []
+                return batches, next_offset
+        fetch_raw = getattr(self.consumer, "fetch_raw", None)
+        if fetch_raw is not None:
+            raw_values, next_offset = fetch_raw(fetch_from, limit)
+            return [bd.decode_one(v) for v in raw_values], next_offset
+        batch = self.consumer.fetch(fetch_from, limit)
+        return ([bd.decode_one(m.value) for m in batch.messages],
+                batch.next_offset)
+
+    def query_segment(self):
+        """The segment object queries should execute against: a frozen
+        point-in-time view when the store provides one (cached per num_docs,
+        optionally device-backed), else the mutable segment itself."""
+        qv = getattr(self.mutable, "query_view", None)
+        return qv() if qv is not None else self.mutable
 
     def _index_row(self, row: Dict, msg_offset: int) -> bool:
         """Index with dedup/upsert hooks (reference: MutableSegmentImpl.index
@@ -500,7 +613,12 @@ class RealtimePartitionConsumer:
         builder = SegmentBuilder(
             self.schema,
             SegmentGeneratorConfig.from_indexing(self.table_cfg.indexing))
-        return builder.build(self.mutable.snapshot_columns(),
+        # already-columnar commit: the chunked store hands the builder typed
+        # arrays directly (no python-list round trip) when it can
+        snap_arrays = getattr(self.mutable, "snapshot_arrays", None)
+        columns = snap_arrays() if snap_arrays is not None \
+            else self.mutable.snapshot_columns()
+        return builder.build(columns,
                              os.path.join(self.data_dir, "realtime_build"),
                              self.segment_name)
 
@@ -517,6 +635,7 @@ class RealtimeTableManager:
         self._lock = threading.RLock()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._pump_pool = None   # lazy per-partition pump lanes (pump_all)
         transforms = (table_cfg.stream.properties or {}).get("columnTransforms")
         filter_expr = (table_cfg.stream.properties or {}).get("filterExpr")
         schema = server.catalog.schema_for_table(table)
@@ -648,9 +767,13 @@ class RealtimeTableManager:
         out = []
         for _, c in snapshot:
             if c.mutable.num_docs > 0:
-                valid = (self.upsert.valid_mask(c.segment_name, c.mutable.num_docs)
+                # frozen per-num_docs view when the store provides one: idle
+                # consuming segments stop paying the O(rows) re-snapshot per
+                # query, and device-staged stores serve from HBM buffers
+                seg = c.query_segment()
+                valid = (self.upsert.valid_mask(c.segment_name, seg.num_docs)
                          if self.upsert else None)
-                out.append(self.server.executor.execute_segment(ctx, c.mutable, valid))
+                out.append(self.server.executor.execute_segment(ctx, seg, valid))
         return out, served
 
     # -- ingestion health rollup (reference: consumingSegmentsInfo + the
@@ -700,19 +823,41 @@ class RealtimeTableManager:
                 reg.remove_gauge(g, labels)
 
     # -- deterministic drive (tests) / background loop (production) ---------
-    def pump_all(self, max_messages: int = 10_000) -> int:
+    def pump_all(self, max_messages: Optional[int] = None) -> int:
+        """Pump every consuming partition once. Partitions are independent
+        lanes: each consumer has its own pump_lock and stream socket, so
+        multi-partition tables pump CONCURRENTLY on the manager's pool —
+        fetch waits and GIL-releasing numpy decode overlap across partitions
+        instead of serializing behind one loop (the seed's 8p < 1p floor).
+        The manager lock is held only to snapshot the consumer list."""
         with self._lock:
             consumers = list(self.consumers.values())
-        total = 0
-        for c in consumers:
-            try:
-                total += c.pump(max_messages)
-            except Exception:
-                # per-partition attribution before the loop-level backoff
-                # (start_loop meters + retries; tests see tracker.errors)
-                c.lag.on_error()
-                raise
-        return total
+        if not consumers:
+            return 0
+        if len(consumers) == 1:
+            return self._pump_one(consumers[0], max_messages)
+        pool = self._pump_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(
+                max_workers=8,
+                thread_name_prefix=f"pump-{self.table}")
+            self._pump_pool = pool
+        futures = [pool.submit(self._pump_one, c, max_messages)
+                   for c in consumers]
+        # bounded collection: a wedged broker socket surfaces as a loop-level
+        # error (start_loop backs off), never as a silently stuck pump thread
+        return sum(f.result(timeout=60.0) for f in futures)
+
+    def _pump_one(self, c: RealtimePartitionConsumer,
+                  max_messages: Optional[int]) -> int:
+        try:
+            return c.pump(max_messages)
+        except Exception:
+            # per-partition attribution before the loop-level backoff
+            # (start_loop meters + retries; tests see tracker.errors)
+            c.lag.on_error()
+            raise
 
     def complete_all(self) -> Dict[str, str]:
         with self._lock:
@@ -764,6 +909,10 @@ class RealtimeTableManager:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        pool = self._pump_pool
+        if pool is not None:
+            self._pump_pool = None
+            pool.shutdown(wait=False)
         with self._lock:
             consumers = list(self.consumers.values())
             self.consumers.clear()

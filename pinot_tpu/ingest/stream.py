@@ -162,10 +162,20 @@ def avro_decoder(value: Any) -> Dict[str, Any]:
     return confluent_avro_decoder(value)
 
 
+def columnar_decoder(value: Any) -> Dict[str, Any]:
+    """Per-row decode is undefined for columnar block streams (one message =
+    many rows) — consumers use the block decoder (`get_block_decoder`); this
+    entry only keeps `get_decoder("columnar")` resolvable so stream configs
+    validate uniformly."""
+    raise ValueError("columnar block streams decode whole blocks; "
+                     "per-row decode is not supported")
+
+
 _DECODERS: Dict[str, Callable[[Any], Dict[str, Any]]] = {
     "json": json_decoder,
     "dict": passthrough_decoder,
     "avro": avro_decoder,
+    "columnar": columnar_decoder,
 }
 
 _FACTORIES: Dict[str, Callable[[str], StreamConsumerFactory]] = {
@@ -201,6 +211,25 @@ _BATCH_DECODERS: Dict[str, Callable[[List[Any]], List[Dict[str, Any]]]] = {
 
 def get_batch_decoder(name: str):
     return _BATCH_DECODERS.get(name)
+
+
+#: block decoders: name -> object with `sep` (1-byte transport splice
+#: separator), `decode_spliced(data, n_msgs) -> List[ColumnarBatch]` and
+#: `decode_one(value) -> ColumnarBatch`. One stream message carries a whole
+#: columnar block of rows — the vectorized ingest plane's wire format
+#: (ingest/vectorized.py); decoded batches feed `index_arrays` directly.
+_BLOCK_DECODERS: Dict[str, Any] = {}
+
+
+def register_block_decoder(name: str, decoder: Any) -> None:
+    _BLOCK_DECODERS[name] = decoder
+
+
+def get_block_decoder(name: str):
+    if name not in _BLOCK_DECODERS and name == "columnar":
+        from .vectorized import ColumnarBlockDecoder   # lazy builtin
+        _BLOCK_DECODERS[name] = ColumnarBlockDecoder()
+    return _BLOCK_DECODERS.get(name)
 
 
 def register_batch_decoder(name: str,

@@ -19,6 +19,12 @@ from ..engine.expr import eval_expr
 from ..schema import Schema
 from ..sql.parser import Parser
 
+#: graftcheck row-loop-in-ingest contract: these functions are the LIST-based
+#: fallback lane (exact per-cell null/coercion semantics, string interning) —
+#: the hot path is ingest/vectorized.py's array-native decode, which falls
+#: back here only for mixed/escaped/overflow cells the arrays can't express.
+__graft_slow_paths__ = ("columns_from_spliced_json",)
+
 
 def _parse_expr(text: str):
     p = Parser(text)

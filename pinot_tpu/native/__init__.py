@@ -106,17 +106,21 @@ def splice_values(records_section: bytes, base_offset: int, count: int,
         return None
     if count > len(records_section) // 7 + 1:
         return None  # hostile count: bound allocations (see decode_records)
+    import numpy as np
     cap = len(records_section) + count + 1
-    out = ctypes.create_string_buffer(cap)
+    # np.empty, not create_string_buffer: no zero-fill of the multi-MB
+    # scratch, and the tail copy below is out_len bytes, not cap (this
+    # wrapper sits on the realtime consume hot path)
+    out = np.empty(cap, dtype=np.uint8)
     out_len = ctypes.c_longlong(0)
     last = ctypes.c_longlong(-1)
     n = lib.pinot_splice_values(records_section, len(records_section),
                                 base_offset, count, min_offset, sep[0],
-                                ctypes.cast(out, ctypes.c_char_p), cap,
+                                out.ctypes.data_as(ctypes.c_char_p), cap,
                                 ctypes.byref(out_len), ctypes.byref(last))
     if n < 0:
         return None
-    return out.raw[:out_len.value], n, last.value
+    return out[:out_len.value].tobytes(), n, last.value
 
 
 def json_columns(data: bytes, n_records: int, col_names):

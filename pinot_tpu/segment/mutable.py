@@ -25,6 +25,11 @@ import numpy as np
 from ..schema import DataType, FieldSpec, Schema
 from .dictionary import Dictionary
 
+#: per-row append loops below are this store's DESIGN (row-append semantics,
+#: upsert/dedup/text-index compatibility); the vectorized consume path lives
+#: in segment/mutable_device.py (see analysis/ingest_hot_loop.py)
+__graft_slow_paths__ = ("index", "index_batch")
+
 
 class MutableColumnReader:
     """ColumnReader-compatible view over an appending column."""
@@ -38,6 +43,11 @@ class MutableColumnReader:
         # under the GIL, so readers never pair a dictionary with ids from a newer
         # snapshot (the ids are re-sorted ids over a DIFFERENT sorted value set)
         self._snap: tuple = (-1, None, None)
+        # (rows, array) caches for the non-dict fwd / raw values() arrays —
+        # repeated queries against an idle consuming segment reuse them
+        # instead of re-running np.asarray over the whole column
+        self._fwd_snap: tuple = (-1, None)
+        self._vals_snap: tuple = (-1, None)
 
     # -- reader surface ----------------------------------------------------
     @property
@@ -94,8 +104,13 @@ class MutableColumnReader:
         if self.has_dictionary:
             return self._snapshot()[2]
         n = self.store.num_docs
+        snap = self._fwd_snap
+        if snap[0] == n:
+            return snap[1]
         vals = self.store.columns[self.name][:n]
-        return np.asarray(vals, dtype=self.data_type.numpy_dtype)
+        arr = np.asarray(vals, dtype=self.data_type.numpy_dtype)
+        self._fwd_snap = (n, arr)
+        return arr
 
     def dict_snapshot(self):
         """Atomic (rows, dictionary, ids) triple — ids are guaranteed to be in THIS
@@ -111,9 +126,14 @@ class MutableColumnReader:
             for i, row in enumerate(vals):
                 out[i] = np.asarray(row, dtype=dt if dt.kind != "O" else object)
             return out
-        if self.has_dictionary:
-            return np.array(vals, dtype=object)
-        return np.asarray(vals, dtype=self.data_type.numpy_dtype)
+        if not self.has_dictionary:
+            return self.fwd   # cached per num_docs
+        snap = self._vals_snap
+        if snap[0] == n:
+            return snap[1]
+        arr = np.array(vals, dtype=object)
+        self._vals_snap = (n, arr)
+        return arr
 
     @property
     def text_index(self):
@@ -323,8 +343,14 @@ class MutableSegment:
         return self._readers[name]
 
     def snapshot_columns(self) -> Dict[str, list]:
-        """Consistent copy of all columns (for immutable conversion at commit)."""
+        """Consistent copy of all columns (for immutable conversion at
+        commit), cached per num_docs — repeated snapshots of an idle segment
+        (commit retries, status probes) stop paying the O(rows) copy. Callers
+        must treat the returned lists as read-only."""
         n = self._num_docs
+        cached = getattr(self, "_snap_cols", None)
+        if cached is not None and cached[0] == n:
+            return cached[1]
         cols = {}
         for name, vals in self.columns.items():
             col = list(vals[:n])
@@ -332,6 +358,7 @@ class MutableSegment:
                 if i < n:
                     col[i] = None
             cols[name] = col
+        self._snap_cols = (n, cols)
         return cols
 
     def __repr__(self) -> str:

@@ -1,0 +1,797 @@
+"""Device ingest plane's consuming segment: chunked columnar store, O(batch)
+appends, device-stageable buffers.
+
+`MutableSegment` (mutable.py) appends python values row-by-row and re-builds
+numpy snapshots per query — correct, but the consume rate is bounded by python
+value churn (BENCH_r05: 0.575x one numpy thread) and every query pays an
+O(rows) copy. `DeviceMutableSegment` keeps the SAME reader/writer surface but
+stores **chunks**: one `index_arrays`/`index_batch` call appends one typed
+array chunk per column, so indexing costs O(columns) python operations per
+batch regardless of row count, and the per-column *append-order* dictionary
+grows by vectorized searchsorted merge (`BatchDictBuilder`) instead of a
+per-value dict probe.
+
+Append-order ids are the durable coin: a chunk's stored dict ids never change
+as the dictionary grows (sorted positions DO shift), and query-time snapshots
+remap them to the sorted-id space with one LUT gather — the same
+unsorted-while-consuming / sorted-at-snapshot split as mutable.py, just
+O(batch) instead of O(row).
+
+With `device_staging` on, numeric chunks are ALSO pushed to device at index
+time (narrowed exactly like `engine.datablock._narrow`), and `query_view()`
+pre-populates the engine's `SegmentBlock` cache with the concatenated staged
+buffers — consuming-segment queries then run the PR 2 device pipeline
+directly instead of host snapshots (`is_mutable=False` on the view routes
+the planner there).
+
+Not supported here (the consumer falls back to `MutableSegment`): realtime
+text/inverted indexes, upsert, dedup — all inherently per-row.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..schema import DataType, FieldSpec, Schema, normalize_mv_cell
+from .dictionary import Dictionary
+
+_I32_MIN, _I32_MAX = -(2 ** 31), 2 ** 31 - 1
+
+#: functions below that intentionally iterate rows in python — they are the
+#: compat/fallback lanes (MV normalization, type-mismatch coercion), never the
+#: columnar hot path (see analysis/ingest_hot_loop.py)
+__graft_slow_paths__ = ("_mv_chunk", "_coerce_loop", "_obj_unique")
+
+
+_WIDE_DTYPES: Dict[DataType, np.dtype] = {}
+
+
+def _wide_dtype(data_type: DataType) -> np.dtype:
+    """Canonical in-store numeric width (int64/float64) — matching the python
+    int/float values the list-based path carries, so both paths round
+    identically at storage-narrowing time. Memoized: this sits on the
+    per-chunk append path."""
+    dt = _WIDE_DTYPES.get(data_type)
+    if dt is None:
+        dt = np.dtype(np.int64) \
+            if np.dtype(data_type.numpy_dtype).kind in "iu" \
+            else np.dtype(np.float64)
+        _WIDE_DTYPES[data_type] = dt
+    return dt
+
+
+def _widen(arr: np.ndarray, base: Optional[int], data_type: DataType
+           ) -> np.ndarray:
+    wide = _wide_dtype(data_type)
+    if base:
+        return np.add(arr, base, dtype=wide)
+    return arr if arr.dtype == wide else arr.astype(wide)
+
+
+class BatchDictBuilder:
+    """Append-order dictionary with O(distinct-per-batch) vectorized merge.
+
+    Like the reference's unsorted realtime dictionary, ids are assigned in
+    first-seen order and NEVER move. Internally a sorted mirror + the
+    append-id of each sorted slot are kept, republished as one tuple per
+    merge (atomic under the GIL), so concurrent readers always see a
+    consistent (values, ids) pair. Probes are `np.searchsorted` over the
+    sorted mirror: one vectorized pass per batch's distinct values."""
+
+    def __init__(self, data_type: DataType):
+        self.data_type = data_type
+        self._numeric = data_type.is_numeric
+        vdtype = data_type.numpy_dtype if self._numeric else object
+        # (sorted values, append-order id of each sorted slot)
+        self._pub = (np.empty(0, dtype=vdtype), np.empty(0, dtype=np.int64))
+        self._snap: tuple = (-1, None, None)
+
+    def __len__(self) -> int:
+        return len(self._pub[0])
+
+    @property
+    def cardinality(self) -> int:
+        return len(self._pub[0])
+
+    def encode_distinct(self, vals: np.ndarray) -> np.ndarray:
+        """Values -> append-order ids, registering unseen values. Meant for a
+        batch's DISTINCT values (callers gather per-row ids from the returned
+        LUT), but correct for any value array."""
+        sorted_v, sorted_ids = self._pub
+        pos = np.searchsorted(sorted_v, vals)
+        hit = pos < len(sorted_v)
+        if hit.any():
+            hit[hit] = sorted_v[pos[hit]] == vals[hit]
+        if not hit.all():
+            new = np.unique(np.asarray(vals, dtype=sorted_v.dtype)[~hit])
+            base = len(sorted_v)
+            ins = np.searchsorted(sorted_v, new)
+            sorted_v = np.insert(sorted_v, ins, new)
+            sorted_ids = np.insert(sorted_ids, ins,
+                                   np.arange(base, base + len(new)))
+            self._pub = (sorted_v, sorted_ids)  # atomic publish
+            pos = np.searchsorted(sorted_v, vals)
+        return sorted_ids[pos]
+
+    def snapshot(self) -> tuple:
+        """(cardinality, sorted Dictionary, append-id -> sorted-id LUT),
+        cached per cardinality (ids never move, so a same-size dictionary is
+        the same dictionary)."""
+        sorted_v, sorted_ids = self._pub
+        card = len(sorted_v)
+        snap = self._snap
+        if snap[0] == card:
+            return snap
+        d = Dictionary(sorted_v if self._numeric else sorted_v.tolist(),
+                       self.data_type)
+        lut = np.empty(card, dtype=np.int64)
+        lut[sorted_ids] = np.arange(card, dtype=np.int64)
+        self._snap = (card, d, lut)
+        return self._snap
+
+
+class DeviceColumnReader:
+    """ColumnReader-compatible view over the chunked store; `fixed_n` freezes
+    it at one row count (frozen query views), else it tracks the live store
+    like `MutableColumnReader`. Materializations are cached per row count."""
+
+    def __init__(self, spec: FieldSpec, store: "DeviceMutableSegment",
+                 fixed_n: Optional[int] = None):
+        self.spec = spec
+        self.store = store
+        self.name = spec.name
+        self.data_type = spec.data_type
+        self._fixed_n = fixed_n
+        self._snap: tuple = (-1, None)
+
+    # -- reader surface (mirrors MutableColumnReader) ----------------------
+    @property
+    def has_dictionary(self) -> bool:
+        return not self.data_type.is_numeric or self.is_multi_value
+
+    @property
+    def is_multi_value(self) -> bool:
+        return not self.spec.single_value
+
+    @property
+    def num_docs(self) -> int:
+        return self._fixed_n if self._fixed_n is not None \
+            else self.store.num_docs
+
+    @property
+    def is_sorted(self) -> bool:
+        return False
+
+    @property
+    def max_num_values(self) -> int:
+        if not self.is_multi_value:
+            return 1
+        counts = self.mv_counts()
+        return int(counts.max()) if len(counts) else 0
+
+    @property
+    def mv_offsets(self) -> Optional[np.ndarray]:
+        return self._mat()[3] if self.is_multi_value else None
+
+    def mv_counts(self) -> np.ndarray:
+        return np.diff(np.asarray(self.mv_offsets))
+
+    @property
+    def cardinality(self) -> int:
+        d = self._mat()[1]
+        return len(d) if d is not None else -1
+
+    @property
+    def meta(self) -> Dict[str, Any]:
+        return {"hasNulls": self.null_bitmap is not None,
+                "dataType": self.data_type.value,
+                "fwdDtype": str(self.fwd.dtype)}
+
+    @property
+    def dictionary(self) -> Optional[Dictionary]:
+        return self._mat()[1]
+
+    @property
+    def fwd(self) -> np.ndarray:
+        """Sorted dict ids for dict-encoded columns, storage-dtype raw values
+        for numeric — same contract (and dtypes) as MutableColumnReader."""
+        m = self._mat()
+        return m[2] if m[1] is not None else m[0]
+
+    def dict_snapshot(self):
+        m = self._mat()
+        if m[1] is None:
+            return (-1, None, None)
+        if self.is_multi_value:
+            return (self.num_docs, m[1], m[2], m[3])
+        return (self.num_docs, m[1], m[2])
+
+    def values(self) -> np.ndarray:
+        m = self._mat()
+        if self.is_multi_value:
+            decoded = m[1].take(m[2]) if len(m[2]) else \
+                np.empty(0, dtype=self.data_type.numpy_dtype)
+            off = m[3]
+            out = np.empty(len(off) - 1, dtype=object)
+            rows = np.split(decoded, off[1:-1]) if len(off) > 1 else []
+            for i, r in enumerate(rows):
+                out[i] = r
+            return out
+        if m[1] is not None:
+            return m[1].take(m[2])
+        return m[0]
+
+    @property
+    def null_bitmap(self) -> Optional[np.ndarray]:
+        return self._mat()[4]
+
+    @property
+    def min_value(self):
+        m = self._mat()
+        if m[1] is not None:
+            return m[1].min_value
+        return m[0].min() if len(m[0]) else None
+
+    @property
+    def max_value(self):
+        m = self._mat()
+        if m[1] is not None:
+            return m[1].max_value
+        return m[0].max() if len(m[0]) else None
+
+    @property
+    def text_index(self):
+        return None
+
+    @property
+    def inverted_index(self):
+        return None
+
+    range_index = None
+    bloom_filter = None
+    index_types: List[str] = []
+
+    # ------------------------------------------------------------------
+    def _mat(self) -> tuple:
+        """(raw, dictionary, ids, offsets, nulls) at this reader's row count;
+        single-slot cache keyed on n (frozen readers hit it forever)."""
+        n = self.num_docs
+        snap = self._snap
+        if snap[0] == n:
+            return snap[1]
+        m = self.store._materialize(self.name, n)
+        self._snap = (n, m)
+        return m
+
+
+class ConsumingView:
+    """Frozen point-in-time segment over the chunked store: every reader is
+    pinned at one row count, so repeated queries against an idle consuming
+    segment share materializations instead of re-snapshotting.
+
+    `is_mutable=False` when the store stages chunks on device — the planner
+    then routes queries through the engine's device pipeline, fed by the
+    pre-populated `SegmentBlock` (`attach_device_block`). Without staging the
+    view stays planner-visible as mutable (host path over cached arrays)."""
+
+    def __init__(self, store: "DeviceMutableSegment", n: int):
+        self.name = store.name
+        self.schema = store.schema
+        self.num_docs = n
+        self.is_mutable = not store.device_staging
+        self._store = store
+        self._readers: Dict[str, DeviceColumnReader] = {}
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.schema.column_names
+
+    def column(self, name: str) -> DeviceColumnReader:
+        r = self._readers.get(name)
+        if r is None:
+            r = DeviceColumnReader(self._store.schema.field_spec(name),
+                                   self._store, fixed_n=self.num_docs)
+            self._readers[name] = r
+        return r
+
+    def __repr__(self) -> str:
+        return f"ConsumingView({self.name!r}, docs={self.num_docs})"
+
+
+class DeviceMutableSegment:
+    """Chunk-append consuming segment; single writer, many readers.
+
+    Same external surface as `MutableSegment` (is_mutable, num_docs, index /
+    index_batch / column / snapshot_columns) plus the array-native entry
+    points the vectorized consume path uses: `index_arrays(ColumnarBatch)`,
+    `query_view()`, `snapshot_arrays()`."""
+
+    is_mutable = True
+
+    def __init__(self, name: str, schema: Schema,
+                 text_index_columns: Sequence[str] = (),
+                 inverted_index_columns: Sequence[str] = (),
+                 device_staging: bool = False):
+        if any(schema.has_column(c) for c in text_index_columns) or \
+                any(schema.has_column(c) for c in inverted_index_columns):
+            raise ValueError(
+                "DeviceMutableSegment does not maintain realtime text/"
+                "inverted indexes (per-row by nature) — use MutableSegment")
+        self.name = name
+        self.schema = schema
+        self.start_time_ms = int(time.time() * 1000)
+        self.text_indexes: Dict[str, Any] = {}
+        self.inverted_indexes: Dict[str, Any] = {}
+        self._num_docs = 0           # volatile row counter, published last
+        self._chunk_rows: List[int] = []   # rows per appended batch
+        # per-column parallel chunk lists; entry shapes by column class:
+        #   numeric SV: (arr, base)  — arr possibly frame-of-reference narrow
+        #   dict SV:    append-order id array
+        #   MV:         (flat append-order ids, per-row counts)
+        self._chunks: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
+        self._null_chunks: Dict[str, List[Optional[np.ndarray]]] = {
+            f.name: [] for f in schema.fields}
+        self._has_nulls: Dict[str, bool] = {f.name: False for f in schema.fields}
+        self._dicts: Dict[str, BatchDictBuilder] = {}
+        for f in schema.fields:
+            if not f.data_type.is_numeric or not f.single_value:
+                self._dicts[f.name] = BatchDictBuilder(f.data_type)
+        self._readers: Dict[str, DeviceColumnReader] = {}
+        self._view: Optional[ConsumingView] = None
+        self._snap_cols: tuple = (-1, None)
+        self._snap_arrays: tuple = (-1, None)
+        # device staging: per-column list of jnp chunks (None once a column
+        # proves unstageable — e.g. epoch-ms values overflow int32)
+        self.device_staging = bool(device_staging)
+        self._dev_chunks: Dict[str, Optional[list]] = {}
+        if self.device_staging:
+            self._dev_chunks = {f.name: [] for f in schema.fields
+                         if f.data_type.is_numeric and f.single_value}
+
+    # -- properties mirroring MutableSegment -------------------------------
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    @property
+    def column_names(self) -> List[str]:
+        return self.schema.column_names
+
+    def column(self, name: str) -> DeviceColumnReader:
+        r = self._readers.get(name)
+        if r is None:
+            if not self.schema.has_column(name):
+                raise KeyError(f"segment {self.name}: no column {name!r}")
+            r = DeviceColumnReader(self.schema.field_spec(name), self)
+            self._readers[name] = r
+        return r
+
+    # -- ingest entry points ------------------------------------------------
+    def index_arrays(self, batch) -> int:
+        """Append one decoded `ColumnarBatch`: O(columns) python ops, all the
+        row-dimension work in numpy. The hot path of the block consume lane."""
+        n = batch.n
+        if n == 0:
+            return 0
+        n0 = self._num_docs
+        for spec in self.schema.fields:
+            self._append_rep(spec, batch.cols.get(spec.name), n)
+        self._chunk_rows.append(n)   # after column chunks: readers zip safely
+        self._num_docs = n0 + n      # publish the batch (one atomic store)
+        return n
+
+    def index_batch(self, cols: Dict[str, List[Any]],
+                    coerced: bool = False) -> int:
+        """List-based column batch (the JSON/pipeline lane and the
+        MutableSegment-compat surface): vectorize each column to a chunk."""
+        m = len(next(iter(cols.values()))) if cols else 0
+        if m == 0:
+            return 0
+        n0 = self._num_docs
+        for spec in self.schema.fields:
+            vals = cols.get(spec.name)
+            if vals is None:
+                self._append_rep(spec, None, m)
+            else:
+                self._append_list(spec, vals, m, coerced)
+        self._chunk_rows.append(m)
+        self._num_docs = n0 + m
+        return m
+
+    def index(self, row: Dict[str, Any]) -> None:
+        """Single-row compat shim (tests / trickle producers)."""
+        self.index_batch({f.name: [row.get(f.name)] for f in self.schema.fields})
+
+    # -- chunk appenders ----------------------------------------------------
+    def _append_rep(self, spec: FieldSpec, rep: Optional[tuple], n: int) -> None:
+        """Append one column's chunk from a ColumnarBatch rep (or None for a
+        column absent from the batch -> all-null chunk)."""
+        name = spec.name
+        if not spec.single_value:
+            vals = self._rep_to_list(spec, rep, n)
+            self._mv_chunk(spec, vals, n)
+            return
+        if rep is None:
+            nulls = np.ones(n, dtype=bool)
+            if spec.data_type.is_numeric:
+                wide = _wide_dtype(spec.data_type)
+                self._push_num(spec, np.full(n, spec.null_value, dtype=wide),
+                               None, nulls)
+            else:
+                nid = self._dicts[name].encode_distinct(
+                    np.array([spec.null_value], dtype=object))[0]
+                self._push_dict(spec, np.full(n, nid, dtype=np.int64), nulls)
+            return
+        kind, a, b, nulls = rep
+        if spec.data_type.is_numeric:
+            if kind == "num":
+                if (a.dtype.kind in "iu") == (_wide_dtype(spec.data_type).kind == "i"):
+                    self._push_num(spec, a, b, nulls)   # aligned: zero-copy
+                else:
+                    arr = _widen(a, b, spec.data_type)  # float wire -> int col etc.
+                    if nulls is not None:
+                        arr = arr.copy()
+                        arr[nulls] = spec.null_value
+                    self._push_num(spec, arr, None, nulls)
+            else:  # dict rep on a numeric column: decode via the small LUT
+                coerce = spec.data_type.coerce
+                lut = np.array([coerce(v) for v in a],
+                               dtype=_wide_dtype(spec.data_type))
+                arr = lut[np.asarray(b, dtype=np.int64)]
+                if nulls is not None:
+                    arr[nulls] = spec.null_value
+                self._push_num(spec, arr, None, nulls)
+            return
+        # dict-encoded column (STRING/JSON/BYTES)
+        builder = self._dicts[name]
+        if kind == "dict":
+            if spec.data_type is DataType.STRING:
+                # wire decode already materialized str values: coerce is
+                # the identity here, and this listcomp sits on the hot path
+                vals_obj = np.array(a, dtype=object)
+            else:
+                coerce = spec.data_type.coerce
+                vals_obj = np.array([coerce(v) for v in a], dtype=object)
+            lut = builder.encode_distinct(vals_obj)
+            ids = lut[np.asarray(b, dtype=np.int64)]
+            if nulls is not None:
+                nid = builder.encode_distinct(
+                    np.array([spec.null_value], dtype=object))[0]
+                ids[nulls] = nid
+            self._push_dict(spec, ids, nulls)
+        else:  # numeric wire rep on a string column: stringify distincts
+            wide = _widen(a, b, DataType.LONG if a.dtype.kind in "iu"
+                          else DataType.DOUBLE)
+            uniq, inv = np.unique(wide, return_inverse=True)
+            coerce = spec.data_type.coerce
+            vals_obj = np.array([coerce(v) for v in uniq.tolist()], dtype=object)
+            lut = builder.encode_distinct(vals_obj)
+            ids = lut[inv]
+            if nulls is not None:
+                nid = builder.encode_distinct(
+                    np.array([spec.null_value], dtype=object))[0]
+                ids[nulls] = nid
+            self._push_dict(spec, ids, nulls)
+
+    def _append_list(self, spec: FieldSpec, vals, n: int, coerced: bool) -> None:
+        name = spec.name
+        if not spec.single_value:
+            self._mv_chunk(spec, vals, n)
+            return
+        if spec.data_type.is_numeric:
+            wide = _wide_dtype(spec.data_type)
+            if isinstance(vals, np.ndarray) and vals.dtype.kind in "iufb":
+                self._push_num(spec, vals.astype(wide)
+                               if vals.dtype.kind == "b" else vals, None, None)
+                return
+            arr = nulls = None
+            if None not in vals:
+                try:
+                    arr = np.asarray(vals, dtype=wide)
+                except (TypeError, ValueError):
+                    arr = None   # strings/bools needing real coercion
+            if arr is None:
+                arr, nulls = self._coerce_loop(spec, vals, wide)
+            self._push_num(spec, arr, None, nulls)
+            return
+        builder = self._dicts[name]
+        obj = np.empty(n, dtype=object)
+        obj[:] = list(vals)
+        nulls = obj == None  # noqa: E711 — elementwise None test
+        nulls = nulls if nulls.any() else None
+        if nulls is not None:
+            obj[nulls] = spec.null_value
+        uniq, inv = self._obj_unique(spec, obj, coerced)
+        lut = builder.encode_distinct(uniq)
+        self._push_dict(spec, lut[inv], nulls)
+
+    # -- slow paths (declared in __graft_slow_paths__) ----------------------
+    def _coerce_loop(self, spec: FieldSpec, vals, wide: np.dtype):
+        """Per-value coercion fallback for numeric columns with nulls or
+        non-numeric inputs — identical semantics to MutableSegment's append."""
+        coerce = spec.data_type.coerce
+        nv = spec.null_value
+        out = np.empty(len(vals), dtype=wide)
+        null_idx = []
+        for i, v in enumerate(vals):
+            if v is None:
+                null_idx.append(i)
+                out[i] = nv
+            else:
+                out[i] = coerce(v)
+        nulls = None
+        if null_idx:
+            nulls = np.zeros(len(vals), dtype=bool)
+            nulls[null_idx] = True
+        return out, nulls
+
+    def _obj_unique(self, spec: FieldSpec, obj: np.ndarray, coerced: bool):
+        """(distinct values, inverse ids) for an object column; coerces
+        per-value first when inputs aren't uniformly comparable strings."""
+        if coerced:
+            try:
+                return np.unique(obj, return_inverse=True)
+            except TypeError:
+                pass  # mixed types snuck past the pipeline: coerce below
+        coerce = spec.data_type.coerce
+        for i, v in enumerate(obj):
+            obj[i] = coerce(v)
+        return np.unique(obj, return_inverse=True)
+
+    def _mv_chunk(self, spec: FieldSpec, vals, n: int) -> None:
+        """Multi-value append: per-row normalization is inherently per-row
+        (ragged cells), then ids resolve via one vectorized dict merge."""
+        builder = self._dicts[spec.name]
+        counts = np.empty(n, dtype=np.int64)
+        flat_vals: List[Any] = []
+        null_idx = []
+        for i in range(n):
+            cell, is_null = normalize_mv_cell(spec, vals[i])
+            if is_null:
+                null_idx.append(i)
+            counts[i] = len(cell)
+            flat_vals.extend(cell)
+        if builder._numeric:
+            flat = np.asarray(flat_vals, dtype=spec.data_type.numpy_dtype)
+        else:
+            flat = np.empty(len(flat_vals), dtype=object)
+            flat[:] = flat_vals
+        uniq, inv = np.unique(flat, return_inverse=True)
+        lut = builder.encode_distinct(uniq)
+        ids = lut[inv] if len(flat) else np.empty(0, dtype=np.int64)
+        nulls = None
+        if null_idx:
+            nulls = np.zeros(n, dtype=bool)
+            nulls[null_idx] = True
+        self._chunks[spec.name].append((ids, counts))
+        self._null_chunks[spec.name].append(nulls)
+        if nulls is not None:
+            self._has_nulls[spec.name] = True
+
+    # -- chunk push + device staging ---------------------------------------
+    def _push_num(self, spec: FieldSpec, arr: np.ndarray,
+                  base: Optional[int], nulls: Optional[np.ndarray]) -> None:
+        name = spec.name
+        self._chunks[name].append((arr, base))
+        self._null_chunks[name].append(nulls)
+        if nulls is not None:
+            self._has_nulls[name] = True
+        dev = self._dev_chunks.get(name)
+        if dev is not None:
+            self._stage_chunk(spec, arr, base, dev)
+
+    def _push_dict(self, spec: FieldSpec, ids: np.ndarray,
+                   nulls: Optional[np.ndarray]) -> None:
+        self._chunks[spec.name].append(ids)
+        self._null_chunks[spec.name].append(nulls)
+        if nulls is not None:
+            self._has_nulls[spec.name] = True
+
+    def _stage_chunk(self, spec: FieldSpec, arr: np.ndarray,
+                     base: Optional[int], dev: list) -> None:
+        """Push one numeric chunk to device, narrowed like datablock._narrow.
+        A column whose values leave int32 range (epoch-ms timestamps) is
+        permanently un-staged — the planner routes those host-side anyway."""
+        name = spec.name
+        try:
+            import jax.numpy as jnp
+            if _wide_dtype(spec.data_type).kind == "i":
+                if len(arr):
+                    lo = int(arr.min()) + (base or 0)
+                    hi = int(arr.max()) + (base or 0)
+                    if lo < _I32_MIN or hi > _I32_MAX:
+                        self._dev_chunks[name] = None
+                        return
+                if base:
+                    host = np.add(arr, base, dtype=np.int32)
+                else:
+                    host = arr.astype(np.int32)
+            else:
+                host = arr.astype(np.float32)
+            dev.append(jnp.asarray(host))
+        except Exception:
+            self._dev_chunks[name] = None   # no device available: stop trying
+
+    # -- query-time materialization ----------------------------------------
+    def _trim(self, items: list, n: int) -> list:
+        """(take, item) pairs covering the first n rows; the writer appends
+        `_chunk_rows` last, so zipping against it only pairs complete chunks."""
+        out = []
+        got = 0
+        for rows, item in zip(self._chunk_rows, items):
+            if got >= n:
+                break
+            out.append((min(rows, n - got), item))
+            got += min(rows, n - got)
+        return out
+
+    def _materialize(self, name: str, n: int) -> tuple:
+        """(raw, dictionary, ids, offsets, nulls) for column `name` frozen at
+        row count `n`. Exactly the shapes MutableColumnReader snapshots:
+        dictionaries contain ONLY values present in the first n rows (sorted),
+        ids live in that dictionary's id space."""
+        spec = self.schema.field_spec(name)
+        nulls = self._mat_nulls(name, n)
+        if not spec.single_value:
+            flat_parts, count_parts = [], []
+            for take, (ids, counts) in self._trim(self._chunks[name], n):
+                c = counts[:take]
+                count_parts.append(c)
+                flat_parts.append(ids[:int(c.sum())])
+            counts = np.concatenate(count_parts) if count_parts else \
+                np.empty(0, dtype=np.int64)
+            flat = np.concatenate(flat_parts) if flat_parts else \
+                np.empty(0, dtype=np.int64)
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(counts, out=offsets[1:])
+            d, sorted_ids = self._remap(name, flat)
+            return (None, d, sorted_ids, offsets, nulls)
+        if spec.data_type.is_numeric:
+            parts = [
+                _widen(arr[:take], base, spec.data_type)
+                for take, (arr, base) in self._trim(self._chunks[name], n)]
+            wide = np.concatenate(parts) if parts else \
+                np.empty(0, dtype=_wide_dtype(spec.data_type))
+            storage = np.dtype(spec.data_type.numpy_dtype)
+            raw = wide if wide.dtype == storage else wide.astype(storage)
+            return (raw, None, None, None, nulls)
+        parts = [ids[:take] for take, ids in self._trim(self._chunks[name], n)]
+        append_ids = np.concatenate(parts) if parts else \
+            np.empty(0, dtype=np.int64)
+        d, sorted_ids = self._remap(name, append_ids)
+        return (None, d, sorted_ids, None, nulls)
+
+    def _remap(self, name: str, append_ids: np.ndarray):
+        """append-order ids -> (snapshot Dictionary, sorted ids). The builder
+        may hold values from rows past the snapshot (or a concurrent batch):
+        the dictionary is cut down to the values actually referenced, keeping
+        snapshots identical to MutableSegment's np.unique-over-rows."""
+        card, d_full, lut = self._dicts[name].snapshot()
+        full = lut[append_ids] if len(append_ids) else append_ids
+        present = np.unique(full)
+        if len(present) == card:
+            return d_full, full
+        if isinstance(d_full.values, np.ndarray):
+            d = Dictionary(d_full.values[present], d_full.data_type)
+        else:
+            vals = d_full.values
+            d = Dictionary([vals[i] for i in present.tolist()],
+                           d_full.data_type)
+        return d, np.searchsorted(present, full)
+
+    def _mat_nulls(self, name: str, n: int) -> Optional[np.ndarray]:
+        if not self._has_nulls[name]:
+            return None
+        out = np.zeros(n, dtype=bool)
+        got = 0
+        for take, mask in self._trim(self._null_chunks[name], n):
+            if mask is not None:
+                out[got:got + take] = mask[:take]
+            got += take
+        return out if out.any() else None
+
+    # -- query / commit integration ----------------------------------------
+    def query_view(self) -> ConsumingView:
+        """Frozen segment view at the current row count, cached per num_docs —
+        consuming-segment queries share materializations until new rows land."""
+        n = self._num_docs
+        view = self._view
+        if view is not None and view.num_docs == n:
+            return view
+        view = ConsumingView(self, n)
+        if self.device_staging:
+            self._attach_device_block(view)
+        self._view = view
+        return view
+
+    def _attach_device_block(self, view: ConsumingView) -> None:
+        """Pre-populate the engine's SegmentBlock for this view from the
+        chunks already staged at index time: queries start with raw columns
+        resident instead of paying the host->device transfer per view."""
+        try:
+            import jax.numpy as jnp
+            from ..engine import datablock
+        except Exception:
+            return
+        blk = datablock.SegmentBlock(view)
+        n, padded = view.num_docs, blk.padded
+        for name, dev in self._dev_chunks.items():
+            if not dev:
+                continue
+            parts, got = [], 0
+            for rows, chunk in zip(self._chunk_rows, dev):
+                if got >= n:
+                    break
+                take = min(rows, n - got)
+                parts.append(chunk if take == rows else chunk[:take])
+                got += take
+            if got < n:   # a chunk raced publish: top up from host
+                spec = self.schema.field_spec(name)
+                host = np.asarray(view.column(name).fwd[got:n])
+                parts.append(jnp.asarray(datablock._narrow(host)))
+            if parts:
+                pad = padded - n
+                if pad:
+                    parts.append(jnp.zeros(pad, dtype=parts[0].dtype))
+                blk._raw[name] = jnp.concatenate(parts) if len(parts) > 1 \
+                    else parts[0]
+        setattr(view, datablock._BLOCK_ATTR, blk)
+
+    def snapshot_arrays(self) -> Dict[str, Any]:
+        """Column arrays for SegmentBuilder.build — the already-columnar
+        commit path (None at null rows, per the builder's null extraction);
+        cached per num_docs."""
+        n = self._num_docs
+        cached = self._snap_arrays
+        if cached[0] == n:
+            return cached[1]
+        out: Dict[str, Any] = {}
+        for spec in self.schema.fields:
+            m = self._materialize(spec.name, n)
+            raw, d, ids, offsets, nulls = m
+            if not spec.single_value:
+                decoded = d.take(ids) if len(ids) else \
+                    np.empty(0, dtype=spec.data_type.numpy_dtype)
+                rows = np.split(decoded, offsets[1:-1]) if n else []
+                col: Any = [r for r in rows]
+                if nulls is not None:
+                    for i in np.nonzero(nulls)[0].tolist():
+                        col[i] = None
+            elif d is not None:
+                col = d.take(ids)
+                if nulls is not None:
+                    col = col.copy()
+                    col[nulls] = None
+            else:
+                if nulls is not None:
+                    col = raw.astype(object)
+                    col[nulls] = None
+                else:
+                    col = raw
+            out[spec.name] = col
+        self._snap_arrays = (n, out)
+        return out
+
+    def snapshot_columns(self) -> Dict[str, list]:
+        """MutableSegment-compat snapshot (python lists, None at nulls);
+        cached per num_docs. Commit uses snapshot_arrays() instead."""
+        n = self._num_docs
+        cached = self._snap_cols
+        if cached[0] == n:
+            return cached[1]
+        cols: Dict[str, list] = {}
+        for name, arr in self.snapshot_arrays().items():
+            if isinstance(arr, np.ndarray):
+                cols[name] = arr.tolist()
+            else:
+                cols[name] = [v.tolist() if isinstance(v, np.ndarray) else v
+                              for v in arr]
+        self._snap_cols = (n, cols)
+        return cols
+
+    def __repr__(self) -> str:
+        return f"DeviceMutableSegment({self.name!r}, docs={self._num_docs})"

@@ -15,8 +15,8 @@ import pytest
 from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
 from pinot_tpu.analysis import (blocking_in_loop, collective_hygiene,
-                                drift_guards, jit_hygiene, lock_discipline,
-                                transport_bypass)
+                                drift_guards, ingest_hot_loop, jit_hygiene,
+                                lock_discipline, transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -450,6 +450,83 @@ def test_collective_axis_scope_suppression_honored():
     """, collective_hygiene.rules())
     assert active == []
     assert _ids(suppressed) == ["collective-axis-scope"]
+
+
+# -- row-loop-in-ingest -------------------------------------------------------
+
+_HOT_REL = "pinot_tpu/ingest/vectorized.py"
+
+
+def test_row_loop_append_true_positive():
+    active, _ = _check("""
+        def decode(rows):
+            out = []
+            for row in rows:
+                out.append(int(row))
+            return out
+    """, ingest_hot_loop.rules(), rel=_HOT_REL)
+    assert _ids(active) == ["row-loop-in-ingest"]
+
+
+def test_row_loop_nested_dict_iteration_flagged():
+    active, _ = _check("""
+        def index(rows, cols):
+            for row in rows:
+                for k, v in row.items():
+                    cols[k] = v
+    """, ingest_hot_loop.rules(), rel=_HOT_REL)
+    assert _ids(active) == ["row-loop-in-ingest"]
+
+
+def test_row_loop_per_column_iteration_clean():
+    # per-COLUMN loops are O(schema width): not the smell this rule hunts
+    active, _ = _check("""
+        def encode(schema, cols):
+            parts = []
+            for spec in schema.fields:
+                parts.append(cols[spec.name])
+            for name, chunk in cols.items():
+                parts.append(chunk)
+            return parts
+    """, ingest_hot_loop.rules(), rel=_HOT_REL)
+    assert active == []
+
+
+def test_row_loop_outside_hot_modules_ignored():
+    active, _ = _check("""
+        def decode(rows):
+            out = []
+            for row in rows:
+                out.append(int(row))
+            return out
+    """, ingest_hot_loop.rules(), rel="pinot_tpu/server/admin.py")
+    assert active == []
+
+
+def test_row_loop_slow_path_declaration_exempts():
+    active, _ = _check("""
+        __graft_slow_paths__ = ("decode_fallback",)
+
+        def decode_fallback(rows):
+            out = []
+            for row in rows:
+                out.append(int(row))
+            return out
+    """, ingest_hot_loop.rules(), rel=_HOT_REL)
+    assert active == []
+
+
+def test_row_loop_suppression_honored():
+    active, suppressed = _check("""
+        def walk(msgs):
+            out = []
+            # graftcheck: ignore[row-loop-in-ingest] -- per-block, not per-row
+            for m in msgs:
+                out.append(m)
+            return out
+    """, ingest_hot_loop.rules(), rel=_HOT_REL)
+    assert active == []
+    assert _ids(suppressed) == ["row-loop-in-ingest"]
 
 
 # -- suppression mechanics ----------------------------------------------------

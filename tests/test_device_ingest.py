@@ -1,0 +1,412 @@
+"""Device ingest plane differentials (PR 9): the vectorized consume→index
+pipeline and device-resident consuming segments must be BIT-IDENTICAL to the
+per-row host path everywhere a query can observe them.
+
+Three layers of differential:
+
+* reader surface — dictionaries, forward indexes, null bitmaps, min/max,
+  MV offsets from `DeviceMutableSegment` vs the classic `MutableSegment`
+  fed the same rows (per-row `index()` on the classic side);
+* query results — integer aggregates byte-identical across the host relay
+  AND the device pipeline, against both the frozen `ConsumingView` and the
+  classic mutable segment;
+* commit — segments built from `snapshot_arrays()` load back with the same
+  data as ones built from the classic `snapshot_columns()`.
+
+Plus the wire codec (PCB1 blocks) round-trip and the end-to-end kafkalite
+block-stream pump.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingest.vectorized import (ColumnarBatch, decode_columnar_block,
+                                         decode_columnar_blocks,
+                                         encode_columnar_block)
+from pinot_tpu.query.context import compile_query
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.segment.mutable import MutableSegment
+from pinot_tpu.segment.mutable_device import DeviceMutableSegment
+
+
+def _schema():
+    return Schema("events", [
+        dimension("site", DataType.STRING),
+        metric("clicks", DataType.LONG),
+        metric("cost", DataType.DOUBLE),
+        metric("score", DataType.INT),
+        date_time("ts", DataType.LONG)])
+
+
+def _mv_schema():
+    return Schema("tagged", [
+        dimension("site", DataType.STRING),
+        dimension("tags", DataType.STRING, single_value=False),
+        dimension("codes", DataType.INT, single_value=False),
+        metric("clicks", DataType.LONG),
+        date_time("ts", DataType.LONG)])
+
+
+def _rows(n, null_every=0, seed=7):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        r = {"site": f"s{int(rng.integers(0, 23))}.com",
+             "clicks": int(rng.integers(-500, 5000)),
+             "cost": float(np.round(rng.random() * 90, 4)),
+             "score": int(rng.integers(-100, 100)),
+             "ts": 1700000000000 + i}
+        if null_every and i % null_every == 0:
+            r["site"] = None
+        if null_every and i % (null_every + 4) == 1:
+            r["cost"] = None
+        if null_every and i % (null_every + 7) == 2:
+            r["score"] = None
+        rows.append(r)
+    return rows
+
+
+def _fill(schema, rows):
+    """rows -> coerced column lists (the per-row shape both stores accept)."""
+    cols = {f.name: [r.get(f.name) for r in rows] for f in schema.fields}
+    return cols
+
+
+def _index_classic(schema, rows, name="seg0"):
+    seg = MutableSegment(name, schema)
+    for r in rows:
+        seg.index(r)
+    return seg
+
+
+def _index_device(schema, rows, name="seg0", device_staging=False,
+                  batch=None):
+    seg = DeviceMutableSegment(name, schema, device_staging=device_staging)
+    step = batch or len(rows) or 1
+    for lo in range(0, len(rows), step):
+        seg.index_batch(_fill(schema, rows[lo:lo + step]), coerced=True)
+    return seg
+
+
+def _assert_readers_equal(classic, dev, schema):
+    assert classic.num_docs == dev.num_docs
+    for f in schema.fields:
+        a, b = classic.column(f.name), dev.column(f.name)
+        assert a.meta == b.meta, (f.name, a.meta, b.meta)
+        assert a.is_multi_value == b.is_multi_value
+        assert a.has_dictionary == b.has_dictionary
+        assert a.cardinality == b.cardinality, f.name
+        assert np.array_equal(np.asarray(a.fwd), np.asarray(b.fwd)), f.name
+        assert np.asarray(a.fwd).dtype == np.asarray(b.fwd).dtype, f.name
+        if a.dictionary is not None or b.dictionary is not None:
+            assert list(a.dictionary.values) == list(b.dictionary.values), \
+                f.name
+        na, nb = a.null_bitmap, b.null_bitmap
+        assert (na is None) == (nb is None), f.name
+        if na is not None:
+            assert np.array_equal(np.asarray(na), np.asarray(nb)), f.name
+        assert a.min_value == b.min_value, f.name
+        assert a.max_value == b.max_value, f.name
+        if a.is_multi_value:
+            assert np.array_equal(a.mv_offsets, b.mv_offsets), f.name
+            assert np.array_equal(a.mv_counts(), b.mv_counts()), f.name
+    assert classic.snapshot_columns() == dev.snapshot_columns()
+
+
+# -- reader-surface differentials ---------------------------------------------
+
+def test_reader_surface_matches_per_row_path():
+    schema = _schema()
+    rows = _rows(1200, null_every=0)
+    _assert_readers_equal(_index_classic(schema, rows),
+                          _index_device(schema, rows, batch=257), schema)
+
+
+def test_null_heavy_batches_match():
+    schema = _schema()
+    rows = _rows(900, null_every=3)
+    _assert_readers_equal(_index_classic(schema, rows),
+                          _index_device(schema, rows, batch=101), schema)
+
+
+def test_multi_value_batches_match():
+    schema = _mv_schema()
+    rng = np.random.default_rng(11)
+    rows = []
+    for i in range(600):
+        tags = [f"t{int(v)}" for v in rng.integers(0, 9, rng.integers(0, 4))]
+        codes = [int(v) for v in rng.integers(0, 50, rng.integers(0, 3))]
+        rows.append({"site": f"s{i % 5}", "tags": tags or None,
+                     "codes": codes or None, "clicks": i,
+                     "ts": 1700000000000 + i})
+    _assert_readers_equal(_index_classic(schema, rows),
+                          _index_device(schema, rows, batch=97), schema)
+
+
+def test_dict_overflow_across_batches():
+    """Dictionary growth across many batches: append-order ids must stay
+    stable while the sorted dictionary reshuffles under them."""
+    schema = Schema("wide", [dimension("k"), metric("v", DataType.LONG)])
+    rows = [{"k": f"key_{(i * 37) % 5000:05d}", "v": i} for i in range(5000)]
+    classic = _index_classic(schema, rows)
+    dev = _index_device(schema, rows, batch=83)
+    _assert_readers_equal(classic, dev, schema)
+    assert dev.column("k").cardinality == classic.column("k").cardinality
+
+
+def test_snapshot_frozen_at_intermediate_num_docs():
+    """A view frozen mid-ingest must keep serving the FIRST n rows exactly
+    even as later batches grow (and re-sort) the shared dictionary."""
+    schema = Schema("t", [dimension("k"), metric("v", DataType.LONG)])
+    rows = [{"k": f"z{i % 97}", "v": i} for i in range(400)]
+    more = [{"k": f"a{i % 53}", "v": i} for i in range(300)]   # sorts BEFORE z*
+    dev = _index_device(schema, rows, batch=100)
+    view = dev.query_view()
+    classic = _index_classic(schema, rows)
+    dev.index_batch(_fill(schema, more), coerced=True)
+    assert view.num_docs == 400
+    for name in ("k", "v"):
+        a, b = classic.column(name), view.column(name)
+        assert np.array_equal(np.asarray(a.fwd), np.asarray(b.fwd)), name
+        if a.dictionary is not None:
+            assert list(a.dictionary.values) == list(b.dictionary.values)
+    full = _index_classic(schema, rows + more)
+    _assert_readers_equal(full, dev, schema)
+
+
+# -- snapshot caching (satellite: per-num_docs caches) ------------------------
+
+def test_snapshot_and_view_caches_key_on_num_docs():
+    schema = _schema()
+    rows = _rows(300)
+    classic = _index_classic(schema, rows)
+    s1 = classic.snapshot_columns()
+    assert classic.snapshot_columns() is s1          # cached, same docs
+    dev = _index_device(schema, rows)
+    v1 = dev.query_view()
+    assert dev.query_view() is v1
+    dev.index_batch(_fill(schema, _rows(10, seed=9)), coerced=True)
+    v2 = dev.query_view()
+    assert v2 is not v1 and v2.num_docs == 310 and v1.num_docs == 300
+    classic.index(_rows(1, seed=3)[0])
+    assert classic.snapshot_columns() is not s1      # invalidated by growth
+
+
+# -- wire codec ---------------------------------------------------------------
+
+def test_wire_codec_round_trip():
+    schema = _schema()
+    rows = _rows(700, null_every=5)
+    cols = _fill(schema, rows)
+    blob = encode_columnar_block(schema, cols)
+    cb = decode_columnar_block(blob)
+    assert isinstance(cb, ColumnarBatch) and cb.n == 700
+    dev = DeviceMutableSegment("seg0", schema)
+    dev.index_arrays(cb)
+    _assert_readers_equal(_index_classic(schema, rows), dev, schema)
+
+
+def test_wire_codec_spliced_walk():
+    schema = Schema("t", [dimension("k"), metric("v", DataType.LONG)])
+    blocks = []
+    for b in range(5):
+        rows = [{"k": f"b{b}_{i % 7}", "v": b * 100 + i} for i in range(40)]
+        blocks.append(encode_columnar_block(schema, _fill(schema, rows)))
+    spliced = b"\n".join(blocks)
+    batches = decode_columnar_blocks(spliced, len(blocks))
+    assert [cb.n for cb in batches] == [40] * 5
+    assert batches[3].max_of("v") == 339
+
+
+def test_wire_codec_rejects_multi_value():
+    schema = _mv_schema()
+    with pytest.raises(ValueError):
+        encode_columnar_block(schema, {f.name: [None] for f in schema.fields})
+
+
+# -- array-native JSON decode -------------------------------------------------
+
+def _native_available():
+    from pinot_tpu.native import get_lib
+    return get_lib() is not None
+
+
+def test_json_array_native_differential():
+    if not _native_available():
+        pytest.skip("no C compiler for the native lib")
+    from pinot_tpu.ingest.transform import columns_from_spliced_json
+    from pinot_tpu.ingest.vectorized import columnar_batch_from_json
+    schema = _schema()
+    rows = _rows(1500, null_every=11)
+    for r in rows[::13]:
+        r.pop("cost", None)                 # missing key -> type-0 cell
+    data = b",".join(json.dumps(r).encode() for r in rows)
+    cb = columnar_batch_from_json(data, len(rows), schema)
+    assert cb is not None, "array-native decode fell back"
+    dev = DeviceMutableSegment("seg0", schema)
+    dev.index_arrays(cb)
+    classic = MutableSegment("seg0", schema)
+    classic.index_batch(columns_from_spliced_json(data, len(rows), schema),
+                        coerced=True)
+    _assert_readers_equal(classic, dev, schema)
+
+
+def test_json_array_native_falls_back_on_mixed_cells():
+    if not _native_available():
+        pytest.skip("no C compiler for the native lib")
+    from pinot_tpu.ingest.vectorized import columnar_batch_from_json
+    schema = _schema()
+    rows = [{"site": "a", "clicks": "not-an-int", "cost": 1.0, "score": 1,
+             "ts": 1}]
+    data = json.dumps(rows[0]).encode()
+    assert columnar_batch_from_json(data, 1, schema) is None
+
+
+# -- query-result differentials (both transports) -----------------------------
+
+_SQLS = (
+    "SELECT COUNT(*), SUM(clicks), SUM(score) FROM events",
+    "SELECT site, COUNT(*), SUM(clicks) FROM events GROUP BY site "
+    "ORDER BY site LIMIT 100",
+    "SELECT MIN(clicks), MAX(clicks), MIN(ts), MAX(ts) FROM events",
+    "SELECT COUNT(*) FROM events WHERE clicks > 1000",
+    "SELECT site, SUM(clicks) FROM events WHERE score >= 0 "
+    "GROUP BY site ORDER BY site LIMIT 100",
+)
+
+
+def _run(seg, schema, sql, use_device):
+    ctx = compile_query(sql, schema)
+    return ServerQueryExecutor(use_device=use_device).execute([seg], ctx)
+
+
+def test_query_results_identical_both_transports():
+    """Integer aggregates must be BYTE-identical: classic mutable (host) vs
+    frozen ConsumingView (host) vs device-staged view (device pipeline)."""
+    schema = _schema()
+    rows = _rows(2500, null_every=9)
+    classic = _index_classic(schema, rows)
+    dev_host = _index_device(schema, rows, batch=331)
+    dev_staged = _index_device(schema, rows, batch=331, device_staging=True)
+    hview = dev_host.query_view()
+    sview = dev_staged.query_view()
+    assert hview.is_mutable and not sview.is_mutable
+    for sql in _SQLS:
+        want = _run(classic, schema, sql, use_device=False).rows
+        got_host = _run(hview, schema, sql, use_device=False).rows
+        assert got_host == want, (sql, got_host, want)
+        got_dev = _run(sview, schema, sql, use_device=True).rows
+        assert got_dev == want, (sql, got_dev, want)
+
+
+# -- commit (parallel segment build from columnar chunks) ---------------------
+
+def test_commit_from_snapshot_arrays_matches(tmp_path):
+    schema = _schema()
+    rows = _rows(800, null_every=6)
+    classic = _index_classic(schema, rows)
+    dev = _index_device(schema, rows, batch=129)
+    a = load_segment(SegmentBuilder(schema).build(
+        classic.snapshot_columns(), str(tmp_path / "a"), "ev_a"))
+    b = load_segment(SegmentBuilder(schema).build(
+        dev.snapshot_arrays(), str(tmp_path / "b"), "ev_b"))
+    assert a.num_docs == b.num_docs == 800
+    for f in schema.fields:
+        ca, cb = a.column(f.name), b.column(f.name)
+        assert np.array_equal(np.asarray(ca.fwd), np.asarray(cb.fwd)), f.name
+        if ca.dictionary is not None:
+            assert list(ca.dictionary.values) == list(cb.dictionary.values)
+    for sql in _SQLS:
+        ra = _run(a, schema, sql, use_device=False).rows
+        rb = _run(b, schema, sql, use_device=False).rows
+        assert ra == rb, sql
+
+
+def test_commit_multi_value_snapshot_arrays(tmp_path):
+    schema = _mv_schema()
+    rows = [{"site": f"s{i % 3}", "tags": [f"t{i % 4}", f"t{i % 6}"],
+             "codes": [i % 9] if i % 5 else None, "clicks": i,
+             "ts": 1700000000000 + i} for i in range(300)]
+    dev = _index_device(schema, rows, batch=77)
+    classic = _index_classic(schema, rows)
+    a = load_segment(SegmentBuilder(schema).build(
+        classic.snapshot_columns(), str(tmp_path / "a"), "mv_a"))
+    b = load_segment(SegmentBuilder(schema).build(
+        dev.snapshot_arrays(), str(tmp_path / "b"), "mv_b"))
+    for name in ("tags", "codes"):
+        ca, cb = a.column(name), b.column(name)
+        assert np.array_equal(np.asarray(ca.fwd), np.asarray(cb.fwd)), name
+        assert np.array_equal(ca.mv_offsets, cb.mv_offsets), name
+        assert list(ca.dictionary.values) == list(cb.dictionary.values), name
+
+
+# -- end-to-end: kafkalite columnar-block stream ------------------------------
+
+def test_pump_end_to_end_block_stream(tmp_path):
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.ingest.kafkalite import LogBrokerClient, LogBrokerServer
+    from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+    schema = _schema()
+    srv = LogBrokerServer()
+    try:
+        client = LogBrokerClient(srv.bootstrap)
+        client.create_topic("ev_blocks", 1)
+        total, bs = 2000, 300
+        rows = _rows(total, null_every=10, seed=13)
+        payloads = [encode_columnar_block(schema,
+                                          _fill(schema, rows[lo:lo + bs]))
+                    for lo in range(0, total, bs)]
+        client.produce_many("ev_blocks", payloads)
+        cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+        cfg = TableConfig("events", table_type=TableType.REALTIME,
+                          stream=StreamConfig(
+                              stream_type="kafkalite", topic="ev_blocks",
+                              decoder="columnar",
+                              properties={"bootstrap": srv.bootstrap},
+                              flush_threshold_rows=100_000))
+        cluster.create_realtime_table(schema, cfg, num_partitions=1)
+        cluster.pump_realtime(cfg.table_name_with_type)
+        mgr = cluster.servers[0].realtime_manager(cfg.table_name_with_type)
+        c = list(mgr.consumers.values())[0]
+        assert c.last_decode_path == "blocks", c.last_decode_path
+        assert isinstance(c.mutable, DeviceMutableSegment)
+        assert c.mutable.num_docs == total
+        res = cluster.query("SELECT COUNT(*), SUM(clicks) FROM events")
+        assert res.rows[0][0] == total
+        assert res.rows[0][1] == sum(r["clicks"] for r in rows)
+    finally:
+        srv.stop()
+
+
+def test_pump_all_multi_partition(tmp_path):
+    """pump_all drives every partition; per-partition lanes must not lose or
+    double-count rows under the concurrent pump."""
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.ingest.stream import MemoryStream
+    from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+    schema = _schema()
+    MemoryStream.reset_all()
+    parts = 4
+    stream = MemoryStream.create("ev_mp", parts)
+    rows = _rows(1600, seed=21)
+    for i, r in enumerate(rows):
+        stream.produce(json.dumps(r), partition=i % parts)
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig("events", table_type=TableType.REALTIME,
+                      stream=StreamConfig(stream_type="memory", topic="ev_mp",
+                                          flush_threshold_rows=100_000))
+    cluster.create_realtime_table(schema, cfg, num_partitions=parts)
+    table = cfg.table_name_with_type
+    for _ in range(6):
+        cluster.pump_realtime(table)
+    mgr = cluster.servers[0].realtime_manager(table)
+    assert sum(c.mutable.num_docs for c in mgr.consumers.values()) == 1600
+    res = cluster.query("SELECT COUNT(*), SUM(clicks) FROM events")
+    assert res.rows[0][0] == 1600
+    assert res.rows[0][1] == sum(r["clicks"] for r in rows)
