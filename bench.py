@@ -743,6 +743,122 @@ def wire_codec_bench(n: int = 4_000_000, iters: int = 5) -> dict:
     }
 
 
+def chaos_bench() -> dict:
+    """Chaos lane (host-only, in-proc dual-server cluster):
+
+    1. `fault_plane_overhead_pct` — what the DISABLED graftfault plane costs
+       a query: the measured per-crossing price of `fault_point` (one module
+       global load + None check) times a generous 8-crossings-per-query
+       bound, as a percentage of the measured in-proc query p50. Gate: <1%.
+    2. `chaos_recovery_ticks` — kill a server, revive it, count the
+       deterministic failure-detector ticks until routing re-admits it.
+    3. `chaos_hedge_*_p99_ms` — p99 under a seeded `server.slow` straggler
+       schedule with hedging off vs on: the hedge must measurably cut p99,
+       and every hedged answer must stay full (numSegmentsQueried counted
+       once, partialResult false).
+    """
+    import shutil
+    import tempfile
+
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension
+    from pinot_tpu.schema import metric as smetric
+    from pinot_tpu.table import TableConfig
+    from pinot_tpu.utils import faults
+
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.fault_point("server.crash")
+    per_call_s = (time.perf_counter() - t0) / n
+
+    work = tempfile.mkdtemp(prefix="pinot_tpu_chaos_")
+    try:
+        cluster = QuickCluster(num_servers=2, work_dir=work)
+        schema = Schema("chaosm", [dimension("user", DataType.STRING),
+                                   smetric("value", DataType.DOUBLE)])
+        cfg = cluster.create_table(schema,
+                                   TableConfig("chaosm", replication=2))
+        cluster.ingest_columns(cfg,
+                               {"user": [f"u{i}" for i in range(20_000)],
+                                "value": [1.0] * 20_000})
+        sql = "SELECT COUNT(*), SUM(value) FROM chaosm"
+        for _ in range(3):
+            cluster.query(sql)
+        lats = []
+        for _ in range(15):
+            q0 = time.perf_counter()
+            cluster.query(sql)
+            lats.append(time.perf_counter() - q0)
+        p50_s = float(np.median(lats))
+        overhead_pct = 100.0 * (8 * per_call_s) / p50_s
+
+        detector = cluster.broker.failure_detector
+        for s in cluster.servers:
+            detector.register_probe(
+                s.instance_id,
+                lambda sid=s.instance_id:
+                    cluster.catalog.instances[sid].alive)
+        cluster.kill_server("server_0")
+        detector.notify_unhealthy("server_0")
+        now = time.time()
+        for _ in range(3):      # stays dead: backoff grows, probes fail
+            now += 40.0         # > max_interval_s, so every tick is due
+            detector.tick(now=now)
+        cluster.catalog.set_instance_alive("server_0", True)
+        recovery_ticks = 0
+        for _ in range(8):
+            now += 40.0
+            recovery_ticks += 1
+            detector.tick(now=now)
+            if "server_0" not in cluster.broker.routing.unhealthy_servers():
+                break
+
+        def slow_p99(hedge: bool, iters=15) -> float:
+            if hedge:
+                cluster.catalog.put_property(
+                    "clusterConfig/broker.hedge.enabled", "true")
+                cluster.catalog.put_property(
+                    "clusterConfig/broker.hedge.delay.ms", "5")
+            else:
+                cluster.catalog.put_property(
+                    "clusterConfig/broker.hedge.enabled", None)
+            lat = []
+            for i in range(iters):
+                # budget of ONE stall per query: the primary dispatch eats
+                # it deterministically, so a hedge (when enabled) always
+                # races a fast replica — same straggler load both modes
+                sched = faults.FaultSchedule(
+                    {"server.slow": {"latencyMs": 40, "count": 1}},
+                    seed=100 + i)
+                with faults.active(sched):
+                    q0 = time.perf_counter()
+                    r = cluster.query(sql)
+                    lat.append((time.perf_counter() - q0) * 1000)
+                # hedged or not, the answer must stay full and count each
+                # segment exactly once
+                assert not r.stats["partialResult"]
+                assert r.rows[0][0] == 20_000
+                assert r.stats["numSegmentsQueried"] == 1
+            lat.sort()
+            return lat[int(0.99 * (len(lat) - 1))]
+
+        p99_off = slow_p99(hedge=False)
+        p99_on = slow_p99(hedge=True)
+        return {
+            "fault_point_ns_disabled": round(per_call_s * 1e9, 1),
+            "fault_plane_overhead_pct": round(overhead_pct, 4),
+            "chaos_recovery_ticks": recovery_ticks,
+            "chaos_hedge_off_p99_ms": round(p99_off, 3),
+            "chaos_hedge_on_p99_ms": round(p99_on, 3),
+            "chaos_hedge_p99_cut_pct": round(
+                (1.0 - p99_on / p99_off) * 100.0, 1) if p99_off else None,
+        }
+    finally:
+        faults.deactivate()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def relay_floor_ms(iters=7) -> float:
     """Median dispatch+fetch of a TRIVIAL kernel: the transport's per-query
     latency floor. Published next to p50 so engine overhead (p50 - floor) is
@@ -1384,6 +1500,7 @@ def main():
             "baseline_kind": "numpy_single_thread_proxy",
             "backend": jax.default_backend(),
     }
+    detail.update(chaos_bench())
     _update_baseline_published(detail, round(q11_rate / n_dev, 1))
     print(json.dumps({
         "metric": "ssb_q1.1_filter_agg_scan_rate",
@@ -1428,5 +1545,7 @@ if __name__ == "__main__":
         _multichip_child(int(sys.argv[sys.argv.index("--multichip-child") + 1]))
     elif "--multichip" in sys.argv:
         run_multichip_lane()
+    elif "--chaos" in sys.argv:
+        print(json.dumps(chaos_bench(), indent=2))
     else:
         main()

@@ -1,0 +1,83 @@
+"""exception-hygiene rule: broad handlers that swallow faults silently.
+
+The fault-injection plane (PR 10) made this shape a liability: a
+`except Exception: pass` between a `fault_point()` and the invariant it
+guards turns an injected fault into a silent wrong answer — the chaos test
+sees short rows instead of a typed error, and production sees the same for
+REAL transport faults. One rule:
+
+* `exception-hygiene` — a bare `except:` / `except Exception:` /
+  `except BaseException:` whose body does nothing but `pass` / `continue` /
+  `...` swallows every fault on the path with no log line, no counter, and
+  no re-raise. Narrow the exception type, or observe the failure (log it,
+  count it) before moving on. Intentional swallows carry a graftcheck
+  suppression whose `-- reason` says why silence is correct there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+#: exception names considered "broad": everything (or nearly everything)
+#: funnels through these, so a do-nothing body hides faults of every kind
+_BROAD = {"Exception", "BaseException"}
+
+
+def _broad_clause(handler: ast.ExceptHandler) -> str:
+    """The broad type this clause catches ('' when the clause is narrow)."""
+    t = handler.type
+    if t is None:
+        return "bare except"
+    name = dotted_name(t).rsplit(".", 1)[-1]
+    if name in _BROAD:
+        return f"except {name}"
+    if isinstance(t, ast.Tuple):
+        for elt in t.elts:
+            name = dotted_name(elt).rsplit(".", 1)[-1]
+            if name in _BROAD:
+                return f"except (... {name} ...)"
+    return ""
+
+
+def _swallows_silently(handler: ast.ExceptHandler) -> bool:
+    """True when the body is ONLY pass/continue/`...` — no logging, no
+    counter, no fallback assignment, no re-raise."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+class SilentBroadExceptRule(Rule):
+    id = "exception-hygiene"
+    description = ("broad except clause whose body only passes/continues — "
+                   "faults vanish with no log, counter, or re-raise")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            clause = _broad_clause(node)
+            if not clause or not _swallows_silently(node):
+                continue
+            out.append(Finding(
+                self.id, module.rel, node.lineno,
+                f"`{clause}` swallowing the fault with a do-nothing body — "
+                "every failure on this path (including injected ones) "
+                "disappears with no log line or counter; narrow the type "
+                "or observe the failure before continuing"))
+        return out
+
+
+def rules() -> List[Rule]:
+    return [SilentBroadExceptRule()]

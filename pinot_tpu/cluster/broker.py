@@ -16,8 +16,10 @@ import logging
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future, ThreadPoolExecutor, as_completed
+from concurrent.futures import (FIRST_COMPLETED, Future, ThreadPoolExecutor,
+                                as_completed)
 from concurrent.futures import TimeoutError as FutureTimeoutError
+from concurrent.futures import wait as futures_wait
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from ..query import stats as qstats
@@ -54,6 +56,8 @@ class FailureDetector:
         self._probes: Dict[str, Callable[[], bool]] = {}
         # server -> (next probe time, current interval)
         self._pending: Dict[str, Tuple[float, float]] = {}
+        # server -> consecutive failed probes since it was last healthy
+        self._fail_counts: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -72,6 +76,7 @@ class FailureDetector:
     def notify_healthy(self, server_id: str) -> None:
         with self._lock:
             self._pending.pop(server_id, None)
+            self._fail_counts.pop(server_id, None)
 
     def remove(self, server_id: str) -> None:
         """Forget a decommissioned server entirely: its probe closure must not
@@ -79,6 +84,28 @@ class FailureDetector:
         with self._lock:
             self._probes.pop(server_id, None)
             self._pending.pop(server_id, None)
+            self._fail_counts.pop(server_id, None)
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Operator view per registered server: `state` (healthy | probing),
+        consecutive failed probes, and seconds until the next probe (absent
+        for healthy servers). Feeds the broker /debug panel and cluster_top."""
+        now = time.time()
+        with self._lock:
+            out: Dict[str, Dict[str, object]] = {}
+            for server_id in self._probes:
+                entry = self._pending.get(server_id)
+                if entry is None:
+                    out[server_id] = {"state": "healthy",
+                                      "consecutiveFailures": 0}
+                else:
+                    out[server_id] = {
+                        "state": "probing",
+                        "consecutiveFailures":
+                            self._fail_counts.get(server_id, 0),
+                        "nextProbeInS": round(max(0.0, entry[0] - now), 3),
+                    }
+            return out
 
     def tick(self, now: Optional[float] = None) -> None:
         """Probe every due server once (tests drive this deterministically;
@@ -121,10 +148,13 @@ class FailureDetector:
                     continue  # raced with notify_healthy/remove
                 if ok:
                     self._pending.pop(server_id, None)
+                    self._fail_counts.pop(server_id, None)
                 else:
                     nxt = min(interval * self.backoff_factor,
                               self.max_interval_s)
                     self._pending[server_id] = (now + nxt, nxt)
+                    self._fail_counts[server_id] = \
+                        self._fail_counts.get(server_id, 0) + 1
             if ok:
                 self.routing.mark_server_healthy(server_id)
 
@@ -141,6 +171,27 @@ class FailureDetector:
         thread = getattr(self, "_thread", None)
         if thread is not None:
             thread.join(timeout=5.0)  # loop wakes within tick_s of the event
+
+
+class _DispatchUnit:
+    """One scatter work unit: a primary dispatch to a server plus, when the
+    hedging machinery duplicates it, one hedge dispatch to an alternate
+    replica. Resolution is FIRST SUCCESS WINS — the loser's partial is dropped
+    unmerged, so merged stats (`numSegmentsQueried` and friends) never
+    double-count a hedged unit's segments."""
+
+    __slots__ = ("server", "segments", "primary", "t0", "hedge",
+                 "hedge_server", "hedge_exhausted", "failed")
+
+    def __init__(self, server: str, segments: List[str], primary: Future):
+        self.server = server
+        self.segments = segments
+        self.primary = primary
+        self.t0 = time.monotonic()
+        self.hedge: Optional[Future] = None
+        self.hedge_server: Optional[str] = None
+        self.hedge_exhausted = False   # no eligible alternate replica
+        self.failed: Dict[Future, BaseException] = {}
 
 
 class Broker:
@@ -478,6 +529,9 @@ class Broker:
                           "sampleRate": self._trace_sample_rate()},
             "brokerMetrics": {k: v for k, v in sorted(snap.items())
                               if k.startswith("pinot_broker_")},
+            "failureDetector": self.failure_detector.snapshot(),
+            "hedgedRequests": int(
+                reg.counter("pinot_broker_hedged_requests").value),
             "gaugeHistories": get_registry().gauge_histories("pinot_broker"),
         }
 
@@ -542,6 +596,17 @@ class Broker:
         schema = self.catalog.schemas.get(self.catalog.table_configs[physical[0]].name)
         ctx = compile_query(stmt, schema)
 
+        # deadline propagation: stamp an absolute wall-clock budget so every
+        # downstream stage (server scheduler slot, device pipeline wait) can
+        # clamp to the REMAINING time instead of restarting a full budget —
+        # a faulted/slow server then fails fast rather than serializing the
+        # whole stage timeout behind it
+        if "deadlineEpochMs" not in ctx.options:
+            t_ms = ctx.options.get("timeoutMs")
+            budget_s = (float(t_ms) / 1000.0 if t_ms is not None
+                        else self.stage_timeout_s)
+            ctx.options["deadlineEpochMs"] = (time.time() + budget_s) * 1000.0
+
         if ctx.analyze:
             return self._handle_analyze(stmt, ctx, physical, t0)
         if ctx.explain:
@@ -597,8 +662,8 @@ class Broker:
             routing = self.routing.route_query(table, ctx, extra_filter=tf_expr,
                                                uncovered=unroutable)
             uncovered_segments.extend(f"{table}:{s}" for s in sorted(unroutable))
-            futures = {}
             missing: Dict[str, Set[str]] = {}  # segment -> servers that missed it
+            units: List[_DispatchUnit] = []
             for server_id, segments in routing.items():
                 handle = self._servers.get(server_id)
                 if handle is None:
@@ -608,57 +673,14 @@ class Broker:
                     for seg in segments:
                         missing.setdefault(seg, set()).add(server_id)
                     continue
-                futures[self._dispatch_partial(handle, server_id, _traced,
-                                               table, ctx, segments,
-                                               tf)] = server_id
-            pending = set(futures)
-            try:
-                for fut in as_completed(futures,
-                                        timeout=self.stage_timeout_s):
-                    pending.discard(fut)
-                    server_id = futures[fut]
-                    servers_queried += 1
-                    try:
-                        partial = fut.result()
-                        partials.append(partial)
-                        exec_stats.merge(partial.stats)
-                        if partial.served is not None:
-                            for seg in set(routing.get(server_id, ())) \
-                                    - set(partial.served):
-                                missing.setdefault(seg, set()).add(server_id)
-                    except Exception as e:
-                        # EVERY failure mode sends the server's segments into
-                        # the retry round on a DIFFERENT replica (never
-                        # re-targeting the one that failed): transport failures
-                        # additionally remove the server from routing;
-                        # backpressure (admission rejection / timeout) is the
-                        # server WORKING as designed; a query error is
-                        # remembered — if the retry covers the segments it was
-                        # replica-local (corrupt file, one bad handler) and the
-                        # query completes as a partial result, but if the retry
-                        # leaves them uncovered the error was deterministic
-                        # (bad query) and is raised to the caller.
-                        servers_failed += 1
-                        if _is_transport_failure(e):
-                            self.routing.mark_server_unhealthy(server_id)
-                            self.failure_detector.notify_unhealthy(server_id)
-                        elif not _is_backpressure(e):
-                            query_errors.append(e)
-                            error_segments.update(routing.get(server_id, ()))
-                        for seg in routing.get(server_id, ()):
-                            missing.setdefault(seg, set()).add(server_id)
-            except FutureTimeoutError:
-                # stage deadline expired with servers still outstanding: each
-                # straggler is treated like a transport failure — marked
-                # unhealthy, its segments sent into the retry round on another
-                # replica (never silently dropped)
-                for fut in pending:
-                    server_id = futures[fut]
-                    servers_failed += 1
-                    self.routing.mark_server_unhealthy(server_id)
-                    self.failure_detector.notify_unhealthy(server_id)
-                    for seg in routing.get(server_id, ()):
-                        missing.setdefault(seg, set()).add(server_id)
+                fut = self._dispatch_partial(handle, server_id, _traced,
+                                             table, ctx, segments, tf)
+                units.append(_DispatchUnit(server_id, list(segments), fut))
+            q, f = self._gather_units(table, ctx, tf, _traced, units, partials,
+                                      exec_stats, missing, query_errors,
+                                      error_segments)
+            servers_queried += q
+            servers_failed += f
             if missing:
                 # a replica mid segment-transition (commit adoption, move) can
                 # briefly serve without a segment it was routed — ONE retry
@@ -846,6 +868,180 @@ class Broker:
                 return fut
         call = traced(handle, server_id) if traced is not None else handle
         return self._pool.submit(call, table, ctx, segments, tf)
+
+    #: hedge delay used before the dispatch-latency histogram has samples
+    HEDGE_DEFAULT_DELAY_MS = 50.0
+
+    def _hedge_params(self) -> Tuple[bool, float, int]:
+        """(enabled, delay seconds, max hedges per query) from the
+        `broker.hedge.*` clusterConfig knobs. delay.ms <= 0 (the default)
+        derives the delay from the observed dispatch-latency p99 — a dispatch
+        that has outlived p99 is a straggler worth duplicating."""
+        if not _truthy(self.catalog.get_property(
+                "clusterConfig/broker.hedge.enabled", False)):
+            return False, 0.0, 0
+        try:
+            delay_ms = float(self.catalog.get_property(
+                "clusterConfig/broker.hedge.delay.ms", 0) or 0)
+        except (TypeError, ValueError):
+            delay_ms = 0.0
+        if delay_ms <= 0:
+            from ..utils.metrics import get_registry
+            p99 = get_registry().histogram(
+                "pinot_broker_dispatch_latency_ms").percentile(0.99)
+            delay_ms = p99 if p99 > 0 else self.HEDGE_DEFAULT_DELAY_MS
+        try:
+            budget = int(self.catalog.get_property(
+                "clusterConfig/broker.hedge.max", 2))
+        except (TypeError, ValueError):
+            budget = 2
+        return True, delay_ms / 1000.0, max(0, budget)
+
+    def _hedge_target(self, table: str, primary: str,
+                      segments: Sequence[str]) -> Optional[str]:
+        """An alternate healthy registered replica serving EVERY segment of
+        the unit, or None (a unit spanning replica groups can't hedge as one
+        dispatch — it stays on the retry-round path instead)."""
+        unhealthy = self.routing.unhealthy_servers()
+        candidates: Optional[Set[str]] = None
+        for seg in segments:
+            cands = {c for c in self.routing.segment_candidates(table, seg)
+                     if c != primary and c in self._servers
+                     and c not in unhealthy}
+            candidates = cands if candidates is None else candidates & cands
+            if not candidates:
+                return None
+        return min(candidates) if candidates else None
+
+    def _gather_units(self, table: str, ctx, tf, traced,
+                      units: List[_DispatchUnit],
+                      partials: List[SegmentResult], exec_stats,
+                      missing: Dict[str, Set[str]],
+                      query_errors: List[Exception],
+                      error_segments: Set[str]) -> Tuple[int, int]:
+        """Gather one table's scatter round, hedging stragglers.
+
+        Failure taxonomy matches the old as_completed loop exactly — transport
+        failures leave routing via the failure detector, backpressure is the
+        server working as designed, anything else is a remembered query error;
+        every failed unit's segments enter the retry round. On top of that,
+        when `broker.hedge.enabled` is on, a unit whose dispatch outlives the
+        hedge delay (p99-based by default) is duplicated onto an alternate
+        replica: first response wins, the loser is discarded unmerged, and a
+        unit only counts failed when EVERY copy failed. Returns
+        (units resolved, units failed)."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        disp_hist = reg.histogram("pinot_broker_dispatch_latency_ms")
+        hedge_on, hedge_delay_s, hedge_budget = self._hedge_params()
+        hedges_sent = 0
+        queried = failed = 0
+        owner: Dict[Future, _DispatchUnit] = {u.primary: u for u in units}
+        unresolved = set(units)
+        deadline = time.monotonic() + self.stage_timeout_s
+
+        def classify(u: _DispatchUnit, server_id: str,
+                     exc: BaseException) -> None:
+            if _is_transport_failure(exc):
+                self.routing.mark_server_unhealthy(server_id)
+                self.failure_detector.notify_unhealthy(server_id)
+            elif not _is_backpressure(exc):
+                query_errors.append(exc)          # type: ignore[arg-type]
+                error_segments.update(u.segments)
+
+        while unresolved:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            wait_set: List[Future] = []
+            next_due: Optional[float] = None
+            for u in unresolved:
+                if u.primary not in u.failed:
+                    wait_set.append(u.primary)
+                if u.hedge is not None and u.hedge not in u.failed:
+                    wait_set.append(u.hedge)
+                if hedge_on and hedges_sent < hedge_budget \
+                        and u.hedge is None and not u.hedge_exhausted \
+                        and u.primary not in u.failed:
+                    due = u.t0 + hedge_delay_s
+                    next_due = due if next_due is None else min(next_due, due)
+            timeout = deadline - now
+            if next_due is not None:
+                timeout = min(timeout, max(next_due - now, 0.0))
+            done = futures_wait(wait_set, timeout=timeout,
+                                return_when=FIRST_COMPLETED)[0] \
+                if wait_set else set()
+            for fut in done:
+                u = owner[fut]
+                if u not in unresolved:
+                    continue   # the duplicate already won: drop unmerged
+                is_hedge = fut is u.hedge
+                server_id = u.hedge_server if is_hedge else u.server
+                try:
+                    # graftcheck: ignore[blocking-result-no-timeout] -- fut is
+                    # from futures_wait's done set: already resolved, no block
+                    partial = fut.result()
+                except Exception as e:
+                    u.failed[fut] = e
+                    classify(u, server_id, e)
+                    other = u.primary if is_hedge else u.hedge
+                    if other is not None and other not in u.failed:
+                        continue   # the other copy may still answer
+                    unresolved.discard(u)
+                    queried += 1
+                    failed += 1
+                    for seg in u.segments:
+                        missing.setdefault(seg, set()).add(u.server)
+                        if u.hedge_server is not None:
+                            missing[seg].add(u.hedge_server)
+                    continue
+                unresolved.discard(u)
+                queried += 1
+                disp_hist.observe((time.monotonic() - u.t0) * 1000)
+                partials.append(partial)
+                exec_stats.merge(partial.stats)
+                if partial.served is not None:
+                    for seg in set(u.segments) - set(partial.served):
+                        missing.setdefault(seg, set()).add(server_id)
+            if hedge_on and hedges_sent < hedge_budget:
+                now = time.monotonic()
+                for u in list(unresolved):
+                    if hedges_sent >= hedge_budget:
+                        break
+                    if u.hedge is not None or u.hedge_exhausted \
+                            or u.primary in u.failed \
+                            or now - u.t0 < hedge_delay_s:
+                        continue
+                    alt = self._hedge_target(table, u.server, u.segments)
+                    if alt is None:
+                        u.hedge_exhausted = True
+                        continue
+                    hf = self._dispatch_partial(self._servers[alt], alt,
+                                                traced, table, ctx,
+                                                u.segments, tf)
+                    owner[hf] = u
+                    u.hedge, u.hedge_server = hf, alt
+                    hedges_sent += 1
+                    exec_stats.add(qstats.HEDGED_REQUESTS)
+                    reg.counter("pinot_broker_hedged_requests").inc()
+        # stage deadline expired with units still outstanding: each straggler
+        # is treated like a transport failure — marked unhealthy, its segments
+        # sent into the retry round on another replica (never silently
+        # dropped); sides that already failed got their taxonomy above
+        for u in unresolved:
+            queried += 1
+            failed += 1
+            for server_id, fut in ((u.server, u.primary),
+                                   (u.hedge_server, u.hedge)):
+                if fut is None or fut in u.failed:
+                    continue
+                self.routing.mark_server_unhealthy(server_id)
+                self.failure_detector.notify_unhealthy(server_id)
+            for seg in u.segments:
+                missing.setdefault(seg, set()).add(u.server)
+                if u.hedge_server is not None:
+                    missing[seg].add(u.hedge_server)
+        return queried, failed
 
     def _retry_missing(self, table: str, ctx, missing: Dict[str, Set[str]],
                        tf: Optional[str], traced

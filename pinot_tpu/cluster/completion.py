@@ -167,6 +167,14 @@ class LLCSegmentManager:
         # would create a DUPLICATE successor consuming the same records
         # (reference: leadership + per-partition locks guard the same window)
         self._lock = threading.RLock()
+        # deep-store quarantine: segments whose upload kept failing past the
+        # retry budget ride the peer:// scheme; the commit path stops
+        # retrying them, and each validation round probes the blob ONCE
+        # (clearing the record on success) — a deep store that poisons a
+        # specific blob (auth, quota, size cap) is re-tried at the periodic
+        # round's cadence, never in a tight loop. Maps segment ->
+        # consecutive upload failures; `clear_quarantine` resets.
+        self.quarantined: Dict[str, int] = {}
         os.makedirs(work_dir, exist_ok=True)
 
     # -- table setup (reference: setUpNewTable) -----------------------------
@@ -286,9 +294,7 @@ class LLCSegmentManager:
         tar_path = os.path.join(self.work_dir, f"{segment}.tar.gz")
         tar_segment(segment_dir, tar_path)
         uri = f"{table}/{segment}.tar.gz"
-        try:
-            self.deepstore.upload(tar_path, uri)
-        except Exception:
+        if not self._upload_with_retry(tar_path, uri, segment):
             # deep store unavailable: the commit still succeeds under the PEER
             # download scheme — replicas fetch the committed copy from a
             # serving peer, and the validation round re-uploads to the deep
@@ -303,6 +309,53 @@ class LLCSegmentManager:
             return self._finish_commit(segment, server, fsm, meta, cfg,
                                        seg_meta_json, crc, uri, size,
                                        end_offset)
+
+    def _upload_with_retry(self, local_path: str, uri: str,
+                           segment: str) -> bool:
+        """Deep-store upload with bounded retries + exponential backoff
+        (knobs `deepstore.retry.max` / `deepstore.retry.backoff.ms`). Returns
+        True on success (clearing any quarantine record for the segment);
+        exhausting the budget quarantines the segment — the caller falls back
+        to the peer:// scheme, and `_heal_peer_segments` probes the blob once
+        per validation round until an upload lands (or an operator clears
+        the record)."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        max_tries = max(1, int(self.catalog.get_property(
+            "clusterConfig/deepstore.retry.max", 3)))
+        backoff_ms = float(self.catalog.get_property(
+            "clusterConfig/deepstore.retry.backoff.ms", 50))
+        for attempt in range(max_tries):
+            if attempt:
+                reg.counter("pinot_controller_deepstore_retries").inc()
+                time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1000.0)
+            try:
+                self.deepstore.upload(local_path, uri)
+            # graftcheck: ignore[exception-hygiene] -- each failed attempt is
+            # observed: the next iteration counts a deepstore retry, and
+            # terminal failure counts + records the quarantine below
+            except Exception:
+                continue
+            with self._lock:
+                self.quarantined.pop(segment, None)
+            return True
+        with self._lock:
+            first_time = segment not in self.quarantined
+            self.quarantined[segment] = \
+                self.quarantined.get(segment, 0) + max_tries
+        if first_time:
+            reg.counter("pinot_controller_deepstore_quarantined").inc()
+        return False
+
+    def clear_quarantine(self, segment: Optional[str] = None) -> None:
+        """Operator escape hatch: reset the failure record for quarantined
+        segment(s) (all of them when segment=None) — e.g. after rotating a
+        credential that was poisoning specific blobs."""
+        with self._lock:
+            if segment is None:
+                self.quarantined.clear()
+            else:
+                self.quarantined.pop(segment, None)
 
     def _finish_commit(self, segment, server, fsm, meta, cfg, seg_meta_json,
                        crc, uri, size, end_offset) -> str:
@@ -469,11 +522,17 @@ class LLCSegmentManager:
                     fetch_from_peer(self.catalog, table, name, tmp)
                     self.deepstore.upload(tmp, uri)
                 except Exception:
+                    with self._lock:
+                        # the once-per-round probe failed: keep (or extend)
+                        # the quarantine record so /debug shows the streak
+                        if name in self.quarantined:
+                            self.quarantined[name] += 1
                     continue  # still unreachable; next round retries
                 finally:
                     if os.path.exists(tmp):
                         os.remove(tmp)
                 with self._lock:
+                    self.quarantined.pop(name, None)
                     # re-check under the lock: the fetch+upload window is
                     # seconds long — a concurrent table drop (or a racing
                     # heal) must not resurrect the segment's metadata

@@ -14,6 +14,8 @@ import tarfile
 import threading
 from typing import Callable, Dict, List
 
+from ..utils.faults import fault_point
+
 
 class DeepStoreFS:
     """Filesystem SPI: copy/open/delete by URI."""
@@ -77,6 +79,9 @@ class LocalDeepStore(DeepStoreFS):
         return os.path.join(self.root, uri.lstrip("/"))
 
     def upload(self, local_path: str, uri: str) -> None:
+        # graftfault: fails BEFORE any byte lands — paired with the atomic
+        # rename below, an injected failure never leaves a torn blob
+        fault_point("deepstore.upload.fail")
         dest = self._path(uri)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         # copy-to-temp + rename: readers never observe a torn write (the
@@ -124,6 +129,7 @@ class MemDeepStore(DeepStoreFS):
         self._lock = threading.Lock()
 
     def upload(self, local_path: str, uri: str) -> None:
+        fault_point("deepstore.upload.fail")
         with open(local_path, "rb") as f:
             data = f.read()
         with self._lock:

@@ -44,6 +44,7 @@ from concurrent.futures import CancelledError, Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Dict, List, Optional, Sequence
 
+from ..utils.faults import FaultInjected, fault_point
 from ..utils.metrics import get_registry
 
 
@@ -151,9 +152,19 @@ class DeviceQueryPipeline:
         item = _Item(ctx, list(segments))
         tr = current_trace()
         submit_ms = tr.now_ms() if tr is not None else 0.0
+        # deadline propagation: never wait on the device past the broker's
+        # stamped deadline — timing out here cancels the item, and the
+        # dispatcher/fetcher skip cancelled work before burning a launch or a
+        # host sync on a result nobody is waiting for
+        timeout_s = self.submit_timeout_s
+        d_ms = ctx.options.get("deadlineEpochMs") \
+            if getattr(ctx, "options", None) else None
+        if d_ms is not None:
+            timeout_s = max(0.0, min(timeout_s,
+                                     float(d_ms) / 1000.0 - time.time()))
         self._q.put(item)
         try:
-            result = item.future.result(timeout=self.submit_timeout_s)
+            result = item.future.result(timeout=timeout_s)
             if tr is not None and result is not DEVICE_FALLBACK:
                 # the pipeline threads can't see this query's trace; rebuild
                 # the device-side phases from the item's launch attribution —
@@ -235,6 +246,17 @@ class DeviceQueryPipeline:
         while not self._stop.is_set():
             batch = self._drain()
             if batch is None:
+                continue
+            try:
+                # graftfault: a slow spec stalls the drain (device contention /
+                # recompile storm); a failing spec means the device path is
+                # down — the whole drain downgrades to host execution,
+                # availability over the fast path, never a dead dispatcher
+                fault_point("device.launch.slow")
+            except FaultInjected:
+                for item in batch:
+                    self.fallbacks += 1
+                    _resolve(item.future, DEVICE_FALLBACK)
                 continue
             t0 = time.perf_counter()
             if prepared_api:

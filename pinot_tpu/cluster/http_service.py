@@ -188,6 +188,9 @@ class HttpService:
                     # the response — draining here would deadlock the stream.
                     try:
                         body.drain()
+                    # graftcheck: ignore[exception-hygiene] -- best-effort
+                    # drain of a connection that is about to close anyway;
+                    # the response below still reports the real outcome
                     except Exception:
                         pass
                 if isinstance(data, str):
@@ -384,6 +387,12 @@ def open_client_connection(scheme: str, host: str, port: int,
     transport-bypass graftcheck rule keeps raw client use out of the rest of
     the package)."""
     import http.client
+
+    # graftfault: being the one mint point also makes it the one reset point —
+    # an injected fault here is a peer refusing/resetting the connection, for
+    # the pool, the mux streams, and every other outbound exchange alike
+    from ..utils.faults import fault_point
+    fault_point("mux.conn.reset")
     if scheme == "https":
         ctx = _CLIENT_SSL_CONTEXT
         if ctx is None:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import struct
 import threading
 import time
@@ -42,6 +43,7 @@ import urllib.parse
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils.faults import FaultInjected, fault_point
 from .http_service import HttpError, open_client_connection
 
 _HEADER = struct.Struct("<IBI")
@@ -71,6 +73,9 @@ class _MuxConnection:
 
     def __init__(self, scheme: str, host: str, port: int,
                  token: Optional[str], timeout_s: float):
+        # graftfault: a reset during connection mint surfaces exactly like a
+        # peer that died mid-handshake (FaultInjected IS a ConnectionError)
+        fault_point("mux.conn.reset")
         self._timeout_s = timeout_s
         conn = open_client_connection(scheme, host, port, timeout_s)
         try:
@@ -172,6 +177,14 @@ class _MuxConnection:
                                     b"\r\n0\r\n\r\n")
                     return
                 tag, payload, entry = item
+                try:
+                    fault_point("mux.frame.drop")
+                except FaultInjected:
+                    # frame lost on the wire: the tag stays pending with no
+                    # response coming, exactly like a switch eating the
+                    # packet — the owner's staleness reap fails the stream
+                    # once the oldest tag overstays its timeout
+                    continue
                 tr = entry["trace"]
                 if tr is not None:
                     wait = tr.now_ms() - entry["enq_ms"]
@@ -295,14 +308,25 @@ class MuxClient:
     whole point: in-flight queries per server are bounded by the server's
     flow-control window, not by a client thread pool."""
 
+    #: reconnect bounds: a dead server must not be stormed by the old
+    #: retry-once-immediately loop — attempts are capped and separated by
+    #: jittered exponential backoff (full jitter halves synchronized retries
+    #: from concurrent submitters)
+    MAX_ATTEMPTS = 4
+    BACKOFF_BASE_S = 0.005
+    BACKOFF_MAX_S = 0.1
+
     def __init__(self, url: str, token: Optional[str] = None,
-                 streams: int = 1, timeout_s: float = 60.0):
+                 streams: int = 1, timeout_s: float = 60.0,
+                 max_attempts: Optional[int] = None):
         parsed = urllib.parse.urlsplit(url)
         self._scheme = parsed.scheme or "http"
         self._host = parsed.hostname or "127.0.0.1"
         self._port = parsed.port or (443 if self._scheme == "https" else 80)
         self._token = token
         self._timeout_s = timeout_s
+        self._max_attempts = max(1, int(max_attempts if max_attempts
+                                        is not None else self.MAX_ATTEMPTS))
         self._slots: List[Optional[_MuxConnection]] = \
             [None] * max(1, int(streams))
         self._rr = 0
@@ -333,18 +357,32 @@ class MuxClient:
     def submit(self, payload: bytes, *, trace=None, depth: int = 0,
                dispatch_ms: float = 0.0, span_name: Optional[str] = None
                ) -> "Future":
+        """Submit one tagged frame, reconnecting with jittered exponential
+        backoff on a dying stream. The attempts cap bounds how long a dead
+        server is hammered; exhausting it raises ConnectionError, which the
+        owning RemoteServerHandle answers by retrying the request once over
+        the legacy per-request transport."""
         from ..utils.metrics import get_registry
-        get_registry().counter("pinot_broker_mux_dispatches").inc()
-        for _attempt in (0, 1):
-            conn = self._connection()
+        reg = get_registry()
+        reg.counter("pinot_broker_mux_dispatches").inc()
+        delay_s = self.BACKOFF_BASE_S
+        last_exc: Optional[Exception] = None
+        for attempt in range(self._max_attempts):
+            if attempt:
+                # full jitter: delay * [0.5, 1.5), doubled per attempt
+                reg.counter("pinot_broker_mux_reconnect_backoffs").inc()
+                time.sleep(delay_s * (0.5 + random.random()))
+                delay_s = min(delay_s * 2.0, self.BACKOFF_MAX_S)
             try:
+                conn = self._connection()
                 return conn.submit(payload, trace=trace, depth=depth,
                                    dispatch_ms=dispatch_ms,
                                    span_name=span_name)
-            except MuxStreamClosed:
-                continue  # raced a dying stream; next _connection() is fresh
+            except (MuxStreamClosed, ConnectionError) as e:
+                last_exc = e  # dying stream or failed mint: back off, retry
         raise ConnectionError(
-            f"mux stream to {self._host}:{self._port} keeps closing")
+            f"mux stream to {self._host}:{self._port} keeps closing "
+            f"({self._max_attempts} attempts): {last_exc}")
 
     def close(self) -> None:
         with self._lock:

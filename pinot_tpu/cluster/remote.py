@@ -27,6 +27,7 @@ from typing import Dict, Optional, Sequence
 
 from ..schema import Schema
 from ..table import TableConfig
+from ..utils.faults import fault_point
 from .catalog import Catalog, InstanceInfo, SegmentMeta
 from .deepstore import DeepStoreFS, tar_segment, untar_segment
 from .http_service import HttpError, get_json, http_call, post_json
@@ -294,7 +295,13 @@ class RemoteServerHandle:
         self._mux_streams = max(1, int(mux_streams))
         self._mux = None               # lazily opened MuxClient
         self._mux_unsupported = False  # old peer without /mux: legacy forever
+        self._mux_down_until = 0.0     # transient legacy window after backoff
         self._mux_lock = threading.Lock()
+
+    #: how long dispatch rides the legacy transport after the mux client
+    #: exhausts its reconnect backoff; afterwards mux is retried (the peer may
+    #: have restarted) rather than being pinned to legacy forever.
+    MUX_COOLDOWN_S = 1.0
 
     def _mux_client(self):
         from .mux import MuxClient
@@ -323,6 +330,12 @@ class RemoteServerHandle:
         peer predates /mux; the caller falls back to the legacy transport."""
         if not self.use_mux or self._mux_unsupported:
             return None
+        if time.time() < self._mux_down_until:
+            return None  # inside the post-backoff cooldown: ride legacy
+        # graftfault: a crashed peer looks like a dispatch that dies before
+        # any response — FaultInjected IS a ConnectionError, so the broker's
+        # taxonomy marks the server unhealthy and retries on another replica
+        fault_point("server.crash")
         from ..utils.metrics import get_registry
         from ..utils.trace import current_depth, current_trace
         sql = ctx if isinstance(ctx, str) else ctx.sql
@@ -351,6 +364,15 @@ class RemoteServerHandle:
                 get_registry().counter("pinot_broker_mux_fallbacks").inc()
                 return None
             raise
+        except ConnectionError:
+            # the mux client already burned its jittered-backoff budget
+            # (MuxClient.submit retries internally); answer by retrying this
+            # request over the legacy per-request transport, and keep riding
+            # it for a short cooldown so a dead peer isn't re-probed through
+            # the full backoff ladder on every scatter
+            self._mux_down_until = time.time() + self.MUX_COOLDOWN_S
+            get_registry().counter("pinot_broker_mux_fallbacks").inc()
+            return None
 
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
@@ -382,6 +404,7 @@ class RemoteServerHandle:
                 trace_id=tr.trace_id if tr is not None else "",
                 sampled=bool(tr.sampled) if tr is not None else False)
         with span("send"):
+            fault_point("server.crash")
             resp = http_call("POST", f"{self.server_url}/query", body,
                              timeout=self.timeout_s,
                              content_type="application/octet-stream",
@@ -459,6 +482,7 @@ class ControllerDeepStore(DeepStoreFS):
         self.controller_url = controller_url.rstrip("/")
 
     def upload(self, local_path: str, uri: str) -> None:
+        fault_point("deepstore.upload.fail")
         with open(local_path, "rb") as f:
             http_call("POST", f"{self.controller_url}/deepstore/{uri}", f.read(),
                       content_type="application/octet-stream", timeout=120.0)

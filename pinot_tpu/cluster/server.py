@@ -22,6 +22,7 @@ from ..parallel.combine import device_topk_screen
 from ..query.executor import ServerQueryExecutor
 from ..query.reduce import SegmentResult, merge_segment_results
 from ..segment.reader import ImmutableSegment, load_segment
+from ..utils.faults import fault_point
 from .catalog import CONSUMING, DROPPED, OFFLINE, ONLINE, Catalog, InstanceInfo
 from .deepstore import DeepStoreFS, untar_segment
 
@@ -144,6 +145,8 @@ class ServerNode:
         self.status = "SHUTTING_DOWN"
         try:
             self.catalog.set_instance_alive(self.instance_id, False)
+        # graftcheck: ignore[exception-hygiene] -- shutdown teardown: the
+        # controller being gone already achieves what this call wanted
         except Exception:
             pass  # controller may already be gone during teardown
         for handler in list(self._realtime_managers.values()):
@@ -175,6 +178,9 @@ class ServerNode:
             # reload failure propagate: it would kill the catalog watch thread.
             try:
                 self.reload_table(table.split("/", 1)[1])
+            # graftcheck: ignore[exception-hygiene] -- reload_table already
+            # isolates + reports per-segment errors; this guard only keeps
+            # the catalog watch thread alive on a wholesale failure
             except Exception:
                 pass  # per-segment errors are already isolated + reported below
 
@@ -431,11 +437,30 @@ class ServerNode:
             ctx = compile_query(ctx, schema)
         if time_filter:
             ctx = _apply_time_filter(ctx, time_filter, schema)
+        # graftfault: a crash here dies exactly where a killed process would
+        # (the broker's taxonomy sees a transport failure and retries on
+        # another replica); slow is the straggler the hedging machinery hunts
+        fault_point("server.crash")
+        fault_point("server.slow")
+        # deadline propagation: the broker stamps deadlineEpochMs from its own
+        # timeout budget; a partial that arrives after the caller gave up
+        # fails typed NOW instead of burning scheduler and device time on an
+        # answer nobody is waiting for
+        remaining_s = _deadline_remaining_s(ctx)
+        if remaining_s is not None and remaining_s <= 0:
+            from ..query.scheduler import QueryTimeoutError
+            raise QueryTimeoutError(
+                f"query deadline already passed by {-remaining_s:.3f}s "
+                f"at {self.instance_id}")
         if self.scheduler is not None:
             timeout_s = None
             t_ms = ctx.options.get("timeoutMs") if ctx.options else None
             if t_ms is not None:
                 timeout_s = float(t_ms) / 1000.0
+            if remaining_s is not None:
+                # the tighter of the per-query budget and the broker deadline
+                timeout_s = remaining_s if timeout_s is None \
+                    else min(timeout_s, remaining_s)
             # the scheduler's worker thread must see the caller's request trace,
             # seeded at the caller's nesting depth so in-proc spans tree up
             # exactly like HTTP-spliced ones; the submit->run gap is admission
@@ -581,6 +606,17 @@ class ServerNode:
     @staticmethod
     def apply_time_filter(ctx: QueryContext, time_filter: str, schema) -> QueryContext:
         return _apply_time_filter(ctx, time_filter, schema)
+
+
+def _deadline_remaining_s(ctx: QueryContext) -> Optional[float]:
+    """Seconds left until the broker-stamped absolute deadline
+    (`deadlineEpochMs` query option), or None when no deadline rode in.
+    Negative means the caller already gave up on this query."""
+    d_ms = ctx.options.get("deadlineEpochMs") if ctx.options else None
+    if d_ms is None:
+        return None
+    import time
+    return float(d_ms) / 1000.0 - time.time()
 
 
 def _apply_time_filter(ctx: QueryContext, time_filter: str, schema) -> QueryContext:

@@ -495,6 +495,9 @@ class ControllerService:
             for uri in d.get("stagedTars", []):
                 try:
                     self.controller.deepstore.delete(uri)
+                # graftcheck: ignore[exception-hygiene] -- staged-tar GC is
+                # best-effort; a missed delete is re-collected by the next
+                # merge round, never a correctness issue
                 except Exception:
                     pass
         return json_response({"status": "OK", "segments": new_names})
@@ -677,6 +680,10 @@ class ServerService:
     def __init__(self, server: ServerNode, host: str = "127.0.0.1", port: int = 0,
                  access_control=None, ssl_context=None):
         self.server = server
+        # graftfault: cluster-wide chaos drills install the plane at role
+        # startup from the `fault.schedule` clusterConfig knob
+        from ..utils.faults import activate_from_config
+        activate_from_config(server.catalog)
         self.http = HttpService(host, port, access_control=access_control,
                                 ssl_context=ssl_context)
         # mux executor: queries demuxed off mux streams run here, NOT on the
@@ -965,6 +972,8 @@ class ServerService:
             # partition
             try:
                 body.drain()
+            # graftcheck: ignore[exception-hygiene] -- best-effort drain on
+            # the cancel path; the 409 below reports the real outcome
             except Exception:
                 pass
             return error_response("query cancelled", 409)
@@ -1134,6 +1143,10 @@ class BrokerService:
                  access_control=None, ssl_context=None,
                  mux: Optional[bool] = None):
         self.broker = broker
+        # graftfault: brokers join cluster-wide chaos drills too (frame drops
+        # and conn resets inject on the dispatching side)
+        from ..utils.faults import activate_from_config
+        activate_from_config(broker.catalog)
         self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
         self._handles: Dict[str, RemoteServerHandle] = {}  # for close()
         # `mux` pins the server-dispatch transport (tests dispatch both ways

@@ -30,6 +30,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.faults import fault_point
 from . import kafka_wire as kw
 from .stream import (MessageBatch, PartitionGroupConsumer, StreamConsumerFactory,
                      StreamMessage, StreamMetadataProvider, register_stream_factory)
@@ -650,6 +651,8 @@ class KafkaLiteConsumer(PartitionGroupConsumer):
         """(spliced values, count, next_offset) or None without the native
         splicer. The record-count contract is approximated through the
         byte budget like `fetch` (Kafka bounds bytes, not records)."""
+        fault_point("stream.stall")
+        fault_point("stream.partition.lost")
         budget = int(max_messages * self._avg_record_bytes)
         budget = min(max(budget, 64 << 10), 8 << 20)
         out = self.client.fetch_spliced(self.topic, self.partition,
@@ -666,6 +669,10 @@ class KafkaLiteConsumer(PartitionGroupConsumer):
 
     def _fetch_records(self, start_offset: int, max_messages: int,
                        timeout_ms: int):
+        # graftfault: the wire-consumer injection point — a lost partition
+        # raises out of the fetch exactly like the broker closing the socket
+        fault_point("stream.stall")
+        fault_point("stream.partition.lost")
         budget = int(max_messages * self._avg_record_bytes)
         budget = min(max(budget, 64 << 10), 8 << 20)
         records = self.client.fetch(self.topic, self.partition, start_offset,

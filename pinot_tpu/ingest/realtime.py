@@ -29,6 +29,7 @@ from ..segment.mutable import MutableSegment
 from ..segment.reader import load_segment
 from ..segment.writer import SegmentBuilder, SegmentGeneratorConfig
 from ..table import TableConfig
+from ..utils.faults import fault_point
 from .stream import get_decoder, get_stream_factory
 from .transform import TransformPipeline
 
@@ -227,6 +228,13 @@ class RealtimePartitionConsumer:
             if limit <= 0:
                 return 0
         fetch_from = self.offset
+        # graftfault: stall = a slow upstream broker (the fetch runs outside
+        # pump_lock, so a stall never blocks state transitions); lost = the
+        # partition dies mid-consume — FaultInjected propagates to the consume
+        # loop's error path (counted, backed off, retried from self.offset, so
+        # recovery is exactly-once by construction)
+        fault_point("stream.stall")
+        fault_point("stream.partition.lost")
         batch_ok = self.dedup is None and self.upsert is None
         # Decode strategy, fastest available first (all fetches run OUTSIDE
         # pump_lock):
@@ -542,6 +550,8 @@ class RealtimePartitionConsumer:
         if close_fn is not None:
             try:
                 close_fn()
+            # graftcheck: ignore[exception-hygiene] -- idempotent teardown:
+            # an already-closed consumer is the desired end state
             except Exception:
                 pass  # already torn down / broker gone
 

@@ -5,8 +5,11 @@ Polls the controller and broker debug/status endpoints and renders one row per
 table: QPS, consuming-segment count, max offset lag, max freshness lag, rows/s,
 the controller's ingestion verdict, and its SLO burn-rate verdict — plus a
 top-consumers panel attributing device time / bytes / queue wait per table
-from the broker rollups. The operator's first stop when a dashboard shows a
-table going stale or an SLO burning:
+from the broker rollups, and a servers panel showing the broker failure
+detector's view (healthy vs probing, consecutive probe failures, seconds to
+the next probe) with the lifetime hedged-request count in the header. The
+operator's first stop when a dashboard shows a table going stale, an SLO
+burning, or a server flapping:
 
     python -m pinot_tpu.tools.cluster_top --controller http://host:9000 \\
         --broker http://host:8099 [--interval 5] [--once] [--token TOKEN]
@@ -55,6 +58,8 @@ def snapshot(controller_url: str, broker_url: Optional[str],
                                 "reasons": [f"poll failed: {e}"]}
         try:
             out["slo"][t] = fetch(f"{controller_url}/tables/{t}/sloStatus")
+        # graftcheck: ignore[exception-hygiene] -- read-only dashboard poll;
+        # the missing entry renders visibly as "-" in the SLO column
         except Exception:
             pass   # older controller / unknown table: SLO column shows "-"
     if broker_url:
@@ -63,6 +68,9 @@ def snapshot(controller_url: str, broker_url: Optional[str],
             out["broker"] = debug.get("queryStats")
             # per-table resource attribution (the top-consumers panel)
             out["tableStats"] = debug.get("tableStats") or {}
+            # failure-detector probe states + hedge count (robustness panel)
+            out["failureDetector"] = debug.get("failureDetector") or {}
+            out["hedgedRequests"] = debug.get("hedgedRequests", 0)
         except Exception as e:
             out["errors"].append(f"broker /debug: {e}")
     try:
@@ -96,7 +104,8 @@ def render(snap: Dict[str, Any]) -> str:
     if broker:
         head += (f"  queries={broker.get('numQueries', 0)}"
                  f" avg={broker.get('avgTimeMs', 0)}ms"
-                 f" slow={broker.get('numSlowQueries', 0)}")
+                 f" slow={broker.get('numSlowQueries', 0)}"
+                 f" hedged={snap.get('hedgedRequests', 0)}")
     lines.append(head)
     cols = f"{'TABLE':<28} {'HEALTH':<10} {'SLO':<12} {'CONS':>4} " \
            f"{'OFFLAG':>8} {'FRESHLAG':>9} {'ROWS/S':>8}  REASONS"
@@ -139,6 +148,20 @@ def render(snap: Dict[str, Any]) -> str:
                 f"{r.get('p99LatencyMs', 0):>8} "
                 f"{int(r.get('numSlowQueries', 0)):>5} "
                 f"{int(r.get('numErrors', 0)):>4}")
+    detector = snap.get("failureDetector") or {}
+    if detector:
+        lines.append("")
+        lines.append("servers (broker failure detector)")
+        dcols = f"{'SERVER':<28} {'STATE':<10} {'FAILS':>6} {'NEXTPROBE':>10}"
+        lines.append(dcols)
+        lines.append("-" * len(dcols))
+        for server_id in sorted(detector):
+            d = detector[server_id]
+            nxt = d.get("nextProbeInS")
+            lines.append(
+                f"{server_id:<28} {d.get('state', '?'):<10} "
+                f"{int(d.get('consecutiveFailures', 0)):>6} "
+                f"{(f'{nxt}s' if nxt is not None else '-'):>10}")
     failing = {n: s for n, s in (snap.get("periodicTasks") or {}).items()
                if s.get("lastError")}
     for name, s in sorted(failing.items()):

@@ -62,6 +62,8 @@ def _show_queries(cluster, queries=_SAMPLE_QUERIES, wait_rows: Optional[int] = N
                 n = got["resultTable"]["rows"][0][0]
                 if n >= wait_rows:
                     break
+            # graftcheck: ignore[exception-hygiene] -- startup poll: the
+            # table not existing yet is the condition being waited out
             except Exception:
                 pass
             time.sleep(0.3)

@@ -15,8 +15,9 @@ import pytest
 from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
 from pinot_tpu.analysis import (blocking_in_loop, collective_hygiene,
-                                drift_guards, ingest_hot_loop, jit_hygiene,
-                                lock_discipline, transport_bypass)
+                                drift_guards, exception_hygiene,
+                                ingest_hot_loop, jit_hygiene, lock_discipline,
+                                transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -527,6 +528,78 @@ def test_row_loop_suppression_honored():
     """, ingest_hot_loop.rules(), rel=_HOT_REL)
     assert active == []
     assert _ids(suppressed) == ["row-loop-in-ingest"]
+
+
+# -- exception-hygiene --------------------------------------------------------
+
+def test_exception_hygiene_true_positives():
+    active, _ = _check("""
+        def f(items):
+            for item in items:
+                try:
+                    item.close()
+                except Exception:
+                    continue
+            try:
+                risky()
+            except:
+                pass
+            try:
+                other()
+            except BaseException:
+                ...
+    """, exception_hygiene.rules())
+    assert _ids(active) == ["exception-hygiene"] * 3
+
+
+def test_exception_hygiene_broad_member_of_tuple():
+    active, _ = _check("""
+        def f():
+            try:
+                risky()
+            except (ValueError, Exception):
+                pass
+    """, exception_hygiene.rules())
+    assert _ids(active) == ["exception-hygiene"]
+
+
+def test_exception_hygiene_clean_negatives():
+    # narrow types, observed failures, and re-raises are all fine
+    active, _ = _check("""
+        import logging
+        def f():
+            try:
+                risky()
+            except ValueError:
+                pass                 # narrow: the one expected failure
+            try:
+                risky()
+            except Exception:
+                logging.exception("risky failed")
+            try:
+                risky()
+            except Exception:
+                count_failure()
+                raise
+            try:
+                risky()
+            except Exception:
+                out = FALLBACK       # the fallback IS the observation
+    """, exception_hygiene.rules())
+    assert active == []
+
+
+def test_exception_hygiene_suppression_honored():
+    active, suppressed = _check("""
+        def f():
+            try:
+                risky()
+            # graftcheck: ignore[exception-hygiene] -- teardown best-effort
+            except Exception:
+                pass
+    """, exception_hygiene.rules())
+    assert active == []
+    assert _ids(suppressed) == ["exception-hygiene"]
 
 
 # -- suppression mechanics ----------------------------------------------------
