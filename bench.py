@@ -859,6 +859,207 @@ def chaos_bench() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def soak_bench(tenants: int = 96, hog_threads: int = 12, good_threads: int = 4,
+               phase_s: float = 5.0, rows_per_tenant: int = 512) -> dict:
+    """Overload soak lane (host-only, in-proc dual-server cluster): sustained
+    mixed workload under ~4x overload proving graceful degradation.
+
+    Many small tenant tables serve a zipf-mixed stream of cheap aggregations
+    from `good_threads` workers while one hog tenant floods expensive
+    unaggregated scans from `hog_threads` workers and a background thread
+    keeps ingesting segments — with broker adaptive admission on and
+    per-tenant fair scheduling on every server. Published gates:
+
+    - `overload_protected_p99_ms` — the well-behaved tenants' p99 UNDER
+      overload; the budget is <= 2x `soak_unloaded_p99_ms`.
+    - `shed_rate` — fraction of broker arrivals shed (the hog's scans).
+    - `tenant_fairness_index` — Jain's index over per-tenant success ratios
+      of the good tenants (1.0 = perfectly even service).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.query.scheduler import QueryScheduler
+    from pinot_tpu.schema import DataType, Schema, dimension
+    from pinot_tpu.schema import metric as smetric
+    from pinot_tpu.table import TableConfig
+
+    work = tempfile.mkdtemp(prefix="pinot_tpu_soak_")
+    try:
+        cluster = QuickCluster(num_servers=2, work_dir=work)
+        # per-tenant fair scheduling on every server: weighted-fair queue,
+        # capped per-table share, so the hog degrades alone server-side too
+        for s in cluster.servers:
+            s.scheduler = QueryScheduler(max_concurrent=4, max_pending=64,
+                                         per_table_share=0.5)
+        rng = np.random.default_rng(97)
+        names = [f"soak{i:03d}" for i in range(tenants)]
+        for nm in names:
+            schema = Schema(nm, [dimension("user", DataType.STRING),
+                                 smetric("value", DataType.DOUBLE)])
+            cfg = cluster.create_table(schema, TableConfig(nm, replication=2))
+            cluster.ingest_columns(cfg, {
+                "user": [f"u{j % 64}" for j in range(rows_per_tenant)],
+                "value": np.round(rng.uniform(0, 10, rows_per_tenant),
+                                  3).tolist()})
+        hog_rows = 50_000
+        hog_schema = Schema("soakhog", [dimension("user", DataType.STRING),
+                                        smetric("value", DataType.DOUBLE)])
+        hog_cfg = cluster.create_table(hog_schema,
+                                       TableConfig("soakhog", replication=2))
+        cluster.ingest_columns(hog_cfg, {
+            "user": [f"h{j % 997}" for j in range(hog_rows)],
+            "value": [1.0] * hog_rows})
+        hog_sql = f"SELECT user, value FROM soakhog LIMIT {hog_rows}"
+
+        # zipf tenant mix, precomputed so every run draws the same stream
+        zipf = np.random.default_rng(1234).zipf(1.4, size=200_000)
+        tenant_seq = ((zipf - 1) % tenants).tolist()
+
+        def good_sql(idx: int) -> str:
+            return f"SELECT COUNT(*), SUM(value) FROM {names[idx]}"
+
+        def run_good_phase(duration_s: float, offset: int):
+            """good_threads workers draw tenants from the zipf stream for
+            duration_s; returns (latencies_ms, per-tenant attempts,
+            per-tenant successes)."""
+            lats: list = []
+            attempts: dict = {}
+            successes: dict = {}
+            lock = threading.Lock()
+            stop_at = time.perf_counter() + duration_s
+
+            def worker(wi: int) -> None:
+                pos = offset + wi * 50_000 // good_threads
+                while time.perf_counter() < stop_at:
+                    idx = tenant_seq[pos % len(tenant_seq)]
+                    pos += 1
+                    q0 = time.perf_counter()
+                    try:
+                        cluster.query(good_sql(idx))
+                        ok = True
+                    except Exception:
+                        ok = False
+                    dt = (time.perf_counter() - q0) * 1000
+                    with lock:
+                        attempts[idx] = attempts.get(idx, 0) + 1
+                        if ok:
+                            successes[idx] = successes.get(idx, 0) + 1
+                            lats.append(dt)
+
+            threads = [threading.Thread(target=worker, args=(wi,))
+                       for wi in range(good_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return lats, attempts, successes
+
+        def p99(lats) -> float:
+            if not lats:
+                return 0.0
+            lats = sorted(lats)
+            return lats[int(0.99 * (len(lats) - 1))]
+
+        # warm the compile caches off the clock
+        for idx in (0, 1, 2):
+            cluster.query(good_sql(idx))
+        cluster.query(hog_sql)
+
+        # phase A: unloaded baseline p99 of the good-tenant mix
+        unloaded_lats, _, _ = run_good_phase(phase_s, offset=0)
+        unloaded_p99 = p99(unloaded_lats)
+
+        # phase B: admission on, hog flood + concurrent ingest + good mix.
+        # The latency threshold keys off the measured unloaded p99: once a
+        # few admitted hog scans inflate the recent dispatch p99 past it the
+        # machine parks in SHEDDING and the expensive class stays shed.
+        cluster.catalog.put_property(
+            "clusterConfig/broker.admission.enabled", "true")
+        cluster.catalog.put_property(
+            "clusterConfig/broker.admission.queue.high", "6")
+        cluster.catalog.put_property(
+            "clusterConfig/broker.admission.queue.max", "48")
+        cluster.catalog.put_property(
+            "clusterConfig/broker.admission.latency.ms",
+            str(max(2.0 * unloaded_p99, 15.0)))
+        stop = threading.Event()
+        hog_counts = {"attempts": 0, "shed": 0}
+        hog_lock = threading.Lock()
+
+        def hog_worker() -> None:
+            while not stop.is_set():
+                try:
+                    cluster.query(hog_sql)
+                    shed = False
+                except Exception as e:
+                    # a well-formed client honors the 429's Retry-After hint
+                    # instead of hammering; cap it so the flood stays a flood
+                    shed = True
+                    hint = getattr(e, "retry_after_ms", None)
+                    wait_s = (min(float(hint), 50.0) / 1000.0
+                              if hint else 0.02)
+                    stop.wait(wait_s)
+                with hog_lock:
+                    hog_counts["attempts"] += 1
+                    hog_counts["shed"] += int(shed)
+
+        def ingest_worker() -> None:
+            j = 0
+            while not stop.is_set():
+                cluster.ingest_columns(hog_cfg, {
+                    "user": [f"g{j}_{k}" for k in range(256)],
+                    "value": [0.5] * 256})
+                j += 1
+                stop.wait(0.2)
+
+        background = ([threading.Thread(target=hog_worker)
+                       for _ in range(hog_threads)]
+                      + [threading.Thread(target=ingest_worker)])
+        for t in background:
+            t.start()
+        b0 = time.perf_counter()
+        loaded_lats, attempts, successes = run_good_phase(
+            phase_s, offset=50_000)
+        stop.set()
+        for t in background:
+            t.join()
+        b_elapsed = time.perf_counter() - b0
+
+        snap = cluster.broker.admission.snapshot()
+        arrivals = snap["admitted"] + snap["sheds"]
+        shed_rate = snap["sheds"] / arrivals if arrivals else 0.0
+        # Jain's fairness index over the good tenants' per-tenant success
+        # ratios: (sum x)^2 / (n * sum x^2); 1.0 = every tenant served evenly
+        ratios = [successes.get(i, 0) / attempts[i]
+                  for i in attempts if attempts[i] > 0]
+        fairness = ((sum(ratios) ** 2 / (len(ratios) * sum(r * r
+                     for r in ratios))) if ratios and sum(ratios) else 0.0)
+        good_qps = len(loaded_lats) / b_elapsed if b_elapsed else 0.0
+        return {
+            "soak_tenants": tenants,
+            "soak_unloaded_p99_ms": round(unloaded_p99, 3),
+            "overload_protected_p99_ms": round(p99(loaded_lats), 3),
+            "soak_p99_ratio": round(p99(loaded_lats) / unloaded_p99, 3)
+            if unloaded_p99 else None,
+            "shed_rate": round(shed_rate, 4),
+            "tenant_fairness_index": round(fairness, 4),
+            # every worker is a closed-loop saturated client, so offered
+            # demand is the thread count: the unloaded baseline ran
+            # good_threads of them, overload adds hog_threads more
+            "soak_overload_factor": round(
+                (good_threads + hog_threads) / good_threads, 2),
+            "soak_good_qps_under_overload": round(good_qps, 1),
+            "soak_hog_attempts": hog_counts["attempts"],
+            "soak_hog_shed": hog_counts["shed"],
+            "soak_admission_state": snap["state"],
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def relay_floor_ms(iters=7) -> float:
     """Median dispatch+fetch of a TRIVIAL kernel: the transport's per-query
     latency floor. Published next to p50 so engine overhead (p50 - floor) is
@@ -1501,6 +1702,7 @@ def main():
             "backend": jax.default_backend(),
     }
     detail.update(chaos_bench())
+    detail.update(soak_bench())
     _update_baseline_published(detail, round(q11_rate / n_dev, 1))
     print(json.dumps({
         "metric": "ssb_q1.1_filter_agg_scan_rate",
@@ -1547,5 +1749,7 @@ if __name__ == "__main__":
         run_multichip_lane()
     elif "--chaos" in sys.argv:
         print(json.dumps(chaos_bench(), indent=2))
+    elif "--soak" in sys.argv:
+        print(json.dumps(soak_bench(), indent=2))
     else:
         main()

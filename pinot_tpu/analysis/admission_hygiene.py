@@ -1,0 +1,126 @@
+"""admission-bypass: unbounded fan-out in cluster modules skipping admission.
+
+The overload plane (broker admission controller, per-tenant fair scheduler,
+mux flow-control window) only degrades gracefully if every producer feeds
+work through SOME bound — a maxsize'd queue, a semaphore window, or an
+admission gate. An unbounded `queue.Queue()` or a bare executor `.submit`
+fan-out inside a loop is a pressure-relief bypass: under overload it buffers
+(or spawns) without limit exactly when shedding should happen, turning a
+bounded brown-out into memory growth and silent latency.
+
+Two shapes are flagged, in `cluster/` modules only:
+
+* `queue.Queue()` (or LifoQueue/PriorityQueue) constructed without a positive
+  `maxsize` — an unbounded buffer between producer and consumer;
+* `.submit(...)` on a ThreadPoolExecutor (a name bound to one in the module,
+  or the conventional `executor`/`pool` receivers) inside a loop or
+  comprehension — unbounded fan-out into a bounded pool's queue.
+
+Deliberately bounded sites (a semaphore window upstream, a consumer that
+drains strictly faster than the producer) carry an inline suppression whose
+reason states the actual bound — the rationale is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+#: only the cluster plane is policed: that is where per-query fan-out lives
+#: and where the admission gates are
+_MODULE_MARKER = "cluster/"
+
+_QUEUE_CTORS = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "Queue", "LifoQueue", "PriorityQueue",
+}
+
+#: conventional receiver names treated as executors even when the binding is
+#: not visible in the module (parameter-passed pools)
+_EXECUTOR_NAMES = {"executor", "pool"}
+
+_LOOP_KINDS = (ast.For, ast.While,
+               ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _is_bounded_queue(call: ast.Call) -> bool:
+    """True when the queue constructor carries a positive bound."""
+    if call.args:
+        arg = call.args[0]
+        return not (isinstance(arg, ast.Constant) and arg.value in (0, None))
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            v = kw.value
+            return not (isinstance(v, ast.Constant) and v.value in (0, None))
+    return False
+
+
+def _executor_bindings(tree: ast.AST) -> Set[str]:
+    """Names (or attribute tails: `self._pool` -> `_pool`) assigned from a
+    ThreadPoolExecutor construction anywhere in the module."""
+    names: Set[str] = set(_EXECUTOR_NAMES)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        ctor = dotted_name(node.value.func)
+        if not ctor.endswith("ThreadPoolExecutor"):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                names.add(tgt.attr)
+    return names
+
+
+def _inside_loop(node: ast.AST) -> bool:
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, _LOOP_KINDS):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = getattr(cur, "graft_parent", None)
+    return False
+
+
+class AdmissionBypassRule(Rule):
+    id = "admission-bypass"
+    description = ("unbounded queue.Queue() or looped ThreadPoolExecutor "
+                   ".submit fan-out in cluster/ modules bypassing an "
+                   "admission gate or maxsize bound")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if _MODULE_MARKER not in module.rel:
+            return ()
+        executors = _executor_bindings(module.tree)
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = dotted_name(node.func)
+            if ctor in _QUEUE_CTORS:
+                if not _is_bounded_queue(node):
+                    out.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        f"unbounded `{ctor}()` buffer — pass a maxsize or "
+                        "gate the producer behind an admission bound"))
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "submit":
+                recv = dotted_name(node.func.value)
+                tail = recv.rpartition(".")[2]
+                if recv and tail in executors and _inside_loop(node):
+                    out.append(Finding(
+                        self.id, module.rel, node.lineno,
+                        f"looped `{recv}.submit(...)` fan-out — bound it "
+                        "with a flow-control window or route through the "
+                        "admission gate"))
+        return out
+
+
+def rules() -> List[Rule]:
+    return [AdmissionBypassRule()]

@@ -128,6 +128,9 @@ class FailureDetector:
         pool = ThreadPoolExecutor(max_workers=min(8, len(due)),
                                   thread_name_prefix="fd-probe")
         try:
+            # graftcheck: ignore[admission-bypass] -- fan-out is len(due)
+            # health probes per tick (bounded by cluster size, not query
+            # load) and the pool is shut down before the tick returns
             futs = {s: pool.submit(run_probe, s) for s, _ in due}
             results = {}
             for s, f in futs.items():
@@ -237,7 +240,13 @@ class Broker:
         self._table_sweep_countdown = 0
         self._lock = threading.RLock()
         from ..query.scheduler import QueryQuotaManager
+        from .admission import AdmissionController
         self.quota = QueryQuotaManager(catalog)
+        self.admission = AdmissionController(catalog)
+        # server_id -> monotonic time until which the server is considered in
+        # backpressure (fed by Retry-After hints on 429s); hedges and retry
+        # rounds avoid these servers instead of amplifying their overload
+        self._backpressure_until: Dict[str, float] = {}
         self.failure_detector = FailureDetector(self.routing)
         catalog.register_instance(InstanceInfo(instance_id, "broker"))
 
@@ -297,39 +306,46 @@ class Broker:
         t0 = time.perf_counter()
         tr = None
         table = None
+        # in-flight depth is the admission state machine's primary signal;
+        # begin/end bracket the WHOLE request so multistage joins count too
+        self.admission.begin()
         try:
-            if stmt is None:
-                from ..sql.parser import parse_query
-                stmt = parse_query(sql)
-            stmt = self._rewrite_subqueries(stmt)
-            table = stmt.table
-            trace_on = _truthy(stmt.options.get("trace"))
-            # always-on: the trace records regardless, the sampler only gates
-            # ring retention; OPTION(trace=true) force-samples AND returns the
-            # spans inline (traceInfo), exactly as before
-            with tracing.request_trace(True) as tr:
-                tr.sampled = trace_on or self.trace_sampler.sample(
-                    self._trace_sample_rate())
-                if stmt.joins:
-                    result = (self._explain_multistage(stmt) if stmt.explain
-                              else self._handle_multistage(stmt))
-                else:
-                    result = self._handle_single(stmt, t0)
-                if trace_on:
-                    result.stats["traceInfo"] = tr.to_rows()
-                result.stats["traceId"] = tr.trace_id
-        except Exception:
-            reg.counter("pinot_broker_query_exceptions").inc()
-            elapsed_ms = (time.perf_counter() - t0) * 1000
-            with self._obs_lock:
-                self._query_rollup["numExceptions"] += 1
-            if table:
-                self._table_account(table, elapsed_ms, error=True)
-            if tr is not None and tr.sampled:
-                # errored traces tail-retain so failures are inspectable
-                self.trace_ring.admit(tr, sql=sql, error=True,
-                                      timeUsedMs=round(elapsed_ms, 3))
-            raise
+            try:
+                if stmt is None:
+                    from ..sql.parser import parse_query
+                    stmt = parse_query(sql)
+                stmt = self._rewrite_subqueries(stmt)
+                table = stmt.table
+                trace_on = _truthy(stmt.options.get("trace"))
+                # always-on: the trace records regardless, the sampler only
+                # gates ring retention; OPTION(trace=true) force-samples AND
+                # returns the spans inline (traceInfo), exactly as before
+                with tracing.request_trace(True) as tr:
+                    tr.sampled = trace_on or self.trace_sampler.sample(
+                        self._trace_sample_rate())
+                    if stmt.joins:
+                        result = (self._explain_multistage(stmt)
+                                  if stmt.explain
+                                  else self._handle_multistage(stmt))
+                    else:
+                        result = self._handle_single(stmt, t0)
+                    if trace_on:
+                        result.stats["traceInfo"] = tr.to_rows()
+                    result.stats["traceId"] = tr.trace_id
+            except Exception:
+                reg.counter("pinot_broker_query_exceptions").inc()
+                elapsed_ms = (time.perf_counter() - t0) * 1000
+                with self._obs_lock:
+                    self._query_rollup["numExceptions"] += 1
+                if table:
+                    self._table_account(table, elapsed_ms, error=True)
+                if tr is not None and tr.sampled:
+                    # errored traces tail-retain so failures are inspectable
+                    self.trace_ring.admit(tr, sql=sql, error=True,
+                                          timeUsedMs=round(elapsed_ms, 3))
+                raise
+        finally:
+            self.admission.end()
         elapsed_ms = (time.perf_counter() - t0) * 1000
         result.stats["timeUsedMs"] = round(elapsed_ms, 3)
         reg.counter("pinot_broker_queries").inc()
@@ -530,6 +546,7 @@ class Broker:
             "brokerMetrics": {k: v for k, v in sorted(snap.items())
                               if k.startswith("pinot_broker_")},
             "failureDetector": self.failure_detector.snapshot(),
+            "admission": self.admission.snapshot(),
             "hedgedRequests": int(
                 reg.counter("pinot_broker_hedged_requests").value),
             "gaugeHistories": get_registry().gauge_histories("pinot_broker"),
@@ -612,6 +629,17 @@ class Broker:
         if ctx.explain:
             return self._handle_explain(ctx, physical)
 
+        # adaptive admission: the shed-state machine plus the deadline-budget
+        # check (placed after the deadline stamp so the budget is visible). A
+        # shed refunds the QPS tokens taken above — a rejected query must not
+        # burn its table's quota
+        try:
+            self.admission.admit(raw_table, ctx)
+        except Exception:
+            for t in physical:
+                self.quota.refund(t)
+            raise
+
         if self._should_distribute_groupby(ctx, physical):
             from ..multistage.shuffle import P2PUnavailable, coordinate_groupby
             try:
@@ -687,7 +715,7 @@ class Broker:
                 # round on the other replicas keeps results complete instead
                 # of silently short (counts must never regress mid-commit)
                 retry_results, retry_failed = self._retry_missing(
-                    table, ctx, missing, tf, _traced)
+                    table, ctx, missing, tf, _traced, exec_stats=exec_stats)
                 partials.extend(r for r, _ in retry_results)
                 for r, _ in retry_results:
                     exec_stats.merge(r.stats)
@@ -775,6 +803,14 @@ class Broker:
             get_registry().counter("pinot_broker_queries_throttled").inc()
             raise QueryRejectedError(
                 f"table {probe.table!r} exceeded its query quota")
+        try:
+            # streaming exports are selection scans — exactly the expensive
+            # class the SHEDDING state exists to shed first
+            self.admission.admit(probe.table, probe)
+        except Exception:
+            for t in physical:
+                self.quota.refund(t)
+            raise
         get_registry().counter("pinot_broker_queries").inc()
         schema = self.catalog.schemas.get(
             self.catalog.table_configs[physical[0]].name)
@@ -897,17 +933,45 @@ class Broker:
             budget = 2
         return True, delay_ms / 1000.0, max(0, budget)
 
+    #: how long a 429 without a Retry-After hint keeps a server out of the
+    #: hedge/retry candidate set
+    BACKPRESSURE_DEFAULT_S = 0.25
+    #: ceiling on honored Retry-After hints (a misbehaving server must not be
+    #: able to exempt itself from traffic indefinitely)
+    BACKPRESSURE_MAX_S = 5.0
+
+    def _note_backpressure(self, server_id: str,
+                           hint_ms: Optional[float]) -> None:
+        """Remember a 429's Retry-After: the server stays out of hedge and
+        retry candidate sets until the hint expires."""
+        hold_s = (min(hint_ms / 1000.0, self.BACKPRESSURE_MAX_S)
+                  if hint_ms is not None and hint_ms > 0
+                  else self.BACKPRESSURE_DEFAULT_S)
+        self._backpressure_until[server_id] = time.monotonic() + hold_s
+
+    def _backpressured_servers(self) -> Set[str]:
+        now = time.monotonic()
+        expired = [s for s, t in list(self._backpressure_until.items())
+                   if t <= now]
+        for s in expired:
+            self._backpressure_until.pop(s, None)
+        return {s for s, t in list(self._backpressure_until.items())
+                if t > now}
+
     def _hedge_target(self, table: str, primary: str,
                       segments: Sequence[str]) -> Optional[str]:
         """An alternate healthy registered replica serving EVERY segment of
         the unit, or None (a unit spanning replica groups can't hedge as one
-        dispatch — it stays on the retry-round path instead)."""
+        dispatch — it stays on the retry-round path instead). Replicas in
+        backpressure are excluded: a hedge against an already-shedding server
+        only deepens its overload."""
         unhealthy = self.routing.unhealthy_servers()
+        backpressured = self._backpressured_servers()
         candidates: Optional[Set[str]] = None
         for seg in segments:
             cands = {c for c in self.routing.segment_candidates(table, seg)
                      if c != primary and c in self._servers
-                     and c not in unhealthy}
+                     and c not in unhealthy and c not in backpressured}
             candidates = cands if candidates is None else candidates & cands
             if not candidates:
                 return None
@@ -934,6 +998,12 @@ class Broker:
         reg = get_registry()
         disp_hist = reg.histogram("pinot_broker_dispatch_latency_ms")
         hedge_on, hedge_delay_s, hedge_budget = self._hedge_params()
+        if hedge_on and self.admission.overloaded():
+            # degradation, not amplification: while the broker itself is
+            # shedding, duplicating dispatches would double the very load
+            # that pushed it past HEALTHY
+            hedge_on = False
+            reg.counter("pinot_broker_hedges_suppressed").inc()
         hedges_sent = 0
         queried = failed = 0
         owner: Dict[Future, _DispatchUnit] = {u.primary: u for u in units}
@@ -945,7 +1015,11 @@ class Broker:
             if _is_transport_failure(exc):
                 self.routing.mark_server_unhealthy(server_id)
                 self.failure_detector.notify_unhealthy(server_id)
-            elif not _is_backpressure(exc):
+            elif _is_backpressure(exc):
+                # the server is working as designed — remember its Retry-After
+                # so hedges/retries back off instead of re-hitting the 429
+                self._note_backpressure(server_id, _retry_after_ms(exc))
+            else:
                 query_errors.append(exc)          # type: ignore[arg-type]
                 error_segments.update(u.segments)
 
@@ -1043,8 +1117,11 @@ class Broker:
                     missing[seg].add(u.hedge_server)
         return queried, failed
 
+    #: cap on how long a retry round waits out replicas' Retry-After hints
+    RETRY_DEFER_CAP_S = 0.5
+
     def _retry_missing(self, table: str, ctx, missing: Dict[str, Set[str]],
-                       tf: Optional[str], traced
+                       tf: Optional[str], traced, exec_stats=None
                        ) -> Tuple[List[Tuple[SegmentResult, List[str]]], int]:
         """One retry round for segments a routed replica didn't serve: dispatch
         each to a different healthy replica, in parallel on the scatter pool
@@ -1062,13 +1139,33 @@ class Broker:
         error) instead."""
         if self.routing.selector_for(table) == "strictreplicagroup":
             return [], 0
+        backpressured = self._backpressured_servers()
+        now = time.monotonic()
         by_server: Dict[str, List[str]] = {}
+        defer_until = 0.0
         for seg, missed_on in missing.items():
-            for cand in self.routing.segment_candidates(table, seg):
-                if cand not in missed_on and cand in self._servers \
-                        and cand not in self.routing.unhealthy_servers():
-                    by_server.setdefault(cand, []).append(seg)
-                    break
+            cands = [c for c in self.routing.segment_candidates(table, seg)
+                     if c not in missed_on and c in self._servers
+                     and c not in self.routing.unhealthy_servers()]
+            ready = [c for c in cands if c not in backpressured]
+            if ready:
+                by_server.setdefault(ready[0], []).append(seg)
+            elif cands:
+                # every live replica is in backpressure: honor the soonest
+                # Retry-After instead of retrying blind into another 429
+                c = min(cands,
+                        key=lambda s: self._backpressure_until.get(s, 0.0))
+                defer_until = max(defer_until,
+                                  self._backpressure_until.get(c, 0.0))
+                by_server.setdefault(c, []).append(seg)
+        if defer_until > now:
+            delay = min(defer_until - now, self.RETRY_DEFER_CAP_S,
+                        max(0.0, _deadline_remaining_s(ctx)))
+            if delay > 0:
+                time.sleep(delay)
+                if exec_stats is not None:
+                    exec_stats.add(qstats.ADMISSION_DEFER_MS,
+                                   round(delay * 1000, 3))
         futures = {self._dispatch_partial(self._servers[s], s, traced, table,
                                           ctx, segs, tf): (s, segs)
                    for s, segs in by_server.items()}
@@ -1547,6 +1644,38 @@ def _is_backpressure(e: BaseException) -> bool:
         return True
     from .http_service import HttpError
     return isinstance(e, HttpError) and getattr(e, "status", None) in (408, 429)
+
+
+def _retry_after_ms(e: BaseException) -> Optional[float]:
+    """Retry-After hint carried by a backpressure error: the attribute set by
+    the scheduler / mux decoder when present, else parsed out of a legacy
+    HttpError message (whose text is the raw 429 JSON body)."""
+    v = getattr(e, "retry_after_ms", None)
+    if v is not None:
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+    s = str(e)
+    i = s.find("{")
+    if i >= 0:
+        try:
+            v = json.loads(s[i:]).get("retryAfterMs")
+            return float(v) if v is not None else None
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def _deadline_remaining_s(ctx) -> float:
+    """Seconds left on the query's absolute deadline (inf when unstamped)."""
+    d = (ctx.options or {}).get("deadlineEpochMs")
+    if d is None:
+        return float("inf")
+    try:
+        return float(d) / 1000.0 - time.time()
+    except (TypeError, ValueError):
+        return float("inf")
 
 
 def _is_transport_failure(e: BaseException) -> bool:

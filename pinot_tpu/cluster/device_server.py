@@ -103,6 +103,9 @@ class DeviceQueryPipeline:
         self.max_batch = max_batch
         self.submit_timeout_s = submit_timeout_s
         self.stack = stack
+        # graftcheck: ignore[admission-bypass] -- producers block in submit()
+        # with submit_timeout_s and the dispatcher drains continuously; the
+        # real bound is _fetchq's max_inflight window right below
         self._q: "queue.Queue[_Item]" = queue.Queue()
         # dispatched-but-unfetched batches: bounded so a slow fetch applies
         # backpressure to dispatch instead of piling device work up

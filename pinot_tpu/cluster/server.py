@@ -96,6 +96,10 @@ class ServerNode:
         # which drive pump/complete deterministically
         self.auto_consume = auto_consume
         self.tables: Dict[str, TableDataManager] = {}
+        # per-table EWMA of bytesFetched per partial: the scheduler's fair
+        # queue charges each tenant by predicted bytes so a scan-heavy table
+        # consumes its share faster than a cheap-aggregation one
+        self._table_bytes_ewma: Dict[str, float] = {}
         self._lock = threading.RLock()
         self._realtime_managers: Dict[str, object] = {}
         self._load_locks: Dict[tuple, threading.Lock] = {}
@@ -421,6 +425,15 @@ class ServerNode:
             return self.tables[table]
 
     # -- query execution ---------------------------------------------------
+
+    #: minimum remaining deadline budget accepted at submit: below this the
+    #: queue hop alone would eat the budget, so the query rejects typed (408
+    #: with the stamped deadline) instead of enqueueing doomed work
+    MIN_DEADLINE_BUDGET_S = 0.005
+
+    #: EWMA smoothing for the per-table bytesFetched estimate
+    _BYTES_EWMA_ALPHA = 0.2
+
     def execute_partial(self, table: str, ctx: Union[str, QueryContext],
                         segment_names: Optional[Sequence[str]] = None,
                         time_filter: Optional[str] = None) -> SegmentResult:
@@ -447,11 +460,19 @@ class ServerNode:
         # fails typed NOW instead of burning scheduler and device time on an
         # answer nobody is waiting for
         remaining_s = _deadline_remaining_s(ctx)
-        if remaining_s is not None and remaining_s <= 0:
+        if remaining_s is not None and remaining_s <= self.MIN_DEADLINE_BUDGET_S:
+            # admission-time rejection: a query whose budget is already spent
+            # (or too thin to survive even the queue hop) fails typed NOW with
+            # the stamped deadline attached, so the 408 body tells the caller
+            # WHICH deadline was missed instead of burning a scheduler slot
             from ..query.scheduler import QueryTimeoutError
-            raise QueryTimeoutError(
-                f"query deadline already passed by {-remaining_s:.3f}s "
-                f"at {self.instance_id}")
+            d_ms = ctx.options.get("deadlineEpochMs") if ctx.options else None
+            err = QueryTimeoutError(
+                f"query deadline budget exhausted ({remaining_s * 1000:.1f}ms "
+                f"remaining, floor {self.MIN_DEADLINE_BUDGET_S * 1000:.0f}ms) "
+                f"at {self.instance_id}",
+                deadline_epoch_ms=float(d_ms) if d_ms is not None else None)
+            raise err
         if self.scheduler is not None:
             timeout_s = None
             t_ms = ctx.options.get("timeoutMs") if ctx.options else None
@@ -478,8 +499,34 @@ class ServerNode:
                           depth=depth)
                 with tr.activate(depth=depth):
                     return self._execute_partial(table, ctx, segment_names)
-            return self.scheduler.submit(table, run, timeout_s=timeout_s)
-        return self._execute_partial(table, ctx, segment_names)
+            result = self.scheduler.submit(
+                table, run, timeout_s=timeout_s,
+                cost_bytes=self._predicted_bytes(table))
+            self._observe_bytes(table, result)
+            return result
+        result = self._execute_partial(table, ctx, segment_names)
+        self._observe_bytes(table, result)
+        return result
+
+    def _predicted_bytes(self, table: str) -> float:
+        """The fair scheduler's per-query byte cost for `table`: the EWMA of
+        recent partials' bytesFetched (0.0 until the first completes — an
+        unknown tenant is charged the 1.0 base cost only)."""
+        with self._lock:
+            return self._table_bytes_ewma.get(table, 0.0)
+
+    def _observe_bytes(self, table: str, result) -> None:
+        stats = getattr(result, "stats", None)
+        if not isinstance(stats, dict):
+            return
+        try:
+            b = float(stats.get(qstats.BYTES_FETCHED, 0.0))
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            prev = self._table_bytes_ewma.get(table)
+            self._table_bytes_ewma[table] = b if prev is None else \
+                prev + self._BYTES_EWMA_ALPHA * (b - prev)
 
     def _execute_partial(self, table: str, ctx: QueryContext,
                          segment_names: Optional[Sequence[str]]) -> SegmentResult:

@@ -769,6 +769,29 @@ class ServerService:
                     streams_gauge.set(self._mux_open)
         return 200, "application/octet-stream", gen()
 
+    def _reject_body(self, e) -> dict:
+        """429 body: the error plus a Retry-After hint. The scheduler stamps
+        its drain-rate estimate on the exception; when absent (e.g. a quota
+        bucket rejection) fall back to asking the scheduler directly so every
+        429 tells the client WHEN retrying could succeed."""
+        body = {"error": str(e)}
+        hint = getattr(e, "retry_after_ms", None)
+        if hint is None and self.server.scheduler is not None:
+            hint = self.server.scheduler.retry_after_ms()
+        if hint is not None:
+            body["retryAfterMs"] = round(float(hint), 3)
+        return body
+
+    @staticmethod
+    def _timeout_body(e) -> dict:
+        """408 body: the error plus the absolute deadline that expired, so the
+        client can see exactly how stale its budget was."""
+        body = {"error": str(e)}
+        d = getattr(e, "deadline_epoch_ms", None)
+        if d is not None:
+            body["deadlineEpochMs"] = round(float(d), 3)
+        return body
+
     def _mux_execute(self, payload, flow_wait_ms):
         """One mux request frame -> (status, response parts). Mirrors
         `_query` exactly — same ACL check, trace-splice surface, and
@@ -802,9 +825,9 @@ class ServerService:
                     req["table"], req["sql"], req["segments"],
                     time_filter=req.get("timeFilter"))
         except QueryRejectedError as e:  # backpressure, not a server fault
-            return 429, [json.dumps({"error": str(e)}).encode()]
+            return 429, [json.dumps(self._reject_body(e)).encode()]
         except QueryTimeoutError as e:
-            return 408, [json.dumps({"error": str(e)}).encode()]
+            return 408, [json.dumps(self._timeout_body(e)).encode()]
         if flow_wait_ms:
             stats = result.stats if isinstance(result.stats, dict) else {}
             stats[MUX_FLOW_CONTROL_MS] = round(
@@ -840,9 +863,11 @@ class ServerService:
                     req["table"], req["sql"], req["segments"],
                     time_filter=req.get("timeFilter"))
         except QueryRejectedError as e:   # backpressure, not a server fault
-            return error_response(str(e), 429)
+            return 429, "application/json", json.dumps(
+                self._reject_body(e)).encode()
         except QueryTimeoutError as e:
-            return error_response(str(e), 408)
+            return 408, "application/json", json.dumps(
+                self._timeout_body(e)).encode()
         spans = None
         if tr is not None:
             # prefix with this server's id so the broker's spliced view reads like

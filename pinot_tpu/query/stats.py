@@ -50,6 +50,7 @@ MUX_FLOW_CONTROL_MS = "muxFlowControlMs"
 COLLECTIVE_MS = "collectiveMs"
 DEVICE_SKEW_PCT = "deviceSkewPct"
 HEDGED_REQUESTS = "hedgedRequests"
+ADMISSION_DEFER_MS = "admissionDeferMs"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -59,7 +60,7 @@ COUNTER_KEYS = (
     COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
     NUM_CONSUMING_SEGMENTS_QUERIED, MUX_FRAME_QUEUE_MS, MUX_FLOW_CONTROL_MS,
-    COLLECTIVE_MS, HEDGED_REQUESTS,
+    COLLECTIVE_MS, HEDGED_REQUESTS, ADMISSION_DEFER_MS,
 )
 
 # keys that merge by MINIMUM instead of sum (reference: the broker reduces

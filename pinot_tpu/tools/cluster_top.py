@@ -7,7 +7,9 @@ the controller's ingestion verdict, and its SLO burn-rate verdict — plus a
 top-consumers panel attributing device time / bytes / queue wait per table
 from the broker rollups, and a servers panel showing the broker failure
 detector's view (healthy vs probing, consecutive probe failures, seconds to
-the next probe) with the lifetime hedged-request count in the header. The
+the next probe) with the lifetime hedged-request count in the header, and an
+admission panel showing the broker's shed state, in-flight depth against its
+queue thresholds, and per-table/per-reason shed counts. The
 operator's first stop when a dashboard shows a table going stale, an SLO
 burning, or a server flapping:
 
@@ -71,6 +73,8 @@ def snapshot(controller_url: str, broker_url: Optional[str],
             # failure-detector probe states + hedge count (robustness panel)
             out["failureDetector"] = debug.get("failureDetector") or {}
             out["hedgedRequests"] = debug.get("hedgedRequests", 0)
+            # adaptive-admission shed state (overload panel)
+            out["admission"] = debug.get("admission") or {}
         except Exception as e:
             out["errors"].append(f"broker /debug: {e}")
     try:
@@ -148,6 +152,29 @@ def render(snap: Dict[str, Any]) -> str:
                 f"{r.get('p99LatencyMs', 0):>8} "
                 f"{int(r.get('numSlowQueries', 0)):>5} "
                 f"{int(r.get('numErrors', 0)):>4}")
+    admission = snap.get("admission") or {}
+    if admission:
+        lines.append("")
+        state = admission.get("state", "?")
+        flag = "" if admission.get("enabled") else " (disabled)"
+        lines.append(
+            f"admission{flag}: {state}"
+            f"  inflight={admission.get('inflight', 0)}"
+            f"/{admission.get('queueHigh', '?')}"
+            f"/{admission.get('queueMax', '?')}"
+            f"  admitted={admission.get('admitted', 0)}"
+            f"  sheds={admission.get('sheds', 0)}"
+            f"  p99={admission.get('predictedServiceMs', 0)}ms"
+            f"(n={admission.get('predictionSamples', 0)})")
+        by_table = admission.get("shedByTable") or {}
+        if by_table:
+            ranked = sorted(by_table.items(), key=lambda kv: -kv[1])[:5]
+            shed_reasons = admission.get("shedByReason") or {}
+            reasons = " ".join(f"{k}={v}" for k, v in
+                               sorted(shed_reasons.items()))
+            lines.append("  shed by table: " +
+                         " ".join(f"{t}={n}" for t, n in ranked) +
+                         (f"   by reason: {reasons}" if reasons else ""))
     detector = snap.get("failureDetector") or {}
     if detector:
         lines.append("")

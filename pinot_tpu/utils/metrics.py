@@ -106,7 +106,13 @@ class Histogram:
     DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
                        1000.0, 2500.0, 5000.0, 10000.0)
 
-    __slots__ = ("buckets", "bucket_counts", "count", "total", "max", "_lock")
+    #: rotation period for the recent-window view: `recent_percentile` reads
+    #: the last 1-2 windows, so an overload spike ages out of admission
+    #: decisions within ~2 windows instead of polluting the lifetime quantile
+    WINDOW_S = 60.0
+
+    __slots__ = ("buckets", "bucket_counts", "count", "total", "max", "_lock",
+                 "_win_counts", "_prev_counts", "_win_started")
 
     def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
         self.buckets = tuple(buckets) if buckets else self.DEFAULT_BUCKETS
@@ -114,13 +120,31 @@ class Histogram:
         self.count = 0
         self.total = 0.0
         self.max = 0.0
+        self._win_counts = [0] * (len(self.buckets) + 1)
+        self._prev_counts = [0] * (len(self.buckets) + 1)
+        self._win_started = time.monotonic()
         self._lock = threading.Lock()
+
+    def _rotate_locked(self, now: float) -> None:
+        age = now - self._win_started
+        if age < self.WINDOW_S:
+            return
+        zeros = [0] * len(self.bucket_counts)
+        # one stale window becomes "previous"; two or more means both views
+        # predate the window and are dropped entirely
+        self._prev_counts = self._win_counts if age < 2 * self.WINDOW_S else zeros
+        # graftcheck: ignore[lock-unguarded-write] -- _locked suffix is the
+        # contract: every caller (observe, percentile paths) already holds
+        # self._lock around this rotation
+        self._win_counts = list(zeros)
+        self._win_started = now
 
     def observe(self, v: float) -> None:
         # the whole observe runs under the lock: scanning outside it let a
         # concurrent snapshot/render see count incremented before the bucket
         # row, breaking the cumulative-bucket invariant readers rely on
         with self._lock:
+            self._rotate_locked(time.monotonic())
             i = 0
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
@@ -128,22 +152,40 @@ class Histogram:
             else:
                 i = len(self.buckets)
             self.bucket_counts[i] += 1
+            self._win_counts[i] += 1
             self.count += 1
             self.total += v
             self.max = max(self.max, v)
 
+    def _percentile_locked(self, q: float, counts, total: int) -> float:
+        if not total:
+            return 0.0
+        target = q * total
+        cum = 0
+        for i, n in enumerate(counts):
+            cum += n
+            if cum >= target:
+                return self.buckets[i] if i < len(self.buckets) else self.max
+        return self.max
+
     def percentile(self, q: float) -> float:
         """Upper-bound estimate of the q-quantile (0 < q <= 1) from buckets."""
         with self._lock:
-            if not self.count:
-                return 0.0
-            target = q * self.count
-            cum = 0
-            for i, n in enumerate(self.bucket_counts):
-                cum += n
-                if cum >= target:
-                    return self.buckets[i] if i < len(self.buckets) else self.max
-            return self.max
+            return self._percentile_locked(q, self.bucket_counts, self.count)
+
+    def recent_percentile(self, q: float) -> Tuple[float, int]:
+        """Quantile over the last 1-2 rotation windows (see WINDOW_S), plus the
+        sample count it was computed from so callers can gate on confidence.
+        Falls back to the lifetime quantile (count included) while the window
+        is empty."""
+        with self._lock:
+            self._rotate_locked(time.monotonic())
+            counts = [a + b for a, b in zip(self._prev_counts, self._win_counts)]
+            total = sum(counts)
+            if not total:
+                return (self._percentile_locked(q, self.bucket_counts,
+                                                self.count), self.count)
+            return self._percentile_locked(q, counts, total), total
 
     @property
     def mean(self) -> float:

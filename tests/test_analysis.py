@@ -14,10 +14,10 @@ import pytest
 
 from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
-from pinot_tpu.analysis import (blocking_in_loop, collective_hygiene,
-                                drift_guards, exception_hygiene,
-                                ingest_hot_loop, jit_hygiene, lock_discipline,
-                                transport_bypass)
+from pinot_tpu.analysis import (admission_hygiene, blocking_in_loop,
+                                collective_hygiene, drift_guards,
+                                exception_hygiene, ingest_hot_loop,
+                                jit_hygiene, lock_discipline, transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -600,6 +600,78 @@ def test_exception_hygiene_suppression_honored():
     """, exception_hygiene.rules())
     assert active == []
     assert _ids(suppressed) == ["exception-hygiene"]
+
+
+# -- admission-bypass ---------------------------------------------------------
+
+_CLUSTER_REL = "pinot_tpu/cluster/fixture.py"
+
+
+def test_admission_bypass_unbounded_queue_true_positive():
+    active, _ = _check("""
+        import queue
+        class Dispatcher:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._lifo = queue.LifoQueue(maxsize=0)
+    """, admission_hygiene.rules(), rel=_CLUSTER_REL)
+    assert _ids(active) == ["admission-bypass"] * 2
+
+
+def test_admission_bypass_looped_submit_true_positive():
+    active, _ = _check("""
+        from concurrent.futures import ThreadPoolExecutor
+        class Broker:
+            def __init__(self):
+                self._scatter = ThreadPoolExecutor(max_workers=4)
+            def fan_out(self, units):
+                for u in units:
+                    self._scatter.submit(u.run)
+            def comprehension(self, units, pool):
+                return [pool.submit(u.run) for u in units]
+    """, admission_hygiene.rules(), rel=_CLUSTER_REL)
+    assert _ids(active) == ["admission-bypass"] * 2
+
+
+def test_admission_bypass_clean_negatives():
+    # bounded queues, non-loop submits, and non-executor .submit receivers
+    active, _ = _check("""
+        import queue
+        from concurrent.futures import ThreadPoolExecutor
+        class Dispatcher:
+            def __init__(self, scheduler):
+                self._q = queue.Queue(maxsize=64)
+                self._prio = queue.PriorityQueue(128)
+                self._pool = ThreadPoolExecutor(max_workers=4)
+                self.scheduler = scheduler
+            def one_shot(self, task):
+                return self._pool.submit(task)          # not fanned out
+            def gated(self, tasks):
+                for t in tasks:
+                    self.scheduler.submit("tbl", t)     # the admission gate
+    """, admission_hygiene.rules(), rel=_CLUSTER_REL)
+    assert active == []
+
+
+def test_admission_bypass_scoped_to_cluster_modules():
+    active, _ = _check("""
+        import queue
+        q = queue.Queue()
+    """, admission_hygiene.rules())                      # default scratch rel
+    assert active == []
+
+
+def test_admission_bypass_suppression_honored():
+    active, suppressed = _check("""
+        import queue
+        class Dispatcher:
+            def __init__(self):
+                # graftcheck: ignore[admission-bypass] -- drained by a bounded
+                # flow-control window downstream
+                self._q = queue.Queue()
+    """, admission_hygiene.rules(), rel=_CLUSTER_REL)
+    assert active == []
+    assert _ids(suppressed) == ["admission-bypass"]
 
 
 # -- suppression mechanics ----------------------------------------------------

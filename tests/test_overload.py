@@ -1,0 +1,641 @@
+"""Overload-robustness plane tests: adaptive broker admission (shed-state
+machine, deadline-budget shed), the weighted-fair per-tenant scheduler, and
+the Retry-After plumbing that turns sheds into typed, retryable backpressure.
+
+Reference scenarios: the broker-side admission gates in front of
+BaseBrokerRequestHandler, per-query-group fair scheduling in
+QuerySchedulerFactory, and 429/Retry-After semantics on the server APIs.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.cluster.admission import (HEALTHY, SATURATED, SHEDDING,
+                                         AdmissionController)
+from pinot_tpu.cluster.http_service import HttpError
+from pinot_tpu.query.scheduler import (QueryQuotaManager, QueryRejectedError,
+                                       QueryScheduler, QueryTimeoutError,
+                                       TokenBucket, scheduler_from_config)
+
+
+class _Catalog:
+    """clusterConfig stub for exercising AdmissionController knobs."""
+
+    def __init__(self, **props):
+        self.props = {f"clusterConfig/{k}": v for k, v in props.items()}
+
+    def get_property(self, key, default=None):
+        return self.props.get(key, default)
+
+
+class _Ctx:
+    """QueryContext stub: just the fields the expensive classifier reads."""
+
+    def __init__(self, agg=False, group_by=(), limit=10, options=None):
+        self.is_aggregation_query = agg
+        self.group_by = list(group_by)
+        self.limit = limit
+        self.options = dict(options or {})
+
+
+def _cheap():
+    return _Ctx(agg=True, limit=10)
+
+
+def _expensive():
+    return _Ctx(agg=False, limit=100_000)
+
+
+# -- admission state machine --------------------------------------------------
+
+def test_admission_disabled_is_noop():
+    ac = AdmissionController(_Catalog())
+    for _ in range(100):
+        ac.begin()
+    ac.admit("t", _expensive())   # never sheds while the knob is off
+    assert ac.state() == HEALTHY
+    assert not ac.overloaded()
+
+
+def test_admission_shed_states_and_hysteresis():
+    ac = AdmissionController(_Catalog(**{
+        "broker.admission.enabled": "true",
+        "broker.admission.queue.high": "2",
+        "broker.admission.queue.max": "4"}))
+    ac.admit("t", _cheap())
+    assert ac.state() == HEALTHY
+
+    ac.begin()
+    ac.begin()                     # depth 2 >= high -> SHEDDING
+    with pytest.raises(QueryRejectedError) as ei:
+        ac.admit("hog", _expensive())
+    assert "query shed (expensive)" in str(ei.value)
+    assert ei.value.retry_after_ms is not None
+    ac.admit("good", _cheap())     # cheap served path keeps admitting
+    assert ac.state() == SHEDDING
+    assert ac.overloaded()
+
+    ac.begin()
+    ac.begin()                     # depth 4 >= max -> SATURATED sheds all
+    with pytest.raises(QueryRejectedError) as ei:
+        ac.admit("good", _cheap())
+    assert "query shed (saturated)" in str(ei.value)
+    assert ei.value.retry_after_ms is not None
+    assert ac.state() == SATURATED
+
+    ac.end()
+    ac.end()                       # depth 2 > high/2: hysteresis holds SHEDDING
+    with pytest.raises(QueryRejectedError):
+        ac.admit("hog", _expensive())
+    assert ac.state() == SHEDDING
+
+    ac.end()                       # depth 1 <= high/2: recovered
+    ac.admit("hog", _expensive())
+    assert ac.state() == HEALTHY
+
+    snap = ac.snapshot()
+    assert snap["enabled"] is True
+    assert snap["sheds"] == 3
+    assert snap["shedByReason"] == {"expensive": 2, "saturated": 1}
+    assert snap["shedByTable"] == {"hog": 2, "good": 1}
+    assert snap["admitted"] == 3
+    assert snap["queueHigh"] == 2.0 and snap["queueMax"] == 4.0
+
+
+def test_admission_deadline_shed_uses_predicted_service_time():
+    ac = AdmissionController(_Catalog(**{"broker.admission.enabled": "true"}))
+    ac.predicted_service_ms = lambda: (500.0, 64)   # p99 500ms, confident
+    doomed = _Ctx(agg=True, options={
+        "deadlineEpochMs": time.time() * 1000.0 + 50.0})
+    with pytest.raises(QueryRejectedError) as ei:
+        ac.admit("t", doomed)
+    assert "query shed (deadline)" in str(ei.value)
+    # ample budget admits even with the same p99
+    ac.admit("t", _Ctx(agg=True, options={
+        "deadlineEpochMs": time.time() * 1000.0 + 60_000.0}))
+    # thin budget but too few samples: the estimate is not trusted yet
+    ac.predicted_service_ms = lambda: (500.0, 3)
+    ac.admit("t", _Ctx(agg=True, options={
+        "deadlineEpochMs": time.time() * 1000.0 + 50.0}))
+    assert ac.snapshot()["shedByReason"] == {"deadline": 1}
+
+
+def test_admission_latency_signal_joins_when_configured():
+    ac = AdmissionController(_Catalog(**{
+        "broker.admission.enabled": "true",
+        "broker.admission.latency.ms": "100"}))
+    # p99 past the threshold with confidence -> SHEDDING at zero depth
+    ac.predicted_service_ms = lambda: (150.0, 20)
+    with pytest.raises(QueryRejectedError):
+        ac.admit("t", _expensive())
+    assert ac.state() == SHEDDING
+    # same p99 without enough samples: stays depth-driven -> recovers
+    ac.predicted_service_ms = lambda: (150.0, 2)
+    ac.admit("t", _expensive())
+    assert ac.state() == HEALTHY
+
+
+def test_admission_expensive_classifier():
+    ac = AdmissionController(_Catalog(**{"broker.admission.enabled": "true"}))
+    assert ac.is_expensive(_Ctx(agg=False, limit=100_000))
+    assert ac.is_expensive(_Ctx(agg=False, limit=None))     # unbounded scan
+    assert not ac.is_expensive(_Ctx(agg=False, limit=100))
+    assert not ac.is_expensive(_Ctx(agg=True, limit=100_000))
+    assert not ac.is_expensive(_Ctx(agg=False, group_by=["d"],
+                                    limit=100_000))
+
+
+# -- the rotating recent-latency window behind the p99 signal -----------------
+
+def test_histogram_recent_percentile_window_rotation():
+    from pinot_tpu.utils.metrics import Histogram
+    h = Histogram()
+    for _ in range(4):
+        h.observe(10.0)
+    val, n = h.recent_percentile(0.99)
+    assert (val, n) == (10.0, 4)
+    # age the window past WINDOW_S: current becomes "previous", and a fresh
+    # spike joins it in the recent view
+    h._win_started -= h.WINDOW_S + 1
+    h.observe(100.0)
+    val, n = h.recent_percentile(0.99)
+    assert (val, n) == (100.0, 5)
+    # both windows stale: the recent view empties and falls back to lifetime
+    h._win_started -= 2 * h.WINDOW_S + 1
+    val, n = h.recent_percentile(0.99)
+    assert n == h.count == 5
+    assert val == 100.0
+
+
+# -- weighted-fair scheduler --------------------------------------------------
+
+def _drive(sched, plan, release):
+    """Enqueue `plan` tables one by one (each submit blocks its own thread)
+    behind a held worker; returns (threads, executed-order list)."""
+    order = []
+    olock = threading.Lock()
+
+    def runner(table):
+        def fn():
+            with olock:
+                order.append(table)
+        try:
+            sched.submit(table, fn, timeout_s=10.0)
+        except QueryRejectedError:
+            pass
+
+    threads = []
+    for i, table in enumerate(plan):
+        t = threading.Thread(target=runner, args=(table,))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 5.0
+        while sched.stats.queued < i + 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sched.stats.queued == i + 1, \
+            f"query {i} for {table!r} never queued"
+    release.set()
+    for t in threads:
+        t.join(10.0)
+    return order
+
+
+def _hold_worker(sched):
+    """Occupy the single worker so later submits queue up; returns the
+    release event and the holder thread."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        release.wait(10.0)
+
+    holder = threading.Thread(target=lambda: sched.submit("hold", blocker,
+                                                          timeout_s=15.0))
+    holder.start()
+    assert started.wait(5.0)
+    return release, holder
+
+
+def test_fair_queue_light_tenant_not_starved():
+    """FIFO would run all four hog queries first; the fair queue dispatches
+    the light tenant right after the first hog query."""
+    sched = QueryScheduler(max_concurrent=1, max_pending=16)
+    release, holder = _hold_worker(sched)
+    order = _drive(sched, ["hog", "hog", "hog", "hog", "good"], release)
+    holder.join(10.0)
+    assert sorted(order) == ["good", "hog", "hog", "hog", "hog"]
+    assert order.index("good") <= 1, f"light tenant starved: {order}"
+    sched.stop()
+
+
+def test_fair_queue_weights_bias_the_split():
+    sched = QueryScheduler(max_concurrent=1, max_pending=16,
+                           tenant_weights={"heavy": 4.0})
+    release, holder = _hold_worker(sched)
+    order = _drive(sched, ["heavy"] * 4 + ["light"] * 4, release)
+    holder.join(10.0)
+    # weight 4 buys ~4 dispatches per light dispatch in the contended prefix
+    assert order[:5].count("heavy") == 4, order
+    sched.stop()
+
+
+def test_byte_budget_bounds_concurrent_bytes_but_never_wedges():
+    sched = QueryScheduler(max_concurrent=2, max_pending=8,
+                           max_table_bytes=1000.0)
+    # an idle tenant may always run one query, however oversized
+    assert sched.submit("t", lambda: 42, cost_bytes=5000.0) == 42
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        release.wait(5.0)
+
+    holder = threading.Thread(
+        target=lambda: sched.submit("t", slow, cost_bytes=800.0))
+    holder.start()
+    assert started.wait(5.0)
+    with pytest.raises(QueryRejectedError) as ei:
+        sched.submit("t", lambda: None, cost_bytes=300.0)
+    assert "byte budget" in str(ei.value)
+    assert ei.value.retry_after_ms is not None
+    # another table is unaffected by t's budget
+    assert sched.submit("u", lambda: "ok", cost_bytes=300.0) == "ok"
+    release.set()
+    holder.join(5.0)
+    assert sched.submit("t", lambda: "ok", cost_bytes=300.0) == "ok"
+    sched.stop()
+
+
+def test_capacity_reject_carries_retry_after_hint():
+    sched = QueryScheduler(max_concurrent=1, max_pending=1)
+    release, holder = _hold_worker(sched)
+    queued = threading.Thread(
+        target=lambda: sched.submit("t", lambda: None, timeout_s=10.0))
+    queued.start()
+    deadline = time.monotonic() + 5.0
+    while sched.stats.queued < 1 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    with pytest.raises(QueryRejectedError) as ei:
+        sched.submit("t", lambda: None)
+    assert ei.value.retry_after_ms is not None
+    assert ei.value.retry_after_ms > 0
+    # the standalone drain estimate agrees in shape: positive milliseconds
+    assert sched.retry_after_ms() >= 1.0
+    release.set()
+    holder.join(5.0)
+    queued.join(5.0)
+    sched.stop()
+
+
+def test_scheduler_from_config_fair_knobs():
+    from pinot_tpu.config import Configuration
+    cfg = Configuration({
+        "server.scheduler.enabled": "true",
+        "server.scheduler.max.concurrent": "3",
+        "server.scheduler.fair.weights": json.dumps({"gold": 4, "bronze": 1}),
+        "server.scheduler.fair.tenant.bytes": "2048"})
+    sched = scheduler_from_config(cfg)
+    assert sched is not None
+    assert sched.tenant_weights == {"gold": 4.0, "bronze": 1.0}
+    assert sched.max_table_bytes == 2048.0
+    sched.stop()
+    # malformed weights JSON degrades to unweighted, not a crash
+    sched2 = scheduler_from_config(Configuration({
+        "server.scheduler.enabled": "true",
+        "server.scheduler.fair.weights": "{not json"}))
+    assert sched2.tenant_weights == {}
+    sched2.stop()
+
+
+# -- Retry-After plumbing -----------------------------------------------------
+
+def test_retry_after_helper_reads_attr_then_json_body():
+    from pinot_tpu.cluster.broker import _retry_after_ms
+    e = HttpError(429, '{"error": "busy", "retryAfterMs": 12.5}')
+    assert _retry_after_ms(e) == 12.5
+    tagged = HttpError(429, "busy")
+    tagged.retry_after_ms = 7
+    assert _retry_after_ms(tagged) == 7.0
+    assert _retry_after_ms(HttpError(429, "no body")) is None
+    assert _retry_after_ms(ValueError("not http")) is None
+
+
+def test_services_reject_body_hint_and_timeout_body_deadline():
+    from pinot_tpu.cluster.services import ServerService
+
+    class _Srv:
+        scheduler = None
+
+    class _Handler:
+        server = _Srv()
+
+    h = _Handler()
+    body = ServerService._reject_body(h, QueryRejectedError(
+        "shed", retry_after_ms=12.5))
+    assert body == {"error": "shed", "retryAfterMs": 12.5}
+    # no hint on the error: the handler asks the scheduler's drain estimate
+    h.server.scheduler = QueryScheduler(max_concurrent=2)
+    body = ServerService._reject_body(h, QueryRejectedError("shed"))
+    assert body["retryAfterMs"] > 0
+    h.server.scheduler.stop()
+
+    body = ServerService._timeout_body(QueryTimeoutError(
+        "late", deadline_epoch_ms=1234.5))
+    assert body == {"error": "late", "deadlineEpochMs": 1234.5}
+    assert "deadlineEpochMs" not in ServerService._timeout_body(
+        QueryTimeoutError("late"))
+
+
+def test_remote_handle_defers_by_retry_after_then_retries():
+    from pinot_tpu.cluster.remote import RemoteServerHandle
+
+    h = RemoteServerHandle.__new__(RemoteServerHandle)
+    calls = []
+
+    def once_then_ok(table, ctx, segs, time_filter=None):
+        calls.append(table)
+        if len(calls) == 1:
+            e = HttpError(429, "busy")
+            e.retry_after_ms = 5.0
+            raise e
+        return "ok"
+
+    h._call_once = once_then_ok
+    t0 = time.monotonic()
+    assert h("t", None, []) == "ok"
+    assert len(calls) == 2
+    assert time.monotonic() - t0 < h.RETRY_AFTER_CAP_S + 1.0
+
+    # legacy transport: the hint rides the JSON error body in the message
+    calls.clear()
+
+    def json_hint(table, ctx, segs, time_filter=None):
+        calls.append(table)
+        if len(calls) == 1:
+            raise HttpError(429, '{"error": "busy", "retryAfterMs": 2.0}')
+        return "ok"
+
+    h._call_once = json_hint
+    assert h("t", None, []) == "ok"
+    assert len(calls) == 2
+
+    # a 429 with NO hint propagates: no blind hammering
+    def no_hint(table, ctx, segs, time_filter=None):
+        raise HttpError(429, "busy, no body")
+
+    h._call_once = no_hint
+    with pytest.raises(HttpError):
+        h("t", None, [])
+
+    # non-backpressure statuses are untouched
+    def server_fault(table, ctx, segs, time_filter=None):
+        raise HttpError(500, "boom")
+
+    h._call_once = server_fault
+    with pytest.raises(HttpError):
+        h("t", None, [])
+
+
+# -- broker integration: sheds are typed and counted --------------------------
+
+def _overload_cluster(tmp_path, num_servers=1, replication=1):
+    from pinot_tpu.cluster.enclosure import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import TableConfig
+
+    cluster = QuickCluster(num_servers=num_servers, work_dir=str(tmp_path))
+    schema = Schema("ov", [dimension("user", DataType.STRING),
+                           metric("value", DataType.DOUBLE)])
+    cfg = cluster.create_table(schema, TableConfig("ov",
+                                                   replication=replication))
+    cluster.ingest_columns(cfg, {"user": [f"u{i}" for i in range(40)],
+                                 "value": [1.0] * 40})
+    return cluster
+
+
+def test_broker_sheds_expensive_scan_typed_and_counted(tmp_path):
+    cluster = _overload_cluster(tmp_path)
+    # queue.high=1: the query's own begin() tips the depth signal, so the
+    # machine is SHEDDING for every admit decision — deterministic overload
+    cluster.catalog.put_property("clusterConfig/broker.admission.enabled",
+                                 "true")
+    cluster.catalog.put_property("clusterConfig/broker.admission.queue.high",
+                                 "1")
+    with pytest.raises(QueryRejectedError) as ei:
+        cluster.query("SELECT user, value FROM ov LIMIT 20000")
+    assert "query shed (expensive)" in str(ei.value)
+    # the cheap served path still answers while shedding
+    assert cluster.query("SELECT COUNT(*) FROM ov").rows[0][0] == 40
+    snap = cluster.broker.admission.snapshot()
+    assert snap["sheds"] == 1
+    assert snap["shedByTable"] == {"ov": 1}
+    assert snap["shedByReason"] == {"expensive": 1}
+    assert snap["state"] == SHEDDING
+    # the shed surfaced in the broker's debug plane for cluster_top
+    assert cluster.broker.debug_stats()["admission"]["sheds"] == 1
+
+
+def test_broker_backpressure_bookkeeping_expires(tmp_path):
+    cluster = _overload_cluster(tmp_path)
+    broker = cluster.broker
+    broker._note_backpressure("s_slow", 60_000.0)   # capped at BACKPRESSURE_MAX_S
+    broker._note_backpressure("s_quick", 1.0)
+    assert "s_slow" in broker._backpressured_servers()
+    time.sleep(0.02)
+    held = broker._backpressured_servers()
+    assert "s_quick" not in held and "s_slow" in held
+    # no hint falls back to the default hold, not an infinite one
+    broker._note_backpressure("s_default", None)
+    assert "s_default" in broker._backpressured_servers()
+    assert broker._backpressure_until["s_default"] - time.monotonic() \
+        <= broker.BACKPRESSURE_DEFAULT_S + 0.01
+
+
+def test_hedges_suppressed_while_broker_overloaded(tmp_path):
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils.faults import FaultSchedule
+    from pinot_tpu.utils.metrics import get_registry
+
+    cluster = _overload_cluster(tmp_path, num_servers=2, replication=2)
+    cluster.catalog.put_property("clusterConfig/broker.hedge.enabled", "true")
+    cluster.catalog.put_property("clusterConfig/broker.hedge.delay.ms", "20")
+    cluster.broker.admission.overloaded = lambda: True
+    before = get_registry().counter_value("pinot_broker_hedges_suppressed")
+    sched = FaultSchedule({"server.slow": {"latencyMs": 100, "count": 1}},
+                          seed=3)
+    with faults.active(sched):
+        res = cluster.query("SELECT COUNT(*) FROM ov")
+    faults.deactivate()
+    assert res.rows[0][0] == 40
+    # the straggler was waited out, not hedged: degradation over amplification
+    assert res.stats["hedgedRequests"] == 0
+    after = get_registry().counter_value("pinot_broker_hedges_suppressed")
+    assert after == before + 1
+
+
+# -- satellite: server-side expired-deadline rejection ------------------------
+
+def test_server_rejects_expired_deadline_with_stamped_deadline(tmp_path):
+    from pinot_tpu.cluster.services import ServerService
+
+    cluster = _overload_cluster(tmp_path)
+    server = cluster.servers[0]
+    past = int(time.time() * 1000.0) - 500
+    with pytest.raises(QueryTimeoutError) as ei:
+        server.execute_partial(
+            "ov_OFFLINE",
+            f"SELECT COUNT(*) FROM ov OPTION(deadlineEpochMs={past})", None)
+    assert "deadline budget exhausted" in str(ei.value)
+    assert ei.value.deadline_epoch_ms == float(past)
+    # and the 408 body carries the stamped deadline back to the caller
+    body = ServerService._timeout_body(ei.value)
+    assert body["deadlineEpochMs"] == float(past)
+
+
+# -- satellite: quota refund + scheduler stats consistency --------------------
+
+class _QuotaCatalog:
+    def __init__(self, configs):
+        self.table_configs = configs
+        self.instances = {}
+
+    def subscribe(self, fn):
+        pass
+
+
+def test_quota_try_acquire_all_refunds_under_concurrency():
+    from pinot_tpu.table import QuotaConfig, TableConfig
+
+    cat = _QuotaCatalog({
+        "a": TableConfig("a", quota=QuotaConfig(max_qps=4)),
+        "b": TableConfig("b"),                              # unlimited
+        "z": TableConfig("z", quota=QuotaConfig(max_qps=1))})
+    qm = QueryQuotaManager(cat, broker_count_fn=lambda: 1)
+    # frozen clocks: no refill mid-test, so token counts are exact
+    qm._buckets["a"] = TokenBucket(4.0, burst=4.0, clock=lambda: 0.0)
+    qm._buckets["z"] = TokenBucket(1.0, burst=1.0, clock=lambda: 0.0)
+    qm._buckets["b"] = None
+    assert qm.try_acquire("z")          # drain z: later hybrid admits fail
+
+    results = []
+    rlock = threading.Lock()
+
+    def storm():
+        for _ in range(25):
+            ok = qm.try_acquire_all(["a", "b", "z"])
+            with rlock:
+                results.append(ok)
+
+    threads = [threading.Thread(target=storm) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    # every admission failed on z — and every one refunded a's token, so the
+    # losing tenant's quota never leaked
+    assert not any(results)
+    assert qm._buckets["a"]._tokens == pytest.approx(4.0)
+
+    # the success path is all-or-nothing too: exactly burst admissions win
+    wins = []
+
+    def racer():
+        ok = qm.try_acquire_all(["a", "b"])
+        with rlock:
+            wins.append(ok)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert sum(wins) == 4
+    assert qm._buckets["a"]._tokens == pytest.approx(0.0)
+
+
+def test_scheduler_stats_consistent_under_parallel_churn():
+    sched = QueryScheduler(max_concurrent=2, max_pending=4,
+                           default_timeout_s=5.0)
+
+    def boom():
+        raise ValueError("query error")
+
+    def worker(i):
+        table = f"t{i % 3}"
+        for j in range(12):
+            kind = (i + j) % 4
+            try:
+                if kind == 0:
+                    sched.submit(table, lambda: None)
+                elif kind == 1:
+                    sched.submit(table, lambda: time.sleep(0.002),
+                                 cost_bytes=512.0)
+                elif kind == 2:
+                    sched.submit(table, boom)
+                else:
+                    sched.submit(table, lambda: time.sleep(0.05),
+                                 timeout_s=0.01)
+            except (QueryRejectedError, QueryTimeoutError, ValueError):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    # abandoned timed-out queries finish in the background; wait for drain
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with sched._lock:
+            if sched.stats.running == 0 and sched.stats.queued == 0:
+                break
+        time.sleep(0.01)
+    snap = sched.stats.snapshot()
+    # conservation: every submitted query resolved exactly one way
+    assert snap["submitted"] == (snap["completed"] + snap["timed_out"]
+                                 + snap["failed"]), snap
+    assert snap["submitted"] + snap["rejected"] == 6 * 12
+    assert snap["running"] == 0 and snap["queued"] == 0
+    assert snap["per_table_running"] == {}
+    assert snap["per_table_queued"] == {}
+    assert snap["per_table_bytes"] == {}
+    sched.stop()
+
+
+# -- satellite: cluster_top admission panel -----------------------------------
+
+def test_cluster_top_admission_panel():
+    from pinot_tpu.tools.cluster_top import render, snapshot
+
+    admission = {"enabled": True, "state": "SHEDDING", "inflight": 7,
+                 "queueHigh": 6.0, "queueMax": 48.0, "admitted": 100,
+                 "sheds": 40, "predictedServiceMs": 12.5,
+                 "predictionSamples": 64,
+                 "shedByTable": {"hog": 39, "good": 1},
+                 "shedByReason": {"expensive": 39, "saturated": 1}}
+    pages = {
+        "http://c/tables": {"tables": []},
+        "http://c/debug": {"periodicTasks": {}},
+        "http://b/debug": {"queryStats": {"numQueries": 5, "avgTimeMs": 1.0,
+                                          "numSlowQueries": 0},
+                           "admission": admission},
+    }
+    snap = snapshot("http://c", "http://b", pages.__getitem__)
+    assert snap["admission"]["state"] == "SHEDDING"
+    out = render(snap)
+    assert "admission: SHEDDING" in out
+    assert "inflight=7/6.0/48.0" in out
+    assert "sheds=40" in out
+    assert "hog=39" in out
+    assert "expensive=39" in out and "saturated=1" in out
+    # disabled controllers render flagged, absent ones render nothing
+    snap["admission"] = dict(admission, enabled=False)
+    assert "admission (disabled): SHEDDING" in render(snap)
+    snap["admission"] = {}
+    assert "admission" not in render(snap)
