@@ -859,6 +859,190 @@ def chaos_bench() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def pruning_bench() -> dict:
+    """Pruning + bitmap-index lane (PR 12):
+
+    1. Routing scale — synthesized SegmentMeta (columnStats only, no real
+       segments) at 100 / 1k / 10k segments; a fixed selective range filter
+       must touch a near-constant handful of segments while the table
+       grows, so the prune RATE climbs monotonically with scale. Floors:
+       `prune_rate_10k` ≥ 50x (acceptance), rate monotone in segment count.
+    2. Real mini-cluster — per-pruner-kind breakdown + `scan_rows_avoided_pct`
+       through the in-proc broker (the same counters EXPLAIN ANALYZE renders).
+    3. Bitmap vs gather — effective filter rows/s of the same COUNT-shaped
+       predicate pinned to the packed-word path (`compute_filter_count`:
+       k-row OR-fold + popcount, O(k * docs/32)) vs the LUT-gather mask scan
+       (`compute_mask` + sum, O(docs)), swept over predicate selectivity;
+       both arms are answer-checked against each other. Publishes the
+       measured `bitmap_vs_gather_crossover_sel` (highest swept selectivity
+       where the bitmap path still wins). Floor: bitmap wins on the most
+       selective predicate.
+    """
+    import shutil
+    import tempfile
+
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.cluster.catalog import (COLUMN_STATS_KEY, ONLINE, Catalog,
+                                           InstanceInfo, SegmentMeta)
+    from pinot_tpu.cluster.routing import PRUNE_ROWS_AVOIDED, RoutingManager
+    from pinot_tpu.engine import kernels
+    from pinot_tpu.engine.datablock import block_for
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.executor import ServerQueryExecutor
+    from pinot_tpu.query.predicate import LutLeaf
+    from pinot_tpu.schema import DataType, Schema, dimension
+    from pinot_tpu.schema import metric as smetric
+    from pinot_tpu.table import TableConfig
+
+    out: dict = {}
+
+    # -- 1. routing scale on synthesized metadata ---------------------------
+    # segment i holds v in [i*100, i*100+99]; the window below overlaps
+    # exactly 3 segments at EVERY scale, so segments-touched stays flat
+    # while the table grows 100x
+    rows_per_seg = 1000
+    ctx = compile_query("SELECT COUNT(*) FROM pscale "
+                        "WHERE v BETWEEN 1000 AND 1299")
+    scales = (100, 1000, 10_000)
+    touched: dict = {}
+    rates: dict = {}
+    for count in scales:
+        catalog = Catalog()
+        cfg = TableConfig("pscale")
+        catalog.put_table_config(cfg)
+        table = cfg.table_name_with_type
+        catalog.register_instance(InstanceInfo("server_0", "server"))
+        for i in range(count):
+            seg = f"pscale_{i}"
+            meta = SegmentMeta(seg, table, num_docs=rows_per_seg)
+            meta.custom[COLUMN_STATS_KEY] = {
+                "v": {"min": i * 100, "max": i * 100 + 99}}
+            catalog.put_segment_meta(meta)
+            catalog.external_view.setdefault(table, {})[seg] = {
+                "server_0": ONLINE}
+        rm = RoutingManager(catalog)
+        lats = []
+        prune_stats: dict = {}
+        routing: dict = {}
+        for _ in range(7):
+            prune_stats = {}
+            q0 = time.perf_counter()
+            routing = rm.route_query(table, ctx, prune_stats=prune_stats)
+            lats.append((time.perf_counter() - q0) * 1000)
+        segs = sum(len(v) for v in routing.values())
+        assert segs > 0, "selective window routed zero segments"
+        pruned = sum(prune_stats.get(k, 0)
+                     for k in prune_stats if k != PRUNE_ROWS_AVOIDED)
+        assert segs + pruned == count, (segs, pruned, count)
+        lats.sort()
+        touched[count] = segs
+        rates[count] = count / segs
+        tag = f"{count // 1000}k" if count >= 1000 else str(count)
+        out[f"prune_segments_touched_{tag}"] = segs
+        out[f"prune_route_p50_ms_{tag}"] = round(lats[len(lats) // 2], 3)
+    # monotone scaling: the prune rate must IMPROVE with segment count —
+    # touched stays flat while the table grows, or pruning isn't metadata-
+    # bounded and the 10k floor is luck
+    assert rates[100] <= rates[1000] <= rates[10_000], rates
+    out["prune_rate_10k"] = round(rates[10_000], 1)
+    assert out["prune_rate_10k"] >= 50, out["prune_rate_10k"]
+
+    # -- 2. per-kind breakdown through the real in-proc broker --------------
+    work = tempfile.mkdtemp(prefix="pinot_tpu_prune_")
+    try:
+        cluster = QuickCluster(num_servers=2, work_dir=work)
+        schema = Schema("pev", [dimension("site", DataType.STRING),
+                                smetric("v", DataType.LONG)])
+        cfg = cluster.create_table(schema, TableConfig("pev", replication=1))
+        n_segs, n_rows = 8, 5000
+        sites = ["a", "b", "c", "d"]
+        for i in range(n_segs):
+            cluster.ingest_columns(cfg, {
+                "site": np.array(sites).repeat(n_rows // len(sites)),
+                "v": np.arange(i * n_rows, (i + 1) * n_rows, dtype=np.int64),
+            })
+        total = n_segs * n_rows
+        res = cluster.query(
+            f"SELECT COUNT(*) FROM pev WHERE v >= {(n_segs - 1) * n_rows}")
+        assert res.rows[0][0] == n_rows
+        assert res.stats["numSegmentsPrunedByRange"] == n_segs - 1
+        miss = cluster.query("SELECT COUNT(*) FROM pev WHERE site = 'bb'")
+        assert miss.rows[0][0] == 0
+        assert miss.stats["numSegmentsPrunedByBloom"] == n_segs
+        out["prune_by_kind_range"] = res.stats["numSegmentsPrunedByRange"]
+        out["prune_by_kind_bloom"] = miss.stats["numSegmentsPrunedByBloom"]
+        out["scan_rows_avoided_pct"] = round(
+            res.stats["scanRowsAvoided"] / total * 100.0, 1)
+        assert out["scan_rows_avoided_pct"] >= 50.0, out
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    # -- 3. bitmap vs LUT-gather rows/s by selectivity ----------------------
+    from pinot_tpu.segment.reader import load_segment
+    from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+    card, n = 64, 1 << 19
+    bschema = Schema("bmsweep", [dimension("g"),
+                                 smetric("v", DataType.LONG)])
+    rng = np.random.default_rng(12)
+    gvals = [f"g{i:02d}" for i in range(card)]
+    work = tempfile.mkdtemp(prefix="pinot_tpu_bmsweep_")
+    try:
+        seg = load_segment(SegmentBuilder(bschema, SegmentGeneratorConfig())
+                           .build({"g": [gvals[i] for i in
+                                         rng.integers(0, card, n)],
+                                   "v": np.arange(n, dtype=np.int64)},
+                                  work, "bmsweep_0"))
+        block = block_for(seg)
+        ex = ServerQueryExecutor()
+        iters = 10
+        sweep = []
+        crossover = None
+        for k in (1, 2, 4, 8, 16, 32, 48):
+            sel = k / card
+            inlist = ", ".join(f"'{v}'" for v in gvals[:k])
+            sctx = compile_query(
+                f"SELECT COUNT(*) FROM bmsweep WHERE g IN ({inlist})", bschema)
+            from pinot_tpu.query.planner import plan_segment
+            plan = plan_segment(sctx, seg)
+            bm = tuple(i for i, leaf in enumerate(plan.filter_prog.leaves)
+                       if isinstance(leaf, LutLeaf)
+                       and block.bitmap_words(leaf.col) is not None)
+            assert bm, "sweep predicate must be bitmap-eligible"
+            rates_rs = {}
+            answers = {}
+            for path, leaves in (("bitmap", bm), ("gather", ())):
+                plan.bitmap_leaves = leaves
+                spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {},
+                                          block.padded, bitmap_leaves=leaves)
+                inputs = ex._kernel_inputs(plan, spec, block)
+                if path == "bitmap":
+                    def consume(s=spec, i=inputs):
+                        return int(kernels.compute_filter_count(s, i))
+                else:
+                    def consume(s=spec, i=inputs):
+                        return int(np.asarray(
+                            kernels.compute_mask(s, i)).sum())
+                answers[path] = consume()                   # warm compile
+                q0 = time.perf_counter()
+                for _ in range(iters):
+                    consume()
+                rates_rs[path] = n * iters / (time.perf_counter() - q0)
+            assert answers["bitmap"] == answers["gather"], answers
+            sweep.append({"selectivity": round(sel, 4),
+                          "bitmap_rows_per_sec": round(rates_rs["bitmap"], 1),
+                          "gather_rows_per_sec": round(rates_rs["gather"], 1)})
+            if rates_rs["bitmap"] > rates_rs["gather"]:
+                crossover = sel
+        assert sweep[0]["bitmap_rows_per_sec"] > \
+            sweep[0]["gather_rows_per_sec"], sweep[0]
+        out["bitmap_vs_gather_sweep"] = sweep
+        out["bitmap_vs_gather_crossover_sel"] = round(crossover, 4)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def soak_bench(tenants: int = 96, hog_threads: int = 12, good_threads: int = 4,
                phase_s: float = 5.0, rows_per_tenant: int = 512) -> dict:
     """Overload soak lane (host-only, in-proc dual-server cluster): sustained
@@ -1702,6 +1886,7 @@ def main():
             "backend": jax.default_backend(),
     }
     detail.update(chaos_bench())
+    detail.update(pruning_bench())
     detail.update(soak_bench())
     _update_baseline_published(detail, round(q11_rate / n_dev, 1))
     print(json.dumps({
@@ -1749,6 +1934,8 @@ if __name__ == "__main__":
         run_multichip_lane()
     elif "--chaos" in sys.argv:
         print(json.dumps(chaos_bench(), indent=2))
+    elif "--pruning" in sys.argv:
+        print(json.dumps(pruning_bench(), indent=2))
     elif "--soak" in sys.argv:
         print(json.dumps(soak_bench(), indent=2))
     else:

@@ -1,0 +1,109 @@
+"""filter-path-host-materialization: host doc-id materialization on the
+filter hot path.
+
+The bitmap/LUT filter plane (PR 12) keeps predicate evaluation in the
+vectorized regime: packed-word bitwise kernels on device, LUT gathers and
+`np.add.reduceat` on host. What regresses it is quietly materializing doc ids
+on the host — `np.nonzero`/`np.flatnonzero` over a mask, or a Python `for`
+loop walking postings — inside the executor or kernel modules, which turns an
+O(words) filter back into an O(docs) scan with per-element Python overhead.
+
+This rule flags, in the filter hot modules only:
+
+* any `np.nonzero` / `np.flatnonzero` / `.nonzero()` call, and
+* any `for` loop whose iterator mentions postings / doc_ids / matches
+  (the posting-walk shape `for doc in inv.doc_ids_for(v): ...`),
+
+unless the nearest enclosing function chain includes a name the module
+declares in `__graft_slow_paths__ = ("fn", ...)` — the explicit allowlist of
+fallback/decode paths — or the line carries an inline suppression with a
+reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+from .ingest_hot_loop import slow_path_names
+
+#: filter-evaluation hot modules (repo-relative suffixes): the per-segment
+#: executor and the fused kernel builder. Planner/routing code may
+#: materialize freely — it runs once per query, not per doc.
+HOT_MODULES = (
+    "pinot_tpu/query/executor.py",
+    "pinot_tpu/engine/kernels.py",
+)
+
+#: iterator sources that look like a per-doc postings walk
+_POSTINGS_ITER_RE = re.compile(r"(posting|doc_ids|doc_id|matches|match_ids)")
+
+
+def _enclosing_functions(node: ast.AST) -> Set[str]:
+    """ALL enclosing function names (nested fns inherit their parent's
+    slow-path status: `leaf_mask` inside `host_filter_mask` is still the
+    declared fallback)."""
+    names: Set[str] = set()
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(cur.name)
+        cur = getattr(cur, "graft_parent", None)
+    return names
+
+
+def _is_nonzero_call(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr in ("nonzero", "flatnonzero"):
+        # np.nonzero(...) / np.flatnonzero(...) / arr.nonzero()
+        return True
+    return False
+
+
+class FilterPathHostMaterializationRule(Rule):
+    id = "filter-path-host-materialization"
+    description = ("host doc-id materialization (`np.nonzero`/"
+                   "`np.flatnonzero` or a Python postings loop) on the "
+                   "filter hot path outside a declared "
+                   "__graft_slow_paths__ function")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if not any(module.rel.endswith(suffix) for suffix in HOT_MODULES):
+            return ()
+        slow = slow_path_names(module)
+        out: List[Finding] = []
+        seen_lines: Set[int] = set()
+
+        def _flag(node: ast.AST, message: str) -> None:
+            fns = _enclosing_functions(node)
+            if fns & slow:
+                return
+            if node.lineno in seen_lines:
+                return
+            seen_lines.add(node.lineno)
+            where = (f"`{sorted(fns)[0]}`" if fns else "module scope")
+            out.append(Finding(self.id, module.rel, node.lineno,
+                               f"{message} in {where} — keep the filter "
+                               "path vectorized (packed words / LUT "
+                               "gathers) or declare the function in "
+                               "__graft_slow_paths__"))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and _is_nonzero_call(node):
+                _flag(node, f"host doc-id materialization "
+                            f"`{dotted_name(node.func)}(...)`")
+            elif isinstance(node, ast.For):
+                seg = ast.get_source_segment(module.source, node.iter)
+                text = seg if seg is not None else dotted_name(node.iter)
+                if _POSTINGS_ITER_RE.search(text):
+                    _flag(node, "Python loop over postings "
+                                f"(`for ... in {text}`)")
+        return out
+
+
+def rules() -> List[Rule]:
+    return [FilterPathHostMaterializationRule()]

@@ -687,8 +687,11 @@ class Broker:
             tf_expr = _boundary_expr(boundary, table)
             tf = to_sql(tf_expr) if tf_expr is not None else None
             unroutable: List[str] = []
+            prune_counts: Dict[str, float] = {}
             routing = self.routing.route_query(table, ctx, extra_filter=tf_expr,
-                                               uncovered=unroutable)
+                                               uncovered=unroutable,
+                                               prune_stats=prune_counts)
+            _record_prune_stats(exec_stats, prune_counts)
             uncovered_segments.extend(f"{table}:{s}" for s in sorted(unroutable))
             missing: Dict[str, Set[str]] = {}  # segment -> servers that missed it
             units: List[_DispatchUnit] = []
@@ -1251,6 +1254,9 @@ class Broker:
         total_ms = (time.perf_counter() - t0) * 1000
         plan = self._handle_explain(ctx, physical)
         rows = annotate_plan_rows(plan.rows, st, len(inner.rows), total_ms)
+        prune_row = _broker_prune_row(st, parent_id=0, next_id=len(rows))
+        if prune_row is not None:
+            rows.append(prune_row)
         res = ResultTable(list(ANALYZE_COLUMNS), rows, dict(inner.stats))
         res.stats.update(st.to_public_dict())
         res.stats["explain"] = True
@@ -1607,6 +1613,45 @@ class Broker:
         if not ends:
             return None
         return (cfg.time_column, max(ends))
+
+
+def _record_prune_stats(exec_stats, prune_counts: Dict[str, float]) -> None:
+    """Fold the routing pruner's per-kind rejection counts into the query's
+    ExecutionStats: the per-kind breakdown, the numSegmentsPruned total, and
+    the pruned segments' doc count as scanRowsAvoided."""
+    if not prune_counts:
+        return
+    from .routing import PRUNE_ROWS_AVOIDED, PRUNER_KINDS
+    total = 0
+    for kind in PRUNER_KINDS:
+        n = int(prune_counts.get(kind, 0))
+        if n:
+            exec_stats.add(qstats.PRUNED_BY_KIND[kind], n)
+            total += n
+    if total:
+        exec_stats.add(qstats.NUM_SEGMENTS_PRUNED, total)
+    rows = int(prune_counts.get(PRUNE_ROWS_AVOIDED, 0))
+    if rows:
+        exec_stats.add(qstats.SCAN_ROWS_AVOIDED, rows)
+
+
+def _broker_prune_row(st, parent_id: int, next_id: int):
+    """EXPLAIN ANALYZE row summarising broker-side metadata pruning: one
+    BROKER_PRUNE(kind:N, ...) operator under the root whose Rows column is the
+    total number of segments the router rejected before fan-out. Returns None
+    when routing pruned nothing (the common unfiltered case)."""
+    pub = st.to_public_dict()
+    parts = []
+    total = 0
+    for kind, key in qstats.PRUNED_BY_KIND.items():
+        n = int(pub.get(key, 0))
+        if n:
+            parts.append(f"{kind}:{n}")
+            total += n
+    if not total:
+        return None
+    return [f"BROKER_PRUNE({', '.join(parts)})", next_id, parent_id,
+            total, None]
 
 
 def _boundary_expr(boundary, table: str):

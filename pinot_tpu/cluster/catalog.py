@@ -68,6 +68,31 @@ class SegmentMeta:
         return SegmentMeta(**d)
 
 
+#: SegmentMeta.custom key holding per-column pruning metadata:
+#: {column: {"min": v, "max": v, "bloom": "<hex>"}} lifted from the segment's
+#: metadata.json at commit/upload so the broker can range/bloom-prune without
+#: ever opening the segment (reference: ColumnValueSegmentPruner consuming
+#: column metadata + bloom filters)
+COLUMN_STATS_KEY = "columnStats"
+
+
+def column_stats_from_meta(seg_meta_json: Dict[str, Any]) -> Dict[str, Any]:
+    """Lift the broker-prunable per-column facts out of a segment's
+    metadata.json `columns` block: min/max (range pruning) and the
+    metadata-carried bloom payload (EQ/IN pruning)."""
+    out: Dict[str, Any] = {}
+    for col, cm in (seg_meta_json.get("columns") or {}).items():
+        entry: Dict[str, Any] = {}
+        if cm.get("minValue") is not None:
+            entry["min"] = cm["minValue"]
+            entry["max"] = cm.get("maxValue")
+        if cm.get("bloomHex"):
+            entry["bloom"] = cm["bloomHex"]
+        if entry:
+            out[col] = entry
+    return out
+
+
 @dataclass
 class InstanceInfo:
     instance_id: str

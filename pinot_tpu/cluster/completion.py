@@ -29,8 +29,9 @@ from typing import Dict, List, Optional
 from ..segment.format import read_json, CREATION_META_FILE, SEGMENT_METADATA_FILE
 from ..table import TableConfig
 from .assignment import balanced_assign, compute_counts
-from .catalog import (CONSUMING, ONLINE, Catalog, SegmentMeta, STATUS_DONE,
-                      STATUS_IN_PROGRESS)
+from .catalog import (CONSUMING, COLUMN_STATS_KEY, ONLINE, Catalog,
+                      SegmentMeta, STATUS_DONE, STATUS_IN_PROGRESS,
+                      column_stats_from_meta)
 from .deepstore import DeepStoreFS, tar_segment
 
 # protocol responses (reference: SegmentCompletionProtocol.ControllerResponseStatus)
@@ -369,6 +370,9 @@ class LLCSegmentManager:
         meta.size_bytes = size
         meta.download_path = uri
         self._fill_time_range(cfg, seg_meta_json, meta)
+        col_stats = column_stats_from_meta(seg_meta_json)
+        if col_stats:
+            meta.custom[COLUMN_STATS_KEY] = col_stats
         self.catalog.put_segment_meta(meta)
 
         resp = fsm.on_commit_end(server, end_offset)

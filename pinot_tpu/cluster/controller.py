@@ -21,8 +21,9 @@ from ..segment.format import read_json, SEGMENT_METADATA_FILE
 from ..segment.reader import load_segment
 from ..table import TableConfig, TableType
 from .assignment import balanced_assign, compute_counts, rebalance_table, replica_group_assign
-from .catalog import (Catalog, InstanceInfo, ONLINE, SegmentMeta,
-                      STATUS_IN_PROGRESS, STATUS_UPLOADED)
+from .catalog import (Catalog, COLUMN_STATS_KEY, InstanceInfo, ONLINE,
+                      SegmentMeta, STATUS_IN_PROGRESS, STATUS_UPLOADED,
+                      column_stats_from_meta)
 from .deepstore import DeepStoreFS, tar_segment
 from .routing import partition_for_value
 
@@ -163,6 +164,9 @@ class Controller:
             custom=dict(custom or {}),
         )
         self._fill_time_range(cfg, seg_meta_json, meta)
+        col_stats = column_stats_from_meta(seg_meta_json)
+        if col_stats:
+            meta.custom[COLUMN_STATS_KEY] = col_stats
         self.catalog.put_segment_meta(meta)
         self._assign_segment(table, cfg, meta)
         from ..utils.metrics import get_registry

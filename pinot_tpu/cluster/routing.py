@@ -17,7 +17,17 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..query.context import QueryContext
 from ..sql.ast import Expr, Function, Identifier, Literal
-from .catalog import CONSUMING, ONLINE, Catalog, SegmentMeta
+from ..segment.indexes.bloom import bloom_hex_might_contain
+from .catalog import (COLUMN_STATS_KEY, CONSUMING, ONLINE, Catalog,
+                      SegmentMeta)
+
+#: pruner kinds in evaluation order — the FIRST pruner that rejects a segment
+#: gets the attribution (numSegmentsPrunedBy<Kind> in ExecutionStats)
+PRUNER_KINDS = ("partition", "time", "range", "bloom")
+
+#: key under which `_prune`/`route_query` accumulate pruned-doc counts in the
+#: caller-supplied prune_stats dict (feeds scanRowsAvoided)
+PRUNE_ROWS_AVOIDED = "rowsAvoided"
 
 
 def partition_for_value(value, function: str, num_partitions: int) -> int:
@@ -170,14 +180,17 @@ class RoutingManager:
     # -- query routing -----------------------------------------------------
     def route_query(self, table: str, ctx: Optional[QueryContext] = None,
                     extra_filter: Optional[Expr] = None,
-                    uncovered: Optional[List[str]] = None
+                    uncovered: Optional[List[str]] = None,
+                    prune_stats: Optional[Dict[str, float]] = None
                     ) -> Dict[str, List[str]]:
         """`extra_filter` is an additional predicate the servers will apply (the
         broker's hybrid time-boundary split) — fed into the metadata pruner here so
         retained realtime segments entirely below the boundary are never dispatched
         (reference: TimeSegmentPruner sees the boundary-augmented filter).
         `uncovered`, when given, collects segments that survive pruning but have
-        no healthy replica to serve them."""
+        no healthy replica to serve them. `prune_stats`, when given, accumulates
+        per-pruner-kind rejection counts (PRUNER_KINDS keys) plus the pruned
+        segments' total doc count under PRUNE_ROWS_AVOIDED."""
         with self._lock:
             rt = self._tables.get(table)
             unhealthy = set(self._unhealthy)
@@ -189,12 +202,19 @@ class RoutingManager:
         if hidden:
             keep -= hidden
         if ctx is not None:
-            keep = self._prune(table, keep, ctx)
+            keep = self._prune(table, keep, ctx, prune_stats)
         if extra_filter is not None and cfg is not None:
             metas = self.catalog.segments.get(table, {})
-            keep = {seg for seg in keep
-                    if seg not in metas
-                    or _segment_may_match(extra_filter, cfg, metas[seg])}
+            kept: Set[str] = set()
+            for seg in keep:
+                meta = metas.get(seg)
+                reason = (None if meta is None else
+                          _prune_reason(extra_filter, cfg, meta))
+                if reason is None:
+                    kept.add(seg)
+                else:
+                    _count_prune(prune_stats, reason, meta)
+            keep = kept
         if uncovered is not None:
             # dead-replica segments that survive pruning are part of the
             # query's answer set but have no server at all
@@ -225,9 +245,12 @@ class RoutingManager:
             hidden.update(e["to"] if e["state"] == "IN_PROGRESS" else e["from"])
         return hidden
 
-    def _prune(self, table: str, segments: Set[str], ctx: QueryContext) -> Set[str]:
-        """Partition + time pruning from SegmentMeta (reference:
-        MultiPartitionColumnsSegmentPruner + TimeSegmentPruner)."""
+    def _prune(self, table: str, segments: Set[str], ctx: QueryContext,
+               prune_stats: Optional[Dict[str, float]] = None) -> Set[str]:
+        """Metadata pruning from SegmentMeta (reference:
+        MultiPartitionColumnsSegmentPruner + TimeSegmentPruner +
+        ColumnValueSegmentPruner): partition/time from the typed meta fields,
+        range/bloom from the commit-time columnStats custom block."""
         cfg = self.catalog.table_configs.get(table)
         metas = self.catalog.segments.get(table, {})
         if cfg is None or ctx.filter is None:
@@ -238,41 +261,113 @@ class RoutingManager:
             if meta is None:
                 keep.add(seg)
                 continue
-            if not _segment_may_match(ctx.filter, cfg, meta):
+            reason = _prune_reason(ctx.filter, cfg, meta)
+            if reason is not None:
+                _count_prune(prune_stats, reason, meta)
                 continue
             keep.add(seg)
         return keep
 
 
+def _count_prune(prune_stats: Optional[Dict[str, float]], reason: str,
+                 meta: Optional[SegmentMeta]) -> None:
+    if prune_stats is None:
+        return
+    prune_stats[reason] = prune_stats.get(reason, 0) + 1
+    if meta is not None:
+        prune_stats[PRUNE_ROWS_AVOIDED] = (
+            prune_stats.get(PRUNE_ROWS_AVOIDED, 0) + meta.num_docs)
+
+
 def _segment_may_match(filt: Expr, cfg, meta: SegmentMeta) -> bool:
-    """Conservative filter check against segment partition/time metadata."""
-    if isinstance(filt, Function):
-        if filt.name == "and":
-            return all(_segment_may_match(a, cfg, meta) for a in filt.args)
-        if filt.name == "or":
-            return any(_segment_may_match(a, cfg, meta) for a in filt.args)
-        # partition pruning: eq on the partition column
-        if (filt.name == "eq" and cfg.partition and meta.partition_id is not None
-                and isinstance(filt.args[0], Identifier)
-                and filt.args[0].name == cfg.partition.column
-                and isinstance(filt.args[1], Literal)):
-            pid = partition_for_value(filt.args[1].value, cfg.partition.function,
-                                      cfg.partition.num_partitions)
-            return pid == meta.partition_id
-        # time pruning: range on the time column vs [start_time, end_time]
-        if (cfg.time_column and meta.start_time_ms is not None
-                and meta.end_time_ms is not None
-                and isinstance(filt.args[0], Identifier)
-                and filt.args[0].name == cfg.time_column
-                and all(isinstance(a, Literal) for a in filt.args[1:])):
-            vals = [a.value for a in filt.args[1:]]
-            lo, hi = meta.start_time_ms, meta.end_time_ms
-            if filt.name == "between":
-                return not (vals[1] < lo or vals[0] > hi)
-            if filt.name == "eq":
-                return lo <= vals[0] <= hi
-            if filt.name in ("gt", "gte"):
-                return vals[0] <= hi
-            if filt.name in ("lt", "lte"):
-                return vals[0] >= lo
-    return True
+    """Conservative filter check against segment metadata (compat wrapper)."""
+    return _prune_reason(filt, cfg, meta) is None
+
+
+def _out_of_range(name: str, args: List, lo, hi) -> bool:
+    """True when the comparison `name(col, *args)` PROVABLY misses [lo, hi].
+    columnStats values round-trip through JSON, so a cross-type comparison
+    (str vs int, bytes literal vs hex string) degrades to "may match"."""
+    try:
+        if name == "eq":
+            return bool(args[0] < lo or hi < args[0])
+        if name == "between":
+            return bool(args[1] < lo or hi < args[0])
+        if name == "gt":
+            return not args[0] < hi          # col > v needs v < max
+        if name == "gte":
+            return bool(hi < args[0])        # col >= v needs v <= max
+        if name == "lt":
+            return not lo < args[0]          # col < v needs v > min
+        if name == "lte":
+            return bool(args[0] < lo)        # col <= v needs v >= min
+        if name == "in":
+            return all(v < lo or hi < v for v in args)
+    except TypeError:
+        return False
+    return False
+
+
+def _prune_reason(filt: Expr, cfg, meta: SegmentMeta) -> Optional[str]:
+    """Why this segment PROVABLY cannot match `filt` (a PRUNER_KINDS name),
+    or None when it may match. Strictly conservative: anything the metadata
+    cannot decide is None."""
+    if not isinstance(filt, Function):
+        return None
+    if filt.name == "and":
+        for a in filt.args:
+            r = _prune_reason(a, cfg, meta)
+            if r is not None:
+                return r
+        return None
+    if filt.name == "or":
+        first: Optional[str] = None
+        for a in filt.args:
+            r = _prune_reason(a, cfg, meta)
+            if r is None:
+                return None      # one satisfiable branch keeps the segment
+            if first is None:
+                first = r
+        return first
+    # partition pruning: eq on the partition column
+    if (filt.name == "eq" and cfg.partition and meta.partition_id is not None
+            and isinstance(filt.args[0], Identifier)
+            and filt.args[0].name == cfg.partition.column
+            and isinstance(filt.args[1], Literal)):
+        pid = partition_for_value(filt.args[1].value, cfg.partition.function,
+                                  cfg.partition.num_partitions)
+        if pid != meta.partition_id:
+            return "partition"
+        return None
+    # time pruning: range on the time column vs [start_time, end_time]
+    if (cfg.time_column and meta.start_time_ms is not None
+            and meta.end_time_ms is not None
+            and isinstance(filt.args[0], Identifier)
+            and filt.args[0].name == cfg.time_column
+            and all(isinstance(a, Literal) for a in filt.args[1:])):
+        vals = [a.value for a in filt.args[1:]]
+        lo, hi = meta.start_time_ms, meta.end_time_ms
+        if filt.name == "between" and (vals[1] < lo or vals[0] > hi):
+            return "time"
+        if filt.name == "eq" and not lo <= vals[0] <= hi:
+            return "time"
+        if filt.name in ("gt", "gte") and not vals[0] <= hi:
+            return "time"
+        if filt.name in ("lt", "lte") and not vals[0] >= lo:
+            return "time"
+        return None
+    # range + bloom pruning from the commit-time per-column stats
+    col_stats = (meta.custom or {}).get(COLUMN_STATS_KEY)
+    if (col_stats and filt.args and isinstance(filt.args[0], Identifier)
+            and all(isinstance(a, Literal) for a in filt.args[1:])):
+        cs = col_stats.get(filt.args[0].name)
+        if not isinstance(cs, dict):
+            return None
+        vals = [a.value for a in filt.args[1:]]
+        if ("min" in cs and "max" in cs and vals
+                and _out_of_range(filt.name, vals, cs["min"], cs["max"])):
+            return "range"
+        if filt.name in ("eq", "in") and cs.get("bloom"):
+            if not any(bloom_hex_might_contain(cs["bloom"], v) for v in vals):
+                return "bloom"
+    return None

@@ -82,7 +82,12 @@ class ServerNode:
         self.catalog = catalog
         self.deepstore = deepstore
         self.data_dir = data_dir
-        self.executor = ServerQueryExecutor()
+        # device bitmap filter indexes default on; operators can force the
+        # LUT/interval filter path cluster-wide (e.g. to bisect a wrong-result
+        # report) without redeploying servers
+        bitmap_on = str(catalog.get_property(
+            "clusterConfig/server.index.bitmap.enabled", "true")).lower() != "false"
+        self.executor = ServerQueryExecutor(bitmap_enabled=bitmap_on)
         # optional admission control (reference: QueryScheduler wrapping the
         # executor; None = direct execution, the single-tenant test default)
         self.scheduler = scheduler

@@ -59,12 +59,18 @@ class KernelCaps:
     minmax_bcast_cap: int = 1024  # broadcast-reduce min/max up to here
     high_card_regime: str = "partitioned"  # above chunk_cap
     partition_block: int = 4096  # sorted-rank block length (multiple of 64)
+    # bitmap-vs-gather filter regime: a dict-column filter leaf takes the
+    # packed-word bitmap path when its estimated selectivity (matched docs /
+    # docs) is at or below this fraction; denser predicates keep the
+    # interval-compare / one-hot LUT path
+    bitmap_sel_cap: float = 0.25
     source: str = "default"      # default | cache | calibrated | env
 
     def token(self) -> Tuple:
         """The part of the caps that changes compiled kernels (jit cache key)."""
         return (self.matmul_cap, self.chunk_cap, self.minmax_bcast_cap,
-                self.high_card_regime, self.partition_block)
+                self.high_card_regime, self.partition_block,
+                self.bitmap_sel_cap)
 
 
 _ACTIVE: Optional[KernelCaps] = None
@@ -78,6 +84,7 @@ def _valid(caps: KernelCaps) -> bool:
                 <= _BCAST_CAP_RANGE[1]
                 and _BLOCK_RANGE[0] <= int(caps.partition_block) <= _BLOCK_RANGE[1]
                 and int(caps.partition_block) % 64 == 0
+                and 0.0 < float(caps.bitmap_sel_cap) <= 1.0
                 and caps.high_card_regime in HIGH_CARD_REGIMES)
     except (TypeError, ValueError):
         return False
@@ -113,6 +120,9 @@ def load_cached_caps(path: Optional[str] = None,
             minmax_bcast_cap=int(entry["minmax_bcast_cap"]),
             high_card_regime=str(entry["high_card_regime"]),
             partition_block=int(entry["partition_block"]),
+            # absent in caches written before the bitmap filter regime existed
+            bitmap_sel_cap=float(entry.get("bitmap_sel_cap",
+                                           KernelCaps.bitmap_sel_cap)),
             source="cache")
     except Exception:
         return None
@@ -159,6 +169,9 @@ def _env_overrides(caps: KernelCaps) -> KernelCaps:
     regime = os.environ.get("PINOT_TPU_GROUPBY_REGIME")
     if regime:
         changed["high_card_regime"] = regime
+    sel = os.environ.get("PINOT_TPU_BITMAP_SEL_CAP")
+    if sel:
+        changed["bitmap_sel_cap"] = float(sel)
     if not changed:
         return caps
     out = replace(caps, source="env", **changed)

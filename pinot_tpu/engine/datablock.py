@@ -44,6 +44,13 @@ def lut_size(cardinality: int) -> int:
     return _pow2(cardinality + 1)
 
 
+# Bitmap filter indexes exist only for dict columns up to this cardinality:
+# the packed representation costs card * padded/8 bytes of HBM and the fused
+# OR-reduce walks card * padded/32 words, so past a few dozen distinct values
+# the forward-id gather/one-hot path is both smaller and cheaper.
+BITMAP_MAX_CARD = 64
+
+
 class SegmentBlock:
     """Lazy per-column device cache for one immutable segment."""
 
@@ -56,7 +63,9 @@ class SegmentBlock:
         self._dict_vals: Dict[str, jnp.ndarray] = {}
         self._decoded: Dict[str, jnp.ndarray] = {}
         self._valid: Optional[jnp.ndarray] = None
+        self._valid_words: Optional[jnp.ndarray] = None
         self._null: Dict[str, jnp.ndarray] = {}
+        self._bitmaps: Dict[str, Optional[jnp.ndarray]] = {}
 
     @property
     def valid(self) -> jnp.ndarray:
@@ -65,6 +74,20 @@ class SegmentBlock:
             v[:self.num_docs] = True
             self._valid = jnp.asarray(v)
         return self._valid
+
+    @property
+    def valid_words(self) -> jnp.ndarray:
+        """Packed `valid`: uint32[padded // 32], same bit layout as the bitmap
+        index rows. ANDed onto word-domain filter results so a NOT (which sets
+        padding bits) never counts padding docs — keeps the popcount COUNT
+        path pure word-domain work."""
+        if self._valid_words is None:
+            w = np.zeros(self.padded // 32, dtype=np.uint32)
+            docs = np.arange(self.num_docs, dtype=np.int64)
+            np.bitwise_or.at(w, docs >> 5,
+                             np.uint32(1) << (docs & 31).astype(np.uint32))
+            self._valid_words = jnp.asarray(w)
+        return self._valid_words
 
     def ids(self, col: str) -> jnp.ndarray:
         """Padded int32 dict-id array for a dict-encoded column.
@@ -122,6 +145,37 @@ class SegmentBlock:
             out[:len(vals)] = vals
             self._dict_vals[col] = jnp.asarray(out)
         return self._dict_vals[col]
+
+    def bitmap_words(self, col: str) -> Optional[jnp.ndarray]:
+        """Packed bitmap filter index: uint32[cardinality, padded // 32].
+
+        Row c is the per-doc membership bitmap of dict id c, packed 32 docs per
+        word (doc r -> word r >> 5, bit r & 31). Input staging gathers only
+        the LUT-selected rows per query and the kernel OR-folds them, so word
+        traffic scales with the leaf's selectivity, not cardinality. Built
+        host-side once from the forward index and cached in HBM alongside the
+        id column; None when the column is ineligible (no dictionary,
+        multi-value, or cardinality above BITMAP_MAX_CARD)."""
+        if col not in self._bitmaps:
+            reader = self.segment.column(col)
+            card = reader.cardinality
+            if (not reader.has_dictionary or card <= 0
+                    or card > BITMAP_MAX_CARD
+                    or getattr(reader, "is_multi_value", False)):
+                self._bitmaps[col] = None
+            else:
+                ids = np.asarray(reader.fwd).astype(np.int64)
+                words = np.zeros((card, self.padded // 32), dtype=np.uint32)
+                docs = np.arange(self.num_docs, dtype=np.int64)
+                # star-tree record tables carry the out-of-dictionary star
+                # marker (id == cardinality): such rows match no dict value,
+                # so they set no bit — same False every LUT gives the id
+                keep = ids < card
+                np.bitwise_or.at(
+                    words, (ids[keep], (docs >> 5)[keep]),
+                    (np.uint32(1) << (docs & 31).astype(np.uint32))[keep])
+                self._bitmaps[col] = jnp.asarray(words)
+        return self._bitmaps[col]
 
     def null_mask(self, col: str) -> jnp.ndarray:
         """Padded bool array: True where the stored value is a filled-in null."""

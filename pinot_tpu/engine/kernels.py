@@ -97,19 +97,31 @@ class KernelSpec:
     # and leaf masks reduce any(-1). Static (not shape-inferred): the mesh path's
     # stacked [segments, rows] arrays are also 2-D but are NOT multi-value.
     mv_cols: Tuple[str, ...] = ()
+    # leaf indices the planner routed to the packed-word bitmap index: the leaf
+    # evaluates as an OR-reduce over `bitmap_words` rows instead of an id
+    # gather/one-hot, with the boolean LUT riding along as the runtime row
+    # selector. When EVERY leaf is a bitmap leaf the whole tree stays in the
+    # word domain (fused AND/OR/NOT over uint32 words, one unpack at the end).
+    bitmap_leaves: Tuple[int, ...] = ()
 
     # per-leaf runtime input routing, computed in __post_init__
     lut_index: Dict[int, int] = field(default_factory=dict)       # dense (scattered) LUTs
     lut_interval: Dict[int, Tuple[int, int]] = field(default_factory=dict)  # (ioff, n)
     cmp_offset: Dict[int, Tuple[str, int]] = field(default_factory=dict)
     docset_index: Dict[int, int] = field(default_factory=dict)
+    bitmap_index: Dict[int, int] = field(default_factory=dict)
 
     def __post_init__(self):
         luts = docsets = 0
         ioff = foff = 0
         for i, leaf in enumerate(self.filter.leaves):
             if isinstance(leaf, LutLeaf):
-                if leaf.intervals is not None:
+                if i in self.bitmap_leaves:
+                    # word-matrix input + runtime row-selector LUT
+                    self.bitmap_index[i] = len(self.bitmap_index)
+                    self.lut_index[i] = luts
+                    luts += 1
+                elif leaf.intervals is not None:
                     # interval bounds ride the int scalar stream: [lo0,hi0,lo1,hi1,...]
                     self.lut_interval[i] = (ioff, len(leaf.intervals))
                     ioff += 2 * len(leaf.intervals)
@@ -136,6 +148,7 @@ class KernelSpec:
             tuple(sorted(self.distinct_lut_sizes.items())),
             self.padded_rows,
             self.mv_cols,
+            self.bitmap_leaves,
             # regime caps change the traced program for the same plan shape
             get_caps().token(),
         )
@@ -155,6 +168,11 @@ class KernelInputs:
     strides: jnp.ndarray  # i32[G] (empty for scalar aggregation)
     agg_luts: Dict[str, jnp.ndarray] = field(default_factory=dict)  # "<i>.bucket"/"<i>.rank"
     docsets: Tuple[jnp.ndarray, ...] = ()  # padded bool[P] per DocSetLeaf
+    bitmaps: Tuple[jnp.ndarray, ...] = ()  # uint32[k_pow2, P//32] per bitmap leaf
+    # packed `valid` (uint32[P//32], same bit layout as bitmap rows) for the
+    # popcount fast path; None when a runtime valid-doc intersection (upsert)
+    # makes the packed form stale — the count path then packs `valid` itself
+    valid_words: Optional[jnp.ndarray] = None
 
 
 _KERNEL_CACHE: Dict[Tuple, Any] = {}
@@ -234,13 +252,76 @@ def tree_bytes(tree) -> int:
                for leaf in jax.tree_util.tree_leaves(tree))
 
 
+def _bitmap_leaf_words(spec: KernelSpec, i: int, bitmaps) -> jnp.ndarray:
+    """One bitmap leaf in the word domain: OR-fold of the PRE-SELECTED word
+    rows. Input staging (`_kernel_inputs`) gathers only the dict-id rows the
+    leaf's LUT selects and pads the row count to a power of two by repeating
+    one selected row — OR is idempotent, so the padding never changes the
+    result, and the pow2 shapes bound retraces to log2(card) variants. Word
+    traffic is k * P/32 (k = selected ids), proportional to the leaf's
+    selectivity instead of the column's cardinality."""
+    bm = bitmaps[spec.bitmap_index[i]]            # uint32 [k_pow2, P//32]
+    out = bm[0]
+    for j in range(1, bm.shape[0]):
+        out = out | bm[j]
+    return out
+
+
+def _unpack_words(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[W] packed bits -> bool[32 * W] row mask (shift + reshape, no gather)."""
+    bits = (words[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :]) \
+        & jnp.uint32(1)
+    return bits.reshape(-1) != 0
+
+
+def _pack_valid(valid: jnp.ndarray) -> jnp.ndarray:
+    """bool[P] -> uint32[P//32] packed words (P is always a multiple of 32:
+    padded rows are pow2 >= ROW_TILE)."""
+    v = valid.ravel().astype(jnp.uint32).reshape(-1, 32)
+    return jnp.sum(v << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1,
+                   dtype=jnp.uint32)
+
+
+def _make_word_fn(spec: KernelSpec):
+    """words(bitmaps) -> uint32[P//32] evaluating the WHOLE filter tree
+    in the packed word domain, or None unless every leaf is a bitmap leaf.
+    NOT sets padding bits; callers AND the result with the packed valid mask."""
+    leaves = spec.filter.leaves
+    if spec.filter.is_match_all or not leaves:
+        return None
+    if set(spec.bitmap_index) != set(range(len(leaves))):
+        return None
+
+    def tree_words(node, bitmaps):
+        kind = node[0]
+        if kind == "leaf":
+            return _bitmap_leaf_words(spec, node[1], bitmaps)
+        if kind == "not":
+            return ~tree_words(node[1], bitmaps)
+        words = [tree_words(c, bitmaps) for c in node[1]]
+        out = words[0]
+        for w in words[1:]:
+            out = (out & w) if kind == "and" else (out | w)
+        return out
+
+    tree = spec.filter.tree
+    if tree[0] == "const":  # _simplify folds consts away except all/none
+        return None
+    return lambda bitmaps: tree_words(tree, bitmaps)
+
+
 def _make_mask_fn(spec: KernelSpec):
     """Returns mask(ids, vals, luts, iscal, fscal, nulls, valid) -> bool[P] closure."""
     leaves = spec.filter.leaves
+    word_fn = _make_word_fn(spec)
 
-    def leaf_mask(i, ids, vals, luts, iscal, fscal, nulls, docsets):
+    def leaf_mask(i, ids, vals, luts, iscal, fscal, nulls, docsets, bitmaps):
         leaf = leaves[i]
         if isinstance(leaf, LutLeaf):
+            if i in spec.bitmap_index:
+                # mixed tree: unpack this leaf's words to a row mask and
+                # combine with the other leaves in the row domain
+                return _unpack_words(_bitmap_leaf_words(spec, i, bitmaps))
             col_ids = ids[leaf.col]
             # multi-value column: [P, W] id matrix; a row matches if ANY of its
             # values does (reference: MVScanDocIdIterator), so per-value masks
@@ -311,10 +392,15 @@ def _make_mask_fn(spec: KernelSpec):
             out = (out & m) if kind == "and" else (out | m)
         return out
 
-    def mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets=()):
+    def mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets=(),
+                bitmaps=()):
         if spec.filter.is_match_all:
             return valid
-        env = (ids, vals, luts, iscal, fscal, nulls, docsets)
+        if word_fn is not None:
+            # every leaf is a bitmap leaf: the tree evaluates as fused bitwise
+            # ops over packed words, one unpack for the row mask at the end
+            return _unpack_words(word_fn(bitmaps) & _pack_valid(valid))
+        env = (ids, vals, luts, iscal, fscal, nulls, docsets, bitmaps)
         return tree_mask(spec.filter.tree, env, valid) & valid
 
     return mask_fn
@@ -588,8 +674,10 @@ def _make_body(spec: KernelSpec):
     mask_fn = _make_mask_fn(spec)
     caps = get_caps()  # regime crossovers (calibrated; part of signature())
 
-    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts, docsets):
-        mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets)
+    def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts,
+               docsets, bitmaps=()):
+        mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets,
+                       bitmaps)
         out: Dict[str, jnp.ndarray] = {}
 
         if group:
@@ -766,7 +854,7 @@ def dispatch_kernel(spec: KernelSpec, inputs: KernelInputs):
     not the FLOPs — is the latency floor)."""
     return get_kernel(spec)(inputs.ids, inputs.vals, inputs.luts, inputs.iscal,
                             inputs.fscal, inputs.nulls, inputs.valid, inputs.strides,
-                            inputs.agg_luts, inputs.docsets)
+                            inputs.agg_luts, inputs.docsets, inputs.bitmaps)
 
 
 def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
@@ -777,19 +865,50 @@ def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
 
 def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
     """Filter-only kernel for selection queries: returns the boolean match mask."""
-    key = ("mask", spec.filter.signature(), spec.padded_rows)
+    key = ("mask", spec.filter.signature(), spec.padded_rows,
+           spec.bitmap_leaves)
 
     def build():
         mask_fn = _make_mask_fn(spec)
         return jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid,
-                       docsets:
+                       docsets, bitmaps:
                        mask_fn(ids, vals, luts, iscal, fscal, nulls, valid,
-                               docsets))
+                               docsets, bitmaps))
 
     fn = _cached_kernel(key, build)
     out = fn(inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
-             inputs.nulls, inputs.valid, inputs.docsets)
+             inputs.nulls, inputs.valid, inputs.docsets, inputs.bitmaps)
     return fetch_outputs(out)
+
+
+def compute_filter_count(spec: KernelSpec,
+                         inputs: KernelInputs) -> Optional[int]:
+    """Popcount fast path: matching-row COUNT for a filter whose every leaf is
+    a bitmap leaf — the tree evaluates as fused bitwise ops over packed words
+    and `lax.population_count` reduces them, so no per-row mask is ever
+    materialized. Returns None when the filter doesn't evaluate fully in the
+    word domain (caller falls back to the mask kernel)."""
+    if _make_word_fn(spec) is None:
+        return None
+    key = ("bitcount", spec.filter.signature(), spec.padded_rows,
+           spec.bitmap_leaves)
+
+    def build():
+        word_fn = _make_word_fn(spec)
+
+        def body(valid_words, bitmaps):
+            words = word_fn(bitmaps) & valid_words
+            return jax.lax.population_count(words).sum(dtype=jnp.uint32)
+
+        return jax.jit(body)
+
+    fn = _cached_kernel(key, build)
+    # the staged packed valid keeps the whole count O(P/32); packing on the
+    # fly (upsert valid-doc intersection) is the O(P) exception
+    vw = inputs.valid_words
+    if vw is None:
+        vw = _pack_valid(inputs.valid)
+    return int(fetch_outputs(fn(vw, inputs.bitmaps)))
 
 
 def topk_kernel(spec: KernelSpec, order_expr, desc: bool, k: int,

@@ -35,12 +35,23 @@ _PLAN_OP_LABELS = {"empty": "PRUNED", "metadata": "METADATA_ONLY_AGGREGATE",
 #: that the array form only adds wire weight (it ships full dictionaries)
 DENSE_PARTIAL_MIN_GROUPS = 4096
 
+#: functions allowed to materialize doc ids on the host (np.nonzero /
+#: postings loops): declared fallbacks and decode paths. Everything else in
+#: this module must stay in the vectorized/device regime — enforced by the
+#: `filter-path-host-materialization` graftcheck rule
+__graft_slow_paths__ = ("_decode_group_partials", "_decode_scalar_partials",
+                        "_host_aggregate", "_selection", "host_filter_mask")
+
 
 class ServerQueryExecutor:
     """Executes a QueryContext over a set of local segments."""
 
-    def __init__(self, use_device: bool = True):
+    def __init__(self, use_device: bool = True, bitmap_enabled: bool = True):
         self.use_device = use_device
+        # packed-word bitmap filter indexes (clusterConfig/
+        # server.index.bitmap.enabled): off -> every dict filter leaf keeps
+        # the interval-compare / LUT path regardless of selectivity
+        self.bitmap_enabled = bitmap_enabled
 
     # -- public API --------------------------------------------------------
     def execute(self, segments: Sequence[ImmutableSegment],
@@ -138,6 +149,7 @@ class ServerQueryExecutor:
             ms = (time.perf_counter() - t0) * 1000
             if plan.kind == "empty":
                 st.add(qstats.NUM_SEGMENTS_PRUNED)
+                st.add(qstats.SCAN_ROWS_AVOIDED, segment.num_docs)
             else:
                 st.add(qstats.NUM_SEGMENTS_QUERIED)
                 if (r.num_docs_scanned > 0 or r.groups or r.rows
@@ -197,15 +209,23 @@ class ServerQueryExecutor:
                 distinct_lut_sizes[i] = lut_size(seg.column(agg.arg.name).cardinality)
 
         block = block_for(seg)
+        plan.bitmap_leaves = self._bitmap_leaves(plan, seg)
         spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
                                   tuple(agg_specs), distinct_lut_sizes, block.padded,
-                                  mv_cols=_mv_lut_cols(plan, seg))
+                                  mv_cols=_mv_lut_cols(plan, seg),
+                                  bitmap_leaves=plan.bitmap_leaves)
         inputs = self._kernel_inputs(plan, spec, block)
         outs = kernels.run_kernel(spec, inputs)
 
         if plan.group_cols:
             return self._decode_group_partials(plan, outs)
         return self._decode_scalar_partials(plan, outs)
+
+    def _bitmap_leaves(self, plan: SegmentPlan, seg) -> Tuple[int, ...]:
+        if not self.bitmap_enabled:
+            return ()
+        from .planner import select_bitmap_leaves
+        return select_bitmap_leaves(plan, seg)
 
     def _kernel_inputs(self, plan: SegmentPlan, spec, block):
         import jax.numpy as jnp
@@ -218,8 +238,32 @@ class ServerQueryExecutor:
         iscal: List[int] = []
         fscal: List[float] = []
         docsets = []
-        for leaf in plan.filter_prog.leaves:
+        bitmaps = []
+        for li, leaf in enumerate(plan.filter_prog.leaves):
             if isinstance(leaf, LutLeaf):
+                if li in spec.bitmap_index:
+                    # packed-word path: gather only the LUT-selected dict-id
+                    # rows from the HBM word matrix, padded to pow2 by
+                    # repeating a selected row (OR-idempotent, bounds
+                    # retraces); this leaf never reads the forward id column
+                    # and its word traffic scales with selectivity, not card
+                    words = block.bitmap_words(leaf.col)
+                    assert words is not None, (
+                        f"leaf {li} ({leaf.col}) marked bitmap but the block "
+                        "declined to build words — planner/block gating drifted")
+                    luts.append(jnp.asarray(leaf.lut))
+                    sel = np.asarray(leaf.lut)[:words.shape[0]].astype(bool)
+                    rows = np.where(sel)[0]
+                    if rows.size == 0:
+                        bitmaps.append(jnp.zeros((1, words.shape[1]),
+                                                 dtype=jnp.uint32))
+                    else:
+                        k = 1 << int(rows.size - 1).bit_length()
+                        idx = np.concatenate(
+                            [rows, np.full(k - rows.size, rows[0])])
+                        bitmaps.append(jnp.take(
+                            words, jnp.asarray(idx.astype(np.int32)), axis=0))
+                    continue
                 ids_cols.add(leaf.col)
                 if leaf.intervals is not None:
                     # interval bounds ride the int scalar stream, in leaf order —
@@ -246,10 +290,12 @@ class ServerQueryExecutor:
                 vals_cols.update(identifiers_in(agg.arg))
 
         valid = block.valid
+        valid_words = block.valid_words
         if plan.valid_docs is not None:
             padded = np.zeros(block.padded, dtype=bool)
             padded[:len(plan.valid_docs)] = plan.valid_docs
             valid = valid & jnp.asarray(padded)  # upsert valid-doc intersection
+            valid_words = None                   # packed form is now stale
 
         return KernelInputs(
             ids={c: block.ids(c) for c in ids_cols},
@@ -262,6 +308,8 @@ class ServerQueryExecutor:
             strides=jnp.asarray(np.asarray(plan.strides, dtype=np.int32)),
             agg_luts=agg_luts,
             docsets=tuple(docsets),
+            bitmaps=tuple(bitmaps),
+            valid_words=valid_words,
         )
 
     def _decode_group_partials(self, plan: SegmentPlan, outs,
@@ -551,8 +599,10 @@ class ServerQueryExecutor:
             from ..engine import kernels
             from ..engine.datablock import block_for
             block = block_for(seg)
+            plan.bitmap_leaves = self._bitmap_leaves(plan, seg)
             spec = kernels.KernelSpec(plan.filter_prog, (), 1, (), {}, block.padded,
-                                      mv_cols=_mv_lut_cols(plan, seg))
+                                      mv_cols=_mv_lut_cols(plan, seg),
+                                      bitmap_leaves=plan.bitmap_leaves)
             inputs = self._kernel_inputs(plan, spec, block)
             return kernels.compute_mask(spec, inputs)[:seg.num_docs]
         return host_filter_mask(plan, seg)
@@ -583,7 +633,26 @@ def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
         leaf = prog.leaves[i]
         if isinstance(leaf, LutLeaf):
             reader = seg.column(leaf.col)
-            inv = getattr(reader, "inverted_index", None)
+            # Mutable (consuming) readers: take ONE dict_snapshot and bind the
+            # LUT, the inverted-index view, AND the forward ids to it. Dict
+            # ids REMAP as the sorted dictionary grows, so the compile-time
+            # LUT paired with a fresh index/fwd read (or vice versa) evaluates
+            # the predicate in two different id spaces — the same
+            # mixed-growth hazard the immutable reader never has. The LUT is
+            # rebuilt from the leaf's source predicate against the snapshot
+            # dictionary (LutLeaf.rebuild_lut).
+            snap_fn = getattr(reader, "dict_snapshot", None)
+            snap = snap_fn() if snap_fn is not None else None
+            if snap is not None and snap[1] is None:  # no-dict reader sentinel
+                snap = None
+            lut = leaf.lut
+            if snap is not None and snap[1] is not None and leaf.op is not None:
+                lut = leaf.rebuild_lut(snap[1], len(snap[1]))
+            if snap is not None:
+                iv = getattr(reader, "inverted_view", None)
+                inv = iv(snap) if iv is not None else None
+            else:
+                inv = getattr(reader, "inverted_index", None)
             if inv is not None:
                 # index-aware path (reference: BitmapBasedFilterOperator;
                 # realtime segments serve it from the incrementally-maintained
@@ -591,8 +660,8 @@ def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
                 # materialize the doc set from postings — O(matches) instead
                 # of the O(docs) forward gather; dense predicates keep the
                 # gather, which is cheaper than concatenating huge postings
-                card = min(inv.cardinality, len(leaf.lut))
-                match_ids = np.nonzero(leaf.lut[:card])[0]
+                card = min(inv.cardinality, len(lut))
+                match_ids = np.nonzero(lut[:card])[0]
                 if inv.match_count_for_ids(match_ids) * 8 <= n:
                     mask = np.zeros(n, dtype=bool)
                     docs = inv.doc_ids_for_ids(match_ids)
@@ -601,24 +670,27 @@ def host_filter_mask(plan: SegmentPlan, seg: ImmutableSegment) -> np.ndarray:
             if getattr(reader, "is_multi_value", False):
                 # ANY-value-matches per row (MVScanDocIdIterator semantics); every
                 # row has >= 1 value (writer stores [null] for empty), so reduceat
-                # over the CSR offsets is well-defined. Mutable readers: take flat
-                # ids + offsets from ONE dict_snapshot — separate property reads
-                # could pair arrays from different growth snapshots.
-                snap = getattr(reader, "dict_snapshot", None)
+                # over the CSR offsets is well-defined
                 if snap is not None:
-                    _, _, flat, off = snap()
+                    _, _, flat, off = snap
                 else:
                     flat = np.asarray(reader.fwd).astype(np.int64)
                     off = np.asarray(reader.mv_offsets)
                 if not len(flat):
                     return np.zeros(n, dtype=bool)
-                hits = leaf.lut[np.asarray(flat).astype(np.int64)].astype(np.int32)
+                hits = lut[np.asarray(flat).astype(np.int64)].astype(np.int32)
                 m = np.add.reduceat(hits, np.asarray(off)[:-1]) > 0
                 if len(m) < n:  # snapshot older than the captured row count
                     m = np.pad(m, (0, n - len(m)), constant_values=False)
                 return m[:n]
+            if snap is not None:
+                ids = np.asarray(snap[2]).astype(np.int64)
+                m = lut[ids]
+                if len(m) < n:
+                    m = np.pad(m, (0, n - len(m)), constant_values=False)
+                return m[:n]
             ids = np.asarray(reader.fwd).astype(np.int64)
-            return leaf.lut[ids]
+            return lut[ids]
         if isinstance(leaf, NullLeaf):
             nb = seg.column(leaf.col).null_bitmap
             m = nb if nb is not None else np.zeros(n, dtype=bool)

@@ -81,6 +81,9 @@ class SegmentPlan:
     fallback_reason: str = ""
     # upsert: only rows set in this mask are visible (None = all rows)
     valid_docs: Optional[np.ndarray] = None
+    # LUT-leaf indices the executor routed to the packed-word bitmap index
+    # (select_bitmap_leaves; () when the knob is off or nothing qualifies)
+    bitmap_leaves: Tuple[int, ...] = ()
 
 
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
@@ -152,6 +155,48 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment,
                              scan_docs if scan_docs is not None
                              else segment.num_docs)
     return plan
+
+
+def select_bitmap_leaves(plan: SegmentPlan,
+                         segment: ImmutableSegment) -> Tuple[int, ...]:
+    """LUT leaves worth evaluating through the packed-word bitmap index.
+
+    Per-leaf regime choice (reference: the broker/server pruners choose
+    index-vs-scan per predicate): a leaf qualifies when its column can carry a
+    bitmap index (single-value dict column within BITMAP_MAX_CARD) AND its
+    estimated selectivity sits at or below the calibrated
+    `KernelCaps.bitmap_sel_cap`. Selectivity comes from the inverted index's
+    posting offsets when the segment has one (exact, O(ids) arithmetic),
+    otherwise from matched-ids / cardinality (uniform-occupancy assumption).
+    Dense predicates keep the interval-compare / one-hot LUT path, which beats
+    streaming the whole word matrix when most rows match anyway."""
+    from ..engine.calibrate import get_caps
+    from ..engine.datablock import BITMAP_MAX_CARD
+    if plan.filter_prog is None or plan.filter_prog.is_match_all \
+            or getattr(segment, "is_mutable", False):
+        return ()
+    cap = get_caps().bitmap_sel_cap
+    n = max(segment.num_docs, 1)
+    out = []
+    for i, leaf in enumerate(plan.filter_prog.leaves):
+        if not isinstance(leaf, LutLeaf):
+            continue
+        reader = segment.column(leaf.col)
+        if not reader.has_dictionary \
+                or getattr(reader, "is_multi_value", False):
+            continue
+        card = reader.cardinality
+        if card <= 0 or card > BITMAP_MAX_CARD:
+            continue
+        matched = leaf.lut[:card]
+        inv = getattr(reader, "inverted_index", None)
+        if inv is not None:
+            sel = inv.match_count_for_ids(np.flatnonzero(matched)) / n
+        else:
+            sel = float(matched.sum()) / card
+        if sel <= cap:
+            out.append(i)
+    return tuple(out)
 
 
 def _validate_mv_usage(ctx: QueryContext, aggs: List[AggFunc],

@@ -64,10 +64,20 @@ class LutLeaf:
     col: str
     lut: np.ndarray  # bool[lut_size(card)] — padding ids map to False
     intervals: Optional[List[Tuple[int, int]]] = field(default=None)
+    # source predicate (op + literal values), kept so the LUT can be REBUILT
+    # against a different dictionary snapshot (mutable segments: dict ids remap
+    # as the sorted dictionary grows). Excluded from signature(): same kernel.
+    op: Optional[str] = field(default=None)
+    values: Optional[List[Any]] = field(default=None)
 
     def __post_init__(self):
         if self.intervals is None:
             self.intervals = _lut_intervals(self.lut)
+
+    def rebuild_lut(self, dictionary, cardinality: int) -> np.ndarray:
+        """The same predicate resolved against another dictionary snapshot."""
+        assert self.op is not None
+        return build_lut(self.op, self.values, dictionary, cardinality)
 
     @property
     def kind(self) -> str:
@@ -267,12 +277,9 @@ def _compile_node(e: Expr, seg: ImmutableSegment, leaves: List[Leaf]) -> FilterT
             raise QueryValidationError(str(exc)) from exc
         reader = seg.column(col.name)
         if reader.has_dictionary:
-            from ..engine.datablock import lut_size
-            lut = np.zeros(lut_size(reader.cardinality), dtype=bool)
-            card = reader.cardinality
-            if card:
-                lut[:card] = ids.contains(reader.dictionary._np_values)
-            leaves.append(LutLeaf(col.name, lut))
+            lut = build_lut("idset", [ids], reader.dictionary,
+                            reader.cardinality)
+            leaves.append(LutLeaf(col.name, lut, op="idset", values=[ids]))
         else:
             import hashlib
             mask = ids.contains(reader.values())
@@ -338,7 +345,8 @@ def _compile_predicate(e: Function, seg: ImmutableSegment, leaves: List[Leaf]) -
     if isinstance(lhs, Identifier):
         reader = seg.column(lhs.name)
         if reader.has_dictionary:
-            leaves.append(LutLeaf(lhs.name, _build_lut(e.name, values, reader)))
+            leaves.append(LutLeaf(lhs.name, _build_lut(e.name, values, reader),
+                                  op=e.name, values=values))
             return ("leaf", len(leaves) - 1)
 
     # raw column / expression predicate -> comparison leaf
@@ -350,10 +358,21 @@ def _compile_predicate(e: Function, seg: ImmutableSegment, leaves: List[Leaf]) -
 
 
 def _build_lut(op: str, values: List[Any], reader: ColumnReader) -> np.ndarray:
+    return build_lut(op, values, reader.dictionary, reader.cardinality,
+                     fst_index=getattr(reader, "fst_index", None))
+
+
+def build_lut(op: str, values: List[Any], d, cardinality: int,
+              fst_index=None) -> np.ndarray:
+    """Resolve a predicate against a specific dictionary snapshot. Factored out
+    of the reader-based path so mutable segments can rebuild LUTs against the
+    one dictionary snapshot the whole filter evaluates under."""
     from ..engine.datablock import lut_size  # local import to avoid jax at module import
-    d = reader.dictionary
-    lut = np.zeros(lut_size(reader.cardinality), dtype=bool)
-    if op == "eq":
+    lut = np.zeros(lut_size(cardinality), dtype=bool)
+    if op == "idset":
+        if cardinality:
+            lut[:cardinality] = values[0].contains(d._np_values)
+    elif op == "eq":
         i = d.index_of(values[0])
         if i >= 0:
             lut[i] = True
@@ -375,10 +394,9 @@ def _build_lut(op: str, values: List[Any], reader: ColumnReader) -> np.ndarray:
         # (reference: FSTBasedRegexpPredicateEvaluatorFactory); falls back to
         # the full per-distinct-value regex otherwise
         ids = None
-        fst = getattr(reader, "fst_index", None)
-        if fst is not None:
+        if fst_index is not None:
             from ..segment.indexes.fst import ids_matching_regex_indexed
-            ids = ids_matching_regex_indexed(fst, d.values, str(values[0]))
+            ids = ids_matching_regex_indexed(fst_index, d.values, str(values[0]))
         if ids is None:
             ids = d.ids_matching_regex(str(values[0]))
         lut[ids] = True

@@ -31,6 +31,16 @@ from typing import Dict, Iterator, Optional
 # README) before emitting it.
 NUM_SEGMENTS_QUERIED = "numSegmentsQueried"
 NUM_SEGMENTS_PRUNED = "numSegmentsPruned"
+# per-pruner-kind breakdown of NUM_SEGMENTS_PRUNED: which pruner rejected the
+# segment first (broker metadata pruners) or that the filter folded to
+# constant-false server-side (the pre-existing numSegmentsPruned path)
+NUM_SEGMENTS_PRUNED_BY_PARTITION = "numSegmentsPrunedByPartition"
+NUM_SEGMENTS_PRUNED_BY_TIME = "numSegmentsPrunedByTime"
+NUM_SEGMENTS_PRUNED_BY_RANGE = "numSegmentsPrunedByRange"
+NUM_SEGMENTS_PRUNED_BY_BLOOM = "numSegmentsPrunedByBloom"
+# docs that were never scanned because their segment was pruned (broker
+# metadata pruning + server constant-false folds) — the "work avoided" number
+SCAN_ROWS_AVOIDED = "scanRowsAvoided"
 NUM_SEGMENTS_MATCHED = "numSegmentsMatched"
 NUM_DOCS_SCANNED = "numDocsScanned"
 DEVICE_LAUNCHES = "deviceLaunches"
@@ -55,7 +65,10 @@ ADMISSION_DEFER_MS = "admissionDeferMs"
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
 COUNTER_KEYS = (
-    NUM_SEGMENTS_QUERIED, NUM_SEGMENTS_PRUNED, NUM_SEGMENTS_MATCHED,
+    NUM_SEGMENTS_QUERIED, NUM_SEGMENTS_PRUNED,
+    NUM_SEGMENTS_PRUNED_BY_PARTITION, NUM_SEGMENTS_PRUNED_BY_TIME,
+    NUM_SEGMENTS_PRUNED_BY_RANGE, NUM_SEGMENTS_PRUNED_BY_BLOOM,
+    SCAN_ROWS_AVOIDED, NUM_SEGMENTS_MATCHED,
     DEVICE_LAUNCHES, COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
     COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
@@ -83,6 +96,14 @@ BROKER_KEYS = (
     "numServersResponded", "partialResult", "phaseTimesMs", "traceInfo",
     "traceId", "gapfilled", "explain", "analyze",
 )
+
+#: routing pruner kind (cluster.routing.PRUNER_KINDS) -> its breakdown counter
+PRUNED_BY_KIND = {
+    "partition": NUM_SEGMENTS_PRUNED_BY_PARTITION,
+    "time": NUM_SEGMENTS_PRUNED_BY_TIME,
+    "range": NUM_SEGMENTS_PRUNED_BY_RANGE,
+    "bloom": NUM_SEGMENTS_PRUNED_BY_BLOOM,
+}
 
 _OP_PREFIX = "op:"
 

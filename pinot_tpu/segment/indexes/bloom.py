@@ -34,8 +34,9 @@ def _hash_pair(value: Any) -> tuple[int, int]:
     return int.from_bytes(d[:8], "little"), int.from_bytes(d[8:], "little")
 
 
-def create_bloom_filter(path: str, values: Iterable[Any], data_type: DataType,
-                        fpp: float = _DEFAULT_FPP) -> None:
+def _build_bloom_bytes(values: Iterable[Any], fpp: float) -> np.ndarray:
+    """[k, m] int64 header (as uint8) + bit array — the shared wire layout
+    for both the on-disk filter and the metadata-carried hex form."""
     vals = list(values)
     n = max(1, len(vals))
     m = max(64, int(-n * math.log(fpp) / (math.log(2) ** 2)))
@@ -47,8 +48,35 @@ def create_bloom_filter(path: str, values: Iterable[Any], data_type: DataType,
         for i in range(k):
             pos = (h1 + i * h2) % m
             bits[pos >> 3] |= 1 << (pos & 7)
-    # header: [k, m] as int64 bytes, then the bit array
-    np.save(path, np.concatenate([np.array([k, m], dtype=np.int64).view(np.uint8), bits]))
+    return np.concatenate([np.array([k, m], dtype=np.int64).view(np.uint8),
+                           bits])
+
+
+def create_bloom_filter(path: str, values: Iterable[Any], data_type: DataType,
+                        fpp: float = _DEFAULT_FPP) -> None:
+    np.save(path, _build_bloom_bytes(values, fpp))
+
+
+def bloom_hex(values: Iterable[Any], fpp: float = _DEFAULT_FPP) -> str:
+    """Serialize a bloom filter over `values` to a hex string small enough to
+    ride in segment metadata (broker-side pruning evaluates it without ever
+    opening the segment)."""
+    return _build_bloom_bytes(values, fpp).tobytes().hex()
+
+
+def bloom_hex_might_contain(hex_str: str, value: Any) -> bool:
+    """Membership probe against a `bloom_hex` payload (no numpy round trip:
+    the broker calls this per segment per EQ literal on the routing path)."""
+    raw = bytes.fromhex(hex_str)
+    k = int.from_bytes(raw[0:8], "little")
+    m = int.from_bytes(raw[8:16], "little")
+    bits = raw[16:]
+    h1, h2 = _hash_pair(value)
+    for i in range(k):
+        pos = (h1 + i * h2) % m
+        if not (bits[pos >> 3] >> (pos & 7)) & 1:
+            return False
+    return True
 
 
 class BloomFilterReader:

@@ -173,10 +173,18 @@ class MutableColumnReader:
         """Point-in-time view of the realtime inverted index (reference:
         RealtimeInvertedIndex), id-space-consistent with THIS reader's sorted
         dictionary snapshot; None when the column isn't inverted-indexed."""
+        return self.inverted_view(self._snapshot())
+
+    def inverted_view(self, snapshot: tuple):
+        """The realtime inverted index bound to a CALLER-HELD snapshot: dict
+        ids remap as the sorted dictionary grows, so a filter that pairs the
+        index with LUTs/forward ids must bind all of them to the SAME
+        (rows, dictionary) pair — a fresh `inverted_index` read between two
+        appends would be a different id space."""
         idx = self.store.inverted_indexes.get(self.name)
         if idx is None or not self.has_dictionary:
             return None
-        n, d = self._snapshot()[:2]
+        n, d = snapshot[:2]
         return idx.view(d, n) if d is not None else None
 
     # other aux indexes don't exist while consuming (range/bloom start at commit)

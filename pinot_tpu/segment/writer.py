@@ -21,7 +21,7 @@ from ..schema import DataType, FieldSpec, Schema
 from . import format as fmt
 from .dictionary import build_dictionary
 from .indexes.inverted import create_inverted_index
-from .indexes.bloom import create_bloom_filter
+from .indexes.bloom import bloom_hex, create_bloom_filter
 from .indexes.range import create_range_index
 
 
@@ -276,6 +276,16 @@ class SegmentBuilder:
             values = dictionary.values if use_dict else raw
             create_bloom_filter(prefix + fmt.BLOOM_SUFFIX, values, data_type)
             indexes.append("bloom")
+        # metadata bloom payload: rides on EVERY dict-encoded column (card
+        # capped by _meta_bloom_hex) so the broker can EQ/IN-prune a 10k
+        # segment table without any per-table index config; raw columns only
+        # carry it when a bloom index was asked for (deduping an arbitrary
+        # raw column at commit is not free)
+        if use_dict or name in self.config.bloom_filter_columns:
+            hx = _meta_bloom_hex(dictionary.values if use_dict else raw,
+                                 deduped=use_dict)
+            if hx is not None:
+                meta["bloomHex"] = hx
 
         if name in self.config.json_index_columns:
             from .indexes.jsonidx import create_json_index
@@ -356,11 +366,36 @@ class SegmentBuilder:
         if name in self.config.bloom_filter_columns:
             create_bloom_filter(prefix + fmt.BLOOM_SUFFIX, dictionary.values, data_type)
             indexes.append("bloom")
+        # MV columns are always dict-encoded: metadata bloom rides by default
+        hx = _meta_bloom_hex(dictionary.values, deduped=True)
+        if hx is not None:
+            meta["bloomHex"] = hx
         if null_mask.any():
             np.save(prefix + fmt.NULLS_SUFFIX, fmt.pack_bitmap(null_mask))
             meta["hasNulls"] = True
         meta["indexes"] = indexes
         return meta
+
+
+#: distinct-value ceiling for the metadata-carried bloom payload: broker-side
+#: pruning wants small catalog entries, and a higher-cardinality column almost
+#: never prunes a whole segment on one EQ literal anyway
+_META_BLOOM_MAX_CARD = 1024
+
+
+def _meta_bloom_hex(values, deduped: bool) -> Optional[str]:
+    """Hex bloom payload destined for segment metadata (`bloomHex` in the
+    per-column meta) — None when the distinct-value count would bloat the
+    catalog. The on-disk `.bloom.npy` file is unaffected."""
+    vals = list(values)
+    if not deduped:
+        try:
+            vals = list(dict.fromkeys(vals))
+        except TypeError:       # unhashable cells: skip the metadata copy
+            return None
+    if len(vals) > _META_BLOOM_MAX_CARD:
+        return None
+    return bloom_hex(vals)
 
 
 def _encode_with_fixed_dict(raw, dictionary, name: str) -> np.ndarray:

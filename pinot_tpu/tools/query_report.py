@@ -95,8 +95,11 @@ def render_report(stats: Dict[str, Any]) -> str:
         out.append(f"  {'device skew':<15} {skew}  (worst mesh launch)")
     out.append("")
     out.append("counters")
-    for key in ("numSegmentsQueried", "numSegmentsPruned", "numSegmentsMatched",
-                "numDocsScanned", "numGroupsTotal", "deviceLaunches",
+    for key in ("numSegmentsQueried", "numSegmentsPruned",
+                "numSegmentsPrunedByPartition", "numSegmentsPrunedByTime",
+                "numSegmentsPrunedByRange", "numSegmentsPrunedByBloom",
+                "numSegmentsMatched", "numDocsScanned", "scanRowsAvoided",
+                "numGroupsTotal", "deviceLaunches",
                 "dedupedLaunches", "stackedLaunches", "compileCacheHits",
                 "compileCacheMisses", "bytesFetched", "numServersQueried",
                 "numServersResponded"):

@@ -16,8 +16,9 @@ from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
 from pinot_tpu.analysis import (admission_hygiene, blocking_in_loop,
                                 collective_hygiene, drift_guards,
-                                exception_hygiene, ingest_hot_loop,
-                                jit_hygiene, lock_discipline, transport_bypass)
+                                exception_hygiene, filter_path,
+                                ingest_hot_loop, jit_hygiene, lock_discipline,
+                                transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -528,6 +529,74 @@ def test_row_loop_suppression_honored():
     """, ingest_hot_loop.rules(), rel=_HOT_REL)
     assert active == []
     assert _ids(suppressed) == ["row-loop-in-ingest"]
+
+
+# -- filter-path-host-materialization -----------------------------------------
+
+_FILTER_REL = "pinot_tpu/query/executor.py"
+
+
+def test_filter_path_nonzero_true_positive():
+    active, _ = _check("""
+        import numpy as np
+        def fast_mask(lut, ids):
+            return np.nonzero(lut[ids])[0]
+    """, filter_path.rules(), rel=_FILTER_REL)
+    assert _ids(active) == ["filter-path-host-materialization"]
+
+
+def test_filter_path_postings_loop_flagged():
+    active, _ = _check("""
+        def collect(inv, match_ids, n):
+            mask = [False] * n
+            for doc in inv.doc_ids_for(match_ids):
+                mask[doc] = True
+            return mask
+    """, filter_path.rules(), rel=_FILTER_REL)
+    assert "filter-path-host-materialization" in _ids(active)
+
+
+def test_filter_path_slow_path_declaration_exempts():
+    active, _ = _check("""
+        import numpy as np
+        __graft_slow_paths__ = ("host_filter_mask",)
+
+        def host_filter_mask(lut, ids):
+            def leaf_mask(i):
+                return np.nonzero(lut[ids])[0]
+            return leaf_mask(0)
+    """, filter_path.rules(), rel=_FILTER_REL)
+    assert active == []
+
+
+def test_filter_path_outside_hot_modules_ignored():
+    active, _ = _check("""
+        import numpy as np
+        def route(lut):
+            return np.flatnonzero(lut)
+    """, filter_path.rules(), rel="pinot_tpu/query/planner.py")
+    assert active == []
+
+
+def test_filter_path_clean_negative():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        def word_mask(words, sel):
+            return jnp.sum(jnp.where(sel[:, None], words, jnp.uint32(0)),
+                           axis=0, dtype=jnp.uint32)
+    """, filter_path.rules(), rel=_FILTER_REL)
+    assert active == []
+
+
+def test_filter_path_suppression_honored():
+    active, suppressed = _check("""
+        import numpy as np
+        def probe(lut):
+            # graftcheck: ignore[filter-path-host-materialization] -- fixture
+            return np.nonzero(lut)[0]
+    """, filter_path.rules(), rel=_FILTER_REL)
+    assert active == []
+    assert _ids(suppressed) == ["filter-path-host-materialization"]
 
 
 # -- exception-hygiene --------------------------------------------------------

@@ -1,0 +1,207 @@
+"""Broker-side metadata pruning (PR 12): commit-time per-column min/max +
+bloom stats in SegmentMeta, range/bloom pruners in routing, per-pruner-kind
+ExecutionStats counters, and the BROKER_PRUNE EXPLAIN ANALYZE row.
+
+Reference: ColumnValueSegmentPruner — the broker rejects segments from
+metadata alone, without ever opening them.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import QuickCluster
+from pinot_tpu.cluster.catalog import COLUMN_STATS_KEY, SegmentMeta
+from pinot_tpu.cluster.routing import (PRUNE_ROWS_AVOIDED, PRUNER_KINDS,
+                                       _count_prune, _prune_reason)
+from pinot_tpu.query import stats as qstats
+from pinot_tpu.schema import DataType, Schema, date_time, dimension, metric
+from pinot_tpu.segment.indexes.bloom import bloom_hex
+from pinot_tpu.sql.parser import parse_query
+from pinot_tpu.table import TableConfig
+
+
+def _filter_of(sql_where: str):
+    stmt = parse_query(f"SELECT COUNT(*) FROM t WHERE {sql_where}")
+    return stmt.where
+
+
+def _meta(col_stats=None, **kw) -> SegmentMeta:
+    meta = SegmentMeta("seg_0", "t_OFFLINE", num_docs=1000, **kw)
+    if col_stats is not None:
+        meta.custom[COLUMN_STATS_KEY] = col_stats
+    return meta
+
+
+CFG = TableConfig("t")
+
+
+# -- _prune_reason: range -----------------------------------------------------
+
+@pytest.mark.parametrize("where,reason", [
+    ("v > 50", "range"), ("v >= 11", "range"), ("v < 0", "range"),
+    ("v <= -1", "range"), ("v = 42", "range"), ("v IN (40, 50)", "range"),
+    ("v BETWEEN 20 AND 30", "range"),
+    # may-match forms: the range overlaps [0, 10]
+    ("v > 5", None), ("v >= 10", None), ("v < 1", None), ("v <= 0", None),
+    ("v = 7", None), ("v IN (40, 7)", None), ("v BETWEEN 5 AND 30", None),
+])
+def test_range_pruning(where, reason):
+    meta = _meta({"v": {"min": 0, "max": 10}})
+    assert _prune_reason(_filter_of(where), CFG, meta) == reason
+
+
+def test_range_pruning_cross_type_degrades_to_may_match():
+    # columnStats round-trip through JSON: a str-vs-int comparison must keep
+    # the segment, never throw
+    meta = _meta({"v": {"min": "a", "max": "z"}})
+    assert _prune_reason(_filter_of("v > 50"), CFG, meta) is None
+
+
+def test_range_pruning_without_stats_keeps_segment():
+    assert _prune_reason(_filter_of("v > 50"), CFG, _meta()) is None
+    assert _prune_reason(_filter_of("v > 50"), CFG, _meta({})) is None
+
+
+# -- _prune_reason: bloom -----------------------------------------------------
+
+def test_bloom_pruning_eq_and_in():
+    hx = bloom_hex(["asia", "europe"], 0.01)
+    meta = _meta({"region": {"bloom": hx}})
+    assert _prune_reason(_filter_of("region = 'mars'"), CFG, meta) == "bloom"
+    assert _prune_reason(_filter_of("region = 'asia'"), CFG, meta) is None
+    assert _prune_reason(
+        _filter_of("region IN ('mars', 'pluto')"), CFG, meta) == "bloom"
+    # one possibly-present member keeps the segment
+    assert _prune_reason(
+        _filter_of("region IN ('mars', 'europe')"), CFG, meta) is None
+
+
+def test_bloom_never_applies_to_ranges():
+    hx = bloom_hex(["asia"], 0.01)
+    meta = _meta({"region": {"bloom": hx}})
+    assert _prune_reason(_filter_of("region > 'mars'"), CFG, meta) is None
+
+
+# -- _prune_reason: tree logic ------------------------------------------------
+
+def test_and_prunes_when_any_conjunct_misses():
+    meta = _meta({"v": {"min": 0, "max": 10}})
+    assert _prune_reason(
+        _filter_of("v > 50 AND region = 'x'"), CFG, meta) == "range"
+    assert _prune_reason(
+        _filter_of("region = 'x' AND v > 5"), CFG, meta) is None
+
+
+def test_or_prunes_only_when_all_branches_miss():
+    hx = bloom_hex(["asia"], 0.01)
+    meta = _meta({"v": {"min": 0, "max": 10}, "region": {"bloom": hx}})
+    assert _prune_reason(
+        _filter_of("v > 50 OR region = 'mars'"), CFG, meta) == "range"
+    assert _prune_reason(
+        _filter_of("v > 50 OR region = 'asia'"), CFG, meta) is None
+
+
+# -- _count_prune -------------------------------------------------------------
+
+def test_count_prune_accumulates_kind_and_rows():
+    stats = {}
+    _count_prune(stats, "range", _meta())
+    _count_prune(stats, "range", _meta())
+    _count_prune(stats, "bloom", _meta())
+    _count_prune(None, "range", _meta())   # no-op without a sink
+    assert stats["range"] == 2 and stats["bloom"] == 1
+    assert stats[PRUNE_ROWS_AVOIDED] == 3000
+    assert set(stats) - {PRUNE_ROWS_AVOIDED} <= set(PRUNER_KINDS)
+
+
+def test_pruned_by_kind_key_table_covers_every_pruner():
+    assert set(qstats.PRUNED_BY_KIND) == set(PRUNER_KINDS)
+    for key in qstats.PRUNED_BY_KIND.values():
+        assert key in qstats.COUNTER_KEYS
+
+
+# -- end-to-end through the in-proc broker ------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    schema = Schema("ev", [
+        dimension("site", DataType.STRING),
+        metric("v", DataType.LONG),
+        date_time("ts", DataType.LONG),
+    ])
+    cluster = QuickCluster(num_servers=2, work_dir=str(tmp_path))
+    cfg = TableConfig("ev", replication=1)
+    cluster.create_table(schema, cfg)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        cluster.ingest_columns(cfg, {
+            "site": np.array(["a", "b", "c", "d"] * 25),
+            "v": rng.integers(i * 100, (i + 1) * 100, 100),
+            "ts": np.full(100, 1_700_000_000_000 + i),
+        })
+    return cluster
+
+
+def test_commit_lifts_column_stats_into_segment_meta(cluster):
+    metas = cluster.catalog.segments["ev_OFFLINE"]
+    assert metas
+    for meta in metas.values():
+        cs = meta.custom.get(COLUMN_STATS_KEY)
+        assert cs and "v" in cs and "site" in cs
+        assert cs["v"]["min"] is not None and cs["v"]["max"] is not None
+        assert cs["site"].get("bloom")          # low-card string: bloom rides
+
+
+def test_range_prune_counted_per_kind_end_to_end(cluster):
+    # segment i holds v in [i*100, (i+1)*100): v >= 250 range-prunes 0 and 1
+    res = cluster.query("SELECT COUNT(*) FROM ev WHERE v >= 250")
+    assert res.stats["numSegmentsPrunedByRange"] == 2
+    assert res.stats["numSegmentsPruned"] >= 2
+    assert res.stats["numSegmentsQueried"] == 1
+    assert res.stats["scanRowsAvoided"] >= 200
+    # the answer itself stays correct
+    full = cluster.query("SELECT COUNT(*) FROM ev").rows[0][0]
+    kept = cluster.query("SELECT COUNT(*) FROM ev WHERE v < 250").rows[0][0]
+    assert res.rows[0][0] + kept == full
+
+
+def test_bloom_prune_counted_end_to_end(cluster):
+    # 'bb' falls INSIDE [min='a', max='d'] so the range pruner keeps the
+    # segment; only the bloom probe can prove absence
+    res = cluster.query("SELECT COUNT(*) FROM ev WHERE site = 'bb'")
+    assert res.stats["numSegmentsPrunedByBloom"] == 3
+    assert res.stats["numSegmentsQueried"] == 0
+    assert res.stats["scanRowsAvoided"] == 300
+    assert res.rows[0][0] == 0
+    # a literal beyond max attributes to the range pruner instead
+    res = cluster.query("SELECT COUNT(*) FROM ev WHERE site = 'nope'")
+    assert res.stats["numSegmentsPrunedByRange"] == 3
+    assert res.stats["numSegmentsPrunedByBloom"] == 0
+    # a present value is never bloom-pruned (no false negatives)
+    hit = cluster.query("SELECT COUNT(*) FROM ev WHERE site = 'a'")
+    assert hit.stats["numSegmentsPrunedByBloom"] == 0
+    assert hit.rows[0][0] == 75
+
+
+def test_prune_invariant_pruned_plus_queried_is_total(cluster):
+    for sql in ("SELECT COUNT(*) FROM ev WHERE site = 'bb'",
+                "SELECT COUNT(*) FROM ev WHERE v >= 250",
+                "SELECT COUNT(*) FROM ev WHERE site = 'a' AND v < 150"):
+        res = cluster.query(sql)
+        assert (res.stats["numSegmentsPruned"]
+                + res.stats["numSegmentsQueried"]) == 3, sql
+        by_kind = sum(res.stats[k] for k in qstats.PRUNED_BY_KIND.values())
+        assert by_kind <= res.stats["numSegmentsPruned"], sql
+
+
+def test_broker_prune_row_in_explain_analyze(cluster):
+    res = cluster.query(
+        "EXPLAIN ANALYZE SELECT COUNT(*) FROM ev WHERE v >= 250")
+    prune_rows = [r for r in res.rows if r[0].startswith("BROKER_PRUNE")]
+    assert len(prune_rows) == 1
+    row = prune_rows[0]
+    assert "range:2" in row[0]
+    assert row[2] == 0 and row[3] == 2      # child of root, Rows = pruned segs
+    # an unpruned query renders NO broker prune row
+    res2 = cluster.query("EXPLAIN ANALYZE SELECT COUNT(*) FROM ev")
+    assert not [r for r in res2.rows if r[0].startswith("BROKER_PRUNE")]
