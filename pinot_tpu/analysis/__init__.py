@@ -11,7 +11,9 @@ codebase-specific rule packs over stdlib `ast` (no new dependencies):
   arrays rebuilt inside jit'd functions, unhashable jit cache-key components.
 * **lock-discipline** — for lock-owning classes: attributes written both
   under and outside their lock, manual acquire()/release(), daemon threads
-  with no join/stop path.
+  with no join/stop path, and cross-method races (an attr guarded in one
+  method but touched lock-free on a thread-entry path, possibly through
+  helpers in other modules).
 * **blocking-in-loop** — unbounded `Future.result()` / queue `.get()` waits
   and sleeps inside dispatcher/fetcher loops and HTTP handlers.
 * **drift-guards** — declarative docs-vs-code guards: metric registry vs the
@@ -23,7 +25,16 @@ codebase-specific rule packs over stdlib `ast` (no new dependencies):
   failure taxonomy the broker's routing health depends on (the PR 7
   `join_stage` lesson).
 
-Run it:  ``python -m pinot_tpu.analysis [--format text|json] [--update-baseline]``
+The rule packs share one interprocedural layer (``analysis/callgraph.py``):
+a project-wide symbol table, a call graph with ``self.``/``cls.`` dispatch,
+and per-function summaries computed to a fixpoint — device-returning
+functions, device-tainted ``self._attr`` stores, and lock-annotated
+attribute accesses folded through param-forwarding calls. Cross-module
+findings carry their propagation chain in the message; the chain never
+enters the baseline fingerprint.
+
+Run it:  ``python -m pinot_tpu.analysis [--changed-only] [--format text|json]
+[--update-baseline]``
 
 Findings are suppressed inline with
 ``# graftcheck: ignore[rule-id] -- reason`` (the reason is mandatory) or

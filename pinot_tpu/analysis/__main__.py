@@ -9,13 +9,60 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 from collections import Counter
-from typing import List
+from typing import List, Optional, Tuple
 
 from .core import (BASELINE_PATH, Finding, all_rules, load_baseline,
-                   run_project, save_baseline, unbaselined)
+                   repo_root_for_package, run_project, save_baseline,
+                   unbaselined)
+
+#: above this many changed .py files an incremental run stops paying off
+#: (the reverse-import closure approaches the whole package anyway)
+_CHANGED_ONLY_CAP = 25
+
+
+def _changed_files(repo_root: str) -> Optional[List[str]]:
+    """Repo-relative paths differing from the git index (staged, unstaged
+    and untracked), or None when git cannot answer."""
+    try:
+        proc = subprocess.run(
+            ["git", "-C", repo_root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) <= 3:
+            continue
+        path = line[3:]
+        if " -> " in path:                  # rename: analyse the new side
+            path = path.split(" -> ", 1)[1]
+        out.append(path.strip().strip('"'))
+    return out
+
+
+def _changed_only_rels(repo_root: str) -> Tuple[Optional[List[str]], str]:
+    """(restrict set, note). A None restrict set means fall back to the
+    full run — the note says why."""
+    changed = _changed_files(repo_root)
+    if changed is None:
+        return None, "git unavailable — running full analysis"
+    if any(p.startswith("pinot_tpu/analysis/") for p in changed):
+        return None, ("analyzer sources changed — call graph/rules may be "
+                      "stale, running full analysis")
+    if any(p == "README.md" for p in changed):
+        return None, "README.md changed — drift guards need a full run"
+    rels = [p for p in changed
+            if p.endswith(".py") and p.startswith("pinot_tpu/")]
+    if len(rels) > _CHANGED_ONLY_CAP:
+        return None, (f"{len(rels)} files changed (> {_CHANGED_ONLY_CAP}) — "
+                      "running full analysis")
+    return rels, ""
 
 
 def main(argv: List[str] = None) -> int:
@@ -34,6 +81,12 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="accept current findings into the baseline and "
                          "exit 0")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="analyse only modules reachable (via reverse "
+                         "imports) from files changed vs the git index; "
+                         "falls back to a full run when git is unavailable, "
+                         "the analyzer itself changed, or the change set is "
+                         "large")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -41,9 +94,24 @@ def main(argv: List[str] = None) -> int:
         for rule in all_rules():
             print(f"{rule.id:28s} {rule.description}")
         return 0
+    if args.changed_only and args.paths:
+        ap.error("--changed-only cannot be combined with explicit paths")
+    if args.changed_only and args.update_baseline:
+        ap.error("--update-baseline needs the full finding set; drop "
+                 "--changed-only")
+
+    restrict = None
+    if args.changed_only:
+        restrict, note = _changed_only_rels(repo_root_for_package())
+        if restrict is None:
+            print(f"graftcheck: --changed-only: {note}", file=sys.stderr)
+        else:
+            print(f"graftcheck: --changed-only: {len(restrict)} changed "
+                  "module(s)", file=sys.stderr)
 
     t0 = time.perf_counter()
-    findings, suppressed, _ctx = run_project(args.paths or None)
+    findings, suppressed, _ctx = run_project(args.paths or None,
+                                             restrict_rels=restrict)
     if args.update_baseline:
         save_baseline(findings, args.baseline)
         print(f"baseline updated: {len(findings)} finding(s) accepted "

@@ -57,12 +57,12 @@ def _is_bounded_queue(call: ast.Call) -> bool:
     return False
 
 
-def _executor_bindings(tree: ast.AST) -> Set[str]:
+def _executor_bindings(module) -> Set[str]:
     """Names (or attribute tails: `self._pool` -> `_pool`) assigned from a
     ThreadPoolExecutor construction anywhere in the module."""
     names: Set[str] = set(_EXECUTOR_NAMES)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+    for node in module.nodes_of(ast.Assign):
+        if not isinstance(node.value, ast.Call):
             continue
         ctor = dotted_name(node.value.func)
         if not ctor.endswith("ThreadPoolExecutor"):
@@ -96,11 +96,9 @@ class AdmissionBypassRule(Rule):
                      ) -> Iterable[Finding]:
         if _MODULE_MARKER not in module.rel:
             return ()
-        executors = _executor_bindings(module.tree)
+        executors = _executor_bindings(module)
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in module.nodes_of(ast.Call):
             ctor = dotted_name(node.func)
             if ctor in _QUEUE_CTORS:
                 if not _is_bounded_queue(node):

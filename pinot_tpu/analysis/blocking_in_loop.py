@@ -57,9 +57,7 @@ class ResultNoTimeoutRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in module.nodes_of(ast.Call):
             if dotted_name(node.func).split(".")[-1] == "as_completed" and \
                     not _has_timeout(node):
                 out.append(Finding(
@@ -116,9 +114,8 @@ class QueueGetNoTimeoutRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not (isinstance(node, ast.Call) and
-                    isinstance(node.func, ast.Attribute) and
+        for node in module.nodes_of(ast.Call):
+            if not (isinstance(node.func, ast.Attribute) and
                     node.func.attr == "get" and
                     not node.args):
                 continue
@@ -144,9 +141,7 @@ class SleepInLoopRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in module.nodes_of(ast.Call):
             name = dotted_name(node.func)
             fn = _in_loop_function(node)
             if not fn:

@@ -1,0 +1,761 @@
+"""Interprocedural layer: project symbol table, call graph, function summaries.
+
+The per-scope rule packs see one function at a time; this module gives them
+the project view the ROADMAP called for ("cross-function taint tracking for
+device values"). One extraction pass per parsed Module collects, per function:
+
+* ordered assignment/return facts (what taints what, resolved lazily),
+* `self._attr` stores and their value facts,
+* call sites with param-forwarding (`self.m()`, `helper(self)`, aliases),
+* attribute accesses on parameters with the lock-attrs held at the site.
+
+A single fixpoint pass then computes summaries:
+
+* `returns_device` + a representative producer chain (`g() -> f()`), so a
+  caller in another module that host-syncs `x = g(...)` is a finding with the
+  whole propagation path in the message;
+* class-level `device_attrs` (`self._x = jnp...` in one method taints
+  `self._x` reads in every other method);
+* transitive unguarded attribute accesses per parameter, so a thread-entry
+  method that reaches `self._buf` through two helpers (possibly in another
+  module, via `drain(self)`) is still visible to the race detector.
+
+Everything is resolved through per-module import tables (plain, aliased and
+relative imports), so `from jax import device_get as dg` cannot hide a sync.
+The build is one walk + one fixpoint and is cached on the AnalysisContext —
+rules share it, nothing is recomputed per rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
+
+from .core import Module, dotted_name
+
+#: value producers that put data on the device (same set as jit_hygiene)
+DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+#: calls that bring a value back to host (a summary "kill"): their result is
+#: host data, whatever went in
+HOST_FETCHERS = {"jax.device_get", "device_get", "np.asarray", "np.array",
+                 "numpy.asarray", "numpy.array", "float", "int", "bool",
+                 "len", "str"}
+
+#: container method calls treated as writes to the receiver attribute
+MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "update",
+            "clear", "extend", "remove", "discard", "setdefault"}
+
+_CHAIN_CAP = 5          # representative chains stay readable
+_FIXPOINT_CAP = 20      # safety bound; monotone facts converge in 2-4 passes
+
+
+# -- facts collected during extraction ---------------------------------------
+
+class Access:
+    """One attribute access on a function parameter (`p.attr`)."""
+
+    __slots__ = ("attr", "kind", "rel", "line", "held", "chain")
+
+    def __init__(self, attr: str, kind: str, rel: str, line: int,
+                 held: FrozenSet[str], chain: Tuple[str, ...]):
+        self.attr = attr
+        self.kind = kind            # 'read' | 'write'
+        self.rel = rel              # module the access physically lives in
+        self.line = line
+        self.held = held            # lock-ish attrs of the SAME receiver held
+        self.chain = chain          # call path from the summarized function
+
+    def key(self) -> Tuple[str, str, FrozenSet[str]]:
+        return (self.attr, self.kind, self.held)
+
+
+class CallFact:
+    """One call site, with enough to resolve + forward parameters later."""
+
+    __slots__ = ("func", "line", "forwards", "held")
+
+    def __init__(self, func: ast.AST, line: int,
+                 forwards: List[Tuple[int, int]], held: FrozenSet[str]):
+        self.func = func            # the ast func expression (resolved later)
+        self.line = line
+        self.forwards = forwards    # (caller_param_idx, callee_param_idx)
+        self.held = held            # locks held on param 0's receiver at site
+
+
+class FunctionInfo:
+    """One module function / class method plus its interprocedural summary."""
+
+    __slots__ = ("name", "display", "module", "node", "cls", "params",
+                 "assign_facts", "return_facts", "attr_stores", "calls",
+                 "param_accesses", "returns_device", "device_chain",
+                 "local_taint")
+
+    def __init__(self, name: str, display: str, module: Module,
+                 node: ast.AST, cls: Optional["ClassInfo"],
+                 params: List[str]):
+        self.name = name
+        self.display = display      # e.g. 'Broker.handle' or 'helper'
+        self.module = module
+        self.node = node
+        self.cls = cls
+        self.params = params
+        # extraction output (source order)
+        self.assign_facts: List[Tuple[Tuple[str, ...], tuple]] = []
+        self.return_facts: List[tuple] = []
+        self.attr_stores: List[Tuple[str, tuple, int]] = []  # self.X = value
+        self.calls: List[CallFact] = []
+        #: param idx -> {Access.key(): Access}, grows to fixpoint
+        self.param_accesses: Dict[int, Dict[tuple, Access]] = {}
+        # summary
+        self.returns_device = False
+        self.device_chain: Tuple[str, ...] = ()
+        self.local_taint: Dict[str, Tuple[str, ...]] = {}
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "node", "methods", "bases",
+                 "device_attrs", "lock_attrs")
+
+    def __init__(self, name: str, module: Module, node: ast.ClassDef):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.bases: List[str] = []          # resolved class keys, best-effort
+        #: attr -> producer chain for self-attrs stored from device values
+        self.device_attrs: Dict[str, Tuple[str, ...]] = {}
+        self.lock_attrs: FrozenSet[str] = frozenset()
+
+    def method(self, name: str, cg: "CallGraph",
+               _seen: Optional[set] = None) -> Optional[FunctionInfo]:
+        """Method lookup through project-resolvable bases."""
+        if name in self.methods:
+            return self.methods[name]
+        _seen = _seen or set()
+        for b in self.bases:
+            if b in _seen:
+                continue
+            _seen.add(b)
+            base = cg.classes.get(b)
+            if base is not None:
+                m = base.method(name, cg, _seen)
+                if m is not None:
+                    return m
+        return None
+
+
+# -- module symbol/import tables ----------------------------------------------
+
+def module_name_for(rel: str) -> str:
+    """'pinot_tpu/cluster/broker.py' -> 'pinot_tpu.cluster.broker'."""
+    name = rel[:-3] if rel.endswith(".py") else rel
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+class _ModuleTable:
+    """Per-module name bindings: local defs + imports (aliases, relative)."""
+
+    __slots__ = ("module", "modname", "is_pkg", "bindings")
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.modname = module_name_for(module.rel)
+        self.is_pkg = module.rel.endswith("__init__.py")
+        #: name -> ('mod', module_name) | ('sym', 'module_name:Symbol')
+        self.bindings: Dict[str, Tuple[str, str]] = {}
+
+    def _package(self) -> str:
+        if self.is_pkg:
+            return self.modname
+        return self.modname.rpartition(".")[0]
+
+    def scan_imports(self) -> None:
+        for node in self.module.nodes_of(ast.Import, ast.ImportFrom):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.bindings[a.asname] = ("mod", a.name)
+                    else:
+                        self.bindings[a.name.split(".")[0]] = \
+                            ("mod", a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg_parts = self._package().split(".")
+                    if node.level - 1:
+                        pkg_parts = pkg_parts[: -(node.level - 1)] \
+                            if node.level - 1 <= len(pkg_parts) else []
+                    base = ".".join(p for p in (".".join(pkg_parts), base)
+                                    if p)
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if a.name == "*":
+                        continue
+                    self.bindings[bound] = ("sym", f"{base}:{a.name}")
+
+
+# -- value facts ---------------------------------------------------------------
+
+# fact shapes (tuples so the fixpoint loop stays allocation-light):
+#   ('device',)            direct jnp./lax. producer
+#   ('host',)              known host materializer — kills taint
+#   ('call', CallNode)     resolved at fixpoint time
+#   ('name', 'x')          alias of a local
+#   ('selfattr', 'attr')   read of self.attr
+#   ('multi', [facts])     tuple/ifexp/binop — tainted if any member is
+#   ('other',)
+
+def classify_value(expr: ast.AST) -> tuple:
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+        if name.startswith(DEVICE_PREFIXES):
+            return ("device",)
+        if name in HOST_FETCHERS:
+            return ("host",)
+        return ("call", expr)
+    if isinstance(expr, ast.Name):
+        return ("name", expr.id)
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return ("selfattr", expr.attr)
+        return ("other",)
+    if isinstance(expr, ast.Subscript):
+        return classify_value(expr.value)
+    if isinstance(expr, (ast.Await, ast.Starred)):
+        return classify_value(expr.value)
+    if isinstance(expr, ast.BinOp):
+        return ("multi", [classify_value(expr.left),
+                          classify_value(expr.right)])
+    if isinstance(expr, ast.Tuple):
+        return ("multi", [classify_value(e) for e in expr.elts])
+    if isinstance(expr, ast.IfExp):
+        return ("multi", [classify_value(expr.body),
+                          classify_value(expr.orelse)])
+    return ("other",)
+
+
+_LOCKISH = ("lock", "cond", "mutex")
+
+
+def _lockish(attr: str) -> bool:
+    low = attr.lower()
+    return any(t in low for t in _LOCKISH)
+
+
+class _Extractor(ast.NodeVisitor):
+    """One pass over a function body collecting ordered facts.
+
+    Maintains the `with p.lock:` stack so every param-attr access and call
+    site records the locks held on its receiver — no parent links needed."""
+
+    def __init__(self, fi: FunctionInfo):
+        self.fi = fi
+        self.param_idx = {p: i for i, p in enumerate(fi.params)}
+        #: rootname -> set of lock-ish attrs currently held on it
+        self.held: Dict[str, set] = {}
+
+    def _held_for(self, root: str) -> FrozenSet[str]:
+        return frozenset(self.held.get(root, ()))
+
+    def _is_lock_attr(self, root: str, attr: str) -> bool:
+        """Lock-ish by name; for `self`, also by the owning class's actual
+        lock attrs (a `self._mu = threading.Lock()` is a lock whatever it's
+        called)."""
+        if _lockish(attr):
+            return True
+        return root == "self" and self.fi.cls is not None and \
+            attr in self.fi.cls.lock_attrs
+
+    # -- with/lock tracking
+    def visit_With(self, node: ast.With) -> None:
+        added: List[Tuple[str, str]] = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):  # with self._lock is not a call;
+                expr = expr.func            # but `with self._cond:` wrappers
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name):
+                root, attr = expr.value.id, expr.attr
+                if self._is_lock_attr(root, attr):
+                    self.held.setdefault(root, set()).add(attr)
+                    added.append((root, attr))
+        for item in node.items:
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for root, attr in added:
+            self.held[root].discard(attr)
+
+    visit_AsyncWith = visit_With
+
+    # -- assignments / returns
+    def _targets(self, t: ast.AST) -> Tuple[str, ...]:
+        if isinstance(t, ast.Name):
+            return (t.id,)
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in t.elts:
+                out.extend(self._targets(e))
+            return tuple(out)
+        return ()
+
+    def _record_assign(self, targets: Sequence[ast.AST],
+                       value: Optional[ast.AST]) -> None:
+        if value is None:
+            return
+        fact = classify_value(value)
+        names: List[str] = []
+        for t in targets:
+            names.extend(self._targets(t))
+            # self.X = <value> stores
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                self.fi.attr_stores.append((t.attr, fact, t.lineno))
+            # `p.attr[k] = v` is a write to p.attr (the Attribute itself
+            # carries Load ctx — record the write explicitly)
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Attribute) and \
+                    isinstance(t.value.value, ast.Name) and \
+                    t.value.value.id in self.param_idx and \
+                    not self._is_lock_attr(t.value.value.id, t.value.attr):
+                idx = self.param_idx[t.value.value.id]
+                acc = Access(t.value.attr, "write", self.fi.module.rel,
+                             t.lineno, self._held_for(t.value.value.id),
+                             (self.fi.display,))
+                self.fi.param_accesses.setdefault(idx, {}) \
+                    .setdefault(acc.key(), acc)
+        if names:
+            self.fi.assign_facts.append((tuple(names), fact))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record_assign(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._record_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_assign([node.target], node.value)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self.fi.return_facts.append(classify_value(node.value))
+        self.generic_visit(node)
+
+    # -- calls (edges + param forwarding)
+    def visit_Call(self, node: ast.Call) -> None:
+        # `p.attr.append(...)`-style mutators are writes to p.attr
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in MUTATORS and \
+                isinstance(node.func.value, ast.Attribute) and \
+                isinstance(node.func.value.value, ast.Name) and \
+                node.func.value.value.id in self.param_idx and \
+                not self._is_lock_attr(node.func.value.value.id,
+                                       node.func.value.attr):
+            root = node.func.value.value.id
+            acc = Access(node.func.value.attr, "write", self.fi.module.rel,
+                         node.func.value.lineno, self._held_for(root),
+                         (self.fi.display,))
+            self.fi.param_accesses.setdefault(self.param_idx[root], {}) \
+                .setdefault(acc.key(), acc)
+        forwards: List[Tuple[int, int]] = []
+        shift = 0
+        # `self.m(...)` / `p.m(...)`: the receiver is forwarded as param 0
+        if isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in self.param_idx:
+            forwards.append((self.param_idx[node.func.value.id], 0))
+            shift = 1
+        for j, a in enumerate(node.args):
+            if isinstance(a, ast.Name) and a.id in self.param_idx:
+                forwards.append((self.param_idx[a.id], j + shift))
+        root = node.func.value.id if (
+            isinstance(node.func, ast.Attribute) and
+            isinstance(node.func.value, ast.Name)) else "self"
+        self.fi.calls.append(CallFact(
+            node.func, node.lineno, forwards, self._held_for(root)))
+        self.generic_visit(node)
+
+    # -- param attr accesses (for the race detector)
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and \
+                node.value.id in self.param_idx and \
+                not self._is_lock_attr(node.value.id, node.attr):
+            idx = self.param_idx[node.value.id]
+            kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                else "read"
+            acc = Access(node.attr, kind, self.fi.module.rel, node.lineno,
+                         self._held_for(node.value.id), (self.fi.display,))
+            self.fi.param_accesses.setdefault(idx, {}) \
+                .setdefault(acc.key(), acc)
+        self.generic_visit(node)
+
+    # nested defs: facts inside belong to the enclosing function's walk (the
+    # per-scope rules make the same choice); nested defs also get their OWN
+    # FunctionInfo only when bound at module/class level, which these are not.
+
+
+# -- the graph -----------------------------------------------------------------
+
+class CallGraph:
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = [m for m in modules if m.tree is not None]
+        self.tables: Dict[str, _ModuleTable] = {}
+        self.by_modname: Dict[str, _ModuleTable] = {}
+        self.functions: Dict[str, FunctionInfo] = {}   # key -> info
+        self.classes: Dict[str, ClassInfo] = {}
+        self.by_node: Dict[int, FunctionInfo] = {}     # id(ast node) -> info
+        self.class_by_node: Dict[int, ClassInfo] = {}
+        #: rel -> set of module rels it imports (project-internal)
+        self.imports: Dict[str, set] = {}
+        self._resolution: Dict[int, Optional[FunctionInfo]] = {}
+        self._adhoc: Dict[int, FunctionInfo] = {}
+        self._build()
+        self._fixpoint()
+
+    # -- construction
+    def _build(self) -> None:
+        for m in self.modules:
+            table = _ModuleTable(m)
+            table.scan_imports()
+            self.tables[m.rel] = table
+            self.by_modname[table.modname] = table
+        for m in self.modules:
+            self._index_module(m)
+        for m in self.modules:
+            self._link_imports(m)
+        for cls in self.classes.values():
+            self._resolve_bases(cls)
+        for fi in self.functions.values():
+            extractor = _Extractor(fi)
+            body = fi.node.body if hasattr(fi.node, "body") else []
+            for stmt in body:
+                extractor.visit(stmt)
+
+    def _index_module(self, m: Module) -> None:
+        modname = self.tables[m.rel].modname
+        for node in m.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{modname}:{node.name}"
+                fi = FunctionInfo(node.name, node.name, m, node, None,
+                                  [a.arg for a in node.args.args])
+                self.functions[key] = fi
+                self.by_node[id(node)] = fi
+            elif isinstance(node, ast.ClassDef):
+                ckey = f"{modname}:{node.name}"
+                ci = ClassInfo(node.name, m, node)
+                self.classes[ckey] = ci
+                self.class_by_node[id(node)] = ci
+                from .lock_discipline import _lock_attrs
+                ci.lock_attrs = frozenset(_lock_attrs(node))
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        mkey = f"{ckey}.{sub.name}"
+                        fi = FunctionInfo(
+                            sub.name, f"{node.name}.{sub.name}", m, sub, ci,
+                            [a.arg for a in sub.args.args])
+                        self.functions[mkey] = fi
+                        ci.methods[sub.name] = fi
+                        self.by_node[id(sub)] = fi
+
+    def _link_imports(self, m: Module) -> None:
+        deps = self.imports.setdefault(m.rel, set())
+        for kind, target in self.tables[m.rel].bindings.values():
+            modname = target if kind == "mod" else target.split(":", 1)[0]
+            t = self.by_modname.get(modname)
+            if t is None and kind == "sym":
+                # `from pkg import submodule` binds a module, not a symbol
+                t = self.by_modname.get(
+                    f"{modname}.{target.split(':', 1)[1]}"
+                    if modname else target.split(":", 1)[1])
+            if t is not None:
+                deps.add(t.module.rel)
+
+    def _resolve_bases(self, ci: ClassInfo) -> None:
+        table = self.tables[ci.module.rel]
+        for b in ci.node.bases:
+            name = dotted_name(b)
+            if not name:
+                continue
+            key = self._resolve_name(table, name)
+            if key is not None and key in self.classes:
+                ci.bases.append(key)
+
+    # -- name/call resolution
+    def _resolve_name(self, table: _ModuleTable, name: str) -> Optional[str]:
+        """Resolve a dotted name in a module to a function/class key."""
+        parts = name.split(".")
+        head, rest = parts[0], parts[1:]
+        # local definition?
+        local = f"{table.modname}:{head}"
+        if not rest and (local in self.functions or local in self.classes):
+            return local
+        binding = table.bindings.get(head)
+        if binding is None:
+            return None
+        kind, target = binding
+        if kind == "sym":
+            base_mod, sym = target.split(":", 1)
+            if not rest:
+                key = f"{base_mod}:{sym}"
+                if key in self.functions or key in self.classes:
+                    return key
+                # `from pkg import module` — nothing more to resolve here
+                return None
+            # from pkg import module; module.f(...)
+            sub = self.by_modname.get(f"{base_mod}.{sym}" if base_mod
+                                      else sym)
+            if sub is not None:
+                return self._resolve_in_module(sub.modname, rest)
+            # Class.method via from-import
+            ckey = f"{base_mod}:{sym}"
+            if ckey in self.classes and len(rest) == 1:
+                mi = self.classes[ckey].method(rest[0], self)
+                return self._key_of(mi) if mi else None
+            return None
+        # module import: walk the longest module prefix, then symbols
+        modpath = target
+        idx = 0
+        while idx < len(rest):
+            nxt = f"{modpath}.{rest[idx]}"
+            if nxt in self.by_modname or idx < len(rest) - 1 and \
+                    f"{nxt}" in self.by_modname:
+                modpath = nxt
+                idx += 1
+            else:
+                break
+        if modpath not in self.by_modname:
+            return None
+        return self._resolve_in_module(modpath, rest[idx:])
+
+    def _resolve_in_module(self, modname: str,
+                           parts: Sequence[str]) -> Optional[str]:
+        if not parts:
+            return None
+        key = f"{modname}:{parts[0]}"
+        if len(parts) == 1:
+            if key in self.functions or key in self.classes:
+                return key
+            return None
+        if key in self.classes and len(parts) == 2:
+            mi = self.classes[key].method(parts[1], self)
+            return self._key_of(mi) if mi else None
+        return None
+
+    def _key_of(self, fi: Optional[FunctionInfo]) -> Optional[str]:
+        if fi is None:
+            return None
+        table = self.tables[fi.module.rel]
+        if fi.cls is not None:
+            return f"{table.modname}:{fi.cls.name}.{fi.name}"
+        return f"{table.modname}:{fi.name}"
+
+    def resolve_call(self, caller: FunctionInfo,
+                     func: ast.AST) -> Optional[FunctionInfo]:
+        """Resolve a call's func expression from `caller`'s context to a
+        project FunctionInfo (constructors resolve to __init__'s class via
+        `resolve_callable`, not here). Memoized per func node — the fixpoint
+        loop re-evaluates facts but resolution never changes."""
+        nid = id(func)
+        if nid in self._resolution:
+            return self._resolution[nid]
+        key = self.resolve_callable(caller, func)
+        out = self.functions.get(key) if key is not None else None
+        self._resolution[nid] = out
+        return out
+
+    def resolve_callable(self, caller: FunctionInfo,
+                         func: ast.AST) -> Optional[str]:
+        table = self.tables[caller.module.rel]
+        name = dotted_name(func)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and caller.cls is not None:
+            if len(parts) == 2:
+                mi = caller.cls.method(parts[1], self)
+                return self._key_of(mi)
+            return None
+        return self._resolve_name(table, name)
+
+    def function_for(self, node: ast.AST) -> Optional[FunctionInfo]:
+        return self.by_node.get(id(node))
+
+    def class_for(self, node: ast.AST) -> Optional[ClassInfo]:
+        return self.class_by_node.get(id(node))
+
+    def adhoc_scope(self, module: Module, node: ast.AST,
+                    cls: Optional[ClassInfo]) -> FunctionInfo:
+        """A throwaway FunctionInfo for scopes outside the registry (module
+        bodies, nested defs) so rules can reuse the same taint evaluation.
+        Memoized per node — check_module may revisit scopes."""
+        nid = id(node)
+        cached = self._adhoc.get(nid)
+        if cached is not None:
+            return cached
+        params = [a.arg for a in node.args.args] \
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            else []
+        name = getattr(node, "name", "<module>")
+        fi = FunctionInfo(name, name, module, node, cls, params)
+        ex = _Extractor(fi)
+        for stmt in getattr(node, "body", ()):
+            ex.visit(stmt)
+        self._adhoc[nid] = fi
+        return fi
+
+    def taint_for(self, fi: FunctionInfo,
+                  seed: Optional[Dict[str, Tuple[str, ...]]] = None
+                  ) -> Dict[str, Tuple[str, ...]]:
+        """Name -> producer chain for `fi`'s scope, seeded with enclosing
+        taint for nested defs (closures see the outer names)."""
+        if not seed:
+            return (self._compute_local_taint(fi) if not fi.local_taint
+                    else fi.local_taint)
+        taint = dict(seed)
+        taint.update(self._compute_local_taint(fi))
+        return taint
+
+    def expand_name(self, module_rel: str, name: str) -> str:
+        """Canonicalize a dotted name through the module's import table:
+        `dg` (from jax import device_get as dg) -> 'jax.device_get',
+        `xnp.asarray` (import jax.numpy as xnp) -> 'jax.numpy.asarray'."""
+        table = self.tables.get(module_rel)
+        if table is None or not name:
+            return name
+        head, _, rest = name.partition(".")
+        binding = table.bindings.get(head)
+        if binding is None:
+            return name
+        kind, target = binding
+        if kind == "mod":
+            expanded = target
+        else:
+            base, _, sym = target.partition(":")
+            expanded = f"{base}.{sym}" if base else sym
+        return f"{expanded}.{rest}" if rest else expanded
+
+    # -- reverse import closure (for --changed-only)
+    def dependents_closure(self, rels: Iterable[str]) -> set:
+        """`rels` plus every module that (transitively) imports one of them."""
+        reverse: Dict[str, set] = {}
+        for src, deps in self.imports.items():
+            for d in deps:
+                reverse.setdefault(d, set()).add(src)
+        out = set(rels)
+        frontier = list(out)
+        while frontier:
+            cur = frontier.pop()
+            for dep in reverse.get(cur, ()):
+                if dep not in out:
+                    out.add(dep)
+                    frontier.append(dep)
+        return out
+
+    # -- summaries (fixpoint)
+    def _eval_fact(self, fi: FunctionInfo, fact: tuple,
+                   taint: Dict[str, Tuple[str, ...]]
+                   ) -> Optional[Tuple[str, ...]]:
+        """Chain if `fact` currently evaluates device-tainted, else None."""
+        kind = fact[0]
+        if kind == "device":
+            return ()
+        if kind in ("host", "other"):
+            return None
+        if kind == "name":
+            return taint.get(fact[1])
+        if kind == "selfattr":
+            if fi.cls is not None and fact[1] in fi.cls.device_attrs:
+                return fi.cls.device_attrs[fact[1]]
+            return None
+        if kind == "call":
+            callee = self.resolve_call(fi, fact[1].func)
+            if callee is not None and callee.returns_device:
+                return callee.device_chain
+            # taint through identity-ish helpers: a resolved callee whose
+            # return is its param and that param is a tainted arg
+            return None
+        if kind == "multi":
+            for sub in fact[1]:
+                c = self._eval_fact(fi, sub, taint)
+                if c is not None:
+                    return c
+            return None
+        return None
+
+    def _compute_local_taint(self, fi: FunctionInfo
+                             ) -> Dict[str, Tuple[str, ...]]:
+        taint: Dict[str, Tuple[str, ...]] = {}
+        for names, fact in fi.assign_facts:
+            chain = self._eval_fact(fi, fact, taint)
+            if chain is not None:
+                for n in names:
+                    taint[n] = chain[:_CHAIN_CAP]
+            else:
+                for n in names:
+                    taint.pop(n, None)
+        return taint
+
+    def _fixpoint(self) -> None:
+        fns = list(self.functions.values())
+        for _ in range(_FIXPOINT_CAP):
+            changed = False
+            for fi in fns:
+                taint = self._compute_local_taint(fi)
+                fi.local_taint = taint
+                # returns_device
+                if not fi.returns_device:
+                    for fact in fi.return_facts:
+                        chain = self._eval_fact(fi, fact, taint)
+                        if chain is not None:
+                            fi.returns_device = True
+                            fi.device_chain = (
+                                (f"{fi.display}()",) + chain)[:_CHAIN_CAP]
+                            changed = True
+                            break
+                # class device attrs
+                if fi.cls is not None:
+                    for attr, fact, _line in fi.attr_stores:
+                        if attr in fi.cls.device_attrs:
+                            continue
+                        chain = self._eval_fact(fi, fact, taint)
+                        if chain is not None:
+                            fi.cls.device_attrs[attr] = (
+                                (f"{fi.display}() stores self.{attr}",)
+                                + chain)[:_CHAIN_CAP]
+                            changed = True
+                # transitive param attr accesses
+                for call in fi.calls:
+                    if not call.forwards:
+                        continue
+                    callee = self.resolve_call(fi, call.func)
+                    if callee is None or callee is fi:
+                        continue
+                    for mine, theirs in call.forwards:
+                        for acc in list(
+                                callee.param_accesses.get(theirs, {})
+                                .values()):
+                            if len(acc.chain) >= _CHAIN_CAP:
+                                continue
+                            folded = Access(
+                                acc.attr, acc.kind, acc.rel, acc.line,
+                                acc.held | call.held,
+                                (fi.display,) + acc.chain)
+                            bucket = fi.param_accesses.setdefault(mine, {})
+                            if folded.key() not in bucket:
+                                bucket[folded.key()] = folded
+                                changed = True
+            if not changed:
+                break
+
+
+def build(modules: Sequence[Module]) -> CallGraph:
+    return CallGraph(modules)

@@ -46,11 +46,11 @@ def _collective_name(node: ast.Call) -> Optional[str]:
     return None
 
 
-def _bare_imports(tree: ast.AST) -> Set[str]:
+def _bare_imports(module) -> Set[str]:
     """Collective names imported bare via `from jax.lax import psum, ...`."""
     out: Set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+    for node in module.nodes_of(ast.ImportFrom):
+        if node.module == "jax.lax":
             for alias in node.names:
                 if alias.name in COLLECTIVE_NAMES:
                     out.add(alias.asname or alias.name)
@@ -64,11 +64,11 @@ def _is_binding_wrapper_call(node: ast.AST) -> bool:
     return bool(name) and (name.split(".")[-1] in _BINDING_WRAPPERS)
 
 
-def _wrapped_function_names(tree: ast.AST) -> Set[str]:
+def _wrapped_function_names(module) -> Set[str]:
     """Names passed (positionally or by keyword) to shard_map/pmap calls —
     those functions execute with the wrapper's axis bound."""
     wrapped: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in module.nodes_of(ast.Call):
         if not _is_binding_wrapper_call(node):
             continue
         for arg in list(node.args) + [kw.value for kw in node.keywords]:
@@ -131,11 +131,9 @@ class CollectiveAxisScopeRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        bare = _bare_imports(module.tree)
-        wrapped = _wrapped_function_names(module.tree)
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        bare = _bare_imports(module)
+        wrapped = _wrapped_function_names(module)
+        for node in module.nodes_of(ast.Call):
             cname = _collective_name(node)
             if cname is None and isinstance(node.func, ast.Name) and \
                     node.func.id in bare:

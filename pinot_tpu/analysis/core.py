@@ -40,12 +40,18 @@ class Finding:
     path: str        # repo-relative posix path
     line: int
     message: str
+    #: propagation chain for interprocedural findings (`f() -> g() ->
+    #: float(x)`). Shown in the rendered message, EXCLUDED from the
+    #: fingerprint: renaming a caller or re-routing the chain must not churn
+    #: the committed baseline, exactly like line edits must not.
+    chain: str = ""
 
     def fingerprint(self) -> str:
         return f"{self.rule}::{self.path}::{self.message}"
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        via = f" [via {self.chain}]" if self.chain else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{via}"
 
 
 class Module:
@@ -62,11 +68,38 @@ class Module:
         self.suppressions: Dict[int, set] = {}
         #: suppression comments missing their `-- reason` (line numbers)
         self.bad_suppressions: List[int] = []
+        self._nodes: Optional[List[ast.AST]] = None
+        self._by_type: Optional[Dict[type, List[ast.AST]]] = None
         try:
             self.tree = ast.parse(source)
         except SyntaxError as e:
             self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
         self._scan_suppressions()
+
+    def nodes(self) -> List[ast.AST]:
+        """Every node of the tree in `ast.walk` order, computed once and
+        shared by every rule pack — 19 rules re-walking the same tree is
+        the dominant cost of a full-package run."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree)) \
+                if self.tree is not None else []
+        return self._nodes
+
+    def nodes_of(self, *types: type) -> List[ast.AST]:
+        """Cached per-type node index. Order within a type follows
+        `ast.walk`; asking for several types concatenates per-type lists
+        (use `nodes()` when interleaved source order matters)."""
+        if self._by_type is None:
+            by: Dict[type, List[ast.AST]] = {}
+            for n in self.nodes():
+                by.setdefault(type(n), []).append(n)
+            self._by_type = by
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, []))
+        return out
 
     def _scan_suppressions(self) -> None:
         try:
@@ -105,17 +138,27 @@ class Module:
 
 @dataclass
 class AnalysisContext:
-    """Shared run state rules may consult (repo docs for drift guards)."""
+    """Shared run state rules may consult (repo docs for drift guards, the
+    interprocedural call graph for the cross-function rules)."""
 
     repo_root: str
     modules: List[Module] = field(default_factory=list)
     _readme: Optional[str] = None
+    _callgraph: Optional[object] = None
 
     def module(self, rel_suffix: str) -> Optional[Module]:
         for m in self.modules:
             if m.rel.endswith(rel_suffix):
                 return m
         return None
+
+    def callgraph(self):
+        """Project call graph + summaries, built once per run (the "summary
+        cache": every rule shares one fixpoint pass)."""
+        if self._callgraph is None:
+            from .callgraph import build
+            self._callgraph = build(self.modules)
+        return self._callgraph
 
     def readme(self) -> str:
         if self._readme is None:
@@ -159,9 +202,11 @@ def dotted_name(node: ast.AST) -> str:
     return ""
 
 
-def attach_parents(tree: ast.AST) -> None:
-    """Set `.graft_parent` on every node (rules walk up for enclosing scope)."""
-    for parent in ast.walk(tree):
+def attach_parents(tree) -> None:
+    """Set `.graft_parent` on every node (rules walk up for enclosing scope).
+    Accepts a Module (reuses its cached node list) or a bare AST."""
+    nodes = tree.nodes() if isinstance(tree, Module) else ast.walk(tree)
+    for parent in nodes:
         for child in ast.iter_child_nodes(parent):
             child.graft_parent = parent  # type: ignore[attr-defined]
 
@@ -230,14 +275,21 @@ def collect_modules(paths: Sequence[str], repo_root: Optional[str] = None
 
 
 def run_rules(rules: Sequence[Rule], modules: Sequence[Module],
-              ctx: AnalysisContext) -> Tuple[List[Finding], List[Finding]]:
+              ctx: AnalysisContext,
+              targets: Optional[Sequence[Module]] = None
+              ) -> Tuple[List[Finding], List[Finding]]:
     """Run every rule; returns (active findings, suppressed findings).
 
     Parse failures and reason-less suppressions surface as findings too —
-    a file the checker cannot read is not a clean file."""
+    a file the checker cannot read is not a clean file.
+
+    `targets` (for --changed-only) narrows which modules the per-module
+    rules scan and which paths project-wide findings may land on; `modules`
+    stays the full set so the call graph keeps whole-project summaries."""
     active: List[Finding] = []
     suppressed: List[Finding] = []
-    for m in modules:
+    target_rels = None if targets is None else {m.rel for m in targets}
+    for m in (modules if targets is None else targets):
         if m.parse_error:
             active.append(Finding(PARSE_ERROR, m.rel, 1, m.parse_error))
         for line in m.bad_suppressions:
@@ -247,7 +299,7 @@ def run_rules(rules: Sequence[Rule], modules: Sequence[Module],
                 "(the rationale is mandatory)"))
         if m.tree is None:
             continue
-        attach_parents(m.tree)
+        attach_parents(m)
         for rule in rules:
             for f in rule.check_module(m, ctx):
                 (suppressed if m.suppressed(f.rule, f.line) else
@@ -255,6 +307,8 @@ def run_rules(rules: Sequence[Rule], modules: Sequence[Module],
     by_rel = {m.rel: m for m in modules}
     for rule in rules:
         for f in rule.check_project(ctx):
+            if target_rels is not None and f.path not in target_rels:
+                continue
             m = by_rel.get(f.path)
             if m is not None and m.suppressed(f.rule, f.line):
                 suppressed.append(f)
@@ -279,16 +333,27 @@ def all_rules() -> List[Rule]:
 
 def run_project(paths: Optional[Sequence[str]] = None,
                 rules: Optional[Sequence[Rule]] = None,
-                repo_root: Optional[str] = None
+                repo_root: Optional[str] = None,
+                restrict_rels: Optional[Sequence[str]] = None
                 ) -> Tuple[List[Finding], List[Finding], AnalysisContext]:
-    """Analyse `paths` (default: the pinot_tpu package) with every rule."""
+    """Analyse `paths` (default: the pinot_tpu package) with every rule.
+
+    `restrict_rels` (--changed-only) limits rule execution to the given
+    repo-relative files PLUS every module that transitively imports one of
+    them (a caller's cross-function findings can change when its callee
+    does); the call graph is still built over the whole package so
+    interprocedural summaries stay accurate."""
     repo_root = repo_root or repo_root_for_package()
     if not paths:
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
     modules = collect_modules(paths, repo_root)
     ctx = AnalysisContext(repo_root=repo_root, modules=modules)
+    targets: Optional[List[Module]] = None
+    if restrict_rels is not None:
+        closure = ctx.callgraph().dependents_closure(restrict_rels)
+        targets = [m for m in modules if m.rel in closure]
     active, suppressed = run_rules(rules if rules is not None else all_rules(),
-                                   modules, ctx)
+                                   modules, ctx, targets=targets)
     return active, suppressed, ctx
 
 

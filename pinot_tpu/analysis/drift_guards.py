@@ -82,9 +82,8 @@ class MetricGlossaryRule(Rule):
         for module in ctx.modules:
             if module.tree is None:
                 continue
-            for node in ast.walk(module.tree):
-                if not (isinstance(node, ast.Call) and
-                        isinstance(node.func, ast.Attribute) and
+            for node in module.nodes_of(ast.Call):
+                if not (isinstance(node.func, ast.Attribute) and
                         node.func.attr in _REGISTRY_FACTORIES and node.args):
                     continue
                 arg = node.args[0]
@@ -164,8 +163,8 @@ class StatsKeysRule(Rule):
         for module in ctx.modules:
             if module.tree is None or module.rel == _STATS_MODULE:
                 continue
-            for node in ast.walk(module.tree):
-                if not (isinstance(node, ast.Call) and node.args and
+            for node in module.nodes_of(ast.Call):
+                if not (node.args and
                         dotted_name(node.func).split(".")[-1] in
                         ("record", "record_min")):
                     continue
@@ -209,7 +208,7 @@ class ClusterConfigRule(Rule):
         for module in ctx.modules:
             if module.tree is None:
                 continue
-            for node in ast.walk(module.tree):
+            for node in module.nodes_of(ast.Constant, ast.Call):
                 if isinstance(node, ast.Constant) and \
                         isinstance(node.value, str) and \
                         node.value.startswith("clusterConfig/"):
@@ -242,9 +241,8 @@ class LabelCardinalityRule(Rule):
                      ) -> Iterable[Finding]:
         if module.tree is None:
             return
-        for node in ast.walk(module.tree):
-            if not (isinstance(node, ast.Call) and
-                    isinstance(node.func, ast.Attribute) and
+        for node in module.nodes_of(ast.Call):
+            if not (isinstance(node.func, ast.Attribute) and
                     node.func.attr in _REGISTRY_FACTORIES):
                 continue
             labels = None

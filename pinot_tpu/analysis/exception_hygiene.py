@@ -64,9 +64,7 @@ class SilentBroadExceptRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
+        for node in module.nodes_of(ast.ExceptHandler):
             clause = _broad_clause(node)
             if not clause or not _swallows_silently(node):
                 continue

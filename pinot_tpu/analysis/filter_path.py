@@ -92,7 +92,7 @@ class FilterPathHostMaterializationRule(Rule):
                                "gathers) or declare the function in "
                                "__graft_slow_paths__"))
 
-        for node in ast.walk(module.tree):
+        for node in module.nodes_of(ast.Call, ast.For):
             if isinstance(node, ast.Call) and _is_nonzero_call(node):
                 _flag(node, f"host doc-id materialization "
                             f"`{dotted_name(node.func)}(...)`")

@@ -116,7 +116,7 @@ class RowLoopInIngestRule(Rule):
                                "(columnar batch ops) or declare the function "
                                "in __graft_slow_paths__"))
 
-        for node in ast.walk(module.tree):
+        for node in module.nodes_of(ast.Call, ast.For):
             if isinstance(node, ast.Call) and \
                     isinstance(node.func, ast.Attribute) and \
                     node.func.attr == "append":

@@ -47,77 +47,116 @@ def _is_device_call(node: ast.AST) -> bool:
     return name.startswith(_DEVICE_PREFIXES)
 
 
-def _scopes(tree: ast.AST):
-    """The module plus every function, each visited as its own scope."""
-    yield tree
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
+def _own_nodes(scope: ast.AST):
+    """Walk a scope WITHOUT descending into nested function/class bodies —
+    those are their own scopes (visited with their own taint maps), so each
+    sync site is judged, and reported, exactly once."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
 
 
 class HostSyncRule(Rule):
     id = "jit-host-sync"
     description = ("float()/int()/bool()/np.asarray() on a jnp-produced value "
-                   "forces a blocking host sync")
+                   "(including one returned by a device-returning helper in "
+                   "another module) forces a blocking host sync")
 
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
+        cg = ctx.callgraph()
         out: List[Finding] = []
-        for scope in _scopes(module.tree):
-            tainted = self._device_names(scope)
-            for node in ast.walk(scope):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                fname = dotted_name(node.func)
-                arg = node.args[0]
-                if not (fname in _HOST_CASTS or fname in _HOST_ARRAY_FNS):
-                    continue
-                if _is_device_call(arg) or (
-                        isinstance(arg, ast.Name) and arg.id in tainted):
-                    out.append(Finding(
-                        self.id, module.rel, node.lineno,
-                        f"{fname}() on a jnp-produced value "
-                        f"({self._describe(arg)}) blocks on the device — "
-                        "fetch via the batched device_get path instead"))
+        self._scan(module.tree, None, {}, module, cg, out)
         return out
+
+    def _scan(self, scope: ast.AST, cls, inherited, module: Module, cg,
+              out: List[Finding]) -> None:
+        """One scope: evaluate taint (local producers + call-graph summaries),
+        flag syncs, then recurse into nested scopes."""
+        fi = cg.function_for(scope) or cg.adhoc_scope(module, scope, cls)
+        taint = cg.taint_for(fi, inherited)
+        nested: List = []
+        for node in _own_nodes(scope):
+            if isinstance(node, ast.Call) and node.args:
+                fname = dotted_name(node.func)
+                if fname in _HOST_CASTS or fname in _HOST_ARRAY_FNS:
+                    chain = self._arg_chain(node.args[0], taint, fi, cg)
+                    if chain is not None:
+                        via = " -> ".join(
+                            chain + (f"{fname}({self._describe(node.args[0])}"
+                                     ")",))
+                        out.append(Finding(
+                            self.id, module.rel, node.lineno,
+                            f"{fname}() on a jnp-produced value "
+                            f"({self._describe(node.args[0])}) blocks on the "
+                            "device — fetch via the batched device_get path "
+                            "instead", chain=via if chain else ""))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                nested.append(node)
+        for node in nested:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs keep the enclosing class: closures read `self`
+                self._scan(node, cls, taint, module, cg, out)
+            else:
+                ci = cg.class_for(node)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self._scan(sub, ci, {}, module, cg, out)
+
+    def _arg_chain(self, arg: ast.AST, taint, fi, cg):
+        """Producer chain tuple if `arg` is device-tainted, else None.
+        () means locally produced (no interprocedural hop to report)."""
+        if _is_device_call(arg):
+            return ()
+        if isinstance(arg, ast.Name):
+            return taint.get(arg.id)
+        if isinstance(arg, ast.Call):
+            callee = cg.resolve_call(fi, arg.func)
+            if callee is not None and callee.returns_device:
+                return callee.device_chain
+            return None
+        if isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and arg.value.id == "self" \
+                and fi.cls is not None:
+            return fi.cls.device_attrs.get(arg.attr)
+        if isinstance(arg, ast.Subscript):
+            return self._arg_chain(arg.value, taint, fi, cg)
+        return None
 
     @staticmethod
     def _describe(arg: ast.AST) -> str:
         if isinstance(arg, ast.Name):
             return arg.id
+        if isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and arg.value.id == "self":
+            return f"self.{arg.attr}"
         return dotted_name(getattr(arg, "func", arg)) or "expression"
-
-    @staticmethod
-    def _device_names(scope: ast.AST) -> Set[str]:
-        """Names assigned from jnp/lax calls within this scope, in order."""
-        tainted: Set[str] = set()
-        for node in ast.walk(scope):
-            if isinstance(node, ast.Assign) and _is_device_call(node.value):
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        tainted.add(t.id)
-            elif isinstance(node, ast.AugAssign) and \
-                    _is_device_call(node.value) and \
-                    isinstance(node.target, ast.Name):
-                tainted.add(node.target.id)
-        return tainted
 
 
 class FetchSiteRule(Rule):
     id = "jit-fetch-site"
     description = ("jax.device_get/block_until_ready outside the sanctioned "
-                   "fetch sites is a hidden host sync")
+                   "fetch sites is a hidden host sync (import aliases are "
+                   "resolved; `from jax import device_get as dg` cannot hide)")
+
+    _SYNC_TARGETS = ("jax.device_get", "jax.block_until_ready")
 
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         if module.rel in SANCTIONED_FETCH_FILES:
             return ()
+        cg = ctx.callgraph()
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in module.nodes_of(ast.Call):
             name = dotted_name(node.func)
-            is_sync = (name in ("jax.device_get", "jax.block_until_ready") or
+            expanded = cg.expand_name(module.rel, name)
+            is_sync = (expanded in self._SYNC_TARGETS or
                        (isinstance(node.func, ast.Attribute) and
                         node.func.attr == "block_until_ready"))
             if is_sync:
@@ -136,7 +175,7 @@ class LiteralRebuildRule(Rule):
 
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
-        jitted = self._jitted_functions(module.tree)
+        jitted = self._jitted_functions(module)
         out: List[Finding] = []
         for fn in jitted:
             for node in ast.walk(fn):
@@ -154,19 +193,16 @@ class LiteralRebuildRule(Rule):
         return out
 
     @staticmethod
-    def _jitted_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    def _jitted_functions(module: Module) -> List[ast.FunctionDef]:
         """Functions decorated with *jit (incl. partial(jax.jit, ...)) or
         passed by name to a jax.jit(...) call in the same module."""
         jit_args: Set[str] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) and \
-                    dotted_name(node.func) in ("jax.jit", "jit") and \
+        for node in module.nodes_of(ast.Call):
+            if dotted_name(node.func) in ("jax.jit", "jit") and \
                     node.args and isinstance(node.args[0], ast.Name):
                 jit_args.add(node.args[0].id)
         out: List[ast.FunctionDef] = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.FunctionDef):
-                continue
+        for node in module.nodes_of(ast.FunctionDef):
             if node.name in jit_args or any(
                     LiteralRebuildRule._is_jit_decorator(d)
                     for d in node.decorator_list):
@@ -196,7 +232,7 @@ class CacheKeyRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.nodes_of(ast.Call, ast.Subscript):
             key = self._cache_key_expr(node)
             if key is None:
                 continue

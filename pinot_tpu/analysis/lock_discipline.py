@@ -113,9 +113,8 @@ class UnguardedWriteRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for cls in ast.walk(module.tree):
-            if isinstance(cls, ast.ClassDef):
-                out.extend(self._check_class(cls, module))
+        for cls in module.nodes_of(ast.ClassDef):
+            out.extend(self._check_class(cls, module))
         return out
 
     def _check_class(self, cls: ast.ClassDef, module: Module
@@ -155,13 +154,11 @@ class ManualAcquireRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         lock_attrs: Set[str] = set()
-        for cls in ast.walk(module.tree):
-            if isinstance(cls, ast.ClassDef):
-                lock_attrs |= _lock_attrs(cls)
+        for cls in module.nodes_of(ast.ClassDef):
+            lock_attrs |= _lock_attrs(cls)
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call) or \
-                    not isinstance(node.func, ast.Attribute):
+        for node in module.nodes_of(ast.Call):
+            if not isinstance(node.func, ast.Attribute):
                 continue
             if node.func.attr not in ("acquire", "release"):
                 continue
@@ -183,9 +180,8 @@ class ThreadJoinRule(Rule):
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
-            if not (isinstance(node, ast.Call) and
-                    dotted_name(node.func) in ("threading.Thread", "Thread")):
+        for node in module.nodes_of(ast.Call):
+            if dotted_name(node.func) not in ("threading.Thread", "Thread"):
                 continue
             finding = self._check_thread(node, module)
             if finding:
@@ -297,5 +293,121 @@ class ThreadJoinRule(Rule):
         return False
 
 
+#: callables whose argument becomes a concurrent entry point
+_THREAD_FACTORIES = ("threading.Thread", "Thread", "threading.Timer", "Timer")
+_TASK_FACTORIES = ("PeriodicTask",)
+
+
+class RaceCrossMethodRule(Rule):
+    id = "race-cross-method"
+    description = ("attribute written under `self._lock` in one method but "
+                   "read/written without it on a thread-entry path "
+                   "(Thread(target=...), executor.submit, PeriodicTask) — "
+                   "including through helpers in other modules — is a race")
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        cg = ctx.callgraph()
+        out: List[Finding] = []
+        seen: Set[tuple] = set()
+        for ci in cg.classes.values():
+            if not ci.lock_attrs:
+                continue
+            guarded = self._guarded_attrs(ci)
+            if not guarded:
+                continue
+            for mname, trigger in self._entries(ci).items():
+                entry = ci.method(mname, cg)
+                if entry is None:
+                    continue
+                for acc in entry.param_accesses.get(0, {}).values():
+                    if acc.attr not in guarded or \
+                            (acc.held & ci.lock_attrs):
+                        continue
+                    # direct unguarded writes in the class's own methods are
+                    # UnguardedWriteRule's findings — don't double-report;
+                    # this rule adds READS and out-of-class helper writes
+                    in_class_site = acc.chain[-1].startswith(f"{ci.name}.")
+                    if acc.kind == "write" and in_class_site:
+                        continue
+                    key = (ci.name, acc.attr, acc.kind, acc.rel, acc.line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    path = " -> ".join(f"{c}()" for c in acc.chain)
+                    out.append(Finding(
+                        self.id, acc.rel, acc.line,
+                        f"{ci.name}.{acc.attr} is written under its lock "
+                        f"elsewhere but {acc.kind} without it on a "
+                        "thread-entry path — take the lock or document why "
+                        "the race is benign",
+                        chain=f"{trigger} -> {path} -> "
+                              f"{acc.kind} self.{acc.attr}"))
+        return out
+
+    @staticmethod
+    def _guarded_attrs(ci) -> Set[str]:
+        """Attrs written under an owned lock in a direct method (chain
+        length 1 == the access physically lives in that method)."""
+        out: Set[str] = set()
+        for fi in ci.methods.values():
+            for acc in fi.param_accesses.get(0, {}).values():
+                if acc.kind == "write" and len(acc.chain) == 1 and \
+                        (acc.held & ci.lock_attrs):
+                    out.add(acc.attr)
+        return out
+
+    def _entries(self, ci) -> Dict[str, str]:
+        """Method name -> human trigger description, for every method handed
+        to a thread/executor/periodic-task factory anywhere in the class.
+        A factory given a LOCAL closure (`Thread(target=loop)`) makes the
+        enclosing method the entry — the extractor attributes closure facts
+        to it."""
+        out: Dict[str, str] = {}
+        for mname, fi in ci.methods.items():
+            nested = {n.name for n in ast.walk(fi.node)
+                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                                    ) and n is not fi.node}
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                cands: List[Tuple[ast.AST, str]] = []
+                if fname in _THREAD_FACTORIES:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            cands.append((kw.value, "Thread(target={m})"))
+                    if fname.endswith("Timer") and len(node.args) >= 2:
+                        cands.append((node.args[1], "Timer(..., {m})"))
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "submit" and node.args:
+                    cands.append((node.args[0], "submit({m})"))
+                elif fname.rsplit(".", 1)[-1] in _TASK_FACTORIES:
+                    for a in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        cands.append((a, "PeriodicTask({m})"))
+                for expr, desc in cands:
+                    m = self._self_method(expr)
+                    if m is not None and m in ci.methods:
+                        out.setdefault(m, desc.format(m=f"self.{m}"))
+                    elif isinstance(expr, ast.Name) and expr.id in nested:
+                        out.setdefault(mname, desc.format(
+                            m=f"local `{expr.id}` in {mname}"))
+        # a class subclassing threading.Thread runs its own `run`
+        for b in ci.node.bases:
+            if dotted_name(b).rsplit(".", 1)[-1] == "Thread" and \
+                    "run" in ci.methods:
+                out.setdefault("run", "Thread.start() -> self.run")
+        return out
+
+    @staticmethod
+    def _self_method(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            return expr.attr
+        return None
+
+
 def rules() -> List[Rule]:
-    return [UnguardedWriteRule(), ManualAcquireRule(), ThreadJoinRule()]
+    return [UnguardedWriteRule(), ManualAcquireRule(), ThreadJoinRule(),
+            RaceCrossMethodRule()]

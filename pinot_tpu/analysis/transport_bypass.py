@@ -49,7 +49,7 @@ class TransportBypassRule(Rule):
         if module.rel.endswith(_SANCTIONED):
             return []
         out: List[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.nodes_of(ast.Import, ast.ImportFrom):
             raw = ""
             if isinstance(node, ast.Import):
                 for alias in node.names:

@@ -115,11 +115,14 @@ class FailureDetector:
         now = time.time() if now is None else now
         with self._lock:
             due = [(s, iv) for s, (t, iv) in self._pending.items() if t <= now]
+            # snapshot: probe closures are registered/removed under the lock
+            # from other threads; the fan-out below must not read the live map
+            probes = dict(self._probes)
         if not due:
             return
 
         def run_probe(server_id: str) -> bool:
-            probe = self._probes.get(server_id)
+            probe = probes.get(server_id)
             try:
                 return bool(probe()) if probe else False
             except Exception:

@@ -170,7 +170,9 @@ class _MuxConnection:
             try:
                 item = self._outq.get(timeout=1.0)
             except queue.Empty:
-                if self._closed:
+                with self._lock:  # _fail() flips _closed under the lock
+                    closed = self._closed
+                if closed:
                     return
                 continue
             try:

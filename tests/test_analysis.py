@@ -947,3 +947,288 @@ def test_failure_detector_tick_survives_wedged_probe():
     assert routing.healthy == ["fine"]
     with fd._lock:
         assert "stuck" in fd._pending  # still unhealthy, backoff rescheduled
+
+
+# -- interprocedural: call graph, cross-function taint, cross-method races ----
+
+def _project(files, rules, readme=""):
+    """Run `rules` over an in-memory multi-module package; (active, supp)."""
+    mods = [Module(f"/{rel}", rel, textwrap.dedent(src))
+            for rel, src in files.items()]
+    for m in mods:
+        assert m.parse_error is None, m.parse_error
+    ctx = AnalysisContext(repo_root="/nonexistent", modules=mods)
+    ctx._readme = readme
+    return run_rules(rules, mods, ctx)
+
+
+_DEVICE_HELPER = """
+    import jax.numpy as jnp
+    def make_scores(a):
+        return jnp.sum(a)
+"""
+
+
+def test_cross_module_host_sync_with_chain():
+    active, _ = _project({
+        "pkg/helper.py": _DEVICE_HELPER,
+        "pkg/caller.py": """
+            from pkg.helper import make_scores
+            def report(a):
+                x = make_scores(a)
+                return float(x)
+        """,
+    }, jit_hygiene.rules())
+    syncs = [f for f in active if f.rule == "jit-host-sync"]
+    assert [f.path for f in syncs] == ["pkg/caller.py"]
+    assert "make_scores" in syncs[0].chain and "float(x)" in syncs[0].chain
+    assert "[via " in syncs[0].render()
+
+
+def test_cross_module_host_sync_negative_on_host_helper():
+    active, _ = _project({
+        "pkg/helper.py": """
+            import jax.numpy as jnp
+            def count(a):
+                return len(a)
+        """,
+        "pkg/caller.py": """
+            from pkg.helper import count
+            def report(a):
+                return float(count(a))
+        """,
+    }, jit_hygiene.rules())
+    assert "jit-host-sync" not in _ids(active)
+
+
+def test_cross_module_host_sync_suppression_honored():
+    active, suppressed = _project({
+        "pkg/helper.py": _DEVICE_HELPER,
+        "pkg/caller.py": """
+            from pkg.helper import make_scores
+            def report(a):
+                x = make_scores(a)
+                return float(x)  # graftcheck: ignore[jit-host-sync] -- fixture
+        """,
+    }, jit_hygiene.rules())
+    assert "jit-host-sync" not in _ids(active)
+    assert "jit-host-sync" in _ids(suppressed)
+
+
+def test_self_attr_device_taint_crosses_methods():
+    active, _ = _project({
+        "pkg/holder.py": """
+            import jax.numpy as jnp
+            class Holder:
+                def put(self, a):
+                    self._val = jnp.sum(a)
+                def read(self):
+                    return float(self._val)
+        """,
+    }, jit_hygiene.rules())
+    syncs = [f for f in active if f.rule == "jit-host-sync"]
+    assert len(syncs) == 1 and "stores self._val" in syncs[0].chain
+
+
+_RACE_STATE = """
+    import threading
+    from pkg.util import drain
+    class Consumer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._buf = []
+            self._t = threading.Thread(target=self._loop)
+        def put(self, x):
+            with self._lock:
+                self._buf.append(x)
+        def _loop(self):
+            return drain(self)
+        def stop(self):
+            self._t.join()
+"""
+
+
+def test_race_cross_method_through_other_module():
+    active, _ = _project({
+        "pkg/state.py": _RACE_STATE,
+        "pkg/util.py": """
+            def drain(c):
+                return list(c._buf)
+        """,
+    }, lock_discipline.rules())
+    races = [f for f in active if f.rule == "race-cross-method"]
+    assert [f.path for f in races] == ["pkg/util.py"]
+    assert "Thread(target=self._loop)" in races[0].chain
+    assert "drain" in races[0].chain and "read self._buf" in races[0].chain
+
+
+def test_race_cross_method_negative_when_helper_locks():
+    active, _ = _project({
+        "pkg/state.py": _RACE_STATE,
+        "pkg/util.py": """
+            def drain(c):
+                with c._lock:
+                    return list(c._buf)
+        """,
+    }, lock_discipline.rules())
+    assert "race-cross-method" not in _ids(active)
+
+
+def test_race_cross_method_suppression_in_helper_module():
+    active, suppressed = _project({
+        "pkg/state.py": _RACE_STATE,
+        "pkg/util.py": """
+            def drain(c):
+                return list(c._buf)  # graftcheck: ignore[race-cross-method] -- fixture
+        """,
+    }, lock_discipline.rules())
+    assert "race-cross-method" not in _ids(active)
+    assert "race-cross-method" in _ids(suppressed)
+
+
+def test_fixpoint_terminates_on_mutually_recursive_helpers():
+    active, _ = _project({
+        "pkg/a.py": """
+            import jax.numpy as jnp
+            from pkg.b import pong
+            def ping(n, x):
+                if n <= 0:
+                    return jnp.sum(x)
+                return pong(n - 1, x)
+        """,
+        "pkg/b.py": """
+            from pkg.a import ping
+            def pong(n, x):
+                return ping(n - 1, x)
+        """,
+        "pkg/c.py": """
+            from pkg.a import ping
+            def use(x):
+                return float(ping(3, x))
+        """,
+    }, jit_hygiene.rules())
+    syncs = [f for f in active if f.rule == "jit-host-sync"]
+    assert [f.path for f in syncs] == ["pkg/c.py"]
+
+
+def test_chain_carrying_fingerprints_survive_rename_and_shift():
+    """Renaming the device-returning helper and shifting the caller's lines
+    must not churn the baseline fingerprint — only the chain may change."""
+    before_active, _ = _project({
+        "pkg/helper.py": _DEVICE_HELPER,
+        "pkg/caller.py": """
+            from pkg.helper import make_scores
+            def report(a):
+                x = make_scores(a)
+                return float(x)
+        """,
+    }, jit_hygiene.rules())
+    after_active, _ = _project({
+        "pkg/helper.py": """
+            import jax.numpy as jnp
+            def compute_scores(a):
+                return jnp.sum(a)
+        """,
+        "pkg/caller.py": """
+            from pkg.helper import compute_scores
+
+
+            def report(a):
+                x = compute_scores(a)
+                return float(x)
+        """,
+    }, jit_hygiene.rules())
+    before = {f.fingerprint() for f in before_active
+              if f.rule == "jit-host-sync"}
+    after = {f.fingerprint() for f in after_active
+             if f.rule == "jit-host-sync"}
+    assert before and before == after
+    chains = {f.chain for f in before_active + after_active
+              if f.rule == "jit-host-sync"}
+    assert len(chains) == 2  # the chain reflects the rename; the id does not
+
+
+def test_run_rules_targets_narrow_the_scan():
+    files = {
+        "pkg/clean.py": "x = 1\n",
+        "pkg/bad.py": "def g(futs):\n    return [f.result() for f in futs]\n",
+    }
+    mods = [Module(f"/{rel}", rel, src) for rel, src in files.items()]
+    ctx = AnalysisContext(repo_root="/nonexistent", modules=mods)
+    ctx._readme = ""
+    rules = blocking_in_loop.rules()
+    active, _ = run_rules(rules, mods, ctx, targets=[mods[0]])
+    assert active == []
+    active, _ = run_rules(rules, mods, ctx, targets=[mods[1]])
+    assert _ids(active) == ["blocking-result-no-timeout"]
+
+
+def test_changed_only_fallbacks(monkeypatch, tmp_path):
+    import pinot_tpu.analysis.__main__ as cli
+    # a directory with no git repo anywhere above it -> git cannot answer
+    assert cli._changed_files("/nonexistent-graftcheck-dir") is None
+    monkeypatch.setattr(cli, "_changed_files",
+                        lambda root: ["pinot_tpu/analysis/core.py"])
+    rels, note = cli._changed_only_rels("/x")
+    assert rels is None and "analyzer" in note
+    monkeypatch.setattr(cli, "_changed_files", lambda root: ["README.md"])
+    assert cli._changed_only_rels("/x")[0] is None
+    monkeypatch.setattr(
+        cli, "_changed_files",
+        lambda root: ["pinot_tpu/cluster/broker.py", "notes.md"])
+    rels, note = cli._changed_only_rels("/x")
+    assert rels == ["pinot_tpu/cluster/broker.py"] and note == ""
+    monkeypatch.setattr(
+        cli, "_changed_files",
+        lambda root: [f"pinot_tpu/m{i}.py" for i in range(40)])
+    assert cli._changed_only_rels("/x")[0] is None
+
+
+def test_cli_seeded_interprocedural_package(tmp_path, capsys):
+    """The acceptance fixture: both new rules firing across module
+    boundaries through the CLI, exit 1, chain-annotated messages."""
+    (tmp_path / "helper.py").write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        def make_scores(a):
+            return jnp.sum(a)
+    """))
+    (tmp_path / "caller.py").write_text(textwrap.dedent("""
+        from helper import make_scores
+        def report(a):
+            x = make_scores(a)
+            return float(x)
+    """))
+    (tmp_path / "state.py").write_text(textwrap.dedent("""
+        import threading
+        from util import drain
+        class Consumer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []
+                self._t = threading.Thread(target=self._loop)
+            def put(self, x):
+                with self._lock:
+                    self._buf.append(x)
+            def _loop(self):
+                return drain(self)
+            def stop(self):
+                self._t.join()
+    """))
+    (tmp_path / "util.py").write_text(textwrap.dedent("""
+        def drain(c):
+            return list(c._buf)
+    """))
+    assert analysis_main([str(tmp_path), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "jit-host-sync" in out and "race-cross-method" in out
+    assert "[via " in out and "make_scores" in out
+    assert "Thread(target=self._loop)" in out
+
+
+def test_full_package_run_within_time_budget():
+    """Tier-1 perf guard: the full-package run (call-graph build, fixpoint
+    and all rule packs) stays under the 15s budget and exits 0 against the
+    committed baseline."""
+    t0 = time.perf_counter()
+    assert analysis_main([]) == 0
+    assert time.perf_counter() - t0 < 15.0
