@@ -322,11 +322,12 @@ def all_rules() -> List[Rule]:
     from . import (admission_hygiene, blocking_in_loop, collective_hygiene,
                    drift_guards, exception_hygiene, filter_path,
                    ingest_hot_loop, jit_hygiene, lock_discipline,
-                   transport_bypass)
+                   memory_hygiene, transport_bypass)
     rules: List[Rule] = []
     for pack in (jit_hygiene, lock_discipline, blocking_in_loop, drift_guards,
                  transport_bypass, collective_hygiene, ingest_hot_loop,
-                 exception_hygiene, admission_hygiene, filter_path):
+                 exception_hygiene, admission_hygiene, filter_path,
+                 memory_hygiene):
         rules.extend(pack.rules())
     return rules
 

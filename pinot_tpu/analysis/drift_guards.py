@@ -235,7 +235,7 @@ class LabelCardinalityRule(Rule):
     #: (per-query/per-segment/per-user labels blow up the registry and every
     #: scrape downstream).
     _BOUNDED_LABEL_KEYS = frozenset(
-        ("table", "task", "partition", "instance", "server", "state"))
+        ("table", "task", "partition", "instance", "server", "state", "kind"))
 
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:

@@ -345,7 +345,8 @@ class Broker:
                 if tr is not None and tr.sampled:
                     # errored traces tail-retain so failures are inspectable
                     self.trace_ring.admit(tr, sql=sql, error=True,
-                                          timeUsedMs=round(elapsed_ms, 3))
+                                          timeUsedMs=round(elapsed_ms, 3),
+                                          memory=self._memory_samples(elapsed_ms))
                 raise
         finally:
             self.admission.end()
@@ -389,6 +390,20 @@ class Broker:
         except (TypeError, ValueError):
             return None
 
+    @staticmethod
+    def _memory_samples(elapsed_ms: float) -> List[Dict[str, object]]:
+        """HBM residency counter samples for the Chrome-trace export,
+        timestamped trace-relative (query completion) so the counter track
+        lines up with the span timeline. In-proc clusters see the process
+        ledger; an OS-process broker holds no device residency and reports
+        zeros — the servers' /debug/memory is the authoritative view there."""
+        from ..utils.memledger import get_ledger
+        snap = get_ledger().snapshot()
+        return [{"tsMs": round(elapsed_ms, 3),
+                 "series": {"hbm_resident_bytes": snap["totalBytes"],
+                            "hbm_transient_peak_bytes":
+                                snap["transientPeakBytes"]}}]
+
     def _account_query(self, sql: str, result: ResultTable,
                        elapsed_ms: float, tr=None, table=None) -> None:
         """Per-query bookkeeping after a successful response: rollups for
@@ -408,7 +423,8 @@ class Broker:
             # head-sampled OR tail-retained (slow): land in the bounded ring
             # behind GET /debug/traces
             self.trace_ring.admit(tr, sql=sql, slow=slow,
-                                  timeUsedMs=round(elapsed_ms, 3))
+                                  timeUsedMs=round(elapsed_ms, 3),
+                                  memory=self._memory_samples(elapsed_ms))
         if not slow:
             return
         entry = {

@@ -85,6 +85,18 @@ class Controller:
         self.slo_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
         self.scheduler.register(PeriodicTask("SLOStatusChecker", 60.0,
                                              self.run_slo_check))
+        # device-memory plane: aggregate every server's HBM residency ledger
+        # (/debug/memory) into per-table verdicts — the cluster-level
+        # accounting the ROADMAP tiered-storage item needs before any
+        # promotion/eviction policy can exist
+        self._memory_tables: set = set()      # tables with exported gauges
+        self._memory_instances: set = set()   # servers with headroom gauges
+        self._memory_status: Dict[str, Dict[str, object]] = {}
+        # in-proc clusters register ServerNode.memory_snapshot directly;
+        # OS-process servers are discovered via advertised instance ports
+        self.memory_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
+        self.scheduler.register(PeriodicTask("MemoryStatusChecker", 60.0,
+                                             self.run_memory_check))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -675,6 +687,146 @@ class Controller:
                 "message": ("no query traffic observed yet" if configured else
                             "no SLO targets in cluster config")}
 
+    # -- device-memory plane (the cluster view over per-server HBM ledgers) --
+
+    _MEMORY_TABLE_GAUGES = ("pinot_controller_hbm_healthy",
+                            "pinot_controller_hbm_resident_bytes")
+    _MEMORY_INSTANCE_GAUGE = "pinot_controller_hbm_headroom_pct"
+    #: minimum per-server HBM headroom before a table degrades; a server at or
+    #: below a quarter of this (or fully out) is UNHEALTHY
+    DEFAULT_MEMORY_HEADROOM_PCT = 20.0
+
+    def _iter_memory_pollers(self):
+        """(server_id, poll fn) for every reachable server: explicitly
+        registered in-proc pollers first, then instances advertising an HTTP
+        port (OS-process servers) — their /debug/memory route."""
+        seen = set()
+        for sid, poll in list(self.memory_pollers.items()):
+            seen.add(sid)
+            yield sid, poll
+        for info in list(self.catalog.instances.values()):
+            if info.role != "server" or not info.port or not info.alive \
+                    or info.instance_id in seen:
+                continue
+
+            def poll(url=info.url):
+                from .http_service import get_json
+                return get_json(f"{url}/debug/memory", timeout=5.0, retries=1)
+            yield info.instance_id, poll
+
+    def run_memory_check(self) -> Dict[str, str]:
+        """Periodic cluster memory rollup: poll every server's residency
+        ledger, publish per-server headroom + per-table residency gauges, and
+        verdict each table HEALTHY / DEGRADED / UNHEALTHY off the
+        `controller.memory.headroom.pct` cluster-config threshold (breach ->
+        DEGRADED; at/below a quarter of it, a server fully out of HBM, or no
+        server reporting -> UNHEALTHY). Stale series are removed on table
+        drop / server departure, same hygiene as the other checkers.
+
+        Per-table bytes sum across servers; in-proc multi-server clusters
+        share one process ledger, so there every server reports the same
+        process view (the `servers` map makes that visible)."""
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        thr = self._cluster_config_float("controller.memory.headroom.pct",
+                                         self.DEFAULT_MEMORY_HEADROOM_PCT)
+        snaps: Dict[str, Dict[str, object]] = {}
+        unreachable: List[str] = []
+        for sid, poll in self._iter_memory_pollers():
+            try:
+                snaps[sid] = dict(poll() or {})
+            except Exception:
+                unreachable.append(sid)
+
+        for sid, snap in snaps.items():
+            reg.gauge(self._MEMORY_INSTANCE_GAUGE, {"instance": sid}).set(
+                float(snap.get("headroomPct") or 0.0))
+        for sid in self._memory_instances - set(snaps):
+            reg.remove_gauge(self._MEMORY_INSTANCE_GAUGE, {"instance": sid})
+        self._memory_instances = set(snaps)
+
+        breached = {sid: float(snap.get("headroomPct") or 0.0)
+                    for sid, snap in snaps.items()
+                    if thr is not None
+                    and float(snap.get("headroomPct") or 0.0) < thr}
+        severe = {sid: h for sid, h in breached.items()
+                  if thr is not None and (h <= thr / 4.0 or h <= 0.0)}
+
+        out: Dict[str, Dict[str, object]] = {}
+        for table in list(self.catalog.table_configs):
+            resident = 0
+            per_server: Dict[str, int] = {}
+            for sid, snap in snaps.items():
+                n = int((snap.get("tables") or {}).get(table, 0) or 0)
+                per_server[sid] = n
+                resident += n
+            verdict = "HEALTHY"
+            reasons: List[str] = []
+
+            def degrade(to: str, reason: str) -> None:
+                nonlocal verdict
+                reasons.append(reason)
+                order = ("HEALTHY", "DEGRADED", "UNHEALTHY")
+                if order.index(to) > order.index(verdict):
+                    verdict = to
+
+            if not snaps:
+                degrade("UNHEALTHY",
+                        "no server reported memory status"
+                        + (f" (unreachable: {sorted(unreachable)})"
+                           if unreachable else ""))
+            elif unreachable:
+                degrade("DEGRADED",
+                        f"memory poll failed for: {sorted(unreachable)}")
+            for sid, h in sorted(breached.items()):
+                if sid in severe:
+                    degrade("UNHEALTHY",
+                            f"server {sid} HBM headroom {h:g}% critically "
+                            f"below threshold {thr:g}%")
+                else:
+                    degrade("DEGRADED",
+                            f"server {sid} HBM headroom {h:g}% below "
+                            f"threshold {thr:g}%")
+
+            labels = {"table": table}
+            reg.gauge(self._MEMORY_TABLE_GAUGES[0], labels).set(
+                1 if verdict == "HEALTHY" else 0)
+            reg.gauge(self._MEMORY_TABLE_GAUGES[1], labels).set(resident)
+            out[table] = {
+                "table": table, "memoryState": verdict, "reasons": reasons,
+                "residentBytes": resident,
+                "headroomThresholdPct": thr,
+                "minServerHeadroomPct": min(
+                    (float(s.get("headroomPct") or 0.0)
+                     for s in snaps.values()), default=None),
+                "servers": per_server,
+                "unreachableServers": sorted(unreachable),
+            }
+        for table in self._memory_tables - set(out):
+            for g in self._MEMORY_TABLE_GAUGES:
+                reg.remove_gauge(g, {"table": table})
+        self._memory_tables = set(out)
+        self._memory_status = out
+        return {t: str(s["memoryState"]) for t, s in out.items()}
+
+    def memory_status(self, table: str) -> Dict[str, object]:
+        """Per-table memory verdict (the /tables/{t}/memoryStatus body).
+        Tables the check has not judged yet answer UNKNOWN; unknown tables
+        raise (-> 404)."""
+        st = self._memory_status.get(table)
+        if st is None and table.endswith(("_OFFLINE", "_REALTIME")):
+            # verdicts key on the LOGICAL table name; accept nameWithType
+            st = self._memory_status.get(table.rsplit("_", 1)[0])
+        if st is not None:
+            return st
+        known = any(name == table or name.rsplit("_", 1)[0] == table
+                    for name in self.catalog.table_configs)
+        if not known:
+            raise ValueError(f"unknown table {table!r}")
+        return {"table": table, "memoryState": "UNKNOWN", "reasons": [],
+                "residentBytes": 0, "servers": {},
+                "message": "memory check has not run yet"}
+
     def debug_stats(self) -> Dict[str, object]:
         """Controller /debug rollup: periodic task health (a silently-failing
         task is a climbing errorCount + stale lastRunMs), the last ingestion
@@ -689,6 +841,7 @@ class Controller:
                                     if k != "servers"}
                                 for t, s in self._ingestion_status.items()},
             "sloStatus": dict(self._slo_status),
+            "memoryStatus": dict(self._memory_status),
             "controllerMetrics": {k: v for k, v in reg.snapshot().items()
                                   if k.startswith(("pinot_controller",
                                                    "pinot_periodic"))},

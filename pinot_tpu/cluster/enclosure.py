@@ -46,6 +46,9 @@ class QuickCluster:
             # in-proc analog of the controller polling /debug/consuming: the
             # ingestion status checker reads each server's consuming rollup
             self.controller.ingestion_pollers[s.instance_id] = s.ingestion_snapshot
+            # same shape for /debug/memory: the memory status checker reads
+            # each server's HBM residency ledger rollup
+            self.controller.memory_pollers[s.instance_id] = s.memory_snapshot
         from ..minion.tasks import MinionWorker
         self.minion = MinionWorker("minion_0", self.catalog, self.deepstore,
                                    self.controller,

@@ -41,11 +41,20 @@ class TableDataManager:
         with self._lock:
             self._segments[name] = segment
             self._refcounts.setdefault(name, 0)
+        # table attribution for staging sites that only know the segment
+        # (engine.datablock): offline segment names carry no table prefix
+        from ..utils.memledger import get_ledger
+        get_ledger().bind_segment(self.table, name)
 
     def remove_segment(self, name: str) -> None:
         with self._lock:
-            self._segments.pop(name, None)
+            seg = self._segments.pop(name, None)
             self._refcounts.pop(name, None)
+        if seg is not None:
+            # unload = free: drop the cached device block and its ledger
+            # entries, not just the host-side reader
+            from ..engine.datablock import release_block
+            release_block(seg)
 
     def acquire(self, names: Optional[Sequence[str]] = None) -> List[ImmutableSegment]:
         with self._lock:
@@ -331,6 +340,11 @@ class ServerNode:
                     del self._load_locks[key]
             if handler is not None:
                 handler.stop()
+            # belt-and-braces ledger teardown: any residency still attributed
+            # to the dropped table (consuming staging a racing stop missed)
+            # must not survive as stale gauges
+            from ..utils.memledger import get_ledger
+            get_ledger().release(table=table)
 
         self._refresh_dim_table(table, mgr)
 
@@ -375,6 +389,18 @@ class ServerNode:
         this method directly as the poller)."""
         return {table: handler.ingestion_status()
                 for table, handler in list(self._realtime_managers.items())}
+
+    def memory_snapshot(self) -> Dict[str, object]:
+        """Device-memory residency rollup — the payload behind /debug/memory
+        and what the controller's memory status check polls (in-proc clusters
+        register this method directly as the poller). The ledger is
+        process-global, so in-proc multi-server clusters all report the one
+        process view — which is also what jax reports, keeping
+        reconciliation honest."""
+        from ..utils.memledger import get_ledger
+        snap = get_ledger().snapshot()
+        snap["instanceId"] = self.instance_id
+        return snap
 
     def _load_online_segment(self, table: str, seg_name: str, mgr: TableDataManager) -> None:
         # per-segment load lock (reference: SegmentLocks): concurrent

@@ -525,6 +525,13 @@ class ControllerService:
                 return json_response(self.controller.slo_status(parts[0]))
             except ValueError as e:
                 return error_response(str(e), 404)
+        # GET /tables/{t}/memoryStatus — the cluster HBM residency verdict
+        # computed by the controller's periodic memory check
+        if len(parts) == 2 and parts[1] == "memoryStatus":
+            try:
+                return json_response(self.controller.memory_status(parts[0]))
+            except ValueError as e:
+                return error_response(str(e), 404)
         with self.catalog._lock:
             if parts:  # GET /tables/{nameWithType} -> the table config
                 cfg = self.catalog.table_configs.get(parts[0])
@@ -896,11 +903,15 @@ class ServerService:
     def _debug(self, parts, params, body):
         """GET /debug — server metric rollup + gauge rings; GET
         /debug/consuming — consumingSegmentsInfo analog: per-consuming-segment
-        offsets, lag, and consumer state for every realtime table."""
+        offsets, lag, and consumer state for every realtime table; GET
+        /debug/memory — the HBM residency ledger panel (top segments by
+        bytes, kind breakdown, watermark history, headroom)."""
         from ..utils.metrics import get_registry
         if parts and parts[0] == "consuming":
             return json_response({"instance": self.server.instance_id,
                                   "tables": self.server.ingestion_snapshot()})
+        if parts and parts[0] == "memory":
+            return json_response(self.server.memory_snapshot())
         reg = get_registry()
         return json_response({
             "instance": self.server.instance_id,

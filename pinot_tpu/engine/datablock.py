@@ -21,6 +21,7 @@ import numpy as np
 
 from ..segment.format import ROW_TILE
 from ..segment.reader import ColumnReader, ImmutableSegment
+from ..utils.memledger import staged
 
 
 def _pow2(n: int) -> int:
@@ -72,7 +73,8 @@ class SegmentBlock:
         if self._valid is None:
             v = np.zeros(self.padded, dtype=bool)
             v[:self.num_docs] = True
-            self._valid = jnp.asarray(v)
+            self._valid = staged(jnp.asarray(v), self.segment.name,
+                                 "valid")
         return self._valid
 
     @property
@@ -86,7 +88,8 @@ class SegmentBlock:
             docs = np.arange(self.num_docs, dtype=np.int64)
             np.bitwise_or.at(w, docs >> 5,
                              np.uint32(1) << (docs & 31).astype(np.uint32))
-            self._valid_words = jnp.asarray(w)
+            self._valid_words = staged(jnp.asarray(w), self.segment.name,
+                                       "valid_words")
         return self._valid_words
 
     def ids(self, col: str) -> jnp.ndarray:
@@ -109,12 +112,14 @@ class SegmentBlock:
                 rows = np.repeat(np.arange(self.num_docs), counts)
                 within = np.arange(len(flat)) - np.repeat(off[:-1], counts)
                 mat[rows, within] = flat
-                self._ids[col] = jnp.asarray(mat)
+                self._ids[col] = staged(jnp.asarray(mat),
+                                        self.segment.name, "ids", name=col)
             else:
                 arr = np.asarray(reader.fwd).astype(np.int32)
                 padded = np.full(self.padded, reader.cardinality, dtype=np.int32)
                 padded[:self.num_docs] = arr
-                self._ids[col] = jnp.asarray(padded)
+                self._ids[col] = staged(jnp.asarray(padded),
+                                        self.segment.name, "ids", name=col)
         return self._ids[col]
 
     def raw(self, col: str) -> jnp.ndarray:
@@ -130,7 +135,8 @@ class SegmentBlock:
             arr = _narrow(arr)
             padded = np.zeros(self.padded, dtype=arr.dtype)
             padded[:self.num_docs] = arr
-            self._raw[col] = jnp.asarray(padded)
+            self._raw[col] = staged(jnp.asarray(padded),
+                                    self.segment.name, "raw", name=col)
         return self._raw[col]
 
     def dict_values(self, col: str) -> jnp.ndarray:
@@ -143,7 +149,9 @@ class SegmentBlock:
             vals = _narrow(np.asarray(reader.dictionary.values))
             out = np.zeros(lut_size(reader.cardinality), dtype=vals.dtype)
             out[:len(vals)] = vals
-            self._dict_vals[col] = jnp.asarray(out)
+            self._dict_vals[col] = staged(jnp.asarray(out),
+                                          self.segment.name, "dict",
+                                          name=col)
         return self._dict_vals[col]
 
     def bitmap_words(self, col: str) -> Optional[jnp.ndarray]:
@@ -174,7 +182,9 @@ class SegmentBlock:
                 np.bitwise_or.at(
                     words, (ids[keep], (docs >> 5)[keep]),
                     (np.uint32(1) << (docs & 31).astype(np.uint32))[keep])
-                self._bitmaps[col] = jnp.asarray(words)
+                self._bitmaps[col] = staged(jnp.asarray(words),
+                                            self.segment.name, "bitmap",
+                                            name=col)
         return self._bitmaps[col]
 
     def null_mask(self, col: str) -> jnp.ndarray:
@@ -185,7 +195,8 @@ class SegmentBlock:
             padded = np.zeros(self.padded, dtype=bool)
             if nb is not None:
                 padded[:self.num_docs] = nb
-            self._null[col] = jnp.asarray(padded)
+            self._null[col] = staged(jnp.asarray(padded),
+                                     self.segment.name, "null", name=col)
         return self._null[col]
 
     def values(self, col: str) -> jnp.ndarray:
@@ -205,7 +216,9 @@ class SegmentBlock:
             fwd = np.asarray(reader.fwd).astype(np.int64)
             padded = np.zeros(self.padded, dtype=vals.dtype)
             padded[:self.num_docs] = vals[fwd]
-            self._decoded[col] = jnp.asarray(padded)
+            self._decoded[col] = staged(jnp.asarray(padded),
+                                        self.segment.name, "decoded",
+                                        name=col)
         return self._decoded[col]
 
 
@@ -218,3 +231,17 @@ def block_for(segment: ImmutableSegment) -> SegmentBlock:
         blk = SegmentBlock(segment)
         setattr(segment, _BLOCK_ATTR, blk)
     return blk
+
+
+def release_block(segment) -> None:
+    """Unload hook: drop a segment's cached device block and deregister its
+    ledger entries. Without this the `_device_block` attribute keeps every
+    column array alive until the segment object itself is GC'd — exactly the
+    leak class the ledger exists to expose."""
+    from ..utils.memledger import get_ledger
+    if getattr(segment, _BLOCK_ATTR, None) is not None:
+        try:
+            delattr(segment, _BLOCK_ATTR)
+        except AttributeError:
+            pass
+    get_ledger().release(segment=getattr(segment, "name", str(segment)))

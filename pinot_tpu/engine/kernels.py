@@ -37,6 +37,8 @@ the measured foundation for future hand-scheduled integration.
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
@@ -49,6 +51,7 @@ from ..query import stats as qstats
 from ..query.aggregates import AggFunc
 from ..query.predicate import CmpLeaf, DocSetLeaf, FilterProgram, LutLeaf, NullLeaf
 from ..sql.ast import Identifier
+from ..utils.memledger import get_ledger
 from ..utils.metrics import get_registry
 from .calibrate import get_caps
 from .expr import eval_expr
@@ -192,13 +195,83 @@ def _block_tree(out):
     return out
 
 
+# -- per-kernel cost profiles (XLA cost_analysis at compile time) ------------
+
+#: pending modeled bytes since the last fetch, per dispatch thread: launches
+#: accumulate, `fetch_outputs` drains into an achieved-vs-roofline pct
+_pending_cost = threading.local()
+
+_NOMINAL_HBM_GBPS: Optional[float] = None
+
+
+def _nominal_hbm_gbps() -> float:
+    """Roofline denominator: the platform's nominal HBM bandwidth (the same
+    819 GB/s constant bench.py's platform_calibration publishes), overridable
+    via PINOT_TPU_HBM_GBPS for other parts/backends."""
+    global _NOMINAL_HBM_GBPS
+    if _NOMINAL_HBM_GBPS is None:
+        try:
+            _NOMINAL_HBM_GBPS = float(os.environ.get("PINOT_TPU_HBM_GBPS",
+                                                     "819"))
+        except ValueError:
+            _NOMINAL_HBM_GBPS = 819.0
+        if _NOMINAL_HBM_GBPS <= 0:
+            _NOMINAL_HBM_GBPS = 819.0
+    return _NOMINAL_HBM_GBPS
+
+
+def _tree_device_nbytes(tree) -> int:
+    """Sum of leaf nbytes WITHOUT materializing (no np.asarray — that would
+    sync); device and host leaves both carry `.nbytes`."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def _kernel_cost(fn, args, kwargs) -> Dict[str, float]:
+    """One compiled executable's per-launch cost profile. Primary source is
+    XLA's `cost_analysis()` via the AOT lowering path (flops + bytes
+    accessed); when the backend exposes neither (CPU builds vary), fall back
+    to a deterministic input-bytes estimate with zero modeled flops — still
+    monotone in problem size, so roofline percentages stay comparable."""
+    flops = 0.0
+    nbytes = 0.0
+    try:
+        analysis = fn.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        if isinstance(analysis, dict):
+            flops = float(analysis.get("flops") or 0.0)
+            nbytes = float(analysis.get("bytes accessed") or 0.0)
+    # graftcheck: ignore[exception-hygiene] -- cost_analysis() is a
+    # best-effort XLA introspection API (shape varies by backend, may raise
+    # on donated/stablehlo paths); the input-bytes fallback below IS the
+    # observation of this failure
+    except Exception:
+        pass
+    if nbytes <= 0.0:
+        nbytes = float(_tree_device_nbytes((args, kwargs)))
+    return {"flops": max(flops, 0.0), "bytes": max(nbytes, 0.0)}
+
+
+def _account_cost(cost: Optional[Dict[str, float]]) -> None:
+    """Fold one launch's modeled cost into the active per-query stats and the
+    process-lifetime counters."""
+    if not cost:
+        return
+    qstats.record(qstats.DEVICE_FLOPS, cost["flops"])
+    qstats.record(qstats.DEVICE_BYTES_ACCESSED, cost["bytes"])
+    _pending_cost.nbytes = getattr(_pending_cost, "nbytes", 0.0) + cost["bytes"]
+
+
 def _fence_first_call(fn):
     """jax.jit is LAZY — trace + compile happen at the first invocation. Fence
     that call with block_until_ready so its wall time (trace + compile + first
     run) lands in the compile histogram / per-query `compileMs` instead of
     silently inflating whichever query hits the cold cache; every invocation
-    counts one device launch."""
-    state = {"cold": True}
+    counts one device launch and its modeled cost-analysis flops/bytes."""
+    state: Dict[str, Any] = {"cold": True, "cost": None}
 
     def call(*args, **kwargs):
         qstats.record(qstats.DEVICE_LAUNCHES)
@@ -210,7 +283,10 @@ def _fence_first_call(fn):
             ms = (time.perf_counter() - t0) * 1000
             get_registry().histogram("pinot_kernel_compile_ms").observe(ms)
             qstats.record(qstats.COMPILE_MS, ms)
+            state["cost"] = _kernel_cost(fn, args, kwargs)
+            _account_cost(state["cost"])
             return out
+        _account_cost(state["cost"])
         return fn(*args, **kwargs)
 
     return call
@@ -242,7 +318,19 @@ def fetch_outputs(outs_dev):
     ms = (time.perf_counter() - t0) * 1000
     get_registry().histogram("pinot_kernel_exec_ms").observe(ms)
     qstats.record(qstats.DEVICE_EXEC_MS, ms)
-    qstats.record(qstats.BYTES_FETCHED, tree_bytes(out))
+    fetched = tree_bytes(out)
+    qstats.record(qstats.BYTES_FETCHED, fetched)
+    get_ledger().note_transient(fetched)
+    # drain the modeled bytes the launches since the last fetch accumulated:
+    # achieved GB/s over this fetch window vs the nominal HBM roofline
+    pending = getattr(_pending_cost, "nbytes", 0.0)
+    if pending > 0.0:
+        _pending_cost.nbytes = 0.0
+        if ms > 0.0:
+            achieved_gbps = pending / (ms * 1e6)
+            qstats.record_max(
+                qstats.ROOFLINE_PCT,
+                min(100.0, 100.0 * achieved_gbps / _nominal_hbm_gbps()))
     return out
 
 

@@ -106,11 +106,13 @@ def _bench() -> None:   # pragma: no cover - manual harness
     import time
     n = 1 << 23
     rng = np.random.default_rng(0)
+    # graftcheck: ignore[memory-untracked-staging] -- manual bench harness:
+    # synthetic inputs live only for this run, never enter serving residency
     od = jnp.asarray(rng.integers(19920101, 19990101, n), dtype=jnp.int32)
-    disc = jnp.asarray(rng.integers(0, 11, n), dtype=jnp.int32)
-    qty = jnp.asarray(rng.integers(1, 51, n), dtype=jnp.int32)
-    price = jnp.asarray(rng.uniform(1, 10000, n), dtype=jnp.float32)
-    rev = jnp.asarray(rng.uniform(1, 60000, n), dtype=jnp.float32)
+    disc = jnp.asarray(rng.integers(0, 11, n), dtype=jnp.int32)  # graftcheck: ignore[memory-untracked-staging] -- bench data, see above
+    qty = jnp.asarray(rng.integers(1, 51, n), dtype=jnp.int32)  # graftcheck: ignore[memory-untracked-staging] -- bench data, see above
+    price = jnp.asarray(rng.uniform(1, 10000, n), dtype=jnp.float32)  # graftcheck: ignore[memory-untracked-staging] -- bench data, see above
+    rev = jnp.asarray(rng.uniform(1, 60000, n), dtype=jnp.float32)  # graftcheck: ignore[memory-untracked-staging] -- bench data, see above
     cols = (od, disc, qty)
     bands = [(19930101, 19931231), (1, 3), (-(1 << 31), 24)]
     rows = (price, rev)

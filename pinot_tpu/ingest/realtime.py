@@ -687,6 +687,7 @@ class RealtimeTableManager:
             # the partition's lag series dies with its consumer (a successor
             # segment re-exports it on the next status snapshot)
             self._remove_lag_gauges([consumer])
+            self._release_device(consumer)
         return consumer
 
     def retire_consumer(self, segment_name: str) -> None:
@@ -695,7 +696,23 @@ class RealtimeTableManager:
         serving. Until this call its mutable buffer keeps answering queries,
         so the segment is never unserved mid-handoff."""
         with self._lock:
-            self.consumers.pop(segment_name, None)
+            consumer = self.consumers.pop(segment_name, None)
+        if consumer is not None:
+            self._release_device(consumer)
+
+    @staticmethod
+    def _release_device(consumer) -> None:
+        """Free a dropped consumer's device staging (and its memory-ledger
+        entries) — DeviceMutableSegment only; plain MutableSegment holds no
+        device arrays."""
+        release = getattr(consumer.mutable, "release_device", None)
+        if release is not None:
+            try:
+                release()
+            # graftcheck: ignore[exception-hygiene] -- teardown best-effort:
+            # a failed device free must not block the commit handoff
+            except Exception:
+                pass
 
     # -- segment transition handling --------------------------------------
     def on_segment_online(self, segment_name: str) -> Optional[str]:

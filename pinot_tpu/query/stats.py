@@ -61,6 +61,12 @@ COLLECTIVE_MS = "collectiveMs"
 DEVICE_SKEW_PCT = "deviceSkewPct"
 HEDGED_REQUESTS = "hedgedRequests"
 ADMISSION_DEFER_MS = "admissionDeferMs"
+# per-kernel cost-profile attribution (XLA cost_analysis at compile time,
+# folded with live launch counters): modeled flops / bytes the query's device
+# launches accounted for, and the achieved-vs-roofline bandwidth percentage
+DEVICE_FLOPS = "deviceFlops"
+DEVICE_BYTES_ACCESSED = "deviceBytesAccessed"
+ROOFLINE_PCT = "rooflinePct"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -74,6 +80,7 @@ COUNTER_KEYS = (
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
     NUM_CONSUMING_SEGMENTS_QUERIED, MUX_FRAME_QUEUE_MS, MUX_FLOW_CONTROL_MS,
     COLLECTIVE_MS, HEDGED_REQUESTS, ADMISSION_DEFER_MS,
+    DEVICE_FLOPS, DEVICE_BYTES_ACCESSED,
 )
 
 # keys that merge by MINIMUM instead of sum (reference: the broker reduces
@@ -87,7 +94,9 @@ MIN_KEYS = (MIN_CONSUMING_FRESHNESS_TIME_MS,)
 # exec-time imbalance any mesh launch saw (summing percentages across
 # launches/servers is meaningless; the slowest chip bounds the query).
 # Absent on responses that never took a multi-device mesh path.
-MAX_KEYS = (DEVICE_SKEW_PCT,)
+# rooflinePct likewise keeps the BEST achieved-vs-roofline fetch window the
+# query saw (sums are meaningless for percentages).
+MAX_KEYS = (DEVICE_SKEW_PCT, ROOFLINE_PCT)
 
 # broker-level keys that live beside the merged counters in QueryResult.stats
 # (listed so the glossary drift guard covers the full emitted surface)

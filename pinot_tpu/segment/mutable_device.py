@@ -612,7 +612,9 @@ class DeviceMutableSegment:
                     host = arr.astype(np.int32)
             else:
                 host = arr.astype(np.float32)
-            dev.append(jnp.asarray(host))
+            from ..utils.memledger import staged
+            dev.append(staged(jnp.asarray(host), self.name, "consuming",
+                              name=f"{name}#{len(dev)}"))
         except Exception:
             self._dev_chunks[name] = None   # no device available: stop trying
 
@@ -732,13 +734,19 @@ class DeviceMutableSegment:
             if got < n:   # a chunk raced publish: top up from host
                 spec = self.schema.field_spec(name)
                 host = np.asarray(view.column(name).fwd[got:n])
+                # graftcheck: ignore[memory-untracked-staging] -- transient
+                # top-up part; the concatenated view column registers below
                 parts.append(jnp.asarray(datablock._narrow(host)))
             if parts:
                 pad = padded - n
                 if pad:
                     parts.append(jnp.zeros(pad, dtype=parts[0].dtype))
-                blk._raw[name] = jnp.concatenate(parts) if len(parts) > 1 \
-                    else parts[0]
+                from ..utils.memledger import staged
+                # re-registration under the stable view:{col} name replaces
+                # the previous view's entry — old view arrays die with it
+                blk._raw[name] = staged(
+                    jnp.concatenate(parts) if len(parts) > 1 else parts[0],
+                    self.name, "consuming", name=f"view:{name}")
         setattr(view, datablock._BLOCK_ATTR, blk)
 
     def snapshot_arrays(self) -> Dict[str, Any]:
@@ -792,6 +800,29 @@ class DeviceMutableSegment:
                               for v in arr]
         self._snap_cols = (n, cols)
         return cols
+
+    def release_device(self) -> None:
+        """Retire hook: drop the staged device chunks and the cached view's
+        device block, and deregister this segment's ledger entries. Without
+        it a retired consuming segment's HBM stays pinned for as long as any
+        stray reference to the consumer survives (the leak class the ledger's
+        reconcile pass exists to catch)."""
+        for name in list(self._dev_chunks):
+            if self._dev_chunks[name]:
+                self._dev_chunks[name] = []
+        view = self._view
+        if view is not None:
+            try:
+                from ..engine import datablock
+                if getattr(view, datablock._BLOCK_ATTR, None) is not None:
+                    delattr(view, datablock._BLOCK_ATTR)
+            # graftcheck: ignore[exception-hygiene] -- retire-time teardown is
+            # best-effort: a failed cache detach must not block the consumer
+            # retire; the ledger release below still frees the accounting
+            except Exception:
+                pass
+        from ..utils.memledger import get_ledger
+        get_ledger().release(segment=self.name, kind="consuming")
 
     def __repr__(self) -> str:
         return f"DeviceMutableSegment({self.name!r}, docs={self._num_docs})"

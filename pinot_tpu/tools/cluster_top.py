@@ -9,9 +9,11 @@ from the broker rollups, and a servers panel showing the broker failure
 detector's view (healthy vs probing, consecutive probe failures, seconds to
 the next probe) with the lifetime hedged-request count in the header, and an
 admission panel showing the broker's shed state, in-flight depth against its
-queue thresholds, and per-table/per-reason shed counts. The
+queue thresholds, and per-table/per-reason shed counts, and a device-memory
+panel with the controller's per-table HBM verdict, resident bytes, and the
+worst per-server headroom. The
 operator's first stop when a dashboard shows a table going stale, an SLO
-burning, or a server flapping:
+burning, a server flapping, or HBM filling up:
 
     python -m pinot_tpu.tools.cluster_top --controller http://host:9000 \\
         --broker http://host:8099 [--interval 5] [--once] [--token TOKEN]
@@ -44,8 +46,8 @@ def snapshot(controller_url: str, broker_url: Optional[str],
     controller plus the broker's lifetime query rollup. Endpoint failures
     degrade to partial data (an unreachable broker must not blank the lag
     columns)."""
-    out: Dict[str, Any] = {"tables": {}, "slo": {}, "tableStats": {},
-                           "broker": None, "errors": []}
+    out: Dict[str, Any] = {"tables": {}, "slo": {}, "memory": {},
+                           "tableStats": {}, "broker": None, "errors": []}
     try:
         tables = fetch(f"{controller_url}/tables").get("tables", [])
     except Exception as e:
@@ -64,6 +66,13 @@ def snapshot(controller_url: str, broker_url: Optional[str],
         # the missing entry renders visibly as "-" in the SLO column
         except Exception:
             pass   # older controller / unknown table: SLO column shows "-"
+        try:
+            out["memory"][t] = fetch(
+                f"{controller_url}/tables/{t}/memoryStatus")
+        # graftcheck: ignore[exception-hygiene] -- read-only dashboard poll;
+        # the missing entry drops the table from the memory panel visibly
+        except Exception:
+            pass   # older controller: memory panel row shows nothing
     if broker_url:
         try:
             debug = fetch(f"{broker_url}/debug")
@@ -98,6 +107,18 @@ def _fmt_lag_ms(v: Any) -> str:
     if ms >= 1_000:
         return f"{ms / 1_000:.1f}s"
     return f"{ms:.0f}ms"
+
+
+def _fmt_bytes(v: Any) -> str:
+    try:
+        n = float(v)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024.0
+    return "-"
 
 
 def render(snap: Dict[str, Any]) -> str:
@@ -175,6 +196,29 @@ def render(snap: Dict[str, Any]) -> str:
             lines.append("  shed by table: " +
                          " ".join(f"{t}={n}" for t, n in ranked) +
                          (f"   by reason: {reasons}" if reasons else ""))
+    memory = {t: m for t, m in (snap.get("memory") or {}).items()
+              if m and m.get("memoryState") not in (None, "UNKNOWN")}
+    if memory:
+        lines.append("")
+        lines.append("device memory (controller verdicts)")
+        mcols = f"{'TABLE':<28} {'MEM':<10} {'RESIDENT':>10} " \
+                f"{'MINHEADROOM':>12}  REASONS"
+        lines.append(mcols)
+        lines.append("-" * len(mcols))
+        servers_seen: Dict[str, Any] = {}
+        for t in sorted(memory):
+            m = memory[t]
+            headroom = m.get("minServerHeadroomPct")
+            lines.append(
+                f"{t:<28} {m.get('memoryState', '?'):<10} "
+                f"{_fmt_bytes(m.get('residentBytes')):>10} "
+                f"{(f'{headroom:.1f}%' if headroom is not None else '-'):>12}"
+                f"  {'; '.join(m.get('reasons') or [])}")
+            servers_seen.update(m.get("servers") or {})
+        if servers_seen:
+            lines.append("  server headroom: " + " ".join(
+                f"{s}={h:.1f}%" if isinstance(h, (int, float)) else f"{s}=-"
+                for s, h in sorted(servers_seen.items())))
     detector = snap.get("failureDetector") or {}
     if detector:
         lines.append("")

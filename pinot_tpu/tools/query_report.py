@@ -93,6 +93,13 @@ def render_report(stats: Dict[str, Any]) -> str:
         except (TypeError, ValueError):
             skew = f"{stats['deviceSkewPct']!s:>10}"
         out.append(f"  {'device skew':<15} {skew}  (worst mesh launch)")
+    if "rooflinePct" in stats:
+        try:
+            roofline = f"{float(stats['rooflinePct']):10.1f} %"
+        except (TypeError, ValueError):
+            roofline = f"{stats['rooflinePct']!s:>10}"
+        out.append(f"  {'hbm roofline':<15} {roofline}  "
+                   "(achieved/nominal bandwidth, worst fetch window)")
     out.append("")
     out.append("counters")
     for key in ("numSegmentsQueried", "numSegmentsPruned",
@@ -101,7 +108,8 @@ def render_report(stats: Dict[str, Any]) -> str:
                 "numSegmentsMatched", "numDocsScanned", "scanRowsAvoided",
                 "numGroupsTotal", "deviceLaunches",
                 "dedupedLaunches", "stackedLaunches", "compileCacheHits",
-                "compileCacheMisses", "bytesFetched", "numServersQueried",
+                "compileCacheMisses", "bytesFetched", "deviceFlops",
+                "deviceBytesAccessed", "numServersQueried",
                 "numServersResponded"):
         if key in stats:
             out.append(f"  {key:<20} {stats[key]}")

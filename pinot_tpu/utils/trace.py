@@ -276,4 +276,13 @@ def to_chrome_trace(entries: Union[Dict[str, Any], Iterable[Dict[str, Any]]]
                 "tid": tids[track],
                 "args": args,
             })
+        # device-memory residency rides the same timeline as counter events
+        # (`ph: "C"` renders as a filled area track under the spans), so a
+        # trace shows HBM residency next to the work that created it
+        for sample in entry.get("memory") or ():
+            ts = round(max(float(sample.get("tsMs", 0.0)), 0.0) * 1000.0, 3)
+            for series, value in (sample.get("series") or {}).items():
+                events.append({"name": str(series), "cat": "memory",
+                               "ph": "C", "ts": ts, "pid": pid, "tid": 0,
+                               "args": {"bytes": value}})
     return {"traceEvents": events, "displayTimeUnit": "ms"}

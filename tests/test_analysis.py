@@ -18,7 +18,7 @@ from pinot_tpu.analysis import (admission_hygiene, blocking_in_loop,
                                 collective_hygiene, drift_guards,
                                 exception_hygiene, filter_path,
                                 ingest_hot_loop, jit_hygiene, lock_discipline,
-                                transport_bypass)
+                                memory_hygiene, transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -377,6 +377,68 @@ def test_transport_bypass_suppression_honored():
     """, transport_bypass.rules())
     assert active == []
     assert _ids(suppressed) == ["transport-bypass"]
+
+
+# -- memory-hygiene -----------------------------------------------------------
+
+def test_untracked_staging_true_positive():
+    active, _ = _check("""
+        import jax
+        import jax.numpy as jnp
+
+        def load(host):
+            a = jnp.asarray(host)
+            b = jax.device_put(host)
+            return a, b
+    """, memory_hygiene.rules(), rel="pinot_tpu/engine/fixture.py")
+    assert _ids(active) == ["memory-untracked-staging"] * 2
+
+
+def test_untracked_staging_clean_when_wrapped():
+    # staged() registers the allocation in the ledger — the sanctioned form
+    active, _ = _check("""
+        import jax.numpy as jnp
+        from pinot_tpu.utils.memledger import staged
+
+        def load(host, seg):
+            return staged(jnp.asarray(host), seg, "raw")
+    """, memory_hygiene.rules(), rel="pinot_tpu/segment/fixture.py")
+    assert active == []
+
+
+def test_untracked_staging_scoped_to_device_residency_packages():
+    # tools/analysis/bench code doesn't hold serving residency: out of scope
+    active, _ = _check("""
+        import jax.numpy as jnp
+
+        def load(host):
+            return jnp.asarray(host)
+    """, memory_hygiene.rules(), rel="pinot_tpu/tools/fixture.py")
+    assert active == []
+
+
+def test_untracked_staging_jit_traced_is_exempt():
+    # inside a jit trace, asarray is math on tracers — not device staging
+    active, _ = _check("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return jnp.asarray(x) + 1
+    """, memory_hygiene.rules(), rel="pinot_tpu/engine/fixture.py")
+    assert active == []
+
+
+def test_untracked_staging_suppression_honored():
+    active, suppressed = _check("""
+        import jax.numpy as jnp
+
+        def bench(host):
+            return jnp.asarray(host)  # graftcheck: ignore[memory-untracked-staging] -- bench-only data
+    """, memory_hygiene.rules(), rel="pinot_tpu/engine/fixture.py")
+    assert active == []
+    assert _ids(suppressed) == ["memory-untracked-staging"]
 
 
 # -- collective-hygiene --------------------------------------------------------

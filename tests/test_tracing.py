@@ -125,11 +125,16 @@ def _assert_valid_chrome_doc(doc):
     assert isinstance(events, list) and events
     json.loads(json.dumps(doc))        # round-trips as pure JSON
     for ev in events:
-        assert ev["ph"] in ("M", "X")
+        assert ev["ph"] in ("M", "X", "C")
         assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
         if ev["ph"] == "M":
             assert ev["name"] in ("process_name", "thread_name")
             assert isinstance(ev["args"]["name"], str)
+        elif ev["ph"] == "C":
+            # HBM residency counter track (the device-memory plane)
+            assert ev["cat"] == "memory"
+            assert ev["ts"] >= 0
+            assert isinstance(ev["args"]["bytes"], (int, float))
         else:
             assert ev["ts"] >= 0 and ev["dur"] >= 0
             assert isinstance(ev["args"]["depth"], int)
