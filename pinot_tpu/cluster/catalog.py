@@ -28,6 +28,10 @@ OFFLINE = "OFFLINE"
 CONSUMING = "CONSUMING"
 DROPPED = "DROPPED"
 ERROR = "ERROR"
+# cold tier: the segment stays registered (catalog + routing) and deepstore
+# holds the bytes, but no server keeps it loaded — first query lazily
+# downloads and admits it like any other segment.
+COLD = "COLD"
 
 # segment metadata status (reference: SegmentZKMetadata.Status)
 STATUS_IN_PROGRESS = "IN_PROGRESS"

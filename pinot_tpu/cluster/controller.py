@@ -319,9 +319,32 @@ class Controller:
                 {"uri": parked,
                  "deletedAtMs": now_ms or int(time.time() * 1000)})
 
+    def demote_segment_to_cold(self, table: str, segment: str) -> bool:
+        """Tiered-storage demotion: flip every replica's ideal-state
+        assignment to COLD. Servers unload their local copy (deep store keeps
+        the bytes), routing keeps the segment routable, and the first query
+        lazily re-downloads it. Returns False when the segment is unknown or
+        already fully COLD."""
+        from .catalog import COLD
+        ist = self.catalog.ideal_state.get(table, {}).get(segment)
+        if not ist or all(st == COLD for st in ist.values()):
+            return False
+        self.catalog.update_ideal_state(
+            table, {segment: {srv: COLD for srv in ist}})
+        from ..utils.metrics import get_registry
+        get_registry().counter("pinot_controller_cold_demotions",
+                               {"table": table}).inc()
+        return True
+
     def run_retention(self, now_ms: Optional[int] = None) -> List[str]:
-        """Reference: RetentionManager periodic task — delete segments past retention."""
+        """Reference: RetentionManager periodic task — delete segments past
+        retention. With `controller.retention.cold.demote` set true, expiry
+        demotes to the cold tier (recoverable, still queryable) instead of
+        deleting — time-based tiering riding the same periodic task."""
         now_ms = now_ms or int(time.time() * 1000)
+        demote = str(self.catalog.get_property(
+            "clusterConfig/controller.retention.cold.demote",
+            "false")).lower() == "true"
         deleted = []
         for table, cfg in list(self.catalog.table_configs.items()):
             if not cfg.retention_days or not cfg.time_column:
@@ -329,6 +352,10 @@ class Controller:
             cutoff = now_ms - cfg.retention_days * 24 * 3600 * 1000
             for seg, meta in list(self.catalog.segments.get(table, {}).items()):
                 if meta.end_time_ms is not None and meta.end_time_ms < cutoff:
+                    if demote:
+                        if self.demote_segment_to_cold(table, seg):
+                            deleted.append(f"cold:{table}/{seg}")
+                        continue
                     self.delete_segment(table, seg, now_ms=now_ms)
                     deleted.append(f"{table}/{seg}")
         # reap parked deep-store copies past the deleted-segment retention
@@ -788,6 +815,18 @@ class Controller:
                             f"server {sid} HBM headroom {h:g}% below "
                             f"threshold {thr:g}%")
 
+            # tiered-storage rollup: sum each server's lifecycle counters so
+            # the verdict shows whether the cluster is riding the admission
+            # gate (evictions/rejections climbing) or comfortably hot
+            tiering: Dict[str, int] = {}
+            for snap in snaps.values():
+                t_snap = snap.get("tiering")
+                if not isinstance(t_snap, dict):
+                    continue
+                for k in ("admissions", "rejections", "evictions",
+                          "promotions", "coldLoads"):
+                    tiering[k] = tiering.get(k, 0) + int(t_snap.get(k, 0) or 0)
+
             labels = {"table": table}
             reg.gauge(self._MEMORY_TABLE_GAUGES[0], labels).set(
                 1 if verdict == "HEALTHY" else 0)
@@ -801,6 +840,7 @@ class Controller:
                      for s in snaps.values()), default=None),
                 "servers": per_server,
                 "unreachableServers": sorted(unreachable),
+                "tiering": tiering,
             }
         for table in self._memory_tables - set(out):
             for g in self._MEMORY_TABLE_GAUGES:

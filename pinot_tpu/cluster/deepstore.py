@@ -91,6 +91,9 @@ class LocalDeepStore(DeepStoreFS):
         os.replace(tmp, dest)
 
     def download(self, uri: str, local_path: str) -> None:
+        # graftfault: fails BEFORE any byte lands, so a retrying caller never
+        # sees a torn local file
+        fault_point("deepstore.download.fail")
         os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
         shutil.copyfile(self._path(uri), local_path)
 
@@ -136,6 +139,7 @@ class MemDeepStore(DeepStoreFS):
             self._blobs[uri] = data
 
     def download(self, uri: str, local_path: str) -> None:
+        fault_point("deepstore.download.fail")
         with self._lock:
             if uri not in self._blobs:
                 raise FileNotFoundError(f"mem://{uri}")
@@ -206,6 +210,7 @@ class RemoteObjectFS(DeepStoreFS):
 
     # shared semantics ------------------------------------------------------
     def download(self, uri: str, local_path: str) -> None:
+        fault_point("deepstore.download.fail")
         data = self.get_bytes(uri)
         os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
         with open(local_path, "wb") as f:

@@ -12,9 +12,26 @@ failed (`completion.py` PeerSchemeSplitSegmentCommitter analog).
 from __future__ import annotations
 
 import os
-from typing import List, Optional
+import threading
+import time
+from typing import List, Optional, Set, Tuple
 
+from ..utils.metrics import get_registry
 from .catalog import ONLINE
+
+# (table, segment) pairs whose deep-store download exhausted the retry
+# budget: subsequent fetches skip straight to the peer path instead of
+# re-burning the backoff schedule against a blob that keeps failing
+# (mirror of the completion.py upload quarantine)
+_download_quarantine: Set[Tuple[str, str]] = set()
+_quarantine_lock = threading.Lock()
+
+
+def clear_download_quarantine() -> None:
+    """Operator/test hook: give quarantined blobs another shot at the deep
+    store (e.g. after the store recovers)."""
+    with _quarantine_lock:
+        _download_quarantine.clear()
 
 
 def peer_urls(catalog, table: str, segment: str,
@@ -37,15 +54,47 @@ def download_segment_tar(deepstore, catalog, table: str, segment: str,
                          dest_tar: str, download_path: str,
                          exclude_instance: Optional[str] = None) -> None:
     """One download policy for every fetcher (server load, minion input,
-    controller raw-download proxy): deep store first, falling back to a
-    serving peer on a peer:// scheme OR any deep-store failure."""
-    try:
-        if download_path.startswith("peer://"):
-            raise ConnectionError("peer-scheme segment")
-        deepstore.download(download_path, dest_tar)
-    except Exception:
+    controller raw-download proxy): deep store first — with the
+    `deepstore.retry.*` exponential backoff the upload path already uses —
+    falling back to a serving peer on a peer:// scheme, retry exhaustion
+    (which also quarantines the blob so later fetches skip the backoff), or
+    any other deep-store failure."""
+    key = (table, segment)
+    with _quarantine_lock:
+        quarantined = key in _download_quarantine
+    if download_path.startswith("peer://") or quarantined:
         fetch_from_peer(catalog, table, segment, dest_tar,
                         exclude_instance=exclude_instance)
+        return
+    max_attempts = 3
+    backoff_ms = 50.0
+    try:
+        max_attempts = max(1, int(catalog.get_property(
+            "clusterConfig/deepstore.retry.max", 3)))
+        backoff_ms = float(catalog.get_property(
+            "clusterConfig/deepstore.retry.backoff.ms", 50))
+    # graftcheck: ignore[exception-hygiene] -- malformed retry knobs fall
+    # back to the documented defaults; the retry loop below is the outcome
+    except Exception:
+        pass
+    reg = get_registry()
+    for attempt in range(1, max_attempts + 1):
+        if attempt > 1:
+            reg.counter("pinot_deepstore_download_retries").inc()
+            time.sleep(backoff_ms * 2 ** (attempt - 2) / 1000.0)
+        try:
+            deepstore.download(download_path, dest_tar)
+            return
+        # graftcheck: ignore[exception-hygiene] -- each failed attempt is
+        # observed: retries counted above, exhaustion counted + quarantined
+        # below, and the peer fallback raises typed when it too fails
+        except Exception:
+            continue
+    with _quarantine_lock:
+        _download_quarantine.add(key)
+    reg.counter("pinot_deepstore_download_quarantined").inc()
+    fetch_from_peer(catalog, table, segment, dest_tar,
+                    exclude_instance=exclude_instance)
 
 
 def fetch_from_peer(catalog, table: str, segment: str, dest_tar: str,

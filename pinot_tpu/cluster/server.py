@@ -23,8 +23,10 @@ from ..query.executor import ServerQueryExecutor
 from ..query.reduce import SegmentResult, merge_segment_results
 from ..segment.reader import ImmutableSegment, load_segment
 from ..utils.faults import fault_point
-from .catalog import CONSUMING, DROPPED, OFFLINE, ONLINE, Catalog, InstanceInfo
+from .catalog import (COLD, CONSUMING, DROPPED, OFFLINE, ONLINE, Catalog,
+                      InstanceInfo)
 from .deepstore import DeepStoreFS, untar_segment
+from .tiering import PRESSURE_INTERVAL_S, TieringManager
 
 
 class TableDataManager:
@@ -35,12 +37,22 @@ class TableDataManager:
         self.data_dir = data_dir
         self._segments: Dict[str, ImmutableSegment] = {}
         self._refcounts: Dict[str, int] = {}
+        # segments unloaded while a query still held a ref: their device
+        # block + ledger release defers until release() drains the refcount
+        self._deferred: Dict[str, ImmutableSegment] = {}
         self._lock = threading.RLock()
 
     def add_segment(self, name: str, segment: ImmutableSegment) -> None:
         with self._lock:
+            # a deferred copy being replaced (reload swap) releases NOW: the
+            # fresh reader takes over, and acquired refs point at the old
+            # object which stays valid until its holders release it
+            old = self._deferred.pop(name, None)
             self._segments[name] = segment
             self._refcounts.setdefault(name, 0)
+        if old is not None and old is not segment:
+            from ..engine.datablock import release_block
+            release_block(old)
         # table attribution for staging sites that only know the segment
         # (engine.datablock): offline segment names carry no table prefix
         from ..utils.memledger import get_ledger
@@ -49,6 +61,13 @@ class TableDataManager:
     def remove_segment(self, name: str) -> None:
         with self._lock:
             seg = self._segments.pop(name, None)
+            if seg is not None and self._refcounts.get(name, 0) > 0:
+                # unload-vs-in-flight-query race: a running query acquired
+                # this segment — yanking the device block now would fail its
+                # kernels mid-flight. Park it; release() frees it when the
+                # refcount drains to zero.
+                self._deferred[name] = seg
+                return
             self._refcounts.pop(name, None)
         if seg is not None:
             # unload = free: drop the cached device block and its ledger
@@ -65,10 +84,26 @@ class TableDataManager:
             return [self._segments[n] for n in targets]
 
     def release(self, segments: Sequence[ImmutableSegment]) -> None:
+        doomed: List[ImmutableSegment] = []
         with self._lock:
             for seg in segments:
                 if seg.name in self._refcounts:
                     self._refcounts[seg.name] -= 1
+                    if (self._refcounts[seg.name] <= 0
+                            and seg.name in self._deferred):
+                        # last holder of an unloaded segment: free it now
+                        doomed.append(self._deferred.pop(seg.name))
+                        self._refcounts.pop(seg.name, None)
+        if doomed:
+            from ..engine.datablock import release_block
+            for seg in doomed:
+                release_block(seg)
+
+    def refcount(self, name: str) -> int:
+        """In-flight acquisitions of `name` — the tiering eviction loop's
+        never-evict-under-a-running-query check."""
+        with self._lock:
+            return self._refcounts.get(name, 0)
 
     def get(self, name: str) -> Optional[ImmutableSegment]:
         with self._lock:
@@ -97,6 +132,23 @@ class ServerNode:
         bitmap_on = str(catalog.get_property(
             "clusterConfig/server.index.bitmap.enabled", "true")).lower() != "false"
         self.executor = ServerQueryExecutor(bitmap_enabled=bitmap_on)
+        # host-tier executor: never stages device blocks — what unadmitted
+        # segments run on when the HBM admission gate rejects them
+        self.host_executor = ServerQueryExecutor(use_device=False,
+                                                 bitmap_enabled=bitmap_on)
+        # HBM capacity override knob (env PINOT_TPU_HBM_CAPACITY_BYTES is the
+        # process-level equivalent): lets tests/bench pin a tiny budget
+        cap_raw = catalog.get_property(
+            "clusterConfig/server.hbm.capacity.bytes", None)
+        if cap_raw is not None:
+            try:
+                from ..utils.memledger import get_ledger
+                get_ledger().set_capacity(int(cap_raw))
+            except (TypeError, ValueError):
+                pass  # malformed knob: keep the probed capacity
+        # tiered-storage lifecycle: HBM admission gate + pressure eviction
+        self.tiering = TieringManager(catalog)
+        self._pressure_scheduler = None
         # optional admission control (reference: QueryScheduler wrapping the
         # executor; None = direct execution, the single-tenant test default)
         self.scheduler = scheduler
@@ -173,6 +225,25 @@ class ServerNode:
             self.scheduler.stop()
         if self.device_pipeline is not None:
             self.device_pipeline.stop()
+        self.stop_pressure_loop()
+
+    def start_pressure_loop(self) -> None:
+        """Run the HBM pressure sweep as a background periodic task — called
+        by ServerService (real server processes); tests drive
+        `tiering.run_pressure_sweep()` directly for determinism."""
+        from ..utils.periodic import PeriodicTask, PeriodicTaskScheduler
+        if self._pressure_scheduler is not None:
+            return
+        sched = PeriodicTaskScheduler()
+        sched.register(PeriodicTask("HbmPressureLoop", PRESSURE_INTERVAL_S,
+                                    self.tiering.run_pressure_sweep))
+        sched.start()
+        self._pressure_scheduler = sched
+
+    def stop_pressure_loop(self) -> None:
+        if self._pressure_scheduler is not None:
+            self._pressure_scheduler.stop()
+            self._pressure_scheduler = None
 
     # -- state transitions -------------------------------------------------
     def _on_catalog_event(self, event: str, table: str) -> None:
@@ -307,10 +378,35 @@ class ServerNode:
                     handler.start_consuming(seg_name)
                     self.catalog.report_state(table, seg_name, self.instance_id,
                                               CONSUMING)
+            elif state == COLD:
+                # cold demotion: the deep store holds the bytes; unload the
+                # local copy. The segment stays registered + routable — first
+                # query lazily re-downloads it (_run_partial cold path).
+                # Transition-edge only (external view not yet COLD): a later
+                # reconcile must NOT unload a copy the cold path just lazily
+                # re-downloaded.
+                ev_state = self.catalog.external_view.get(table, {}) \
+                    .get(seg_name, {}).get(self.instance_id)
+                if ev_state != COLD:
+                    if seg_name in mgr.segment_names:
+                        busy = mgr.refcount(seg_name) > 0
+                        mgr.remove_segment(seg_name)
+                        self.tiering.forget(seg_name)
+                        if not busy:
+                            # an in-flight query may lazily open column files
+                            # off its deferred reader — only reclaim disk when
+                            # no one holds the segment
+                            import shutil
+                            shutil.rmtree(os.path.join(self.data_dir, table,
+                                                       seg_name),
+                                          ignore_errors=True)
+                    self.catalog.report_state(table, seg_name,
+                                              self.instance_id, COLD)
 
         for seg_name in list(mgr.segment_names):
             if seg_name not in desired:
                 mgr.remove_segment(seg_name)
+                self.tiering.forget(seg_name)
                 with self._lock:  # prune the load lock with the segment
                     self._load_locks.pop((table, seg_name), None)
                 self.catalog.report_state(table, seg_name, self.instance_id, None)
@@ -400,6 +496,7 @@ class ServerNode:
         from ..utils.memledger import get_ledger
         snap = get_ledger().snapshot()
         snap["instanceId"] = self.instance_id
+        snap["tiering"] = self.tiering.snapshot()
         return snap
 
     def _load_online_segment(self, table: str, seg_name: str, mgr: TableDataManager) -> None:
@@ -425,6 +522,22 @@ class ServerNode:
                     if os.path.exists(tar_local):
                         os.remove(tar_local)
             mgr.add_segment(seg_name, load_segment(local_dir))
+
+    def _cold_unloaded(self, table: str,
+                       segment_names: Optional[Sequence[str]],
+                       mgr: TableDataManager) -> List[str]:
+        """Segments the query wants that are assigned COLD to this server
+        with no loaded copy — the cold-tier lazy-load set. Snapshot under the
+        catalog lock (startup_status idiom: the in-proc catalog mutates its
+        dicts in place)."""
+        with self.catalog._lock:
+            ist = {s: dict(a) for s, a in
+                   self.catalog.ideal_state.get(table, {}).items()}
+        loaded = set(mgr.segment_names)
+        wanted = list(ist) if segment_names is None else list(segment_names)
+        return [s for s in wanted
+                if s not in loaded
+                and ist.get(s, {}).get(self.instance_id) == COLD]
 
     def local_segment_dir(self, table: str, seg_name: str) -> Optional[str]:
         """On-disk directory of a LOADED segment (peer download serves from
@@ -582,10 +695,53 @@ class ServerNode:
         handler = self._realtime_managers.get(table)
         upsert = getattr(handler, "upsert", None) if handler else None
         segments = mgr.acquire(segment_names)
+        admitted: List[ImmutableSegment] = []
         try:
+            # cold tier: requested segments assigned COLD to this server with
+            # no local copy lazily download NOW, bounded by the propagated
+            # deadline — past-budget loads fail typed instead of stalling
+            for seg_name in self._cold_unloaded(table, segment_names, mgr):
+                remaining_s = _deadline_remaining_s(ctx)
+                if (remaining_s is not None
+                        and remaining_s <= self.MIN_DEADLINE_BUDGET_S):
+                    from ..query.scheduler import QueryTimeoutError
+                    d_ms = ctx.options.get("deadlineEpochMs") \
+                        if ctx.options else None
+                    raise QueryTimeoutError(
+                        f"deadline budget exhausted before cold-tier load of "
+                        f"{table}/{seg_name} at {self.instance_id}",
+                        deadline_epoch_ms=float(d_ms)
+                        if d_ms is not None else None)
+                t_load = _t.perf_counter()
+                with span(f"coldload:{seg_name}"):
+                    self._load_online_segment(table, seg_name, mgr)
+                segments.extend(mgr.acquire([seg_name]))
+                self.tiering.note_cold_load()
+                qstats.record(qstats.SEGMENTS_COLD_LOADED, 1)
+                qstats.record(qstats.COLD_LOAD_MS,
+                              (_t.perf_counter() - t_load) * 1000)
+
+            # HBM admission gate: predict each un-staged block's bytes
+            # against the tiering target (evicting colder victims first);
+            # rejected segments run the host plan instead of OOMing
+            from ..engine.datablock import has_block
+            host_tier: List[ImmutableSegment] = []
+            for seg in segments:
+                fresh = not has_block(seg)
+                if self.tiering.admit(table, seg, mgr):
+                    admitted.append(seg)
+                    if fresh:
+                        self.tiering.note_promotion()
+                        qstats.record(qstats.TIER_PROMOTIONS, 1)
+                else:
+                    host_tier.append(seg)
+            if host_tier:
+                qstats.record(qstats.SEGMENTS_SERVED_HOST_TIER,
+                              len(host_tier))
+
             results = []
             device_partial = None
-            if (self.device_pipeline is not None and segments
+            if (self.device_pipeline is not None and admitted
                     and upsert is None
                     and (ctx.aggregations or ctx.distinct
                          or device_topk_screen(ctx))):
@@ -605,7 +761,7 @@ class ServerNode:
                 with span("device"):
                     try:
                         out = self.device_pipeline.execute_partial(ctx,
-                                                                   segments)
+                                                                   admitted)
                     except Exception:
                         out = DEVICE_FALLBACK  # device fault -> host answers
                 if out is not DEVICE_FALLBACK:
@@ -617,18 +773,27 @@ class ServerNode:
                 # the pipeline's threads can't attribute per-query segment
                 # counts (they serve many queries per launch) — account the
                 # set here, on the query's own thread
-                qstats.record(qstats.NUM_SEGMENTS_QUERIED, len(segments))
+                qstats.record(qstats.NUM_SEGMENTS_QUERIED, len(admitted))
                 if (device_partial.num_docs_scanned > 0
                         or device_partial.groups or device_partial.rows
                         or device_partial.dense is not None):
-                    qstats.record(qstats.NUM_SEGMENTS_MATCHED, len(segments))
+                    qstats.record(qstats.NUM_SEGMENTS_MATCHED, len(admitted))
+                # unadmitted segments still answer — on the host plan
+                for seg in host_tier:
+                    with span(f"segment:{seg.name}"):
+                        valid = upsert.valid_mask(seg.name, seg.num_docs) \
+                            if upsert else None
+                        results.append(self.host_executor.execute_segment(
+                            ctx, seg, valid))
             else:
+                admitted_names = {seg.name for seg in admitted}
                 for seg in segments:
                     with span(f"segment:{seg.name}"):
                         valid = upsert.valid_mask(seg.name, seg.num_docs) \
                             if upsert else None
-                        results.append(self.executor.execute_segment(ctx, seg,
-                                                                     valid))
+                        ex = self.executor if seg.name in admitted_names \
+                            else self.host_executor
+                        results.append(ex.execute_segment(ctx, seg, valid))
             # include in-progress realtime docs when a consuming manager exists
             served = [seg.name for seg in segments]
             if handler is not None:
@@ -650,6 +815,10 @@ class ServerNode:
                         qstats.record_min(
                             qstats.MIN_CONSUMING_FRESHNESS_TIME_MS, fresh)
         finally:
+            # reservations made by THIS query's admissions are settled: a
+            # block either staged (the ledger counts it now) or never will
+            # until another query re-admits it
+            self.tiering.settle([seg.name for seg in admitted])
             mgr.release(segments)
         aggs = [make_agg(f) for f in ctx.aggregations]
         with span("merge"):

@@ -732,6 +732,10 @@ class ServerService:
         # endpoint so exchange legs targeting it skip the HTTP hop
         from ..multistage.shuffle import register_local_endpoint
         register_local_endpoint(self.http.url)
+        # tiered storage: the HBM pressure sweep runs as a background
+        # periodic task in real server processes (tests drive
+        # tiering.run_pressure_sweep() directly for determinism)
+        server.start_pressure_loop()
 
     @property
     def url(self) -> str:
@@ -740,6 +744,7 @@ class ServerService:
     def stop(self) -> None:
         from ..multistage.shuffle import unregister_local_endpoint
         unregister_local_endpoint(self.http.url)
+        self.server.stop_pressure_loop()
         self.http.stop()
         self._mux_pool.shutdown(wait=False)
 

@@ -225,6 +225,42 @@ class SegmentBlock:
 _BLOCK_ATTR = "_device_block"
 
 
+def has_block(segment) -> bool:
+    """True when the segment already holds a cached device block — the
+    tiering admission gate's hot-path check (an admitted segment re-touches
+    its entry instead of re-predicting bytes)."""
+    return getattr(segment, _BLOCK_ATTR, None) is not None
+
+
+def predicted_block_bytes(segment: ImmutableSegment) -> int:
+    """Upper bound on the HBM bytes a fully-staged SegmentBlock for this
+    segment can occupy, computed from segment metadata alone (no staging, no
+    column reads) — what the tiering admission gate charges against ledger
+    headroom BEFORE `block_for` stages anything.
+
+    Deliberately conservative: every column is priced as if every lazy cache
+    the block can build for it (ids + LUT + decoded + bitmap, or raw) gets
+    built. Overestimating only host-tiers a segment early; underestimating
+    is how admission OOMs."""
+    padded = padded_rows(segment.num_docs)
+    # valid mask + packed valid words (built for every block)
+    total = padded * 1 + (padded // 32) * 4
+    for col, meta in segment.metadata.get("columns", {}).items():
+        width = max(int(meta.get("maxNumValues", 1) or 1), 1) \
+            if meta.get("multiValue") else 1
+        if meta.get("hasDictionary"):
+            card = int(meta.get("cardinality", 0) or 0)
+            total += padded * 4 * width            # int32 ids
+            total += lut_size(card) * 4            # dict LUT (narrowed to 32-bit)
+            total += padded * 4                    # decoded-values cache
+            if 0 < card <= BITMAP_MAX_CARD and width == 1:
+                total += card * (padded // 32) * 4  # packed bitmap index
+        else:
+            total += padded * 4                    # raw view (narrowed)
+        total += padded * 1                        # null mask
+    return total
+
+
 def block_for(segment: ImmutableSegment) -> SegmentBlock:
     blk = getattr(segment, _BLOCK_ATTR, None)
     if blk is None:

@@ -67,6 +67,14 @@ ADMISSION_DEFER_MS = "admissionDeferMs"
 DEVICE_FLOPS = "deviceFlops"
 DEVICE_BYTES_ACCESSED = "deviceBytesAccessed"
 ROOFLINE_PCT = "rooflinePct"
+# tiered-storage lifecycle: segments the admission gate kept OFF the device
+# (served by the host plan instead of OOMing), segments freshly promoted
+# host→HBM this query, and cold-tier segments lazily downloaded from the
+# deep store on first query (+ the wall time those downloads took)
+SEGMENTS_SERVED_HOST_TIER = "segmentsServedHostTier"
+TIER_PROMOTIONS = "tierPromotions"
+SEGMENTS_COLD_LOADED = "segmentsColdLoaded"
+COLD_LOAD_MS = "coldLoadMs"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -81,6 +89,8 @@ COUNTER_KEYS = (
     NUM_CONSUMING_SEGMENTS_QUERIED, MUX_FRAME_QUEUE_MS, MUX_FLOW_CONTROL_MS,
     COLLECTIVE_MS, HEDGED_REQUESTS, ADMISSION_DEFER_MS,
     DEVICE_FLOPS, DEVICE_BYTES_ACCESSED,
+    SEGMENTS_SERVED_HOST_TIER, TIER_PROMOTIONS,
+    SEGMENTS_COLD_LOADED, COLD_LOAD_MS,
 )
 
 # keys that merge by MINIMUM instead of sum (reference: the broker reduces

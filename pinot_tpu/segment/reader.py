@@ -174,6 +174,16 @@ class ImmutableSegment:
     def column_names(self) -> List[str]:
         return list(self.metadata["columns"].keys())
 
+    def column_meta(self, name: str) -> Dict:
+        """The column's durable metadata dict (dataType / hasDictionary /
+        cardinality / multiValue / maxNumValues / …) WITHOUT opening the
+        column files — what metadata-only consumers (the tiering admission
+        gate's byte prediction, broker pruning) should read instead of
+        `column()`, which mmaps the forward index."""
+        if name not in self.metadata["columns"]:
+            raise KeyError(f"segment {self.name}: no column {name!r}")
+        return self.metadata["columns"][name]
+
     @cached_property
     def star_trees(self) -> List["StarTree"]:
         from .startree import load_star_trees

@@ -219,6 +219,18 @@ def render(snap: Dict[str, Any]) -> str:
             lines.append("  server headroom: " + " ".join(
                 f"{s}={h:.1f}%" if isinstance(h, (int, float)) else f"{s}=-"
                 for s, h in sorted(servers_seen.items())))
+        # tiered-storage lifecycle rollup: every verdict carries the same
+        # cluster-wide counter sums, so max per key is the cluster view
+        tiering: Dict[str, int] = {}
+        for m in memory.values():
+            for k, v in (m.get("tiering") or {}).items():
+                if isinstance(v, (int, float)):
+                    tiering[k] = max(tiering.get(k, 0), int(v))
+        if any(tiering.values()):
+            lines.append("  tiering: " + " ".join(
+                f"{k}={tiering.get(k, 0)}"
+                for k in ("admissions", "promotions", "evictions",
+                          "rejections", "coldLoads")))
     detector = snap.get("failureDetector") or {}
     if detector:
         lines.append("")

@@ -68,6 +68,7 @@ SITES = frozenset((
     "stream.stall",         # stream fetch stalls (slow upstream)
     "stream.partition.lost",  # stream fetch dies (lost partition / rebalance)
     "deepstore.upload.fail",  # segment upload to the deep store fails
+    "deepstore.download.fail",  # segment download from the deep store fails
     "device.launch.slow",   # device pipeline dispatch stalls before launch
 ))
 

@@ -203,6 +203,26 @@ class MemoryLedger:
             reg.gauge("pinot_server_hbm_transient_peak_bytes").set(nbytes)
             self._update_watermark_locked()
 
+    def set_capacity(self, nbytes: int, estimated: bool = False) -> None:
+        """Override the device-memory budget at runtime (the
+        `server.hbm.capacity.bytes` cluster knob; tests/bench pin tiny
+        capacities per server with it). Republishes the capacity gauge —
+        `_gauges_locked` only publishes it once per registry swap — and
+        force-flushes headroom so verdicts see the new budget immediately."""
+        nbytes = max(1, int(nbytes))
+        with self._lock:
+            self._capacity = nbytes
+            self._capacity_estimated = bool(estimated)
+            reg = self._gauges_locked()
+            reg.gauge("pinot_server_hbm_capacity_bytes").set(nbytes)
+            self._publish_locked(force=True)
+
+    def capacity_bytes(self) -> Tuple[int, bool]:
+        """(capacity_bytes, estimated) — the budget admission/eviction and
+        headroom math run against."""
+        with self._lock:
+            return self._capacity, self._capacity_estimated
+
     def flush(self) -> None:
         """Publish any throttle-deferred gauge updates now. The register hot
         path defers gauge writes up to `_PUBLISH_INTERVAL_S`; release and

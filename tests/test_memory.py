@@ -442,13 +442,17 @@ def test_segment_unload_returns_ledger_to_baseline(lineorder_cluster):
             assert now == staged_bytes
             datablock.release_block(seg)
             assert ledger.resident_bytes(segment=seg.name) == baseline
-        # unload path: remove_segment drops the device block AND its ledger
+        # unload path: remove_segment DEFERS the block drop while this test
+        # still holds an acquired ref (the unload-vs-in-flight-query fix) —
+        # the device block stays alive until the last release()
         datablock.block_for(seg).ids("lo_region")
         assert ledger.resident_bytes(segment=seg.name) > baseline
         mgr.remove_segment(seg.name)
-        assert ledger.resident_bytes(segment=seg.name) == 0
+        assert ledger.resident_bytes(segment=seg.name) > baseline
     finally:
         mgr.release(segments)
+    # the release that drained the refcount freed block + ledger entries
+    assert ledger.resident_bytes(segment=seg.name) == 0
 
 
 # -- HTTP transport: /debug/memory, memoryStatus, cost fields -----------------
