@@ -95,7 +95,8 @@ class DeviceQueryPipeline:
 
     def __init__(self, mesh_exec=None, max_batch: int = 64,
                  submit_timeout_s: float = 120.0, max_inflight: int = 4,
-                 stack: bool = True, start: bool = True):
+                 stack: bool = True, start: bool = True,
+                 burst_window_s: float = 0.0):
         if mesh_exec is None:
             from ..parallel.combine import MeshQueryExecutor
             mesh_exec = MeshQueryExecutor()
@@ -103,6 +104,12 @@ class DeviceQueryPipeline:
         self.max_batch = max_batch
         self.submit_timeout_s = submit_timeout_s
         self.stack = stack
+        # stacking burst window (server.fused.burst.window.ms): how long the
+        # dispatcher lingers after the first queued query so a burst of
+        # same-signature queries coalesces into ONE stacked persistent
+        # launch even when the fetcher is idle. 0 keeps the original
+        # drain-what's-there behavior.
+        self.burst_window_s = burst_window_s
         # graftcheck: ignore[admission-bypass] -- producers block in submit()
         # with submit_timeout_s and the dispatcher drains continuously; the
         # real bound is _fetchq's max_inflight window right below
@@ -122,6 +129,7 @@ class DeviceQueryPipeline:
         self.launches = 0
         self.dedupe_hits = 0
         self.stacked_launches = 0
+        self.fused_launches = 0
         # per-stage wall times: bounded deques back stats() percentiles;
         # the process registry histograms back /metrics
         self._stage_ms: Dict[str, deque] = {s: deque(maxlen=512)
@@ -226,11 +234,15 @@ class DeviceQueryPipeline:
         except queue.Empty:
             return None
         batch = [first]
+        deadline = (time.perf_counter() + self.burst_window_s
+                    if self.burst_window_s > 0 else None)
         while len(batch) < self.max_batch:
             try:
                 batch.append(self._q.get_nowait())
             except queue.Empty:
-                if not (self._fetch_busy.is_set() or not self._fetchq.empty()):
+                busy = self._fetch_busy.is_set() or not self._fetchq.empty()
+                if not busy and (deadline is None
+                                 or time.perf_counter() >= deadline):
                     break
                 try:
                     batch.append(self._q.get(timeout=0.005))
@@ -346,9 +358,15 @@ class DeviceQueryPipeline:
                                      if len(idxs) > 1)
         for _, _, idxs in launches:
             stacked = len(idxs) > 1
+            fused = any(getattr(getattr(reps[i], "spec", None),
+                                "fused_cols", ()) for i in idxs)
+            if fused:
+                self.fused_launches += 1
             for i in idxs:
                 for item, _ in rep_groups[i]:
                     item.stats["deviceLaunches"] = 1
+                    if fused:
+                        item.stats["fusedLaunches"] = 1
                     if stacked:
                         item.stats["stackedLaunches"] = 1
         entry = [(outs_dev, finish, [rep_groups[i] for i in idxs])
@@ -458,6 +476,7 @@ class DeviceQueryPipeline:
                "fallbacks": self.fallbacks, "timeouts": self.timeouts,
                "launches": self.launches, "dedupeHits": self.dedupe_hits,
                "stackedLaunches": self.stacked_launches,
+               "fusedLaunches": self.fused_launches,
                "meanBatch": round(self.dispatched / self.batches, 2)
                if self.batches else 0.0}
         out["stageMs"] = {s: _summarize(self._stage_ms[s]) for s in _STAGES}
@@ -484,16 +503,25 @@ def pipeline_from_config(cfg) -> Optional[DeviceQueryPipeline]:
     if not cfg.get_bool("server.device.enabled", False):
         return None
     mesh_exec = None
+    # fused single-launch execution over compressed forms: the knob only
+    # forces it OFF cluster-wide; when on (default), the calibrated
+    # KernelCaps.fused_enabled regime still decides per platform
+    fused = None if cfg.get_bool("server.fused.enabled", True) else False
     n_mesh = cfg.get_int("server.mesh.devices", 0)
     if n_mesh > 0:
         # explicit mesh width (0 = every visible device): a server can pin its
         # pipeline to a sub-mesh, e.g. to split chips between serving replicas
         from ..parallel.combine import MeshQueryExecutor
         from ..parallel.mesh import default_mesh
-        mesh_exec = MeshQueryExecutor(default_mesh(n_mesh))
+        mesh_exec = MeshQueryExecutor(default_mesh(n_mesh), fused_enabled=fused)
+    elif fused is not None:
+        from ..parallel.combine import MeshQueryExecutor
+        mesh_exec = MeshQueryExecutor(fused_enabled=fused)
     return DeviceQueryPipeline(
         mesh_exec=mesh_exec,
         max_batch=cfg.get_int("server.device.max.batch", 64),
         submit_timeout_s=cfg.get_float("server.device.timeout.seconds", 120.0),
         max_inflight=cfg.get_int("server.device.max.inflight", 4),
-        stack=cfg.get_bool("server.device.stacking.enabled", True))
+        stack=cfg.get_bool("server.device.stacking.enabled", True),
+        burst_window_s=cfg.get_float("server.fused.burst.window.ms", 0.0)
+        / 1000.0)

@@ -45,6 +45,7 @@ _MATMUL_CAP_RANGE = (64, 1 << 14)
 _CHUNK_CAP_RANGE = (4096, 1 << 22)
 _BCAST_CAP_RANGE = (64, 1 << 16)
 _BLOCK_RANGE = (256, 1 << 16)
+_FUSED_LUT_CAP_RANGE = (64, 1 << 22)
 
 CACHE_ENV = "PINOT_TPU_CALIBRATE_CACHE"
 _DEFAULT_CACHE = os.path.join("~", ".cache", "pinot_tpu", "kernel_caps.json")
@@ -64,13 +65,21 @@ class KernelCaps:
     # docs) is at or below this fraction; denser predicates keep the
     # interval-compare / one-hot LUT path
     bitmap_sel_cap: float = 0.25
+    # fused-vs-staged execution regime (PR 16): when enabled, eligible plans
+    # decode compressed forms (dict-id LUT gather, FOR base+delta) inside the
+    # single fused kernel instead of staging decoded columns through HBM.
+    # fused_lut_cap bounds the decode-table length (padded entries) a fused
+    # plan may gather from in-kernel; columns with larger dictionaries fall
+    # back to the staged two-launch ladder.
+    fused_enabled: bool = True
+    fused_lut_cap: int = 1 << 16
     source: str = "default"      # default | cache | calibrated | env
 
     def token(self) -> Tuple:
         """The part of the caps that changes compiled kernels (jit cache key)."""
         return (self.matmul_cap, self.chunk_cap, self.minmax_bcast_cap,
                 self.high_card_regime, self.partition_block,
-                self.bitmap_sel_cap)
+                self.bitmap_sel_cap, self.fused_enabled, self.fused_lut_cap)
 
 
 _ACTIVE: Optional[KernelCaps] = None
@@ -85,6 +94,9 @@ def _valid(caps: KernelCaps) -> bool:
                 and _BLOCK_RANGE[0] <= int(caps.partition_block) <= _BLOCK_RANGE[1]
                 and int(caps.partition_block) % 64 == 0
                 and 0.0 < float(caps.bitmap_sel_cap) <= 1.0
+                and isinstance(caps.fused_enabled, bool)
+                and _FUSED_LUT_CAP_RANGE[0] <= int(caps.fused_lut_cap)
+                <= _FUSED_LUT_CAP_RANGE[1]
                 and caps.high_card_regime in HIGH_CARD_REGIMES)
     except (TypeError, ValueError):
         return False
@@ -123,6 +135,11 @@ def load_cached_caps(path: Optional[str] = None,
             # absent in caches written before the bitmap filter regime existed
             bitmap_sel_cap=float(entry.get("bitmap_sel_cap",
                                            KernelCaps.bitmap_sel_cap)),
+            # absent in caches written before the fused execution regime
+            fused_enabled=bool(entry.get("fused_enabled",
+                                         KernelCaps.fused_enabled)),
+            fused_lut_cap=int(entry.get("fused_lut_cap",
+                                        KernelCaps.fused_lut_cap)),
             source="cache")
     except Exception:
         return None
@@ -153,6 +170,58 @@ def save_cached_caps(caps: KernelCaps, path: Optional[str] = None,
     os.replace(tmp, path)
 
 
+# -- measured HBM bandwidth (the shared roofline denominator) ----------------
+# bench.py's platform calibration measures the streaming scan bandwidth the
+# chip actually sustains and persists it here; `kernels.fetch_outputs` and the
+# bench lanes then divide by the SAME figure, so a `rooflinePct`/`*_pct_of_
+# measured_roofline` above ~100 is a bug, not a denominator mismatch (the
+# BENCH_r05 464.8% report came from bench using a measured figure while the
+# stats plane divided by nominal). Stored as a sibling top-level key in the
+# caps cache file (`<platform>#hbm_gbps`) so caps saves never clobber it.
+
+def _hbm_key(key: Optional[str] = None) -> str:
+    return f"{key or platform_key()}#hbm_gbps"
+
+
+def load_measured_hbm_gbps(path: Optional[str] = None,
+                           key: Optional[str] = None) -> Optional[float]:
+    """The persisted measured HBM bandwidth for this platform, or None."""
+    path = path or cache_path()
+    try:
+        with open(path) as f:
+            blob = json.load(f)
+        gbps = float(blob[_hbm_key(key)])
+    except Exception:
+        return None
+    return gbps if 0.0 < gbps < 1e5 else None
+
+
+def save_measured_hbm_gbps(gbps: float, path: Optional[str] = None,
+                           key: Optional[str] = None) -> None:
+    """Persist a measured bandwidth figure and drop kernels' cached copy."""
+    if not (0.0 < float(gbps) < 1e5):
+        raise ValueError(f"implausible HBM bandwidth: {gbps} GB/s")
+    path = path or cache_path()
+    blob: Dict[str, object] = {}
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict):
+            blob = loaded
+    # graftcheck: ignore[exception-hygiene] -- a missing/corrupt cache file
+    # just means a fresh blob; the save below rewrites it
+    except Exception:
+        pass
+    blob[_hbm_key(key)] = round(float(gbps), 3)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(blob, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    from . import kernels
+    kernels.invalidate_roofline_cache()
+
+
 def _env_overrides(caps: KernelCaps) -> KernelCaps:
     def _int(name):
         v = os.environ.get(name)
@@ -172,6 +241,12 @@ def _env_overrides(caps: KernelCaps) -> KernelCaps:
     sel = os.environ.get("PINOT_TPU_BITMAP_SEL_CAP")
     if sel:
         changed["bitmap_sel_cap"] = float(sel)
+    fused = os.environ.get("PINOT_TPU_FUSED")
+    if fused:
+        changed["fused_enabled"] = fused not in ("0", "false", "no")
+    lut_cap = _int("PINOT_TPU_FUSED_LUT_CAP")
+    if lut_cap is not None:
+        changed["fused_lut_cap"] = lut_cap
     if not changed:
         return caps
     out = replace(caps, source="env", **changed)
@@ -333,6 +408,34 @@ def calibrate(rows: Optional[int] = None,
             chunk_cap = max(chunk_cap, nseg)
     regime, _ = best_high_card(times[key_grid[-1]])
 
+    # fused-vs-staged probe: masked sum with an in-kernel dict decode (LUT
+    # gather) vs the same sum over a pre-decoded column. Fusion also saves a
+    # dispatch and the decoded HBM write, so the gather form gets 2x slack
+    # before the ladder falls back to staged (some interconnect relays turn
+    # every device gather into a host round trip — that is the case this
+    # probe exists to catch).
+    fused_enabled = defaults.fused_enabled
+    try:
+        import jax
+        card = 4096
+        ids_np = rng.integers(0, card, rows).astype(np.int32)
+        lut_np = rng.uniform(-1e3, 1e3, card).astype(np.float32)
+        # graftcheck: ignore[memory-untracked-staging] -- calibration probe
+        # inputs: freed after the probe, never part of serving residency
+        ids = jnp.asarray(ids_np)
+        lut = jnp.asarray(lut_np)  # graftcheck: ignore[memory-untracked-staging] -- calibration probe data, see above
+        fmask = jnp.asarray((rng.random(rows) < 0.5).astype(np.float32))  # graftcheck: ignore[memory-untracked-staging] -- calibration probe data, see above
+        decoded = jnp.asarray(lut_np[ids_np])  # graftcheck: ignore[memory-untracked-staging] -- calibration probe data, see above
+        t_fused = _bench_once(jax.jit(lambda i, t, m: (t[i] * m).sum()),
+                              (ids, lut, fmask))
+        t_staged = _bench_once(jax.jit(lambda v, m: (v * m).sum()),
+                               (decoded, fmask))
+        fused_enabled = bool(t_fused <= t_staged * 2.0)
+    # graftcheck: ignore[exception-hygiene] -- probe is best-effort; the
+    # default (fused on, CPU/TPU-measured) still dispatches correctly
+    except Exception:
+        pass
+
     caps = KernelCaps(
         matmul_cap=int(np.clip(matmul_cap or defaults.matmul_cap,
                                *_MATMUL_CAP_RANGE)),
@@ -341,5 +444,6 @@ def calibrate(rows: Optional[int] = None,
         minmax_bcast_cap=defaults.minmax_bcast_cap,
         high_card_regime=regime,
         partition_block=block,
+        fused_enabled=fused_enabled,
         source="calibrated")
     return caps if _valid(caps) else defaults

@@ -63,6 +63,7 @@ class SegmentBlock:
         self._raw: Dict[str, jnp.ndarray] = {}
         self._dict_vals: Dict[str, jnp.ndarray] = {}
         self._decoded: Dict[str, jnp.ndarray] = {}
+        self._for: Dict[str, Optional[tuple]] = {}
         self._valid: Optional[jnp.ndarray] = None
         self._valid_words: Optional[jnp.ndarray] = None
         self._null: Dict[str, jnp.ndarray] = {}
@@ -154,6 +155,46 @@ class SegmentBlock:
                                           name=col)
         return self._dict_vals[col]
 
+    def for_form(self, col: str) -> Optional[tuple]:
+        """Frame-of-reference compressed form for a raw integer column:
+        `(base, deltas)` where `deltas` is the padded column rebased to its
+        metadata minimum in the narrowest unsigned dtype that holds the range
+        (uint8/uint16), or None when FOR doesn't pay (non-int, multi-value,
+        dict-encoded, unknown min/max, range >= 2^16, or a base outside
+        int32 — the base rides the kernel's int32 scalar stream).
+
+        The fused kernel reconstructs values in-register as
+        `deltas.astype(int32) + base` (`kernels._fused_env`), so the resident
+        form is 1-2 bytes/row instead of the 4-byte decoded column. Padding
+        rows hold delta 0 and reconstruct to `base`; they are masked out of
+        every result by `valid` exactly like the raw path's 0 padding."""
+        if col not in self._for:
+            self._for[col] = self._build_for(col)
+        return self._for[col]
+
+    def _build_for(self, col: str) -> Optional[tuple]:
+        reader = self.segment.column(col)
+        if (reader.has_dictionary
+                or getattr(reader, "is_multi_value", False)):
+            return None
+        mn, mx = reader.min_value, reader.max_value
+        if not isinstance(mn, (int, np.integer)) \
+                or not isinstance(mx, (int, np.integer)):
+            return None
+        arr = np.asarray(reader.fwd)
+        if arr.dtype.kind != "i":
+            return None
+        rng = int(mx) - int(mn)
+        if not 0 <= rng < (1 << 16) or not -(2 ** 31) <= int(mn) < 2 ** 31:
+            return None
+        dt = np.uint8 if rng < (1 << 8) else np.uint16
+        if dt(0).nbytes >= _narrow(arr).dtype.itemsize:
+            return None  # deltas would be no narrower than the raw view
+        padded = np.zeros(self.padded, dtype=dt)
+        padded[:self.num_docs] = (arr.astype(np.int64) - int(mn)).astype(dt)
+        return (int(mn), staged(jnp.asarray(padded), self.segment.name,
+                                "for", name=col))
+
     def bitmap_words(self, col: str) -> Optional[jnp.ndarray]:
         """Packed bitmap filter index: uint32[cardinality, padded // 32].
 
@@ -200,13 +241,18 @@ class SegmentBlock:
         return self._null[col]
 
     def values(self, col: str) -> jnp.ndarray:
-        """Decoded numeric values on device regardless of encoding.
+        """Decoded numeric values on device regardless of encoding — the
+        STAGED layout's value input.
 
-        Dict columns are decoded HOST-side once and the materialized array cached in
-        HBM — never `table[ids]` on device: the axon relay turns every device gather
-        into an extra host round trip per dispatch, so decode must not be in the
-        per-query kernel. This is the TPU analog of the reference's
-        `DataFetcher` value-buffer cache (`DataFetcher.java:47`).
+        Dict columns are decoded HOST-side once and the materialized array
+        cached in HBM (the TPU analog of the reference's `DataFetcher`
+        value-buffer cache, `DataFetcher.java:47`). Fused plans never call
+        this: they route `dict_values(col)` + `ids(col)` (or `for_form`)
+        into the kernel and decode in-register, so no decoded column is ever
+        written back to HBM. The staged ladder rung keeps this path for
+        shapes where in-kernel decode loses (oversized decode tables,
+        multi-value value columns, relay platforms whose calibration probe
+        measured device gathers as an extra host round trip per dispatch).
         """
         reader = self.segment.column(col)
         if not reader.has_dictionary:
@@ -232,7 +278,8 @@ def has_block(segment) -> bool:
     return getattr(segment, _BLOCK_ATTR, None) is not None
 
 
-def predicted_block_bytes(segment: ImmutableSegment) -> int:
+def predicted_block_bytes(segment: ImmutableSegment,
+                          fused: bool = False) -> int:
     """Upper bound on the HBM bytes a fully-staged SegmentBlock for this
     segment can occupy, computed from segment metadata alone (no staging, no
     column reads) — what the tiering admission gate charges against ledger
@@ -241,7 +288,15 @@ def predicted_block_bytes(segment: ImmutableSegment) -> int:
     Deliberately conservative: every column is priced as if every lazy cache
     the block can build for it (ids + LUT + decoded + bitmap, or raw) gets
     built. Overestimating only host-tiers a segment early; underestimating
-    is how admission OOMs."""
+    is how admission OOMs.
+
+    `fused=True` prices the compressed-resident layout instead: fused plans
+    decode single-value dict columns in-register (`kernels._fused_env`), so
+    no decoded-values cache is ever built for them and admission charges only
+    ids + LUT (+ bitmap). Multi-value dict columns keep the decoded term —
+    they are staged-only. A segment rejected under the fused price still
+    degrades through the staged/host ladder; it is never force-staged past
+    headroom."""
     padded = padded_rows(segment.num_docs)
     # valid mask + packed valid words (built for every block)
     total = padded * 1 + (padded // 32) * 4
@@ -252,7 +307,8 @@ def predicted_block_bytes(segment: ImmutableSegment) -> int:
             card = int(meta.get("cardinality", 0) or 0)
             total += padded * 4 * width            # int32 ids
             total += lut_size(card) * 4            # dict LUT (narrowed to 32-bit)
-            total += padded * 4                    # decoded-values cache
+            if not fused or width > 1:
+                total += padded * 4                # decoded-values cache
             if 0 < card <= BITMAP_MAX_CARD and width == 1:
                 total += card * (padded // 32) * 4  # packed bitmap index
         else:

@@ -106,6 +106,15 @@ class KernelSpec:
     # selector. When EVERY leaf is a bitmap leaf the whole tree stays in the
     # word domain (fused AND/OR/NOT over uint32 words, one unpack at the end).
     bitmap_leaves: Tuple[int, ...] = ()
+    # value columns the kernel decodes from their COMPRESSED resident form
+    # in-register instead of reading a decoded HBM column: (col, form) pairs,
+    # form "dict" (vals[col] is the padded decode table, ids[col] the dict
+    # ids — the gather fuses into the scan, nothing is materialized) or "for"
+    # (vals[col] is a narrow unsigned delta column; the frame-of-reference
+    # base rides the int scalar stream at `for_offset[col]`). Empty = the
+    # staged layout (vals[col] is the decoded column), so the flag is part of
+    # `signature()` — fused and staged plans never share a compiled kernel.
+    fused_cols: Tuple[Tuple[str, str], ...] = ()
 
     # per-leaf runtime input routing, computed in __post_init__
     lut_index: Dict[int, int] = field(default_factory=dict)       # dense (scattered) LUTs
@@ -113,6 +122,7 @@ class KernelSpec:
     cmp_offset: Dict[int, Tuple[str, int]] = field(default_factory=dict)
     docset_index: Dict[int, int] = field(default_factory=dict)
     bitmap_index: Dict[int, int] = field(default_factory=dict)
+    for_offset: Dict[str, int] = field(default_factory=dict)  # FOR base in iscal
 
     def __post_init__(self):
         luts = docsets = 0
@@ -141,6 +151,12 @@ class KernelSpec:
                 else:
                     self.cmp_offset[i] = ("fscal", foff)
                     foff += len(leaf.operands)
+        # FOR bases ride the int scalar stream AFTER every filter scalar, in
+        # fused_cols order (input staging appends them in the same order)
+        for col, form in self.fused_cols:
+            if form == "for":
+                self.for_offset[col] = ioff
+                ioff += 1
 
     def signature(self) -> Tuple:
         return (
@@ -152,6 +168,7 @@ class KernelSpec:
             self.padded_rows,
             self.mv_cols,
             self.bitmap_leaves,
+            self.fused_cols,
             # regime caps change the traced program for the same plan shape
             get_caps().token(),
         )
@@ -202,12 +219,13 @@ def _block_tree(out):
 _pending_cost = threading.local()
 
 _NOMINAL_HBM_GBPS: Optional[float] = None
+_ROOFLINE_GBPS: Optional[float] = None
 
 
 def _nominal_hbm_gbps() -> float:
-    """Roofline denominator: the platform's nominal HBM bandwidth (the same
-    819 GB/s constant bench.py's platform_calibration publishes), overridable
-    via PINOT_TPU_HBM_GBPS for other parts/backends."""
+    """The platform's nominal HBM bandwidth (the same 819 GB/s constant
+    bench.py's platform_calibration publishes), overridable via
+    PINOT_TPU_HBM_GBPS for other parts/backends."""
     global _NOMINAL_HBM_GBPS
     if _NOMINAL_HBM_GBPS is None:
         try:
@@ -218,6 +236,30 @@ def _nominal_hbm_gbps() -> float:
         if _NOMINAL_HBM_GBPS <= 0:
             _NOMINAL_HBM_GBPS = 819.0
     return _NOMINAL_HBM_GBPS
+
+
+def roofline_hbm_gbps() -> float:
+    """THE roofline denominator — shared by `rooflinePct` here and every
+    `*_pct_of_measured_roofline` figure bench.py publishes, so the two can
+    never disagree again (the BENCH_r05 464.8% report was exactly such a
+    denominator mismatch). Resolution: PINOT_TPU_HBM_GBPS env override, else
+    the bandwidth bench.py's platform calibration measured and persisted via
+    `calibrate.save_measured_hbm_gbps`, else the nominal constant."""
+    global _ROOFLINE_GBPS
+    if _ROOFLINE_GBPS is None:
+        if os.environ.get("PINOT_TPU_HBM_GBPS"):
+            _ROOFLINE_GBPS = _nominal_hbm_gbps()
+        else:
+            from .calibrate import load_measured_hbm_gbps
+            _ROOFLINE_GBPS = load_measured_hbm_gbps() or _nominal_hbm_gbps()
+    return _ROOFLINE_GBPS
+
+
+def invalidate_roofline_cache() -> None:
+    """Drop the cached denominator (a fresh calibration was just persisted)."""
+    global _ROOFLINE_GBPS, _NOMINAL_HBM_GBPS
+    _ROOFLINE_GBPS = None
+    _NOMINAL_HBM_GBPS = None
 
 
 def _tree_device_nbytes(tree) -> int:
@@ -322,7 +364,8 @@ def fetch_outputs(outs_dev):
     qstats.record(qstats.BYTES_FETCHED, fetched)
     get_ledger().note_transient(fetched)
     # drain the modeled bytes the launches since the last fetch accumulated:
-    # achieved GB/s over this fetch window vs the nominal HBM roofline
+    # achieved GB/s over this fetch window vs the MEASURED HBM roofline
+    # (the same calibrated figure bench.py divides by)
     pending = getattr(_pending_cost, "nbytes", 0.0)
     if pending > 0.0:
         _pending_cost.nbytes = 0.0
@@ -330,7 +373,7 @@ def fetch_outputs(outs_dev):
             achieved_gbps = pending / (ms * 1e6)
             qstats.record_max(
                 qstats.ROOFLINE_PCT,
-                min(100.0, 100.0 * achieved_gbps / _nominal_hbm_gbps()))
+                min(100.0, 100.0 * achieved_gbps / roofline_hbm_gbps()))
     return out
 
 
@@ -396,6 +439,30 @@ def _make_word_fn(spec: KernelSpec):
     if tree[0] == "const":  # _simplify folds consts away except all/none
         return None
     return lambda bitmaps: tree_words(tree, bitmaps)
+
+
+def _fused_env(spec: KernelSpec, ids, vals, iscal):
+    """The expression env over COMPRESSED resident forms: for every fused
+    column, synthesize the decoded values in-register at trace time — a dict
+    column as one LUT gather over its ids (XLA fuses it into the scan tiles;
+    the decoded column never exists in HBM), a FOR column as delta + base.
+    Non-fused columns pass through (staged layout: already decoded). The
+    stacked mesh form carries one decode table PER SEGMENT ([s, W] sharded on
+    the segment axis, like every other per-segment operand)."""
+    if not spec.fused_cols:
+        return vals
+    env = dict(vals)
+    for col, form in spec.fused_cols:
+        if form == "dict":
+            lut = vals[col]
+            idx = ids[col]
+            if lut.ndim == 2 and idx.ndim == 2:
+                env[col] = jnp.take_along_axis(lut, idx, axis=1)
+            else:
+                env[col] = lut[idx]
+        else:  # "for": narrow unsigned deltas + scalar-stream base
+            env[col] = vals[col].astype(jnp.int32) + iscal[spec.for_offset[col]]
+    return env
 
 
 def _make_mask_fn(spec: KernelSpec):
@@ -764,6 +831,7 @@ def _make_body(spec: KernelSpec):
 
     def kernel(ids, vals, luts, iscal, fscal, nulls, valid, strides, agg_luts,
                docsets, bitmaps=()):
+        vals = _fused_env(spec, ids, vals, iscal)
         mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets,
                        bitmaps)
         out: Dict[str, jnp.ndarray] = {}
@@ -946,27 +1014,80 @@ def dispatch_kernel(spec: KernelSpec, inputs: KernelInputs):
 
 
 def run_kernel(spec: KernelSpec, inputs: KernelInputs) -> Dict[str, np.ndarray]:
+    """Single-launch fused execution: filter + project + aggregate in ONE
+    dispatch over the resident forms (compressed when `spec.fused_cols` routes
+    them — decode then happens in-register, never through HBM)."""
+    qstats.record(qstats.FUSED_LAUNCHES)
     # device_get, never np.asarray: asarray takes the synchronous per-leaf literal
     # path on the relay (~7x slower than one batched device_get round trip)
     return fetch_outputs(dispatch_kernel(spec, inputs))
 
 
-def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
-    """Filter-only kernel for selection queries: returns the boolean match mask."""
+def _staged_agg_spec(spec: KernelSpec) -> KernelSpec:
+    """The aggregate-only half of the staged pair: same group/agg geometry,
+    match-all filter (the mask launch's device output arrives as `valid`),
+    no fused columns (staged inputs are decoded HBM columns)."""
+    return KernelSpec(FilterProgram(), spec.group_cols, spec.num_keys_pad,
+                      spec.aggs, dict(spec.distinct_lut_sizes),
+                      spec.padded_rows, mv_cols=spec.mv_cols)
+
+
+def run_kernel_staged(spec: KernelSpec,
+                      inputs: KernelInputs) -> Dict[str, np.ndarray]:
+    """The staged (pre-fusion) ladder rung: dispatch the filter mask as its
+    own launch, then the aggregate kernel over decoded columns with the mask
+    riding in as `valid` — two device launches where `run_kernel` takes one.
+    The regime ladder (KernelCaps.fused_enabled / fused_lut_cap, executor
+    eligibility) routes here when in-kernel decode would lose: oversized
+    decode tables, multi-value value columns, or a platform whose calibration
+    probe measured gathers as a regression. Results are bit-identical to the
+    fused path — both consume the same decode tables and the same mask
+    semantics, only the HBM traffic and launch count differ."""
+    if spec.filter.is_match_all:
+        mask_dev = inputs.valid     # no filter: the mask launch would be a no-op
+        qstats.record(qstats.STAGED_LAUNCHES)
+    else:
+        mask_dev = dispatch_mask(spec, inputs)
+        qstats.record(qstats.STAGED_LAUNCHES, 2)
+    agg_spec = _staged_agg_spec(spec)
+    outs = get_kernel(agg_spec)(inputs.ids, inputs.vals, inputs.luts,
+                                inputs.iscal, inputs.fscal, inputs.nulls,
+                                mask_dev, inputs.strides, inputs.agg_luts,
+                                (), ())
+    return fetch_outputs(outs)
+
+
+def _mask_kernel(spec: KernelSpec):
+    """Cached jit of the filter-only kernel (selection queries and the staged
+    pair's first launch share it)."""
     key = ("mask", spec.filter.signature(), spec.padded_rows,
-           spec.bitmap_leaves)
+           spec.bitmap_leaves, spec.fused_cols)
 
     def build():
         mask_fn = _make_mask_fn(spec)
-        return jax.jit(lambda ids, vals, luts, iscal, fscal, nulls, valid,
-                       docsets, bitmaps:
-                       mask_fn(ids, vals, luts, iscal, fscal, nulls, valid,
-                               docsets, bitmaps))
 
-    fn = _cached_kernel(key, build)
-    out = fn(inputs.ids, inputs.vals, inputs.luts, inputs.iscal, inputs.fscal,
-             inputs.nulls, inputs.valid, inputs.docsets, inputs.bitmaps)
-    return fetch_outputs(out)
+        def body(ids, vals, luts, iscal, fscal, nulls, valid, docsets,
+                 bitmaps):
+            vals = _fused_env(spec, ids, vals, iscal)
+            return mask_fn(ids, vals, luts, iscal, fscal, nulls, valid,
+                           docsets, bitmaps)
+
+        return jax.jit(body)
+
+    return _cached_kernel(key, build)
+
+
+def dispatch_mask(spec: KernelSpec, inputs: KernelInputs):
+    """Asynchronously dispatch the filter mask; returns the unfetched device
+    bool[P] (already ANDed with `valid`), ready to feed a second launch."""
+    return _mask_kernel(spec)(inputs.ids, inputs.vals, inputs.luts,
+                              inputs.iscal, inputs.fscal, inputs.nulls,
+                              inputs.valid, inputs.docsets, inputs.bitmaps)
+
+
+def compute_mask(spec: KernelSpec, inputs: KernelInputs) -> np.ndarray:
+    """Filter-only kernel for selection queries: returns the boolean match mask."""
+    return fetch_outputs(dispatch_mask(spec, inputs))
 
 
 def compute_filter_count(spec: KernelSpec,
@@ -1016,12 +1137,13 @@ def topk_kernel(spec: KernelSpec, order_expr, desc: bool, k: int,
     outputs asynchronously in the pipeline's batched device_get."""
     k = min(k, total_rows if total_rows is not None else spec.padded_rows)
     key = ("topk", spec.filter.signature(), repr(order_expr), desc, k,
-           spec.padded_rows, total_rows)
+           spec.padded_rows, total_rows, spec.fused_cols)
 
     def build():
         mask_fn = _make_mask_fn(spec)
 
         def body(ids, vals, luts, iscal, fscal, nulls, valid, docsets):
+            vals = _fused_env(spec, ids, vals, iscal)
             mask = mask_fn(ids, vals, luts, iscal, fscal, nulls, valid, docsets).ravel()
             v = eval_expr(order_expr, vals, jnp).ravel().astype(jnp.float32)
             # NaN keys sink to the bottom (numpy sorts NaN last ascending; exact

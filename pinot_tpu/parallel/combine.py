@@ -300,6 +300,34 @@ class SegmentSetBlock:
 
         return self._stack("decoded", col, 0, per_seg)
 
+    def dict_luts(self, col: str) -> jnp.ndarray:
+        """Per-segment padded decode tables stacked [S_pad, Lmax], sharded on
+        the segment axis like every other block array.
+
+        Row i is segment i's OWN dictionary zero-padded to the set-wide max
+        lut_size, so the fused kernel's `take_along_axis` gather
+        (`kernels._fused_env`) decodes segment-local ids in-register and the
+        decoded [S_pad, rows] column never materializes in HBM. Aligned
+        sets only: merged views remap ids into the global dictionary space,
+        which a per-segment LUT stack cannot decode."""
+        key = ("dictlut", col)
+        if key not in self._cache:
+            from ..engine.datablock import _narrow, lut_size
+            tables = []
+            for s in self.segments:
+                reader = s.column(col)
+                vals = _narrow(np.asarray(reader.dictionary.values))
+                t = np.zeros(lut_size(reader.cardinality), dtype=vals.dtype)
+                t[:len(vals)] = vals
+                tables.append(t)
+            lmax = max(len(t) for t in tables)
+            out = np.zeros((self.s_pad, lmax),
+                           dtype=np.result_type(*[t.dtype for t in tables]))
+            for i, t in enumerate(tables):
+                out[self.slots[i], :len(t)] = t
+            self._cache[key] = jax.device_put(out, self._sharded)
+        return self._cache[key]
+
     def null_mask(self, col: str) -> jnp.ndarray:
         def per_seg(i, s):
             nb = s.column(col).null_bitmap
@@ -316,10 +344,15 @@ class SegmentSetBlock:
 class MeshQueryExecutor:
     """Executes aggregation queries over segment sets sharded across a device mesh."""
 
-    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None):
+    def __init__(self, mesh: Optional[jax.sharding.Mesh] = None,
+                 fused_enabled: Optional[bool] = None):
         self.mesh = mesh if mesh is not None else default_mesh()
         self.n_devices = self.mesh.devices.size
-        self._fallback = ServerQueryExecutor()
+        # fused in-register dict decode over the stacked block
+        # (clusterConfig/server.fused.enabled): None defers to the
+        # calibrated KernelCaps.fused_enabled regime
+        self.fused_enabled = fused_enabled
+        self._fallback = ServerQueryExecutor(fused_enabled=fused_enabled)
         self._set_blocks: Dict[Tuple, SegmentSetBlock] = {}
         self._views: Dict[Tuple, MergedSegmentView] = {}
         self._replicated = jax.sharding.NamedSharding(
@@ -661,6 +694,8 @@ class MeshQueryExecutor:
                     outs = p.launch()
                 else:
                     fn = self._get_shard_kernel(p.spec, p.s_pad, p.rows)
+                    if p.spec.fused_cols:
+                        qstats.record(qstats.FUSED_LAUNCHES)
                     outs = fn(p.inputs)
                 packed, unpack = self._pack(outs, p.trim_keys, batched=0)
                 launches.append((packed,
@@ -691,6 +726,9 @@ class MeshQueryExecutor:
         inputs["fscal"] = self._const(fscal)
         fn = self._get_shard_kernel(ps[0].spec, ps[0].s_pad, ps[0].rows,
                                     batch=b_pad)
+        if ps[0].spec.fused_cols:
+            # one persistent launch carries every stacked query's fused scan
+            qstats.record(qstats.FUSED_LAUNCHES)
         return fn(inputs), b
 
     def _pack(self, outs_dev: Dict[str, jnp.ndarray], trim_keys: Tuple[int, int],
@@ -855,6 +893,37 @@ class MeshQueryExecutor:
                                      valid_override=valid_dev,
                                      star=(ctx, sp), partial=partial)
 
+    def _mesh_fused_cols(self, plan, segments,
+                         view) -> Tuple[Tuple[str, str], ...]:
+        """Dict value columns the stacked kernel decodes in-register
+        ((col, "dict") KernelSpec routing) instead of reading a
+        host-materialized decoded HBM column.
+
+        Aligned sets only — a merged view remaps ids into the GLOBAL
+        dictionary space, which the per-segment LUT stack cannot decode.
+        FOR forms stay single-device: per-segment bases cannot ride the
+        replicated iscal stream. Ineligible columns (multi-value, raw, or
+        over `fused_lut_cap`) simply keep the decoded path — there is no
+        separate staged mode on the mesh, fusion here only removes the
+        decode materialization."""
+        from ..engine.calibrate import get_caps
+        from ..query.executor import _plan_vals_cols
+        caps = get_caps()
+        enabled = caps.fused_enabled if self.fused_enabled is None \
+            else self.fused_enabled
+        if not enabled or view is not None:
+            return ()
+        fused = []
+        for c in sorted(_plan_vals_cols(plan)):
+            readers = [s.column(c) for s in segments]
+            if all(r.has_dictionary
+                   and not getattr(r, "is_multi_value", False)
+                   for r in readers) \
+                    and max(lut_size(r.cardinality)
+                            for r in readers) <= caps.fused_lut_cap:
+                fused.append((c, "dict"))
+        return tuple(fused)
+
     def _prepare_sharded(self, ctx: QueryContext, plan, segments, view=None,
                          valid_override=None, star=None,
                          partial=False) -> PreparedDispatch:
@@ -876,9 +945,14 @@ class MeshQueryExecutor:
                 distinct_lut_sizes[i] = lut_size(plan.segment.column(agg.arg.name).cardinality)
 
         from ..query.executor import _mv_lut_cols
+        # star-tree record tables dispatch pre-decoded (their views are not
+        # plain segment readers); everything else may fuse
+        fused_cols = () if star is not None \
+            else self._mesh_fused_cols(plan, segments, view)
         spec = KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
                           tuple(agg_specs), distinct_lut_sizes, block.rows,
-                          mv_cols=_mv_lut_cols(plan, plan.segment))
+                          mv_cols=_mv_lut_cols(plan, plan.segment),
+                          fused_cols=fused_cols)
 
         # -- gather runtime inputs ------------------------------------
         # ids only where dict ids are semantically needed (group keys, interval/LUT
@@ -913,9 +987,17 @@ class MeshQueryExecutor:
 
         iscal_np = np.asarray(iscal, dtype=np.int32)
         fscal_np = np.asarray(fscal, dtype=np.float32)
+        # fused dict columns ship their per-segment LUT stack via vals and
+        # their id column via ids; the kernel gathers in-register, so the
+        # decoded HBM column is never built for them
+        fused = dict(fused_cols)
+        for c in vals_cols:
+            if fused.get(c) == "dict":
+                ids_cols.add(c)
         inputs = dict(
             ids={c: block.ids(c) for c in ids_cols},
-            vals={c: block.decoded(c) for c in vals_cols},
+            vals={c: block.dict_luts(c) if fused.get(c) == "dict"
+                  else block.decoded(c) for c in vals_cols},
             luts=tuple(luts),
             iscal=self._const(iscal_np),
             fscal=self._const(fscal_np),

@@ -46,12 +46,19 @@ __graft_slow_paths__ = ("_decode_group_partials", "_decode_scalar_partials",
 class ServerQueryExecutor:
     """Executes a QueryContext over a set of local segments."""
 
-    def __init__(self, use_device: bool = True, bitmap_enabled: bool = True):
+    def __init__(self, use_device: bool = True, bitmap_enabled: bool = True,
+                 fused_enabled: Optional[bool] = None):
         self.use_device = use_device
         # packed-word bitmap filter indexes (clusterConfig/
         # server.index.bitmap.enabled): off -> every dict filter leaf keeps
         # the interval-compare / LUT path regardless of selectivity
         self.bitmap_enabled = bitmap_enabled
+        # fused single-launch execution over compressed resident forms
+        # (clusterConfig/server.fused.enabled): None defers to the calibrated
+        # KernelCaps.fused_enabled regime; False forces the staged
+        # two-launch ladder everywhere (decoded HBM columns, mask launch +
+        # aggregate launch)
+        self.fused_enabled = fused_enabled
 
     # -- public API --------------------------------------------------------
     def execute(self, segments: Sequence[ImmutableSegment],
@@ -156,8 +163,11 @@ class ServerQueryExecutor:
                         or r.dense is not None or plan.kind == "metadata"):
                     st.add(qstats.NUM_SEGMENTS_MATCHED)
             st.add_operator("SEGMENT_PLAN", rows=r.num_docs_scanned, ms=ms)
-            st.add_operator(_PLAN_OP_LABELS[plan.kind],
-                            rows=r.num_docs_scanned, ms=ms)
+            label = _PLAN_OP_LABELS[plan.kind]
+            if plan.kind == "device" and \
+                    getattr(plan, "exec_mode", "fused") == "staged":
+                label = "DEVICE_STAGED"  # two-launch fallback rung
+            st.add_operator(label, rows=r.num_docs_scanned, ms=ms)
         return r
 
     # ------------------------------------------------------------------
@@ -210,16 +220,55 @@ class ServerQueryExecutor:
 
         block = block_for(seg)
         plan.bitmap_leaves = self._bitmap_leaves(plan, seg)
+        fused_cols = self._fused_cols(plan, seg, block)
+        plan.exec_mode = "staged" if fused_cols is None else "fused"
         spec = kernels.KernelSpec(plan.filter_prog, plan.group_cols, plan.num_keys_pad,
                                   tuple(agg_specs), distinct_lut_sizes, block.padded,
                                   mv_cols=_mv_lut_cols(plan, seg),
-                                  bitmap_leaves=plan.bitmap_leaves)
+                                  bitmap_leaves=plan.bitmap_leaves,
+                                  fused_cols=fused_cols or ())
         inputs = self._kernel_inputs(plan, spec, block)
-        outs = kernels.run_kernel(spec, inputs)
+        if fused_cols is None:
+            outs = kernels.run_kernel_staged(spec, inputs)
+        else:
+            outs = kernels.run_kernel(spec, inputs)
 
         if plan.group_cols:
             return self._decode_group_partials(plan, outs)
         return self._decode_scalar_partials(plan, outs)
+
+    def _fused_cols(self, plan: SegmentPlan, seg,
+                    block) -> Optional[Tuple[Tuple[str, str], ...]]:
+        """(col, form) routing for a fused single-launch plan, or None when
+        the regime ladder sends this shape down the staged two-launch rung.
+
+        Fused iff every value column (filter compare expressions + aggregate
+        arguments) stays in a compressed resident form the kernel can decode
+        in-register: a single-value dict column whose padded decode table
+        fits `KernelCaps.fused_lut_cap` routes as ("dict"), a raw int column
+        with a profitable frame-of-reference form as ("for"), and plain raw
+        columns pass through unrouted (their resident form IS the value
+        form). A multi-value or over-cap dict value column means the decoded
+        HBM cache would be built anyway — the plan stages instead."""
+        from ..engine.calibrate import get_caps
+        from ..engine.datablock import lut_size
+        caps = get_caps()
+        enabled = (caps.fused_enabled if self.fused_enabled is None
+                   else self.fused_enabled)
+        if not enabled or getattr(seg, "is_mutable", False):
+            return None
+        fused: List[Tuple[str, str]] = []
+        for c in sorted(_plan_vals_cols(plan)):
+            reader = seg.column(c)
+            if getattr(reader, "is_multi_value", False):
+                return None
+            if reader.has_dictionary:
+                if lut_size(reader.cardinality) > caps.fused_lut_cap:
+                    return None
+                fused.append((c, "dict"))
+            elif block.for_form(c) is not None:
+                fused.append((c, "for"))
+        return tuple(fused)
 
     def _bitmap_leaves(self, plan: SegmentPlan, seg) -> Tuple[int, ...]:
         if not self.bitmap_enabled:
@@ -297,9 +346,30 @@ class ServerQueryExecutor:
             valid = valid & jnp.asarray(padded)  # upsert valid-doc intersection
             valid_words = None                   # packed form is now stale
 
+        # fused plans keep value columns in compressed resident form: a
+        # "dict" column ships its padded decode table via vals plus the id
+        # column via ids (gathered in-register by _fused_env), a "for"
+        # column ships narrow deltas via vals with its base appended to
+        # iscal AFTER every filter scalar, in fused_cols order — must
+        # mirror KernelSpec.__post_init__'s for_offset routing exactly
+        fused = dict(spec.fused_cols)
+        vals = {}
+        for c in vals_cols:
+            form = fused.get(c)
+            if form == "dict":
+                ids_cols.add(c)
+                vals[c] = block.dict_values(c)
+            elif form == "for":
+                vals[c] = block.for_form(c)[1]
+            else:
+                vals[c] = block.values(c)
+        for c, form in spec.fused_cols:
+            if form == "for":
+                iscal.append(block.for_form(c)[0])
+
         return KernelInputs(
             ids={c: block.ids(c) for c in ids_cols},
-            vals={c: block.values(c) for c in vals_cols},
+            vals=vals,
             luts=tuple(luts),
             iscal=jnp.asarray(np.asarray(iscal, dtype=np.int32)),
             fscal=jnp.asarray(np.asarray(fscal, dtype=np.float32)),
@@ -606,6 +676,24 @@ class ServerQueryExecutor:
             inputs = self._kernel_inputs(plan, spec, block)
             return kernels.compute_mask(spec, inputs)[:seg.num_docs]
         return host_filter_mask(plan, seg)
+
+
+def _plan_vals_cols(plan: SegmentPlan) -> set:
+    """Columns the kernel reads as *values* (not dict ids): filter compare
+    expressions plus non-distinct aggregate arguments. Mirrors the
+    vals_cols set `_kernel_inputs` builds — fused eligibility is decided
+    over exactly these columns."""
+    cols = set()
+    for leaf in plan.filter_prog.leaves:
+        if isinstance(leaf, CmpLeaf):
+            cols.update(identifiers_in(leaf.expr))
+    for agg in plan.aggs:
+        if "distinct" in agg.device_outputs:
+            continue
+        if agg.arg is not None and not (isinstance(agg.arg, Identifier)
+                                        and agg.arg.name == "*"):
+            cols.update(identifiers_in(agg.arg))
+    return cols
 
 
 def _mv_lut_cols(plan: SegmentPlan, seg: ImmutableSegment) -> Tuple[str, ...]:

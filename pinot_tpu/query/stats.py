@@ -53,6 +53,12 @@ BYTES_FETCHED = "bytesFetched"
 QUEUE_WAIT_MS = "queueWaitMs"
 DEDUPED_LAUNCHES = "dedupedLaunches"
 STACKED_LAUNCHES = "stackedLaunches"
+# fused-vs-staged execution split (PR 16): fusedLaunches counts single-launch
+# kernels that decode compressed forms (dict ids / FOR deltas) in-register;
+# stagedLaunches counts the sub-launches of the two-dispatch fallback
+# (mask kernel + aggregate kernel over decoded HBM columns)
+FUSED_LAUNCHES = "fusedLaunches"
+STAGED_LAUNCHES = "stagedLaunches"
 NUM_CONSUMING_SEGMENTS_QUERIED = "numConsumingSegmentsQueried"
 MIN_CONSUMING_FRESHNESS_TIME_MS = "minConsumingFreshnessTimeMs"
 MUX_FRAME_QUEUE_MS = "muxFrameQueueMs"
@@ -86,6 +92,7 @@ COUNTER_KEYS = (
     DEVICE_LAUNCHES, COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
     COMPILE_MS, DEVICE_EXEC_MS, DEVICE_FETCH_MS, BYTES_FETCHED,
     QUEUE_WAIT_MS, DEDUPED_LAUNCHES, STACKED_LAUNCHES,
+    FUSED_LAUNCHES, STAGED_LAUNCHES,
     NUM_CONSUMING_SEGMENTS_QUERIED, MUX_FRAME_QUEUE_MS, MUX_FLOW_CONTROL_MS,
     COLLECTIVE_MS, HEDGED_REQUESTS, ADMISSION_DEFER_MS,
     DEVICE_FLOPS, DEVICE_BYTES_ACCESSED,

@@ -99,14 +99,15 @@ def render_report(stats: Dict[str, Any]) -> str:
         except (TypeError, ValueError):
             roofline = f"{stats['rooflinePct']!s:>10}"
         out.append(f"  {'hbm roofline':<15} {roofline}  "
-                   "(achieved/nominal bandwidth, worst fetch window)")
+                   "(achieved/measured bandwidth, worst fetch window)")
     out.append("")
     out.append("counters")
     for key in ("numSegmentsQueried", "numSegmentsPruned",
                 "numSegmentsPrunedByPartition", "numSegmentsPrunedByTime",
                 "numSegmentsPrunedByRange", "numSegmentsPrunedByBloom",
                 "numSegmentsMatched", "numDocsScanned", "scanRowsAvoided",
-                "numGroupsTotal", "deviceLaunches",
+                "numGroupsTotal", "deviceLaunches", "fusedLaunches",
+                "stagedLaunches",
                 "dedupedLaunches", "stackedLaunches", "compileCacheHits",
                 "compileCacheMisses", "bytesFetched", "deviceFlops",
                 "deviceBytesAccessed", "numServersQueried",

@@ -16,7 +16,7 @@ from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
 from pinot_tpu.analysis import (admission_hygiene, blocking_in_loop,
                                 collective_hygiene, drift_guards,
-                                exception_hygiene, filter_path,
+                                exception_hygiene, filter_path, fused_path,
                                 ingest_hot_loop, jit_hygiene, lock_discipline,
                                 memory_hygiene, transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
@@ -659,6 +659,77 @@ def test_filter_path_suppression_honored():
     """, filter_path.rules(), rel=_FILTER_REL)
     assert active == []
     assert _ids(suppressed) == ["filter-path-host-materialization"]
+
+
+# -- fused-path-materialization -----------------------------------------------
+
+_FUSED_REL = "pinot_tpu/engine/kernels.py"
+
+
+def test_fused_path_take_gather_flagged():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        def build_env(lut, ids):
+            return jnp.take(lut, ids)
+    """, fused_path.rules(), rel=_FUSED_REL)
+    assert _ids(active) == ["fused-path-materialization"]
+
+
+def test_fused_path_staged_surface_call_flagged():
+    active, _ = _check("""
+        def gather_inputs(block, cols):
+            return {c: block.values(c) for c in cols}
+    """, fused_path.rules(), rel=_FUSED_REL)
+    assert _ids(active) == ["fused-path-materialization"]
+
+
+def test_fused_path_decoded_call_flagged():
+    active, _ = _check("""
+        def gather_inputs(block, c):
+            return block.decoded(c)
+    """, fused_path.rules(), rel="pinot_tpu/engine/datablock.py")
+    assert _ids(active) == ["fused-path-materialization"]
+
+
+def test_fused_path_take_along_axis_is_sanctioned():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        def fused_env(lut, idx):
+            return jnp.take_along_axis(lut, idx, axis=1)
+    """, fused_path.rules(), rel=_FUSED_REL)
+    assert active == []
+
+
+def test_fused_path_slow_path_declaration_exempts():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        __graft_slow_paths__ = ("staged_decode",)
+
+        def staged_decode(block, lut, ids, c):
+            full = jnp.take(lut, ids)
+            return full, block.values(c)
+    """, fused_path.rules(), rel=_FUSED_REL)
+    assert active == []
+
+
+def test_fused_path_outside_hot_modules_ignored():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        def inputs(block, lut, ids, c):
+            return jnp.take(lut, ids), block.values(c)
+    """, fused_path.rules(), rel="pinot_tpu/query/executor.py")
+    assert active == []
+
+
+def test_fused_path_suppression_honored():
+    active, suppressed = _check("""
+        import jax.numpy as jnp
+        def probe(lut, ids):
+            # graftcheck: ignore[fused-path-materialization] -- fixture
+            return jnp.take(lut, ids)
+    """, fused_path.rules(), rel=_FUSED_REL)
+    assert active == []
+    assert _ids(suppressed) == ["fused-path-materialization"]
 
 
 # -- exception-hygiene --------------------------------------------------------

@@ -1,0 +1,271 @@
+"""Differential suite for fused single-launch execution on compressed forms.
+
+Three-way differential per query shape: the FUSED plan (single launch,
+in-register dict/FOR decode), the STAGED plan (mask launch + aggregate launch
+over decoded columns), and the host f64 oracle. Fused and staged run the same
+f32 kernel regimes over the same row order, so their results must be
+BYTE-IDENTICAL — any drift means the compressed-form decode changed a value.
+The host comparison carries the usual f32-accumulation tolerance.
+
+Covers the routing matrix: bitmap-only / mixed / NOT filter trees, null-heavy
+columns, MV columns (value-column MV forces the staged rung; MV *filters*
+stay fused), FOR-int and dict-encoded projections, and the stacked-burst
+case where same-signature fused queries share one persistent launch.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import kernels
+from pinot_tpu.engine.datablock import block_for, release_block
+from pinot_tpu.query import stats as qstats
+from pinot_tpu.query.executor import ServerQueryExecutor
+from pinot_tpu.schema import (DataType, FieldRole, FieldSpec, Schema,
+                              dimension, metric)
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder, SegmentGeneratorConfig
+
+N = 2400
+RNG = np.random.default_rng(20260807)
+
+SCHEMA = Schema("fused", [
+    dimension("dim_a"), dimension("dim_b"),
+    dimension("dim_i", DataType.INT),
+    FieldSpec("tags", DataType.STRING, FieldRole.DIMENSION,
+              single_value=False),
+    metric("num_for", DataType.INT), metric("num_wide", DataType.INT),
+    metric("val_x", DataType.DOUBLE), metric("val_null", DataType.DOUBLE),
+])
+
+COLS = {
+    "dim_a": [f"a{i}" for i in RNG.integers(0, 8, N)],
+    "dim_b": [f"b{i}" for i in RNG.integers(0, 5, N)],
+    # dict-encoded int: the fused "dict" value form (in-register LUT gather)
+    "dim_i": RNG.integers(0, 40, N).astype(np.int32) * 7,
+    "tags": [[f"t{j}" for j in RNG.integers(0, 6, RNG.integers(1, 4))]
+             for _ in range(N)],
+    # range 200 < 2^8: uint8 FOR deltas vs int16 narrowed raw -> FOR form
+    "num_for": RNG.integers(1000, 1200, N).astype(np.int32),
+    # range >= 2^16: FOR declined -> raw passthrough stays fused
+    "num_wide": RNG.integers(-(1 << 20), 1 << 20, N).astype(np.int32),
+    "val_x": np.round(RNG.uniform(-100, 100, N), 3),
+    # null-heavy: ~40% nulls through the writer's null bitmap
+    "val_null": [None if RNG.random() < 0.4 else
+                 round(float(RNG.uniform(0, 50)), 3) for _ in range(N)],
+}
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fused")
+    builder = SegmentBuilder(SCHEMA, SegmentGeneratorConfig(
+        no_dictionary_columns=["num_for", "num_wide", "val_x", "val_null"]))
+    return load_segment(builder.build(
+        {k: (v.copy() if isinstance(v, np.ndarray) else list(v))
+         for k, v in COLS.items()}, str(tmp), "fused_0"))
+
+
+QUERIES = [
+    # bitmap-only tree (dict IN/EQ leaves), FOR-int + raw projections
+    ("SELECT dim_b, COUNT(*), SUM(num_for), MIN(num_wide) FROM fused "
+     "WHERE dim_a IN ('a1', 'a2', 'a3') GROUP BY dim_b"),
+    # mixed tree: dict leaf AND numeric compare (CmpLeaf value column)
+    ("SELECT COUNT(*), SUM(val_x), MAX(num_for) FROM fused "
+     "WHERE dim_a = 'a1' AND num_wide > 0"),
+    # NOT over a compare, OR with a dict leaf
+    ("SELECT dim_a, COUNT(*), SUM(num_for) FROM fused "
+     "WHERE NOT num_for < 1100 OR dim_b = 'b2' GROUP BY dim_a"),
+    # null-heavy value column: null rows drop out of SUM/COUNT identically
+    ("SELECT dim_b, COUNT(val_null), SUM(val_null) FROM fused "
+     "WHERE dim_a <> 'a0' GROUP BY dim_b"),
+    # dict-encoded INT projection: the "dict" fused form feeds the aggregate
+    ("SELECT dim_a, SUM(dim_i), MAX(dim_i) FROM fused "
+     "WHERE num_for BETWEEN 1050 AND 1150 GROUP BY dim_a"),
+    # MV filter (stacked id matrix) + SV aggregate: fused handles MV LUT
+    # leaves — only MV *value* columns force the staged rung
+    ("SELECT COUNT(*), SUM(num_for) FROM fused WHERE tags = 't1'"),
+    # match-all: staged collapses to one launch, fused still one
+    "SELECT SUM(num_wide), AVG(val_x) FROM fused",
+]
+
+
+def _rows(res):
+    return sorted([tuple(r) for r in res.rows], key=lambda r: str(r))
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_fused_vs_staged_byte_identical_vs_host(seg, qi):
+    sql = QUERIES[qi]
+    fused = ServerQueryExecutor(fused_enabled=True).execute([seg], sql)
+    staged = ServerQueryExecutor(fused_enabled=False).execute([seg], sql)
+    host = ServerQueryExecutor(use_device=False).execute([seg], sql)
+    fr, sr, hr = _rows(fused), _rows(staged), _rows(host)
+    assert fr == sr, f"fused != staged (byte-identical contract)\n{sql}"
+    assert len(fr) == len(hr), sql
+    for frow, hrow in zip(fr, hr):
+        for fv, hv in zip(frow, hrow):
+            if isinstance(fv, float) and isinstance(hv, float):
+                assert fv == pytest.approx(hv, rel=1e-5, abs=0.05), sql
+            else:
+                assert fv == hv, sql
+
+
+def test_fused_launch_count_halves_staged(seg):
+    """Filter+aggregate: fused = 1 device launch, staged = 2 (mask +
+    aggregate) — the >=2x launch-count reduction the issue pins."""
+    sql = ("SELECT dim_b, COUNT(*), SUM(num_for) FROM fused "
+           "WHERE dim_a = 'a1' AND num_wide > 0 GROUP BY dim_b")
+    ServerQueryExecutor(fused_enabled=True).execute([seg], sql)   # warm jit
+    ServerQueryExecutor(fused_enabled=False).execute([seg], sql)
+    with qstats.collect_stats() as st_f:
+        ServerQueryExecutor(fused_enabled=True).execute([seg], sql)
+    with qstats.collect_stats() as st_s:
+        ServerQueryExecutor(fused_enabled=False).execute([seg], sql)
+    f_launches = int(st_f.counters.get(qstats.DEVICE_LAUNCHES, 0))
+    s_launches = int(st_s.counters.get(qstats.DEVICE_LAUNCHES, 0))
+    assert f_launches == 1, st_f.counters
+    assert s_launches == 2, st_s.counters
+    assert int(st_f.counters.get(qstats.FUSED_LAUNCHES, 0)) == 1
+    assert int(st_s.counters.get(qstats.STAGED_LAUNCHES, 0)) == 2
+
+
+def test_mv_value_column_degrades_to_staged(seg):
+    """An MV aggregate argument cannot ride the fused forms; the plan must
+    take the staged rung (or host), never a wrong fused answer."""
+    sql = "SELECT COUNT(tags) FROM fused WHERE dim_a = 'a1'"
+    ex = ServerQueryExecutor(fused_enabled=True)
+    host = ServerQueryExecutor(use_device=False)
+    got = ex.execute([seg], sql)
+    want = host.execute([seg], sql)
+    assert _rows(got) == _rows(want)
+
+
+def test_for_form_eligibility(seg):
+    """num_for (range 200, int16 raw) carries a FOR form; num_wide (range
+    2^21) and the doubles do not."""
+    block = block_for(seg)
+    try:
+        ff = block.for_form("num_for")
+        assert ff is not None
+        base, deltas = ff
+        assert base == int(np.min(COLS["num_for"]))
+        assert np.asarray(deltas).dtype == np.uint8
+        assert block.for_form("num_wide") is None
+        assert block.for_form("val_x") is None
+    finally:
+        release_block(seg)
+
+
+def test_fused_spec_routes_expected_forms(seg):
+    """The executor's routing decision itself: dict-SV value cols -> "dict",
+    FOR-eligible raw ints -> "for", wide raw ints -> passthrough."""
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    ex = ServerQueryExecutor(fused_enabled=True)
+    ctx = compile_query(
+        "SELECT SUM(dim_i), SUM(num_for), SUM(num_wide) FROM fused "
+        "WHERE val_x > 0", seg.schema)
+    plan = plan_segment(ctx, seg)
+    assert plan.kind == "device"
+    block = block_for(seg)
+    try:
+        routed = dict(ex._fused_cols(plan, seg, block))
+        assert routed.get("dim_i") == "dict"
+        assert routed.get("num_for") == "for"
+        assert "num_wide" not in routed      # raw passthrough
+        assert "val_x" not in routed         # raw float passthrough
+    finally:
+        release_block(seg)
+
+
+def test_stacked_burst_one_launch_byte_identical(seg):
+    """A burst of same-signature fused queries (different scalars) rides ONE
+    stacked persistent launch; each answer matches its solo staged execution
+    and the burst uses strictly fewer device launches than staged (which
+    needs two per query)."""
+    from pinot_tpu.parallel.combine import MeshQueryExecutor
+    from pinot_tpu.query.aggregates import make_agg
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.reduce import (merge_segment_results,
+                                        reduce_to_result)
+    thresholds = (0, 100_000, -250_000, 500_000)
+    # dim_i is dict-encoded: SUM(dim_i) rides the mesh fused "dict" form
+    sqls = [("SELECT COUNT(*), SUM(dim_i) FROM fused "
+             f"WHERE num_wide > {t}") for t in thresholds]
+    mex = MeshQueryExecutor()
+    ctxs = [compile_query(sql, seg.schema) for sql in sqls]
+    preps = [mex.prepare_partial(ctx, [seg]) for ctx in ctxs]
+    assert all(p is not None for p in preps)
+    assert any(p.spec.fused_cols for p in preps), \
+        "burst should ride the fused compressed forms"
+    # same signature + same block -> one stack key -> ONE batched launch
+    with qstats.collect_stats() as st:
+        launches = mex.dispatch_prepared(preps)
+        assert len(launches) == 1, "same-signature burst must stack"
+        outs_dev, finish, idxs = launches[0]
+        assert sorted(idxs) == list(range(len(sqls)))
+        outs_list = finish(mex.fetch([outs_dev])[0])
+    burst_launches = int(st.counters.get(qstats.DEVICE_LAUNCHES, 0))
+    assert burst_launches == 1, st.counters
+    assert int(st.counters.get(qstats.FUSED_LAUNCHES, 0)) == 1
+
+    staged = ServerQueryExecutor(fused_enabled=False)
+    for pos, i in enumerate(idxs):
+        partial = preps[i].decode(outs_list[pos])
+        aggs = [make_agg(f) for f in ctxs[i].aggregations]
+        got = reduce_to_result(
+            ctxs[i], merge_segment_results([partial], aggs), aggs, []).rows
+        want = staged.execute([seg], sqls[i])
+        assert sorted(map(tuple, got)) == _rows(want), sqls[i]
+
+
+def test_fused_kill_switch_env(seg, monkeypatch):
+    """PINOT_TPU_FUSED=0 routes every plan down the staged rung."""
+    from pinot_tpu.engine import calibrate
+    monkeypatch.setenv("PINOT_TPU_FUSED", "0")
+    calibrate.set_caps(None)  # force lazy re-resolution under the env var
+    try:
+        assert calibrate.get_caps().fused_enabled is False
+        sql = "SELECT COUNT(*), SUM(num_for) FROM fused WHERE dim_a = 'a1'"
+        with qstats.collect_stats() as st:
+            ServerQueryExecutor().execute([seg], sql)
+        assert int(st.counters.get(qstats.FUSED_LAUNCHES, 0)) == 0
+        assert int(st.counters.get(qstats.STAGED_LAUNCHES, 0)) >= 1
+    finally:
+        monkeypatch.delenv("PINOT_TPU_FUSED")
+        calibrate.set_caps(None)
+
+
+def test_staged_spec_reuses_match_all_single_launch(seg):
+    """A match-all filter needs no mask launch: staged executes in ONE
+    launch and records stagedLaunches=1."""
+    sql = "SELECT SUM(num_for), COUNT(*) FROM fused"
+    ex = ServerQueryExecutor(fused_enabled=False)
+    ex.execute([seg], sql)                     # warm
+    with qstats.collect_stats() as st:
+        ex.execute([seg], sql)
+    assert int(st.counters.get(qstats.DEVICE_LAUNCHES, 0)) == 1
+    assert int(st.counters.get(qstats.STAGED_LAUNCHES, 0)) == 1
+
+
+def test_fused_signature_distinct_from_staged(seg):
+    """fused_cols participates in KernelSpec.signature(): fused and staged
+    plans must never share a jit cache entry."""
+    from pinot_tpu.query.context import compile_query
+    from pinot_tpu.query.planner import plan_segment
+    ctx = compile_query(
+        "SELECT SUM(num_for) FROM fused WHERE dim_a = 'a1'", seg.schema)
+    plan = plan_segment(ctx, seg)
+    block = block_for(seg)
+    try:
+        ex = ServerQueryExecutor(fused_enabled=True)
+        fused_cols = ex._fused_cols(plan, seg, block)
+        assert fused_cols  # num_for routes as ("num_for", "for")
+        spec_fused = kernels.KernelSpec(
+            plan.filter_prog, (), 1, (), {}, block.padded,
+            fused_cols=fused_cols)
+        spec_staged = kernels.KernelSpec(
+            plan.filter_prog, (), 1, (), {}, block.padded)
+        assert spec_fused.signature() != spec_staged.signature()
+    finally:
+        release_block(seg)
