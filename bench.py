@@ -1645,6 +1645,215 @@ def fused_bench(rows: int = None, iters: int = None) -> dict:
 
 
 # --------------------------------------------------------------------------
+# device hash-join lane: build/probe rows/s device vs the host oracle across
+# build cardinalities, zipf probe-key skew, broadcast-vs-partitioned crossover
+# --------------------------------------------------------------------------
+
+JOIN_PROBE_ROWS = int(os.environ.get("PINOT_BENCH_JOIN_PROBE_ROWS", 1 << 20))
+JOIN_BUILD_CARDS = tuple(
+    int(x) for x in os.environ.get("PINOT_BENCH_JOIN_CARDS",
+                                   "1000,100000,2000000").split(","))
+JOIN_ITERS = int(os.environ.get("PINOT_BENCH_JOIN_ITERS", 3))
+
+
+def _zipf_probe(rng, n: int, card: int, s) -> np.ndarray:
+    """Probe-side keys in [0, card): uniform when `s` is None, else drawn
+    from a zipf(s) rank distribution — s=1.5 puts ~65% of probes on a
+    handful of hot build keys, the JSPIM skew shape."""
+    if s is None:
+        return rng.integers(0, card, n).astype(np.int64)
+    p = np.arange(1, card + 1, dtype=np.float64) ** (-float(s))
+    p /= p.sum()
+    return rng.choice(card, size=n, p=p).astype(np.int64)
+
+
+def join_bench(probe_rows: int = None, iters: int = None) -> dict:
+    """Device hash-join lane (PR 17), three sub-sweeps:
+
+    1. device-vs-host across build cardinalities (1k / 100k / 2M by default,
+       uniform probe keys): the device scatter/sort-merge fast path against
+       `hash_join_host`, both verified against a direct numpy oracle
+       (row count + payload sums). Publishes rows/s both ways, the speedup,
+       and `gate_3x` per 100k+ cardinality. The >= 3x gate hard-asserts only
+       on a real accelerator backend: when jax "device" IS this host's CPU,
+       the scatter/sort launches and numpy's vectorized factorize run on the
+       same silicon and converge, so the gate is published + warned instead
+       of failing a box that has no accelerator attached.
+    2. zipf skew sweep at the middle cardinality (uniform / 1.1 / 1.5):
+       the kernels' fold-histogram must actually fire (`joinSkewPct` > 0 on
+       the skewed probes) and zipf-1.5 must hold within 2x of the uniform
+       rate — with a unique-key build side every probe matches exactly once,
+       so a slowdown here could only come from the skew plumbing itself.
+    3. broadcast-vs-partitioned crossover on the same shapes: per
+       cardinality, the stats-driven chooser's pick, the exchange bytes both
+       ways through the real partitioner (`_partition_join_input`, 4
+       workers — broadcast ships p build replicas, partitioned hashes both
+       sides), and the measured wall of executing all 4 per-worker joins
+       under each strategy. Broadcast wins while the build side is small
+       (p tiny replicas beat hash-routing a 1M-row probe side); by the 2M
+       build side the p-fold replicated build work has to lose.
+    """
+    import jax
+
+    from pinot_tpu.multistage import runtime as mrt
+    from pinot_tpu.multistage.planner import (BROADCAST_MAX_BYTES_DEFAULT,
+                                              JoinSpec, choose_join_strategy)
+    from pinot_tpu.multistage.shuffle import _partition_join_input
+    from pinot_tpu.query import stats as qstats
+
+    probe_rows = probe_rows or JOIN_PROBE_ROWS
+    iters = iters or JOIN_ITERS
+    rng = np.random.default_rng(17)
+    accel = jax.default_backend() != "cpu"
+    spec = JoinSpec(right_alias="r", join_type="inner",
+                    left_keys=["lk"], right_keys=["rk"])
+    saved = dict(mrt._DEVICE_JOIN)
+    mrt.configure_device_join(enabled=True, min_rows=0)
+    out: dict = {"join_probe_rows": probe_rows,
+                 "join_build_cards": list(JOIN_BUILD_CARDS),
+                 "join_cards": {}, "join_skew": {}}
+
+    def exchange_wall(left, right, strategy):
+        """One full p-worker exchange + join under `strategy`: partition
+        both sides, run every per-worker join (codes ride the JoinInput
+        hand-off exactly as `_deliver_local` passes them), return (wall_s,
+        bytes_shuffled, rows_out)."""
+        p = 4
+        rparts, rbytes = _partition_join_input(right, ["rk"], p, strategy,
+                                               "R")
+        lparts, lbytes = _partition_join_input(left, ["lk"], p, strategy,
+                                               "L")
+        t0 = time.perf_counter()
+        rows = 0
+        for lp, rp in zip(lparts, rparts):
+            j = mrt.hash_join(lp.block, rp.block, spec,
+                              lcodes=lp.codes, rcodes=rp.codes)
+            rows += mrt._block_rows(j)
+        return time.perf_counter() - t0, int(rbytes + lbytes), rows
+
+    try:
+        # -- 1) device vs host oracle across build cardinalities -----------
+        for card in JOIN_BUILD_CARDS:
+            right = {"rk": np.arange(card, dtype=np.int64),
+                     "w": rng.uniform(0.0, 10.0, card)}
+            lk = _zipf_probe(rng, probe_rows, card, None)
+            left = {"lk": lk, "v": rng.uniform(0.0, 10.0, probe_rows)}
+            dev = mrt.hash_join(left, right, spec)        # warm jit shapes
+            # numpy oracle: every probe key exists exactly once on the build
+            # side, so the inner join is a pure gather — count and payload
+            # sums must agree to fp tolerance
+            want_v = float(np.sum(left["v"]))
+            want_w = float(np.sum(right["w"][lk]))
+            assert mrt._block_rows(dev) == probe_rows, \
+                (card, mrt._block_rows(dev))
+            for col, want in (("v", want_v), ("w", want_w)):
+                got = float(np.sum(dev[col]))
+                assert abs(got - want) <= 1e-6 * max(1.0, abs(want)), \
+                    (card, col, got, want)
+            with qstats.collect_stats() as st:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    mrt.hash_join(left, right, spec)
+                dev_wall = time.perf_counter() - t0
+            assert not st.counters.get(qstats.JOIN_SERVED_HOST_TIER), \
+                f"device join degraded to host at card={card}"
+            host = mrt.hash_join_host(left, right, spec)
+            assert mrt._block_rows(host) == probe_rows
+            host_iters = max(1, iters - 1)
+            t0 = time.perf_counter()
+            for _ in range(host_iters):
+                mrt.hash_join_host(left, right, spec)
+            host_wall = time.perf_counter() - t0
+            total = probe_rows + card
+            dev_rate = total * iters / dev_wall
+            host_rate = total * host_iters / host_wall
+            entry = {
+                "device_rows_per_sec": round(dev_rate, 1),
+                "host_rows_per_sec": round(host_rate, 1),
+                "device_vs_host": round(dev_rate / max(host_rate, 1.0), 3),
+                "build_ms": round(
+                    st.counters.get(qstats.JOIN_BUILD_MS, 0.0) / iters, 3),
+                "probe_ms": round(
+                    st.counters.get(qstats.JOIN_PROBE_MS, 0.0) / iters, 3),
+            }
+            # acceptance gate: >= 3x host from 100k build keys up — binding
+            # on accelerator backends (see docstring); published + warned on
+            # a CPU-hosted "device"
+            if card >= 100_000:
+                entry["gate_3x"] = entry["device_vs_host"] >= 3.0
+                if accel:
+                    assert entry["gate_3x"], (card, entry)
+                elif not entry["gate_3x"]:
+                    print(f"WARNING: join device_vs_host "
+                          f"{entry['device_vs_host']} < 3.0 at card={card} "
+                          "(cpu-hosted device backend)", file=sys.stderr)
+            # -- 3) broadcast-vs-partitioned crossover on the same shapes --
+            est = mrt._block_nbytes(right)
+            strategy = choose_join_strategy("inner", est)
+            entry["est_build_bytes"] = int(est)
+            entry["strategy"] = strategy
+            for tag in ("broadcast", "partitioned"):
+                exchange_wall(left, right, tag)           # warm jit shapes
+                wall, nbytes, rows = exchange_wall(left, right, tag)
+                assert rows == probe_rows, (card, tag, rows)
+                entry[f"{tag}_exchange_bytes"] = nbytes
+                entry[f"{tag}_exchange_join_ms"] = round(wall * 1000, 3)
+            faster = ("broadcast" if entry["broadcast_exchange_join_ms"]
+                      <= entry["partitioned_exchange_join_ms"]
+                      else "partitioned")
+            # the chooser must not replicate a build side that measures
+            # slower by more than timing jitter (20%)
+            if strategy != faster and (
+                    entry[f"{strategy}_exchange_join_ms"]
+                    > 1.2 * entry[f"{faster}_exchange_join_ms"]):
+                print(f"WARNING: join strategy {strategy} measured "
+                      f"{entry[f'{strategy}_exchange_join_ms']}ms vs "
+                      f"{faster} {entry[f'{faster}_exchange_join_ms']}ms "
+                      f"at card={card}", file=sys.stderr)
+            out["join_cards"][str(card)] = entry
+
+        out["join_broadcast_crossover_build_rows"] = (
+            BROADCAST_MAX_BYTES_DEFAULT // 16)  # 2 int64/f64 cols = 16B/row
+
+        # -- 2) zipf probe-key skew sweep at the middle cardinality --------
+        card = JOIN_BUILD_CARDS[min(1, len(JOIN_BUILD_CARDS) - 1)]
+        right = {"rk": np.arange(card, dtype=np.int64),
+                 "w": rng.uniform(0.0, 10.0, card)}
+        uniform_rate = None
+        for s in (None, 1.1, 1.5):
+            lk = _zipf_probe(rng, probe_rows, card, s)
+            left = {"lk": lk, "v": rng.uniform(0.0, 10.0, probe_rows)}
+            mrt.hash_join(left, right, spec)              # warm
+            with qstats.collect_stats() as st:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    dev = mrt.hash_join(left, right, spec)
+                wall = time.perf_counter() - t0
+            assert mrt._block_rows(dev) == probe_rows
+            rate = (probe_rows + card) * iters / wall
+            skew = float(st.counters.get(qstats.JOIN_SKEW_PCT, 0.0))
+            tag = "uniform" if s is None else f"zipf_{s}"
+            out["join_skew"][tag] = {
+                "device_rows_per_sec": round(rate, 1),
+                "join_skew_pct": round(skew, 1),
+            }
+            if s is None:
+                uniform_rate = rate
+            else:
+                out["join_skew"][tag]["vs_uniform"] = round(
+                    rate / max(uniform_rate, 1.0), 3)
+            if s == 1.5:
+                # acceptance gates: the histogram must actually detect the
+                # hot keys, and salting must hold the skewed probe within
+                # 2x of the uniform rate
+                assert skew > 0.0, out["join_skew"]
+                assert rate >= 0.5 * uniform_rate, out["join_skew"]
+    finally:
+        mrt.configure_device_join(**saved)
+    return out
+
+
+# --------------------------------------------------------------------------
 # multichip scaling lane: scan + high-card group-by + shuffle exchange at
 # 1/2/4/8 devices (virtual CPU devices when no real mesh is attached)
 # --------------------------------------------------------------------------
@@ -2181,6 +2390,7 @@ def main():
             "backend": jax.default_backend(),
     }
     detail.update(fused_bench())
+    detail.update(join_bench())
     detail.update(chaos_bench())
     detail.update(pruning_bench())
     detail.update(soak_bench())
@@ -2242,5 +2452,7 @@ if __name__ == "__main__":
         print(json.dumps(tiering_bench(), indent=2))
     elif "--fused" in sys.argv:
         print(json.dumps(fused_bench(), indent=2))
+    elif "--join" in sys.argv:
+        print(json.dumps(join_bench(), indent=2))
     else:
         main()

@@ -1,0 +1,103 @@
+"""join-path-host-materialization: host round-trips inside the device
+hash-join hot modules.
+
+The device join fast path (PR 17) keeps both sides' key codes and the
+candidate index pairs device-resident across the build/probe launches: the
+build side sorts (or scatters) on device, the probe is a jitted
+gather+compare, and only the final verified index vectors come back to the
+host. What silently regresses it is a "convenience" host materialization in
+the middle of that pipeline: a per-row `np.fromiter(...)` loop over a column
+that has a vectorized path, a `.tolist()` that turns a code array into a
+Python list (every later op is then interpreter-speed), or an explicit
+`jax.device_get(...)` that drags a device buffer home between launches
+instead of letting the final fetch batch it.
+
+This rule flags, in the join hot modules only:
+
+* any `np.fromiter` / `numpy.fromiter` call (the per-row Python-loop shape),
+* any `.tolist(...)` method call, and
+* any `device_get` call (`jax.device_get`, dotted or bare),
+
+unless the nearest enclosing function chain includes a name the module
+declares in `__graft_slow_paths__ = ("fn", ...)` — the explicit allowlist of
+host fallback paths (the object-dtype hash tail, the host `hash_join_host`
+oracle) — or the line carries an inline suppression with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+from .ingest_hot_loop import slow_path_names
+
+#: device-join hot modules (repo-relative suffixes): the build/probe kernel
+#: module and the multistage runtime that stages inputs for it. shuffle.py
+#: routes frames between processes, so its codec legitimately touches host
+#: memory — it is not listed here.
+HOT_MODULES = (
+    "pinot_tpu/engine/join_kernels.py",
+    "pinot_tpu/multistage/runtime.py",
+)
+
+#: the per-row Python-loop spelling
+_FROMITER_NAMES = ("np.fromiter", "numpy.fromiter")
+
+#: explicit device->host fetches (bare or dotted)
+_DEVICE_GET_NAMES = ("device_get", "jax.device_get")
+
+
+class JoinPathHostMaterializationRule(Rule):
+    id = "join-path-host-materialization"
+    description = ("host materialization (`np.fromiter` per-row loop, "
+                   "`.tolist()`, or `jax.device_get`) inside a device-join "
+                   "hot module outside a declared __graft_slow_paths__ "
+                   "function")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if not any(module.rel.endswith(suffix) for suffix in HOT_MODULES):
+            return ()
+        slow = slow_path_names(module)
+        out: List[Finding] = []
+        seen_lines: Set[int] = set()
+
+        def _enclosing(node: ast.AST) -> Set[str]:
+            names: Set[str] = set()
+            cur = getattr(node, "graft_parent", None)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    names.add(cur.name)
+                cur = getattr(cur, "graft_parent", None)
+            return names
+
+        def _flag(node: ast.AST, message: str) -> None:
+            fns = _enclosing(node)
+            if fns & slow:
+                return
+            if node.lineno in seen_lines:
+                return
+            seen_lines.add(node.lineno)
+            where = (f"`{sorted(fns)[0]}`" if fns else "module scope")
+            out.append(Finding(self.id, module.rel, node.lineno,
+                               f"{message} in {where} — the join fast path "
+                               "keeps key codes and candidate pairs device-"
+                               "resident (vectorized host staging only); "
+                               "move the host loop to a declared "
+                               "__graft_slow_paths__ function"))
+
+        for node in module.nodes_of(ast.Call):
+            name = dotted_name(node.func)
+            if name in _FROMITER_NAMES:
+                _flag(node, f"per-row host loop `{name}(...)`")
+            elif name in _DEVICE_GET_NAMES:
+                _flag(node, f"explicit device fetch `{name}(...)`")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "tolist":
+                _flag(node, "host list materialization `.tolist(...)`")
+        return out
+
+
+def rules() -> List[Rule]:
+    return [JoinPathHostMaterializationRule()]

@@ -326,7 +326,8 @@ class Broker:
                 with tracing.request_trace(True) as tr:
                     tr.sampled = trace_on or self.trace_sampler.sample(
                         self._trace_sample_rate())
-                    if stmt.joins:
+                    from ..multistage.planner import stmt_has_in_subquery
+                    if stmt.joins or stmt_has_in_subquery(stmt):
                         result = (self._explain_multistage(stmt)
                                   if stmt.explain
                                   else self._handle_multistage(stmt))
@@ -586,6 +587,11 @@ class Broker:
             if not isinstance(e, Function):
                 return e
             if e.name in ("in_subquery", "in_partitioned_subquery"):
+                from ..sql.ast import Subquery
+                if len(e.args) == 2 and isinstance(e.args[1], Subquery):
+                    # `x IN (SELECT ...)` AST form: the multistage planner
+                    # lowers it to a SEMI join — not the id-set rewrite
+                    return e
                 if len(e.args) != 2 or not isinstance(e.args[1], Literal):
                     raise QueryValidationError(
                         f"IN_SUBQUERY(expr, 'sql') expected: {e!r}")
@@ -1285,18 +1291,30 @@ class Broker:
     def _explain_multistage(self, stmt) -> ResultTable:
         """EXPLAIN for a JOIN query: describe the stage plan WITHOUT executing
         (reference: v2 EXPLAIN prints the logical stage tree)."""
-        from ..multistage.planner import plan_multistage
+        from ..multistage.planner import choose_join_strategy, plan_multistage
+        from ..multistage.shuffle import _broadcast_max_bytes
         from ..sql.ast import to_sql
         plan = plan_multistage(stmt, lambda t: (
             self.catalog.schema_for_table(self._physical_tables(t)[0])
             if self._physical_tables(t) else None))
+
+        def est_bytes(alias: str) -> int:
+            scan = plan.scans[alias]
+            docs = sum(int(getattr(m, "num_docs", 0))
+                       for t in self._physical_tables(scan.table)
+                       for m in self.catalog.segments.get(t, {}).values())
+            return docs * max(1, len(scan.columns)) * 8
+
+        bmax = _broadcast_max_bytes(self)
         rows: List[list] = [["MULTISTAGE_REDUCE", 0, -1]]
         parent = 0
         for j in reversed(plan.joins):
             keys = ", ".join(f"{l}={r}" for l, r in
                              zip(j.left_keys, j.right_keys))
-            rows.append([f"HASH_JOIN(type:{j.join_type}; keys:[{keys}])",
-                         len(rows), parent])
+            strategy = choose_join_strategy(
+                j.join_type, est_bytes(j.right_alias), bmax)
+            rows.append([f"HASH_JOIN(type:{j.join_type}; keys:[{keys}]; "
+                         f"strategy:{strategy})", len(rows), parent])
             parent = len(rows) - 1
         for alias in [plan.base_alias] + [j.right_alias for j in plan.joins]:
             scan = plan.scans[alias]
@@ -1447,6 +1465,16 @@ class Broker:
         from ..multistage import execute_multistage
         from ..sql.ast import Identifier
 
+        # cluster knob `server.join.device.enabled`: operators can force the
+        # join build/probe onto the host path fleet-wide (e.g. while a device
+        # regression is being chased) without restarting servers
+        dev = self.catalog.get_property(
+            "clusterConfig/server.join.device.enabled")
+        if dev is not None:
+            from ..multistage.runtime import configure_device_join
+            configure_device_join(enabled=str(dev).strip().lower()
+                                  not in ("false", "0", "no", "off"))
+
         opt = {str(k).lower(): v for k, v in (stmt.options or {}).items()}
         use_mailbox = ("usemailboxshuffle" not in opt
                        or _truthy(opt["usemailboxshuffle"]))
@@ -1592,9 +1620,12 @@ class Broker:
 
         # shuffle width is per-query tunable (reference: the v2 engine's
         # stage parallelism query options)
+        from ..multistage.shuffle import _broadcast_max_bytes
         return execute_multistage(stmt, scan, schema_for,
                                   num_partitions=self._num_partitions(stmt),
-                                  stage_runner=stage_runner())
+                                  stage_runner=stage_runner(),
+                                  broadcast_max_bytes=_broadcast_max_bytes(
+                                      self))
 
     def _physical_tables(self, raw_table: str) -> List[str]:
         """Resolve a logical name to physical tables; hybrid tables hit both OFFLINE

@@ -52,6 +52,39 @@ DEFAULT_TARGET_HEADROOM_PCT = 10.0
 PRESSURE_INTERVAL_S = 5.0
 
 
+# -- join-intermediate pricing (PR 17) ---------------------------------------
+# The device hash-join stages both sides' key codes plus the matched output
+# in HBM alongside whatever segments are already resident. An exploding join
+# (duplicate build keys fanning every probe row out) must degrade to the host
+# `hash_join` path — flagged `joinServedHostTier` — instead of OOMing, the
+# same graceful-degradation contract the segment admission gate gives scans.
+
+def predicted_join_bytes(build_rows: int, probe_rows: int, ncols: int,
+                         dup_factor: float = 1.0) -> int:
+    """Metadata-only sizing of a device join's working set: the staged key
+    codes for both sides (padded to the kernel's pow2 shapes) plus the
+    expanded candidate index pairs. `dup_factor` is the build-side key
+    duplication (rows / distinct keys) — the probe match-rate estimate's
+    upper bound: every probe row matching `dup_factor` build rows."""
+    def pow2(n: int) -> int:
+        return 1 << (max(1, int(n)) - 1).bit_length()
+    code_bytes = 4 * (pow2(build_rows) * 2 + pow2(probe_rows))
+    out_rows = int(max(0.0, float(probe_rows)) * max(1.0, float(dup_factor)))
+    # candidate (li, ri) int64 pairs + one gathered output column set
+    pair_bytes = out_rows * 16
+    out_bytes = out_rows * max(1, int(ncols)) * 8
+    return int(code_bytes + pair_bytes + out_bytes)
+
+
+def join_device_budget_bytes(headroom_pct: float = DEFAULT_TARGET_HEADROOM_PCT
+                             ) -> int:
+    """HBM bytes a device join may claim right now: target residency budget
+    minus what the ledger already holds (0 when scans have HBM pinned)."""
+    cap, _ = get_ledger().capacity_bytes()
+    target = int(cap * (1.0 - max(0.0, min(99.0, headroom_pct)) / 100.0))
+    return max(0, target - get_ledger().resident_bytes())
+
+
 class _Admitted:
     """Book-keeping for one hot-tier resident: which TableDataManager owns
     it (for the refcount check + the segment handle), when a query last

@@ -73,13 +73,19 @@ class KernelCaps:
     # back to the staged two-launch ladder.
     fused_enabled: bool = True
     fused_lut_cap: int = 1 << 16
+    # device hash-join regime split (PR 17): a single-integer-key build side
+    # whose value span fits under this many direct-address slots takes the
+    # scatter-table probe (one gather launch, at most one match per probe
+    # row); wider/duplicate-key builds take the sort-merge probe ladder.
+    join_scatter_cap: int = 1 << 20
     source: str = "default"      # default | cache | calibrated | env
 
     def token(self) -> Tuple:
         """The part of the caps that changes compiled kernels (jit cache key)."""
         return (self.matmul_cap, self.chunk_cap, self.minmax_bcast_cap,
                 self.high_card_regime, self.partition_block,
-                self.bitmap_sel_cap, self.fused_enabled, self.fused_lut_cap)
+                self.bitmap_sel_cap, self.fused_enabled, self.fused_lut_cap,
+                self.join_scatter_cap)
 
 
 _ACTIVE: Optional[KernelCaps] = None
@@ -97,6 +103,7 @@ def _valid(caps: KernelCaps) -> bool:
                 and isinstance(caps.fused_enabled, bool)
                 and _FUSED_LUT_CAP_RANGE[0] <= int(caps.fused_lut_cap)
                 <= _FUSED_LUT_CAP_RANGE[1]
+                and (1 << 10) <= int(caps.join_scatter_cap) <= (1 << 26)
                 and caps.high_card_regime in HIGH_CARD_REGIMES)
     except (TypeError, ValueError):
         return False
@@ -140,6 +147,9 @@ def load_cached_caps(path: Optional[str] = None,
                                          KernelCaps.fused_enabled)),
             fused_lut_cap=int(entry.get("fused_lut_cap",
                                         KernelCaps.fused_lut_cap)),
+            # absent in caches written before the device hash-join regime
+            join_scatter_cap=int(entry.get("join_scatter_cap",
+                                           KernelCaps.join_scatter_cap)),
             source="cache")
     except Exception:
         return None

@@ -42,13 +42,15 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tupl
 import numpy as np
 
 from ..constants import UNBOUNDED_LIMIT
+from ..query import stats as qstats
 from ..query.aggregates import make_agg
 from ..query.reduce import (SegmentResult, _eval_result, _object_array,
                             _sort_key, merge_segment_results)
 from ..sql.ast import Expr, Function, OrderByItem, to_sql
-from .planner import JoinSpec
-from .runtime import (Block, _block_rows, _concat_blocks, _null_safe_mask,
-                      _take, aggregate_block, hash_join, partition_block_stable,
+from .planner import JoinSpec, choose_join_strategy
+from .runtime import (Block, JoinInput, _block_nbytes, _block_rows,
+                      _concat_join_inputs, _null_safe_mask, _take,
+                      aggregate_block, hash_join, partition_block_stable,
                       selection_block, spec_from_json, spec_to_json,
                       stable_hash_codes, stable_hash_key)
 
@@ -96,6 +98,38 @@ def partition_groups_stable(result: SegmentResult, p: int) -> List[SegmentResult
     if outs:
         outs[0].num_docs_scanned = result.num_docs_scanned
     return outs
+
+
+def _partition_join_input(block: Block, keys: List[str], p: int,
+                          strategy: str, side: str
+                          ) -> Tuple[List[JoinInput], int]:
+    """Split one sender's rows for a join exchange. Partitioned: hash-route
+    on the stable key codes (identical routing in every process). Broadcast:
+    the build side (R) replicates whole to every worker; the probe side (L)
+    strip-splits — no hashing, so probe-key skew cannot pile one worker up.
+    Every part carries its rows' key codes: an in-process delivery hands
+    them to the worker by reference (device-staged exchange, the join skips
+    re-hashing), while remote legs ship only the block. Returns the parts
+    plus the exchanged-bytes estimate."""
+    codes = stable_hash_codes(block, keys)
+    if strategy == "broadcast":
+        if side == "R":
+            parts = [JoinInput(block, codes)] * p
+        else:
+            parts = [JoinInput(_take(block, ix), codes[ix])
+                     for ix in np.array_split(
+                         np.arange(_block_rows(block)), p)]
+    else:
+        pid = (codes % np.uint64(p)).astype(np.int64)
+        parts = [JoinInput(_take(block, ix), codes[ix])
+                 for ix in (np.nonzero(pid == i)[0] for i in range(p))]
+    return parts, sum(_block_nbytes(part.block) for part in parts)
+
+
+def _join_input_frames(part: JoinInput) -> Iterator[dict]:
+    """Remote framer for a join-exchange partition: the key codes stay home
+    (cheaper to re-hash on the worker than to ship 8 bytes/row)."""
+    return block_frames(part.block)
 
 
 # ---------------------------------------------------------------------------
@@ -557,12 +591,13 @@ def run_leaf_join_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
         block[f"{alias}.{c}"] = (
             np.asarray(vals, dtype=dt.numpy_dtype) if dt.is_numeric
             else np.asarray(vals, dtype=object))
-    parts = partition_block_stable(block, list(task["keys"]),
-                                   int(task["numPartitions"]))
+    parts, shuffled = _partition_join_input(
+        block, list(task["keys"]), int(task["numPartitions"]),
+        task.get("strategy", "partitioned"), task["side"])
     _send_partitions(list(task["targets"]), qid, task["stage"], task["side"],
-                     parts, task["senderId"], block_frames, "block",
+                     parts, task["senderId"], _join_input_frames, "block",
                      local_ok=bool(task.get("deviceRoute", True)))
-    return {"rows": n}
+    return {"rows": n, "shuffleBytes": int(shuffled)}
 
 
 def run_leaf_agg_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
@@ -584,33 +619,34 @@ def run_leaf_agg_task(server, task: Dict[str, Any]) -> Dict[str, Any]:
             int((res.dense.counts > 0).sum())}
 
 
-def run_join_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
-    """One join-stage partition on a worker server: consume both side
-    mailboxes, hash-join, then either (a) forward re-partitioned output to the
-    next stage's mailboxes, or (b) run the final stage (post-filter +
-    aggregation/selection trim) and stream partial frames back in the HTTP
-    response. Yields response frames."""
+def _join_stage_body(task: Dict[str, Any]) -> List[dict]:
+    """The work of one join-stage partition, run under the caller's active
+    stats record. Returns the data frames to stream back."""
     qid = task["queryId"]
     stage = task["stage"]
     p = int(task["partition"])
     spec = spec_from_json(task["spec"])
-    lblocks, _ = consume_mailbox(qid, f"{stage}.L.{p}",
-                                 int(task["numLeftSenders"]))
-    rblocks, _ = consume_mailbox(qid, f"{stage}.R.{p}",
-                                 int(task["numRightSenders"]))
-    out = hash_join(_concat_blocks(lblocks), _concat_blocks(rblocks), spec)
+    lparts, _ = consume_mailbox(qid, f"{stage}.L.{p}",
+                                int(task["numLeftSenders"]))
+    rparts, _ = consume_mailbox(qid, f"{stage}.R.{p}",
+                                int(task["numRightSenders"]))
+    # local senders delivered JoinInput parts whose key codes survive the
+    # exchange by reference; remote frames degrade to re-hashing inside
+    left, lcodes = _concat_join_inputs(lparts)
+    right, rcodes = _concat_join_inputs(rparts)
+    out = hash_join(left, right, spec, lcodes=lcodes, rcodes=rcodes)
 
     down = task["downstream"]
     if down["kind"] == "mailbox":
-        parts = partition_block_stable(out, list(down["keys"]),
-                                       len(down["targets"]))
+        parts, shuffled = _partition_join_input(
+            out, list(down["keys"]), len(down["targets"]),
+            down.get("strategy", "partitioned"), down.get("side", "L"))
+        qstats.record(qstats.JOIN_SHUFFLE_BYTES, shuffled)
         _send_partitions(list(down["targets"]), qid, down["stage"],
                          down.get("side", "L"), parts, down["senderId"],
-                         block_frames, "block",
+                         _join_input_frames, "block",
                          local_ok=bool(down.get("deviceRoute", True)))
-        yield frame_bytes({"kind": "ack", "rows": _block_rows(out)})
-        yield frame_bytes({"kind": "end"})
-        return
+        return [{"kind": "ack", "rows": _block_rows(out)}]
 
     # final stage: post-filter (row-local, safe pre-aggregation), then
     # aggregate or select + per-partition trim
@@ -626,8 +662,25 @@ def run_join_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
         # trim here would be unsound; ship full partials (they are mergeable)
     else:
         partial = _trim_selection(ctx, selection_block(ctx, out))
-    for fr in partial_frames(partial):
+    return list(partial_frames(partial))
+
+
+def run_join_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
+    """One join-stage partition on a worker server: consume both side
+    mailboxes, hash-join, then either (a) forward re-partitioned output to the
+    next stage's mailboxes, or (b) run the final stage (post-filter +
+    aggregation/selection trim) and stream partial frames back in the HTTP
+    response. Yields response frames, ending with the worker's join stats
+    (joinBuildMs/joinProbeMs/joinSkewPct/...) so device-join accounting rides
+    the P2P transport back to the broker. The body runs EAGERLY under the
+    stats record — a generator suspending inside `collect_stats` would leak
+    the thread-local record onto the HTTP handler thread between yields."""
+    st = qstats.ExecutionStats()
+    with qstats.collect_stats(st):
+        frames = _join_stage_body(task)
+    for fr in frames:
         yield frame_bytes(fr)
+    yield frame_bytes({"kind": "stats", "stats": st.to_wire()})
     yield frame_bytes({"kind": "end"})
 
 
@@ -656,8 +709,12 @@ def run_agg_stage_task(task: Dict[str, Any]) -> Iterator[bytes]:
 # ---------------------------------------------------------------------------
 
 def _post_stage_task(url: str, path: str, task: Dict[str, Any],
-                     timeout_s: float) -> List[SegmentResult]:
-    """Dispatch a worker task and consume its streamed response frames."""
+                     timeout_s: float,
+                     stats_sink: Optional[List[Dict[str, float]]] = None
+                     ) -> List[SegmentResult]:
+    """Dispatch a worker task and consume its streamed response frames.
+    Worker stats frames (join accounting) append to `stats_sink` when given;
+    workers that predate them simply never send one."""
     from ..cluster.http_service import (_DEFAULT_TOKEN, HttpError,
                                         client_ssl_context)
     from ..cluster.wire import decode_segment_result, encode_value
@@ -683,6 +740,8 @@ def _post_stage_task(url: str, path: str, task: Dict[str, Any],
                 raise RuntimeError(f"stage worker failed: {d['message']}")
             if d["kind"] == "partial":
                 partials.append(decode_segment_result(d["result"]))
+            elif d["kind"] == "stats" and stats_sink is not None:
+                stats_sink.append(d["stats"])
             # "ack" frames carry no data
     return partials
 
@@ -724,6 +783,32 @@ def _explicit_partitions(options) -> bool:
     return bool(opt & {"numpartitions", "stageparallelism"})
 
 
+def _broadcast_max_bytes(broker) -> Optional[int]:
+    """clusterConfig `broker.join.broadcast.max.bytes`: build sides estimated
+    under this replicate to every worker instead of hash-partitioning
+    (None -> planner default)."""
+    prop = broker.catalog.get_property(
+        "clusterConfig/broker.join.broadcast.max.bytes")
+    try:
+        return int(prop) if prop is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _est_route_bytes(broker, routes, ncols: int) -> int:
+    """Metadata-only size estimate of a routed scan: catalog doc counts of
+    the routed segments x projected columns x 8 bytes — the stats input the
+    broadcast-vs-partitioned chooser reads (pushdown filters make it an
+    upper bound, which only errs toward the always-correct partitioned
+    strategy)."""
+    docs = 0
+    for r in routes:
+        metas = broker.catalog.segments.get(r.table, {})
+        docs += sum(int(getattr(metas[s], "num_docs", 0))
+                    for s in r.segments if s in metas)
+    return docs * max(1, int(ncols)) * 8
+
+
 def coordinate_join(broker, stmt, num_partitions: int):
     """P2P multistage execution of a join query. The broker plans, routes leaf
     scans, assigns P workers per stage, dispatches everything, and receives
@@ -761,6 +846,19 @@ def coordinate_join(broker, stmt, num_partitions: int):
     # funnel path must not have charged any table's QPS budget yet
     broker._acquire_scan_quota([s.table for s in plan.scans.values()])
 
+    # stats-driven exchange strategy per stage: a build side whose catalog
+    # size estimate fits under the broadcast cap replicates to every worker
+    # (probe rows then split by strips — immune to probe-key skew); larger
+    # builds hash-partition both sides
+    bmax = _broadcast_max_bytes(broker)
+    strategies = [
+        choose_join_strategy(
+            spec.join_type,
+            _est_route_bytes(broker, leaf_routes[spec.right_alias],
+                             len(plan.scans[spec.right_alias].columns)),
+            bmax)
+        for spec in plan.joins]
+
     # -- build the task graph ----------------------------------------------
     leaf_tasks: List[Tuple[str, Dict[str, Any]]] = []  # (url, task)
 
@@ -771,8 +869,8 @@ def coordinate_join(broker, stmt, num_partitions: int):
             sql += f" WHERE {to_sql(scan.filter)}"
         return sql + f" LIMIT {UNBOUNDED_LIMIT}"
 
-    def add_leaf_tasks(alias: str, stage: str, side: str, keys: List[str]
-                       ) -> int:
+    def add_leaf_tasks(alias: str, stage: str, side: str, keys: List[str],
+                       strategy: str) -> int:
         scan = plan.scans[alias]
         routes = leaf_routes[alias]
         sql = leaf_sql(scan)
@@ -782,6 +880,7 @@ def coordinate_join(broker, stmt, num_partitions: int):
                 "segments": r.segments, "timeFilter": r.time_filter,
                 "alias": alias, "columns": scan.columns, "keys": keys,
                 "numPartitions": P, "stage": stage, "side": side,
+                "strategy": strategy,
                 "targets": [w[1] for w in workers],
                 "deviceRoute": device_route,
                 "senderId": f"leaf.{alias}.{i}"}))
@@ -789,10 +888,11 @@ def coordinate_join(broker, stmt, num_partitions: int):
 
     worker_tasks: List[Tuple[str, str, Dict[str, Any]]] = []  # (url, path, task)
     n_left = add_leaf_tasks(plan.base_alias, "join0", "L",
-                            plan.joins[0].left_keys)
+                            plan.joins[0].left_keys, strategies[0])
     for si, spec in enumerate(plan.joins):
         stage = f"join{si}"
-        n_right = add_leaf_tasks(spec.right_alias, stage, "R", spec.right_keys)
+        n_right = add_leaf_tasks(spec.right_alias, stage, "R",
+                                 spec.right_keys, strategies[si])
         last = si == len(plan.joins) - 1
         for p in range(P):
             task: Dict[str, Any] = {
@@ -810,6 +910,7 @@ def coordinate_join(broker, stmt, num_partitions: int):
                 task["downstream"] = {
                     "kind": "mailbox", "keys": nxt.left_keys,
                     "stage": f"join{si + 1}", "side": "L",
+                    "strategy": strategies[si + 1],
                     "targets": [w[1] for w in workers],
                     "deviceRoute": device_route,
                     "senderId": f"{stage}.w{p}"}
@@ -824,6 +925,8 @@ def coordinate_join(broker, stmt, num_partitions: int):
     # (workers queued behind the leaf dispatches that feed them)
     n_tasks = len(worker_tasks) + len(leaf_tasks)
     partials: List[SegmentResult] = []
+    worker_stats: List[Dict[str, float]] = []
+    leaf_shuffle_bytes = 0
     pool = ThreadPoolExecutor(max_workers=n_tasks,
                               thread_name_prefix="p2p-stage")
     try:
@@ -834,7 +937,7 @@ def coordinate_join(broker, stmt, num_partitions: int):
         futs = {}
         for url, path, task in worker_tasks:
             futs[pool.submit(_post_stage_task, url, path, task,
-                             broker.stage_timeout_s)] = "worker"
+                             broker.stage_timeout_s, worker_stats)] = "worker"
         for url, task in leaf_tasks:
             futs[pool.submit(broker._post_leaf_task, url, "leafStage",
                              task)] = "leaf"
@@ -844,6 +947,8 @@ def coordinate_join(broker, stmt, num_partitions: int):
             r = f.result()
             if futs[f] == "worker":
                 partials.extend(r)
+            else:
+                leaf_shuffle_bytes += int(r.get("shuffleBytes", 0) or 0)
     except Exception:
         # wake every blocked sender/consumer across the cluster BEFORE the
         # pool shutdown below waits on their futures — otherwise a dead
@@ -866,6 +971,23 @@ def coordinate_join(broker, stmt, num_partitions: int):
     result.stats["multistage"] = True
     result.stats["mailboxShuffle"] = True
     result.stats["numStageWorkers"] = len({u for u, _, _ in worker_tasks})
+    # join accounting: worker-side device-join counters (build/probe ms,
+    # skew, host-tier degrades) merged with the leaf exchange volume, then
+    # exported under the same keys as the funnel path
+    st = qstats.ExecutionStats()
+    for d in worker_stats:
+        st.merge(d)
+    if leaf_shuffle_bytes:
+        st.add(qstats.JOIN_SHUFFLE_BYTES, leaf_shuffle_bytes)
+    for key, val in st.to_public_dict().items():
+        if key.startswith("join") or \
+                key == qstats.NUM_SEGMENTS_PRUNED_BY_JOIN_KEY:
+            result.stats[key] = val
+    result.stats["joinStrategy"] = (strategies[0] if len(strategies) == 1
+                                    else ",".join(strategies))
+    outer = qstats.current_stats()
+    if outer is not None:
+        outer.merge(st)
     return result
 
 

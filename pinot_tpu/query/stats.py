@@ -81,6 +81,19 @@ SEGMENTS_SERVED_HOST_TIER = "segmentsServedHostTier"
 TIER_PROMOTIONS = "tierPromotions"
 SEGMENTS_COLD_LOADED = "segmentsColdLoaded"
 COLD_LOAD_MS = "coldLoadMs"
+# device hash-join fast path (PR 17): wall time in the build-side sort /
+# scatter launches and the probe launches (summed across join partitions),
+# bytes exchanged between join stages, probe segments skipped by the
+# build-key derived filter, and joins the admission gate priced off the
+# device (served by the host hash_join instead of OOMing HBM)
+JOIN_BUILD_MS = "joinBuildMs"
+JOIN_PROBE_MS = "joinProbeMs"
+JOIN_SHUFFLE_BYTES = "joinShuffleBytes"
+NUM_SEGMENTS_PRUNED_BY_JOIN_KEY = "numSegmentsPrunedByJoinKey"
+JOIN_SERVED_HOST_TIER = "joinServedHostTier"
+# worst probe-key skew any join partition saw (hot-bucket excess percentage
+# from the probe-hash histogram); max-merged like deviceSkewPct
+JOIN_SKEW_PCT = "joinSkewPct"
 
 # merged-counter keys always present in a query response (0 when the path
 # never ran); `*Ms` keys round to 3 decimals on export
@@ -98,6 +111,8 @@ COUNTER_KEYS = (
     DEVICE_FLOPS, DEVICE_BYTES_ACCESSED,
     SEGMENTS_SERVED_HOST_TIER, TIER_PROMOTIONS,
     SEGMENTS_COLD_LOADED, COLD_LOAD_MS,
+    JOIN_BUILD_MS, JOIN_PROBE_MS, JOIN_SHUFFLE_BYTES,
+    NUM_SEGMENTS_PRUNED_BY_JOIN_KEY, JOIN_SERVED_HOST_TIER,
 )
 
 # keys that merge by MINIMUM instead of sum (reference: the broker reduces
@@ -113,14 +128,14 @@ MIN_KEYS = (MIN_CONSUMING_FRESHNESS_TIME_MS,)
 # Absent on responses that never took a multi-device mesh path.
 # rooflinePct likewise keeps the BEST achieved-vs-roofline fetch window the
 # query saw (sums are meaningless for percentages).
-MAX_KEYS = (DEVICE_SKEW_PCT, ROOFLINE_PCT)
+MAX_KEYS = (DEVICE_SKEW_PCT, ROOFLINE_PCT, JOIN_SKEW_PCT)
 
 # broker-level keys that live beside the merged counters in QueryResult.stats
 # (listed so the glossary drift guard covers the full emitted surface)
 BROKER_KEYS = (
     "timeUsedMs", NUM_DOCS_SCANNED, "numGroupsTotal", "numServersQueried",
     "numServersResponded", "partialResult", "phaseTimesMs", "traceInfo",
-    "traceId", "gapfilled", "explain", "analyze",
+    "traceId", "gapfilled", "explain", "analyze", "joinStrategy",
 )
 
 #: routing pruner kind (cluster.routing.PRUNER_KINDS) -> its breakdown counter

@@ -43,6 +43,19 @@ class Function:
         return f"{self.name}({d}{', '.join(map(repr, self.args))})"
 
 
+@dataclass(eq=False)
+class Subquery:
+    """A column subquery operand: `x IN (SELECT col FROM t ...)`. Never
+    compiled directly — the multistage planner lowers the enclosing
+    `in_subquery`/`not_in_subquery` function into a SEMI/ANTI join before
+    compilation, so MEMBERSHIP deliberately excludes those names."""
+
+    stmt: "QueryStatement"
+
+    def __repr__(self) -> str:
+        return f"subquery({self.stmt.table})"
+
+
 STAR = Identifier("*")
 
 # canonical operator names (reference: FilterKind + arithmetic function names)
@@ -194,6 +207,10 @@ def to_sql(e: Expr) -> str:
         kw = "IN" if op == "in" else "NOT IN"
         vals = ", ".join(to_sql(a) for a in e.args[1:])
         return f"({to_sql(e.args[0])} {kw} ({vals}))"
+    if op in ("in_subquery", "not_in_subquery"):
+        kw = "IN" if op == "in_subquery" else "NOT IN"
+        return (f"({to_sql(e.args[0])} {kw} "
+                f"({statement_to_sql(e.args[1].stmt)}))")
     if op == "between":
         return (f"({to_sql(e.args[0])} BETWEEN {to_sql(e.args[1])}"
                 f" AND {to_sql(e.args[2])})")
@@ -217,3 +234,29 @@ def to_sql(e: Expr) -> str:
         return " ".join(parts)
     d = "DISTINCT " if e.distinct else ""
     return f"{op}({d}{', '.join(to_sql(a) for a in e.args)})"
+
+
+def statement_to_sql(stmt: "QueryStatement") -> str:
+    """QueryStatement -> SQL text that re-parses to the same statement (used
+    to unparse subquery operands; covers the single-table SELECT surface)."""
+    items = ", ".join(
+        to_sql(e) + (f" AS {_sql_ident(a)}" if a else "")
+        for e, a in stmt.select)
+    out = "SELECT " + ("DISTINCT " if stmt.distinct else "") + items
+    out += f" FROM {_sql_ident(stmt.table)}"
+    if stmt.table_alias:
+        out += f" AS {_sql_ident(stmt.table_alias)}"
+    if stmt.where is not None:
+        out += f" WHERE {to_sql(stmt.where)}"
+    if stmt.group_by:
+        out += " GROUP BY " + ", ".join(to_sql(e) for e in stmt.group_by)
+    if stmt.having is not None:
+        out += f" HAVING {to_sql(stmt.having)}"
+    if stmt.order_by:
+        out += " ORDER BY " + ", ".join(
+            to_sql(o.expr) + (" DESC" if o.desc else "")
+            for o in stmt.order_by)
+    out += f" LIMIT {stmt.limit}"
+    if stmt.offset:
+        out += f" OFFSET {stmt.offset}"
+    return out

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from .ast import (Expr, Function, Identifier, JoinClause, Literal, OrderByItem,
-                  QueryStatement, STAR)
+                  QueryStatement, STAR, Subquery)
 from .lexer import SqlSyntaxError, Token, tokenize
 
 _COMPARISON_OPS = {"=": "eq", "!=": "neq", "<>": "neq", "<": "lt", "<=": "lte",
@@ -69,28 +69,42 @@ class Parser:
 
     # -- statement ---------------------------------------------------------
     def parse(self) -> QueryStatement:
-        q = QueryStatement()
+        options = {}
         # leading `SET key = value;` statements (reference: SqlNodeAndOptions options)
         while self.at_keyword("SET"):
             self.advance()
             key = self.advance().value
             self.expect_op("=")
-            q.options[key] = self._literal_token_value()
+            options[key] = self._literal_token_value()
             self.accept_op(";")
 
         # EXPLAIN/PLAN/FOR/ANALYZE are CONTEXTUAL: only the statement-leading
         # "EXPLAIN PLAN FOR" / "EXPLAIN ANALYZE" sequences are special, so
         # columns/tables named plan/for/explain/analyze keep working
         # (reference: Calcite treats EXPLAIN as a statement prefix)
+        explain = analyze = False
         if self._accept_ident_word("EXPLAIN"):
             if self._accept_ident_word("ANALYZE"):
-                q.explain = True
-                q.analyze = True
+                explain = analyze = True
             elif (self._accept_ident_word("PLAN")
                     and self._accept_ident_word("FOR")):
-                q.explain = True
+                explain = True
             else:
                 raise SqlSyntaxError("expected PLAN FOR or ANALYZE after EXPLAIN")
+        q = self._select_statement()
+        if options:
+            q.options = {**options, **q.options}
+        q.explain, q.analyze = explain, analyze
+        self.accept_op(";")
+        if self.cur.kind != "EOF":
+            raise SqlSyntaxError(f"unexpected trailing input at position {self.cur.pos}: "
+                                 f"{self.cur.value!r}")
+        return q
+
+    def _select_statement(self) -> QueryStatement:
+        """The SELECT body proper (shared by top-level parse and `IN
+        (subquery)` operands, which stop at the closing paren)."""
+        q = QueryStatement()
         self.expect_keyword("SELECT")
         q.distinct = self.accept_keyword("DISTINCT")
         q.select = self._select_list()
@@ -124,10 +138,6 @@ class Parser:
                 self.expect_op("=")
                 q.options[key] = self._literal_token_value()
                 self.accept_op(",")
-        self.accept_op(";")
-        if self.cur.kind != "EOF":
-            raise SqlSyntaxError(f"unexpected trailing input at position {self.cur.pos}: "
-                                 f"{self.cur.value!r}")
         return q
 
     def _literal_token_value(self):
@@ -246,6 +256,11 @@ class Parser:
         negated = self.accept_keyword("NOT")
         if self.accept_keyword("IN"):
             self.expect_op("(")
+            if self.at_keyword("SELECT"):
+                sub = Subquery(self._select_statement())
+                self.expect_op(")")
+                return Function("not_in_subquery" if negated
+                                else "in_subquery", (left, sub))
             values = self._expr_list()
             self.expect_op(")")
             return Function("not_in" if negated else "in", (left, *values))

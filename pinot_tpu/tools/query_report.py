@@ -100,6 +100,38 @@ def render_report(stats: Dict[str, Any]) -> str:
             roofline = f"{stats['rooflinePct']!s:>10}"
         out.append(f"  {'hbm roofline':<15} {roofline}  "
                    "(achieved/measured bandwidth, worst fetch window)")
+    # join section only when a join ran (joinStrategy is set by both the
+    # funnel and the P2P multistage paths)
+    if stats.get("joinStrategy") or any(
+            float(stats.get(k) or 0) for k in
+            ("joinBuildMs", "joinProbeMs", "joinShuffleBytes",
+             "joinServedHostTier")):
+        out.append("")
+        out.append("join (device hash-join fast path)")
+        if stats.get("joinStrategy"):
+            out.append(f"  {'strategy':<15} {stats['joinStrategy']:>10}")
+        for key, label in (("joinBuildMs", "build"),
+                           ("joinProbeMs", "probe")):
+            if key in stats:
+                out.append(f"  {label:<15} {_fmt_ms(stats.get(key, 0))}")
+        if "joinShuffleBytes" in stats:
+            out.append(f"  {'shuffle bytes':<15} "
+                       f"{int(float(stats['joinShuffleBytes'] or 0)):>10}")
+        if "joinSkewPct" in stats:
+            try:
+                jskew = f"{float(stats['joinSkewPct']):10.1f} %"
+            except (TypeError, ValueError):
+                jskew = f"{stats['joinSkewPct']!s:>10}"
+            out.append(f"  {'probe-key skew':<15} {jskew}  "
+                       "(worst hot-bucket excess)")
+        if "numSegmentsPrunedByJoinKey" in stats:
+            out.append(f"  {'pruned by key':<15} "
+                       f"{int(float(stats['numSegmentsPrunedByJoinKey'] or 0)):>10}"
+                       "  (probe segments skipped by the build-key filter)")
+        if float(stats.get("joinServedHostTier") or 0):
+            out.append(f"  {'host-tier joins':<15} "
+                       f"{int(float(stats['joinServedHostTier'])):>10}  "
+                       "(admission gate priced the join off the device)")
     out.append("")
     out.append("counters")
     for key in ("numSegmentsQueried", "numSegmentsPruned",

@@ -17,8 +17,9 @@ from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
 from pinot_tpu.analysis import (admission_hygiene, blocking_in_loop,
                                 collective_hygiene, drift_guards,
                                 exception_hygiene, filter_path, fused_path,
-                                ingest_hot_loop, jit_hygiene, lock_discipline,
-                                memory_hygiene, transport_bypass)
+                                ingest_hot_loop, jit_hygiene, join_path,
+                                lock_discipline, memory_hygiene,
+                                transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
 
@@ -730,6 +731,76 @@ def test_fused_path_suppression_honored():
     """, fused_path.rules(), rel=_FUSED_REL)
     assert active == []
     assert _ids(suppressed) == ["fused-path-materialization"]
+
+
+# -- join-path-host-materialization -------------------------------------------
+
+_JOIN_REL = "pinot_tpu/engine/join_kernels.py"
+
+
+def test_join_path_fromiter_flagged():
+    active, _ = _check("""
+        import numpy as np
+        def codes_for(col):
+            return np.fromiter((hash(v) for v in col), dtype=np.uint64)
+    """, join_path.rules(), rel=_JOIN_REL)
+    assert _ids(active) == ["join-path-host-materialization"]
+
+
+def test_join_path_tolist_flagged():
+    active, _ = _check("""
+        def probe_candidates(cand):
+            return cand.tolist()
+    """, join_path.rules(), rel="pinot_tpu/multistage/runtime.py")
+    assert _ids(active) == ["join-path-host-materialization"]
+
+
+def test_join_path_device_get_flagged():
+    active, _ = _check("""
+        import jax
+        def fetch_mid_pipeline(buf):
+            return jax.device_get(buf)
+    """, join_path.rules(), rel=_JOIN_REL)
+    assert _ids(active) == ["join-path-host-materialization"]
+
+
+def test_join_path_vectorized_staging_is_clean():
+    active, _ = _check("""
+        import numpy as np
+        def fold_codes(codes):
+            return (codes ^ (codes >> np.uint64(33))).astype(np.uint32)
+    """, join_path.rules(), rel=_JOIN_REL)
+    assert active == []
+
+
+def test_join_path_slow_path_declaration_exempts():
+    active, _ = _check("""
+        import numpy as np
+        __graft_slow_paths__ = ("_hash_obj_rows",)
+
+        def _hash_obj_rows(arr):
+            return np.fromiter((hash(v) for v in arr), dtype=np.uint64)
+    """, join_path.rules(), rel="pinot_tpu/multistage/runtime.py")
+    assert active == []
+
+
+def test_join_path_outside_hot_modules_ignored():
+    active, _ = _check("""
+        import numpy as np
+        def frame_rows(arr):
+            return arr.tolist()
+    """, join_path.rules(), rel="pinot_tpu/multistage/shuffle.py")
+    assert active == []
+
+
+def test_join_path_suppression_honored():
+    active, suppressed = _check("""
+        def probe(cand):
+            # graftcheck: ignore[join-path-host-materialization] -- fixture
+            return cand.tolist()
+    """, join_path.rules(), rel=_JOIN_REL)
+    assert active == []
+    assert _ids(suppressed) == ["join-path-host-materialization"]
 
 
 # -- exception-hygiene --------------------------------------------------------

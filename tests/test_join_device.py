@@ -1,0 +1,309 @@
+"""Device hash-join fast path (PR 17): differential suite vs the host oracle.
+
+Every test forces the device path on (`configure_device_join(min_rows=0)`) and
+compares `hash_join` — the scatter/sort-merge kernels plus host verification —
+against `hash_join_host`, the numpy factorize oracle, as exact row multisets.
+Covers all six join types, null keys, dtype-promoted keys, the MV/mixed-object
+fallback, zipf probe skew (`joinSkewPct`), partitioned-exchange widths 1 and 8,
+the capacity-pinned admission degradation (`joinServedHostTier`), the JoinSpec
+JSON roundtrip for SEMI/ANTI, and `WHERE x IN (SELECT ...)` lowering against a
+sqlite oracle.
+"""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.multistage import execute_multistage
+from pinot_tpu.multistage.planner import JoinSpec
+from pinot_tpu.multistage.runtime import (_block_rows, _DEVICE_JOIN,
+                                          configure_device_join, hash_join,
+                                          hash_join_host, make_segment_scan,
+                                          spec_from_json, spec_to_json)
+from pinot_tpu.multistage.shuffle import _partition_join_input
+from pinot_tpu.query import stats as qstats
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.segment.reader import load_segment
+from pinot_tpu.segment.writer import SegmentBuilder
+
+JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti")
+
+
+@pytest.fixture(autouse=True)
+def _force_device_join():
+    saved = dict(_DEVICE_JOIN)
+    configure_device_join(enabled=True, min_rows=0)
+    yield
+    configure_device_join(**saved)
+
+
+def _rows_of(block):
+    """Canonical sorted row-tuples of a Block: None/NaN collapse to markers,
+    numerics compare as rounded floats (int vs float64 promotion must not
+    fail equality), everything else by repr."""
+    cols = sorted(block)
+    rows = []
+    for i in range(_block_rows(block)):
+        row = []
+        for c in cols:
+            v = block[c][i]
+            if v is None:
+                row.append("<null>")
+            elif isinstance(v, (float, np.floating)) and np.isnan(v):
+                row.append("<nan>")
+            elif isinstance(v, (int, float, np.integer, np.floating)):
+                row.append(round(float(v), 9))
+            else:
+                row.append(repr(v))
+        rows.append(tuple(row))
+    return sorted(rows, key=repr)
+
+
+def _assert_device_matches_host(left, right, spec, expect_device=True):
+    with qstats.collect_stats() as st:
+        dev = hash_join(left, right, spec)
+    host = hash_join_host(left, right, spec)
+    assert _rows_of(dev) == _rows_of(host), spec
+    ran_device = (qstats.JOIN_BUILD_MS in st.counters
+                  or qstats.JOIN_PROBE_MS in st.counters)
+    assert ran_device == expect_device, dict(st.counters)
+    return dev
+
+
+def _int_blocks(rng, n=1500, m=400, card=120):
+    left = {"lk": rng.integers(0, card, n).astype(np.int64),
+            "v": np.round(rng.uniform(0, 100, n), 3)}
+    right = {"rk": rng.integers(0, card, m).astype(np.int64),  # dup build keys
+             "w": np.round(rng.uniform(0, 100, m), 3)}
+    return left, right
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+def test_device_vs_host_int_keys(how):
+    rng = np.random.default_rng(17)
+    left, right = _int_blocks(rng)
+    spec = JoinSpec(right_alias="r", join_type=how,
+                    left_keys=["lk"], right_keys=["rk"])
+    _assert_device_matches_host(left, right, spec)
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+def test_device_vs_host_string_keys(how):
+    rng = np.random.default_rng(23)
+    univ = np.array([f"k{i}" for i in range(90)], dtype=object)
+    left = {"lk": univ[rng.integers(0, 90, 1200)],
+            "v": rng.integers(0, 50, 1200).astype(np.int32)}
+    right = {"rk": univ[rng.integers(0, 90, 300)],
+             "w": np.round(rng.uniform(0, 10, 300), 3)}
+    spec = JoinSpec(right_alias="r", join_type=how,
+                    left_keys=["lk"], right_keys=["rk"])
+    _assert_device_matches_host(left, right, spec)
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+def test_device_vs_host_null_keys(how):
+    """NaN keys never match: left/full/anti keep them null-extended (anti:
+    kept outright — NOT EXISTS semantics), inner/semi/right drop them."""
+    rng = np.random.default_rng(31)
+    lk = rng.integers(0, 60, 900).astype(np.float64)
+    lk[rng.random(900) < 0.15] = np.nan
+    rk = rng.integers(0, 60, 250).astype(np.float64)
+    rk[rng.random(250) < 0.15] = np.nan
+    left = {"lk": lk, "v": rng.integers(0, 9, 900).astype(np.int64)}
+    right = {"rk": rk, "w": rng.integers(0, 9, 250).astype(np.int64)}
+    spec = JoinSpec(right_alias="r", join_type=how,
+                    left_keys=["lk"], right_keys=["rk"])
+    _assert_device_matches_host(left, right, spec)
+
+
+@pytest.mark.parametrize("how", ("inner", "left", "semi", "anti"))
+def test_device_vs_host_dtype_promoted_keys(how):
+    """int32 probe keys joining float64 build keys (an upstream outer join
+    promoted one side): int 3 must meet double 3.0 on both paths."""
+    rng = np.random.default_rng(41)
+    left = {"lk": rng.integers(0, 80, 1000).astype(np.int32),
+            "v": np.round(rng.uniform(0, 5, 1000), 3)}
+    right = {"rk": rng.integers(0, 80, 200).astype(np.float64),
+             "w": np.round(rng.uniform(0, 5, 200), 3)}
+    spec = JoinSpec(right_alias="r", join_type=how,
+                    left_keys=["lk"], right_keys=["rk"])
+    _assert_device_matches_host(left, right, spec)
+
+
+def test_mv_and_mixed_object_keys_fall_back_to_host():
+    """Non-scalar (MV tuple cells) and mixed-type object key columns are not
+    vectorizable: `hash_join` must route to the host oracle — same rows, no
+    device kernel launches."""
+    rng = np.random.default_rng(47)
+    tuples = np.empty(600, dtype=object)
+    rtuples = np.empty(90, dtype=object)
+    for i in range(600):
+        tuples[i] = ("a", int(rng.integers(0, 30)))
+    for i in range(90):
+        rtuples[i] = ("a", int(rng.integers(0, 30)))
+    left = {"lk": tuples, "v": rng.integers(0, 9, 600).astype(np.int64)}
+    right = {"rk": rtuples, "w": rng.integers(0, 9, 90).astype(np.int64)}
+    spec = JoinSpec(right_alias="r", join_type="inner",
+                    left_keys=["lk"], right_keys=["rk"])
+    _assert_device_matches_host(left, right, spec, expect_device=False)
+
+    mixed = np.array([("s%d" % i) if i % 2 else i for i in range(400)],
+                     dtype=object)
+    left = {"lk": mixed, "v": np.arange(400, dtype=np.int64)}
+    right = {"rk": mixed[:100].copy(), "w": np.arange(100, dtype=np.int64)}
+    _assert_device_matches_host(left, right, spec, expect_device=False)
+
+
+def test_zipf_probe_skew_records_join_skew_pct():
+    """A zipf-heavy probe side must light up the kernels' fold-bucket
+    histogram (`joinSkewPct` > 0) while the joined rows stay oracle-exact."""
+    rng = np.random.default_rng(53)
+    card = 64
+    p = np.arange(1, card + 1, dtype=np.float64) ** -1.6
+    p /= p.sum()
+    left = {"lk": rng.choice(card, 6000, p=p).astype(np.int64),
+            "v": rng.integers(0, 9, 6000).astype(np.int64)}
+    right = {"rk": np.arange(card, dtype=np.int64),
+             "w": rng.integers(0, 9, card).astype(np.int64)}
+    spec = JoinSpec(right_alias="r", join_type="inner",
+                    left_keys=["lk"], right_keys=["rk"])
+    with qstats.collect_stats() as st:
+        dev = hash_join(left, right, spec)
+    assert st.counters.get(qstats.JOIN_SKEW_PCT, 0.0) > 0.0, \
+        dict(st.counters)
+    assert _rows_of(dev) == _rows_of(hash_join_host(left, right, spec))
+
+
+@pytest.mark.parametrize("p", (1, 8))
+def test_partitioned_exchange_widths(p):
+    """Hash-partition both sides across `p` workers (the mailbox-exchange
+    shape), device-join every co-partition with its staged codes, and the
+    union must equal the whole-block host join — width 1 and width 8."""
+    rng = np.random.default_rng(59 + p)
+    left, right = _int_blocks(rng, n=2200, m=500, card=150)
+    spec = JoinSpec(right_alias="r", join_type="inner",
+                    left_keys=["lk"], right_keys=["rk"])
+    lparts, _ = _partition_join_input(left, ["lk"], p, "partitioned", "L")
+    rparts, _ = _partition_join_input(right, ["rk"], p, "partitioned", "R")
+    got = []
+    for lp, rp in zip(lparts, rparts):
+        j = hash_join(lp.block, rp.block, spec,
+                      lcodes=lp.codes, rcodes=rp.codes)
+        got.extend(_rows_of(j))
+    assert sorted(got, key=repr) == _rows_of(
+        hash_join_host(left, right, spec))
+
+
+def test_broadcast_exchange_equals_partitioned():
+    """Broadcast (replicated build, strip-split probe) must produce the same
+    multiset as the partitioned exchange on the same inputs."""
+    rng = np.random.default_rng(67)
+    left, right = _int_blocks(rng, n=1800, m=120, card=80)
+    spec = JoinSpec(right_alias="r", join_type="inner",
+                    left_keys=["lk"], right_keys=["rk"])
+    got = []
+    lparts, _ = _partition_join_input(left, ["lk"], 4, "broadcast", "L")
+    rparts, _ = _partition_join_input(right, ["rk"], 4, "broadcast", "R")
+    for lp, rp in zip(lparts, rparts):
+        j = hash_join(lp.block, rp.block, spec,
+                      lcodes=lp.codes, rcodes=rp.codes)
+        got.extend(_rows_of(j))
+    assert sorted(got, key=repr) == _rows_of(
+        hash_join_host(left, right, spec))
+
+
+def test_capacity_pinned_admission_degrades_to_host(monkeypatch):
+    """With HBM capacity pinned to a few hundred bytes the admission gate
+    must price the join off the device (`joinServedHostTier`), serve it from
+    the host oracle, and stay deterministic across runs."""
+    from pinot_tpu.utils.memledger import reset_ledger
+
+    monkeypatch.setenv("PINOT_TPU_HBM_CAPACITY_BYTES", "1000")
+    reset_ledger()
+    try:
+        rng = np.random.default_rng(71)
+        left, right = _int_blocks(rng, n=3000, m=600, card=40)  # dup-heavy
+        spec = JoinSpec(right_alias="r", join_type="inner",
+                        left_keys=["lk"], right_keys=["rk"])
+        with qstats.collect_stats() as st:
+            out1 = hash_join(left, right, spec)
+        assert st.counters.get(qstats.JOIN_SERVED_HOST_TIER, 0) >= 1, \
+            dict(st.counters)
+        out2 = hash_join(left, right, spec)
+        assert _rows_of(out1) == _rows_of(out2)        # same-seed determinism
+        assert _rows_of(out1) == _rows_of(hash_join_host(left, right, spec))
+    finally:
+        monkeypatch.delenv("PINOT_TPU_HBM_CAPACITY_BYTES")
+        reset_ledger()
+
+
+@pytest.mark.parametrize("how", ("semi", "anti"))
+def test_join_spec_json_roundtrip_semi_anti(how):
+    spec = JoinSpec(right_alias="__in0", join_type=how,
+                    left_keys=["o.cust_id"], right_keys=["__in0.cust_id"])
+    rt = spec_from_json(spec_to_json(spec))
+    assert (rt.right_alias, rt.join_type, rt.left_keys, rt.right_keys,
+            rt.residual) == ("__in0", how, ["o.cust_id"],
+                             ["__in0.cust_id"], None)
+
+
+# -- IN (SELECT ...) lowering vs sqlite --------------------------------------
+
+ORDERS_SCHEMA = Schema("orders", [
+    dimension("cust_id"), metric("qty", DataType.INT),
+    metric("amount", DataType.DOUBLE)])
+CUSTS_SCHEMA = Schema("custs", [
+    dimension("cust_id"), dimension("region"), metric("tier", DataType.INT)])
+
+
+@pytest.fixture(scope="module")
+def subquery_env(tmp_path_factory):
+    rng = np.random.default_rng(83)
+    n, m = 1200, 60
+    orders = {"cust_id": [f"c{i}" for i in rng.integers(0, 80, n)],
+              "qty": rng.integers(1, 20, n).astype(np.int32),
+              "amount": np.round(rng.uniform(1, 200, n), 2)}
+    custs = {"cust_id": [f"c{i}" for i in range(m)],  # c60..c79 dangle
+             "region": [["east", "west"][i % 2] for i in range(m)],
+             "tier": rng.integers(1, 4, m).astype(np.int32)}
+    tmp = tmp_path_factory.mktemp("insub")
+    o_seg = load_segment(SegmentBuilder(ORDERS_SCHEMA).build(
+        dict(orders), str(tmp), "o_0"))
+    c_seg = load_segment(SegmentBuilder(CUSTS_SCHEMA).build(
+        dict(custs), str(tmp), "c_0"))
+    scan = make_segment_scan({"orders": [o_seg], "custs": [c_seg]})
+    schema_for = {"orders": ORDERS_SCHEMA, "custs": CUSTS_SCHEMA}.get
+    db = sqlite3.connect(":memory:")
+    db.execute("CREATE TABLE orders (cust_id TEXT, qty INTEGER, amount REAL)")
+    db.execute("CREATE TABLE custs (cust_id TEXT, region TEXT, tier INTEGER)")
+    db.executemany("INSERT INTO orders VALUES (?,?,?)",
+                   list(zip(orders["cust_id"], orders["qty"].tolist(),
+                            orders["amount"].tolist())))
+    db.executemany("INSERT INTO custs VALUES (?,?,?)",
+                   list(zip(custs["cust_id"], custs["region"],
+                            custs["tier"].tolist())))
+    return scan, schema_for, db
+
+
+@pytest.mark.parametrize("neg", (False, True))
+def test_in_subquery_lowers_to_semi_anti_vs_sqlite(subquery_env, neg):
+    scan, schema_for, db = subquery_env
+    op = "NOT IN" if neg else "IN"
+    sql = (f"SELECT COUNT(*), SUM(amount) FROM orders WHERE qty > 3 AND "
+           f"cust_id {op} (SELECT cust_id FROM custs WHERE tier = 2) LIMIT 5")
+    want = db.execute(sql.replace(" LIMIT 5", "")).fetchone()
+    got = execute_multistage(sql, scan, schema_for).rows[0]
+    assert got[0] == want[0]
+    assert abs(got[1] - (want[1] or 0.0)) <= 1e-6 * max(1.0, abs(want[1] or 0))
+
+
+def test_in_subquery_grouped_vs_sqlite(subquery_env):
+    scan, schema_for, db = subquery_env
+    sql = ("SELECT cust_id, COUNT(*) FROM orders WHERE cust_id IN "
+           "(SELECT cust_id FROM custs WHERE region = 'east') "
+           "GROUP BY cust_id LIMIT 1000")
+    want = sorted(db.execute(sql.replace(" LIMIT 1000", "")).fetchall())
+    got = sorted((r[0], r[1]) for r in
+                 execute_multistage(sql, scan, schema_for).rows)
+    assert got == want
