@@ -1,0 +1,191 @@
+"""unbounded-keyed-accumulation: query-keyed state must have a bound.
+
+A long-running broker/controller process accumulates per-key state — per-table
+rollups, per-shape profiles, per-segment sequences. When the key space is
+driven by *queries* (fingerprints, SQL text, user-supplied names), the map
+grows without bound: the exact bug class a workload/fingerprint registry
+invites. This pack makes the bound a static property:
+
+* `unbounded-keyed-accumulation` — an instance-attribute dict/list/set in a
+  `cluster/` or `query/` module that has a dynamic-keyed growth site
+  (`self.x[key] = ...` / `.setdefault(key, ...)` / `.append(...)` /
+  `.add(...)`) but NO shrink or bound site anywhere in the class (`pop` /
+  `popitem` / `clear` / `remove` / `discard` / `del self.x[...]` /
+  reassignment outside the defining method / a `len(self.x)` bound check).
+  `deque(...)`-initialized attributes are exempt (bounded by `maxlen` at the
+  construction site, where a reviewer can see it). Intentional unbounded maps
+  (key space bounded elsewhere, e.g. by cluster topology) suppress with a
+  rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+#: layers that hold long-lived per-key state driven by query traffic
+_SCOPED_PREFIXES = ("pinot_tpu/cluster/", "pinot_tpu/query/")
+
+#: constructors that create a growable container
+_CONTAINER_CALLS = ("dict", "list", "set", "OrderedDict", "defaultdict",
+                    "collections.OrderedDict", "collections.defaultdict")
+
+#: constructors bounded at the construction site
+_BOUNDED_CALLS = ("deque", "collections.deque")
+
+_SHRINK_METHODS = ("pop", "popitem", "clear", "remove", "discard",
+                   "popleft")
+
+_GROW_METHODS = ("setdefault", "append", "add", "extend", "insert")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.x` -> "x", else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_container_ctor(value: ast.AST) -> Optional[bool]:
+    """True: growable container literal/ctor. False: bounded (deque).
+    None: neither (not a container initialization)."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name in _BOUNDED_CALLS:
+            return False
+        if name in _CONTAINER_CALLS:
+            return True
+    return None
+
+
+class _ClassState:
+    """Per-class accumulation facts, filled in one walk."""
+
+    def __init__(self) -> None:
+        self.containers: Dict[str, int] = {}   # attr -> init line
+        self.bounded: Set[str] = set()         # deque-init or len() bound
+        self.init_funcs: Dict[str, str] = {}   # attr -> defining method
+        self.assign_funcs: Dict[str, Set[str]] = {}  # attr -> methods assigning
+        self.grow: Dict[str, int] = {}         # attr -> first growth line
+        self.shrink: Set[str] = set()
+
+
+def _enclosing_func(node: ast.AST) -> str:
+    cur = getattr(node, "graft_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = getattr(cur, "graft_parent", None)
+    return "<class body>"
+
+
+def _scan_class(cls: ast.ClassDef) -> _ClassState:
+    st = _ClassState()
+    for node in ast.walk(cls):
+        # container initializations + reassignments
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None:
+                continue
+            kind = _is_container_ctor(value)
+            if kind is True and attr not in st.containers:
+                st.containers[attr] = node.lineno
+                st.init_funcs[attr] = _enclosing_func(node)
+            elif kind is False:
+                st.bounded.add(attr)
+            st.assign_funcs.setdefault(attr, set()).add(
+                _enclosing_func(node))
+        # keyed growth: self.x[<dynamic>] = ...  (growth inside __init__ is a
+        # construction-time build from a dataset, not runtime accumulation)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else \
+                [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None \
+                            and not isinstance(t.slice, ast.Constant) \
+                            and _enclosing_func(t) != "__init__":
+                        st.grow.setdefault(attr, t.lineno)
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    if func.attr in _SHRINK_METHODS:
+                        st.shrink.add(attr)
+                    elif func.attr in _GROW_METHODS and \
+                            _enclosing_func(node) != "__init__":
+                        # setdefault with a constant key is a fixed-slot
+                        # rollup, not keyed accumulation
+                        if func.attr == "setdefault" and node.args and \
+                                isinstance(node.args[0], ast.Constant):
+                            continue
+                        st.grow.setdefault(attr, node.lineno)
+        # `del self.x[...]` shrinks
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        st.shrink.add(attr)
+        # a `len(self.x)` comparison anywhere is a bound check (the LRU /
+        # spill-on-cap idiom: `while len(self._shapes) > cap: ... popitem`)
+        if isinstance(node, (ast.Compare, ast.While, ast.If)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        dotted_name(sub.func) == "len" and sub.args:
+                    attr = _self_attr(sub.args[0])
+                    if attr is not None:
+                        st.bounded.add(attr)
+    return st
+
+
+class UnboundedKeyedAccumulationRule(Rule):
+    id = "unbounded-keyed-accumulation"
+    description = ("an instance dict/list/set in cluster/ or query/ grows "
+                   "under dynamic keys with no eviction, bound check, or "
+                   "rebuild anywhere in the class — a query-keyed leak in a "
+                   "long-running process")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        if not module.rel.startswith(_SCOPED_PREFIXES):
+            return ()
+        out: List[Finding] = []
+        for cls in module.nodes_of(ast.ClassDef):
+            st = _scan_class(cls)
+            for attr, grow_line in sorted(st.grow.items(),
+                                          key=lambda kv: kv[1]):
+                if attr not in st.containers or attr in st.bounded \
+                        or attr in st.shrink:
+                    continue
+                # reassigned outside the defining method: the replace/rebuild
+                # idiom (`self.x = new_map` each refresh) bounds it
+                funcs = st.assign_funcs.get(attr, set())
+                if len(funcs - {st.init_funcs.get(attr)}) > 0:
+                    continue
+                out.append(Finding(
+                    self.id, module.rel, grow_line,
+                    f"`self.{attr}` (initialized line "
+                    f"{st.containers[attr]}) accumulates under dynamic "
+                    "keys with no pop/clear/del/len-bound/rebuild in "
+                    f"class `{cls.name}` — bound it (LRU/cap + overflow "
+                    "counter) or evict on the owning lifecycle event"))
+        return out
+
+
+def rules() -> List[Rule]:
+    return [UnboundedKeyedAccumulationRule()]

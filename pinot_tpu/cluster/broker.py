@@ -251,6 +251,10 @@ class Broker:
         # rounds avoid these servers instead of amplifying their overload
         self._backpressure_until: Dict[str, float] = {}
         self.failure_detector = FailureDetector(self.routing)
+        # workload intelligence plane: per-shape profiles keyed by plan
+        # fingerprint, LRU-bounded with overflow counters (/debug/workload)
+        from .workload import WorkloadRegistry
+        self.workload = WorkloadRegistry(catalog)
         catalog.register_instance(InstanceInfo(instance_id, "broker"))
 
     def register_server_handle(self, server_id: str, handle: ServerHandle,
@@ -309,6 +313,7 @@ class Broker:
         t0 = time.perf_counter()
         tr = None
         table = None
+        shape = None
         # in-flight depth is the admission state machine's primary signal;
         # begin/end bracket the WHOLE request so multistage joins count too
         self.admission.begin()
@@ -319,6 +324,7 @@ class Broker:
                     stmt = parse_query(sql)
                 stmt = self._rewrite_subqueries(stmt)
                 table = stmt.table
+                shape = self._plan_shape(stmt)
                 trace_on = _truthy(stmt.options.get("trace"))
                 # always-on: the trace records regardless, the sampler only
                 # gates ring retention; OPTION(trace=true) force-samples AND
@@ -345,18 +351,35 @@ class Broker:
                     self._table_account(table, elapsed_ms, error=True)
                 if tr is not None and tr.sampled:
                     # errored traces tail-retain so failures are inspectable
-                    self.trace_ring.admit(tr, sql=sql, error=True,
-                                          timeUsedMs=round(elapsed_ms, 3),
-                                          memory=self._memory_samples(elapsed_ms))
+                    meta = dict(sql=sql, error=True,
+                                timeUsedMs=round(elapsed_ms, 3),
+                                memory=self._memory_samples(elapsed_ms))
+                    if shape is not None:
+                        meta["workloadFingerprint"] = shape.fingerprint
+                    self.trace_ring.admit(tr, **meta)
                 raise
         finally:
             self.admission.end()
         elapsed_ms = (time.perf_counter() - t0) * 1000
         result.stats["timeUsedMs"] = round(elapsed_ms, 3)
+        if shape is not None:
+            result.stats[qstats.WORKLOAD_FINGERPRINT] = shape.fingerprint
         reg.counter("pinot_broker_queries").inc()
         reg.timer("pinot_broker_query_latency_ms").update(elapsed_ms)
-        self._account_query(sql, result, elapsed_ms, tr=tr, table=table)
+        self._account_query(sql, result, elapsed_ms, tr=tr, table=table,
+                            shape=shape)
         return result
+
+    @staticmethod
+    def _plan_shape(stmt):
+        """Normalize the parsed plan into its PlanShape (sql/fingerprint.py).
+        Best-effort: fingerprinting must never fail a query, so an exotic
+        statement the normalizer chokes on just goes unprofiled."""
+        from ..sql.fingerprint import fingerprint_statement
+        try:
+            return fingerprint_statement(stmt)
+        except Exception:
+            return None
 
     # log channel for queries over the `broker.slow.query.ms` threshold: one
     # machine-parseable JSON object per slow query (reference: the slow-query
@@ -406,11 +429,12 @@ class Broker:
                                 snap["transientPeakBytes"]}}]
 
     def _account_query(self, sql: str, result: ResultTable,
-                       elapsed_ms: float, tr=None, table=None) -> None:
+                       elapsed_ms: float, tr=None, table=None,
+                       shape=None) -> None:
         """Per-query bookkeeping after a successful response: rollups for
-        /debug, per-table resource attribution, trace-ring retention, plus
-        the slow-query log when over threshold (exactly one structured line
-        per slow query)."""
+        /debug, per-table resource attribution, workload-shape profiling,
+        trace-ring retention, plus the slow-query log when over threshold
+        (exactly one structured line per slow query)."""
         with self._obs_lock:
             self._query_rollup["numQueries"] += 1
             self._query_rollup["totalTimeMs"] += elapsed_ms
@@ -420,12 +444,16 @@ class Broker:
         slow = thr is not None and elapsed_ms > thr
         if table:
             self._table_account(table, elapsed_ms, result=result, slow=slow)
+        if shape is not None:
+            self.workload.observe(shape, elapsed_ms, result.stats)
         if tr is not None and (tr.sampled or slow):
             # head-sampled OR tail-retained (slow): land in the bounded ring
             # behind GET /debug/traces
-            self.trace_ring.admit(tr, sql=sql, slow=slow,
-                                  timeUsedMs=round(elapsed_ms, 3),
-                                  memory=self._memory_samples(elapsed_ms))
+            meta = dict(sql=sql, slow=slow, timeUsedMs=round(elapsed_ms, 3),
+                        memory=self._memory_samples(elapsed_ms))
+            if shape is not None:
+                meta["workloadFingerprint"] = shape.fingerprint
+            self.trace_ring.admit(tr, **meta)
         if not slow:
             return
         entry = {
@@ -436,6 +464,9 @@ class Broker:
             "stats": {k: v for k, v in result.stats.items()
                       if isinstance(v, (int, float, bool, str))},
         }
+        if shape is not None:
+            # joinable against /debug/workload without re-parsing the SQL
+            entry["workloadFingerprint"] = shape.fingerprint
         trace_rows = result.stats.get("traceInfo")
         if trace_rows:
             entry["traceSpans"] = trace_rows
@@ -563,6 +594,7 @@ class Broker:
             "traceRing": {"retained": len(self.trace_ring),
                           "capacity": self.trace_ring.capacity,
                           "sampleRate": self._trace_sample_rate()},
+            "workload": self.workload.summary(),
             "brokerMetrics": {k: v for k, v in sorted(snap.items())
                               if k.startswith("pinot_broker_")},
             "failureDetector": self.failure_detector.snapshot(),

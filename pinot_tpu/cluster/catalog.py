@@ -137,6 +137,8 @@ class Catalog:
     def subscribe(self, fn: Callable[[str, str], None]) -> None:
         """fn(event, table); events: ideal_state, external_view, table, schema, instance."""
         with self._lock:
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- one entry
+            # per subscribing component at wiring time, not query-driven
             self._watchers.append(fn)
 
     def _notify(self, event: str, table: str) -> None:

@@ -219,6 +219,10 @@ class LLCSegmentManager:
             counts = compute_counts(self.catalog.ideal_state.get(table, {}))
             chosen = balanced_assign(name, servers, cfg.replication, counts)
         self.catalog.update_ideal_state(table, {name: {s: CONSUMING for s in chosen}})
+        # graftcheck: ignore[unbounded-keyed-accumulation] -- keyed by LLC
+        # segment name: catalog lifecycle objects created by this manager at
+        # partition cadence, not query traffic; DONE FSMs are the crash-replay
+        # record the completion protocol re-answers duplicate commits from
         self.fsms[name] = CompletionFSM(name, num_replicas=len(chosen))
         return name
 

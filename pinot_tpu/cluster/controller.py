@@ -97,6 +97,16 @@ class Controller:
         self.memory_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
         self.scheduler.register(PeriodicTask("MemoryStatusChecker", 60.0,
                                              self.run_memory_check))
+        # workload regression sentinel: the per-shape generalization of the
+        # SLO plane — windowed burn of each plan fingerprint's overBaseline
+        # counter from the brokers' /debug/workload registries
+        self._workload_status: Dict[str, object] = {}
+        self._workload_samples: Dict[str, object] = {}  # fingerprint -> deque
+        # in-proc clusters register Broker.workload.snapshot directly;
+        # OS-process brokers are discovered via GET /debug/workload
+        self.workload_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
+        self.scheduler.register(PeriodicTask("WorkloadSentinel", 60.0,
+                                             self.run_workload_check))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -714,6 +724,158 @@ class Controller:
                 "message": ("no query traffic observed yet" if configured else
                             "no SLO targets in cluster config")}
 
+    # -- workload regression sentinel (per-shape SLO burn over plan
+    # fingerprints: which query SHAPE regressed, not just which table) ------
+
+    #: per-shape violation budget: a healthy shape is allowed this fraction
+    #: of queries over `baselineMs * workload.baseline.multiplier`
+    #: (override: `workload.sentinel.budget`; <= 0 disables the sentinel)
+    WORKLOAD_SENTINEL_BUDGET = 0.01
+
+    def _iter_workload_pollers(self):
+        """(broker_id, poll fn) for every reachable broker's workload
+        registry: in-proc pollers first, then advertised HTTP brokers via
+        their GET /debug/workload route."""
+        seen = set()
+        for bid, poll in list(self.workload_pollers.items()):
+            seen.add(bid)
+            yield bid, poll
+        for info in list(self.catalog.instances.values()):
+            if info.role != "broker" or not info.port or not info.alive \
+                    or info.instance_id in seen:
+                continue
+
+            def poll(url=info.url):
+                from .http_service import get_json
+                return get_json(f"{url}/debug/workload", timeout=5.0,
+                                retries=1)
+            yield info.instance_id, poll
+
+    def run_workload_check(self, now: Optional[float] = None
+                           ) -> Dict[str, str]:
+        """Periodic per-shape regression evaluation: sample every broker's
+        cumulative per-fingerprint `count` / `overBaseline` counters, burn
+        them against the sentinel budget over the shared SLO fast/slow
+        windows, and publish a verdict per fingerprint — DEGRADED/UNHEALTHY
+        reasons NAME the offending fingerprint so the operator can drill into
+        `/debug/workload?fp=`. `now` is injectable for synthetic timelines."""
+        from collections import deque
+
+        from ..utils.metrics import get_registry
+        reg = get_registry()
+        now = time.time() if now is None else float(now)
+        budget = self._cluster_config_float(
+            "workload.sentinel.budget", self.WORKLOAD_SENTINEL_BUDGET)
+        if budget is None or budget <= 0:
+            # sentinel disabled: tear the plane down
+            reg.remove_gauge("pinot_controller_workload_regressing_shapes")
+            self._workload_samples.clear()
+            self._workload_status = {}
+            return {}
+        fast_s = self._cluster_config_float("slo.window.fast.s", 300.0)
+        slow_s = self._cluster_config_float("slo.window.slow.s", 3600.0)
+
+        # aggregate cumulative per-shape counters across brokers
+        totals: Dict[str, Dict[str, object]] = {}
+        unreachable: List[str] = []
+        for bid, poll in self._iter_workload_pollers():
+            try:
+                snap = poll()
+            except Exception:
+                unreachable.append(bid)
+                continue
+            for shape in (snap.get("shapes") or []):
+                fp = shape.get("fingerprint")
+                if not fp:
+                    continue
+                agg = totals.setdefault(fp, {
+                    "count": 0.0, "overBaseline": 0.0, "totalTimeMs": 0.0,
+                    "baselineMs": 0.0, "canonical": shape.get("canonical"),
+                    "tables": shape.get("tables") or []})
+                for k in ("count", "overBaseline", "totalTimeMs"):
+                    v = shape.get(k)
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        agg[k] += float(v)
+                agg["baselineMs"] = max(agg["baselineMs"],
+                                        float(shape.get("baselineMs") or 0.0))
+
+        prev = self._workload_status.get("regressions") or {}
+        regressions: Dict[str, Dict[str, object]] = {}
+        verdicts: Dict[str, str] = {}
+        for fp, agg in totals.items():
+            samples = self._workload_samples.setdefault(
+                fp, deque(maxlen=256))
+            samples.append((now, {"count": agg["count"],
+                                  "overBaseline": agg["overBaseline"]}))
+
+            def window_delta(window_s):
+                # delta vs the OLDEST sample inside the window (zero when
+                # only the sample just taken is inside — no judgement before
+                # a second observation lands)
+                cutoff = now - window_s
+                for ts, base in samples:
+                    if ts >= cutoff:
+                        return {k: agg[k] - base[k] for k in base}
+                return {"count": 0.0, "overBaseline": 0.0}
+
+            def burn(delta):
+                n = delta["count"]
+                if n <= 0:
+                    return 0.0   # zero traffic burns no budget
+                return round((delta["overBaseline"] / n) / budget, 3)
+
+            bf = burn(window_delta(fast_s))
+            bs = burn(window_delta(slow_s))
+            verdict = "HEALTHY"
+            if bf >= self.SLO_PAGE_BURN_RATE:
+                verdict = "UNHEALTHY"
+            elif bf > 1.0 and bs > 1.0:
+                verdict = "DEGRADED"
+            verdicts[fp] = verdict
+            if verdict == "HEALTHY":
+                continue
+            regressions[fp] = {
+                "state": verdict,
+                "reason": f"shape {fp} over-baseline burn {bf:g}x fast / "
+                          f"{bs:g}x slow (baseline "
+                          f"{agg['baselineMs']:g}ms)",
+                "burnFast": bf, "burnSlow": bs,
+                "count": agg["count"], "overBaseline": agg["overBaseline"],
+                "baselineMs": agg["baselineMs"],
+                "canonical": agg["canonical"], "tables": agg["tables"],
+            }
+            if fp not in prev:
+                # HEALTHY -> regressing transition: one tick per regression
+                reg.counter(
+                    "pinot_broker_workload_shape_regressions").inc()
+
+        # prune fingerprints no longer reported (evicted/restarted brokers)
+        for fp in list(self._workload_samples):
+            if fp not in totals:
+                self._workload_samples.pop(fp)
+        reg.gauge("pinot_controller_workload_regressing_shapes").set(
+            len(regressions))
+        state = "HEALTHY"
+        if any(r["state"] == "UNHEALTHY" for r in regressions.values()):
+            state = "UNHEALTHY"
+        elif regressions:
+            state = "DEGRADED"
+        self._workload_status = {
+            "state": state,
+            "budget": budget,
+            "windowsS": {"fast": fast_s, "slow": slow_s},
+            "shapesTracked": len(totals),
+            "reasons": sorted(r["reason"] for r in regressions.values()),
+            "regressions": regressions,
+            "unreachableBrokers": sorted(unreachable),
+        }
+        return verdicts
+
+    def workload_status(self) -> Dict[str, object]:
+        """The sentinel's last verdict (surfaced in controller /debug as
+        `workloadStatus`); empty until the first check runs."""
+        return dict(self._workload_status)
+
     # -- device-memory plane (the cluster view over per-server HBM ledgers) --
 
     _MEMORY_TABLE_GAUGES = ("pinot_controller_hbm_healthy",
@@ -882,6 +1044,7 @@ class Controller:
                                 for t, s in self._ingestion_status.items()},
             "sloStatus": dict(self._slo_status),
             "memoryStatus": dict(self._memory_status),
+            "workloadStatus": dict(self._workload_status),
             "controllerMetrics": {k: v for k, v in reg.snapshot().items()
                                   if k.startswith(("pinot_controller",
                                                    "pinot_periodic"))},

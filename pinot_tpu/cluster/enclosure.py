@@ -49,6 +49,10 @@ class QuickCluster:
             # same shape for /debug/memory: the memory status checker reads
             # each server's HBM residency ledger rollup
             self.controller.memory_pollers[s.instance_id] = s.memory_snapshot
+        # in-proc analog of GET /debug/workload: the regression sentinel
+        # reads the broker's per-shape workload registry directly
+        self.controller.workload_pollers[self.broker.instance_id] = \
+            self.broker.workload.snapshot
         from ..minion.tasks import MinionWorker
         self.minion = MinionWorker("minion_0", self.catalog, self.deepstore,
                                    self.controller,
@@ -69,6 +73,8 @@ class QuickCluster:
         table = table_config.table_name_with_type
         schema = self.catalog.schemas[table_config.name]
         seq = self._seg_seq.get(table, 0)
+        # graftcheck: ignore[unbounded-keyed-accumulation] -- one counter per
+        # table in a test-fixture cluster; dies with the fixture
         self._seg_seq[table] = seq + 1
         name = segment_name or f"{table_config.name}_{seq}"
         idx = table_config.indexing

@@ -415,6 +415,8 @@ class HdfsStub:
         cur = ""
         for p in parts:
             cur += "/" + p
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- in-memory
+            # filesystem stub: the directory tree IS the stored dataset
             self.dirs.add(cur)
 
     def _is_dir(self, path: str) -> bool:

@@ -288,11 +288,19 @@ class HttpService:
         request body is consumed — the generator reads request frames and
         yields response frames concurrently on one exchange (mux streams);
         the pre-response body drain is skipped."""
+        # graftcheck: ignore[unbounded-keyed-accumulation] -- route table:
+        # one entry per route() call at service wiring time, not query-driven
         self._routes[(method, head)] = handler
+        # graftcheck: ignore[unbounded-keyed-accumulation] -- same wiring-time
+        # key space as the route table above
         self._actions[(method, head)] = action
         if stream_body:
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- subset of
+            # the wiring-time route table
             self._stream_body.add((method, head))
         if duplex:
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- subset of
+            # the wiring-time route table
             self._duplex.add((method, head))
 
     def _authenticate(self, method: str, head: str, headers) -> None:

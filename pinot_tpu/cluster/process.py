@@ -468,6 +468,8 @@ class ProcessCluster:
             cmd += ["--config", self._config_path]
         with open(os.path.join(self.run_dir, f"{name}.log"), "wb") as log:
             # the child holds its own dup of the fd; close the parent's copy
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- one handle
+            # per launched OS process (cluster topology, reaped on stop)
             self.procs[name] = subprocess.Popen(
                 cmd, env=self._env, stdout=log, stderr=subprocess.STDOUT)
 

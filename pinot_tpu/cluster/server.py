@@ -676,6 +676,8 @@ class ServerNode:
             return
         with self._lock:
             prev = self._table_bytes_ewma.get(table)
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- one float
+            # per table this server hosts (topology-bounded key space)
             self._table_bytes_ewma[table] = b if prev is None else \
                 prev + self._BYTES_EWMA_ALPHA * (b - prev)
 

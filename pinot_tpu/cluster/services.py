@@ -1245,7 +1245,22 @@ class BrokerService:
         """GET /debug — broker query rollups. GET /debug/traces — the retained
         (sampled + slow) trace ring: `?id=<traceId>` resolves one trace (404
         when evicted/unknown), `?limit=N` bounds the listing, `?format=chrome`
-        renders a Chrome trace-event document loadable in Perfetto."""
+        renders a Chrome trace-event document loadable in Perfetto.
+        GET /debug/workload — the workload registry: per-shape profiles
+        ranked by time share (`?k=N` trims the ranking, `?fp=<fingerprint>`
+        drills into one shape, 404 when unknown/evicted)."""
+        if parts and parts[0] == "workload":
+            fp = params.get("fp")
+            if fp:
+                prof = self.broker.workload.shape(fp)
+                if prof is None:
+                    return error_response(f"unknown shape {fp}", 404)
+                return json_response(prof)
+            try:
+                k = int(params["k"]) if "k" in params else None
+            except (TypeError, ValueError):
+                k = None
+            return json_response(self.broker.workload.snapshot(k))
         if parts and parts[0] == "traces":
             from ..utils.trace import to_chrome_trace
             ring = self.broker.trace_ring

@@ -99,8 +99,12 @@ class _PartsWriter:
         """Append a large payload as its own part (no copy); flushes the
         scalar accumulator first to preserve byte order."""
         if self._buf:
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- response-
+            # scoped writer: parts live exactly as long as one encode
             self._parts.append(self._buf)
             self._buf = bytearray()
+        # graftcheck: ignore[unbounded-keyed-accumulation] -- response-scoped
+        # writer: parts live exactly as long as one encode
         self._parts.append(mv)
 
     def parts(self) -> List[Buffer]:

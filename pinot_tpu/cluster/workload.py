@@ -1,0 +1,320 @@
+"""Broker-side workload intelligence: per-shape profiles over plan fingerprints.
+
+Every successful query lands in the WorkloadRegistry under its 16-hex plan
+fingerprint (sql/fingerprint.py). The registry is a bounded LRU
+(`broker.workload.max.shapes`, default 512) with overflow counters — when a
+shape is evicted its query count moves into `evictedQueries`, so
+`sum(per-shape counts) + evictedQueries == totalQueries` and
+`shapesEvicted + shapesResident == shapesSeen` hold at all times (no silent
+truncation).
+
+Each profile aggregates: query count, a rotating-window latency histogram
+(utils.metrics.Histogram.recent_percentile), bytes fetched, rows scanned,
+segments queried/pruned, device launches vs host-tier serves, the
+fused/staged/join-strategy mix, per-slot literal cardinality, and the
+**cacheability signal**: the tables the shape reads plus a segment-version
+vector — catalog segment lifecycle events (upload/commit/evict/demote/drop)
+bump a per-table version counter, so the profile reports how many times the
+shape's inputs changed since it was last seen. The ROADMAP result-cache item
+keys on exactly "(normalized plan, segment-version vector)".
+
+The regression sentinel (controller.run_workload_check) reads the per-shape
+cumulative `count` / `overBaseline` counters from `/debug/workload`:
+`overBaseline` counts queries slower than `baselineMs * multiplier`, where
+`baselineMs` is a rolling EWMA updated only by non-violating samples after a
+warmup — so a regressed shape keeps violating instead of absorbing the
+regression into its own baseline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.metrics import Histogram
+
+#: distinct literal values tracked per parameter slot before the slot is
+#: marked overflowed (cardinality reported as "> cap" instead of growing an
+#: unbounded set per shape)
+SLOT_VALUE_CAP = 32
+
+#: numeric stat keys aggregated per shape from each query's ExecutionStats
+_SUM_KEYS = (
+    ("bytesFetched", "bytesFetched"),
+    ("rowsScanned", "numDocsScanned"),
+    ("segmentsQueried", "numSegmentsQueried"),
+    ("segmentsPruned", "numSegmentsPruned"),
+    ("deviceLaunches", "deviceLaunches"),
+    ("hostTierServes", "segmentsServedHostTier"),
+    ("fusedLaunches", "fusedLaunches"),
+    ("stagedLaunches", "stagedLaunches"),
+)
+
+
+class ShapeProfile:
+    """Aggregated profile of one plan shape (all mutation under the registry
+    lock; the latency histogram carries its own lock)."""
+
+    __slots__ = ("fingerprint", "canonical", "tables", "count", "totalTimeMs",
+                 "maxTimeMs", "hist", "sums", "joinStrategies", "slots",
+                 "firstSeenTs", "lastSeenTs", "versionsLastSeen",
+                 "inputChanges", "baselineMs", "overBaseline", "warmupLeft")
+
+    def __init__(self, fingerprint: str, canonical: str,
+                 tables: Tuple[str, ...], warmup: int):
+        self.fingerprint = fingerprint
+        self.canonical = canonical
+        self.tables = tables
+        self.count = 0
+        self.totalTimeMs = 0.0
+        self.maxTimeMs = 0.0
+        self.hist = Histogram()
+        self.sums: Dict[str, float] = {k: 0.0 for k, _ in _SUM_KEYS}
+        # strategy -> count; strategies are a tiny planner enum, not
+        # query-derived, so the dict is naturally bounded
+        self.joinStrategies: Dict[str, int] = {}
+        # slot index -> (set of distinct literal reprs, overflowed flag)
+        self.slots: List[Tuple[set, bool]] = []
+        self.firstSeenTs = time.time()
+        self.lastSeenTs = self.firstSeenTs
+        self.versionsLastSeen: Dict[str, int] = {}
+        self.inputChanges = 0
+        # rolling latency baseline for the regression sentinel
+        self.baselineMs = 0.0
+        self.overBaseline = 0
+        self.warmupLeft = warmup
+
+
+class WorkloadRegistry:
+    """Bounded LRU of ShapeProfiles plus the per-table version counters."""
+
+    #: EWMA weight of a fresh non-violating latency sample in the baseline
+    BASELINE_ALPHA = 0.2
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+        self._lock = threading.Lock()
+        self._shapes: "OrderedDict[str, ShapeProfile]" = OrderedDict()
+        self._evicted_shapes = 0
+        self._evicted_queries = 0
+        self._total_queries = 0
+        self._shapes_seen = 0   # admissions, incl. re-admission after evict
+        self._table_versions: Dict[str, int] = {}
+        catalog.subscribe(self._on_catalog_event)
+
+    # -- knobs -------------------------------------------------------------
+    def _max_shapes(self) -> int:
+        try:
+            v = self.catalog.get_property(
+                "clusterConfig/broker.workload.max.shapes", 512)
+            return max(1, int(v))
+        except (TypeError, ValueError):
+            return 512
+
+    def _baseline_min_samples(self) -> int:
+        try:
+            v = self.catalog.get_property(
+                "clusterConfig/workload.baseline.min.samples", 20)
+            return max(1, int(v))
+        except (TypeError, ValueError):
+            return 20
+
+    def _baseline_multiplier(self) -> float:
+        try:
+            v = self.catalog.get_property(
+                "clusterConfig/workload.baseline.multiplier", 2.0)
+            return max(1.0, float(v))
+        except (TypeError, ValueError):
+            return 2.0
+
+    # -- segment-version vector -------------------------------------------
+    @staticmethod
+    def _logical(table: str) -> str:
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            if table.endswith(suffix):
+                return table[: -len(suffix)]
+        return table
+
+    def _on_catalog_event(self, event: str, key: str) -> None:
+        """Catalog watcher: segment lifecycle (upload/commit/drop) and ideal-
+        state transitions (evict/demote/relocate) bump the owning table's
+        version — any of them can change what a cached shape answer reads."""
+        if event in ("segment", "ideal_state"):
+            table = self._logical(key)
+            with self._lock:
+                self._table_versions[table] = \
+                    self._table_versions.get(table, 0) + 1
+        elif event == "table":
+            # dropped/changed table config: prune versions for tables no
+            # longer in the catalog so the counter map tracks the lifecycle
+            live = {self._logical(t) for t in list(self.catalog.table_configs)}
+            with self._lock:
+                for t in list(self._table_versions):
+                    if t not in live:
+                        self._table_versions.pop(t)
+
+    def table_versions(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._table_versions)
+
+    # -- ingest ------------------------------------------------------------
+    def observe(self, shape, elapsed_ms: float, stats: Dict) -> None:
+        """Fold one finished query into its shape profile. `shape` is the
+        PlanShape from sql.fingerprint; `stats` the response's stats dict."""
+        multiplier = self._baseline_multiplier()
+        evicted = False
+        with self._lock:
+            self._total_queries += 1
+            prof = self._shapes.get(shape.fingerprint)
+            if prof is None:
+                # shape-miss path only: the admission knobs gate profile
+                # creation and eviction, never the per-query fold
+                max_shapes = self._max_shapes()
+                prof = ShapeProfile(shape.fingerprint, shape.canonical,
+                                    shape.tables,
+                                    warmup=self._baseline_min_samples())
+                prof.versionsLastSeen = {
+                    t: self._table_versions.get(t, 0) for t in shape.tables}
+                self._shapes[shape.fingerprint] = prof
+                self._shapes_seen += 1
+                while len(self._shapes) > max_shapes:
+                    _, old = self._shapes.popitem(last=False)
+                    self._evicted_shapes += 1
+                    self._evicted_queries += old.count
+                    evicted = True
+            else:
+                self._shapes.move_to_end(shape.fingerprint)
+            self._fold_locked(prof, shape, elapsed_ms, stats, multiplier)
+        if evicted:
+            from ..utils.metrics import get_registry
+            get_registry().counter(
+                "pinot_broker_workload_shapes_evicted").inc()
+
+    def _fold_locked(self, prof: ShapeProfile, shape, elapsed_ms: float,
+                     stats: Dict, multiplier: float) -> None:
+        prof.count += 1
+        prof.totalTimeMs += elapsed_ms
+        prof.maxTimeMs = max(prof.maxTimeMs, elapsed_ms)
+        prof.lastSeenTs = time.time()
+        prof.hist.observe(elapsed_ms)
+        sums = prof.sums
+        get = stats.get
+        for out_key, stat_key in _SUM_KEYS:
+            v = get(stat_key)
+            # type() is, not isinstance: excludes bool (int subclass) for
+            # free and is cheaper on this per-query fold path
+            if type(v) is int or type(v) is float:
+                sums[out_key] += v
+        strategy = get("joinStrategy")
+        if type(strategy) is str and strategy:
+            prof.joinStrategies[strategy] = \
+                prof.joinStrategies.get(strategy, 0) + 1
+        # per-slot literal cardinality, capped (no unbounded value sets)
+        for i, value in enumerate(shape.slots):
+            if i >= len(prof.slots):
+                prof.slots.append((set(), False))
+            values, overflowed = prof.slots[i]
+            if not overflowed:
+                values.add(value)
+                if len(values) > SLOT_VALUE_CAP:
+                    prof.slots[i] = (values, True)
+        # cacheability: how many times did this shape's inputs change since
+        # it was last seen?
+        for t in prof.tables:
+            cur = self._table_versions.get(t, 0)
+            prev = prof.versionsLastSeen.get(t, cur)
+            if cur > prev:
+                prof.inputChanges += cur - prev
+            prof.versionsLastSeen[t] = cur
+        # rolling baseline: warmup samples always feed the EWMA and never
+        # violate; after warmup, violators count but do NOT move the baseline
+        if prof.warmupLeft > 0:
+            prof.warmupLeft -= 1
+            prof.baselineMs = (elapsed_ms if prof.count == 1 else
+                               prof.baselineMs
+                               + self.BASELINE_ALPHA
+                               * (elapsed_ms - prof.baselineMs))
+        elif elapsed_ms > prof.baselineMs * multiplier:
+            prof.overBaseline += 1
+        else:
+            prof.baselineMs += self.BASELINE_ALPHA \
+                * (elapsed_ms - prof.baselineMs)
+
+    # -- export ------------------------------------------------------------
+    def _shape_dict(self, prof: ShapeProfile, total_time: float,
+                    detail: bool = False) -> Dict:
+        recent = prof.hist.recent_summary()
+        d = {
+            "fingerprint": prof.fingerprint,
+            "canonical": prof.canonical,
+            "tables": list(prof.tables),
+            "count": prof.count,
+            "totalTimeMs": round(prof.totalTimeMs, 3),
+            "timeSharePct": round(100.0 * prof.totalTimeMs / total_time, 2)
+            if total_time > 0 else 0.0,
+            "avgTimeMs": round(prof.totalTimeMs / prof.count, 3)
+            if prof.count else 0.0,
+            "maxTimeMs": round(prof.maxTimeMs, 3),
+            "recentP50Ms": recent["recentP50Ms"],
+            "recentP99Ms": recent["recentP99Ms"],
+            "recentSamples": recent["recentSamples"],
+            "joinStrategies": dict(prof.joinStrategies),
+            "slotCardinality": [len(values) for values, _ in prof.slots],
+            "slotOverflowed": [flag for _, flag in prof.slots],
+            "segmentVersions": dict(prof.versionsLastSeen),
+            "inputChangesSinceFirstSeen": prof.inputChanges,
+            "firstSeenTs": round(prof.firstSeenTs, 3),
+            "lastSeenTs": round(prof.lastSeenTs, 3),
+            "baselineMs": round(prof.baselineMs, 3),
+            "overBaseline": prof.overBaseline,
+        }
+        for k in prof.sums:
+            d[k] = round(prof.sums[k], 3)
+        if detail:
+            d["slotValues"] = [sorted(values)[:8] for values, _ in prof.slots]
+        return d
+
+    def snapshot(self, k: Optional[int] = None) -> Dict:
+        """The `/debug/workload` body: conservation counters plus shapes
+        ranked by total time share (all resident shapes unless `k` trims)."""
+        with self._lock:
+            profiles = list(self._shapes.values())
+            totals = {
+                "totalQueries": self._total_queries,
+                "shapesResident": len(self._shapes),
+                "shapesEvicted": self._evicted_shapes,
+                "shapesSeen": self._shapes_seen,
+                "evictedQueries": self._evicted_queries,
+                "maxShapes": self._max_shapes(),
+                "tableVersions": dict(self._table_versions),
+            }
+            total_time = sum(p.totalTimeMs for p in profiles)
+            ranked = sorted(profiles, key=lambda p: p.totalTimeMs,
+                            reverse=True)
+            if k is not None and k > 0:
+                ranked = ranked[:k]
+            shapes = [self._shape_dict(p, total_time) for p in ranked]
+        totals["shapes"] = shapes
+        return totals
+
+    def shape(self, fingerprint: str) -> Optional[Dict]:
+        """Per-shape drill-down (`/debug/workload?fp=`): the full profile
+        including sampled slot values; None when unknown/evicted."""
+        with self._lock:
+            prof = self._shapes.get(fingerprint)
+            if prof is None:
+                return None
+            total_time = sum(p.totalTimeMs for p in self._shapes.values())
+            return self._shape_dict(prof, total_time, detail=True)
+
+    def summary(self) -> Dict:
+        """Light rollup for the broker's main /debug body."""
+        with self._lock:
+            return {
+                "totalQueries": self._total_queries,
+                "shapesResident": len(self._shapes),
+                "shapesEvicted": self._evicted_shapes,
+                "evictedQueries": self._evicted_queries,
+            }

@@ -130,12 +130,18 @@ MIN_KEYS = (MIN_CONSUMING_FRESHNESS_TIME_MS,)
 # query saw (sums are meaningless for percentages).
 MAX_KEYS = (DEVICE_SKEW_PCT, ROOFLINE_PCT, JOIN_SKEW_PCT)
 
+# the query's 16-hex plan-shape fingerprint (sql/fingerprint.py): stamped by
+# the broker so any response / slow-log line / trace resolves to its shape
+# profile at GET /debug/workload?fp=
+WORKLOAD_FINGERPRINT = "workloadFingerprint"
+
 # broker-level keys that live beside the merged counters in QueryResult.stats
 # (listed so the glossary drift guard covers the full emitted surface)
 BROKER_KEYS = (
     "timeUsedMs", NUM_DOCS_SCANNED, "numGroupsTotal", "numServersQueried",
     "numServersResponded", "partialResult", "phaseTimesMs", "traceInfo",
     "traceId", "gapfilled", "explain", "analyze", "joinStrategy",
+    WORKLOAD_FINGERPRINT,
 )
 
 #: routing pruner kind (cluster.routing.PRUNER_KINDS) -> its breakdown counter
@@ -165,6 +171,8 @@ class ExecutionStats:
 
     def add(self, key: str, n: float = 1) -> None:
         with self._lock:
+            # graftcheck: ignore[unbounded-keyed-accumulation] -- per-query
+            # stats object; key space is the drift-guarded stat-key constants
             self.counters[key] = self.counters.get(key, 0) + n
 
     def set_min(self, key: str, v: float) -> None:

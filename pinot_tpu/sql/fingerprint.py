@@ -1,0 +1,165 @@
+"""Plan fingerprinting: a parsed statement -> a stable 16-hex shape id.
+
+The workload intelligence plane (cluster/workload.py) keys everything on the
+*shape* of a query, not its text: two queries that differ only in literal
+values, whitespace, or the order of AND/OR conjuncts are the same unit of
+work to the planner and must land in the same profile. Normalization rules:
+
+* literals are stripped and parameterized (`?`), their values collected as
+  ordered **slots** so the registry can track per-slot literal cardinality;
+* commutative predicate lists (`and` / `or` args) are ordered canonically by
+  their normalized text, so `a=? AND b<?` == `b<? AND a=?`;
+* `IN` / `NOT IN` literal lists collapse into ONE variadic slot (`?*`) —
+  `IN (1,2)` and `IN (3,4,5)` are the same shape with different slot values;
+* table names (and join tables / subquery tables) are KEPT — the fingerprint
+  is the cache key the ROADMAP result-cache item pairs with the
+  segment-version vector, so the tables it reads are part of its identity;
+* `LIMIT` / `OFFSET` parameterize like any literal.
+
+Whitespace and comment immunity comes for free: fingerprinting operates on
+the parsed AST (sql/ast.py), never on the SQL text. The digest is
+sha256 truncated to 16 hex chars — the same width as trace ids, so the two
+join cleanly in log pipelines.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, List, Tuple
+
+from .ast import Expr, Function, Identifier, Literal, QueryStatement, Subquery
+
+
+class PlanShape:
+    """One normalized plan: the 16-hex fingerprint, the canonical text it
+    hashes, the tables it touches (dedup'd, order of appearance), and the
+    literal value captured per parameter slot (canonical slot order).
+    Plain __slots__ class, not a dataclass: one is built per query on the
+    broker hot path."""
+
+    __slots__ = ("fingerprint", "canonical", "tables", "slots")
+
+    def __init__(self, fingerprint: str, canonical: str,
+                 tables: Tuple[str, ...], slots: Tuple[str, ...]):
+        self.fingerprint = fingerprint
+        self.canonical = canonical
+        self.tables = tables
+        self.slots = slots
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, PlanShape) and \
+            self.fingerprint == other.fingerprint and \
+            self.slots == other.slots
+
+    def __repr__(self) -> str:
+        return f"PlanShape({self.fingerprint}, {self.canonical!r})"
+
+
+def _slot_repr(v: Any) -> str:
+    """Stable literal rendering for slot-cardinality tracking (NOT hashed)."""
+    if isinstance(v, str):
+        return "'" + v + "'"
+    return repr(v)
+
+
+def _canon_expr(e: Expr, slots: List[str], tables: List[str]) -> str:
+    # `type() is` dispatch, most-frequent first: this runs per AST node per
+    # query on the broker hot path (ast.py nodes are never subclassed)
+    t = type(e)
+    if t is Literal:
+        slots.append(_slot_repr(e.value))
+        return "?"
+    if t is Identifier:
+        return e.name
+    name = e.name
+    if name in ("and", "or"):
+        # canonical predicate order: sort conjuncts by normalized text, then
+        # emit their slots in the sorted order so slot indices are stable
+        parts: List[Tuple[str, List[str]]] = []
+        for a in e.args:
+            local: List[str] = []
+            parts.append((_canon_expr(a, local, tables), local))
+        parts.sort(key=lambda p: p[0])
+        for _, local in parts:
+            slots.extend(local)
+        return name + "(" + ",".join([t for t, _ in parts]) + ")"
+    if name in ("in", "not_in"):
+        head = _canon_expr(e.args[0], slots, tables)
+        lits = sorted([_slot_repr(a.value) for a in e.args[1:]
+                       if type(a) is Literal])
+        inner: List[str] = []
+        if lits:   # the whole literal list is ONE variadic slot
+            slots.append("[" + ",".join(lits) + "]")
+            inner.append("?*")
+        inner.extend([_canon_expr(a, slots, tables) for a in e.args[1:]
+                      if type(a) is not Literal])
+        return f"{name}({head},{','.join(inner)})"
+    if name in ("in_subquery", "not_in_subquery") and len(e.args) == 2 \
+            and isinstance(e.args[1], Subquery):
+        head = _canon_expr(e.args[0], slots, tables)
+        sub = _canon_statement(e.args[1].stmt, slots, tables)
+        return f"{name}({head},({sub}))"
+    body = ",".join([_canon_expr(a, slots, tables) for a in e.args])
+    if e.distinct:
+        return name + "(distinct " + body + ")"
+    return name + "(" + body + ")"
+
+
+def _canon_statement(stmt: QueryStatement, slots: List[str],
+                     tables: List[str]) -> str:
+    tables.append(stmt.table)
+    sel = ",".join(
+        [_canon_expr(e, slots, tables) + (f" as {a}" if a else "")
+         for e, a in stmt.select])
+    parts = [("select distinct " if stmt.distinct else "select ") + sel,
+             f"from {stmt.table}"
+             + (f" {stmt.table_alias}" if stmt.table_alias else "")]
+    for j in stmt.joins:
+        tables.append(j.table)
+        item = f"{j.join_type} join {j.table}"
+        if j.alias:
+            item += f" {j.alias}"
+        if j.condition is not None:
+            item += f" on {_canon_expr(j.condition, slots, tables)}"
+        parts.append(item)
+    if stmt.where is not None:
+        parts.append(f"where {_canon_expr(stmt.where, slots, tables)}")
+    if stmt.group_by:
+        parts.append("group by " + ",".join(
+            [_canon_expr(e, slots, tables) for e in stmt.group_by]))
+    if stmt.having is not None:
+        parts.append(f"having {_canon_expr(stmt.having, slots, tables)}")
+    if stmt.order_by:
+        parts.append("order by " + ",".join(
+            _canon_expr(o.expr, slots, tables) + (" desc" if o.desc else "")
+            for o in stmt.order_by))
+    slots.append(_slot_repr(stmt.limit))
+    parts.append("limit ?")
+    if stmt.offset:
+        slots.append(_slot_repr(stmt.offset))
+        parts.append("offset ?")
+    if stmt.options:
+        # options steer the plan (engine choice, shuffle mode): part of the
+        # shape, key-sorted so OPTION order never splits a fingerprint
+        parts.append("option(" + ",".join(
+            f"{k}={v}" for k, v in sorted(stmt.options.items())) + ")")
+    if stmt.explain:
+        parts.insert(0, "explain")
+    if stmt.analyze:
+        parts.insert(0, "analyze")
+    return "; ".join(parts)
+
+
+def fingerprint_statement(stmt: QueryStatement) -> PlanShape:
+    """Normalize one parsed statement into its PlanShape."""
+    slots: List[str] = []
+    tables: List[str] = []
+    canonical = _canon_statement(stmt, slots, tables)
+    fp = hashlib.sha256(canonical.encode()).hexdigest()[:16]
+    seen, uniq = set(), []
+    for t in tables:
+        if t not in seen:
+            seen.add(t)
+            uniq.append(t)
+    return PlanShape(fingerprint=fp, canonical=canonical,
+                     tables=tuple(uniq), slots=tuple(slots))

@@ -11,9 +11,11 @@ the next probe) with the lifetime hedged-request count in the header, and an
 admission panel showing the broker's shed state, in-flight depth against its
 queue thresholds, and per-table/per-reason shed counts, and a device-memory
 panel with the controller's per-table HBM verdict, resident bytes, and the
-worst per-server headroom. The
+worst per-server headroom, and a workload panel with the top query shapes by
+time share (count, p99, and the controller sentinel's regression verdict per
+plan fingerprint). The
 operator's first stop when a dashboard shows a table going stale, an SLO
-burning, a server flapping, or HBM filling up:
+burning, a server flapping, HBM filling up, or one query shape regressing:
 
     python -m pinot_tpu.tools.cluster_top --controller http://host:9000 \\
         --broker http://host:8099 [--interval 5] [--once] [--token TOKEN]
@@ -47,7 +49,8 @@ def snapshot(controller_url: str, broker_url: Optional[str],
     degrade to partial data (an unreachable broker must not blank the lag
     columns)."""
     out: Dict[str, Any] = {"tables": {}, "slo": {}, "memory": {},
-                           "tableStats": {}, "broker": None, "errors": []}
+                           "tableStats": {}, "broker": None, "errors": [],
+                           "workload": None, "workloadStatus": {}}
     try:
         tables = fetch(f"{controller_url}/tables").get("tables", [])
     except Exception as e:
@@ -86,9 +89,18 @@ def snapshot(controller_url: str, broker_url: Optional[str],
             out["admission"] = debug.get("admission") or {}
         except Exception as e:
             out["errors"].append(f"broker /debug: {e}")
+        try:
+            # per-shape workload registry (the workload panel, top-5)
+            out["workload"] = fetch(f"{broker_url}/debug/workload?k=5")
+        # graftcheck: ignore[exception-hygiene] -- read-only dashboard poll;
+        # the missing body visibly drops the whole workload panel
+        except Exception:
+            pass   # older broker: no workload panel
     try:
-        out["periodicTasks"] = fetch(f"{controller_url}/debug").get(
-            "periodicTasks", {})
+        cdebug = fetch(f"{controller_url}/debug")
+        out["periodicTasks"] = cdebug.get("periodicTasks", {})
+        # sentinel verdicts join the workload panel's REGR column
+        out["workloadStatus"] = cdebug.get("workloadStatus") or {}
     except Exception as e:
         out["errors"].append(f"controller /debug: {e}")
         out["periodicTasks"] = {}
@@ -173,6 +185,31 @@ def render(snap: Dict[str, Any]) -> str:
                 f"{r.get('p99LatencyMs', 0):>8} "
                 f"{int(r.get('numSlowQueries', 0)):>5} "
                 f"{int(r.get('numErrors', 0)):>4}")
+    workload = snap.get("workload") or {}
+    if workload.get("shapes"):
+        regressions = (snap.get("workloadStatus") or {}).get(
+            "regressions") or {}
+        lines.append("")
+        lines.append(
+            f"workload (top shapes by time share; "
+            f"{workload.get('totalQueries', 0)} queries over "
+            f"{workload.get('shapesSeen', '?')} shapes, "
+            f"{workload.get('shapesEvicted', 0)} evicted)")
+        wcols = f"{'FINGERPRINT':<17} {'COUNT':>8} {'SHARE':>7} " \
+                f"{'P99MS':>9} {'REGR':<10}  PLAN"
+        lines.append(wcols)
+        lines.append("-" * len(wcols))
+        for s in workload["shapes"][:5]:
+            fp = s.get("fingerprint", "?")
+            regr = (regressions.get(fp) or {}).get("state", "-")
+            plan = str(s.get("canonical") or "")
+            if len(plan) > 60:
+                plan = plan[:57] + "..."
+            lines.append(
+                f"{fp:<17} {int(s.get('count', 0)):>8} "
+                f"{float(s.get('timeSharePct') or 0):>6.2f}% "
+                f"{float(s.get('recentP99Ms') or 0):>9.3f} "
+                f"{regr:<10}  {plan}")
     admission = snap.get("admission") or {}
     if admission:
         lines.append("")

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Pretty-print a saved `GET /debug/workload` capture as a workload report.
+
+Feed it the JSON the broker's workload endpoint returns (or the `workload`
+drill-down body from `?fp=`):
+
+    curl -s broker:8099/debug/workload > workload.json
+    python tools/workload_report.py workload.json
+    curl -s broker:8099/debug/workload | python tools/workload_report.py
+
+Output: the conservation header (total queries vs per-shape counts plus the
+evicted overflow), a top-K table of shapes ranked by total time share with a
+share bar, and a per-shape drill-down (canonical plan, latency profile,
+scan/launch counters, slot cardinality, and the cacheability signal — the
+segment-version vector and how often the shape's inputs changed). Pass
+`--top N` to trim the ranking, `--fp <fingerprint>` to render one shape.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+BAR_WIDTH = 40
+
+
+def _bar(share_pct: float) -> str:
+    n = int(round(BAR_WIDTH * share_pct / 100.0))
+    return "#" * max(n, 1 if share_pct > 0 else 0)
+
+
+def render_summary(doc: Dict[str, Any], top: int = 10) -> str:
+    """The ranked top-K table (the CLI prints it; tests assert on it)."""
+    out: List[str] = []
+    shapes = [s for s in (doc.get("shapes") or []) if isinstance(s, dict)]
+    total = doc.get("totalQueries", sum(s.get("count", 0) for s in shapes))
+    out.append(f"workload: {total} queries over "
+               f"{doc.get('shapesSeen', len(shapes))} shapes "
+               f"({doc.get('shapesResident', len(shapes))} resident, "
+               f"{doc.get('shapesEvicted', 0)} evicted holding "
+               f"{doc.get('evictedQueries', 0)} queries)")
+    accounted = sum(s.get("count", 0) for s in shapes) \
+        + (doc.get("evictedQueries") or 0)
+    if total and accounted != total:
+        out.append(f"  ** conservation gap: {accounted} accounted "
+                   f"vs {total} total **")
+    out.append("")
+    out.append(f"  {'fingerprint':<17} {'count':>7} {'share':>7} "
+               f"{'p50ms':>9} {'p99ms':>9} {'over':>5}  |{'time share':<{BAR_WIDTH}}|")
+    for s in shapes[:top]:
+        share = float(s.get("timeSharePct") or 0.0)
+        over = int(s.get("overBaseline") or 0)
+        out.append(
+            f"  {s.get('fingerprint', '?'):<17} {int(s.get('count', 0)):>7} "
+            f"{share:>6.2f}% {float(s.get('recentP50Ms') or 0):>9.3f} "
+            f"{float(s.get('recentP99Ms') or 0):>9.3f} {over:>5}  "
+            f"|{_bar(share):<{BAR_WIDTH}}|")
+    if len(shapes) > top:
+        rest = shapes[top:]
+        out.append(f"  ... {len(rest)} more shapes "
+                   f"({sum(s.get('count', 0) for s in rest)} queries)")
+    return "\n".join(out)
+
+
+def render_shape(s: Dict[str, Any]) -> str:
+    """One shape's drill-down (the `?fp=` body, or a ranked entry)."""
+    out: List[str] = []
+    out.append(f"shape {s.get('fingerprint', '?')}  "
+               f"tables={','.join(s.get('tables') or [])}")
+    out.append(f"  plan: {s.get('canonical', '?')}")
+    out.append(f"  count={int(s.get('count', 0))}  "
+               f"avg={float(s.get('avgTimeMs') or 0):.3f}ms  "
+               f"max={float(s.get('maxTimeMs') or 0):.3f}ms  "
+               f"recent p50/p99={float(s.get('recentP50Ms') or 0):.3f}/"
+               f"{float(s.get('recentP99Ms') or 0):.3f}ms")
+    out.append(f"  baseline={float(s.get('baselineMs') or 0):.3f}ms  "
+               f"overBaseline={int(s.get('overBaseline') or 0)}")
+    counters = [(k, s[k]) for k in
+                ("bytesFetched", "rowsScanned", "segmentsQueried",
+                 "segmentsPruned", "deviceLaunches", "hostTierServes",
+                 "fusedLaunches", "stagedLaunches") if k in s]
+    if counters:
+        out.append("  counters: " + "  ".join(
+            f"{k}={int(float(v or 0))}" for k, v in counters))
+    if s.get("joinStrategies"):
+        out.append("  join strategies: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(s["joinStrategies"].items())))
+    card = s.get("slotCardinality") or []
+    if card:
+        flags = s.get("slotOverflowed") or [False] * len(card)
+        slots = "  ".join(
+            f"?{i}:{'>' if flags[i] else ''}{card[i]}"
+            for i in range(len(card)))
+        out.append(f"  slot cardinality: {slots}")
+        values = s.get("slotValues")
+        if values:
+            for i, vs in enumerate(values):
+                out.append(f"    ?{i} sample: {', '.join(map(str, vs))}")
+    # cacheability: this is the key the result-cache pairs with the plan
+    versions = s.get("segmentVersions") or {}
+    if versions:
+        vec = "  ".join(f"{t}@v{v}" for t, v in sorted(versions.items()))
+        out.append(f"  cacheability: inputs {vec}  "
+                   f"(changed {int(s.get('inputChangesSinceFirstSeen') or 0)}"
+                   "x since first seen)")
+    return "\n".join(out)
+
+
+def render(doc: Dict[str, Any], top: int = 10, fp: str = "") -> str:
+    """Full report: a single-shape doc (the `?fp=` body) renders alone; a
+    registry snapshot renders the ranked table plus per-shape drill-downs."""
+    if "shapes" not in doc and "fingerprint" in doc:
+        return render_shape(doc)
+    shapes = [s for s in (doc.get("shapes") or []) if isinstance(s, dict)]
+    if fp:
+        for s in shapes:
+            if s.get("fingerprint") == fp:
+                return render_shape(s)
+        return f"unknown shape {fp} (evicted, or never seen)"
+    parts = [render_summary(doc, top)]
+    parts.extend(render_shape(s) for s in shapes[:top])
+    return "\n\n".join(parts)
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv[1:])
+    if "-h" in args or "--help" in args:
+        print(__doc__)
+        return 0
+    top, fp, path = 10, "", None
+    i = 0
+    while i < len(args):
+        if args[i] == "--top" and i + 1 < len(args):
+            top = int(args[i + 1])
+            i += 2
+        elif args[i] == "--fp" and i + 1 < len(args):
+            fp = args[i + 1]
+            i += 2
+        else:
+            path = args[i]
+            i += 1
+    if path and path != "-":
+        with open(path) as f:
+            doc = json.load(f)
+    else:
+        doc = json.load(sys.stdin)
+    print(render(doc, top=top, fp=fp))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -197,6 +197,15 @@ class Histogram:
                 "p95Ms": round(self.percentile(0.95), 3),
                 "maxMs": round(self.max, 3)}
 
+    def recent_summary(self) -> Dict[str, float]:
+        """summary() restricted to the rotating recent window: p50/p99 plus
+        the sample count they were computed from (recent_percentile's
+        lifetime fallback applies while the window is empty)."""
+        p50, n = self.recent_percentile(0.5)
+        p99, _ = self.recent_percentile(0.99)
+        return {"recentSamples": n, "recentP50Ms": round(p50, 3),
+                "recentP99Ms": round(p99, 3)}
+
 
 class MetricsRegistry:
     def __init__(self):

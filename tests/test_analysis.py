@@ -14,7 +14,8 @@ import pytest
 
 from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
                                 run_project, run_rules, unbaselined)
-from pinot_tpu.analysis import (admission_hygiene, blocking_in_loop,
+from pinot_tpu.analysis import (accumulation, admission_hygiene,
+                                blocking_in_loop,
                                 collective_hygiene, drift_guards,
                                 exception_hygiene, filter_path, fused_path,
                                 ingest_hot_loop, jit_hygiene, join_path,
@@ -1427,6 +1428,93 @@ def test_cli_seeded_interprocedural_package(tmp_path, capsys):
     assert "jit-host-sync" in out and "race-cross-method" in out
     assert "[via " in out and "make_scores" in out
     assert "Thread(target=self._loop)" in out
+
+
+# -- unbounded-keyed-accumulation ---------------------------------------------
+
+def test_unbounded_accumulation_true_positive():
+    # a query-keyed dict with growth sites and no shrink/bound anywhere:
+    # exactly the grow-forever registry bug class
+    active, _ = _check("""
+        class Registry:
+            def __init__(self):
+                self.profiles = {}
+                self.recent = []
+
+            def observe(self, fingerprint, row):
+                self.profiles[fingerprint] = row
+                self.recent.append(row)
+    """, accumulation.rules(), rel="pinot_tpu/cluster/fixture.py")
+    assert _ids(active) == ["unbounded-keyed-accumulation"] * 2
+    assert {"self.profiles", "self.recent"} <= {
+        a for f in active for a in f.message.split("`")[1::2]}
+
+
+def test_unbounded_accumulation_clean_negatives():
+    # every bounding idiom the rule recognizes: an LRU evict loop (pop),
+    # a len() bound check, a deque(maxlen=), and construction-time fill
+    active, _ = _check("""
+        from collections import OrderedDict, deque
+
+        class Bounded:
+            def __init__(self, rows):
+                self.lru = OrderedDict()
+                self.capped = {}
+                self.window = deque(maxlen=256)
+                self.index = {r: i for i, r in enumerate(rows)}
+
+            def observe(self, key, row):
+                self.lru[key] = row
+                while len(self.lru) > 512:
+                    self.lru.popitem(last=False)
+                if len(self.capped) < 100:
+                    self.capped[key] = row
+                self.window.append(row)
+    """, accumulation.rules(), rel="pinot_tpu/cluster/fixture.py")
+    assert active == []
+
+
+def test_unbounded_accumulation_replace_rebuild_exempt():
+    # snapshot-replace idiom: the attr is reassigned wholesale outside its
+    # defining method, so each generation's size is the rebuild's concern
+    active, _ = _check("""
+        class View:
+            def __init__(self):
+                self.by_table = {}
+
+            def refresh(self, rows):
+                self.by_table = {}
+                for r in rows:
+                    self.by_table[r.table] = r
+    """, accumulation.rules(), rel="pinot_tpu/cluster/fixture.py")
+    assert active == []
+
+
+def test_unbounded_accumulation_scoped_to_serving_layers():
+    # tools/analysis/bench code is process-short: out of scope
+    active, _ = _check("""
+        class Collector:
+            def __init__(self):
+                self.rows = {}
+
+            def add(self, k, v):
+                self.rows[k] = v
+    """, accumulation.rules(), rel="pinot_tpu/tools/fixture.py")
+    assert active == []
+
+
+def test_unbounded_accumulation_suppression_honored():
+    active, suppressed = _check("""
+        class Topology:
+            def __init__(self):
+                self.per_server = {}
+
+            def admit(self, server, row):
+                # graftcheck: ignore[unbounded-keyed-accumulation] -- keyed by cluster topology, not query text
+                self.per_server[server] = row
+    """, accumulation.rules(), rel="pinot_tpu/cluster/fixture.py")
+    assert active == []
+    assert _ids(suppressed) == ["unbounded-keyed-accumulation"]
 
 
 def test_full_package_run_within_time_budget():

@@ -1,0 +1,352 @@
+"""Workload intelligence plane: plan fingerprints, shape profiles, sentinel.
+
+The broker normalizes every parsed plan into a 16-hex fingerprint
+(sql/fingerprint.py), folds per-query stats into a bounded LRU of per-shape
+profiles (cluster/workload.py, served at /debug/workload), and the
+controller's WorkloadSentinel burns each shape's over-baseline rate against
+the sentinel budget over the shared SLO fast/slow windows — a per-shape
+generalization of the per-table SLO machinery in test_table_slo.py.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.catalog import Catalog
+from pinot_tpu.cluster.workload import SLOT_VALUE_CAP, WorkloadRegistry
+from pinot_tpu.schema import DataType, Schema, dimension, metric
+from pinot_tpu.sql.fingerprint import fingerprint_statement
+from pinot_tpu.sql.parser import parse_query
+from pinot_tpu.table import TableConfig
+from pinot_tpu.utils.metrics import get_registry
+
+
+def _shape(sql):
+    return fingerprint_statement(parse_query(sql))
+
+
+# -- fingerprint normalization ------------------------------------------------
+
+def test_fingerprint_stable_across_literals_whitespace_and_order():
+    """Literal values, whitespace/case, AND-conjunct order, and IN-list
+    length are NOT part of the shape; each query maps to one fingerprint."""
+    base = _shape("SELECT SUM(v) FROM t WHERE a > 5 AND b = 'x' LIMIT 10")
+    variants = [
+        "SELECT SUM(v) FROM t WHERE a > 99 AND b = 'y' LIMIT 500",
+        "select   sum(v)  from t  where a > 5 and b = 'x' limit 10",
+        "SELECT SUM(v) FROM t WHERE b = 'x' AND a > 5 LIMIT 10",
+    ]
+    for sql in variants:
+        assert _shape(sql).fingerprint == base.fingerprint, sql
+    # slots still capture the literals, in canonical (sorted-conjunct) order
+    reordered = _shape(variants[2])
+    assert reordered.slots == base.slots
+
+    short = _shape("SELECT a FROM t WHERE a IN (1, 2) LIMIT 5")
+    long = _shape("SELECT a FROM t WHERE a IN (7, 8, 9, 10) LIMIT 5")
+    assert short.fingerprint == long.fingerprint
+    assert short.slots != long.slots   # one variadic slot, different values
+
+
+def test_fingerprint_distinct_across_plans():
+    shapes = [_shape(s) for s in (
+        "SELECT SUM(v) FROM t WHERE a > 5 LIMIT 10",
+        "SELECT MAX(v) FROM t WHERE a > 5 LIMIT 10",
+        "SELECT SUM(v) FROM t WHERE b > 5 LIMIT 10",
+        "SELECT SUM(v) FROM t2 WHERE a > 5 LIMIT 10",
+        "SELECT a, SUM(v) FROM t WHERE a > 5 GROUP BY a LIMIT 10",
+    )]
+    fps = {s.fingerprint for s in shapes}
+    assert len(fps) == len(shapes)
+    assert all(len(fp) == 16 for fp in fps)
+    assert shapes[3].tables == ("t2",)
+
+
+# -- registry: concurrency, LRU eviction, conservation ------------------------
+
+def test_concurrent_registration_exact(tmp_path):
+    """8 threads folding into overlapping shapes: every counter exact."""
+    reg = WorkloadRegistry(Catalog())
+    shapes = [_shape(f"SELECT SUM(v) FROM t WHERE c{i} > 1 LIMIT 5")
+              for i in range(4)]
+    per_thread = 500
+
+    def worker(tid):
+        for i in range(per_thread):
+            reg.observe(shapes[(tid + i) % len(shapes)], 1.0,
+                        {"numDocsScanned": 10})
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = reg.snapshot()
+    assert snap["totalQueries"] == 8 * per_thread
+    assert snap["shapesResident"] == len(shapes)
+    assert snap["shapesEvicted"] == 0
+    counts = {s["fingerprint"]: s["count"] for s in snap["shapes"]}
+    assert sum(counts.values()) == 8 * per_thread
+    assert all(c == 8 * per_thread // len(shapes) for c in counts.values())
+    assert all(s["rowsScanned"] == s["count"] * 10 for s in snap["shapes"])
+
+
+def test_lru_eviction_overflow_conservation(tmp_path):
+    """Over the max.shapes cap the LRU evicts coldest-use shapes, but the
+    evicted queries stay counted: nothing is silently truncated."""
+    cat = Catalog()
+    cat.put_property("clusterConfig/broker.workload.max.shapes", "4")
+    reg = WorkloadRegistry(cat)
+    base = get_registry().snapshot().get(
+        "pinot_broker_workload_shapes_evicted", 0.0)
+
+    shapes = [_shape(f"SELECT SUM(v) FROM t WHERE c{i} > 1 LIMIT 5")
+              for i in range(10)]
+    for i, s in enumerate(shapes):
+        for _ in range(i + 1):   # shape i folded i+1 times
+            reg.observe(s, 1.0, {})
+
+    snap = reg.snapshot()
+    assert snap["maxShapes"] == 4
+    assert snap["shapesResident"] == 4
+    assert snap["shapesEvicted"] == 6
+    assert snap["shapesSeen"] == 10
+    assert snap["shapesEvicted"] + snap["shapesResident"] \
+        == snap["shapesSeen"]
+    # conservation: resident counts + evicted overflow == every query seen
+    total = sum(range(1, 11))
+    assert sum(s["count"] for s in snap["shapes"]) \
+        + snap["evictedQueries"] == total == snap["totalQueries"]
+    assert get_registry().snapshot()[
+        "pinot_broker_workload_shapes_evicted"] - base == 6.0
+    # most-recently-used survive: shapes 6..9 are the residents
+    assert {s["fingerprint"] for s in snap["shapes"]} \
+        == {s.fingerprint for s in shapes[6:]}
+    # an evicted shape re-admits from scratch (and counts as a new sighting)
+    reg.observe(shapes[0], 1.0, {})
+    snap = reg.snapshot()
+    assert snap["shapesSeen"] == 11 and snap["shapesEvicted"] == 7
+
+
+def test_slot_cardinality_capped():
+    reg = WorkloadRegistry(Catalog())
+    for i in range(SLOT_VALUE_CAP + 10):
+        reg.observe(_shape(f"SELECT SUM(v) FROM t WHERE a > {i} LIMIT 5"),
+                    1.0, {})
+    snap = reg.snapshot()
+    assert snap["shapesResident"] == 1
+    (prof,) = snap["shapes"]
+    assert prof["slotOverflowed"] == [True, False]   # a-literal, limit
+    assert prof["slotCardinality"][0] <= SLOT_VALUE_CAP + 1
+    assert prof["slotCardinality"][1] == 1
+
+
+# -- segment-version vector (cacheability signal) -----------------------------
+
+def test_segment_versions_bump_on_lifecycle_events():
+    cat = Catalog()
+    reg = WorkloadRegistry(cat)
+    shape = _shape("SELECT SUM(v) FROM trips WHERE a > 1 LIMIT 5")
+    reg.observe(shape, 1.0, {})
+    assert reg.table_versions() == {}
+
+    # segment commit/upload and ideal-state transitions (evict/demote/
+    # relocate) each bump the owning logical table's version
+    cat._notify("segment", "trips_OFFLINE")
+    cat._notify("segment", "trips_REALTIME")
+    cat._notify("ideal_state", "trips_OFFLINE")
+    cat._notify("segment", "other_OFFLINE")
+    assert reg.table_versions() == {"trips": 3, "other": 1}
+
+    # the next fold of the shape picks up the drift as inputChanges
+    reg.observe(shape, 1.0, {})
+    prof = reg.shape(shape.fingerprint)
+    assert prof["inputChangesSinceFirstSeen"] == 3
+    assert prof["segmentVersions"] == {"trips": 3}
+
+    # steady state: no further drift, counter stays put
+    reg.observe(shape, 1.0, {})
+    assert reg.shape(
+        shape.fingerprint)["inputChangesSinceFirstSeen"] == 3
+
+
+# -- regression sentinel ------------------------------------------------------
+
+@pytest.fixture
+def sentinel_controller(tmp_path):
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.deepstore import LocalDeepStore
+    catalog = Catalog()
+    return Controller("controller_wl", catalog,
+                      LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / "ctrl"))
+
+
+def _wl_poller(shapes):
+    return lambda: {"shapes": [dict(s) for s in shapes]}
+
+
+def test_sentinel_healthy_to_degraded_timeline(sentinel_controller):
+    """Synthetic per-shape counter timeline with exact burn arithmetic:
+    over-baseline rate vs the 1% budget over fast AND slow windows."""
+    c = sentinel_controller
+    fp = "deadbeef00c0ffee"
+    shape = {"fingerprint": fp, "canonical": "select sum(v); from t",
+             "tables": ["t"], "count": 1000, "overBaseline": 0,
+             "totalTimeMs": 5000.0, "baselineMs": 5.0}
+    c.workload_pollers["b1"] = _wl_poller([shape])
+
+    # first observation: single sample in every window -> zero burn
+    assert c.run_workload_check(now=1000.0) == {fp: "HEALTHY"}
+    st = c.workload_status()
+    assert st["state"] == "HEALTHY" and st["shapesTracked"] == 1
+    base_regr = get_registry().snapshot().get(
+        "pinot_broker_workload_shape_regressions", 0.0)
+
+    # 5 violators over 1000 queries = 0.5% < the 1% budget -> HEALTHY
+    shape.update(count=2000, overBaseline=5)
+    assert c.run_workload_check(now=1060.0) == {fp: "HEALTHY"}
+
+    # window delta vs the ts=1000 sample: 2000 queries, 25 over-baseline
+    # = 1.25% -> 1.25x budget in BOTH windows -> DEGRADED, reason names
+    # the offending fingerprint
+    shape.update(count=3000, overBaseline=25)
+    assert c.run_workload_check(now=1120.0) == {fp: "DEGRADED"}
+    st = c.workload_status()
+    assert st["state"] == "DEGRADED"
+    reg_entry = st["regressions"][fp]
+    assert reg_entry["burnFast"] == 1.25 and reg_entry["burnSlow"] == 1.25
+    assert fp in reg_entry["reason"] and "1.25x fast" in reg_entry["reason"]
+    snap = get_registry().snapshot()
+    assert snap["pinot_broker_workload_shape_regressions"] - base_regr == 1.0
+    assert snap["pinot_controller_workload_regressing_shapes"] == 1.0
+
+    # still regressing next tick: the transition counter does NOT re-tick
+    shape.update(count=4000, overBaseline=55)
+    assert c.run_workload_check(now=1180.0) == {fp: "DEGRADED"}
+    assert get_registry().snapshot()[
+        "pinot_broker_workload_shape_regressions"] - base_regr == 1.0
+
+    # 800 over 4000 in-window queries = 20% = 20x >= the 14.4x page rate
+    shape.update(count=5000, overBaseline=800)
+    assert c.run_workload_check(now=1240.0) == {fp: "UNHEALTHY"}
+    assert c.workload_status()["state"] == "UNHEALTHY"
+
+    # recovery: clean traffic drains the burn back under budget
+    # (800 violators over 99000 in-window queries = 0.81%)
+    shape.update(count=100000, overBaseline=800)
+    assert c.run_workload_check(now=1300.0) == {fp: "HEALTHY"}
+    assert c.workload_status()["state"] == "HEALTHY"
+    assert get_registry().snapshot()[
+        "pinot_controller_workload_regressing_shapes"] == 0.0
+
+
+def test_sentinel_disable_and_stale_shape_pruning(sentinel_controller):
+    c = sentinel_controller
+    shape = {"fingerprint": "aa" * 8, "canonical": "x", "tables": [],
+             "count": 100, "overBaseline": 0, "totalTimeMs": 1.0,
+             "baselineMs": 1.0}
+    c.workload_pollers["b1"] = _wl_poller([shape])
+    c.run_workload_check(now=2000.0)
+    assert c.workload_status()["shapesTracked"] == 1
+
+    # shape evicted broker-side: its sample history is pruned
+    c.workload_pollers["b1"] = _wl_poller([])
+    c.run_workload_check(now=2060.0)
+    assert c.workload_status()["shapesTracked"] == 0
+    assert not c._workload_samples
+
+    # budget <= 0 disables the sentinel and tears the plane down
+    c.catalog.put_property("clusterConfig/workload.sentinel.budget", "0")
+    assert c.run_workload_check(now=2120.0) == {}
+    assert c.workload_status() == {}
+    assert "pinot_controller_workload_regressing_shapes" \
+        not in get_registry().snapshot()
+
+
+def test_sentinel_unreachable_broker_reported(sentinel_controller):
+    c = sentinel_controller
+
+    def boom():
+        raise OSError("connection refused")
+
+    c.workload_pollers["b1"] = boom
+    c.run_workload_check(now=3000.0)
+    assert c.workload_status()["unreachableBrokers"] == ["b1"]
+
+
+# -- end to end: zipf mix through a real cluster ------------------------------
+
+def test_workload_debug_endpoint_zipf_mix(tmp_path):
+    """Acceptance demo: a zipf mix over 20+ distinct shapes, served queries
+    carrying their fingerprint, /debug/workload top-K + drill-down, and
+    conservation across the whole run."""
+    from pinot_tpu.cluster import QuickCluster
+
+    schema = Schema("wl", [dimension("site", DataType.STRING),
+                           metric("v", DataType.LONG),
+                           metric("w", DataType.LONG)])
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    cfg = TableConfig("wl", replication=1)
+    cluster.create_table(schema, cfg)
+    cluster.ingest_columns(cfg, {
+        "site": np.array(["a", "b", "c", "d"] * 64),
+        "v": np.arange(256, dtype=np.int64),
+        "w": np.arange(256, dtype=np.int64) % 7,
+    })
+    cluster.catalog.put_property("clusterConfig/broker.slow.query.ms", "0")
+
+    # 5 aggs x 2 columns x 2 predicate structures = 20 structurally
+    # distinct plans (a template varying only its literals is ONE shape)
+    aggs = ["COUNT(*)", "SUM(v)", "MAX(v)", "MIN(w)", "SUM(w)"]
+    cols = ["v", "w"]
+    forms = ["WHERE {c} > {{n}}", "WHERE {c} > {{n}} AND site = 'a'"]
+    templates = [f"SELECT {a} FROM wl " + f.format(c=c) + " LIMIT 10"
+                 for a in aggs for c in cols for f in forms]
+    assert len(templates) >= 20
+
+    rng = np.random.default_rng(11)
+    ranks = np.concatenate([
+        np.arange(len(templates)),
+        np.minimum(rng.zipf(1.4, size=40) - 1, len(templates) - 1)])
+    reg = cluster.broker.workload
+    base_total = reg.snapshot()["totalQueries"]
+    fps = {}
+    for r in ranks:
+        res = cluster.query(templates[int(r)].format(
+            n=int(rng.integers(0, 100))))
+        fp = res.stats.get("workloadFingerprint")
+        assert fp, "served query must carry its fingerprint"
+        fps.setdefault(int(r), set()).add(fp)
+
+    # literal-varied queries of one template landed in ONE shape each,
+    # distinct templates in distinct shapes
+    assert all(len(s) == 1 for s in fps.values())
+    assert len({next(iter(s)) for s in fps.values()}) == len(templates)
+
+    snap = reg.snapshot()
+    assert snap["totalQueries"] - base_total == len(ranks)
+    assert sum(s["count"] for s in snap["shapes"]) \
+        + snap["evictedQueries"] == snap["totalQueries"]
+    assert abs(sum(s["timeSharePct"] for s in snap["shapes"]) - 100.0) < 1.0
+
+    # top-K trims the ranking but not the conservation counters
+    top = reg.snapshot(k=5)
+    assert len(top["shapes"]) == 5
+    assert top["totalQueries"] == snap["totalQueries"]
+    assert [s["totalTimeMs"] for s in top["shapes"]] \
+        == sorted((s["totalTimeMs"] for s in top["shapes"]), reverse=True)
+
+    # drill-down resolves; the slow log line joins on the same fingerprint
+    hot = top["shapes"][0]["fingerprint"]
+    detail = reg.shape(hot)
+    assert detail["fingerprint"] == hot and "slotValues" in detail
+    assert reg.shape("0" * 16) is None
+    slow_fp = cluster.broker._recent_slow[-1]["workloadFingerprint"]
+    assert any(slow_fp in s for s in fps.values())
+
+    # the broker's main /debug body carries the light rollup
+    summary = cluster.broker.debug_stats()["workload"]
+    assert summary["totalQueries"] == snap["totalQueries"]
