@@ -320,15 +320,16 @@ def run_rules(rules: Sequence[Rule], modules: Sequence[Module],
 
 def all_rules() -> List[Rule]:
     from . import (accumulation, admission_hygiene, blocking_in_loop,
-                   collective_hygiene, drift_guards, exception_hygiene,
-                   filter_path, fused_path, ingest_hot_loop, jit_hygiene,
-                   join_path, lock_discipline, memory_hygiene,
-                   transport_bypass)
+                   collective_hygiene, drift_guards, events_drift,
+                   exception_hygiene, filter_path, fused_path,
+                   ingest_hot_loop, jit_hygiene, join_path, lock_discipline,
+                   memory_hygiene, transport_bypass)
     rules: List[Rule] = []
     for pack in (jit_hygiene, lock_discipline, blocking_in_loop, drift_guards,
-                 transport_bypass, collective_hygiene, ingest_hot_loop,
-                 exception_hygiene, admission_hygiene, filter_path,
-                 fused_path, join_path, memory_hygiene, accumulation):
+                 events_drift, transport_bypass, collective_hygiene,
+                 ingest_hot_loop, exception_hygiene, admission_hygiene,
+                 filter_path, fused_path, join_path, memory_hygiene,
+                 accumulation):
         rules.extend(pack.rules())
     return rules
 

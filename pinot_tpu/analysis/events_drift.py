@@ -1,0 +1,142 @@
+"""event-kind-drift: the event journal's kind registry stays closed and
+documented.
+
+The journal (`utils/events.py`) rejects unregistered kinds at emit time with a
+ValueError — but only on the code paths a test run happens to execute. This
+rule closes the gap statically, the same way `drift-metric-glossary` covers
+every registry factory call site:
+
+* every `emit("kind", ...)` call site in the package must pass a kind that is
+  registered in the `KINDS` table (a typo'd kind is a state transition that
+  silently never reaches the flight recorder — until it crashes the emitting
+  path in production);
+* every registered kind must appear backticked in README.md's Observability
+  section, so the operator reading a timeline can look up what each kind
+  means.
+
+Call-site detection is deliberately narrow to keep unrelated `emit` helpers
+(e.g. the EXPLAIN tree walker) out of scope: a call counts only when its
+callee name was imported from the events module (`from ..utils.events import
+emit as emit_event`) or when it is an `.emit(...)` attribute call on a
+journal-named receiver (`JOURNAL.emit`, `self.journal.emit`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Module, Rule, dotted_name
+
+_EVENTS_MODULE = "pinot_tpu/utils/events.py"
+
+
+def _registered_kinds(ctx: AnalysisContext) -> Tuple[Set[str], int]:
+    """Kind names from the events module's KINDS dict literal, plus the
+    assignment's line (the doc-drift finding anchor)."""
+    mod = ctx.module(_EVENTS_MODULE)
+    if mod is None or mod.tree is None or \
+            not isinstance(mod.tree, ast.Module):
+        return set(), 1
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target: Optional[ast.expr] = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):   # KINDS: Dict[...] = {...}
+            target = node.target
+        else:
+            continue
+        if isinstance(target, ast.Name) and target.id == "KINDS" and \
+                isinstance(node.value, ast.Dict):
+            kinds = {k.value for k in node.value.keys
+                     if isinstance(k, ast.Constant) and
+                     isinstance(k.value, str)}
+            return kinds, node.lineno
+    return set(), 1
+
+
+def _emit_aliases(module: Module) -> Set[str]:
+    """Local names bound to the events module's `emit` via import."""
+    out: Set[str] = set()
+    for node in module.nodes_of(ast.ImportFrom):
+        if not node.module or not node.module.split(".")[-1] == "events":
+            continue
+        for alias in node.names:
+            if alias.name == "emit":
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _emitted_kind(node: ast.Call) -> Optional[ast.Constant]:
+    """The string-constant kind argument of an emit call, if judgeable."""
+    arg: Optional[ast.expr] = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "kind":
+            arg = kw.value
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg
+    return None
+
+
+def _observability_section(readme: str) -> str:
+    if "## Observability" not in readme:
+        return ""
+    tail = readme.split("## Observability", 1)[1]
+    m = re.search(r"\n## ", tail)
+    return tail[:m.start()] if m else tail
+
+
+class EventKindDriftRule(Rule):
+    id = "event-kind-drift"
+    description = ("emit() call sites must use kinds registered in the "
+                   "event journal's KINDS table, and every registered kind "
+                   "must be documented in the README Observability section")
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        kinds, kinds_line = _registered_kinds(ctx)
+        if not kinds:   # scanning outside the repo (scratch fixtures)
+            return ()
+        out: List[Finding] = []
+        for module, line, kind in self._emit_sites(ctx):
+            if kind not in kinds:
+                out.append(Finding(
+                    self.id, module.rel, line,
+                    f"event kind {kind!r} is emitted here but not "
+                    "registered in utils/events.py KINDS — the call would "
+                    "raise ValueError at runtime; register the kind (with "
+                    "severity + description) first"))
+        documented = set(re.findall(r"`([a-z][a-z0-9.]+)`",
+                                    _observability_section(ctx.readme())))
+        if documented:   # no README in scope: skip the doc-drift half
+            for kind in sorted(kinds - documented):
+                out.append(Finding(
+                    self.id, _EVENTS_MODULE, kinds_line,
+                    f"event kind `{kind}` is registered in KINDS but "
+                    "missing from README.md's Observability kind glossary "
+                    "— document it before emitting it"))
+        return out
+
+    @staticmethod
+    def _emit_sites(ctx: AnalysisContext
+                    ) -> Iterable[Tuple[Module, int, str]]:
+        """(module, line, kind) for every judgeable journal-emit call."""
+        for module in ctx.modules:
+            if module.tree is None:
+                continue
+            aliases = _emit_aliases(module)
+            for node in module.nodes_of(ast.Call):
+                func = node.func
+                is_emit = (isinstance(func, ast.Name) and func.id in aliases)
+                if not is_emit and isinstance(func, ast.Attribute) and \
+                        func.attr == "emit":
+                    recv = dotted_name(func.value).split(".")[-1].lower()
+                    is_emit = recv == "journal" or recv.endswith("journal")
+                if not is_emit:
+                    continue
+                kind = _emitted_kind(node)
+                if kind is not None:
+                    yield module, kind.lineno, str(kind.value)
+
+
+def rules() -> List[Rule]:
+    return [EventKindDriftRule()]

@@ -34,6 +34,7 @@ from typing import Dict, Optional
 
 from ..constants import UNBOUNDED_LIMIT
 from ..query.scheduler import QueryRejectedError
+from ..utils.events import emit as emit_event
 
 HEALTHY = "HEALTHY"
 SHEDDING = "SHEDDING"
@@ -48,8 +49,9 @@ class AdmissionController:
     #: Retry-After fallback when the latency histogram has no samples yet
     DEFAULT_RETRY_MS = 50.0
 
-    def __init__(self, catalog):
+    def __init__(self, catalog, node: str = ""):
         self.catalog = catalog
+        self._node = node          # event journal label (the broker's id)
         self._lock = threading.Lock()
         self._inflight = 0
         self._state = HEALTHY
@@ -150,8 +152,15 @@ class AdmissionController:
         from ..utils.metrics import get_registry
         p99, n = self.predicted_service_ms()
         with self._lock:
+            prev = self._state
             state = self._state = self._compute_state(self._inflight, p99, n)
+            inflight = self._inflight
         get_registry().gauge("pinot_broker_shed_state").set(STATE_LEVEL[state])
+        if state != prev:
+            # edge-triggered: one event per flip, not one per admitted query
+            emit_event("admission.state", node=self._node or None,
+                       severity="INFO" if state == HEALTHY else "WARN",
+                       fromState=prev, toState=state, inflight=inflight)
 
         # a query that cannot meet its own deadline shed up front, whatever
         # the state: the predicted per-dispatch service time already exceeds

@@ -29,6 +29,7 @@ from ..query.reduce import SegmentResult, merge_segment_results, reduce_to_resul
 from ..query.result import ResultTable
 from ..sql.ast import to_sql
 from ..table import TableType
+from ..utils.events import emit as emit_event
 from .catalog import Catalog, InstanceInfo
 from .routing import RoutingManager
 
@@ -47,8 +48,9 @@ class FailureDetector:
 
     def __init__(self, routing, initial_interval_s: float = 0.5,
                  backoff_factor: float = 2.0, max_interval_s: float = 30.0,
-                 probe_timeout_s: float = 10.0):
+                 probe_timeout_s: float = 10.0, node: str = ""):
         self.routing = routing
+        self._node = node          # event journal label (the broker's id)
         self.initial_interval_s = initial_interval_s
         self.backoff_factor = backoff_factor
         self.max_interval_s = max_interval_s
@@ -67,11 +69,17 @@ class FailureDetector:
             self._probes[server_id] = probe
 
     def notify_unhealthy(self, server_id: str) -> None:
+        newly_down = False
         with self._lock:
             if server_id in self._probes and server_id not in self._pending:
                 self._pending[server_id] = (
                     time.time() + self.initial_interval_s,
                     self.initial_interval_s)
+                newly_down = True
+        if newly_down:
+            # edge, not level: repeated failures while probing stay silent
+            emit_event("server.down", node=self._node or None,
+                       server=server_id)
 
     def notify_healthy(self, server_id: str) -> None:
         with self._lock:
@@ -163,6 +171,8 @@ class FailureDetector:
                         self._fail_counts.get(server_id, 0) + 1
             if ok:
                 self.routing.mark_server_healthy(server_id)
+                emit_event("server.up", node=self._node or None,
+                           server=server_id)
 
     def start(self, tick_s: float = 0.25) -> None:
         def loop():
@@ -245,12 +255,13 @@ class Broker:
         from ..query.scheduler import QueryQuotaManager
         from .admission import AdmissionController
         self.quota = QueryQuotaManager(catalog)
-        self.admission = AdmissionController(catalog)
+        self.admission = AdmissionController(catalog, node=instance_id)
         # server_id -> monotonic time until which the server is considered in
         # backpressure (fed by Retry-After hints on 429s); hedges and retry
         # rounds avoid these servers instead of amplifying their overload
         self._backpressure_until: Dict[str, float] = {}
-        self.failure_detector = FailureDetector(self.routing)
+        self.failure_detector = FailureDetector(self.routing,
+                                                node=instance_id)
         # workload intelligence plane: per-shape profiles keyed by plan
         # fingerprint, LRU-bounded with overflow counters (/debug/workload)
         from .workload import WorkloadRegistry
@@ -286,6 +297,8 @@ class Broker:
             self.failure_detector.register_probe(server_id, probe)
         self.failure_detector.notify_healthy(server_id)
         self.routing.mark_server_healthy(server_id)
+        emit_event("server.registered", node=self.instance_id,
+                   server=server_id)
 
     def unregister_server(self, server_id: str) -> None:
         """Forget a decommissioned server: every handle map + detector entry
@@ -297,6 +310,8 @@ class Broker:
             self._urls.pop(server_id, None)
         self.failure_detector.remove(server_id)
         self.routing.mark_server_unhealthy(server_id)
+        emit_event("server.unregistered", node=self.instance_id,
+                   server=server_id)
 
     # ------------------------------------------------------------------
     def handle_query(self, sql: str, stmt=None) -> ResultTable:
@@ -1008,6 +1023,8 @@ class Broker:
                   if hint_ms is not None and hint_ms > 0
                   else self.BACKPRESSURE_DEFAULT_S)
         self._backpressure_until[server_id] = time.monotonic() + hold_s
+        emit_event("backpressure.hold", node=self.instance_id,
+                   server=server_id, holdMs=round(hold_s * 1000.0, 3))
 
     def _backpressured_servers(self) -> Set[str]:
         now = time.monotonic()
@@ -1064,6 +1081,7 @@ class Broker:
             # that pushed it past HEALTHY
             hedge_on = False
             reg.counter("pinot_broker_hedges_suppressed").inc()
+            emit_event("hedge.suppressed", node=self.instance_id, table=table)
         hedges_sent = 0
         queried = failed = 0
         owner: Dict[Future, _DispatchUnit] = {u.primary: u for u in units}

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 from ..segment.format import read_json, CREATION_META_FILE, SEGMENT_METADATA_FILE
 from ..table import TableConfig
+from ..utils.events import emit as emit_event
 from .assignment import balanced_assign, compute_counts
 from .catalog import (CONSUMING, COLUMN_STATS_KEY, ONLINE, Catalog,
                       SegmentMeta, STATUS_DONE, STATUS_IN_PROGRESS,
@@ -224,6 +225,8 @@ class LLCSegmentManager:
         # partition cadence, not query traffic; DONE FSMs are the crash-replay
         # record the completion protocol re-answers duplicate commits from
         self.fsms[name] = CompletionFSM(name, num_replicas=len(chosen))
+        emit_event("segment.consuming.created", node="controller", table=table,
+                   segment=name, partition=partition, sequence=seq)
         return name
 
     # -- completion protocol endpoints (reference: LLCSegmentCompletionHandlers) ----
@@ -350,6 +353,8 @@ class LLCSegmentManager:
                 self.quarantined.get(segment, 0) + max_tries
         if first_time:
             reg.counter("pinot_controller_deepstore_quarantined").inc()
+            emit_event("deepstore.quarantined", node="controller",
+                       segment=segment, attempts=max_tries)
         return False
 
     def clear_quarantine(self, segment: Optional[str] = None) -> None:
@@ -387,6 +392,10 @@ class LLCSegmentManager:
         assignment = self.catalog.ideal_state.get(table, {}).get(segment, {})
         self.catalog.update_ideal_state(
             table, {segment: {s: ONLINE for s in assignment}})
+        emit_event("segment.committed", node="controller", table=table,
+                   segment=segment, committer=server, endOffset=end_offset)
+        emit_event("segment.online", node="controller", table=table,
+                   segment=segment)
 
         # create the successor CONSUMING segment from the end offset — unless
         # consumption is paused, in which case resume (or the validation
@@ -494,6 +503,8 @@ class LLCSegmentManager:
                 # fresh election among the new replicas
                 self.fsms[seg] = CompletionFSM(seg, num_replicas=len(chosen))
                 moved.append(seg)
+                emit_event("segment.reassigned", node="controller",
+                           table=table, segment=seg, servers=sorted(chosen))
         return moved
 
     def validate(self) -> Dict[str, List[str]]:
@@ -551,6 +562,8 @@ class LLCSegmentManager:
                     cur.download_path = uri
                     self.catalog.put_segment_meta(cur)
                 healed.append(name)
+                emit_event("deepstore.healed", node="controller", table=table,
+                           segment=name)
         return healed
 
     def _meta(self, segment: str) -> Optional[SegmentMeta]:

@@ -10,8 +10,11 @@ write metadata, update ideal state), `RetentionManager` (expiry deletion),
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -107,6 +110,29 @@ class Controller:
         self.workload_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
         self.scheduler.register(PeriodicTask("WorkloadSentinel", 60.0,
                                              self.run_workload_check))
+        # event journal plane: cursor-incremental pulls of every node's
+        # journal (/debug/events?since=) merged into one bounded cluster
+        # timeline; verdict edges trip the flight recorder, which freezes an
+        # incident bundle (recent timeline + /debug snapshots + slow-query
+        # trace ids) into a bounded incident ring (/debug/incidents)
+        self._events_lock = threading.Lock()
+        self._timeline: deque = deque()              # merged, arrival order
+        self._event_cursors: Dict[str, int] = {}     # source id -> last gseq
+        self._events_unreachable: List[str] = []
+        self._incidents: deque = deque()             # oldest -> newest
+        self._incident_seq = 0
+        # in-proc clusters register extra journals here (node -> fn(since));
+        # OS-process nodes are discovered via GET /debug/events?since=
+        self.event_pollers: Dict[str, Callable[[int], Dict[str, object]]] = {}
+        # incident snapshot sources (node -> fn() -> /debug payload); in-proc
+        # clusters register Broker.debug_stats, OS-process brokers via HTTP
+        self.incident_pollers: Dict[str, Callable[[], Dict[str, object]]] = {}
+        # edge-trigger memory for the four verdict planes: previous status
+        # per table (per fingerprint for workload), pruned with the plane
+        self._verdict_prev: Dict[str, Dict[str, str]] = {
+            "ingestion": {}, "slo": {}, "memory": {}, "workload": {}}
+        self.scheduler.register(PeriodicTask("EventTimelineCollector", 10.0,
+                                             self.run_event_check))
         catalog.register_instance(InstanceInfo(instance_id, "controller"))
 
     def start_periodic_tasks(self) -> None:
@@ -344,6 +370,9 @@ class Controller:
         from ..utils.metrics import get_registry
         get_registry().counter("pinot_controller_cold_demotions",
                                {"table": table}).inc()
+        from ..utils.events import emit as emit_event
+        emit_event("segment.cold.demoted", node=self.instance_id,
+                   table=table, segment=segment)
         return True
 
     def run_retention(self, now_ms: Optional[int] = None) -> List[str]:
@@ -544,11 +573,14 @@ class Controller:
             reg.gauge(self._INGESTION_GAUGES[2], labels).set(
                 st["maxFreshnessLagMs"])
             out[table] = st
+            self._note_verdict("ingestion", table, str(st["ingestionState"]),
+                               list(st.get("reasons") or []))
         for table in self._ingestion_tables - set(out):
             for g in self._INGESTION_GAUGES:
                 reg.remove_gauge(g, {"table": table})
         self._ingestion_tables = set(out)
         self._ingestion_status = out
+        self._prune_verdicts("ingestion", set(out))
         return {t: str(s["ingestionState"]) for t, s in out.items()}
 
     # -- SLO layer (reference frame: the SRE-workbook multi-window,
@@ -605,6 +637,7 @@ class Controller:
             self._slo_tables = set()
             self._slo_status = {}
             self._slo_samples.clear()
+            self._prune_verdicts("slo", set())
             return {}
         fast_s = self._cluster_config_float("slo.window.fast.s", 300.0)
         slow_s = self._cluster_config_float("slo.window.slow.s", 3600.0)
@@ -692,12 +725,14 @@ class Controller:
                 "totals": {k: round(v, 3) for k, v in agg.items()},
                 "unreachableBrokers": sorted(unreachable),
             }
+            self._note_verdict("slo", table, verdict, reasons)
         for table in self._slo_tables - set(out):
             for g in self._SLO_GAUGES:
                 reg.remove_gauge(g, {"table": table})
             self._slo_samples.pop(table, None)
         self._slo_tables = set(out)
         self._slo_status = out
+        self._prune_verdicts("slo", set(out))
         return {t: str(s["sloState"]) for t, s in out.items()}
 
     def slo_status(self, table: str) -> Dict[str, object]:
@@ -771,6 +806,7 @@ class Controller:
             reg.remove_gauge("pinot_controller_workload_regressing_shapes")
             self._workload_samples.clear()
             self._workload_status = {}
+            self._prune_verdicts("workload", set())
             return {}
         fast_s = self._cluster_config_float("slo.window.fast.s", 300.0)
         slow_s = self._cluster_config_float("slo.window.slow.s", 3600.0)
@@ -869,6 +905,11 @@ class Controller:
             "regressions": regressions,
             "unreachableBrokers": sorted(unreachable),
         }
+        for fp, v in verdicts.items():
+            self._note_verdict(
+                "workload", fp, v,
+                [regressions[fp]["reason"]] if fp in regressions else [])
+        self._prune_verdicts("workload", set(verdicts))
         return verdicts
 
     def workload_status(self) -> Dict[str, object]:
@@ -1004,11 +1045,13 @@ class Controller:
                 "unreachableServers": sorted(unreachable),
                 "tiering": tiering,
             }
+            self._note_verdict("memory", table, verdict, reasons)
         for table in self._memory_tables - set(out):
             for g in self._MEMORY_TABLE_GAUGES:
                 reg.remove_gauge(g, {"table": table})
         self._memory_tables = set(out)
         self._memory_status = out
+        self._prune_verdicts("memory", set(out))
         return {t: str(s["memoryState"]) for t, s in out.items()}
 
     def memory_status(self, table: str) -> Dict[str, object]:
@@ -1028,6 +1071,245 @@ class Controller:
         return {"table": table, "memoryState": "UNKNOWN", "reasons": [],
                 "residentBytes": 0, "servers": {},
                 "message": "memory check has not run yet"}
+
+    # -- verdict edge-triggering + event timeline + flight recorder ---------
+
+    _VERDICT_KINDS = {"ingestion": "verdict.ingestion", "slo": "verdict.slo",
+                      "memory": "verdict.memory",
+                      "workload": "verdict.workload"}
+    _VERDICT_SEVERITY = {"HEALTHY": "INFO", "DEGRADED": "WARN",
+                         "UNHEALTHY": "ERROR"}
+    VERDICT_LOGGER = "pinot_tpu.verdicts"
+
+    def _note_verdict(self, plane: str, key: str, status: str,
+                      reasons: List[str]) -> None:
+        """Edge-trigger one verdict plane's (table-or-shape, status): a no-op
+        while the status is unchanged, so repeated DEGRADED ticks emit
+        exactly one transition event and one log line. A change counts one
+        `pinot_controller_verdict_transitions{kind}` tick; a transition to
+        UNHEALTHY (DEGRADED too when `controller.incident.on.degraded` is
+        set) trips the flight recorder."""
+        prev_map = self._verdict_prev[plane]
+        prev = prev_map.get(key, "HEALTHY")
+        if status == prev:
+            return
+        prev_map[key] = status
+        from ..utils.events import emit as emit_event
+        from ..utils.metrics import get_registry
+        get_registry().counter("pinot_controller_verdict_transitions",
+                               {"kind": plane}).inc()
+        logging.getLogger(self.VERDICT_LOGGER).warning(
+            "%s verdict for %s: %s -> %s%s", plane, key, prev, status,
+            f" ({'; '.join(map(str, reasons[:3]))})" if reasons else "")
+        attrs = {"fromState": prev, "toState": status,
+                 "reasons": [str(r) for r in reasons[:3]]}
+        if plane == "workload":
+            attrs["fingerprint"] = key
+            emit_event(self._VERDICT_KINDS[plane], node=self.instance_id,
+                       severity=self._VERDICT_SEVERITY.get(status, "WARN"),
+                       **attrs)
+        else:
+            emit_event(self._VERDICT_KINDS[plane], node=self.instance_id,
+                       table=key,
+                       severity=self._VERDICT_SEVERITY.get(status, "WARN"),
+                       **attrs)
+        on_degraded = str(self.catalog.get_property(
+            "clusterConfig/controller.incident.on.degraded",
+            "false")).lower() == "true"
+        if status == "UNHEALTHY" or (status == "DEGRADED" and on_degraded):
+            self._capture_incident(plane, key, status, reasons)
+
+    def _prune_verdicts(self, plane: str, live_keys) -> None:
+        """Drop edge-trigger memory for tables/shapes the plane no longer
+        judges (table drop, evicted fingerprint) — the map stays bounded by
+        the plane's live key set."""
+        prev_map = self._verdict_prev[plane]
+        for k in list(prev_map):
+            if k not in live_keys:
+                prev_map.pop(k)
+
+    def _iter_event_pollers(self):
+        """(node id, poll fn taking the since-cursor) for every journal
+        source: explicitly registered in-proc pollers first, then instances
+        advertising an HTTP port — their GET /debug/events?since= route
+        (the memory-checker discovery pattern)."""
+        seen = set()
+        for nid, poll in list(self.event_pollers.items()):
+            seen.add(nid)
+            yield nid, poll
+        for info in list(self.catalog.instances.values()):
+            if info.role not in ("server", "broker") or not info.port \
+                    or not info.alive or info.instance_id in seen:
+                continue
+
+            def poll(since, url=info.url):
+                from .http_service import get_json
+                return get_json(f"{url}/debug/events?since={int(since)}",
+                                timeout=5.0, retries=1)
+            yield info.instance_id, poll
+
+    def run_event_check(self) -> int:
+        """Periodic timeline merge: pull every journal source's NEW events
+        (cursor-incremental, so a poll ships only what arrived since the
+        last tick) into the bounded merged timeline. The controller's own
+        process journal is always a source — in-proc clusters share it
+        across roles, so it alone carries the whole timeline there. Returns
+        the number of events merged this tick."""
+        from ..utils.events import get_journal
+        cap = max(1, int(self._cluster_config_float(
+            "controller.events.ring.size", 1024) or 1024))
+        local = get_journal()
+        sources = [("local",
+                    lambda since: local.events_since(since))]
+        sources.extend(self._iter_event_pollers())
+        merged = 0
+        unreachable: List[str] = []
+        seen_ids = set()
+        for nid, poll in sources:
+            seen_ids.add(nid)
+            with self._events_lock:
+                since = self._event_cursors.get(nid, 0)
+            try:
+                payload = poll(since) or {}
+            except Exception:
+                unreachable.append(nid)   # cursor unchanged; next tick re-pulls
+                continue
+            rows = payload.get("events") or []
+            cursor = payload.get("cursor")
+            with self._events_lock:
+                for ev in rows:
+                    if isinstance(ev, dict):
+                        self._timeline.append(dict(ev))
+                        merged += 1
+                if isinstance(cursor, (int, float)):
+                    self._event_cursors[nid] = int(cursor)
+                while len(self._timeline) > cap:
+                    self._timeline.popleft()
+        with self._events_lock:
+            # cursors of departed sources are dropped with the source
+            for nid in list(self._event_cursors):
+                if nid not in seen_ids:
+                    self._event_cursors.pop(nid)
+            self._events_unreachable = sorted(unreachable)
+        return merged
+
+    def timeline(self, kind: Optional[str] = None, table: Optional[str] = None,
+                 severity: Optional[str] = None, since: Optional[float] = None,
+                 limit: Optional[int] = None) -> List[Dict[str, object]]:
+        """The merged cluster timeline in causal order — sorted on
+        (tsMs, node, seq), the deterministic tiebreak — with the
+        /debug/timeline filters: exact kind/table match, `severity` admitting
+        its level and everything worse, `since` an epoch-ms lower bound, and
+        `limit` keeping the newest N after filtering."""
+        from ..utils.events import SEVERITIES
+        with self._events_lock:
+            rows = list(self._timeline)
+        rows.sort(key=lambda e: (e.get("tsMs", 0), str(e.get("node", "")),
+                                 e.get("seq", 0)))
+        if kind:
+            rows = [e for e in rows if e.get("kind") == kind]
+        if table:
+            rows = [e for e in rows if e.get("table") == table]
+        if severity and severity in SEVERITIES:
+            floor = SEVERITIES.index(severity)
+            rows = [e for e in rows
+                    if e.get("severity") in SEVERITIES
+                    and SEVERITIES.index(e["severity"]) >= floor]
+        if since is not None:
+            rows = [e for e in rows if e.get("tsMs", 0) >= float(since)]
+        if limit is not None:
+            rows = rows[-max(0, int(limit)):]
+        return rows
+
+    def _iter_incident_pollers(self):
+        """(node id, poll fn) for incident snapshot sources: registered
+        in-proc pollers (Broker.debug_stats) first, then HTTP brokers via
+        their GET /debug route."""
+        seen = set()
+        for nid, poll in list(self.incident_pollers.items()):
+            seen.add(nid)
+            yield nid, poll
+        for info in list(self.catalog.instances.values()):
+            if info.role != "broker" or not info.port or not info.alive \
+                    or info.instance_id in seen:
+                continue
+
+            def poll(url=info.url):
+                from .http_service import get_json
+                return get_json(f"{url}/debug", timeout=5.0, retries=1)
+            yield info.instance_id, poll
+
+    def _capture_incident(self, plane: str, key: str, status: str,
+                          reasons: List[str]) -> Dict[str, object]:
+        """Flight recorder: freeze one incident bundle — the freshest N
+        timeline events, the controller's verdict-plane snapshots, every
+        incident poller's /debug payload (admission, detector, workload,
+        recent slow queries), and the slow-query trace ids those payloads
+        carry — into the bounded incident ring. Called on verdict edges
+        only, so one UNHEALTHY episode captures exactly one bundle."""
+        from ..utils.events import emit as emit_event
+        from ..utils.metrics import get_registry
+        n_events = max(1, int(self._cluster_config_float(
+            "controller.incident.events", 100) or 100))
+        ring_cap = max(1, int(self._cluster_config_float(
+            "controller.incident.ring.size", 8) or 8))
+        # pull journals NOW: the bundle must include the very transitions
+        # that tripped the verdict, not wait out the collector's cadence
+        self.run_event_check()
+        nodes: Dict[str, object] = {}
+        slow_trace_ids: List[str] = []
+        for nid, poll in self._iter_incident_pollers():
+            try:
+                snap = poll()
+            except Exception:
+                nodes[nid] = {"unreachable": True}
+                continue
+            nodes[nid] = snap
+            if isinstance(snap, dict):
+                for q in snap.get("recentSlowQueries") or []:
+                    tid = (q.get("stats") or {}).get("traceId") \
+                        if isinstance(q, dict) else None
+                    if tid and tid not in slow_trace_ids:
+                        slow_trace_ids.append(tid)
+        snapshots = {
+            "ingestionStatus": {t: {k: v for k, v in s.items()
+                                    if k != "servers"}
+                                for t, s in self._ingestion_status.items()},
+            "sloStatus": dict(self._slo_status),
+            "memoryStatus": dict(self._memory_status),
+            "workloadStatus": dict(self._workload_status),
+            "nodes": nodes,
+        }
+        with self._events_lock:
+            events = list(self._timeline)[-n_events:]
+            self._incident_seq += 1
+            bundle = {
+                "id": self._incident_seq,
+                "tsMs": int(time.time() * 1000),
+                "plane": plane,
+                "key": key,
+                "status": status,
+                "reasons": [str(r) for r in reasons],
+                "events": events,
+                "snapshots": snapshots,
+                "slowTraceIds": slow_trace_ids,
+            }
+            self._incidents.append(bundle)
+            while len(self._incidents) > ring_cap:
+                self._incidents.popleft()
+        get_registry().counter("pinot_controller_incidents_captured").inc()
+        emit_event("incident.captured", node=self.instance_id,
+                   plane=plane, key=key, status=status)
+        return bundle
+
+    def incidents(self, limit: Optional[int] = None
+                  ) -> List[Dict[str, object]]:
+        """Newest-first retained incident bundles (the /debug/incidents
+        body)."""
+        with self._events_lock:
+            rows = list(self._incidents)
+        rows.reverse()
+        return rows[:limit] if limit is not None else rows
 
     def debug_stats(self) -> Dict[str, object]:
         """Controller /debug rollup: periodic task health (a silently-failing
@@ -1049,7 +1331,20 @@ class Controller:
                                   if k.startswith(("pinot_controller",
                                                    "pinot_periodic"))},
             "gaugeHistories": reg.gauge_histories("pinot_controller"),
+            "events": self.events_summary(),
         }
+
+    def events_summary(self) -> Dict[str, object]:
+        """Light timeline rollup for /debug (the full data lives behind the
+        /debug/timeline and /debug/incidents routes)."""
+        with self._events_lock:
+            return {
+                "timelineEvents": len(self._timeline),
+                "cursors": dict(self._event_cursors),
+                "unreachable": list(self._events_unreachable),
+                "incidents": len(self._incidents),
+                "incidentsCaptured": self._incident_seq,
+            }
 
     def cleanup_dead_minions(self) -> List[str]:
         """Reference: MinionInstancesCleanupTask — drop dead minion instances

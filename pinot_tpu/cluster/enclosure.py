@@ -53,6 +53,13 @@ class QuickCluster:
         # reads the broker's per-shape workload registry directly
         self.controller.workload_pollers[self.broker.instance_id] = \
             self.broker.workload.snapshot
+        # flight recorder: incident bundles freeze the broker's /debug view
+        # (admission state, failure detector, recent slow queries). No
+        # event_pollers entry — every role here shares the ONE process
+        # journal, which the timeline collector always reads as "local";
+        # registering it again per role would double-merge every event.
+        self.controller.incident_pollers[self.broker.instance_id] = \
+            self.broker.debug_stats
         from ..minion.tasks import MinionWorker
         self.minion = MinionWorker("minion_0", self.catalog, self.deepstore,
                                    self.controller,

@@ -134,6 +134,9 @@ class ControllerFailover:
         return True
 
     def _on_become_leader(self) -> None:
+        from ..utils.events import emit as emit_event
+        emit_event("leader.elected", node=self.election.instance_id,
+                   epoch=self.election.epoch)
         self._checkpoint()
         if not self._subscribed:  # a re-elected standby must not double-write
             self.controller.catalog.subscribe(self._on_catalog_event)
@@ -159,8 +162,11 @@ class ControllerFailover:
         """Renew the lease; on deposition, stop acting (tests drive this
         deterministically; production wraps it in utils.periodic)."""
         ok = self.election.renew()
-        if not ok and self.on_loss:
-            self.on_loss()
+        if not ok:
+            from ..utils.events import emit as emit_event
+            emit_event("leader.lost", node=self.election.instance_id)
+            if self.on_loss:
+                self.on_loss()
         return ok
 
     # -- standby side ------------------------------------------------------

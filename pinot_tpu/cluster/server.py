@@ -22,6 +22,7 @@ from ..parallel.combine import device_topk_screen
 from ..query.executor import ServerQueryExecutor
 from ..query.reduce import SegmentResult, merge_segment_results
 from ..segment.reader import ImmutableSegment, load_segment
+from ..utils.events import emit as emit_event
 from ..utils.faults import fault_point
 from .catalog import (COLD, CONSUMING, DROPPED, OFFLINE, ONLINE, Catalog,
                       InstanceInfo)
@@ -154,7 +155,7 @@ class ServerNode:
             except (TypeError, ValueError):
                 pass  # malformed knob: keep the probed capacity
         # tiered-storage lifecycle: HBM admission gate + pressure eviction
-        self.tiering = TieringManager(catalog)
+        self.tiering = TieringManager(catalog, node=instance_id)
         self._pressure_scheduler = None
         # optional admission control (reference: QueryScheduler wrapping the
         # executor; None = direct execution, the single-tenant test default)
@@ -726,6 +727,8 @@ class ServerNode:
                     self._load_online_segment(table, seg_name, mgr)
                 segments.extend(mgr.acquire([seg_name]))
                 self.tiering.note_cold_load()
+                emit_event("segment.cold.loaded", node=self.instance_id,
+                           table=table, segment=seg_name)
                 qstats.record(qstats.SEGMENTS_COLD_LOADED, 1)
                 qstats.record(qstats.COLD_LOAD_MS,
                               (_t.perf_counter() - t_load) * 1000)
@@ -741,6 +744,9 @@ class ServerNode:
                     admitted.append(seg)
                     if fresh:
                         self.tiering.note_promotion()
+                        emit_event("tier.promoted", node=self.instance_id,
+                                   table=table,
+                                   segment=getattr(seg, "name", ""))
                         qstats.record(qstats.TIER_PROMOTIONS, 1)
                 else:
                     host_tier.append(seg)

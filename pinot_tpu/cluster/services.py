@@ -28,7 +28,7 @@ from .broker import Broker
 from .catalog import Catalog, InstanceInfo
 from .controller import Controller
 from .http_service import (HttpService, binary_response, error_response,
-                           json_response, stats_route)
+                           json_response)
 from .deepstore import untar_segment
 from .remote import RemoteServerHandle
 from .server import ServerNode
@@ -40,6 +40,40 @@ def _metrics_route(parts, params, body):
     (reference: the JMX->Prometheus exporter over the yammer metrics registry)."""
     from ..utils.metrics import get_registry
     return 200, "text/plain; version=0.0.4", get_registry().render_prometheus().encode()
+
+
+def _events_route(params):
+    """GET /debug/events?since=<gseq> — the process journal's incremental
+    pull (shared by every role service): events past the cursor plus the
+    cursor to pass next time. The controller's timeline collector polls
+    this exactly like the memory checker polls /debug/memory."""
+    from ..utils.events import get_journal
+    try:
+        since = int(params.get("since", 0))
+    except (TypeError, ValueError):
+        since = 0
+    try:
+        limit = int(params["limit"]) if "limit" in params else None
+    except (TypeError, ValueError):
+        limit = None
+    return json_response(get_journal().events_since(since, limit))
+
+
+def _configure_journal(catalog, instance_id: str) -> None:
+    """Role-startup journal config: stamp the process journal's default node
+    label and apply the `events.ring.size` knob. One journal per process —
+    in OS-process deployments each role owns it; in-proc test clusters the
+    last-constructed service wins the default label (emit sites pass their
+    node explicitly, so only unlabeled emits are affected)."""
+    from ..utils.events import get_journal
+    cap = None
+    try:
+        raw = catalog.get_property("clusterConfig/events.ring.size", None)
+        if raw is not None:
+            cap = int(raw)
+    except (TypeError, ValueError):
+        cap = None   # malformed knob: keep the current capacity
+    get_journal().configure(node=instance_id, capacity=cap)
 
 
 def _untar_body(body: bytes, name: str, dest: str) -> str:
@@ -57,6 +91,7 @@ class ControllerService:
                  port: int = 0, access_control=None, ssl_context=None):
         self.controller = controller
         self.catalog = controller.catalog
+        _configure_journal(self.catalog, controller.instance_id)
         self.http = HttpService(host, port, access_control=access_control,
                                 ssl_context=ssl_context)
         self._version = 0
@@ -102,7 +137,7 @@ class ControllerService:
         s.route("POST", "replaceSegments", self._replace_segments, action="WRITE")
         s.route("POST", "ingestJobs", self._ingest_jobs, action="WRITE")
         s.route("GET", "metrics", _metrics_route)
-        s.route("GET", "debug", stats_route(controller.debug_stats))
+        s.route("GET", "debug", self._debug)
         s.route("POST", "sql", self._sql_proxy)  # query console backend
         s.route("GET", "", self._ui)       # admin UI at /
         s.route("GET", "ui", self._ui)
@@ -114,6 +149,43 @@ class ControllerService:
 
     def stop(self) -> None:
         self.http.stop()
+
+    def _debug(self, parts, params, body):
+        """GET /debug — controller rollup (periodic tasks, verdict planes).
+        GET /debug/events — this process's journal (incremental, ?since=).
+        GET /debug/timeline — the merged cluster timeline in causal order
+        (?kind= ?table= ?severity= ?since= ?limit= filters). GET
+        /debug/incidents — the flight recorder's retained bundles
+        (?id=<n> resolves one, 404 when evicted/unknown)."""
+        if parts and parts[0] == "events":
+            return _events_route(params)
+        if parts and parts[0] == "timeline":
+            try:
+                since = float(params["since"]) if "since" in params else None
+            except (TypeError, ValueError):
+                since = None
+            try:
+                limit = int(params["limit"]) if "limit" in params else None
+            except (TypeError, ValueError):
+                limit = None
+            rows = self.controller.timeline(
+                kind=params.get("kind"), table=params.get("table"),
+                severity=params.get("severity"), since=since, limit=limit)
+            return json_response({"events": rows, "count": len(rows)})
+        if parts and parts[0] == "incidents":
+            inc_id = params.get("id")
+            if inc_id:
+                for b in self.controller.incidents():
+                    if str(b.get("id")) == str(inc_id):
+                        return json_response(b)
+                return error_response(f"unknown incident {inc_id}", 404)
+            try:
+                limit = int(params["limit"]) if "limit" in params else None
+            except (TypeError, ValueError):
+                limit = None
+            rows = self.controller.incidents(limit)
+            return json_response({"incidents": rows, "count": len(rows)})
+        return json_response(self.controller.debug_stats())
 
     _UI_STYLE = (
         "<style>body{font-family:sans-serif;margin:2em}table{border-collapse:"
@@ -691,6 +763,7 @@ class ServerService:
         # startup from the `fault.schedule` clusterConfig knob
         from ..utils.faults import activate_from_config
         activate_from_config(server.catalog)
+        _configure_journal(server.catalog, server.instance_id)
         self.http = HttpService(host, port, access_control=access_control,
                                 ssl_context=ssl_context)
         # mux executor: queries demuxed off mux streams run here, NOT on the
@@ -917,6 +990,8 @@ class ServerService:
                                   "tables": self.server.ingestion_snapshot()})
         if parts and parts[0] == "memory":
             return json_response(self.server.memory_snapshot())
+        if parts and parts[0] == "events":
+            return _events_route(params)
         reg = get_registry()
         return json_response({
             "instance": self.server.instance_id,
@@ -1188,6 +1263,7 @@ class BrokerService:
         # and conn resets inject on the dispatching side)
         from ..utils.faults import activate_from_config
         activate_from_config(broker.catalog)
+        _configure_journal(broker.catalog, broker.instance_id)
         self._registered: Dict[str, str] = {}   # instance_id -> endpoint url
         self._handles: Dict[str, RemoteServerHandle] = {}  # for close()
         # `mux` pins the server-dispatch transport (tests dispatch both ways
@@ -1261,6 +1337,8 @@ class BrokerService:
             except (TypeError, ValueError):
                 k = None
             return json_response(self.broker.workload.snapshot(k))
+        if parts and parts[0] == "events":
+            return _events_route(params)
         if parts and parts[0] == "traces":
             from ..utils.trace import to_chrome_trace
             ring = self.broker.trace_ring

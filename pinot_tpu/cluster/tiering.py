@@ -40,6 +40,7 @@ import time
 from typing import Any, Dict, Iterable, Optional
 
 from ..engine.datablock import has_block, predicted_block_bytes, release_block
+from ..utils.events import emit as emit_event
 from ..utils.memledger import get_ledger
 from ..utils.metrics import get_registry
 
@@ -107,8 +108,9 @@ class TieringManager:
     clusters therefore run several managers against the shared ledger, which
     only makes each manager MORE conservative (it sees the process total)."""
 
-    def __init__(self, catalog=None):
+    def __init__(self, catalog=None, node: str = ""):
         self._catalog = catalog
+        self._node = node          # event journal label (the server's id)
         self._lock = threading.Lock()
         self._admitted: Dict[str, _Admitted] = {}
         self._counters = {"admissions": 0, "rejections": 0, "evictions": 0,
@@ -202,6 +204,8 @@ class TieringManager:
             get_registry().counter(
                 "pinot_server_hbm_admission_rejects",
                 {"table": table}).inc()
+            emit_event("tier.admission.rejected", node=self._node or None,
+                       table=table, segment=name, neededBytes=need)
             return False
         with self._lock:
             self._counters["admissions"] += 1
@@ -274,6 +278,7 @@ class TieringManager:
                 self._admitted.pop(name, None)
                 self._counters["evictions"] += 1
             get_registry().counter("pinot_server_hbm_evictions").inc()
+            emit_event("tier.evicted", node=self._node or None, segment=name)
             evicted += 1
         return evicted
 

@@ -13,7 +13,8 @@ queue thresholds, and per-table/per-reason shed counts, and a device-memory
 panel with the controller's per-table HBM verdict, resident bytes, and the
 worst per-server headroom, and a workload panel with the top query shapes by
 time share (count, p99, and the controller sentinel's regression verdict per
-plan fingerprint). The
+plan fingerprint), and a recent-events panel tailing the controller's merged
+causal timeline (`/debug/timeline`) with the incident count in its header. The
 operator's first stop when a dashboard shows a table going stale, an SLO
 burning, a server flapping, HBM filling up, or one query shape regressing:
 
@@ -101,9 +102,19 @@ def snapshot(controller_url: str, broker_url: Optional[str],
         out["periodicTasks"] = cdebug.get("periodicTasks", {})
         # sentinel verdicts join the workload panel's REGR column
         out["workloadStatus"] = cdebug.get("workloadStatus") or {}
+        # event-journal rollup (incident count joins the events panel header)
+        out["eventsSummary"] = cdebug.get("events") or {}
     except Exception as e:
         out["errors"].append(f"controller /debug: {e}")
         out["periodicTasks"] = {}
+    try:
+        # merged causal timeline (the recent-events panel, newest 8)
+        body = fetch(f"{controller_url}/debug/timeline?limit=8")
+        out["timeline"] = body.get("events") or []
+    # graftcheck: ignore[exception-hygiene] -- read-only dashboard poll;
+    # the missing body visibly drops the whole events panel
+    except Exception:
+        pass   # older controller: no events panel
     return out
 
 
@@ -282,6 +293,26 @@ def render(snap: Dict[str, Any]) -> str:
                 f"{server_id:<28} {d.get('state', '?'):<10} "
                 f"{int(d.get('consecutiveFailures', 0)):>6} "
                 f"{(f'{nxt}s' if nxt is not None else '-'):>10}")
+    timeline = snap.get("timeline") or []
+    if timeline:
+        summary = snap.get("eventsSummary") or {}
+        lines.append("")
+        lines.append(
+            f"recent events (controller timeline; "
+            f"{summary.get('timelineEvents', len(timeline))} merged, "
+            f"{summary.get('incidents', 0)} incidents)")
+        ecols = f"{'AGE':>8} {'NODE':<16} {'KIND':<26} {'SEV':<5}  SUBJECT"
+        lines.append(ecols)
+        lines.append("-" * len(ecols))
+        now_ms = time.time() * 1000.0
+        for ev in timeline[-8:]:
+            subject = ev.get("segment") or ev.get("table") or ""
+            age = _fmt_lag_ms(max(now_ms - float(ev.get("tsMs") or now_ms),
+                                  0.0))
+            lines.append(
+                f"{age:>8} {ev.get('node', '?'):<16} "
+                f"{ev.get('kind', '?'):<26} "
+                f"{ev.get('severity', '?'):<5}  {subject}")
     failing = {n: s for n, s in (snap.get("periodicTasks") or {}).items()
                if s.get("lastError")}
     for name, s in sorted(failing.items()):

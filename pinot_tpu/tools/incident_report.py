@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Pretty-print a flight-recorder incident bundle as a postmortem report.
+
+Feed it the JSON the controller's incident endpoint returns — the full ring
+listing or one bundle:
+
+    curl -s controller:9000/debug/incidents > incidents.json
+    python tools/incident_report.py incidents.json
+    curl -s controller:9000/debug/incidents?id=3 | python tools/incident_report.py
+
+Output, per bundle: the header (which verdict plane tripped, for which
+table/fingerprint, into which state, and why), the causal event timeline the
+recorder froze at capture time (the last N merged journal events, oldest
+first, so the sequence that led INTO the incident reads top-to-bottom), the
+frozen /debug snapshots (ingestion / SLO / memory / workload verdicts plus
+per-node health), and the slow-query trace ids to pull from `/debug/traces`
+for span-level drill-down. Pass `--id N` to render one bundle from a listing.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+#: event severities get a one-column marker so ERROR rows jump out of the
+#: timeline without color support
+_SEVERITY_MARK = {"INFO": " ", "WARN": "*", "ERROR": "!"}
+
+
+def _fmt_ts(ts_ms: Any, origin_ms: float) -> str:
+    """Offset from the first timeline event, in seconds — incident timelines
+    read as "what happened in the last minute", not absolute wall clock."""
+    try:
+        return f"+{(float(ts_ms) - origin_ms) / 1000.0:8.3f}s"
+    except (TypeError, ValueError):
+        return f"{ts_ms!s:>9}"
+
+
+def render_event_line(ev: Dict[str, Any], origin_ms: float) -> str:
+    mark = _SEVERITY_MARK.get(str(ev.get("severity", "")), " ")
+    subject = ev.get("segment") or ev.get("table") or ""
+    attrs = ev.get("attrs") or {}
+    detail = "  ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+    parts = [f"  {mark} {_fmt_ts(ev.get('tsMs'), origin_ms)}",
+             f"{ev.get('node', '?'):<14}", f"{ev.get('kind', '?'):<26}"]
+    if subject:
+        parts.append(f"{subject:<24}")
+    if detail:
+        parts.append(detail)
+    return " ".join(parts).rstrip()
+
+
+def render_timeline(events: List[Dict[str, Any]]) -> str:
+    out: List[str] = []
+    out.append(f"timeline ({len(events)} events, oldest first)")
+    if not events:
+        out.append("  (no events captured)")
+        return "\n".join(out)
+    origin = min(float(e.get("tsMs") or 0) for e in events)
+    out.extend(render_event_line(e, origin) for e in events)
+    return "\n".join(out)
+
+
+def _verdict_rows(doc: Any, state_key: str) -> List[str]:
+    """One row per table from a frozen verdict snapshot ({table: {...}})."""
+    rows: List[str] = []
+    if not isinstance(doc, dict):
+        return rows
+    for table in sorted(doc):
+        st = doc[table]
+        if not isinstance(st, dict):
+            continue
+        verdict = st.get(state_key) or st.get("verdict") or "?"
+        reasons = st.get("reasons") or []
+        suffix = f"  ({'; '.join(map(str, reasons[:2]))})" if reasons else ""
+        rows.append(f"    {table:<28} {verdict}{suffix}")
+    return rows
+
+
+def render_snapshots(snaps: Dict[str, Any]) -> str:
+    out: List[str] = ["frozen /debug snapshots"]
+    for key, title, state_key in (
+            ("ingestionStatus", "ingestion", "ingestionState"),
+            ("sloStatus", "slo", "verdict"),
+            ("memoryStatus", "memory", "verdict")):
+        doc = snaps.get(key)
+        if doc:
+            out.append(f"  {title}:")
+            out.extend(_verdict_rows(doc, state_key) or ["    (empty)"])
+    wl = snaps.get("workloadStatus")
+    if isinstance(wl, dict) and wl:
+        out.append("  workload:")
+        out.extend(f"    {fp:<28} {v}" for fp, v in sorted(wl.items()))
+    nodes = snaps.get("nodes")
+    if isinstance(nodes, dict) and nodes:
+        out.append("  nodes:")
+        for node in sorted(nodes):
+            snap = nodes[node]
+            if isinstance(snap, dict) and snap.get("unreachable"):
+                out.append(f"    {node:<28} UNREACHABLE at capture")
+            else:
+                out.append(f"    {node:<28} captured")
+    if len(out) == 1:
+        out.append("  (none)")
+    return "\n".join(out)
+
+
+def render_incident(bundle: Dict[str, Any]) -> str:
+    """One bundle's postmortem (the CLI prints it; tests assert on it)."""
+    out: List[str] = []
+    out.append(f"incident #{bundle.get('id', '?')}  "
+               f"plane={bundle.get('plane', '?')}  "
+               f"key={bundle.get('key', '?')}  "
+               f"-> {bundle.get('status', '?')}")
+    reasons = bundle.get("reasons") or []
+    for r in reasons:
+        out.append(f"  reason: {r}")
+    out.append("")
+    out.append(render_timeline(bundle.get("events") or []))
+    out.append("")
+    out.append(render_snapshots(bundle.get("snapshots") or {}))
+    traces = bundle.get("slowTraceIds") or []
+    if traces:
+        out.append("")
+        out.append("slow-query traces (pull from /debug/traces?id=...):")
+        out.extend(f"  {t}" for t in traces)
+    return "\n".join(out)
+
+
+def render(doc: Dict[str, Any], incident_id: int = -1) -> str:
+    """Full report: a single bundle renders alone; a ring listing renders
+    newest-first, or one bundle when `--id` selects it."""
+    if "incidents" not in doc and "plane" in doc:
+        return render_incident(doc)
+    bundles = [b for b in (doc.get("incidents") or []) if isinstance(b, dict)]
+    if incident_id >= 0:
+        for b in bundles:
+            if b.get("id") == incident_id:
+                return render_incident(b)
+        return f"unknown incident id {incident_id} (evicted, or never captured)"
+    if not bundles:
+        return "no incidents captured"
+    return "\n\n".join(render_incident(b) for b in bundles)
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv[1:])
+    if "-h" in args or "--help" in args:
+        print(__doc__)
+        return 0
+    incident_id, path = -1, None
+    i = 0
+    while i < len(args):
+        if args[i] == "--id" and i + 1 < len(args):
+            incident_id = int(args[i + 1])
+            i += 2
+        else:
+            path = args[i]
+            i += 1
+    if path and path != "-":
+        with open(path) as f:
+            doc = json.load(f)
+    else:
+        doc = json.load(sys.stdin)
+    print(render(doc, incident_id=incident_id))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -185,9 +185,43 @@ def _trace_entries(doc: Any) -> List[Dict[str, Any]]:
     return []
 
 
-def render_trace(entry: Dict[str, Any]) -> str:
+def _events_for(trace_id: Any, events: Any) -> List[Dict[str, Any]]:
+    """Journal events carrying this trace's id (the event journal stamps
+    `traceId` from the ambient trace at emit time)."""
+    if not trace_id or not isinstance(events, list):
+        return []
+    return [e for e in events
+            if isinstance(e, dict) and e.get("traceId") == trace_id]
+
+
+def render_events_section(events: List[Dict[str, Any]]) -> str:
+    """Cluster-state transitions that fired DURING this query (same traceId),
+    oldest first — a slow query that straddles a server.down or an admission
+    flip shows the transition inline with its waterfall."""
+    out: List[str] = ["journal events (same traceId)"]
+    ordered = sorted(events, key=lambda e: (float(e.get("tsMs") or 0),
+                                            str(e.get("node", "")),
+                                            int(e.get("seq") or 0)))
+    origin = min(float(e.get("tsMs") or 0) for e in ordered)
+    for ev in ordered:
+        offset = (float(ev.get("tsMs") or 0) - origin) / 1000.0
+        subject = ev.get("segment") or ev.get("table") or ""
+        attrs = ev.get("attrs") or {}
+        detail = "  ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+        line = (f"  +{offset:7.3f}s  {ev.get('node', '?'):<14} "
+                f"{ev.get('kind', '?'):<24} {subject}")
+        if detail:
+            line = f"{line.rstrip()}  {detail}"
+        out.append(line.rstrip())
+    return "\n".join(out)
+
+
+def render_trace(entry: Dict[str, Any],
+                 events: Any = None) -> str:
     """Span waterfall for one retained trace: rows sorted by start, indented
-    by nesting depth, bars on a shared wall-clock axis."""
+    by nesting depth, bars on a shared wall-clock axis. Journal events with
+    the same traceId (pass the `/debug/timeline` body's `events` list, or
+    embed an `events` key in the document) interleave below the spans."""
     out: List[str] = []
     head = f"trace: {entry.get('traceId', '?')}"
     if entry.get("sql"):
@@ -197,10 +231,14 @@ def render_trace(entry: Dict[str, Any]) -> str:
                                         "error") if k in entry]
     if meta:
         out.append("  " + "  ".join(meta))
+    matched = _events_for(entry.get("traceId"), events)
     spans = sorted(entry.get("spans") or [],
                    key=lambda s: float(s.get("startMs", 0.0)))
     if not spans:
         out.append("  (no spans)")
+        if matched:
+            out.append("")
+            out.append(render_events_section(matched))
         return "\n".join(out)
     end = max(float(s.get("startMs", 0.0)) + float(s.get("durationMs", 0.0))
               for s in spans)
@@ -217,6 +255,9 @@ def render_trace(entry: Dict[str, Any]) -> str:
         flag = "  !ERROR" if s.get("error") else ""
         out.append(f"  {name:<34} {_fmt_ms(dur)}  "
                    f"|{bar:<{BAR_WIDTH}}|{flag}")
+    if matched:
+        out.append("")
+        out.append(render_events_section(matched))
     return "\n".join(out)
 
 
@@ -229,11 +270,19 @@ def main(argv: List[str]) -> int:
         return 0
     else:
         doc = json.load(sys.stdin)
+    # a `/debug/timeline` body (or an incident bundle) pasted alongside the
+    # trace doc interleaves its journal events into each trace's report
+    events = doc.get("events") if isinstance(doc, dict) else None
     traces = _trace_entries(doc)
     if traces:
-        print("\n\n".join(render_trace(e) for e in traces))
+        print("\n\n".join(render_trace(e, events=events) for e in traces))
         return 0
-    print(render_report(_extract_stats(doc)))
+    stats = _extract_stats(doc)
+    report = render_report(stats)
+    matched = _events_for(stats.get("traceId"), events)
+    if matched:
+        report = f"{report}\n\n{render_events_section(matched)}"
+    print(report)
     return 0
 
 

@@ -156,8 +156,11 @@ class FaultSchedule:
                     spec.rng.random() >= spec.probability:
                 return
             self._fired[site] = self._fired.get(site, 0) + 1
+        from .events import emit as emit_event
         from .metrics import get_registry
         get_registry().counter("pinot_fault_injections").inc()
+        emit_event("fault.fired", site=site,
+                   latencyMs=spec.latency_ms, fail=bool(spec.fail))
         if spec.latency_ms > 0:
             time.sleep(spec.latency_ms / 1000.0)
         if spec.fail:

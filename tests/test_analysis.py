@@ -17,9 +17,9 @@ from pinot_tpu.analysis import (AnalysisContext, Module, load_baseline,
 from pinot_tpu.analysis import (accumulation, admission_hygiene,
                                 blocking_in_loop,
                                 collective_hygiene, drift_guards,
-                                exception_hygiene, filter_path, fused_path,
-                                ingest_hot_loop, jit_hygiene, join_path,
-                                lock_discipline, memory_hygiene,
+                                events_drift, exception_hygiene, filter_path,
+                                fused_path, ingest_hot_loop, jit_hygiene,
+                                join_path, lock_discipline, memory_hygiene,
                                 transport_bypass)
 from pinot_tpu.analysis.__main__ import main as analysis_main
 from pinot_tpu.analysis.core import BAD_SUPPRESSION
@@ -325,6 +325,69 @@ def test_label_cardinality_suppression_honored():
     """, drift_guards.rules(), readme=_OBS_README)
     assert active == []
     assert "metric-label-cardinality" in _ids(suppressed)
+
+
+# -- event-kind-drift ---------------------------------------------------------
+
+# one fixture module standing in for utils/events.py: it carries the KINDS
+# registry AND the call sites (the rel= makes ctx.module() resolve it)
+_EVENTS_REL = "pinot_tpu/utils/events.py"
+
+_EVENTS_README = """
+## Observability
+
+Event kinds: `segment.online` means the segment went queryable.
+
+## Layout
+"""
+
+
+def test_event_kind_drift_unregistered_kind():
+    active, _ = _check("""
+        from pinot_tpu.utils.events import emit as emit_event
+        KINDS = {"segment.online": ("INFO", "segment went queryable")}
+        def fire():
+            emit_event("segment.mystery")
+    """, events_drift.rules(), rel=_EVENTS_REL, readme=_EVENTS_README)
+    assert _ids(active) == ["event-kind-drift"]
+    assert "segment.mystery" in active[0].message
+
+
+def test_event_kind_drift_undocumented_kind():
+    active, _ = _check("""
+        KINDS = {"segment.online": ("INFO", "documented"),
+                 "segment.shadow": ("WARN", "registered, never documented")}
+    """, events_drift.rules(), rel=_EVENTS_REL, readme=_EVENTS_README)
+    assert _ids(active) == ["event-kind-drift"]
+    assert "segment.shadow" in active[0].message
+
+
+def test_event_kind_drift_clean_negative():
+    # a registered+documented kind passes; journal-attribute emits are in
+    # scope; an unrelated local emit() helper is NOT (no events import)
+    active, _ = _check("""
+        from pinot_tpu.utils.events import emit as emit_event
+        KINDS = {"segment.online": ("INFO", "documented")}
+        def fire(journal):
+            emit_event("segment.online")
+            journal.emit("segment.online")
+        def unrelated_tree_walk():
+            def emit(label):
+                return label
+            emit("not.an.event.kind")
+    """, events_drift.rules(), rel=_EVENTS_REL, readme=_EVENTS_README)
+    assert active == []
+
+
+def test_event_kind_drift_suppression_honored():
+    active, suppressed = _check("""
+        from pinot_tpu.utils.events import emit as emit_event
+        KINDS = {"segment.online": ("INFO", "documented")}
+        def fire():
+            emit_event("segment.mystery")  # graftcheck: ignore[event-kind-drift] -- fixture
+    """, events_drift.rules(), rel=_EVENTS_REL, readme=_EVENTS_README)
+    assert active == []
+    assert "event-kind-drift" in _ids(suppressed)
 
 
 # -- transport-bypass ---------------------------------------------------------

@@ -755,3 +755,102 @@ def test_tiering_chaos_lane_invariants_and_determinism(tmp_path):
     assert fired.get("deepstore.download.fail", 0) > 0, fired
     assert "full" in outcomes, outcomes
     assert any(o != "full" for o in outcomes), outcomes
+
+
+# -- event journal: seeded chaos determinism + flight recorder ----------------
+
+def _event_chaos_scenario(work_dir, seed, queries=12):
+    """The acceptance lane for the event journal: the overload scenario
+    (broker pinned SHEDDING, seeded server.slow/server.crash schedule on a
+    single-worker scatter pool) followed by a synthetic SLO burn escalation
+    (HEALTHY -> DEGRADED -> UNHEALTHY) that must trip the flight recorder
+    exactly once. Returns (stable event sequence json, incident count, fire
+    counts). The stable sequence keeps per-node causal fields ONLY — (node,
+    seq, kind, severity, table, segment), sorted by (node, seq) — because
+    tsMs/gseq depend on wall clock and cross-node arrival interleaving."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pinot_tpu.utils.events import get_journal
+
+    get_journal().clear()
+    cluster = QuickCluster(num_servers=2, work_dir=str(work_dir))
+    schema = Schema("metrics", [dimension("user", DataType.STRING),
+                                metric("value", DataType.DOUBLE)])
+    cfg = cluster.create_table(schema, TableConfig("metrics", replication=2))
+    for seg in range(2):
+        cluster.ingest_columns(cfg, {
+            "user": [f"u{seg}_{i}" for i in range(50)],
+            "value": [1.0] * 50})
+    cluster.broker._pool.shutdown(wait=True)
+    cluster.broker._pool = ThreadPoolExecutor(max_workers=1)
+    cluster.catalog.put_property("clusterConfig/broker.admission.enabled",
+                                 "true")
+    cluster.catalog.put_property("clusterConfig/broker.admission.queue.high",
+                                 "1")
+
+    sched = FaultSchedule({"server.slow": {"p": 0.4, "latencyMs": 10},
+                           "server.crash": {"p": 0.3}}, seed=seed)
+    with faults.active(sched):
+        for i in range(queries):
+            for s in cluster.servers:
+                cluster.revive_server(s.instance_id)
+                cluster.broker.failure_detector.notify_healthy(s.instance_id)
+            sql = ("SELECT user, value FROM metrics LIMIT 20000" if i % 2
+                   else "SELECT COUNT(*) FROM metrics")
+            try:
+                cluster.query(sql)
+            except Exception:
+                pass   # outcomes are the overload lane's concern; events here
+
+    # deterministic SLO escalation on synthetic counters (test_table_slo's
+    # timeline): the UNHEALTHY edge must capture exactly one incident
+    c = cluster.controller
+    cluster.catalog.put_property("clusterConfig/slo.latency.p99.ms", "100")
+    cluster.catalog.put_property("clusterConfig/slo.error.rate", "0.01")
+    counters = {"numQueries": 1000, "numErrors": 0, "numOverSlo": 0}
+    c.slo_pollers["b1"] = lambda: {"tableStats": {"metrics": dict(counters)}}
+    assert c.run_slo_check(now=1000.0) == {"metrics": "HEALTHY"}
+    counters.update(numQueries=2000)
+    assert c.run_slo_check(now=1060.0) == {"metrics": "HEALTHY"}
+    counters.update(numQueries=3000, numErrors=40)
+    assert c.run_slo_check(now=1120.0) == {"metrics": "DEGRADED"}
+    counters.update(numQueries=4000, numErrors=540)
+    assert c.run_slo_check(now=1180.0) == {"metrics": "UNHEALTHY"}
+    assert c.run_slo_check(now=1240.0) == {"metrics": "UNHEALTHY"}  # no edge
+
+    rows = get_journal().events_since(0)["events"]
+    stable = sorted((e["node"], e["seq"], e["kind"], e["severity"],
+                     e.get("table", ""), e.get("segment", ""))
+                    for e in rows)
+    return json.dumps(stable), c.incidents(), sched.fired()
+
+
+def test_event_chaos_determinism_and_single_incident(tmp_path):
+    """Two same-seed runs of the overload+SLO lane produce byte-equal stable
+    event sequences and exactly one incident bundle each."""
+    seq_a, incidents_a, fired_a = _event_chaos_scenario(tmp_path / "a",
+                                                        seed=4242)
+    seq_b, incidents_b, fired_b = _event_chaos_scenario(tmp_path / "b",
+                                                        seed=4242)
+    assert seq_a == seq_b                      # byte-equal across runs
+    assert fired_a == fired_b
+    assert len(incidents_a) == 1 and len(incidents_b) == 1
+    bundle = incidents_a[0]
+    assert bundle["plane"] == "slo" and bundle["key"] == "metrics"
+    assert bundle["status"] == "UNHEALTHY"
+    # the bundle froze the tripping transition and the broker's view
+    assert any(e["kind"] == "verdict.slo" and
+               e["attrs"]["toState"] == "UNHEALTHY"
+               for e in bundle["events"])
+    assert "broker_0" in bundle["snapshots"]["nodes"]
+    # non-vacuous: the chaos half actually journaled overload + fault kinds
+    kinds = {t[2] for t in json.loads(seq_a)}
+    assert "admission.state" in kinds, kinds
+    assert "fault.fired" in kinds, kinds
+    assert "server.registered" in kinds
+    # verdict edges rode the journal: exactly the two SLO transitions plus
+    # the incident capture, never one per tick
+    slo_edges = [t for t in json.loads(seq_a) if t[2] == "verdict.slo"]
+    assert len(slo_edges) == 2, slo_edges
+    assert sum(1 for t in json.loads(seq_a)
+               if t[2] == "incident.captured") == 1

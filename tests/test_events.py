@@ -1,0 +1,413 @@
+"""Event journal + controller timeline + incident flight recorder tests.
+
+Covers the journal's core contracts (per-node monotonic seq exact under
+8-thread concurrency, strict oldest-first ring eviction with conservation,
+closed kind schema), the controller's cursor-incremental timeline merge
+across multiple journal sources, the edge-triggered verdict planes (one
+event per transition, never per tick), the flight recorder's
+exactly-one-bundle-per-episode behavior, the HTTP debug routes, and the
+operator tools that render all of it.
+"""
+
+import json
+import threading
+
+import pytest
+
+from pinot_tpu.cluster.catalog import Catalog
+from pinot_tpu.cluster.controller import Controller
+from pinot_tpu.cluster.deepstore import LocalDeepStore
+from pinot_tpu.ingest.stream import MemoryStream
+from pinot_tpu.utils import faults
+from pinot_tpu.utils.events import (EventJournal, KINDS, SEVERITIES,
+                                    get_journal)
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    """The process journal is global (all in-proc roles share it): every test
+    starts and ends with an empty ring and the default node/capacity."""
+    j = get_journal()
+    j.clear()
+    j.configure(node="proc", capacity=512)
+    faults.deactivate()
+    MemoryStream.reset_all()
+    yield
+    faults.deactivate()
+    MemoryStream.reset_all()
+    j.clear()
+    j.configure(node="proc", capacity=512)
+
+
+def controller(tmp_path, name="c0"):
+    return Controller(name, Catalog(), LocalDeepStore(str(tmp_path / "ds")),
+                      str(tmp_path / name))
+
+
+# -- journal core -------------------------------------------------------------
+
+def test_journal_emit_schema_and_seqs():
+    j = EventJournal(capacity=32, node="n0")
+    ev1 = j.emit("segment.online", table="t_REALTIME", segment="s1")
+    ev2 = j.emit("server.down", node="n1", server="s0")
+    ev3 = j.emit("segment.committed", table="t_REALTIME")
+    # per-node seq is monotonic per node; gseq is journal arrival order
+    assert (ev1.seq, ev2.seq, ev3.seq) == (1, 1, 2)
+    assert [ev1.gseq, ev2.gseq, ev3.gseq] == [1, 2, 3]
+    d = ev1.as_dict()
+    assert d["node"] == "n0" and d["kind"] == "segment.online"
+    assert d["severity"] == "INFO" and d["table"] == "t_REALTIME"
+    assert "traceId" not in d and "attrs" not in d   # empty fields omitted
+    assert ev2.as_dict()["severity"] == "ERROR"      # schema default
+    assert ev2.as_dict()["attrs"] == {"server": "s0"}
+    # severity override (direction-dependent sites)
+    assert j.emit("admission.state", severity="INFO").severity == "INFO"
+
+
+def test_journal_rejects_unregistered_kind():
+    j = EventJournal()
+    with pytest.raises(ValueError, match="unregistered event kind"):
+        j.emit("segment.mystery")
+    assert len(j) == 0 and j.emitted == 0
+
+
+def test_kinds_schema_table_is_well_formed():
+    for kind, (severity, description) in KINDS.items():
+        assert severity in SEVERITIES, kind
+        assert description, kind
+
+
+def test_ring_eviction_oldest_first_and_conservation():
+    j = EventJournal(capacity=4, node="n0")
+    for i in range(10):
+        j.emit("bench.probe", i=i)
+    snap = j.snapshot()
+    assert snap["emitted"] == 10 and snap["retained"] == 4
+    assert snap["evicted"] == 6
+    assert snap["emitted"] == snap["retained"] + snap["evicted"]
+    # survivors are exactly the newest window, newest first
+    assert [e["attrs"]["i"] for e in j.entries()] == [9, 8, 7, 6]
+    # configure() shrink trims oldest-first and keeps the conservation law
+    j.configure(capacity=2)
+    snap = j.snapshot()
+    assert snap["retained"] == 2 and snap["evicted"] == 8
+    assert [e["attrs"]["i"] for e in j.entries()] == [9, 8]
+
+
+def test_events_since_cursor_is_incremental():
+    j = EventJournal(capacity=32, node="n0")
+    j.emit("bench.probe", i=0)
+    j.emit("bench.probe", i=1)
+    first = j.events_since(0)
+    assert [e["attrs"]["i"] for e in first["events"]] == [0, 1]
+    j.emit("bench.probe", i=2)
+    second = j.events_since(first["cursor"])
+    assert [e["attrs"]["i"] for e in second["events"]] == [2]
+    assert j.events_since(second["cursor"])["events"] == []
+
+
+def test_emit_seq_exact_under_8_threads():
+    j = EventJournal(capacity=10_000)
+    per_thread = 100
+
+    def worker(tid):
+        for _ in range(per_thread):
+            j.emit("bench.probe", node=f"n{tid}")   # own node stream
+            j.emit("bench.probe", node="shared")    # contended node stream
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rows = j.events_since(0)["events"]
+    assert j.emitted == 8 * per_thread * 2
+    by_node = {}
+    for e in rows:
+        by_node.setdefault(e["node"], []).append(e["seq"])
+    # per-node seqs are exactly 1..N — no gaps, no duplicates, even on the
+    # node all 8 threads contend on
+    assert sorted(by_node["shared"]) == list(range(1, 8 * per_thread + 1))
+    for tid in range(8):
+        assert sorted(by_node[f"n{tid}"]) == list(range(1, per_thread + 1))
+    # gseq is a strict arrival order over the whole journal
+    gseqs = [e["gseq"] for e in rows]
+    assert gseqs == sorted(gseqs) and len(set(gseqs)) == len(gseqs)
+
+
+# -- controller timeline merge ------------------------------------------------
+
+def test_timeline_merge_two_sources_no_duplication(tmp_path):
+    ctrl = controller(tmp_path)
+    j1 = EventJournal(node="server_1")
+    j2 = EventJournal(node="server_2")
+    ctrl.event_pollers["server_1"] = j1.events_since
+    ctrl.event_pollers["server_2"] = j2.events_since
+    j1.emit("segment.online", table="t", segment="a")
+    j2.emit("server.down", server="x")
+    assert ctrl.run_event_check() == 2
+    # second tick with no new events merges nothing (cursors advanced)
+    assert ctrl.run_event_check() == 0
+    j2.emit("server.up", server="x")
+    assert ctrl.run_event_check() == 1
+    rows = ctrl.timeline()
+    assert [r["kind"] for r in rows if r["node"].startswith("server_")] == \
+        ["segment.online", "server.down", "server.up"]
+    summary = ctrl.events_summary()
+    assert summary["cursors"]["server_1"] == 1
+    assert summary["cursors"]["server_2"] == 2
+    assert summary["unreachable"] == []
+
+
+def test_timeline_filters_and_unreachable(tmp_path):
+    ctrl = controller(tmp_path)
+    j = EventJournal(node="s1")
+    ctrl.event_pollers["s1"] = j.events_since
+
+    def dead(_since):
+        raise ConnectionError("down")
+    ctrl.event_pollers["s9"] = dead
+    j.emit("segment.online", table="t1", segment="a")
+    j.emit("tier.evicted", table="t2", segment="b")
+    j.emit("server.down", server="x")
+    ctrl.run_event_check()
+    assert [r["kind"] for r in ctrl.timeline(kind="server.down")] == \
+        ["server.down"]
+    assert [r["segment"] for r in ctrl.timeline(table="t2")] == ["b"]
+    # severity floor admits the level and everything worse
+    assert {r["severity"] for r in ctrl.timeline(severity="WARN")} == \
+        {"ERROR"}
+    assert len(ctrl.timeline(limit=1)) == 1
+    assert ctrl.events_summary()["unreachable"] == ["s9"]
+    # an unreachable source's cursor is untouched: once it heals, the next
+    # tick re-pulls from the same spot
+    ctrl.event_pollers["s9"] = EventJournal(node="s9").events_since
+    ctrl.run_event_check()
+    assert ctrl.events_summary()["unreachable"] == []
+
+
+# -- verdict edges + flight recorder ------------------------------------------
+
+def test_verdict_edge_triggered_exactly_once(tmp_path):
+    ctrl = controller(tmp_path)
+    j = get_journal()
+    ctrl._note_verdict("slo", "t1", "DEGRADED", ["burn 2x"])
+    ctrl._note_verdict("slo", "t1", "DEGRADED", ["burn 2x"])   # no edge
+    ctrl._note_verdict("slo", "t1", "DEGRADED", ["burn 3x"])   # still no edge
+    edges = [e for e in j.entries() if e["kind"] == "verdict.slo"]
+    assert len(edges) == 1
+    assert edges[0]["attrs"]["fromState"] == "HEALTHY"
+    assert edges[0]["attrs"]["toState"] == "DEGRADED"
+    assert edges[0]["severity"] == "WARN"
+    # DEGRADED does not trip the recorder by default
+    assert ctrl.incidents() == []
+    # recovery is an edge too, at INFO
+    ctrl._note_verdict("slo", "t1", "HEALTHY", [])
+    edges = [e for e in j.entries() if e["kind"] == "verdict.slo"]
+    assert len(edges) == 2 and edges[0]["severity"] == "INFO"
+    # pruning forgets the key: the next DEGRADED is a fresh edge
+    ctrl._prune_verdicts("slo", set())
+    ctrl._note_verdict("slo", "t1", "DEGRADED", [])
+    assert len([e for e in j.entries() if e["kind"] == "verdict.slo"]) == 3
+
+
+def test_incident_captured_once_per_episode(tmp_path):
+    ctrl = controller(tmp_path)
+    ctrl._note_verdict("ingestion", "t1", "UNHEALTHY", ["stalled"])
+    ctrl._note_verdict("ingestion", "t1", "UNHEALTHY", ["stalled"])  # no-op
+    assert len(ctrl.incidents()) == 1
+    b = ctrl.incidents()[0]
+    assert b["plane"] == "ingestion" and b["key"] == "t1"
+    assert b["status"] == "UNHEALTHY" and b["reasons"] == ["stalled"]
+    for field in ("id", "tsMs", "events", "snapshots", "slowTraceIds"):
+        assert field in b
+    for snap_key in ("ingestionStatus", "sloStatus", "memoryStatus",
+                     "workloadStatus", "nodes"):
+        assert snap_key in b["snapshots"]
+    # the bundle's timeline includes the tripping transition itself
+    assert any(e["kind"] == "verdict.ingestion" for e in b["events"])
+    # recovery then relapse captures a SECOND bundle (new episode)
+    ctrl._note_verdict("ingestion", "t1", "HEALTHY", [])
+    ctrl._note_verdict("ingestion", "t1", "UNHEALTHY", ["stalled again"])
+    assert [i["id"] for i in ctrl.incidents()] == [2, 1]   # newest first
+    # the capture itself is journaled
+    assert any(e["kind"] == "incident.captured" for e in get_journal().entries())
+
+
+def test_incident_on_degraded_knob_and_ring_cap(tmp_path):
+    ctrl = controller(tmp_path)
+    ctrl.catalog.put_property(
+        "clusterConfig/controller.incident.on.degraded", "true")
+    ctrl.catalog.put_property("clusterConfig/controller.incident.ring.size",
+                              "2")
+    ctrl._note_verdict("memory", "t1", "DEGRADED", ["headroom low"])
+    assert len(ctrl.incidents()) == 1
+    for n in range(2, 5):   # flap to force captures past the ring cap
+        ctrl._note_verdict("memory", "t1", "HEALTHY", [])
+        ctrl._note_verdict("memory", "t1", "DEGRADED", [f"flap {n}"])
+    assert [i["id"] for i in ctrl.incidents()] == [4, 3]   # oldest evicted
+
+
+def test_incident_poller_snapshot_and_slow_traces(tmp_path):
+    ctrl = controller(tmp_path)
+    ctrl.incident_pollers["broker_0"] = lambda: {
+        "admission": {"state": "SHEDDING"},
+        "recentSlowQueries": [{"stats": {"traceId": "tr-1"}},
+                              {"stats": {"traceId": "tr-1"}}]}
+
+    def dead():
+        raise ConnectionError("down")
+    ctrl.incident_pollers["broker_1"] = dead
+    b = ctrl._capture_incident("slo", "t1", "UNHEALTHY", ["burn"])
+    assert b["snapshots"]["nodes"]["broker_0"]["admission"]["state"] == \
+        "SHEDDING"
+    assert b["snapshots"]["nodes"]["broker_1"] == {"unreachable": True}
+    assert b["slowTraceIds"] == ["tr-1"]   # deduped
+
+
+# -- end-to-end lifecycle timeline --------------------------------------------
+
+def test_quickcluster_lifecycle_causal_timeline(tmp_path):
+    """The acceptance arc without chaos: consuming -> commit -> ONLINE ->
+    cold demote -> lazy reload, every transition on the merged timeline in
+    causal order."""
+    from pinot_tpu.cluster import QuickCluster
+    from pinot_tpu.schema import DataType, Schema, dimension, metric
+    from pinot_tpu.table import StreamConfig, TableConfig, TableType
+
+    cluster = QuickCluster(num_servers=1, work_dir=str(tmp_path))
+    schema = Schema("events", [dimension("user", DataType.STRING),
+                               metric("value", DataType.DOUBLE)])
+    cfg = TableConfig("events", table_type=TableType.REALTIME, replication=1,
+                      stream=StreamConfig(stream_type="memory",
+                                          topic="events_topic", decoder="json",
+                                          flush_threshold_rows=5))
+    cluster.create_realtime_table(schema, cfg, 1)
+    stream = MemoryStream.get("events_topic")
+    for i in range(10):
+        stream.produce(json.dumps({"user": f"u{i}", "value": 1.0}),
+                       partition=0)
+    cluster.pump_realtime("events_REALTIME")
+    committed = [s for s, m in
+                 cluster.catalog.segments["events_REALTIME"].items()
+                 if m.status == "DONE"]
+    assert committed
+    assert cluster.controller.demote_segment_to_cold("events_REALTIME",
+                                                     committed[0])
+    assert cluster.query("SELECT COUNT(*) FROM events").rows == [[10]]
+    cluster.controller.run_event_check()
+    kinds = [e["kind"] for e in cluster.controller.timeline()]
+    for expected in ("segment.consuming.created", "segment.committed",
+                     "segment.online", "segment.cold.demoted",
+                     "segment.cold.loaded", "tier.promoted"):
+        assert expected in kinds, expected
+    # causal order within the lifecycle
+    assert kinds.index("segment.committed") < kinds.index("segment.online")
+    assert kinds.index("segment.online") < \
+        kinds.index("segment.cold.demoted")
+    assert kinds.index("segment.cold.demoted") < \
+        kinds.index("segment.cold.loaded")
+    # cluster_top renders the recent-events panel off this timeline
+    from pinot_tpu.tools import cluster_top
+    snap = {"tables": {}, "timeline": cluster.controller.timeline(limit=8),
+            "eventsSummary": cluster.controller.events_summary()}
+    text = cluster_top.render(snap)
+    assert "recent events" in text and "segment.cold.demoted" in text
+
+
+# -- HTTP routes --------------------------------------------------------------
+
+def test_http_event_routes(tmp_path):
+    from pinot_tpu.cluster.http_service import HttpError, get_json
+    from pinot_tpu.cluster.services import ControllerService
+
+    ctrl = controller(tmp_path)
+    svc = ControllerService(ctrl)
+    try:
+        get_journal().emit("segment.online", node="c0", table="t",
+                           segment="s1")
+        body = get_json(f"{svc.url}/debug/events?since=0")
+        assert [e["kind"] for e in body["events"]] == ["segment.online"]
+        assert get_json(
+            f"{svc.url}/debug/events?since={body['cursor']}")["events"] == []
+        ctrl.run_event_check()
+        tl = get_json(f"{svc.url}/debug/timeline?kind=segment.online")
+        assert tl["count"] == 1 and tl["events"][0]["segment"] == "s1"
+        assert get_json(
+            f"{svc.url}/debug/timeline?severity=ERROR")["count"] == 0
+        # incidents: empty ring, then one capture, then by-id + 404
+        assert get_json(f"{svc.url}/debug/incidents")["incidents"] == []
+        ctrl._note_verdict("slo", "t", "UNHEALTHY", ["burn"])
+        listing = get_json(f"{svc.url}/debug/incidents")
+        assert listing["count"] == 1
+        one = get_json(f"{svc.url}/debug/incidents?id=1")
+        assert one["plane"] == "slo" and one["key"] == "t"
+        with pytest.raises(HttpError):
+            get_json(f"{svc.url}/debug/incidents?id=99")
+        # /debug rollup carries the light summary
+        assert get_json(f"{svc.url}/debug")["events"]["timelineEvents"] >= 1
+    finally:
+        svc.stop()
+
+
+# -- operator tools -----------------------------------------------------------
+
+def test_incident_report_renders_bundle(tmp_path, capsys):
+    from pinot_tpu.tools.incident_report import main as report_main
+
+    ctrl = controller(tmp_path)
+    get_journal().emit("server.down", node="broker_0", server="server_1")
+    ctrl.incident_pollers["broker_0"] = lambda: {
+        "recentSlowQueries": [{"stats": {"traceId": "tr-9"}}]}
+    ctrl._slo_status["t1"] = {"table": "t1", "verdict": "UNHEALTHY",
+                              "reasons": ["availability burn 5.0x"]}
+    ctrl._note_verdict("slo", "t1", "UNHEALTHY", ["availability burn 5.0x"])
+    path = tmp_path / "incidents.json"
+    path.write_text(json.dumps({"incidents": ctrl.incidents()}))
+    assert report_main(["incident_report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "incident #1" in out and "plane=slo" in out
+    assert "reason: availability burn 5.0x" in out
+    assert "server.down" in out and "verdict.slo" in out
+    assert "tr-9" in out
+    # --id selects one bundle; unknown ids answer visibly
+    assert report_main(["incident_report", "--id", "1", str(path)]) == 0
+    assert "UNHEALTHY" in capsys.readouterr().out
+    assert report_main(["incident_report", "--id", "7", str(path)]) == 0
+    assert "unknown incident id 7" in capsys.readouterr().out
+
+
+def test_query_report_interleaves_journal_events(capsys):
+    from pinot_tpu.tools.query_report import main as report_main
+    doc = {
+        "traces": [{"traceId": "tr-1", "sql": "SELECT 1",
+                    "timeUsedMs": 12.0,
+                    "spans": [{"name": "broker.query", "startMs": 0.0,
+                               "durationMs": 12.0, "depth": 0}]}],
+        "events": [
+            {"tsMs": 1000, "seq": 1, "node": "server_0",
+             "kind": "server.down", "severity": "ERROR", "traceId": "tr-1"},
+            {"tsMs": 2000, "seq": 2, "node": "broker_0",
+             "kind": "hedge.suppressed", "severity": "WARN", "table": "t",
+             "traceId": "tr-1"},
+            {"tsMs": 1500, "seq": 3, "node": "broker_0",
+             "kind": "backpressure.hold", "severity": "WARN",
+             "traceId": "tr-OTHER"}],
+    }
+    import io
+    import sys as _sys
+    _sys.stdin = io.StringIO(json.dumps(doc))
+    try:
+        assert report_main(["query_report"]) == 0
+    finally:
+        _sys.stdin = _sys.__stdin__
+    out = capsys.readouterr().out
+    assert "journal events (same traceId)" in out
+    assert "server.down" in out and "hedge.suppressed" in out
+    assert "backpressure.hold" not in out   # other trace's event filtered
+    # chronological: the earlier event renders first
+    assert out.index("server.down") < out.index("hedge.suppressed")
+
+
+def test_cluster_top_events_panel_absent_without_timeline():
+    from pinot_tpu.tools import cluster_top
+    assert "recent events" not in cluster_top.render({"tables": {}})
