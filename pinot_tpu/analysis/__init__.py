@@ -3,17 +3,24 @@
 Every regression class this repo has shipped was statically detectable — the
 PR 2 `_const` jit-cache shape collision, the PR 3 unfenced-compile timing bug,
 the PR 4 advisor findings (unlocked `Histogram.observe`, stale queued futures,
-null-bitmap-dropping rewrites). graftcheck encodes those lessons as five
+null-bitmap-dropping rewrites). graftcheck encodes those lessons as
 codebase-specific rule packs over stdlib `ast` (no new dependencies):
 
 * **jit-hygiene** — host/device boundary discipline: implicit host syncs on
-  traced values, device fetches outside the sanctioned fetch sites, literal
-  arrays rebuilt inside jit'd functions, unhashable jit cache-key components.
+  traced values (including container elements: ``self._cache[k] = jnp…``
+  taints later ``[k]``/``.get()``/``.pop()`` reads), device fetches outside
+  the sanctioned fetch sites, literal arrays rebuilt inside jit'd functions,
+  unhashable jit cache-key components.
 * **lock-discipline** — for lock-owning classes: attributes written both
-  under and outside their lock, manual acquire()/release(), daemon threads
-  with no join/stop path, and cross-method races (an attr guarded in one
-  method but touched lock-free on a thread-entry path, possibly through
-  helpers in other modules).
+  under and outside their lock, flow-sensitive manual acquire()/release()
+  (exception/return paths that leak the lock or permit, writes after a
+  mid-method release or under a conditional acquire), daemon threads with
+  no join/stop path, and cross-method races (an attr guarded in one method
+  but touched lock-free on a thread-entry path, possibly through helpers in
+  other modules).
+* **lock-order** — a global lock-acquisition-order graph (class-/module-
+  qualified lock identities, nesting folded through the call graph); cycles
+  are reported as potential deadlocks (``lock-order-inversion``).
 * **blocking-in-loop** — unbounded `Future.result()` / queue `.get()` waits
   and sleeps inside dispatcher/fetcher loops and HTTP handlers.
 * **drift-guards** — declarative docs-vs-code guards: metric registry vs the
@@ -28,13 +35,17 @@ codebase-specific rule packs over stdlib `ast` (no new dependencies):
 The rule packs share one interprocedural layer (``analysis/callgraph.py``):
 a project-wide symbol table, a call graph with ``self.``/``cls.`` dispatch,
 and per-function summaries computed to a fixpoint — device-returning
-functions, device-tainted ``self._attr`` stores, and lock-annotated
-attribute accesses folded through param-forwarding calls. Cross-module
-findings carry their propagation chain in the message; the chain never
-enters the baseline fingerprint.
+functions, device-tainted ``self._attr`` stores (whole-attribute and
+per-element), and lock-annotated attribute accesses folded through
+param-forwarding calls — plus one flow-sensitive layer (``analysis/cfg.py``):
+per-function CFGs (branches, loops, try/except/finally, ``with``
+enter/exit markers, early exits) cached on the analysis context, with a
+generic forward-dataflow worklist engine on top. Cross-module findings
+carry their propagation chain in the message; the chain never enters the
+baseline fingerprint.
 
-Run it:  ``python -m pinot_tpu.analysis [--changed-only] [--format text|json]
-[--update-baseline]``
+Run it:  ``python -m pinot_tpu.analysis [--changed-only]
+[--format text|json|sarif] [--update-baseline]``
 
 Findings are suppressed inline with
 ``# graftcheck: ignore[rule-id] -- reason`` (the reason is mandatory) or

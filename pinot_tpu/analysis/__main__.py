@@ -72,7 +72,8 @@ def main(argv: List[str] = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyse (default: the pinot_tpu "
                          "package)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--baseline", default=BASELINE_PATH,
                     help="baseline file (default: the committed "
                          "analysis/baseline.json)")
@@ -120,6 +121,11 @@ def main(argv: List[str] = None) -> int:
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new = unbaselined(findings, baseline)
     elapsed = time.perf_counter() - t0
+
+    if args.format == "sarif":
+        from .sarif import to_sarif
+        print(json.dumps(to_sarif(new, all_rules()), indent=1))
+        return 1 if new else 0
 
     if args.format == "json":
         print(json.dumps({

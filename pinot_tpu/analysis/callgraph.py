@@ -103,7 +103,9 @@ class FunctionInfo:
         # extraction output (source order)
         self.assign_facts: List[Tuple[Tuple[str, ...], tuple]] = []
         self.return_facts: List[tuple] = []
-        self.attr_stores: List[Tuple[str, tuple, int]] = []  # self.X = value
+        #: (attr, fact, line, kind): kind 'attr' for `self.X = v`, 'elem' for
+        #: element stores (`self.X[k] = v`, `self.X.append(v)`, setdefault)
+        self.attr_stores: List[Tuple[str, tuple, int, str]] = []
         self.calls: List[CallFact] = []
         #: param idx -> {Access.key(): Access}, grows to fixpoint
         self.param_accesses: Dict[int, Dict[tuple, Access]] = {}
@@ -209,6 +211,11 @@ class _ModuleTable:
 #   ('multi', [facts])     tuple/ifexp/binop — tainted if any member is
 #   ('other',)
 
+#: container methods whose RESULT is an element of the receiver — a read of
+#: the container's element taint
+ELEMENT_GETTERS = {"get", "pop", "popleft", "setdefault"}
+
+
 def classify_value(expr: ast.AST) -> tuple:
     if isinstance(expr, ast.Call):
         name = dotted_name(expr.func)
@@ -216,6 +223,17 @@ def classify_value(expr: ast.AST) -> tuple:
             return ("device",)
         if name in HOST_FETCHERS:
             return ("host",)
+        # `self._cache.get(k)` / `.pop(k)` read an element: classify as a
+        # read of the container itself so element stores taint the result
+        if isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr in ELEMENT_GETTERS:
+            recv = expr.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                return ("selfattr", recv.attr)
+            if isinstance(recv, ast.Name):
+                return ("name", recv.id)
         return ("call", expr)
     if isinstance(expr, ast.Name):
         return ("name", expr.id)
@@ -315,7 +333,16 @@ class _Extractor(ast.NodeVisitor):
             # self.X = <value> stores
             if isinstance(t, ast.Attribute) and \
                     isinstance(t.value, ast.Name) and t.value.id == "self":
-                self.fi.attr_stores.append((t.attr, fact, t.lineno))
+                self.fi.attr_stores.append((t.attr, fact, t.lineno, "attr"))
+            # self.X[k] = <value>: an ELEMENT store — taints reads of the
+            # container's elements (self.X[j], self.X.get(j)) without ever
+            # killing existing taint (other keys keep their values)
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Attribute) and \
+                    isinstance(t.value.value, ast.Name) and \
+                    t.value.value.id == "self":
+                self.fi.attr_stores.append(
+                    (t.value.attr, fact, t.lineno, "elem"))
             # `p.attr[k] = v` is a write to p.attr (the Attribute itself
             # carries Load ctx — record the write explicitly)
             if isinstance(t, ast.Subscript) and \
@@ -351,6 +378,24 @@ class _Extractor(ast.NodeVisitor):
 
     # -- calls (edges + param forwarding)
     def visit_Call(self, node: ast.Call) -> None:
+        # container-element taint: `self._q.append(dev)`, `self._c.update(d)`,
+        # `self._m.setdefault(k, dev)` make element reads device-tainted
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in MUTATORS:
+            recv = node.func.value
+            value_args = node.args[1:] if node.func.attr == "setdefault" \
+                else node.args
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                for a in value_args:
+                    self.fi.attr_stores.append(
+                        (recv.attr, classify_value(a), node.lineno, "elem"))
+            elif isinstance(recv, ast.Name):
+                # local container mutated in place: augment (never kill)
+                for a in value_args:
+                    self.fi.assign_facts.append(
+                        ((recv.id,), ("augment", classify_value(a))))
         # `p.attr.append(...)`-style mutators are writes to p.attr
         if isinstance(node.func, ast.Attribute) and \
                 node.func.attr in MUTATORS and \
@@ -670,6 +715,8 @@ class CallGraph:
             return ()
         if kind in ("host", "other"):
             return None
+        if kind == "augment":
+            return self._eval_fact(fi, fact[1], taint)
         if kind == "name":
             return taint.get(fact[1])
         if kind == "selfattr":
@@ -699,7 +746,9 @@ class CallGraph:
             if chain is not None:
                 for n in names:
                     taint[n] = chain[:_CHAIN_CAP]
-            else:
+            elif fact[0] != "augment":
+                # an in-place mutation with a clean value never CLEARS the
+                # container's taint — other elements keep theirs
                 for n in names:
                     taint.pop(n, None)
         return taint
@@ -721,15 +770,17 @@ class CallGraph:
                                 (f"{fi.display}()",) + chain)[:_CHAIN_CAP]
                             changed = True
                             break
-                # class device attrs
+                # class device attrs (plain stores and element stores)
                 if fi.cls is not None:
-                    for attr, fact, _line in fi.attr_stores:
+                    for attr, fact, _line, skind in fi.attr_stores:
                         if attr in fi.cls.device_attrs:
                             continue
                         chain = self._eval_fact(fi, fact, taint)
                         if chain is not None:
+                            stored = f"self.{attr}[...]" if skind == "elem" \
+                                else f"self.{attr}"
                             fi.cls.device_attrs[attr] = (
-                                (f"{fi.display}() stores self.{attr}",)
+                                (f"{fi.display}() stores {stored}",)
                                 + chain)[:_CHAIN_CAP]
                             changed = True
                 # transitive param attr accesses

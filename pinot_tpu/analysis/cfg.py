@@ -1,0 +1,607 @@
+"""Per-function control-flow graphs and a generic forward-dataflow engine.
+
+This is the flow-sensitive layer under graftcheck.  ``build_cfg`` lowers a
+function body (stdlib ``ast``, no dependencies) into basic blocks with:
+
+* normal successor edges for branches, loops, ``try``/``except``/``finally``,
+  and ``with`` bodies;
+* one *exception-edge target* per block (``exc_target``): the block control
+  would reach if any statement in the block raised.  Try boundaries force
+  block splits so the target is constant within a block;
+* synthetic ``WithEnter``/``WithExit`` marker statements bracketing ``with``
+  bodies so lock analyses observe acquire/release events on both the normal
+  and the exception path;
+* early exits: ``return`` routes through every enclosing ``finally`` to the
+  function exit block, ``raise`` to the nearest handler (or ``raise_exit``),
+  ``break``/``continue`` to the loop's after/head blocks.
+
+On top sits ``run_forward`` — a worklist fixpoint over any analysis exposing
+``initial``/``bottom``/``join``/``transfer``.  Exception flow is propagated
+at *statement* granularity: both the pre- and post-state of every statement
+join into the block's exception target, so ``acquire(); x = f(); release()``
+inside one block still leaks the held state through ``f()``'s raise edge.
+
+CFGs are cached per (module, function) on ``AnalysisContext`` (see
+``core.AnalysisContext.cfg``) and shared by every flow-sensitive rule pack.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class WithEnter:
+    """Synthetic statement: the context manager of ``node`` was entered."""
+
+    __slots__ = ("node",)
+
+    def __init__(self, node: ast.withitem) -> None:
+        self.node = node
+
+
+class WithExit:
+    """Synthetic statement: the context manager of ``node`` was exited.
+
+    ``on_exception`` is True for the copy placed on the exception edge —
+    ``with`` releases its resource whether the body raised or not.
+    """
+
+    __slots__ = ("node", "on_exception")
+
+    def __init__(self, node: ast.withitem, on_exception: bool = False) -> None:
+        self.node = node
+        self.on_exception = on_exception
+
+
+class Block:
+    """A basic block: a straight-line list of statements.
+
+    ``succs`` are normal-flow successors; ``exc_target`` is the single block
+    any raising statement in this block would reach (None means the raise
+    escapes the function to ``raise_exit``).
+    """
+
+    __slots__ = ("idx", "stmts", "succs", "exc_target", "label")
+
+    def __init__(self, idx: int, label: str = "") -> None:
+        self.idx = idx
+        self.stmts: List[object] = []
+        self.succs: List[int] = []
+        self.exc_target: Optional[int] = None
+        self.label = label
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "Block(%d%s succs=%r exc=%r)" % (
+            self.idx,
+            " " + self.label if self.label else "",
+            self.succs,
+            self.exc_target,
+        )
+
+
+class CFG:
+    """Control-flow graph for one function body."""
+
+    def __init__(self) -> None:
+        self.blocks: List[Block] = []
+        self.entry = 0
+        # ``exit`` collects normal completion (fall off the end / return);
+        # ``raise_exit`` collects exceptions that escape the function.
+        self.exit = -1
+        self.raise_exit = -1
+
+    def new_block(self, label: str = "") -> Block:
+        b = Block(len(self.blocks), label)
+        self.blocks.append(b)
+        return b
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {b.idx: [] for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succs:
+                out[s].append(b.idx)
+            if b.exc_target is not None:
+                out[b.exc_target].append(b.idx)
+        return out
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.cfg = CFG()
+        exit_block = self.cfg.new_block("exit")
+        raise_block = self.cfg.new_block("raise_exit")
+        self.cfg.exit = exit_block.idx
+        self.cfg.raise_exit = raise_block.idx
+        entry = self.cfg.new_block("entry")
+        self.cfg.entry = entry.idx
+        self.cur: Optional[Block] = entry
+        # Innermost-last stacks.
+        # Loop frames: (head_idx, after_idx).
+        self.loops: List[Tuple[int, int]] = []
+        # Finally frames: each is the list of ``finally`` body statements that
+        # an early exit (return/break/continue/raise) must execute on the way
+        # out.  We inline the finally body into a fresh block per early exit —
+        # simple, and keeps per-path lock state precise.
+        self.finals: List[List[ast.stmt]] = []
+        # Exception-handler stack: the block a raise in the current position
+        # would reach.  Empty means the raise escapes the function.
+        self.handlers: List[int] = []
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _exc_target(self) -> Optional[int]:
+        return self.handlers[-1] if self.handlers else None
+
+    def _fresh(self, label: str = "") -> Block:
+        b = self.cfg.new_block(label)
+        b.exc_target = self._exc_target()
+        return b
+
+    def _append(self, stmt: object) -> None:
+        if self.cur is None:
+            return  # unreachable code after return/raise/break
+        # A statement must live in a block whose exc_target matches the
+        # current handler context (try boundaries call _split around bodies,
+        # so normally they agree; this is a safety net).
+        if self.cur.stmts and self.cur.exc_target != self._exc_target():
+            self._split()
+        self.cur.exc_target = self._exc_target()
+        self.cur.stmts.append(stmt)
+
+    def _split(self, label: str = "") -> None:
+        """End the current block and continue in a fresh successor."""
+        if self.cur is None:
+            return
+        nxt = self._fresh(label)
+        self.cur.succs.append(nxt.idx)
+        self.cur = nxt
+
+    def _terminate(self) -> None:
+        self.cur = None
+
+    def _run_finals(self, depth_above: int) -> None:
+        """Inline every finally body from innermost down to ``depth_above``."""
+        for body in reversed(self.finals[depth_above:]):
+            for s in body:
+                self._visit(s)
+                if self.cur is None:
+                    return
+
+    # -- statement dispatch -------------------------------------------------
+
+    def build(self, body: List[ast.stmt]) -> CFG:
+        for s in body:
+            self._visit(s)
+            if self.cur is None:
+                break
+        if self.cur is not None:
+            self.cur.succs.append(self.cfg.exit)
+        # Wire every block with no handler to raise_exit explicitly? No:
+        # exc_target None already means "escapes"; run_forward maps None to
+        # raise_exit.  Keep None for compactness.
+        return self.cfg
+
+    def _visit(self, stmt: ast.stmt) -> None:
+        handler = getattr(self, "_visit_" + type(stmt).__name__, None)
+        if handler is not None:
+            handler(stmt)
+        else:
+            self._append(stmt)
+
+    # -- straight-line / early exits ---------------------------------------
+
+    def _visit_Return(self, stmt: ast.Return) -> None:
+        self._append(stmt)
+        self._run_finals(0)
+        if self.cur is not None:
+            self.cur.succs.append(self.cfg.exit)
+        self._terminate()
+
+    def _visit_Raise(self, stmt: ast.Raise) -> None:
+        self._append(stmt)
+        if self.cur is not None:
+            tgt = self._exc_target()
+            if tgt is None:
+                # Escaping raise still unwinds through finally bodies.
+                self._run_finals(0)
+                if self.cur is not None:
+                    self.cur.succs.append(self.cfg.raise_exit)
+            else:
+                self.cur.succs.append(tgt)
+        self._terminate()
+
+    def _visit_Break(self, stmt: ast.Break) -> None:
+        self._append(stmt)
+        if self.loops and self.cur is not None:
+            # Finally bodies between the break and the loop run first.  We
+            # conservatively run all of them (loop/finally frame interleaving
+            # is not tracked; analyses only lose a little precision).
+            self._run_finals(0)
+            if self.cur is not None:
+                self.cur.succs.append(self.loops[-1][1])
+        self._terminate()
+
+    def _visit_Continue(self, stmt: ast.Continue) -> None:
+        self._append(stmt)
+        if self.loops and self.cur is not None:
+            self._run_finals(0)
+            if self.cur is not None:
+                self.cur.succs.append(self.loops[-1][0])
+        self._terminate()
+
+    # -- branches -----------------------------------------------------------
+
+    def _visit_If(self, stmt: ast.If) -> None:
+        self._append(stmt.test)
+        cond = self.cur
+        after = self._fresh("if.after")
+
+        assert cond is not None
+        then = self._fresh("if.then")
+        cond.succs.append(then.idx)
+        self.cur = then
+        for s in stmt.body:
+            self._visit(s)
+            if self.cur is None:
+                break
+        if self.cur is not None:
+            self.cur.succs.append(after.idx)
+
+        if stmt.orelse:
+            els = self._fresh("if.else")
+            cond.succs.append(els.idx)
+            self.cur = els
+            for s in stmt.orelse:
+                self._visit(s)
+                if self.cur is None:
+                    break
+            if self.cur is not None:
+                self.cur.succs.append(after.idx)
+        else:
+            cond.succs.append(after.idx)
+
+        if not after.stmts and not self._preds_of(after.idx):
+            # Both arms terminated; after is unreachable.
+            self.cur = None
+        else:
+            self.cur = after
+
+    def _preds_of(self, idx: int) -> List[int]:
+        return [b.idx for b in self.cfg.blocks if idx in b.succs]
+
+    # -- loops --------------------------------------------------------------
+
+    def _loop(self, head_expr: Optional[ast.expr], body: List[ast.stmt],
+              orelse: List[ast.stmt], infinite: bool) -> None:
+        head = self._fresh("loop.head")
+        assert self.cur is not None
+        self.cur.succs.append(head.idx)
+        after = self._fresh("loop.after")
+        if head_expr is not None:
+            head.stmts.append(head_expr)  # the test / iterator expression
+
+        body_entry = self._fresh("loop.body")
+        head.succs.append(body_entry.idx)
+        if not infinite:
+            # Loop may not execute / may finish: head -> orelse -> after.
+            if orelse:
+                else_b = self._fresh("loop.else")
+                head.succs.append(else_b.idx)
+                self.cur = else_b
+                for s in orelse:
+                    self._visit(s)
+                    if self.cur is None:
+                        break
+                if self.cur is not None:
+                    self.cur.succs.append(after.idx)
+            else:
+                head.succs.append(after.idx)
+
+        self.loops.append((head.idx, after.idx))
+        self.cur = body_entry
+        for s in body:
+            self._visit(s)
+            if self.cur is None:
+                break
+        if self.cur is not None:
+            self.cur.succs.append(head.idx)  # back edge
+        self.loops.pop()
+
+        if infinite and not self._preds_of(after.idx):
+            self.cur = None  # while True with no break
+        else:
+            self.cur = after
+
+    def _visit_While(self, stmt: ast.While) -> None:
+        infinite = isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+        head = None if infinite else stmt.test
+        self._loop(head, stmt.body, stmt.orelse, infinite)
+
+    def _visit_For(self, stmt: ast.For) -> None:
+        self._loop(stmt.iter, stmt.body, stmt.orelse, False)
+
+    _visit_AsyncFor = _visit_For
+
+    # -- with ---------------------------------------------------------------
+
+    def _visit_With(self, stmt: ast.With) -> None:
+        if self.cur is None:
+            return
+        # Entering the managers can itself raise (before acquisition
+        # completes), so the enter markers live in the pre-entry context.
+        for item in stmt.items:
+            self._append(WithEnter(item))
+
+        # Body raises reach a synthetic "with.cleanup" block that exits every
+        # manager, then propagates to the enclosing handler.
+        cleanup = self.cfg.new_block("with.cleanup")
+        cleanup.exc_target = self._exc_target()
+        for item in reversed(stmt.items):
+            cleanup.stmts.append(WithExit(item, on_exception=True))
+        outer = self._exc_target()
+        if outer is None:
+            cleanup.succs.append(self.cfg.raise_exit)
+        else:
+            cleanup.succs.append(outer)
+
+        self.handlers.append(cleanup.idx)
+        # The with body also counts as a finally frame for early exits:
+        # return/break inside the body must exit the managers on the way out.
+        # We model that by pushing a pseudo-finally of WithExit markers.
+        exit_stmts: List[ast.stmt] = [WithExit(i) for i in reversed(stmt.items)]  # type: ignore[misc]
+        self.finals.append(exit_stmts)  # type: ignore[arg-type]
+        self._split("with.body")
+        for s in stmt.body:
+            self._visit(s)
+            if self.cur is None:
+                break
+        self.finals.pop()
+        self.handlers.pop()
+        if self.cur is not None:
+            for item in reversed(stmt.items):
+                self._append(WithExit(item))
+            self._split("with.after")
+
+    _visit_AsyncWith = _visit_With
+
+    # -- try ----------------------------------------------------------------
+
+    def _visit_Try(self, stmt: ast.Try) -> None:
+        if self.cur is None:
+            return
+        has_final = bool(stmt.finalbody)
+        after = self._fresh("try.after")
+
+        # Handler dispatch block: any raise in the try body lands here, then
+        # fans out to each handler (conservatively all of them) and — if no
+        # handler matches — onward to the enclosing context via the finally.
+        dispatch = self.cfg.new_block("try.dispatch")
+        dispatch.exc_target = self._exc_target()
+
+        # Exceptions escaping the else/handler bodies (and exceptions the
+        # handlers don't match) must run the finally before propagating —
+        # model that with an "unwind" block filled in below.
+        unwind: Optional[Block] = None
+        if has_final:
+            self.finals.append(stmt.finalbody)
+            unwind = self.cfg.new_block("finally.unwind")
+            unwind.exc_target = self._exc_target()
+
+        self.handlers.append(dispatch.idx)
+        self._split("try.body")
+        for s in stmt.body:
+            self._visit(s)
+            if self.cur is None:
+                break
+        body_end = self.cur
+        self.handlers.pop()
+
+        if unwind is not None:
+            self.handlers.append(unwind.idx)
+
+        ends: List[Block] = []
+
+        # else runs only when the body completed normally.
+        if body_end is not None:
+            self.cur = body_end
+            self._split("try.else" if stmt.orelse else "try.bodyend")
+            for s in stmt.orelse:
+                self._visit(s)
+                if self.cur is None:
+                    break
+            if self.cur is not None:
+                ends.append(self.cur)
+
+        # Handlers fan out from dispatch (conservatively, all of them).
+        for h in stmt.handlers:
+            hb = self._fresh("except")
+            dispatch.succs.append(hb.idx)
+            self.cur = hb
+            if h.type is not None:
+                self._append(h.type)
+            for s in h.body:
+                self._visit(s)
+                if self.cur is None:
+                    break
+            if self.cur is not None:
+                ends.append(self.cur)
+
+        if unwind is not None:
+            self.handlers.pop()
+
+        # Unhandled path: exception matched no handler (or there are none) —
+        # it unwinds through the finally to the enclosing handler/raise_exit.
+        # `except:` / `except BaseException:` match everything, so that path
+        # does not exist (handlers that re-raise take their own raise edge).
+        catch_all = any(
+            h.type is None or
+            (isinstance(h.type, ast.Name) and h.type.id == "BaseException")
+            for h in stmt.handlers)
+        if has_final:
+            self.finals.pop()
+            assert unwind is not None
+            if not catch_all:
+                dispatch.succs.append(unwind.idx)
+            self.cur = unwind
+            for s in stmt.finalbody:
+                self._visit(s)
+                if self.cur is None:
+                    break
+            if self.cur is not None:
+                outer = self._exc_target()
+                self.cur.succs.append(
+                    self.cfg.raise_exit if outer is None else outer)
+        elif not catch_all:
+            outer = self._exc_target()
+            dispatch.succs.append(
+                self.cfg.raise_exit if outer is None else outer)
+
+        # Normal completion of body/else/handlers runs the finally then
+        # continues at ``after``.
+        if ends:
+            if has_final:
+                joiner = self._fresh("finally")
+                for e in ends:
+                    e.succs.append(joiner.idx)
+                self.cur = joiner
+                for s in stmt.finalbody:
+                    self._visit(s)
+                    if self.cur is None:
+                        break
+                if self.cur is not None:
+                    self.cur.succs.append(after.idx)
+            else:
+                for e in ends:
+                    e.succs.append(after.idx)
+
+        if not self._preds_of(after.idx):
+            self.cur = None
+        else:
+            self.cur = after
+
+    # -- nested scopes are opaque ------------------------------------------
+
+    def _visit_FunctionDef(self, stmt: ast.FunctionDef) -> None:
+        self._append(stmt)  # the *definition* is a straight-line statement
+
+    _visit_AsyncFunctionDef = _visit_FunctionDef
+    _visit_ClassDef = _visit_FunctionDef
+
+
+def shallow_walk(node: ast.AST):
+    """Walk a CFG statement without descending into nested function/class
+    bodies or lambdas — their code runs later, not here.  A statement that
+    IS a nested definition yields nothing (defining it executes no body)."""
+    nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+    if isinstance(node, nested):
+        return
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if not isinstance(c, nested):
+                stack.append(c)
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build a CFG for a FunctionDef/AsyncFunctionDef (or any stmt list owner)."""
+    body = getattr(fn, "body", None)
+    if body is None:
+        raise TypeError("build_cfg needs a node with a body")
+    return _Builder().build(list(body))
+
+
+# ---------------------------------------------------------------------------
+# Generic forward dataflow
+# ---------------------------------------------------------------------------
+
+#: Hard cap on worklist iterations; guarantees termination even if an
+#: analysis's join is not monotone.  Generously above anything a real
+#: function body needs (blocks * lattice height is tiny here).
+_ITER_CAP = 4000
+
+
+class ForwardAnalysis:
+    """Interface for ``run_forward``.  Subclass or duck-type.
+
+    States must be immutable values supporting ``==``.  ``join`` must be
+    commutative/associative; ``transfer`` returns the post-state of one
+    statement (which may be a raw ast node or a WithEnter/WithExit marker).
+    """
+
+    def initial(self):  # state at function entry
+        raise NotImplementedError
+
+    def bottom(self):  # identity element for join (unreachable)
+        raise NotImplementedError
+
+    def join(self, a, b):
+        raise NotImplementedError
+
+    def transfer(self, stmt, state):
+        raise NotImplementedError
+
+    def may_raise(self, stmt) -> bool:
+        """Whether this statement contributes to the exception edge.
+        Analyses override to exempt statements whose raising cannot leave
+        the analysed effect half-done (e.g. `lock.release()` itself)."""
+        return True
+
+
+def run_forward(cfg: CFG, analysis: ForwardAnalysis,
+                observe: Optional[Callable[[object, object, int], None]] = None
+                ) -> Dict[int, object]:
+    """Worklist forward fixpoint.  Returns the in-state of every block.
+
+    Exception flow is statement-granular: the pre-state of each statement is
+    joined into the block's ``exc_target`` in-state (a raise can happen
+    *during* the statement, before its effect commits).  ``observe``, if
+    given, is called as ``observe(stmt, pre_state, block_idx)`` for every
+    statement on the final stable pass — rules use it to inspect per-
+    statement states without re-implementing the walk.
+    """
+    bottom = analysis.bottom()
+    in_states: Dict[int, object] = {b.idx: bottom for b in cfg.blocks}
+    in_states[cfg.entry] = analysis.initial()
+    work = [cfg.entry]
+    iters = 0
+    while work and iters < _ITER_CAP:
+        iters += 1
+        idx = work.pop()
+        block = cfg.blocks[idx]
+        state = in_states[idx]
+        # Only the PRE-state of a statement flows along its raise edge: an
+        # exception happens *during* the statement, before its effect
+        # commits (so `lock.acquire()` raising does not leak a held lock,
+        # but any statement between acquire() and release() does).
+        exc_acc = bottom
+        raising = False
+        for stmt in block.stmts:
+            if analysis.may_raise(stmt):
+                exc_acc = analysis.join(exc_acc, state)
+                raising = True
+            state = analysis.transfer(stmt, state)
+
+        targets: List[Tuple[int, object]] = [(s, state) for s in block.succs]
+        if raising and block.idx not in (cfg.exit, cfg.raise_exit):
+            exc_tgt = block.exc_target
+            if exc_tgt is None:
+                exc_tgt = cfg.raise_exit
+            targets.append((exc_tgt, exc_acc))
+        for tgt, st in targets:
+            merged = analysis.join(in_states[tgt], st)
+            if merged != in_states[tgt]:
+                in_states[tgt] = merged
+                if tgt not in work:
+                    work.append(tgt)
+
+    if observe is not None:
+        for block in cfg.blocks:
+            state = in_states[block.idx]
+            if state == bottom and block.idx != cfg.entry:
+                continue  # unreachable
+            for stmt in block.stmts:
+                observe(stmt, state, block.idx)
+                state = analysis.transfer(stmt, state)
+    return in_states

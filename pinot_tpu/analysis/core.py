@@ -145,6 +145,7 @@ class AnalysisContext:
     modules: List[Module] = field(default_factory=list)
     _readme: Optional[str] = None
     _callgraph: Optional[object] = None
+    _cfgs: Dict[int, object] = field(default_factory=dict)
 
     def module(self, rel_suffix: str) -> Optional[Module]:
         for m in self.modules:
@@ -159,6 +160,18 @@ class AnalysisContext:
             from .callgraph import build
             self._callgraph = build(self.modules)
         return self._callgraph
+
+    def cfg(self, fn: ast.AST):
+        """Control-flow graph for a function node, built once per run and
+        shared by every flow-sensitive rule (same economics as the call
+        graph: one lowering, many analyses). Keyed by node identity —
+        modules are parsed once, so the same def is the same object."""
+        cached = self._cfgs.get(id(fn))
+        if cached is None:
+            from .cfg import build_cfg
+            cached = build_cfg(fn)
+            self._cfgs[id(fn)] = cached
+        return cached
 
     def readme(self) -> str:
         if self._readme is None:
@@ -323,13 +336,13 @@ def all_rules() -> List[Rule]:
                    collective_hygiene, drift_guards, events_drift,
                    exception_hygiene, filter_path, fused_path,
                    ingest_hot_loop, jit_hygiene, join_path, lock_discipline,
-                   memory_hygiene, transport_bypass)
+                   lock_order, memory_hygiene, transport_bypass)
     rules: List[Rule] = []
-    for pack in (jit_hygiene, lock_discipline, blocking_in_loop, drift_guards,
-                 events_drift, transport_bypass, collective_hygiene,
-                 ingest_hot_loop, exception_hygiene, admission_hygiene,
-                 filter_path, fused_path, join_path, memory_hygiene,
-                 accumulation):
+    for pack in (jit_hygiene, lock_discipline, lock_order, blocking_in_loop,
+                 drift_guards, events_drift, transport_bypass,
+                 collective_hygiene, ingest_hot_loop, exception_hygiene,
+                 admission_hygiene, filter_path, fused_path, join_path,
+                 memory_hygiene, accumulation):
         rules.extend(pack.rules())
     return rules
 

@@ -117,6 +117,11 @@ class HostSyncRule(Rule):
         if isinstance(arg, ast.Name):
             return taint.get(arg.id)
         if isinstance(arg, ast.Call):
+            # `self._cache.get(k)` reads an element — taint of the container
+            from .callgraph import ELEMENT_GETTERS
+            if isinstance(arg.func, ast.Attribute) and \
+                    arg.func.attr in ELEMENT_GETTERS:
+                return self._arg_chain(arg.func.value, taint, fi, cg)
             callee = cg.resolve_call(fi, arg.func)
             if callee is not None and callee.returns_device:
                 return callee.device_chain
@@ -136,6 +141,10 @@ class HostSyncRule(Rule):
         if isinstance(arg, ast.Attribute) and \
                 isinstance(arg.value, ast.Name) and arg.value.id == "self":
             return f"self.{arg.attr}"
+        if isinstance(arg, ast.Subscript):
+            base = HostSyncRule._describe(arg.value)
+            if base != "expression":
+                return f"{base}[...]"
         return dotted_name(getattr(arg, "func", arg)) or "expression"
 
 

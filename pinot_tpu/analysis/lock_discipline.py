@@ -6,8 +6,12 @@ outside the lock; this pack generalizes that audit. For any class that owns a
 
 * an attribute written under `with self._lock` in one method and without it
   in another is a torn-write hazard (`lock-unguarded-write`);
-* direct `.acquire()`/`.release()` instead of `with` leaks the lock on any
-  exception between them (`lock-manual-acquire`);
+* a manual `.acquire()` whose CFG has an exception or return path that exits
+  with the lock still held leaks it (`lock-manual-acquire` — flow-sensitive
+  via cfg.py: `acquire(); try: ... finally: release()` is clean);
+* a guarded attribute written after a mid-method `release()`, or on a path
+  where the lock was only conditionally acquired, updates shared state
+  lock-free (`lock-state-flow`);
 * a `threading.Thread(...)` started with no join/stop path anywhere in its
   owner means shutdown cannot fence in-flight work (`thread-no-join`).
 
@@ -19,11 +23,17 @@ what `# graftcheck: ignore[lock-unguarded-write] -- held by caller` is for.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
+from . import cfg as cfgmod
 from .core import AnalysisContext, Finding, Module, Rule, dotted_name
 
 _LOCK_FACTORIES = ("threading.Lock", "threading.RLock", "threading.Condition")
+
+#: admission-style primitives: tracked for manual acquire/release LEAK
+#: analysis only (a lost permit throttles forever), never for guarded-write
+#: semantics (holding a semaphore is not mutual exclusion)
+_SEM_FACTORIES = ("threading.Semaphore", "threading.BoundedSemaphore")
 
 #: container method calls treated as writes to the receiver attribute
 _MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "update",
@@ -105,6 +115,160 @@ def _write_targets(node: ast.AST) -> List[Tuple[str, ast.AST]]:
     return out
 
 
+# -- flow-sensitive lock states ----------------------------------------------
+#
+# Built on the cfg.py forward-dataflow engine.  The state is, per tracked
+# lock, the SET of statuses it may have at a program point:
+#
+#   held      — a with-enter or manual acquire() dominates this point
+#   free      — never (or not currently) taken on this path
+#   released  — a manual release() executed earlier in the method
+#
+# encoded as a frozenset of (lock, status) pairs; join = set union, so a
+# merge point remembers every possibility ("maybe held").  Flow states only
+# diverge from the syntactic with-walk when a method uses manual
+# acquire()/release(), so the CFG work is gated on seeing one.
+
+_HELD, _FREE, _RELEASED = "held", "free", "released"
+
+_LockState = FrozenSet[Tuple[str, str]]
+
+
+def _lock_of_expr(expr: ast.AST, lock_names: Set[str]) -> Optional[str]:
+    attr = _self_attr(expr)
+    if attr is None and isinstance(expr, ast.Name):
+        attr = expr.id
+    return attr if attr in lock_names else None
+
+
+def _is_lockish(name: str) -> bool:
+    return "lock" in name.lower() or "mutex" in name.lower()
+
+
+def _manual_ops(method: ast.AST, lock_names: Set[str]
+                ) -> List[Tuple[str, str, ast.Call]]:
+    """(kind, lock, call) for manual `.acquire()`/`.release()` calls on
+    tracked locks (or lockish-named receivers) in this method, excluding
+    nested function bodies."""
+    out: List[Tuple[str, str, ast.Call]] = []
+    for stmt in getattr(method, "body", ()):
+        for n in cfgmod.shallow_walk(stmt):
+            if not isinstance(n, ast.Call) or \
+                    not isinstance(n.func, ast.Attribute) or \
+                    n.func.attr not in ("acquire", "release"):
+                continue
+            recv = dotted_name(n.func.value)
+            if not recv:
+                continue
+            term = recv.rsplit(".", 1)[-1]
+            if term in lock_names or _is_lockish(term):
+                out.append((n.func.attr, term, n))
+    return out
+
+
+class _LockFlow(cfgmod.ForwardAnalysis):
+    def __init__(self, lock_names: Set[str]):
+        self.locks = frozenset(lock_names)
+
+    def initial(self) -> _LockState:
+        return frozenset((l, _FREE) for l in self.locks)
+
+    def bottom(self):
+        return None  # unreachable
+
+    def join(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a | b
+
+    def may_raise(self, stmt) -> bool:
+        # Pragmatic raise model: only CALLS (and explicit `raise`) create
+        # exception edges.  Plain assignments/tests between acquire() and
+        # release() cannot realistically throw, and treating them as raise
+        # sources would flag every manual critical section no matter how
+        # it is guarded.  Lock ops themselves are exempt too: a failed
+        # acquire never held the lock, a failed release is already fatal.
+        if not isinstance(stmt, ast.AST):
+            return False  # WithEnter/WithExit markers
+        for n in cfgmod.shallow_walk(stmt):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in ("acquire", "release") and \
+                        self._resolve_lock(f.value):
+                    continue
+                return True
+        return False
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        lock = _lock_of_expr(expr, self.locks)
+        if lock is None:
+            recv = dotted_name(expr)
+            term = recv.rsplit(".", 1)[-1] if recv else ""
+            lock = term if term in self.locks else None
+        return lock
+
+    def _set(self, state: _LockState, lock: str,
+             statuses: Iterable[str]) -> _LockState:
+        kept = {p for p in state if p[0] != lock}
+        kept.update((lock, s) for s in statuses)
+        return frozenset(kept)
+
+    def transfer(self, stmt, state):
+        if state is None:
+            return None
+        if isinstance(stmt, cfgmod.WithEnter):
+            lock = _lock_of_expr(stmt.node.context_expr, self.locks)
+            return self._set(state, lock, (_HELD,)) if lock else state
+        if isinstance(stmt, cfgmod.WithExit):
+            lock = _lock_of_expr(stmt.node.context_expr, self.locks)
+            return self._set(state, lock, (_FREE,)) if lock else state
+        if not isinstance(stmt, ast.AST):
+            return state
+        calls = [n for n in cfgmod.shallow_walk(stmt)
+                 if isinstance(n, ast.Call) and
+                 isinstance(n.func, ast.Attribute) and
+                 n.func.attr in ("acquire", "release")]
+        for call in sorted(calls, key=lambda c: (c.lineno, c.col_offset)):
+            lock = self._resolve_lock(call.func.value)
+            if lock is None:
+                continue
+            if call.func.attr == "release":
+                state = self._set(state, lock, (_RELEASED,))
+            else:
+                # An acquire whose result is *used* (if-test, assignment)
+                # is a conditional/timeout acquire — the lock is only
+                # maybe held afterwards.
+                definite = isinstance(stmt, ast.Expr) and stmt.value is call
+                state = self._set(
+                    state, lock, (_HELD,) if definite else (_HELD, _FREE))
+        return state
+
+
+def _statuses(state: Optional[_LockState], lock: str) -> Set[str]:
+    if state is None:
+        return set()
+    return {s for (l, s) in state if l == lock}
+
+
+def _flow_for_method(ctx: AnalysisContext, method: ast.AST,
+                     lock_names: Set[str]):
+    """(cfg, in_states, analysis) for a method, or None when the method has
+    no manual lock ops (flow states would never diverge from the with-walk)."""
+    ops = _manual_ops(method, lock_names)
+    if not ops:
+        return None
+    tracked = set(lock_names) | {lock for _, lock, _ in ops}
+    analysis = _LockFlow(tracked)
+    graph = ctx.cfg(method)
+    states = cfgmod.run_forward(graph, analysis)
+    return graph, states, analysis, ops
+
+
 class UnguardedWriteRule(Rule):
     id = "lock-unguarded-write"
     description = ("attribute written both under `with self._lock` and "
@@ -114,11 +278,11 @@ class UnguardedWriteRule(Rule):
                      ) -> Iterable[Finding]:
         out: List[Finding] = []
         for cls in module.nodes_of(ast.ClassDef):
-            out.extend(self._check_class(cls, module))
+            out.extend(self._check_class(cls, module, ctx))
         return out
 
-    def _check_class(self, cls: ast.ClassDef, module: Module
-                     ) -> Iterable[Finding]:
+    def _check_class(self, cls: ast.ClassDef, module: Module,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
         locks = _lock_attrs(cls)
         if not locks:
             return ()
@@ -127,10 +291,22 @@ class UnguardedWriteRule(Rule):
         for method in cls.body:
             if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
+            # Flow states let a manual acquire()/release() method count its
+            # definitely-held writes as guarded, and hand its released /
+            # maybe-held writes to LockStateFlowRule instead of reporting
+            # them here path-insensitively.
+            site_states = self._site_states(ctx, method, locks)
             for node in ast.walk(method):
                 for attr, site in _write_targets(node):
                     if attr in locks:
                         continue
+                    flow = site_states.get(id(site))
+                    if flow is not None:
+                        if any(sts == {_HELD} for sts in flow.values()):
+                            guarded.add(attr)
+                            continue
+                        if any(sts - {_FREE} for sts in flow.values()):
+                            continue  # LockStateFlowRule's finding
                     if _held_locks(node, method, locks):
                         guarded.add(attr)
                     elif method.name != "__init__":
@@ -145,30 +321,193 @@ class UnguardedWriteRule(Rule):
                     "why this write is safe"))
         return out
 
+    @staticmethod
+    def _site_states(ctx: AnalysisContext, method: ast.AST, locks: Set[str]
+                     ) -> Dict[int, Dict[str, Set[str]]]:
+        """id(write site) -> {lock: possible statuses} for methods with
+        manual lock ops; empty for the (common) purely-`with` methods."""
+        flow = _flow_for_method(ctx, method, locks)
+        if flow is None:
+            return {}
+        graph, _states, analysis, _ops = flow
+        out: Dict[int, Dict[str, Set[str]]] = {}
+
+        def observe(stmt, state, _bidx):
+            if not isinstance(stmt, ast.AST):
+                return
+            for n in cfgmod.shallow_walk(stmt):
+                for _attr, site in _write_targets(n):
+                    out[id(site)] = {lock: _statuses(state, lock)
+                                     for lock in analysis.locks}
+
+        cfgmod.run_forward(graph, analysis, observe=observe)
+        return out
+
+
+def _module_level_locks(module: Module) -> Set[str]:
+    """Names bound to threading lock factories at module level."""
+    out: Set[str] = set()
+    tree = module.tree
+    if tree is None:
+        return out
+    for node in getattr(tree, "body", ()):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and dotted_name(node.value.func) in _LOCK_FACTORIES:
+            out.update(t.id for t in node.targets if isinstance(t, ast.Name))
+    return out
+
+
+def _factory_bound_names(module: Module) -> Set[str]:
+    """Every name (including function locals and self-attrs) bound to a
+    lock OR semaphore factory anywhere in the module — the receiver set for
+    manual acquire/release leak analysis.  A `window =
+    threading.Semaphore(n)` flow-control permit leaks exactly like a lock."""
+    out: Set[str] = set()
+    for node in module.nodes_of(ast.Assign):
+        if not isinstance(node.value, ast.Call):
+            continue
+        if dotted_name(node.value.func) not in \
+                _LOCK_FACTORIES + _SEM_FACTORIES:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            else:
+                attr = _self_attr(t)
+                if attr:
+                    out.add(attr)
+    return out
+
 
 class ManualAcquireRule(Rule):
     id = "lock-manual-acquire"
-    description = ("lock.acquire()/release() outside `with` leaks the lock "
-                   "on any exception in between")
+    description = ("manual lock.acquire() with an exception or return path "
+                   "that leaks the lock — use `with` or try/finally")
 
     def check_module(self, module: Module, ctx: AnalysisContext
                      ) -> Iterable[Finding]:
-        lock_attrs: Set[str] = set()
+        lock_attrs: Set[str] = _factory_bound_names(module)
         for cls in module.nodes_of(ast.ClassDef):
             lock_attrs |= _lock_attrs(cls)
         out: List[Finding] = []
-        for node in module.nodes_of(ast.Call):
-            if not isinstance(node.func, ast.Attribute):
+        for fn in module.nodes_of(ast.FunctionDef, ast.AsyncFunctionDef):
+            out.extend(self._check_function(fn, module, ctx, lock_attrs))
+        return out
+
+    def _check_function(self, fn: ast.AST, module: Module,
+                        ctx: AnalysisContext, lock_attrs: Set[str]
+                        ) -> Iterable[Finding]:
+        flow = _flow_for_method(ctx, fn, lock_attrs)
+        if flow is None:
+            return ()
+        graph, states, _analysis, ops = flow
+        out: List[Finding] = []
+        reported: Set[str] = set()
+        for kind, lock, call in ops:
+            if kind != "acquire" or lock in reported:
                 continue
-            if node.func.attr not in ("acquire", "release"):
-                continue
-            recv = dotted_name(node.func.value)
-            terminal = recv.rsplit(".", 1)[-1]
-            if terminal in lock_attrs or "lock" in terminal.lower():
+            reported.add(lock)
+            recv = dotted_name(call.func.value) or lock
+            raise_sts = _statuses(states.get(graph.raise_exit), lock)
+            exit_sts = _statuses(states.get(graph.exit), lock)
+            if _HELD in raise_sts:
                 out.append(Finding(
-                    self.id, module.rel, node.lineno,
-                    f"`{recv}.{node.func.attr}()` called directly — use "
-                    "`with` so the lock is released on every exit path"))
+                    self.id, module.rel, call.lineno,
+                    f"`{recv}.acquire()` has an exception path that leaks "
+                    "the lock — wrap the critical section in `with` or "
+                    "release in try/finally"))
+            elif _HELD in exit_sts and _FREE not in exit_sts:
+                out.append(Finding(
+                    self.id, module.rel, call.lineno,
+                    f"`{recv}.acquire()` can return from "
+                    f"{getattr(fn, 'name', '<fn>')}() with the lock still "
+                    "held — release on every exit path"))
+        return out
+
+
+class LockStateFlowRule(Rule):
+    id = "lock-state-flow"
+    description = ("write to a lock-guarded attribute on a path where the "
+                   "lock was released mid-method or only conditionally "
+                   "acquired")
+
+    def check_module(self, module: Module, ctx: AnalysisContext
+                     ) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in module.nodes_of(ast.ClassDef):
+            out.extend(self._check_class(cls, module, ctx))
+        return out
+
+    def _check_class(self, cls: ast.ClassDef, module: Module,
+                     ctx: AnalysisContext) -> Iterable[Finding]:
+        locks = _lock_attrs(cls)
+        if not locks:
+            return ()
+        guarded = self._guarded_by(cls, locks)
+        if not guarded:
+            return ()
+        out: List[Finding] = []
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) or \
+                    method.name == "__init__":
+                continue
+            flow = _flow_for_method(ctx, method, locks)
+            if flow is None:
+                continue
+            graph, _states, analysis, _ops = flow
+            seen: Set[Tuple[int, str]] = set()
+
+            def observe(stmt, state, _bidx,
+                        method=method, seen=seen, analysis=analysis):
+                if not isinstance(stmt, ast.AST):
+                    return
+                for n in cfgmod.shallow_walk(stmt):
+                    for attr, site in _write_targets(n):
+                        for lock in sorted(guarded.get(attr, ()) &
+                                           analysis.locks):
+                            key = (id(site), lock)
+                            if key in seen:
+                                continue
+                            sts = _statuses(state, lock)
+                            if _RELEASED in sts:
+                                seen.add(key)
+                                out.append(Finding(
+                                    self.id, module.rel, site.lineno,
+                                    f"{cls.name}.{attr} is written in "
+                                    f"{method.name}() after "
+                                    f"self.{lock}.release() — the guarded "
+                                    "state is updated lock-free on this "
+                                    "path"))
+                            elif _HELD in sts and _FREE in sts:
+                                seen.add(key)
+                                out.append(Finding(
+                                    self.id, module.rel, site.lineno,
+                                    f"{cls.name}.{attr} write in "
+                                    f"{method.name}() is reachable both "
+                                    f"with and without self.{lock} held — "
+                                    "one branch skips the acquire"))
+
+            cfgmod.run_forward(graph, analysis, observe=observe)
+        return out
+
+    @staticmethod
+    def _guarded_by(cls: ast.ClassDef, locks: Set[str]
+                    ) -> Dict[str, Set[str]]:
+        """attr -> owned locks under which it is written somewhere in the
+        class (the syntactic `with` walk; manual definitely-held writes are
+        already credited by UnguardedWriteRule)."""
+        out: Dict[str, Set[str]] = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(method):
+                for attr, _site in _write_targets(node):
+                    if attr in locks:
+                        continue
+                    held = _held_locks(node, method, locks)
+                    if held:
+                        out.setdefault(attr, set()).update(held)
         return out
 
 
@@ -409,5 +748,5 @@ class RaceCrossMethodRule(Rule):
 
 
 def rules() -> List[Rule]:
-    return [UnguardedWriteRule(), ManualAcquireRule(), ThreadJoinRule(),
-            RaceCrossMethodRule()]
+    return [UnguardedWriteRule(), ManualAcquireRule(), LockStateFlowRule(),
+            ThreadJoinRule(), RaceCrossMethodRule()]

@@ -1,0 +1,256 @@
+"""lock-order-inversion: a global lock-acquisition-order graph.
+
+Every function contributes edges `A -> B` whenever lock B is acquired while
+lock A is held — directly (`with self._a: ... with self._b:`) or through a
+call chain (`with self._a: self._flush()` where `_flush` takes `self._b`,
+possibly in another module).  Transitive acquisition sets are folded through
+the PR 13 call graph with a small fixpoint, then strongly-connected
+components of the order graph are reported as potential deadlocks: two
+threads taking the same pair of locks in opposite orders can block each
+other forever.
+
+Lock identity is class-qualified (`Broker._lock`) for `self.` locks and
+module-qualified (`pinot_tpu.ingest.stream._LOCK`) for module-level locks,
+so the same attribute name on different classes never aliases.  Locks that
+cannot be resolved to an owner (a lock passed in as a parameter) are skipped
+— better silent than wrong.
+
+The finding message lists only the sorted lock set (line-free, path-free) so
+the fingerprint survives refactors; the conflicting acquisition sites are
+rendered in the chain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Rule
+from .lock_discipline import _is_lockish, _module_level_locks
+
+_FIXPOINT_CAP = 20
+
+_Site = Tuple[str, int, str]    # (rel, line, function display)
+
+
+class _FnOrder:
+    __slots__ = ("acquires", "edges", "calls")
+
+    def __init__(self) -> None:
+        #: lock id -> first acquisition site in this function
+        self.acquires: Dict[str, _Site] = {}
+        #: (outer, inner) -> site of the inner acquisition
+        self.edges: Dict[Tuple[str, str], _Site] = {}
+        #: (call node, locks held at the site, line)
+        self.calls: List[Tuple[ast.Call, Tuple[str, ...], int]] = []
+
+
+class LockOrderRule(Rule):
+    id = "lock-order-inversion"
+    description = ("two locks are acquired in opposite orders on different "
+                   "code paths (folded through the call graph) — a potential "
+                   "deadlock")
+
+    def check_project(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        cg = ctx.callgraph()
+        key_of = {id(fi): key for key, fi in cg.functions.items()}
+        module_locks = {m.rel: _module_level_locks(m) for m in ctx.modules}
+
+        orders: Dict[str, _FnOrder] = {}
+        for key, fi in cg.functions.items():
+            orders[key] = self._collect(fi, module_locks.get(
+                fi.module.rel, set()), cg)
+
+        # transitive acquisition sets, to fixpoint through the call graph
+        acq: Dict[str, Set[str]] = {
+            key: set(o.acquires) for key, o in orders.items()}
+        for _ in range(_FIXPOINT_CAP):
+            changed = False
+            for key, fi in cg.functions.items():
+                mine = acq[key]
+                for call, _held, _line in orders[key].calls:
+                    callee = cg.resolve_call(fi, call.func)
+                    ckey = key_of.get(id(callee)) if callee else None
+                    if ckey is None or ckey == key:
+                        continue
+                    extra = acq[ckey] - mine
+                    if extra:
+                        mine |= extra
+                        changed = True
+            if not changed:
+                break
+
+        # global order edges: direct nesting + call-sites under held locks
+        edges: Dict[Tuple[str, str], _Site] = {}
+        for key, fi in cg.functions.items():
+            o = orders[key]
+            for e, site in o.edges.items():
+                edges.setdefault(e, site)
+            for call, held, line in o.calls:
+                if not held:
+                    continue
+                callee = cg.resolve_call(fi, call.func)
+                ckey = key_of.get(id(callee)) if callee else None
+                if ckey is None or ckey == key:
+                    continue
+                for inner in acq[ckey]:
+                    for outer in held:
+                        if outer == inner:
+                            continue
+                        edges.setdefault(
+                            (outer, inner),
+                            (fi.module.rel, line,
+                             f"{fi.display}() -> {callee.display}()"))
+
+        return self._report(edges)
+
+    # -- per-function collection -------------------------------------------
+
+    def _collect(self, fi, module_locks: Set[str], cg) -> _FnOrder:
+        out = _FnOrder()
+        rel = fi.module.rel
+
+        def lock_id(expr: ast.AST) -> Optional[str]:
+            # with self._a: / with cls._a:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls"):
+                if fi.cls is None:
+                    return None
+                attr = expr.attr
+                if attr in fi.cls.lock_attrs or _is_lockish(attr):
+                    return f"{fi.cls.name}.{attr}"
+                return None
+            # with _LOCK: — module-level lock (possibly imported: canonicalize
+            # through the module's import table so `from x import _LOCK`
+            # aliases to the owning module, not the user's)
+            if isinstance(expr, ast.Name):
+                name = expr.id
+                if name in module_locks or \
+                        (_is_lockish(name) and name.isupper()):
+                    from .callgraph import module_name_for
+                    expanded = cg.expand_name(rel, name)
+                    if expanded != name:
+                        return expanded
+                    return f"{module_name_for(rel)}.{name}"
+            return None
+
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Lambda)
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, nested):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner_held = held
+                for item in node.items:
+                    walk(item.context_expr, inner_held)
+                    lid = lock_id(item.context_expr)
+                    if lid is not None:
+                        out.acquires.setdefault(
+                            lid, (rel, item.context_expr.lineno, fi.display))
+                        for outer in inner_held:
+                            if outer != lid:
+                                out.edges.setdefault(
+                                    (outer, lid),
+                                    (rel, item.context_expr.lineno,
+                                     fi.display))
+                        inner_held = inner_held + (lid,)
+                for stmt in node.body:
+                    walk(stmt, inner_held)
+                return
+            if isinstance(node, ast.Call):
+                out.calls.append((node, held, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in getattr(fi.node, "body", ()):
+            walk(stmt, ())
+        return out
+
+    # -- cycle reporting ----------------------------------------------------
+
+    def _report(self, edges: Dict[Tuple[str, str], _Site]
+                ) -> Iterable[Finding]:
+        succs: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            succs.setdefault(a, set()).add(b)
+            succs.setdefault(b, set())
+        out: List[Finding] = []
+        for scc in self._sccs(succs):
+            if len(scc) < 2:
+                continue
+            cycle = sorted(scc)
+            # render the witness edges inside the component
+            witness = []
+            for e in sorted(edges):
+                if e[0] in scc and e[1] in scc:
+                    rel_, line_, fn_ = edges[e]
+                    witness.append(
+                        f"{e[0]} -> {e[1]} ({fn_} at {rel_}:{line_})")
+            first = edges[min(e for e in edges
+                              if e[0] in scc and e[1] in scc)]
+            out.append(Finding(
+                self.id, first[0], first[1],
+                "lock-order inversion between "
+                f"{', '.join(cycle)} — these locks are acquired in "
+                "conflicting orders on different paths; two threads can "
+                "deadlock",
+                chain="; ".join(witness[:6])))
+        return out
+
+    @staticmethod
+    def _sccs(succs: Dict[str, Set[str]]) -> List[Set[str]]:
+        """Tarjan's strongly-connected components (iterative)."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[Set[str]] = []
+        counter = [0]
+
+        def strongconnect(root: str) -> None:
+            work: List[Tuple[str, Optional[str], List[str]]] = [
+                (root, None, list(succs.get(root, ())))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                v, parent, iters = work[-1]
+                advanced = False
+                while iters:
+                    w = iters.pop()
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, v, list(succs.get(w, ()))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if parent is not None:
+                    low[parent] = min(low[parent], low[v])
+                if low[v] == index[v]:
+                    comp: Set[str] = set()
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.add(w)
+                        if w == v:
+                            break
+                    sccs.append(comp)
+
+        for node in succs:
+            if node not in index:
+                strongconnect(node)
+        return sccs
+
+
+def rules() -> List[Rule]:
+    return [LockOrderRule()]

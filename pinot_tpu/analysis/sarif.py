@@ -1,0 +1,64 @@
+"""SARIF 2.1.0 output for graftcheck (`--format sarif`).
+
+The minimal static-analysis interchange shape CI annotators consume: one
+run, one tool driver with the active rule set, one result per NEW finding.
+`partialFingerprints` carries the graftcheck fingerprint under the
+`graftcheck/v1` key so SARIF-aware baselining dedups exactly like the
+committed baseline.json does (line-free, chain-free).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .core import Finding, Rule
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+#: the partialFingerprints key: bump the suffix if fingerprint semantics
+#: ever change incompatibly
+FINGERPRINT_KEY = "graftcheck/v1"
+
+
+def to_sarif(findings: Sequence[Finding],
+             rules: Sequence[Rule]) -> Dict[str, object]:
+    rule_ids = sorted({f.rule for f in findings} |
+                      {r.id for r in rules if r.id != "abstract"})
+    descriptions = {r.id: r.description for r in rules}
+    results: List[Dict[str, object]] = []
+    for f in findings:
+        message = f.message + (f" [via {f.chain}]" if f.chain else "")
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {FINGERPRINT_KEY: f.fingerprint()},
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftcheck",
+                    "informationUri":
+                        "https://github.com/pinot-tpu/pinot-tpu",
+                    "rules": [
+                        {"id": rid,
+                         "shortDescription":
+                             {"text": descriptions.get(rid, rid)}}
+                        for rid in rule_ids
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }

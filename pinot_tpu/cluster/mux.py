@@ -491,16 +491,31 @@ def serve_mux_stream(body, execute: Callable[[bytes, float],
                 if on_frame is not None:
                     on_frame()
                 t0 = time.perf_counter()
+                # graftcheck: ignore[lock-manual-acquire] -- the permit is
+                # deliberately NOT released here: it is handed to the _run
+                # task and released by _frames() once the response frame is
+                # written, which is the whole flow-control window
                 while not window.acquire(timeout=1.0):
                     if state["aborted"]:
                         return
-                wait_ms = (time.perf_counter() - t0) * 1000
-                with lock:
-                    state["inflight"] += 1
-                # graftcheck: ignore[admission-bypass] -- the window.acquire
-                # above IS the admission gate: at most max_inflight _run
-                # tasks exist per stream
-                executor.submit(_run, tag, payload, wait_ms)
+                try:
+                    with lock:
+                        state["inflight"] += 1
+                    wait_ms = (time.perf_counter() - t0) * 1000
+                    # graftcheck: ignore[admission-bypass] -- the
+                    # window.acquire above IS the admission gate: at most
+                    # max_inflight _run tasks exist per stream
+                    executor.submit(_run, tag, payload, wait_ms)
+                except BaseException:
+                    # submit() raises once the executor shuts down mid-stream;
+                    # without this rollback the window permit and the inflight
+                    # count both leak and the stream never drains.  A dead
+                    # executor means the server is going down — end the stream
+                    # cleanly rather than crash the demux thread.
+                    window.release()
+                    with lock:
+                        state["inflight"] -= 1
+                    return
         except ConnectionError:
             pass  # torn stream: the client fails its own in-flight tags
         finally:

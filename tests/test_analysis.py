@@ -1587,3 +1587,591 @@ def test_full_package_run_within_time_budget():
     t0 = time.perf_counter()
     assert analysis_main([]) == 0
     assert time.perf_counter() - t0 < 15.0
+
+
+# -- CFG + forward dataflow (the flow-sensitive layer) ------------------------
+
+def _fn(src):
+    import ast
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def test_cfg_try_finally_edges():
+    """An unmatched exception runs the finally (dispatch -> finally.unwind);
+    a catch-all handler removes the unmatched path entirely."""
+    from pinot_tpu.analysis.cfg import build_cfg
+    g = build_cfg(_fn("""
+        def f(self):
+            try:
+                self.work()
+            except ValueError:
+                self.log()
+            finally:
+                self.cleanup()
+    """))
+    labels = {b.label for b in g.blocks}
+    assert {"try.dispatch", "finally.unwind", "finally"} <= labels
+    dispatch = next(b for b in g.blocks if b.label == "try.dispatch")
+    unwind = next(b for b in g.blocks if b.label == "finally.unwind")
+    assert unwind.idx in dispatch.succs
+
+    g2 = build_cfg(_fn("""
+        def f(self):
+            try:
+                self.work()
+            except BaseException:
+                self.log()
+                raise
+    """))
+    dispatch2 = next(b for b in g2.blocks if b.label == "try.dispatch")
+    assert g2.raise_exit not in dispatch2.succs  # catch-all: no unmatched path
+    # ...but the handler's own `raise` still reaches raise_exit
+    raising = [b for b in g2.blocks if g2.raise_exit in b.succs]
+    assert raising
+
+
+def test_cfg_loop_edges():
+    """break exits to loop.after, continue re-enters loop.head, and the
+    body's fall-through is the back edge."""
+    import ast
+    from pinot_tpu.analysis.cfg import build_cfg
+    g = build_cfg(_fn("""
+        def f(xs):
+            for x in xs:
+                if x > 9:
+                    break
+                if x < 0:
+                    continue
+                handle(x)
+    """))
+    head = next(b for b in g.blocks if b.label == "loop.head")
+    after = next(b for b in g.blocks if b.label == "loop.after")
+    assert any(isinstance(s, ast.expr) for s in head.stmts)  # the iterable
+    break_blocks = [b for b in g.blocks
+                    if any(isinstance(s, ast.Break) for s in b.stmts)]
+    assert break_blocks and all(after.idx in b.succs for b in break_blocks)
+    cont_blocks = [b for b in g.blocks
+                   if any(isinstance(s, ast.Continue) for s in b.stmts)]
+    assert cont_blocks and all(head.idx in b.succs for b in cont_blocks)
+    back = [b for b in g.blocks
+            if head.idx in b.succs and b.idx != g.entry
+            and b not in cont_blocks]
+    assert back  # the body fall-through back edge
+
+
+def test_dataflow_fixpoint_terminates_on_cyclic_cfg():
+    """A while-True loop with a conditional acquire cycles the lattice
+    through {held, free}; the worklist must still reach a fixpoint."""
+    from pinot_tpu.analysis.cfg import build_cfg, run_forward
+    from pinot_tpu.analysis.lock_discipline import _LockFlow
+    fn = _fn("""
+        def f(self):
+            while True:
+                got = self._lock.acquire(timeout=0.1)
+                if got:
+                    self._lock.release()
+                if self.done:
+                    break
+    """)
+    g = build_cfg(fn)
+    states = run_forward(g, _LockFlow({"_lock"}))
+    assert set(states) == {b.idx for b in g.blocks}
+    head = next(b for b in g.blocks if b.label == "loop.head")
+    assert states[head.idx] is not None  # the cycle converged, not skipped
+
+
+# -- lock-manual-acquire (flow-sensitive) -------------------------------------
+
+def test_manual_acquire_exception_leak_true_positive():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def leak(self):
+                self._lock.acquire()
+                self.flush()
+                self._lock.release()
+    """, lock_discipline.rules())
+    leaks = [f for f in active if f.rule == "lock-manual-acquire"]
+    assert len(leaks) == 1 and "exception path" in leaks[0].message
+
+
+def test_manual_acquire_try_finally_is_clean():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def safe(self):
+                self._lock.acquire()
+                try:
+                    self.flush()
+                finally:
+                    self._lock.release()
+    """, lock_discipline.rules())
+    assert "lock-manual-acquire" not in _ids(active)
+
+
+def test_manual_acquire_return_while_held():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def grab(self):
+                self._lock.acquire()
+                return self.value
+    """, lock_discipline.rules())
+    leaks = [f for f in active if f.rule == "lock-manual-acquire"]
+    assert len(leaks) == 1 and "still" in leaks[0].message
+
+
+def test_manual_acquire_semaphore_permit_leak():
+    """The mux.py bug class: a factory-bound LOCAL semaphore whose permit
+    leaks when a call between acquire and the next iteration raises."""
+    active, _ = _check("""
+        import threading
+        def pump(jobs, run):
+            window = threading.Semaphore(4)
+            for j in jobs:
+                window.acquire()
+                run(j)
+    """, lock_discipline.rules())
+    leaks = [f for f in active if f.rule == "lock-manual-acquire"]
+    assert len(leaks) == 1 and "window.acquire()" in leaks[0].message
+
+
+def test_manual_acquire_suppression_honored():
+    active, suppressed = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def leak(self):
+                self._lock.acquire()  # graftcheck: ignore[lock-manual-acquire] -- fixture
+                self.flush()
+                self._lock.release()
+    """, lock_discipline.rules())
+    assert "lock-manual-acquire" not in _ids(active)
+    assert "lock-manual-acquire" in _ids(suppressed)
+
+
+# -- lock-state-flow ----------------------------------------------------------
+
+_STATE_FLOW_BAD = """
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+        def ok(self):
+            with self._lock:
+                self._n += 1
+        def bad(self):
+            self._lock.acquire()
+            try:
+                self._n += 1
+            finally:
+                self._lock.release()
+            self._n += 2{suffix}
+"""
+
+
+def test_lock_state_flow_write_after_release():
+    active, _ = _check(_STATE_FLOW_BAD.format(suffix=""),
+                       lock_discipline.rules())
+    flows = [f for f in active if f.rule == "lock-state-flow"]
+    assert len(flows) == 1
+    assert "after self._lock.release()" in flows[0].message
+    # the definitely-held write inside the try does NOT double-report as
+    # lock-unguarded-write: the flow state credits it as guarded
+    assert "lock-unguarded-write" not in _ids(active)
+
+
+def test_lock_state_flow_conditional_acquire():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def ok(self):
+                with self._lock:
+                    self._n += 1
+            def maybe(self):
+                got = self._lock.acquire(timeout=0.1)
+                self._n += 1
+                if got:
+                    self._lock.release()
+    """, lock_discipline.rules())
+    flows = [f for f in active if f.rule == "lock-state-flow"]
+    assert len(flows) == 1
+    assert "both with and without" in flows[0].message
+
+
+def test_lock_state_flow_clean_negative():
+    active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def ok(self):
+                with self._lock:
+                    self._n += 1
+            def manual_but_correct(self):
+                self._lock.acquire()
+                try:
+                    self._n += 1
+                finally:
+                    self._lock.release()
+    """, lock_discipline.rules())
+    assert "lock-state-flow" not in _ids(active)
+    assert "lock-unguarded-write" not in _ids(active)
+
+
+def test_lock_state_flow_suppression_honored():
+    active, suppressed = _check(
+        _STATE_FLOW_BAD.format(
+            suffix="  # graftcheck: ignore[lock-state-flow] -- fixture"),
+        lock_discipline.rules())
+    assert "lock-state-flow" not in _ids(active)
+    assert "lock-state-flow" in _ids(suppressed)
+
+
+# -- lock-order-inversion -----------------------------------------------------
+
+def test_lock_order_inversion_direct():
+    from pinot_tpu.analysis import lock_order
+    active, _ = _check("""
+        import threading
+        class Broker:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+            def forward(self):
+                with self._alock:
+                    with self._block:
+                        pass
+            def reverse(self):
+                with self._block:
+                    with self._alock:
+                        pass
+    """, lock_order.rules())
+    inv = [f for f in active if f.rule == "lock-order-inversion"]
+    assert len(inv) == 1
+    assert "Broker._alock" in inv[0].message
+    assert "Broker._block" in inv[0].message
+    assert "->" in inv[0].chain  # witness edges ride in the chain
+
+
+def test_lock_order_inversion_through_call_graph():
+    """The two halves of the inversion live in different modules and only
+    meet through the call graph's transitive acquisition sets."""
+    from pinot_tpu.analysis import lock_order
+    active, _ = _project({
+        "pkg/a.py": """
+            import threading
+            from pkg.b import with_b
+            _A_LOCK = threading.Lock()
+            def with_a_then_b():
+                with _A_LOCK:
+                    with_b()
+            def take_a():
+                with _A_LOCK:
+                    pass
+        """,
+        "pkg/b.py": """
+            import threading
+            from pkg.a import take_a
+            _B_LOCK = threading.Lock()
+            def with_b():
+                with _B_LOCK:
+                    pass
+            def with_b_then_a():
+                with _B_LOCK:
+                    take_a()
+        """,
+    }, lock_order.rules())
+    inv = [f for f in active if f.rule == "lock-order-inversion"]
+    assert len(inv) == 1
+    assert "pkg.a._A_LOCK" in inv[0].message
+    assert "pkg.b._B_LOCK" in inv[0].message
+
+
+def test_lock_order_consistent_is_clean():
+    from pinot_tpu.analysis import lock_order
+    active, _ = _check("""
+        import threading
+        class Broker:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+            def one(self):
+                with self._alock:
+                    with self._block:
+                        pass
+            def two(self):
+                with self._alock:
+                    with self._block:
+                        pass
+    """, lock_order.rules())
+    assert "lock-order-inversion" not in _ids(active)
+
+
+def test_lock_order_suppression_honored():
+    from pinot_tpu.analysis import lock_order
+    active, suppressed = _check("""
+        import threading
+        class Broker:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+            def forward(self):
+                with self._alock:
+                    with self._block:  # graftcheck: ignore[lock-order-inversion] -- fixture
+                        pass
+            def reverse(self):
+                with self._block:
+                    with self._alock:
+                        pass
+    """, lock_order.rules())
+    assert "lock-order-inversion" not in _ids(active)
+    assert "lock-order-inversion" in _ids(suppressed)
+
+
+# -- container-element device taint -------------------------------------------
+
+def test_container_element_taint_subscript_store():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        class Cache:
+            def __init__(self):
+                self._vals = {}
+            def put(self, k, a):
+                self._vals[k] = jnp.sum(a)
+            def read(self, k):
+                return float(self._vals[k])
+    """, jit_hygiene.rules())
+    syncs = [f for f in active if f.rule == "jit-host-sync"]
+    assert len(syncs) == 1
+    assert "_vals" in syncs[0].message or "_vals" in syncs[0].chain
+
+
+def test_container_element_taint_append_and_pop():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        class Buf:
+            def __init__(self):
+                self._parts = []
+            def add(self, a):
+                self._parts.append(jnp.dot(a, a))
+            def head(self):
+                return float(self._parts.pop())
+    """, jit_hygiene.rules())
+    assert "jit-host-sync" in _ids(active)
+
+
+def test_container_element_taint_setdefault_and_get():
+    active, _ = _check("""
+        import jax.numpy as jnp
+        class Memo:
+            def __init__(self):
+                self._vals = {}
+            def memo(self, k, a):
+                self._vals.setdefault(k, jnp.sum(a))
+            def peek(self, k):
+                return float(self._vals.get(k))
+    """, jit_hygiene.rules())
+    assert "jit-host-sync" in _ids(active)
+
+
+def test_container_element_host_values_are_clean():
+    active, _ = _check("""
+        class Counts:
+            def __init__(self):
+                self._vals = {}
+            def put(self, k, n):
+                self._vals[k] = n + 1
+            def read(self, k):
+                return float(self._vals[k])
+    """, jit_hygiene.rules())
+    assert "jit-host-sync" not in _ids(active)
+
+
+def test_container_element_taint_suppression_honored():
+    active, suppressed = _check("""
+        import jax.numpy as jnp
+        class Cache:
+            def __init__(self):
+                self._vals = {}
+            def put(self, k, a):
+                self._vals[k] = jnp.sum(a)
+            def read(self, k):
+                return float(self._vals[k])  # graftcheck: ignore[jit-host-sync] -- fixture
+    """, jit_hygiene.rules())
+    assert "jit-host-sync" not in _ids(active)
+    assert "jit-host-sync" in _ids(suppressed)
+
+
+# -- SARIF output -------------------------------------------------------------
+
+def test_cli_sarif_round_trip(tmp_path, capsys):
+    """--format sarif carries the same findings as --format json: same rules,
+    same lines, and the canonical fingerprint under partialFingerprints."""
+    import json
+    from pinot_tpu.analysis.core import Finding
+    bad = tmp_path / "seeded.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def leak(self):
+                self._lock.acquire()
+                self.flush()
+                self._lock.release()
+        def g(futs):
+            return [f.result() for f in futs]
+    """))
+    assert analysis_main([str(bad), "--no-baseline", "--format", "json"]) == 1
+    jnew = json.loads(capsys.readouterr().out)["new"]
+    assert analysis_main([str(bad), "--no-baseline", "--format", "sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == [f["rule"] for f in jnew]
+    assert [r["locations"][0]["physicalLocation"]["region"]["startLine"]
+            for r in results] == [f["line"] for f in jnew]
+    want = [Finding(f["rule"], f["path"], f["line"], f["message"],
+                    chain=f.get("chain", "")).fingerprint() for f in jnew]
+    assert [r["partialFingerprints"]["graftcheck/v1"]
+            for r in results] == want
+    driver_rules = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"lock-manual-acquire", "lock-state-flow",
+            "lock-order-inversion"} <= driver_rules
+    # a clean file: exit 0, zero results, rule metadata still present
+    ok = tmp_path / "clean.py"
+    ok.write_text("x = 1\n")
+    assert analysis_main([str(ok), "--no-baseline", "--format", "sarif"]) == 0
+    empty = json.loads(capsys.readouterr().out)
+    assert empty["runs"][0]["results"] == []
+    assert empty["runs"][0]["tool"]["driver"]["rules"]
+
+
+# -- --changed-only closure reaches flow-sensitive dependents -----------------
+
+def test_changed_only_closure_reaches_lock_flow_dependents(tmp_path):
+    """Editing a helper must re-fire its importer's lock-flow finding via the
+    reverse import closure; restricting to an unrelated module must not."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "helper.py").write_text(textwrap.dedent("""
+        def compute(x):
+            return x + 1
+    """))
+    (pkg / "consumer.py").write_text(textwrap.dedent("""
+        import threading
+        from pkg.helper import compute
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def step(self, x):
+                self._lock.acquire()
+                y = compute(x)
+                self._lock.release()
+                return y
+    """))
+    (pkg / "unrelated.py").write_text("z = 3\n")
+    root = str(tmp_path)
+    findings, _, _ = run_project(paths=[root], repo_root=root,
+                                 restrict_rels=["pkg/helper.py"])
+    assert "lock-manual-acquire" in _ids(findings)
+    assert all(f.path == "pkg/consumer.py" for f in findings
+               if f.rule == "lock-manual-acquire")
+    findings, _, _ = run_project(paths=[root], repo_root=root,
+                                 restrict_rels=["pkg/unrelated.py"])
+    assert "lock-manual-acquire" not in _ids(findings)
+
+
+# -- fingerprint stability for the flow-sensitive rules -----------------------
+
+def test_lock_state_flow_fingerprint_survives_rename_and_shift():
+    """Line shifts and renaming an uninvolved helper must not churn the
+    lock-state-flow fingerprint (message is line-free)."""
+    before_active, _ = _check("""
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def helper_one(self):
+                return 1
+            def ok(self):
+                with self._lock:
+                    self._n += 1
+            def bad(self):
+                self._lock.acquire()
+                try:
+                    self._n += 1
+                finally:
+                    self._lock.release()
+                self._n += 2
+    """, lock_discipline.rules())
+    after_active, _ = _check("""
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def renamed_helper(self):
+                return 1
+
+            def ok(self):
+                with self._lock:
+                    self._n += 1
+
+            def bad(self):
+                self._lock.acquire()
+                try:
+                    self._n += 1
+                finally:
+                    self._lock.release()
+                self._n += 2
+    """, lock_discipline.rules())
+    before = {f.fingerprint() for f in before_active
+              if f.rule == "lock-state-flow"}
+    after = {f.fingerprint() for f in after_active
+             if f.rule == "lock-state-flow"}
+    assert before and before == after
+    lines = {f.line for f in before_active + after_active
+             if f.rule == "lock-state-flow"}
+    assert len(lines) == 2  # the site moved; the identity did not
+
+
+# -- threaded regression: mux flow-control window under submit failure --------
+
+def test_mux_submit_failure_releases_window_permit():
+    """Regression for the demux window-permit leak: when executor.submit
+    raises (shutdown mid-stream), the permit and the inflight count must be
+    rolled back so the stream still drains instead of hanging forever."""
+    import io
+    from concurrent.futures import ThreadPoolExecutor
+    from pinot_tpu.cluster.mux import (serve_mux_stream, _HEADER,
+                                       KIND_REQUEST)
+    ex = ThreadPoolExecutor(1)
+    ex.shutdown()
+    body = io.BytesIO(_HEADER.pack(7, KIND_REQUEST, 0))
+    frames = serve_mux_stream(body, lambda p, w: (200, [b"x"]), ex,
+                              max_inflight=2)
+    out = []
+    consumer = threading.Thread(target=lambda: out.extend(frames))
+    consumer.start()
+    consumer.join(timeout=10.0)
+    assert not consumer.is_alive(), \
+        "mux stream failed to drain after submit() raised"
+    assert out == []  # the failed frame produced no response
